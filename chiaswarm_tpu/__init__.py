@@ -1,0 +1,16 @@
+"""chiaSWARM-TPU: a TPU-native distributed generative-AI inference swarm.
+
+A ground-up JAX/XLA/Pallas rebuild with the capabilities of chiaSWARM
+(reference: /root/reference/swarm/__init__.py:1, v0.37.0). Worker nodes poll a
+central "hive" REST API for generative jobs and execute them on TPU chips via
+jit-compiled Flax pipelines instead of torch/CUDA diffusers pipelines.
+
+Wire protocol, job schema and artifact format are compatible with the
+reference hive (see `hive.py`, `post_processors/artifacts.py`).
+"""
+
+__version__ = "0.1.0"
+
+# The reference identifies itself as chiaSWARM.worker/<version>; we keep the
+# product name with a tpu suffix so hives can distinguish backend capability.
+USER_AGENT = f"chiaSWARM.worker-tpu/{__version__}"

@@ -1,0 +1,4 @@
+from .device import ChipSet
+from .allocator import SliceAllocator
+
+__all__ = ["ChipSet", "SliceAllocator"]

@@ -1,0 +1,77 @@
+"""Chip-slice allocator: jobs sized in chips, placed on free sub-meshes.
+
+The reference shipped a dead `swarm/gpu/device_pool.py` (never imported) and
+used a bare semaphore sized to the GPU count instead (swarm/worker.py:195-196)
+— with the bug that work advertisement always used the *last* device's
+capabilities (swarm/worker.py:45-62). This allocator is that idea done right:
+
+- local chips are partitioned into fixed disjoint slices of `chips_per_job`
+  (0 = one slice spanning every chip);
+- `acquire()` waits for any free slice; `release()` returns it;
+- `capabilities()` aggregates over the whole pool so advertisement reflects
+  what the worker can actually take, not one arbitrary device.
+
+Slices are disjoint device subsets so concurrent jobs never contend for a
+chip; each slice compiles its own programs (XLA caches are per-process, so
+same-shaped jobs on different slices share the compiled executable).
+"""
+
+from __future__ import annotations
+
+import asyncio
+
+import jax
+
+from .device import ChipSet
+
+
+class SliceAllocator:
+    def __init__(self, devices: list | None = None, chips_per_job: int = 0):
+        if devices is None:
+            devices = jax.devices()
+        if not devices:
+            raise Exception("No accelerator devices present. Quitting.")
+
+        n = chips_per_job if chips_per_job > 0 else len(devices)
+        if len(devices) % n != 0:
+            raise ValueError(
+                f"chips_per_job={n} does not divide device count {len(devices)}"
+            )
+
+        self.slices = [
+            ChipSet(devices[i : i + n], slice_id=i // n)
+            for i in range(0, len(devices), n)
+        ]
+        self._free: asyncio.Queue[ChipSet] = asyncio.Queue()
+        for s in self.slices:
+            self._free.put_nowait(s)
+
+    def __len__(self) -> int:
+        return len(self.slices)
+
+    @property
+    def free_count(self) -> int:
+        return self._free.qsize()
+
+    def has_free_slice(self) -> bool:
+        return not self._free.empty()
+
+    async def acquire(self) -> ChipSet:
+        return await self._free.get()
+
+    def release(self, chipset: ChipSet) -> None:
+        self._free.put_nowait(chipset)
+
+    def capabilities(self) -> dict:
+        """Pool-wide capability advertisement for /work polling."""
+        per_slice = self.slices[0].capabilities()
+        total_chips = sum(s.chip_count() for s in self.slices)
+        return {
+            "memory": per_slice["memory"],
+            "gpu": per_slice["gpu"],
+            "chips": total_chips,
+            "hbm_gb": sum(s.hbm_bytes() for s in self.slices) >> 30,
+            "topology": f"{self.slices[0].platform}x{total_chips}"
+            + (f"({len(self.slices)}x{per_slice['chips']})" if len(self.slices) > 1 else ""),
+            "slices": len(self.slices),
+        }

@@ -1,0 +1,136 @@
+"""ChipSet: the TPU analog of the reference's one-CUDA-device abstraction.
+
+Where reference swarm/gpu/device.py:6-53 wraps one `cuda:{i}` device with a
+busy mutex and a per-job seeded torch.Generator, a ChipSet wraps a *set* of
+TPU chips as a `jax.sharding.Mesh` (so one job can be batch-parallel across
+its slice), seeds via `jax.random.key`, and reports chip/HBM capability for
+work advertisement. The 8 GB VRAM floor (:8-11) has no TPU analog — HBM per
+chip is fixed by the platform — so capability is advertised rather than gated.
+"""
+
+from __future__ import annotations
+
+import logging
+import random
+import threading
+import time
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+logger = logging.getLogger(__name__)
+
+# Known HBM per chip (GiB) by device kind; fallback is queried or 16.
+_HBM_GB = {
+    "TPU v2": 8,
+    "TPU v3": 16,
+    "TPU v4": 32,
+    "TPU v5 lite": 16,
+    "TPU v5": 95,
+    "TPU v5p": 95,
+    "TPU v6 lite": 32,
+    "cpu": 4,
+}
+
+
+def hbm_gb_of(device) -> int:
+    kind = getattr(device, "device_kind", "cpu")
+    for prefix, gb in _HBM_GB.items():
+        if kind.startswith(prefix):
+            return gb
+    try:
+        stats = device.memory_stats()
+        return int(stats["bytes_limit"] / (1 << 30))
+    except Exception:
+        return 16
+
+
+class ChipSet:
+    """A fixed subset of local accelerator chips, meshed for one job at a time.
+
+    The mesh has a single ``data`` axis over the slice's chips; pipelines
+    shard the image batch (and CFG pair) over it and may reshape it into
+    finer axes (tp/sp) internally via `parallel.mesh.reshape_mesh`.
+    """
+
+    def __init__(self, devices: list, slice_id: int = 0):
+        if not devices:
+            raise ValueError("ChipSet requires at least one device")
+        self.devices = list(devices)
+        self.slice_id = slice_id
+        self._mutex = threading.Lock()
+
+    # --- identity / capability (reference swarm/gpu/device.py:17-27) ---
+
+    @property
+    def platform(self) -> str:
+        return self.devices[0].platform
+
+    def identifier(self) -> str:
+        ids = ",".join(str(d.id) for d in self.devices)
+        return f"{self.platform}:{ids}"
+
+    def name(self) -> str:
+        return getattr(self.devices[0], "device_kind", self.platform)
+
+    def descriptor(self) -> str:
+        return f"{self.identifier()}:{self.name()}"
+
+    def chip_count(self) -> int:
+        return len(self.devices)
+
+    def hbm_bytes(self) -> int:
+        return sum(hbm_gb_of(d) for d in self.devices) << 30
+
+    def memory(self) -> int:
+        # legacy `memory` capability key (reference swarm/hive.py:19)
+        return self.hbm_bytes()
+
+    def capabilities(self) -> dict:
+        return {
+            # legacy keys a reference hive understands
+            "memory": self.memory(),
+            "gpu": self.name(),
+            # TPU-native keys
+            "chips": self.chip_count(),
+            "hbm_gb": self.hbm_bytes() >> 30,
+            "topology": f"{self.platform}x{self.chip_count()}",
+        }
+
+    # --- execution ---
+
+    def mesh(self, axis_name: str = "data") -> Mesh:
+        return Mesh(np.asarray(self.devices), (axis_name,))
+
+    def __call__(self, func, **kwargs):
+        """Run one job on this slice under the busy lock.
+
+        Mirrors reference swarm/gpu/device.py:29-50: pops model_name, draws a
+        seed when the job didn't pin one, injects the RNG, and stamps the
+        seed into the returned pipeline_config. Here the RNG is a counter-
+        based `jax.random.key` (deterministic across chip counts) and the
+        callback also receives this ChipSet for mesh placement.
+        """
+        if not self._mutex.acquire(blocking=False):
+            logger.error("ChipSet %s is busy but got invoked.", self.identifier())
+            raise Exception("busy")
+        try:
+            model_name = kwargs.pop("model_name")
+            seed = kwargs.pop("seed", None)
+            if seed is None:
+                seed = random.getrandbits(63)
+
+            kwargs["rng"] = jax.random.key(seed)
+            kwargs["chipset"] = self
+
+            started = time.perf_counter()
+            artifacts, pipeline_config = func(self.identifier(), model_name, **kwargs)
+            pipeline_config["seed"] = seed
+            # per-job timing breadcrumb (reference has none; SURVEY §5 asks for it)
+            pipeline_config.setdefault("timings", {})["job_s"] = round(
+                time.perf_counter() - started, 3
+            )
+            return artifacts, pipeline_config
+        finally:
+            self._mutex.release()

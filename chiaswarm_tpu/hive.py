@@ -1,0 +1,163 @@
+"""Hive wire protocol client.
+
+Protocol parity with reference swarm/hive.py:9-88:
+
+  GET  {hive}/work?worker_version&worker_name&memory&gpu  -> {"jobs": [...]}
+  POST {hive}/results  <- result envelope                  -> ack JSON
+  GET  {hive}api/models                                    -> {models, language_models}
+
+Auth is a bearer token; 400 from /work carries a {"message": ...} explaining
+why the hive is refusing this worker (e.g. too slow). We additionally
+advertise TPU capability (`chips`, `hbm_gb`, `topology`) alongside the legacy
+`memory`/`gpu` keys so a capability-aware hive can place by chip count while
+legacy hives keep working.
+
+Unlike the reference (one aiohttp session per call), `HiveClient` holds a
+single session for connection reuse; the module-level functions keep the
+reference's call signatures for drop-in use.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+from typing import Any
+
+import aiohttp
+
+from . import USER_AGENT, __version__
+
+logger = logging.getLogger(__name__)
+
+ASK_TIMEOUT_S = 10
+SUBMIT_TIMEOUT_S = 90
+
+
+class HiveError(Exception):
+    """Raised when the hive returns a non-retryable error response."""
+
+
+class HiveClient:
+    def __init__(self, settings, hive_uri: str):
+        self.settings = settings
+        self.hive_uri = hive_uri.rstrip("/")
+        self._session: aiohttp.ClientSession | None = None
+
+    def _headers(self) -> dict[str, str]:
+        return {
+            "Content-type": "application/json",
+            "Authorization": f"Bearer {self.settings.sdaas_token}",
+            "user-agent": USER_AGENT,
+        }
+
+    async def _get_session(self) -> aiohttp.ClientSession:
+        if self._session is None or self._session.closed:
+            self._session = aiohttp.ClientSession()
+        return self._session
+
+    async def close(self) -> None:
+        if self._session is not None and not self._session.closed:
+            await self._session.close()
+
+    async def ask_for_work(self, capabilities: dict[str, Any]) -> list[dict]:
+        """Poll the hive for jobs, advertising this worker's capabilities.
+
+        `capabilities` comes from the chip layer (chips/allocator.py) and
+        includes legacy keys (`memory`, `gpu`) plus TPU keys.
+        """
+        logger.info("asking for work from %s", self.hive_uri)
+        params = {
+            "worker_version": __version__,
+            "worker_name": self.settings.worker_name,
+            **{k: str(v) for k, v in capabilities.items()},
+        }
+        session = await self._get_session()
+        timeout = aiohttp.ClientTimeout(total=ASK_TIMEOUT_S)
+        async with session.get(
+            f"{self.hive_uri}/work",
+            params=params,
+            headers=self._headers(),
+            timeout=timeout,
+        ) as response:
+            if response.status == 200:
+                try:
+                    payload = await response.json()
+                    return payload["jobs"]
+                except Exception:
+                    logger.exception("malformed /work response")
+                    return []
+
+            if response.status == 400:
+                # hive refuses this worker (reference swarm/hive.py:39-44)
+                payload = await response.json()
+                message = payload.get("message", "bad worker")
+                logger.warning("hive refused worker: %s", message)
+
+            response.raise_for_status()
+            return []
+
+    async def submit_result(self, result: dict) -> dict:
+        session = await self._get_session()
+        timeout = aiohttp.ClientTimeout(total=SUBMIT_TIMEOUT_S)
+        async with session.post(
+            f"{self.hive_uri}/results",
+            data=json.dumps(result),
+            headers=self._headers(),
+            timeout=timeout,
+        ) as response:
+            response.raise_for_status()
+            ack = await response.json()
+            logger.info("result ack: %s", ack)
+            return ack
+
+    async def get_models(self) -> list[dict]:
+        """Fetch the hive's model catalog; cached to models.json on success."""
+        from .settings import save_file
+
+        # normalize whether we were handed the API base ({uri}/api, as Worker
+        # does) or the bare site URI (as the reference's initialize CLI does)
+        base = self.hive_uri
+        models_url = (
+            f"{base}/models" if base.endswith("/api") else f"{base}/api/models"
+        )
+        try:
+            session = await self._get_session()
+            timeout = aiohttp.ClientTimeout(total=ASK_TIMEOUT_S)
+            async with session.get(
+                models_url,
+                headers={"user-agent": USER_AGENT},
+                timeout=timeout,
+            ) as response:
+                data = await response.json()
+                save_file(data, "models.json")
+                return data["language_models"] + data["models"]
+        except Exception as e:
+            logger.warning("failed to fetch model list: %s", e)
+            return []
+
+
+# --- reference-signature wrappers (swarm/hive.py:9,50,69) ---
+
+
+async def ask_for_work(settings, hive_uri: str, capabilities: dict) -> list[dict]:
+    client = HiveClient(settings, hive_uri)
+    try:
+        return await client.ask_for_work(capabilities)
+    finally:
+        await client.close()
+
+
+async def submit_result(settings, hive_uri: str, result: dict) -> dict:
+    client = HiveClient(settings, hive_uri)
+    try:
+        return await client.submit_result(result)
+    finally:
+        await client.close()
+
+
+async def get_models(hive_uri: str) -> list[dict]:
+    client = HiveClient(type("S", (), {"sdaas_token": ""})(), hive_uri)
+    try:
+        return await client.get_models()
+    finally:
+        await client.close()

@@ -1,0 +1,26 @@
+"""Rotating-file logging setup (behavior parity: swarm/log_setup.py:5-29)."""
+
+from __future__ import annotations
+
+import logging
+import logging.handlers
+from pathlib import Path
+
+MAX_BYTES = 50 * 1024 * 1024
+BACKUP_COUNT = 7
+
+
+def setup_logging(log_path: Path | str, log_level: str = "WARN") -> None:
+    log_path = Path(log_path)
+    log_path.parent.mkdir(parents=True, exist_ok=True)
+
+    handler = logging.handlers.RotatingFileHandler(
+        log_path, maxBytes=MAX_BYTES, backupCount=BACKUP_COUNT
+    )
+    handler.setFormatter(
+        logging.Formatter("%(asctime)s %(levelname)s %(name)s %(message)s")
+    )
+
+    root = logging.getLogger()
+    root.setLevel(log_level)
+    root.addHandler(handler)

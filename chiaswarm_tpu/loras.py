@@ -1,0 +1,61 @@
+"""LoRA reference resolution.
+
+Parses the job's `lora` string into {lora, weight_name, subfolder} the way the
+reference intends (swarm/loras.py:8-39) — including the ≥4-segment case that
+the reference gets wrong (`parts[parts[2:-1]]` at swarm/loras.py:37 raises
+TypeError; here deep subfolder paths are joined correctly).
+
+Forms accepted:
+  "name"                                  -> local file under lora_root_dir
+  "publisher/repo"                        -> hub repo, default weights
+  "publisher/repo/file.safetensors"       -> hub repo + weight file
+  "publisher/repo/sub/dirs/file.st"       -> hub repo + nested subfolder + file
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass
+
+
+@dataclass(frozen=True)
+class LoraRef:
+    lora: str
+    weight_name: str | None = None
+    subfolder: str | None = None
+
+    def as_dict(self) -> dict:
+        return {
+            "lora": self.lora,
+            "weight_name": self.weight_name,
+            "subfolder": self.subfolder,
+        }
+
+
+def resolve_lora(lora: str, lora_root_dir: str) -> dict:
+    parts = [p for p in lora.split("/") if p]
+    if len(parts) == 1:
+        # bare local name under lora_root_dir
+        return LoraRef(
+            lora=os.path.expanduser(lora_root_dir), weight_name=parts[0]
+        ).as_dict()
+    if len(parts) == 2:
+        return LoraRef(lora=f"{parts[0]}/{parts[1]}").as_dict()
+    if len(parts) == 3:
+        return LoraRef(lora=f"{parts[0]}/{parts[1]}", weight_name=parts[2]).as_dict()
+    # publisher/repo/<subfolder...>/file
+    return LoraRef(
+        lora=f"{parts[0]}/{parts[1]}",
+        weight_name=parts[-1],
+        subfolder="/".join(parts[2:-1]),
+    ).as_dict()
+
+
+class Loras:
+    """Reference-compatible wrapper (swarm/loras.py class shape)."""
+
+    def __init__(self, lora_root_dir: str):
+        self.lora_root_dir = lora_root_dir
+
+    def resolve_lora(self, lora: str) -> dict:
+        return resolve_lora(lora, self.lora_root_dir)

@@ -1,0 +1,6 @@
+"""Jitted Flax inference pipelines (the TPU compute path).
+
+Each module registers a pipeline family with the residency registry
+(`..registry`). Modules are imported lazily by the registry / workflow
+callbacks so the dispatch layer stays importable without model code.
+"""

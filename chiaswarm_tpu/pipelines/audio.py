@@ -1,0 +1,9 @@
+"""AudioLDM-style txt2audio pipeline (reference swarm/audio/audioldm.py)."""
+
+from __future__ import annotations
+
+
+def run_audioldm(device_identifier: str, model_name: str, **kwargs):
+    raise Exception(
+        f"txt2audio is not yet available on this worker (model {model_name})."
+    )

@@ -1,0 +1,7 @@
+"""Auxiliary perception models (depth, pose, segmentation) for preprocessors."""
+
+from __future__ import annotations
+
+
+def estimate_depth(image):
+    raise Exception("depth estimation is not yet available on this worker.")

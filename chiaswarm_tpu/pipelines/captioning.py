@@ -1,0 +1,9 @@
+"""BLIP-style captioning/VQA (reference swarm/captioning/caption_image.py)."""
+
+from __future__ import annotations
+
+
+def caption_image(image, model_name: str, prompt=None, processor_type=None, model_type=None) -> str:
+    raise Exception(
+        f"img2txt is not yet available on this worker (model {model_name})."
+    )

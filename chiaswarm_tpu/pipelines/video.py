@@ -1,0 +1,21 @@
+"""Video diffusion pipelines (reference swarm/video/*)."""
+
+from __future__ import annotations
+
+
+def run_txt2vid(device_identifier: str, model_name: str, **kwargs):
+    raise Exception(
+        f"txt2vid is not yet available on this worker (model {model_name})."
+    )
+
+
+def run_img2vid(device_identifier: str, model_name: str, **kwargs):
+    raise Exception(
+        f"img2vid is not yet available on this worker (model {model_name})."
+    )
+
+
+def run_vid2vid(device_identifier: str, model_name: str, **kwargs):
+    raise Exception(
+        f"vid2vid is not yet available on this worker (model {model_name})."
+    )

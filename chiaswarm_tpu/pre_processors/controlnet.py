@@ -1,0 +1,63 @@
+"""ControlNet condition-image preprocessors (reference swarm/pre_processors/
+controlnet.py:25-298: canny, depth, tile, crop, segmentation, pose, ...).
+
+CPU-geometry preprocessors (canny/tile/crop) are implemented here; the
+model-backed ones (depth, pose, segmentation) land with their Flax aux
+models. Unknown names raise ValueError -> fatal job envelope.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+from PIL import Image
+
+from .image_utils import center_crop_resize, resize_for_condition_image
+
+_PREPROCESSORS = {}
+
+
+def register(name):
+    def deco(fn):
+        _PREPROCESSORS[name] = fn
+        return fn
+
+    return deco
+
+
+def preprocess_image(image: Image.Image, preprocessor: str, device_identifier: str):
+    fn = _PREPROCESSORS.get(preprocessor)
+    if fn is None:
+        raise ValueError(
+            f"Unknown or unavailable controlnet preprocessor: {preprocessor}"
+        )
+    return fn(image)
+
+
+@register("canny")
+def canny(image: Image.Image) -> Image.Image:
+    import cv2
+
+    arr = cv2.Canny(np.array(image), 100, 200)
+    return Image.fromarray(np.stack([arr] * 3, axis=-1))
+
+
+@register("tile")
+def tile(image: Image.Image) -> Image.Image:
+    return resize_for_condition_image(image, 1024)
+
+
+@register("crop")
+def crop(image: Image.Image) -> Image.Image:
+    return center_crop_resize(image, (512, 512))
+
+
+@register("scribble")
+@register("softedge")
+def soft_edge(image: Image.Image) -> Image.Image:
+    # HED-style soft edges approximated with a blurred inverted laplacian;
+    # the model-backed HED detector replaces this when aux models land
+    import cv2
+
+    gray = cv2.cvtColor(np.array(image), cv2.COLOR_RGB2GRAY)
+    edges = cv2.Laplacian(cv2.GaussianBlur(gray, (5, 5), 0), cv2.CV_8U, ksize=5)
+    return Image.fromarray(np.stack([edges] * 3, axis=-1))

@@ -1,0 +1,18 @@
+"""Depth-hint preprocessor for Kandinsky controlnet-depth (reference
+swarm/pre_processors/depth_estimator.py:8-24).
+
+Returns an HWC float32 numpy hint (3 identical depth channels in [0, 1]) —
+the JAX pipeline consumes it directly; no torch tensors on the wire.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+from PIL import Image
+
+
+def make_hint(image: Image.Image):
+    from ..pipelines.aux_models import estimate_depth
+
+    depth = estimate_depth(image)  # HW float32 in [0,1]
+    return np.stack([depth] * 3, axis=-1).astype(np.float32)

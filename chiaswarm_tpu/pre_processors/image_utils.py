@@ -1,0 +1,50 @@
+"""CPU-side PIL image geometry helpers.
+
+Behavior parity with reference swarm/pre_processors/image_utils.py:4-51.
+These run on the host before tensors ever reach the TPU, so they stay PIL.
+"""
+
+from __future__ import annotations
+
+from PIL import Image
+
+
+def scale_to_size(image: Image.Image, size: tuple[int, int]) -> Image.Image:
+    return image.convert("RGB").resize(size)
+
+
+def resize_square(img: Image.Image) -> Image.Image:
+    """Center-crop to the shortest side (no resize)."""
+    side = min(img.width, img.height)
+    left = (img.width - side) // 2
+    top = (img.height - side) // 2
+    return img.crop((left, top, left + side, top + side))
+
+
+def center_crop_resize(
+    img: Image.Image, output_size: tuple[int, int] = (512, 512)
+) -> Image.Image:
+    """Center-crop to square then resize to output_size."""
+    return resize_square(img).resize(output_size)
+
+
+def resize_for_condition_image(image: Image.Image, resolution: int = 1024) -> Image.Image:
+    """Scale shortest side to `resolution`, rounding dims to multiples of 64.
+
+    The /64 rounding matters on TPU beyond the reference's motivation: it
+    bounds the set of latent shapes, which bounds the number of distinct XLA
+    compilations (see pipelines/registry shape bucketing).
+    """
+    input_image = image.convert("RGB")
+    w, h = input_image.size
+    k = float(resolution) / min(h, w)
+    w = int(round(w * k / 64.0)) * 64
+    h = int(round(h * k / 64.0)) * 64
+    return input_image.resize((w, h), resample=Image.Resampling.LANCZOS)
+
+
+def snap_to_multiple(size: tuple[int, int], multiple: int = 64) -> tuple[int, int]:
+    """Round (h, w) down to the nearest multiple (min one multiple)."""
+    h, w = size
+    return (max(multiple, (h // multiple) * multiple),
+            max(multiple, (w // multiple) * multiple))

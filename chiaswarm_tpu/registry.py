@@ -1,0 +1,144 @@
+"""Pipeline registry with weight residency — the heart of the TPU redesign.
+
+The reference resolves diffusers class names from job JSON by reflection
+(swarm/type_helpers.py:9-22) and calls `from_pretrained` on EVERY job
+(swarm/diffusion/diffusion_func.py:103) — disk -> VRAM per job is its #1
+perf loss (SURVEY §2.2). Here:
+
+- job `pipeline_type` strings map to registered `PipelineFactory` entries
+  (a fixed table, no reflection / no arbitrary imports);
+- built pipelines are cached by (model_name, pipeline_type, variant): Flax
+  params are loaded once, transferred to the job's mesh, and stay resident;
+  jitted programs are cached by XLA per (shape bucket, step count) on top;
+- an LRU bound keeps HBM use sane when a worker serves many models.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+from collections import OrderedDict
+from typing import Callable
+
+logger = logging.getLogger(__name__)
+
+# wire-name -> family; the table covers every pipeline_type string the
+# reference hive can send (SURVEY §2.7) so legacy jobs resolve.
+PIPELINE_FAMILIES: dict[str, str] = {
+    "DiffusionPipeline": "sd",
+    "StableDiffusionPipeline": "sd",
+    "StableDiffusionImg2ImgPipeline": "sd",
+    "StableDiffusionInpaintPipeline": "sd",
+    "StableDiffusionControlNetPipeline": "sd",
+    "StableDiffusionControlNetImg2ImgPipeline": "sd",
+    "StableDiffusionControlNetInpaintPipeline": "sd",
+    "StableDiffusionXLPipeline": "sdxl",
+    "StableDiffusionXLImg2ImgPipeline": "sdxl",
+    "StableDiffusionXLInpaintPipeline": "sdxl",
+    "StableDiffusionXLControlNetPipeline": "sdxl",
+    "StableDiffusionXLControlNetImg2ImgPipeline": "sdxl",
+    "StableDiffusionXLControlNetInpaintPipeline": "sdxl",
+    "StableDiffusionInstructPix2PixPipeline": "sd",
+    "StableDiffusionXLInstructPix2PixPipeline": "sdxl",
+    "StableDiffusionLatentUpscalePipeline": "sd_upscale",
+    "KandinskyPipeline": "kandinsky",
+    "KandinskyV22Pipeline": "kandinsky",
+    "KandinskyV22ControlnetPipeline": "kandinsky",
+    "KandinskyV22PriorPipeline": "kandinsky_prior",
+    "KandinskyV22PriorEmb2EmbPipeline": "kandinsky_prior",
+    "Kandinsky3Pipeline": "kandinsky3",
+    "AutoPipelineForText2Image": "sd",
+    "StableCascadeDecoderPipeline": "cascade",
+    "FluxPipeline": "flux",
+    "AudioLDMPipeline": "audioldm",
+    "AnimateDiffPipeline": "animatediff",
+    "I2VGenXLPipeline": "i2vgenxl",
+    "StableVideoDiffusionPipeline": "svd",
+}
+
+# family -> factory(model_name, chipset, **variant) -> pipeline bundle.
+# A bundle holds ONE resident param set per (model, family) and serves every
+# pipeline_type of that family: run() dispatches txt2img/img2img/inpaint from
+# the kwargs it receives (image/mask_image presence), so the txt2img and
+# inpaint wire names share weights instead of loading twice.
+_FACTORIES: dict[str, Callable] = {}
+
+_CACHE_LOCK = threading.Lock()
+_CACHE: OrderedDict[tuple, object] = OrderedDict()
+_BUILD_LOCKS: dict[tuple, threading.Lock] = {}
+MAX_RESIDENT_PIPELINES = 4
+
+
+def register_family(family: str):
+    def deco(factory: Callable):
+        _FACTORIES[family] = factory
+        return factory
+
+    return deco
+
+
+def family_of(pipeline_type: str) -> str:
+    try:
+        return PIPELINE_FAMILIES[pipeline_type]
+    except KeyError:
+        raise ValueError(f"Unknown pipeline type: {pipeline_type}") from None
+
+
+def get_pipeline(model_name: str, pipeline_type: str, chipset=None, **variant):
+    """Resolve (and cache) a resident pipeline for this model on this mesh."""
+    _ensure_builtin_families()
+    family = family_of(pipeline_type)
+    factory = _FACTORIES.get(family)
+    if factory is None:
+        raise ValueError(
+            f"Pipeline family '{family}' ({pipeline_type}) is not available on "
+            "this worker."
+        )
+
+    slice_id = getattr(chipset, "slice_id", 0)
+    key = (model_name, family, slice_id, tuple(sorted(variant.items())))
+    with _CACHE_LOCK:
+        if key in _CACHE:
+            _CACHE.move_to_end(key)
+            return _CACHE[key]
+        build_lock = _BUILD_LOCKS.setdefault(key, threading.Lock())
+
+    # build outside the cache lock (weight load/convert can take seconds) but
+    # serialized per key so concurrent slices don't double-load weights
+    with build_lock:
+        with _CACHE_LOCK:
+            if key in _CACHE:
+                _CACHE.move_to_end(key)
+                return _CACHE[key]
+        logger.info("building pipeline %s/%s", model_name, family)
+        pipeline = factory(model_name, chipset, **variant)
+
+        with _CACHE_LOCK:
+            _CACHE[key] = pipeline
+            while len(_CACHE) > MAX_RESIDENT_PIPELINES:
+                evicted_key, evicted = _CACHE.popitem(last=False)
+                logger.info("evicting resident pipeline %s", evicted_key)
+                release = getattr(evicted, "release", None)
+                if release:
+                    release()
+    return pipeline
+
+
+def clear_cache() -> None:
+    with _CACHE_LOCK:
+        _CACHE.clear()
+
+
+_BUILTINS_LOADED = False
+
+
+def _ensure_builtin_families() -> None:
+    """Import pipeline modules lazily so the registry is importable without jax."""
+    global _BUILTINS_LOADED
+    if _BUILTINS_LOADED:
+        return
+    _BUILTINS_LOADED = True
+    try:
+        from .pipelines import stable_diffusion  # noqa: F401  registers sd/sdxl
+    except Exception as e:
+        logger.warning("stable-diffusion family unavailable: %s", e)

@@ -1,0 +1,95 @@
+"""Layered configuration: JSON settings file + environment overrides.
+
+Behavior parity with reference swarm/settings.py:7-76 — same file location
+($SDAAS_ROOT or ~/.sdaas/settings.json), same field names, same env override
+keys (SDAAS_TOKEN / SDAAS_URI / SDAAS_WORKERNAME) — plus TPU-specific fields
+the reference has no analog for (mesh topology, compilation cache directory).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+from pathlib import Path
+
+
+@dataclasses.dataclass
+class Settings:
+    log_level: str = "WARN"
+    log_filename: str = "log/generator.log"
+    sdaas_token: str = ""
+    sdaas_uri: str = "http://localhost:9511"
+    worker_name: str = "worker"
+    lora_root_dir: str = "~/lora"
+    # --- TPU-native additions (no reference analog) ---
+    # chips per job slice; 0 = use every local chip as one slice
+    chips_per_job: int = 0
+    # persistent XLA compilation cache (the TPU analog of the HF model cache)
+    compilation_cache_dir: str = "~/.sdaas/xla_cache"
+    # model weight root (converted Flax checkpoints / HF safetensors)
+    model_root_dir: str = "~/.sdaas/models"
+    # dtype policy for pipeline params: "bfloat16" | "float32"
+    dtype: str = "bfloat16"
+
+    @classmethod
+    def field_names(cls) -> tuple[str, ...]:
+        return tuple(f.name for f in dataclasses.fields(cls))
+
+
+# env var -> settings attribute (reference swarm/settings.py:38-41)
+_ENV_OVERRIDES = {
+    "SDAAS_TOKEN": "sdaas_token",
+    "SDAAS_URI": "sdaas_uri",
+    "SDAAS_WORKERNAME": "worker_name",
+    "SDAAS_CHIPS_PER_JOB": "chips_per_job",
+    "SDAAS_DTYPE": "dtype",
+}
+
+
+def get_settings_dir() -> Path:
+    return Path(os.environ.get("SDAAS_ROOT") or "~/.sdaas/").expanduser()
+
+
+def resolve_path(path: str | Path) -> Path:
+    full_path = get_settings_dir() / path
+    full_path.parent.mkdir(parents=True, exist_ok=True)
+    return full_path
+
+
+def get_settings_full_path() -> Path:
+    return resolve_path("settings.json")
+
+
+def settings_exist() -> bool:
+    return get_settings_full_path().is_file()
+
+
+def load_settings() -> Settings:
+    try:
+        raw = json.loads(get_settings_full_path().read_text())
+    except FileNotFoundError:
+        raw = {}
+    except json.JSONDecodeError:
+        raw = {}
+
+    known = {k: v for k, v in raw.items() if k in Settings.field_names()}
+    settings = Settings(**known)
+
+    for env_key, attr in _ENV_OVERRIDES.items():
+        value = os.getenv(env_key)
+        if value is not None:
+            field_type = type(getattr(settings, attr))
+            setattr(settings, attr, field_type(value))
+
+    return settings
+
+
+def save_settings(settings: Settings) -> None:
+    get_settings_full_path().write_text(
+        json.dumps(dataclasses.asdict(settings), indent=2)
+    )
+
+
+def save_file(data, filename: str) -> None:
+    resolve_path(filename).write_text(json.dumps(data, indent=2))

@@ -1,0 +1,221 @@
+"""Worker runtime: poll the hive, fan jobs out to chip slices, upload results.
+
+Loop-shape parity with reference swarm/worker.py:38-196 — 11 s poll cadence,
+121 s backoff on poll errors, bounded work queue, per-slice consumer tasks,
+a result-upload task, and the same error policy (transient exceptions become
+error-image artifacts and the job "succeeds"; ValueError/TypeError mark the
+envelope `fatal_error` so the hive won't resubmit; bad input args take the
+fatal path before execution, swarm/worker.py:105-115).
+
+Differences by design:
+- `Worker` is a class with injected settings/allocator, so tests run it
+  against an in-process fake hive (the reference used module globals and was
+  untestable without a live hive).
+- The GPU semaphore is replaced by the SliceAllocator; capability
+  advertisement aggregates the whole pool (fixing swarm/worker.py:45-62
+  which advertised only the last device).
+- Jobs execute in a thread pool sized to the slice count, so one slice's
+  denoise loop never blocks another slice's or the event loop.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+from concurrent.futures import ThreadPoolExecutor
+
+from . import __version__
+from .chips.allocator import SliceAllocator
+from .hive import HiveClient
+from .job_arguments import format_args
+from .log_setup import setup_logging
+from .post_processors.output_processor import (
+    exception_image,
+    exception_message,
+    fatal_exception_response,
+)
+from .settings import Settings, load_settings, resolve_path
+
+logger = logging.getLogger(__name__)
+
+POLL_SECONDS = 11
+ERROR_BACKOFF_SECONDS = 121
+
+
+class Worker:
+    def __init__(
+        self,
+        settings: Settings | None = None,
+        allocator: SliceAllocator | None = None,
+        hive_uri: str | None = None,
+    ):
+        self.settings = settings or load_settings()
+        self.hive_uri = (
+            hive_uri
+            if hive_uri is not None
+            else f"{self.settings.sdaas_uri.rstrip('/')}/api"
+        )
+        self.allocator = allocator or SliceAllocator(
+            chips_per_job=self.settings.chips_per_job
+        )
+        self.hive = HiveClient(self.settings, self.hive_uri)
+        self.work_queue: asyncio.Queue = asyncio.Queue(maxsize=len(self.allocator))
+        self.result_queue: asyncio.Queue = asyncio.Queue()
+        self._executor = ThreadPoolExecutor(
+            max_workers=len(self.allocator), thread_name_prefix="chipslice"
+        )
+        self._stopping = asyncio.Event()
+
+    # --- lifecycle ---
+
+    async def run(self) -> None:
+        self.startup()
+        tasks = [
+            asyncio.create_task(self.slice_worker(), name=f"slice_worker_{i}")
+            for i in range(len(self.allocator))
+        ]
+        tasks.append(asyncio.create_task(self.result_worker(), name="result_worker"))
+        tasks.append(asyncio.create_task(self.poll_loop(), name="poll_loop"))
+        try:
+            await self._stopping.wait()
+        finally:
+            for t in tasks:
+                t.cancel()
+            await asyncio.gather(*tasks, return_exceptions=True)
+            await self.hive.close()
+            self._executor.shutdown(wait=False, cancel_futures=True)
+
+    def stop(self) -> None:
+        self._stopping.set()
+
+    def startup(self) -> None:
+        setup_logging(resolve_path(self.settings.log_filename), self.settings.log_level)
+        logger.info("chiaSWARM-TPU worker %s", __version__)
+        caps = self.allocator.capabilities()
+        print(
+            f"Found {caps['chips']} chips ({caps['topology']}), "
+            f"{len(self.allocator)} job slice(s)"
+        )
+        self._enable_compilation_cache()
+
+    def _enable_compilation_cache(self) -> None:
+        """Persistent XLA compilation cache — the TPU analog of the reference's
+        warm HF model cache (SURVEY §5 'checkpoint/resume')."""
+        try:
+            import os
+
+            import jax
+
+            cache_dir = os.path.expanduser(self.settings.compilation_cache_dir)
+            jax.config.update("jax_compilation_cache_dir", cache_dir)
+            jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+        except Exception as e:  # cache is an optimization, never fatal
+            logger.warning("compilation cache unavailable: %s", e)
+
+    # --- producer: poll the hive ---
+
+    async def poll_loop(self) -> None:
+        sleep_seconds = POLL_SECONDS
+        while True:
+            if not self.work_queue.full() and self.allocator.has_free_slice():
+                try:
+                    jobs = await self.hive.ask_for_work(self.allocator.capabilities())
+                    for job in jobs:
+                        print(f"Got job {job['id']}")
+                        await self.work_queue.put(job)
+                    sleep_seconds = POLL_SECONDS
+                except asyncio.TimeoutError:
+                    logger.warning("hive poll timeout")
+                except Exception as e:
+                    logger.exception("ask_for_work error")
+                    print(f"ask_for_work error {e}")
+                    sleep_seconds = ERROR_BACKOFF_SECONDS
+            await asyncio.sleep(sleep_seconds)
+
+    # --- consumers: one logical worker per chip slice ---
+
+    async def slice_worker(self) -> None:
+        while True:
+            job = await self.work_queue.get()
+            chipset = await self.allocator.acquire()
+            try:
+                worker_function, kwargs = await self.get_args(
+                    job, chipset.identifier()
+                )
+                if worker_function is not None:
+                    result = await self.do_work(chipset, worker_function, kwargs)
+                    await self.result_queue.put(result)
+            except Exception as e:
+                logger.exception("slice_worker error")
+                print(f"slice_worker {e}")
+            finally:
+                self.allocator.release(chipset)
+                self.work_queue.task_done()
+
+    async def get_args(self, job: dict, device_identifier: str):
+        try:
+            return await format_args(job, self.settings, device_identifier)
+        except Exception as e:
+            # input args are wrong somehow: not recoverable, don't resubmit
+            # (reference swarm/worker.py:105-115)
+            logger.exception("format_args failed for job %s", job.get("id"))
+            await self.result_queue.put(fatal_exception_response(e, job["id"], job))
+        return None, None
+
+    async def do_work(self, chipset, worker_function, kwargs) -> dict:
+        loop = asyncio.get_running_loop()
+        return await loop.run_in_executor(
+            self._executor, self.synchronous_do_work, chipset, worker_function, kwargs
+        )
+
+    def synchronous_do_work(self, chipset, worker_function, kwargs) -> dict:
+        job_id = kwargs.pop("id")
+        print(f"Processing {job_id} on {chipset.descriptor()}")
+
+        try:
+            artifacts, pipeline_config = chipset(worker_function, **kwargs)
+        except (ValueError, TypeError) as e:
+            # non-recoverable (e.g. incompatible adapter): fatal envelope
+            return fatal_exception_response(e, job_id, kwargs)
+        except Exception as e:
+            # transient: render the error as the artifact, job still "succeeds"
+            logger.exception("job %s failed", job_id)
+            content_type = kwargs.get("content_type", "image/jpeg")
+            if content_type.startswith("image/"):
+                artifacts, pipeline_config = exception_image(e, content_type)
+            else:
+                artifacts, pipeline_config = exception_message(e)
+
+        return {
+            "id": job_id,
+            "artifacts": artifacts,
+            "nsfw": pipeline_config.get("nsfw", False),
+            "worker_version": __version__,
+            "pipeline_config": pipeline_config,
+        }
+
+    # --- uploader ---
+
+    async def result_worker(self) -> None:
+        while True:
+            result = await self.result_queue.get()
+            try:
+                await self.hive.submit_result(result)
+            except asyncio.TimeoutError:
+                logger.warning("timeout submitting result %s", result.get("id"))
+            except Exception as e:
+                logger.exception("result_worker error")
+                print(f"result_worker {e}")
+            finally:
+                self.result_queue.task_done()
+
+
+async def run_worker() -> None:
+    await Worker().run()
+
+
+if __name__ == "__main__":
+    try:
+        asyncio.run(run_worker())
+    except KeyboardInterrupt:
+        print("done")

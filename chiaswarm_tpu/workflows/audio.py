@@ -1,0 +1,20 @@
+"""Audio workloads: AudioLDM-style txt2audio and Bark TTS.
+
+Reference: swarm/audio/audioldm.py:23-34 (AudioLDM -> wav 16 kHz -> mp3) and
+swarm/audio/bark.py:16-21. mp3 encoding is gated on pydub/ffmpeg presence;
+workers without it return wav artifacts.
+"""
+
+from __future__ import annotations
+
+
+def txt2audio_callback(device_identifier: str, model_name: str, **kwargs):
+    from ..pipelines.audio import run_audioldm
+
+    return run_audioldm(device_identifier, model_name, **kwargs)
+
+
+def bark_callback(device_identifier: str, model_name: str, **kwargs):
+    raise Exception(
+        f"Bark TTS is not available on this worker (model {model_name})."
+    )

@@ -1,0 +1,28 @@
+"""Image captioning / VQA workload (reference swarm/captioning/caption_image.py).
+
+BLIP-style: unconditional captioning, or question-conditioned when the job
+carries a prompt; result is a JSON text artifact.
+"""
+
+from __future__ import annotations
+
+from ..post_processors.output_processor import make_text_result
+
+
+def caption_callback(device_identifier: str, model_name: str, **kwargs):
+    from ..pipelines.captioning import caption_image
+
+    image = kwargs.get("image")
+    if image is None:
+        raise ValueError("img2txt requires an input image. None provided")
+
+    prompt = kwargs.get("prompt") or None
+    parameters = kwargs.get("parameters", {})
+    text = caption_image(
+        image,
+        model_name=model_name,
+        prompt=prompt,
+        processor_type=parameters.get("processor_type"),
+        model_type=parameters.get("model_type"),
+    )
+    return {"primary": make_text_result(text)}, {"caption": text}

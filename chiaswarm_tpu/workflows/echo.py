@@ -1,0 +1,26 @@
+"""Echo workload: renders the prompt as an image artifact.
+
+A hermetic diagnostic workflow with no model dependency — used by the
+integration tests to exercise the full worker loop (hive poll -> dispatch ->
+chip slice -> artifact -> result upload) and usable in production as a
+liveness probe. No reference analog (the reference is only testable against
+a live hive + GPU; SURVEY §4).
+"""
+
+from __future__ import annotations
+
+from ..post_processors.output_processor import OutputProcessor, image_from_text
+
+
+def echo_callback(device_identifier: str, model_name: str, **kwargs):
+    prompt = kwargs.get("prompt", "")
+    content_type = kwargs.get("content_type", "image/jpeg")
+    size = (kwargs.get("width", 512), kwargs.get("height", 512))
+
+    processor = OutputProcessor(
+        kwargs.get("outputs", ["primary"]), content_type
+    )
+    processor.add_outputs([image_from_text(f"echo: {prompt}", size)])
+
+    pipeline_config = {"echo": True, "device": device_identifier, "model": model_name}
+    return processor.get_results(), pipeline_config

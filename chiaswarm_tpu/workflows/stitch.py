@@ -1,0 +1,54 @@
+"""Stitch workload: composite prior jobs' images into a mosaic + image map.
+
+Behavior parity with reference swarm/toolbox/stitch.py:12-100: lays out the
+input images in a near-square grid of uniform tiles, returns the mosaic as
+the primary artifact plus an HTML-image-map style metadata list locating each
+source job's tile, so the hive UI can make regions clickable.
+"""
+
+from __future__ import annotations
+
+import math
+
+from PIL import Image
+
+from ..post_processors.output_processor import OutputProcessor
+
+TILE = 256
+
+
+def stitch_callback(device_identifier: str, model_name: str, **kwargs):
+    images: list[Image.Image] = kwargs["images"]
+    jobs: list[dict] = kwargs.get("jobs", [])
+    content_type = kwargs.get("content_type", "image/jpeg")
+
+    if not images:
+        raise ValueError("stitch requires at least one input image")
+
+    cols = math.ceil(math.sqrt(len(images)))
+    rows = math.ceil(len(images) / cols)
+
+    mosaic = Image.new("RGB", (cols * TILE, rows * TILE))
+    image_map = []
+    for i, image in enumerate(images):
+        tile = image.convert("RGB").copy()
+        tile.thumbnail((TILE, TILE), Image.Resampling.LANCZOS)
+        x, y = (i % cols) * TILE, (i // cols) * TILE
+        # center the tile in its cell
+        mosaic.paste(tile, (x + (TILE - tile.width) // 2, y + (TILE - tile.height) // 2))
+        region = {
+            "coords": [x, y, x + TILE, y + TILE],
+            "shape": "rect",
+        }
+        if i < len(jobs):
+            region["job_id"] = jobs[i].get("id")
+            region["href"] = jobs[i].get("resultUri")
+        image_map.append(region)
+
+    processor = OutputProcessor(kwargs.get("outputs", ["primary"]), content_type)
+    processor.add_outputs([mosaic])
+    return processor.get_results(), {
+        "image_map": image_map,
+        "rows": rows,
+        "cols": cols,
+    }

@@ -1,0 +1,28 @@
+"""Video workloads: txt2vid, img2vid, vid2vid (reference swarm/video/*).
+
+txt2vid: AnimateDiff-style motion-module diffusion (swarm/video/tx2vid.py).
+img2vid: image-conditioned video (swarm/video/img2vid.py).
+vid2vid: per-frame instruct-pix2pix over a downloaded clip — on TPU the
+frames are processed as one batched denoise instead of the reference's
+sequential Python loop (swarm/video/pix2pix.py:47-68).
+"""
+
+from __future__ import annotations
+
+
+def txt2vid_callback(device_identifier: str, model_name: str, **kwargs):
+    from ..pipelines.video import run_txt2vid
+
+    return run_txt2vid(device_identifier, model_name, **kwargs)
+
+
+def img2vid_callback(device_identifier: str, model_name: str, **kwargs):
+    from ..pipelines.video import run_img2vid
+
+    return run_img2vid(device_identifier, model_name, **kwargs)
+
+
+def vid2vid_callback(device_identifier: str, model_name: str, **kwargs):
+    from ..pipelines.video import run_vid2vid
+
+    return run_vid2vid(device_identifier, model_name, **kwargs)

@@ -1,0 +1,34 @@
+"""Test configuration: hermetic CPU backend with 8 virtual devices.
+
+Mesh/sharding paths are exercised without TPU hardware by forcing the JAX CPU
+platform and splitting the host into 8 virtual devices (SURVEY §4 test
+strategy). Must run before the first `import jax` anywhere in the test
+process.
+"""
+
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+# Some environments import jax at interpreter startup (sitecustomize), which
+# freezes config before the env vars above can act — force via jax.config too.
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_num_cpu_devices", 8)
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture()
+def sdaas_root(tmp_path, monkeypatch):
+    """Isolated settings/cache root so tests never touch ~/.sdaas."""
+    monkeypatch.setenv("SDAAS_ROOT", str(tmp_path / "sdaas"))
+    for var in ("SDAAS_TOKEN", "SDAAS_URI", "SDAAS_WORKERNAME"):
+        monkeypatch.delenv(var, raising=False)
+    return tmp_path / "sdaas"

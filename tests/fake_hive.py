@@ -1,0 +1,80 @@
+"""In-process fake hive: hermetic integration testing of the worker loop.
+
+Serves the reference wire protocol (GET /api/work, POST /api/results,
+GET /api/models — swarm/hive.py:14,55,78) from a local aiohttp server. Jobs
+are queued by the test; submitted results are captured for assertions. The
+reference has no such harness (SURVEY §4) — its worker loop is only testable
+against the production hive.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+
+from aiohttp import web
+
+
+class FakeHive:
+    def __init__(self):
+        self.pending_jobs: list[dict] = []
+        self.results: list[dict] = []
+        self.work_requests: list[dict] = []
+        self.result_event = asyncio.Event()
+        self.refuse_with: str | None = None  # set -> /work returns 400 + message
+        self._runner: web.AppRunner | None = None
+        self.port: int | None = None
+
+    @property
+    def uri(self) -> str:
+        return f"http://127.0.0.1:{self.port}/api"
+
+    async def start(self) -> "FakeHive":
+        app = web.Application()
+        app.router.add_get("/api/work", self._work)
+        app.router.add_post("/api/results", self._results)
+        app.router.add_get("/api/models", self._models)
+        self._runner = web.AppRunner(app)
+        await self._runner.setup()
+        site = web.TCPSite(self._runner, "127.0.0.1", 0)
+        await site.start()
+        self.port = site._server.sockets[0].getsockname()[1]
+        return self
+
+    async def stop(self) -> None:
+        if self._runner:
+            await self._runner.cleanup()
+
+    def add_job(self, job: dict) -> None:
+        self.pending_jobs.append(job)
+
+    async def wait_for_results(self, n: int, timeout: float = 30.0) -> list[dict]:
+        async def _wait():
+            while len(self.results) < n:
+                self.result_event.clear()
+                await self.result_event.wait()
+            return self.results
+
+        return await asyncio.wait_for(_wait(), timeout)
+
+    # --- handlers ---
+
+    async def _work(self, request: web.Request) -> web.Response:
+        self.work_requests.append(dict(request.query))
+        if self.refuse_with is not None:
+            return web.json_response({"message": self.refuse_with}, status=400)
+        jobs, self.pending_jobs = self.pending_jobs, []
+        return web.json_response({"jobs": jobs})
+
+    async def _results(self, request: web.Request) -> web.Response:
+        self.results.append(json.loads(await request.text()))
+        self.result_event.set()
+        return web.json_response({"status": "ok"})
+
+    async def _models(self, request: web.Request) -> web.Response:
+        return web.json_response(
+            {
+                "models": [{"id": "stabilityai/stable-diffusion-2-1"}],
+                "language_models": [],
+            }
+        )

@@ -1,0 +1,82 @@
+"""Chip-slice allocator over the 8 virtual CPU devices."""
+
+import asyncio
+
+import jax
+import pytest
+
+from chiaswarm_tpu.chips.allocator import SliceAllocator
+from chiaswarm_tpu.chips.device import ChipSet
+
+
+def test_virtual_device_count():
+    assert len(jax.devices()) == 8
+
+
+def test_one_slice_spans_all_chips():
+    alloc = SliceAllocator(chips_per_job=0)
+    assert len(alloc) == 1
+    assert alloc.slices[0].chip_count() == 8
+
+
+def test_partition_into_slices():
+    alloc = SliceAllocator(chips_per_job=2)
+    assert len(alloc) == 4
+    all_ids = [d.id for s in alloc.slices for d in s.devices]
+    assert sorted(all_ids) == list(range(8))
+
+
+def test_indivisible_partition_rejected():
+    with pytest.raises(ValueError, match="does not divide"):
+        SliceAllocator(chips_per_job=3)
+
+
+def test_acquire_release_cycle():
+    async def scenario():
+        alloc = SliceAllocator(chips_per_job=4)
+        a = await alloc.acquire()
+        b = await alloc.acquire()
+        assert not alloc.has_free_slice()
+        assert {d.id for d in a.devices}.isdisjoint({d.id for d in b.devices})
+        alloc.release(a)
+        assert alloc.has_free_slice()
+        c = await alloc.acquire()
+        assert c is a
+
+    asyncio.run(scenario())
+
+
+def test_capabilities_aggregate_pool():
+    alloc = SliceAllocator(chips_per_job=2)
+    caps = alloc.capabilities()
+    assert caps["chips"] == 8
+    assert caps["slices"] == 4
+    assert "memory" in caps and "gpu" in caps  # legacy keys
+
+
+def test_chipset_busy_mutex():
+    cs = ChipSet(jax.devices()[:1])
+
+    def job(identifier, model_name, **kwargs):
+        # re-entering the same chipset while busy must fail (reference
+        # swarm/gpu/device.py:29-32 semantics)
+        with pytest.raises(Exception, match="busy"):
+            cs(lambda *a, **k: ({}, {}), model_name="inner")
+        return {}, {}
+
+    artifacts, config = cs(job, model_name="m", seed=123)
+    assert config["seed"] == 123
+    assert "job_s" in config["timings"]
+
+
+def test_chipset_draws_seed_when_absent():
+    cs = ChipSet(jax.devices()[:1])
+    _, config = cs(lambda *a, **k: ({}, {}), model_name="m")
+    assert isinstance(config["seed"], int)
+
+
+def test_chipset_mesh():
+    cs = ChipSet(jax.devices()[:4])
+    mesh = cs.mesh()
+    assert mesh.axis_names == ("data",)
+    assert mesh.devices.size == 4

@@ -1,0 +1,212 @@
+"""Job dispatch + argument normalization (reference swarm/job_arguments.py)."""
+
+import asyncio
+
+import pytest
+from PIL import Image
+
+from chiaswarm_tpu import job_arguments
+from chiaswarm_tpu.settings import Settings
+
+
+def fmt(job):
+    return asyncio.run(job_arguments.format_args(job, Settings(), "cpu:0"))
+
+
+def test_echo_workflow_dispatch():
+    cb, kwargs = fmt({"id": "j1", "workflow": "echo", "prompt": "hi", "model_name": "x"})
+    assert cb.__name__ == "echo_callback"
+    assert kwargs["prompt"] == "hi"
+
+
+def test_txt2img_defaults():
+    cb, kwargs = fmt(
+        {
+            "id": "j1",
+            "workflow": "txt2img",
+            "model_name": "stabilityai/stable-diffusion-2-1",
+            "prompt": "a cat",
+        }
+    )
+    assert cb.__name__ == "diffusion_callback"
+    assert kwargs["num_inference_steps"] == 30
+    assert kwargs["pipeline_type"] == "DiffusionPipeline"
+    assert kwargs["scheduler_type"] == "DPMSolverMultistepScheduler"
+
+
+def test_size_cap_enforced():
+    with pytest.raises(Exception, match="max image size"):
+        fmt(
+            {
+                "id": "j1",
+                "workflow": "txt2img",
+                "model_name": "m",
+                "height": 2048,
+                "width": 2048,
+            }
+        )
+
+
+def test_model_default_canvas_applied():
+    _, kwargs = fmt(
+        {
+            "id": "j1",
+            "workflow": "txt2img",
+            "model_name": "m",
+            "parameters": {"default_height": 768, "default_width": 768},
+        }
+    )
+    assert kwargs["height"] == 768
+    assert kwargs["width"] == 768
+
+
+def test_unsupported_arguments_dropped():
+    _, kwargs = fmt(
+        {
+            "id": "j1",
+            "workflow": "txt2img",
+            "model_name": "m",
+            "guidance_scale": 7.5,
+            "parameters": {"unsupported_pipeline_arguments": ["guidance_scale"]},
+        }
+    )
+    assert "guidance_scale" not in kwargs
+
+
+def test_extra_parameters_passed_through():
+    _, kwargs = fmt(
+        {
+            "id": "j1",
+            "workflow": "txt2img",
+            "model_name": "m",
+            "parameters": {"max_sequence_length": 512},
+        }
+    )
+    assert kwargs["max_sequence_length"] == 512
+
+
+def test_txt2audio_defaults():
+    cb, kwargs = fmt(
+        {"id": "j1", "workflow": "txt2audio", "model_name": "cvssp/audioldm-s-full-v2"}
+    )
+    assert cb.__name__ == "txt2audio_callback"
+    assert kwargs["num_inference_steps"] == 20
+    assert kwargs["pipeline_type"] == "AudioLDMPipeline"
+
+
+def test_bark_routes_to_bark_callback():
+    cb, _ = fmt({"id": "j1", "workflow": "txt2audio", "model_name": "suno/bark"})
+    assert cb.__name__ == "bark_callback"
+
+
+def test_txt2vid_scheduler_args_trump_user_settings():
+    cb, kwargs = fmt(
+        {
+            "id": "j1",
+            "workflow": "txt2vid",
+            "model_name": "emilianJR/epiCRealism",
+            "num_images_per_prompt": 4,
+            "parameters": {
+                "scheduler_args": {
+                    "scheduler_type": "LCMScheduler",
+                    "beta_schedule": "linear",
+                },
+                "motion_adapter": {"model_name": "wangfuyun/AnimateLCM"},
+            },
+        }
+    )
+    assert cb.__name__ == "txt2vid_callback"
+    assert kwargs["scheduler_type"] == "LCMScheduler"
+    assert kwargs["scheduler_args"] == {"beta_schedule": "linear"}
+    assert "num_images_per_prompt" not in kwargs
+    assert kwargs["num_inference_steps"] == 25
+
+
+def test_lora_resolved_in_prepare():
+    _, kwargs = fmt(
+        {
+            "id": "j1",
+            "workflow": "txt2img",
+            "model_name": "m",
+            "lora": "pub/repo/w.safetensors",
+        }
+    )
+    assert kwargs["lora"] == {
+        "lora": "pub/repo",
+        "weight_name": "w.safetensors",
+        "subfolder": None,
+    }
+
+
+def test_img2img_requires_image():
+    with pytest.raises(ValueError, match="requires an input image"):
+        fmt({"id": "j1", "workflow": "img2img", "model_name": "m"})
+
+
+def test_deepfloyd_routes_to_if_callback():
+    cb, _ = fmt({"id": "j1", "workflow": "txt2img", "model_name": "DeepFloyd/IF-I-M-v1.0"})
+    assert cb.__name__ == "deepfloyd_if_callback"
+
+
+def test_large_model_selects_xl_pipeline(monkeypatch):
+    # img2img with a local PIL image injected via control path: use start image
+    async def fake_get_image(uri, size):
+        return Image.new("RGB", (64, 64)) if uri else None
+
+    monkeypatch.setattr(job_arguments, "get_image", fake_get_image)
+    _, kwargs = fmt(
+        {
+            "id": "j1",
+            "workflow": "img2img",
+            "model_name": "stabilityai/sdxl",
+            "start_image_uri": "http://x/img.png",
+            "parameters": {"large_model": True},
+        }
+    )
+    assert kwargs["pipeline_type"] == "StableDiffusionXLImg2ImgPipeline"
+    assert kwargs["image"].size == (64, 64)
+
+
+def test_pix2pix_strength_mapping(monkeypatch):
+    async def fake_get_image(uri, size):
+        return Image.new("RGB", (64, 64)) if uri else None
+
+    monkeypatch.setattr(job_arguments, "get_image", fake_get_image)
+    _, kwargs = fmt(
+        {
+            "id": "j1",
+            "workflow": "img2img",
+            "model_name": "timbrooks/instruct-pix2pix",
+            "start_image_uri": "http://x/img.png",
+            "strength": 0.8,
+        }
+    )
+    assert kwargs["image_guidance_scale"] == pytest.approx(4.0)
+    assert "strength" not in kwargs
+
+
+def test_inpaint_threads_size_and_mask(monkeypatch):
+    # regression for reference bug swarm/job_arguments.py:234 (size dropped)
+    captured = {}
+
+    async def fake_get_image(uri, size):
+        captured[uri] = size
+        return Image.new("RGB", (64, 64)) if uri else None
+
+    monkeypatch.setattr(job_arguments, "get_image", fake_get_image)
+    _, kwargs = fmt(
+        {
+            "id": "j1",
+            "workflow": "inpaint",
+            "model_name": "m",
+            "height": 512,
+            "width": 512,
+            "start_image_uri": "http://x/start.png",
+            "mask_image_uri": "http://x/mask.png",
+        }
+    )
+    assert captured["http://x/start.png"] == (512, 512)
+    assert captured["http://x/mask.png"] == (512, 512)
+    assert kwargs["pipeline_type"] == "StableDiffusionInpaintPipeline"
+    assert "height" not in kwargs and "width" not in kwargs
+    assert kwargs["mask_image"] is not None

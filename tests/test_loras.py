@@ -1,0 +1,37 @@
+"""LoRA reference parsing — including the ≥4-segment case the reference
+gets wrong (swarm/loras.py:37 raises TypeError)."""
+
+from chiaswarm_tpu.loras import Loras, resolve_lora
+
+
+def test_bare_local_name():
+    r = resolve_lora("mylora.safetensors", "/tmp/lora")
+    assert r["lora"] == "/tmp/lora"
+    assert r["weight_name"] == "mylora.safetensors"
+    assert r["subfolder"] is None
+
+
+def test_publisher_repo():
+    r = resolve_lora("ostris/ikea-instructions-lora-sdxl", "/tmp/lora")
+    assert r["lora"] == "ostris/ikea-instructions-lora-sdxl"
+    assert r["weight_name"] is None
+
+
+def test_publisher_repo_file():
+    r = resolve_lora("pub/repo/weights.safetensors", "/tmp/lora")
+    assert r["lora"] == "pub/repo"
+    assert r["weight_name"] == "weights.safetensors"
+    assert r["subfolder"] is None
+
+
+def test_deep_subfolder_path():
+    # the reference raises TypeError here (swarm/loras.py:37)
+    r = resolve_lora("pub/repo/sub1/sub2/weights.safetensors", "/tmp/lora")
+    assert r["lora"] == "pub/repo"
+    assert r["subfolder"] == "sub1/sub2"
+    assert r["weight_name"] == "weights.safetensors"
+
+
+def test_class_wrapper_expands_root():
+    r = Loras("~/lora").resolve_lora("name")
+    assert "~" not in r["lora"]

@@ -1,0 +1,58 @@
+"""Settings layering: file defaults, JSON values, env overrides.
+
+Parity targets: reference swarm/settings.py:19-43.
+"""
+
+import json
+
+from chiaswarm_tpu.settings import (
+    Settings,
+    get_settings_full_path,
+    load_settings,
+    save_settings,
+)
+
+
+def test_defaults_when_no_file(sdaas_root):
+    s = load_settings()
+    assert s.sdaas_uri == "http://localhost:9511"
+    assert s.worker_name == "worker"
+    assert s.log_level == "WARN"
+    assert s.lora_root_dir == "~/lora"
+
+
+def test_file_values_loaded(sdaas_root):
+    save_settings(Settings(sdaas_token="tok", worker_name="tpu-worker"))
+    s = load_settings()
+    assert s.sdaas_token == "tok"
+    assert s.worker_name == "tpu-worker"
+
+
+def test_env_overrides_file(sdaas_root, monkeypatch):
+    save_settings(Settings(sdaas_token="file-tok", worker_name="file-name"))
+    monkeypatch.setenv("SDAAS_TOKEN", "env-tok")
+    monkeypatch.setenv("SDAAS_WORKERNAME", "env-name")
+    monkeypatch.setenv("SDAAS_URI", "https://hive.example")
+    s = load_settings()
+    assert s.sdaas_token == "env-tok"
+    assert s.worker_name == "env-name"
+    assert s.sdaas_uri == "https://hive.example"
+
+
+def test_invalid_json_falls_back_to_defaults(sdaas_root):
+    get_settings_full_path().write_text("{not json")
+    s = load_settings()
+    assert s.worker_name == "worker"
+
+
+def test_unknown_keys_ignored(sdaas_root):
+    get_settings_full_path().write_text(json.dumps({"bogus": 1, "sdaas_token": "t"}))
+    s = load_settings()
+    assert s.sdaas_token == "t"
+
+
+def test_tpu_fields_roundtrip(sdaas_root):
+    save_settings(Settings(chips_per_job=4, dtype="float32"))
+    s = load_settings()
+    assert s.chips_per_job == 4
+    assert s.dtype == "float32"

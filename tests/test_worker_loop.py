@@ -1,0 +1,113 @@
+"""End-to-end worker loop against the in-process fake hive.
+
+Exercises: poll -> job dispatch -> chip-slice execution -> artifact packaging
+-> result upload, plus the fatal-vs-transient error policy (reference
+swarm/worker.py:105-161 semantics) — all hermetic on CPU devices.
+"""
+
+import asyncio
+import base64
+
+import pytest
+
+from chiaswarm_tpu import worker as worker_mod
+from chiaswarm_tpu.chips.allocator import SliceAllocator
+from chiaswarm_tpu.settings import Settings
+from chiaswarm_tpu.worker import Worker
+
+from .fake_hive import FakeHive
+
+
+@pytest.fixture(autouse=True)
+def fast_poll(monkeypatch):
+    monkeypatch.setattr(worker_mod, "POLL_SECONDS", 0.05)
+    monkeypatch.setattr(worker_mod, "ERROR_BACKOFF_SECONDS", 0.2)
+
+
+def run_jobs(jobs, sdaas_root, n_results=None, chips_per_job=4):
+    async def scenario():
+        hive = await FakeHive().start()
+        for job in jobs:
+            hive.add_job(job)
+        settings = Settings(sdaas_token="test-token", worker_name="test-worker")
+        w = Worker(
+            settings=settings,
+            allocator=SliceAllocator(chips_per_job=chips_per_job),
+            hive_uri=hive.uri,
+        )
+        runner = asyncio.create_task(w.run())
+        try:
+            results = await hive.wait_for_results(n_results or len(jobs))
+        finally:
+            w.stop()
+            await asyncio.wait_for(runner, 10)
+            await hive.stop()
+        return hive, results
+
+    return asyncio.run(scenario())
+
+
+def test_echo_job_end_to_end(sdaas_root):
+    hive, results = run_jobs(
+        [{"id": "job-1", "workflow": "echo", "model_name": "none", "prompt": "hello"}],
+        sdaas_root,
+    )
+    [result] = results
+    assert result["id"] == "job-1"
+    assert result["pipeline_config"]["echo"] is True
+    assert "seed" in result["pipeline_config"]
+    blob = base64.b64decode(result["artifacts"]["primary"]["blob"])
+    assert blob.startswith(b"\xff\xd8")  # jpeg
+    assert not result.get("fatal_error")
+
+
+def test_capability_advertisement(sdaas_root):
+    hive, _ = run_jobs(
+        [{"id": "job-1", "workflow": "echo", "model_name": "none", "prompt": "x"}],
+        sdaas_root,
+    )
+    req = hive.work_requests[0]
+    assert req["worker_name"] == "test-worker"
+    assert req["chips"] == "8"
+    assert req["slices"] == "2"
+    assert "memory" in req and "gpu" in req  # legacy keys still advertised
+
+
+def test_bad_args_produce_fatal_envelope(sdaas_root):
+    # img2img with no input image: format_args raises -> fatal, don't resubmit
+    hive, results = run_jobs(
+        [{"id": "job-2", "workflow": "img2img", "model_name": "m"}], sdaas_root
+    )
+    [result] = results
+    assert result["fatal_error"] is True
+    assert "error" in result["pipeline_config"]
+
+
+def test_transient_error_renders_error_image(sdaas_root):
+    # txt2audio callback exists but audio pipeline raises: transient ->
+    # error-image artifact, envelope NOT fatal
+    hive, results = run_jobs(
+        [
+            {
+                "id": "job-3",
+                "workflow": "txt2audio",
+                "model_name": "suno/bark",
+                "prompt": "x",
+                "content_type": "image/jpeg",
+            }
+        ],
+        sdaas_root,
+    )
+    [result] = results
+    assert not result.get("fatal_error")
+    assert "error" in result["pipeline_config"]
+    assert result["artifacts"]["primary"]["content_type"] == "image/jpeg"
+
+
+def test_multiple_jobs_across_slices(sdaas_root):
+    jobs = [
+        {"id": f"job-{i}", "workflow": "echo", "model_name": "none", "prompt": str(i)}
+        for i in range(4)
+    ]
+    hive, results = run_jobs(jobs, sdaas_root, chips_per_job=2)
+    assert {r["id"] for r in results} == {f"job-{i}" for i in range(4)}
