@@ -1,0 +1,19 @@
+"""Flax model zoo: UNet2D (SD/SDXL), AutoencoderKL, CLIP text encoders.
+
+Written NHWC-first for TPU (XLA tiles NHWC convs onto the MXU directly);
+HF torch checkpoints are converted by `conversion.py`. Architecture parity
+targets the models the reference serves via diffusers (SURVEY §2.7).
+"""
+
+from .clip import CLIPTextConfig, CLIPTextEncoder
+from .unet2d import UNet2DConfig, UNet2DConditionModel
+from .vae import AutoencoderKL, VAEConfig
+
+__all__ = [
+    "CLIPTextConfig",
+    "CLIPTextEncoder",
+    "UNet2DConfig",
+    "UNet2DConditionModel",
+    "AutoencoderKL",
+    "VAEConfig",
+]

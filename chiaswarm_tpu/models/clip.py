@@ -1,0 +1,128 @@
+"""CLIP text encoders (SD1.x ViT-L, SD2.x OpenCLIP-H, SDXL dual encoders).
+
+Config-driven flax transformer with causal masking; supports returning the
+penultimate hidden state (SD2/SDXL use clip-skip style conditioning) and a
+final text projection (SDXL's second encoder pools + projects).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+
+@dataclasses.dataclass(frozen=True)
+class CLIPTextConfig:
+    vocab_size: int = 49408
+    hidden_size: int = 1024
+    num_layers: int = 23
+    num_heads: int = 16
+    max_positions: int = 77
+    intermediate_mult: int = 4
+    hidden_act: str = "gelu"  # gelu (SD2/XL) | quick_gelu (SD1.x ViT-L)
+    # output selection: -1 = final layer norm output; -2 = penultimate layer
+    hidden_state_index: int = -1
+    projection_dim: int = 0  # >0: emit pooled projection (SDXL encoder 2)
+
+
+def _act(name: str):
+    if name == "quick_gelu":
+        return lambda x: x * nn.sigmoid(1.702 * x)
+    # exact erf gelu (transformers "gelu"); flax defaults to tanh approx
+    return lambda x: nn.gelu(x, approximate=False)
+
+
+class CLIPAttention(nn.Module):
+    config: CLIPTextConfig
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, hidden, mask):
+        cfg = self.config
+        head_dim = cfg.hidden_size // cfg.num_heads
+        b, s, _ = hidden.shape
+
+        def heads(name):
+            return nn.Dense(cfg.hidden_size, dtype=self.dtype, name=name)(
+                hidden
+            ).reshape(b, s, cfg.num_heads, head_dim)
+
+        q, k, v = heads("q_proj"), heads("k_proj"), heads("v_proj")
+        logits = jnp.einsum("bqhd,bkhd->bhqk", q, k) * head_dim**-0.5
+        logits = logits + mask
+        weights = nn.softmax(logits.astype(jnp.float32), axis=-1).astype(self.dtype)
+        out = jnp.einsum("bhqk,bkhd->bqhd", weights, v).reshape(b, s, cfg.hidden_size)
+        return nn.Dense(cfg.hidden_size, dtype=self.dtype, name="out_proj")(out)
+
+
+class CLIPLayer(nn.Module):
+    config: CLIPTextConfig
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, hidden, mask):
+        cfg = self.config
+        hidden = hidden + CLIPAttention(cfg, dtype=self.dtype, name="self_attn")(
+            nn.LayerNorm(epsilon=1e-5, dtype=self.dtype, name="layer_norm1")(hidden),
+            mask,
+        )
+        mlp_in = nn.LayerNorm(epsilon=1e-5, dtype=self.dtype, name="layer_norm2")(hidden)
+        h = nn.Dense(
+            cfg.hidden_size * cfg.intermediate_mult, dtype=self.dtype, name="fc1"
+        )(mlp_in)
+        h = _act(cfg.hidden_act)(h)
+        return hidden + nn.Dense(cfg.hidden_size, dtype=self.dtype, name="fc2")(h)
+
+
+class CLIPTextEncoder(nn.Module):
+    config: CLIPTextConfig
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, input_ids):
+        """input_ids [B, 77] -> dict with:
+        - hidden_states: [B, 77, D] conditioning sequence (per config index)
+        - pooled: [B, D or projection_dim] EOS-token pooled output
+        """
+        cfg = self.config
+        b, s = input_ids.shape
+
+        tok = nn.Embed(
+            cfg.vocab_size, cfg.hidden_size, dtype=self.dtype, name="token_embedding"
+        )(input_ids)
+        pos = self.param(
+            "position_embedding",
+            nn.initializers.normal(0.01),
+            (cfg.max_positions, cfg.hidden_size),
+        ).astype(self.dtype)
+        hidden = tok + pos[None, :s, :]
+
+        causal = jnp.triu(jnp.full((s, s), -1e9, self.dtype), k=1)[None, None]
+
+        collected = []
+        for i in range(cfg.num_layers):
+            collected.append(hidden)
+            hidden = CLIPLayer(cfg, dtype=self.dtype, name=f"layers_{i}")(hidden, causal)
+        final = nn.LayerNorm(epsilon=1e-5, dtype=self.dtype, name="final_layer_norm")(
+            hidden
+        )
+        collected.append(final)  # index -1
+
+        # hidden_state_index -2 = input of the last layer (diffusers clip-skip)
+        out_hidden = final if cfg.hidden_state_index == -1 else collected[
+            cfg.hidden_state_index
+        ]
+
+        # pooled = final-LN state at each sequence's EOS (= argmax token id,
+        # EOS has the highest id in CLIP vocab)
+        eos_idx = jnp.argmax(input_ids, axis=-1)
+        pooled = final[jnp.arange(b), eos_idx]
+        if cfg.projection_dim:
+            pooled = nn.Dense(
+                cfg.projection_dim, use_bias=False, dtype=self.dtype,
+                name="text_projection",
+            )(pooled)
+
+        return {"hidden_states": out_hidden, "pooled": pooled}

@@ -1,0 +1,190 @@
+"""HF torch checkpoint -> Flax param tree conversion.
+
+The #1 hard part per SURVEY §7: diffusers/transformers safetensors state
+dicts (NCHW convs, [out,in] linears, dotted names) map onto the NHWC flax
+modules in this package. Module naming in unet2d/vae/clip deliberately
+mirrors the HF graph so the mapping is mechanical:
+
+  torch `down_blocks.0.resnets.1.conv1.weight` [O,I,kh,kw]
+    -> flax params["down_blocks_0"]["resnets_1"]["conv1"]["kernel"] [kh,kw,I,O]
+
+Rules:
+- conv weight (4d): transpose OIHW -> HWIO
+- linear weight (2d): transpose [O,I] -> [I,O]
+- norm weight/bias: -> scale/bias
+- embeddings: kept as-is ([V, D])
+- flax GroupNorm/LayerNorm: weight -> scale
+
+Works from a flat `{name: np.ndarray}` dict, so the source can be
+safetensors files, torch .bin (via torch.load), or a synthetic test dict.
+"""
+
+from __future__ import annotations
+
+import logging
+from pathlib import Path
+
+import numpy as np
+
+logger = logging.getLogger(__name__)
+
+
+def load_torch_state_dict(model_dir: str | Path, subfolder: str = "") -> dict:
+    """Flat numpy state dict from safetensors file(s) under model_dir."""
+    from safetensors import safe_open
+
+    root = Path(model_dir) / subfolder
+    files = sorted(root.glob("*.safetensors"))
+    if not files:
+        raise FileNotFoundError(f"no safetensors under {root}")
+    state = {}
+    for f in files:
+        with safe_open(str(f), framework="np") as sf:
+            for key in sf.keys():
+                state[key] = sf.get_tensor(key)
+    return state
+
+
+def _assign(tree: dict, path: list[str], value) -> None:
+    node = tree
+    for p in path[:-1]:
+        node = node.setdefault(p, {})
+    node[path[-1]] = value
+
+
+def torch_name_to_flax_path(name: str) -> tuple[list[str], str]:
+    """'down_blocks.0.resnets.1.conv1.weight' ->
+    (['down_blocks_0','resnets_1','conv1'], 'weight')"""
+    parts = name.split(".")
+    leaf = parts[-1]
+    merged: list[str] = []
+    for p in parts[:-1]:
+        if p.isdigit() and merged:
+            merged[-1] = f"{merged[-1]}_{p}"
+        else:
+            merged.append(p)
+    return merged, leaf
+
+
+def convert_tensor(path: list[str], leaf: str, tensor: np.ndarray):
+    """Apply layout + naming rules for one parameter."""
+    if leaf == "weight":
+        if tensor.ndim == 4:
+            if path and path[-1] in ("proj_in", "proj_out") and tensor.shape[2:] == (1, 1):
+                # SD1.x Transformer2D proj convs are 1x1; our module is Dense
+                return "kernel", tensor[:, :, 0, 0].T
+            return "kernel", tensor.transpose(2, 3, 1, 0)  # conv OIHW -> HWIO
+        if tensor.ndim == 2:
+            if "embedding" in path[-1] or "embed_tokens" in path[-1]:
+                return "embedding", tensor
+            return "kernel", tensor.T
+        if tensor.ndim == 1:  # norm scale
+            return "scale", tensor
+    if leaf == "bias":
+        return "bias", tensor
+    if leaf in ("position_ids",):
+        return None, None  # buffer, not a param
+    # verbatim leaves (e.g. logit_scale, position_embedding as param)
+    return leaf, tensor
+
+
+def convert_state_dict(state: dict, rename=None) -> dict:
+    """Flat torch state dict -> nested flax params dict (numpy leaves).
+
+    `rename`: optional callable mapping torch names to this package's module
+    names (model-specific quirks, e.g. CLIP's text_model prefix).
+    """
+    params: dict = {}
+    for name, tensor in state.items():
+        if rename is not None:
+            name = rename(name)
+            if name is None:
+                continue
+        path, leaf = torch_name_to_flax_path(name)
+        new_leaf, value = convert_tensor(path, leaf, np.asarray(tensor))
+        if new_leaf is None:
+            continue
+        _assign(params, path + [new_leaf], value)
+    return params
+
+
+# --- model-specific torch-name normalizers ---
+
+
+def clip_rename(name: str) -> str | None:
+    """transformers CLIPTextModel names -> models.clip module names."""
+    if name.startswith("text_model."):
+        name = name[len("text_model.") :]
+    name = name.replace("encoder.layers.", "layers.")
+    name = name.replace("embeddings.token_embedding", "token_embedding")
+    name = name.replace("mlp.fc1", "fc1").replace("mlp.fc2", "fc2")
+    if "embeddings.position_ids" in name:
+        return None
+    if "embeddings.position_embedding.weight" in name:
+        # stored as a bare param (not nn.Embed) in CLIPTextEncoder
+        return "position_embedding"
+    return name
+
+
+def vae_rename(name: str) -> str | None:
+    """diffusers AutoencoderKL names -> models.vae module names (the flax
+    modules flatten mid/up/down block interiors into single-level names)."""
+    name = name.replace("mid_block.resnets.", "mid_block_resnets.")
+    name = name.replace("mid_block.attentions.", "mid_block_attentions.")
+    for kind in ("down_blocks", "up_blocks"):
+        # down_blocks.0.resnets.1.x -> down_blocks_0_resnets.1.x
+        import re
+
+        name = re.sub(rf"{kind}\.(\d+)\.resnets\.", rf"{kind}_\1_resnets.", name)
+        name = re.sub(
+            rf"{kind}\.(\d+)\.downsamplers\.", rf"{kind}_\1_downsamplers.", name
+        )
+        name = re.sub(rf"{kind}\.(\d+)\.upsamplers\.", rf"{kind}_\1_upsamplers.", name)
+    # legacy attention naming (diffusers <0.18): query/key/value/proj_attn
+    name = name.replace(".query.", ".to_q.")
+    name = name.replace(".key.", ".to_k.")
+    name = name.replace(".value.", ".to_v.")
+    name = name.replace(".proj_attn.", ".to_out.0.")
+    name = name.replace(".to_out.0.", ".to_out_0.")
+    return name
+
+
+def unet_rename(name: str) -> str | None:
+    """diffusers UNet2DConditionModel names -> models.unet2d module names."""
+    name = name.replace(".to_out.0.", ".to_out_0.")
+    name = name.replace(".ff.net.0.", ".ff.net_0.")
+    name = name.replace(".ff.net.2.", ".ff.net_2.")
+    return name
+
+
+def convert_clip(state: dict) -> dict:
+    return convert_state_dict(state, clip_rename)
+
+
+def convert_vae(state: dict) -> dict:
+    return convert_state_dict(state, vae_rename)
+
+
+def convert_unet(state: dict) -> dict:
+    return convert_state_dict(state, unet_rename)
+
+
+def assert_tree_shapes_match(converted: dict, initialized: dict, prefix=""):
+    """Structural check: every initialized param has a converted twin of the
+    same shape. Raises with the full list of mismatches."""
+    problems: list[str] = []
+
+    def walk(c, i, path):
+        if isinstance(i, dict):
+            for k, v in i.items():
+                if not isinstance(c, dict) or k not in c:
+                    problems.append(f"missing {path}/{k}")
+                else:
+                    walk(c[k], v, f"{path}/{k}")
+        else:
+            if np.shape(c) != np.shape(i):
+                problems.append(f"shape {path}: {np.shape(c)} != {np.shape(i)}")
+
+    walk(converted, initialized, prefix)
+    if problems:
+        raise ValueError("conversion mismatches:\n" + "\n".join(problems[:40]))

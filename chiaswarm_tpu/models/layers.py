@@ -1,0 +1,219 @@
+"""Shared diffusion building blocks (flax.linen, NHWC).
+
+Block semantics match the SD/SDXL architecture family so HF checkpoints
+convert 1:1 (conversion.py), but the code is organized TPU-first: tensors
+stay NHWC, attention routes through ops.dot_product_attention (Pallas flash
+on TPU), and everything traces to static shapes.
+"""
+
+from __future__ import annotations
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from ..ops import dot_product_attention
+
+
+def timestep_embedding(
+    timesteps,
+    dim: int,
+    *,
+    max_period: float = 10000.0,
+    flip_sin_to_cos: bool = True,
+    downscale_freq_shift: float = 0.0,
+    dtype=jnp.float32,
+):
+    """Sinusoidal timestep features [B] -> [B, dim] (SD convention: cos-first)."""
+    half = dim // 2
+    exponent = -jnp.log(max_period) * jnp.arange(half, dtype=jnp.float32)
+    exponent = exponent / (half - downscale_freq_shift)
+    freqs = jnp.exp(exponent)
+    args = jnp.asarray(timesteps, jnp.float32)[:, None] * freqs[None, :]
+    emb = jnp.concatenate([jnp.sin(args), jnp.cos(args)], axis=-1)
+    if flip_sin_to_cos:
+        emb = jnp.concatenate([emb[:, half:], emb[:, :half]], axis=-1)
+    return emb.astype(dtype)
+
+
+class TimestepEmbedding(nn.Module):
+    """2-layer MLP lifting sinusoidal features to the UNet's temb width."""
+
+    dim: int
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, sample):
+        sample = nn.Dense(self.dim, dtype=self.dtype, name="linear_1")(sample)
+        sample = nn.silu(sample)
+        return nn.Dense(self.dim, dtype=self.dtype, name="linear_2")(sample)
+
+
+class ResnetBlock2D(nn.Module):
+    out_channels: int
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, temb=None):
+        residual = x
+        h = nn.GroupNorm(32, epsilon=1e-5, dtype=self.dtype, name="norm1")(x)
+        h = nn.silu(h)
+        h = nn.Conv(
+            self.out_channels, (3, 3), padding=((1, 1), (1, 1)), dtype=self.dtype,
+            name="conv1",
+        )(h)
+
+        if temb is not None:
+            temb_proj = nn.Dense(self.out_channels, dtype=self.dtype, name="time_emb_proj")(
+                nn.silu(temb)
+            )
+            h = h + temb_proj[:, None, None, :]
+
+        h = nn.GroupNorm(32, epsilon=1e-5, dtype=self.dtype, name="norm2")(h)
+        h = nn.silu(h)
+        h = nn.Conv(
+            self.out_channels, (3, 3), padding=((1, 1), (1, 1)), dtype=self.dtype,
+            name="conv2",
+        )(h)
+
+        if residual.shape[-1] != self.out_channels:
+            residual = nn.Conv(
+                self.out_channels, (1, 1), dtype=self.dtype, name="conv_shortcut"
+            )(residual)
+        return h + residual
+
+
+class Attention(nn.Module):
+    """Multi-head attention over [B, S, C] with optional cross context."""
+
+    num_heads: int
+    head_dim: int
+    out_dim: int
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, hidden, context=None):
+        context = hidden if context is None else context
+        inner = self.num_heads * self.head_dim
+        q = nn.Dense(inner, use_bias=False, dtype=self.dtype, name="to_q")(hidden)
+        k = nn.Dense(inner, use_bias=False, dtype=self.dtype, name="to_k")(context)
+        v = nn.Dense(inner, use_bias=False, dtype=self.dtype, name="to_v")(context)
+
+        b, sq, _ = q.shape
+        sk = k.shape[1]
+        q = q.reshape(b, sq, self.num_heads, self.head_dim)
+        k = k.reshape(b, sk, self.num_heads, self.head_dim)
+        v = v.reshape(b, sk, self.num_heads, self.head_dim)
+
+        out = dot_product_attention(q, k, v)
+        out = out.reshape(b, sq, inner)
+        return nn.Dense(self.out_dim, dtype=self.dtype, name="to_out_0")(out)
+
+
+class GEGLU(nn.Module):
+    dim: int
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x):
+        h = nn.Dense(self.dim * 2, dtype=self.dtype, name="proj")(x)
+        h, gate = jnp.split(h, 2, axis=-1)
+        return h * nn.gelu(gate, approximate=False)  # erf gelu, diffusers parity
+
+
+class FeedForward(nn.Module):
+    dim: int
+    mult: int = 4
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x):
+        x = GEGLU(self.dim * self.mult, dtype=self.dtype, name="net_0")(x)
+        return nn.Dense(self.dim, dtype=self.dtype, name="net_2")(x)
+
+
+class BasicTransformerBlock(nn.Module):
+    """self-attn -> cross-attn -> GEGLU MLP, pre-LN residual wiring."""
+
+    dim: int
+    num_heads: int
+    head_dim: int
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, hidden, context):
+        attn = Attention(
+            self.num_heads, self.head_dim, self.dim, dtype=self.dtype, name="attn1"
+        )
+        hidden = hidden + attn(
+            nn.LayerNorm(epsilon=1e-5, dtype=self.dtype, name="norm1")(hidden)
+        )
+        cross = Attention(
+            self.num_heads, self.head_dim, self.dim, dtype=self.dtype, name="attn2"
+        )
+        hidden = hidden + cross(
+            nn.LayerNorm(epsilon=1e-5, dtype=self.dtype, name="norm2")(hidden), context
+        )
+        ff = FeedForward(self.dim, dtype=self.dtype, name="ff")
+        return hidden + ff(
+            nn.LayerNorm(epsilon=1e-5, dtype=self.dtype, name="norm3")(hidden)
+        )
+
+
+class Transformer2DModel(nn.Module):
+    """Spatial transformer: NHWC -> tokens -> N blocks -> NHWC residual."""
+
+    num_heads: int
+    head_dim: int
+    num_layers: int
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, context):
+        b, h, w, c = x.shape
+        residual = x
+        hidden = nn.GroupNorm(32, epsilon=1e-6, dtype=self.dtype, name="norm")(x)
+        hidden = hidden.reshape(b, h * w, c)
+        hidden = nn.Dense(c, dtype=self.dtype, name="proj_in")(hidden)
+        for i in range(self.num_layers):
+            hidden = BasicTransformerBlock(
+                c,
+                self.num_heads,
+                self.head_dim,
+                dtype=self.dtype,
+                name=f"transformer_blocks_{i}",
+            )(hidden, context)
+        hidden = nn.Dense(c, dtype=self.dtype, name="proj_out")(hidden)
+        return hidden.reshape(b, h, w, c) + residual
+
+
+class Downsample2D(nn.Module):
+    out_channels: int
+    # VAE encoder uses asymmetric (0,1) padding (diffusers parity); UNet (1,1)
+    asymmetric_pad: bool = False
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x):
+        pad = ((0, 1), (0, 1)) if self.asymmetric_pad else ((1, 1), (1, 1))
+        return nn.Conv(
+            self.out_channels,
+            (3, 3),
+            strides=(2, 2),
+            padding=pad,
+            dtype=self.dtype,
+            name="conv",
+        )(x)
+
+
+class Upsample2D(nn.Module):
+    out_channels: int
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x):
+        b, h, w, c = x.shape
+        x = jnp.repeat(jnp.repeat(x, 2, axis=1), 2, axis=2)  # nearest 2x
+        return nn.Conv(
+            self.out_channels, (3, 3), padding=((1, 1), (1, 1)), dtype=self.dtype,
+            name="conv",
+        )(x)

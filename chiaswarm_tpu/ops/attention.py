@@ -1,0 +1,56 @@
+"""Attention dispatch: Pallas flash kernel on TPU, fused XLA path elsewhere.
+
+The reference leans on diffusers' attention slicing to fit VRAM
+(swarm/diffusion/diffusion_func.py:134-146); on TPU the lever is a fused
+flash kernel that never materializes the [S, S] score matrix in HBM
+(SURVEY §7 'Pallas attention kernel'). All shapes here are [B, S, H, D].
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+# sequence length below which the plain XLA path is faster than paying
+# kernel launch + pipelining overheads
+_FLASH_MIN_SEQ = 1024
+
+
+def reference_attention(q, k, v, scale: float | None = None):
+    """Readable O(S^2)-memory reference; also the CPU/test path."""
+    if scale is None:
+        scale = q.shape[-1] ** -0.5
+    logits = jnp.einsum("bqhd,bkhd->bhqk", q, k) * scale
+    weights = jax.nn.softmax(logits.astype(jnp.float32), axis=-1).astype(q.dtype)
+    return jnp.einsum("bhqk,bkhd->bqhd", weights, v)
+
+
+@functools.partial(jax.named_call, name="attention")
+def dot_product_attention(q, k, v, scale: float | None = None):
+    """[B, S_q, H, D] x [B, S_kv, H, D] -> [B, S_q, H, D].
+
+    Self- and cross-attention both route here (cross: S_kv = text length).
+    On TPU with long latent sequences the Pallas flash kernel takes over;
+    otherwise XLA's fused attention handles it.
+    """
+    on_tpu = jax.default_backend() == "tpu"  # trace-time platform check
+    if on_tpu and q.shape[1] >= _FLASH_MIN_SEQ and q.shape[-1] <= 128:
+        try:
+            from .flash_attention import flash_attention
+        except ImportError:
+            _warn_no_flash()
+        else:
+            return flash_attention(q, k, v, scale=scale)
+    return reference_attention(q, k, v, scale=scale)
+
+
+@functools.cache
+def _warn_no_flash():
+    import logging
+
+    logging.getLogger(__name__).warning(
+        "Pallas flash-attention kernel unavailable; falling back to the "
+        "O(S^2)-memory XLA attention path."
+    )

@@ -1,0 +1,65 @@
+"""Diffusion noise schedulers, designed for `lax.scan` denoising loops.
+
+The reference swaps diffusers scheduler classes by wire name with optional
+Karras sigmas (swarm/diffusion/diffusion_func.py:129-132). Here schedulers
+are *functional*: `make_schedule()` precomputes all per-step constants as
+arrays at trace time (static shapes, no data-dependent control flow), and
+`step()` is a pure function `(state, i, sample, model_output, noise) ->
+(state, sample)` suitable for the body of a jitted scan. Multistep history
+(DPM-Solver++) lives in the state pytree.
+
+Wire names accepted (reference hive schema, SURVEY §2.7) map via
+`get_scheduler`.
+"""
+
+from .common import Schedule, SchedulerConfig
+from .solvers import (
+    DDIMScheduler,
+    DDPMScheduler,
+    DPMSolverMultistepScheduler,
+    EulerAncestralDiscreteScheduler,
+    EulerDiscreteScheduler,
+    FlowMatchEulerScheduler,
+    LCMScheduler,
+)
+
+# wire name -> implementation; aliases cover every scheduler_type string the
+# reference test matrix sends (swarm/test.py)
+SCHEDULERS = {
+    "DPMSolverMultistepScheduler": DPMSolverMultistepScheduler,
+    "DPMSolverSinglestepScheduler": DPMSolverMultistepScheduler,
+    "UniPCMultistepScheduler": DPMSolverMultistepScheduler,
+    "EulerDiscreteScheduler": EulerDiscreteScheduler,
+    "EulerAncestralDiscreteScheduler": EulerAncestralDiscreteScheduler,
+    "DDIMScheduler": DDIMScheduler,
+    "DDPMScheduler": DDPMScheduler,
+    "PNDMScheduler": DDIMScheduler,
+    "LMSDiscreteScheduler": EulerDiscreteScheduler,
+    "HeunDiscreteScheduler": EulerDiscreteScheduler,
+    "LCMScheduler": LCMScheduler,
+    "FlowMatchEulerDiscreteScheduler": FlowMatchEulerScheduler,
+    "FlowMatchEulerScheduler": FlowMatchEulerScheduler,
+}
+
+
+def get_scheduler(name: str, **config):
+    try:
+        cls = SCHEDULERS[name]
+    except KeyError:
+        raise ValueError(f"Unknown scheduler type: {name}") from None
+    return cls(SchedulerConfig(**config))
+
+
+__all__ = [
+    "Schedule",
+    "SchedulerConfig",
+    "get_scheduler",
+    "SCHEDULERS",
+    "DDIMScheduler",
+    "DDPMScheduler",
+    "DPMSolverMultistepScheduler",
+    "EulerAncestralDiscreteScheduler",
+    "EulerDiscreteScheduler",
+    "FlowMatchEulerScheduler",
+    "LCMScheduler",
+]

@@ -1,0 +1,291 @@
+"""Solver implementations over the shared Schedule tables.
+
+Two internal parametrizations, hidden behind one interface:
+
+- *sigma space* (Euler family): the latent is x = x0 + sigma*eps; the model
+  input is rescaled by 1/sqrt(sigma^2+1) each step.
+- *VP space* (DDIM/DDPM/DPM++/LCM): the latent is
+  x = sqrt(abar)*x0 + sqrt(1-abar)*eps with abar = 1/(1+sigma^2); model
+  input needs no rescaling.
+
+Every `step()` is a pure jnp function of (state, i, sample, model_output,
+noise) with `i` a traced scan counter indexing the schedule arrays, so a
+whole denoise loop jits as one `lax.scan` (SURVEY §7: no data-dependent
+Python control flow).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from .common import (
+    Schedule,
+    SchedulerConfig,
+    ddpm_schedule,
+    discrete_schedule,
+    train_sigmas,
+)
+
+
+def _match_dims(a, x):
+    """Broadcast a scalar/1-d step constant over a NCHW/NHWC batch."""
+    return jnp.asarray(a, x.dtype).reshape((-1,) + (1,) * (x.ndim - 1))
+
+
+# --- prediction-type conversions ---
+
+
+def x0_from_sigma_space(sample, model_output, sigma, prediction_type):
+    """x0 given sigma-space sample (x = x0 + sigma*eps)."""
+    if prediction_type == "epsilon":
+        return sample - sigma * model_output
+    if prediction_type == "v_prediction":
+        return sample / (sigma**2 + 1.0) - model_output * sigma / jnp.sqrt(
+            sigma**2 + 1.0
+        )
+    if prediction_type == "sample":
+        return model_output
+    raise ValueError(f"Unknown prediction type: {prediction_type}")
+
+
+def x0_eps_from_vp_space(sample, model_output, abar, prediction_type):
+    """(x0, eps) given VP sample (x = sqrt(abar)x0 + sqrt(1-abar)eps)."""
+    sqrt_a, sqrt_1ma = jnp.sqrt(abar), jnp.sqrt(1.0 - abar)
+    if prediction_type == "epsilon":
+        eps = model_output
+        x0 = (sample - sqrt_1ma * eps) / sqrt_a
+    elif prediction_type == "v_prediction":
+        x0 = sqrt_a * sample - sqrt_1ma * model_output
+        eps = sqrt_a * model_output + sqrt_1ma * sample
+    elif prediction_type == "sample":
+        x0 = model_output
+        eps = (sample - sqrt_a * x0) / jnp.maximum(sqrt_1ma, 1e-8)
+    else:
+        raise ValueError(f"Unknown prediction type: {prediction_type}")
+    return x0, eps
+
+
+class BaseScheduler:
+    """Stateless solver bound to a SchedulerConfig."""
+
+    uses_ancestral_noise = False
+
+    def __init__(self, config: SchedulerConfig | None = None):
+        self.config = config or SchedulerConfig()
+
+    # hosts-side: called once per (num_steps) at trace time
+    def schedule(self, num_steps: int) -> Schedule:
+        raise NotImplementedError
+
+    # device-side helpers
+    def scale_model_input(self, schedule: Schedule, sample, i):
+        return sample
+
+    def init_state(self, sample_shape, dtype):
+        return ()
+
+    def step(self, schedule: Schedule, state, i, sample, model_output, noise):
+        raise NotImplementedError
+
+
+# --- sigma-space solvers ---
+
+
+class EulerDiscreteScheduler(BaseScheduler):
+    def schedule(self, num_steps: int) -> Schedule:
+        s = discrete_schedule(self.config, num_steps)
+        # diffusers parity: 'leading' spacing scales init noise by
+        # sqrt(sigma_max^2+1); linspace/trailing by sigma_max
+        if self.config.timestep_spacing == "leading":
+            init = float(np.sqrt(s.sigmas[0] ** 2 + 1.0))
+        else:
+            init = float(s.sigmas[0])
+        return Schedule(s.timesteps, s.sigmas, init, num_steps)
+
+    def scale_model_input(self, schedule, sample, i):
+        sigma = jnp.asarray(schedule.sigmas)[i]
+        return sample / jnp.sqrt(sigma**2 + 1.0)
+
+    def step(self, schedule, state, i, sample, model_output, noise):
+        sigmas = jnp.asarray(schedule.sigmas)
+        sigma, sigma_next = sigmas[i], sigmas[i + 1]
+        x0 = x0_from_sigma_space(
+            sample, model_output, sigma, self.config.prediction_type
+        )
+        derivative = (sample - x0) / sigma
+        return state, sample + derivative * (sigma_next - sigma)
+
+
+class EulerAncestralDiscreteScheduler(EulerDiscreteScheduler):
+    uses_ancestral_noise = True
+
+    def step(self, schedule, state, i, sample, model_output, noise):
+        sigmas = jnp.asarray(schedule.sigmas)
+        sigma, sigma_next = sigmas[i], sigmas[i + 1]
+        x0 = x0_from_sigma_space(
+            sample, model_output, sigma, self.config.prediction_type
+        )
+        sigma_up = jnp.sqrt(
+            jnp.maximum(sigma_next**2 * (sigma**2 - sigma_next**2) / sigma**2, 0.0)
+        )
+        sigma_down = jnp.sqrt(jnp.maximum(sigma_next**2 - sigma_up**2, 0.0))
+        derivative = (sample - x0) / sigma
+        sample = sample + derivative * (sigma_down - sigma)
+        return state, sample + noise * sigma_up
+
+
+# --- VP-space solvers ---
+
+
+def _abar(sigma):
+    return 1.0 / (1.0 + sigma**2)
+
+
+class DPMSolverMultistepScheduler(BaseScheduler):
+    """DPM-Solver++(2M), data-prediction variant — the reference's default
+    scheduler (swarm/job_arguments.py:210). First and final steps fall back
+    to first order (lower_order_final) for few-step stability."""
+
+    def schedule(self, num_steps: int) -> Schedule:
+        s = discrete_schedule(self.config, num_steps)
+        return Schedule(s.timesteps, s.sigmas, 1.0, num_steps)
+
+    def init_state(self, sample_shape, dtype):
+        # previous step's x0 prediction (zeros until step 1)
+        return jnp.zeros(sample_shape, dtype)
+
+    def step(self, schedule, state, i, sample, model_output, noise):
+        sigmas = jnp.asarray(schedule.sigmas)
+        # terminal sigma 0 -> clamp for log; final update handled below
+        sig_t, sig_next = sigmas[i], jnp.maximum(sigmas[i + 1], 1e-5)
+        sig_prev = jnp.where(i > 0, sigmas[jnp.maximum(i - 1, 0)], sig_t)
+
+        abar_t = _abar(sig_t)
+        x0, _ = x0_eps_from_vp_space(
+            sample, model_output, abar_t, self.config.prediction_type
+        )
+
+        lam = lambda s: -jnp.log(s)
+        h = lam(sig_next) - lam(sig_t)
+        h_last = lam(sig_t) - lam(sig_prev)
+        r = h_last / jnp.where(h == 0, 1.0, h)
+
+        x0_prev = state
+        d_2m = (1.0 + 1.0 / (2.0 * jnp.where(r == 0, 1.0, r))) * x0 - (
+            1.0 / (2.0 * jnp.where(r == 0, 1.0, r))
+        ) * x0_prev
+        first_order = (i == 0) | (i == schedule.num_steps - 1)
+        d = jnp.where(first_order, x0, d_2m)
+
+        # VP-space sigma/alpha at boundaries
+        alpha_next = jnp.sqrt(_abar(sig_next))
+        sigma_vp_next = sig_next * alpha_next
+        sigma_vp_t = sig_t * jnp.sqrt(abar_t)
+
+        new_sample = (sigma_vp_next / sigma_vp_t) * sample - alpha_next * (
+            jnp.exp(-h) - 1.0
+        ) * d
+        # exact final step: return x0 (sigma -> 0)
+        new_sample = jnp.where(i == schedule.num_steps - 1, d, new_sample)
+        return x0, new_sample
+
+
+class DDIMScheduler(BaseScheduler):
+    def schedule(self, num_steps: int) -> Schedule:
+        return ddpm_schedule(self.config, num_steps)
+
+    def step(self, schedule, state, i, sample, model_output, noise):
+        sigmas = jnp.asarray(schedule.sigmas)
+        abar_t, abar_next = _abar(sigmas[i]), _abar(sigmas[i + 1])
+        x0, eps = x0_eps_from_vp_space(
+            sample, model_output, abar_t, self.config.prediction_type
+        )
+        return state, jnp.sqrt(abar_next) * x0 + jnp.sqrt(1.0 - abar_next) * eps
+
+
+class DDPMScheduler(BaseScheduler):
+    uses_ancestral_noise = True
+
+    def schedule(self, num_steps: int) -> Schedule:
+        return ddpm_schedule(self.config, num_steps)
+
+    def step(self, schedule, state, i, sample, model_output, noise):
+        sigmas = jnp.asarray(schedule.sigmas)
+        abar_t, abar_next = _abar(sigmas[i]), _abar(sigmas[i + 1])
+        alpha_t = abar_t / abar_next  # per-step alpha
+        beta_t = 1.0 - alpha_t
+        x0, eps = x0_eps_from_vp_space(
+            sample, model_output, abar_t, self.config.prediction_type
+        )
+        # posterior mean (DDPM eq. 7)
+        mean = (
+            jnp.sqrt(abar_next) * beta_t / (1.0 - abar_t) * x0
+            + jnp.sqrt(alpha_t) * (1.0 - abar_next) / (1.0 - abar_t) * sample
+        )
+        var = beta_t * (1.0 - abar_next) / (1.0 - abar_t)
+        last = i == schedule.num_steps - 1
+        sample = mean + jnp.where(last, 0.0, 1.0) * jnp.sqrt(
+            jnp.maximum(var, 1e-20)
+        ) * noise
+        return state, jnp.where(last, x0, sample)
+
+
+class LCMScheduler(BaseScheduler):
+    """Latent-consistency sampling (AnimateLCM / LCM-LoRA jobs,
+    swarm/test.py:150-178): x0 via boundary-condition scaling, fresh noise
+    re-injection between the few steps."""
+
+    uses_ancestral_noise = True
+
+    def schedule(self, num_steps: int) -> Schedule:
+        # LCM picks its k timesteps from the teacher's original step grid
+        cfg = self.config
+        n = cfg.num_train_timesteps
+        k = n // cfg.original_inference_steps
+        origin = np.arange(1, cfg.original_inference_steps + 1) * k - 1
+        idx = np.linspace(0, len(origin) - 1, num_steps).round().astype(int)
+        ts = origin[idx][::-1].astype(np.float64)
+        sigmas = np.interp(ts, np.arange(n), train_sigmas(cfg))
+        sigmas = np.concatenate([sigmas, [0.0]]).astype(np.float32)
+        return Schedule(ts.astype(np.float32), sigmas, 1.0, num_steps)
+
+    def step(self, schedule, state, i, sample, model_output, noise):
+        sigmas = jnp.asarray(schedule.sigmas)
+        timesteps = jnp.asarray(schedule.timesteps)
+        abar_t, abar_next = _abar(sigmas[i]), _abar(sigmas[i + 1])
+        x0, _ = x0_eps_from_vp_space(
+            sample, model_output, abar_t, self.config.prediction_type
+        )
+        # consistency boundary conditions (sigma_data=0.5, timestep_scaling=10)
+        scaled_t = timesteps[i] * 10.0
+        c_skip = 0.5**2 / (scaled_t**2 + 0.5**2)
+        c_out = scaled_t / jnp.sqrt(scaled_t**2 + 0.5**2)
+        denoised = c_skip * sample + c_out * x0
+        last = i == schedule.num_steps - 1
+        next_sample = jnp.sqrt(abar_next) * denoised + jnp.sqrt(
+            1.0 - abar_next
+        ) * noise
+        return state, jnp.where(last, denoised, next_sample)
+
+
+class FlowMatchEulerScheduler(BaseScheduler):
+    """Rectified-flow Euler for Flux-style MMDiT models: x_t = (1-s)x0 + s*eps,
+    model predicts velocity (eps - x0); resolution-shifted sigmas."""
+
+    def schedule(self, num_steps: int) -> Schedule:
+        shift = self.config.shift
+        s = np.linspace(1.0, 1.0 / num_steps, num_steps)
+        s = shift * s / (1.0 + (shift - 1.0) * s)
+        sigmas = np.concatenate([s, [0.0]]).astype(np.float32)
+        return Schedule(
+            timesteps=(s * self.config.num_train_timesteps).astype(np.float32),
+            sigmas=sigmas,
+            init_noise_sigma=1.0,
+            num_steps=num_steps,
+        )
+
+    def step(self, schedule, state, i, sample, model_output, noise):
+        sigmas = jnp.asarray(schedule.sigmas)
+        return state, sample + (sigmas[i + 1] - sigmas[i]) * model_output

@@ -1,0 +1,80 @@
+"""CLIP BPE tokenizer: merge algorithm, layout, fallback."""
+
+import json
+
+import numpy as np
+
+from chiaswarm_tpu.models.tokenizer import (
+    CLIPTokenizer,
+    HashTokenizer,
+    bytes_to_unicode,
+    load_tokenizer,
+)
+
+
+def tiny_tokenizer():
+    # vocab: single chars + a couple of merges for "cat"/"at</w>"
+    chars = [c for c in "abcdefghijklmnopqrstuvwxyz "]
+    vocab = {}
+    for c in chars:
+        vocab[c] = len(vocab)
+        vocab[c + "</w>"] = len(vocab)
+    for merged in ["at</w>", "cat</w>", "do", "dog</w>"]:
+        vocab[merged] = len(vocab)
+    vocab["<|startoftext|>"] = len(vocab)
+    vocab["<|endoftext|>"] = len(vocab)
+    merges = [("a", "t</w>"), ("c", "at</w>"), ("d", "o"), ("do", "g</w>")]
+    return CLIPTokenizer(vocab, merges, max_length=16)
+
+
+def test_bpe_merges_applied():
+    tok = tiny_tokenizer()
+    assert tok.bpe("cat") == ["cat</w>"]
+    assert tok.bpe("dog") == ["dog</w>"]
+    assert tok.bpe("ba") == ["b", "a</w>"]
+
+
+def test_encode_layout():
+    tok = tiny_tokenizer()
+    ids = tok("a cat")
+    assert ids.shape == (1, 16)
+    assert ids[0, 0] == tok.bos
+    decoded = list(ids[0])
+    eos_pos = decoded.index(tok.eos)
+    assert eos_pos == 3  # BOS, a</w>, cat</w>, EOS
+    assert all(x == tok.eos for x in decoded[eos_pos:])
+
+
+def test_long_prompt_truncated():
+    tok = tiny_tokenizer()
+    ids = tok(" ".join(["cat"] * 50))
+    assert ids.shape == (1, 16)
+    assert ids[0, -1] == tok.eos
+
+
+def test_byte_unicode_reversible():
+    mapping = bytes_to_unicode()
+    assert len(mapping) == 256
+    assert len(set(mapping.values())) == 256
+
+
+def test_from_dir_and_loader(tmp_path):
+    tok = tiny_tokenizer()
+    d = tmp_path / "model" / "tokenizer"
+    d.mkdir(parents=True)
+    (d / "vocab.json").write_text(json.dumps(tok.vocab))
+    (d / "merges.txt").write_text(
+        "#version\n" + "\n".join(f"{a} {b}" for a, b in tok.ranks)
+    )
+    loaded = load_tokenizer(tmp_path / "model", max_length=16)
+    assert isinstance(loaded, CLIPTokenizer)
+    np.testing.assert_array_equal(loaded("a cat"), tok("a cat"))
+
+
+def test_hash_fallback_deterministic(tmp_path):
+    loaded = load_tokenizer(tmp_path / "missing", vocab_size=1000)
+    assert isinstance(loaded, HashTokenizer)
+    a = loaded("a cat sat")
+    b = loaded("a cat sat")
+    np.testing.assert_array_equal(a, b)
+    assert a.max() < 1000
