@@ -1,0 +1,35 @@
+"""Intra-job parallelism: device meshes, sharding, and XLA collectives.
+
+The reference has NO intra-job parallelism or collective backend — its
+"distributed" layer is the HTTP hive protocol (SURVEY §2.6). This package is
+the part the TPU rebuild adds: jobs run over a `jax.sharding.Mesh` of the
+chips a ChipSet allocated, with the batch (and CFG pair) sharded over the
+`data` axis, model weights optionally sharded over `tensor`, and long
+sequences over `seq` via ring attention. Collectives ride ICI within a
+slice and DCN across hosts, inserted by XLA from sharding annotations.
+"""
+
+from .mesh import (
+    batch_sharding,
+    host_local_mesh,
+    make_mesh,
+    pad_batch,
+    replicated,
+    shard_batch,
+)
+from .ring import ring_attention, ring_self_attention_sharded
+from .tensor import column_parallel, row_parallel, unet_partition_rules
+
+__all__ = [
+    "batch_sharding",
+    "host_local_mesh",
+    "make_mesh",
+    "pad_batch",
+    "replicated",
+    "shard_batch",
+    "ring_attention",
+    "ring_self_attention_sharded",
+    "column_parallel",
+    "row_parallel",
+    "unet_partition_rules",
+]

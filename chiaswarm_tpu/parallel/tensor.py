@@ -1,0 +1,90 @@
+"""Tensor-parallel partition rules for the diffusion model families.
+
+Megatron-style sharding expressed as jax PartitionSpecs over flax param
+trees: attention QKV + MLP-in are column-parallel (shard the output
+feature dim over ``tensor``), attention-out + MLP-out are row-parallel
+(shard the input dim); XLA inserts the psum where the row-parallel matmul
+contracts over the sharded dim. Convolutions and norms are small — they
+stay replicated. The reference scales big models by CPU offload instead
+(swarm/diffusion/diffusion_func.py:134-146); on TPU we shard.
+"""
+
+from __future__ import annotations
+
+import re
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from .mesh import TENSOR_AXIS
+
+
+def column_parallel() -> P:
+    """Kernel [in, out] sharded on out -> each device computes a head/ffn slice."""
+    return P(None, TENSOR_AXIS)
+
+
+def row_parallel() -> P:
+    """Kernel [in, out] sharded on in -> psum over tensor axis after matmul."""
+    return P(TENSOR_AXIS, None)
+
+
+# (regex over "/"-joined param path) -> spec, first match wins.
+# Matches the module names in models/layers.py Transformer2DModel /
+# FeedForward and models/clip.py CLIPAttention.
+_UNET_RULES: tuple[tuple[str, P], ...] = (
+    (r".*(to_q|to_k|to_v|q_proj|k_proj|v_proj)/kernel$", column_parallel()),
+    (r".*(to_out_0|out_proj)/kernel$", row_parallel()),
+    (r".*net_0_proj/kernel$", column_parallel()),  # geglu in (gate+value)
+    (r".*net_2/kernel$", row_parallel()),  # ffn out
+    (r".*(to_out_0|out_proj)/bias$", P()),  # bias added after psum: replicate
+)
+
+
+def unet_partition_rules():
+    return _UNET_RULES
+
+
+def _spec_for(path: str, rules) -> P:
+    for pattern, spec in rules:
+        if re.fullmatch(pattern, path):
+            return spec
+    return P()
+
+
+def partition_spec_tree(params, rules=_UNET_RULES):
+    """Map a param pytree to PartitionSpecs by path."""
+    flat = jax.tree_util.tree_flatten_with_path(params)[0]
+
+    def path_str(kp):
+        return "/".join(str(getattr(k, "key", getattr(k, "idx", k))) for k in kp)
+
+    specs = {path_str(kp): _spec_for(path_str(kp), rules) for kp, _ in flat}
+
+    def lookup(kp, leaf):
+        spec = specs[path_str(kp)]
+        # never shard a dim the leaf doesn't have or that doesn't divide
+        if len(spec) > leaf.ndim:
+            return P()
+        return spec
+
+    return jax.tree_util.tree_map_with_path(lookup, params)
+
+
+def shard_params(mesh: Mesh, params, rules=_UNET_RULES):
+    """Place a param tree on the mesh per the partition rules.
+
+    A leaf whose dim doesn't divide the mesh axis falls back to replication
+    (e.g. head dims not divisible by the tensor axis) instead of erroring
+    deep inside device_put.
+    """
+    specs = partition_spec_tree(params, rules)
+
+    def place(x, spec):
+        for d, axis in enumerate(spec):
+            if axis is not None and x.shape[d] % mesh.shape[axis] != 0:
+                spec = P()
+                break
+        return jax.device_put(x, NamedSharding(mesh, spec))
+
+    return jax.tree_util.tree_map(place, params, specs)
