@@ -35,7 +35,17 @@ def dot_product_attention(q, k, v, scale: float | None = None):
     On TPU with long latent sequences the Pallas flash kernel takes over;
     otherwise XLA's fused attention handles it.
     """
-    on_tpu = jax.default_backend() == "tpu"  # trace-time platform check
+    # trace-time platform check honoring an active `jax.default_device(...)`
+    # scope (e.g. param init pinned to CPU while the global backend is TPU);
+    # the override may be a Device or a platform string
+    override = jax.config.jax_default_device
+    if override is None:
+        platform = jax.default_backend()
+    elif isinstance(override, str):
+        platform = override
+    else:
+        platform = override.platform
+    on_tpu = platform == "tpu"
     if on_tpu and q.shape[1] >= _FLASH_MIN_SEQ and q.shape[-1] <= 128:
         try:
             from .flash_attention import flash_attention

@@ -1,0 +1,121 @@
+"""Pallas flash attention for TPU (forward only — inference framework).
+
+The hot attention in diffusion UNets/DiTs: latent self-attention at 1024^2
+is 4096 tokens, where the O(S^2) score matrix (4096^2 x heads x f32) blows
+HBM traffic; this kernel keeps the online-softmax state in VMEM and streams
+KV blocks, so scores never round-trip to HBM (SURVEY §7 hard part #3).
+
+Non-causal (diffusion attention has no causal mask), self- and cross-
+attention (padded + masked KV for ragged text lengths like 77).
+
+Layout: q [B, Sq, H, D], k/v [B, Skv, H, D] -> [B, Sq, H, D], matching
+ops.attention. Internally heads fold into the grid's batch dimension.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+_NEG_INF = -1e30
+
+
+def _flash_kernel(q_ref, k_ref, v_ref, o_ref, *, block_k: int, kv_len: int,
+                  scale: float):
+    """One (batch*head, q-block) program: stream KV blocks, online softmax.
+
+    q_ref [1, BQ, D]; k_ref/v_ref [1, Skv_pad, D]; o_ref [1, BQ, D].
+    """
+    q = q_ref[0].astype(jnp.float32) * scale
+    block_q, head_dim = q.shape
+    padded_kv = k_ref.shape[1]
+
+    m0 = jnp.full((block_q, 1), _NEG_INF, jnp.float32)
+    l0 = jnp.zeros((block_q, 1), jnp.float32)
+    acc0 = jnp.zeros((block_q, head_dim), jnp.float32)
+
+    def body(j, carry):
+        m, l, acc = carry
+        k = k_ref[0, pl.ds(j * block_k, block_k), :]
+        v = v_ref[0, pl.ds(j * block_k, block_k), :]
+        s = jax.lax.dot_general(
+            q, k.astype(jnp.float32),
+            dimension_numbers=(((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )  # [BQ, BK]
+        # mask KV padding (ragged cross-attention lengths)
+        if kv_len % block_k:
+            col = j * block_k + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+            s = jnp.where(col < kv_len, s, _NEG_INF)
+        m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
+        alpha = jnp.exp(m - m_new)
+        p = jnp.exp(s - m_new)
+        l_new = l * alpha + jnp.sum(p, axis=-1, keepdims=True)
+        acc_new = acc * alpha + jax.lax.dot_general(
+            p.astype(v.dtype), v,
+            dimension_numbers=(((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        return m_new, l_new, acc_new
+
+    _, l, acc = jax.lax.fori_loop(0, padded_kv // block_k, body, (m0, l0, acc0))
+    o_ref[0] = (acc / jnp.maximum(l, 1e-30)).astype(o_ref.dtype)
+
+
+def _pad_to(x, length: int, axis: int):
+    pad = length - x.shape[axis]
+    if pad == 0:
+        return x
+    widths = [(0, 0)] * x.ndim
+    widths[axis] = (0, pad)
+    return jnp.pad(x, widths)
+
+
+@functools.partial(
+    jax.jit, static_argnames=("scale", "block_q", "block_k", "interpret")
+)
+def flash_attention(q, k, v, scale: float | None = None, block_q: int = 512,
+                    block_k: int = 512, interpret: bool = False):
+    """[B, Sq, H, D] x [B, Skv, H, D] -> [B, Sq, H, D]."""
+    if scale is None:
+        scale = q.shape[-1] ** -0.5
+    b, sq, h, d = q.shape
+    skv = k.shape[1]
+
+    block_q = min(block_q, max(sq, 16))
+    block_k = min(block_k, max(_round_up(skv, 128), 128))
+
+    sq_pad = _round_up(sq, block_q)
+    skv_pad = _round_up(skv, block_k)
+
+    # [B, S, H, D] -> [B*H, S, D] so heads ride the grid's batch dim
+    fold = lambda x, s_pad: _pad_to(
+        jnp.transpose(x, (0, 2, 1, 3)), s_pad, 2
+    ).reshape(b * h, s_pad, d)
+    qf, kf, vf = fold(q, sq_pad), fold(k, skv_pad), fold(v, skv_pad)
+
+    grid = (b * h, sq_pad // block_q)
+    out = pl.pallas_call(
+        functools.partial(
+            _flash_kernel, block_k=block_k, kv_len=skv, scale=scale
+        ),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), lambda bh, i: (bh, i, 0)),
+            pl.BlockSpec((1, skv_pad, d), lambda bh, i: (bh, 0, 0)),
+            pl.BlockSpec((1, skv_pad, d), lambda bh, i: (bh, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, block_q, d), lambda bh, i: (bh, i, 0)),
+        out_shape=jax.ShapeDtypeStruct((b * h, sq_pad, d), q.dtype),
+        interpret=interpret,
+    )(qf, kf, vf)
+
+    out = out.reshape(b, h, sq_pad, d)[:, :, :sq, :]
+    return jnp.transpose(out, (0, 2, 1, 3))
+
+
+def _round_up(n: int, m: int) -> int:
+    return ((n + m - 1) // m) * m
