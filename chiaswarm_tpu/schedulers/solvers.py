@@ -89,6 +89,15 @@ class BaseScheduler:
     def step(self, schedule: Schedule, state, i, sample, model_output, noise):
         raise NotImplementedError
 
+    def add_noise(self, schedule: Schedule, x0, noise, i):
+        """Noise clean latents to step i's level (img2img/inpaint starts).
+
+        VP-space form; sigma-space solvers override. `i` may be traced.
+        """
+        sigma = jnp.asarray(schedule.sigmas)[i]
+        abar = _abar(sigma)
+        return jnp.sqrt(abar) * x0 + jnp.sqrt(1.0 - abar) * noise
+
 
 # --- sigma-space solvers ---
 
@@ -107,6 +116,10 @@ class EulerDiscreteScheduler(BaseScheduler):
     def scale_model_input(self, schedule, sample, i):
         sigma = jnp.asarray(schedule.sigmas)[i]
         return sample / jnp.sqrt(sigma**2 + 1.0)
+
+    def add_noise(self, schedule, x0, noise, i):
+        # sigma space: x = x0 + sigma*eps
+        return x0 + jnp.asarray(schedule.sigmas)[i] * noise
 
     def step(self, schedule, state, i, sample, model_output, noise):
         sigmas = jnp.asarray(schedule.sigmas)
@@ -153,8 +166,10 @@ class DPMSolverMultistepScheduler(BaseScheduler):
         return Schedule(s.timesteps, s.sigmas, 1.0, num_steps)
 
     def init_state(self, sample_shape, dtype):
-        # previous step's x0 prediction (zeros until step 1)
-        return jnp.zeros(sample_shape, dtype)
+        # (previous step's x0 prediction, has-history flag). The flag — not
+        # `i == 0` — gates the 2nd-order update: img2img/inpaint scans start
+        # at i = t_start > 0, where x0_prev is still the zeros init.
+        return (jnp.zeros(sample_shape, dtype), jnp.zeros((), jnp.bool_))
 
     def step(self, schedule, state, i, sample, model_output, noise):
         sigmas = jnp.asarray(schedule.sigmas)
@@ -172,11 +187,11 @@ class DPMSolverMultistepScheduler(BaseScheduler):
         h_last = lam(sig_t) - lam(sig_prev)
         r = h_last / jnp.where(h == 0, 1.0, h)
 
-        x0_prev = state
+        x0_prev, has_history = state
         d_2m = (1.0 + 1.0 / (2.0 * jnp.where(r == 0, 1.0, r))) * x0 - (
             1.0 / (2.0 * jnp.where(r == 0, 1.0, r))
         ) * x0_prev
-        first_order = (i == 0) | (i == schedule.num_steps - 1)
+        first_order = (~has_history) | (i == schedule.num_steps - 1)
         d = jnp.where(first_order, x0, d_2m)
 
         # VP-space sigma/alpha at boundaries
@@ -189,7 +204,7 @@ class DPMSolverMultistepScheduler(BaseScheduler):
         ) * d
         # exact final step: return x0 (sigma -> 0)
         new_sample = jnp.where(i == schedule.num_steps - 1, d, new_sample)
-        return x0, new_sample
+        return (x0, jnp.ones((), jnp.bool_)), new_sample
 
 
 class DDIMScheduler(BaseScheduler):
@@ -289,3 +304,8 @@ class FlowMatchEulerScheduler(BaseScheduler):
     def step(self, schedule, state, i, sample, model_output, noise):
         sigmas = jnp.asarray(schedule.sigmas)
         return state, sample + (sigmas[i + 1] - sigmas[i]) * model_output
+
+    def add_noise(self, schedule, x0, noise, i):
+        # rectified flow: x_s = (1-s)*x0 + s*eps
+        s = jnp.asarray(schedule.sigmas)[i]
+        return (1.0 - s) * x0 + s * noise

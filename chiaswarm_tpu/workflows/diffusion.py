@@ -17,6 +17,15 @@ def diffusion_callback(device_identifier: str, model_name: str, **kwargs):
     content_type = kwargs.pop("content_type", "image/jpeg")
     outputs = kwargs.pop("outputs", ["primary"])
 
+    if kwargs.pop("test_tiny_model", False):
+        # hermetic test hook (SURVEY §4): serve the job with the tiny
+        # random-weight stand-in of the requested architecture family
+        from ..models.configs import model_family
+
+        model_name = (
+            "test/tiny-xl" if "xl" in model_family(model_name) else "test/tiny-sd"
+        )
+
     pipeline_type = kwargs.pop("pipeline_type", "DiffusionPipeline")
     pipeline = get_pipeline(
         model_name, pipeline_type=pipeline_type, chipset=kwargs.get("chipset")

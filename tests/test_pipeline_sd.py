@@ -1,0 +1,167 @@
+"""End-to-end SD pipeline tests on tiny configs (hermetic, CPU mesh).
+
+The reference's only 'test' was eyeballing real-GPU output (SURVEY §4);
+here the full job path — registry residency, text encode, scan denoise with
+CFG, VAE decode, PIL artifacts — runs on random tiny weights in seconds.
+"""
+
+import numpy as np
+import pytest
+from PIL import Image
+
+from chiaswarm_tpu import registry
+from chiaswarm_tpu.chips.device import ChipSet
+from chiaswarm_tpu.pipelines.stable_diffusion import SDPipeline
+
+import jax
+
+
+@pytest.fixture(autouse=True)
+def clean_registry():
+    registry.clear_cache()
+    yield
+    registry.clear_cache()
+
+
+@pytest.fixture(scope="module")
+def tiny_sd():
+    return SDPipeline("test/tiny-sd")
+
+
+@pytest.fixture(scope="module")
+def tiny_xl():
+    return SDPipeline("test/tiny-xl")
+
+
+def test_txt2img_basic(tiny_sd):
+    images, config = tiny_sd.run(
+        prompt="a photo of a cat",
+        height=64,
+        width=64,
+        num_inference_steps=3,
+        rng=jax.random.key(7),
+    )
+    assert len(images) == 1
+    assert images[0].size == (64, 64)
+    assert config["mode"] == "txt2img"
+    assert config["steps"] == 3
+    assert config["timings"]["denoise_decode_s"] > 0
+
+
+def test_txt2img_deterministic_given_seed(tiny_sd):
+    run = lambda: np.asarray(
+        tiny_sd.run(
+            prompt="same seed",
+            height=64,
+            width=64,
+            num_inference_steps=2,
+            rng=jax.random.key(3),
+        )[0][0]
+    )
+    np.testing.assert_array_equal(run(), run())
+
+
+def test_txt2img_seed_changes_output(tiny_sd):
+    a = np.asarray(
+        tiny_sd.run(prompt="x", height=64, width=64, num_inference_steps=2,
+                    rng=jax.random.key(1))[0][0]
+    )
+    b = np.asarray(
+        tiny_sd.run(prompt="x", height=64, width=64, num_inference_steps=2,
+                    rng=jax.random.key(2))[0][0]
+    )
+    assert not np.array_equal(a, b)
+
+
+@pytest.mark.parametrize(
+    "scheduler",
+    ["EulerDiscreteScheduler", "EulerAncestralDiscreteScheduler",
+     "DDIMScheduler", "LCMScheduler"],
+)
+def test_scheduler_variants(tiny_sd, scheduler):
+    images, config = tiny_sd.run(
+        prompt="scheduler test", height=64, width=64, num_inference_steps=2,
+        scheduler_type=scheduler, rng=jax.random.key(0),
+    )
+    arr = np.asarray(images[0])
+    assert arr.shape == (64, 64, 3)
+    assert config["scheduler"] == scheduler
+
+
+def test_img2img(tiny_sd):
+    start = Image.fromarray(
+        (np.random.default_rng(0).random((64, 64, 3)) * 255).astype(np.uint8)
+    )
+    images, config = tiny_sd.run(
+        prompt="repaint", image=start, strength=0.5, num_inference_steps=4,
+        rng=jax.random.key(0),
+    )
+    assert config["mode"] == "img2img"
+    assert images[0].size == (64, 64)
+
+
+def test_inpaint_preserves_unmasked_region(tiny_sd):
+    rng = np.random.default_rng(1)
+    start = Image.fromarray((rng.random((64, 64, 3)) * 255).astype(np.uint8))
+    # repaint only the left half
+    mask = np.zeros((64, 64), np.uint8)
+    mask[:, :32] = 255
+    images, config = tiny_sd.run(
+        prompt="fill", image=start, mask_image=Image.fromarray(mask),
+        strength=1.0, num_inference_steps=3, rng=jax.random.key(0),
+    )
+    assert config["mode"] == "inpaint"
+    out = np.asarray(images[0], np.float32)
+    # The unmasked (right) half rides the original's noise trajectory, so it
+    # is nearly seed-independent; the masked half is sampled. Exact equality
+    # is impossible — the VAE decoder's global attention bleeds masked
+    # content everywhere — so assert the contrast, not bit-equality.
+    out2 = np.asarray(
+        tiny_sd.run(
+            prompt="fill", image=start, mask_image=Image.fromarray(mask),
+            strength=1.0, num_inference_steps=3, rng=jax.random.key(9),
+        )[0][0],
+        np.float32,
+    )
+    right_diff = np.abs(out[:, 32:] - out2[:, 32:]).mean()
+    left_diff = np.abs(out[:, :32] - out2[:, :32]).mean()
+    assert left_diff > 4 * right_diff, (left_diff, right_diff)
+
+
+def test_sdxl_branch(tiny_xl):
+    images, config = tiny_xl.run(
+        prompt="xl", height=64, width=64, num_inference_steps=2,
+        rng=jax.random.key(0),
+    )
+    assert images[0].size == (64, 64)
+    assert tiny_xl.is_xl
+
+
+def test_batch_sharded_over_mesh():
+    chipset = ChipSet(jax.devices())  # all 8 virtual devices, 'data' axis
+    pipe = SDPipeline("test/tiny-sd-mesh", chipset=chipset)
+    assert pipe.data_parts == 8
+    images, _ = pipe.run(
+        prompt="sharded", height=64, width=64, num_inference_steps=2,
+        num_images_per_prompt=8, rng=jax.random.key(0),
+    )
+    assert len(images) == 8
+
+
+def test_registry_residency():
+    p1 = registry.get_pipeline("test/tiny-sd", "StableDiffusionPipeline")
+    p2 = registry.get_pipeline("test/tiny-sd", "StableDiffusionImg2ImgPipeline")
+    assert p1 is p2  # same family + model -> one resident bundle
+
+
+def test_program_cache_reused(tiny_sd):
+    tiny_sd._programs.clear()
+    kw = dict(prompt="warm", height=64, width=64, num_inference_steps=2,
+              rng=jax.random.key(0))
+    tiny_sd.run(**kw)
+    assert len(tiny_sd._programs) == 1
+    tiny_sd.run(**kw)
+    assert len(tiny_sd._programs) == 1  # same bucket -> no retrace
+    tiny_sd.run(prompt="warm", height=128, width=64, num_inference_steps=2,
+                rng=jax.random.key(0))
+    assert len(tiny_sd._programs) == 2

@@ -141,3 +141,37 @@ def test_schedule_is_jit_static():
 def test_unknown_scheduler_raises():
     with pytest.raises(ValueError, match="Unknown scheduler"):
         get_scheduler("NotAScheduler")
+
+
+def test_dpm_first_executed_step_is_first_order_mid_schedule():
+    """img2img scans start at t_start > 0; the first executed step must not
+    consume the zeros-initialized x0_prev as second-order history."""
+    import jax.numpy as jnp
+    import numpy as np
+
+    from chiaswarm_tpu.schedulers import DPMSolverMultistepScheduler
+
+    scheduler = DPMSolverMultistepScheduler()
+    schedule = scheduler.schedule(8)
+    shape = (1, 4, 4, 4)
+    sample = jnp.ones(shape)
+    model_output = jnp.full(shape, 0.1)
+
+    # starting cold at i=3 must give the same update as starting cold at i=3
+    # with a *poisoned* x0_prev — i.e. x0_prev must be ignored
+    state_clean = scheduler.init_state(shape, jnp.float32)
+    poisoned = (jnp.full(shape, 123.0), state_clean[1])
+    _, out_clean = scheduler.step(schedule, state_clean, 3, sample, model_output, None)
+    _, out_poisoned = scheduler.step(schedule, poisoned, 3, sample, model_output, None)
+    np.testing.assert_array_equal(np.asarray(out_clean), np.asarray(out_poisoned))
+
+    # but with genuine history the second-order path must engage
+    (x0_prev, flag), _ = scheduler.step(
+        schedule, state_clean, 3, sample, model_output, None
+    )
+    assert bool(flag)
+    state_hist = (jnp.full(shape, 0.5), flag)
+    _, out_hist = scheduler.step(schedule, state_hist, 4, sample, model_output, None)
+    state_cold = scheduler.init_state(shape, jnp.float32)
+    _, out_cold = scheduler.step(schedule, state_cold, 4, sample, model_output, None)
+    assert not np.array_equal(np.asarray(out_hist), np.asarray(out_cold))
