@@ -1,0 +1,163 @@
+"""LoRA adapters merged into Flax param trees at load time.
+
+The reference loads LoRA per job via diffusers `load_lora_weights` + fuse
+(swarm/diffusion/diffusion_func.py:113-126) — a per-job torch graph edit.
+On TPU the jitted program's weights are just a pytree, so a LoRA is merged
+arithmetically (W += scale * (alpha/r) * B @ A) into a COPY of the base
+tree, and the merged tree is cached by (model, lora, scale) at the pipeline
+layer — zero per-step cost, no graph surgery.
+
+Supports both common safetensors layouts:
+- diffusers/PEFT: `unet.down_blocks.0...to_q.lora_A.weight` / `lora_B`
+- kohya:          `lora_unet_down_blocks_0_..._to_q.lora_down.weight` / `lora_up`
+  with optional per-module `.alpha` tensors.
+"""
+
+from __future__ import annotations
+
+import logging
+from pathlib import Path
+
+import jax.numpy as jnp
+import numpy as np
+
+logger = logging.getLogger(__name__)
+
+
+def load_lora_state(path: str | Path, weight_name: str | None = None,
+                    subfolder: str | None = None) -> dict:
+    """Flat {name: np.ndarray} from a LoRA safetensors file."""
+    from safetensors import safe_open
+
+    root = Path(path).expanduser()
+    if subfolder:
+        root = root / subfolder
+    if root.is_dir():
+        candidates = (
+            [root / weight_name]
+            if weight_name
+            else sorted(root.glob("*.safetensors"))
+        )
+        if not candidates or not candidates[0].is_file():
+            raise FileNotFoundError(f"no LoRA weights under {root}")
+        root = candidates[0]
+    state = {}
+    with safe_open(str(root), framework="np") as sf:
+        for key in sf.keys():
+            state[key] = sf.get_tensor(key)
+    return state
+
+
+def _module_path(name: str) -> tuple[str, str] | None:
+    """LoRA tensor name -> ('/'-joined flax module path, 'A'|'B'|'alpha')."""
+    if name.endswith(".alpha"):
+        base, kind = name[: -len(".alpha")], "alpha"
+    elif name.endswith(".lora_A.weight") or name.endswith(".lora_down.weight"):
+        base, kind = name.rsplit(".lora_", 1)[0], "A"
+    elif name.endswith(".lora_B.weight") or name.endswith(".lora_up.weight"):
+        base, kind = name.rsplit(".lora_", 1)[0], "B"
+    else:
+        return None
+
+    # kohya: lora_unet_down_blocks_0_attentions_0_... (all underscores)
+    if base.startswith("lora_unet_"):
+        base = base[len("lora_unet_"):]
+        return base, kind
+    if base.startswith("lora_te_") or base.startswith("lora_te1_") or base.startswith(
+        "lora_te2_"
+    ):
+        return None  # text-encoder LoRA: not merged yet
+    # diffusers: unet.down_blocks.0.attentions.0....processor?.to_q(_lora)?
+    if base.startswith("unet."):
+        base = base[len("unet."):]
+    elif base.startswith("text_encoder"):
+        return None
+    base = (
+        base.replace(".processor.", ".")
+        .replace("_lora", "")
+        .replace("to_out.0", "to_out_0")
+    )
+    return base.replace(".", "_"), kind
+
+
+def collect_lora_deltas(state: dict) -> dict[str, tuple]:
+    """Group tensors -> {module_key: (A [r,in], B [out,r], alpha|None)}."""
+    mods: dict[str, dict] = {}
+    for name, tensor in state.items():
+        parsed = _module_path(name)
+        if parsed is None:
+            continue
+        base, kind = parsed
+        mods.setdefault(base, {})[kind] = tensor
+    out = {}
+    for base, parts in mods.items():
+        if "A" in parts and "B" in parts:
+            out[base] = (
+                parts["A"],
+                parts["B"],
+                float(parts["alpha"]) if "alpha" in parts else None,
+            )
+    return out
+
+
+def _flat_params(tree, prefix=()):
+    for k, v in tree.items():
+        path = prefix + (k,)
+        if isinstance(v, dict):
+            yield from _flat_params(v, path)
+        else:
+            yield path, v
+
+
+def merge_lora(params: dict, lora_state: dict, scale: float = 1.0) -> tuple[dict, int]:
+    """Return (new param tree with LoRA deltas merged, matched module count).
+
+    `params` is a UNet param tree whose linear kernels are [in, out]; LoRA
+    A/B are torch-layout [r, in] / [out, r], so delta_kernel = (B @ A).T.
+    Unmatched LoRA modules are logged and skipped (reference behavior: LoRA
+    incompatibility is a job error, not a crash — handled by caller).
+    """
+    deltas = collect_lora_deltas(lora_state)
+    if not deltas:
+        return params, 0
+
+    # index the param tree by normalized underscore path of the kernel's parent
+    index = {}
+    for path, leaf in _flat_params(params):
+        if path[-1] != "kernel":
+            continue
+        index["_".join(path[:-1])] = path
+
+    new_params = {k: v for k, v in params.items()}  # shallow copy, CoW below
+
+    def set_leaf(path, value):
+        node = new_params
+        for p in path[:-1]:
+            child = node[p]
+            child = dict(child)
+            node[p] = child
+            node = child
+        node[path[-1]] = value
+
+    matched = 0
+    for key, (a, b, alpha) in deltas.items():
+        path = index.get(key)
+        if path is None:
+            logger.warning("LoRA module %s not found in param tree", key)
+            continue
+        node = params
+        for p in path:
+            node = node[p]
+        kernel = node
+        rank = a.shape[0]
+        eff = scale * ((alpha / rank) if alpha is not None else 1.0)
+        delta = (np.asarray(b, np.float32) @ np.asarray(a, np.float32)).T
+        if delta.shape != kernel.shape:
+            logger.warning(
+                "LoRA %s shape %s incompatible with kernel %s",
+                key, delta.shape, kernel.shape,
+            )
+            continue
+        set_leaf(path, kernel + jnp.asarray(eff * delta, kernel.dtype))
+        matched += 1
+    return new_params, matched

@@ -143,6 +143,8 @@ class UNet2DConditionModel(nn.Module):
         timesteps,  # [B] or scalar
         encoder_hidden_states,  # [B, S, cross_attention_dim]
         added_cond: dict | None = None,  # SDXL: {"text_embeds": [B,D], "time_ids": [B,6]}
+        down_residuals: tuple | None = None,  # ControlNet per-skip residuals
+        mid_residual=None,  # ControlNet mid-block residual
     ):
         cfg = self.config
         if jnp.ndim(timesteps) == 0:
@@ -195,6 +197,9 @@ class UNet2DConditionModel(nn.Module):
             )(x, temb, encoder_hidden_states)
             skips.extend(block_skips)
 
+        if down_residuals is not None:
+            skips = [s + r for s, r in zip(skips, down_residuals)]
+
         x = UNetMidBlock(
             cfg,
             cfg.block_out_channels[-1],
@@ -203,6 +208,9 @@ class UNet2DConditionModel(nn.Module):
             dtype=self.dtype,
             name="mid_block",
         )(x, temb, encoder_hidden_states)
+
+        if mid_residual is not None:
+            x = x + mid_residual
 
         for b, out_ch in enumerate(reversed(cfg.block_out_channels)):
             rev = len(cfg.block_out_channels) - 1 - b

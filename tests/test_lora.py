@@ -1,0 +1,110 @@
+"""LoRA merge tests: math, name-mapping (diffusers + kohya), job wiring."""
+
+import numpy as np
+import pytest
+from safetensors.numpy import save_file
+
+import jax
+import jax.numpy as jnp
+
+from chiaswarm_tpu.models.lora import collect_lora_deltas, merge_lora
+from chiaswarm_tpu.pipelines.stable_diffusion import SDPipeline
+
+TARGET = "down_blocks_0/attentions_0/transformer_blocks_0/attn1/to_q"
+
+
+def _params_with_kernel(shape=(32, 32)):
+    kernel = np.ones(shape, np.float32)
+    tree = {}
+    node = tree
+    for seg in TARGET.split("/")[:-1]:
+        node = node.setdefault(seg, {})
+    node[TARGET.split("/")[-1]] = {"kernel": jnp.asarray(kernel)}
+    return tree
+
+
+def _lora_state(name_style: str, rank=4, dim=32, alpha=None):
+    rng = np.random.default_rng(0)
+    a = rng.standard_normal((rank, dim)).astype(np.float32)  # [r, in]
+    b = rng.standard_normal((dim, rank)).astype(np.float32)  # [out, r]
+    if name_style == "diffusers":
+        base = "unet." + TARGET.replace("/", ".")
+        state = {f"{base}.lora_A.weight": a, f"{base}.lora_B.weight": b}
+    else:
+        base = "lora_unet_" + TARGET.replace("/", "_")
+        state = {f"{base}.lora_down.weight": a, f"{base}.lora_up.weight": b}
+        if alpha is not None:
+            state[f"{base}.alpha"] = np.float32(alpha)
+    return state, a, b
+
+
+@pytest.mark.parametrize("style", ["diffusers", "kohya"])
+def test_merge_math(style):
+    params = _params_with_kernel()
+    state, a, b = _lora_state(style)
+    merged, matched = merge_lora(params, state, scale=0.5)
+    assert matched == 1
+    node = merged
+    for seg in TARGET.split("/"):
+        node = node[seg]
+    expected = np.ones((32, 32), np.float32) + 0.5 * (b @ a).T
+    np.testing.assert_allclose(np.asarray(node["kernel"]), expected, rtol=1e-6)
+    # base tree untouched
+    node0 = params
+    for seg in TARGET.split("/"):
+        node0 = node0[seg]
+    np.testing.assert_array_equal(np.asarray(node0["kernel"]), 1.0)
+
+
+def test_alpha_scaling():
+    params = _params_with_kernel()
+    state, a, b = _lora_state("kohya", rank=4, alpha=2.0)
+    merged, matched = merge_lora(params, state, scale=1.0)
+    node = merged
+    for seg in TARGET.split("/"):
+        node = node[seg]
+    expected = np.ones((32, 32), np.float32) + (2.0 / 4.0) * (b @ a).T
+    np.testing.assert_allclose(np.asarray(node["kernel"]), expected, rtol=1e-6)
+
+
+def test_unmatched_modules_skipped():
+    params = _params_with_kernel()
+    state = {
+        "unet.nonexistent.to_q.lora_A.weight": np.zeros((4, 32), np.float32),
+        "unet.nonexistent.to_q.lora_B.weight": np.zeros((32, 4), np.float32),
+    }
+    _, matched = merge_lora(params, state, 1.0)
+    assert matched == 0
+    assert collect_lora_deltas(state)
+
+
+def test_job_with_lora_changes_output(tmp_path):
+    pipe = SDPipeline("test/tiny-sd")
+    q_kernel = np.asarray(
+        pipe.params["unet"]["down_blocks_0"]["attentions_0"][
+            "transformer_blocks_0"]["attn1"]["to_q"]["kernel"]
+    )
+    dim = q_kernel.shape[0]
+    state, _, _ = _lora_state("diffusers", rank=2, dim=dim)
+    lora_file = tmp_path / "adapter.safetensors"
+    save_file(state, str(lora_file))
+
+    kw = dict(prompt="with lora", height=64, width=64, num_inference_steps=2,
+              rng=jax.random.key(4))
+    base = np.asarray(pipe.run(**kw)[0][0])
+    lored = np.asarray(
+        pipe.run(lora={"lora": str(lora_file)}, lora_scale=1.0, **kw)[0][0]
+    )
+    assert not np.array_equal(base, lored)
+    # cached merge reused
+    assert len(pipe._lora_cache) == 1
+    pipe.run(lora={"lora": str(lora_file)}, lora_scale=1.0, **kw)
+    assert len(pipe._lora_cache) == 1
+
+
+def test_missing_lora_is_fatal_value_error():
+    pipe = SDPipeline("test/tiny-sd")
+    with pytest.raises(ValueError, match="Could not load lora"):
+        pipe.run(prompt="x", height=64, width=64, num_inference_steps=2,
+                 lora={"lora": "/does/not/exist.safetensors"},
+                 rng=jax.random.key(0))

@@ -1,0 +1,40 @@
+"""Chained-stage tests: latent 2x upscale, SDXL refiner pass."""
+
+import numpy as np
+import pytest
+
+import jax
+
+from chiaswarm_tpu import registry
+from chiaswarm_tpu.pipelines.stable_diffusion import SDPipeline
+
+
+@pytest.fixture(autouse=True)
+def clean_registry():
+    registry.clear_cache()
+    yield
+    registry.clear_cache()
+
+
+def test_upscale_doubles_output_size():
+    pipe = SDPipeline("test/tiny-sd")
+    images, config = pipe.run(
+        prompt="upscaled", height=64, width=64, num_inference_steps=2,
+        upscale=True, rng=jax.random.key(0),
+    )
+    assert images[0].size == (128, 128)
+    assert config["size"] == [64, 64]  # canvas pre-upscale, reference parity
+
+
+def test_refiner_stage_chains():
+    pipe = SDPipeline("test/tiny-xl")
+    images, config = pipe.run(
+        prompt="refined", height=64, width=64, num_inference_steps=2,
+        refiner={"model_name": "test/tiny-xl-refiner"},
+        rng=jax.random.key(0),
+    )
+    assert len(images) == 1
+    assert images[0].size == (64, 64)
+    assert "refiner_s" in config["timings"]
+    # refiner became resident in the registry for subsequent jobs
+    assert any("tiny-xl-refiner" in str(k) for k in registry._CACHE.keys())
