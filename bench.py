@@ -1,0 +1,112 @@
+"""Benchmark harness: SDXL 1024^2 30-step txt2img, images/sec/chip.
+
+The north-star config from BASELINE.json (the reference publishes no
+numbers, SURVEY §6). Run on TPU this measures the real flagship pipeline;
+on CPU it falls back to the tiny model so the harness itself stays
+testable, and labels the metric accordingly.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", ...}.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+NORTH_STAR_IMG_PER_SEC_PER_CHIP = 1.0  # BASELINE.json target on v5e-8
+
+
+def main() -> None:
+    import jax
+
+    on_tpu = jax.default_backend() == "tpu"
+
+    from chiaswarm_tpu.chips.device import ChipSet
+    from chiaswarm_tpu.pipelines.stable_diffusion import SDPipeline
+
+    chips = jax.devices()
+    chipset = ChipSet(chips)
+
+    if on_tpu:
+        model, size, steps = "stabilityai/stable-diffusion-xl-base-1.0", 1024, 30
+        batch_candidates = [int(os.environ.get("BENCH_BATCH", 0)) or 4, 2, 1]
+    else:
+        model, size, steps = "test/tiny-sd", 64, 30
+        batch_candidates = [4]
+
+    pipe = SDPipeline(model, chipset=chipset)
+
+    result = None
+    for batch in batch_candidates:
+        try:
+            result = run_config(pipe, size, steps, batch)
+            break
+        except Exception as e:  # OOM on small chips -> retry smaller batch
+            sys.stderr.write(f"batch={batch} failed: {type(e).__name__}: {e}\n")
+    if result is None:
+        raise SystemExit("all batch sizes failed")
+
+    images_per_sec, p50_job_s, batch = result
+    per_chip = images_per_sec / len(chips)
+    metric = (
+        "sdxl_txt2img_1024_30step_images_per_sec_per_chip"
+        if on_tpu
+        else "tiny_txt2img_cpu_smoke_images_per_sec_per_chip"
+    )
+    print(
+        json.dumps(
+            {
+                "metric": metric,
+                "value": round(per_chip, 4),
+                "unit": "images/sec/chip",
+                "vs_baseline": round(per_chip / NORTH_STAR_IMG_PER_SEC_PER_CHIP, 4),
+                "p50_job_s": round(p50_job_s, 3),
+                "batch": batch,
+                "chips": len(chips),
+                "backend": jax.default_backend(),
+                "steps": 30,
+                "size": 1024 if on_tpu else 64,
+            }
+        )
+    )
+
+
+def run_config(pipe, size: int, steps: int, batch: int):
+    import jax
+
+    kw = dict(
+        prompt="a photograph of an astronaut riding a horse on mars",
+        negative_prompt="blurry, low quality",
+        height=size,
+        width=size,
+        num_inference_steps=steps,
+        num_images_per_prompt=batch,
+        scheduler_type="EulerDiscreteScheduler",
+    )
+
+    # warmup: compile + first run
+    t0 = time.perf_counter()
+    pipe.run(rng=jax.random.key(0), **kw)
+    warmup_s = time.perf_counter() - t0
+    sys.stderr.write(f"warmup (incl. compile): {warmup_s:.1f}s\n")
+
+    job_times = []
+    runs = 3
+    for i in range(runs):
+        t0 = time.perf_counter()
+        _, config = pipe.run(rng=jax.random.key(i + 1), **kw)
+        job_times.append(time.perf_counter() - t0)
+        sys.stderr.write(
+            f"run {i}: {job_times[-1]:.2f}s job, "
+            f"{config['timings']['denoise_decode_s']:.2f}s denoise+decode\n"
+        )
+
+    job_times.sort()
+    p50 = job_times[len(job_times) // 2]
+    return batch / p50, p50, batch
+
+
+if __name__ == "__main__":
+    main()
