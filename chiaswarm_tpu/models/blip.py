@@ -1,0 +1,163 @@
+"""BLIP-style captioner: ViT image encoder + cross-attending text decoder.
+
+Reference swarm/captioning/caption_image.py:12-40 loads transformers BLIP
+classes named in the job JSON. TPU rebuild: one flax module pair, greedy
+decode as a fixed-length `lax.scan` (static shapes — no dynamic stopping
+inside jit; EOS handling happens on host after the scan).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+
+@dataclasses.dataclass(frozen=True)
+class BlipConfig:
+    image_size: int = 224
+    patch_size: int = 16
+    vision_hidden: int = 768
+    vision_layers: int = 12
+    vision_heads: int = 12
+    vocab_size: int = 30524  # bert-base vocab (BLIP's text side)
+    text_hidden: int = 768
+    text_layers: int = 12
+    text_heads: int = 12
+    max_caption_len: int = 24
+    bos_token_id: int = 30522
+    eos_token_id: int = 102  # bert [SEP]
+
+
+TINY_BLIP = BlipConfig(
+    image_size=64, patch_size=16, vision_hidden=32, vision_layers=2,
+    vision_heads=4, vocab_size=1000, text_hidden=32, text_layers=2,
+    text_heads=4, max_caption_len=8, bos_token_id=998, eos_token_id=999,
+)
+
+
+class _MHA(nn.Module):
+    heads: int
+    dim: int
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, q_in, kv_in, mask=None):
+        head_dim = self.dim // self.heads
+        b, sq, _ = q_in.shape
+        sk = kv_in.shape[1]
+        proj = lambda x, s, name: nn.Dense(self.dim, dtype=self.dtype, name=name)(
+            x
+        ).reshape(b, s, self.heads, head_dim)
+        q, k, v = proj(q_in, sq, "q"), proj(kv_in, sk, "k"), proj(kv_in, sk, "v")
+        logits = jnp.einsum("bqhd,bkhd->bhqk", q, k) * head_dim**-0.5
+        if mask is not None:
+            logits = logits + mask
+        weights = nn.softmax(logits.astype(jnp.float32), axis=-1).astype(self.dtype)
+        out = jnp.einsum("bhqk,bkhd->bqhd", weights, v).reshape(b, sq, self.dim)
+        return nn.Dense(self.dim, dtype=self.dtype, name="out")(out)
+
+
+class VisionEncoder(nn.Module):
+    config: BlipConfig
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, pixels):
+        """[B, H, W, 3] in [-1,1] -> [B, patches+1, D]."""
+        cfg = self.config
+        x = nn.Conv(
+            cfg.vision_hidden, (cfg.patch_size, cfg.patch_size),
+            strides=(cfg.patch_size, cfg.patch_size), dtype=self.dtype,
+            name="patch_embed",
+        )(pixels)
+        b, h, w, c = x.shape
+        x = x.reshape(b, h * w, c)
+        cls = self.param(
+            "cls_token", nn.initializers.normal(0.02), (1, 1, cfg.vision_hidden)
+        ).astype(self.dtype)
+        x = jnp.concatenate([jnp.broadcast_to(cls, (b, 1, c)), x], axis=1)
+        pos = self.param(
+            "pos_embed", nn.initializers.normal(0.02),
+            (1, x.shape[1], cfg.vision_hidden),
+        ).astype(self.dtype)
+        x = x + pos
+        for i in range(cfg.vision_layers):
+            y = nn.LayerNorm(dtype=self.dtype, name=f"ln1_{i}")(x)
+            x = x + _MHA(cfg.vision_heads, cfg.vision_hidden, dtype=self.dtype,
+                         name=f"attn_{i}")(y, y)
+            y = nn.LayerNorm(dtype=self.dtype, name=f"ln2_{i}")(x)
+            y = nn.Dense(cfg.vision_hidden * 4, dtype=self.dtype, name=f"fc1_{i}")(y)
+            y = nn.gelu(y, approximate=False)
+            x = x + nn.Dense(cfg.vision_hidden, dtype=self.dtype, name=f"fc2_{i}")(y)
+        return nn.LayerNorm(dtype=self.dtype, name="ln_post")(x)
+
+
+class TextDecoder(nn.Module):
+    config: BlipConfig
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, input_ids, image_embeds):
+        """[B, L] ids + [B, P, Dv] -> [B, L, vocab] logits (causal)."""
+        cfg = self.config
+        b, s = input_ids.shape
+        x = nn.Embed(
+            cfg.vocab_size, cfg.text_hidden, dtype=self.dtype, name="tok_embed"
+        )(input_ids)
+        pos = self.param(
+            "pos_embed", nn.initializers.normal(0.02),
+            (1, cfg.max_caption_len, cfg.text_hidden),
+        ).astype(self.dtype)
+        x = x + pos[:, :s]
+        causal = jnp.triu(jnp.full((s, s), -1e9, self.dtype), k=1)[None, None]
+        img = nn.Dense(cfg.text_hidden, dtype=self.dtype, name="vis_proj")(
+            image_embeds.astype(self.dtype)
+        )
+        for i in range(cfg.text_layers):
+            y = nn.LayerNorm(dtype=self.dtype, name=f"ln1_{i}")(x)
+            x = x + _MHA(cfg.text_heads, cfg.text_hidden, dtype=self.dtype,
+                         name=f"self_{i}")(y, y, causal)
+            y = nn.LayerNorm(dtype=self.dtype, name=f"lnx_{i}")(x)
+            x = x + _MHA(cfg.text_heads, cfg.text_hidden, dtype=self.dtype,
+                         name=f"cross_{i}")(y, img)
+            y = nn.LayerNorm(dtype=self.dtype, name=f"ln2_{i}")(x)
+            y = nn.Dense(cfg.text_hidden * 4, dtype=self.dtype, name=f"fc1_{i}")(y)
+            y = nn.gelu(y, approximate=False)
+            x = x + nn.Dense(cfg.text_hidden, dtype=self.dtype, name=f"fc2_{i}")(y)
+        x = nn.LayerNorm(dtype=self.dtype, name="ln_final")(x)
+        return nn.Dense(cfg.vocab_size, dtype=self.dtype, name="lm_head")(x)
+
+
+def greedy_decode(decoder_apply, params, image_embeds, config: BlipConfig,
+                  prefix_ids=None):
+    """Fixed-length greedy decode under jit; returns [B, max_len] int32 ids.
+
+    The buffer starts as [BOS, prefix..., EOS-pad]; each scan step writes
+    the argmax for the next position. EOS truncation happens host-side.
+    """
+    b = image_embeds.shape[0]
+    max_len = config.max_caption_len
+    ids = jnp.full((b, max_len), config.eos_token_id, jnp.int32)
+    ids = ids.at[:, 0].set(config.bos_token_id)
+    start = 1
+    if prefix_ids is not None:
+        plen = prefix_ids.shape[1]
+        ids = jax.lax.dynamic_update_slice(ids, prefix_ids.astype(jnp.int32), (0, 1))
+        start = 1 + plen
+
+    def body(ids, t):
+        logits = decoder_apply(params, ids, image_embeds)  # [B, L, V]
+        next_id = jnp.argmax(logits[:, t - 1, :], axis=-1).astype(jnp.int32)
+        write = t >= start  # keep BOS/prefix intact
+        current = jax.lax.dynamic_slice_in_dim(ids, t, 1, axis=1)[:, 0]
+        next_id = jnp.where(write, next_id, current)
+        ids = jax.lax.dynamic_update_slice_in_dim(
+            ids, next_id[:, None], t, axis=1
+        )
+        return ids, ()
+
+    ids, _ = jax.lax.scan(body, ids, jnp.arange(1, max_len))
+    return ids

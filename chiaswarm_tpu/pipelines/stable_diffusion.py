@@ -526,10 +526,14 @@ class SDPipeline:
             # diffusers txt2img-ControlNet convention: `image` IS the control
             control_image, image = image, None
 
+        if isinstance(image, (list, tuple)):
+            n_images = len(image)  # batch of distinct start images
         height = kwargs.pop("height", None)
         width = kwargs.pop("width", None)
         if height is None and image is not None:
-            width, height = image.size
+            width, height = (
+                image[0].size if isinstance(image, (list, tuple)) else image.size
+            )
         if height is None and control_image is not None:
             width, height = control_image.size
         height = int(height or self.default_size)
@@ -590,12 +594,20 @@ class SDPipeline:
         image_latents = jnp.zeros((1, 1, 1, latent_c), jnp.float32)
         mask = jnp.zeros((1, 1, 1, 1), jnp.float32)
         if image is not None:
-            pixels = jnp.asarray(_pil_to_array(image, width, height))[None]
+            # one start image broadcast over the batch, or a list of distinct
+            # images (e.g. vid2vid frames batched through one program)
+            if isinstance(image, (list, tuple)):
+                pixels = jnp.stack(
+                    [jnp.asarray(_pil_to_array(im, width, height)) for im in image]
+                )
+            else:
+                pixels = jnp.broadcast_to(
+                    jnp.asarray(_pil_to_array(image, width, height))[None],
+                    (n_images, height, width, 3),
+                )
             enc = self.vae.apply(
                 {"params": job_params["vae"]},
-                jnp.broadcast_to(pixels, (n_images, height, width, 3)).astype(
-                    self.dtype
-                ),
+                pixels.astype(self.dtype),
                 method=self.vae.encode,
             ).astype(jnp.float32)
             image_latents = enc

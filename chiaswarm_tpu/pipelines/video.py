@@ -1,21 +1,385 @@
-"""Video diffusion pipelines (reference swarm/video/*)."""
+"""Video diffusion pipelines: txt2vid, img2vid, vid2vid.
+
+Reference swarm/video/* rebuilt TPU-first:
+- txt2vid (tx2vid.py:15-81): motion-module UNet, whole clip denoised in ONE
+  jitted scan (frames ride the batch dim), VAE-decoded per frame, exported
+  mp4/webm/gif.
+- img2vid (img2vid.py:14-38): SVD-style — the conditioning frame's latents
+  concatenate onto every frame's channels (in_channels 8).
+- vid2vid (pix2pix.py:14-191): the reference edits frames one at a time in
+  a Python loop (up to 100 sequential pipeline calls, :47-68); here frames
+  batch through the image pipeline's jitted program in fixed-size chunks.
+"""
 
 from __future__ import annotations
 
+import logging
+import os
+import time
+import zlib
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from PIL import Image
+
+from ..models import configs as cfgs
+from ..models.clip import CLIPTextEncoder
+from ..models.tokenizer import load_tokenizer
+from ..models.unet2d import UNet2DConfig
+from ..models.vae import AutoencoderKL
+from ..models.video_unet import VideoUNet, VideoUNetConfig
+from ..post_processors.output_processor import make_result
+from ..registry import register_family
+from ..schedulers import get_scheduler
+from ..toolbox.video_helpers import (
+    download_video,
+    export_frames,
+    first_frame_thumbnail,
+    split_video_frames,
+)
+
+logger = logging.getLogger(__name__)
+
+DEFAULT_FPS = 8
+VID2VID_CHUNK = 8  # frames per batched img2img program call
+
+
+def _replace(cfg: UNet2DConfig, **kw) -> UNet2DConfig:
+    import dataclasses
+
+    return dataclasses.replace(cfg, **kw)
+
+
+def _video_configs(model_name: str):
+    name = model_name.lower()
+    if "tiny" in name or name.startswith("test/"):
+        return (
+            VideoUNetConfig(base=cfgs.TINY_UNET, num_frames=8),
+            cfgs.TINY_CLIP,
+            cfgs.TINY_VAE,
+            64,
+        )
+    # AnimateDiff / zeroscope / damo / SVD ride SD1.5-geometry UNets
+    return (
+        VideoUNetConfig(base=cfgs.SD15_UNET, num_frames=16),
+        cfgs.SD15_CLIP,
+        cfgs.SD_VAE,
+        512,
+    )
+
+
+class VideoPipeline:
+    """Resident motion-module pipeline; serves txt2vid and img2vid."""
+
+    def __init__(self, model_name: str, chipset=None, image_conditioned=False):
+        self.model_name = model_name
+        self.chipset = chipset
+        self.image_conditioned = image_conditioned
+        video_cfg, clip_cfg, vae_cfg, self.default_size = _video_configs(model_name)
+        if image_conditioned:
+            # SVD layout: noise latents + conditioning-frame latents stacked
+            video_cfg = VideoUNetConfig(
+                base=_replace(video_cfg.base, in_channels=8),
+                num_frames=video_cfg.num_frames,
+            )
+        self.config = video_cfg
+        self.latent_factor = 2 ** (len(vae_cfg.block_out_channels) - 1)
+
+        on_tpu = jax.default_backend() == "tpu"
+        self.dtype = jnp.bfloat16 if on_tpu else jnp.float32
+        self.unet = VideoUNet(video_cfg, dtype=self.dtype)
+        self.text_encoder = CLIPTextEncoder(clip_cfg, dtype=self.dtype)
+        self.vae = AutoencoderKL(vae_cfg, dtype=self.dtype)
+        self.tokenizer = load_tokenizer(None, vocab_size=clip_cfg.vocab_size)
+
+        t0 = time.perf_counter()
+        self.params = self._init_params()
+        logger.info(
+            "%s video pipeline resident in %.1fs", model_name,
+            time.perf_counter() - t0,
+        )
+        self._programs = {}
+
+    def _init_params(self):
+        rng = jax.random.key(zlib.crc32(self.model_name.encode()))
+        k1, k2, k3 = jax.random.split(rng, 3)
+        frames = self.config.num_frames
+        hw = 2 ** max(len(self.config.base.block_out_channels), 3)
+        with jax.default_device(jax.local_devices(backend="cpu")[0]):
+            unet_params = self.unet.init(
+                k1,
+                jnp.zeros((frames, hw, hw, self.config.base.in_channels)),
+                jnp.zeros((frames,)),
+                jnp.zeros((frames, 77, self.config.base.cross_attention_dim)),
+            )["params"]
+            text_params = self.text_encoder.init(
+                k2, jnp.zeros((1, 77), jnp.int32)
+            )["params"]
+            vae_params = self.vae.init(
+                k3,
+                jnp.zeros((1, hw * self.latent_factor, hw * self.latent_factor, 3)),
+            )["params"]
+        cast = lambda x: jnp.asarray(x, self.dtype)
+        return jax.tree_util.tree_map(
+            cast, {"unet": unet_params, "text": text_params, "vae": vae_params}
+        )
+
+    def release(self):
+        self.params = None
+        self._programs.clear()
+
+    def _program(self, key):
+        if key in self._programs:
+            return self._programs[key]
+        lh, lw, frames, steps, sched_name = key
+        scheduler = get_scheduler(sched_name)
+        schedule = scheduler.schedule(steps)
+
+        def run(params, latents, context, guidance_scale, cond_latents, rng):
+            """latents [F, lh, lw, 4]; context [2, 77, D] = (uncond, cond)."""
+            latents = latents * jnp.asarray(schedule.init_noise_sigma, latents.dtype)
+            state = scheduler.init_state(latents.shape, latents.dtype)
+            f = latents.shape[0]
+            ctx2 = jnp.concatenate(
+                [
+                    jnp.broadcast_to(context[:1], (f,) + context.shape[1:]),
+                    jnp.broadcast_to(context[1:2], (f,) + context.shape[1:]),
+                ],
+                axis=0,
+            ).astype(self.dtype)
+
+            def body(carry, i):
+                latents, state = carry
+                inp = scheduler.scale_model_input(schedule, latents, i)
+                if self.image_conditioned:
+                    inp = jnp.concatenate(
+                        [inp, cond_latents.astype(inp.dtype)], axis=-1
+                    )
+                model_in = jnp.concatenate([inp, inp], axis=0).astype(self.dtype)
+                t = jnp.broadcast_to(
+                    jnp.asarray(schedule.timesteps)[i], (model_in.shape[0],)
+                )
+                out = self.unet.apply(
+                    {"params": params["unet"]}, model_in, t, ctx2
+                ).astype(jnp.float32)
+                out_u, out_c = jnp.split(out, 2, axis=0)
+                out = out_u + guidance_scale * (out_c - out_u)
+                noise = jax.random.normal(
+                    jax.random.fold_in(rng, i), latents.shape, jnp.float32
+                )
+                state, latents = scheduler.step(schedule, state, i, latents, out, noise)
+                return (latents, state), ()
+
+            (latents, _), _ = jax.lax.scan(
+                body, (latents.astype(jnp.float32), state), jnp.arange(steps)
+            )
+            return self.vae.apply(
+                {"params": params["vae"]}, latents.astype(self.dtype),
+                method=self.vae.decode,
+            ).astype(jnp.float32)
+
+        program = jax.jit(run)
+        self._programs[key] = program
+        return program
+
+    def run(self, prompt="", negative_prompt="", image=None, **kwargs):
+        if self.params is None:
+            raise Exception(f"pipeline {self.model_name} was evicted; resubmit")
+        timings = {}
+        steps = int(kwargs.pop("num_inference_steps", 25))
+        guidance_scale = float(kwargs.pop("guidance_scale", 7.5))
+        frames = min(
+            int(kwargs.pop("num_frames", self.config.num_frames)),
+            self.config.num_frames,
+        )
+        fps = int(kwargs.pop("fps", DEFAULT_FPS))
+        scheduler_type = kwargs.pop(
+            "scheduler_type", "EulerAncestralDiscreteScheduler"
+        )
+        rng = kwargs.pop("rng", None)
+        if rng is None:
+            rng = jax.random.key(0)
+        height = int(kwargs.pop("height", None) or self.default_size)
+        width = int(kwargs.pop("width", None) or self.default_size)
+        height, width = (max(64, (d // 64) * 64) for d in (height, width))
+        lh, lw = height // self.latent_factor, width // self.latent_factor
+
+        ids = jnp.asarray(self.tokenizer([negative_prompt, prompt]))
+        context = self.text_encoder.apply(
+            {"params": self.params["text"]}, ids
+        )["hidden_states"]
+
+        rng, init_rng, step_rng = jax.random.split(rng, 3)
+        noise = jax.random.normal(init_rng, (frames, lh, lw, 4), jnp.float32)
+
+        cond_latents = jnp.zeros((1, 1, 1, 4), jnp.float32)
+        if self.image_conditioned:
+            if image is None:
+                raise ValueError("img2vid requires an input image. None provided")
+            arr = (
+                np.asarray(
+                    image.convert("RGB").resize((width, height), Image.LANCZOS),
+                    np.float32,
+                )
+                / 127.5
+                - 1.0
+            )
+            enc = self.vae.apply(
+                {"params": self.params["vae"]},
+                jnp.asarray(arr)[None].astype(self.dtype),
+                method=self.vae.encode,
+            ).astype(jnp.float32)
+            cond_latents = jnp.broadcast_to(enc, (frames, lh, lw, 4))
+
+        key = (lh, lw, frames, steps, scheduler_type)
+        t0 = time.perf_counter()
+        program = self._program(key)
+        pixels = jax.block_until_ready(
+            program(self.params, noise, context, jnp.float32(guidance_scale),
+                    cond_latents, step_rng)
+        )
+        timings["denoise_decode_s"] = round(time.perf_counter() - t0, 3)
+
+        arr = np.clip(np.asarray(pixels, np.float32) * 0.5 + 0.5, 0, 1)
+        pil_frames = [
+            Image.fromarray((f * 255).round().astype(np.uint8)) for f in arr
+        ]
+        config = {
+            "model": self.model_name,
+            "frames": frames,
+            "fps": fps,
+            "steps": steps,
+            "size": [width, height],
+            "scheduler": scheduler_type,
+            "timings": timings,
+        }
+        return pil_frames, config
+
+
+@register_family("animatediff")
+def _build_animatediff(model_name, chipset, **variant):
+    return VideoPipeline(model_name, chipset, image_conditioned=False)
+
+
+def _build_img2vid(model_name, chipset, **variant):
+    return VideoPipeline(model_name, chipset, image_conditioned=True)
+
+
+register_family("svd")(_build_img2vid)
+register_family("i2vgenxl")(_build_img2vid)
+
+
+def _frames_artifact(frames, fps, content_type):
+    buffer, actual_type = export_frames(frames, content_type, fps)
+    return make_result(buffer, first_frame_thumbnail(frames), actual_type)
+
 
 def run_txt2vid(device_identifier: str, model_name: str, **kwargs):
-    raise Exception(
-        f"txt2vid is not yet available on this worker (model {model_name})."
+    """txt2vid job -> video artifact (reference swarm/video/tx2vid.py:15-81)."""
+    from ..registry import get_pipeline
+
+    content_type = kwargs.pop("content_type", "video/mp4")
+    kwargs.pop("outputs", None)
+    if kwargs.pop("test_tiny_model", False):
+        model_name = "test/tiny-video"
+    # hive txt2vid jobs often say "DiffusionPipeline" (reference resolved it
+    # reflectively); the workflow itself pins the video family
+    from ..registry import PIPELINE_FAMILIES
+
+    ptype = kwargs.pop("pipeline_type", "AnimateDiffPipeline")
+    if PIPELINE_FAMILIES.get(ptype) != "animatediff":
+        ptype = "AnimateDiffPipeline"
+    pipeline = get_pipeline(
+        model_name,
+        pipeline_type=ptype,
+        chipset=kwargs.pop("chipset", None),
     )
+    kwargs.pop("lora", None)  # motion-LoRA conversion lands with real weights
+    kwargs.pop("upscale", None)
+    frames, config = pipeline.run(**kwargs)
+    return {"primary": _frames_artifact(frames, config["fps"], content_type)}, config
 
 
 def run_img2vid(device_identifier: str, model_name: str, **kwargs):
-    raise Exception(
-        f"img2vid is not yet available on this worker (model {model_name})."
+    """img2vid job (reference swarm/video/img2vid.py:14-38)."""
+    from ..registry import get_pipeline
+
+    content_type = kwargs.pop("content_type", "video/mp4")
+    kwargs.pop("outputs", None)
+    if kwargs.pop("test_tiny_model", False):
+        model_name = "test/tiny-video-svd"
+    pipeline = get_pipeline(
+        model_name,
+        pipeline_type=kwargs.pop("pipeline_type", "I2VGenXLPipeline"),
+        chipset=kwargs.pop("chipset", None),
     )
+    for drop in ("decode_chunk_size", "motion_bucket_id", "noise_aug_strength"):
+        kwargs.pop(drop, None)
+    frames, config = pipeline.run(**kwargs)
+    return {"primary": _frames_artifact(frames, config["fps"], content_type)}, config
 
 
 def run_vid2vid(device_identifier: str, model_name: str, **kwargs):
-    raise Exception(
-        f"vid2vid is not yet available on this worker (model {model_name})."
+    """vid2vid: chunked-batch frame editing (reference swarm/video/pix2pix.py).
+
+    The reference's hot loop — one full pipeline invocation per frame — runs
+    as batched img2img: VID2VID_CHUNK frames per jitted call, one compile.
+    """
+    from ..registry import get_pipeline
+
+    content_type = kwargs.pop("content_type", "video/mp4")
+    kwargs.pop("outputs", None)
+    video_uri = kwargs.pop("video_uri", None)
+    if video_uri is None:
+        raise ValueError("vid2vid requires a video_uri. None provided")
+    if kwargs.pop("test_tiny_model", False):
+        model_name = "test/tiny-sd"
+
+    path = download_video(video_uri)
+    try:
+        frames, fps = split_video_frames(path)
+    finally:
+        os.unlink(path)
+
+    pipeline = get_pipeline(
+        model_name,
+        pipeline_type=kwargs.pop(
+            "pipeline_type", "StableDiffusionInstructPix2PixPipeline"
+        ),
+        chipset=kwargs.pop("chipset", None),
     )
+    rng = kwargs.pop("rng", None)
+    if rng is None:
+        rng = jax.random.key(0)
+    prompt = kwargs.pop("prompt", "")
+    steps = int(kwargs.pop("num_inference_steps", 25))
+    strength = float(kwargs.pop("strength", 0.6))
+    kwargs.pop("image_guidance_scale", None)
+
+    # size-normalize all frames so every chunk hits the same program bucket
+    w, h = frames[0].size
+    frames = [f if f.size == (w, h) else f.resize((w, h)) for f in frames]
+
+    out_frames = []
+    t0 = time.perf_counter()
+    for start in range(0, len(frames), VID2VID_CHUNK):
+        chunk = frames[start : start + VID2VID_CHUNK]
+        pad = VID2VID_CHUNK - len(chunk)
+        images, _ = pipeline.run(
+            prompt=prompt,
+            image=chunk + [chunk[-1]] * pad,  # pad partial chunk, slice below
+            strength=strength,
+            num_inference_steps=steps,
+            rng=jax.random.fold_in(rng, start),
+        )
+        out_frames.extend(images[: len(chunk)])
+    config = {
+        "model": model_name,
+        "frames": len(frames),
+        "fps": fps,
+        # reference cost metric (swarm/video/pix2pix.py:79)
+        "compute_cost": 512 * 512 * steps * len(frames),
+        "timings": {"edit_s": round(time.perf_counter() - t0, 3)},
+    }
+    return {"primary": _frames_artifact(out_frames, int(fps), content_type)}, config

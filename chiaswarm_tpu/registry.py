@@ -52,6 +52,8 @@ PIPELINE_FAMILIES: dict[str, str] = {
     "FluxPipeline": "flux",
     "AudioLDMPipeline": "audioldm",
     "AnimateDiffPipeline": "animatediff",
+    "TextToVideoSDPipeline": "animatediff",
+    "VideoToVideoSDPipeline": "animatediff",
     "I2VGenXLPipeline": "i2vgenxl",
     "StableVideoDiffusionPipeline": "svd",
 }
@@ -138,7 +140,8 @@ def _ensure_builtin_families() -> None:
     if _BUILTINS_LOADED:
         return
     _BUILTINS_LOADED = True
-    try:
-        from .pipelines import stable_diffusion  # noqa: F401  registers sd/sdxl
-    except Exception as e:
-        logger.warning("stable-diffusion family unavailable: %s", e)
+    for module in ("stable_diffusion", "video", "audio"):
+        try:
+            __import__(f"{__package__}.pipelines.{module}")
+        except Exception as e:
+            logger.warning("pipeline family module %s unavailable: %s", module, e)

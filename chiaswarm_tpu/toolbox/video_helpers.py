@@ -1,0 +1,135 @@
+"""Video IO: PIL frames <-> mp4/webm/gif, download with caps.
+
+Reference parity: swarm/toolbox/video_helpers.py:53-111 (cv2 writers, gif
+via diffusers' util, first-frame thumbnail) and swarm/video/pix2pix.py:
+84-116,148-183 (30 MiB download cap, <=100 frame split). moviepy isn't in
+this image, so resizing happens via PIL before encode instead of a
+subprocess ffmpeg pass.
+"""
+
+from __future__ import annotations
+
+import io
+import os
+import tempfile
+
+import numpy as np
+from PIL import Image
+
+MAX_VIDEO_BYTES = 30 * 1024 * 1024  # reference swarm/video/pix2pix.py:95-98
+MAX_FRAMES = 100  # reference swarm/video/pix2pix.py:40
+
+
+def _cv2():
+    import cv2
+
+    return cv2
+
+
+def frames_to_video_buffer(frames: list[Image.Image], fps: int = 8,
+                           content_type: str = "video/mp4") -> io.BytesIO:
+    """Encode PIL frames into an mp4 (mp4v) or webm (VP90) buffer via cv2.
+
+    cv2 writers need a real file path; encode through a temp file.
+    """
+    cv2 = _cv2()
+    if content_type == "video/webm":
+        fourcc, suffix = cv2.VideoWriter_fourcc(*"VP90"), ".webm"
+    else:
+        fourcc, suffix = cv2.VideoWriter_fourcc(*"mp4v"), ".mp4"
+
+    w, h = frames[0].size
+    fd, path = tempfile.mkstemp(suffix=suffix)
+    os.close(fd)
+    try:
+        writer = cv2.VideoWriter(path, fourcc, fps, (w, h))
+        try:
+            for frame in frames:
+                arr = np.asarray(frame.convert("RGB"))
+                writer.write(cv2.cvtColor(arr, cv2.COLOR_RGB2BGR))
+        finally:
+            writer.release()
+        with open(path, "rb") as f:
+            return io.BytesIO(f.read())
+    finally:
+        os.unlink(path)
+
+
+def frames_to_gif_buffer(frames: list[Image.Image], fps: int = 8) -> io.BytesIO:
+    buffer = io.BytesIO()
+    frames[0].save(
+        buffer, format="GIF", save_all=True, append_images=frames[1:],
+        duration=max(1, int(1000 / fps)), loop=0,
+    )
+    buffer.seek(0)
+    return buffer
+
+
+def export_frames(frames: list[Image.Image], content_type: str, fps: int = 8):
+    """-> (video buffer, actual content_type). Falls back to GIF when cv2
+    can't encode the requested container."""
+    if content_type == "image/gif":
+        return frames_to_gif_buffer(frames, fps), content_type
+    try:
+        return frames_to_video_buffer(frames, fps, content_type), content_type
+    except Exception:
+        return frames_to_gif_buffer(frames, fps), "image/gif"
+
+
+def first_frame_thumbnail(frames: list[Image.Image]) -> io.BytesIO:
+    thumb = frames[0].convert("RGB").copy()
+    thumb.thumbnail((100, 100))
+    buffer = io.BytesIO()
+    thumb.save(buffer, format="JPEG")
+    buffer.seek(0)
+    return buffer
+
+
+def download_video(url: str, max_bytes: int = MAX_VIDEO_BYTES) -> str:
+    """Stream a remote video to a temp file, enforcing the size cap."""
+    import requests
+
+    response = requests.get(url, stream=True, timeout=30)
+    response.raise_for_status()
+    length = response.headers.get("content-length")
+    if length and int(length) > max_bytes:
+        raise ValueError(f"video exceeds the {max_bytes >> 20} MiB limit")
+
+    fd, path = tempfile.mkstemp(suffix=".mp4")
+    size = 0
+    with os.fdopen(fd, "wb") as f:
+        for chunk in response.iter_content(chunk_size=1 << 16):
+            size += len(chunk)
+            if size > max_bytes:
+                os.unlink(path)
+                raise ValueError(f"video exceeds the {max_bytes >> 20} MiB limit")
+            f.write(chunk)
+    return path
+
+
+def split_video_frames(path: str, max_frames: int = MAX_FRAMES,
+                       max_size: int = 512) -> tuple[list[Image.Image], float]:
+    """-> (<=max_frames PIL frames downscaled to <=max_size, source fps)."""
+    cv2 = _cv2()
+    capture = cv2.VideoCapture(path)
+    fps = capture.get(cv2.CAP_PROP_FPS) or 8.0
+    frames = []
+    try:
+        while len(frames) < max_frames:
+            ok, frame = capture.read()
+            if not ok:
+                break
+            img = Image.fromarray(cv2.cvtColor(frame, cv2.COLOR_BGR2RGB))
+            if max(img.size) > max_size:
+                scale = max_size / max(img.size)
+                img = img.resize(
+                    (max(64, int(img.width * scale) // 8 * 8),
+                     max(64, int(img.height * scale) // 8 * 8)),
+                    Image.LANCZOS,
+                )
+            frames.append(img)
+    finally:
+        capture.release()
+    if not frames:
+        raise ValueError(f"could not decode any frames from {path}")
+    return frames, float(fps)

@@ -1,0 +1,130 @@
+"""Video pipeline tests: temporal UNet, txt2vid/img2vid jobs, vid2vid batch,
+and the cv2/PIL export helpers."""
+
+import base64
+import os
+
+import numpy as np
+import pytest
+from PIL import Image
+
+import jax
+import jax.numpy as jnp
+
+from chiaswarm_tpu import registry
+from chiaswarm_tpu.models import configs as cfgs
+from chiaswarm_tpu.models.video_unet import TemporalTransformer, VideoUNet, VideoUNetConfig
+from chiaswarm_tpu.pipelines import video as video_pipelines
+from chiaswarm_tpu.toolbox import video_helpers
+
+
+@pytest.fixture(autouse=True)
+def clean_registry():
+    registry.clear_cache()
+    yield
+    registry.clear_cache()
+
+
+def test_temporal_transformer_zero_init_is_identity():
+    frames = 4
+    module = TemporalTransformer(32, frames)
+    x = jnp.asarray(np.random.default_rng(0).standard_normal((frames, 8, 8, 32)),
+                    jnp.float32)
+    params = module.init(jax.random.key(0), x)["params"]
+    out = module.apply({"params": params}, x)
+    np.testing.assert_array_equal(np.asarray(out), np.asarray(x))
+
+
+def test_video_unet_shapes():
+    cfg = VideoUNetConfig(base=cfgs.TINY_UNET, num_frames=4)
+    unet = VideoUNet(cfg)
+    x = jnp.zeros((4, 8, 8, 4))
+    ctx = jnp.zeros((4, 77, cfg.base.cross_attention_dim))
+    params = unet.init(jax.random.key(0), x, jnp.zeros((4,)), ctx)["params"]
+    out = unet.apply({"params": params}, x, jnp.zeros((4,)), ctx)
+    assert out.shape == (4, 8, 8, 4)
+
+
+def test_txt2vid_job_produces_video_artifact():
+    artifacts, config = video_pipelines.run_txt2vid(
+        "cpu", "damo-vilab/text-to-video-ms-1.7b",
+        prompt="a rocket", num_inference_steps=2, num_frames=4,
+        height=64, width=64, test_tiny_model=True,
+        pipeline_type="DiffusionPipeline",  # hive wire default gets coerced
+        rng=jax.random.key(0),
+    )
+    assert config["frames"] == 4
+    primary = artifacts["primary"]
+    assert primary["content_type"] in ("video/mp4", "image/gif")
+    assert len(base64.b64decode(primary["blob"])) > 100
+    assert primary["thumbnail"]
+
+
+def test_img2vid_job_conditions_on_image():
+    start = Image.fromarray(
+        (np.random.default_rng(1).random((64, 64, 3)) * 255).astype(np.uint8)
+    )
+    artifacts, config = video_pipelines.run_img2vid(
+        "cpu", "stabilityai/stable-video-diffusion-img2vid",
+        image=start, num_inference_steps=2, num_frames=4,
+        test_tiny_model=True, rng=jax.random.key(0),
+    )
+    assert artifacts["primary"]["blob"]
+    assert config["frames"] == 4
+
+    with pytest.raises(ValueError, match="requires an input image"):
+        video_pipelines.run_img2vid(
+            "cpu", "svd", test_tiny_model=True, num_inference_steps=2,
+            rng=jax.random.key(0),
+        )
+
+
+def test_export_roundtrip(tmp_path):
+    frames = [
+        Image.fromarray(
+            (np.random.default_rng(i).random((64, 64, 3)) * 255).astype(np.uint8)
+        )
+        for i in range(4)
+    ]
+    buffer, ctype = video_helpers.export_frames(frames, "video/mp4", fps=4)
+    assert buffer.getbuffer().nbytes > 0
+    if ctype == "video/mp4":  # cv2 encoded: split it back
+        path = tmp_path / "clip.mp4"
+        path.write_bytes(buffer.getvalue())
+        back, fps = video_helpers.split_video_frames(str(path))
+        assert len(back) == 4
+        assert back[0].size == (64, 64)
+
+    gif, _ = video_helpers.export_frames(frames, "image/gif", fps=4)
+    assert gif.getvalue()[:3] == b"GIF"
+
+
+def test_vid2vid_batches_frames(tmp_path, monkeypatch):
+    frames = [
+        Image.fromarray(np.full((64, 64, 3), i * 40, np.uint8)) for i in range(5)
+    ]
+    buffer, ctype = video_helpers.export_frames(frames, "video/mp4", fps=4)
+    if ctype != "video/mp4":
+        pytest.skip("cv2 mp4 encoder unavailable")
+    clip = tmp_path / "in.mp4"
+    clip.write_bytes(buffer.getvalue())
+
+    monkeypatch.setattr(
+        video_pipelines, "download_video", lambda uri, **kw: str(clip)
+    )
+    # download cleanup unlinks the path; keep the fixture file
+    real_unlink = os.unlink
+    monkeypatch.setattr(
+        video_pipelines.os, "unlink",
+        lambda p: None if p == str(clip) else real_unlink(p),
+    )
+
+    artifacts, config = video_pipelines.run_vid2vid(
+        "cpu", "timbrooks/instruct-pix2pix",
+        video_uri="http://example.org/in.mp4",
+        prompt="make it snow", num_inference_steps=2, strength=0.5,
+        test_tiny_model=True, rng=jax.random.key(0),
+    )
+    assert config["frames"] == 5
+    assert config["compute_cost"] == 512 * 512 * 2 * 5
+    assert artifacts["primary"]["blob"]
