@@ -46,27 +46,33 @@ class VideoUNetConfig:
 class TemporalTransformer(nn.Module):
     """Self-attention over the frame axis at fixed spatial positions.
 
-    Input [BF, H, W, C] with static frame count; mirrors AnimateDiff's
-    motion module (temporal transformer + sinusoidal frame positions).
+    Input [BF, H, W, C]; `num_frames` is the RUNTIME clip length (static at
+    trace time), passed per call because jobs may request fewer frames than
+    the configured maximum — deriving it from config would fold the CFG
+    uncond/cond halves into one clip.  Mirrors AnimateDiff's motion module
+    (temporal transformer + sinusoidal frame positions).
     """
 
     channels: int
-    num_frames: int
     num_heads: int = 8
     dtype: jnp.dtype = jnp.float32
 
     @nn.compact
-    def __call__(self, x):
+    def __call__(self, x, num_frames: int):
         bf, h, w, c = x.shape
-        b = bf // self.num_frames
+        if bf % num_frames:
+            raise ValueError(
+                f"batch*frames {bf} not divisible by num_frames {num_frames}"
+            )
+        b = bf // num_frames
         residual = x
         hidden = nn.GroupNorm(32, epsilon=1e-6, dtype=self.dtype, name="norm")(x)
         # [B, F, H, W, C] -> [B*H*W, F, C]
-        hidden = hidden.reshape(b, self.num_frames, h, w, c)
-        hidden = hidden.transpose(0, 2, 3, 1, 4).reshape(b * h * w, self.num_frames, c)
+        hidden = hidden.reshape(b, num_frames, h, w, c)
+        hidden = hidden.transpose(0, 2, 3, 1, 4).reshape(b * h * w, num_frames, c)
 
         pos = timestep_embedding(
-            jnp.arange(self.num_frames), c, flip_sin_to_cos=False, dtype=self.dtype
+            jnp.arange(num_frames), c, flip_sin_to_cos=False, dtype=self.dtype
         )
         hidden = hidden + pos[None]
 
@@ -78,7 +84,7 @@ class TemporalTransformer(nn.Module):
             nn.LayerNorm(epsilon=1e-5, dtype=self.dtype, name="norm_ff")(hidden)
         )
 
-        hidden = hidden.reshape(b, h, w, self.num_frames, c)
+        hidden = hidden.reshape(b, h, w, num_frames, c)
         hidden = hidden.transpose(0, 3, 1, 2, 4).reshape(bf, h, w, c)
         # zero-init output projection: an unconverted motion module is a
         # no-op on the spatial model (AnimateDiff init convention)
@@ -95,9 +101,16 @@ class VideoUNet(nn.Module):
     dtype: jnp.dtype = jnp.float32
 
     @nn.compact
-    def __call__(self, sample, timesteps, encoder_hidden_states):
+    def __call__(self, sample, timesteps, encoder_hidden_states, num_frames=None):
         cfg = self.config.base
-        frames = self.config.num_frames
+        # runtime clip length (static per compile); defaults to the config
+        # maximum for single-clip calls like param init
+        frames = int(num_frames) if num_frames is not None else self.config.num_frames
+        if frames > self.config.temporal_pos_max:
+            raise ValueError(
+                f"num_frames {frames} exceeds temporal_pos_max "
+                f"{self.config.temporal_pos_max}"
+            )
         if jnp.ndim(timesteps) == 0:
             timesteps = jnp.broadcast_to(timesteps, (sample.shape[0],))
 
@@ -131,9 +144,9 @@ class VideoUNet(nn.Module):
                         name=f"down_{bidx}_attentions_{i}",
                     )(x, encoder_hidden_states)
                 x = TemporalTransformer(
-                    out_ch, frames, dtype=self.dtype,
+                    out_ch, dtype=self.dtype,
                     name=f"down_{bidx}_motion_modules_{i}",
-                )(x)
+                )(x, frames)
                 skips.append(x)
             if not last:
                 x = Downsample2D(out_ch, dtype=self.dtype, name=f"down_{bidx}_downsample")(x)
@@ -146,8 +159,8 @@ class VideoUNet(nn.Module):
             dtype=self.dtype, name="mid_attentions_0",
         )(x, encoder_hidden_states)
         x = TemporalTransformer(
-            mid_ch, frames, dtype=self.dtype, name="mid_motion_modules_0"
-        )(x)
+            mid_ch, dtype=self.dtype, name="mid_motion_modules_0"
+        )(x, frames)
         x = ResnetBlock2D(mid_ch, dtype=self.dtype, name="mid_resnets_1")(x, temb)
 
         for bidx, out_ch in enumerate(reversed(cfg.block_out_channels)):
@@ -165,9 +178,9 @@ class VideoUNet(nn.Module):
                         name=f"up_{bidx}_attentions_{i}",
                     )(x, encoder_hidden_states)
                 x = TemporalTransformer(
-                    out_ch, frames, dtype=self.dtype,
+                    out_ch, dtype=self.dtype,
                     name=f"up_{bidx}_motion_modules_{i}",
-                )(x)
+                )(x, frames)
             if not last:
                 x = Upsample2D(out_ch, dtype=self.dtype, name=f"up_{bidx}_upsample")(x)
 

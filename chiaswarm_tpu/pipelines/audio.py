@@ -143,7 +143,9 @@ class AudioPipeline:
         return program
 
     def run(self, prompt="", negative_prompt="", **kwargs):
-        if self.params is None:
+        # snapshot once: a concurrent registry eviction nulls self.params
+        params = self.params
+        if params is None:
             raise Exception(f"pipeline {self.model_name} was evicted; resubmit")
         steps = int(kwargs.pop("num_inference_steps", 20))
         guidance_scale = float(kwargs.pop("guidance_scale", 2.5))
@@ -160,7 +162,7 @@ class AudioPipeline:
 
         ids = jnp.asarray(self.tokenizer([negative_prompt, prompt]))
         context = self.text_encoder.apply(
-            {"params": self.params["text"]}, ids
+            {"params": params["text"]}, ids
         )["hidden_states"].astype(self.dtype)
 
         rng, init_rng, step_rng = jax.random.split(rng, 3)
@@ -170,7 +172,7 @@ class AudioPipeline:
         t0 = time.perf_counter()
         program = self._program((lt, lf, steps, scheduler_type))
         mel = jax.block_until_ready(
-            program(self.params, noise, context, jnp.float32(guidance_scale),
+            program(params, noise, context, jnp.float32(guidance_scale),
                     step_rng)
         )
         denoise_s = round(time.perf_counter() - t0, 3)

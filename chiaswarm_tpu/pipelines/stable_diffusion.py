@@ -236,7 +236,7 @@ class SDPipeline:
         self._controlnets.clear()
         self._lora_cache.clear()
 
-    def _lora_params(self, lora: dict, scale: float) -> dict:
+    def _lora_params(self, base_params: dict, lora: dict, scale: float) -> dict:
         """Base params with a LoRA merged into the UNet, cached by (ref, scale).
 
         Reference fuses via diffusers per job (diffusion_func.py:113-126);
@@ -270,7 +270,7 @@ class SDPipeline:
                 f"Could not load lora {lora}. It might be incompatible with "
                 f"{self.model_name}: {'; '.join(errors)}"
             )
-        merged_unet, matched = merge_lora(self.params["unet"], state, scale)
+        merged_unet, matched = merge_lora(base_params["unet"], state, scale)
         if matched == 0:
             raise ValueError(
                 f"Could not load lora {lora}: no modules matched "
@@ -278,7 +278,7 @@ class SDPipeline:
             )
         logger.info("merged LoRA %s into %s (%d modules, scale %.2f)",
                     lora.get("lora"), self.model_name, matched, scale)
-        params = dict(self.params)
+        params = dict(base_params)
         params["unet"] = jax.device_put(merged_unet, replicated(self.mesh))
         self._lora_cache[key] = params
         while len(self._lora_cache) > MAX_RESIDENT_LORAS:
@@ -510,7 +510,9 @@ class SDPipeline:
         xattn_kwargs = kwargs.pop("cross_attention_kwargs", {}) or {}
         lora_scale = float(kwargs.pop("lora_scale", xattn_kwargs.get("scale", 1.0)))
         job_params = (
-            base_params if lora is None else self._lora_params(lora, lora_scale)
+            base_params
+            if lora is None
+            else self._lora_params(base_params, lora, lora_scale)
         )
 
         # --- ControlNet wire args (swarm/job_arguments.py:330-397 parity) ---
@@ -544,6 +546,10 @@ class SDPipeline:
         lh, lw = height // self.latent_factor, width // self.latent_factor
 
         if mask_image is not None:
+            if image is None:
+                # without an init image the placeholder zeros would decode as
+                # garbage in the unmasked region — job-level error instead
+                raise ValueError("inpaint requires an init image. None provided")
             mode = "inpaint"
         elif image is not None:
             mode = "img2img"
@@ -696,20 +702,34 @@ class SDPipeline:
                 chipset=self.chipset,
             )
             t0 = time.perf_counter()
-            refined = []
-            for img in images:
-                out, _ = refiner_pipe.run(
-                    prompt=prompt,
-                    negative_prompt=negative_prompt,
-                    image=img,
-                    strength=float(refiner.get("strength", 0.3)),
-                    num_inference_steps=steps,
-                    guidance_scale=guidance_scale,
-                    scheduler_type=scheduler_type,
-                    rng=rng,
+            refiner_kw = dict(
+                prompt=prompt,
+                negative_prompt=negative_prompt,
+                strength=float(refiner.get("strength", 0.3)),
+                num_inference_steps=steps,
+                guidance_scale=guidance_scale,
+                scheduler_type=scheduler_type,
+            )
+            # one batched refiner call: the whole base batch denoises as a
+            # single jitted program with per-image noise (no per-image Python
+            # loop, no shared rng trajectory across the batch)
+            try:
+                images, _ = refiner_pipe.run(
+                    image=list(images), rng=rng, **refiner_kw
                 )
-                refined.extend(out)
-            images = refined
+            except Exception as e:
+                if "RESOURCE_EXHAUSTED" not in str(e) and "emory" not in str(e):
+                    raise
+                # memory-tight slice: fall back to sequential batch-1 calls
+                # with per-image keys
+                logger.warning("batched refiner OOM; refining sequentially")
+                refined = []
+                for idx, img in enumerate(images):
+                    out, _ = refiner_pipe.run(
+                        image=img, rng=jax.random.fold_in(rng, idx), **refiner_kw
+                    )
+                    refined.extend(out)
+                images = refined
             timings["refiner_s"] = round(time.perf_counter() - t0, 3)
 
         pipeline_config = {

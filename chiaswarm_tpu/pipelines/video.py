@@ -161,7 +161,8 @@ class VideoPipeline:
                     jnp.asarray(schedule.timesteps)[i], (model_in.shape[0],)
                 )
                 out = self.unet.apply(
-                    {"params": params["unet"]}, model_in, t, ctx2
+                    {"params": params["unet"]}, model_in, t, ctx2,
+                    num_frames=f,
                 ).astype(jnp.float32)
                 out_u, out_c = jnp.split(out, 2, axis=0)
                 out = out_u + guidance_scale * (out_c - out_u)
@@ -184,7 +185,9 @@ class VideoPipeline:
         return program
 
     def run(self, prompt="", negative_prompt="", image=None, **kwargs):
-        if self.params is None:
+        # snapshot once: a concurrent registry eviction nulls self.params
+        params = self.params
+        if params is None:
             raise Exception(f"pipeline {self.model_name} was evicted; resubmit")
         timings = {}
         steps = int(kwargs.pop("num_inference_steps", 25))
@@ -207,7 +210,7 @@ class VideoPipeline:
 
         ids = jnp.asarray(self.tokenizer([negative_prompt, prompt]))
         context = self.text_encoder.apply(
-            {"params": self.params["text"]}, ids
+            {"params": params["text"]}, ids
         )["hidden_states"]
 
         rng, init_rng, step_rng = jax.random.split(rng, 3)
@@ -226,7 +229,7 @@ class VideoPipeline:
                 - 1.0
             )
             enc = self.vae.apply(
-                {"params": self.params["vae"]},
+                {"params": params["vae"]},
                 jnp.asarray(arr)[None].astype(self.dtype),
                 method=self.vae.encode,
             ).astype(jnp.float32)
@@ -236,7 +239,7 @@ class VideoPipeline:
         t0 = time.perf_counter()
         program = self._program(key)
         pixels = jax.block_until_ready(
-            program(self.params, noise, context, jnp.float32(guidance_scale),
+            program(params, noise, context, jnp.float32(guidance_scale),
                     cond_latents, step_rng)
         )
         timings["denoise_decode_s"] = round(time.perf_counter() - t0, 3)

@@ -100,6 +100,13 @@ def test_img2img(tiny_sd):
     assert images[0].size == (64, 64)
 
 
+def test_inpaint_without_init_image_is_job_error(tiny_sd):
+    mask = Image.fromarray(np.full((64, 64), 255, np.uint8))
+    with pytest.raises(ValueError, match="inpaint requires an init image"):
+        tiny_sd.run(prompt="fill", mask_image=mask, num_inference_steps=2,
+                    rng=jax.random.key(0))
+
+
 def test_inpaint_preserves_unmasked_region(tiny_sd):
     rng = np.random.default_rng(1)
     start = Image.fromarray((rng.random((64, 64, 3)) * 255).astype(np.uint8))

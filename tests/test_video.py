@@ -27,12 +27,35 @@ def clean_registry():
 
 def test_temporal_transformer_zero_init_is_identity():
     frames = 4
-    module = TemporalTransformer(32, frames)
+    module = TemporalTransformer(32)
     x = jnp.asarray(np.random.default_rng(0).standard_normal((frames, 8, 8, 32)),
                     jnp.float32)
-    params = module.init(jax.random.key(0), x)["params"]
-    out = module.apply({"params": params}, x)
+    params = module.init(jax.random.key(0), x, frames)["params"]
+    out = module.apply({"params": params}, x, frames)
     np.testing.assert_array_equal(np.asarray(out), np.asarray(x))
+
+
+def test_temporal_transformer_clips_stay_independent():
+    """Runtime frame count < configured max must not mix clips (the CFG
+    uncond/cond halves ride as separate clips in one batch)."""
+    frames = 4
+    rng = np.random.default_rng(0)
+    module = TemporalTransformer(32)
+    clip_a = jnp.asarray(rng.standard_normal((frames, 8, 8, 32)), jnp.float32)
+    clip_b = jnp.asarray(rng.standard_normal((frames, 8, 8, 32)), jnp.float32)
+    params = module.init(jax.random.key(0), clip_a, frames)["params"]
+    # non-zero proj_out so temporal attention actually flows
+    params = jax.tree_util.tree_map(
+        lambda x: jnp.asarray(rng.standard_normal(x.shape) * 0.05, jnp.float32),
+        params,
+    )
+    both = module.apply(
+        {"params": params}, jnp.concatenate([clip_a, clip_b], axis=0), frames
+    )
+    alone = module.apply({"params": params}, clip_a, frames)
+    np.testing.assert_allclose(
+        np.asarray(both[:frames]), np.asarray(alone), rtol=2e-4, atol=2e-5
+    )
 
 
 def test_video_unet_shapes():
@@ -43,6 +66,23 @@ def test_video_unet_shapes():
     params = unet.init(jax.random.key(0), x, jnp.zeros((4,)), ctx)["params"]
     out = unet.apply({"params": params}, x, jnp.zeros((4,)), ctx)
     assert out.shape == (4, 8, 8, 4)
+
+
+def test_video_unet_runtime_frames_below_config():
+    """An 8-frame config serving a 4-frame CFG-doubled batch reshapes by the
+    RUNTIME clip length, not the configured maximum."""
+    cfg = VideoUNetConfig(base=cfgs.TINY_UNET, num_frames=8)
+    unet = VideoUNet(cfg)
+    ctx8 = jnp.zeros((8, 77, cfg.base.cross_attention_dim))
+    params = unet.init(
+        jax.random.key(0), jnp.zeros((8, 8, 8, 4)), jnp.zeros((8,)), ctx8
+    )["params"]
+    # 2 clips x 4 frames (uncond|cond) with runtime num_frames=4
+    out = unet.apply(
+        {"params": params}, jnp.zeros((8, 8, 8, 4)), jnp.zeros((8,)), ctx8,
+        num_frames=4,
+    )
+    assert out.shape == (8, 8, 8, 4)
 
 
 def test_txt2vid_job_produces_video_artifact():
