@@ -127,7 +127,7 @@ def main() -> None:
     if result is None:
         raise SystemExit("all batch sizes failed")
 
-    images_per_sec, p50_job_s, batch = result
+    images_per_sec, p50_job_s, batch, extra = result
     per_chip = images_per_sec / len(chips)
     metric = (
         "sdxl_txt2img_1024_30step_images_per_sec_per_chip"
@@ -147,9 +147,31 @@ def main() -> None:
                 "backend": backend,
                 "steps": 30,
                 "size": 1024 if on_tpu else 64,
+                **extra,
             }
         )
     )
+
+
+# peak dense bf16 TFLOP/s per chip, by device kind prefix
+_PEAK_TFLOPS = {
+    "TPU v4": 275.0,
+    "TPU v5 lite": 197.0,
+    "TPU v5p": 459.0,
+    "TPU v5": 459.0,
+    "TPU v6 lite": 918.0,
+}
+
+
+def peak_tflops(device) -> float | None:
+    override = os.environ.get("BENCH_PEAK_TFLOPS")
+    if override:
+        return float(override)
+    kind = getattr(device, "device_kind", "")
+    for prefix, tf in _PEAK_TFLOPS.items():
+        if kind.startswith(prefix):
+            return tf
+    return None
 
 
 def run_config(pipe, size: int, steps: int, batch: int):
@@ -171,20 +193,35 @@ def run_config(pipe, size: int, steps: int, batch: int):
     warmup_s = time.perf_counter() - t0
     sys.stderr.write(f"warmup (incl. compile): {warmup_s:.1f}s\n")
 
-    job_times = []
+    job_times, denoise_times = [], []
     runs = 3
+    config = {}
     for i in range(runs):
         t0 = time.perf_counter()
         _, config = pipe.run(rng=jax.random.key(i + 1), **kw)
         job_times.append(time.perf_counter() - t0)
+        denoise_times.append(config["timings"]["denoise_decode_s"])
         sys.stderr.write(
             f"run {i}: {job_times[-1]:.2f}s job, "
-            f"{config['timings']['denoise_decode_s']:.2f}s denoise+decode\n"
+            f"{denoise_times[-1]:.2f}s denoise+decode\n"
         )
 
-    job_times.sort()
-    p50 = job_times[len(job_times) // 2]
-    return batch / p50, p50, batch
+    order = sorted(range(runs), key=lambda i: job_times[i])
+    mid = order[runs // 2]
+    p50 = job_times[mid]
+    extra = {"denoise_fraction": round(denoise_times[mid] / p50, 3)}
+    peak = peak_tflops(jax.devices()[0])
+    if peak and config.get("unet_tflops"):
+        # MFU over the denoise+decode program (UNet FLOPs only — VAE and
+        # ControlNet are excluded, so this is a conservative floor). The
+        # batch shards over the mesh, so peak scales with chip count.
+        extra["unet_mfu"] = round(
+            config["unet_tflops"]
+            / denoise_times[mid]
+            / (peak * len(jax.devices())),
+            4,
+        )
+    return batch / p50, p50, batch, extra
 
 
 if __name__ == "__main__":
