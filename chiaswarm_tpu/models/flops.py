@@ -91,6 +91,7 @@ def unet_call_flops(cfg: UNet2DConfig, lh: int, lw: int, batch: int,
 
 
 def denoise_flops(cfg: UNet2DConfig, lh: int, lw: int, n_images: int,
-                  steps: int, ctx_len: int = 77) -> float:
-    """FLOPs of a full CFG denoise loop (batch doubled to 2N per step)."""
-    return unet_call_flops(cfg, lh, lw, 2 * n_images, ctx_len) * steps
+                  steps: int, ctx_len: int = 77, cfg_rows: int = 2) -> float:
+    """FLOPs of a full CFG denoise loop (batch is cfg_rows*N per step;
+    2 for standard CFG, 3 for instruct-pix2pix dual guidance)."""
+    return unet_call_flops(cfg, lh, lw, cfg_rows * n_images, ctx_len) * steps

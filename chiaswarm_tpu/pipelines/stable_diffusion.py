@@ -52,28 +52,46 @@ MAX_RESIDENT_LORAS = 4
 
 def _family_configs(model_name: str):
     """(unet_cfg, [clip_cfgs], vae_cfg, default_size, prediction_type)."""
+    import dataclasses
+
     name = model_name.lower()
     if "tiny" in name:
         if "xl" in name:
-            return (
+            out = (
                 cfgs.TINY_XL_UNET,
                 [cfgs.TINY_CLIP, cfgs.TINY_CLIP_2],
                 cfgs.TINY_VAE,
                 64,
                 "epsilon",
             )
-        return cfgs.TINY_UNET, [cfgs.TINY_CLIP], cfgs.TINY_VAE, 64, "epsilon"
-    family = cfgs.model_family(model_name)
-    if family == "sdxl":
-        return cfgs.SDXL_UNET, [cfgs.SDXL_CLIP_1, cfgs.SDXL_CLIP_2], cfgs.SDXL_VAE, 1024, "epsilon"
-    if family == "sdxl_refiner":
-        return cfgs.SDXL_REFINER_UNET, [cfgs.SDXL_CLIP_2], cfgs.SDXL_VAE, 1024, "epsilon"
-    if family == "sd21":
-        # SD2.1-768 is v-prediction; the 512 base is epsilon. The hive sends
-        # full model names, so key off the canonical 768 checkpoint name.
-        pred = "v_prediction" if "768" in name or name.endswith("2-1") else "epsilon"
-        return cfgs.SD21_UNET, [cfgs.SD21_CLIP], cfgs.SD_VAE, 768, pred
-    return cfgs.SD15_UNET, [cfgs.SD15_CLIP], cfgs.SD_VAE, 512, "epsilon"
+        else:
+            out = (cfgs.TINY_UNET, [cfgs.TINY_CLIP], cfgs.TINY_VAE, 64, "epsilon")
+    else:
+        family = cfgs.model_family(model_name)
+        if family == "sdxl":
+            out = (cfgs.SDXL_UNET, [cfgs.SDXL_CLIP_1, cfgs.SDXL_CLIP_2],
+                   cfgs.SDXL_VAE, 1024, "epsilon")
+        elif family == "sdxl_refiner":
+            out = (cfgs.SDXL_REFINER_UNET, [cfgs.SDXL_CLIP_2], cfgs.SDXL_VAE,
+                   1024, "epsilon")
+        elif family == "sd21":
+            # SD2.1-768 is v-prediction; the 512 base is epsilon. The hive
+            # sends full model names, so key off the canonical 768 name.
+            pred = (
+                "v_prediction" if "768" in name or name.endswith("2-1") else "epsilon"
+            )
+            out = (cfgs.SD21_UNET, [cfgs.SD21_CLIP], cfgs.SD_VAE, 768, pred)
+        else:
+            out = (cfgs.SD15_UNET, [cfgs.SD15_CLIP], cfgs.SD_VAE, 512, "epsilon")
+    unet_cfg, clip_cfgs, vae_cfg, size, pred = out
+    if "pix2pix" in name or "ip2p" in name:
+        # edit-tuned checkpoints (timbrooks/instruct-pix2pix and the SDXL
+        # variant, reference swarm/job_arguments.py:299-305) take the start-
+        # image latents on the channel dim: 8-channel UNet input
+        unet_cfg = dataclasses.replace(
+            unet_cfg, in_channels=2 * vae_cfg.latent_channels
+        )
+    return unet_cfg, clip_cfgs, vae_cfg, size, pred
 
 
 def _pil_to_array(image: Image.Image, width: int, height: int) -> np.ndarray:
@@ -122,6 +140,10 @@ class SDPipeline:
 
         # VAE spatial reduction: one 2x downsample per block transition
         self.latent_factor = 2 ** (len(vae_cfg.block_out_channels) - 1)
+        self.latent_channels = vae_cfg.latent_channels
+        # edit-tuned (instruct-pix2pix) checkpoints concat start-image latents
+        # on the channel dim; detect by architecture, not by name
+        self.is_pix2pix = unet_cfg.in_channels == 2 * vae_cfg.latent_channels
         self.mesh = (
             chipset.mesh() if chipset is not None else make_mesh(jax.devices()[:1])
         )
@@ -387,16 +409,18 @@ class SDPipeline:
 
         unet_apply = self.unet.apply
         vae = self.vae
-        latent_c = self.unet.config.in_channels
+        latent_c = self.latent_channels
+        # pix2pix runs a 3-way CFG: rows [uncond | image-only | image+text]
+        cfg_rows = 3 if mode == "pix2pix" else 2
         # chunked single-chip decode bounds peak decoder activations on big
         # canvases (batch 4 x 1024^2 OOM'd a v5e chip in round 1); on a
         # multi-chip mesh the batch is sharded so the full decode stays
         decode_area = lh * lw * (4 if upscale else 1)
         big_decode = decode_area >= 9216 and batch >= 2 and self.data_parts == 1
 
-        def run(params, init_rng, context, added, guidance_scale, image_latents,
-                mask, rng, cn_params, control_cond, cn_scale):
-            """context [2B,77,D] (uncond|cond); noise drawn in-program."""
+        def run(params, init_rng, context, added, guidance_scale, image_guidance,
+                image_latents, mask, rng, cn_params, control_cond, cn_scale):
+            """context [cfg_rows*B,77,D] (uncond first); noise drawn in-program."""
             latents = jax.random.normal(
                 init_rng, (batch, lh, lw, latent_c), jnp.float32
             )
@@ -408,11 +432,20 @@ class SDPipeline:
                 clean = image_latents
                 latents = scheduler.add_noise(schedule, clean, latents, t_start)
             else:
+                # txt2img and pix2pix both denoise from pure noise; pix2pix's
+                # image conditioning rides the UNet's channel dim instead
                 latents = latents * jnp.asarray(
                     schedule.init_noise_sigma, latents.dtype
                 )
 
             state = scheduler.init_state(latents.shape, latents.dtype)
+            if mode == "pix2pix":
+                # per-row channel conditioning: zeros for the uncond row so
+                # image guidance has a true no-image baseline
+                cond_rows = jnp.concatenate(
+                    [jnp.zeros_like(image_latents), image_latents, image_latents],
+                    axis=0,
+                ).astype(self.dtype)
             if cn_key is not None:
                 control2 = jnp.concatenate([control_cond, control_cond], axis=0).astype(
                     self.dtype
@@ -422,7 +455,13 @@ class SDPipeline:
             def body(carry, i):
                 latents, state = carry
                 inp = scheduler.scale_model_input(schedule, latents, i)
-                model_in = jnp.concatenate([inp, inp], axis=0).astype(self.dtype)
+                model_in = jnp.concatenate([inp] * cfg_rows, axis=0).astype(
+                    self.dtype
+                )
+                if mode == "pix2pix":
+                    # image latents join unscaled: the edit checkpoint was
+                    # trained on raw latent-dist modes
+                    model_in = jnp.concatenate([model_in, cond_rows], axis=-1)
                 t = jnp.asarray(schedule.timesteps)[i]
                 t_vec = jnp.broadcast_to(t, (model_in.shape[0],))
                 residual_kw = {}
@@ -453,8 +492,19 @@ class SDPipeline:
                     added_cond=added,
                     **residual_kw,
                 ).astype(jnp.float32)
-                out_u, out_c = jnp.split(out, 2, axis=0)
-                out = out_u + guidance_scale * (out_c - out_u)
+                if mode == "pix2pix":
+                    # dual guidance (InstructPix2Pix eq. 3): text guidance
+                    # pulls away from image-only, image guidance away from
+                    # the fully-unconditional row
+                    out_u, out_i, out_c = jnp.split(out, 3, axis=0)
+                    out = (
+                        out_u
+                        + guidance_scale * (out_c - out_i)
+                        + image_guidance * (out_i - out_u)
+                    )
+                else:
+                    out_u, out_c = jnp.split(out, 2, axis=0)
+                    out = out_u + guidance_scale * (out_c - out_u)
 
                 noise = jax.random.normal(
                     jax.random.fold_in(rng, i), latents.shape, jnp.float32
@@ -538,6 +588,7 @@ class SDPipeline:
         image = kwargs.pop("image", None)
         mask_image = kwargs.pop("mask_image", None)
         strength = float(kwargs.pop("strength", 0.75))
+        image_guidance = kwargs.pop("image_guidance_scale", None)
 
         # chained stages (reference pipeline_steps.py:40-105 semantics)
         refiner = kwargs.pop("refiner", None)
@@ -590,6 +641,14 @@ class SDPipeline:
                 # garbage in the unmasked region — job-level error instead
                 raise ValueError("inpaint requires an init image. None provided")
             mode = "inpaint"
+        elif image is not None and self.is_pix2pix:
+            mode = "pix2pix"
+            if controlnet_name:
+                raise ValueError(
+                    "ControlNet is not supported with instruct-pix2pix models"
+                )
+            if image_guidance is None:
+                image_guidance = 1.5  # edit-checkpoint default
         elif image is not None:
             mode = "img2img"
         else:
@@ -599,12 +658,16 @@ class SDPipeline:
         if mode in ("img2img", "inpaint"):
             t_start = min(max(int(steps * (1.0 - strength)), 0), steps - 1)
 
-        # --- conditioning: one batched pass, rows [uncond*N | cond*N] ---
+        # --- conditioning: one batched pass, rows [uncond*N | cond*N];
+        # pix2pix duplicates the uncond rows for its image-only CFG row ---
         t0 = time.perf_counter()
+        cfg_rows = 3 if mode == "pix2pix" else 2
         texts = [negative_prompt] * n_images + [prompt] * n_images
         context, pooled = self.encode_prompts(texts, job_params)
         pooled_u = pooled[:n_images] if pooled is not None else None
         pooled_c = pooled[n_images:] if pooled is not None else None
+        if cfg_rows == 3:
+            context = jnp.concatenate([context[:n_images], context], axis=0)
 
         added = None
         if self.is_xl:
@@ -619,16 +682,17 @@ class SDPipeline:
                 ids = [height, width, 0, 0, float(kwargs.pop("aesthetic_score", 6.0))]
             else:
                 ids = [height, width, 0, 0, height, width][:n_ids]
-            time_ids = jnp.asarray([ids] * (2 * n_images), jnp.float32)
+            time_ids = jnp.asarray([ids] * (cfg_rows * n_images), jnp.float32)
+            pooled_rows = [pooled_u] * (cfg_rows - 1) + [pooled_c]
             added = {
-                "text_embeds": jnp.concatenate([pooled_u, pooled_c], axis=0),
+                "text_embeds": jnp.concatenate(pooled_rows, axis=0),
                 "time_ids": time_ids,
             }
         timings["text_encode_s"] = round(time.perf_counter() - t0, 3)
 
         # --- latents (initial noise is drawn inside the jitted program) ---
         rng, init_rng, step_rng = jax.random.split(rng, 3)
-        latent_c = self.unet.config.in_channels
+        latent_c = self.latent_channels
 
         # rank-preserving (1,1,1,C) placeholders when a mode doesn't use an
         # input — no dead full-res buffers riding along (program cache is
@@ -650,6 +714,10 @@ class SDPipeline:
             image_latents = self._vae_encode_program(
                 job_params["vae"], pixels.astype(self.dtype)
             )
+            if mode == "pix2pix":
+                # the edit checkpoint conditions on raw latent-dist modes —
+                # undo the sampling scale our encode applies
+                image_latents = image_latents / self.vae.config.scaling_factor
         if mask_image is not None:
             m = jnp.asarray(
                 _mask_to_latent_array(mask_image, width, height, self.latent_factor)
@@ -712,6 +780,7 @@ class SDPipeline:
             context,
             added,
             jnp.float32(guidance_scale),
+            jnp.float32(image_guidance or 0.0),
             image_latents,
             mask,
             step_rng,
@@ -776,9 +845,22 @@ class SDPipeline:
             "steps": steps,
             "size": [width, height],
             "guidance_scale": guidance_scale,
+            **(
+                {"image_guidance_scale": image_guidance}
+                if mode == "pix2pix"
+                else {}
+            ),
+            # a pix2pix job routed to a non-edit checkpoint degrades to plain
+            # img2img — record the approximation so callers can tell
+            **(
+                {"approximated_as": "img2img"}
+                if image_guidance is not None and mode == "img2img"
+                else {}
+            ),
             # analytic UNet FLOPs of the denoise loop -> MFU in the bench
             "unet_tflops": round(
-                denoise_flops(self.unet.config, lh, lw, n_images, steps - t_start)
+                denoise_flops(self.unet.config, lh, lw, n_images, steps - t_start,
+                              cfg_rows=cfg_rows)
                 / 1e12,
                 4,
             ),

@@ -358,29 +358,38 @@ def run_vid2vid(device_identifier: str, model_name: str, **kwargs):
     prompt = kwargs.pop("prompt", "")
     steps = int(kwargs.pop("num_inference_steps", 25))
     strength = float(kwargs.pop("strength", 0.6))
-    kwargs.pop("image_guidance_scale", None)
+    # edit-tuned checkpoints consume dual-guidance strength; non-pix2pix
+    # models ignore it and fall back to strength-based img2img (recorded as
+    # approximated_as in the per-chunk config)
+    image_guidance = kwargs.pop("image_guidance_scale", None)
 
     # size-normalize all frames so every chunk hits the same program bucket
     w, h = frames[0].size
     frames = [f if f.size == (w, h) else f.resize((w, h)) for f in frames]
 
     out_frames = []
+    edit_mode = None
     t0 = time.perf_counter()
     for start in range(0, len(frames), VID2VID_CHUNK):
         chunk = frames[start : start + VID2VID_CHUNK]
         pad = VID2VID_CHUNK - len(chunk)
-        images, _ = pipeline.run(
+        run_kw = dict(
             prompt=prompt,
             image=chunk + [chunk[-1]] * pad,  # pad partial chunk, slice below
             strength=strength,
             num_inference_steps=steps,
             rng=jax.random.fold_in(rng, start),
         )
+        if image_guidance is not None:
+            run_kw["image_guidance_scale"] = image_guidance
+        images, chunk_cfg = pipeline.run(**run_kw)
+        edit_mode = chunk_cfg.get("approximated_as", chunk_cfg.get("mode"))
         out_frames.extend(images[: len(chunk)])
     config = {
         "model": model_name,
         "frames": len(frames),
         "fps": fps,
+        "mode": edit_mode,
         # reference cost metric (swarm/video/pix2pix.py:79)
         "compute_cost": 512 * 512 * steps * len(frames),
         "timings": {"edit_s": round(time.perf_counter() - t0, 3)},

@@ -22,9 +22,12 @@ def diffusion_callback(device_identifier: str, model_name: str, **kwargs):
         # random-weight stand-in of the requested architecture family
         from ..models.configs import model_family
 
-        model_name = (
-            "test/tiny-xl" if "xl" in model_family(model_name) else "test/tiny-sd"
-        )
+        if "pix2pix" in model_name.lower() or "ip2p" in model_name.lower():
+            model_name = "test/tiny-pix2pix"  # keep the 8-channel edit arch
+        elif "xl" in model_family(model_name):
+            model_name = "test/tiny-xl"
+        else:
+            model_name = "test/tiny-sd"
 
     pipeline_type = kwargs.pop("pipeline_type", "DiffusionPipeline")
     pipeline = get_pipeline(

@@ -1,0 +1,88 @@
+"""Instruct-pix2pix semantics on the tiny edit config (hermetic, CPU).
+
+Reference parity target: swarm/job_arguments.py:299-305 maps vid2vid
+strength onto image_guidance_scale for edit-tuned checkpoints; diffusers'
+StableDiffusionInstructPix2PixPipeline runs an 8-channel UNet with 3-way
+CFG. Round-1 review (VERDICT weak #5) found those jobs silently served as
+plain img2img — these tests pin the real semantics.
+"""
+
+import numpy as np
+import pytest
+from PIL import Image
+
+import jax
+
+from chiaswarm_tpu.pipelines.stable_diffusion import SDPipeline
+
+
+@pytest.fixture(scope="module")
+def tiny_p2p():
+    return SDPipeline("test/tiny-pix2pix")
+
+
+def _start_image(seed=0, size=64):
+    rng = np.random.default_rng(seed)
+    return Image.fromarray((rng.random((size, size, 3)) * 255).astype(np.uint8))
+
+
+def test_pix2pix_arch_detected(tiny_p2p):
+    # edit checkpoints concat start-image latents on the channel dim
+    assert tiny_p2p.is_pix2pix
+    assert tiny_p2p.unet.config.in_channels == 2 * tiny_p2p.latent_channels
+
+
+def test_pix2pix_runs_and_reports_mode(tiny_p2p):
+    images, config = tiny_p2p.run(
+        prompt="make it snow",
+        image=_start_image(),
+        num_inference_steps=3,
+        rng=jax.random.key(0),
+    )
+    assert config["mode"] == "pix2pix"
+    assert config["image_guidance_scale"] == 1.5  # default when unset
+    assert images[0].size == (64, 64)
+
+
+def test_image_guidance_changes_output(tiny_p2p):
+    kw = dict(
+        prompt="edit", image=_start_image(1), num_inference_steps=3,
+        rng=jax.random.key(4),
+    )
+    a = np.asarray(tiny_p2p.run(image_guidance_scale=1.0, **kw)[0][0])
+    b = np.asarray(tiny_p2p.run(image_guidance_scale=2.5, **kw)[0][0])
+    assert not np.array_equal(a, b)
+
+
+def test_start_image_changes_output(tiny_p2p):
+    # the conditioning rides the channel concat, not the init latents — two
+    # different start images must give different edits under the same seed
+    kw = dict(prompt="edit", num_inference_steps=3, rng=jax.random.key(5))
+    a = np.asarray(tiny_p2p.run(image=_start_image(2), **kw)[0][0])
+    b = np.asarray(tiny_p2p.run(image=_start_image(3), **kw)[0][0])
+    assert not np.array_equal(a, b)
+
+
+def test_plain_model_records_img2img_approximation():
+    pipe = SDPipeline("test/tiny-sd")
+    _, config = pipe.run(
+        prompt="edit",
+        image=_start_image(),
+        image_guidance_scale=1.8,
+        num_inference_steps=2,
+        rng=jax.random.key(0),
+    )
+    assert config["mode"] == "img2img"
+    assert config["approximated_as"] == "img2img"
+
+
+def test_controlnet_rejected_with_pix2pix(tiny_p2p):
+    with pytest.raises(ValueError, match="not supported with instruct-pix2pix"):
+        tiny_p2p.run(
+            prompt="edit",
+            image=_start_image(),
+            control_image=_start_image(1),
+            controlnet_model_name="test/tiny-cn",
+            num_inference_steps=2,
+            rng=jax.random.key(0),
+        )
