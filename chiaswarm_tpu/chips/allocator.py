@@ -26,7 +26,8 @@ from .device import ChipSet
 
 
 class SliceAllocator:
-    def __init__(self, devices: list | None = None, chips_per_job: int = 0):
+    def __init__(self, devices: list | None = None, chips_per_job: int = 0,
+                 tensor_parallelism: int = 1):
         if devices is None:
             devices = jax.devices()
         if not devices:
@@ -39,7 +40,8 @@ class SliceAllocator:
             )
 
         self.slices = [
-            ChipSet(devices[i : i + n], slice_id=i // n)
+            ChipSet(devices[i : i + n], slice_id=i // n,
+                    tensor=tensor_parallelism)
             for i in range(0, len(devices), n)
         ]
         self._free: asyncio.Queue[ChipSet] = asyncio.Queue()

@@ -16,7 +16,6 @@ import threading
 import time
 
 import jax
-import numpy as np
 from jax.sharding import Mesh
 
 logger = logging.getLogger(__name__)
@@ -49,16 +48,28 @@ def hbm_gb_of(device) -> int:
 class ChipSet:
     """A fixed subset of local accelerator chips, meshed for one job at a time.
 
-    The mesh has a single ``data`` axis over the slice's chips; pipelines
-    shard the image batch (and CFG pair) over it and may reshape it into
-    finer axes (tp/sp) internally via `parallel.mesh.reshape_mesh`.
+    The mesh is [data, tensor, seq] (scaling-book axis convention): pipelines
+    shard the image batch (and CFG pair) over ``data``, Megatron-style
+    attention/MLP kernels over ``tensor`` (parallel/tensor.py partition
+    rules), and ring-attention sequence blocks over ``seq``. Degrees default
+    to 1, so a plain ChipSet behaves exactly like the round-1 data-only mesh.
     """
 
-    def __init__(self, devices: list, slice_id: int = 0):
+    def __init__(self, devices: list, slice_id: int = 0, tensor: int = 1,
+                 seq: int = 1):
         if not devices:
             raise ValueError("ChipSet requires at least one device")
+        if tensor < 1 or seq < 1:
+            raise ValueError(f"parallel degrees must be >= 1, got {tensor=} {seq=}")
+        if len(devices) % (tensor * seq) != 0:
+            raise ValueError(
+                f"tensor*seq={tensor * seq} does not divide "
+                f"slice size {len(devices)}"
+            )
         self.devices = list(devices)
         self.slice_id = slice_id
+        self.tensor = tensor
+        self.seq = seq
         self._mutex = threading.Lock()
 
     # --- identity / capability (reference swarm/gpu/device.py:17-27) ---
@@ -100,8 +111,10 @@ class ChipSet:
 
     # --- execution ---
 
-    def mesh(self, axis_name: str = "data") -> Mesh:
-        return Mesh(np.asarray(self.devices), (axis_name,))
+    def mesh(self) -> Mesh:
+        from ..parallel.mesh import make_mesh
+
+        return make_mesh(self.devices, tensor=self.tensor, seq=self.seq)
 
     def __call__(self, func, **kwargs):
         """Run one job on this slice under the busy lock.

@@ -37,7 +37,10 @@ _UNET_RULES: tuple[tuple[str, P], ...] = (
     (r".*(to_out_0|out_proj)/kernel$", row_parallel()),
     (r".*net_0_proj/kernel$", column_parallel()),  # geglu in (gate+value)
     (r".*net_2/kernel$", row_parallel()),  # ffn out
-    (r".*(to_out_0|out_proj)/bias$", P()),  # bias added after psum: replicate
+    (r".*fc1/kernel$", column_parallel()),  # CLIP MLP in
+    (r".*fc2/kernel$", row_parallel()),  # CLIP MLP out
+    # biases (incl. row-parallel layers') fall through to the replicated
+    # default in _spec_for — added once after the psum
 )
 
 

@@ -148,6 +148,7 @@ class SDPipeline:
             chipset.mesh() if chipset is not None else make_mesh(jax.devices()[:1])
         )
         self.data_parts = self.mesh.shape.get("data", 1)
+        self.tensor_parts = self.mesh.shape.get("tensor", 1)
 
         t0 = time.perf_counter()
         self.params = self._load_params()
@@ -250,9 +251,28 @@ class SDPipeline:
         return self._place(params)
 
     def _place(self, params):
+        """Cast to the serving dtype and place on the mesh.
+
+        Data-only mesh: everything replicated (the batch shards instead).
+        Tensor-parallel mesh: UNet / text-encoder / ControlNet kernels shard
+        Megatron-style per parallel/tensor.py partition rules — XLA inserts
+        the psums where row-parallel matmuls contract. The VAE stays
+        replicated; its decode shards over `data` via the batch sharding.
+        """
         cast = lambda x: jnp.asarray(x, self.dtype)
         params = jax.tree_util.tree_map(cast, params)
-        return jax.device_put(params, replicated(self.mesh))
+        if self.tensor_parts <= 1:
+            return jax.device_put(params, replicated(self.mesh))
+        from ..parallel.tensor import shard_params
+
+        def place_component(name, tree):
+            if name == "vae":
+                return jax.device_put(tree, replicated(self.mesh))
+            if isinstance(tree, list):
+                return [shard_params(self.mesh, t) for t in tree]
+            return shard_params(self.mesh, tree)
+
+        return {k: place_component(k, v) for k, v in params.items()}
 
     def _dummy_added_cond(self, b):
         if not self.is_xl:
@@ -314,7 +334,7 @@ class SDPipeline:
         logger.info("merged LoRA %s into %s (%d modules, scale %.2f)",
                     lora.get("lora"), self.model_name, matched, scale)
         params = dict(base_params)
-        params["unet"] = jax.device_put(merged_unet, replicated(self.mesh))
+        params["unet"] = self._place({"unet": merged_unet})["unet"]
         self._lora_cache[key] = params
         while len(self._lora_cache) > MAX_RESIDENT_LORAS:
             self._lora_cache.popitem(last=False)

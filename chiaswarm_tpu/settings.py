@@ -25,6 +25,10 @@ class Settings:
     # --- TPU-native additions (no reference analog) ---
     # chips per job slice; 0 = use every local chip as one slice
     chips_per_job: int = 0
+    # tensor-parallel degree within each slice (Megatron-style sharding of
+    # attention/MLP kernels over the mesh's `tensor` axis); must divide the
+    # slice's chip count
+    tensor_parallelism: int = 1
     # persistent XLA compilation cache (the TPU analog of the HF model cache)
     compilation_cache_dir: str = "~/.sdaas/xla_cache"
     # model weight root (converted Flax checkpoints / HF safetensors)
@@ -43,6 +47,7 @@ _ENV_OVERRIDES = {
     "SDAAS_URI": "sdaas_uri",
     "SDAAS_WORKERNAME": "worker_name",
     "SDAAS_CHIPS_PER_JOB": "chips_per_job",
+    "SDAAS_TENSOR_PARALLELISM": "tensor_parallelism",
     "SDAAS_DTYPE": "dtype",
 }
 

@@ -56,7 +56,8 @@ class Worker:
             else f"{self.settings.sdaas_uri.rstrip('/')}/api"
         )
         self.allocator = allocator or SliceAllocator(
-            chips_per_job=self.settings.chips_per_job
+            chips_per_job=self.settings.chips_per_job,
+            tensor_parallelism=self.settings.tensor_parallelism,
         )
         self.hive = HiveClient(self.settings, self.hive_uri)
         self.work_queue: asyncio.Queue = asyncio.Queue(maxsize=len(self.allocator))

@@ -78,5 +78,19 @@ def test_chipset_draws_seed_when_absent():
 def test_chipset_mesh():
     cs = ChipSet(jax.devices()[:4])
     mesh = cs.mesh()
-    assert mesh.axis_names == ("data",)
+    assert mesh.axis_names == ("data", "tensor", "seq")
+    assert mesh.shape == {"data": 4, "tensor": 1, "seq": 1}
     assert mesh.devices.size == 4
+
+
+def test_chipset_tensor_axis():
+    cs = ChipSet(jax.devices()[:4], tensor=2)
+    mesh = cs.mesh()
+    assert mesh.shape == {"data": 2, "tensor": 2, "seq": 1}
+
+
+def test_chipset_rejects_nondividing_tensor_degree():
+    with pytest.raises(ValueError, match="does not divide"):
+        ChipSet(jax.devices()[:3], tensor=2)
+    with pytest.raises(ValueError, match="degrees must be >= 1"):
+        ChipSet(jax.devices()[:4], tensor=0)

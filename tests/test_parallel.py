@@ -96,3 +96,34 @@ def test_tensor_parallel_matmul_matches_dense():
     out = jax.jit(lambda x, a, b: jax.nn.relu(x @ a) @ b)(xw, w1s, w2s)
     expected = np.maximum(x @ w1, 0) @ w2
     np.testing.assert_allclose(np.asarray(out), expected, rtol=2e-4, atol=2e-4)
+
+
+def test_sd_pipeline_tensor_parallel_matches_replicated():
+    """THE serving-path TP check (VERDICT weak #4): the same job on a
+    data+tensor ChipSet mesh must match the single-chip replicated run —
+    same random weights (seeded by model name), same seed, sharded kernels.
+    """
+    from chiaswarm_tpu.chips.device import ChipSet
+    from chiaswarm_tpu.pipelines.stable_diffusion import SDPipeline
+
+    chipset = ChipSet(jax.devices(), tensor=2)  # data=4, tensor=2
+    tp = SDPipeline("test/tiny-sd", chipset=chipset)
+    assert tp.tensor_parts == 2 and tp.data_parts == 4
+    # UNet attention kernels actually sharded, not replicated
+    spec = jax.tree_util.tree_leaves(
+        jax.tree_util.tree_map(
+            lambda x: x.sharding.spec,
+            tp.params["unet"],
+            is_leaf=lambda x: hasattr(x, "sharding"),
+        )
+    )
+    assert any(s == P(None, "tensor") for s in spec)
+
+    ref = SDPipeline("test/tiny-sd")
+    kw = dict(prompt="tp parity", height=64, width=64, num_inference_steps=2,
+              num_images_per_prompt=4)
+    a = np.asarray(tp.run(rng=jax.random.key(11), **kw)[0][0], np.int32)
+    b = np.asarray(ref.run(rng=jax.random.key(11), **kw)[0][0], np.int32)
+    # fp32 CPU: sharded matmul + psum reassociates float sums; after uint8
+    # quantization the outputs agree to the last-bit rounding boundary
+    assert np.abs(a - b).max() <= 2, np.abs(a - b).max()
