@@ -1,0 +1,289 @@
+"""Worker setup CLI: `python -m chiaswarm_tpu.initialize`.
+
+Behavioral parity with reference swarm/initialize.py:18-104 — interactive
+token/uri prompt into settings.json, `--reset`, `--silent`, and
+`--download` prefetching every hive-known model — redesigned around this
+framework's weight pipeline: downloads land as raw safetensors under
+`model_root_dir` (not a torch pickle cache), and each model is then
+CONVERTED + SHAPE-CHECKED against the Flax architecture via
+`jax.eval_shape` (structural validation without materializing a full-size
+init). `--check` runs that validation alone on already-present models.
+
+A model that passes `--check` is exactly what SDPipeline._convert_params
+loads at serving time, so a green check here means the worker will serve
+real weights, not hit the fatal missing-weights path (weights.py).
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import functools
+import logging
+import sys
+from pathlib import Path
+
+from .hive import get_models
+from .log_setup import setup_logging
+from .settings import (
+    Settings,
+    get_settings_full_path,
+    load_settings,
+    resolve_path,
+    save_settings,
+    settings_exist,
+)
+
+logger = logging.getLogger(__name__)
+
+# repo files worth fetching for serving: weights + configs + tokenizer
+_DOWNLOAD_PATTERNS = [
+    "*.safetensors",
+    "*.json",
+    "tokenizer/*",
+    "text_encoder/*",
+    "text_encoder_2/*",
+    "unet/*",
+    "vae/*",
+    "scheduler/*",
+    "*.txt",
+]
+
+
+def prompt_for_settings(existing: Settings) -> Settings:
+    print("chiaswarm-tpu worker setup")
+    token = input(f"hive token [{existing.sdaas_token or 'unset'}]: ").strip()
+    uri = input(f"hive uri [{existing.sdaas_uri}]: ").strip()
+    name = input(f"worker name [{existing.worker_name}]: ").strip()
+    if token:
+        existing.sdaas_token = token
+    if uri:
+        existing.sdaas_uri = uri
+    if name:
+        existing.worker_name = name
+    return existing
+
+
+def model_root() -> Path:
+    return Path(load_settings().model_root_dir).expanduser()
+
+
+def download_model(model_id: str, root: Path) -> bool:
+    """Fetch one model's safetensors tree from the HF hub into the model
+    root. Returns False (with a log line) when the hub is unreachable or
+    the package is absent — callers keep going; serving later fails loudly
+    per weights.py if the weights still aren't there."""
+    try:
+        from huggingface_hub import snapshot_download
+    except ImportError:
+        logger.error("huggingface_hub not installed; cannot download %s", model_id)
+        return False
+    target = root / model_id
+    try:
+        snapshot_download(
+            repo_id=model_id,
+            local_dir=str(target),
+            allow_patterns=_DOWNLOAD_PATTERNS,
+        )
+        return True
+    except Exception as e:
+        logger.error("download failed for %s: %s", model_id, e)
+        return False
+
+
+def _eval_shape_params(module, *args, **kwargs):
+    import jax
+
+    fn = functools.partial(module.init, **kwargs) if kwargs else module.init
+    shapes = jax.eval_shape(fn, jax.random.key(0), *args)
+    return shapes["params"]
+
+
+_UNSUPPORTED_CHECK_KEYWORDS = (
+    # families the worker can schedule but cannot yet serve with real
+    # weights (no conversion path) — `--check` skips instead of failing
+    "audioldm", "bark", "animatediff", "zeroscope", "text-to-video",
+    "i2vgen", "stable-video", "damo",
+)
+
+
+def _param_count(tree) -> int:
+    import jax
+    import numpy as np
+
+    return sum(int(np.prod(x.shape)) for x in jax.tree_util.tree_leaves(tree))
+
+
+def verify_local_model(model_name: str, root: Path | None = None) -> dict | None:
+    """Convert a downloaded checkpoint and structurally validate every
+    component against the Flax architecture (family-dispatched: SD-like and
+    BLIP today). Returns per-component param counts; None when the family
+    has no real-weight serving path yet (skip, not failure); raises with
+    the full mismatch list on a genuine mismatch."""
+    name = model_name.lower()
+    if any(k in name for k in _UNSUPPORTED_CHECK_KEYWORDS):
+        return None
+    root = root or model_root()
+    if "blip" in name:
+        return _verify_blip_model(model_name, root)
+    return _verify_sd_model(model_name, root)
+
+
+def _verify_blip_model(model_name: str, root: Path) -> dict:
+    import jax.numpy as jnp
+
+    from .models.blip import TINY_BLIP, BlipConfig, TextDecoder, VisionEncoder
+    from .models.conversion import (
+        assert_tree_shapes_match,
+        convert_blip,
+        load_torch_state_dict,
+    )
+    from .weights import is_test_model
+
+    model_dir = root / model_name
+    cfg = TINY_BLIP if is_test_model(model_name) else BlipConfig()
+    converted = convert_blip(load_torch_state_dict(model_dir))
+    n_patches = (cfg.image_size // cfg.patch_size) ** 2
+    vision_exp = _eval_shape_params(
+        VisionEncoder(cfg), jnp.zeros((1, cfg.image_size, cfg.image_size, 3))
+    )
+    assert_tree_shapes_match(converted["vision"], vision_exp, prefix="vision")
+    text_exp = _eval_shape_params(
+        TextDecoder(cfg),
+        jnp.zeros((1, cfg.max_caption_len), jnp.int32),
+        jnp.zeros((1, n_patches + 1, cfg.vision_hidden)),
+    )
+    assert_tree_shapes_match(converted["text"], text_exp, prefix="text")
+    return {
+        "vision": _param_count(converted["vision"]),
+        "text": _param_count(converted["text"]),
+    }
+
+
+def _verify_sd_model(model_name: str, root: Path) -> dict:
+    import jax.numpy as jnp
+
+    from .models.clip import CLIPTextEncoder
+    from .models.conversion import (
+        assert_tree_shapes_match,
+        convert_clip,
+        convert_unet,
+        convert_vae,
+        load_torch_state_dict,
+    )
+    from .models.unet2d import UNet2DConditionModel
+    from .models.vae import AutoencoderKL
+    from .pipelines.stable_diffusion import _family_configs, dummy_added_cond
+
+    model_dir = root / model_name
+    unet_cfg, clip_cfgs, vae_cfg, _, _ = _family_configs(model_name)
+    report: dict[str, int] = {}
+    count = _param_count
+
+    unet = UNet2DConditionModel(unet_cfg)
+    n_down = len(unet_cfg.block_out_channels) - 1
+    hw = 2 ** max(n_down, 2)
+    converted = convert_unet(load_torch_state_dict(model_dir, "unet"))
+    expected = _eval_shape_params(
+        unet,
+        jnp.zeros((1, hw, hw, unet_cfg.in_channels)),
+        jnp.zeros((1,)),
+        jnp.zeros((1, 77, unet_cfg.cross_attention_dim)),
+        added_cond=dummy_added_cond(unet_cfg, 1),
+    )
+    assert_tree_shapes_match(converted, expected, prefix="unet")
+    report["unet"] = count(converted)
+
+    vae = AutoencoderKL(vae_cfg)
+    factor = 2 ** (len(vae_cfg.block_out_channels) - 1)
+    converted = convert_vae(load_torch_state_dict(model_dir, "vae"))
+    expected = _eval_shape_params(vae, jnp.zeros((1, 4 * factor, 4 * factor, 3)))
+    assert_tree_shapes_match(converted, expected, prefix="vae")
+    report["vae"] = count(converted)
+
+    for i, clip_cfg in enumerate(clip_cfgs):
+        sub = "text_encoder" if i == 0 else f"text_encoder_{i + 1}"
+        enc = CLIPTextEncoder(clip_cfg)
+        converted = convert_clip(load_torch_state_dict(model_dir, sub))
+        expected = _eval_shape_params(enc, jnp.zeros((1, 77), jnp.int32))
+        assert_tree_shapes_match(converted, expected, prefix=sub)
+        report[sub] = count(converted)
+    return report
+
+
+async def fetch_hive_model_list(settings: Settings) -> list[str]:
+    models = await get_models(f"{settings.sdaas_uri.rstrip('/')}/api")
+    names = []
+    for m in models:
+        name = m.get("id") or m.get("model_name") or m.get("name")
+        if name:
+            names.append(name)
+    return names
+
+
+async def init() -> int:
+    parser = argparse.ArgumentParser(
+        prog="chiaswarm-tpu-init", description=__doc__.splitlines()[0]
+    )
+    parser.add_argument("--reset", action="store_true",
+                        help="delete settings and exit")
+    parser.add_argument("--silent", action="store_true",
+                        help="no interactive prompt; keep existing settings")
+    parser.add_argument("--download", action="store_true",
+                        help="prefetch every hive-known model into the model root")
+    parser.add_argument("--check", action="store_true",
+                        help="convert + shape-check locally present models")
+    parser.add_argument("--models", nargs="*", default=None,
+                        help="explicit model ids (default: ask the hive)")
+    args = parser.parse_args()
+
+    if args.reset:
+        path = get_settings_full_path()
+        if path.is_file():
+            path.unlink()
+            print(f"removed {path}")
+        return 0
+
+    settings = load_settings()
+    if not args.silent and (not settings_exist() or not settings.sdaas_token):
+        settings = prompt_for_settings(settings)
+    save_settings(settings)
+    setup_logging(resolve_path(settings.log_filename), settings.log_level)
+
+    rc = 0
+    if args.download or args.check:
+        names = args.models
+        if names is None:
+            names = await fetch_hive_model_list(settings)
+            if not names:
+                print("hive returned no model list; pass --models explicitly")
+                return 1
+        root = model_root()
+        root.mkdir(parents=True, exist_ok=True)
+        for name in names:
+            if args.download:
+                ok = download_model(name, root)
+                print(f"download {name}: {'ok' if ok else 'FAILED'}")
+                rc |= 0 if ok else 1
+            if args.check:
+                try:
+                    report = verify_local_model(name, root)
+                    if report is None:
+                        print(f"check {name}: skipped (family has no "
+                              f"real-weight serving path yet)")
+                    else:
+                        total = sum(report.values())
+                        print(f"check {name}: ok ({total / 1e6:.1f}M params, "
+                              f"{sorted(report)} verified)")
+                except Exception as e:
+                    print(f"check {name}: FAILED: {e}")
+                    rc |= 1
+    return rc
+
+
+def main() -> None:
+    sys.exit(asyncio.run(init()))
+
+
+if __name__ == "__main__":
+    main()

@@ -169,10 +169,123 @@ def convert_unet(state: dict) -> dict:
     return convert_state_dict(state, unet_rename)
 
 
+def convert_blip(state: dict) -> dict:
+    """HF BlipForConditionalGeneration state dict -> {"vision","text"} trees
+    matching models/blip.py. Two non-mechanical steps: the vision tower's
+    fused qkv projection splits into our separate q/k/v Denses, and BERT's
+    dotted layer names flatten onto the decoder's per-layer module names.
+    Reference behavior replaced: swarm/captioning/caption_image.py:12-17
+    (transformers classes resolved by name per job)."""
+    vision: dict = {}
+    text: dict = {}
+
+    def put(tree: dict, path: str, leaf: str, value):
+        node = tree
+        for p in path.split("/"):
+            node = node.setdefault(p, {})
+        node[leaf] = value
+
+    def dense(tree, path, leaf, v):
+        # torch Linear [out, in] -> flax kernel [in, out]; bias verbatim
+        if leaf == "weight":
+            put(tree, path, "kernel", np.ascontiguousarray(v.T))
+        else:
+            put(tree, path, "bias", v)
+
+    def norm(tree, path, leaf, v):
+        put(tree, path, "scale" if leaf == "weight" else "bias", v)
+
+    import re
+
+    for name, v in state.items():
+        v = np.asarray(v)
+        if name.startswith("vision_model."):
+            n = name[len("vision_model."):]
+            if n == "embeddings.class_embedding":
+                vision["cls_token"] = v.reshape(1, 1, -1)
+            elif n == "embeddings.position_embedding":
+                vision["pos_embed"] = v.reshape(1, v.shape[-2], v.shape[-1])
+            elif n.startswith("embeddings.patch_embedding."):
+                leaf = n.rsplit(".", 1)[1]
+                if leaf == "weight":
+                    put(vision, "patch_embed", "kernel", v.transpose(2, 3, 1, 0))
+                else:
+                    put(vision, "patch_embed", "bias", v)
+            elif n.startswith("post_layernorm."):
+                norm(vision, "ln_post", n.rsplit(".", 1)[1], v)
+            else:
+                m = re.match(r"encoder\.layers\.(\d+)\.(.+)\.(weight|bias)$", n)
+                if not m:
+                    continue
+                i, sub, leaf = m.group(1), m.group(2), m.group(3)
+                if sub == "self_attn.qkv":
+                    # fused [3D, D] rows (or [3D] bias) -> separate q/k/v
+                    for part, chunk in zip("qkv", np.split(v, 3, axis=0)):
+                        dense(vision, f"attn_{i}/{part}", leaf, chunk)
+                elif sub == "self_attn.projection":
+                    dense(vision, f"attn_{i}/out", leaf, v)
+                elif sub == "layer_norm1":
+                    norm(vision, f"ln1_{i}", leaf, v)
+                elif sub == "layer_norm2":
+                    norm(vision, f"ln2_{i}", leaf, v)
+                elif sub == "mlp.fc1":
+                    dense(vision, f"fc1_{i}", leaf, v)
+                elif sub == "mlp.fc2":
+                    dense(vision, f"fc2_{i}", leaf, v)
+        elif name.startswith("text_decoder."):
+            n = name[len("text_decoder."):]
+            if n == "bert.embeddings.word_embeddings.weight":
+                put(text, "word_embeddings", "embedding", v)
+            elif n == "bert.embeddings.position_embeddings.weight":
+                text["position_embeddings"] = v
+            elif n.startswith("bert.embeddings.LayerNorm."):
+                norm(text, "embed_ln", n.rsplit(".", 1)[1], v)
+            elif n.startswith("cls.predictions.transform.dense."):
+                dense(text, "head_dense", n.rsplit(".", 1)[1], v)
+            elif n.startswith("cls.predictions.transform.LayerNorm."):
+                norm(text, "head_ln", n.rsplit(".", 1)[1], v)
+            elif n.startswith("cls.predictions.decoder."):
+                dense(text, "lm_head", n.rsplit(".", 1)[1], v)
+            elif n == "cls.predictions.bias":
+                # tied duplicate of decoder.bias in HF checkpoints
+                text.setdefault("lm_head", {}).setdefault("bias", v)
+            else:
+                m = re.match(r"bert\.encoder\.layer\.(\d+)\.(.+)\.(weight|bias)$", n)
+                if not m:
+                    continue
+                i, sub, leaf = m.group(1), m.group(2), m.group(3)
+                table = {
+                    "attention.self.query": ("dense", f"self_{i}/q"),
+                    "attention.self.key": ("dense", f"self_{i}/k"),
+                    "attention.self.value": ("dense", f"self_{i}/v"),
+                    "attention.output.dense": ("dense", f"self_{i}/out"),
+                    "attention.output.LayerNorm": ("norm", f"self_ln_{i}"),
+                    "crossattention.self.query": ("dense", f"cross_{i}/q"),
+                    "crossattention.self.key": ("dense", f"cross_{i}/k"),
+                    "crossattention.self.value": ("dense", f"cross_{i}/v"),
+                    "crossattention.output.dense": ("dense", f"cross_{i}/out"),
+                    "crossattention.output.LayerNorm": ("norm", f"cross_ln_{i}"),
+                    "intermediate.dense": ("dense", f"fc1_{i}"),
+                    "output.dense": ("dense", f"fc2_{i}"),
+                    "output.LayerNorm": ("norm", f"ffn_ln_{i}"),
+                }
+                entry = table.get(sub)
+                if entry is None:
+                    continue
+                kind, path = entry
+                (dense if kind == "dense" else norm)(text, path, leaf, v)
+    return {"vision": vision, "text": text}
+
+
 def assert_tree_shapes_match(converted: dict, initialized: dict, prefix=""):
     """Structural check: every initialized param has a converted twin of the
     same shape. Raises with the full list of mismatches."""
     problems: list[str] = []
+
+    def shape_of(x):
+        # works for arrays AND jax.eval_shape's ShapeDtypeStructs, so the
+        # check can run without materializing a full-size init
+        return tuple(getattr(x, "shape", None) or np.shape(x))
 
     def walk(c, i, path):
         if isinstance(i, dict):
@@ -182,8 +295,8 @@ def assert_tree_shapes_match(converted: dict, initialized: dict, prefix=""):
                 else:
                     walk(c[k], v, f"{path}/{k}")
         else:
-            if np.shape(c) != np.shape(i):
-                problems.append(f"shape {path}: {np.shape(c)} != {np.shape(i)}")
+            if shape_of(c) != shape_of(i):
+                problems.append(f"shape {path}: {shape_of(c)} != {shape_of(i)}")
 
     walk(converted, initialized, prefix)
     if problems:

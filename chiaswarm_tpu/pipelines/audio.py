@@ -59,7 +59,18 @@ def _audio_configs(model_name: str):
 class AudioPipeline:
     """Resident mel-latent diffusion bundle for txt2audio jobs."""
 
-    def __init__(self, model_name: str, chipset=None):
+    def __init__(self, model_name: str, chipset=None,
+                 allow_random_init: bool = False):
+        # stand-in AudioLDM architecture with no conversion path yet: real
+        # model names fail loudly instead of serving random-weight audio
+        from ..weights import require_weights_present
+
+        require_weights_present(
+            model_name, None, allow_random_init,
+            component="audio model",
+            hint="This worker cannot serve real audio-model weights yet; "
+                 "only test/tiny audio models are available.",
+        )
         self.model_name = model_name
         self.chipset = chipset
         unet_cfg, clip_cfg, vae_cfg = _audio_configs(model_name)
@@ -245,7 +256,7 @@ def wav_to_buffer(wav: np.ndarray, rate: int = SAMPLE_RATE) -> io.BytesIO:
 
 @register_family("audioldm")
 def _build_audioldm(model_name, chipset, **variant):
-    return AudioPipeline(model_name, chipset)
+    return AudioPipeline(model_name, chipset, **variant)
 
 
 def run_audioldm(device_identifier: str, model_name: str, **kwargs):

@@ -72,7 +72,19 @@ def _video_configs(model_name: str):
 class VideoPipeline:
     """Resident motion-module pipeline; serves txt2vid and img2vid."""
 
-    def __init__(self, model_name: str, chipset=None, image_conditioned=False):
+    def __init__(self, model_name: str, chipset=None, image_conditioned=False,
+                 allow_random_init: bool = False):
+        # no weight-conversion path exists for motion checkpoints yet, so a
+        # non-test model without opt-in is a fatal job error, not silent
+        # random-weight video (weights.py policy)
+        from ..weights import require_weights_present
+
+        require_weights_present(
+            model_name, None, allow_random_init,
+            component="video model",
+            hint="This worker cannot serve real video-model weights yet; "
+                 "only test/tiny video models are available.",
+        )
         self.model_name = model_name
         self.chipset = chipset
         self.image_conditioned = image_conditioned
@@ -262,11 +274,11 @@ class VideoPipeline:
 
 @register_family("animatediff")
 def _build_animatediff(model_name, chipset, **variant):
-    return VideoPipeline(model_name, chipset, image_conditioned=False)
+    return VideoPipeline(model_name, chipset, image_conditioned=False, **variant)
 
 
 def _build_img2vid(model_name, chipset, **variant):
-    return VideoPipeline(model_name, chipset, image_conditioned=True)
+    return VideoPipeline(model_name, chipset, image_conditioned=True, **variant)
 
 
 register_family("svd")(_build_img2vid)

@@ -1,0 +1,63 @@
+"""Weight-availability policy: production jobs fail loudly, tests stay hermetic.
+
+Reference behavior: every callback calls `from_pretrained` against the HF
+cache and crashes with a library error when the model was never downloaded
+(swarm/diffusion/diffusion_func.py:103); operators prefetch via
+`python -m swarm.initialize --download` (swarm/initialize.py:68-100).
+
+Round-1 review (VERDICT weak #3) found our fallback silently served images
+from deterministic *random* weights. Policy now:
+
+- `test/*` and `*tiny*` model names: random init is the point (hermetic
+  CPU tests, `test_tiny_model` jobs) — always allowed.
+- anything else: missing weights raise `MissingWeightsError`, a ValueError
+  subclass, so the worker marks the job envelope `fatal_error: true`
+  (worker.py:178-180) and the hive does not resubmit.
+- benchmarks / bring-up can opt in explicitly with `allow_random_init=True`
+  (perf does not depend on weight values).
+"""
+
+from __future__ import annotations
+
+from pathlib import Path
+
+
+class MissingWeightsError(ValueError):
+    """Model weights are not present on this worker (fatal job error)."""
+
+
+def is_test_model(model_name: str) -> bool:
+    name = model_name.lower()
+    return name.startswith("test/") or "tiny" in name
+
+
+def random_init_permitted(model_name: str, allow_random_init: bool) -> bool:
+    return allow_random_init or is_test_model(model_name)
+
+
+def require_weights_present(
+    model_name: str,
+    model_dir: Path | None,
+    allow_random_init: bool,
+    component: str = "model",
+    hint: str | None = None,
+) -> bool:
+    """Gate a missing-weights fallback.
+
+    Returns True when the caller may proceed with random init; raises
+    MissingWeightsError when this is a production model whose weights are
+    simply absent. `hint` overrides the default remediation text (families
+    with no conversion path must not prescribe a dead-end `--download`).
+    """
+    if random_init_permitted(model_name, allow_random_init):
+        return True
+    where = f" (looked in {model_dir})" if model_dir is not None else ""
+    if hint is None:
+        hint = (
+            "Prefetch them with `python -m chiaswarm_tpu.initialize "
+            "--download` or place converted safetensors under the model root."
+        )
+    raise MissingWeightsError(
+        f"{component} weights for '{model_name}' are not present on this "
+        f"worker{where}. {hint}"
+    )
