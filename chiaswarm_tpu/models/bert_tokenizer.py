@@ -1,0 +1,133 @@
+"""Minimal BERT WordPiece tokenizer for the BLIP text side.
+
+Replaces the reference's reflection-loaded `BlipProcessor`
+(swarm/captioning/caption_image.py:12-17) with a dependency-free
+implementation: lowercasing + punctuation-splitting pre-tokenizer and
+greedy longest-match WordPiece over a bert-base `vocab.txt`. Decoding
+re-joins `##` continuation pieces — enough for caption output, which is
+plain lowercase English.
+
+`HashBertTokenizer` is the hermetic stand-in for tiny/test models (same
+role as models/tokenizer.py's HashTokenizer for CLIP).
+"""
+
+from __future__ import annotations
+
+from pathlib import Path
+
+_PUNCT = set("!\"#$%&'()*+,-./:;<=>?@[\\]^_`{|}~")
+
+
+def _pre_tokenize(text: str) -> list[str]:
+    """Lowercase, then split on whitespace and isolate punctuation."""
+    words: list[str] = []
+    current: list[str] = []
+    for ch in text.lower():
+        if ch.isspace():
+            if current:
+                words.append("".join(current))
+                current = []
+        elif ch in _PUNCT:
+            if current:
+                words.append("".join(current))
+                current = []
+            words.append(ch)
+        else:
+            current.append(ch)
+    if current:
+        words.append("".join(current))
+    return words
+
+
+class BertWordPieceTokenizer:
+    unk_token = "[UNK]"
+
+    def __init__(self, vocab: dict[str, int]):
+        self.vocab = vocab
+        self.inverse = {i: t for t, i in vocab.items()}
+        self.unk_id = vocab.get(self.unk_token, 100)
+
+    @classmethod
+    def from_file(cls, vocab_path: str | Path) -> "BertWordPieceTokenizer":
+        vocab = {}
+        with open(vocab_path, encoding="utf-8") as f:
+            # ids are line numbers including blanks, but CRLF endings and
+            # empty trailing lines must not register as tokens
+            for i, line in enumerate(f):
+                token = line.rstrip("\r\n")
+                if token:
+                    vocab[token] = i
+        return cls(vocab)
+
+    def _wordpiece(self, word: str) -> list[int]:
+        """Greedy longest-match-first, `##` continuation prefixes."""
+        ids: list[int] = []
+        start = 0
+        while start < len(word):
+            end = len(word)
+            piece_id = None
+            while end > start:
+                piece = word[start:end]
+                if start > 0:
+                    piece = "##" + piece
+                if piece in self.vocab:
+                    piece_id = self.vocab[piece]
+                    break
+                end -= 1
+            if piece_id is None:
+                return [self.unk_id]  # whole word unknown
+            ids.append(piece_id)
+            start = end
+        return ids
+
+    def encode(self, text: str) -> list[int]:
+        ids: list[int] = []
+        for word in _pre_tokenize(text):
+            ids.extend(self._wordpiece(word))
+        return ids
+
+    def decode(self, ids, skip_special: bool = True) -> str:
+        pieces = []
+        for i in ids:
+            tok = self.inverse.get(int(i), self.unk_token)
+            if skip_special and tok.startswith("[") and tok.endswith("]"):
+                continue
+            pieces.append(tok)
+        out = ""
+        for p in pieces:
+            if p.startswith("##"):
+                out += p[2:]
+            elif out and p not in _PUNCT:
+                out += " " + p
+            else:
+                out += p
+        return out
+
+
+class HashBertTokenizer:
+    """Deterministic stand-in for tiny/test models: stable ids from token
+    text, synthetic `t{id}` decode."""
+
+    def __init__(self, vocab_size: int):
+        self.vocab_size = vocab_size
+
+    def encode(self, text: str) -> list[int]:
+        import zlib
+
+        # reserve the top ids for bos/eos of the tiny config
+        span = max(self.vocab_size - 2, 1)
+        return [zlib.crc32(w.encode()) % span for w in _pre_tokenize(text)]
+
+    def decode(self, ids, skip_special: bool = True) -> str:
+        return " ".join(f"t{int(i)}" for i in ids)
+
+
+def load_bert_tokenizer(model_dir: str | Path | None, vocab_size: int):
+    """Real WordPiece when a vocab ships with the model, else the hash
+    stand-in (mirrors models/tokenizer.py's load_tokenizer contract)."""
+    if model_dir is not None:
+        for rel in ("vocab.txt", "tokenizer/vocab.txt"):
+            path = Path(model_dir) / rel
+            if path.is_file():
+                return BertWordPieceTokenizer.from_file(path)
+    return HashBertTokenizer(vocab_size)

@@ -17,24 +17,27 @@ import jax.numpy as jnp
 
 @dataclasses.dataclass(frozen=True)
 class BlipConfig:
-    image_size: int = 224
+    image_size: int = 384  # Salesforce/blip-image-captioning-* native size
     patch_size: int = 16
     vision_hidden: int = 768
     vision_layers: int = 12
     vision_heads: int = 12
-    vocab_size: int = 30524  # bert-base vocab (BLIP's text side)
+    vocab_size: int = 30524  # bert-base vocab + [DEC]/[ENC] (BLIP's text side)
     text_hidden: int = 768
     text_layers: int = 12
     text_heads: int = 12
+    max_positions: int = 512  # BERT absolute position table
     max_caption_len: int = 24
-    bos_token_id: int = 30522
+    bos_token_id: int = 30522  # [DEC]
     eos_token_id: int = 102  # bert [SEP]
+    pad_token_id: int = 0
 
 
 TINY_BLIP = BlipConfig(
     image_size=64, patch_size=16, vision_hidden=32, vision_layers=2,
     vision_heads=4, vocab_size=1000, text_hidden=32, text_layers=2,
-    text_heads=4, max_caption_len=8, bos_token_id=998, eos_token_id=999,
+    text_heads=4, max_positions=64, max_caption_len=8, bos_token_id=998,
+    eos_token_id=999,
 )
 
 
@@ -61,12 +64,15 @@ class _MHA(nn.Module):
 
 
 class VisionEncoder(nn.Module):
+    """BLIP ViT (pre-LN). Module names line up with the HF checkpoint graph
+    (vision_model.*) so convert_blip is a mechanical rename + qkv split."""
+
     config: BlipConfig
     dtype: jnp.dtype = jnp.float32
 
     @nn.compact
     def __call__(self, pixels):
-        """[B, H, W, 3] in [-1,1] -> [B, patches+1, D]."""
+        """[B, H, W, 3] normalized -> [B, patches+1, D]."""
         cfg = self.config
         x = nn.Conv(
             cfg.vision_hidden, (cfg.patch_size, cfg.patch_size),
@@ -84,18 +90,26 @@ class VisionEncoder(nn.Module):
             (1, x.shape[1], cfg.vision_hidden),
         ).astype(self.dtype)
         x = x + pos
+        eps = 1e-5  # HF BlipVisionConfig.layer_norm_eps
         for i in range(cfg.vision_layers):
-            y = nn.LayerNorm(dtype=self.dtype, name=f"ln1_{i}")(x)
+            y = nn.LayerNorm(epsilon=eps, dtype=self.dtype, name=f"ln1_{i}")(x)
             x = x + _MHA(cfg.vision_heads, cfg.vision_hidden, dtype=self.dtype,
                          name=f"attn_{i}")(y, y)
-            y = nn.LayerNorm(dtype=self.dtype, name=f"ln2_{i}")(x)
+            y = nn.LayerNorm(epsilon=eps, dtype=self.dtype, name=f"ln2_{i}")(x)
             y = nn.Dense(cfg.vision_hidden * 4, dtype=self.dtype, name=f"fc1_{i}")(y)
             y = nn.gelu(y, approximate=False)
             x = x + nn.Dense(cfg.vision_hidden, dtype=self.dtype, name=f"fc2_{i}")(y)
-        return nn.LayerNorm(dtype=self.dtype, name="ln_post")(x)
+        return nn.LayerNorm(epsilon=eps, dtype=self.dtype, name="ln_post")(x)
 
 
 class TextDecoder(nn.Module):
+    """BERT-style post-LN causal decoder mirroring HF BLIP's text_decoder
+    (BlipTextLMHeadModel): embedding LN, per-layer [self-attn + LN,
+    cross-attn over vision embeds + LN, FFN + LN], prediction-head
+    transform (dense -> gelu -> LN) before the vocab projection. Post-LN
+    ordering and 1e-12 epsilons are load-bearing for converted weights.
+    """
+
     config: BlipConfig
     dtype: jnp.dtype = jnp.float32
 
@@ -104,31 +118,40 @@ class TextDecoder(nn.Module):
         """[B, L] ids + [B, P, Dv] -> [B, L, vocab] logits (causal)."""
         cfg = self.config
         b, s = input_ids.shape
+        eps = 1e-12  # BERT layer_norm_eps
         x = nn.Embed(
-            cfg.vocab_size, cfg.text_hidden, dtype=self.dtype, name="tok_embed"
+            cfg.vocab_size, cfg.text_hidden, dtype=self.dtype,
+            name="word_embeddings",
         )(input_ids)
         pos = self.param(
-            "pos_embed", nn.initializers.normal(0.02),
-            (1, cfg.max_caption_len, cfg.text_hidden),
+            "position_embeddings", nn.initializers.normal(0.02),
+            (cfg.max_positions, cfg.text_hidden),
         ).astype(self.dtype)
-        x = x + pos[:, :s]
+        x = x + pos[None, :s]
+        x = nn.LayerNorm(epsilon=eps, dtype=self.dtype, name="embed_ln")(x)
         causal = jnp.triu(jnp.full((s, s), -1e9, self.dtype), k=1)[None, None]
-        img = nn.Dense(cfg.text_hidden, dtype=self.dtype, name="vis_proj")(
-            image_embeds.astype(self.dtype)
-        )
+        img = image_embeds.astype(self.dtype)
         for i in range(cfg.text_layers):
-            y = nn.LayerNorm(dtype=self.dtype, name=f"ln1_{i}")(x)
-            x = x + _MHA(cfg.text_heads, cfg.text_hidden, dtype=self.dtype,
-                         name=f"self_{i}")(y, y, causal)
-            y = nn.LayerNorm(dtype=self.dtype, name=f"lnx_{i}")(x)
-            x = x + _MHA(cfg.text_heads, cfg.text_hidden, dtype=self.dtype,
-                         name=f"cross_{i}")(y, img)
-            y = nn.LayerNorm(dtype=self.dtype, name=f"ln2_{i}")(x)
-            y = nn.Dense(cfg.text_hidden * 4, dtype=self.dtype, name=f"fc1_{i}")(y)
+            y = _MHA(cfg.text_heads, cfg.text_hidden, dtype=self.dtype,
+                     name=f"self_{i}")(x, x, causal)
+            x = nn.LayerNorm(epsilon=eps, dtype=self.dtype, name=f"self_ln_{i}")(
+                x + y
+            )
+            y = _MHA(cfg.text_heads, cfg.text_hidden, dtype=self.dtype,
+                     name=f"cross_{i}")(x, img)
+            x = nn.LayerNorm(epsilon=eps, dtype=self.dtype, name=f"cross_ln_{i}")(
+                x + y
+            )
+            y = nn.Dense(cfg.text_hidden * 4, dtype=self.dtype, name=f"fc1_{i}")(x)
             y = nn.gelu(y, approximate=False)
-            x = x + nn.Dense(cfg.text_hidden, dtype=self.dtype, name=f"fc2_{i}")(y)
-        x = nn.LayerNorm(dtype=self.dtype, name="ln_final")(x)
-        return nn.Dense(cfg.vocab_size, dtype=self.dtype, name="lm_head")(x)
+            y = nn.Dense(cfg.text_hidden, dtype=self.dtype, name=f"fc2_{i}")(y)
+            x = nn.LayerNorm(epsilon=eps, dtype=self.dtype, name=f"ffn_ln_{i}")(
+                x + y
+            )
+        y = nn.Dense(cfg.text_hidden, dtype=self.dtype, name="head_dense")(x)
+        y = nn.gelu(y, approximate=False)
+        y = nn.LayerNorm(epsilon=eps, dtype=self.dtype, name="head_ln")(y)
+        return nn.Dense(cfg.vocab_size, dtype=self.dtype, name="lm_head")(y)
 
 
 def greedy_decode(decoder_apply, params, image_embeds, config: BlipConfig,

@@ -56,6 +56,8 @@ PIPELINE_FAMILIES: dict[str, str] = {
     "VideoToVideoSDPipeline": "animatediff",
     "I2VGenXLPipeline": "i2vgenxl",
     "StableVideoDiffusionPipeline": "svd",
+    "BlipForConditionalGeneration": "blip",
+    "BlipForQuestionAnswering": "blip",
 }
 
 # family -> factory(model_name, chipset, **variant) -> pipeline bundle.
@@ -140,7 +142,7 @@ def _ensure_builtin_families() -> None:
     if _BUILTINS_LOADED:
         return
     _BUILTINS_LOADED = True
-    for module in ("stable_diffusion", "video", "audio"):
+    for module in ("stable_diffusion", "video", "audio", "captioning"):
         try:
             __import__(f"{__package__}.pipelines.{module}")
         except Exception as e:

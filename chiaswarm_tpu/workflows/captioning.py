@@ -10,7 +10,7 @@ from ..post_processors.output_processor import make_text_result
 
 
 def caption_callback(device_identifier: str, model_name: str, **kwargs):
-    from ..pipelines.captioning import caption_image
+    from ..pipelines.captioning import get_caption_pipeline
 
     image = kwargs.get("image")
     if image is None:
@@ -18,11 +18,12 @@ def caption_callback(device_identifier: str, model_name: str, **kwargs):
 
     prompt = kwargs.get("prompt") or None
     parameters = kwargs.get("parameters", {})
-    text = caption_image(
-        image,
-        model_name=model_name,
-        prompt=prompt,
-        processor_type=parameters.get("processor_type"),
+    if parameters.get("test_tiny_model"):
+        model_name = "test/tiny-blip"
+    pipe = get_caption_pipeline(
+        model_name,
+        chipset=kwargs.get("chipset"),
         model_type=parameters.get("model_type"),
     )
-    return {"primary": make_text_result(text)}, {"caption": text}
+    text, config = pipe.run(image, prompt=prompt)
+    return {"primary": make_text_result(text)}, {**config, "caption": text}

@@ -104,6 +104,79 @@ def test_transient_error_renders_error_image(sdaas_root):
     assert result["artifacts"]["primary"]["content_type"] == "image/jpeg"
 
 
+def test_img2txt_job_end_to_end(sdaas_root):
+    """The FULL worker path for captioning (VERDICT missing #3): poll ->
+    format_img2txt_args -> registry-resident BLIP -> greedy decode -> JSON
+    text artifact."""
+    import json
+
+    from PIL import Image
+    import numpy as np
+
+    from chiaswarm_tpu import external_resources
+
+    img = Image.fromarray(
+        (np.random.default_rng(0).random((64, 64, 3)) * 255).astype(np.uint8)
+    )
+
+    async def fake_get_image(uri, size):
+        return img if uri else None
+
+    original = external_resources.get_image
+    external_resources.get_image = fake_get_image
+    # job_arguments imported get_image by name — patch there too
+    from chiaswarm_tpu import job_arguments
+
+    ja_original = job_arguments.get_image
+    job_arguments.get_image = fake_get_image
+    try:
+        hive, results = run_jobs(
+            [
+                {
+                    "id": "job-cap",
+                    "workflow": "img2txt",
+                    "model_name": "Salesforce/blip-image-captioning-base",
+                    "start_image_uri": "fake://img",
+                    "prompt": "a picture of",
+                    "parameters": {"test_tiny_model": True},
+                }
+            ],
+            sdaas_root,
+        )
+    finally:
+        external_resources.get_image = original
+        job_arguments.get_image = ja_original
+    [result] = results
+    assert not result.get("fatal_error")
+    assert result["pipeline_config"]["caption"]
+    art = result["artifacts"]["primary"]
+    assert art["content_type"] == "application/json"
+    payload = json.loads(base64.b64decode(art["blob"]))
+    assert payload["caption"] == result["pipeline_config"]["caption"]
+
+
+def test_missing_weights_job_is_fatal(sdaas_root):
+    """A production model with no local weights must come back fatal with
+    the remediation hint, not serve random-weight output (VERDICT weak #3)."""
+    hive, results = run_jobs(
+        [
+            {
+                "id": "job-nw",
+                "workflow": "txt2img",
+                "model_name": "stabilityai/stable-diffusion-2-1",
+                "prompt": "x",
+                "height": 64,
+                "width": 64,
+                "num_inference_steps": 2,
+            }
+        ],
+        sdaas_root,
+    )
+    [result] = results
+    assert result["fatal_error"] is True
+    assert "not present on this worker" in result["pipeline_config"]["error"]
+
+
 def test_multiple_jobs_across_slices(sdaas_root):
     jobs = [
         {"id": f"job-{i}", "workflow": "echo", "model_name": "none", "prompt": str(i)}
