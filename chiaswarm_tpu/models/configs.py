@@ -70,6 +70,12 @@ SDXL_REFINER_UNET = UNet2DConfig(
 
 SD_VAE = VAEConfig()
 SDXL_VAE = VAEConfig(scaling_factor=0.13025)
+# Flux: 16-channel latents, shifted+scaled, no 1x1 quant convs
+# (black-forest-labs/FLUX.1-* AutoencoderKL config)
+FLUX_VAE = VAEConfig(
+    latent_channels=16, scaling_factor=0.3611, shift_factor=0.1159,
+    use_quant_conv=False,
+)
 
 # --- tiny configs for hermetic tests / test_tiny_model jobs ---
 TINY_UNET = UNet2DConfig(

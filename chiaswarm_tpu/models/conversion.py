@@ -169,6 +169,161 @@ def convert_unet(state: dict) -> dict:
     return convert_state_dict(state, unet_rename)
 
 
+def convert_t5(state: dict) -> dict:
+    """transformers T5EncoderModel names -> models/t5.py module names."""
+    params: dict = {}
+
+    def put(path: list[str], leaf: str, value):
+        _assign(params, path + [leaf], value)
+
+    import re
+
+    for name, v in state.items():
+        v = np.asarray(v)
+        if name in ("shared.weight", "encoder.embed_tokens.weight"):
+            put(["token_embedding"], "embedding", v)
+            continue
+        if name == "encoder.final_layer_norm.weight":
+            put(["final_norm"], "scale", v)
+            continue
+        m = re.match(r"encoder\.block\.(\d+)\.layer\.(\d)\.(.+)\.weight$", name)
+        if not m:
+            continue
+        i, sub_idx, sub = m.group(1), m.group(2), m.group(3)
+        block = f"block_{i}"
+        if sub_idx == "0":  # attention sublayer
+            if sub == "layer_norm":
+                put([block, "attn_norm"], "scale", v)
+            elif sub == "SelfAttention.relative_attention_bias":
+                put([block, "attention"], "relative_attention_bias", v)
+            elif sub.startswith("SelfAttention."):
+                proj = sub.rsplit(".", 1)[1]  # q|k|v|o
+                put([block, "attention", proj], "kernel",
+                    np.ascontiguousarray(v.T))
+        else:  # feed-forward sublayer
+            if sub == "layer_norm":
+                put([block, "ff_norm"], "scale", v)
+            elif sub.startswith("DenseReluDense."):
+                proj = sub.rsplit(".", 1)[1]  # wi_0|wi_1|wo
+                put([block, proj], "kernel", np.ascontiguousarray(v.T))
+    return params
+
+
+def convert_flux(state: dict) -> dict:
+    """diffusers FluxTransformer2DModel names -> models/flux.py module names.
+
+    Non-mechanical steps: diffusers keeps separate to_q/to_k/to_v (and
+    add_*_proj for the text stream) which fuse into this module's
+    `*_attn_qkv` Dense; the single-block to_q/k/v + proj_mlp fuse into
+    `linear1`; and AdaLayerNormContinuous's (scale, shift) chunk order
+    swaps into this module's (shift, scale).
+    """
+    import re
+
+    params: dict = {}
+
+    def put(path: list[str], leaf: str, value):
+        _assign(params, path + [leaf], np.ascontiguousarray(value))
+
+    def dense(path: list[str], leaf: str, v):
+        if leaf == "weight":
+            put(path, "kernel", v.T)
+        else:
+            put(path, "bias", v)
+
+    # gather per-block q/k/v pieces for fusing
+    fused: dict[tuple, dict] = {}
+
+    top = {
+        "x_embedder": ["img_in"],
+        "context_embedder": ["txt_in"],
+        "time_text_embed.timestep_embedder.linear_1": ["time_in", "in_layer"],
+        "time_text_embed.timestep_embedder.linear_2": ["time_in", "out_layer"],
+        "time_text_embed.text_embedder.linear_1": ["vector_in", "in_layer"],
+        "time_text_embed.text_embedder.linear_2": ["vector_in", "out_layer"],
+        "time_text_embed.guidance_embedder.linear_1": ["guidance_in", "in_layer"],
+        "time_text_embed.guidance_embedder.linear_2": ["guidance_in", "out_layer"],
+        "proj_out": ["final_layer_linear"],
+    }
+
+    for name, v in state.items():
+        v = np.asarray(v)
+        base, leaf = name.rsplit(".", 1)
+        if base in top:
+            dense(top[base], leaf, v)
+            continue
+        if base == "norm_out.linear":
+            # (scale, shift) -> (shift, scale): swap output halves
+            half = v.shape[0] // 2
+            swapped = np.concatenate([v[half:], v[:half]], axis=0)
+            dense(["final_layer_mod"], leaf, swapped)
+            continue
+        m = re.match(r"transformer_blocks\.(\d+)\.(.+)$", base)
+        if m:
+            i, sub = m.group(1), m.group(2)
+            blk = f"double_blocks_{i}"
+            table = {
+                "norm1.linear": [blk, "img_mod", "lin"],
+                "norm1_context.linear": [blk, "txt_mod", "lin"],
+                "attn.to_out.0": [blk, "img_attn_proj"],
+                "attn.to_add_out": [blk, "txt_attn_proj"],
+                "ff.net.0.proj": [blk, "img_mlp_0"],
+                "ff.net.2": [blk, "img_mlp_2"],
+                "ff_context.net.0.proj": [blk, "txt_mlp_0"],
+                "ff_context.net.2": [blk, "txt_mlp_2"],
+            }
+            if sub in table:
+                dense(table[sub], leaf, v)
+            qk = {
+                "attn.norm_q": ([blk, "img_attn_norm"], "query_scale"),
+                "attn.norm_k": ([blk, "img_attn_norm"], "key_scale"),
+                "attn.norm_added_q": ([blk, "txt_attn_norm"], "query_scale"),
+                "attn.norm_added_k": ([blk, "txt_attn_norm"], "key_scale"),
+            }
+            if sub in qk and leaf == "weight":
+                path, pname = qk[sub]
+                put(path, pname, v)
+            fuse = {
+                "attn.to_q": ("img", 0), "attn.to_k": ("img", 1),
+                "attn.to_v": ("img", 2),
+                "attn.add_q_proj": ("txt", 0), "attn.add_k_proj": ("txt", 1),
+                "attn.add_v_proj": ("txt", 2),
+            }
+            if sub in fuse:
+                stream, slot = fuse[sub]
+                fused.setdefault((blk, stream), {})[(slot, leaf)] = v
+            continue
+        m = re.match(r"single_transformer_blocks\.(\d+)\.(.+)$", base)
+        if m:
+            i, sub = m.group(1), m.group(2)
+            blk = f"single_blocks_{i}"
+            if sub == "norm.linear":
+                dense([blk, "modulation", "lin"], leaf, v)
+            elif sub == "proj_out":
+                dense([blk, "linear2"], leaf, v)
+            elif sub == "attn.norm_q" and leaf == "weight":
+                put([blk, "norm"], "query_scale", v)
+            elif sub == "attn.norm_k" and leaf == "weight":
+                put([blk, "norm"], "key_scale", v)
+            elif sub in ("attn.to_q", "attn.to_k", "attn.to_v", "proj_mlp"):
+                slot = {"attn.to_q": 0, "attn.to_k": 1, "attn.to_v": 2,
+                        "proj_mlp": 3}[sub]
+                fused.setdefault((blk, "single"), {})[(slot, leaf)] = v
+
+    for (blk, stream), pieces in fused.items():
+        n_slots = 4 if stream == "single" else 3
+        for leaf in ("weight", "bias"):
+            parts = [pieces.get((s, leaf)) for s in range(n_slots)]
+            if any(p is None for p in parts):
+                continue
+            cat = np.concatenate(parts, axis=0)  # torch out-dim
+            if stream == "single":
+                dense([blk, "linear1"], leaf, cat)
+            else:
+                dense([blk, f"{stream}_attn_qkv"], leaf, cat)
+    return params
+
+
 def convert_blip(state: dict) -> dict:
     """HF BlipForConditionalGeneration state dict -> {"vision","text"} trees
     matching models/blip.py. Two non-mechanical steps: the vision tower's

@@ -1,0 +1,329 @@
+"""Flux MMDiT transformer, flax.linen — the rectified-flow flagship family.
+
+Reference context: Flux dev/schnell jobs ride `FluxPipeline` wire names
+with bf16 + sequential CPU offload on CUDA (reference swarm/test.py:
+244-290, swarm/job_arguments.py large-model branches). TPU rebuild: the
+whole transformer is one XLA program — no offload; memory scaling comes
+from sharding (parallel/tensor.py) instead.
+
+Architecture (Black Forest Labs Flux):
+- 2x2-patchified 16-channel latents -> `img_in` linear; T5 context ->
+  `txt_in` linear; sinusoidal timestep (+ guidance for dev) and CLIP
+  pooled vector feed MLPs summed into the modulation vector `vec`.
+- `depth_double` double-stream blocks: separate img/txt streams, each with
+  adaLN modulation from `vec`, joint attention over the concatenated
+  token sequence, per-head RMS qk-norm, 3D RoPE (text ids zero, image ids
+  (y, x)).
+- `depth_single` single-stream blocks over the fused sequence: one fused
+  linear producing qkv + MLP-in, attention + gelu-MLP combined, one
+  output linear.
+- final adaLN + linear back to patch channels.
+
+Module names follow the BFL checkpoint graph (double_blocks.N.img_attn.*)
+so conversion is mechanical (models/conversion.py convert_flux).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+
+@dataclasses.dataclass(frozen=True)
+class FluxConfig:
+    in_channels: int = 64  # 16 latent channels x 2x2 patch
+    hidden_size: int = 3072
+    num_heads: int = 24
+    depth_double: int = 19
+    depth_single: int = 38
+    mlp_ratio: float = 4.0
+    context_dim: int = 4096  # T5-XXL d_model
+    pooled_dim: int = 768  # CLIP-L pooled
+    guidance_embed: bool = True  # flux-dev distilled guidance; schnell: False
+    axes_dims_rope: tuple[int, ...] = (16, 56, 56)
+    theta: int = 10_000
+
+    @property
+    def head_dim(self) -> int:
+        return self.hidden_size // self.num_heads
+
+
+TINY_FLUX = FluxConfig(
+    in_channels=16,  # 4 latent channels x 2x2 patch (tiny VAE)
+    hidden_size=32,
+    num_heads=2,
+    depth_double=1,
+    depth_single=1,
+    context_dim=32,
+    pooled_dim=32,
+    guidance_embed=True,
+    axes_dims_rope=(4, 6, 6),
+)
+
+
+def timestep_embedding(t, dim: int, max_period: float = 10_000.0,
+                       time_factor: float = 1000.0):
+    """Sinusoidal features of (scaled) flow time t in [0, 1] -> [B, dim]."""
+    t = t * time_factor
+    half = dim // 2
+    freqs = jnp.exp(
+        -math.log(max_period) * jnp.arange(half, dtype=jnp.float32) / half
+    )
+    args = t[:, None].astype(jnp.float32) * freqs[None]
+    return jnp.concatenate([jnp.cos(args), jnp.sin(args)], axis=-1)
+
+
+def rope_frequencies(ids, axes_dims: tuple[int, ...], theta: int):
+    """[B, S, n_axes] integer positions -> complex-as-pair rotations
+    [B, S, head_dim/2, 2] laid out axis-by-axis (Flux 3D RoPE)."""
+    components = []
+    for axis, dim in enumerate(axes_dims):
+        pos = ids[..., axis].astype(jnp.float32)  # [B, S]
+        scale = jnp.arange(0, dim, 2, dtype=jnp.float32) / dim
+        omega = 1.0 / (theta**scale)  # [dim/2]
+        angles = pos[..., None] * omega  # [B, S, dim/2]
+        components.append(angles)
+    angles = jnp.concatenate(components, axis=-1)  # [B, S, head_dim/2]
+    return jnp.cos(angles), jnp.sin(angles)
+
+
+def apply_rope(x, cos, sin):
+    """x [B, S, H, D] with rotation pairs on the last dim."""
+    x1 = x[..., 0::2]
+    x2 = x[..., 1::2]
+    cos = cos[:, :, None, :]
+    sin = sin[:, :, None, :]
+    out1 = x1 * cos - x2 * sin
+    out2 = x1 * sin + x2 * cos
+    return jnp.stack([out1, out2], axis=-1).reshape(x.shape)
+
+
+class QKNorm(nn.Module):
+    """Per-head RMS normalization of q and k (Flux stabilization)."""
+
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, q, k):
+        def rms(x, name):
+            scale = self.param(name, nn.initializers.ones, (x.shape[-1],))
+            var = jnp.mean(
+                jnp.square(x.astype(jnp.float32)), axis=-1, keepdims=True
+            )
+            return ((x * (var + 1e-6) ** -0.5) * scale).astype(self.dtype)
+
+        return rms(q, "query_scale"), rms(k, "key_scale")
+
+
+class MLPEmbedder(nn.Module):
+    hidden: int
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x):
+        x = nn.Dense(self.hidden, dtype=self.dtype, name="in_layer")(x)
+        x = nn.silu(x)
+        return nn.Dense(self.hidden, dtype=self.dtype, name="out_layer")(x)
+
+
+class Modulation(nn.Module):
+    """vec -> (shift, scale, gate) x n chunks."""
+
+    hidden: int
+    n: int
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, vec):
+        out = nn.Dense(self.n * self.hidden, dtype=self.dtype, name="lin")(
+            nn.silu(vec)
+        )
+        return jnp.split(out[:, None, :], self.n, axis=-1)
+
+
+def _attention(q, k, v, cos, sin):
+    """Joint attention with RoPE; [B, S, H, D] -> [B, S, H*D]."""
+    q = apply_rope(q, cos, sin)
+    k = apply_rope(k, cos, sin)
+    from ..ops import dot_product_attention
+
+    out = dot_product_attention(q, k, v)
+    b, s, h, d = out.shape
+    return out.reshape(b, s, h * d)
+
+
+class DoubleStreamBlock(nn.Module):
+    config: FluxConfig
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, img, txt, vec, cos, sin):
+        cfg = self.config
+        h, hd = cfg.num_heads, cfg.head_dim
+        mlp_dim = int(cfg.hidden_size * cfg.mlp_ratio)
+
+        def stream(name):
+            mod = Modulation(cfg.hidden_size, 6, dtype=self.dtype,
+                             name=f"{name}_mod")
+            return mod
+
+        img_mod = stream("img")(vec)
+        txt_mod = stream("txt")(vec)
+
+        def norm(x):
+            return nn.LayerNorm(
+                use_bias=False, use_scale=False, epsilon=1e-6, dtype=self.dtype
+            )(x)
+
+        def qkv(x, name):
+            b, s, _ = x.shape
+            out = nn.Dense(3 * h * hd, dtype=self.dtype, name=f"{name}_attn_qkv")(x)
+            q, k, v = jnp.split(out.reshape(b, s, 3, h, hd), 3, axis=2)
+            q, k, v = (t[:, :, 0] for t in (q, k, v))
+            q, k = QKNorm(dtype=self.dtype, name=f"{name}_attn_norm")(q, k)
+            return q, k, v
+
+        # modulated pre-norm + qkv per stream
+        img_n = norm(img) * (1 + img_mod[1]) + img_mod[0]
+        txt_n = norm(txt) * (1 + txt_mod[1]) + txt_mod[0]
+        iq, ik, iv = qkv(img_n, "img")
+        tq, tk, tv = qkv(txt_n, "txt")
+
+        # joint attention: text tokens first (matches ids layout)
+        q = jnp.concatenate([tq, iq], axis=1)
+        k = jnp.concatenate([tk, ik], axis=1)
+        v = jnp.concatenate([tv, iv], axis=1)
+        attn = _attention(q, k, v, cos, sin)
+        txt_len = txt.shape[1]
+        txt_attn, img_attn = attn[:, :txt_len], attn[:, txt_len:]
+
+        img = img + img_mod[2] * nn.Dense(
+            cfg.hidden_size, dtype=self.dtype, name="img_attn_proj"
+        )(img_attn)
+        txt = txt + txt_mod[2] * nn.Dense(
+            cfg.hidden_size, dtype=self.dtype, name="txt_attn_proj"
+        )(txt_attn)
+
+        def mlp(x, mod_shift, mod_scale, mod_gate, name):
+            y = norm(x) * (1 + mod_scale) + mod_shift
+            y = nn.Dense(mlp_dim, dtype=self.dtype, name=f"{name}_mlp_0")(y)
+            y = nn.gelu(y, approximate=True)
+            y = nn.Dense(cfg.hidden_size, dtype=self.dtype, name=f"{name}_mlp_2")(y)
+            return x + mod_gate * y
+
+        img = mlp(img, img_mod[3], img_mod[4], img_mod[5], "img")
+        txt = mlp(txt, txt_mod[3], txt_mod[4], txt_mod[5], "txt")
+        return img, txt
+
+
+class SingleStreamBlock(nn.Module):
+    config: FluxConfig
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, vec, cos, sin):
+        cfg = self.config
+        h, hd = cfg.num_heads, cfg.head_dim
+        mlp_dim = int(cfg.hidden_size * cfg.mlp_ratio)
+        shift, scale, gate = Modulation(
+            cfg.hidden_size, 3, dtype=self.dtype, name="modulation"
+        )(vec)
+        y = nn.LayerNorm(
+            use_bias=False, use_scale=False, epsilon=1e-6, dtype=self.dtype
+        )(x)
+        y = y * (1 + scale) + shift
+        b, s, _ = y.shape
+        fused = nn.Dense(
+            3 * h * hd + mlp_dim, dtype=self.dtype, name="linear1"
+        )(y)
+        qkv_part, mlp_part = jnp.split(fused, [3 * h * hd], axis=-1)
+        q, k, v = jnp.split(qkv_part.reshape(b, s, 3, h, hd), 3, axis=2)
+        q, k, v = (t[:, :, 0] for t in (q, k, v))
+        q, k = QKNorm(dtype=self.dtype, name="norm")(q, k)
+        attn = _attention(q, k, v, cos, sin)
+        out = nn.Dense(cfg.hidden_size, dtype=self.dtype, name="linear2")(
+            jnp.concatenate([attn, nn.gelu(mlp_part, approximate=True)], axis=-1)
+        )
+        return x + gate * out
+
+
+class FluxTransformer(nn.Module):
+    config: FluxConfig
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, img, img_ids, txt, txt_ids, timesteps, pooled,
+                 guidance=None):
+        """img [B, S_img, in_channels] patchified latents; txt [B, S_txt,
+        context_dim]; ids [B, S, 3]; -> [B, S_img, in_channels]."""
+        cfg = self.config
+        img = nn.Dense(cfg.hidden_size, dtype=self.dtype, name="img_in")(img)
+        txt = nn.Dense(cfg.hidden_size, dtype=self.dtype, name="txt_in")(txt)
+
+        vec = MLPEmbedder(cfg.hidden_size, dtype=self.dtype, name="time_in")(
+            timestep_embedding(timesteps, 256).astype(self.dtype)
+        )
+        if cfg.guidance_embed:
+            g = guidance if guidance is not None else jnp.ones_like(timesteps)
+            vec = vec + MLPEmbedder(
+                cfg.hidden_size, dtype=self.dtype, name="guidance_in"
+            )(timestep_embedding(g, 256).astype(self.dtype))
+        vec = vec + MLPEmbedder(
+            cfg.hidden_size, dtype=self.dtype, name="vector_in"
+        )(pooled.astype(self.dtype))
+
+        ids = jnp.concatenate([txt_ids, img_ids], axis=1)
+        cos, sin = rope_frequencies(ids, cfg.axes_dims_rope, cfg.theta)
+        cos = cos.astype(self.dtype)
+        sin = sin.astype(self.dtype)
+
+        for i in range(cfg.depth_double):
+            img, txt = DoubleStreamBlock(
+                cfg, dtype=self.dtype, name=f"double_blocks_{i}"
+            )(img, txt, vec, cos, sin)
+
+        x = jnp.concatenate([txt, img], axis=1)
+        for i in range(cfg.depth_single):
+            x = SingleStreamBlock(
+                cfg, dtype=self.dtype, name=f"single_blocks_{i}"
+            )(x, vec, cos, sin)
+        x = x[:, txt.shape[1]:]
+
+        shift, scale = jnp.split(
+            nn.Dense(2 * cfg.hidden_size, dtype=self.dtype,
+                     name="final_layer_mod")(nn.silu(vec))[:, None, :],
+            2, axis=-1,
+        )
+        x = nn.LayerNorm(
+            use_bias=False, use_scale=False, epsilon=1e-6, dtype=self.dtype
+        )(x)
+        x = x * (1 + scale) + shift
+        return nn.Dense(
+            cfg.in_channels, dtype=self.dtype, name="final_layer_linear"
+        )(x)
+
+
+def patchify(latents):
+    """[B, H, W, C] -> ([B, H/2*W/2, 4C], ids [B, S, 3])."""
+    b, h, w, c = latents.shape
+    x = latents.reshape(b, h // 2, 2, w // 2, 2, c)
+    x = x.transpose(0, 1, 3, 2, 4, 5).reshape(b, (h // 2) * (w // 2), 4 * c)
+    ys, xs = jnp.meshgrid(
+        jnp.arange(h // 2), jnp.arange(w // 2), indexing="ij"
+    )
+    ids = jnp.stack(
+        [jnp.zeros_like(ys), ys, xs], axis=-1
+    ).reshape(1, -1, 3)
+    return x, jnp.broadcast_to(ids, (b, ids.shape[1], 3)).astype(jnp.int32)
+
+
+def unpatchify(x, h: int, w: int):
+    """[B, H/2*W/2, 4C] -> [B, H, W, C]."""
+    b, s, c4 = x.shape
+    c = c4 // 4
+    x = x.reshape(b, h // 2, w // 2, 2, 2, c)
+    x = x.transpose(0, 1, 3, 2, 4, 5).reshape(b, h, w, c)
+    return x

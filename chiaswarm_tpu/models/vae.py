@@ -23,7 +23,10 @@ class VAEConfig:
     latent_channels: int = 4
     block_out_channels: tuple[int, ...] = (128, 256, 512, 512)
     layers_per_block: int = 2
-    scaling_factor: float = 0.18215  # 0.13025 for SDXL
+    scaling_factor: float = 0.18215  # 0.13025 for SDXL, 0.3611 for Flux
+    shift_factor: float = 0.0  # Flux: 0.1159 (latents are shifted, then scaled)
+    # Flux VAE checkpoints ship without the 1x1 (post_)quant convs
+    use_quant_conv: bool = True
 
 
 class VAEAttention(nn.Module):
@@ -128,15 +131,19 @@ class AutoencoderKL(nn.Module):
     def setup(self):
         self.encoder = Encoder(self.config, dtype=self.dtype)
         self.decoder = Decoder(self.config, dtype=self.dtype)
-        self.quant_conv = nn.Conv(
-            2 * self.config.latent_channels, (1, 1), dtype=self.dtype
-        )
-        self.post_quant_conv = nn.Conv(
-            self.config.latent_channels, (1, 1), dtype=self.dtype
-        )
+        if self.config.use_quant_conv:
+            self.quant_conv = nn.Conv(
+                2 * self.config.latent_channels, (1, 1), dtype=self.dtype
+            )
+            self.post_quant_conv = nn.Conv(
+                self.config.latent_channels, (1, 1), dtype=self.dtype
+            )
+        else:  # Flux layout: encoder/decoder connect directly to the latents
+            self.quant_conv = lambda x: x
+            self.post_quant_conv = lambda x: x
 
     def encode(self, pixels, rng=None):
-        """pixels [B,H,W,3] in [-1,1] -> scaled latents [B,H/8,W/8,4]."""
+        """pixels [B,H,W,3] in [-1,1] -> scaled latents [B,H/8,W/8,C]."""
         moments = self.quant_conv(self.encoder(pixels))
         mean, logvar = jnp.split(moments, 2, axis=-1)
         if rng is not None:
@@ -144,11 +151,11 @@ class AutoencoderKL(nn.Module):
 
             std = jnp.exp(0.5 * jnp.clip(logvar, -30.0, 20.0))
             mean = mean + std * jax.random.normal(rng, mean.shape, mean.dtype)
-        return mean * self.config.scaling_factor
+        return (mean - self.config.shift_factor) * self.config.scaling_factor
 
     def decode(self, latents):
         """scaled latents -> pixels [B,H,W,3] in [-1,1]."""
-        latents = latents / self.config.scaling_factor
+        latents = latents / self.config.scaling_factor + self.config.shift_factor
         return self.decoder(self.post_quant_conv(latents))
 
     def __call__(self, pixels):

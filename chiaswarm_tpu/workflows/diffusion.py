@@ -22,8 +22,13 @@ def diffusion_callback(device_identifier: str, model_name: str, **kwargs):
         # random-weight stand-in of the requested architecture family
         from ..models.configs import model_family
 
-        if "pix2pix" in model_name.lower() or "ip2p" in model_name.lower():
+        name = model_name.lower()
+        if "pix2pix" in name or "ip2p" in name:
             model_name = "test/tiny-pix2pix"  # keep the 8-channel edit arch
+        elif "flux" in name:
+            model_name = (
+                "test/tiny-flux-schnell" if "schnell" in name else "test/tiny-flux"
+            )
         elif "xl" in model_family(model_name):
             model_name = "test/tiny-xl"
         else:
