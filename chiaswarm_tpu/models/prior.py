@@ -1,0 +1,109 @@
+"""Diffusion prior transformer: text -> CLIP image embedding (Kandinsky 2.x).
+
+Reference behavior replaced: KandinskyV22PriorPipeline loaded fresh per job
+and run before the main pipeline (swarm/diffusion/pipeline_steps.py:7-38,
+including the split-embeds mode where `prior_timesteps` rides the job). The
+prior denoises in CLIP *embedding* space: a transformer over
+[text tokens | text embed | timestep | noisy image embed | learned query]
+predicts the clean image embedding each step.
+
+This is an original flax formulation (the reference imported diffusers'
+PriorTransformer); tiny configs exercise the same graph hermetically.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from .flux import timestep_embedding
+
+
+@dataclasses.dataclass(frozen=True)
+class PriorConfig:
+    embed_dim: int = 1280  # CLIP image-embedding width (ViT-bigG)
+    hidden_size: int = 2048
+    num_layers: int = 10
+    num_heads: int = 32
+    text_seq: int = 77
+    text_dim: int = 1280  # text-encoder hidden width
+
+
+TINY_PRIOR = PriorConfig(
+    embed_dim=32, hidden_size=64, num_layers=2, num_heads=4, text_seq=77,
+    text_dim=32,
+)
+
+
+class PriorBlock(nn.Module):
+    config: PriorConfig
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x):
+        cfg = self.config
+        h = cfg.num_heads
+        hd = cfg.hidden_size // h
+        b, s, _ = x.shape
+        y = nn.LayerNorm(dtype=self.dtype, name="norm1")(x)
+        qkv = nn.Dense(3 * cfg.hidden_size, dtype=self.dtype, name="qkv")(y)
+        q, k, v = jnp.split(qkv.reshape(b, s, 3, h, hd), 3, axis=2)
+        q, k, v = (t[:, :, 0] for t in (q, k, v))
+        from ..ops import dot_product_attention
+
+        attn = dot_product_attention(q, k, v).reshape(b, s, cfg.hidden_size)
+        x = x + nn.Dense(cfg.hidden_size, dtype=self.dtype, name="proj")(attn)
+        y = nn.LayerNorm(dtype=self.dtype, name="norm2")(x)
+        y = nn.Dense(4 * cfg.hidden_size, dtype=self.dtype, name="fc1")(y)
+        y = nn.gelu(y, approximate=True)
+        return x + nn.Dense(cfg.hidden_size, dtype=self.dtype, name="fc2")(y)
+
+
+class DiffusionPrior(nn.Module):
+    config: PriorConfig
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, noisy_embed, timesteps, text_hiddens, text_embed):
+        """noisy_embed [B, E], timesteps [B], text_hiddens [B, S, Dt],
+        text_embed [B, Dt] -> predicted clean image embed [B, E]."""
+        cfg = self.config
+        b = noisy_embed.shape[0]
+        tokens = [
+            nn.Dense(cfg.hidden_size, dtype=self.dtype, name="text_proj")(
+                text_hiddens.astype(self.dtype)
+            ),
+            nn.Dense(cfg.hidden_size, dtype=self.dtype, name="embed_proj")(
+                text_embed.astype(self.dtype)
+            )[:, None],
+            nn.Dense(cfg.hidden_size, dtype=self.dtype, name="time_proj")(
+                timestep_embedding(timesteps, 256, time_factor=1.0).astype(
+                    self.dtype
+                )
+            )[:, None],
+            nn.Dense(cfg.hidden_size, dtype=self.dtype, name="sample_proj")(
+                noisy_embed.astype(self.dtype)
+            )[:, None],
+            jnp.broadcast_to(
+                self.param(
+                    "query_embedding", nn.initializers.normal(0.02),
+                    (1, 1, cfg.hidden_size),
+                ).astype(self.dtype),
+                (b, 1, cfg.hidden_size),
+            ),
+        ]
+        x = jnp.concatenate(tokens, axis=1)
+        pos = self.param(
+            "positional_embedding", nn.initializers.normal(0.02),
+            (1, cfg.text_seq + 4, cfg.hidden_size),
+        ).astype(self.dtype)
+        x = x + pos
+        for i in range(cfg.num_layers):
+            x = PriorBlock(cfg, dtype=self.dtype, name=f"blocks_{i}")(x)
+        x = nn.LayerNorm(dtype=self.dtype, name="norm_out")(x)
+        # the learned query token carries the prediction
+        return nn.Dense(cfg.embed_dim, dtype=self.dtype, name="to_embed")(
+            x[:, -1]
+        )

@@ -1,0 +1,466 @@
+"""Kandinsky 2.x two-stage cascade: diffusion prior -> image-embed decoder.
+
+Reference behavior replaced: swarm/diffusion/pipeline_steps.py:7-38 runs
+KandinskyV22PriorPipeline per job (fresh `from_pretrained`) to turn the
+prompt into CLIP image embeddings — including the split-embeds mode where
+`pipeline_prior_type`/`prior_timesteps` ride the job parameters — then the
+main pipeline consumes `image_embeds`/`negative_image_embeds` kwargs.
+
+TPU redesign: both stages are resident jitted programs. The prior denoises
+in embedding space with a `lax.scan` (DDPM, sample-prediction, CFG as a
+batch of 2); the decoder is a standard latent-diffusion scan whose
+cross-attention context comes from the image embedding (projected into a
+short token sequence) instead of text. The decoder stays on this package's
+AutoencoderKL rather than MoVQ — real-weight conversion for this family is
+not wired yet, so non-test model names fail loudly per weights.py.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+import zlib
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from PIL import Image
+
+from ..models import configs as cfgs
+from ..models.clip import CLIPTextEncoder
+from ..models.prior import TINY_PRIOR, DiffusionPrior, PriorConfig
+from ..models.tokenizer import load_tokenizer
+from ..models.unet2d import UNet2DConditionModel, UNet2DConfig
+from ..models.vae import AutoencoderKL
+from ..parallel.mesh import make_mesh, replicated
+from ..registry import register_family
+from ..schedulers import get_scheduler
+from ..weights import require_weights_present
+
+logger = logging.getLogger(__name__)
+
+_NO_CONVERSION_HINT = (
+    "This worker cannot serve real Kandinsky weights yet; only test/tiny "
+    "Kandinsky models are available."
+)
+
+# image embedding -> this many cross-attention context tokens
+IMAGE_CONTEXT_TOKENS = 4
+
+
+def _is_tiny(name: str) -> bool:
+    return "tiny" in name.lower() or name.startswith("test/")
+
+
+def _prior_configs(model_name: str):
+    """(prior_cfg, clip_cfg)."""
+    if _is_tiny(model_name):
+        return TINY_PRIOR, cfgs.TINY_CLIP_2
+    # Kandinsky 2.2 rides the OpenCLIP ViT-bigG text tower (same one SDXL
+    # uses as encoder 2) and a 1280-wide embedding space
+    return PriorConfig(), cfgs.SDXL_CLIP_2
+
+
+# decoder UNet geometry (K2.2-like; conversion lands in a later round)
+K22_UNET = UNet2DConfig(
+    block_out_channels=(384, 768, 1152, 1536),
+    transformer_layers=(1, 1, 1, 1),
+    num_attention_heads=(6, 12, 18, 24),
+    cross_attention_dim=1280,
+)
+
+
+def _decoder_configs(model_name: str):
+    """(unet_cfg, vae_cfg, embed_dim, default_size)."""
+    if _is_tiny(model_name):
+        return cfgs.TINY_UNET, cfgs.TINY_VAE, TINY_PRIOR.embed_dim, 64
+    return K22_UNET, cfgs.SD_VAE, PriorConfig().embed_dim, 512
+
+
+def _prior_name_for(decoder_name: str) -> str:
+    if _is_tiny(decoder_name):
+        return "test/tiny-kandinsky-prior"
+    if "decoder" in decoder_name:
+        return decoder_name.replace("decoder", "prior")
+    return "kandinsky-community/kandinsky-2-2-prior"
+
+
+class KandinskyPriorPipeline:
+    """Resident prior stage; produces (image_embeds, negative_image_embeds).
+
+    Not a standalone image job — the hive schedules the decoder and the
+    prior runs as its prepipeline (reference pipeline_steps.py semantics).
+    """
+
+    def __init__(self, model_name: str, chipset=None,
+                 allow_random_init: bool = False):
+        require_weights_present(
+            model_name, None, allow_random_init, component="Kandinsky prior",
+            hint=_NO_CONVERSION_HINT,
+        )
+        self.model_name = model_name
+        self.chipset = chipset
+        self.config, clip_cfg = _prior_configs(model_name)
+        on_tpu = jax.default_backend() == "tpu"
+        self.dtype = jnp.bfloat16 if on_tpu else jnp.float32
+        self.prior = DiffusionPrior(self.config, dtype=self.dtype)
+        self.text_encoder = CLIPTextEncoder(clip_cfg, dtype=self.dtype)
+        self.tokenizer = load_tokenizer(None, vocab_size=clip_cfg.vocab_size)
+        self.mesh = (
+            chipset.mesh() if chipset is not None else make_mesh(jax.devices()[:1])
+        )
+
+        rng = jax.random.key(zlib.crc32(model_name.encode()))
+        k1, k2 = jax.random.split(rng)
+        cfg = self.config
+        with jax.default_device(jax.local_devices(backend="cpu")[0]):
+            prior_params = self.prior.init(
+                k1,
+                jnp.zeros((1, cfg.embed_dim)),
+                jnp.zeros((1,)),
+                jnp.zeros((1, cfg.text_seq, cfg.text_dim)),
+                jnp.zeros((1, cfg.text_dim)),
+            )["params"]
+            text_params = self.text_encoder.init(
+                k2, jnp.zeros((1, 77), jnp.int32)
+            )["params"]
+        cast = lambda x: jnp.asarray(x, self.dtype)
+        self.params = jax.device_put(
+            jax.tree_util.tree_map(
+                cast, {"prior": prior_params, "text": text_params}
+            ),
+            replicated(self.mesh),
+        )
+        self._programs: dict[tuple, callable] = {}
+        self._lock = threading.Lock()
+
+    def release(self):
+        self.params = None
+        self._programs.clear()
+
+    def run(self, *args, **kwargs):
+        """Prior-typed jobs are not standalone image jobs — job-level error
+        (the hive should schedule the decoder; the prior runs inside it)."""
+        raise Exception(
+            f"{self.model_name} is a prior prepipeline stage; schedule the "
+            f"Kandinsky decoder model instead (the prior runs automatically)."
+        )
+
+    def _program(self, steps: int, guided: bool):
+        key = (steps, guided)
+        with self._lock:
+            if key in self._programs:
+                return self._programs[key]
+        scheduler = get_scheduler("DDPMScheduler", prediction_type="sample")
+        schedule = scheduler.schedule(steps)
+        prior = self.prior
+        cfg = self.config
+
+        def run(params, rng, text_hiddens, text_embed, guidance):
+            """guided: rows [uncond | cond] stacked on batch (CFG 2N);
+            unguided: plain N rows (the zero-prompt negative pass)."""
+            rows = 2 if guided else 1
+            b = text_embed.shape[0] // rows
+            latents = jax.random.normal(rng, (b, cfg.embed_dim), jnp.float32)
+            latents = latents * jnp.asarray(
+                schedule.init_noise_sigma, jnp.float32
+            )
+            state = scheduler.init_state(latents.shape, latents.dtype)
+
+            def body(carry, i):
+                latents, state = carry
+                t = jnp.asarray(schedule.timesteps)[i]
+                model_in = (
+                    jnp.concatenate([latents, latents], axis=0)
+                    if guided
+                    else latents
+                )
+                pred = prior.apply(
+                    {"params": params["prior"]},
+                    model_in.astype(prior.dtype),
+                    jnp.broadcast_to(t, (rows * b,)),
+                    text_hiddens,
+                    text_embed,
+                ).astype(jnp.float32)
+                if guided:
+                    pred_u, pred_c = jnp.split(pred, 2, axis=0)
+                    pred = pred_u + guidance * (pred_c - pred_u)
+                noise = jax.random.normal(
+                    jax.random.fold_in(rng, i), latents.shape, jnp.float32
+                )
+                state, latents = scheduler.step(
+                    schedule, state, i, latents, pred, noise
+                )
+                return (latents, state), ()
+
+            (latents, _), _ = jax.lax.scan(
+                body, (latents, state), jnp.arange(steps)
+            )
+            return latents
+
+        program = jax.jit(run)
+        with self._lock:
+            self._programs[key] = program
+        return program
+
+    def generate(self, prompt: str, negative_prompt: str = "",
+                 num_images: int = 1, steps: int = 25,
+                 guidance_scale: float = 4.0, rng=None):
+        """-> (image_embeds [N, E], negative_image_embeds [N, E])."""
+        params = self.params
+        if params is None:
+            raise Exception(f"prior {self.model_name} was evicted; resubmit")
+        if rng is None:
+            rng = jax.random.key(0)
+        texts = [negative_prompt] * num_images + [prompt] * num_images
+        ids = jnp.asarray(self.tokenizer(texts))
+        out = self.text_encoder.apply({"params": params["text"]}, ids)
+        embeds = self._program(steps, guided=True)(
+            params, rng, out["hidden_states"], out["pooled"],
+            jnp.float32(guidance_scale),
+        )
+        # the reference's negative embeds come from the zero prompt — a
+        # plain unguided N-row run (no CFG doubling to collapse)
+        zero_out = self.text_encoder.apply(
+            {"params": params["text"]},
+            jnp.asarray(self.tokenizer([""] * num_images)),
+        )
+        negative = self._program(steps, guided=False)(
+            params, jax.random.fold_in(rng, 1), zero_out["hidden_states"],
+            zero_out["pooled"], jnp.float32(1.0),
+        )
+        return embeds, negative
+
+
+class _ImageContext:
+    """Image embedding -> cross-attention token sequence (pipeline-owned
+    projection params, initialized deterministically per model)."""
+
+    def __init__(self, embed_dim: int, cross_dim: int, dtype, seed: int):
+        import flax.linen as nn
+
+        class Proj(nn.Module):
+            @nn.compact
+            def __call__(self, e):
+                x = nn.Dense(
+                    IMAGE_CONTEXT_TOKENS * cross_dim, dtype=dtype, name="proj"
+                )(e)
+                return x.reshape(e.shape[0], IMAGE_CONTEXT_TOKENS, cross_dim)
+
+        self.module = Proj()
+        self.params = self.module.init(
+            jax.random.key(seed), jnp.zeros((1, embed_dim))
+        )["params"]
+
+    def __call__(self, params, embeds):
+        return self.module.apply({"params": params}, embeds)
+
+
+class KandinskyPipeline:
+    """Resident decoder stage serving KandinskyV22Pipeline wire names; runs
+    the prior prepipeline internally when a job arrives with a prompt."""
+
+    def __init__(self, model_name: str, chipset=None,
+                 allow_random_init: bool = False):
+        require_weights_present(
+            model_name, None, allow_random_init, component="Kandinsky decoder",
+            hint=_NO_CONVERSION_HINT,
+        )
+        self.model_name = model_name
+        self.chipset = chipset
+        unet_cfg, vae_cfg, self.embed_dim, self.default_size = _decoder_configs(
+            model_name
+        )
+        on_tpu = jax.default_backend() == "tpu"
+        self.dtype = jnp.bfloat16 if on_tpu else jnp.float32
+        self.unet = UNet2DConditionModel(unet_cfg, dtype=self.dtype)
+        self.vae = AutoencoderKL(vae_cfg, dtype=self.dtype)
+        self.latent_factor = 2 ** (len(vae_cfg.block_out_channels) - 1)
+        self.mesh = (
+            chipset.mesh() if chipset is not None else make_mesh(jax.devices()[:1])
+        )
+
+        seed = zlib.crc32(model_name.encode())
+        k1, k2 = jax.random.split(jax.random.key(seed))
+        n_down = len(unet_cfg.block_out_channels) - 1
+        hw = 2 ** max(n_down, 2)
+        with jax.default_device(jax.local_devices(backend="cpu")[0]):
+            unet_params = self.unet.init(
+                k1,
+                jnp.zeros((1, hw, hw, unet_cfg.in_channels)),
+                jnp.zeros((1,)),
+                jnp.zeros((1, IMAGE_CONTEXT_TOKENS, unet_cfg.cross_attention_dim)),
+            )["params"]
+            vae_params = self.vae.init(
+                k2,
+                jnp.zeros(
+                    (1, hw * self.latent_factor, hw * self.latent_factor, 3)
+                ),
+            )["params"]
+        self.image_ctx = _ImageContext(
+            self.embed_dim, unet_cfg.cross_attention_dim, self.dtype, seed + 1
+        )
+        cast = lambda x: jnp.asarray(x, self.dtype)
+        self.params = jax.device_put(
+            jax.tree_util.tree_map(cast, {
+                "unet": unet_params,
+                "vae": vae_params,
+                "ctx": self.image_ctx.params,
+            }),
+            replicated(self.mesh),
+        )
+        self.image_ctx.params = None  # device copy in self.params is canonical
+        self._programs: dict[tuple, callable] = {}
+        self._lock = threading.Lock()
+
+    def release(self):
+        self.params = None
+        self._programs.clear()
+
+    def _program(self, key: tuple):
+        with self._lock:
+            if key in self._programs:
+                return self._programs[key]
+        lh, lw, batch, steps, sched_name = key
+        scheduler = get_scheduler(sched_name)
+        schedule = scheduler.schedule(steps)
+        unet = self.unet
+        vae = self.vae
+        image_ctx = self.image_ctx
+        latent_c = unet.config.in_channels
+
+        def run(params, rng, embeds, neg_embeds, guidance):
+            context = image_ctx(
+                params["ctx"],
+                jnp.concatenate([neg_embeds, embeds], axis=0).astype(self.dtype),
+            )
+            latents = jax.random.normal(
+                rng, (batch, lh, lw, latent_c), jnp.float32
+            ) * jnp.asarray(schedule.init_noise_sigma, jnp.float32)
+            state = scheduler.init_state(latents.shape, latents.dtype)
+
+            def body(carry, i):
+                latents, state = carry
+                inp = scheduler.scale_model_input(schedule, latents, i)
+                model_in = jnp.concatenate([inp, inp], axis=0).astype(self.dtype)
+                t = jnp.asarray(schedule.timesteps)[i]
+                out = unet.apply(
+                    {"params": params["unet"]},
+                    model_in,
+                    jnp.broadcast_to(t, (2 * batch,)),
+                    context,
+                ).astype(jnp.float32)
+                out_u, out_c = jnp.split(out, 2, axis=0)
+                out = out_u + guidance * (out_c - out_u)
+                noise = jax.random.normal(
+                    jax.random.fold_in(rng, i), latents.shape, jnp.float32
+                )
+                state, latents = scheduler.step(
+                    schedule, state, i, latents, out, noise
+                )
+                return (latents, state), ()
+
+            (latents, _), _ = jax.lax.scan(
+                body, (latents, state), jnp.arange(steps)
+            )
+            pixels = vae.apply(
+                {"params": params["vae"]}, latents.astype(self.dtype),
+                method=vae.decode,
+            )
+            return (
+                (pixels.astype(jnp.float32) + 1.0) * 127.5
+            ).clip(0.0, 255.0).round().astype(jnp.uint8)
+
+        program = jax.jit(run)
+        with self._lock:
+            self._programs[key] = program
+        return program
+
+    def run(self, prompt="", negative_prompt="",
+            pipeline_type="KandinskyV22Pipeline", **kwargs):
+        params = self.params
+        if params is None:
+            raise Exception(
+                f"pipeline {self.model_name} was evicted; resubmit the job"
+            )
+        if "Controlnet" in pipeline_type or "hint" in kwargs:
+            # silently ignoring the depth hint would return an unconditioned
+            # image as a "successful" controlnet job
+            raise Exception(
+                "Kandinsky ControlNet (depth hint) is not supported on this "
+                "worker yet."
+            )
+        timings: dict[str, float] = {}
+        steps = int(kwargs.pop("num_inference_steps", 30))
+        guidance_scale = float(kwargs.pop("guidance_scale", 4.0))
+        n_images = int(kwargs.pop("num_images_per_prompt", 1))
+        scheduler_type = kwargs.pop("scheduler_type", "DDPMScheduler")
+        prior_steps = int(kwargs.pop("prior_timesteps", None) or 25)
+        kwargs.pop("pipeline_prior_type", None)
+        rng = kwargs.pop("rng", None)
+        if rng is None:
+            rng = jax.random.key(0)
+        chipset = kwargs.pop("chipset", None)
+
+        height = int(kwargs.pop("height", None) or self.default_size)
+        width = int(kwargs.pop("width", None) or self.default_size)
+        height, width = (max(64, (d // 64) * 64) for d in (height, width))
+        lh, lw = height // self.latent_factor, width // self.latent_factor
+
+        embeds = kwargs.pop("image_embeds", None)
+        neg_embeds = kwargs.pop("negative_image_embeds", None)
+        rng, prior_rng, dec_rng = jax.random.split(rng, 3)
+        if embeds is None:
+            # prepipeline stage (reference pipeline_steps.py:7-38)
+            from ..registry import get_pipeline
+
+            t0 = time.perf_counter()
+            prior = get_pipeline(
+                _prior_name_for(self.model_name),
+                pipeline_type="KandinskyV22PriorPipeline",
+                chipset=chipset,
+            )
+            embeds, neg_embeds = prior.generate(
+                prompt, negative_prompt, num_images=n_images,
+                steps=prior_steps, rng=prior_rng,
+            )
+            timings["prior_s"] = round(time.perf_counter() - t0, 3)
+        embeds = jnp.asarray(embeds)
+        if neg_embeds is None:
+            neg_embeds = jnp.zeros_like(embeds)
+        neg_embeds = jnp.asarray(neg_embeds)
+        # split-embeds jobs deliver the batch via the embeds themselves
+        n_images = int(embeds.shape[0])
+
+        key = (lh, lw, n_images, steps, scheduler_type)
+        program = self._program(key)
+        t0 = time.perf_counter()
+        pixels = jax.block_until_ready(
+            program(params, dec_rng, embeds, neg_embeds,
+                    jnp.float32(guidance_scale))
+        )
+        timings["denoise_decode_s"] = round(time.perf_counter() - t0, 3)
+
+        images = [Image.fromarray(img) for img in np.asarray(pixels)]
+        pipeline_config = {
+            "model": self.model_name,
+            "pipeline": pipeline_type,
+            "scheduler": scheduler_type,
+            "mode": "txt2img",
+            "steps": steps,
+            "size": [width, height],
+            "guidance_scale": guidance_scale,
+            "timings": timings,
+        }
+        return images, pipeline_config
+
+
+@register_family("kandinsky")
+def _build_kandinsky(model_name, chipset, **variant):
+    return KandinskyPipeline(model_name, chipset, **variant)
+
+
+@register_family("kandinsky_prior")
+def _build_kandinsky_prior(model_name, chipset, **variant):
+    return KandinskyPriorPipeline(model_name, chipset, **variant)

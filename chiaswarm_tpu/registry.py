@@ -142,7 +142,8 @@ def _ensure_builtin_families() -> None:
     if _BUILTINS_LOADED:
         return
     _BUILTINS_LOADED = True
-    for module in ("stable_diffusion", "video", "audio", "captioning", "flux"):
+    for module in ("stable_diffusion", "video", "audio", "captioning", "flux",
+                   "kandinsky"):
         try:
             __import__(f"{__package__}.pipelines.{module}")
         except Exception as e:

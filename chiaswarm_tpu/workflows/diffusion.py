@@ -29,6 +29,11 @@ def diffusion_callback(device_identifier: str, model_name: str, **kwargs):
             model_name = (
                 "test/tiny-flux-schnell" if "schnell" in name else "test/tiny-flux"
             )
+        elif "kandinsky" in name:
+            model_name = (
+                "test/tiny-kandinsky-prior" if "prior" in name
+                else "test/tiny-kandinsky"
+            )
         elif "xl" in model_family(model_name):
             model_name = "test/tiny-xl"
         else:
