@@ -126,7 +126,29 @@ def verify_local_model(model_name: str, root: Path | None = None) -> dict | None
     root = root or model_root()
     if "blip" in name:
         return _verify_blip_model(model_name, root)
+    if "dpt" in name or "midas" in name:
+        return _verify_dpt_model(model_name, root)
     return _verify_sd_model(model_name, root)
+
+
+def _verify_dpt_model(model_name: str, root: Path) -> dict:
+    import jax.numpy as jnp
+
+    from .models.conversion import (
+        assert_tree_shapes_match,
+        convert_dpt,
+        load_torch_state_dict,
+    )
+    from .models.depth import TINY_DPT, DPTConfig, DPTDepthModel
+    from .weights import is_test_model
+
+    cfg = TINY_DPT if is_test_model(model_name) else DPTConfig()
+    converted = convert_dpt(load_torch_state_dict(root / model_name))
+    expected = _eval_shape_params(
+        DPTDepthModel(cfg), jnp.zeros((1, cfg.image_size, cfg.image_size, 3))
+    )
+    assert_tree_shapes_match(converted, expected, prefix="dpt")
+    return {"dpt": _param_count(converted)}
 
 
 def _verify_blip_model(model_name: str, root: Path) -> dict:

@@ -324,6 +324,165 @@ def convert_flux(state: dict) -> dict:
     return params
 
 
+def convert_dpt(state: dict) -> dict:
+    """transformers DPTForDepthEstimation names -> models/depth.py names.
+
+    Notable remaps: fusion_stage layer order is reversed (HF layer 0 fuses
+    the DEEPEST feature; this module indexes fusion_k by feature k), and
+    ConvTranspose weights are [in, out, kh, kw] (vs Conv's [out, in, ...]).
+    """
+    import re
+
+    params: dict = {}
+
+    def put(path: str, leaf: str, value):
+        _assign(params, path.split("/") + [leaf], np.ascontiguousarray(value))
+
+    def dense(path, leaf, v):
+        put(path, "kernel" if leaf == "weight" else "bias",
+            v.T if leaf == "weight" else v)
+
+    def conv(path, leaf, v):
+        put(path, "kernel" if leaf == "weight" else "bias",
+            v.transpose(2, 3, 1, 0) if leaf == "weight" else v)
+
+    def convT(path, leaf, v):
+        put(path, "kernel" if leaf == "weight" else "bias",
+            v.transpose(2, 3, 0, 1) if leaf == "weight" else v)
+
+    def norm(path, leaf, v):
+        put(path, "scale" if leaf == "weight" else "bias", v)
+
+    n_taps = 4
+    for name, v in state.items():
+        v = np.asarray(v)
+        base, leaf = name.rsplit(".", 1)
+        if name == "dpt.embeddings.cls_token":
+            params["cls_token"] = v
+        elif name == "dpt.embeddings.position_embeddings":
+            params["pos_embed"] = v
+        elif base == "dpt.embeddings.patch_embeddings.projection":
+            conv("patch_embed", leaf, v)
+        elif base.startswith("dpt.encoder.layer."):
+            m = re.match(r"dpt\.encoder\.layer\.(\d+)\.(.+)$", base)
+            i, sub = m.group(1), m.group(2)
+            blk = f"layer_{i}"
+            table = {
+                "attention.attention.query": (dense, f"{blk}/q"),
+                "attention.attention.key": (dense, f"{blk}/k"),
+                "attention.attention.value": (dense, f"{blk}/v"),
+                "attention.output.dense": (dense, f"{blk}/out"),
+                "intermediate.dense": (dense, f"{blk}/fc1"),
+                "output.dense": (dense, f"{blk}/fc2"),
+                "layernorm_before": (norm, f"{blk}/ln1"),
+                "layernorm_after": (norm, f"{blk}/ln2"),
+            }
+            if sub in table:
+                fn, path = table[sub]
+                fn(path, leaf, v)
+        elif base.startswith("neck.reassemble_stage.readout_projects."):
+            # stage-level ModuleList: readout_projects.{k}.0 is the Linear
+            m = re.match(
+                r"neck\.reassemble_stage\.readout_projects\.(\d+)\.0$", base
+            )
+            if m:
+                dense(f"reassemble_{m.group(1)}_readout", leaf, v)
+        elif base.startswith("neck.reassemble_stage.layers."):
+            m = re.match(
+                r"neck\.reassemble_stage\.layers\.(\d+)\.(.+)$", base
+            )
+            k, sub = m.group(1), m.group(2)
+            if sub == "projection":
+                conv(f"reassemble_{k}_project", leaf, v)
+            elif sub == "resize":
+                (convT if int(k) < 2 else conv)(
+                    f"reassemble_{k}_resize", leaf, v
+                )
+        elif base.startswith("neck.convs."):
+            k = base.rsplit(".", 1)[1]
+            conv(f"conv_{k}", leaf, v)
+        elif base.startswith("neck.fusion_stage.layers."):
+            m = re.match(
+                r"neck\.fusion_stage\.layers\.(\d+)\.(.+)$", base
+            )
+            j, sub = int(m.group(1)), m.group(2)
+            k = n_taps - 1 - j  # HF fuses deepest-first; we index by feature
+            table = {
+                "residual_layer1.convolution1": f"fusion_{k}_rcu1/conv1",
+                "residual_layer1.convolution2": f"fusion_{k}_rcu1/conv2",
+                "residual_layer2.convolution1": f"fusion_{k}_rcu2/conv1",
+                "residual_layer2.convolution2": f"fusion_{k}_rcu2/conv2",
+                "projection": f"fusion_{k}_project",
+            }
+            if sub in table:
+                conv(table[sub], leaf, v)
+        elif base.startswith("head.head."):
+            idx = base.rsplit(".", 1)[1]
+            conv({"0": "head_conv1", "2": "head_conv2", "4": "head_conv3"}[idx],
+                 leaf, v)
+    return params
+
+
+def convert_safety_checker(state: dict) -> dict:
+    """transformers StableDiffusionSafetyChecker -> models/safety.py names."""
+    import re
+
+    params: dict = {"vision": {}}
+
+    def put(tree, path, leaf, value):
+        node = tree
+        for p in path.split("/"):
+            if p:
+                node = node.setdefault(p, {})
+        node[leaf] = np.ascontiguousarray(value)
+
+    v_tree = params["vision"]
+    for name, t in state.items():
+        t = np.asarray(t)
+        if name in ("concept_embeds", "special_care_embeds",
+                    "concept_embeds_weights", "special_care_embeds_weights"):
+            params[name] = t
+            continue
+        if name == "visual_projection.weight":
+            put(v_tree, "projection", "kernel", t.T)
+            continue
+        prefix = "vision_model.vision_model."
+        if not name.startswith(prefix):
+            continue
+        n = name[len(prefix):]
+        if n == "embeddings.class_embedding":
+            v_tree["cls_embed"] = t
+        elif n == "embeddings.position_embedding.weight":
+            v_tree["pos_embed"] = t
+        elif n == "embeddings.patch_embedding.weight":
+            put(v_tree, "patch_embed", "kernel", t.transpose(2, 3, 1, 0))
+        elif n.startswith("pre_layrnorm."):  # (sic) HF's typo'd name
+            put(v_tree, "pre_ln", "scale" if n.endswith("weight") else "bias", t)
+        elif n.startswith("post_layernorm."):
+            put(v_tree, "post_ln", "scale" if n.endswith("weight") else "bias", t)
+        else:
+            m = re.match(r"encoder\.layers\.(\d+)\.(.+)\.(weight|bias)$", n)
+            if not m:
+                continue
+            i, sub, leaf = m.group(1), m.group(2), m.group(3)
+            blk = f"layer_{i}"
+            dense = {
+                "self_attn.q_proj": f"{blk}_q",
+                "self_attn.k_proj": f"{blk}_k",
+                "self_attn.v_proj": f"{blk}_v",
+                "self_attn.out_proj": f"{blk}_out",
+                "mlp.fc1": f"{blk}_fc1",
+                "mlp.fc2": f"{blk}_fc2",
+            }
+            norm = {"layer_norm1": f"{blk}_ln1", "layer_norm2": f"{blk}_ln2"}
+            if sub in dense:
+                put(v_tree, dense[sub], "kernel" if leaf == "weight" else "bias",
+                    t.T if leaf == "weight" else t)
+            elif sub in norm:
+                put(v_tree, norm[sub], "scale" if leaf == "weight" else "bias", t)
+    return params
+
+
 def convert_blip(state: dict) -> dict:
     """HF BlipForConditionalGeneration state dict -> {"vision","text"} trees
     matching models/blip.py. Two non-mechanical steps: the vision tower's

@@ -1,7 +1,115 @@
-"""Auxiliary perception models (depth, pose, segmentation) for preprocessors."""
+"""Auxiliary perception models (depth today; pose/segmentation to come).
+
+Reference behavior replaced: swarm/pre_processors/controlnet.py:94-119
+(transformers DPT pipeline for the `depth` preprocessor) and
+swarm/pre_processors/depth_estimator.py:8-24 (Kandinsky depth hint). TPU
+redesign: one resident flax DPT model, jitted per canvas bucket; weights
+convert from Intel/dpt-* checkpoints under the model root (weights.py
+policy: tiny/test names random-init, real names fail loudly when absent).
+"""
 
 from __future__ import annotations
 
+import logging
+import threading
+import zlib
+from pathlib import Path
 
-def estimate_depth(image):
-    raise Exception("depth estimation is not yet available on this worker.")
+import numpy as np
+
+logger = logging.getLogger(__name__)
+
+DEFAULT_DEPTH_MODEL = "Intel/dpt-large"
+# ImageNet normalization (DPT image processor)
+_MEAN = np.asarray([0.485, 0.456, 0.406], np.float32)
+_STD = np.asarray([0.229, 0.224, 0.225], np.float32)
+
+_DEPTH: dict[str, "DepthEstimator"] = {}
+_DEPTH_LOCK = threading.Lock()
+
+
+class DepthEstimator:
+    def __init__(self, model_name: str = DEFAULT_DEPTH_MODEL,
+                 allow_random_init: bool = False):
+        import jax
+        import jax.numpy as jnp
+
+        from ..models.depth import DPTConfig, DPTDepthModel, TINY_DPT
+        from ..settings import load_settings
+        from ..weights import is_test_model, require_weights_present
+
+        self.model_name = model_name
+        self.config = TINY_DPT if is_test_model(model_name) else DPTConfig()
+        on_tpu = jax.default_backend() == "tpu"
+        self.dtype = jnp.bfloat16 if on_tpu else jnp.float32
+        self.model = DPTDepthModel(self.config, dtype=self.dtype)
+
+        root = Path(load_settings().model_root_dir).expanduser()
+        model_dir = root / model_name
+        params = None
+        if model_dir.is_dir():
+            try:
+                from ..models.conversion import convert_dpt, load_torch_state_dict
+
+                params = convert_dpt(load_torch_state_dict(model_dir))
+            except FileNotFoundError:
+                params = None
+        if params is None:
+            require_weights_present(
+                model_name, model_dir if model_dir.is_dir() else None,
+                allow_random_init, component="depth model",
+            )
+            size = self.config.image_size
+            params = self.model.init(
+                jax.random.key(zlib.crc32(model_name.encode())),
+                jnp.zeros((1, size, size, 3)),
+            )["params"]
+        cast = lambda x: jnp.asarray(x, self.dtype)
+        self.params = jax.tree_util.tree_map(cast, params)
+        self._program = jax.jit(
+            lambda p, px: self.model.apply({"params": p}, px)
+        )
+
+    def __call__(self, image) -> np.ndarray:
+        """PIL -> inverse-depth map [H, W] float32 normalized to [0, 1]."""
+        import jax.numpy as jnp
+        from PIL import Image
+
+        size = self.config.image_size
+        original = image.size
+        rgb = image.convert("RGB").resize((size, size), Image.BICUBIC)
+        arr = (np.asarray(rgb, np.float32) / 255.0 - _MEAN) / _STD
+        depth = np.asarray(
+            self._program(self.params, jnp.asarray(arr[None], self.dtype)),
+            np.float32,
+        )[0]
+        lo, hi = float(depth.min()), float(depth.max())
+        depth = (depth - lo) / (hi - lo) if hi > lo else np.zeros_like(depth)
+        if original != (size, size):
+            depth = np.asarray(
+                Image.fromarray((depth * 255).astype(np.uint8)).resize(
+                    original, Image.BICUBIC
+                ),
+                np.float32,
+            ) / 255.0
+        return depth.astype(np.float32)
+
+
+def get_depth_estimator(model_name: str | None = None) -> DepthEstimator:
+    if model_name is None:
+        from ..settings import load_settings
+
+        model_name = load_settings().depth_model or DEFAULT_DEPTH_MODEL
+    # construction happens under the lock: a concurrent cold start would
+    # otherwise double-load and double-place the full DPT checkpoint
+    with _DEPTH_LOCK:
+        est = _DEPTH.get(model_name)
+        if est is None:
+            est = DepthEstimator(model_name)
+            _DEPTH[model_name] = est
+        return est
+
+
+def estimate_depth(image, model_name: str | None = None) -> np.ndarray:
+    """PIL image -> [H, W] float32 inverse depth in [0, 1]."""
+    return get_depth_estimator(model_name)(image)

@@ -1,0 +1,145 @@
+"""Resident NSFW safety checker feeding the result-envelope flag.
+
+Reference behavior replaced: diffusers' bundled safety checker whose
+output rides `nsfw_content_detected` into the envelope
+(swarm/worker.py:166). Policy here: the checker is *auxiliary* — when its
+weights aren't on the worker the job still serves (flag False,
+`nsfw_checked: false` recorded) rather than failing; tiny/test model
+names random-init for hermetic tests.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import zlib
+from pathlib import Path
+
+import numpy as np
+
+logger = logging.getLogger(__name__)
+
+DEFAULT_SAFETY_MODEL = "CompVis/stable-diffusion-safety-checker"
+# CLIP image normalization
+_MEAN = np.asarray([0.48145466, 0.4578275, 0.40821073], np.float32)
+_STD = np.asarray([0.26862954, 0.26130258, 0.27577711], np.float32)
+
+_CHECKER = None
+_CHECKER_NAME = None
+_LOCK = threading.Lock()
+
+
+class NSFWChecker:
+    def __init__(self, model_name: str = DEFAULT_SAFETY_MODEL):
+        import jax
+        import jax.numpy as jnp
+
+        from ..models.safety import SafetyChecker, SafetyConfig, TINY_SAFETY
+        from ..settings import load_settings
+        from ..weights import is_test_model
+
+        self.model_name = model_name
+        self.config = TINY_SAFETY if is_test_model(model_name) else SafetyConfig()
+        on_tpu = jax.default_backend() == "tpu"
+        self.dtype = jnp.bfloat16 if on_tpu else jnp.float32
+        self.model = SafetyChecker(self.config, dtype=self.dtype)
+        self.available = False
+
+        root = Path(load_settings().model_root_dir).expanduser()
+        model_dir = root / model_name
+        params = None
+        if model_dir.is_dir():
+            try:
+                from ..models.conversion import (
+                    convert_safety_checker,
+                    load_torch_state_dict,
+                )
+
+                params = convert_safety_checker(load_torch_state_dict(model_dir))
+                self.available = bool(params.get("vision"))
+            except FileNotFoundError:
+                params = None
+        if params is None or not self.available:
+            if is_test_model(model_name):
+                size = self.config.image_size
+                params = self.model.init(
+                    jax.random.key(zlib.crc32(model_name.encode())),
+                    jnp.zeros((1, size, size, 3)),
+                )["params"]
+                self.available = True
+            else:
+                logger.warning(
+                    "safety checker %s not present; NSFW flag disabled",
+                    model_name,
+                )
+                self.params = None
+                return
+        cast = lambda x: jnp.asarray(x, self.dtype)
+        self.params = jax.tree_util.tree_map(cast, params)
+        self._program = jax.jit(
+            lambda p, px: self.model.apply({"params": p}, px)
+        )
+
+    def check(self, images) -> list[bool] | None:
+        """PIL images -> per-image NSFW booleans; None when unavailable."""
+        if not self.available:
+            return None
+        import jax.numpy as jnp
+        from PIL import Image
+
+        size = self.config.image_size
+        batch = np.stack([
+            (
+                np.asarray(
+                    im.convert("RGB").resize((size, size), Image.BICUBIC),
+                    np.float32,
+                ) / 255.0 - _MEAN
+            ) / _STD
+            for im in images
+        ])
+        flags = self._program(self.params, jnp.asarray(batch, self.dtype))
+        return [bool(f) for f in np.asarray(flags)]
+
+
+class _DisabledChecker:
+    available = False
+
+    def check(self, images):
+        return None
+
+
+def get_checker(model_name: str | None = None):
+    global _CHECKER, _CHECKER_NAME
+    if model_name is None:
+        from ..settings import load_settings
+
+        model_name = getattr(
+            load_settings(), "safety_checker_model", DEFAULT_SAFETY_MODEL
+        )
+    if not model_name:  # settings contract: "" disables the checker
+        return _DisabledChecker()
+    with _LOCK:
+        if _CHECKER is not None and _CHECKER_NAME == model_name:
+            return _CHECKER
+        try:
+            checker = NSFWChecker(model_name)
+        except Exception as e:  # noqa: BLE001 — corrupt checkpoint etc.
+            logger.warning(
+                "safety checker %s failed to load (%s); NSFW flag disabled",
+                model_name, e,
+            )
+            checker = _DisabledChecker()  # cache: don't re-parse per job
+        _CHECKER, _CHECKER_NAME = checker, model_name
+        return checker
+
+
+def flag_images(images) -> tuple[bool, bool]:
+    """-> (any_nsfw, checked). Never raises — auxiliary subsystem."""
+    try:
+        flags = get_checker().check(images)
+    except Exception as e:  # noqa: BLE001 — must not fail the job
+        logger.warning("safety check failed: %s", e)
+        return False, False
+    if flags is None:
+        return False, False
+    return any(flags), True

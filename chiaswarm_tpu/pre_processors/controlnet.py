@@ -51,6 +51,42 @@ def crop(image: Image.Image) -> Image.Image:
     return center_crop_resize(image, (512, 512))
 
 
+@register("depth")
+def depth(image: Image.Image) -> Image.Image:
+    """Model-backed DPT inverse depth (reference controlnet.py:94-119)."""
+    from ..pipelines.aux_models import estimate_depth
+
+    d = estimate_depth(image)  # [H, W] in [0, 1]
+    arr = (d * 255).astype(np.uint8)
+    return Image.fromarray(np.stack([arr] * 3, axis=-1))
+
+
+@register("shuffle")
+def shuffle(image: Image.Image) -> Image.Image:
+    """Content shuffle: smooth random-flow warp that keeps palette/texture
+    while destroying composition (reference's ContentShuffleDetector)."""
+    import cv2
+
+    arr = np.asarray(image.convert("RGB"))
+    h, w = arr.shape[:2]
+    # deterministic per image content so identical jobs reproduce
+    seed = int(np.uint32(np.sum(arr[::16, ::16], dtype=np.uint64) & 0xFFFFFFFF))
+    rng = np.random.default_rng(seed)
+    grid_h, grid_w = max(h // 64, 2), max(w // 64, 2)
+    fx = cv2.resize(
+        rng.standard_normal((grid_h, grid_w)).astype(np.float32), (w, h)
+    ) * (w / 4)
+    fy = cv2.resize(
+        rng.standard_normal((grid_h, grid_w)).astype(np.float32), (w, h)
+    ) * (h / 4)
+    xx, yy = np.meshgrid(np.arange(w, dtype=np.float32),
+                         np.arange(h, dtype=np.float32))
+    out = cv2.remap(
+        arr, xx + fx, yy + fy, cv2.INTER_LINEAR, borderMode=cv2.BORDER_REFLECT
+    )
+    return Image.fromarray(out)
+
+
 @register("scribble")
 @register("softedge")
 def soft_edge(image: Image.Image) -> Image.Image:

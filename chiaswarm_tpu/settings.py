@@ -35,6 +35,10 @@ class Settings:
     model_root_dir: str = "~/.sdaas/models"
     # dtype policy for pipeline params: "bfloat16" | "float32"
     dtype: str = "bfloat16"
+    # aux depth model serving the `depth` preprocessor + Kandinsky hint
+    depth_model: str = "Intel/dpt-large"
+    # NSFW safety checker feeding the envelope flag ("" disables)
+    safety_checker_model: str = "CompVis/stable-diffusion-safety-checker"
 
     @classmethod
     def field_names(cls) -> tuple[str, ...]:

@@ -45,6 +45,14 @@ def diffusion_callback(device_identifier: str, model_name: str, **kwargs):
     )
     images, pipeline_config = pipeline.run(pipeline_type=pipeline_type, **kwargs)
 
+    # real NSFW detection on the decoded pixels (reference envelope parity:
+    # swarm/worker.py:166); auxiliary — never fails the job
+    from ..pipelines.safety import flag_images
+
+    nsfw, checked = flag_images(images)
+    pipeline_config["nsfw"] = nsfw
+    pipeline_config["nsfw_checked"] = checked
+
     processor = OutputProcessor(outputs, content_type)
     processor.add_outputs(images)
     return processor.get_results(), pipeline_config

@@ -1,0 +1,176 @@
+"""DPT depth estimator + model-backed preprocessors (VERDICT missing #4).
+
+`controlnet.preprocessor: "depth"` and the Kandinsky depth hint now run a
+real flax DPT; the tiny config exercises the full graph hermetically, and
+the conversion mapping is validated by an exact inversion roundtrip.
+"""
+
+import numpy as np
+import pytest
+from PIL import Image
+
+import jax
+import jax.numpy as jnp
+
+from chiaswarm_tpu.models.depth import TINY_DPT, DPTDepthModel
+from chiaswarm_tpu.pipelines.aux_models import DepthEstimator, estimate_depth
+from chiaswarm_tpu.settings import Settings, save_settings
+from chiaswarm_tpu.weights import MissingWeightsError
+
+
+def _image(seed=0, size=64):
+    rng = np.random.default_rng(seed)
+    return Image.fromarray((rng.random((size, size, 3)) * 255).astype(np.uint8))
+
+
+def test_dpt_forward_shapes():
+    model = DPTDepthModel(TINY_DPT)
+    px = jnp.zeros((1, TINY_DPT.image_size, TINY_DPT.image_size, 3))
+    params = model.init(jax.random.key(0), px)
+    out = model.apply(params, px)
+    assert out.shape == (1, TINY_DPT.image_size, TINY_DPT.image_size)
+    assert np.isfinite(np.asarray(out)).all()
+
+
+def test_estimate_depth_tiny():
+    d = estimate_depth(_image(0, 48), model_name="test/tiny-dpt")
+    assert d.shape == (48, 48)
+    assert d.dtype == np.float32
+    assert 0.0 <= d.min() and d.max() <= 1.0
+
+
+def test_depth_requires_weights_for_real_model(sdaas_root):
+    with pytest.raises(MissingWeightsError):
+        DepthEstimator("Intel/dpt-large")
+
+
+def test_depth_preprocessor_via_settings_override(sdaas_root):
+    save_settings(Settings(depth_model="test/tiny-dpt"))
+    from chiaswarm_tpu.pre_processors.controlnet import preprocess_image
+
+    out = preprocess_image(_image(1, 64), "depth", "cpu:0")
+    arr = np.asarray(out)
+    assert arr.shape == (64, 64, 3)
+    # three identical channels of the depth map
+    np.testing.assert_array_equal(arr[..., 0], arr[..., 1])
+
+
+def test_make_hint_unlocked(sdaas_root):
+    save_settings(Settings(depth_model="test/tiny-dpt"))
+    from chiaswarm_tpu.pre_processors.depth_estimator import make_hint
+
+    hint = make_hint(_image(2, 64))
+    assert hint.shape == (64, 64, 3)
+    assert hint.dtype == np.float32
+
+
+def test_shuffle_preprocessor_keeps_palette():
+    from chiaswarm_tpu.pre_processors.controlnet import preprocess_image
+
+    img = _image(3, 128)
+    out = preprocess_image(img, "shuffle", "cpu:0")
+    a, b = np.asarray(img, np.float32), np.asarray(out, np.float32)
+    assert not np.array_equal(a, b)  # composition destroyed
+    assert abs(a.mean() - b.mean()) < 16  # palette roughly preserved
+    # deterministic for identical content
+    out2 = preprocess_image(img, "shuffle", "cpu:0")
+    np.testing.assert_array_equal(np.asarray(out), np.asarray(out2))
+
+
+def _dpt_flax_to_hf(p):
+    """Invert models/depth.py tree into transformers DPT naming."""
+    state = {
+        "dpt.embeddings.cls_token": np.asarray(p["cls_token"], np.float32),
+        "dpt.embeddings.position_embeddings": np.asarray(
+            p["pos_embed"], np.float32
+        ),
+    }
+
+    def conv(torch_name, tree):
+        state[f"{torch_name}.weight"] = np.ascontiguousarray(
+            np.asarray(tree["kernel"], np.float32).transpose(3, 2, 0, 1)
+        )
+        if "bias" in tree:
+            state[f"{torch_name}.bias"] = np.asarray(tree["bias"], np.float32)
+
+    def convT(torch_name, tree):
+        state[f"{torch_name}.weight"] = np.ascontiguousarray(
+            np.asarray(tree["kernel"], np.float32).transpose(2, 3, 0, 1)
+        )
+        state[f"{torch_name}.bias"] = np.asarray(tree["bias"], np.float32)
+
+    def dense(torch_name, tree):
+        state[f"{torch_name}.weight"] = np.ascontiguousarray(
+            np.asarray(tree["kernel"], np.float32).T
+        )
+        state[f"{torch_name}.bias"] = np.asarray(tree["bias"], np.float32)
+
+    def norm(torch_name, tree):
+        state[f"{torch_name}.weight"] = np.asarray(tree["scale"], np.float32)
+        state[f"{torch_name}.bias"] = np.asarray(tree["bias"], np.float32)
+
+    conv("dpt.embeddings.patch_embeddings.projection", p["patch_embed"])
+    for i in range(TINY_DPT.num_layers):
+        blk = p[f"layer_{i}"]
+        base = f"dpt.encoder.layer.{i}"
+        dense(f"{base}.attention.attention.query", blk["q"])
+        dense(f"{base}.attention.attention.key", blk["k"])
+        dense(f"{base}.attention.attention.value", blk["v"])
+        dense(f"{base}.attention.output.dense", blk["out"])
+        dense(f"{base}.intermediate.dense", blk["fc1"])
+        dense(f"{base}.output.dense", blk["fc2"])
+        norm(f"{base}.layernorm_before", blk["ln1"])
+        norm(f"{base}.layernorm_after", blk["ln2"])
+    for k in range(4):
+        base = f"neck.reassemble_stage.layers.{k}"
+        # readout Linears live in a stage-level ModuleList in HF
+        dense(f"neck.reassemble_stage.readout_projects.{k}.0",
+              p[f"reassemble_{k}_readout"])
+        conv(f"{base}.projection", p[f"reassemble_{k}_project"])
+        if k < 2:
+            convT(f"{base}.resize", p[f"reassemble_{k}_resize"])
+        elif k == 3:
+            conv(f"{base}.resize", p[f"reassemble_{k}_resize"])
+        state[f"neck.convs.{k}.weight"] = np.ascontiguousarray(
+            np.asarray(p[f"conv_{k}"]["kernel"], np.float32).transpose(
+                3, 2, 0, 1
+            )
+        )
+        j = 3 - k  # HF fusion layer order is deepest-first
+        fb = f"neck.fusion_stage.layers.{j}"
+        if k != 3:
+            # the deepest feature has no residual input, so our module
+            # never creates fusion_3_rcu1 (HF ships unused params there)
+            conv(f"{fb}.residual_layer1.convolution1",
+                 p[f"fusion_{k}_rcu1"]["conv1"])
+            conv(f"{fb}.residual_layer1.convolution2",
+                 p[f"fusion_{k}_rcu1"]["conv2"])
+        conv(f"{fb}.residual_layer2.convolution1", p[f"fusion_{k}_rcu2"]["conv1"])
+        conv(f"{fb}.residual_layer2.convolution2", p[f"fusion_{k}_rcu2"]["conv2"])
+        conv(f"{fb}.projection", p[f"fusion_{k}_project"])
+    conv("head.head.0", p["head_conv1"])
+    conv("head.head.2", p["head_conv2"])
+    conv("head.head.4", p["head_conv3"])
+    return state
+
+
+def test_convert_dpt_roundtrip_exact():
+    from chiaswarm_tpu.models.conversion import convert_dpt
+
+    model = DPTDepthModel(TINY_DPT)
+    params = model.init(
+        jax.random.key(1),
+        jnp.zeros((1, TINY_DPT.image_size, TINY_DPT.image_size, 3)),
+    )["params"]
+    ref = jax.tree_util.tree_map(lambda x: np.asarray(x, np.float32), dict(params))
+    converted = convert_dpt(_dpt_flax_to_hf(ref))
+
+    flat_ref = jax.tree_util.tree_flatten_with_path(ref)[0]
+    flat_conv = jax.tree_util.tree_flatten_with_path(converted)[0]
+    assert len(flat_ref) == len(flat_conv), (len(flat_ref), len(flat_conv))
+    conv_map = {tuple(str(k) for k in kp): v for kp, v in flat_conv}
+    for kp, v in flat_ref:
+        key = tuple(str(k) for k in kp)
+        assert key in conv_map, key
+        np.testing.assert_allclose(conv_map[key], np.asarray(v), rtol=1e-6,
+                                   err_msg=str(key))
