@@ -215,8 +215,13 @@ async def run_worker() -> None:
     await Worker().run()
 
 
-if __name__ == "__main__":
+def main() -> None:
+    """Console entry point (`chiaswarm-tpu-worker`)."""
     try:
         asyncio.run(run_worker())
     except KeyboardInterrupt:
         print("done")
+
+
+if __name__ == "__main__":
+    main()
