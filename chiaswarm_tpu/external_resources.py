@@ -1,15 +1,21 @@
-"""Safe retrieval of external job inputs (images, QR synthesis).
+"""Retrieval and validation of external job inputs.
 
-Behavior parity with reference swarm/external_resources.py:8-98: HEAD-first
-content-type/size validation (3 MiB cap), EXIF transpose, RGB conversion,
-downscale to the requested size or the global 1024 cap, parallel fan-in
-download for stitch jobs, QR-code image synthesis (gated: the `qrcode`
-package may be absent; raises a clear error instead of ImportError).
+Serves the same job-schema needs as reference swarm/external_resources.py
+(remote start/mask/control images, QR synthesis, stitch fan-in) with a
+different shape: limits live in one policy object, header probing / body
+capping / pixel normalization are separate stages, and the byte cap is
+enforced on the *actual stream* — a Content-Length header that lies (or is
+absent) cannot smuggle an oversized body past the check, which the
+reference's HEAD-only validation allowed.
+
+All sizes use PIL (width, height) convention throughout (the reference
+mixed (h, w) job tuples into PIL calls, mis-bounding non-square inputs).
 """
 
 from __future__ import annotations
 
 import asyncio
+import dataclasses
 from io import BytesIO
 
 import aiohttp
@@ -17,9 +23,19 @@ from PIL import Image, ImageOps
 
 from .pre_processors.image_utils import resize_for_condition_image
 
-max_size = 1024
-MAX_IMAGE_BYTES = 3 * 1048576
-FETCH_TIMEOUT_S = 10
+
+@dataclasses.dataclass(frozen=True)
+class FetchLimits:
+    max_bytes: int = 3 * 1024 * 1024  # reference parity: 3 MiB input cap
+    max_edge: int = 1024  # global canvas cap (swarm job schema)
+    timeout_s: float = 10.0
+
+
+LIMITS = FetchLimits()
+# legacy aliases other modules import
+max_size = LIMITS.max_edge
+MAX_IMAGE_BYTES = LIMITS.max_bytes
+FETCH_TIMEOUT_S = LIMITS.timeout_s
 
 
 def is_blank(s) -> bool:
@@ -30,50 +46,91 @@ def is_not_blank(s) -> bool:
     return bool(s and s.strip())
 
 
-async def get_image(uri: str | None, size: tuple[int, int] | None) -> Image.Image | None:
-    """Fetch a remote image with size/content-type guards, normalized to RGB.
+class InputRejected(Exception):
+    """Job input failed validation (type/size). Raised during argument
+    formatting, so the worker marks the envelope fatal_error (no hive
+    resubmit) with an error-image artifact — same contract as the
+    reference's bad-input path (swarm/worker.py:105-115)."""
 
-    `size` is PIL convention (width, height) — the whole module standardizes
-    on it (the reference mixed (h, w) job tuples with (w, h) PIL tuples,
-    mis-bounding non-square thumbnails at swarm/external_resources.py:45-46).
-    """
-    if is_blank(uri):
-        return None
 
-    timeout = aiohttp.ClientTimeout(total=FETCH_TIMEOUT_S)
-    async with aiohttp.ClientSession(timeout=timeout) as session:
-        async with session.head(uri, allow_redirects=True) as response:
-            response.raise_for_status()
-            content_length = int(response.headers.get("Content-Length", 0))
-            content_type = response.headers.get("Content-Type", "")
+def _check_headers(content_type: str, content_length: int,
+                   limits: FetchLimits) -> None:
+    if not content_type.startswith("image"):
+        raise InputRejected(
+            f"Refusing non-image input (content-type '{content_type}')."
+        )
+    if content_length > limits.max_bytes:
+        raise InputRejected(
+            f"Refusing oversized image input: {content_length} bytes "
+            f"(limit {limits.max_bytes})."
+        )
 
-            if not content_type.startswith("image"):
-                raise Exception(
-                    "Input does not appear to be an image.\n"
-                    f"Content type was {content_type}."
-                )
-            if content_length > MAX_IMAGE_BYTES:
-                raise Exception(
-                    f"Input image too large.\nMax size is {MAX_IMAGE_BYTES} bytes.\n"
-                    f"Image was {content_length}."
-                )
 
-        async with session.get(uri) as response:
-            response.raise_for_status()
-            content = await response.read()
+async def _read_capped(response, limits: FetchLimits) -> bytes:
+    """Read the body enforcing the cap on actual bytes, not headers."""
+    chunks: list[bytes] = []
+    total = 0
+    async for chunk in response.content.iter_chunked(64 * 1024):
+        total += len(chunk)
+        if total > limits.max_bytes:
+            raise InputRejected(
+                f"Refusing oversized image input: body exceeded "
+                f"{limits.max_bytes} bytes while streaming."
+            )
+        chunks.append(chunk)
+    return b"".join(chunks)
 
-    image = ImageOps.exif_transpose(Image.open(BytesIO(content))).convert("RGB")
 
-    if size is not None and (image.width > size[0] or image.height > size[1]):
-        image.thumbnail(size, Image.Resampling.LANCZOS)
-    elif image.height > max_size or image.width > max_size:
-        image.thumbnail((max_size, max_size), Image.Resampling.LANCZOS)
-
+def _decode_image(raw: bytes, size: tuple[int, int] | None,
+                  limits: FetchLimits) -> Image.Image:
+    """bytes -> RGB PIL, EXIF-upright, bounded to `size` or the global cap."""
+    image = ImageOps.exif_transpose(Image.open(BytesIO(raw))).convert("RGB")
+    bound = (
+        size
+        if size is not None
+        and (image.width > size[0] or image.height > size[1])
+        else (
+            (limits.max_edge, limits.max_edge)
+            if max(image.size) > limits.max_edge
+            else None
+        )
+    )
+    if bound is not None:
+        image.thumbnail(bound, Image.Resampling.LANCZOS)
     return image
 
 
-async def get_qrcode_image(qr_code_contents: str, size: tuple[int, int] | None) -> Image.Image:
-    """Synthesize a QR-code control image (reference swarm/external_resources.py:54-70)."""
+async def get_image(
+    uri: str | None,
+    size: tuple[int, int] | None,
+    limits: FetchLimits = LIMITS,
+) -> Image.Image | None:
+    """Fetch one remote job-input image; None for blank URIs."""
+    if is_blank(uri):
+        return None
+
+    timeout = aiohttp.ClientTimeout(total=limits.timeout_s)
+    async with aiohttp.ClientSession(timeout=timeout) as session:
+        # probe first so obviously-bad inputs are rejected without a body
+        # transfer; the streaming cap below is the authoritative guard
+        async with session.head(uri, allow_redirects=True) as probe:
+            probe.raise_for_status()
+            _check_headers(
+                probe.headers.get("Content-Type", ""),
+                int(probe.headers.get("Content-Length", 0)),
+                limits,
+            )
+        async with session.get(uri) as response:
+            response.raise_for_status()
+            raw = await _read_capped(response, limits)
+
+    return _decode_image(raw, size, limits)
+
+
+async def get_qrcode_image(
+    qr_code_contents: str, size: tuple[int, int] | None
+) -> Image.Image:
+    """Synthesize a QR control image for the qr-monster workflows."""
     try:
         import qrcode
     except ImportError as e:
@@ -82,9 +139,7 @@ async def get_qrcode_image(qr_code_contents: str, size: tuple[int, int] | None) 
             "installed on this worker."
         ) from e
 
-    w, h = size if size is not None else (768, 768)
-    resolution = max(h, w)
-
+    edge = max(size) if size is not None else 768
     qr = qrcode.QRCode(
         version=None,
         error_correction=qrcode.constants.ERROR_CORRECT_H,
@@ -93,12 +148,13 @@ async def get_qrcode_image(qr_code_contents: str, size: tuple[int, int] | None) 
     )
     qr.add_data(qr_code_contents)
     qr.make(fit=True)
-    image = qr.make_image(fill_color="black", back_color="white")
-    return resize_for_condition_image(image, resolution)
+    return resize_for_condition_image(
+        qr.make_image(fill_color="black", back_color="white"), edge
+    )
 
 
 async def download_images(image_urls: list[str]) -> list[Image.Image]:
-    """Parallel fan-in download (stitch inputs); no size guard, trusted URIs."""
+    """Parallel fan-in of prior job results (stitch inputs, hive-trusted)."""
     async with aiohttp.ClientSession() as session:
 
         async def fetch(url: str) -> Image.Image:

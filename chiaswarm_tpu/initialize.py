@@ -128,7 +128,29 @@ def verify_local_model(model_name: str, root: Path | None = None) -> dict | None
         return _verify_blip_model(model_name, root)
     if "dpt" in name or "midas" in name:
         return _verify_dpt_model(model_name, root)
+    if "safety" in name:
+        return _verify_safety_model(model_name, root)
     return _verify_sd_model(model_name, root)
+
+
+def _verify_safety_model(model_name: str, root: Path) -> dict:
+    import jax.numpy as jnp
+
+    from .models.conversion import (
+        assert_tree_shapes_match,
+        convert_safety_checker,
+        load_torch_state_dict,
+    )
+    from .models.safety import SafetyChecker, SafetyConfig, TINY_SAFETY
+    from .weights import is_test_model
+
+    cfg = TINY_SAFETY if is_test_model(model_name) else SafetyConfig()
+    converted = convert_safety_checker(load_torch_state_dict(root / model_name))
+    expected = _eval_shape_params(
+        SafetyChecker(cfg), jnp.zeros((1, cfg.image_size, cfg.image_size, 3))
+    )
+    assert_tree_shapes_match(converted, expected, prefix="safety")
+    return {"safety": _param_count(converted)}
 
 
 def _verify_dpt_model(model_name: str, root: Path) -> dict:
@@ -280,6 +302,11 @@ async def init() -> int:
             if not names:
                 print("hive returned no model list; pass --models explicitly")
                 return 1
+            # aux models the hive doesn't list but serving depends on
+            # (depth preprocessor / hint, NSFW envelope flag)
+            for aux in (settings.depth_model, settings.safety_checker_model):
+                if aux and aux not in names:
+                    names.append(aux)
         root = model_root()
         root.mkdir(parents=True, exist_ok=True)
         for name in names:

@@ -19,6 +19,8 @@ Differences by design:
 
 from __future__ import annotations
 
+import asyncio
+
 from .external_resources import (
     download_images,
     get_image,
@@ -302,6 +304,18 @@ async def format_img2img_args(args, parameters, size, device_identifier):
     args["image"] = start_image
 
 
+async def _preprocess_off_loop(image, preprocessor: str, device_identifier: str):
+    """Model-backed preprocessors (depth etc.) load weights and jit-compile;
+    run them in the default executor so the poll/upload loops keep breathing
+    (the same boundary do_work uses for pipeline execution)."""
+    from .pre_processors.controlnet import preprocess_image
+
+    loop = asyncio.get_running_loop()
+    return await loop.run_in_executor(
+        None, preprocess_image, image, preprocessor, device_identifier
+    )
+
+
 async def format_controlnet_args(args, parameters, start_image, size, device_identifier):
     controlnet = parameters.pop("controlnet")
     control_image = await get_image(controlnet.get("control_image_uri"), size)
@@ -313,15 +327,11 @@ async def format_controlnet_args(args, parameters, start_image, size, device_ide
         if start_image is None:
             start_image = control_image
     elif start_image is not None and is_not_blank(controlnet.get("preprocessor")):
-        from .pre_processors.controlnet import preprocess_image
-
-        control_image = preprocess_image(
+        control_image = await _preprocess_off_loop(
             start_image, controlnet["preprocessor"], device_identifier
         )
     elif control_image is not None and is_not_blank(controlnet.get("preprocessor")):
-        from .pre_processors.controlnet import preprocess_image
-
-        control_image = preprocess_image(
+        control_image = await _preprocess_off_loop(
             control_image, controlnet["preprocessor"], device_identifier
         )
     elif control_image is None:
@@ -351,7 +361,8 @@ async def format_controlnet_args(args, parameters, start_image, size, device_ide
         # kandinsky controlnet takes a depth "hint" instead of "image"
         from .pre_processors.depth_estimator import make_hint
 
-        args["hint"] = make_hint(control_image)
+        loop = asyncio.get_running_loop()
+        args["hint"] = await loop.run_in_executor(None, make_hint, control_image)
     elif parameters.get("pipeline_type") in (
         "StableDiffusionControlNetPipeline",
         "StableDiffusionXLControlNetPipeline",

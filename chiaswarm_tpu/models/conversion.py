@@ -407,6 +407,8 @@ def convert_dpt(state: dict) -> dict:
             )
             j, sub = int(m.group(1)), m.group(2)
             k = n_taps - 1 - j  # HF fuses deepest-first; we index by feature
+            if j == 0 and sub.startswith("residual_layer1."):
+                continue  # unused on the deepest stage; our module omits it
             table = {
                 "residual_layer1.convolution1": f"fusion_{k}_rcu1/conv1",
                 "residual_layer1.convolution2": f"fusion_{k}_rcu1/conv2",

@@ -86,13 +86,15 @@ class DepthEstimator:
         lo, hi = float(depth.min()), float(depth.max())
         depth = (depth - lo) / (hi - lo) if hi > lo else np.zeros_like(depth)
         if original != (size, size):
+            # resize in float (mode "F") — a uint8 detour would band smooth
+            # depth gradients into 1/255 stair-steps
             depth = np.asarray(
-                Image.fromarray((depth * 255).astype(np.uint8)).resize(
+                Image.fromarray(depth.astype(np.float32), mode="F").resize(
                     original, Image.BICUBIC
                 ),
                 np.float32,
-            ) / 255.0
-        return depth.astype(np.float32)
+            )
+        return np.clip(depth, 0.0, 1.0).astype(np.float32)
 
 
 def get_depth_estimator(model_name: str | None = None) -> DepthEstimator:

@@ -1,0 +1,111 @@
+"""External input retrieval: header probe, streaming byte cap, decode."""
+
+import asyncio
+import io
+
+import numpy as np
+import pytest
+from aiohttp import web
+from PIL import Image
+
+from chiaswarm_tpu.external_resources import (
+    FetchLimits,
+    InputRejected,
+    get_image,
+    is_blank,
+    is_not_blank,
+)
+
+
+def _png_bytes(size=32):
+    img = Image.fromarray(
+        (np.random.default_rng(0).random((size, size, 3)) * 255).astype(np.uint8)
+    )
+    buf = io.BytesIO()
+    img.save(buf, "PNG")
+    return buf.getvalue()
+
+
+async def _serve(app):
+    runner = web.AppRunner(app)
+    await runner.setup()
+    site = web.TCPSite(runner, "127.0.0.1", 0)
+    await site.start()
+    port = site._server.sockets[0].getsockname()[1]
+    return runner, f"http://127.0.0.1:{port}"
+
+
+def test_blank_helpers():
+    assert is_blank(None) and is_blank("  ") and not is_blank("x")
+    assert is_not_blank("x") and not is_not_blank("")
+
+
+def test_blank_uri_returns_none():
+    assert asyncio.run(get_image(None, None)) is None
+    assert asyncio.run(get_image("  ", None)) is None
+
+
+def test_fetch_and_normalize():
+    png = _png_bytes(64)
+
+    async def scenario():
+        app = web.Application()
+        app.router.add_route(
+            "*", "/img.png",
+            lambda r: web.Response(body=png, content_type="image/png"),
+        )
+        runner, base = await _serve(app)
+        try:
+            img = await get_image(f"{base}/img.png", (32, 32))
+        finally:
+            await runner.cleanup()
+        return img
+
+    img = asyncio.run(scenario())
+    assert img.mode == "RGB"
+    assert max(img.size) <= 32  # bounded to the requested size
+
+
+def test_wrong_content_type_rejected():
+    async def scenario():
+        app = web.Application()
+        app.router.add_route(
+            "*", "/x",
+            lambda r: web.Response(text="hello", content_type="text/html"),
+        )
+        runner, base = await _serve(app)
+        try:
+            with pytest.raises(InputRejected, match="non-image"):
+                await get_image(f"{base}/x", None)
+        finally:
+            await runner.cleanup()
+
+    asyncio.run(scenario())
+
+
+def test_streaming_cap_beats_lying_content_length():
+    """A HEAD that claims a small size must not smuggle a huge body."""
+    big = b"\x89PNG" + b"\x00" * (256 * 1024)
+
+    async def handler(request):
+        if request.method == "HEAD":
+            return web.Response(
+                headers={"Content-Type": "image/png", "Content-Length": "10"}
+            )
+        resp = web.StreamResponse(headers={"Content-Type": "image/png"})
+        await resp.prepare(request)
+        await resp.write(big)
+        return resp
+
+    async def scenario():
+        app = web.Application()
+        app.router.add_route("*", "/liar.png", handler)
+        runner, base = await _serve(app)
+        limits = FetchLimits(max_bytes=64 * 1024)
+        try:
+            with pytest.raises(InputRejected, match="streaming"):
+                await get_image(f"{base}/liar.png", None, limits)
+        finally:
+            await runner.cleanup()
+
+    asyncio.run(scenario())
