@@ -81,17 +81,32 @@ class CLIPTextEncoder(nn.Module):
     dtype: jnp.dtype = jnp.float32
 
     @nn.compact
-    def __call__(self, input_ids):
+    def __call__(self, input_ids, extra_embeddings=None):
         """input_ids [B, 77] -> dict with:
         - hidden_states: [B, 77, D] conditioning sequence (per config index)
         - pooled: [B, D or projection_dim] EOS-token pooled output
+
+        `extra_embeddings` [K, D] carries textual-inversion placeholder
+        vectors: ids >= vocab_size index into it (id - vocab_size). Passed
+        as data rather than grafted into the Embed table so the resident
+        param tree (and its flax shape contract) never changes per job.
         """
         cfg = self.config
         b, s = input_ids.shape
 
         tok = nn.Embed(
             cfg.vocab_size, cfg.hidden_size, dtype=self.dtype, name="token_embedding"
-        )(input_ids)
+        )(jnp.minimum(input_ids, cfg.vocab_size - 1))
+        if extra_embeddings is not None:
+            is_extra = input_ids >= cfg.vocab_size
+            extra_idx = jnp.clip(
+                input_ids - cfg.vocab_size, 0, extra_embeddings.shape[0] - 1
+            )
+            tok = jnp.where(
+                is_extra[..., None],
+                extra_embeddings.astype(tok.dtype)[extra_idx],
+                tok,
+            )
         pos = self.param(
             "position_embedding",
             nn.initializers.normal(0.01),
@@ -115,9 +130,13 @@ class CLIPTextEncoder(nn.Module):
             cfg.hidden_state_index
         ]
 
-        # pooled = final-LN state at each sequence's EOS (= argmax token id,
-        # EOS has the highest id in CLIP vocab)
-        eos_idx = jnp.argmax(input_ids, axis=-1)
+        # pooled = final-LN state at each sequence's first EOS. EOS is the
+        # highest id in the BASE vocab (both tokenizers), but textual-
+        # inversion placeholder ids sit past it — match the id exactly
+        # instead of argmax-ing raw ids
+        eos_idx = jnp.argmax(
+            (input_ids == cfg.vocab_size - 1).astype(jnp.int32), axis=-1
+        )
         pooled = final[jnp.arange(b), eos_idx]
         if cfg.projection_dim:
             pooled = nn.Dense(

@@ -153,6 +153,57 @@ class HashTokenizer:
         return batch
 
 
+class PlaceholderTokenizer:
+    """Base tokenizer extended with textual-inversion placeholder tokens.
+
+    Each placeholder string maps to a fixed run of ids past the base vocab
+    (the pipeline appends matching rows to the token-embedding table).
+    Splitting happens before BPE so multi-word or bracketed placeholders
+    like `<gta5-artwork>` survive intact.
+    """
+
+    def __init__(self, base, placeholders: dict[str, list[int]]):
+        self.base = base
+        self.placeholders = dict(placeholders)
+        self.max_length = base.max_length
+        self.bos = base.bos
+        self.eos = base.eos
+        if self.placeholders:
+            import re as _re
+
+            pattern = "|".join(
+                _re.escape(p)
+                for p in sorted(self.placeholders, key=len, reverse=True)
+            )
+            self._splitter = _re.compile(f"({pattern})")
+        else:
+            self._splitter = None
+
+    def encode(self, text: str) -> list[int]:
+        if self._splitter is None:
+            return self.base.encode(text)
+        ids: list[int] = []
+        for part in self._splitter.split(text):
+            if not part:
+                continue
+            if part in self.placeholders:
+                ids.extend(self.placeholders[part])
+            else:
+                ids.extend(self.base.encode(part))
+        return ids
+
+    def __call__(self, texts: str | list[str]) -> np.ndarray:
+        if isinstance(texts, str):
+            texts = [texts]
+        batch = np.full((len(texts), self.max_length), self.eos, dtype=np.int32)
+        for row, text in enumerate(texts):
+            ids = self.encode(text)[: self.max_length - 2]
+            batch[row, 0] = self.bos
+            batch[row, 1 : 1 + len(ids)] = ids
+            batch[row, 1 + len(ids)] = self.eos
+        return batch
+
+
 def load_tokenizer(model_dir: str | Path | None, vocab_size: int = 49408,
                    max_length: int = 77):
     """CLIPTokenizer when vocab files exist under the model dir, else hash."""

@@ -47,6 +47,63 @@ from ..settings import load_settings
 logger = logging.getLogger(__name__)
 
 MAX_RESIDENT_LORAS = 4
+MAX_RESIDENT_TI = 4
+MAX_RESIDENT_VAES = 2
+
+
+def load_learned_embeddings(ref) -> list[dict]:
+    """Textual-inversion file -> [{"tokens": [alias, ...], "vectors":
+    [[k, D] float32, ...]}] groups (aliases share one id run; multiple
+    vectors cover SDXL's per-encoder embeds).
+
+    Accepts a direct path, a model-root entry, or a lora-root entry;
+    handled formats: diffusers (one key per placeholder token), kohya
+    `emb_params`, and the SDXL dual-encoder `clip_l`/`clip_g` layout. The
+    file-named formats register both the bare stem and `<stem>` as
+    triggers (prompts conventionally use either). Reference behavior
+    replaced: diffusers load_textual_inversion per job
+    (swarm/diffusion/diffusion_func.py:105-111).
+    """
+    from safetensors import safe_open
+
+    settings = load_settings()
+    candidates: list[Path] = []
+    for base in (
+        Path(str(ref)).expanduser(),
+        Path(settings.model_root_dir).expanduser() / str(ref),
+        Path(settings.lora_root_dir).expanduser() / str(ref),
+    ):
+        if base.is_file():
+            candidates.append(base)
+        elif base.is_dir():
+            candidates.extend(sorted(base.glob("*.safetensors")))
+    for f in candidates:
+        try:
+            with safe_open(str(f), framework="np") as sf:
+                state = {k: sf.get_tensor(k) for k in sf.keys()}
+        except Exception:  # noqa: BLE001 — try the next candidate
+            continue
+        if not state:
+            continue
+        as2d = lambda v: np.atleast_2d(np.asarray(v, np.float32))
+        keys = set(state)
+        stem_aliases = [f.stem, f"<{f.stem}>"]
+        if keys == {"emb_params"}:
+            return [{"tokens": stem_aliases,
+                     "vectors": [as2d(state["emb_params"])]}]
+        if keys <= {"clip_l", "clip_g"} and keys:
+            return [{
+                "tokens": stem_aliases,
+                "vectors": [as2d(v) for v in state.values()],
+            }]
+        return [
+            {"tokens": [token], "vectors": [as2d(v)]}
+            for token, v in state.items()
+        ]
+    raise ValueError(
+        f"Could not load textual inversion {ref}: no embedding safetensors "
+        f"found (looked at {[str(c) for c in candidates] or 'no candidates'})"
+    )
 
 
 
@@ -90,6 +147,12 @@ def _family_configs(model_name: str):
         # image latents on the channel dim: 8-channel UNet input
         unet_cfg = dataclasses.replace(
             unet_cfg, in_channels=2 * vae_cfg.latent_channels
+        )
+    elif "inpaint" in name:
+        # dedicated inpaint checkpoints (runwayml/stable-diffusion-inpainting
+        # family): 9-channel input = latents + mask + masked-image latents
+        unet_cfg = dataclasses.replace(
+            unet_cfg, in_channels=2 * vae_cfg.latent_channels + 1
         )
     return unet_cfg, clip_cfgs, vae_cfg, size, pred
 
@@ -155,8 +218,12 @@ class SDPipeline:
         self.latent_factor = 2 ** (len(vae_cfg.block_out_channels) - 1)
         self.latent_channels = vae_cfg.latent_channels
         # edit-tuned (instruct-pix2pix) checkpoints concat start-image latents
-        # on the channel dim; detect by architecture, not by name
+        # on the channel dim; dedicated inpaint checkpoints add a mask plane;
+        # detect both by architecture, not by name
         self.is_pix2pix = unet_cfg.in_channels == 2 * vae_cfg.latent_channels
+        self.is_inpaint_unet = (
+            unet_cfg.in_channels == 2 * vae_cfg.latent_channels + 1
+        )
         self.mesh = (
             chipset.mesh() if chipset is not None else make_mesh(jax.devices()[:1])
         )
@@ -189,6 +256,10 @@ class SDPipeline:
         # param trees with LoRAs merged, keyed by (lora ref, scale); LRU-
         # bounded — each entry pins a full UNet copy in HBM
         self._lora_cache: OrderedDict[tuple, dict] = OrderedDict()
+        # textual inversions: (extended text params, wrapped tokenizers)
+        self._ti_cache: OrderedDict[str, tuple] = OrderedDict()
+        # per-job custom VAEs (reference diffusion_func.py:46-49)
+        self._vae_cache: OrderedDict[str, dict] = OrderedDict()
 
     # --- weights ---
 
@@ -303,6 +374,8 @@ class SDPipeline:
         self._programs.clear()
         self._controlnets.clear()
         self._lora_cache.clear()
+        self._ti_cache.clear()
+        self._vae_cache.clear()
 
     def _lora_params(self, base_params: dict, lora: dict, scale: float) -> dict:
         """Base params with a LoRA merged into the UNet, cached by (ref, scale).
@@ -351,6 +424,98 @@ class SDPipeline:
         self._lora_cache[key] = params
         while len(self._lora_cache) > MAX_RESIDENT_LORAS:
             self._lora_cache.popitem(last=False)
+        return params
+
+    def _ti_apply(self, ti_ref) -> tuple[list, list]:
+        """-> (per-encoder extra-embedding tables, tokenizers with the
+        placeholder tokens). Cached per ref; vectors route to whichever
+        encoder's hidden width they match (SDXL ships per-encoder embeds).
+        The placeholder vectors ride as *inputs* to the encoders (ids past
+        vocab_size index into them), leaving the resident params untouched.
+        """
+        key = str(ti_ref)
+        if key in self._ti_cache:
+            self._ti_cache.move_to_end(key)
+            return self._ti_cache[key]
+        from ..models.tokenizer import PlaceholderTokenizer
+
+        groups = load_learned_embeddings(ti_ref)
+        extras = []
+        tokenizers = []
+        applied = False
+        for enc, tok in zip(self.text_encoders, self.tokenizers):
+            dim = enc.config.hidden_size
+            vocab = enc.config.vocab_size
+            placeholders = {}
+            rows = []
+            next_id = vocab
+            for group in groups:
+                vec = next(
+                    (v for v in group["vectors"] if v.shape[-1] == dim), None
+                )
+                if vec is None:
+                    continue
+                ids = list(range(next_id, next_id + vec.shape[0]))
+                for alias in group["tokens"]:
+                    placeholders[alias] = ids
+                rows.append(vec)
+                next_id += vec.shape[0]
+            if not rows:
+                extras.append(None)
+                tokenizers.append(tok)
+                continue
+            extras.append(
+                jax.device_put(
+                    jnp.asarray(np.concatenate(rows, axis=0), self.dtype),
+                    replicated(self.mesh),
+                )
+            )
+            tokenizers.append(PlaceholderTokenizer(tok, placeholders))
+            applied = True
+            logger.info(
+                "textual inversion %s: %d group(s) for %s's encoder %d",
+                ti_ref, len(rows), self.model_name, len(extras) - 1,
+            )
+        if not applied:
+            dims = sorted({
+                v.shape[-1] for g in groups for v in g["vectors"]
+            })
+            raise ValueError(
+                f"Textual inversion {ti_ref} is incompatible with "
+                f"{self.model_name}: embedding widths {dims} match no "
+                f"text encoder"
+            )
+        self._ti_cache[key] = (extras, tokenizers)
+        while len(self._ti_cache) > MAX_RESIDENT_TI:
+            self._ti_cache.popitem(last=False)
+        return extras, tokenizers
+
+    def _custom_vae(self, name: str) -> dict:
+        """Converted per-job VAE (reference diffusion_func.py:46-49),
+        resident + LRU-bounded; missing weights are a fatal job error."""
+        if name in self._vae_cache:
+            self._vae_cache.move_to_end(name)
+            return self._vae_cache[name]
+        from ..models.conversion import convert_vae, load_torch_state_dict
+
+        root = Path(load_settings().model_root_dir).expanduser() / name
+        state = None
+        for sub in ("", "vae"):
+            try:
+                state = load_torch_state_dict(root, sub)
+                break
+            except FileNotFoundError:
+                continue
+        if state is None:
+            raise ValueError(
+                f"Could not load custom VAE {name}: no safetensors under "
+                f"{root}. Prefetch it with `chiaswarm-tpu-init --download "
+                f"--models {name}`."
+            )
+        params = self._place({"vae": convert_vae(state)})["vae"]
+        self._vae_cache[name] = params
+        while len(self._vae_cache) > MAX_RESIDENT_VAES:
+            self._vae_cache.popitem(last=False)
         return params
 
     def _get_controlnet(self, name: str):
@@ -406,27 +571,101 @@ class SDPipeline:
         self._controlnets[name] = (cn, params)
         return cn, params
 
+    def _run_qr_two_stage(self, prompt, negative_prompt, pipeline_type,
+                          **kwargs):
+        """QR-monster chain (reference diffusion_func.py:78-101): a plain
+        txt2img prepipeline composes the scene at half resolution, the
+        result upscales, and the ControlNet img2img pass imposes the QR
+        structure at full size. The reference chained through a raw latent
+        2x interpolation; here the handoff is pixel-space (upscale + VAE
+        re-encode), preserving the two-stage semantics with one code path.
+        """
+        kwargs.pop("controlnet_prepipeline_type", None)
+        height = int(kwargs.pop("height", None) or self.default_size)
+        width = int(kwargs.pop("width", None) or self.default_size)
+        strength = float(kwargs.pop("strength", 0.9))
+        rng = kwargs.pop("rng", None)
+        if rng is None:
+            rng = jax.random.key(0)
+        rng, stage1_rng, stage2_rng = jax.random.split(rng, 3)
+
+        cn_kwargs = {
+            k: kwargs.pop(k)
+            for k in (
+                "controlnet_model_name", "control_image",
+                "controlnet_conditioning_scale", "control_guidance_start",
+                "control_guidance_end",
+            )
+            if k in kwargs
+        }
+        # the txt2img-ControlNet wire delivers the QR as `image`
+        # (job_arguments format_controlnet_args sets args["image"])
+        start_image = kwargs.pop("image", None)
+        if cn_kwargs.get("control_image") is None and start_image is not None:
+            cn_kwargs["control_image"] = start_image
+        if cn_kwargs.get("control_image") is None:
+            raise ValueError("Controlnet specified but no control image provided")
+
+        stage1_kwargs = dict(kwargs)
+        # one composition image is all stage 2 consumes
+        stage1_kwargs["num_images_per_prompt"] = 1
+        t0 = time.perf_counter()
+        stage1, _ = self.run(
+            prompt=prompt,
+            negative_prompt=negative_prompt,
+            pipeline_type=pipeline_type,
+            height=max(height // 2, 64),
+            width=max(width // 2, 64),
+            rng=stage1_rng,
+            **stage1_kwargs,
+        )
+        prepipeline_s = round(time.perf_counter() - t0, 3)
+
+        base = stage1[0].resize((width, height), Image.LANCZOS)
+        images, config = self.run(
+            prompt=prompt,
+            negative_prompt=negative_prompt,
+            pipeline_type=pipeline_type,
+            image=base,
+            strength=strength,
+            height=height,
+            width=width,
+            rng=stage2_rng,
+            **cn_kwargs,
+            **kwargs,
+        )
+        config["prepipeline"] = "qr_two_stage"
+        config["timings"]["prepipeline_s"] = prepipeline_s
+        return images, config
+
     # --- text conditioning (host + tiny device work, once per job) ---
 
-    def _encode_impl(self, text_params, ids_list):
+    def _encode_impl(self, text_params, ids_list, extras_list):
         """All text encoders fused into one jitted program."""
         hiddens, pooled = [], None
-        for enc, p, ids in zip(self.text_encoders, text_params, ids_list):
-            out = enc.apply({"params": p}, ids)
+        for enc, p, ids, extra in zip(
+            self.text_encoders, text_params, ids_list, extras_list
+        ):
+            out = enc.apply({"params": p}, ids, extra_embeddings=extra)
             hiddens.append(out["hidden_states"])
             pooled = out["pooled"]  # last encoder's pooled (SDXL: encoder 2)
         context = jnp.concatenate(hiddens, axis=-1) if len(hiddens) > 1 else hiddens[0]
         return context, pooled
 
-    def encode_prompts(self, prompts: list[str], params: dict):
+    def encode_prompts(self, prompts: list[str], params: dict,
+                       tokenizers=None, extra_embeddings=None):
         """-> (context [B,77,D], pooled [B,P] or None).
 
         One batched pass over all encoders in a single jitted dispatch —
         callers stack [negatives + prompts] so uncond/cond conditioning is
-        one program call, not per-encoder op-by-op applies.
+        one program call, not per-encoder op-by-op applies. `tokenizers` /
+        `extra_embeddings` override the residents for textual-inversion
+        placeholder tokens.
         """
-        ids_list = [jnp.asarray(tok(prompts)) for tok in self.tokenizers]
-        context, pooled = self._encode_program(params["text"], ids_list)
+        toks = tokenizers or self.tokenizers
+        extras = extra_embeddings or [None] * len(toks)
+        ids_list = [jnp.asarray(tok(prompts)) for tok in toks]
+        context, pooled = self._encode_program(params["text"], ids_list, extras)
         return context, (pooled if self.is_xl else None)
 
     # --- the jitted core ---
@@ -446,6 +685,9 @@ class SDPipeline:
             **dict(sched_key[1]),
         )
         schedule = scheduler.schedule(steps)
+        # most solvers: one model call per user step; Heun interleaves two
+        # and maps the bounds onto its doubled index space
+        loop_start, loop_end = scheduler.loop_bounds(schedule, steps, t_start)
 
         unet_apply = self.unet.apply
         vae = self.vae
@@ -466,11 +708,11 @@ class SDPipeline:
             )
             if mode == "img2img":
                 latents = scheduler.add_noise(
-                    schedule, image_latents, latents, t_start
+                    schedule, image_latents, latents, loop_start
                 )
             elif mode == "inpaint":
                 clean = image_latents
-                latents = scheduler.add_noise(schedule, clean, latents, t_start)
+                latents = scheduler.add_noise(schedule, clean, latents, loop_start)
             else:
                 # txt2img and pix2pix both denoise from pure noise; pix2pix's
                 # image conditioning rides the UNet's channel dim instead
@@ -486,6 +728,13 @@ class SDPipeline:
                     [jnp.zeros_like(image_latents), image_latents, image_latents],
                     axis=0,
                 ).astype(self.dtype)
+            if mode == "inpaint9":
+                # dedicated inpaint UNet: mask plane + masked-image latents
+                # ride the channel dim on both CFG rows
+                cond9 = jnp.concatenate([mask, image_latents], axis=-1)
+                cond9 = jnp.concatenate([cond9, cond9], axis=0).astype(
+                    self.dtype
+                )
             if cn_key is not None:
                 control2 = jnp.concatenate([control_cond, control_cond], axis=0).astype(
                     self.dtype
@@ -502,6 +751,8 @@ class SDPipeline:
                     # image latents join unscaled: the edit checkpoint was
                     # trained on raw latent-dist modes
                     model_in = jnp.concatenate([model_in, cond_rows], axis=-1)
+                elif mode == "inpaint9":
+                    model_in = jnp.concatenate([model_in, cond9], axis=-1)
                 t = jnp.asarray(schedule.timesteps)[i]
                 t_vec = jnp.broadcast_to(t, (model_in.shape[0],))
                 residual_kw = {}
@@ -563,14 +814,15 @@ class SDPipeline:
                             clean.shape,
                             jnp.float32,
                         ),
-                        jnp.minimum(i + 1, steps - 1),
+                        jnp.minimum(i + 1, loop_end - 1),
                     )
-                    keep = jnp.where(i == steps - 1, clean, keep)
+                    keep = jnp.where(i == loop_end - 1, clean, keep)
                     latents = mask * latents + (1.0 - mask) * keep
                 return (latents, state), ()
 
             (latents, _), _ = jax.lax.scan(
-                body, (latents.astype(jnp.float32), state), jnp.arange(t_start, steps)
+                body, (latents.astype(jnp.float32), state),
+                jnp.arange(loop_start, loop_end)
             )
             if upscale:
                 # reference upscale path: latents leave the main pipeline and
@@ -607,6 +859,18 @@ class SDPipeline:
     def run(self, prompt="", negative_prompt="", pipeline_type="DiffusionPipeline",
             **kwargs):
         """Execute one job; returns (list[PIL.Image], pipeline_config)."""
+        if (
+            kwargs.get("controlnet_prepipeline_type")
+            and kwargs.get("controlnet_model_name")
+            and kwargs.get("mask_image") is None
+        ):
+            # NB the hive's txt2img-ControlNet wire puts the QR image in
+            # `image` (job_arguments.py format_controlnet_args), so the
+            # guard must not require image=None; _run_qr_two_stage sorts
+            # control vs start image out
+            return self._run_qr_two_stage(
+                prompt, negative_prompt, pipeline_type, **kwargs
+            )
         # snapshot at entry: registry LRU eviction may release() this bundle
         # mid-job from another thread; the snapshot keeps this job's arrays
         # alive (and correct) until it finishes
@@ -645,14 +909,26 @@ class SDPipeline:
             else self._lora_params(base_params, lora, lora_scale)
         )
 
+        # per-job conditioning/decoding add-ons (reference
+        # diffusion_func.py:46-49 custom VAE, :105-111 textual inversion)
+        job_tokenizers = None
+        job_extras = None
+        ti_ref = kwargs.pop("textual_inversion", None)
+        if ti_ref:
+            job_extras, job_tokenizers = self._ti_apply(ti_ref)
+        vae_ref = kwargs.pop("vae", None)
+        if vae_ref:
+            job_params = dict(job_params)
+            job_params["vae"] = self._custom_vae(str(vae_ref))
+
         # --- ControlNet wire args (swarm/job_arguments.py:330-397 parity) ---
         controlnet_name = kwargs.pop("controlnet_model_name", None)
         cn_scale = float(kwargs.pop("controlnet_conditioning_scale", 1.0))
         cg_start = float(kwargs.pop("control_guidance_start", 0.0))
         cg_end = float(kwargs.pop("control_guidance_end", 1.0))
-        for drop in ("controlnet_model_type", "controlnet_prepipeline_type",
-                     "save_preprocessed_input"):
+        for drop in ("controlnet_model_type", "save_preprocessed_input"):
             kwargs.pop(drop, None)
+        kwargs.pop("controlnet_prepipeline_type", None)  # handled at entry
         control_image = kwargs.pop("control_image", None)
         if controlnet_name and control_image is None:
             # diffusers txt2img-ControlNet convention: `image` IS the control
@@ -680,7 +956,10 @@ class SDPipeline:
                 # without an init image the placeholder zeros would decode as
                 # garbage in the unmasked region — job-level error instead
                 raise ValueError("inpaint requires an init image. None provided")
-            mode = "inpaint"
+            # dedicated inpaint checkpoints take mask + masked-image latents
+            # on the channel dim (full denoise); 4-channel models use latent
+            # masking along the original's noise trajectory
+            mode = "inpaint9" if self.is_inpaint_unet else "inpaint"
         elif image is not None and self.is_pix2pix:
             mode = "pix2pix"
             if controlnet_name:
@@ -703,7 +982,10 @@ class SDPipeline:
         t0 = time.perf_counter()
         cfg_rows = 3 if mode == "pix2pix" else 2
         texts = [negative_prompt] * n_images + [prompt] * n_images
-        context, pooled = self.encode_prompts(texts, job_params)
+        context, pooled = self.encode_prompts(
+            texts, job_params, tokenizers=job_tokenizers,
+            extra_embeddings=job_extras,
+        )
         pooled_u = pooled[:n_images] if pooled is not None else None
         pooled_c = pooled[n_images:] if pooled is not None else None
         if cfg_rows == 3:
@@ -751,6 +1033,16 @@ class SDPipeline:
                     jnp.asarray(_pil_to_array(image, width, height))[None],
                     (n_images, height, width, 3),
                 )
+            if mode == "inpaint9":
+                # the 9-channel checkpoint conditions on the MASKED image:
+                # repaint region blanked before encoding
+                mask_px = np.asarray(
+                    mask_image.convert("L").resize(
+                        (width, height), Image.NEAREST
+                    ),
+                    np.float32,
+                )[None, ..., None] / 255.0
+                pixels = pixels * jnp.asarray(mask_px <= 0.5, jnp.float32)
             image_latents = self._vae_encode_program(
                 job_params["vae"], pixels.astype(self.dtype)
             )

@@ -14,6 +14,8 @@ Wire names accepted (reference hive schema, SURVEY §2.7) map via
 
 from .common import Schedule, SchedulerConfig
 from .solvers import (
+    HeunDiscreteScheduler,
+    UniPCMultistepScheduler,
     DDIMScheduler,
     DDPMScheduler,
     DPMSolverMultistepScheduler,
@@ -27,15 +29,16 @@ from .solvers import (
 # reference test matrix sends (swarm/test.py)
 SCHEDULERS = {
     "DPMSolverMultistepScheduler": DPMSolverMultistepScheduler,
+    # singlestep still aliases to 2M (logged divergence); UniPC/Heun are real
     "DPMSolverSinglestepScheduler": DPMSolverMultistepScheduler,
-    "UniPCMultistepScheduler": DPMSolverMultistepScheduler,
+    "UniPCMultistepScheduler": UniPCMultistepScheduler,
     "EulerDiscreteScheduler": EulerDiscreteScheduler,
     "EulerAncestralDiscreteScheduler": EulerAncestralDiscreteScheduler,
     "DDIMScheduler": DDIMScheduler,
     "DDPMScheduler": DDPMScheduler,
     "PNDMScheduler": DDIMScheduler,
     "LMSDiscreteScheduler": EulerDiscreteScheduler,
-    "HeunDiscreteScheduler": EulerDiscreteScheduler,
+    "HeunDiscreteScheduler": HeunDiscreteScheduler,
     "LCMScheduler": LCMScheduler,
     "FlowMatchEulerDiscreteScheduler": FlowMatchEulerScheduler,
     "FlowMatchEulerScheduler": FlowMatchEulerScheduler,

@@ -1,0 +1,105 @@
+"""9-channel inpaint UNet + QR-monster two-stage prepipeline.
+
+VERDICT weak #8 (dedicated inpaint checkpoints) and missing #7 (QR
+prepipeline chaining, reference diffusion_func.py:78-101).
+"""
+
+import numpy as np
+import pytest
+from PIL import Image
+
+import jax
+
+from chiaswarm_tpu.pipelines.stable_diffusion import SDPipeline
+
+
+def _image(seed=0, size=64):
+    rng = np.random.default_rng(seed)
+    return Image.fromarray((rng.random((size, size, 3)) * 255).astype(np.uint8))
+
+
+def _half_mask(size=64):
+    m = np.zeros((size, size), np.uint8)
+    m[:, : size // 2] = 255
+    return Image.fromarray(m)
+
+
+@pytest.fixture(scope="module")
+def tiny_inpaint():
+    return SDPipeline("test/tiny-inpaint")
+
+
+def test_inpaint_arch_detected(tiny_inpaint):
+    assert tiny_inpaint.is_inpaint_unet
+    assert (
+        tiny_inpaint.unet.config.in_channels
+        == 2 * tiny_inpaint.latent_channels + 1
+    )
+
+
+def test_inpaint9_runs(tiny_inpaint):
+    images, config = tiny_inpaint.run(
+        prompt="fill the left half",
+        image=_image(0),
+        mask_image=_half_mask(),
+        num_inference_steps=3,
+        rng=jax.random.key(0),
+    )
+    assert config["mode"] == "inpaint9"
+    assert images[0].size == (64, 64)
+
+
+def test_inpaint9_mask_changes_output(tiny_inpaint):
+    kw = dict(prompt="fill", image=_image(1), num_inference_steps=2,
+              rng=jax.random.key(2))
+    a = np.asarray(tiny_inpaint.run(mask_image=_half_mask(), **kw)[0][0])
+    full = Image.fromarray(np.full((64, 64), 255, np.uint8))
+    b = np.asarray(tiny_inpaint.run(mask_image=full, **kw)[0][0])
+    assert not np.array_equal(a, b)
+
+
+def test_four_channel_model_still_uses_latent_masking():
+    pipe = SDPipeline("test/tiny-sd")
+    _, config = pipe.run(
+        prompt="fill", image=_image(0), mask_image=_half_mask(),
+        num_inference_steps=2, rng=jax.random.key(0),
+    )
+    assert config["mode"] == "inpaint"
+
+
+def test_qr_two_stage_wire_format_image_key():
+    """The hive's txt2img-ControlNet wire delivers the QR as `image`
+    (job_arguments.format_controlnet_args) — the chain must still fire."""
+    pipe = SDPipeline("test/tiny-sd")
+    images, config = pipe.run(
+        prompt="qr",
+        controlnet_prepipeline_type="StableDiffusionPipeline",
+        controlnet_model_name="test/tiny-controlnet",
+        image=_image(5),  # wire position of the QR control image
+        height=64,
+        width=64,
+        num_inference_steps=2,
+        num_images_per_prompt=2,
+        rng=jax.random.key(1),
+    )
+    assert config["prepipeline"] == "qr_two_stage"
+    assert len(images) == 2  # stage 2 keeps the requested batch
+
+
+def test_qr_two_stage_prepipeline():
+    pipe = SDPipeline("test/tiny-sd")
+    images, config = pipe.run(
+        prompt="a qr of a castle",
+        controlnet_prepipeline_type="StableDiffusionPipeline",
+        controlnet_model_name="test/tiny-controlnet",
+        control_image=_image(3),
+        height=64,
+        width=64,
+        num_inference_steps=2,
+        rng=jax.random.key(0),
+    )
+    assert config["prepipeline"] == "qr_two_stage"
+    assert config["timings"]["prepipeline_s"] > 0
+    assert config["mode"] == "img2img"  # stage 2 runs as guided img2img
+    assert config["controlnet"] == "test/tiny-controlnet"
+    assert images[0].size == (64, 64)

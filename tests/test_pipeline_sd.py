@@ -76,7 +76,8 @@ def test_txt2img_seed_changes_output(tiny_sd):
 @pytest.mark.parametrize(
     "scheduler",
     ["EulerDiscreteScheduler", "EulerAncestralDiscreteScheduler",
-     "DDIMScheduler", "LCMScheduler"],
+     "DDIMScheduler", "LCMScheduler", "HeunDiscreteScheduler",
+     "UniPCMultistepScheduler"],
 )
 def test_scheduler_variants(tiny_sd, scheduler):
     images, config = tiny_sd.run(

@@ -25,7 +25,8 @@ def perfect_model(scheduler, schedule, x0_true, sample, i, prediction_type):
     """Closed-form ideal model output for a point-mass distribution."""
     sigma = jnp.asarray(schedule.sigmas)[i]
     name = type(scheduler).__name__
-    if name in ("EulerDiscreteScheduler", "EulerAncestralDiscreteScheduler"):
+    if name in ("EulerDiscreteScheduler", "EulerAncestralDiscreteScheduler",
+                "HeunDiscreteScheduler"):
         # sigma space: x = x0 + sigma*eps
         eps = (sample - x0_true) / jnp.maximum(sigma, 1e-8)
         if prediction_type == "epsilon":
@@ -71,8 +72,9 @@ def run_sampler(scheduler, num_steps, prediction_type, seed=0):
         state, sample = scheduler.step(schedule, state, i, sample, out, noise)
         return (sample, state, key), None
 
+    start, end = scheduler.loop_bounds(schedule, num_steps, 0)
     (sample, _, _), _ = jax.lax.scan(
-        jax.jit(body), (sample, state, key), jnp.arange(num_steps)
+        jax.jit(body), (sample, state, key), jnp.arange(start, end)
     )
     return np.asarray(sample), np.asarray(x0_true)
 
@@ -82,6 +84,8 @@ DETERMINISTIC = [
     "EulerDiscreteScheduler",
     "DDIMScheduler",
     "FlowMatchEulerScheduler",
+    "HeunDiscreteScheduler",
+    "UniPCMultistepScheduler",
 ]
 STOCHASTIC = ["EulerAncestralDiscreteScheduler", "DDPMScheduler", "LCMScheduler"]
 
@@ -123,12 +127,16 @@ def test_karras_option_changes_schedule():
 
 
 def test_timesteps_descending_and_bounded():
-    for name in SCHEDULERS:
+    for name, cls in SCHEDULERS.items():
         sched = get_scheduler(name).schedule(15)
-        assert len(sched.timesteps) == 15
-        assert np.all(np.diff(sched.timesteps) < 0), name
+        # schedule length is solver-defined (Heun interleaves 2 calls/step)
+        assert len(sched.timesteps) == sched.num_steps, name
+        assert len(sched.sigmas) == sched.num_steps + 1, name
         assert sched.sigmas[-1] == 0.0
-        assert len(sched.sigmas) == 16
+        if cls.__name__ == "HeunDiscreteScheduler":
+            assert np.all(np.diff(sched.timesteps) <= 0), name  # repeats
+        else:
+            assert np.all(np.diff(sched.timesteps) < 0), name
 
 
 def test_schedule_is_jit_static():

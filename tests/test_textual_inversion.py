@@ -1,0 +1,171 @@
+"""Textual inversion + per-job custom VAE (VERDICT missing #6).
+
+Reference parity: swarm/diffusion/diffusion_func.py:46-49 (custom VAE via
+job kwargs) and :105-111 (load_textual_inversion with the 'incompatible'
+error contract).
+"""
+
+import numpy as np
+import pytest
+from safetensors.numpy import save_file
+
+import jax
+
+from chiaswarm_tpu.models.tokenizer import HashTokenizer, PlaceholderTokenizer
+from chiaswarm_tpu.pipelines.stable_diffusion import SDPipeline
+from chiaswarm_tpu.settings import Settings, save_settings
+
+
+def test_placeholder_tokenizer_splits_and_maps():
+    base = HashTokenizer(1000)
+    tok = PlaceholderTokenizer(base, {"<fox-style>": [1000, 1001]})
+    ids = tok.encode("a photo in <fox-style> please")
+    assert 1000 in ids and 1001 in ids
+    # placeholder ids are contiguous and ordered
+    i = ids.index(1000)
+    assert ids[i : i + 2] == [1000, 1001]
+    # surrounding words still go through the base encoder
+    assert len(ids) > 2
+    batch = tok(["<fox-style>"])
+    assert batch.shape == (1, 77)
+    assert batch[0, 1] == 1000 and batch[0, 2] == 1001
+
+
+def test_placeholder_tokenizer_without_placeholders_is_passthrough():
+    base = HashTokenizer(1000)
+    tok = PlaceholderTokenizer(base, {})
+    np.testing.assert_array_equal(tok(["hello"]), base(["hello"]))
+
+
+@pytest.fixture()
+def ti_on_disk(sdaas_root, tmp_path):
+    model_root = tmp_path / "models"
+    model_root.mkdir()
+    save_settings(Settings(model_root_dir=str(model_root)))
+    # tiny-sd text encoder hidden size is 32
+    vec = np.random.default_rng(0).standard_normal((2, 32)).astype(np.float32)
+    ti_dir = model_root / "test-ti"
+    ti_dir.mkdir()
+    save_file({"<tiny-style>": vec}, str(ti_dir / "learned_embeds.safetensors"))
+    return "test-ti", vec
+
+
+def test_textual_inversion_changes_output(ti_on_disk):
+    ref, _ = ti_on_disk
+    pipe = SDPipeline("test/tiny-sd")
+    kw = dict(height=64, width=64, num_inference_steps=2, rng=jax.random.key(3))
+    plain = np.asarray(pipe.run(prompt="a <tiny-style> photo", **kw)[0][0])
+    with_ti = np.asarray(
+        pipe.run(prompt="a <tiny-style> photo", textual_inversion=ref, **kw)[0][0]
+    )
+    assert not np.array_equal(plain, with_ti)
+    # cached for the next job
+    assert ref in pipe._ti_cache
+
+
+def test_textual_inversion_extras_and_ids(ti_on_disk):
+    ref, vec = ti_on_disk
+    pipe = SDPipeline("test/tiny-sd")
+    extras, tokenizers = pipe._ti_apply(ref)
+    base_v = pipe.text_encoders[0].config.vocab_size
+    np.testing.assert_allclose(np.asarray(extras[0]), vec, rtol=1e-3)
+    ids = tokenizers[0].encode("<tiny-style>")
+    assert ids == [base_v, base_v + 1]
+
+
+def test_kohya_emb_params_registers_bare_and_bracketed_stem(sdaas_root, tmp_path):
+    model_root = tmp_path / "models"
+    model_root.mkdir()
+    save_settings(Settings(model_root_dir=str(model_root)))
+    vec = np.random.default_rng(1).standard_normal((1, 32)).astype(np.float32)
+    d = model_root / "easyneg"
+    d.mkdir()
+    save_file({"emb_params": vec}, str(d / "easynegative.safetensors"))
+
+    pipe = SDPipeline("test/tiny-sd")
+    extras, tokenizers = pipe._ti_apply("easyneg")
+    base_v = pipe.text_encoders[0].config.vocab_size
+    # both trigger spellings map to the SAME id run
+    assert tokenizers[0].encode("easynegative") == [base_v]
+    assert tokenizers[0].encode("<easynegative>") == [base_v]
+
+
+def test_sdxl_dual_encoder_ti_routes_per_width(sdaas_root, tmp_path):
+    model_root = tmp_path / "models"
+    model_root.mkdir()
+    save_settings(Settings(model_root_dir=str(model_root)))
+    rng = np.random.default_rng(2)
+    # tiny-xl: both encoders are 32-wide, so emulate the dual format with
+    # distinct vectors; each encoder must pick one (the first that matches)
+    vl = rng.standard_normal((1, 32)).astype(np.float32)
+    vg = rng.standard_normal((2, 32)).astype(np.float32)
+    d = model_root / "style-xl"
+    d.mkdir()
+    save_file({"clip_l": vl, "clip_g": vg}, str(d / "papercut.safetensors"))
+
+    pipe = SDPipeline("test/tiny-xl")
+    extras, tokenizers = pipe._ti_apply("style-xl")
+    # file-stem triggers registered on every matching encoder
+    assert tokenizers[0].encode("papercut")
+    assert tokenizers[1].encode("<papercut>")
+    assert extras[0] is not None and extras[1] is not None
+
+
+def test_incompatible_ti_is_fatal_value_error(sdaas_root, tmp_path):
+    model_root = tmp_path / "models"
+    model_root.mkdir()
+    save_settings(Settings(model_root_dir=str(model_root)))
+    bad = model_root / "bad-ti"
+    bad.mkdir()
+    save_file(
+        {"<w>": np.zeros((1, 999), np.float32)},
+        str(bad / "learned_embeds.safetensors"),
+    )
+    pipe = SDPipeline("test/tiny-sd")
+    with pytest.raises(ValueError, match="incompatible"):
+        pipe.run(prompt="x", textual_inversion="bad-ti",
+                 num_inference_steps=2, rng=jax.random.key(0))
+
+
+def test_missing_ti_is_fatal_value_error(sdaas_root):
+    pipe = SDPipeline("test/tiny-sd")
+    with pytest.raises(ValueError, match="Could not load textual inversion"):
+        pipe.run(prompt="x", textual_inversion="nope/missing",
+                 num_inference_steps=2, rng=jax.random.key(0))
+
+
+def test_custom_vae_swaps_decoder(sdaas_root, tmp_path):
+    import jax.numpy as jnp
+
+    from chiaswarm_tpu.models import configs as cfgs
+    from chiaswarm_tpu.models.vae import AutoencoderKL
+
+    model_root = tmp_path / "models"
+    model_root.mkdir()
+    save_settings(Settings(model_root_dir=str(model_root)))
+    # a tiny VAE with different weights, in diffusers torch layout
+    import sys
+
+    sys.path.insert(0, "tests")
+    from test_weights_path import flax_to_torch_layout
+
+    vae = AutoencoderKL(cfgs.TINY_VAE)
+    alt = vae.init(jax.random.key(99), jnp.zeros((1, 16, 16, 3)))["params"]
+    vdir = model_root / "alt-vae"
+    vdir.mkdir()
+    save_file(flax_to_torch_layout(alt), str(vdir / "model.safetensors"))
+
+    pipe = SDPipeline("test/tiny-sd")
+    kw = dict(prompt="v", height=64, width=64, num_inference_steps=2,
+              rng=jax.random.key(1))
+    plain = np.asarray(pipe.run(**kw)[0][0])
+    swapped = np.asarray(pipe.run(vae="alt-vae", **kw)[0][0])
+    assert not np.array_equal(plain, swapped)
+    assert "alt-vae" in pipe._vae_cache
+
+
+def test_missing_custom_vae_is_fatal(sdaas_root):
+    pipe = SDPipeline("test/tiny-sd")
+    with pytest.raises(ValueError, match="Could not load custom VAE"):
+        pipe.run(prompt="x", vae="nope/missing-vae",
+                 num_inference_steps=2, rng=jax.random.key(0))
