@@ -679,7 +679,7 @@ class SDPipeline:
         with self._jit_lock:
             if key in self._programs:
                 return self._programs[key]
-        mode, lh, lw, batch, steps, sched_key, t_start, cn_key, upscale = key
+        mode, lh, lw, batch, steps, sched_key, t_start, cn_key = key
         scheduler = get_scheduler(
             sched_key[0],
             **dict(sched_key[1]),
@@ -697,7 +697,7 @@ class SDPipeline:
         # chunked single-chip decode bounds peak decoder activations on big
         # canvases (batch 4 x 1024^2 OOM'd a v5e chip in round 1); on a
         # multi-chip mesh the batch is sharded so the full decode stays
-        decode_area = lh * lw * (4 if upscale else 1)
+        decode_area = lh * lw
         big_decode = decode_area >= 9216 and batch >= 2 and self.data_parts == 1
 
         def run(params, init_rng, context, added, guidance_scale, image_guidance,
@@ -824,13 +824,6 @@ class SDPipeline:
                 body, (latents.astype(jnp.float32), state),
                 jnp.arange(loop_start, loop_end)
             )
-            if upscale:
-                # reference upscale path: latents leave the main pipeline and
-                # get 2x'd before decode (diffusion_func.py:95 nearest-exact)
-                b_, h_, w_, c_ = latents.shape
-                latents = jax.image.resize(
-                    latents, (b_, 2 * h_, 2 * w_, c_), "nearest"
-                )
             latents = latents.astype(self.dtype)
             if big_decode:
                 pixels = jax.lax.map(
@@ -897,6 +890,18 @@ class SDPipeline:
         # chained stages (reference pipeline_steps.py:40-105 semantics)
         refiner = kwargs.pop("refiner", None)
         upscale = bool(kwargs.pop("upscale", False))
+        upscaler = None
+        if upscale:
+            # resolve (and weight-check) the upscaler BEFORE spending the
+            # denoise: a missing-weights failure must not cost a full job
+            from ..registry import get_pipeline
+            from .upscale import upscaler_name_for
+
+            upscaler = get_pipeline(
+                upscaler_name_for(self.model_name),
+                pipeline_type="StableDiffusionLatentUpscalePipeline",
+                chipset=self.chipset,
+            )
 
         lora = kwargs.pop("lora", None)
         # reference wire: scale rides in cross_attention_kwargs.scale
@@ -1100,7 +1105,7 @@ class SDPipeline:
             scheduler_type,
             tuple(sorted(dataclass_items(sched_cfg))),
         )
-        key = (mode, lh, lw, n_images, steps, sched_key, t_start, cn_key, upscale)
+        key = (mode, lh, lw, n_images, steps, sched_key, t_start, cn_key)
         t0 = time.perf_counter()
         program = self._denoise_program(key, controlnet_module)
         timings["trace_s"] = round(time.perf_counter() - t0, 3)
@@ -1165,6 +1170,16 @@ class SDPipeline:
                     refined.extend(out)
                 images = refined
             timings["refiner_s"] = round(time.perf_counter() - t0, 3)
+
+        if upscaler is not None:
+            # learned SD-x2 latent upscaler stage (reference upscale.py:5-36
+            # chained at diffusion_func.py:163; 20 unguided steps)
+            t0 = time.perf_counter()
+            images = upscaler.upscale(
+                list(images), prompt=prompt, negative_prompt=negative_prompt,
+                rng=jax.random.fold_in(rng, 0x5d2),
+            )
+            timings["upscale_s"] = round(time.perf_counter() - t0, 3)
 
         from ..models.flops import denoise_flops
 

@@ -49,6 +49,8 @@ PIPELINE_FAMILIES: dict[str, str] = {
     "Kandinsky3Pipeline": "kandinsky3",
     "AutoPipelineForText2Image": "sd",
     "StableCascadeDecoderPipeline": "cascade",
+    "StableCascadePriorPipeline": "cascade_prior",
+    "StableCascadeCombinedPipeline": "cascade",
     "FluxPipeline": "flux",
     "AudioLDMPipeline": "audioldm",
     "AnimateDiffPipeline": "animatediff",
@@ -143,7 +145,7 @@ def _ensure_builtin_families() -> None:
         return
     _BUILTINS_LOADED = True
     for module in ("stable_diffusion", "video", "audio", "captioning", "flux",
-                   "kandinsky"):
+                   "kandinsky", "cascade", "upscale"):
         try:
             __import__(f"{__package__}.pipelines.{module}")
         except Exception as e:

@@ -34,6 +34,11 @@ def diffusion_callback(device_identifier: str, model_name: str, **kwargs):
                 "test/tiny-kandinsky-prior" if "prior" in name
                 else "test/tiny-kandinsky"
             )
+        elif "cascade" in name:
+            model_name = (
+                "test/tiny-cascade-prior" if "prior" in name
+                else "test/tiny-cascade"
+            )
         elif "xl" in model_family(model_name):
             model_name = "test/tiny-xl"
         else:

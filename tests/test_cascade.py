@@ -1,0 +1,142 @@
+"""Stable Cascade: stage-C prior -> stage-B decoder -> pixel decode.
+
+Covers VERDICT missing #2 (Stable Cascade family): the
+StableCascadePriorPipeline / StableCascadeDecoderPipeline wire names
+resolve and produce images on tiny configs, with the prior chaining into
+the decoder the way reference swarm/diffusion/pipeline_steps.py:70-90 does
+(decoder consumes `image_embeddings`, 10 unguided steps).
+"""
+
+import numpy as np
+import pytest
+
+import jax
+
+from chiaswarm_tpu import registry
+from chiaswarm_tpu.pipelines.cascade import (
+    PRIOR_CHANNELS,
+    CascadePipeline,
+    CascadePriorPipeline,
+    _decoder_name_for,
+    _prior_name_for,
+)
+from chiaswarm_tpu.weights import MissingWeightsError
+
+
+@pytest.fixture(scope="module")
+def tiny_prior():
+    return CascadePriorPipeline("test/tiny-cascade-prior")
+
+
+@pytest.fixture(scope="module")
+def tiny_decoder():
+    return CascadePipeline("test/tiny-cascade")
+
+
+def test_prior_generates_spatial_latents(tiny_prior):
+    embeds = tiny_prior.generate(
+        "a red fox", num_images=2, steps=2, height=64, width=64,
+        rng=jax.random.key(0),
+    )
+    # 64px at tiny compression 8 -> 8x8 spatial latent, 16 channels
+    assert embeds.shape == (2, 8, 8, PRIOR_CHANNELS)
+    assert np.isfinite(np.asarray(embeds)).all()
+
+
+def test_prior_deterministic(tiny_prior):
+    gen = lambda: np.asarray(
+        tiny_prior.generate("same", steps=2, rng=jax.random.key(3))
+    )
+    np.testing.assert_array_equal(gen(), gen())
+
+
+def test_decoder_from_explicit_embeddings(tiny_decoder):
+    embeds = np.random.default_rng(0).standard_normal(
+        (1, 8, 8, PRIOR_CHANNELS)
+    ).astype(np.float32)
+    images, config = tiny_decoder.run(
+        image_embeddings=embeds, height=64, width=64, num_inference_steps=2,
+        rng=jax.random.key(0),
+    )
+    assert images[0].size == (64, 64)
+    assert "prior_s" not in config["timings"]  # prior stage skipped
+
+
+def test_decoder_runs_prior_when_prompted(tiny_decoder):
+    images, config = tiny_decoder.run(
+        prompt="a fox in the snow", height=64, width=64,
+        num_inference_steps=2, rng=jax.random.key(0),
+    )
+    assert images[0].size == (64, 64)
+    assert config["timings"]["prior_s"] > 0
+
+
+def test_prior_typed_job_chains_into_decoder(tiny_prior):
+    # the hive schedules the PRIOR as the main pipeline with a `decoder`
+    # parameter (reference diffusion_func.py:151-161)
+    images, config = tiny_prior.run(
+        prompt="a lighthouse",
+        height=64,
+        width=64,
+        num_inference_steps=2,
+        decoder={"model_name": "stabilityai/stable-cascade",
+                 "num_inference_steps": 2},
+        rng=jax.random.key(1),
+    )
+    assert images[0].size == (64, 64)
+    assert config["prior"]["steps"] == 2
+    assert config["steps"] == 2  # decoder honored its own step count
+
+
+def test_embeddings_condition_the_decoder(tiny_decoder):
+    rng = np.random.default_rng(1)
+    kw = dict(height=64, width=64, num_inference_steps=2, rng=jax.random.key(7))
+    a = np.asarray(tiny_decoder.run(
+        image_embeddings=rng.standard_normal(
+            (1, 8, 8, PRIOR_CHANNELS)).astype(np.float32), **kw)[0][0])
+    b = np.asarray(tiny_decoder.run(
+        image_embeddings=rng.standard_normal(
+            (1, 8, 8, PRIOR_CHANNELS)).astype(np.float32), **kw)[0][0])
+    assert not np.array_equal(a, b)
+
+
+def test_decoder_batch_follows_embeddings(tiny_decoder):
+    embeds = np.random.default_rng(2).standard_normal(
+        (3, 8, 8, PRIOR_CHANNELS)
+    ).astype(np.float32)
+    images, _ = tiny_decoder.run(
+        image_embeddings=embeds, height=64, width=64, num_inference_steps=2,
+        rng=jax.random.key(0),
+    )
+    assert len(images) == 3
+
+
+def test_registry_wire_names():
+    pipe = registry.get_pipeline(
+        "test/tiny-cascade", "StableCascadeDecoderPipeline"
+    )
+    assert isinstance(pipe, CascadePipeline)
+    prior = registry.get_pipeline(
+        "test/tiny-cascade-prior", "StableCascadePriorPipeline"
+    )
+    assert isinstance(prior, CascadePriorPipeline)
+
+
+def test_name_mapping():
+    assert _prior_name_for("test/tiny-cascade") == "test/tiny-cascade-prior"
+    assert _decoder_name_for("test/tiny-cascade-prior") == "test/tiny-cascade"
+    assert (
+        _decoder_name_for("stabilityai/stable-cascade-prior")
+        == "stabilityai/stable-cascade"
+    )
+    assert (
+        _prior_name_for("stabilityai/stable-cascade")
+        == "stabilityai/stable-cascade-prior"
+    )
+
+
+def test_real_weights_fail_loud():
+    with pytest.raises(MissingWeightsError):
+        CascadePipeline("stabilityai/stable-cascade")
+    with pytest.raises(MissingWeightsError):
+        CascadePriorPipeline("stabilityai/stable-cascade-prior")

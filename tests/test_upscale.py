@@ -1,0 +1,86 @@
+"""Learned SD-x2 latent upscaler (VERDICT missing #5): the
+StableDiffusionLatentUpscalePipeline wire name resolves to a real
+noise-conditioned upscaling diffusion, not a nearest-neighbor resize.
+Reference: swarm/post_processors/upscale.py:5-36.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+from PIL import Image
+
+from chiaswarm_tpu import registry
+from chiaswarm_tpu.pipelines.upscale import (
+    LatentUpscalePipeline,
+    upscaler_name_for,
+)
+from chiaswarm_tpu.weights import MissingWeightsError
+
+
+@pytest.fixture(scope="module")
+def tiny_upscaler():
+    return LatentUpscalePipeline("test/tiny-upscaler")
+
+
+def _checker(size=64):
+    a = np.indices((size, size)).sum(axis=0) % 16 < 8
+    return Image.fromarray((a * 255).astype(np.uint8)).convert("RGB")
+
+
+def test_upscale_doubles(tiny_upscaler):
+    out = tiny_upscaler.upscale(
+        [_checker()], prompt="sharp checkerboard", steps=2,
+        rng=jax.random.key(0),
+    )
+    assert out[0].size == (128, 128)
+
+
+def test_input_conditions_output(tiny_upscaler):
+    kw = dict(prompt="", steps=2, rng=jax.random.key(1))
+    a = np.asarray(tiny_upscaler.upscale([_checker()], **kw)[0])
+    solid = Image.new("RGB", (64, 64), (200, 30, 30))
+    b = np.asarray(tiny_upscaler.upscale([solid], **kw)[0])
+    assert not np.array_equal(a, b)
+
+
+def test_batch(tiny_upscaler):
+    out = tiny_upscaler.upscale(
+        [_checker(), _checker()], steps=2, rng=jax.random.key(0)
+    )
+    assert len(out) == 2
+
+
+def test_standalone_run(tiny_upscaler):
+    images, config = tiny_upscaler.run(
+        prompt="x", image=_checker(), num_inference_steps=2,
+        rng=jax.random.key(0),
+    )
+    assert images[0].size == (128, 128)
+    assert config["mode"] == "upscale"
+    assert config["size"] == [128, 128]
+
+
+def test_standalone_requires_image(tiny_upscaler):
+    with pytest.raises(ValueError, match="requires an input image"):
+        tiny_upscaler.run(prompt="x")
+
+
+def test_registry_wire_name():
+    pipe = registry.get_pipeline(
+        "test/tiny-upscaler", "StableDiffusionLatentUpscalePipeline"
+    )
+    assert isinstance(pipe, LatentUpscalePipeline)
+
+
+def test_chain_name_mapping():
+    assert upscaler_name_for("test/tiny-sd") == "test/tiny-upscaler"
+    assert (
+        upscaler_name_for("stabilityai/stable-diffusion-2-1")
+        == "stabilityai/sd-x2-latent-upscaler"
+    )
+
+
+def test_real_weights_fail_loud():
+    with pytest.raises(MissingWeightsError):
+        LatentUpscalePipeline("stabilityai/sd-x2-latent-upscaler")
