@@ -1,0 +1,288 @@
+"""Bark TTS pipeline: text -> semantic -> coarse -> fine -> waveform.
+
+Reference behavior replaced: swarm/audio/bark.py:16-21 (suno-bark
+`preload_models()` + `generate_audio()` per job, wav -> mp3). The TPU
+rebuild keeps the four-stage suno/bark architecture (models/bark.py) as
+ONE resident jitted program per (prompt-budget, duration) bucket: both AR
+stages run as `lax.scan` KV-cache loops, the fine stage refines codebooks
+3..8 with a bidirectional transformer, and the codec decoder emits the
+waveform — text-in, audio-out in a single XLA program, nothing returns to
+the host between stages. Real suno/bark weight conversion is not wired
+yet, so non-test model names fail loudly per weights.py.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+import zlib
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..models.bark import (
+    CODEBOOK_SIZE,
+    CODEC_RATE,
+    N_COARSE_BOOKS,
+    N_FINE_BOOKS,
+    SEMANTIC_RATE,
+    SEMANTIC_VOCAB,
+    BarkGPT,
+    CodecDecoder,
+    bark_small,
+    bark_tiny,
+    generate,
+)
+from ..models.bert_tokenizer import HashBertTokenizer
+from ..parallel.mesh import make_mesh, replicated
+from ..registry import register_family
+from ..weights import is_test_model, require_weights_present
+
+logger = logging.getLogger(__name__)
+
+SAMPLE_RATE = 24_000  # EnCodec rate the bark codec targets
+
+_NO_CONVERSION_HINT = (
+    "This worker cannot serve real suno/bark weights yet; only the "
+    "test/tiny bark stack is available."
+)
+
+
+_is_tiny = is_test_model
+
+
+class BarkPipeline:
+    """Resident 4-stage TTS stack serving `suno/bark*` model names."""
+
+    def __init__(self, model_name: str, chipset=None,
+                 allow_random_init: bool = False):
+        require_weights_present(
+            model_name, None, allow_random_init, component="Bark TTS",
+            hint=_NO_CONVERSION_HINT,
+        )
+        self.model_name = model_name
+        self.chipset = chipset
+        self.tiny = _is_tiny(model_name)
+        mk = bark_tiny if self.tiny else bark_small
+        self.sem_cfg = mk("semantic")
+        self.coarse_cfg = mk("coarse")
+        self.fine_cfg = mk("fine")
+        # OUTPUT-vocab slice width of one coarse codebook
+        self.cb = self.coarse_cfg.output_vocab // N_COARSE_BOOKS
+        # token rates scale down on the tiny stack so tests stay fast
+        self.sem_rate = 8 if self.tiny else SEMANTIC_RATE
+        self.codec_rate = 8 if self.tiny else CODEC_RATE
+
+        on_tpu = jax.default_backend() == "tpu"
+        self.dtype = jnp.bfloat16 if on_tpu else jnp.float32
+        self.semantic = BarkGPT(self.sem_cfg, dtype=self.dtype)
+        self.coarse = BarkGPT(self.coarse_cfg, dtype=self.dtype)
+        self.fine = BarkGPT(self.fine_cfg, dtype=self.dtype)
+        self.codec = CodecDecoder(
+            n_books=N_FINE_BOOKS,
+            codebook_size=self.cb,
+            d_model=32 if self.tiny else 128,
+            ratios=(4, 2) if self.tiny else (8, 5, 4, 2),
+            dtype=self.dtype,
+        )
+        self.hop = int(np.prod(self.codec.ratios))
+        # text ids ride above the semantic ids in the semantic input vocab
+        self.text_vocab = self.sem_cfg.input_vocab - SEMANTIC_VOCAB \
+            if not self.tiny else self.sem_cfg.input_vocab - 1000
+        self.sem_out = self.sem_cfg.output_vocab
+        self.tokenizer = HashBertTokenizer(self.text_vocab)
+        self.mesh = (
+            chipset.mesh() if chipset is not None else make_mesh(jax.devices()[:1])
+        )
+
+        rng = jax.random.key(zlib.crc32(model_name.encode()))
+        k1, k2, k3, k4 = jax.random.split(rng, 4)
+        with jax.default_device(jax.local_devices(backend="cpu")[0]):
+            sem_params = self.semantic.init(
+                k1, jnp.zeros((1, 8), jnp.int32)
+            )["params"]
+            coarse_params = self.coarse.init(
+                k2, jnp.zeros((1, 8), jnp.int32)
+            )["params"]
+            fine_params = self.fine.init(
+                k3, jnp.zeros((1, N_FINE_BOOKS, 8), jnp.int32)
+            )["params"]
+            codec_params = self.codec.init(
+                k4, jnp.zeros((1, N_FINE_BOOKS, 8), jnp.int32)
+            )["params"]
+        cast = lambda x: (
+            jnp.asarray(x, self.dtype) if jnp.issubdtype(
+                jnp.asarray(x).dtype, jnp.floating) else jnp.asarray(x)
+        )
+        self.params = jax.device_put(
+            jax.tree_util.tree_map(cast, {
+                "semantic": sem_params,
+                "coarse": coarse_params,
+                "fine": fine_params,
+                "codec": codec_params,
+            }),
+            replicated(self.mesh),
+        )
+        self._programs: dict[tuple, callable] = {}
+        self._lock = threading.Lock()
+
+    def release(self):
+        self.params = None
+        self._programs.clear()
+
+    def _program(self, key: tuple):
+        """One fused text->waveform program."""
+        with self._lock:
+            if key in self._programs:
+                return self._programs[key]
+        t_text, n_sem, n_frames = key
+        semantic, coarse, fine, codec = (
+            self.semantic, self.coarse, self.fine, self.codec
+        )
+        cb = self.cb
+        sem_offset = SEMANTIC_VOCAB if not self.tiny else 1000
+        n_coarse_tokens = n_frames * N_COARSE_BOOKS
+
+        def run(params, rng, text_ids, temperature):
+            k_sem, k_coarse, k_fine = jax.random.split(rng, 3)
+            # stage 1: text -> semantic (text ids arrive pre-offset)
+            sem = generate(
+                semantic, params["semantic"], text_ids, n_sem, k_sem,
+                temperature=temperature,
+            )
+            # stage 2: semantic -> coarse, codebooks interleaved with a
+            # parity range constraint; coarse ids ride above semantic ids
+            # in the coarse input vocab
+            def parity_range(gen_idx):
+                lo = (gen_idx % N_COARSE_BOOKS) * cb
+                return lo, lo + cb
+
+            coarse_tokens = generate(
+                coarse, params["coarse"], sem, n_coarse_tokens, k_coarse,
+                temperature=temperature, input_offset=sem_offset,
+                range_fn=parity_range,
+            )
+            # de-interleave [B, 2*T] -> [B, 2, T]; strip the parity offset
+            c = coarse_tokens.reshape(
+                coarse_tokens.shape[0], n_frames, N_COARSE_BOOKS
+            )
+            c = jnp.moveaxis(c, 1, 2) - (jnp.arange(N_COARSE_BOOKS) * cb)[
+                None, :, None
+            ]
+            c = jnp.clip(c, 0, cb - 1)
+            # stage 3: fine refinement — codebooks 3..8 predicted from all
+            # books so far (bidirectional, one pass per book)
+            codes = jnp.concatenate(
+                [c] + [jnp.zeros_like(c[:, :1])] * (N_FINE_BOOKS - N_COARSE_BOOKS),
+                axis=1,
+            )
+            book_offsets = (jnp.arange(N_FINE_BOOKS) * cb)[None, :, None]
+            for target in range(N_COARSE_BOOKS, N_FINE_BOOKS):
+                logits = fine.apply(
+                    {"params": params["fine"]}, codes + book_offsets
+                )
+                sampled = jax.random.categorical(
+                    jax.random.fold_in(k_fine, target),
+                    logits.astype(jnp.float32)
+                    / jnp.maximum(jnp.asarray(temperature, jnp.float32), 1e-4),
+                )
+                codes = codes.at[:, target].set(jnp.clip(sampled, 0, cb - 1))
+            # stage 4: codec decode to waveform
+            return codec.apply({"params": params["codec"]}, codes)
+
+        program = jax.jit(run)
+        with self._lock:
+            self._programs[key] = program
+        return program
+
+    def run(self, prompt="", **kwargs):
+        params = self.params
+        if params is None:
+            raise Exception(
+                f"pipeline {self.model_name} was evicted; resubmit the job"
+            )
+        timings: dict[str, float] = {}
+        duration = float(kwargs.pop("duration", 2.0 if self.tiny else 5.0))
+        duration = min(duration, 16.0)
+        temperature = float(kwargs.pop("temperature", 0.7))
+        rng = kwargs.pop("rng", None)
+        if rng is None:
+            rng = jax.random.key(0)
+        kwargs.pop("chipset", None)
+        kwargs.pop("negative_prompt", None)
+        kwargs.pop("num_inference_steps", None)  # TTS has no denoise steps
+
+        # static text budget: bucket to 32-token multiples
+        ids = self.tokenizer.encode(prompt)[: self.sem_cfg.block_size // 4]
+        t_text = max(32, (len(ids) + 31) // 32 * 32)
+        sem_offset = SEMANTIC_VOCAB if not self.tiny else 1000
+        text_arr = np.zeros((1, t_text), np.int32)
+        text_arr[0, : len(ids)] = np.asarray(ids, np.int32) % self.text_vocab
+        text_arr = text_arr + sem_offset  # text ids live above semantic ids
+
+        n_sem = max(8, int(duration * self.sem_rate))
+        n_frames = max(8, int(duration * self.codec_rate))
+        # every stage's (prompt + generation) must fit its position table
+        n_sem = min(n_sem, self.sem_cfg.block_size - t_text)
+        n_frames = min(
+            n_frames,
+            (self.coarse_cfg.block_size - n_sem) // N_COARSE_BOOKS,
+            self.fine_cfg.block_size,
+        )
+        program = self._program((t_text, n_sem, n_frames))
+        t0 = time.perf_counter()
+        wav = jax.block_until_ready(
+            program(params, rng, jnp.asarray(text_arr),
+                    jnp.float32(temperature))
+        )
+        timings["generate_s"] = round(time.perf_counter() - t0, 3)
+
+        wav = np.asarray(wav[0], np.float32)
+        peak = float(np.max(np.abs(wav))) or 1.0
+        wav = wav / peak * 0.95
+        rate = self.hop * self.codec_rate  # samples/sec this stack emits
+        config = {
+            "model": self.model_name,
+            "pipeline": "BarkPipeline",
+            "mode": "txt2audio",
+            "duration_s": round(len(wav) / rate, 3),
+            "sample_rate": rate,
+            "semantic_tokens": n_sem,
+            "codec_frames": n_frames,
+            "timings": timings,
+        }
+        return wav, rate, config
+
+
+@register_family("bark")
+def _build_bark(model_name, chipset, **variant):
+    return BarkPipeline(model_name, chipset, **variant)
+
+
+def run_bark(device_identifier: str, model_name: str, **kwargs):
+    """txt2audio (Bark) job -> wav artifact (reference swarm/audio/bark.py).
+
+    Bark jobs dispatch before parameter formatting (job_arguments.py:55-58
+    mirrors reference :29-30), so the raw `parameters` may still ride in."""
+    from ..post_processors.output_processor import make_result
+    from ..registry import get_pipeline
+    from .audio import wav_to_buffer
+
+    parameters = kwargs.pop("parameters", {}) or {}
+    kwargs.pop("content_type", None)  # mp3 needs pydub/ffmpeg: emit wav
+    kwargs.pop("outputs", None)
+    if kwargs.pop("test_tiny_model", False) or parameters.pop(
+        "test_tiny_model", False
+    ):
+        model_name = "test/tiny-bark"
+    kwargs.update(parameters)
+    pipeline = get_pipeline(
+        model_name, pipeline_type="BarkPipeline",
+        chipset=kwargs.pop("chipset", None),
+    )
+    wav, rate, config = pipeline.run(**kwargs)
+    return {
+        "primary": make_result(wav_to_buffer(wav, rate), None, "audio/wav")
+    }, config

@@ -39,7 +39,7 @@ from ..models.vae import AutoencoderKL, VAEConfig
 from ..parallel.mesh import make_mesh, replicated
 from ..registry import register_family
 from ..schedulers import get_scheduler
-from ..weights import require_weights_present
+from ..weights import is_test_model, require_weights_present
 
 logger = logging.getLogger(__name__)
 
@@ -52,8 +52,7 @@ _NO_CONVERSION_HINT = (
 PRIOR_CHANNELS = 16
 
 
-def _is_tiny(name: str) -> bool:
-    return "tiny" in name.lower() or name.startswith("test/")
+_is_tiny = is_test_model
 
 
 # stage-C prior UNet (StableCascadeUNet stage-C analog: text-conditioned,
@@ -98,8 +97,8 @@ def _prior_configs(model_name: str):
     if _is_tiny(model_name):
         return TINY_PRIOR_UNET, cfgs.TINY_CLIP_2, 8, 64
     # Stable Cascade conditions on the OpenCLIP ViT-bigG text tower; the
-    # stage-C latent is ~42x compressed (1024^2 -> 24x24)
-    return CASCADE_PRIOR_UNET, cfgs.SDXL_CLIP_2, 42, 1024
+    # stage-C latent is ~42.67x compressed (1024^2 -> 24x24, factor 1024/24)
+    return CASCADE_PRIOR_UNET, cfgs.SDXL_CLIP_2, 1024 / 24, 1024
 
 
 def _decoder_configs(model_name: str):
@@ -268,18 +267,11 @@ class CascadePriorPipeline:
         if rng is None:
             rng = jax.random.key(0)
         prior_rng, dec_rng = jax.random.split(rng)
-        t0 = time.perf_counter()
-        embeds = jax.block_until_ready(
-            self.generate(
-                prompt, negative_prompt, num_images=n_images, steps=steps,
-                guidance_scale=guidance_scale, height=height, width=width,
-                rng=prior_rng,
-            )
-        )
-        timings["prior_s"] = round(time.perf_counter() - t0, 3)
 
-        # reference pipeline_steps.py:70-90: decoder stage consumes the
-        # embeddings with 10 steps, guidance 0
+        # resolve (and weight-check) the decoder BEFORE the prior denoise
+        # so a missing-weights failure doesn't cost the whole stage-C run
+        # (reference pipeline_steps.py:70-90: decoder stage consumes the
+        # embeddings with 10 steps, guidance 0)
         from ..registry import get_pipeline
 
         decoder_name = decoder.get(
@@ -295,6 +287,16 @@ class CascadePriorPipeline:
             ),
             chipset=chipset,
         )
+
+        t0 = time.perf_counter()
+        embeds = jax.block_until_ready(
+            self.generate(
+                prompt, negative_prompt, num_images=n_images, steps=steps,
+                guidance_scale=guidance_scale, height=height, width=width,
+                rng=prior_rng,
+            )
+        )
+        timings["prior_s"] = round(time.perf_counter() - t0, 3)
         images, pipeline_config = decoder_pipe.run(
             image_embeddings=embeds,
             num_inference_steps=int(decoder.get("num_inference_steps", 10)),

@@ -1,0 +1,336 @@
+"""DeepFloyd IF cascade: pixel-space base diffusion + super-resolution.
+
+Reference behavior replaced: swarm/diffusion/diffusion_func_if.py:13-69 —
+a 3-stage cascade (IF-I 64px -> IF-II 256px -> x4 upscaler) that was
+shipped half-finished: prompt embeddings were `torch.randn` placeholders
+(:34-36) and :62 referenced an undefined variable (NameError on every
+job). The capability is rebuilt here for real.
+
+TPU redesign: both IF stages are resident jitted programs operating in
+PIXEL space (no VAE anywhere — that is the defining trait of this family).
+Stage I denoises a 64px RGB canvas under one `lax.scan` with CFG as a
+batch of 2, cross-attending on real T5 encodings (the reference family
+conditions on T5-XL; the same `models/t5.py` encoder that serves Flux).
+Stage II concatenates the 4x nearest-upsampled stage-I output onto the
+noise channels (6-channel UNet input, the IF super-res conditioning
+scheme) and denoises at 256px. The reference's third stage (an SD x4
+upscaler) maps onto this package's learned latent upscaler when the job
+requests `upscale`. Real-weight conversion for this family is not wired
+yet, so non-test model names fail loudly per weights.py.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+import zlib
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from PIL import Image
+
+from ..models.t5 import TINY_T5, T5Config, T5Encoder
+from ..models.unet2d import UNet2DConditionModel, UNet2DConfig
+from ..parallel.mesh import make_mesh, replicated
+from ..registry import register_family
+from ..schedulers import get_scheduler
+from ..weights import is_test_model, require_weights_present
+
+logger = logging.getLogger(__name__)
+
+_NO_CONVERSION_HINT = (
+    "This worker cannot serve real DeepFloyd IF weights yet; only the "
+    "test/tiny IF cascade is available."
+)
+
+# stage II upsamples the base canvas by this factor
+SR_FACTOR = 4
+
+
+_is_tiny = is_test_model
+
+
+# IF-I geometry (DeepFloyd/IF-I-XL analog, approximated): pixel-space UNet,
+# T5 cross-attention
+IF_BASE_UNET = UNet2DConfig(
+    in_channels=3,
+    out_channels=3,
+    block_out_channels=(320, 640, 1280, 1280),
+    transformer_layers=(0, 1, 1, 1),
+    num_attention_heads=(5, 10, 20, 20),
+    cross_attention_dim=4096,
+)
+# IF-II: 6ch input (noise + upsampled base image)
+IF_SR_UNET = UNet2DConfig(
+    in_channels=6,
+    out_channels=3,
+    block_out_channels=(128, 256, 512, 1024),
+    transformer_layers=(0, 0, 1, 1),
+    num_attention_heads=(2, 4, 8, 16),
+    cross_attention_dim=4096,
+)
+TINY_IF_BASE = UNet2DConfig(
+    in_channels=3,
+    out_channels=3,
+    block_out_channels=(32, 64),
+    transformer_layers=(1, 1),
+    mid_transformer_layers=1,
+    layers_per_block=1,
+    num_attention_heads=4,
+    cross_attention_dim=32,
+)
+TINY_IF_SR = UNet2DConfig(
+    in_channels=6,
+    out_channels=3,
+    block_out_channels=(32, 64),
+    transformer_layers=(0, 1),
+    mid_transformer_layers=1,
+    layers_per_block=1,
+    num_attention_heads=4,
+    cross_attention_dim=32,
+)
+
+
+def _configs(model_name: str):
+    """(base_cfg, sr_cfg, t5_cfg, base_size)."""
+    if _is_tiny(model_name):
+        return TINY_IF_BASE, TINY_IF_SR, TINY_T5, 32
+    return IF_BASE_UNET, IF_SR_UNET, T5Config(), 64
+
+
+class DeepFloydIFPipeline:
+    """Resident two-stage IF cascade serving `DeepFloyd/*` model names."""
+
+    def __init__(self, model_name: str, chipset=None,
+                 allow_random_init: bool = False):
+        require_weights_present(
+            model_name, None, allow_random_init, component="DeepFloyd IF",
+            hint=_NO_CONVERSION_HINT,
+        )
+        self.model_name = model_name
+        self.chipset = chipset
+        base_cfg, sr_cfg, t5_cfg, self.base_size = _configs(model_name)
+        on_tpu = jax.default_backend() == "tpu"
+        self.dtype = jnp.bfloat16 if on_tpu else jnp.float32
+        self.base_unet = UNet2DConditionModel(base_cfg, dtype=self.dtype)
+        self.sr_unet = UNet2DConditionModel(sr_cfg, dtype=self.dtype)
+        self.t5 = T5Encoder(t5_cfg, dtype=self.dtype)
+        from .flux import _load_t5_tokenizer
+
+        self.tokenizer = _load_t5_tokenizer(None, t5_cfg.vocab_size)
+        self.mesh = (
+            chipset.mesh() if chipset is not None else make_mesh(jax.devices()[:1])
+        )
+
+        rng = jax.random.key(zlib.crc32(model_name.encode()))
+        k1, k2, k3 = jax.random.split(rng, 3)
+        hw = 2 ** max(len(base_cfg.block_out_channels) - 1, 2)
+        with jax.default_device(jax.local_devices(backend="cpu")[0]):
+            base_params = self.base_unet.init(
+                k1,
+                jnp.zeros((1, hw, hw, 3)),
+                jnp.zeros((1,)),
+                jnp.zeros((1, 77, base_cfg.cross_attention_dim)),
+            )["params"]
+            sr_params = self.sr_unet.init(
+                k2,
+                jnp.zeros((1, hw, hw, 6)),
+                jnp.zeros((1,)),
+                jnp.zeros((1, 77, sr_cfg.cross_attention_dim)),
+            )["params"]
+            t5_params = self.t5.init(
+                k3, jnp.zeros((1, 16), jnp.int32)
+            )["params"]
+        cast = lambda x: jnp.asarray(x, self.dtype)
+        self.params = jax.device_put(
+            jax.tree_util.tree_map(cast, {
+                "base": base_params,
+                "sr": sr_params,
+                "t5": t5_params,
+            }),
+            replicated(self.mesh),
+        )
+        self._programs: dict[tuple, callable] = {}
+        self._lock = threading.Lock()
+
+    def release(self):
+        self.params = None
+        self._programs.clear()
+
+    def _program(self, key: tuple):
+        """One fused program: stage-I denoise -> 4x upsample -> stage-II
+        denoise. Pixel space end to end; nothing leaves the device."""
+        with self._lock:
+            if key in self._programs:
+                return self._programs[key]
+        size, batch, steps, sr_steps = key
+        scheduler = get_scheduler("DDPMScheduler")
+        base_schedule = scheduler.schedule(steps)
+        sr_schedule = scheduler.schedule(sr_steps)
+        base_unet = self.base_unet
+        sr_unet = self.sr_unet
+        sr_size = size * SR_FACTOR
+
+        def denoise(rng, shape, schedule_, n_steps, model_fn):
+            latents = jax.random.normal(rng, shape, jnp.float32) * jnp.asarray(
+                schedule_.init_noise_sigma, jnp.float32
+            )
+            state = scheduler.init_state(latents.shape, latents.dtype)
+
+            def body(carry, i):
+                latents, state = carry
+                inp = scheduler.scale_model_input(schedule_, latents, i)
+                t = jnp.asarray(schedule_.timesteps)[i]
+                pred = model_fn(inp, t, i)
+                noise = jax.random.normal(
+                    jax.random.fold_in(rng, i), latents.shape, jnp.float32
+                )
+                state, latents = scheduler.step(
+                    schedule_, state, i, latents, pred, noise
+                )
+                return (latents, state), ()
+
+            (latents, _), _ = jax.lax.scan(
+                body, (latents, state), jnp.arange(n_steps)
+            )
+            return latents
+
+        def run(params, rng, context, guidance):
+            """context [2B,77,D] rows [uncond | cond]."""
+            base_rng, sr_rng = jax.random.split(rng)
+
+            def base_fn(inp, t, i):
+                model_in = jnp.concatenate([inp, inp], axis=0).astype(self.dtype)
+                pred = base_unet.apply(
+                    {"params": params["base"]},
+                    model_in,
+                    jnp.broadcast_to(t, (2 * batch,)),
+                    context,
+                ).astype(jnp.float32)
+                pred_u, pred_c = jnp.split(pred, 2, axis=0)
+                return pred_u + guidance * (pred_c - pred_u)
+
+            base_px = denoise(
+                base_rng, (batch, size, size, 3), base_schedule, steps, base_fn
+            )
+
+            cond = jax.image.resize(
+                base_px, (batch, sr_size, sr_size, 3), "nearest"
+            )
+
+            def sr_fn(inp, t, i):
+                model_in = jnp.concatenate(
+                    [
+                        jnp.concatenate([inp, cond], axis=-1),
+                        jnp.concatenate([inp, cond], axis=-1),
+                    ],
+                    axis=0,
+                ).astype(self.dtype)
+                pred = sr_unet.apply(
+                    {"params": params["sr"]},
+                    model_in,
+                    jnp.broadcast_to(t, (2 * batch,)),
+                    context,
+                ).astype(jnp.float32)
+                pred_u, pred_c = jnp.split(pred, 2, axis=0)
+                return pred_u + guidance * (pred_c - pred_u)
+
+            pixels = denoise(
+                sr_rng, (batch, sr_size, sr_size, 3), sr_schedule, sr_steps,
+                sr_fn,
+            )
+            return (
+                (pixels.astype(jnp.float32) + 1.0) * 127.5
+            ).clip(0.0, 255.0).round().astype(jnp.uint8)
+
+        program = jax.jit(run)
+        with self._lock:
+            self._programs[key] = program
+        return program
+
+    def run(self, prompt="", negative_prompt="", pipeline_type="IFPipeline",
+            **kwargs):
+        params = self.params
+        if params is None:
+            raise Exception(
+                f"pipeline {self.model_name} was evicted; resubmit the job"
+            )
+        if pipeline_type == "IFSuperResolutionPipeline":
+            # a standalone SR-typed job would need the caller's image;
+            # silently regenerating from the prompt would violate the
+            # fail-loud policy (the SR stage runs inside the cascade)
+            raise Exception(
+                "IFSuperResolutionPipeline is not schedulable standalone on "
+                "this worker; submit the base DeepFloyd model (the super-"
+                "resolution stage runs inside the cascade)."
+            )
+        timings: dict[str, float] = {}
+        steps = int(kwargs.pop("num_inference_steps", 30))
+        sr_steps = int(kwargs.pop("sr_steps", None) or max(steps // 2, 2))
+        guidance_scale = float(kwargs.pop("guidance_scale", 7.0))
+        n_images = int(kwargs.pop("num_images_per_prompt", 1))
+        rng = kwargs.pop("rng", None)
+        if rng is None:
+            rng = jax.random.key(0)
+        chipset = kwargs.pop("chipset", None)
+        kwargs.pop("height", None)  # the cascade geometry fixes the canvas
+        kwargs.pop("width", None)
+        upscaler = None
+        if kwargs.pop("upscale", False):
+            # the reference's stage 3 (x4 SD upscaler, diffusion_func_if.py)
+            # maps onto the learned latent upscaler; resolve BEFORE the
+            # denoise so missing weights fail fast
+            from ..registry import get_pipeline
+            from .upscale import upscaler_name_for
+
+            upscaler = get_pipeline(
+                upscaler_name_for(self.model_name),
+                pipeline_type="StableDiffusionLatentUpscalePipeline",
+                chipset=chipset,
+            )
+
+        texts = [negative_prompt] * n_images + [prompt] * n_images
+        max_seq = 77
+        ids = jnp.asarray(
+            np.asarray(self.tokenizer(texts, max_seq), np.int32)
+        )
+        t0 = time.perf_counter()
+        context = self.t5.apply({"params": params["t5"]}, ids)
+        timings["text_encode_s"] = round(time.perf_counter() - t0, 3)
+
+        program = self._program((self.base_size, n_images, steps, sr_steps))
+        t0 = time.perf_counter()
+        pixels = jax.block_until_ready(
+            program(params, rng, context, jnp.float32(guidance_scale))
+        )
+        timings["denoise_s"] = round(time.perf_counter() - t0, 3)
+
+        images = [Image.fromarray(img) for img in np.asarray(pixels)]
+        out_size = self.base_size * SR_FACTOR
+        if upscaler is not None:
+            t0 = time.perf_counter()
+            images = upscaler.upscale(
+                images, prompt=prompt, negative_prompt=negative_prompt,
+                rng=jax.random.fold_in(rng, 0x1f),
+            )
+            timings["upscale_s"] = round(time.perf_counter() - t0, 3)
+            out_size *= 2
+        pipeline_config = {
+            "model": self.model_name,
+            "pipeline": pipeline_type,
+            "scheduler": "DDPMScheduler",
+            "mode": "txt2img",
+            "steps": steps,
+            "sr_steps": sr_steps,
+            "size": [out_size, out_size],
+            "guidance_scale": guidance_scale,
+            "timings": timings,
+        }
+        return images, pipeline_config
+
+
+@register_family("deepfloyd_if")
+def _build_if(model_name, chipset, **variant):
+    return DeepFloydIFPipeline(model_name, chipset, **variant)

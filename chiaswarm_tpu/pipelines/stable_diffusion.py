@@ -1204,6 +1204,13 @@ class SDPipeline:
                 if image_guidance is not None and mode == "img2img"
                 else {}
             ),
+            # `size` stays the requested canvas (reference parity); the
+            # learned upscaler stage doubles the actual output
+            **(
+                {"output_size": [2 * width, 2 * height], "upscaled": True}
+                if upscaler is not None
+                else {}
+            ),
             # analytic UNet FLOPs of the denoise loop -> MFU in the bench
             "unet_tflops": round(
                 denoise_flops(self.unet.config, lh, lw, n_images, steps - t_start,

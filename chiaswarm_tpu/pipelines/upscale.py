@@ -34,7 +34,7 @@ from ..models.vae import AutoencoderKL
 from ..parallel.mesh import make_mesh, replicated
 from ..registry import register_family
 from ..schedulers import get_scheduler
-from ..weights import require_weights_present
+from ..weights import is_test_model, require_weights_present
 
 logger = logging.getLogger(__name__)
 
@@ -65,8 +65,7 @@ TINY_SDX2_UNET = UNet2DConfig(
 )
 
 
-def _is_tiny(name: str) -> bool:
-    return "tiny" in name.lower() or name.startswith("test/")
+_is_tiny = is_test_model
 
 
 def upscaler_name_for(model_name: str) -> str:

@@ -52,7 +52,10 @@ PIPELINE_FAMILIES: dict[str, str] = {
     "StableCascadePriorPipeline": "cascade_prior",
     "StableCascadeCombinedPipeline": "cascade",
     "FluxPipeline": "flux",
+    "IFPipeline": "deepfloyd_if",
+    "IFSuperResolutionPipeline": "deepfloyd_if",
     "AudioLDMPipeline": "audioldm",
+    "BarkPipeline": "bark",
     "AnimateDiffPipeline": "animatediff",
     "TextToVideoSDPipeline": "animatediff",
     "VideoToVideoSDPipeline": "animatediff",
@@ -145,7 +148,7 @@ def _ensure_builtin_families() -> None:
         return
     _BUILTINS_LOADED = True
     for module in ("stable_diffusion", "video", "audio", "captioning", "flux",
-                   "kandinsky", "cascade", "upscale"):
+                   "kandinsky", "cascade", "upscale", "deepfloyd", "bark"):
         try:
             __import__(f"{__package__}.pipelines.{module}")
         except Exception as e:

@@ -15,6 +15,6 @@ def txt2audio_callback(device_identifier: str, model_name: str, **kwargs):
 
 
 def bark_callback(device_identifier: str, model_name: str, **kwargs):
-    raise Exception(
-        f"Bark TTS is not available on this worker (model {model_name})."
-    )
+    from ..pipelines.bark import run_bark
+
+    return run_bark(device_identifier, model_name, **kwargs)

@@ -1,0 +1,130 @@
+"""Bark TTS stack (VERDICT missing #8): text -> semantic -> coarse ->
+fine -> waveform, all stages jitted, scan-based AR decode with KV cache.
+Reference: swarm/audio/bark.py:16-21 (delegated everything to the bark
+package; rebuilt here as flax transformers + codec decoder).
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from chiaswarm_tpu.models.bark import (
+    BarkGPT,
+    CodecDecoder,
+    bark_tiny,
+    generate,
+)
+from chiaswarm_tpu.pipelines.bark import BarkPipeline, run_bark
+from chiaswarm_tpu.weights import MissingWeightsError
+
+
+def test_gpt_causal_logits_shape():
+    cfg = bark_tiny("semantic")
+    model = BarkGPT(cfg)
+    params = model.init(jax.random.key(0), jnp.zeros((2, 8), jnp.int32))
+    logits = model.apply(params, jnp.zeros((2, 8), jnp.int32))
+    assert logits.shape == (2, 8, cfg.output_vocab)
+
+
+def test_gpt_step_matches_full_forward():
+    """The KV-cache decode path must agree with the full causal forward."""
+    cfg = bark_tiny("semantic")
+    model = BarkGPT(cfg)
+    tokens = jax.random.randint(jax.random.key(1), (1, 6), 0, cfg.input_vocab)
+    params = model.init(jax.random.key(0), tokens)["params"]
+    full = model.apply({"params": params}, tokens)
+
+    caches = model.init_cache(1, 6)
+    step_logits = []
+    for i in range(6):
+        lg, caches = model.apply(
+            {"params": params}, tokens[:, i], i, caches, method=BarkGPT.step
+        )
+        step_logits.append(lg)
+    np.testing.assert_allclose(
+        np.asarray(jnp.stack(step_logits, axis=1)), np.asarray(full),
+        rtol=2e-4, atol=2e-4,
+    )
+
+
+def test_generate_shapes_and_determinism():
+    cfg = bark_tiny("semantic")
+    model = BarkGPT(cfg)
+    prompt = jnp.full((1, 4), 1001, jnp.int32)  # text ids above semantic
+    params = model.init(jax.random.key(0), prompt)["params"]
+    out = generate(model, params, prompt, 5, jax.random.key(7))
+    assert out.shape == (1, 5)
+    assert int(out.max()) < cfg.output_vocab
+    out2 = generate(model, params, prompt, 5, jax.random.key(7))
+    np.testing.assert_array_equal(np.asarray(out), np.asarray(out2))
+
+
+def test_generate_range_constraint():
+    cfg = bark_tiny("coarse")
+    model = BarkGPT(cfg)
+    prompt = jnp.zeros((1, 4), jnp.int32)
+    params = model.init(jax.random.key(0), prompt)["params"]
+
+    def parity(gen_idx):
+        lo = (gen_idx % 2) * 64
+        return lo, lo + 64
+
+    out = np.asarray(
+        generate(model, params, prompt, 6, jax.random.key(3),
+                 input_offset=1000, range_fn=parity)[0]
+    )
+    assert (out[0::2] < 64).all()  # even generated indices: codebook 0
+    assert (out[1::2] >= 64).all() and (out[1::2] < 128).all()
+
+
+def test_codec_decoder_output():
+    codec = CodecDecoder(n_books=8, codebook_size=64, d_model=32, ratios=(4, 2))
+    codes = jax.random.randint(jax.random.key(0), (1, 8, 16), 0, 64)
+    params = codec.init(jax.random.key(1), codes)
+    wav = codec.apply(params, codes)
+    assert wav.shape == (1, 16 * 8)  # T * prod(ratios)
+    assert float(jnp.abs(wav).max()) <= 1.0
+
+
+@pytest.fixture(scope="module")
+def tiny_bark():
+    return BarkPipeline("test/tiny-bark")
+
+
+def test_pipeline_end_to_end(tiny_bark):
+    wav, rate, config = tiny_bark.run(
+        prompt="hello swarm", duration=1.0, rng=jax.random.key(0)
+    )
+    assert wav.ndim == 1 and len(wav) > 0
+    assert np.isfinite(wav).all() and np.abs(wav).max() <= 1.0
+    assert config["mode"] == "txt2audio"
+    assert config["timings"]["generate_s"] > 0
+    assert rate == tiny_bark.hop * tiny_bark.codec_rate
+
+
+def test_pipeline_prompt_conditions_audio(tiny_bark):
+    # near-greedy decode: random-init logits are nearly flat, so at normal
+    # temperature the shared gumbel noise dominates and both prompts can
+    # sample identical tokens; at temperature->0 the argmax tracks the
+    # prompt-dependent logits directly
+    kw = dict(duration=1.0, rng=jax.random.key(5), temperature=0.01)
+    a = tiny_bark.run(prompt="a low hum", **kw)[0]
+    b = tiny_bark.run(prompt="a shrill whistle", **kw)[0]
+    assert not np.array_equal(a, b)
+
+
+def test_callback_artifact_envelope():
+    artifacts, config = run_bark(
+        "cpu:0", "suno/bark", prompt="hi",
+        parameters={"test_tiny_model": True, "duration": 1.0},
+    )
+    art = artifacts["primary"]
+    assert art["content_type"] == "audio/wav"
+    assert len(art["blob"]) > 0 and art["sha256_hash"]
+
+
+def test_real_weights_fail_loud():
+    with pytest.raises(MissingWeightsError):
+        BarkPipeline("suno/bark")

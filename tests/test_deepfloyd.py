@@ -1,0 +1,82 @@
+"""DeepFloyd IF cascade (VERDICT coverage §2.2 'DeepFloyd IF: no').
+
+The reference's own IF path shipped broken (diffusion_func_if.py:34-36
+random prompt embeds, :62 NameError); here the two-stage pixel cascade
+actually produces images, T5-conditioned, on tiny configs.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+
+from chiaswarm_tpu import registry
+from chiaswarm_tpu.pipelines.deepfloyd import SR_FACTOR, DeepFloydIFPipeline
+from chiaswarm_tpu.weights import MissingWeightsError
+from chiaswarm_tpu.workflows.diffusion import deepfloyd_if_callback
+
+
+@pytest.fixture(scope="module")
+def tiny_if():
+    return DeepFloydIFPipeline("test/tiny-if")
+
+
+def test_cascade_produces_sr_canvas(tiny_if):
+    images, config = tiny_if.run(
+        prompt="a fox", num_inference_steps=2, sr_steps=2,
+        rng=jax.random.key(0),
+    )
+    size = tiny_if.base_size * SR_FACTOR
+    assert images[0].size == (size, size)
+    assert config["size"] == [size, size]
+    assert config["sr_steps"] == 2
+    assert config["timings"]["denoise_s"] > 0
+
+
+def test_deterministic(tiny_if):
+    gen = lambda: np.asarray(
+        tiny_if.run(prompt="same", num_inference_steps=2, sr_steps=2,
+                    rng=jax.random.key(3))[0][0]
+    )
+    np.testing.assert_array_equal(gen(), gen())
+
+
+def test_prompt_conditions_output(tiny_if):
+    kw = dict(num_inference_steps=2, sr_steps=2, rng=jax.random.key(5))
+    a = np.asarray(tiny_if.run(prompt="a red fox", **kw)[0][0])
+    b = np.asarray(tiny_if.run(prompt="a blue whale", **kw)[0][0])
+    assert not np.array_equal(a, b)
+
+
+def test_batch(tiny_if):
+    images, _ = tiny_if.run(
+        prompt="x", num_images_per_prompt=2, num_inference_steps=2,
+        sr_steps=2, rng=jax.random.key(0),
+    )
+    assert len(images) == 2
+
+
+def test_callback_end_to_end():
+    # the raw-dispatch path: parameters still nested (job_arguments.py:78-81)
+    results, config = deepfloyd_if_callback(
+        "cpu:0",
+        "DeepFloyd/IF-I-XL-v1.0",
+        prompt="a fox",
+        num_inference_steps=2,
+        parameters={"test_tiny_model": True, "sr_steps": 2},
+        outputs=["primary"],
+    )
+    assert "primary" in results
+    assert results["primary"]["content_type"] == "image/jpeg"
+    assert config["pipeline"] == "IFPipeline"
+    assert "nsfw" in config
+
+
+def test_registry_wire_name():
+    pipe = registry.get_pipeline("test/tiny-if", "IFPipeline")
+    assert isinstance(pipe, DeepFloydIFPipeline)
+
+
+def test_real_weights_fail_loud():
+    with pytest.raises(MissingWeightsError):
+        DeepFloydIFPipeline("DeepFloyd/IF-I-XL-v1.0")
