@@ -118,6 +118,9 @@ def format_txt2audio_args(args: dict):
     args["pipeline_type"] = parameters.pop("pipeline_type", "AudioLDMPipeline")
     args["scheduler_type"] = parameters.pop("scheduler_type", DEFAULT_SCHEDULER)
     _drop_unsupported(args, parameters)
+    # remaining special parameters (test_tiny_model, audio_length_in_s, ...)
+    # pass straight through to the pipeline, like the diffusion formatter
+    args.update(parameters)
     return txt2audio_callback, args
 
 
@@ -145,6 +148,7 @@ def format_txt2vid_args(args: dict):
         args["lora"] = parameters["lora"]
 
     _drop_unsupported(args, parameters)
+    args.update(parameters)
     return txt2vid_callback, args
 
 
@@ -163,6 +167,7 @@ async def format_img2vid_args(args: dict):
         args["image"] = await get_image(args.pop("start_image_uri"), None)
 
     _drop_unsupported(args, parameters)
+    args.update(parameters)
     return img2vid_callback, args
 
 

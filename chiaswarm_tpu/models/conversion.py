@@ -617,3 +617,80 @@ def assert_tree_shapes_match(converted: dict, initialized: dict, prefix=""):
     walk(converted, initialized, prefix)
     if problems:
         raise ValueError("conversion mismatches:\n" + "\n".join(problems[:40]))
+
+
+# --- CLAP text encoder (AudioLDM conditioning; models/clap.py) ---
+
+
+def clap_rename(name: str) -> str | None:
+    """transformers ClapTextModelWithProjection names -> models.clap names."""
+    if name.startswith("text_model."):
+        name = name[len("text_model."):]
+    if "position_ids" in name:
+        return None
+    name = name.replace("embeddings.word_embeddings", "word_embeddings")
+    name = name.replace("embeddings.position_embeddings", "position_embeddings")
+    name = name.replace("embeddings.token_type_embeddings",
+                        "token_type_embeddings")
+    name = name.replace("embeddings.LayerNorm", "embed_norm")
+    name = name.replace("encoder.layer.", "layers.")
+    name = name.replace("attention.self.", "self_attn.")
+    name = name.replace("attention.output.dense", "attn_out")
+    name = name.replace("attention.output.LayerNorm", "attn_norm")
+    name = name.replace("intermediate.dense", "intermediate")
+    name = name.replace("output.dense", "output")
+    name = name.replace("output.LayerNorm", "output_norm")
+    name = name.replace("pooler.dense", "pooler")
+    name = name.replace("text_projection.linear1", "proj_1")
+    name = name.replace("text_projection.linear2", "proj_2")
+    return name
+
+
+def convert_clap(state: dict) -> dict:
+    params = convert_state_dict(state, rename=clap_rename)
+    # the self-attn q/k/v ended up under layers_N.self_attn already; the
+    # flax module names are query/key/value — convert_state_dict keeps them
+    return params
+
+
+# --- HiFi-GAN vocoder (AudioLDM mel->waveform; models/hifigan.py) ---
+
+
+def convert_hifigan(state: dict) -> dict:
+    """transformers SpeechT5HifiGan state dict -> models.hifigan params.
+
+    Conv1d weights are [O, I, K] -> flax Conv kernel [K, I, O];
+    ConvTranspose1d weights are [I, O, K] -> flax ConvTranspose [K, I, O].
+    The normalize-before `mean`/`scale` buffers ride along as params.
+    """
+    params: dict = {}
+    for name, tensor in state.items():
+        tensor = np.asarray(tensor)
+        # strip weight-norm decomposition if present (g * v/|v|)
+        if name.endswith(".weight_g") or name.endswith(".weight_v"):
+            base = name.rsplit(".", 1)[0]
+            g_name, v_name = base + ".weight_g", base + ".weight_v"
+            if g_name not in state or v_name not in state:
+                continue
+            if not name.endswith(".weight_g"):
+                continue  # handle the pair once, on the _g entry
+            g = np.asarray(state[g_name])
+            v = np.asarray(state[v_name])
+            norm = np.sqrt((v**2).sum(axis=(1, 2), keepdims=True))
+            tensor = g * v / np.maximum(norm, 1e-12)
+            name = base + ".weight"
+        # resblocks.N.convs1.M.weight -> resblocks_N.convs1_M.kernel
+        path, leaf = torch_name_to_flax_path(name)
+        if leaf == "weight" and tensor.ndim == 3:
+            if path and path[-1].startswith("upsampler"):
+                value = tensor.transpose(2, 0, 1)  # IOK -> KIO
+            else:
+                value = tensor.transpose(2, 1, 0)  # OIK -> KIO
+            _assign(params, path + ["kernel"], value)
+        elif leaf in ("mean", "scale") and not path:
+            _assign(params, [leaf], tensor)
+        elif leaf == "bias":
+            _assign(params, path + ["bias"], tensor)
+        else:
+            _assign(params, path + [leaf], tensor)
+    return params

@@ -1,0 +1,70 @@
+"""Heatmap pose estimation network for the openpose preprocessor.
+
+Reference behavior replaced: swarm/pre_processors/controlnet.py:46-47
+(`OpenposeDetector.from_pretrained("lllyasviel/ControlNet")` — a torch
+body-pose network run per job). TPU redesign: a compact fully-conv
+heatmap network in flax (strided conv encoder -> residual trunk -> 18
+COCO-keypoint heatmaps at 1/8 resolution), resident and jitted once per
+canvas bucket; keypoints read out as per-channel argmax + confidence.
+Weights follow weights.py policy: tiny/test names random-init, real names
+fail loudly until pose-weight conversion lands.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+# COCO-18 keypoint scheme (the openpose body model's output order)
+N_KEYPOINTS = 18
+# limb connectivity for skeleton rendering (keypoint index pairs)
+LIMBS = (
+    (0, 1), (1, 2), (2, 3), (3, 4), (1, 5), (5, 6), (6, 7), (1, 8),
+    (8, 9), (9, 10), (1, 11), (11, 12), (12, 13), (0, 14), (14, 16),
+    (0, 15), (15, 17),
+)
+
+
+@dataclasses.dataclass(frozen=True)
+class PoseConfig:
+    image_size: int = 368  # openpose canonical input canvas
+    widths: tuple[int, ...] = (64, 128, 256)
+    trunk_blocks: int = 4
+    n_keypoints: int = N_KEYPOINTS
+
+
+TINY_POSE = PoseConfig(image_size=64, widths=(8, 16), trunk_blocks=1)
+
+
+class _ResBlock(nn.Module):
+    width: int
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x):
+        h = nn.relu(nn.Conv(self.width, (3, 3), dtype=self.dtype)(x))
+        h = nn.Conv(self.width, (3, 3), dtype=self.dtype)(h)
+        return nn.relu(x + h)
+
+
+class PoseNet(nn.Module):
+    config: PoseConfig
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, pixels):
+        """[B, S, S, 3] in [-1, 1] -> heatmaps [B, S/2^len(widths), ..,
+        n_keypoints] (sigmoid confidence per cell)."""
+        x = pixels
+        for w in self.config.widths:
+            x = nn.relu(
+                nn.Conv(w, (3, 3), strides=(2, 2), dtype=self.dtype)(x)
+            )
+        for _ in range(self.config.trunk_blocks):
+            x = _ResBlock(self.config.widths[-1], dtype=self.dtype)(x)
+        heat = nn.Conv(
+            self.config.n_keypoints, (1, 1), dtype=self.dtype, name="heatmaps"
+        )(x)
+        return nn.sigmoid(heat)

@@ -21,7 +21,8 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..models import configs as cfgs
-from ..models.clip import CLIPTextEncoder
+from ..models.clap import TINY_CLAP, ClapTextConfig, ClapTextEncoder
+from ..models.hifigan import HifiGanConfig, HifiGanGenerator
 from ..models.tokenizer import load_tokenizer
 from ..models.unet2d import UNet2DConditionModel
 from ..models.vae import AutoencoderKL, VAEConfig
@@ -38,22 +39,65 @@ N_FFT = 1024
 
 
 def _audio_configs(model_name: str):
+    """(unet_cfg, clap_cfg, vae_cfg, vocoder_cfg)."""
     name = model_name.lower()
     if "tiny" in name or name.startswith("test/"):
         vae = VAEConfig(in_channels=1, block_out_channels=(32, 32), layers_per_block=1)
-        return cfgs.TINY_UNET, cfgs.TINY_CLIP, vae
-    # AudioLDM-s geometry: 4-ch latents over mel patches, CLAP-width text
+        # hop stays 160 (8*5*4) so tiny jobs emit the same 16 kHz wire rate
+        vocoder = HifiGanConfig(
+            model_in_dim=N_MELS,
+            upsample_initial_channel=16,
+            upsample_rates=(8, 5, 4),
+            upsample_kernel_sizes=(16, 10, 8),
+            resblock_kernel_sizes=(3,),
+            resblock_dilation_sizes=((1, 3),),
+        )
+        return cfgs.TINY_UNET, TINY_CLAP, vae, vocoder
+    # AudioLDM-s geometry: 4-ch latents over mel patches; the prompt
+    # conditions through the CLAP joint-space embedding and the waveform
+    # comes out of the SpeechT5-layout HiFi-GAN (hop 160 = HOP, 16 kHz)
     unet = cfgs.UNet2DConfig(
         block_out_channels=(128, 256, 512, 512),
         transformer_layers=(1, 1, 1, 0),
         num_attention_heads=8,
         cross_attention_dim=512,
     )
-    clip = cfgs.CLIPTextConfig(hidden_size=512, num_layers=12, num_heads=8)
     vae = VAEConfig(
         in_channels=1, block_out_channels=(128, 256, 512), scaling_factor=0.9227
     )
-    return unet, clip, vae
+    return unet, ClapTextConfig(), vae, HifiGanConfig(model_in_dim=N_MELS)
+
+
+def _clap_tokenizer(model_dir, vocab_size: int, max_length: int = 77):
+    """Real RoBERTa BPE tokenizer when the checkpoint ships one; converted
+    CLAP weights paired with the hash fallback would hash prompts into
+    arbitrary vocab ids (unconditioned audio), so the real path loads the
+    tokenizer files from the model dir (offline, via transformers)."""
+    tok_dir = None
+    if model_dir is not None:
+        for sub in ("tokenizer", "text_encoder"):
+            cand = model_dir / sub
+            if (cand / "vocab.json").is_file() or (
+                cand / "tokenizer.json"
+            ).is_file():
+                tok_dir = cand
+                break
+    if tok_dir is not None:
+        try:
+            from transformers import AutoTokenizer
+
+            tok = AutoTokenizer.from_pretrained(str(tok_dir))
+
+            def call(texts):
+                return tok(
+                    list(texts), padding="max_length", truncation=True,
+                    max_length=max_length, return_tensors="np",
+                )["input_ids"].astype(np.int32)
+
+            return call
+        except Exception as e:  # corrupt tokenizer dir: fall through
+            logger.warning("CLAP tokenizer load failed (%s); hash fallback", e)
+    return load_tokenizer(None, vocab_size=vocab_size)
 
 
 class AudioPipeline:
@@ -73,40 +117,75 @@ class AudioPipeline:
         )
         self.model_name = model_name
         self.chipset = chipset
-        unet_cfg, clip_cfg, vae_cfg = _audio_configs(model_name)
+        unet_cfg, clap_cfg, vae_cfg, vocoder_cfg = _audio_configs(model_name)
         self.latent_factor = 2 ** (len(vae_cfg.block_out_channels) - 1)
         on_tpu = jax.default_backend() == "tpu"
         self.dtype = jnp.bfloat16 if on_tpu else jnp.float32
         self.unet = UNet2DConditionModel(unet_cfg, dtype=self.dtype)
-        self.text_encoder = CLIPTextEncoder(clip_cfg, dtype=self.dtype)
+        self.text_encoder = ClapTextEncoder(clap_cfg, dtype=self.dtype)
         self.vae = AutoencoderKL(vae_cfg, dtype=self.dtype)
-        self.tokenizer = load_tokenizer(None, vocab_size=clip_cfg.vocab_size)
+        self.vocoder = HifiGanGenerator(vocoder_cfg, dtype=self.dtype)
+        self.vocoder_hop = int(np.prod(vocoder_cfg.upsample_rates))
+        self.tokenizer = _clap_tokenizer(
+            self._model_dir(), clap_cfg.vocab_size
+        )
 
         t0 = time.perf_counter()
         rng = jax.random.key(zlib.crc32(model_name.encode()))
-        k1, k2, k3 = jax.random.split(rng, 3)
+        k1, k2, k3, k4 = jax.random.split(rng, 4)
         hw = 4 * self.latent_factor
         with jax.default_device(jax.local_devices(backend="cpu")[0]):
+            init_params = {
+                "unet": self.unet.init(
+                    k1,
+                    jnp.zeros((1, 8, 8, unet_cfg.in_channels)),
+                    jnp.zeros((1,)),
+                    jnp.zeros((1, 77, unet_cfg.cross_attention_dim)),
+                )["params"],
+                "text": self.text_encoder.init(
+                    k2, jnp.zeros((1, 77), jnp.int32)
+                )["params"],
+                "vae": self.vae.init(k3, jnp.zeros((1, hw, hw, 1)))["params"],
+                "vocoder": self.vocoder.init(
+                    k4, jnp.zeros((1, 16, N_MELS))
+                )["params"],
+            }
+            # converted real weights override the random init per component
+            # (text_encoder = ClapTextModelWithProjection, vocoder =
+            # SpeechT5HifiGan in the HF audioldm layout)
+            for comp, sub, conv in self._conversion_sources():
+                try:
+                    from ..models.conversion import load_torch_state_dict
+
+                    init_params[comp] = conv(
+                        load_torch_state_dict(self._model_dir(), sub)
+                    )
+                    logger.info("loaded converted %s for %s", comp, model_name)
+                except (FileNotFoundError, OSError):
+                    pass
             self.params = jax.tree_util.tree_map(
-                lambda x: jnp.asarray(x, self.dtype),
-                {
-                    "unet": self.unet.init(
-                        k1,
-                        jnp.zeros((1, 8, 8, unet_cfg.in_channels)),
-                        jnp.zeros((1,)),
-                        jnp.zeros((1, 77, unet_cfg.cross_attention_dim)),
-                    )["params"],
-                    "text": self.text_encoder.init(
-                        k2, jnp.zeros((1, 77), jnp.int32)
-                    )["params"],
-                    "vae": self.vae.init(k3, jnp.zeros((1, hw, hw, 1)))["params"],
-                },
+                lambda x: jnp.asarray(x, self.dtype), init_params
             )
         logger.info(
             "%s audio pipeline resident in %.1fs", model_name,
             time.perf_counter() - t0,
         )
         self._programs = {}
+
+    def _model_dir(self):
+        from pathlib import Path
+
+        from ..settings import load_settings
+
+        return Path(load_settings().model_root_dir).expanduser() / self.model_name
+
+    def _conversion_sources(self):
+        from ..models.conversion import convert_clap, convert_hifigan
+
+        return (
+            ("text", "text_encoder", convert_clap),
+            ("vocoder", "vocoder", convert_hifigan),
+        )
 
     def release(self):
         self.params = None
@@ -144,10 +223,16 @@ class AudioPipeline:
             (latents, _), _ = jax.lax.scan(
                 body, (latents.astype(jnp.float32), state), jnp.arange(steps)
             )
-            return self.vae.apply(
+            mel = self.vae.apply(
                 {"params": params["vae"]}, latents.astype(self.dtype),
                 method=self.vae.decode,
-            ).astype(jnp.float32)
+            )
+            # HiFi-GAN vocoder fused into the same program: mel [B,T,F,1]
+            # -> waveform; only the waveform crosses back to the host
+            wav = self.vocoder.apply(
+                {"params": params["vocoder"]}, mel[..., 0]
+            )
+            return wav.astype(jnp.float32)
 
         program = jax.jit(run)
         self._programs[key] = program
@@ -172,9 +257,12 @@ class AudioPipeline:
         lf = max(8, N_MELS // self.latent_factor)
 
         ids = jnp.asarray(self.tokenizer([negative_prompt, prompt]))
-        context = self.text_encoder.apply(
-            {"params": params["text"]}, ids
-        )["hidden_states"].astype(self.dtype)
+        # AudioLDM conditions on the pooled CLAP joint-space embedding;
+        # it enters the UNet as a single cross-attention token
+        pooled = self.text_encoder.apply({"params": params["text"]}, ids)[
+            "pooled"
+        ]
+        context = pooled[:, None, :].astype(self.dtype)
 
         rng, init_rng, step_rng = jax.random.split(rng, 3)
         latent_c = self.unet.config.in_channels
@@ -182,21 +270,23 @@ class AudioPipeline:
 
         t0 = time.perf_counter()
         program = self._program((lt, lf, steps, scheduler_type))
-        mel = jax.block_until_ready(
+        wav = jax.block_until_ready(
             program(params, noise, context, jnp.float32(guidance_scale),
                     step_rng)
         )
         denoise_s = round(time.perf_counter() - t0, 3)
 
-        # [1, T', F', 1] -> log-mel [F, T]
-        log_mel = np.asarray(mel, np.float32)[0, :, :, 0].T
-        wav = griffin_lim(log_mel)
+        wav = normalize_wav(np.asarray(wav, np.float32)[0])
+        # frames/sec is fixed by the mel hop; the vocoder hop sets the
+        # output rate (real geometry: 100 fps * 160 = 16 kHz = reference)
+        out_rate = int(SAMPLE_RATE / HOP * self.vocoder_hop)
         config = {
             "model": self.model_name,
             "steps": steps,
             "duration_s": duration_s,
-            "sample_rate": SAMPLE_RATE,
+            "sample_rate": out_rate,
             "scheduler": scheduler_type,
+            "vocoder": "hifigan",
             "timings": {"denoise_vocode_s": denoise_s},
         }
         return wav, config
@@ -241,8 +331,13 @@ def griffin_lim(log_mel: np.ndarray, iterations: int = 24) -> np.ndarray:
             spec = np.pad(spec, ((0, 0), (0, linear.shape[1] - spec.shape[1])))
         angles = np.exp(1j * np.angle(spec))
     _, wav = istft(linear * angles, **kw)
+    return normalize_wav(wav)
+
+
+def normalize_wav(wav: np.ndarray, headroom: float = 0.95) -> np.ndarray:
+    """Peak-normalize to +/-headroom (silence passes through unscaled)."""
     peak = float(np.max(np.abs(wav))) or 1.0
-    return (wav / peak * 0.95).astype(np.float32)
+    return (wav / peak * headroom).astype(np.float32)
 
 
 def wav_to_buffer(wav: np.ndarray, rate: int = SAMPLE_RATE) -> io.BytesIO:
@@ -274,5 +369,8 @@ def run_audioldm(device_identifier: str, model_name: str, **kwargs):
     )
     wav, config = pipeline.run(**kwargs)
     return {
-        "primary": make_result(wav_to_buffer(wav), None, "audio/wav")
+        "primary": make_result(
+            wav_to_buffer(wav, config.get("sample_rate", SAMPLE_RATE)),
+            None, "audio/wav",
+        )
     }, config

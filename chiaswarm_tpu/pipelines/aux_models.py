@@ -115,3 +115,96 @@ def get_depth_estimator(model_name: str | None = None) -> DepthEstimator:
 def estimate_depth(image, model_name: str | None = None) -> np.ndarray:
     """PIL image -> [H, W] float32 inverse depth in [0, 1]."""
     return get_depth_estimator(model_name)(image)
+
+
+# --- pose (openpose preprocessor backend) ---
+
+_POSE: dict[str, "PoseEstimator"] = {}
+_POSE_LOCK = threading.Lock()
+
+DEFAULT_POSE_MODEL = "lllyasviel/ControlNet-openpose"
+
+
+class PoseEstimator:
+    """Resident heatmap pose network (reference controlnet.py:46-47's
+    OpenposeDetector). Returns COCO-18 keypoints in original pixel space."""
+
+    def __init__(self, model_name: str = DEFAULT_POSE_MODEL,
+                 allow_random_init: bool = False):
+        import jax
+        import jax.numpy as jnp
+
+        from ..models.pose import TINY_POSE, PoseConfig, PoseNet
+        from ..weights import is_test_model, require_weights_present
+
+        self.model_name = model_name
+        self.config = TINY_POSE if is_test_model(model_name) else PoseConfig()
+        on_tpu = jax.default_backend() == "tpu"
+        self.dtype = jnp.bfloat16 if on_tpu else jnp.float32
+        self.model = PoseNet(self.config, dtype=self.dtype)
+        # no pose-weight conversion path exists yet: real names fail loudly
+        require_weights_present(
+            model_name, None, allow_random_init, component="pose model",
+            hint=(
+                "This worker cannot serve real openpose weights yet; only "
+                "the test/tiny pose network is available."
+            ),
+        )
+        size = self.config.image_size
+        params = self.model.init(
+            jax.random.key(zlib.crc32(model_name.encode())),
+            jnp.zeros((1, size, size, 3)),
+        )["params"]
+        cast = lambda x: jnp.asarray(x, self.dtype)
+        self.params = jax.tree_util.tree_map(cast, params)
+        self._program = jax.jit(
+            lambda p, px: self.model.apply({"params": p}, px)
+        )
+
+    def __call__(self, image) -> np.ndarray:
+        """PIL -> [18, 3] float32 rows (x_px, y_px, confidence) in the
+        ORIGINAL image's pixel coordinates."""
+        import jax.numpy as jnp
+        from PIL import Image
+
+        size = self.config.image_size
+        w, h = image.size
+        rgb = image.convert("RGB").resize((size, size), Image.BICUBIC)
+        arr = np.asarray(rgb, np.float32) / 127.5 - 1.0
+        heat = np.asarray(
+            self._program(self.params, jnp.asarray(arr[None], self.dtype)),
+            np.float32,
+        )[0]  # [S', S', K]
+        hs, ws, k = heat.shape
+        flat = heat.reshape(hs * ws, k)
+        idx = flat.argmax(axis=0)
+        conf = flat[idx, np.arange(k)]
+        ys, xs = np.divmod(idx, ws)
+        out = np.stack(
+            [
+                (xs + 0.5) / ws * w,
+                (ys + 0.5) / hs * h,
+                conf,
+            ],
+            axis=-1,
+        )
+        return out.astype(np.float32)
+
+
+def get_pose_estimator(model_name: str | None = None) -> PoseEstimator:
+    if model_name is None:
+        from ..settings import load_settings
+
+        model_name = getattr(load_settings(), "pose_model", None) \
+            or DEFAULT_POSE_MODEL
+    with _POSE_LOCK:
+        est = _POSE.get(model_name)
+        if est is None:
+            est = PoseEstimator(model_name)
+            _POSE[model_name] = est
+        return est
+
+
+def estimate_pose(image, model_name: str | None = None) -> np.ndarray:
+    """PIL image -> [18, 3] (x, y, confidence) keypoints."""
+    return get_pose_estimator(model_name)(image)

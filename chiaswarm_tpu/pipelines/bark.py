@@ -231,6 +231,17 @@ class BarkPipeline:
             (self.coarse_cfg.block_size - n_sem) // N_COARSE_BOOKS,
             self.fine_cfg.block_size,
         )
+        # the renderable duration is set by n_frames: shrink the semantic
+        # plan to match (no point AR-decoding semantic tokens the coarse
+        # stage can never render) and surface the truncation to the caller
+        renderable_s = n_frames / self.codec_rate
+        truncated = renderable_s + 1e-6 < duration
+        if truncated:
+            logger.warning(
+                "bark duration %.1fs truncated to %.1fs (position-table cap)",
+                duration, renderable_s,
+            )
+            n_sem = min(n_sem, max(8, int(renderable_s * self.sem_rate)))
         program = self._program((t_text, n_sem, n_frames))
         t0 = time.perf_counter()
         wav = jax.block_until_ready(
@@ -239,15 +250,17 @@ class BarkPipeline:
         )
         timings["generate_s"] = round(time.perf_counter() - t0, 3)
 
-        wav = np.asarray(wav[0], np.float32)
-        peak = float(np.max(np.abs(wav))) or 1.0
-        wav = wav / peak * 0.95
+        from .audio import normalize_wav
+
+        wav = normalize_wav(np.asarray(wav[0], np.float32))
         rate = self.hop * self.codec_rate  # samples/sec this stack emits
         config = {
             "model": self.model_name,
             "pipeline": "BarkPipeline",
             "mode": "txt2audio",
             "duration_s": round(len(wav) / rate, 3),
+            "requested_duration_s": duration,
+            **({"duration_truncated": True} if truncated else {}),
             "sample_rate": rate,
             "semantic_tokens": n_sem,
             "codec_frames": n_frames,

@@ -25,7 +25,13 @@ def register(name):
 
 
 def preprocess_image(image: Image.Image, preprocessor: str, device_identifier: str):
-    fn = _PREPROCESSORS.get(preprocessor)
+    # the reference lowercases the wire name (controlnet.py:26) and several
+    # names carry spaces ("normal bae", "soft edge", "zoe depth", "center
+    # crop"); accept dashed/concatenated spellings too
+    name = preprocessor.lower().strip()
+    fn = _PREPROCESSORS.get(name) or _PREPROCESSORS.get(
+        name.replace("-", " ")
+    ) or _PREPROCESSORS.get(name.replace(" ", "").replace("-", ""))
     if fn is None:
         raise ValueError(
             f"Unknown or unavailable controlnet preprocessor: {preprocessor}"
@@ -89,6 +95,7 @@ def shuffle(image: Image.Image) -> Image.Image:
 
 @register("scribble")
 @register("softedge")
+@register("soft edge")
 def soft_edge(image: Image.Image) -> Image.Image:
     # HED-style soft edges approximated with a blurred inverted laplacian;
     # the model-backed HED detector replaces this when aux models land
@@ -97,3 +104,199 @@ def soft_edge(image: Image.Image) -> Image.Image:
     gray = cv2.cvtColor(np.array(image), cv2.COLOR_RGB2GRAY)
     edges = cv2.Laplacian(cv2.GaussianBlur(gray, (5, 5), 0), cv2.CV_8U, ksize=5)
     return Image.fromarray(np.stack([edges] * 3, axis=-1))
+
+
+@register("pix2pix")
+def pix2pix(image: Image.Image) -> Image.Image:
+    """Identity: the edit model conditions on the raw image
+    (reference controlnet.py:49-50)."""
+    return image
+
+
+@register("center crop")
+def center_crop(image: Image.Image) -> Image.Image:
+    return crop(image)
+
+
+@register("mlsd")
+def mlsd(image: Image.Image) -> Image.Image:
+    """Straight-line wireframe (reference's MLSDdetector, controlnet.py:31),
+    approximated with probabilistic Hough segments over Canny edges —
+    white line segments on black, the M-LSD output convention."""
+    import cv2
+
+    arr = np.asarray(image.convert("RGB"))
+    gray = cv2.cvtColor(arr, cv2.COLOR_RGB2GRAY)
+    edges = cv2.Canny(gray, 60, 180)
+    h, w = gray.shape
+    lines = cv2.HoughLinesP(
+        edges, 1, np.pi / 180, threshold=40,
+        minLineLength=max(min(h, w) // 16, 8), maxLineGap=4,
+    )
+    out = np.zeros((h, w, 3), np.uint8)
+    if lines is not None:
+        for seg in np.asarray(lines).reshape(-1, 4):
+            x1, y1, x2, y2 = (int(v) for v in seg)
+            cv2.line(out, (x1, y1), (x2, y2), (255, 255, 255), 1)
+    return Image.fromarray(out)
+
+
+@register("lineart")
+def lineart(image: Image.Image) -> Image.Image:
+    """Fine line drawing (reference's LineartDetector, controlnet.py:43),
+    approximated with a difference-of-gaussians sketch — white strokes on
+    black (the annotator's inverted-coal convention)."""
+    import cv2
+
+    gray = cv2.cvtColor(
+        np.asarray(image.convert("RGB")), cv2.COLOR_RGB2GRAY
+    ).astype(np.float32)
+    dog = cv2.GaussianBlur(gray, (0, 0), 1.0) - cv2.GaussianBlur(
+        gray, (0, 0), 3.0
+    )
+    lines = np.clip(-dog * 4.0, 0, 255).astype(np.uint8)
+    lines = cv2.morphologyEx(lines, cv2.MORPH_CLOSE, np.ones((2, 2), np.uint8))
+    return Image.fromarray(np.stack([lines] * 3, axis=-1))
+
+
+@register("normal bae")
+def normal_bae(image: Image.Image) -> Image.Image:
+    """Surface normals (reference's NormalBaeDetector, controlnet.py:36-37),
+    derived from the resident DPT depth model: depth gradients -> per-pixel
+    normal vectors, RGB-encoded in the BAE convention (x,y,z -> r,g,b)."""
+    import cv2
+
+    from ..pipelines.aux_models import estimate_depth
+
+    d = estimate_depth(image).astype(np.float32)  # [H, W] in [0, 1]
+    d = cv2.GaussianBlur(d, (5, 5), 0)
+    gy, gx = np.gradient(d)
+    h, w = d.shape
+    # scale gradients into a plausible slope range before normalizing
+    nx, ny = -gx * w / 4.0, -gy * h / 4.0
+    nz = np.ones_like(d)
+    norm = np.sqrt(nx * nx + ny * ny + nz * nz)
+    n = np.stack([nx / norm, ny / norm, nz / norm], axis=-1)
+    return Image.fromarray(((n * 0.5 + 0.5) * 255).astype(np.uint8))
+
+
+@register("zoe depth")
+@register("zoe")
+def zoe_depth(image: Image.Image) -> Image.Image:
+    """Metric-style depth map (reference zoe_depth.py:8-64: ZoeDepth +
+    `colorize(depth, cmap="gray_r")`), served by the resident DPT model
+    with the same reversed-gray colorization."""
+    from ..pipelines.aux_models import estimate_depth
+
+    d = estimate_depth(image)  # inverse depth in [0, 1], near = 1
+    # gray_r on metric depth: near -> dark in metric terms, but the
+    # reference colorizes raw depth (near = small) reversed, i.e. near ->
+    # white — which matches inverse depth directly
+    arr = (d * 255).astype(np.uint8)
+    return Image.fromarray(np.stack([arr] * 3, axis=-1))
+
+
+@register("depth estimator")
+def depth_estimator(image: Image.Image) -> Image.Image:
+    """Kandinsky depth-hint rendered as an image (reference
+    controlnet.py:72-73 -> make_hint_image)."""
+    from .depth_estimator import make_hint
+
+    hint = make_hint(image)  # HWC float32 in [0,1]
+    return Image.fromarray((hint * 255).astype(np.uint8))
+
+
+def _segmentation_palette(n: int = 150) -> np.ndarray:
+    """Deterministic ADE20K-style label palette: n visually-distinct RGB
+    colors from a golden-ratio hue walk (the reference inlines the ADE
+    table, controlnet.py:144-298; any stable label->color map serves the
+    conditioning purpose)."""
+    import colorsys
+
+    colors = []
+    for i in range(n):
+        hue = (i * 0.61803398875) % 1.0
+        sat = 0.55 + 0.45 * ((i * 7) % 3) / 2.0
+        val = 0.6 + 0.4 * ((i * 5) % 4) / 3.0
+        colors.append(
+            tuple(int(c * 255) for c in colorsys.hsv_to_rgb(hue, sat, val))
+        )
+    return np.asarray(colors, np.uint8)
+
+
+ADE_STYLE_PALETTE = _segmentation_palette()
+
+
+@register("segmentation")
+def segmentation(image: Image.Image) -> Image.Image:
+    """Semantic-segmentation conditioning map (reference's UperNet +
+    ADE palette, controlnet.py:39-40,122-141), approximated with k-means
+    region clustering over color+position features painted with the same
+    style of label palette. The model-backed UperNet replaces this when
+    segmentation weights land."""
+    import cv2
+
+    arr = np.asarray(
+        image.convert("RGB").resize(
+            (min(image.width, 256), min(image.height, 256)), Image.BILINEAR
+        ),
+        np.float32,
+    )
+    h, w = arr.shape[:2]
+    yy, xx = np.mgrid[0:h, 0:w].astype(np.float32)
+    feats = np.concatenate(
+        [arr.reshape(-1, 3), (xx * 255 / w).reshape(-1, 1),
+         (yy * 255 / h).reshape(-1, 1)],
+        axis=1,
+    ).astype(np.float32)
+    k = 12
+    criteria = (cv2.TERM_CRITERIA_EPS + cv2.TERM_CRITERIA_MAX_ITER, 8, 1.0)
+    # fixed-seed kmeans so identical jobs reproduce identical maps
+    cv2.setRNGSeed(0)
+    _, labels, _ = cv2.kmeans(
+        feats, k, None, criteria, 2, cv2.KMEANS_PP_CENTERS
+    )
+    seg = ADE_STYLE_PALETTE[labels.reshape(h, w) % len(ADE_STYLE_PALETTE)]
+    return Image.fromarray(seg).resize(image.size, Image.NEAREST)
+
+
+# openpose skeleton rendering: conventional limb colors of the openpose
+# visualizer (hue wheel over 17 limbs)
+def _limb_colors(n: int) -> list[tuple[int, int, int]]:
+    import colorsys
+
+    return [
+        tuple(int(c * 255) for c in colorsys.hsv_to_rgb(i / n, 1.0, 1.0))
+        for i in range(n)
+    ]
+
+
+@register("openpose")
+def openpose(image: Image.Image) -> Image.Image:
+    """Body-pose skeleton map (reference's OpenposeDetector,
+    controlnet.py:46-47): the resident pose network's COCO-18 keypoints
+    rendered as the standard openpose stick figure on black."""
+    import cv2
+
+    from ..models.pose import LIMBS
+    from ..pipelines.aux_models import estimate_pose
+
+    kps = estimate_pose(image)  # [18, 3] (x, y, conf)
+    w, h = image.size
+    out = np.zeros((h, w, 3), np.uint8)
+    colors = _limb_colors(len(LIMBS))
+    thick = max(min(h, w) // 128, 2)
+    conf_floor = 0.05
+    for (a, b), color in zip(LIMBS, colors):
+        if kps[a, 2] > conf_floor and kps[b, 2] > conf_floor:
+            cv2.line(
+                out,
+                (int(kps[a, 0]), int(kps[a, 1])),
+                (int(kps[b, 0]), int(kps[b, 1])),
+                color,
+                thick,
+            )
+    for x, y, c in kps:
+        if c > conf_floor:
+            cv2.circle(out, (int(x), int(y)), thick + 1, (255, 255, 255), -1)
+    return Image.fromarray(out)

@@ -37,6 +37,8 @@ class Settings:
     dtype: str = "bfloat16"
     # aux depth model serving the `depth` preprocessor + Kandinsky hint
     depth_model: str = "Intel/dpt-large"
+    # aux pose model for the openpose preprocessor
+    pose_model: str = "lllyasviel/ControlNet-openpose"
     # NSFW safety checker feeding the envelope flag ("" disables)
     safety_checker_model: str = "CompVis/stable-diffusion-safety-checker"
 

@@ -1,0 +1,153 @@
+"""CLAP text encoder + HiFi-GAN vocoder torch parity (VERDICT §2.2:
+'no path to real AudioLDM weights (CLAP encoder, HiFi-GAN vocoder
+missing)'). Randomly-initialized transformers models convert through
+conversion.py and must agree numerically — validating both the conversion
+rules and the flax architectures, no downloads needed.
+"""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from chiaswarm_tpu.models.clap import TINY_CLAP, ClapTextEncoder
+from chiaswarm_tpu.models.hifigan import TINY_HIFIGAN, HifiGanGenerator
+
+
+class TestClapTorchParity:
+    @pytest.fixture(scope="class")
+    def pair(self):
+        torch = pytest.importorskip("torch")
+        from transformers import ClapTextConfig as HFConfig
+        from transformers import ClapTextModelWithProjection
+
+        hf = HFConfig(
+            vocab_size=1000,
+            hidden_size=32,
+            num_hidden_layers=2,
+            num_attention_heads=4,
+            intermediate_size=64,
+            max_position_embeddings=80,
+            type_vocab_size=1,
+            pad_token_id=1,
+            projection_dim=32,
+            projection_hidden_act="relu",
+            hidden_act="gelu",
+            layer_norm_eps=1e-12,
+        )
+        torch_model = ClapTextModelWithProjection(hf).eval()
+        state = {k: v.numpy() for k, v in torch_model.state_dict().items()}
+
+        from chiaswarm_tpu.models.conversion import convert_clap
+
+        params = convert_clap(state)
+        return torch_model, ClapTextEncoder(TINY_CLAP), params
+
+    def test_pooled_and_hidden_match(self, pair):
+        import torch
+
+        torch_model, flax_model, params = pair
+        rng = np.random.default_rng(0)
+        ids = rng.integers(2, 1000, size=(2, 12)).astype(np.int64)
+        ids[1, 9:] = 1  # padding on the second row
+
+        with torch.no_grad():
+            t_out = torch_model(
+                torch.from_numpy(ids),
+                attention_mask=torch.from_numpy((ids != 1).astype(np.int64)),
+                output_hidden_states=True,
+            )
+        f_out = flax_model.apply({"params": params}, jnp.asarray(ids))
+        np.testing.assert_allclose(
+            np.asarray(f_out["pooled"]), t_out.text_embeds.numpy(), atol=2e-4
+        )
+        np.testing.assert_allclose(
+            np.asarray(f_out["hidden_states"]),
+            t_out.hidden_states[-1].numpy(),
+            atol=2e-4,
+        )
+
+
+class TestHifiGanTorchParity:
+    @pytest.fixture(scope="class")
+    def pair(self):
+        torch = pytest.importorskip("torch")
+        from transformers import SpeechT5HifiGan, SpeechT5HifiGanConfig
+
+        hf = SpeechT5HifiGanConfig(
+            model_in_dim=8,
+            upsample_initial_channel=16,
+            upsample_rates=[4, 2],
+            upsample_kernel_sizes=[8, 4],
+            resblock_kernel_sizes=[3],
+            resblock_dilation_sizes=[[1, 3]],
+            normalize_before=True,
+            leaky_relu_slope=0.1,
+        )
+        torch_model = SpeechT5HifiGan(hf).eval()
+        state = {k: v.numpy() for k, v in torch_model.state_dict().items()}
+
+        from chiaswarm_tpu.models.conversion import convert_hifigan
+
+        params = convert_hifigan(state)
+        return torch_model, HifiGanGenerator(TINY_HIFIGAN), params
+
+    def test_waveform_matches(self, pair):
+        import torch
+
+        torch_model, flax_model, params = pair
+        mel = np.random.default_rng(1).standard_normal((1, 20, 8)).astype(
+            np.float32
+        )
+        with torch.no_grad():
+            t_wav = torch_model(torch.from_numpy(mel)).numpy()
+        f_wav = np.asarray(flax_model.apply({"params": params}, jnp.asarray(mel)))
+        assert f_wav.shape == t_wav.reshape(f_wav.shape).shape
+        np.testing.assert_allclose(
+            f_wav, t_wav.reshape(f_wav.shape), atol=5e-4
+        )
+
+
+def test_pipeline_loads_converted_weights(sdaas_root, tmp_path):
+    """Placed safetensors under the model root override random init —
+    the real-weight path for AudioLDM's CLAP/vocoder components."""
+    torch = pytest.importorskip("torch")
+    from safetensors.numpy import save_file
+    from transformers import ClapTextConfig as HFConfig
+    from transformers import ClapTextModelWithProjection
+
+    from chiaswarm_tpu.pipelines.audio import AudioPipeline
+    from chiaswarm_tpu.settings import load_settings
+
+    hf = HFConfig(
+        vocab_size=1000, hidden_size=32, num_hidden_layers=2,
+        num_attention_heads=4, intermediate_size=64,
+        max_position_embeddings=80, type_vocab_size=1, pad_token_id=1,
+        projection_dim=32, projection_hidden_act="relu", hidden_act="gelu",
+    )
+    torch_model = ClapTextModelWithProjection(hf).eval()
+    state = {k: v.numpy() for k, v in torch_model.state_dict().items()}
+
+    from pathlib import Path
+
+    model_dir = (
+        Path(load_settings().model_root_dir).expanduser()
+        / "test/tiny-audio/text_encoder"
+    )
+    model_dir.mkdir(parents=True, exist_ok=True)
+    save_file(state, str(model_dir / "model.safetensors"))
+
+    pipe = AudioPipeline("test/tiny-audio")
+    ids = np.asarray(pipe.tokenizer(["hello"]))
+    f_out = pipe.text_encoder.apply(
+        {"params": pipe.params["text"]}, jnp.asarray(ids)
+    )
+    with torch.no_grad():
+        t_out = torch_model(
+            torch.from_numpy(ids.astype(np.int64)),
+            attention_mask=torch.from_numpy((ids != 1).astype(np.int64)),
+        )
+    np.testing.assert_allclose(
+        np.asarray(f_out["pooled"], np.float32),
+        t_out.text_embeds.numpy(), atol=2e-4,
+    )

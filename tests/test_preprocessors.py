@@ -1,0 +1,121 @@
+"""Extended ControlNet preprocessors (VERDICT missing #4 tail): mlsd,
+lineart, normal-bae, segmentation, zoe depth, openpose, pix2pix identity,
+and the reference's spaced wire-name spellings (controlnet.py:25-75).
+"""
+
+import numpy as np
+import pytest
+from PIL import Image
+
+from chiaswarm_tpu.pre_processors.controlnet import (
+    ADE_STYLE_PALETTE,
+    preprocess_image,
+)
+from chiaswarm_tpu.settings import Settings, save_settings
+
+
+def _image(seed=0, size=64):
+    rng = np.random.default_rng(seed)
+    arr = (rng.random((size, size, 3)) * 255).astype(np.uint8)
+    # add structure so edge/line detectors have something to find
+    arr[size // 4: size // 2, :, :] = 240
+    arr[:, size // 3, :] = 0
+    return Image.fromarray(arr)
+
+
+@pytest.fixture()
+def tiny_aux(sdaas_root):
+    save_settings(
+        Settings(depth_model="test/tiny-dpt", pose_model="test/tiny-pose")
+    )
+
+
+def test_pix2pix_identity():
+    img = _image(0)
+    assert preprocess_image(img, "pix2pix", "cpu:0") is img
+
+
+def test_mlsd_wireframe():
+    out = np.asarray(preprocess_image(_image(1, 128), "mlsd", "cpu:0"))
+    assert out.shape == (128, 128, 3)
+    # white-on-black: strictly binary palette
+    assert set(np.unique(out)) <= {0, 255}
+
+
+def test_lineart_strokes():
+    out = np.asarray(preprocess_image(_image(2, 96), "lineart", "cpu:0"))
+    assert out.shape == (96, 96, 3)
+    np.testing.assert_array_equal(out[..., 0], out[..., 1])
+    assert out.max() > 0  # found some strokes in the structured image
+
+
+def test_normal_bae_unit_vectors(tiny_aux):
+    out = np.asarray(
+        preprocess_image(_image(3, 64), "normal bae", "cpu:0"), np.float32
+    )
+    n = out / 255.0 * 2.0 - 1.0
+    norms = np.sqrt((n**2).sum(axis=-1))
+    # decoded normals are unit-ish (8-bit quantization slack)
+    assert float(np.abs(norms - 1.0).max()) < 0.05
+    # z points mostly toward the camera
+    assert float(n[..., 2].mean()) > 0.3
+
+
+def test_normal_bae_dashed_alias(tiny_aux):
+    out = preprocess_image(_image(3, 64), "Normal-BAE", "cpu:0")
+    assert out.size == (64, 64)
+
+
+def test_zoe_depth(tiny_aux):
+    out = np.asarray(preprocess_image(_image(4, 64), "zoe depth", "cpu:0"))
+    assert out.shape == (64, 64, 3)
+    np.testing.assert_array_equal(out[..., 0], out[..., 2])
+
+
+def test_depth_estimator_hint(tiny_aux):
+    out = np.asarray(
+        preprocess_image(_image(5, 64), "depth estimator", "cpu:0")
+    )
+    assert out.shape == (64, 64, 3)
+
+
+def test_segmentation_palette_map():
+    img = _image(6, 80)
+    out = np.asarray(preprocess_image(img, "segmentation", "cpu:0"))
+    assert out.shape == (80, 80, 3)
+    palette = {tuple(c) for c in ADE_STYLE_PALETTE}
+    seen = {tuple(px) for px in out.reshape(-1, 3)}
+    assert seen <= palette
+    assert 2 <= len(seen) <= 12
+    # deterministic across runs (fixed-seed kmeans)
+    out2 = np.asarray(preprocess_image(img, "segmentation", "cpu:0"))
+    np.testing.assert_array_equal(out, out2)
+
+
+def test_openpose_skeleton(tiny_aux):
+    out = np.asarray(preprocess_image(_image(7, 96), "openpose", "cpu:0"))
+    assert out.shape == (96, 96, 3)
+    assert out.max() > 0  # some limbs/joints rendered
+
+
+def test_openpose_real_weights_fail_loud(sdaas_root):
+    from chiaswarm_tpu.pipelines.aux_models import PoseEstimator
+    from chiaswarm_tpu.weights import MissingWeightsError
+
+    with pytest.raises(MissingWeightsError):
+        PoseEstimator("lllyasviel/ControlNet-openpose")
+
+
+def test_soft_edge_spaced_alias():
+    out = preprocess_image(_image(8, 64), "soft edge", "cpu:0")
+    assert out.size == (64, 64)
+
+
+def test_center_crop_alias():
+    out = preprocess_image(_image(9, 100), "center crop", "cpu:0")
+    assert out.size == (512, 512)
+
+
+def test_unknown_preprocessor_raises():
+    with pytest.raises(ValueError, match="Unknown or unavailable"):
+        preprocess_image(_image(0), "frobnicate", "cpu:0")
