@@ -94,11 +94,17 @@ class HifiGanGenerator(nn.Module):
         ):
             x = nn.leaky_relu(x, cfg.leaky_relu_slope)
             ch = cfg.upsample_initial_channel // (2 ** (i + 1))
-            # SAME -> T*rate output, the torch pad=(k-rate)//2 geometry
+            # torch ConvTranspose1d(pad=(k-rate)//2) == full (VALID)
+            # transpose conv cropped by that pad on both ends; SAME only
+            # coincides when k-rate is even, and the real AudioLDM vocoder
+            # hits an odd case (kernel 16, rate 5)
             x = nn.ConvTranspose(
-                ch, (k,), strides=(rate,), padding="SAME",
+                ch, (k,), strides=(rate,), padding="VALID",
                 dtype=self.dtype, name=f"upsampler_{i}",
             )(x)
+            pad = (k - rate) // 2
+            if pad:
+                x = x[:, pad:-pad]
             # multi-receptive-field fusion: mean of the per-kernel resblocks
             acc = None
             for j, (rk, dil) in enumerate(

@@ -257,11 +257,16 @@ class AudioPipeline:
         lf = max(8, N_MELS // self.latent_factor)
 
         ids = jnp.asarray(self.tokenizer([negative_prompt, prompt]))
-        # AudioLDM conditions on the pooled CLAP joint-space embedding;
-        # it enters the UNet as a single cross-attention token
+        # AudioLDM conditions on the pooled CLAP joint-space embedding,
+        # L2-NORMALIZED (diffusers AudioLDMPipeline._encode_prompt applies
+        # F.normalize before conditioning — the UNet was trained on unit-
+        # norm embeds); it enters the UNet as one cross-attention token
         pooled = self.text_encoder.apply({"params": params["text"]}, ids)[
             "pooled"
-        ]
+        ].astype(jnp.float32)
+        pooled = pooled / jnp.maximum(
+            jnp.linalg.norm(pooled, axis=-1, keepdims=True), 1e-8
+        )
         context = pooled[:, None, :].astype(self.dtype)
 
         rng, init_rng, step_rng = jax.random.split(rng, 3)

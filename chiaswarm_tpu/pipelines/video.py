@@ -112,6 +112,60 @@ class VideoPipeline:
             time.perf_counter() - t0,
         )
         self._programs = {}
+        # param trees with motion-LoRAs merged, keyed by (ref, scale);
+        # bounded — each entry pins a full UNet copy
+        from collections import OrderedDict
+
+        self._lora_cache: OrderedDict[tuple, dict] = OrderedDict()
+
+    def _lora_params(self, base_params: dict, lora: dict, scale: float) -> dict:
+        """Base params with a motion-LoRA merged into the video UNet
+        (reference tx2vid.py:26-48 loads AnimateDiff motion adapters /
+        LoRA adapter weights per job; here the merge happens once and the
+        merged tree stays resident)."""
+        from pathlib import Path
+
+        from ..settings import load_settings
+
+        key = (lora.get("lora"), lora.get("weight_name"),
+               lora.get("subfolder"), round(scale, 4))
+        if key in self._lora_cache:
+            self._lora_cache.move_to_end(key)
+            return self._lora_cache[key]
+        from ..models.lora import load_lora_state, merge_lora
+
+        candidates = [Path(str(lora.get("lora"))).expanduser()]
+        candidates.append(
+            Path(load_settings().model_root_dir).expanduser()
+            / str(lora.get("lora"))
+        )
+        state = None
+        errors = []
+        for root in candidates:
+            try:
+                state = load_lora_state(
+                    root, lora.get("weight_name"), lora.get("subfolder")
+                )
+                break
+            except (FileNotFoundError, OSError) as e:
+                errors.append(str(e))
+        if state is None:
+            raise ValueError(
+                f"motion LoRA {lora.get('lora')} not found: {'; '.join(errors)}"
+            )
+        merged_unet, matched = merge_lora(base_params["unet"], state, scale)
+        if matched == 0:
+            raise ValueError(
+                f"motion LoRA {lora.get('lora')} is incompatible with "
+                f"{self.model_name} (no matching modules)"
+            )
+        cast = lambda x: jnp.asarray(x, self.dtype)
+        out = dict(base_params)
+        out["unet"] = jax.tree_util.tree_map(cast, merged_unet)
+        self._lora_cache[key] = out
+        while len(self._lora_cache) > 2:
+            self._lora_cache.popitem(last=False)
+        return out
 
     def _init_params(self):
         rng = jax.random.key(zlib.crc32(self.model_name.encode()))
@@ -140,6 +194,7 @@ class VideoPipeline:
     def release(self):
         self.params = None
         self._programs.clear()
+        self._lora_cache.clear()
 
     def _program(self, key):
         if key in self._programs:
@@ -202,6 +257,13 @@ class VideoPipeline:
         if params is None:
             raise Exception(f"pipeline {self.model_name} was evicted; resubmit")
         timings = {}
+        lora = kwargs.pop("lora", None)
+        xattn_kwargs = kwargs.pop("cross_attention_kwargs", {}) or {}
+        lora_scale = float(
+            kwargs.pop("lora_scale", xattn_kwargs.get("scale", 1.0))
+        )
+        if lora is not None:
+            params = self._lora_params(params, lora, lora_scale)
         steps = int(kwargs.pop("num_inference_steps", 25))
         guidance_scale = float(kwargs.pop("guidance_scale", 7.5))
         frames = min(
@@ -305,14 +367,44 @@ def run_txt2vid(device_identifier: str, model_name: str, **kwargs):
     ptype = kwargs.pop("pipeline_type", "AnimateDiffPipeline")
     if PIPELINE_FAMILIES.get(ptype) != "animatediff":
         ptype = "AnimateDiffPipeline"
-    pipeline = get_pipeline(
-        model_name,
-        pipeline_type=ptype,
-        chipset=kwargs.pop("chipset", None),
-    )
-    kwargs.pop("lora", None)  # motion-LoRA conversion lands with real weights
-    kwargs.pop("upscale", None)
+    chipset = kwargs.pop("chipset", None)
+    pipeline = get_pipeline(model_name, pipeline_type=ptype, chipset=chipset)
+
+    # motion-LoRA refs may ride parameters as bare strings — resolve them
+    # through the same path resolver job-level loras use
+    lora = kwargs.pop("lora", None)
+    if isinstance(lora, str):
+        from ..loras import Loras
+        from ..settings import load_settings
+
+        lora = Loras(load_settings().lora_root_dir).resolve_lora(lora)
+    if lora is not None:
+        kwargs["lora"] = lora
+
+    # zeroscope-style upscale pass (reference tx2vid.py:66-76 chains
+    # zeroscope_v2_XL over the produced clip): the learned 2x upscaler runs
+    # over the frames; resolved BEFORE the denoise so missing weights fail
+    # fast
+    upscaler = None
+    if kwargs.pop("upscale", False):
+        from .upscale import upscaler_name_for
+
+        upscaler = get_pipeline(
+            upscaler_name_for(model_name),
+            pipeline_type="StableDiffusionLatentUpscalePipeline",
+            chipset=chipset,
+        )
+
+    prompt = kwargs.get("prompt", "")
     frames, config = pipeline.run(**kwargs)
+    if upscaler is not None:
+        t0 = time.perf_counter()
+        frames = upscaler.upscale(frames, prompt=prompt)
+        config.setdefault("timings", {})["upscale_s"] = round(
+            time.perf_counter() - t0, 3
+        )
+        config["upscaled"] = True
+        config["output_size"] = [frames[0].width, frames[0].height]
     return {"primary": _frames_artifact(frames, config["fps"], content_type)}, config
 
 

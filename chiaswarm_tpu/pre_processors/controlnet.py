@@ -16,22 +16,24 @@ from .image_utils import center_crop_resize, resize_for_condition_image
 _PREPROCESSORS = {}
 
 
+def _norm(name: str) -> str:
+    """Canonical key: lowercase, spaces/dashes/underscores stripped — so
+    "normal bae", "Normal-BAE", and "normalbae" all resolve to one entry
+    (the reference lowercases only, controlnet.py:26, but its hive sends
+    spaced names while dashed spellings circulate in job templates)."""
+    return name.lower().strip().replace(" ", "").replace("-", "").replace("_", "")
+
+
 def register(name):
     def deco(fn):
-        _PREPROCESSORS[name] = fn
+        _PREPROCESSORS[_norm(name)] = fn
         return fn
 
     return deco
 
 
 def preprocess_image(image: Image.Image, preprocessor: str, device_identifier: str):
-    # the reference lowercases the wire name (controlnet.py:26) and several
-    # names carry spaces ("normal bae", "soft edge", "zoe depth", "center
-    # crop"); accept dashed/concatenated spellings too
-    name = preprocessor.lower().strip()
-    fn = _PREPROCESSORS.get(name) or _PREPROCESSORS.get(
-        name.replace("-", " ")
-    ) or _PREPROCESSORS.get(name.replace(" ", "").replace("-", ""))
+    fn = _PREPROCESSORS.get(_norm(preprocessor))
     if fn is None:
         raise ValueError(
             f"Unknown or unavailable controlnet preprocessor: {preprocessor}"

@@ -69,16 +69,26 @@ class TestClapTorchParity:
 
 
 class TestHifiGanTorchParity:
-    @pytest.fixture(scope="class")
-    def pair(self):
+    # even k-rate (tiny config) AND odd k-rate (the real AudioLDM vocoder's
+    # first stage is kernel 16 / rate 5, where SAME padding would diverge)
+    CONFIGS = {
+        "even": dict(upsample_rates=(4, 2), upsample_kernel_sizes=(8, 4)),
+        "odd": dict(upsample_rates=(5, 4), upsample_kernel_sizes=(16, 16)),
+    }
+
+    @pytest.fixture(scope="class", params=sorted(CONFIGS))
+    def pair(self, request):
         torch = pytest.importorskip("torch")
         from transformers import SpeechT5HifiGan, SpeechT5HifiGanConfig
 
+        import dataclasses
+
+        shape = self.CONFIGS[request.param]
         hf = SpeechT5HifiGanConfig(
             model_in_dim=8,
             upsample_initial_channel=16,
-            upsample_rates=[4, 2],
-            upsample_kernel_sizes=[8, 4],
+            upsample_rates=list(shape["upsample_rates"]),
+            upsample_kernel_sizes=list(shape["upsample_kernel_sizes"]),
             resblock_kernel_sizes=[3],
             resblock_dilation_sizes=[[1, 3]],
             normalize_before=True,
@@ -90,7 +100,8 @@ class TestHifiGanTorchParity:
         from chiaswarm_tpu.models.conversion import convert_hifigan
 
         params = convert_hifigan(state)
-        return torch_model, HifiGanGenerator(TINY_HIFIGAN), params
+        cfg = dataclasses.replace(TINY_HIFIGAN, **shape)
+        return torch_model, HifiGanGenerator(cfg), params
 
     def test_waveform_matches(self, pair):
         import torch
