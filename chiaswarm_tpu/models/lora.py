@@ -161,3 +161,48 @@ def merge_lora(params: dict, lora_state: dict, scale: float = 1.0) -> tuple[dict
         set_leaf(path, kernel + jnp.asarray(eff * delta, kernel.dtype))
         matched += 1
     return new_params, matched
+
+
+def resolve_and_merge(base_unet_params: dict, lora: dict, scale: float,
+                      model_name: str) -> dict:
+    """Load a LoRA by job reference and merge it into a UNet param tree.
+
+    One shared path for every pipeline family (SD and video motion-LoRAs):
+    candidate roots are the literal path then `model_root_dir`/<ref>; load
+    failures and zero-module matches raise ValueError -> fatal job error
+    (the reference's "incompatible lora" contract,
+    swarm/diffusion/diffusion_func.py:113-126). Returns the merged UNet
+    tree (host-side); the caller places/casts and caches it.
+    """
+    from ..settings import load_settings
+
+    candidates = [Path(str(lora.get("lora"))).expanduser()]
+    candidates.append(
+        Path(load_settings().model_root_dir).expanduser() / str(lora.get("lora"))
+    )
+    state = None
+    errors = []
+    for root in candidates:
+        try:
+            state = load_lora_state(
+                root, lora.get("weight_name"), lora.get("subfolder")
+            )
+            break
+        except (FileNotFoundError, OSError) as e:
+            errors.append(str(e))
+    if state is None:
+        raise ValueError(
+            f"Could not load lora {lora}. It might be incompatible with "
+            f"{model_name}: {'; '.join(errors)}"
+        )
+    merged, matched = merge_lora(base_unet_params, state, scale)
+    if matched == 0:
+        raise ValueError(
+            f"Could not load lora {lora}: no modules matched "
+            f"{model_name}'s parameter tree"
+        )
+    logging.getLogger(__name__).info(
+        "merged LoRA %s into %s (%d modules, scale %.2f)",
+        lora.get("lora"), model_name, matched, scale,
+    )
+    return merged

@@ -8,7 +8,9 @@ flash kernel that never materializes the [S, S] score matrix in HBM
 
 from __future__ import annotations
 
+import contextlib
 import functools
+import threading
 
 import jax
 import jax.numpy as jnp
@@ -16,6 +18,54 @@ import jax.numpy as jnp
 # sequence length below which the plain XLA path is faster than paying
 # kernel launch + pipelining overheads
 _FLASH_MIN_SEQ = 1024
+
+# sequence length at which self-attention shards over the mesh seq axis
+# (ring attention) when a sequence_parallel_scope is active
+_RING_MIN_SEQ = 2048
+
+_SEQ_SCOPE = threading.local()
+
+
+@contextlib.contextmanager
+def sequence_parallel_scope(mesh):
+    """Route long self-attention through ring attention over `mesh`'s seq
+    axis while tracing under this scope.
+
+    Pipelines wrap their jitted-program *invocation* in this scope: jit
+    traces lazily on the first call, so the routing decision (a trace-time
+    branch) lands in the compiled program; cached invocations are
+    unaffected. `mesh=None` or a mesh with seq size 1 makes the scope a
+    no-op, so call sites never need their own guard.
+    """
+    from ..parallel.mesh import SEQ_AXIS
+
+    prev = getattr(_SEQ_SCOPE, "mesh", None)
+    _SEQ_SCOPE.mesh = (
+        mesh if mesh is not None and mesh.shape.get(SEQ_AXIS, 1) > 1 else None
+    )
+    try:
+        yield
+    finally:
+        _SEQ_SCOPE.mesh = prev
+
+
+def _ring_route(q, k, v, scale):
+    """Ring attention under shard_map when the active scope's mesh can
+    split this self-attention; None when it doesn't apply."""
+    mesh = getattr(_SEQ_SCOPE, "mesh", None)
+    if mesh is None:
+        return None
+    if q.shape[1] != k.shape[1]:  # cross-attention keeps the short KV local
+        return None
+    if q.shape[1] < _RING_MIN_SEQ:
+        return None
+    from ..parallel.mesh import SEQ_AXIS
+    from ..parallel.ring import ring_shard_map
+
+    n = mesh.shape[SEQ_AXIS]
+    if q.shape[1] % n:
+        return None
+    return ring_shard_map(mesh, scale)(q, k, v)
 
 
 def reference_attention(q, k, v, scale: float | None = None):
@@ -45,6 +95,9 @@ def dot_product_attention(q, k, v, scale: float | None = None):
         platform = override
     else:
         platform = override.platform
+    ring_out = _ring_route(q, k, v, scale)
+    if ring_out is not None:
+        return ring_out
     on_tpu = platform == "tpu"
     if on_tpu and q.shape[1] >= _FLASH_MIN_SEQ and q.shape[-1] <= 128:
         try:

@@ -78,19 +78,25 @@ def _noop(x, mesh):  # pragma: no cover - placeholder for cache warmup
     return x
 
 
-def ring_self_attention_sharded(mesh: Mesh, q, k, v, scale: float | None = None):
-    """Convenience wrapper: shard [B,S,H,D] host arrays over the seq axis and
-    run ring attention under shard_map. For use outside an enclosing pjit
-    (tests, standalone ops); pipelines call `ring_attention` directly inside
-    their own shard_map.
-    """
+def ring_shard_map(mesh: Mesh, scale: float | None = None):
+    """The shard_map'd ring-attention entry: [B,S,H,D] sequence-sharded on
+    the seq axis. Shared by the host-array wrapper below and the trace-time
+    routing in ops/attention.py."""
     spec = P(None, SEQ_AXIS, None, None)
-    fn = jax.shard_map(
+    return jax.shard_map(
         lambda q, k, v: ring_attention(q, k, v, scale=scale),
         mesh=mesh,
         in_specs=(spec, spec, spec),
         out_specs=spec,
     )
-    sharding = NamedSharding(mesh, spec)
+
+
+def ring_self_attention_sharded(mesh: Mesh, q, k, v, scale: float | None = None):
+    """Convenience wrapper: shard [B,S,H,D] host arrays over the seq axis and
+    run ring attention under shard_map. For use outside an enclosing pjit
+    (tests, standalone ops); pipelines route here via
+    `ops.attention.sequence_parallel_scope`.
+    """
+    sharding = NamedSharding(mesh, P(None, SEQ_AXIS, None, None))
     q, k, v = (jax.device_put(x, sharding) for x in (q, k, v))
-    return fn(q, k, v)
+    return ring_shard_map(mesh, scale)(q, k, v)

@@ -390,35 +390,11 @@ class SDPipeline:
         if key in self._lora_cache:
             self._lora_cache.move_to_end(key)
             return self._lora_cache[key]
-        from ..models.lora import load_lora_state, merge_lora
+        from ..models.lora import resolve_and_merge
 
-        candidates = [Path(str(lora.get("lora"))).expanduser()]
-        candidates.append(
-            Path(load_settings().model_root_dir).expanduser() / str(lora.get("lora"))
+        merged_unet = resolve_and_merge(
+            base_params["unet"], lora, scale, self.model_name
         )
-        state = None
-        errors = []
-        for root in candidates:
-            try:
-                state = load_lora_state(
-                    root, lora.get("weight_name"), lora.get("subfolder")
-                )
-                break
-            except (FileNotFoundError, OSError) as e:
-                errors.append(str(e))
-        if state is None:
-            raise ValueError(
-                f"Could not load lora {lora}. It might be incompatible with "
-                f"{self.model_name}: {'; '.join(errors)}"
-            )
-        merged_unet, matched = merge_lora(base_params["unet"], state, scale)
-        if matched == 0:
-            raise ValueError(
-                f"Could not load lora {lora}: no modules matched "
-                f"{self.model_name}'s parameter tree"
-            )
-        logger.info("merged LoRA %s into %s (%d modules, scale %.2f)",
-                    lora.get("lora"), self.model_name, matched, scale)
         params = dict(base_params)
         params["unet"] = self._place({"unet": merged_unet})["unet"]
         self._lora_cache[key] = params
@@ -1111,20 +1087,26 @@ class SDPipeline:
         timings["trace_s"] = round(time.perf_counter() - t0, 3)
 
         t0 = time.perf_counter()
-        pixels = program(
-            job_params,
-            init_rng,
-            context,
-            added,
-            jnp.float32(guidance_scale),
-            jnp.float32(image_guidance or 0.0),
-            image_latents,
-            mask,
-            step_rng,
-            cn_params,
-            control_cond,
-            jnp.float32(cn_scale),
-        )
+        # long-sequence self-attention shards over the mesh seq axis (ring
+        # attention) when this ChipSet carved one out; trace-time routing,
+        # so it binds on the first (tracing) call of each program bucket
+        from ..ops.attention import sequence_parallel_scope
+
+        with sequence_parallel_scope(self.mesh):
+            pixels = program(
+                job_params,
+                init_rng,
+                context,
+                added,
+                jnp.float32(guidance_scale),
+                jnp.float32(image_guidance or 0.0),
+                image_latents,
+                mask,
+                step_rng,
+                cn_params,
+                control_cond,
+                jnp.float32(cn_scale),
+            )
         pixels = jax.block_until_ready(pixels)
         timings["denoise_decode_s"] = round(time.perf_counter() - t0, 3)
 

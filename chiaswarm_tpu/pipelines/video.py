@@ -123,42 +123,16 @@ class VideoPipeline:
         (reference tx2vid.py:26-48 loads AnimateDiff motion adapters /
         LoRA adapter weights per job; here the merge happens once and the
         merged tree stays resident)."""
-        from pathlib import Path
-
-        from ..settings import load_settings
-
         key = (lora.get("lora"), lora.get("weight_name"),
                lora.get("subfolder"), round(scale, 4))
         if key in self._lora_cache:
             self._lora_cache.move_to_end(key)
             return self._lora_cache[key]
-        from ..models.lora import load_lora_state, merge_lora
+        from ..models.lora import resolve_and_merge
 
-        candidates = [Path(str(lora.get("lora"))).expanduser()]
-        candidates.append(
-            Path(load_settings().model_root_dir).expanduser()
-            / str(lora.get("lora"))
+        merged_unet = resolve_and_merge(
+            base_params["unet"], lora, scale, self.model_name
         )
-        state = None
-        errors = []
-        for root in candidates:
-            try:
-                state = load_lora_state(
-                    root, lora.get("weight_name"), lora.get("subfolder")
-                )
-                break
-            except (FileNotFoundError, OSError) as e:
-                errors.append(str(e))
-        if state is None:
-            raise ValueError(
-                f"motion LoRA {lora.get('lora')} not found: {'; '.join(errors)}"
-            )
-        merged_unet, matched = merge_lora(base_params["unet"], state, scale)
-        if matched == 0:
-            raise ValueError(
-                f"motion LoRA {lora.get('lora')} is incompatible with "
-                f"{self.model_name} (no matching modules)"
-            )
         cast = lambda x: jnp.asarray(x, self.dtype)
         out = dict(base_params)
         out["unet"] = jax.tree_util.tree_map(cast, merged_unet)
@@ -312,10 +286,14 @@ class VideoPipeline:
         key = (lh, lw, frames, steps, scheduler_type)
         t0 = time.perf_counter()
         program = self._program(key)
-        pixels = jax.block_until_ready(
-            program(params, noise, context, jnp.float32(guidance_scale),
-                    cond_latents, step_rng)
-        )
+        from ..ops.attention import sequence_parallel_scope
+
+        mesh = self.chipset.mesh() if self.chipset is not None else None
+        with sequence_parallel_scope(mesh):
+            pixels = jax.block_until_ready(
+                program(params, noise, context, jnp.float32(guidance_scale),
+                        cond_latents, step_rng)
+            )
         timings["denoise_decode_s"] = round(time.perf_counter() - t0, 3)
 
         arr = np.clip(np.asarray(pixels, np.float32) * 0.5 + 0.5, 0, 1)

@@ -41,6 +41,8 @@ class Settings:
     pose_model: str = "lllyasviel/ControlNet-openpose"
     # NSFW safety checker feeding the envelope flag ("" disables)
     safety_checker_model: str = "CompVis/stable-diffusion-safety-checker"
+    # jax.profiler trace server port (0 = disabled)
+    profiler_port: int = 0
 
     @classmethod
     def field_names(cls) -> tuple[str, ...]:

@@ -98,6 +98,24 @@ class Worker:
             f"{len(self.allocator)} job slice(s)"
         )
         self._enable_compilation_cache()
+        self._start_profiler_server()
+
+    def _start_profiler_server(self) -> None:
+        """jax.profiler trace endpoint (SURVEY §5 'tracing/profiling:
+        absent' in the reference — rebuilt as a first-class worker
+        capability). Connect with TensorBoard's profile plugin or
+        `jax.profiler.trace_function` tooling against localhost:PORT;
+        0 disables."""
+        port = int(getattr(self.settings, "profiler_port", 0) or 0)
+        if not port:
+            return
+        try:
+            import jax.profiler
+
+            jax.profiler.start_server(port)
+            logger.info("jax profiler server on :%d", port)
+        except Exception as e:  # profiling is an optimization, never fatal
+            logger.warning("profiler server unavailable: %s", e)
 
     def _enable_compilation_cache(self) -> None:
         """Persistent XLA compilation cache — the TPU analog of the reference's

@@ -1,0 +1,53 @@
+"""Ring attention in the SERVING path (VERDICT weak #4 follow-through):
+when a ChipSet carves out a seq mesh axis, long self-attention inside the
+jitted denoise program shards over it via ring attention — and the result
+matches the single-device path (ring attention is exact).
+"""
+
+import numpy as np
+import pytest
+
+import jax
+
+from chiaswarm_tpu.chips.device import ChipSet
+from chiaswarm_tpu.ops import attention as attention_ops
+from chiaswarm_tpu.pipelines.stable_diffusion import SDPipeline
+
+
+def test_seq_parallel_sd_matches_replicated(monkeypatch):
+    # tiny canvases never reach the production 2048-token threshold; lower
+    # it so the 64px latent self-attention (up to 1024 tokens) rings
+    monkeypatch.setattr(attention_ops, "_RING_MIN_SEQ", 64)
+
+    kw = dict(prompt="a fox", height=64, width=64, num_inference_steps=2,
+              rng=jax.random.key(0))
+    ref = np.asarray(SDPipeline("test/tiny-sd").run(**kw)[0][0])
+
+    chipset = ChipSet(jax.devices(), seq=2)  # data=4, seq=2 on 8 devices
+    sp = np.asarray(SDPipeline("test/tiny-sd", chipset=chipset).run(**kw)[0][0])
+
+    # exact attention, fp32 online-softmax merge: allow 8-bit rounding slack
+    assert ref.shape == sp.shape
+    diff = np.abs(ref.astype(np.int16) - sp.astype(np.int16))
+    assert diff.max() <= 2, f"max pixel diff {diff.max()}"
+
+
+def test_scope_noop_without_seq_axis():
+    # seq=1 mesh: scope must not reroute anything
+    chipset = ChipSet(jax.devices())
+    mesh = chipset.mesh()
+    with attention_ops.sequence_parallel_scope(mesh):
+        assert getattr(attention_ops._SEQ_SCOPE, "mesh", None) is None
+
+
+def test_ring_route_skips_cross_attention(monkeypatch):
+    import jax.numpy as jnp
+
+    monkeypatch.setattr(attention_ops, "_RING_MIN_SEQ", 8)
+    chipset = ChipSet(jax.devices(), seq=2)
+    with attention_ops.sequence_parallel_scope(chipset.mesh()):
+        q = jnp.zeros((1, 16, 2, 8))
+        kv = jnp.zeros((1, 6, 2, 8))  # different KV length = cross
+        assert attention_ops._ring_route(q, kv, kv, 0.5) is None
+        # self-attention with compatible length DOES route
+        assert attention_ops._ring_route(q, q, q, 0.5) is not None
