@@ -1,0 +1,134 @@
+"""Per-model capacity requirements: the TPU-native replacement for the
+reference's memory-pressure knobs.
+
+Reference behavior replaced: swarm/diffusion/diffusion_func.py:134-146
+(VAE slicing/tiling, attention slicing, model/sequential CPU offload) —
+CUDA-side degradation hacks that trade 2-10x latency for VRAM. On TPU the
+policy is explicit capacity accounting instead (SURVEY §2.6 row
+'memory-pressure fallbacks'):
+
+- every model family carries a parameter-footprint estimate and a
+  per-image activation estimate;
+- a job that cannot fit at the requested batch is capped to the batch
+  that fits (recorded in pipeline_config, never silent);
+- a model whose parameters alone exceed the slice's HBM is a fatal job
+  error naming the chip count it needs — the operator scales the slice
+  (tensor parallelism) instead of thrashing host offload.
+
+Numbers are engineering estimates in bf16 serving dtype, anchored on
+measured fits (SDXL batch 4 @ 1024^2 runs on one 16 GB v5e chip with
+~2 GB/image of transient headroom — bench_r02).
+"""
+
+from __future__ import annotations
+
+from ..models.configs import model_family
+
+# static parameter + resident-state footprint, GiB (bf16, incl. text/vae)
+FAMILY_PARAMS_GB: dict[str, float] = {
+    "sd15": 1.8,
+    "sd21": 2.1,
+    "sdxl": 8.0,
+    "sdxl_refiner": 7.2,
+    "flux": 26.0,  # 12B MMDiT + T5-XXL: needs a TP slice
+    "flux_schnell": 26.0,
+}
+
+# transient activations per image in the fused denoise+decode program,
+# GiB at a 1024^2 canvas; scales with canvas area
+FAMILY_ACT_GB_PER_IMAGE: dict[str, float] = {
+    "sd15": 1.0,
+    "sd21": 1.1,
+    "sdxl": 2.0,
+    "sdxl_refiner": 1.8,
+    "flux": 2.5,
+    "flux_schnell": 2.5,
+}
+
+_DEFAULT_PARAMS_GB = 2.0
+_DEFAULT_ACT_GB = 1.0
+
+
+def _family_key(model_name: str) -> str:
+    name = model_name.lower()
+    if "flux" in name:
+        return "flux"
+    return model_family(model_name)
+
+
+def _area_scale(height: int, width: int | None = None) -> float:
+    width = height if width is None else width
+    return max((height * width) / (1024.0 * 1024.0), 0.05)
+
+
+def required_hbm_gb(model_name: str, batch: int, size: int,
+                    width: int | None = None) -> float:
+    """Estimated HBM for `batch` images at size x (width or size)."""
+    fam = _family_key(model_name)
+    params = FAMILY_PARAMS_GB.get(fam, _DEFAULT_PARAMS_GB)
+    act = FAMILY_ACT_GB_PER_IMAGE.get(fam, _DEFAULT_ACT_GB)
+    return params + batch * act * _area_scale(size, width)
+
+
+def min_chips(model_name: str, hbm_gb_per_chip: float) -> int:
+    """TP shards needed so the per-chip parameter cut + one image fits."""
+    fam = _family_key(model_name)
+    params = FAMILY_PARAMS_GB.get(fam, _DEFAULT_PARAMS_GB)
+    n = 1
+    while params / n + _DEFAULT_ACT_GB > hbm_gb_per_chip and n < 64:
+        n *= 2
+    return n
+
+
+def _per_chip_need_gb(chipset, model_name: str, batch: int, size: int,
+                      width: int | None) -> float:
+    """HBM needed on the BUSIEST chip: parameters are replicated except
+    over the tensor axis, activations shard over the data axis."""
+    fam = _family_key(model_name)
+    params = FAMILY_PARAMS_GB.get(fam, _DEFAULT_PARAMS_GB)
+    act = FAMILY_ACT_GB_PER_IMAGE.get(fam, _DEFAULT_ACT_GB)
+    tensor = max(getattr(chipset, "tensor", 1), 1)
+    seq = max(getattr(chipset, "seq", 1), 1)
+    data = max(chipset.chip_count() // (tensor * seq), 1)
+    local_batch = -(-batch // data)  # ceil: the busiest data shard
+    return params / tensor + local_batch * act * _area_scale(size, width)
+
+
+def fit_batch(chipset, model_name: str, batch: int, size: int,
+              width: int | None = None) -> int:
+    """Largest batch (<= requested) this slice fits; 0 = model doesn't fit.
+
+    Accounting is PER CHIP: with tensor=1 the parameter tree replicates
+    onto every chip, so a model bigger than one chip's HBM fails no matter
+    how many data-parallel chips the slice has. Non-accelerator slices
+    (CPU tests) always fit — the host heap is not HBM.
+    """
+    if chipset is None or chipset.platform != "tpu":
+        return batch
+    per_chip_hbm = chipset.hbm_bytes() / (1 << 30) / max(chipset.chip_count(), 1)
+    while batch > 0 and (
+        _per_chip_need_gb(chipset, model_name, batch, size, width)
+        > per_chip_hbm
+    ):
+        batch -= 1
+    return batch
+
+
+def check_capacity(chipset, model_name: str, batch: int, size: int,
+                   width: int | None = None) -> int:
+    """-> allowed batch, or raise a fatal job error naming the fix."""
+    allowed = fit_batch(chipset, model_name, batch, size, width)
+    if allowed == 0:
+        hbm_gb = chipset.hbm_bytes() / (1 << 30)
+        per_chip = hbm_gb / max(chipset.chip_count(), 1)
+        need = min_chips(model_name, per_chip)
+        raise ValueError(
+            f"{model_name} does not fit on this {chipset.chip_count()}-chip "
+            f"slice ({hbm_gb:.0f} GB HBM, tensor="
+            f"{max(getattr(chipset, 'tensor', 1), 1)}): it needs about "
+            f"{required_hbm_gb(model_name, 1, size, width):.0f} GB at this "
+            f"canvas. Serve it from a slice with tensor parallelism >= "
+            f"{need} (chips shard the parameters; data-parallel chips "
+            f"each hold a full copy)."
+        )
+    return allowed

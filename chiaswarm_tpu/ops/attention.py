@@ -59,13 +59,17 @@ def _ring_route(q, k, v, scale):
         return None
     if q.shape[1] < _RING_MIN_SEQ:
         return None
-    from ..parallel.mesh import SEQ_AXIS
+    from ..parallel.mesh import DATA_AXIS, SEQ_AXIS
     from ..parallel.ring import ring_shard_map
 
     n = mesh.shape[SEQ_AXIS]
     if q.shape[1] % n:
         return None
-    return ring_shard_map(mesh, scale)(q, k, v)
+    # keep the enclosing program's batch sharding when the CFG-doubled
+    # batch divides the data axis (otherwise replicate B, shard S only)
+    data = mesh.shape.get(DATA_AXIS, 1)
+    shard_batch = data > 1 and q.shape[0] % data == 0
+    return ring_shard_map(mesh, scale, shard_batch=shard_batch)(q, k, v)
 
 
 def reference_attention(q, k, v, scale: float | None = None):

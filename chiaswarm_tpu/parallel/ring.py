@@ -78,11 +78,20 @@ def _noop(x, mesh):  # pragma: no cover - placeholder for cache warmup
     return x
 
 
-def ring_shard_map(mesh: Mesh, scale: float | None = None):
+def ring_shard_map(mesh: Mesh, scale: float | None = None,
+                   shard_batch: bool = False):
     """The shard_map'd ring-attention entry: [B,S,H,D] sequence-sharded on
     the seq axis. Shared by the host-array wrapper below and the trace-time
-    routing in ops/attention.py."""
-    spec = P(None, SEQ_AXIS, None, None)
+    routing in ops/attention.py.
+
+    `shard_batch` additionally shards B over the data axis — without it,
+    entering shard_map from a batch-sharded enclosing program would
+    all-gather the batch and make every data-axis row redundantly compute
+    the same attention. Callers enable it when B divides the data size.
+    """
+    from .mesh import DATA_AXIS
+
+    spec = P(DATA_AXIS if shard_batch else None, SEQ_AXIS, None, None)
     return jax.shard_map(
         lambda q, k, v: ring_attention(q, k, v, scale=scale),
         mesh=mesh,

@@ -93,10 +93,36 @@ def family_of(pipeline_type: str) -> str:
         raise ValueError(f"Unknown pipeline type: {pipeline_type}") from None
 
 
+def _auto_family(model_name: str) -> str:
+    """Generic wire names (AutoPipelineFor*, DiffusionPipeline) resolve by
+    MODEL name, the way diffusers' AutoPipeline does — the reference hive
+    sends e.g. Kandinsky jobs as AutoPipelineForText2Image
+    (swarm/test.py:96,144)."""
+    name = model_name.lower()
+    if "kandinsky-3" in name or "kandinsky3" in name:
+        return "kandinsky3"
+    if "kandinsky" in name:
+        return "kandinsky_prior" if "prior" in name else "kandinsky"
+    if "cascade" in name:
+        return "cascade_prior" if "prior" in name else "cascade"
+    if "flux" in name:
+        return "flux"
+    if name.startswith("deepfloyd/") or "tiny-if" in name:
+        return "deepfloyd_if"
+    if "latent-upscaler" in name or "tiny-upscaler" in name:
+        return "sd_upscale"
+    from .models.configs import model_family
+
+    return "sdxl" if "xl" in model_family(model_name) else "sd"
+
+
 def get_pipeline(model_name: str, pipeline_type: str, chipset=None, **variant):
     """Resolve (and cache) a resident pipeline for this model on this mesh."""
     _ensure_builtin_families()
-    family = family_of(pipeline_type)
+    if pipeline_type.startswith("AutoPipeline") or pipeline_type == "DiffusionPipeline":
+        family = _auto_family(model_name)
+    else:
+        family = family_of(pipeline_type)
     factory = _FACTORIES.get(family)
     if factory is None:
         raise ValueError(
@@ -148,7 +174,8 @@ def _ensure_builtin_families() -> None:
         return
     _BUILTINS_LOADED = True
     for module in ("stable_diffusion", "video", "audio", "captioning", "flux",
-                   "kandinsky", "cascade", "upscale", "deepfloyd", "bark"):
+                   "kandinsky", "kandinsky3", "cascade", "upscale",
+                   "deepfloyd", "bark"):
         try:
             __import__(f"{__package__}.pipelines.{module}")
         except Exception as e:

@@ -29,6 +29,8 @@ def diffusion_callback(device_identifier: str, model_name: str, **kwargs):
             model_name = (
                 "test/tiny-flux-schnell" if "schnell" in name else "test/tiny-flux"
             )
+        elif "kandinsky-3" in name or "kandinsky3" in name:
+            model_name = "test/tiny-kandinsky3"
         elif "kandinsky" in name:
             model_name = (
                 "test/tiny-kandinsky-prior" if "prior" in name
@@ -45,10 +47,41 @@ def diffusion_callback(device_identifier: str, model_name: str, **kwargs):
             model_name = "test/tiny-sd"
 
     pipeline_type = kwargs.pop("pipeline_type", "DiffusionPipeline")
+
+    # capacity gate BEFORE residency: a model that cannot fit this slice is
+    # a fatal job error naming the chip count it needs; a batch that does
+    # not fit is capped (the TPU-native analog of the reference's
+    # offload/slicing knobs — chips/requirements.py)
+    from ..chips.requirements import check_capacity
+
+    chipset = kwargs.get("chipset")
+    requested_batch = int(kwargs.get("num_images_per_prompt", 1) or 1)
+    # canvas: explicit dims, else the start image's (img2img/inpaint jobs
+    # drop height/width during formatting), else the 1024 family default
+    height = kwargs.get("height")
+    width = kwargs.get("width")
+    image = kwargs.get("image")
+    if (height is None or width is None) and image is not None:
+        probe = image[0] if isinstance(image, list) else image
+        if hasattr(probe, "size"):
+            width, height = probe.size
+    height = int(height or 1024)
+    width = int(width or height)
+    batch_capped = None
+    if chipset is not None:
+        allowed = check_capacity(
+            chipset, model_name, requested_batch, height, width
+        )
+        if allowed < requested_batch:
+            kwargs["num_images_per_prompt"] = allowed
+            batch_capped = {"requested": requested_batch, "served": allowed}
+
     pipeline = get_pipeline(
-        model_name, pipeline_type=pipeline_type, chipset=kwargs.get("chipset")
+        model_name, pipeline_type=pipeline_type, chipset=chipset
     )
     images, pipeline_config = pipeline.run(pipeline_type=pipeline_type, **kwargs)
+    if batch_capped:
+        pipeline_config["batch_capped"] = batch_capped
 
     # real NSFW detection on the decoded pixels (reference envelope parity:
     # swarm/worker.py:166); auxiliary — never fails the job

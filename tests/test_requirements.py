@@ -1,0 +1,97 @@
+"""Per-model capacity requirements (SURVEY §2.6 'memory-pressure
+fallbacks'): explicit HBM accounting replaces the reference's CPU-offload
+knobs — batches cap to what fits, oversized models fail loudly naming the
+slice they need.
+"""
+
+import pytest
+
+from chiaswarm_tpu.chips.requirements import (
+    check_capacity,
+    fit_batch,
+    min_chips,
+    required_hbm_gb,
+)
+
+
+class FakeChipSet:
+    platform = "tpu"
+
+    def __init__(self, chips=1, hbm_gb_per_chip=16, tensor=1, seq=1):
+        self._chips = chips
+        self._hbm = hbm_gb_per_chip
+        self.tensor = tensor
+        self.seq = seq
+
+    def chip_count(self):
+        return self._chips
+
+    def hbm_bytes(self):
+        return self._chips * self._hbm << 30
+
+
+def test_sdxl_batch4_fits_one_v5e():
+    # the measured anchor: bench r02 ran SDXL batch 4 @ 1024^2 on 16 GB
+    assert required_hbm_gb(
+        "stabilityai/stable-diffusion-xl-base-1.0", 4, 1024
+    ) <= 16.0
+    assert fit_batch(
+        FakeChipSet(), "stabilityai/stable-diffusion-xl-base-1.0", 4, 1024
+    ) == 4
+
+
+def test_oversized_batch_caps_not_fails():
+    allowed = check_capacity(
+        FakeChipSet(), "stabilityai/stable-diffusion-xl-base-1.0", 32, 1024
+    )
+    assert 1 <= allowed < 32
+
+
+def test_flux_needs_tensor_parallelism():
+    # 26 GB of parameters cannot sit on one 16 GB chip
+    with pytest.raises(ValueError, match="tensor parallel"):
+        check_capacity(FakeChipSet(), "black-forest-labs/FLUX.1-dev", 1, 1024)
+    assert min_chips("black-forest-labs/FLUX.1-dev", 16.0) >= 2
+    # DATA-parallel chips do not help: the params replicate per chip
+    with pytest.raises(ValueError, match="tensor parallel"):
+        check_capacity(
+            FakeChipSet(chips=8), "black-forest-labs/FLUX.1-dev", 1, 1024
+        )
+    # a tensor-parallel 2-chip slice shards the parameters and fits
+    assert check_capacity(
+        FakeChipSet(chips=2, tensor=2), "black-forest-labs/FLUX.1-dev", 1, 1024
+    ) == 1
+
+
+def test_wide_canvas_counts_both_dims():
+    # 512x2048 has the area of 1024^2 — the gate must not scale by
+    # height alone
+    assert required_hbm_gb(
+        "stabilityai/stable-diffusion-2-1", 4, 512, 2048
+    ) == pytest.approx(
+        required_hbm_gb("stabilityai/stable-diffusion-2-1", 4, 1024, 1024)
+    )
+
+
+def test_data_parallel_shards_activations():
+    # same model, same batch: an 8-chip data-parallel slice holds a larger
+    # batch than one chip because activations shard over data
+    one = fit_batch(FakeChipSet(), "stabilityai/stable-diffusion-xl-base-1.0",
+                    64, 1024)
+    eight = fit_batch(FakeChipSet(chips=8),
+                      "stabilityai/stable-diffusion-xl-base-1.0", 64, 1024)
+    assert eight > one
+
+
+def test_small_canvas_scales_down():
+    big = required_hbm_gb("stabilityai/stable-diffusion-2-1", 4, 1024)
+    small = required_hbm_gb("stabilityai/stable-diffusion-2-1", 4, 512)
+    assert small < big
+
+
+def test_cpu_slices_always_fit():
+    class CpuChipSet(FakeChipSet):
+        platform = "cpu"
+
+    assert fit_batch(CpuChipSet(), "anything", 64, 1024) == 64
+    assert fit_batch(None, "anything", 64, 1024) == 64
