@@ -70,12 +70,23 @@ def required_hbm_gb(model_name: str, batch: int, size: int,
     return params + batch * act * _area_scale(size, width)
 
 
-def min_chips(model_name: str, hbm_gb_per_chip: float) -> int:
-    """TP shards needed so the per-chip parameter cut + one image fits."""
+def default_canvas(model_name: str) -> int:
+    """The family's native serving canvas (the gate's estimate when a job
+    names no dims — assuming 1024 would over-cap SD 1.x/2.x batches)."""
+    fam = _family_key(model_name)
+    return {"sd15": 512, "sd21": 768}.get(fam, 1024)
+
+
+def min_chips(model_name: str, hbm_gb_per_chip: float, size: int = 1024,
+              width: int | None = None) -> int:
+    """TP shards needed so the per-chip parameter cut + one image at this
+    canvas fits."""
     fam = _family_key(model_name)
     params = FAMILY_PARAMS_GB.get(fam, _DEFAULT_PARAMS_GB)
+    act = FAMILY_ACT_GB_PER_IMAGE.get(fam, _DEFAULT_ACT_GB)
+    one_image = act * _area_scale(size, width)
     n = 1
-    while params / n + _DEFAULT_ACT_GB > hbm_gb_per_chip and n < 64:
+    while params / n + one_image > hbm_gb_per_chip and n < 64:
         n *= 2
     return n
 
@@ -103,7 +114,14 @@ def fit_batch(chipset, model_name: str, batch: int, size: int,
     how many data-parallel chips the slice has. Non-accelerator slices
     (CPU tests) always fit — the host heap is not HBM.
     """
+    from ..weights import is_test_model
+
     if chipset is None or chipset.platform != "tpu":
+        return batch
+    if is_test_model(model_name):
+        # tiny stand-ins are a few MB regardless of the family whose
+        # architecture they mimic — the family footprint table is wrong
+        # for them by three orders of magnitude
         return batch
     per_chip_hbm = chipset.hbm_bytes() / (1 << 30) / max(chipset.chip_count(), 1)
     while batch > 0 and (
@@ -121,7 +139,7 @@ def check_capacity(chipset, model_name: str, batch: int, size: int,
     if allowed == 0:
         hbm_gb = chipset.hbm_bytes() / (1 << 30)
         per_chip = hbm_gb / max(chipset.chip_count(), 1)
-        need = min_chips(model_name, per_chip)
+        need = min_chips(model_name, per_chip, size, width)
         raise ValueError(
             f"{model_name} does not fit on this {chipset.chip_count()}-chip "
             f"slice ({hbm_gb:.0f} GB HBM, tensor="

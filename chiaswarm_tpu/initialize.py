@@ -103,7 +103,8 @@ _UNSUPPORTED_CHECK_KEYWORDS = (
     # families the worker can schedule but cannot yet serve with real
     # weights (no conversion path) — `--check` skips instead of failing
     "audioldm", "bark", "animatediff", "zeroscope", "text-to-video",
-    "i2vgen", "stable-video", "damo",
+    "i2vgen", "stable-video", "damo", "kandinsky", "cascade", "deepfloyd",
+    "latent-upscaler", "openpose",
 )
 
 
@@ -130,7 +131,64 @@ def verify_local_model(model_name: str, root: Path | None = None) -> dict | None
         return _verify_dpt_model(model_name, root)
     if "safety" in name:
         return _verify_safety_model(model_name, root)
+    if "flux" in name:
+        return _verify_flux_model(model_name, root)
     return _verify_sd_model(model_name, root)
+
+
+def _verify_flux_model(model_name: str, root: Path) -> dict:
+    """Flux ships transformer/text_encoder(CLIP)/text_encoder_2(T5)/vae
+    subfolders; every component converts through conversion.py the same
+    way FluxPipeline._convert_params loads them at serving time, and each
+    tree shape-checks against the flax architecture."""
+    import jax.numpy as jnp
+
+    from .models.clip import CLIPTextEncoder
+    from .models.conversion import (
+        assert_tree_shapes_match,
+        convert_clip,
+        convert_flux,
+        convert_t5,
+        convert_vae,
+        load_torch_state_dict,
+    )
+    from .models.flux import FluxTransformer
+    from .models.t5 import T5Encoder
+    from .models.vae import AutoencoderKL
+    from .pipelines.flux import _flux_configs
+
+    flux_cfg, t5_cfg, clip_cfg, vae_cfg, _, _, _ = _flux_configs(model_name)
+    model_dir = root / model_name
+    s = 16  # token count: param shapes don't depend on sequence length
+    expected = {
+        "flux": _eval_shape_params(
+            FluxTransformer(flux_cfg),
+            jnp.zeros((1, s, flux_cfg.in_channels)),
+            jnp.zeros((1, s, 3)),
+            jnp.zeros((1, s, flux_cfg.context_dim)),
+            jnp.zeros((1, s, 3)),
+            jnp.zeros((1,)),
+            jnp.zeros((1, flux_cfg.pooled_dim)),
+        ),
+        "t5": _eval_shape_params(
+            T5Encoder(t5_cfg), jnp.zeros((1, s), jnp.int32)
+        ),
+        "clip": _eval_shape_params(
+            CLIPTextEncoder(clip_cfg), jnp.zeros((1, 77), jnp.int32)
+        ),
+        "vae": _eval_shape_params(AutoencoderKL(vae_cfg), jnp.zeros((1, 64, 64, 3))),
+    }
+    counts = {}
+    for comp, sub, conv in (
+        ("flux", "transformer", convert_flux),
+        ("t5", "text_encoder_2", convert_t5),
+        ("clip", "text_encoder", convert_clip),
+        ("vae", "vae", convert_vae),
+    ):
+        converted = conv(load_torch_state_dict(model_dir, sub))
+        assert_tree_shapes_match(converted, expected[comp], prefix=comp)
+        counts[comp] = _param_count(converted)
+    return counts
 
 
 def _verify_safety_model(model_name: str, root: Path) -> dict:

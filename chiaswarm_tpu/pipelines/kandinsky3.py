@@ -133,18 +133,29 @@ class Kandinsky3Pipeline:
         with self._lock:
             if key in self._programs:
                 return self._programs[key]
-        lh, lw, batch, steps, sched_name = key
+        mode, lh, lw, batch, steps, sched_name, t_start = key
         scheduler = get_scheduler(sched_name)
         schedule = scheduler.schedule(steps)
+        loop_start, loop_end = scheduler.loop_bounds(schedule, steps, t_start)
         unet = self.unet
         vae = self.vae
         latent_c = unet.config.in_channels
 
-        def run(params, rng, context, guidance):
-            """context [2B,S,D] rows [uncond | cond]."""
-            latents = jax.random.normal(
+        def run(params, rng, context, guidance, image_latents):
+            """context [2B,S,D] rows [uncond | cond]; img2img starts from
+            the init image's latents noised to the strength level."""
+            noise0 = jax.random.normal(
                 rng, (batch, lh, lw, latent_c), jnp.float32
-            ) * jnp.asarray(schedule.init_noise_sigma, jnp.float32)
+            )
+            if mode == "img2img":
+                latents = scheduler.add_noise(
+                    schedule, image_latents.astype(jnp.float32), noise0,
+                    loop_start,
+                )
+            else:
+                latents = noise0 * jnp.asarray(
+                    schedule.init_noise_sigma, jnp.float32
+                )
             state = scheduler.init_state(latents.shape, latents.dtype)
 
             def body(carry, i):
@@ -169,7 +180,7 @@ class Kandinsky3Pipeline:
                 return (latents, state), ()
 
             (latents, _), _ = jax.lax.scan(
-                body, (latents, state), jnp.arange(steps)
+                body, (latents, state), jnp.arange(loop_start, loop_end)
             )
             pixels = vae.apply(
                 {"params": params["vae"]}, latents.astype(self.dtype),
@@ -201,11 +212,43 @@ class Kandinsky3Pipeline:
             rng = jax.random.key(0)
         kwargs.pop("chipset", None)
         kwargs.pop("pipeline_prior_type", None)  # K3 has no prior stage
+        image = kwargs.pop("image", None)
+        strength = float(kwargs.pop("strength", 0.75))
 
-        height = int(kwargs.pop("height", None) or self.default_size)
-        width = int(kwargs.pop("width", None) or self.default_size)
+        if image is not None:
+            width, height = image.size
+            kwargs.pop("height", None)
+            kwargs.pop("width", None)
+        else:
+            height = int(kwargs.pop("height", None) or self.default_size)
+            width = int(kwargs.pop("width", None) or self.default_size)
         height, width = (max(64, (d // 64) * 64) for d in (height, width))
         lh, lw = height // self.latent_factor, width // self.latent_factor
+
+        mode = "img2img" if image is not None else "txt2img"
+        t_start = (
+            min(int(steps * (1.0 - strength)), steps - 1)
+            if mode == "img2img"
+            else 0
+        )
+        image_latents = jnp.zeros((1, 1, 1, 1), jnp.float32)
+        if image is not None:
+            arr = (
+                np.asarray(
+                    image.convert("RGB").resize((width, height), Image.LANCZOS),
+                    np.float32,
+                )
+                / 127.5
+                - 1.0
+            )
+            image_latents = jnp.broadcast_to(
+                self.vae.apply(
+                    {"params": params["vae"]},
+                    jnp.asarray(arr)[None].astype(self.dtype),
+                    method=self.vae.encode,
+                ).astype(jnp.float32),
+                (n_images, lh, lw, self.unet.config.in_channels),
+            )
 
         max_seq = 77
         texts = [negative_prompt] * n_images + [prompt] * n_images
@@ -214,10 +257,13 @@ class Kandinsky3Pipeline:
         context = self.t5.apply({"params": params["t5"]}, ids)
         timings["text_encode_s"] = round(time.perf_counter() - t0, 3)
 
-        program = self._program((lh, lw, n_images, steps, scheduler_type))
+        program = self._program(
+            (mode, lh, lw, n_images, steps, scheduler_type, t_start)
+        )
         t0 = time.perf_counter()
         pixels = jax.block_until_ready(
-            program(params, rng, context, jnp.float32(guidance_scale))
+            program(params, rng, context, jnp.float32(guidance_scale),
+                    image_latents)
         )
         timings["denoise_decode_s"] = round(time.perf_counter() - t0, 3)
 
@@ -226,7 +272,7 @@ class Kandinsky3Pipeline:
             "model": self.model_name,
             "pipeline": pipeline_type,
             "scheduler": scheduler_type,
-            "mode": "txt2img",
+            "mode": mode,
             "steps": steps,
             "size": [width, height],
             "guidance_scale": guidance_scale,

@@ -58,6 +58,8 @@ def diffusion_callback(device_identifier: str, model_name: str, **kwargs):
     requested_batch = int(kwargs.get("num_images_per_prompt", 1) or 1)
     # canvas: explicit dims, else the start image's (img2img/inpaint jobs
     # drop height/width during formatting), else the 1024 family default
+    from ..chips.requirements import default_canvas
+
     height = kwargs.get("height")
     width = kwargs.get("width")
     image = kwargs.get("image")
@@ -65,7 +67,7 @@ def diffusion_callback(device_identifier: str, model_name: str, **kwargs):
         probe = image[0] if isinstance(image, list) else image
         if hasattr(probe, "size"):
             width, height = probe.size
-    height = int(height or 1024)
+    height = int(height or default_canvas(model_name))
     width = int(width or height)
     batch_capped = None
     if chipset is not None:

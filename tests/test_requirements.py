@@ -95,3 +95,23 @@ def test_cpu_slices_always_fit():
 
     assert fit_batch(CpuChipSet(), "anything", 64, 1024) == 64
     assert fit_batch(None, "anything", 64, 1024) == 64
+
+
+def test_tiny_models_bypass_gate():
+    # tiny stand-ins are a few MB even when their name matches a huge family
+    assert fit_batch(FakeChipSet(), "test/tiny-flux", 8, 1024) == 8
+
+
+def test_default_canvas_per_family():
+    from chiaswarm_tpu.chips.requirements import default_canvas
+
+    assert default_canvas("runwayml/stable-diffusion-v1-5") == 512
+    assert default_canvas("stabilityai/stable-diffusion-2-1") == 768
+    assert default_canvas("stabilityai/stable-diffusion-xl-base-1.0") == 1024
+
+
+def test_min_chips_accounts_canvas():
+    # a bigger canvas can demand a deeper tensor split
+    assert min_chips(
+        "black-forest-labs/FLUX.1-dev", 16.0, 2048, 2048
+    ) >= min_chips("black-forest-labs/FLUX.1-dev", 16.0, 1024, 1024)

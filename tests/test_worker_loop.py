@@ -83,9 +83,10 @@ def test_bad_args_produce_fatal_envelope(sdaas_root):
     assert "error" in result["pipeline_config"]
 
 
-def test_transient_error_renders_error_image(sdaas_root):
-    # txt2audio callback exists but audio pipeline raises: transient ->
-    # error-image artifact, envelope NOT fatal
+def test_missing_bark_weights_fatal(sdaas_root):
+    # Bark is implemented now, so an unconverted real model name follows
+    # the missing-weights policy: FATAL envelope (hive must not resubmit),
+    # error rendered as an image artifact
     hive, results = run_jobs(
         [
             {
@@ -99,8 +100,36 @@ def test_transient_error_renders_error_image(sdaas_root):
         sdaas_root,
     )
     [result] = results
+    assert result["fatal_error"] is True
+    assert "weights" in result["pipeline_config"]["error"]
+    assert result["artifacts"]["primary"]["content_type"] == "image/jpeg"
+
+
+def test_transient_error_renders_error_image(sdaas_root, monkeypatch):
+    # a RUNTIME failure inside an otherwise-valid job stays transient:
+    # error-image artifact, envelope NOT fatal, hive may resubmit
+    from chiaswarm_tpu.pipelines import bark as bark_mod
+
+    def boom(*a, **k):
+        raise RuntimeError("chip fell over mid-job")
+
+    monkeypatch.setattr(bark_mod.BarkPipeline, "run", boom)
+    hive, results = run_jobs(
+        [
+            {
+                "id": "job-3b",
+                "workflow": "txt2audio",
+                "model_name": "suno/bark",
+                "prompt": "x",
+                "content_type": "image/jpeg",
+                "parameters": {"test_tiny_model": True},
+            }
+        ],
+        sdaas_root,
+    )
+    [result] = results
     assert not result.get("fatal_error")
-    assert "error" in result["pipeline_config"]
+    assert "chip fell over" in result["pipeline_config"]["error"]
     assert result["artifacts"]["primary"]["content_type"] == "image/jpeg"
 
 
