@@ -72,7 +72,13 @@ def required_hbm_gb(model_name: str, batch: int, size: int,
 
 def default_canvas(model_name: str) -> int:
     """The family's native serving canvas (the gate's estimate when a job
-    names no dims — assuming 1024 would over-cap SD 1.x/2.x batches)."""
+    names no dims). Only the SD 1.x/2.x families serve below 1024 —
+    `model_family`'s catch-all bucket is 'sd15', so non-SD names
+    (Kandinsky, Cascade, ...) must not fall through to 512 or the gate
+    under-estimates 4x."""
+    name = model_name.lower()
+    if any(k in name for k in ("kandinsky", "cascade", "flux", "deepfloyd")):
+        return 1024
     fam = _family_key(model_name)
     return {"sd15": 512, "sd21": 768}.get(fam, 1024)
 
@@ -139,13 +145,26 @@ def check_capacity(chipset, model_name: str, batch: int, size: int,
     if allowed == 0:
         hbm_gb = chipset.hbm_bytes() / (1 << 30)
         per_chip = hbm_gb / max(chipset.chip_count(), 1)
-        need = min_chips(model_name, per_chip, size, width)
-        raise ValueError(
+        fam = _family_key(model_name)
+        act = FAMILY_ACT_GB_PER_IMAGE.get(fam, _DEFAULT_ACT_GB)
+        one_image = act * _area_scale(size, width)
+        base = (
             f"{model_name} does not fit on this {chipset.chip_count()}-chip "
             f"slice ({hbm_gb:.0f} GB HBM, tensor="
             f"{max(getattr(chipset, 'tensor', 1), 1)}): it needs about "
             f"{required_hbm_gb(model_name, 1, size, width):.0f} GB at this "
-            f"canvas. Serve it from a slice with tensor parallelism >= "
+            f"canvas. "
+        )
+        if one_image >= per_chip:
+            # activations don't shard over tensor: no degree can save this
+            raise ValueError(
+                base + "One image's activations alone exceed a chip's HBM "
+                "at this canvas — reduce the canvas or serve from "
+                "higher-HBM chips."
+            )
+        need = min_chips(model_name, per_chip, size, width)
+        raise ValueError(
+            base + f"Serve it from a slice with tensor parallelism >= "
             f"{need} (chips shard the parameters; data-parallel chips "
             f"each hold a full copy)."
         )

@@ -272,6 +272,17 @@ class KandinskyPipeline:
         unet_cfg, vae_cfg, self.embed_dim, self.default_size = _decoder_configs(
             model_name
         )
+        # controlnet-depth checkpoints condition on a 3-channel depth hint
+        # concatenated onto the latent input (reference job_arguments.py:387
+        # passes `hint` instead of `image` for this model family)
+        self.controlnet = "controlnet" in model_name.lower()
+        if self.controlnet:
+            import dataclasses
+
+            unet_cfg = dataclasses.replace(
+                unet_cfg, in_channels=unet_cfg.in_channels + 3
+            )
+        self.latent_channels = 4
         on_tpu = jax.default_backend() == "tpu"
         self.dtype = jnp.bfloat16 if on_tpu else jnp.float32
         self.unet = UNet2DConditionModel(unet_cfg, dtype=self.dtype)
@@ -328,9 +339,12 @@ class KandinskyPipeline:
         unet = self.unet
         vae = self.vae
         image_ctx = self.image_ctx
-        latent_c = unet.config.in_channels
+        latent_c = self.latent_channels
+        controlnet = self.controlnet
 
-        def run(params, rng, embeds, neg_embeds, guidance):
+        def run(params, rng, embeds, neg_embeds, guidance, hint):
+            """hint [B, lh, lw, 3] depth conditioning (zeros when the model
+            is not a controlnet variant — traced away, never concatenated)."""
             context = image_ctx(
                 params["ctx"],
                 jnp.concatenate([neg_embeds, embeds], axis=0).astype(self.dtype),
@@ -343,6 +357,11 @@ class KandinskyPipeline:
             def body(carry, i):
                 latents, state = carry
                 inp = scheduler.scale_model_input(schedule, latents, i)
+                if controlnet:
+                    # depth hint concatenates onto the latent input channels
+                    inp = jnp.concatenate(
+                        [inp, hint.astype(inp.dtype)], axis=-1
+                    )
                 model_in = jnp.concatenate([inp, inp], axis=0).astype(self.dtype)
                 t = jnp.asarray(schedule.timesteps)[i]
                 out = unet.apply(
@@ -384,12 +403,23 @@ class KandinskyPipeline:
             raise Exception(
                 f"pipeline {self.model_name} was evicted; resubmit the job"
             )
-        if "Controlnet" in pipeline_type or "hint" in kwargs:
+        hint = kwargs.pop("hint", None)
+        if hint is None and (self.controlnet or "Controlnet" in pipeline_type):
+            # a Controlnet-typed job on a non-controlnet checkpoint (or a
+            # controlnet checkpoint with no control image) must not run
+            # silently unconditioned
+            raise Exception(
+                "Kandinsky ControlNet requires a depth hint: schedule "
+                "kandinsky-community/kandinsky-2-2-controlnet-depth with a "
+                "control image (the depth estimator builds the hint)."
+            )
+        if hint is not None and not self.controlnet:
             # silently ignoring the depth hint would return an unconditioned
             # image as a "successful" controlnet job
             raise Exception(
-                "Kandinsky ControlNet (depth hint) is not supported on this "
-                "worker yet."
+                f"{self.model_name} is not a ControlNet checkpoint; the "
+                f"depth hint cannot condition it (use "
+                f"kandinsky-community/kandinsky-2-2-controlnet-depth)."
             )
         timings: dict[str, float] = {}
         steps = int(kwargs.pop("num_inference_steps", 30))
@@ -433,12 +463,26 @@ class KandinskyPipeline:
         # split-embeds jobs deliver the batch via the embeds themselves
         n_images = int(embeds.shape[0])
 
+        hint_lat = jnp.zeros((1, 1, 1, 3), jnp.float32)
+        if self.controlnet:
+            # HWC float hint (pre_processors/depth_estimator.make_hint) ->
+            # latent-resolution conditioning planes
+            hint_arr = jnp.asarray(np.asarray(hint, np.float32))
+            if hint_arr.ndim == 3:
+                hint_arr = hint_arr[None]
+            hint_lat = jnp.broadcast_to(
+                jax.image.resize(
+                    hint_arr, (hint_arr.shape[0], lh, lw, 3), "bilinear"
+                ),
+                (n_images, lh, lw, 3),
+            )
+
         key = (lh, lw, n_images, steps, scheduler_type)
         program = self._program(key)
         t0 = time.perf_counter()
         pixels = jax.block_until_ready(
             program(params, dec_rng, embeds, neg_embeds,
-                    jnp.float32(guidance_scale))
+                    jnp.float32(guidance_scale), hint_lat)
         )
         timings["denoise_decode_s"] = round(time.perf_counter() - t0, 3)
 
@@ -447,7 +491,7 @@ class KandinskyPipeline:
             "model": self.model_name,
             "pipeline": pipeline_type,
             "scheduler": scheduler_type,
-            "mode": "txt2img",
+            "mode": "controlnet" if self.controlnet else "txt2img",
             "steps": steps,
             "size": [width, height],
             "guidance_scale": guidance_scale,

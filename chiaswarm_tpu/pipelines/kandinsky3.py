@@ -213,7 +213,8 @@ class Kandinsky3Pipeline:
         kwargs.pop("chipset", None)
         kwargs.pop("pipeline_prior_type", None)  # K3 has no prior stage
         image = kwargs.pop("image", None)
-        strength = float(kwargs.pop("strength", 0.75))
+        # clamp: strength outside [0,1] would index the schedule negatively
+        strength = min(max(float(kwargs.pop("strength", 0.75)), 0.0), 1.0)
 
         if image is not None:
             width, height = image.size
@@ -227,7 +228,7 @@ class Kandinsky3Pipeline:
 
         mode = "img2img" if image is not None else "txt2img"
         t_start = (
-            min(int(steps * (1.0 - strength)), steps - 1)
+            min(max(int(steps * (1.0 - strength)), 0), steps - 1)
             if mode == "img2img"
             else 0
         )

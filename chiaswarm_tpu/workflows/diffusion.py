@@ -32,10 +32,12 @@ def diffusion_callback(device_identifier: str, model_name: str, **kwargs):
         elif "kandinsky-3" in name or "kandinsky3" in name:
             model_name = "test/tiny-kandinsky3"
         elif "kandinsky" in name:
-            model_name = (
-                "test/tiny-kandinsky-prior" if "prior" in name
-                else "test/tiny-kandinsky"
-            )
+            if "controlnet" in name:
+                model_name = "test/tiny-kandinsky-controlnet"
+            elif "prior" in name:
+                model_name = "test/tiny-kandinsky-prior"
+            else:
+                model_name = "test/tiny-kandinsky"
         elif "cascade" in name:
             model_name = (
                 "test/tiny-cascade-prior" if "prior" in name

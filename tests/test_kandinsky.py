@@ -118,12 +118,42 @@ def test_prior_typed_job_is_clean_error(tiny_prior):
         tiny_prior.run(prompt="x")
 
 
-def test_kandinsky_controlnet_rejected(tiny_decoder):
-    with pytest.raises(Exception, match="ControlNet.*not supported"):
+def test_hint_on_non_controlnet_model_rejected(tiny_decoder):
+    # a hint against a plain decoder checkpoint cannot condition anything
+    with pytest.raises(Exception, match="not a ControlNet checkpoint"):
         tiny_decoder.run(
             prompt="x", pipeline_type="KandinskyV22ControlnetPipeline",
             hint=np.zeros((1, 8, 8, 3), np.float32), num_inference_steps=2,
         )
+
+
+@pytest.fixture(scope="module")
+def tiny_controlnet():
+    return KandinskyPipeline("test/tiny-kandinsky-controlnet")
+
+
+def test_controlnet_depth_hint_conditions(tiny_controlnet):
+    """KandinskyV22ControlnetPipeline with a depth hint (reference
+    job_arguments.py:386-388 passes `hint` instead of `image`)."""
+    rng = np.random.default_rng(0)
+    kw = dict(
+        prompt="a robot, 4k photo",
+        pipeline_type="KandinskyV22ControlnetPipeline",
+        height=64, width=64, num_inference_steps=2, prior_timesteps=2,
+        rng=jax.random.key(3),
+    )
+    a_hint = rng.random((64, 64, 3)).astype(np.float32)
+    b_hint = rng.random((64, 64, 3)).astype(np.float32)
+    a, cfg = tiny_controlnet.run(hint=a_hint, **kw)
+    assert cfg["mode"] == "controlnet"
+    assert a[0].size == (64, 64)
+    b, _ = tiny_controlnet.run(hint=b_hint, **kw)
+    assert not np.array_equal(np.asarray(a[0]), np.asarray(b[0]))
+
+
+def test_controlnet_requires_hint(tiny_controlnet):
+    with pytest.raises(Exception, match="requires a depth hint"):
+        tiny_controlnet.run(prompt="x", num_inference_steps=2)
 
 
 def test_registry_wire_names():

@@ -115,3 +115,20 @@ def test_min_chips_accounts_canvas():
     assert min_chips(
         "black-forest-labs/FLUX.1-dev", 16.0, 2048, 2048
     ) >= min_chips("black-forest-labs/FLUX.1-dev", 16.0, 1024, 1024)
+
+
+def test_unservable_canvas_names_the_real_fix():
+    # FLUX at a huge canvas on small-HBM chips: no tensor degree shards
+    # activations, so the error must not recommend one
+    with pytest.raises(ValueError, match="reduce the canvas"):
+        check_capacity(
+            FakeChipSet(hbm_gb_per_chip=8),
+            "black-forest-labs/FLUX.1-dev", 1, 2048, 2048,
+        )
+
+
+def test_default_canvas_non_sd_families():
+    from chiaswarm_tpu.chips.requirements import default_canvas
+
+    assert default_canvas("kandinsky-community/kandinsky-3") == 1024
+    assert default_canvas("stabilityai/stable-cascade") == 1024
