@@ -31,7 +31,10 @@ FAMILY_PARAMS_GB: dict[str, float] = {
     "sdxl": 8.0,
     "sdxl_refiner": 7.2,
     "flux": 26.0,  # 12B MMDiT + T5-XXL: needs a TP slice
-    "flux_schnell": 26.0,
+    "kandinsky": 6.0,  # prior + decoder + CLIP-bigG text tower
+    "kandinsky3": 16.0,  # 3B UNet + FLAN-T5-XXL encoder
+    "cascade": 11.0,  # stage C 3.6B + stage B 1.5B + text tower
+    "deepfloyd_if": 18.0,  # IF-I XL + T5-XXL encoder
 }
 
 # transient activations per image in the fused denoise+decode program,
@@ -42,7 +45,17 @@ FAMILY_ACT_GB_PER_IMAGE: dict[str, float] = {
     "sdxl": 2.0,
     "sdxl_refiner": 1.8,
     "flux": 2.5,
-    "flux_schnell": 2.5,
+    "kandinsky": 1.2,
+    "kandinsky3": 2.2,
+    "cascade": 1.5,
+    "deepfloyd_if": 1.5,
+}
+
+# native serving canvas per family (everything else serves 1024)
+_FAMILY_CANVAS: dict[str, int] = {
+    "sd15": 512,
+    "sd21": 768,
+    "kandinsky": 512,  # K2.x decoder default (pipelines/kandinsky.py)
 }
 
 _DEFAULT_PARAMS_GB = 2.0
@@ -50,9 +63,21 @@ _DEFAULT_ACT_GB = 1.0
 
 
 def _family_key(model_name: str) -> str:
+    """Capacity bucket — model_family()'s catch-all is 'sd15', so the
+    non-SD families that every capacity table keys on resolve by name
+    FIRST (a Kandinsky charged as a 1.8 GB SD model would defeat the
+    gate)."""
     name = model_name.lower()
     if "flux" in name:
         return "flux"
+    if "kandinsky-3" in name or "kandinsky3" in name:
+        return "kandinsky3"
+    if "kandinsky" in name:
+        return "kandinsky"
+    if "cascade" in name:
+        return "cascade"
+    if name.startswith("deepfloyd/"):
+        return "deepfloyd_if"
     return model_family(model_name)
 
 
@@ -72,15 +97,10 @@ def required_hbm_gb(model_name: str, batch: int, size: int,
 
 def default_canvas(model_name: str) -> int:
     """The family's native serving canvas (the gate's estimate when a job
-    names no dims). Only the SD 1.x/2.x families serve below 1024 —
-    `model_family`'s catch-all bucket is 'sd15', so non-SD names
-    (Kandinsky, Cascade, ...) must not fall through to 512 or the gate
-    under-estimates 4x."""
-    name = model_name.lower()
-    if any(k in name for k in ("kandinsky", "cascade", "flux", "deepfloyd")):
-        return 1024
-    fam = _family_key(model_name)
-    return {"sd15": 512, "sd21": 768}.get(fam, 1024)
+    names no dims — it must match what the pipeline will actually serve,
+    in both directions: 1024 for a 512-native family over-caps batches,
+    512 for a 1024-native family admits OOMs)."""
+    return _FAMILY_CANVAS.get(_family_key(model_name), 1024)
 
 
 def min_chips(model_name: str, hbm_gb_per_chip: float, size: int = 1024,

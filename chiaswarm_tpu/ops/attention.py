@@ -103,6 +103,8 @@ def dot_product_attention(q, k, v, scale: float | None = None):
     if ring_out is not None:
         return ring_out
     on_tpu = platform == "tpu"
+    if _flash_disabled():
+        on_tpu = False
     if on_tpu and q.shape[1] >= _FLASH_MIN_SEQ and q.shape[-1] <= 128:
         try:
             from .flash_attention import flash_attention
@@ -111,6 +113,16 @@ def dot_product_attention(q, k, v, scale: float | None = None):
         else:
             return flash_attention(q, k, v, scale=scale)
     return reference_attention(q, k, v, scale=scale)
+
+
+@functools.cache
+def _flash_disabled() -> bool:
+    """Operational escape hatch: CHIASWARM_DISABLE_FLASH=1 routes all
+    attention through XLA's fused path (A/B perf comparison, or a
+    suspected kernel miscompile on a new TPU generation)."""
+    import os
+
+    return os.environ.get("CHIASWARM_DISABLE_FLASH", "") == "1"
 
 
 @functools.cache

@@ -333,25 +333,39 @@ class KandinskyPipeline:
         with self._lock:
             if key in self._programs:
                 return self._programs[key]
-        lh, lw, batch, steps, sched_name = key
+        mode, lh, lw, batch, steps, sched_name, t_start = key
         scheduler = get_scheduler(sched_name)
         schedule = scheduler.schedule(steps)
+        loop_start, loop_end = scheduler.loop_bounds(schedule, steps, t_start)
         unet = self.unet
         vae = self.vae
         image_ctx = self.image_ctx
         latent_c = self.latent_channels
         controlnet = self.controlnet
 
-        def run(params, rng, embeds, neg_embeds, guidance, hint):
+        def run(params, rng, embeds, neg_embeds, guidance, hint,
+                image_latents):
             """hint [B, lh, lw, 3] depth conditioning (zeros when the model
-            is not a controlnet variant — traced away, never concatenated)."""
+            is not a controlnet variant — traced away, never concatenated);
+            img2img starts from the init image's latents noised to the
+            strength level (reference wire: kandinsky img2img jobs,
+            swarm/test.py:100-113)."""
             context = image_ctx(
                 params["ctx"],
                 jnp.concatenate([neg_embeds, embeds], axis=0).astype(self.dtype),
             )
-            latents = jax.random.normal(
+            noise0 = jax.random.normal(
                 rng, (batch, lh, lw, latent_c), jnp.float32
-            ) * jnp.asarray(schedule.init_noise_sigma, jnp.float32)
+            )
+            if mode == "img2img":
+                latents = scheduler.add_noise(
+                    schedule, image_latents.astype(jnp.float32), noise0,
+                    loop_start,
+                )
+            else:
+                latents = noise0 * jnp.asarray(
+                    schedule.init_noise_sigma, jnp.float32
+                )
             state = scheduler.init_state(latents.shape, latents.dtype)
 
             def body(carry, i):
@@ -381,7 +395,7 @@ class KandinskyPipeline:
                 return (latents, state), ()
 
             (latents, _), _ = jax.lax.scan(
-                body, (latents, state), jnp.arange(steps)
+                body, (latents, state), jnp.arange(loop_start, loop_end)
             )
             pixels = vae.apply(
                 {"params": params["vae"]}, latents.astype(self.dtype),
@@ -432,11 +446,27 @@ class KandinskyPipeline:
         if rng is None:
             rng = jax.random.key(0)
         chipset = kwargs.pop("chipset", None)
+        image = kwargs.pop("image", None)
+        kwargs.pop("control_image", None)  # the hint IS the conditioning
+        # clamp: strength outside [0,1] would index the schedule negatively
+        strength = min(max(float(kwargs.pop("strength", 0.75)), 0.0), 1.0)
 
-        height = int(kwargs.pop("height", None) or self.default_size)
-        width = int(kwargs.pop("width", None) or self.default_size)
+        if image is not None:
+            width, height = image.size
+            kwargs.pop("height", None)
+            kwargs.pop("width", None)
+        else:
+            height = int(kwargs.pop("height", None) or self.default_size)
+            width = int(kwargs.pop("width", None) or self.default_size)
         height, width = (max(64, (d // 64) * 64) for d in (height, width))
         lh, lw = height // self.latent_factor, width // self.latent_factor
+
+        mode = "img2img" if image is not None else "txt2img"
+        t_start = (
+            min(max(int(steps * (1.0 - strength)), 0), steps - 1)
+            if mode == "img2img"
+            else 0
+        )
 
         embeds = kwargs.pop("image_embeds", None)
         neg_embeds = kwargs.pop("negative_image_embeds", None)
@@ -463,6 +493,25 @@ class KandinskyPipeline:
         # split-embeds jobs deliver the batch via the embeds themselves
         n_images = int(embeds.shape[0])
 
+        image_latents = jnp.zeros((1, 1, 1, 1), jnp.float32)
+        if image is not None:
+            arr = (
+                np.asarray(
+                    image.convert("RGB").resize((width, height), Image.LANCZOS),
+                    np.float32,
+                )
+                / 127.5
+                - 1.0
+            )
+            image_latents = jnp.broadcast_to(
+                self.vae.apply(
+                    {"params": params["vae"]},
+                    jnp.asarray(arr)[None].astype(self.dtype),
+                    method=self.vae.encode,
+                ).astype(jnp.float32),
+                (n_images, lh, lw, self.latent_channels),
+            )
+
         hint_lat = jnp.zeros((1, 1, 1, 3), jnp.float32)
         if self.controlnet:
             # HWC float hint (pre_processors/depth_estimator.make_hint) ->
@@ -477,12 +526,12 @@ class KandinskyPipeline:
                 (n_images, lh, lw, 3),
             )
 
-        key = (lh, lw, n_images, steps, scheduler_type)
+        key = (mode, lh, lw, n_images, steps, scheduler_type, t_start)
         program = self._program(key)
         t0 = time.perf_counter()
         pixels = jax.block_until_ready(
             program(params, dec_rng, embeds, neg_embeds,
-                    jnp.float32(guidance_scale), hint_lat)
+                    jnp.float32(guidance_scale), hint_lat, image_latents)
         )
         timings["denoise_decode_s"] = round(time.perf_counter() - t0, 3)
 
@@ -491,7 +540,7 @@ class KandinskyPipeline:
             "model": self.model_name,
             "pipeline": pipeline_type,
             "scheduler": scheduler_type,
-            "mode": "controlnet" if self.controlnet else "txt2img",
+            "mode": "controlnet" if self.controlnet else mode,
             "steps": steps,
             "size": [width, height],
             "guidance_scale": guidance_scale,

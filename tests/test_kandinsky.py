@@ -199,3 +199,20 @@ def test_kandinsky_job_through_callback():
     )
     assert config["model"] == "test/tiny-kandinsky"
     assert artifacts["primary"]["content_type"] == "image/jpeg"
+
+
+def test_img2img_conditions_on_init_image(tiny_decoder):
+    """Kandinsky img2img (reference swarm/test.py:100-113 schedules it via
+    AutoPipelineForImage2Image): the init image sets the denoise start."""
+    from PIL import Image as PILImage
+
+    rng = np.random.default_rng(0)
+    img_a = PILImage.fromarray((rng.random((64, 64, 3)) * 255).astype(np.uint8))
+    img_b = PILImage.fromarray((rng.random((64, 64, 3)) * 255).astype(np.uint8))
+    kw = dict(prompt="repaint", num_inference_steps=4, prior_timesteps=2,
+              strength=0.5, rng=jax.random.key(9))
+    a, cfg = tiny_decoder.run(image=img_a, **kw)
+    assert cfg["mode"] == "img2img"
+    assert a[0].size == (64, 64)
+    b, _ = tiny_decoder.run(image=img_b, **kw)
+    assert not np.array_equal(np.asarray(a[0]), np.asarray(b[0]))
