@@ -184,3 +184,50 @@ def greedy_decode(decoder_apply, params, image_embeds, config: BlipConfig,
 
     ids, _ = jax.lax.scan(body, ids, jnp.arange(1, max_len))
     return ids
+
+
+class TextEncoder(nn.Module):
+    """BERT-style post-LN BIDIRECTIONAL encoder with cross-attention over
+    vision embeds — HF BlipTextModel as BlipForQuestionAnswering uses it to
+    encode the question against the image. Same block structure as
+    TextDecoder minus the causal mask and the LM head; returns hidden
+    states for the answer decoder to cross-attend."""
+
+    config: BlipConfig
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, input_ids, image_embeds):
+        """[B, L] ids + [B, P, Dv] -> [B, L, D] question states."""
+        cfg = self.config
+        b, s = input_ids.shape
+        eps = 1e-12
+        x = nn.Embed(
+            cfg.vocab_size, cfg.text_hidden, dtype=self.dtype,
+            name="word_embeddings",
+        )(input_ids)
+        pos = self.param(
+            "position_embeddings", nn.initializers.normal(0.02),
+            (cfg.max_positions, cfg.text_hidden),
+        ).astype(self.dtype)
+        x = x + pos[None, :s]
+        x = nn.LayerNorm(epsilon=eps, dtype=self.dtype, name="embed_ln")(x)
+        img = image_embeds.astype(self.dtype)
+        for i in range(cfg.text_layers):
+            y = _MHA(cfg.text_heads, cfg.text_hidden, dtype=self.dtype,
+                     name=f"self_{i}")(x, x)
+            x = nn.LayerNorm(epsilon=eps, dtype=self.dtype, name=f"self_ln_{i}")(
+                x + y
+            )
+            y = _MHA(cfg.text_heads, cfg.text_hidden, dtype=self.dtype,
+                     name=f"cross_{i}")(x, img)
+            x = nn.LayerNorm(epsilon=eps, dtype=self.dtype, name=f"cross_ln_{i}")(
+                x + y
+            )
+            y = nn.Dense(cfg.text_hidden * 4, dtype=self.dtype, name=f"fc1_{i}")(x)
+            y = nn.gelu(y, approximate=False)
+            y = nn.Dense(cfg.text_hidden, dtype=self.dtype, name=f"fc2_{i}")(y)
+            x = nn.LayerNorm(epsilon=eps, dtype=self.dtype, name=f"ffn_ln_{i}")(
+                x + y
+            )
+        return x

@@ -59,10 +59,18 @@ class CaptionPipeline:
         self.model_name = model_name
         self.chipset = chipset
         self.config = _blip_configs(model_name)
+        # VQA checkpoints add a question encoder; the answer decoder then
+        # cross-attends the encoded question instead of the raw image
+        # (HF BlipForQuestionAnswering, reference caption_image.py:21-26)
+        self.vqa = "vqa" in model_name.lower()
         on_tpu = jax.default_backend() == "tpu"
         self.dtype = jnp.bfloat16 if on_tpu else jnp.float32
         self.vision = VisionEncoder(self.config, dtype=self.dtype)
         self.decoder = TextDecoder(self.config, dtype=self.dtype)
+        if self.vqa:
+            from ..models.blip import TextEncoder
+
+            self.question_encoder = TextEncoder(self.config, dtype=self.dtype)
         self.mesh = (
             chipset.mesh() if chipset is not None else make_mesh(jax.devices()[:1])
         )
@@ -93,6 +101,11 @@ class CaptionPipeline:
 
     def _load_params(self, model_dir: Path | None, allow_random_init: bool):
         self._real_weights = False
+        if self.vqa and model_dir is not None:
+            # the VQA question-encoder conversion is not wired yet; loading
+            # only the captioning components would answer with confident
+            # garbage — fall through to the weights gate
+            model_dir = None
         if model_dir is not None:
             try:
                 from ..models.conversion import convert_blip, load_torch_state_dict
@@ -118,15 +131,24 @@ class CaptionPipeline:
             vision = self.vision.init(
                 k1, jnp.zeros((1, cfg.image_size, cfg.image_size, 3))
             )["params"]
+            # VQA: the answer decoder's cross-attention context is the
+            # question states [*, L, text_hidden], not the image embeds
+            ctx_dim = cfg.text_hidden if self.vqa else cfg.vision_hidden
+            ctx_len = cfg.max_caption_len if self.vqa else n_patches + 1
             text = self.decoder.init(
                 k2,
                 jnp.zeros((1, cfg.max_caption_len), jnp.int32),
-                jnp.zeros((1, n_patches + 1, cfg.vision_hidden)),
+                jnp.zeros((1, ctx_len, ctx_dim)),
             )["params"]
+            tree = {"vision": vision, "text": text}
+            if self.vqa:
+                tree["qenc"] = self.question_encoder.init(
+                    jax.random.fold_in(rng, 2),
+                    jnp.zeros((1, cfg.max_caption_len), jnp.int32),
+                    jnp.zeros((1, n_patches + 1, cfg.vision_hidden)),
+                )["params"]
         cast = lambda x: jnp.asarray(x, self.dtype)
-        params = jax.tree_util.tree_map(
-            cast, {"vision": vision, "text": text}
-        )
+        params = jax.tree_util.tree_map(cast, tree)
         return jax.device_put(params, replicated(self.mesh))
 
     def _check_converted_shapes(self, params: dict, model_dir: Path) -> None:
@@ -194,6 +216,9 @@ class CaptionPipeline:
         pixels = jnp.asarray(self._preprocess(image), self.dtype)
         embeds = self._encode_program(params["vision"], pixels)
 
+        if self.vqa:
+            return self._run_vqa(params, embeds, prompt, t0)
+
         prefix_ids = None
         prefix_len = 0
         if prompt:
@@ -220,6 +245,54 @@ class CaptionPipeline:
         }
         return text, config
 
+    def _run_vqa(self, params, image_embeds, prompt, t0) -> tuple[str, dict]:
+        """Question -> encoded-against-image states -> greedy answer."""
+        if not prompt:
+            raise ValueError(
+                "BLIP VQA requires a question; send it as the job prompt."
+            )
+        cfg = self.config
+        enc = self.tokenizer.encode(prompt)[: cfg.max_caption_len - 1]
+        q_ids = np.full((1, cfg.max_caption_len), cfg.eos_token_id, np.int32)
+        q_ids[0, : len(enc)] = enc
+        program = self._vqa_program()
+        ids = np.asarray(
+            jax.block_until_ready(
+                program(params, jnp.asarray(q_ids), image_embeds)
+            )
+        )[0]
+        body = ids[1:]  # strip [DEC]
+        eos = np.nonzero(body == cfg.eos_token_id)[0]
+        if eos.size:
+            body = body[: eos[0]]
+        text = self.tokenizer.decode(body)
+        config = {
+            "model": self.model_name,
+            "vqa": True,
+            "timings": {"caption_s": round(time.perf_counter() - t0, 3)},
+        }
+        return text, config
+
+    def _vqa_program(self):
+        if "vqa" in self._decode_programs:
+            return self._decode_programs["vqa"]
+        cfg = self.config
+        qenc = self.question_encoder
+        decoder = self.decoder
+
+        def apply(text_params, ids, context):
+            return decoder.apply({"params": text_params}, ids, context)
+
+        def run(params, q_ids, image_embeds):
+            question_states = qenc.apply(
+                {"params": params["qenc"]}, q_ids, image_embeds
+            )
+            return greedy_decode(apply, params["text"], question_states, cfg)
+
+        program = jax.jit(run)
+        self._decode_programs["vqa"] = program
+        return program
+
     def release(self):
         self.params = None
         self._decode_programs.clear()
@@ -231,13 +304,14 @@ def _build_blip(model_name, chipset, **variant):
 
 
 def reject_unsupported_blip(model_name: str, model_type: str | None) -> None:
-    """VQA checkpoints need a question-encoder stack this worker doesn't
-    implement; serving them through the captioning decoder would return
-    confident garbage as a 'successful' answer. Fail the job cleanly."""
-    if model_type == "BlipForQuestionAnswering" or "vqa" in model_name.lower():
+    """VQA routes by MODEL NAME (CaptionPipeline builds the question
+    encoder for 'vqa' names); a VQA-typed job whose model name doesn't
+    identify as VQA would silently serve the captioning stack, so it
+    still fails cleanly."""
+    if model_type == "BlipForQuestionAnswering" and "vqa" not in model_name.lower():
         raise Exception(
-            f"BLIP VQA ({model_name}) is not supported on this worker; only "
-            f"conditional captioning models are."
+            f"BlipForQuestionAnswering was requested but '{model_name}' is "
+            f"not a VQA checkpoint (use Salesforce/blip-vqa-base)."
         )
 
 

@@ -1,0 +1,54 @@
+"""Shared per-job helpers for the resident pipelines.
+
+The img2img start logic (strength clamp, scan start index, init-image
+VAE encode through a cached jitted program) is identical across the
+Kandinsky families — one implementation here so fixes land once.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+def clamp_strength(value) -> float:
+    """Strength outside [0,1] would index the schedule negatively."""
+    return min(max(float(value), 0.0), 1.0)
+
+
+def img2img_t_start(steps: int, strength: float) -> int:
+    """Scan start index for an img2img job at this strength."""
+    return min(max(int(steps * (1.0 - strength)), 0), steps - 1)
+
+
+def encode_init_image(pipe, vae_params, image, width: int, height: int,
+                      n_images: int, lh: int, lw: int, channels: int):
+    """PIL init image -> [n_images, lh, lw, channels] float32 latents.
+
+    Encodes through ONE cached jitted program per pipeline instance —
+    an op-by-op `vae.apply` on the job hot path costs a host->device
+    round trip per op (round-1 measurement: >50% of job time host-side,
+    stable_diffusion.py's `_vae_encode_program` rationale).
+    """
+    import jax
+    import jax.numpy as jnp
+    from PIL import Image
+
+    program = getattr(pipe, "_vae_encode_program", None)
+    if program is None:
+        program = jax.jit(
+            lambda p, px: pipe.vae.apply(
+                {"params": p}, px, method=pipe.vae.encode
+            ).astype(jnp.float32)
+        )
+        pipe._vae_encode_program = program
+
+    arr = (
+        np.asarray(
+            image.convert("RGB").resize((width, height), Image.LANCZOS),
+            np.float32,
+        )
+        / 127.5
+        - 1.0
+    )
+    latents = program(vae_params, jnp.asarray(arr)[None].astype(pipe.dtype))
+    return jnp.broadcast_to(latents, (n_images, lh, lw, channels))

@@ -448,8 +448,9 @@ class KandinskyPipeline:
         chipset = kwargs.pop("chipset", None)
         image = kwargs.pop("image", None)
         kwargs.pop("control_image", None)  # the hint IS the conditioning
-        # clamp: strength outside [0,1] would index the schedule negatively
-        strength = min(max(float(kwargs.pop("strength", 0.75)), 0.0), 1.0)
+        from .common import clamp_strength, img2img_t_start
+
+        strength = clamp_strength(kwargs.pop("strength", 0.75))
 
         if image is not None:
             width, height = image.size
@@ -462,11 +463,7 @@ class KandinskyPipeline:
         lh, lw = height // self.latent_factor, width // self.latent_factor
 
         mode = "img2img" if image is not None else "txt2img"
-        t_start = (
-            min(max(int(steps * (1.0 - strength)), 0), steps - 1)
-            if mode == "img2img"
-            else 0
-        )
+        t_start = img2img_t_start(steps, strength) if mode == "img2img" else 0
 
         embeds = kwargs.pop("image_embeds", None)
         neg_embeds = kwargs.pop("negative_image_embeds", None)
@@ -495,21 +492,11 @@ class KandinskyPipeline:
 
         image_latents = jnp.zeros((1, 1, 1, 1), jnp.float32)
         if image is not None:
-            arr = (
-                np.asarray(
-                    image.convert("RGB").resize((width, height), Image.LANCZOS),
-                    np.float32,
-                )
-                / 127.5
-                - 1.0
-            )
-            image_latents = jnp.broadcast_to(
-                self.vae.apply(
-                    {"params": params["vae"]},
-                    jnp.asarray(arr)[None].astype(self.dtype),
-                    method=self.vae.encode,
-                ).astype(jnp.float32),
-                (n_images, lh, lw, self.latent_channels),
+            from .common import encode_init_image
+
+            image_latents = encode_init_image(
+                self, params["vae"], image, width, height, n_images,
+                lh, lw, self.latent_channels,
             )
 
         hint_lat = jnp.zeros((1, 1, 1, 3), jnp.float32)

@@ -213,8 +213,9 @@ class Kandinsky3Pipeline:
         kwargs.pop("chipset", None)
         kwargs.pop("pipeline_prior_type", None)  # K3 has no prior stage
         image = kwargs.pop("image", None)
-        # clamp: strength outside [0,1] would index the schedule negatively
-        strength = min(max(float(kwargs.pop("strength", 0.75)), 0.0), 1.0)
+        from .common import clamp_strength, encode_init_image, img2img_t_start
+
+        strength = clamp_strength(kwargs.pop("strength", 0.75))
 
         if image is not None:
             width, height = image.size
@@ -227,28 +228,12 @@ class Kandinsky3Pipeline:
         lh, lw = height // self.latent_factor, width // self.latent_factor
 
         mode = "img2img" if image is not None else "txt2img"
-        t_start = (
-            min(max(int(steps * (1.0 - strength)), 0), steps - 1)
-            if mode == "img2img"
-            else 0
-        )
+        t_start = img2img_t_start(steps, strength) if mode == "img2img" else 0
         image_latents = jnp.zeros((1, 1, 1, 1), jnp.float32)
         if image is not None:
-            arr = (
-                np.asarray(
-                    image.convert("RGB").resize((width, height), Image.LANCZOS),
-                    np.float32,
-                )
-                / 127.5
-                - 1.0
-            )
-            image_latents = jnp.broadcast_to(
-                self.vae.apply(
-                    {"params": params["vae"]},
-                    jnp.asarray(arr)[None].astype(self.dtype),
-                    method=self.vae.encode,
-                ).astype(jnp.float32),
-                (n_images, lh, lw, self.unet.config.in_channels),
+            image_latents = encode_init_image(
+                self, params["vae"], image, width, height, n_images,
+                lh, lw, self.unet.config.in_channels,
             )
 
         max_seq = 77

@@ -42,11 +42,15 @@ PIPELINE_FAMILIES: dict[str, str] = {
     "StableDiffusionXLInstructPix2PixPipeline": "sdxl",
     "StableDiffusionLatentUpscalePipeline": "sd_upscale",
     "KandinskyPipeline": "kandinsky",
+    "KandinskyImg2ImgPipeline": "kandinsky",
     "KandinskyV22Pipeline": "kandinsky",
+    "KandinskyV22Img2ImgPipeline": "kandinsky",
     "KandinskyV22ControlnetPipeline": "kandinsky",
+    "KandinskyV22ControlnetImg2ImgPipeline": "kandinsky",
     "KandinskyV22PriorPipeline": "kandinsky_prior",
     "KandinskyV22PriorEmb2EmbPipeline": "kandinsky_prior",
     "Kandinsky3Pipeline": "kandinsky3",
+    "Kandinsky3Img2ImgPipeline": "kandinsky3",
     "AutoPipelineForText2Image": "sd",
     "StableCascadeDecoderPipeline": "cascade",
     "StableCascadePriorPipeline": "cascade_prior",

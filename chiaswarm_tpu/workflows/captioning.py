@@ -19,7 +19,11 @@ def caption_callback(device_identifier: str, model_name: str, **kwargs):
     prompt = kwargs.get("prompt") or None
     parameters = kwargs.get("parameters", {})
     if parameters.get("test_tiny_model"):
-        model_name = "test/tiny-blip"
+        is_vqa = (
+            "vqa" in model_name.lower()
+            or parameters.get("model_type") == "BlipForQuestionAnswering"
+        )
+        model_name = "test/tiny-blip-vqa" if is_vqa else "test/tiny-blip"
     pipe = get_caption_pipeline(
         model_name,
         chipset=kwargs.get("chipset"),
