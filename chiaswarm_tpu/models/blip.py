@@ -102,52 +102,78 @@ class VisionEncoder(nn.Module):
         return nn.LayerNorm(epsilon=eps, dtype=self.dtype, name="ln_post")(x)
 
 
+def _embed_text(module, cfg: BlipConfig, input_ids, dtype):
+    """Word + learned-position embeddings with BERT embedding LN (shared by
+    the decoder and the VQA question encoder; identical param names)."""
+    s = input_ids.shape[1]
+    x = nn.Embed(
+        cfg.vocab_size, cfg.text_hidden, dtype=dtype, name="word_embeddings"
+    )(input_ids)
+    pos = module.param(
+        "position_embeddings", nn.initializers.normal(0.02),
+        (cfg.max_positions, cfg.text_hidden),
+    ).astype(dtype)
+    x = x + pos[None, :s]
+    return nn.LayerNorm(epsilon=1e-12, dtype=dtype, name="embed_ln")(x)
+
+
+def _bert_layer(cfg: BlipConfig, dtype, i: int, x, context,
+                self_mask=None, context_mask=None):
+    """One post-LN BERT layer [self-attn + LN, cross-attn + LN, FFN + LN]
+    — the block both TextDecoder and TextEncoder run, differing only in
+    the masks. Must be called inside the owner's @nn.compact so the param
+    names (self_{i}, cross_{i}, fc1_{i}, ...) land identically whichever
+    module runs it."""
+    eps = 1e-12  # BERT layer_norm_eps
+    y = _MHA(cfg.text_heads, cfg.text_hidden, dtype=dtype,
+             name=f"self_{i}")(x, x, self_mask)
+    x = nn.LayerNorm(epsilon=eps, dtype=dtype, name=f"self_ln_{i}")(x + y)
+    y = _MHA(cfg.text_heads, cfg.text_hidden, dtype=dtype,
+             name=f"cross_{i}")(x, context, context_mask)
+    x = nn.LayerNorm(epsilon=eps, dtype=dtype, name=f"cross_ln_{i}")(x + y)
+    y = nn.Dense(cfg.text_hidden * 4, dtype=dtype, name=f"fc1_{i}")(x)
+    y = nn.gelu(y, approximate=False)
+    y = nn.Dense(cfg.text_hidden, dtype=dtype, name=f"fc2_{i}")(y)
+    return nn.LayerNorm(epsilon=eps, dtype=dtype, name=f"ffn_ln_{i}")(x + y)
+
+
+def _additive_mask(attention_mask, dtype):
+    """[B, K] 1/0 keep-mask -> [B, 1, 1, K] additive logits mask."""
+    return ((1.0 - attention_mask.astype(jnp.float32)) * -1e9).astype(dtype)[
+        :, None, None, :
+    ]
+
+
 class TextDecoder(nn.Module):
     """BERT-style post-LN causal decoder mirroring HF BLIP's text_decoder
     (BlipTextLMHeadModel): embedding LN, per-layer [self-attn + LN,
-    cross-attn over vision embeds + LN, FFN + LN], prediction-head
+    cross-attn over the context + LN, FFN + LN], prediction-head
     transform (dense -> gelu -> LN) before the vocab projection. Post-LN
     ordering and 1e-12 epsilons are load-bearing for converted weights.
+    The cross-attention context is the vision embeds for captioning or the
+    encoded question for VQA; `context_mask` [B, K] excludes padded
+    context positions.
     """
 
     config: BlipConfig
     dtype: jnp.dtype = jnp.float32
 
     @nn.compact
-    def __call__(self, input_ids, image_embeds):
-        """[B, L] ids + [B, P, Dv] -> [B, L, vocab] logits (causal)."""
+    def __call__(self, input_ids, image_embeds, context_mask=None):
+        """[B, L] ids + [B, K, Dc] -> [B, L, vocab] logits (causal)."""
         cfg = self.config
-        b, s = input_ids.shape
-        eps = 1e-12  # BERT layer_norm_eps
-        x = nn.Embed(
-            cfg.vocab_size, cfg.text_hidden, dtype=self.dtype,
-            name="word_embeddings",
-        )(input_ids)
-        pos = self.param(
-            "position_embeddings", nn.initializers.normal(0.02),
-            (cfg.max_positions, cfg.text_hidden),
-        ).astype(self.dtype)
-        x = x + pos[None, :s]
-        x = nn.LayerNorm(epsilon=eps, dtype=self.dtype, name="embed_ln")(x)
+        s = input_ids.shape[1]
+        eps = 1e-12
+        x = _embed_text(self, cfg, input_ids, self.dtype)
         causal = jnp.triu(jnp.full((s, s), -1e9, self.dtype), k=1)[None, None]
-        img = image_embeds.astype(self.dtype)
+        ctx = image_embeds.astype(self.dtype)
+        ctx_mask = (
+            _additive_mask(context_mask, self.dtype)
+            if context_mask is not None
+            else None
+        )
         for i in range(cfg.text_layers):
-            y = _MHA(cfg.text_heads, cfg.text_hidden, dtype=self.dtype,
-                     name=f"self_{i}")(x, x, causal)
-            x = nn.LayerNorm(epsilon=eps, dtype=self.dtype, name=f"self_ln_{i}")(
-                x + y
-            )
-            y = _MHA(cfg.text_heads, cfg.text_hidden, dtype=self.dtype,
-                     name=f"cross_{i}")(x, img)
-            x = nn.LayerNorm(epsilon=eps, dtype=self.dtype, name=f"cross_ln_{i}")(
-                x + y
-            )
-            y = nn.Dense(cfg.text_hidden * 4, dtype=self.dtype, name=f"fc1_{i}")(x)
-            y = nn.gelu(y, approximate=False)
-            y = nn.Dense(cfg.text_hidden, dtype=self.dtype, name=f"fc2_{i}")(y)
-            x = nn.LayerNorm(epsilon=eps, dtype=self.dtype, name=f"ffn_ln_{i}")(
-                x + y
-            )
+            x = _bert_layer(cfg, self.dtype, i, x, ctx, causal, ctx_mask)
         y = nn.Dense(cfg.text_hidden, dtype=self.dtype, name="head_dense")(x)
         y = nn.gelu(y, approximate=False)
         y = nn.LayerNorm(epsilon=eps, dtype=self.dtype, name="head_ln")(y)
@@ -189,45 +215,28 @@ def greedy_decode(decoder_apply, params, image_embeds, config: BlipConfig,
 class TextEncoder(nn.Module):
     """BERT-style post-LN BIDIRECTIONAL encoder with cross-attention over
     vision embeds — HF BlipTextModel as BlipForQuestionAnswering uses it to
-    encode the question against the image. Same block structure as
-    TextDecoder minus the causal mask and the LM head; returns hidden
-    states for the answer decoder to cross-attend."""
+    encode the question against the image. Same block as TextDecoder
+    (shared `_bert_layer`, identical param names) minus the causal mask
+    and the LM head; returns hidden states for the answer decoder to
+    cross-attend. `attention_mask` [B, L] excludes padded question
+    positions from self-attention. HF additionally swaps token 0 for its
+    [ENC] id — handled at weight-conversion time alongside the tokenizer's
+    special-token table."""
 
     config: BlipConfig
     dtype: jnp.dtype = jnp.float32
 
     @nn.compact
-    def __call__(self, input_ids, image_embeds):
+    def __call__(self, input_ids, image_embeds, attention_mask=None):
         """[B, L] ids + [B, P, Dv] -> [B, L, D] question states."""
         cfg = self.config
-        b, s = input_ids.shape
-        eps = 1e-12
-        x = nn.Embed(
-            cfg.vocab_size, cfg.text_hidden, dtype=self.dtype,
-            name="word_embeddings",
-        )(input_ids)
-        pos = self.param(
-            "position_embeddings", nn.initializers.normal(0.02),
-            (cfg.max_positions, cfg.text_hidden),
-        ).astype(self.dtype)
-        x = x + pos[None, :s]
-        x = nn.LayerNorm(epsilon=eps, dtype=self.dtype, name="embed_ln")(x)
+        x = _embed_text(self, cfg, input_ids, self.dtype)
         img = image_embeds.astype(self.dtype)
+        self_mask = (
+            _additive_mask(attention_mask, self.dtype)
+            if attention_mask is not None
+            else None
+        )
         for i in range(cfg.text_layers):
-            y = _MHA(cfg.text_heads, cfg.text_hidden, dtype=self.dtype,
-                     name=f"self_{i}")(x, x)
-            x = nn.LayerNorm(epsilon=eps, dtype=self.dtype, name=f"self_ln_{i}")(
-                x + y
-            )
-            y = _MHA(cfg.text_heads, cfg.text_hidden, dtype=self.dtype,
-                     name=f"cross_{i}")(x, img)
-            x = nn.LayerNorm(epsilon=eps, dtype=self.dtype, name=f"cross_ln_{i}")(
-                x + y
-            )
-            y = nn.Dense(cfg.text_hidden * 4, dtype=self.dtype, name=f"fc1_{i}")(x)
-            y = nn.gelu(y, approximate=False)
-            y = nn.Dense(cfg.text_hidden, dtype=self.dtype, name=f"fc2_{i}")(y)
-            x = nn.LayerNorm(epsilon=eps, dtype=self.dtype, name=f"ffn_ln_{i}")(
-                x + y
-            )
+            x = _bert_layer(cfg, self.dtype, i, x, img, self_mask, None)
         return x

@@ -104,7 +104,17 @@ class CaptionPipeline:
         if self.vqa and model_dir is not None:
             # the VQA question-encoder conversion is not wired yet; loading
             # only the captioning components would answer with confident
-            # garbage — fall through to the weights gate
+            # garbage — fail with an accurate message, not the default
+            # "prefetch with --download" (the weights ARE on disk)
+            require_weights_present(
+                self.model_name, model_dir, allow_random_init,
+                component="BLIP VQA",
+                hint=(
+                    "This worker cannot serve real BLIP VQA weights yet "
+                    "(question-encoder conversion is not wired); only the "
+                    "test/tiny VQA stack is available."
+                ),
+            )
             model_dir = None
         if model_dir is not None:
             try:
@@ -230,20 +240,22 @@ class CaptionPipeline:
             params["text"], embeds,
             prefix_ids if prefix_ids is not None else jnp.zeros((1, 0), jnp.int32),
         )
-        ids = np.asarray(jax.block_until_ready(ids))[0]
-
-        # host-side EOS truncation (the scan is fixed-length for XLA)
-        body = ids[1:]  # strip [DEC]
-        eos = np.nonzero(body == cfg.eos_token_id)[0]
-        if eos.size:
-            body = body[: eos[0]]
-        text = self.tokenizer.decode(body)
+        text = self._decode_ids(np.asarray(jax.block_until_ready(ids))[0])
         config = {
             "model": self.model_name,
             "prompt_conditioned": bool(prefix_len),
             "timings": {"caption_s": round(time.perf_counter() - t0, 3)},
         }
         return text, config
+
+    def _decode_ids(self, ids: np.ndarray) -> str:
+        """[max_len] greedy ids -> text: strip [DEC], truncate at EOS on
+        the host (the scan is fixed-length for XLA)."""
+        body = ids[1:]
+        eos = np.nonzero(body == self.config.eos_token_id)[0]
+        if eos.size:
+            body = body[: eos[0]]
+        return self.tokenizer.decode(body)
 
     def _run_vqa(self, params, image_embeds, prompt, t0) -> tuple[str, dict]:
         """Question -> encoded-against-image states -> greedy answer."""
@@ -255,17 +267,16 @@ class CaptionPipeline:
         enc = self.tokenizer.encode(prompt)[: cfg.max_caption_len - 1]
         q_ids = np.full((1, cfg.max_caption_len), cfg.eos_token_id, np.int32)
         q_ids[0, : len(enc)] = enc
+        q_mask = np.zeros((1, cfg.max_caption_len), np.float32)
+        q_mask[0, : len(enc)] = 1.0
         program = self._vqa_program()
         ids = np.asarray(
             jax.block_until_ready(
-                program(params, jnp.asarray(q_ids), image_embeds)
+                program(params, jnp.asarray(q_ids), jnp.asarray(q_mask),
+                        image_embeds)
             )
         )[0]
-        body = ids[1:]  # strip [DEC]
-        eos = np.nonzero(body == cfg.eos_token_id)[0]
-        if eos.size:
-            body = body[: eos[0]]
-        text = self.tokenizer.decode(body)
+        text = self._decode_ids(ids)
         config = {
             "model": self.model_name,
             "vqa": True,
@@ -280,13 +291,19 @@ class CaptionPipeline:
         qenc = self.question_encoder
         decoder = self.decoder
 
-        def apply(text_params, ids, context):
-            return decoder.apply({"params": text_params}, ids, context)
-
-        def run(params, q_ids, image_embeds):
+        def run(params, q_ids, q_mask, image_embeds):
             question_states = qenc.apply(
-                {"params": params["qenc"]}, q_ids, image_embeds
+                {"params": params["qenc"]}, q_ids, image_embeds,
+                attention_mask=q_mask,
             )
+
+            def apply(text_params, ids, context):
+                # padded question positions are masked out of the answer
+                # decoder's cross-attention
+                return decoder.apply(
+                    {"params": text_params}, ids, context, context_mask=q_mask
+                )
+
             return greedy_decode(apply, params["text"], question_states, cfg)
 
         program = jax.jit(run)

@@ -231,6 +231,12 @@ class VideoPipeline:
         if params is None:
             raise Exception(f"pipeline {self.model_name} was evicted; resubmit")
         timings = {}
+        # requested AnimateDiff/LCM motion adapter (reference tx2vid.py:26-36
+        # loads it onto the torch UNet per job). The resident VideoUNet's
+        # temporal modules ARE the motion adapter slot; which checkpoint
+        # fills them is decided at weight-conversion time, so the request is
+        # recorded for observability rather than silently dropped.
+        motion_adapter = kwargs.pop("motion_adapter", None)
         lora = kwargs.pop("lora", None)
         xattn_kwargs = kwargs.pop("cross_attention_kwargs", {}) or {}
         lora_scale = float(
@@ -307,6 +313,11 @@ class VideoPipeline:
             "steps": steps,
             "size": [width, height],
             "scheduler": scheduler_type,
+            **(
+                {"motion_adapter": str(motion_adapter)}
+                if motion_adapter is not None
+                else {}
+            ),
             "timings": timings,
         }
         return pil_frames, config

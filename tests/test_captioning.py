@@ -256,7 +256,9 @@ def _question_ids(pipe, prompt):
     enc = pipe.tokenizer.encode(prompt)[: cfg.max_caption_len - 1]
     q = np.full((1, cfg.max_caption_len), cfg.eos_token_id, np.int32)
     q[0, : len(enc)] = enc
-    return jnp.asarray(q)
+    mask = np.zeros((1, cfg.max_caption_len), np.float32)
+    mask[0, : len(enc)] = 1.0
+    return jnp.asarray(q), jnp.asarray(mask)
 
 
 def _image_embeds(pipe, img):
@@ -284,14 +286,11 @@ def test_vqa_answers_question():
     # the question must condition the answer: compare raw greedy token ids
     # (a wiring bug that bypasses the question encoder would pass a
     # type-only check)
-    ids1 = pipe._vqa_program()(
-        pipe.params, _question_ids(pipe, "what color is the sky"),
-        _image_embeds(pipe, img),
-    )
-    ids2 = pipe._vqa_program()(
-        pipe.params, _question_ids(pipe, "how many dogs are there"),
-        _image_embeds(pipe, img),
-    )
+    q1, m1 = _question_ids(pipe, "what color is the sky")
+    q2, m2 = _question_ids(pipe, "how many dogs are there")
+    embeds = _image_embeds(pipe, img)
+    ids1 = pipe._vqa_program()(pipe.params, q1, m1, embeds)
+    ids2 = pipe._vqa_program()(pipe.params, q2, m2, embeds)
     assert not np.array_equal(np.asarray(ids1), np.asarray(ids2))
 
 
