@@ -1,0 +1,35 @@
+"""Registry exhaustiveness: every wire name the hive can send must reach
+a registered family factory. Round 1 shipped wire names mapped to
+families with NO factory (cascade, kandinsky3, sd_upscale) — jobs died
+with 'family not available'; this pins the invariant.
+"""
+
+from chiaswarm_tpu import registry
+
+
+def test_every_wire_name_has_a_factory():
+    registry._ensure_builtin_families()
+    missing = sorted(
+        {
+            family
+            for family in registry.PIPELINE_FAMILIES.values()
+            if family not in registry._FACTORIES
+        }
+    )
+    assert not missing, f"wire-mapped families without a factory: {missing}"
+
+
+def test_auto_names_resolve_for_every_family_exemplar():
+    registry._ensure_builtin_families()
+    exemplars = [
+        "stabilityai/stable-diffusion-2-1",
+        "stabilityai/stable-diffusion-xl-base-1.0",
+        "kandinsky-community/kandinsky-2-2-decoder",
+        "kandinsky-community/kandinsky-3",
+        "stabilityai/stable-cascade",
+        "stabilityai/stable-cascade-prior",
+        "black-forest-labs/FLUX.1-dev",
+    ]
+    for name in exemplars:
+        family = registry._auto_family(name)
+        assert family in registry._FACTORIES, (name, family)
