@@ -27,7 +27,7 @@ from .device import ChipSet
 
 class SliceAllocator:
     def __init__(self, devices: list | None = None, chips_per_job: int = 0,
-                 tensor_parallelism: int = 1):
+                 tensor_parallelism: int = 1, sequence_parallelism: int = 1):
         if devices is None:
             devices = jax.devices()
         if not devices:
@@ -41,7 +41,7 @@ class SliceAllocator:
 
         self.slices = [
             ChipSet(devices[i : i + n], slice_id=i // n,
-                    tensor=tensor_parallelism)
+                    tensor=tensor_parallelism, seq=sequence_parallelism)
             for i in range(0, len(devices), n)
         ]
         self._free: asyncio.Queue[ChipSet] = asyncio.Queue()

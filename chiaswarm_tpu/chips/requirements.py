@@ -117,20 +117,6 @@ def min_chips(model_name: str, hbm_gb_per_chip: float, size: int = 1024,
     return n
 
 
-def _per_chip_need_gb(chipset, model_name: str, batch: int, size: int,
-                      width: int | None) -> float:
-    """HBM needed on the BUSIEST chip: parameters are replicated except
-    over the tensor axis, activations shard over the data axis."""
-    fam = _family_key(model_name)
-    params = FAMILY_PARAMS_GB.get(fam, _DEFAULT_PARAMS_GB)
-    act = FAMILY_ACT_GB_PER_IMAGE.get(fam, _DEFAULT_ACT_GB)
-    tensor = max(getattr(chipset, "tensor", 1), 1)
-    seq = max(getattr(chipset, "seq", 1), 1)
-    data = max(chipset.chip_count() // (tensor * seq), 1)
-    local_batch = -(-batch // data)  # ceil: the busiest data shard
-    return params / tensor + local_batch * act * _area_scale(size, width)
-
-
 def fit_batch(chipset, model_name: str, batch: int, size: int,
               width: int | None = None) -> int:
     """Largest batch (<= requested) this slice fits; 0 = model doesn't fit.
@@ -150,12 +136,21 @@ def fit_batch(chipset, model_name: str, batch: int, size: int,
         # for them by three orders of magnitude
         return batch
     per_chip_hbm = chipset.hbm_bytes() / (1 << 30) / max(chipset.chip_count(), 1)
-    while batch > 0 and (
-        _per_chip_need_gb(chipset, model_name, batch, size, width)
-        > per_chip_hbm
-    ):
-        batch -= 1
-    return batch
+    # Closed form (the batch arrives unvalidated from the wire — a loop
+    # decrementing from 1e9 would stall the worker): the busiest data
+    # shard holds ceil(batch/data) images, so the largest admissible
+    # batch is floor(free / per_image) * data.
+    fam = _family_key(model_name)
+    params = FAMILY_PARAMS_GB.get(fam, _DEFAULT_PARAMS_GB)
+    act = FAMILY_ACT_GB_PER_IMAGE.get(fam, _DEFAULT_ACT_GB)
+    tensor = max(getattr(chipset, "tensor", 1), 1)
+    seq = max(getattr(chipset, "seq", 1), 1)
+    data = max(chipset.chip_count() // (tensor * seq), 1)
+    free = per_chip_hbm - params / tensor
+    per_image = act * _area_scale(size, width)
+    if free < per_image:
+        return 0
+    return min(batch, int(free / per_image) * data)
 
 
 def check_capacity(chipset, model_name: str, batch: int, size: int,

@@ -119,8 +119,9 @@ def format_txt2audio_args(args: dict):
     args["scheduler_type"] = parameters.pop("scheduler_type", DEFAULT_SCHEDULER)
     _drop_unsupported(args, parameters)
     # remaining special parameters (test_tiny_model, audio_length_in_s, ...)
-    # pass straight through to the pipeline, like the diffusion formatter
-    args.update(parameters)
+    # pass through WITHOUT overwriting already-formatted top-level args —
+    # a hive-controlled parameters dict must not rewrite model_name/prompt
+    _merge_passthrough(args, parameters)
     return txt2audio_callback, args
 
 
@@ -148,7 +149,7 @@ def format_txt2vid_args(args: dict):
         args["lora"] = parameters["lora"]
 
     _drop_unsupported(args, parameters)
-    args.update(parameters)
+    _merge_passthrough(args, parameters)
     return txt2vid_callback, args
 
 
@@ -167,7 +168,7 @@ async def format_img2vid_args(args: dict):
         args["image"] = await get_image(args.pop("start_image_uri"), None)
 
     _drop_unsupported(args, parameters)
-    args.update(parameters)
+    _merge_passthrough(args, parameters)
     return img2vid_callback, args
 
 
@@ -217,7 +218,8 @@ async def format_stable_diffusion_args(args: dict, workflow, device_identifier: 
 
     _drop_unsupported(args, parameters)
     # remaining special parameters pass straight through to the pipeline
-    args.update(parameters)
+    # (protected identity keys excepted — same rule as the other formatters)
+    _merge_passthrough(args, parameters)
 
     return diffusion_callback, args
 
@@ -384,3 +386,22 @@ async def format_controlnet_args(args, parameters, start_image, size, device_ide
 def _drop_unsupported(args: dict, parameters: dict) -> None:
     for arg in parameters.pop("unsupported_pipeline_arguments", []):
         args.pop(arg, None)
+
+
+# identity / payload keys a hive-controlled parameters dict may FILL but
+# never rewrite (pipeline_type/scheduler_type are popped explicitly by each
+# formatter before the merge, so they never reach it)
+_PROTECTED_ARGS = frozenset({
+    "model_name", "prompt", "negative_prompt", "image", "mask_image",
+    "control_image", "workflow", "id", "rng", "chipset",
+})
+
+
+def _merge_passthrough(args: dict, parameters: dict) -> None:
+    """Passthrough with reference precedence — parameters win (model-pinned
+    steps/scheduler knobs must override formatter defaults) — EXCEPT the
+    protected identity keys, which parameters may fill but never rewrite."""
+    for k, v in parameters.items():
+        if k in _PROTECTED_ARGS and k in args:
+            continue
+        args[k] = v

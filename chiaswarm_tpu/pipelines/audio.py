@@ -69,10 +69,12 @@ def _audio_configs(model_name: str):
 
 
 def _clap_tokenizer(model_dir, vocab_size: int, max_length: int = 77):
-    """Real RoBERTa BPE tokenizer when the checkpoint ships one; converted
-    CLAP weights paired with the hash fallback would hash prompts into
-    arbitrary vocab ids (unconditioned audio), so the real path loads the
-    tokenizer files from the model dir (offline, via transformers)."""
+    """-> (tokenize_fn, is_real). Real RoBERTa BPE tokenizer when the
+    checkpoint ships one; converted CLAP weights paired with the hash
+    fallback would hash prompts into arbitrary vocab ids (unconditioned
+    audio), so the real path loads the tokenizer files from the model dir
+    (offline, via transformers) and the caller FAILS the build when
+    converted text weights meet the hash fallback."""
     tok_dir = None
     if model_dir is not None:
         for sub in ("tokenizer", "text_encoder"):
@@ -94,10 +96,10 @@ def _clap_tokenizer(model_dir, vocab_size: int, max_length: int = 77):
                     max_length=max_length, return_tensors="np",
                 )["input_ids"].astype(np.int32)
 
-            return call
+            return call, True
         except Exception as e:  # corrupt tokenizer dir: fall through
             logger.warning("CLAP tokenizer load failed (%s); hash fallback", e)
-    return load_tokenizer(None, vocab_size=vocab_size)
+    return load_tokenizer(None, vocab_size=vocab_size), False
 
 
 class AudioPipeline:
@@ -126,7 +128,7 @@ class AudioPipeline:
         self.vae = AutoencoderKL(vae_cfg, dtype=self.dtype)
         self.vocoder = HifiGanGenerator(vocoder_cfg, dtype=self.dtype)
         self.vocoder_hop = int(np.prod(vocoder_cfg.upsample_rates))
-        self.tokenizer = _clap_tokenizer(
+        self.tokenizer, self._real_tokenizer = _clap_tokenizer(
             self._model_dir(), clap_cfg.vocab_size
         )
 
@@ -153,16 +155,42 @@ class AudioPipeline:
             # converted real weights override the random init per component
             # (text_encoder = ClapTextModelWithProjection, vocoder =
             # SpeechT5HifiGan in the HF audioldm layout)
+            converted_comps = set()
             for comp, sub, conv in self._conversion_sources():
                 try:
-                    from ..models.conversion import load_torch_state_dict
+                    from ..models.conversion import (
+                        assert_tree_shapes_match,
+                        load_torch_state_dict,
+                    )
 
-                    init_params[comp] = conv(
+                    converted = conv(
                         load_torch_state_dict(self._model_dir(), sub)
                     )
+                    # geometry mismatch surfaces HERE as a conversion report,
+                    # not later as an opaque flax apply error
+                    assert_tree_shapes_match(
+                        converted, init_params[comp], prefix=comp
+                    )
+                    init_params[comp] = converted
+                    converted_comps.add(comp)
                     logger.info("loaded converted %s for %s", comp, model_name)
                 except (FileNotFoundError, OSError):
                     pass
+            if "text" in converted_comps and not self._real_tokenizer:
+                # hashed prompt ids through a real CLAP tower produce
+                # effectively unconditioned audio — refuse to build real
+                # models (tiny test bundles only warn: their parity tests
+                # drive the encoder with explicit ids)
+                from ..weights import is_test_model
+
+                msg = (
+                    f"{model_name}: converted CLAP text weights are present "
+                    "but no tokenizer files were found in the model dir; "
+                    "re-run initialize --download to fetch the tokenizer"
+                )
+                if not is_test_model(model_name):
+                    raise ValueError(msg)
+                logger.warning(msg)
             self.params = jax.tree_util.tree_map(
                 lambda x: jnp.asarray(x, self.dtype), init_params
             )

@@ -107,6 +107,31 @@ def load_learned_embeddings(ref) -> list[dict]:
 
 
 
+def _config_prediction_type(model_name: str) -> str | None:
+    """`prediction_type` from the downloaded scheduler config JSON —
+    authoritative over any name heuristic (a v-prediction fine-tune named
+    without '768' would otherwise silently get epsilon and produce garbage
+    with real weights). None when the checkpoint isn't local."""
+    import json
+    from pathlib import Path
+
+    from ..settings import load_settings
+
+    try:
+        root = Path(load_settings().model_root_dir).expanduser() / model_name
+    except Exception:
+        return None
+    p = root / "scheduler" / "scheduler_config.json"
+    if p.is_file():
+        try:
+            pred = json.loads(p.read_text()).get("prediction_type")
+            if pred:
+                return str(pred)
+        except (OSError, ValueError):
+            pass
+    return None
+
+
 def _family_configs(model_name: str):
     """(unet_cfg, [clip_cfgs], vae_cfg, default_size, prediction_type)."""
     import dataclasses
@@ -141,6 +166,9 @@ def _family_configs(model_name: str):
         else:
             out = (cfgs.SD15_UNET, [cfgs.SD15_CLIP], cfgs.SD_VAE, 512, "epsilon")
     unet_cfg, clip_cfgs, vae_cfg, size, pred = out
+    cfg_pred = _config_prediction_type(model_name)
+    if cfg_pred is not None:
+        pred = cfg_pred
     if "pix2pix" in name or "ip2p" in name:
         # edit-tuned checkpoints (timbrooks/instruct-pix2pix and the SDXL
         # variant, reference swarm/job_arguments.py:299-305) take the start-
@@ -251,6 +279,11 @@ class SDPipeline:
                 {"params": vae_params}, px, method=self.vae.encode
             ).astype(jnp.float32)
         )
+        # weights-free 2x: encode -> bilinear latent resize -> decode.
+        # Kept as the explicit `upscale` fallback when the learned sd-x2
+        # upscaler has no converted weights (otherwise every production
+        # upscale job would die on MissingWeightsError)
+        self._latent2x_program = jax.jit(self._latent2x_impl)
         # resident ControlNet branches keyed by controlnet model name
         self._controlnets: dict[str, tuple] = {}
         # param trees with LoRAs merged, keyed by (lora ref, scale); LRU-
@@ -616,6 +649,28 @@ class SDPipeline:
 
     # --- text conditioning (host + tiny device work, once per job) ---
 
+    def _latent2x_impl(self, vae_params, px):
+        """Encode -> bilinear 2x latent resize -> decode, one program.
+
+        The round-1 `upscale: true` behavior, retained as the explicit
+        fallback when stabilityai/sd-x2-latent-upscaler has no converted
+        weights on this worker (reference chains the learned upscaler at
+        swarm/diffusion/diffusion_func.py:163)."""
+        z = self.vae.apply(
+            {"params": vae_params}, px.astype(self.dtype),
+            method=self.vae.encode,
+        )
+        b, h, w, c = z.shape
+        z2 = jax.image.resize(
+            z.astype(jnp.float32), (b, 2 * h, 2 * w, c), "bilinear"
+        ).astype(self.dtype)
+        out = self.vae.apply(
+            {"params": vae_params}, z2, method=self.vae.decode
+        )
+        return (
+            (out.astype(jnp.float32) + 1.0) * 127.5
+        ).clip(0.0, 255.0).round().astype(jnp.uint8)
+
     def _encode_impl(self, text_params, ids_list, extras_list):
         """All text encoders fused into one jitted program."""
         hiddens, pooled = [], None
@@ -867,17 +922,29 @@ class SDPipeline:
         refiner = kwargs.pop("refiner", None)
         upscale = bool(kwargs.pop("upscale", False))
         upscaler = None
+        upscale_fallback = False
         if upscale:
             # resolve (and weight-check) the upscaler BEFORE spending the
             # denoise: a missing-weights failure must not cost a full job
             from ..registry import get_pipeline
+            from ..weights import MissingWeightsError
             from .upscale import upscaler_name_for
 
-            upscaler = get_pipeline(
-                upscaler_name_for(self.model_name),
-                pipeline_type="StableDiffusionLatentUpscalePipeline",
-                chipset=self.chipset,
-            )
+            try:
+                upscaler = get_pipeline(
+                    upscaler_name_for(self.model_name),
+                    pipeline_type="StableDiffusionLatentUpscalePipeline",
+                    chipset=self.chipset,
+                )
+            except MissingWeightsError:
+                # no converted sd-x2 weights on this worker: serve the job
+                # anyway with the latent-resize path and record the
+                # degradation in pipeline_config instead of failing
+                logger.warning(
+                    "sd-x2 upscaler weights missing; falling back to "
+                    "latent-resize 2x for this job"
+                )
+                upscale_fallback = True
 
         lora = kwargs.pop("lora", None)
         # reference wire: scale rides in cross_attention_kwargs.scale
@@ -1162,6 +1229,19 @@ class SDPipeline:
                 rng=jax.random.fold_in(rng, 0x5d2),
             )
             timings["upscale_s"] = round(time.perf_counter() - t0, 3)
+        elif upscale_fallback:
+            # per-image calls: the 2x decode has 4x the activation footprint,
+            # and a fallback path must not be the thing that OOMs the job
+            t0 = time.perf_counter()
+            out = []
+            for im in images:
+                px = jnp.asarray(_pil_to_array(im, width, height))[None]
+                up = np.asarray(
+                    self._latent2x_program(job_params["vae"], px)
+                )
+                out.append(Image.fromarray(up[0]))
+            images = out
+            timings["upscale_s"] = round(time.perf_counter() - t0, 3)
 
         from ..models.flops import denoise_flops
 
@@ -1190,7 +1270,12 @@ class SDPipeline:
             # learned upscaler stage doubles the actual output
             **(
                 {"output_size": [2 * width, 2 * height], "upscaled": True}
-                if upscaler is not None
+                if upscaler is not None or upscale_fallback
+                else {}
+            ),
+            **(
+                {"upscaler": "latent-resize-fallback"}
+                if upscale_fallback
                 else {}
             ),
             # analytic UNet FLOPs of the denoise loop -> MFU in the bench

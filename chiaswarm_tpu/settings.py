@@ -29,6 +29,10 @@ class Settings:
     # attention/MLP kernels over the mesh's `tensor` axis); must divide the
     # slice's chip count
     tensor_parallelism: int = 1
+    # sequence-parallel degree within each slice (ring attention over the
+    # mesh's `seq` axis for long self-attention); tensor * seq must divide
+    # the slice's chip count
+    sequence_parallelism: int = 1
     # persistent XLA compilation cache (the TPU analog of the HF model cache)
     compilation_cache_dir: str = "~/.sdaas/xla_cache"
     # model weight root (converted Flax checkpoints / HF safetensors)
@@ -56,6 +60,7 @@ _ENV_OVERRIDES = {
     "SDAAS_WORKERNAME": "worker_name",
     "SDAAS_CHIPS_PER_JOB": "chips_per_job",
     "SDAAS_TENSOR_PARALLELISM": "tensor_parallelism",
+    "SDAAS_SEQUENCE_PARALLELISM": "sequence_parallelism",
     "SDAAS_DTYPE": "dtype",
 }
 

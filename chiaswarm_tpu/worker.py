@@ -58,6 +58,7 @@ class Worker:
         self.allocator = allocator or SliceAllocator(
             chips_per_job=self.settings.chips_per_job,
             tensor_parallelism=self.settings.tensor_parallelism,
+            sequence_parallelism=self.settings.sequence_parallelism,
         )
         self.hive = HiveClient(self.settings, self.hive_uri)
         self.work_queue: asyncio.Queue = asyncio.Queue(maxsize=len(self.allocator))

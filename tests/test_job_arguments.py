@@ -210,3 +210,60 @@ def test_inpaint_threads_size_and_mask(monkeypatch):
     assert kwargs["pipeline_type"] == "StableDiffusionInpaintPipeline"
     assert "height" not in kwargs and "width" not in kwargs
     assert kwargs["mask_image"] is not None
+
+
+def test_parameters_cannot_overwrite_formatted_args():
+    # ADVICE r2: the hive-controlled parameters dict is fill-only — it must
+    # not rewrite already-formatted top-level args like model_name/prompt
+    from chiaswarm_tpu.job_arguments import format_txt2audio_args
+
+    _, args = format_txt2audio_args(
+        {
+            "model_name": "test/tiny-audio",
+            "prompt": "ping",
+            "parameters": {
+                "model_name": "evil/model",
+                "prompt": "evil",
+                "audio_length_in_s": 3.0,
+            },
+        }
+    )
+    assert args["model_name"] == "test/tiny-audio"
+    assert args["prompt"] == "ping"
+    assert args["audio_length_in_s"] == 3.0
+
+
+def test_model_pinned_parameters_override_defaults():
+    # reference precedence: a model-pinned num_inference_steps in the
+    # parameters dict trumps the formatter's generic default (an LCM model
+    # pinned to 8 steps must not silently run 25)
+    from chiaswarm_tpu.job_arguments import format_txt2vid_args
+
+    _, args = format_txt2vid_args(
+        {
+            "model_name": "test/tiny-video",
+            "prompt": "x",
+            "parameters": {"num_inference_steps": 8},
+        }
+    )
+    assert args["num_inference_steps"] == 8
+
+
+def test_diffusion_parameters_cannot_overwrite_identity():
+    # same protection on the highest-traffic formatter
+    _, args = fmt(
+        {
+            "id": "j",
+            "workflow": "txt2img",
+            "model_name": "test/tiny-sd",
+            "prompt": "good",
+            "parameters": {
+                "model_name": "evil/model",
+                "prompt": "evil",
+                "num_inference_steps": 7,
+            },
+        }
+    )
+    assert args["model_name"] == "test/tiny-sd"
+    assert args["prompt"] == "good"
+    assert args["num_inference_steps"] == 7  # tuning keys keep ref precedence

@@ -173,3 +173,46 @@ def test_program_cache_reused(tiny_sd):
     tiny_sd.run(prompt="warm", height=128, width=64, num_inference_steps=2,
                 rng=jax.random.key(0))
     assert len(tiny_sd._programs) == 2
+
+
+def test_prediction_type_from_scheduler_config(sdaas_root, tmp_path):
+    # a renamed v-prediction checkpoint must still get v_prediction when the
+    # downloaded scheduler config says so (name heuristic alone says epsilon)
+    import json
+
+    from chiaswarm_tpu.pipelines.stable_diffusion import _family_configs
+    from chiaswarm_tpu.settings import Settings, save_settings
+
+    model_root = tmp_path / "models"
+    name = "acme/stable-diffusion-2-renamed"
+    sched = model_root / name / "scheduler"
+    sched.mkdir(parents=True)
+    (sched / "scheduler_config.json").write_text(
+        json.dumps({"prediction_type": "v_prediction"})
+    )
+    save_settings(Settings(model_root_dir=str(model_root)))
+    assert _family_configs(name)[4] == "v_prediction"
+    # and the heuristic still stands when no local config exists
+    assert _family_configs("acme/stable-diffusion-2-other")[4] == "epsilon"
+
+
+def test_upscale_falls_back_when_upscaler_weights_missing(
+    monkeypatch, sdaas_root
+):
+    # ADVICE r2: upscale jobs must not die on MissingWeightsError when the
+    # learned sd-x2 upscaler isn't converted — latent-resize 2x serves them
+    from chiaswarm_tpu.pipelines import upscale as upscale_mod
+
+    monkeypatch.setattr(
+        upscale_mod, "upscaler_name_for",
+        lambda name: "stabilityai/sd-x2-latent-upscaler",
+    )
+    pipe = SDPipeline("test/tiny-sd")
+    images, config = pipe.run(
+        prompt="x", height=64, width=64, num_inference_steps=2,
+        upscale=True, rng=jax.random.key(0),
+    )
+    assert images[0].size == (128, 128)
+    assert config["upscaled"] is True
+    assert config["upscaler"] == "latent-resize-fallback"
+    assert config["output_size"] == [128, 128]

