@@ -231,10 +231,49 @@ def _verify_dpt_model(model_name: str, root: Path) -> dict:
     return {"dpt": _param_count(converted)}
 
 
+def _emit_blip_special_tokens(model_dir: Path) -> None:
+    """Derive the special-token table from the checkpoint's vocab.txt and
+    write it next to the weights (special_tokens.json) — the serving
+    pipeline reads it instead of trusting config constants (the [DEC]/[ENC]
+    ids live at the END of BLIP's extended BERT vocab, so they depend on
+    the shipped vocab, not the architecture)."""
+    import json
+
+    vocab_path = None
+    for rel in ("vocab.txt", "tokenizer/vocab.txt"):
+        if (model_dir / rel).is_file():
+            vocab_path = model_dir / rel
+            break
+    if vocab_path is None:
+        return
+    ids: dict[str, int] = {}
+    with open(vocab_path, encoding="utf-8") as f:
+        for i, line in enumerate(f):
+            tok = line.rstrip("\r\n")
+            if tok in ("[PAD]", "[CLS]", "[SEP]", "[DEC]", "[ENC]"):
+                ids[tok] = i
+    table = {}
+    if "[DEC]" in ids:
+        table["bos_token_id"] = ids["[DEC]"]  # decoder_start_token_id
+    if "[SEP]" in ids:
+        table["eos_token_id"] = ids["[SEP]"]
+        table["sep_token_id"] = ids["[SEP]"]
+    if "[PAD]" in ids:
+        table["pad_token_id"] = ids["[PAD]"]
+    if "[CLS]" in ids:
+        table["cls_token_id"] = ids["[CLS]"]
+    if "[ENC]" in ids:
+        table["enc_token_id"] = ids["[ENC]"]
+    if table:
+        (model_dir / "special_tokens.json").write_text(
+            json.dumps(table, indent=2)
+        )
+
+
 def _verify_blip_model(model_name: str, root: Path) -> dict:
     import jax.numpy as jnp
 
-    from .models.blip import TINY_BLIP, BlipConfig, TextDecoder, VisionEncoder
+    from .models.blip import TINY_BLIP, TextDecoder, TextEncoder, VisionEncoder
     from .models.conversion import (
         assert_tree_shapes_match,
         convert_blip,
@@ -242,24 +281,46 @@ def _verify_blip_model(model_name: str, root: Path) -> dict:
     )
     from .weights import is_test_model
 
+    from .pipelines.captioning import _blip_configs
+
     model_dir = root / model_name
-    cfg = TINY_BLIP if is_test_model(model_name) else BlipConfig()
+    # the SAME config dispatch the serving path uses ('large' = ViT-L vision
+    # tower) — a --check green must mean the worker will actually serve it
+    cfg = TINY_BLIP if is_test_model(model_name) else _blip_configs(model_name)
+    vqa = "vqa" in model_name.lower()
     converted = convert_blip(load_torch_state_dict(model_dir))
     n_patches = (cfg.image_size // cfg.patch_size) ** 2
     vision_exp = _eval_shape_params(
         VisionEncoder(cfg), jnp.zeros((1, cfg.image_size, cfg.image_size, 3))
     )
     assert_tree_shapes_match(converted["vision"], vision_exp, prefix="vision")
+    ctx_dim = cfg.text_hidden if vqa else cfg.vision_hidden
+    ctx_len = cfg.max_caption_len if vqa else n_patches + 1
     text_exp = _eval_shape_params(
         TextDecoder(cfg),
         jnp.zeros((1, cfg.max_caption_len), jnp.int32),
-        jnp.zeros((1, n_patches + 1, cfg.vision_hidden)),
+        jnp.zeros((1, ctx_len, ctx_dim)),
     )
     assert_tree_shapes_match(converted["text"], text_exp, prefix="text")
-    return {
+    out = {
         "vision": _param_count(converted["vision"]),
         "text": _param_count(converted["text"]),
     }
+    if vqa:
+        if not converted.get("qenc"):
+            raise ValueError(
+                f"{model_name}: VQA checkpoint has no text_encoder "
+                "(question encoder) weights"
+            )
+        qenc_exp = _eval_shape_params(
+            TextEncoder(cfg),
+            jnp.zeros((1, cfg.max_caption_len), jnp.int32),
+            jnp.zeros((1, n_patches + 1, cfg.vision_hidden)),
+        )
+        assert_tree_shapes_match(converted["qenc"], qenc_exp, prefix="qenc")
+        out["qenc"] = _param_count(converted["qenc"])
+    _emit_blip_special_tokens(model_dir)
+    return out
 
 
 def _verify_sd_model(model_name: str, root: Path) -> dict:

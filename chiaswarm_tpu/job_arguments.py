@@ -400,8 +400,10 @@ _PROTECTED_ARGS = frozenset({
 def _merge_passthrough(args: dict, parameters: dict) -> None:
     """Passthrough with reference precedence — parameters win (model-pinned
     steps/scheduler knobs must override formatter defaults) — EXCEPT the
-    protected identity keys, which parameters may fill but never rewrite."""
+    protected identity keys, which parameters may fill but never rewrite.
+    A formatter's neutral default (None/"", e.g. setdefault('prompt',''))
+    counts as fillable, not as a value to protect."""
     for k, v in parameters.items():
-        if k in _PROTECTED_ARGS and k in args:
+        if k in _PROTECTED_ARGS and args.get(k) not in (None, ""):
             continue
         args[k] = v

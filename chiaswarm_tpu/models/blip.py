@@ -219,9 +219,14 @@ class TextEncoder(nn.Module):
     (shared `_bert_layer`, identical param names) minus the causal mask
     and the LM head; returns hidden states for the answer decoder to
     cross-attend. `attention_mask` [B, L] excludes padded question
-    positions from self-attention. HF additionally swaps token 0 for its
-    [ENC] id — handled at weight-conversion time alongside the tokenizer's
-    special-token table."""
+    positions from self-attention.
+
+    Note on [ENC]: the original Salesforce BLIP swaps the question's
+    leading [CLS] for a dedicated [ENC] token (id 30523); HF transformers'
+    BlipForQuestionAnswering.generate — the stack the reference serves
+    with — passes the tokenizer output ([CLS] q [SEP]) through UNCHANGED
+    (verified against transformers 4.57). This encoder follows HF, and the
+    torch-parity test in tests/test_captioning.py pins that choice."""
 
     config: BlipConfig
     dtype: jnp.dtype = jnp.float32

@@ -486,14 +486,17 @@ def convert_safety_checker(state: dict) -> dict:
 
 
 def convert_blip(state: dict) -> dict:
-    """HF BlipForConditionalGeneration state dict -> {"vision","text"} trees
-    matching models/blip.py. Two non-mechanical steps: the vision tower's
+    """HF BlipForConditionalGeneration / BlipForQuestionAnswering state dict
+    -> {"vision","text","qenc"} trees matching models/blip.py ("qenc" is the
+    VQA question encoder, `text_encoder.*` in the HF layout; empty for
+    caption-only checkpoints). Two non-mechanical steps: the vision tower's
     fused qkv projection splits into our separate q/k/v Denses, and BERT's
     dotted layer names flatten onto the decoder's per-layer module names.
     Reference behavior replaced: swarm/captioning/caption_image.py:12-17
     (transformers classes resolved by name per job)."""
     vision: dict = {}
     text: dict = {}
+    qenc: dict = {}
 
     def put(tree: dict, path: str, leaf: str, value):
         node = tree
@@ -512,6 +515,43 @@ def convert_blip(state: dict) -> dict:
         put(tree, path, "scale" if leaf == "weight" else "bias", v)
 
     import re
+
+    def bert_text(tree: dict, n: str, v) -> None:
+        """One BlipTextModel-relative key (embeddings.* / encoder.layer.*)
+        into a models/blip.py text tree — shared by the answer decoder
+        (under text_decoder.bert.) and the question encoder (text_encoder.,
+        no bert. prefix, no cls head)."""
+        if n == "embeddings.word_embeddings.weight":
+            put(tree, "word_embeddings", "embedding", v)
+        elif n == "embeddings.position_embeddings.weight":
+            tree["position_embeddings"] = v
+        elif n.startswith("embeddings.LayerNorm."):
+            norm(tree, "embed_ln", n.rsplit(".", 1)[1], v)
+        else:
+            m = re.match(r"encoder\.layer\.(\d+)\.(.+)\.(weight|bias)$", n)
+            if not m:
+                return
+            i, sub, leaf = m.group(1), m.group(2), m.group(3)
+            table = {
+                "attention.self.query": ("dense", f"self_{i}/q"),
+                "attention.self.key": ("dense", f"self_{i}/k"),
+                "attention.self.value": ("dense", f"self_{i}/v"),
+                "attention.output.dense": ("dense", f"self_{i}/out"),
+                "attention.output.LayerNorm": ("norm", f"self_ln_{i}"),
+                "crossattention.self.query": ("dense", f"cross_{i}/q"),
+                "crossattention.self.key": ("dense", f"cross_{i}/k"),
+                "crossattention.self.value": ("dense", f"cross_{i}/v"),
+                "crossattention.output.dense": ("dense", f"cross_{i}/out"),
+                "crossattention.output.LayerNorm": ("norm", f"cross_ln_{i}"),
+                "intermediate.dense": ("dense", f"fc1_{i}"),
+                "output.dense": ("dense", f"fc2_{i}"),
+                "output.LayerNorm": ("norm", f"ffn_ln_{i}"),
+            }
+            entry = table.get(sub)
+            if entry is None:
+                return
+            kind, path = entry
+            (dense if kind == "dense" else norm)(tree, path, leaf, v)
 
     for name, v in state.items():
         v = np.asarray(v)
@@ -550,13 +590,7 @@ def convert_blip(state: dict) -> dict:
                     dense(vision, f"fc2_{i}", leaf, v)
         elif name.startswith("text_decoder."):
             n = name[len("text_decoder."):]
-            if n == "bert.embeddings.word_embeddings.weight":
-                put(text, "word_embeddings", "embedding", v)
-            elif n == "bert.embeddings.position_embeddings.weight":
-                text["position_embeddings"] = v
-            elif n.startswith("bert.embeddings.LayerNorm."):
-                norm(text, "embed_ln", n.rsplit(".", 1)[1], v)
-            elif n.startswith("cls.predictions.transform.dense."):
+            if n.startswith("cls.predictions.transform.dense."):
                 dense(text, "head_dense", n.rsplit(".", 1)[1], v)
             elif n.startswith("cls.predictions.transform.LayerNorm."):
                 norm(text, "head_ln", n.rsplit(".", 1)[1], v)
@@ -565,32 +599,12 @@ def convert_blip(state: dict) -> dict:
             elif n == "cls.predictions.bias":
                 # tied duplicate of decoder.bias in HF checkpoints
                 text.setdefault("lm_head", {}).setdefault("bias", v)
-            else:
-                m = re.match(r"bert\.encoder\.layer\.(\d+)\.(.+)\.(weight|bias)$", n)
-                if not m:
-                    continue
-                i, sub, leaf = m.group(1), m.group(2), m.group(3)
-                table = {
-                    "attention.self.query": ("dense", f"self_{i}/q"),
-                    "attention.self.key": ("dense", f"self_{i}/k"),
-                    "attention.self.value": ("dense", f"self_{i}/v"),
-                    "attention.output.dense": ("dense", f"self_{i}/out"),
-                    "attention.output.LayerNorm": ("norm", f"self_ln_{i}"),
-                    "crossattention.self.query": ("dense", f"cross_{i}/q"),
-                    "crossattention.self.key": ("dense", f"cross_{i}/k"),
-                    "crossattention.self.value": ("dense", f"cross_{i}/v"),
-                    "crossattention.output.dense": ("dense", f"cross_{i}/out"),
-                    "crossattention.output.LayerNorm": ("norm", f"cross_ln_{i}"),
-                    "intermediate.dense": ("dense", f"fc1_{i}"),
-                    "output.dense": ("dense", f"fc2_{i}"),
-                    "output.LayerNorm": ("norm", f"ffn_ln_{i}"),
-                }
-                entry = table.get(sub)
-                if entry is None:
-                    continue
-                kind, path = entry
-                (dense if kind == "dense" else norm)(text, path, leaf, v)
-    return {"vision": vision, "text": text}
+            elif n.startswith("bert."):
+                bert_text(text, n[len("bert."):], v)
+        elif name.startswith("text_encoder."):
+            # VQA question encoder: BlipTextModel without pooler or cls head
+            bert_text(qenc, name[len("text_encoder."):], v)
+    return {"vision": vision, "text": text, "qenc": qenc}
 
 
 def assert_tree_shapes_match(converted: dict, initialized: dict, prefix=""):

@@ -38,6 +38,23 @@ _IMAGE_MEAN = np.asarray([0.48145466, 0.4578275, 0.40821073], np.float32)
 _IMAGE_STD = np.asarray([0.26862954, 0.26130258, 0.27577711], np.float32)
 
 
+def _load_special_tokens(model_dir: Path | None) -> dict:
+    """Special-token table emitted at conversion (initialize.py) — the
+    authoritative ids for a converted checkpoint; {} when absent."""
+    import json
+
+    if model_dir is None:
+        return {}
+    p = Path(model_dir) / "special_tokens.json"
+    if not p.is_file():
+        return {}
+    try:
+        return {k: int(v) for k, v in json.loads(p.read_text()).items()}
+    except (OSError, ValueError):
+        logger.warning("unreadable special_tokens.json under %s", model_dir)
+        return {}
+
+
 def _blip_configs(model_name: str) -> BlipConfig:
     name = model_name.lower()
     if is_test_model(model_name):
@@ -78,11 +95,30 @@ class CaptionPipeline:
         root = Path(load_settings().model_root_dir).expanduser()
         model_dir = root / model_name
         t0 = time.perf_counter()
+        # converted checkpoints carry their special-token ids (emitted by
+        # initialize.py from vocab.txt); config constants are the fallback
+        import dataclasses
+
+        toks = _load_special_tokens(model_dir if model_dir.is_dir() else None)
+        overrides = {
+            k: toks[k]
+            for k in ("bos_token_id", "eos_token_id", "pad_token_id")
+            if k in toks
+        }
+        if overrides:
+            self.config = dataclasses.replace(self.config, **overrides)
+        self.cls_token_id = toks.get("cls_token_id")
+        self.sep_token_id = toks.get("sep_token_id")
         self.params = self._load_params(model_dir if model_dir.is_dir() else None,
                                         allow_random_init)
         self.tokenizer = load_bert_tokenizer(
             model_dir if model_dir.is_dir() else None, self.config.vocab_size
         )
+        if self.cls_token_id is None:
+            vocab = getattr(self.tokenizer, "vocab", None)
+            if vocab:
+                self.cls_token_id = vocab.get("[CLS]")
+                self.sep_token_id = vocab.get("[SEP]")
         if self._real_weights and isinstance(self.tokenizer, HashBertTokenizer):
             # real weights decoded through the hash stand-in would emit
             # garbage token strings as a "successful" job — fail loudly
@@ -101,27 +137,22 @@ class CaptionPipeline:
 
     def _load_params(self, model_dir: Path | None, allow_random_init: bool):
         self._real_weights = False
-        if self.vqa and model_dir is not None:
-            # the VQA question-encoder conversion is not wired yet; loading
-            # only the captioning components would answer with confident
-            # garbage — fail with an accurate message, not the default
-            # "prefetch with --download" (the weights ARE on disk)
-            require_weights_present(
-                self.model_name, model_dir, allow_random_init,
-                component="BLIP VQA",
-                hint=(
-                    "This worker cannot serve real BLIP VQA weights yet "
-                    "(question-encoder conversion is not wired); only the "
-                    "test/tiny VQA stack is available."
-                ),
-            )
-            model_dir = None
         if model_dir is not None:
             try:
                 from ..models.conversion import convert_blip, load_torch_state_dict
 
                 state = load_torch_state_dict(model_dir)
                 params = convert_blip(state)
+                if self.vqa and not params.get("qenc"):
+                    # a VQA checkpoint without text_encoder weights would
+                    # answer with a random-init question encoder — refuse
+                    raise MissingWeightsError(
+                        f"checkpoint under {model_dir} has no text_encoder "
+                        f"(question encoder) weights; '{self.model_name}' "
+                        "cannot serve VQA from it. Re-download the model."
+                    )
+                if not self.vqa:
+                    params.pop("qenc", None)
                 if params["vision"] and params["text"]:
                     self._check_converted_shapes(params, model_dir)
                     self._real_weights = True
@@ -175,12 +206,23 @@ class CaptionPipeline:
                 jnp.zeros((1, cfg.image_size, cfg.image_size, 3)),
             )["params"]
             assert_tree_shapes_match(params["vision"], vision_exp, prefix="vision")
+            # VQA: the answer decoder cross-attends question states
+            # [*, L, text_hidden]; captioning cross-attends image embeds
+            ctx_dim = cfg.text_hidden if self.vqa else cfg.vision_hidden
+            ctx_len = cfg.max_caption_len if self.vqa else n_patches + 1
             text_exp = jax.eval_shape(
                 self.decoder.init, jax.random.key(0),
                 jnp.zeros((1, cfg.max_caption_len), jnp.int32),
-                jnp.zeros((1, n_patches + 1, cfg.vision_hidden)),
+                jnp.zeros((1, ctx_len, ctx_dim)),
             )["params"]
             assert_tree_shapes_match(params["text"], text_exp, prefix="text")
+            if self.vqa:
+                qenc_exp = jax.eval_shape(
+                    self.question_encoder.init, jax.random.key(0),
+                    jnp.zeros((1, cfg.max_caption_len), jnp.int32),
+                    jnp.zeros((1, n_patches + 1, cfg.vision_hidden)),
+                )["params"]
+                assert_tree_shapes_match(params["qenc"], qenc_exp, prefix="qenc")
         except ValueError as e:
             raise MissingWeightsError(
                 f"checkpoint under {model_dir} does not match the supported "
@@ -264,8 +306,19 @@ class CaptionPipeline:
                 "BLIP VQA requires a question; send it as the job prompt."
             )
         cfg = self.config
-        enc = self.tokenizer.encode(prompt)[: cfg.max_caption_len - 1]
-        q_ids = np.full((1, cfg.max_caption_len), cfg.eos_token_id, np.int32)
+        enc = self.tokenizer.encode(prompt)
+        if self.cls_token_id is not None and self.sep_token_id is not None:
+            # HF BlipProcessor parity: the question reaches the encoder as
+            # [CLS] q [SEP] (HF's generate passes it through unchanged —
+            # no [ENC] substitution; see models/blip.py TextEncoder note)
+            enc = (
+                [self.cls_token_id]
+                + enc[: cfg.max_caption_len - 2]
+                + [self.sep_token_id]
+            )
+        else:
+            enc = enc[: cfg.max_caption_len - 1]
+        q_ids = np.full((1, cfg.max_caption_len), cfg.pad_token_id, np.int32)
         q_ids[0, : len(enc)] = enc
         q_mask = np.zeros((1, cfg.max_caption_len), np.float32)
         q_mask[0, : len(enc)] = 1.0

@@ -317,3 +317,149 @@ def test_initialize_check_skips_unservable_families():
 
     assert verify_local_model("cvssp/audioldm-s-full-v2") is None
     assert verify_local_model("guoyww/animatediff-motion-adapter-v1-5-2") is None
+
+
+class TestVQATorchParity:
+    """Question encoder + answer decode vs transformers'
+    BlipForQuestionAnswering on identical random weights — the conversion
+    contract for real VQA checkpoints (VERDICT missing #5). Also pins the
+    [ENC] decision: HF's generate feeds the tokenizer output through
+    unchanged (no [CLS]->[ENC] substitution), so ours must too."""
+
+    @pytest.fixture(scope="class")
+    def pair(self):
+        torch = pytest.importorskip("torch")
+        from transformers import BlipConfig as HFBlipConfig
+        from transformers import (
+            BlipForQuestionAnswering,
+            BlipTextConfig,
+            BlipVisionConfig,
+        )
+
+        from chiaswarm_tpu.models.conversion import convert_blip
+
+        hf_cfg = HFBlipConfig(
+            text_config=BlipTextConfig(
+                vocab_size=1000, hidden_size=32, num_hidden_layers=2,
+                num_attention_heads=4, intermediate_size=128,
+                max_position_embeddings=64, encoder_hidden_size=32,
+                bos_token_id=998, eos_token_id=999, sep_token_id=999,
+                pad_token_id=0, hidden_act="gelu",
+            ).to_dict(),
+            vision_config=BlipVisionConfig(
+                hidden_size=32, num_hidden_layers=2, num_attention_heads=4,
+                intermediate_size=128, image_size=64, patch_size=16,
+                hidden_act="gelu",
+            ).to_dict(),
+        )
+        torch.manual_seed(0)
+        hf = BlipForQuestionAnswering(hf_cfg).eval()
+        state = {k: v.numpy() for k, v in hf.state_dict().items()}
+        params = convert_blip(state)
+        assert params["qenc"], "conversion produced no question encoder"
+        return hf, params
+
+    def _modules(self):
+        from chiaswarm_tpu.models.blip import TextDecoder, TextEncoder, VisionEncoder
+
+        cfg = TINY_BLIP  # same geometry as the HF config above
+        return (
+            cfg,
+            VisionEncoder(cfg),
+            TextEncoder(cfg),
+            TextDecoder(cfg),
+        )
+
+    def test_question_encoder_matches(self, pair):
+        import torch
+
+        import jax.numpy as jnp
+
+        hf, params = pair
+        cfg, vision, qenc, _ = self._modules()
+        rng = np.random.default_rng(1)
+        px = rng.standard_normal((1, 64, 64, 3)).astype(np.float32)
+        ids = np.array([[101, 7, 23, 102]], np.int64)  # [CLS] q q [SEP]
+
+        with torch.no_grad():
+            img_t = hf.vision_model(
+                pixel_values=torch.from_numpy(px.transpose(0, 3, 1, 2))
+            )[0]
+            q_t = hf.text_encoder(
+                input_ids=torch.from_numpy(ids),
+                encoder_hidden_states=img_t,
+                encoder_attention_mask=torch.ones(img_t.shape[:-1], dtype=torch.long),
+            )[0].numpy()
+
+        img_f = vision.apply({"params": params["vision"]}, jnp.asarray(px))
+        np.testing.assert_allclose(np.asarray(img_f), img_t.numpy(), atol=2e-4)
+        q_f = qenc.apply(
+            {"params": params["qenc"]}, jnp.asarray(ids.astype(np.int32)), img_f
+        )
+        np.testing.assert_allclose(np.asarray(q_f), q_t, atol=2e-4)
+
+    def test_padded_question_matches_unpadded_torch(self, pair):
+        # our serving path pads the question to max_caption_len and masks;
+        # HF serves it unpadded — outputs must agree anyway
+        import torch
+
+        import jax.numpy as jnp
+
+        hf, params = pair
+        cfg, vision, qenc, decoder = self._modules()
+        from chiaswarm_tpu.models.blip import greedy_decode
+
+        rng = np.random.default_rng(2)
+        px = rng.standard_normal((1, 64, 64, 3)).astype(np.float32)
+        raw = [101, 11, 29, 3, 102]  # unpadded [CLS] q q q [SEP]
+
+        with torch.no_grad():
+            out_t = hf.generate(
+                input_ids=torch.tensor([raw]),
+                pixel_values=torch.from_numpy(px.transpose(0, 3, 1, 2)),
+                max_length=cfg.max_caption_len,
+                num_beams=1,
+                do_sample=False,
+            )[0].tolist()
+
+        q_ids = np.full((1, cfg.max_caption_len), cfg.pad_token_id, np.int32)
+        q_ids[0, : len(raw)] = raw
+        q_mask = np.zeros((1, cfg.max_caption_len), np.float32)
+        q_mask[0, : len(raw)] = 1.0
+        img_f = vision.apply({"params": params["vision"]}, jnp.asarray(px))
+        states = qenc.apply(
+            {"params": params["qenc"]}, jnp.asarray(q_ids), img_f,
+            attention_mask=jnp.asarray(q_mask),
+        )
+
+        def apply(p, ids, ctx):
+            return decoder.apply(
+                {"params": p}, ids, ctx, context_mask=jnp.asarray(q_mask)
+            )
+
+        ours = np.asarray(
+            greedy_decode(apply, params["text"], states, cfg)
+        )[0].tolist()
+        # HF stops at EOS; our fixed-length buffer must agree up to there
+        assert ours[: len(out_t)] == out_t
+
+
+def test_special_token_table_emitted_and_loaded(tmp_path):
+    # conversion derives token ids from the shipped vocab.txt ([DEC]/[ENC]
+    # live at the END of the extended vocab) and the pipeline reads them
+    from chiaswarm_tpu.initialize import _emit_blip_special_tokens
+    from chiaswarm_tpu.pipelines.captioning import _load_special_tokens
+
+    d = tmp_path / "m"
+    d.mkdir()
+    vocab = ["[PAD]", "a", "b", "[CLS]", "[SEP]", "c", "[DEC]", "[ENC]"]
+    (d / "vocab.txt").write_text("\n".join(vocab) + "\n")
+    _emit_blip_special_tokens(d)
+    assert _load_special_tokens(d) == {
+        "bos_token_id": 6,
+        "eos_token_id": 4,
+        "sep_token_id": 4,
+        "pad_token_id": 0,
+        "cls_token_id": 3,
+        "enc_token_id": 7,
+    }

@@ -267,3 +267,14 @@ def test_diffusion_parameters_cannot_overwrite_identity():
     assert args["model_name"] == "test/tiny-sd"
     assert args["prompt"] == "good"
     assert args["num_inference_steps"] == 7  # tuning keys keep ref precedence
+
+
+def test_parameters_fill_empty_prompt():
+    # a prompt delivered only via parameters must survive the formatter's
+    # setdefault("prompt", "") — neutral defaults are fillable, not protected
+    from chiaswarm_tpu.job_arguments import format_txt2audio_args
+
+    _, args = format_txt2audio_args(
+        {"model_name": "test/tiny-audio", "parameters": {"prompt": "a cat"}}
+    )
+    assert args["prompt"] == "a cat"
