@@ -101,10 +101,11 @@ def _eval_shape_params(module, *args, **kwargs):
 
 _UNSUPPORTED_CHECK_KEYWORDS = (
     # families the worker can schedule but cannot yet serve with real
-    # weights (no conversion path) — `--check` skips instead of failing
+    # weights (no conversion path) — `--check` skips instead of failing.
+    # Kandinsky 2.x converts (unet/movq/prior); Kandinsky 3 does not yet.
     "audioldm", "bark", "animatediff", "zeroscope", "text-to-video",
-    "i2vgen", "stable-video", "damo", "kandinsky", "cascade", "deepfloyd",
-    "latent-upscaler", "openpose",
+    "i2vgen", "stable-video", "damo", "kandinsky-3", "kandinsky3",
+    "kandinsky-2-1", "cascade", "deepfloyd", "latent-upscaler", "openpose",
 )
 
 
@@ -133,7 +134,104 @@ def verify_local_model(model_name: str, root: Path | None = None) -> dict | None
         return _verify_safety_model(model_name, root)
     if "flux" in name:
         return _verify_flux_model(model_name, root)
+    if "kandinsky" in name:
+        return _verify_kandinsky_model(model_name, root)
     return _verify_sd_model(model_name, root)
+
+
+def _verify_kandinsky_model(model_name: str, root: Path) -> dict:
+    """K2.2 prior repos (prior + text tower + precomputed zero-image
+    embed) and decoder repos (UNet with checkpoint-inferred geometry +
+    MoVQ) — exactly what pipelines/kandinsky.py loads at serving time."""
+    import jax.numpy as jnp
+
+    from .models import configs as cfgs
+    from .models.clip import CLIPTextEncoder
+    from .models.conversion import (
+        assert_tree_shapes_match,
+        convert_clip,
+        convert_prior,
+        load_torch_state_dict,
+    )
+
+    model_dir = root / model_name
+    if "prior" in model_name.lower():
+        from .models.prior import DiffusionPrior, PriorConfig
+
+        cfg = PriorConfig()
+        prior_params, stats = convert_prior(
+            load_torch_state_dict(model_dir, "prior")
+        )
+        prior_exp = _eval_shape_params(
+            DiffusionPrior(cfg),
+            jnp.zeros((1, cfg.embed_dim)),
+            jnp.zeros((1,)),
+            jnp.zeros((1, cfg.text_seq, cfg.text_dim)),
+            jnp.zeros((1, cfg.text_dim)),
+        )
+        assert_tree_shapes_match(prior_params, prior_exp, prefix="prior")
+        text_params = convert_clip(
+            load_torch_state_dict(model_dir, "text_encoder")
+        )
+        text_exp = _eval_shape_params(
+            CLIPTextEncoder(cfgs.SDXL_CLIP_2), jnp.zeros((1, 77), jnp.int32)
+        )
+        assert_tree_shapes_match(text_params, text_exp, prefix="text")
+        _emit_zero_image_embed(model_dir)
+        return {
+            "prior": _param_count(prior_params),
+            "text": _param_count(text_params),
+            "clip_stats": bool(stats),
+        }
+
+    from .models.movq import MoVQ, MoVQConfig
+    from .models.unet_kandinsky import K22UNet
+    from .pipelines.kandinsky import convert_decoder_checkpoint
+
+    # the SAME recipe the serving path loads (pipelines/kandinsky.py) — a
+    # green check must mean exactly what the worker will serve
+    ucfg, unet_params, movq_params = convert_decoder_checkpoint(model_dir)
+    unet_exp = _eval_shape_params(
+        K22UNet(ucfg),
+        jnp.zeros((1, 2 ** len(ucfg.block_out_channels),
+                   2 ** len(ucfg.block_out_channels), ucfg.in_channels)),
+        jnp.zeros((1,)),
+        jnp.zeros((1, ucfg.encoder_hid_dim)),
+    )
+    assert_tree_shapes_match(unet_params, unet_exp, prefix="unet")
+    movq_cfg = MoVQConfig()
+    side = 8 * 2 ** (len(movq_cfg.block_out_channels) - 1)
+    movq_exp = _eval_shape_params(
+        MoVQ(movq_cfg), jnp.zeros((1, side, side, 3))
+    )
+    assert_tree_shapes_match(movq_params, movq_exp, prefix="movq")
+    return {
+        "unet": _param_count(unet_params),
+        "movq": _param_count(movq_params),
+    }
+
+
+def _emit_zero_image_embed(model_dir: Path) -> None:
+    """Precompute diffusers' negative conditioning — the CLIP VISION
+    embedding of a zero image — so the serving prior never needs the
+    vision tower resident (offline torch pass, conversion-time only)."""
+    import numpy as np
+
+    enc_dir = model_dir / "image_encoder"
+    if not enc_dir.is_dir():
+        return
+    try:
+        import torch
+        from transformers import CLIPVisionModelWithProjection
+
+        enc = CLIPVisionModelWithProjection.from_pretrained(str(enc_dir))
+        size = enc.config.image_size
+        with torch.no_grad():
+            z = enc(torch.zeros(1, 3, size, size)).image_embeds[0].numpy()
+        np.save(model_dir / "zero_image_embed.npy", z)
+        logger.info("precomputed zero-image embed for %s", model_dir)
+    except Exception as e:
+        logger.warning("zero-image embed not precomputed: %s", e)
 
 
 def _verify_flux_model(model_name: str, root: Path) -> dict:

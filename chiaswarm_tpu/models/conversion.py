@@ -75,7 +75,13 @@ def convert_tensor(path: list[str], leaf: str, tensor: np.ndarray):
                 return "kernel", tensor[:, :, 0, 0].T
             return "kernel", tensor.transpose(2, 3, 1, 0)  # conv OIHW -> HWIO
         if tensor.ndim == 2:
-            if "embedding" in path[-1] or "embed_tokens" in path[-1]:
+            # exact module names only: a substring match turns Denses that
+            # merely MENTION embeddings (embedding_proj,
+            # proj_to_clip_embeddings) into untransposed tables
+            if path[-1] in (
+                "token_embedding", "word_embeddings", "position_embeddings",
+                "token_type_embeddings", "embed_tokens",
+            ):
                 return "embedding", tensor
             return "kernel", tensor.T
         if tensor.ndim == 1:  # norm scale
@@ -708,3 +714,147 @@ def convert_hifigan(state: dict) -> dict:
         else:
             _assign(params, path + [leaf], tensor)
     return params
+
+
+# --- Kandinsky 2.2 family (models/unet_kandinsky.py, movq.py, prior.py) ---
+
+
+def k22_unet_rename(name: str) -> str | None:
+    """diffusers K2.2 UNet2DConditionModel names -> models.unet_kandinsky
+    module names."""
+    name = name.replace(".to_out.0.", ".to_out_0.")
+    name = name.replace("add_embedding.image_proj.", "aug_emb_proj.")
+    name = name.replace("add_embedding.image_norm.", "aug_emb_norm.")
+    name = name.replace("encoder_hid_proj.image_embeds.", "hid_proj.")
+    name = name.replace("encoder_hid_proj.norm.", "hid_proj_norm.")
+    name = name.replace("mid_block.resnets.", "mid_block_resnets.")
+    name = name.replace("mid_block.attentions.", "mid_block_attentions.")
+    return name
+
+
+def infer_k22_unet_config(state: dict, config_json: dict | None = None):
+    """Derive the UNet geometry from the checkpoint itself (block channels,
+    layers, attention placement, cross/image dims, ImageProjection token
+    count) — hardcoding those invites silent drift from the real weights.
+    `attention_head_dim` is the one field shapes cannot reveal (q/k/v are
+    fused over heads); it comes from the shipped config.json, default 64."""
+    import re
+
+    from .unet_kandinsky import K22UNetConfig
+
+    blocks: dict[int, int] = {}
+    attn_blocks: set[int] = set()
+    layers = 1
+    for k in state:
+        m = re.match(r"down_blocks\.(\d+)\.resnets\.(\d+)\.conv1\.weight", k)
+        if m:
+            blocks[int(m.group(1))] = np.asarray(state[k]).shape[0]
+            layers = max(layers, int(m.group(2)) + 1)
+        m = re.match(r"down_blocks\.(\d+)\.attentions\.0\.to_q\.weight", k)
+        if m:
+            attn_blocks.add(int(m.group(1)))
+    n = max(blocks) + 1
+    block_out = tuple(blocks[i] for i in range(n))
+    proj_w = np.asarray(state["encoder_hid_proj.image_embeds.weight"])
+    first_attn = min(attn_blocks)
+    cross = np.asarray(
+        state[f"down_blocks.{first_attn}.attentions.0.add_k_proj.weight"]
+    ).shape[1]
+    cfg_json = config_json or {}
+    head_dim = int(cfg_json.get("attention_head_dim", 64))
+    groups = int(cfg_json.get("norm_num_groups", 32))
+    return K22UNetConfig(
+        in_channels=np.asarray(state["conv_in.weight"]).shape[1],
+        out_channels=np.asarray(state["conv_out.weight"]).shape[0],
+        block_out_channels=block_out,
+        layers_per_block=layers,
+        attention_head_dim=head_dim,
+        cross_attention_dim=cross,
+        encoder_hid_dim=proj_w.shape[1],
+        image_proj_tokens=proj_w.shape[0] // cross,
+        down_attention=tuple(i in attn_blocks for i in range(n)),
+        norm_num_groups=groups,
+    )
+
+
+def convert_kandinsky_unet(state: dict, config_json: dict | None = None):
+    """-> (K22UNetConfig, params)."""
+    cfg = infer_k22_unet_config(state, config_json)
+    return cfg, convert_state_dict(state, k22_unet_rename)
+
+
+def movq_rename(name: str) -> str | None:
+    """diffusers VQModel (norm_type=spatial) names -> models.movq names."""
+    import re
+
+    if name.startswith("quantize."):
+        return None  # codebook: dead weight for continuous-latent serving
+    name = name.replace(".to_out.0.", ".to_out_0.")
+    for pre in ("encoder", "decoder"):
+        name = name.replace(f"{pre}.mid_block.resnets.",
+                            f"{pre}.mid_block_resnets.")
+        name = name.replace(f"{pre}.mid_block.attentions.",
+                            f"{pre}.mid_block_attentions.")
+        name = re.sub(
+            rf"{pre}\.(down_blocks|up_blocks)\.(\d+)\.(resnets|downsamplers|upsamplers)\.",
+            rf"{pre}.\1_\2_\3.",
+            name,
+        )
+    # samplers are a bare conv: flatten onto the module's single name
+    # (after the block flatten above the shape is "..._downsamplers.0.conv.")
+    name = re.sub(r"_(downsamplers|upsamplers)\.0\.conv\.",
+                  r"_\1_0_conv.", name)
+    # legacy attention naming (q/k/v/proj_attn) in older exports
+    name = name.replace(".query.", ".to_q.")
+    name = name.replace(".key.", ".to_k.")
+    name = name.replace(".value.", ".to_v.")
+    name = name.replace(".proj_attn.", ".to_out_0.")
+    return name
+
+
+def convert_movq(state: dict) -> dict:
+    """diffusers VQModel state dict -> models.movq params. Checkpoints
+    whose SpatialNorm group-norm is non-affine get identity scale/bias
+    filled in (our module keeps them as real params)."""
+    params = convert_state_dict(state, movq_rename)
+
+    def fill(tree: dict):
+        for v in tree.values():
+            if isinstance(v, dict):
+                if "conv_y" in v and "norm_layer" not in v:
+                    ch = np.asarray(v["conv_y"]["kernel"]).shape[-1]
+                    v["norm_layer"] = {
+                        "scale": np.ones((ch,), np.float32),
+                        "bias": np.zeros((ch,), np.float32),
+                    }
+                else:
+                    fill(v)
+
+    fill(params)
+    return params
+
+
+def prior_rename(name: str) -> str | None:
+    """diffusers PriorTransformer names -> models.prior names."""
+    if name in ("clip_mean", "clip_std"):
+        return None  # extracted separately (embedding-space whitening stats)
+    name = name.replace("embedding_proj.", "embed_proj.")
+    name = name.replace(".attn1.to_out.0.", ".to_out_0.")
+    name = name.replace(".attn1.", ".")
+    name = name.replace(".ff.net.0.proj.", ".ff_proj.")
+    name = name.replace(".ff.net.2.", ".ff_out.")
+    return name
+
+
+def convert_prior(state: dict):
+    """-> (params, clip_stats or None). clip_stats = {"mean","std"} [E] —
+    PriorTransformer.post_process_latents un-whitens the predicted
+    embedding before the decoder consumes it."""
+    params = convert_state_dict(state, prior_rename)
+    stats = None
+    if "clip_mean" in state and "clip_std" in state:
+        stats = {
+            "mean": np.asarray(state["clip_mean"]).reshape(-1),
+            "std": np.asarray(state["clip_std"]).reshape(-1),
+        }
+    return params, stats

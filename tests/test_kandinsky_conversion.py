@@ -1,0 +1,279 @@
+"""Kandinsky 2.2 conversion mapping (VERDICT r2 next #2).
+
+No diffusers in this environment, so the checkpoint side is SYNTHESIZED:
+each test inverts the tiny flax tree into the diffusers state-dict naming
+(the documented key layout of kandinsky-community/kandinsky-2-2-decoder /
+-prior), converts it back through models/conversion.py, and demands exact
+equality — proving the rename map is bijective and every transpose rule is
+its own inverse. Config inference is pinned on the same synthetic dicts.
+"""
+
+import re
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from chiaswarm_tpu.models.conversion import (
+    convert_kandinsky_unet,
+    convert_movq,
+    convert_prior,
+)
+from chiaswarm_tpu.models.movq import TINY_MOVQ, MoVQ
+from chiaswarm_tpu.models.prior import TINY_PRIOR, DiffusionPrior
+from chiaswarm_tpu.models.unet_kandinsky import TINY_K22_UNET, K22UNet
+
+
+def _walk(tree, path=()):
+    for k, v in tree.items():
+        if isinstance(v, dict):
+            yield from _walk(v, path + (k,))
+        else:
+            yield path + (k,), np.asarray(v, np.float32)
+
+
+def _to_torch_name(parts, subs):
+    """Flax param path -> diffusers dotted name (inverse of the rename)."""
+    comps = []
+    for p in parts[:-1]:
+        comps.append(re.sub(r"_(\d+)(?=_|$)", r".\1", p))
+    name = ".".join(comps)
+    for src, dst in subs:
+        name = name.replace(src, dst)
+    return name
+
+
+def _to_torch_leaf(parts, arr):
+    leaf = parts[-1]
+    if leaf == "kernel":
+        if arr.ndim == 4:
+            return "weight", np.ascontiguousarray(arr.transpose(3, 2, 0, 1))
+        return "weight", np.ascontiguousarray(arr.T)
+    if leaf == "scale":
+        return "weight", arr
+    if leaf == "embedding":
+        return "weight", arr
+    return leaf, arr
+
+
+def _synth_state(params, subs):
+    state = {}
+    for parts, arr in _walk(params):
+        if len(parts) == 1:
+            # bare top-level params (positional_embedding, prd_embedding)
+            state[parts[0]] = arr
+            continue
+        name = _to_torch_name(parts, subs)
+        leaf, val = _to_torch_leaf(parts, arr)
+        state[f"{name}.{leaf}"] = val
+    return state
+
+
+def _assert_trees_equal(a, b, path=""):
+    assert isinstance(a, dict) == isinstance(b, dict), path
+    if isinstance(a, dict):
+        assert set(a) == set(b), f"{path}: {set(a) ^ set(b)}"
+        for k in a:
+            _assert_trees_equal(a[k], b[k], f"{path}/{k}")
+    else:
+        np.testing.assert_allclose(np.asarray(a, np.float32), b, rtol=1e-6,
+                                   err_msg=path)
+
+
+K22_SUBS = [
+    ("aug_emb_proj", "add_embedding.image_proj"),
+    ("aug_emb_norm", "add_embedding.image_norm"),
+    ("hid_proj_norm", "encoder_hid_proj.norm"),
+    ("hid_proj", "encoder_hid_proj.image_embeds"),
+    ("mid_block_resnets", "mid_block.resnets"),
+    ("mid_block_attentions", "mid_block.attentions"),
+    ("to_out_0", "to_out.0"),
+]
+
+
+@pytest.fixture(scope="module")
+def k22_params():
+    unet = K22UNet(TINY_K22_UNET)
+    return unet.init(
+        jax.random.key(0),
+        jnp.zeros((1, 8, 8, TINY_K22_UNET.in_channels)),
+        jnp.zeros((1,)),
+        jnp.zeros((1, TINY_K22_UNET.encoder_hid_dim)),
+    )["params"]
+
+
+def test_k22_unet_roundtrip_exact(k22_params):
+    state = _synth_state(k22_params, K22_SUBS)
+    cfg, converted = convert_kandinsky_unet(
+        state, {"attention_head_dim": TINY_K22_UNET.attention_head_dim,
+                "norm_num_groups": TINY_K22_UNET.norm_num_groups},
+    )
+    _assert_trees_equal(
+        converted, jax.tree_util.tree_map(lambda x: np.asarray(x), k22_params)
+    )
+
+
+def test_k22_config_inferred_from_checkpoint(k22_params):
+    state = _synth_state(k22_params, K22_SUBS)
+    cfg, _ = convert_kandinsky_unet(
+        state, {"attention_head_dim": TINY_K22_UNET.attention_head_dim,
+                "norm_num_groups": TINY_K22_UNET.norm_num_groups},
+    )
+    assert cfg == TINY_K22_UNET
+
+
+MOVQ_SUBS = [
+    ("_resnets", ".resnets"),
+    ("_downsamplers", ".downsamplers"),
+    ("_upsamplers", ".upsamplers"),
+    ("_attentions", ".attentions"),
+    ("0_conv", "0.conv"),
+]
+
+
+def test_movq_roundtrip_exact():
+    movq = MoVQ(TINY_MOVQ)
+    params = movq.init(jax.random.key(1), jnp.zeros((1, 16, 16, 3)))["params"]
+    state = _synth_state(params, MOVQ_SUBS)
+    # the real checkpoint also carries the codebook — conversion must skip it
+    state["quantize.embedding.weight"] = np.zeros((16, 4), np.float32)
+    converted = convert_movq(state)
+    _assert_trees_equal(
+        converted, jax.tree_util.tree_map(lambda x: np.asarray(x), params)
+    )
+
+
+def test_movq_fills_non_affine_spatial_norm():
+    movq = MoVQ(TINY_MOVQ)
+    params = movq.init(jax.random.key(1), jnp.zeros((1, 16, 16, 3)))["params"]
+    state = _synth_state(params, MOVQ_SUBS)
+    dropped = [k for k in state if "norm_layer" in k]
+    assert dropped, "tiny movq has no spatial norms to exercise"
+    for k in dropped:
+        del state[k]
+    converted = convert_movq(state)
+    # identity scale/bias filled in wherever the checkpoint was non-affine
+    for parts, arr in _walk(converted):
+        if "norm_layer" in parts:
+            leaf = parts[-1]
+            expect = 1.0 if leaf == "scale" else 0.0
+            np.testing.assert_array_equal(arr, np.full_like(arr, expect))
+
+
+PRIOR_SUBS = [
+    ("embed_proj", "embedding_proj"),
+    ("to_q", "attn1.to_q"),
+    ("to_k", "attn1.to_k"),
+    ("to_v", "attn1.to_v"),
+    ("to_out_0", "attn1.to_out.0"),
+    ("ff_proj", "ff.net.0.proj"),
+    ("ff_out", "ff.net.2"),
+]
+
+
+def test_prior_roundtrip_exact_and_stats():
+    prior = DiffusionPrior(TINY_PRIOR)
+    cfg = TINY_PRIOR
+    params = prior.init(
+        jax.random.key(2),
+        jnp.zeros((1, cfg.embed_dim)),
+        jnp.zeros((1,)),
+        jnp.zeros((1, cfg.text_seq, cfg.text_dim)),
+        jnp.zeros((1, cfg.text_dim)),
+    )["params"]
+    state = _synth_state(params, PRIOR_SUBS)
+    state["clip_mean"] = np.full((1, cfg.embed_dim), 0.5, np.float32)
+    state["clip_std"] = np.full((1, cfg.embed_dim), 2.0, np.float32)
+    converted, stats = convert_prior(state)
+    _assert_trees_equal(
+        converted, jax.tree_util.tree_map(lambda x: np.asarray(x), params)
+    )
+    assert stats["mean"].shape == (cfg.embed_dim,)
+    assert float(stats["std"][0]) == 2.0
+
+
+def test_prior_causal_mask_changes_output():
+    """The mask path must actually bind (PriorTransformer runs causal +
+    pad-masked attention whenever the pipeline passes the text mask)."""
+    prior = DiffusionPrior(TINY_PRIOR)
+    cfg = TINY_PRIOR
+    rng = jax.random.key(3)
+    params = prior.init(
+        rng,
+        jnp.zeros((1, cfg.embed_dim)),
+        jnp.zeros((1,)),
+        jnp.zeros((1, cfg.text_seq, cfg.text_dim)),
+        jnp.zeros((1, cfg.text_dim)),
+    )["params"]
+    args = (
+        jax.random.normal(jax.random.key(4), (1, cfg.embed_dim)),
+        jnp.ones((1,)),
+        jax.random.normal(jax.random.key(5), (1, cfg.text_seq, cfg.text_dim)),
+        jax.random.normal(jax.random.key(6), (1, cfg.text_dim)),
+    )
+    free = prior.apply({"params": params}, *args)
+    mask = np.ones((1, cfg.text_seq), np.float32)
+    mask[0, 10:] = 0.0
+    masked = prior.apply({"params": params}, *args,
+                         attention_mask=jnp.asarray(mask))
+    assert not np.allclose(np.asarray(free), np.asarray(masked))
+
+
+def test_verify_local_model_checks_kandinsky(sdaas_root, tmp_path):
+    """initialize --check now validates Kandinsky 2.2 repos end-to-end on a
+    synthetic checkpoint with the real key layout (K3 stays skip-listed)."""
+    from safetensors.numpy import save_file
+
+    from chiaswarm_tpu.initialize import verify_local_model
+    from chiaswarm_tpu.settings import Settings, save_settings
+
+    model_root = tmp_path / "models"
+    name = "kandinsky-community/kandinsky-2-2-decoder"
+    unet_dir = model_root / name / "unet"
+    movq_dir = model_root / name / "movq"
+    unet_dir.mkdir(parents=True)
+    movq_dir.mkdir(parents=True)
+    save_settings(Settings(model_root_dir=str(model_root)))
+
+    # full-geometry synthetic state dicts are GBs; monkeypatching the size
+    # down via the tiny configs exercises the same code path
+    import json
+
+    import chiaswarm_tpu.initialize as init_mod
+    from chiaswarm_tpu.models import conversion as conv
+    from chiaswarm_tpu.models import movq as movq_mod
+
+    unet = K22UNet(TINY_K22_UNET)
+    uparams = unet.init(
+        jax.random.key(0),
+        jnp.zeros((1, 8, 8, 4)), jnp.zeros((1,)),
+        jnp.zeros((1, TINY_K22_UNET.encoder_hid_dim)),
+    )["params"]
+    save_file(
+        {k: v for k, v in _flatten_state(_synth_state(uparams, K22_SUBS)).items()},
+        str(unet_dir / "model.safetensors"),
+    )
+    (unet_dir / "config.json").write_text(json.dumps({
+        "attention_head_dim": TINY_K22_UNET.attention_head_dim,
+        "norm_num_groups": TINY_K22_UNET.norm_num_groups,
+    }))
+    movq = MoVQ(TINY_MOVQ)
+    mparams = movq.init(jax.random.key(1), jnp.zeros((1, 16, 16, 3)))["params"]
+    save_file(
+        _flatten_state(_synth_state(mparams, MOVQ_SUBS)),
+        str(movq_dir / "model.safetensors"),
+    )
+
+    import unittest.mock as mock
+
+    with mock.patch.object(movq_mod, "MoVQConfig", lambda: TINY_MOVQ):
+        out = verify_local_model(name, model_root)
+    assert out is not None and out["unet"] > 0 and out["movq"] > 0
+    # Kandinsky 3 has no conversion path yet: still a skip, not a failure
+    assert verify_local_model("kandinsky-community/kandinsky-3") is None
+
+
+def _flatten_state(state):
+    return {k: np.ascontiguousarray(v) for k, v in state.items()}
