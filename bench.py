@@ -1,11 +1,19 @@
 """Benchmark harness: SDXL 1024^2 30-step txt2img, images/sec/chip.
 
-The north-star config from BASELINE.json (the reference publishes no
-numbers, SURVEY §6). Run on TPU this measures the real flagship pipeline;
-on CPU it falls back to the tiny model so the harness itself stays
-testable, and labels the metric accordingly.
+The primary config from BASELINE.md (the reference publishes no numbers,
+SURVEY §6). Run on TPU this measures the real flagship pipeline; on CPU it
+falls back to the tiny model so the harness itself stays testable, and
+labels the metric accordingly. Secondary rows (SD2.1-768, SDXL+ControlNet)
+and a warm-compile probe ride the same JSON object; each is best-effort so
+a failure there never loses the primary metric.
 
 Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", ...}.
+
+`vs_baseline` compares against the ROOFLINE-HONEST target (see BASELINE.md
+round-3 re-derivation): SDXL 1024^2 30-step CFG needs ~419 UNet TFLOP per
+image, so one 197-TFLOP/s v5e chip is compute-bound at ~0.47 img/s at 100%
+MFU — the target is 0.33 img/s/chip (~70% MFU), not the physically
+unreachable 1.0 the round-1 BASELINE guessed.
 """
 
 from __future__ import annotations
@@ -15,7 +23,7 @@ import os
 import sys
 import time
 
-NORTH_STAR_IMG_PER_SEC_PER_CHIP = 1.0  # BASELINE.json target on v5e-8
+TARGET_IMG_PER_SEC_PER_CHIP = 0.33  # ~70% UNet MFU on one v5e chip
 
 
 def probe_tpu(timeout_s: float) -> str:
@@ -100,9 +108,25 @@ def init_backend():
         raise SystemExit(0)
 
 
+def _enable_compile_cache() -> None:
+    """Same persistent XLA cache the worker uses (worker.py) — the bench
+    both exercises it (warm-compile probe) and leaves it populated."""
+    try:
+        import jax
+
+        from chiaswarm_tpu.settings import load_settings
+
+        cache_dir = os.path.expanduser(load_settings().compilation_cache_dir)
+        jax.config.update("jax_compilation_cache_dir", cache_dir)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+    except Exception as e:
+        sys.stderr.write(f"compilation cache unavailable: {e}\n")
+
+
 def main() -> None:
     backend, chips = init_backend()
     on_tpu = any(d.platform == "tpu" for d in chips)
+    _enable_compile_cache()
 
     from chiaswarm_tpu.chips.device import ChipSet
     from chiaswarm_tpu.pipelines.stable_diffusion import SDPipeline
@@ -137,23 +161,107 @@ def main() -> None:
         if on_tpu
         else "tiny_txt2img_cpu_smoke_images_per_sec_per_chip"
     )
-    print(
-        json.dumps(
-            {
-                "metric": metric,
-                "value": round(per_chip, 4),
-                "unit": "images/sec/chip",
-                "vs_baseline": round(per_chip / NORTH_STAR_IMG_PER_SEC_PER_CHIP, 4),
-                "p50_job_s": round(p50_job_s, 3),
-                "batch": batch,
-                "chips": len(chips),
-                "backend": backend,
-                "steps": 30,
-                "size": 1024 if on_tpu else 64,
-                **extra,
-            }
+    out = {
+        "metric": metric,
+        "value": round(per_chip, 4),
+        "unit": "images/sec/chip",
+        "vs_baseline": round(per_chip / TARGET_IMG_PER_SEC_PER_CHIP, 4),
+        "target_img_per_sec_per_chip": TARGET_IMG_PER_SEC_PER_CHIP,
+        "p50_job_s": round(p50_job_s, 3),
+        "batch": batch,
+        "chips": len(chips),
+        "backend": backend,
+        "steps": 30,
+        "size": 1024 if on_tpu else 64,
+        **extra,
+    }
+
+    if on_tpu:
+        out.update(_warm_compile_probe(pipe, size, steps, batch))
+        if os.environ.get("BENCH_CONFIGS", "full") == "full":
+            out.update(_secondary_rows(chipset, chips, pipe))
+
+    print(json.dumps(out))
+
+
+def _warm_compile_probe(pipe, size, steps, batch) -> dict:
+    """Prove the persistent compile cache: drop every in-memory executable,
+    re-trace the SAME shape bucket, and time the rebuild — a worker restart
+    pays this, not the cold compile (VERDICT weak #2)."""
+    import jax
+
+    try:
+        jax.clear_caches()
+        pipe._programs.clear()
+        t0 = time.perf_counter()
+        pipe.run(
+            prompt="warm probe",
+            height=size,
+            width=size,
+            num_inference_steps=steps,
+            num_images_per_prompt=batch,
+            scheduler_type="EulerDiscreteScheduler",
+            rng=jax.random.key(99),
         )
-    )
+        return {"warm_compile_s": round(time.perf_counter() - t0, 1)}
+    except Exception as e:
+        sys.stderr.write(f"warm-compile probe failed: {e}\n")
+        return {}
+
+
+def _secondary_rows(chipset, chips, xl_pipe) -> dict:
+    """SD2.1-768 and SDXL+ControlNet rows — regressions there were
+    invisible when only the flagship config was measured (VERDICT weak #3).
+    The ControlNet row reuses the resident SDXL pipeline (a second copy
+    would double HBM); shorter runs keep the bench inside its budget."""
+    from chiaswarm_tpu.pipelines.stable_diffusion import SDPipeline
+
+    out = {}
+    try:
+        from PIL import Image
+
+        rate, p50 = _quick_rate(
+            xl_pipe,
+            dict(height=1024, width=1024, num_inference_steps=30,
+                 num_images_per_prompt=2,
+                 controlnet_model_name="diffusers/controlnet-canny-sdxl-1.0",
+                 image=Image.new("RGB", (1024, 1024), (128, 128, 128)),
+                 scheduler_type="EulerDiscreteScheduler"),
+        )
+        out["sdxl_controlnet_img_per_sec_per_chip"] = round(rate / len(chips), 4)
+        out["sdxl_controlnet_p50_job_s"] = round(p50, 3)
+    except Exception as e:
+        sys.stderr.write(f"controlnet row failed: {type(e).__name__}: {e}\n")
+    try:
+        xl_pipe.release()  # free HBM before the second model family
+        sd21 = SDPipeline(
+            "stabilityai/stable-diffusion-2-1", chipset=chipset,
+            allow_random_init=True,
+        )
+        rate, p50 = _quick_rate(
+            sd21, dict(height=768, width=768, num_inference_steps=30,
+                       num_images_per_prompt=4,
+                       scheduler_type="EulerDiscreteScheduler")
+        )
+        out["sd21_768_img_per_sec_per_chip"] = round(rate / len(chips), 4)
+        out["sd21_768_p50_job_s"] = round(p50, 3)
+        sd21.release()
+    except Exception as e:
+        sys.stderr.write(f"sd21 row failed: {type(e).__name__}: {e}\n")
+    return out
+
+
+def _quick_rate(pipe, kw) -> tuple[float, float]:
+    import jax
+
+    pipe.run(rng=jax.random.key(0), prompt="bench", **kw)  # compile
+    times = []
+    for i in range(3):
+        t0 = time.perf_counter()
+        pipe.run(rng=jax.random.key(i + 1), prompt="bench", **kw)
+        times.append(time.perf_counter() - t0)
+    p50 = sorted(times)[1]  # true median of 3
+    return kw["num_images_per_prompt"] / p50, p50
 
 
 # peak dense bf16 TFLOP/s per chip, by device kind prefix

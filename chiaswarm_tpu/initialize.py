@@ -103,9 +103,9 @@ _UNSUPPORTED_CHECK_KEYWORDS = (
     # families the worker can schedule but cannot yet serve with real
     # weights (no conversion path) — `--check` skips instead of failing.
     # Kandinsky 2.x converts (unet/movq/prior); Kandinsky 3 does not yet.
-    "audioldm", "bark", "animatediff", "zeroscope", "text-to-video",
+    "audioldm", "bark", "zeroscope", "text-to-video",
     "i2vgen", "stable-video", "damo", "kandinsky-3", "kandinsky3",
-    "kandinsky-2-1", "cascade", "deepfloyd", "latent-upscaler", "openpose",
+    "kandinsky-2-1", "cascade", "latent-upscaler", "openpose",
 )
 
 
@@ -136,7 +136,85 @@ def verify_local_model(model_name: str, root: Path | None = None) -> dict | None
         return _verify_flux_model(model_name, root)
     if "kandinsky" in name:
         return _verify_kandinsky_model(model_name, root)
+    if name.startswith("deepfloyd/"):
+        return _verify_if_model(model_name, root)
+    if "animatediff" in name or "motion-adapter" in name:
+        return _verify_motion_adapter(model_name, root)
     return _verify_sd_model(model_name, root)
+
+
+def _verify_motion_adapter(model_name: str, root: Path) -> dict:
+    """A MotionAdapter repo: the temporal modules convert and shape-check
+    against the SD1.5-geometry VideoUNet they overlay at serving time."""
+    import jax.numpy as jnp
+
+    from .models import configs as cfgs
+    from .models.conversion import (
+        assert_tree_shapes_match,
+        convert_motion_adapter,
+        load_torch_state_dict,
+    )
+    from .models.video_unet import VideoUNet, VideoUNetConfig
+
+    converted = convert_motion_adapter(load_torch_state_dict(root / model_name))
+    if not converted:
+        raise ValueError(f"{model_name}: no motion-module weights found")
+    cfg = VideoUNetConfig(base=cfgs.SD15_UNET, num_frames=16)
+    hw = 2 ** len(cfg.base.block_out_channels)
+    full_exp = _eval_shape_params(
+        VideoUNet(cfg),
+        jnp.zeros((cfg.num_frames, hw, hw, cfg.base.in_channels)),
+        jnp.zeros((cfg.num_frames,)),
+        jnp.zeros((cfg.num_frames, 77, cfg.base.cross_attention_dim)),
+    )
+    motion_exp = {k: v for k, v in full_exp.items() if "motion_modules" in k}
+    assert_tree_shapes_match(converted, motion_exp, prefix="motion")
+    return {"motion": _param_count(converted)}
+
+
+def _verify_if_model(model_name: str, root: Path) -> dict:
+    """One IF repo (stage I or II): the UNet converts through the same
+    checkpoint-inferred K-block recipe the serving cascade loads, plus the
+    T5 tower when the repo ships one."""
+    import json
+
+    import jax.numpy as jnp
+
+    from .models.conversion import (
+        assert_tree_shapes_match,
+        convert_kandinsky_unet,
+        convert_t5,
+        load_torch_state_dict,
+    )
+    from .models.unet_kandinsky import K22UNet
+
+    model_dir = root / model_name
+    cfg_json = {}
+    p = model_dir / "unet" / "config.json"
+    if p.is_file():
+        cfg_json = json.loads(p.read_text())
+    ucfg, unet_params = convert_kandinsky_unet(
+        load_torch_state_dict(model_dir, "unet"), cfg_json
+    )
+    side = 2 ** len(ucfg.block_out_channels)
+    unet_exp = _eval_shape_params(
+        K22UNet(ucfg),
+        jnp.zeros((1, side, side, ucfg.in_channels)),
+        jnp.zeros((1,)),
+        jnp.zeros((1, 8, ucfg.encoder_hid_dim)),
+    )
+    assert_tree_shapes_match(unet_params, unet_exp, prefix="unet")
+    out = {"unet": _param_count(unet_params)}
+    if (model_dir / "text_encoder").is_dir():
+        from .models.t5 import T5Config, T5Encoder
+
+        t5_params = convert_t5(load_torch_state_dict(model_dir, "text_encoder"))
+        t5_exp = _eval_shape_params(
+            T5Encoder(T5Config()), jnp.zeros((1, 8), jnp.int32)
+        )
+        assert_tree_shapes_match(t5_params, t5_exp, prefix="t5")
+        out["t5"] = _param_count(t5_params)
+    return out
 
 
 def _verify_kandinsky_model(model_name: str, root: Path) -> dict:

@@ -720,13 +720,22 @@ def convert_hifigan(state: dict) -> dict:
 
 
 def k22_unet_rename(name: str) -> str | None:
-    """diffusers K2.2 UNet2DConditionModel names -> models.unet_kandinsky
-    module names."""
+    """diffusers K2.2 / DeepFloyd IF UNet2DConditionModel names ->
+    models.unet_kandinsky module names (the same block family serves both:
+    image-conditioned for Kandinsky, text-conditioned for IF)."""
     name = name.replace(".to_out.0.", ".to_out_0.")
+    # Kandinsky: ImageTimeEmbedding + ImageProjection
     name = name.replace("add_embedding.image_proj.", "aug_emb_proj.")
     name = name.replace("add_embedding.image_norm.", "aug_emb_norm.")
     name = name.replace("encoder_hid_proj.image_embeds.", "hid_proj.")
     name = name.replace("encoder_hid_proj.norm.", "hid_proj_norm.")
+    # IF: TextTimeEmbedding (LN -> attention pool -> proj -> LN) + Linear
+    name = name.replace("add_embedding.norm1.", "aug_emb_norm1.")
+    name = name.replace("add_embedding.pool.", "aug_emb_pool.")
+    name = name.replace("add_embedding.proj.", "aug_emb_proj.")
+    name = name.replace("add_embedding.norm2.", "aug_emb_norm2.")
+    name = name.replace("encoder_hid_proj.weight", "hid_proj.weight")
+    name = name.replace("encoder_hid_proj.bias", "hid_proj.bias")
     name = name.replace("mid_block.resnets.", "mid_block_resnets.")
     name = name.replace("mid_block.attentions.", "mid_block_attentions.")
     return name
@@ -755,7 +764,6 @@ def infer_k22_unet_config(state: dict, config_json: dict | None = None):
             attn_blocks.add(int(m.group(1)))
     n = max(blocks) + 1
     block_out = tuple(blocks[i] for i in range(n))
-    proj_w = np.asarray(state["encoder_hid_proj.image_embeds.weight"])
     first_attn = min(attn_blocks)
     cross = np.asarray(
         state[f"down_blocks.{first_attn}.attentions.0.add_k_proj.weight"]
@@ -763,6 +771,16 @@ def infer_k22_unet_config(state: dict, config_json: dict | None = None):
     cfg_json = config_json or {}
     head_dim = int(cfg_json.get("attention_head_dim", 64))
     groups = int(cfg_json.get("norm_num_groups", 32))
+    image_mode = "encoder_hid_proj.image_embeds.weight" in state
+    if image_mode:
+        proj_w = np.asarray(state["encoder_hid_proj.image_embeds.weight"])
+        hid_dim = proj_w.shape[1]
+        tokens = proj_w.shape[0] // cross
+    else:
+        # IF: plain Linear T5-state projection
+        proj_w = np.asarray(state["encoder_hid_proj.weight"])
+        hid_dim = proj_w.shape[1]
+        tokens = 0
     return K22UNetConfig(
         in_channels=np.asarray(state["conv_in.weight"]).shape[1],
         out_channels=np.asarray(state["conv_out.weight"]).shape[0],
@@ -770,10 +788,18 @@ def infer_k22_unet_config(state: dict, config_json: dict | None = None):
         layers_per_block=layers,
         attention_head_dim=head_dim,
         cross_attention_dim=cross,
-        encoder_hid_dim=proj_w.shape[1],
-        image_proj_tokens=proj_w.shape[0] // cross,
+        encoder_hid_dim=hid_dim,
+        image_proj_tokens=tokens,
         down_attention=tuple(i in attn_blocks for i in range(n)),
         norm_num_groups=groups,
+        conditioning="image" if image_mode else "text",
+        act=str(cfg_json.get("act_fn", "silu" if image_mode else "gelu")),
+        class_embed_timestep=any(
+            k.startswith("class_embedding.") for k in state
+        ),
+        addition_embed_heads=int(
+            cfg_json.get("addition_embed_type_num_heads", 64)
+        ),
     )
 
 
@@ -858,3 +884,98 @@ def convert_prior(state: dict):
             "std": np.asarray(state["clip_std"]).reshape(-1),
         }
     return params, stats
+
+
+# --- AnimateDiff video family (models/video_unet.py) ---
+
+
+def motion_adapter_rename(name: str) -> str | None:
+    """diffusers MotionAdapter names -> models.video_unet motion-module
+    names (the temporal_transformer wrapper level flattens away)."""
+    import re
+
+    name = re.sub(
+        r"down_blocks\.(\d+)\.motion_modules\.(\d+)\.temporal_transformer\.",
+        r"down_\1_motion_modules_\2.", name,
+    )
+    name = re.sub(
+        r"up_blocks\.(\d+)\.motion_modules\.(\d+)\.temporal_transformer\.",
+        r"up_\1_motion_modules_\2.", name,
+    )
+    name = re.sub(
+        r"mid_block\.motion_modules\.(\d+)\.temporal_transformer\.",
+        r"mid_motion_modules_\1.", name,
+    )
+    name = name.replace(".to_out.0.", ".to_out_0.")
+    name = name.replace(".ff.net.0.", ".ff.net_0.")
+    name = name.replace(".ff.net.2.", ".ff.net_2.")
+    return name
+
+
+def convert_motion_adapter(state: dict) -> dict:
+    """MotionAdapter checkpoint -> motion-module subtrees, ready to overlay
+    onto a VideoUNet param tree (same top-level names)."""
+    return convert_state_dict(state, motion_adapter_rename)
+
+
+def video_unet_rename(name: str) -> str | None:
+    """diffusers SD UNet2DConditionModel names -> models.video_unet SPATIAL
+    module names (VideoUNet flattens the block level: down_blocks.0.resnets.1
+    -> down_0_resnets_1; motion modules come from the adapter)."""
+    import re
+
+    name = re.sub(r"down_blocks\.(\d+)\.(resnets|attentions)\.",
+                  r"down_\1_\2.", name)
+    name = re.sub(r"down_blocks\.(\d+)\.downsamplers\.0\.conv\.",
+                  r"down_\1_downsample.conv.", name)
+    name = re.sub(r"up_blocks\.(\d+)\.(resnets|attentions)\.",
+                  r"up_\1_\2.", name)
+    name = re.sub(r"up_blocks\.(\d+)\.upsamplers\.0\.conv\.",
+                  r"up_\1_upsample.conv.", name)
+    name = name.replace("mid_block.resnets.", "mid_resnets.")
+    name = name.replace("mid_block.attentions.", "mid_attentions.")
+    name = name.replace(".to_out.0.", ".to_out_0.")
+    name = name.replace(".ff.net.0.", ".ff.net_0.")
+    name = name.replace(".ff.net.2.", ".ff.net_2.")
+    return name
+
+
+def convert_video_unet(spatial_state: dict, motion_state: dict) -> dict:
+    """SD1.5-family UNet checkpoint + MotionAdapter checkpoint -> one
+    VideoUNet param tree (AnimateDiff's composition: frozen spatial weights
+    with temporal modules threaded between them)."""
+    params = convert_state_dict(spatial_state, video_unet_rename)
+    for key, sub in convert_motion_adapter(motion_state).items():
+        params[key] = sub
+    return params
+
+
+# --- HED edge annotator (models/hed.py) ---
+
+
+def convert_hed(state: dict) -> dict:
+    """lllyasviel ControlNetHED state dict (norm, blockN.convs.M,
+    blockN.projection) -> models.hed params; the generic merge handles the
+    dotted indices, and `norm` rides verbatim in its NCHW shape."""
+    return convert_state_dict(state)
+
+
+def checked_converted(module, example_args, converted, prefix, rng):
+    """Shape-check a converted tree against a flax module via eval_shape
+    (no materialized random init) and return it; geometry mismatches
+    surface as MissingWeightsError naming the component. The shared
+    loader-side twin of assert_tree_shapes_match, used by every pipeline
+    family that loads converted weights."""
+    import jax
+
+    from ..weights import MissingWeightsError
+
+    expected = jax.eval_shape(module.init, rng, *example_args)["params"]
+    try:
+        assert_tree_shapes_match(converted, expected, prefix=prefix)
+    except ValueError as e:
+        raise MissingWeightsError(
+            f"converted checkpoint does not match the {prefix} "
+            f"architecture: {e}"
+        ) from None
+    return converted

@@ -50,12 +50,14 @@ class TimestepEmbedding(nn.Module):
 
 class ResnetBlock2D(nn.Module):
     out_channels: int
+    # diffusers: UNet resnets norm at 1e-5, VAE resnets at 1e-6
+    eps: float = 1e-5
     dtype: jnp.dtype = jnp.float32
 
     @nn.compact
     def __call__(self, x, temb=None):
         residual = x
-        h = nn.GroupNorm(32, epsilon=1e-5, dtype=self.dtype, name="norm1")(x)
+        h = nn.GroupNorm(32, epsilon=self.eps, dtype=self.dtype, name="norm1")(x)
         h = nn.silu(h)
         h = nn.Conv(
             self.out_channels, (3, 3), padding=((1, 1), (1, 1)), dtype=self.dtype,
@@ -68,7 +70,7 @@ class ResnetBlock2D(nn.Module):
             )
             h = h + temb_proj[:, None, None, :]
 
-        h = nn.GroupNorm(32, epsilon=1e-5, dtype=self.dtype, name="norm2")(h)
+        h = nn.GroupNorm(32, epsilon=self.eps, dtype=self.dtype, name="norm2")(h)
         h = nn.silu(h)
         h = nn.Conv(
             self.out_channels, (3, 3), padding=((1, 1), (1, 1)), dtype=self.dtype,

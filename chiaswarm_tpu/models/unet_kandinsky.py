@@ -41,6 +41,16 @@ class K22UNetConfig:
     # which down blocks carry attention (block 0 is pure resnet)
     down_attention: tuple[bool, ...] = (False, True, True, True)
     norm_num_groups: int = 32
+    # "image": K2.2 — a single CLIP image embedding feeds BOTH the additive
+    #   time branch (ImageTimeEmbedding) and the ImageProjection tokens.
+    # "text": DeepFloyd IF — T5 states feed an attention-pooled
+    #   TextTimeEmbedding and a Linear encoder_hid projection.
+    conditioning: str = "image"
+    act: str = "silu"  # resnet/out nonlinearity ("gelu" for IF)
+    # IF super-resolution stages carry a second timestep conditioning (the
+    # aug/noise level) through a class embedding
+    class_embed_timestep: bool = False
+    addition_embed_heads: int = 64  # TextTimeEmbedding pool heads
 
 
 TINY_K22_UNET = K22UNetConfig(
@@ -54,6 +64,45 @@ TINY_K22_UNET = K22UNetConfig(
     norm_num_groups=8,
 )
 
+# DeepFloyd IF-I (pixel-space base stage) real geometry analog; conversion
+# re-derives the true numbers from the checkpoint
+IF_UNET = K22UNetConfig(
+    in_channels=3,
+    out_channels=6,  # pixels + learned variance
+    block_out_channels=(704, 1408, 2112, 2816),
+    layers_per_block=3,
+    attention_head_dim=64,
+    cross_attention_dim=2048,
+    encoder_hid_dim=4096,  # T5-XXL hidden width
+    down_attention=(False, True, True, True),
+    conditioning="text",
+    act="gelu",
+    addition_embed_heads=64,
+)
+
+TINY_IF_UNET = K22UNetConfig(
+    in_channels=3,
+    out_channels=3,
+    block_out_channels=(32, 64),
+    layers_per_block=1,
+    attention_head_dim=8,
+    cross_attention_dim=16,
+    encoder_hid_dim=32,
+    down_attention=(False, True),
+    norm_num_groups=8,
+    conditioning="text",
+    act="gelu",
+    addition_embed_heads=4,
+)
+
+TINY_IF_SR_UNET = dataclasses.replace(
+    TINY_IF_UNET, in_channels=6, class_embed_timestep=True
+)
+
+
+def _act(name: str):
+    return nn.gelu if name == "gelu" else nn.silu
+
 
 class KResnetBlock(nn.Module):
     """diffusers ResnetBlock2D with time_embedding_norm='scale_shift' and
@@ -65,13 +114,15 @@ class KResnetBlock(nn.Module):
     groups: int = 32
     down: bool = False
     up: bool = False
+    act: str = "silu"
     dtype: jnp.dtype = jnp.float32
 
     @nn.compact
     def __call__(self, x, temb):
+        act = _act(self.act)
         h = nn.GroupNorm(self.groups, epsilon=1e-5, dtype=self.dtype,
                          name="norm1")(x)
-        h = nn.silu(h)
+        h = act(h)
         if self.down:
             x = nn.avg_pool(x, (2, 2), strides=(2, 2))
             h = nn.avg_pool(h, (2, 2), strides=(2, 2))
@@ -87,7 +138,7 @@ class KResnetBlock(nn.Module):
         h = nn.GroupNorm(self.groups, epsilon=1e-5, dtype=self.dtype,
                          name="norm2")(h)
         h = h * (1.0 + scale) + shift
-        h = nn.silu(h)
+        h = act(h)
         h = nn.Conv(self.out_channels, (3, 3), padding=((1, 1), (1, 1)),
                     dtype=self.dtype, name="conv2")(h)
         if x.shape[-1] != self.out_channels:
@@ -155,7 +206,8 @@ class KDownBlock(nn.Module):
         skips = []
         for i in range(cfg.layers_per_block):
             x = KResnetBlock(self.out_channels, groups=cfg.norm_num_groups,
-                             dtype=self.dtype, name=f"resnets_{i}")(x, temb)
+                             act=cfg.act, dtype=self.dtype,
+                             name=f"resnets_{i}")(x, temb)
             if self.attend:
                 x = KAttention(
                     self.out_channels // cfg.attention_head_dim,
@@ -166,7 +218,7 @@ class KDownBlock(nn.Module):
             skips.append(x)
         if self.add_downsample:
             x = KResnetBlock(self.out_channels, groups=cfg.norm_num_groups,
-                             down=True, dtype=self.dtype,
+                             down=True, act=cfg.act, dtype=self.dtype,
                              name="downsamplers_0")(x, temb)
             skips.append(x)
         return x, skips
@@ -188,7 +240,8 @@ class KUpBlock(nn.Module):
         for i in range(cfg.layers_per_block + 1):
             x = jnp.concatenate([x, skips.pop()], axis=-1)
             x = KResnetBlock(self.out_channels, groups=cfg.norm_num_groups,
-                             dtype=self.dtype, name=f"resnets_{i}")(x, temb)
+                             act=cfg.act, dtype=self.dtype,
+                             name=f"resnets_{i}")(x, temb)
             if self.attend:
                 x = KAttention(
                     self.out_channels // cfg.attention_head_dim,
@@ -198,9 +251,38 @@ class KUpBlock(nn.Module):
                 )(x, context)
         if self.add_upsample:
             x = KResnetBlock(self.out_channels, groups=cfg.norm_num_groups,
-                             up=True, dtype=self.dtype,
+                             up=True, act=cfg.act, dtype=self.dtype,
                              name="upsamplers_0")(x, temb)
         return x
+
+
+class AttentionPooling(nn.Module):
+    """diffusers AttentionPooling (IF's TextTimeEmbedding pool): a mean+
+    positional class token attends the sequence; its attention output is
+    the pooled vector."""
+
+    num_heads: int
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x):
+        b, s, width = x.shape
+        pos = self.param(
+            "positional_embedding", nn.initializers.normal(width**-0.5),
+            (1, width),
+        ).astype(self.dtype)
+        cls = jnp.mean(x, axis=1, keepdims=True) + pos[None]
+        seq = jnp.concatenate([cls, x], axis=1)
+        hd = width // self.num_heads
+        shape = lambda t: t.reshape(b, t.shape[1], self.num_heads, hd)
+        q = shape(nn.Dense(width, dtype=self.dtype, name="q_proj")(cls))
+        k = shape(nn.Dense(width, dtype=self.dtype, name="k_proj")(seq))
+        v = shape(nn.Dense(width, dtype=self.dtype, name="v_proj")(seq))
+        scale = hd**-0.5
+        logits = jnp.einsum("bqhd,bkhd->bhqk", q, k) * scale
+        w = nn.softmax(logits.astype(jnp.float32), axis=-1).astype(self.dtype)
+        out = jnp.einsum("bhqk,bkhd->bqhd", w, v).reshape(b, 1, width)
+        return out[:, 0]
 
 
 class K22UNet(nn.Module):
@@ -208,9 +290,12 @@ class K22UNet(nn.Module):
     dtype: jnp.dtype = jnp.float32
 
     @nn.compact
-    def __call__(self, sample, timesteps, image_embeds):
-        """sample [B, H, W, C_in], timesteps [B], image_embeds [B, E]
-        -> [B, H, W, C_out]."""
+    def __call__(self, sample, timesteps, cond, class_labels=None):
+        """sample [B, H, W, C_in], timesteps [B] -> [B, H, W, C_out].
+
+        `cond` is the image embedding [B, E] (conditioning="image") or the
+        T5 states [B, S, E] (conditioning="text"). `class_labels` [B] is
+        the IF super-res aug/noise level (class_embed_timestep)."""
         cfg = self.config
         if jnp.ndim(timesteps) == 0:
             timesteps = jnp.broadcast_to(timesteps, (sample.shape[0],))
@@ -221,19 +306,41 @@ class K22UNet(nn.Module):
         )
         temb = TimestepEmbedding(temb_dim, dtype=self.dtype,
                                  name="time_embedding")(t_feat)
-        # addition_embed_type="image" (ImageTimeEmbedding): the image embed
-        # joins the timestep embedding additively
-        img = image_embeds.astype(self.dtype)
-        aug = nn.Dense(temb_dim, dtype=self.dtype, name="aug_emb_proj")(img)
-        aug = nn.LayerNorm(dtype=self.dtype, name="aug_emb_norm")(aug)
-        temb = temb + aug
-        # encoder_hid_dim_type="image_proj" (ImageProjection): the image
-        # embed also becomes the cross-attention token sequence
-        ctx = nn.Dense(
-            cfg.image_proj_tokens * cfg.cross_attention_dim,
-            dtype=self.dtype, name="hid_proj",
-        )(img).reshape(-1, cfg.image_proj_tokens, cfg.cross_attention_dim)
-        ctx = nn.LayerNorm(dtype=self.dtype, name="hid_proj_norm")(ctx)
+        cond = cond.astype(self.dtype)
+        if cfg.conditioning == "image":
+            # addition_embed_type="image" (ImageTimeEmbedding): the image
+            # embed joins the timestep embedding additively
+            aug = nn.Dense(temb_dim, dtype=self.dtype, name="aug_emb_proj")(cond)
+            aug = nn.LayerNorm(dtype=self.dtype, name="aug_emb_norm")(aug)
+            temb = temb + aug
+            # encoder_hid_dim_type="image_proj" (ImageProjection): the image
+            # embed also becomes the cross-attention token sequence
+            ctx = nn.Dense(
+                cfg.image_proj_tokens * cfg.cross_attention_dim,
+                dtype=self.dtype, name="hid_proj",
+            )(cond).reshape(-1, cfg.image_proj_tokens, cfg.cross_attention_dim)
+            ctx = nn.LayerNorm(dtype=self.dtype, name="hid_proj_norm")(ctx)
+        else:
+            # IF: addition_embed_type="text" (TextTimeEmbedding = LN ->
+            # attention pool -> proj -> LN), encoder_hid_dim_type="text_proj"
+            aug = nn.LayerNorm(dtype=self.dtype, name="aug_emb_norm1")(cond)
+            aug = AttentionPooling(cfg.addition_embed_heads, dtype=self.dtype,
+                                   name="aug_emb_pool")(aug)
+            aug = nn.Dense(temb_dim, dtype=self.dtype, name="aug_emb_proj")(aug)
+            aug = nn.LayerNorm(dtype=self.dtype, name="aug_emb_norm2")(aug)
+            temb = temb + aug
+            ctx = nn.Dense(cfg.cross_attention_dim, dtype=self.dtype,
+                           name="hid_proj")(cond)
+        if cfg.class_embed_timestep:
+            # IF-II: the SR noise level rides a second timestep embedding
+            if class_labels is None:
+                class_labels = jnp.zeros_like(timesteps)
+            c_feat = timestep_embedding(
+                class_labels, cfg.block_out_channels[0], dtype=self.dtype
+            )
+            temb = temb + TimestepEmbedding(
+                temb_dim, dtype=self.dtype, name="class_embedding"
+            )(c_feat)
 
         x = nn.Conv(cfg.block_out_channels[0], (3, 3),
                     padding=((1, 1), (1, 1)), dtype=self.dtype,
@@ -250,15 +357,15 @@ class K22UNet(nn.Module):
             skips.extend(block_skips)
 
         mid_ch = cfg.block_out_channels[-1]
-        x = KResnetBlock(mid_ch, groups=cfg.norm_num_groups, dtype=self.dtype,
-                         name="mid_block_resnets_0")(x, temb)
+        x = KResnetBlock(mid_ch, groups=cfg.norm_num_groups, act=cfg.act,
+                         dtype=self.dtype, name="mid_block_resnets_0")(x, temb)
         x = KAttention(
             mid_ch // cfg.attention_head_dim, cfg.attention_head_dim, mid_ch,
             groups=cfg.norm_num_groups, dtype=self.dtype,
             name="mid_block_attentions_0",
         )(x, ctx)
-        x = KResnetBlock(mid_ch, groups=cfg.norm_num_groups, dtype=self.dtype,
-                         name="mid_block_resnets_1")(x, temb)
+        x = KResnetBlock(mid_ch, groups=cfg.norm_num_groups, act=cfg.act,
+                         dtype=self.dtype, name="mid_block_resnets_1")(x, temb)
 
         for b, out_ch in enumerate(reversed(cfg.block_out_channels)):
             rev = len(cfg.block_out_channels) - 1 - b

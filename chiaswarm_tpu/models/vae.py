@@ -65,8 +65,7 @@ class Encoder(nn.Module):
 
         for b, out_ch in enumerate(cfg.block_out_channels):
             for i in range(cfg.layers_per_block):
-                x = ResnetBlock2D(
-                    out_ch, dtype=self.dtype, name=f"down_blocks_{b}_resnets_{i}"
+                x = ResnetBlock2D(out_ch, eps=1e-6, dtype=self.dtype, name=f"down_blocks_{b}_resnets_{i}"
                 )(x)
             if b != len(cfg.block_out_channels) - 1:
                 x = Downsample2D(
@@ -77,9 +76,9 @@ class Encoder(nn.Module):
                 )(x)
 
         mid_ch = cfg.block_out_channels[-1]
-        x = ResnetBlock2D(mid_ch, dtype=self.dtype, name="mid_block_resnets_0")(x)
+        x = ResnetBlock2D(mid_ch, eps=1e-6, dtype=self.dtype, name="mid_block_resnets_0")(x)
         x = VAEAttention(mid_ch, dtype=self.dtype, name="mid_block_attentions_0")(x)
-        x = ResnetBlock2D(mid_ch, dtype=self.dtype, name="mid_block_resnets_1")(x)
+        x = ResnetBlock2D(mid_ch, eps=1e-6, dtype=self.dtype, name="mid_block_resnets_1")(x)
 
         x = nn.GroupNorm(32, epsilon=1e-6, dtype=self.dtype, name="conv_norm_out")(x)
         x = nn.silu(x)
@@ -102,14 +101,13 @@ class Decoder(nn.Module):
             mid_ch, (3, 3), padding=((1, 1), (1, 1)), dtype=self.dtype, name="conv_in"
         )(latents)
 
-        x = ResnetBlock2D(mid_ch, dtype=self.dtype, name="mid_block_resnets_0")(x)
+        x = ResnetBlock2D(mid_ch, eps=1e-6, dtype=self.dtype, name="mid_block_resnets_0")(x)
         x = VAEAttention(mid_ch, dtype=self.dtype, name="mid_block_attentions_0")(x)
-        x = ResnetBlock2D(mid_ch, dtype=self.dtype, name="mid_block_resnets_1")(x)
+        x = ResnetBlock2D(mid_ch, eps=1e-6, dtype=self.dtype, name="mid_block_resnets_1")(x)
 
         for b, out_ch in enumerate(reversed(cfg.block_out_channels)):
             for i in range(cfg.layers_per_block + 1):
-                x = ResnetBlock2D(
-                    out_ch, dtype=self.dtype, name=f"up_blocks_{b}_resnets_{i}"
+                x = ResnetBlock2D(out_ch, eps=1e-6, dtype=self.dtype, name=f"up_blocks_{b}_resnets_{i}"
                 )(x)
             if b != len(cfg.block_out_channels) - 1:
                 x = Upsample2D(

@@ -43,18 +43,64 @@ class VideoUNetConfig:
     temporal_pos_max: int = 32  # max frames the positional table supports
 
 
+def _sinusoidal_pe(n: int, dim: int, dtype) -> jnp.ndarray:
+    """diffusers SinusoidalPositionalEmbedding layout: sin/cos INTERLEAVED
+    (pe[:, 0::2]=sin, pe[:, 1::2]=cos) — converted attention weights were
+    trained against this layout, so the concatenated variant would silently
+    scramble positions."""
+    position = jnp.arange(n, dtype=jnp.float32)[:, None]
+    div = jnp.exp(
+        jnp.arange(0, dim, 2, dtype=jnp.float32) * (-jnp.log(10000.0) / dim)
+    )
+    args = position * div[None]
+    pe = jnp.zeros((n, dim), jnp.float32)
+    pe = pe.at[:, 0::2].set(jnp.sin(args))
+    pe = pe.at[:, 1::2].set(jnp.cos(args))
+    return pe.astype(dtype)
+
+
+class _TemporalBlock(nn.Module):
+    """diffusers motion BasicTransformerBlock: two temporal SELF-attentions
+    and a GEGLU FF, with the sinusoidal positions applied to the NORMED
+    input of each attention (positional_embeddings='sinusoidal')."""
+
+    channels: int
+    num_heads: int
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, hidden, pos):
+        c = self.channels
+        hd = c // self.num_heads
+        y = nn.LayerNorm(epsilon=1e-5, dtype=self.dtype, name="norm1")(hidden)
+        hidden = hidden + Attention(
+            self.num_heads, hd, c, dtype=self.dtype, name="attn1"
+        )(y + pos[None])
+        y = nn.LayerNorm(epsilon=1e-5, dtype=self.dtype, name="norm2")(hidden)
+        hidden = hidden + Attention(
+            self.num_heads, hd, c, dtype=self.dtype, name="attn2"
+        )(y + pos[None])
+        y = nn.LayerNorm(epsilon=1e-5, dtype=self.dtype, name="norm3")(hidden)
+        return hidden + FeedForward(c, dtype=self.dtype, name="ff")(y)
+
+
 class TemporalTransformer(nn.Module):
     """Self-attention over the frame axis at fixed spatial positions.
 
     Input [BF, H, W, C]; `num_frames` is the RUNTIME clip length (static at
     trace time), passed per call because jobs may request fewer frames than
     the configured maximum — deriving it from config would fold the CFG
-    uncond/cond halves into one clip.  Mirrors AnimateDiff's motion module
-    (temporal transformer + sinusoidal frame positions).
+    uncond/cond halves into one clip.
+
+    The graph IS diffusers' AnimateDiff motion module (group norm ->
+    proj_in -> temporal transformer blocks -> zero-init proj_out ->
+    residual), parameter-for-parameter, so real motion-adapter checkpoints
+    convert mechanically (conversion.py convert_motion_adapter).
     """
 
     channels: int
     num_heads: int = 8
+    num_layers: int = 1
     dtype: jnp.dtype = jnp.float32
 
     @nn.compact
@@ -70,27 +116,25 @@ class TemporalTransformer(nn.Module):
         # [B, F, H, W, C] -> [B*H*W, F, C]
         hidden = hidden.reshape(b, num_frames, h, w, c)
         hidden = hidden.transpose(0, 2, 3, 1, 4).reshape(b * h * w, num_frames, c)
+        hidden = nn.Dense(c, dtype=self.dtype, name="proj_in")(hidden)
 
-        pos = timestep_embedding(
-            jnp.arange(num_frames), c, flip_sin_to_cos=False, dtype=self.dtype
+        pos = _sinusoidal_pe(num_frames, c, self.dtype)
+        heads = self.num_heads if c % self.num_heads == 0 else max(
+            1, min(self.num_heads, c // 8)
         )
-        hidden = hidden + pos[None]
+        for i in range(self.num_layers):
+            hidden = _TemporalBlock(
+                c, heads, dtype=self.dtype, name=f"transformer_blocks_{i}"
+            )(hidden, pos)
 
-        heads = max(1, min(self.num_heads, c // 8))
-        hidden = hidden + Attention(
-            heads, c // heads, c, dtype=self.dtype, name="attn1"
-        )(nn.LayerNorm(epsilon=1e-5, dtype=self.dtype, name="norm1")(hidden))
-        hidden = hidden + FeedForward(c, dtype=self.dtype, name="ff")(
-            nn.LayerNorm(epsilon=1e-5, dtype=self.dtype, name="norm_ff")(hidden)
-        )
-
-        hidden = hidden.reshape(b, h, w, num_frames, c)
-        hidden = hidden.transpose(0, 3, 1, 2, 4).reshape(bf, h, w, c)
         # zero-init output projection: an unconverted motion module is a
         # no-op on the spatial model (AnimateDiff init convention)
         hidden = nn.Dense(
-            c, kernel_init=nn.initializers.zeros, dtype=self.dtype, name="proj_out"
+            c, kernel_init=nn.initializers.zeros, dtype=self.dtype,
+            name="proj_out",
         )(hidden)
+        hidden = hidden.reshape(b, h, w, num_frames, c)
+        hidden = hidden.transpose(0, 3, 1, 2, 4).reshape(bf, h, w, c)
         return residual + hidden
 
 

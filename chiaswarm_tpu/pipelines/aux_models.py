@@ -208,3 +208,118 @@ def get_pose_estimator(model_name: str | None = None) -> PoseEstimator:
 def estimate_pose(image, model_name: str | None = None) -> np.ndarray:
     """PIL image -> [18, 3] (x, y, confidence) keypoints."""
     return get_pose_estimator(model_name)(image)
+
+
+# --- HED edges (scribble / softedge preprocessor backend) ---
+
+_HED: dict[str, "HEDDetector"] = {}
+_HED_LOCK = threading.Lock()
+
+DEFAULT_HED_MODEL = "lllyasviel/Annotators"
+_HED_SIZE = 512  # fully convolutional; fixed processing canvas = one program
+
+
+class HEDDetector:
+    """Resident HED edge net (reference controlnet.py:51-57's HEDdetector).
+    Returns soft edge probabilities [H, W] in [0, 1] at the ORIGINAL size."""
+
+    def __init__(self, model_name: str = DEFAULT_HED_MODEL,
+                 allow_random_init: bool = False):
+        import jax
+        import jax.numpy as jnp
+
+        from ..models.hed import HEDConfig, HEDNet, TINY_HED
+        from ..settings import load_settings
+        from ..weights import is_test_model, require_weights_present
+
+        self.model_name = model_name
+        self.config = TINY_HED if is_test_model(model_name) else HEDConfig()
+        on_tpu = jax.default_backend() == "tpu"
+        self.dtype = jnp.bfloat16 if on_tpu else jnp.float32
+        self.model = HEDNet(self.config, dtype=self.dtype)
+
+        root = Path(load_settings().model_root_dir).expanduser()
+        model_dir = root / model_name
+        params = None
+        if model_dir.is_dir():
+            try:
+                params = self._load_converted(model_dir)
+            except FileNotFoundError:
+                params = None
+        if params is None:
+            require_weights_present(
+                model_name, model_dir if model_dir.is_dir() else None,
+                allow_random_init, component="HED edge model",
+            )
+            params = self.model.init(
+                jax.random.key(zlib.crc32(model_name.encode())),
+                jnp.zeros((1, 64, 64, 3)),
+            )["params"]
+        cast = lambda x: jnp.asarray(x, self.dtype)
+        self.params = jax.tree_util.tree_map(cast, params)
+        self._program = jax.jit(
+            lambda p, px: self.model.apply({"params": p}, px)
+        )
+
+    @staticmethod
+    def _load_converted(model_dir: Path):
+        """The Annotators repo ships ControlNetHED as a torch .pth pickle
+        (no safetensors) — convert whichever is present."""
+        from ..models.conversion import convert_hed, load_torch_state_dict
+
+        try:
+            return convert_hed(load_torch_state_dict(model_dir))
+        except FileNotFoundError:
+            for p in sorted(model_dir.glob("*HED*.pth")):
+                import torch
+
+                sd = torch.load(str(p), map_location="cpu", weights_only=True)
+                return convert_hed(
+                    {k: v.numpy() for k, v in sd.items()}
+                )
+            raise
+
+    def __call__(self, image) -> np.ndarray:
+        import jax.numpy as jnp
+        from PIL import Image
+
+        original = image.size
+        rgb = image.convert("RGB").resize((_HED_SIZE, _HED_SIZE), Image.BICUBIC)
+        px = jnp.asarray(
+            np.asarray(rgb, np.float32)[None], self.dtype
+        )
+        logits = self._program(self.params, px)
+        maps = []
+        for m in logits:
+            arr = np.asarray(m.astype(jnp.float32))[0, :, :, 0]
+            maps.append(
+                np.asarray(
+                    Image.fromarray(arr).resize(original, Image.BILINEAR),
+                    np.float32,
+                )
+            )
+        edge = 1.0 / (1.0 + np.exp(-np.mean(np.stack(maps), axis=0)))
+        return edge.astype(np.float32)
+
+
+def get_hed_detector(model_name: str | None = None,
+                     allow_random_init: bool = False) -> "HEDDetector":
+    name = model_name or DEFAULT_HED_MODEL
+    with _HED_LOCK:
+        det = _HED.get(name)
+        if det is None:
+            det = HEDDetector(name, allow_random_init=allow_random_init)
+            _HED[name] = det
+        return det
+
+
+def hed_edges(image, model_name: str | None = None):
+    """PIL -> [H, W] float32 soft-edge probabilities, or None when no
+    converted HED weights are on this worker (callers degrade to the
+    classical heuristic with a logged warning)."""
+    from ..weights import MissingWeightsError
+
+    try:
+        return get_hed_detector(model_name)(image)
+    except MissingWeightsError:
+        return None

@@ -32,7 +32,12 @@ import numpy as np
 from PIL import Image
 
 from ..models.t5 import TINY_T5, T5Config, T5Encoder
-from ..models.unet2d import UNet2DConditionModel, UNet2DConfig
+from ..models.unet_kandinsky import (
+    IF_UNET,
+    TINY_IF_SR_UNET,
+    TINY_IF_UNET,
+    K22UNet,
+)
 from ..parallel.mesh import make_mesh, replicated
 from ..registry import register_family
 from ..schedulers import get_scheduler
@@ -41,8 +46,9 @@ from ..weights import is_test_model, require_weights_present
 logger = logging.getLogger(__name__)
 
 _NO_CONVERSION_HINT = (
-    "This worker cannot serve real DeepFloyd IF weights yet; only the "
-    "test/tiny IF cascade is available."
+    "DeepFloyd IF weights were not found under the model root; run "
+    "`chiaswarm-tpu-init --download` to fetch and convert them (the "
+    "cascade needs BOTH the IF-I and matching IF-II repos)."
 )
 
 # stage II upsamples the base canvas by this factor
@@ -52,52 +58,87 @@ SR_FACTOR = 4
 _is_tiny = is_test_model
 
 
-# IF-I geometry (DeepFloyd/IF-I-XL analog, approximated): pixel-space UNet,
-# T5 cross-attention
-IF_BASE_UNET = UNet2DConfig(
-    in_channels=3,
-    out_channels=3,
+# Real IF geometry analogs (conversion re-derives the true numbers from
+# the checkpoints; see models/unet_kandinsky.py — IF shares the
+# ResnetDownsample/SimpleCrossAttn block family with Kandinsky 2.2)
+import dataclasses as _dc
+
+IF_SR_UNET = _dc.replace(
+    IF_UNET,
+    in_channels=6,
     block_out_channels=(320, 640, 1280, 1280),
-    transformer_layers=(0, 1, 1, 1),
-    num_attention_heads=(5, 10, 20, 20),
-    cross_attention_dim=4096,
-)
-# IF-II: 6ch input (noise + upsampled base image)
-IF_SR_UNET = UNet2DConfig(
-    in_channels=6,
-    out_channels=3,
-    block_out_channels=(128, 256, 512, 1024),
-    transformer_layers=(0, 0, 1, 1),
-    num_attention_heads=(2, 4, 8, 16),
-    cross_attention_dim=4096,
-)
-TINY_IF_BASE = UNet2DConfig(
-    in_channels=3,
-    out_channels=3,
-    block_out_channels=(32, 64),
-    transformer_layers=(1, 1),
-    mid_transformer_layers=1,
-    layers_per_block=1,
-    num_attention_heads=4,
-    cross_attention_dim=32,
-)
-TINY_IF_SR = UNet2DConfig(
-    in_channels=6,
-    out_channels=3,
-    block_out_channels=(32, 64),
-    transformer_layers=(0, 1),
-    mid_transformer_layers=1,
-    layers_per_block=1,
-    num_attention_heads=4,
-    cross_attention_dim=32,
+    class_embed_timestep=True,
 )
 
 
 def _configs(model_name: str):
     """(base_cfg, sr_cfg, t5_cfg, base_size)."""
     if _is_tiny(model_name):
-        return TINY_IF_BASE, TINY_IF_SR, TINY_T5, 32
-    return IF_BASE_UNET, IF_SR_UNET, T5Config(), 64
+        return TINY_IF_UNET, TINY_IF_SR_UNET, TINY_T5, 32
+    return IF_UNET, IF_SR_UNET, T5Config(), 64
+
+
+def _sr_name_for(base_name: str) -> str:
+    """DeepFloyd/IF-I-XL-v1.0 -> the matching stage-II repo (IF-II tops
+    out at L, so XL maps to L)."""
+    if "IF-I-XL" in base_name:
+        return base_name.replace("IF-I-XL", "IF-II-L")
+    return base_name.replace("IF-I-", "IF-II-")
+
+
+def _model_dir(model_name: str):
+    from ..weights import model_dir_for
+
+    return model_dir_for(model_name)
+
+
+def _load_converted_if(model_name: str):
+    """-> {"base_cfg","base","sr_cfg","sr","t5","model_dir"} or None.
+    All-or-nothing: the cascade needs IF-I unet + T5 + IF-II unet; a
+    partial set would serve one real stage against one random stage."""
+    if _is_tiny(model_name):
+        return None
+    d = _model_dir(model_name)
+    sr_d = _model_dir(_sr_name_for(model_name))
+    if d is None:
+        return None
+    from ..models.conversion import (
+        convert_kandinsky_unet,
+        convert_t5,
+        load_torch_state_dict,
+    )
+    from ..weights import MissingWeightsError
+
+    def unet_cfg_json(mdir):
+        import json
+
+        p = mdir / "unet" / "config.json"
+        return json.loads(p.read_text()) if p.is_file() else {}
+
+    try:
+        base_cfg, base = convert_kandinsky_unet(
+            load_torch_state_dict(d, "unet"), unet_cfg_json(d)
+        )
+        t5 = convert_t5(load_torch_state_dict(d, "text_encoder"))
+        if sr_d is None:
+            raise FileNotFoundError(
+                f"stage-II repo {_sr_name_for(model_name)} not downloaded"
+            )
+        sr_cfg, sr = convert_kandinsky_unet(
+            load_torch_state_dict(sr_d, "unet"), unet_cfg_json(sr_d)
+        )
+    except (FileNotFoundError, OSError):
+        return None
+    except Exception as e:
+        raise MissingWeightsError(
+            f"checkpoint under {d} could not be converted for "
+            f"'{model_name}': {e}"
+        ) from e
+    return {
+        "base_cfg": base_cfg, "base": base,
+        "sr_cfg": sr_cfg, "sr": sr,
+        "t5": t5, "model_dir": d,
+    }
 
 
 class DeepFloydIFPipeline:
@@ -105,21 +146,28 @@ class DeepFloydIFPipeline:
 
     def __init__(self, model_name: str, chipset=None,
                  allow_random_init: bool = False):
-        require_weights_present(
-            model_name, None, allow_random_init, component="DeepFloyd IF",
-            hint=_NO_CONVERSION_HINT,
-        )
         self.model_name = model_name
         self.chipset = chipset
         base_cfg, sr_cfg, t5_cfg, self.base_size = _configs(model_name)
+        converted = _load_converted_if(model_name)
+        if converted is None:
+            require_weights_present(
+                model_name, None, allow_random_init, component="DeepFloyd IF",
+                hint=_NO_CONVERSION_HINT,
+            )
+        else:
+            base_cfg = converted["base_cfg"]
+            sr_cfg = converted["sr_cfg"]
         on_tpu = jax.default_backend() == "tpu"
         self.dtype = jnp.bfloat16 if on_tpu else jnp.float32
-        self.base_unet = UNet2DConditionModel(base_cfg, dtype=self.dtype)
-        self.sr_unet = UNet2DConditionModel(sr_cfg, dtype=self.dtype)
+        self.base_unet = K22UNet(base_cfg, dtype=self.dtype)
+        self.sr_unet = K22UNet(sr_cfg, dtype=self.dtype)
         self.t5 = T5Encoder(t5_cfg, dtype=self.dtype)
         from .flux import _load_t5_tokenizer
 
-        self.tokenizer = _load_t5_tokenizer(None, t5_cfg.vocab_size)
+        self.tokenizer = _load_t5_tokenizer(
+            converted["model_dir"] if converted else None, t5_cfg.vocab_size
+        )
         self.mesh = (
             chipset.mesh() if chipset is not None else make_mesh(jax.devices()[:1])
         )
@@ -127,22 +175,37 @@ class DeepFloydIFPipeline:
         rng = jax.random.key(zlib.crc32(model_name.encode()))
         k1, k2, k3 = jax.random.split(rng, 3)
         hw = 2 ** max(len(base_cfg.block_out_channels) - 1, 2)
+        base_args = (
+            jnp.zeros((1, hw, hw, base_cfg.in_channels)),
+            jnp.zeros((1,)),
+            jnp.zeros((1, 77, base_cfg.encoder_hid_dim)),
+        )
+        sr_args = (
+            jnp.zeros((1, hw, hw, sr_cfg.in_channels)),
+            jnp.zeros((1,)),
+            jnp.zeros((1, 77, sr_cfg.encoder_hid_dim)),
+        )
         with jax.default_device(jax.local_devices(backend="cpu")[0]):
-            base_params = self.base_unet.init(
-                k1,
-                jnp.zeros((1, hw, hw, 3)),
-                jnp.zeros((1,)),
-                jnp.zeros((1, 77, base_cfg.cross_attention_dim)),
-            )["params"]
-            sr_params = self.sr_unet.init(
-                k2,
-                jnp.zeros((1, hw, hw, 6)),
-                jnp.zeros((1,)),
-                jnp.zeros((1, 77, sr_cfg.cross_attention_dim)),
-            )["params"]
-            t5_params = self.t5.init(
-                k3, jnp.zeros((1, 16), jnp.int32)
-            )["params"]
+            if converted is not None:
+                from ..models.conversion import checked_converted as _checked_converted
+
+                base_params = _checked_converted(
+                    self.base_unet, base_args, converted["base"], "base", k1
+                )
+                sr_params = _checked_converted(
+                    self.sr_unet, sr_args, converted["sr"], "sr", k2
+                )
+                t5_params = _checked_converted(
+                    self.t5, (jnp.zeros((1, 16), jnp.int32),),
+                    converted["t5"], "t5", k3,
+                )
+                logger.info("loaded converted IF weights for %s", model_name)
+            else:
+                base_params = self.base_unet.init(k1, *base_args)["params"]
+                sr_params = self.sr_unet.init(k2, *sr_args)["params"]
+                t5_params = self.t5.init(
+                    k3, jnp.zeros((1, 16), jnp.int32)
+                )["params"]
         cast = lambda x: jnp.asarray(x, self.dtype)
         self.params = jax.device_put(
             jax.tree_util.tree_map(cast, {
@@ -209,6 +272,9 @@ class DeepFloydIFPipeline:
                     jnp.broadcast_to(t, (2 * batch,)),
                     context,
                 ).astype(jnp.float32)
+                # learned-variance checkpoints emit 6 channels; the DDPM
+                # step here is fixed-variance, so keep the pixel half
+                pred = pred[..., :3]
                 pred_u, pred_c = jnp.split(pred, 2, axis=0)
                 return pred_u + guidance * (pred_c - pred_u)
 
@@ -234,6 +300,7 @@ class DeepFloydIFPipeline:
                     jnp.broadcast_to(t, (2 * batch,)),
                     context,
                 ).astype(jnp.float32)
+                pred = pred[..., :3]
                 pred_u, pred_c = jnp.split(pred, 2, axis=0)
                 return pred_u + guidance * (pred_c - pred_u)
 

@@ -70,12 +70,9 @@ def _decoder_configs(model_name: str):
 
 
 def _model_dir(model_name: str):
-    from pathlib import Path
+    from ..weights import model_dir_for
 
-    from ..settings import load_settings
-
-    d = Path(load_settings().model_root_dir).expanduser() / model_name
-    return d if d.is_dir() else None
+    return model_dir_for(model_name)
 
 
 def convert_decoder_checkpoint(model_dir):
@@ -162,21 +159,9 @@ def _load_converted_prior(model_name: str):
 
 
 def _checked_converted(module, example_args, converted, prefix, rng):
-    """Shape-check a converted tree against the module via eval_shape (no
-    materialized random init) and return it; geometry mismatches surface as
-    MissingWeightsError naming the component."""
-    from ..models.conversion import assert_tree_shapes_match
-    from ..weights import MissingWeightsError
+    from ..models.conversion import checked_converted
 
-    expected = jax.eval_shape(module.init, rng, *example_args)["params"]
-    try:
-        assert_tree_shapes_match(converted, expected, prefix=prefix)
-    except ValueError as e:
-        raise MissingWeightsError(
-            f"converted checkpoint does not match the {prefix} "
-            f"architecture: {e}"
-        ) from None
-    return converted
+    return checked_converted(module, example_args, converted, prefix, rng)
 
 
 def _prior_name_for(decoder_name: str) -> str:

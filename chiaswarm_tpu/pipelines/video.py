@@ -44,6 +44,59 @@ logger = logging.getLogger(__name__)
 DEFAULT_FPS = 8
 VID2VID_CHUNK = 8  # frames per batched img2img program call
 
+# the adapter AnimateDiff jobs get unless the job names one (reference
+# tx2vid.py:26-36 hard-codes the same default)
+DEFAULT_MOTION_ADAPTER = "guoyww/animatediff-motion-adapter-v1-5-2"
+
+
+def _model_dir(model_name: str):
+    from ..weights import model_dir_for
+
+    return model_dir_for(model_name)
+
+
+def _load_converted_video(model_name: str, motion_adapter: str | None):
+    """-> {"unet","text","vae","model_dir"} or None. AnimateDiff's
+    composition: an SD1.5-family spatial UNet checkpoint overlaid with a
+    MotionAdapter's temporal modules, plus the checkpoint's CLIP/VAE —
+    all-or-nothing (spatial weights with random temporal modules are a
+    no-op video model; the reverse hallucinates)."""
+    name = model_name.lower()
+    if "tiny" in name or name.startswith("test/"):
+        return None
+    d = _model_dir(model_name)
+    adapter_dir = _model_dir(motion_adapter or DEFAULT_MOTION_ADAPTER)
+    if d is None:
+        return None
+    from ..models.conversion import (
+        convert_clip,
+        convert_vae,
+        convert_video_unet,
+        load_torch_state_dict,
+    )
+    from ..weights import MissingWeightsError
+
+    try:
+        if adapter_dir is None:
+            raise FileNotFoundError(
+                f"motion adapter {motion_adapter or DEFAULT_MOTION_ADAPTER} "
+                "not downloaded"
+            )
+        unet = convert_video_unet(
+            load_torch_state_dict(d, "unet"),
+            load_torch_state_dict(adapter_dir),
+        )
+        text = convert_clip(load_torch_state_dict(d, "text_encoder"))
+        vae = convert_vae(load_torch_state_dict(d, "vae"))
+    except (FileNotFoundError, OSError):
+        return None
+    except Exception as e:
+        raise MissingWeightsError(
+            f"checkpoint under {d} could not be converted for "
+            f"'{model_name}': {e}"
+        ) from e
+    return {"unet": unet, "text": text, "vae": vae, "model_dir": d}
+
 
 def _replace(cfg: UNet2DConfig, **kw) -> UNet2DConfig:
     import dataclasses
@@ -73,21 +126,33 @@ class VideoPipeline:
     """Resident motion-module pipeline; serves txt2vid and img2vid."""
 
     def __init__(self, model_name: str, chipset=None, image_conditioned=False,
-                 allow_random_init: bool = False):
-        # no weight-conversion path exists for motion checkpoints yet, so a
-        # non-test model without opt-in is a fatal job error, not silent
-        # random-weight video (weights.py policy)
+                 allow_random_init: bool = False, motion_adapter=None):
         from ..weights import require_weights_present
 
-        require_weights_present(
-            model_name, None, allow_random_init,
-            component="video model",
-            hint="This worker cannot serve real video-model weights yet; "
-                 "only test/tiny video models are available.",
-        )
         self.model_name = model_name
         self.chipset = chipset
         self.image_conditioned = image_conditioned
+        # img2vid (SVD-style 8ch conditioning) has no conversion path yet;
+        # txt2vid serves real AnimateDiff weights (spatial SD1.5 checkpoint
+        # + motion adapter)
+        self._loaded_adapter = (
+            (motion_adapter or DEFAULT_MOTION_ADAPTER)
+            if not image_conditioned
+            else None
+        )
+        self._converted = (
+            None
+            if image_conditioned
+            else _load_converted_video(model_name, motion_adapter)
+        )
+        if self._converted is None:
+            require_weights_present(
+                model_name, None, allow_random_init,
+                component="video model",
+                hint="Video weights were not found under the model root; "
+                     "AnimateDiff serving needs the base SD checkpoint AND "
+                     "the motion adapter downloaded (initialize --download).",
+            )
         video_cfg, clip_cfg, vae_cfg, self.default_size = _video_configs(model_name)
         if image_conditioned:
             # SVD layout: noise latents + conditioning-frame latents stacked
@@ -103,7 +168,10 @@ class VideoPipeline:
         self.unet = VideoUNet(video_cfg, dtype=self.dtype)
         self.text_encoder = CLIPTextEncoder(clip_cfg, dtype=self.dtype)
         self.vae = AutoencoderKL(vae_cfg, dtype=self.dtype)
-        self.tokenizer = load_tokenizer(None, vocab_size=clip_cfg.vocab_size)
+        self.tokenizer = load_tokenizer(
+            self._converted["model_dir"] if self._converted else None,
+            vocab_size=clip_cfg.vocab_size,
+        )
 
         t0 = time.perf_counter()
         self.params = self._init_params()
@@ -117,6 +185,44 @@ class VideoPipeline:
         from collections import OrderedDict
 
         self._lora_cache: OrderedDict[tuple, dict] = OrderedDict()
+
+    def _adapter_params(self, params: dict, motion_adapter) -> dict:
+        """Params with the REQUESTED adapter's temporal modules overlaid
+        (jobs may pin e.g. AnimateLCM instead of the resident default)."""
+        name = (
+            motion_adapter.get("model_name")
+            if isinstance(motion_adapter, dict)
+            else str(motion_adapter)
+        )
+        if not name or name == self._loaded_adapter:
+            return params
+        key = ("adapter", name)
+        if key in self._lora_cache:
+            self._lora_cache.move_to_end(key)
+            return self._lora_cache[key]
+        from ..models.conversion import (
+            convert_motion_adapter,
+            load_torch_state_dict,
+        )
+        from ..weights import MissingWeightsError
+
+        d = _model_dir(name)
+        if d is None:
+            raise MissingWeightsError(
+                f"motion adapter '{name}' is not downloaded; run "
+                f"initialize --download"
+            )
+        motion = convert_motion_adapter(load_torch_state_dict(d))
+        cast = lambda x: jnp.asarray(x, self.dtype)
+        unet = dict(params["unet"])
+        for k, sub in motion.items():
+            unet[k] = jax.tree_util.tree_map(cast, sub)
+        out = dict(params)
+        out["unet"] = unet
+        self._lora_cache[key] = out
+        while len(self._lora_cache) > 2:
+            self._lora_cache.popitem(last=False)
+        return out
 
     def _lora_params(self, base_params: dict, lora: dict, scale: float) -> dict:
         """Base params with a motion-LoRA merged into the video UNet
@@ -146,20 +252,43 @@ class VideoPipeline:
         k1, k2, k3 = jax.random.split(rng, 3)
         frames = self.config.num_frames
         hw = 2 ** max(len(self.config.base.block_out_channels), 3)
+        unet_args = (
+            jnp.zeros((frames, hw, hw, self.config.base.in_channels)),
+            jnp.zeros((frames,)),
+            jnp.zeros((frames, 77, self.config.base.cross_attention_dim)),
+        )
         with jax.default_device(jax.local_devices(backend="cpu")[0]):
-            unet_params = self.unet.init(
-                k1,
-                jnp.zeros((frames, hw, hw, self.config.base.in_channels)),
-                jnp.zeros((frames,)),
-                jnp.zeros((frames, 77, self.config.base.cross_attention_dim)),
-            )["params"]
-            text_params = self.text_encoder.init(
-                k2, jnp.zeros((1, 77), jnp.int32)
-            )["params"]
-            vae_params = self.vae.init(
-                k3,
-                jnp.zeros((1, hw * self.latent_factor, hw * self.latent_factor, 3)),
-            )["params"]
+            if self._converted is not None:
+                from ..models.conversion import checked_converted as _checked_converted
+
+                unet_params = _checked_converted(
+                    self.unet, unet_args, self._converted["unet"], "unet", k1
+                )
+                text_params = _checked_converted(
+                    self.text_encoder, (jnp.zeros((1, 77), jnp.int32),),
+                    self._converted["text"], "text", k2,
+                )
+                vae_params = _checked_converted(
+                    self.vae,
+                    (jnp.zeros((1, hw * self.latent_factor,
+                                hw * self.latent_factor, 3)),),
+                    self._converted["vae"], "vae", k3,
+                )
+                logger.info(
+                    "loaded converted AnimateDiff weights for %s",
+                    self.model_name,
+                )
+            else:
+                unet_params = self.unet.init(k1, *unet_args)["params"]
+                text_params = self.text_encoder.init(
+                    k2, jnp.zeros((1, 77), jnp.int32)
+                )["params"]
+                vae_params = self.vae.init(
+                    k3,
+                    jnp.zeros(
+                        (1, hw * self.latent_factor, hw * self.latent_factor, 3)
+                    ),
+                )["params"]
         cast = lambda x: jnp.asarray(x, self.dtype)
         return jax.tree_util.tree_map(
             cast, {"unet": unet_params, "text": text_params, "vae": vae_params}
@@ -232,11 +361,12 @@ class VideoPipeline:
             raise Exception(f"pipeline {self.model_name} was evicted; resubmit")
         timings = {}
         # requested AnimateDiff/LCM motion adapter (reference tx2vid.py:26-36
-        # loads it onto the torch UNet per job). The resident VideoUNet's
-        # temporal modules ARE the motion adapter slot; which checkpoint
-        # fills them is decided at weight-conversion time, so the request is
-        # recorded for observability rather than silently dropped.
+        # loads it onto the torch UNet per job). With converted weights the
+        # requested adapter's temporal modules overlay the resident tree;
+        # tiny/random pipelines record the request for observability.
         motion_adapter = kwargs.pop("motion_adapter", None)
+        if motion_adapter is not None and self._converted is not None:
+            params = self._adapter_params(params, motion_adapter)
         lora = kwargs.pop("lora", None)
         xattn_kwargs = kwargs.pop("cross_attention_kwargs", {}) or {}
         lora_scale = float(

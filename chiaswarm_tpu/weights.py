@@ -61,3 +61,14 @@ def require_weights_present(
         f"{component} weights for '{model_name}' are not present on this "
         f"worker{where}. {hint}"
     )
+
+
+def model_dir_for(model_name: str):
+    """The downloaded checkpoint dir under the model root, or None — the
+    one resolution every pipeline family shares."""
+    from pathlib import Path
+
+    from .settings import load_settings
+
+    d = Path(load_settings().model_root_dir).expanduser() / model_name
+    return d if d.is_dir() else None

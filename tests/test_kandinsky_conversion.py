@@ -40,6 +40,9 @@ def _to_torch_name(parts, subs):
     for p in parts[:-1]:
         comps.append(re.sub(r"_(\d+)(?=_|$)", r".\1", p))
     name = ".".join(comps)
+    # TimestepEmbedding layers are literally named linear_1/linear_2 in
+    # diffusers — the digit regex must not split them
+    name = name.replace("linear.1", "linear_1").replace("linear.2", "linear_2")
     for src, dst in subs:
         name = name.replace(src, dst)
     return name
@@ -277,3 +280,105 @@ def test_verify_local_model_checks_kandinsky(sdaas_root, tmp_path):
 
 def _flatten_state(state):
     return {k: np.ascontiguousarray(v) for k, v in state.items()}
+
+
+# --- DeepFloyd IF (same K-block family, text conditioning) ---
+
+IF_SUBS = [
+    ("aug_emb_norm1", "add_embedding.norm1"),
+    ("aug_emb_norm2", "add_embedding.norm2"),
+    ("aug_emb_pool", "add_embedding.pool"),
+    ("aug_emb_proj", "add_embedding.proj"),
+    ("hid_proj", "encoder_hid_proj"),
+    ("mid_block_resnets", "mid_block.resnets"),
+    ("mid_block_attentions", "mid_block.attentions"),
+]
+
+
+def _if_params(cfg):
+    from chiaswarm_tpu.models.unet_kandinsky import K22UNet
+
+    unet = K22UNet(cfg)
+    return unet.init(
+        jax.random.key(5),
+        jnp.zeros((1, 8, 8, cfg.in_channels)),
+        jnp.zeros((1,)),
+        jnp.zeros((1, 6, cfg.encoder_hid_dim)),
+    )["params"]
+
+
+def test_if_unet_roundtrip_exact():
+    import dataclasses
+
+    from chiaswarm_tpu.models.unet_kandinsky import TINY_IF_UNET
+
+    params = _if_params(TINY_IF_UNET)
+    state = _synth_state(params, IF_SUBS)
+    cfg, converted = convert_kandinsky_unet(
+        state, {"attention_head_dim": TINY_IF_UNET.attention_head_dim,
+                "norm_num_groups": TINY_IF_UNET.norm_num_groups,
+                "act_fn": "gelu", "addition_embed_type_num_heads": 4},
+    )
+    assert cfg.conditioning == "text"
+    assert cfg.act == "gelu"
+    assert not cfg.class_embed_timestep
+    # token count is an image-mode concept; text mode infers 0
+    assert cfg == dataclasses.replace(TINY_IF_UNET, image_proj_tokens=0)
+    _assert_trees_equal(
+        converted, jax.tree_util.tree_map(lambda x: np.asarray(x), params)
+    )
+
+
+def test_if_sr_unet_roundtrip_detects_class_embed():
+    from chiaswarm_tpu.models.unet_kandinsky import TINY_IF_SR_UNET
+
+    params = _if_params(TINY_IF_SR_UNET)
+    state = _synth_state(params, IF_SUBS)
+    cfg, converted = convert_kandinsky_unet(
+        state, {"attention_head_dim": TINY_IF_SR_UNET.attention_head_dim,
+                "norm_num_groups": TINY_IF_SR_UNET.norm_num_groups,
+                "act_fn": "gelu", "addition_embed_type_num_heads": 4},
+    )
+    assert cfg.class_embed_timestep
+    assert cfg.in_channels == 6
+    _assert_trees_equal(
+        converted, jax.tree_util.tree_map(lambda x: np.asarray(x), params)
+    )
+
+
+def test_sr_name_mapping():
+    from chiaswarm_tpu.pipelines.deepfloyd import _sr_name_for
+
+    assert _sr_name_for("DeepFloyd/IF-I-XL-v1.0") == "DeepFloyd/IF-II-L-v1.0"
+    assert _sr_name_for("DeepFloyd/IF-I-M-v1.0") == "DeepFloyd/IF-II-M-v1.0"
+
+
+def test_verify_local_model_checks_deepfloyd(sdaas_root, tmp_path):
+    """--check validates an IF repo (stage-II layout with class embedding)
+    through the same conversion the cascade serving path loads."""
+    import json
+
+    from safetensors.numpy import save_file
+
+    from chiaswarm_tpu.initialize import verify_local_model
+    from chiaswarm_tpu.models.unet_kandinsky import TINY_IF_SR_UNET
+    from chiaswarm_tpu.settings import Settings, save_settings
+
+    model_root = tmp_path / "models"
+    name = "DeepFloyd/IF-II-M-v1.0"
+    unet_dir = model_root / name / "unet"
+    unet_dir.mkdir(parents=True)
+    save_settings(Settings(model_root_dir=str(model_root)))
+    params = _if_params(TINY_IF_SR_UNET)
+    save_file(
+        _flatten_state(_synth_state(params, IF_SUBS)),
+        str(unet_dir / "model.safetensors"),
+    )
+    (unet_dir / "config.json").write_text(json.dumps({
+        "attention_head_dim": TINY_IF_SR_UNET.attention_head_dim,
+        "norm_num_groups": TINY_IF_SR_UNET.norm_num_groups,
+        "act_fn": "gelu",
+        "addition_embed_type_num_heads": 4,
+    }))
+    out = verify_local_model(name, model_root)
+    assert out is not None and out["unet"] > 0 and "t5" not in out

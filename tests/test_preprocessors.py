@@ -119,3 +119,70 @@ def test_center_crop_alias():
 def test_unknown_preprocessor_raises():
     with pytest.raises(ValueError, match="Unknown or unavailable"):
         preprocess_image(_image(0), "frobnicate", "cpu:0")
+
+
+class TestHED:
+    def test_conversion_roundtrip(self):
+        import jax
+        import jax.numpy as jnp
+
+        from chiaswarm_tpu.models.conversion import convert_hed
+        from chiaswarm_tpu.models.hed import HEDNet, TINY_HED
+
+        net = HEDNet(TINY_HED)
+        params = net.init(jax.random.key(0), jnp.zeros((1, 32, 32, 3)))["params"]
+        # synthesize the checkpoint layout (norm, blockN.convs.M, projection)
+        state = {"norm": np.asarray(params["norm"], np.float32)}
+        for bi in range(len(TINY_HED.channels)):
+            blk = params[f"block{bi + 1}"]
+            for ci in range(TINY_HED.layers[bi]):
+                k = np.asarray(blk[f"convs_{ci}"]["kernel"], np.float32)
+                state[f"block{bi + 1}.convs.{ci}.weight"] = (
+                    np.ascontiguousarray(k.transpose(3, 2, 0, 1))
+                )
+                state[f"block{bi + 1}.convs.{ci}.bias"] = np.asarray(
+                    blk[f"convs_{ci}"]["bias"], np.float32
+                )
+            pk = np.asarray(blk["projection"]["kernel"], np.float32)
+            state[f"block{bi + 1}.projection.weight"] = np.ascontiguousarray(
+                pk.transpose(3, 2, 0, 1)
+            )
+            state[f"block{bi + 1}.projection.bias"] = np.asarray(
+                blk["projection"]["bias"], np.float32
+            )
+        converted = convert_hed(state)
+        flat_a = jax.tree_util.tree_leaves(converted)
+        flat_b = jax.tree_util.tree_leaves(params)
+        assert len(flat_a) == len(flat_b)
+        for a, b in zip(
+            jax.tree_util.tree_leaves_with_path(converted),
+            jax.tree_util.tree_leaves_with_path(params),
+        ):
+            np.testing.assert_allclose(
+                np.asarray(a[1], np.float32), np.asarray(b[1], np.float32),
+                rtol=1e-6, err_msg=str(a[0]),
+            )
+
+    def test_scribble_differs_from_softedge_with_hed(self, monkeypatch):
+        # with a (stubbed) HED map, scribble is thinned binary, softedge is
+        # the soft map — the round-2 complaint was that both were one fn
+        from chiaswarm_tpu.pipelines import aux_models
+        from chiaswarm_tpu.pre_processors import controlnet as pp
+
+        rng = np.random.default_rng(0)
+        soft = rng.random((48, 48)).astype(np.float32)
+        monkeypatch.setattr(aux_models, "hed_edges", lambda img: soft)
+        img = Image.fromarray((rng.random((48, 48, 3)) * 255).astype(np.uint8))
+        s = np.asarray(pp.preprocess_image(img, "scribble", "cpu:0"))
+        e = np.asarray(pp.preprocess_image(img, "softedge", "cpu:0"))
+        assert set(np.unique(s)).issubset({0, 255})  # thinned binary
+        assert len(np.unique(e)) > 2  # soft probabilities
+        assert not np.array_equal(s, e)
+
+    def test_fallback_without_weights(self, sdaas_root):
+        # no converted HED weights: the classical heuristic serves the job
+        from chiaswarm_tpu.pre_processors import controlnet as pp
+
+        img = Image.new("RGB", (32, 32), (120, 50, 200))
+        out = pp.preprocess_image(img, "softedge", "cpu:0")
+        assert out.size == (32, 32)
