@@ -101,7 +101,11 @@ TINY_IF_SR_UNET = dataclasses.replace(
 
 
 def _act(name: str):
-    return nn.gelu if name == "gelu" else nn.silu
+    if name == "gelu":
+        # erf gelu, diffusers parity (approximate=True would silently
+        # diverge from converted IF checkpoints)
+        return lambda x: nn.gelu(x, approximate=False)
+    return nn.silu
 
 
 class KResnetBlock(nn.Module):
@@ -131,9 +135,11 @@ class KResnetBlock(nn.Module):
             h = jnp.repeat(jnp.repeat(h, 2, axis=1), 2, axis=2)
         h = nn.Conv(self.out_channels, (3, 3), padding=((1, 1), (1, 1)),
                     dtype=self.dtype, name="conv1")(h)
-        # scale_shift AdaGN: the projection emits [scale | shift]
+        # scale_shift AdaGN: the projection emits [scale | shift]; the temb
+        # nonlinearity is the BLOCK's act (diffusers ResnetBlock2D applies
+        # self.nonlinearity to temb, so IF uses gelu here too)
         t = nn.Dense(2 * self.out_channels, dtype=self.dtype,
-                     name="time_emb_proj")(nn.silu(temb))
+                     name="time_emb_proj")(act(temb))
         scale, shift = jnp.split(t[:, None, None, :], 2, axis=-1)
         h = nn.GroupNorm(self.groups, epsilon=1e-5, dtype=self.dtype,
                          name="norm2")(h)

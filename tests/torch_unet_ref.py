@@ -479,3 +479,210 @@ class AutoencoderKLT(nn.Module):
     def decode_raw(self, latents):
         """Unscaled latents -> pixels."""
         return self.decoder(self.post_quant_conv(latents))
+
+
+# --- Kandinsky 2.2 / DeepFloyd IF K-block family reference ---
+
+
+class KResnetT(nn.Module):
+    """ResnetBlock2D with time_embedding_norm='scale_shift' and optional
+    resnet-internal down/up sampling (diffusers ResnetDownsample/Upsample
+    blocks' resnets)."""
+
+    def __init__(self, in_ch, out_ch, temb_dim, down=False, up=False,
+                 groups=32, act="silu"):
+        super().__init__()
+        self.norm1 = nn.GroupNorm(groups, in_ch, eps=1e-5)
+        self.conv1 = nn.Conv2d(in_ch, out_ch, 3, padding=1)
+        self.time_emb_proj = nn.Linear(temb_dim, 2 * out_ch)
+        self.norm2 = nn.GroupNorm(groups, out_ch, eps=1e-5)
+        self.conv2 = nn.Conv2d(out_ch, out_ch, 3, padding=1)
+        if in_ch != out_ch:
+            self.conv_shortcut = nn.Conv2d(in_ch, out_ch, 1)
+        self._needs_shortcut = in_ch != out_ch
+        self._down, self._up = down, up
+        self._act = F.gelu if act == "gelu" else F.silu
+
+    def forward(self, x, temb):
+        h = self._act(self.norm1(x))
+        if self._down:
+            x = F.avg_pool2d(x, 2)
+            h = F.avg_pool2d(h, 2)
+        elif self._up:
+            x = F.interpolate(x, scale_factor=2.0, mode="nearest")
+            h = F.interpolate(h, scale_factor=2.0, mode="nearest")
+        h = self.conv1(h)
+        scale, shift = self.time_emb_proj(
+            self._act(temb)
+        )[:, :, None, None].chunk(2, dim=1)
+        h = self.norm2(h) * (1 + scale) + shift
+        h = self.conv2(self._act(h))
+        if self._needs_shortcut:
+            x = self.conv_shortcut(x)
+        return x + h
+
+
+class KAttnT(nn.Module):
+    """Attention with AttnAddedKVProcessor: token-space group norm, added
+    KV from the projected conditioning concatenated BEFORE self KV."""
+
+    def __init__(self, ch, heads, head_dim, cross_dim, groups=32):
+        super().__init__()
+        inner = heads * head_dim
+        self.heads, self.head_dim = heads, head_dim
+        self.group_norm = nn.GroupNorm(groups, ch, eps=1e-5)
+        self.to_q = nn.Linear(ch, inner)
+        self.to_k = nn.Linear(ch, inner)
+        self.to_v = nn.Linear(ch, inner)
+        self.add_k_proj = nn.Linear(cross_dim, inner)
+        self.add_v_proj = nn.Linear(cross_dim, inner)
+        self.to_out = nn.Sequential(nn.Linear(inner, ch), nn.Dropout(0.0))
+
+    def forward(self, x, context):
+        b, c, h, w = x.shape
+        tokens = x.view(b, c, h * w).transpose(1, 2)
+        norm = self.group_norm(tokens.transpose(1, 2)).transpose(1, 2)
+        shape = lambda t: t.view(b, t.shape[1], self.heads,
+                                 self.head_dim).transpose(1, 2)
+        q = shape(self.to_q(norm))
+        k = torch.cat([self.add_k_proj(context), self.to_k(norm)], dim=1)
+        v = torch.cat([self.add_v_proj(context), self.to_v(norm)], dim=1)
+        k, v = shape(k), shape(v)
+        wts = torch.softmax(q @ k.transpose(-1, -2) * self.head_dim**-0.5,
+                            dim=-1)
+        out = self.to_out((wts @ v).transpose(1, 2).reshape(b, h * w, -1))
+        return x + out.transpose(1, 2).view(b, c, h, w)
+
+
+class _KStage(nn.Module):
+    """One down/up stage; attribute names mirror the diffusers state dict
+    (resnets / attentions / downsamplers / upsamplers)."""
+
+    def __init__(self):
+        super().__init__()
+
+
+class K22UNetT(nn.Module):
+    """Torch mirror of the K2.2 decoder UNet with EXACT diffusers key
+    names, so convert_kandinsky_unet consumes its state dict directly."""
+
+    def __init__(self, cfg):
+        super().__init__()
+        self.cfg = cfg
+        blocks = cfg.block_out_channels
+        temb_dim = blocks[0] * 4
+        g = cfg.norm_num_groups
+        self.time_embedding = TimestepEmbeddingT(blocks[0], temb_dim)
+        self.add_embedding = nn.ModuleDict({
+            "image_proj": nn.Linear(cfg.encoder_hid_dim, temb_dim),
+            "image_norm": nn.LayerNorm(temb_dim),
+        })
+        self.encoder_hid_proj = nn.ModuleDict({
+            "image_embeds": nn.Linear(
+                cfg.encoder_hid_dim,
+                cfg.image_proj_tokens * cfg.cross_attention_dim,
+            ),
+            "norm": nn.LayerNorm(cfg.cross_attention_dim),
+        })
+        self.conv_in = nn.Conv2d(cfg.in_channels, blocks[0], 3, padding=1)
+
+        def attn(ch):
+            return KAttnT(ch, ch // cfg.attention_head_dim,
+                          cfg.attention_head_dim, cfg.cross_attention_dim,
+                          groups=g)
+
+        self.down_blocks = nn.ModuleList()
+        ch = blocks[0]
+        for b, out_ch in enumerate(blocks):
+            last = b == len(blocks) - 1
+            stage = _KStage()
+            stage.resnets = nn.ModuleList(
+                [KResnetT(ch if i == 0 else out_ch, out_ch, temb_dim,
+                          groups=g, act=cfg.act)
+                 for i in range(cfg.layers_per_block)]
+            )
+            if cfg.down_attention[b]:
+                stage.attentions = nn.ModuleList(
+                    [attn(out_ch) for _ in range(cfg.layers_per_block)]
+                )
+            if not last:
+                stage.downsamplers = nn.ModuleList(
+                    [KResnetT(out_ch, out_ch, temb_dim, down=True, groups=g,
+                              act=cfg.act)]
+                )
+            self.down_blocks.append(stage)
+            ch = out_ch
+        mid = blocks[-1]
+        self.mid_block = _KStage()
+        self.mid_block.resnets = nn.ModuleList(
+            [KResnetT(mid, mid, temb_dim, groups=g, act=cfg.act),
+             KResnetT(mid, mid, temb_dim, groups=g, act=cfg.act)]
+        )
+        self.mid_block.attentions = nn.ModuleList([attn(mid)])
+
+        skip_chs_all = [blocks[0]]
+        for b, out_ch in enumerate(blocks):
+            skip_chs_all += [out_ch] * cfg.layers_per_block
+            if b != len(blocks) - 1:
+                skip_chs_all.append(out_ch)
+        self.up_blocks = nn.ModuleList()
+        ch = blocks[-1]
+        for b, out_ch in enumerate(reversed(blocks)):
+            rev = len(blocks) - 1 - b
+            last = b == len(blocks) - 1
+            stage = _KStage()
+            resnets = nn.ModuleList()
+            for i in range(cfg.layers_per_block + 1):
+                skip = skip_chs_all.pop()
+                resnets.append(KResnetT(ch + skip, out_ch, temb_dim, groups=g,
+                                        act=cfg.act))
+                ch = out_ch
+            stage.resnets = resnets
+            if cfg.down_attention[rev]:
+                stage.attentions = nn.ModuleList(
+                    [attn(out_ch) for _ in range(cfg.layers_per_block + 1)]
+                )
+            if not last:
+                stage.upsamplers = nn.ModuleList(
+                    [KResnetT(out_ch, out_ch, temb_dim, up=True, groups=g,
+                              act=cfg.act)]
+                )
+            self.up_blocks.append(stage)
+        self.conv_norm_out = nn.GroupNorm(g, blocks[0], eps=1e-5)
+        self.conv_out = nn.Conv2d(blocks[0], cfg.out_channels, 3, padding=1)
+
+    def forward(self, sample, timesteps, image_embeds):
+        cfg = self.cfg
+        temb = self.time_embedding(
+            timestep_embedding_t(timesteps, cfg.block_out_channels[0])
+        )
+        temb = temb + self.add_embedding["image_norm"](
+            self.add_embedding["image_proj"](image_embeds)
+        )
+        ctx = self.encoder_hid_proj["image_embeds"](image_embeds).view(
+            -1, cfg.image_proj_tokens, cfg.cross_attention_dim
+        )
+        ctx = self.encoder_hid_proj["norm"](ctx)
+        x = self.conv_in(sample)
+        skips = [x]
+        for stage in self.down_blocks:
+            for i, resnet in enumerate(stage.resnets):
+                x = resnet(x, temb)
+                if hasattr(stage, "attentions"):
+                    x = stage.attentions[i](x, ctx)
+                skips.append(x)
+            if hasattr(stage, "downsamplers"):
+                x = stage.downsamplers[0](x, temb)
+                skips.append(x)
+        x = self.mid_block.resnets[0](x, temb)
+        x = self.mid_block.attentions[0](x, ctx)
+        x = self.mid_block.resnets[1](x, temb)
+        for stage in self.up_blocks:
+            for i, resnet in enumerate(stage.resnets):
+                x = torch.cat([x, skips.pop()], dim=1)
+                x = resnet(x, temb)
+                if hasattr(stage, "attentions"):
+                    x = stage.attentions[i](x, ctx)
+            if hasattr(stage, "upsamplers"):
+                x = stage.upsamplers[0](x, temb)
+        return self.conv_out(F.silu(self.conv_norm_out(x)))
