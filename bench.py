@@ -74,8 +74,8 @@ def init_backend():
     the CPU backend so a (labelled) number is still produced instead of
     rc=1/rc=124 with no metric.
     """
-    probe_budget = float(os.environ.get("BENCH_TPU_PROBE_TIMEOUT", "240"))
-    attempts = int(os.environ.get("BENCH_TPU_PROBE_ATTEMPTS", "2"))
+    probe_budget = float(os.environ.get("BENCH_TPU_PROBE_TIMEOUT", "300"))
+    attempts = int(os.environ.get("BENCH_TPU_PROBE_ATTEMPTS", "3"))
     tpu_ok = False
     for attempt in range(attempts):
         status = probe_tpu(probe_budget)
@@ -84,7 +84,9 @@ def init_backend():
         if status in ("tpu", "no-tpu"):
             break
         if attempt + 1 < attempts:
-            time.sleep(15)
+            # relay/plugin restarts have been observed to take minutes;
+            # back off harder each retry (VERDICT r03 weak #1)
+            time.sleep(30 * (attempt + 1))
 
     import jax
 
@@ -175,6 +177,10 @@ def main() -> None:
         "size": 1024 if on_tpu else 64,
         **extra,
     }
+    if not on_tpu:
+        # never let a CPU smoke number pass silently for a TPU datum
+        # (VERDICT r03: the artifact itself must say the TPU was missing)
+        out["tpu_unavailable"] = True
 
     if on_tpu:
         out.update(_warm_compile_probe(pipe, size, steps, batch))
@@ -206,7 +212,8 @@ def _warm_compile_probe(pipe, size, steps, batch) -> dict:
         return {"warm_compile_s": round(time.perf_counter() - t0, 1)}
     except Exception as e:
         sys.stderr.write(f"warm-compile probe failed: {e}\n")
-        return {}
+        # failure must be visible in the artifact, not just stderr
+        return {"warm_compile_s": f"failed: {type(e).__name__}: {e}"}
 
 
 def _secondary_rows(chipset, chips, xl_pipe) -> dict:
@@ -232,6 +239,7 @@ def _secondary_rows(chipset, chips, xl_pipe) -> dict:
         out["sdxl_controlnet_p50_job_s"] = round(p50, 3)
     except Exception as e:
         sys.stderr.write(f"controlnet row failed: {type(e).__name__}: {e}\n")
+        out["sdxl_controlnet_row"] = f"failed: {type(e).__name__}: {e}"
     try:
         xl_pipe.release()  # free HBM before the second model family
         sd21 = SDPipeline(
@@ -248,6 +256,7 @@ def _secondary_rows(chipset, chips, xl_pipe) -> dict:
         sd21.release()
     except Exception as e:
         sys.stderr.write(f"sd21 row failed: {type(e).__name__}: {e}\n")
+        out["sd21_768_row"] = f"failed: {type(e).__name__}: {e}"
     return out
 
 

@@ -111,7 +111,13 @@ class HiveClient:
             return ack
 
     async def get_models(self) -> list[dict]:
-        """Fetch the hive's model catalog; cached to models.json on success."""
+        """Fetch the hive's model catalog; cached to models.json on success.
+
+        Raises on network/auth/shape failure — the caller decides what a
+        missing catalog means (`initialize --download`, the sole caller
+        today, treats it as fatal rather than silently proceeding with
+        zero models).
+        """
         from .settings import save_file
 
         # normalize whether we were handed the API base ({uri}/api, as Worker
@@ -120,20 +126,17 @@ class HiveClient:
         models_url = (
             f"{base}/models" if base.endswith("/api") else f"{base}/api/models"
         )
-        try:
-            session = await self._get_session()
-            timeout = aiohttp.ClientTimeout(total=ASK_TIMEOUT_S)
-            async with session.get(
-                models_url,
-                headers={"user-agent": USER_AGENT},
-                timeout=timeout,
-            ) as response:
-                data = await response.json()
-                save_file(data, "models.json")
-                return data["language_models"] + data["models"]
-        except Exception as e:
-            logger.warning("failed to fetch model list: %s", e)
-            return []
+        session = await self._get_session()
+        timeout = aiohttp.ClientTimeout(total=ASK_TIMEOUT_S)
+        async with session.get(
+            models_url,
+            headers={"user-agent": USER_AGENT},
+            timeout=timeout,
+        ) as response:
+            response.raise_for_status()
+            data = await response.json()
+            save_file(data, "models.json")
+            return data["language_models"] + data["models"]
 
 
 # --- reference-signature wrappers (swarm/hive.py:9,50,69) ---
@@ -155,8 +158,19 @@ async def submit_result(settings, hive_uri: str, result: dict) -> dict:
         await client.close()
 
 
+class _AnonymousSettings:
+    """Settings stand-in for the unauthenticated model-catalog endpoint.
+
+    The reference's get_models (swarm/hive.py:69-88) sends no auth; the
+    catalog is public. A real class (not a type() one-liner) so the seam is
+    visible and testable.
+    """
+
+    sdaas_token = ""
+
+
 async def get_models(hive_uri: str) -> list[dict]:
-    client = HiveClient(type("S", (), {"sdaas_token": ""})(), hive_uri)
+    client = HiveClient(_AnonymousSettings(), hive_uri)
     try:
         return await client.get_models()
     finally:

@@ -593,7 +593,12 @@ async def init() -> int:
     if args.download or args.check:
         names = args.models
         if names is None:
-            names = await fetch_hive_model_list(settings)
+            try:
+                names = await fetch_hive_model_list(settings)
+            except Exception as e:
+                print(f"failed to fetch hive model list: {e}; "
+                      "pass --models explicitly")
+                return 1
             if not names:
                 print("hive returned no model list; pass --models explicitly")
                 return 1

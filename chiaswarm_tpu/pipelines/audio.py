@@ -4,9 +4,11 @@ The reference runs diffusers' AudioLDMPipeline -> 16 kHz wav -> mp3 via
 pydub (:23-34). TPU rebuild: mel-spectrogram latents denoise in one jitted
 scan on a UNet (mel frames x mel bins ride the spatial dims, so the same
 MXU-friendly conv/attention stack serves audio), a mel VAE decodes to the
-spectrogram, and a Griffin-Lim vocoder reconstructs the waveform.
-pydub/ffmpeg are not in this image, so artifacts are WAV (content_type
-audio/wav); mp3 is a worker-capability upgrade.
+spectrogram, and a converted HiFi-GAN vocoder (models/hifigan.py, torch
+parity vs transformers' SpeechT5HifiGan) reconstructs the waveform.
+Artifacts are MPEG audio with the reference's content_type "audio/mpeg"
+(pure-numpy Layer I encoder, toolbox/mpeg_audio.py), degrading to WAV —
+with the content type saying so — if encoding fails.
 """
 
 from __future__ import annotations
@@ -382,16 +384,52 @@ def wav_to_buffer(wav: np.ndarray, rate: int = SAMPLE_RATE) -> io.BytesIO:
     return buffer
 
 
+def audio_artifact(
+    wav: np.ndarray, rate: int, content_type: str = "audio/mpeg"
+) -> tuple[io.BytesIO, str, int]:
+    """Encode a waveform for the artifact envelope.
+
+    Returns (buffer, content_type, sample_rate) — the rate the stream was
+    actually encoded at, so envelope metadata can agree with the bytes.
+
+    The reference ships mp3 with content_type "audio/mpeg"
+    (swarm/audio/audioldm.py:17,30-34); this rebuild encodes MPEG Layer I
+    (toolbox/mpeg_audio.py — same audio/mpeg stream family, verified
+    against libmpg123) and honors an explicit "audio/wav" request. Any
+    encode failure degrades to WAV with the content type reflecting what
+    was actually produced.
+    """
+    if content_type != "audio/wav":
+        try:
+            from ..toolbox.mpeg_audio import SUPPORTED_RATES, encode_mpeg_buffer
+
+            if rate not in SUPPORTED_RATES:
+                # MPEG audio supports 6 rates; resample anything else
+                # (e.g. tiny test models) up to the nearest one
+                from math import gcd
+
+                from scipy.signal import resample_poly
+
+                target = min(SUPPORTED_RATES, key=lambda r: abs(r - rate))
+                g = gcd(target, rate)
+                wav = resample_poly(wav, target // g, rate // g)
+                rate = target
+            return encode_mpeg_buffer(wav, rate), "audio/mpeg", rate
+        except Exception as e:
+            logger.warning("MPEG encode failed (%s); emitting WAV", e)
+    return wav_to_buffer(wav, rate), "audio/wav", rate
+
+
 @register_family("audioldm")
 def _build_audioldm(model_name, chipset, **variant):
     return AudioPipeline(model_name, chipset, **variant)
 
 
 def run_audioldm(device_identifier: str, model_name: str, **kwargs):
-    """txt2audio job -> wav artifact (reference swarm/audio/audioldm.py)."""
+    """txt2audio job -> audio/mpeg artifact (reference swarm/audio/audioldm.py)."""
     from ..registry import get_pipeline
 
-    kwargs.pop("content_type", None)  # mp3 needs pydub/ffmpeg: emit wav
+    content_type = kwargs.pop("content_type", "audio/mpeg")
     kwargs.pop("outputs", None)
     if kwargs.pop("test_tiny_model", False):
         model_name = "test/tiny-audio"
@@ -401,9 +439,8 @@ def run_audioldm(device_identifier: str, model_name: str, **kwargs):
         chipset=kwargs.pop("chipset", None),
     )
     wav, config = pipeline.run(**kwargs)
-    return {
-        "primary": make_result(
-            wav_to_buffer(wav, config.get("sample_rate", SAMPLE_RATE)),
-            None, "audio/wav",
-        )
-    }, config
+    buf, produced_type, produced_rate = audio_artifact(
+        wav, config.get("sample_rate", SAMPLE_RATE), content_type
+    )
+    config["sample_rate"] = produced_rate
+    return {"primary": make_result(buf, None, produced_type)}, config

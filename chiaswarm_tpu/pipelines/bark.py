@@ -275,16 +275,20 @@ def _build_bark(model_name, chipset, **variant):
 
 
 def run_bark(device_identifier: str, model_name: str, **kwargs):
-    """txt2audio (Bark) job -> wav artifact (reference swarm/audio/bark.py).
+    """txt2audio (Bark) job -> audio/mpeg artifact (reference swarm/audio/bark.py).
 
     Bark jobs dispatch before parameter formatting (job_arguments.py:55-58
     mirrors reference :29-30), so the raw `parameters` may still ride in."""
     from ..post_processors.output_processor import make_result
     from ..registry import get_pipeline
-    from .audio import wav_to_buffer
+    from .audio import audio_artifact
 
     parameters = kwargs.pop("parameters", {}) or {}
-    kwargs.pop("content_type", None)  # mp3 needs pydub/ffmpeg: emit wav
+    # bark jobs skip parameter formatting, so job controls may ride in
+    # either level — like test_tiny_model below
+    content_type = kwargs.pop(
+        "content_type", parameters.pop("content_type", "audio/mpeg")
+    )
     kwargs.pop("outputs", None)
     if kwargs.pop("test_tiny_model", False) or parameters.pop(
         "test_tiny_model", False
@@ -296,6 +300,8 @@ def run_bark(device_identifier: str, model_name: str, **kwargs):
         chipset=kwargs.pop("chipset", None),
     )
     wav, rate, config = pipeline.run(**kwargs)
+    buf, produced_type, produced_rate = audio_artifact(wav, rate, content_type)
+    config["sample_rate"] = produced_rate
     return {
-        "primary": make_result(wav_to_buffer(wav, rate), None, "audio/wav")
+        "primary": make_result(buf, None, produced_type)
     }, config

@@ -1,0 +1,334 @@
+"""Pure-numpy MPEG-1/2 Audio Layer I encoder — mp3-family artifacts offline.
+
+The reference converts wav -> mp3 with pydub/ffmpeg and returns content
+type ``audio/mpeg`` (reference swarm/audio/audioldm.py:17,30-34 and
+swarm/audio/bark.py:12,32-34). Neither ffmpeg nor any mp3 library is in
+this image, so the rebuild carries its own MPEG audio encoder: MPEG-1 /
+MPEG-2-LSF **Layer I** (ISO 11172-3 / 13818-3), which shares the
+``audio/mpeg`` stream format and decodes in the same players (mpg123,
+ffmpeg, VLC, SDL_mixer) while being implementable — and *verifiable* —
+offline. Layer III needs large normative Huffman tables that cannot be
+reproduced from first principles without the spec text; Layer I is fully
+determined by the polyphase filterbank + uniform midtread quantizers.
+
+Every normative constant here was recovered **by black-box measurement
+against a real decoder** (pygame's bundled libmpg123, driven over ctypes
+— see tests/mpg123_ref.py):
+
+- The 512-tap synthesis window ``_D``: crafted single-impulse frames per
+  subband give the 32 synthesis impulse responses, which factor exactly
+  as ``S[k][n] = D[n] * cos((2k+1)(n+16) pi/64)`` with D on a 2^-16 grid
+  — i.e. the ISO table itself, recovered to the last bit. (Positions
+  n = 16 mod 64 have a vanishing cosine, so D there is unconstrained /
+  irrelevant; they are stored as 0.)
+- Dequantization: ``value = scf * 2/(2^nb - 1) * (code - (2^(nb-1)-1))``
+  — measured linear over every code for nb = 2..4, zero code verified.
+- Scalefactors: index i -> ``2 * 2^(-i/3)`` — measured ratios match to
+  1e-6 (ISO table B.1).
+
+The analysis filterbank is the time-matched adjoint of the measured
+synthesis (windows S/32 on a 32-sample hop); the encoder->libmpg123
+roundtrip measures > 80 dB SNR unquantized, so the pair is
+near-perfect-reconstruction against real decoders, not just in theory.
+
+Encoding is vectorised numpy (one matmul per frame batch for the
+filterbank); a 10 s clip encodes in well under a second on the worker
+host, off the TPU path entirely.
+"""
+
+from __future__ import annotations
+
+import io
+
+import numpy as np
+
+# ISO 11172-3 synthesis window x 2^16 (recovered by measurement, see
+# module docstring). Zeros at n = 0 and n = 16 mod 64 are positions where
+# the cosine modulation vanishes.
+_D_TABLE = [
+    0, -1, -1, -1, -1, -1, -1, -2,
+    -2, -2, -2, -3, -3, -4, -4, -5,
+    0, -6, -7, -7, -8, -9, -10, -11,
+    -13, -14, -16, -17, -19, -21, -24, -26,
+    -29, -31, -35, -38, -41, -45, -49, -53,
+    -58, -63, -68, -73, -79, -85, -91, -97,
+    -104, -111, -117, -125, -132, -139, -147, -154,
+    -161, -169, -176, -183, -190, -196, -202, -208,
+    -213, -218, -222, -225, -227, -228, -228, -227,
+    -224, -221, -215, -208, -200, -189, -177, -163,
+    0, -127, -106, -83, -57, -29, 2, 36,
+    72, 111, 153, 197, 244, 294, 347, 401,
+    459, 519, 581, 645, 711, 779, 848, 919,
+    991, 1064, 1137, 1210, 1283, 1356, 1428, 1498,
+    1567, 1634, 1698, 1759, 1817, 1870, 1919, 1962,
+    2001, 2032, 2057, 2075, 2085, 2087, 2080, 2063,
+    2037, 2000, 1952, 1893, 1822, 1739, 1644, 1535,
+    1414, 1280, 1131, 970, 794, 605, 402, 185,
+    0, -288, -545, -814, -1095, -1388, -1692, -2006,
+    -2330, -2663, -3004, -3351, -3705, -4063, -4425, -4788,
+    -5153, -5517, -5879, -6237, -6589, -6935, -7271, -7597,
+    -7910, -8209, -8491, -8755, -8998, -9219, -9416, -9585,
+    -9727, -9838, -9916, -9959, -9966, -9935, -9863, -9750,
+    -9592, -9389, -9139, -8840, -8492, -8092, -7640, -7134,
+    -6574, -5959, -5288, -4561, -3776, -2935, -2037, -1082,
+    -70, 998, 2122, 3300, 4533, 5818, 7154, 8540,
+    0, 11455, 12980, 14548, 16155, 17799, 19478, 21189,
+    22929, 24694, 26482, 28289, 30112, 31947, 33791, 35640,
+    37489, 39336, 41176, 43006, 44821, 46617, 48390, 50137,
+    51853, 53534, 55178, 56778, 58333, 59838, 61289, 62684,
+    64019, 65290, 66494, 67629, 68692, 69679, 70590, 71420,
+    72169, 72835, 73415, 73908, 74313, 74630, 74856, 74992,
+    75038, 74992, 74856, 74630, 74313, 73908, 73415, 72835,
+    72169, 71420, 70590, 69679, 68692, 67629, 66494, 65290,
+    0, 62684, 61289, 59838, 58333, 56778, 55178, 53534,
+    51853, 50137, 48390, 46617, 44821, 43006, 41176, 39336,
+    37489, 35640, 33791, 31947, 30112, 28289, 26482, 24694,
+    22929, 21189, 19478, 17799, 16155, 14548, 12980, 11455,
+    9975, 8540, 7154, 5818, 4533, 3300, 2122, 998,
+    -70, -1082, -2037, -2935, -3776, -4561, -5288, -5959,
+    -6574, -7134, -7640, -8092, -8492, -8840, -9139, -9389,
+    -9592, -9750, -9863, -9935, -9966, -9959, -9916, -9838,
+    0, -9585, -9416, -9219, -8998, -8755, -8491, -8209,
+    -7910, -7597, -7271, -6935, -6589, -6237, -5879, -5517,
+    -5153, -4788, -4425, -4063, -3705, -3351, -3004, -2663,
+    -2330, -2006, -1692, -1388, -1095, -814, -545, -288,
+    -45, 185, 402, 605, 794, 970, 1131, 1280,
+    1414, 1535, 1644, 1739, 1822, 1893, 1952, 2000,
+    2037, 2063, 2080, 2087, 2085, 2075, 2057, 2032,
+    2001, 1962, 1919, 1870, 1817, 1759, 1698, 1634,
+    0, 1498, 1428, 1356, 1283, 1210, 1137, 1064,
+    991, 919, 848, 779, 711, 645, 581, 519,
+    459, 401, 347, 294, 244, 197, 153, 111,
+    72, 36, 2, -29, -57, -83, -106, -127,
+    -146, -163, -177, -189, -200, -208, -215, -221,
+    -224, -227, -228, -228, -227, -225, -222, -218,
+    -213, -208, -202, -196, -190, -183, -176, -169,
+    -161, -154, -147, -139, -132, -125, -117, -111,
+    0, -97, -91, -85, -79, -73, -68, -63,
+    -58, -53, -49, -45, -41, -38, -35, -31,
+    -29, -26, -24, -21, -19, -17, -16, -14,
+    -13, -11, -10, -9, -8, -7, -7, -6,
+    -5, -5, -4, -4, -3, -3, -2, -2,
+    -2, -2, -1, -1, -1, -1, -1, -1,
+]
+
+# Layer I bitrate tables, kbps (index 1..14; 0 = free, 15 = forbidden)
+_BITRATES_V1 = [0, 32, 64, 96, 128, 160, 192, 224,
+                256, 288, 320, 352, 384, 416, 448]
+_BITRATES_V2 = [0, 32, 48, 56, 64, 80, 96, 112,
+                128, 144, 160, 176, 192, 224, 256]
+# sampling-rate index by version: header fs bits -> Hz
+_RATES_V1 = {44100: 0, 48000: 1, 32000: 2}
+_RATES_V2 = {22050: 0, 24000: 1, 16000: 2}
+
+_SCF = 2.0 * 2.0 ** (-np.arange(63) / 3.0)  # ISO table B.1
+
+_FRAME_SAMPLES = 384  # Layer I: 12 subband samples x 32 subbands
+
+
+def _filterbank_matrices() -> tuple[np.ndarray, np.ndarray]:
+    """(analysis [32,512], synthesis [32,512]) from the measured window."""
+    d = np.asarray(_D_TABLE, np.float64) / 65536.0
+    n = np.arange(512)
+    k = np.arange(32)
+    cos = np.cos((2 * k[:, None] + 1) * (n[None, :] + 16) * np.pi / 64)
+    synth = d[None, :] * cos
+    return synth / 32.0, synth
+
+
+_ANALYSIS, _SYNTHESIS = _filterbank_matrices()
+
+# Alignment of the analysis hop grid against the decoder's synthesis
+# phase, found by maximising the measured roundtrip SNR (84.6 dB on white
+# noise): the encoder consumes input delayed by 19 samples relative to
+# the hop grid used below.
+_PHASE = 19
+
+
+class _BitWriter:
+    def __init__(self) -> None:
+        self.buf = bytearray()
+        self.acc = 0
+        self.n = 0
+
+    def put(self, value: int, nbits: int) -> None:
+        self.acc = (self.acc << nbits) | (value & ((1 << nbits) - 1))
+        self.n += nbits
+        while self.n >= 8:
+            self.n -= 8
+            self.buf.append((self.acc >> self.n) & 0xFF)
+
+    def pad_to(self, nbytes: int) -> bytes:
+        if self.n:
+            self.buf.append((self.acc << (8 - self.n)) & 0xFF)
+            self.acc = 0
+            self.n = 0
+        assert len(self.buf) <= nbytes, (len(self.buf), nbytes)
+        return bytes(self.buf) + b"\x00" * (nbytes - len(self.buf))
+
+
+def _pick_bitrate(rate: int, bitrate_kbps: int | None) -> tuple[int, int, list]:
+    """-> (version_bits, bitrate_index, bitrate_table)."""
+    if rate in _RATES_V1:
+        version, table = 3, _BITRATES_V1
+    elif rate in _RATES_V2:
+        version, table = 2, _BITRATES_V2
+    else:
+        raise ValueError(
+            f"unsupported MPEG audio rate {rate}; "
+            f"supported: {sorted(_RATES_V1) + sorted(_RATES_V2)}"
+        )
+    if bitrate_kbps is None:
+        # ~10 coded bits per PCM sample: measured 43 dB SNR at 8 bits,
+        # ~60 dB at 10 on program material; Layer I has no Huffman stage
+        # so it buys quality with rate
+        want = 10 * rate // 1000
+        candidates = [b for b in table[1:] if b >= want]
+        bitrate_kbps = candidates[0] if candidates else table[-1]
+    if bitrate_kbps not in table[1:]:
+        raise ValueError(f"bitrate {bitrate_kbps} not in Layer I table {table[1:]}")
+    return version, table.index(bitrate_kbps), table
+
+
+def _analyze(pcm: np.ndarray) -> np.ndarray:
+    """PCM [n] -> subband samples [T, 32] on a 32-sample hop."""
+    x = np.concatenate([np.zeros(512 - 32 + _PHASE), pcm.astype(np.float64)])
+    t = len(x) // 32
+    hops = np.lib.stride_tricks.sliding_window_view(x, 512)[::32]
+    hops = hops[: min(t, len(hops))]
+    return hops @ _ANALYSIS.T
+
+
+def _allocate(scaled_peaks: np.ndarray, budget_bits: int) -> np.ndarray:
+    """Greedy MNR-driven bit allocation for one frame.
+
+    `scaled_peaks` [32]: per-subband peak magnitude. Repeatedly grant
+    bits to the subband whose quantization noise is worst relative to
+    its signal level (6.02 dB per bit); starting a subband costs
+    12*2 sample bits + 6 scalefactor bits, each further bit costs 12.
+    """
+    smr = 20.0 * np.log10(np.maximum(scaled_peaks, 1e-10))
+    nb = np.zeros(32, np.int64)
+    # silent subbands never get bits; threshold ~ -96 dBFS
+    active = smr > -96.0
+    while True:
+        mnr = np.where(nb > 0, 6.02 * nb - smr, -smr - 0.0)
+        mnr = np.where(active & (nb < 15), mnr, np.inf)
+        sb = int(np.argmin(mnr))
+        if not np.isfinite(mnr[sb]):
+            break
+        cost = 30 if nb[sb] == 0 else 12
+        if budget_bits < cost:
+            break
+        nb[sb] += 2 if nb[sb] == 0 else 1
+        budget_bits -= cost
+    return nb
+
+
+def encode_layer1(
+    pcm: np.ndarray, rate: int, bitrate_kbps: int | None = None
+) -> bytes:
+    """float PCM in [-1, 1] (mono [n] or [n, ch] downmixed) -> MPEG Layer I.
+
+    Returns a self-contained ``audio/mpeg`` elementary stream.
+    """
+    pcm = np.asarray(pcm, np.float64)
+    if pcm.ndim == 2:
+        pcm = pcm.mean(axis=1)
+    peak = np.max(np.abs(pcm)) if pcm.size else 0.0
+    if peak > 1.0:
+        pcm = pcm / peak
+    version, br_idx, table = _pick_bitrate(rate, bitrate_kbps)
+    fs_idx = (_RATES_V1 if version == 3 else _RATES_V2)[rate]
+    bitrate = table[br_idx] * 1000
+
+    # pad so every frame is full
+    nframes = (len(pcm) + _FRAME_SAMPLES - 1) // _FRAME_SAMPLES
+    pcm = np.concatenate([pcm, np.zeros(nframes * _FRAME_SAMPLES - len(pcm))])
+    sub = _analyze(pcm)  # [T, 32]
+    sub = sub[: nframes * 12].reshape(nframes, 12, 32)
+
+    # Layer I frame length is slots = floor(12*bitrate/fs) (+1 when the
+    # padding bit is set); the standard accumulator decides padding so the
+    # average rate is exact (only 44.1/22.05 kHz ever need it). The header
+    # padding bit MUST match the emitted length or decoders lose sync.
+    base_slots, frac = divmod(12 * bitrate, rate)
+    out = io.BytesIO()
+    acc = 0
+    for f in range(nframes):
+        acc += frac
+        padding = 1 if acc >= rate else 0
+        acc -= rate * padding
+        frame_bits = (base_slots + padding) * 32
+        frame = _encode_frame(
+            sub[f], version, br_idx, fs_idx, padding, frame_bits
+        )
+        out.write(frame)
+    return out.getvalue()
+
+
+def _encode_frame(
+    sub: np.ndarray, version: int, br_idx: int, fs_idx: int,
+    padding: int, frame_bits: int,
+) -> bytes:
+    peaks = np.abs(sub).max(axis=0)  # [32]
+    # smallest scalefactor still >= peak (the table is descending, so:
+    # count entries >= peak, take the last of them — picking the next
+    # SMALLER scf instead clips the loudest samples by up to 2^(1/3))
+    ge = np.searchsorted(-_SCF, -np.maximum(peaks, 1e-10), side="right")
+    scf_idx = np.clip(ge - 1, 0, 62)
+    scaled = peaks / _SCF[scf_idx]
+
+    header_bits = 32
+    alloc_bits = 32 * 4
+    budget = frame_bits - header_bits - alloc_bits
+    nb = _allocate(scaled, budget)
+
+    w = _BitWriter()
+    w.put(0x7FF, 11)
+    w.put(version, 2)      # 3 = MPEG-1, 2 = MPEG-2 LSF
+    w.put(3, 2)            # Layer I
+    w.put(1, 1)            # no CRC
+    w.put(br_idx, 4)
+    w.put(fs_idx, 2)
+    w.put(padding, 1)
+    w.put(0, 1)            # private
+    w.put(3, 2)            # single channel
+    w.put(0, 2)            # mode extension
+    w.put(0, 1)            # copyright
+    w.put(1, 1)            # original
+    w.put(0, 2)            # no emphasis
+
+    for sb in range(32):
+        w.put(int(nb[sb]) - 1 if nb[sb] else 0, 4)
+    for sb in range(32):
+        if nb[sb]:
+            w.put(int(scf_idx[sb]), 6)
+    # quantize: code = round(x / (scf * 2/(2^nb-1))) + (2^(nb-1)-1)
+    codes = np.zeros((12, 32), np.int64)
+    for sb in range(32):
+        if not nb[sb]:
+            continue
+        steps = (1 << int(nb[sb])) - 1
+        step = _SCF[scf_idx[sb]] * 2.0 / steps
+        zero = (1 << (int(nb[sb]) - 1)) - 1
+        q = np.round(sub[:, sb] / step).astype(np.int64) + zero
+        codes[:, sb] = np.clip(q, 0, steps)
+    for s in range(12):
+        for sb in range(32):
+            if nb[sb]:
+                w.put(int(codes[s, sb]), int(nb[sb]))
+    return w.pad_to(frame_bits // 8)
+
+
+def encode_mpeg_buffer(
+    pcm: np.ndarray, rate: int, bitrate_kbps: int | None = None
+) -> io.BytesIO:
+    """Encoder entry for the audio pipelines: BytesIO of an audio/mpeg
+    stream, rewound, mirroring wav_to_buffer's contract."""
+    buf = io.BytesIO(encode_layer1(pcm, rate, bitrate_kbps))
+    buf.seek(0)
+    return buf
+
+
+SUPPORTED_RATES = tuple(sorted(_RATES_V1) + sorted(_RATES_V2))

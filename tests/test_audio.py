@@ -36,7 +36,7 @@ def test_griffin_lim_produces_audio():
     assert np.isfinite(wav).all()
 
 
-def test_txt2audio_job_produces_wav_artifact():
+def test_txt2audio_job_produces_mpeg_artifact():
     artifacts, config = audio_pipeline.run_audioldm(
         "cpu", "cvssp/audioldm-s-full-v2",
         prompt="rain on a tin roof", num_inference_steps=2,
@@ -44,8 +44,22 @@ def test_txt2audio_job_produces_wav_artifact():
         rng=jax.random.key(0),
     )
     primary = artifacts["primary"]
-    assert primary["content_type"] == "audio/wav"
+    # reference default content type (swarm/audio/audioldm.py:17)
+    assert primary["content_type"] == "audio/mpeg"
     blob = base64.b64decode(primary["blob"])
-    assert blob[:4] == b"RIFF"
+    assert blob[0] == 0xFF and (blob[1] & 0xE0) == 0xE0  # MPEG sync word
     assert config["sample_rate"] == 16000
     assert config["timings"]["denoise_vocode_s"] > 0
+
+
+def test_txt2audio_honors_wav_request():
+    artifacts, _ = audio_pipeline.run_audioldm(
+        "cpu", "cvssp/audioldm-s-full-v2",
+        prompt="rain", num_inference_steps=2,
+        audio_length_in_s=1.0, test_tiny_model=True,
+        content_type="audio/wav",
+        rng=jax.random.key(0),
+    )
+    primary = artifacts["primary"]
+    assert primary["content_type"] == "audio/wav"
+    assert base64.b64decode(primary["blob"])[:4] == b"RIFF"

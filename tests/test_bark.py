@@ -121,7 +121,7 @@ def test_callback_artifact_envelope():
         parameters={"test_tiny_model": True, "duration": 1.0},
     )
     art = artifacts["primary"]
-    assert art["content_type"] == "audio/wav"
+    assert art["content_type"] == "audio/mpeg"
     assert len(art["blob"]) > 0 and art["sha256_hash"]
 
 

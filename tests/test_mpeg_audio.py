@@ -1,0 +1,142 @@
+"""MPEG Layer I encoder tests: bitstream validity by header parse (pure
+python) and end-to-end SNR against a real third-party decoder (pygame's
+libmpg123 over ctypes), closing VERDICT r03 item 6 — mp3-family audio
+artifacts with content types reflecting reality.
+"""
+
+import numpy as np
+import pytest
+
+from chiaswarm_tpu.toolbox.mpeg_audio import (
+    SUPPORTED_RATES,
+    encode_layer1,
+    encode_mpeg_buffer,
+)
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(__file__))
+from mpg123_ref import find_libmpg123  # noqa: E402
+
+needs_mpg123 = pytest.mark.skipif(
+    find_libmpg123() is None, reason="libmpg123 not available"
+)
+
+_BITRATES_V1 = [0, 32, 64, 96, 128, 160, 192, 224,
+                256, 288, 320, 352, 384, 416, 448]
+_BITRATES_V2 = [0, 32, 48, 56, 64, 80, 96, 112,
+                128, 144, 160, 176, 192, 224, 256]
+_FS_V1 = {0: 44100, 1: 48000, 2: 32000}
+_FS_V2 = {0: 22050, 1: 24000, 2: 16000}
+
+
+def _tone(rate: int, seconds: float = 1.5) -> np.ndarray:
+    t = np.arange(int(rate * seconds)) / rate
+    rng = np.random.default_rng(7)
+    x = (0.5 * np.sin(2 * np.pi * 440 * t)
+         + 0.2 * np.sin(2 * np.pi * 2333 * t)
+         + 0.03 * rng.standard_normal(len(t)))
+    return (x / np.abs(x).max() * 0.8).astype(np.float32)
+
+
+def _walk_frames(data: bytes):
+    """Parse frame headers, asserting sync integrity; yields header fields."""
+    pos = 0
+    while pos + 4 <= len(data):
+        h = data[pos: pos + 4]
+        assert h[0] == 0xFF and (h[1] & 0xE0) == 0xE0, f"lost sync at {pos}"
+        version = (h[1] >> 3) & 0x3
+        layer = (h[1] >> 1) & 0x3
+        assert layer == 3, "Layer I"
+        br_idx = (h[2] >> 4) & 0xF
+        fs_idx = (h[2] >> 2) & 0x3
+        padding = (h[2] >> 1) & 0x1
+        if version == 3:
+            bitrate, fs = _BITRATES_V1[br_idx] * 1000, _FS_V1[fs_idx]
+        else:
+            assert version == 2
+            bitrate, fs = _BITRATES_V2[br_idx] * 1000, _FS_V2[fs_idx]
+        slots = 12 * bitrate // fs + padding
+        yield {"version": version, "bitrate": bitrate, "fs": fs,
+               "slots": slots}
+        pos += slots * 4
+    assert pos == len(data), "stream ends mid-frame"
+
+
+@pytest.mark.parametrize("rate", SUPPORTED_RATES)
+def test_stream_structure(rate):
+    data = encode_layer1(_tone(rate, 0.5), rate)
+    frames = list(_walk_frames(data))
+    assert len(frames) >= int(0.5 * rate / 384)
+    assert all(f["fs"] == rate for f in frames)
+    # whole stream is frame-aligned and every header agrees
+    assert len({f["bitrate"] for f in frames}) == 1
+
+
+def test_buffer_contract():
+    buf = encode_mpeg_buffer(_tone(16000, 0.2), 16000)
+    data = buf.read()
+    assert data[:1] == b"\xff"
+    assert buf.tell() == len(data)
+
+
+def test_unsupported_rate_raises():
+    with pytest.raises(ValueError):
+        encode_layer1(np.zeros(100), 12345)
+
+
+def test_stereo_downmix_and_overload():
+    # [n, 2] input and amplitude > 1 both normalise instead of crashing
+    x = np.stack([_tone(16000, 0.3)] * 2, axis=1) * 2.5
+    data = encode_layer1(x, 16000)
+    assert len(list(_walk_frames(data))) > 0
+
+
+@needs_mpg123
+@pytest.mark.parametrize("rate", SUPPORTED_RATES)
+def test_decodes_with_real_decoder(rate):
+    from mpg123_ref import decode, roundtrip_snr_db
+
+    x = _tone(rate)
+    pcm, decoded_rate = decode(encode_layer1(x, rate))
+    assert decoded_rate == rate
+    assert abs(len(pcm) - len(x)) < 2 * 384 + 512  # frame + filter padding
+    assert roundtrip_snr_db(x, pcm[:, 0]) > 35.0
+
+
+@needs_mpg123
+def test_high_bitrate_near_transparent():
+    from mpg123_ref import decode, roundtrip_snr_db
+
+    x = _tone(16000)
+    pcm, _ = decode(encode_layer1(x, 16000, bitrate_kbps=256))
+    assert roundtrip_snr_db(x, pcm[:, 0]) > 70.0
+
+
+@needs_mpg123
+def test_silence_stays_silent():
+    from mpg123_ref import decode
+
+    pcm, _ = decode(encode_layer1(np.zeros(16000, np.float32), 16000))
+    assert np.abs(pcm).max() < 1e-4
+
+
+def test_audio_artifact_contract():
+    from chiaswarm_tpu.pipelines.audio import audio_artifact
+
+    # off-table rates resample to the nearest MPEG rate, still audio/mpeg,
+    # and the returned rate reflects the stream
+    buf, produced, rate = audio_artifact(np.zeros(1000, np.float32), 12345)
+    assert produced == "audio/mpeg" and rate == 16000
+    assert list(_walk_frames(buf.read()))[0]["fs"] == 16000
+
+    buf, produced, rate = audio_artifact(
+        np.zeros(1000, np.float32), 16000, content_type="audio/wav")
+    assert produced == "audio/wav" and rate == 16000
+    assert buf.read(4) == b"RIFF"
+
+    buf, produced, rate = audio_artifact(_tone(16000, 0.2), 16000)
+    assert produced == "audio/mpeg" and rate == 16000
+    head = buf.read(2)
+    assert head[0] == 0xFF and (head[1] & 0xE0) == 0xE0
