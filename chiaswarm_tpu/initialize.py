@@ -102,8 +102,11 @@ def _eval_shape_params(module, *args, **kwargs):
 _UNSUPPORTED_CHECK_KEYWORDS = (
     # families the worker can schedule but cannot yet serve with real
     # weights (no conversion path) — `--check` skips instead of failing.
-    # Kandinsky 2.x converts (unet/movq/prior); Kandinsky 3 does not yet.
-    "audioldm", "bark", "zeroscope", "text-to-video",
+    # Kandinsky 2.x converts (unet/movq/prior); Kandinsky 3 does not yet;
+    # AudioLDM v1 converts, AudioLDM2's different component set (GPT-2
+    # projection bridge, text_encoder_2, list-valued cross_attention_dim)
+    # does not.
+    "audioldm2", "bark", "zeroscope", "text-to-video",
     "i2vgen", "stable-video", "damo", "kandinsky-3", "kandinsky3",
     "kandinsky-2-1", "cascade", "latent-upscaler", "openpose",
 )
@@ -136,6 +139,8 @@ def verify_local_model(model_name: str, root: Path | None = None) -> dict | None
         return _verify_flux_model(model_name, root)
     if "kandinsky" in name:
         return _verify_kandinsky_model(model_name, root)
+    if "audioldm" in name:
+        return _verify_audioldm_model(model_name, root)
     if name.startswith("deepfloyd/"):
         return _verify_if_model(model_name, root)
     if "animatediff" in name or "motion-adapter" in name:
@@ -365,6 +370,81 @@ def _verify_flux_model(model_name: str, root: Path) -> dict:
         assert_tree_shapes_match(converted, expected[comp], prefix=comp)
         counts[comp] = _param_count(converted)
     return counts
+
+
+def _verify_audioldm_model(model_name: str, root: Path) -> dict:
+    """AudioLDM repo: UNet (class-embed FiLM graph) + mel VAE + CLAP text
+    tower + HiFi-GAN vocoder, through the same geometry-inference recipe
+    AudioPipeline loads with (reference swarm/audio/audioldm.py:19)."""
+    import jax.numpy as jnp
+
+    from .models.conversion import (
+        assert_tree_shapes_match,
+        convert_clap,
+        convert_hifigan,
+        convert_unet,
+        convert_vae,
+        infer_unet2d_config,
+        infer_vae_config,
+        load_torch_state_dict,
+    )
+    from .models.hifigan import HifiGanGenerator
+    from .models.clap import ClapTextEncoder
+    from .models.unet2d import UNet2DConditionModel
+    from .models.vae import AutoencoderKL
+    from .pipelines.audio import _config_json, _infer_clap_vocoder_configs
+
+    model_dir = root / model_name
+    report = {}
+
+    unet_state = load_torch_state_dict(model_dir, "unet")
+    unet_cfg = infer_unet2d_config(unet_state, _config_json(model_dir, "unet"))
+    converted = convert_unet(unet_state)
+    cond = (
+        dict(class_labels=jnp.zeros((1, unet_cfg.class_embed_dim)))
+        if unet_cfg.class_embed_dim
+        else {}
+    )
+    ctx = (
+        None
+        if not unet_cfg.cross_attention_dim
+        else jnp.zeros((1, 8, unet_cfg.cross_attention_dim))
+    )
+    expected = _eval_shape_params(
+        UNet2DConditionModel(unet_cfg),
+        jnp.zeros((1, 16, 8, unet_cfg.in_channels)),
+        jnp.zeros((1,)),
+        ctx,
+        **cond,
+    )
+    assert_tree_shapes_match(converted, expected, prefix="unet")
+    report["unet"] = _param_count(converted)
+
+    vae_state = load_torch_state_dict(model_dir, "vae")
+    vae_cfg = infer_vae_config(vae_state, _config_json(model_dir, "vae"))
+    converted = convert_vae(vae_state)
+    expected = _eval_shape_params(
+        AutoencoderKL(vae_cfg), jnp.zeros((1, 32, 16, vae_cfg.in_channels))
+    )
+    assert_tree_shapes_match(converted, expected, prefix="vae")
+    report["vae"] = _param_count(converted)
+
+    clap_cfg, vocoder_cfg = _infer_clap_vocoder_configs(model_dir)
+    converted = convert_clap(load_torch_state_dict(model_dir, "text_encoder"))
+    expected = _eval_shape_params(
+        ClapTextEncoder(clap_cfg), jnp.zeros((1, 8), jnp.int32)
+    )
+    assert_tree_shapes_match(converted, expected, prefix="text_encoder")
+    report["text_encoder"] = _param_count(converted)
+
+    converted = convert_hifigan(load_torch_state_dict(model_dir, "vocoder"))
+    expected = _eval_shape_params(
+        HifiGanGenerator(vocoder_cfg),
+        jnp.zeros((1, 16, vocoder_cfg.model_in_dim)),
+    )
+    assert_tree_shapes_match(converted, expected, prefix="vocoder")
+    report["vocoder"] = _param_count(converted)
+    return report
 
 
 def _verify_safety_model(model_name: str, root: Path) -> dict:

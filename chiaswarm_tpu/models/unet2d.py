@@ -41,10 +41,19 @@ class UNet2DConfig:
     # number of heads (SD1.5: 8 heads of dim 40; SD2.1/XL: (5,10,20) heads
     # of dim 64). Keep the semantics, fix the name.
     num_attention_heads: int | tuple[int, ...] = 8
-    cross_attention_dim: int = 768
     # SDXL additional conditioning: projection dim of pooled text embeds
     addition_embed_dim: int = 0  # 0 = disabled
     addition_time_embed_dim: int = 256
+    # AudioLDM-style FiLM conditioning: a `simple_projection` class
+    # embedding (Linear from e.g. the 512-d CLAP joint space into temb),
+    # concatenated to — not summed with — the time embedding when
+    # `class_embeddings_concat` (diffusers UNet2DConditionModel semantics;
+    # the resnet time projections then see 2x temb width)
+    class_embed_dim: int = 0  # 0 = disabled
+    class_embeddings_concat: bool = False
+    # 0 = the transformer blocks self-attend (encoder_hidden_states=None,
+    # AudioLDM's layout) instead of cross-attending to a text sequence
+    cross_attention_dim: int = 768
     flip_sin_to_cos: bool = True
     freq_shift: float = 0.0
 
@@ -145,6 +154,7 @@ class UNet2DConditionModel(nn.Module):
         added_cond: dict | None = None,  # SDXL: {"text_embeds": [B,D], "time_ids": [B,6]}
         down_residuals: tuple | None = None,  # ControlNet per-skip residuals
         mid_residual=None,  # ControlNet mid-block residual
+        class_labels=None,  # AudioLDM: [B, class_embed_dim] CLAP embedding
     ):
         cfg = self.config
         if jnp.ndim(timesteps) == 0:
@@ -176,6 +186,15 @@ class UNet2DConditionModel(nn.Module):
             temb = temb + TimestepEmbedding(
                 temb_dim, dtype=self.dtype, name="add_embedding"
             )(add_feat)
+
+        if cfg.class_embed_dim:
+            class_emb = nn.Dense(
+                temb_dim, dtype=self.dtype, name="class_embedding"
+            )(class_labels.astype(self.dtype))
+            if cfg.class_embeddings_concat:
+                temb = jnp.concatenate([temb, class_emb], axis=-1)
+            else:
+                temb = temb + class_emb
 
         x = nn.Conv(
             cfg.block_out_channels[0], (3, 3), padding=((1, 1), (1, 1)),

@@ -277,12 +277,13 @@ def _encode_frame(
     # SMALLER scf instead clips the loudest samples by up to 2^(1/3))
     ge = np.searchsorted(-_SCF, -np.maximum(peaks, 1e-10), side="right")
     scf_idx = np.clip(ge - 1, 0, 62)
-    scaled = peaks / _SCF[scf_idx]
 
     header_bits = 32
     alloc_bits = 32 * 4
     budget = frame_bits - header_bits - alloc_bits
-    nb = _allocate(scaled, budget)
+    # allocate by RAW level: scf-normalized peaks are all ~1, which would
+    # flatten the SMR and spread bits uniformly over noise-floor subbands
+    nb = _allocate(peaks, budget)
 
     w = _BitWriter()
     w.put(0x7FF, 11)

@@ -1,8 +1,10 @@
 """Audio workloads: AudioLDM-style txt2audio and Bark TTS.
 
 Reference: swarm/audio/audioldm.py:23-34 (AudioLDM -> wav 16 kHz -> mp3) and
-swarm/audio/bark.py:16-21. mp3 encoding is gated on pydub/ffmpeg presence;
-workers without it return wav artifacts.
+swarm/audio/bark.py:16-21. Artifacts default to content_type "audio/mpeg"
+(the reference's default) via the built-in MPEG Layer I encoder
+(toolbox/mpeg_audio.py — no pydub/ffmpeg dependency); WAV only on explicit
+request or encode failure, with the content type saying so.
 """
 
 from __future__ import annotations

@@ -162,3 +162,128 @@ def test_pipeline_loads_converted_weights(sdaas_root, tmp_path):
         np.asarray(f_out["pooled"], np.float32),
         t_out.text_embeds.numpy(), atol=2e-4,
     )
+
+
+def test_full_audioldm_repo_check_and_pipeline(sdaas_root, tmp_path):
+    """A complete synthetic AudioLDM checkpoint — every component in its
+    real key layout (torch-mirror UNet/VAE, transformers CLAP/HiFi-GAN) —
+    passes `initialize --check` geometry inference AND serves through
+    AudioPipeline with converted weights end-to-end (VERDICT r03 item 2)."""
+    import dataclasses
+    import json
+    import os
+    import sys
+
+    torch = pytest.importorskip("torch")
+    from safetensors.numpy import save_file
+    from transformers import ClapTextConfig as HFClapConfig
+    from transformers import (
+        ClapTextModelWithProjection,
+        SpeechT5HifiGan,
+        SpeechT5HifiGanConfig,
+    )
+
+    sys.path.insert(0, os.path.dirname(__file__))
+    from torch_unet_ref import AutoencoderKLT, UNet2DConditionT
+
+    import jax
+    from chiaswarm_tpu.initialize import verify_local_model
+    from chiaswarm_tpu.models import configs as cfgs
+    from chiaswarm_tpu.pipelines.audio import AudioPipeline
+    from chiaswarm_tpu.settings import load_settings
+    from pathlib import Path
+
+    name = "cvssp/audioldm-s-full-v2"
+    root = Path(load_settings().model_root_dir).expanduser()
+    repo = root / name
+    torch.manual_seed(11)
+
+    unet_cfg = dataclasses.replace(
+        cfgs.TINY_UNET, in_channels=8, out_channels=8,
+        cross_attention_dim=0, class_embed_dim=32,
+        class_embeddings_concat=True,
+    )
+    (repo / "unet").mkdir(parents=True)
+    save_file(
+        {k: v.numpy() for k, v in UNet2DConditionT(unet_cfg).state_dict().items()},
+        str(repo / "unet" / "diffusion_pytorch_model.safetensors"),
+    )
+    (repo / "unet" / "config.json").write_text(
+        json.dumps({"attention_head_dim": 4})
+    )
+
+    vae_cfg = dataclasses.replace(
+        cfgs.TINY_VAE, in_channels=1, latent_channels=8,
+        scaling_factor=0.9227,
+    )
+    (repo / "vae").mkdir(parents=True)
+    save_file(
+        {k: v.numpy() for k, v in AutoencoderKLT(vae_cfg).state_dict().items()},
+        str(repo / "vae" / "diffusion_pytorch_model.safetensors"),
+    )
+    (repo / "vae" / "config.json").write_text(
+        json.dumps({"scaling_factor": 0.9227})
+    )
+
+    clap_kwargs = dict(
+        vocab_size=1000, hidden_size=32, num_hidden_layers=2,
+        num_attention_heads=4, intermediate_size=64,
+        max_position_embeddings=80, type_vocab_size=1, pad_token_id=1,
+        projection_dim=32,
+    )
+    hf_clap = HFClapConfig(
+        **clap_kwargs, projection_hidden_act="relu", hidden_act="gelu",
+    )
+    (repo / "text_encoder").mkdir(parents=True)
+    save_file(
+        {k: v.numpy()
+         for k, v in ClapTextModelWithProjection(hf_clap).state_dict().items()},
+        str(repo / "text_encoder" / "model.safetensors"),
+    )
+    (repo / "text_encoder" / "config.json").write_text(json.dumps({
+        "vocab_size": 1000, "hidden_size": 32, "num_hidden_layers": 2,
+        "num_attention_heads": 4, "intermediate_size": 64,
+        "max_position_embeddings": 80, "projection_dim": 32,
+    }))
+
+    voc_shape = dict(
+        model_in_dim=64, upsample_initial_channel=16,
+        upsample_rates=[8, 5, 4], upsample_kernel_sizes=[16, 10, 8],
+        resblock_kernel_sizes=[3], resblock_dilation_sizes=[[1, 3]],
+    )
+    hf_voc = SpeechT5HifiGanConfig(
+        **voc_shape, normalize_before=True, leaky_relu_slope=0.1,
+    )
+    (repo / "vocoder").mkdir(parents=True)
+    save_file(
+        {k: v.numpy() for k, v in SpeechT5HifiGan(hf_voc).state_dict().items()},
+        str(repo / "vocoder" / "model.safetensors"),
+    )
+    (repo / "vocoder" / "config.json").write_text(json.dumps(voc_shape))
+
+    # --check: geometry inference + conversion shape match, all components
+    report = verify_local_model(name, root)
+    assert report is not None
+    assert set(report) == {"unet", "vae", "text_encoder", "vocoder"}
+    assert all(v > 0 for v in report.values())
+
+    # serving: the pipeline builds from the same checkpoint (hash-tokenizer
+    # warning is acceptable only for test models; here the name is real, so
+    # a tokenizer must be present — give it the minimal files)
+    tok_dir = repo / "tokenizer"
+    tok_dir.mkdir()
+    vocab = {"<s>": 0, "<pad>": 1, "</s>": 2, "<unk>": 3, "rain": 4,
+             "Ġon": 5, "Ġroof": 6}
+    (tok_dir / "vocab.json").write_text(json.dumps(vocab))
+    (tok_dir / "merges.txt").write_text("#version: 0.2\n")
+    (tok_dir / "tokenizer_config.json").write_text(
+        json.dumps({"tokenizer_class": "RobertaTokenizer",
+                    "model_max_length": 80})
+    )
+    pipe = AudioPipeline(name)
+    wav, config = pipe.run(
+        prompt="rain on roof", num_inference_steps=2,
+        audio_length_in_s=0.5, rng=jax.random.key(0),
+    )
+    assert wav.ndim == 1 and len(wav) > 500 and np.isfinite(wav).all()
+    assert config["sample_rate"] == 16000

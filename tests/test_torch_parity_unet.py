@@ -91,6 +91,67 @@ class TestUNetTorchParity:
         }
         self._compare(cfg, added=added)
 
+    def test_audioldm_unet_matches(self):
+        """AudioLDM branch: `simple_projection` class embedding concatenated
+        to temb, transformer blocks self-attending (encoder_hidden_states=
+        None) — the graph diffusers runs for cvssp/audioldm-*
+        (reference swarm/audio/audioldm.py:19)."""
+        import dataclasses
+
+        cfg = dataclasses.replace(
+            cfgs.TINY_UNET,
+            in_channels=8, out_channels=8,
+            cross_attention_dim=0,
+            class_embed_dim=16,
+            class_embeddings_concat=True,
+        )
+        torch.manual_seed(6)
+        tref = UNet2DConditionT(cfg).eval()
+        state = {k: v.numpy() for k, v in tref.state_dict().items()}
+        params = convert_unet(state)
+
+        rng = np.random.default_rng(7)
+        x = rng.standard_normal((2, 16, 16, 8)).astype(np.float32)
+        t = np.array([7.0, 451.0], np.float32)
+        labels = rng.standard_normal((2, 16)).astype(np.float32)
+        with torch.no_grad():
+            out_t = tref(
+                _to_torch_nchw(x), torch.from_numpy(t), None,
+                class_labels=torch.from_numpy(labels),
+            ).numpy().transpose(0, 2, 3, 1)
+        out_f = np.asarray(
+            UNet2DConditionModel(cfg).apply(
+                {"params": params}, jnp.asarray(x), jnp.asarray(t), None,
+                class_labels=jnp.asarray(labels),
+            )
+        )
+        np.testing.assert_allclose(out_f, out_t, atol=2e-4, rtol=1e-3)
+
+    def test_audioldm_config_inference_roundtrip(self):
+        """infer_unet2d_config recovers the full geometry from the state
+        dict alone (class embed + concat + self-attn + channels)."""
+        import dataclasses
+
+        from chiaswarm_tpu.models.conversion import infer_unet2d_config
+
+        cfg = dataclasses.replace(
+            cfgs.TINY_UNET,
+            in_channels=8, out_channels=8,
+            cross_attention_dim=0,
+            class_embed_dim=16,
+            class_embeddings_concat=True,
+            num_attention_heads=4,
+        )
+        torch.manual_seed(8)
+        state = {
+            k: v.numpy()
+            for k, v in UNet2DConditionT(cfg).state_dict().items()
+        }
+        inferred = infer_unet2d_config(
+            state, {"attention_head_dim": 4}
+        )
+        assert inferred == cfg
+
 
 class TestVAETorchParity:
     @pytest.fixture(scope="class")
@@ -135,6 +196,34 @@ class TestVAETorchParity:
             )
         )
         np.testing.assert_allclose(px_f, px_t, atol=2e-4, rtol=1e-3)
+
+    def test_audioldm_vae_matches_and_infers(self):
+        """Mel-spectrogram VAE (1 input channel, 8 latent channels) decodes
+        identically, and infer_vae_config recovers the geometry."""
+        import dataclasses
+
+        from chiaswarm_tpu.models.conversion import infer_vae_config
+
+        cfg = dataclasses.replace(
+            cfgs.TINY_VAE, in_channels=1, latent_channels=8,
+            scaling_factor=0.9227,
+        )
+        torch.manual_seed(9)
+        tref = AutoencoderKLT(cfg).eval()
+        state = {k: v.numpy() for k, v in tref.state_dict().items()}
+        assert infer_vae_config(state, {"scaling_factor": 0.9227}) == cfg
+        params = convert_vae(state)
+        vae = AutoencoderKL(cfg)
+        rng = np.random.default_rng(10)
+        px = rng.standard_normal((1, 32, 16, 1)).astype(np.float32)
+        with torch.no_grad():
+            mean_t = tref.encode_mode(_to_torch_nchw(px)).numpy().transpose(
+                0, 2, 3, 1
+            )
+        z_f = np.asarray(
+            vae.apply({"params": params}, jnp.asarray(px), method=vae.encode)
+        ) / cfg.scaling_factor
+        np.testing.assert_allclose(z_f, mean_t, atol=2e-4, rtol=1e-3)
 
 
 class TestK22UNetTorchParity:

@@ -266,20 +266,28 @@ class UNet2DConditionT(nn.Module):
         self.time_embedding = TimestepEmbeddingT(blocks[0], temb_dim)
         if cfg.addition_embed_dim:
             self.add_embedding = TimestepEmbeddingT(cfg.addition_embed_dim, temb_dim)
+        # AudioLDM: `simple_projection` class embedding, concatenated to
+        # temb, so the blocks see doubled conditioning width
+        class_embed_dim = getattr(cfg, "class_embed_dim", 0)
+        concat = class_embed_dim and getattr(cfg, "class_embeddings_concat", False)
+        if class_embed_dim:
+            self.class_embedding = nn.Linear(class_embed_dim, temb_dim)
+        block_temb = temb_dim * (2 if concat else 1)
+        cross_dim = cfg.cross_attention_dim or None  # 0/None -> self-attn
         self.conv_in = nn.Conv2d(cfg.in_channels, blocks[0], 3, padding=1)
         self.down_blocks = nn.ModuleList()
         ch = blocks[0]
         for b, out_ch in enumerate(blocks):
             last = b == len(blocks) - 1
             self.down_blocks.append(
-                DownBlockT(ch, out_ch, temb_dim, cfg.layers_per_block,
+                DownBlockT(ch, out_ch, block_temb, cfg.layers_per_block,
                            cfg.transformer_layers[b], heads[b],
-                           cfg.cross_attention_dim, add_down=not last)
+                           cross_dim, add_down=not last)
             )
             ch = out_ch
-        self.mid_block = MidBlockT(blocks[-1], temb_dim,
+        self.mid_block = MidBlockT(blocks[-1], block_temb,
                                    cfg.mid_transformer_layers, heads[-1],
-                                   cfg.cross_attention_dim)
+                                   cross_dim)
         # skip channel bookkeeping mirrors diffusers
         skip_chs_all = [blocks[0]]
         for b, out_ch in enumerate(blocks):
@@ -293,15 +301,16 @@ class UNet2DConditionT(nn.Module):
             last = b == len(blocks) - 1
             skip_chs = [skip_chs_all.pop() for _ in range(cfg.layers_per_block + 1)]
             self.up_blocks.append(
-                UpBlockT(ch, skip_chs, out_ch, temb_dim, cfg.layers_per_block + 1,
+                UpBlockT(ch, skip_chs, out_ch, block_temb, cfg.layers_per_block + 1,
                          cfg.transformer_layers[rev], heads[rev],
-                         cfg.cross_attention_dim, add_up=not last)
+                         cross_dim, add_up=not last)
             )
             ch = out_ch
         self.conv_norm_out = nn.GroupNorm(32, blocks[0], eps=1e-5)
         self.conv_out = nn.Conv2d(blocks[0], cfg.out_channels, 3, padding=1)
 
-    def forward(self, sample, timesteps, context, added_cond=None):
+    def forward(self, sample, timesteps, context, added_cond=None,
+                class_labels=None):
         cfg = self.cfg
         temb = self.time_embedding(
             timestep_embedding_t(timesteps, cfg.block_out_channels[0],
@@ -316,6 +325,12 @@ class UNet2DConditionT(nn.Module):
             temb = temb + self.add_embedding(
                 torch.cat([added_cond["text_embeds"], tid], dim=-1)
             )
+        if getattr(cfg, "class_embed_dim", 0):
+            class_emb = self.class_embedding(class_labels)
+            if getattr(cfg, "class_embeddings_concat", False):
+                temb = torch.cat([temb, class_emb], dim=-1)
+            else:
+                temb = temb + class_emb
         x = self.conv_in(sample)
         skips = [x]
         for block in self.down_blocks:
