@@ -106,7 +106,7 @@ _UNSUPPORTED_CHECK_KEYWORDS = (
     # AudioLDM v1 converts, AudioLDM2's different component set (GPT-2
     # projection bridge, text_encoder_2, list-valued cross_attention_dim)
     # does not.
-    "audioldm2", "bark", "zeroscope", "text-to-video",
+    "audioldm2", "zeroscope", "text-to-video",
     "i2vgen", "stable-video", "damo", "kandinsky-3", "kandinsky3",
     "kandinsky-2-1", "cascade", "latent-upscaler", "openpose",
 )
@@ -141,6 +141,8 @@ def verify_local_model(model_name: str, root: Path | None = None) -> dict | None
         return _verify_kandinsky_model(model_name, root)
     if "audioldm" in name:
         return _verify_audioldm_model(model_name, root)
+    if "bark" in name:
+        return _verify_bark_model(model_name, root)
     if name.startswith("deepfloyd/"):
         return _verify_if_model(model_name, root)
     if "animatediff" in name or "motion-adapter" in name:
@@ -370,6 +372,15 @@ def _verify_flux_model(model_name: str, root: Path) -> dict:
         assert_tree_shapes_match(converted, expected[comp], prefix=comp)
         counts[comp] = _param_count(converted)
     return counts
+
+
+def _verify_bark_model(model_name: str, root: Path) -> dict:
+    """suno/bark repo: the pipeline's own loader converts + shape-checks
+    all three GPT stages and the EnCodec codec, so a green check here is
+    exactly what BarkPipeline serves (reference swarm/audio/bark.py:16-21)."""
+    from .pipelines.bark import load_bark_checkpoint, verify_bark_params
+
+    return verify_bark_params(load_bark_checkpoint(root / model_name, model_name))
 
 
 def _verify_audioldm_model(model_name: str, root: Path) -> dict:

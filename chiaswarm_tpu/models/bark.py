@@ -34,6 +34,11 @@ class BarkGPTConfig:
     d_model: int = 768
     block_size: int = 1024
     causal: bool = True
+    # fine stage (transformers BarkFineModel): one embedding table per
+    # codebook (summed over books 0..codebook_idx) and one LM head per
+    # predicted book. 0 = single-table causal stages (semantic/coarse).
+    n_codes_total: int = 0
+    n_codes_given: int = 1
 
 
 # suno/bark token scheme constants (semantic rate ~50 Hz, EnCodec 75 Hz)
@@ -46,34 +51,36 @@ CODEC_RATE = 75
 
 
 def bark_small(stage: str) -> BarkGPTConfig:
-    """suno/bark-small geometry (12L/12H/768) per stage."""
+    """suno/bark vocab structure (transformers Bark*Config); real serving
+    reads the per-stage config.json from the checkpoint instead."""
     if stage == "semantic":
         return BarkGPTConfig(
-            input_vocab=SEMANTIC_VOCAB + 30_000,  # text ids ride above 10k
-            output_vocab=SEMANTIC_VOCAB,
+            input_vocab=129_600,  # text ids at 10_048.., specials at top
+            output_vocab=10_048,
         )
     if stage == "coarse":
-        return BarkGPTConfig(
-            input_vocab=SEMANTIC_VOCAB + N_COARSE_BOOKS * CODEBOOK_SIZE,
-            output_vocab=N_COARSE_BOOKS * CODEBOOK_SIZE,
-        )
-    return BarkGPTConfig(  # fine: all 8 codebooks in, one codebook out
-        input_vocab=N_FINE_BOOKS * CODEBOOK_SIZE,
-        output_vocab=CODEBOOK_SIZE,
+        # coarse codes live at 10_000 + book*1024 INSIDE the shared vocab
+        return BarkGPTConfig(input_vocab=12_096, output_vocab=12_096)
+    return BarkGPTConfig(  # fine: per-book tables, pad id = CODEBOOK_SIZE
+        input_vocab=1056,
+        output_vocab=1056,
         causal=False,
+        n_codes_total=N_FINE_BOOKS,
     )
 
 
 def bark_tiny(stage: str) -> BarkGPTConfig:
+    """Same vocab STRUCTURE as the real scheme at test scale
+    (pipelines.bark.TINY_SCHEME): semantic ids 0..999, text above 1048,
+    coarse codes at 1000 + book*64 in a shared in/out vocab."""
     kw = dict(n_layer=2, n_head=2, d_model=32, block_size=128)
     if stage == "semantic":
         return BarkGPTConfig(input_vocab=1200, output_vocab=1000, **kw)
     if stage == "coarse":
-        return BarkGPTConfig(
-            input_vocab=1000 + N_COARSE_BOOKS * 64, output_vocab=2 * 64, **kw
-        )
+        return BarkGPTConfig(input_vocab=1136, output_vocab=1136, **kw)
     return BarkGPTConfig(
-        input_vocab=N_FINE_BOOKS * 64, output_vocab=64, causal=False, **kw
+        input_vocab=64 + 1, output_vocab=64, causal=False,
+        n_codes_total=N_FINE_BOOKS, **kw
     )
 
 
@@ -83,8 +90,8 @@ class _Block(nn.Module):
 
     def setup(self):
         cfg = self.config
-        self.ln1 = nn.LayerNorm(dtype=self.dtype)
-        self.ln2 = nn.LayerNorm(dtype=self.dtype)
+        self.ln1 = nn.LayerNorm(epsilon=1e-5, dtype=self.dtype)
+        self.ln2 = nn.LayerNorm(epsilon=1e-5, dtype=self.dtype)
         self.qkv = nn.Dense(3 * cfg.d_model, dtype=self.dtype)
         self.proj = nn.Dense(cfg.d_model, dtype=self.dtype)
         self.fc = nn.Dense(4 * cfg.d_model, dtype=self.dtype)
@@ -96,7 +103,8 @@ class _Block(nn.Module):
         return x.reshape(b, -1, h, self.config.d_model // h)
 
     def _mlp(self, x):
-        return self.fc_out(nn.gelu(self.fc(x)))
+        # transformers BarkMLP uses exact (erf) GELU, not the tanh approx
+        return self.fc_out(nn.gelu(self.fc(x), approximate=False))
 
     def __call__(self, x, mask=None):
         """Full-sequence pass. x [B,T,D]; mask [T,T] additive or None."""
@@ -144,25 +152,33 @@ class BarkGPT(nn.Module):
 
     def setup(self):
         cfg = self.config
-        self.tok_embed = nn.Embed(cfg.input_vocab, cfg.d_model, dtype=self.dtype)
+        if cfg.n_codes_total:
+            self.tok_embeds = [
+                nn.Embed(cfg.input_vocab, cfg.d_model, dtype=self.dtype,
+                         name=f"tok_embed_{i}")
+                for i in range(cfg.n_codes_total)
+            ]
+            self.heads = [
+                nn.Dense(cfg.output_vocab, use_bias=False, dtype=self.dtype,
+                         name=f"head_{i}")
+                for i in range(cfg.n_codes_total - cfg.n_codes_given)
+            ]
+        else:
+            self.tok_embed = nn.Embed(
+                cfg.input_vocab, cfg.d_model, dtype=self.dtype
+            )
+            self.head = nn.Dense(
+                cfg.output_vocab, use_bias=False, dtype=self.dtype
+            )
         self.pos_embed = nn.Embed(cfg.block_size, cfg.d_model, dtype=self.dtype)
         self.blocks = [
             _Block(cfg, dtype=self.dtype, name=f"block_{i}")
             for i in range(cfg.n_layer)
         ]
-        self.ln_f = nn.LayerNorm(dtype=self.dtype)
-        self.head = nn.Dense(cfg.output_vocab, use_bias=False, dtype=self.dtype)
+        self.ln_f = nn.LayerNorm(epsilon=1e-5, dtype=self.dtype)
 
-    def __call__(self, tokens):
-        """[B,T] (or [B,K,T] multi-codebook: embeddings sum over K, the
-        fine-stage conditioning scheme) -> logits [B,T,output_vocab]
-        (causal iff config.causal)."""
-        if tokens.ndim == 3:
-            t = tokens.shape[2]
-            x = self.tok_embed(tokens).sum(axis=1)
-        else:
-            t = tokens.shape[1]
-            x = self.tok_embed(tokens)
+    def _trunk(self, x):
+        t = x.shape[1]
         x = x + self.pos_embed(jnp.arange(t))[None]
         mask = None
         if self.config.causal:
@@ -171,7 +187,35 @@ class BarkGPT(nn.Module):
             ).astype(self.dtype)
         for block in self.blocks:
             x = block(x, mask)
-        return self.head(self.ln_f(x))
+        return self.ln_f(x)
+
+    def __call__(self, tokens, codebook_idx: int | None = None):
+        """Single-table stages: [B,T] -> logits [B,T,output_vocab] (causal
+        iff config.causal). Fine stage (n_codes_total): tokens [B,K,T],
+        `codebook_idx` a static int — embeddings sum over books
+        0..codebook_idx (transformers BarkFineModel semantics; unpredicted
+        books carry the pad id = codebook size) and the logits come from
+        that book's own head."""
+        if self.config.n_codes_total:
+            assert codebook_idx is not None, "fine stage needs codebook_idx"
+            x = sum(
+                self.tok_embeds[i](tokens[:, i])
+                for i in range(codebook_idx + 1)
+            )
+            x = self._trunk(x)
+            return self.heads[codebook_idx - self.config.n_codes_given](x)
+        x = self.tok_embed(tokens)
+        return self.head(self._trunk(x))
+
+    def init_all(self, tokens):
+        """Init-only entry touching every per-book table and head so a
+        single `init` materialises the full fine-stage parameter tree."""
+        cfg = self.config
+        if not cfg.n_codes_total:
+            return self(tokens)
+        x = sum(emb(tokens[:, i]) for i, emb in enumerate(self.tok_embeds))
+        x = self._trunk(x)
+        return sum(head(x) for head in self.heads)
 
     def embed_step(self, token, pos):
         """[B] int32, pos scalar -> [B,D] (decode-path embedding)."""
@@ -242,38 +286,3 @@ def generate(model: BarkGPT, params, prompt, n_new: int, rng,
     # out[i] is the sample made AFTER consuming position i; generation
     # begins once the prompt is exhausted
     return jnp.moveaxis(out, 0, 1)[:, t_prompt - 1:]
-
-
-class CodecDecoder(nn.Module):
-    """EnCodec-analog decoder: summed codebook embeddings -> waveform via a
-    SEANet-style transposed-conv upsampling stack."""
-
-    n_books: int = N_FINE_BOOKS
-    codebook_size: int = CODEBOOK_SIZE
-    d_model: int = 128
-    # product = samples per code frame (EnCodec 24 kHz: 320)
-    ratios: tuple[int, ...] = (8, 5, 4, 2)
-    dtype: jnp.dtype = jnp.float32
-
-    @nn.compact
-    def __call__(self, codes):
-        """codes [B, K, T] int32 -> wav [B, T * prod(ratios)] in [-1,1]."""
-        b, k_books, t = codes.shape
-        embeds = nn.Embed(
-            self.n_books * self.codebook_size, self.d_model, dtype=self.dtype,
-            name="codebook_embed",
-        )
-        offsets = (jnp.arange(k_books) * self.codebook_size)[None, :, None]
-        x = embeds(codes + offsets).sum(axis=1)  # [B, T, D]
-        x = nn.Conv(self.d_model, (7,), dtype=self.dtype)(x)
-        ch = self.d_model
-        for r in self.ratios:
-            ch = max(ch // 2, 16)
-            x = nn.gelu(x)
-            x = nn.ConvTranspose(
-                ch, (2 * r,), strides=(r,), dtype=self.dtype
-            )(x)
-            res = nn.Conv(ch, (3,), dtype=self.dtype)(nn.gelu(x))
-            x = x + nn.Conv(ch, (1,), dtype=self.dtype)(res)
-        x = nn.Conv(1, (7,), dtype=self.dtype)(nn.gelu(x))
-        return jnp.tanh(x[..., 0])

@@ -1090,3 +1090,173 @@ def infer_vae_config(state: dict, config_json: dict | None = None):
         scaling_factor=float(cfg_json.get("scaling_factor", 0.18215)),
         use_quant_conv="quant_conv.weight" in state,
     )
+
+
+# --- Bark (transformers BarkSemanticModel/BarkCoarseModel/BarkFineModel) ---
+
+
+def bark_gpt_rename(name: str) -> str | None:
+    """transformers Bark*Model names -> models.bark.BarkGPT names."""
+    if name.endswith("attn.bias") or name.endswith("attn.masked_bias"):
+        return None  # causal-mask buffers
+    name = name.replace("input_embeds_layers.", "tok_embed_")
+    name = name.replace("input_embeds_layer.", "tok_embed.")
+    name = name.replace("position_embeds_layer.", "pos_embed.")
+    name = name.replace("layers.", "block_")
+    name = name.replace("layernorm_1.", "ln1.")
+    name = name.replace("layernorm_2.", "ln2.")
+    name = name.replace("layernorm_final.", "ln_f.")
+    name = name.replace("attn.att_proj.", "qkv.")
+    name = name.replace("attn.out_proj.", "proj.")
+    name = name.replace("mlp.in_proj.", "fc.")
+    name = name.replace("mlp.out_proj.", "fc_out.")
+    name = name.replace("lm_heads.", "head_")
+    name = name.replace("lm_head.", "head.")
+    return name
+
+
+def convert_bark_gpt(state: dict) -> dict:
+    params = convert_state_dict(state, bark_gpt_rename)
+
+    # nn.Embed tables need `embedding` (untransposed), not `kernel`
+    def fix(tree: dict):
+        for key, v in list(tree.items()):
+            if isinstance(v, dict):
+                if key.startswith(("tok_embed", "pos_embed")) and "kernel" in v:
+                    v["embedding"] = np.ascontiguousarray(v.pop("kernel").T)
+                else:
+                    fix(v)
+
+    fix(params)
+    return params
+
+
+def split_bark_state(state: dict) -> dict:
+    """The HF suno/bark repo ships ONE state dict holding every stage;
+    split by prefix -> {"semantic"|"coarse"|"fine"|"codec": substate}."""
+    prefixes = {
+        "semantic.": "semantic",
+        "coarse_acoustics.": "coarse",
+        "fine_acoustics.": "fine",
+        "codec_model.": "codec",
+    }
+    out: dict[str, dict] = {}
+    for k, v in state.items():
+        for pre, stage in prefixes.items():
+            if k.startswith(pre):
+                out.setdefault(stage, {})[k[len(pre):]] = v
+                break
+    return out
+
+
+def infer_bark_gpt_config(stage_cfg: dict, stage: str):
+    """Per-stage geometry from the repo config.json's nested stage config
+    (keys: semantic_config / coarse_acoustics_config /
+    fine_acoustics_config)."""
+    from .bark import BarkGPTConfig
+
+    fine = stage == "fine"
+    return BarkGPTConfig(
+        input_vocab=int(stage_cfg.get("input_vocab_size", 10_048)),
+        output_vocab=int(stage_cfg.get("output_vocab_size", 10_048)),
+        n_layer=int(stage_cfg.get("num_layers", 12)),
+        n_head=int(stage_cfg.get("num_heads", 12)),
+        d_model=int(stage_cfg.get("hidden_size", 768)),
+        block_size=int(stage_cfg.get("block_size", 1024)),
+        causal=not fine,
+        n_codes_total=int(stage_cfg.get("n_codes_total", 8)) if fine else 0,
+        n_codes_given=int(stage_cfg.get("n_codes_given", 1)),
+    )
+
+
+# --- EnCodec decoder (transformers EncodecModel) ---
+
+
+def _fold_weight_norm(g: np.ndarray, v: np.ndarray) -> np.ndarray:
+    """weight_norm: w = g * v / ||v|| with the norm over all dims but 0."""
+    flat = v.reshape(v.shape[0], -1)
+    norm = np.linalg.norm(flat, axis=1).reshape((-1,) + (1,) * (v.ndim - 1))
+    return g * v / np.maximum(norm, 1e-12)
+
+
+def infer_encodec_config(config_json: dict | None = None):
+    from .encodec import EncodecConfig
+
+    cfg = config_json or {}
+    base = EncodecConfig()
+    return EncodecConfig(
+        hidden_size=int(cfg.get("hidden_size", base.hidden_size)),
+        num_filters=int(cfg.get("num_filters", base.num_filters)),
+        upsampling_ratios=tuple(
+            cfg.get("upsampling_ratios", base.upsampling_ratios)
+        ),
+        kernel_size=int(cfg.get("kernel_size", base.kernel_size)),
+        last_kernel_size=int(
+            cfg.get("last_kernel_size", base.last_kernel_size)
+        ),
+        residual_kernel_size=int(
+            cfg.get("residual_kernel_size", base.residual_kernel_size)
+        ),
+        dilation_growth_rate=int(
+            cfg.get("dilation_growth_rate", base.dilation_growth_rate)
+        ),
+        num_residual_layers=int(
+            cfg.get("num_residual_layers", base.num_residual_layers)
+        ),
+        num_lstm_layers=int(cfg.get("num_lstm_layers", base.num_lstm_layers)),
+        compress=int(cfg.get("compress", base.compress)),
+        codebook_size=int(cfg.get("codebook_size", base.codebook_size)),
+        audio_channels=int(cfg.get("audio_channels", base.audio_channels)),
+        pad_mode=str(cfg.get("pad_mode", base.pad_mode)),
+        use_conv_shortcut=bool(
+            cfg.get("use_conv_shortcut", base.use_conv_shortcut)
+        ),
+    )
+
+
+def convert_encodec_decoder(state: dict, max_codebooks: int | None = None) -> dict:
+    """transformers EncodecModel state (decoder.* + quantizer.*) ->
+    models.encodec.EncodecDecoderModel params. Weight-norm pairs
+    (parametrizations.weight.original0/1) fold into plain kernels;
+    Conv1d kernels go OIK->KIO, ConvTranspose1d IOK->K,out,in (flax
+    `transpose_kernel=True` layout, verified numerically in tests).
+    `max_codebooks` drops RVQ layers beyond the serving depth (the 24 kHz
+    checkpoint carries 32 codebooks; Bark uses 8)."""
+    import re
+
+    # pair up the weight-norm halves first
+    groups: dict[str, dict] = {}
+    loose: dict[str, np.ndarray] = {}
+    for k, v in state.items():
+        m = re.match(r"(.*)\.parametrizations\.weight\.original([01])$", k)
+        if m:
+            groups.setdefault(m.group(1), {})[m.group(2)] = np.asarray(v)
+        else:
+            loose[k] = np.asarray(v)
+
+    params: dict = {}
+
+    def assign(torch_name: str, leaf: str, value):
+        path, _ = torch_name_to_flax_path(torch_name + ".x")
+        _assign(params, path + [leaf], value)
+
+    for base, halves in groups.items():
+        if not base.startswith("decoder."):
+            continue
+        w = _fold_weight_norm(halves["0"], halves["1"])
+        # One permutation serves both conv kinds: torch Conv1d [out,in,k]
+        # -> flax Conv [k,in,out], and torch ConvTranspose1d [in,out,k] ->
+        # the flax transpose_kernel=True layout [k,out,in] (measured exact
+        # vs torch, maxerr 0.0) — both are axis reversal.
+        assign(base, "kernel", np.ascontiguousarray(w.transpose(2, 1, 0)))
+    for k, v in loose.items():
+        if k.startswith("decoder.") and k.endswith(".bias"):
+            assign(k[: -len(".bias")], "bias", v)
+        elif k.startswith("decoder.") and ".lstm." in k:
+            mod, leaf = k.rsplit(".lstm.", 1)
+            assign(mod, leaf, v)
+        elif re.match(r"quantizer\.layers\.\d+\.codebook\.embed$", k):
+            idx = int(k.split(".")[2])
+            if max_codebooks is None or idx < max_codebooks:
+                params[f"codebook_{idx}"] = v
+    return params

@@ -5,14 +5,25 @@ Reference behavior replaced: swarm/audio/bark.py:16-21 (suno-bark
 rebuild keeps the four-stage suno/bark architecture (models/bark.py) as
 ONE resident jitted program per (prompt-budget, duration) bucket: both AR
 stages run as `lax.scan` KV-cache loops, the fine stage refines codebooks
-3..8 with a bidirectional transformer, and the codec decoder emits the
-waveform — text-in, audio-out in a single XLA program, nothing returns to
-the host between stages. Real suno/bark weight conversion is not wired
-yet, so non-test model names fail loudly per weights.py.
+3..8 with a bidirectional transformer, and the EnCodec decoder
+(models/encodec.py) emits the waveform — text-in, audio-out in a single
+XLA program, nothing returns to the host between stages.
+
+Real suno/bark weights convert from the HF repo's single state dict
+(conversion.split_bark_state / convert_bark_gpt /
+convert_encodec_decoder), every GPT stage and the codec numerically
+validated against transformers' Bark*Model / EncodecModel
+(tests/test_bark_conversion.py). The token scheme (text offset 10_048,
+pads, infer tokens, coarse codes at 10_000 + book*1024) follows
+transformers' Bark generation configs. One deliberate divergence: the
+coarse stage runs the full context in one scan instead of 60-token
+sliding windows, so the renderable duration is capped by the coarse
+position table (~5 s per job) rather than unbounded.
 """
 
 from __future__ import annotations
 
+import dataclasses
 import logging
 import threading
 import time
@@ -28,14 +39,13 @@ from ..models.bark import (
     N_COARSE_BOOKS,
     N_FINE_BOOKS,
     SEMANTIC_RATE,
-    SEMANTIC_VOCAB,
     BarkGPT,
-    CodecDecoder,
     bark_small,
     bark_tiny,
     generate,
 )
-from ..models.bert_tokenizer import HashBertTokenizer
+from ..models.bert_tokenizer import BertWordPieceTokenizer, HashBertTokenizer
+from ..models.encodec import TINY_ENCODEC, EncodecConfig, EncodecDecoderModel
 from ..parallel.mesh import make_mesh, replicated
 from ..registry import register_family
 from ..weights import is_test_model, require_weights_present
@@ -44,13 +54,156 @@ logger = logging.getLogger(__name__)
 
 SAMPLE_RATE = 24_000  # EnCodec rate the bark codec targets
 
-_NO_CONVERSION_HINT = (
-    "This worker cannot serve real suno/bark weights yet; only the "
-    "test/tiny bark stack is available."
+
+@dataclasses.dataclass(frozen=True)
+class BarkTokenScheme:
+    """The id bookkeeping between stages (transformers Bark generation
+    configs; values are the real suno/bark constants by default)."""
+
+    text_offset: int = 10_048
+    text_pad: int = 129_595
+    sem_pad: int = 10_000
+    sem_infer: int = 129_599
+    sem_vocab: int = 10_000
+    max_text_len: int = 256
+    sem_history_len: int = 256
+    # coarse_pad pads the semantic history in transformers' sliding-window
+    # coarse generation; the full-context scan here never pads, so the
+    # field is carried only for scheme completeness
+    coarse_pad: int = 12_048
+    coarse_infer: int = 12_050
+    coarse_code_offset: int = 10_000  # coarse codes live above semantic ids
+    codebook_size: int = CODEBOOK_SIZE
+
+
+TINY_SCHEME = BarkTokenScheme(
+    text_offset=1048, text_pad=1195, sem_pad=1000, sem_infer=1199,
+    sem_vocab=1000, max_text_len=32, sem_history_len=32,
+    coarse_pad=1128, coarse_infer=1130, coarse_code_offset=1000,
+    codebook_size=64,
 )
 
 
 _is_tiny = is_test_model
+
+
+@dataclasses.dataclass(frozen=True)
+class BarkCheckpoint:
+    """A converted suno/bark repo: per-stage configs + token scheme +
+    params. ONE loader serves both `initialize --check` and the pipeline
+    so the two can never drift."""
+
+    sem_cfg: object
+    coarse_cfg: object
+    fine_cfg: object
+    codec_cfg: EncodecConfig
+    scheme: BarkTokenScheme
+    params: dict
+
+
+def load_bark_checkpoint(model_dir, model_name: str = "") -> BarkCheckpoint:
+    """HF suno/bark repo: one state dict with per-stage prefixes,
+    config.json with nested stage configs, generation_config.json with the
+    token-scheme constants."""
+    import json
+
+    from ..models.conversion import (
+        convert_bark_gpt,
+        convert_encodec_decoder,
+        infer_bark_gpt_config,
+        infer_encodec_config,
+        load_torch_state_dict,
+        split_bark_state,
+    )
+
+    cfg_path = model_dir / "config.json"
+    repo_cfg = json.loads(cfg_path.read_text()) if cfg_path.is_file() else {}
+    sem_cfg = infer_bark_gpt_config(
+        repo_cfg.get("semantic_config", {}), "semantic"
+    )
+    coarse_cfg = infer_bark_gpt_config(
+        repo_cfg.get("coarse_acoustics_config", {}), "coarse"
+    )
+    fine_cfg = infer_bark_gpt_config(
+        repo_cfg.get("fine_acoustics_config", {}), "fine"
+    )
+    codec_cfg = infer_encodec_config(repo_cfg.get("codec_config", {}))
+    gen_path = model_dir / "generation_config.json"
+    gen = json.loads(gen_path.read_text()) if gen_path.is_file() else {}
+    sem_g = gen.get("semantic_config", {})
+    coarse_g = gen.get("coarse_acoustics_config", {})
+    base = BarkTokenScheme()
+    scheme = BarkTokenScheme(
+        text_offset=int(sem_g.get("text_encoding_offset", base.text_offset)),
+        text_pad=int(sem_g.get("text_pad_token", base.text_pad)),
+        sem_pad=int(sem_g.get("semantic_pad_token", base.sem_pad)),
+        sem_infer=int(sem_g.get("semantic_infer_token", base.sem_infer)),
+        sem_vocab=int(sem_g.get("semantic_vocab_size", base.sem_vocab)),
+        max_text_len=int(sem_g.get("max_input_semantic_length",
+                                   base.max_text_len)),
+        sem_history_len=int(sem_g.get("max_input_semantic_length",
+                                      base.sem_history_len)),
+        coarse_pad=int(coarse_g.get("coarse_semantic_pad_token",
+                                    base.coarse_pad)),
+        coarse_infer=int(coarse_g.get("coarse_infer_token",
+                                      base.coarse_infer)),
+        coarse_code_offset=int(sem_g.get("semantic_vocab_size",
+                                         base.coarse_code_offset)),
+        codebook_size=codec_cfg.codebook_size,
+    )
+    split = split_bark_state(load_torch_state_dict(model_dir))
+    missing = {"semantic", "coarse", "fine", "codec"} - set(split)
+    if missing:
+        raise ValueError(
+            f"{model_name or model_dir}: checkpoint lacks stages "
+            f"{sorted(missing)}"
+        )
+    params = {
+        "semantic": convert_bark_gpt(split["semantic"]),
+        "coarse": convert_bark_gpt(split["coarse"]),
+        "fine": convert_bark_gpt(split["fine"]),
+        "codec": convert_encodec_decoder(split["codec"], N_FINE_BOOKS),
+    }
+    return BarkCheckpoint(
+        sem_cfg, coarse_cfg, fine_cfg, codec_cfg, scheme, params
+    )
+
+
+def verify_bark_params(ckpt: BarkCheckpoint) -> dict:
+    """Shape-check every converted stage against its architecture;
+    -> per-stage param counts (the `--check` report)."""
+    import functools
+
+    from ..models.conversion import assert_tree_shapes_match
+
+    expected = {
+        "semantic": jax.eval_shape(
+            BarkGPT(ckpt.sem_cfg).init, jax.random.key(0),
+            jnp.zeros((1, 8), jnp.int32),
+        )["params"],
+        "coarse": jax.eval_shape(
+            BarkGPT(ckpt.coarse_cfg).init, jax.random.key(0),
+            jnp.zeros((1, 8), jnp.int32),
+        )["params"],
+        "fine": jax.eval_shape(
+            functools.partial(
+                BarkGPT(ckpt.fine_cfg).init, method=BarkGPT.init_all
+            ),
+            jax.random.key(0), jnp.zeros((1, N_FINE_BOOKS, 8), jnp.int32),
+        )["params"],
+        "codec": jax.eval_shape(
+            EncodecDecoderModel(ckpt.codec_cfg).init, jax.random.key(0),
+            jnp.zeros((1, N_FINE_BOOKS, 8), jnp.int32),
+        )["params"],
+    }
+    report = {}
+    for comp, tree in expected.items():
+        assert_tree_shapes_match(ckpt.params[comp], tree, prefix=comp)
+        report[comp] = sum(
+            int(np.prod(x.shape))
+            for x in jax.tree_util.tree_leaves(ckpt.params[comp])
+        )
+    return report
 
 
 class BarkPipeline:
@@ -58,19 +211,37 @@ class BarkPipeline:
 
     def __init__(self, model_name: str, chipset=None,
                  allow_random_init: bool = False):
-        require_weights_present(
-            model_name, None, allow_random_init, component="Bark TTS",
-            hint=_NO_CONVERSION_HINT,
-        )
         self.model_name = model_name
         self.chipset = chipset
         self.tiny = _is_tiny(model_name)
-        mk = bark_tiny if self.tiny else bark_small
-        self.sem_cfg = mk("semantic")
-        self.coarse_cfg = mk("coarse")
-        self.fine_cfg = mk("fine")
-        # OUTPUT-vocab slice width of one coarse codebook
-        self.cb = self.coarse_cfg.output_vocab // N_COARSE_BOOKS
+        model_dir = None if self.tiny else self._model_dir()
+        if model_dir is not None and not model_dir.is_dir():
+            model_dir = None
+        if not self.tiny and model_dir is None:
+            require_weights_present(
+                model_name, self._model_dir(), allow_random_init,
+                component="Bark TTS",
+            )
+
+        converted = None
+        if model_dir is not None:
+            ckpt = load_bark_checkpoint(model_dir, model_name)
+            verify_bark_params(ckpt)  # geometry mismatches surface here
+            self.sem_cfg = ckpt.sem_cfg
+            self.coarse_cfg = ckpt.coarse_cfg
+            self.fine_cfg = ckpt.fine_cfg
+            self.codec_cfg = ckpt.codec_cfg
+            self.scheme = ckpt.scheme
+            converted = ckpt.params
+        else:
+            mk = bark_tiny if self.tiny else bark_small
+            self.sem_cfg = mk("semantic")
+            self.coarse_cfg = mk("coarse")
+            self.fine_cfg = mk("fine")
+            self.codec_cfg = TINY_ENCODEC if self.tiny else EncodecConfig()
+            self.scheme = TINY_SCHEME if self.tiny else BarkTokenScheme()
+
+        self.cb = self.scheme.codebook_size
         # token rates scale down on the tiny stack so tests stay fast
         self.sem_rate = 8 if self.tiny else SEMANTIC_RATE
         self.codec_rate = 8 if self.tiny else CODEC_RATE
@@ -80,19 +251,9 @@ class BarkPipeline:
         self.semantic = BarkGPT(self.sem_cfg, dtype=self.dtype)
         self.coarse = BarkGPT(self.coarse_cfg, dtype=self.dtype)
         self.fine = BarkGPT(self.fine_cfg, dtype=self.dtype)
-        self.codec = CodecDecoder(
-            n_books=N_FINE_BOOKS,
-            codebook_size=self.cb,
-            d_model=32 if self.tiny else 128,
-            ratios=(4, 2) if self.tiny else (8, 5, 4, 2),
-            dtype=self.dtype,
-        )
-        self.hop = int(np.prod(self.codec.ratios))
-        # text ids ride above the semantic ids in the semantic input vocab
-        self.text_vocab = self.sem_cfg.input_vocab - SEMANTIC_VOCAB \
-            if not self.tiny else self.sem_cfg.input_vocab - 1000
-        self.sem_out = self.sem_cfg.output_vocab
-        self.tokenizer = HashBertTokenizer(self.text_vocab)
+        self.codec = EncodecDecoderModel(self.codec_cfg, dtype=self.dtype)
+        self.hop = int(np.prod(self.codec_cfg.upsampling_ratios))
+        self.tokenizer = self._tokenizer(model_dir)
         self.mesh = (
             chipset.mesh() if chipset is not None else make_mesh(jax.devices()[:1])
         )
@@ -100,33 +261,57 @@ class BarkPipeline:
         rng = jax.random.key(zlib.crc32(model_name.encode()))
         k1, k2, k3, k4 = jax.random.split(rng, 4)
         with jax.default_device(jax.local_devices(backend="cpu")[0]):
-            sem_params = self.semantic.init(
-                k1, jnp.zeros((1, 8), jnp.int32)
-            )["params"]
-            coarse_params = self.coarse.init(
-                k2, jnp.zeros((1, 8), jnp.int32)
-            )["params"]
-            fine_params = self.fine.init(
-                k3, jnp.zeros((1, N_FINE_BOOKS, 8), jnp.int32)
-            )["params"]
-            codec_params = self.codec.init(
-                k4, jnp.zeros((1, N_FINE_BOOKS, 8), jnp.int32)
-            )["params"]
+            if converted is not None:
+                params = converted
+            else:
+                params = {
+                    "semantic": self.semantic.init(
+                        k1, jnp.zeros((1, 8), jnp.int32)
+                    )["params"],
+                    "coarse": self.coarse.init(
+                        k2, jnp.zeros((1, 8), jnp.int32)
+                    )["params"],
+                    "fine": self.fine.init(
+                        k3, jnp.zeros((1, N_FINE_BOOKS, 8), jnp.int32),
+                        method=BarkGPT.init_all,
+                    )["params"],
+                    "codec": self.codec.init(
+                        k4, jnp.zeros((1, N_FINE_BOOKS, 8), jnp.int32)
+                    )["params"],
+                }
         cast = lambda x: (
             jnp.asarray(x, self.dtype) if jnp.issubdtype(
                 jnp.asarray(x).dtype, jnp.floating) else jnp.asarray(x)
         )
         self.params = jax.device_put(
-            jax.tree_util.tree_map(cast, {
-                "semantic": sem_params,
-                "coarse": coarse_params,
-                "fine": fine_params,
-                "codec": codec_params,
-            }),
-            replicated(self.mesh),
+            jax.tree_util.tree_map(cast, params), replicated(self.mesh)
         )
         self._programs: dict[tuple, callable] = {}
         self._lock = threading.Lock()
+
+    def _model_dir(self):
+        from pathlib import Path
+
+        from ..settings import load_settings
+
+        return (
+            Path(load_settings().model_root_dir).expanduser() / self.model_name
+        )
+
+    def _tokenizer(self, model_dir):
+        if model_dir is not None:
+            vocab = model_dir / "tokenizer" / "vocab.txt"
+            if not vocab.is_file():
+                vocab = model_dir / "vocab.txt"
+            if vocab.is_file():
+                return BertWordPieceTokenizer.from_file(vocab)
+            raise ValueError(
+                f"{self.model_name}: converted weights present but no "
+                "tokenizer vocab.txt — hash-tokenized prompts would drive "
+                "the real semantic stage with garbage ids"
+            )
+        text_vocab = 100 if self.tiny else 119_547  # bert-multilingual size
+        return HashBertTokenizer(text_vocab)
 
     def release(self):
         self.params = None
@@ -142,53 +327,71 @@ class BarkPipeline:
             self.semantic, self.coarse, self.fine, self.codec
         )
         cb = self.cb
-        sem_offset = SEMANTIC_VOCAB if not self.tiny else 1000
+        scheme = self.scheme
         n_coarse_tokens = n_frames * N_COARSE_BOOKS
 
-        def run(params, rng, text_ids, temperature):
+        def run(params, rng, sem_prompt, temperature):
             k_sem, k_coarse, k_fine = jax.random.split(rng, 3)
-            # stage 1: text -> semantic (text ids arrive pre-offset)
+            # stage 1: text -> semantic. Prompt arrives pre-built per the
+            # transformers scheme ([text+offset | pad]*L + [sem history
+            # pads] + [infer]); sampling stays inside the semantic vocab
+            # (fixed-length generation; no eos early-stop — static shapes)
             sem = generate(
-                semantic, params["semantic"], text_ids, n_sem, k_sem,
+                semantic, params["semantic"], sem_prompt, n_sem, k_sem,
                 temperature=temperature,
+                range_fn=lambda _: (0, scheme.sem_vocab),
             )
-            # stage 2: semantic -> coarse, codebooks interleaved with a
-            # parity range constraint; coarse ids ride above semantic ids
-            # in the coarse input vocab
+            # stage 2: semantic -> coarse. Prompt = semantic ids ++
+            # [coarse_infer]; the two codebooks interleave, each book's
+            # codes living at coarse_code_offset + book*cb inside the
+            # SHARED coarse vocab (output vocab == input vocab, so sampled
+            # ids feed back with no extra offset)
+            coarse_prompt = jnp.concatenate(
+                [sem, jnp.full((sem.shape[0], 1), scheme.coarse_infer,
+                               sem.dtype)], axis=1,
+            )
+
             def parity_range(gen_idx):
-                lo = (gen_idx % N_COARSE_BOOKS) * cb
+                lo = scheme.coarse_code_offset + (
+                    gen_idx % N_COARSE_BOOKS
+                ) * cb
                 return lo, lo + cb
 
             coarse_tokens = generate(
-                coarse, params["coarse"], sem, n_coarse_tokens, k_coarse,
-                temperature=temperature, input_offset=sem_offset,
-                range_fn=parity_range,
+                coarse, params["coarse"], coarse_prompt, n_coarse_tokens,
+                k_coarse, temperature=temperature, range_fn=parity_range,
             )
-            # de-interleave [B, 2*T] -> [B, 2, T]; strip the parity offset
+            # de-interleave [B, 2*T] -> [B, 2, T]; strip offsets to raw codes
             c = coarse_tokens.reshape(
                 coarse_tokens.shape[0], n_frames, N_COARSE_BOOKS
             )
-            c = jnp.moveaxis(c, 1, 2) - (jnp.arange(N_COARSE_BOOKS) * cb)[
-                None, :, None
-            ]
+            c = jnp.moveaxis(c, 1, 2) - scheme.coarse_code_offset - (
+                jnp.arange(N_COARSE_BOOKS) * cb
+            )[None, :, None]
             c = jnp.clip(c, 0, cb - 1)
-            # stage 3: fine refinement — codebooks 3..8 predicted from all
-            # books so far (bidirectional, one pass per book)
+            # stage 3: fine refinement — books 3..8 predicted one pass per
+            # book (bidirectional). Unpredicted books carry the pad id
+            # (= codebook size, transformers BarkFineModel.generate), and
+            # each book embeds through its own table — no id offsets.
             codes = jnp.concatenate(
-                [c] + [jnp.zeros_like(c[:, :1])] * (N_FINE_BOOKS - N_COARSE_BOOKS),
+                [c] + [jnp.full_like(c[:, :1], cb)]
+                * (N_FINE_BOOKS - N_COARSE_BOOKS),
                 axis=1,
             )
-            book_offsets = (jnp.arange(N_FINE_BOOKS) * cb)[None, :, None]
             for target in range(N_COARSE_BOOKS, N_FINE_BOOKS):
                 logits = fine.apply(
-                    {"params": params["fine"]}, codes + book_offsets
+                    {"params": params["fine"]}, codes, codebook_idx=target
                 )
+                # real fine heads are wider than the codebook (1056 vs
+                # 1024: pad/unused columns); sample only the valid codes
+                # like transformers BarkFineModel.generate, never clip
+                # out-of-range draws onto code cb-1
                 sampled = jax.random.categorical(
                     jax.random.fold_in(k_fine, target),
-                    logits.astype(jnp.float32)
+                    logits[..., :cb].astype(jnp.float32)
                     / jnp.maximum(jnp.asarray(temperature, jnp.float32), 1e-4),
                 )
-                codes = codes.at[:, target].set(jnp.clip(sampled, 0, cb - 1))
+                codes = codes.at[:, target].set(sampled)
             # stage 4: codec decode to waveform
             return codec.apply({"params": params["codec"]}, codes)
 
@@ -214,13 +417,24 @@ class BarkPipeline:
         kwargs.pop("negative_prompt", None)
         kwargs.pop("num_inference_steps", None)  # TTS has no denoise steps
 
-        # static text budget: bucket to 32-token multiples
-        ids = self.tokenizer.encode(prompt)[: self.sem_cfg.block_size // 4]
-        t_text = max(32, (len(ids) + 31) // 32 * 32)
-        sem_offset = SEMANTIC_VOCAB if not self.tiny else 1000
-        text_arr = np.zeros((1, t_text), np.int32)
-        text_arr[0, : len(ids)] = np.asarray(ids, np.int32) % self.text_vocab
-        text_arr = text_arr + sem_offset  # text ids live above semantic ids
+        # transformers Bark prompt: [text ids + text_offset, padded with
+        # text_pad] ++ [semantic-history pads] ++ [semantic infer token]
+        scheme = self.scheme
+        ids = self.tokenizer.encode(prompt)[: scheme.max_text_len]
+        text_arr = np.full((1, scheme.max_text_len), scheme.text_pad, np.int32)
+        if ids:
+            text_arr[0, : len(ids)] = (
+                np.asarray(ids, np.int32) + scheme.text_offset
+            )
+        sem_prompt = np.concatenate(
+            [
+                text_arr,
+                np.full((1, scheme.sem_history_len), scheme.sem_pad, np.int32),
+                np.full((1, 1), scheme.sem_infer, np.int32),
+            ],
+            axis=1,
+        )
+        t_text = sem_prompt.shape[1]
 
         n_sem = max(8, int(duration * self.sem_rate))
         n_frames = max(8, int(duration * self.codec_rate))
@@ -228,7 +442,8 @@ class BarkPipeline:
         n_sem = min(n_sem, self.sem_cfg.block_size - t_text)
         n_frames = min(
             n_frames,
-            (self.coarse_cfg.block_size - n_sem) // N_COARSE_BOOKS,
+            # coarse prompt = n_sem semantic ids + infer token
+            (self.coarse_cfg.block_size - n_sem - 1) // N_COARSE_BOOKS,
             self.fine_cfg.block_size,
         )
         # the renderable duration is set by n_frames: shrink the semantic
@@ -245,7 +460,7 @@ class BarkPipeline:
         program = self._program((t_text, n_sem, n_frames))
         t0 = time.perf_counter()
         wav = jax.block_until_ready(
-            program(params, rng, jnp.asarray(text_arr),
+            program(params, rng, jnp.asarray(sem_prompt),
                     jnp.float32(temperature))
         )
         timings["generate_s"] = round(time.perf_counter() - t0, 3)

@@ -193,8 +193,11 @@ def test_full_audioldm_repo_check_and_pipeline(sdaas_root, tmp_path):
     from chiaswarm_tpu.settings import load_settings
     from pathlib import Path
 
+    from chiaswarm_tpu.settings import Settings, save_settings
+
     name = "cvssp/audioldm-s-full-v2"
-    root = Path(load_settings().model_root_dir).expanduser()
+    root = tmp_path / "models"
+    save_settings(Settings(model_root_dir=str(root)))
     repo = root / name
     torch.manual_seed(11)
 

@@ -12,7 +12,6 @@ import jax.numpy as jnp
 
 from chiaswarm_tpu.models.bark import (
     BarkGPT,
-    CodecDecoder,
     bark_tiny,
     generate,
 )
@@ -80,12 +79,14 @@ def test_generate_range_constraint():
 
 
 def test_codec_decoder_output():
-    codec = CodecDecoder(n_books=8, codebook_size=64, d_model=32, ratios=(4, 2))
+    from chiaswarm_tpu.models.encodec import TINY_ENCODEC, EncodecDecoderModel
+
+    codec = EncodecDecoderModel(TINY_ENCODEC)
     codes = jax.random.randint(jax.random.key(0), (1, 8, 16), 0, 64)
     params = codec.init(jax.random.key(1), codes)
     wav = codec.apply(params, codes)
-    assert wav.shape == (1, 16 * 8)  # T * prod(ratios)
-    assert float(jnp.abs(wav).max()) <= 1.0
+    assert wav.shape == (1, 16 * 8)  # T * prod(upsampling_ratios)
+    assert jnp.isfinite(wav).all()
 
 
 @pytest.fixture(scope="module")

@@ -1,0 +1,282 @@
+"""Bark real-weight conversion: numeric parity against transformers.
+
+transformers ships the actual BarkSemanticModel/BarkCoarseModel/
+BarkFineModel and EncodecModel graphs, so — unlike the diffusers families
+— Bark conversion is validated against the real reference implementation
+offline: converted weights must drive the flax modules to the same logits
+/ waveform (VERDICT r03 item 2; reference swarm/audio/bark.py:16-21).
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+torch = pytest.importorskip("torch")
+
+from chiaswarm_tpu.models.bark import BarkGPT, BarkGPTConfig  # noqa: E402
+from chiaswarm_tpu.models.conversion import (  # noqa: E402
+    convert_bark_gpt,
+    convert_encodec_decoder,
+    infer_bark_gpt_config,
+    infer_encodec_config,
+    split_bark_state,
+)
+from chiaswarm_tpu.models.encodec import (  # noqa: E402
+    TINY_ENCODEC,
+    EncodecDecoderModel,
+)
+
+
+class TestBarkGPTParity:
+    def _causal_pair(self, causal=True):
+        from transformers import BarkSemanticConfig, BarkSemanticModel
+
+        hf = BarkSemanticConfig(
+            num_layers=2, num_heads=2, hidden_size=32, block_size=64,
+            input_vocab_size=120, output_vocab_size=100, dropout=0.0,
+        )
+        torch.manual_seed(0)
+        tref = BarkSemanticModel(hf).eval()
+        state = {k: v.numpy() for k, v in tref.state_dict().items()}
+        cfg = BarkGPTConfig(
+            input_vocab=120, output_vocab=100, n_layer=2, n_head=2,
+            d_model=32, block_size=64, causal=causal,
+        )
+        return tref, BarkGPT(cfg), convert_bark_gpt(state)
+
+    def test_semantic_logits_match(self):
+        tref, flax_model, params = self._causal_pair()
+        ids = np.array([[3, 17, 99, 5, 64, 2, 11, 8]], np.int64)
+        with torch.no_grad():
+            t_logits = tref(torch.from_numpy(ids)).logits.numpy()
+        f_logits = np.asarray(
+            flax_model.apply({"params": params}, jnp.asarray(ids, jnp.int32))
+        )
+        np.testing.assert_allclose(f_logits, t_logits, atol=2e-4, rtol=1e-3)
+
+    def test_fine_logits_match_per_codebook(self):
+        from transformers import BarkFineConfig, BarkFineModel
+
+        hf = BarkFineConfig(
+            num_layers=2, num_heads=2, hidden_size=32, block_size=64,
+            input_vocab_size=65, output_vocab_size=65,
+            n_codes_total=8, n_codes_given=1, dropout=0.0,
+        )
+        torch.manual_seed(1)
+        tref = BarkFineModel(hf).eval()
+        state = {k: v.numpy() for k, v in tref.state_dict().items()}
+        cfg = BarkGPTConfig(
+            input_vocab=65, output_vocab=65, n_layer=2, n_head=2,
+            d_model=32, block_size=64, causal=False,
+            n_codes_total=8, n_codes_given=1,
+        )
+        flax_model = BarkGPT(cfg)
+        params = convert_bark_gpt(state)
+        rng = np.random.default_rng(2)
+        ids = rng.integers(0, 64, (1, 12, 8))  # [B, T, K] torch layout
+        for codebook_idx in (2, 5, 7):
+            with torch.no_grad():
+                t_logits = tref(
+                    codebook_idx, torch.from_numpy(ids)
+                ).logits.numpy()
+            f_logits = np.asarray(
+                flax_model.apply(
+                    {"params": params},
+                    jnp.asarray(ids.transpose(0, 2, 1), jnp.int32),
+                    codebook_idx=codebook_idx,
+                )
+            )
+            np.testing.assert_allclose(
+                f_logits, t_logits, atol=2e-4, rtol=1e-3,
+                err_msg=f"codebook {codebook_idx}",
+            )
+
+
+class TestEncodecParity:
+    def test_decode_matches(self):
+        from transformers import EncodecConfig as HFEncodecConfig
+        from transformers import EncodecModel
+
+        hf = HFEncodecConfig(
+            num_filters=TINY_ENCODEC.num_filters,
+            num_residual_layers=TINY_ENCODEC.num_residual_layers,
+            upsampling_ratios=list(TINY_ENCODEC.upsampling_ratios),
+            codebook_size=TINY_ENCODEC.codebook_size,
+            codebook_dim=TINY_ENCODEC.hidden_size,
+            hidden_size=TINY_ENCODEC.hidden_size,
+            num_lstm_layers=TINY_ENCODEC.num_lstm_layers,
+            audio_channels=1,
+            kernel_size=TINY_ENCODEC.kernel_size,
+            last_kernel_size=TINY_ENCODEC.last_kernel_size,
+            residual_kernel_size=TINY_ENCODEC.residual_kernel_size,
+            use_causal_conv=True,
+            pad_mode="reflect",
+            trim_right_ratio=1.0,
+            normalize=False,
+        )
+        torch.manual_seed(3)
+        tref = EncodecModel(hf).eval()
+        state = {k: v.numpy() for k, v in tref.state_dict().items()}
+        params = convert_encodec_decoder(state)
+
+        rng = np.random.default_rng(4)
+        # the tiny HF config derives a single quantizer layer from its
+        # bandwidth table; real bark uses 8 (the flax side sums whatever
+        # K the codes carry)
+        n_books, t = 1, 24
+        codes = rng.integers(
+            0, TINY_ENCODEC.codebook_size, (1, n_books, t)
+        )
+        with torch.no_grad():
+            t_wav = tref.decode(
+                torch.from_numpy(codes)[None], [None]
+            ).audio_values.numpy()
+        model = EncodecDecoderModel(TINY_ENCODEC)
+        f_wav = np.asarray(
+            model.apply({"params": params}, jnp.asarray(codes, jnp.int32))
+        )
+        assert f_wav.shape == (1, t_wav.shape[-1])
+        np.testing.assert_allclose(
+            f_wav, t_wav[:, 0], atol=5e-4, rtol=1e-3
+        )
+
+
+def test_infer_bark_config_and_split():
+    cfg = infer_bark_gpt_config(
+        {"input_vocab_size": 129_600, "output_vocab_size": 10_048,
+         "num_layers": 24, "num_heads": 16, "hidden_size": 1024,
+         "block_size": 1024},
+        "semantic",
+    )
+    assert cfg.input_vocab == 129_600 and cfg.causal and not cfg.n_codes_total
+    fine = infer_bark_gpt_config(
+        {"n_codes_total": 8, "n_codes_given": 1}, "fine"
+    )
+    assert fine.n_codes_total == 8 and not fine.causal
+
+    split = split_bark_state({
+        "semantic.lm_head.weight": np.zeros(1),
+        "coarse_acoustics.layers.0.attn.att_proj.weight": np.zeros(1),
+        "fine_acoustics.lm_heads.0.weight": np.zeros(1),
+        "codec_model.decoder.layers.0.conv.bias": np.zeros(1),
+        "unrelated.key": np.zeros(1),
+    })
+    assert set(split) == {"semantic", "coarse", "fine", "codec"}
+    assert "lm_head.weight" in split["semantic"]
+
+
+def test_infer_encodec_config():
+    cfg = infer_encodec_config(
+        {"upsampling_ratios": [8, 5, 4, 2], "num_filters": 32,
+         "hidden_size": 128}
+    )
+    assert cfg.upsampling_ratios == (8, 5, 4, 2)
+    assert infer_encodec_config(None).codebook_size == 1024
+
+
+def test_full_bark_repo_check_and_pipeline(sdaas_root, tmp_path):
+    """A complete synthetic suno/bark repo — single prefixed state dict in
+    the real HF layout (transformers Bark submodels + EncodecModel),
+    config.json + generation_config.json + tokenizer vocab — passes
+    `initialize --check` AND serves text->waveform through BarkPipeline
+    with the converted weights."""
+    import json
+    from pathlib import Path
+
+    from safetensors.numpy import save_file
+    from transformers import (
+        BarkCoarseConfig,
+        BarkCoarseModel,
+        BarkFineConfig,
+        BarkFineModel,
+        BarkSemanticConfig,
+        BarkSemanticModel,
+        EncodecConfig as HFEncodecConfig,
+        EncodecModel,
+    )
+
+    from chiaswarm_tpu.initialize import verify_local_model
+    from chiaswarm_tpu.pipelines.bark import BarkPipeline
+    from chiaswarm_tpu.settings import load_settings
+
+    from chiaswarm_tpu.settings import Settings, save_settings
+
+    name = "suno/bark"
+    root = tmp_path / "models"
+    save_settings(Settings(model_root_dir=str(root)))
+    repo = root / name
+    repo.mkdir(parents=True)
+    torch.manual_seed(20)
+
+    gpt_kw = dict(num_layers=2, num_heads=2, hidden_size=32, block_size=128,
+                  dropout=0.0)
+    sem = BarkSemanticModel(BarkSemanticConfig(
+        input_vocab_size=1200, output_vocab_size=1000, **gpt_kw))
+    coarse = BarkCoarseModel(BarkCoarseConfig(
+        input_vocab_size=1136, output_vocab_size=1136, **gpt_kw))
+    fine = BarkFineModel(BarkFineConfig(
+        input_vocab_size=65, output_vocab_size=65,
+        n_codes_total=8, n_codes_given=1, **gpt_kw))
+    # 8 RVQ codebooks: bandwidth 16 kbps at frame rate 200 Hz -> 8 layers
+    codec = EncodecModel(HFEncodecConfig(
+        num_filters=4, num_residual_layers=1, upsampling_ratios=[4, 2],
+        codebook_size=64, codebook_dim=16, hidden_size=16,
+        num_lstm_layers=1, audio_channels=1, sampling_rate=1600,
+        target_bandwidths=[16.0], use_causal_conv=True, pad_mode="reflect",
+        normalize=False,
+    ))
+    n_q = len([k for k in codec.state_dict() if k.endswith("codebook.embed")])
+    assert n_q >= 8, f"tiny codec built only {n_q} quantizer layers"
+
+    state = {}
+    for prefix, model in (("semantic", sem), ("coarse_acoustics", coarse),
+                          ("fine_acoustics", fine), ("codec_model", codec)):
+        for k, v in model.state_dict().items():
+            state[f"{prefix}.{k}"] = v.numpy()
+    save_file(state, str(repo / "model.safetensors"))
+
+    (repo / "config.json").write_text(json.dumps({
+        "semantic_config": {"input_vocab_size": 1200,
+                            "output_vocab_size": 1000, "num_layers": 2,
+                            "num_heads": 2, "hidden_size": 32,
+                            "block_size": 128},
+        "coarse_acoustics_config": {"input_vocab_size": 1136,
+                                    "output_vocab_size": 1136,
+                                    "num_layers": 2, "num_heads": 2,
+                                    "hidden_size": 32, "block_size": 128},
+        "fine_acoustics_config": {"input_vocab_size": 65,
+                                  "output_vocab_size": 65, "num_layers": 2,
+                                  "num_heads": 2, "hidden_size": 32,
+                                  "block_size": 128, "n_codes_total": 8,
+                                  "n_codes_given": 1},
+        "codec_config": {"hidden_size": 16, "num_filters": 4,
+                         "upsampling_ratios": [4, 2], "num_lstm_layers": 1,
+                         "codebook_size": 64},
+    }))
+    (repo / "generation_config.json").write_text(json.dumps({
+        "semantic_config": {"text_encoding_offset": 1048,
+                            "text_pad_token": 1195,
+                            "semantic_pad_token": 1000,
+                            "semantic_infer_token": 1199,
+                            "semantic_vocab_size": 1000,
+                            "max_input_semantic_length": 32},
+        "coarse_acoustics_config": {"coarse_semantic_pad_token": 1128,
+                                    "coarse_infer_token": 1130},
+    }))
+    vocab = ["[PAD]", "[UNK]", "[CLS]", "[SEP]", "hello", "world", "swarm",
+             "##ing", "a", "the"]
+    (repo / "vocab.txt").write_text("\n".join(vocab) + "\n")
+
+    report = verify_local_model(name, root)
+    assert report is not None
+    assert set(report) == {"semantic", "coarse", "fine", "codec"}
+    assert all(v > 0 for v in report.values())
+
+    pipe = BarkPipeline(name)
+    wav, rate, config = pipe.run(
+        prompt="hello world", duration=0.6, rng=jax.random.key(1)
+    )
+    assert wav.ndim == 1 and len(wav) > 50 and np.isfinite(wav).all()
+    assert rate == pipe.hop * pipe.codec_rate
