@@ -108,7 +108,7 @@ _UNSUPPORTED_CHECK_KEYWORDS = (
     # does not.
     "audioldm2", "zeroscope", "text-to-video",
     "i2vgen", "stable-video", "damo", "kandinsky-3", "kandinsky3",
-    "kandinsky-2-1", "cascade", "latent-upscaler", "openpose",
+    "cascade", "latent-upscaler", "openpose",
 )
 
 
@@ -241,9 +241,23 @@ def _verify_kandinsky_model(model_name: str, root: Path) -> dict:
 
     model_dir = root / model_name
     if "prior" in model_name.lower():
-        from .models.prior import DiffusionPrior, PriorConfig
+        import dataclasses
+        import json
 
-        cfg = PriorConfig()
+        from .models.prior import DiffusionPrior
+        from .pipelines.kandinsky import _prior_configs
+
+        cfg, text_cfg = _prior_configs(model_name)
+        p = model_dir / "prior" / "config.json"
+        if p.is_file():
+            cj = json.loads(p.read_text())
+            cfg = dataclasses.replace(
+                cfg,
+                embed_dim=int(cj.get("embedding_dim", cfg.embed_dim)),
+                num_heads=int(cj.get("num_attention_heads", cfg.num_heads)),
+                head_dim=int(cj.get("attention_head_dim", cfg.head_dim)),
+                num_layers=int(cj.get("num_layers", cfg.num_layers)),
+            )
         prior_params, stats = convert_prior(
             load_torch_state_dict(model_dir, "prior")
         )
@@ -259,7 +273,7 @@ def _verify_kandinsky_model(model_name: str, root: Path) -> dict:
             load_torch_state_dict(model_dir, "text_encoder")
         )
         text_exp = _eval_shape_params(
-            CLIPTextEncoder(cfgs.SDXL_CLIP_2), jnp.zeros((1, 77), jnp.int32)
+            CLIPTextEncoder(text_cfg), jnp.zeros((1, 77), jnp.int32)
         )
         assert_tree_shapes_match(text_params, text_exp, prefix="text")
         _emit_zero_image_embed(model_dir)
@@ -276,12 +290,20 @@ def _verify_kandinsky_model(model_name: str, root: Path) -> dict:
     # the SAME recipe the serving path loads (pipelines/kandinsky.py) — a
     # green check must mean exactly what the worker will serve
     ucfg, unet_params, movq_params = convert_decoder_checkpoint(model_dir)
+    side = 2 ** len(ucfg.block_out_channels)
+    if ucfg.conditioning == "text_image":
+        cond = {
+            "text_states": jnp.zeros((1, 8, ucfg.encoder_hid_dim)),
+            "text_embeds": jnp.zeros((1, ucfg.cross_attention_dim)),
+            "image_embeds": jnp.zeros((1, ucfg.image_embed_dim)),
+        }
+    else:
+        cond = jnp.zeros((1, ucfg.encoder_hid_dim))
     unet_exp = _eval_shape_params(
         K22UNet(ucfg),
-        jnp.zeros((1, 2 ** len(ucfg.block_out_channels),
-                   2 ** len(ucfg.block_out_channels), ucfg.in_channels)),
+        jnp.zeros((1, side, side, ucfg.in_channels)),
         jnp.zeros((1,)),
-        jnp.zeros((1, ucfg.encoder_hid_dim)),
+        cond,
     )
     assert_tree_shapes_match(unet_params, unet_exp, prefix="unet")
     movq_cfg = MoVQConfig()
@@ -290,10 +312,27 @@ def _verify_kandinsky_model(model_name: str, root: Path) -> dict:
         MoVQ(movq_cfg), jnp.zeros((1, side, side, 3))
     )
     assert_tree_shapes_match(movq_params, movq_exp, prefix="movq")
-    return {
+    report = {
         "unet": _param_count(unet_params),
         "movq": _param_count(movq_params),
     }
+    if ucfg.conditioning == "text_image":
+        # K2.1: the MCLIP text tower must convert too (same recipe the
+        # decoder pipeline loads)
+        from .models.conversion import convert_mclip
+        from .models.mclip import MCLIPTextEncoder
+        from .pipelines.kandinsky import KandinskyPipeline
+
+        mclip_cfg = KandinskyPipeline._mclip_config_from_dir(model_dir)
+        text_params = convert_mclip(
+            load_torch_state_dict(model_dir, "text_encoder")
+        )
+        text_exp = _eval_shape_params(
+            MCLIPTextEncoder(mclip_cfg), jnp.zeros((1, 8), jnp.int32)
+        )
+        assert_tree_shapes_match(text_params, text_exp, prefix="mclip")
+        report["text"] = _param_count(text_params)
+    return report
 
 
 def _emit_zero_image_embed(model_dir: Path) -> None:

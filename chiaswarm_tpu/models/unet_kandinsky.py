@@ -45,7 +45,13 @@ class K22UNetConfig:
     #   time branch (ImageTimeEmbedding) and the ImageProjection tokens.
     # "text": DeepFloyd IF — T5 states feed an attention-pooled
     #   TextTimeEmbedding and a Linear encoder_hid projection.
+    # "text_image": K2.1 — MCLIP text states + pooled text embed + prior
+    #   image embed feed TextImageTimeEmbedding (additive) and
+    #   TextImageProjection (image tokens prepended to projected text).
     conditioning: str = "image"
+    # K2.1: width of the prior image embedding entering the text_image
+    # projections (encoder_hid_dim is the TEXT hidden width there)
+    image_embed_dim: int = 768
     act: str = "silu"  # resnet/out nonlinearity ("gelu" for IF)
     # IF super-resolution stages carry a second timestep conditioning (the
     # aug/noise level) through a class embedding
@@ -312,7 +318,8 @@ class K22UNet(nn.Module):
         )
         temb = TimestepEmbedding(temb_dim, dtype=self.dtype,
                                  name="time_embedding")(t_feat)
-        cond = cond.astype(self.dtype)
+        if not isinstance(cond, dict):
+            cond = cond.astype(self.dtype)
         if cfg.conditioning == "image":
             # addition_embed_type="image" (ImageTimeEmbedding): the image
             # embed joins the timestep embedding additively
@@ -326,6 +333,32 @@ class K22UNet(nn.Module):
                 dtype=self.dtype, name="hid_proj",
             )(cond).reshape(-1, cfg.image_proj_tokens, cfg.cross_attention_dim)
             ctx = nn.LayerNorm(dtype=self.dtype, name="hid_proj_norm")(ctx)
+        elif cfg.conditioning == "text_image":
+            # K2.1: `cond` is a dict {"text_states" [B,S,Dt], "text_embeds"
+            # [B,Dt'], "image_embeds" [B,Di]}.
+            # addition_embed_type="text_image" (TextImageTimeEmbedding):
+            # LN(text_proj(pooled text)) + image_proj(image embed)
+            text_states = cond["text_states"].astype(self.dtype)
+            text_embeds = cond["text_embeds"].astype(self.dtype)
+            image_embeds = cond["image_embeds"].astype(self.dtype)
+            aug_text = nn.LayerNorm(dtype=self.dtype, name="aug_emb_text_norm")(
+                nn.Dense(temb_dim, dtype=self.dtype,
+                         name="aug_emb_text_proj")(text_embeds)
+            )
+            aug_img = nn.Dense(temb_dim, dtype=self.dtype,
+                               name="aug_emb_image_proj")(image_embeds)
+            temb = temb + aug_text + aug_img
+            # encoder_hid_dim_type="text_image_proj" (TextImageProjection):
+            # image tokens prepended to the projected text sequence (no LN)
+            img_tokens = nn.Dense(
+                cfg.image_proj_tokens * cfg.cross_attention_dim,
+                dtype=self.dtype, name="hid_proj_image",
+            )(image_embeds).reshape(
+                -1, cfg.image_proj_tokens, cfg.cross_attention_dim
+            )
+            txt_tokens = nn.Dense(cfg.cross_attention_dim, dtype=self.dtype,
+                                  name="hid_proj_text")(text_states)
+            ctx = jnp.concatenate([img_tokens, txt_tokens], axis=1)
         else:
             # IF: addition_embed_type="text" (TextTimeEmbedding = LN ->
             # attention pool -> proj -> LN), encoder_hid_dim_type="text_proj"

@@ -125,50 +125,211 @@ _POSE_LOCK = threading.Lock()
 DEFAULT_POSE_MODEL = "lllyasviel/ControlNet-openpose"
 
 
+def decode_openpose(paf: np.ndarray, heat: np.ndarray,
+                    out_w: int, out_h: int,
+                    peak_thresh: float = 0.1,
+                    paf_thresh: float = 0.05) -> np.ndarray:
+    """Openpose PAF grouping: (paf [h,w,38], heat [h,w,19]) -> people
+    [P, 18, 3] with (x, y, conf) scaled to (out_w, out_h).
+
+    The standard pipeline: per-channel peak detection on the smoothed
+    heatmaps, candidate limb scoring by line integrals of the part
+    affinity fields, greedy per-limb assignment, then assembling limbs
+    into per-person keypoint sets (reference: the OpenposeDetector the
+    reference runs, swarm/pre_processors/controlnet.py:46-47)."""
+    from scipy.ndimage import gaussian_filter
+
+    from ..models.pose import LIMB_SEQ, PAF_IDX
+
+    h, w = heat.shape[:2]
+    sx, sy = out_w / w, out_h / h
+
+    # 1. peaks per keypoint channel
+    all_peaks: list[list[tuple]] = []
+    peak_id = 0
+    for k in range(18):
+        m = gaussian_filter(heat[:, :, k], sigma=2)
+        peaks = (
+            (m > np.roll(m, 1, 0)) & (m > np.roll(m, -1, 0))
+            & (m > np.roll(m, 1, 1)) & (m > np.roll(m, -1, 1))
+            & (m > peak_thresh)
+        )
+        ys, xs = np.nonzero(peaks)
+        rows = []
+        for x, y in zip(xs, ys):
+            rows.append((float(x), float(y), float(heat[y, x, k]), peak_id))
+            peak_id += 1
+        all_peaks.append(rows)
+
+    # 2. score candidate limbs by PAF line integral
+    connections: list[list[tuple]] = []
+    for (a, b), (c1, c2) in zip(LIMB_SEQ, PAF_IDX):
+        cand_a, cand_b = all_peaks[a], all_peaks[b]
+        scored = []
+        for i, pa in enumerate(cand_a):
+            for j, pb in enumerate(cand_b):
+                vec = np.array([pb[0] - pa[0], pb[1] - pa[1]], np.float32)
+                norm = float(np.linalg.norm(vec))
+                if norm < 1e-4:
+                    continue
+                u = vec / norm
+                xs = np.linspace(pa[0], pb[0], 10)
+                ys = np.linspace(pa[1], pb[1], 10)
+                px = paf[
+                    np.clip(np.round(ys).astype(int), 0, h - 1),
+                    np.clip(np.round(xs).astype(int), 0, w - 1),
+                ]
+                scores = px[:, c1] * u[0] + px[:, c2] * u[1]
+                # distance prior like the reference implementation
+                prior = min(0.5 * h / norm - 1.0, 0.0)
+                mean = float(scores.mean()) + prior
+                if (scores > paf_thresh).sum() > 0.8 * len(scores) and mean > 0:
+                    scored.append((i, j, mean))
+        scored.sort(key=lambda t: -t[2])
+        used_a, used_b, conn = set(), set(), []
+        for i, j, s in scored:
+            if i not in used_a and j not in used_b:
+                used_a.add(i)
+                used_b.add(j)
+                conn.append((cand_a[i][3], cand_b[j][3], s, i, j))
+        connections.append(conn)
+
+    # 3. assemble limbs into people, keyed by global peak id
+    flat_peaks = [p for rows in all_peaks for p in rows]
+    subsets: list[dict] = []  # {kp_index: peak_id}, "score", "n"
+    for limb, ((a, b), conn) in enumerate(zip(LIMB_SEQ, connections)):
+        for pid_a, pid_b, score, _, _ in conn:
+            placed = False
+            for s in subsets:
+                if s.get(a) == pid_a or s.get(b) == pid_b:
+                    s[a] = pid_a
+                    s[b] = pid_b
+                    s["score"] += score
+                    placed = True
+                    break
+            if not placed:
+                subsets.append({a: pid_a, b: pid_b, "score": score})
+
+    people = []
+    for s in subsets:
+        kps = [k for k in s if isinstance(k, int)]
+        if len(kps) < 4 or s["score"] / max(len(kps), 1) < 0.2:
+            continue  # spurious fragments, openpose's subset pruning
+        row = np.zeros((18, 3), np.float32)
+        for k in kps:
+            x, y, conf, _ = flat_peaks[s[k]]
+            row[k] = ((x + 0.5) * sx, (y + 0.5) * sy, conf)
+        people.append(row)
+    if not people:
+        return np.zeros((0, 18, 3), np.float32)
+    return np.stack(people)
+
+
 class PoseEstimator:
-    """Resident heatmap pose network (reference controlnet.py:46-47's
-    OpenposeDetector). Returns COCO-18 keypoints in original pixel space."""
+    """Resident body-pose network (reference controlnet.py:46-47's
+    OpenposeDetector). Returns per-person COCO-18 keypoints [P, 18, 3] in
+    original pixel space.
+
+    Real model names load the converted CMU 6-stage network
+    (models.pose.OpenposeBody <- lllyasviel body_pose_model.pth) and
+    decode multi-person poses through PAF grouping; tiny/test names keep
+    the compact single-person heatmap stand-in."""
+
+    # fixed square canvas: one jitted program (aspect handled by coordinate
+    # mapping; the CPM trunk is fully convolutional)
+    CANVAS = 368
 
     def __init__(self, model_name: str = DEFAULT_POSE_MODEL,
                  allow_random_init: bool = False):
         import jax
         import jax.numpy as jnp
 
-        from ..models.pose import TINY_POSE, PoseConfig, PoseNet
+        from ..models.pose import OpenposeBody, TINY_POSE, PoseNet
         from ..weights import is_test_model, require_weights_present
 
         self.model_name = model_name
-        self.config = TINY_POSE if is_test_model(model_name) else PoseConfig()
         on_tpu = jax.default_backend() == "tpu"
         self.dtype = jnp.bfloat16 if on_tpu else jnp.float32
-        self.model = PoseNet(self.config, dtype=self.dtype)
-        # no pose-weight conversion path exists yet: real names fail loudly
-        require_weights_present(
-            model_name, None, allow_random_init, component="pose model",
-            hint=(
-                "This worker cannot serve real openpose weights yet; only "
-                "the test/tiny pose network is available."
-            ),
-        )
-        size = self.config.image_size
-        params = self.model.init(
-            jax.random.key(zlib.crc32(model_name.encode())),
-            jnp.zeros((1, size, size, 3)),
-        )["params"]
+        self.real = not is_test_model(model_name)
+        converted = self._load_converted(model_name) if self.real else None
+        if self.real and converted is None:
+            require_weights_present(
+                model_name, None, allow_random_init, component="pose model",
+            )
+            # allow_random_init bring-up on the real architecture
+            self.model = OpenposeBody(dtype=self.dtype)
+            params = self.model.init(
+                jax.random.key(zlib.crc32(model_name.encode())),
+                jnp.zeros((1, 64, 64, 3)),
+            )["params"]
+        elif self.real:
+            from ..models.conversion import checked_converted
+
+            self.model = OpenposeBody(dtype=self.dtype)
+            params = checked_converted(
+                self.model, (jnp.zeros((1, 64, 64, 3)),), converted,
+                "openpose_body", jax.random.key(0),
+            )
+        else:
+            self.config = TINY_POSE
+            self.model = PoseNet(self.config, dtype=self.dtype)
+            size = self.config.image_size
+            params = self.model.init(
+                jax.random.key(zlib.crc32(model_name.encode())),
+                jnp.zeros((1, size, size, 3)),
+            )["params"]
         cast = lambda x: jnp.asarray(x, self.dtype)
         self.params = jax.tree_util.tree_map(cast, params)
         self._program = jax.jit(
             lambda p, px: self.model.apply({"params": p}, px)
         )
 
+    @staticmethod
+    def _load_converted(model_name: str):
+        """body_pose_model as safetensors or the upstream .pth pickle."""
+        from ..models.conversion import (
+            convert_openpose_body,
+            load_torch_state_dict,
+        )
+        from ..weights import model_dir_for
+
+        model_dir = model_dir_for(model_name)
+        if model_dir is None:
+            return None
+        try:
+            return convert_openpose_body(load_torch_state_dict(model_dir))
+        except FileNotFoundError:
+            for p in sorted(model_dir.glob("*body_pose*.pth")):
+                import torch
+
+                sd = torch.load(
+                    str(p), map_location="cpu", weights_only=True
+                )
+                return convert_openpose_body(
+                    {k: v.numpy() for k, v in sd.items()}
+                )
+        return None
+
     def __call__(self, image) -> np.ndarray:
-        """PIL -> [18, 3] float32 rows (x_px, y_px, confidence) in the
-        ORIGINAL image's pixel coordinates."""
+        """PIL -> [P, 18, 3] float32 (x_px, y_px, confidence) per person
+        in the ORIGINAL image's pixel coordinates."""
         import jax.numpy as jnp
         from PIL import Image
 
-        size = self.config.image_size
         w, h = image.size
+        if self.real:
+            size = self.CANVAS
+            rgb = image.convert("RGB").resize((size, size), Image.BICUBIC)
+            # pytorch-openpose normalization: x/256 - 0.5
+            arr = np.asarray(rgb, np.float32) / 256.0 - 0.5
+            paf, heat = self._program(
+                self.params, jnp.asarray(arr[None], self.dtype)
+            )
+            return decode_openpose(
+                np.asarray(paf, np.float32)[0],
+                np.asarray(heat, np.float32)[0], w, h,
+            )
+        size = self.config.image_size
         rgb = image.convert("RGB").resize((size, size), Image.BICUBIC)
         arr = np.asarray(rgb, np.float32) / 127.5 - 1.0
         heat = np.asarray(
@@ -181,14 +342,9 @@ class PoseEstimator:
         conf = flat[idx, np.arange(k)]
         ys, xs = np.divmod(idx, ws)
         out = np.stack(
-            [
-                (xs + 0.5) / ws * w,
-                (ys + 0.5) / hs * h,
-                conf,
-            ],
-            axis=-1,
+            [(xs + 0.5) / ws * w, (ys + 0.5) / hs * h, conf], axis=-1
         )
-        return out.astype(np.float32)
+        return out.astype(np.float32)[None]  # [1, 18, 3]
 
 
 def get_pose_estimator(model_name: str | None = None) -> PoseEstimator:
@@ -206,7 +362,7 @@ def get_pose_estimator(model_name: str | None = None) -> PoseEstimator:
 
 
 def estimate_pose(image, model_name: str | None = None) -> np.ndarray:
-    """PIL image -> [18, 3] (x, y, confidence) keypoints."""
+    """PIL image -> [P, 18, 3] (x, y, confidence) keypoints per person."""
     return get_pose_estimator(model_name)(image)
 
 

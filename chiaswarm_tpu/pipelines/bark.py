@@ -214,13 +214,12 @@ class BarkPipeline:
         self.model_name = model_name
         self.chipset = chipset
         self.tiny = _is_tiny(model_name)
-        model_dir = None if self.tiny else self._model_dir()
-        if model_dir is not None and not model_dir.is_dir():
-            model_dir = None
+        from ..weights import model_dir_for
+
+        model_dir = None if self.tiny else model_dir_for(model_name)
         if not self.tiny and model_dir is None:
             require_weights_present(
-                model_name, self._model_dir(), allow_random_init,
-                component="Bark TTS",
+                model_name, None, allow_random_init, component="Bark TTS",
             )
 
         converted = None
@@ -288,15 +287,6 @@ class BarkPipeline:
         )
         self._programs: dict[tuple, callable] = {}
         self._lock = threading.Lock()
-
-    def _model_dir(self):
-        from pathlib import Path
-
-        from ..settings import load_settings
-
-        return (
-            Path(load_settings().model_root_dir).expanduser() / self.model_name
-        )
 
     def _tokenizer(self, model_dir):
         if model_dir is not None:

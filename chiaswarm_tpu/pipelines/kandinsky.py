@@ -57,6 +57,16 @@ def _prior_configs(model_name: str):
     """(prior_cfg, clip_cfg)."""
     if _is_tiny(model_name):
         return TINY_PRIOR, cfgs.TINY_CLIP_2
+    if "2-1" in model_name or "2_1" in model_name:
+        # Kandinsky 2.1: CLIP ViT-L/14 text tower, 768-wide joint space
+        from ..models.clip import CLIPTextConfig
+
+        return (
+            PriorConfig(embed_dim=768, text_dim=768, num_heads=32,
+                        num_layers=20),
+            CLIPTextConfig(hidden_size=768, num_layers=12, num_heads=12,
+                           hidden_act="quick_gelu", projection_dim=768),
+        )
     # Kandinsky 2.2 rides the OpenCLIP ViT-bigG text tower (same one SDXL
     # uses as encoder 2) and a 1280-wide embedding space
     return PriorConfig(), cfgs.SDXL_CLIP_2
@@ -150,11 +160,20 @@ def _load_converted_prior(model_name: str):
             f"checkpoint under {d} could not be converted for "
             f"'{model_name}': {e}"
         ) from e
+    # geometry overrides from the shipped config.json (2.1 and 2.2 priors
+    # share the 20L/2048 transformer but differ in embedding width)
+    prior_cfg_json = {}
+    p = d / "prior" / "config.json"
+    if p.is_file():
+        import json
+
+        prior_cfg_json = json.loads(p.read_text())
     return {
         "prior": prior_params,
         "text": text_params,
         "clip_stats": stats,
         "model_dir": d,
+        "config_json": prior_cfg_json,
     }
 
 
@@ -169,6 +188,8 @@ def _prior_name_for(decoder_name: str) -> str:
         return "test/tiny-kandinsky-prior"
     if "decoder" in decoder_name:
         return decoder_name.replace("decoder", "prior")
+    if "2-1" in decoder_name or "2_1" in decoder_name:
+        return "kandinsky-community/kandinsky-2-1-prior"
     return "kandinsky-community/kandinsky-2-2-prior"
 
 
@@ -185,6 +206,21 @@ class KandinskyPriorPipeline:
         self.chipset = chipset
         self.config, clip_cfg = _prior_configs(model_name)
         converted = _load_converted_prior(model_name)
+        if converted and converted.get("config_json"):
+            import dataclasses
+
+            cj = converted["config_json"]
+            self.config = dataclasses.replace(
+                self.config,
+                embed_dim=int(cj.get("embedding_dim", self.config.embed_dim)),
+                num_heads=int(
+                    cj.get("num_attention_heads", self.config.num_heads)
+                ),
+                head_dim=int(
+                    cj.get("attention_head_dim", self.config.head_dim)
+                ),
+                num_layers=int(cj.get("num_layers", self.config.num_layers)),
+            )
         if converted is None:
             require_weights_present(
                 model_name, None, allow_random_init,
@@ -407,9 +443,16 @@ class KandinskyPipeline:
         else:
             unet_cfg = converted["unet_cfg"]  # token count from checkpoint
         self.unet_cfg = unet_cfg
+        # Kandinsky 2.1 checkpoints condition on MCLIP text as well as the
+        # prior image embedding (conditioning="text_image", detected from
+        # the checkpoint by infer_k22_unet_config)
+        self.text_image = unet_cfg.conditioning == "text_image"
+        self.text_encoder = None
         self.latent_channels = movq_cfg.latent_channels
         on_tpu = jax.default_backend() == "tpu"
         self.dtype = jnp.bfloat16 if on_tpu else jnp.float32
+        if self.text_image:
+            self._init_mclip(_model_dir(model_name))
         self.unet = K22UNet(unet_cfg, dtype=self.dtype)
         self.vae = MoVQ(movq_cfg, dtype=self.dtype)
         self.latent_factor = 2 ** (len(movq_cfg.block_out_channels) - 1)
@@ -418,13 +461,21 @@ class KandinskyPipeline:
         )
 
         seed = zlib.crc32(model_name.encode())
-        k1, k2 = jax.random.split(jax.random.key(seed))
+        k1, k2, k3 = jax.random.split(jax.random.key(seed), 3)
         n_down = len(unet_cfg.block_out_channels) - 1
         hw = 2 ** max(n_down, 2)
+        if self.text_image:
+            unet_cond = {
+                "text_states": jnp.zeros((1, 8, unet_cfg.encoder_hid_dim)),
+                "text_embeds": jnp.zeros((1, unet_cfg.cross_attention_dim)),
+                "image_embeds": jnp.zeros((1, unet_cfg.image_embed_dim)),
+            }
+        else:
+            unet_cond = jnp.zeros((1, unet_cfg.encoder_hid_dim))
         unet_args = (
             jnp.zeros((1, hw, hw, unet_cfg.in_channels)),
             jnp.zeros((1,)),
-            jnp.zeros((1, unet_cfg.encoder_hid_dim)),
+            unet_cond,
         )
         movq_args = (
             jnp.zeros(
@@ -441,20 +492,98 @@ class KandinskyPipeline:
                 movq_params = _checked_converted(
                     self.vae, movq_args, converted["movq"], "movq", k2
                 )
-                logger.info("loaded converted K2.2 weights for %s", model_name)
+                logger.info(
+                    "loaded converted K2.%s weights for %s",
+                    "1" if self.text_image else "2", model_name,
+                )
             else:
                 unet_params = self.unet.init(k1, *unet_args)["params"]
                 movq_params = self.vae.init(k2, *movq_args)["params"]
+            tree = {"unet": unet_params, "vae": movq_params}
+            if self.text_image:
+                from ..models.conversion import (
+                    convert_mclip,
+                    load_torch_state_dict,
+                )
+
+                tree["text"] = _checked_converted(
+                    self.text_encoder, (jnp.zeros((1, 8), jnp.int32),),
+                    convert_mclip(
+                        load_torch_state_dict(
+                            _model_dir(model_name), "text_encoder"
+                        )
+                    ),
+                    "mclip", k3,
+                )
         cast = lambda x: jnp.asarray(x, self.dtype)
         self.params = jax.device_put(
-            jax.tree_util.tree_map(cast, {
-                "unet": unet_params,
-                "vae": movq_params,
-            }),
-            replicated(self.mesh),
+            jax.tree_util.tree_map(cast, tree), replicated(self.mesh)
         )
         self._programs: dict[tuple, callable] = {}
         self._lock = threading.Lock()
+
+    @staticmethod
+    def _mclip_config_from_dir(model_dir):
+        """MCLIP trunk geometry from text_encoder/config.json (MCLIPConfig
+        nests the trunk dims under transformerDimensions/numDims; plain
+        XLM-R config keys cover synthetic checkpoints)."""
+        import json
+
+        from ..models.clap import ClapTextConfig
+        from ..models.mclip import MCLIP_XLMR_LARGE
+
+        cfg = MCLIP_XLMR_LARGE
+        p = model_dir / "text_encoder" / "config.json"
+        if p.is_file():
+            cj = json.loads(p.read_text())
+            cfg = ClapTextConfig(
+                vocab_size=int(cj.get("vocab_size", cfg.vocab_size)),
+                hidden_size=int(
+                    cj.get("transformerDimensions",
+                           cj.get("hidden_size", cfg.hidden_size))
+                ),
+                num_layers=int(cj.get("num_hidden_layers", cfg.num_layers)),
+                num_heads=int(
+                    cj.get("num_attention_heads", cfg.num_heads)
+                ),
+                intermediate_size=int(
+                    cj.get("intermediate_size", cfg.intermediate_size)
+                ),
+                max_positions=int(
+                    cj.get("max_position_embeddings", cfg.max_positions)
+                ),
+                projection_dim=int(cj.get("numDims", cfg.projection_dim)),
+                layer_norm_eps=float(
+                    cj.get("layer_norm_eps", cfg.layer_norm_eps)
+                ),
+            )
+        return cfg
+
+    def _init_mclip(self, model_dir):
+        """K2.1 text tower: MCLIP (XLM-R + LinearTransformation) with its
+        fast tokenizer. Geometry from text_encoder/config.json."""
+        from ..models.mclip import MCLIPTextEncoder
+        from ..weights import MissingWeightsError
+
+        if model_dir is None:
+            raise MissingWeightsError(
+                f"{self.model_name}: text_image checkpoints need the MCLIP "
+                "text tower on disk"
+            )
+        cfg = self._mclip_config_from_dir(model_dir)
+        self.mclip_cfg = cfg
+        self.text_encoder = MCLIPTextEncoder(cfg, dtype=self.dtype)
+        tok_dir = model_dir / "tokenizer"
+        try:
+            from transformers import AutoTokenizer
+
+            self.mclip_tokenizer = AutoTokenizer.from_pretrained(str(tok_dir))
+        except Exception as e:
+            raise MissingWeightsError(
+                f"{self.model_name}: MCLIP tokenizer failed to load from "
+                f"{tok_dir} ({e}). XLM-R needs tokenizer.json (fast "
+                "tokenizer) since sentencepiece is not installed."
+            ) from e
 
     def release(self):
         self.params = None
@@ -480,10 +609,14 @@ class KandinskyPipeline:
             img2img starts from the init image's latents noised to the
             strength level (reference wire: kandinsky img2img jobs,
             swarm/test.py:100-113)."""
-            # the UNet consumes the raw image embedding; CFG rows carry
-            # [negative | positive] embeds
-            embeds2 = jnp.concatenate([neg_embeds, embeds], axis=0).astype(
-                self.dtype
+            # CFG rows carry [negative | positive] conditioning; `embeds`
+            # is a raw image embedding (2.2) or the text_image dict (2.1)
+            # — tree_map handles both
+            embeds2 = jax.tree_util.tree_map(
+                lambda n, p: jnp.concatenate([n, p], axis=0).astype(
+                    self.dtype
+                ),
+                neg_embeds, embeds,
             )
             noise0 = jax.random.normal(
                 rng, (batch, lh, lw, latent_c), jnp.float32
@@ -623,6 +756,32 @@ class KandinskyPipeline:
         neg_embeds = jnp.asarray(neg_embeds)
         # split-embeds jobs deliver the batch via the embeds themselves
         n_images = int(embeds.shape[0])
+
+        if self.text_image:
+            # K2.1: MCLIP text conditioning rides alongside the prior's
+            # image embedding (diffusers KandinskyPipeline._encode_prompt)
+            tok = self.mclip_tokenizer(
+                [negative_prompt or "", prompt], padding="max_length",
+                truncation=True, max_length=77, return_tensors="np",
+            )
+            enc = self.text_encoder.apply(
+                {"params": params["text"]},
+                jnp.asarray(tok["input_ids"], jnp.int32),
+                jnp.asarray(tok["attention_mask"], jnp.float32),
+            )
+            states = jnp.asarray(enc["hidden_states"], jnp.float32)
+            pooled = jnp.asarray(enc["pooled_proj"], jnp.float32)
+            tile = lambda x: jnp.repeat(x, n_images, axis=0)
+            embeds = {
+                "text_states": tile(states[1:2]),
+                "text_embeds": tile(pooled[1:2]),
+                "image_embeds": embeds,
+            }
+            neg_embeds = {
+                "text_states": tile(states[0:1]),
+                "text_embeds": tile(pooled[0:1]),
+                "image_embeds": neg_embeds,
+            }
 
         image_latents = jnp.zeros((1, 1, 1, 1), jnp.float32)
         if image is not None:

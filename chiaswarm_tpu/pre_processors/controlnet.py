@@ -32,6 +32,20 @@ def register(name):
     return deco
 
 
+# classical-CV approximations of learned detectors the reference runs
+# (MLSDdetector, LineartDetector, UperNet segmentation, real ZoeDepth —
+# swarm/pre_processors/controlnet.py:31-61). Jobs conditioned through
+# these get a `degraded_preprocessors` entry in the result envelope so
+# the hive/user can see the conditioning image is an approximation.
+_DEGRADED = frozenset(
+    _norm(n) for n in ("mlsd", "lineart", "segmentation", "zoe depth", "zoe")
+)
+
+
+def is_degraded_preprocessor(name: str) -> bool:
+    return _norm(name) in _DEGRADED
+
+
 def preprocess_image(image: Image.Image, preprocessor: str, device_identifier: str):
     fn = _PREPROCESSORS.get(_norm(preprocessor))
     if fn is None:
@@ -346,22 +360,25 @@ def openpose(image: Image.Image) -> Image.Image:
     from ..models.pose import LIMBS
     from ..pipelines.aux_models import estimate_pose
 
-    kps = estimate_pose(image)  # [18, 3] (x, y, conf)
+    people = estimate_pose(image)  # [P, 18, 3] (x, y, conf) per person
     w, h = image.size
     out = np.zeros((h, w, 3), np.uint8)
     colors = _limb_colors(len(LIMBS))
     thick = max(min(h, w) // 128, 2)
     conf_floor = 0.05
-    for (a, b), color in zip(LIMBS, colors):
-        if kps[a, 2] > conf_floor and kps[b, 2] > conf_floor:
-            cv2.line(
-                out,
-                (int(kps[a, 0]), int(kps[a, 1])),
-                (int(kps[b, 0]), int(kps[b, 1])),
-                color,
-                thick,
-            )
-    for x, y, c in kps:
-        if c > conf_floor:
-            cv2.circle(out, (int(x), int(y)), thick + 1, (255, 255, 255), -1)
+    for kps in people:
+        for (a, b), color in zip(LIMBS, colors):
+            if kps[a, 2] > conf_floor and kps[b, 2] > conf_floor:
+                cv2.line(
+                    out,
+                    (int(kps[a, 0]), int(kps[a, 1])),
+                    (int(kps[b, 0]), int(kps[b, 1])),
+                    color,
+                    thick,
+                )
+        for x, y, c in kps:
+            if c > conf_floor:
+                cv2.circle(
+                    out, (int(x), int(y)), thick + 1, (255, 255, 255), -1
+                )
     return Image.fromarray(out)

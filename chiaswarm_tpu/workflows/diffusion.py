@@ -16,6 +16,9 @@ from ..registry import get_pipeline
 def diffusion_callback(device_identifier: str, model_name: str, **kwargs):
     content_type = kwargs.pop("content_type", "image/jpeg")
     outputs = kwargs.pop("outputs", ["primary"])
+    # classical-stand-in annotators used for conditioning (job_arguments
+    # _flag_degraded) surface in the result envelope, not just the logs
+    degraded_preprocessors = kwargs.pop("degraded_preprocessors", None)
 
     if kwargs.pop("test_tiny_model", False):
         # hermetic test hook (SURVEY §4): serve the job with the tiny
@@ -86,6 +89,8 @@ def diffusion_callback(device_identifier: str, model_name: str, **kwargs):
     images, pipeline_config = pipeline.run(pipeline_type=pipeline_type, **kwargs)
     if batch_capped:
         pipeline_config["batch_capped"] = batch_capped
+    if degraded_preprocessors:
+        pipeline_config["degraded_preprocessors"] = degraded_preprocessors
 
     # real NSFW detection on the decoded pixels (reference envelope parity:
     # swarm/worker.py:166); auxiliary — never fails the job

@@ -34,6 +34,7 @@ class FakeHive:
         app.router.add_get("/api/work", self._work)
         app.router.add_post("/api/results", self._results)
         app.router.add_get("/api/models", self._models)
+        app.router.add_get("/image.png", self._image)
         self._runner = web.AppRunner(app)
         await self._runner.setup()
         site = web.TCPSite(self._runner, "127.0.0.1", 0)
@@ -78,3 +79,18 @@ class FakeHive:
                 "language_models": [],
             }
         )
+
+    async def _image(self, request: web.Request) -> web.Response:
+        """A tiny PNG for control_image_uri jobs."""
+        import io
+
+        import numpy as np
+        from PIL import Image
+
+        rng = np.random.default_rng(0)
+        img = Image.fromarray(
+            rng.integers(0, 255, (64, 64, 3), dtype=np.uint8), "RGB"
+        )
+        buf = io.BytesIO()
+        img.save(buf, format="PNG")
+        return web.Response(body=buf.getvalue(), content_type="image/png")

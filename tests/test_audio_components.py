@@ -190,7 +190,6 @@ def test_full_audioldm_repo_check_and_pipeline(sdaas_root, tmp_path):
     from chiaswarm_tpu.initialize import verify_local_model
     from chiaswarm_tpu.models import configs as cfgs
     from chiaswarm_tpu.pipelines.audio import AudioPipeline
-    from chiaswarm_tpu.settings import load_settings
     from pathlib import Path
 
     from chiaswarm_tpu.settings import Settings, save_settings

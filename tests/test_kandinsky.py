@@ -173,7 +173,7 @@ def test_prior_name_mapping():
     )
     assert (
         _prior_name_for("kandinsky-community/kandinsky-2-1")
-        == "kandinsky-community/kandinsky-2-2-prior"
+        == "kandinsky-community/kandinsky-2-1-prior"
     )
 
 

@@ -382,3 +382,170 @@ def test_verify_local_model_checks_deepfloyd(sdaas_root, tmp_path):
     }))
     out = verify_local_model(name, model_root)
     assert out is not None and out["unet"] > 0 and "t5" not in out
+
+
+class TestMCLIPParity:
+    """K2.1's MultilingualCLIP = XLM-R trunk + mean pool + Linear; parity
+    against transformers XLMRobertaModel with the head computed per the
+    diffusers MultilingualCLIP definition."""
+
+    def test_matches_xlm_roberta(self):
+        import torch
+        from transformers import XLMRobertaConfig, XLMRobertaModel
+
+        from chiaswarm_tpu.models.conversion import convert_mclip
+        from chiaswarm_tpu.models.mclip import TINY_MCLIP, MCLIPTextEncoder
+
+        hf = XLMRobertaConfig(
+            vocab_size=1000, hidden_size=32, num_hidden_layers=2,
+            num_attention_heads=4, intermediate_size=64,
+            max_position_embeddings=80, type_vocab_size=1, pad_token_id=1,
+            layer_norm_eps=1e-5, hidden_act="gelu",
+        )
+        torch.manual_seed(30)
+        trunk = XLMRobertaModel(hf).eval()
+        transformation = torch.nn.Linear(32, TINY_MCLIP.projection_dim)
+        state = {
+            f"transformer.{k}": v.numpy() for k, v in trunk.state_dict().items()
+        }
+        state["LinearTransformation.weight"] = (
+            transformation.weight.detach().numpy()
+        )
+        state["LinearTransformation.bias"] = (
+            transformation.bias.detach().numpy()
+        )
+        params = convert_mclip(state)
+
+        ids = np.array([[0, 5, 17, 99, 2, 1, 1, 1]], np.int64)
+        mask = (ids != 1).astype(np.int64)
+        with torch.no_grad():
+            hidden_t = trunk(
+                torch.from_numpy(ids), attention_mask=torch.from_numpy(mask)
+            )[0]
+            pooled_t = (hidden_t * torch.from_numpy(mask)[..., None]).sum(
+                1
+            ) / torch.from_numpy(mask).sum(1)[:, None]
+            proj_t = transformation(pooled_t.float()).numpy()
+
+        out = MCLIPTextEncoder(TINY_MCLIP).apply(
+            {"params": params}, jnp.asarray(ids, jnp.int32)
+        )
+        np.testing.assert_allclose(
+            np.asarray(out["hidden_states"]), hidden_t.numpy(),
+            atol=2e-4, rtol=1e-3,
+        )
+        np.testing.assert_allclose(
+            np.asarray(out["pooled_proj"]), proj_t, atol=2e-4, rtol=1e-3
+        )
+
+
+def test_full_k21_repo_check_and_pipeline(sdaas_root, tmp_path):
+    """A complete synthetic Kandinsky 2.1 repo — torch-mirror text_image
+    UNet, synthetic MoVQ, real-layout MCLIP (XLM-R + LinearTransformation),
+    fast tokenizer — passes `initialize --check` AND serves a txt2img job
+    through KandinskyPipeline with converted weights (VERDICT r03 item 8,
+    reference swarm/test.py:85-107)."""
+    import dataclasses
+    import json
+    import unittest.mock as mock
+
+    import torch
+    from safetensors.numpy import save_file
+    from transformers import XLMRobertaConfig, XLMRobertaModel
+
+    import os
+    import sys
+
+    sys.path.insert(0, os.path.dirname(__file__))
+    from torch_unet_ref import K22UNetT
+
+    from chiaswarm_tpu.initialize import verify_local_model
+    from chiaswarm_tpu.models import movq as movq_mod
+    from chiaswarm_tpu.models.unet_kandinsky import TINY_K22_UNET
+    from chiaswarm_tpu.pipelines import kandinsky as kd
+    from chiaswarm_tpu.settings import Settings, save_settings
+
+    name = "kandinsky-community/kandinsky-2-1"
+    root = tmp_path / "models"
+    save_settings(Settings(model_root_dir=str(root)))
+    repo = root / name
+    torch.manual_seed(40)
+
+    ucfg = dataclasses.replace(
+        TINY_K22_UNET, conditioning="text_image",
+        encoder_hid_dim=32, image_embed_dim=16, image_proj_tokens=3,
+    )
+    (repo / "unet").mkdir(parents=True)
+    save_file(
+        {k: v.numpy() for k, v in K22UNetT(ucfg).state_dict().items()},
+        str(repo / "unet" / "diffusion_pytorch_model.safetensors"),
+    )
+    (repo / "unet" / "config.json").write_text(json.dumps({
+        "attention_head_dim": ucfg.attention_head_dim,
+        "norm_num_groups": ucfg.norm_num_groups,
+    }))
+
+    movq = movq_mod.MoVQ(movq_mod.TINY_MOVQ)
+    mparams = movq.init(jax.random.key(41), jnp.zeros((1, 16, 16, 3)))["params"]
+    (repo / "movq").mkdir(parents=True)
+    save_file(
+        _flatten_state(_synth_state(mparams, MOVQ_SUBS)),
+        str(repo / "movq" / "diffusion_pytorch_model.safetensors"),
+    )
+
+    hf = XLMRobertaConfig(
+        vocab_size=1000, hidden_size=32, num_hidden_layers=2,
+        num_attention_heads=4, intermediate_size=64,
+        max_position_embeddings=80, type_vocab_size=1, pad_token_id=1,
+        layer_norm_eps=1e-5,
+    )
+    trunk = XLMRobertaModel(hf)
+    transformation = torch.nn.Linear(32, 16)
+    state = {f"transformer.{k}": v.numpy()
+             for k, v in trunk.state_dict().items()}
+    state["LinearTransformation.weight"] = transformation.weight.detach().numpy()
+    state["LinearTransformation.bias"] = transformation.bias.detach().numpy()
+    (repo / "text_encoder").mkdir(parents=True)
+    save_file(state, str(repo / "text_encoder" / "model.safetensors"))
+    (repo / "text_encoder" / "config.json").write_text(json.dumps({
+        "vocab_size": 1000, "transformerDimensions": 32, "numDims": 16,
+        "num_hidden_layers": 2, "num_attention_heads": 4,
+        "intermediate_size": 64, "max_position_embeddings": 80,
+        "layer_norm_eps": 1e-5,
+    }))
+
+    from tokenizers import Tokenizer
+    from tokenizers.models import WordLevel
+    from tokenizers.pre_tokenizers import Whitespace
+
+    vocab = {"<s>": 0, "<pad>": 1, "</s>": 2, "<unk>": 3,
+             "a": 4, "red": 5, "fox": 6}
+    tok = Tokenizer(WordLevel(vocab, unk_token="<unk>"))
+    tok.pre_tokenizer = Whitespace()
+    (repo / "tokenizer").mkdir(parents=True)
+    tok.save(str(repo / "tokenizer" / "tokenizer.json"))
+    (repo / "tokenizer" / "tokenizer_config.json").write_text(json.dumps({
+        "tokenizer_class": "PreTrainedTokenizerFast",
+        "pad_token": "<pad>", "unk_token": "<unk>",
+        "model_max_length": 80,
+    }))
+
+    with mock.patch.object(movq_mod, "MoVQConfig", lambda: movq_mod.TINY_MOVQ), \
+         mock.patch.object(kd, "MoVQConfig", lambda: movq_mod.TINY_MOVQ):
+        report = verify_local_model(name, root)
+        assert report is not None
+        assert set(report) == {"unet", "movq", "text"}
+
+        pipe = kd.KandinskyPipeline(name)
+        assert pipe.text_image
+        rng = np.random.default_rng(42)
+        images, cfg_out = pipe.run(
+            prompt="a red fox", height=64, width=64,
+            num_inference_steps=2,
+            image_embeds=rng.standard_normal((1, 16)).astype(np.float32),
+            negative_image_embeds=rng.standard_normal((1, 16)).astype(
+                np.float32
+            ),
+            rng=jax.random.key(7),
+        )
+        assert len(images) == 1 and images[0].size == (64, 64)

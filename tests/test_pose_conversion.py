@@ -1,0 +1,152 @@
+"""Real CMU body-pose network conversion (VERDICT r03 item 3).
+
+The torch mirror below reproduces the exact pytorch-openpose
+`bodypose_model` module layout (the state-dict format of
+lllyasviel/ControlNet's body_pose_model.pth annotator), so
+convert_openpose_body consumes its state dict directly and the flax
+OpenposeBody must compute identical PAF/heatmap outputs.
+"""
+
+from collections import OrderedDict
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+torch = pytest.importorskip("torch")
+import torch.nn as nn  # noqa: E402
+
+from chiaswarm_tpu.models.conversion import convert_openpose_body  # noqa: E402
+from chiaswarm_tpu.models.pose import OpenposeBody  # noqa: E402
+
+
+def _stage1(branch, out):
+    d = OrderedDict()
+    for i in (1, 2, 3):
+        d[f"conv5_{i}_CPM_L{branch}"] = nn.Conv2d(128, 128, 3, padding=1)
+        d[f"r{i}"] = nn.ReLU()
+    d[f"conv5_4_CPM_L{branch}"] = nn.Conv2d(128, 512, 1)
+    d["r4"] = nn.ReLU()
+    d[f"conv5_5_CPM_L{branch}"] = nn.Conv2d(512, out, 1)
+    return nn.Sequential(d)
+
+
+def _stage_t(t, branch, out):
+    d = OrderedDict()
+    ch = 185
+    for i in (1, 2, 3, 4, 5):
+        d[f"Mconv{i}_stage{t}_L{branch}"] = nn.Conv2d(ch, 128, 7, padding=3)
+        d[f"r{i}"] = nn.ReLU()
+        ch = 128
+    d[f"Mconv6_stage{t}_L{branch}"] = nn.Conv2d(128, 128, 1)
+    d["r6"] = nn.ReLU()
+    d[f"Mconv7_stage{t}_L{branch}"] = nn.Conv2d(128, out, 1)
+    return nn.Sequential(d)
+
+
+class BodyPoseT(nn.Module):
+    """pytorch-openpose bodypose_model layout, exactly."""
+
+    def __init__(self):
+        super().__init__()
+        m0 = OrderedDict()
+        spec = [
+            ("conv1_1", (3, 64)), ("conv1_2", (64, 64)), ("pool1", None),
+            ("conv2_1", (64, 128)), ("conv2_2", (128, 128)), ("pool2", None),
+            ("conv3_1", (128, 256)), ("conv3_2", (256, 256)),
+            ("conv3_3", (256, 256)), ("conv3_4", (256, 256)), ("pool3", None),
+            ("conv4_1", (256, 512)), ("conv4_2", (512, 512)),
+            ("conv4_3_CPM", (512, 256)), ("conv4_4_CPM", (256, 128)),
+        ]
+        for name, io in spec:
+            if io is None:
+                m0[name] = nn.MaxPool2d(2, 2)
+            else:
+                m0[name] = nn.Conv2d(io[0], io[1], 3, padding=1)
+                m0[name + "_r"] = nn.ReLU()
+        self.model0 = nn.Sequential(m0)
+        self.model1_1 = _stage1(1, 38)
+        self.model1_2 = _stage1(2, 19)
+        for t in range(2, 7):
+            setattr(self, f"model{t}_1", _stage_t(t, 1, 38))
+            setattr(self, f"model{t}_2", _stage_t(t, 2, 19))
+
+    def forward(self, x):
+        feats = self.model0(x)
+        paf, heat = self.model1_1(feats), self.model1_2(feats)
+        for t in range(2, 7):
+            z = torch.cat([paf, heat, feats], 1)
+            paf = getattr(self, f"model{t}_1")(z)
+            heat = getattr(self, f"model{t}_2")(z)
+        return paf, heat
+
+
+def test_openpose_body_parity():
+    torch.manual_seed(50)
+    tref = BodyPoseT().eval()
+    state = {k: v.numpy() for k, v in tref.state_dict().items()}
+    params = convert_openpose_body(state)
+
+    x = np.random.default_rng(0).standard_normal((1, 3, 64, 64)).astype(
+        np.float32
+    )
+    with torch.no_grad():
+        paf_t, heat_t = tref(torch.from_numpy(x))
+    paf_f, heat_f = OpenposeBody().apply(
+        {"params": params}, jnp.asarray(x.transpose(0, 2, 3, 1))
+    )
+    np.testing.assert_allclose(
+        np.asarray(paf_f), paf_t.numpy().transpose(0, 2, 3, 1),
+        atol=2e-4, rtol=1e-3,
+    )
+    np.testing.assert_allclose(
+        np.asarray(heat_f), heat_t.numpy().transpose(0, 2, 3, 1),
+        atol=2e-4, rtol=1e-3,
+    )
+
+
+def test_paf_grouping_decodes_synthetic_person():
+    """The PAF grouping decoder recovers a synthetic stick figure planted
+    directly in heatmap/PAF space."""
+    from chiaswarm_tpu.models.pose import LIMB_SEQ, PAF_IDX
+    from chiaswarm_tpu.pipelines.aux_models import decode_openpose
+
+    from scipy.ndimage import gaussian_filter
+
+    h = w = 46
+    heat = np.zeros((h, w, 19), np.float32)
+    paf = np.zeros((h, w, 38), np.float32)
+    # plant keypoints as gaussian blobs (real heatmaps are wide peaks, and
+    # the decoder thresholds the SMOOTHED map like openpose does)
+    pts = {}
+    for k in range(18):
+        y, x = 6 + (k % 6) * 6, 6 + (k // 6) * 12
+        pts[k] = (x, y)
+        heat[y, x, k] = 1.0
+        blob = gaussian_filter(heat[:, :, k], sigma=2)
+        heat[:, :, k] = blob / blob.max()
+    # paint each limb's PAF along the segment
+    for (a, b), (c1, c2) in zip(LIMB_SEQ, PAF_IDX):
+        (x1, y1), (x2, y2) = pts[a], pts[b]
+        v = np.array([x2 - x1, y2 - y1], np.float32)
+        norm = np.linalg.norm(v) or 1.0
+        v /= norm
+        for t in np.linspace(0, 1, 24):
+            xi = int(round(x1 + t * (x2 - x1)))
+            yi = int(round(y1 + t * (y2 - y1)))
+            for dy in (-1, 0, 1):
+                for dx in (-1, 0, 1):
+                    yy, xx = np.clip(yi + dy, 0, h - 1), np.clip(
+                        xi + dx, 0, w - 1
+                    )
+                    paf[yy, xx, c1] = v[0]
+                    paf[yy, xx, c2] = v[1]
+    people = decode_openpose(paf, heat, w * 8, h * 8)
+    assert people.shape[0] == 1
+    found = people[0]
+    assert (found[:, 2] > 0).sum() >= 16  # nearly every keypoint recovered
+    for k in range(18):
+        if found[k, 2] > 0:
+            assert abs(found[k, 0] - pts[k][0] * 8) < 12
+            assert abs(found[k, 1] - pts[k][1] * 8) < 12

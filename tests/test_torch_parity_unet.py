@@ -267,3 +267,55 @@ class TestK22UNetTorchParity:
             )
         )
         np.testing.assert_allclose(out_f, out_t, atol=2e-4, rtol=1e-3)
+
+    def test_k21_text_image_unet_matches(self):
+        """Kandinsky 2.1: TextImageTimeEmbedding + TextImageProjection
+        conditioning over the same K blocks — torch-mirror numeric parity
+        + exact config inference (reference swarm/test.py:85-107)."""
+        import dataclasses
+
+        from torch_unet_ref import K22UNetT
+
+        from chiaswarm_tpu.models.conversion import convert_kandinsky_unet
+        from chiaswarm_tpu.models.unet_kandinsky import TINY_K22_UNET, K22UNet
+
+        # real K2.1 geometry relations: image embeds and pooled text embeds
+        # are cross_attention_dim wide; text states are encoder_hid wide
+        cfg = dataclasses.replace(
+            TINY_K22_UNET, conditioning="text_image",
+            encoder_hid_dim=24, image_embed_dim=TINY_K22_UNET.cross_attention_dim,
+            image_proj_tokens=3,
+        )
+        torch.manual_seed(12)
+        tref = K22UNetT(cfg).eval()
+        state = {k: v.numpy() for k, v in tref.state_dict().items()}
+        inferred, params = convert_kandinsky_unet(
+            state, {"attention_head_dim": cfg.attention_head_dim,
+                    "norm_num_groups": cfg.norm_num_groups},
+        )
+        assert inferred == cfg
+
+        rng = np.random.default_rng(13)
+        x = rng.standard_normal((2, 16, 16, cfg.in_channels)).astype(np.float32)
+        t = np.array([11.0, 333.0], np.float32)
+        image_embeds = rng.standard_normal(
+            (2, cfg.image_embed_dim)).astype(np.float32)
+        text_states = rng.standard_normal((2, 7, 24)).astype(np.float32)
+        text_embeds = rng.standard_normal(
+            (2, cfg.cross_attention_dim)).astype(np.float32)
+        with torch.no_grad():
+            out_t = tref(
+                _to_torch_nchw(x), torch.from_numpy(t),
+                torch.from_numpy(image_embeds),
+                text_states=torch.from_numpy(text_states),
+                text_embeds=torch.from_numpy(text_embeds),
+            ).numpy().transpose(0, 2, 3, 1)
+        out_f = np.asarray(
+            K22UNet(cfg).apply(
+                {"params": params}, jnp.asarray(x), jnp.asarray(t),
+                {"text_states": jnp.asarray(text_states),
+                 "text_embeds": jnp.asarray(text_embeds),
+                 "image_embeds": jnp.asarray(image_embeds)},
+            )
+        )
+        np.testing.assert_allclose(out_f, out_t, atol=2e-4, rtol=1e-3)

@@ -588,17 +588,38 @@ class K22UNetT(nn.Module):
         temb_dim = blocks[0] * 4
         g = cfg.norm_num_groups
         self.time_embedding = TimestepEmbeddingT(blocks[0], temb_dim)
-        self.add_embedding = nn.ModuleDict({
-            "image_proj": nn.Linear(cfg.encoder_hid_dim, temb_dim),
-            "image_norm": nn.LayerNorm(temb_dim),
-        })
-        self.encoder_hid_proj = nn.ModuleDict({
-            "image_embeds": nn.Linear(
-                cfg.encoder_hid_dim,
-                cfg.image_proj_tokens * cfg.cross_attention_dim,
-            ),
-            "norm": nn.LayerNorm(cfg.cross_attention_dim),
-        })
+        if cfg.conditioning == "text_image":
+            # K2.1: TextImageTimeEmbedding + TextImageProjection. diffusers
+            # builds both time projections over cross_attention_dim-wide
+            # embeds (UNet2DConditionModel passes cross_attention_dim as
+            # text_embed_dim AND image_embed_dim for addition_embed_type=
+            # "text_image")
+            self.add_embedding = nn.ModuleDict({
+                "text_proj": nn.Linear(cfg.cross_attention_dim, temb_dim),
+                "text_norm": nn.LayerNorm(temb_dim),
+                "image_proj": nn.Linear(cfg.image_embed_dim, temb_dim),
+            })
+            self.encoder_hid_proj = nn.ModuleDict({
+                "image_embeds": nn.Linear(
+                    cfg.image_embed_dim,
+                    cfg.image_proj_tokens * cfg.cross_attention_dim,
+                ),
+                "text_proj": nn.Linear(
+                    cfg.encoder_hid_dim, cfg.cross_attention_dim
+                ),
+            })
+        else:
+            self.add_embedding = nn.ModuleDict({
+                "image_proj": nn.Linear(cfg.encoder_hid_dim, temb_dim),
+                "image_norm": nn.LayerNorm(temb_dim),
+            })
+            self.encoder_hid_proj = nn.ModuleDict({
+                "image_embeds": nn.Linear(
+                    cfg.encoder_hid_dim,
+                    cfg.image_proj_tokens * cfg.cross_attention_dim,
+                ),
+                "norm": nn.LayerNorm(cfg.cross_attention_dim),
+            })
         self.conv_in = nn.Conv2d(cfg.in_channels, blocks[0], 3, padding=1)
 
         def attn(ch):
@@ -666,18 +687,31 @@ class K22UNetT(nn.Module):
         self.conv_norm_out = nn.GroupNorm(g, blocks[0], eps=1e-5)
         self.conv_out = nn.Conv2d(blocks[0], cfg.out_channels, 3, padding=1)
 
-    def forward(self, sample, timesteps, image_embeds):
+    def forward(self, sample, timesteps, image_embeds, text_states=None,
+                text_embeds=None):
         cfg = self.cfg
         temb = self.time_embedding(
             timestep_embedding_t(timesteps, cfg.block_out_channels[0])
         )
-        temb = temb + self.add_embedding["image_norm"](
-            self.add_embedding["image_proj"](image_embeds)
-        )
-        ctx = self.encoder_hid_proj["image_embeds"](image_embeds).view(
-            -1, cfg.image_proj_tokens, cfg.cross_attention_dim
-        )
-        ctx = self.encoder_hid_proj["norm"](ctx)
+        if cfg.conditioning == "text_image":
+            temb = temb + self.add_embedding["text_norm"](
+                self.add_embedding["text_proj"](text_embeds)
+            ) + self.add_embedding["image_proj"](image_embeds)
+            img_tokens = self.encoder_hid_proj["image_embeds"](
+                image_embeds
+            ).view(-1, cfg.image_proj_tokens, cfg.cross_attention_dim)
+            ctx = torch.cat(
+                [img_tokens, self.encoder_hid_proj["text_proj"](text_states)],
+                dim=1,
+            )
+        else:
+            temb = temb + self.add_embedding["image_norm"](
+                self.add_embedding["image_proj"](image_embeds)
+            )
+            ctx = self.encoder_hid_proj["image_embeds"](image_embeds).view(
+                -1, cfg.image_proj_tokens, cfg.cross_attention_dim
+            )
+            ctx = self.encoder_hid_proj["norm"](ctx)
         x = self.conv_in(sample)
         skips = [x]
         for stage in self.down_blocks:
