@@ -99,16 +99,11 @@ def _eval_shape_params(module, *args, **kwargs):
     return shapes["params"]
 
 
-_UNSUPPORTED_CHECK_KEYWORDS = (
-    # families the worker can schedule but cannot yet serve with real
-    # weights (no conversion path) — `--check` skips instead of failing.
-    # Kandinsky 2.x converts (unet/movq/prior); Kandinsky 3 does not yet;
-    # AudioLDM v1 converts, AudioLDM2's different component set (GPT-2
-    # projection bridge, text_encoder_2, list-valued cross_attention_dim)
-    # does not.
-    "audioldm2", "zeroscope", "text-to-video",
-    "i2vgen", "stable-video", "damo", "kandinsky-3", "kandinsky3",
-    "cascade", "latent-upscaler", "openpose",
+# see weights.UNCONVERTED_FAMILY_KEYWORDS — shared with the worker's
+# capability advertisement; openpose weights now convert, so only
+# whole families remain here
+from .weights import (  # noqa: E402
+    UNCONVERTED_FAMILY_KEYWORDS as _UNSUPPORTED_CHECK_KEYWORDS,
 )
 
 
@@ -147,7 +142,64 @@ def verify_local_model(model_name: str, root: Path | None = None) -> dict | None
         return _verify_if_model(model_name, root)
     if "animatediff" in name or "motion-adapter" in name:
         return _verify_motion_adapter(model_name, root)
+    if "openpose" in name:
+        return _verify_openpose_model(model_name, root)
+    if "upernet" in name:
+        return _verify_upernet_model(model_name, root)
     return _verify_sd_model(model_name, root)
+
+
+def _verify_upernet_model(model_name: str, root: Path) -> dict:
+    """The segmentation annotator repo: UperNet+ConvNeXt converts (BN
+    folded) against the geometry in config.json — the same recipe the
+    resident Segmenter loads."""
+    import json
+
+    import jax.numpy as jnp
+
+    from .models.conversion import (
+        assert_tree_shapes_match,
+        convert_upernet,
+        load_torch_state_dict,
+    )
+    from .models.segmentation import UperNetSegmenter, upernet_config_from_json
+
+    model_dir = root / model_name
+    p = model_dir / "config.json"
+    cfg = upernet_config_from_json(
+        json.loads(p.read_text()) if p.is_file() else None
+    )
+    converted = convert_upernet(load_torch_state_dict(model_dir))
+    expected = _eval_shape_params(
+        UperNetSegmenter(cfg), jnp.zeros((1, 64, 64, 3))
+    )
+    assert_tree_shapes_match(converted, expected, prefix="upernet")
+    return {"upernet": _param_count(converted)}
+
+
+def _verify_openpose_model(model_name: str, root: Path) -> dict:
+    """The body-pose annotator repo: converts through the SAME loader the
+    PoseEstimator serves with (pytorch-openpose layout, .pth or
+    safetensors)."""
+    import jax.numpy as jnp
+
+    from .models.conversion import assert_tree_shapes_match
+    from .models.pose import OpenposeBody
+    from .pipelines.aux_models import load_openpose_checkpoint
+
+    model_dir = root / model_name
+    converted = (
+        load_openpose_checkpoint(model_dir) if model_dir.is_dir() else None
+    )
+    if converted is None:
+        raise FileNotFoundError(
+            f"no body_pose_model weights under {model_dir}"
+        )
+    expected = _eval_shape_params(
+        OpenposeBody(), jnp.zeros((1, 64, 64, 3))
+    )
+    assert_tree_shapes_match(converted, expected, prefix="openpose")
+    return {"openpose_body": _param_count(converted)}
 
 
 def _verify_motion_adapter(model_name: str, root: Path) -> dict:
@@ -241,23 +293,18 @@ def _verify_kandinsky_model(model_name: str, root: Path) -> dict:
 
     model_dir = root / model_name
     if "prior" in model_name.lower():
-        import dataclasses
         import json
 
         from .models.prior import DiffusionPrior
-        from .pipelines.kandinsky import _prior_configs
+        from .pipelines.kandinsky import (
+            _prior_configs,
+            prior_config_with_overrides,
+        )
 
         cfg, text_cfg = _prior_configs(model_name)
         p = model_dir / "prior" / "config.json"
         if p.is_file():
-            cj = json.loads(p.read_text())
-            cfg = dataclasses.replace(
-                cfg,
-                embed_dim=int(cj.get("embedding_dim", cfg.embed_dim)),
-                num_heads=int(cj.get("num_attention_heads", cfg.num_heads)),
-                head_dim=int(cj.get("attention_head_dim", cfg.head_dim)),
-                num_layers=int(cj.get("num_layers", cfg.num_layers)),
-            )
+            cfg = prior_config_with_overrides(cfg, json.loads(p.read_text()))
         prior_params, stats = convert_prior(
             load_torch_state_dict(model_dir, "prior")
         )

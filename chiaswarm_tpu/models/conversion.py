@@ -1319,8 +1319,135 @@ def convert_mclip(state: dict) -> dict:
 
 
 def convert_openpose_body(state: dict) -> dict:
-    """pytorch-openpose bodypose_model state dict (the
-    lllyasviel/ControlNet `body_pose_model.pth` annotator) ->
-    models.pose.OpenposeBody params. Names like `model1_1.conv5_1_CPM_L1`
-    map mechanically (no digit segments to merge)."""
+    """pytorch-openpose bodypose_model weights (the lllyasviel/ControlNet
+    `body_pose_model.pth` annotator) -> models.pose.OpenposeBody params.
+
+    The distributed .pth stores FLAT caffe-style keys (`conv1_1.weight`,
+    `Mconv1_stage2_L1.weight` — pytorch-openpose re-prefixes them at load
+    time via its `transfer()` helper); a module-prefixed dict
+    (`model0.conv1_1.weight`) passes through unchanged. Flat names are
+    unique per block, so the prefix derives from the name itself."""
+    import re
+
+    def prefix(name: str) -> str:
+        m = re.match(r"Mconv\d+_stage(\d+)_L([12])\.", name)
+        if m:
+            return f"model{m.group(1)}_{m.group(2)}."
+        m = re.match(r"conv5_\d+_CPM_L([12])\.", name)
+        if m:
+            return f"model1_{m.group(1)}."
+        return "model0."
+
+    if not any(k.startswith("model") for k in state):
+        state = {prefix(k) + k: v for k, v in state.items()}
     return convert_state_dict(state)
+
+
+def convert_upernet(state: dict) -> dict:
+    """transformers UperNetForSemanticSegmentation (ConvNeXt backbone) ->
+    models.segmentation.UperNetSegmenter params. BatchNorms fold into
+    their conv kernels (eval-mode running stats), the auxiliary FCN head
+    (training-only deep supervision) is dropped."""
+    import re
+
+    params: dict = {}
+
+    def put(module: str, leaf: str, value):
+        params.setdefault(module, {})[leaf] = value
+
+    # group conv+bn pairs of the decode head for folding
+    convs: dict[str, dict] = {}
+    for k, v in state.items():
+        v = np.asarray(v)
+        if k.startswith("auxiliary_head."):
+            continue
+        m = re.match(
+            r"decode_head\.(.+)\.(conv|batch_norm)\.(weight|bias|"
+            r"running_mean|running_var)$", k,
+        )
+        if m:
+            convs.setdefault(m.group(1), {})[
+                f"{m.group(2)}.{m.group(3)}"
+            ] = v
+            continue
+        if k == "decode_head.classifier.weight":
+            put("classifier", "kernel", v.transpose(2, 3, 1, 0))
+        elif k == "decode_head.classifier.bias":
+            put("classifier", "bias", v)
+        elif k == "backbone.embeddings.patch_embeddings.weight":
+            put("patch_embeddings", "kernel", v.transpose(2, 3, 1, 0))
+        elif k == "backbone.embeddings.patch_embeddings.bias":
+            put("patch_embeddings", "bias", v)
+        elif k == "backbone.embeddings.layernorm.weight":
+            put("embeddings_norm", "scale", v)
+        elif k == "backbone.embeddings.layernorm.bias":
+            put("embeddings_norm", "bias", v)
+        else:
+            m = re.match(
+                r"backbone\.encoder\.stages\.(\d+)\.downsampling_layer\."
+                r"([01])\.(weight|bias)$", k,
+            )
+            if m:
+                s, which, leaf = int(m.group(1)), m.group(2), m.group(3)
+                if which == "0":
+                    put(f"downsample_norm_{s}",
+                        "scale" if leaf == "weight" else "bias", v)
+                else:
+                    put(f"downsample_conv_{s}",
+                        "kernel" if leaf == "weight" else "bias",
+                        v.transpose(2, 3, 1, 0) if leaf == "weight" else v)
+                continue
+            m = re.match(
+                r"backbone\.encoder\.stages\.(\d+)\.layers\.(\d+)\.(.+)$", k
+            )
+            if m:
+                s, j, rest = int(m.group(1)), int(m.group(2)), m.group(3)
+                mod = f"stage_{s}_layer_{j}"
+                if rest == "layer_scale_parameter":
+                    put(mod, "layer_scale", v)
+                elif rest == "dwconv.weight":
+                    _assign(params, [mod, "dwconv", "kernel"],
+                            v.transpose(2, 3, 1, 0))
+                elif rest == "dwconv.bias":
+                    _assign(params, [mod, "dwconv", "bias"], v)
+                elif rest.startswith("layernorm."):
+                    leaf = "scale" if rest.endswith("weight") else "bias"
+                    _assign(params, [mod, "norm", leaf], v)
+                elif rest.startswith("pwconv"):
+                    which = rest.split(".")[0]
+                    leaf = "kernel" if rest.endswith("weight") else "bias"
+                    _assign(params, [mod, which, leaf],
+                            v.T if leaf == "kernel" else v)
+                continue
+            m = re.match(
+                r"backbone\.hidden_states_norms\.stage(\d)\.(weight|bias)$", k
+            )
+            if m:
+                s = int(m.group(1)) - 1
+                put(f"feature_norm_{s}",
+                    "scale" if m.group(2) == "weight" else "bias", v)
+                continue
+
+    # fold BN into the decode-head convs; rename to the flax module names
+    rename = {}
+    for i in range(8):
+        rename[f"psp_modules.{i}.1"] = f"psp_{i}"
+        rename[f"lateral_convs.{i}"] = f"lateral_{i}"
+        rename[f"fpn_convs.{i}"] = f"fpn_{i}"
+    rename["bottleneck"] = "bottleneck"
+    rename["fpn_bottleneck"] = "fpn_bottleneck"
+    for torch_mod, tensors in convs.items():
+        target = rename.get(torch_mod)
+        if target is None:
+            continue
+        w = tensors["conv.weight"]  # [O, I, kh, kw], no conv bias
+        gamma = tensors["batch_norm.weight"]
+        beta = tensors["batch_norm.bias"]
+        mean = tensors["batch_norm.running_mean"]
+        var = tensors["batch_norm.running_var"]
+        scale = gamma / np.sqrt(var + 1e-5)
+        w = w * scale[:, None, None, None]
+        b = beta - mean * scale
+        _assign(params, [target, "conv", "kernel"], w.transpose(2, 3, 1, 0))
+        _assign(params, [target, "conv", "bias"], b)
+    return params

@@ -225,6 +225,28 @@ def decode_openpose(paf: np.ndarray, heat: np.ndarray,
     return np.stack(people)
 
 
+def load_openpose_checkpoint(model_dir):
+    """body_pose_model as safetensors or the upstream .pth pickle, from an
+    EXPLICIT directory — shared by PoseEstimator and initialize --check so
+    a green check means exactly what serving loads. None when absent."""
+    from ..models.conversion import (
+        convert_openpose_body,
+        load_torch_state_dict,
+    )
+
+    try:
+        return convert_openpose_body(load_torch_state_dict(model_dir))
+    except FileNotFoundError:
+        for p in sorted(model_dir.glob("*body_pose*.pth")):
+            import torch
+
+            sd = torch.load(str(p), map_location="cpu", weights_only=True)
+            return convert_openpose_body(
+                {k: v.numpy() for k, v in sd.items()}
+            )
+    return None
+
+
 class PoseEstimator:
     """Resident body-pose network (reference controlnet.py:46-47's
     OpenposeDetector). Returns per-person COCO-18 keypoints [P, 18, 3] in
@@ -286,29 +308,12 @@ class PoseEstimator:
 
     @staticmethod
     def _load_converted(model_name: str):
-        """body_pose_model as safetensors or the upstream .pth pickle."""
-        from ..models.conversion import (
-            convert_openpose_body,
-            load_torch_state_dict,
-        )
         from ..weights import model_dir_for
 
         model_dir = model_dir_for(model_name)
-        if model_dir is None:
-            return None
-        try:
-            return convert_openpose_body(load_torch_state_dict(model_dir))
-        except FileNotFoundError:
-            for p in sorted(model_dir.glob("*body_pose*.pth")):
-                import torch
-
-                sd = torch.load(
-                    str(p), map_location="cpu", weights_only=True
-                )
-                return convert_openpose_body(
-                    {k: v.numpy() for k, v in sd.items()}
-                )
-        return None
+        return None if model_dir is None else load_openpose_checkpoint(
+            model_dir
+        )
 
     def __call__(self, image) -> np.ndarray:
         """PIL -> [P, 18, 3] float32 (x_px, y_px, confidence) per person
@@ -479,3 +484,98 @@ def hed_edges(image, model_name: str | None = None):
         return get_hed_detector(model_name)(image)
     except MissingWeightsError:
         return None
+
+
+# --- UperNet segmentation (segmentation preprocessor backend) ---
+
+_SEG: dict[str, "Segmenter"] = {}
+_SEG_LOCK = threading.Lock()
+
+DEFAULT_SEGMENTATION_MODEL = "openmmlab/upernet-convnext-small"
+_SEG_SIZE = 512
+
+
+class Segmenter:
+    """Resident UperNet+ConvNeXt segmenter (the learned detector the
+    reference's `segmentation` annotator runs,
+    swarm/pre_processors/controlnet.py:122-141). Converted weights only —
+    construction raises when the checkpoint is absent so the preprocessor
+    can fall back to its classical stand-in (and flag the job degraded)."""
+
+    def __init__(self, model_name: str = DEFAULT_SEGMENTATION_MODEL):
+        import json
+
+        import jax
+        import jax.numpy as jnp
+
+        from ..models.conversion import (
+            checked_converted,
+            convert_upernet,
+            load_torch_state_dict,
+        )
+        from ..models.segmentation import (
+            UperNetSegmenter,
+            upernet_config_from_json,
+        )
+        from ..weights import MissingWeightsError, model_dir_for
+
+        model_dir = model_dir_for(model_name)
+        if model_dir is None:
+            raise MissingWeightsError(
+                f"segmentation weights for '{model_name}' are not present"
+            )
+        p = model_dir / "config.json"
+        cfg = upernet_config_from_json(
+            json.loads(p.read_text()) if p.is_file() else None
+        )
+        self.config = cfg
+        on_tpu = jax.default_backend() == "tpu"
+        self.dtype = jnp.bfloat16 if on_tpu else jnp.float32
+        self.model = UperNetSegmenter(cfg, dtype=self.dtype)
+        converted = convert_upernet(load_torch_state_dict(model_dir))
+        params = checked_converted(
+            self.model, (jnp.zeros((1, 64, 64, 3)),), converted,
+            "segmentation", jax.random.key(0),
+        )
+        cast = lambda x: jnp.asarray(x, self.dtype)
+        self.params = jax.tree_util.tree_map(cast, params)
+        self._program = jax.jit(
+            lambda p, px: self.model.apply({"params": p}, px).argmax(-1)
+        )
+
+    def __call__(self, image) -> np.ndarray:
+        """PIL -> [H, W] int32 ADE label map at the original size."""
+        import jax.numpy as jnp
+        from PIL import Image
+
+        w, h = image.size
+        rgb = image.convert("RGB").resize((_SEG_SIZE, _SEG_SIZE), Image.BILINEAR)
+        px = (np.asarray(rgb, np.float32) / 255.0 - _MEAN) / _STD
+        labels = np.asarray(
+            self._program(self.params, jnp.asarray(px[None], self.dtype)),
+            np.int32,
+        )[0]
+        return np.asarray(
+            Image.fromarray(labels.astype(np.uint8)).resize(
+                (w, h), Image.NEAREST
+            ),
+            np.int32,
+        )
+
+
+def get_segmenter(model_name: str | None = None):
+    """The resident segmenter, or None when no converted checkpoint is
+    available (callers fall back to the classical stand-in)."""
+    from ..weights import MissingWeightsError
+
+    name = model_name or DEFAULT_SEGMENTATION_MODEL
+    with _SEG_LOCK:
+        if name in _SEG:
+            return _SEG[name]
+        try:
+            seg = Segmenter(name)
+        except (MissingWeightsError, FileNotFoundError, OSError) as e:
+            logger.info("no converted segmentation weights (%s)", e)
+            return None
+        _SEG[name] = seg
+        return seg

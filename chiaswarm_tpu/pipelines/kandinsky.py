@@ -183,6 +183,21 @@ def _checked_converted(module, example_args, converted, prefix, rng):
     return checked_converted(module, example_args, converted, prefix, rng)
 
 
+def prior_config_with_overrides(cfg, config_json: dict | None):
+    """Geometry overrides from prior/config.json — the ONE mapping shared
+    by the serving pipeline and `initialize --check`."""
+    import dataclasses
+
+    cj = config_json or {}
+    return dataclasses.replace(
+        cfg,
+        embed_dim=int(cj.get("embedding_dim", cfg.embed_dim)),
+        num_heads=int(cj.get("num_attention_heads", cfg.num_heads)),
+        head_dim=int(cj.get("attention_head_dim", cfg.head_dim)),
+        num_layers=int(cj.get("num_layers", cfg.num_layers)),
+    )
+
+
 def _prior_name_for(decoder_name: str) -> str:
     if _is_tiny(decoder_name):
         return "test/tiny-kandinsky-prior"
@@ -207,19 +222,8 @@ class KandinskyPriorPipeline:
         self.config, clip_cfg = _prior_configs(model_name)
         converted = _load_converted_prior(model_name)
         if converted and converted.get("config_json"):
-            import dataclasses
-
-            cj = converted["config_json"]
-            self.config = dataclasses.replace(
-                self.config,
-                embed_dim=int(cj.get("embedding_dim", self.config.embed_dim)),
-                num_heads=int(
-                    cj.get("num_attention_heads", self.config.num_heads)
-                ),
-                head_dim=int(
-                    cj.get("attention_head_dim", self.config.head_dim)
-                ),
-                num_layers=int(cj.get("num_layers", self.config.num_layers)),
+            self.config = prior_config_with_overrides(
+                self.config, converted["config_json"]
             )
         if converted is None:
             require_weights_present(
@@ -573,6 +577,13 @@ class KandinskyPipeline:
         cfg = self._mclip_config_from_dir(model_dir)
         self.mclip_cfg = cfg
         self.text_encoder = MCLIPTextEncoder(cfg, dtype=self.dtype)
+        # the 24-layer XLM-R tower runs per job at a fixed (2, 77) shape:
+        # one cached jitted program, like every other resident model here
+        self._text_program = jax.jit(
+            lambda p, ids, mask: self.text_encoder.apply(
+                {"params": p}, ids, mask
+            )
+        )
         tok_dir = model_dir / "tokenizer"
         try:
             from transformers import AutoTokenizer
@@ -764,8 +775,8 @@ class KandinskyPipeline:
                 [negative_prompt or "", prompt], padding="max_length",
                 truncation=True, max_length=77, return_tensors="np",
             )
-            enc = self.text_encoder.apply(
-                {"params": params["text"]},
+            enc = self._text_program(
+                params["text"],
                 jnp.asarray(tok["input_ids"], jnp.int32),
                 jnp.asarray(tok["attention_mask"], jnp.float32),
             )

@@ -33,17 +33,25 @@ def register(name):
 
 
 # classical-CV approximations of learned detectors the reference runs
-# (MLSDdetector, LineartDetector, UperNet segmentation, real ZoeDepth —
+# (MLSDdetector, LineartDetector, real ZoeDepth —
 # swarm/pre_processors/controlnet.py:31-61). Jobs conditioned through
 # these get a `degraded_preprocessors` entry in the result envelope so
 # the hive/user can see the conditioning image is an approximation.
 _DEGRADED = frozenset(
-    _norm(n) for n in ("mlsd", "lineart", "segmentation", "zoe depth", "zoe")
+    _norm(n) for n in ("mlsd", "lineart", "zoe depth", "zoe")
 )
 
 
 def is_degraded_preprocessor(name: str) -> bool:
-    return _norm(name) in _DEGRADED
+    if _norm(name) in _DEGRADED:
+        return True
+    if _norm(name) == "segmentation":
+        # real UperNet when converted weights are present; k-means
+        # stand-in (degraded) otherwise
+        from ..pipelines.aux_models import get_segmenter
+
+        return get_segmenter() is None
+    return False
 
 
 def preprocess_image(image: Image.Image, preprocessor: str, device_identifier: str):
@@ -308,11 +316,19 @@ ADE_STYLE_PALETTE = _segmentation_palette()
 
 @register("segmentation")
 def segmentation(image: Image.Image) -> Image.Image:
-    """Semantic-segmentation conditioning map (reference's UperNet +
-    ADE palette, controlnet.py:39-40,122-141), approximated with k-means
-    region clustering over color+position features painted with the same
-    style of label palette. The model-backed UperNet replaces this when
-    segmentation weights land."""
+    """Semantic-segmentation conditioning map (reference's UperNet + ADE
+    palette, controlnet.py:39-40,122-141). With converted
+    openmmlab/upernet-convnext weights present, the REAL UperNet runs
+    (models/segmentation.py, parity-tested vs transformers); otherwise a
+    k-means clustering stand-in paints the same style of label palette
+    and the job is flagged degraded."""
+    from ..pipelines.aux_models import get_segmenter
+
+    seg_model = get_segmenter()
+    if seg_model is not None:
+        labels = seg_model(image)  # [H, W] ADE ids
+        seg = ADE_STYLE_PALETTE[labels % len(ADE_STYLE_PALETTE)]
+        return Image.fromarray(seg)
     import cv2
 
     arr = np.asarray(

@@ -150,3 +150,22 @@ def test_paf_grouping_decodes_synthetic_person():
         if found[k, 2] > 0:
             assert abs(found[k, 0] - pts[k][0] * 8) < 12
             assert abs(found[k, 1] - pts[k][1] * 8) < 12
+
+
+def test_flat_pth_keys_convert():
+    """The distributed body_pose_model.pth stores FLAT caffe-style keys
+    (pytorch-openpose re-prefixes them at load); conversion must produce
+    the same tree as the module-prefixed layout."""
+    torch.manual_seed(51)
+    tref = BodyPoseT().eval()
+    prefixed = {k: v.numpy() for k, v in tref.state_dict().items()}
+    flat = {k.split(".", 1)[1]: v for k, v in prefixed.items()}
+    a = convert_openpose_body(prefixed)
+    b = convert_openpose_body(flat)
+    import jax
+
+    la = jax.tree_util.tree_leaves_with_path(a)
+    lb = dict(jax.tree_util.tree_leaves_with_path(b))
+    assert len(la) == len(lb)
+    for path, va in la:
+        np.testing.assert_array_equal(va, lb[path])

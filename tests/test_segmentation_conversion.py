@@ -1,0 +1,135 @@
+"""UperNet (ConvNeXt) segmentation conversion: numeric parity against the
+real transformers UperNetForSemanticSegmentation graph — the learned
+detector the reference's `segmentation` annotator runs
+(swarm/pre_processors/controlnet.py:122-141), replacing the k-means
+stand-in (VERDICT r03 item 3).
+"""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+torch = pytest.importorskip("torch")
+
+from chiaswarm_tpu.models.conversion import convert_upernet  # noqa: E402
+from chiaswarm_tpu.models.segmentation import (  # noqa: E402
+    TINY_UPERNET,
+    UperNetSegmenter,
+)
+
+
+@pytest.fixture(scope="module")
+def pair():
+    from transformers import (
+        ConvNextConfig,
+        UperNetConfig as HFUperNetConfig,
+        UperNetForSemanticSegmentation,
+    )
+
+    cfg = TINY_UPERNET
+    hf = HFUperNetConfig(
+        backbone_config=ConvNextConfig(
+            depths=list(cfg.depths), hidden_sizes=list(cfg.hidden_sizes),
+            num_channels=3,
+            out_features=["stage1", "stage2", "stage3", "stage4"],
+        ),
+        hidden_size=cfg.hidden_size,
+        num_labels=cfg.num_labels,
+        auxiliary_in_channels=cfg.hidden_sizes[2],
+        pool_scales=list(cfg.pool_scales),
+    )
+    torch.manual_seed(60)
+    tref = UperNetForSemanticSegmentation(hf).eval()
+    state = {k: v.numpy() for k, v in tref.state_dict().items()}
+    return tref, convert_upernet(state)
+
+
+def test_logits_match(pair):
+    tref, params = pair
+    cfg = TINY_UPERNET
+    rng = np.random.default_rng(61)
+    # 64x64 keeps stage-4 at 4x4, exercising non-divisible adaptive pools
+    px = rng.standard_normal((1, 64, 64, 3)).astype(np.float32)
+    with torch.no_grad():
+        out_t = tref(
+            torch.from_numpy(px.transpose(0, 3, 1, 2))
+        ).logits.numpy().transpose(0, 2, 3, 1)
+    out_f = np.asarray(
+        UperNetSegmenter(cfg).apply(
+            {"params": params}, jnp.asarray(px)
+        )
+    )
+    assert out_f.shape == out_t.shape
+    np.testing.assert_allclose(out_f, out_t, atol=5e-4, rtol=1e-3)
+
+
+def test_argmax_label_map_matches(pair):
+    tref, params = pair
+    rng = np.random.default_rng(62)
+    px = rng.standard_normal((1, 32, 32, 3)).astype(np.float32)
+    with torch.no_grad():
+        lab_t = tref(
+            torch.from_numpy(px.transpose(0, 3, 1, 2))
+        ).logits.argmax(1).numpy()
+    lab_f = np.asarray(
+        UperNetSegmenter(TINY_UPERNET).apply(
+            {"params": params}, jnp.asarray(px)
+        ).argmax(-1)
+    )
+    assert (lab_f == lab_t).mean() > 0.99
+
+
+def test_synthetic_repo_check_and_preprocessor(sdaas_root, tmp_path, pair):
+    """A synthetic upernet repo passes --check, the resident Segmenter
+    loads it, the `segmentation` preprocessor runs the REAL model, and the
+    degraded flag clears."""
+    import json
+
+    from PIL import Image
+    from safetensors.numpy import save_file
+
+    from chiaswarm_tpu.initialize import verify_local_model
+    from chiaswarm_tpu.pipelines import aux_models
+    from chiaswarm_tpu.pre_processors.controlnet import (
+        is_degraded_preprocessor,
+        preprocess_image,
+    )
+    from chiaswarm_tpu.settings import Settings, save_settings
+
+    tref, _ = pair
+    cfg = TINY_UPERNET
+    name = "openmmlab/upernet-convnext-small"
+    root = tmp_path / "models"
+    save_settings(Settings(model_root_dir=str(root)))
+    repo = root / name
+    repo.mkdir(parents=True)
+    save_file(
+        {k: v.numpy() for k, v in tref.state_dict().items()},
+        str(repo / "model.safetensors"),
+    )
+    (repo / "config.json").write_text(json.dumps({
+        "backbone_config": {"depths": list(cfg.depths),
+                            "hidden_sizes": list(cfg.hidden_sizes)},
+        "hidden_size": cfg.hidden_size,
+        "num_labels": cfg.num_labels,
+        "pool_scales": list(cfg.pool_scales),
+    }))
+
+    report = verify_local_model(name, root)
+    assert report is not None and report["upernet"] > 0
+
+    aux_models._SEG.clear()
+    try:
+        assert not is_degraded_preprocessor("segmentation")
+        img = Image.fromarray(
+            np.random.default_rng(63).integers(
+                0, 255, (40, 56, 3), dtype=np.uint8
+            ),
+            "RGB",
+        )
+        out = preprocess_image(img, "segmentation", "cpu:0")
+        assert out.size == img.size
+        assert np.asarray(out).ndim == 3  # palette-painted label map
+    finally:
+        aux_models._SEG.clear()

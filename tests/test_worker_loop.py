@@ -71,6 +71,11 @@ def test_capability_advertisement(sdaas_root):
     assert req["chips"] == "8"
     assert req["slices"] == "2"
     assert "memory" in req and "gpu" in req  # legacy keys still advertised
+    # model-layer honesty: families with no conversion path are advertised
+    # so a capability-aware hive stops sending un-runnable jobs
+    unconverted = req["unconverted_families"].split(",")
+    assert "cascade" in unconverted and "kandinsky3" in unconverted
+    assert "bark" not in unconverted and "audioldm2" in unconverted
 
 
 def test_bad_args_produce_fatal_envelope(sdaas_root):
