@@ -30,7 +30,9 @@ FAMILY_PARAMS_GB: dict[str, float] = {
     "sd21": 2.1,
     "sdxl": 8.0,
     "sdxl_refiner": 7.2,
-    "flux": 26.0,  # 12B MMDiT + T5-XXL: needs a TP slice
+    # measured from the real flux-dev geometry via eval_shape in
+    # tests/test_flux_tp.py (12B MMDiT + 4.7B T5-XXL, bf16)
+    "flux": 31.4,
     "kandinsky": 6.0,  # prior + decoder + CLIP-bigG text tower
     "kandinsky3": 16.0,  # 3B UNet + FLAN-T5-XXL encoder
     "cascade": 11.0,  # stage C 3.6B + stage B 1.5B + text tower

@@ -62,7 +62,7 @@ class PriorBlock(nn.Module):
         h, hd = cfg.num_heads, cfg.head_dim
         inner = cfg.hidden_size
         b, s, _ = x.shape
-        y = nn.LayerNorm(dtype=self.dtype, name="norm1")(x)
+        y = nn.LayerNorm(epsilon=1e-5, dtype=self.dtype, name="norm1")(x)
         proj = lambda name: nn.Dense(inner, dtype=self.dtype, name=name)(
             y
         ).reshape(b, s, h, hd)
@@ -73,7 +73,7 @@ class PriorBlock(nn.Module):
         w = nn.softmax(logits.astype(jnp.float32), axis=-1).astype(self.dtype)
         attn = jnp.einsum("bhqk,bkhd->bqhd", w, v).reshape(b, s, inner)
         x = x + nn.Dense(inner, dtype=self.dtype, name="to_out_0")(attn)
-        y = nn.LayerNorm(dtype=self.dtype, name="norm3")(x)
+        y = nn.LayerNorm(epsilon=1e-5, dtype=self.dtype, name="norm3")(x)
         y = nn.Dense(4 * inner, dtype=self.dtype, name="ff_proj")(y)
         y = nn.gelu(y, approximate=False)
         return x + nn.Dense(inner, dtype=self.dtype, name="ff_out")(y)
@@ -139,7 +139,7 @@ class DiffusionPrior(nn.Module):
         for i in range(cfg.num_layers):
             x = PriorBlock(cfg, dtype=self.dtype,
                            name=f"transformer_blocks_{i}")(x, mask)
-        x = nn.LayerNorm(dtype=self.dtype, name="norm_out")(x)
+        x = nn.LayerNorm(epsilon=1e-5, dtype=self.dtype, name="norm_out")(x)
         # the learned prd token carries the prediction
         return nn.Dense(cfg.embed_dim, dtype=self.dtype,
                         name="proj_to_clip_embeddings")(x[:, -1])

@@ -80,6 +80,7 @@ IF_UNET = K22UNetConfig(
     attention_head_dim=64,
     cross_attention_dim=2048,
     encoder_hid_dim=4096,  # T5-XXL hidden width
+    image_proj_tokens=0,  # text mode: no ImageProjection tokens
     down_attention=(False, True, True, True),
     conditioning="text",
     act="gelu",
@@ -94,6 +95,7 @@ TINY_IF_UNET = K22UNetConfig(
     attention_head_dim=8,
     cross_attention_dim=16,
     encoder_hid_dim=32,
+    image_proj_tokens=0,  # text mode: no ImageProjection tokens
     down_attention=(False, True),
     norm_num_groups=8,
     conditioning="text",

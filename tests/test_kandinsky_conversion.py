@@ -549,3 +549,94 @@ def test_full_k21_repo_check_and_pipeline(sdaas_root, tmp_path):
             rng=jax.random.key(7),
         )
         assert len(images) == 1 and images[0].size == (64, 64)
+
+
+def test_movq_decode_torch_parity():
+    """MoVQ decode numerically validated against an exact-key torch mirror
+    of the diffusers spatial-norm VQModel decoder (roundtrip-only until
+    now — VERDICT r03 item 5)."""
+    import os
+    import sys
+
+    import torch
+
+    sys.path.insert(0, os.path.dirname(__file__))
+    from torch_unet_ref import MoVQDecoderT
+
+    from chiaswarm_tpu.models.conversion import convert_movq
+    from chiaswarm_tpu.models.movq import TINY_MOVQ, MoVQ
+
+    torch.manual_seed(80)
+    tref = MoVQDecoderT(TINY_MOVQ).eval()
+    state = {k: v.numpy() for k, v in tref.state_dict().items()}
+    params = convert_movq(state)
+
+    rng = np.random.default_rng(81)
+    z = rng.standard_normal(
+        (1, 8, 8, TINY_MOVQ.latent_channels)
+    ).astype(np.float32)
+    with torch.no_grad():
+        px_t = tref(
+            torch.from_numpy(z.transpose(0, 3, 1, 2))
+        ).numpy().transpose(0, 2, 3, 1)
+    px_f = np.asarray(
+        MoVQ(TINY_MOVQ).apply(
+            {"params": params}, jnp.asarray(z), method=MoVQ.decode
+        )
+    )
+    np.testing.assert_allclose(px_f, px_t, atol=3e-4, rtol=1e-3)
+
+
+def test_prior_transformer_torch_parity():
+    """PriorTransformer forward numerically validated against an exact-key
+    torch mirror (roundtrip-only until now — VERDICT r03 item 5), with and
+    without a text attention mask."""
+    import os
+    import sys
+
+    import torch
+
+    sys.path.insert(0, os.path.dirname(__file__))
+    from torch_unet_ref import PriorTransformerT
+
+    from chiaswarm_tpu.models.conversion import convert_prior
+    from chiaswarm_tpu.models.prior import TINY_PRIOR, DiffusionPrior
+
+    cfg = TINY_PRIOR
+    torch.manual_seed(90)
+    tref = PriorTransformerT(cfg).eval()
+    with torch.no_grad():
+        tref.positional_embedding.normal_(0, 0.02)
+        tref.prd_embedding.normal_(0, 0.02)
+    state = {k: v.numpy() for k, v in tref.state_dict().items()}
+    params, stats = convert_prior(state)
+
+    rng = np.random.default_rng(91)
+    noisy = rng.standard_normal((2, cfg.embed_dim)).astype(np.float32)
+    t = np.array([13.0, 700.0], np.float32)
+    hiddens = rng.standard_normal(
+        (2, cfg.text_seq, cfg.text_dim)
+    ).astype(np.float32)
+    embed = rng.standard_normal((2, cfg.text_dim)).astype(np.float32)
+    mask = np.ones((2, cfg.text_seq), np.float32)
+    mask[:, 30:] = 0.0
+
+    model = DiffusionPrior(cfg)
+    for m in (None, mask):
+        kw_t = {} if m is None else {
+            "attention_mask": torch.from_numpy(m)
+        }
+        kw_f = {} if m is None else {"attention_mask": jnp.asarray(m)}
+        with torch.no_grad():
+            out_t = tref(
+                torch.from_numpy(noisy), torch.from_numpy(t),
+                torch.from_numpy(hiddens), torch.from_numpy(embed), **kw_t,
+            ).numpy()
+        out_f = np.asarray(
+            model.apply(
+                {"params": params}, jnp.asarray(noisy), jnp.asarray(t),
+                jnp.asarray(hiddens), jnp.asarray(embed), **kw_f,
+            )
+        )
+        np.testing.assert_allclose(out_f, out_t, atol=3e-4, rtol=1e-3)
+    assert stats is not None

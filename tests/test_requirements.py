@@ -48,18 +48,26 @@ def test_oversized_batch_caps_not_fails():
 
 
 def test_flux_needs_tensor_parallelism():
-    # 26 GB of parameters cannot sit on one 16 GB chip
+    # 31.4 GB of parameters (measured geometry, test_flux_tp.py) cannot
+    # sit on one 16 GB chip
     with pytest.raises(ValueError, match="tensor parallel"):
         check_capacity(FakeChipSet(), "black-forest-labs/FLUX.1-dev", 1, 1024)
-    assert min_chips("black-forest-labs/FLUX.1-dev", 16.0) >= 2
+    assert min_chips("black-forest-labs/FLUX.1-dev", 16.0) >= 4
     # DATA-parallel chips do not help: the params replicate per chip
     with pytest.raises(ValueError, match="tensor parallel"):
         check_capacity(
             FakeChipSet(chips=8), "black-forest-labs/FLUX.1-dev", 1, 1024
         )
-    # a tensor-parallel 2-chip slice shards the parameters and fits
+    # tensor=2 leaves <1 GB headroom after the 15.7 GB parameter cut: still
+    # refused rather than admitted into an OOM
+    with pytest.raises(ValueError, match="does not fit"):
+        check_capacity(
+            FakeChipSet(chips=2, tensor=2), "black-forest-labs/FLUX.1-dev",
+            1, 1024,
+        )
+    # a tensor-parallel 4-chip slice shards the parameters and fits
     assert check_capacity(
-        FakeChipSet(chips=2, tensor=2), "black-forest-labs/FLUX.1-dev", 1, 1024
+        FakeChipSet(chips=4, tensor=4), "black-forest-labs/FLUX.1-dev", 1, 1024
     ) == 1
 
 

@@ -319,3 +319,64 @@ class TestK22UNetTorchParity:
             )
         )
         np.testing.assert_allclose(out_f, out_t, atol=2e-4, rtol=1e-3)
+
+
+class TestIFUNetTorchParity:
+    """DeepFloyd IF's text-conditioning branch numerically validated (the
+    torch mirror was roundtrip-only here until now — VERDICT r03 item 5):
+    TextTimeEmbedding attention pooling, gelu K-blocks, the SR stage's
+    class-embedded noise level."""
+
+    def _run(self, cfg, class_labels=None):
+        from torch_unet_ref import K22UNetT
+
+        from chiaswarm_tpu.models.conversion import convert_kandinsky_unet
+        from chiaswarm_tpu.models.unet_kandinsky import K22UNet
+
+        torch.manual_seed(70)
+        tref = K22UNetT(cfg).eval()
+        state = {k: v.numpy() for k, v in tref.state_dict().items()}
+        inferred, params = convert_kandinsky_unet(
+            state, {"attention_head_dim": cfg.attention_head_dim,
+                    "norm_num_groups": cfg.norm_num_groups,
+                    "act_fn": cfg.act,
+                    "addition_embed_type_num_heads": cfg.addition_embed_heads},
+        )
+        assert inferred == cfg
+
+        rng = np.random.default_rng(71)
+        x = rng.standard_normal((2, 16, 16, cfg.in_channels)).astype(np.float32)
+        t = np.array([3.0, 801.0], np.float32)
+        states = rng.standard_normal((2, 6, cfg.encoder_hid_dim)).astype(
+            np.float32
+        )
+        kw_t = {}
+        kw_f = {}
+        if class_labels is not None:
+            kw_t["class_labels"] = torch.from_numpy(class_labels)
+            kw_f["class_labels"] = jnp.asarray(class_labels)
+        with torch.no_grad():
+            out_t = tref(
+                _to_torch_nchw(x), torch.from_numpy(t),
+                torch.from_numpy(states), **kw_t,
+            ).numpy().transpose(0, 2, 3, 1)
+        out_f = np.asarray(
+            K22UNet(cfg).apply(
+                {"params": params}, jnp.asarray(x), jnp.asarray(t),
+                jnp.asarray(states), **kw_f,
+            )
+        )
+        np.testing.assert_allclose(out_f, out_t, atol=3e-4, rtol=1e-3)
+
+    def test_if_base_text_conditioning_matches(self):
+        from chiaswarm_tpu.models.unet_kandinsky import TINY_IF_UNET
+
+        self._run(TINY_IF_UNET)
+
+    def test_if_sr_class_embed_matches(self):
+        from chiaswarm_tpu.models.unet_kandinsky import TINY_IF_SR_UNET
+
+        self._run(
+            TINY_IF_SR_UNET,
+            class_labels=np.array([50.0, 250.0], np.float32),
+        )

@@ -146,3 +146,52 @@ def test_sinusoidal_pe_interleaves():
     # interleaved layout: pe[p, 0] = sin(p), pe[p, 1] = cos(p)
     np.testing.assert_allclose(pe[3, 0], np.sin(3.0), atol=1e-6)
     np.testing.assert_allclose(pe[3, 1], np.cos(3.0), atol=1e-6)
+
+
+def test_motion_module_torch_parity():
+    """The AnimateDiff temporal transformer numerically validated against
+    an exact-key torch mirror (roundtrip-only until now — VERDICT r03
+    item 5): interleaved sinusoidal positions on the normed attention
+    inputs, GEGLU FF, zero-init residual projection wiring."""
+    import os
+    import sys
+
+    import numpy as np
+    import torch
+
+    import jax.numpy as jnp
+
+    sys.path.insert(0, os.path.dirname(__file__))
+    from torch_unet_ref import MotionModuleT
+
+    from chiaswarm_tpu.models.conversion import convert_state_dict
+    from chiaswarm_tpu.models.video_unet import TemporalTransformer
+
+    channels, heads, layers, frames = 32, 4, 2, 8
+    torch.manual_seed(100)
+    tref = MotionModuleT(channels, heads, layers).eval()
+    state = {
+        k.replace("temporal_transformer.", ""): v.numpy()
+        for k, v in tref.state_dict().items()
+    }
+
+    def rename(name):
+        name = name.replace(".to_out.0.", ".to_out_0.")
+        name = name.replace(".ff.net.0.", ".ff.net_0.")
+        name = name.replace(".ff.net.2.", ".ff.net_2.")
+        return name
+
+    params = convert_state_dict(state, rename)
+
+    rng = np.random.default_rng(101)
+    x = rng.standard_normal((frames, 6, 5, channels)).astype(np.float32)
+    with torch.no_grad():
+        out_t = tref(
+            torch.from_numpy(x.transpose(0, 3, 1, 2)), frames
+        ).numpy().transpose(0, 2, 3, 1)
+    out_f = np.asarray(
+        TemporalTransformer(channels, heads, layers).apply(
+            {"params": params}, jnp.asarray(x), frames
+        )
+    )
+    np.testing.assert_allclose(out_f, out_t, atol=3e-4, rtol=1e-3)

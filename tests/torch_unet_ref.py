@@ -577,9 +577,45 @@ class _KStage(nn.Module):
         super().__init__()
 
 
+class AttentionPoolingT(nn.Module):
+    """diffusers AttentionPooling (IF TextTimeEmbedding pool), exact keys."""
+
+    def __init__(self, num_heads, embed_dim):
+        super().__init__()
+        self.positional_embedding = nn.Parameter(
+            torch.randn(1, embed_dim) / embed_dim**0.5
+        )
+        self.k_proj = nn.Linear(embed_dim, embed_dim)
+        self.q_proj = nn.Linear(embed_dim, embed_dim)
+        self.v_proj = nn.Linear(embed_dim, embed_dim)
+        self.num_heads = num_heads
+        self.dim_per_head = embed_dim // num_heads
+
+    def forward(self, x):
+        bs, length, width = x.size()
+
+        def shape(t):
+            return (
+                t.view(bs, -1, self.num_heads, self.dim_per_head)
+                .transpose(1, 2)
+            )
+
+        class_token = x.mean(dim=1, keepdim=True) + self.positional_embedding
+        x = torch.cat([class_token, x], dim=1)
+        q = shape(self.q_proj(class_token))
+        k = shape(self.k_proj(x))
+        v = shape(self.v_proj(x))
+        w = torch.softmax(
+            (q @ k.transpose(-1, -2)) * self.dim_per_head**-0.5, dim=-1
+        )
+        a = (w @ v).transpose(1, 2).reshape(bs, -1, width)
+        return a[:, 0, :]
+
+
 class K22UNetT(nn.Module):
-    """Torch mirror of the K2.2 decoder UNet with EXACT diffusers key
-    names, so convert_kandinsky_unet consumes its state dict directly."""
+    """Torch mirror of the K2.x / DeepFloyd IF UNet with EXACT diffusers
+    key names, so convert_kandinsky_unet consumes its state dict directly
+    (image mode = K2.2, text_image = K2.1, text = IF)."""
 
     def __init__(self, cfg):
         super().__init__()
@@ -608,6 +644,24 @@ class K22UNetT(nn.Module):
                     cfg.encoder_hid_dim, cfg.cross_attention_dim
                 ),
             })
+        elif cfg.conditioning == "text":
+            # DeepFloyd IF: TextTimeEmbedding (LN -> attention pool ->
+            # proj -> LN) + a plain Linear encoder_hid projection
+            self.add_embedding = nn.ModuleDict({
+                "norm1": nn.LayerNorm(cfg.encoder_hid_dim),
+                "pool": AttentionPoolingT(
+                    cfg.addition_embed_heads, cfg.encoder_hid_dim
+                ),
+                "proj": nn.Linear(cfg.encoder_hid_dim, temb_dim),
+                "norm2": nn.LayerNorm(temb_dim),
+            })
+            self.encoder_hid_proj = nn.Linear(
+                cfg.encoder_hid_dim, cfg.cross_attention_dim
+            )
+            if cfg.class_embed_timestep:
+                self.class_embedding = TimestepEmbeddingT(
+                    blocks[0], temb_dim
+                )
         else:
             self.add_embedding = nn.ModuleDict({
                 "image_proj": nn.Linear(cfg.encoder_hid_dim, temb_dim),
@@ -688,7 +742,7 @@ class K22UNetT(nn.Module):
         self.conv_out = nn.Conv2d(blocks[0], cfg.out_channels, 3, padding=1)
 
     def forward(self, sample, timesteps, image_embeds, text_states=None,
-                text_embeds=None):
+                text_embeds=None, class_labels=None):
         cfg = self.cfg
         temb = self.time_embedding(
             timestep_embedding_t(timesteps, cfg.block_out_channels[0])
@@ -704,6 +758,21 @@ class K22UNetT(nn.Module):
                 [img_tokens, self.encoder_hid_proj["text_proj"](text_states)],
                 dim=1,
             )
+        elif cfg.conditioning == "text":
+            # `image_embeds` carries the T5 states [B, S, E] in text mode
+            aug = self.add_embedding["norm1"](image_embeds)
+            aug = self.add_embedding["pool"](aug)
+            aug = self.add_embedding["proj"](aug)
+            temb = temb + self.add_embedding["norm2"](aug)
+            if cfg.class_embed_timestep:
+                if class_labels is None:
+                    class_labels = torch.zeros_like(timesteps)
+                temb = temb + self.class_embedding(
+                    timestep_embedding_t(
+                        class_labels, cfg.block_out_channels[0]
+                    )
+                )
+            ctx = self.encoder_hid_proj(image_embeds)
         else:
             temb = temb + self.add_embedding["image_norm"](
                 self.add_embedding["image_proj"](image_embeds)
@@ -735,3 +804,285 @@ class K22UNetT(nn.Module):
             if hasattr(stage, "upsamplers"):
                 x = stage.upsamplers[0](x, temb)
         return self.conv_out(F.silu(self.conv_norm_out(x)))
+
+
+# --- MoVQ (diffusers VQModel norm_type="spatial") decoder reference ---
+
+
+class SpatialNormT(nn.Module):
+    def __init__(self, ch, zq_ch, groups):
+        super().__init__()
+        self.norm_layer = nn.GroupNorm(groups, ch, eps=1e-6)
+        self.conv_y = nn.Conv2d(zq_ch, ch, 1)
+        self.conv_b = nn.Conv2d(zq_ch, ch, 1)
+
+    def forward(self, f, zq):
+        zq = F.interpolate(zq, size=f.shape[-2:], mode="nearest")
+        return self.norm_layer(f) * self.conv_y(zq) + self.conv_b(zq)
+
+
+class VQResnetT(nn.Module):
+    def __init__(self, in_ch, out_ch, zq_ch, groups):
+        super().__init__()
+        self.norm1 = SpatialNormT(in_ch, zq_ch, groups)
+        self.conv1 = nn.Conv2d(in_ch, out_ch, 3, padding=1)
+        self.norm2 = SpatialNormT(out_ch, zq_ch, groups)
+        self.conv2 = nn.Conv2d(out_ch, out_ch, 3, padding=1)
+        if in_ch != out_ch:
+            self.conv_shortcut = nn.Conv2d(in_ch, out_ch, 1)
+
+    def forward(self, x, zq):
+        h = self.conv1(F.silu(self.norm1(x, zq)))
+        h = self.conv2(F.silu(self.norm2(h, zq)))
+        if hasattr(self, "conv_shortcut"):
+            x = self.conv_shortcut(x)
+        return x + h
+
+
+class VQAttentionT(nn.Module):
+    def __init__(self, ch, zq_ch, groups):
+        super().__init__()
+        self.spatial_norm = SpatialNormT(ch, zq_ch, groups)
+        self.to_q = nn.Linear(ch, ch)
+        self.to_k = nn.Linear(ch, ch)
+        self.to_v = nn.Linear(ch, ch)
+        self.to_out = nn.ModuleList([nn.Linear(ch, ch)])
+
+    def forward(self, x, zq):
+        b, c, h, w = x.shape
+        tokens = self.spatial_norm(x, zq).permute(0, 2, 3, 1).reshape(
+            b, h * w, c
+        )
+        q, k, v = self.to_q(tokens), self.to_k(tokens), self.to_v(tokens)
+        wts = torch.softmax(q @ k.transpose(-1, -2) * c**-0.5, dim=-1)
+        out = self.to_out[0](wts @ v)
+        return x + out.reshape(b, h, w, c).permute(0, 3, 1, 2)
+
+
+class _VQStage(nn.Module):
+    pass
+
+
+class MoVQDecoderT(nn.Module):
+    """Decoder+post_quant_conv of the kandinsky movq VQModel, exact keys
+    under `decoder.` / `post_quant_conv.` so convert_movq consumes its
+    state dict directly."""
+
+    def __init__(self, cfg):
+        super().__init__()
+        self.cfg = cfg
+        g = cfg.norm_num_groups
+        zq = cfg.latent_channels
+        rev = list(reversed(cfg.block_out_channels))
+        self.post_quant_conv = nn.Conv2d(zq, cfg.latent_channels, 1)
+        dec = _VQStage()
+        dec.conv_in = nn.Conv2d(cfg.latent_channels, rev[0], 3, padding=1)
+        dec.mid_block = _VQStage()
+        dec.mid_block.resnets = nn.ModuleList(
+            [VQResnetT(rev[0], rev[0], zq, g), VQResnetT(rev[0], rev[0], zq, g)]
+        )
+        dec.mid_block.attentions = nn.ModuleList(
+            [VQAttentionT(rev[0], zq, g)]
+        )
+        dec.up_blocks = nn.ModuleList()
+        ch = rev[0]
+        for b, out_ch in enumerate(rev):
+            stage = _VQStage()
+            resnets = nn.ModuleList()
+            for i in range(cfg.layers_per_block + 1):
+                resnets.append(VQResnetT(ch, out_ch, zq, g))
+                ch = out_ch
+            stage.resnets = resnets
+            if b != len(rev) - 1:
+                up = _VQStage()
+                up.conv = nn.Conv2d(out_ch, out_ch, 3, padding=1)
+                stage.upsamplers = nn.ModuleList([up])
+            dec.up_blocks.append(stage)
+        dec.conv_norm_out = SpatialNormT(rev[-1], zq, g)
+        dec.conv_out = nn.Conv2d(rev[-1], cfg.out_channels, 3, padding=1)
+        self.decoder = dec
+
+    def forward(self, latents):
+        zq = latents
+        x = self.post_quant_conv(latents)
+        d = self.decoder
+        x = d.conv_in(x)
+        x = d.mid_block.resnets[0](x, zq)
+        x = d.mid_block.attentions[0](x, zq)
+        x = d.mid_block.resnets[1](x, zq)
+        for b, stage in enumerate(d.up_blocks):
+            for r in stage.resnets:
+                x = r(x, zq)
+            if hasattr(stage, "upsamplers"):
+                x = F.interpolate(x, scale_factor=2.0, mode="nearest")
+                x = stage.upsamplers[0].conv(x)
+        return d.conv_out(F.silu(d.conv_norm_out(x, zq)))
+
+
+# --- PriorTransformer reference (kandinsky prior) ---
+
+
+class PriorBlockT(nn.Module):
+    """BasicTransformerBlock(attention_bias=True, activation_fn='gelu',
+    norm1/attn1/norm3/ff) with exact diffusers key names."""
+
+    def __init__(self, inner, heads):
+        super().__init__()
+        self.norm1 = nn.LayerNorm(inner)
+        self.attn1 = AttentionT(inner, heads, inner // heads, qkv_bias=True)
+        self.norm3 = nn.LayerNorm(inner)
+
+        class _FF(nn.Module):
+            def __init__(self):
+                super().__init__()
+
+                class _Proj(nn.Module):
+                    def __init__(self):
+                        super().__init__()
+                        self.proj = nn.Linear(inner, 4 * inner)
+
+                    def forward(self, x):
+                        return F.gelu(self.proj(x))
+
+                self.net = nn.ModuleList(
+                    [_Proj(), nn.Dropout(0.0), nn.Linear(4 * inner, inner)]
+                )
+
+            def forward(self, x):
+                for m in self.net:
+                    x = m(x)
+                return x
+
+        self.ff = _FF()
+
+    def forward(self, x, mask=None):
+        y = self.norm1(x)
+        b, s, inner = y.shape
+        h = self.attn1.heads
+        hd = self.attn1.dim_head
+        shape = lambda t: t.view(b, s, h, hd).transpose(1, 2)
+        q = shape(self.attn1.to_q(y))
+        k = shape(self.attn1.to_k(y))
+        v = shape(self.attn1.to_v(y))
+        logits = q @ k.transpose(-1, -2) * hd**-0.5
+        if mask is not None:
+            logits = logits + mask
+        w = torch.softmax(logits.float(), dim=-1).to(q.dtype)
+        attn = (w @ v).transpose(1, 2).reshape(b, s, inner)
+        x = x + self.attn1.to_out(attn)
+        return x + self.ff(self.norm3(x))
+
+
+class PriorTransformerT(nn.Module):
+    """diffusers PriorTransformer with exact key names, mirroring
+    models/prior.py's graph (token layout [text_hiddens | text_embed |
+    time | noisy | prd], pad+causal attention mask, prd-token readout)."""
+
+    def __init__(self, cfg):
+        super().__init__()
+        self.cfg = cfg
+        inner = cfg.hidden_size
+        self.time_embedding = TimestepEmbeddingT(inner, inner)
+        self.proj_in = nn.Linear(cfg.embed_dim, inner)
+        self.embedding_proj = nn.Linear(cfg.text_dim, inner)
+        self.encoder_hidden_states_proj = nn.Linear(cfg.text_dim, inner)
+        self.positional_embedding = nn.Parameter(
+            torch.zeros(1, cfg.text_seq + cfg.additional_tokens, inner)
+        )
+        self.prd_embedding = nn.Parameter(torch.zeros(1, 1, inner))
+        self.transformer_blocks = nn.ModuleList(
+            [PriorBlockT(inner, cfg.num_heads) for _ in range(cfg.num_layers)]
+        )
+        self.norm_out = nn.LayerNorm(inner)
+        self.proj_to_clip_embeddings = nn.Linear(inner, cfg.embed_dim)
+        self.register_buffer("clip_mean", torch.zeros(1, cfg.embed_dim))
+        self.register_buffer("clip_std", torch.ones(1, cfg.embed_dim))
+
+    def forward(self, noisy, timesteps, text_hiddens, text_embed,
+                attention_mask=None):
+        cfg = self.cfg
+        b = noisy.shape[0]
+        t_feat = timestep_embedding_t(timesteps, cfg.hidden_size)
+        time_tok = self.time_embedding(t_feat)
+        x = torch.cat(
+            [
+                self.encoder_hidden_states_proj(text_hiddens),
+                self.embedding_proj(text_embed)[:, None],
+                time_tok[:, None],
+                self.proj_in(noisy)[:, None],
+                self.prd_embedding.expand(b, -1, -1),
+            ],
+            dim=1,
+        )
+        x = x + self.positional_embedding
+        seq = cfg.text_seq + cfg.additional_tokens
+        mask = None
+        if attention_mask is not None:
+            pad = (1.0 - attention_mask.float()) * -1e4
+            pad = F.pad(pad, (0, cfg.additional_tokens))
+            causal = torch.triu(
+                torch.full((seq, seq), -1e4), diagonal=1
+            )
+            mask = (pad[:, None, :] + causal[None])[:, None, :, :]
+        for blk in self.transformer_blocks:
+            x = blk(x, mask)
+        x = self.norm_out(x)
+        return self.proj_to_clip_embeddings(x[:, -1])
+
+
+# --- AnimateDiff temporal (motion-module) transformer reference ---
+
+
+class MotionModuleT(nn.Module):
+    """diffusers AnimateDiff motion module (TransformerTemporalModel with
+    sinusoidal positional embeddings), exact `temporal_transformer.*` keys.
+    Forward takes [B*F, C, H, W] like the UNet integration point."""
+
+    def __init__(self, channels, heads, layers, max_pos=32):
+        super().__init__()
+
+        class _TT(nn.Module):
+            def __init__(self):
+                super().__init__()
+                self.norm = nn.GroupNorm(32, channels, eps=1e-6)
+                self.proj_in = nn.Linear(channels, channels)
+                self.transformer_blocks = nn.ModuleList(
+                    [BasicBlockT(channels, heads, channels // heads, None)
+                     for _ in range(layers)]
+                )
+                self.proj_out = nn.Linear(channels, channels)
+
+        self.temporal_transformer = _TT()
+        self.channels = channels
+        # interleaved sin/cos table (diffusers SinusoidalPositionalEmbedding)
+        position = torch.arange(max_pos).unsqueeze(1).float()
+        div = torch.exp(
+            torch.arange(0, channels, 2).float()
+            * (-math.log(10000.0) / channels)
+        )
+        pe = torch.zeros(max_pos, channels)
+        pe[:, 0::2] = torch.sin(position * div)
+        pe[:, 1::2] = torch.cos(position * div)
+        self.register_buffer("pe", pe, persistent=False)
+
+    def forward(self, x, num_frames):
+        tt = self.temporal_transformer
+        bf, c, h, w = x.shape
+        b = bf // num_frames
+        residual = x
+        hidden = tt.norm(x)
+        hidden = hidden.view(b, num_frames, c, h, w).permute(0, 3, 4, 1, 2)
+        hidden = hidden.reshape(b * h * w, num_frames, c)
+        hidden = tt.proj_in(hidden)
+        pos = self.pe[:num_frames]
+        for blk in tt.transformer_blocks:
+            # positional embeddings apply to the NORMED input of each attn
+            y = blk.norm1(hidden)
+            hidden = hidden + blk.attn1(y + pos[None])
+            y = blk.norm2(hidden)
+            hidden = hidden + blk.attn2(y + pos[None])
+            hidden = hidden + blk.ff(blk.norm3(hidden))
+        hidden = tt.proj_out(hidden)
+        hidden = hidden.reshape(b, h, w, num_frames, c).permute(0, 3, 4, 1, 2)
+        hidden = hidden.reshape(bf, c, h, w)
+        return residual + hidden
