@@ -146,7 +146,53 @@ def verify_local_model(model_name: str, root: Path | None = None) -> dict | None
         return _verify_openpose_model(model_name, root)
     if "upernet" in name:
         return _verify_upernet_model(model_name, root)
+    if any(k in name for k in ("zeroscope", "text-to-video", "damo")):
+        return _verify_unet3d_model(model_name, root)
     return _verify_sd_model(model_name, root)
+
+
+def _verify_unet3d_model(model_name: str, root: Path) -> dict:
+    """zeroscope/modelscope text-to-video repo: the SAME loader the video
+    pipeline serves with (UNet3D + CLIP tower + VAE, geometry from the
+    checkpoint)."""
+    import jax.numpy as jnp
+
+    from .models.clip import CLIPTextEncoder
+    from .models.conversion import assert_tree_shapes_match
+    from .models.unet3d import UNet3DConditionModel
+    from .models.vae import AutoencoderKL
+    from .pipelines.video import _load_converted_video
+
+    model_dir = root / model_name
+    if not model_dir.is_dir():
+        raise FileNotFoundError(f"no checkpoint directory {model_dir}")
+    conv = _load_converted_video(model_name, None, model_dir=model_dir)
+    if conv is None or "unet3d" not in conv:
+        raise FileNotFoundError(
+            f"no UNet3D checkpoint under {model_dir}"
+        )
+    cfg = conv["unet3d_cfg"]
+    expected = _eval_shape_params(
+        UNet3DConditionModel(cfg),
+        jnp.zeros((2, 16, 16, cfg.in_channels)),
+        jnp.zeros((2,)),
+        jnp.zeros((2, 8, cfg.cross_attention_dim)),
+        num_frames=2,  # static reshape factor: must not be traced
+    )
+    assert_tree_shapes_match(conv["unet3d"], expected, prefix="unet3d")
+    text_exp = _eval_shape_params(
+        CLIPTextEncoder(conv["clip_cfg"]), jnp.zeros((1, 77), jnp.int32)
+    )
+    assert_tree_shapes_match(conv["text"], text_exp, prefix="text")
+    vae_exp = _eval_shape_params(
+        AutoencoderKL(conv["vae_cfg"]), jnp.zeros((1, 32, 32, 3))
+    )
+    assert_tree_shapes_match(conv["vae"], vae_exp, prefix="vae")
+    return {
+        "unet3d": _param_count(conv["unet3d"]),
+        "text": _param_count(conv["text"]),
+        "vae": _param_count(conv["vae"]),
+    }
 
 
 def _verify_upernet_model(model_name: str, root: Path) -> dict:

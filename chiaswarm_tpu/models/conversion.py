@@ -84,6 +84,9 @@ def convert_tensor(path: list[str], leaf: str, tensor: np.ndarray):
             ):
                 return "embedding", tensor
             return "kernel", tensor.T
+        if tensor.ndim == 5:
+            # Conv3d (O, I, kt, kh, kw) -> flax (kt, kh, kw, I, O)
+            return "kernel", tensor.transpose(2, 3, 4, 1, 0)
         if tensor.ndim == 1:  # norm scale
             return "scale", tensor
     if leaf == "bias":
@@ -1451,3 +1454,79 @@ def convert_upernet(state: dict) -> dict:
         _assign(params, [target, "conv", "kernel"], w.transpose(2, 3, 1, 0))
         _assign(params, [target, "conv", "bias"], b)
     return params
+
+
+def unet3d_rename(name: str) -> str:
+    """diffusers UNet3DConditionModel names -> models.unet3d names."""
+    import re
+
+    name = name.replace(".to_out.0.", ".to_out_0.")
+    name = name.replace(".ff.net.0.", ".ff.net_0.")
+    name = name.replace(".ff.net.2.", ".ff.net_2.")
+    # TemporalConvLayer Sequentials: conv1 = [GN, SiLU, Conv] (conv idx 2),
+    # conv2..4 = [GN, SiLU, Dropout, Conv] (conv idx 3)
+    name = re.sub(r"\.conv1\.0\.", ".conv1_norm.", name)
+    name = re.sub(r"\.conv1\.2\.", ".conv1_conv.", name)
+    name = re.sub(r"\.conv([234])\.0\.", r".conv\1_norm.", name)
+    name = re.sub(r"\.conv([234])\.3\.", r".conv\1_conv.", name)
+    name = re.sub(
+        r"^down_blocks\.(\d+)\.(resnets|attentions|temp_attentions|"
+        r"temp_convs)\.", r"down_\1_\2.", name,
+    )
+    name = re.sub(
+        r"^up_blocks\.(\d+)\.(resnets|attentions|temp_attentions|"
+        r"temp_convs)\.", r"up_\1_\2.", name,
+    )
+    name = re.sub(r"^down_blocks\.(\d+)\.downsamplers\.0\.",
+                  r"down_\1_downsample.", name)
+    name = re.sub(r"^up_blocks\.(\d+)\.upsamplers\.0\.",
+                  r"up_\1_upsample.", name)
+    name = re.sub(r"^mid_block\.(resnets|attentions|temp_attentions|"
+                  r"temp_convs)\.", r"mid_\1_", name)
+    return name
+
+
+def convert_unet3d(state: dict) -> dict:
+    """diffusers UNet3DConditionModel state dict -> models.unet3d params
+    (temporal Conv3d kernels ride convert_tensor's generic 5d rule)."""
+    return convert_state_dict(state, unet3d_rename)
+
+
+def infer_unet3d_config(state: dict, config_json: dict | None = None):
+    """UNet3DConfig from the checkpoint shapes + config.json head dim."""
+    import re
+
+    from .unet3d import UNet3DConfig
+
+    blocks: dict[int, int] = {}
+    attn: set[int] = set()
+    layers = 1
+    for k in state:
+        m = re.match(r"down_blocks\.(\d+)\.resnets\.(\d+)\.conv1\.weight", k)
+        if m:
+            blocks[int(m.group(1))] = np.asarray(state[k]).shape[0]
+            layers = max(layers, int(m.group(2)) + 1)
+        m = re.match(r"down_blocks\.(\d+)\.attentions\.", k)
+        if m:
+            attn.add(int(m.group(1)))
+    n = max(blocks) + 1
+    cross = 1024
+    for k in state:
+        m = re.match(
+            r"down_blocks\.\d+\.attentions\.0\.transformer_blocks\.0\."
+            r"attn2\.to_k\.weight", k,
+        )
+        if m:
+            cross = np.asarray(state[k]).shape[1]
+            break
+    cfg_json = config_json or {}
+    return UNet3DConfig(
+        in_channels=np.asarray(state["conv_in.weight"]).shape[1],
+        out_channels=np.asarray(state["conv_out.weight"]).shape[0],
+        block_out_channels=tuple(blocks[i] for i in range(n)),
+        layers_per_block=layers,
+        attention=tuple(i in attn for i in range(n)),
+        attention_head_dim=int(cfg_json.get("attention_head_dim", 64)),
+        cross_attention_dim=cross,
+        norm_num_groups=int(cfg_json.get("norm_num_groups", 32)),
+    )

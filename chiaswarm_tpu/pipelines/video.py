@@ -55,7 +55,8 @@ def _model_dir(model_name: str):
     return model_dir_for(model_name)
 
 
-def _load_converted_video(model_name: str, motion_adapter: str | None):
+def _load_converted_video(model_name: str, motion_adapter: str | None,
+                          model_dir=None):
     """-> {"unet","text","vae","model_dir"} or None. AnimateDiff's
     composition: an SD1.5-family spatial UNet checkpoint overlaid with a
     MotionAdapter's temporal modules, plus the checkpoint's CLIP/VAE —
@@ -64,7 +65,7 @@ def _load_converted_video(model_name: str, motion_adapter: str | None):
     name = model_name.lower()
     if "tiny" in name or name.startswith("test/"):
         return None
-    d = _model_dir(model_name)
+    d = model_dir if model_dir is not None else _model_dir(model_name)
     adapter_dir = _model_dir(motion_adapter or DEFAULT_MOTION_ADAPTER)
     if d is None:
         return None
@@ -77,13 +78,61 @@ def _load_converted_video(model_name: str, motion_adapter: str | None):
     from ..weights import MissingWeightsError
 
     try:
+        unet_state = load_torch_state_dict(d, "unet")
+        if any("temp_convs" in k for k in unet_state):
+            # zeroscope / modelscope text-to-video: a native
+            # UNet3DConditionModel checkpoint (temporal convs +
+            # frame-attention), geometry inferred from the state dict
+            import json
+
+            from ..models.conversion import (
+                convert_unet3d,
+                infer_unet3d_config,
+            )
+
+            from ..models.clip import CLIPTextConfig
+            from ..models.conversion import infer_vae_config
+
+            def read_json(sub):
+                p = d / sub / "config.json"
+                return json.loads(p.read_text()) if p.is_file() else {}
+
+            unet3d_cfg = infer_unet3d_config(unet_state, read_json("unet"))
+            # zeroscope's text tower is CLIP ViT-H (1024), not the SD1.5
+            # default — geometry from the checkpoint's own config.json
+            tj = read_json("text_encoder")
+            base = CLIPTextConfig()
+            clip_cfg = CLIPTextConfig(
+                vocab_size=int(tj.get("vocab_size", base.vocab_size)),
+                hidden_size=int(tj.get("hidden_size", base.hidden_size)),
+                num_layers=int(
+                    tj.get("num_hidden_layers", base.num_layers)
+                ),
+                num_heads=int(
+                    tj.get("num_attention_heads", base.num_heads)
+                ),
+                max_positions=int(
+                    tj.get("max_position_embeddings", base.max_positions)
+                ),
+                hidden_act=str(tj.get("hidden_act", base.hidden_act)),
+            )
+            vae_state = load_torch_state_dict(d, "vae")
+            return {
+                "unet3d": convert_unet3d(unet_state),
+                "unet3d_cfg": unet3d_cfg,
+                "clip_cfg": clip_cfg,
+                "vae_cfg": infer_vae_config(vae_state, read_json("vae")),
+                "text": convert_clip(load_torch_state_dict(d, "text_encoder")),
+                "vae": convert_vae(vae_state),
+                "model_dir": d,
+            }
         if adapter_dir is None:
             raise FileNotFoundError(
                 f"motion adapter {motion_adapter or DEFAULT_MOTION_ADAPTER} "
                 "not downloaded"
             )
         unet = convert_video_unet(
-            load_torch_state_dict(d, "unet"),
+            unet_state,
             load_torch_state_dict(adapter_dir),
         )
         text = convert_clip(load_torch_state_dict(d, "text_encoder"))
@@ -160,12 +209,26 @@ class VideoPipeline:
                 base=_replace(video_cfg.base, in_channels=8),
                 num_frames=video_cfg.num_frames,
             )
+        if self._converted and "clip_cfg" in self._converted:
+            # native UNet3D checkpoints carry their own tower geometry
+            clip_cfg = self._converted["clip_cfg"]
+            vae_cfg = self._converted["vae_cfg"]
         self.config = video_cfg
         self.latent_factor = 2 ** (len(vae_cfg.block_out_channels) - 1)
 
         on_tpu = jax.default_backend() == "tpu"
         self.dtype = jnp.bfloat16 if on_tpu else jnp.float32
-        self.unet = VideoUNet(video_cfg, dtype=self.dtype)
+        self.unet3d = bool(self._converted) and "unet3d" in self._converted
+        if self.unet3d:
+            # native zeroscope/modelscope UNet3D checkpoint: motion-adapter
+            # and motion-LoRA overlays do not apply to this graph
+            from ..models.unet3d import UNet3DConditionModel
+
+            self.unet = UNet3DConditionModel(
+                self._converted["unet3d_cfg"], dtype=self.dtype
+            )
+        else:
+            self.unet = VideoUNet(video_cfg, dtype=self.dtype)
         self.text_encoder = CLIPTextEncoder(clip_cfg, dtype=self.dtype)
         self.vae = AutoencoderKL(vae_cfg, dtype=self.dtype)
         self.tokenizer = load_tokenizer(
@@ -261,9 +324,36 @@ class VideoPipeline:
             if self._converted is not None:
                 from ..models.conversion import checked_converted as _checked_converted
 
-                unet_params = _checked_converted(
-                    self.unet, unet_args, self._converted["unet"], "unet", k1
-                )
+                if self.unet3d:
+                    import functools
+
+                    from ..models.conversion import (
+                        assert_tree_shapes_match,
+                    )
+                    from ..weights import MissingWeightsError
+
+                    cfg3d = self._converted["unet3d_cfg"]
+                    # num_frames is a STATIC python int (reshape factor):
+                    # partial it so eval_shape never traces it
+                    expected = jax.eval_shape(
+                        functools.partial(self.unet.init, num_frames=frames),
+                        k1,
+                        jnp.zeros((frames, hw, hw, cfg3d.in_channels)),
+                        jnp.zeros((frames,)),
+                        jnp.zeros((frames, 77, cfg3d.cross_attention_dim)),
+                    )["params"]
+                    try:
+                        assert_tree_shapes_match(
+                            self._converted["unet3d"], expected, prefix="unet"
+                        )
+                    except ValueError as e:
+                        raise MissingWeightsError(str(e)) from None
+                    unet_params = self._converted["unet3d"]
+                else:
+                    unet_params = _checked_converted(
+                        self.unet, unet_args, self._converted["unet"],
+                        "unet", k1,
+                    )
                 text_params = _checked_converted(
                     self.text_encoder, (jnp.zeros((1, 77), jnp.int32),),
                     self._converted["text"], "text", k2,
@@ -365,21 +455,40 @@ class VideoPipeline:
         # requested adapter's temporal modules overlay the resident tree;
         # tiny/random pipelines record the request for observability.
         motion_adapter = kwargs.pop("motion_adapter", None)
+        ignored_adapters = []
         if motion_adapter is not None and self._converted is not None:
-            params = self._adapter_params(params, motion_adapter)
+            if self.unet3d:
+                # a native UNet3D graph has no motion modules to overlay —
+                # surface the ignored request instead of silently echoing
+                # it as applied
+                ignored_adapters.append(
+                    f"motion_adapter:{motion_adapter}"
+                )
+                motion_adapter = None
+            else:
+                params = self._adapter_params(params, motion_adapter)
         lora = kwargs.pop("lora", None)
         xattn_kwargs = kwargs.pop("cross_attention_kwargs", {}) or {}
         lora_scale = float(
             kwargs.pop("lora_scale", xattn_kwargs.get("scale", 1.0))
         )
         if lora is not None:
-            params = self._lora_params(params, lora, lora_scale)
+            if self.unet3d:
+                ignored_adapters.append(f"motion_lora:{lora}")
+            else:
+                params = self._lora_params(params, lora, lora_scale)
         steps = int(kwargs.pop("num_inference_steps", 25))
         guidance_scale = float(kwargs.pop("guidance_scale", 7.5))
-        frames = min(
-            int(kwargs.pop("num_frames", self.config.num_frames)),
-            self.config.num_frames,
+        # AnimateDiff's positional table caps the clip length; the native
+        # UNet3D graph has no positional embedding — its bound is memory,
+        # budgeted generously here
+        max_frames = 48 if self.unet3d else self.config.num_frames
+        requested_frames = int(
+            kwargs.pop("num_frames", 24 if self.unet3d
+                       else self.config.num_frames)
         )
+        frames = min(requested_frames, max_frames)
+        frames_truncated = frames < requested_frames
         fps = int(kwargs.pop("fps", DEFAULT_FPS))
         scheduler_type = kwargs.pop(
             "scheduler_type", "EulerAncestralDiscreteScheduler"
@@ -448,6 +557,9 @@ class VideoPipeline:
                 if motion_adapter is not None
                 else {}
             ),
+            **({"ignored_adapters": ignored_adapters}
+               if ignored_adapters else {}),
+            **({"frames_truncated": True} if frames_truncated else {}),
             "timings": timings,
         }
         return pil_frames, config

@@ -80,7 +80,7 @@ def model_dir_for(model_name: str):
 # capability-aware hive can stop sending jobs this worker can never run
 # (VERDICT r03 weak #7).
 UNCONVERTED_FAMILY_KEYWORDS = (
-    "audioldm2", "zeroscope", "text-to-video",
-    "i2vgen", "stable-video", "damo", "kandinsky-3", "kandinsky3",
+    "audioldm2",
+    "i2vgen", "stable-video", "kandinsky-3", "kandinsky3",
     "cascade", "latent-upscaler",
 )

@@ -1,0 +1,367 @@
+"""UNet3DConditionModel (zeroscope/modelscope text-to-video) conversion:
+numeric parity against an exact-key torch mirror (VERDICT r03 item 2 —
+the zeroscope family previously served an AnimateDiff-style
+approximation with no conversion path).
+"""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+torch = pytest.importorskip("torch")
+import torch.nn as nn  # noqa: E402
+import torch.nn.functional as F  # noqa: E402
+
+from chiaswarm_tpu.models.conversion import (  # noqa: E402
+    convert_unet3d,
+    infer_unet3d_config,
+)
+from chiaswarm_tpu.models.unet3d import (  # noqa: E402
+    TINY_UNET3D,
+    UNet3DConditionModel,
+)
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(__file__))
+from torch_unet_ref import (  # noqa: E402
+    BasicBlockT,
+    ResnetT,
+    TimestepEmbeddingT,
+    timestep_embedding_t,
+)
+
+
+class TemporalConvT(nn.Module):
+    """diffusers TemporalConvLayer, exact Sequential indices."""
+
+    def __init__(self, ch, groups):
+        super().__init__()
+        self.conv1 = nn.Sequential(
+            nn.GroupNorm(groups, ch), nn.SiLU(),
+            nn.Conv3d(ch, ch, (3, 1, 1), padding=(1, 0, 0)),
+        )
+        for i in (2, 3, 4):
+            setattr(self, f"conv{i}", nn.Sequential(
+                nn.GroupNorm(groups, ch), nn.SiLU(), nn.Dropout(0.0),
+                nn.Conv3d(ch, ch, (3, 1, 1), padding=(1, 0, 0)),
+            ))
+
+    def forward(self, x, num_frames):
+        bf, c, h, w = x.shape
+        b = bf // num_frames
+        hidden = x.reshape(b, num_frames, c, h, w).permute(0, 2, 1, 3, 4)
+        identity = hidden
+        for i in (1, 2, 3, 4):
+            hidden = getattr(self, f"conv{i}")(hidden)
+        hidden = identity + hidden
+        return hidden.permute(0, 2, 1, 3, 4).reshape(bf, c, h, w)
+
+
+class TransformerTemporalT(nn.Module):
+    """diffusers TransformerTemporalModel (double_self_attention)."""
+
+    def __init__(self, ch, heads, head_dim, groups):
+        super().__init__()
+        inner = heads * head_dim
+        self.norm = nn.GroupNorm(groups, ch, eps=1e-6)
+        self.proj_in = nn.Linear(ch, inner)
+        self.transformer_blocks = nn.ModuleList(
+            [BasicBlockT(inner, heads, head_dim, None)]
+        )
+        self.proj_out = nn.Linear(inner, ch)
+
+    def forward(self, x, num_frames):
+        bf, c, h, w = x.shape
+        b = bf // num_frames
+        residual = x
+        hidden = self.norm(x)
+        hidden = hidden.reshape(b, num_frames, c, h * w).permute(0, 3, 1, 2)
+        hidden = hidden.reshape(b * h * w, num_frames, c)
+        hidden = self.proj_in(hidden)
+        for blk in self.transformer_blocks:
+            hidden = blk(hidden, None)
+        hidden = self.proj_out(hidden)
+        hidden = hidden.reshape(b, h * w, num_frames, c).permute(0, 2, 3, 1)
+        return hidden.reshape(bf, c, h, w) + residual
+
+
+class SpatialTransformerT(nn.Module):
+    """Transformer2DModel with linear projections (one layer)."""
+
+    def __init__(self, ch, heads, head_dim, cross):
+        super().__init__()
+        self.norm = nn.GroupNorm(32 if ch % 32 == 0 else 8, ch, eps=1e-6)
+        self.proj_in = nn.Linear(ch, ch)
+        self.transformer_blocks = nn.ModuleList(
+            [BasicBlockT(ch, heads, head_dim, cross)]
+        )
+        self.proj_out = nn.Linear(ch, ch)
+
+    def forward(self, x, ctx):
+        b, c, h, w = x.shape
+        residual = x
+        hidden = self.norm(x).permute(0, 2, 3, 1).reshape(b, h * w, c)
+        hidden = self.proj_in(hidden)
+        for blk in self.transformer_blocks:
+            hidden = blk(hidden, ctx)
+        hidden = self.proj_out(hidden)
+        return hidden.reshape(b, h, w, c).permute(0, 3, 1, 2) + residual
+
+
+class _Stage(nn.Module):
+    pass
+
+
+class UNet3DT(nn.Module):
+    """Exact-key diffusers UNet3DConditionModel mirror for the tiny
+    config."""
+
+    def __init__(self, cfg):
+        super().__init__()
+        self.cfg = cfg
+        g = cfg.norm_num_groups
+        blocks = cfg.block_out_channels
+        temb_dim = blocks[0] * 4
+        hd = cfg.attention_head_dim
+        self.time_embedding = TimestepEmbeddingT(blocks[0], temb_dim)
+        self.conv_in = nn.Conv2d(cfg.in_channels, blocks[0], 3, padding=1)
+        self.transformer_in = TransformerTemporalT(blocks[0], 8, hd, g)
+        self.down_blocks = nn.ModuleList()
+        ch = blocks[0]
+        for bidx, out_ch in enumerate(blocks):
+            stage = _Stage()
+            stage.resnets = nn.ModuleList()
+            stage.temp_convs = nn.ModuleList()
+            if cfg.attention[bidx]:
+                stage.attentions = nn.ModuleList()
+                stage.temp_attentions = nn.ModuleList()
+            for i in range(cfg.layers_per_block):
+                stage.resnets.append(
+                    ResnetT(ch if i == 0 else out_ch, out_ch, temb_dim)
+                )
+                stage.temp_convs.append(TemporalConvT(out_ch, g))
+                if cfg.attention[bidx]:
+                    stage.attentions.append(
+                        SpatialTransformerT(
+                            out_ch, out_ch // hd, hd, cfg.cross_attention_dim
+                        )
+                    )
+                    stage.temp_attentions.append(
+                        TransformerTemporalT(out_ch, out_ch // hd, hd, g)
+                    )
+            if bidx != len(blocks) - 1:
+                down = _Stage()
+                down.conv = nn.Conv2d(out_ch, out_ch, 3, stride=2, padding=1)
+                stage.downsamplers = nn.ModuleList([down])
+            self.down_blocks.append(stage)
+            ch = out_ch
+
+        mid = _Stage()
+        mid.resnets = nn.ModuleList(
+            [ResnetT(blocks[-1], blocks[-1], temb_dim),
+             ResnetT(blocks[-1], blocks[-1], temb_dim)]
+        )
+        mid.temp_convs = nn.ModuleList(
+            [TemporalConvT(blocks[-1], g), TemporalConvT(blocks[-1], g)]
+        )
+        mid.attentions = nn.ModuleList([
+            SpatialTransformerT(blocks[-1], blocks[-1] // hd, hd,
+                                cfg.cross_attention_dim)
+        ])
+        mid.temp_attentions = nn.ModuleList([
+            TransformerTemporalT(blocks[-1], blocks[-1] // hd, hd, g)
+        ])
+        self.mid_block = mid
+
+        skip_chs = [blocks[0]]
+        for bidx, out_ch in enumerate(blocks):
+            skip_chs += [out_ch] * cfg.layers_per_block
+            if bidx != len(blocks) - 1:
+                skip_chs.append(out_ch)
+        self.up_blocks = nn.ModuleList()
+        ch = blocks[-1]
+        for bidx, out_ch in enumerate(reversed(blocks)):
+            rev = len(blocks) - 1 - bidx
+            stage = _Stage()
+            stage.resnets = nn.ModuleList()
+            stage.temp_convs = nn.ModuleList()
+            if cfg.attention[rev]:
+                stage.attentions = nn.ModuleList()
+                stage.temp_attentions = nn.ModuleList()
+            for i in range(cfg.layers_per_block + 1):
+                skip = skip_chs.pop()
+                stage.resnets.append(ResnetT(ch + skip, out_ch, temb_dim))
+                stage.temp_convs.append(TemporalConvT(out_ch, g))
+                if cfg.attention[rev]:
+                    stage.attentions.append(
+                        SpatialTransformerT(
+                            out_ch, out_ch // hd, hd, cfg.cross_attention_dim
+                        )
+                    )
+                    stage.temp_attentions.append(
+                        TransformerTemporalT(out_ch, out_ch // hd, hd, g)
+                    )
+                ch = out_ch
+            if bidx != len(blocks) - 1:
+                up = _Stage()
+                up.conv = nn.Conv2d(out_ch, out_ch, 3, padding=1)
+                stage.upsamplers = nn.ModuleList([up])
+            self.up_blocks.append(stage)
+        self.conv_norm_out = nn.GroupNorm(g, blocks[0], eps=1e-5)
+        self.conv_out = nn.Conv2d(blocks[0], cfg.out_channels, 3, padding=1)
+
+    def forward(self, sample, timesteps, ctx, num_frames):
+        cfg = self.cfg
+        temb = self.time_embedding(
+            timestep_embedding_t(timesteps, cfg.block_out_channels[0])
+        )
+        x = self.conv_in(sample)
+        x = self.transformer_in(x, num_frames)
+        skips = [x]
+        for bidx, stage in enumerate(self.down_blocks):
+            for i, resnet in enumerate(stage.resnets):
+                x = resnet(x, temb)
+                x = stage.temp_convs[i](x, num_frames)
+                if hasattr(stage, "attentions"):
+                    x = stage.attentions[i](x, ctx)
+                    x = stage.temp_attentions[i](x, num_frames)
+                skips.append(x)
+            if hasattr(stage, "downsamplers"):
+                x = stage.downsamplers[0].conv(x)
+                skips.append(x)
+        m = self.mid_block
+        x = m.resnets[0](x, temb)
+        x = m.temp_convs[0](x, num_frames)
+        x = m.attentions[0](x, ctx)
+        x = m.temp_attentions[0](x, num_frames)
+        x = m.resnets[1](x, temb)
+        x = m.temp_convs[1](x, num_frames)
+        for bidx, stage in enumerate(self.up_blocks):
+            for i, resnet in enumerate(stage.resnets):
+                x = torch.cat([x, skips.pop()], dim=1)
+                x = resnet(x, temb)
+                x = stage.temp_convs[i](x, num_frames)
+                if hasattr(stage, "attentions"):
+                    x = stage.attentions[i](x, ctx)
+                    x = stage.temp_attentions[i](x, num_frames)
+            if hasattr(stage, "upsamplers"):
+                x = F.interpolate(x, scale_factor=2.0, mode="nearest")
+                x = stage.upsamplers[0].conv(x)
+        return self.conv_out(F.silu(self.conv_norm_out(x)))
+
+
+def test_unet3d_torch_parity():
+    cfg = TINY_UNET3D
+    torch.manual_seed(110)
+    tref = UNet3DT(cfg).eval()
+    state = {k: v.numpy() for k, v in tref.state_dict().items()}
+    inferred = infer_unet3d_config(
+        state, {"attention_head_dim": cfg.attention_head_dim,
+                "norm_num_groups": cfg.norm_num_groups},
+    )
+    assert inferred == cfg
+    params = convert_unet3d(state)
+
+    frames = 4
+    rng = np.random.default_rng(111)
+    x = rng.standard_normal((frames, 16, 16, cfg.in_channels)).astype(
+        np.float32
+    )
+    t = np.full((frames,), 321.0, np.float32)
+    ctx = rng.standard_normal(
+        (frames, 7, cfg.cross_attention_dim)
+    ).astype(np.float32)
+    with torch.no_grad():
+        out_t = tref(
+            torch.from_numpy(x.transpose(0, 3, 1, 2)), torch.from_numpy(t),
+            torch.from_numpy(ctx), frames,
+        ).numpy().transpose(0, 2, 3, 1)
+    out_f = np.asarray(
+        UNet3DConditionModel(cfg).apply(
+            {"params": params}, jnp.asarray(x), jnp.asarray(t),
+            jnp.asarray(ctx), frames,
+        )
+    )
+    np.testing.assert_allclose(out_f, out_t, atol=3e-4, rtol=1e-3)
+
+
+def test_full_zeroscope_repo_check_and_pipeline(sdaas_root, tmp_path):
+    """A complete synthetic zeroscope repo (torch-mirror UNet3D +
+    transformers CLIP + torch-mirror VAE) passes `initialize --check` AND
+    serves a txt2vid job through VideoPipeline with converted weights."""
+    import json
+
+    from safetensors.numpy import save_file
+    from transformers import CLIPTextConfig as HFCLIPConfig
+    from transformers import CLIPTextModel
+
+    import jax
+    from torch_unet_ref import AutoencoderKLT
+
+    from chiaswarm_tpu.initialize import verify_local_model
+    from chiaswarm_tpu.models import configs as cfgs
+    from chiaswarm_tpu.pipelines.video import VideoPipeline
+    from chiaswarm_tpu.settings import Settings, save_settings
+
+    name = "cerspense/zeroscope_v2_576w"
+    root = tmp_path / "models"
+    save_settings(Settings(model_root_dir=str(root)))
+    repo = root / name
+    torch.manual_seed(120)
+
+    cfg = TINY_UNET3D
+    (repo / "unet").mkdir(parents=True)
+    save_file(
+        {k: v.numpy() for k, v in UNet3DT(cfg).state_dict().items()},
+        str(repo / "unet" / "diffusion_pytorch_model.safetensors"),
+    )
+    (repo / "unet" / "config.json").write_text(json.dumps({
+        "attention_head_dim": cfg.attention_head_dim,
+        "norm_num_groups": cfg.norm_num_groups,
+    }))
+
+    # the text hidden width IS the cross-attention width (real
+    # zeroscope: CLIP ViT-H 1024 == cross 1024)
+    hf_clip = HFCLIPConfig(
+        vocab_size=1000, hidden_size=TINY_UNET3D.cross_attention_dim,
+        num_hidden_layers=2, num_attention_heads=4, intermediate_size=64,
+        max_position_embeddings=77, hidden_act="gelu",
+        bos_token_id=0, eos_token_id=2,
+    )
+    (repo / "text_encoder").mkdir(parents=True)
+    save_file(
+        {k: v.numpy() for k, v in CLIPTextModel(hf_clip).state_dict().items()},
+        str(repo / "text_encoder" / "model.safetensors"),
+    )
+    (repo / "text_encoder" / "config.json").write_text(json.dumps({
+        "vocab_size": 1000,
+        "hidden_size": TINY_UNET3D.cross_attention_dim,
+        "num_hidden_layers": 2, "num_attention_heads": 4,
+        "max_position_embeddings": 77, "hidden_act": "gelu",
+    }))
+
+    (repo / "vae").mkdir(parents=True)
+    save_file(
+        {k: v.numpy()
+         for k, v in AutoencoderKLT(cfgs.TINY_VAE).state_dict().items()},
+        str(repo / "vae" / "diffusion_pytorch_model.safetensors"),
+    )
+    (repo / "vae" / "config.json").write_text(json.dumps({
+        "scaling_factor": cfgs.TINY_VAE.scaling_factor,
+    }))
+
+    report = verify_local_model(name, root)
+    assert report is not None
+    assert set(report) == {"unet3d", "text", "vae"}
+
+    pipe = VideoPipeline(name)
+    assert pipe.unet3d
+    frames, config = pipe.run(
+        prompt="a red fox running", num_frames=4, height=64, width=64,
+        num_inference_steps=2, rng=jax.random.key(3),
+    )
+    assert len(frames) == 4
+    assert frames[0].size == (64, 64)
