@@ -148,7 +148,63 @@ def verify_local_model(model_name: str, root: Path | None = None) -> dict | None
         return _verify_upernet_model(model_name, root)
     if any(k in name for k in ("zeroscope", "text-to-video", "damo")):
         return _verify_unet3d_model(model_name, root)
+    if "cascade" in name:
+        return _verify_cascade_model(model_name, root)
     return _verify_sd_model(model_name, root)
+
+
+def _verify_cascade_model(model_name: str, root: Path) -> dict:
+    """Stable Cascade repos (prior or decoder): convert through the SAME
+    loader the pipelines serve with (true StableCascadeUNet + Paella VQGAN
+    decode path, geometry inferred from the checkpoints)."""
+    import jax.numpy as jnp
+
+    from .models.cascade_unet import StableCascadeUNet
+    from .models.clip import CLIPTextEncoder
+    from .models.conversion import assert_tree_shapes_match
+    from .models.paella_vq import PaellaVQDecoder
+    from .pipelines.cascade import _load_converted_cascade
+
+    model_dir = root / model_name
+    if not model_dir.is_dir():
+        raise FileNotFoundError(f"no checkpoint directory {model_dir}")
+    conv = _load_converted_cascade(model_name, model_dir=model_dir)
+    if conv is None:
+        raise FileNotFoundError(f"no cascade checkpoint under {model_dir}")
+    cfg = conv["unet_cfg"]
+    hw = 8 * cfg.patch_size
+    kwargs = {}
+    if cfg.clip_text_in_channels:
+        kwargs["clip_text"] = jnp.zeros((1, 8, cfg.clip_text_in_channels))
+    if cfg.clip_image_in_channels:
+        kwargs["clip_img"] = jnp.zeros((1, 1, cfg.clip_image_in_channels))
+    if cfg.effnet_in_channels:
+        kwargs["effnet"] = jnp.zeros((1, 4, 4, cfg.effnet_in_channels))
+    expected = _eval_shape_params(
+        StableCascadeUNet(cfg),
+        jnp.zeros((1, hw, hw, cfg.in_channels)),
+        jnp.zeros((1,)),
+        jnp.zeros((1, 1, cfg.clip_text_pooled_in_channels)),
+        **kwargs,
+    )
+    assert_tree_shapes_match(conv["unet"], expected, prefix="unet")
+    text_exp = _eval_shape_params(
+        CLIPTextEncoder(conv["clip_cfg"]), jnp.zeros((1, 77), jnp.int32)
+    )
+    assert_tree_shapes_match(conv["text"], text_exp, prefix="text")
+    report = {
+        "unet": _param_count(conv["unet"]),
+        "text": _param_count(conv["text"]),
+    }
+    if "vqgan" in conv:
+        vq_cfg = conv["vqgan_cfg"]
+        vq_exp = _eval_shape_params(
+            PaellaVQDecoder(vq_cfg),
+            jnp.zeros((1, 8, 8, vq_cfg.latent_channels)),
+        )
+        assert_tree_shapes_match(conv["vqgan"], vq_exp, prefix="vqgan")
+        report["vqgan"] = _param_count(conv["vqgan"])
+    return report
 
 
 def _verify_unet3d_model(model_name: str, root: Path) -> dict:

@@ -24,6 +24,9 @@ class CLIPTextConfig:
     hidden_act: str = "gelu"  # gelu (SD2/XL) | quick_gelu (SD1.x ViT-L)
     # output selection: -1 = final layer norm output; -2 = penultimate layer
     hidden_state_index: int = -1
+    # False + index -1: the LAST layer's output BEFORE the final LayerNorm
+    # (HF `hidden_states[-1]` — Stable Cascade's prior/decoder conditioning)
+    apply_final_norm: bool = True
     projection_dim: int = 0  # >0: emit pooled projection (SDXL encoder 2)
 
 
@@ -81,10 +84,14 @@ class CLIPTextEncoder(nn.Module):
     dtype: jnp.dtype = jnp.float32
 
     @nn.compact
-    def __call__(self, input_ids, extra_embeddings=None):
+    def __call__(self, input_ids, extra_embeddings=None, attention_mask=None):
         """input_ids [B, 77] -> dict with:
         - hidden_states: [B, 77, D] conditioning sequence (per config index)
         - pooled: [B, D or projection_dim] EOS-token pooled output
+
+        `attention_mask` [B, S] (1 = attend) composes with the causal mask
+        — Stable Cascade's pipelines mask padding (most SD-family callers
+        don't pass one, matching diffusers).
 
         `extra_embeddings` [K, D] carries textual-inversion placeholder
         vectors: ids >= vocab_size index into it (id - vocab_size). Passed
@@ -115,20 +122,27 @@ class CLIPTextEncoder(nn.Module):
         hidden = tok + pos[None, :s, :]
 
         causal = jnp.triu(jnp.full((s, s), -1e9, self.dtype), k=1)[None, None]
+        if attention_mask is not None:
+            pad = jnp.where(
+                attention_mask[:, None, None, :].astype(bool), 0.0, -1e9
+            ).astype(self.dtype)
+            causal = causal + pad
 
         collected = []
         for i in range(cfg.num_layers):
             collected.append(hidden)
             hidden = CLIPLayer(cfg, dtype=self.dtype, name=f"layers_{i}")(hidden, causal)
+        pre_ln = hidden
         final = nn.LayerNorm(epsilon=1e-5, dtype=self.dtype, name="final_layer_norm")(
             hidden
         )
         collected.append(final)  # index -1
 
         # hidden_state_index -2 = input of the last layer (diffusers clip-skip)
-        out_hidden = final if cfg.hidden_state_index == -1 else collected[
-            cfg.hidden_state_index
-        ]
+        if cfg.hidden_state_index == -1:
+            out_hidden = final if cfg.apply_final_norm else pre_ln
+        else:
+            out_hidden = collected[cfg.hidden_state_index]
 
         # pooled = final-LN state at each sequence's first EOS. EOS is the
         # highest id in the BASE vocab (both tokenizers), but textual-

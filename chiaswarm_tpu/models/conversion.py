@@ -1530,3 +1530,249 @@ def infer_unet3d_config(state: dict, config_json: dict | None = None):
         cross_attention_dim=cross,
         norm_num_groups=int(cfg_json.get("norm_num_groups", 32)),
     )
+
+
+# --- Stable Cascade (Wuerstchen v3) family ---
+
+
+def cascade_unet_rename(name: str) -> str | None:
+    """diffusers StableCascadeUNet names -> models.cascade_unet names.
+
+    The switch-level UpDownBlock2d wraps its mapping conv in `.blocks.{m}`
+    (the interpolation sibling is parameterless), which collapses onto the
+    same flax module as the plain strided conv; diffusers' Attention
+    submodule flattens onto this package's single-module attn block."""
+    import re
+
+    name = re.sub(r"(down_downscalers\.\d+\.1)\.blocks\.\d+\.", r"\1.", name)
+    name = re.sub(r"(up_upscalers\.\d+\.1)\.blocks\.\d+\.", r"\1.", name)
+    name = name.replace(".attention.to_out.0.", ".attention_to_out_0.")
+    name = name.replace(".attention.to_", ".attention_to_")
+    name = name.replace(".kv_mapper.1.", ".kv_mapper_1.")
+    return name
+
+
+def infer_cascade_unet_config(state: dict, config_json: dict | None = None):
+    """CascadeUNetConfig from checkpoint shapes; config.json only supplies
+    what shapes cannot (patch size, head counts, clip_seq, conditioning
+    order) with released-config defaults."""
+    import re
+
+    from .cascade_unet import CascadeUNetConfig
+
+    cj = config_json or {}
+    levels = 1 + max(
+        int(m.group(1))
+        for k in state
+        for m in [re.match(r"down_blocks\.(\d+)\.", k)]
+        if m
+    )
+    block_out, down_layers, up_layers, attention = [], [], [], []
+    for i in range(levels):
+        res_idx = sorted(
+            int(m.group(1))
+            for k in state
+            for m in [
+                re.match(rf"down_blocks\.{i}\.(\d+)\.depthwise\.weight$", k)
+            ]
+            if m
+        )
+        block_out.append(
+            int(np.asarray(
+                state[f"down_blocks.{i}.{res_idx[0]}.depthwise.weight"]
+            ).shape[0])
+        )
+        down_layers.append(len(res_idx))
+        up_layers.append(
+            len([
+                k for k in state
+                if re.match(
+                    rf"up_blocks\.{levels - 1 - i}\.\d+\.depthwise\.weight$", k
+                )
+            ])
+        )
+        attention.append(
+            any(
+                re.match(rf"down_blocks\.{i}\.\d+\.attention\.to_q\.", k)
+                for k in state
+            )
+        )
+
+    def repeat_counts(prefix):
+        counts = []
+        for i in range(levels):
+            reps = {
+                int(m.group(1))
+                for k in state
+                for m in [re.match(rf"{prefix}\.{i}\.(\d+)\.weight$", k)]
+                if m
+            }
+            counts.append(len(reps) + 1)
+        return tuple(counts)
+
+    t_dim = None
+    for k in state:
+        if k.endswith(".mapper.weight"):
+            t_dim = int(np.asarray(state[k]).shape[1])
+            break
+    conds = tuple(
+        cj.get(
+            "timestep_conditioning_type",
+            [
+                c for c in ("sca", "crp")
+                if any(k.endswith(f".mapper_{c}.weight") for k in state)
+            ],
+        )
+    )
+    clip_seq = int(cj.get("clip_seq") or 4)
+    ctp_w = np.asarray(state["clip_txt_pooled_mapper.weight"])
+    conditioning_dim = ctp_w.shape[0] // clip_seq
+    patch = int(cj.get("patch_size") or 1)
+    emb_w = np.asarray(state["embedding.1.weight"])
+    heads_cj = cj.get("num_attention_heads")
+    if heads_cj is None:
+        heads = tuple(
+            (c // 64 if a else 0) for c, a in zip(block_out, attention)
+        )
+    elif isinstance(heads_cj, int):
+        heads = (heads_cj,) * levels
+    else:
+        heads = tuple(int(h or 0) for h in heads_cj)
+    self_attn = cj.get("self_attn", True)
+    if isinstance(self_attn, (list, tuple)):
+        self_attn = bool(self_attn[0])
+    switch = None
+    if any(
+        ".blocks." in k
+        for k in state
+        if k.startswith(("down_downscalers", "up_upscalers"))
+    ):
+        switch = tuple(cj.get("switch_level") or [False] * (levels - 1))
+    dw_key = next(k for k in state if k.endswith(".depthwise.weight"))
+    return CascadeUNetConfig(
+        in_channels=int(emb_w.shape[1] // patch**2),
+        out_channels=int(
+            np.asarray(state["clf.1.weight"]).shape[0] // patch**2
+        ),
+        patch_size=patch,
+        timestep_ratio_embedding_dim=t_dim or 64,
+        conditioning_dim=int(conditioning_dim),
+        block_out_channels=tuple(block_out),
+        num_attention_heads=heads,
+        down_num_layers_per_block=tuple(down_layers),
+        up_num_layers_per_block=tuple(reversed(up_layers)),
+        down_blocks_repeat_mappers=repeat_counts("down_repeat_mappers"),
+        up_blocks_repeat_mappers=repeat_counts("up_repeat_mappers"),
+        attention=tuple(attention),
+        clip_text_pooled_in_channels=int(ctp_w.shape[1]),
+        clip_text_in_channels=int(
+            np.asarray(state["clip_txt_mapper.weight"]).shape[1]
+        ) if "clip_txt_mapper.weight" in state else 0,
+        clip_image_in_channels=int(
+            np.asarray(state["clip_img_mapper.weight"]).shape[1]
+        ) if "clip_img_mapper.weight" in state else 0,
+        clip_seq=clip_seq,
+        effnet_in_channels=int(
+            np.asarray(state["effnet_mapper.0.weight"]).shape[1]
+        ) if "effnet_mapper.0.weight" in state else 0,
+        pixel_mapper_in_channels=int(
+            np.asarray(state["pixels_mapper.0.weight"]).shape[1]
+        ) if "pixels_mapper.0.weight" in state else 0,
+        kernel_size=int(np.asarray(state[dw_key]).shape[-1]),
+        self_attn=bool(self_attn),
+        timestep_conditioning_type=conds,
+        switch_level=switch,
+    )
+
+
+def _conv_transpose_kernel(w: np.ndarray) -> np.ndarray:
+    """torch ConvTranspose2d [in, out, kh, kw] -> the equivalent forward
+    (input-dilated) conv kernel [kh, kw, in, out] (spatially flipped)."""
+    return np.ascontiguousarray(np.flip(w, (2, 3)).transpose(2, 3, 0, 1))
+
+
+def convert_cascade_unet(state: dict, config_json: dict | None = None):
+    """diffusers StableCascadeUNet state dict -> (config, flax params)."""
+    cfg = infer_cascade_unet_config(state, config_json)
+    state = dict(state)
+    specials = []
+    if cfg.switch_level is None:
+        for j in range(len(cfg.block_out_channels) - 1):
+            wkey = f"up_upscalers.{j}.1.weight"
+            if wkey in state:
+                specials.append((
+                    [f"up_upscalers_{j}_1", "kernel"],
+                    _conv_transpose_kernel(np.asarray(state.pop(wkey))),
+                ))
+                specials.append((
+                    [f"up_upscalers_{j}_1", "bias"],
+                    np.asarray(state.pop(f"up_upscalers.{j}.1.bias")),
+                ))
+    params = convert_state_dict(state, rename=cascade_unet_rename)
+    for path, value in specials:
+        _assign(params, path, value)
+    return cfg, params
+
+
+def infer_paella_vq_config(state: dict, config_json: dict | None = None):
+    """PaellaVQConfig (decode path) from `up_blocks.*`/`out_block.*` keys."""
+    import re
+
+    from .paella_vq import PaellaVQConfig
+
+    cj = config_json or {}
+    in_w = np.asarray(state["up_blocks.0.0.weight"])
+    ct_idx = sorted(
+        int(m.group(1))
+        for k in state
+        for m in [re.match(r"up_blocks\.(\d+)\.weight$", k)]
+        if m
+    )
+    mix_idx = sorted(
+        int(m.group(1))
+        for k in state
+        for m in [re.match(r"up_blocks\.(\d+)\.gammas$", k)]
+        if m
+    )
+    first_ct = ct_idx[0] if ct_idx else 1 + (mix_idx[-1] if mix_idx else 0)
+    factor = int(cj.get("up_down_scale_factor") or 2)
+    return PaellaVQConfig(
+        out_channels=int(
+            np.asarray(state["out_block.0.weight"]).shape[0] // factor**2
+        ),
+        up_down_scale_factor=factor,
+        levels=len(ct_idx) + 1,
+        bottleneck_blocks=len([i for i in mix_idx if i < first_ct]),
+        embed_dim=int(in_w.shape[0]),
+        latent_channels=int(in_w.shape[1]),
+        scale_factor=float(cj.get("scale_factor") or 0.3764),
+    )
+
+
+def convert_paella_vq(state: dict, config_json: dict | None = None):
+    """PaellaVQModel state dict -> (config, decoder params). Encoder +
+    quantizer keys (in_block/down_blocks/vquantizer) are dropped — the
+    serving path only decodes (pipeline_steps.py:70-90 semantics)."""
+    import re
+
+    cfg = infer_paella_vq_config(state, config_json)
+    decode_state = {
+        k: v
+        for k, v in state.items()
+        if k.startswith(("up_blocks.", "out_block."))
+    }
+    specials = []
+    for k in list(decode_state):
+        m = re.match(r"up_blocks\.(\d+)\.(weight|bias)$", k)
+        if not m:
+            continue
+        idx, leaf = m.group(1), m.group(2)
+        v = np.asarray(decode_state.pop(k))
+        specials.append((
+            [f"up_blocks_{idx}", "kernel" if leaf == "weight" else "bias"],
+            _conv_transpose_kernel(v) if leaf == "weight" else v,
+        ))
+    params = convert_state_dict(decode_state)
+    for path, value in specials:
+        _assign(params, path, value)
+    return cfg, params

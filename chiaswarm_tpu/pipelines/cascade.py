@@ -1,5 +1,5 @@
-"""Stable Cascade (Wuerstchen v3) two-stage cascade: prior (stage C) ->
-latent decoder (stage B) -> pixel decode (stage A analog).
+"""Stable Cascade (Wuerstchen v3) serving: prior (stage C) -> latent
+decoder (stage B) -> Paella VQGAN pixel decode (stage A).
 
 Reference behavior replaced: swarm/diffusion/pipeline_steps.py:70-90 chains
 `StableCascadeDecoderPipeline.from_pretrained` after a prior main pipeline,
@@ -7,19 +7,21 @@ feeding `image_embeddings` with `num_inference_steps=10, guidance_scale=0`;
 the hive schedules the prior as the main pipeline and rides a `decoder`
 parameter dict (model_name / pipeline_type / variant).
 
-TPU redesign: both stages are resident jitted programs, mirroring the
-Kandinsky cascade in this package. Stage C denoises a ~42x-compressed
-16-channel spatial latent with a text-conditioned UNet under one `lax.scan`
-(CFG as a batch of 2); stage B denoises the 4x-compressed VQ latent space
-conditioned on the flattened stage-C latent as cross-attention tokens —
-guidance 0 per the reference, so the program is a single-row scan with no
-CFG doubling. Stage A is served by this package's AutoencoderKL at 4x
-(VQGAN-analog; real-weight conversion for this family is not wired yet, so
-non-test model names fail loudly per weights.py).
+TPU redesign: both stages are resident jitted programs built on the TRUE
+`StableCascadeUNet` architecture (models/cascade_unet.py) with weights
+converted from the diffusers checkpoints (models/conversion.py::
+convert_cascade_unet — geometry inferred from the state dict). Stage C
+denoises the 16-channel ~42.67x-compressed latent under one `lax.scan`
+with the ratio-space Wuerstchen scheduler and CFG as a batch of 2,
+conditioned on CLIP-bigG pre-LN hidden states + projected pooled embeds
+(attention-masked, diffusers parity); stage B denoises the 4-channel VQ
+latent conditioned on the stage-C latent through `effnet_mapper`, unguided
+per the reference default; stage A is the converted Paella VQGAN decoder.
 """
 
 from __future__ import annotations
 
+import json
 import logging
 import math
 import threading
@@ -31,11 +33,14 @@ import jax.numpy as jnp
 import numpy as np
 from PIL import Image
 
-from ..models import configs as cfgs
-from ..models.clip import CLIPTextEncoder
+from ..models.cascade_unet import (
+    TINY_CASCADE_B,
+    TINY_CASCADE_C,
+    StableCascadeUNet,
+)
+from ..models.clip import CLIPTextConfig, CLIPTextEncoder
+from ..models.paella_vq import TINY_PAELLA_VQ, PaellaVQDecoder
 from ..models.tokenizer import load_tokenizer
-from ..models.unet2d import UNet2DConditionModel, UNet2DConfig
-from ..models.vae import AutoencoderKL, VAEConfig
 from ..parallel.mesh import make_mesh, replicated
 from ..registry import register_family
 from ..schedulers import get_scheduler
@@ -43,69 +48,30 @@ from ..weights import is_test_model, require_weights_present
 
 logger = logging.getLogger(__name__)
 
-_NO_CONVERSION_HINT = (
-    "This worker cannot serve real Stable Cascade weights yet; only "
-    "test/tiny cascade models are available."
+_NO_WEIGHTS_HINT = (
+    "Download the Stable Cascade checkpoints (prior + decoder repos) with "
+    "`python -m chiaswarm_tpu.initialize --download` so they convert at load."
 )
 
-# stage-C latent channels (the "effnet" space both stages agree on)
-PRIOR_CHANNELS = 16
-
+# diffusers pipeline constants (StableCascadePrior/DecoderPipeline configs)
+PRIOR_COMPRESSION = 42.67  # resolution_multiple: 1024 -> 24
+LATENT_DIM_SCALE = 10.67  # stage-C grid -> stage-B latent grid (24 -> 256)
+PRIOR_CHANNELS = 16  # the "effnet" latent space both stages agree on
 
 _is_tiny = is_test_model
 
 
-# stage-C prior UNet (StableCascadeUNet stage-C analog: text-conditioned,
-# operates on the 16ch compressed latent; real geometry approximated)
-CASCADE_PRIOR_UNET = UNet2DConfig(
-    in_channels=PRIOR_CHANNELS,
-    out_channels=PRIOR_CHANNELS,
-    block_out_channels=(1024, 1536),
-    transformer_layers=(4, 4),
-    mid_transformer_layers=4,
-    layers_per_block=2,
-    num_attention_heads=(16, 24),
-    cross_attention_dim=1280,
+# stage-C conditioning tower for tiny jobs (matches TINY_CASCADE_C's
+# text/pooled widths); real jobs read geometry from the checkpoint
+_TINY_CASCADE_CLIP = CLIPTextConfig(
+    vocab_size=1000,
+    hidden_size=16,
+    num_layers=2,
+    num_heads=4,
+    max_positions=77,
+    projection_dim=16,
+    apply_final_norm=False,
 )
-TINY_PRIOR_UNET = UNet2DConfig(
-    in_channels=PRIOR_CHANNELS,
-    out_channels=PRIOR_CHANNELS,
-    block_out_channels=(32, 64),
-    transformer_layers=(1, 1),
-    mid_transformer_layers=1,
-    layers_per_block=1,
-    num_attention_heads=4,
-    cross_attention_dim=32,
-)
-
-# stage-B decoder UNet: denoises the 4ch VQ latent, cross-attends on the
-# flattened stage-C latent tokens
-CASCADE_DECODER_UNET = UNet2DConfig(
-    block_out_channels=(320, 640, 1280),
-    transformer_layers=(0, 2, 4),
-    mid_transformer_layers=4,
-    num_attention_heads=(5, 10, 20),
-    cross_attention_dim=1280,
-)
-# stage-A analog: 4x pixel decode (VQGAN compression factor)
-CASCADE_VQ_VAE = VAEConfig(block_out_channels=(128, 256, 512))
-TINY_VQ_VAE = VAEConfig(block_out_channels=(32, 32), layers_per_block=1)
-
-
-def _prior_configs(model_name: str):
-    """(unet_cfg, clip_cfg, compression, default_size)."""
-    if _is_tiny(model_name):
-        return TINY_PRIOR_UNET, cfgs.TINY_CLIP_2, 8, 64
-    # Stable Cascade conditions on the OpenCLIP ViT-bigG text tower; the
-    # stage-C latent is ~42.67x compressed (1024^2 -> 24x24, factor 1024/24)
-    return CASCADE_PRIOR_UNET, cfgs.SDXL_CLIP_2, 1024 / 24, 1024
-
-
-def _decoder_configs(model_name: str):
-    """(unet_cfg, vae_cfg, default_size)."""
-    if _is_tiny(model_name):
-        return cfgs.TINY_UNET, TINY_VQ_VAE, 64
-    return CASCADE_DECODER_UNET, CASCADE_VQ_VAE, 1024
 
 
 def _decoder_name_for(prior_name: str) -> str:
@@ -122,54 +88,177 @@ def _prior_name_for(decoder_name: str) -> str:
     return decoder_name + "-prior"
 
 
+def _clip_cfg_from_json(tj: dict) -> CLIPTextConfig:
+    """CLIPTextModelWithProjection geometry (laion bigG for the released
+    checkpoints) with Stable Cascade's pre-LN conditioning semantics."""
+    return CLIPTextConfig(
+        vocab_size=int(tj.get("vocab_size", 49408)),
+        hidden_size=int(tj.get("hidden_size", 1280)),
+        num_layers=int(tj.get("num_hidden_layers", 32)),
+        num_heads=int(tj.get("num_attention_heads", 20)),
+        max_positions=int(tj.get("max_position_embeddings", 77)),
+        hidden_act=str(tj.get("hidden_act", "gelu")),
+        projection_dim=int(tj.get("projection_dim", 1280)),
+        apply_final_norm=False,
+    )
+
+
+def _load_converted_cascade(model_name: str, model_dir=None,
+                            stage: str | None = None):
+    """-> {"unet_cfg","unet","text","clip_cfg"[,"vqgan_cfg","vqgan"]} or
+    None (not downloaded). Prior repos carry a `prior/` subfolder, decoder
+    repos `decoder/` + `vqgan/`; both carry `text_encoder/`. `stage`
+    ("prior"/"decoder") pins which repo kind the caller can serve — a
+    pipeline pointed at the WRONG stage's repo must fail diagnosably, not
+    load the other stage's UNet."""
+    if _is_tiny(model_name):
+        return None
+    if model_dir is None:
+        from ..weights import model_dir_for
+
+        model_dir = model_dir_for(model_name)
+    if model_dir is None:
+        return None
+    from ..models.conversion import (
+        convert_cascade_unet,
+        convert_clip,
+        convert_paella_vq,
+        load_torch_state_dict,
+    )
+    from ..weights import MissingWeightsError
+
+    def read_json(sub):
+        p = model_dir / sub / "config.json"
+        return json.loads(p.read_text()) if p.is_file() else {}
+
+    stage_sub = "prior" if (model_dir / "prior").is_dir() else "decoder"
+    if stage is not None and stage != stage_sub:
+        if (model_dir / stage_sub).is_dir():
+            raise MissingWeightsError(
+                f"'{model_name}' is a Stable Cascade {stage_sub} repo but "
+                f"this pipeline serves the {stage} stage — point the job at "
+                f"the matching repo (prior jobs chain the decoder via the "
+                f"`decoder` parameter)."
+            )
+        return None  # neither subfolder present: not downloaded
+    try:
+        unet_cfg, unet = convert_cascade_unet(
+            load_torch_state_dict(model_dir, stage_sub), read_json(stage_sub)
+        )
+        out = {
+            "unet_cfg": unet_cfg,
+            "unet": unet,
+            "clip_cfg": _clip_cfg_from_json(read_json("text_encoder")),
+            "text": convert_clip(load_torch_state_dict(model_dir, "text_encoder")),
+            "model_dir": model_dir,
+        }
+        if stage_sub == "decoder":
+            vq_cfg, vq = convert_paella_vq(
+                load_torch_state_dict(model_dir, "vqgan"), read_json("vqgan")
+            )
+            out["vqgan_cfg"] = vq_cfg
+            out["vqgan"] = vq
+        return out
+    except (FileNotFoundError, OSError):
+        return None
+    except Exception as e:
+        raise MissingWeightsError(
+            f"checkpoint under {model_dir} could not be converted for "
+            f"'{model_name}': {e}"
+        ) from e
+
+
+def _attention_mask(ids: np.ndarray, eos_id: int) -> np.ndarray:
+    """1 through the first EOS, 0 for the EOS-padding tail (the tokenizer
+    pads with EOS; diffusers' cascade pipelines mask padding)."""
+    first_eos = np.argmax(ids == eos_id, axis=-1)
+    pos = np.arange(ids.shape[1])[None, :]
+    return (pos <= first_eos[:, None]).astype(np.int32)
+
+
+def _encode_text(tokenizer, clip_cfg, text_encoder, text_params,
+                 texts: list[str]):
+    """Shared masked CLIP encode for both cascade stages -> (hiddens
+    zeroed past EOS, pooled-projected [B, 1, D])."""
+    ids = np.asarray(tokenizer(texts))
+    mask = _attention_mask(ids, clip_cfg.vocab_size - 1)
+    out = text_encoder.apply(
+        {"params": text_params},
+        jnp.asarray(ids),
+        attention_mask=jnp.asarray(mask),
+    )
+    # keep padding from injecting garbage keys: the UNet cross-attends
+    # every token, so zero the masked positions like diffusers' masked
+    # encode leaves them attended-nowhere
+    hiddens = out["hidden_states"] * jnp.asarray(mask)[:, :, None].astype(
+        out["hidden_states"].dtype
+    )
+    return hiddens, out["pooled"][:, None, :]
+
+
 class CascadePriorPipeline:
     """Resident stage-C prior; produces `image_embeddings` (the compressed
-    spatial latent). Unlike the Kandinsky prior, the hive schedules THIS as
-    the main pipeline (reference diffusion_func.py:151-161 takes
-    `.image_embeddings` from the main pipeline output), so `run()` chains
-    into the decoder named by the job's `decoder` parameter.
-    """
+    spatial latent). The hive schedules THIS as the main pipeline
+    (reference diffusion_func.py:151-161 takes `.image_embeddings` from the
+    main pipeline output), so `run()` chains into the decoder named by the
+    job's `decoder` parameter."""
 
     def __init__(self, model_name: str, chipset=None,
                  allow_random_init: bool = False):
-        require_weights_present(
-            model_name, None, allow_random_init, component="Cascade prior",
-            hint=_NO_CONVERSION_HINT,
-        )
         self.model_name = model_name
         self.chipset = chipset
-        self.config, clip_cfg, self.compression, self.default_size = (
-            _prior_configs(model_name)
-        )
+        conv = _load_converted_cascade(model_name, stage="prior")
+        if conv is None:
+            require_weights_present(
+                model_name, None, allow_random_init,
+                component="Cascade prior", hint=_NO_WEIGHTS_HINT,
+            )
+            self.config = TINY_CASCADE_C
+            clip_cfg = _TINY_CASCADE_CLIP
+            self.compression = 8.0
+            self.default_size = 64
+        else:
+            self.config = conv["unet_cfg"]
+            clip_cfg = conv["clip_cfg"]
+            self.compression = PRIOR_COMPRESSION
+            self.default_size = 1024
         on_tpu = jax.default_backend() == "tpu"
         self.dtype = jnp.bfloat16 if on_tpu else jnp.float32
-        self.unet = UNet2DConditionModel(self.config, dtype=self.dtype)
+        self.clip_cfg = clip_cfg
+        self.unet = StableCascadeUNet(self.config, dtype=self.dtype)
         self.text_encoder = CLIPTextEncoder(clip_cfg, dtype=self.dtype)
-        self.tokenizer = load_tokenizer(None, vocab_size=clip_cfg.vocab_size)
+        self.tokenizer = load_tokenizer(
+            conv and conv.get("model_dir"), vocab_size=clip_cfg.vocab_size
+        )
         self.mesh = (
             chipset.mesh() if chipset is not None else make_mesh(jax.devices()[:1])
         )
 
-        rng = jax.random.key(zlib.crc32(model_name.encode()))
-        k1, k2 = jax.random.split(rng)
-        n_down = len(self.config.block_out_channels) - 1
-        hw = 2 ** max(n_down, 2)
-        with jax.default_device(jax.local_devices(backend="cpu")[0]):
-            unet_params = self.unet.init(
-                k1,
-                jnp.zeros((1, hw, hw, PRIOR_CHANNELS)),
-                jnp.zeros((1,)),
-                jnp.zeros((1, 77, self.config.cross_attention_dim)),
-            )["params"]
-            text_params = self.text_encoder.init(
-                k2, jnp.zeros((1, 77), jnp.int32)
-            )["params"]
+        if conv is None:
+            rng = jax.random.key(zlib.crc32(model_name.encode()))
+            k1, k2 = jax.random.split(rng)
+            with jax.default_device(jax.local_devices(backend="cpu")[0]):
+                unet_params = self.unet.init(
+                    k1,
+                    jnp.zeros((1, 8, 8, self.config.in_channels)),
+                    jnp.zeros((1,)),
+                    jnp.zeros((1, 1, self.config.clip_text_pooled_in_channels)),
+                    clip_text=jnp.zeros(
+                        (1, 77, self.config.clip_text_in_channels)
+                    ),
+                    clip_img=jnp.zeros(
+                        (1, 1, self.config.clip_image_in_channels)
+                    ),
+                )["params"]
+                text_params = self.text_encoder.init(
+                    k2, jnp.zeros((1, 77), jnp.int32)
+                )["params"]
+            tree = {"unet": unet_params, "text": text_params}
+        else:
+            tree = {"unet": conv["unet"], "text": conv["text"]}
         cast = lambda x: jnp.asarray(x, self.dtype)
         self.params = jax.device_put(
-            jax.tree_util.tree_map(
-                cast, {"unet": unet_params, "text": text_params}
-            ),
-            replicated(self.mesh),
+            jax.tree_util.tree_map(cast, tree), replicated(self.mesh)
         )
         self._programs: dict[tuple, callable] = {}
         self._lock = threading.Lock()
@@ -183,40 +272,45 @@ class CascadePriorPipeline:
             if key in self._programs:
                 return self._programs[key]
         ch, cw, batch, steps = key
-        scheduler = get_scheduler("DDPMScheduler")
+        scheduler = get_scheduler("DDPMWuerstchenScheduler")
         schedule = scheduler.schedule(steps)
         unet = self.unet
+        cfg = self.config
 
-        def run(params, rng, text_hiddens, guidance):
-            """text_hiddens rows are [uncond | cond] stacked (CFG 2N)."""
+        def run(params, rng, text_hiddens, text_pooled, guidance):
+            """text rows are [uncond | cond] stacked (CFG 2N)."""
             latents = jax.random.normal(
-                rng, (batch, ch, cw, PRIOR_CHANNELS), jnp.float32
-            ) * jnp.asarray(schedule.init_noise_sigma, jnp.float32)
-            state = scheduler.init_state(latents.shape, latents.dtype)
+                rng, (batch, ch, cw, cfg.in_channels), jnp.float32
+            )
+            ratios = jnp.asarray(schedule.timesteps)
+            clip_img = jnp.zeros(
+                (2 * batch, 1, cfg.clip_image_in_channels), self.dtype
+            )
 
             def body(carry, i):
-                latents, state = carry
-                inp = scheduler.scale_model_input(schedule, latents, i)
-                model_in = jnp.concatenate([inp, inp], axis=0).astype(self.dtype)
-                t = jnp.asarray(schedule.timesteps)[i]
+                latents, _ = carry
+                model_in = jnp.concatenate([latents, latents], axis=0)
+                r = jnp.broadcast_to(ratios[i], (2 * batch,))
                 pred = unet.apply(
                     {"params": params["unet"]},
-                    model_in,
-                    jnp.broadcast_to(t, (2 * batch,)),
-                    text_hiddens,
+                    model_in.astype(self.dtype),
+                    r,
+                    text_pooled,
+                    clip_text=text_hiddens,
+                    clip_img=clip_img,
                 ).astype(jnp.float32)
                 pred_u, pred_c = jnp.split(pred, 2, axis=0)
                 pred = pred_u + guidance * (pred_c - pred_u)
                 noise = jax.random.normal(
                     jax.random.fold_in(rng, i), latents.shape, jnp.float32
                 )
-                state, latents = scheduler.step(
-                    schedule, state, i, latents, pred, noise
+                _, latents = scheduler.step(
+                    schedule, (), i, latents, pred, noise
                 )
-                return (latents, state), ()
+                return (latents, ()), ()
 
             (latents, _), _ = jax.lax.scan(
-                body, (latents, state), jnp.arange(steps)
+                body, (latents, ()), jnp.arange(steps)
             )
             return latents
 
@@ -240,10 +334,12 @@ class CascadePriorPipeline:
         ch = max(4, math.ceil(height / self.compression))
         cw = max(4, math.ceil(width / self.compression))
         texts = [negative_prompt] * num_images + [prompt] * num_images
-        ids = jnp.asarray(self.tokenizer(texts))
-        out = self.text_encoder.apply({"params": params["text"]}, ids)
+        hiddens, pooled = _encode_text(
+            self.tokenizer, self.clip_cfg, self.text_encoder, params["text"],
+            texts,
+        )
         return self._program((ch, cw, num_images, steps))(
-            params, rng, out["hidden_states"], jnp.float32(guidance_scale)
+            params, rng, hiddens, pooled, jnp.float32(guidance_scale)
         )
 
     def run(self, prompt="", negative_prompt="",
@@ -298,6 +394,7 @@ class CascadePriorPipeline:
         )
         timings["prior_s"] = round(time.perf_counter() - t0, 3)
         images, pipeline_config = decoder_pipe.run(
+            prompt=prompt,
             image_embeddings=embeds,
             num_inference_steps=int(decoder.get("num_inference_steps", 10)),
             height=height,
@@ -321,64 +418,69 @@ class CascadePipeline:
 
     def __init__(self, model_name: str, chipset=None,
                  allow_random_init: bool = False):
-        require_weights_present(
-            model_name, None, allow_random_init, component="Cascade decoder",
-            hint=_NO_CONVERSION_HINT,
-        )
         self.model_name = model_name
         self.chipset = chipset
-        unet_cfg, vae_cfg, self.default_size = _decoder_configs(model_name)
+        conv = _load_converted_cascade(model_name, stage="decoder")
+        if conv is None:
+            require_weights_present(
+                model_name, None, allow_random_init,
+                component="Cascade decoder", hint=_NO_WEIGHTS_HINT,
+            )
+            self.config = TINY_CASCADE_B
+            self.vq_cfg = TINY_PAELLA_VQ
+            clip_cfg = _TINY_CASCADE_CLIP
+            self.default_size = 64
+            self.latent_dim_scale = 2.0
+        else:
+            self.config = conv["unet_cfg"]
+            self.vq_cfg = conv["vqgan_cfg"]
+            clip_cfg = conv["clip_cfg"]
+            self.default_size = 1024
+            self.latent_dim_scale = LATENT_DIM_SCALE
         on_tpu = jax.default_backend() == "tpu"
         self.dtype = jnp.bfloat16 if on_tpu else jnp.float32
-        self.unet = UNet2DConditionModel(unet_cfg, dtype=self.dtype)
-        self.vae = AutoencoderKL(vae_cfg, dtype=self.dtype)
-        self.latent_factor = 2 ** (len(vae_cfg.block_out_channels) - 1)
+        self.clip_cfg = clip_cfg
+        self.unet = StableCascadeUNet(self.config, dtype=self.dtype)
+        self.vqgan = PaellaVQDecoder(self.vq_cfg, dtype=self.dtype)
+        self.text_encoder = CLIPTextEncoder(clip_cfg, dtype=self.dtype)
+        self.tokenizer = load_tokenizer(
+            conv and conv.get("model_dir"), vocab_size=clip_cfg.vocab_size
+        )
         self.mesh = (
             chipset.mesh() if chipset is not None else make_mesh(jax.devices()[:1])
         )
 
-        seed = zlib.crc32(model_name.encode())
-        k1, k2, k3 = jax.random.split(jax.random.key(seed), 3)
-        n_down = len(unet_cfg.block_out_channels) - 1
-        hw = 2 ** max(n_down, 2)
-        cross = unet_cfg.cross_attention_dim
-        dtype = self.dtype
-        import flax.linen as nn
-
-        # flattened stage-C latents -> cross-attention tokens
-        class EffnetProj(nn.Module):
-            @nn.compact
-            def __call__(self, e):
-                b, ch, cw, c = e.shape
-                return nn.Dense(cross, dtype=dtype, name="proj")(
-                    e.reshape(b, ch * cw, c)
-                )
-
-        self.effnet_proj = EffnetProj()
-        with jax.default_device(jax.local_devices(backend="cpu")[0]):
-            unet_params = self.unet.init(
-                k1,
-                jnp.zeros((1, hw, hw, unet_cfg.in_channels)),
-                jnp.zeros((1,)),
-                jnp.zeros((1, 16, cross)),
-            )["params"]
-            vae_params = self.vae.init(
-                k2,
-                jnp.zeros(
-                    (1, hw * self.latent_factor, hw * self.latent_factor, 3)
-                ),
-            )["params"]
-            proj_params = self.effnet_proj.init(
-                k3, jnp.zeros((1, 4, 4, PRIOR_CHANNELS))
-            )["params"]
+        if conv is None:
+            seed = zlib.crc32(model_name.encode())
+            k1, k2, k3 = jax.random.split(jax.random.key(seed), 3)
+            with jax.default_device(jax.local_devices(backend="cpu")[0]):
+                unet_params = self.unet.init(
+                    k1,
+                    jnp.zeros((1, 8, 8, self.config.in_channels)),
+                    jnp.zeros((1,)),
+                    jnp.zeros((1, 1, self.config.clip_text_pooled_in_channels)),
+                    effnet=jnp.zeros(
+                        (1, 4, 4, self.config.effnet_in_channels)
+                    ),
+                )["params"]
+                vq_params = self.vqgan.init(
+                    k2, jnp.zeros((1, 4, 4, self.vq_cfg.latent_channels))
+                )["params"]
+                text_params = self.text_encoder.init(
+                    k3, jnp.zeros((1, 77), jnp.int32)
+                )["params"]
+            tree = {
+                "unet": unet_params, "vqgan": vq_params, "text": text_params,
+            }
+        else:
+            tree = {
+                "unet": conv["unet"],
+                "vqgan": conv["vqgan"],
+                "text": conv["text"],
+            }
         cast = lambda x: jnp.asarray(x, self.dtype)
         self.params = jax.device_put(
-            jax.tree_util.tree_map(cast, {
-                "unet": unet_params,
-                "vae": vae_params,
-                "proj": proj_params,
-            }),
-            replicated(self.mesh),
+            jax.tree_util.tree_map(cast, tree), replicated(self.mesh)
         )
         self._programs: dict[tuple, callable] = {}
         self._lock = threading.Lock()
@@ -391,51 +493,50 @@ class CascadePipeline:
         with self._lock:
             if key in self._programs:
                 return self._programs[key]
-        lh, lw, batch, steps, ch, cw = key
-        scheduler = get_scheduler("DDPMScheduler")
+        lh, lw, batch, steps, eh, ew = key
+        scheduler = get_scheduler("DDPMWuerstchenScheduler")
         schedule = scheduler.schedule(steps)
         unet = self.unet
-        vae = self.vae
-        proj = self.effnet_proj
-        latent_c = unet.config.in_channels
+        vqgan = self.vqgan
+        cfg = self.config
+        scale_factor = self.vq_cfg.scale_factor
 
-        def run(params, rng, embeds):
+        def run(params, rng, embeds, pooled):
             """Unguided (reference decoder stage runs guidance_scale=0)."""
-            context = proj.apply(
-                {"params": params["proj"]}, embeds.astype(self.dtype)
-            )
             latents = jax.random.normal(
-                rng, (batch, lh, lw, latent_c), jnp.float32
-            ) * jnp.asarray(schedule.init_noise_sigma, jnp.float32)
-            state = scheduler.init_state(latents.shape, latents.dtype)
+                rng, (batch, lh, lw, cfg.in_channels), jnp.float32
+            )
+            ratios = jnp.asarray(schedule.timesteps)
+            effnet = embeds.astype(self.dtype)
 
             def body(carry, i):
-                latents, state = carry
-                inp = scheduler.scale_model_input(schedule, latents, i)
-                t = jnp.asarray(schedule.timesteps)[i]
+                latents, _ = carry
+                r = jnp.broadcast_to(ratios[i], (batch,))
                 pred = unet.apply(
                     {"params": params["unet"]},
-                    inp.astype(self.dtype),
-                    jnp.broadcast_to(t, (batch,)),
-                    context,
+                    latents.astype(self.dtype),
+                    r,
+                    pooled,
+                    effnet=effnet,
                 ).astype(jnp.float32)
                 noise = jax.random.normal(
                     jax.random.fold_in(rng, i), latents.shape, jnp.float32
                 )
-                state, latents = scheduler.step(
-                    schedule, state, i, latents, pred, noise
+                _, latents = scheduler.step(
+                    schedule, (), i, latents, pred, noise
                 )
-                return (latents, state), ()
+                return (latents, ()), ()
 
             (latents, _), _ = jax.lax.scan(
-                body, (latents, state), jnp.arange(steps)
+                body, (latents, ()), jnp.arange(steps)
             )
-            pixels = vae.apply(
-                {"params": params["vae"]}, latents.astype(self.dtype),
-                method=vae.decode,
+            pixels = vqgan.apply(
+                {"params": params["vqgan"]},
+                (latents * scale_factor).astype(self.dtype),
             )
+            # Paella decodes to [0, 1] (diffusers clamps there, not [-1, 1])
             return (
-                (pixels.astype(jnp.float32) + 1.0) * 127.5
+                pixels.astype(jnp.float32) * 255.0
             ).clip(0.0, 255.0).round().astype(jnp.uint8)
 
         program = jax.jit(run)
@@ -467,7 +568,6 @@ class CascadePipeline:
         height = int(kwargs.pop("height", None) or self.default_size)
         width = int(kwargs.pop("width", None) or self.default_size)
         height, width = (max(64, (d // 64) * 64) for d in (height, width))
-        lh, lw = height // self.latent_factor, width // self.latent_factor
 
         embeds = kwargs.pop("image_embeddings", None)
         rng, prior_rng, dec_rng = jax.random.split(rng, 3)
@@ -497,21 +597,37 @@ class CascadePipeline:
             timings["prior_s"] = round(time.perf_counter() - t0, 3)
         embeds = jnp.asarray(embeds)
         n_images = int(embeds.shape[0])
+        eh, ew = int(embeds.shape[1]), int(embeds.shape[2])
 
-        key = (lh, lw, n_images, steps, embeds.shape[1], embeds.shape[2])
+        # stage-B latent grid follows the stage-C grid (diffusers
+        # latent_dim_scale, truncating int like the reference pipeline:
+        # 24 -> int(24*10.67) = 256), NOT the pixel size directly; odd
+        # grids survive via the up-path bilinear skip alignment
+        lh = 2 * (int(eh * self.latent_dim_scale) // 2)
+        lw = 2 * (int(ew * self.latent_dim_scale) // 2)
+
+        # pooled text conditioning (decoder uses pooled only)
+        _, pooled = _encode_text(
+            self.tokenizer, self.clip_cfg, self.text_encoder, params["text"],
+            [prompt] * n_images,
+        )
+
+        key = (lh, lw, n_images, steps, eh, ew)
         program = self._program(key)
         t0 = time.perf_counter()
-        pixels = jax.block_until_ready(program(params, dec_rng, embeds))
+        pixels = jax.block_until_ready(
+            program(params, dec_rng, embeds, pooled)
+        )
         timings["denoise_decode_s"] = round(time.perf_counter() - t0, 3)
 
         images = [Image.fromarray(img) for img in np.asarray(pixels)]
         pipeline_config = {
             "model": self.model_name,
             "pipeline": pipeline_type,
-            "scheduler": "DDPMScheduler",
+            "scheduler": "DDPMWuerstchenScheduler",
             "mode": "txt2img",
             "steps": steps,
-            "size": [width, height],
+            "size": [images[0].width, images[0].height] if images else [0, 0],
             "timings": timings,
         }
         return images, pipeline_config

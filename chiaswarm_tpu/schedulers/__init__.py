@@ -14,6 +14,7 @@ Wire names accepted (reference hive schema, SURVEY §2.7) map via
 
 from .common import Schedule, SchedulerConfig
 from .solvers import (
+    DDPMWuerstchenScheduler,
     HeunDiscreteScheduler,
     UniPCMultistepScheduler,
     DDIMScheduler,
@@ -36,6 +37,7 @@ SCHEDULERS = {
     "EulerAncestralDiscreteScheduler": EulerAncestralDiscreteScheduler,
     "DDIMScheduler": DDIMScheduler,
     "DDPMScheduler": DDPMScheduler,
+    "DDPMWuerstchenScheduler": DDPMWuerstchenScheduler,
     "PNDMScheduler": DDIMScheduler,
     "LMSDiscreteScheduler": EulerDiscreteScheduler,
     "HeunDiscreteScheduler": HeunDiscreteScheduler,
@@ -60,6 +62,7 @@ __all__ = [
     "SCHEDULERS",
     "DDIMScheduler",
     "DDPMScheduler",
+    "DDPMWuerstchenScheduler",
     "DPMSolverMultistepScheduler",
     "EulerAncestralDiscreteScheduler",
     "EulerDiscreteScheduler",

@@ -442,3 +442,44 @@ class FlowMatchEulerScheduler(BaseScheduler):
         # rectified flow: x_s = (1-s)*x0 + s*eps
         s = jnp.asarray(schedule.sigmas)[i]
         return (1.0 - s) * x0 + s * noise
+
+
+class DDPMWuerstchenScheduler(BaseScheduler):
+    """Stable Cascade's ratio-space DDPM (diffusers DDPMWuerstchenScheduler):
+    timesteps are RATIOS in [0, 1] fed to the UNet directly (not indices
+    into a trained grid), alpha-bar is the squared-cosine schedule on the
+    ratio, and the ancestral step mirrors DDPM in that space. Used by both
+    cascade stages (prior guided, decoder unguided)."""
+
+    uses_ancestral_noise = True
+    s = 0.008
+
+    def schedule(self, num_steps: int) -> Schedule:
+        ratios = np.linspace(1.0, 0.0, num_steps + 1).astype(np.float32)
+        # timesteps double as the model input (length n per the Schedule
+        # contract); sigmas carry the n+1 ratio boundaries for step()
+        return Schedule(ratios[:-1], ratios, 1.0, num_steps)
+
+    def _abar(self, t):
+        import math
+
+        t = jnp.asarray(t, jnp.float32)
+        norm = math.cos(self.s / (1 + self.s) * math.pi * 0.5) ** 2
+        abar = jnp.cos((t + self.s) / (1 + self.s) * jnp.pi * 0.5) ** 2 / norm
+        return jnp.clip(abar, 0.0001, 0.9999)
+
+    def step(self, schedule, state, i, sample, model_output, noise):
+        ts = jnp.asarray(schedule.sigmas)  # the n+1 ratio boundaries
+        t, prev_t = ts[i], ts[i + 1]
+        abar = self._abar(t)
+        abar_prev = self._abar(prev_t)
+        alpha = abar / abar_prev
+        mu = (1.0 / jnp.sqrt(alpha)) * (
+            sample - (1.0 - alpha) * model_output / jnp.sqrt(1.0 - abar)
+        )
+        std = jnp.sqrt((1.0 - alpha) * (1.0 - abar_prev) / (1.0 - abar))
+        return state, mu + std * noise * jnp.where(prev_t > 0, 1.0, 0.0)
+
+    def add_noise(self, schedule, x0, noise, i):
+        abar = self._abar(jnp.asarray(schedule.timesteps)[i])
+        return jnp.sqrt(abar) * x0 + jnp.sqrt(1.0 - abar) * noise

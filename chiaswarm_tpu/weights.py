@@ -82,5 +82,5 @@ def model_dir_for(model_name: str):
 UNCONVERTED_FAMILY_KEYWORDS = (
     "audioldm2",
     "i2vgen", "stable-video", "kandinsky-3", "kandinsky3",
-    "cascade", "latent-upscaler",
+    "latent-upscaler",
 )

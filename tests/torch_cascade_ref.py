@@ -1,0 +1,546 @@
+"""Exact-key torch mirror of the diffusers Stable Cascade graphs
+(StableCascadeUNet + PaellaVQModel decode path), used to prove the flax
+modules + conversion numerically (the same in-repo-reference strategy as
+torch_unet_ref.py; diffusers itself is not available in this image).
+
+State-dict keys match diffusers exactly so `convert_cascade_unet` /
+`convert_paella_vq` exercise the real layouts: flattened per-level block
+lists (`down_blocks.{level}.{idx}.*`), `.blocks.{m}` switch-level
+scalers, biased attention projections under `attention.to_*`, ConvTranspose
+up-scalers, and the Paella `depthwise.1` replication-padded convs.
+"""
+
+import math
+
+import torch
+import torch.nn as nn
+import torch.nn.functional as F
+
+
+class LayerNorm2dT(nn.LayerNorm):
+    """SDCascadeLayerNorm: channel-last LN applied to NCHW maps."""
+
+    def forward(self, x):
+        x = x.permute(0, 2, 3, 1)
+        x = super().forward(x)
+        return x.permute(0, 3, 1, 2)
+
+
+class GlobalResponseNormT(nn.Module):
+    def __init__(self, dim):
+        super().__init__()
+        self.gamma = nn.Parameter(torch.zeros(1, 1, 1, dim))
+        self.beta = nn.Parameter(torch.zeros(1, 1, 1, dim))
+
+    def forward(self, x):  # NHWC
+        agg = torch.norm(x, p=2, dim=(1, 2), keepdim=True)
+        stand = agg / (agg.mean(dim=-1, keepdim=True) + 1e-6)
+        return self.gamma * (x * stand) + self.beta + x
+
+
+class ResBlockT(nn.Module):
+    def __init__(self, c, c_skip=0, kernel_size=3):
+        super().__init__()
+        self.depthwise = nn.Conv2d(
+            c, c, kernel_size=kernel_size, padding=kernel_size // 2, groups=c
+        )
+        self.norm = LayerNorm2dT(c, elementwise_affine=False, eps=1e-6)
+        self.channelwise = nn.Sequential(
+            nn.Linear(c + c_skip, c * 4),
+            nn.GELU(),
+            GlobalResponseNormT(c * 4),
+            nn.Dropout(0.0),
+            nn.Linear(c * 4, c),
+        )
+
+    def forward(self, x, x_skip=None):
+        res = x
+        x = self.norm(self.depthwise(x))
+        if x_skip is not None:
+            x = torch.cat([x, x_skip], dim=1)
+        x = self.channelwise(x.permute(0, 2, 3, 1)).permute(0, 3, 1, 2)
+        return x + res
+
+
+class TimestepBlockT(nn.Module):
+    def __init__(self, c, c_timestep, conds=()):
+        super().__init__()
+        self.mapper = nn.Linear(c_timestep, c * 2)
+        self.conds = conds
+        for cname in conds:
+            setattr(self, f"mapper_{cname}", nn.Linear(c_timestep, c * 2))
+
+    def forward(self, x, t):
+        t = t.chunk(len(self.conds) + 1, dim=1)
+        a, b = self.mapper(t[0])[:, :, None, None].chunk(2, dim=1)
+        for i, cname in enumerate(self.conds):
+            ac, bc = getattr(self, f"mapper_{cname}")(t[i + 1])[
+                :, :, None, None
+            ].chunk(2, dim=1)
+            a, b = a + ac, b + bc
+        return x * (1 + a) + b
+
+
+class AttentionT(nn.Module):
+    """diffusers Attention(bias=True) key layout: to_q/k/v + to_out.0."""
+
+    def __init__(self, dim, heads):
+        super().__init__()
+        self.heads = heads
+        self.to_q = nn.Linear(dim, dim, bias=True)
+        self.to_k = nn.Linear(dim, dim, bias=True)
+        self.to_v = nn.Linear(dim, dim, bias=True)
+        self.to_out = nn.ModuleList([nn.Linear(dim, dim), nn.Dropout(0.0)])
+
+    def forward(self, hidden, encoder_hidden_states):
+        b, s, d = hidden.shape
+        hd = d // self.heads
+        q = self.to_q(hidden).view(b, s, self.heads, hd).transpose(1, 2)
+        sk = encoder_hidden_states.shape[1]
+        k = self.to_k(encoder_hidden_states).view(
+            b, sk, self.heads, hd
+        ).transpose(1, 2)
+        v = self.to_v(encoder_hidden_states).view(
+            b, sk, self.heads, hd
+        ).transpose(1, 2)
+        out = F.scaled_dot_product_attention(q, k, v)
+        out = out.transpose(1, 2).reshape(b, s, d)
+        return self.to_out[0](out)
+
+
+class AttnBlockT(nn.Module):
+    def __init__(self, c, c_cond, nhead, self_attn=True):
+        super().__init__()
+        self.self_attn = self_attn
+        self.norm = LayerNorm2dT(c, elementwise_affine=False, eps=1e-6)
+        self.attention = AttentionT(c, nhead)
+        self.kv_mapper = nn.Sequential(nn.SiLU(), nn.Linear(c_cond, c))
+
+    def forward(self, x, kv):
+        kv = self.kv_mapper(kv)
+        norm_x = self.norm(x)
+        b, c, h, w = x.shape
+        tokens = norm_x.view(b, c, h * w).transpose(1, 2)
+        if self.self_attn:
+            kv = torch.cat([tokens, kv], dim=1)
+        out = self.attention(tokens, kv)
+        return x + out.transpose(1, 2).view(b, c, h, w)
+
+
+class UpDownBlock2dT(nn.Module):
+    def __init__(self, in_channels, out_channels, mode, enabled=True):
+        super().__init__()
+        interpolation = (
+            nn.Upsample(
+                scale_factor=2 if mode == "up" else 0.5,
+                mode="bilinear",
+                align_corners=True,
+            )
+            if enabled
+            else nn.Identity()
+        )
+        mapping = nn.Conv2d(in_channels, out_channels, kernel_size=1)
+        self.blocks = nn.ModuleList(
+            [interpolation, mapping] if mode == "up" else [mapping, interpolation]
+        )
+
+    def forward(self, x):
+        for block in self.blocks:
+            x = block(x)
+        return x
+
+
+class StableCascadeUNetT(nn.Module):
+    """Mirror driven by the SAME CascadeUNetConfig dataclass the flax
+    module uses, emitting the diffusers key layout."""
+
+    def __init__(self, cfg):
+        super().__init__()
+        self.cfg = cfg
+        levels = len(cfg.block_out_channels)
+        c0 = cfg.block_out_channels[0]
+
+        self.clip_txt_pooled_mapper = nn.Linear(
+            cfg.clip_text_pooled_in_channels,
+            cfg.conditioning_dim * cfg.clip_seq,
+        )
+        if cfg.clip_text_in_channels:
+            self.clip_txt_mapper = nn.Linear(
+                cfg.clip_text_in_channels, cfg.conditioning_dim
+            )
+        if cfg.clip_image_in_channels:
+            self.clip_img_mapper = nn.Linear(
+                cfg.clip_image_in_channels,
+                cfg.conditioning_dim * cfg.clip_seq,
+            )
+        self.clip_norm = nn.LayerNorm(
+            cfg.conditioning_dim, elementwise_affine=False, eps=1e-6
+        )
+
+        self.embedding = nn.Sequential(
+            nn.PixelUnshuffle(cfg.patch_size),
+            nn.Conv2d(
+                cfg.in_channels * cfg.patch_size**2, c0, kernel_size=1
+            ),
+            LayerNorm2dT(c0, elementwise_affine=False, eps=1e-6),
+        )
+        if cfg.effnet_in_channels:
+            self.effnet_mapper = nn.Sequential(
+                nn.Conv2d(cfg.effnet_in_channels, c0 * 4, kernel_size=1),
+                nn.GELU(),
+                nn.Conv2d(c0 * 4, c0, kernel_size=1),
+                LayerNorm2dT(c0, elementwise_affine=False, eps=1e-6),
+            )
+        if cfg.pixel_mapper_in_channels:
+            self.pixels_mapper = nn.Sequential(
+                nn.Conv2d(cfg.pixel_mapper_in_channels, c0 * 4, kernel_size=1),
+                nn.GELU(),
+                nn.Conv2d(c0 * 4, c0, kernel_size=1),
+                LayerNorm2dT(c0, elementwise_affine=False, eps=1e-6),
+            )
+
+        def make_level(level, n_layers, c_skip_first):
+            ch = cfg.block_out_channels[level]
+            blocks = nn.ModuleList()
+            for layer in range(n_layers):
+                blocks.append(
+                    ResBlockT(
+                        ch,
+                        c_skip=c_skip_first if layer == 0 else 0,
+                        kernel_size=cfg.kernel_size,
+                    )
+                )
+                blocks.append(
+                    TimestepBlockT(
+                        ch,
+                        cfg.timestep_ratio_embedding_dim,
+                        conds=cfg.timestep_conditioning_type,
+                    )
+                )
+                if cfg.attention[level]:
+                    blocks.append(
+                        AttnBlockT(
+                            ch,
+                            cfg.conditioning_dim,
+                            cfg.num_attention_heads[level],
+                            self_attn=cfg.self_attn,
+                        )
+                    )
+            return blocks
+
+        self.down_blocks = nn.ModuleList()
+        self.down_downscalers = nn.ModuleList()
+        self.down_repeat_mappers = nn.ModuleList()
+        for i in range(levels):
+            if i > 0:
+                scaler = (
+                    UpDownBlock2dT(
+                        cfg.block_out_channels[i - 1],
+                        cfg.block_out_channels[i],
+                        mode="down",
+                        enabled=cfg.switch_level[i - 1],
+                    )
+                    if cfg.switch_level is not None
+                    else nn.Conv2d(
+                        cfg.block_out_channels[i - 1],
+                        cfg.block_out_channels[i],
+                        kernel_size=2,
+                        stride=2,
+                    )
+                )
+                self.down_downscalers.append(
+                    nn.Sequential(
+                        LayerNorm2dT(
+                            cfg.block_out_channels[i - 1],
+                            elementwise_affine=False,
+                            eps=1e-6,
+                        ),
+                        scaler,
+                    )
+                )
+            else:
+                self.down_downscalers.append(nn.Identity())
+            self.down_blocks.append(
+                make_level(i, cfg.down_num_layers_per_block[i], 0)
+            )
+            self.down_repeat_mappers.append(
+                nn.ModuleList(
+                    [
+                        nn.Conv2d(
+                            cfg.block_out_channels[i],
+                            cfg.block_out_channels[i],
+                            kernel_size=1,
+                        )
+                        for _ in range(cfg.down_blocks_repeat_mappers[i] - 1)
+                    ]
+                )
+            )
+
+        self.up_blocks = nn.ModuleList()
+        self.up_upscalers = nn.ModuleList()
+        self.up_repeat_mappers = nn.ModuleList()
+        for j in range(levels):
+            i = levels - 1 - j
+            c_skip = cfg.block_out_channels[i] if j > 0 else 0
+            self.up_blocks.append(
+                make_level(i, cfg.up_num_layers_per_block[j], c_skip)
+            )
+            if i > 0:
+                scaler = (
+                    UpDownBlock2dT(
+                        cfg.block_out_channels[i],
+                        cfg.block_out_channels[i - 1],
+                        mode="up",
+                        enabled=cfg.switch_level[i - 1],
+                    )
+                    if cfg.switch_level is not None
+                    else nn.ConvTranspose2d(
+                        cfg.block_out_channels[i],
+                        cfg.block_out_channels[i - 1],
+                        kernel_size=2,
+                        stride=2,
+                    )
+                )
+                self.up_upscalers.append(
+                    nn.Sequential(
+                        LayerNorm2dT(
+                            cfg.block_out_channels[i],
+                            elementwise_affine=False,
+                            eps=1e-6,
+                        ),
+                        scaler,
+                    )
+                )
+            else:
+                self.up_upscalers.append(nn.Identity())
+            self.up_repeat_mappers.append(
+                nn.ModuleList(
+                    [
+                        nn.Conv2d(
+                            cfg.block_out_channels[i],
+                            cfg.block_out_channels[i],
+                            kernel_size=1,
+                        )
+                        for _ in range(cfg.up_blocks_repeat_mappers[j] - 1)
+                    ]
+                )
+            )
+
+        self.clf = nn.Sequential(
+            LayerNorm2dT(c0, elementwise_affine=False, eps=1e-6),
+            nn.Conv2d(
+                c0, cfg.out_channels * cfg.patch_size**2, kernel_size=1
+            ),
+            nn.PixelShuffle(cfg.patch_size),
+        )
+
+    def gen_r_embedding(self, r, max_positions=10000):
+        dim = self.cfg.timestep_ratio_embedding_dim
+        r = r * max_positions
+        half = dim // 2
+        emb = math.log(max_positions) / (half - 1)
+        emb = torch.arange(half, dtype=torch.float32).mul(-emb).exp()
+        emb = r[:, None] * emb[None, :]
+        emb = torch.cat([emb.sin(), emb.cos()], dim=1)
+        if dim % 2 == 1:
+            emb = F.pad(emb, (0, 1), mode="constant")
+        return emb
+
+    def forward(
+        self,
+        sample,
+        timestep_ratio,
+        clip_text_pooled,
+        clip_text=None,
+        clip_img=None,
+        effnet=None,
+        pixels=None,
+    ):
+        cfg = self.cfg
+        b = sample.shape[0]
+        t_embed = self.gen_r_embedding(timestep_ratio)
+        for _ in cfg.timestep_conditioning_type:
+            t_embed = torch.cat(
+                [t_embed, self.gen_r_embedding(torch.zeros_like(timestep_ratio))],
+                dim=1,
+            )
+
+        ctp = self.clip_txt_pooled_mapper(clip_text_pooled).view(
+            b, clip_text_pooled.shape[1] * cfg.clip_seq, -1
+        )
+        if cfg.clip_text_in_channels and clip_text is not None:
+            pieces = [self.clip_txt_mapper(clip_text)]
+            if cfg.clip_image_in_channels:
+                if clip_img is None:
+                    clip_img = sample.new_zeros(
+                        b, 1, cfg.clip_image_in_channels
+                    )
+                pieces.append(
+                    self.clip_img_mapper(clip_img).view(
+                        b, clip_img.shape[1] * cfg.clip_seq, -1
+                    )
+                )
+            clip = torch.cat(pieces + [ctp], dim=1)
+        else:
+            clip = ctp
+        clip = self.clip_norm(clip)
+
+        x = self.embedding(sample)
+        if cfg.effnet_in_channels and effnet is not None:
+            x = x + self.effnet_mapper(
+                F.interpolate(
+                    effnet, size=x.shape[-2:], mode="bilinear",
+                    align_corners=True,
+                )
+            )
+        if cfg.pixel_mapper_in_channels:
+            if pixels is None:
+                pixels = sample.new_zeros(b, cfg.pixel_mapper_in_channels, 8, 8)
+            x = x + F.interpolate(
+                self.pixels_mapper(pixels),
+                size=x.shape[-2:],
+                mode="bilinear",
+                align_corners=True,
+            )
+
+        def run_blocks(blocks, x, skip=None):
+            first = True
+            for block in blocks:
+                if isinstance(block, ResBlockT):
+                    s = skip if first else None
+                    if s is not None and x.shape[-2:] != s.shape[-2:]:
+                        x = F.interpolate(
+                            x, size=s.shape[-2:], mode="bilinear",
+                            align_corners=True,
+                        )
+                    x = block(x, s)
+                    first = False
+                elif isinstance(block, TimestepBlockT):
+                    x = block(x, t_embed)
+                else:
+                    x = block(x, clip)
+            return x
+
+        level_outputs = []
+        for i, (blocks, scaler, repmap) in enumerate(
+            zip(self.down_blocks, self.down_downscalers, self.down_repeat_mappers)
+        ):
+            x = scaler(x)
+            for r in range(len(repmap) + 1):
+                x = run_blocks(blocks, x)
+                if r < len(repmap):
+                    x = repmap[r](x)
+            level_outputs.insert(0, x)
+
+        x = level_outputs[0]
+        for j, (blocks, scaler, repmap) in enumerate(
+            zip(self.up_blocks, self.up_upscalers, self.up_repeat_mappers)
+        ):
+            skip = level_outputs[j] if j > 0 else None
+            for r in range(len(repmap) + 1):
+                x = run_blocks(blocks, x, skip=skip)
+                if r < len(repmap):
+                    x = repmap[r](x)
+            x = scaler(x)
+        return self.clf(x)
+
+
+class MixingResidualBlockT(nn.Module):
+    def __init__(self, inp_channels, embed_dim):
+        super().__init__()
+        self.norm1 = LayerNorm2dT(inp_channels, elementwise_affine=False, eps=1e-6)
+        self.depthwise = nn.Sequential(
+            nn.ReplicationPad2d(1),
+            nn.Conv2d(inp_channels, inp_channels, kernel_size=3, groups=inp_channels),
+        )
+        self.norm2 = LayerNorm2dT(inp_channels, elementwise_affine=False, eps=1e-6)
+        self.channelwise = nn.Sequential(
+            nn.Linear(inp_channels, embed_dim),
+            nn.GELU(),
+            nn.Linear(embed_dim, inp_channels),
+        )
+        self.gammas = nn.Parameter(torch.zeros(6), requires_grad=True)
+
+    def forward(self, x):
+        mods = self.gammas
+        x_temp = self.norm1(x) * (1 + mods[0]) + mods[1]
+        x = x + self.depthwise(x_temp) * mods[2]
+        x_temp = self.norm2(x) * (1 + mods[3]) + mods[4]
+        x = (
+            x
+            + self.channelwise(x_temp.permute(0, 2, 3, 1)).permute(0, 3, 1, 2)
+            * mods[5]
+        )
+        return x
+
+
+class PaellaVQT(nn.Module):
+    """PaellaVQModel mirror (decode path exercised; encoder keys exist so
+    the converter's ignore-list is tested on real layouts)."""
+
+    def __init__(self, cfg):
+        super().__init__()
+        self.cfg = cfg
+        c_levels = cfg.c_levels()
+        self.in_block = nn.Sequential(
+            nn.PixelUnshuffle(cfg.up_down_scale_factor),
+            nn.Conv2d(
+                cfg.out_channels * cfg.up_down_scale_factor**2,
+                c_levels[0],
+                kernel_size=1,
+            ),
+        )
+        down_blocks = []
+        for i in range(cfg.levels):
+            if i > 0:
+                down_blocks.append(
+                    nn.Conv2d(
+                        c_levels[i - 1], c_levels[i], kernel_size=4,
+                        stride=2, padding=1,
+                    )
+                )
+            down_blocks.append(
+                MixingResidualBlockT(c_levels[i], c_levels[i] * 4)
+            )
+        down_blocks.append(
+            nn.Sequential(
+                nn.Conv2d(
+                    c_levels[-1], cfg.latent_channels, kernel_size=1,
+                    bias=False,
+                ),
+                nn.BatchNorm2d(cfg.latent_channels),
+            )
+        )
+        self.down_blocks = nn.Sequential(*down_blocks)
+
+        up_blocks = [nn.Sequential(nn.Conv2d(cfg.latent_channels, c_levels[-1], kernel_size=1))]
+        for i in range(cfg.levels):
+            for j in range(cfg.bottleneck_blocks if i == 0 else 1):
+                up_blocks.append(
+                    MixingResidualBlockT(
+                        c_levels[cfg.levels - 1 - i],
+                        c_levels[cfg.levels - 1 - i] * 4,
+                    )
+                )
+            if i < cfg.levels - 1:
+                up_blocks.append(
+                    nn.ConvTranspose2d(
+                        c_levels[cfg.levels - 1 - i],
+                        c_levels[cfg.levels - 2 - i],
+                        kernel_size=4,
+                        stride=2,
+                        padding=1,
+                    )
+                )
+        self.up_blocks = nn.Sequential(*up_blocks)
+        self.out_block = nn.Sequential(
+            nn.Conv2d(
+                c_levels[0],
+                cfg.out_channels * cfg.up_down_scale_factor**2,
+                kernel_size=1,
+            ),
+            nn.PixelShuffle(cfg.up_down_scale_factor),
+        )
+
+    def decode(self, latents):
+        return self.out_block(self.up_blocks(latents))
