@@ -150,7 +150,55 @@ def verify_local_model(model_name: str, root: Path | None = None) -> dict | None
         return _verify_unet3d_model(model_name, root)
     if "cascade" in name:
         return _verify_cascade_model(model_name, root)
+    if "stable-video" in name or "svd" in name:
+        return _verify_svd_model(model_name, root)
     return _verify_sd_model(model_name, root)
+
+
+def _verify_svd_model(model_name: str, root: Path) -> dict:
+    """Stable Video Diffusion repos: convert through the SAME loader the
+    SVDPipeline serves with (spatio-temporal UNet, temporal-decoder VAE,
+    CLIP vision tower; geometry inferred from the checkpoints)."""
+    import jax.numpy as jnp
+
+    from .models.safety import CLIPVisionEncoder
+    from .models.svd_unet import UNetSpatioTemporalConditionModel
+    from .models.svd_vae import AutoencoderKLTemporalDecoder
+    from .models.conversion import assert_tree_shapes_match
+    from .pipelines.svd import _load_converted_svd
+
+    model_dir = root / model_name
+    if not model_dir.is_dir():
+        raise FileNotFoundError(f"no checkpoint directory {model_dir}")
+    conv = _load_converted_svd(model_name, model_dir=model_dir)
+    if conv is None:
+        raise FileNotFoundError(f"no SVD checkpoint under {model_dir}")
+    ucfg = conv["unet_cfg"]
+    unet_exp = _eval_shape_params(
+        UNetSpatioTemporalConditionModel(ucfg),
+        jnp.zeros((1, 2, 8, 8, ucfg.in_channels)),
+        jnp.zeros((1,)),
+        jnp.zeros((1, 1, ucfg.cross_attention_dim)),
+        jnp.zeros((1, 3)),
+    )
+    assert_tree_shapes_match(conv["unet"], unet_exp, prefix="unet")
+    vcfg = conv["vae_cfg"]
+    vae_exp = _eval_shape_params(
+        AutoencoderKLTemporalDecoder(vcfg), jnp.zeros((1, 32, 32, 3)),
+        num_frames=1,  # static: frame-axis reshapes need a concrete count
+    )
+    assert_tree_shapes_match(conv["vae"], vae_exp, prefix="vae")
+    icfg = conv["vision_cfg"]
+    vis_exp = _eval_shape_params(
+        CLIPVisionEncoder(icfg),
+        jnp.zeros((1, icfg.image_size, icfg.image_size, 3)),
+    )
+    assert_tree_shapes_match(conv["vision"], vis_exp, prefix="vision")
+    return {
+        "unet": _param_count(conv["unet"]),
+        "vae": _param_count(conv["vae"]),
+        "vision": _param_count(conv["vision"]),
+    }
 
 
 def _verify_cascade_model(model_name: str, root: Path) -> dict:

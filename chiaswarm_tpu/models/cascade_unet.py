@@ -512,10 +512,13 @@ class StableCascadeUNet(nn.Module):
                             x, x.shape[1] // 2, x.shape[2] // 2
                         )
                 else:
+                    # torch Conv2d(k=2, s=2) has padding=0: VALID, so odd
+                    # grids floor (flax SAME would zero-pad and diverge)
                     x = nn.Conv(
                         cfg.block_out_channels[i],
                         (2, 2),
                         strides=(2, 2),
+                        padding="VALID",
                         dtype=self.dtype,
                         name=f"down_downscalers_{i}_1",
                     )(x)

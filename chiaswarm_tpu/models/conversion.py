@@ -1776,3 +1776,152 @@ def convert_paella_vq(state: dict, config_json: dict | None = None):
     for path, value in specials:
         _assign(params, path, value)
     return cfg, params
+
+
+# --- Stable Video Diffusion family ---
+
+
+def svd_unet_rename(name: str) -> str:
+    """diffusers UNetSpatioTemporalConditionModel names -> models.svd_unet
+    names (flatten per-level block lists; GEGLU nets; flat time_pos_embed)."""
+    import re
+
+    name = re.sub(
+        r"(down_blocks|up_blocks)\.(\d+)\."
+        r"(resnets|attentions|downsamplers|upsamplers)\.",
+        r"\1_\2_\3.",
+        name,
+    )
+    name = name.replace("mid_block.resnets.", "mid_block_resnets.")
+    name = name.replace("mid_block.attentions.", "mid_block_attentions.")
+    name = name.replace(".to_out.0.", ".to_out_0.")
+    name = re.sub(r"\.(ff|ff_in)\.net\.0\.", r".\1.net_0.", name)
+    name = re.sub(r"\.(ff|ff_in)\.net\.2\.", r".\1.net_2.", name)
+    name = name.replace(".time_pos_embed.linear_", ".time_pos_embed_linear_")
+    return name
+
+
+def convert_svd_unet(state: dict) -> dict:
+    return convert_state_dict(state, svd_unet_rename)
+
+
+def infer_svd_unet_config(state: dict, config_json: dict | None = None):
+    """SVDUNetConfig from checkpoint shapes (head counts from config.json,
+    falling back to head-dim-64 like the released checkpoints)."""
+    import re
+
+    from .svd_unet import SVDUNetConfig
+
+    cj = config_json or {}
+    blocks: dict[int, int] = {}
+    attn: set[int] = set()
+    layers = 1
+    for k in state:
+        m = re.match(
+            r"down_blocks\.(\d+)\.resnets\.(\d+)\."
+            r"spatial_res_block\.conv1\.weight",
+            k,
+        )
+        if m:
+            blocks[int(m.group(1))] = int(np.asarray(state[k]).shape[0])
+            layers = max(layers, int(m.group(2)) + 1)
+        m = re.match(r"down_blocks\.(\d+)\.attentions\.", k)
+        if m:
+            attn.add(int(m.group(1)))
+    n = max(blocks) + 1
+    cross = 1024
+    tlayers = 1
+    for k in state:
+        m = re.match(
+            r"down_blocks\.\d+\.attentions\.0\.transformer_blocks\."
+            r"(\d+)\.attn2\.to_k\.weight",
+            k,
+        )
+        if m:
+            cross = int(np.asarray(state[k]).shape[1])
+            tlayers = max(tlayers, int(m.group(1)) + 1)
+    proj_in_dim = int(np.asarray(state["add_embedding.linear_1.weight"]).shape[1])
+    heads_cj = cj.get("num_attention_heads")
+    if heads_cj is None:
+        heads = tuple(max(1, blocks[i] // 64) for i in range(n))
+    elif isinstance(heads_cj, int):
+        heads = (heads_cj,) * n
+    else:
+        heads = tuple(int(h) for h in heads_cj)
+    return SVDUNetConfig(
+        in_channels=int(np.asarray(state["conv_in.weight"]).shape[1]),
+        out_channels=int(np.asarray(state["conv_out.weight"]).shape[0]),
+        block_out_channels=tuple(blocks[i] for i in range(n)),
+        layers_per_block=layers,
+        attention=tuple(i in attn for i in range(n)),
+        num_attention_heads=heads,
+        cross_attention_dim=cross,
+        transformer_layers_per_block=tlayers,
+        addition_time_embed_dim=proj_in_dim // 3,
+        projection_class_embeddings_input_dim=proj_in_dim,
+    )
+
+
+def convert_svd_vae(state: dict) -> dict:
+    """AutoencoderKLTemporalDecoder -> models.svd_vae params: the standard
+    VAE rename covers both sides (the temporal decoder's level names
+    flatten identically; its spatio-temporal res-block children pass
+    through unchanged)."""
+    return convert_state_dict(state, vae_rename)
+
+
+def infer_svd_vae_config(state: dict, config_json: dict | None = None):
+    import re
+
+    from .svd_vae import SVDVAEConfig
+
+    cj = config_json or {}
+    blocks: dict[int, int] = {}
+    layers = 1
+    for k in state:
+        m = re.match(
+            r"encoder\.down_blocks\.(\d+)\.resnets\.(\d+)\.conv1\.weight", k
+        )
+        if m:
+            blocks[int(m.group(1))] = int(np.asarray(state[k]).shape[0])
+            layers = max(layers, int(m.group(2)) + 1)
+    n = max(blocks) + 1
+    return SVDVAEConfig(
+        in_channels=int(np.asarray(state["encoder.conv_in.weight"]).shape[1]),
+        latent_channels=int(
+            np.asarray(state["quant_conv.weight"]).shape[0] // 2
+        ),
+        block_out_channels=tuple(blocks[i] for i in range(n)),
+        layers_per_block=layers,
+        scaling_factor=float(cj.get("scaling_factor") or 0.18215),
+    )
+
+
+def convert_clip_vision(state: dict) -> dict:
+    """transformers CLIPVisionModelWithProjection -> the standalone vision
+    tower (models/safety.py::CLIPVisionEncoder param names). Reuses the
+    safety-checker converter by aliasing the key prefix."""
+    aliased = {}
+    for k, v in state.items():
+        if k.startswith("vision_model."):
+            aliased["vision_model." + k] = v
+        elif k == "visual_projection.weight":
+            aliased[k] = v
+    return convert_safety_checker(aliased)["vision"]
+
+
+def infer_clip_vision_config(config_json: dict | None = None):
+    """SafetyConfig (the vision-tower geometry carrier) from a
+    CLIPVisionModelWithProjection config.json."""
+    from .safety import SafetyConfig
+
+    cj = config_json or {}
+    return SafetyConfig(
+        image_size=int(cj.get("image_size", 224)),
+        patch_size=int(cj.get("patch_size", 14)),
+        hidden_size=int(cj.get("hidden_size", 1280)),
+        num_layers=int(cj.get("num_hidden_layers", 32)),
+        num_heads=int(cj.get("num_attention_heads", 16)),
+        projection_dim=int(cj.get("projection_dim", 1024)),
+        hidden_act=str(cj.get("hidden_act", "gelu")),
+    )

@@ -19,6 +19,8 @@ import dataclasses
 import flax.linen as nn
 import jax.numpy as jnp
 
+from .clip import _act
+
 
 @dataclasses.dataclass(frozen=True)
 class SafetyConfig:
@@ -30,6 +32,9 @@ class SafetyConfig:
     projection_dim: int = 768
     num_concepts: int = 17
     num_special: int = 3
+    # ViT-L towers (safety checker) use quick_gelu; ViT-H (SVD's image
+    # encoder, which reuses this tower standalone) uses erf gelu
+    hidden_act: str = "quick_gelu"
 
 
 TINY_SAFETY = SafetyConfig(
@@ -84,7 +89,7 @@ class CLIPVisionEncoder(nn.Module):
             y = nn.LayerNorm(dtype=self.dtype, name=f"{blk}_ln2")(x)
             y = nn.Dense(4 * cfg.hidden_size, dtype=self.dtype,
                          name=f"{blk}_fc1")(y)
-            y = y * nn.sigmoid(1.702 * y)  # quick_gelu
+            y = _act(cfg.hidden_act)(y)
             x = x + nn.Dense(cfg.hidden_size, dtype=self.dtype,
                              name=f"{blk}_fc2")(y)
         pooled = nn.LayerNorm(dtype=self.dtype, name="post_ln")(x[:, 0])

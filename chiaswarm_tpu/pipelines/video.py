@@ -574,7 +574,8 @@ def _build_img2vid(model_name, chipset, **variant):
     return VideoPipeline(model_name, chipset, image_conditioned=True, **variant)
 
 
-register_family("svd")(_build_img2vid)
+# "svd" is owned by pipelines/svd.py (true spatio-temporal architecture
+# with conversion); I2VGenXL still rides the motion-module approximation
 register_family("i2vgenxl")(_build_img2vid)
 
 
@@ -652,8 +653,12 @@ def run_img2vid(device_identifier: str, model_name: str, **kwargs):
         pipeline_type=kwargs.pop("pipeline_type", "I2VGenXLPipeline"),
         chipset=kwargs.pop("chipset", None),
     )
-    for drop in ("decode_chunk_size", "motion_bucket_id", "noise_aug_strength"):
-        kwargs.pop(drop, None)
+    # decode_chunk_size is a CUDA-memory knob with no TPU analog (the whole
+    # decode is one program); SVD's micro-conditioning keys pass through
+    kwargs.pop("decode_chunk_size", None)
+    if not getattr(pipeline, "accepts_micro_conditioning", False):
+        for drop in ("motion_bucket_id", "noise_aug_strength"):
+            kwargs.pop(drop, None)
     frames, config = pipeline.run(**kwargs)
     return {"primary": _frames_artifact(frames, config["fps"], content_type)}, config
 

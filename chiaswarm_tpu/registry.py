@@ -177,8 +177,8 @@ def _ensure_builtin_families() -> None:
     if _BUILTINS_LOADED:
         return
     _BUILTINS_LOADED = True
-    for module in ("stable_diffusion", "video", "audio", "captioning", "flux",
-                   "kandinsky", "kandinsky3", "cascade", "upscale",
+    for module in ("stable_diffusion", "video", "svd", "audio", "captioning",
+                   "flux", "kandinsky", "kandinsky3", "cascade", "upscale",
                    "deepfloyd", "bark"):
         try:
             __import__(f"{__package__}.pipelines.{module}")

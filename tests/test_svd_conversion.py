@@ -1,0 +1,194 @@
+"""Stable Video Diffusion real-architecture conversion: numeric parity of
+the flax UNetSpatioTemporalConditionModel and AutoencoderKLTemporalDecoder
+against exact-key torch mirrors (VERDICT r03 item 2 — img2vid previously
+served an AnimateDiff-style approximation with no conversion path)."""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+torch = pytest.importorskip("torch")
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(__file__))
+
+from torch_svd_ref import (  # noqa: E402
+    AutoencoderKLTemporalDecoderT,
+    UNetSpatioTemporalT,
+)
+
+from chiaswarm_tpu.models.conversion import (  # noqa: E402
+    convert_svd_unet,
+    convert_svd_vae,
+    infer_svd_unet_config,
+    infer_svd_vae_config,
+)
+from chiaswarm_tpu.models.svd_unet import (  # noqa: E402
+    TINY_SVD_UNET,
+    UNetSpatioTemporalConditionModel,
+)
+from chiaswarm_tpu.models.svd_vae import (  # noqa: E402
+    TINY_SVD_VAE,
+    AutoencoderKLTemporalDecoder,
+)
+
+
+def _state(module):
+    return {k: v.numpy() for k, v in module.state_dict().items()}
+
+
+def test_svd_unet_torch_parity():
+    cfg = TINY_SVD_UNET
+    torch.manual_seed(150)
+    tref = UNetSpatioTemporalT(cfg).eval()
+    state = _state(tref)
+    inferred = infer_svd_unet_config(
+        state, {"num_attention_heads": list(cfg.num_attention_heads)}
+    )
+    assert inferred == cfg
+    params = convert_svd_unet(state)
+
+    rng = np.random.default_rng(151)
+    b, frames = 2, 3
+    x = rng.standard_normal((b, frames, 8, 8, cfg.in_channels)).astype(
+        np.float32
+    )
+    t = np.asarray([321.0, 77.0], np.float32)
+    ctx = rng.standard_normal((b, 1, cfg.cross_attention_dim)).astype(
+        np.float32
+    )
+    ids = np.asarray([[6.0, 127.0, 0.02], [7.0, 63.0, 0.1]], np.float32)
+    with torch.no_grad():
+        out_t = tref(
+            torch.from_numpy(x.transpose(0, 1, 4, 2, 3)),
+            torch.from_numpy(t),
+            torch.from_numpy(ctx),
+            torch.from_numpy(ids),
+        ).numpy().transpose(0, 1, 3, 4, 2)
+    out_f = np.asarray(
+        UNetSpatioTemporalConditionModel(cfg).apply(
+            {"params": params},
+            jnp.asarray(x),
+            jnp.asarray(t),
+            jnp.asarray(ctx),
+            jnp.asarray(ids),
+        )
+    )
+    assert out_f.shape == out_t.shape
+    np.testing.assert_allclose(out_f, out_t, atol=3e-4, rtol=1e-3)
+
+
+def test_svd_vae_torch_parity():
+    cfg = TINY_SVD_VAE
+    torch.manual_seed(152)
+    tref = AutoencoderKLTemporalDecoderT(cfg).eval()
+    state = _state(tref)
+    inferred = infer_svd_vae_config(
+        state, {"scaling_factor": cfg.scaling_factor}
+    )
+    assert inferred == cfg
+    params = convert_svd_vae(state)
+
+    rng = np.random.default_rng(153)
+    frames = 3
+    pixels = rng.standard_normal((1, 32, 32, 3)).astype(np.float32)
+    model = AutoencoderKLTemporalDecoder(cfg)
+
+    with torch.no_grad():
+        enc_t = tref.encode_mode(
+            torch.from_numpy(pixels.transpose(0, 3, 1, 2))
+        ).numpy().transpose(0, 2, 3, 1)
+    enc_f = np.asarray(
+        model.apply({"params": params}, jnp.asarray(pixels), method=model.encode)
+    )
+    np.testing.assert_allclose(enc_f, enc_t, atol=3e-4, rtol=1e-3)
+
+    latents = rng.standard_normal(
+        (frames, 8, 8, cfg.latent_channels)
+    ).astype(np.float32)
+    with torch.no_grad():
+        dec_t = tref.decode_raw(
+            torch.from_numpy(latents.transpose(0, 3, 1, 2)), frames
+        ).numpy().transpose(0, 2, 3, 1)
+    dec_f = np.asarray(
+        model.apply(
+            {"params": params},
+            jnp.asarray(latents) * cfg.scaling_factor,
+            frames,
+            method=model.decode,
+        )
+    )
+    assert dec_f.shape == (frames, 16, 16, 3)
+    np.testing.assert_allclose(dec_f, dec_t, atol=3e-4, rtol=1e-3)
+
+
+def test_full_svd_repo_check_and_pipeline(sdaas_root, tmp_path):
+    """A complete synthetic SVD repo (torch-mirror UNet + temporal VAE,
+    transformers CLIP vision tower) passes `initialize --check` AND serves
+    an img2vid job through SVDPipeline with converted weights (reference
+    swarm/video/img2vid.py:14-38 semantics)."""
+    import json
+
+    from PIL import Image
+    from safetensors.numpy import save_file
+    from transformers import CLIPVisionConfig as HFVisionConfig
+    from transformers import CLIPVisionModelWithProjection
+
+    import jax
+
+    from chiaswarm_tpu.initialize import verify_local_model
+    from chiaswarm_tpu.pipelines.svd import SVDPipeline
+    from chiaswarm_tpu.settings import Settings, save_settings
+
+    name = "stabilityai/stable-video-diffusion-img2vid-xt"
+    root = tmp_path / "models"
+    save_settings(Settings(model_root_dir=str(root)))
+    repo = root / name
+    torch.manual_seed(154)
+
+    (repo / "unet").mkdir(parents=True)
+    save_file(
+        _state(UNetSpatioTemporalT(TINY_SVD_UNET)),
+        str(repo / "unet" / "diffusion_pytorch_model.safetensors"),
+    )
+    (repo / "unet" / "config.json").write_text(json.dumps({
+        "num_attention_heads": list(TINY_SVD_UNET.num_attention_heads),
+    }))
+
+    (repo / "vae").mkdir(parents=True)
+    save_file(
+        _state(AutoencoderKLTemporalDecoderT(TINY_SVD_VAE)),
+        str(repo / "vae" / "diffusion_pytorch_model.safetensors"),
+    )
+    (repo / "vae" / "config.json").write_text(json.dumps({
+        "scaling_factor": TINY_SVD_VAE.scaling_factor,
+    }))
+
+    vis_fields = dict(
+        image_size=32, patch_size=8, hidden_size=32, num_hidden_layers=2,
+        num_attention_heads=4, intermediate_size=128,
+        projection_dim=TINY_SVD_UNET.cross_attention_dim, hidden_act="gelu",
+    )
+    vision = CLIPVisionModelWithProjection(HFVisionConfig(**vis_fields))
+    (repo / "image_encoder").mkdir(parents=True)
+    save_file(
+        {k: v.numpy() for k, v in vision.state_dict().items()},
+        str(repo / "image_encoder" / "model.safetensors"),
+    )
+    (repo / "image_encoder" / "config.json").write_text(json.dumps(vis_fields))
+
+    report = verify_local_model(name, root)
+    assert set(report) == {"unet", "vae", "vision"}
+
+    pipe = SVDPipeline(name)
+    img = Image.new("RGB", (80, 70), (90, 140, 200))
+    frames, config = pipe.run(
+        image=img, height=64, width=64, num_frames=3,
+        num_inference_steps=2, rng=jax.random.key(7),
+    )
+    assert len(frames) == 3
+    assert frames[0].size == (64, 64)
+    assert config["motion_bucket_id"] == 127

@@ -1,0 +1,447 @@
+"""Exact-key torch mirror of the diffusers Stable Video Diffusion graphs
+(UNetSpatioTemporalConditionModel + AutoencoderKLTemporalDecoder), proving
+the flax modules + conversion numerically (same in-repo-reference strategy
+as torch_unet_ref.py / torch_cascade_ref.py).
+
+Keys match diffusers exactly: spatio-temporal res pairs
+(`spatial_res_block` / `temporal_res_block` / `time_mixer.mix_factor`),
+transformer pairs (`transformer_blocks` / `temporal_transformer_blocks` /
+`time_pos_embed`), SDXL-style `add_embedding` micro-conditioning, and the
+temporal decoder's trailing `time_conv_out`.
+"""
+
+import torch
+import torch.nn as nn
+import torch.nn.functional as F
+
+from torch_unet_ref import (
+    AttentionT,
+    EncoderT,
+    FeedForwardT,
+    ResnetT,
+    TimestepEmbeddingT,
+    VAEAttnT,
+    timestep_embedding_t,
+)
+
+
+class AlphaBlenderT(nn.Module):
+    def __init__(self, strategy="learned_with_images", switch=False):
+        super().__init__()
+        self.strategy = strategy
+        self.switch = switch
+        self.mix_factor = nn.Parameter(torch.Tensor([0.5]))
+
+    def forward(self, x_spatial, x_temporal, image_only_indicator=None):
+        alpha = torch.sigmoid(self.mix_factor)[0]
+        if self.strategy == "learned_with_images" and image_only_indicator is not None:
+            flags = image_only_indicator.bool()
+            while flags.ndim < x_spatial.ndim:
+                flags = flags.unsqueeze(-1)
+            alpha = torch.where(flags, torch.ones_like(alpha), alpha)
+        if self.switch:
+            alpha = 1.0 - alpha
+        return alpha * x_spatial + (1.0 - alpha) * x_temporal
+
+
+class TemporalResnetT(nn.Module):
+    """TemporalResnetBlock: (3,1,1) 3D convs on [B, C, F, H, W]."""
+
+    def __init__(self, in_ch, out_ch, temb_dim=None, eps=1e-6):
+        super().__init__()
+        self.norm1 = nn.GroupNorm(32, in_ch, eps=eps)
+        self.conv1 = nn.Conv3d(in_ch, out_ch, (3, 1, 1), padding=(1, 0, 0))
+        if temb_dim:
+            self.time_emb_proj = nn.Linear(temb_dim, out_ch)
+        self.norm2 = nn.GroupNorm(32, out_ch, eps=eps)
+        self.conv2 = nn.Conv3d(out_ch, out_ch, (3, 1, 1), padding=(1, 0, 0))
+        if in_ch != out_ch:
+            self.conv_shortcut = nn.Conv3d(in_ch, out_ch, 1)
+        self._has_temb = bool(temb_dim)
+        self._short = in_ch != out_ch
+
+    def forward(self, x, temb=None):
+        h = self.conv1(F.silu(self.norm1(x)))
+        if self._has_temb and temb is not None:
+            # temb [B, F, C] -> [B, C, F, 1, 1]
+            h = h + self.time_emb_proj(F.silu(temb)).permute(0, 2, 1)[
+                :, :, :, None, None
+            ]
+        h = self.conv2(F.silu(self.norm2(h)))
+        if self._short:
+            x = self.conv_shortcut(x)
+        return x + h
+
+
+class SpatioTemporalResT(nn.Module):
+    def __init__(self, in_ch, out_ch, temb_dim=None, eps=1e-5,
+                 temporal_eps=None, strategy="learned_with_images",
+                 switch=False):
+        super().__init__()
+        self.spatial_res_block = ResnetT(in_ch, out_ch, temb_dim, eps=eps)
+        self.temporal_res_block = TemporalResnetT(
+            out_ch, out_ch, temb_dim,
+            eps=temporal_eps if temporal_eps is not None else eps,
+        )
+        self.time_mixer = AlphaBlenderT(strategy, switch)
+
+    def forward(self, x, temb, image_only_indicator):
+        num_frames = image_only_indicator.shape[-1]
+        h = self.spatial_res_block(x, temb)
+        bf, c, hh, ww = h.shape
+        b = bf // num_frames
+        h5 = h.reshape(b, num_frames, c, hh, ww).permute(0, 2, 1, 3, 4)
+        temb5 = temb.reshape(b, num_frames, -1) if temb is not None else None
+        ht = self.temporal_res_block(h5, temb5)
+        mixed = self.time_mixer(
+            h5, ht,
+            image_only_indicator[:, None, :, None, None]
+            if self.time_mixer.strategy == "learned_with_images"
+            else None,
+        )
+        return mixed.permute(0, 2, 1, 3, 4).reshape(bf, c, hh, ww)
+
+
+class BasicBlockSVDT(nn.Module):
+    """Spatial BasicTransformerBlock (self + cross to image tokens)."""
+
+    def __init__(self, dim, heads, head_dim, cross_dim):
+        super().__init__()
+        self.norm1 = nn.LayerNorm(dim)
+        self.attn1 = AttentionT(dim, heads, head_dim)
+        self.norm2 = nn.LayerNorm(dim)
+        self.attn2 = AttentionT(dim, heads, head_dim, cross_dim=cross_dim)
+        self.norm3 = nn.LayerNorm(dim)
+        self.ff = FeedForwardT(dim)
+
+    def forward(self, x, context):
+        x = x + self.attn1(self.norm1(x))
+        x = x + self.attn2(self.norm2(x), context)
+        return x + self.ff(self.norm3(x))
+
+
+class TemporalBasicBlockT(nn.Module):
+    def __init__(self, dim, heads, head_dim, cross_dim):
+        super().__init__()
+        self.norm_in = nn.LayerNorm(dim)
+        self.ff_in = FeedForwardT(dim)
+        self.norm1 = nn.LayerNorm(dim)
+        self.attn1 = AttentionT(dim, heads, head_dim)
+        self.norm2 = nn.LayerNorm(dim)
+        self.attn2 = AttentionT(dim, heads, head_dim, cross_dim=cross_dim)
+        self.norm3 = nn.LayerNorm(dim)
+        self.ff = FeedForwardT(dim)
+
+    def forward(self, hidden, num_frames, encoder_hidden_states):
+        bf, s, c = hidden.shape
+        b = bf // num_frames
+        hidden = hidden.reshape(b, num_frames, s, c).permute(0, 2, 1, 3)
+        hidden = hidden.reshape(b * s, num_frames, c)
+        residual = hidden
+        hidden = self.ff_in(self.norm_in(hidden))
+        hidden = hidden + residual  # is_res (dim == inner)
+        hidden = self.attn1(self.norm1(hidden)) + hidden
+        hidden = self.attn2(self.norm2(hidden), encoder_hidden_states) + hidden
+        hidden = self.ff(self.norm3(hidden)) + hidden
+        hidden = hidden.reshape(b, s, num_frames, c).permute(0, 2, 1, 3)
+        return hidden.reshape(bf, s, c)
+
+
+class TransformerSpatioTemporalT(nn.Module):
+    def __init__(self, ch, heads, head_dim, layers, cross_dim):
+        super().__init__()
+        inner = heads * head_dim
+        self.norm = nn.GroupNorm(32, ch, eps=1e-6)
+        self.proj_in = nn.Linear(ch, inner)
+        self.transformer_blocks = nn.ModuleList(
+            [BasicBlockSVDT(inner, heads, head_dim, cross_dim)
+             for _ in range(layers)]
+        )
+        self.temporal_transformer_blocks = nn.ModuleList(
+            [TemporalBasicBlockT(inner, heads, head_dim, cross_dim)
+             for _ in range(layers)]
+        )
+        self._ch = ch
+        self.time_pos_embed = TimestepEmbeddingT4(ch, ch * 4, ch)
+        self.time_mixer = AlphaBlenderT("learned_with_images")
+        self.proj_out = nn.Linear(inner, ch)
+
+    def forward(self, x, context, image_only_indicator):
+        bf, c, hh, ww = x.shape
+        num_frames = image_only_indicator.shape[-1]
+        b = bf // num_frames
+
+        ctx_first = context.reshape(b, num_frames, -1, context.shape[-1])[:, 0]
+        time_context = ctx_first[:, None].expand(
+            b, hh * ww, ctx_first.shape[1], ctx_first.shape[2]
+        ).reshape(b * hh * ww, ctx_first.shape[1], ctx_first.shape[2])
+
+        residual = x
+        hidden = self.norm(x).permute(0, 2, 3, 1).reshape(bf, hh * ww, c)
+        hidden = self.proj_in(hidden)
+
+        frame_ids = torch.arange(num_frames).repeat(b)
+        emb = self.time_pos_embed(timestep_embedding_t(frame_ids, c))[:, None]
+
+        for block, tblock in zip(
+            self.transformer_blocks, self.temporal_transformer_blocks
+        ):
+            hidden = block(hidden, context)
+            mix = hidden + emb
+            mix = tblock(mix, num_frames, time_context)
+            s = hidden.shape[1]
+            sp = hidden.reshape(b, num_frames, s, c)
+            tp = mix.reshape(b, num_frames, s, c)
+            hidden = self.time_mixer(
+                sp, tp, image_only_indicator
+            ).reshape(bf, s, c)
+        hidden = self.proj_out(hidden)
+        return hidden.reshape(bf, hh, ww, c).permute(0, 3, 1, 2) + residual
+
+
+class TimestepEmbeddingT4(nn.Module):
+    """TimestepEmbedding with out_dim != hidden dim (time_pos_embed)."""
+
+    def __init__(self, in_dim, hidden, out_dim):
+        super().__init__()
+        self.linear_1 = nn.Linear(in_dim, hidden)
+        self.linear_2 = nn.Linear(hidden, out_dim)
+
+    def forward(self, x):
+        return self.linear_2(F.silu(self.linear_1(x)))
+
+
+class _Stage(nn.Module):
+    pass
+
+
+class _DownST(nn.Module):
+    def __init__(self, ch):
+        super().__init__()
+        self.conv = nn.Conv2d(ch, ch, 3, stride=2, padding=1)
+
+    def forward(self, x):
+        return self.conv(x)
+
+
+class _UpST(nn.Module):
+    def __init__(self, ch):
+        super().__init__()
+        self.conv = nn.Conv2d(ch, ch, 3, padding=1)
+
+    def forward(self, x):
+        return self.conv(F.interpolate(x, scale_factor=2.0, mode="nearest"))
+
+
+class UNetSpatioTemporalT(nn.Module):
+    """Mirror driven by the SAME SVDUNetConfig dataclass as the flax
+    module, emitting the diffusers key layout."""
+
+    def __init__(self, cfg):
+        super().__init__()
+        self.cfg = cfg
+        blocks = cfg.block_out_channels
+        temb_dim = blocks[0] * 4
+        self.conv_in = nn.Conv2d(cfg.in_channels, blocks[0], 3, padding=1)
+        self.time_embedding = TimestepEmbeddingT(blocks[0], temb_dim)
+        self.add_embedding = TimestepEmbeddingT(
+            cfg.projection_class_embeddings_input_dim, temb_dim
+        )
+
+        def attn_stage(level):
+            ch = blocks[level]
+            heads = cfg.num_attention_heads[level]
+            return TransformerSpatioTemporalT(
+                ch, heads, ch // heads, cfg.transformer_layers_per_block,
+                cfg.cross_attention_dim,
+            )
+
+        self.down_blocks = nn.ModuleList()
+        ch = blocks[0]
+        for i, out_ch in enumerate(blocks):
+            stage = _Stage()
+            stage.resnets = nn.ModuleList()
+            if cfg.attention[i]:
+                stage.attentions = nn.ModuleList()
+            for j in range(cfg.layers_per_block):
+                stage.resnets.append(
+                    SpatioTemporalResT(ch if j == 0 else out_ch, out_ch, temb_dim)
+                )
+                if cfg.attention[i]:
+                    stage.attentions.append(attn_stage(i))
+            if i != len(blocks) - 1:
+                stage.downsamplers = nn.ModuleList([_DownST(out_ch)])
+            self.down_blocks.append(stage)
+            ch = out_ch
+
+        mid = _Stage()
+        mid.resnets = nn.ModuleList([
+            SpatioTemporalResT(blocks[-1], blocks[-1], temb_dim),
+            SpatioTemporalResT(blocks[-1], blocks[-1], temb_dim),
+        ])
+        mid.attentions = nn.ModuleList([attn_stage(len(blocks) - 1)])
+        self.mid_block = mid
+
+        skip_chs = [blocks[0]]
+        for i, out_ch in enumerate(blocks):
+            skip_chs += [out_ch] * cfg.layers_per_block
+            if i != len(blocks) - 1:
+                skip_chs.append(out_ch)
+        self.up_blocks = nn.ModuleList()
+        ch = blocks[-1]
+        for bi, out_ch in enumerate(reversed(blocks)):
+            rev = len(blocks) - 1 - bi
+            stage = _Stage()
+            stage.resnets = nn.ModuleList()
+            if cfg.attention[rev]:
+                stage.attentions = nn.ModuleList()
+            for j in range(cfg.layers_per_block + 1):
+                skip = skip_chs.pop()
+                stage.resnets.append(
+                    SpatioTemporalResT(ch + skip, out_ch, temb_dim)
+                )
+                if cfg.attention[rev]:
+                    stage.attentions.append(attn_stage(rev))
+                ch = out_ch
+            if bi != len(blocks) - 1:
+                stage.upsamplers = nn.ModuleList([_UpST(out_ch)])
+            self.up_blocks.append(stage)
+
+        self.conv_norm_out = nn.GroupNorm(32, blocks[0], eps=1e-5)
+        self.conv_out = nn.Conv2d(blocks[0], cfg.out_channels, 3, padding=1)
+
+    def forward(self, sample, timesteps, encoder_hidden_states, added_time_ids):
+        cfg = self.cfg
+        b, num_frames = sample.shape[0], sample.shape[1]
+        temb = self.time_embedding(
+            timestep_embedding_t(timesteps, cfg.block_out_channels[0])
+        )
+        tid = timestep_embedding_t(
+            added_time_ids.flatten(), cfg.addition_time_embed_dim
+        ).reshape(b, -1)
+        temb = temb + self.add_embedding(tid)
+
+        x = sample.flatten(0, 1)
+        temb = temb.repeat_interleave(num_frames, dim=0)
+        context = encoder_hidden_states.repeat_interleave(num_frames, dim=0)
+        indicator = torch.zeros(b, num_frames)
+
+        x = self.conv_in(x)
+        skips = [x]
+        for stage in self.down_blocks:
+            for j, resnet in enumerate(stage.resnets):
+                x = resnet(x, temb, indicator)
+                if hasattr(stage, "attentions"):
+                    x = stage.attentions[j](x, context, indicator)
+                skips.append(x)
+            if hasattr(stage, "downsamplers"):
+                x = stage.downsamplers[0](x)
+                skips.append(x)
+
+        x = self.mid_block.resnets[0](x, temb, indicator)
+        x = self.mid_block.attentions[0](x, context, indicator)
+        x = self.mid_block.resnets[1](x, temb, indicator)
+
+        for stage in self.up_blocks:
+            for j, resnet in enumerate(stage.resnets):
+                x = torch.cat([x, skips.pop()], dim=1)
+                x = resnet(x, temb, indicator)
+                if hasattr(stage, "attentions"):
+                    x = stage.attentions[j](x, context, indicator)
+            if hasattr(stage, "upsamplers"):
+                x = stage.upsamplers[0](x)
+
+        x = self.conv_out(F.silu(self.conv_norm_out(x)))
+        return x.reshape(b, num_frames, *x.shape[1:])
+
+
+class _MidTD(nn.Module):
+    def __init__(self, ch, layers):
+        super().__init__()
+        self.resnets = nn.ModuleList([
+            SpatioTemporalResT(ch, ch, None, eps=1e-6, temporal_eps=1e-5,
+                               strategy="learned", switch=True)
+            for _ in range(layers)
+        ])
+        self.attentions = nn.ModuleList([VAEAttnT(ch)])
+
+    def forward(self, x, indicator):
+        x = self.resnets[0](x, None, indicator)
+        for resnet in self.resnets[1:]:
+            x = self.attentions[0](x)
+            x = resnet(x, None, indicator)
+        return x
+
+
+class _UpTD(nn.Module):
+    def __init__(self, in_ch, out_ch, layers, add_up):
+        super().__init__()
+        self.resnets = nn.ModuleList([
+            SpatioTemporalResT(in_ch if i == 0 else out_ch, out_ch, None,
+                               eps=1e-6, temporal_eps=1e-5,
+                               strategy="learned", switch=True)
+            for i in range(layers)
+        ])
+        if add_up:
+            self.upsamplers = nn.ModuleList([_UpST(out_ch)])
+
+    def forward(self, x, indicator):
+        for r in self.resnets:
+            x = r(x, None, indicator)
+        if hasattr(self, "upsamplers"):
+            x = self.upsamplers[0](x)
+        return x
+
+
+class TemporalDecoderT(nn.Module):
+    def __init__(self, cfg):
+        super().__init__()
+        blocks = cfg.block_out_channels
+        rev = list(reversed(blocks))
+        self.conv_in = nn.Conv2d(cfg.latent_channels, rev[0], 3, padding=1)
+        self.mid_block = _MidTD(rev[0], 2)
+        self.up_blocks = nn.ModuleList()
+        ch = rev[0]
+        for i, out_ch in enumerate(rev):
+            self.up_blocks.append(
+                _UpTD(ch, out_ch, cfg.layers_per_block + 1,
+                      add_up=i != len(rev) - 1)
+            )
+            ch = out_ch
+        self.conv_norm_out = nn.GroupNorm(32, blocks[0], eps=1e-6)
+        self.conv_out = nn.Conv2d(blocks[0], cfg.in_channels, 3, padding=1)
+        self.time_conv_out = nn.Conv3d(
+            cfg.in_channels, cfg.in_channels, (3, 1, 1), padding=(1, 0, 0)
+        )
+
+    def forward(self, z, num_frames):
+        indicator = torch.zeros(z.shape[0] // num_frames, num_frames)
+        x = self.conv_in(z)
+        x = self.mid_block(x, indicator)
+        for b in self.up_blocks:
+            x = b(x, indicator)
+        x = self.conv_out(F.silu(self.conv_norm_out(x)))
+        bf, c, hh, ww = x.shape
+        x = x.reshape(bf // num_frames, num_frames, c, hh, ww).permute(
+            0, 2, 1, 3, 4
+        )
+        x = self.time_conv_out(x)
+        return x.permute(0, 2, 1, 3, 4).reshape(bf, c, hh, ww)
+
+
+class AutoencoderKLTemporalDecoderT(nn.Module):
+    def __init__(self, cfg):
+        super().__init__()
+        self.encoder = EncoderT(cfg.encoder_config())
+        self.decoder = TemporalDecoderT(cfg)
+        self.quant_conv = nn.Conv2d(
+            2 * cfg.latent_channels, 2 * cfg.latent_channels, 1
+        )
+
+    def encode_mode(self, pixels):
+        moments = self.quant_conv(self.encoder(pixels))
+        mean, _ = moments.chunk(2, dim=1)
+        return mean
+
+    def decode_raw(self, latents, num_frames):
+        return self.decoder(latents, num_frames)
