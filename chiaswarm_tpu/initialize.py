@@ -132,6 +132,8 @@ def verify_local_model(model_name: str, root: Path | None = None) -> dict | None
         return _verify_safety_model(model_name, root)
     if "flux" in name:
         return _verify_flux_model(model_name, root)
+    if "kandinsky-3" in name or "kandinsky3" in name:
+        return _verify_kandinsky3_model(model_name, root)
     if "kandinsky" in name:
         return _verify_kandinsky_model(model_name, root)
     if "audioldm" in name:
@@ -153,6 +155,47 @@ def verify_local_model(model_name: str, root: Path | None = None) -> dict | None
     if "stable-video" in name or "svd" in name:
         return _verify_svd_model(model_name, root)
     return _verify_sd_model(model_name, root)
+
+
+def _verify_kandinsky3_model(model_name: str, root: Path) -> dict:
+    """Kandinsky 3 repos: convert through the SAME recipe the pipeline
+    serves with (Kandinsky3UNet + MoVQ + FLAN-UL2 T5 encoder, geometry
+    inferred from the checkpoints)."""
+    import jax.numpy as jnp
+
+    from .models.conversion import assert_tree_shapes_match
+    from .models.movq import MoVQ
+    from .models.t5 import T5Encoder
+    from .models.unet_kandinsky3 import Kandinsky3UNet
+    from .pipelines.kandinsky3 import convert_k3_checkpoint
+
+    model_dir = root / model_name
+    if not model_dir.is_dir():
+        raise FileNotFoundError(f"no checkpoint directory {model_dir}")
+    ucfg, unet, mcfg, movq, tcfg, t5 = convert_k3_checkpoint(model_dir)
+    hw = 2 ** (len(ucfg.block_out_channels) + 1)
+    unet_exp = _eval_shape_params(
+        Kandinsky3UNet(ucfg),
+        jnp.zeros((1, hw, hw, ucfg.in_channels)),
+        jnp.zeros((1,)),
+        jnp.zeros((1, 4, ucfg.encoder_hid_dim)),
+        jnp.ones((1, 4)),
+    )
+    assert_tree_shapes_match(unet, unet_exp, prefix="unet")
+    factor = 2 ** (len(mcfg.block_out_channels) - 1)
+    movq_exp = _eval_shape_params(
+        MoVQ(mcfg), jnp.zeros((1, 4 * factor, 4 * factor, 3))
+    )
+    assert_tree_shapes_match(movq, movq_exp, prefix="movq")
+    t5_exp = _eval_shape_params(
+        T5Encoder(tcfg), jnp.zeros((1, 4), jnp.int32)
+    )
+    assert_tree_shapes_match(t5, t5_exp, prefix="text_encoder")
+    return {
+        "unet": _param_count(unet),
+        "movq": _param_count(movq),
+        "text_encoder": _param_count(t5),
+    }
 
 
 def _verify_svd_model(model_name: str, root: Path) -> dict:

@@ -1925,3 +1925,97 @@ def infer_clip_vision_config(config_json: dict | None = None):
         projection_dim=int(cj.get("projection_dim", 1024)),
         hidden_act=str(cj.get("hidden_act", "gelu")),
     )
+
+
+# --- Kandinsky 3 (models/unet_kandinsky3.py) ---
+
+
+def infer_k3_unet_config(state: dict, config_json: dict | None = None):
+    """K3UNetConfig from the checkpoint itself. Shapes reveal everything
+    except attention_head_dim and groups (fused projections), which come
+    from the shipped config.json (defaults 64/32, the released values)."""
+    import re
+
+    from .unet_kandinsky3 import K3UNetConfig
+
+    cj = config_json or {}
+    blocks: dict[int, int] = {}
+    layers = 1
+    self_attn: set[int] = set()
+    cross_attn: set[int] = set()
+    for k in state:
+        m = re.match(
+            r"down_blocks\.(\d+)\.resnets_in\.(\d+)\.resnet_blocks\.3\."
+            r"projection\.weight",
+            k,
+        )
+        if m:
+            blocks[int(m.group(1))] = int(np.asarray(state[k]).shape[0])
+            layers = max(layers, int(m.group(2)) + 1)
+        m = re.match(r"down_blocks\.(\d+)\.attentions\.(\d+)\.attention\.", k)
+        if m:
+            (self_attn if m.group(2) == "0" else cross_attn).add(
+                int(m.group(1))
+            )
+    n = max(blocks) + 1
+    block_out = tuple(blocks[i] for i in range(n))
+    hid_w = np.asarray(state["encoder_hid_proj.weight"])
+    # hidden bottleneck width of down level 0's first resnet reveals the
+    # compression ratio: hidden = max(in, out) // ratio
+    h0 = int(
+        np.asarray(
+            state["down_blocks.0.resnets_in.0.resnet_blocks.0.projection.weight"]
+        ).shape[0]
+    )
+    first_attn = min(self_attn | cross_attn) if (self_attn or cross_attn) else 0
+    ff0 = state.get(
+        f"down_blocks.{first_attn}.attentions.0.feed_forward.0.weight",
+        state.get(
+            f"down_blocks.{first_attn}.attentions.1.feed_forward.0.weight"
+        ),
+    )
+    expansion = 4
+    if ff0 is not None:
+        ff0 = np.asarray(ff0)
+        expansion = int(ff0.shape[0] // ff0.shape[1])
+    return K3UNetConfig(
+        in_channels=int(np.asarray(state["conv_in.weight"]).shape[1]),
+        time_embedding_dim=int(
+            np.asarray(state["time_embedding.linear_2.weight"]).shape[0]
+        ),
+        groups=int(cj.get("groups", 32)),
+        attention_head_dim=int(cj.get("attention_head_dim", 64)),
+        layers_per_block=layers,
+        block_out_channels=block_out,
+        cross_attention_dim=int(hid_w.shape[0]),
+        encoder_hid_dim=int(hid_w.shape[1]),
+        add_cross_attention=tuple(i in cross_attn for i in range(n)),
+        add_self_attention=tuple(i in self_attn for i in range(n)),
+        expansion_ratio=expansion,
+        compression_ratio=max(1, block_out[0] // h0),
+    )
+
+
+def convert_kandinsky3_unet(state: dict, config_json: dict | None = None):
+    """-> (K3UNetConfig, params). The flattened diffusers names map by the
+    generic digit-merge rename; the ConvTranspose2d kernels
+    ((shortcut_)up_sample.weight, layout IOHW not OIHW) are the one
+    special case."""
+    cfg = infer_k3_unet_config(state, config_json)
+    specials = []
+    rest = {}
+    for k, v in state.items():
+        arr = np.asarray(v)
+        if (
+            (k.endswith("up_sample.weight"))
+            and arr.ndim == 4
+            and arr.shape[2:] == (2, 2)
+        ):
+            path, _ = torch_name_to_flax_path(k)
+            specials.append((path + ["kernel"], arr.transpose(2, 3, 0, 1)))
+        else:
+            rest[k] = v
+    params = convert_state_dict(rest)
+    for path, value in specials:
+        _assign(params, path, value)
+    return cfg, params

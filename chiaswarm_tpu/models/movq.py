@@ -43,6 +43,30 @@ TINY_MOVQ = MoVQConfig(
 )
 
 
+def movq_config_from_json(cj: dict | None) -> MoVQConfig:
+    """Geometry from a diffusers VQModel config.json (Kandinsky 3's movq
+    differs from 2.2's only in fields this reads)."""
+    cj = cj or {}
+    base = MoVQConfig()
+    return MoVQConfig(
+        in_channels=int(cj.get("in_channels", base.in_channels)),
+        out_channels=int(cj.get("out_channels", base.out_channels)),
+        latent_channels=int(cj.get("latent_channels", base.latent_channels)),
+        vq_embed_dim=int(cj.get("vq_embed_dim", base.vq_embed_dim)),
+        block_out_channels=tuple(
+            int(c) for c in cj.get("block_out_channels",
+                                   base.block_out_channels)
+        ),
+        layers_per_block=int(
+            cj.get("layers_per_block", base.layers_per_block)
+        ),
+        norm_num_groups=int(
+            cj.get("norm_num_groups", base.norm_num_groups)
+        ),
+        scaling_factor=float(cj.get("scaling_factor", base.scaling_factor)),
+    )
+
+
 class SpatialNorm(nn.Module):
     """GroupNorm whose scale/shift are 1x1 convs of the (nearest-resized)
     latent map — the 'Mo' in MoVQ (modulated quantized vectors)."""

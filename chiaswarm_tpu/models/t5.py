@@ -37,6 +37,33 @@ TINY_T5 = T5Config(
 )
 
 
+def t5_config_from_json(cj: dict | None) -> T5Config:
+    """Geometry from a transformers T5/UL2 config.json (Kandinsky 3 rides
+    FLAN-UL2's encoder: d_model 4096, 32 layers, d_ff 16384, 16x256
+    heads — different from Flux's XXL defaults)."""
+    cj = cj or {}
+    base = T5Config()
+    return T5Config(
+        vocab_size=int(cj.get("vocab_size", base.vocab_size)),
+        d_model=int(cj.get("d_model", base.d_model)),
+        d_kv=int(cj.get("d_kv", base.d_kv)),
+        num_heads=int(cj.get("num_heads", base.num_heads)),
+        d_ff=int(cj.get("d_ff", base.d_ff)),
+        num_layers=int(cj.get("num_layers", base.num_layers)),
+        relative_attention_num_buckets=int(
+            cj.get("relative_attention_num_buckets",
+                   base.relative_attention_num_buckets)
+        ),
+        relative_attention_max_distance=int(
+            cj.get("relative_attention_max_distance",
+                   base.relative_attention_max_distance)
+        ),
+        layer_norm_epsilon=float(
+            cj.get("layer_norm_epsilon", base.layer_norm_epsilon)
+        ),
+    )
+
+
 class RMSNorm(nn.Module):
     epsilon: float = 1e-6
     dtype: jnp.dtype = jnp.float32
@@ -81,7 +108,7 @@ class T5Attention(nn.Module):
     has_relative_bias: bool = False
 
     @nn.compact
-    def __call__(self, x, position_bias=None):
+    def __call__(self, x, position_bias=None, attention_mask=None):
         cfg = self.config
         b, s, _ = x.shape
         inner = cfg.num_heads * cfg.d_kv
@@ -111,6 +138,14 @@ class T5Attention(nn.Module):
         logits = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32)
         if position_bias is not None:
             logits = logits + position_bias.astype(jnp.float32)
+        if attention_mask is not None:
+            # [B, S] 1-keep mask over keys (transformers' extended-mask
+            # additive form: masked keys get a large negative)
+            logits = jnp.where(
+                attention_mask[:, None, None, :].astype(bool),
+                logits,
+                jnp.asarray(-1e9, jnp.float32),
+            )
         weights = nn.softmax(logits, axis=-1).astype(self.dtype)
         out = jnp.einsum("bhqk,bkhd->bqhd", weights, v).reshape(b, s, inner)
         return nn.Dense(
@@ -124,13 +159,13 @@ class T5Block(nn.Module):
     has_relative_bias: bool = False
 
     @nn.compact
-    def __call__(self, x, position_bias=None):
+    def __call__(self, x, position_bias=None, attention_mask=None):
         cfg = self.config
         y = RMSNorm(cfg.layer_norm_epsilon, dtype=self.dtype, name="attn_norm")(x)
         y, position_bias = T5Attention(
             cfg, dtype=self.dtype, has_relative_bias=self.has_relative_bias,
             name="attention",
-        )(y, position_bias)
+        )(y, position_bias, attention_mask)
         x = x + y
         y = RMSNorm(cfg.layer_norm_epsilon, dtype=self.dtype, name="ff_norm")(x)
         # gated-GELU FFN (T5 v1.1 / XXL): gelu(wi_0(x)) * wi_1(x) -> wo
@@ -146,8 +181,8 @@ class T5Encoder(nn.Module):
     dtype: jnp.dtype = jnp.float32
 
     @nn.compact
-    def __call__(self, input_ids):
-        """[B, S] int32 -> [B, S, d_model]."""
+    def __call__(self, input_ids, attention_mask=None):
+        """[B, S] int32 (+ [B, S] 1-keep mask) -> [B, S, d_model]."""
         cfg = self.config
         x = nn.Embed(
             cfg.vocab_size, cfg.d_model, dtype=self.dtype, name="token_embedding"
@@ -157,6 +192,6 @@ class T5Encoder(nn.Module):
             x, position_bias = T5Block(
                 cfg, dtype=self.dtype, has_relative_bias=(i == 0),
                 name=f"block_{i}",
-            )(x, position_bias)
+            )(x, position_bias, attention_mask)
         return RMSNorm(cfg.layer_norm_epsilon, dtype=self.dtype,
                        name="final_norm")(x)

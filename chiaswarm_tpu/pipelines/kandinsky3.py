@@ -3,19 +3,22 @@
 Reference behavior replaced: swarm/test.py:130-147 schedules
 `kandinsky-community/kandinsky-3` via AutoPipeline with
 `Kandinsky3Pipeline` semantics — unlike Kandinsky 2.x there is no prior
-stage; the prompt conditions a latent UNet directly through a FLAN-T5
-text encoder (the same family split diffusers implements).
+stage; the prompt conditions the Kandinsky3UNet directly through FLAN-UL2's
+T5 encoder (128 tokens, attention-masked all the way into the UNet's
+cross-attention and time-embedding pooling), and the pixels come out of a
+MoVQ decode.
 
 TPU redesign: the same resident one-scan shape as the other families —
 T5 encode once per job, CFG as a batch of 2 inside a single jitted
-`lax.scan` denoise + VAE decode program. The MoVQ decoder is served by
-this package's AutoencoderKL (as with Kandinsky 2.x; real-weight
-conversion for this family is not wired yet, so non-test model names fail
-loudly per weights.py).
+`lax.scan` denoise + MoVQ decode program. Real checkpoints convert at
+load (models/conversion.py convert_kandinsky3_unet + convert_movq +
+convert_t5, geometry inferred from the checkpoint); test/tiny names run
+the same true architecture at toy widths.
 """
 
 from __future__ import annotations
 
+import json
 import logging
 import threading
 import time
@@ -26,104 +29,190 @@ import jax.numpy as jnp
 import numpy as np
 from PIL import Image
 
-from ..models import configs as cfgs
-from ..models.t5 import TINY_T5, T5Config, T5Encoder
-from ..models.unet2d import UNet2DConditionModel, UNet2DConfig
-from ..models.vae import AutoencoderKL
+from ..models.movq import MoVQ, TINY_MOVQ, MoVQConfig, movq_config_from_json
+from ..models.t5 import TINY_T5, T5Config, T5Encoder, t5_config_from_json
+from ..models.unet_kandinsky3 import (
+    TINY_K3_UNET,
+    K3UNetConfig,
+    Kandinsky3UNet,
+)
 from ..parallel.mesh import make_mesh, replicated
 from ..registry import register_family
 from ..schedulers import get_scheduler
-from ..weights import is_test_model, require_weights_present
+from ..weights import (
+    MissingWeightsError,
+    is_test_model,
+    model_dir_for,
+    require_weights_present,
+)
 
 logger = logging.getLogger(__name__)
 
 _NO_CONVERSION_HINT = (
-    "This worker cannot serve real Kandinsky 3 weights yet; only the "
-    "test/tiny Kandinsky 3 model is available."
+    "No converted Kandinsky 3 checkpoint is present for this model name; "
+    "download it first (initialize --download) or use a test/tiny name."
 )
 
 _is_tiny = is_test_model
 
-# Kandinsky3 UNet analog: latent-space, FLAN-T5-conditioned (the real model
-# cross-attends on 4096-d T5 states at three scales)
-K3_UNET = UNet2DConfig(
-    block_out_channels=(384, 768, 1536, 3072),
-    transformer_layers=(0, 1, 1, 1),
-    num_attention_heads=(6, 12, 24, 48),
-    cross_attention_dim=4096,
-)
-TINY_K3_UNET = UNet2DConfig(
-    block_out_channels=(32, 64),
-    transformer_layers=(1, 1),
-    mid_transformer_layers=1,
-    layers_per_block=1,
-    num_attention_heads=4,
-    cross_attention_dim=32,
-)
+# the diffusers pipeline tokenizes to 128 T5 tokens
+MAX_TOKENS = 128
 
 
-def _configs(model_name: str):
-    """(unet_cfg, t5_cfg, vae_cfg, default_size)."""
+def convert_k3_checkpoint(model_dir):
+    """One Kandinsky 3 repo conversion recipe ->
+    (unet_cfg, unet, movq_cfg, movq, t5_cfg, t5) — shared by serving and
+    `initialize --check` so a green check means EXACTLY what the worker
+    will load."""
+    from ..models.conversion import (
+        convert_kandinsky3_unet,
+        convert_movq,
+        convert_t5,
+        load_torch_state_dict,
+    )
+
+    def cfg_json(sub):
+        p = model_dir / sub / "config.json"
+        return json.loads(p.read_text()) if p.is_file() else {}
+
+    ucfg, unet = convert_kandinsky3_unet(
+        load_torch_state_dict(model_dir, "unet"), cfg_json("unet")
+    )
+    movq_cfg = movq_config_from_json(cfg_json("movq"))
+    movq = convert_movq(load_torch_state_dict(model_dir, "movq"))
+    t5_cfg = t5_config_from_json(cfg_json("text_encoder"))
+    t5 = convert_t5(load_torch_state_dict(model_dir, "text_encoder"))
+    return ucfg, unet, movq_cfg, movq, t5_cfg, t5
+
+
+def _load_converted_k3(model_name: str):
+    """-> dict of configs+params or None when no checkpoint is local. A
+    present-but-unconvertible checkpoint fails as MissingWeightsError."""
     if _is_tiny(model_name):
-        return TINY_K3_UNET, TINY_T5, cfgs.TINY_VAE, 64
-    return K3_UNET, T5Config(), cfgs.SD_VAE, 1024
+        return None
+    d = model_dir_for(model_name)
+    if d is None:
+        return None
+    try:
+        ucfg, unet, mcfg, movq, tcfg, t5 = convert_k3_checkpoint(d)
+    except (FileNotFoundError, OSError):
+        return None
+    except Exception as e:
+        raise MissingWeightsError(
+            f"checkpoint under {d} could not be converted for "
+            f"'{model_name}': {e}"
+        ) from e
+    return {
+        "unet_cfg": ucfg, "unet": unet,
+        "movq_cfg": mcfg, "movq": movq,
+        "t5_cfg": tcfg, "t5": t5,
+        "model_dir": d,
+    }
 
 
 class Kandinsky3Pipeline:
     """Resident single-stage pipeline serving Kandinsky3Pipeline wire
-    names (txt2img; img2img arrives as noised init latents)."""
+    names (txt2img; img2img starts from MoVQ-encoded noised latents)."""
 
     def __init__(self, model_name: str, chipset=None,
                  allow_random_init: bool = False):
-        require_weights_present(
-            model_name, None, allow_random_init, component="Kandinsky 3",
-            hint=_NO_CONVERSION_HINT,
-        )
+        converted = _load_converted_k3(model_name)
+        if converted is None:
+            require_weights_present(
+                model_name, model_dir_for(model_name), allow_random_init,
+                component="Kandinsky 3", hint=_NO_CONVERSION_HINT,
+            )
         self.model_name = model_name
         self.chipset = chipset
-        unet_cfg, t5_cfg, vae_cfg, self.default_size = _configs(model_name)
+        if converted is not None:
+            unet_cfg = converted["unet_cfg"]
+            movq_cfg = converted["movq_cfg"]
+            t5_cfg = converted["t5_cfg"]
+            self.default_size = 1024
+        elif _is_tiny(model_name):
+            unet_cfg, movq_cfg, t5_cfg = TINY_K3_UNET, TINY_MOVQ, TINY_T5
+            self.default_size = 64
+        else:  # allow_random_init bench path at real geometry
+            unet_cfg, movq_cfg, t5_cfg = (
+                K3UNetConfig(), MoVQConfig(), T5Config()
+            )
+            self.default_size = 1024
         on_tpu = jax.default_backend() == "tpu"
         self.dtype = jnp.bfloat16 if on_tpu else jnp.float32
-        self.unet = UNet2DConditionModel(unet_cfg, dtype=self.dtype)
+        self.unet = Kandinsky3UNet(unet_cfg, dtype=self.dtype)
         self.t5 = T5Encoder(t5_cfg, dtype=self.dtype)
-        self.vae = AutoencoderKL(vae_cfg, dtype=self.dtype)
-        self.latent_factor = 2 ** (len(vae_cfg.block_out_channels) - 1)
+        self.movq = MoVQ(movq_cfg, dtype=self.dtype)
+        self.vae = self.movq  # common.encode_init_image's codec handle
+        self.latent_factor = 2 ** (len(movq_cfg.block_out_channels) - 1)
         from .flux import _load_t5_tokenizer
 
-        self.tokenizer = _load_t5_tokenizer(None, t5_cfg.vocab_size)
+        self.tokenizer = _load_t5_tokenizer(
+            converted["model_dir"] if converted else None, t5_cfg.vocab_size
+        )
         self.mesh = (
             chipset.mesh() if chipset is not None else make_mesh(jax.devices()[:1])
         )
 
-        rng = jax.random.key(zlib.crc32(model_name.encode()))
+        params = (
+            {"unet": converted["unet"], "t5": converted["t5"],
+             "movq": converted["movq"]}
+            if converted is not None
+            else self._random_params(unet_cfg, t5_cfg)
+        )
+        if converted is not None:
+            from ..models.conversion import checked_converted
+
+            rng = jax.random.key(0)
+            hw = 2 ** (len(unet_cfg.block_out_channels) + 1)
+            checked_converted(
+                self.unet,
+                (jnp.zeros((1, hw, hw, unet_cfg.in_channels)),
+                 jnp.zeros((1,)),
+                 jnp.zeros((1, 4, unet_cfg.encoder_hid_dim)),
+                 jnp.ones((1, 4))),
+                converted["unet"], "kandinsky3 unet", rng,
+            )
+            # a stale/missing movq or text_encoder config.json would
+            # otherwise surface mid-job as an opaque XLA shape error
+            f = self.latent_factor
+            checked_converted(
+                self.movq, (jnp.zeros((1, 4 * f, 4 * f, 3)),),
+                converted["movq"], "kandinsky3 movq", rng,
+            )
+            checked_converted(
+                self.t5, (jnp.zeros((1, 4), jnp.int32),),
+                converted["t5"], "kandinsky3 text_encoder", rng,
+            )
+        cast = lambda x: jnp.asarray(x, self.dtype)
+        self.params = jax.device_put(
+            jax.tree_util.tree_map(cast, params), replicated(self.mesh)
+        )
+        self._programs: dict[tuple, callable] = {}
+        self._lock = threading.Lock()
+
+    def _random_params(self, unet_cfg, t5_cfg):
+        rng = jax.random.key(zlib.crc32(self.model_name.encode()))
         k1, k2, k3 = jax.random.split(rng, 3)
         n_down = len(unet_cfg.block_out_channels) - 1
-        hw = 2 ** max(n_down, 2)
+        hw = 2 ** max(n_down + 1, 3)
         with jax.default_device(jax.local_devices(backend="cpu")[0]):
             unet_params = self.unet.init(
                 k1,
                 jnp.zeros((1, hw, hw, unet_cfg.in_channels)),
                 jnp.zeros((1,)),
-                jnp.zeros((1, 16, unet_cfg.cross_attention_dim)),
+                jnp.zeros((1, 8, unet_cfg.encoder_hid_dim)),
+                jnp.ones((1, 8)),
             )["params"]
             t5_params = self.t5.init(
-                k2, jnp.zeros((1, 16), jnp.int32)
+                k2, jnp.zeros((1, 8), jnp.int32)
             )["params"]
-            vae_params = self.vae.init(
+            movq_params = self.movq.init(
                 k3,
                 jnp.zeros(
-                    (1, hw * self.latent_factor, hw * self.latent_factor, 3)
+                    (1, 4 * self.latent_factor, 4 * self.latent_factor, 3)
                 ),
             )["params"]
-        cast = lambda x: jnp.asarray(x, self.dtype)
-        self.params = jax.device_put(
-            jax.tree_util.tree_map(cast, {
-                "unet": unet_params, "t5": t5_params, "vae": vae_params
-            }),
-            replicated(self.mesh),
-        )
-        self._programs: dict[tuple, callable] = {}
-        self._lock = threading.Lock()
+        return {"unet": unet_params, "t5": t5_params, "movq": movq_params}
 
     def release(self):
         self.params = None
@@ -138,12 +227,13 @@ class Kandinsky3Pipeline:
         schedule = scheduler.schedule(steps)
         loop_start, loop_end = scheduler.loop_bounds(schedule, steps, t_start)
         unet = self.unet
-        vae = self.vae
+        movq = self.movq
         latent_c = unet.config.in_channels
 
-        def run(params, rng, context, guidance, image_latents):
-            """context [2B,S,D] rows [uncond | cond]; img2img starts from
-            the init image's latents noised to the strength level."""
+        def run(params, rng, context, context_mask, guidance, image_latents):
+            """context [2B,S,D] rows [uncond | cond]; context_mask [2B,S];
+            img2img starts from the init image's MoVQ latents noised to the
+            strength level."""
             noise0 = jax.random.normal(
                 rng, (batch, lh, lw, latent_c), jnp.float32
             )
@@ -168,6 +258,7 @@ class Kandinsky3Pipeline:
                     model_in,
                     jnp.broadcast_to(t, (2 * batch,)),
                     context,
+                    context_mask,
                 ).astype(jnp.float32)
                 pred_u, pred_c = jnp.split(pred, 2, axis=0)
                 pred = pred_u + guidance * (pred_c - pred_u)
@@ -182,9 +273,9 @@ class Kandinsky3Pipeline:
             (latents, _), _ = jax.lax.scan(
                 body, (latents, state), jnp.arange(loop_start, loop_end)
             )
-            pixels = vae.apply(
-                {"params": params["vae"]}, latents.astype(self.dtype),
-                method=vae.decode,
+            pixels = movq.apply(
+                {"params": params["movq"]}, latents.astype(self.dtype),
+                method=movq.decode,
             )
             return (
                 (pixels.astype(jnp.float32) + 1.0) * 127.5
@@ -213,7 +304,11 @@ class Kandinsky3Pipeline:
         kwargs.pop("chipset", None)
         kwargs.pop("pipeline_prior_type", None)  # K3 has no prior stage
         image = kwargs.pop("image", None)
-        from .common import clamp_strength, encode_init_image, img2img_t_start
+        from .common import (
+            clamp_strength,
+            encode_init_image,
+            img2img_t_start,
+        )
 
         strength = clamp_strength(kwargs.pop("strength", 0.75))
 
@@ -232,15 +327,27 @@ class Kandinsky3Pipeline:
         image_latents = jnp.zeros((1, 1, 1, 1), jnp.float32)
         if image is not None:
             image_latents = encode_init_image(
-                self, params["vae"], image, width, height, n_images,
+                self, params["movq"], image, width, height, n_images,
                 lh, lw, self.unet.config.in_channels,
             )
 
-        max_seq = 77
+        max_seq = MAX_TOKENS if not _is_tiny(self.model_name) else 16
         texts = [negative_prompt] * n_images + [prompt] * n_images
-        ids = jnp.asarray(np.asarray(self.tokenizer(texts, max_seq), np.int32))
+        tok = np.asarray(self.tokenizer(texts, max_seq), np.int32)
+        # 1-keep mask over non-pad positions (pad id 0 for T5 tokenizers);
+        # position 0 of an empty prompt keeps at least the EOS token
+        mask = (tok != 0).astype(np.float32)
+        mask[:, 0] = 1.0
+        ids = jnp.asarray(tok)
+        context_mask = jnp.asarray(mask)
         t0 = time.perf_counter()
-        context = self.t5.apply({"params": params["t5"]}, ids)
+        context = self.t5.apply(
+            {"params": params["t5"]}, ids, context_mask
+        )
+        # diffusers' encode_prompt zeroes padded positions before the UNet
+        # (the attention-pooling mean query would otherwise average in
+        # full-magnitude pad-position states)
+        context = context * context_mask[..., None].astype(context.dtype)
         timings["text_encode_s"] = round(time.perf_counter() - t0, 3)
 
         program = self._program(
@@ -248,8 +355,8 @@ class Kandinsky3Pipeline:
         )
         t0 = time.perf_counter()
         pixels = jax.block_until_ready(
-            program(params, rng, context, jnp.float32(guidance_scale),
-                    image_latents)
+            program(params, rng, context, context_mask,
+                    jnp.float32(guidance_scale), image_latents)
         )
         timings["denoise_decode_s"] = round(time.perf_counter() - t0, 3)
 

@@ -81,6 +81,6 @@ def model_dir_for(model_name: str):
 # (VERDICT r03 weak #7).
 UNCONVERTED_FAMILY_KEYWORDS = (
     "audioldm2",
-    "i2vgen", "kandinsky-3", "kandinsky3",
+    "i2vgen",
     "latent-upscaler",
 )

@@ -316,10 +316,20 @@ def test_initialize_check_skips_unservable_families():
     from chiaswarm_tpu.initialize import verify_local_model
 
     # families that STILL lack a conversion path skip (AudioLDM v1, Bark,
-    # zeroscope, K2.1, openpose and friends all convert as of round 4)
-    assert verify_local_model("stabilityai/stable-cascade") is None
-    assert verify_local_model("kandinsky-community/kandinsky-3") is None
-    assert verify_local_model("cvssp/audioldm2") is None
+    # zeroscope, K2.1, cascade, SVD, openpose and friends all convert as
+    # of round 4) — keep in lockstep with weights.UNCONVERTED_FAMILY_KEYWORDS
+    from chiaswarm_tpu.weights import UNCONVERTED_FAMILY_KEYWORDS
+
+    probe_names = {
+        "audioldm2": "cvssp/audioldm2",
+        "i2vgen": "ali-vilab/i2vgen-xl",
+        "kandinsky-3": "kandinsky-community/kandinsky-3",
+        "kandinsky3": "kandinsky-community/kandinsky3",
+        "latent-upscaler": "stabilityai/sd-x2-latent-upscaler",
+    }
+    for keyword in UNCONVERTED_FAMILY_KEYWORDS:
+        name = probe_names.get(keyword, f"acme/{keyword}")
+        assert verify_local_model(name) is None, keyword
 
 
 class TestVQATorchParity:

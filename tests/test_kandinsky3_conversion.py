@@ -1,0 +1,195 @@
+"""Kandinsky 3 conversion contract (VERDICT r03 missing #1, next #2).
+
+No diffusers in this environment, so the checkpoint side is the torch
+mirror in torch_unet_ref.py (Kandinsky3UNetT, exact diffusers key names):
+random torch init -> state dict -> convert -> flax forward must equal the
+torch forward. Config inference is pinned on the same state dict, and a
+full synthetic repo (UNet + MoVQ + T5) must pass `initialize --check`
+AND serve txt2img end-to-end with converted weights.
+"""
+
+import json
+import os
+import sys
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from chiaswarm_tpu.models.conversion import convert_kandinsky3_unet
+from chiaswarm_tpu.models.unet_kandinsky3 import (
+    TINY_K3_UNET,
+    Kandinsky3UNet,
+)
+
+sys.path.insert(0, os.path.dirname(__file__))
+
+torch = pytest.importorskip("torch")
+
+from torch_unet_ref import Kandinsky3UNetT  # noqa: E402
+
+
+def _state_numpy(module) -> dict:
+    return {k: v.detach().numpy() for k, v in module.state_dict().items()}
+
+
+@pytest.fixture(scope="module")
+def mirror():
+    torch.manual_seed(30)
+    m = Kandinsky3UNetT(TINY_K3_UNET)
+    m.eval()
+    return m
+
+
+def test_k3_config_inferred_from_checkpoint(mirror):
+    cfg, _ = convert_kandinsky3_unet(
+        _state_numpy(mirror),
+        {"attention_head_dim": TINY_K3_UNET.attention_head_dim,
+         "groups": TINY_K3_UNET.groups},
+    )
+    assert cfg == TINY_K3_UNET
+
+
+def test_k3_unet_torch_parity(mirror):
+    """Converted mirror weights drive the flax graph to the torch output —
+    validates the rename map, the ConvTranspose layout special-case, the
+    conditional group norms, masked attention, and the skip wiring."""
+    cfg, params = convert_kandinsky3_unet(
+        _state_numpy(mirror),
+        {"attention_head_dim": TINY_K3_UNET.attention_head_dim,
+         "groups": TINY_K3_UNET.groups},
+    )
+    rng = np.random.default_rng(31)
+    b, hw, s = 2, 16, 8
+    sample = rng.standard_normal((b, hw, hw, cfg.in_channels)).astype(
+        np.float32
+    )
+    t = np.asarray([3.0, 250.0], np.float32)
+    ctx = rng.standard_normal((b, s, cfg.encoder_hid_dim)).astype(np.float32)
+    mask = np.ones((b, s), np.float32)
+    mask[0, 5:] = 0.0  # ragged row exercises the mask path end-to-end
+
+    with torch.no_grad():
+        out_t = mirror(
+            torch.from_numpy(sample).permute(0, 3, 1, 2),
+            torch.from_numpy(t),
+            torch.from_numpy(ctx),
+            torch.from_numpy(mask),
+        ).permute(0, 2, 3, 1).numpy()
+
+    out_f = Kandinsky3UNet(cfg).apply(
+        {"params": params}, jnp.asarray(sample), jnp.asarray(t),
+        jnp.asarray(ctx), jnp.asarray(mask),
+    )
+    np.testing.assert_allclose(np.asarray(out_f), out_t, atol=2e-4, rtol=1e-3)
+
+
+def _t5_synth_state(params) -> dict:
+    """Invert convert_t5: flax T5Encoder params -> transformers
+    T5EncoderModel key layout."""
+    state = {
+        "shared.weight": np.asarray(params["token_embedding"]["embedding"]),
+        "encoder.final_layer_norm.weight": np.asarray(
+            params["final_norm"]["scale"]
+        ),
+    }
+    i = 0
+    while f"block_{i}" in params:
+        b = params[f"block_{i}"]
+        pre = f"encoder.block.{i}.layer"
+        state[f"{pre}.0.layer_norm.weight"] = np.asarray(
+            b["attn_norm"]["scale"]
+        )
+        for p in ("q", "k", "v", "o"):
+            state[f"{pre}.0.SelfAttention.{p}.weight"] = np.ascontiguousarray(
+                np.asarray(b["attention"][p]["kernel"]).T
+            )
+        if "relative_attention_bias" in b["attention"]:
+            state[f"{pre}.0.SelfAttention.relative_attention_bias.weight"] = (
+                np.asarray(b["attention"]["relative_attention_bias"])
+            )
+        state[f"{pre}.1.layer_norm.weight"] = np.asarray(b["ff_norm"]["scale"])
+        for p in ("wi_0", "wi_1", "wo"):
+            state[f"{pre}.1.DenseReluDense.{p}.weight"] = np.ascontiguousarray(
+                np.asarray(b[p]["kernel"]).T
+            )
+        i += 1
+    return state
+
+
+def test_full_k3_repo_check_and_pipeline(sdaas_root, tmp_path):
+    """A complete synthetic Kandinsky 3 repo — torch-mirror UNet,
+    synthetic MoVQ and FLAN-UL2-shaped T5 — passes `initialize --check`
+    AND serves a txt2img job through Kandinsky3Pipeline with converted
+    weights (reference swarm/test.py:130-147)."""
+    from safetensors.numpy import save_file
+
+    from test_kandinsky_conversion import MOVQ_SUBS, _synth_state
+    from chiaswarm_tpu.initialize import verify_local_model
+    from chiaswarm_tpu.models import movq as movq_mod
+    from chiaswarm_tpu.models.t5 import TINY_T5, T5Encoder
+    from chiaswarm_tpu.pipelines.kandinsky3 import Kandinsky3Pipeline
+    from chiaswarm_tpu.settings import Settings, save_settings
+
+    name = "kandinsky-community/kandinsky-3"
+    root = tmp_path / "models"
+    save_settings(Settings(model_root_dir=str(root)))
+    repo = root / name
+    torch.manual_seed(32)
+
+    (repo / "unet").mkdir(parents=True)
+    save_file(
+        _state_numpy(Kandinsky3UNetT(TINY_K3_UNET)),
+        str(repo / "unet" / "diffusion_pytorch_model.safetensors"),
+    )
+    (repo / "unet" / "config.json").write_text(json.dumps({
+        "attention_head_dim": TINY_K3_UNET.attention_head_dim,
+        "groups": TINY_K3_UNET.groups,
+    }))
+
+    movq = movq_mod.MoVQ(movq_mod.TINY_MOVQ)
+    mparams = movq.init(jax.random.key(33), jnp.zeros((1, 16, 16, 3)))[
+        "params"
+    ]
+    (repo / "movq").mkdir(parents=True)
+    flat = {}
+    for k, v in _synth_state(mparams, MOVQ_SUBS).items():
+        flat[k] = np.asarray(v)
+    save_file(
+        flat, str(repo / "movq" / "diffusion_pytorch_model.safetensors")
+    )
+    (repo / "movq" / "config.json").write_text(json.dumps({
+        "block_out_channels": list(movq_mod.TINY_MOVQ.block_out_channels),
+        "layers_per_block": movq_mod.TINY_MOVQ.layers_per_block,
+        "norm_num_groups": movq_mod.TINY_MOVQ.norm_num_groups,
+        "latent_channels": movq_mod.TINY_MOVQ.latent_channels,
+        "vq_embed_dim": movq_mod.TINY_MOVQ.vq_embed_dim,
+    }))
+
+    t5_params = T5Encoder(TINY_T5).init(
+        jax.random.key(34), jnp.zeros((1, 8), jnp.int32)
+    )["params"]
+    (repo / "text_encoder").mkdir(parents=True)
+    save_file(
+        _t5_synth_state(t5_params),
+        str(repo / "text_encoder" / "model.safetensors"),
+    )
+    (repo / "text_encoder" / "config.json").write_text(json.dumps({
+        "vocab_size": TINY_T5.vocab_size, "d_model": TINY_T5.d_model,
+        "d_kv": TINY_T5.d_kv, "num_heads": TINY_T5.num_heads,
+        "d_ff": TINY_T5.d_ff, "num_layers": TINY_T5.num_layers,
+    }))
+
+    report = verify_local_model(name, root)
+    assert report is not None
+    assert set(report) == {"unet", "movq", "text_encoder"}
+
+    pipe = Kandinsky3Pipeline(name)
+    images, cfg_out = pipe.run(
+        prompt="a red fox", height=64, width=64, num_inference_steps=2,
+        rng=jax.random.key(35),
+    )
+    assert len(images) == 1 and images[0].size == (64, 64)
+    assert cfg_out["pipeline"] == "Kandinsky3Pipeline"

@@ -1086,3 +1086,332 @@ class MotionModuleT(nn.Module):
         hidden = hidden.reshape(b, h, w, num_frames, c).permute(0, 3, 4, 1, 2)
         hidden = hidden.reshape(bf, c, h, w)
         return residual + hidden
+
+
+# --- Kandinsky 3 (models/unet_kandinsky3.py) ---
+
+
+class K3CondGroupNormT(nn.Module):
+    """Kandinsky3ConditionalGroupNorm: affine-free GroupNorm modulated by
+    SiLU->Linear of the time embedding (key `context_mlp.1`)."""
+
+    def __init__(self, groups, ch, context_dim):
+        super().__init__()
+        self.norm = nn.GroupNorm(groups, ch, affine=False)
+        self.context_mlp = nn.Sequential(
+            nn.SiLU(), nn.Linear(context_dim, 2 * ch)
+        )
+
+    def forward(self, x, temb):
+        ctx = self.context_mlp(temb)[:, :, None, None]
+        scale, shift = ctx.chunk(2, dim=1)
+        return self.norm(x) * (scale + 1.0) + shift
+
+
+class K3AttentionT(nn.Module):
+    """The bias-free Attention instance Kandinsky3 builds (out_dim-headed,
+    to_out as ModuleList so the key is `to_out.0`)."""
+
+    def __init__(self, query_dim, context_dim, head_dim, out_dim):
+        super().__init__()
+        self.heads = max(1, out_dim // head_dim)
+        self.head_dim = out_dim // self.heads
+        self.to_q = nn.Linear(query_dim, out_dim, bias=False)
+        self.to_k = nn.Linear(context_dim, out_dim, bias=False)
+        self.to_v = nn.Linear(context_dim, out_dim, bias=False)
+        self.to_out = nn.ModuleList([nn.Linear(out_dim, out_dim, bias=False)])
+
+    def forward(self, q_in, kv_in, mask=None):
+        b, n, _ = q_in.shape
+        s = kv_in.shape[1]
+        q = self.to_q(q_in).view(b, n, self.heads, self.head_dim).transpose(1, 2)
+        k = self.to_k(kv_in).view(b, s, self.heads, self.head_dim).transpose(1, 2)
+        v = self.to_v(kv_in).view(b, s, self.heads, self.head_dim).transpose(1, 2)
+        logits = (q @ k.transpose(-1, -2)) * self.head_dim ** -0.5
+        if mask is not None:
+            logits = logits.masked_fill(
+                ~(mask[:, None, None, :] != 0), float(-1e9)
+            )
+        out = logits.softmax(dim=-1) @ v
+        out = out.transpose(1, 2).reshape(b, n, -1)
+        return self.to_out[0](out)
+
+
+class K3AttentionPoolingT(nn.Module):
+    def __init__(self, num_ch, context_dim, head_dim):
+        super().__init__()
+        self.attention = K3AttentionT(context_dim, context_dim, head_dim, num_ch)
+
+    def forward(self, x, context, mask=None):
+        pooled = self.attention(
+            context.mean(dim=1, keepdim=True), context, mask
+        )
+        return x + pooled.squeeze(1)
+
+
+class K3SubBlockT(nn.Module):
+    """Kandinsky3Block: cond-norm -> silu -> (transposed up) -> conv ->
+    (strided down)."""
+
+    def __init__(self, in_ch, out_ch, temb_dim, kernel, groups, up_resolution):
+        super().__init__()
+        self.group_norm = K3CondGroupNormT(groups, in_ch, temb_dim)
+        self.activation = nn.SiLU()
+        self.up_sample = (
+            nn.ConvTranspose2d(in_ch, in_ch, 2, 2)
+            if up_resolution is True
+            else nn.Identity()
+        )
+        self.projection = nn.Conv2d(
+            in_ch, out_ch, kernel, padding=int(kernel > 1)
+        )
+        self.down_sample = (
+            nn.Conv2d(out_ch, out_ch, 2, 2)
+            if up_resolution is False
+            else nn.Identity()
+        )
+
+    def forward(self, x, temb):
+        x = self.group_norm(x, temb)
+        x = self.activation(x)
+        x = self.up_sample(x)
+        x = self.projection(x)
+        return self.down_sample(x)
+
+
+class K3ResNetBlockT(nn.Module):
+    def __init__(self, in_ch, out_ch, temb_dim, groups, compression,
+                 up_resolutions=(None, None, None, None)):
+        super().__init__()
+        kernels = (1, 3, 3, 1)
+        hidden = max(in_ch, out_ch) // compression
+        pairs = [(in_ch, hidden), (hidden, hidden), (hidden, hidden),
+                 (hidden, out_ch)]
+        self.resnet_blocks = nn.ModuleList([
+            K3SubBlockT(i, o, temb_dim, k, groups, u)
+            for (i, o), k, u in zip(pairs, kernels, up_resolutions)
+        ])
+        self.shortcut_up_sample = (
+            nn.ConvTranspose2d(in_ch, in_ch, 2, 2)
+            if True in up_resolutions
+            else nn.Identity()
+        )
+        self.shortcut_projection = (
+            nn.Conv2d(in_ch, out_ch, 1) if in_ch != out_ch else nn.Identity()
+        )
+        self.shortcut_down_sample = (
+            nn.Conv2d(out_ch, out_ch, 2, 2)
+            if False in up_resolutions
+            else nn.Identity()
+        )
+
+    def forward(self, x, temb):
+        out = x
+        for blk in self.resnet_blocks:
+            out = blk(out, temb)
+        x = self.shortcut_up_sample(x)
+        x = self.shortcut_projection(x)
+        x = self.shortcut_down_sample(x)
+        return x + out
+
+
+class K3AttentionBlockT(nn.Module):
+    def __init__(self, ch, temb_dim, context_dim=None, groups=32,
+                 head_dim=64, expansion=4):
+        super().__init__()
+        self.in_norm = K3CondGroupNormT(groups, ch, temb_dim)
+        self.attention = K3AttentionT(ch, context_dim or ch, head_dim, ch)
+        self.out_norm = K3CondGroupNormT(groups, ch, temb_dim)
+        self.feed_forward = nn.Sequential(
+            nn.Conv2d(ch, expansion * ch, 1, bias=False),
+            nn.SiLU(),
+            nn.Conv2d(expansion * ch, ch, 1, bias=False),
+        )
+
+    def forward(self, x, temb, context=None, mask=None):
+        b, c, h, w = x.shape
+        out = self.in_norm(x, temb)
+        tokens = out.reshape(b, c, h * w).permute(0, 2, 1)
+        kv = context if context is not None else tokens
+        attn = self.attention(tokens, kv, mask if context is not None else None)
+        x = x + attn.permute(0, 2, 1).reshape(b, c, h, w)
+        out = self.out_norm(x, temb)
+        return x + self.feed_forward(out)
+
+
+class K3DownBlockT(nn.Module):
+    def __init__(self, cfg, in_ch, out_ch, cross, self_attention, down_sample):
+        super().__init__()
+        temb = cfg.time_embedding_dim
+        nb = cfg.layers_per_block
+        attentions = [
+            K3AttentionBlockT(in_ch, temb, None, cfg.groups,
+                              cfg.attention_head_dim, cfg.expansion_ratio)
+            if self_attention else nn.Identity()
+        ]
+        resnets_in, resnets_out = [], []
+        for j in range(nb):
+            ic = in_ch if j == 0 else out_ch
+            resnets_in.append(
+                K3ResNetBlockT(ic, out_ch, temb, cfg.groups,
+                               cfg.compression_ratio)
+            )
+            attentions.append(
+                K3AttentionBlockT(out_ch, temb, cfg.cross_attention_dim,
+                                  cfg.groups, cfg.attention_head_dim,
+                                  cfg.expansion_ratio)
+                if cross else nn.Identity()
+            )
+            up_res = (
+                (None, None, False, None)
+                if (j == nb - 1 and down_sample)
+                else (None, None, None, None)
+            )
+            resnets_out.append(
+                K3ResNetBlockT(out_ch, out_ch, temb, cfg.groups,
+                               cfg.compression_ratio, up_res)
+            )
+        self.attentions = nn.ModuleList(attentions)
+        self.resnets_in = nn.ModuleList(resnets_in)
+        self.resnets_out = nn.ModuleList(resnets_out)
+        self.cross = cross
+        self.self_attention = self_attention
+
+    def forward(self, x, temb, context, mask):
+        if self.self_attention:
+            x = self.attentions[0](x, temb)
+        for attn, rin, rout in zip(self.attentions[1:], self.resnets_in,
+                                   self.resnets_out):
+            x = rin(x, temb)
+            if self.cross:
+                x = attn(x, temb, context, mask)
+            x = rout(x, temb)
+        return x
+
+
+class K3UpBlockT(nn.Module):
+    def __init__(self, cfg, in_ch, cat_dim, out_ch, cross, self_attention,
+                 up_sample):
+        super().__init__()
+        temb = cfg.time_embedding_dim
+        nb = cfg.layers_per_block
+        pairs = (
+            [(in_ch + cat_dim, in_ch)]
+            + [(in_ch, in_ch)] * (nb - 2)
+            + [(in_ch, out_ch)]
+        )
+        attentions = [
+            K3AttentionBlockT(out_ch, temb, None, cfg.groups,
+                              cfg.attention_head_dim, cfg.expansion_ratio)
+            if self_attention else nn.Identity()
+        ]
+        resnets_in, resnets_out = [], []
+        for j, (ic, oc) in enumerate(pairs):
+            up_res = (
+                (None, True, None, None)
+                if (j == 0 and up_sample)
+                else (None, None, None, None)
+            )
+            resnets_in.append(
+                K3ResNetBlockT(ic, ic, temb, cfg.groups,
+                               cfg.compression_ratio, up_res)
+            )
+            attentions.append(
+                K3AttentionBlockT(ic, temb, cfg.cross_attention_dim,
+                                  cfg.groups, cfg.attention_head_dim,
+                                  cfg.expansion_ratio)
+                if cross else nn.Identity()
+            )
+            resnets_out.append(
+                K3ResNetBlockT(ic, oc, temb, cfg.groups,
+                               cfg.compression_ratio)
+            )
+        self.attentions = nn.ModuleList(attentions)
+        self.resnets_in = nn.ModuleList(resnets_in)
+        self.resnets_out = nn.ModuleList(resnets_out)
+        self.cross = cross
+        self.self_attention = self_attention
+
+    def forward(self, x, temb, context, mask):
+        for attn, rin, rout in zip(self.attentions[1:], self.resnets_in,
+                                   self.resnets_out):
+            x = rin(x, temb)
+            if self.cross:
+                x = attn(x, temb, context, mask)
+            x = rout(x, temb)
+        if self.self_attention:
+            x = self.attentions[0](x, temb)
+        return x
+
+
+class Kandinsky3UNetT(nn.Module):
+    """Torch mirror of diffusers Kandinsky3UNet with EXACT key names, so
+    convert_kandinsky3_unet consumes its state dict directly. Takes the
+    flax-side K3UNetConfig."""
+
+    def __init__(self, cfg):
+        super().__init__()
+        self.cfg = cfg
+        init_ch = cfg.block_out_channels[0] // 2
+        self.time_embedding = TimestepEmbeddingT(
+            init_ch, cfg.time_embedding_dim
+        )
+        self.add_time_condition = K3AttentionPoolingT(
+            cfg.time_embedding_dim, cfg.cross_attention_dim,
+            cfg.attention_head_dim,
+        )
+        self.conv_in = nn.Conv2d(
+            cfg.in_channels, init_ch, 3, padding=1
+        )
+        self.encoder_hid_proj = nn.Linear(
+            cfg.encoder_hid_dim, cfg.cross_attention_dim, bias=False
+        )
+        n = len(cfg.block_out_channels)
+        hidden_dims = (init_ch,) + tuple(cfg.block_out_channels)
+        downs = []
+        for i in range(n):
+            downs.append(K3DownBlockT(
+                cfg, hidden_dims[i], cfg.block_out_channels[i],
+                cfg.add_cross_attention[i], cfg.add_self_attention[i],
+                down_sample=i != n - 1,
+            ))
+        self.down_blocks = nn.ModuleList(downs)
+        ups = []
+        for lvl in range(n):
+            i = n - 1 - lvl
+            ups.append(K3UpBlockT(
+                cfg, cfg.block_out_channels[i],
+                cfg.block_out_channels[i] if lvl != 0 else 0,
+                hidden_dims[i],
+                cfg.add_cross_attention[i], cfg.add_self_attention[i],
+                up_sample=lvl != 0,
+            ))
+        self.up_blocks = nn.ModuleList(ups)
+        self.conv_norm_out = nn.GroupNorm(cfg.groups, init_ch)
+        self.conv_act_out = nn.SiLU()
+        self.conv_out = nn.Conv2d(init_ch, cfg.in_channels, 3, padding=1)
+
+    def forward(self, sample, timesteps, encoder_hidden_states, mask=None):
+        cfg = self.cfg
+        n = len(cfg.block_out_channels)
+        init_ch = cfg.block_out_channels[0] // 2
+        temb = self.time_embedding(
+            timestep_embedding_t(
+                timesteps, init_ch, flip_sin_to_cos=False, freq_shift=1.0
+            )
+        )
+        context = self.encoder_hid_proj(encoder_hidden_states)
+        temb = self.add_time_condition(temb, context, mask)
+        x = self.conv_in(sample)
+        skips = []
+        for i, down in enumerate(self.down_blocks):
+            x = down(x, temb, context, mask)
+            if i != n - 1:
+                skips.append(x)
+        for lvl, up in enumerate(self.up_blocks):
+            if lvl != 0:
+                x = torch.cat([x, skips.pop()], dim=1)
+            x = up(x, temb, context, mask)
+        x = self.conv_norm_out(x)
+        x = self.conv_act_out(x)
+        return self.conv_out(x)
