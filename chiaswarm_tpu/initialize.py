@@ -134,6 +134,11 @@ def verify_local_model(model_name: str, root: Path | None = None) -> dict | None
         return _verify_flux_model(model_name, root)
     if "kandinsky-3" in name or "kandinsky3" in name:
         return _verify_kandinsky3_model(model_name, root)
+    # only the latent-upscaler family routes here (registry.py keys); a
+    # broad "upscaler" match would check e.g. sd-x4-upscaler (a standard
+    # UNet2DConditionModel the SD family serves) against the K graph
+    if "latent-upscaler" in name or "tiny-upscaler" in name:
+        return _verify_upscaler_model(model_name, root)
     if "kandinsky" in name:
         return _verify_kandinsky_model(model_name, root)
     if "audioldm" in name:
@@ -155,6 +160,46 @@ def verify_local_model(model_name: str, root: Path | None = None) -> dict | None
     if "stable-video" in name or "svd" in name:
         return _verify_svd_model(model_name, root)
     return _verify_sd_model(model_name, root)
+
+
+def _verify_upscaler_model(model_name: str, root: Path) -> dict:
+    """SD-x2 latent upscaler repos: convert through the SAME recipe the
+    pipeline serves with (K-diffusion UNet + CLIP ViT-L + SD VAE)."""
+    import jax.numpy as jnp
+
+    from .models.clip import CLIPTextEncoder
+    from .models.conversion import assert_tree_shapes_match
+    from .models.k_upscaler import KUpscalerUNet
+    from .models.vae import AutoencoderKL
+    from .pipelines.upscale import convert_upscaler_checkpoint
+
+    model_dir = root / model_name
+    if not model_dir.is_dir():
+        raise FileNotFoundError(f"no checkpoint directory {model_dir}")
+    ucfg, unet, ccfg, text, vcfg, vae, _ = convert_upscaler_checkpoint(
+        model_dir
+    )
+    unet_exp = _eval_shape_params(
+        KUpscalerUNet(ucfg),
+        jnp.zeros((1, 8, 8, ucfg.in_channels)),
+        jnp.zeros((1,)),
+        jnp.zeros((1, 77, ucfg.cross_attention_dim)),
+        jnp.zeros((1, ucfg.time_cond_proj_dim)),
+    )
+    assert_tree_shapes_match(unet, unet_exp, prefix="unet")
+    text_exp = _eval_shape_params(
+        CLIPTextEncoder(ccfg), jnp.zeros((1, 77), jnp.int32)
+    )
+    assert_tree_shapes_match(text, text_exp, prefix="text_encoder")
+    vae_exp = _eval_shape_params(
+        AutoencoderKL(vcfg), jnp.zeros((1, 32, 32, 3))
+    )
+    assert_tree_shapes_match(vae, vae_exp, prefix="vae")
+    return {
+        "unet": _param_count(unet),
+        "text_encoder": _param_count(text),
+        "vae": _param_count(vae),
+    }
 
 
 def _verify_kandinsky3_model(model_name: str, root: Path) -> dict:

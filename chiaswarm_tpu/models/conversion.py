@@ -1959,7 +1959,7 @@ def infer_k3_unet_config(state: dict, config_json: dict | None = None):
             )
     n = max(blocks) + 1
     block_out = tuple(blocks[i] for i in range(n))
-    hid_w = np.asarray(state["encoder_hid_proj.weight"])
+    hid_w = np.asarray(state["encoder_hid_proj.projection_linear.weight"])
     # hidden bottleneck width of down level 0's first resnet reveals the
     # compression ratio: hidden = max(in, out) // ratio
     h0 = int(
@@ -2019,3 +2019,89 @@ def convert_kandinsky3_unet(state: dict, config_json: dict | None = None):
     for path, value in specials:
         _assign(params, path, value)
     return cfg, params
+
+
+# --- SD-x2 latent upscaler (models/k_upscaler.py) ---
+
+
+def k_upscaler_rename(name: str) -> str:
+    """diffusers K-UNet names -> models.k_upscaler names. The digit-merge
+    of torch_name_to_flax_path flattens the block lists; only the flat
+    time-embedding names and the frozen fourier weight need mapping."""
+    import re
+
+    if name == "time_proj.weight":
+        return "time_proj_weight"
+    name = name.replace("time_embedding.linear_1.", "time_embedding_linear_1.")
+    name = name.replace("time_embedding.linear_2.", "time_embedding_linear_2.")
+    name = name.replace("time_embedding.cond_proj.", "time_embedding_cond_proj.")
+    name = re.sub(
+        r"(down_blocks|up_blocks)\.(\d+)\.(resnets|attentions)\.(\d+)\.",
+        r"\1_\2_\3_\4.",
+        name,
+    )
+    return name
+
+
+def infer_k_upscaler_config(state: dict, config_json: dict | None = None):
+    """KUpscalerConfig from the checkpoint itself (self/cross attention
+    placement from attn1/attn2 key presence, q/k/v bias from bias keys;
+    head dim and group size from config.json, defaults 64/32)."""
+    import re
+
+    from .k_upscaler import KUpscalerConfig
+
+    cj = config_json or {}
+    blocks: dict[int, int] = {}
+    layers = 1
+    cross: set[int] = set()
+    down_self: set[int] = set()
+    up_self: set[int] = set()
+    for k in state:
+        m = re.match(r"down_blocks\.(\d+)\.resnets\.(\d+)\.conv1\.weight", k)
+        if m:
+            blocks[int(m.group(1))] = int(np.asarray(state[k]).shape[0])
+            layers = max(layers, int(m.group(2)) + 1)
+        m = re.match(r"down_blocks\.(\d+)\.attentions\.0\.attn2\.to_q\.", k)
+        if m:
+            cross.add(int(m.group(1)))
+        m = re.match(r"down_blocks\.(\d+)\.attentions\.0\.attn1\.to_q\.", k)
+        if m:
+            down_self.add(int(m.group(1)))
+        m = re.match(r"up_blocks\.(\d+)\.attentions\.0\.attn1\.to_q\.", k)
+        if m:
+            up_self.add(int(m.group(1)))
+    n = max(blocks) + 1
+    first = min(cross) if cross else 1
+    cross_dim = int(
+        np.asarray(
+            state[f"down_blocks.{first}.attentions.0.attn2.to_k.weight"]
+        ).shape[1]
+    )
+    group_size = int(
+        cj.get("resnet_group_size") or cj.get("norm_num_groups") or 32
+    )
+    return KUpscalerConfig(
+        in_channels=int(np.asarray(state["conv_in.weight"]).shape[1]),
+        out_channels=int(np.asarray(state["conv_out.weight"]).shape[0]),
+        block_out_channels=tuple(blocks[i] for i in range(n)),
+        layers_per_block=layers,
+        cross_attention_dim=cross_dim,
+        attention_head_dim=int(cj.get("attention_head_dim", 64)),
+        resnet_group_size=group_size,
+        time_cond_proj_dim=int(
+            np.asarray(state["time_embedding.cond_proj.weight"]).shape[1]
+        ),
+        cross_attention=tuple(i in cross for i in range(n)),
+        down_self_attention=tuple(i in down_self for i in range(n)),
+        up_self_attention=tuple(i in up_self for i in range(n)),
+        attention_bias=any(
+            k.endswith("attn2.to_q.bias") for k in state
+        ),
+    )
+
+
+def convert_k_upscaler(state: dict, config_json: dict | None = None):
+    """-> (KUpscalerConfig, params)."""
+    cfg = infer_k_upscaler_config(state, config_json)
+    return cfg, convert_state_dict(state, k_upscaler_rename)

@@ -149,6 +149,24 @@ class K3Attention(nn.Module):
         )(out)
 
 
+class K3EncoderProj(nn.Module):
+    """diffusers Kandinsky3EncoderProj: bias-free Linear + LayerNorm over
+    the T5 states before they condition anything."""
+
+    cross_attention_dim: int
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x):
+        x = nn.Dense(
+            self.cross_attention_dim, use_bias=False, dtype=self.dtype,
+            name="projection_linear",
+        )(x)
+        return nn.LayerNorm(
+            epsilon=1e-5, dtype=self.dtype, name="projection_norm"
+        )(x)
+
+
 class K3AttentionPooling(nn.Module):
     """Mean-of-context query attends over the context; the pooled vector
     adds onto the time embedding (diffusers Kandinsky3AttentionPooling)."""
@@ -401,8 +419,8 @@ class Kandinsky3UNet(nn.Module):
             cfg.time_embedding_dim, dtype=self.dtype, name="time_embedding"
         )(temb_in)
 
-        context = nn.Dense(
-            cfg.cross_attention_dim, use_bias=False, dtype=self.dtype,
+        context = K3EncoderProj(
+            cfg.cross_attention_dim, dtype=self.dtype,
             name="encoder_hid_proj",
         )(jnp.asarray(encoder_hidden_states, self.dtype))
         temb = K3AttentionPooling(

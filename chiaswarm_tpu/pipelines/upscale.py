@@ -5,17 +5,23 @@ Reference behavior replaced: swarm/post_processors/upscale.py:5-36 loads
 steps on the decoded images; swarm/diffusion/diffusion_func.py:163 chains
 it after the main/refiner/decoder stages whenever the job sets `upscale`.
 
-TPU redesign: a resident jitted program. The input image VAE-encodes to
-latents, the latents nearest-upsample 2x as the conditioning half of an
-8-channel UNet input (noise latents + image latents, the latent-upscaler
-conditioning scheme), a `lax.scan` runs the Euler solver unguided
-(reference passes guidance_scale=0), and the decode happens at 2x inside
-the same program — the handoff never leaves the device between encode and
-final pixels.
+TPU redesign: a resident jitted program around the TRUE architecture
+(models/k_upscaler.py — the K-diffusion upscaler UNet). The input image
+VAE-encodes to scaled latents, the latents nearest-upsample 2x as the
+conditioning half of the 8-channel UNet input, a `lax.scan` runs the
+denoised-sample Euler solver unguided (reference passes guidance_scale=0)
+with the pipeline's exact conditioning — continuous log(sigma)/4
+timesteps, and a 896-d timestep condition of [fixed 64 ones | 64 zeros |
+CLIP pooler output] — and the decode happens at 2x inside the same
+program. Real checkpoints convert at load (conversion.py
+convert_k_upscaler, geometry inferred from the checkpoint); the 5th
+output channel is dropped exactly as the diffusers pipeline drops it.
 """
 
 from __future__ import annotations
 
+import dataclasses
+import json
 import logging
 import threading
 import time
@@ -28,42 +34,29 @@ from PIL import Image
 
 from ..models import configs as cfgs
 from ..models.clip import CLIPTextEncoder
+from ..models.k_upscaler import (
+    TINY_K_UPSCALER,
+    KUpscalerConfig,
+    KUpscalerUNet,
+)
 from ..models.tokenizer import load_tokenizer
-from ..models.unet2d import UNet2DConditionModel, UNet2DConfig
 from ..models.vae import AutoencoderKL
 from ..parallel.mesh import make_mesh, replicated
 from ..registry import register_family
 from ..schedulers import get_scheduler
-from ..weights import is_test_model, require_weights_present
+from ..weights import (
+    MissingWeightsError,
+    is_test_model,
+    model_dir_for,
+    require_weights_present,
+)
 
 logger = logging.getLogger(__name__)
 
 _NO_CONVERSION_HINT = (
-    "This worker cannot serve real sd-x2-latent-upscaler weights yet; only "
-    "the test/tiny upscaler is available."
+    "No converted sd-x2-latent-upscaler checkpoint is present; download it "
+    "first (initialize --download) or use the test/tiny upscaler."
 )
-
-# noise latents + image latents concatenated on channels
-IN_CHANNELS = 8
-
-# sd-x2-latent-upscaler geometry (approximated; text tower is CLIP ViT-L)
-SDX2_UNET = UNet2DConfig(
-    in_channels=IN_CHANNELS,
-    block_out_channels=(384, 768, 1280, 1280),
-    transformer_layers=(1, 1, 1, 0),
-    num_attention_heads=(6, 12, 20, 20),
-    cross_attention_dim=768,
-)
-TINY_SDX2_UNET = UNet2DConfig(
-    in_channels=IN_CHANNELS,
-    block_out_channels=(32, 64),
-    transformer_layers=(1, 1),
-    mid_transformer_layers=1,
-    layers_per_block=1,
-    num_attention_heads=4,
-    cross_attention_dim=32,
-)
-
 
 _is_tiny = is_test_model
 
@@ -75,6 +68,83 @@ def upscaler_name_for(model_name: str) -> str:
     return "stabilityai/sd-x2-latent-upscaler"
 
 
+def convert_upscaler_checkpoint(model_dir):
+    """One sd-x2 repo conversion recipe ->
+    (unet_cfg, unet, clip_cfg, text, vae_cfg, vae, sched_json) — shared by
+    serving and `initialize --check`."""
+    from ..models.conversion import (
+        convert_clip,
+        convert_k_upscaler,
+        convert_vae,
+        infer_vae_config,
+        load_torch_state_dict,
+    )
+
+    def cfg_json(sub):
+        p = model_dir / sub / "config.json"
+        return json.loads(p.read_text()) if p.is_file() else {}
+
+    ucfg, unet = convert_k_upscaler(
+        load_torch_state_dict(model_dir, "unet"), cfg_json("unet")
+    )
+    text = convert_clip(load_torch_state_dict(model_dir, "text_encoder"))
+    tj = cfg_json("text_encoder")
+    clip_cfg = dataclasses.replace(
+        cfgs.SD15_CLIP,
+        vocab_size=int(tj.get("vocab_size", 49408)),
+        hidden_size=int(tj.get("hidden_size", 768)),
+        num_layers=int(tj.get("num_hidden_layers", 12)),
+        num_heads=int(tj.get("num_attention_heads", 12)),
+        hidden_act=str(tj.get("hidden_act", "quick_gelu")),
+        # the pipeline conditions on hidden_states[-1]: the last layer's
+        # output BEFORE the final LayerNorm (pooled still uses final LN)
+        hidden_state_index=-1,
+        apply_final_norm=False,
+    )
+    vae_state = load_torch_state_dict(model_dir, "vae")
+    vae_cfg = infer_vae_config(vae_state, cfg_json("vae"))
+    vae = convert_vae(vae_state)
+    p = model_dir / "scheduler" / "scheduler_config.json"
+    sched_json = json.loads(p.read_text()) if p.is_file() else {}
+    return ucfg, unet, clip_cfg, text, vae_cfg, vae, sched_json
+
+
+def _load_converted_upscaler(model_name: str):
+    if _is_tiny(model_name):
+        return None
+    d = model_dir_for(model_name)
+    if d is None:
+        return None
+    try:
+        ucfg, unet, ccfg, text, vcfg, vae, sj = convert_upscaler_checkpoint(d)
+    except (FileNotFoundError, OSError):
+        return None
+    except Exception as e:
+        raise MissingWeightsError(
+            f"checkpoint under {d} could not be converted for "
+            f"'{model_name}': {e}"
+        ) from e
+    return {
+        "unet_cfg": ucfg, "unet": unet, "clip_cfg": ccfg, "text": text,
+        "vae_cfg": vcfg, "vae": vae, "scheduler_json": sj, "model_dir": d,
+    }
+
+
+# the pipeline's fixed noise-level embedding: noise_level=0 ->
+# [ones(half) | zeros(half)], concatenated before the CLIP pooler output
+def _timestep_condition(cond_dim: int, pooled):
+    b, pw = pooled.shape
+    half = (cond_dim - pw) // 2
+    return jnp.concatenate(
+        [
+            jnp.ones((b, half), pooled.dtype),
+            jnp.zeros((b, cond_dim - pw - half), pooled.dtype),
+            pooled,
+        ],
+        axis=-1,
+    )
+
+
 class LatentUpscalePipeline:
     """Resident 2x latent upscaler serving the
     StableDiffusionLatentUpscalePipeline wire name, standalone or chained
@@ -82,21 +152,39 @@ class LatentUpscalePipeline:
 
     def __init__(self, model_name: str, chipset=None,
                  allow_random_init: bool = False):
-        require_weights_present(
-            model_name, None, allow_random_init, component="latent upscaler",
-            hint=_NO_CONVERSION_HINT,
-        )
+        converted = _load_converted_upscaler(model_name)
+        if converted is None:
+            require_weights_present(
+                model_name, model_dir_for(model_name), allow_random_init,
+                component="latent upscaler", hint=_NO_CONVERSION_HINT,
+            )
         self.model_name = model_name
         self.chipset = chipset
-        if _is_tiny(model_name):
+        if converted is not None:
+            unet_cfg = converted["unet_cfg"]
+            clip_cfg = converted["clip_cfg"]
+            vae_cfg = converted["vae_cfg"]
+            self.scheduler_json = converted["scheduler_json"]
+        elif _is_tiny(model_name):
             unet_cfg, clip_cfg, vae_cfg = (
-                TINY_SDX2_UNET, cfgs.TINY_CLIP, cfgs.TINY_VAE
+                TINY_K_UPSCALER,
+                dataclasses.replace(cfgs.TINY_CLIP, apply_final_norm=False),
+                cfgs.TINY_VAE,
             )
-        else:
-            unet_cfg, clip_cfg, vae_cfg = SDX2_UNET, cfgs.SD15_CLIP, cfgs.SD_VAE
+            self.scheduler_json = {}
+        else:  # bench path at real geometry
+            unet_cfg, clip_cfg, vae_cfg = (
+                KUpscalerConfig(),
+                dataclasses.replace(
+                    cfgs.SD15_CLIP, hidden_state_index=-1,
+                    apply_final_norm=False,
+                ),
+                cfgs.SD_VAE,
+            )
+            self.scheduler_json = {}
         on_tpu = jax.default_backend() == "tpu"
         self.dtype = jnp.bfloat16 if on_tpu else jnp.float32
-        self.unet = UNet2DConditionModel(unet_cfg, dtype=self.dtype)
+        self.unet = KUpscalerUNet(unet_cfg, dtype=self.dtype)
         self.text_encoder = CLIPTextEncoder(clip_cfg, dtype=self.dtype)
         self.tokenizer = load_tokenizer(None, vocab_size=clip_cfg.vocab_size)
         self.vae = AutoencoderKL(vae_cfg, dtype=self.dtype)
@@ -105,16 +193,55 @@ class LatentUpscalePipeline:
             chipset.mesh() if chipset is not None else make_mesh(jax.devices()[:1])
         )
 
-        rng = jax.random.key(zlib.crc32(model_name.encode()))
+        if converted is not None:
+            from ..models.conversion import checked_converted
+
+            rng = jax.random.key(0)
+            checked_converted(
+                self.unet,
+                (jnp.zeros((1, 8, 8, unet_cfg.in_channels)),
+                 jnp.zeros((1,)),
+                 jnp.zeros((1, 77, unet_cfg.cross_attention_dim)),
+                 jnp.zeros((1, unet_cfg.time_cond_proj_dim))),
+                converted["unet"], "upscaler unet", rng,
+            )
+            # stale text_encoder/vae config.jsons would otherwise surface
+            # mid-job as opaque XLA shape errors
+            checked_converted(
+                self.text_encoder, (jnp.zeros((1, 77), jnp.int32),),
+                converted["text"], "upscaler text_encoder", rng,
+            )
+            f = self.latent_factor
+            checked_converted(
+                self.vae, (jnp.zeros((1, 4 * f, 4 * f, 3)),),
+                converted["vae"], "upscaler vae", rng,
+            )
+            params = {
+                "unet": converted["unet"],
+                "text": converted["text"],
+                "vae": converted["vae"],
+            }
+        else:
+            params = self._random_params(unet_cfg, clip_cfg, vae_cfg)
+        cast = lambda x: jnp.asarray(x, self.dtype)
+        self.params = jax.device_put(
+            jax.tree_util.tree_map(cast, params), replicated(self.mesh)
+        )
+        self._programs: dict[tuple, callable] = {}
+        self._lock = threading.Lock()
+
+    def _random_params(self, unet_cfg, clip_cfg, vae_cfg):
+        rng = jax.random.key(zlib.crc32(self.model_name.encode()))
         k1, k2, k3 = jax.random.split(rng, 3)
         n_down = len(unet_cfg.block_out_channels) - 1
         hw = 2 ** max(n_down, 2)
         with jax.default_device(jax.local_devices(backend="cpu")[0]):
             unet_params = self.unet.init(
                 k1,
-                jnp.zeros((1, hw, hw, IN_CHANNELS)),
+                jnp.zeros((1, hw, hw, unet_cfg.in_channels)),
                 jnp.zeros((1,)),
                 jnp.zeros((1, 77, unet_cfg.cross_attention_dim)),
+                jnp.zeros((1, unet_cfg.time_cond_proj_dim)),
             )["params"]
             text_params = self.text_encoder.init(
                 k2, jnp.zeros((1, 77), jnp.int32)
@@ -125,63 +252,75 @@ class LatentUpscalePipeline:
                     (1, hw * self.latent_factor, hw * self.latent_factor, 3)
                 ),
             )["params"]
-        cast = lambda x: jnp.asarray(x, self.dtype)
-        self.params = jax.device_put(
-            jax.tree_util.tree_map(cast, {
-                "unet": unet_params,
-                "text": text_params,
-                "vae": vae_params,
-            }),
-            replicated(self.mesh),
-        )
-        self._programs: dict[tuple, callable] = {}
-        self._lock = threading.Lock()
+        return {"unet": unet_params, "text": text_params, "vae": vae_params}
 
     def release(self):
         self.params = None
         self._programs.clear()
+
+    def _scheduler(self):
+        """EulerDiscrete in denoised-sample prediction, geometry from the
+        shipped scheduler_config.json when a real checkpoint is resident."""
+        sj = self.scheduler_json
+        kw = {"prediction_type": str(sj.get("prediction_type", "sample"))}
+        for field in ("beta_start", "beta_end"):
+            if field in sj:
+                kw[field] = float(sj[field])
+        if "beta_schedule" in sj:
+            kw["beta_schedule"] = str(sj["beta_schedule"])
+        if "num_train_timesteps" in sj:
+            kw["num_train_timesteps"] = int(sj["num_train_timesteps"])
+        return get_scheduler("EulerDiscreteScheduler", **kw)
 
     def _program(self, key: tuple):
         with self._lock:
             if key in self._programs:
                 return self._programs[key]
         lh, lw, batch, steps = key  # INPUT latent dims; output is 2x
-        scheduler = get_scheduler("EulerDiscreteScheduler")
+        scheduler = self._scheduler()
         schedule = scheduler.schedule(steps)
         unet = self.unet
         vae = self.vae
         latent_c = self.vae.config.latent_channels
+        cond_dim = self.unet.config.time_cond_proj_dim
         # the 2x decode has 4x the activation footprint of a base decode —
         # chunk it per-image on big canvases (same guard as SDPipeline;
         # batch 4 x 1024^2 OOM'd a v5e chip in round 1)
         big_decode = (2 * lh) * (2 * lw) >= 9216 and batch >= 2
 
-        def run(params, rng, pixels, context):
+        def run(params, rng, pixels, context, pooled):
             """pixels [B,H,W,3] in [-1,1]; unguided (reference passes
             guidance_scale=0 at upscale.py:31)."""
             image_latents = vae.apply(
                 {"params": params["vae"]}, pixels.astype(self.dtype),
                 method=vae.encode,
             ).astype(jnp.float32)
+            # noise_level=0: inv_noise_level = 1, so the conditioning half
+            # is exactly the nearest-2x latents
             cond = jax.image.resize(
                 image_latents, (batch, 2 * lh, 2 * lw, latent_c), "nearest"
             )
+            timestep_cond = _timestep_condition(cond_dim, pooled)
             latents = jax.random.normal(
                 rng, (batch, 2 * lh, 2 * lw, latent_c), jnp.float32
             ) * jnp.asarray(schedule.init_noise_sigma, jnp.float32)
             state = scheduler.init_state(latents.shape, latents.dtype)
+            sigmas = jnp.asarray(schedule.sigmas, jnp.float32)
 
             def body(carry, i):
                 latents, state = carry
                 inp = scheduler.scale_model_input(schedule, latents, i)
                 model_in = jnp.concatenate([inp, cond], axis=-1)
-                t = jnp.asarray(schedule.timesteps)[i]
+                # continuous K-diffusion timestep: log(sigma)/4
+                t = jnp.log(sigmas[i]) * 0.25
                 pred = unet.apply(
                     {"params": params["unet"]},
                     model_in.astype(self.dtype),
                     jnp.broadcast_to(t, (batch,)),
                     context,
+                    timestep_cond,
                 ).astype(jnp.float32)
+                pred = pred[..., : latent_c]  # 5th channel dropped
                 noise = jax.random.normal(
                     jax.random.fold_in(rng, i), latents.shape, jnp.float32
                 )
@@ -238,15 +377,17 @@ class LatentUpscalePipeline:
                 for img in images
             ]) / 127.5 - 1.0
         )
-        # unguided: the prompt still conditions via cross-attention, one row
+        # unguided: the prompt still conditions via cross-attention and the
+        # pooled timestep condition, one row per image
         ids = jnp.asarray(self.tokenizer([prompt] * batch))
-        context = self.text_encoder.apply(
-            {"params": params["text"]}, ids
-        )["hidden_states"]
+        out = self.text_encoder.apply({"params": params["text"]}, ids)
+        context, pooled = out["hidden_states"], out["pooled"]
         program = self._program(
             (h // self.latent_factor, w // self.latent_factor, batch, steps)
         )
-        out = jax.block_until_ready(program(params, rng, pixels, context))
+        out = jax.block_until_ready(
+            program(params, rng, pixels, context, pooled)
+        )
         return [Image.fromarray(img) for img in np.asarray(out)]
 
     def run(self, prompt="", negative_prompt="",

@@ -82,5 +82,4 @@ def model_dir_for(model_name: str):
 UNCONVERTED_FAMILY_KEYWORDS = (
     "audioldm2",
     "i2vgen",
-    "latent-upscaler",
 )

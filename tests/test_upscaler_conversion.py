@@ -1,0 +1,170 @@
+"""SD-x2 latent upscaler conversion contract (VERDICT r03 missing #1).
+
+The checkpoint side is the torch mirror in torch_unet_ref.py
+(KUpscalerUNetT, exact diffusers key names): random torch init -> state
+dict -> convert -> flax forward must equal the torch forward, including
+the Gaussian-Fourier time path, the 896-d timestep condition, AdaGroupNorm
+modulation, fixed blur down/upsampling, and the K-UNet skip wiring. A full
+synthetic repo (UNet + CLIP + VAE) must pass `initialize --check` and
+serve a 2x upscale end-to-end with converted weights.
+"""
+
+import json
+import os
+import sys
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from chiaswarm_tpu.models.conversion import convert_k_upscaler
+from chiaswarm_tpu.models.k_upscaler import (
+    TINY_K_UPSCALER,
+    KUpscalerUNet,
+)
+
+sys.path.insert(0, os.path.dirname(__file__))
+
+torch = pytest.importorskip("torch")
+
+from torch_unet_ref import KUpscalerUNetT  # noqa: E402
+
+
+def _state_numpy(module) -> dict:
+    return {k: v.detach().numpy() for k, v in module.state_dict().items()}
+
+
+@pytest.fixture(scope="module")
+def mirror():
+    torch.manual_seed(50)
+    m = KUpscalerUNetT(TINY_K_UPSCALER)
+    m.eval()
+    return m
+
+
+def test_k_upscaler_config_inferred(mirror):
+    cfg, _ = convert_k_upscaler(
+        _state_numpy(mirror),
+        {"attention_head_dim": TINY_K_UPSCALER.attention_head_dim,
+         "resnet_group_size": TINY_K_UPSCALER.resnet_group_size},
+    )
+    assert cfg == TINY_K_UPSCALER
+
+
+def test_k_upscaler_torch_parity(mirror):
+    cfg, params = convert_k_upscaler(
+        _state_numpy(mirror),
+        {"attention_head_dim": TINY_K_UPSCALER.attention_head_dim,
+         "resnet_group_size": TINY_K_UPSCALER.resnet_group_size},
+    )
+    rng = np.random.default_rng(51)
+    b, hw, s = 2, 16, 7
+    sample = rng.standard_normal((b, hw, hw, cfg.in_channels)).astype(
+        np.float32
+    )
+    # continuous K-diffusion timesteps (log-sigma scale, can be negative)
+    t = np.asarray([-0.55, 0.6], np.float32)
+    ctx = rng.standard_normal((b, s, cfg.cross_attention_dim)).astype(
+        np.float32
+    )
+    tcond = rng.standard_normal((b, cfg.time_cond_proj_dim)).astype(
+        np.float32
+    )
+
+    with torch.no_grad():
+        out_t = mirror(
+            torch.from_numpy(sample).permute(0, 3, 1, 2),
+            torch.from_numpy(t),
+            torch.from_numpy(ctx),
+            torch.from_numpy(tcond),
+        ).permute(0, 2, 3, 1).numpy()
+
+    out_f = KUpscalerUNet(cfg).apply(
+        {"params": params}, jnp.asarray(sample), jnp.asarray(t),
+        jnp.asarray(ctx), jnp.asarray(tcond),
+    )
+    np.testing.assert_allclose(np.asarray(out_f), out_t, atol=3e-4, rtol=1e-3)
+
+
+def test_full_upscaler_repo_check_and_pipeline(sdaas_root, tmp_path):
+    """A complete synthetic sd-x2 repo — torch-mirror K-UNet, a REAL
+    transformers CLIPTextModel state dict, torch-mirror VAE — passes
+    `initialize --check` AND serves a 2x upscale with converted weights
+    (reference swarm/post_processors/upscale.py:5-36)."""
+    from PIL import Image
+    from safetensors.numpy import save_file
+    from transformers import CLIPTextConfig as HFCLIPTextConfig
+    from transformers import CLIPTextModel
+
+    from torch_unet_ref import AutoencoderKLT
+
+    from chiaswarm_tpu.initialize import verify_local_model
+    from chiaswarm_tpu.models import configs as cfgs
+    from chiaswarm_tpu.pipelines.upscale import LatentUpscalePipeline
+    from chiaswarm_tpu.settings import Settings, save_settings
+
+    name = "stabilityai/sd-x2-latent-upscaler"
+    root = tmp_path / "models"
+    save_settings(Settings(model_root_dir=str(root)))
+    repo = root / name
+    torch.manual_seed(52)
+
+    (repo / "unet").mkdir(parents=True)
+    save_file(
+        _state_numpy(KUpscalerUNetT(TINY_K_UPSCALER)),
+        str(repo / "unet" / "diffusion_pytorch_model.safetensors"),
+    )
+    (repo / "unet" / "config.json").write_text(json.dumps({
+        "attention_head_dim": TINY_K_UPSCALER.attention_head_dim,
+        "resnet_group_size": TINY_K_UPSCALER.resnet_group_size,
+    }))
+
+    hf = HFCLIPTextConfig(
+        vocab_size=1000, hidden_size=32, num_hidden_layers=2,
+        num_attention_heads=4, intermediate_size=128,
+        max_position_embeddings=77, hidden_act="quick_gelu",
+    )
+    clip = CLIPTextModel(hf)
+    (repo / "text_encoder").mkdir(parents=True)
+    save_file(
+        {k: v.numpy() for k, v in clip.state_dict().items()},
+        str(repo / "text_encoder" / "model.safetensors"),
+    )
+    (repo / "text_encoder" / "config.json").write_text(json.dumps({
+        "vocab_size": 1000, "hidden_size": 32, "num_hidden_layers": 2,
+        "num_attention_heads": 4, "hidden_act": "quick_gelu",
+    }))
+
+    vae = AutoencoderKLT(cfgs.TINY_VAE)
+    (repo / "vae").mkdir(parents=True)
+    save_file(
+        _state_numpy(vae),
+        str(repo / "vae" / "diffusion_pytorch_model.safetensors"),
+    )
+    (repo / "vae" / "config.json").write_text(json.dumps({
+        "scaling_factor": 0.18215,
+    }))
+
+    (repo / "scheduler").mkdir(parents=True)
+    (repo / "scheduler" / "scheduler_config.json").write_text(json.dumps({
+        "prediction_type": "sample",
+        "beta_start": 0.0001,
+        "beta_end": 0.02,
+        "beta_schedule": "linear",
+    }))
+
+    report = verify_local_model(name, root)
+    assert report is not None
+    assert set(report) == {"unet", "text_encoder", "vae"}
+
+    pipe = LatentUpscalePipeline(name)
+    assert pipe.scheduler_json["prediction_type"] == "sample"
+    img = Image.fromarray(
+        (np.random.default_rng(53).random((64, 64, 3)) * 255).astype(
+            np.uint8
+        )
+    )
+    out = pipe.upscale([img], prompt="sharp", steps=2, rng=jax.random.key(54))
+    assert out[0].size == (128, 128)

@@ -1363,9 +1363,12 @@ class Kandinsky3UNetT(nn.Module):
         self.conv_in = nn.Conv2d(
             cfg.in_channels, init_ch, 3, padding=1
         )
-        self.encoder_hid_proj = nn.Linear(
+        proj = nn.Module()
+        proj.projection_linear = nn.Linear(
             cfg.encoder_hid_dim, cfg.cross_attention_dim, bias=False
         )
+        proj.projection_norm = nn.LayerNorm(cfg.cross_attention_dim)
+        self.encoder_hid_proj = proj
         n = len(cfg.block_out_channels)
         hidden_dims = (init_ch,) + tuple(cfg.block_out_channels)
         downs = []
@@ -1400,7 +1403,9 @@ class Kandinsky3UNetT(nn.Module):
                 timesteps, init_ch, flip_sin_to_cos=False, freq_shift=1.0
             )
         )
-        context = self.encoder_hid_proj(encoder_hidden_states)
+        context = self.encoder_hid_proj.projection_norm(
+            self.encoder_hid_proj.projection_linear(encoder_hidden_states)
+        )
         temb = self.add_time_condition(temb, context, mask)
         x = self.conv_in(sample)
         skips = []
@@ -1414,4 +1419,241 @@ class Kandinsky3UNetT(nn.Module):
             x = up(x, temb, context, mask)
         x = self.conv_norm_out(x)
         x = self.conv_act_out(x)
+        return self.conv_out(x)
+
+
+# --- SD-x2 latent upscaler (models/k_upscaler.py) ---
+
+
+class AdaGroupNormT(nn.Module):
+    """diffusers AdaGroupNorm (no act): affine-free GN, scale/shift from a
+    Linear of the time embedding (key `linear`)."""
+
+    def __init__(self, temb_dim, ch, groups):
+        super().__init__()
+        self.groups = groups
+        self.linear = nn.Linear(temb_dim, 2 * ch)
+
+    def forward(self, x, temb):
+        emb = self.linear(temb)[:, :, None, None]
+        scale, shift = emb.chunk(2, dim=1)
+        x = F.group_norm(x, self.groups, eps=1e-5)
+        return x * (1.0 + scale) + shift
+
+
+class KUpResnetT(nn.Module):
+    """diffusers ResnetBlockCondNorm2D with time_embedding_norm=ada_group,
+    gelu, conv_shortcut_bias=False."""
+
+    def __init__(self, in_ch, out_ch, temb_dim, group_size):
+        super().__init__()
+        self.norm1 = AdaGroupNormT(temb_dim, in_ch, max(1, in_ch // group_size))
+        self.conv1 = nn.Conv2d(in_ch, out_ch, 3, padding=1)
+        self.norm2 = AdaGroupNormT(temb_dim, out_ch, max(1, out_ch // group_size))
+        self.conv2 = nn.Conv2d(out_ch, out_ch, 3, padding=1)
+        self.nonlinearity = nn.GELU()
+        self.conv_shortcut = (
+            nn.Conv2d(in_ch, out_ch, 1, bias=False)
+            if in_ch != out_ch
+            else None
+        )
+
+    def forward(self, x, temb):
+        h = self.nonlinearity(self.norm1(x, temb))
+        h = self.conv1(h)
+        h = self.nonlinearity(self.norm2(h, temb))
+        h = self.conv2(h)
+        if self.conv_shortcut is not None:
+            x = self.conv_shortcut(x)
+        return x + h
+
+
+class KAttnT(nn.Module):
+    """The Attention instance K blocks build: optional q/k/v bias, to_out.0
+    with bias, norm_cross LayerNorm on encoder states."""
+
+    def __init__(self, dim, head_dim, context_dim=None, bias=True):
+        super().__init__()
+        self.heads = max(1, dim // head_dim)
+        self.head_dim = dim // self.heads
+        kv_dim = context_dim or dim
+        self.to_q = nn.Linear(dim, dim, bias=bias)
+        self.to_k = nn.Linear(kv_dim, dim, bias=bias)
+        self.to_v = nn.Linear(kv_dim, dim, bias=bias)
+        self.to_out = nn.ModuleList([nn.Linear(dim, dim)])
+        self.norm_cross = (
+            nn.LayerNorm(kv_dim) if context_dim is not None else None
+        )
+
+    def forward(self, q_in, kv_in):
+        if self.norm_cross is not None:
+            kv_in = self.norm_cross(kv_in)
+        b, n, _ = q_in.shape
+        s = kv_in.shape[1]
+        q = self.to_q(q_in).view(b, n, self.heads, self.head_dim).transpose(1, 2)
+        k = self.to_k(kv_in).view(b, s, self.heads, self.head_dim).transpose(1, 2)
+        v = self.to_v(kv_in).view(b, s, self.heads, self.head_dim).transpose(1, 2)
+        out = (q @ k.transpose(-1, -2) * self.head_dim ** -0.5).softmax(-1) @ v
+        out = out.transpose(1, 2).reshape(b, n, -1)
+        return self.to_out[0](out)
+
+
+class KUpAttnBlockT(nn.Module):
+    """diffusers KAttentionBlock: AdaGN -> (self attn1) -> AdaGN -> cross
+    attn2 over layer-normed encoder states, both residual."""
+
+    def __init__(self, ch, temb_dim, head_dim, context_dim, group_size,
+                 self_attention, bias=True):
+        super().__init__()
+        groups = max(1, ch // group_size)
+        self.add_self_attention = self_attention
+        if self_attention:
+            self.norm1 = AdaGroupNormT(temb_dim, ch, groups)
+            self.attn1 = KAttnT(ch, head_dim, None, bias)
+        self.norm2 = AdaGroupNormT(temb_dim, ch, groups)
+        self.attn2 = KAttnT(ch, head_dim, context_dim, bias)
+
+    def forward(self, x, temb, context):
+        b, c, h, w = x.shape
+        if self.add_self_attention:
+            tokens = self.norm1(x, temb).reshape(b, c, h * w).permute(0, 2, 1)
+            attn = self.attn1(tokens, tokens)
+            x = x + attn.permute(0, 2, 1).reshape(b, c, h, w)
+        tokens = self.norm2(x, temb).reshape(b, c, h * w).permute(0, 2, 1)
+        attn = self.attn2(tokens, context)
+        return x + attn.permute(0, 2, 1).reshape(b, c, h, w)
+
+
+class KDownsampleT(nn.Module):
+    """Fixed blur kernel — parameterless (buffer not in state_dict)."""
+
+    def forward(self, x):
+        k1 = torch.tensor([[1.0, 3.0, 3.0, 1.0]]) / 8.0
+        kernel = (k1.T @ k1).to(x)
+        x = F.pad(x, (1, 1, 1, 1), mode="reflect")
+        c = x.shape[1]
+        weight = x.new_zeros(c, c, 4, 4)
+        idx = torch.arange(c)
+        weight[idx, idx] = kernel
+        return F.conv2d(x, weight, stride=2)
+
+
+class KUpsampleT(nn.Module):
+    def forward(self, x):
+        k1 = torch.tensor([[1.0, 3.0, 3.0, 1.0]]) / 8.0 * 2.0
+        kernel = (k1.T @ k1).to(x)
+        x = F.pad(x, (1, 1, 1, 1), mode="reflect")
+        c = x.shape[1]
+        weight = x.new_zeros(c, c, 4, 4)
+        idx = torch.arange(c)
+        weight[idx, idx] = kernel
+        return F.conv_transpose2d(x, weight, stride=2, padding=3)
+
+
+class KUpscalerUNetT(nn.Module):
+    """Torch mirror of the sd-x2-latent-upscaler UNet with EXACT diffusers
+    key names, so convert_k_upscaler consumes its state dict directly.
+    Takes the flax-side KUpscalerConfig."""
+
+    def __init__(self, cfg):
+        super().__init__()
+        self.cfg = cfg
+        c0 = cfg.block_out_channels[0]
+        self.time_proj_weight = nn.Parameter(
+            torch.randn(c0) * 16.0, requires_grad=False
+        )
+        self.time_embedding = nn.ModuleDict({
+            "cond_proj": nn.Linear(cfg.time_cond_proj_dim, 2 * c0, bias=False),
+            "linear_1": nn.Linear(2 * c0, 2 * c0),
+            "linear_2": nn.Linear(2 * c0, 2 * c0),
+        })
+        self.conv_in = nn.Conv2d(cfg.in_channels, c0, 1)
+        n = len(cfg.block_out_channels)
+        temb_dim = 2 * c0
+        downs, ups = [], []
+        for i in range(n):
+            in_ch = cfg.block_out_channels[max(i - 1, 0)] if i else c0
+            out_ch = cfg.block_out_channels[i]
+            resnets, attns = [], []
+            for j in range(cfg.layers_per_block):
+                resnets.append(KUpResnetT(
+                    in_ch if j == 0 else out_ch, out_ch, temb_dim,
+                    cfg.resnet_group_size,
+                ))
+                if cfg.cross_attention[i]:
+                    attns.append(KUpAttnBlockT(
+                        out_ch, temb_dim, cfg.attention_head_dim,
+                        cfg.cross_attention_dim, cfg.resnet_group_size,
+                        cfg.down_self_attention[i], cfg.attention_bias,
+                    ))
+            block = nn.Module()
+            block.resnets = nn.ModuleList(resnets)
+            if attns:
+                block.attentions = nn.ModuleList(attns)
+            block.downsamplers = (
+                nn.ModuleList([KDownsampleT()]) if i != n - 1 else None
+            )
+            downs.append(block)
+        rev = tuple(reversed(cfg.block_out_channels))
+        for lvl in range(n):
+            i = n - 1 - lvl
+            out_ch = rev[lvl]
+            k_out = rev[min(lvl + 1, n - 1)]
+            resnets, attns = [], []
+            for j in range(cfg.layers_per_block):
+                in_ch = 2 * out_ch if j == 0 else out_ch
+                width = k_out if j == cfg.layers_per_block - 1 else out_ch
+                resnets.append(KUpResnetT(
+                    in_ch, width, temb_dim, cfg.resnet_group_size
+                ))
+                if cfg.cross_attention[i]:
+                    attns.append(KUpAttnBlockT(
+                        width, temb_dim, cfg.attention_head_dim,
+                        cfg.cross_attention_dim, cfg.resnet_group_size,
+                        cfg.up_self_attention[lvl], cfg.attention_bias,
+                    ))
+            block = nn.Module()
+            block.resnets = nn.ModuleList(resnets)
+            if attns:
+                block.attentions = nn.ModuleList(attns)
+            block.upsamplers = (
+                nn.ModuleList([KUpsampleT()]) if lvl != n - 1 else None
+            )
+            ups.append(block)
+        self.down_blocks = nn.ModuleList(downs)
+        self.up_blocks = nn.ModuleList(ups)
+        self.conv_out = nn.Conv2d(c0, cfg.out_channels, 1)
+
+    def forward(self, sample, timesteps, encoder_hidden_states, timestep_cond):
+        cfg = self.cfg
+        n = len(cfg.block_out_channels)
+        args = timesteps.float()[:, None] * self.time_proj_weight[None, :] \
+            * 2.0 * math.pi
+        t_emb = torch.cat([torch.cos(args), torch.sin(args)], dim=-1)
+        t_emb = t_emb + self.time_embedding["cond_proj"](timestep_cond)
+        t_emb = self.time_embedding["linear_1"](t_emb)
+        t_emb = F.gelu(t_emb)
+        t_emb = self.time_embedding["linear_2"](t_emb)
+        temb = F.gelu(t_emb)
+
+        x = self.conv_in(sample)
+        skips = []
+        for i, block in enumerate(self.down_blocks):
+            attns = list(getattr(block, "attentions", []))
+            for j, resnet in enumerate(block.resnets):
+                x = resnet(x, temb)
+                if attns:
+                    x = attns[j](x, temb, encoder_hidden_states)
+            skips.append(x)
+            if block.downsamplers is not None:
+                x = block.downsamplers[0](x)
+        for lvl, block in enumerate(self.up_blocks):
+            x = torch.cat([x, skips.pop()], dim=1)
+            attns = list(getattr(block, "attentions", []))
+            for j, resnet in enumerate(block.resnets):
+                x = resnet(x, temb)
+                if attns:
+                    x = attns[j](x, temb, encoder_hidden_states)
+            if block.upsamplers is not None:
+                x = block.upsamplers[0](x)
         return self.conv_out(x)
