@@ -2105,3 +2105,209 @@ def convert_k_upscaler(state: dict, config_json: dict | None = None):
     """-> (KUpscalerConfig, params)."""
     cfg = infer_k_upscaler_config(state, config_json)
     return cfg, convert_state_dict(state, k_upscaler_rename)
+
+
+# --- LineArt generator (models/lineart.py) ---
+
+
+def infer_lineart_config(state: dict):
+    import re
+
+    from .lineart import LineartConfig
+
+    n_res = 0
+    for k in state:
+        m = re.match(r"model2\.(\d+)\.conv_block\.1\.weight", k)
+        if m:
+            n_res = max(n_res, int(m.group(1)) + 1)
+    return LineartConfig(
+        base_channels=int(np.asarray(state["model0.1.weight"]).shape[0]),
+        n_residual_blocks=n_res,
+    )
+
+
+def convert_lineart(state: dict):
+    """informative-drawings Generator state dict -> (LineartConfig,
+    models.lineart params). InstanceNorms are affine-free (no params);
+    the two ConvTranspose kernels flip+transpose into the input-dilated
+    conv layout _UpConv runs."""
+    import re
+
+    cfg = infer_lineart_config(state)
+    params: dict = {}
+
+    def put_conv(target, w, b):
+        _assign(params, [target, "kernel"], w.transpose(2, 3, 1, 0))
+        _assign(params, [target, "bias"], b)
+
+    def put_convt(target, w, b):
+        # torch convT (in, out, kh, kw) -> flipped conv (kh, kw, in, out)
+        _assign(
+            params, [target, "kernel"],
+            np.ascontiguousarray(np.flip(w, (2, 3)).transpose(2, 3, 0, 1)),
+        )
+        _assign(params, [target, "bias"], b)
+
+    arr = {k: np.asarray(v) for k, v in state.items()}
+    put_conv("model0_conv", arr["model0.1.weight"], arr["model0.1.bias"])
+    put_conv("model1_conv0", arr["model1.0.weight"], arr["model1.0.bias"])
+    put_conv("model1_conv1", arr["model1.3.weight"], arr["model1.3.bias"])
+    for i in range(cfg.n_residual_blocks):
+        put_conv(f"res_{i}_conv0", arr[f"model2.{i}.conv_block.1.weight"],
+                 arr[f"model2.{i}.conv_block.1.bias"])
+        put_conv(f"res_{i}_conv1", arr[f"model2.{i}.conv_block.5.weight"],
+                 arr[f"model2.{i}.conv_block.5.bias"])
+    put_convt("model3_conv0", arr["model3.0.weight"], arr["model3.0.bias"])
+    put_convt("model3_conv1", arr["model3.3.weight"], arr["model3.3.bias"])
+    put_conv("model4_conv", arr["model4.1.weight"], arr["model4.1.bias"])
+    return cfg, params
+
+
+# --- M-LSD line detector (models/mlsd.py) ---
+
+
+def _fold_bn(w, b, bn_w, bn_b, bn_mean, bn_var, eps=1e-5):
+    """Fold BatchNorm into the preceding conv: returns (w', b')."""
+    scale = bn_w / np.sqrt(bn_var + eps)
+    w = w * scale[:, None, None, None]
+    if b is None:
+        b = np.zeros_like(bn_b)
+    return w, bn_b + (b - bn_mean) * scale
+
+
+def convert_mlsd(state: dict):
+    """MobileV2_MLSD_Large state dict -> models.mlsd params, every
+    BatchNorm folded into its conv. Accepts DataParallel 'module.'
+    prefixes."""
+    from .mlsd import MBV2_SETTING
+
+    arr = {}
+    for k, v in state.items():
+        if k.startswith("module."):
+            k = k[len("module."):]
+        arr[k] = np.asarray(v)
+    params: dict = {}
+
+    def fold_into(target, conv_key, bn_key):
+        w = arr[f"{conv_key}.weight"]
+        b = arr.get(f"{conv_key}.bias")
+        w, b = _fold_bn(
+            w, b, arr[f"{bn_key}.weight"], arr[f"{bn_key}.bias"],
+            arr[f"{bn_key}.running_mean"], arr[f"{bn_key}.running_var"],
+        )
+        path = target.split("/")
+        _assign(params, path + ["kernel"], w.transpose(2, 3, 1, 0))
+        _assign(params, path + ["bias"], b)
+
+    fold_into("features_0/conv", "backbone.features.0.0",
+              "backbone.features.0.1")
+    idx = 1
+    for t, c, n, s in MBV2_SETTING:
+        for _ in range(n):
+            pre = f"backbone.features.{idx}.conv"
+            if t == 1:
+                fold_into(f"features_{idx}/depthwise/conv",
+                          f"{pre}.0.0", f"{pre}.0.1")
+                fold_into(f"features_{idx}/project", f"{pre}.1", f"{pre}.2")
+            else:
+                fold_into(f"features_{idx}/expand/conv",
+                          f"{pre}.0.0", f"{pre}.0.1")
+                fold_into(f"features_{idx}/depthwise/conv",
+                          f"{pre}.1.0", f"{pre}.1.1")
+                fold_into(f"features_{idx}/project", f"{pre}.2", f"{pre}.3")
+            idx += 1
+    for blk in range(15, 23):
+        for conv in ("conv1", "conv2"):
+            fold_into(f"block{blk}/{conv}", f"block{blk}.{conv}.0",
+                      f"block{blk}.{conv}.1")
+    fold_into("block23/conv1", "block23.conv1.0", "block23.conv1.1")
+    fold_into("block23/conv2", "block23.conv2.0", "block23.conv2.1")
+    _assign(params, ["block23", "conv3", "kernel"],
+            arr["block23.conv3.weight"].transpose(2, 3, 1, 0))
+    _assign(params, ["block23", "conv3", "bias"], arr["block23.conv3.bias"])
+    return params
+
+
+# --- PiDiNet soft-edge detector (models/pidinet.py) ---
+
+
+def _convert_pdc(op: str, w: np.ndarray) -> np.ndarray:
+    """Re-parameterize a pixel-difference conv kernel into a vanilla conv
+    kernel (the pidinet authors' convert_pdc math). cd/ad stay 3x3; rd
+    expands to 5x5."""
+    if op == "cv":
+        return w
+    o, i = w.shape[:2]
+    flat = w.reshape(o, i, -1).copy()
+    if op == "cd":
+        flat[:, :, 4] = flat[:, :, 4] - w.sum(axis=(2, 3))
+        return flat.reshape(w.shape)
+    if op == "ad":
+        return (flat - flat[:, :, [3, 0, 1, 6, 4, 2, 7, 8, 5]]).reshape(
+            w.shape
+        )
+    if op == "rd":
+        buffer = np.zeros((o, i, 25), w.dtype)
+        buffer[:, :, [0, 2, 4, 10, 14, 20, 22, 24]] = flat[:, :, 1:]
+        buffer[:, :, [6, 7, 8, 11, 13, 16, 17, 18]] = -flat[:, :, 1:]
+        return buffer.reshape(o, i, 5, 5)
+    raise ValueError(f"unknown pdc op {op}")
+
+
+def convert_pidinet(state: dict):
+    """table5_pidinet checkpoint (raw pixel-difference kernels, carv4
+    config) -> models.pidinet params. Accepts the {'state_dict': ...}
+    wrapper and DataParallel 'module.' prefixes."""
+    from .pidinet import CARV4
+
+    if "state_dict" in state and not any(
+        k.startswith(("init_block", "block")) for k in state
+    ):
+        state = state["state_dict"]
+    arr = {}
+    for k, v in state.items():
+        if k.startswith("module."):
+            k = k[len("module."):]
+        arr[k] = np.asarray(v)
+
+    params: dict = {}
+
+    def put(path, leaf, value):
+        _assign(params, list(path) + [leaf], value)
+
+    put(["init_block"], "kernel",
+        _convert_pdc(CARV4[0], arr["init_block.weight"]).transpose(2, 3, 1, 0))
+    for s in range(4):
+        n_blocks = 3 if s == 0 else 4
+        for j in range(n_blocks):
+            layer = j + 1 if s == 0 else s * 4 + j
+            name = f"block{s + 1}_{j + 1}"
+            w = _convert_pdc(CARV4[layer], arr[f"{name}.conv1.weight"])
+            put([name, "conv1"], "kernel", w.transpose(2, 3, 1, 0))
+            put([name, "conv2"], "kernel",
+                arr[f"{name}.conv2.weight"].transpose(2, 3, 1, 0))
+            if f"{name}.shortcut.weight" in arr:
+                put([name, "shortcut"], "kernel",
+                    arr[f"{name}.shortcut.weight"].transpose(2, 3, 1, 0))
+                put([name, "shortcut"], "bias", arr[f"{name}.shortcut.bias"])
+    for i in range(4):
+        put([f"dilations_{i}", "conv1"], "kernel",
+            arr[f"dilations.{i}.conv1.weight"].transpose(2, 3, 1, 0))
+        put([f"dilations_{i}", "conv1"], "bias",
+            arr[f"dilations.{i}.conv1.bias"])
+        for d in range(1, 5):
+            put([f"dilations_{i}", f"conv2_{d}"], "kernel",
+                arr[f"dilations.{i}.conv2_{d}.weight"].transpose(2, 3, 1, 0))
+        put([f"attentions_{i}", "conv1"], "kernel",
+            arr[f"attentions.{i}.conv1.weight"].transpose(2, 3, 1, 0))
+        put([f"attentions_{i}", "conv1"], "bias",
+            arr[f"attentions.{i}.conv1.bias"])
+        put([f"attentions_{i}", "conv2"], "kernel",
+            arr[f"attentions.{i}.conv2.weight"].transpose(2, 3, 1, 0))
+        put([f"conv_reduces_{i}"], "kernel",
+            arr[f"conv_reduces.{i}.conv.weight"].transpose(2, 3, 1, 0))
+        put([f"conv_reduces_{i}"], "bias", arr[f"conv_reduces.{i}.conv.bias"])
+    put(["classifier"], "kernel",
+        arr["classifier.weight"].transpose(2, 3, 1, 0))
+    put(["classifier"], "bias", arr["classifier.bias"])
+    return params

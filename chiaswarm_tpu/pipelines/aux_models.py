@@ -576,6 +576,334 @@ def get_segmenter(model_name: str | None = None):
             seg = Segmenter(name)
         except (MissingWeightsError, FileNotFoundError, OSError) as e:
             logger.info("no converted segmentation weights (%s)", e)
+            _SEG[name] = None  # negative-cache: stop re-reading weights per job
             return None
         _SEG[name] = seg
         return seg
+
+
+# --- M-LSD line detector (mlsd preprocessor backend) ---
+
+_MLSD: dict[str, "MLSDDetector"] = {}
+_MLSD_LOCK = threading.Lock()
+
+DEFAULT_MLSD_MODEL = "lllyasviel/Annotators"
+_MLSD_SIZE = 512  # upstream processing canvas; TP map comes out at /2
+
+
+class MLSDDetector:
+    """Resident MobileV2-MLSD-Large line detector (the learned annotator
+    the reference's `mlsd` preprocessor runs, swarm/pre_processors/
+    controlnet.py:31). BatchNorms fold at conversion; the TP-map decode
+    (sigmoid center NMS + displacement endpoints) runs host-side like the
+    pose PAF grouping."""
+
+    def __init__(self, model_name: str = DEFAULT_MLSD_MODEL):
+        import jax
+        import jax.numpy as jnp
+
+        from ..models.mlsd import MLSDNet
+        from ..settings import load_settings
+
+        self.model_name = model_name
+        on_tpu = jax.default_backend() == "tpu"
+        self.dtype = jnp.bfloat16 if on_tpu else jnp.float32
+        self.model = MLSDNet(dtype=self.dtype)
+        root = Path(load_settings().model_root_dir).expanduser()
+        params = self._load_converted(root / model_name)
+        cast = lambda x: jnp.asarray(x, self.dtype)
+        self.params = jax.tree_util.tree_map(cast, params)
+        self._program = jax.jit(
+            lambda p, px: self.model.apply({"params": p}, px)
+        )
+
+    @staticmethod
+    def _load_converted(model_dir: Path):
+        """The Annotators repo ships mlsd_large_512_fp32.pth (a raw torch
+        pickle); accept any mlsd*.pth / safetensors layout present."""
+        from ..models.conversion import convert_mlsd, load_torch_state_dict
+
+        if not model_dir.is_dir():
+            raise FileNotFoundError(f"no checkpoint directory {model_dir}")
+        try:
+            return convert_mlsd(load_torch_state_dict(model_dir))
+        except (FileNotFoundError, KeyError):
+            # KeyError: the shared Annotators dir can hold OTHER
+            # annotators' safetensors — fall through to the mlsd .pth
+            for p in sorted(model_dir.glob("*mlsd*.pth")):
+                import torch
+
+                sd = torch.load(str(p), map_location="cpu",
+                                weights_only=True)
+                return convert_mlsd({k: v.numpy() for k, v in sd.items()})
+            raise
+
+    def __call__(self, image, score_thr: float = 0.1,
+                 dist_thr: float = 0.1) -> np.ndarray:
+        """PIL -> [N, 4] float32 line segments (x1, y1, x2, y2) in the
+        ORIGINAL image's pixel coordinates."""
+        import cv2
+        import jax.numpy as jnp
+        from PIL import Image
+
+        w, h = image.size
+        rgb = image.convert("RGB").resize(
+            (_MLSD_SIZE, _MLSD_SIZE), Image.BILINEAR
+        )
+        arr = np.concatenate(
+            [np.asarray(rgb, np.float32),
+             np.ones((_MLSD_SIZE, _MLSD_SIZE, 1), np.float32)],
+            axis=-1,
+        ) / 127.5 - 1.0
+        tp = np.asarray(
+            self._program(self.params, jnp.asarray(arr[None], self.dtype))
+            .astype(jnp.float32)
+        )[0]
+        center, disp = tp[:, :, 0], tp[:, :, 1:5]
+        heat = 1.0 / (1.0 + np.exp(-center))
+        hmax = cv2.dilate(heat, np.ones((3, 3), np.uint8))
+        heat = np.where(heat >= hmax, heat, 0.0)
+        flat = heat.ravel()
+        top = np.argsort(flat)[::-1][:200]
+        ys, xs = np.unravel_index(top, heat.shape)
+        lines = []
+        for y, x in zip(ys, xs):
+            if heat[y, x] <= score_thr:
+                break
+            x1 = x + disp[y, x, 0]
+            y1 = y + disp[y, x, 1]
+            x2 = x + disp[y, x, 2]
+            y2 = y + disp[y, x, 3]
+            if np.hypot(x2 - x1, y2 - y1) > dist_thr:
+                lines.append((x1, y1, x2, y2))
+        if not lines:
+            return np.zeros((0, 4), np.float32)
+        # TP map is at canvas/2; scale 2x to the canvas then to the
+        # original image
+        seg = np.asarray(lines, np.float32) * 2.0
+        seg[:, 0::2] *= w / _MLSD_SIZE
+        seg[:, 1::2] *= h / _MLSD_SIZE
+        return seg
+
+
+def get_mlsd_detector(model_name: str | None = None):
+    """The resident MLSD detector, or None when no converted checkpoint
+    is available (callers fall back to the Hough stand-in)."""
+    from ..weights import MissingWeightsError
+
+    name = model_name or DEFAULT_MLSD_MODEL
+    with _MLSD_LOCK:
+        if name in _MLSD:
+            return _MLSD[name]
+        try:
+            det = MLSDDetector(name)
+        except (MissingWeightsError, FileNotFoundError, OSError,
+                KeyError) as e:
+            logger.info("no converted MLSD weights (%s)", e)
+            _MLSD[name] = None  # negative-cache: stop re-reading per job
+            return None
+        _MLSD[name] = det
+        return det
+
+
+# --- LineArt generator (lineart preprocessor backend) ---
+
+_LINEART: dict[str, "LineartDetector"] = {}
+_LINEART_LOCK = threading.Lock()
+
+DEFAULT_LINEART_MODEL = "lllyasviel/Annotators"
+_LINEART_SIZE = 512
+
+
+class LineartDetector:
+    """Resident informative-drawings sketch generator (the learned
+    annotator the reference's `lineart` preprocessor runs,
+    swarm/pre_processors/controlnet.py:43)."""
+
+    def __init__(self, model_name: str = DEFAULT_LINEART_MODEL):
+        import jax
+        import jax.numpy as jnp
+
+        from ..models.lineart import LineartGenerator
+        from ..settings import load_settings
+
+        self.model_name = model_name
+        on_tpu = jax.default_backend() == "tpu"
+        self.dtype = jnp.bfloat16 if on_tpu else jnp.float32
+        root = Path(load_settings().model_root_dir).expanduser()
+        cfg, params = self._load_converted(root / model_name)
+        self.model = LineartGenerator(cfg, dtype=self.dtype)
+        cast = lambda x: jnp.asarray(x, self.dtype)
+        self.params = jax.tree_util.tree_map(cast, params)
+        self._program = jax.jit(
+            lambda p, px: self.model.apply({"params": p}, px)
+        )
+
+    @staticmethod
+    def _load_converted(model_dir: Path):
+        """sk_model.pth (fine, the reference's default); sk_model2.pth is
+        the coarse variant of the same graph."""
+        from ..models.conversion import convert_lineart, load_torch_state_dict
+
+        if not model_dir.is_dir():
+            raise FileNotFoundError(f"no checkpoint directory {model_dir}")
+        try:
+            return convert_lineart(load_torch_state_dict(model_dir))
+        except (FileNotFoundError, KeyError):
+            for p in sorted(model_dir.glob("sk_model*.pth")):
+                import torch
+
+                sd = torch.load(str(p), map_location="cpu",
+                                weights_only=True)
+                return convert_lineart(
+                    {k: v.numpy() for k, v in sd.items()}
+                )
+            raise FileNotFoundError(f"no sk_model*.pth under {model_dir}")
+
+    def __call__(self, image) -> np.ndarray:
+        """PIL -> [H, W] float32 stroke intensity in [0, 1] (white lines
+        on black, the conditioning convention — already inverted)."""
+        import jax.numpy as jnp
+        from PIL import Image
+
+        original = image.size
+        rgb = image.convert("RGB").resize(
+            (_LINEART_SIZE, _LINEART_SIZE), Image.BILINEAR
+        )
+        px = jnp.asarray(
+            np.asarray(rgb, np.float32)[None] / 255.0, self.dtype
+        )
+        sketch = np.asarray(
+            self._program(self.params, px).astype(jnp.float32)
+        )[0, :, :, 0]
+        inverted = 1.0 - sketch  # dark-on-white sketch -> white-on-black
+        return np.asarray(
+            Image.fromarray((inverted * 255).astype(np.uint8)).resize(
+                original, Image.BILINEAR
+            ),
+            np.float32,
+        ) / 255.0
+
+
+def get_lineart_detector(model_name: str | None = None):
+    """The resident LineArt generator, or None when no converted
+    checkpoint is available (callers fall back to the DoG stand-in)."""
+    from ..weights import MissingWeightsError
+
+    name = model_name or DEFAULT_LINEART_MODEL
+    with _LINEART_LOCK:
+        if name in _LINEART:
+            return _LINEART[name]
+        try:
+            det = LineartDetector(name)
+        except (MissingWeightsError, FileNotFoundError, OSError,
+                KeyError) as e:
+            logger.info("no converted LineArt weights (%s)", e)
+            _LINEART[name] = None  # negative-cache: stop re-reading per job
+            return None
+        _LINEART[name] = det
+        return det
+
+
+# --- PiDiNet soft-edge (softedge preprocessor backend) ---
+
+_PIDI: dict[str, "PidinetDetector"] = {}
+_PIDI_LOCK = threading.Lock()
+
+DEFAULT_PIDINET_MODEL = "lllyasviel/Annotators"
+_PIDI_SIZE = 512
+
+
+class PidinetDetector:
+    """Resident table5 PiDiNet (the learned detector the reference's
+    `softedge` preprocessor runs, swarm/pre_processors/controlnet.py:56).
+    Pixel-difference kernels re-parameterize to vanilla convs at
+    conversion."""
+
+    def __init__(self, model_name: str = DEFAULT_PIDINET_MODEL):
+        import jax
+        import jax.numpy as jnp
+
+        from ..models.pidinet import PiDiNet
+        from ..settings import load_settings
+
+        self.model_name = model_name
+        on_tpu = jax.default_backend() == "tpu"
+        self.dtype = jnp.bfloat16 if on_tpu else jnp.float32
+        self.model = PiDiNet(dtype=self.dtype)
+        root = Path(load_settings().model_root_dir).expanduser()
+        params = self._load_converted(root / model_name)
+        cast = lambda x: jnp.asarray(x, self.dtype)
+        self.params = jax.tree_util.tree_map(cast, params)
+        self._program = jax.jit(
+            lambda p, px: self.model.apply({"params": p}, px)
+        )
+
+    @staticmethod
+    def _load_converted(model_dir: Path):
+        from ..models.conversion import (
+            convert_pidinet,
+            load_torch_state_dict,
+        )
+
+        if not model_dir.is_dir():
+            raise FileNotFoundError(f"no checkpoint directory {model_dir}")
+        try:
+            return convert_pidinet(load_torch_state_dict(model_dir))
+        except (FileNotFoundError, KeyError):
+            for p in sorted(model_dir.glob("*pidinet*.pth")):
+                import torch
+
+                sd = torch.load(str(p), map_location="cpu",
+                                weights_only=True)
+                if isinstance(sd, dict) and "state_dict" in sd:
+                    sd = sd["state_dict"]
+                return convert_pidinet(
+                    {k: np.asarray(v) for k, v in sd.items()}
+                )
+            raise FileNotFoundError(
+                f"no *pidinet*.pth under {model_dir}"
+            )
+
+    def __call__(self, image) -> np.ndarray:
+        """PIL -> [H, W] float32 soft-edge probabilities in [0, 1]."""
+        import jax.numpy as jnp
+        from PIL import Image
+
+        original = image.size
+        rgb = image.convert("RGB").resize(
+            (_PIDI_SIZE, _PIDI_SIZE), Image.BILINEAR
+        )
+        px = jnp.asarray(
+            np.asarray(rgb, np.float32)[None] / 255.0, self.dtype
+        )
+        edge = np.asarray(
+            self._program(self.params, px).astype(jnp.float32)
+        )[0, :, :, 0]
+        return np.asarray(
+            Image.fromarray((edge * 255).astype(np.uint8)).resize(
+                original, Image.BILINEAR
+            ),
+            np.float32,
+        ) / 255.0
+
+
+def get_pidinet_detector(model_name: str | None = None):
+    """The resident PiDiNet, or None when no converted checkpoint is
+    available (softedge falls back to HED, then the classical
+    heuristic)."""
+    from ..weights import MissingWeightsError
+
+    name = model_name or DEFAULT_PIDINET_MODEL
+    with _PIDI_LOCK:
+        if name in _PIDI:
+            return _PIDI[name]
+        try:
+            det = PidinetDetector(name)
+        except (MissingWeightsError, FileNotFoundError, OSError,
+                KeyError) as e:
+            logger.info("no converted PiDiNet weights (%s)", e)
+            _PIDI[name] = None  # negative-cache: stop re-reading per job
+            return None
+        _PIDI[name] = det
+        return det

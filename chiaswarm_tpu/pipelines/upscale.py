@@ -309,10 +309,11 @@ class LatentUpscalePipeline:
 
             def body(carry, i):
                 latents, state = carry
+                sigma = sigmas[i]
                 inp = scheduler.scale_model_input(schedule, latents, i)
                 model_in = jnp.concatenate([inp, cond], axis=-1)
                 # continuous K-diffusion timestep: log(sigma)/4
-                t = jnp.log(sigmas[i]) * 0.25
+                t = jnp.log(sigma) * 0.25
                 pred = unet.apply(
                     {"params": params["unet"]},
                     model_in.astype(self.dtype),
@@ -321,11 +322,18 @@ class LatentUpscalePipeline:
                     timestep_cond,
                 ).astype(jnp.float32)
                 pred = pred[..., : latent_c]  # 5th channel dropped
+                # Karras table-1 preconditioning (the diffusers pipeline
+                # applies it OUTSIDE the UNet before the solver step):
+                # x0 = c_skip*x + c_out*F(c_in*x), c_skip = 1/(sigma^2+1),
+                # c_out = sigma/sqrt(sigma^2+1)
+                x0_pred = latents / (sigma**2 + 1.0) + pred * (
+                    sigma / jnp.sqrt(sigma**2 + 1.0)
+                )
                 noise = jax.random.normal(
                     jax.random.fold_in(rng, i), latents.shape, jnp.float32
                 )
                 state, latents = scheduler.step(
-                    schedule, state, i, latents, pred, noise
+                    schedule, state, i, latents, x0_pred, noise
                 )
                 return (latents, state), ()
 

@@ -33,24 +33,25 @@ def register(name):
 
 
 # classical-CV approximations of learned detectors the reference runs
-# (MLSDdetector, LineartDetector, real ZoeDepth —
-# swarm/pre_processors/controlnet.py:31-61). Jobs conditioned through
-# these get a `degraded_preprocessors` entry in the result envelope so
-# the hive/user can see the conditioning image is an approximation.
-_DEGRADED = frozenset(
-    _norm(n) for n in ("mlsd", "lineart", "zoe depth", "zoe")
-)
+# (real ZoeDepth — swarm/pre_processors/controlnet.py:58-61). Jobs
+# conditioned through these get a `degraded_preprocessors` entry in the
+# result envelope so the hive/user can see the conditioning image is an
+# approximation. mlsd/lineart/segmentation run their REAL detectors when
+# converted weights are present and degrade (flagged) otherwise.
+_DEGRADED = frozenset(_norm(n) for n in ("zoe depth", "zoe"))
 
 
 def is_degraded_preprocessor(name: str) -> bool:
     if _norm(name) in _DEGRADED:
         return True
-    if _norm(name) == "segmentation":
-        # real UperNet when converted weights are present; k-means
-        # stand-in (degraded) otherwise
-        from ..pipelines.aux_models import get_segmenter
+    from ..pipelines import aux_models
 
-        return get_segmenter() is None
+    if _norm(name) == "segmentation":
+        return aux_models.get_segmenter() is None
+    if _norm(name) == "mlsd":
+        return aux_models.get_mlsd_detector() is None
+    if _norm(name) == "lineart":
+        return aux_models.get_lineart_detector() is None
     return False
 
 
@@ -163,13 +164,14 @@ def scribble(image: Image.Image) -> Image.Image:
 @register("softedge")
 @register("soft edge")
 def soft_edge(image: Image.Image) -> Image.Image:
-    """Soft HED edge probabilities (the reference serves PidiNet here,
-    controlnet.py:56-57; HED is the learned detector this worker ships —
-    a soft-edge map of the same family, distinct from scribble's thinned
-    binary output). Classical Laplacian when HED weights are absent."""
-    from ..pipelines.aux_models import hed_edges
+    """Soft edge probabilities. With converted table5_pidinet weights the
+    REAL PiDiNet runs (the detector the reference serves here,
+    controlnet.py:56-57; models/pidinet.py); else HED (same family, soft
+    map); else the classical Laplacian (logged)."""
+    from ..pipelines.aux_models import get_pidinet_detector, hed_edges
 
-    edge = hed_edges(image)
+    pidi = get_pidinet_detector()
+    edge = pidi(image) if pidi is not None else hed_edges(image)
     if edge is None:
         _warn_no_hed()
         return _laplacian_edges(image)
@@ -207,20 +209,30 @@ def center_crop(image: Image.Image) -> Image.Image:
 
 @register("mlsd")
 def mlsd(image: Image.Image) -> Image.Image:
-    """Straight-line wireframe (reference's MLSDdetector, controlnet.py:31),
-    approximated with probabilistic Hough segments over Canny edges —
-    white line segments on black, the M-LSD output convention."""
+    """Straight-line wireframe (reference's MLSDdetector, controlnet.py:31)
+    — white line segments on black. With converted M-LSD weights present
+    the REAL MobileV2-MLSD-Large runs (models/mlsd.py); otherwise
+    probabilistic Hough segments over Canny edges approximate it and the
+    job is flagged degraded."""
     import cv2
 
+    from ..pipelines.aux_models import get_mlsd_detector
+
     arr = np.asarray(image.convert("RGB"))
+    h, w = arr.shape[:2]
+    out = np.zeros((h, w, 3), np.uint8)
+    det = get_mlsd_detector()
+    if det is not None:
+        for x1, y1, x2, y2 in det(image):
+            cv2.line(out, (int(round(x1)), int(round(y1))),
+                     (int(round(x2)), int(round(y2))), (255, 255, 255), 1)
+        return Image.fromarray(out)
     gray = cv2.cvtColor(arr, cv2.COLOR_RGB2GRAY)
     edges = cv2.Canny(gray, 60, 180)
-    h, w = gray.shape
     lines = cv2.HoughLinesP(
         edges, 1, np.pi / 180, threshold=40,
         minLineLength=max(min(h, w) // 16, 8), maxLineGap=4,
     )
-    out = np.zeros((h, w, 3), np.uint8)
     if lines is not None:
         for seg in np.asarray(lines).reshape(-1, 4):
             x1, y1, x2, y2 = (int(v) for v in seg)
@@ -230,11 +242,19 @@ def mlsd(image: Image.Image) -> Image.Image:
 
 @register("lineart")
 def lineart(image: Image.Image) -> Image.Image:
-    """Fine line drawing (reference's LineartDetector, controlnet.py:43),
-    approximated with a difference-of-gaussians sketch — white strokes on
-    black (the annotator's inverted-coal convention)."""
+    """Fine line drawing (reference's LineartDetector, controlnet.py:43) —
+    white strokes on black (the annotator's inverted-coal convention).
+    With converted sk_model weights present the REAL informative-drawings
+    generator runs (models/lineart.py); otherwise a difference-of-
+    gaussians sketch approximates it and the job is flagged degraded."""
     import cv2
 
+    from ..pipelines.aux_models import get_lineart_detector
+
+    det = get_lineart_detector()
+    if det is not None:
+        strokes = (det(image) * 255).astype(np.uint8)
+        return Image.fromarray(np.stack([strokes] * 3, axis=-1))
     gray = cv2.cvtColor(
         np.asarray(image.convert("RGB")), cv2.COLOR_RGB2GRAY
     ).astype(np.float32)

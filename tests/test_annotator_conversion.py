@@ -1,0 +1,199 @@
+"""MLSD / LineArt learned-annotator conversion (VERDICT r03 next #3).
+
+The checkpoint side is the torch mirrors in torch_unet_ref.py (exact
+upstream key layouts): random torch init with non-trivial BatchNorm
+running stats -> state dict -> convert -> flax forward must equal the
+torch eval forward. The preprocessor wiring is proven by dropping a
+converted .pth into the model root and asserting the real detector
+serves (and the degraded flag clears).
+"""
+
+import os
+import sys
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+sys.path.insert(0, os.path.dirname(__file__))
+
+torch = pytest.importorskip("torch")
+
+from torch_unet_ref import LineartGeneratorT, MLSDLargeT  # noqa: E402
+
+from chiaswarm_tpu.models.conversion import (  # noqa: E402
+    convert_lineart,
+    convert_mlsd,
+)
+from chiaswarm_tpu.models.lineart import LineartGenerator  # noqa: E402
+from chiaswarm_tpu.models.mlsd import MLSDNet  # noqa: E402
+
+
+def _randomize_bn_stats(module, seed):
+    """Non-trivial running stats so the folding math is actually
+    exercised (fresh BNs have mean 0 / var 1, which folding can fake)."""
+    g = torch.Generator().manual_seed(seed)
+    for m in module.modules():
+        if isinstance(m, torch.nn.BatchNorm2d):
+            m.running_mean.copy_(torch.randn(m.num_features, generator=g) * 0.2)
+            m.running_var.copy_(
+                torch.rand(m.num_features, generator=g) * 1.5 + 0.3
+            )
+
+
+def _state_numpy(module) -> dict:
+    return {k: v.detach().numpy() for k, v in module.state_dict().items()}
+
+
+def test_mlsd_torch_parity():
+    torch.manual_seed(60)
+    mirror = MLSDLargeT()
+    with torch.no_grad():
+        _randomize_bn_stats(mirror, 61)
+    mirror.eval()
+    params = convert_mlsd(_state_numpy(mirror))
+
+    rng = np.random.default_rng(62)
+    x = rng.standard_normal((1, 64, 64, 4)).astype(np.float32)
+    with torch.no_grad():
+        out_t = mirror(
+            torch.from_numpy(x).permute(0, 3, 1, 2)
+        ).permute(0, 2, 3, 1).numpy()
+    out_f = MLSDNet().apply({"params": params}, jnp.asarray(x))
+    assert out_f.shape == out_t.shape  # [1, 32, 32, 9]
+    np.testing.assert_allclose(np.asarray(out_f), out_t, atol=3e-4, rtol=1e-3)
+
+
+def test_mlsd_accepts_dataparallel_prefix():
+    torch.manual_seed(63)
+    mirror = MLSDLargeT()
+    mirror.eval()
+    state = {f"module.{k}": v for k, v in _state_numpy(mirror).items()}
+    params = convert_mlsd(state)
+    assert "features_0" in params and "block23" in params
+
+
+def test_lineart_torch_parity():
+    torch.manual_seed(64)
+    mirror = LineartGeneratorT(base=8, n_res=2)
+    mirror.eval()
+    cfg, params = convert_lineart(_state_numpy(mirror))
+    assert cfg.base_channels == 8 and cfg.n_residual_blocks == 2
+
+    rng = np.random.default_rng(65)
+    x = rng.random((2, 32, 32, 3)).astype(np.float32)
+    with torch.no_grad():
+        out_t = mirror(
+            torch.from_numpy(x).permute(0, 3, 1, 2)
+        ).permute(0, 2, 3, 1).numpy()
+    out_f = LineartGenerator(cfg).apply({"params": params}, jnp.asarray(x))
+    assert out_f.shape == out_t.shape  # transposed convs restore H, W
+    np.testing.assert_allclose(np.asarray(out_f), out_t, atol=3e-4, rtol=1e-3)
+
+
+def test_annotator_preprocessors_serve_real_weights(sdaas_root, tmp_path):
+    """Converted .pth files under the model root flip mlsd/lineart from
+    classical stand-ins to the real detectors, and the degraded flag
+    clears (the envelope-visible contract from round 4's
+    degraded_preprocessors work)."""
+    from PIL import Image
+
+    from chiaswarm_tpu.pipelines import aux_models
+    from chiaswarm_tpu.pre_processors.controlnet import (
+        is_degraded_preprocessor,
+        preprocess_image,
+    )
+    from chiaswarm_tpu.settings import Settings, save_settings
+
+    root = tmp_path / "models"
+    annot = root / "lllyasviel/Annotators"
+    annot.mkdir(parents=True)
+    save_settings(Settings(model_root_dir=str(root)))
+
+    torch.manual_seed(66)
+    torch.save(MLSDLargeT().state_dict(),
+               str(annot / "mlsd_large_512_fp32.pth"))
+    torch.save(LineartGeneratorT(base=8, n_res=1).state_dict(),
+               str(annot / "sk_model.pth"))
+
+    aux_models._MLSD.clear()
+    aux_models._LINEART.clear()
+    try:
+        assert aux_models.get_mlsd_detector() is not None
+        assert aux_models.get_lineart_detector() is not None
+        assert not is_degraded_preprocessor("mlsd")
+        assert not is_degraded_preprocessor("lineart")
+
+        img = Image.fromarray(
+            (np.random.default_rng(67).random((96, 96, 3)) * 255).astype(
+                np.uint8
+            )
+        )
+        for name in ("mlsd", "lineart"):
+            out = preprocess_image(img, name, "cpu")
+            assert out.size == img.size
+    finally:
+        aux_models._MLSD.clear()
+        aux_models._LINEART.clear()
+
+
+def test_pidinet_torch_parity():
+    """convert_pidinet's re-parameterization vs the functional pixel-
+    difference ops (for 'cd', genuinely independent math)."""
+    from torch_unet_ref import PiDiNetT
+
+    from chiaswarm_tpu.models.conversion import convert_pidinet
+    from chiaswarm_tpu.models.pidinet import PiDiNet
+
+    torch.manual_seed(70)
+    mirror = PiDiNetT()
+    mirror.eval()
+    params = convert_pidinet(_state_numpy(mirror))
+
+    rng = np.random.default_rng(71)
+    x = rng.random((1, 64, 64, 3)).astype(np.float32)
+    with torch.no_grad():
+        out_t = mirror(
+            torch.from_numpy(x).permute(0, 3, 1, 2)
+        ).permute(0, 2, 3, 1).numpy()
+    out_f = PiDiNet().apply({"params": params}, jnp.asarray(x))
+    np.testing.assert_allclose(np.asarray(out_f), out_t, atol=3e-4, rtol=1e-3)
+
+
+def test_pidinet_preprocessor_serves_real_weights(sdaas_root, tmp_path):
+    """A wrapped {'state_dict': module.-prefixed} table5_pidinet.pth (the
+    published checkpoint's exact shape) serves the real soft_edge path."""
+    from PIL import Image
+    from torch_unet_ref import PiDiNetT
+
+    from chiaswarm_tpu.pipelines import aux_models
+    from chiaswarm_tpu.pre_processors.controlnet import preprocess_image
+    from chiaswarm_tpu.settings import Settings, save_settings
+
+    root = tmp_path / "models"
+    annot = root / "lllyasviel/Annotators"
+    annot.mkdir(parents=True)
+    save_settings(Settings(model_root_dir=str(root)))
+
+    torch.manual_seed(72)
+    wrapped = {
+        "state_dict": {
+            f"module.{k}": v for k, v in PiDiNetT().state_dict().items()
+        }
+    }
+    torch.save(wrapped, str(annot / "table5_pidinet.pth"))
+
+    aux_models._PIDI.clear()
+    try:
+        assert aux_models.get_pidinet_detector() is not None
+        img = Image.fromarray(
+            (np.random.default_rng(73).random((80, 80, 3)) * 255).astype(
+                np.uint8
+            )
+        )
+        out = preprocess_image(img, "softedge", "cpu")
+        assert out.size == img.size
+    finally:
+        aux_models._PIDI.clear()

@@ -1657,3 +1657,342 @@ class KUpscalerUNetT(nn.Module):
             if block.upsamplers is not None:
                 x = block.upsamplers[0](x)
         return self.conv_out(x)
+
+
+# --- M-LSD (models/mlsd.py) and LineArt (models/lineart.py) annotators ---
+
+
+class _ConvBNReLU6T(nn.Sequential):
+    def __init__(self, inp, oup, k=3, stride=1, groups=1):
+        super().__init__(
+            nn.Conv2d(inp, oup, k, stride, (k - 1) // 2, groups=groups,
+                      bias=False),
+            nn.BatchNorm2d(oup),
+            nn.ReLU6(inplace=True),
+        )
+
+
+class _InvertedResidualT(nn.Module):
+    def __init__(self, inp, oup, stride, expand_ratio):
+        super().__init__()
+        hidden = round(inp * expand_ratio)
+        self.use_res_connect = stride == 1 and inp == oup
+        layers = []
+        if expand_ratio != 1:
+            layers.append(_ConvBNReLU6T(inp, hidden, k=1))
+        layers.extend([
+            _ConvBNReLU6T(hidden, hidden, stride=stride, groups=hidden),
+            nn.Conv2d(hidden, oup, 1, bias=False),
+            nn.BatchNorm2d(oup),
+        ])
+        self.conv = nn.Sequential(*layers)
+
+    def forward(self, x):
+        return x + self.conv(x) if self.use_res_connect else self.conv(x)
+
+
+class _MLSDBackboneT(nn.Module):
+    SETTING = ((1, 16, 1, 1), (6, 24, 2, 2), (6, 32, 3, 2),
+               (6, 64, 4, 2), (6, 96, 3, 1))
+    TAPS = (1, 3, 6, 10, 13)
+
+    def __init__(self):
+        super().__init__()
+        features = [_ConvBNReLU6T(4, 32, stride=2)]
+        in_ch = 32
+        for t, c, n, s in self.SETTING:
+            for i in range(n):
+                features.append(
+                    _InvertedResidualT(in_ch, c, s if i == 0 else 1, t)
+                )
+                in_ch = c
+        self.features = nn.Sequential(*features)
+
+    def forward(self, x):
+        taps = []
+        for i, f in enumerate(self.features):
+            x = f(x)
+            if i in self.TAPS:
+                taps.append(x)
+        return taps
+
+
+class _BlockAT(nn.Module):
+    def __init__(self, in_c1, in_c2, out_c1, out_c2, upscale=True):
+        super().__init__()
+        self.conv1 = nn.Sequential(
+            nn.Conv2d(in_c2, out_c2, 1), nn.BatchNorm2d(out_c2), nn.ReLU()
+        )
+        self.conv2 = nn.Sequential(
+            nn.Conv2d(in_c1, out_c1, 1), nn.BatchNorm2d(out_c1), nn.ReLU()
+        )
+        self.upscale = upscale
+
+    def forward(self, a, b):
+        b = self.conv1(b)
+        a = self.conv2(a)
+        if self.upscale:
+            b = F.interpolate(b, scale_factor=2.0, mode="bilinear",
+                              align_corners=True)
+        return torch.cat((a, b), dim=1)
+
+
+class _BlockBT(nn.Module):
+    def __init__(self, in_c, out_c):
+        super().__init__()
+        self.conv1 = nn.Sequential(
+            nn.Conv2d(in_c, in_c, 3, padding=1), nn.BatchNorm2d(in_c),
+            nn.ReLU(),
+        )
+        self.conv2 = nn.Sequential(
+            nn.Conv2d(in_c, out_c, 3, padding=1), nn.BatchNorm2d(out_c),
+            nn.ReLU(),
+        )
+
+    def forward(self, x):
+        x = self.conv1(x) + x
+        return self.conv2(x)
+
+
+class _BlockCT(nn.Module):
+    def __init__(self, in_c, out_c):
+        super().__init__()
+        self.conv1 = nn.Sequential(
+            nn.Conv2d(in_c, in_c, 3, padding=5, dilation=5),
+            nn.BatchNorm2d(in_c), nn.ReLU(),
+        )
+        self.conv2 = nn.Sequential(
+            nn.Conv2d(in_c, in_c, 3, padding=1), nn.BatchNorm2d(in_c),
+            nn.ReLU(),
+        )
+        self.conv3 = nn.Conv2d(in_c, out_c, 1)
+
+    def forward(self, x):
+        return self.conv3(self.conv2(self.conv1(x)))
+
+
+class MLSDLargeT(nn.Module):
+    """Torch mirror of MobileV2_MLSD_Large with EXACT upstream key names
+    (backbone.features.N..., blockNN.convM.K) so convert_mlsd consumes
+    its state dict directly."""
+
+    def __init__(self):
+        super().__init__()
+        self.backbone = _MLSDBackboneT()
+        self.block15 = _BlockAT(64, 96, 64, 64, upscale=False)
+        self.block16 = _BlockBT(128, 64)
+        self.block17 = _BlockAT(32, 64, 64, 64)
+        self.block18 = _BlockBT(128, 64)
+        self.block19 = _BlockAT(24, 64, 64, 64)
+        self.block20 = _BlockBT(128, 64)
+        self.block21 = _BlockAT(16, 64, 64, 64)
+        self.block22 = _BlockBT(128, 64)
+        self.block23 = _BlockCT(64, 16)
+
+    def forward(self, x):
+        c1, c2, c3, c4, c5 = self.backbone(x)
+        x = self.block15(c4, c5)
+        x = self.block16(x)
+        x = self.block17(c3, x)
+        x = self.block18(x)
+        x = self.block19(c2, x)
+        x = self.block20(x)
+        x = self.block21(c1, x)
+        x = self.block22(x)
+        x = self.block23(x)
+        return x[:, 7:, :, :]
+
+
+class _LineartResBlockT(nn.Module):
+    def __init__(self, ch):
+        super().__init__()
+        self.conv_block = nn.Sequential(
+            nn.ReflectionPad2d(1), nn.Conv2d(ch, ch, 3),
+            nn.InstanceNorm2d(ch), nn.ReLU(inplace=True),
+            nn.ReflectionPad2d(1), nn.Conv2d(ch, ch, 3),
+            nn.InstanceNorm2d(ch),
+        )
+
+    def forward(self, x):
+        return x + self.conv_block(x)
+
+
+class LineartGeneratorT(nn.Module):
+    """Torch mirror of the informative-drawings Generator with EXACT
+    upstream key names (model0.1, model1.0/.3, model2.N.conv_block.1/.5,
+    model3.0/.3, model4.1)."""
+
+    def __init__(self, base=64, n_res=3):
+        super().__init__()
+        c = base
+        self.model0 = nn.Sequential(
+            nn.ReflectionPad2d(3), nn.Conv2d(3, c, 7),
+            nn.InstanceNorm2d(c), nn.ReLU(inplace=True),
+        )
+        self.model1 = nn.Sequential(
+            nn.Conv2d(c, 2 * c, 3, stride=2, padding=1),
+            nn.InstanceNorm2d(2 * c), nn.ReLU(inplace=True),
+            nn.Conv2d(2 * c, 4 * c, 3, stride=2, padding=1),
+            nn.InstanceNorm2d(4 * c), nn.ReLU(inplace=True),
+        )
+        self.model2 = nn.Sequential(
+            *[_LineartResBlockT(4 * c) for _ in range(n_res)]
+        )
+        self.model3 = nn.Sequential(
+            nn.ConvTranspose2d(4 * c, 2 * c, 3, stride=2, padding=1,
+                               output_padding=1),
+            nn.InstanceNorm2d(2 * c), nn.ReLU(inplace=True),
+            nn.ConvTranspose2d(2 * c, c, 3, stride=2, padding=1,
+                               output_padding=1),
+            nn.InstanceNorm2d(c), nn.ReLU(inplace=True),
+        )
+        self.model4 = nn.Sequential(
+            nn.ReflectionPad2d(3), nn.Conv2d(c, 1, 7), nn.Sigmoid()
+        )
+
+    def forward(self, x):
+        x = self.model0(x)
+        x = self.model1(x)
+        x = self.model2(x)
+        x = self.model3(x)
+        return self.model4(x)
+
+
+class _PdcConvT(nn.Module):
+    """pidinet's pixel-difference Conv2d: stores RAW 3x3 kernels (key
+    `weight`), applies the difference op functionally at forward — the
+    independent side of the convert_pdc re-parameterization."""
+
+    def __init__(self, pdc, inp, oup, groups=1):
+        super().__init__()
+        self.pdc = pdc
+        self.groups = groups
+        self.weight = nn.Parameter(torch.randn(oup, inp // groups, 3, 3) * 0.1)
+
+    def forward(self, x):
+        w = self.weight
+        if self.pdc == "cv":
+            return F.conv2d(x, w, padding=1, groups=self.groups)
+        if self.pdc == "cd":
+            yc = F.conv2d(x, w.sum(dim=[2, 3], keepdim=True),
+                          groups=self.groups)
+            y = F.conv2d(x, w, padding=1, groups=self.groups)
+            return y - yc
+        o, i = w.shape[:2]
+        flat = w.view(o, i, -1)
+        if self.pdc == "ad":
+            wc = (flat - flat[:, :, [3, 0, 1, 6, 4, 2, 7, 8, 5]]).view(
+                w.shape
+            )
+            return F.conv2d(x, wc, padding=1, groups=self.groups)
+        if self.pdc == "rd":
+            buffer = w.new_zeros(o, i, 25)
+            buffer[:, :, [0, 2, 4, 10, 14, 20, 22, 24]] = flat[:, :, 1:]
+            buffer[:, :, [6, 7, 8, 11, 13, 16, 17, 18]] = -flat[:, :, 1:]
+            return F.conv2d(x, buffer.view(o, i, 5, 5), padding=2,
+                            groups=self.groups)
+        raise ValueError(self.pdc)
+
+
+class _PDCBlockT(nn.Module):
+    def __init__(self, pdc, inplane, ouplane, stride=1):
+        super().__init__()
+        self.stride = stride
+        if stride > 1:
+            self.pool = nn.MaxPool2d(2, 2)
+            self.shortcut = nn.Conv2d(inplane, ouplane, 1)
+        self.conv1 = _PdcConvT(pdc, inplane, inplane, groups=inplane)
+        self.conv2 = nn.Conv2d(inplane, ouplane, 1, bias=False)
+
+    def forward(self, x):
+        if self.stride > 1:
+            x = self.pool(x)
+        y = self.conv2(F.relu(self.conv1(x)))
+        if self.stride > 1:
+            x = self.shortcut(x)
+        return y + x
+
+
+class _CDCMT(nn.Module):
+    def __init__(self, in_ch, out_ch):
+        super().__init__()
+        self.conv1 = nn.Conv2d(in_ch, out_ch, 1)
+        for i, d in enumerate((5, 7, 9, 11)):
+            setattr(self, f"conv2_{i + 1}",
+                    nn.Conv2d(out_ch, out_ch, 3, dilation=d, padding=d,
+                              bias=False))
+
+    def forward(self, x):
+        x = self.conv1(F.relu(x))
+        return sum(getattr(self, f"conv2_{i}")(x) for i in range(1, 5))
+
+
+class _CSAMT(nn.Module):
+    def __init__(self, channels):
+        super().__init__()
+        self.conv1 = nn.Conv2d(channels, 4, 1)
+        self.conv2 = nn.Conv2d(4, 1, 3, padding=1, bias=False)
+
+    def forward(self, x):
+        return x * torch.sigmoid(self.conv2(self.conv1(F.relu(x))))
+
+
+class _MapReduceT(nn.Module):
+    def __init__(self, channels):
+        super().__init__()
+        self.conv = nn.Conv2d(channels, 1, 1)
+
+    def forward(self, x):
+        return self.conv(x)
+
+
+class PiDiNetT(nn.Module):
+    """Torch mirror of the UNCONVERTED table5_pidinet (carv4) with exact
+    upstream key names; forward applies the pixel-difference ops
+    functionally, so convert_pidinet's re-parameterization is validated
+    against independent math (for cd, genuinely independent)."""
+
+    CARV4 = ("cd", "ad", "rd", "cv") * 4
+    PLANES = (60, 120, 240, 240)
+    DIL = 24
+
+    def __init__(self):
+        super().__init__()
+        self.init_block = _PdcConvT(self.CARV4[0], 3, 60)
+        in_ch = 60
+        for s in range(4):
+            n_blocks = 3 if s == 0 else 4
+            for j in range(n_blocks):
+                layer = j + 1 if s == 0 else s * 4 + j
+                setattr(self, f"block{s + 1}_{j + 1}", _PDCBlockT(
+                    self.CARV4[layer], in_ch, self.PLANES[s],
+                    stride=2 if (s > 0 and j == 0) else 1,
+                ))
+                in_ch = self.PLANES[s]
+        self.dilations = nn.ModuleList(
+            [_CDCMT(p, self.DIL) for p in self.PLANES]
+        )
+        self.attentions = nn.ModuleList(
+            [_CSAMT(self.DIL) for _ in self.PLANES]
+        )
+        self.conv_reduces = nn.ModuleList(
+            [_MapReduceT(self.DIL) for _ in self.PLANES]
+        )
+        self.classifier = nn.Conv2d(4, 1, 1)
+
+    def forward(self, x):
+        h, w = x.shape[2:]
+        x = self.init_block(x)
+        stage_outs = []
+        for s in range(4):
+            n_blocks = 3 if s == 0 else 4
+            for j in range(n_blocks):
+                x = getattr(self, f"block{s + 1}_{j + 1}")(x)
+            stage_outs.append(x)
+        maps = []
+        for i, xi in enumerate(stage_outs):
+            y = self.conv_reduces[i](self.attentions[i](self.dilations[i](xi)))
+            maps.append(F.interpolate(y, (h, w), mode="bilinear",
+                                      align_corners=False))
+        fused = self.classifier(torch.cat(maps, dim=1))
+        return torch.sigmoid(fused)
