@@ -159,7 +159,60 @@ def verify_local_model(model_name: str, root: Path | None = None) -> dict | None
         return _verify_cascade_model(model_name, root)
     if "stable-video" in name or "svd" in name:
         return _verify_svd_model(model_name, root)
+    if "i2vgen" in name:
+        return _verify_i2vgen_model(model_name, root)
     return _verify_sd_model(model_name, root)
+
+
+def _verify_i2vgen_model(model_name: str, root: Path) -> dict:
+    """i2vgen-xl repos: convert through the SAME recipe the pipeline
+    serves with (I2VGenXLUNet + CLIP text/vision towers + VAE, geometry
+    inferred from the checkpoints)."""
+    import jax.numpy as jnp
+
+    from .models.clip import CLIPTextEncoder
+    from .models.conversion import assert_tree_shapes_match
+    from .models.i2vgen import I2VGenXLUNet
+    from .models.safety import CLIPVisionEncoder
+    from .models.vae import AutoencoderKL
+    from .pipelines.i2vgen import convert_i2vgen_checkpoint
+
+    model_dir = root / model_name
+    if not model_dir.is_dir():
+        raise FileNotFoundError(f"no checkpoint directory {model_dir}")
+    conv = convert_i2vgen_checkpoint(model_dir)
+    ucfg = conv["unet_cfg"]
+    f = 2
+    unet_exp = _eval_shape_params(
+        I2VGenXLUNet(ucfg),
+        jnp.zeros((f, 16, 16, ucfg.in_channels)),
+        jnp.zeros((1,)), jnp.ones((1,)),
+        jnp.zeros((f, 16, 16, ucfg.in_channels)),
+        jnp.zeros((1, ucfg.cross_attention_dim)),
+        jnp.zeros((1, 4, ucfg.cross_attention_dim)),
+        num_frames=f,
+    )
+    assert_tree_shapes_match(conv["unet"], unet_exp, prefix="unet")
+    text_exp = _eval_shape_params(
+        CLIPTextEncoder(conv["clip_cfg"]), jnp.zeros((1, 77), jnp.int32)
+    )
+    assert_tree_shapes_match(conv["text"], text_exp, prefix="text_encoder")
+    icfg = conv["vision_cfg"]
+    vis_exp = _eval_shape_params(
+        CLIPVisionEncoder(icfg),
+        jnp.zeros((1, icfg.image_size, icfg.image_size, 3)),
+    )
+    assert_tree_shapes_match(conv["vision"], vis_exp, prefix="image_encoder")
+    vae_exp = _eval_shape_params(
+        AutoencoderKL(conv["vae_cfg"]), jnp.zeros((1, 32, 32, 3))
+    )
+    assert_tree_shapes_match(conv["vae"], vae_exp, prefix="vae")
+    return {
+        "unet": _param_count(conv["unet"]),
+        "text_encoder": _param_count(conv["text"]),
+        "image_encoder": _param_count(conv["vision"]),
+        "vae": _param_count(conv["vae"]),
+    }
 
 
 def _verify_upscaler_model(model_name: str, root: Path) -> dict:

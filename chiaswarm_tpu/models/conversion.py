@@ -1002,17 +1002,27 @@ def convert_hed(state: dict) -> dict:
     return convert_state_dict(state)
 
 
-def checked_converted(module, example_args, converted, prefix, rng):
+def checked_converted(module, example_args, converted, prefix, rng,
+                      example_kwargs: dict | None = None):
     """Shape-check a converted tree against a flax module via eval_shape
     (no materialized random init) and return it; geometry mismatches
     surface as MissingWeightsError naming the component. The shared
     loader-side twin of assert_tree_shapes_match, used by every pipeline
-    family that loads converted weights."""
+    family that loads converted weights. Static call arguments (e.g.
+    num_frames) must ride `example_kwargs` — eval_shape abstracts every
+    positional argument."""
+    import functools
+
     import jax
 
     from ..weights import MissingWeightsError
 
-    expected = jax.eval_shape(module.init, rng, *example_args)["params"]
+    init = (
+        functools.partial(module.init, **example_kwargs)
+        if example_kwargs
+        else module.init
+    )
+    expected = jax.eval_shape(init, rng, *example_args)["params"]
     try:
         assert_tree_shapes_match(converted, expected, prefix=prefix)
     except ValueError as e:
@@ -2311,3 +2321,44 @@ def convert_pidinet(state: dict):
         arr["classifier.weight"].transpose(2, 3, 1, 0))
     put(["classifier"], "bias", arr["classifier.bias"])
     return params
+
+
+# --- I2VGenXL (models/i2vgen.py) ---
+
+
+def i2vgen_rename(name: str) -> str:
+    """diffusers I2VGenXLUNet names -> models.i2vgen names: flatten the
+    temporal-encoder internals, then the shared unet3d trunk rename. The
+    Sequential conditioning stacks flatten by the generic digit-merge."""
+    if name.startswith("image_latents_temporal_encoder."):
+        name = name.replace(".attn1.to_q.", ".attn1_to_q.")
+        name = name.replace(".attn1.to_k.", ".attn1_to_k.")
+        name = name.replace(".attn1.to_v.", ".attn1_to_v.")
+        name = name.replace(".attn1.to_out.0.", ".attn1_to_out_0.")
+        name = name.replace(".ff.net.0.proj.", ".ff_net_0_proj.")
+        name = name.replace(".ff.net.2.", ".ff_net_2.")
+        return name
+    return unet3d_rename(name)
+
+
+def convert_i2vgen_unet(state: dict) -> dict:
+    return convert_state_dict(state, i2vgen_rename)
+
+
+def infer_i2vgen_config(state: dict, config_json: dict | None = None):
+    """I2VGenConfig from checkpoint shapes: the trunk geometry via
+    infer_unet3d_config; conv_in sees 2*in_channels (noise + projected
+    image latents)."""
+    from .i2vgen import I2VGenConfig
+
+    base = infer_unet3d_config(state, config_json)
+    return I2VGenConfig(
+        in_channels=base.in_channels // 2,
+        out_channels=base.out_channels,
+        block_out_channels=base.block_out_channels,
+        layers_per_block=base.layers_per_block,
+        attention=base.attention,
+        attention_head_dim=base.attention_head_dim,
+        cross_attention_dim=base.cross_attention_dim,
+        norm_num_groups=base.norm_num_groups,
+    )

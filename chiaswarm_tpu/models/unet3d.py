@@ -129,6 +129,115 @@ class TransformerTemporal(nn.Module):
         return hidden.reshape(bf, h, w, c) + residual
 
 
+def unet3d_backbone(cfg: UNet3DConfig, dtype, sample, temb, ctx,
+                    num_frames: int):
+    """conv_in -> transformer_in -> down/mid/up -> out head, with the
+    module names conversion.unet3d_rename maps. Must be called inside a
+    parent module's compact `__call__` (inline submodules register on the
+    caller) — shared by UNet3DConditionModel and the I2VGenXL variant,
+    which differ only in the conditioning assembled around this trunk."""
+    g = cfg.norm_num_groups
+    heads_of = lambda ch: ch // cfg.attention_head_dim
+    x = nn.Conv(
+        cfg.block_out_channels[0], (3, 3), padding=((1, 1), (1, 1)),
+        dtype=dtype, name="conv_in",
+    )(sample)
+    # diffusers builds transformer_in with 8 heads of
+    # attention_head_dim regardless of the block width
+    x = TransformerTemporal(
+        8, cfg.attention_head_dim, groups=g, dtype=dtype,
+        name="transformer_in",
+    )(x, num_frames)
+
+    skips = [x]
+    for bidx, out_ch in enumerate(cfg.block_out_channels):
+        last = bidx == len(cfg.block_out_channels) - 1
+        for i in range(cfg.layers_per_block):
+            x = ResnetBlock2D(
+                out_ch, dtype=dtype,
+                name=f"down_{bidx}_resnets_{i}",
+            )(x, temb)
+            x = TemporalConvLayer(
+                out_ch, groups=g, dtype=dtype,
+                name=f"down_{bidx}_temp_convs_{i}",
+            )(x, num_frames)
+            if cfg.attention[bidx]:
+                x = Transformer2DModel(
+                    heads_of(out_ch), cfg.attention_head_dim, 1,
+                    dtype=dtype,
+                    name=f"down_{bidx}_attentions_{i}",
+                )(x, ctx)
+                x = TransformerTemporal(
+                    heads_of(out_ch), cfg.attention_head_dim, groups=g,
+                    dtype=dtype,
+                    name=f"down_{bidx}_temp_attentions_{i}",
+                )(x, num_frames)
+            skips.append(x)
+        if not last:
+            x = Downsample2D(
+                out_ch, dtype=dtype, name=f"down_{bidx}_downsample"
+            )(x)
+            skips.append(x)
+
+    mid_ch = cfg.block_out_channels[-1]
+    x = ResnetBlock2D(mid_ch, dtype=dtype, name="mid_resnets_0")(
+        x, temb
+    )
+    x = TemporalConvLayer(
+        mid_ch, groups=g, dtype=dtype, name="mid_temp_convs_0"
+    )(x, num_frames)
+    x = Transformer2DModel(
+        heads_of(mid_ch), cfg.attention_head_dim, 1, dtype=dtype,
+        name="mid_attentions_0",
+    )(x, ctx)
+    x = TransformerTemporal(
+        heads_of(mid_ch), cfg.attention_head_dim, groups=g,
+        dtype=dtype, name="mid_temp_attentions_0",
+    )(x, num_frames)
+    x = ResnetBlock2D(mid_ch, dtype=dtype, name="mid_resnets_1")(
+        x, temb
+    )
+    x = TemporalConvLayer(
+        mid_ch, groups=g, dtype=dtype, name="mid_temp_convs_1"
+    )(x, num_frames)
+
+    for bidx, out_ch in enumerate(reversed(cfg.block_out_channels)):
+        rev = len(cfg.block_out_channels) - 1 - bidx
+        last = bidx == len(cfg.block_out_channels) - 1
+        for i in range(cfg.layers_per_block + 1):
+            x = jnp.concatenate([x, skips.pop()], axis=-1)
+            x = ResnetBlock2D(
+                out_ch, dtype=dtype, name=f"up_{bidx}_resnets_{i}"
+            )(x, temb)
+            x = TemporalConvLayer(
+                out_ch, groups=g, dtype=dtype,
+                name=f"up_{bidx}_temp_convs_{i}",
+            )(x, num_frames)
+            if cfg.attention[rev]:
+                x = Transformer2DModel(
+                    heads_of(out_ch), cfg.attention_head_dim, 1,
+                    dtype=dtype,
+                    name=f"up_{bidx}_attentions_{i}",
+                )(x, ctx)
+                x = TransformerTemporal(
+                    heads_of(out_ch), cfg.attention_head_dim, groups=g,
+                    dtype=dtype,
+                    name=f"up_{bidx}_temp_attentions_{i}",
+                )(x, num_frames)
+        if not last:
+            x = Upsample2D(
+                out_ch, dtype=dtype, name=f"up_{bidx}_upsample"
+            )(x)
+
+    x = nn.GroupNorm(g, epsilon=1e-5, dtype=dtype,
+                     name="conv_norm_out")(x)
+    x = nn.silu(x)
+    return nn.Conv(
+        cfg.out_channels, (3, 3), padding=((1, 1), (1, 1)),
+        dtype=dtype, name="conv_out",
+    )(x)
+
+
 class UNet3DConditionModel(nn.Module):
     config: UNet3DConfig
     dtype: jnp.dtype = jnp.float32
@@ -140,7 +249,6 @@ class UNet3DConditionModel(nn.Module):
         [B*F, S, D] (text states repeated per frame) -> [B*F, H, W, C_out].
         """
         cfg = self.config
-        g = cfg.norm_num_groups
         if jnp.ndim(timesteps) == 0:
             timesteps = jnp.broadcast_to(timesteps, (sample.shape[0],))
 
@@ -152,104 +260,6 @@ class UNet3DConditionModel(nn.Module):
             temb_dim, dtype=self.dtype, name="time_embedding"
         )(t_feat)
         ctx = encoder_hidden_states.astype(self.dtype)
-
-        heads_of = lambda ch: ch // cfg.attention_head_dim
-
-        x = nn.Conv(
-            cfg.block_out_channels[0], (3, 3), padding=((1, 1), (1, 1)),
-            dtype=self.dtype, name="conv_in",
-        )(sample)
-        # diffusers builds transformer_in with 8 heads of
-        # attention_head_dim regardless of the block width
-        x = TransformerTemporal(
-            8, cfg.attention_head_dim, groups=g, dtype=self.dtype,
-            name="transformer_in",
-        )(x, num_frames)
-
-        skips = [x]
-        for bidx, out_ch in enumerate(cfg.block_out_channels):
-            last = bidx == len(cfg.block_out_channels) - 1
-            for i in range(cfg.layers_per_block):
-                x = ResnetBlock2D(
-                    out_ch, dtype=self.dtype,
-                    name=f"down_{bidx}_resnets_{i}",
-                )(x, temb)
-                x = TemporalConvLayer(
-                    out_ch, groups=g, dtype=self.dtype,
-                    name=f"down_{bidx}_temp_convs_{i}",
-                )(x, num_frames)
-                if cfg.attention[bidx]:
-                    x = Transformer2DModel(
-                        heads_of(out_ch), cfg.attention_head_dim, 1,
-                        dtype=self.dtype,
-                        name=f"down_{bidx}_attentions_{i}",
-                    )(x, ctx)
-                    x = TransformerTemporal(
-                        heads_of(out_ch), cfg.attention_head_dim, groups=g,
-                        dtype=self.dtype,
-                        name=f"down_{bidx}_temp_attentions_{i}",
-                    )(x, num_frames)
-                skips.append(x)
-            if not last:
-                x = Downsample2D(
-                    out_ch, dtype=self.dtype, name=f"down_{bidx}_downsample"
-                )(x)
-                skips.append(x)
-
-        mid_ch = cfg.block_out_channels[-1]
-        x = ResnetBlock2D(mid_ch, dtype=self.dtype, name="mid_resnets_0")(
-            x, temb
+        return unet3d_backbone(
+            cfg, self.dtype, sample, temb, ctx, num_frames
         )
-        x = TemporalConvLayer(
-            mid_ch, groups=g, dtype=self.dtype, name="mid_temp_convs_0"
-        )(x, num_frames)
-        x = Transformer2DModel(
-            heads_of(mid_ch), cfg.attention_head_dim, 1, dtype=self.dtype,
-            name="mid_attentions_0",
-        )(x, ctx)
-        x = TransformerTemporal(
-            heads_of(mid_ch), cfg.attention_head_dim, groups=g,
-            dtype=self.dtype, name="mid_temp_attentions_0",
-        )(x, num_frames)
-        x = ResnetBlock2D(mid_ch, dtype=self.dtype, name="mid_resnets_1")(
-            x, temb
-        )
-        x = TemporalConvLayer(
-            mid_ch, groups=g, dtype=self.dtype, name="mid_temp_convs_1"
-        )(x, num_frames)
-
-        for bidx, out_ch in enumerate(reversed(cfg.block_out_channels)):
-            rev = len(cfg.block_out_channels) - 1 - bidx
-            last = bidx == len(cfg.block_out_channels) - 1
-            for i in range(cfg.layers_per_block + 1):
-                x = jnp.concatenate([x, skips.pop()], axis=-1)
-                x = ResnetBlock2D(
-                    out_ch, dtype=self.dtype, name=f"up_{bidx}_resnets_{i}"
-                )(x, temb)
-                x = TemporalConvLayer(
-                    out_ch, groups=g, dtype=self.dtype,
-                    name=f"up_{bidx}_temp_convs_{i}",
-                )(x, num_frames)
-                if cfg.attention[rev]:
-                    x = Transformer2DModel(
-                        heads_of(out_ch), cfg.attention_head_dim, 1,
-                        dtype=self.dtype,
-                        name=f"up_{bidx}_attentions_{i}",
-                    )(x, ctx)
-                    x = TransformerTemporal(
-                        heads_of(out_ch), cfg.attention_head_dim, groups=g,
-                        dtype=self.dtype,
-                        name=f"up_{bidx}_temp_attentions_{i}",
-                    )(x, num_frames)
-            if not last:
-                x = Upsample2D(
-                    out_ch, dtype=self.dtype, name=f"up_{bidx}_upsample"
-                )(x)
-
-        x = nn.GroupNorm(g, epsilon=1e-5, dtype=self.dtype,
-                         name="conv_norm_out")(x)
-        x = nn.silu(x)
-        return nn.Conv(
-            cfg.out_channels, (3, 3), padding=((1, 1), (1, 1)),
-            dtype=self.dtype, name="conv_out",
-        )(x)

@@ -4,8 +4,8 @@ Reference swarm/video/* rebuilt TPU-first:
 - txt2vid (tx2vid.py:15-81): motion-module UNet, whole clip denoised in ONE
   jitted scan (frames ride the batch dim), VAE-decoded per frame, exported
   mp4/webm/gif.
-- img2vid (img2vid.py:14-38): SVD-style — the conditioning frame's latents
-  concatenate onto every frame's channels (in_channels 8).
+- img2vid (img2vid.py:14-38): owned by pipelines/svd.py (SVD) and
+  pipelines/i2vgen.py (I2VGenXL, the workflow default).
 - vid2vid (pix2pix.py:14-191): the reference edits frames one at a time in
   a Python loop (up to 100 sequential pipeline calls, :47-68); here frames
   batch through the image pipeline's jitted program in fixed-size chunks.
@@ -174,26 +174,17 @@ def _video_configs(model_name: str):
 class VideoPipeline:
     """Resident motion-module pipeline; serves txt2vid and img2vid."""
 
-    def __init__(self, model_name: str, chipset=None, image_conditioned=False,
+    def __init__(self, model_name: str, chipset=None,
                  allow_random_init: bool = False, motion_adapter=None):
         from ..weights import require_weights_present
 
         self.model_name = model_name
         self.chipset = chipset
-        self.image_conditioned = image_conditioned
-        # img2vid (SVD-style 8ch conditioning) has no conversion path yet;
         # txt2vid serves real AnimateDiff weights (spatial SD1.5 checkpoint
-        # + motion adapter)
-        self._loaded_adapter = (
-            (motion_adapter or DEFAULT_MOTION_ADAPTER)
-            if not image_conditioned
-            else None
-        )
-        self._converted = (
-            None
-            if image_conditioned
-            else _load_converted_video(model_name, motion_adapter)
-        )
+        # + motion adapter) or a native UNet3D checkpoint; img2vid is owned
+        # by pipelines/svd.py and pipelines/i2vgen.py
+        self._loaded_adapter = motion_adapter or DEFAULT_MOTION_ADAPTER
+        self._converted = _load_converted_video(model_name, motion_adapter)
         if self._converted is None:
             require_weights_present(
                 model_name, None, allow_random_init,
@@ -203,12 +194,6 @@ class VideoPipeline:
                      "the motion adapter downloaded (initialize --download).",
             )
         video_cfg, clip_cfg, vae_cfg, self.default_size = _video_configs(model_name)
-        if image_conditioned:
-            # SVD layout: noise latents + conditioning-frame latents stacked
-            video_cfg = VideoUNetConfig(
-                base=_replace(video_cfg.base, in_channels=8),
-                num_frames=video_cfg.num_frames,
-            )
         if self._converted and "clip_cfg" in self._converted:
             # native UNet3D checkpoints carry their own tower geometry
             clip_cfg = self._converted["clip_cfg"]
@@ -396,7 +381,7 @@ class VideoPipeline:
         scheduler = get_scheduler(sched_name)
         schedule = scheduler.schedule(steps)
 
-        def run(params, latents, context, guidance_scale, cond_latents, rng):
+        def run(params, latents, context, guidance_scale, rng):
             """latents [F, lh, lw, 4]; context [2, 77, D] = (uncond, cond)."""
             latents = latents * jnp.asarray(schedule.init_noise_sigma, latents.dtype)
             state = scheduler.init_state(latents.shape, latents.dtype)
@@ -412,10 +397,6 @@ class VideoPipeline:
             def body(carry, i):
                 latents, state = carry
                 inp = scheduler.scale_model_input(schedule, latents, i)
-                if self.image_conditioned:
-                    inp = jnp.concatenate(
-                        [inp, cond_latents.astype(inp.dtype)], axis=-1
-                    )
                 model_in = jnp.concatenate([inp, inp], axis=0).astype(self.dtype)
                 t = jnp.broadcast_to(
                     jnp.asarray(schedule.timesteps)[i], (model_in.shape[0],)
@@ -509,25 +490,6 @@ class VideoPipeline:
         rng, init_rng, step_rng = jax.random.split(rng, 3)
         noise = jax.random.normal(init_rng, (frames, lh, lw, 4), jnp.float32)
 
-        cond_latents = jnp.zeros((1, 1, 1, 4), jnp.float32)
-        if self.image_conditioned:
-            if image is None:
-                raise ValueError("img2vid requires an input image. None provided")
-            arr = (
-                np.asarray(
-                    image.convert("RGB").resize((width, height), Image.LANCZOS),
-                    np.float32,
-                )
-                / 127.5
-                - 1.0
-            )
-            enc = self.vae.apply(
-                {"params": params["vae"]},
-                jnp.asarray(arr)[None].astype(self.dtype),
-                method=self.vae.encode,
-            ).astype(jnp.float32)
-            cond_latents = jnp.broadcast_to(enc, (frames, lh, lw, 4))
-
         key = (lh, lw, frames, steps, scheduler_type)
         t0 = time.perf_counter()
         program = self._program(key)
@@ -537,7 +499,7 @@ class VideoPipeline:
         with sequence_parallel_scope(mesh):
             pixels = jax.block_until_ready(
                 program(params, noise, context, jnp.float32(guidance_scale),
-                        cond_latents, step_rng)
+                        step_rng)
             )
         timings["denoise_decode_s"] = round(time.perf_counter() - t0, 3)
 
@@ -567,16 +529,11 @@ class VideoPipeline:
 
 @register_family("animatediff")
 def _build_animatediff(model_name, chipset, **variant):
-    return VideoPipeline(model_name, chipset, image_conditioned=False, **variant)
+    return VideoPipeline(model_name, chipset, **variant)
 
 
-def _build_img2vid(model_name, chipset, **variant):
-    return VideoPipeline(model_name, chipset, image_conditioned=True, **variant)
-
-
-# "svd" is owned by pipelines/svd.py (true spatio-temporal architecture
-# with conversion); I2VGenXL still rides the motion-module approximation
-register_family("i2vgenxl")(_build_img2vid)
+# "svd" is owned by pipelines/svd.py and "i2vgenxl" by pipelines/i2vgen.py
+# (true architectures with conversion).
 
 
 def _frames_artifact(frames, fps, content_type):

@@ -177,9 +177,9 @@ def _ensure_builtin_families() -> None:
     if _BUILTINS_LOADED:
         return
     _BUILTINS_LOADED = True
-    for module in ("stable_diffusion", "video", "svd", "audio", "captioning",
-                   "flux", "kandinsky", "kandinsky3", "cascade", "upscale",
-                   "deepfloyd", "bark"):
+    for module in ("stable_diffusion", "video", "svd", "i2vgen", "audio",
+                   "captioning", "flux", "kandinsky", "kandinsky3", "cascade",
+                   "upscale", "deepfloyd", "bark"):
         try:
             __import__(f"{__package__}.pipelines.{module}")
         except Exception as e:

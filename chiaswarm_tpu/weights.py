@@ -81,5 +81,4 @@ def model_dir_for(model_name: str):
 # (VERDICT r03 weak #7).
 UNCONVERTED_FAMILY_KEYWORDS = (
     "audioldm2",
-    "i2vgen",
 )

@@ -1,0 +1,329 @@
+"""I2VGenXL conversion contract (VERDICT r03 missing #1: the reference's
+DEFAULT img2vid pipeline type, swarm/job_arguments.py:143).
+
+The checkpoint side is a torch mirror with exact diffusers key names
+(trunk pieces shared with test_unet3d_conversion's UNet3DT components):
+random torch init -> state dict -> convert -> flax forward must equal the
+torch forward, covering the FPS embedding, the image-latents projection +
+frame-axis temporal encoder, the three-source context assembly (text +
+adaptive-pooled first-frame grid + lifted image embedding), and the
+shared 3D trunk.
+"""
+
+import os
+import sys
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+sys.path.insert(0, os.path.dirname(__file__))
+
+torch = pytest.importorskip("torch")
+import torch.nn as nn  # noqa: E402
+import torch.nn.functional as F  # noqa: E402
+
+from test_unet3d_conversion import UNet3DT  # noqa: E402
+from torch_unet_ref import TimestepEmbeddingT, timestep_embedding_t  # noqa: E402
+
+from chiaswarm_tpu.models.conversion import (  # noqa: E402
+    convert_i2vgen_unet,
+    infer_i2vgen_config,
+)
+from chiaswarm_tpu.models.i2vgen import (  # noqa: E402
+    TINY_I2VGEN,
+    I2VGenXLUNet,
+)
+
+
+class _GELUProj(nn.Module):
+    def __init__(self, dim, inner):
+        super().__init__()
+        self.proj = nn.Linear(dim, inner)
+
+    def forward(self, x):
+        return F.gelu(self.proj(x))
+
+
+class _TemporalEncoderT(nn.Module):
+    """I2VGenXLTransformerTemporalEncoder with exact diffusers keys."""
+
+    def __init__(self, dim, heads=2):
+        super().__init__()
+        self.heads = heads
+        self.head_dim = dim // heads
+        self.norm1 = nn.LayerNorm(dim)
+        attn = nn.Module()
+        attn.to_q = nn.Linear(dim, dim, bias=False)
+        attn.to_k = nn.Linear(dim, dim, bias=False)
+        attn.to_v = nn.Linear(dim, dim, bias=False)
+        attn.to_out = nn.ModuleList([nn.Linear(dim, dim)])
+        self.attn1 = attn
+        ff = nn.Module()
+        ff.net = nn.ModuleList([_GELUProj(dim, 4 * dim), nn.Identity(),
+                                nn.Linear(4 * dim, dim)])
+        self.ff = ff
+
+    def forward(self, tokens):
+        b, f, d = tokens.shape
+        h = self.norm1(tokens)
+        q = self.attn1.to_q(h).view(b, f, self.heads, self.head_dim)
+        k = self.attn1.to_k(h).view(b, f, self.heads, self.head_dim)
+        v = self.attn1.to_v(h).view(b, f, self.heads, self.head_dim)
+        q, k, v = (t.transpose(1, 2) for t in (q, k, v))
+        attn = (q @ k.transpose(-1, -2) * self.head_dim ** -0.5).softmax(-1) @ v
+        attn = attn.transpose(1, 2).reshape(b, f, d)
+        tokens = tokens + self.attn1.to_out[0](attn)
+        return tokens + self.ff.net[2](self.ff.net[0](tokens))
+
+
+class I2VGenXLUNetT(UNet3DT):
+    """Exact-key diffusers I2VGenXLUNet mirror: the UNet3DT trunk (8-ch
+    conv_in) plus the I2VGen conditioning modules."""
+
+    def __init__(self, cfg):
+        super().__init__(cfg.trunk())
+        c0 = cfg.block_out_channels[0]
+        temb_dim = 4 * c0
+        cross = cfg.cross_attention_dim
+        ic = cfg.in_channels
+        self.i2v_cfg = cfg
+        self.fps_embedding = TimestepEmbeddingT(c0, temb_dim)
+        self.image_latents_proj_in = nn.Sequential(
+            nn.Conv2d(ic, 4 * ic, 1), nn.SiLU(),
+            nn.Conv2d(4 * ic, 4 * ic, 3, padding=1), nn.SiLU(),
+            nn.Conv2d(4 * ic, ic, 3, padding=1),
+        )
+        self.image_latents_temporal_encoder = _TemporalEncoderT(ic)
+        self.image_latents_context_embedding = nn.Sequential(
+            nn.Conv2d(ic, 8 * ic, 3, padding=1), nn.SiLU(),
+            nn.AdaptiveAvgPool2d((32, 32)),
+            nn.Conv2d(8 * ic, 16 * ic, 3, stride=2, padding=1), nn.SiLU(),
+            nn.Conv2d(16 * ic, cross, 3, stride=2, padding=1),
+        )
+        self.context_embedding = nn.Sequential(
+            nn.Linear(cross, temb_dim), nn.SiLU(),
+            nn.Linear(temb_dim, ic * cross),
+        )
+
+    def forward(self, sample, timesteps, fps, image_latents,
+                image_embeddings, encoder_hidden_states, num_frames):
+        cfg = self.i2v_cfg
+        c0 = cfg.block_out_channels[0]
+        bf = sample.shape[0]
+        b = bf // num_frames
+        temb = self.time_embedding(timestep_embedding_t(timesteps, c0))
+        temb = temb + self.fps_embedding(timestep_embedding_t(fps, c0))
+        temb = temb.repeat_interleave(num_frames, dim=0)
+
+        first = image_latents.view(b, num_frames, *image_latents.shape[1:])[
+            :, 0
+        ]
+        y = self.image_latents_context_embedding(first)
+        latent_tokens = y.flatten(2).permute(0, 2, 1)
+        img = self.context_embedding(image_embeddings)
+        img_tokens = img.view(b, cfg.in_channels, cfg.cross_attention_dim)
+        ctx = torch.cat([encoder_hidden_states, latent_tokens, img_tokens],
+                        dim=1)
+        ctx = ctx.repeat_interleave(num_frames, dim=0)
+
+        il = self.image_latents_proj_in(image_latents)
+        _, c, h, w = il.shape
+        tokens = il.view(b, num_frames, c, h * w).permute(0, 3, 1, 2)
+        tokens = tokens.reshape(b * h * w, num_frames, c)
+        tokens = self.image_latents_temporal_encoder(tokens)
+        il = tokens.view(b, h * w, num_frames, c).permute(0, 2, 3, 1)
+        il = il.reshape(bf, c, h, w)
+
+        x = torch.cat([sample, il], dim=1)
+
+        # the UNet3DT trunk, with temb/ctx precomputed
+        x = self.conv_in(x)
+        x = self.transformer_in(x, num_frames)
+        skips = [x]
+        for stage in self.down_blocks:
+            for i, resnet in enumerate(stage.resnets):
+                x = resnet(x, temb)
+                x = stage.temp_convs[i](x, num_frames)
+                if hasattr(stage, "attentions"):
+                    x = stage.attentions[i](x, ctx)
+                    x = stage.temp_attentions[i](x, num_frames)
+                skips.append(x)
+            if hasattr(stage, "downsamplers"):
+                x = stage.downsamplers[0].conv(x)
+                skips.append(x)
+        m = self.mid_block
+        x = m.resnets[0](x, temb)
+        x = m.temp_convs[0](x, num_frames)
+        x = m.attentions[0](x, ctx)
+        x = m.temp_attentions[0](x, num_frames)
+        x = m.resnets[1](x, temb)
+        x = m.temp_convs[1](x, num_frames)
+        for stage in self.up_blocks:
+            for i, resnet in enumerate(stage.resnets):
+                x = torch.cat([x, skips.pop()], dim=1)
+                x = resnet(x, temb)
+                x = stage.temp_convs[i](x, num_frames)
+                if hasattr(stage, "attentions"):
+                    x = stage.attentions[i](x, ctx)
+                    x = stage.temp_attentions[i](x, num_frames)
+            if hasattr(stage, "upsamplers"):
+                x = F.interpolate(x, scale_factor=2.0, mode="nearest")
+                x = stage.upsamplers[0].conv(x)
+        return self.conv_out(F.silu(self.conv_norm_out(x)))
+
+
+def _state_numpy(module) -> dict:
+    return {k: v.detach().numpy() for k, v in module.state_dict().items()}
+
+
+@pytest.fixture(scope="module")
+def mirror():
+    torch.manual_seed(80)
+    m = I2VGenXLUNetT(TINY_I2VGEN)
+    m.eval()
+    return m
+
+
+def test_i2vgen_config_inferred(mirror):
+    cfg = infer_i2vgen_config(
+        _state_numpy(mirror),
+        {"attention_head_dim": TINY_I2VGEN.attention_head_dim,
+         "norm_num_groups": TINY_I2VGEN.norm_num_groups},
+    )
+    assert cfg == TINY_I2VGEN
+
+
+def test_i2vgen_torch_parity(mirror):
+    params = convert_i2vgen_unet(_state_numpy(mirror))
+    rng = np.random.default_rng(81)
+    b, f, hw = 2, 3, 16
+    cfg = TINY_I2VGEN
+    sample = rng.standard_normal((b * f, hw, hw, 4)).astype(np.float32)
+    t = np.asarray([2.0, 500.0], np.float32)
+    fps = np.asarray([16.0, 16.0], np.float32)
+    il = rng.standard_normal((b * f, hw, hw, 4)).astype(np.float32)
+    emb = rng.standard_normal((b, cfg.cross_attention_dim)).astype(
+        np.float32
+    )
+    ctx = rng.standard_normal((b, 5, cfg.cross_attention_dim)).astype(
+        np.float32
+    )
+
+    def nchw(x):
+        return torch.from_numpy(x).permute(0, 3, 1, 2)
+
+    with torch.no_grad():
+        out_t = mirror(
+            nchw(sample), torch.from_numpy(t), torch.from_numpy(fps),
+            nchw(il), torch.from_numpy(emb), torch.from_numpy(ctx), f,
+        ).permute(0, 2, 3, 1).numpy()
+
+    out_f = I2VGenXLUNet(cfg).apply(
+        {"params": params}, jnp.asarray(sample), jnp.asarray(t),
+        jnp.asarray(fps), jnp.asarray(il), jnp.asarray(emb),
+        jnp.asarray(ctx), f,
+    )
+    np.testing.assert_allclose(np.asarray(out_f), out_t, atol=3e-4, rtol=1e-3)
+
+
+def test_full_i2vgen_repo_check_and_pipeline(sdaas_root, tmp_path):
+    """A complete synthetic i2vgen-xl repo — torch-mirror UNet + VAE, REAL
+    transformers CLIP text/vision state dicts — passes `initialize
+    --check` AND serves an img2vid job end-to-end with converted weights
+    (the reference's default img2vid path, swarm/job_arguments.py:143)."""
+    import json
+
+    from PIL import Image
+    from safetensors.numpy import save_file
+    from transformers import (
+        CLIPTextConfig as HFCLIPTextConfig,
+        CLIPTextModel,
+        CLIPVisionConfig as HFCLIPVisionConfig,
+        CLIPVisionModelWithProjection,
+    )
+
+    from torch_unet_ref import AutoencoderKLT
+
+    from chiaswarm_tpu.initialize import verify_local_model
+    from chiaswarm_tpu.models import configs as cfgs
+    from chiaswarm_tpu.pipelines.video import run_img2vid
+    from chiaswarm_tpu.settings import Settings, save_settings
+
+    name = "ali-vilab/i2vgen-xl"
+    root = tmp_path / "models"
+    save_settings(Settings(model_root_dir=str(root)))
+    repo = root / name
+    torch.manual_seed(82)
+
+    (repo / "unet").mkdir(parents=True)
+    save_file(
+        _state_numpy(I2VGenXLUNetT(TINY_I2VGEN)),
+        str(repo / "unet" / "diffusion_pytorch_model.safetensors"),
+    )
+    (repo / "unet" / "config.json").write_text(json.dumps({
+        "attention_head_dim": TINY_I2VGEN.attention_head_dim,
+        "norm_num_groups": TINY_I2VGEN.norm_num_groups,
+    }))
+
+    text = CLIPTextModel(HFCLIPTextConfig(
+        vocab_size=1000, hidden_size=16, num_hidden_layers=2,
+        num_attention_heads=2, intermediate_size=64,
+        max_position_embeddings=77, hidden_act="gelu",
+    ))
+    (repo / "text_encoder").mkdir(parents=True)
+    save_file(
+        {k: v.numpy() for k, v in text.state_dict().items()},
+        str(repo / "text_encoder" / "model.safetensors"),
+    )
+    (repo / "text_encoder" / "config.json").write_text(json.dumps({
+        "vocab_size": 1000, "hidden_size": 16, "num_hidden_layers": 2,
+        "num_attention_heads": 2, "hidden_act": "gelu",
+    }))
+
+    vision = CLIPVisionModelWithProjection(HFCLIPVisionConfig(
+        image_size=32, patch_size=8, hidden_size=24, num_hidden_layers=2,
+        num_attention_heads=2, intermediate_size=96,
+        projection_dim=TINY_I2VGEN.cross_attention_dim,
+        hidden_act="quick_gelu",
+    ))
+    (repo / "image_encoder").mkdir(parents=True)
+    save_file(
+        {k: v.numpy() for k, v in vision.state_dict().items()},
+        str(repo / "image_encoder" / "model.safetensors"),
+    )
+    (repo / "image_encoder" / "config.json").write_text(json.dumps({
+        "image_size": 32, "patch_size": 8, "hidden_size": 24,
+        "num_hidden_layers": 2, "num_attention_heads": 2,
+        "projection_dim": TINY_I2VGEN.cross_attention_dim,
+        "hidden_act": "quick_gelu",
+    }))
+
+    vae = AutoencoderKLT(cfgs.TINY_VAE)
+    (repo / "vae").mkdir(parents=True)
+    save_file(
+        _state_numpy(vae),
+        str(repo / "vae" / "diffusion_pytorch_model.safetensors"),
+    )
+    (repo / "vae" / "config.json").write_text(json.dumps({
+        "scaling_factor": 0.18215,
+    }))
+
+    report = verify_local_model(name, root)
+    assert report is not None
+    assert set(report) == {"unet", "text_encoder", "image_encoder", "vae"}
+
+    start = Image.fromarray(
+        (np.random.default_rng(83).random((64, 64, 3)) * 255).astype(
+            np.uint8
+        )
+    )
+    artifacts, config = run_img2vid(
+        "cpu", name, image=start, prompt="a drifting boat",
+        num_inference_steps=2, num_frames=3,
+        rng=__import__("jax").random.key(84),
+    )
+    assert artifacts["primary"]["blob"]
+    assert config["frames"] == 3
+    assert config["pipeline"] == "I2VGenXLPipeline"
