@@ -141,6 +141,8 @@ def verify_local_model(model_name: str, root: Path | None = None) -> dict | None
         return _verify_upscaler_model(model_name, root)
     if "kandinsky" in name:
         return _verify_kandinsky_model(model_name, root)
+    if "audioldm2" in name:
+        return _verify_audioldm2_model(model_name, root)
     if "audioldm" in name:
         return _verify_audioldm_model(model_name, root)
     if "bark" in name:
@@ -162,6 +164,53 @@ def verify_local_model(model_name: str, root: Path | None = None) -> dict | None
     if "i2vgen" in name:
         return _verify_i2vgen_model(model_name, root)
     return _verify_sd_model(model_name, root)
+
+
+def _verify_audioldm2_model(model_name: str, root: Path) -> dict:
+    """AudioLDM2 repos: convert through the SAME recipe the pipeline
+    serves with (dual-conditioned UNet + CLAP/T5 towers + GPT-2 +
+    projection + mel VAE + vocoder)."""
+    import jax.numpy as jnp
+
+    from .models.audioldm2_unet import AudioLDM2Projection, AudioLDM2UNet
+    from .models.conversion import assert_tree_shapes_match
+    from .models.gpt2 import GPT2Model
+    from .pipelines.audioldm2 import convert_audioldm2_checkpoint
+
+    model_dir = root / model_name
+    if not model_dir.is_dir():
+        raise FileNotFoundError(f"no checkpoint directory {model_dir}")
+    conv = convert_audioldm2_checkpoint(model_dir)
+    ucfg = conv["unet_cfg"]
+    unet_exp = _eval_shape_params(
+        AudioLDM2UNet(ucfg),
+        jnp.zeros((1, 16, 8, ucfg.in_channels)), jnp.zeros((1,)),
+        jnp.zeros((1, 4, ucfg.cross_attention_dims[0])), jnp.ones((1, 4)),
+        jnp.zeros((1, 4, ucfg.cross_attention_dims[1])), jnp.ones((1, 4)),
+    )
+    assert_tree_shapes_match(conv["unet"], unet_exp, prefix="unet")
+    gcfg = conv["gpt2_cfg"]
+    gpt_exp = _eval_shape_params(
+        GPT2Model(gcfg), jnp.zeros((1, 4, gcfg.hidden_size))
+    )
+    assert_tree_shapes_match(conv["gpt2"], gpt_exp, prefix="language_model")
+    proj_exp = _eval_shape_params(
+        AudioLDM2Projection(ucfg.cross_attention_dims[0]),
+        jnp.zeros((1, 1, conv["clap_cfg"].projection_dim)),
+        jnp.ones((1, 1)),
+        jnp.zeros((1, 4, conv["t5_cfg"].d_model)), jnp.ones((1, 4)),
+    )
+    assert_tree_shapes_match(conv["proj"], proj_exp,
+                             prefix="projection_model")
+    return {
+        "unet": _param_count(conv["unet"]),
+        "language_model": _param_count(conv["gpt2"]),
+        "text_encoder": _param_count(conv["clap"]),
+        "text_encoder_2": _param_count(conv["t5"]),
+        "projection_model": _param_count(conv["proj"]),
+        "vae": _param_count(conv["vae"]),
+        "vocoder": _param_count(conv["vocoder"]),
+    }
 
 
 def _verify_i2vgen_model(model_name: str, root: Path) -> dict:

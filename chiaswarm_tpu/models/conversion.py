@@ -2362,3 +2362,160 @@ def infer_i2vgen_config(state: dict, config_json: dict | None = None):
         cross_attention_dim=base.cross_attention_dim,
         norm_num_groups=base.norm_num_groups,
     )
+
+
+# --- GPT-2 trunk (models/gpt2.py — AudioLDM2's language model) ---
+
+
+def gpt2_config_from_json(cj: dict | None):
+    from .gpt2 import GPT2Config
+
+    cj = cj or {}
+    base = GPT2Config()
+    return GPT2Config(
+        hidden_size=int(cj.get("n_embd", base.hidden_size)),
+        num_layers=int(cj.get("n_layer", base.num_layers)),
+        num_heads=int(cj.get("n_head", base.num_heads)),
+        n_positions=int(cj.get("n_positions", base.n_positions)),
+        layer_norm_epsilon=float(
+            cj.get("layer_norm_epsilon", base.layer_norm_epsilon)
+        ),
+    )
+
+
+def convert_gpt2(state: dict) -> dict:
+    """transformers GPT2Model names -> models.gpt2 params. Conv1D weights
+    are already (in, out) = flax Dense layout, so they copy UNtransposed;
+    wte and the causal-mask buffers are dead weight for embeds-in
+    serving."""
+    import re
+
+    params: dict = {}
+    for name, v in state.items():
+        if name.startswith("transformer."):
+            name = name[len("transformer."):]
+        if name == "wte.weight" or name.endswith((".attn.bias",
+                                                  ".attn.masked_bias")):
+            continue
+        v = np.asarray(v)
+        if name == "wpe.weight":
+            _assign(params, ["wpe"], v)
+            continue
+        if name in ("ln_f.weight", "ln_f.bias"):
+            leaf = "scale" if name.endswith("weight") else "bias"
+            _assign(params, ["ln_f", leaf], v)
+            continue
+        m = re.match(r"h\.(\d+)\.(.+)$", name)
+        if not m:
+            continue
+        block = f"h_{m.group(1)}"
+        sub = m.group(2)
+        leaf = "bias" if sub.endswith(".bias") else "weight"
+        target = {
+            "ln_1": ["ln_1"],
+            "ln_2": ["ln_2"],
+            "attn.c_attn": ["c_attn"],
+            "attn.c_proj": ["c_proj"],
+            "mlp.c_fc": ["c_fc"],
+            "mlp.c_proj": ["mlp_c_proj"],
+        }.get(sub.rsplit(".", 1)[0])
+        if target is None:
+            continue
+        if target[0].startswith("ln"):
+            new_leaf = "scale" if leaf == "weight" else "bias"
+        else:
+            new_leaf = "kernel" if leaf == "weight" else "bias"
+        _assign(params, [block] + target + [new_leaf], v)
+    return params
+
+
+# --- AudioLDM2 UNet + projection (models/audioldm2_unet.py) ---
+
+
+def audioldm2_unet_rename(name: str) -> str:
+    """diffusers AudioLDM2UNet2DConditionModel names ->
+    models.audioldm2_unet names (flatten block lists and the single
+    transformer block's internals)."""
+    import re
+
+    name = name.replace(".transformer_blocks.0.attn1.",
+                        ".transformer_blocks_0_attn1_")
+    name = name.replace(".transformer_blocks.0.attn2.",
+                        ".transformer_blocks_0_attn2_")
+    name = re.sub(r"\.transformer_blocks\.0\.norm([123])\.",
+                  r".transformer_blocks_0_norm\1.", name)
+    name = name.replace(".transformer_blocks.0.ff.",
+                        ".transformer_blocks_0_ff.")
+    name = name.replace("_to_out.0.", "_to_out_0.")
+    name = re.sub(
+        r"^down_blocks\.(\d+)\.(resnets|attentions)\.", r"down_\1_\2.", name
+    )
+    name = re.sub(
+        r"^up_blocks\.(\d+)\.(resnets|attentions)\.", r"up_\1_\2.", name
+    )
+    name = re.sub(r"^down_blocks\.(\d+)\.downsamplers\.0\.conv\.",
+                  r"down_\1_downsample.", name)
+    name = re.sub(r"^up_blocks\.(\d+)\.upsamplers\.0\.conv\.",
+                  r"up_\1_upsample.", name)
+    name = re.sub(r"^mid_block\.(resnets|attentions)\.", r"mid_\1_", name)
+    return name
+
+
+def convert_audioldm2_unet(state: dict) -> dict:
+    return convert_state_dict(state, audioldm2_unet_rename)
+
+
+def infer_audioldm2_unet_config(state: dict, config_json: dict | None = None):
+    """AudioLDM2UNetConfig from the checkpoint shapes: per-slot cross
+    widths from the paired attn2 projections; head dim from config.json
+    (fused projections hide it)."""
+    import re
+
+    from .audioldm2_unet import AudioLDM2UNetConfig
+
+    cj = config_json or {}
+    blocks: dict[int, int] = {}
+    attn: set[int] = set()
+    layers = 1
+    for k in state:
+        m = re.match(r"down_blocks\.(\d+)\.resnets\.(\d+)\.conv1\.weight", k)
+        if m:
+            blocks[int(m.group(1))] = int(np.asarray(state[k]).shape[0])
+            layers = max(layers, int(m.group(2)) + 1)
+        m = re.match(r"down_blocks\.(\d+)\.attentions\.", k)
+        if m:
+            attn.add(int(m.group(1)))
+    n = max(blocks) + 1
+    first = min(attn)
+    cross = []
+    for idx in (0, 1):
+        key = (f"down_blocks.{first}.attentions.{idx}"
+               ".transformer_blocks.0.attn2.to_k.weight")
+        cross.append(int(np.asarray(state[key]).shape[1]))
+    head_dim = int(cj.get("attention_head_dim", 8))
+    return AudioLDM2UNetConfig(
+        in_channels=int(np.asarray(state["conv_in.weight"]).shape[1]),
+        out_channels=int(np.asarray(state["conv_out.weight"]).shape[0]),
+        block_out_channels=tuple(blocks[i] for i in range(n)),
+        layers_per_block=layers,
+        attention=tuple(i in attn for i in range(n)),
+        attention_head_dim=head_dim,
+        cross_attention_dims=tuple(cross),
+        norm_num_groups=int(cj.get("norm_num_groups", 32)),
+    )
+
+
+def convert_audioldm2_projection(state: dict) -> dict:
+    """AudioLDM2ProjectionModel state dict -> models.audioldm2_unet
+    AudioLDM2Projection params."""
+    params: dict = {}
+    for name, v in state.items():
+        v = np.asarray(v)
+        if name in ("sos_embed", "eos_embed", "sos_embed_1", "eos_embed_1"):
+            _assign(params, [name], v)
+        elif name.endswith(".weight"):
+            _assign(params, [name[: -len(".weight")], "kernel"],
+                    np.ascontiguousarray(v.T))
+        elif name.endswith(".bias"):
+            _assign(params, [name[: -len(".bias")], "bias"], v)
+    return params

@@ -1,0 +1,461 @@
+"""AudioLDM2 pipeline: dual-conditioned mel-latent diffusion.
+
+Reference behavior replaced: the reference serves AudioLDM2 through the
+same txt2audio callback as v1 when a job sets
+`parameters.pipeline_type = "AudioLDM2Pipeline"`
+(swarm/job_arguments.py get_type resolves any diffusers class;
+swarm/audio/audioldm.py:12-21 runs it and mp3-encodes the waveform).
+
+TPU redesign: the conditioning chain runs once per job host-side —
+CLAP pooled embedding (unit-norm, one token) and masked T5 states feed
+the projection model's [sos|clap|eos|sos_1|t5|eos_1] sequence, GPT-2
+rolls 8 deterministic last-hidden-state continuations (each step a
+cached jit per sequence length), and the denoise is one `lax.scan` DDIM
+program over a CFG batch of 2 with BOTH contexts cross-attended per
+layer, mel VAE decode and HiFi-GAN vocoding fused at the end (only the
+waveform crosses back to the host). Real checkpoints convert at load;
+GPT-2 and the text towers have exact transformers parity tests.
+"""
+
+from __future__ import annotations
+
+import time
+import zlib
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..models.audioldm2_unet import (
+    TINY_AUDIOLDM2_UNET,
+    AudioLDM2Projection,
+    AudioLDM2UNet,
+)
+from ..models.clap import TINY_CLAP, ClapTextEncoder
+from ..models.gpt2 import TINY_GPT2, GPT2Model
+from ..models.hifigan import TINY_HIFIGAN, HifiGanGenerator
+from ..models.t5 import TINY_T5, T5Encoder, t5_config_from_json
+from ..models.vae import AutoencoderKL, VAEConfig
+from ..registry import register_family
+from ..weights import (
+    MissingWeightsError,
+    is_test_model,
+    model_dir_for,
+    require_weights_present,
+)
+from .audio import (
+    HOP,
+    SAMPLE_RATE,
+    _clap_tokenizer,
+    _config_json,
+    _infer_clap_vocoder_configs,
+    normalize_wav,
+)
+
+_NO_CONVERSION_HINT = (
+    "No converted AudioLDM2 checkpoint is present for this model name; "
+    "download it first (initialize --download) or use a test/tiny name."
+)
+
+_is_tiny = is_test_model
+
+# fixed T5 token budget so GPT-2 generation lengths are static per job
+MAX_T5_TOKENS = 128
+TINY_MAX_T5 = 12
+GENERATED_TOKENS = 8
+
+TINY_MEL_VAE = VAEConfig(
+    in_channels=1, latent_channels=8, block_out_channels=(32, 32),
+    layers_per_block=1,
+)
+
+
+def convert_audioldm2_checkpoint(model_dir):
+    """One cvssp/audioldm2 repo conversion recipe -> component
+    configs+params — shared by serving and `initialize --check`."""
+    from ..models.conversion import (
+        convert_audioldm2_projection,
+        convert_audioldm2_unet,
+        convert_clap,
+        convert_gpt2,
+        convert_hifigan,
+        convert_t5,
+        convert_vae,
+        gpt2_config_from_json,
+        infer_audioldm2_unet_config,
+        infer_vae_config,
+        load_torch_state_dict,
+    )
+
+    unet_state = load_torch_state_dict(model_dir, "unet")
+    ucfg = infer_audioldm2_unet_config(
+        unet_state, _config_json(model_dir, "unet")
+    )
+    unet = convert_audioldm2_unet(unet_state)
+    # the ClapModel checkpoint carries the audio tower too — only the
+    # text branch serves
+    clap_state = {
+        k: v
+        for k, v in load_torch_state_dict(model_dir, "text_encoder").items()
+        if k.startswith(("text_model.", "text_projection."))
+    }
+    clap = convert_clap(clap_state)
+    clap_cfg, vocoder_cfg = _infer_clap_vocoder_configs(model_dir)
+    t5 = convert_t5(load_torch_state_dict(model_dir, "text_encoder_2"))
+    t5_cfg = t5_config_from_json(_config_json(model_dir, "text_encoder_2"))
+    gpt2 = convert_gpt2(load_torch_state_dict(model_dir, "language_model"))
+    gpt2_cfg = gpt2_config_from_json(
+        _config_json(model_dir, "language_model")
+    )
+    proj = convert_audioldm2_projection(
+        load_torch_state_dict(model_dir, "projection_model")
+    )
+    vae_state = load_torch_state_dict(model_dir, "vae")
+    vae_cfg = infer_vae_config(vae_state, _config_json(model_dir, "vae"))
+    vae = convert_vae(vae_state)
+    vocoder = convert_hifigan(load_torch_state_dict(model_dir, "vocoder"))
+    return {
+        "unet_cfg": ucfg, "unet": unet,
+        "clap_cfg": clap_cfg, "clap": clap,
+        "t5_cfg": t5_cfg, "t5": t5,
+        "gpt2_cfg": gpt2_cfg, "gpt2": gpt2,
+        "proj": proj,
+        "vae_cfg": vae_cfg, "vae": vae,
+        "vocoder_cfg": vocoder_cfg, "vocoder": vocoder,
+        "model_dir": model_dir,
+    }
+
+
+def _load_converted_audioldm2(model_name: str):
+    if _is_tiny(model_name):
+        return None
+    d = model_dir_for(model_name)
+    if d is None:
+        return None
+    try:
+        return convert_audioldm2_checkpoint(d)
+    except (FileNotFoundError, OSError):
+        return None
+    except Exception as e:
+        raise MissingWeightsError(
+            f"checkpoint under {d} could not be converted for "
+            f"'{model_name}': {e}"
+        ) from e
+
+
+class AudioLDM2Pipeline:
+    """Resident AudioLDM2 bundle serving the AudioLDM2Pipeline wire
+    name on the txt2audio workflow."""
+
+    def __init__(self, model_name: str, chipset=None,
+                 allow_random_init: bool = False):
+        converted = _load_converted_audioldm2(model_name)
+        if converted is None:
+            require_weights_present(
+                model_name, model_dir_for(model_name), allow_random_init,
+                component="AudioLDM2", hint=_NO_CONVERSION_HINT,
+            )
+        self.model_name = model_name
+        self.chipset = chipset
+        tiny = _is_tiny(model_name)
+        if converted is not None:
+            ucfg = converted["unet_cfg"]
+            clap_cfg = converted["clap_cfg"]
+            t5_cfg = converted["t5_cfg"]
+            gpt2_cfg = converted["gpt2_cfg"]
+            vae_cfg = converted["vae_cfg"]
+            vocoder_cfg = converted["vocoder_cfg"]
+        else:
+            import dataclasses
+
+            ucfg = TINY_AUDIOLDM2_UNET
+            clap_cfg = TINY_CLAP  # projection feeds the Linear below
+            t5_cfg = dataclasses.replace(
+                TINY_T5,
+                d_model=TINY_AUDIOLDM2_UNET.cross_attention_dims[1],
+            )
+            gpt2_cfg = TINY_GPT2  # hidden == cross_attention_dims[0]
+            vae_cfg = TINY_MEL_VAE
+            vocoder_cfg = TINY_HIFIGAN
+        if tiny or converted is None:
+            self.max_t5 = TINY_MAX_T5
+        else:
+            # the joint sequence [sos|clap|eos|sos_1|t5|eos_1] plus the 8
+            # generated continuations must fit the LM's position table
+            self.max_t5 = min(
+                MAX_T5_TOKENS,
+                gpt2_cfg.n_positions - 5 - GENERATED_TOKENS,
+            )
+        on_tpu = jax.default_backend() == "tpu"
+        self.dtype = jnp.bfloat16 if on_tpu else jnp.float32
+        self.unet = AudioLDM2UNet(ucfg, dtype=self.dtype)
+        self.clap = ClapTextEncoder(clap_cfg, dtype=self.dtype)
+        self.t5 = T5Encoder(t5_cfg, dtype=self.dtype)
+        # GPT-2 operates at the first cross width (the generated tokens
+        # the UNet attends)
+        self.lm_dim = ucfg.cross_attention_dims[0]
+        self.gpt2 = GPT2Model(gpt2_cfg, dtype=self.dtype)
+        self.projection = AudioLDM2Projection(self.lm_dim, dtype=self.dtype)
+        self.vae = AutoencoderKL(vae_cfg, dtype=self.dtype)
+        self.vocoder = HifiGanGenerator(vocoder_cfg, dtype=self.dtype)
+        self.vocoder_hop = int(np.prod(vocoder_cfg.upsample_rates))
+        self.latent_factor = 2 ** (len(vae_cfg.block_out_channels) - 1)
+        d = model_dir_for(model_name)
+        self.clap_tokenizer, self._real_tok = _clap_tokenizer(
+            d, clap_cfg.vocab_size
+        )
+        from .flux import _load_t5_tokenizer
+
+        self.t5_tokenizer = _load_t5_tokenizer(d, t5_cfg.vocab_size)
+
+        if converted is not None:
+            from ..models.conversion import checked_converted
+
+            rng = jax.random.key(0)
+            checked_converted(
+                self.unet,
+                (jnp.zeros((1, 16, 8, ucfg.in_channels)), jnp.zeros((1,)),
+                 jnp.zeros((1, 4, ucfg.cross_attention_dims[0])),
+                 jnp.ones((1, 4)),
+                 jnp.zeros((1, 4, ucfg.cross_attention_dims[1])),
+                 jnp.ones((1, 4))),
+                converted["unet"], "audioldm2 unet", rng,
+            )
+            checked_converted(
+                self.gpt2, (jnp.zeros((1, 4, gpt2_cfg.hidden_size)),),
+                converted["gpt2"], "audioldm2 language_model", rng,
+            )
+            checked_converted(
+                self.projection,
+                (jnp.zeros((1, 1, clap_cfg.projection_dim)),
+                 jnp.ones((1, 1)),
+                 jnp.zeros((1, 4, t5_cfg.d_model)), jnp.ones((1, 4))),
+                converted["proj"], "audioldm2 projection_model", rng,
+            )
+            if not self._real_tok:
+                raise MissingWeightsError(
+                    f"{model_name}: converted CLAP weights need the real "
+                    "tokenizer files (re-run initialize --download)"
+                )
+            params = {
+                "unet": converted["unet"], "clap": converted["clap"],
+                "t5": converted["t5"], "gpt2": converted["gpt2"],
+                "proj": converted["proj"], "vae": converted["vae"],
+                "vocoder": converted["vocoder"],
+            }
+        else:
+            params = self._random_params(ucfg, clap_cfg, t5_cfg, vae_cfg)
+        self.params = jax.tree_util.tree_map(
+            lambda x: jnp.asarray(x, self.dtype), params
+        )
+        self._programs: dict = {}
+        self._gpt2_step = jax.jit(
+            lambda p, seq, mask: self.gpt2.apply(
+                {"params": p}, seq, mask
+            )[:, -1:, :]
+        )
+        self._encode = jax.jit(
+            lambda p, clap_ids, t5_ids, t5_mask: self._encode_impl(
+                p, clap_ids, t5_ids, t5_mask
+            )
+        )
+
+    def _random_params(self, ucfg, clap_cfg, t5_cfg, vae_cfg):
+        rng = jax.random.key(zlib.crc32(self.model_name.encode()))
+        ks = jax.random.split(rng, 7)
+        with jax.default_device(jax.local_devices(backend="cpu")[0]):
+            return {
+                "unet": self.unet.init(
+                    ks[0], jnp.zeros((1, 16, 8, ucfg.in_channels)),
+                    jnp.zeros((1,)),
+                    jnp.zeros((1, 4, ucfg.cross_attention_dims[0])),
+                    jnp.ones((1, 4)),
+                    jnp.zeros((1, 4, ucfg.cross_attention_dims[1])),
+                    jnp.ones((1, 4)),
+                )["params"],
+                "clap": self.clap.init(
+                    ks[1], jnp.zeros((1, 8), jnp.int32)
+                )["params"],
+                "t5": self.t5.init(
+                    ks[2], jnp.zeros((1, 8), jnp.int32)
+                )["params"],
+                "gpt2": self.gpt2.init(
+                    ks[3], jnp.zeros((1, 4, self.gpt2.config.hidden_size))
+                )["params"],
+                "proj": self.projection.init(
+                    ks[4],
+                    jnp.zeros((1, 1, self.clap.config.projection_dim)),
+                    jnp.ones((1, 1)),
+                    jnp.zeros((1, 4, self.t5.config.d_model)),
+                    jnp.ones((1, 4)),
+                )["params"],
+                "vae": self.vae.init(
+                    ks[5],
+                    jnp.zeros((1, 4 * self.latent_factor,
+                               4 * self.latent_factor, 1)),
+                )["params"],
+                "vocoder": self.vocoder.init(
+                    ks[6],
+                    jnp.zeros((1, 16, self.vocoder.config.model_in_dim)),
+                )["params"],
+            }
+
+    def _encode_impl(self, params, clap_ids, t5_ids, t5_mask):
+        pooled = self.clap.apply({"params": params["clap"]}, clap_ids)[
+            "pooled"
+        ].astype(jnp.float32)
+        # transformers ClapModel.get_text_features unit-normalizes
+        pooled = pooled / jnp.maximum(
+            jnp.linalg.norm(pooled, axis=-1, keepdims=True), 1e-8
+        )
+        h0 = pooled[:, None, :]
+        m0 = jnp.ones(h0.shape[:2], jnp.float32)
+        h1 = self.t5.apply({"params": params["t5"]}, t5_ids, t5_mask)
+        seq, mask = self.projection.apply(
+            {"params": params["proj"]}, h0, m0, h1, t5_mask
+        )
+        return seq, mask, h1
+
+    def release(self):
+        self.params = None
+        self._programs.clear()
+
+    def _generate(self, params, seq, mask):
+        """GPT-2 rollout: append the last hidden state GENERATED_TOKENS
+        times (the diffusers generate_language_model semantics — no
+        sampling)."""
+        for _ in range(GENERATED_TOKENS):
+            nxt = self._gpt2_step(params["gpt2"], seq, mask)
+            seq = jnp.concatenate([seq, nxt.astype(seq.dtype)], axis=1)
+            mask = jnp.concatenate(
+                [mask, jnp.ones((mask.shape[0], 1), mask.dtype)], axis=-1
+            )
+        return seq[:, -GENERATED_TOKENS:, :]
+
+    def _program(self, key):
+        if key in self._programs:
+            return self._programs[key]
+        lt, lf, steps, sched_name = key
+        from ..schedulers import get_scheduler
+
+        scheduler = get_scheduler(sched_name)
+        schedule = scheduler.schedule(steps)
+
+        def run(params, latents, gen, t5_states, t5_mask, guidance, rng):
+            """latents [1, lt, lf, C]; gen [2, 8, lm]; t5_states
+            [2, S, d]; rows [uncond | cond]."""
+            latents = latents * jnp.asarray(
+                schedule.init_noise_sigma, latents.dtype
+            )
+            state = scheduler.init_state(latents.shape, latents.dtype)
+            gen_mask = jnp.ones(gen.shape[:2], jnp.float32)
+
+            def body(carry, i):
+                latents, state = carry
+                inp = scheduler.scale_model_input(schedule, latents, i)
+                model_in = jnp.concatenate([inp, inp], axis=0).astype(
+                    self.dtype
+                )
+                t = jnp.broadcast_to(
+                    jnp.asarray(schedule.timesteps)[i], (2,)
+                )
+                out = self.unet.apply(
+                    {"params": params["unet"]}, model_in, t,
+                    gen.astype(self.dtype), gen_mask,
+                    t5_states.astype(self.dtype), t5_mask,
+                ).astype(jnp.float32)
+                out_u, out_c = jnp.split(out, 2, axis=0)
+                out = out_u + guidance * (out_c - out_u)
+                noise = jax.random.normal(
+                    jax.random.fold_in(rng, i), latents.shape, jnp.float32
+                )
+                state, latents = scheduler.step(
+                    schedule, state, i, latents, out, noise
+                )
+                return (latents, state), ()
+
+            (latents, _), _ = jax.lax.scan(
+                body, (latents.astype(jnp.float32), state),
+                jnp.arange(steps),
+            )
+            mel = self.vae.apply(
+                {"params": params["vae"]}, latents.astype(self.dtype),
+                method=self.vae.decode,
+            )
+            wav = self.vocoder.apply(
+                {"params": params["vocoder"]}, mel[..., 0]
+            )
+            return wav.astype(jnp.float32)
+
+        program = jax.jit(run)
+        self._programs[key] = program
+        return program
+
+    def run(self, prompt="", negative_prompt="", **kwargs):
+        params = self.params
+        if params is None:
+            raise Exception(
+                f"pipeline {self.model_name} was evicted; resubmit"
+            )
+        steps = int(kwargs.pop("num_inference_steps", 20))
+        guidance_scale = float(kwargs.pop("guidance_scale", 3.5))
+        duration_s = float(kwargs.pop("audio_length_in_s", 5.0))
+        scheduler_type = kwargs.pop("scheduler_type", "DDIMScheduler")
+        rng = kwargs.pop("rng", None)
+        if rng is None:
+            rng = jax.random.key(0)
+
+        frames = int(duration_s * SAMPLE_RATE / HOP)
+        lt = max(8, frames // self.latent_factor // 8 * 8)
+        # the decoded mel must hit the vocoder's freq-bin count exactly
+        lf = max(4, self.vocoder.config.model_in_dim // self.latent_factor)
+
+        t0 = time.perf_counter()
+        clap_ids = jnp.asarray(
+            np.asarray(self.clap_tokenizer([negative_prompt, prompt]),
+                       np.int32)
+        )
+        t5_tok = np.asarray(
+            self.t5_tokenizer([negative_prompt, prompt], self.max_t5),
+            np.int32,
+        )
+        t5_mask = (t5_tok != 0).astype(np.float32)
+        t5_mask[:, 0] = 1.0
+        t5_ids = jnp.asarray(t5_tok)
+        t5_mask = jnp.asarray(t5_mask)
+        seq, mask, t5_states = self._encode(params, clap_ids, t5_ids, t5_mask)
+        generated = self._generate(params, seq, mask)
+        timings = {"conditioning_s": round(time.perf_counter() - t0, 3)}
+
+        rng, init_rng, step_rng = jax.random.split(rng, 3)
+        latent_c = self.unet.config.in_channels
+        noise = jax.random.normal(
+            init_rng, (1, lt, lf, latent_c), jnp.float32
+        )
+        t0 = time.perf_counter()
+        program = self._program((lt, lf, steps, scheduler_type))
+        wav = jax.block_until_ready(
+            program(params, noise, generated, t5_states, t5_mask,
+                    jnp.float32(guidance_scale), step_rng)
+        )
+        timings["denoise_vocode_s"] = round(time.perf_counter() - t0, 3)
+
+        wav = normalize_wav(np.asarray(wav, np.float32)[0])
+        out_rate = int(SAMPLE_RATE / HOP * self.vocoder_hop)
+        config = {
+            "model": self.model_name,
+            "pipeline": "AudioLDM2Pipeline",
+            "steps": steps,
+            "duration_s": duration_s,
+            "sample_rate": out_rate,
+            "scheduler": scheduler_type,
+            "vocoder": "hifigan",
+            "guidance_scale": guidance_scale,
+            "timings": timings,
+        }
+        return wav, config
+
+
+@register_family("audioldm2")
+def _build_audioldm2(model_name, chipset, **variant):
+    return AudioLDM2Pipeline(model_name, chipset, **variant)

@@ -59,6 +59,7 @@ PIPELINE_FAMILIES: dict[str, str] = {
     "IFPipeline": "deepfloyd_if",
     "IFSuperResolutionPipeline": "deepfloyd_if",
     "AudioLDMPipeline": "audioldm",
+    "AudioLDM2Pipeline": "audioldm2",
     "BarkPipeline": "bark",
     "AnimateDiffPipeline": "animatediff",
     "TextToVideoSDPipeline": "animatediff",
@@ -178,6 +179,7 @@ def _ensure_builtin_families() -> None:
         return
     _BUILTINS_LOADED = True
     for module in ("stable_diffusion", "video", "svd", "i2vgen", "audio",
+                   "audioldm2",
                    "captioning", "flux", "kandinsky", "kandinsky3", "cascade",
                    "upscale", "deepfloyd", "bark"):
         try:

@@ -78,11 +78,13 @@ def test_capability_advertisement(sdaas_root):
     assert "memory" in req and "gpu" in req  # legacy keys still advertised
     # model-layer honesty: families with no conversion path are advertised
     # so a capability-aware hive stops sending un-runnable jobs — in
-    # lockstep with the real keyword list (cascade/kandinsky3/SVD/
-    # latent-upscaler all convert as of round 4)
+    # lockstep with the real keyword list, which is EMPTY as of round 4
+    # (every served family converts; ",".join(()) wires through as "")
     from chiaswarm_tpu.weights import UNCONVERTED_FAMILY_KEYWORDS
 
-    unconverted = req["unconverted_families"].split(",")
+    unconverted = [
+        k for k in req["unconverted_families"].split(",") if k
+    ]
     assert sorted(unconverted) == sorted(UNCONVERTED_FAMILY_KEYWORDS)
     assert "bark" not in unconverted and "kandinsky3" not in unconverted
 
