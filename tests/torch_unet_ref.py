@@ -1468,7 +1468,7 @@ class KUpResnetT(nn.Module):
         return x + h
 
 
-class KAttnT(nn.Module):
+class KUpsAttnT(nn.Module):
     """The Attention instance K blocks build: optional q/k/v bias, to_out.0
     with bias, norm_cross LayerNorm on encoder states."""
 
@@ -1509,9 +1509,9 @@ class KUpAttnBlockT(nn.Module):
         self.add_self_attention = self_attention
         if self_attention:
             self.norm1 = AdaGroupNormT(temb_dim, ch, groups)
-            self.attn1 = KAttnT(ch, head_dim, None, bias)
+            self.attn1 = KUpsAttnT(ch, head_dim, None, bias)
         self.norm2 = AdaGroupNormT(temb_dim, ch, groups)
-        self.attn2 = KAttnT(ch, head_dim, context_dim, bias)
+        self.attn2 = KUpsAttnT(ch, head_dim, context_dim, bias)
 
     def forward(self, x, temb, context):
         b, c, h, w = x.shape
