@@ -126,6 +126,8 @@ def verify_local_model(model_name: str, root: Path | None = None) -> dict | None
     root = root or model_root()
     if "blip" in name:
         return _verify_blip_model(model_name, root)
+    if "zoedepth" in name:
+        return _verify_zoedepth_model(model_name, root)
     if "dpt" in name or "midas" in name:
         return _verify_dpt_model(model_name, root)
     if "safety" in name:
@@ -164,6 +166,36 @@ def verify_local_model(model_name: str, root: Path | None = None) -> dict | None
     if "i2vgen" in name:
         return _verify_i2vgen_model(model_name, root)
     return _verify_sd_model(model_name, root)
+
+
+def _verify_zoedepth_model(model_name: str, root: Path) -> dict:
+    """ZoeDepth repos: convert through the SAME loader the zoe annotator
+    serves with (BEiT backbone + metric-bins head)."""
+    import json
+
+    import jax.numpy as jnp
+
+    from .models.conversion import (
+        assert_tree_shapes_match,
+        convert_zoedepth,
+        load_torch_state_dict,
+    )
+    from .models.zoedepth import ZoeDepthModel
+
+    model_dir = root / model_name
+    if not model_dir.is_dir():
+        raise FileNotFoundError(f"no checkpoint directory {model_dir}")
+    cfg_json = {}
+    p = model_dir / "config.json"
+    if p.is_file():
+        cfg_json = json.loads(p.read_text())
+    cfg, params = convert_zoedepth(load_torch_state_dict(model_dir), cfg_json)
+    expected = _eval_shape_params(
+        ZoeDepthModel(cfg),
+        jnp.zeros((1, cfg.image_size, cfg.image_size, 3)),
+    )
+    assert_tree_shapes_match(params, expected, prefix="zoedepth")
+    return {"zoedepth": _param_count(params)}
 
 
 def _verify_audioldm2_model(model_name: str, root: Path) -> dict:

@@ -2519,3 +2519,82 @@ def convert_audioldm2_projection(state: dict) -> dict:
         elif name.endswith(".bias"):
             _assign(params, [name[: -len(".bias")], "bias"], v)
     return params
+
+
+# --- ZoeDepth (models/zoedepth.py) ---
+
+
+def zoedepth_rename(name: str) -> str | None:
+    """transformers ZoeDepthForDepthEstimation names -> models.zoedepth
+    names (digit-merge covers the lists; the readout Sequential index and
+    the per-layer bias table need explicit mapping)."""
+    import re
+
+    if name.endswith("relative_position_index"):
+        return None  # computed, not a weight
+    name = name.replace(
+        ".relative_position_bias.relative_position_bias_table",
+        ".relative_position_bias",
+    )
+    name = re.sub(r"\.readout_projects\.(\d+)\.0\.",
+                  r".readout_projects.\1.proj.", name)
+    return name
+
+
+def convert_zoedepth(state: dict, config_json: dict | None = None):
+    """-> (ZoeConfig, params). The two transposed-conv reassemble resizes
+    (factors > 1) are the only layout special-cases (IOHW, stride ==
+    kernel)."""
+    from .zoedepth import ZoeConfig
+
+    cj = config_json or {}
+    bj = cj.get("backbone_config", {})
+    bins = (cj.get("bin_configurations") or [{}])[0]
+    cfg = ZoeConfig(
+        image_size=int(bj.get("image_size", 384)),
+        patch_size=int(bj.get("patch_size", 16)),
+        hidden_size=int(bj.get("hidden_size", 1024)),
+        num_layers=int(bj.get("num_hidden_layers", 24)),
+        num_heads=int(bj.get("num_attention_heads", 16)),
+        intermediate_size=int(bj.get("intermediate_size", 4096)),
+        layer_norm_eps=float(bj.get("layer_norm_eps", 1e-12)),
+        out_indices=tuple(bj.get("out_indices", (6, 12, 18, 24))),
+        reassemble_factors=tuple(
+            cj.get("reassemble_factors", (4, 2, 1, 0.5))
+        ),
+        neck_hidden_sizes=tuple(
+            cj.get("neck_hidden_sizes", (96, 192, 384, 768))
+        ),
+        fusion_hidden_size=int(cj.get("fusion_hidden_size", 256)),
+        bottleneck_features=int(cj.get("bottleneck_features", 256)),
+        num_relative_features=int(cj.get("num_relative_features", 32)),
+        num_attractors=tuple(cj.get("num_attractors", (16, 8, 4, 1))),
+        bin_embedding_dim=int(cj.get("bin_embedding_dim", 128)),
+        n_bins=int(bins.get("n_bins", 64)),
+        min_depth=float(bins.get("min_depth", 1e-3)),
+        max_depth=float(bins.get("max_depth", 10.0)),
+        min_temp=float(cj.get("min_temp", 0.0212)),
+        max_temp=float(cj.get("max_temp", 50.0)),
+    )
+    if len(cj.get("bin_configurations", [{}])) > 1:
+        raise ValueError(
+            "multi-domain (NK) ZoeDepth heads are not supported; use a "
+            "single-configuration checkpoint (ZoeD_N)"
+        )
+    specials = []
+    rest = {}
+    convt = {
+        f"neck.reassemble_stage.layers.{i}.resize.weight"
+        for i, f in enumerate(cfg.reassemble_factors) if f > 1
+    }
+    for k, v in state.items():
+        if k in convt:
+            arr = np.asarray(v)
+            path, _ = torch_name_to_flax_path(k)
+            specials.append((path + ["kernel"], arr.transpose(2, 3, 0, 1)))
+        else:
+            rest[k] = v
+    params = convert_state_dict(rest, zoedepth_rename)
+    for path, value in specials:
+        _assign(params, path, value)
+    return cfg, params

@@ -907,3 +907,93 @@ def get_pidinet_detector(model_name: str | None = None):
             return None
         _PIDI[name] = det
         return det
+
+
+# --- ZoeDepth metric depth (zoe preprocessor backend) ---
+
+_ZOE: dict[str, "ZoeEstimator"] = {}
+_ZOE_LOCK = threading.Lock()
+
+DEFAULT_ZOE_MODEL = "Intel/zoedepth-nyu"
+
+
+class ZoeEstimator:
+    """Resident ZoeDepth (the metric-depth model the reference's
+    `zoe depth` preprocessor runs, swarm/pre_processors/zoe_depth.py:8-13)
+    — BEiT backbone + metric-bins head with EXACT transformers parity
+    (tests/test_zoedepth.py). Serves a fixed square canvas equal to the
+    trained window so the relative-position tables index directly."""
+
+    def __init__(self, model_name: str = DEFAULT_ZOE_MODEL):
+        import json
+
+        import jax
+        import jax.numpy as jnp
+
+        from ..models.conversion import convert_zoedepth, load_torch_state_dict
+        from ..models.zoedepth import ZoeDepthModel
+        from ..settings import load_settings
+
+        self.model_name = model_name
+        root = Path(load_settings().model_root_dir).expanduser()
+        model_dir = root / model_name
+        if not model_dir.is_dir():
+            raise FileNotFoundError(f"no checkpoint directory {model_dir}")
+        cfg_json = {}
+        p = model_dir / "config.json"
+        if p.is_file():
+            cfg_json = json.loads(p.read_text())
+        cfg, params = convert_zoedepth(
+            load_torch_state_dict(model_dir), cfg_json
+        )
+        self.config = cfg
+        on_tpu = jax.default_backend() == "tpu"
+        self.dtype = jnp.bfloat16 if on_tpu else jnp.float32
+        self.model = ZoeDepthModel(cfg, dtype=self.dtype)
+        cast = lambda x: jnp.asarray(x, self.dtype)
+        self.params = jax.tree_util.tree_map(cast, params)
+        self._program = jax.jit(
+            lambda p, px: self.model.apply({"params": p}, px)
+        )
+
+    def __call__(self, image) -> np.ndarray:
+        """PIL -> [H, W] float32 metric depth (meters) at the ORIGINAL
+        canvas."""
+        import jax.numpy as jnp
+        from PIL import Image
+
+        size = self.config.image_size
+        original = image.size
+        rgb = image.convert("RGB").resize((size, size), Image.BICUBIC)
+        arr = (np.asarray(rgb, np.float32) / 255.0 - 0.5) / 0.5
+        depth = np.asarray(
+            self._program(
+                self.params, jnp.asarray(arr[None], self.dtype)
+            ).astype(jnp.float32)
+        )[0]
+        return np.asarray(
+            Image.fromarray(depth, mode="F").resize(
+                original, Image.BICUBIC
+            ),
+            np.float32,
+        )
+
+
+def get_zoe_estimator(model_name: str | None = None):
+    """The resident ZoeDepth, or None when no converted checkpoint is
+    available (zoe falls back to the DPT stand-in, flagged degraded)."""
+    from ..weights import MissingWeightsError
+
+    name = model_name or DEFAULT_ZOE_MODEL
+    with _ZOE_LOCK:
+        if name in _ZOE:
+            return _ZOE[name]
+        try:
+            est = ZoeEstimator(name)
+        except (MissingWeightsError, FileNotFoundError, OSError, KeyError,
+                ValueError) as e:
+            logger.info("no converted ZoeDepth weights (%s)", e)
+            _ZOE[name] = None  # negative-cache: stop re-reading per job
+            return None
+        _ZOE[name] = est
+        return est

@@ -32,26 +32,23 @@ def register(name):
     return deco
 
 
-# classical-CV approximations of learned detectors the reference runs
-# (real ZoeDepth — swarm/pre_processors/controlnet.py:58-61). Jobs
-# conditioned through these get a `degraded_preprocessors` entry in the
-# result envelope so the hive/user can see the conditioning image is an
-# approximation. mlsd/lineart/segmentation run their REAL detectors when
-# converted weights are present and degrade (flagged) otherwise.
-_DEGRADED = frozenset(_norm(n) for n in ("zoe depth", "zoe"))
-
-
+# every learned detector the reference runs now has a real serving path;
+# a preprocessor is "degraded" only when ITS converted weights are absent
+# on this worker and a classical/DPT stand-in answers instead — flagged
+# in the result envelope so the hive/user can see the conditioning image
+# is an approximation.
 def is_degraded_preprocessor(name: str) -> bool:
-    if _norm(name) in _DEGRADED:
-        return True
     from ..pipelines import aux_models
 
-    if _norm(name) == "segmentation":
+    key = _norm(name)
+    if key == "segmentation":
         return aux_models.get_segmenter() is None
-    if _norm(name) == "mlsd":
+    if key == "mlsd":
         return aux_models.get_mlsd_detector() is None
-    if _norm(name) == "lineart":
+    if key == "lineart":
         return aux_models.get_lineart_detector() is None
+    if key in (_norm("zoe depth"), _norm("zoe")):
+        return aux_models.get_zoe_estimator() is None
     return False
 
 
@@ -291,10 +288,20 @@ def normal_bae(image: Image.Image) -> Image.Image:
 @register("zoe")
 def zoe_depth(image: Image.Image) -> Image.Image:
     """Metric-style depth map (reference zoe_depth.py:8-64: ZoeDepth +
-    `colorize(depth, cmap="gray_r")`), served by the resident DPT model
-    with the same reversed-gray colorization."""
-    from ..pipelines.aux_models import estimate_depth
+    `colorize(depth, cmap="gray_r")`). With converted Intel/zoedepth-nyu
+    weights present the REAL ZoeDepth runs (models/zoedepth.py, exact
+    transformers parity); otherwise the resident DPT serves the same
+    reversed-gray colorization and the job is flagged degraded."""
+    from ..pipelines.aux_models import estimate_depth, get_zoe_estimator
 
+    zoe = get_zoe_estimator()
+    if zoe is not None:
+        depth = zoe(image)  # metric meters, near = small
+        lo, hi = float(depth.min()), float(depth.max())
+        norm = (depth - lo) / (hi - lo) if hi > lo else np.zeros_like(depth)
+        # gray_r: near (small depth) -> white
+        arr = ((1.0 - norm) * 255).astype(np.uint8)
+        return Image.fromarray(np.stack([arr] * 3, axis=-1))
     d = estimate_depth(image)  # inverse depth in [0, 1], near = 1
     # gray_r on metric depth: near -> dark in metric terms, but the
     # reference colorizes raw depth (near = small) reversed, i.e. near ->
