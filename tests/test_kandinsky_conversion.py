@@ -226,7 +226,7 @@ def test_prior_causal_mask_changes_output():
 
 def test_verify_local_model_checks_kandinsky(sdaas_root, tmp_path):
     """initialize --check now validates Kandinsky 2.2 repos end-to-end on a
-    synthetic checkpoint with the real key layout (K3 stays skip-listed)."""
+    synthetic checkpoint with the real key layout."""
     from safetensors.numpy import save_file
 
     from chiaswarm_tpu.initialize import verify_local_model
@@ -274,8 +274,10 @@ def test_verify_local_model_checks_kandinsky(sdaas_root, tmp_path):
     with mock.patch.object(movq_mod, "MoVQConfig", lambda: TINY_MOVQ):
         out = verify_local_model(name, model_root)
     assert out is not None and out["unet"] > 0 and out["movq"] > 0
-    # Kandinsky 3 has no conversion path yet: still a skip, not a failure
-    assert verify_local_model("kandinsky-community/kandinsky-3") is None
+    # Kandinsky 3 converts as of round 4: an absent checkpoint is now a
+    # loud failure (not a silent skip)
+    with pytest.raises(FileNotFoundError):
+        verify_local_model("kandinsky-community/kandinsky-3", model_root)
 
 
 def _flatten_state(state):
