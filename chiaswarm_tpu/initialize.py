@@ -41,6 +41,7 @@ _DOWNLOAD_PATTERNS = [
     "*.safetensors",
     "*.json",
     "tokenizer/*",
+    "tokenizer_2/*",
     "text_encoder/*",
     "text_encoder_2/*",
     "unet/*",
@@ -48,6 +49,15 @@ _DOWNLOAD_PATTERNS = [
     "scheduler/*",
     "*.txt",
 ]
+
+# annotator repos ship raw torch pickles, no safetensors — fetch ONLY the
+# files the detector loaders glob (a blanket *.pth would pull gigabytes
+# of unrelated checkpoints from lllyasviel/Annotators)
+_PTH_PATTERNS_BY_KEYWORD = {
+    "annotators": ["*HED*.pth", "*mlsd*.pth", "sk_model*.pth",
+                   "*pidinet*.pth"],
+    "openpose": ["*body_pose*.pth", "*.pth"],
+}
 
 
 def prompt_for_settings(existing: Settings) -> Settings:
@@ -79,11 +89,15 @@ def download_model(model_id: str, root: Path) -> bool:
         logger.error("huggingface_hub not installed; cannot download %s", model_id)
         return False
     target = root / model_id
+    patterns = list(_DOWNLOAD_PATTERNS)
+    for keyword, extra in _PTH_PATTERNS_BY_KEYWORD.items():
+        if keyword in model_id.lower():
+            patterns += extra
     try:
         snapshot_download(
             repo_id=model_id,
             local_dir=str(target),
-            allow_patterns=_DOWNLOAD_PATTERNS,
+            allow_patterns=patterns,
         )
         return True
     except Exception as e:
@@ -128,6 +142,8 @@ def verify_local_model(model_name: str, root: Path | None = None) -> dict | None
         return _verify_blip_model(model_name, root)
     if "zoedepth" in name:
         return _verify_zoedepth_model(model_name, root)
+    if "annotators" in name:
+        return _verify_annotators_repo(model_name, root)
     if "dpt" in name or "midas" in name:
         return _verify_dpt_model(model_name, root)
     if "safety" in name:
@@ -166,6 +182,44 @@ def verify_local_model(model_name: str, root: Path | None = None) -> dict | None
     if "i2vgen" in name:
         return _verify_i2vgen_model(model_name, root)
     return _verify_sd_model(model_name, root)
+
+
+def _verify_annotators_repo(model_name: str, root: Path) -> dict:
+    """The shared lllyasviel/Annotators repo holds several independent
+    detector checkpoints (HED, M-LSD, LineArt, PiDiNet); verify whichever
+    are present by converting each through its serving loader. An empty
+    directory is a failure; a missing individual detector is not (the
+    preprocessor degrades, flagged)."""
+    from .pipelines.aux_models import (
+        HEDDetector,
+        LineartDetector,
+        MLSDDetector,
+        PidinetDetector,
+    )
+
+    model_dir = root / model_name
+    if not model_dir.is_dir():
+        raise FileNotFoundError(f"no checkpoint directory {model_dir}")
+    report = {}
+    loaders = {
+        "hed": HEDDetector._load_converted,
+        "mlsd": MLSDDetector._load_converted,
+        "lineart": LineartDetector._load_converted,
+        "pidinet": PidinetDetector._load_converted,
+    }
+    for comp, load in loaders.items():
+        try:
+            converted = load(model_dir)
+        except (FileNotFoundError, KeyError):
+            continue
+        if isinstance(converted, tuple):  # lineart returns (cfg, params)
+            converted = converted[1]
+        report[comp] = _param_count(converted)
+    if not report:
+        raise FileNotFoundError(
+            f"no convertible detector checkpoints under {model_dir}"
+        )
+    return report
 
 
 def _verify_zoedepth_model(model_name: str, root: Path) -> dict:
@@ -1099,6 +1153,37 @@ def _verify_sd_model(model_name: str, root: Path) -> dict:
     return report
 
 
+def aux_model_names(settings: Settings) -> list[str]:
+    """Models the hive doesn't list but serving depends on: every
+    preprocessor detector (depth/pose/edges/lines/soft-edge/segmentation/
+    zoe — one shared Annotators repo covers four of them), the NSFW
+    checker, and the AnimateDiff motion adapter. `--download` fetches
+    these so a worker that advertises the full preprocessor set can
+    actually serve it un-degraded."""
+    from .pipelines.aux_models import (
+        DEFAULT_HED_MODEL,
+        DEFAULT_LINEART_MODEL,
+        DEFAULT_MLSD_MODEL,
+        DEFAULT_PIDINET_MODEL,
+        DEFAULT_POSE_MODEL,
+        DEFAULT_SEGMENTATION_MODEL,
+        DEFAULT_ZOE_MODEL,
+    )
+    from .weights import DEFAULT_MOTION_ADAPTER
+
+    out = []
+    for aux in (
+        settings.depth_model, settings.safety_checker_model,
+        DEFAULT_HED_MODEL, DEFAULT_MLSD_MODEL,
+        DEFAULT_LINEART_MODEL, DEFAULT_PIDINET_MODEL,
+        DEFAULT_POSE_MODEL, DEFAULT_SEGMENTATION_MODEL,
+        DEFAULT_ZOE_MODEL, DEFAULT_MOTION_ADAPTER,
+    ):
+        if aux and aux not in out:
+            out.append(aux)
+    return out
+
+
 async def fetch_hive_model_list(settings: Settings) -> list[str]:
     models = await get_models(f"{settings.sdaas_uri.rstrip('/')}/api")
     names = []
@@ -1151,10 +1236,8 @@ async def init() -> int:
             if not names:
                 print("hive returned no model list; pass --models explicitly")
                 return 1
-            # aux models the hive doesn't list but serving depends on
-            # (depth preprocessor / hint, NSFW envelope flag)
-            for aux in (settings.depth_model, settings.safety_checker_model):
-                if aux and aux not in names:
+            for aux in aux_model_names(settings):
+                if aux not in names:
                     names.append(aux)
         root = model_root()
         root.mkdir(parents=True, exist_ok=True)

@@ -44,9 +44,7 @@ logger = logging.getLogger(__name__)
 DEFAULT_FPS = 8
 VID2VID_CHUNK = 8  # frames per batched img2img program call
 
-# the adapter AnimateDiff jobs get unless the job names one (reference
-# tx2vid.py:26-36 hard-codes the same default)
-DEFAULT_MOTION_ADAPTER = "guoyww/animatediff-motion-adapter-v1-5-2"
+from ..weights import DEFAULT_MOTION_ADAPTER  # noqa: F401  (job default)
 
 
 def _model_dir(model_name: str):

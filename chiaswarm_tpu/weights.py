@@ -82,3 +82,10 @@ def model_dir_for(model_name: str):
 # every family the registry serves now has a real-weight conversion path;
 # the mechanism stays so a future family can gate honestly again
 UNCONVERTED_FAMILY_KEYWORDS: tuple[str, ...] = ()
+
+
+# the adapter AnimateDiff jobs get unless the job names one (reference
+# tx2vid.py:26-36 hard-codes the same default). Lives here — not in
+# pipelines/video.py — so the download CLI can read it without importing
+# the jax model stack.
+DEFAULT_MOTION_ADAPTER = "guoyww/animatediff-motion-adapter-v1-5-2"

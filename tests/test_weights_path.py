@@ -12,6 +12,8 @@ safetensors files — the pipeline then loads them through the same
 `load_torch_state_dict` path it uses for genuine HF checkpoints.
 """
 
+import os
+
 import numpy as np
 import pytest
 from safetensors.numpy import save_file
@@ -186,3 +188,61 @@ def test_initialize_reset_and_silent(sdaas_root, capsys, monkeypatch):
     monkeypatch.setattr("sys.argv", ["chiaswarm-tpu-init", "--reset"])
     assert asyncio.run(init_mod.init()) == 0
     assert not get_settings_full_path().is_file()
+
+
+def test_download_aux_list_covers_every_learned_detector(sdaas_root):
+    """--download must fetch every checkpoint the preprocessor set needs
+    to serve un-degraded (a worker that advertises detectors it never
+    downloaded would silently serve approximations)."""
+    from chiaswarm_tpu.initialize import _DOWNLOAD_PATTERNS, aux_model_names
+    from chiaswarm_tpu.settings import Settings
+
+    names = aux_model_names(Settings())
+    assert "lllyasviel/Annotators" in names  # HED/MLSD/LineArt/PiDiNet
+    assert "lllyasviel/ControlNet-openpose" in names
+    assert "openmmlab/upernet-convnext-small" in names
+    assert "Intel/zoedepth-nyu" in names
+    assert any("motion-adapter" in n for n in names)
+    assert len(names) == len(set(names))
+    # the Annotators repo ships raw .pth pickles — the fetch patterns
+    # must cover exactly the files the detector loaders glob (a blanket
+    # *.pth would pull gigabytes of unrelated checkpoints)
+    from chiaswarm_tpu.initialize import _PTH_PATTERNS_BY_KEYWORD
+
+    assert "*.pth" not in _DOWNLOAD_PATTERNS
+    ann = _PTH_PATTERNS_BY_KEYWORD["annotators"]
+    for pattern in ("*HED*.pth", "*mlsd*.pth", "sk_model*.pth",
+                    "*pidinet*.pth"):
+        assert pattern in ann
+
+
+def test_verify_annotators_repo_reports_present_detectors(sdaas_root,
+                                                          tmp_path):
+    """--check on the shared Annotators repo converts whichever detector
+    checkpoints are present instead of failing through the SD verifier."""
+    import sys
+
+    import torch
+
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    from torch_unet_ref import LineartGeneratorT
+
+    from chiaswarm_tpu.initialize import verify_local_model
+    from chiaswarm_tpu.settings import Settings, save_settings
+
+    root = tmp_path / "models"
+    repo = root / "lllyasviel/Annotators"
+    repo.mkdir(parents=True)
+    save_settings(Settings(model_root_dir=str(root)))
+    torch.manual_seed(1)
+    torch.save(LineartGeneratorT(base=8, n_res=1).state_dict(),
+               str(repo / "sk_model.pth"))
+
+    report = verify_local_model("lllyasviel/Annotators", root)
+    assert report == {"lineart": report["lineart"]}
+    assert report["lineart"] > 0
+
+    import pytest
+
+    with pytest.raises(FileNotFoundError):
+        verify_local_model("lllyasviel/Annotators", tmp_path / "empty")
