@@ -9,12 +9,21 @@ Non-causal (diffusion attention has no causal mask), self- and cross-
 attention (padded + masked KV for ragged text lengths like 77).
 
 Layout: q [B, Sq, H, D], k/v [B, Skv, H, D] -> [B, Sq, H, D], matching
-ops.attention. Internally heads fold into the grid's batch dimension.
+ops.attention. Heads ride the GRID via BlockSpec index maps — unlike the
+round-2 kernel there is no [B,S,H,D] -> [B*H,S,D] transpose+reshape, which
+materialized full copies of Q, K, V and O in HBM around every attention
+call (~6 extra tensor round-trips of pure bandwidth per layer). The only
+remaining host-side data movement is S-axis padding, and the common
+diffusion sequence lengths (4096, 1024, 256) pad to nothing.
+
+Block sizes are env-tunable for on-hardware sweeps:
+CHIASWARM_FLASH_BLOCK_Q / CHIASWARM_FLASH_BLOCK_K (default 512).
 """
 
 from __future__ import annotations
 
 import functools
+import os
 
 import jax
 import jax.numpy as jnp
@@ -23,13 +32,22 @@ from jax.experimental import pallas as pl
 _NEG_INF = -1e30
 
 
+def _env_blocks() -> tuple[int, int]:
+    # read fresh on every call: an in-process sweep that re-exports the
+    # env vars must get new kernels, not the first trace's cached blocks
+    return (
+        int(os.environ.get("CHIASWARM_FLASH_BLOCK_Q", "512")),
+        int(os.environ.get("CHIASWARM_FLASH_BLOCK_K", "512")),
+    )
+
+
 def _flash_kernel(q_ref, k_ref, v_ref, o_ref, *, block_k: int, kv_len: int,
                   scale: float):
     """One (batch*head, q-block) program: stream KV blocks, online softmax.
 
-    q_ref [1, BQ, D]; k_ref/v_ref [1, Skv_pad, D]; o_ref [1, BQ, D].
+    q_ref [1, BQ, 1, D]; k_ref/v_ref [1, Skv_pad, 1, D]; o_ref [1, BQ, 1, D].
     """
-    q = q_ref[0].astype(jnp.float32) * scale
+    q = q_ref[0, :, 0, :].astype(jnp.float32) * scale
     block_q, head_dim = q.shape
     padded_kv = k_ref.shape[1]
 
@@ -39,8 +57,8 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, *, block_k: int, kv_len: int,
 
     def body(j, carry):
         m, l, acc = carry
-        k = k_ref[0, pl.ds(j * block_k, block_k), :]
-        v = v_ref[0, pl.ds(j * block_k, block_k), :]
+        k = k_ref[0, pl.ds(j * block_k, block_k), 0, :]
+        v = v_ref[0, pl.ds(j * block_k, block_k), 0, :]
         s = jax.lax.dot_general(
             q, k.astype(jnp.float32),
             dimension_numbers=(((1,), (1,)), ((), ())),
@@ -62,7 +80,7 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, *, block_k: int, kv_len: int,
         return m_new, l_new, acc_new
 
     _, l, acc = jax.lax.fori_loop(0, padded_kv // block_k, body, (m0, l0, acc0))
-    o_ref[0] = (acc / jnp.maximum(l, 1e-30)).astype(o_ref.dtype)
+    o_ref[0, :, 0, :] = (acc / jnp.maximum(l, 1e-30)).astype(o_ref.dtype)
 
 
 def _pad_to(x, length: int, axis: int):
@@ -74,12 +92,30 @@ def _pad_to(x, length: int, axis: int):
     return jnp.pad(x, widths)
 
 
+def flash_attention(q, k, v, scale: float | None = None,
+                    block_q: int | None = None, block_k: int | None = None,
+                    interpret: bool = False):
+    """[B, Sq, H, D] x [B, Skv, H, D] -> [B, Sq, H, D].
+
+    Env defaults are resolved OUTSIDE the jitted impl so the jit cache is
+    keyed on the concrete block sizes — otherwise a block_q=None call
+    would silently reuse whichever sizes the first trace saw.
+    """
+    env_q, env_k = _env_blocks()
+    return _flash_impl(
+        q, k, v,
+        scale=scale,
+        block_q=block_q if block_q is not None else env_q,
+        block_k=block_k if block_k is not None else env_k,
+        interpret=interpret,
+    )
+
+
 @functools.partial(
     jax.jit, static_argnames=("scale", "block_q", "block_k", "interpret")
 )
-def flash_attention(q, k, v, scale: float | None = None, block_q: int = 512,
-                    block_k: int = 512, interpret: bool = False):
-    """[B, Sq, H, D] x [B, Skv, H, D] -> [B, Sq, H, D]."""
+def _flash_impl(q, k, v, scale: float | None, block_q: int, block_k: int,
+                interpret: bool):
     if scale is None:
         scale = q.shape[-1] ** -0.5
     b, sq, h, d = q.shape
@@ -91,12 +127,13 @@ def flash_attention(q, k, v, scale: float | None = None, block_q: int = 512,
     sq_pad = _round_up(sq, block_q)
     skv_pad = _round_up(skv, block_k)
 
-    # [B, S, H, D] -> [B*H, S, D] so heads ride the grid's batch dim
-    fold = lambda x, s_pad: _pad_to(
-        jnp.transpose(x, (0, 2, 1, 3)), s_pad, 2
-    ).reshape(b * h, s_pad, d)
-    qf, kf, vf = fold(q, sq_pad), fold(k, skv_pad), fold(v, skv_pad)
+    q = _pad_to(q, sq_pad, 1)
+    k = _pad_to(k, skv_pad, 1)
+    v = _pad_to(v, skv_pad, 1)
 
+    # heads fold into the grid via the index maps — no data movement. The
+    # grid order (bh outer, q-block inner) keeps each head's KV block
+    # resident in VMEM across its q-blocks (identical index -> no refetch).
     grid = (b * h, sq_pad // block_q)
     out = pl.pallas_call(
         functools.partial(
@@ -104,17 +141,18 @@ def flash_attention(q, k, v, scale: float | None = None, block_q: int = 512,
         ),
         grid=grid,
         in_specs=[
-            pl.BlockSpec((1, block_q, d), lambda bh, i: (bh, i, 0)),
-            pl.BlockSpec((1, skv_pad, d), lambda bh, i: (bh, 0, 0)),
-            pl.BlockSpec((1, skv_pad, d), lambda bh, i: (bh, 0, 0)),
+            pl.BlockSpec((1, block_q, 1, d), lambda bh, i: (bh // h, i, bh % h, 0)),
+            pl.BlockSpec((1, skv_pad, 1, d), lambda bh, i: (bh // h, 0, bh % h, 0)),
+            pl.BlockSpec((1, skv_pad, 1, d), lambda bh, i: (bh // h, 0, bh % h, 0)),
         ],
-        out_specs=pl.BlockSpec((1, block_q, d), lambda bh, i: (bh, i, 0)),
-        out_shape=jax.ShapeDtypeStruct((b * h, sq_pad, d), q.dtype),
+        out_specs=pl.BlockSpec(
+            (1, block_q, 1, d), lambda bh, i: (bh // h, i, bh % h, 0)
+        ),
+        out_shape=jax.ShapeDtypeStruct((b, sq_pad, h, d), q.dtype),
         interpret=interpret,
-    )(qf, kf, vf)
+    )(q, k, v)
 
-    out = out.reshape(b, h, sq_pad, d)[:, :, :sq, :]
-    return jnp.transpose(out, (0, 2, 1, 3))
+    return out[:, :sq]
 
 
 def _round_up(n: int, m: int) -> int:
