@@ -56,7 +56,10 @@ _DOWNLOAD_PATTERNS = [
 _PTH_PATTERNS_BY_KEYWORD = {
     "annotators": ["*HED*.pth", "*mlsd*.pth", "sk_model*.pth",
                    "*pidinet*.pth"],
-    "openpose": ["*body_pose*.pth", "*.pth"],
+    # the pose loader globs only *body_pose*.pth (aux_models.py); a
+    # blanket *.pth here would also pull the multi-GB full ControlNet
+    # checkpoints those repos carry (ADVICE r04)
+    "openpose": ["*body_pose*.pth"],
 }
 
 
@@ -1239,13 +1242,25 @@ async def init() -> int:
             for aux in aux_model_names(settings):
                 if aux not in names:
                     names.append(aux)
+        # aux detectors appended from the hive list degrade gracefully at
+        # serving time (flagged fallbacks), so their download failures are
+        # warnings — but anything the operator EXPLICITLY asked for via
+        # --models still fails the run (ADVICE r04)
+        soft_fail = set() if args.models is not None else set(
+            aux_model_names(settings))
         root = model_root()
         root.mkdir(parents=True, exist_ok=True)
         for name in names:
             if args.download:
                 ok = download_model(name, root)
-                print(f"download {name}: {'ok' if ok else 'FAILED'}")
-                rc |= 0 if ok else 1
+                if ok:
+                    print(f"download {name}: ok")
+                elif name in soft_fail:
+                    print(f"download {name}: FAILED (aux model; serving "
+                          f"will flag degraded fallbacks)")
+                else:
+                    print(f"download {name}: FAILED")
+                    rc |= 1
             if args.check:
                 try:
                     report = verify_local_model(name, root)

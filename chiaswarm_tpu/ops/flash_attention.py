@@ -47,7 +47,11 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, *, block_k: int, kv_len: int,
 
     q_ref [1, BQ, 1, D]; k_ref/v_ref [1, Skv_pad, 1, D]; o_ref [1, BQ, 1, D].
     """
-    q = q_ref[0, :, 0, :].astype(jnp.float32) * scale
+    # QK^T runs in the INPUT dtype (bf16 on TPU) with f32 accumulation:
+    # the MXU computes bf16 x bf16 -> f32 natively at full rate, while an
+    # f32 x f32 matmul costs several passes. The softmax scale applies to
+    # the f32 scores after the dot, so no precision is lost to scaling.
+    q = q_ref[0, :, 0, :]
     block_q, head_dim = q.shape
     padded_kv = k_ref.shape[1]
 
@@ -60,10 +64,10 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, *, block_k: int, kv_len: int,
         k = k_ref[0, pl.ds(j * block_k, block_k), 0, :]
         v = v_ref[0, pl.ds(j * block_k, block_k), 0, :]
         s = jax.lax.dot_general(
-            q, k.astype(jnp.float32),
+            q, k,
             dimension_numbers=(((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
-        )  # [BQ, BK]
+        ) * scale  # [BQ, BK] f32
         # mask KV padding (ragged cross-attention lengths)
         if kv_len % block_k:
             col = j * block_k + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)

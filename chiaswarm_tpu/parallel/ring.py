@@ -28,7 +28,11 @@ def _block_attend(q, k, v, scale):
     Returns (unnormalized_out [B,Sq,H,D], row_max [B,H,Sq], row_sum [B,H,Sq])
     in float32 for stable cross-block merging.
     """
-    logits = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32) * scale
+    # bf16 x bf16 -> f32 in one MXU pass (accumulation already f32 on TPU;
+    # preferred_element_type keeps the f32 result instead of downcasting)
+    logits = jnp.einsum(
+        "bqhd,bkhd->bhqk", q, k, preferred_element_type=jnp.float32
+    ) * scale
     m = jnp.max(logits, axis=-1)
     p = jnp.exp(logits - m[..., None])
     s = jnp.sum(p, axis=-1)

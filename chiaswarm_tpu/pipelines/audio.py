@@ -16,6 +16,7 @@ from __future__ import annotations
 import dataclasses
 import io
 import logging
+import os
 import time
 import zlib
 
@@ -532,7 +533,25 @@ def audio_artifact(
     against libmpg123) and honors an explicit "audio/wav" request. Any
     encode failure degrades to WAV with the content type reflecting what
     was actually produced.
+
+    Layer I at high bitrate is an unusual stream some clients may
+    mishandle; CHIASWARM_FFMPEG_AUDIO=1 re-encodes through ffmpeg to
+    Layer III (MP3) when the binary is present (it is in the Docker
+    image), falling back to the built-in encoder otherwise.
     """
+    if content_type != "audio/wav" and os.environ.get(
+            "CHIASWARM_FFMPEG_AUDIO", "") == "1":
+        try:
+            # force the output to a legal MP3 rate so the returned rate
+            # matches the actual stream (ffmpeg would otherwise resample
+            # silently and the envelope metadata would lie)
+            mp3_rate = min(_MP3_RATES, key=lambda r: abs(r - rate))
+            buf = _ffmpeg_mp3(wav, rate, mp3_rate)
+            if buf is not None:
+                return buf, "audio/mpeg", mp3_rate
+        except Exception as e:
+            logger.warning("ffmpeg mp3 encode failed (%s); using built-in "
+                           "Layer I encoder", e)
     if content_type != "audio/wav":
         try:
             from ..toolbox.mpeg_audio import SUPPORTED_RATES, encode_mpeg_buffer
@@ -552,6 +571,32 @@ def audio_artifact(
         except Exception as e:
             logger.warning("MPEG encode failed (%s); emitting WAV", e)
     return wav_to_buffer(wav, rate), "audio/wav", rate
+
+
+# sample rates MPEG-1/2/2.5 Layer III can carry
+_MP3_RATES = (8000, 11025, 12000, 16000, 22050, 24000, 32000, 44100, 48000)
+
+
+def _ffmpeg_mp3(wav: np.ndarray, in_rate: int,
+                out_rate: int) -> io.BytesIO | None:
+    """Pipe f32 PCM through a local ffmpeg to a Layer-III stream at
+    `out_rate`; None when no ffmpeg binary is on PATH (caller falls
+    back)."""
+    import shutil
+    import subprocess
+
+    if shutil.which("ffmpeg") is None:
+        return None
+    pcm = np.clip(np.asarray(wav, np.float32), -1.0, 1.0)
+    proc = subprocess.run(
+        ["ffmpeg", "-loglevel", "error", "-f", "f32le", "-ar", str(in_rate),
+         "-ac", "1", "-i", "pipe:0", "-f", "mp3", "-ar", str(out_rate),
+         "-b:a", "192k", "pipe:1"],
+        input=pcm.tobytes(), capture_output=True, timeout=120,
+    )
+    if proc.returncode != 0 or not proc.stdout:
+        raise RuntimeError(proc.stderr[-200:].decode("utf-8", "replace"))
+    return io.BytesIO(proc.stdout)
 
 
 @register_family("audioldm")

@@ -28,6 +28,48 @@ _DEPTH: dict[str, "DepthEstimator"] = {}
 _DEPTH_LOCK = threading.Lock()
 
 
+def _model_dir_stamp(name: str) -> float:
+    """mtime of the model directory under the model root (-1 if absent).
+
+    Negative detector caches key on this: a worker started before
+    `initialize --download` completed must pick the weights up on the next
+    job instead of serving degraded fallbacks for its whole lifetime
+    (ADVICE r04)."""
+    from ..settings import load_settings
+
+    d = Path(load_settings().model_root_dir).expanduser() / name
+    try:
+        return d.stat().st_mtime
+    except OSError:
+        return -1.0
+
+
+def _cached_detector(cache: dict, name: str, builder, label: str,
+                     exceptions: tuple):
+    """Build-or-fetch a resident detector with mtime-aware negative
+    caching. cache maps name -> (detector_or_None, dir_stamp); a cached
+    None is honored only while the checkpoint directory is unchanged.
+    Caller must hold the cache's lock."""
+    hit = cache.get(name)
+    if hit is not None:
+        det, stamp = hit
+        if det is not None or stamp == _model_dir_stamp(name):
+            return det
+        logger.info("%s checkpoint dir changed; re-probing weights", label)
+    # stamp BEFORE building: if a download completes between the failed
+    # build and the stamp read, the stale stamp must not match the
+    # now-complete directory (that would re-freeze the negative cache)
+    stamp = _model_dir_stamp(name)
+    try:
+        det = builder()
+    except exceptions as e:
+        logger.info("no converted %s weights (%s)", label, e)
+        cache[name] = (None, stamp)
+        return None
+    cache[name] = (det, 0.0)
+    return det
+
+
 class DepthEstimator:
     def __init__(self, model_name: str = DEFAULT_DEPTH_MODEL,
                  allow_random_init: bool = False):
@@ -570,16 +612,10 @@ def get_segmenter(model_name: str | None = None):
 
     name = model_name or DEFAULT_SEGMENTATION_MODEL
     with _SEG_LOCK:
-        if name in _SEG:
-            return _SEG[name]
-        try:
-            seg = Segmenter(name)
-        except (MissingWeightsError, FileNotFoundError, OSError) as e:
-            logger.info("no converted segmentation weights (%s)", e)
-            _SEG[name] = None  # negative-cache: stop re-reading weights per job
-            return None
-        _SEG[name] = seg
-        return seg
+        return _cached_detector(
+            _SEG, name, lambda: Segmenter(name), "segmentation",
+            (MissingWeightsError, FileNotFoundError, OSError),
+        )
 
 
 # --- M-LSD line detector (mlsd preprocessor backend) ---
@@ -661,7 +697,10 @@ class MLSDDetector:
         )[0]
         center, disp = tp[:, :, 0], tp[:, :, 1:5]
         heat = 1.0 / (1.0 + np.exp(-center))
-        hmax = cv2.dilate(heat, np.ones((3, 3), np.uint8))
+        # 5x5 NMS window to match upstream controlnet_aux's pred_lines
+        # decode (max-pool ksize=5) — a 3x3 window kept near-duplicate
+        # peaks the reference annotator suppresses (ADVICE r04)
+        hmax = cv2.dilate(heat, np.ones((5, 5), np.uint8))
         heat = np.where(heat >= hmax, heat, 0.0)
         flat = heat.ravel()
         top = np.argsort(flat)[::-1][:200]
@@ -693,17 +732,10 @@ def get_mlsd_detector(model_name: str | None = None):
 
     name = model_name or DEFAULT_MLSD_MODEL
     with _MLSD_LOCK:
-        if name in _MLSD:
-            return _MLSD[name]
-        try:
-            det = MLSDDetector(name)
-        except (MissingWeightsError, FileNotFoundError, OSError,
-                KeyError) as e:
-            logger.info("no converted MLSD weights (%s)", e)
-            _MLSD[name] = None  # negative-cache: stop re-reading per job
-            return None
-        _MLSD[name] = det
-        return det
+        return _cached_detector(
+            _MLSD, name, lambda: MLSDDetector(name), "MLSD",
+            (MissingWeightsError, FileNotFoundError, OSError, KeyError),
+        )
 
 
 # --- LineArt generator (lineart preprocessor backend) ---
@@ -792,17 +824,10 @@ def get_lineart_detector(model_name: str | None = None):
 
     name = model_name or DEFAULT_LINEART_MODEL
     with _LINEART_LOCK:
-        if name in _LINEART:
-            return _LINEART[name]
-        try:
-            det = LineartDetector(name)
-        except (MissingWeightsError, FileNotFoundError, OSError,
-                KeyError) as e:
-            logger.info("no converted LineArt weights (%s)", e)
-            _LINEART[name] = None  # negative-cache: stop re-reading per job
-            return None
-        _LINEART[name] = det
-        return det
+        return _cached_detector(
+            _LINEART, name, lambda: LineartDetector(name), "LineArt",
+            (MissingWeightsError, FileNotFoundError, OSError, KeyError),
+        )
 
 
 # --- PiDiNet soft-edge (softedge preprocessor backend) ---
@@ -896,17 +921,10 @@ def get_pidinet_detector(model_name: str | None = None):
 
     name = model_name or DEFAULT_PIDINET_MODEL
     with _PIDI_LOCK:
-        if name in _PIDI:
-            return _PIDI[name]
-        try:
-            det = PidinetDetector(name)
-        except (MissingWeightsError, FileNotFoundError, OSError,
-                KeyError) as e:
-            logger.info("no converted PiDiNet weights (%s)", e)
-            _PIDI[name] = None  # negative-cache: stop re-reading per job
-            return None
-        _PIDI[name] = det
-        return det
+        return _cached_detector(
+            _PIDI, name, lambda: PidinetDetector(name), "PiDiNet",
+            (MissingWeightsError, FileNotFoundError, OSError, KeyError),
+        )
 
 
 # --- ZoeDepth metric depth (zoe preprocessor backend) ---
@@ -986,14 +1004,8 @@ def get_zoe_estimator(model_name: str | None = None):
 
     name = model_name or DEFAULT_ZOE_MODEL
     with _ZOE_LOCK:
-        if name in _ZOE:
-            return _ZOE[name]
-        try:
-            est = ZoeEstimator(name)
-        except (MissingWeightsError, FileNotFoundError, OSError, KeyError,
-                ValueError) as e:
-            logger.info("no converted ZoeDepth weights (%s)", e)
-            _ZOE[name] = None  # negative-cache: stop re-reading per job
-            return None
-        _ZOE[name] = est
-        return est
+        return _cached_detector(
+            _ZOE, name, lambda: ZoeEstimator(name), "ZoeDepth",
+            (MissingWeightsError, FileNotFoundError, OSError, KeyError,
+             ValueError),
+        )

@@ -256,8 +256,8 @@ class I2VGenPipeline:
         with self._lock:
             if key in self._programs:
                 return self._programs[key]
-        lh, lw, frames, steps = key
-        scheduler = get_scheduler("DDIMScheduler")
+        lh, lw, frames, steps, sched_name = key
+        scheduler = get_scheduler(sched_name)
         schedule = scheduler.schedule(steps)
         unet = self.unet
         vae = self.vae
@@ -343,6 +343,13 @@ class I2VGenPipeline:
         )
         fps = float(kwargs.pop("target_fps", kwargs.pop("fps", 16)))
         guidance = float(kwargs.pop("guidance_scale", 9.0))
+        # honor the job's requested solver like the sibling pipelines do
+        # (ADVICE r04: DDIM was hardcoded and the request silently ignored);
+        # the job layer defaults img2vid to DPMSolverMultistepScheduler
+        # (job_arguments.py DEFAULT_SCHEDULER, reference job_arguments.py:143)
+        scheduler_type = kwargs.pop(
+            "scheduler_type", "DPMSolverMultistepScheduler"
+        )
         rng = kwargs.pop("rng", None)
         if rng is None:
             rng = jax.random.key(0)
@@ -402,7 +409,7 @@ class I2VGenPipeline:
             image_latents = first
         timings["conditioning_s"] = round(time.perf_counter() - t0, 3)
 
-        program = self._program((lh, lw, frames, steps))
+        program = self._program((lh, lw, frames, steps, scheduler_type))
         t0 = time.perf_counter()
         pixels = jax.block_until_ready(
             program(params, rng, context, image_embed, image_latents,
@@ -414,7 +421,7 @@ class I2VGenPipeline:
         config = {
             "model": self.model_name,
             "pipeline": pipeline_type,
-            "scheduler": "DDIMScheduler",
+            "scheduler": scheduler_type,
             "mode": "img2vid",
             "steps": steps,
             "frames": frames,

@@ -33,6 +33,11 @@ class Settings:
     # mesh's `seq` axis for long self-attention); tensor * seq must divide
     # the slice's chip count
     sequence_parallelism: int = 1
+    # self-attention sequence length at which the ring route engages when a
+    # seq axis is active (4096 tokens = a 1024^2 SDXL canvas's largest
+    # attention level); configurable so tests and small-canvas deployments
+    # exercise the exact production routing instead of monkey-patching
+    ring_min_seq: int = 2048
     # persistent XLA compilation cache (the TPU analog of the HF model cache)
     compilation_cache_dir: str = "~/.sdaas/xla_cache"
     # model weight root (converted Flax checkpoints / HF safetensors)
@@ -61,6 +66,7 @@ _ENV_OVERRIDES = {
     "SDAAS_CHIPS_PER_JOB": "chips_per_job",
     "SDAAS_TENSOR_PARALLELISM": "tensor_parallelism",
     "SDAAS_SEQUENCE_PARALLELISM": "sequence_parallelism",
+    "SDAAS_RING_MIN_SEQ": "ring_min_seq",
     "SDAAS_DTYPE": "dtype",
 }
 

@@ -140,3 +140,33 @@ def test_audio_artifact_contract():
     assert produced == "audio/mpeg" and rate == 16000
     head = buf.read(2)
     assert head[0] == 0xFF and (head[1] & 0xE0) == 0xE0
+
+
+def test_ffmpeg_escape_hatch(monkeypatch, tmp_path):
+    """CHIASWARM_FFMPEG_AUDIO=1 routes through a PATH ffmpeg when present
+    and falls back to the built-in Layer-I encoder when absent."""
+    import os
+
+    from chiaswarm_tpu.pipelines.audio import audio_artifact
+
+    monkeypatch.setenv("CHIASWARM_FFMPEG_AUDIO", "1")
+    real_path = os.environ.get("PATH", "")
+
+    # no ffmpeg on PATH -> built-in encoder still produces audio/mpeg
+    monkeypatch.setenv("PATH", str(tmp_path / "nowhere"))
+    buf, produced, rate = audio_artifact(_tone(16000, 0.1), 16000)
+    assert produced == "audio/mpeg"
+    head = buf.read(2)
+    assert head[0] == 0xFF and (head[1] & 0xE0) == 0xE0
+
+    # fake ffmpeg FIRST on the real PATH (the script still needs cat) ->
+    # its stdout becomes the artifact verbatim
+    fake = tmp_path / "bin"
+    fake.mkdir()
+    script = fake / "ffmpeg"
+    script.write_text("#!/bin/sh\ncat > /dev/null\nprintf 'MP3!'\n")
+    script.chmod(0o755)
+    monkeypatch.setenv("PATH", str(fake) + os.pathsep + real_path)
+    buf, produced, rate = audio_artifact(_tone(16000, 0.1), 16000)
+    assert produced == "audio/mpeg"
+    assert buf.read() == b"MP3!"
