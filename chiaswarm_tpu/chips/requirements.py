@@ -119,6 +119,42 @@ def min_chips(model_name: str, hbm_gb_per_chip: float, size: int = 1024,
     return n
 
 
+# Flux weight streaming (the TPU analog of the reference's sequential CPU
+# offload, swarm/job_arguments.py:209-218): the 12B MMDiT pages through the
+# chip block-by-block from host RAM, so only the resident tail (T5-XXL
+# 9.4 GB + CLIP/VAE/head/final ~0.8) plus two ~0.8 GB double-buffered
+# block transfers must fit alongside activations.
+FLUX_STREAM_RESIDENT_GB = 12.0
+
+
+def flux_stream_fit(chipset, batch: int, size: int,
+                    width: int | None = None) -> int:
+    """Largest batch a single-chip slice serves with flux weight
+    streaming; 0 when even the resident tail + one image doesn't fit.
+    Streaming v1 targets exactly the small-worker gap: one-chip slices,
+    tensor=1 (multi-chip slices shard the resident model instead)."""
+    if chipset is None or chipset.platform != "tpu":
+        return batch
+    if chipset.chip_count() != 1 or max(getattr(chipset, "tensor", 1), 1) > 1:
+        return 0
+    per_chip_hbm = chipset.hbm_bytes() / (1 << 30)
+    act = FAMILY_ACT_GB_PER_IMAGE["flux"]
+    free = per_chip_hbm - FLUX_STREAM_RESIDENT_GB
+    per_image = act * _area_scale(size, width)
+    if free < per_image:
+        return 0
+    return min(batch, int(free / per_image))
+
+
+def streaming_enabled() -> bool:
+    from ..settings import load_settings
+
+    try:
+        return bool(load_settings().flux_streaming)
+    except Exception:
+        return True
+
+
 def fit_batch(chipset, model_name: str, batch: int, size: int,
               width: int | None = None) -> int:
     """Largest batch (<= requested) this slice fits; 0 = model doesn't fit.
@@ -159,6 +195,9 @@ def check_capacity(chipset, model_name: str, batch: int, size: int,
                    width: int | None = None) -> int:
     """-> allowed batch, or raise a fatal job error naming the fix."""
     allowed = fit_batch(chipset, model_name, batch, size, width)
+    if allowed == 0 and _family_key(model_name) == "flux" \
+            and streaming_enabled():
+        allowed = flux_stream_fit(chipset, batch, size, width)
     if allowed == 0:
         hbm_gb = chipset.hbm_bytes() / (1 << 30)
         per_chip = hbm_gb / max(chipset.chip_count(), 1)

@@ -306,6 +306,67 @@ class FluxTransformer(nn.Module):
         )(x)
 
 
+class FluxHead(nn.Module):
+    """The pre-block section of FluxTransformer as a standalone module.
+
+    Param names (img_in/txt_in/time_in/guidance_in/vector_in) match the
+    monolith exactly, so the weight-streaming runner applies it against
+    the SAME converted tree (a subset of params['flux']) — parity between
+    the streamed and resident paths is asserted in tests/test_flux_stream.
+    """
+
+    config: FluxConfig
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, img, txt, timesteps, pooled, guidance=None):
+        cfg = self.config
+        img = nn.Dense(cfg.hidden_size, dtype=self.dtype, name="img_in")(img)
+        txt = nn.Dense(cfg.hidden_size, dtype=self.dtype, name="txt_in")(txt)
+        vec = MLPEmbedder(cfg.hidden_size, dtype=self.dtype, name="time_in")(
+            timestep_embedding(timesteps, 256).astype(self.dtype)
+        )
+        if cfg.guidance_embed:
+            g = guidance if guidance is not None else jnp.ones_like(timesteps)
+            vec = vec + MLPEmbedder(
+                cfg.hidden_size, dtype=self.dtype, name="guidance_in"
+            )(timestep_embedding(g, 256).astype(self.dtype))
+        vec = vec + MLPEmbedder(
+            cfg.hidden_size, dtype=self.dtype, name="vector_in"
+        )(pooled.astype(self.dtype))
+        return img, txt, vec
+
+
+class FluxFinal(nn.Module):
+    """The post-block section of FluxTransformer (modulated output proj),
+    standalone for the streaming runner; names match the monolith."""
+
+    config: FluxConfig
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, vec):
+        cfg = self.config
+        shift, scale = jnp.split(
+            nn.Dense(2 * cfg.hidden_size, dtype=self.dtype,
+                     name="final_layer_mod")(nn.silu(vec))[:, None, :],
+            2, axis=-1,
+        )
+        x = nn.LayerNorm(
+            use_bias=False, use_scale=False, epsilon=1e-6, dtype=self.dtype
+        )(x)
+        x = x * (1 + scale) + shift
+        return nn.Dense(
+            cfg.in_channels, dtype=self.dtype, name="final_layer_linear"
+        )(x)
+
+
+# params['flux'] keys consumed by FluxHead / FluxFinal (the rest are the
+# double_blocks_i / single_blocks_i trees the streaming runner pages in)
+HEAD_KEYS = ("img_in", "txt_in", "time_in", "guidance_in", "vector_in")
+FINAL_KEYS = ("final_layer_mod", "final_layer_linear")
+
+
 def patchify(latents):
     """[B, H, W, C] -> ([B, H/2*W/2, 4C], ids [B, S, 3])."""
     b, h, w, c = latents.shape

@@ -26,7 +26,20 @@ import numpy as np
 
 from ..models import configs as cfgs
 from ..models.clip import CLIPTextEncoder
-from ..models.flux import TINY_FLUX, FluxConfig, FluxTransformer, patchify, unpatchify
+from ..models.flux import (
+    FINAL_KEYS,
+    HEAD_KEYS,
+    TINY_FLUX,
+    DoubleStreamBlock,
+    FluxConfig,
+    FluxFinal,
+    FluxHead,
+    FluxTransformer,
+    SingleStreamBlock,
+    patchify,
+    rope_frequencies,
+    unpatchify,
+)
 from ..models.t5 import TINY_T5, T5Config, T5Encoder
 from ..models.tokenizer import load_tokenizer
 from ..models.vae import AutoencoderKL
@@ -83,7 +96,8 @@ class FluxPipeline:
     """One resident Flux bundle per (model, slice)."""
 
     def __init__(self, model_name: str, chipset=None, dtype=None,
-                 allow_random_init: bool = False):
+                 allow_random_init: bool = False,
+                 streaming: bool | None = None):
         self.model_name = model_name
         self.chipset = chipset
         (self.config, t5_cfg, clip_cfg, vae_cfg, self.default_size,
@@ -104,6 +118,26 @@ class FluxPipeline:
         self.data_parts = self.mesh.shape.get("data", 1)
         self.tensor_parts = self.mesh.shape.get("tensor", 1)
 
+        if streaming is None:
+            # auto: page transformer blocks from host RAM when the model
+            # cannot sit resident on this slice (the TPU analog of the
+            # reference's enable_sequential_cpu_offload — VERDICT r04 #2)
+            from ..chips.requirements import (
+                fit_batch,
+                flux_stream_fit,
+                streaming_enabled,
+            )
+
+            streaming = (
+                chipset is not None
+                and fit_batch(chipset, model_name, 1, self.default_size) == 0
+                and streaming_enabled()
+                and bool(flux_stream_fit(chipset, 1, self.default_size))
+            )
+        self.streaming = bool(streaming)
+        self._host_double: list = []
+        self._host_single: list = []
+
         t0 = time.perf_counter()
         self.params = self._load_params(allow_random_init)
         model_dir = self._model_dir()
@@ -122,6 +156,8 @@ class FluxPipeline:
         return d if d.is_dir() else None
 
     def _place(self, params):
+        if self.streaming:
+            return self._place_streaming(params)
         cast = lambda x: jnp.asarray(x, self.dtype)
         params = jax.tree_util.tree_map(cast, params)
         if self.tensor_parts <= 1:
@@ -135,6 +171,32 @@ class FluxPipeline:
             else:
                 placed[key] = shard_params(self.mesh, tree)
         return placed
+
+    def _place_streaming(self, params):
+        """Resident tail (T5/CLIP/VAE + flux head/final) on the chip;
+        transformer blocks stay in HOST RAM (serving-dtype jax CPU arrays,
+        halving the per-step PCIe traffic vs f32) and page through the
+        chip double-buffered during sampling."""
+        cfg = self.config
+        cpu = jax.local_devices(backend="cpu")[0]
+        flux = params["flux"]
+        cast = lambda x: jnp.asarray(x, self.dtype)
+        with jax.default_device(cpu):
+            self._host_double = [
+                jax.tree_util.tree_map(cast, flux[f"double_blocks_{i}"])
+                for i in range(cfg.depth_double)
+            ]
+            self._host_single = [
+                jax.tree_util.tree_map(cast, flux[f"single_blocks_{i}"])
+                for i in range(cfg.depth_single)
+            ]
+        resident = {
+            "flux": {k: flux[k] for k in (*HEAD_KEYS, *FINAL_KEYS)
+                     if k in flux},
+            "t5": params["t5"], "clip": params["clip"], "vae": params["vae"],
+        }
+        resident = jax.tree_util.tree_map(cast, resident)
+        return jax.device_put(resident, replicated(self.mesh))
 
     def _load_params(self, allow_random_init: bool) -> dict:
         model_dir = self._model_dir()
@@ -193,6 +255,10 @@ class FluxPipeline:
     def release(self):
         self.params = None
         self._programs.clear()
+        self._host_double = []
+        self._host_single = []
+        if hasattr(self, "_sfns"):
+            del self._sfns
 
     # --- conditioning ---
 
@@ -257,6 +323,101 @@ class FluxPipeline:
             self._programs[key] = program
         return program
 
+    # --- weight-streaming sampler (host-RAM paged transformer blocks) ---
+
+    def _stream_fns(self) -> dict:
+        """Jitted per-block programs: ONE executable per block type is
+        reused by all 19/38 block instances (identical shapes/structure),
+        so compile cost is constant, not per-block."""
+        with self._jit_lock:
+            if hasattr(self, "_sfns"):
+                return self._sfns
+        cfg, dtype = self.config, self.dtype
+        head = FluxHead(cfg, dtype=dtype)
+        final = FluxFinal(cfg, dtype=dtype)
+        dbl = DoubleStreamBlock(cfg, dtype=dtype)
+        sgl = SingleStreamBlock(cfg, dtype=dtype)
+        vae = self.vae
+        fns = {
+            "head": jax.jit(lambda p, img, txt, t, pooled, g: head.apply(
+                {"params": p}, img, txt, t, pooled, guidance=g)),
+            "double": jax.jit(lambda p, img, txt, vec, cos, sin: dbl.apply(
+                {"params": p}, img, txt, vec, cos, sin)),
+            "single": jax.jit(lambda p, x, vec, cos, sin: sgl.apply(
+                {"params": p}, x, vec, cos, sin)),
+            "final": jax.jit(lambda p, x, vec: final.apply(
+                {"params": p}, x, vec)),
+            "euler": jax.jit(lambda img, v, ds: (
+                img.astype(jnp.float32) + ds * v.astype(jnp.float32))),
+            "decode": jax.jit(lambda p, lat: (
+                (vae.apply({"params": p}, lat, method=vae.decode)
+                 .astype(jnp.float32) + 1.0) * 127.5
+            ).clip(0.0, 255.0).round().astype(jnp.uint8)),
+        }
+        with self._jit_lock:
+            self._sfns = fns
+        return fns
+
+    def _run_streaming(self, lh, lw, batch, steps, txt_len, init_rng,
+                       context, pooled, guidance):
+        """Python-loop sampler: per step, page every transformer block
+        through the chip. `jax.device_put` is async, so issuing block
+        i+1's transfer BEFORE dispatching block i's compute overlaps PCIe
+        with the MXU — the same pipelining trick as the reference's
+        sequential offload, minus the per-job from_pretrained."""
+        cfg = self.config
+        fns = self._stream_fns()
+        shift = _sigma_shift((lh // 2) * (lw // 2), self.dynamic_shift)
+        scheduler = FlowMatchEulerScheduler(
+            SchedulerConfig(prediction_type="flow", shift=shift)
+        )
+        sigmas = np.asarray(scheduler.schedule(steps).sigmas, np.float32)
+
+        params = self.params
+        head_p = {k: params["flux"][k] for k in HEAD_KEYS
+                  if k in params["flux"]}
+        final_p = {k: params["flux"][k] for k in FINAL_KEYS
+                   if k in params["flux"]}
+
+        latents = jax.random.normal(
+            init_rng, (batch, lh, lw, self.latent_channels), jnp.float32
+        )
+        carry, img_ids = patchify(latents)
+        txt_ids = jnp.zeros((batch, txt_len, 3), jnp.int32)
+        ids = jnp.concatenate([txt_ids, img_ids], axis=1)
+        cos, sin = rope_frequencies(ids, cfg.axes_dims_rope, cfg.theta)
+        cos, sin = cos.astype(self.dtype), sin.astype(self.dtype)
+
+        for i in range(steps):
+            t = jnp.broadcast_to(jnp.float32(sigmas[i]), (batch,))
+            img, txt, vec = fns["head"](
+                head_p, carry.astype(self.dtype), context, t, pooled,
+                guidance,
+            )
+            nxt = jax.device_put(self._host_double[0]) \
+                if cfg.depth_double else None
+            for b in range(cfg.depth_double):
+                cur = nxt
+                if b + 1 < cfg.depth_double:
+                    nxt = jax.device_put(self._host_double[b + 1])
+                elif cfg.depth_single:
+                    nxt = jax.device_put(self._host_single[0])
+                img, txt = fns["double"](cur, img, txt, vec, cos, sin)
+            x = jnp.concatenate([txt, img], axis=1)
+            for b in range(cfg.depth_single):
+                cur = nxt
+                if b + 1 < cfg.depth_single:
+                    nxt = jax.device_put(self._host_single[b + 1])
+                x = fns["single"](cur, x, vec, cos, sin)
+            x = x[:, txt_len:]
+            velocity = fns["final"](final_p, x, vec)
+            carry = fns["euler"](
+                carry, velocity, jnp.float32(sigmas[i + 1] - sigmas[i])
+            )
+
+        latents = unpatchify(carry, lh, lw).astype(self.dtype)
+        return fns["decode"](params["vae"], latents)
+
     # --- public job API ---
 
     def run(self, prompt="", negative_prompt="", pipeline_type="FluxPipeline",
@@ -300,17 +461,27 @@ class FluxPipeline:
         context, pooled = place_b(context), place_b(pooled)
         guidance = jnp.full((n_images,), guidance_scale, jnp.float32)
 
-        key = (lh, lw, n_images, steps, int(t5_ids.shape[1]))
-        t0 = time.perf_counter()
-        program = self._program(key)
-        timings["trace_s"] = round(time.perf_counter() - t0, 3)
-
         rng, init_rng = jax.random.split(rng)
-        t0 = time.perf_counter()
-        pixels = jax.block_until_ready(
-            program(params, init_rng, context, pooled, guidance)
-        )
-        timings["denoise_decode_s"] = round(time.perf_counter() - t0, 3)
+        if self.streaming:
+            t0 = time.perf_counter()
+            pixels = jax.block_until_ready(
+                self._run_streaming(
+                    lh, lw, n_images, steps, int(t5_ids.shape[1]),
+                    init_rng, context, pooled, guidance,
+                )
+            )
+            timings["denoise_decode_s"] = round(time.perf_counter() - t0, 3)
+        else:
+            key = (lh, lw, n_images, steps, int(t5_ids.shape[1]))
+            t0 = time.perf_counter()
+            program = self._program(key)
+            timings["trace_s"] = round(time.perf_counter() - t0, 3)
+
+            t0 = time.perf_counter()
+            pixels = jax.block_until_ready(
+                program(params, init_rng, context, pooled, guidance)
+            )
+            timings["denoise_decode_s"] = round(time.perf_counter() - t0, 3)
 
         from PIL import Image
 
@@ -325,6 +496,10 @@ class FluxPipeline:
             "guidance_scale": guidance_scale,
             "timings": timings,
         }
+        if self.streaming:
+            # visible in the envelope like the reference's offload mode:
+            # slower, but serving on hardware the resident model outgrows
+            pipeline_config["weight_streaming"] = True
         return images, pipeline_config
 
 

@@ -52,6 +52,10 @@ class Settings:
     safety_checker_model: str = "CompVis/stable-diffusion-safety-checker"
     # jax.profiler trace server port (0 = disabled)
     profiler_port: int = 0
+    # serve Flux on single-chip slices by paging transformer blocks from
+    # host RAM (the TPU analog of the reference's sequential CPU offload);
+    # False restores the round-4 behavior of refusing with flux_min_chips
+    flux_streaming: bool = True
 
     @classmethod
     def field_names(cls) -> tuple[str, ...]:
@@ -67,6 +71,7 @@ _ENV_OVERRIDES = {
     "SDAAS_TENSOR_PARALLELISM": "tensor_parallelism",
     "SDAAS_SEQUENCE_PARALLELISM": "sequence_parallelism",
     "SDAAS_RING_MIN_SEQ": "ring_min_seq",
+    "SDAAS_FLUX_STREAMING": "flux_streaming",
     "SDAAS_DTYPE": "dtype",
 }
 
@@ -104,7 +109,12 @@ def load_settings() -> Settings:
         value = os.getenv(env_key)
         if value is not None:
             field_type = type(getattr(settings, attr))
-            setattr(settings, attr, field_type(value))
+            if field_type is bool:
+                # bool("0") is True — parse the usual spellings instead
+                setattr(settings, attr,
+                        value.strip().lower() in ("1", "true", "yes", "on"))
+            else:
+                setattr(settings, attr, field_type(value))
 
     return settings
 

@@ -95,6 +95,10 @@ class AssetServer:
 
         png = _asset_image()
         video, video_ctype = _asset_video()
+        # aiohttp drains a BytesIO payload on the first request; serve the
+        # raw bytes so re-fetches don't get an empty 200
+        if hasattr(video, "getvalue"):
+            video = video.getvalue()
 
         async def image(_):
             return web.Response(body=png, content_type="image/png")
@@ -313,7 +317,9 @@ _TINY_OVERRIDES: dict[str, dict] = {
                 "num_frames": 4},
     "svd": {"height": 64, "width": 64, "num_inference_steps": 2,
             "num_frames": 4},
-    "vid2vid": {"num_inference_steps": 2},
+    # vid2vid's tiny hook reads the top-level key, not parameters
+    # (pipelines/video.py run_vid2vid)
+    "vid2vid": {"num_inference_steps": 2, "test_tiny_model": True},
     "audioldm": {"num_inference_steps": 2},
     "audioldm2": {"num_inference_steps": 2},
     "bark": {},
@@ -328,6 +334,12 @@ def _apply_tiny(name: str, job: dict) -> dict:
     params["test_tiny_model"] = True
     if name in ("audioldm", "audioldm2"):
         params["audio_length_in_s"] = 1.0
+    if "controlnet" in params:
+        # the tiny hook swaps only the main model; the controlnet
+        # sub-model needs its own tiny stand-in
+        cn = dict(params["controlnet"])
+        cn["controlnet_model_name"] = "test/tiny-controlnet"
+        params["controlnet"] = cn
     job["parameters"] = params
     return job
 

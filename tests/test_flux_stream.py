@@ -1,0 +1,102 @@
+"""Flux weight streaming (VERDICT r04 missing #2 / next-step #5): the TPU
+analog of the reference's sequential CPU offload
+(swarm/job_arguments.py:209-218 enable_sequential_cpu_offload) — the 12B
+transformer pages through the chip block-by-block from host RAM, so a
+single small chip serves Flux instead of refusing with flux_min_chips.
+
+The load-bearing proof: the streamed sampler (python loop + standalone
+FluxHead/Block/FluxFinal applies) produces the SAME images as the resident
+lax.scan program over the monolithic FluxTransformer — any divergence in
+the head/final re-implementations or block paging order shows up here.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+
+from chiaswarm_tpu.chips.requirements import (
+    FLUX_STREAM_RESIDENT_GB,
+    check_capacity,
+    flux_stream_fit,
+)
+from chiaswarm_tpu.pipelines.flux import FluxPipeline
+
+
+class FakeChipSet:
+    platform = "tpu"
+
+    def __init__(self, chips=1, hbm_gb_per_chip=16, tensor=1, seq=1):
+        self._chips = chips
+        self._hbm = hbm_gb_per_chip
+        self.tensor = tensor
+        self.seq = seq
+
+    def chip_count(self):
+        return self._chips
+
+    def hbm_bytes(self):
+        return self._chips * self._hbm << 30
+
+
+def _run(pipe, seed=7):
+    return np.asarray(
+        pipe.run(prompt="a marmot astronaut", height=64, width=64,
+                 num_inference_steps=3, rng=jax.random.key(seed))[0][0]
+    )
+
+
+@pytest.mark.parametrize("model", ["test/tiny-flux", "test/tiny-flux-schnell"])
+def test_streamed_matches_resident(model):
+    resident = FluxPipeline(model)
+    streamed = FluxPipeline(model, streaming=True)
+    assert streamed.streaming and not resident.streaming
+    a, b = _run(resident), _run(streamed)
+    assert a.shape == b.shape
+    # identical math modulo XLA fusion differences (scan+monolith vs
+    # per-block programs): allow 8-bit rounding slack
+    diff = np.abs(a.astype(np.int16) - b.astype(np.int16))
+    assert diff.max() <= 2, f"max pixel diff {diff.max()}"
+
+
+def test_streamed_envelope_flag():
+    pipe = FluxPipeline("test/tiny-flux", streaming=True)
+    _, config = pipe.run(prompt="x", height=64, width=64,
+                         num_inference_steps=2, rng=jax.random.key(0))
+    assert config["weight_streaming"] is True
+
+
+def test_streamed_release_frees_host_blocks():
+    pipe = FluxPipeline("test/tiny-flux", streaming=True)
+    assert pipe._host_double and pipe._host_single
+    pipe.release()
+    assert not pipe._host_double and not pipe._host_single
+
+
+def test_flux_stream_fit_single_small_chip():
+    # one 16 GB v5e chip: resident fit is 0 (31.4 GB params), streaming
+    # serves at least one 1024^2 image (12 GB resident tail + 2.5 GB act)
+    chip = FakeChipSet(chips=1, hbm_gb_per_chip=16)
+    assert flux_stream_fit(chip, 1, 1024) == 1
+    # admission gate routes through streaming instead of raising
+    assert check_capacity(chip, "black-forest-labs/FLUX.1-dev", 1, 1024) == 1
+
+
+def test_flux_stream_fit_limits():
+    # streaming v1 targets exactly the small-slice gap: multi-chip or
+    # TP slices use the resident sharded path instead
+    assert flux_stream_fit(FakeChipSet(chips=2), 1, 1024) == 0
+    assert flux_stream_fit(FakeChipSet(chips=1, tensor=2), 1, 1024) == 0
+    # a chip smaller than the resident tail cannot stream
+    tiny_chip = FakeChipSet(chips=1, hbm_gb_per_chip=8)
+    assert FLUX_STREAM_RESIDENT_GB > 8
+    assert flux_stream_fit(tiny_chip, 1, 1024) == 0
+
+
+def test_flux_streaming_setting_gates_admission(monkeypatch, sdaas_root):
+    chip = FakeChipSet(chips=1, hbm_gb_per_chip=16)
+    monkeypatch.setenv("SDAAS_FLUX_STREAMING", "0")
+    with pytest.raises(ValueError, match="tensor parallelism"):
+        check_capacity(chip, "black-forest-labs/FLUX.1-dev", 1, 1024)
+    monkeypatch.setenv("SDAAS_FLUX_STREAMING", "true")
+    assert check_capacity(chip, "black-forest-labs/FLUX.1-dev", 1, 1024) == 1

@@ -47,11 +47,17 @@ def test_oversized_batch_caps_not_fails():
     assert 1 <= allowed < 32
 
 
-def test_flux_needs_tensor_parallelism():
+def test_flux_needs_tensor_parallelism(monkeypatch, sdaas_root):
     # 31.4 GB of parameters (measured geometry, test_flux_tp.py) cannot
-    # sit on one 16 GB chip
+    # sit RESIDENT on one 16 GB chip; weight streaming admits the job
+    # anyway (test_flux_stream.py), so the refusal contract is now gated
+    # on the flux_streaming setting
+    assert check_capacity(
+        FakeChipSet(), "black-forest-labs/FLUX.1-dev", 1, 1024) == 1
+    monkeypatch.setenv("SDAAS_FLUX_STREAMING", "0")
     with pytest.raises(ValueError, match="tensor parallel"):
         check_capacity(FakeChipSet(), "black-forest-labs/FLUX.1-dev", 1, 1024)
+    monkeypatch.delenv("SDAAS_FLUX_STREAMING")
     assert min_chips("black-forest-labs/FLUX.1-dev", 16.0) >= 4
     # DATA-parallel chips do not help: the params replicate per chip
     with pytest.raises(ValueError, match="tensor parallel"):
