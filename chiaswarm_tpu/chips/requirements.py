@@ -147,12 +147,30 @@ def flux_stream_fit(chipset, batch: int, size: int,
 
 
 def streaming_enabled() -> bool:
+    # load_settings already degrades to defaults on a missing/corrupt
+    # file; anything it does raise (e.g. a malformed env override) must
+    # propagate — silently forcing streaming ON would override an
+    # operator's explicit flux_streaming: false
     from ..settings import load_settings
 
-    try:
-        return bool(load_settings().flux_streaming)
-    except Exception:
-        return True
+    return bool(load_settings().flux_streaming)
+
+
+def flux_admissible(chipset, batch: int, size: int,
+                    width: int | None = None,
+                    model_name: str = "black-forest-labs/FLUX.1-dev") -> int:
+    """The ONE flux admission rule (resident fit, else streaming fit) —
+    shared by check_capacity, the worker's flux_runnable advertisement,
+    and FluxPipeline's auto-streaming detection, so the hive's placement
+    decision, the job gate, and the pipeline's actual mode cannot drift.
+
+    Returns the admissible batch (0 = refuse)."""
+    resident = fit_batch(chipset, model_name, batch, size, width)
+    if resident:
+        return resident
+    if streaming_enabled():
+        return flux_stream_fit(chipset, batch, size, width)
+    return 0
 
 
 def fit_batch(chipset, model_name: str, batch: int, size: int,
@@ -194,10 +212,10 @@ def fit_batch(chipset, model_name: str, batch: int, size: int,
 def check_capacity(chipset, model_name: str, batch: int, size: int,
                    width: int | None = None) -> int:
     """-> allowed batch, or raise a fatal job error naming the fix."""
-    allowed = fit_batch(chipset, model_name, batch, size, width)
-    if allowed == 0 and _family_key(model_name) == "flux" \
-            and streaming_enabled():
-        allowed = flux_stream_fit(chipset, batch, size, width)
+    if _family_key(model_name) == "flux":
+        allowed = flux_admissible(chipset, batch, size, width, model_name)
+    else:
+        allowed = fit_batch(chipset, model_name, batch, size, width)
     if allowed == 0:
         hbm_gb = chipset.hbm_bytes() / (1 << 30)
         per_chip = hbm_gb / max(chipset.chip_count(), 1)

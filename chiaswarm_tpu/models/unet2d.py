@@ -19,6 +19,7 @@ import jax.numpy as jnp
 from .layers import (
     BasicTransformerBlock,
     Downsample2D,
+    FusedGroupNorm,
     ResnetBlock2D,
     TimestepEmbedding,
     Transformer2DModel,
@@ -244,8 +245,8 @@ class UNet2DConditionModel(nn.Module):
                 name=f"up_blocks_{b}",
             )(x, skips, temb, encoder_hidden_states)
 
-        x = nn.GroupNorm(32, epsilon=1e-5, dtype=self.dtype, name="conv_norm_out")(x)
-        x = nn.silu(x)
+        x = FusedGroupNorm(32, epsilon=1e-5, dtype=self.dtype, act="silu",
+                           name="conv_norm_out")(x)
         return nn.Conv(
             cfg.out_channels, (3, 3), padding=((1, 1), (1, 1)), dtype=self.dtype,
             name="conv_out",

@@ -13,7 +13,7 @@ import dataclasses
 import flax.linen as nn
 import jax.numpy as jnp
 
-from .layers import Downsample2D, ResnetBlock2D, Upsample2D
+from .layers import Downsample2D, FusedGroupNorm, ResnetBlock2D, Upsample2D
 from ..ops import dot_product_attention
 
 
@@ -39,7 +39,8 @@ class VAEAttention(nn.Module):
     def __call__(self, x):
         b, h, w, c = x.shape
         residual = x
-        hidden = nn.GroupNorm(32, epsilon=1e-6, dtype=self.dtype, name="group_norm")(x)
+        hidden = FusedGroupNorm(32, epsilon=1e-6, dtype=self.dtype,
+                                name="group_norm")(x)
         hidden = hidden.reshape(b, h * w, c)
         q = nn.Dense(c, dtype=self.dtype, name="to_q")(hidden)
         k = nn.Dense(c, dtype=self.dtype, name="to_k")(hidden)
@@ -80,8 +81,8 @@ class Encoder(nn.Module):
         x = VAEAttention(mid_ch, dtype=self.dtype, name="mid_block_attentions_0")(x)
         x = ResnetBlock2D(mid_ch, eps=1e-6, dtype=self.dtype, name="mid_block_resnets_1")(x)
 
-        x = nn.GroupNorm(32, epsilon=1e-6, dtype=self.dtype, name="conv_norm_out")(x)
-        x = nn.silu(x)
+        x = FusedGroupNorm(32, epsilon=1e-6, dtype=self.dtype, act="silu",
+                           name="conv_norm_out")(x)
         # moments: mean + logvar
         return nn.Conv(
             2 * cfg.latent_channels, (3, 3), padding=((1, 1), (1, 1)),
@@ -114,8 +115,8 @@ class Decoder(nn.Module):
                     out_ch, dtype=self.dtype, name=f"up_blocks_{b}_upsamplers_0"
                 )(x)
 
-        x = nn.GroupNorm(32, epsilon=1e-6, dtype=self.dtype, name="conv_norm_out")(x)
-        x = nn.silu(x)
+        x = FusedGroupNorm(32, epsilon=1e-6, dtype=self.dtype, act="silu",
+                           name="conv_norm_out")(x)
         return nn.Conv(
             cfg.in_channels, (3, 3), padding=((1, 1), (1, 1)), dtype=self.dtype,
             name="conv_out",

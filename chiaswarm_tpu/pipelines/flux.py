@@ -121,18 +121,17 @@ class FluxPipeline:
         if streaming is None:
             # auto: page transformer blocks from host RAM when the model
             # cannot sit resident on this slice (the TPU analog of the
-            # reference's enable_sequential_cpu_offload — VERDICT r04 #2)
-            from ..chips.requirements import (
-                fit_batch,
-                flux_stream_fit,
-                streaming_enabled,
-            )
+            # reference's enable_sequential_cpu_offload — VERDICT r04 #2).
+            # Same flux_admissible rule as the job gate and the worker's
+            # flux_runnable advertisement — stream exactly when admission
+            # came from the streaming arm (resident fit == 0).
+            from ..chips.requirements import fit_batch, flux_admissible
 
             streaming = (
                 chipset is not None
                 and fit_batch(chipset, model_name, 1, self.default_size) == 0
-                and streaming_enabled()
-                and bool(flux_stream_fit(chipset, 1, self.default_size))
+                and bool(flux_admissible(
+                    chipset, 1, self.default_size, model_name=model_name))
             )
         self.streaming = bool(streaming)
         self._host_double: list = []
@@ -388,26 +387,31 @@ class FluxPipeline:
         cos, sin = rope_frequencies(ids, cfg.axes_dims_rope, cfg.theta)
         cos, sin = cos.astype(self.dtype), sin.astype(self.dtype)
 
+        # page blocks onto THIS pipeline's slice, not the process default
+        # device — a 1-chip slice k>0 on a multi-chip host would otherwise
+        # compute against device 0 (or pay a silent extra hop per block)
+        target = replicated(self.mesh)
+        page = lambda tree: jax.device_put(tree, target)
+
         for i in range(steps):
             t = jnp.broadcast_to(jnp.float32(sigmas[i]), (batch,))
             img, txt, vec = fns["head"](
                 head_p, carry.astype(self.dtype), context, t, pooled,
                 guidance,
             )
-            nxt = jax.device_put(self._host_double[0]) \
-                if cfg.depth_double else None
+            nxt = page(self._host_double[0]) if cfg.depth_double else None
             for b in range(cfg.depth_double):
                 cur = nxt
                 if b + 1 < cfg.depth_double:
-                    nxt = jax.device_put(self._host_double[b + 1])
+                    nxt = page(self._host_double[b + 1])
                 elif cfg.depth_single:
-                    nxt = jax.device_put(self._host_single[0])
+                    nxt = page(self._host_single[0])
                 img, txt = fns["double"](cur, img, txt, vec, cos, sin)
             x = jnp.concatenate([txt, img], axis=1)
             for b in range(cfg.depth_single):
                 cur = nxt
                 if b + 1 < cfg.depth_single:
-                    nxt = jax.device_put(self._host_single[b + 1])
+                    nxt = page(self._host_single[b + 1])
                 x = fns["single"](cur, x, vec, cos, sin)
             x = x[:, txt_len:]
             velocity = fns["final"](final_p, x, vec)

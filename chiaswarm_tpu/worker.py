@@ -137,28 +137,21 @@ class Worker:
         with no real-weight conversion path are advertised as unconverted
         so a capability-aware hive stops scheduling jobs this worker can
         only fail (VERDICT r03 weak #7); legacy hives ignore the key."""
-        from .chips.requirements import (
-            fit_batch,
-            flux_stream_fit,
-            min_chips,
-            streaming_enabled,
-        )
+        from .chips.requirements import flux_admissible, min_chips
         from .weights import UNCONVERTED_FAMILY_KEYWORDS
 
         caps = dict(self.allocator.capabilities())
         caps["unconverted_families"] = ",".join(UNCONVERTED_FAMILY_KEYWORDS)
         # flux cannot fit one 16 GB chip resident (VERDICT r03 item 4), but
         # weight streaming serves it there anyway (VERDICT r04 missing #2).
-        # Advertise admission from the SAME gates that admit jobs —
-        # fit_batch/flux_stream_fit on an actual job slice (its chip count
-        # AND tensor degree), so placement matches check_capacity.
+        # flux_admissible IS the job gate (check_capacity routes flux
+        # through it), evaluated on an actual job slice, so the hive's
+        # placement decision matches admission exactly.
         flux = "black-forest-labs/FLUX.1-dev"
         job_slice = self.allocator.slices[0]
-        runnable = bool(fit_batch(job_slice, flux, 1, 1024)) or (
-            streaming_enabled()
-            and bool(flux_stream_fit(job_slice, 1, 1024))
-        )
-        caps["flux_runnable"] = int(runnable)
+        caps["flux_runnable"] = int(bool(
+            flux_admissible(job_slice, 1, 1024, model_name=flux)
+        ))
         if job_slice.platform == "tpu":
             per_chip = job_slice.hbm_bytes() / (1 << 30) / max(
                 job_slice.chip_count(), 1
