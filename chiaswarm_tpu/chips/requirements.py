@@ -158,19 +158,23 @@ def streaming_enabled() -> bool:
 
 def flux_admissible(chipset, batch: int, size: int,
                     width: int | None = None,
-                    model_name: str = "black-forest-labs/FLUX.1-dev") -> int:
+                    model_name: str = "black-forest-labs/FLUX.1-dev",
+                    ) -> tuple[int, str]:
     """The ONE flux admission rule (resident fit, else streaming fit) —
     shared by check_capacity, the worker's flux_runnable advertisement,
     and FluxPipeline's auto-streaming detection, so the hive's placement
     decision, the job gate, and the pipeline's actual mode cannot drift.
 
-    Returns the admissible batch (0 = refuse)."""
+    Returns (admissible batch, mode) where mode is "resident",
+    "streaming", or "refuse" (batch 0)."""
     resident = fit_batch(chipset, model_name, batch, size, width)
     if resident:
-        return resident
+        return resident, "resident"
     if streaming_enabled():
-        return flux_stream_fit(chipset, batch, size, width)
-    return 0
+        streamed = flux_stream_fit(chipset, batch, size, width)
+        if streamed:
+            return streamed, "streaming"
+    return 0, "refuse"
 
 
 def fit_batch(chipset, model_name: str, batch: int, size: int,
@@ -213,7 +217,7 @@ def check_capacity(chipset, model_name: str, batch: int, size: int,
                    width: int | None = None) -> int:
     """-> allowed batch, or raise a fatal job error naming the fix."""
     if _family_key(model_name) == "flux":
-        allowed = flux_admissible(chipset, batch, size, width, model_name)
+        allowed, _ = flux_admissible(chipset, batch, size, width, model_name)
     else:
         allowed = fit_batch(chipset, model_name, batch, size, width)
     if allowed == 0:

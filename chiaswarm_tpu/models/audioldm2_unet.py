@@ -27,6 +27,7 @@ import jax.numpy as jnp
 
 from .layers import (
     FeedForward,
+    FusedGroupNorm,
     ResnetBlock2D,
     TimestepEmbedding,
     timestep_embedding,
@@ -74,7 +75,7 @@ class MaskedTransformer2D(nn.Module):
     def __call__(self, x, context, context_mask=None):
         b, h, w, c = x.shape
         residual = x
-        hidden = nn.GroupNorm(
+        hidden = FusedGroupNorm(
             self.groups, epsilon=1e-6, dtype=self.dtype, name="norm"
         )(x)
         hidden = hidden.reshape(b, h * w, c)
@@ -211,9 +212,8 @@ class AudioLDM2UNet(nn.Module):
                     dtype=self.dtype, name=f"up_{bidx}_upsample",
                 )(x)
 
-        x = nn.GroupNorm(g, epsilon=1e-5, dtype=self.dtype,
-                         name="conv_norm_out")(x)
-        x = nn.silu(x)
+        x = FusedGroupNorm(g, epsilon=1e-5, dtype=self.dtype, act="silu",
+                           name="conv_norm_out")(x)
         return nn.Conv(
             cfg.out_channels, (3, 3), padding=((1, 1), (1, 1)),
             dtype=self.dtype, name="conv_out",

@@ -24,6 +24,8 @@ import flax.linen as nn
 import jax
 import jax.numpy as jnp
 
+from .layers import FusedGroupNorm
+
 
 @dataclasses.dataclass(frozen=True)
 class MoVQConfig:
@@ -81,8 +83,8 @@ class SpatialNorm(nn.Module):
         zq = jax.image.resize(
             zq.astype(self.dtype), (b, h, w, zq.shape[-1]), "nearest"
         )
-        norm = nn.GroupNorm(self.groups, epsilon=1e-6, dtype=self.dtype,
-                            name="norm_layer")(f)
+        norm = FusedGroupNorm(self.groups, epsilon=1e-6, dtype=self.dtype,
+                              name="norm_layer")(f)
         y = nn.Conv(self.channels, (1, 1), dtype=self.dtype, name="conv_y")(zq)
         bb = nn.Conv(self.channels, (1, 1), dtype=self.dtype, name="conv_b")(zq)
         return norm * y + bb
@@ -103,8 +105,8 @@ class VQResnet(nn.Module):
             if self.spatial:
                 return SpatialNorm(h.shape[-1], groups=self.groups,
                                    dtype=self.dtype, name=name)(h, zq)
-            return nn.GroupNorm(self.groups, epsilon=1e-6, dtype=self.dtype,
-                                name=name)(h)
+            return FusedGroupNorm(self.groups, epsilon=1e-6, dtype=self.dtype,
+                                  name=name)(h)
 
         h = nn.silu(norm("norm1", x))
         h = nn.Conv(self.out_channels, (3, 3), padding=((1, 1), (1, 1)),
@@ -133,8 +135,8 @@ class VQAttention(nn.Module):
             norm = SpatialNorm(c, groups=self.groups, dtype=self.dtype,
                                name="spatial_norm")(x, zq)
         else:
-            norm = nn.GroupNorm(self.groups, epsilon=1e-6, dtype=self.dtype,
-                                name="group_norm")(x)
+            norm = FusedGroupNorm(self.groups, epsilon=1e-6, dtype=self.dtype,
+                                  name="group_norm")(x)
         tokens = norm.reshape(b, h * w, c)
         q = nn.Dense(c, dtype=self.dtype, name="to_q")(tokens)
         k = nn.Dense(c, dtype=self.dtype, name="to_k")(tokens)
@@ -178,9 +180,8 @@ class MoVQEncoder(nn.Module):
                         name="mid_block_attentions_0")(x)
         x = VQResnet(ch, groups=g, dtype=self.dtype,
                      name="mid_block_resnets_1")(x)
-        x = nn.GroupNorm(g, epsilon=1e-6, dtype=self.dtype,
-                         name="conv_norm_out")(x)
-        x = nn.silu(x)
+        x = FusedGroupNorm(g, epsilon=1e-6, dtype=self.dtype, act="silu",
+                           name="conv_norm_out")(x)
         return nn.Conv(cfg.latent_channels, (3, 3), padding=((1, 1), (1, 1)),
                        dtype=self.dtype, name="conv_out")(x)
 

@@ -36,6 +36,7 @@ import jax.numpy as jnp
 from .layers import (
     BasicTransformerBlock,
     Downsample2D,
+    FusedGroupNorm,
     TimestepEmbedding,
     Upsample2D,
     timestep_embedding,
@@ -105,8 +106,8 @@ class TemporalResnetBlock(nn.Module):
     @nn.compact
     def __call__(self, x, temb=None):
         residual = x
-        h = nn.GroupNorm(32, epsilon=self.eps, dtype=self.dtype, name="norm1")(x)
-        h = nn.silu(h)
+        h = FusedGroupNorm(32, epsilon=self.eps, dtype=self.dtype,
+                           act="silu", name="norm1")(x)
         h = nn.Conv(
             self.out_channels,
             (3, 1, 1),
@@ -120,8 +121,8 @@ class TemporalResnetBlock(nn.Module):
                 self.out_channels, dtype=self.dtype, name="time_emb_proj"
             )(nn.silu(temb))
             h = h + proj[:, :, None, None, :]
-        h = nn.GroupNorm(32, epsilon=self.eps, dtype=self.dtype, name="norm2")(h)
-        h = nn.silu(h)
+        h = FusedGroupNorm(32, epsilon=self.eps, dtype=self.dtype,
+                           act="silu", name="norm2")(h)
         h = nn.Conv(
             self.out_channels,
             (3, 1, 1),
@@ -256,7 +257,8 @@ class TransformerSpatioTemporal(nn.Module):
         inner = self.num_heads * self.head_dim
         residual = x
 
-        hidden = nn.GroupNorm(32, epsilon=1e-6, dtype=self.dtype, name="norm")(x)
+        hidden = FusedGroupNorm(32, epsilon=1e-6, dtype=self.dtype,
+                                name="norm")(x)
         hidden = hidden.reshape(bf, hh * ww, c)
         hidden = nn.Dense(inner, dtype=self.dtype, name="proj_in")(hidden)
 
@@ -417,8 +419,8 @@ class UNetSpatioTemporalConditionModel(nn.Module):
                     out_ch, dtype=self.dtype, name=f"{prefix}_upsamplers_0"
                 )(x)
 
-        x = nn.GroupNorm(32, epsilon=1e-5, dtype=self.dtype, name="conv_norm_out")(x)
-        x = nn.silu(x)
+        x = FusedGroupNorm(32, epsilon=1e-5, dtype=self.dtype, act="silu",
+                           name="conv_norm_out")(x)
         x = nn.Conv(
             cfg.out_channels, (3, 3), padding=((1, 1), (1, 1)), dtype=self.dtype,
             name="conv_out",

@@ -21,7 +21,7 @@ import dataclasses
 import flax.linen as nn
 import jax.numpy as jnp
 
-from .layers import Upsample2D
+from .layers import FusedGroupNorm, Upsample2D
 from .svd_unet import SpatioTemporalResBlock
 from .vae import Encoder, VAEAttention, VAEConfig
 
@@ -88,8 +88,8 @@ class TemporalDecoder(nn.Module):
                     out_ch, dtype=self.dtype, name=f"up_blocks_{b}_upsamplers_0"
                 )(x)
 
-        x = nn.GroupNorm(32, epsilon=1e-6, dtype=self.dtype, name="conv_norm_out")(x)
-        x = nn.silu(x)
+        x = FusedGroupNorm(32, epsilon=1e-6, dtype=self.dtype, act="silu",
+                           name="conv_norm_out")(x)
         x = nn.Conv(
             cfg.in_channels, (3, 3), padding=((1, 1), (1, 1)), dtype=self.dtype,
             name="conv_out",

@@ -26,6 +26,7 @@ import jax.numpy as jnp
 from .layers import (
     BasicTransformerBlock,
     Downsample2D,
+    FusedGroupNorm,
     ResnetBlock2D,
     TimestepEmbedding,
     Transformer2DModel,
@@ -74,11 +75,10 @@ class TemporalConvLayer(nn.Module):
         hidden = x.reshape(b, num_frames, h, w, c)
         identity = hidden
         for i in range(1, 5):
-            hidden = nn.GroupNorm(
-                self.groups, epsilon=1e-5, dtype=self.dtype,
+            hidden = FusedGroupNorm(
+                self.groups, epsilon=1e-5, dtype=self.dtype, act="silu",
                 name=f"conv{i}_norm",
             )(hidden)
-            hidden = nn.silu(hidden)
             hidden = nn.Conv(
                 self.channels, (3, 1, 1),
                 padding=((1, 1), (0, 0), (0, 0)),
@@ -111,7 +111,7 @@ class TransformerTemporal(nn.Module):
         # attention_head_dim regardless of block width)
         inner = self.num_heads * self.head_dim
         residual = x
-        hidden = nn.GroupNorm(
+        hidden = FusedGroupNorm(
             self.groups, epsilon=1e-6, dtype=self.dtype, name="norm"
         )(x)
         hidden = hidden.reshape(b, num_frames, h * w, c)
@@ -229,9 +229,8 @@ def unet3d_backbone(cfg: UNet3DConfig, dtype, sample, temb, ctx,
                 out_ch, dtype=dtype, name=f"up_{bidx}_upsample"
             )(x)
 
-    x = nn.GroupNorm(g, epsilon=1e-5, dtype=dtype,
-                     name="conv_norm_out")(x)
-    x = nn.silu(x)
+    x = FusedGroupNorm(g, epsilon=1e-5, dtype=dtype, act="silu",
+                       name="conv_norm_out")(x)
     return nn.Conv(
         cfg.out_channels, (3, 3), padding=((1, 1), (1, 1)),
         dtype=dtype, name="conv_out",

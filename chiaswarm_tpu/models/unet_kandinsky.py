@@ -23,7 +23,7 @@ import dataclasses
 import flax.linen as nn
 import jax.numpy as jnp
 
-from .layers import TimestepEmbedding, timestep_embedding
+from .layers import FusedGroupNorm, TimestepEmbedding, timestep_embedding
 
 
 @dataclasses.dataclass(frozen=True)
@@ -132,8 +132,8 @@ class KResnetBlock(nn.Module):
     @nn.compact
     def __call__(self, x, temb):
         act = _act(self.act)
-        h = nn.GroupNorm(self.groups, epsilon=1e-5, dtype=self.dtype,
-                         name="norm1")(x)
+        h = FusedGroupNorm(self.groups, epsilon=1e-5, dtype=self.dtype,
+                           name="norm1")(x)
         h = act(h)
         if self.down:
             x = nn.avg_pool(x, (2, 2), strides=(2, 2))
@@ -149,8 +149,8 @@ class KResnetBlock(nn.Module):
         t = nn.Dense(2 * self.out_channels, dtype=self.dtype,
                      name="time_emb_proj")(act(temb))
         scale, shift = jnp.split(t[:, None, None, :], 2, axis=-1)
-        h = nn.GroupNorm(self.groups, epsilon=1e-5, dtype=self.dtype,
-                         name="norm2")(h)
+        h = FusedGroupNorm(self.groups, epsilon=1e-5, dtype=self.dtype,
+                           name="norm2")(h)
         h = h * (1.0 + scale) + shift
         h = act(h)
         h = nn.Conv(self.out_channels, (3, 3), padding=((1, 1), (1, 1)),
@@ -179,8 +179,8 @@ class KAttention(nn.Module):
         tokens = x.reshape(b, h * w, c)
         # torch GroupNorm over [B, C, S]: stats over (group channels, S) —
         # flax GroupNorm on [B, S, C] reduces identically
-        norm = nn.GroupNorm(self.groups, epsilon=1e-5, dtype=self.dtype,
-                            name="group_norm")(tokens)
+        norm = FusedGroupNorm(self.groups, epsilon=1e-5, dtype=self.dtype,
+                              name="group_norm")(tokens)
         inner = self.heads * self.head_dim
         q = nn.Dense(inner, dtype=self.dtype, name="to_q")(norm)
         k_self = nn.Dense(inner, dtype=self.dtype, name="to_k")(norm)
@@ -417,8 +417,8 @@ class K22UNet(nn.Module):
                 name=f"up_blocks_{b}",
             )(x, skips, temb, ctx)
 
-        x = nn.GroupNorm(cfg.norm_num_groups, epsilon=1e-5, dtype=self.dtype,
-                         name="conv_norm_out")(x)
-        x = nn.silu(x)
+        x = FusedGroupNorm(cfg.norm_num_groups, epsilon=1e-5,
+                           dtype=self.dtype, act="silu",
+                           name="conv_norm_out")(x)
         return nn.Conv(cfg.out_channels, (3, 3), padding=((1, 1), (1, 1)),
                        dtype=self.dtype, name="conv_out")(x)

@@ -27,7 +27,7 @@ import dataclasses
 import flax.linen as nn
 import jax.numpy as jnp
 
-from .layers import TimestepEmbedding, timestep_embedding
+from .layers import FusedGroupNorm, TimestepEmbedding, timestep_embedding
 
 
 @dataclasses.dataclass(frozen=True)
@@ -462,10 +462,10 @@ class Kandinsky3UNet(nn.Module):
                 name=f"up_blocks_{lvl}",
             )(x, temb, context, encoder_attention_mask)
 
-        x = nn.GroupNorm(
-            cfg.groups, epsilon=1e-5, dtype=self.dtype, name="conv_norm_out"
+        x = FusedGroupNorm(
+            cfg.groups, epsilon=1e-5, dtype=self.dtype, act="silu",
+            name="conv_norm_out",
         )(x)
-        x = nn.silu(x)
         return nn.Conv(
             cfg.in_channels, (3, 3), dtype=self.dtype, name="conv_out"
         )(x)

@@ -27,6 +27,7 @@ from .layers import (
     Attention,
     Downsample2D,
     FeedForward,
+    FusedGroupNorm,
     ResnetBlock2D,
     TimestepEmbedding,
     Transformer2DModel,
@@ -112,7 +113,8 @@ class TemporalTransformer(nn.Module):
             )
         b = bf // num_frames
         residual = x
-        hidden = nn.GroupNorm(32, epsilon=1e-6, dtype=self.dtype, name="norm")(x)
+        hidden = FusedGroupNorm(32, epsilon=1e-6, dtype=self.dtype,
+                                name="norm")(x)
         # [B, F, H, W, C] -> [B*H*W, F, C]
         hidden = hidden.reshape(b, num_frames, h, w, c)
         hidden = hidden.transpose(0, 2, 3, 1, 4).reshape(b * h * w, num_frames, c)
@@ -228,8 +230,8 @@ class VideoUNet(nn.Module):
             if not last:
                 x = Upsample2D(out_ch, dtype=self.dtype, name=f"up_{bidx}_upsample")(x)
 
-        x = nn.GroupNorm(32, epsilon=1e-5, dtype=self.dtype, name="conv_norm_out")(x)
-        x = nn.silu(x)
+        x = FusedGroupNorm(32, epsilon=1e-5, dtype=self.dtype, act="silu",
+                           name="conv_norm_out")(x)
         return nn.Conv(
             cfg.out_channels, (3, 3), padding=((1, 1), (1, 1)), dtype=self.dtype,
             name="conv_out",

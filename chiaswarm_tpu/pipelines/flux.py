@@ -124,15 +124,15 @@ class FluxPipeline:
             # reference's enable_sequential_cpu_offload — VERDICT r04 #2).
             # Same flux_admissible rule as the job gate and the worker's
             # flux_runnable advertisement — stream exactly when admission
-            # came from the streaming arm (resident fit == 0).
-            from ..chips.requirements import fit_batch, flux_admissible
+            # came from the streaming arm.
+            from ..chips.requirements import flux_admissible
 
-            streaming = (
-                chipset is not None
-                and fit_batch(chipset, model_name, 1, self.default_size) == 0
-                and bool(flux_admissible(
-                    chipset, 1, self.default_size, model_name=model_name))
-            )
+            if chipset is None:
+                streaming = False
+            else:
+                _, mode = flux_admissible(
+                    chipset, 1, self.default_size, model_name=model_name)
+                streaming = mode == "streaming"
         self.streaming = bool(streaming)
         self._host_double: list = []
         self._host_single: list = []

@@ -149,9 +149,8 @@ class Worker:
         # placement decision matches admission exactly.
         flux = "black-forest-labs/FLUX.1-dev"
         job_slice = self.allocator.slices[0]
-        caps["flux_runnable"] = int(bool(
-            flux_admissible(job_slice, 1, 1024, model_name=flux)
-        ))
+        allowed, _ = flux_admissible(job_slice, 1, 1024, model_name=flux)
+        caps["flux_runnable"] = int(bool(allowed))
         if job_slice.platform == "tpu":
             per_chip = job_slice.hbm_bytes() / (1 << 30) / max(
                 job_slice.chip_count(), 1

@@ -33,6 +33,8 @@ def _rand(shape, dtype, seed):
     ((2, 8, 8, 64), 32),
     ((1, 16, 16, 96), 32),   # cg=3: ragged-ish group width
     ((3, 5, 7, 64), 16),     # odd spatial dims
+    ((2, 64, 32), 16),       # 3D token tensors (KAttention [B,S,C])
+    ((1, 4, 8, 8, 32), 16),  # 5D video tensors ([B,F,H,W,C])
 ])
 def test_kernel_matches_flax_f32(shape, groups):
     x = _rand(shape, jnp.float32, 0)
