@@ -349,6 +349,33 @@ def run_row(name: str) -> None:
         # child is killed mid-probe
         print(json.dumps(out), flush=True)
         out.update(_warm_compile_probe(pipe, 1024, 30, batch))
+    elif name == "flux":
+        # streamed Flux-schnell on whatever slice this is: on one 16 GB
+        # chip the 12B transformer pages from host RAM (weight streaming),
+        # measuring the small-worker serving mode the reference covers
+        # with sequential CPU offload. Sweep-only row (not in the ladder).
+        from chiaswarm_tpu.pipelines.flux import FluxPipeline
+
+        pipe = FluxPipeline("black-forest-labs/FLUX.1-schnell",
+                            chipset=chipset, allow_random_init=True)
+        times = []
+        kwf = dict(prompt="bench", height=1024, width=1024,
+                   num_inference_steps=4, guidance_scale=0)
+        pipe.run(rng=jax.random.key(0), **kwf)  # compile + first page-through
+        for i in range(3):
+            t0 = time.perf_counter()
+            pipe.run(rng=jax.random.key(i + 1), **kwf)
+            times.append(time.perf_counter() - t0)
+        p50 = sorted(times)[1]
+        out = {
+            "metric": "flux_schnell_1024_4step_images_per_sec_per_chip",
+            "value": round(1.0 / p50 / n, 4),
+            "unit": "images/sec/chip",
+            "vs_baseline": 0.0,  # no reference/baseline row for flux
+            "p50_job_s": round(p50, 3), "chips": n, "backend": "tpu",
+            "steps": 4, "size": 1024,
+            "weight_streaming": pipe.streaming,
+        }
     elif name == "controlnet":
         from PIL import Image
 
