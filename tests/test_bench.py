@@ -53,3 +53,21 @@ def test_bench_cpu_fallback_produces_labeled_smoke_row():
     assert out.get("tiny_controlnet_smoke_img_per_sec_per_chip", 0) > 0, out
     assert out.get("tiny_sd_smoke_img_per_sec_per_chip", 0) > 0, out
     assert not any(k.startswith(("sd21_768", "sdxl_controlnet")) for k in out)
+
+
+@pytest.mark.parametrize("row", ["tiny", "sdxl", "flux"])
+def test_row_child_refuses_without_tpu(row):
+    """The ladder's row children must exit with a machine-readable error
+    (not hang or crash opaquely) when no TPU is present — the parent
+    ladder records exactly this JSON on a CPU-only misfire."""
+    env = dict(os.environ)
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    env["JAX_PLATFORMS"] = "cpu"
+    proc = subprocess.run(
+        [sys.executable, "bench.py", "--row", row],
+        cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        env=env, capture_output=True, text=True, timeout=300,
+    )
+    assert proc.returncode == 1, proc.stderr[-500:]
+    line = [ln for ln in proc.stdout.splitlines() if ln.startswith("{")][-1]
+    assert json.loads(line)["error"] == "no TPU device in row child"
