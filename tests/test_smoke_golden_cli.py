@@ -1,0 +1,66 @@
+"""Smoke + golden CLI harnesses (VERDICT r04 #3/#4): listing, tiny-mode
+serving through the real format_args path, artifact saving, and the
+golden record->check->mismatch cycle against a temp manifest."""
+
+import asyncio
+import json
+
+import pytest
+
+
+def test_smoke_list_prints_all_families(capsys):
+    from chiaswarm_tpu.smoke import amain
+
+    rc = asyncio.run(amain(["--list"]))
+    assert rc == 0
+    names = capsys.readouterr().out.split()
+    for family in ("txt2img", "sdxl", "bark", "img2vid", "vid2vid",
+                   "audioldm2", "kandinsky3", "flux", "stitch"):
+        assert family in names
+    assert len(names) >= 20
+
+
+def test_smoke_rejects_unknown_family():
+    from chiaswarm_tpu.smoke import amain
+
+    with pytest.raises(SystemExit):
+        asyncio.run(amain(["no-such-family"]))
+
+
+def test_smoke_tiny_echo_stitch_saves_artifacts(tmp_path, sdaas_root):
+    from chiaswarm_tpu.smoke import amain
+
+    out = tmp_path / "artifacts"
+    rc = asyncio.run(amain(["--tiny", "--out", str(out), "echo", "stitch"]))
+    assert rc == 0
+    saved = sorted(p.name for p in out.iterdir())
+    assert any(n.startswith("echo.") for n in saved), saved
+    assert any(n.startswith("stitch.") for n in saved), saved
+
+
+def test_golden_record_check_mismatch_cycle(tmp_path, monkeypatch,
+                                            sdaas_root):
+    from chiaswarm_tpu.golden import amain
+
+    manifest = tmp_path / "goldens" / "manifest.json"
+    monkeypatch.setenv("CHIASWARM_GOLDEN_MANIFEST", str(manifest))
+
+    # check before record -> NO RECORDED GOLDEN, nonzero
+    assert asyncio.run(amain(["--check", "--tiny", "img2txt"])) == 1
+
+    assert asyncio.run(amain(["--record", "--tiny", "img2txt"])) == 0
+    data = json.loads(manifest.read_text())
+    entry = data["tiers"]["tiny"]["img2txt"]
+    assert entry["expected_sha256"]
+    assert entry["job"]["seed"] == 31337
+    # asset URIs normalized: no ephemeral localhost port committed
+    assert "127.0.0.1" not in manifest.read_text()
+    assert entry["recorded_env"]["backend"] == "cpu"
+
+    # same machine, same seed -> deterministic pass
+    assert asyncio.run(amain(["--check", "--tiny", "img2txt"])) == 0
+
+    # corrupt the hash -> mismatch reported, nonzero
+    entry["expected_sha256"] = {"primary": "0" * 64}
+    manifest.write_text(json.dumps(data))
+    assert asyncio.run(amain(["--check", "--tiny", "img2txt"])) == 1
