@@ -132,7 +132,10 @@ def test_flux_params_gb_is_fact_based():
     )
 
 
-def test_one_chip_refuses_flux_naming_the_fix():
+def test_one_chip_refuses_flux_naming_the_fix(monkeypatch, sdaas_root):
+    """With weight streaming DISABLED (the round-4 contract), a 1-chip
+    slice still refuses flux naming the tensor-degree fix; with streaming
+    on (the default) the same slice is admitted — test_flux_stream.py."""
     from chiaswarm_tpu.chips.requirements import check_capacity, min_chips
 
     assert min_chips("black-forest-labs/FLUX.1-dev", 16.0) >= 2
@@ -148,6 +151,10 @@ def test_one_chip_refuses_flux_naming_the_fix():
         def chip_count(self):
             return 1
 
+    assert check_capacity(
+        FakeChip(), "black-forest-labs/FLUX.1-dev", 1, 1024) == 1
+
+    monkeypatch.setenv("SDAAS_FLUX_STREAMING", "0")
     with pytest.raises(ValueError) as e:
         check_capacity(FakeChip(), "black-forest-labs/FLUX.1-dev", 1, 1024)
     assert "tensor" in str(e.value)
