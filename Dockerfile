@@ -11,6 +11,8 @@ RUN apt-get update && apt-get install -y --no-install-recommends \
 WORKDIR /app
 COPY pyproject.toml README.md ./
 COPY chiaswarm_tpu ./chiaswarm_tpu
+# golden-image manifest (chiaswarm-tpu-golden --check against pinned hashes)
+COPY goldens ./goldens
 
 RUN pip install --no-cache-dir -e ".[media,download]" \
     && pip install --no-cache-dir "jax[tpu]" \
