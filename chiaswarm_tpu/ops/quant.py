@@ -1,0 +1,79 @@
+"""Per-channel int8 weight quantization for host-paged params.
+
+Built for Flux weight streaming (pipelines/flux.py): the streamed mode's
+bottleneck is PCIe — every denoise step pages the 12B transformer through
+the chip, ~24 GB in bf16. Storing the host-side block trees as int8 with
+per-output-channel f32 scales halves that traffic; dequantization happens
+ON CHIP inside the jitted block program, so the transfer stays int8 end
+to end. Symmetric per-channel quantization of matmul kernels is the
+standard inference scheme; biases, norms, and small tensors stay in the
+serving dtype. Opt-in via settings.flux_stream_int8 — the accuracy cost
+is bounded by tests/test_flux_stream.py's parity assertions.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+class QTensor(NamedTuple):
+    """int8 values + f32 per-output-channel scales (pytree-transparent:
+    device_put and jit see a (q, s) pair)."""
+
+    q: jax.Array
+    s: jax.Array
+
+
+# leaves smaller than this stay unquantized: scales/overhead would eat
+# the saving, and small tensors (biases, norms) are precision-sensitive.
+# Env-overridable so tests can force quantization onto tiny models (the
+# tiny Flux block kernels are all below the production threshold).
+_MIN_QUANT_ELEMS = 1 << 14
+
+
+def min_quant_elems() -> int:
+    import os
+
+    return int(os.environ.get("CHIASWARM_MIN_QUANT_ELEMS",
+                              _MIN_QUANT_ELEMS))
+
+
+def quantize_leaf(x, dtype):
+    """Matmul-kernel leaves -> QTensor; everything else -> dtype cast."""
+    arr = np.asarray(x)
+    if arr.ndim >= 2 and arr.size >= min_quant_elems():
+        a = arr.astype(np.float32)
+        # per-output-channel (last axis) symmetric scales
+        s = np.abs(a).max(axis=tuple(range(a.ndim - 1)), keepdims=True)
+        s = np.maximum(s / 127.0, 1e-12).astype(np.float32)
+        q = np.clip(np.round(a / s), -127, 127).astype(np.int8)
+        return QTensor(jnp.asarray(q), jnp.asarray(s))
+    return jnp.asarray(arr, dtype)
+
+
+def quantize_tree(tree, dtype):
+    return jax.tree_util.tree_map(lambda x: quantize_leaf(x, dtype), tree)
+
+
+def dequantize_tree(tree, dtype):
+    """QTensor leaves -> dense `dtype` arrays (runs on device, inside the
+    consuming jitted program)."""
+    return jax.tree_util.tree_map(
+        lambda x: (
+            (x.q.astype(jnp.float32) * x.s).astype(dtype)
+            if isinstance(x, QTensor) else x
+        ),
+        tree,
+        is_leaf=lambda x: isinstance(x, QTensor),
+    )
+
+
+def tree_bytes(tree) -> int:
+    return sum(
+        leaf.size * leaf.dtype.itemsize
+        for leaf in jax.tree_util.tree_leaves(tree)
+    )

@@ -136,6 +136,7 @@ class FluxPipeline:
         self.streaming = bool(streaming)
         self._host_double: list = []
         self._host_single: list = []
+        self._stream_int8 = False  # set for real in _place_streaming
 
         t0 = time.perf_counter()
         self.params = self._load_params(allow_random_init)
@@ -174,21 +175,30 @@ class FluxPipeline:
     def _place_streaming(self, params):
         """Resident tail (T5/CLIP/VAE + flux head/final) on the chip;
         transformer blocks stay in HOST RAM (serving-dtype jax CPU arrays,
-        halving the per-step PCIe traffic vs f32) and page through the
-        chip double-buffered during sampling."""
+        halving the per-step PCIe traffic vs f32 — or int8 with
+        per-channel scales when flux_stream_int8 is on, halving it again)
+        and page through the chip double-buffered during sampling."""
         cfg = self.config
         cpu = jax.local_devices(backend="cpu")[0]
         flux = params["flux"]
-        cast = lambda x: jnp.asarray(x, self.dtype)
+        self._stream_int8 = bool(load_settings().flux_stream_int8)
+        if self._stream_int8:
+            from ..ops.quant import quantize_tree
+
+            pack = lambda tree: quantize_tree(tree, self.dtype)
+        else:
+            pack = lambda tree: jax.tree_util.tree_map(
+                lambda x: jnp.asarray(x, self.dtype), tree)
         with jax.default_device(cpu):
             self._host_double = [
-                jax.tree_util.tree_map(cast, flux[f"double_blocks_{i}"])
+                pack(flux[f"double_blocks_{i}"])
                 for i in range(cfg.depth_double)
             ]
             self._host_single = [
-                jax.tree_util.tree_map(cast, flux[f"single_blocks_{i}"])
+                pack(flux[f"single_blocks_{i}"])
                 for i in range(cfg.depth_single)
             ]
+        cast = lambda x: jnp.asarray(x, self.dtype)
         resident = {
             "flux": {k: flux[k] for k in (*HEAD_KEYS, *FINAL_KEYS)
                      if k in flux},
@@ -337,13 +347,21 @@ class FluxPipeline:
         dbl = DoubleStreamBlock(cfg, dtype=dtype)
         sgl = SingleStreamBlock(cfg, dtype=dtype)
         vae = self.vae
+        if self._stream_int8:
+            # transfers stay int8 over PCIe; the dequant runs on-chip as
+            # part of the same jitted block program
+            from ..ops.quant import dequantize_tree
+
+            dq = lambda p: dequantize_tree(p, dtype)
+        else:
+            dq = lambda p: p
         fns = {
             "head": jax.jit(lambda p, img, txt, t, pooled, g: head.apply(
                 {"params": p}, img, txt, t, pooled, guidance=g)),
             "double": jax.jit(lambda p, img, txt, vec, cos, sin: dbl.apply(
-                {"params": p}, img, txt, vec, cos, sin)),
+                {"params": dq(p)}, img, txt, vec, cos, sin)),
             "single": jax.jit(lambda p, x, vec, cos, sin: sgl.apply(
-                {"params": p}, x, vec, cos, sin)),
+                {"params": dq(p)}, x, vec, cos, sin)),
             "final": jax.jit(lambda p, x, vec: final.apply(
                 {"params": p}, x, vec)),
             "euler": jax.jit(lambda img, v, ds: (
@@ -504,6 +522,8 @@ class FluxPipeline:
             # visible in the envelope like the reference's offload mode:
             # slower, but serving on hardware the resident model outgrows
             pipeline_config["weight_streaming"] = True
+            if self._stream_int8:
+                pipeline_config["stream_int8"] = True
         return images, pipeline_config
 
 

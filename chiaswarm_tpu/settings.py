@@ -56,6 +56,10 @@ class Settings:
     # host RAM (the TPU analog of the reference's sequential CPU offload);
     # False restores the round-4 behavior of refusing with flux_min_chips
     flux_streaming: bool = True
+    # store the paged transformer blocks as per-channel int8 (halves the
+    # per-step PCIe traffic — the streamed mode's bottleneck — at a small
+    # bounded accuracy cost; dequantization happens on-chip)
+    flux_stream_int8: bool = False
 
     @classmethod
     def field_names(cls) -> tuple[str, ...]:
@@ -72,6 +76,7 @@ _ENV_OVERRIDES = {
     "SDAAS_SEQUENCE_PARALLELISM": "sequence_parallelism",
     "SDAAS_RING_MIN_SEQ": "ring_min_seq",
     "SDAAS_FLUX_STREAMING": "flux_streaming",
+    "SDAAS_FLUX_STREAM_INT8": "flux_stream_int8",
     "SDAAS_DTYPE": "dtype",
 }
 

@@ -93,6 +93,72 @@ def test_flux_stream_fit_limits():
     assert flux_stream_fit(tiny_chip, 1, 1024) == 0
 
 
+def test_quantize_roundtrip_bounds():
+    from chiaswarm_tpu.ops.quant import (
+        QTensor,
+        dequantize_tree,
+        quantize_leaf,
+        quantize_tree,
+        tree_bytes,
+    )
+    import jax.numpy as jnp
+
+    rng = np.random.default_rng(0)
+    w = rng.standard_normal((256, 128)).astype(np.float32) * 0.07
+    q = quantize_leaf(w, jnp.float32)
+    assert isinstance(q, QTensor) and q.q.dtype == jnp.int8
+    back = np.asarray(dequantize_tree(q, jnp.float32))
+    # symmetric per-channel int8: error bounded by scale/2 per element
+    scales = np.asarray(q.s)
+    assert np.all(np.abs(back - w) <= scales / 2 + 1e-7)
+    # small tensors stay dense
+    small = quantize_leaf(np.ones((4, 4), np.float32), jnp.bfloat16)
+    assert not isinstance(small, QTensor)
+
+    tree = {"kernel": w, "bias": np.zeros((128,), np.float32)}
+    qt = quantize_tree(tree, jnp.bfloat16)
+    assert isinstance(qt["kernel"], QTensor)
+    # int8 + scales is about half the bf16 footprint
+    assert tree_bytes(qt) < 0.6 * tree_bytes(
+        jax.tree_util.tree_map(lambda x: jnp.asarray(x, jnp.bfloat16), tree))
+
+
+def test_streamed_int8_close_to_resident(monkeypatch, sdaas_root):
+    """flux_stream_int8: per-channel int8 paging must stay visually close
+    to the full-precision resident output (the parity BOUND VERDICT r04
+    asked of an int8 mode) and flag itself in the envelope."""
+    from chiaswarm_tpu.ops.quant import QTensor
+
+    monkeypatch.setenv("SDAAS_FLUX_STREAM_INT8", "1")
+    # tiny-model kernels sit below the production size gate; force
+    # quantization so this test actually exercises the int8 page +
+    # on-chip dequant path instead of comparing two dense runs
+    monkeypatch.setenv("CHIASWARM_MIN_QUANT_ELEMS", "1")
+    streamed = FluxPipeline("test/tiny-flux", streaming=True)
+    assert streamed._stream_int8
+    assert any(
+        isinstance(leaf, QTensor)
+        for blk in streamed._host_double
+        for leaf in jax.tree_util.tree_leaves(
+            blk, is_leaf=lambda x: isinstance(x, QTensor))
+    ), "no block leaf was quantized — the int8 path is not under test"
+    monkeypatch.delenv("SDAAS_FLUX_STREAM_INT8")
+    monkeypatch.delenv("CHIASWARM_MIN_QUANT_ELEMS")
+    resident = FluxPipeline("test/tiny-flux")
+
+    imgs, config = streamed.run(
+        prompt="a marmot astronaut", height=64, width=64,
+        num_inference_steps=3, rng=jax.random.key(7))
+    assert config["weight_streaming"] is True
+    assert config["stream_int8"] is True
+    a = np.asarray(imgs[0])
+    b = _run(resident)
+    diff = np.abs(a.astype(np.int16) - b.astype(np.int16))
+    # int8 weights perturb the trajectory; random tiny weights are the
+    # adversarial case, so the bound is loose but must stay visually close
+    assert diff.mean() <= 8.0, f"mean pixel diff {diff.mean():.2f}"
+
+
 def test_flux_streaming_setting_gates_admission(monkeypatch, sdaas_root):
     chip = FakeChipSet(chips=1, hbm_gb_per_chip=16)
     monkeypatch.setenv("SDAAS_FLUX_STREAMING", "0")
