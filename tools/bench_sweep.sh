@@ -1,0 +1,39 @@
+#!/bin/bash
+# Perf sweep legs, run serially after the main ladder banked its rows.
+# Each leg is a direct `--row sdxl` child (sole tenant), best-effort.
+cd /root/repo
+echo "[sweep] noflash $(date +%T)"
+CHIASWARM_DISABLE_FLASH=1 timeout 2700 \
+  python bench.py --row sdxl > /tmp/bench_noflash.json 2> /tmp/bench_noflash.err
+echo "[sweep] noflash rc=$?"
+for B in 2 8; do
+  echo "[sweep] batch$B $(date +%T)"
+  BENCH_BATCH=$B timeout 2700 \
+    python bench.py --row sdxl > /tmp/bench_b$B.json 2> /tmp/bench_b$B.err
+  echo "[sweep] batch$B rc=$?"
+done
+echo "[sweep] nofusedgn $(date +%T)"
+CHIASWARM_DISABLE_FUSED_GN=1 timeout 2700 \
+  python bench.py --row sdxl > /tmp/bench_nofusedgn.json 2> /tmp/bench_nofusedgn.err
+echo "[sweep] nofusedgn rc=$?"
+echo "[sweep] bigfusedgn $(date +%T)"
+CHIASWARM_FUSED_GN_MAX_BYTES=25165824 timeout 2700 \
+  python bench.py --row sdxl > /tmp/bench_bigfusedgn.json 2> /tmp/bench_bigfusedgn.err
+echo "[sweep] bigfusedgn rc=$?"
+for BQ in 256 1024; do
+  echo "[sweep] flashq$BQ $(date +%T)"
+  CHIASWARM_FLASH_BLOCK_Q=$BQ CHIASWARM_FLASH_BLOCK_K=$BQ timeout 2700 \
+    python bench.py --row sdxl > /tmp/bench_fq$BQ.json 2> /tmp/bench_fq$BQ.err
+  echo "[sweep] flashq$BQ rc=$?"
+done
+echo "[sweep] flux-streamed $(date +%T)"
+timeout 3600 python bench.py --row flux > /tmp/bench_flux.json 2> /tmp/bench_flux.err
+echo "[sweep] flux rc=$?"
+echo "[sweep] flux-streamed-int8 $(date +%T)"
+SDAAS_FLUX_STREAM_INT8=1 timeout 3600 \
+  python bench.py --row flux > /tmp/bench_flux_int8.json 2> /tmp/bench_flux_int8.err
+echo "[sweep] flux-int8 rc=$?"
+echo "[sweep] profiled $(date +%T)"
+BENCH_PROFILE_DIR=/tmp/bench_trace_r05 timeout 2700 \
+  python bench.py --row sdxl > /tmp/bench_profiled.json 2> /tmp/bench_profiled.err
+echo "[sweep] profiled rc=$?"

@@ -1,0 +1,25 @@
+#!/bin/bash
+# Round-5 watcher: poll the axon chip; the moment it answers, run the
+# LADDERED bench exclusively (banks rows to /tmp/bench_ladder_r05.json as
+# each completes), then any extra sweep legs from /tmp/bench_sweep.sh.
+cd /root/repo
+LOG=/tmp/bench_watch5.log
+for i in $(seq 1 300); do
+  echo "[watch] probe $i $(date +%T)" >> $LOG
+  if timeout 120 python -c "import jax; print(jax.devices())" >> $LOG 2>&1; then
+    echo "[watch] chip up at $(date +%T); starting laddered bench" >> $LOG
+    BENCH_TPU_PROBE_TIMEOUT=240 BENCH_TPU_PROBE_ATTEMPTS=1 BENCH_CONFIGS=full \
+      BENCH_LADDER_FILE=/tmp/bench_ladder_r05.json \
+      timeout 10800 python bench.py > /tmp/bench_r05.json 2> /tmp/bench_r05.err
+    echo "[watch] ladder rc=$? at $(date +%T)" >> $LOG
+    if [ -f /tmp/bench_sweep.sh ]; then
+      echo "[watch] running sweep at $(date +%T)" >> $LOG
+      bash /tmp/bench_sweep.sh >> $LOG 2>&1
+      echo "[watch] sweep rc=$? at $(date +%T)" >> $LOG
+    fi
+    exit 0
+  fi
+  sleep 120
+done
+echo "[watch] chip never recovered" >> $LOG
+exit 1
