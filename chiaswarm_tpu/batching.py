@@ -1,0 +1,262 @@
+"""Cross-job micro-batching: coalesce compatible hive jobs per slice.
+
+The round-5 worker maps one hive job to one chip slice (worker.py
+slice_worker), so a batch-1 SDXL job leaves most of a slice's MXU idle
+even while the queue holds more jobs for the *same resident model and
+shape bucket* — the under-utilization request-batching serving systems
+(SwiftDiffusion, arXiv:2407.02031) attack. This module is the batching
+layer between the poll loop and the slice workers:
+
+- `coalesce_key(job)` buckets a raw hive job by everything that must be
+  IDENTICAL for two jobs to share one jitted denoise+decode invocation:
+  (model, family, canvas, steps, scheduler, guidance mode). Jobs that
+  carry per-job structure the batched program can't express — start
+  images, masks, ControlNet, LoRA, chained stages — key to None and take
+  the existing single-job path unchanged.
+- `BatchScheduler` holds compatible jobs for a short linger window
+  (Settings.batch_linger_ms) so batchmates arriving in the same poll
+  burst coalesce, then releases the group to a slice worker as ONE work
+  item. Groups cap at Settings.max_coalesce jobs and at the slice's
+  capacity limit in images (rows_limit, wired to
+  chips/requirements.fit_batch by the worker), so a coalesced batch is
+  always admissible without rejection.
+
+Batching is an optimization, never a behavior change visible to the
+hive: every job keeps its own id, seed, prompt, nsfw flags, and result
+envelope; only latency (and `batched_with` in pipeline_config) tells a
+coalesced job from a solo one.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+from typing import Callable
+
+logger = logging.getLogger(__name__)
+
+# wire pipeline_type strings whose txt2img semantics the batched program
+# reproduces exactly (plain prompt-conditioned CFG denoise + decode)
+_BATCHABLE_PIPELINE_TYPES = {
+    None,
+    "DiffusionPipeline",
+    "StableDiffusionPipeline",
+    "StableDiffusionXLPipeline",
+    "AutoPipelineForText2Image",
+}
+
+# families with a run_batched entry (pipelines/stable_diffusion.py)
+_BATCHABLE_FAMILIES = {"sd", "sdxl"}
+
+# job-level keys that mean per-job structure the padded batch can't carry
+_UNBATCHABLE_JOB_KEYS = (
+    "start_image_uri",
+    "mask_image_uri",
+    "lora",
+    "refiner",
+    "upscale",
+    "strength",
+    "textual_inversion",
+    "vae",
+)
+
+# the only `parameters` keys a batchable job may carry; anything else
+# (controlnet, scheduler_args, aesthetic_score, ...) is per-job behavior
+# we refuse to guess at — the job falls through to the single path
+_SAFE_PARAMETER_KEYS = frozenset({
+    "test_tiny_model",
+    "pipeline_type",
+    "scheduler_type",
+    "num_inference_steps",
+    "guidance_scale",
+    "num_images_per_prompt",
+    "large_model",
+    "use_karras_sigmas",
+    "default_height",
+    "default_width",
+})
+
+DEFAULT_STEPS = 30
+DEFAULT_GUIDANCE = 7.5
+DEFAULT_SCHEDULER = "DPMSolverMultistepScheduler"
+
+
+def job_rows(job: dict) -> int:
+    """Images this job contributes to a coalesced batch."""
+    params = job.get("parameters") or {}
+    try:
+        n = int(params.get("num_images_per_prompt",
+                           job.get("num_images_per_prompt", 1)) or 1)
+    except (TypeError, ValueError):
+        return 1
+    return max(n, 1)
+
+
+def coalesce_key(job: dict) -> tuple | None:
+    """Compatibility bucket for one raw hive job; None = not batchable.
+
+    Two jobs with equal keys produce identical results whether they run
+    alone or coalesced: everything the jitted program closes over or
+    shares across the batch (model, canvas, step count, scheduler,
+    guidance scale) is in the key; everything per-row (prompt, negative,
+    seed, image count) rides outside it.
+    """
+    try:
+        if job.get("workflow") != "txt2img":
+            return None
+        model = job.get("model_name")
+        if not isinstance(model, str) or not model:
+            return None
+        if any(k in job for k in _UNBATCHABLE_JOB_KEYS):
+            return None
+        params = job.get("parameters") or {}
+        if not isinstance(params, dict):
+            return None
+        if not set(params) <= _SAFE_PARAMETER_KEYS:
+            return None
+        if params.get("pipeline_type") not in _BATCHABLE_PIPELINE_TYPES:
+            return None
+
+        from .registry import _auto_family
+
+        family = _auto_family(model)
+        if family not in _BATCHABLE_FAMILIES:
+            return None
+
+        # canvas: explicit dims, else the model-pinned default the
+        # formatter would apply; jobs relying on the family default share
+        # the None bucket (they all resolve to the same canvas)
+        height = job.get("height", params.get("default_height"))
+        width = job.get("width", params.get("default_width"))
+        if (height is None) != (width is None):
+            return None
+        if height is not None:
+            height, width = int(height), int(width)
+        steps = int(params.get("num_inference_steps",
+                               job.get("num_inference_steps", DEFAULT_STEPS)))
+        guidance = round(float(params.get(
+            "guidance_scale", job.get("guidance_scale", DEFAULT_GUIDANCE))), 4)
+        scheduler = str(params.get("scheduler_type", DEFAULT_SCHEDULER))
+        karras = bool(params.get("use_karras_sigmas", False))
+        tiny = bool(params.get("test_tiny_model", False))
+        # large_model flips the SD-vs-SDXL default pipeline class
+        large = bool(params.get("large_model", False))
+        return (model, family, height, width, steps, scheduler, guidance,
+                karras, tiny, large)
+    except (TypeError, ValueError):
+        # hive-controlled values that don't parse: let the single-job
+        # path produce its usual fatal envelope for them
+        return None
+
+
+class BatchScheduler:
+    """Linger-window grouping between the poll loop and slice workers.
+
+    put() admits raw hive jobs; get() yields work items as LISTS of jobs
+    — a singleton for unbatchable jobs (immediately), a coalesced group
+    for compatible ones (after the linger window, or sooner when the
+    group hits max_coalesce jobs or the slice's capacity in images).
+    task_done() mirrors asyncio.Queue so the worker's poll gating
+    (full()) keeps bounding in-flight work.
+    """
+
+    def __init__(self, linger_s: float = 0.05, max_coalesce: int = 8,
+                 maxsize: int = 0, ready_maxsize: int = 0,
+                 rows_limit: Callable[[dict], int | None] | None = None):
+        self.linger_s = max(float(linger_s), 0.0)
+        self.max_coalesce = int(max_coalesce)
+        self.maxsize = int(maxsize)
+        self.ready_maxsize = int(ready_maxsize)
+        self.rows_limit = rows_limit
+        self._ready: asyncio.Queue[list[dict]] = asyncio.Queue()
+        # key -> {"jobs": [...], "rows": int, "cap": int|None, "timer": handle}
+        self._pending: dict[tuple, dict] = {}
+        self._outstanding = 0
+        self._ready_jobs = 0  # jobs released to _ready, not yet fetched
+
+    # --- queue-compatible surface for the worker loop ---
+
+    def full(self) -> bool:
+        """Poll gating. Two bounds, so coalescing's extra headroom never
+        turns into hoarding of work other swarm members could take:
+        - ready_maxsize bounds jobs already RELEASED to slice workers
+          (the round-5 work-queue bound — unbatchable singletons land
+          here immediately, so mixed traffic backs polls off exactly as
+          before);
+        - maxsize bounds total in-flight jobs, giving only the jobs
+          LINGERING in open groups the extended coalescing allowance.
+        """
+        if self.ready_maxsize > 0 and self._ready_jobs >= self.ready_maxsize:
+            return True
+        return self.maxsize > 0 and self._outstanding >= self.maxsize
+
+    def task_done(self) -> None:
+        self._outstanding -= 1
+
+    @property
+    def pending_jobs(self) -> int:
+        """Jobs lingering in open groups (not yet released to a slice)."""
+        return sum(len(g["jobs"]) for g in self._pending.values())
+
+    async def get(self) -> list[dict]:
+        group = await self._ready.get()
+        self._ready_jobs -= len(group)
+        return group
+
+    def _release(self, jobs: list[dict]) -> None:
+        self._ready_jobs += len(jobs)
+        self._ready.put_nowait(jobs)
+
+    async def put(self, job: dict) -> None:
+        self._outstanding += 1
+        if self.max_coalesce <= 1 or self.linger_s <= 0:
+            self._release([job])
+            return
+        key = coalesce_key(job)
+        if key is None:
+            self._release([job])
+            return
+
+        rows = job_rows(job)
+        group = self._pending.get(key)
+        if group is not None and group["cap"] is not None \
+                and group["rows"] + rows > group["cap"]:
+            # this job would push the group past what the slice fits in
+            # one pass — release the full group now, start a fresh one
+            self._flush(key)
+            group = None
+        if group is None:
+            cap = None
+            if self.rows_limit is not None:
+                try:
+                    cap = self.rows_limit(job)
+                except Exception:  # capacity probe is advisory, never fatal
+                    logger.exception("rows_limit probe failed")
+            group = {"jobs": [], "rows": 0, "cap": cap}
+            group["timer"] = asyncio.get_running_loop().call_later(
+                self.linger_s, self._flush, key
+            )
+            self._pending[key] = group
+        group["jobs"].append(job)
+        group["rows"] += rows
+        if len(group["jobs"]) >= self.max_coalesce or (
+            group["cap"] is not None and group["rows"] >= group["cap"]
+        ):
+            self._flush(key)
+
+    def _flush(self, key: tuple) -> None:
+        group = self._pending.pop(key, None)
+        if group is None:  # timer fired after a size-triggered flush
+            return
+        group["timer"].cancel()
+        if len(group["jobs"]) > 1:
+            logger.info(
+                "coalesced %d jobs (%d images) for %s",
+                len(group["jobs"]), group["rows"], key[0],
+            )
+        self._release(group["jobs"])
+
+    def flush_all(self) -> None:
+        """Release every lingering group immediately (shutdown/tests)."""
+        for key in list(self._pending):
+            self._flush(key)

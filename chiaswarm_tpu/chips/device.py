@@ -147,3 +147,46 @@ class ChipSet:
             return artifacts, pipeline_config
         finally:
             self._mutex.release()
+
+    def run_batched(self, func, requests: list[dict]):
+        """Run a coalesced group of jobs on this slice under the busy lock.
+
+        The batch analog of __call__: draws (or honors) a seed PER JOB,
+        injects each job's own counter-based RNG plus this ChipSet, and
+        stamps each returned pipeline_config with its job's seed — so a
+        coalesced job's images depend only on its own seed, never on its
+        batchmates (the batched path's noise stream is its own, distinct
+        from the single-job path's draws for the same seed).
+
+        `func(identifier, requests)` must return one (artifacts,
+        pipeline_config) pair per request, in order.
+        """
+        if not self._mutex.acquire(blocking=False):
+            logger.error("ChipSet %s is busy but got invoked.", self.identifier())
+            raise Exception("busy")
+        try:
+            seeds = []
+            for kw in requests:
+                seed = kw.pop("seed", None)
+                if seed is None:
+                    seed = random.getrandbits(63)
+                seeds.append(seed)
+                kw["rng"] = jax.random.key(seed)
+                kw["chipset"] = self
+
+            started = time.perf_counter()
+            results = func(self.identifier(), requests)
+            if len(results) != len(requests):
+                raise RuntimeError(
+                    f"batched callback returned {len(results)} envelopes "
+                    f"for {len(requests)} jobs"
+                )
+            elapsed = round(time.perf_counter() - started, 3)
+            for (artifacts, pipeline_config), seed in zip(results, seeds):
+                pipeline_config["seed"] = seed
+                timings = pipeline_config.setdefault("timings", {})
+                # the pass was shared: job_s is the group's wall clock
+                timings["job_s"] = elapsed
+            return results
+        finally:
+            self._mutex.release()

@@ -213,6 +213,34 @@ def fit_batch(chipset, model_name: str, batch: int, size: int,
     return min(batch, int(free / per_image) * data)
 
 
+def coalesce_rows_limit(chipset, model_name: str, size: int,
+                        width: int | None = None,
+                        ceiling: int = 256) -> int:
+    """Most images one coalesced cross-job batch may hold on this slice.
+
+    The batching scheduler (batching.py) sizes its groups with this BEFORE
+    dispatch so a coalesced batch arrives already admissible — the batched
+    path caps groups, it never rejects one (each member job passed the
+    single-job gate on its own). Non-accelerator slices return the
+    ceiling: the host heap is not HBM.
+    """
+    allowed = fit_batch(chipset, model_name, ceiling, size, width)
+    # a 0 here means the MODEL doesn't fit — that's the single-job gate's
+    # fatal error to raise with its remediation text, not a grouping
+    # concern; never let the probe block grouping below one job
+    return max(allowed, 1)
+
+
+def coalesced_fit(chipset, model_name: str, total_rows: int, size: int,
+                  width: int | None = None) -> int:
+    """Admit a coalesced batch of total_rows images: returns the capped
+    row budget for ONE denoise pass (the executor splits the request list
+    into passes of at most this many rows). Raises only when even one
+    image cannot fit — the same fatal contract as check_capacity, which
+    each member job already cleared individually."""
+    return check_capacity(chipset, model_name, total_rows, size, width)
+
+
 def check_capacity(chipset, model_name: str, batch: int, size: int,
                    width: int | None = None) -> int:
     """-> allowed batch, or raise a fatal job error naming the fix."""

@@ -25,12 +25,19 @@ def _ring_min_seq() -> int:
     Settings-backed (`ring_min_seq` / SDAAS_RING_MIN_SEQ) so tests and the
     multichip dryrun exercise the production routing through configuration
     rather than monkey-patching (VERDICT r04 weak #3). Read at trace time
-    only — routing is a trace-time branch, so per-call file I/O is nil."""
+    only — routing is a trace-time branch, so per-call file I/O is nil.
+
+    load_settings errors propagate: a typo'd SDAAS_RING_MIN_SEQ must fail
+    loudly, not silently revert ring routing to the default — the same
+    propagate-on-error policy requirements.streaming_enabled documents
+    (ADVICE r05). Only an absent/non-numeric FIELD (hand-edited settings
+    file) takes the 2048 fallback."""
     from ..settings import load_settings
 
+    settings = load_settings()
     try:
-        return int(load_settings().ring_min_seq)
-    except Exception:
+        return int(settings.ring_min_seq)
+    except (AttributeError, TypeError, ValueError):
         return 2048
 
 _SEQ_SCOPE = threading.local()

@@ -95,8 +95,15 @@ def ring_shard_map(mesh: Mesh, scale: float | None = None,
     """
     from .mesh import DATA_AXIS
 
+    # jax moved shard_map out of experimental around 0.4.38; serve both
+    # (this container's 0.4.37 only has the experimental spelling)
+    try:
+        shard_map = jax.shard_map
+    except AttributeError:
+        from jax.experimental.shard_map import shard_map
+
     spec = P(DATA_AXIS if shard_batch else None, SEQ_AXIS, None, None)
-    return jax.shard_map(
+    return shard_map(
         lambda q, k, v: ring_attention(q, k, v, scale=scale),
         mesh=mesh,
         in_specs=(spec, spec, spec),

@@ -3,11 +3,62 @@
 The img2img start logic (strength clamp, scan start index, init-image
 VAE encode through a cached jitted program) is identical across the
 Kandinsky families — one implementation here so fixes land once.
+
+Also home to the cross-job micro-batching helpers (batching.py design):
+row-padding buckets so coalesce factors 3 and 4 share one compiled
+program, per-request splitting of a coalesced image batch, and capacity
+chunking that keeps every request's rows inside one denoise pass.
 """
 
 from __future__ import annotations
 
 import numpy as np
+
+
+def pad_bucket(rows: int) -> int:
+    """Next power-of-two row count >= rows.
+
+    The batched denoise program is compiled per total row count; padding
+    a coalesced batch up to the bucket boundary means factors 3 and 4
+    (say) share one executable instead of compiling each distinct
+    coalesce count the queue happens to produce.
+    """
+    p = 1
+    while p < rows:
+        p *= 2
+    return p
+
+
+def split_by_counts(items, counts: list[int]) -> list[list]:
+    """Slice a flat per-row list back into per-request groups.
+
+    The inverse of the row concatenation a coalesced batch performs;
+    trailing padding rows (len(items) > sum(counts)) are dropped.
+    """
+    out, offset = [], 0
+    for n in counts:
+        out.append(list(items[offset:offset + n]))
+        offset += n
+    return out
+
+
+def chunk_by_rows(counts: list[int], max_rows: int) -> list[tuple[int, int]]:
+    """Greedy [start, end) request ranges whose row sums fit max_rows.
+
+    Requests are atomic — one request's images never straddle two denoise
+    passes. A single request bigger than max_rows still gets its own
+    chunk (the pipeline's per-request capacity cap handles it), so every
+    request is always served.
+    """
+    chunks: list[tuple[int, int]] = []
+    start, rows = 0, 0
+    for i, n in enumerate(counts):
+        if i > start and rows + n > max_rows:
+            chunks.append((start, i))
+            start, rows = i, 0
+        rows += n
+    chunks.append((start, len(counts)))
+    return chunks
 
 
 def clamp_strength(value) -> float:

@@ -417,7 +417,15 @@ class FluxPipeline:
                 head_p, carry.astype(self.dtype), context, t, pooled,
                 guidance,
             )
-            nxt = page(self._host_double[0]) if cfg.depth_double else None
+            # seed the prefetch from the first NON-EMPTY block list: a
+            # config with depth_double == 0 must hand the first
+            # SingleStreamBlock a real param tree, not None (ADVICE r05)
+            if cfg.depth_double:
+                nxt = page(self._host_double[0])
+            elif cfg.depth_single:
+                nxt = page(self._host_single[0])
+            else:
+                nxt = None
             for b in range(cfg.depth_double):
                 cur = nxt
                 if b + 1 < cfg.depth_double:

@@ -401,6 +401,27 @@ class SDPipeline:
     def _dummy_added_cond(self, b):
         return dummy_added_cond(self.unet.config, b) if self.is_xl else None
 
+    def _xl_time_ids(self, pooled_dim: int, height: int, width: int,
+                     aesthetic_score: float = 6.0) -> list:
+        """SDXL micro-conditioning id vector for this canvas. ONE
+        implementation for the solo and batched paths — the 5-id refiner
+        layout carries the aesthetic score (SDXL paper appendix)."""
+        cfg = self.unet.config
+        n_ids = (cfg.addition_embed_dim - pooled_dim) // (
+            cfg.addition_time_embed_dim
+        )
+        if n_ids == 5:
+            return [height, width, 0, 0, float(aesthetic_score)]
+        return [height, width, 0, 0, height, width][:n_ids]
+
+    def _place_batch(self, x):
+        """Shard a leading-batch array over the mesh's data axis when the
+        batch divides it evenly; replicate otherwise (rank-preserving
+        placeholders, odd batches). Shared by solo and batched paths."""
+        if self.data_parts > 1 and x.shape[0] % self.data_parts == 0:
+            return jax.device_put(x, batch_sharding(self.mesh, x.ndim))
+        return jax.device_put(x, replicated(self.mesh))
+
     def release(self):
         """Drop device references so HBM frees on registry eviction."""
         self.params = None
@@ -734,9 +755,17 @@ class SDPipeline:
         def run(params, init_rng, context, added, guidance_scale, image_guidance,
                 image_latents, mask, rng, cn_params, control_cond, cn_scale):
             """context [cfg_rows*B,77,D] (uncond first); noise drawn in-program."""
-            latents = jax.random.normal(
-                init_rng, (batch, lh, lw, latent_c), jnp.float32
-            )
+            if mode == "batched":
+                # cross-job coalesced txt2img: init_rng is a [batch] key
+                # array, one per row, each derived only from its own job's
+                # seed — a job's images must not depend on its batchmates
+                latents = jax.vmap(
+                    lambda k: jax.random.normal(k, (lh, lw, latent_c), jnp.float32)
+                )(init_rng)
+            else:
+                latents = jax.random.normal(
+                    init_rng, (batch, lh, lw, latent_c), jnp.float32
+                )
             if mode == "img2img":
                 latents = scheduler.add_noise(
                     schedule, image_latents, latents, loop_start
@@ -828,9 +857,16 @@ class SDPipeline:
                     out_u, out_c = jnp.split(out, 2, axis=0)
                     out = out_u + guidance_scale * (out_c - out_u)
 
-                noise = jax.random.normal(
-                    jax.random.fold_in(rng, i), latents.shape, jnp.float32
-                )
+                if mode == "batched":
+                    # per-row ancestral noise from per-job keys (same
+                    # independence argument as the init draw)
+                    noise = jax.vmap(lambda k: jax.random.normal(
+                        jax.random.fold_in(k, i), (lh, lw, latent_c),
+                        jnp.float32))(rng)
+                else:
+                    noise = jax.random.normal(
+                        jax.random.fold_in(rng, i), latents.shape, jnp.float32
+                    )
                 state, latents = scheduler.step(
                     schedule, state, i, latents, out, noise
                 )
@@ -1041,17 +1077,10 @@ class SDPipeline:
 
         added = None
         if self.is_xl:
-            cfg_u = self.unet.config
-            pooled_dim = pooled_c.shape[-1]
-            n_ids = (cfg_u.addition_embed_dim - pooled_dim) // (
-                cfg_u.addition_time_embed_dim
+            ids = self._xl_time_ids(
+                pooled_c.shape[-1], height, width,
+                float(kwargs.pop("aesthetic_score", 6.0)),
             )
-            if n_ids == 5:
-                # refiner micro-conditioning: [orig_h, orig_w, crop, crop,
-                # aesthetic_score] (SDXL paper appendix)
-                ids = [height, width, 0, 0, float(kwargs.pop("aesthetic_score", 6.0))]
-            else:
-                ids = [height, width, 0, 0, height, width][:n_ids]
             time_ids = jnp.asarray([ids] * (cfg_rows * n_images), jnp.float32)
             pooled_rows = [pooled_u] * (cfg_rows - 1) + [pooled_c]
             added = {
@@ -1129,15 +1158,11 @@ class SDPipeline:
         # --- shard or replicate over the slice (per array: placeholders
         # with batch dim 1 stay replicated; the CFG-doubled 2N batch shards
         # evenly iff N does) ---
-        def place_b(x):
-            if self.data_parts > 1 and x.shape[0] % self.data_parts == 0:
-                return jax.device_put(x, batch_sharding(self.mesh, x.ndim))
-            return jax.device_put(x, replicated(self.mesh))
         context, image_latents, mask, control_cond = map(
-            place_b, (context, image_latents, mask, control_cond)
+            self._place_batch, (context, image_latents, mask, control_cond)
         )
         if added is not None:
-            added = {k: place_b(v) for k, v in added.items()}
+            added = {k: self._place_batch(v) for k, v in added.items()}
 
         # --- compile (cached) + execute ---
         sched_cfg = SchedulerConfig(
@@ -1288,6 +1313,157 @@ class SDPipeline:
             "timings": timings,
         }
         return images, pipeline_config
+
+    def run_batched(self, requests: list[dict], *, height=None, width=None,
+                    num_inference_steps: int = 30, guidance_scale: float = 7.5,
+                    scheduler_type: str = "DPMSolverMultistepScheduler",
+                    use_karras_sigmas: bool = False,
+                    pipeline_type: str = "DiffusionPipeline"):
+        """Coalesced txt2img: N independent requests, ONE padded jitted
+        denoise+decode invocation (batching.py design).
+
+        requests: [{"prompt", "negative_prompt", "rng", "num_images_per_prompt"}]
+        — everything that must match across the batch (model, canvas,
+        steps, scheduler, guidance) arrives as shared keyword arguments;
+        the caller (workflows/diffusion.diffusion_batched_callback) groups
+        by batching.coalesce_key so that invariant holds.
+
+        Returns [(images_j, pipeline_config_j)] aligned with requests.
+        Every row's noise derives only from its own request's rng (the
+        "batched" program variant draws per-row via vmapped keys), so a
+        request's images do not depend on who it was coalesced with. The
+        total row count pads up to a power-of-two bucket so coalesce
+        factors 3 and 4 share one compiled program; padding rows carry an
+        empty prompt and are discarded after decode.
+        """
+        from .common import pad_bucket, split_by_counts
+
+        base_params = self.params
+        if base_params is None:
+            raise Exception(
+                f"pipeline {self.model_name} was evicted; resubmit the job"
+            )
+        timings: dict[str, float] = {}
+        height = int(height or self.default_size)
+        width = int(width or height)
+        height, width = (max(64, (d // 64) * 64) for d in (height, width))
+        lh, lw = height // self.latent_factor, width // self.latent_factor
+        steps = int(num_inference_steps)
+        counts = [
+            max(int(r.get("num_images_per_prompt", 1) or 1), 1)
+            for r in requests
+        ]
+        total = sum(counts)
+        padded = pad_bucket(total)
+        pad_rows = padded - total
+
+        # --- conditioning: rows [uncond*padded | cond*padded]; padding
+        # rows are empty prompts whose outputs are discarded ---
+        t0 = time.perf_counter()
+        negs: list[str] = []
+        prompts: list[str] = []
+        for r, n in zip(requests, counts):
+            negs.extend([r.get("negative_prompt") or ""] * n)
+            prompts.extend([r.get("prompt") or ""] * n)
+        texts = negs + [""] * pad_rows + prompts + [""] * pad_rows
+        context, pooled = self.encode_prompts(texts, base_params)
+
+        added = None
+        if self.is_xl:
+            ids = self._xl_time_ids(pooled.shape[-1], height, width)
+            added = {
+                "text_embeds": pooled,  # already [uncond*padded | cond*padded]
+                "time_ids": jnp.asarray([ids] * (2 * padded), jnp.float32),
+            }
+        timings["text_encode_s"] = round(time.perf_counter() - t0, 3)
+
+        # --- per-row key pairs (init draw + ancestral step noise), each
+        # derived only from the owning request's rng ---
+        init_keys, step_keys = [], []
+        row_sources = [
+            (r.get("rng") if r.get("rng") is not None else jax.random.key(0), n)
+            for r, n in zip(requests, counts)
+        ] + [(jax.random.key(0x9AD), pad_rows)]
+        for base, n in row_sources:
+            for i in range(n):
+                k_init, k_step = jax.random.split(jax.random.fold_in(base, i))
+                init_keys.append(k_init)
+                step_keys.append(k_step)
+        init_rng = jnp.stack(init_keys)
+        step_rng = jnp.stack(step_keys)
+
+        # unused-mode placeholders, same rank trick as run()
+        latent_c = self.latent_channels
+        image_latents = jnp.zeros((1, 1, 1, latent_c), jnp.float32)
+        mask = jnp.zeros((1, 1, 1, 1), jnp.float32)
+        control_cond = jnp.zeros((1, 1, 1, 3), jnp.float32)
+
+        context, image_latents, mask, control_cond = map(
+            self._place_batch, (context, image_latents, mask, control_cond)
+        )
+        if added is not None:
+            added = {k: self._place_batch(v) for k, v in added.items()}
+
+        sched_cfg = SchedulerConfig(
+            prediction_type=self.prediction_type,
+            use_karras_sigmas=bool(use_karras_sigmas),
+        )
+        sched_key = (scheduler_type, tuple(sorted(dataclass_items(sched_cfg))))
+        key = ("batched", lh, lw, padded, steps, sched_key, 0, None)
+        t0 = time.perf_counter()
+        program = self._denoise_program(key)
+        timings["trace_s"] = round(time.perf_counter() - t0, 3)
+
+        t0 = time.perf_counter()
+        from ..ops.attention import sequence_parallel_scope
+
+        with sequence_parallel_scope(self.mesh):
+            pixels = program(
+                base_params,
+                init_rng,
+                context,
+                added,
+                jnp.float32(guidance_scale),
+                jnp.float32(0.0),
+                image_latents,
+                mask,
+                step_rng,
+                {},
+                control_cond,
+                jnp.float32(1.0),
+            )
+        pixels = jax.block_until_ready(pixels)
+        timings["denoise_decode_s"] = round(time.perf_counter() - t0, 3)
+
+        groups = split_by_counts(_to_pil(np.asarray(pixels)), counts)
+
+        from ..models.flops import denoise_flops
+
+        results = []
+        offset = 0
+        for r, n, images in zip(requests, counts, groups):
+            results.append((images, {
+                "model": self.model_name,
+                "pipeline": pipeline_type,
+                "scheduler": scheduler_type,
+                "controlnet": None,
+                "mode": "txt2img",
+                "steps": steps,
+                "size": [width, height],
+                "guidance_scale": guidance_scale,
+                "batched_with": len(requests),
+                "batch_rows": [offset, n],
+                "padded_rows": padded,
+                "unet_tflops": round(
+                    denoise_flops(self.unet.config, lh, lw, n, steps,
+                                  cfg_rows=2) / 1e12, 4,
+                ),
+                # shared pass timings, copied per envelope: the envelope
+                # must stand alone once the hive splits the batch apart
+                "timings": dict(timings),
+            }))
+            offset += n
+        return results
 
 
 def dataclass_items(cfg) -> list[tuple]:

@@ -60,6 +60,12 @@ class Settings:
     # per-step PCIe traffic — the streamed mode's bottleneck — at a small
     # bounded accuracy cost; dequantization happens on-chip)
     flux_stream_int8: bool = False
+    # cross-job micro-batching (batching.py): how long a compatible txt2img
+    # job waits for batchmates before its group dispatches to a slice. 0
+    # disables the linger (every job dispatches alone, round-5 behavior)
+    batch_linger_ms: float = 50.0
+    # most jobs one coalesced group may hold; <= 1 disables coalescing
+    max_coalesce: int = 8
 
     @classmethod
     def field_names(cls) -> tuple[str, ...]:
@@ -78,6 +84,8 @@ _ENV_OVERRIDES = {
     "SDAAS_FLUX_STREAMING": "flux_streaming",
     "SDAAS_FLUX_STREAM_INT8": "flux_stream_int8",
     "SDAAS_DTYPE": "dtype",
+    "SDAAS_BATCH_LINGER_MS": "batch_linger_ms",
+    "SDAAS_MAX_COALESCE": "max_coalesce",
 }
 
 

@@ -16,6 +16,12 @@ Differences by design:
   which advertised only the last device).
 - Jobs execute in a thread pool sized to the slice count, so one slice's
   denoise loop never blocks another slice's or the event loop.
+- Between the poll loop and the slice workers sits a BatchScheduler
+  (batching.py): compatible txt2img jobs for the same resident model and
+  shape bucket coalesce — after a short linger window — into ONE padded
+  denoise+decode pass per slice, each job keeping its own id, seed, and
+  result envelope. Anything the batched program can't express dispatches
+  solo, exactly as before.
 """
 
 from __future__ import annotations
@@ -25,6 +31,7 @@ import logging
 from concurrent.futures import ThreadPoolExecutor
 
 from . import __version__
+from .batching import BatchScheduler
 from .chips.allocator import SliceAllocator
 from .hive import HiveClient
 from .job_arguments import format_args
@@ -61,7 +68,19 @@ class Worker:
             sequence_parallelism=self.settings.sequence_parallelism,
         )
         self.hive = HiveClient(self.settings, self.hive_uri)
-        self.work_queue: asyncio.Queue = asyncio.Queue(maxsize=len(self.allocator))
+        coalesce = max(int(getattr(self.settings, "max_coalesce", 8)), 1)
+        self.batcher = BatchScheduler(
+            linger_s=float(getattr(self.settings, "batch_linger_ms", 50.0))
+            / 1000.0,
+            max_coalesce=coalesce,
+            # released (ready) work keeps the round-5 work-queue bound, so
+            # unbatchable traffic never hoards jobs other workers could
+            # take; only jobs lingering toward a coalesced pass get the
+            # extra in-flight allowance
+            maxsize=len(self.allocator) * coalesce,
+            ready_maxsize=len(self.allocator),
+            rows_limit=self._coalesce_rows_limit,
+        )
         self.result_queue: asyncio.Queue = asyncio.Queue()
         self._executor = ThreadPoolExecutor(
             max_workers=len(self.allocator), thread_name_prefix="chipslice"
@@ -165,12 +184,12 @@ class Worker:
     async def poll_loop(self) -> None:
         sleep_seconds = POLL_SECONDS
         while True:
-            if not self.work_queue.full() and self.allocator.has_free_slice():
+            if not self.batcher.full() and self.allocator.has_free_slice():
                 try:
                     jobs = await self.hive.ask_for_work(self._capabilities())
                     for job in jobs:
                         print(f"Got job {job['id']}")
-                        await self.work_queue.put(job)
+                        await self.batcher.put(job)
                     sleep_seconds = POLL_SECONDS
                 except asyncio.TimeoutError:
                     logger.warning("hive poll timeout")
@@ -182,23 +201,58 @@ class Worker:
 
     # --- consumers: one logical worker per chip slice ---
 
+    def _coalesce_rows_limit(self, job: dict) -> int | None:
+        """Advisory image budget for one coalesced group (BatchScheduler
+        rows_limit): the representative slice's capacity for this job's
+        model at its canvas, so groups arrive already admissible."""
+        from .chips.requirements import coalesce_rows_limit, default_canvas
+
+        model = job.get("model_name", "")
+        params = job.get("parameters") or {}
+        height = job.get("height", params.get("default_height"))
+        width = job.get("width", params.get("default_width"))
+        height = int(height or default_canvas(model))
+        width = int(width or height)
+        return coalesce_rows_limit(self.allocator.slices[0], model, height, width)
+
     async def slice_worker(self) -> None:
         while True:
-            job = await self.work_queue.get()
+            batch = await self.batcher.get()
             chipset = await self.allocator.acquire()
             try:
-                worker_function, kwargs = await self.get_args(
-                    job, chipset.identifier()
-                )
-                if worker_function is not None:
-                    result = await self.do_work(chipset, worker_function, kwargs)
-                    await self.result_queue.put(result)
+                prepared = []
+                for job in batch:
+                    worker_function, kwargs = await self.get_args(
+                        job, chipset.identifier()
+                    )
+                    if worker_function is not None:
+                        prepared.append((worker_function, kwargs))
+                if len(prepared) > 1 and self._batchable(prepared):
+                    results = await self.do_batched_work(chipset, prepared)
+                    for result in results:
+                        await self.result_queue.put(result)
+                else:
+                    for worker_function, kwargs in prepared:
+                        result = await self.do_work(
+                            chipset, worker_function, kwargs
+                        )
+                        await self.result_queue.put(result)
             except Exception as e:
                 logger.exception("slice_worker error")
                 print(f"slice_worker {e}")
             finally:
                 self.allocator.release(chipset)
-                self.work_queue.task_done()
+                for _ in batch:
+                    self.batcher.task_done()
+
+    @staticmethod
+    def _batchable(prepared: list) -> bool:
+        """A group executes as one pass only when every member formatted to
+        the plain diffusion callback — anything else (a mid-flight
+        fallback, a mixed group from a future scheduler) runs solo."""
+        from .workflows.diffusion import diffusion_callback
+
+        return all(fn is diffusion_callback for fn, _ in prepared)
 
     async def get_args(self, job: dict, device_identifier: str):
         try:
@@ -215,6 +269,50 @@ class Worker:
         return await loop.run_in_executor(
             self._executor, self.synchronous_do_work, chipset, worker_function, kwargs
         )
+
+    async def do_batched_work(self, chipset, prepared: list) -> list[dict]:
+        loop = asyncio.get_running_loop()
+        return await loop.run_in_executor(
+            self._executor, self.synchronous_do_batch, chipset, prepared
+        )
+
+    def synchronous_do_batch(self, chipset, prepared: list) -> list[dict]:
+        """One coalesced pass for a compatible group; on ANY failure, fall
+        back to the single-job path per member — which reproduces the
+        error with the existing fatal/transient attribution, so batching
+        never changes what the hive sees beyond latency."""
+        from .workflows.diffusion import diffusion_batched_callback
+
+        # pristine copies for the fallback: the batched path pops/injects
+        # keys (id, seed, rng, chipset) destructively
+        singles = [(fn, dict(kwargs)) for fn, kwargs in prepared]
+        requests = [kwargs for _, kwargs in prepared]
+        ids = [kwargs.pop("id") for kwargs in requests]
+        print(
+            f"Processing batch of {len(ids)} jobs {ids} "
+            f"on {chipset.descriptor()}"
+        )
+        try:
+            outs = chipset.run_batched(diffusion_batched_callback, requests)
+            return [
+                {
+                    "id": job_id,
+                    "artifacts": artifacts,
+                    "nsfw": pipeline_config.get("nsfw", False),
+                    "worker_version": __version__,
+                    "pipeline_config": pipeline_config,
+                }
+                for job_id, (artifacts, pipeline_config) in zip(ids, outs)
+            ]
+        except Exception as e:
+            logger.exception(
+                "coalesced pass for %s failed; retrying jobs individually", ids
+            )
+            print(f"batched pass failed ({e}); falling back to single jobs")
+            return [
+                self.synchronous_do_work(chipset, fn, kwargs)
+                for fn, kwargs in singles
+            ]
 
     def synchronous_do_work(self, chipset, worker_function, kwargs) -> dict:
         job_id = kwargs.pop("id")

@@ -13,6 +13,33 @@ from ..post_processors.output_processor import OutputProcessor
 from ..registry import get_pipeline
 
 
+def _tiny_stand_in(model_name: str) -> str:
+    """hermetic test hook (SURVEY §4): the tiny random-weight stand-in of
+    the requested architecture family (`test_tiny_model` job parameter)."""
+    from ..models.configs import model_family
+
+    name = model_name.lower()
+    if "pix2pix" in name or "ip2p" in name:
+        return "test/tiny-pix2pix"  # keep the 8-channel edit arch
+    if "flux" in name:
+        return "test/tiny-flux-schnell" if "schnell" in name else "test/tiny-flux"
+    if "kandinsky-3" in name or "kandinsky3" in name:
+        return "test/tiny-kandinsky3"
+    if "kandinsky" in name:
+        if "controlnet" in name:
+            return "test/tiny-kandinsky-controlnet"
+        if "prior" in name:
+            return "test/tiny-kandinsky-prior"
+        return "test/tiny-kandinsky"
+    if "cascade" in name:
+        return (
+            "test/tiny-cascade-prior" if "prior" in name else "test/tiny-cascade"
+        )
+    if "xl" in model_family(model_name):
+        return "test/tiny-xl"
+    return "test/tiny-sd"
+
+
 def diffusion_callback(device_identifier: str, model_name: str, **kwargs):
     content_type = kwargs.pop("content_type", "image/jpeg")
     outputs = kwargs.pop("outputs", ["primary"])
@@ -21,35 +48,7 @@ def diffusion_callback(device_identifier: str, model_name: str, **kwargs):
     degraded_preprocessors = kwargs.pop("degraded_preprocessors", None)
 
     if kwargs.pop("test_tiny_model", False):
-        # hermetic test hook (SURVEY §4): serve the job with the tiny
-        # random-weight stand-in of the requested architecture family
-        from ..models.configs import model_family
-
-        name = model_name.lower()
-        if "pix2pix" in name or "ip2p" in name:
-            model_name = "test/tiny-pix2pix"  # keep the 8-channel edit arch
-        elif "flux" in name:
-            model_name = (
-                "test/tiny-flux-schnell" if "schnell" in name else "test/tiny-flux"
-            )
-        elif "kandinsky-3" in name or "kandinsky3" in name:
-            model_name = "test/tiny-kandinsky3"
-        elif "kandinsky" in name:
-            if "controlnet" in name:
-                model_name = "test/tiny-kandinsky-controlnet"
-            elif "prior" in name:
-                model_name = "test/tiny-kandinsky-prior"
-            else:
-                model_name = "test/tiny-kandinsky"
-        elif "cascade" in name:
-            model_name = (
-                "test/tiny-cascade-prior" if "prior" in name
-                else "test/tiny-cascade"
-            )
-        elif "xl" in model_family(model_name):
-            model_name = "test/tiny-xl"
-        else:
-            model_name = "test/tiny-sd"
+        model_name = _tiny_stand_in(model_name)
 
     pipeline_type = kwargs.pop("pipeline_type", "DiffusionPipeline")
 
@@ -103,6 +102,105 @@ def diffusion_callback(device_identifier: str, model_name: str, **kwargs):
     processor = OutputProcessor(outputs, content_type)
     processor.add_outputs(images)
     return processor.get_results(), pipeline_config
+
+
+def diffusion_batched_callback(device_identifier: str, requests: list[dict]):
+    """Cross-job coalesced txt2img (batching.py design): every request in
+    `requests` shares one coalesce key — same model, canvas, steps,
+    scheduler, guidance — and differs only per-row (prompt, negative,
+    seed, image count). Executes the group in as few padded jitted
+    denoise+decode passes as capacity allows (usually one) and returns
+    per-request (artifacts, pipeline_config) envelopes in order.
+
+    Raising here (capacity, weights) is fine: the worker falls back to
+    the single-job path, which reproduces the error per job with the
+    existing fatal/transient attribution.
+    """
+    from ..chips.requirements import coalesced_fit, default_canvas
+    from ..pipelines.common import chunk_by_rows
+    from ..pipelines.safety import flag_images
+
+    shared = requests[0]
+    model_name = shared["model_name"]
+    if shared.get("test_tiny_model", False):
+        model_name = _tiny_stand_in(model_name)
+    pipeline_type = shared.get("pipeline_type", "DiffusionPipeline")
+    chipset = shared.get("chipset")
+    # None flows through to run_batched, which defaults to the pipeline's
+    # own default_size — the same resolution the single path's run() does;
+    # the family-table canvas below is only the capacity gate's estimate
+    height = shared.get("height")
+    width = shared.get("width")
+    est_h = int(height or default_canvas(model_name))
+    est_w = int(width or est_h)
+    steps = int(shared.get("num_inference_steps", 30))
+    guidance = float(shared.get("guidance_scale", 7.5))
+    scheduler_type = shared.get("scheduler_type", "DPMSolverMultistepScheduler")
+    karras = bool(shared.get("use_karras_sigmas", False))
+
+    # per-request envelope parameters + the run_batched row spec
+    envelopes = []
+    row_specs = []
+    counts = []
+    for r in requests:
+        envelopes.append({
+            "content_type": r.get("content_type", "image/jpeg"),
+            "outputs": r.get("outputs", ["primary"]),
+        })
+        n = max(int(r.get("num_images_per_prompt", 1) or 1), 1)
+        counts.append(n)
+        row_specs.append({
+            "prompt": r.get("prompt", ""),
+            "negative_prompt": r.get("negative_prompt", ""),
+            "rng": r.get("rng"),
+            "num_images_per_prompt": n,
+        })
+
+    # capacity admits the COALESCED batch, capping rather than rejecting:
+    # a group bigger than one pass splits into passes that fit (the
+    # batching scheduler already sized groups with coalesce_rows_limit,
+    # so more than one chunk means the estimate moved under us)
+    max_rows = sum(counts)
+    if chipset is not None:
+        max_rows = coalesced_fit(chipset, model_name, max_rows, est_h, est_w)
+    # per-request cap, mirroring the single path's check_capacity clamp:
+    # a request bigger than one pass serves the batch that fits, recorded
+    # in its envelope, never silently
+    capped: dict[int, dict] = {}
+    for i, n in enumerate(counts):
+        if n > max_rows:
+            capped[i] = {"requested": n, "served": max_rows}
+            counts[i] = max_rows
+            row_specs[i]["num_images_per_prompt"] = max_rows
+
+    pipeline = get_pipeline(
+        model_name, pipeline_type=pipeline_type, chipset=chipset
+    )
+    results = []
+    for start, end in chunk_by_rows(counts, max_rows):
+        results.extend(pipeline.run_batched(
+            row_specs[start:end],
+            height=height,
+            width=width,
+            num_inference_steps=steps,
+            guidance_scale=guidance,
+            scheduler_type=scheduler_type,
+            use_karras_sigmas=karras,
+            pipeline_type=pipeline_type,
+        ))
+
+    out = []
+    for i, ((images, pipeline_config), env) in enumerate(zip(results, envelopes)):
+        nsfw, checked = flag_images(images)
+        pipeline_config["nsfw"] = nsfw
+        pipeline_config["nsfw_checked"] = checked
+        pipeline_config["batched_with"] = len(requests)
+        if i in capped:
+            pipeline_config["batch_capped"] = capped[i]
+        processor = OutputProcessor(env["outputs"], env["content_type"])
+        processor.add_outputs(images)
+        out.append((processor.get_results(), pipeline_config))
+    return out
 
 
 def deepfloyd_if_callback(device_identifier: str, model_name: str, **kwargs):

@@ -15,12 +15,37 @@ if "xla_force_host_platform_device_count" not in _flags:
         _flags + " --xla_force_host_platform_device_count=8"
     ).strip()
 
+# Load torch's native runtime BEFORE jax's, and pin it to one thread.
+# The torch-parity modules import torch lazily mid-suite; on this
+# jax/torch build the first parity test — landing after 20+ jax tests
+# have warmed XLA's thread pools — segfaults the whole process in native
+# code (reproduced on the pristine seed tree, so it predates any repo
+# code; the classic OpenMP/oneDNN runtime clash). The parity models are
+# tiny, so a single-threaded torch costs nothing.
+os.environ.setdefault("MKL_THREADING_LAYER", "GNU")
+try:
+    import torch
+
+    torch.set_num_threads(1)
+    torch.set_num_interop_threads(1)
+except ImportError:
+    pass
+
 # Some environments import jax at interpreter startup (sitecustomize), which
 # freezes config before the env vars above can act — force via jax.config too.
 import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
-jax.config.update("jax_num_cpu_devices", 8)
+try:
+    jax.config.update("jax_num_cpu_devices", 8)
+except AttributeError:
+    # pre-0.4.38 jax has no such option; the XLA_FLAGS path above already
+    # provides the 8 virtual devices unless jax was imported before us —
+    # in which case fail loudly rather than run the mesh tests on 1 device
+    assert len(jax.devices()) == 8, (
+        "jax predates jax_num_cpu_devices and was imported before conftest "
+        "could set XLA_FLAGS; the 8-virtual-device test mesh is unavailable"
+    )
 
 import pytest  # noqa: E402
 
@@ -31,6 +56,7 @@ import pytest  # noqa: E402
 # is marked `heavy`. CI runs the whole suite either way.
 _QUICK_MODULES = {
     "test_allocator",
+    "test_batching",
     "test_external_resources",
     "test_flash_attention",
     "test_job_arguments",
@@ -53,6 +79,26 @@ def pytest_configure(config):
         "markers", "heavy: full-model / e2e tests excluded from -m quick")
 
 
+import functools  # noqa: E402
+import re  # noqa: E402
+
+
+_TORCH_USE = re.compile(
+    r"^\s*(import torch|from torch)|importorskip\([\"']torch"
+    r"|torch_unet_ref|torch_svd_ref|torch_cascade_ref",
+    re.M,
+)
+
+
+@functools.lru_cache(maxsize=None)
+def _module_uses_torch(path: str) -> bool:
+    try:
+        with open(path, encoding="utf-8") as f:
+            return _TORCH_USE.search(f.read()) is not None
+    except OSError:
+        return False
+
+
 def pytest_collection_modifyitems(config, items):
     for item in items:
         name = item.module.__name__.rsplit(".", 1)[-1]
@@ -60,6 +106,19 @@ def pytest_collection_modifyitems(config, items):
             pytest.mark.quick if name in _QUICK_MODULES
             else pytest.mark.heavy
         )
+    # Run every torch-executing module LAST. On this jax/torch build a
+    # torch/transformers forward segfaults the whole process once enough
+    # other native work has accumulated (reproduced on the pristine seed
+    # tree: the suite died at test #22, the first CLAP parity forward;
+    # neither import order, nor single-threaded torch, nor running the
+    # torch modules first dodges it, and each crashing combination passes
+    # in isolation). Sorting the torch-parity/conversion modules to the
+    # end lets the ~430 jax-only tests bank their results before the
+    # first at-risk forward; the torch modules themselves all pass when
+    # run standalone. Stable sort: alphabetical order is preserved within
+    # each group, and every test still runs exactly once.
+    items.sort(key=lambda item: 1 if _module_uses_torch(str(item.fspath))
+               else 0)
 
 
 @pytest.fixture()

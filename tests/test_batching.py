@@ -1,0 +1,206 @@
+"""Unit tests for the cross-job micro-batching layer (batching.py):
+compatibility keying, linger-window grouping, size/capacity caps, and the
+queue-compatible accounting the worker's poll gating relies on."""
+
+import asyncio
+
+import pytest
+
+from chiaswarm_tpu.batching import BatchScheduler, coalesce_key, job_rows
+
+TINY_JOB = {
+    "id": "job-1",
+    "workflow": "txt2img",
+    "model_name": "stabilityai/stable-diffusion-2-1",
+    "prompt": "a red cube",
+    "height": 64,
+    "width": 64,
+    "num_inference_steps": 2,
+    "parameters": {"test_tiny_model": True},
+}
+
+
+def job(**overrides) -> dict:
+    j = {k: (dict(v) if isinstance(v, dict) else v) for k, v in TINY_JOB.items()}
+    params = overrides.pop("parameters", None)
+    if params is not None:
+        j["parameters"].update(params)
+    j.update(overrides)
+    return j
+
+
+# --- coalesce_key ---
+
+
+def test_compatible_jobs_share_a_key():
+    a = coalesce_key(job())
+    b = coalesce_key(job(id="job-2", prompt="a blue sphere", seed=7,
+                         num_images_per_prompt=3))
+    assert a is not None
+    assert a == b
+
+
+def test_per_row_fields_stay_out_of_the_key():
+    # prompt/negative/seed/image-count are per-row payload, not bucket
+    base = coalesce_key(job())
+    assert coalesce_key(job(negative_prompt="blurry")) == base
+    assert coalesce_key(job(seed=123456)) == base
+
+
+@pytest.mark.parametrize("variant", [
+    {"workflow": "img2img"},
+    {"workflow": "echo"},
+    {"start_image_uri": "http://x/i.png"},
+    {"mask_image_uri": "http://x/m.png"},
+    {"lora": "some-lora"},
+    {"refiner": {"model_name": "x"}},
+    {"upscale": True},
+    {"parameters": {"controlnet": {"preprocessor": "canny"}}},
+    {"parameters": {"pipeline_type": "StableDiffusionImg2ImgPipeline"}},
+    # unknown passthrough parameters are per-job behavior we refuse to
+    # guess at: single path
+    {"parameters": {"aesthetic_score": 9.0}},
+    {"model_name": "black-forest-labs/FLUX.1-dev"},  # no run_batched family
+    {"model_name": ""},
+])
+def test_unbatchable_jobs_key_to_none(variant):
+    assert coalesce_key(job(**variant)) is None
+
+
+@pytest.mark.parametrize("variant", [
+    {"num_inference_steps": 8},
+    {"height": 128, "width": 128},
+    {"parameters": {"scheduler_type": "EulerDiscreteScheduler"}},
+    {"parameters": {"guidance_scale": 1.0}},
+    {"parameters": {"test_tiny_model": False}},
+    {"model_name": "stabilityai/stable-diffusion-xl-base-1.0"},
+])
+def test_shape_and_guidance_changes_split_the_bucket(variant):
+    assert coalesce_key(job(**variant)) != coalesce_key(job())
+    assert coalesce_key(job(**variant)) is not None
+
+
+def test_malformed_values_fall_back_to_single_path():
+    assert coalesce_key(job(height="tall", width="wide")) is None
+    assert coalesce_key(job(parameters={"guidance_scale": "lots"})) is None
+
+
+def test_job_rows():
+    assert job_rows(job()) == 1
+    assert job_rows(job(num_images_per_prompt=3)) == 3
+    assert job_rows(job(parameters={"num_images_per_prompt": 2})) == 2
+    assert job_rows(job(num_images_per_prompt="many")) == 1
+
+
+# --- BatchScheduler ---
+
+
+def run(coro):
+    return asyncio.run(coro)
+
+
+def test_linger_coalesces_compatible_jobs():
+    async def scenario():
+        b = BatchScheduler(linger_s=0.02, max_coalesce=8)
+        for i in range(3):
+            await b.put(job(id=f"j{i}", prompt=str(i)))
+        group = await asyncio.wait_for(b.get(), 1.0)
+        return group
+
+    group = run(scenario())
+    assert [j["id"] for j in group] == ["j0", "j1", "j2"]
+
+
+def test_unbatchable_jobs_dispatch_immediately():
+    async def scenario():
+        b = BatchScheduler(linger_s=60.0, max_coalesce=8)  # linger = never
+        await b.put({"id": "e1", "workflow": "echo", "model_name": "none"})
+        return await asyncio.wait_for(b.get(), 1.0)
+
+    assert [j["id"] for j in run(scenario())] == ["e1"]
+
+
+def test_incompatible_groups_stay_separate():
+    async def scenario():
+        b = BatchScheduler(linger_s=0.02, max_coalesce=8)
+        await b.put(job(id="small"))
+        await b.put(job(id="big", height=128, width=128))
+        first = await asyncio.wait_for(b.get(), 1.0)
+        second = await asyncio.wait_for(b.get(), 1.0)
+        return first, second
+
+    first, second = run(scenario())
+    assert {j["id"] for j in first} | {j["id"] for j in second} == \
+        {"small", "big"}
+    assert len(first) == len(second) == 1
+
+
+def test_max_coalesce_releases_full_group_early():
+    async def scenario():
+        b = BatchScheduler(linger_s=60.0, max_coalesce=2)
+        for i in range(2):
+            await b.put(job(id=f"j{i}"))
+        # full group must release WITHOUT waiting out the 60 s linger
+        group = await asyncio.wait_for(b.get(), 1.0)
+        assert b.pending_jobs == 0
+        return group
+
+    assert [j["id"] for j in run(scenario())] == ["j0", "j1"]
+
+
+def test_capacity_cap_bounds_group_rows():
+    async def scenario():
+        b = BatchScheduler(linger_s=60.0, max_coalesce=8,
+                           rows_limit=lambda job: 4)
+        await b.put(job(id="three", num_images_per_prompt=3))
+        # 3 + 2 > 4: the open group must release before admitting this one
+        await b.put(job(id="two", num_images_per_prompt=2))
+        first = await asyncio.wait_for(b.get(), 1.0)
+        # 2 + 2 >= 4 releases the second group at capacity
+        await b.put(job(id="two-more", num_images_per_prompt=2))
+        second = await asyncio.wait_for(b.get(), 1.0)
+        return first, second
+
+    first, second = run(scenario())
+    assert [j["id"] for j in first] == ["three"]
+    assert [j["id"] for j in second] == ["two", "two-more"]
+
+
+def test_coalescing_disabled_by_knobs():
+    async def scenario(**kw):
+        b = BatchScheduler(**kw)
+        await b.put(job(id="a"))
+        await b.put(job(id="b"))
+        return await asyncio.wait_for(b.get(), 1.0), \
+            await asyncio.wait_for(b.get(), 1.0)
+
+    for kw in ({"linger_s": 0.0}, {"max_coalesce": 1}):
+        first, second = run(scenario(**kw))
+        assert len(first) == len(second) == 1
+
+
+def test_outstanding_accounting_backs_poll_gating():
+    async def scenario():
+        b = BatchScheduler(linger_s=0.01, max_coalesce=8, maxsize=2)
+        await b.put(job(id="a"))
+        await b.put(job(id="b"))
+        assert b.full()
+        group = await asyncio.wait_for(b.get(), 1.0)
+        for _ in group:
+            b.task_done()
+        assert not b.full()
+        return group
+
+    assert len(run(scenario())) == 2
+
+
+def test_flush_all_releases_lingering_groups():
+    async def scenario():
+        b = BatchScheduler(linger_s=60.0, max_coalesce=8)
+        await b.put(job(id="a"))
+        assert b.pending_jobs == 1
+        b.flush_all()
+        assert b.pending_jobs == 0
+        return await asyncio.wait_for(b.get(), 1.0)
+
+    assert [j["id"] for j in run(scenario())] == ["a"]

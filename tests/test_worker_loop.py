@@ -231,6 +231,47 @@ def test_multiple_jobs_across_slices(sdaas_root):
     assert {r["id"] for r in results} == {f"job-{i}" for i in range(4)}
 
 
+def test_compatible_jobs_coalesce_into_one_batch(sdaas_root):
+    """Cross-job micro-batching end to end (batching.py): 4 compatible
+    tiny-model txt2img jobs arriving in one poll burst must execute as ONE
+    padded denoise+decode pass on one slice, yet come back as 4 distinct
+    result envelopes — correct ids, each job's own seed, no cross-job
+    image leakage."""
+    jobs = [
+        {
+            "id": f"job-b{i}",
+            "workflow": "txt2img",
+            "model_name": "stabilityai/stable-diffusion-2-1",
+            "prompt": f"a photograph of test subject number {i}",
+            "seed": 1000 + i,
+            "height": 64,
+            "width": 64,
+            "num_inference_steps": 2,
+            "parameters": {"test_tiny_model": True},
+        }
+        for i in range(4)
+    ]
+    # one slice spanning all chips: the whole group lands in one pass
+    hive, results = run_jobs(jobs, sdaas_root, chips_per_job=8)
+    assert {r["id"] for r in results} == {f"job-b{i}" for i in range(4)}
+
+    by_id = {r["id"]: r for r in results}
+    blobs = []
+    for i in range(4):
+        r = by_id[f"job-b{i}"]
+        cfg = r["pipeline_config"]
+        assert not r.get("fatal_error"), cfg
+        # executed as one coalesced pass of all 4 jobs...
+        assert cfg["batched_with"] == 4, cfg
+        # ...but each envelope keeps ITS job's seed (independent noise)
+        assert cfg["seed"] == 1000 + i
+        blob = r["artifacts"]["primary"]["blob"]
+        assert base64.b64decode(blob).startswith(b"\xff\xd8")  # jpeg
+        blobs.append(blob)
+    # no cross-job leakage: distinct seeds/prompts -> distinct images
+    assert len(set(blobs)) == 4
+
+
 def test_degraded_preprocessor_flag_in_envelope(sdaas_root):
     """A ControlNet job conditioned through a classical-CV stand-in
     annotator (mlsd) must carry `degraded_preprocessors` in its result
