@@ -696,15 +696,19 @@ def _batched_rows(pipe, n_chips: int, size: int = 64, steps: int = 4) -> dict:
         try:
             pipe.run_batched(requests, **shared)  # compile
             times = []
+            last = None
             for _ in range(3):
                 t0 = time.perf_counter()
-                pipe.run_batched(requests, **shared)
+                last = pipe.run_batched(requests, **shared)
                 times.append(time.perf_counter() - t0)
             p50 = sorted(times)[1]
             rates[factor] = factor / p50 / n_chips
             out[f"batched_txt2img_x{factor}_img_per_sec_per_chip"] = round(
                 rates[factor], 4)
             out[f"batched_txt2img_x{factor}_p50_pass_s"] = round(p50, 3)
+            # shared-pass span timings (telemetry.Span), last timed run
+            out[f"batched_txt2img_x{factor}_stage_timings"] = dict(
+                last[0][1].get("timings", {}))
         except Exception as e:
             sys.stderr.write(
                 f"batched row x{factor} failed: {type(e).__name__}: {e}\n")
@@ -773,7 +777,7 @@ def run_config(pipe, size: int, steps: int, batch: int):
     # the p50 sample stays clean.
     profile_dir = os.environ.get("BENCH_PROFILE_DIR", "")
 
-    job_times, denoise_times = [], []
+    job_times, denoise_times, configs = [], [], []
     runs = 3
     config = {}
     for i in range(runs):
@@ -785,6 +789,7 @@ def run_config(pipe, size: int, steps: int, batch: int):
             _, config = pipe.run(rng=jax.random.key(i + 1), **kw)
         job_times.append(time.perf_counter() - t0)
         denoise_times.append(config["timings"]["denoise_decode_s"])
+        configs.append(config)
         sys.stderr.write(
             f"run {i}: {job_times[-1]:.2f}s job, "
             f"{denoise_times[-1]:.2f}s denoise+decode\n"
@@ -794,7 +799,11 @@ def run_config(pipe, size: int, steps: int, batch: int):
     mid = order[runs // 2]
     p50 = job_times[mid]
     extra = {"denoise_fraction": round(denoise_times[mid] / p50, 3),
-             "warmup_s": round(warmup_s, 1)}
+             "warmup_s": round(warmup_s, 1),
+             # per-stage breakdown of the MEDIAN run, sourced from the same
+             # telemetry spans that feed /metrics (text_encode/compile/
+             # denoise(+decode) keys from pipelines, decode from workflows)
+             "stage_timings": dict(configs[mid].get("timings", {}))}
     peak = peak_tflops(jax.devices()[0])
     if peak and config.get("unet_tflops"):
         # MFU over the denoise+decode program (UNet FLOPs only — VAE and

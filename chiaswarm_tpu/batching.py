@@ -21,19 +21,50 @@ layer between the poll loop and the slice workers:
   chips/requirements.fit_batch by the worker), so a coalesced batch is
   always admissible without rejection.
 
-Batching is an optimization, never a behavior change visible to the
-hive: every job keeps its own id, seed, prompt, nsfw flags, and result
-envelope; only latency (and `batched_with` in pipeline_config) tells a
-coalesced job from a solo one.
+Batching is an optimization, not a semantic change to what the hive
+gets back: every job keeps its own id, prompt, nsfw flags, and result
+envelope, and a coalesced job's images depend only on its OWN seed,
+never on its batchmates. One honest caveat: the batched program draws
+its per-row noise differently from the legacy single-job path, so a
+seed-pinned job renders a different (equally valid) image coalesced
+than solo — `batched_with` in pipeline_config records which path ran.
 """
 
 from __future__ import annotations
 
 import asyncio
 import logging
+import time
 from typing import Callable
 
+from . import telemetry
+
 logger = logging.getLogger(__name__)
+
+# why a work item left the scheduler: "solo" (unbatchable / coalescing
+# off), "linger" (timer expired), "size" (hit max_coalesce), "rows" (hit
+# the slice's image capacity), "priority" (interactive fast-path),
+# "shutdown" (flush_all)
+_FLUSHES = telemetry.counter(
+    "swarm_batch_flush_total",
+    "Work items released by the batch scheduler, by flush reason",
+    ("reason",),
+)
+_GROUP_JOBS = telemetry.histogram(
+    "swarm_batch_group_jobs",
+    "Jobs per released work item (coalesce factor; 1 = solo dispatch)",
+    buckets=(1, 2, 3, 4, 6, 8, 12, 16),
+)
+_GROUP_ROWS = telemetry.histogram(
+    "swarm_batch_group_rows",
+    "Images per released coalesced group",
+    buckets=(1, 2, 4, 8, 16, 32),
+)
+_LINGER_WAIT = telemetry.histogram(
+    "swarm_batch_linger_wait_seconds",
+    "Open time of a coalescing group from first job to flush",
+    buckets=(0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0),
+)
 
 # wire pipeline_type strings whose txt2img semantics the batched program
 # reproduces exactly (plain prompt-conditioned CFG denoise + decode)
@@ -79,6 +110,16 @@ _SAFE_PARAMETER_KEYS = frozenset({
 DEFAULT_STEPS = 30
 DEFAULT_GUIDANCE = 7.5
 DEFAULT_SCHEDULER = "DPMSolverMultistepScheduler"
+
+
+def is_interactive(job: dict) -> bool:
+    """Latency-sensitive marker (ROADMAP "priority-aware batching", minimal
+    slice): a job carrying `priority: "interactive"` (or the legacy
+    `sdaas_priority` spelling) must not sit in a linger window."""
+    return "interactive" in (
+        str(job.get("priority", "")).lower(),
+        str(job.get("sdaas_priority", "")).lower(),
+    )
 
 
 def job_rows(job: dict) -> int:
@@ -198,6 +239,16 @@ class BatchScheduler:
         """Jobs lingering in open groups (not yet released to a slice)."""
         return sum(len(g["jobs"]) for g in self._pending.values())
 
+    @property
+    def ready_jobs(self) -> int:
+        """Jobs released to slice workers but not yet fetched."""
+        return self._ready_jobs
+
+    @property
+    def outstanding_jobs(self) -> int:
+        """All in-flight jobs: lingering + ready + executing."""
+        return self._outstanding
+
     async def get(self) -> list[dict]:
         group = await self._ready.get()
         self._ready_jobs -= len(group)
@@ -210,11 +261,11 @@ class BatchScheduler:
     async def put(self, job: dict) -> None:
         self._outstanding += 1
         if self.max_coalesce <= 1 or self.linger_s <= 0:
-            self._release([job])
+            self._release_solo(job)
             return
         key = coalesce_key(job)
         if key is None:
-            self._release([job])
+            self._release_solo(job)
             return
 
         rows = job_rows(job)
@@ -223,7 +274,7 @@ class BatchScheduler:
                 and group["rows"] + rows > group["cap"]:
             # this job would push the group past what the slice fits in
             # one pass — release the full group now, start a fresh one
-            self._flush(key)
+            self._flush(key, reason="rows")
             group = None
         if group is None:
             cap = None
@@ -232,31 +283,45 @@ class BatchScheduler:
                     cap = self.rows_limit(job)
                 except Exception:  # capacity probe is advisory, never fatal
                     logger.exception("rows_limit probe failed")
-            group = {"jobs": [], "rows": 0, "cap": cap}
-            group["timer"] = asyncio.get_running_loop().call_later(
-                self.linger_s, self._flush, key
-            )
+            loop = asyncio.get_running_loop()
+            group = {"jobs": [], "rows": 0, "cap": cap,
+                     "opened": time.monotonic()}
+            group["timer"] = loop.call_later(self.linger_s, self._flush, key)
             self._pending[key] = group
         group["jobs"].append(job)
         group["rows"] += rows
-        if len(group["jobs"]) >= self.max_coalesce or (
-            group["cap"] is not None and group["rows"] >= group["cap"]
-        ):
-            self._flush(key)
+        if is_interactive(job):
+            # priority fast-path: an interactive job takes its whole group
+            # with it NOW — batchmates already lingering ride along (they
+            # only get faster), nobody waits on the timer
+            self._flush(key, reason="priority")
+        elif len(group["jobs"]) >= self.max_coalesce:
+            self._flush(key, reason="size")
+        elif group["cap"] is not None and group["rows"] >= group["cap"]:
+            self._flush(key, reason="rows")
 
-    def _flush(self, key: tuple) -> None:
+    def _release_solo(self, job: dict) -> None:
+        _FLUSHES.inc(reason="solo")
+        _GROUP_JOBS.observe(1)
+        self._release([job])
+
+    def _flush(self, key: tuple, reason: str = "linger") -> None:
         group = self._pending.pop(key, None)
         if group is None:  # timer fired after a size-triggered flush
             return
         group["timer"].cancel()
+        _FLUSHES.inc(reason=reason)
+        _GROUP_JOBS.observe(len(group["jobs"]))
+        _GROUP_ROWS.observe(group["rows"])
+        _LINGER_WAIT.observe(time.monotonic() - group["opened"])
         if len(group["jobs"]) > 1:
             logger.info(
-                "coalesced %d jobs (%d images) for %s",
-                len(group["jobs"]), group["rows"], key[0],
+                "coalesced %d jobs (%d images) for %s [%s]",
+                len(group["jobs"]), group["rows"], key[0], reason,
             )
         self._release(group["jobs"])
 
     def flush_all(self) -> None:
         """Release every lingering group immediately (shutdown/tests)."""
         for key in list(self._pending):
-            self._flush(key)
+            self._flush(key, reason="shutdown")

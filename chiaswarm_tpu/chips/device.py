@@ -18,7 +18,18 @@ import time
 import jax
 from jax.sharding import Mesh
 
+from .. import telemetry
+
 logger = logging.getLogger(__name__)
+
+# wall clock under a slice's busy lock, solo vs coalesced pass — with
+# swarm_job_stage_seconds (compile/denoise split stamped by the pipeline)
+# this separates "slice occupied" from "slice computing usefully"
+_EXECUTE_SECONDS = telemetry.histogram(
+    "swarm_slice_execute_seconds",
+    "Wall-clock seconds one job (or coalesced pass) held a chip slice",
+    ("kind",),
+)
 
 # Known HBM per chip (GiB) by device kind; fallback is queried or 16.
 _HBM_GB = {
@@ -77,6 +88,11 @@ class ChipSet:
     @property
     def platform(self) -> str:
         return self.devices[0].platform
+
+    @property
+    def busy(self) -> bool:
+        """A job currently holds this slice (healthz per-slice state)."""
+        return self._mutex.locked()
 
     def identifier(self) -> str:
         ids = ",".join(str(d.id) for d in self.devices)
@@ -139,10 +155,12 @@ class ChipSet:
 
             started = time.perf_counter()
             artifacts, pipeline_config = func(self.identifier(), model_name, **kwargs)
+            elapsed = time.perf_counter() - started
+            _EXECUTE_SECONDS.observe(elapsed, kind="solo")
             pipeline_config["seed"] = seed
             # per-job timing breadcrumb (reference has none; SURVEY §5 asks for it)
             pipeline_config.setdefault("timings", {})["job_s"] = round(
-                time.perf_counter() - started, 3
+                elapsed, 3
             )
             return artifacts, pipeline_config
         finally:
@@ -181,6 +199,8 @@ class ChipSet:
                     f"batched callback returned {len(results)} envelopes "
                     f"for {len(requests)} jobs"
                 )
+            _EXECUTE_SECONDS.observe(
+                time.perf_counter() - started, kind="batched")
             elapsed = round(time.perf_counter() - started, 3)
             for (artifacts, pipeline_config), seed in zip(results, seeds):
                 pipeline_config["seed"] = seed

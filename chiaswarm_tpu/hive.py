@@ -19,18 +19,39 @@ reference's call signatures for drop-in use.
 
 from __future__ import annotations
 
+import asyncio
 import json
 import logging
+import time
 from typing import Any
 
 import aiohttp
 
-from . import USER_AGENT, __version__
+from . import USER_AGENT, __version__, telemetry
 
 logger = logging.getLogger(__name__)
 
 ASK_TIMEOUT_S = 10
 SUBMIT_TIMEOUT_S = 90
+# one retry with a short backoff for transient submit failures — losing a
+# finished job's artifacts to a single 502 wastes a whole denoise pass
+SUBMIT_RETRY_BACKOFF_S = 0.5
+
+_REQUEST_SECONDS = telemetry.histogram(
+    "swarm_hive_request_seconds",
+    "Hive HTTP round-trip latency by endpoint (errors included)",
+    ("endpoint",),
+)
+_ERRORS = telemetry.counter(
+    "swarm_hive_errors_total",
+    "Hive HTTP requests that raised, by endpoint",
+    ("endpoint",),
+)
+_RETRIES = telemetry.counter(
+    "swarm_hive_retries_total",
+    "Hive requests retried after a transient failure, by endpoint",
+    ("endpoint",),
+)
 
 
 class HiveError(Exception):
@@ -73,42 +94,87 @@ class HiveClient:
         }
         session = await self._get_session()
         timeout = aiohttp.ClientTimeout(total=ASK_TIMEOUT_S)
-        async with session.get(
-            f"{self.hive_uri}/work",
-            params=params,
-            headers=self._headers(),
-            timeout=timeout,
-        ) as response:
-            if response.status == 200:
-                try:
+        t0 = time.perf_counter()
+        try:
+            async with session.get(
+                f"{self.hive_uri}/work",
+                params=params,
+                headers=self._headers(),
+                timeout=timeout,
+            ) as response:
+                if response.status == 200:
+                    try:
+                        payload = await response.json()
+                        return payload["jobs"]
+                    except Exception:
+                        logger.exception("malformed /work response")
+                        return []
+
+                if response.status == 400:
+                    # hive refuses this worker (reference swarm/hive.py:39-44)
                     payload = await response.json()
-                    return payload["jobs"]
-                except Exception:
-                    logger.exception("malformed /work response")
-                    return []
+                    message = payload.get("message", "bad worker")
+                    logger.warning("hive refused worker: %s", message)
 
-            if response.status == 400:
-                # hive refuses this worker (reference swarm/hive.py:39-44)
-                payload = await response.json()
-                message = payload.get("message", "bad worker")
-                logger.warning("hive refused worker: %s", message)
+                response.raise_for_status()
+                return []
+        except Exception:
+            _ERRORS.inc(endpoint="work")
+            raise
+        finally:
+            _REQUEST_SECONDS.observe(time.perf_counter() - t0, endpoint="work")
 
-            response.raise_for_status()
-            return []
-
-    async def submit_result(self, result: dict) -> dict:
+    async def _submit_once(self, result: dict) -> dict:
         session = await self._get_session()
         timeout = aiohttp.ClientTimeout(total=SUBMIT_TIMEOUT_S)
-        async with session.post(
-            f"{self.hive_uri}/results",
-            data=json.dumps(result),
-            headers=self._headers(),
-            timeout=timeout,
-        ) as response:
-            response.raise_for_status()
-            ack = await response.json()
-            logger.info("result ack: %s", ack)
-            return ack
+        t0 = time.perf_counter()
+        try:
+            async with session.post(
+                f"{self.hive_uri}/results",
+                data=json.dumps(result),
+                headers=self._headers(),
+                timeout=timeout,
+            ) as response:
+                response.raise_for_status()
+                ack = await response.json()
+                logger.info("result ack: %s", ack)
+                return ack
+        except Exception:
+            _ERRORS.inc(endpoint="results")
+            raise
+        finally:
+            _REQUEST_SECONDS.observe(
+                time.perf_counter() - t0, endpoint="results")
+
+    async def submit_result(self, result: dict) -> dict:
+        """POST one result envelope; a TRANSIENT failure (connection-level
+        aiohttp.ClientError or a 5xx status) gets exactly one retry after a
+        short backoff before surfacing as HiveError — the artifacts in
+        `result` cost a full denoise pass and a single hive hiccup must not
+        discard them. Non-transient client errors (4xx) surface
+        immediately; timeouts keep propagating as asyncio.TimeoutError (the
+        worker's result loop already has a policy for those)."""
+        last_exc: Exception | None = None
+        for attempt in (0, 1):
+            try:
+                return await self._submit_once(result)
+            except aiohttp.ClientResponseError as e:
+                transient = e.status >= 500
+                last_exc = e
+            except aiohttp.ClientError as e:
+                transient = True
+                last_exc = e
+            if not transient or attempt == 1:
+                break
+            _RETRIES.inc(endpoint="results")
+            logger.warning(
+                "transient submit failure for %s (%s); retrying once",
+                result.get("id"), last_exc,
+            )
+            await asyncio.sleep(SUBMIT_RETRY_BACKOFF_S)
+        raise HiveError(
+            f"submit_result failed for job {result.get('id')}: {last_exc}"
+        ) from last_exc
 
     async def get_models(self) -> list[dict]:
         """Fetch the hive's model catalog; cached to models.json on success.
@@ -128,15 +194,23 @@ class HiveClient:
         )
         session = await self._get_session()
         timeout = aiohttp.ClientTimeout(total=ASK_TIMEOUT_S)
-        async with session.get(
-            models_url,
-            headers={"user-agent": USER_AGENT},
-            timeout=timeout,
-        ) as response:
-            response.raise_for_status()
-            data = await response.json()
-            save_file(data, "models.json")
-            return data["language_models"] + data["models"]
+        t0 = time.perf_counter()
+        try:
+            async with session.get(
+                models_url,
+                headers={"user-agent": USER_AGENT},
+                timeout=timeout,
+            ) as response:
+                response.raise_for_status()
+                data = await response.json()
+                save_file(data, "models.json")
+                return data["language_models"] + data["models"]
+        except Exception:
+            _ERRORS.inc(endpoint="models")
+            raise
+        finally:
+            _REQUEST_SECONDS.observe(
+                time.perf_counter() - t0, endpoint="models")
 
 
 # --- reference-signature wrappers (swarm/hive.py:9,50,69) ---

@@ -1224,7 +1224,8 @@ async def init() -> int:
     if not args.silent and (not settings_exist() or not settings.sdaas_token):
         settings = prompt_for_settings(settings)
     save_settings(settings)
-    setup_logging(resolve_path(settings.log_filename), settings.log_level)
+    setup_logging(resolve_path(settings.log_filename), settings.log_level,
+                  getattr(settings, "log_format", "plain"))
 
     rc = 0
     if args.download or args.check:

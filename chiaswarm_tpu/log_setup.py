@@ -1,25 +1,61 @@
-"""Rotating-file logging setup (behavior parity: swarm/log_setup.py:5-29)."""
+"""Rotating-file logging setup (behavior parity: swarm/log_setup.py:5-29).
+
+Beyond the reference: `log_format="json"` (Settings.log_format /
+CHIASWARM_LOG_FORMAT) swaps the formatter for structured one-object-per-line
+JSON whose records carry the active `job_id` — either passed explicitly via
+``logger.info(..., extra={"job_id": ...})`` or picked up from the
+telemetry contextvar that `trace_job` / the worker's executor threads pin
+around each job. Plain format stays the default.
+"""
 
 from __future__ import annotations
 
+import json
 import logging
 import logging.handlers
 from pathlib import Path
+
+from .telemetry import current_job_id
 
 MAX_BYTES = 50 * 1024 * 1024
 BACKUP_COUNT = 7
 
 
-def setup_logging(log_path: Path | str, log_level: str = "WARN") -> None:
+class JsonFormatter(logging.Formatter):
+    """One JSON object per line; `job_id` rides every record logged while a
+    job trace is active, so a grep for one job id yields its whole story."""
+
+    def format(self, record: logging.LogRecord) -> str:
+        payload = {
+            "ts": self.formatTime(record),
+            "level": record.levelname,
+            "logger": record.name,
+            "message": record.getMessage(),
+        }
+        job_id = getattr(record, "job_id", None)
+        if job_id is None:
+            job_id = current_job_id.get()
+        if job_id is not None:
+            payload["job_id"] = str(job_id)
+        if record.exc_info:
+            payload["exc_info"] = self.formatException(record.exc_info)
+        return json.dumps(payload, ensure_ascii=False)
+
+
+def setup_logging(log_path: Path | str, log_level: str = "WARN",
+                  log_format: str = "plain") -> None:
     log_path = Path(log_path)
     log_path.parent.mkdir(parents=True, exist_ok=True)
 
     handler = logging.handlers.RotatingFileHandler(
         log_path, maxBytes=MAX_BYTES, backupCount=BACKUP_COUNT
     )
-    handler.setFormatter(
-        logging.Formatter("%(asctime)s %(levelname)s %(name)s %(message)s")
-    )
+    if log_format == "json":
+        handler.setFormatter(JsonFormatter())
+    else:
+        handler.setFormatter(
+            logging.Formatter("%(asctime)s %(levelname)s %(name)s %(message)s")
+        )
 
     root = logging.getLogger()
     root.setLevel(log_level)

@@ -43,8 +43,25 @@ from ..registry import register_family
 from ..schedulers import get_scheduler
 from ..schedulers.common import SchedulerConfig
 from ..settings import load_settings
+from ..telemetry import Span, counter as telemetry_counter
 
 logger = logging.getLogger(__name__)
+
+# jitted-program cache effectiveness: a "miss" pays a full XLA trace +
+# compile; the shape-bucket design lives or dies by this ratio
+_COMPILE_CACHE = telemetry_counter(
+    "swarm_compile_cache_total",
+    "Denoise-program cache lookups by outcome (miss = trace + XLA compile)",
+    ("event",),
+)
+
+# padded-vs-real rows through run_batched: how much of each coalesced
+# pass was real work vs power-of-two padding (batching ROI, per PR 1)
+_BATCH_ROWS = telemetry_counter(
+    "swarm_batch_pass_rows_total",
+    "Image rows through coalesced passes, real vs padding",
+    ("kind",),
+)
 
 MAX_RESIDENT_LORAS = 4
 MAX_RESIDENT_TI = 4
@@ -730,7 +747,9 @@ class SDPipeline:
         """
         with self._jit_lock:
             if key in self._programs:
+                _COMPILE_CACHE.inc(event="hit")
                 return self._programs[key]
+        _COMPILE_CACHE.inc(event="miss")
         mode, lh, lw, batch, steps, sched_key, t_start, cn_key = key
         scheduler = get_scheduler(
             sched_key[0],
@@ -1063,31 +1082,32 @@ class SDPipeline:
 
         # --- conditioning: one batched pass, rows [uncond*N | cond*N];
         # pix2pix duplicates the uncond rows for its image-only CFG row ---
-        t0 = time.perf_counter()
-        cfg_rows = 3 if mode == "pix2pix" else 2
-        texts = [negative_prompt] * n_images + [prompt] * n_images
-        context, pooled = self.encode_prompts(
-            texts, job_params, tokenizers=job_tokenizers,
-            extra_embeddings=job_extras,
-        )
-        pooled_u = pooled[:n_images] if pooled is not None else None
-        pooled_c = pooled[n_images:] if pooled is not None else None
-        if cfg_rows == 3:
-            context = jnp.concatenate([context[:n_images], context], axis=0)
-
-        added = None
-        if self.is_xl:
-            ids = self._xl_time_ids(
-                pooled_c.shape[-1], height, width,
-                float(kwargs.pop("aesthetic_score", 6.0)),
+        with Span("text_encode", timings):
+            cfg_rows = 3 if mode == "pix2pix" else 2
+            texts = [negative_prompt] * n_images + [prompt] * n_images
+            context, pooled = self.encode_prompts(
+                texts, job_params, tokenizers=job_tokenizers,
+                extra_embeddings=job_extras,
             )
-            time_ids = jnp.asarray([ids] * (cfg_rows * n_images), jnp.float32)
-            pooled_rows = [pooled_u] * (cfg_rows - 1) + [pooled_c]
-            added = {
-                "text_embeds": jnp.concatenate(pooled_rows, axis=0),
-                "time_ids": time_ids,
-            }
-        timings["text_encode_s"] = round(time.perf_counter() - t0, 3)
+            pooled_u = pooled[:n_images] if pooled is not None else None
+            pooled_c = pooled[n_images:] if pooled is not None else None
+            if cfg_rows == 3:
+                context = jnp.concatenate(
+                    [context[:n_images], context], axis=0)
+
+            added = None
+            if self.is_xl:
+                ids = self._xl_time_ids(
+                    pooled_c.shape[-1], height, width,
+                    float(kwargs.pop("aesthetic_score", 6.0)),
+                )
+                time_ids = jnp.asarray(
+                    [ids] * (cfg_rows * n_images), jnp.float32)
+                pooled_rows = [pooled_u] * (cfg_rows - 1) + [pooled_c]
+                added = {
+                    "text_embeds": jnp.concatenate(pooled_rows, axis=0),
+                    "time_ids": time_ids,
+                }
 
         # --- latents (initial noise is drawn inside the jitted program) ---
         rng, init_rng, step_rng = jax.random.split(rng, 3)
@@ -1174,33 +1194,34 @@ class SDPipeline:
             tuple(sorted(dataclass_items(sched_cfg))),
         )
         key = (mode, lh, lw, n_images, steps, sched_key, t_start, cn_key)
-        t0 = time.perf_counter()
-        program = self._denoise_program(key, controlnet_module)
-        timings["trace_s"] = round(time.perf_counter() - t0, 3)
+        # stage "compile" is program-cache resolution: ~0 on a hit, the
+        # full trace+XLA compile on a miss (swarm_compile_cache_total
+        # tells the two apart in aggregate)
+        with Span("compile", timings, key="trace_s"):
+            program = self._denoise_program(key, controlnet_module)
 
-        t0 = time.perf_counter()
         # long-sequence self-attention shards over the mesh seq axis (ring
         # attention) when this ChipSet carved one out; trace-time routing,
         # so it binds on the first (tracing) call of each program bucket
         from ..ops.attention import sequence_parallel_scope
 
-        with sequence_parallel_scope(self.mesh):
-            pixels = program(
-                job_params,
-                init_rng,
-                context,
-                added,
-                jnp.float32(guidance_scale),
-                jnp.float32(image_guidance or 0.0),
-                image_latents,
-                mask,
-                step_rng,
-                cn_params,
-                control_cond,
-                jnp.float32(cn_scale),
-            )
-        pixels = jax.block_until_ready(pixels)
-        timings["denoise_decode_s"] = round(time.perf_counter() - t0, 3)
+        with Span("denoise", timings, key="denoise_decode_s"):
+            with sequence_parallel_scope(self.mesh):
+                pixels = program(
+                    job_params,
+                    init_rng,
+                    context,
+                    added,
+                    jnp.float32(guidance_scale),
+                    jnp.float32(image_guidance or 0.0),
+                    image_latents,
+                    mask,
+                    step_rng,
+                    cn_params,
+                    control_cond,
+                    jnp.float32(cn_scale),
+                )
+            pixels = jax.block_until_ready(pixels)
 
         images = _to_pil(np.asarray(pixels))
 
@@ -1357,25 +1378,30 @@ class SDPipeline:
         padded = pad_bucket(total)
         pad_rows = padded - total
 
+        _BATCH_ROWS.inc(total, kind="real")
+        if pad_rows:
+            _BATCH_ROWS.inc(pad_rows, kind="padding")
+
         # --- conditioning: rows [uncond*padded | cond*padded]; padding
         # rows are empty prompts whose outputs are discarded ---
-        t0 = time.perf_counter()
-        negs: list[str] = []
-        prompts: list[str] = []
-        for r, n in zip(requests, counts):
-            negs.extend([r.get("negative_prompt") or ""] * n)
-            prompts.extend([r.get("prompt") or ""] * n)
-        texts = negs + [""] * pad_rows + prompts + [""] * pad_rows
-        context, pooled = self.encode_prompts(texts, base_params)
+        with Span("text_encode", timings):
+            negs: list[str] = []
+            prompts: list[str] = []
+            for r, n in zip(requests, counts):
+                negs.extend([r.get("negative_prompt") or ""] * n)
+                prompts.extend([r.get("prompt") or ""] * n)
+            texts = negs + [""] * pad_rows + prompts + [""] * pad_rows
+            context, pooled = self.encode_prompts(texts, base_params)
 
-        added = None
-        if self.is_xl:
-            ids = self._xl_time_ids(pooled.shape[-1], height, width)
-            added = {
-                "text_embeds": pooled,  # already [uncond*padded | cond*padded]
-                "time_ids": jnp.asarray([ids] * (2 * padded), jnp.float32),
-            }
-        timings["text_encode_s"] = round(time.perf_counter() - t0, 3)
+            added = None
+            if self.is_xl:
+                ids = self._xl_time_ids(pooled.shape[-1], height, width)
+                added = {
+                    # already [uncond*padded | cond*padded]
+                    "text_embeds": pooled,
+                    "time_ids": jnp.asarray(
+                        [ids] * (2 * padded), jnp.float32),
+                }
 
         # --- per-row key pairs (init draw + ancestral step noise), each
         # derived only from the owning request's rng ---
@@ -1410,30 +1436,28 @@ class SDPipeline:
         )
         sched_key = (scheduler_type, tuple(sorted(dataclass_items(sched_cfg))))
         key = ("batched", lh, lw, padded, steps, sched_key, 0, None)
-        t0 = time.perf_counter()
-        program = self._denoise_program(key)
-        timings["trace_s"] = round(time.perf_counter() - t0, 3)
+        with Span("compile", timings, key="trace_s"):
+            program = self._denoise_program(key)
 
-        t0 = time.perf_counter()
         from ..ops.attention import sequence_parallel_scope
 
-        with sequence_parallel_scope(self.mesh):
-            pixels = program(
-                base_params,
-                init_rng,
-                context,
-                added,
-                jnp.float32(guidance_scale),
-                jnp.float32(0.0),
-                image_latents,
-                mask,
-                step_rng,
-                {},
-                control_cond,
-                jnp.float32(1.0),
-            )
-        pixels = jax.block_until_ready(pixels)
-        timings["denoise_decode_s"] = round(time.perf_counter() - t0, 3)
+        with Span("denoise", timings, key="denoise_decode_s"):
+            with sequence_parallel_scope(self.mesh):
+                pixels = program(
+                    base_params,
+                    init_rng,
+                    context,
+                    added,
+                    jnp.float32(guidance_scale),
+                    jnp.float32(0.0),
+                    image_latents,
+                    mask,
+                    step_rng,
+                    {},
+                    control_cond,
+                    jnp.float32(1.0),
+                )
+            pixels = jax.block_until_ready(pixels)
 
         groups = split_by_counts(_to_pil(np.asarray(pixels)), counts)
 

@@ -169,6 +169,12 @@ def clear_cache() -> None:
         _CACHE.clear()
 
 
+def resident_models() -> list[str]:
+    """Model names currently resident in HBM (telemetry /healthz)."""
+    with _CACHE_LOCK:
+        return sorted({key[0] for key in _CACHE})
+
+
 _BUILTINS_LOADED = False
 
 

@@ -66,6 +66,17 @@ class Settings:
     batch_linger_ms: float = 50.0
     # most jobs one coalesced group may hold; <= 1 disables coalescing
     max_coalesce: int = 8
+    # --- observability (telemetry.py) ---
+    # local /metrics + /healthz HTTP port; 0 disables the server (the
+    # in-process instrumentation stays on either way — it is dict ops)
+    metrics_port: int = 8061
+    # bind address for the metrics server; loopback by default so worker
+    # internals are not exposed off-host unless the operator opts in
+    # (set 0.0.0.0 for a Prometheus scrape from another machine)
+    metrics_host: str = "127.0.0.1"
+    # log line format: "plain" (reference parity) | "json" (structured
+    # lines carrying the active job_id — log_setup.JsonFormatter)
+    log_format: str = "plain"
 
     @classmethod
     def field_names(cls) -> tuple[str, ...]:
@@ -86,6 +97,9 @@ _ENV_OVERRIDES = {
     "SDAAS_DTYPE": "dtype",
     "SDAAS_BATCH_LINGER_MS": "batch_linger_ms",
     "SDAAS_MAX_COALESCE": "max_coalesce",
+    "CHIASWARM_METRICS_PORT": "metrics_port",
+    "CHIASWARM_METRICS_HOST": "metrics_host",
+    "CHIASWARM_LOG_FORMAT": "log_format",
 }
 
 

@@ -28,9 +28,10 @@ from __future__ import annotations
 
 import asyncio
 import logging
+import time
 from concurrent.futures import ThreadPoolExecutor
 
-from . import __version__
+from . import __version__, telemetry
 from .batching import BatchScheduler
 from .chips.allocator import SliceAllocator
 from .hive import HiveClient
@@ -42,11 +43,38 @@ from .post_processors.output_processor import (
     fatal_exception_response,
 )
 from .settings import Settings, load_settings, resolve_path
+from .telemetry import observe_stage, trace_job
 
 logger = logging.getLogger(__name__)
 
 POLL_SECONDS = 11
 ERROR_BACKOFF_SECONDS = 121
+
+_JOBS_POLLED = telemetry.counter(
+    "swarm_jobs_polled_total", "Jobs received from hive /work polls")
+_POLL_ERRORS = telemetry.counter(
+    "swarm_poll_errors_total", "ask_for_work calls that raised")
+_JOBS_COMPLETED = telemetry.counter(
+    "swarm_jobs_completed_total",
+    "Result envelopes produced, by outcome (ok | error | fatal)",
+    ("outcome",),
+)
+_LAST_POLL = telemetry.gauge(
+    "swarm_last_poll_unixtime",
+    "Wall-clock time of the last successful hive poll")
+_SLICES_TOTAL = telemetry.gauge(
+    "swarm_slices_total", "Chip slices this worker serves jobs on")
+_SLICES_BUSY = telemetry.gauge(
+    "swarm_slices_busy", "Chip slices currently executing a job")
+_JOBS_IN_FLIGHT = telemetry.gauge(
+    "swarm_jobs_in_flight",
+    "Jobs accepted from the hive and not yet uploaded")
+_QUEUE_DEPTH = telemetry.gauge(
+    "swarm_queue_depth",
+    "Jobs per internal queue (lingering = open coalescing groups, "
+    "ready = released to slice workers, results = awaiting upload)",
+    ("queue",),
+)
 
 
 class Worker:
@@ -86,11 +114,15 @@ class Worker:
             max_workers=len(self.allocator), thread_name_prefix="chipslice"
         )
         self._stopping = asyncio.Event()
+        self._metrics_runner = None
+        # monotonic time of the last SUCCESSFUL hive poll (healthz age)
+        self._last_poll_monotonic: float | None = None
 
     # --- lifecycle ---
 
     async def run(self) -> None:
         self.startup()
+        await self._start_metrics_server()
         tasks = [
             asyncio.create_task(self.slice_worker(), name=f"slice_worker_{i}")
             for i in range(len(self.allocator))
@@ -104,21 +136,83 @@ class Worker:
                 t.cancel()
             await asyncio.gather(*tasks, return_exceptions=True)
             await self.hive.close()
+            if self._metrics_runner is not None:
+                await self._metrics_runner.cleanup()
+                self._metrics_runner = None
             self._executor.shutdown(wait=False, cancel_futures=True)
 
     def stop(self) -> None:
         self._stopping.set()
 
     def startup(self) -> None:
-        setup_logging(resolve_path(self.settings.log_filename), self.settings.log_level)
+        setup_logging(
+            resolve_path(self.settings.log_filename),
+            self.settings.log_level,
+            getattr(self.settings, "log_format", "plain"),
+        )
         logger.info("chiaSWARM-TPU worker %s", __version__)
         caps = self.allocator.capabilities()
         print(
             f"Found {caps['chips']} chips ({caps['topology']}), "
             f"{len(self.allocator)} job slice(s)"
         )
+        _SLICES_TOTAL.set(len(self.allocator))
         self._enable_compilation_cache()
         self._start_profiler_server()
+
+    async def _start_metrics_server(self) -> None:
+        """Local telemetry endpoint (telemetry.py): GET /metrics in
+        Prometheus text format, GET /healthz with last-poll age, resident
+        models, and per-slice busy state. Sits next to the jax.profiler
+        server; Settings.metrics_port / CHIASWARM_METRICS_PORT picks the
+        port, 0 disables. Never fatal — a busy port costs the scrape, not
+        the worker."""
+        port = int(getattr(self.settings, "metrics_port", 0) or 0)
+        if not port:
+            return
+        try:
+            from .telemetry import start_metrics_server
+
+            self._metrics_runner = await start_metrics_server(
+                port,
+                health=self._health,
+                host=getattr(self.settings, "metrics_host", "127.0.0.1"),
+            )
+            logger.info("metrics server on :%d", port)
+        except Exception as e:  # observability is an add-on, never fatal
+            logger.warning("metrics server unavailable: %s", e)
+
+    def _health(self) -> dict:
+        """/healthz snapshot: is this worker polling, what is resident,
+        which slices are busy."""
+        from .registry import resident_models
+
+        age = None
+        if self._last_poll_monotonic is not None:
+            age = round(time.monotonic() - self._last_poll_monotonic, 1)
+        return {
+            "status": "ok",
+            "worker_version": __version__,
+            "last_poll_age_s": age,
+            "jobs_in_flight": self.batcher.outstanding_jobs,
+            "results_pending": self.result_queue.qsize(),
+            "resident_models": resident_models(),
+            "slices": [
+                {
+                    "slice_id": s.slice_id,
+                    "chips": s.chip_count(),
+                    "busy": s.busy,
+                }
+                for s in self.allocator.slices
+            ],
+        }
+
+    def _update_queue_gauges(self) -> None:
+        _JOBS_IN_FLIGHT.set(self.batcher.outstanding_jobs)
+        _SLICES_BUSY.set(len(self.allocator) - self.allocator.free_count)
+        _QUEUE_DEPTH.set(self.batcher.pending_jobs, queue="lingering")
+        _QUEUE_DEPTH.set(self.batcher.ready_jobs, queue="ready")
+        _QUEUE_DEPTH.set(self.result_queue.qsize(), queue="results")
 
     def _start_profiler_server(self) -> None:
         """jax.profiler trace endpoint (SURVEY §5 'tracing/profiling:
@@ -177,6 +271,15 @@ class Worker:
             # chips a slice would need at full TP — the remediation the
             # hive/operator can act on when flux_runnable is 0
             caps["flux_min_chips"] = min_chips(flux, max(per_chip, 1e-6))
+        # live-load snapshot riding the heartbeat: a capability-aware hive
+        # can place by actual occupancy instead of round-robin (legacy
+        # hives ignore unknown query params)
+        caps["jobs_in_flight"] = self.batcher.outstanding_jobs
+        caps["busy_slices"] = len(self.allocator) - self.allocator.free_count
+        caps["jobs_completed"] = int(_JOBS_COMPLETED.total())
+        if self._last_poll_monotonic is not None:
+            caps["last_poll_age_s"] = round(
+                time.monotonic() - self._last_poll_monotonic, 1)
         return caps
 
     # --- producer: poll the hive ---
@@ -187,16 +290,25 @@ class Worker:
             if not self.batcher.full() and self.allocator.has_free_slice():
                 try:
                     jobs = await self.hive.ask_for_work(self._capabilities())
+                    self._last_poll_monotonic = time.monotonic()
+                    _LAST_POLL.set(time.time())
                     for job in jobs:
                         print(f"Got job {job['id']}")
+                        _JOBS_POLLED.inc()
+                        # queue_wait stage starts here; the slice worker
+                        # pops the stamp when it picks the job up
+                        job["_telemetry_enqueued"] = time.monotonic()
                         await self.batcher.put(job)
                     sleep_seconds = POLL_SECONDS
                 except asyncio.TimeoutError:
                     logger.warning("hive poll timeout")
+                    _POLL_ERRORS.inc()
                 except Exception as e:
                     logger.exception("ask_for_work error")
                     print(f"ask_for_work error {e}")
+                    _POLL_ERRORS.inc()
                     sleep_seconds = ERROR_BACKOFF_SECONDS
+            self._update_queue_gauges()
             await asyncio.sleep(sleep_seconds)
 
     # --- consumers: one logical worker per chip slice ---
@@ -218,7 +330,15 @@ class Worker:
     async def slice_worker(self) -> None:
         while True:
             batch = await self.batcher.get()
+            # queue_wait: hive handoff -> a slice actually starting the work
+            picked_up = time.monotonic()
+            queue_wait = {}
+            for job in batch:
+                enqueued = job.pop("_telemetry_enqueued", None)
+                if enqueued is not None and "id" in job:
+                    queue_wait[job["id"]] = picked_up - enqueued
             chipset = await self.allocator.acquire()
+            self._update_queue_gauges()
             try:
                 prepared = []
                 for job in batch:
@@ -230,12 +350,14 @@ class Worker:
                 if len(prepared) > 1 and self._batchable(prepared):
                     results = await self.do_batched_work(chipset, prepared)
                     for result in results:
+                        self._finish_result(result, queue_wait)
                         await self.result_queue.put(result)
                 else:
                     for worker_function, kwargs in prepared:
                         result = await self.do_work(
                             chipset, worker_function, kwargs
                         )
+                        self._finish_result(result, queue_wait)
                         await self.result_queue.put(result)
             except Exception as e:
                 logger.exception("slice_worker error")
@@ -244,6 +366,26 @@ class Worker:
                 self.allocator.release(chipset)
                 for _ in batch:
                     self.batcher.task_done()
+                self._update_queue_gauges()
+
+    @staticmethod
+    def _finish_result(result: dict, queue_wait: dict) -> None:
+        """Stamp worker-side stage timings into the envelope and count the
+        job by outcome — ONE place, so solo, coalesced, and fallback paths
+        all report identically."""
+        cfg = result.setdefault("pipeline_config", {})
+        timings = cfg.setdefault("timings", {})
+        wait = queue_wait.get(result.get("id"))
+        if wait is not None:
+            observe_stage("queue_wait", wait)
+            timings["queue_wait_s"] = round(wait, 3)
+        if result.get("fatal_error"):
+            outcome = "fatal"
+        elif "error" in cfg:
+            outcome = "error"
+        else:
+            outcome = "ok"
+        _JOBS_COMPLETED.inc(outcome=outcome)
 
     @staticmethod
     def _batchable(prepared: list) -> bool:
@@ -261,7 +403,9 @@ class Worker:
             # input args are wrong somehow: not recoverable, don't resubmit
             # (reference swarm/worker.py:105-115)
             logger.exception("format_args failed for job %s", job.get("id"))
-            await self.result_queue.put(fatal_exception_response(e, job["id"], job))
+            result = fatal_exception_response(e, job["id"], job)
+            self._finish_result(result, {})
+            await self.result_queue.put(result)
         return None, None
 
     async def do_work(self, chipset, worker_function, kwargs) -> dict:
@@ -293,7 +437,8 @@ class Worker:
             f"on {chipset.descriptor()}"
         )
         try:
-            outs = chipset.run_batched(diffusion_batched_callback, requests)
+            with trace_job(",".join(str(i) for i in ids)):
+                outs = chipset.run_batched(diffusion_batched_callback, requests)
             return [
                 {
                     "id": job_id,
@@ -318,8 +463,11 @@ class Worker:
         job_id = kwargs.pop("id")
         print(f"Processing {job_id} on {chipset.descriptor()}")
 
+        # trace_job pins the job id on this executor thread so every log
+        # line (and span) emitted during execution carries it (JSON logs)
         try:
-            artifacts, pipeline_config = chipset(worker_function, **kwargs)
+            with trace_job(job_id):
+                artifacts, pipeline_config = chipset(worker_function, **kwargs)
         except (ValueError, TypeError) as e:
             # non-recoverable (e.g. incompatible adapter): fatal envelope
             return fatal_exception_response(e, job_id, kwargs)
@@ -346,7 +494,11 @@ class Worker:
         while True:
             result = await self.result_queue.get()
             try:
+                t0 = time.perf_counter()
                 await self.hive.submit_result(result)
+                # stage "submit": successful upload latency (failures are
+                # counted per-endpoint by hive.py)
+                observe_stage("submit", time.perf_counter() - t0)
             except asyncio.TimeoutError:
                 logger.warning("timeout submitting result %s", result.get("id"))
             except Exception as e:

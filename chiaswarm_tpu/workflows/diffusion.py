@@ -11,6 +11,7 @@ from __future__ import annotations
 
 from ..post_processors.output_processor import OutputProcessor
 from ..registry import get_pipeline
+from ..telemetry import Span
 
 
 def _tiny_stand_in(model_name: str) -> str:
@@ -95,13 +96,17 @@ def diffusion_callback(device_identifier: str, model_name: str, **kwargs):
     # swarm/worker.py:166); auxiliary — never fails the job
     from ..pipelines.safety import flag_images
 
-    nsfw, checked = flag_images(images)
-    pipeline_config["nsfw"] = nsfw
-    pipeline_config["nsfw_checked"] = checked
+    # stage "decode": host-side postprocess (NSFW check + grid composite +
+    # encode) after the on-device decode that ends the denoise program
+    with Span("decode", pipeline_config.setdefault("timings", {})):
+        nsfw, checked = flag_images(images)
+        pipeline_config["nsfw"] = nsfw
+        pipeline_config["nsfw_checked"] = checked
 
-    processor = OutputProcessor(outputs, content_type)
-    processor.add_outputs(images)
-    return processor.get_results(), pipeline_config
+        processor = OutputProcessor(outputs, content_type)
+        processor.add_outputs(images)
+        results = processor.get_results()
+    return results, pipeline_config
 
 
 def diffusion_batched_callback(device_identifier: str, requests: list[dict]):
@@ -191,15 +196,17 @@ def diffusion_batched_callback(device_identifier: str, requests: list[dict]):
 
     out = []
     for i, ((images, pipeline_config), env) in enumerate(zip(results, envelopes)):
-        nsfw, checked = flag_images(images)
-        pipeline_config["nsfw"] = nsfw
-        pipeline_config["nsfw_checked"] = checked
-        pipeline_config["batched_with"] = len(requests)
-        if i in capped:
-            pipeline_config["batch_capped"] = capped[i]
-        processor = OutputProcessor(env["outputs"], env["content_type"])
-        processor.add_outputs(images)
-        out.append((processor.get_results(), pipeline_config))
+        with Span("decode", pipeline_config.setdefault("timings", {})):
+            nsfw, checked = flag_images(images)
+            pipeline_config["nsfw"] = nsfw
+            pipeline_config["nsfw_checked"] = checked
+            pipeline_config["batched_with"] = len(requests)
+            if i in capped:
+                pipeline_config["batch_capped"] = capped[i]
+            processor = OutputProcessor(env["outputs"], env["content_type"])
+            processor.add_outputs(images)
+            packaged = processor.get_results()
+        out.append((packaged, pipeline_config))
     return out
 
 

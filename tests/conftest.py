@@ -67,6 +67,7 @@ _QUICK_MODULES = {
     "test_requirements",
     "test_schedulers",
     "test_settings",
+    "test_telemetry",
     "test_tokenizer",
     "test_weights_path",
 }

@@ -22,6 +22,9 @@ class FakeHive:
         self.work_requests: list[dict] = []
         self.result_event = asyncio.Event()
         self.refuse_with: str | None = None  # set -> /work returns 400 + message
+        # next N POST /results answer 500 before succeeding (retry tests)
+        self.fail_results_times: int = 0
+        self.result_attempts: int = 0
         self._runner: web.AppRunner | None = None
         self.port: int | None = None
 
@@ -68,6 +71,10 @@ class FakeHive:
         return web.json_response({"jobs": jobs})
 
     async def _results(self, request: web.Request) -> web.Response:
+        self.result_attempts += 1
+        if self.fail_results_times > 0:
+            self.fail_results_times -= 1
+            return web.json_response({"message": "hive hiccup"}, status=502)
         self.results.append(json.loads(await request.text()))
         self.result_event.set()
         return web.json_response({"status": "ok"})

@@ -204,3 +204,67 @@ def test_flush_all_releases_lingering_groups():
         return await asyncio.wait_for(b.get(), 1.0)
 
     assert [j["id"] for j in run(scenario())] == ["a"]
+
+
+# --- priority fast-path (ROADMAP "priority-aware batching", minimal slice) ---
+
+
+def test_interactive_job_flushes_its_group_immediately():
+    from chiaswarm_tpu.batching import _FLUSHES
+
+    async def scenario():
+        before = _FLUSHES.value(reason="priority")
+        b = BatchScheduler(linger_s=60.0, max_coalesce=8)  # linger = never
+        await b.put(job(id="patient"))
+        await b.put(job(id="hurry", priority="interactive"))
+        # the interactive job takes its whole lingering group with it NOW
+        group = await asyncio.wait_for(b.get(), 1.0)
+        assert b.pending_jobs == 0
+        assert _FLUSHES.value(reason="priority") == before + 1
+        return group
+
+    assert [j["id"] for j in run(scenario())] == ["patient", "hurry"]
+
+
+def test_sdaas_priority_spelling_and_solo_interactive():
+    async def scenario():
+        b = BatchScheduler(linger_s=60.0, max_coalesce=8)
+        await b.put(job(id="vip", sdaas_priority="interactive"))
+        return await asyncio.wait_for(b.get(), 1.0)
+
+    assert [j["id"] for j in run(scenario())] == ["vip"]
+
+
+def test_non_interactive_priority_values_still_linger():
+    async def scenario():
+        b = BatchScheduler(linger_s=0.02, max_coalesce=8)
+        await b.put(job(id="a", priority="batch"))
+        await b.put(job(id="b"))
+        return await asyncio.wait_for(b.get(), 1.0)
+
+    # an unrecognized priority value changes nothing: both coalesce after
+    # the linger window as before
+    assert [j["id"] for j in run(scenario())] == ["a", "b"]
+
+
+def test_flush_reason_counters_cover_release_paths():
+    from chiaswarm_tpu.batching import _FLUSHES, _GROUP_JOBS
+
+    async def scenario():
+        solo = _FLUSHES.value(reason="solo")
+        size = _FLUSHES.value(reason="size")
+        linger = _FLUSHES.value(reason="linger")
+        groups = _GROUP_JOBS.count()
+        b = BatchScheduler(linger_s=0.02, max_coalesce=2)
+        await b.put({"id": "e", "workflow": "echo", "model_name": "none"})
+        await b.put(job(id="a"))
+        await b.put(job(id="b"))  # completes a max_coalesce=2 group
+        await b.put(job(id="c"))  # left to the linger timer
+        for _ in range(3):
+            await asyncio.wait_for(b.get(), 1.0)
+        assert _FLUSHES.value(reason="solo") == solo + 1
+        assert _FLUSHES.value(reason="size") == size + 1
+        assert _FLUSHES.value(reason="linger") == linger + 1
+        assert _GROUP_JOBS.count() == groups + 3
+
+    run(scenario())

@@ -1,0 +1,68 @@
+"""tools/metrics_dump.py contract tests: the exposition parser and stage
+table on synthetic input, and the REAL in-process smoke-job mode — so the
+operator tool can't rot between TPU windows."""
+
+import importlib.util
+import pathlib
+import sys
+
+_TOOL = pathlib.Path(__file__).resolve().parent.parent / "tools" / "metrics_dump.py"
+
+
+def _load_tool():
+    spec = importlib.util.spec_from_file_location("metrics_dump", _TOOL)
+    mod = importlib.util.module_from_spec(spec)
+    sys.modules.setdefault("metrics_dump", mod)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+SYNTHETIC = """\
+# HELP swarm_job_stage_seconds Per-job wall-clock seconds by lifecycle stage
+# TYPE swarm_job_stage_seconds histogram
+swarm_job_stage_seconds_bucket{stage="denoise",le="1"} 1
+swarm_job_stage_seconds_bucket{stage="denoise",le="5"} 3
+swarm_job_stage_seconds_bucket{stage="denoise",le="+Inf"} 4
+swarm_job_stage_seconds_sum{stage="denoise"} 14.5
+swarm_job_stage_seconds_count{stage="denoise"} 4
+swarm_job_stage_seconds_bucket{stage="submit",le="1"} 2
+swarm_job_stage_seconds_bucket{stage="submit",le="+Inf"} 2
+swarm_job_stage_seconds_sum{stage="submit"} 0.2
+swarm_job_stage_seconds_count{stage="submit"} 2
+# TYPE swarm_jobs_completed_total counter
+swarm_jobs_completed_total{outcome="ok"} 4
+"""
+
+
+def test_parse_and_stage_table_from_synthetic_text():
+    tool = _load_tool()
+    samples = tool.parse_metrics(SYNTHETIC)
+    assert ("swarm_jobs_completed_total", {"outcome": "ok"}, 4.0) in samples
+
+    rows = tool.stage_rows(samples)
+    by_stage = {r["stage"]: r for r in rows}
+    assert set(by_stage) == {"denoise", "submit"}
+    d = by_stage["denoise"]
+    assert d["count"] == 4
+    assert d["mean_s"] == 14.5 / 4
+    assert d["p50_le_s"] == 5.0  # cumulative 3/4 crossed at le=5
+    assert d["p90_le_s"] == float("inf")
+    assert by_stage["submit"]["p50_le_s"] == 1.0
+
+    table = tool.render_table(rows)
+    assert "denoise" in table and "submit" in table
+    assert "+Inf" in table
+
+    # empty input degrades to a message, not a crash
+    assert "no job stages" in tool.render_table(tool.stage_rows([]))
+
+
+def test_inprocess_smoke_job_prints_stage_table(sdaas_root, capsys):
+    """The tool's no-hive mode runs one tiny txt2img job through the real
+    serving path and prints a table covering the pipeline stages."""
+    tool = _load_tool()
+    rc = tool.main([])
+    out = capsys.readouterr().out
+    assert rc == 0
+    for stage in ("compile", "denoise", "decode", "text_encode"):
+        assert stage in out, out

@@ -56,3 +56,17 @@ def test_tpu_fields_roundtrip(sdaas_root):
     s = load_settings()
     assert s.chips_per_job == 4
     assert s.dtype == "float32"
+
+
+def test_observability_knobs(sdaas_root, monkeypatch):
+    s = load_settings()
+    assert s.metrics_port == 8061  # default: local /metrics + /healthz on
+    assert s.metrics_host == "127.0.0.1"  # loopback unless opted in
+    assert s.log_format == "plain"
+    monkeypatch.setenv("CHIASWARM_METRICS_PORT", "0")
+    monkeypatch.setenv("CHIASWARM_METRICS_HOST", "0.0.0.0")
+    monkeypatch.setenv("CHIASWARM_LOG_FORMAT", "json")
+    s = load_settings()
+    assert s.metrics_port == 0  # opt-out disables the HTTP server
+    assert s.metrics_host == "0.0.0.0"
+    assert s.log_format == "json"

@@ -1,0 +1,281 @@
+"""Telemetry unit tests: registry semantics, Prometheus text rendering,
+span/trace nesting, the /metrics + /healthz aiohttp app, and the JSON log
+formatter (log_setup satellite)."""
+
+import asyncio
+import json
+import logging
+import time
+
+import pytest
+
+from chiaswarm_tpu.telemetry import (
+    STAGE_METRIC,
+    Registry,
+    Span,
+    build_metrics_app,
+    trace_job,
+)
+
+
+# --- counter / gauge / histogram semantics ---
+
+
+def test_counter_inc_and_labels():
+    reg = Registry()
+    c = reg.counter("jobs_total", "jobs", ("outcome",))
+    c.inc(outcome="ok")
+    c.inc(2, outcome="ok")
+    c.inc(outcome="fatal")
+    assert c.value(outcome="ok") == 3
+    assert c.value(outcome="fatal") == 1
+    assert c.value(outcome="never_seen") == 0
+    assert c.total() == 4
+
+
+def test_counter_rejects_negative_and_wrong_labels():
+    reg = Registry()
+    c = reg.counter("c_total", "", ("a",))
+    with pytest.raises(ValueError):
+        c.inc(-1, a="x")
+    with pytest.raises(ValueError):
+        c.inc(b="x")  # unknown label
+    with pytest.raises(ValueError):
+        c.inc()  # missing label
+
+
+def test_gauge_set_inc_dec():
+    reg = Registry()
+    g = reg.gauge("depth")
+    g.set(5)
+    g.inc()
+    g.dec(2)
+    assert g.value() == 4
+
+
+def test_histogram_buckets_sum_count():
+    reg = Registry()
+    h = reg.histogram("lat_seconds", "", ("stage",), buckets=(0.1, 1.0, 10.0))
+    for v in (0.05, 0.1, 0.5, 20.0):
+        h.observe(v, stage="s")
+    assert h.count(stage="s") == 4
+    assert h.sum(stage="s") == pytest.approx(20.65)
+    # a value equal to a bound lands in that bucket (le semantics)
+    rendered = h.render()
+    assert 'lat_seconds_bucket{stage="s",le="0.1"} 2' in rendered
+    assert 'lat_seconds_bucket{stage="s",le="1"} 3' in rendered
+    assert 'lat_seconds_bucket{stage="s",le="10"} 3' in rendered
+    assert 'lat_seconds_bucket{stage="s",le="+Inf"} 4' in rendered
+    assert 'lat_seconds_count{stage="s"} 4' in rendered
+
+
+def test_registry_get_or_create_is_idempotent_and_type_safe():
+    reg = Registry()
+    a = reg.counter("x_total", "help", ("l",))
+    b = reg.counter("x_total", "other help", ("l",))
+    assert a is b
+    with pytest.raises(ValueError):
+        reg.gauge("x_total")  # same name, different type
+    with pytest.raises(ValueError):
+        reg.counter("x_total", "", ("other",))  # different label set
+
+
+# --- Prometheus text rendering ---
+
+
+def test_render_escapes_label_values():
+    reg = Registry()
+    c = reg.counter("esc_total", "", ("model",))
+    c.inc(model='a"b\\c\nd')
+    out = reg.render()
+    assert 'esc_total{model="a\\"b\\\\c\\nd"} 1' in out
+
+
+def test_render_label_ordering_is_declaration_order():
+    reg = Registry()
+    c = reg.counter("ord_total", "", ("zeta", "alpha"))
+    c.inc(alpha="1", zeta="2")
+    # declared order (zeta first), NOT alphabetical
+    assert 'ord_total{zeta="2",alpha="1"} 1' in reg.render()
+
+
+def test_render_help_and_type_lines():
+    reg = Registry()
+    reg.counter("a_total", "counts a\nthings").inc()
+    reg.gauge("b").set(1)
+    out = reg.render()
+    assert "# HELP a_total counts a\\nthings" in out
+    assert "# TYPE a_total counter" in out
+    assert "# TYPE b gauge" in out
+    # histograms put le LAST, after the declared labels
+    h = reg.histogram("h_seconds", "", ("stage",), buckets=(1.0,))
+    h.observe(0.5, stage="s")
+    assert 'h_seconds_bucket{stage="s",le="1"} 1' in reg.render()
+
+
+# --- spans / traces ---
+
+
+def test_span_records_histogram_and_timings_dict():
+    reg = Registry()
+    timings = {}
+    with Span("denoise", timings, key="denoise_decode_s", registry=reg):
+        time.sleep(0.01)
+    h = reg.get(STAGE_METRIC)
+    assert h.count(stage="denoise") == 1
+    assert timings["denoise_decode_s"] >= 0.01
+    assert h.sum(stage="denoise") >= 0.01
+
+
+def test_span_records_on_exception():
+    reg = Registry()
+    with pytest.raises(RuntimeError):
+        with Span("compile", registry=reg):
+            raise RuntimeError("trace failed")
+    assert reg.get(STAGE_METRIC).count(stage="compile") == 1
+
+
+def test_trace_job_nested_stages_share_timings():
+    reg = Registry()
+    with trace_job("job-42", registry=reg) as trace:
+        with trace.stage("outer"):
+            with trace.stage("inner"):
+                time.sleep(0.002)
+        trace.record("queue_wait", 1.25)
+    h = reg.get(STAGE_METRIC)
+    assert h.label_values("stage") == ["inner", "outer", "queue_wait"]
+    # nesting: outer wall clock includes inner's
+    assert trace.timings["outer_s"] >= trace.timings["inner_s"]
+    assert trace.timings["queue_wait_s"] == 1.25
+
+
+def test_trace_job_pins_current_job_id():
+    from chiaswarm_tpu.telemetry import current_job_id
+
+    assert current_job_id.get() is None
+    with trace_job("job-7"):
+        assert current_job_id.get() == "job-7"
+    assert current_job_id.get() is None
+
+
+# --- HTTP endpoints (aiohttp.test_utils) ---
+
+
+def test_metrics_and_healthz_endpoints():
+    from aiohttp.test_utils import TestClient, TestServer
+
+    reg = Registry()
+    reg.counter("swarm_jobs_completed_total", "", ("outcome",)).inc(
+        outcome="ok")
+
+    health = {
+        "last_poll_age_s": 2.5,
+        "resident_models": ["test/tiny-sd"],
+        "slices": [{"slice_id": 0, "busy": False}],
+    }
+
+    async def scenario():
+        app = build_metrics_app(reg, health=lambda: dict(health))
+        client = TestClient(TestServer(app))
+        await client.start_server()
+        try:
+            resp = await client.get("/metrics")
+            assert resp.status == 200
+            assert "text/plain" in resp.headers["Content-Type"]
+            body = await resp.text()
+            assert 'swarm_jobs_completed_total{outcome="ok"} 1' in body
+
+            resp = await client.get("/healthz")
+            assert resp.status == 200
+            payload = await resp.json()
+            assert payload["status"] == "ok"
+            assert payload["last_poll_age_s"] == 2.5
+            assert payload["resident_models"] == ["test/tiny-sd"]
+            assert payload["slices"][0]["busy"] is False
+        finally:
+            await client.close()
+
+    asyncio.run(scenario())
+
+
+def test_healthz_degrades_to_503():
+    from aiohttp.test_utils import TestClient, TestServer
+
+    async def scenario():
+        app = build_metrics_app(
+            Registry(), health=lambda: {"status": "stale"})
+        client = TestClient(TestServer(app))
+        await client.start_server()
+        try:
+            resp = await client.get("/healthz")
+            assert resp.status == 503
+            assert (await resp.json())["status"] == "stale"
+        finally:
+            await client.close()
+
+    asyncio.run(scenario())
+
+    async def broken():
+        app = build_metrics_app(
+            Registry(), health=lambda: 1 / 0)
+        client = TestClient(TestServer(app))
+        await client.start_server()
+        try:
+            resp = await client.get("/healthz")
+            assert resp.status == 503
+            assert "ZeroDivisionError" in (await resp.json())["error"]
+        finally:
+            await client.close()
+
+    asyncio.run(broken())
+
+
+# --- JSON log formatter (log_setup satellite) ---
+
+
+def _record(msg="hello", **extra):
+    record = logging.LogRecord(
+        "chiaswarm_tpu.worker", logging.INFO, __file__, 1, msg, (), None)
+    for k, v in extra.items():
+        setattr(record, k, v)
+    return record
+
+
+def test_json_formatter_carries_job_id_from_trace():
+    from chiaswarm_tpu.log_setup import JsonFormatter
+
+    fmt = JsonFormatter()
+    with trace_job("job-99"):
+        payload = json.loads(fmt.format(_record("working")))
+    assert payload["message"] == "working"
+    assert payload["job_id"] == "job-99"
+    assert payload["level"] == "INFO"
+    assert payload["logger"] == "chiaswarm_tpu.worker"
+
+    # explicit extra beats the contextvar; no trace -> no job_id key
+    payload = json.loads(fmt.format(_record("x", job_id="override")))
+    assert payload["job_id"] == "override"
+    payload = json.loads(fmt.format(_record("y")))
+    assert "job_id" not in payload
+
+
+def test_setup_logging_json_format(tmp_path):
+    from chiaswarm_tpu.log_setup import setup_logging
+
+    root = logging.getLogger()
+    before = list(root.handlers)
+    setup_logging(tmp_path / "w.log", "INFO", log_format="json")
+    try:
+        with trace_job("job-json"):
+            logging.getLogger("t.json").info("structured %s", "line")
+        handler = [h for h in root.handlers if h not in before][0]
+        handler.flush()
+        lines = (tmp_path / "w.log").read_text().strip().splitlines()
+        payload = json.loads(lines[-1])
+        assert payload["message"] == "structured line"
+        assert payload["job_id"] == "job-json"
+    finally:
+        for h in list(root.handlers):
+            if h not in before:
+                root.removeHandler(h)
+                h.close()

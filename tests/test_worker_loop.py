@@ -319,3 +319,94 @@ def test_degraded_preprocessor_flag_in_envelope(sdaas_root):
     )
     cfg = results[0]["pipeline_config"]
     assert cfg["degraded_preprocessors"] == ["mlsd"]
+
+
+def test_job_stage_spans_recorded_end_to_end(sdaas_root):
+    """Telemetry acceptance: after a real (tiny) txt2img job runs the full
+    poll -> coalesce -> denoise -> decode -> submit path, the process-wide
+    `swarm_job_stage_seconds` histogram covers every lifecycle stage, the
+    completion counter moved, and the envelope's timings carry the same
+    span-sourced keys."""
+    from chiaswarm_tpu import telemetry
+    from chiaswarm_tpu.telemetry import STAGE_METRIC
+
+    stages = telemetry.REGISTRY.get(STAGE_METRIC) or telemetry.histogram(
+        STAGE_METRIC, "", ("stage",))
+    completed = telemetry.REGISTRY.get("swarm_jobs_completed_total")
+    required = ("queue_wait", "compile", "denoise", "decode", "submit")
+    before = {s: stages.count(stage=s) for s in required}
+    ok_before = completed.value(outcome="ok") if completed else 0
+
+    hive, results = run_jobs(
+        [
+            {
+                "id": "job-tel",
+                "workflow": "txt2img",
+                "model_name": "stabilityai/stable-diffusion-2-1",
+                "prompt": "a telemetry probe",
+                "height": 64,
+                "width": 64,
+                "num_inference_steps": 2,
+                "parameters": {"test_tiny_model": True},
+            }
+        ],
+        sdaas_root,
+    )
+    [result] = results
+    assert not result.get("fatal_error")
+
+    # every required stage observed at least once more than before the job
+    stages = telemetry.REGISTRY.get(STAGE_METRIC)
+    for s in required:
+        assert stages.count(stage=s) > before[s], f"stage {s} not recorded"
+    completed = telemetry.REGISTRY.get("swarm_jobs_completed_total")
+    assert completed.value(outcome="ok") > ok_before
+
+    # the envelope carries the span-sourced per-stage timings (the hive's
+    # view and the /metrics view come from the same measurements)
+    timings = result["pipeline_config"]["timings"]
+    for key in ("queue_wait_s", "trace_s", "denoise_decode_s", "decode_s"):
+        assert key in timings, timings
+    # capability heartbeat folded in the live-load snapshot
+    req = hive.work_requests[0]
+    assert "jobs_in_flight" in req and "busy_slices" in req
+
+
+def test_submit_result_retries_transient_5xx(sdaas_root):
+    """Satellite: one 502 from POST /results must not cost the artifacts —
+    the client retries once after a short backoff and counts the retry."""
+    from chiaswarm_tpu import hive as hive_mod
+    from chiaswarm_tpu.hive import _RETRIES
+
+    retries_before = _RETRIES.value(endpoint="results")
+    original_backoff = hive_mod.SUBMIT_RETRY_BACKOFF_S
+    hive_mod.SUBMIT_RETRY_BACKOFF_S = 0.01
+    try:
+
+        async def scenario():
+            hive = await FakeHive().start()
+            hive.fail_results_times = 1
+            hive.add_job({"id": "job-r", "workflow": "echo",
+                          "model_name": "none", "prompt": "x"})
+            settings = Settings(sdaas_token="t", worker_name="w")
+            w = Worker(
+                settings=settings,
+                allocator=SliceAllocator(chips_per_job=4),
+                hive_uri=hive.uri,
+            )
+            runner = asyncio.create_task(w.run())
+            try:
+                results = await hive.wait_for_results(1, timeout=240.0)
+            finally:
+                w.stop()
+                await asyncio.wait_for(runner, 10)
+                await hive.stop()
+            return hive, results
+
+        hive, results = asyncio.run(scenario())
+    finally:
+        hive_mod.SUBMIT_RETRY_BACKOFF_S = original_backoff
+
+    assert results[0]["id"] == "job-r"
+    assert hive.result_attempts == 2  # 502 then success, ONE worker pass
+    assert _RETRIES.value(endpoint="results") == retries_before + 1

@@ -1,0 +1,210 @@
+#!/usr/bin/env python
+"""Fetch a worker's /metrics and print the per-stage latency table.
+
+Two modes:
+
+  python tools/metrics_dump.py --url http://127.0.0.1:8061
+      Scrape a LIVE worker's telemetry endpoint (Settings.metrics_port /
+      CHIASWARM_METRICS_PORT) and print its stage breakdown + health.
+
+  python tools/metrics_dump.py
+      No worker required: run one hermetic tiny-model txt2img smoke job
+      IN PROCESS through the real serving path (format_args -> ChipSet ->
+      jitted denoise+decode), then print the stage table from the
+      process-local registry. Uses the ambient JAX backend (set
+      JAX_PLATFORMS=cpu to keep it off a TPU relay).
+
+The table is computed from the `swarm_job_stage_seconds` histogram series
+(count / mean / approx p50 / p90 from the cumulative buckets), so what it
+prints is exactly what a Prometheus scrape would see.
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import json
+import re
+import sys
+import urllib.request
+
+STAGE_METRIC = "swarm_job_stage_seconds"
+
+_SAMPLE_RE = re.compile(
+    r"^(?P<name>[a-zA-Z_:][a-zA-Z0-9_:]*)"
+    r"(?:\{(?P<labels>.*)\})?\s+(?P<value>[^\s]+)$"
+)
+_LABEL_RE = re.compile(r'(\w+)="((?:[^"\\]|\\.)*)"')
+
+
+_ESCAPES = {'"': '"', "n": "\n", "\\": "\\"}
+
+
+def _unescape(v: str) -> str:
+    # single pass: ordered str.replace would corrupt values where a
+    # doubled backslash precedes an 'n' (e.g. 'C:\\new')
+    return re.sub(r"\\(.)", lambda m: _ESCAPES.get(m.group(1), m.group(0)),
+                  v)
+
+
+def parse_metrics(text: str) -> list[tuple[str, dict, float]]:
+    """Prometheus text -> [(metric_name, labels, value)]."""
+    samples = []
+    for line in text.splitlines():
+        line = line.strip()
+        if not line or line.startswith("#"):
+            continue
+        m = _SAMPLE_RE.match(line)
+        if not m:
+            continue
+        labels = {
+            k: _unescape(v) for k, v in _LABEL_RE.findall(m.group("labels") or "")
+        }
+        raw = m.group("value")
+        value = float("inf") if raw == "+Inf" else float(raw)
+        samples.append((m.group("name"), labels, value))
+    return samples
+
+
+def _quantile_from_buckets(buckets: list[tuple[float, float]], count: float,
+                           q: float) -> float | None:
+    """Approximate quantile from cumulative (le, count) pairs — the bucket
+    upper bound where the cumulative count first crosses q*count (what
+    Prometheus' histogram_quantile reports, minus interpolation)."""
+    if count <= 0:
+        return None
+    target = q * count
+    for le, cum in sorted(buckets, key=lambda b: b[0]):
+        if cum >= target:
+            return le
+    return None
+
+
+def stage_rows(samples: list[tuple[str, dict, float]]) -> list[dict]:
+    """Aggregate the stage histogram series into per-stage table rows."""
+    by_stage: dict[str, dict] = {}
+    for name, labels, value in samples:
+        if not name.startswith(STAGE_METRIC):
+            continue
+        stage = labels.get("stage", "?")
+        s = by_stage.setdefault(stage, {"buckets": [], "sum": 0.0, "count": 0.0})
+        if name == f"{STAGE_METRIC}_bucket":
+            le = labels.get("le", "+Inf")
+            s["buckets"].append(
+                (float("inf") if le == "+Inf" else float(le), value))
+        elif name == f"{STAGE_METRIC}_sum":
+            s["sum"] = value
+        elif name == f"{STAGE_METRIC}_count":
+            s["count"] = value
+    rows = []
+    for stage, s in sorted(by_stage.items()):
+        n = s["count"]
+        rows.append({
+            "stage": stage,
+            "count": int(n),
+            "mean_s": (s["sum"] / n) if n else None,
+            "p50_le_s": _quantile_from_buckets(s["buckets"], n, 0.5),
+            "p90_le_s": _quantile_from_buckets(s["buckets"], n, 0.9),
+            "total_s": s["sum"],
+        })
+    return rows
+
+
+def render_table(rows: list[dict]) -> str:
+    if not rows:
+        return "(no job stages recorded yet — has a job run?)"
+
+    def fmt(v):
+        if v is None:
+            return "-"
+        if v == float("inf"):
+            return "+Inf"
+        return f"{v:.3f}"
+
+    header = f"{'stage':<14} {'count':>6} {'mean_s':>9} " \
+             f"{'p50<=s':>9} {'p90<=s':>9} {'total_s':>9}"
+    lines = [header, "-" * len(header)]
+    for r in rows:
+        lines.append(
+            f"{r['stage']:<14} {r['count']:>6} {fmt(r['mean_s']):>9} "
+            f"{fmt(r['p50_le_s']):>9} {fmt(r['p90_le_s']):>9} "
+            f"{fmt(r['total_s']):>9}"
+        )
+    return "\n".join(lines)
+
+
+def fetch(url: str, path: str) -> str:
+    with urllib.request.urlopen(f"{url.rstrip('/')}{path}", timeout=10) as r:
+        return r.read().decode("utf-8")
+
+
+async def _run_smoke_job() -> None:
+    """One tiny-model txt2img job through the REAL worker path (the same
+    code a hive job takes minus the HTTP hop), populating the stage spans."""
+    from chiaswarm_tpu.chips.allocator import SliceAllocator
+    from chiaswarm_tpu.job_arguments import format_args
+    from chiaswarm_tpu.settings import load_settings
+
+    job = {
+        "id": "metrics-dump-smoke",
+        "workflow": "txt2img",
+        "model_name": "stabilityai/stable-diffusion-2-1",
+        "prompt": "a red cube on a table",
+        "height": 64,
+        "width": 64,
+        "num_inference_steps": 2,
+        "parameters": {"test_tiny_model": True},
+    }
+    settings = load_settings()
+    allocator = SliceAllocator(chips_per_job=0)
+    chipset = await allocator.acquire()
+    try:
+        func, kwargs = await format_args(job, settings, chipset.identifier())
+        kwargs.pop("id", None)
+        chipset(func, **kwargs)
+    finally:
+        allocator.release(chipset)
+
+
+def run_inprocess() -> str:
+    """Run the smoke job and return the process-local registry rendering."""
+    from chiaswarm_tpu.telemetry import REGISTRY
+
+    asyncio.run(_run_smoke_job())
+    return REGISTRY.render()
+
+
+def main(argv: list[str] | None = None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="metrics_dump", description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter)
+    parser.add_argument(
+        "--url", default=None,
+        help="live worker telemetry base URL (e.g. http://127.0.0.1:8061); "
+             "omit to run one in-process smoke job instead")
+    parser.add_argument(
+        "--raw", action="store_true",
+        help="also dump the raw /metrics exposition text")
+    args = parser.parse_args(argv)
+
+    if args.url:
+        text = fetch(args.url, "/metrics")
+        try:
+            health = json.loads(fetch(args.url, "/healthz"))
+            print(f"healthz: {json.dumps(health, indent=1)}")
+        except Exception as e:  # the table is still worth printing
+            print(f"healthz unavailable: {e}")
+    else:
+        print("no --url given: running one in-process tiny smoke job "
+              "(this compiles a tiny pipeline; ~a minute on CPU)")
+        text = run_inprocess()
+
+    if args.raw:
+        print(text)
+    rows = stage_rows(parse_metrics(text))
+    print(render_table(rows))
+    return 0 if rows else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())
