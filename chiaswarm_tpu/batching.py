@@ -214,6 +214,7 @@ class BatchScheduler:
         self._pending: dict[tuple, dict] = {}
         self._outstanding = 0
         self._ready_jobs = 0  # jobs released to _ready, not yet fetched
+        self._closed = False  # drain mode: nothing lingers anymore
 
     # --- queue-compatible surface for the worker loop ---
 
@@ -260,7 +261,7 @@ class BatchScheduler:
 
     async def put(self, job: dict) -> None:
         self._outstanding += 1
-        if self.max_coalesce <= 1 or self.linger_s <= 0:
+        if self._closed or self.max_coalesce <= 1 or self.linger_s <= 0:
             self._release_solo(job)
             return
         key = coalesce_key(job)
@@ -325,3 +326,10 @@ class BatchScheduler:
         """Release every lingering group immediately (shutdown/tests)."""
         for key in list(self._pending):
             self._flush(key, reason="shutdown")
+
+    def close(self) -> None:
+        """Drain mode (worker stop(drain=True)): release every lingering
+        group now and dispatch any straggler put() immediately — no job
+        may sit in a linger window while the process is trying to exit."""
+        self._closed = True
+        self.flush_all()

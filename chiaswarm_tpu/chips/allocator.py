@@ -45,11 +45,24 @@ class SliceAllocator:
             for i in range(0, len(devices), n)
         ]
         self._free: asyncio.Queue[ChipSet] = asyncio.Queue()
+        # membership mirrors of the free queue and of handed-out slices:
+        # every path that could re-enqueue a slice (release after a job,
+        # reinstate after a quarantine probe) funnels through _put_free,
+        # so no interleaving of watchdog and worker can double-free one
+        self._free_ids: set[int] = set()
+        self._leased: set[int] = set()
+        self._quarantined: set[int] = set()
         for s in self.slices:
-            self._free.put_nowait(s)
+            self._put_free(s)
 
     def __len__(self) -> int:
         return len(self.slices)
+
+    def _put_free(self, chipset: ChipSet) -> None:
+        if chipset.slice_id in self._free_ids:
+            return
+        self._free_ids.add(chipset.slice_id)
+        self._free.put_nowait(chipset)
 
     @property
     def free_count(self) -> int:
@@ -59,21 +72,61 @@ class SliceAllocator:
         return not self._free.empty()
 
     async def acquire(self) -> ChipSet:
-        return await self._free.get()
+        chipset = await self._free.get()
+        self._free_ids.discard(chipset.slice_id)
+        self._leased.add(chipset.slice_id)
+        return chipset
 
     def release(self, chipset: ChipSet) -> None:
-        self._free.put_nowait(chipset)
+        self._leased.discard(chipset.slice_id)
+        if chipset.slice_id in self._quarantined:
+            # the watchdog took this slice out of service mid-job; only a
+            # passed smoke probe (reinstate) returns it to the free queue
+            return
+        self._put_free(chipset)
+
+    # --- quarantine (worker watchdog) ---
+
+    def quarantine(self, chipset: ChipSet) -> None:
+        """Take a slice out of service: it will not be handed to jobs and
+        release() becomes a no-op for it. Idempotent."""
+        self._quarantined.add(chipset.slice_id)
+
+    def reinstate(self, chipset: ChipSet) -> None:
+        """Clear a slice's quarantine (smoke probe passed). If a worker
+        still holds the slice for the rest of its batch, only the flag
+        clears — that worker's release() re-enqueues it; otherwise it goes
+        back to the free queue here. No-op when never quarantined."""
+        if chipset.slice_id not in self._quarantined:
+            return
+        self._quarantined.discard(chipset.slice_id)
+        if chipset.slice_id in self._leased:
+            return
+        self._put_free(chipset)
+
+    def is_quarantined(self, chipset: ChipSet) -> bool:
+        return chipset.slice_id in self._quarantined
+
+    @property
+    def quarantined_count(self) -> int:
+        return len(self._quarantined)
 
     def capabilities(self) -> dict:
-        """Pool-wide capability advertisement for /work polling."""
+        """Pool-wide capability advertisement for /work polling.
+
+        Quarantined slices are excluded — advertised capacity shrinks
+        while a slice is out of service, so a capability-aware hive stops
+        placing work this worker cannot take."""
         per_slice = self.slices[0].capabilities()
-        total_chips = sum(s.chip_count() for s in self.slices)
+        active = [s for s in self.slices
+                  if s.slice_id not in self._quarantined]
+        total_chips = sum(s.chip_count() for s in active)
         return {
             "memory": per_slice["memory"],
             "gpu": per_slice["gpu"],
             "chips": total_chips,
-            "hbm_gb": sum(s.hbm_bytes() for s in self.slices) >> 30,
+            "hbm_gb": sum(s.hbm_bytes() for s in active) >> 30,
             "topology": f"{self.slices[0].platform}x{total_chips}"
-            + (f"({len(self.slices)}x{per_slice['chips']})" if len(self.slices) > 1 else ""),
-            "slices": len(self.slices),
+            + (f"({len(active)}x{per_slice['chips']})" if len(active) > 1 else ""),
+            "slices": len(active),
         }

@@ -18,7 +18,7 @@ import time
 import jax
 from jax.sharding import Mesh
 
-from .. import telemetry
+from .. import faults, telemetry
 
 logger = logging.getLogger(__name__)
 
@@ -125,6 +125,26 @@ class ChipSet:
             "topology": f"{self.platform}x{self.chip_count()}",
         }
 
+    def smoke_probe(self) -> bool:
+        """Quarantine-recovery probe (worker watchdog): one tiny matmul on
+        every chip of the slice, synchronously. True = the slice computes
+        and may return to the allocator; False (busy, or any device error)
+        = it stays quarantined."""
+        if not self._mutex.acquire(blocking=False):
+            return False
+        try:
+            import jax.numpy as jnp
+
+            for d in self.devices:
+                x = jax.device_put(jnp.eye(8, dtype=jnp.float32), d)
+                jnp.matmul(x, x).block_until_ready()
+            return True
+        except Exception:
+            logger.exception("smoke probe failed on %s", self.identifier())
+            return False
+        finally:
+            self._mutex.release()
+
     # --- execution ---
 
     def mesh(self) -> Mesh:
@@ -145,6 +165,9 @@ class ChipSet:
             logger.error("ChipSet %s is busy but got invoked.", self.identifier())
             raise Exception("busy")
         try:
+            # fault-injection point: a hung compile/denoise holds the busy
+            # lock exactly like the real failure would (faults.py)
+            faults.hang("hang_denoise")
             model_name = kwargs.pop("model_name")
             seed = kwargs.pop("seed", None)
             if seed is None:
@@ -183,6 +206,12 @@ class ChipSet:
             logger.error("ChipSet %s is busy but got invoked.", self.identifier())
             raise Exception("busy")
         try:
+            # fault-injection points: hang (watchdog path) and a coalesced
+            # OOM raised before any request kwarg is mutated, so the
+            # worker's per-job fallback reruns the group unchanged
+            faults.hang("hang_denoise")
+            faults.fire("oom_batched", exc=RuntimeError(
+                "RESOURCE_EXHAUSTED: injected OOM (fault oom_batched)"))
             seeds = []
             for kw in requests:
                 seed = kw.pop("seed", None)

@@ -213,6 +213,14 @@ def fit_batch(chipset, model_name: str, batch: int, size: int,
     return min(batch, int(free / per_image) * data)
 
 
+def _pow2_floor(n: int) -> int:
+    """Largest power of two <= n (n >= 1)."""
+    p = 1
+    while p * 2 <= n:
+        p *= 2
+    return p
+
+
 def coalesce_rows_limit(chipset, model_name: str, size: int,
                         width: int | None = None,
                         ceiling: int = 256) -> int:
@@ -223,12 +231,19 @@ def coalesce_rows_limit(chipset, model_name: str, size: int,
     path caps groups, it never rejects one (each member job passed the
     single-job gate on its own). Non-accelerator slices return the
     ceiling: the host heap is not HBM.
+
+    The budget is a power-of-two BUCKET boundary, not the raw fit:
+    run_batched pads the admitted row count up to pad_bucket(rows) AFTER
+    admission, so a raw budget of (say) 5 would admit a 5-row group that
+    executes an 8-row padded pass and OOMs before the per-job fallback
+    (the ROADMAP pad-vs-admission item). Capping at pow2_floor(fit) makes
+    every admissible group's PADDED pass fit too.
     """
     allowed = fit_batch(chipset, model_name, ceiling, size, width)
     # a 0 here means the MODEL doesn't fit — that's the single-job gate's
     # fatal error to raise with its remediation text, not a grouping
     # concern; never let the probe block grouping below one job
-    return max(allowed, 1)
+    return _pow2_floor(allowed) if allowed >= 1 else 1
 
 
 def coalesced_fit(chipset, model_name: str, total_rows: int, size: int,
@@ -237,8 +252,18 @@ def coalesced_fit(chipset, model_name: str, total_rows: int, size: int,
     row budget for ONE denoise pass (the executor splits the request list
     into passes of at most this many rows). Raises only when even one
     image cannot fit — the same fatal contract as check_capacity, which
-    each member job already cleared individually."""
-    return check_capacity(chipset, model_name, total_rows, size, width)
+    each member job already cleared individually.
+
+    Like coalesce_rows_limit, the budget accounts for padding: a pass of
+    r rows executes as pad_bucket(r) rows, so the per-pass budget is the
+    largest power of two within the raw fit — any chunk at or under it
+    pads to at most the budget itself."""
+    total_rows = max(int(total_rows), 1)
+    # probe the slice's RAW capacity (independent of the request size so
+    # the pow2 budget is a property of the slice, not of this group)
+    fit = check_capacity(
+        chipset, model_name, max(total_rows, 256), size, width)
+    return min(total_rows, _pow2_floor(fit))
 
 
 def check_capacity(chipset, model_name: str, batch: int, size: int,

@@ -27,7 +27,7 @@ from typing import Any
 
 import aiohttp
 
-from . import USER_AGENT, __version__, telemetry
+from . import USER_AGENT, __version__, faults, telemetry
 
 logger = logging.getLogger(__name__)
 
@@ -55,7 +55,16 @@ _RETRIES = telemetry.counter(
 
 
 class HiveError(Exception):
-    """Raised when the hive returns a non-retryable error response."""
+    """Raised when the hive keeps refusing a request.
+
+    `permanent` is True when the final failure was a non-transient client
+    error (4xx) — the outbox parks such envelopes instead of retrying a
+    refusal the hive will repeat forever.
+    """
+
+    def __init__(self, message: str, permanent: bool = False):
+        super().__init__(message)
+        self.permanent = permanent
 
 
 class HiveClient:
@@ -129,6 +138,13 @@ class HiveClient:
         timeout = aiohttp.ClientTimeout(total=SUBMIT_TIMEOUT_S)
         t0 = time.perf_counter()
         try:
+            # fault-injection point: the POST never leaves the worker
+            # (faults.py "drop_submit"); raised as the connection error a
+            # real network drop would produce so classification is shared
+            faults.fire(
+                "drop_submit",
+                exc=aiohttp.ClientConnectionError("injected fault: drop_submit"),
+            )
             async with session.post(
                 f"{self.hive_uri}/results",
                 data=json.dumps(result),
@@ -173,7 +189,8 @@ class HiveClient:
             )
             await asyncio.sleep(SUBMIT_RETRY_BACKOFF_S)
         raise HiveError(
-            f"submit_result failed for job {result.get('id')}: {last_exc}"
+            f"submit_result failed for job {result.get('id')}: {last_exc}",
+            permanent=not transient,
         ) from last_exc
 
     async def get_models(self) -> list[dict]:

@@ -77,6 +77,28 @@ class Settings:
     # log line format: "plain" (reference parity) | "json" (structured
     # lines carrying the active job_id — log_setup.JsonFormatter)
     log_format: str = "plain"
+    # --- fault tolerance (outbox.py / worker watchdog / faults.py) ---
+    # per-job execution deadline enforced by the slice watchdog; 0 disables.
+    # On expiry the job returns the transient-error envelope and the slice
+    # is quarantined until it passes a smoke probe
+    job_deadline_s: float = 900.0
+    # deadline multiplier when the job's model is not yet resident (first
+    # compile of a big program legitimately takes minutes)
+    job_deadline_compile_scale: float = 4.0
+    # how long the quarantine probe waits for a wedged slice to come back
+    # before writing it off (capacity stays shrunk if it never does)
+    quarantine_probe_grace_s: float = 30.0
+    # stop(drain=True)/SIGTERM: how long in-flight slices + the outbox get
+    # to flush before the worker exits anyway (spooled envelopes survive)
+    drain_deadline_s: float = 120.0
+    # durable result spool directory (relative to $SDAAS_ROOT)
+    outbox_dir: str = "outbox"
+    # spooled-envelope count at which /healthz turns degraded (0 = never);
+    # spooling itself never stops — saturation is a signal, not a limit
+    outbox_max_entries: int = 512
+    # deterministic fault-injection spec (faults.py), e.g.
+    # "drop_submit=3,hang_denoise=1"; empty = no faults armed
+    fault_injection: str = ""
 
     @classmethod
     def field_names(cls) -> tuple[str, ...]:
@@ -100,6 +122,11 @@ _ENV_OVERRIDES = {
     "CHIASWARM_METRICS_PORT": "metrics_port",
     "CHIASWARM_METRICS_HOST": "metrics_host",
     "CHIASWARM_LOG_FORMAT": "log_format",
+    "CHIASWARM_JOB_DEADLINE_S": "job_deadline_s",
+    "CHIASWARM_DRAIN_DEADLINE_S": "drain_deadline_s",
+    "CHIASWARM_OUTBOX_DIR": "outbox_dir",
+    "CHIASWARM_OUTBOX_MAX_ENTRIES": "outbox_max_entries",
+    "CHIASWARM_FAULTS": "fault_injection",
 }
 
 

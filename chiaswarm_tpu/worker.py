@@ -22,21 +22,34 @@ Differences by design:
   denoise+decode pass per slice, each job keeping its own id, seed, and
   result envelope. Anything the batched program can't express dispatches
   solo, exactly as before.
+- The job lifecycle is fault-tolerant end to end: result envelopes go
+  through a durable disk outbox (outbox.py — spooled before upload,
+  retried with backoff, redelivered after a restart, unlinked only on
+  hive ACK), a per-pass watchdog deadline quarantines-and-probes a slice
+  whose execution hangs instead of pinning it forever, SIGTERM drains
+  (finish in-flight slices, flush the outbox) instead of cancelling
+  mid-denoise, and every failure path is deterministically injectable
+  via faults.py.
 """
 
 from __future__ import annotations
 
 import asyncio
 import logging
+import random
+import signal
 import time
 from concurrent.futures import ThreadPoolExecutor
 
-from . import __version__, telemetry
+from . import __version__, faults, telemetry
+from . import outbox as outbox_mod
 from .batching import BatchScheduler
 from .chips.allocator import SliceAllocator
-from .hive import HiveClient
+from .faults import FaultInjected
+from .hive import HiveClient, HiveError
 from .job_arguments import format_args
 from .log_setup import setup_logging
+from .outbox import Outbox, OutboxEntry
 from .post_processors.output_processor import (
     exception_image,
     exception_message,
@@ -49,6 +62,16 @@ logger = logging.getLogger(__name__)
 
 POLL_SECONDS = 11
 ERROR_BACKOFF_SECONDS = 121
+
+
+def _next_backoff(prev: float) -> float:
+    """Poll-error backoff with decorrelated jitter (sleep ~ U(cadence,
+    3*prev), capped): repeated failures walk up toward the cap instead of
+    hammering the hive at the 11 s cadence, and a fleet that lost the
+    hive together does not re-poll in lockstep when it returns."""
+    base = float(POLL_SECONDS)
+    prev = max(float(prev), base)
+    return min(float(ERROR_BACKOFF_SECONDS), random.uniform(base, prev * 3))
 
 _JOBS_POLLED = telemetry.counter(
     "swarm_jobs_polled_total", "Jobs received from hive /work polls")
@@ -74,6 +97,21 @@ _QUEUE_DEPTH = telemetry.gauge(
     "Jobs per internal queue (lingering = open coalescing groups, "
     "ready = released to slice workers, results = awaiting upload)",
     ("queue",),
+)
+_WATCHDOG_EXPIRED = telemetry.counter(
+    "swarm_watchdog_expired_total",
+    "Jobs whose execution exceeded the slice watchdog deadline",
+    ("kind",),
+)
+_WATCHDOG_PROBES = telemetry.counter(
+    "swarm_watchdog_probe_total",
+    "Quarantined-slice smoke probes, by outcome (ok | failed | wedged)",
+    ("outcome",),
+)
+_SLICE_STATE = telemetry.gauge(
+    "swarm_slice_state",
+    "Chip slices by lifecycle state (active | quarantined)",
+    ("state",),
 )
 
 
@@ -110,38 +148,108 @@ class Worker:
             rows_limit=self._coalesce_rows_limit,
         )
         self.result_queue: asyncio.Queue = asyncio.Queue()
+        # durable result spool: envelopes land here BEFORE the first
+        # upload attempt and are unlinked only on hive ACK (outbox.py)
+        self.outbox = Outbox(
+            resolve_path(getattr(self.settings, "outbox_dir", "outbox")),
+            max_entries=int(getattr(self.settings, "outbox_max_entries", 512)),
+        )
+        if getattr(self.settings, "fault_injection", ""):
+            faults.configure(self.settings.fault_injection)
         self._executor = ThreadPoolExecutor(
             max_workers=len(self.allocator), thread_name_prefix="chipslice"
         )
         self._stopping = asyncio.Event()
+        self._draining = asyncio.Event()
+        self._probe_tasks: set[asyncio.Task] = set()
+        self._delivering = 0  # entries popped from result_queue, not yet acked
         self._metrics_runner = None
         # monotonic time of the last SUCCESSFUL hive poll (healthz age)
         self._last_poll_monotonic: float | None = None
+        self._poll_backoff_s = float(POLL_SECONDS)
 
     # --- lifecycle ---
 
     async def run(self) -> None:
         self.startup()
         await self._start_metrics_server()
+        loop = asyncio.get_running_loop()
+        sigterm_installed = False
+        try:
+            # rolling restarts send SIGTERM: drain instead of dropping work
+            loop.add_signal_handler(signal.SIGTERM, self.stop, True)
+            sigterm_installed = True
+        except (NotImplementedError, RuntimeError, ValueError):
+            pass  # non-unix / nested loop: stop(drain=True) still works
+        # redeliver envelopes a previous process spooled but never got
+        # ACKed (outbox contract: at-least-once across restarts)
+        recovered = self.outbox.recover()
+        for entry in recovered:
+            self.result_queue.put_nowait(entry)
+        if recovered:
+            logger.warning(
+                "outbox: redelivering %d spooled result(s) from a previous run",
+                len(recovered))
         tasks = [
             asyncio.create_task(self.slice_worker(), name=f"slice_worker_{i}")
             for i in range(len(self.allocator))
         ]
         tasks.append(asyncio.create_task(self.result_worker(), name="result_worker"))
         tasks.append(asyncio.create_task(self.poll_loop(), name="poll_loop"))
+        tasks.append(asyncio.create_task(self._drain_watcher(), name="drain_watcher"))
         try:
             await self._stopping.wait()
         finally:
-            for t in tasks:
+            if sigterm_installed:
+                try:
+                    loop.remove_signal_handler(signal.SIGTERM)
+                except (NotImplementedError, RuntimeError, ValueError):
+                    pass
+            for t in [*tasks, *self._probe_tasks]:
                 t.cancel()
-            await asyncio.gather(*tasks, return_exceptions=True)
+            await asyncio.gather(
+                *tasks, *self._probe_tasks, return_exceptions=True)
             await self.hive.close()
             if self._metrics_runner is not None:
                 await self._metrics_runner.cleanup()
                 self._metrics_runner = None
             self._executor.shutdown(wait=False, cancel_futures=True)
 
-    def stop(self) -> None:
+    def stop(self, drain: bool = False) -> None:
+        """Stop the worker. drain=False (default) cancels immediately —
+        spooled envelopes survive on disk for the next start. drain=True
+        (the SIGTERM path) stops polling, finishes in-flight slices, and
+        flushes the outbox up to Settings.drain_deadline_s first, so a
+        rolling restart loses zero work."""
+        if drain:
+            self._draining.set()
+        else:
+            self._stopping.set()
+
+    async def _drain_watcher(self) -> None:
+        await self._draining.wait()
+        deadline = time.monotonic() + max(
+            float(getattr(self.settings, "drain_deadline_s", 120.0)), 0.0)
+        logger.warning(
+            "drain: polls stopped; flushing %d in-flight job(s) and the outbox",
+            self.batcher.outstanding_jobs)
+        # lingering coalescing groups dispatch now; nothing new lingers
+        self.batcher.close()
+        while time.monotonic() < deadline:
+            # deliverable work = executing jobs + queued/in-flight uploads;
+            # NOT outbox.depth, which also counts parked (permanently
+            # refused) envelopes that only a restart may retry
+            if (self.batcher.outstanding_jobs == 0
+                    and self.result_queue.qsize() == 0
+                    and self._delivering == 0):
+                logger.warning("drain complete: no in-flight work remains")
+                break
+            await asyncio.sleep(0.05)
+        else:
+            logger.error(
+                "drain deadline hit with %d job(s) in flight and %d spooled "
+                "envelope(s); exiting — spooled results redeliver on restart",
+                self.batcher.outstanding_jobs, self.outbox.depth)
         self._stopping.set()
 
     def startup(self) -> None:
@@ -184,24 +292,56 @@ class Worker:
 
     def _health(self) -> dict:
         """/healthz snapshot: is this worker polling, what is resident,
-        which slices are busy."""
+        which slices serve. Reports `degraded` (telemetry.py answers 503)
+        when polling has stalled, a slice is quarantined, or the outbox is
+        saturated — so an orchestrator can act instead of trusting an
+        unconditional "ok"."""
         from .registry import resident_models
 
         age = None
         if self._last_poll_monotonic is not None:
             age = round(time.monotonic() - self._last_poll_monotonic, 1)
+        reasons = []
+        # a stale poll only means trouble when the worker SHOULD be
+        # polling — the loop intentionally pauses while draining, while
+        # every slice is busy, or while the batcher is full, and a worker
+        # mid-denoise must not probe as unhealthy
+        expects_polls = (not self._draining.is_set()
+                         and self.allocator.has_free_slice()
+                         and not self.batcher.full())
+        if expects_polls and age is not None and age > 3 * POLL_SECONDS:
+            reasons.append(
+                f"last successful poll {age:.0f}s ago "
+                f"(cadence {POLL_SECONDS}s)")
+        quarantined = self.allocator.quarantined_count
+        if quarantined:
+            reasons.append(f"{quarantined} slice(s) quarantined")
+        if self.outbox.saturated:
+            reasons.append(
+                f"outbox saturated ({self.outbox.depth} spooled envelopes)")
+        oldest = self.outbox.oldest_age_s()
         return {
-            "status": "ok",
+            "status": "degraded" if reasons else "ok",
+            "degraded_reasons": reasons,
             "worker_version": __version__,
             "last_poll_age_s": age,
+            "draining": self._draining.is_set(),
             "jobs_in_flight": self.batcher.outstanding_jobs,
             "results_pending": self.result_queue.qsize(),
+            "outbox": {
+                "depth": self.outbox.depth,
+                "oldest_age_s": round(oldest, 1) if oldest else 0,
+                "saturated": self.outbox.saturated,
+            },
             "resident_models": resident_models(),
             "slices": [
                 {
                     "slice_id": s.slice_id,
                     "chips": s.chip_count(),
                     "busy": s.busy,
+                    "state": ("quarantined"
+                              if self.allocator.is_quarantined(s)
+                              else "active"),
                 }
                 for s in self.allocator.slices
             ],
@@ -213,6 +353,10 @@ class Worker:
         _QUEUE_DEPTH.set(self.batcher.pending_jobs, queue="lingering")
         _QUEUE_DEPTH.set(self.batcher.ready_jobs, queue="ready")
         _QUEUE_DEPTH.set(self.result_queue.qsize(), queue="results")
+        quarantined = self.allocator.quarantined_count
+        _SLICE_STATE.set(len(self.allocator) - quarantined, state="active")
+        _SLICE_STATE.set(quarantined, state="quarantined")
+        self.outbox.refresh_gauges()
 
     def _start_profiler_server(self) -> None:
         """jax.profiler trace endpoint (SURVEY §5 'tracing/profiling:
@@ -287,7 +431,8 @@ class Worker:
     async def poll_loop(self) -> None:
         sleep_seconds = POLL_SECONDS
         while True:
-            if not self.batcher.full() and self.allocator.has_free_slice():
+            if (not self._draining.is_set() and not self.batcher.full()
+                    and self.allocator.has_free_slice()):
                 try:
                     jobs = await self.hive.ask_for_work(self._capabilities())
                     self._last_poll_monotonic = time.monotonic()
@@ -301,13 +446,18 @@ class Worker:
                         await self.batcher.put(job)
                     sleep_seconds = POLL_SECONDS
                 except asyncio.TimeoutError:
+                    # a timeout IS a poll failure: back off like one (the
+                    # round-6 branch forgot, re-polling a struggling hive
+                    # at the full cadence)
                     logger.warning("hive poll timeout")
                     _POLL_ERRORS.inc()
+                    sleep_seconds = _next_backoff(sleep_seconds)
                 except Exception as e:
                     logger.exception("ask_for_work error")
                     print(f"ask_for_work error {e}")
                     _POLL_ERRORS.inc()
-                    sleep_seconds = ERROR_BACKOFF_SECONDS
+                    sleep_seconds = _next_backoff(sleep_seconds)
+            self._poll_backoff_s = sleep_seconds
             self._update_queue_gauges()
             await asyncio.sleep(sleep_seconds)
 
@@ -351,14 +501,14 @@ class Worker:
                     results = await self.do_batched_work(chipset, prepared)
                     for result in results:
                         self._finish_result(result, queue_wait)
-                        await self.result_queue.put(result)
+                        await self._enqueue_result(result)
                 else:
                     for worker_function, kwargs in prepared:
                         result = await self.do_work(
                             chipset, worker_function, kwargs
                         )
                         self._finish_result(result, queue_wait)
-                        await self.result_queue.put(result)
+                        await self._enqueue_result(result)
             except Exception as e:
                 logger.exception("slice_worker error")
                 print(f"slice_worker {e}")
@@ -405,20 +555,145 @@ class Worker:
             logger.exception("format_args failed for job %s", job.get("id"))
             result = fatal_exception_response(e, job["id"], job)
             self._finish_result(result, {})
-            await self.result_queue.put(result)
+            await self._enqueue_result(result)
         return None, None
+
+    # --- slice watchdog ---
+
+    def _job_deadline(self, model_name) -> float | None:
+        """Execution deadline for one pass; None = watchdog off. A model
+        that is not yet resident gets the first-compile allowance — big
+        programs legitimately take minutes to compile once."""
+        base = float(getattr(self.settings, "job_deadline_s", 0.0) or 0.0)
+        if base <= 0:
+            return None
+        scale = 1.0
+        try:
+            from .registry import resident_models
+
+            if model_name and model_name not in resident_models():
+                scale = max(float(getattr(
+                    self.settings, "job_deadline_compile_scale", 4.0)), 1.0)
+        except Exception:  # residency probe must never block execution
+            pass
+        return base * scale
+
+    def _expire_pass(self, chipset, fut, jobs_meta: list[dict],
+                     deadline: float, kind: str) -> list[dict]:
+        """A pass blew its watchdog deadline: quarantine the slice, hand
+        every member job the existing transient-error envelope (the hive
+        may resubmit elsewhere), and let the wedged thread finish or rot
+        in the background — the probe decides if the slice returns."""
+        _WATCHDOG_EXPIRED.inc(len(jobs_meta), kind=kind)
+        logger.error(
+            "watchdog: %s pass on slice %s exceeded its %.1fs deadline "
+            "(jobs %s); quarantining the slice",
+            kind, chipset.slice_id, deadline,
+            [m.get("id") for m in jobs_meta])
+        # the orphaned executor future may still raise much later; consume
+        # it so asyncio doesn't log an unretrieved exception
+        fut.add_done_callback(
+            lambda f: f.cancelled() or f.exception())
+        self.allocator.quarantine(chipset)
+        self._update_queue_gauges()
+        probe = asyncio.create_task(
+            self._quarantine_probe(chipset),
+            name=f"quarantine_probe_{chipset.slice_id}")
+        self._probe_tasks.add(probe)
+        probe.add_done_callback(self._probe_tasks.discard)
+
+        results = []
+        for meta in jobs_meta:
+            err = TimeoutError(
+                f"job execution exceeded the {deadline:g}s watchdog "
+                "deadline; the slice was quarantined and the job may be "
+                "resubmitted")
+            content_type = meta.get("content_type") or "image/jpeg"
+            if content_type.startswith("image/"):
+                artifacts, pipeline_config = exception_image(err, content_type)
+            else:
+                artifacts, pipeline_config = exception_message(err)
+            results.append({
+                "id": meta.get("id"),
+                "artifacts": artifacts,
+                "nsfw": False,
+                "worker_version": __version__,
+                "pipeline_config": pipeline_config,
+            })
+        return results
+
+    async def _quarantine_probe(self, chipset) -> None:
+        """Wait (bounded) for the wedged pass to release the slice, then
+        run the tiny smoke program. Pass -> the slice returns to the
+        allocator without a worker restart; fail/wedged -> it stays out
+        and advertised capacity stays shrunk."""
+        grace = max(float(getattr(
+            self.settings, "quarantine_probe_grace_s", 30.0)), 0.0)
+        deadline = time.monotonic() + grace
+        while chipset.busy and time.monotonic() < deadline:
+            await asyncio.sleep(0.05)
+        if chipset.busy:
+            _WATCHDOG_PROBES.inc(outcome="wedged")
+            logger.error(
+                "slice %s still wedged %.0fs after its watchdog expiry; "
+                "leaving it quarantined (capacity stays shrunk)",
+                chipset.slice_id, grace)
+            self._update_queue_gauges()
+            return
+        # the default executor, not the slice pool — a wedged slice thread
+        # must not be able to starve its own recovery probe
+        ok = await asyncio.get_running_loop().run_in_executor(
+            None, chipset.smoke_probe)
+        if ok:
+            self.allocator.reinstate(chipset)
+            _WATCHDOG_PROBES.inc(outcome="ok")
+            logger.warning(
+                "slice %s passed the smoke probe; returned to service",
+                chipset.slice_id)
+        else:
+            _WATCHDOG_PROBES.inc(outcome="failed")
+            logger.error(
+                "slice %s failed the smoke probe; leaving it quarantined",
+                chipset.slice_id)
+        self._update_queue_gauges()
 
     async def do_work(self, chipset, worker_function, kwargs) -> dict:
         loop = asyncio.get_running_loop()
-        return await loop.run_in_executor(
+        # captured BEFORE dispatch: the executor thread mutates kwargs
+        meta = [{"id": kwargs.get("id"),
+                 "content_type": kwargs.get("content_type", "image/jpeg")}]
+        deadline = self._job_deadline(kwargs.get("model_name"))
+        fut = loop.run_in_executor(
             self._executor, self.synchronous_do_work, chipset, worker_function, kwargs
         )
+        if deadline is None:
+            return await fut
+        try:
+            return await asyncio.wait_for(asyncio.shield(fut), deadline)
+        except asyncio.TimeoutError:
+            return self._expire_pass(chipset, fut, meta, deadline, "solo")[0]
 
     async def do_batched_work(self, chipset, prepared: list) -> list[dict]:
         loop = asyncio.get_running_loop()
-        return await loop.run_in_executor(
+        meta = [{"id": kw.get("id"),
+                 "content_type": kw.get("content_type", "image/jpeg")}
+                for _, kw in prepared]
+        deadline = self._job_deadline(prepared[0][1].get("model_name"))
+        if deadline is not None:
+            # budget the WORST case of this executor call: the coalesced
+            # pass fails and synchronous_do_batch reruns every member
+            # sequentially through the solo path — a legitimate full-group
+            # fallback must not read as a hang and cost the slice
+            deadline *= max(len(prepared), 1)
+        fut = loop.run_in_executor(
             self._executor, self.synchronous_do_batch, chipset, prepared
         )
+        if deadline is None:
+            return await fut
+        try:
+            return await asyncio.wait_for(asyncio.shield(fut), deadline)
+        except asyncio.TimeoutError:
+            return self._expire_pass(chipset, fut, meta, deadline, "batched")
 
     def synchronous_do_batch(self, chipset, prepared: list) -> list[dict]:
         """One coalesced pass for a compatible group; on ANY failure, fall
@@ -488,24 +763,75 @@ class Worker:
             "pipeline_config": pipeline_config,
         }
 
-    # --- uploader ---
+    # --- uploader (durable outbox, outbox.py) ---
+
+    async def _enqueue_result(self, result: dict) -> None:
+        """Spool the envelope to disk, then queue it for delivery — the
+        write-ahead half of the outbox contract. From this point the job
+        cannot be silently lost: only a hive ACK unlinks the file. The
+        write runs off-loop: a multi-MB artifact envelope on a slow disk
+        must not stall timers, polls, or the drain watcher."""
+        entry = await asyncio.get_running_loop().run_in_executor(
+            None, self.outbox.spool, result)
+        await self.result_queue.put(entry)
 
     async def result_worker(self) -> None:
         while True:
-            result = await self.result_queue.get()
+            entry = await self.result_queue.get()
+            self._delivering += 1
             try:
-                t0 = time.perf_counter()
-                await self.hive.submit_result(result)
-                # stage "submit": successful upload latency (failures are
-                # counted per-endpoint by hive.py)
-                observe_stage("submit", time.perf_counter() - t0)
-            except asyncio.TimeoutError:
-                logger.warning("timeout submitting result %s", result.get("id"))
+                await self._deliver(entry)
+            except FaultInjected:
+                # fault harness only: a simulated crash after upload,
+                # before ACK — the envelope stays spooled for redelivery
+                logger.error(
+                    "injected crash before ack for %s", entry.job_id)
+                raise
             except Exception as e:
                 logger.exception("result_worker error")
                 print(f"result_worker {e}")
             finally:
+                self._delivering -= 1
                 self.result_queue.task_done()
+                self._update_queue_gauges()
+
+    async def _deliver(self, entry: OutboxEntry) -> None:
+        """Upload one spooled envelope until the hive ACKs (capped
+        exponential backoff + jitter between attempts). A permanent 4xx
+        refusal parks the entry on disk instead — retried next restart,
+        never dropped."""
+        while True:
+            err: Exception
+            try:
+                t0 = time.perf_counter()
+                await self.hive.submit_result(entry.result)
+                # stage "submit": successful upload latency (failures are
+                # counted per-endpoint by hive.py)
+                observe_stage("submit", time.perf_counter() - t0)
+                faults.fire("kill_before_ack")
+                self.outbox.delivered(entry)
+                return
+            except FaultInjected:
+                raise
+            except asyncio.TimeoutError as e:
+                err = e
+            except HiveError as e:
+                if e.permanent:
+                    logger.error(
+                        "hive permanently refused result %s (%s); parking "
+                        "the envelope on disk", entry.job_id, e)
+                    self.outbox.park(entry)
+                    return
+                err = e
+            except Exception as e:  # unexpected: still never drop work
+                err = e
+            entry.retries += 1
+            self.outbox.note_retry()
+            delay = outbox_mod.backoff_delay(entry.retries)
+            logger.warning(
+                "submit failed for %s (attempt %d: %s); retrying in %.1fs",
+                entry.job_id, entry.retries, err, delay)
+            await asyncio.sleep(delay)
 
 
 async def run_worker() -> None:

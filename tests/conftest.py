@@ -58,10 +58,12 @@ _QUICK_MODULES = {
     "test_allocator",
     "test_batching",
     "test_external_resources",
+    "test_faults",
     "test_flash_attention",
     "test_job_arguments",
     "test_loras",
     "test_mpeg_audio",
+    "test_outbox",
     "test_output_processor",
     "test_registry_exhaustive",
     "test_requirements",

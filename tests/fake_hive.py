@@ -24,6 +24,13 @@ class FakeHive:
         self.refuse_with: str | None = None  # set -> /work returns 400 + message
         # next N POST /results answer 500 before succeeding (retry tests)
         self.fail_results_times: int = 0
+        # next N POST /results have their CONNECTION dropped mid-request
+        # (the client sees ServerDisconnectedError, not a status)
+        self.drop_results_times: int = 0
+        # next N GET /work have their connection dropped (poll-error tests)
+        self.drop_work_times: int = 0
+        # artificial latency before /results answers (timeout/drain tests)
+        self.slow_results_s: float = 0.0
         self.result_attempts: int = 0
         self._runner: web.AppRunner | None = None
         self.port: int | None = None
@@ -63,8 +70,19 @@ class FakeHive:
 
     # --- handlers ---
 
+    @staticmethod
+    def _drop_connection(request: web.Request) -> web.Response:
+        """Sever the TCP connection without answering — the client-side
+        failure mode a crashed/partitioned hive actually produces."""
+        if request.transport is not None:
+            request.transport.close()
+        return web.Response(status=500, text="dropped")  # never reaches client
+
     async def _work(self, request: web.Request) -> web.Response:
         self.work_requests.append(dict(request.query))
+        if self.drop_work_times > 0:
+            self.drop_work_times -= 1
+            return self._drop_connection(request)
         if self.refuse_with is not None:
             return web.json_response({"message": self.refuse_with}, status=400)
         jobs, self.pending_jobs = self.pending_jobs, []
@@ -72,6 +90,11 @@ class FakeHive:
 
     async def _results(self, request: web.Request) -> web.Response:
         self.result_attempts += 1
+        if self.slow_results_s:
+            await asyncio.sleep(self.slow_results_s)
+        if self.drop_results_times > 0:
+            self.drop_results_times -= 1
+            return self._drop_connection(request)
         if self.fail_results_times > 0:
             self.fail_results_times -= 1
             return web.json_response({"message": "hive hiccup"}, status=502)

@@ -46,6 +46,49 @@ def test_acquire_release_cycle():
     asyncio.run(scenario())
 
 
+def test_quarantine_reinstate_release_never_double_frees():
+    """Watchdog-vs-worker interleavings: whichever of reinstate() (probe)
+    and release() (slice_worker finally) runs first, the slice re-enters
+    the free queue exactly once — two workers must never acquire the same
+    slice."""
+
+    async def scenario():
+        alloc = SliceAllocator(chips_per_job=4)  # 2 slices
+        a = await alloc.acquire()
+        alloc.quarantine(a)
+        assert alloc.quarantined_count == 1
+        # probe clears the quarantine while the worker still holds a
+        alloc.reinstate(a)
+        assert alloc.quarantined_count == 0
+        assert alloc.free_count == 1  # only the other slice
+        alloc.release(a)
+        assert alloc.free_count == 2  # a re-entered exactly once
+
+        # opposite order: release during quarantine, reinstate later
+        b = await alloc.acquire()
+        alloc.quarantine(b)
+        alloc.release(b)
+        assert alloc.free_count == 1  # b held back by the quarantine
+        alloc.reinstate(b)
+        assert alloc.free_count == 2
+
+        # both free entries are DISTINCT slices
+        s1, s2 = await alloc.acquire(), await alloc.acquire()
+        assert s1.slice_id != s2.slice_id
+        assert not alloc.has_free_slice()
+
+    asyncio.run(scenario())
+
+
+def test_quarantine_shrinks_advertised_capabilities():
+    alloc = SliceAllocator(chips_per_job=4)
+    alloc.quarantine(alloc.slices[0])
+    caps = alloc.capabilities()
+    assert caps["slices"] == 1 and caps["chips"] == 4
+    alloc.reinstate(alloc.slices[0])
+    assert alloc.capabilities()["slices"] == 2
+
+
 def test_capabilities_aggregate_pool():
     alloc = SliceAllocator(chips_per_job=2)
     caps = alloc.capabilities()

@@ -1,0 +1,34 @@
+"""tools/chaos_smoke.py wired into CI: every fault-injection scenario —
+submit drops, hive connection drops, hang-in-denoise under the watchdog,
+crash-before-ack, drain-with-in-flight-job — must end with a healthy
+worker and zero lost envelopes.
+"""
+
+import importlib.util
+import pathlib
+import sys
+
+import pytest
+
+_TOOL = pathlib.Path(__file__).resolve().parent.parent / "tools" / "chaos_smoke.py"
+
+
+def _load_tool():
+    spec = importlib.util.spec_from_file_location("chaos_smoke", _TOOL)
+    mod = importlib.util.module_from_spec(spec)
+    sys.modules.setdefault("chaos_smoke", mod)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+@pytest.mark.parametrize("name", [
+    "drop_submit",
+    "hive_connection_drop",
+    "hang_watchdog",
+    "kill_before_ack",
+    "sigterm_drain",
+])
+def test_chaos_scenario(name, sdaas_root):
+    tool = _load_tool()
+    ok, detail = tool.run_scenario(name)
+    assert ok, f"chaos scenario {name} failed: {detail}"

@@ -1,0 +1,101 @@
+"""Fault-injection switchboard (faults.py): spec parsing, deterministic
+countdowns, the hang latch, and the settings/env wiring.
+"""
+
+import threading
+import time
+
+import pytest
+
+from chiaswarm_tpu import faults
+from chiaswarm_tpu.faults import FaultInjected, FaultPlan
+
+
+@pytest.fixture(autouse=True)
+def disarm():
+    yield
+    faults.configure("")
+
+
+def test_spec_parses_counts_and_fires_exactly_n_times():
+    plan = FaultPlan("drop_submit=2, oom_batched=1")
+    for _ in range(2):
+        with pytest.raises(FaultInjected):
+            plan.fire("drop_submit")
+    plan.fire("drop_submit")  # disarmed: no-op
+    assert plan.fired("drop_submit") == 2
+    assert not plan.active("drop_submit")
+    assert plan.active("oom_batched")
+
+
+def test_bare_point_defaults_to_one():
+    plan = FaultPlan("kill_before_ack")
+    with pytest.raises(FaultInjected):
+        plan.fire("kill_before_ack")
+    plan.fire("kill_before_ack")
+
+
+def test_unknown_points_and_garbage_never_fire():
+    plan = FaultPlan("what=ever=3, =, nonsense=abc")
+    plan.fire("what")  # count parse failed -> entry ignored
+    plan.fire("drop_submit")
+    assert plan.fired("drop_submit") == 0
+
+
+def test_site_supplied_exception_class_is_raised():
+    plan = FaultPlan("drop_submit=1")
+    with pytest.raises(ConnectionResetError):
+        plan.fire("drop_submit", exc=ConnectionResetError("injected"))
+
+
+def test_hang_blocks_until_release():
+    plan = FaultPlan("hang_denoise=1", hang_timeout_s=30.0)
+    released = threading.Event()
+
+    def target():
+        plan.hang("hang_denoise")
+        released.set()
+
+    t = threading.Thread(target=target)
+    t.start()
+    deadline = time.monotonic() + 5.0
+    while plan.hanging == 0 and time.monotonic() < deadline:
+        time.sleep(0.005)
+    assert plan.hanging == 1
+    assert not released.is_set()
+    plan.release_hangs()
+    t.join(timeout=5.0)
+    assert released.is_set() and plan.hanging == 0
+    # a released plan does not hang later arrivals
+    plan2 = FaultPlan("hang_denoise=2", hang_timeout_s=30.0)
+    plan2.release_hangs()
+    plan2.hang("hang_denoise")  # returns immediately
+
+
+def test_hang_timeout_bounds_the_block():
+    plan = FaultPlan("hang_denoise=1,hang_timeout=0.05")
+    t0 = time.monotonic()
+    plan.hang("hang_denoise")
+    assert time.monotonic() - t0 < 2.0
+
+
+def test_configure_replaces_global_plan_and_frees_hangers():
+    plan = faults.configure("hang_denoise=1", hang_timeout_s=30.0)
+    t = threading.Thread(target=lambda: faults.hang("hang_denoise"))
+    t.start()
+    deadline = time.monotonic() + 5.0
+    while plan.hanging == 0 and time.monotonic() < deadline:
+        time.sleep(0.005)
+    # reconfiguring must not strand the blocked thread
+    new_plan = faults.configure("")
+    t.join(timeout=5.0)
+    assert not t.is_alive()
+    assert faults.get_plan() is new_plan
+    faults.fire("hang_denoise")  # disarmed
+
+
+def test_settings_env_wiring(sdaas_root, monkeypatch):
+    from chiaswarm_tpu.settings import load_settings
+
+    monkeypatch.setenv("CHIASWARM_FAULTS", "drop_submit=3")
+    assert load_settings().fault_injection == "drop_submit=3"

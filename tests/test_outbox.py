@@ -1,0 +1,104 @@
+"""Durable result outbox (outbox.py): the write-ahead delivery contract
+in isolation — spool before upload, unlink only on ACK, park permanent
+refusals aside, recover everything after a restart.
+"""
+
+import json
+
+import pytest
+
+from chiaswarm_tpu import outbox as outbox_mod
+from chiaswarm_tpu.outbox import Outbox, backoff_delay
+
+
+@pytest.fixture()
+def box(tmp_path):
+    return Outbox(tmp_path / "outbox", max_entries=3)
+
+
+def test_spool_is_atomic_json_on_disk(box):
+    entry = box.spool({"id": "job-1", "artifacts": {"primary": {}}})
+    assert entry.path is not None and entry.path.is_file()
+    assert not list(box.directory.glob("*.tmp"))  # tmp renamed away
+    payload = json.loads(entry.path.read_text())
+    assert payload["result"]["id"] == "job-1"
+    assert box.depth == 1
+    assert box.oldest_age_s() is not None and box.oldest_age_s() >= 0
+
+
+def test_delivered_unlinks_only_that_entry(box):
+    a = box.spool({"id": "a"})
+    b = box.spool({"id": "b"})
+    box.delivered(a)
+    assert box.depth == 1
+    assert not a.path.exists() and b.path.exists()
+
+
+def test_recover_returns_entries_oldest_first(box):
+    for i in range(3):
+        box.spool({"id": f"job-{i}"})
+    fresh = Outbox(box.directory)
+    recovered = fresh.recover()
+    assert [e.job_id for e in recovered] == ["job-0", "job-1", "job-2"]
+    # recovery does not consume: the files stay until delivered()
+    assert fresh.depth == 3
+
+
+def test_park_keeps_the_envelope_on_disk_and_recoverable(box):
+    entry = box.spool({"id": "refused"})
+    box.park(entry)
+    assert entry.parked and entry.path.name.endswith(".parked")
+    assert box.depth == 1  # parked entries still count toward depth
+    recovered = Outbox(box.directory).recover()
+    assert [e.job_id for e in recovered] == ["refused"]
+    assert recovered[0].parked
+
+
+def test_unreadable_entry_is_skipped_not_fatal(box):
+    box.spool({"id": "good"})
+    (box.directory / "00000000000000000000-0000-corrupt.json").write_text("{nope")
+    recovered = Outbox(box.directory).recover()
+    assert [e.job_id for e in recovered] == ["good"]
+    # the corrupt file is left in place for the operator
+    assert box.depth == 2
+
+
+def test_job_id_sanitized_in_filename(box):
+    entry = box.spool({"id": "../../etc/passwd job\n1"})
+    assert entry.path.parent == box.directory
+    assert "/" not in entry.path.name.replace(box.directory.name, "")
+    assert "\n" not in entry.path.name
+
+
+def test_saturation_flag(box):
+    assert not box.saturated
+    for i in range(3):
+        box.spool({"id": str(i)})
+    assert box.saturated
+    # saturation never blocks spooling — it is a health signal only
+    box.spool({"id": "overflow"})
+    assert box.depth == 4
+
+
+def test_spool_failure_degrades_to_memory_entry(box, monkeypatch):
+    import os
+
+    def boom(*a, **k):
+        raise OSError("disk full")
+
+    monkeypatch.setattr(os, "replace", boom)
+    entry = box.spool({"id": "job-x"})
+    assert entry.path is None  # in-memory only, still deliverable
+    # delivered()/park() on a memory-only entry must not raise
+    box.park(entry)
+    box.delivered(entry)
+
+
+def test_backoff_caps_and_jitters(monkeypatch):
+    monkeypatch.setattr(outbox_mod, "BACKOFF_BASE_S", 0.5)
+    monkeypatch.setattr(outbox_mod, "BACKOFF_CAP_S", 4.0)
+    for retries, ceiling in ((1, 0.5), (2, 1.0), (3, 2.0), (4, 4.0), (50, 4.0)):
+        samples = [backoff_delay(retries) for _ in range(50)]
+        assert all(ceiling / 2 <= s <= ceiling for s in samples)
+    # jittered: a fleet must not retry in lockstep
+    assert len({round(backoff_delay(4), 6) for _ in range(50)}) > 5

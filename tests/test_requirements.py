@@ -166,3 +166,46 @@ def test_default_canvas_non_sd_families():
 
     assert default_canvas("kandinsky-community/kandinsky-3") == 1024
     assert default_canvas("stabilityai/stable-cascade") == 1024
+
+
+def test_coalesce_rows_limit_budgets_the_padded_pass():
+    """ROADMAP pad-vs-admission: run_batched pads the admitted row count
+    up to a power-of-two bucket AFTER admission, so the group budget must
+    be a bucket boundary — pad_bucket(limit) must fit the raw capacity."""
+    from chiaswarm_tpu.chips.requirements import coalesce_rows_limit, fit_batch
+    from chiaswarm_tpu.pipelines.common import pad_bucket
+
+    chip = FakeChipSet()
+    model = "stabilityai/stable-diffusion-2-1"
+    raw = fit_batch(chip, model, 256, 768)
+    limit = coalesce_rows_limit(chip, model, 768)
+    assert raw == 22  # non-power-of-two raw fit: the interesting case
+    assert limit == 16  # capped to the bucket boundary, not the raw fit
+    assert limit & (limit - 1) == 0
+    assert pad_bucket(limit) <= raw
+
+
+def test_coalesced_fit_caps_at_the_bucket_not_the_raw_fit():
+    from chiaswarm_tpu.chips.requirements import coalesced_fit
+
+    chip = FakeChipSet()
+    model = "stabilityai/stable-diffusion-2-1"
+    # 20 admitted rows would previously pass (raw fit 22) and then pad to
+    # a 32-row program that cannot fit; the budget now stops at 16
+    assert coalesced_fit(chip, model, 20, 768) == 16
+    # a group within the bucket is untouched (3 rows pad to 4 <= 16)
+    assert coalesced_fit(chip, model, 3, 768) == 3
+    # CPU slices keep the no-HBM behavior
+    class CpuChipSet(FakeChipSet):
+        platform = "cpu"
+
+    assert coalesced_fit(CpuChipSet(), model, 20, 768) == 20
+
+
+def test_coalesce_rows_limit_never_blocks_single_jobs():
+    # a model that does not fit at all is the single-job gate's fatal
+    # error to raise; grouping still proceeds one job at a time
+    from chiaswarm_tpu.chips.requirements import coalesce_rows_limit
+
+    assert coalesce_rows_limit(
+        FakeChipSet(), "black-forest-labs/FLUX.1-dev", 1024) == 1

@@ -2,14 +2,22 @@
 
 Exercises: poll -> job dispatch -> chip-slice execution -> artifact packaging
 -> result upload, plus the fatal-vs-transient error policy (reference
-swarm/worker.py:105-161 semantics) — all hermetic on CPU devices.
+swarm/worker.py:105-161 semantics) — all hermetic on CPU devices. The
+fault-tolerance layer (outbox redelivery, slice watchdog + quarantine,
+graceful drain) is driven through the deterministic injection points in
+faults.py rather than sleeps-and-hope.
 """
 
 import asyncio
 import base64
+import os
+import signal
+import time
 
 import pytest
 
+from chiaswarm_tpu import faults
+from chiaswarm_tpu import outbox as outbox_mod
 from chiaswarm_tpu import worker as worker_mod
 from chiaswarm_tpu.chips.allocator import SliceAllocator
 from chiaswarm_tpu.settings import Settings
@@ -22,6 +30,23 @@ from .fake_hive import FakeHive
 def fast_poll(monkeypatch):
     monkeypatch.setattr(worker_mod, "POLL_SECONDS", 0.05)
     monkeypatch.setattr(worker_mod, "ERROR_BACKOFF_SECONDS", 0.2)
+
+
+@pytest.fixture(autouse=True)
+def disarm_faults():
+    yield
+    faults.configure("")
+
+
+@pytest.fixture()
+def fast_outbox_backoff(monkeypatch):
+    monkeypatch.setattr(outbox_mod, "BACKOFF_BASE_S", 0.02)
+    monkeypatch.setattr(outbox_mod, "BACKOFF_CAP_S", 0.1)
+
+
+def echo_job(job_id: str) -> dict:
+    return {"id": job_id, "workflow": "echo", "model_name": "none",
+            "prompt": job_id}
 
 
 def run_jobs(jobs, sdaas_root, n_results=None, chips_per_job=4):
@@ -410,3 +435,303 @@ def test_submit_result_retries_transient_5xx(sdaas_root):
     assert results[0]["id"] == "job-r"
     assert hive.result_attempts == 2  # 502 then success, ONE worker pass
     assert _RETRIES.value(endpoint="results") == retries_before + 1
+
+
+# --- fault-tolerant job lifecycle (outbox / watchdog / drain) ---
+
+
+def test_injected_submit_drops_never_lose_the_envelope(
+        sdaas_root, fast_outbox_backoff):
+    """Submit drop x3: more consecutive connection failures than the hive
+    client's single in-call retry absorbs — the outbox keeps the envelope
+    and redelivers until the hive ACKs. Zero silent drops."""
+    faults.configure("drop_submit=3")
+    hive, results = run_jobs([echo_job("job-drop")], sdaas_root)
+    assert results[0]["id"] == "job-drop"
+    assert faults.get_plan().fired("drop_submit") == 3
+    assert hive.result_attempts == 1  # drops never reached the hive
+
+
+def test_hive_connection_drops_never_lose_the_envelope(
+        sdaas_root, fast_outbox_backoff):
+    """Same contract with the failure on the hive side: the fake hive
+    severs the TCP connection mid-request twice before accepting."""
+
+    async def scenario():
+        hive = await FakeHive().start()
+        hive.drop_results_times = 2
+        hive.add_job(echo_job("job-sever"))
+        settings = Settings(sdaas_token="t", worker_name="w")
+        w = Worker(settings=settings,
+                   allocator=SliceAllocator(chips_per_job=4),
+                   hive_uri=hive.uri)
+        runner = asyncio.create_task(w.run())
+        try:
+            results = await hive.wait_for_results(1, timeout=240.0)
+            # delivered AND acked: the spool entry is gone
+            for _ in range(100):
+                if w.outbox.depth == 0:
+                    break
+                await asyncio.sleep(0.05)
+            assert w.outbox.depth == 0
+        finally:
+            w.stop()
+            await asyncio.wait_for(runner, 10)
+            await hive.stop()
+        return hive, results
+
+    hive, results = asyncio.run(scenario())
+    assert results[0]["id"] == "job-sever"
+    assert hive.result_attempts >= 3  # 2 severed + 1 accepted
+
+
+def test_outbox_redelivery_across_worker_restart(sdaas_root):
+    """kill-before-ack: the process dies after the hive accepted the POST
+    but before the spool entry was unlinked. The next worker generation
+    must redeliver it (at-least-once; the hive dedupes by job id)."""
+    faults.configure("kill_before_ack=1")
+
+    async def first_generation():
+        hive = await FakeHive().start()
+        hive.add_job(echo_job("job-redeliver"))
+        settings = Settings(sdaas_token="t", worker_name="w")
+        w = Worker(settings=settings,
+                   allocator=SliceAllocator(chips_per_job=4),
+                   hive_uri=hive.uri)
+        runner = asyncio.create_task(w.run())
+        try:
+            await hive.wait_for_results(1, timeout=240.0)
+            # the injected crash fired AFTER the ack, BEFORE the unlink:
+            # the envelope must still be spooled
+            assert w.outbox.depth == 1
+        finally:
+            w.stop()
+            await asyncio.wait_for(runner, 10)
+            await hive.stop()
+
+    asyncio.run(first_generation())
+    faults.configure("")
+
+    async def second_generation():
+        hive = await FakeHive().start()  # no new jobs queued
+        settings = Settings(sdaas_token="t", worker_name="w")
+        w = Worker(settings=settings,
+                   allocator=SliceAllocator(chips_per_job=4),
+                   hive_uri=hive.uri)
+        runner = asyncio.create_task(w.run())
+        try:
+            results = await hive.wait_for_results(1, timeout=60.0)
+            assert results[0]["id"] == "job-redeliver"
+            for _ in range(100):
+                if w.outbox.depth == 0:
+                    break
+                await asyncio.sleep(0.05)
+            assert w.outbox.depth == 0  # unlinked on the real ACK
+        finally:
+            w.stop()
+            await asyncio.wait_for(runner, 10)
+            await hive.stop()
+
+    asyncio.run(second_generation())
+
+
+def test_watchdog_expiry_quarantines_then_probe_reinstates(sdaas_root):
+    """A hung pass must not pin its slice forever: the watchdog returns
+    the transient-error envelope at the deadline, quarantines the slice,
+    and — once the hang clears and the smoke probe passes — returns it to
+    service WITHOUT a worker restart."""
+    faults.configure("hang_denoise=1", hang_timeout_s=60.0)
+
+    async def scenario():
+        hive = await FakeHive().start()
+        hive.add_job(echo_job("job-hang"))
+        settings = Settings(
+            sdaas_token="t", worker_name="w",
+            job_deadline_s=0.4, job_deadline_compile_scale=1.0,
+            quarantine_probe_grace_s=10.0,
+        )
+        w = Worker(settings=settings,
+                   allocator=SliceAllocator(chips_per_job=8),  # ONE slice
+                   hive_uri=hive.uri)
+        runner = asyncio.create_task(w.run())
+        try:
+            results = await hive.wait_for_results(1, timeout=60.0)
+            r = results[0]
+            assert r["id"] == "job-hang"
+            assert not r.get("fatal_error")  # transient: hive may resubmit
+            assert "watchdog" in r["pipeline_config"]["error"]
+            assert w.allocator.quarantined_count == 1
+            health = w._health()
+            assert health["status"] == "degraded"
+            assert any("quarantined" in reason
+                       for reason in health["degraded_reasons"])
+            assert health["slices"][0]["state"] == "quarantined"
+            # advertised capacity shrank while the slice is out
+            assert w.allocator.capabilities()["slices"] == 0
+
+            # the hang clears -> probe runs -> slice returns to service
+            faults.get_plan().release_hangs()
+            for _ in range(200):
+                if w.allocator.quarantined_count == 0:
+                    break
+                await asyncio.sleep(0.05)
+            assert w.allocator.quarantined_count == 0
+
+            # and it actually serves again, same process
+            hive.add_job(echo_job("job-after"))
+            results = await hive.wait_for_results(2, timeout=240.0)
+            assert {r["id"] for r in results} == {"job-hang", "job-after"}
+        finally:
+            w.stop()
+            await asyncio.wait_for(runner, 10)
+            await hive.stop()
+
+    asyncio.run(scenario())
+
+
+def test_sigterm_drains_inflight_job_to_completion(sdaas_root):
+    """SIGTERM mid-job: the worker stops polling, lets the in-flight
+    denoise finish, flushes the outbox, and exits on its own — the round-6
+    behavior cancelled the executing job and dropped its work."""
+    faults.configure("hang_denoise=1", hang_timeout_s=60.0)
+
+    async def scenario():
+        hive = await FakeHive().start()
+        hive.add_job(echo_job("job-drain"))
+        settings = Settings(
+            sdaas_token="t", worker_name="w",
+            job_deadline_s=0.0,  # watchdog off: this hang is "a slow job"
+            drain_deadline_s=60.0,
+        )
+        w = Worker(settings=settings,
+                   allocator=SliceAllocator(chips_per_job=8),
+                   hive_uri=hive.uri)
+        runner = asyncio.create_task(w.run())
+        try:
+            # wait until the job is actually executing (blocked in-pass)
+            plan = faults.get_plan()
+            for _ in range(400):
+                if plan.hanging:
+                    break
+                await asyncio.sleep(0.05)
+            assert plan.hanging == 1
+
+            os.kill(os.getpid(), signal.SIGTERM)
+            await asyncio.sleep(0.3)
+            # draining, not dead: the in-flight job is still running
+            assert not runner.done()
+            assert w._health()["draining"] is True
+            assert hive.results == []
+
+            plan.release_hangs()  # the job finishes normally
+            await asyncio.wait_for(runner, 30.0)  # worker exits by itself
+            assert [r["id"] for r in hive.results] == ["job-drain"]
+            assert w.outbox.depth == 0  # flushed before exit
+        finally:
+            if not runner.done():
+                w.stop()
+                await asyncio.wait_for(runner, 10)
+            await hive.stop()
+
+    asyncio.run(scenario())
+
+
+def test_batched_pass_oom_falls_back_per_job(sdaas_root):
+    """Injected RESOURCE_EXHAUSTED on the coalesced pass: every member job
+    must still come back clean through the per-job fallback path."""
+    faults.configure("oom_batched=1")
+    jobs = [
+        {
+            "id": f"job-oom{i}",
+            "workflow": "txt2img",
+            "model_name": "stabilityai/stable-diffusion-2-1",
+            "prompt": f"fallback probe {i}",
+            "seed": 2000 + i,
+            "height": 64,
+            "width": 64,
+            "num_inference_steps": 2,
+            "parameters": {"test_tiny_model": True},
+        }
+        for i in range(3)
+    ]
+    hive, results = run_jobs(jobs, sdaas_root, chips_per_job=8)
+    assert {r["id"] for r in results} == {f"job-oom{i}" for i in range(3)}
+    assert faults.get_plan().fired("oom_batched") == 1
+    for r in results:
+        cfg = r["pipeline_config"]
+        assert not r.get("fatal_error"), cfg
+        assert "error" not in cfg, cfg
+        # served by the solo fallback, not the (failed) coalesced pass
+        assert "batched_with" not in cfg
+
+
+def test_poll_timeout_backs_off_with_jitter(sdaas_root):
+    """Round-6 bug: the asyncio.TimeoutError branch never set the error
+    backoff, so repeated timeouts hammered the hive at the poll cadence."""
+
+    async def scenario():
+        settings = Settings(sdaas_token="t", worker_name="w", metrics_port=0)
+        w = Worker(settings=settings,
+                   allocator=SliceAllocator(chips_per_job=8),
+                   hive_uri="http://127.0.0.1:9/api")
+
+        async def always_times_out(caps):
+            raise asyncio.TimeoutError
+
+        w.hive.ask_for_work = always_times_out
+        poll = asyncio.create_task(w.poll_loop())
+        try:
+            for _ in range(200):
+                if w._poll_backoff_s > worker_mod.POLL_SECONDS:
+                    break
+                await asyncio.sleep(0.01)
+            assert w._poll_backoff_s > worker_mod.POLL_SECONDS
+            assert w._poll_backoff_s <= worker_mod.ERROR_BACKOFF_SECONDS
+        finally:
+            poll.cancel()
+            await asyncio.gather(poll, return_exceptions=True)
+            w._executor.shutdown(wait=False)
+        # decorrelated jitter: bounded by [cadence, cap], not a constant
+        samples = {worker_mod._next_backoff(worker_mod.POLL_SECONDS)
+                   for _ in range(50)}
+        assert all(worker_mod.POLL_SECONDS <= s <= worker_mod.ERROR_BACKOFF_SECONDS
+                   for s in samples)
+        assert len(samples) > 10
+
+    asyncio.run(scenario())
+
+
+def test_healthz_degrades_on_stale_poll_and_outbox_saturation(sdaas_root):
+    async def scenario():
+        settings = Settings(sdaas_token="t", worker_name="w",
+                            metrics_port=0, outbox_max_entries=2)
+        w = Worker(settings=settings,
+                   allocator=SliceAllocator(chips_per_job=8),
+                   hive_uri="http://127.0.0.1:9/api")
+        try:
+            assert w._health()["status"] == "ok"  # age unknown at startup
+            w._last_poll_monotonic = time.monotonic() - 1000.0
+            h = w._health()
+            assert h["status"] == "degraded"
+            assert any("poll" in r for r in h["degraded_reasons"])
+
+            # a stale poll while every slice is BUSY is the loop pausing
+            # on purpose (mid-denoise), not a wedged worker
+            held = await w.allocator.acquire()
+            assert w._health()["status"] == "ok"
+            w.allocator.release(held)
+            assert w._health()["status"] == "degraded"
+
+            w._last_poll_monotonic = time.monotonic()
+            assert w._health()["status"] == "ok"
+
+            w.outbox.spool({"id": "a"})
+            w.outbox.spool({"id": "b"})
+            h = w._health()
+            assert h["outbox"]["saturated"]
+            assert h["status"] == "degraded"
+            assert any("outbox" in r for r in h["degraded_reasons"])
+        finally:
+            w._executor.shutdown(wait=False)
+
+    asyncio.run(scenario())

@@ -1,0 +1,273 @@
+"""Chaos smoke: drive one in-process worker through every fault-injection
+point and assert it ends healthy with zero lost envelopes.
+
+    JAX_PLATFORMS=cpu python tools/chaos_smoke.py            # all scenarios
+    JAX_PLATFORMS=cpu python tools/chaos_smoke.py drop_submit sigterm_drain
+
+Each scenario stands up a fresh FakeHive + Worker (echo jobs — no model
+weights, no compile), arms exactly one failure via chiaswarm_tpu.faults,
+and checks the lifecycle contract the fault-tolerance layer promises:
+every accepted job's envelope is eventually DELIVERED to the hive or
+SPOOLED on disk, and the worker's /healthz view ends "ok". Exit code =
+number of failed scenarios. tests/test_chaos_smoke.py runs the same
+scenarios under pytest so CI exercises every injection point.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import contextlib
+import pathlib
+import sys
+
+sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent.parent))
+
+from chiaswarm_tpu import faults  # noqa: E402
+from chiaswarm_tpu.chips.allocator import SliceAllocator  # noqa: E402
+from chiaswarm_tpu.settings import Settings  # noqa: E402
+from chiaswarm_tpu.worker import Worker  # noqa: E402
+from tests.fake_hive import FakeHive  # noqa: E402
+
+
+@contextlib.contextmanager
+def fast_mode():
+    """Shrink the production cadences so a scenario runs in seconds."""
+    import chiaswarm_tpu.outbox as ob
+    import chiaswarm_tpu.worker as wm
+
+    saved = (wm.POLL_SECONDS, wm.ERROR_BACKOFF_SECONDS,
+             ob.BACKOFF_BASE_S, ob.BACKOFF_CAP_S)
+    wm.POLL_SECONDS, wm.ERROR_BACKOFF_SECONDS = 0.05, 0.2
+    ob.BACKOFF_BASE_S, ob.BACKOFF_CAP_S = 0.02, 0.1
+    try:
+        yield
+    finally:
+        (wm.POLL_SECONDS, wm.ERROR_BACKOFF_SECONDS,
+         ob.BACKOFF_BASE_S, ob.BACKOFF_CAP_S) = saved
+
+
+def _echo(job_id: str) -> dict:
+    return {"id": job_id, "workflow": "echo", "model_name": "none",
+            "prompt": job_id}
+
+
+def _settings(**overrides) -> Settings:
+    base = dict(sdaas_token="chaos", worker_name="chaos-worker",
+                metrics_port=0)
+    base.update(overrides)
+    return Settings(**base)
+
+
+async def _spin(predicate, timeout_s: float = 30.0, step: float = 0.02) -> bool:
+    deadline = asyncio.get_running_loop().time() + timeout_s
+    while asyncio.get_running_loop().time() < deadline:
+        if predicate():
+            return True
+        await asyncio.sleep(step)
+    return predicate()
+
+
+class ScenarioFailure(AssertionError):
+    pass
+
+
+def _check(condition, detail: str) -> None:
+    if not condition:
+        raise ScenarioFailure(detail)
+
+
+# --- scenarios -------------------------------------------------------------
+
+
+async def scenario_drop_submit() -> str:
+    """Submit drop x3 (worker side): outbox retries until the hive ACKs."""
+    faults.configure("drop_submit=3")
+    hive = await FakeHive().start()
+    hive.add_job(_echo("chaos-drop"))
+    w = Worker(settings=_settings(),
+               allocator=SliceAllocator(chips_per_job=0), hive_uri=hive.uri)
+    runner = asyncio.create_task(w.run())
+    try:
+        results = await hive.wait_for_results(1, timeout=30.0)
+        _check(results[0]["id"] == "chaos-drop", "wrong envelope delivered")
+        _check(await _spin(lambda: w.outbox.depth == 0),
+               f"outbox not drained (depth {w.outbox.depth})")
+        _check(faults.get_plan().fired("drop_submit") == 3,
+               "injection did not fire 3 times")
+        _check(w._health()["status"] == "ok", "worker not healthy at end")
+    finally:
+        w.stop()
+        await asyncio.wait_for(runner, 10)
+        await hive.stop()
+    return "delivered after 3 injected submit drops"
+
+
+async def scenario_hive_connection_drop() -> str:
+    """Connection severed hive-side x2: same zero-loss contract."""
+    faults.configure("")
+    hive = await FakeHive().start()
+    hive.drop_results_times = 2
+    hive.slow_results_s = 0.05  # latency on top of the drops
+    hive.add_job(_echo("chaos-sever"))
+    w = Worker(settings=_settings(),
+               allocator=SliceAllocator(chips_per_job=0), hive_uri=hive.uri)
+    runner = asyncio.create_task(w.run())
+    try:
+        results = await hive.wait_for_results(1, timeout=30.0)
+        _check(results[0]["id"] == "chaos-sever", "wrong envelope delivered")
+        _check(await _spin(lambda: w.outbox.depth == 0),
+               "outbox not drained")
+        _check(w._health()["status"] == "ok", "worker not healthy at end")
+    finally:
+        w.stop()
+        await asyncio.wait_for(runner, 10)
+        await hive.stop()
+    return "delivered through 2 severed hive connections"
+
+
+async def scenario_hang_watchdog() -> str:
+    """Hang-in-denoise: watchdog envelope at the deadline, slice
+    quarantined, probed, and back in service — no restart."""
+    faults.configure("hang_denoise=1", hang_timeout_s=60.0)
+    hive = await FakeHive().start()
+    hive.add_job(_echo("chaos-hang"))
+    w = Worker(
+        settings=_settings(job_deadline_s=0.4, job_deadline_compile_scale=1.0,
+                           quarantine_probe_grace_s=10.0),
+        allocator=SliceAllocator(chips_per_job=0), hive_uri=hive.uri)
+    runner = asyncio.create_task(w.run())
+    try:
+        results = await hive.wait_for_results(1, timeout=30.0)
+        _check("watchdog" in results[0]["pipeline_config"].get("error", ""),
+               "expected the watchdog's transient-error envelope")
+        _check(not results[0].get("fatal_error"),
+               "watchdog envelope must stay transient (resubmittable)")
+        _check(w.allocator.quarantined_count == 1, "slice not quarantined")
+        _check(w._health()["status"] == "degraded",
+               "healthz must report the quarantine")
+        faults.get_plan().release_hangs()
+        _check(await _spin(lambda: w.allocator.quarantined_count == 0),
+               "slice never reinstated after the hang cleared")
+        hive.add_job(_echo("chaos-after"))
+        await hive.wait_for_results(2, timeout=30.0)
+        _check(await _spin(lambda: w._health()["status"] == "ok"),
+               "worker not healthy after recovery")
+    finally:
+        w.stop()
+        await asyncio.wait_for(runner, 10)
+        await hive.stop()
+    return "watchdog expiry -> quarantine -> probe -> back in service"
+
+
+async def scenario_kill_before_ack() -> str:
+    """Crash between hive ack and outbox unlink; a second worker
+    generation redelivers from the spool."""
+    faults.configure("kill_before_ack=1")
+    hive = await FakeHive().start()
+    hive.add_job(_echo("chaos-ack"))
+    settings = _settings()
+    w1 = Worker(settings=settings,
+                allocator=SliceAllocator(chips_per_job=0), hive_uri=hive.uri)
+    runner = asyncio.create_task(w1.run())
+    try:
+        await hive.wait_for_results(1, timeout=30.0)
+        _check(w1.outbox.depth == 1,
+               "envelope must stay spooled through the simulated crash")
+    finally:
+        w1.stop()
+        await asyncio.wait_for(runner, 10)
+
+    faults.configure("")
+    hive.results.clear()
+    w2 = Worker(settings=settings,
+                allocator=SliceAllocator(chips_per_job=0), hive_uri=hive.uri)
+    runner = asyncio.create_task(w2.run())
+    try:
+        results = await hive.wait_for_results(1, timeout=30.0)
+        _check(results[0]["id"] == "chaos-ack", "redelivery lost the job id")
+        _check(await _spin(lambda: w2.outbox.depth == 0),
+               "spool entry not unlinked after the real ack")
+        _check(w2._health()["status"] == "ok", "worker not healthy at end")
+    finally:
+        w2.stop()
+        await asyncio.wait_for(runner, 10)
+        await hive.stop()
+    return "crash-before-ack redelivered by the next worker generation"
+
+
+async def scenario_sigterm_drain() -> str:
+    """stop(drain=True) with a job mid-execution: the pass finishes, the
+    outbox flushes, the worker exits on its own."""
+    faults.configure("hang_denoise=1", hang_timeout_s=60.0)
+    hive = await FakeHive().start()
+    hive.add_job(_echo("chaos-drain"))
+    w = Worker(settings=_settings(job_deadline_s=0.0, drain_deadline_s=30.0),
+               allocator=SliceAllocator(chips_per_job=0), hive_uri=hive.uri)
+    runner = asyncio.create_task(w.run())
+    try:
+        plan = faults.get_plan()
+        _check(await _spin(lambda: plan.hanging == 1),
+               "job never started executing")
+        w.stop(drain=True)  # what the SIGTERM handler calls
+        await asyncio.sleep(0.3)
+        _check(not runner.done(), "worker must drain, not die, mid-job")
+        _check(hive.results == [], "nothing should be delivered yet")
+        plan.release_hangs()
+        await asyncio.wait_for(runner, 30.0)
+        _check([r["id"] for r in hive.results] == ["chaos-drain"],
+               "in-flight job lost across the drain")
+        _check(w.outbox.depth == 0, "outbox not flushed before exit")
+    finally:
+        if not runner.done():
+            w.stop()
+            await asyncio.wait_for(runner, 10)
+        await hive.stop()
+    return "drain finished the in-flight job and flushed the outbox"
+
+
+SCENARIOS = {
+    "drop_submit": scenario_drop_submit,
+    "hive_connection_drop": scenario_hive_connection_drop,
+    "hang_watchdog": scenario_hang_watchdog,
+    "kill_before_ack": scenario_kill_before_ack,
+    "sigterm_drain": scenario_sigterm_drain,
+}
+
+
+def run_scenario(name: str) -> tuple[bool, str]:
+    """One scenario under the fast cadences; (ok, detail). Always disarms
+    the global fault plan afterwards."""
+    try:
+        with fast_mode():
+            detail = asyncio.run(SCENARIOS[name]())
+        return True, detail
+    except ScenarioFailure as e:
+        return False, str(e)
+    except Exception as e:  # noqa: BLE001 — a crash is a failed scenario
+        return False, f"{type(e).__name__}: {e}"
+    finally:
+        faults.configure("")
+
+
+def main(argv: list[str] | None = None) -> int:
+    import os
+    import tempfile
+
+    names = (argv if argv else sys.argv[1:]) or list(SCENARIOS)
+    unknown = [n for n in names if n not in SCENARIOS]
+    if unknown:
+        print(f"unknown scenario(s): {unknown}; have {list(SCENARIOS)}")
+        return len(unknown)
+    failed = 0
+    with tempfile.TemporaryDirectory(prefix="chaos-sdaas-") as root:
+        os.environ["SDAAS_ROOT"] = root  # isolate spool/log from ~/.sdaas
+        for name in names:
+            ok, detail = run_scenario(name)
+            print(f"  {name}: {'ok' if ok else 'FAILED'} — {detail}")
+            failed += 0 if ok else 1
+    print(f"chaos: {len(names) - failed}/{len(names)} scenarios ok")
+    return failed
+
+
+if __name__ == "__main__":
+    sys.exit(main())
