@@ -9,17 +9,27 @@ layer between the poll loop and the slice workers:
 
 - `coalesce_key(job)` buckets a raw hive job by everything that must be
   IDENTICAL for two jobs to share one jitted denoise+decode invocation:
-  (model, family, canvas, steps, scheduler, guidance mode). Jobs that
-  carry per-job structure the batched program can't express — start
-  images, masks, ControlNet, LoRA, chained stages — key to None and take
-  the existing single-job path unchanged.
+  (model, family, canvas, steps, scheduler, guidance mode, workflow —
+  plain txt2img, or img2img with per-request start images at a shared
+  explicit canvas and strength). Jobs that carry per-job structure the
+  batched program can't express — masks, ControlNet, LoRA, chained
+  stages — key to None and take the existing single-job path unchanged.
 - `BatchScheduler` holds compatible jobs for a short linger window
   (Settings.batch_linger_ms) so batchmates arriving in the same poll
-  burst coalesce, then releases the group to a slice worker as ONE work
-  item. Groups cap at Settings.max_coalesce jobs and at the slice's
+  burst coalesce, then releases the group to the DISPATCH BOARD as ONE
+  work item. Groups cap at Settings.max_coalesce jobs and at the slice's
   capacity limit in images (rows_limit, wired to
   chips/requirements.fit_batch by the worker), so a coalesced batch is
   always admissible without rejection.
+- The dispatch board is the placement layer (round 8): released work
+  items sit on the board until an idle slice claims one via `claim()`,
+  which matches groups to slices by MODEL RESIDENCY (chips/allocator.py
+  residency map) — a group goes to the slice where its model is already
+  warm ("affinity"), a first-load group prefers a residency-unclaimed
+  slice ("cold"), and a group whose home slice is busy is STOLEN by any
+  idle slice rather than lingering (the ROADMAP cross-slice-stealing
+  item). Interactive groups always claim first. Outcomes are counted in
+  `swarm_placement_total{outcome}`.
 
 Batching is an optimization, not a semantic change to what the hive
 gets back: every job keeps its own id, prompt, nsfw flags, and result
@@ -44,7 +54,8 @@ logger = logging.getLogger(__name__)
 # why a work item left the scheduler: "solo" (unbatchable / coalescing
 # off), "linger" (timer expired), "size" (hit max_coalesce), "rows" (hit
 # the slice's image capacity), "priority" (interactive fast-path),
-# "shutdown" (flush_all)
+# "preempt" (an interactive job in a DIFFERENT group flushed this one —
+# slice contention, see put()), "shutdown" (flush_all)
 _FLUSHES = telemetry.counter(
     "swarm_batch_flush_total",
     "Work items released by the batch scheduler, by flush reason",
@@ -65,6 +76,15 @@ _LINGER_WAIT = telemetry.histogram(
     "Open time of a coalescing group from first job to flush",
     buckets=(0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0),
 )
+# the tentpole metric: where each claimed work item landed relative to
+# its model's warm state. affinity = the resident slice took it; steal =
+# the resident slice was busy and an idle slice took it anyway; cold =
+# the model was resident nowhere (first load / non-pipeline work)
+_PLACEMENT = telemetry.counter(
+    "swarm_placement_total",
+    "Dispatch-board claims by placement outcome (affinity | steal | cold)",
+    ("outcome",),
+)
 
 # wire pipeline_type strings whose txt2img semantics the batched program
 # reproduces exactly (plain prompt-conditioned CFG denoise + decode)
@@ -76,17 +96,26 @@ _BATCHABLE_PIPELINE_TYPES = {
     "AutoPipelineForText2Image",
 }
 
+# img2img wire names the stacked-init-latent program variant serves
+_BATCHABLE_I2I_PIPELINE_TYPES = {
+    None,
+    "DiffusionPipeline",
+    "StableDiffusionImg2ImgPipeline",
+    "StableDiffusionXLImg2ImgPipeline",
+    "AutoPipelineForImage2Image",
+}
+
 # families with a run_batched entry (pipelines/stable_diffusion.py)
 _BATCHABLE_FAMILIES = {"sd", "sdxl"}
 
 # job-level keys that mean per-job structure the padded batch can't carry
+# (start_image_uri and strength are handled per-workflow: txt2img refuses
+# them, img2img REQUIRES the start image and keys on the strength)
 _UNBATCHABLE_JOB_KEYS = (
-    "start_image_uri",
     "mask_image_uri",
     "lora",
     "refiner",
     "upscale",
-    "strength",
     "textual_inversion",
     "vae",
 )
@@ -110,6 +139,7 @@ _SAFE_PARAMETER_KEYS = frozenset({
 DEFAULT_STEPS = 30
 DEFAULT_GUIDANCE = 7.5
 DEFAULT_SCHEDULER = "DPMSolverMultistepScheduler"
+DEFAULT_STRENGTH = 0.75
 
 
 def is_interactive(job: dict) -> bool:
@@ -133,17 +163,40 @@ def job_rows(job: dict) -> int:
     return max(n, 1)
 
 
+def placement_model(job: dict) -> str | None:
+    """The model name the residency map will know this job by — the tiny
+    stand-in when `test_tiny_model` is set (that is the name the registry
+    loads and therefore the name load events record)."""
+    model = job.get("model_name")
+    if not isinstance(model, str) or not model:
+        return None
+    params = job.get("parameters")
+    tiny = bool(job.get("test_tiny_model"))
+    if isinstance(params, dict):
+        tiny = tiny or bool(params.get("test_tiny_model"))
+    if tiny:
+        try:
+            from .workflows.diffusion import _tiny_stand_in
+
+            return _tiny_stand_in(model)
+        except Exception:  # placement is advisory; never fail a job over it
+            return model
+    return model
+
+
 def coalesce_key(job: dict) -> tuple | None:
     """Compatibility bucket for one raw hive job; None = not batchable.
 
     Two jobs with equal keys produce identical results whether they run
     alone or coalesced: everything the jitted program closes over or
     shares across the batch (model, canvas, step count, scheduler,
-    guidance scale) is in the key; everything per-row (prompt, negative,
-    seed, image count) rides outside it.
+    guidance scale, workflow, img2img strength) is in the key;
+    everything per-row (prompt, negative, seed, start image, image
+    count) rides outside it.
     """
     try:
-        if job.get("workflow") != "txt2img":
+        workflow = job.get("workflow")
+        if workflow not in ("txt2img", "img2img"):
             return None
         model = job.get("model_name")
         if not isinstance(model, str) or not model:
@@ -154,8 +207,6 @@ def coalesce_key(job: dict) -> tuple | None:
         if not isinstance(params, dict):
             return None
         if not set(params) <= _SAFE_PARAMETER_KEYS:
-            return None
-        if params.get("pipeline_type") not in _BATCHABLE_PIPELINE_TYPES:
             return None
 
         from .registry import _auto_family
@@ -173,17 +224,47 @@ def coalesce_key(job: dict) -> tuple | None:
             return None
         if height is not None:
             height, width = int(height), int(width)
+
+        strength = None
+        if workflow == "txt2img":
+            # a txt2img job carrying img2img-shaped fields is something
+            # the formatter may interpret per-job — single path
+            if "start_image_uri" in job or "strength" in job:
+                return None
+            if params.get("pipeline_type") not in _BATCHABLE_PIPELINE_TYPES:
+                return None
+        else:  # img2img: per-request start images -> stacked init latents
+            if not job.get("start_image_uri"):
+                return None
+            # without an explicit canvas the solo path sizes the pass to
+            # each start image — a group can't share a program over
+            # unknown per-image canvases, so explicit dims are required
+            if height is None:
+                return None
+            if params.get("pipeline_type") not in _BATCHABLE_I2I_PIPELINE_TYPES:
+                return None
+            name = model.lower()
+            # edit/inpaint architectures condition on the channel dim —
+            # different program semantics, out of the batched variant
+            if any(s in name for s in ("pix2pix", "ip2p", "inpaint")):
+                return None
+            strength = round(float(job.get("strength", DEFAULT_STRENGTH)), 4)
+
         steps = int(params.get("num_inference_steps",
                                job.get("num_inference_steps", DEFAULT_STEPS)))
         guidance = round(float(params.get(
             "guidance_scale", job.get("guidance_scale", DEFAULT_GUIDANCE))), 4)
         scheduler = str(params.get("scheduler_type", DEFAULT_SCHEDULER))
         karras = bool(params.get("use_karras_sigmas", False))
-        tiny = bool(params.get("test_tiny_model", False))
+        # the tiny flag rides at either level on the wire (formatters copy
+        # the whole job); both must split the bucket or a real job could
+        # coalesce behind a tiny-flagged one and run on the stand-in model
+        tiny = bool(params.get("test_tiny_model", False)) \
+            or bool(job.get("test_tiny_model", False))
         # large_model flips the SD-vs-SDXL default pipeline class
         large = bool(params.get("large_model", False))
         return (model, family, height, width, steps, scheduler, guidance,
-                karras, tiny, large)
+                karras, tiny, large, workflow, strength)
     except (TypeError, ValueError):
         # hive-controlled values that don't parse: let the single-job
         # path produce its usual fatal envelope for them
@@ -191,29 +272,39 @@ def coalesce_key(job: dict) -> tuple | None:
 
 
 class BatchScheduler:
-    """Linger-window grouping between the poll loop and slice workers.
+    """Linger-window grouping between the poll loop and the slice workers'
+    dispatch board.
 
-    put() admits raw hive jobs; get() yields work items as LISTS of jobs
-    — a singleton for unbatchable jobs (immediately), a coalesced group
+    put() admits raw hive jobs; released work items are LISTS of jobs —
+    a singleton for unbatchable jobs (immediately), a coalesced group
     for compatible ones (after the linger window, or sooner when the
     group hits max_coalesce jobs or the slice's capacity in images).
-    task_done() mirrors asyncio.Queue so the worker's poll gating
-    (full()) keeps bounding in-flight work.
+    Slice workers consume via claim() (placement-aware, residency
+    routing + stealing) or the plain FIFO get(). task_done() mirrors
+    asyncio.Queue so the worker's poll gating (full()) keeps bounding
+    in-flight work.
     """
 
     def __init__(self, linger_s: float = 0.05, max_coalesce: int = 8,
                  maxsize: int = 0, ready_maxsize: int = 0,
-                 rows_limit: Callable[[dict], int | None] | None = None):
+                 rows_limit: Callable[[dict], int | None] | None = None,
+                 free_slices: Callable[[], int] | None = None):
         self.linger_s = max(float(linger_s), 0.0)
         self.max_coalesce = int(max_coalesce)
         self.maxsize = int(maxsize)
         self.ready_maxsize = int(ready_maxsize)
         self.rows_limit = rows_limit
-        self._ready: asyncio.Queue[list[dict]] = asyncio.Queue()
+        # free-slice probe for the interactive preemption rule; None means
+        # "unknown" and is treated as contended (preempt — latency first)
+        self.free_slices = free_slices
+        # the dispatch board: released work items awaiting a slice, oldest
+        # first. Each entry: {"jobs", "model", "interactive"}
+        self._board: list[dict] = []
+        self._change = asyncio.Event()
         # key -> {"jobs": [...], "rows": int, "cap": int|None, "timer": handle}
         self._pending: dict[tuple, dict] = {}
         self._outstanding = 0
-        self._ready_jobs = 0  # jobs released to _ready, not yet fetched
+        self._ready_jobs = 0  # jobs released to the board, not yet claimed
         self._closed = False  # drain mode: nothing lingers anymore
 
     # --- queue-compatible surface for the worker loop ---
@@ -221,9 +312,9 @@ class BatchScheduler:
     def full(self) -> bool:
         """Poll gating. Two bounds, so coalescing's extra headroom never
         turns into hoarding of work other swarm members could take:
-        - ready_maxsize bounds jobs already RELEASED to slice workers
-          (the round-5 work-queue bound — unbatchable singletons land
-          here immediately, so mixed traffic backs polls off exactly as
+        - ready_maxsize bounds jobs already RELEASED to the board (the
+          round-5 work-queue bound — unbatchable singletons land here
+          immediately, so mixed traffic backs polls off exactly as
           before);
         - maxsize bounds total in-flight jobs, giving only the jobs
           LINGERING in open groups the extended coalescing allowance.
@@ -237,12 +328,12 @@ class BatchScheduler:
 
     @property
     def pending_jobs(self) -> int:
-        """Jobs lingering in open groups (not yet released to a slice)."""
+        """Jobs lingering in open groups (not yet released to the board)."""
         return sum(len(g["jobs"]) for g in self._pending.values())
 
     @property
     def ready_jobs(self) -> int:
-        """Jobs released to slice workers but not yet fetched."""
+        """Jobs released to the dispatch board but not yet claimed."""
         return self._ready_jobs
 
     @property
@@ -250,14 +341,96 @@ class BatchScheduler:
         """All in-flight jobs: lingering + ready + executing."""
         return self._outstanding
 
+    def notify(self) -> None:
+        """Wake claim()/get() waiters to re-match (fired on every board
+        publish, and wired by the worker to SliceAllocator slice-free
+        events so a claim blocked on 'work ready, no slice free' resumes
+        the moment a slice returns)."""
+        ev, self._change = self._change, asyncio.Event()
+        ev.set()
+
+    async def _wait_change(self) -> None:
+        # grab the CURRENT event synchronously: callers check their
+        # condition and call this with no await in between, so a notify()
+        # racing the check can't be lost (single-threaded event loop)
+        await self._change.wait()
+
     async def get(self) -> list[dict]:
-        group = await self._ready.get()
-        self._ready_jobs -= len(group)
-        return group
+        """Plain FIFO pop of the oldest work item (tests/tools; the worker
+        uses the placement-aware claim())."""
+        while not self._board:
+            await self._wait_change()
+        entry = self._board.pop(0)
+        self._ready_jobs -= len(entry["jobs"])
+        return entry["jobs"]
+
+    async def claim(self, allocator) -> tuple[list[dict], object, str]:
+        """Placement-aware dispatch: wait until a work item AND a free
+        slice exist, then match them — returns (jobs, chipset, outcome)
+        with the chipset already acquired from `allocator`.
+
+        Match policy, in order (oldest entry first within each rule):
+        1. interactive work claims first, wherever it lands;
+        2. a group whose model's home slice is free goes HOME (affinity);
+        3. a group with no home anywhere takes a free slice, preferring
+           one that is nobody's home (cold);
+        4. otherwise the oldest group's home is busy: any idle slice
+           steals it rather than idling (cross-slice batch stealing).
+        The check-and-acquire section is synchronous, so concurrent slice
+        workers cannot double-claim an entry or a slice.
+        """
+        while True:
+            if self._board and allocator.has_free_slice():
+                match = self._match(allocator)
+                if match is not None:
+                    return match
+            await self._wait_change()
+
+    def _match(self, allocator):
+        from .chips.allocator import resident_slice
+
+        def take(idx: int, chipset, outcome: str):
+            entry = self._board.pop(idx)
+            self._ready_jobs -= len(entry["jobs"])
+            _PLACEMENT.inc(outcome=outcome)
+            return entry["jobs"], chipset, outcome
+
+        # rule 1: interactive first
+        for i, entry in enumerate(self._board):
+            if entry["interactive"]:
+                acquired = allocator.acquire_for(entry["model"])
+                if acquired is None:
+                    return None
+                return take(i, *acquired)
+        # rule 2: any entry whose home slice is free goes home
+        free_ids = allocator.free_slice_ids()
+        for i, entry in enumerate(self._board):
+            home = resident_slice(entry["model"])
+            if home is not None and home in free_ids:
+                chipset = allocator.try_acquire(home)
+                if chipset is not None:
+                    return take(i, chipset, "affinity")
+        # rule 3: oldest homeless entry takes a fresh slice
+        for i, entry in enumerate(self._board):
+            if resident_slice(entry["model"]) is None:
+                acquired = allocator.acquire_for(entry["model"])
+                if acquired is None:
+                    return None
+                return take(i, *acquired)
+        # rule 4: every entry's home is busy — steal for the oldest
+        acquired = allocator.acquire_for(self._board[0]["model"])
+        if acquired is None:
+            return None
+        return take(0, *acquired)
 
     def _release(self, jobs: list[dict]) -> None:
         self._ready_jobs += len(jobs)
-        self._ready.put_nowait(jobs)
+        self._board.append({
+            "jobs": jobs,
+            "model": placement_model(jobs[0]),
+            "interactive": any(is_interactive(j) for j in jobs),
+        })
+        self.notify()
 
     async def put(self, job: dict) -> None:
         self._outstanding += 1
@@ -266,6 +439,8 @@ class BatchScheduler:
             return
         key = coalesce_key(job)
         if key is None:
+            if is_interactive(job):
+                self._preempt_lingerers()
             self._release_solo(job)
             return
 
@@ -296,10 +471,36 @@ class BatchScheduler:
             # with it NOW — batchmates already lingering ride along (they
             # only get faster), nobody waits on the timer
             self._flush(key, reason="priority")
+            self._preempt_lingerers()
         elif len(group["jobs"]) >= self.max_coalesce:
             self._flush(key, reason="size")
         elif group["cap"] is not None and group["rows"] >= group["cap"]:
             self._flush(key, reason="rows")
+
+    def _preempt_lingerers(self) -> None:
+        """Interactive preemption ACROSS groups (ROADMAP): when an
+        interactive job dispatches while slices are contended (at most one
+        free), any group still lingering would contend for that slice the
+        moment its timer fires — and linger-timer luck must not decide who
+        goes first. Flushing them now (reason "preempt") puts every
+        contender on the dispatch board, where claim() serves the
+        interactive group first, then the preempted groups in age order.
+        With multiple free slices nothing blocks, so lingering continues.
+        (Callers flush the interactive job's own group before this runs,
+        so _pending holds only the OTHER groups.)
+        """
+        if not self._pending:
+            return
+        contended = True
+        if self.free_slices is not None:
+            try:
+                contended = int(self.free_slices()) <= 1
+            except Exception:  # probe is advisory; stay latency-first
+                contended = True
+        if not contended:
+            return
+        for other in list(self._pending):
+            self._flush(other, reason="preempt")
 
     def _release_solo(self, job: dict) -> None:
         _FLUSHES.inc(reason="solo")

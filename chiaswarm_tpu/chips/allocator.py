@@ -14,15 +14,83 @@ capabilities (swarm/worker.py:45-62). This allocator is that idea done right:
 Slices are disjoint device subsets so concurrent jobs never contend for a
 chip; each slice compiles its own programs (XLA caches are per-process, so
 same-shaped jobs on different slices share the compiled executable).
+
+Placement (round 8): the allocator also carries the MODEL RESIDENCY map —
+which slice last loaded/compiled each model (fed by registry builds and
+SDPipeline compile events). `acquire_for(model)` is the placement-aware
+acquire: it hands out the slice where the model is already warm when that
+slice is free ("affinity"), prefers a residency-unclaimed slice for a model
+with no home ("cold"), and otherwise takes any free slice rather than
+idling ("steal" — the model's home is busy, so recompiling elsewhere beats
+waiting; the ROADMAP cross-slice-stealing item). Residency is process-global
+(models are resident per process+slice, and pipelines don't hold an
+allocator reference), guarded by a lock because pipeline builds run on
+executor threads.
 """
 
 from __future__ import annotations
 
 import asyncio
+import threading
+from typing import Callable
 
 import jax
 
 from .device import ChipSet
+
+# model name -> slice_id of the slice where it was last loaded/compiled.
+# A slice can be home to many models (registry keeps an LRU of resident
+# pipelines per slice); a model has ONE home — the most recent load wins,
+# which is exactly the copy worth routing to.
+_RESIDENCY: dict[str, int] = {}
+_RESIDENCY_LOCK = threading.Lock()
+
+
+def note_resident(model_name: str, slice_id: int) -> None:
+    """Record a load/compile event: `model_name` is now warm on slice
+    `slice_id`. Called by registry.get_pipeline after a build and by
+    SDPipeline on denoise-program compiles (recency refresh)."""
+    if not model_name:
+        return
+    with _RESIDENCY_LOCK:
+        _RESIDENCY[str(model_name)] = int(slice_id)
+
+
+def clear_resident(model_name: str, slice_id: int | None = None) -> None:
+    """Drop a residency entry (registry eviction). With `slice_id`, only
+    clears when the entry still points at that slice — a fresher load on
+    another slice must not be erased by a stale eviction."""
+    with _RESIDENCY_LOCK:
+        current = _RESIDENCY.get(model_name)
+        if current is None:
+            return
+        if slice_id is None or current == int(slice_id):
+            del _RESIDENCY[model_name]
+
+
+def resident_slice(model_name) -> int | None:
+    """Slice id where this model is warm, or None (never loaded)."""
+    if not model_name:
+        return None
+    with _RESIDENCY_LOCK:
+        return _RESIDENCY.get(str(model_name))
+
+
+def residency_snapshot() -> dict[str, int]:
+    with _RESIDENCY_LOCK:
+        return dict(_RESIDENCY)
+
+
+def models_resident_on(slice_id: int) -> list[str]:
+    """Models whose residency entry points at this slice (healthz view)."""
+    with _RESIDENCY_LOCK:
+        return sorted(m for m, s in _RESIDENCY.items() if s == int(slice_id))
+
+
+def reset_residency() -> None:
+    """Tests only: forget every residency entry."""
+    with _RESIDENCY_LOCK:
+        _RESIDENCY.clear()
 
 
 class SliceAllocator:
@@ -52,21 +120,36 @@ class SliceAllocator:
         self._free_ids: set[int] = set()
         self._leased: set[int] = set()
         self._quarantined: set[int] = set()
+        # fired (best-effort) whenever a slice re-enters the free queue so
+        # a placement claim blocked on "group ready but no slice free" can
+        # re-match without polling (worker wires the dispatch board here)
+        self._free_listeners: list[Callable[[], None]] = []
         for s in self.slices:
             self._put_free(s)
 
     def __len__(self) -> int:
         return len(self.slices)
 
+    def add_free_listener(self, callback: Callable[[], None]) -> None:
+        self._free_listeners.append(callback)
+
     def _put_free(self, chipset: ChipSet) -> None:
         if chipset.slice_id in self._free_ids:
             return
         self._free_ids.add(chipset.slice_id)
         self._free.put_nowait(chipset)
+        for cb in self._free_listeners:
+            try:
+                cb()
+            except Exception:  # a notification must never wedge a release
+                pass
 
     @property
     def free_count(self) -> int:
         return self._free.qsize()
+
+    def free_slice_ids(self) -> set[int]:
+        return set(self._free_ids)
 
     def has_free_slice(self) -> bool:
         return not self._free.empty()
@@ -76,6 +159,62 @@ class SliceAllocator:
         self._free_ids.discard(chipset.slice_id)
         self._leased.add(chipset.slice_id)
         return chipset
+
+    def try_acquire(self, slice_id: int | None = None) -> ChipSet | None:
+        """Non-blocking acquire of a SPECIFIC free slice (or any, when
+        slice_id is None). Returns None when the wanted slice (or, with
+        None, every slice) is not in the free pool — leased, or evicted
+        by quarantine(). Synchronous on purpose: the placement match runs
+        check-and-take without an await point, so concurrent slice
+        workers cannot race it."""
+        target = self._take_from_free(slice_id)
+        if target is not None:
+            self._leased.add(target.slice_id)
+        return target
+
+    def _take_from_free(self, slice_id: int | None) -> ChipSet | None:
+        """Pop one slice out of the free queue (a specific one, or the
+        FIFO head) without leasing it; non-targets keep their order."""
+        kept: list[ChipSet] = []
+        target: ChipSet | None = None
+        while True:
+            try:
+                c = self._free.get_nowait()
+            except asyncio.QueueEmpty:
+                break
+            if target is None and (slice_id is None or c.slice_id == slice_id):
+                target = c
+            else:
+                kept.append(c)
+        for c in kept:  # preserve FIFO order for plain acquire()
+            self._free.put_nowait(c)
+        if target is not None:
+            self._free_ids.discard(target.slice_id)
+        return target
+
+    def acquire_for(self, model_name) -> tuple[ChipSet, str] | None:
+        """Placement-aware acquire: the best free slice for `model_name`,
+        plus the placement outcome — "affinity" (its home slice was free),
+        "cold" (no home anywhere; prefers a slice that is nobody's home so
+        later same-model traffic doesn't evict another model's warmth), or
+        "steal" (home exists but is busy/quarantined; any free slice beats
+        idling — cross-slice batch stealing). None when no slice is free.
+        """
+        home = resident_slice(model_name)
+        if home is not None and home not in self._quarantined:
+            chipset = self.try_acquire(home)
+            if chipset is not None:
+                return chipset, "affinity"
+        if not self._free_ids:
+            return None
+        outcome = "cold" if home is None else "steal"
+        occupied = set(residency_snapshot().values())
+        preferred = sorted(self._free_ids - occupied) or sorted(self._free_ids)
+        for sid in preferred:
+            chipset = self.try_acquire(sid)
+            if chipset is not None:
+                return chipset, outcome
+        return None
 
     def release(self, chipset: ChipSet) -> None:
         self._leased.discard(chipset.slice_id)
@@ -89,8 +228,12 @@ class SliceAllocator:
 
     def quarantine(self, chipset: ChipSet) -> None:
         """Take a slice out of service: it will not be handed to jobs and
-        release() becomes a no-op for it. Idempotent."""
+        release() becomes a no-op for it. Idempotent. A slice sitting in
+        the free pool is evicted too — no acquire path (plain, specific,
+        or placement) may hand out a quarantined slice."""
         self._quarantined.add(chipset.slice_id)
+        if chipset.slice_id in self._free_ids:
+            self._take_from_free(chipset.slice_id)
 
     def reinstate(self, chipset: ChipSet) -> None:
         """Clear a slice's quarantine (smoke probe passed). If a worker

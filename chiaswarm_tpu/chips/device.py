@@ -125,6 +125,14 @@ class ChipSet:
             "topology": f"{self.platform}x{self.chip_count()}",
         }
 
+    def resident_models(self) -> list[str]:
+        """Models whose residency entry (allocator residency map, fed by
+        registry load + pipeline compile events) points at this slice —
+        the warm state the dispatch board routes same-model groups to."""
+        from .allocator import models_resident_on
+
+        return models_resident_on(self.slice_id)
+
     def smoke_probe(self) -> bool:
         """Quarantine-recovery probe (worker watchdog): one tiny matmul on
         every chip of the slice, synchronously. True = the slice computes

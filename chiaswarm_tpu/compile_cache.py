@@ -1,0 +1,80 @@
+"""Persistent XLA compilation cache plumbing (one knob, two consumers).
+
+A cold slice pays the full XLA trace+compile on its first pass of every
+shape bucket (~12 s of the ~17.7 s tiny-smoke warmup on CPU, 369 s for
+the SDXL flagship on a v5e chip — BENCH_r02/r05). The compiled
+executables are deterministic per (HLO, backend), so JAX's persistent
+compilation cache can carry the compile half across process restarts:
+a rolling worker restart then pays only trace + cache deserialization.
+
+`Settings.compile_cache_dir` / `CHIASWARM_COMPILE_CACHE_DIR` picks the
+directory: a relative value resolves under `$SDAAS_ROOT` (default
+`xla_cache` -> `$SDAAS_ROOT/xla_cache`), `~` expands, and an empty
+value (or "0"/"off") disables the cache entirely — the disabled path
+never imports jax or touches its config, so opting out is 0-cost. An
+unwritable directory degrades to a warning + disabled cache, never a
+worker failure (the cache is an optimization).
+
+Consumers: worker.startup() (min_compile_time 1.0 s, so thousands of
+trivial sub-programs don't spam the spool) and bench.py (the
+warm-restart probe uses 0.0 so the whole tiny pipeline caches).
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+from pathlib import Path
+
+logger = logging.getLogger(__name__)
+
+_DISABLED_VALUES = {"", "0", "off", "none", "disabled"}
+
+
+def resolve_cache_dir(settings=None) -> Path | None:
+    """The configured cache directory, or None when disabled. Pure path
+    logic — no filesystem writes, no jax."""
+    if settings is None:
+        from .settings import load_settings
+
+        settings = load_settings()
+    raw = str(getattr(settings, "compile_cache_dir", "") or "").strip()
+    if raw.lower() in _DISABLED_VALUES:
+        return None
+    path = Path(os.path.expanduser(raw))
+    if not path.is_absolute():
+        from .settings import get_settings_dir
+
+        path = get_settings_dir() / path
+    return path
+
+
+def enable_compile_cache(settings=None,
+                         min_compile_time_s: float = 1.0) -> Path | None:
+    """Point jax's persistent compilation cache at the configured
+    directory. Returns the active path, or None when disabled or the
+    directory can't be created/written (logged as a warning — the worker
+    keeps serving, it just recompiles on restart)."""
+    path = resolve_cache_dir(settings)
+    if path is None:
+        return None
+    try:
+        path.mkdir(parents=True, exist_ok=True)
+        probe = path / ".write_probe"
+        probe.write_text("ok")
+        probe.unlink()
+    except OSError as e:
+        logger.warning(
+            "compile cache dir %s is not writable (%s); persistent "
+            "compilation cache disabled for this run", path, e)
+        return None
+    try:
+        import jax
+
+        jax.config.update("jax_compilation_cache_dir", str(path))
+        jax.config.update("jax_persistent_cache_min_compile_time_secs",
+                          float(min_compile_time_s))
+    except Exception as e:  # cache is an optimization, never fatal
+        logger.warning("persistent compilation cache unavailable: %s", e)
+        return None
+    return path

@@ -750,6 +750,13 @@ class SDPipeline:
                 _COMPILE_CACHE.inc(event="hit")
                 return self._programs[key]
         _COMPILE_CACHE.inc(event="miss")
+        if self.chipset is not None:
+            # compile event -> placement layer: refresh this model's
+            # residency so the dispatch board keeps routing same-model
+            # groups at the slice that owns the jitted programs
+            from ..chips.allocator import note_resident
+
+            note_resident(self.model_name, self.chipset.slice_id)
         mode, lh, lw, batch, steps, sched_key, t_start, cn_key = key
         scheduler = get_scheduler(
             sched_key[0],
@@ -774,8 +781,8 @@ class SDPipeline:
         def run(params, init_rng, context, added, guidance_scale, image_guidance,
                 image_latents, mask, rng, cn_params, control_cond, cn_scale):
             """context [cfg_rows*B,77,D] (uncond first); noise drawn in-program."""
-            if mode == "batched":
-                # cross-job coalesced txt2img: init_rng is a [batch] key
+            if mode in ("batched", "batched_i2i"):
+                # cross-job coalesced pass: init_rng is a [batch] key
                 # array, one per row, each derived only from its own job's
                 # seed — a job's images must not depend on its batchmates
                 latents = jax.vmap(
@@ -785,7 +792,9 @@ class SDPipeline:
                 latents = jax.random.normal(
                     init_rng, (batch, lh, lw, latent_c), jnp.float32
                 )
-            if mode == "img2img":
+            if mode in ("img2img", "batched_i2i"):
+                # batched_i2i: image_latents is the [batch] stack of each
+                # row's own start-image latents (padding rows zeros)
                 latents = scheduler.add_noise(
                     schedule, image_latents, latents, loop_start
                 )
@@ -876,7 +885,7 @@ class SDPipeline:
                     out_u, out_c = jnp.split(out, 2, axis=0)
                     out = out_u + guidance_scale * (out_c - out_u)
 
-                if mode == "batched":
+                if mode in ("batched", "batched_i2i"):
                     # per-row ancestral noise from per-job keys (same
                     # independence argument as the init draw)
                     noise = jax.vmap(lambda k: jax.random.normal(
@@ -1339,25 +1348,37 @@ class SDPipeline:
                     num_inference_steps: int = 30, guidance_scale: float = 7.5,
                     scheduler_type: str = "DPMSolverMultistepScheduler",
                     use_karras_sigmas: bool = False,
-                    pipeline_type: str = "DiffusionPipeline"):
-        """Coalesced txt2img: N independent requests, ONE padded jitted
-        denoise+decode invocation (batching.py design).
+                    pipeline_type: str = "DiffusionPipeline",
+                    strength: float = 0.75):
+        """Coalesced txt2img/img2img: N independent requests, ONE padded
+        jitted denoise+decode invocation (batching.py design).
 
-        requests: [{"prompt", "negative_prompt", "rng", "num_images_per_prompt"}]
-        — everything that must match across the batch (model, canvas,
-        steps, scheduler, guidance) arrives as shared keyword arguments;
-        the caller (workflows/diffusion.diffusion_batched_callback) groups
-        by batching.coalesce_key so that invariant holds.
+        requests: [{"prompt", "negative_prompt", "rng",
+        "num_images_per_prompt", "image"?}] — everything that must match
+        across the batch (model, canvas, steps, scheduler, guidance,
+        img2img strength) arrives as shared keyword arguments; the caller
+        (workflows/diffusion.diffusion_batched_callback) groups by
+        batching.coalesce_key so that invariant holds. When requests
+        carry start images (img2img), EVERY request must: each image is
+        resized to the shared canvas and VAE-encoded into a per-row stack
+        of init latents ("batched_i2i" program variant), so each row
+        denoises from ITS OWN image's noised latents — padding rows get
+        zero latents and are discarded after decode.
 
         Returns [(images_j, pipeline_config_j)] aligned with requests.
         Every row's noise derives only from its own request's rng (the
-        "batched" program variant draws per-row via vmapped keys), so a
+        batched program variants draw per-row via vmapped keys), so a
         request's images do not depend on who it was coalesced with. The
         total row count pads up to a power-of-two bucket so coalesce
         factors 3 and 4 share one compiled program; padding rows carry an
         empty prompt and are discarded after decode.
         """
-        from .common import pad_bucket, split_by_counts
+        from .common import (
+            clamp_strength,
+            img2img_t_start,
+            pad_bucket,
+            split_by_counts,
+        )
 
         base_params = self.params
         if base_params is None:
@@ -1365,11 +1386,32 @@ class SDPipeline:
                 f"pipeline {self.model_name} was evicted; resubmit the job"
             )
         timings: dict[str, float] = {}
+        start_images = [r.get("image") for r in requests]
+        i2i = any(im is not None for im in start_images)
+        if i2i and not all(im is not None for im in start_images):
+            # a mixed group means the grouping layer broke its invariant;
+            # raising routes every member through the solo fallback
+            raise ValueError("coalesced img2img group missing a start image")
+        if i2i and len({im.size for im in start_images}) > 1:
+            # the input path only bounds images DOWN to the job's dims, so
+            # same-key jobs can still arrive at different native sizes —
+            # and the solo path sizes each job's canvas to ITS image. One
+            # shared program can't reproduce that; the solo fallback can.
+            raise ValueError(
+                "coalesced img2img group has mixed start-image sizes; "
+                "serving members individually")
+        if height is None and i2i:
+            # all start images share one size (checked above), which is
+            # the canvas the solo path would use for each of them
+            width, height = start_images[0].size
         height = int(height or self.default_size)
         width = int(width or height)
         height, width = (max(64, (d // 64) * 64) for d in (height, width))
         lh, lw = height // self.latent_factor, width // self.latent_factor
         steps = int(num_inference_steps)
+        t_start = (
+            img2img_t_start(steps, clamp_strength(strength)) if i2i else 0
+        )
         counts = [
             max(int(r.get("num_images_per_prompt", 1) or 1), 1)
             for r in requests
@@ -1423,6 +1465,30 @@ class SDPipeline:
         image_latents = jnp.zeros((1, 1, 1, latent_c), jnp.float32)
         mask = jnp.zeros((1, 1, 1, 1), jnp.float32)
         control_cond = jnp.zeros((1, 1, 1, 3), jnp.float32)
+        if i2i:
+            # per-row init latents: encode each request's start image
+            # ONCE (already at the shared canvas, resized defensively
+            # here; plus one zero frame covering every padding row), then
+            # gather the latents into the padded row layout — a request
+            # with n rows shares one encode instead of paying n, and
+            # padding rows don't run the encoder at full resolution
+            uniq = [_pil_to_array(im, width, height) for im in start_images]
+            # the ENCODE batch pads to a power-of-two bucket too (jit
+            # retraces per shape — distinct group sizes would otherwise
+            # each pay a VAE-encode compile); the zero frames double as
+            # the padding rows' init latents
+            need = len(uniq) + (1 if pad_rows else 0)
+            while len(uniq) < pad_bucket(need):
+                uniq.append(np.zeros((height, width, 3), np.float32))
+            uniq_latents = self._vae_encode_program(
+                base_params["vae"],
+                jnp.asarray(np.stack(uniq)).astype(self.dtype),
+            )
+            row_index = []
+            for i, n in enumerate(counts):
+                row_index.extend([i] * n)
+            row_index.extend([len(start_images)] * pad_rows)
+            image_latents = uniq_latents[jnp.asarray(row_index)]
 
         context, image_latents, mask, control_cond = map(
             self._place_batch, (context, image_latents, mask, control_cond)
@@ -1435,7 +1501,8 @@ class SDPipeline:
             use_karras_sigmas=bool(use_karras_sigmas),
         )
         sched_key = (scheduler_type, tuple(sorted(dataclass_items(sched_cfg))))
-        key = ("batched", lh, lw, padded, steps, sched_key, 0, None)
+        key = ("batched_i2i" if i2i else "batched",
+               lh, lw, padded, steps, sched_key, t_start, None)
         with Span("compile", timings, key="trace_s"):
             program = self._denoise_program(key)
 
@@ -1471,16 +1538,17 @@ class SDPipeline:
                 "pipeline": pipeline_type,
                 "scheduler": scheduler_type,
                 "controlnet": None,
-                "mode": "txt2img",
+                "mode": "img2img" if i2i else "txt2img",
                 "steps": steps,
                 "size": [width, height],
                 "guidance_scale": guidance_scale,
+                **({"strength": clamp_strength(strength)} if i2i else {}),
                 "batched_with": len(requests),
                 "batch_rows": [offset, n],
                 "padded_rows": padded,
                 "unet_tflops": round(
-                    denoise_flops(self.unet.config, lh, lw, n, steps,
-                                  cfg_rows=2) / 1e12, 4,
+                    denoise_flops(self.unet.config, lh, lw, n,
+                                  steps - t_start, cfg_rows=2) / 1e12, 4,
                 ),
                 # shared pass timings, copied per envelope: the envelope
                 # must stand alone once the hive splits the batch apart

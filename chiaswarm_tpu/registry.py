@@ -140,8 +140,18 @@ def get_pipeline(model_name: str, pipeline_type: str, chipset=None, **variant):
     with _CACHE_LOCK:
         if key in _CACHE:
             _CACHE.move_to_end(key)
-            return _CACHE[key]
-        build_lock = _BUILD_LOCKS.setdefault(key, threading.Lock())
+            pipeline = _CACHE[key]
+            hit = True
+        else:
+            build_lock = _BUILD_LOCKS.setdefault(key, threading.Lock())
+            hit = False
+    if hit:
+        # a cache hit is a residency signal too: this slice serves the
+        # model right now, so the dispatch board should keep routing
+        # same-model groups here (recency refresh)
+        if chipset is not None:
+            _note_resident(model_name, slice_id)
+        return pipeline
 
     # build outside the cache lock (weight load/convert can take seconds) but
     # serialized per key so concurrent slices don't double-load weights
@@ -149,19 +159,48 @@ def get_pipeline(model_name: str, pipeline_type: str, chipset=None, **variant):
         with _CACHE_LOCK:
             if key in _CACHE:
                 _CACHE.move_to_end(key)
-                return _CACHE[key]
+                pipeline = _CACHE[key]
+                hit = True
+        if hit:
+            if chipset is not None:
+                _note_resident(model_name, slice_id)
+            return pipeline
         logger.info("building pipeline %s/%s", model_name, family)
         pipeline = factory(model_name, chipset, **variant)
+        if chipset is not None:
+            # the load event feeding the placement layer: this model is
+            # now warm on this slice, so the dispatch board routes the
+            # next same-model group here (chips/allocator residency map)
+            _note_resident(model_name, slice_id)
 
         with _CACHE_LOCK:
             _CACHE[key] = pipeline
             while len(_CACHE) > MAX_RESIDENT_PIPELINES:
                 evicted_key, evicted = _CACHE.popitem(last=False)
                 logger.info("evicting resident pipeline %s", evicted_key)
+                _clear_resident(evicted_key[0], evicted_key[2])
                 release = getattr(evicted, "release", None)
                 if release:
                     release()
     return pipeline
+
+
+def _note_resident(model_name: str, slice_id: int) -> None:
+    try:
+        from .chips.allocator import note_resident
+
+        note_resident(model_name, slice_id)
+    except Exception:  # placement is advisory; never fail a build over it
+        logger.debug("residency note failed", exc_info=True)
+
+
+def _clear_resident(model_name: str, slice_id: int) -> None:
+    try:
+        from .chips.allocator import clear_resident
+
+        clear_resident(model_name, slice_id)
+    except Exception:
+        logger.debug("residency clear failed", exc_info=True)
 
 
 def clear_cache() -> None:
@@ -169,10 +208,18 @@ def clear_cache() -> None:
         _CACHE.clear()
 
 
-def resident_models() -> list[str]:
-    """Model names currently resident in HBM (telemetry /healthz)."""
+def resident_models(slice_id: int | None = None) -> list[str]:
+    """Model names currently resident in HBM (telemetry /healthz).
+
+    With `slice_id`, only models resident on THAT slice — pipelines and
+    their jitted programs are per-slice, so a process-wide answer would
+    deny a stolen group its first-compile watchdog allowance on the
+    slice that actually has to compile."""
     with _CACHE_LOCK:
-        return sorted({key[0] for key in _CACHE})
+        return sorted({
+            key[0] for key in _CACHE
+            if slice_id is None or key[2] == slice_id
+        })
 
 
 _BUILTINS_LOADED = False

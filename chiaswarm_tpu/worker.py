@@ -17,11 +17,18 @@ Differences by design:
 - Jobs execute in a thread pool sized to the slice count, so one slice's
   denoise loop never blocks another slice's or the event loop.
 - Between the poll loop and the slice workers sits a BatchScheduler
-  (batching.py): compatible txt2img jobs for the same resident model and
+  (batching.py): compatible txt2img/img2img jobs for the same model and
   shape bucket coalesce — after a short linger window — into ONE padded
   denoise+decode pass per slice, each job keeping its own id, seed, and
   result envelope. Anything the batched program can't express dispatches
   solo, exactly as before.
+- Released work items land on the scheduler's dispatch board and are
+  matched to slices by MODEL RESIDENCY (batching.BatchScheduler.claim +
+  chips/allocator residency map): groups route to the slice whose HBM
+  and program cache are already warm (affinity), first loads prefer
+  unclaimed slices (cold), and an idle slice steals a busy home's group
+  rather than idling (cross-slice batch stealing). Outcomes land in
+  swarm_placement_total and each envelope's pipeline_config.placement.
 - The job lifecycle is fault-tolerant end to end: result envelopes go
   through a durable disk outbox (outbox.py — spooled before upload,
   retried with backoff, redelivered after a restart, unlinked only on
@@ -146,7 +153,14 @@ class Worker:
             maxsize=len(self.allocator) * coalesce,
             ready_maxsize=len(self.allocator),
             rows_limit=self._coalesce_rows_limit,
+            # interactive preemption probe: other lingering groups flush
+            # when an interactive dispatch finds slices contended
+            free_slices=lambda: self.allocator.free_count,
         )
+        # a slice returning to the free pool re-runs the placement match,
+        # so a board entry blocked on "no slice free" dispatches the
+        # moment release()/reinstate() happens
+        self.allocator.add_free_listener(self.batcher.notify)
         self.result_queue: asyncio.Queue = asyncio.Queue()
         # durable result spool: envelopes land here BEFORE the first
         # upload attempt and are unlinked only on hive ACK (outbox.py)
@@ -342,6 +356,10 @@ class Worker:
                     "state": ("quarantined"
                               if self.allocator.is_quarantined(s)
                               else "active"),
+                    # per-slice warm models (the placement layer's view):
+                    # which slice the dispatch board would route each
+                    # model's next group to
+                    "resident": s.resident_models(),
                 }
                 for s in self.allocator.slices
             ],
@@ -377,15 +395,15 @@ class Worker:
 
     def _enable_compilation_cache(self) -> None:
         """Persistent XLA compilation cache — the TPU analog of the reference's
-        warm HF model cache (SURVEY §5 'checkpoint/resume')."""
+        warm HF model cache (SURVEY §5 'checkpoint/resume'). The knob,
+        the unwritable-dir fallback, and the disabled fast path live in
+        compile_cache.enable_compile_cache (shared with bench.py)."""
         try:
-            import os
+            from .compile_cache import enable_compile_cache
 
-            import jax
-
-            cache_dir = os.path.expanduser(self.settings.compilation_cache_dir)
-            jax.config.update("jax_compilation_cache_dir", cache_dir)
-            jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+            path = enable_compile_cache(self.settings)
+            if path is not None:
+                logger.info("persistent compile cache at %s", path)
         except Exception as e:  # cache is an optimization, never fatal
             logger.warning("compilation cache unavailable: %s", e)
 
@@ -479,7 +497,11 @@ class Worker:
 
     async def slice_worker(self) -> None:
         while True:
-            batch = await self.batcher.get()
+            # placement-aware dispatch (batching.py board): the work item
+            # and the slice are matched by model residency — affinity to
+            # the warm slice, stealing by an idle one when the warm slice
+            # is busy — and the chipset arrives already acquired
+            batch, chipset, outcome = await self.batcher.claim(self.allocator)
             # queue_wait: hive handoff -> a slice actually starting the work
             picked_up = time.monotonic()
             queue_wait = {}
@@ -487,7 +509,6 @@ class Worker:
                 enqueued = job.pop("_telemetry_enqueued", None)
                 if enqueued is not None and "id" in job:
                     queue_wait[job["id"]] = picked_up - enqueued
-            chipset = await self.allocator.acquire()
             self._update_queue_gauges()
             try:
                 prepared = []
@@ -500,14 +521,14 @@ class Worker:
                 if len(prepared) > 1 and self._batchable(prepared):
                     results = await self.do_batched_work(chipset, prepared)
                     for result in results:
-                        self._finish_result(result, queue_wait)
+                        self._finish_result(result, queue_wait, outcome)
                         await self._enqueue_result(result)
                 else:
                     for worker_function, kwargs in prepared:
                         result = await self.do_work(
                             chipset, worker_function, kwargs
                         )
-                        self._finish_result(result, queue_wait)
+                        self._finish_result(result, queue_wait, outcome)
                         await self._enqueue_result(result)
             except Exception as e:
                 logger.exception("slice_worker error")
@@ -519,11 +540,15 @@ class Worker:
                 self._update_queue_gauges()
 
     @staticmethod
-    def _finish_result(result: dict, queue_wait: dict) -> None:
-        """Stamp worker-side stage timings into the envelope and count the
+    def _finish_result(result: dict, queue_wait: dict,
+                       placement: str | None = None) -> None:
+        """Stamp worker-side stage timings (and the placement outcome that
+        routed the work item to its slice) into the envelope and count the
         job by outcome — ONE place, so solo, coalesced, and fallback paths
         all report identically."""
         cfg = result.setdefault("pipeline_config", {})
+        if placement is not None:
+            cfg["placement"] = placement
         timings = cfg.setdefault("timings", {})
         wait = queue_wait.get(result.get("id"))
         if wait is not None:
@@ -560,10 +585,12 @@ class Worker:
 
     # --- slice watchdog ---
 
-    def _job_deadline(self, model_name) -> float | None:
+    def _job_deadline(self, model_name, chipset=None) -> float | None:
         """Execution deadline for one pass; None = watchdog off. A model
-        that is not yet resident gets the first-compile allowance — big
-        programs legitimately take minutes to compile once."""
+        that is not yet resident ON THIS SLICE gets the first-compile
+        allowance — big programs legitimately take minutes to compile
+        once, and a STOLEN group pays that on the stealing slice even
+        when the model is warm elsewhere in the process."""
         base = float(getattr(self.settings, "job_deadline_s", 0.0) or 0.0)
         if base <= 0:
             return None
@@ -571,7 +598,8 @@ class Worker:
         try:
             from .registry import resident_models
 
-            if model_name and model_name not in resident_models():
+            slice_id = getattr(chipset, "slice_id", None)
+            if model_name and model_name not in resident_models(slice_id):
                 scale = max(float(getattr(
                     self.settings, "job_deadline_compile_scale", 4.0)), 1.0)
         except Exception:  # residency probe must never block execution
@@ -662,7 +690,7 @@ class Worker:
         # captured BEFORE dispatch: the executor thread mutates kwargs
         meta = [{"id": kwargs.get("id"),
                  "content_type": kwargs.get("content_type", "image/jpeg")}]
-        deadline = self._job_deadline(kwargs.get("model_name"))
+        deadline = self._job_deadline(kwargs.get("model_name"), chipset)
         fut = loop.run_in_executor(
             self._executor, self.synchronous_do_work, chipset, worker_function, kwargs
         )
@@ -678,7 +706,7 @@ class Worker:
         meta = [{"id": kw.get("id"),
                  "content_type": kw.get("content_type", "image/jpeg")}
                 for _, kw in prepared]
-        deadline = self._job_deadline(prepared[0][1].get("model_name"))
+        deadline = self._job_deadline(prepared[0][1].get("model_name"), chipset)
         if deadline is not None:
             # budget the WORST case of this executor call: the coalesced
             # pass fails and synchronous_do_batch reruns every member

@@ -110,12 +110,13 @@ def diffusion_callback(device_identifier: str, model_name: str, **kwargs):
 
 
 def diffusion_batched_callback(device_identifier: str, requests: list[dict]):
-    """Cross-job coalesced txt2img (batching.py design): every request in
-    `requests` shares one coalesce key — same model, canvas, steps,
-    scheduler, guidance — and differs only per-row (prompt, negative,
-    seed, image count). Executes the group in as few padded jitted
-    denoise+decode passes as capacity allows (usually one) and returns
-    per-request (artifacts, pipeline_config) envelopes in order.
+    """Cross-job coalesced txt2img/img2img (batching.py design): every
+    request in `requests` shares one coalesce key — same model, canvas,
+    steps, scheduler, guidance, workflow (and strength for img2img) — and
+    differs only per-row (prompt, negative, seed, start image, image
+    count). Executes the group in as few padded jitted denoise+decode
+    passes as capacity allows (usually one) and returns per-request
+    (artifacts, pipeline_config) envelopes in order.
 
     Raising here (capacity, weights) is fine: the worker falls back to
     the single-job path, which reproduces the error per job with the
@@ -132,16 +133,27 @@ def diffusion_batched_callback(device_identifier: str, requests: list[dict]):
     pipeline_type = shared.get("pipeline_type", "DiffusionPipeline")
     chipset = shared.get("chipset")
     # None flows through to run_batched, which defaults to the pipeline's
-    # own default_size — the same resolution the single path's run() does;
-    # the family-table canvas below is only the capacity gate's estimate
+    # own default_size (or, for img2img, the shared start-image canvas) —
+    # the same resolution the single path's run() does; the canvas below
+    # is only the capacity gate's estimate
     height = shared.get("height")
     width = shared.get("width")
-    est_h = int(height or default_canvas(model_name))
-    est_w = int(width or est_h)
+    i2i = shared.get("image") is not None
+    if (height is None or width is None) and i2i:
+        # img2img formatting pops height/width after resizing every start
+        # image to the shared explicit canvas — read it back off the image
+        est_w, est_h = shared["image"].size
+    else:
+        est_h = int(height or default_canvas(model_name))
+        est_w = int(width or est_h)
     steps = int(shared.get("num_inference_steps", 30))
     guidance = float(shared.get("guidance_scale", 7.5))
     scheduler_type = shared.get("scheduler_type", "DPMSolverMultistepScheduler")
     karras = bool(shared.get("use_karras_sigmas", False))
+    # NB `or`-defaulting would silently rewrite an explicit strength of
+    # 0.0 and make the coalesced output diverge from the solo path's
+    raw_strength = shared.get("strength")
+    strength = 0.75 if raw_strength is None else float(raw_strength)
 
     # per-request envelope parameters + the run_batched row spec
     envelopes = []
@@ -159,6 +171,7 @@ def diffusion_batched_callback(device_identifier: str, requests: list[dict]):
             "negative_prompt": r.get("negative_prompt", ""),
             "rng": r.get("rng"),
             "num_images_per_prompt": n,
+            "image": r.get("image"),
         })
 
     # capacity admits the COALESCED batch, capping rather than rejecting:
@@ -192,6 +205,7 @@ def diffusion_batched_callback(device_identifier: str, requests: list[dict]):
             scheduler_type=scheduler_type,
             use_karras_sigmas=karras,
             pipeline_type=pipeline_type,
+            strength=strength,
         ))
 
     out = []

@@ -65,6 +65,7 @@ _QUICK_MODULES = {
     "test_mpeg_audio",
     "test_outbox",
     "test_output_processor",
+    "test_placement_stats",
     "test_registry_exhaustive",
     "test_requirements",
     "test_schedulers",

@@ -5,8 +5,18 @@ import asyncio
 import jax
 import pytest
 
+from chiaswarm_tpu.chips import allocator as alloc_mod
 from chiaswarm_tpu.chips.allocator import SliceAllocator
 from chiaswarm_tpu.chips.device import ChipSet
+
+
+@pytest.fixture()
+def clean_residency():
+    """The residency map is process-global (fed by registry builds);
+    placement tests need a known-empty one."""
+    alloc_mod.reset_residency()
+    yield
+    alloc_mod.reset_residency()
 
 
 def test_virtual_device_count():
@@ -95,6 +105,138 @@ def test_capabilities_aggregate_pool():
     assert caps["chips"] == 8
     assert caps["slices"] == 4
     assert "memory" in caps and "gpu" in caps  # legacy keys
+
+
+# --- residency map + placement-aware acquire (dispatch-board backend) ---
+
+
+def test_residency_map_note_clear_semantics(clean_residency):
+    assert alloc_mod.resident_slice("m") is None
+    alloc_mod.note_resident("m", 1)
+    assert alloc_mod.resident_slice("m") == 1
+    # most recent load wins (the copy worth routing to)
+    alloc_mod.note_resident("m", 0)
+    assert alloc_mod.resident_slice("m") == 0
+    # a stale eviction (old slice) must not erase the fresher entry
+    alloc_mod.clear_resident("m", slice_id=1)
+    assert alloc_mod.resident_slice("m") == 0
+    alloc_mod.clear_resident("m", slice_id=0)
+    assert alloc_mod.resident_slice("m") is None
+    # empty / unknown names are no-ops
+    alloc_mod.note_resident("", 0)
+    assert alloc_mod.resident_slice("") is None
+    alloc_mod.clear_resident("never-seen")
+
+
+def test_models_resident_on_reverse_view(clean_residency):
+    alloc_mod.note_resident("a", 0)
+    alloc_mod.note_resident("b", 0)
+    alloc_mod.note_resident("c", 1)
+    assert alloc_mod.models_resident_on(0) == ["a", "b"]
+    assert alloc_mod.models_resident_on(1) == ["c"]
+    cs = ChipSet(jax.devices()[:1], slice_id=1)
+    assert cs.resident_models() == ["c"]
+
+
+def test_acquire_for_affinity_hit(clean_residency):
+    alloc = SliceAllocator(chips_per_job=4)  # 2 slices
+    alloc_mod.note_resident("m", 1)
+    chipset, outcome = alloc.acquire_for("m")
+    assert outcome == "affinity"
+    assert chipset.slice_id == 1
+    alloc.release(chipset)
+
+
+def test_acquire_for_cold_prefers_unclaimed_slice(clean_residency):
+    alloc = SliceAllocator(chips_per_job=4)
+    alloc_mod.note_resident("other-model", 0)
+    chipset, outcome = alloc.acquire_for("never-loaded")
+    assert outcome == "cold"
+    # slice 0 is other-model's home; the cold load goes elsewhere
+    assert chipset.slice_id == 1
+    alloc.release(chipset)
+
+
+def test_acquire_for_steals_when_home_is_busy(clean_residency):
+    async def scenario():
+        alloc = SliceAllocator(chips_per_job=4)
+        alloc_mod.note_resident("m", 0)
+        home, outcome = alloc.acquire_for("m")
+        assert outcome == "affinity" and home.slice_id == 0
+        # home leased: the next same-model acquire steals the idle slice
+        stolen, outcome = alloc.acquire_for("m")
+        assert outcome == "steal"
+        assert stolen.slice_id == 1
+        # nothing free at all -> None, caller waits
+        assert alloc.acquire_for("m") is None
+        alloc.release(home)
+        alloc.release(stolen)
+
+    asyncio.run(scenario())
+
+
+def test_acquire_for_excludes_quarantined_home(clean_residency):
+    alloc = SliceAllocator(chips_per_job=4)
+    alloc_mod.note_resident("m", 0)
+    alloc.quarantine(alloc.slices[0])
+    # home exists but is out of service: counted as a steal, never handed
+    # the quarantined slice
+    chipset, outcome = alloc.acquire_for("m")
+    assert outcome == "steal"
+    assert chipset.slice_id == 1
+    alloc.release(chipset)
+    alloc.reinstate(alloc.slices[0])
+
+
+def test_quarantine_evicts_idle_slice_from_free_pool(clean_residency):
+    """Quarantining a slice that is sitting FREE must pull it out of the
+    pool — no acquire path (plain, specific, or placement) may hand out
+    an out-of-service slice — and reinstate() returns it."""
+
+    async def scenario():
+        alloc = SliceAllocator(chips_per_job=4)
+        alloc.quarantine(alloc.slices[0])
+        assert alloc.free_count == 1
+        assert alloc.try_acquire(0) is None
+        only = await alloc.acquire()
+        assert only.slice_id == 1
+        alloc.release(only)
+        alloc.reinstate(alloc.slices[0])
+        assert alloc.free_count == 2
+        assert alloc.try_acquire(0) is not None
+
+    asyncio.run(scenario())
+
+
+def test_try_acquire_specific_slice_preserves_fifo(clean_residency):
+    async def scenario():
+        alloc = SliceAllocator(chips_per_job=4)
+        taken = alloc.try_acquire(1)
+        assert taken is not None and taken.slice_id == 1
+        assert alloc.try_acquire(1) is None  # already leased
+        other = await alloc.acquire()  # the untouched slice still flows
+        assert other.slice_id == 0
+        assert alloc.try_acquire() is None  # pool empty
+        alloc.release(taken)
+        alloc.release(other)
+
+    asyncio.run(scenario())
+
+
+def test_free_listener_fires_on_release(clean_residency):
+    async def scenario():
+        alloc = SliceAllocator(chips_per_job=4)
+        fired = []
+        alloc.add_free_listener(lambda: fired.append(1))
+        held = await alloc.acquire()
+        assert not fired
+        alloc.release(held)
+        assert fired  # and a listener error must not wedge release
+        alloc.add_free_listener(lambda: 1 / 0)
+        held = await alloc.acquire()
+        alloc.release(held)
+
+    asyncio.run(scenario())
 
 
 def test_chipset_busy_mutex():

@@ -247,6 +247,79 @@ def test_non_interactive_priority_values_still_linger():
     assert [j["id"] for j in run(scenario())] == ["a", "b"]
 
 
+def test_img2img_jobs_with_start_images_share_a_key():
+    a = coalesce_key(job(workflow="img2img",
+                         start_image_uri="http://x/a.png", strength=0.6))
+    b = coalesce_key(job(id="job-2", workflow="img2img", prompt="other",
+                         start_image_uri="http://x/b.png", strength=0.6,
+                         seed=9))
+    assert a is not None
+    assert a == b  # per-request start images ride OUTSIDE the key
+    # txt2img and img2img never share a bucket
+    assert a != coalesce_key(job())
+
+
+@pytest.mark.parametrize("variant", [
+    # strength shapes the program (scan start index): different bucket
+    {"strength": 0.3},
+])
+def test_img2img_strength_splits_the_bucket(variant):
+    base = job(workflow="img2img", start_image_uri="http://x/a.png",
+               strength=0.6)
+    other = dict(base, **variant)
+    assert coalesce_key(base) is not None
+    assert coalesce_key(other) is not None
+    assert coalesce_key(base) != coalesce_key(other)
+
+
+@pytest.mark.parametrize("variant", [
+    {"start_image_uri": None},  # img2img without a start image: formatter
+                                # will fail it per-job — single path
+    {"height": None, "width": None},  # no explicit canvas: the solo path
+                                      # sizes the pass to each image
+    {"model_name": "timbrooks/instruct-pix2pix"},  # edit arch (3-row CFG)
+    {"model_name": "runwayml/stable-diffusion-inpainting"},  # 9ch arch
+    {"mask_image_uri": "http://x/m.png"},
+])
+def test_unbatchable_img2img_variants_key_to_none(variant):
+    base = dict(job(workflow="img2img", start_image_uri="http://x/a.png",
+                    strength=0.6))
+    base.update(variant)
+    assert coalesce_key(base) is None
+
+
+def test_top_level_tiny_flag_splits_the_bucket():
+    # the tiny stand-in flag rides at either level on the wire; a real
+    # job must never coalesce behind a tiny-flagged one (the whole group
+    # runs on one model)
+    plain = job(parameters={"test_tiny_model": False})
+    top_level = dict(plain, test_tiny_model=True)
+    assert coalesce_key(top_level) is not None
+    assert coalesce_key(top_level) != coalesce_key(plain)
+    # ...and it matches the params-level spelling's bucket behavior
+    assert coalesce_key(dict(plain, test_tiny_model=True)) == \
+        coalesce_key(dict(top_level))
+
+
+def test_zero_strength_keys_distinctly():
+    # strength 0.0 is falsy but meaningful (keep ~the whole start image);
+    # it must key apart from the 0.75 default, never be rewritten
+    zero = coalesce_key(job(workflow="img2img",
+                            start_image_uri="http://x/a.png", strength=0.0))
+    default = coalesce_key(job(workflow="img2img",
+                               start_image_uri="http://x/a.png"))
+    assert zero is not None and zero != default
+
+
+def test_default_strength_buckets_with_explicit_default():
+    implicit = coalesce_key(job(workflow="img2img",
+                                start_image_uri="http://x/a.png"))
+    explicit = coalesce_key(job(workflow="img2img",
+                                start_image_uri="http://x/a.png",
+                                strength=0.75))
+    assert implicit == explicit
+
+
 def test_flush_reason_counters_cover_release_paths():
     from chiaswarm_tpu.batching import _FLUSHES, _GROUP_JOBS
 
@@ -266,5 +339,202 @@ def test_flush_reason_counters_cover_release_paths():
         assert _FLUSHES.value(reason="size") == size + 1
         assert _FLUSHES.value(reason="linger") == linger + 1
         assert _GROUP_JOBS.count() == groups + 3
+
+    run(scenario())
+
+
+# --- dispatch board: placement-aware claiming (residency routing) ---
+
+
+def _placement_rig(chips_per_job=4, linger_s=0.01, **kw):
+    """(allocator, scheduler) wired the way the worker wires them."""
+    from chiaswarm_tpu.chips import allocator as alloc_mod
+    from chiaswarm_tpu.chips.allocator import SliceAllocator
+
+    alloc_mod.reset_residency()
+    alloc = SliceAllocator(chips_per_job=chips_per_job)  # 8/4 = 2 slices
+    b = BatchScheduler(linger_s=linger_s, max_coalesce=8,
+                       free_slices=lambda: alloc.free_count, **kw)
+    alloc.add_free_listener(b.notify)
+    return alloc, b
+
+
+def test_claim_routes_resident_model_home_and_steals_for_foreign():
+    """The acceptance scenario: with model M resident on slice 0, a
+    second M group lands on slice 0 (affinity) while a foreign-model
+    group — M is resident elsewhere from ITS point of view, F from the
+    slice's — takes the idle slice 1; when M's home is busy, an M group
+    steals the idle slice instead of waiting. All observable through
+    swarm_placement_total."""
+    from chiaswarm_tpu.batching import _PLACEMENT
+    from chiaswarm_tpu.chips import allocator as alloc_mod
+
+    async def scenario():
+        before = {o: _PLACEMENT.value(outcome=o)
+                  for o in ("affinity", "steal", "cold")}
+        alloc, b = _placement_rig()
+
+        # 1. first M group: resident nowhere -> cold
+        await b.put(job(id="m1"))
+        jobs1, cs1, out1 = await asyncio.wait_for(b.claim(alloc), 2.0)
+        assert [j["id"] for j in jobs1] == ["m1"] and out1 == "cold"
+        # the registry's load event (emulated): M is now warm on cs1
+        alloc_mod.note_resident("test/tiny-sd", cs1.slice_id)
+        alloc.release(cs1)
+
+        # 2. second M group with both slices free -> home slice (affinity)
+        await b.put(job(id="m2"))
+        jobs2, cs2, out2 = await asyncio.wait_for(b.claim(alloc), 2.0)
+        assert out2 == "affinity" and cs2.slice_id == cs1.slice_id
+
+        # 3. while M's home is busy with m2, a foreign-model group claims
+        # the idle slice (cold: F has no home anywhere)...
+        await b.put(job(id="f1", model_name="stabilityai/stable-diffusion-xl-base-1.0"))
+        jobs3, cs3, out3 = await asyncio.wait_for(b.claim(alloc), 2.0)
+        assert out3 == "cold" and cs3.slice_id != cs1.slice_id
+        alloc.release(cs3)
+
+        # 4. ...and a further M group steals the idle slice rather than
+        # waiting for its busy home (cross-slice batch stealing)
+        await b.put(job(id="m3", num_inference_steps=7))
+        jobs4, cs4, out4 = await asyncio.wait_for(b.claim(alloc), 2.0)
+        assert out4 == "steal" and cs4.slice_id != cs1.slice_id
+        alloc.release(cs2)
+        alloc.release(cs4)
+
+        deltas = {o: _PLACEMENT.value(outcome=o) - before[o]
+                  for o in ("affinity", "steal", "cold")}
+        assert deltas == {"affinity": 1, "steal": 1, "cold": 2}
+        for jobs in (jobs1, jobs2, jobs3, jobs4):
+            for _ in jobs:
+                b.task_done()
+
+    run(scenario())
+
+
+def test_claim_blocked_on_busy_slices_resumes_on_release():
+    """A board entry with every slice leased dispatches the moment a
+    slice frees — via the allocator's free listener, no polling."""
+
+    async def scenario():
+        alloc, b = _placement_rig(chips_per_job=8)  # ONE slice
+        await b.put(job(id="first"))
+        _, held, _ = await asyncio.wait_for(b.claim(alloc), 2.0)
+        await b.put(job(id="second", num_inference_steps=9))
+        claim2 = asyncio.create_task(b.claim(alloc))
+        await asyncio.sleep(0.05)
+        assert not claim2.done()  # work ready, no slice -> waiting
+        alloc.release(held)
+        jobs, cs, _ = await asyncio.wait_for(claim2, 2.0)
+        assert [j["id"] for j in jobs] == ["second"]
+        alloc.release(cs)
+
+    run(scenario())
+
+
+def test_concurrent_slice_workers_claim_distinct_groups():
+    """N workers racing the board: every group is claimed exactly once,
+    and jobs are never duplicated or dropped."""
+
+    async def scenario():
+        alloc, b = _placement_rig(chips_per_job=2, linger_s=0.005)  # 4 slices
+        seen: list[str] = []
+
+        async def worker_loop():
+            while True:
+                jobs, cs, _ = await b.claim(alloc)
+                await asyncio.sleep(0.01)  # overlap the claims
+                seen.extend(j["id"] for j in jobs)
+                alloc.release(cs)
+                for _ in jobs:
+                    b.task_done()
+
+        workers = [asyncio.create_task(worker_loop()) for _ in range(4)]
+        ids = []
+        for i in range(10):
+            # distinct step counts -> distinct groups -> 10 work items
+            await b.put(job(id=f"j{i}", num_inference_steps=i + 1))
+            ids.append(f"j{i}")
+        for _ in range(200):
+            if sorted(seen) == sorted(ids):
+                break
+            await asyncio.sleep(0.01)
+        for w in workers:
+            w.cancel()
+        await asyncio.gather(*workers, return_exceptions=True)
+        assert sorted(seen) == sorted(ids)
+
+    run(scenario())
+
+
+def test_interactive_group_claims_before_older_groups():
+    async def scenario():
+        alloc, b = _placement_rig(chips_per_job=8)  # ONE slice
+        await b.put(job(id="old", num_inference_steps=3))
+        await asyncio.sleep(0.03)  # old group flushes to the board first
+        await b.put(job(id="vip", priority="interactive"))
+        jobs, cs, _ = await asyncio.wait_for(b.claim(alloc), 2.0)
+        assert [j["id"] for j in jobs] == ["vip"]  # jumps the queue
+        alloc.release(cs)
+        jobs, cs, _ = await asyncio.wait_for(b.claim(alloc), 2.0)
+        assert [j["id"] for j in jobs] == ["old"]
+        alloc.release(cs)
+
+    run(scenario())
+
+
+# --- interactive preemption ACROSS groups (ROADMAP item) ---
+
+
+def test_interactive_arrival_preempts_other_lingering_group():
+    from chiaswarm_tpu.batching import _FLUSHES
+
+    async def scenario():
+        before = _FLUSHES.value(reason="preempt")
+        # one free slice reported: contended -> lingering groups flush
+        b = BatchScheduler(linger_s=60.0, max_coalesce=8,
+                           free_slices=lambda: 1)
+        await b.put(job(id="patient", num_inference_steps=9))  # lingers
+        await b.put(job(id="hurry", priority="interactive"))
+        assert b.pending_jobs == 0  # BOTH groups flushed
+        assert _FLUSHES.value(reason="preempt") == before + 1
+        first = await asyncio.wait_for(b.get(), 1.0)
+        second = await asyncio.wait_for(b.get(), 1.0)
+        return first, second
+
+    first, second = run(scenario())
+    # the interactive group is on the board; board order still serves it
+    # first through claim() (rule 1) even though FIFO get() may not
+    assert {j["id"] for j in first} | {j["id"] for j in second} == \
+        {"patient", "hurry"}
+
+
+def test_interactive_does_not_preempt_when_slices_are_plentiful():
+    async def scenario():
+        b = BatchScheduler(linger_s=60.0, max_coalesce=8,
+                           free_slices=lambda: 3)
+        await b.put(job(id="patient", num_inference_steps=9))
+        await b.put(job(id="hurry", priority="interactive"))
+        # nothing contends: the other group keeps lingering for batchmates
+        assert b.pending_jobs == 1
+        group = await asyncio.wait_for(b.get(), 1.0)
+        assert [j["id"] for j in group] == ["hurry"]
+        b.flush_all()
+
+    run(scenario())
+
+
+def test_unbatchable_interactive_job_also_preempts():
+    from chiaswarm_tpu.batching import _FLUSHES
+
+    async def scenario():
+        before = _FLUSHES.value(reason="preempt")
+        b = BatchScheduler(linger_s=60.0, max_coalesce=8,
+                           free_slices=lambda: 0)
+        await b.put(job(id="patient"))
+        await b.put({"id": "vip-echo", "workflow": "echo",
+                     "model_name": "none", "priority": "interactive"})
+        assert b.pending_jobs == 0
+        assert _FLUSHES.value(reason="preempt") == before + 1
 
     run(scenario())

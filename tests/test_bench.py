@@ -54,6 +54,23 @@ def test_bench_cpu_fallback_produces_labeled_smoke_row():
     assert out.get("tiny_sd_smoke_img_per_sec_per_chip", 0) > 0, out
     assert not any(k.startswith(("sd21_768", "sdxl_controlnet")) for k in out)
 
+    # persistent-compile-cache restart probe (ISSUE 4): both legs banked,
+    # and the warm restart is substantially cheaper than the cold start
+    # (the acceptance bar is < 0.5; 0.75 here is the unflaky CI floor —
+    # measured ~0.32 on this container, the artifact carries the ratio)
+    assert out.get("warm_restart_cold_warmup_s", 0) > 0, out
+    assert out.get("warm_restart_warmup_s", 0) > 0, out
+    assert out["warm_restart_warmup_s"] < \
+        0.75 * out["warm_restart_cold_warmup_s"], out
+    assert out["warm_restart_detail"]["cache_entries"] > 0, out
+
+    # residency-aware placement smoke (2-slice claim exercise): the claim
+    # sequence covered all three outcomes
+    assert out.get("placement_total") == \
+        {"affinity": 1, "steal": 1, "cold": 1}, out
+    assert out.get("affinity_hit_rate", 0) > 0, out
+    assert out.get("steals") == 1, out
+
     # cross-job micro-batching row (4-virtual-device slice child): the
     # coalesce ladder landed, and filling the slice beats batch-1 passes
     # (structurally ~4x here — replicated vs sharded — so >1 is a safe,

@@ -1,0 +1,68 @@
+"""tools/placement_stats.py contract tests: the placement summary on
+synthetic exposition text, and the REAL in-process claim smoke — so the
+operator's view of the dispatch board can't rot between TPU windows."""
+
+import importlib.util
+import pathlib
+import sys
+
+_TOOLS = pathlib.Path(__file__).resolve().parent.parent / "tools"
+
+
+def _load_tool():
+    # placement_stats imports the exposition parser from metrics_dump
+    if "metrics_dump" not in sys.modules:
+        md_spec = importlib.util.spec_from_file_location(
+            "metrics_dump", _TOOLS / "metrics_dump.py")
+        md = importlib.util.module_from_spec(md_spec)
+        sys.modules["metrics_dump"] = md
+        md_spec.loader.exec_module(md)
+    spec = importlib.util.spec_from_file_location(
+        "placement_stats", _TOOLS / "placement_stats.py")
+    mod = importlib.util.module_from_spec(spec)
+    sys.modules.setdefault("placement_stats", mod)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+SYNTHETIC = """\
+# TYPE swarm_placement_total counter
+swarm_placement_total{outcome="affinity"} 6
+swarm_placement_total{outcome="steal"} 2
+swarm_placement_total{outcome="cold"} 2
+# TYPE swarm_batch_flush_total counter
+swarm_batch_flush_total{reason="linger"} 5
+swarm_batch_flush_total{reason="preempt"} 1
+"""
+
+
+def test_placement_summary_from_synthetic_text():
+    tool = _load_tool()
+    summary = tool.placement_summary(tool.parse_metrics(SYNTHETIC))
+    assert summary["placements"] == {"affinity": 6, "steal": 2, "cold": 2}
+    assert summary["claimed"] == 10
+    assert summary["affinity_hit_rate"] == 0.6
+    assert summary["steals"] == 2
+    assert summary["flushes"]["preempt"] == 1
+
+    table = tool.render(summary)
+    assert "affinity_hit_rate: 0.6" in table
+    assert "preempt" in table
+
+    # empty input degrades to a message, not a crash
+    empty = tool.placement_summary([])
+    assert empty["affinity_hit_rate"] is None
+    assert "no placements" in tool.render(empty)
+
+
+def test_inprocess_claim_smoke_prints_placement_table(sdaas_root, capsys):
+    """The tool's no-worker mode drives the real dispatch-board claim
+    path (cold -> affinity -> steal) and prints nonzero placements."""
+    tool = _load_tool()
+    rc = tool.main([])
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "cold -> affinity -> steal" in out.replace("claim sequence: ", "") \
+        or "affinity" in out
+    assert "affinity_hit_rate" in out
+    assert "steals" in out

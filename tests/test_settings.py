@@ -58,6 +58,58 @@ def test_tpu_fields_roundtrip(sdaas_root):
     assert s.dtype == "float32"
 
 
+def test_compile_cache_knob_layering(sdaas_root, monkeypatch):
+    from chiaswarm_tpu.compile_cache import resolve_cache_dir
+
+    s = load_settings()
+    assert s.compile_cache_dir == "xla_cache"
+    # relative default resolves under $SDAAS_ROOT
+    assert resolve_cache_dir(s) == sdaas_root / "xla_cache"
+    # env override wins, absolute paths pass through untouched
+    monkeypatch.setenv("CHIASWARM_COMPILE_CACHE_DIR", "/somewhere/xla")
+    assert str(resolve_cache_dir(load_settings())) == "/somewhere/xla"
+    # empty / "0" disable at zero cost
+    for off in ("", "0", "off"):
+        monkeypatch.setenv("CHIASWARM_COMPILE_CACHE_DIR", off)
+        assert resolve_cache_dir(load_settings()) is None
+
+
+def test_compile_cache_legacy_settings_key_still_loads(sdaas_root):
+    get_settings_full_path().write_text(
+        json.dumps({"compilation_cache_dir": "/old/spelling"}))
+    assert load_settings().compile_cache_dir == "/old/spelling"
+
+
+def test_enable_compile_cache_set_disabled_unwritable(
+        sdaas_root, monkeypatch, caplog):
+    """The three contract cases: a writable dir activates (and is
+    created), "" disables silently, an unwritable dir degrades to a
+    warning + disabled — never an exception."""
+    import logging
+
+    from chiaswarm_tpu.compile_cache import enable_compile_cache
+
+    import jax
+
+    target = sdaas_root / "xla_cache"
+    try:
+        assert enable_compile_cache(load_settings()) == target
+        assert target.is_dir()
+    finally:
+        # tmp_path dies with the test; jax must not keep spooling there
+        jax.config.update("jax_compilation_cache_dir", None)
+
+    monkeypatch.setenv("CHIASWARM_COMPILE_CACHE_DIR", "")
+    assert enable_compile_cache(load_settings()) is None
+
+    blocker = sdaas_root / "blocked"
+    blocker.write_text("a file where the cache dir should go")
+    monkeypatch.setenv("CHIASWARM_COMPILE_CACHE_DIR", str(blocker))
+    with caplog.at_level(logging.WARNING, logger="chiaswarm_tpu.compile_cache"):
+        assert enable_compile_cache(load_settings()) is None
+    assert any("not writable" in r.message for r in caplog.records)
+
+
 def test_observability_knobs(sdaas_root, monkeypatch):
     s = load_settings()
     assert s.metrics_port == 8061  # default: local /metrics + /healthz on

@@ -735,3 +735,126 @@ def test_healthz_degrades_on_stale_poll_and_outbox_saturation(sdaas_root):
             w._executor.shutdown(wait=False)
 
     asyncio.run(scenario())
+
+
+# --- residency-aware placement (dispatch board, ISSUE 4 tentpole) ---
+
+
+def test_placement_affinity_and_steal_across_two_slices(sdaas_root):
+    """The acceptance scenario on 2 real (virtual-CPU) slices: the first
+    tiny-SD job lands cold, a second same-model group lands on the slice
+    where the model is now resident (affinity), and when two same-model
+    groups arrive together the home slice takes one while the idle slice
+    STEALS the other instead of waiting — all asserted through
+    swarm_placement_total and the per-envelope placement stamp."""
+    from chiaswarm_tpu import telemetry
+    from chiaswarm_tpu.chips import allocator as alloc_mod
+
+    placements = telemetry.REGISTRY.get(
+        "swarm_placement_total") or telemetry.counter(
+        "swarm_placement_total", "", ("outcome",))
+    before = {o: placements.value(outcome=o)
+              for o in ("affinity", "steal", "cold")}
+    alloc_mod.reset_residency()
+
+    def sd_job(jid: str, steps: int = 2) -> dict:
+        return {"id": jid, "workflow": "txt2img",
+                "model_name": "stabilityai/stable-diffusion-2-1",
+                "prompt": jid, "height": 64, "width": 64,
+                "num_inference_steps": steps,
+                "parameters": {"test_tiny_model": True}}
+
+    async def scenario():
+        hive = await FakeHive().start()
+        hive.add_job(sd_job("job-place1"))
+        settings = Settings(sdaas_token="t", worker_name="w")
+        w = Worker(settings=settings,
+                   allocator=SliceAllocator(chips_per_job=4),  # 2 slices
+                   hive_uri=hive.uri)
+        runner = asyncio.create_task(w.run())
+        try:
+            await hive.wait_for_results(1, timeout=240.0)
+            # model now resident where job-place1 ran; a second group
+            # with both slices free must go HOME
+            hive.add_job(sd_job("job-place2"))
+            await hive.wait_for_results(2, timeout=240.0)
+            # two same-model groups in one poll burst (distinct step
+            # counts -> distinct coalesce keys -> two work items): the
+            # home slice takes one, the idle slice steals the other
+            hive.add_job(sd_job("job-place3"))
+            hive.add_job(sd_job("job-place4", steps=3))
+            results = await hive.wait_for_results(4, timeout=240.0)
+        finally:
+            w.stop()
+            await asyncio.wait_for(runner, 10)
+            await hive.stop()
+        return results
+
+    results = asyncio.run(scenario())
+    by_id = {r["id"]: r for r in results}
+    for r in results:
+        assert not r.get("fatal_error"), r["pipeline_config"]
+    assert by_id["job-place1"]["pipeline_config"]["placement"] == "cold"
+    assert by_id["job-place2"]["pipeline_config"]["placement"] == "affinity"
+    burst = {by_id["job-place3"]["pipeline_config"]["placement"],
+             by_id["job-place4"]["pipeline_config"]["placement"]}
+    assert burst == {"affinity", "steal"}, burst
+
+    deltas = {o: placements.value(outcome=o) - before[o]
+              for o in ("affinity", "steal", "cold")}
+    assert deltas["cold"] == 1
+    assert deltas["affinity"] == 2
+    assert deltas["steal"] == 1
+
+
+def test_compatible_img2img_jobs_coalesce_into_one_batch(sdaas_root):
+    """Batched img2img end to end (ROADMAP "beyond plain txt2img"):
+    3 compatible img2img jobs — per-request start images at a shared
+    explicit canvas and strength — execute as ONE stacked-init-latent
+    padded pass, each envelope keeping its own id, seed, and mode."""
+
+    async def scenario():
+        hive = await FakeHive().start()
+        image_uri = hive.uri[: -len("/api")] + "/image.png"
+        for i in range(3):
+            hive.add_job({
+                "id": f"job-i2i{i}",
+                "workflow": "img2img",
+                "model_name": "stabilityai/stable-diffusion-2-1",
+                "prompt": f"repainted subject {i}",
+                "seed": 3000 + i,
+                "start_image_uri": image_uri,
+                "strength": 0.5,
+                "height": 64,
+                "width": 64,
+                "num_inference_steps": 4,
+                "parameters": {"test_tiny_model": True},
+            })
+        settings = Settings(sdaas_token="t", worker_name="w")
+        w = Worker(settings=settings,
+                   allocator=SliceAllocator(chips_per_job=8),  # ONE slice
+                   hive_uri=hive.uri)
+        runner = asyncio.create_task(w.run())
+        try:
+            results = await hive.wait_for_results(3, timeout=240.0)
+        finally:
+            w.stop()
+            await asyncio.wait_for(runner, 10)
+            await hive.stop()
+        return results
+
+    results = asyncio.run(scenario())
+    assert {r["id"] for r in results} == {f"job-i2i{i}" for i in range(3)}
+    blobs = []
+    for r in sorted(results, key=lambda r: r["id"]):
+        cfg = r["pipeline_config"]
+        assert not r.get("fatal_error"), cfg
+        assert cfg["batched_with"] == 3, cfg  # ONE coalesced pass
+        assert cfg["mode"] == "img2img"
+        assert cfg["strength"] == 0.5
+        assert cfg["seed"] == 3000 + int(r["id"][-1])
+        blob = r["artifacts"]["primary"]["blob"]
+        assert base64.b64decode(blob).startswith(b"\xff\xd8")  # jpeg
+        blobs.append(blob)
+    # distinct seeds/prompts -> distinct images (no cross-row leakage)
+    assert len(set(blobs)) == 3
