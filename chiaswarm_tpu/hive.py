@@ -101,6 +101,18 @@ class HiveClient:
             "worker_name": self.settings.worker_name,
             **{k: str(v) for k, v in capabilities.items()},
         }
+        # placement signal for a residency-aware hive (hive_server/
+        # dispatch.py): which models are warm HERE rides the poll itself,
+        # so dispatch needs no second round trip. Filled from the
+        # process-global registry unless the caller already provided it;
+        # legacy hives ignore unknown query params.
+        if "resident_models" not in params:
+            try:
+                from .registry import resident_models
+
+                params["resident_models"] = ",".join(resident_models())
+            except Exception:  # advertisement is advisory, never a gate
+                pass
         session = await self._get_session()
         timeout = aiohttp.ClientTimeout(total=ASK_TIMEOUT_S)
         t0 = time.perf_counter()

@@ -1,0 +1,51 @@
+"""Embedded hive coordinator: the missing half of the swarm topology.
+
+The repo reproduced only the worker side of the paper's hive/worker
+split; every end-to-end path terminated in the hand-rolled test double
+(tests/fake_hive.py). This package is a real, in-repo coordinator
+speaking the exact wire protocol in `chiaswarm_tpu/hive.py` — a pristine
+`Worker` connects to it unmodified:
+
+- `queue.py`    priority-class job queue (interactive > default > batch,
+                FIFO within class) with admission backpressure on depth;
+- `dispatch.py` residency-aware dispatcher reading each worker's
+                advertised resident models and chip capabilities from the
+                /work query — the slice-level placement logic of
+                chips/allocator.py lifted one level up, to workers;
+- `leases.py`   lease table re-queuing jobs whose results never arrive
+                (bounded redeliveries, then a failed state) so a dead
+                worker costs one lease deadline, not the job;
+- `spool.py`    content-addressed artifact store for accepted results;
+- `app.py`      the aiohttp server tying it together (bearer auth,
+                400-with-message refusals, idempotent result ACKs,
+                /metrics + /healthz from the shared telemetry registry);
+- `harness.py`  in-process swarm (HiveServer + real Workers over real
+                sockets) for e2e tests, chaos scenarios, and the bench.
+
+Entry point: `tools/hive_serve.py` (or `python -m
+chiaswarm_tpu.hive_server`).
+"""
+
+from .app import HiveServer
+from .queue import JOB_CLASSES, JobRecord, PriorityJobQueue, QueueFull, job_class
+
+
+def __getattr__(name):
+    # LocalSwarm pulls in the whole Worker runtime (jax included); the
+    # coordinator itself must stay importable on a chip-less host, so
+    # the harness loads only when actually asked for
+    if name == "LocalSwarm":
+        from .harness import LocalSwarm
+
+        return LocalSwarm
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
+
+__all__ = [
+    "HiveServer",
+    "LocalSwarm",
+    "JOB_CLASSES",
+    "JobRecord",
+    "PriorityJobQueue",
+    "QueueFull",
+    "job_class",
+]

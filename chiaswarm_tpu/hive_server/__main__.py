@@ -1,0 +1,11 @@
+"""`python -m chiaswarm_tpu.hive_server` — same entry as tools/hive_serve.py."""
+
+import asyncio
+
+from .app import serve
+
+if __name__ == "__main__":
+    try:
+        asyncio.run(serve())
+    except KeyboardInterrupt:
+        print("hive stopped")

@@ -1,0 +1,429 @@
+"""The hive HTTP server: the wire protocol of hive.py, served.
+
+Protocol parity with the client in `chiaswarm_tpu/hive.py` (itself at
+parity with reference swarm/hive.py:9-88):
+
+  GET  /api/work?worker_version&worker_name&<capabilities>
+       -> 200 {"jobs": [...]} | 400 {"message": ...} (refusal)
+  POST /api/results  <- result envelope -> 200 ack JSON (idempotent)
+  GET  /api/models   -> {"models": [...], "language_models": [...]}
+
+plus the coordinator's own surface, which the reference hive kept
+closed-source:
+
+  POST /api/jobs            submit a job (admission control; 429 on a
+                            full queue), returns {"id", "class"}
+  GET  /api/jobs/{id}       lifecycle snapshot + spooled result
+  GET  /api/artifacts/{d}   content-addressed artifact bytes
+  GET  /metrics, /healthz   same telemetry registry the worker uses
+
+Auth is the same bearer token workers are provisioned with
+(`Settings.sdaas_token`); an empty token disables the check (dev mode).
+`GET /api/models` alone is unauthenticated — the reference hive serves
+its catalog publicly and the worker's `initialize --download` probe
+relies on that (tests/fake_hive.py pins the same exception).
+`refuse_with` mirrors tests/fake_hive.py: set it and /work answers 400
+with the message — the hive-side drain switch (workers back off and
+retry, nothing errors).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import logging
+import time
+
+from aiohttp import web
+
+from .. import telemetry
+from ..settings import Settings, get_settings_dir, load_settings, resolve_path
+from .dispatch import Dispatcher, WorkerDirectory
+from .leases import LeaseTable
+from .queue import PriorityJobQueue, QueueFull
+from .spool import ArtifactSpool
+
+logger = logging.getLogger(__name__)
+
+_RESULTS = telemetry.counter(
+    "swarm_hive_results_total",
+    "Result envelopes POSTed to the hive, by disposition "
+    "(ok | duplicate | late | unknown)",
+    ("status",),
+)
+_POLLS = telemetry.counter(
+    "swarm_hive_polls_total",
+    "GET /work polls answered, by reply (jobs | empty | refused)",
+    ("reply",),
+)
+# registered by leases.py (imported above); same-name counter() returns it
+_JOBS_FAILED = telemetry.counter("swarm_hive_jobs_failed_total")
+
+# served when no models.json exists under $SDAAS_ROOT — enough for a
+# worker's `initialize --download` probe to succeed against a dev hive
+_DEFAULT_CATALOG = {
+    "models": [{"id": "stabilityai/stable-diffusion-2-1"}],
+    "language_models": [],
+}
+
+
+class HiveServer:
+    """One coordinator instance; start()/stop() or `async with`."""
+
+    def __init__(self, settings: Settings | None = None,
+                 host: str | None = None, port: int | None = None):
+        self.settings = settings or load_settings()
+        g = lambda name, default: getattr(self.settings, name, default)  # noqa: E731
+        self.host = host if host is not None else g("hive_host", "127.0.0.1")
+        self.port = port if port is not None else int(g("hive_port", 9511))
+        self.token = str(g("sdaas_token", ""))
+        self.queue = PriorityJobQueue(
+            depth_limit=int(g("hive_queue_depth_limit", 256)),
+            history_limit=int(g("hive_job_history_limit", 1000)))
+        self.leases = LeaseTable(
+            deadline_s=float(g("hive_lease_deadline_s", 300.0)),
+            max_redeliveries=int(g("hive_max_redeliveries", 3)),
+        )
+        self.directory = WorkerDirectory(
+            ttl_s=float(g("hive_worker_ttl_s", 45.0)))
+        self.dispatcher = Dispatcher(
+            self.directory,
+            affinity_hold_s=float(g("hive_affinity_hold_s", 15.0)),
+            max_jobs_per_poll=int(g("hive_max_jobs_per_poll", 4)),
+        )
+        self.spool = ArtifactSpool(
+            resolve_path(g("hive_spool_dir", "hive_spool")))
+        self.refuse_with: str | None = None
+        self.started_at = time.monotonic()
+        self._runner: web.AppRunner | None = None
+        self._reaper: asyncio.Task | None = None
+
+    # --- lifecycle ---
+
+    @property
+    def uri(self) -> str:
+        return f"http://{self.host}:{self.port}"
+
+    @property
+    def api_uri(self) -> str:
+        return f"{self.uri}/api"
+
+    def build_app(self) -> web.Application:
+        app = web.Application(client_max_size=256 * 1024 * 1024)
+        app.router.add_get("/api/work", self._work)
+        app.router.add_post("/api/results", self._results)
+        app.router.add_get("/api/models", self._models)
+        app.router.add_post("/api/jobs", self._submit)
+        app.router.add_get("/api/jobs/{job_id}", self._job_status)
+        app.router.add_get("/api/artifacts/{digest}", self._artifact)
+        app.router.add_get("/metrics", self._metrics)
+        app.router.add_get("/healthz", self._healthz)
+        return app
+
+    async def start(self) -> "HiveServer":
+        self._runner = web.AppRunner(self.build_app())
+        await self._runner.setup()
+        site = web.TCPSite(self._runner, self.host, self.port)
+        await site.start()
+        # port 0 binds an ephemeral port; report the real one
+        self.port = site._server.sockets[0].getsockname()[1]
+        self._reaper = asyncio.create_task(
+            self._reap_loop(), name="hive_lease_reaper")
+        logger.info("hive coordinator on %s (lease %.0fs, queue limit %d)",
+                    self.uri, self.leases.deadline_s,
+                    self.queue.depth_limit)
+        return self
+
+    async def stop(self) -> None:
+        if self._reaper is not None:
+            self._reaper.cancel()
+            await asyncio.gather(self._reaper, return_exceptions=True)
+            self._reaper = None
+        if self._runner is not None:
+            await self._runner.cleanup()
+            self._runner = None
+
+    async def __aenter__(self) -> "HiveServer":
+        return await self.start()
+
+    async def __aexit__(self, *exc) -> None:
+        await self.stop()
+
+    async def _reap_loop(self) -> None:
+        """Expire overdue leases on a cadence well inside the deadline,
+        so a redelivery waits ~one deadline, not up to two."""
+        interval = min(1.0, max(self.leases.deadline_s / 4.0, 0.05))
+        while True:
+            await asyncio.sleep(interval)
+            try:
+                for record in self.leases.reap(self.queue):
+                    if record.state == "failed":
+                        self.queue.retire(record)
+                        logger.error("job %s failed: %s",
+                                     record.job_id, record.error)
+                    else:
+                        logger.warning(
+                            "lease expired for job %s (attempt %d); "
+                            "re-queued at the front of class %s",
+                            record.job_id, record.attempts,
+                            record.job_class)
+                self._park_unplaceable()
+            except Exception:
+                # the reaper is the only thing that frees a dead
+                # worker's lease; it must survive any single bad pass
+                logger.exception("lease reaper pass failed; continuing")
+
+    def _park_unplaceable(self) -> None:
+        """Park queued jobs no live worker can run. A job whose model
+        family every live worker advertises as unconverted is skipped by
+        dispatch on every poll — it never leases, so the redelivery
+        budget never engages, yet it occupies admission depth; enough of
+        them wedge the queue at 429 until a restart. Give each one a
+        full lease deadline of queue time for a capable worker to show
+        up, then fail it with the same parking machinery an exhausted
+        lease uses."""
+        cutoff = time.monotonic() - self.leases.deadline_s
+        for record in self.queue.iter_queued():
+            if record.submitted_at > cutoff:
+                continue
+            if not self.dispatcher.unplaceable(record):
+                continue
+            self.queue.discard_queued(record)
+            record.state = "failed"
+            record.error = (
+                "unplaceable: every live worker advertises this job's "
+                "model family as unconverted "
+                f"(waited {self.leases.deadline_s:g}s)")
+            self.queue.retire(record)
+            _JOBS_FAILED.inc()
+            logger.error("job %s failed: %s", record.job_id, record.error)
+
+    # --- auth ---
+
+    def _authorized(self, request: web.Request) -> bool:
+        if not self.token:
+            return True
+        return request.headers.get(
+            "Authorization", "") == f"Bearer {self.token}"
+
+    @staticmethod
+    def _unauthorized() -> web.Response:
+        return web.json_response({"message": "unauthorized"}, status=401)
+
+    # --- wire-protocol handlers ---
+
+    async def _work(self, request: web.Request) -> web.Response:
+        if not self._authorized(request):
+            return self._unauthorized()
+        if self.refuse_with is not None:
+            _POLLS.inc(reply="refused")
+            return web.json_response(
+                {"message": self.refuse_with}, status=400)
+        query = dict(request.query)
+        if not query.get("worker_version"):
+            # 400-with-message refusal, reference swarm/hive.py:39-44
+            _POLLS.inc(reply="refused")
+            return web.json_response(
+                {"message": "worker_version is required"}, status=400)
+        worker = self.directory.observe(query)
+        handed = self.dispatcher.select(worker, self.queue)
+        for record, outcome in handed:
+            self.queue.take(record, worker.name, outcome)
+            self.leases.grant(record, worker.name)
+            logger.info("dispatched job %s to %s (%s, attempt %d)",
+                        record.job_id, worker.name, outcome, record.attempts)
+        _POLLS.inc(reply="jobs" if handed else "empty")
+        return web.json_response(
+            {"jobs": [record.job for record, _ in handed]})
+
+    async def _results(self, request: web.Request) -> web.Response:
+        if not self._authorized(request):
+            return self._unauthorized()
+        body = await request.read()
+        try:
+            # a result envelope can be hundreds of MB of base64 blobs
+            # (client_max_size above); parsing that on the event loop
+            # would stall every other handler and the lease reaper
+            result = await asyncio.to_thread(json.loads, body)
+        except json.JSONDecodeError:
+            return web.json_response(
+                {"message": "result envelope is not JSON"}, status=400)
+        if not isinstance(result, dict):
+            return web.json_response(
+                {"message": "result envelope must be a JSON object"},
+                status=400)
+        job_id = str(result.get("id", ""))
+        record = self.queue.records.get(job_id)
+        if record is None:
+            # a job this hive never issued (e.g. another hive's outbox
+            # redelivery): ACK it anyway — a 4xx would make the worker
+            # park an envelope the operator may still want
+            _RESULTS.inc(status="unknown")
+            return web.json_response({"status": "ok", "unknown_job": True})
+        if record.state in ("done", "settling"):
+            # duplicate submit (outbox redelivery after a lost ACK, or a
+            # concurrent POST racing the spool write): idempotent ACK,
+            # nothing re-stored
+            _RESULTS.inc(status="duplicate")
+            return web.json_response({"status": "ok", "duplicate": True})
+        # the envelope's own worker_name (stamped by the worker's outbox
+        # path; optional on the wire) identifies the true sender — the
+        # current lease does NOT: a late result from an expired lessee
+        # can arrive while the redelivered copy is leased to someone else
+        sender = str(result.get("worker_name") or "") or None
+        lease = self.leases.settle(job_id)
+        if record.state == "queued":
+            # the original lessee answered after expiry, while the
+            # redelivered copy was still queued: take the result, cancel
+            # the redelivery
+            self.queue.discard_queued(record)
+            status = "late"
+        elif record.state == "failed":
+            status = "late"  # better late than parked
+        elif sender and lease and sender != lease.worker:
+            status = "late"  # an earlier lessee beat the current one
+        else:
+            status = "ok"
+        # "settling" (set with no await point since the state checks
+        # above) routes a concurrent duplicate POST to the idempotent
+        # ACK; the blob decode/hash/write itself runs in a thread so a
+        # multi-MB envelope never stalls /work polls or the lease reaper
+        record.state = "settling"
+        try:
+            stored = await asyncio.to_thread(self.spool.store_result, result)
+        except Exception:
+            # the spool is an optimization, never a gate on accepting a
+            # result: a full/read-only disk keeps the blobs inline rather
+            # than wedging the record in "settling" (where the worker's
+            # retry would be ACKed as a duplicate and the result lost)
+            logger.exception("artifact spool failed for job %s; "
+                             "keeping blobs inline", job_id)
+            stored = result
+        record.result = stored
+        record.error = None
+        record.done_at = time.monotonic()
+        record.completed_by = (
+            sender or (lease.worker if lease else record.worker))
+        record.state = "done"
+        self.queue.retire(record)
+        _RESULTS.inc(status=status)
+        return web.json_response({"status": "ok"})
+
+    async def _models(self, request: web.Request) -> web.Response:
+        # deliberately unauthenticated: public catalog, reference parity
+        # (see module docstring) — keep job data and metrics off it
+        catalog = _DEFAULT_CATALOG
+        path = get_settings_dir() / "models.json"
+        try:
+            data = json.loads(path.read_text())
+            if isinstance(data, dict) and "models" in data:
+                catalog = {
+                    "models": data.get("models", []),
+                    "language_models": data.get("language_models", []),
+                }
+        except (OSError, json.JSONDecodeError):
+            pass
+        return web.json_response(catalog)
+
+    # --- coordinator surface ---
+
+    async def _submit(self, request: web.Request) -> web.Response:
+        if not self._authorized(request):
+            return self._unauthorized()
+        try:
+            job = json.loads(await request.text())
+        except json.JSONDecodeError:
+            return web.json_response(
+                {"message": "job is not JSON"}, status=400)
+        if not isinstance(job, dict):
+            return web.json_response(
+                {"message": "job must be a JSON object"}, status=400)
+        try:
+            record = self.queue.submit(job)
+        except QueueFull as e:
+            return web.json_response({"message": str(e)}, status=429)
+        return web.json_response({
+            "id": record.job_id,
+            "class": record.job_class,
+            "status": record.state,
+            "depth": self.queue.depth,
+        })
+
+    async def _job_status(self, request: web.Request) -> web.Response:
+        if not self._authorized(request):
+            return self._unauthorized()
+        record = self.queue.records.get(request.match_info["job_id"])
+        if record is None:
+            return web.json_response(
+                {"message": "unknown job id"}, status=404)
+        return web.json_response(record.status())
+
+    async def _artifact(self, request: web.Request) -> web.Response:
+        if not self._authorized(request):
+            return self._unauthorized()
+        path = self.spool.path_for(request.match_info["digest"])
+        if path is None:
+            return web.json_response(
+                {"message": "unknown artifact"}, status=404)
+        # FileResponse streams via sendfile — a multi-hundred-MB blob
+        # neither blocks the event loop nor lands in memory whole
+        return web.FileResponse(
+            path, headers={"Content-Type": "application/octet-stream"})
+
+    # --- telemetry ---
+
+    async def _metrics(self, request: web.Request) -> web.Response:
+        return web.Response(
+            text=telemetry.REGISTRY.render(),
+            headers={
+                "Content-Type": "text/plain; version=0.0.4; charset=utf-8"},
+        )
+
+    def health(self) -> dict:
+        states: dict[str, int] = {}
+        for record in self.queue.records.values():
+            states[record.state] = states.get(record.state, 0) + 1
+        reasons = []
+        if (self.queue.depth_limit > 0
+                and self.queue.depth >= self.queue.depth_limit):
+            reasons.append(
+                f"queue full ({self.queue.depth}/{self.queue.depth_limit}): "
+                "admission refusing new jobs")
+        if self.refuse_with is not None:
+            reasons.append(f"draining: refusing workers ({self.refuse_with})")
+        return {
+            "status": "degraded" if reasons else "ok",
+            "degraded_reasons": reasons,
+            "uptime_s": round(time.monotonic() - self.started_at, 1),
+            "queue_depth": self.queue.depths(),
+            "leases_active": len(self.leases),
+            "jobs": states,
+            "workers": self.directory.snapshot(),
+        }
+
+    async def _healthz(self, request: web.Request) -> web.Response:
+        payload = self.health()
+        status = 200 if payload.get("status") == "ok" else 503
+        return web.json_response(payload, status=status)
+
+
+async def serve(settings: Settings | None = None, host: str | None = None,
+                port: int | None = None) -> None:
+    """Run a hive until SIGTERM/SIGINT (tools/hive_serve.py and
+    `python -m chiaswarm_tpu.hive_server`)."""
+    import signal
+
+    server = await HiveServer(settings, host=host, port=port).start()
+    print(f"hive coordinator listening on {server.uri} "
+          f"(workers poll {server.api_uri}/work)")
+    stop = asyncio.Event()
+    loop = asyncio.get_running_loop()
+    for sig in (signal.SIGINT, signal.SIGTERM):
+        try:
+            loop.add_signal_handler(sig, stop.set)
+        except (NotImplementedError, RuntimeError, ValueError):
+            pass
+    try:
+        await stop.wait()
+    finally:
+        await server.stop()

@@ -1,0 +1,224 @@
+"""Residency-aware dispatch: place jobs where the weights are warm.
+
+chips/allocator.py routes work items to the chip slice whose HBM already
+holds the model (affinity / cold / steal). This module is the same
+policy one level up — across WORKERS instead of slices — using only what
+each worker volunteers in its /work query: `resident_models` (the
+registry's warm set), `chips`/`hbm_gb`, live load, and the
+`unconverted_families` honesty key. SwiftDiffusion (arXiv 2407.02031)
+and LegoDiffusion (arXiv 2604.08123) both put the next serving win
+exactly here: a request placed on a cold worker pays the full weight
+load + compile; placed on the warm one it pays neither.
+
+Outcomes (counted in `swarm_hive_dispatch_total{outcome}`):
+
+- affinity  the polling worker already holds the job's model;
+- cold      no live worker holds it — whoever polls first loads it;
+- steal     a warm worker exists but the job has waited past
+            `affinity_hold_s`, so the cold poller takes it rather than
+            letting latency pile up behind a busy home;
+- hold      the job was SKIPPED this poll (a warm worker is live and the
+            hold window hasn't lapsed) — deferred, not dispatched.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+
+from .. import telemetry
+from ..batching import placement_model
+from .queue import JobRecord, PriorityJobQueue
+
+_DISPATCH = telemetry.counter(
+    "swarm_hive_dispatch_total",
+    "Hive /work dispatch decisions by placement outcome "
+    "(affinity | cold | steal | hold)",
+    ("outcome",),
+)
+_WORKERS_LIVE = telemetry.gauge(
+    "swarm_hive_workers_live",
+    "Distinct workers seen polling within the liveness window")
+
+
+def _split_csv(value: str | None) -> frozenset[str]:
+    return frozenset(
+        part.strip() for part in (value or "").split(",") if part.strip())
+
+
+def _to_int(value, default: int = 0) -> int:
+    try:
+        return int(float(value))
+    except (TypeError, ValueError):
+        return default
+
+
+@dataclasses.dataclass
+class WorkerInfo:
+    """One worker's latest self-advertisement, parsed from /work query
+    params (everything arrives stringified — hive.py ask_for_work)."""
+
+    name: str
+    version: str = ""
+    resident: frozenset[str] = frozenset()
+    unconverted: frozenset[str] = frozenset()
+    chips: int = 0
+    hbm_gb: int = 0
+    slices: int = 1
+    busy_slices: int = 0
+    queue_depth: int = 0
+    last_seen: float = 0.0
+
+    @property
+    def free_slices(self) -> int:
+        return max(self.slices - self.busy_slices, 0)
+
+    def can_run(self, model: str | None) -> bool:
+        """Capability gate from the honesty key: never hand a worker a
+        model family it advertised as unconverted (it can only fail)."""
+        if not model:
+            return True
+        lowered = model.lower()
+        return not any(k and k in lowered for k in self.unconverted)
+
+    def snapshot(self) -> dict:
+        return {
+            "name": self.name,
+            "version": self.version,
+            "chips": self.chips,
+            "hbm_gb": self.hbm_gb,
+            "slices": self.slices,
+            "busy_slices": self.busy_slices,
+            "queue_depth": self.queue_depth,
+            "resident_models": sorted(self.resident),
+        }
+
+
+class WorkerDirectory:
+    """Who is alive and what is warm where. Entries refresh on every
+    /work poll and age out after `ttl_s` — a dead worker's stale
+    residency claim must not hold jobs hostage (see live_holders)."""
+
+    def __init__(self, ttl_s: float):
+        self.ttl_s = max(float(ttl_s), 0.0)
+        self._workers: dict[str, WorkerInfo] = {}
+
+    def observe(self, query: dict) -> WorkerInfo:
+        name = str(query.get("worker_name") or "anonymous")
+        info = WorkerInfo(
+            name=name,
+            version=str(query.get("worker_version", "")),
+            resident=_split_csv(query.get("resident_models")),
+            # keywords are substring-matched against model.lower() in
+            # can_run — lowercase them here or a capitalized keyword
+            # fails open and the job dispatches to a worker that can
+            # only fail it
+            unconverted=_split_csv(
+                (query.get("unconverted_families") or "").lower()),
+            chips=_to_int(query.get("chips")),
+            hbm_gb=_to_int(query.get("hbm_gb")),
+            slices=max(_to_int(query.get("slices"), 1), 1),
+            busy_slices=_to_int(query.get("busy_slices")),
+            queue_depth=_to_int(query.get("queue_depth")),
+            last_seen=time.monotonic(),
+        )
+        self._workers[name] = info
+        # drop aged-out entries here rather than letting the dict grow
+        # with every worker name ever seen (ephemeral/autoscaled fleets
+        # register a fresh name per restart) — live() then scans only
+        # names that could actually matter
+        cutoff = time.monotonic() - self.ttl_s
+        for stale in [n for n, w in self._workers.items()
+                      if w.last_seen < cutoff]:
+            del self._workers[stale]
+        _WORKERS_LIVE.set(len(self.live()))
+        return info
+
+    def live(self) -> list[WorkerInfo]:
+        cutoff = time.monotonic() - self.ttl_s
+        return [w for w in self._workers.values() if w.last_seen >= cutoff]
+
+    def live_holders(self, model: str | None,
+                     exclude: str | None = None) -> list[WorkerInfo]:
+        """Live workers (other than `exclude`) advertising `model` warm."""
+        if not model:
+            return []
+        return [
+            w for w in self.live()
+            if w.name != exclude and model in w.resident
+        ]
+
+    def snapshot(self) -> list[dict]:
+        return [w.snapshot() for w in sorted(
+            self.live(), key=lambda w: w.name)]
+
+
+class Dispatcher:
+    """The placement decision for one /work poll."""
+
+    def __init__(self, directory: WorkerDirectory, affinity_hold_s: float,
+                 max_jobs_per_poll: int):
+        self.directory = directory
+        self.affinity_hold_s = max(float(affinity_hold_s), 0.0)
+        self.max_jobs_per_poll = max(int(max_jobs_per_poll), 1)
+
+    def _budget(self, worker: WorkerInfo) -> int:
+        """Jobs to hand this poll: the worker's advertised free capacity,
+        capped by the per-poll knob. A worker already sitting on a local
+        queue gets that counted against it — depth it reported is work
+        it has not started — and one advertising no net capacity gets
+        NOTHING: its poll is a heartbeat, and handing it a job anyway
+        would bury it while an idle worker's next poll could have taken
+        the job immediately. Workers that advertise no load fields at
+        all default to slices=1/busy=0/depth=0, i.e. budget 1."""
+        free = worker.free_slices - worker.queue_depth
+        return max(0, min(self.max_jobs_per_poll, free))
+
+    def unplaceable(self, record: JobRecord) -> bool:
+        """True when every LIVE worker has declared itself incapable of
+        the job's model family. Such a job is skipped by select() on
+        every poll, so it never leases — and therefore never reaches the
+        redelivery/failed machinery — while still counting against
+        admission depth. The reaper parks it (see HiveServer._reap_loop)
+        rather than letting it clog the queue forever. An empty
+        directory is NOT unplaceable: with nobody polling, the job
+        simply waits for a worker to arrive."""
+        live = self.directory.live()
+        if not live:
+            return False
+        model = placement_model(record.job)
+        return all(not w.can_run(model) for w in live)
+
+    def select(self, worker: WorkerInfo,
+               queue: PriorityJobQueue) -> list[tuple[JobRecord, str]]:
+        """Pick (record, outcome) pairs for this worker, class order
+        first, residency second. Jobs a warm OTHER worker should take
+        are held back ("hold") until `affinity_hold_s` lapses; jobs this
+        worker cannot run at all (unconverted family) are skipped
+        silently for it."""
+        handed: list[tuple[JobRecord, str]] = []
+        budget = self._budget(worker)
+        now = time.monotonic()
+        for record in queue.iter_queued():
+            if len(handed) >= budget:
+                break
+            # placement_model maps tiny-flagged jobs to the stand-in
+            # name the worker's registry (and therefore its advertised
+            # resident_models) actually knows them by
+            model = placement_model(record.job)
+            if not worker.can_run(model):
+                continue
+            if model and model in worker.resident:
+                outcome = "affinity"
+            else:
+                holders = self.directory.live_holders(model, exclude=worker.name)
+                if not holders:
+                    outcome = "cold"
+                elif now - record.submitted_at >= self.affinity_hold_s:
+                    outcome = "steal"
+                else:
+                    _DISPATCH.inc(outcome="hold")
+                    continue
+            _DISPATCH.inc(outcome=outcome)
+            handed.append((record, outcome))
+        return handed

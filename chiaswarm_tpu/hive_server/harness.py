@@ -1,0 +1,146 @@
+"""In-process swarm: a real HiveServer plus real Workers on real sockets.
+
+The worker tests fake the hive; the hive tests fake the worker. This
+harness is the seam where neither is faked: it stands up a HiveServer on
+an ephemeral loopback port and N pristine `Worker` instances pointed at
+it via HTTP, then drives jobs through POST /api/jobs. Used by the e2e
+tests (tests/test_hive_server.py), the chaos lease-takeover scenario
+(tools/chaos_smoke.py), and anything else that needs the whole swarm
+loop without subprocesses.
+
+Note: in-process workers share one registry/residency map, so two
+LocalSwarm workers always advertise identical resident models. Scenarios
+that need residency to DIFFER per worker (the affinity acceptance test,
+the bench row) use worker subprocesses or simulated pollers instead.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import dataclasses
+from typing import Any
+
+import aiohttp
+
+from ..chips.allocator import SliceAllocator
+from ..settings import Settings
+from ..worker import Worker
+from .app import HiveServer
+
+
+class LocalSwarm:
+    def __init__(self, n_workers: int = 1, chips_per_job: int = 0,
+                 settings: Settings | None = None,
+                 worker_overrides: dict[str, Any] | None = None):
+        self.settings = settings or Settings(
+            sdaas_token="local-swarm", worker_name="swarm-worker",
+            hive_port=0, metrics_port=0)
+        self.n_workers = n_workers
+        self.chips_per_job = chips_per_job
+        self.worker_overrides = worker_overrides or {}
+        self.hive: HiveServer | None = None
+        self.workers: list[Worker] = []
+        self._worker_tasks: list[asyncio.Task] = []
+        self._session: aiohttp.ClientSession | None = None
+
+    async def __aenter__(self) -> "LocalSwarm":
+        return await self.start()
+
+    async def __aexit__(self, *exc) -> None:
+        await self.stop()
+
+    async def start(self) -> "LocalSwarm":
+        self.hive = await HiveServer(self.settings, port=0).start()
+        for i in range(self.n_workers):
+            self.add_worker(f"swarm-worker-{i}")
+        self._session = aiohttp.ClientSession()
+        return self
+
+    def add_worker(self, name: str) -> Worker:
+        """Start one more pristine Worker against the hive (the
+        second-worker half of takeover scenarios). Workers inherit the
+        swarm's settings (a caller tuning e.g. job_deadline_s or
+        batch_linger_ms configures the whole swarm, not just the hive),
+        with per-worker identity and `worker_overrides` on top."""
+        worker = Worker(
+            settings=dataclasses.replace(
+                self.settings, worker_name=name, metrics_port=0,
+                **self.worker_overrides),
+            allocator=SliceAllocator(chips_per_job=self.chips_per_job),
+            hive_uri=self.hive.api_uri,
+        )
+        self.workers.append(worker)
+        self._worker_tasks.append(
+            asyncio.create_task(worker.run(), name=f"swarm_{name}"))
+        return worker
+
+    async def stop_worker(self, worker: Worker) -> None:
+        """Hard-stop one worker (no drain) — 'the worker died mid-lease'."""
+        idx = self.workers.index(worker)
+        worker.stop()
+        task = self._worker_tasks[idx]
+        await asyncio.wait_for(
+            asyncio.gather(task, return_exceptions=True), 10)
+
+    async def stop(self) -> None:
+        for worker in self.workers:
+            worker.stop()
+        await asyncio.gather(*self._worker_tasks, return_exceptions=True)
+        self.workers.clear()
+        self._worker_tasks.clear()
+        if self._session is not None:
+            await self._session.close()
+            self._session = None
+        if self.hive is not None:
+            await self.hive.stop()
+
+    # --- client surface (real HTTP against the hive) ---
+
+    def _headers(self) -> dict:
+        return {"Authorization": f"Bearer {self.settings.sdaas_token}",
+                "Content-type": "application/json"}
+
+    async def submit(self, job: dict) -> str:
+        import json
+
+        async with self._session.post(
+                f"{self.hive.api_uri}/jobs", data=json.dumps(job),
+                headers=self._headers()) as resp:
+            resp.raise_for_status()
+            payload = await resp.json()
+            return payload["id"]
+
+    async def job_status(self, job_id: str) -> dict:
+        async with self._session.get(
+                f"{self.hive.api_uri}/jobs/{job_id}",
+                headers=self._headers()) as resp:
+            resp.raise_for_status()
+            return await resp.json()
+
+    async def wait_done(self, job_id: str, timeout: float = 240.0,
+                        accept_failed: bool = False) -> dict:
+        """Poll until the job reaches a terminal state; returns the
+        status snapshot (result included, blobs as spool refs)."""
+        deadline = asyncio.get_running_loop().time() + timeout
+        while True:
+            status = await self.job_status(job_id)
+            if status["status"] == "done":
+                return status
+            if status["status"] == "failed":
+                if accept_failed:
+                    return status
+                raise AssertionError(
+                    f"job {job_id} failed at the hive: {status['error']}")
+            if asyncio.get_running_loop().time() >= deadline:
+                raise asyncio.TimeoutError(
+                    f"job {job_id} still {status['status']} "
+                    f"after {timeout:.0f}s")
+            await asyncio.sleep(0.05)
+
+    async def artifact(self, href_or_digest: str) -> bytes:
+        path = (href_or_digest if href_or_digest.startswith("/")
+                else f"/api/artifacts/{href_or_digest}")
+        async with self._session.get(f"{self.hive.uri}{path}",
+                                     headers=self._headers()) as resp:
+            resp.raise_for_status()
+            return await resp.read()

@@ -1,0 +1,97 @@
+"""Lease table: a dispatched job is a loan, not a transfer.
+
+The worker side already treats result delivery as at-least-once (durable
+outbox, redelivery across restarts); this module is the counterparty
+that makes those semantics mean something. Every job handed out on
+/work gets a lease with a deadline; a result arriving before the
+deadline settles it, and the reaper re-queues anything else — a worker
+that died mid-denoise costs one lease deadline, not the job. After
+`max_redeliveries` expiries the job parks in a `failed` state with the
+history visible, so a poison job cannot ping-pong around the swarm
+forever.
+"""
+
+from __future__ import annotations
+
+import time
+
+from .. import telemetry
+from .queue import JobRecord, PriorityJobQueue
+
+_LEASES_ACTIVE = telemetry.gauge(
+    "swarm_hive_leases_active", "Jobs currently leased to a worker")
+_LEASES_EXPIRED = telemetry.counter(
+    "swarm_hive_leases_expired_total",
+    "Leases that hit their deadline without a result (each one is a "
+    "redelivery, or the final failure when the budget is spent)",
+)
+_JOBS_FAILED = telemetry.counter(
+    "swarm_hive_jobs_failed_total",
+    "Jobs parked as failed: redelivery budget exhausted, or unplaceable "
+    "(no live worker can run the model family)",
+)
+
+
+class Lease:
+    __slots__ = ("record", "worker", "expires_at")
+
+    def __init__(self, record: JobRecord, worker: str, expires_at: float):
+        self.record = record
+        self.worker = worker
+        self.expires_at = expires_at
+
+
+class LeaseTable:
+    def __init__(self, deadline_s: float, max_redeliveries: int):
+        self.deadline_s = max(float(deadline_s), 0.0)
+        self.max_redeliveries = max(int(max_redeliveries), 0)
+        self._leases: dict[str, Lease] = {}
+
+    def __len__(self) -> int:
+        return len(self._leases)
+
+    def get(self, job_id: str) -> Lease | None:
+        return self._leases.get(job_id)
+
+    def grant(self, record: JobRecord, worker: str) -> Lease:
+        lease = Lease(record, worker, time.monotonic() + self.deadline_s)
+        self._leases[record.job_id] = lease
+        _LEASES_ACTIVE.set(len(self._leases))
+        return lease
+
+    def settle(self, job_id: str) -> Lease | None:
+        """Drop the lease on a result arrival (normal completion — also
+        called for late results so an already-expired worker's answer
+        stops any further redelivery)."""
+        lease = self._leases.pop(job_id, None)
+        _LEASES_ACTIVE.set(len(self._leases))
+        return lease
+
+    def reap(self, queue: PriorityJobQueue) -> list[JobRecord]:
+        """Expire overdue leases: re-queue while the redelivery budget
+        lasts, park as failed after. Returns the records that changed
+        state (the caller logs them)."""
+        now = time.monotonic()
+        changed: list[JobRecord] = []
+        for job_id, lease in list(self._leases.items()):
+            if lease.expires_at > now:
+                continue
+            del self._leases[job_id]
+            record = lease.record
+            _LEASES_EXPIRED.inc()
+            # attempts counts dispatches; the budget bounds how many
+            # times the job may be handed out in total
+            if record.attempts > self.max_redeliveries:
+                record.state = "failed"
+                record.error = (
+                    f"lease expired {record.attempts} time(s) "
+                    f"(deadline {self.deadline_s:g}s, last worker "
+                    f"{lease.worker}); redelivery budget "
+                    f"{self.max_redeliveries} exhausted"
+                )
+                _JOBS_FAILED.inc()
+            else:
+                queue.requeue_front(record)
+            changed.append(record)
+        _LEASES_ACTIVE.set(len(self._leases))
+        return changed

@@ -1,0 +1,227 @@
+"""Priority-class job queue for the hive coordinator.
+
+Three classes — interactive > default > batch — each FIFO, dispatched
+strictly in class order (an interactive job submitted last still leaves
+before every queued batch job). The class comes from the job's own
+`priority` field (or the legacy `sdaas_priority` spelling), the same key
+the worker's BatchScheduler fast-path reads, so priority is one value
+end to end: hive queue class -> job dict on the wire -> linger-skip on
+the slice.
+
+Admission is backpressure, not silent truncation: past
+`depth_limit` total queued jobs, `submit` raises QueueFull and the HTTP
+layer answers 429 with a message — the submitter decides whether to
+retry, the hive never grows an unbounded backlog.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import itertools
+import time
+import uuid
+from collections import deque
+
+from .. import telemetry
+
+# dispatch order, highest first
+JOB_CLASSES = ("interactive", "default", "batch")
+
+_QUEUE_DEPTH = telemetry.gauge(
+    "swarm_hive_queue_depth",
+    "Jobs queued at the hive awaiting dispatch, by priority class",
+    ("class",),
+)
+_SUBMITTED = telemetry.counter(
+    "swarm_hive_jobs_submitted_total",
+    "Jobs accepted into the hive queue, by priority class",
+    ("class",),
+)
+_REFUSED = telemetry.counter(
+    "swarm_hive_jobs_refused_total",
+    "Job submissions refused by admission control (queue depth limit)",
+)
+_QUEUE_WAIT = telemetry.histogram(
+    "swarm_hive_queue_wait_seconds",
+    "Hive-side wait from job submission to dispatch to a worker",
+)
+
+
+def job_class(job: dict) -> str:
+    """The queue class a raw job dict belongs to; unknown/absent
+    priorities land in "default" (legacy hives send no priority at all).
+    """
+    for key in ("priority", "sdaas_priority"):
+        value = str(job.get(key, "")).lower()
+        if value in JOB_CLASSES:
+            return value
+    return "default"
+
+
+class QueueFull(Exception):
+    """Admission control refused the job; the message is wire-ready."""
+
+
+@dataclasses.dataclass
+class JobRecord:
+    """One job's hive-side lifecycle. `state` walks
+    queued -> leased -> settling -> done, with the exit `failed`
+    (redelivery budget exhausted) and a leased->queued loop on lease
+    expiry ("settling" = result accepted, artifact spool write in
+    flight)."""
+
+    job: dict
+    job_id: str
+    job_class: str
+    submitted_at: float  # monotonic
+    seq: int
+    state: str = "queued"
+    attempts: int = 0  # dispatches so far
+    worker: str | None = None  # current/last lessee
+    completed_by: str | None = None
+    queue_wait_s: float | None = None  # first submit -> first dispatch
+    placement: str | None = None  # last dispatch outcome
+    result: dict | None = None  # spooled envelope (blob refs, not blobs)
+    error: str | None = None
+    done_at: float | None = None  # monotonic, stamped on result acceptance
+    retired: bool = False  # already counted against history_limit
+
+    def status(self) -> dict:
+        """JSON-ready snapshot for GET /api/jobs/{id}."""
+        return {
+            "id": self.job_id,
+            "class": self.job_class,
+            "status": self.state,
+            "attempts": self.attempts,
+            "worker": self.worker,
+            "completed_by": self.completed_by,
+            "queue_wait_s": self.queue_wait_s,
+            "placement": self.placement,
+            "error": self.error,
+            "result": self.result,
+        }
+
+
+class PriorityJobQueue:
+    """Class-ordered FIFO queue + the record table for every job the hive
+    has ever admitted this process. Single-threaded by design: every
+    caller is an aiohttp handler or the reaper task on one event loop."""
+
+    def __init__(self, depth_limit: int = 0, history_limit: int = 0):
+        self.depth_limit = int(depth_limit)
+        # finished (done/failed) records kept for GET /api/jobs/{id};
+        # past this many the oldest are forgotten so a long-running
+        # coordinator's memory is bounded by the limit, not its job
+        # history (0 = keep everything)
+        self.history_limit = int(history_limit)
+        self._queues: dict[str, deque[JobRecord]] = {
+            cls: deque() for cls in JOB_CLASSES
+        }
+        self.records: dict[str, JobRecord] = {}
+        self._finished: deque[str] = deque()
+        self._seq = itertools.count()
+        self._refresh_gauges()
+
+    def _refresh_gauges(self) -> None:
+        for cls, q in self._queues.items():
+            _QUEUE_DEPTH.set(len(q), **{"class": cls})
+
+    @property
+    def depth(self) -> int:
+        return sum(len(q) for q in self._queues.values())
+
+    def depths(self) -> dict[str, int]:
+        return {cls: len(q) for cls, q in self._queues.items()}
+
+    def submit(self, job: dict) -> JobRecord:
+        """Admit one raw job dict; assigns an id when the submitter sent
+        none. Raises QueueFull past the depth limit (interactive jobs
+        included — a full hive must shed load, not reorder it away)."""
+        job = dict(job)
+        job_id = str(job.get("id") or uuid.uuid4().hex)
+        job["id"] = job_id
+        if job_id in self.records:
+            # resubmission of a known id: hand back the existing record
+            # (the hive dedupes by job id, mirroring what workers already
+            # assume when they redeliver results at-least-once); dedup
+            # beats admission — a retry of an admitted job is not load
+            return self.records[job_id]
+        if self.depth_limit > 0 and self.depth >= self.depth_limit:
+            _REFUSED.inc()
+            raise QueueFull(
+                f"hive queue full ({self.depth} jobs, limit "
+                f"{self.depth_limit}); resubmit later"
+            )
+        cls = job_class(job)
+        record = JobRecord(
+            job=job,
+            job_id=job_id,
+            job_class=cls,
+            submitted_at=time.monotonic(),
+            seq=next(self._seq),
+        )
+        self.records[job_id] = record
+        self._queues[cls].append(record)
+        _SUBMITTED.inc(**{"class": cls})
+        self._refresh_gauges()
+        return record
+
+    def iter_queued(self):
+        """Records in dispatch order: class rank, FIFO within class.
+        Snapshot copy — callers take() entries while iterating."""
+        for cls in JOB_CLASSES:
+            yield from list(self._queues[cls])
+
+    def take(self, record: JobRecord, worker: str, outcome: str) -> None:
+        """Remove a queued record for dispatch and stamp its lease-side
+        bookkeeping (attempts, queue wait on the first dispatch)."""
+        self._queues[record.job_class].remove(record)
+        record.state = "leased"
+        record.worker = worker
+        record.attempts += 1
+        record.placement = outcome
+        if record.queue_wait_s is None:
+            record.queue_wait_s = round(
+                time.monotonic() - record.submitted_at, 3)
+            _QUEUE_WAIT.observe(record.queue_wait_s)
+        self._refresh_gauges()
+
+    def requeue_front(self, record: JobRecord) -> None:
+        """Put an expired-lease job back at the FRONT of its class: a
+        redelivery has already waited a full lease deadline and must not
+        queue behind fresh arrivals of the same class. `worker` keeps
+        the expired lessee's name — a LATE result from it is attributed
+        correctly, and the next take() overwrites it anyway."""
+        record.state = "queued"
+        self._queues[record.job_class].appendleft(record)
+        self._refresh_gauges()
+
+    def retire(self, record: JobRecord) -> None:
+        """Note a record reaching a terminal state and prune the oldest
+        finished ones past `history_limit`. Spooled artifact files stay
+        on disk (content-addressed); only the in-memory status entry is
+        forgotten — a later poll for a pruned id answers 404, the same
+        as a job this hive never knew."""
+        if self.history_limit <= 0:
+            return
+        if record.retired:
+            # a failed job completed later by a late result passes
+            # through twice (reaper, then _results); one _finished slot
+            # per record or the pruning loop evicts other records early
+            return
+        record.retired = True
+        self._finished.append(record.job_id)
+        while len(self._finished) > self.history_limit:
+            old = self._finished.popleft()
+            stale = self.records.get(old)
+            if stale is not None and stale.state in ("done", "failed"):
+                del self.records[old]
+
+    def discard_queued(self, record: JobRecord) -> None:
+        """Drop a record from its class queue if present (a late result
+        arrived for a job we had already re-queued)."""
+        try:
+            self._queues[record.job_class].remove(record)
+        except ValueError:
+            return
+        self._refresh_gauges()

@@ -1,0 +1,132 @@
+"""Content-addressed artifact spool for accepted results.
+
+Result envelopes arrive with base64 blobs inline (the wire format the
+reference hive defined). Keeping those in memory per job would make the
+hive's footprint proportional to its history, and identical artifacts
+(error images, redelivered duplicates) would be stored twice. The spool
+writes each decoded blob once under its own sha256
+(``<dir>/<aa>/<digest>``, atomic tmp+rename like outbox.py) and hands
+back the envelope with blobs replaced by references::
+
+    {"sha256": ..., "bytes": N, "href": "/api/artifacts/<digest>"}
+
+``GET /api/artifacts/{digest}`` serves the bytes back. Thumbnails stay
+inline — they are a few KB and exist to be embedded.
+"""
+
+from __future__ import annotations
+
+import base64
+import binascii
+import hashlib
+import logging
+import os
+import threading
+import uuid
+from pathlib import Path
+
+from .. import telemetry
+
+logger = logging.getLogger(__name__)
+
+_SPOOLED = telemetry.counter(
+    "swarm_hive_spool_writes_total",
+    "Artifact blobs written to the content-addressed spool, by outcome "
+    "(stored | dedup | error)",
+    ("outcome",),
+)
+_SPOOL_BYTES = telemetry.gauge(
+    "swarm_hive_spool_bytes", "Total bytes resident in the artifact spool")
+
+
+class ArtifactSpool:
+    def __init__(self, root: Path):
+        self.root = Path(root)
+        self.root.mkdir(parents=True, exist_ok=True)
+        # a crash between tmp write and rename leaves dot-prefixed .tmp
+        # orphans (invisible to the glob below, but they leak disk)
+        for orphan in self.root.glob("*/.*.tmp"):
+            try:
+                orphan.unlink()
+            except OSError:
+                pass
+        self._lock = threading.Lock()
+        self._bytes = sum(
+            f.stat().st_size for f in self.root.glob("*/*") if f.is_file())
+        _SPOOL_BYTES.set(self._bytes)
+
+    def _path(self, digest: str) -> Path:
+        return self.root / digest[:2] / digest
+
+    def put(self, payload: bytes) -> str:
+        """Store one blob; returns its sha256. Idempotent — an existing
+        entry is trusted by its name (content addressing). Serialized:
+        store_result runs in to_thread workers, and two concurrent puts
+        of the same payload must not double-count the byte gauge."""
+        digest = hashlib.sha256(payload).hexdigest()
+        path = self._path(digest)
+        with self._lock:
+            if path.exists():
+                _SPOOLED.inc(outcome="dedup")
+                return digest
+            path.parent.mkdir(parents=True, exist_ok=True)
+            tmp = path.parent / f".{digest}.{uuid.uuid4().hex}.tmp"
+            tmp.write_bytes(payload)
+            os.replace(tmp, path)
+            self._bytes += len(payload)
+            _SPOOL_BYTES.set(self._bytes)
+            _SPOOLED.inc(outcome="stored")
+        return digest
+
+    def path_for(self, digest: str) -> Path | None:
+        """Path to a stored blob, or None if absent/invalid. The digest
+        is validated as hex before touching the filesystem — it arrives
+        from a URL. HTTP handlers serve this path as a streamed file
+        response instead of buffering the blob in memory."""
+        if not (len(digest) == 64 and all(
+                c in "0123456789abcdef" for c in digest)):
+            return None
+        path = self._path(digest)
+        return path if path.is_file() else None
+
+    def get(self, digest: str) -> bytes | None:
+        """Blob bytes by digest, or None."""
+        path = self.path_for(digest)
+        if path is None:
+            return None
+        try:
+            return path.read_bytes()
+        except OSError:
+            return None
+
+    def store_result(self, result: dict) -> dict:
+        """Spool every artifact blob in an envelope; returns a copy with
+        blobs replaced by spool references. A blob that fails to decode
+        is kept inline rather than lost — the spool is an optimization,
+        never a gate on accepting a worker's result."""
+        stored = dict(result)
+        artifacts = result.get("artifacts")
+        if not isinstance(artifacts, dict):
+            return stored
+        out = {}
+        for name, art in artifacts.items():
+            if not (isinstance(art, dict) and isinstance(
+                    art.get("blob"), str)):
+                out[name] = art
+                continue
+            try:
+                payload = base64.b64decode(art["blob"])
+            except (binascii.Error, ValueError):
+                _SPOOLED.inc(outcome="error")
+                logger.warning("artifact %r blob is not base64; kept inline",
+                               name)
+                out[name] = art
+                continue
+            digest = self.put(payload)
+            ref = {k: v for k, v in art.items() if k != "blob"}
+            ref["sha256"] = digest
+            ref["bytes"] = len(payload)
+            ref["href"] = f"/api/artifacts/{digest}"
+            out[name] = ref
+        stored["artifacts"] = out
+        return stored

@@ -103,6 +103,36 @@ class Settings:
     # deterministic fault-injection spec (faults.py), e.g.
     # "drop_submit=3,hang_denoise=1"; empty = no faults armed
     fault_injection: str = ""
+    # --- embedded hive coordinator (hive_server/, tools/hive_serve.py) ---
+    # bind address/port for the coordinator; the port default matches the
+    # worker's sdaas_uri default, so `hive_serve` + a stock worker on one
+    # host form a swarm with zero configuration (0 = ephemeral port)
+    hive_host: str = "127.0.0.1"
+    hive_port: int = 9511
+    # how long a dispatched job may go without a result before its lease
+    # expires and the job is re-queued for another worker
+    hive_lease_deadline_s: float = 300.0
+    # expired-lease redeliveries before the job parks as failed (a poison
+    # job must not ping-pong around the swarm forever)
+    hive_max_redeliveries: int = 3
+    # total queued jobs past which POST /api/jobs answers 429 (admission
+    # backpressure; 0 = unlimited)
+    hive_queue_depth_limit: int = 256
+    # how long a job waits for its model's WARM worker to poll before any
+    # cold worker may steal it (residency-aware dispatch)
+    hive_affinity_hold_s: float = 15.0
+    # a worker unseen for this long stops counting as a live residency
+    # holder (3-4 poll cadences; dead workers must not hold jobs hostage)
+    hive_worker_ttl_s: float = 45.0
+    # most jobs one /work poll may hand out (also capped by the worker's
+    # advertised free capacity)
+    hive_max_jobs_per_poll: int = 4
+    # content-addressed artifact spool directory (relative to $SDAAS_ROOT)
+    hive_spool_dir: str = "hive_spool"
+    # finished (done/failed) job records kept in memory for
+    # GET /api/jobs/{id}; older ones are forgotten so coordinator memory
+    # is bounded by this, not by job history (0 = keep everything)
+    hive_job_history_limit: int = 1000
 
     @classmethod
     def field_names(cls) -> tuple[str, ...]:
@@ -132,6 +162,16 @@ _ENV_OVERRIDES = {
     "CHIASWARM_OUTBOX_DIR": "outbox_dir",
     "CHIASWARM_OUTBOX_MAX_ENTRIES": "outbox_max_entries",
     "CHIASWARM_FAULTS": "fault_injection",
+    "CHIASWARM_HIVE_HOST": "hive_host",
+    "CHIASWARM_HIVE_PORT": "hive_port",
+    "CHIASWARM_HIVE_LEASE_DEADLINE_S": "hive_lease_deadline_s",
+    "CHIASWARM_HIVE_MAX_REDELIVERIES": "hive_max_redeliveries",
+    "CHIASWARM_HIVE_QUEUE_DEPTH_LIMIT": "hive_queue_depth_limit",
+    "CHIASWARM_HIVE_AFFINITY_HOLD_S": "hive_affinity_hold_s",
+    "CHIASWARM_HIVE_WORKER_TTL_S": "hive_worker_ttl_s",
+    "CHIASWARM_HIVE_MAX_JOBS_PER_POLL": "hive_max_jobs_per_poll",
+    "CHIASWARM_HIVE_SPOOL_DIR": "hive_spool_dir",
+    "CHIASWARM_HIVE_JOB_HISTORY_LIMIT": "hive_job_history_limit",
 }
 
 

@@ -43,6 +43,7 @@ from __future__ import annotations
 
 import asyncio
 import logging
+import os
 import random
 import signal
 import time
@@ -67,7 +68,28 @@ from .telemetry import observe_stage, trace_job
 
 logger = logging.getLogger(__name__)
 
-POLL_SECONDS = 11
+# reference cadence is 11 s; the env knob exists for worker SUBPROCESSES
+# driven by the bench/e2e harness, which cannot monkeypatch the module
+# the way the in-process tests do
+
+
+def _env_poll_seconds() -> float:
+    raw = os.environ.get("CHIASWARM_POLL_SECONDS", "")
+    try:
+        value = float(raw)
+    except ValueError:
+        if raw:
+            logger.warning(
+                "CHIASWARM_POLL_SECONDS=%r is not a number; using 11", raw)
+        return 11.0
+    if value <= 0:  # a zero/negative cadence would busy-loop the hive
+        logger.warning(
+            "CHIASWARM_POLL_SECONDS=%r must be positive; using 11", raw)
+        return 11.0
+    return value
+
+
+POLL_SECONDS = _env_poll_seconds()
 ERROR_BACKOFF_SECONDS = 121
 
 
@@ -438,6 +460,11 @@ class Worker:
         # hives ignore unknown query params)
         caps["jobs_in_flight"] = self.batcher.outstanding_jobs
         caps["busy_slices"] = len(self.allocator) - self.allocator.free_count
+        # jobs accepted but not yet executing (lingering + board): the
+        # residency-aware hive counts this against the next poll's
+        # dispatch budget so it never buries one worker in work
+        caps["queue_depth"] = (
+            self.batcher.pending_jobs + self.batcher.ready_jobs)
         caps["jobs_completed"] = int(_JOBS_COMPLETED.total())
         if self._last_poll_monotonic is not None:
             caps["last_poll_age_s"] = round(
@@ -799,6 +826,11 @@ class Worker:
         cannot be silently lost: only a hive ACK unlinks the file. The
         write runs off-loop: a multi-MB artifact envelope on a slow disk
         must not stall timers, polls, or the drain watcher."""
+        # the sender's identity rides the envelope (legacy hives ignore
+        # unknown keys): a lease-tracking hive needs it to attribute a
+        # LATE result to the worker that actually produced it, not to
+        # whoever holds the redelivered lease at arrival time
+        result.setdefault("worker_name", self.settings.worker_name)
         entry = await asyncio.get_running_loop().run_in_executor(
             None, self.outbox.spool, result)
         await self.result_queue.put(entry)

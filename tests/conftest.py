@@ -60,6 +60,7 @@ _QUICK_MODULES = {
     "test_external_resources",
     "test_faults",
     "test_flash_attention",
+    "test_hive_protocol",
     "test_job_arguments",
     "test_loras",
     "test_mpeg_audio",

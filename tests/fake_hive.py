@@ -22,6 +22,12 @@ class FakeHive:
         self.work_requests: list[dict] = []
         self.result_event = asyncio.Event()
         self.refuse_with: str | None = None  # set -> /work returns 400 + message
+        # set -> /work and /results require this bearer token (401 else);
+        # None skips the check. The protocol-conformance suite
+        # (tests/test_hive_protocol.py) pins this to the real hive
+        # server's auth behavior so the fake cannot drift from the wire
+        # contract again.
+        self.expected_token: str | None = None
         # next N POST /results answer 500 before succeeding (retry tests)
         self.fail_results_times: int = 0
         # next N POST /results have their CONNECTION dropped mid-request
@@ -78,7 +84,18 @@ class FakeHive:
             request.transport.close()
         return web.Response(status=500, text="dropped")  # never reaches client
 
+    def _unauthorized(self, request: web.Request) -> web.Response | None:
+        if self.expected_token is None:
+            return None
+        if request.headers.get(
+                "Authorization") == f"Bearer {self.expected_token}":
+            return None
+        return web.json_response({"message": "unauthorized"}, status=401)
+
     async def _work(self, request: web.Request) -> web.Response:
+        denied = self._unauthorized(request)
+        if denied is not None:
+            return denied
         self.work_requests.append(dict(request.query))
         if self.drop_work_times > 0:
             self.drop_work_times -= 1
@@ -89,6 +106,9 @@ class FakeHive:
         return web.json_response({"jobs": jobs})
 
     async def _results(self, request: web.Request) -> web.Response:
+        denied = self._unauthorized(request)
+        if denied is not None:
+            return denied
         self.result_attempts += 1
         if self.slow_results_s:
             await asyncio.sleep(self.slow_results_s)

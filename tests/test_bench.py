@@ -71,6 +71,15 @@ def test_bench_cpu_fallback_produces_labeled_smoke_row():
     assert out.get("affinity_hit_rate", 0) > 0, out
     assert out.get("steals") == 1, out
 
+    # whole-swarm-loop row (ISSUE 5): embedded hive + pristine worker
+    # subprocess over real sockets; a healthy run redelivers nothing
+    assert out.get("hive_e2e_jobs_per_s", 0) > 0, out
+    assert out.get("hive_e2e_jobs", 0) >= 1, out
+    assert out.get("hive_e2e_redeliveries") == 0, out
+    assert out.get("hive_e2e_queue_wait_p50_s") is not None, out
+    assert out.get("hive_e2e_queue_wait_p95_s") >= \
+        out["hive_e2e_queue_wait_p50_s"], out
+
     # cross-job micro-batching row (4-virtual-device slice child): the
     # coalesce ladder landed, and filling the slice beats batch-1 passes
     # (structurally ~4x here — replicated vs sharded — so >1 is a safe,

@@ -1,7 +1,8 @@
 """tools/chaos_smoke.py wired into CI: every fault-injection scenario —
 submit drops, hive connection drops, hang-in-denoise under the watchdog,
-crash-before-ack, drain-with-in-flight-job — must end with a healthy
-worker and zero lost envelopes.
+crash-before-ack, drain-with-in-flight-job, and a hive-side lease
+takeover (worker dies mid-lease, the real coordinator redelivers to a
+second worker) — must end with a healthy swarm and zero lost envelopes.
 """
 
 import importlib.util
@@ -27,6 +28,7 @@ def _load_tool():
     "hang_watchdog",
     "kill_before_ack",
     "sigterm_drain",
+    "hive_lease_takeover",
 ])
 def test_chaos_scenario(name, sdaas_root):
     tool = _load_tool()

@@ -1,0 +1,256 @@
+"""Wire-protocol conformance: FakeHive and the real hive_server answer
+identically to the worker's own client.
+
+Every assertion here runs against BOTH backends (parametrized), driven
+through `chiaswarm_tpu.hive.HiveClient` — the exact code a production
+worker uses — plus raw aiohttp where the contract is about status codes
+and payload shapes. The fake can therefore never drift from the real
+coordinator's wire contract again: a behavior change in either backend
+breaks this suite until the other follows.
+"""
+
+import asyncio
+import json
+
+import aiohttp
+import pytest
+
+from chiaswarm_tpu.hive import HiveClient
+from chiaswarm_tpu.settings import Settings
+
+from .fake_hive import FakeHive
+
+TOKEN = "conformance-token"
+
+
+class FakeBackend:
+    name = "fake"
+
+    async def start(self):
+        self.hive = await FakeHive().start()
+        self.hive.expected_token = TOKEN
+        return self
+
+    @property
+    def uri(self) -> str:
+        return self.hive.uri
+
+    def queue_job(self, job: dict) -> None:
+        self.hive.add_job(job)
+
+    def refuse(self, message: str) -> None:
+        self.hive.refuse_with = message
+
+    async def stop(self) -> None:
+        await self.hive.stop()
+
+
+class RealBackend:
+    name = "real"
+
+    async def start(self):
+        from chiaswarm_tpu.hive_server import HiveServer
+
+        settings = Settings(sdaas_token=TOKEN, hive_port=0,
+                            hive_max_jobs_per_poll=8)
+        self.server = await HiveServer(settings, port=0).start()
+        return self
+
+    @property
+    def uri(self) -> str:
+        return self.server.api_uri
+
+    def queue_job(self, job: dict) -> None:
+        # submission is the coordinator's own surface, not part of the
+        # worker-facing wire contract under test — enqueue directly
+        self.server.queue.submit(job)
+
+    def refuse(self, message: str) -> None:
+        self.server.refuse_with = message
+
+    async def stop(self) -> None:
+        await self.server.stop()
+
+
+BACKENDS = {"fake": FakeBackend, "real": RealBackend}
+
+
+def run_conformance(backend_name: str, scenario):
+    """Stand a backend up, run one async scenario against it, tear down."""
+
+    async def _run():
+        backend = await BACKENDS[backend_name]().start()
+        client = HiveClient(Settings(sdaas_token=TOKEN), backend.uri)
+        try:
+            return await scenario(backend, client)
+        finally:
+            await client.close()
+            await backend.stop()
+
+    return asyncio.run(_run())
+
+
+CAPS = {"memory": 16, "gpu": "tpu", "chips": 4, "hbm_gb": 64,
+        "slices": 2, "busy_slices": 0, "queue_depth": 0, "topology": "cpux4"}
+
+
+def echo_job(job_id: str = "conf-1") -> dict:
+    return {"id": job_id, "workflow": "echo", "model_name": "none",
+            "prompt": job_id}
+
+
+@pytest.fixture(params=["fake", "real"])
+def backend_name(request, sdaas_root):
+    return request.param
+
+
+def test_work_hands_out_queued_jobs_then_empties(backend_name):
+    async def scenario(backend, client):
+        backend.queue_job(echo_job())
+        jobs = await client.ask_for_work(dict(CAPS))
+        assert isinstance(jobs, list)
+        assert [j["id"] for j in jobs] == ["conf-1"]
+        # the same job is not handed out twice on the next poll
+        assert await client.ask_for_work(dict(CAPS)) == []
+
+    run_conformance(backend_name, scenario)
+
+
+def test_work_response_shape_is_jobs_list(backend_name):
+    async def scenario(backend, client):
+        async with aiohttp.ClientSession() as session:
+            async with session.get(
+                    f"{backend.uri}/work",
+                    params={"worker_version": "0.1.0", "worker_name": "w"},
+                    headers={"Authorization": f"Bearer {TOKEN}"}) as resp:
+                assert resp.status == 200
+                payload = await resp.json()
+        assert isinstance(payload, dict)
+        assert isinstance(payload["jobs"], list)
+
+    run_conformance(backend_name, scenario)
+
+
+def test_refusal_is_400_with_message(backend_name):
+    async def scenario(backend, client):
+        backend.refuse("worker too slow for this hive")
+        async with aiohttp.ClientSession() as session:
+            async with session.get(
+                    f"{backend.uri}/work",
+                    params={"worker_version": "0.1.0", "worker_name": "w"},
+                    headers={"Authorization": f"Bearer {TOKEN}"}) as resp:
+                assert resp.status == 400
+                payload = await resp.json()
+        assert payload["message"] == "worker too slow for this hive"
+        # the client surfaces the refusal as an HTTP error (poll_loop's
+        # backoff path), never as an empty job list
+        with pytest.raises(aiohttp.ClientResponseError):
+            await client.ask_for_work(dict(CAPS))
+
+    run_conformance(backend_name, scenario)
+
+
+def test_bearer_auth_enforced_on_work_and_results(backend_name):
+    async def scenario(backend, client):
+        bad = HiveClient(Settings(sdaas_token="wrong-token"), backend.uri)
+        try:
+            with pytest.raises(aiohttp.ClientResponseError) as err:
+                await bad.ask_for_work(dict(CAPS))
+            assert err.value.status == 401
+            with pytest.raises(Exception):
+                await bad.submit_result({"id": "x", "artifacts": {}})
+        finally:
+            await bad.close()
+
+    run_conformance(backend_name, scenario)
+
+
+def test_result_ack_is_json_and_duplicate_safe(backend_name):
+    async def scenario(backend, client):
+        backend.queue_job(echo_job("conf-ack"))
+        [job] = await client.ask_for_work(dict(CAPS))
+        envelope = {
+            "id": job["id"],
+            "artifacts": {"primary": {
+                "blob": "aGVsbG8=", "content_type": "image/jpeg"}},
+            "nsfw": False,
+            "worker_version": "0.1.0",
+            "pipeline_config": {},
+        }
+        ack = await client.submit_result(envelope)
+        assert isinstance(ack, dict)
+        # at-least-once delivery: the outbox may re-POST after a lost
+        # ACK, and the hive must answer 200 again, not error
+        ack2 = await client.submit_result(dict(envelope))
+        assert isinstance(ack2, dict)
+
+    run_conformance(backend_name, scenario)
+
+
+def test_models_catalog_shape(backend_name):
+    async def scenario(backend, client):
+        async with aiohttp.ClientSession() as session:
+            async with session.get(f"{backend.uri}/models") as resp:
+                assert resp.status == 200
+                catalog = await resp.json()
+        assert isinstance(catalog["models"], list)
+        assert isinstance(catalog["language_models"], list)
+        for entry in catalog["models"]:
+            assert "id" in entry
+        # the client's combined view (it also caches models.json, which
+        # sdaas_root sandboxes)
+        combined = await client.get_models()
+        assert isinstance(combined, list)
+        assert len(combined) == len(catalog["models"]) + len(
+            catalog["language_models"])
+
+    run_conformance(backend_name, scenario)
+
+
+def test_unknown_query_params_are_ignored(backend_name):
+    """Capability advertisement grows over time (resident_models,
+    queue_depth, flux_runnable, ...); a hive must never refuse a worker
+    for sending a key it does not know."""
+
+    async def scenario(backend, client):
+        backend.queue_job(echo_job("conf-extra"))
+        # queue_depth is deliberately NOT an example here: it is a KNOWN
+        # placement param — a worker advertising more local depth than
+        # free slices is saturated, and the real hive answers it with an
+        # empty jobs list (dispatch-budget contract, pinned in
+        # test_hive_server.py) rather than burying it
+        caps = dict(CAPS, resident_models="a/b,c/d",
+                    some_future_capability="42")
+        jobs = await client.ask_for_work(caps)
+        assert [j["id"] for j in jobs] == ["conf-extra"]
+
+    run_conformance(backend_name, scenario)
+
+
+def test_work_query_carries_placement_signal(backend_name):
+    """Satellite: the /work poll itself carries the dispatcher's
+    placement inputs — worker identity, chip capabilities, resident
+    models, and local queue depth — with every value stringified."""
+
+    async def scenario(backend, client):
+        await client.ask_for_work(dict(CAPS, queue_depth=2))
+        if backend.name == "fake":
+            recorded = backend.hive.work_requests[-1]
+        else:
+            worker = backend.server.directory.live()[0]
+            recorded = {
+                "worker_name": worker.name,
+                "worker_version": worker.version,
+                "chips": str(worker.chips),
+                "queue_depth": str(worker.queue_depth),
+                "resident_models": ",".join(sorted(worker.resident)),
+            }
+        assert recorded["worker_name"] == "worker"
+        assert recorded["worker_version"]
+        assert recorded["chips"] == "4"
+        assert recorded["queue_depth"] == "2"
+        # the client injects the registry's warm set when the caller
+        # didn't provide one (empty registry here -> empty string)
+        assert "resident_models" in recorded
+
+    run_conformance(backend_name, scenario)

@@ -1,0 +1,710 @@
+"""The embedded hive coordinator (chiaswarm_tpu/hive_server/): unit
+coverage for the queue/lease/dispatch/spool layers, plus the ISSUE 5
+acceptance scenarios end to end — a pristine Worker over real HTTP,
+residency-aware dispatch between workers that differ in residency,
+idempotent result ACKs, and an expired lease redelivered to a second
+worker.
+"""
+
+import asyncio
+import base64
+import json
+import time
+
+import aiohttp
+import pytest
+
+from chiaswarm_tpu import telemetry
+from chiaswarm_tpu import worker as worker_mod
+from chiaswarm_tpu.hive_server.dispatch import Dispatcher, WorkerDirectory
+from chiaswarm_tpu.hive_server.leases import LeaseTable
+from chiaswarm_tpu.hive_server.queue import (
+    PriorityJobQueue,
+    QueueFull,
+    job_class,
+)
+from chiaswarm_tpu.hive_server.spool import ArtifactSpool
+from chiaswarm_tpu.settings import Settings
+
+TOKEN = "hive-test-token"
+
+
+@pytest.fixture(autouse=True)
+def fast_poll(monkeypatch):
+    monkeypatch.setattr(worker_mod, "POLL_SECONDS", 0.05)
+    monkeypatch.setattr(worker_mod, "ERROR_BACKOFF_SECONDS", 0.2)
+
+
+def _dispatch_counts() -> dict:
+    metric = telemetry.REGISTRY.get(
+        "swarm_hive_dispatch_total") or telemetry.counter(
+        "swarm_hive_dispatch_total", "", ("outcome",))
+    return {o: metric.value(outcome=o)
+            for o in ("affinity", "cold", "steal", "hold")}
+
+
+# --- queue ------------------------------------------------------------------
+
+
+def test_job_class_mapping():
+    assert job_class({"priority": "interactive"}) == "interactive"
+    assert job_class({"priority": "BATCH"}) == "batch"
+    assert job_class({"sdaas_priority": "interactive"}) == "interactive"
+    assert job_class({"priority": "urgent!!"}) == "default"
+    assert job_class({}) == "default"
+
+
+def test_queue_dispatch_order_is_class_then_fifo():
+    q = PriorityJobQueue()
+    ids = []
+    for i, prio in enumerate(
+            ["batch", "default", "batch", "interactive", "default"]):
+        r = q.submit({"id": f"j{i}", "priority": prio})
+        ids.append(r.job_id)
+    order = [r.job_id for r in q.iter_queued()]
+    assert order == ["j3", "j1", "j4", "j0", "j2"]
+
+
+def test_queue_admission_backpressure():
+    q = PriorityJobQueue(depth_limit=2)
+    q.submit({"id": "a"})
+    q.submit({"id": "b"})
+    with pytest.raises(QueueFull) as err:
+        q.submit({"id": "c", "priority": "interactive"})
+    assert "full" in str(err.value)
+    # resubmitting a KNOWN id is dedup, not admission
+    assert q.submit({"id": "a"}).job_id == "a"
+    assert q.depth == 2
+
+
+def test_requeue_front_beats_fresh_arrivals():
+    q = PriorityJobQueue()
+    first = q.submit({"id": "old", "priority": "default"})
+    q.submit({"id": "new1", "priority": "default"})
+    q.take(first, worker="w", outcome="cold")
+    q.submit({"id": "new2", "priority": "default"})
+    q.requeue_front(first)
+    assert [r.job_id for r in q.iter_queued()] == ["old", "new1", "new2"]
+
+
+# --- leases -----------------------------------------------------------------
+
+
+def test_lease_reap_requeues_then_fails():
+    q = PriorityJobQueue()
+    record = q.submit({"id": "leased"})
+    leases = LeaseTable(deadline_s=0.0, max_redeliveries=1)
+
+    q.take(record, "w1", "cold")
+    leases.grant(record, "w1")
+    assert [r.job_id for r in leases.reap(q)] == ["leased"]
+    assert record.state == "queued" and record.attempts == 1
+
+    q.take(record, "w2", "cold")
+    leases.grant(record, "w2")
+    leases.reap(q)
+    assert record.state == "failed"
+    assert "redelivery budget" in record.error
+    assert len(leases) == 0
+
+
+def test_lease_settle_removes_lease():
+    q = PriorityJobQueue()
+    record = q.submit({"id": "s"})
+    leases = LeaseTable(deadline_s=60.0, max_redeliveries=1)
+    q.take(record, "w1", "cold")
+    leases.grant(record, "w1")
+    lease = leases.settle("s")
+    assert lease.worker == "w1"
+    assert leases.settle("s") is None
+    assert leases.reap(q) == []
+
+
+# --- dispatch ---------------------------------------------------------------
+
+
+def _observe(directory, name, resident="", **extra):
+    query = {"worker_name": name, "worker_version": "0.1.0", "chips": "4",
+             "slices": "2", "busy_slices": "0", "queue_depth": "0",
+             "resident_models": resident}
+    query.update({k: str(v) for k, v in extra.items()})
+    return directory.observe(query)
+
+
+def test_dispatch_prefers_resident_worker():
+    before = _dispatch_counts()
+    directory = WorkerDirectory(ttl_s=45.0)
+    dispatcher = Dispatcher(directory, affinity_hold_s=30.0,
+                            max_jobs_per_poll=4)
+    q = PriorityJobQueue()
+    q.submit({"id": "warmjob", "model_name": "stabilityai/sd-x"})
+
+    warm = _observe(directory, "warm-worker", resident="stabilityai/sd-x")
+    cold = _observe(directory, "cold-worker")
+
+    # the cold worker polls first: the job is HELD for the warm worker
+    assert dispatcher.select(cold, q) == []
+    # the warm worker gets it with the affinity outcome
+    handed = dispatcher.select(warm, q)
+    assert [(r.job_id, o) for r, o in handed] == [("warmjob", "affinity")]
+    delta = {k: v - before[k] for k, v in _dispatch_counts().items()}
+    assert delta["affinity"] == 1 and delta["hold"] == 1
+
+
+def test_dispatch_steals_after_hold_window_and_cold_without_holders():
+    directory = WorkerDirectory(ttl_s=45.0)
+    dispatcher = Dispatcher(directory, affinity_hold_s=0.05,
+                            max_jobs_per_poll=4)
+    q = PriorityJobQueue()
+    nobody = q.submit({"id": "coldjob", "model_name": "brand/new-model"})
+    held = q.submit({"id": "heldjob", "model_name": "stabilityai/sd-x"})
+
+    _observe(directory, "warm-worker", resident="stabilityai/sd-x")
+    cold = _observe(directory, "cold-worker")
+
+    # no live holder anywhere -> cold, immediately
+    handed = dispatcher.select(cold, q)
+    assert [(r.job_id, o) for r, o in handed] == [("coldjob", "cold")]
+    for record, outcome in handed:  # what the /work handler does
+        q.take(record, cold.name, outcome)
+    assert held.state == "queued"  # still held for the warm worker
+    time.sleep(0.06)  # the hold window lapses
+    handed = dispatcher.select(cold, q)
+    assert [(r.job_id, o) for r, o in handed] == [("heldjob", "steal")]
+
+
+def test_dispatch_dead_holders_do_not_hold_jobs():
+    directory = WorkerDirectory(ttl_s=0.05)
+    dispatcher = Dispatcher(directory, affinity_hold_s=300.0,
+                            max_jobs_per_poll=4)
+    q = PriorityJobQueue()
+    q.submit({"id": "orphan", "model_name": "stabilityai/sd-x"})
+    _observe(directory, "warm-worker", resident="stabilityai/sd-x")
+    time.sleep(0.06)  # the warm worker ages out of the liveness window
+    cold = _observe(directory, "cold-worker")
+    handed = dispatcher.select(cold, q)
+    assert [(r.job_id, o) for r, o in handed] == [("orphan", "cold")]
+
+
+def test_dispatch_skips_unconverted_families():
+    directory = WorkerDirectory(ttl_s=45.0)
+    dispatcher = Dispatcher(directory, affinity_hold_s=0.0,
+                            max_jobs_per_poll=4)
+    q = PriorityJobQueue()
+    q.submit({"id": "bark1", "model_name": "suno/bark-v2"})
+    limited = _observe(directory, "limited", unconverted_families="bark,svd")
+    assert dispatcher.select(limited, q) == []
+    capable = _observe(directory, "capable")
+    assert [r.job_id for r, _ in dispatcher.select(capable, q)] == ["bark1"]
+
+
+def test_dispatch_unconverted_keywords_match_case_insensitively():
+    """A capitalized advertised keyword must not fail open against the
+    lowercased model name."""
+    directory = WorkerDirectory(ttl_s=45.0)
+    dispatcher = Dispatcher(directory, affinity_hold_s=0.0,
+                            max_jobs_per_poll=4)
+    q = PriorityJobQueue()
+    q.submit({"id": "flux1", "model_name": "black-forest-labs/FLUX.1-dev"})
+    limited = _observe(directory, "limited", unconverted_families="Flux")
+    assert dispatcher.select(limited, q) == []
+
+
+def test_unplaceable_job_parks_failed_after_lease_deadline(sdaas_root):
+    """A queued job every live worker advertises as unconverted never
+    leases, so the redelivery machinery never engages — the reaper must
+    park it after a lease deadline of queue time instead of letting it
+    occupy admission depth forever."""
+    from chiaswarm_tpu.hive_server import HiveServer
+
+    server = HiveServer(_hive_settings(hive_lease_deadline_s=0.0))
+    record = server.queue.submit(
+        {"id": "stuck", "model_name": "suno/bark-v2"})
+    # nobody polling yet: the job just waits, no matter how old
+    server._park_unplaceable()
+    assert record.state == "queued"
+    _observe(server.directory, "limited", unconverted_families="bark")
+    server._park_unplaceable()
+    assert record.state == "failed"
+    assert "unplaceable" in record.error
+    assert server.queue.depth == 0
+    # a CAPABLE live worker keeps an aged job queued
+    waiting = server.queue.submit(
+        {"id": "waiting", "model_name": "suno/bark-v2"})
+    _observe(server.directory, "capable")
+    server._park_unplaceable()
+    assert waiting.state == "queued"
+
+
+def test_dispatch_budget_respects_advertised_capacity():
+    directory = WorkerDirectory(ttl_s=45.0)
+    dispatcher = Dispatcher(directory, affinity_hold_s=0.0,
+                            max_jobs_per_poll=4)
+    q = PriorityJobQueue()
+    for i in range(6):
+        q.submit({"id": f"b{i}", "model_name": "m/n"})
+    wide = _observe(directory, "wide", slices=8, busy_slices=0)
+    assert len(dispatcher.select(wide, q)) == 4  # per-poll cap
+    part = _observe(directory, "part", slices=2, busy_slices=1,
+                    queue_depth=0)
+    assert len(dispatcher.select(part, q)) == 1  # one free slice
+    # advertised queue depth consumes the free slice: this poll is a
+    # heartbeat, handing it a job would bury the worker
+    saturated = _observe(directory, "saturated", slices=2, busy_slices=1,
+                         queue_depth=1)
+    assert dispatcher.select(saturated, q) == []
+
+
+def test_retire_bounds_finished_record_history():
+    q = PriorityJobQueue(history_limit=2)
+    records = []
+    for i in range(4):
+        r = q.submit({"id": f"h{i}"})
+        q.take(r, "w", "cold")
+        r.state = "done"
+        q.retire(r)
+        records.append(r)
+    # only the two most recent finished records survive
+    assert set(q.records) == {"h2", "h3"}
+    # an UNFINISHED record is never pruned, whatever the history says
+    live = q.submit({"id": "live"})
+    q.take(live, "w", "cold")
+    live.state = "done"
+    q.retire(live)
+    live.state = "leased"  # re-leased before pruning caught up
+    q.retire(q.submit({"id": "h5"}))
+    assert "live" in q.records
+
+
+def test_requeue_keeps_last_lessee_for_late_attribution():
+    q = PriorityJobQueue()
+    record = q.submit({"id": "late"})
+    q.take(record, "original-w", "cold")
+    q.requeue_front(record)
+    # a late result arriving while re-queued is attributed to the
+    # worker that actually produced it
+    assert record.worker == "original-w"
+    q.take(record, "next-w", "cold")
+    assert record.worker == "next-w"
+
+
+def test_retire_is_idempotent_per_record():
+    """A failed job later completed by a late result passes through
+    retire() twice (reaper, then the results handler); the second pass
+    must not consume a history slot another record is entitled to."""
+    q = PriorityJobQueue(history_limit=2)
+    twice = q.submit({"id": "twice"})
+    q.take(twice, "w", "cold")
+    twice.state = "failed"
+    q.retire(twice)
+    twice.state = "done"  # late result arrived after parking
+    q.retire(twice)
+    others = []
+    for i in range(2):
+        r = q.submit({"id": f"o{i}"})
+        q.take(r, "w", "cold")
+        r.state = "done"
+        q.retire(r)
+        others.append(r)
+    # exactly the 2 most recent records survive; the duplicate retire
+    # of "twice" did not evict "o0" early
+    assert set(q.records) == {"o0", "o1"}
+
+
+def test_worker_directory_prunes_aged_entries():
+    directory = WorkerDirectory(ttl_s=0.05)
+    directory.observe({"worker_name": "ephemeral-1",
+                       "worker_version": "0.1.0"})
+    assert "ephemeral-1" in directory._workers
+    time.sleep(0.1)
+    directory.observe({"worker_name": "ephemeral-2",
+                       "worker_version": "0.1.0"})
+    # the aged-out name is dropped from the dict itself, not just
+    # filtered by live() — distinct names must not accumulate forever
+    assert set(directory._workers) == {"ephemeral-2"}
+
+
+# --- spool ------------------------------------------------------------------
+
+
+def test_spool_content_addressing_and_dedup(sdaas_root):
+    spool = ArtifactSpool(sdaas_root / "spool")
+    d1 = spool.put(b"payload")
+    d2 = spool.put(b"payload")
+    assert d1 == d2
+    assert spool.get(d1) == b"payload"
+    assert spool.get("nope") is None
+    assert spool.get("a" * 64) is None
+
+    blob = base64.b64encode(b"artifact-bytes").decode()
+    stored = spool.store_result({
+        "id": "j1",
+        "artifacts": {"primary": {"blob": blob, "content_type": "image/jpeg",
+                                  "thumbnail": "dGh1bWI="}},
+    })
+    art = stored["artifacts"]["primary"]
+    assert "blob" not in art
+    assert art["bytes"] == len(b"artifact-bytes")
+    assert art["content_type"] == "image/jpeg"
+    assert art["thumbnail"] == "dGh1bWI="  # thumbnails stay inline
+    assert spool.get(art["sha256"]) == b"artifact-bytes"
+    assert art["href"] == f"/api/artifacts/{art['sha256']}"
+
+
+# --- HTTP + e2e (ISSUE 5 acceptance) ---------------------------------------
+
+
+def _hive_settings(**overrides) -> Settings:
+    fields = dict(sdaas_token=TOKEN, hive_port=0, metrics_port=0)
+    fields.update(overrides)
+    return Settings(**fields)
+
+
+async def _poll(session, api_uri, name, resident="", **extra):
+    params = {"worker_version": "0.1.0", "worker_name": name,
+              "chips": "4", "slices": "1", "busy_slices": "0",
+              "queue_depth": "0", "resident_models": resident}
+    params.update({k: str(v) for k, v in extra.items()})
+    async with session.get(f"{api_uri}/work", params=params,
+                           headers={"Authorization": f"Bearer {TOKEN}"}) as r:
+        assert r.status == 200, await r.text()
+        return (await r.json())["jobs"]
+
+
+async def _post(session, url, payload):
+    async with session.post(
+            url, data=json.dumps(payload),
+            headers={"Authorization": f"Bearer {TOKEN}",
+                     "Content-type": "application/json"}) as r:
+        return r.status, await r.json()
+
+
+def test_affinity_dispatch_between_workers_differing_in_residency(sdaas_root):
+    """Acceptance: two workers differ in residency; the job goes to the
+    resident one (affinity > 0) while the cold poller is held off, and a
+    second job past the hold window is stolen rather than stranded."""
+    from chiaswarm_tpu.hive_server import HiveServer
+
+    before = _dispatch_counts()
+
+    async def scenario():
+        # generous hold window: the cold worker's poll lands well inside
+        # it even on a paused CI container
+        settings = _hive_settings(hive_affinity_hold_s=1.0)
+        async with HiveServer(settings, port=0) as hive, \
+                aiohttp.ClientSession() as session:
+            # both workers introduce themselves before any job exists
+            await _poll(session, hive.api_uri, "warm-w",
+                        resident="stabilityai/sd-model")
+            await _poll(session, hive.api_uri, "cold-w")
+            status, payload = await _post(
+                session, f"{hive.api_uri}/jobs",
+                {"workflow": "txt2img",
+                 "model_name": "stabilityai/sd-model", "prompt": "x"})
+            assert status == 200, payload
+            job_id = payload["id"]
+            # cold worker polls first and must NOT get the job
+            assert await _poll(session, hive.api_uri, "cold-w") == []
+            handed = await _poll(session, hive.api_uri, "warm-w",
+                                 resident="stabilityai/sd-model")
+            assert [j["id"] for j in handed] == [job_id]
+            record = hive.queue.records[job_id]
+            assert record.placement == "affinity"
+            assert record.worker == "warm-w"
+
+            # a second same-model job past the hold window: the cold
+            # worker steals instead of idling
+            _, payload = await _post(
+                session, f"{hive.api_uri}/jobs",
+                {"workflow": "txt2img",
+                 "model_name": "stabilityai/sd-model", "prompt": "y"})
+            await asyncio.sleep(1.1)
+            stolen = await _poll(session, hive.api_uri, "cold-w")
+            assert [j["id"] for j in stolen] == [payload["id"]]
+            assert hive.queue.records[payload["id"]].placement == "steal"
+
+    asyncio.run(scenario())
+    delta = {k: v - before[k] for k, v in _dispatch_counts().items()}
+    assert delta["affinity"] >= 1
+    assert delta["steal"] >= 1
+    assert delta["hold"] >= 1
+
+
+def test_expired_lease_redelivered_to_another_worker(sdaas_root):
+    """Acceptance: a job leased to a worker that never answers is
+    observably redelivered to a second worker, and the late result from
+    the first is still accepted without double delivery."""
+    from chiaswarm_tpu.hive_server import HiveServer
+
+    expired = telemetry.REGISTRY.get(
+        "swarm_hive_leases_expired_total") or telemetry.counter(
+        "swarm_hive_leases_expired_total", "")
+    expired_before = expired.value()
+
+    async def scenario():
+        settings = _hive_settings(
+            hive_lease_deadline_s=0.2, hive_max_redeliveries=2)
+        async with HiveServer(settings, port=0) as hive, \
+                aiohttp.ClientSession() as session:
+            _, payload = await _post(
+                session, f"{hive.api_uri}/jobs",
+                {"workflow": "echo", "model_name": "none", "prompt": "p"})
+            job_id = payload["id"]
+            [job] = await _poll(session, hive.api_uri, "doomed-w")
+            assert job["id"] == job_id
+
+            # doomed-w never answers; the reaper re-queues
+            for _ in range(100):
+                if hive.queue.records[job_id].state == "queued":
+                    break
+                await asyncio.sleep(0.05)
+            assert hive.queue.records[job_id].state == "queued"
+            assert expired.value() > expired_before
+
+            [redelivered] = await _poll(session, hive.api_uri, "second-w")
+            assert redelivered["id"] == job_id
+            record = hive.queue.records[job_id]
+            assert record.attempts == 2 and record.worker == "second-w"
+
+            envelope = {"id": job_id, "artifacts": {}, "nsfw": False,
+                        "pipeline_config": {}}
+            status, ack = await _post(
+                session, f"{hive.api_uri}/results", envelope)
+            assert status == 200 and ack["status"] == "ok"
+            assert record.completed_by == "second-w"
+            # the doomed worker's duplicate arrives afterwards: ACKed
+            # idempotently, state unchanged
+            status, ack = await _post(
+                session, f"{hive.api_uri}/results", envelope)
+            assert status == 200 and ack.get("duplicate") is True
+            assert record.state == "done"
+
+    asyncio.run(scenario())
+
+
+def test_spool_failure_keeps_result_inline_not_wedged(sdaas_root):
+    """An artifact-spool write failure (full/read-only disk) must not
+    wedge the record in "settling" — the result is kept with blobs
+    inline and the job still reaches done."""
+    from chiaswarm_tpu.hive_server import HiveServer
+
+    async def scenario():
+        async with HiveServer(_hive_settings(), port=0) as hive, \
+                aiohttp.ClientSession() as session:
+            def explode(result):
+                raise OSError("disk full")
+            hive.spool.store_result = explode
+
+            _, payload = await _post(
+                session, f"{hive.api_uri}/jobs",
+                {"workflow": "echo", "model_name": "none", "prompt": "p"})
+            job_id = payload["id"]
+            [job] = await _poll(session, hive.api_uri, "w1")
+            envelope = {"id": job_id, "nsfw": False, "pipeline_config": {},
+                        "artifacts": {"primary": {
+                            "blob": base64.b64encode(b"x").decode()}}}
+            status, ack = await _post(
+                session, f"{hive.api_uri}/results", envelope)
+            assert status == 200 and ack["status"] == "ok"
+            record = hive.queue.records[job_id]
+            assert record.state == "done"
+            # blobs stayed inline: the spool is an optimization, not a
+            # gate on accepting the worker's result
+            assert record.result["artifacts"]["primary"]["blob"]
+
+    asyncio.run(scenario())
+
+
+def test_late_result_attributed_to_sender_not_current_lessee(sdaas_root):
+    """A slow-but-alive worker's result can arrive while the redelivered
+    copy is already leased to a second worker: completed_by must name
+    the worker that produced the result (the envelope's worker_name),
+    and the disposition counts as late."""
+    from chiaswarm_tpu.hive_server import HiveServer
+
+    late_metric = telemetry.REGISTRY.get(
+        "swarm_hive_results_total") or telemetry.counter(
+        "swarm_hive_results_total", "", ("status",))
+
+    async def scenario():
+        settings = _hive_settings(
+            hive_lease_deadline_s=0.2, hive_max_redeliveries=2)
+        async with HiveServer(settings, port=0) as hive, \
+                aiohttp.ClientSession() as session:
+            _, payload = await _post(
+                session, f"{hive.api_uri}/jobs",
+                {"workflow": "echo", "model_name": "none", "prompt": "p"})
+            job_id = payload["id"]
+            [job] = await _poll(session, hive.api_uri, "slow-w")
+            record = hive.queue.records[job_id]
+            for _ in range(100):
+                if record.state == "queued":
+                    break
+                await asyncio.sleep(0.05)
+            [redelivered] = await _poll(session, hive.api_uri, "fast-w")
+            assert record.state == "leased" and record.worker == "fast-w"
+
+            late_before = late_metric.value(status="late")
+            envelope = {"id": job_id, "artifacts": {}, "nsfw": False,
+                        "pipeline_config": {}, "worker_name": "slow-w"}
+            status, ack = await _post(
+                session, f"{hive.api_uri}/results", envelope)
+            assert status == 200 and ack["status"] == "ok"
+            # attributed to the actual sender, not fast-w's live lease
+            assert record.completed_by == "slow-w"
+            assert late_metric.value(status="late") == late_before + 1
+            # fast-w's lease was settled: no further redelivery pends
+            assert hive.leases.get(job_id) is None
+
+    asyncio.run(scenario())
+
+
+def test_admission_backpressure_over_http(sdaas_root):
+    from chiaswarm_tpu.hive_server import HiveServer
+
+    async def scenario():
+        settings = _hive_settings(hive_queue_depth_limit=2)
+        async with HiveServer(settings, port=0) as hive, \
+                aiohttp.ClientSession() as session:
+            for i in range(2):
+                status, _ = await _post(
+                    session, f"{hive.api_uri}/jobs", {"prompt": str(i)})
+                assert status == 200
+            status, payload = await _post(
+                session, f"{hive.api_uri}/jobs", {"prompt": "overflow"})
+            assert status == 429
+            assert "full" in payload["message"]
+            # the saturated queue is visible on /healthz as degraded
+            async with session.get(f"{hive.uri}/healthz") as r:
+                assert r.status == 503
+                health = await r.json()
+            assert health["status"] == "degraded"
+            assert any("queue full" in reason
+                       for reason in health["degraded_reasons"])
+
+    asyncio.run(scenario())
+
+
+def test_pristine_worker_txt2img_end_to_end(sdaas_root):
+    """THE acceptance scenario: a pristine Worker (no test doubles)
+    completes an interactive txt2img job against the real coordinator
+    over real HTTP — accepted, dispatched, executed, spooled, ACKed."""
+    from chiaswarm_tpu.hive_server import LocalSwarm
+
+    async def scenario():
+        swarm = LocalSwarm(
+            n_workers=1, chips_per_job=0, settings=_hive_settings())
+        async with swarm:
+            job_id = await swarm.submit({
+                "id": "e2e-txt2img",
+                "workflow": "txt2img",
+                "model_name": "stabilityai/stable-diffusion-2-1",
+                "prompt": "a hive coordinator proof",
+                "seed": 7,
+                "height": 64,
+                "width": 64,
+                "num_inference_steps": 2,
+                "priority": "interactive",
+                "parameters": {"test_tiny_model": True},
+            })
+            status = await swarm.wait_done(job_id, timeout=240.0)
+            assert status["class"] == "interactive"
+            assert status["attempts"] == 1
+            assert status["completed_by"] == "swarm-worker-0"
+            assert status["queue_wait_s"] >= 0
+            envelope = status["result"]
+            assert not envelope.get("fatal_error"), envelope
+            cfg = envelope["pipeline_config"]
+            assert "error" not in cfg, cfg
+            assert cfg["seed"] == 7
+            art = envelope["artifacts"]["primary"]
+            assert art["content_type"].startswith("image/")
+            payload = await swarm.artifact(art["href"])
+            assert payload.startswith(b"\xff\xd8")  # jpeg
+            assert len(payload) == art["bytes"]
+            # artifact bytes are job data: bearer auth applies
+            async with aiohttp.ClientSession() as anon:
+                async with anon.get(
+                        f"{swarm.hive.uri}{art['href']}") as resp:
+                    assert resp.status == 401
+            # hive-side health reflects a completed, lease-free swarm
+            health = swarm.hive.health()
+            assert health["jobs"].get("done") == 1
+            assert health["leases_active"] == 0
+
+    asyncio.run(scenario())
+
+
+def test_interactive_job_overtakes_queued_batch_jobs(sdaas_root):
+    """Satellite: `priority` is honored end to end — an interactive job
+    submitted LAST, behind a queue of batch jobs, is dispatched first
+    (hive class order) and rides the BatchScheduler fast-path to finish
+    before every batch job on a single-slice worker."""
+    from chiaswarm_tpu.hive_server import LocalSwarm
+
+    async def scenario():
+        swarm = LocalSwarm(
+            n_workers=0, chips_per_job=0, settings=_hive_settings())
+        async with swarm:
+            batch_ids = []
+            for i in range(4):
+                batch_ids.append(await swarm.submit({
+                    "id": f"batch-{i}", "workflow": "echo",
+                    "model_name": "none", "prompt": f"b{i}",
+                    "priority": "batch"}))
+            urgent = await swarm.submit({
+                "id": "urgent", "workflow": "echo", "model_name": "none",
+                "prompt": "now", "priority": "interactive"})
+            swarm.add_worker("overtake-worker")
+            statuses = [await swarm.wait_done(j, timeout=60.0)
+                        for j in [urgent, *batch_ids]]
+            records = swarm.hive.queue.records
+            urgent_done = records["urgent"].done_at
+            assert urgent_done is not None
+            for b in batch_ids:
+                assert urgent_done < records[b].done_at, (
+                    f"batch job {b} finished before the interactive job")
+            # the job dict carried the priority onto the wire: the
+            # worker's scheduler saw it (interactive jobs never linger)
+            assert statuses[0]["class"] == "interactive"
+
+    asyncio.run(scenario())
+
+
+def test_worker_advertises_queue_depth_and_residency(sdaas_root):
+    """Satellite: the pristine worker's own /work polls carry the
+    placement signal — queue_depth and resident_models — so the
+    dispatcher needs no second round trip."""
+    from chiaswarm_tpu.hive_server import LocalSwarm
+
+    async def scenario():
+        swarm = LocalSwarm(
+            n_workers=1, chips_per_job=0, settings=_hive_settings())
+        async with swarm:
+            for _ in range(200):
+                if swarm.hive.directory.live():
+                    break
+                await asyncio.sleep(0.02)
+            [info] = swarm.hive.directory.live()
+            assert info.name == "swarm-worker-0"
+            assert info.queue_depth == 0
+            assert info.chips > 0
+            # resident set parsed (empty now — nothing loaded yet)
+            assert isinstance(info.resident, frozenset)
+
+            # run one tiny job; the NEXT poll advertises the stand-in
+            job_id = await swarm.submit({
+                "workflow": "txt2img",
+                "model_name": "stabilityai/stable-diffusion-2-1",
+                "prompt": "warmth", "height": 64, "width": 64,
+                "num_inference_steps": 2,
+                "parameters": {"test_tiny_model": True}})
+            await swarm.wait_done(job_id, timeout=240.0)
+            for _ in range(200):
+                [info] = swarm.hive.directory.live()
+                if info.resident:
+                    break
+                await asyncio.sleep(0.05)
+            assert any("tiny" in m for m in info.resident), info.resident
+
+    asyncio.run(scenario())

@@ -225,12 +225,50 @@ async def scenario_sigterm_drain() -> str:
     return "drain finished the in-flight job and flushed the outbox"
 
 
+async def scenario_hive_lease_takeover() -> str:
+    """Hive-side fault tolerance (the real coordinator, not the fake):
+    worker 1 takes a lease and dies mid-job; the hive's reaper expires
+    the lease and re-queues, and worker 2 completes the SAME job."""
+    from chiaswarm_tpu import telemetry
+    from chiaswarm_tpu.hive_server import LocalSwarm
+    from chiaswarm_tpu.settings import Settings
+
+    faults.configure("hang_denoise=1", hang_timeout_s=120.0)
+    expired = telemetry.REGISTRY.get(
+        "swarm_hive_leases_expired_total") or telemetry.counter(
+        "swarm_hive_leases_expired_total", "")
+    expired_before = expired.value()
+    settings = Settings(sdaas_token="chaos", hive_port=0, metrics_port=0,
+                        hive_lease_deadline_s=1.0, hive_max_redeliveries=2)
+    swarm = LocalSwarm(n_workers=1, chips_per_job=0, settings=settings)
+    plan = faults.get_plan()
+    async with swarm:
+        job_id = await swarm.submit(_echo("chaos-takeover"))
+        _check(await _spin(lambda: plan.hanging == 1),
+               "worker 1 never started the job")
+        # worker 1 dies mid-lease, the job unfinished
+        await swarm.stop_worker(swarm.workers[0])
+        faults.configure("")  # worker 2 must run clean
+        _check(await _spin(lambda: expired.value() > expired_before, 15.0),
+               "hive never expired the dead worker's lease")
+        swarm.add_worker("chaos-second-worker")
+        status = await swarm.wait_done(job_id, timeout=30.0)
+        _check(status["completed_by"] == "chaos-second-worker",
+               f"job finished by {status['completed_by']}, not the "
+               "takeover worker")
+        _check(status["attempts"] >= 2,
+               "job should record the redelivery attempt")
+        plan.release_hangs()  # unstick worker 1's orphaned thread
+    return "dead worker's lease expired; second worker completed the job"
+
+
 SCENARIOS = {
     "drop_submit": scenario_drop_submit,
     "hive_connection_drop": scenario_hive_connection_drop,
     "hang_watchdog": scenario_hang_watchdog,
     "kill_before_ack": scenario_kill_before_ack,
     "sigterm_drain": scenario_sigterm_drain,
+    "hive_lease_takeover": scenario_hive_lease_takeover,
 }
 
 

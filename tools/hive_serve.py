@@ -1,0 +1,62 @@
+"""Run the embedded hive coordinator (chiaswarm_tpu/hive_server/).
+
+    python tools/hive_serve.py                       # settings defaults
+    python tools/hive_serve.py --port 9511 --host 0.0.0.0
+    python tools/hive_serve.py --lease-deadline 120 --queue-limit 64
+
+Workers need no changes: a stock worker with `sdaas_uri` pointing at
+this process (the defaults already line up on one host — port 9511)
+polls `/api/work`, executes, and POSTs `/api/results` exactly as it
+would against the production hive. Submit jobs with:
+
+    curl -X POST http://127.0.0.1:9511/api/jobs \
+         -H "Authorization: Bearer $SDAAS_TOKEN" \
+         -d '{"workflow": "txt2img", "model_name": "...", \
+              "prompt": "...", "priority": "interactive"}'
+
+then watch `GET /api/jobs/<id>`; `/metrics` and `/healthz` serve the
+hive-side catalog (swarm_hive_queue_depth, swarm_hive_dispatch_total,
+swarm_hive_leases_expired_total, ...). The server imports no jax — it
+runs fine on a CPU-only coordinator host.
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import pathlib
+import sys
+
+sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent.parent))
+
+from chiaswarm_tpu.hive_server.app import serve  # noqa: E402
+from chiaswarm_tpu.settings import load_settings  # noqa: E402
+
+
+def main(argv: list[str] | None = None) -> int:
+    parser = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    parser.add_argument("--host", default=None,
+                        help="bind address (default: Settings.hive_host)")
+    parser.add_argument("--port", type=int, default=None,
+                        help="bind port (default: Settings.hive_port; "
+                             "0 = ephemeral)")
+    parser.add_argument("--lease-deadline", type=float, default=None,
+                        metavar="S", help="override hive_lease_deadline_s")
+    parser.add_argument("--queue-limit", type=int, default=None,
+                        help="override hive_queue_depth_limit")
+    args = parser.parse_args(argv)
+
+    settings = load_settings()
+    if args.lease_deadline is not None:
+        settings.hive_lease_deadline_s = args.lease_deadline
+    if args.queue_limit is not None:
+        settings.hive_queue_depth_limit = args.queue_limit
+    try:
+        asyncio.run(serve(settings, host=args.host, port=args.port))
+    except KeyboardInterrupt:
+        print("hive stopped")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
