@@ -28,6 +28,14 @@ Injection points wired today (site -> effect):
 - ``kill_before_ack`` worker result delivery raises FaultInjected AFTER
                      the hive ack, BEFORE the outbox unlink (simulated
                      crash; exercises redelivery-on-restart)
+- ``kill_before_journal_sync`` (hive-side) the coordinator dies between
+                     an in-memory state mutation and the WAL append —
+                     the in-flight HTTP response errors and the journal
+                     misses the transition; recovery must tolerate it
+- ``crash_after_lease`` (hive-side) the coordinator dies after leasing +
+                     journaling jobs on a /work poll but before the
+                     reply leaves — the worker never sees the jobs, and
+                     WAL replay + lease expiry must redeliver them
 
 Sites call ``faults.fire(point)`` / ``faults.hang(point)`` by name;
 unknown names simply never fire, so new points cost one line at the site.

@@ -7,7 +7,16 @@ speaking the exact wire protocol in `chiaswarm_tpu/hive.py` — a pristine
 `Worker` connects to it unmodified:
 
 - `queue.py`    priority-class job queue (interactive > default > batch,
-                FIFO within class) with admission backpressure on depth;
+                FIFO within class) with class-aware load shedding (per-
+                class depth watermarks: batch sheds first, interactive
+                last) and O(1) lazy-deletion dispatch;
+- `journal.py`  write-ahead journal under $SDAAS_ROOT/hive_wal/ — every
+                queue/lease transition is an append-only JSONL line with
+                periodic compaction, so a SIGKILL'd hive replays to its
+                pre-crash state (recovered leases get a fresh deadline);
+- `clock.py`    the wall-vs-monotonic convention: intervals are
+                monotonic, persisted instants are wall-clock and
+                re-anchored on replay;
 - `dispatch.py` residency-aware dispatcher reading each worker's
                 advertised resident models and chip capabilities from the
                 /work query — the slice-level placement logic of
@@ -27,6 +36,8 @@ chiaswarm_tpu.hive_server`).
 """
 
 from .app import HiveServer
+from .clock import CLOCK, HiveClock
+from .journal import HiveJournal
 from .queue import JOB_CLASSES, JobRecord, PriorityJobQueue, QueueFull, job_class
 
 
@@ -42,6 +53,9 @@ def __getattr__(name):
 
 __all__ = [
     "HiveServer",
+    "HiveJournal",
+    "HiveClock",
+    "CLOCK",
     "LocalSwarm",
     "JOB_CLASSES",
     "JobRecord",

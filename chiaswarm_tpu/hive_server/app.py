@@ -36,11 +36,22 @@ import time
 
 from aiohttp import web
 
-from .. import telemetry
+from .. import faults, telemetry
 from ..settings import Settings, get_settings_dir, load_settings, resolve_path
 from .dispatch import Dispatcher, WorkerDirectory
+from .journal import (
+    HiveJournal,
+    apply_events,
+    ev_admit,
+    ev_lease,
+    ev_park,
+    ev_requeue,
+    ev_retire,
+    ev_settle,
+    snapshot_events,
+)
 from .leases import LeaseTable
-from .queue import PriorityJobQueue, QueueFull
+from .queue import PriorityJobQueue, QueueFull, parse_shed_watermarks
 from .spool import ArtifactSpool
 
 logger = logging.getLogger(__name__)
@@ -79,7 +90,9 @@ class HiveServer:
         self.token = str(g("sdaas_token", ""))
         self.queue = PriorityJobQueue(
             depth_limit=int(g("hive_queue_depth_limit", 256)),
-            history_limit=int(g("hive_job_history_limit", 1000)))
+            history_limit=int(g("hive_job_history_limit", 1000)),
+            shed_watermarks=parse_shed_watermarks(
+                g("hive_shed_watermarks", None)))
         self.leases = LeaseTable(
             deadline_s=float(g("hive_lease_deadline_s", 300.0)),
             max_redeliveries=int(g("hive_max_redeliveries", 3)),
@@ -93,10 +106,38 @@ class HiveServer:
         )
         self.spool = ArtifactSpool(
             resolve_path(g("hive_spool_dir", "hive_spool")))
+        self.spool_max_bytes = int(g("hive_spool_max_bytes", 0))
+        self.spool_max_age_s = float(g("hive_spool_max_age_s", 0.0))
         self.refuse_with: str | None = None
         self.started_at = time.monotonic()
+        self._last_spool_sweep = time.monotonic()
         self._runner: web.AppRunner | None = None
         self._reaper: asyncio.Task | None = None
+        # write-ahead journal: recover the pre-crash queue + lease state
+        # BEFORE serving a single request ("" disables — pure in-memory,
+        # the pre-WAL behavior). Replay happens here in __init__, not
+        # start(), so tests and tools that drive the state machine
+        # without a socket get the same durability semantics.
+        self.journal: HiveJournal | None = None
+        self.recovery: dict | None = None
+        wal_dir = str(g("hive_wal_dir", "hive_wal"))
+        if wal_dir:
+            self.journal = HiveJournal(
+                resolve_path(wal_dir),
+                fsync=bool(g("hive_wal_fsync", False)),
+                compact_every=int(g("hive_wal_compact_every", 512)))
+            events = self.journal.recover()
+            if events:
+                self.recovery = apply_events(events, self.queue, self.leases)
+                logger.warning(
+                    "hive WAL replayed %d event(s) -> %s (recovered leases "
+                    "get a fresh %gs deadline)", len(events), self.recovery,
+                    self.leases.deadline_s)
+            # compact now: the stream shrinks to live state, and a
+            # crash-restart-crash loop cannot grow it without bound
+            self.journal.compact(snapshot_events(self.queue, self.leases))
+            self.journal.snapshot_fn = (
+                lambda: snapshot_events(self.queue, self.leases))
 
     # --- lifecycle ---
 
@@ -142,6 +183,24 @@ class HiveServer:
         if self._runner is not None:
             await self._runner.cleanup()
             self._runner = None
+        if self.journal is not None:
+            self.journal.close()
+
+    def _journal(self, event: dict) -> None:
+        """Append one transition; a journal WRITE failure (full disk,
+        bad mount) is logged loudly but never takes serving down — the
+        hive degrades to the pre-WAL in-memory semantics it had for five
+        PRs rather than refusing jobs it can still run. Injected faults
+        (kill_before_journal_sync) DO propagate: they simulate the
+        process dying at this exact line."""
+        if self.journal is None:
+            return
+        try:
+            self.journal.append(event)
+        except OSError:
+            logger.exception(
+                "hive WAL append failed; this transition is NOT "
+                "restart-durable")
 
     async def __aenter__(self) -> "HiveServer":
         return await self.start()
@@ -158,16 +217,20 @@ class HiveServer:
             try:
                 for record in self.leases.reap(self.queue):
                     if record.state == "failed":
-                        self.queue.retire(record)
+                        self._journal(ev_park(record))
+                        for pruned in self.queue.retire(record):
+                            self._journal(ev_retire(pruned))
                         logger.error("job %s failed: %s",
                                      record.job_id, record.error)
                     else:
+                        self._journal(ev_requeue(record))
                         logger.warning(
                             "lease expired for job %s (attempt %d); "
                             "re-queued at the front of class %s",
                             record.job_id, record.attempts,
                             record.job_class)
                 self._park_unplaceable()
+                self._sweep_spool_if_due()
             except Exception:
                 # the reaper is the only thing that frees a dead
                 # worker's lease; it must survive any single bad pass
@@ -182,7 +245,7 @@ class HiveServer:
         full lease deadline of queue time for a capable worker to show
         up, then fail it with the same parking machinery an exhausted
         lease uses."""
-        cutoff = time.monotonic() - self.leases.deadline_s
+        cutoff = self.queue.clock.mono() - self.leases.deadline_s
         for record in self.queue.iter_queued():
             if record.submitted_at > cutoff:
                 continue
@@ -194,9 +257,45 @@ class HiveServer:
                 "unplaceable: every live worker advertises this job's "
                 "model family as unconverted "
                 f"(waited {self.leases.deadline_s:g}s)")
-            self.queue.retire(record)
+            self._journal(ev_park(record))
+            for pruned in self.queue.retire(record):
+                self._journal(ev_retire(pruned))
             _JOBS_FAILED.inc()
             logger.error("job %s failed: %s", record.job_id, record.error)
+
+    # artifact-retention cadence: the sweep globs the whole spool tree,
+    # so it rides the reaper at most this often, not every pass
+    SPOOL_SWEEP_INTERVAL_S = 30.0
+
+    def _sweep_spool_if_due(self) -> None:
+        if self.spool_max_bytes <= 0 and self.spool_max_age_s <= 0:
+            return
+        now = time.monotonic()
+        if now - self._last_spool_sweep < self.SPOOL_SWEEP_INTERVAL_S:
+            return
+        self._last_spool_sweep = now
+        self.sweep_spool()
+
+    def sweep_spool(self) -> int:
+        """Age/size-bound the artifact spool. Blobs referenced by a live
+        record — any record still answering GET /api/jobs/{id}, i.e. not
+        yet pruned from history — are protected: a status poll must keep
+        resolving its hrefs. Everything else is fair game — content
+        addressing means a re-submitted duplicate simply re-stores the
+        blob."""
+        protected: set[str] = set()
+        for record in self.queue.records.values():
+            if not isinstance(record.result, dict):
+                continue
+            artifacts = record.result.get("artifacts")
+            if not isinstance(artifacts, dict):
+                continue
+            for art in artifacts.values():
+                if isinstance(art, dict) and isinstance(
+                        art.get("sha256"), str):
+                    protected.add(art["sha256"])
+        return self.spool.sweep(self.spool_max_bytes, self.spool_max_age_s,
+                                protected)
 
     # --- auth ---
 
@@ -230,8 +329,13 @@ class HiveServer:
         for record, outcome in handed:
             self.queue.take(record, worker.name, outcome)
             self.leases.grant(record, worker.name)
+            self._journal(ev_lease(record))
             logger.info("dispatched job %s to %s (%s, attempt %d)",
                         record.job_id, worker.name, outcome, record.attempts)
+        # chaos hook: the hive 'dies' after leasing + journaling but
+        # before the reply leaves — the worker never sees the jobs, and
+        # recovery + lease expiry must redeliver them
+        faults.fire("crash_after_lease")
         _POLLS.inc(reply="jobs" if handed else "empty")
         return web.json_response(
             {"jobs": [record.job for record, _ in handed]})
@@ -305,7 +409,9 @@ class HiveServer:
         record.completed_by = (
             sender or (lease.worker if lease else record.worker))
         record.state = "done"
-        self.queue.retire(record)
+        self._journal(ev_settle(record))
+        for pruned in self.queue.retire(record):
+            self._journal(ev_retire(pruned))
         _RESULTS.inc(status=status)
         return web.json_response({"status": "ok"})
 
@@ -338,10 +444,13 @@ class HiveServer:
         if not isinstance(job, dict):
             return web.json_response(
                 {"message": "job must be a JSON object"}, status=400)
+        known = str(job.get("id") or "") in self.queue.records
         try:
             record = self.queue.submit(job)
         except QueueFull as e:
             return web.json_response({"message": str(e)}, status=429)
+        if not known:
+            self._journal(ev_admit(record))
         return web.json_response({
             "id": record.job_id,
             "class": record.job_class,
@@ -389,9 +498,17 @@ class HiveServer:
             reasons.append(
                 f"queue full ({self.queue.depth}/{self.queue.depth_limit}): "
                 "admission refusing new jobs")
+        for cls in self.queue.shedding():
+            threshold = self.queue.shed_threshold(cls)
+            if threshold < self.queue.depth_limit:
+                # partial, class-aware degradation; the full queue is
+                # already reported above
+                reasons.append(
+                    f"shedding {cls} jobs ({self.queue.depth} queued >= "
+                    f"{cls} watermark {threshold})")
         if self.refuse_with is not None:
             reasons.append(f"draining: refusing workers ({self.refuse_with})")
-        return {
+        payload = {
             "status": "degraded" if reasons else "ok",
             "degraded_reasons": reasons,
             "uptime_s": round(time.monotonic() - self.started_at, 1),
@@ -400,6 +517,15 @@ class HiveServer:
             "jobs": states,
             "workers": self.directory.snapshot(),
         }
+        if self.journal is not None:
+            payload["wal"] = {
+                "dir": str(self.journal.root),
+                "appends_since_compact": self.journal.appends_since_compact,
+                "replayed_events": self.journal.replayed_events,
+                "torn_lines": self.journal.torn_lines,
+                "recovery": self.recovery,
+            }
+        return payload
 
     async def _healthz(self, request: web.Request) -> web.Response:
         payload = self.health()

@@ -1,0 +1,64 @@
+"""One clock, two faces: monotonic for intervals, wall for persistence.
+
+The hive measures every interval — queue wait, lease deadlines, affinity
+hold windows, worker liveness — with ``time.monotonic()``, which is the
+right tool exactly until a value has to survive the process: a monotonic
+reading is an offset from an arbitrary per-process origin, so a
+persisted ``submitted_at`` or ``expires_at`` is meaningless after a
+restart. The pre-WAL code had this bug latent (nothing persisted yet,
+so nothing broke); the journal makes it load-bearing.
+
+``HiveClock`` pins the convention in one place:
+
+- **intervals** are always monotonic arithmetic (``mono()``), immune to
+  NTP steps and operator ``date`` changes;
+- **persistence** always goes through ``wall_from_mono`` on the way to
+  disk and ``mono_from_wall`` on the way back, which re-anchors a stored
+  wall-clock instant into the *current* process's monotonic timebase so
+  interval arithmetic keeps working across the restart (to within
+  wall-clock accuracy — the only timebase two processes share).
+
+The two source functions are injectable so tests can simulate a restart
+(new monotonic origin, continuous wall clock) without sleeping or
+monkey-patching the ``time`` module.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Callable
+
+
+class HiveClock:
+    __slots__ = ("_mono", "_wall")
+
+    def __init__(self, mono: Callable[[], float] = time.monotonic,
+                 wall: Callable[[], float] = time.time):
+        self._mono = mono
+        self._wall = wall
+
+    def mono(self) -> float:
+        """Now, in the process-local monotonic timebase (intervals)."""
+        return self._mono()
+
+    def wall(self) -> float:
+        """Now, as a wall-clock epoch instant (persistence)."""
+        return self._wall()
+
+    def wall_from_mono(self, mono_instant: float) -> float:
+        """Translate a monotonic instant into a wall-clock epoch value
+        fit for persistence."""
+        return self._wall() - (self._mono() - mono_instant)
+
+    def mono_from_wall(self, wall_instant: float) -> float:
+        """Re-anchor a persisted wall-clock instant into this process's
+        monotonic timebase. The result can be negative (an instant before
+        this process's monotonic origin) — it is an arithmetic anchor,
+        never a value to sleep until."""
+        return self._mono() - (self._wall() - wall_instant)
+
+
+# the process-default clock every hive component shares unless a test
+# injects its own; sharing matters — mixing two monotonic origins in one
+# interval subtraction is exactly the bug this module exists to prevent
+CLOCK = HiveClock()

@@ -24,10 +24,10 @@ Outcomes (counted in `swarm_hive_dispatch_total{outcome}`):
 from __future__ import annotations
 
 import dataclasses
-import time
 
 from .. import telemetry
 from ..batching import placement_model
+from .clock import CLOCK
 from .queue import JobRecord, PriorityJobQueue
 
 _DISPATCH = telemetry.counter(
@@ -120,14 +120,14 @@ class WorkerDirectory:
             slices=max(_to_int(query.get("slices"), 1), 1),
             busy_slices=_to_int(query.get("busy_slices")),
             queue_depth=_to_int(query.get("queue_depth")),
-            last_seen=time.monotonic(),
+            last_seen=CLOCK.mono(),
         )
         self._workers[name] = info
         # drop aged-out entries here rather than letting the dict grow
         # with every worker name ever seen (ephemeral/autoscaled fleets
         # register a fresh name per restart) — live() then scans only
         # names that could actually matter
-        cutoff = time.monotonic() - self.ttl_s
+        cutoff = CLOCK.mono() - self.ttl_s
         for stale in [n for n, w in self._workers.items()
                       if w.last_seen < cutoff]:
             del self._workers[stale]
@@ -135,7 +135,7 @@ class WorkerDirectory:
         return info
 
     def live(self) -> list[WorkerInfo]:
-        cutoff = time.monotonic() - self.ttl_s
+        cutoff = CLOCK.mono() - self.ttl_s
         return [w for w in self._workers.values() if w.last_seen >= cutoff]
 
     def live_holders(self, model: str | None,
@@ -198,7 +198,7 @@ class Dispatcher:
         silently for it."""
         handed: list[tuple[JobRecord, str]] = []
         budget = self._budget(worker)
-        now = time.monotonic()
+        now = CLOCK.mono()
         for record in queue.iter_queued():
             if len(handed) >= budget:
                 break

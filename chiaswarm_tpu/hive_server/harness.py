@@ -74,6 +74,18 @@ class LocalSwarm:
             asyncio.create_task(worker.run(), name=f"swarm_{name}"))
         return worker
 
+    async def restart_hive(self) -> HiveServer:
+        """Hard-stop the hive and stand a fresh instance up over the same
+        $SDAAS_ROOT and port — the in-process analog of a coordinator
+        restart. With the WAL enabled (the default) the new instance
+        replays to the pre-stop queue + lease state; workers keep polling
+        the same URI and never learn a restart happened beyond a few
+        refused connections."""
+        port = self.hive.port
+        await self.hive.stop()
+        self.hive = await HiveServer(self.settings, port=port).start()
+        return self.hive
+
     async def stop_worker(self, worker: Worker) -> None:
         """Hard-stop one worker (no drain) — 'the worker died mid-lease'."""
         idx = self.workers.index(worker)

@@ -1,0 +1,345 @@
+"""Write-ahead journal: the hive's queue + lease state survives SIGKILL.
+
+PR 3 made the *worker* half of the lifecycle at-least-once (durable
+outbox, redelivery across restarts); until now the coordinator kept its
+queue, lease table, and job records purely in memory, so a hive crash
+silently lost every queued and leased job even though the artifact spool
+under $SDAAS_ROOT already survived. This module closes that gap with the
+same write-ahead discipline the outbox uses, at coordinator granularity:
+
+- every state transition — admit, lease, settle, requeue, park, retire —
+  appends one JSON line to ``$SDAAS_ROOT/hive_wal/wal.jsonl`` *after* the
+  in-memory mutation and *before* the HTTP response leaves (so a client
+  never holds an ACK for state the journal missed);
+- a restarted hive replays the stream through :func:`apply_events` and
+  lands on exactly the pre-crash queue order, record table, and lease
+  set;
+- every ``compact_every`` appends (and once after each recovery) the
+  stream is rewritten as the *minimal* event sequence reconstructing the
+  current state (:func:`snapshot_events`) — an atomic tmp+rename, so the
+  WAL's size is bounded by live state, not by history.
+
+Replay is semantically correct, not just mechanical:
+
+- monotonic instants (``submitted_at``, lease deadlines) are meaningless
+  in a new process, so events persist wall-clock twins and replay
+  re-anchors them through :class:`~.clock.HiveClock` — intervals like
+  queue wait and the unplaceable-parking window span the restart;
+- a recovered lease gets a **fresh full deadline**: the lessee may still
+  be running the job (its result lands on the idempotent-ACK path as a
+  duplicate) or may have died with the hive (the reaper redelivers one
+  deadline from now — never "immediately" off a stale deadline);
+- a torn tail — the half-written last line a crash mid-append leaves —
+  is skipped and counted, never fatal; the transition it described is
+  the one the crash interrupted, and the lease/redelivery machinery
+  already covers an event that never happened.
+
+Durability model: every append is flushed to the OS, so the journal
+survives process death (SIGKILL included). ``fsync=True`` additionally
+survives power loss at a per-transition fsync cost; compaction snapshots
+are always fsynced before the rename either way.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+from pathlib import Path
+
+from .. import faults, telemetry
+from .leases import LeaseTable
+from .queue import PriorityJobQueue
+
+logger = logging.getLogger(__name__)
+
+WAL_NAME = "wal.jsonl"
+
+_APPENDS = telemetry.counter(
+    "swarm_hive_wal_appends_total",
+    "State transitions appended to the hive write-ahead journal, by event",
+    ("event",),
+)
+_COMPACTIONS = telemetry.counter(
+    "swarm_hive_wal_compactions_total",
+    "Hive WAL compactions (stream rewritten as a minimal state snapshot)",
+)
+_REPLAYED = telemetry.counter(
+    "swarm_hive_wal_replayed_total",
+    "Journal events applied during hive recovery",
+)
+_TORN = telemetry.counter(
+    "swarm_hive_wal_torn_lines_total",
+    "Unparseable journal lines skipped during recovery (a torn tail is "
+    "the expected crash artifact; mid-stream corruption is logged loudly)",
+)
+_RECOVERED_JOBS = telemetry.gauge(
+    "swarm_hive_wal_recovered_jobs",
+    "Job records reconstructed by the last WAL replay, by state",
+    ("state",),
+)
+
+
+class HiveJournal:
+    """Append-only JSONL stream + periodic compaction for one hive.
+
+    Single-threaded by design, like everything else hive-side: appends
+    happen on the event loop between an in-memory mutation and the HTTP
+    response. ``snapshot_fn`` (set by the owner once recovery is done)
+    supplies the minimal event sequence for compaction."""
+
+    def __init__(self, root: Path, fsync: bool = False,
+                 compact_every: int = 512):
+        self.root = Path(root)
+        self.root.mkdir(parents=True, exist_ok=True)
+        self.path = self.root / WAL_NAME
+        self.fsync = bool(fsync)
+        self.compact_every = int(compact_every)
+        self.snapshot_fn = None
+        self.appends_since_compact = 0
+        self.replayed_events = 0
+        self.torn_lines = 0
+        self._fh = None
+        # a crash mid-compaction leaves a tmp beside the live stream;
+        # the rename never happened, so the live stream is authoritative
+        for orphan in self.root.glob(f".{WAL_NAME}.*.tmp"):
+            try:
+                orphan.unlink()
+            except OSError:
+                pass
+
+    # --- recovery ---
+
+    def recover(self) -> list[dict]:
+        """Parse the stream, tolerant of a torn tail: the last line a
+        crash left half-written is skipped and counted. Corruption
+        *mid*-stream (not the tail) is also skipped — losing one
+        transition degrades to a redelivery, which beats refusing to
+        start — but logged loudly because it means more than a crash
+        happened to this file."""
+        events: list[dict] = []
+        try:
+            raw = self.path.read_bytes()
+        except FileNotFoundError:
+            return events
+        lines = raw.split(b"\n")
+        last_index = max(
+            (i for i, ln in enumerate(lines) if ln.strip()), default=-1)
+        for i, line in enumerate(lines):
+            if not line.strip():
+                continue
+            try:
+                event = json.loads(line)
+                if not isinstance(event, dict) or "ev" not in event:
+                    raise ValueError("journal line is not an event object")
+            except (ValueError, UnicodeDecodeError) as e:
+                self.torn_lines += 1
+                _TORN.inc()
+                if i == last_index:
+                    logger.warning(
+                        "hive WAL torn tail skipped (%d bytes): the crash "
+                        "interrupted this append", len(line))
+                else:
+                    logger.error(
+                        "hive WAL line %d is corrupt mid-stream (%s); "
+                        "skipping it — the transition it described is "
+                        "lost and will resolve as a redelivery", i, e)
+                continue
+            events.append(event)
+        self.replayed_events = len(events)
+        return events
+
+    # --- append path ---
+
+    def _handle(self):
+        if self._fh is None:
+            self._fh = open(self.path, "ab")
+        return self._fh
+
+    def append(self, event: dict) -> None:
+        """Persist one transition. ``kill_before_journal_sync`` fires
+        here (the hive 'crashed' between the in-memory mutation and the
+        journal write — recovery must tolerate the missing event); the
+        exception propagates so the in-flight HTTP response dies exactly
+        as it would mid-crash."""
+        faults.fire("kill_before_journal_sync")
+        fh = self._handle()
+        fh.write(json.dumps(event, separators=(",", ":")).encode() + b"\n")
+        fh.flush()
+        if self.fsync:
+            os.fsync(fh.fileno())
+        _APPENDS.inc(event=str(event.get("ev", "?")))
+        self.appends_since_compact += 1
+        if (self.compact_every > 0 and self.snapshot_fn is not None
+                and self.appends_since_compact >= self.compact_every):
+            self.compact(self.snapshot_fn())
+
+    def compact(self, events: list[dict]) -> None:
+        """Atomically replace the stream with the given minimal event
+        sequence (tmp + fsync + rename, like the outbox and the spool)."""
+        tmp = self.root / f".{WAL_NAME}.{os.getpid()}.tmp"
+        with open(tmp, "wb") as fh:
+            for event in events:
+                fh.write(
+                    json.dumps(event, separators=(",", ":")).encode() + b"\n")
+            fh.flush()
+            os.fsync(fh.fileno())
+        self.close()
+        os.replace(tmp, self.path)
+        self.appends_since_compact = 0
+        _COMPACTIONS.inc()
+
+    def close(self) -> None:
+        if self._fh is not None:
+            try:
+                self._fh.close()
+            except OSError:
+                pass
+            self._fh = None
+
+
+# --- event constructors (one vocabulary for append sites and replay) ---
+
+
+def ev_admit(record) -> dict:
+    event = {"ev": "admit", "job": record.job, "class": record.job_class,
+             "seq": record.seq, "wall": record.submitted_wall}
+    if record.attempts:
+        # compaction folds a queued record's dispatch history (it was
+        # leased and requeued before the snapshot) into its admit, so
+        # replay reproduces queue ORDER by plain appends — replaying
+        # lease+requeue pairs would front-insert and reverse the queue
+        event.update(attempts=record.attempts, worker=record.worker,
+                     queue_wait_s=record.queue_wait_s,
+                     placement=record.placement)
+    return event
+
+
+def ev_lease(record) -> dict:
+    return {"ev": "lease", "id": record.job_id, "worker": record.worker,
+            "attempts": record.attempts, "outcome": record.placement,
+            "queue_wait_s": record.queue_wait_s}
+
+
+def ev_settle(record) -> dict:
+    return {"ev": "settle", "id": record.job_id,
+            "completed_by": record.completed_by,
+            "attempts": record.attempts, "result": record.result}
+
+
+def ev_requeue(record) -> dict:
+    return {"ev": "requeue", "id": record.job_id, "attempts": record.attempts}
+
+
+def ev_park(record) -> dict:
+    return {"ev": "park", "id": record.job_id, "error": record.error,
+            "attempts": record.attempts}
+
+
+def ev_retire(job_id: str) -> dict:
+    return {"ev": "retire", "id": job_id}
+
+
+def snapshot_events(queue: PriorityJobQueue,
+                    leases: LeaseTable) -> list[dict]:
+    """The minimal event sequence reconstructing the current state: one
+    admit per live record, plus the single event carrying its terminal
+    or leased condition. Queued records are emitted LAST and in dispatch
+    order, so replay's enqueue order reproduces the queue exactly
+    (requeue-front history included — the order IS the state)."""
+    events: list[dict] = []
+    queued_ids = set()
+    for record in queue.iter_queued():
+        queued_ids.add(record.job_id)
+    for record in queue.records.values():
+        if record.job_id in queued_ids:
+            continue
+        events.append(ev_admit(record))
+        if record.state in ("leased", "settling"):
+            events.append(ev_lease(record))
+        elif record.state == "done":
+            events.append(ev_settle(record))
+        elif record.state == "failed":
+            events.append(ev_park(record))
+    for record in queue.iter_queued():
+        events.append(ev_admit(record))
+    return events
+
+
+def apply_events(events: list[dict], queue: PriorityJobQueue,
+                 leases: LeaseTable) -> dict:
+    """Replay a recovered stream into fresh queue/lease tables. Events
+    referencing unknown ids (their admit was the torn tail, or the
+    record was retired in a compacted-away past) are skipped and
+    counted, never fatal. Returns a summary for the recovery log line."""
+    skipped = 0
+    for event in events:
+        ev = event.get("ev")
+        if ev == "admit":
+            job = event.get("job")
+            if not isinstance(job, dict) or not job.get("id"):
+                skipped += 1
+                continue
+            if str(job["id"]) in queue.records:
+                skipped += 1  # duplicate admit (resubmission journaled)
+                continue
+            restored = queue.restore(job, str(event.get("class", "")),
+                                     int(event.get("seq", 0)),
+                                     float(event.get("wall", 0.0)))
+            if event.get("attempts"):
+                # dispatch history folded in by compaction; still queued
+                restored.attempts = int(event["attempts"])
+                restored.worker = event.get("worker")
+                restored.queue_wait_s = event.get("queue_wait_s")
+                restored.placement = event.get("placement")
+            _REPLAYED.inc()
+            continue
+        record = queue.records.get(str(event.get("id", "")))
+        if record is None:
+            skipped += 1
+            continue
+        if ev == "lease":
+            if record.state != "queued":
+                skipped += 1
+                continue
+            queue.restore_leased(
+                record, str(event.get("worker") or "unknown"),
+                int(event.get("attempts", 1)), event.get("outcome"),
+                event.get("queue_wait_s"))
+            leases.restore(record, record.worker)
+        elif ev == "settle":
+            leases.settle(record.job_id)
+            queue.discard_queued(record)
+            record.state = "done"
+            record.result = event.get("result")
+            record.error = None
+            record.completed_by = event.get("completed_by")
+            record.attempts = int(event.get("attempts", record.attempts))
+            record.done_at = queue.clock.mono()
+            queue.retire(record)
+        elif ev == "requeue":
+            leases.settle(record.job_id)
+            if record.state == "leased":
+                record.attempts = int(event.get("attempts", record.attempts))
+                queue.requeue_front(record)
+        elif ev == "park":
+            leases.settle(record.job_id)
+            queue.discard_queued(record)
+            record.state = "failed"
+            record.error = event.get("error")
+            record.attempts = int(event.get("attempts", record.attempts))
+            queue.retire(record)
+        elif ev == "retire":
+            queue.forget(record.job_id)
+        else:
+            skipped += 1
+            continue
+        _REPLAYED.inc()
+
+    states: dict[str, int] = {}
+    for record in queue.records.values():
+        states[record.state] = states.get(record.state, 0) + 1
+    for state in ("queued", "leased", "done", "failed"):
+        _RECOVERED_JOBS.set(states.get(state, 0), state=state)
+    return {"jobs": len(queue.records), "states": states,
+            "leases": len(leases), "skipped": skipped}

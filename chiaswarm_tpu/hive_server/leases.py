@@ -13,9 +13,8 @@ forever.
 
 from __future__ import annotations
 
-import time
-
 from .. import telemetry
+from .clock import CLOCK, HiveClock
 from .queue import JobRecord, PriorityJobQueue
 
 _LEASES_ACTIVE = telemetry.gauge(
@@ -42,9 +41,11 @@ class Lease:
 
 
 class LeaseTable:
-    def __init__(self, deadline_s: float, max_redeliveries: int):
+    def __init__(self, deadline_s: float, max_redeliveries: int,
+                 clock: HiveClock | None = None):
         self.deadline_s = max(float(deadline_s), 0.0)
         self.max_redeliveries = max(int(max_redeliveries), 0)
+        self.clock = clock or CLOCK
         self._leases: dict[str, Lease] = {}
 
     def __len__(self) -> int:
@@ -54,10 +55,19 @@ class LeaseTable:
         return self._leases.get(job_id)
 
     def grant(self, record: JobRecord, worker: str) -> Lease:
-        lease = Lease(record, worker, time.monotonic() + self.deadline_s)
+        lease = Lease(record, worker, self.clock.mono() + self.deadline_s)
         self._leases[record.job_id] = lease
         _LEASES_ACTIVE.set(len(self._leases))
         return lease
+
+    def restore(self, record: JobRecord, worker: str) -> Lease:
+        """Replay a journaled lease after a restart. The journaled
+        deadline is a dead process's monotonic offset, so the recovered
+        lease gets a FRESH full deadline — the worker may still be
+        running the job (the idempotent-ACK path absorbs its result), or
+        may be long gone (the reaper redelivers one deadline from NOW,
+        never in the past)."""
+        return self.grant(record, worker)
 
     def settle(self, job_id: str) -> Lease | None:
         """Drop the lease on a result arrival (normal completion — also
@@ -71,7 +81,7 @@ class LeaseTable:
         """Expire overdue leases: re-queue while the redelivery budget
         lasts, park as failed after. Returns the records that changed
         state (the caller logs them)."""
-        now = time.monotonic()
+        now = self.clock.mono()
         changed: list[JobRecord] = []
         for job_id, lease in list(self._leases.items()):
             if lease.expires_at > now:

@@ -8,24 +8,46 @@ the worker's BatchScheduler fast-path reads, so priority is one value
 end to end: hive queue class -> job dict on the wire -> linger-skip on
 the slice.
 
-Admission is backpressure, not silent truncation: past
-`depth_limit` total queued jobs, `submit` raises QueueFull and the HTTP
-layer answers 429 with a message — the submitter decides whether to
-retry, the hive never grows an unbounded backlog.
+Admission is backpressure, not silent truncation — and it degrades in
+priority order. Each class has a watermark, a fraction of `depth_limit`
+past which NEW submissions of that class are shed with a 429 (counted in
+`swarm_hive_shed_total{class}`): batch sheds first, interactive last, so
+an overloaded hive keeps serving the traffic that cares about latency
+while telling bulk submitters to come back later. A watermark of 1.0
+reproduces the old flat limit for that class.
+
+Internally each class queue is a deque of `(token, record)` entries with
+LAZY deletion: `take()` / `discard_queued()` mark the record (state
+change or token bump) instead of an O(n) `deque.remove`, and stale
+entries are skipped on iteration and compacted away once they outnumber
+the live ones. Dispatch cost therefore stays flat at thousands of queued
+jobs — the same "stays cheap at thousands" direction as the worker
+directory.
 """
 
 from __future__ import annotations
 
 import dataclasses
-import itertools
-import time
+import logging
+import math
 import uuid
 from collections import deque
 
 from .. import telemetry
+from .clock import CLOCK, HiveClock
+
+logger = logging.getLogger(__name__)
 
 # dispatch order, highest first
 JOB_CLASSES = ("interactive", "default", "batch")
+
+# per-class shed watermarks as fractions of depth_limit; parsed from
+# Settings.hive_shed_watermarks ("interactive:1.0,default:0.85,batch:0.5")
+DEFAULT_SHED_WATERMARKS = {
+    "interactive": 1.0,
+    "default": 0.85,
+    "batch": 0.5,
+}
 
 _QUEUE_DEPTH = telemetry.gauge(
     "swarm_hive_queue_depth",
@@ -41,9 +63,15 @@ _REFUSED = telemetry.counter(
     "swarm_hive_jobs_refused_total",
     "Job submissions refused by admission control (queue depth limit)",
 )
+_SHED = telemetry.counter(
+    "swarm_hive_shed_total",
+    "Job submissions shed by class-aware admission (per-class depth "
+    "watermark crossed; batch sheds first, interactive last)",
+    ("class",),
+)
 _QUEUE_WAIT = telemetry.histogram(
     "swarm_hive_queue_wait_seconds",
-    "Hive-side wait from job submission to dispatch to a worker",
+    "Hive-side wait from job submission to dispatch to a worker"
 )
 
 
@@ -56,6 +84,36 @@ def job_class(job: dict) -> str:
         if value in JOB_CLASSES:
             return value
     return "default"
+
+
+def parse_shed_watermarks(spec: str | None) -> dict[str, float]:
+    """Parse "interactive:1.0,default:0.85,batch:0.5" (``=`` also
+    accepted) into a class->fraction map; unknown classes are logged and
+    dropped, values clamp to (0, 1], absent classes default to 1.0 (the
+    flat limit). An empty spec means the stock degradation order."""
+    marks = dict(DEFAULT_SHED_WATERMARKS)
+    if spec is None:
+        return marks
+    spec = spec.strip()
+    if not spec:
+        return marks
+    marks = {cls: 1.0 for cls in JOB_CLASSES}
+    for part in spec.replace(";", ",").split(","):
+        part = part.strip()
+        if not part:
+            continue
+        sep = ":" if ":" in part else "="
+        cls, _, value = part.partition(sep)
+        cls = cls.strip().lower()
+        if cls not in JOB_CLASSES:
+            logger.warning("unknown class %r in shed watermark spec %r "
+                           "ignored", cls, spec)
+            continue
+        try:
+            marks[cls] = min(max(float(value), 1e-9), 1.0)
+        except ValueError:
+            logger.warning("unparseable shed watermark %r ignored", part)
+    return marks
 
 
 class QueueFull(Exception):
@@ -73,8 +131,9 @@ class JobRecord:
     job: dict
     job_id: str
     job_class: str
-    submitted_at: float  # monotonic
+    submitted_at: float  # monotonic (intervals); NEVER persisted as-is
     seq: int
+    submitted_wall: float = 0.0  # wall clock twin, for the journal
     state: str = "queued"
     attempts: int = 0  # dispatches so far
     worker: str | None = None  # current/last lessee
@@ -85,6 +144,10 @@ class JobRecord:
     error: str | None = None
     done_at: float | None = None  # monotonic, stamped on result acceptance
     retired: bool = False  # already counted against history_limit
+    # lazy-deletion bookkeeping: a deque entry (token, record) is live
+    # iff the record is queued AND the token matches (requeue_front /
+    # discard_queued bump it, turning older entries into tombstones)
+    enqueue_token: int = 0
 
     def status(self) -> dict:
         """JSON-ready snapshot for GET /api/jobs/{id}."""
@@ -107,36 +170,96 @@ class PriorityJobQueue:
     has ever admitted this process. Single-threaded by design: every
     caller is an aiohttp handler or the reaper task on one event loop."""
 
-    def __init__(self, depth_limit: int = 0, history_limit: int = 0):
+    def __init__(self, depth_limit: int = 0, history_limit: int = 0,
+                 shed_watermarks: dict[str, float] | None = None,
+                 clock: HiveClock | None = None):
         self.depth_limit = int(depth_limit)
         # finished (done/failed) records kept for GET /api/jobs/{id};
         # past this many the oldest are forgotten so a long-running
         # coordinator's memory is bounded by the limit, not its job
         # history (0 = keep everything)
         self.history_limit = int(history_limit)
-        self._queues: dict[str, deque[JobRecord]] = {
+        self.shed_watermarks = dict(
+            shed_watermarks if shed_watermarks is not None
+            else DEFAULT_SHED_WATERMARKS)
+        self.clock = clock or CLOCK
+        self._queues: dict[str, deque[tuple[int, JobRecord]]] = {
             cls: deque() for cls in JOB_CLASSES
         }
+        # live (queued) entries per class; deque lengths include
+        # tombstones and must never be used as a depth
+        self._live: dict[str, int] = {cls: 0 for cls in JOB_CLASSES}
         self.records: dict[str, JobRecord] = {}
         self._finished: deque[str] = deque()
-        self._seq = itertools.count()
+        self._next_seq = 0
         self._refresh_gauges()
 
     def _refresh_gauges(self) -> None:
-        for cls, q in self._queues.items():
-            _QUEUE_DEPTH.set(len(q), **{"class": cls})
+        for cls, n in self._live.items():
+            _QUEUE_DEPTH.set(n, **{"class": cls})
 
     @property
     def depth(self) -> int:
-        return sum(len(q) for q in self._queues.values())
+        return sum(self._live.values())
 
     def depths(self) -> dict[str, int]:
-        return {cls: len(q) for cls, q in self._queues.items()}
+        return dict(self._live)
+
+    # --- lazy-deletion internals ---
+
+    @staticmethod
+    def _is_live(entry: tuple[int, JobRecord]) -> bool:
+        token, record = entry
+        return record.state == "queued" and token == record.enqueue_token
+
+    def _enqueue(self, record: JobRecord, front: bool = False) -> None:
+        record.enqueue_token += 1
+        entry = (record.enqueue_token, record)
+        q = self._queues[record.job_class]
+        if front:
+            q.appendleft(entry)
+        else:
+            q.append(entry)
+        self._live[record.job_class] += 1
+        self._refresh_gauges()
+
+    def _dequeued(self, record: JobRecord) -> None:
+        """Note one live entry of `record` turning into a tombstone (the
+        caller already changed state / bumped the token). Compacts the
+        class deque once tombstones outnumber live entries."""
+        cls = record.job_class
+        self._live[cls] = max(self._live[cls] - 1, 0)
+        q = self._queues[cls]
+        if len(q) - self._live[cls] > max(self._live[cls], 8):
+            self._queues[cls] = deque(e for e in q if self._is_live(e))
+        self._refresh_gauges()
+
+    # --- admission ---
+
+    def shed_threshold(self, cls: str) -> int:
+        """Queued-job count at which class `cls` submissions shed
+        (0 = unlimited)."""
+        if self.depth_limit <= 0:
+            return 0
+        # ceil, so a watermark of 1.0 reproduces the flat limit exactly
+        # and tiny limits don't truncate a class to zero admission
+        return max(math.ceil(
+            self.depth_limit * self.shed_watermarks.get(cls, 1.0)), 1)
+
+    def shedding(self) -> list[str]:
+        """Classes whose watermark the current depth has crossed (for
+        /healthz degraded reasons)."""
+        if self.depth_limit <= 0:
+            return []
+        depth = self.depth
+        return [cls for cls in JOB_CLASSES
+                if depth >= self.shed_threshold(cls)]
 
     def submit(self, job: dict) -> JobRecord:
         """Admit one raw job dict; assigns an id when the submitter sent
-        none. Raises QueueFull past the depth limit (interactive jobs
-        included — a full hive must shed load, not reorder it away)."""
+        none. Raises QueueFull past the class's shed watermark — batch
+        sheds first, interactive only at the full depth limit (a full
+        hive must shed load, not reorder it away)."""
         job = dict(job)
         job_id = str(job.get("id") or uuid.uuid4().hex)
         job["id"] = job_id
@@ -146,45 +269,50 @@ class PriorityJobQueue:
             # assume when they redeliver results at-least-once); dedup
             # beats admission — a retry of an admitted job is not load
             return self.records[job_id]
-        if self.depth_limit > 0 and self.depth >= self.depth_limit:
-            _REFUSED.inc()
-            raise QueueFull(
-                f"hive queue full ({self.depth} jobs, limit "
-                f"{self.depth_limit}); resubmit later"
-            )
         cls = job_class(job)
+        threshold = self.shed_threshold(cls)
+        if threshold and self.depth >= threshold:
+            _REFUSED.inc()
+            _SHED.inc(**{"class": cls})
+            raise QueueFull(
+                f"hive queue full for {cls} jobs ({self.depth} queued, "
+                f"limit {self.depth_limit}, {cls} sheds at {threshold}); "
+                "resubmit later"
+            )
         record = JobRecord(
             job=job,
             job_id=job_id,
             job_class=cls,
-            submitted_at=time.monotonic(),
-            seq=next(self._seq),
+            submitted_at=self.clock.mono(),
+            submitted_wall=self.clock.wall(),
+            seq=self._next_seq,
         )
+        self._next_seq += 1
         self.records[job_id] = record
-        self._queues[cls].append(record)
+        self._enqueue(record)
         _SUBMITTED.inc(**{"class": cls})
-        self._refresh_gauges()
         return record
 
     def iter_queued(self):
         """Records in dispatch order: class rank, FIFO within class.
         Snapshot copy — callers take() entries while iterating."""
         for cls in JOB_CLASSES:
-            yield from list(self._queues[cls])
+            for entry in list(self._queues[cls]):
+                if self._is_live(entry):
+                    yield entry[1]
 
     def take(self, record: JobRecord, worker: str, outcome: str) -> None:
         """Remove a queued record for dispatch and stamp its lease-side
         bookkeeping (attempts, queue wait on the first dispatch)."""
-        self._queues[record.job_class].remove(record)
         record.state = "leased"
         record.worker = worker
         record.attempts += 1
         record.placement = outcome
         if record.queue_wait_s is None:
             record.queue_wait_s = round(
-                time.monotonic() - record.submitted_at, 3)
+                self.clock.mono() - record.submitted_at, 3)
             _QUEUE_WAIT.observe(record.queue_wait_s)
-        self._refresh_gauges()
+        self._dequeued(record)
 
     def requeue_front(self, record: JobRecord) -> None:
         """Put an expired-lease job back at the FRONT of its class: a
@@ -193,35 +321,85 @@ class PriorityJobQueue:
         the expired lessee's name — a LATE result from it is attributed
         correctly, and the next take() overwrites it anyway."""
         record.state = "queued"
-        self._queues[record.job_class].appendleft(record)
-        self._refresh_gauges()
+        self._enqueue(record, front=True)
 
-    def retire(self, record: JobRecord) -> None:
+    def retire(self, record: JobRecord) -> list[str]:
         """Note a record reaching a terminal state and prune the oldest
-        finished ones past `history_limit`. Spooled artifact files stay
-        on disk (content-addressed); only the in-memory status entry is
-        forgotten — a later poll for a pruned id answers 404, the same
-        as a job this hive never knew."""
+        finished ones past `history_limit`. Returns the pruned job ids
+        (the journal must forget them too). Spooled artifact files stay
+        on disk subject only to the retention sweep; a later poll for a
+        pruned id answers 404, the same as a job this hive never knew."""
         if self.history_limit <= 0:
-            return
+            return []
         if record.retired:
             # a failed job completed later by a late result passes
             # through twice (reaper, then _results); one _finished slot
             # per record or the pruning loop evicts other records early
-            return
+            return []
         record.retired = True
         self._finished.append(record.job_id)
+        pruned: list[str] = []
         while len(self._finished) > self.history_limit:
             old = self._finished.popleft()
             stale = self.records.get(old)
             if stale is not None and stale.state in ("done", "failed"):
                 del self.records[old]
+                pruned.append(old)
+        return pruned
 
     def discard_queued(self, record: JobRecord) -> None:
         """Drop a record from its class queue if present (a late result
         arrived for a job we had already re-queued)."""
-        try:
-            self._queues[record.job_class].remove(record)
-        except ValueError:
+        if record.state != "queued":
             return
-        self._refresh_gauges()
+        # the token bump tombstones the deque entry whatever state the
+        # caller moves the record to next
+        record.enqueue_token += 1
+        self._dequeued(record)
+
+    # --- journal replay (no admission, no counters: these rebuild state
+    # the metrics already counted in a previous process) ---
+
+    def restore(self, job: dict, cls: str, seq: int, submitted_wall: float,
+                queue_wait_s: float | None = None) -> JobRecord:
+        """Recreate one admitted record from its journal event, queued.
+        `submitted_at` is re-anchored into this process's monotonic
+        timebase so interval arithmetic (queue wait, affinity hold,
+        unplaceable parking) spans the restart correctly."""
+        job_id = str(job.get("id", ""))
+        record = JobRecord(
+            job=dict(job),
+            job_id=job_id,
+            job_class=cls if cls in JOB_CLASSES else job_class(job),
+            submitted_at=self.clock.mono_from_wall(submitted_wall),
+            submitted_wall=submitted_wall,
+            seq=int(seq),
+            queue_wait_s=queue_wait_s,
+        )
+        self._next_seq = max(self._next_seq, record.seq + 1)
+        self.records[job_id] = record
+        self._enqueue(record)
+        return record
+
+    def restore_leased(self, record: JobRecord, worker: str, attempts: int,
+                       placement: str | None,
+                       queue_wait_s: float | None) -> None:
+        """Replay a dispatch: dequeue + stamp, without re-counting the
+        queue-wait histogram or dispatch metrics."""
+        record.state = "leased"
+        record.worker = worker
+        record.attempts = int(attempts)
+        record.placement = placement
+        if record.queue_wait_s is None:
+            record.queue_wait_s = queue_wait_s
+        self._dequeued(record)
+
+    def forget(self, job_id: str) -> None:
+        """Replay a history prune: the record is gone, as it was in the
+        process that journaled the retire event."""
+        record = self.records.pop(job_id, None)
+        if record is not None:
+            try:
+                self._finished.remove(job_id)
+            except ValueError:
+                pass

@@ -22,6 +22,7 @@ import hashlib
 import logging
 import os
 import threading
+import time
 import uuid
 from pathlib import Path
 
@@ -37,6 +38,11 @@ _SPOOLED = telemetry.counter(
 )
 _SPOOL_BYTES = telemetry.gauge(
     "swarm_hive_spool_bytes", "Total bytes resident in the artifact spool")
+_EVICTED = telemetry.counter(
+    "swarm_hive_spool_evicted_total",
+    "Artifact blobs deleted by the retention sweep (age or size bound; "
+    "blobs referenced by a live job record are never evicted)",
+)
 
 
 class ArtifactSpool:
@@ -98,6 +104,64 @@ class ArtifactSpool:
             return path.read_bytes()
         except OSError:
             return None
+
+    def sweep(self, max_bytes: int = 0, max_age_s: float = 0.0,
+              protected: frozenset[str] | set[str] = frozenset()) -> int:
+        """Retention sweep: `retire()` prunes in-memory records but the
+        content-addressed blobs would otherwise live forever. Deletes
+        blobs older than `max_age_s`, then the oldest remaining blobs
+        while the spool exceeds `max_bytes` (either bound 0 = off).
+        Digests in `protected` — everything a live (non-retired) record
+        still references — are never deleted, whatever their age: a
+        GET /api/jobs/{id} href must not dangle while the record can
+        still answer. Returns the number of blobs evicted."""
+        if max_bytes <= 0 and max_age_s <= 0:
+            return 0
+        with self._lock:
+            entries = []
+            total = 0
+            for path in self.root.glob("*/*"):
+                try:
+                    st = path.stat()
+                except OSError:
+                    continue
+                if not path.is_file():
+                    continue
+                total += st.st_size
+                entries.append((st.st_mtime, st.st_size, path))
+            entries.sort()  # oldest first
+            evicted = 0
+            now = time.time()
+            survivors = []
+            for mtime, size, path in entries:
+                if path.name in protected:
+                    continue
+                if max_age_s > 0 and now - mtime > max_age_s:
+                    try:
+                        path.unlink()
+                    except OSError:
+                        continue
+                    total -= size
+                    evicted += 1
+                else:
+                    survivors.append((size, path))
+            if max_bytes > 0:
+                for size, path in survivors:
+                    if total <= max_bytes:
+                        break
+                    try:
+                        path.unlink()
+                    except OSError:
+                        continue
+                    total -= size
+                    evicted += 1
+            self._bytes = max(total, 0)
+            _SPOOL_BYTES.set(self._bytes)
+            if evicted:
+                _EVICTED.inc(evicted)
+                logger.info("spool sweep evicted %d blob(s); %d bytes remain",
+                            evicted, self._bytes)
+        return evicted
 
     def store_result(self, result: dict) -> dict:
         """Spool every artifact blob in an envelope; returns a copy with

@@ -133,6 +133,27 @@ class Settings:
     # GET /api/jobs/{id}; older ones are forgotten so coordinator memory
     # is bounded by this, not by job history (0 = keep everything)
     hive_job_history_limit: int = 1000
+    # --- hive durability (hive_server/journal.py) ---
+    # write-ahead journal directory (relative to $SDAAS_ROOT); every
+    # queue/lease transition is appended so a crashed hive replays to its
+    # pre-crash state on restart. "" disables (pure in-memory coordinator)
+    hive_wal_dir: str = "hive_wal"
+    # fsync each WAL append: flush-only (False) survives process death
+    # incl. SIGKILL; fsync additionally survives power loss, at a
+    # per-transition disk-sync cost
+    hive_wal_fsync: bool = False
+    # appends between WAL compactions (stream rewritten as a minimal
+    # state snapshot); 0 = only compact on startup
+    hive_wal_compact_every: int = 512
+    # class-aware load shedding: per-class fractions of
+    # hive_queue_depth_limit past which NEW submissions of that class
+    # answer 429 — batch sheds first, interactive last
+    hive_shed_watermarks: str = "interactive:1.0,default:0.85,batch:0.5"
+    # artifact-spool retention sweep: total size / blob age bounds
+    # (0 = keep everything); blobs referenced by a live job record are
+    # never evicted
+    hive_spool_max_bytes: int = 0
+    hive_spool_max_age_s: float = 0.0
 
     @classmethod
     def field_names(cls) -> tuple[str, ...]:
@@ -172,6 +193,12 @@ _ENV_OVERRIDES = {
     "CHIASWARM_HIVE_MAX_JOBS_PER_POLL": "hive_max_jobs_per_poll",
     "CHIASWARM_HIVE_SPOOL_DIR": "hive_spool_dir",
     "CHIASWARM_HIVE_JOB_HISTORY_LIMIT": "hive_job_history_limit",
+    "CHIASWARM_HIVE_WAL_DIR": "hive_wal_dir",
+    "CHIASWARM_HIVE_WAL_FSYNC": "hive_wal_fsync",
+    "CHIASWARM_HIVE_WAL_COMPACT_EVERY": "hive_wal_compact_every",
+    "CHIASWARM_HIVE_SHED_WATERMARKS": "hive_shed_watermarks",
+    "CHIASWARM_HIVE_SPOOL_MAX_BYTES": "hive_spool_max_bytes",
+    "CHIASWARM_HIVE_SPOOL_MAX_AGE_S": "hive_spool_max_age_s",
 }
 
 

@@ -80,6 +80,16 @@ def test_bench_cpu_fallback_produces_labeled_smoke_row():
     assert out.get("hive_e2e_queue_wait_p95_s") >= \
         out["hive_e2e_queue_wait_p50_s"], out
 
+    # hive durability row (ISSUE 6): a SIGKILL'd hive restarted over the
+    # same $SDAAS_ROOT must recover every queued + leased job from the
+    # WAL — zero lost is the acceptance bar, not a target
+    assert out.get("hive_restart_jobs", 0) >= 1, out
+    assert out.get("hive_restart_jobs_lost") == 0, out
+    assert out.get("hive_restart_leased", 0) >= 1, out
+    assert out.get("hive_restart_recovered_leased") == \
+        out["hive_restart_leased"], out
+    assert out.get("hive_restart_recovery_s", -1) >= 0, out
+
     # cross-job micro-batching row (4-virtual-device slice child): the
     # coalesce ladder landed, and filling the slice beats batch-1 passes
     # (structurally ~4x here — replicated vs sharded — so >1 is a safe,

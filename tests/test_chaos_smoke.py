@@ -1,8 +1,10 @@
 """tools/chaos_smoke.py wired into CI: every fault-injection scenario —
 submit drops, hive connection drops, hang-in-denoise under the watchdog,
-crash-before-ack, drain-with-in-flight-job, and a hive-side lease
-takeover (worker dies mid-lease, the real coordinator redelivers to a
-second worker) — must end with a healthy swarm and zero lost envelopes.
+crash-before-ack, drain-with-in-flight-job, a hive-side lease takeover
+(worker dies mid-lease, the real coordinator redelivers to a second
+worker), and a hive SIGKILL'd while holding queued + leased jobs (WAL
+replay on restart, zero lost) — must end with a healthy swarm and zero
+lost envelopes.
 """
 
 import importlib.util
@@ -29,6 +31,7 @@ def _load_tool():
     "kill_before_ack",
     "sigterm_drain",
     "hive_lease_takeover",
+    "hive_crash_recovery",
 ])
 def test_chaos_scenario(name, sdaas_root):
     tool = _load_tool()

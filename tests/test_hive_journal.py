@@ -1,0 +1,426 @@
+"""Hive durability (ISSUE 6): the write-ahead journal, the wall/mono
+clock convention, and crash-recovery semantics.
+
+Covers the clock helper across a simulated restart (monotonic origins
+differ, wall clock is the shared timebase), WAL replay equivalence at
+the HTTP level (a restarted HiveServer lands on the pre-stop queue
+order, record table, and lease set), torn-tail tolerance, compaction,
+the hive-side fault-injection points, and the WAL-off escape hatch.
+"""
+
+import asyncio
+import json
+
+import aiohttp
+import pytest
+
+from chiaswarm_tpu import faults
+from chiaswarm_tpu.hive_server.clock import HiveClock
+from chiaswarm_tpu.hive_server.journal import HiveJournal
+from chiaswarm_tpu.hive_server.leases import LeaseTable
+from chiaswarm_tpu.hive_server.queue import PriorityJobQueue
+from chiaswarm_tpu.settings import Settings
+
+TOKEN = "journal-test-token"
+
+
+@pytest.fixture(autouse=True)
+def disarm_faults():
+    yield
+    faults.configure("")
+
+
+def _hive_settings(**overrides) -> Settings:
+    fields = dict(sdaas_token=TOKEN, hive_port=0, metrics_port=0)
+    fields.update(overrides)
+    return Settings(**fields)
+
+
+def _fake_clocks():
+    """Two clocks sharing one wall timebase but with different monotonic
+    origins — process A, then its restart B thirty wall-seconds later."""
+    a = HiveClock(mono=lambda: 100.0, wall=lambda: 1000.0)
+    b = HiveClock(mono=lambda: 5.0, wall=lambda: 1030.0)
+    return a, b
+
+
+# --- clock helper (satellite: the monotonic-clock bug, WAL-independent) ---
+
+
+def test_clock_roundtrip_within_one_process():
+    clock = HiveClock(mono=lambda: 50.0, wall=lambda: 2000.0)
+    assert clock.wall_from_mono(40.0) == 1990.0
+    assert clock.mono_from_wall(1990.0) == 40.0
+
+
+def test_queue_wait_arithmetic_spans_a_simulated_restart():
+    clock_a, clock_b = _fake_clocks()
+    q1 = PriorityJobQueue(clock=clock_a)
+    record = q1.submit({"id": "travelled"})
+    assert record.submitted_at == 100.0
+    assert record.submitted_wall == 1000.0
+
+    # restart: a new queue in a process whose monotonic origin has
+    # nothing to do with the old one
+    q2 = PriorityJobQueue(clock=clock_b)
+    restored = q2.restore(record.job, record.job_class, record.seq,
+                          record.submitted_wall)
+    q2.take(restored, worker="w", outcome="cold")
+    # 30 wall-seconds passed across the restart; the interval survives
+    assert restored.queue_wait_s == pytest.approx(30.0)
+
+
+def test_lease_reap_uses_injected_clock_and_fresh_restore_deadline():
+    now = [0.0]
+    clock = HiveClock(mono=lambda: now[0], wall=lambda: 1e9 + now[0])
+    q = PriorityJobQueue(clock=clock)
+    record = q.submit({"id": "leased"})
+    leases = LeaseTable(deadline_s=10.0, max_redeliveries=3, clock=clock)
+    q.take(record, "w1", "cold")
+    leases.grant(record, "w1")
+    now[0] = 9.0
+    assert leases.reap(q) == []
+    now[0] = 11.0
+    assert [r.job_id for r in leases.reap(q)] == ["leased"]
+
+    # a restored lease measures its deadline from NOW, not from a dead
+    # process's monotonic offset
+    q.take(record, "w1", "cold")
+    leases.restore(record, "w1")
+    now[0] = 20.0  # 9s after restore: inside the fresh deadline
+    assert leases.reap(q) == []
+    now[0] = 22.0
+    assert [r.job_id for r in leases.reap(q)] == ["leased"]
+
+
+# --- HTTP-level replay equivalence ------------------------------------------
+
+
+async def _poll(session, api_uri, name, **extra):
+    params = {"worker_version": "0.1.0", "worker_name": name,
+              "chips": "4", "slices": "4", "busy_slices": "0",
+              "queue_depth": "0", "resident_models": ""}
+    params.update({k: str(v) for k, v in extra.items()})
+    async with session.get(f"{api_uri}/work", params=params,
+                           headers={"Authorization": f"Bearer {TOKEN}"}) as r:
+        return r.status, (await r.json() if r.status == 200 else None)
+
+
+async def _post(session, url, payload):
+    async with session.post(
+            url, data=json.dumps(payload),
+            headers={"Authorization": f"Bearer {TOKEN}",
+                     "Content-type": "application/json"}) as r:
+        try:
+            return r.status, await r.json()
+        except (aiohttp.ContentTypeError, json.JSONDecodeError):
+            return r.status, None
+
+
+def test_restarted_hive_replays_to_pre_stop_state(sdaas_root):
+    """THE tentpole scenario at the wire level: queued jobs (with a
+    requeue-front in the history), a live lease, and a settled result
+    all survive a stop + fresh construction over the same root."""
+    from chiaswarm_tpu.hive_server import HiveServer
+
+    async def scenario():
+        settings = _hive_settings()
+        async with HiveServer(settings, port=0) as hive, \
+                aiohttp.ClientSession() as session:
+            for i, prio in enumerate(
+                    ["batch", "interactive", "default", "default"]):
+                status, _ = await _post(
+                    session, f"{hive.api_uri}/jobs",
+                    {"id": f"j{i}", "workflow": "echo", "model_name": "none",
+                     "prompt": str(i), "priority": prio})
+                assert status == 200
+            # w1 leases the interactive job and the first default job
+            status, payload = await _poll(
+                session, hive.api_uri, "w1", slices=2)
+            leased_ids = [j["id"] for j in payload["jobs"]]
+            assert leased_ids == ["j1", "j2"]
+            # j1 completes; j2 stays leased across the restart
+            status, ack = await _post(
+                session, f"{hive.api_uri}/results",
+                {"id": "j1", "artifacts": {}, "nsfw": False,
+                 "pipeline_config": {}, "worker_name": "w1"})
+            assert status == 200 and ack["status"] == "ok"
+            pre = {jid: rec.status()
+                   for jid, rec in hive.queue.records.items()}
+            pre_order = [r.job_id for r in hive.queue.iter_queued()]
+
+        # same root, fresh process state: __init__ replays the WAL
+        revived = HiveServer(settings)
+        post = {jid: rec.status()
+                for jid, rec in revived.queue.records.items()}
+        post_order = [r.job_id for r in revived.queue.iter_queued()]
+        assert post_order == pre_order == ["j3", "j0"]
+        assert set(post) == set(pre)
+        for jid in pre:
+            for key in ("class", "status", "attempts", "worker",
+                        "completed_by", "error"):
+                assert post[jid][key] == pre[jid][key], (jid, key)
+        # the settled result rode along (spool refs intact)
+        assert post["j1"]["status"] == "done"
+        assert post["j1"]["result"]["id"] == "j1"
+        # the live lease was re-granted — to the same worker, fresh clock
+        lease = revived.leases.get("j2")
+        assert lease is not None and lease.worker == "w1"
+        assert lease.expires_at > revived.leases.clock.mono()
+        # recovery is visible on /healthz
+        assert revived.health()["wal"]["recovery"]["jobs"] == 4
+
+    asyncio.run(scenario())
+
+
+def test_recovered_lease_expires_and_redelivers(sdaas_root):
+    """A lease recovered from the WAL belongs to a possibly-dead worker:
+    it must expire one FRESH deadline after the restart and redeliver to
+    whoever polls next."""
+    from chiaswarm_tpu.hive_server import HiveServer
+
+    async def scenario():
+        settings = _hive_settings(hive_lease_deadline_s=0.2,
+                                  hive_max_redeliveries=3)
+        async with HiveServer(settings, port=0) as hive, \
+                aiohttp.ClientSession() as session:
+            await _post(session, f"{hive.api_uri}/jobs",
+                        {"id": "orphan", "workflow": "echo",
+                         "model_name": "none", "prompt": "x"})
+            _, payload = await _poll(session, hive.api_uri, "doomed-w")
+            assert [j["id"] for j in payload["jobs"]] == ["orphan"]
+
+        async with HiveServer(settings, port=0) as revived, \
+                aiohttp.ClientSession() as session:
+            record = revived.queue.records["orphan"]
+            assert record.state == "leased"
+            for _ in range(100):
+                if record.state == "queued":
+                    break
+                await asyncio.sleep(0.05)
+            assert record.state == "queued", "recovered lease never expired"
+            _, payload = await _poll(session, revived.api_uri, "second-w")
+            assert [j["id"] for j in payload["jobs"]] == ["orphan"]
+            assert record.attempts == 2
+
+    asyncio.run(scenario())
+
+
+def test_history_prune_survives_replay(sdaas_root):
+    """retire() pruning is journaled: a restarted hive answers 404 for a
+    pruned id, exactly as the pre-crash hive did."""
+    from chiaswarm_tpu.hive_server import HiveServer
+
+    async def scenario():
+        settings = _hive_settings(hive_job_history_limit=1)
+        async with HiveServer(settings, port=0) as hive, \
+                aiohttp.ClientSession() as session:
+            for i in range(2):
+                await _post(session, f"{hive.api_uri}/jobs",
+                            {"id": f"h{i}", "workflow": "echo",
+                             "model_name": "none", "prompt": str(i)})
+            _, payload = await _poll(session, hive.api_uri, "w1", slices=4)
+            assert len(payload["jobs"]) == 2
+            for i in range(2):
+                await _post(session, f"{hive.api_uri}/results",
+                            {"id": f"h{i}", "artifacts": {}, "nsfw": False,
+                             "pipeline_config": {}, "worker_name": "w1"})
+            assert set(hive.queue.records) == {"h1"}
+
+        revived = HiveServer(settings)
+        assert set(revived.queue.records) == {"h1"}
+        assert revived.queue.records["h1"].state == "done"
+
+    asyncio.run(scenario())
+
+
+# --- journal file mechanics -------------------------------------------------
+
+
+def test_torn_tail_is_skipped_not_fatal(sdaas_root, caplog):
+    journal = HiveJournal(sdaas_root / "wal")
+    journal.append({"ev": "admit", "job": {"id": "a"}, "class": "default",
+                    "seq": 0, "wall": 1.0})
+    journal.append({"ev": "admit", "job": {"id": "b"}, "class": "default",
+                    "seq": 1, "wall": 2.0})
+    journal.close()
+    # the crash artifact: a half-written last line
+    with open(journal.path, "ab") as fh:
+        fh.write(b'{"ev": "lease", "id": "b", "wor')
+
+    revived = HiveJournal(sdaas_root / "wal")
+    events = revived.recover()
+    assert [e["job"]["id"] for e in events] == ["a", "b"]
+    assert revived.torn_lines == 1
+
+
+def test_mid_stream_corruption_skipped_loudly(sdaas_root, caplog):
+    journal = HiveJournal(sdaas_root / "wal")
+    journal.append({"ev": "admit", "job": {"id": "a"}, "class": "default",
+                    "seq": 0, "wall": 1.0})
+    journal.close()
+    with open(journal.path, "ab") as fh:
+        fh.write(b"### not json at all ###\n")
+        fh.write(json.dumps({"ev": "admit", "job": {"id": "c"},
+                             "class": "default", "seq": 2,
+                             "wall": 3.0}).encode() + b"\n")
+
+    import logging
+    revived = HiveJournal(sdaas_root / "wal")
+    with caplog.at_level(logging.ERROR,
+                         logger="chiaswarm_tpu.hive_server.journal"):
+        events = revived.recover()
+    assert [e["job"]["id"] for e in events] == ["a", "c"]
+    assert revived.torn_lines == 1
+    assert any("corrupt mid-stream" in r.message for r in caplog.records)
+
+
+def test_compaction_bounds_the_stream(sdaas_root):
+    """Past compact_every appends the WAL is rewritten as minimal state;
+    a replay of the compacted stream still reconstructs everything."""
+    from chiaswarm_tpu.hive_server import HiveServer
+
+    async def scenario():
+        settings = _hive_settings(hive_wal_compact_every=4)
+        async with HiveServer(settings, port=0) as hive, \
+                aiohttp.ClientSession() as session:
+            for i in range(10):
+                await _post(session, f"{hive.api_uri}/jobs",
+                            {"id": f"c{i}", "workflow": "echo",
+                             "model_name": "none", "prompt": str(i)})
+            assert hive.journal.appends_since_compact < 4
+            lines = [ln for ln in
+                     hive.journal.path.read_bytes().split(b"\n")
+                     if ln.strip()]
+            # bounded by live state (+ the tail since the last compaction)
+            assert len(lines) <= 10 + 4
+
+        revived = HiveServer(settings)
+        assert set(revived.queue.records) == {f"c{i}" for i in range(10)}
+        assert [r.job_id for r in revived.queue.iter_queued()] == \
+            [f"c{i}" for i in range(10)]
+
+    asyncio.run(scenario())
+
+
+def test_requeue_front_order_survives_compaction_and_replay(sdaas_root):
+    """A redelivered job sits at the FRONT of its class; compaction must
+    preserve that order (the order IS the state), and the folded-in
+    dispatch history must survive too."""
+    from chiaswarm_tpu.hive_server import HiveServer
+
+    async def scenario():
+        settings = _hive_settings(hive_lease_deadline_s=0.2)
+        async with HiveServer(settings, port=0) as hive, \
+                aiohttp.ClientSession() as session:
+            await _post(session, f"{hive.api_uri}/jobs",
+                        {"id": "first", "workflow": "echo",
+                         "model_name": "none", "prompt": "a"})
+            _, payload = await _poll(session, hive.api_uri, "slow-w")
+            assert [j["id"] for j in payload["jobs"]] == ["first"]
+            await _post(session, f"{hive.api_uri}/jobs",
+                        {"id": "second", "workflow": "echo",
+                         "model_name": "none", "prompt": "b"})
+            record = hive.queue.records["first"]
+            for _ in range(100):
+                if record.state == "queued":
+                    break
+                await asyncio.sleep(0.05)
+            assert record.state == "queued"
+            assert [r.job_id for r in hive.queue.iter_queued()] == \
+                ["first", "second"]
+            # force a compaction so replay goes through snapshot_events
+            hive.journal.compact(hive.journal.snapshot_fn())
+
+        revived = HiveServer(settings)
+        assert [r.job_id for r in revived.queue.iter_queued()] == \
+            ["first", "second"]
+        # history folded into the admit: a later failure still counts
+        # this dispatch against the redelivery budget
+        assert revived.queue.records["first"].attempts == 1
+        assert revived.queue.records["first"].worker == "slow-w"
+
+    asyncio.run(scenario())
+
+
+def test_wal_disabled_preserves_in_memory_behavior(sdaas_root):
+    from chiaswarm_tpu.hive_server import HiveServer
+
+    async def scenario():
+        settings = _hive_settings(hive_wal_dir="")
+        async with HiveServer(settings, port=0) as hive, \
+                aiohttp.ClientSession() as session:
+            assert hive.journal is None
+            await _post(session, f"{hive.api_uri}/jobs",
+                        {"id": "volatile", "workflow": "echo",
+                         "model_name": "none", "prompt": "x"})
+            assert "wal" not in hive.health()
+        assert not (sdaas_root / "hive_wal").exists()
+        # a fresh instance remembers nothing — exactly the old contract
+        assert HiveServer(settings).queue.records == {}
+
+    asyncio.run(scenario())
+
+
+# --- hive-side fault injection ----------------------------------------------
+
+
+def test_kill_before_journal_sync_loses_only_that_transition(sdaas_root):
+    """The hive 'dies' between the in-memory admit and the WAL append:
+    the submitter sees the crash (500, no ACK) and the restarted hive
+    has no trace of the job — never a half-recorded one."""
+    from chiaswarm_tpu.hive_server import HiveServer
+
+    async def scenario():
+        settings = _hive_settings()
+        async with HiveServer(settings, port=0) as hive, \
+                aiohttp.ClientSession() as session:
+            status, _ = await _post(session, f"{hive.api_uri}/jobs",
+                                    {"id": "durable", "workflow": "echo",
+                                     "model_name": "none", "prompt": "x"})
+            assert status == 200
+            faults.configure("kill_before_journal_sync=1")
+            status, _ = await _post(session, f"{hive.api_uri}/jobs",
+                                    {"id": "lost", "workflow": "echo",
+                                     "model_name": "none", "prompt": "y"})
+            assert status == 500  # the submitter holds no ACK
+            assert faults.get_plan().fired("kill_before_journal_sync") == 1
+            faults.configure("")
+
+        revived = HiveServer(settings)
+        assert set(revived.queue.records) == {"durable"}
+
+    asyncio.run(scenario())
+
+
+def test_crash_after_lease_redelivers_via_wal(sdaas_root):
+    """The hive 'dies' after leasing + journaling but before the /work
+    reply leaves: the worker has nothing, and the restarted hive holds
+    the lease — redelivered to the next poller after expiry."""
+    from chiaswarm_tpu.hive_server import HiveServer
+
+    async def scenario():
+        settings = _hive_settings(hive_lease_deadline_s=0.2)
+        async with HiveServer(settings, port=0) as hive, \
+                aiohttp.ClientSession() as session:
+            await _post(session, f"{hive.api_uri}/jobs",
+                        {"id": "mid-crash", "workflow": "echo",
+                         "model_name": "none", "prompt": "x"})
+            faults.configure("crash_after_lease=1")
+            status, _ = await _poll(session, hive.api_uri, "unlucky-w")
+            assert status == 500  # the reply never left the 'crashing' hive
+            faults.configure("")
+
+        async with HiveServer(settings, port=0) as revived, \
+                aiohttp.ClientSession() as session:
+            record = revived.queue.records["mid-crash"]
+            assert record.state == "leased"
+            assert record.worker == "unlucky-w"
+            for _ in range(100):
+                if record.state == "queued":
+                    break
+                await asyncio.sleep(0.05)
+            _, payload = await _poll(session, revived.api_uri, "lucky-w")
+            assert [j["id"] for j in payload["jobs"]] == ["mid-crash"]
+
+    asyncio.run(scenario())

@@ -19,9 +19,11 @@ from chiaswarm_tpu import worker as worker_mod
 from chiaswarm_tpu.hive_server.dispatch import Dispatcher, WorkerDirectory
 from chiaswarm_tpu.hive_server.leases import LeaseTable
 from chiaswarm_tpu.hive_server.queue import (
+    JOB_CLASSES,
     PriorityJobQueue,
     QueueFull,
     job_class,
+    parse_shed_watermarks,
 )
 from chiaswarm_tpu.hive_server.spool import ArtifactSpool
 from chiaswarm_tpu.settings import Settings
@@ -85,6 +87,83 @@ def test_requeue_front_beats_fresh_arrivals():
     q.submit({"id": "new2", "priority": "default"})
     q.requeue_front(first)
     assert [r.job_id for r in q.iter_queued()] == ["old", "new1", "new2"]
+
+
+def test_parse_shed_watermarks():
+    marks = parse_shed_watermarks("interactive:1.0,default=0.9,batch:0.25")
+    assert marks == {"interactive": 1.0, "default": 0.9, "batch": 0.25}
+    # unknown classes dropped, absent classes default to the flat limit
+    assert parse_shed_watermarks("bogus:0.1")["interactive"] == 1.0
+    # empty/None = the stock degradation order (batch first)
+    assert parse_shed_watermarks(None)["batch"] < \
+        parse_shed_watermarks(None)["interactive"] == 1.0
+    # values clamp into (0, 1]
+    assert parse_shed_watermarks("batch:7")["batch"] == 1.0
+
+
+def test_class_aware_shedding_degrades_in_priority_order():
+    """Satellite of the tentpole: past its watermark a class sheds while
+    higher classes still admit — batch first, interactive last."""
+    shed = telemetry.REGISTRY.get(
+        "swarm_hive_shed_total") or telemetry.counter(
+        "swarm_hive_shed_total", "", ("class",))
+    before = {cls: shed.value(**{"class": cls}) for cls in JOB_CLASSES}
+    q = PriorityJobQueue(depth_limit=10)  # thresholds: 5 / 9 / 10
+    for i in range(5):
+        q.submit({"id": f"b{i}", "priority": "batch"})
+    with pytest.raises(QueueFull) as err:
+        q.submit({"id": "b5", "priority": "batch"})
+    assert "batch" in str(err.value) and "full" in str(err.value)
+    # default still admits past the batch watermark...
+    for i in range(4):
+        q.submit({"id": f"d{i}"})
+    with pytest.raises(QueueFull):
+        q.submit({"id": "d4"})  # depth 9 >= default threshold 9
+    # ...and interactive admits to the full flat limit
+    q.submit({"id": "i0", "priority": "interactive"})
+    with pytest.raises(QueueFull):
+        q.submit({"id": "i1", "priority": "interactive"})
+    delta = {cls: shed.value(**{"class": cls}) - before[cls]
+             for cls in JOB_CLASSES}
+    assert delta == {"batch": 1, "default": 1, "interactive": 1}
+    assert set(q.shedding()) == set(JOB_CLASSES)
+
+
+def test_shedding_visible_on_healthz(sdaas_root):
+    from chiaswarm_tpu.hive_server import HiveServer
+
+    server = HiveServer(_hive_settings(hive_queue_depth_limit=10))
+    for i in range(5):  # depth 5 == the batch watermark (ceil(10*0.5))
+        server.queue.submit({"id": f"s{i}", "priority": "batch"})
+    health = server.health()
+    assert health["status"] == "degraded"
+    assert any("shedding batch" in r for r in health["degraded_reasons"])
+    # interactive traffic is NOT degraded yet
+    assert not any("interactive" in r for r in health["degraded_reasons"])
+
+
+def test_queue_lazy_deletion_keeps_deques_bounded():
+    """Satellite: take()/discard_queued() are tombstone marks, not O(n)
+    deque.remove, and tombstones are compacted once they outnumber the
+    live entries — the internal deque cannot grow past ~2x live."""
+    q = PriorityJobQueue()
+    records = [q.submit({"id": f"t{i}"}) for i in range(500)]
+    for r in records:
+        q.take(r, "w", "cold")
+    assert q.depth == 0
+    assert sum(len(d) for d in q._queues.values()) <= 16
+    # a discard mid-queue keeps order for the survivors
+    a, b, c = (q.submit({"id": x}) for x in ("a", "b", "c"))
+    q.discard_queued(b)
+    b.state = "done"
+    assert [r.job_id for r in q.iter_queued()] == ["a", "c"]
+    assert q.depth == 2
+    # requeue_front after take still wins the front slot exactly once
+    q.take(a, "w", "cold")
+    q.requeue_front(a)
+    assert [r.job_id for r in q.iter_queued()] == ["a", "c"]
+    q.take(a, "w", "cold")
+    assert [r.job_id for r in q.iter_queued()] == ["c"]
 
 
 # --- leases -----------------------------------------------------------------
@@ -349,6 +428,73 @@ def test_spool_content_addressing_and_dedup(sdaas_root):
     assert art["thumbnail"] == "dGh1bWI="  # thumbnails stay inline
     assert spool.get(art["sha256"]) == b"artifact-bytes"
     assert art["href"] == f"/api/artifacts/{art['sha256']}"
+
+
+def test_spool_sweep_age_size_and_protection(sdaas_root):
+    """Satellite: the retention sweep bounds the spool by age and size,
+    oldest-first, and never touches a protected digest."""
+    import os
+    import time as _time
+
+    spool = ArtifactSpool(sdaas_root / "spool")
+    old = spool.put(b"old-blob" * 64)
+    mid = spool.put(b"mid-blob" * 64)
+    new = spool.put(b"new-blob" * 64)
+    now = _time.time()
+    os.utime(spool.path_for(old), (now - 1000, now - 1000))
+    os.utime(spool.path_for(mid), (now - 500, now - 500))
+
+    # age bound: only the 1000s-old blob crosses 600s
+    assert spool.sweep(max_age_s=600.0) == 1
+    assert spool.path_for(old) is None
+    assert spool.path_for(mid) is not None
+
+    # size bound: evict oldest-first down to one blob's budget
+    assert spool.sweep(max_bytes=600) == 1
+    assert spool.path_for(mid) is None
+    assert spool.path_for(new) is not None
+
+    # protection beats both bounds
+    assert spool.sweep(max_bytes=1, max_age_s=0.0001,
+                       protected={new}) == 0
+    assert spool.path_for(new) is not None
+    # both knobs zero = sweep off entirely
+    assert spool.sweep() == 0
+
+
+def test_server_sweep_protects_live_record_artifacts(sdaas_root):
+    """App-level: a blob referenced by a live (non-retired) done record
+    survives the sweep; an orphaned blob does not."""
+    import os
+    import time as _time
+
+    from chiaswarm_tpu.hive_server import HiveServer
+
+    async def scenario():
+        settings = _hive_settings(hive_spool_max_age_s=60.0)
+        async with HiveServer(settings, port=0) as hive, \
+                aiohttp.ClientSession() as session:
+            _, payload = await _post(
+                session, f"{hive.api_uri}/jobs",
+                {"id": "keeper", "workflow": "echo", "model_name": "none",
+                 "prompt": "x"})
+            [job] = await _poll(session, hive.api_uri, "w1")
+            blob = base64.b64encode(b"live-artifact").decode()
+            await _post(session, f"{hive.api_uri}/results",
+                        {"id": "keeper", "nsfw": False, "pipeline_config": {},
+                         "artifacts": {"primary": {"blob": blob}}})
+            live = hive.queue.records["keeper"].result[
+                "artifacts"]["primary"]["sha256"]
+            orphan = hive.spool.put(b"orphaned-artifact")
+            now = _time.time()
+            for digest in (live, orphan):
+                os.utime(hive.spool.path_for(digest),
+                         (now - 3600, now - 3600))
+            assert hive.sweep_spool() == 1
+            assert hive.spool.path_for(live) is not None
+            assert hive.spool.path_for(orphan) is None
+
+    asyncio.run(scenario())
 
 
 # --- HTTP + e2e (ISSUE 5 acceptance) ---------------------------------------
@@ -667,6 +813,33 @@ def test_interactive_job_overtakes_queued_batch_jobs(sdaas_root):
             # the job dict carried the priority onto the wire: the
             # worker's scheduler saw it (interactive jobs never linger)
             assert statuses[0]["class"] == "interactive"
+
+    asyncio.run(scenario())
+
+
+def test_hive_restart_preserves_jobs_end_to_end(sdaas_root):
+    """ISSUE 6 acceptance, in-process: jobs submitted before a hive
+    restart are completed after it by a worker that joined later — the
+    WAL carried the queue across, and the worker needed no changes."""
+    from chiaswarm_tpu.hive_server import LocalSwarm
+
+    async def scenario():
+        swarm = LocalSwarm(
+            n_workers=0, chips_per_job=0, settings=_hive_settings())
+        async with swarm:
+            ids = []
+            for i in range(3):
+                ids.append(await swarm.submit({
+                    "id": f"restart-{i}", "workflow": "echo",
+                    "model_name": "none", "prompt": f"r{i}",
+                    "priority": ["interactive", "default", "batch"][i]}))
+            await swarm.restart_hive()
+            assert set(swarm.hive.queue.records) == set(ids)
+            assert [r.job_id for r in swarm.hive.queue.iter_queued()] == ids
+            swarm.add_worker("post-restart-worker")
+            for job_id in ids:
+                status = await swarm.wait_done(job_id, timeout=60.0)
+                assert status["completed_by"] == "post-restart-worker"
 
     asyncio.run(scenario())
 

@@ -51,6 +51,26 @@ def test_unknown_keys_ignored(sdaas_root):
     assert s.sdaas_token == "t"
 
 
+def test_hive_durability_env_overrides(sdaas_root, monkeypatch):
+    monkeypatch.setenv("CHIASWARM_HIVE_WAL_DIR", "custom_wal")
+    monkeypatch.setenv("CHIASWARM_HIVE_WAL_FSYNC", "true")
+    monkeypatch.setenv("CHIASWARM_HIVE_WAL_COMPACT_EVERY", "64")
+    monkeypatch.setenv("CHIASWARM_HIVE_SHED_WATERMARKS", "batch:0.25")
+    monkeypatch.setenv("CHIASWARM_HIVE_SPOOL_MAX_BYTES", "1048576")
+    monkeypatch.setenv("CHIASWARM_HIVE_SPOOL_MAX_AGE_S", "3600")
+    s = load_settings()
+    assert s.hive_wal_dir == "custom_wal"
+    assert s.hive_wal_fsync is True
+    assert s.hive_wal_compact_every == 64
+    assert s.hive_shed_watermarks == "batch:0.25"
+    assert s.hive_spool_max_bytes == 1048576
+    assert s.hive_spool_max_age_s == 3600.0
+    # the WAL defaults ON — durability is not opt-in
+    monkeypatch.undo()
+    assert load_settings().hive_wal_dir == "hive_wal"
+    assert load_settings().hive_wal_fsync is False
+
+
 def test_tpu_fields_roundtrip(sdaas_root):
     save_settings(Settings(chips_per_job=4, dtype="float32"))
     s = load_settings()

@@ -262,6 +262,137 @@ async def scenario_hive_lease_takeover() -> str:
     return "dead worker's lease expired; second worker completed the job"
 
 
+async def scenario_hive_crash_recovery() -> str:
+    """Hive durability (ISSUE 6 acceptance): a hive subprocess holding
+    one QUEUED and one LEASED job is killed with SIGKILL; a restart over
+    the same $SDAAS_ROOT replays the WAL to the pre-crash state, the
+    dead lessee's lease expires, and a pristine worker completes BOTH
+    jobs — zero lost."""
+    import json
+    import os
+    import socket
+    import subprocess
+
+    import aiohttp
+
+    faults.configure("")
+    token = "chaos"
+    repo = str(pathlib.Path(__file__).resolve().parent.parent)
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+    env = dict(os.environ, SDAAS_TOKEN=token,
+               CHIASWARM_HIVE_PORT=str(port),
+               CHIASWARM_HIVE_LEASE_DEADLINE_S="1.0",
+               PYTHONPATH=repo + os.pathsep + os.environ.get("PYTHONPATH", ""))
+    uri = f"http://127.0.0.1:{port}"
+    headers = {"Authorization": f"Bearer {token}",
+               "Content-type": "application/json"}
+
+    def spawn() -> subprocess.Popen:
+        return subprocess.Popen(
+            [sys.executable, "-m", "chiaswarm_tpu.hive_server"],
+            cwd=repo, env=env,
+            stdout=subprocess.DEVNULL, stderr=subprocess.STDOUT)
+
+    async def wait_up(session) -> bool:
+        for _ in range(200):
+            try:
+                async with session.get(f"{uri}/healthz") as r:
+                    if r.status in (200, 503):
+                        return True
+            except aiohttp.ClientError:
+                pass
+            await asyncio.sleep(0.1)
+        return False
+
+    procs = [spawn()]
+    w = runner = None
+    try:
+        async with aiohttp.ClientSession() as session:
+
+            async def submit(job: dict) -> str:
+                async with session.post(f"{uri}/api/jobs",
+                                        data=json.dumps(job),
+                                        headers=headers) as r:
+                    _check(r.status == 200, f"submit failed: {r.status}")
+                    return (await r.json())["id"]
+
+            async def status(job_id: str) -> dict:
+                async with session.get(f"{uri}/api/jobs/{job_id}",
+                                       headers=headers) as r:
+                    _check(r.status == 200,
+                           f"job {job_id} lost across the restart "
+                           f"(HTTP {r.status})")
+                    return await r.json()
+
+            _check(await wait_up(session),
+                   "hive subprocess never answered /healthz")
+            leased_id = await submit(_echo("chaos-crash-leased"))
+            queued_id = await submit(_echo("chaos-crash-queued"))
+            # a doomed worker takes ONE lease (budget 1), then dies with
+            # the hive — neither ever gets to report anything
+            async with session.get(
+                    f"{uri}/api/work",
+                    params={"worker_version": "0.1.0",
+                            "worker_name": "doomed-w"},
+                    headers=headers) as r:
+                jobs = (await r.json())["jobs"]
+            _check([j["id"] for j in jobs] == [leased_id],
+                   f"expected exactly the first job leased, got {jobs}")
+
+            procs[0].kill()  # SIGKILL: no drain, no atexit, no flush
+            procs[0].wait()
+            procs.append(spawn())  # same $SDAAS_ROOT, same port
+            _check(await wait_up(session),
+                   "restarted hive never answered /healthz")
+
+            st = await status(leased_id)
+            _check(st["status"] in ("leased", "queued"),
+                   f"leased job recovered as {st['status']}")
+            _check(st["worker"] == "doomed-w",
+                   "recovered lease lost its lessee attribution")
+            _check((await status(queued_id))["status"] == "queued",
+                   "queued job not recovered as queued")
+
+            # a pristine worker against the restarted hive: the dead
+            # lessee's recovered lease expires (fresh 1s deadline) and
+            # both jobs complete
+            w = Worker(settings=_settings(),
+                       allocator=SliceAllocator(chips_per_job=0),
+                       hive_uri=f"{uri}/api")
+            runner = asyncio.create_task(w.run())
+
+            deadline = asyncio.get_running_loop().time() + 30.0
+            finals = {}
+            while len(finals) < 2:
+                _check(asyncio.get_running_loop().time() < deadline,
+                       f"jobs not completed after restart: {finals}")
+                for job_id in (queued_id, leased_id):
+                    if job_id not in finals:
+                        st = await status(job_id)
+                        _check(st["status"] != "failed",
+                               f"job {job_id} failed: {st['error']}")
+                        if st["status"] == "done":
+                            finals[job_id] = st
+                await asyncio.sleep(0.1)
+            _check(finals[leased_id]["completed_by"] == "chaos-worker",
+                   "leased job not completed by the takeover worker")
+            _check(finals[leased_id]["attempts"] >= 2,
+                   "redelivery attempt not recorded across the restart")
+    finally:
+        if w is not None:
+            w.stop()
+        if runner is not None:
+            await asyncio.wait_for(
+                asyncio.gather(runner, return_exceptions=True), 10)
+        for proc in procs:
+            proc.kill()
+            proc.wait()
+    return ("SIGKILL'd hive recovered 2 jobs from the WAL; the leased one "
+            "was redelivered to a pristine worker")
+
+
 SCENARIOS = {
     "drop_submit": scenario_drop_submit,
     "hive_connection_drop": scenario_hive_connection_drop,
@@ -269,6 +400,7 @@ SCENARIOS = {
     "kill_before_ack": scenario_kill_before_ack,
     "sigterm_drain": scenario_sigterm_drain,
     "hive_lease_takeover": scenario_hive_lease_takeover,
+    "hive_crash_recovery": scenario_hive_crash_recovery,
 }
 
 
