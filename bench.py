@@ -548,6 +548,11 @@ def cpu_smoke(extra_fields: dict | None = None,
     # (must be 0; the WAL replay is the claim under test)
     out.update(_hive_restart_row_subprocess())
 
+    # hive availability row (ISSUE 7): primary + WAL-shipped standby +
+    # echo worker; primary killed mid-run, standby health-checks it dead
+    # and promotes — takeover time and jobs lost (must be 0)
+    out.update(_hive_failover_row_subprocess())
+
     # BENCH_FORCE_SECONDARY exercises the warm-probe + secondary-row code
     # paths on CPU with tiny models (they had never executed before a TPU
     # run — VERDICT r03 weak #4)
@@ -859,27 +864,32 @@ def _hive_e2e_row_subprocess() -> dict:
     return row
 
 
-def _hive_restart_row_subprocess() -> dict:
-    """Parent wrapper for the hive-restart durability row (child below);
-    no jax anywhere in this path, so it is cheap next to the e2e row."""
+def _hive_row_subprocess(row: str, key: str, timeout_default: float,
+                         extra_env: dict | None = None) -> dict:
+    """Shared parent wrapper for the hive robustness rows (restart,
+    failover): spawn the child row, tail its stderr, parse its JSON."""
     import subprocess
 
-    timeout_s = _row_timeout("hive_restart", 180.0)
+    timeout_s = _row_timeout(row.replace("-", "_"), timeout_default)
+    env = dict(os.environ, **(extra_env or {}))
     try:
         proc = subprocess.run(
-            [sys.executable, os.path.abspath(__file__),
-             "--row", "hive-restart"],
-            timeout=timeout_s, capture_output=True, text=True,
-            env=dict(os.environ),
+            [sys.executable, os.path.abspath(__file__), "--row", row],
+            timeout=timeout_s, capture_output=True, text=True, env=env,
         )
         sys.stderr.write(proc.stderr[-2000:] + "\n")
-        row = _parse_last_json(proc.stdout)
-        if row is None:
-            row = {"hive_restart_row": f"failed: no JSON "
-                                       f"(rc={proc.returncode})"}
+        parsed = _parse_last_json(proc.stdout)
+        if parsed is None:
+            parsed = {key: f"failed: no JSON (rc={proc.returncode})"}
     except subprocess.TimeoutExpired:
-        row = {"hive_restart_row": f"failed: timeout after {timeout_s:.0f}s"}
-    return row
+        parsed = {key: f"failed: timeout after {timeout_s:.0f}s"}
+    return parsed
+
+
+def _hive_restart_row_subprocess() -> dict:
+    """Hive-restart durability row (child: run_hive_restart_row); no jax
+    anywhere in this path, so it is cheap next to the e2e row."""
+    return _hive_row_subprocess("hive-restart", "hive_restart_row", 180.0)
 
 
 def run_hive_restart_row() -> None:
@@ -987,6 +997,82 @@ def run_hive_restart_row() -> None:
 
     with tempfile.TemporaryDirectory(prefix="bench_hive_restart_") as root:
         print(json.dumps(asyncio.run(scenario(root))))
+
+
+def _hive_failover_row_subprocess() -> dict:
+    """Hive-failover availability row (child: run_hive_failover_row):
+    primary + WAL-shipped standby + one in-process echo worker, primary
+    killed mid-run — reports takeover_s and jobs_lost (the acceptance
+    bar is exactly 0). The child needs jax (it runs a real Worker), so
+    pin it to CPU."""
+    return _hive_row_subprocess("hive-failover", "hive_failover_row",
+                                300.0, {"JAX_PLATFORMS": "cpu"})
+
+
+def run_hive_failover_row() -> None:
+    """Child for the failover row: a primary HiveServer, a WAL-shipped
+    StandbyHive replicating it, and one in-process Worker (echo jobs —
+    no weights, no compile) holding BOTH endpoints. The backlog is
+    submitted, the primary hard-stops mid-lease, and the standby must
+    health-check it dead, promote itself, and serve the worker's
+    failed-over polls until every job settles. `takeover_s` is
+    kill -> promoted; `jobs_lost` must be 0."""
+    import asyncio
+    import tempfile
+
+    os.environ["CHIASWARM_POLL_SECONDS"] = "0.1"  # read at worker import
+
+    n_jobs = int(os.environ.get("BENCH_HIVE_FAILOVER_JOBS", "8"))
+
+    async def scenario() -> dict:
+        import chiaswarm_tpu.worker as worker_mod
+        from chiaswarm_tpu.hive_server import LocalSwarm
+        from chiaswarm_tpu.settings import Settings
+
+        # the 121 s production poll-error backoff would dominate a row
+        # whose whole point is sub-second takeover
+        worker_mod.ERROR_BACKOFF_SECONDS = 2.0
+        settings = Settings(
+            sdaas_token="bench-failover", hive_port=0, metrics_port=0,
+            hive_lease_deadline_s=2.0, hive_max_redeliveries=3,
+            hive_failover_grace_s=0.5, hive_replication_poll_s=0.1)
+        swarm = LocalSwarm(n_workers=1, chips_per_job=0, settings=settings,
+                           standby=True)
+        async with swarm:
+            ids = [await swarm.submit(
+                {"id": f"bench-fo-{i}", "workflow": "echo",
+                 "model_name": "none", "prompt": f"failover {i}"})
+                for i in range(n_jobs)]
+            deadline = time.monotonic() + 30.0
+            while not all(j in swarm.standby.server.queue.records
+                          for j in ids):
+                if time.monotonic() > deadline:
+                    raise TimeoutError("standby never replicated the backlog")
+                await asyncio.sleep(0.05)
+            t0 = time.monotonic()
+            await swarm.kill_primary()
+            while not swarm.standby.promoted:
+                if time.monotonic() - t0 > 60.0:
+                    raise TimeoutError("standby never promoted")
+                await asyncio.sleep(0.02)
+            takeover_s = time.monotonic() - t0
+            done = 0
+            for job_id in ids:
+                status = await swarm.wait_done(job_id, timeout=120.0,
+                                               accept_failed=True)
+                done += int(status["status"] == "done")
+            return {
+                "hive_failover_jobs": n_jobs,
+                "hive_failover_jobs_lost": n_jobs - done,
+                "hive_failover_takeover_s": round(takeover_s, 3),
+                "hive_failover_epoch": swarm.standby.server.epoch,
+                "hive_failover_worker_failovers":
+                    swarm.workers[0].hive.failovers,
+            }
+
+    with tempfile.TemporaryDirectory(prefix="bench_hive_failover_") as root:
+        os.environ["SDAAS_ROOT"] = root  # isolate WAL/spool/outbox
+        print(json.dumps(asyncio.run(scenario())))
 
 
 def run_hive_e2e_row() -> None:
@@ -1299,6 +1385,8 @@ if __name__ == "__main__":
             run_hive_e2e_row()
         elif sys.argv[2] == "hive-restart":
             run_hive_restart_row()
+        elif sys.argv[2] == "hive-failover":
+            run_hive_failover_row()
         else:
             run_row(sys.argv[2])
     else:

@@ -36,6 +36,10 @@ Injection points wired today (site -> effect):
                      journaling jobs on a /work poll but before the
                      reply leaves — the worker never sees the jobs, and
                      WAL replay + lease expiry must redeliver them
+- ``drop_replication`` (hive-side) a standby's replication stream fetch
+                     dies mid-flight (network partition / primary
+                     mid-crash); the next sync must resume from the
+                     same position without losing or doubling events
 
 Sites call ``faults.fire(point)`` / ``faults.hang(point)`` by name;
 unknown names simply never fire, so new points cost one line at the site.

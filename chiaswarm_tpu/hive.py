@@ -14,14 +14,32 @@ legacy hives keep working.
 
 Unlike the reference (one aiohttp session per call), `HiveClient` holds a
 single session for connection reuse; the module-level functions keep the
-reference's call signatures for drop-in use.
+reference's call signatures for drop-in use (routed through a shared
+process-wide client, so they too reuse connections and failover state).
+
+Multi-hive failover (hive_server/replication.py is the serving half):
+`HiveClient` accepts a LIST of endpoints (`Settings.sdaas_uris` /
+`CHIASWARM_HIVE_URIS`, primary first) and PINS to one. It fails over —
+advances the pin to the next endpoint — on `hive_failover_errors`
+consecutive transport-level failures, or immediately on a not-primary
+refusal (HTTP 409 from a standby or a deposed, stale-epoch primary).
+Between attempts the existing retry layers supply the decorrelated
+backoff (the poll loop's `_next_backoff`, the outbox's `backoff_delay`),
+so a fleet failing over together does not stampede the survivor. The
+client also tracks the highest fencing epoch any hive has advertised
+(`X-Hive-Epoch`) and echoes it on every request — that echo is what lets
+a deposed primary discover it was deposed and refuse, instead of
+double-dispatching (split-brain fencing).
 """
 
 from __future__ import annotations
 
 import asyncio
+import atexit
+import contextlib
 import json
 import logging
+import os
 import time
 from typing import Any
 
@@ -52,6 +70,22 @@ _RETRIES = telemetry.counter(
     "Hive requests retried after a transient failure, by endpoint",
     ("endpoint",),
 )
+_FAILOVERS = telemetry.counter(
+    "swarm_hive_failover_total",
+    "Worker-side hive failovers (the client pinned to the next "
+    "configured endpoint after transport errors or a not-primary 409)",
+)
+_ENDPOINT_ERRORS = telemetry.counter(
+    "swarm_hive_endpoint_errors_total",
+    "Transport-level hive failures by configured endpoint URI",
+    ("uri",),
+)
+_ACTIVE_ENDPOINT = telemetry.gauge(
+    "swarm_hive_active_endpoint",
+    "1 for the hive endpoint this worker is currently pinned to, "
+    "0 for the others",
+    ("uri",),
+)
 
 
 class HiveError(Exception):
@@ -67,35 +101,215 @@ class HiveError(Exception):
         self.permanent = permanent
 
 
+class HiveNotPrimary(Exception):
+    """The pinned endpoint answered 409: it is a standby still
+    replicating, or a deposed primary fenced by a newer epoch. Always
+    transient — the job belongs to whichever hive IS primary."""
+
+
+# the worker host's highest-seen fencing epoch, persisted so outbox
+# redelivery after a restart still carries it (see HiveClient.__init__)
+EPOCH_FILENAME = "hive_epoch"
+
+
+def _load_persisted_epoch() -> int:
+    from .settings import resolve_path
+
+    try:
+        return int(resolve_path(EPOCH_FILENAME).read_text().strip())
+    except (OSError, ValueError):
+        return 0
+
+
+def _persist_epoch(epoch: int) -> None:
+    """Best-effort: a failed write degrades split-brain fencing back to
+    in-memory (this process still fences), never the request path."""
+    from .settings import resolve_path
+
+    try:
+        path = resolve_path(EPOCH_FILENAME)
+        tmp = path.with_name(path.name + ".tmp")
+        tmp.write_text(str(int(epoch)))
+        os.replace(tmp, path)
+    except OSError:
+        logger.warning("could not persist hive epoch %d; fencing is "
+                       "in-memory only for this process", epoch)
+
+
+def hive_endpoints(settings) -> list[str]:
+    """The worker-facing API endpoint list, multi-hive aware:
+    `sdaas_uris` (CHIASWARM_HIVE_URIS) names site URIs in preference
+    order — primary first, standbys after; empty falls back to the
+    single `sdaas_uri`. Site URIs are normalized to their `/api` base."""
+    raw = str(getattr(settings, "sdaas_uris", "") or "")
+    uris = [u.strip().rstrip("/")
+            for u in raw.replace(";", ",").split(",") if u.strip()]
+    if not uris:
+        uris = [str(settings.sdaas_uri).rstrip("/")]
+    return [u if u.endswith("/api") else f"{u}/api" for u in uris]
+
+
 class HiveClient:
-    def __init__(self, settings, hive_uri: str):
+    def __init__(self, settings, hive_uri: str | list[str]):
         self.settings = settings
-        self.hive_uri = hive_uri.rstrip("/")
+        if isinstance(hive_uri, str):
+            endpoints = [hive_uri]
+        else:
+            endpoints = list(hive_uri)
+        self.endpoints = [u.rstrip("/") for u in endpoints if u]
+        if not self.endpoints:
+            raise ValueError("HiveClient needs at least one hive endpoint")
+        self._pin = 0
+        self.failovers = 0
+        # highest fencing epoch any hive has advertised; echoed on every
+        # request so a deposed primary can recognize itself and refuse.
+        # PERSISTED under $SDAAS_ROOT: the outbox redelivers across
+        # worker restarts, and a restarted worker that forgot the epoch
+        # would hand its envelope to a revived deposed primary — the
+        # exact double-settle the fence exists to stop
+        self.epoch = _load_persisted_epoch()
+        self._consecutive_errors = 0
+        self._failover_errors = max(
+            int(getattr(settings, "hive_failover_errors", 2) or 2), 1)
         self._session: aiohttp.ClientSession | None = None
+        self._session_loop: asyncio.AbstractEventLoop | None = None
+        self._refresh_active_gauge()
+
+    @property
+    def hive_uri(self) -> str:
+        """The endpoint this client is currently pinned to (the only one
+        there is, in the classic single-hive configuration)."""
+        return self.endpoints[self._pin]
 
     def _headers(self) -> dict[str, str]:
-        return {
+        headers = {
             "Content-type": "application/json",
             "Authorization": f"Bearer {self.settings.sdaas_token}",
             "user-agent": USER_AGENT,
         }
+        if self.epoch > 0:
+            headers["X-Hive-Epoch"] = str(self.epoch)
+        return headers
 
     async def _get_session(self) -> aiohttp.ClientSession:
-        if self._session is None or self._session.closed:
+        loop = asyncio.get_running_loop()
+        if self._session is not None and (
+                self._session.closed or self._session_loop is not loop):
+            if not self._session.closed and self._session_loop is not loop:
+                # born on another (likely dead) event loop — the shared
+                # module-level clients hit this across asyncio.run calls.
+                # Release the old sockets synchronously; awaiting close()
+                # on a foreign loop is not an option
+                with contextlib.suppress(Exception):
+                    self._session.connector.close()
+            self._session = None
+        if self._session is None:
             self._session = aiohttp.ClientSession()
+            self._session_loop = loop
         return self._session
 
     async def close(self) -> None:
         if self._session is not None and not self._session.closed:
             await self._session.close()
 
+    # --- failover bookkeeping ---
+
+    def _refresh_active_gauge(self) -> None:
+        for uri in self.endpoints:
+            _ACTIVE_ENDPOINT.set(1 if uri == self.hive_uri else 0, uri=uri)
+
+    def _failover(self, reason: str) -> None:
+        """Pin to the next configured endpoint (no-op with one). The
+        caller's retry loop supplies the decorrelated backoff before the
+        next attempt lands on the new pin."""
+        self._consecutive_errors = 0
+        if len(self.endpoints) <= 1:
+            return
+        old = self.hive_uri
+        self._pin = (self._pin + 1) % len(self.endpoints)
+        self.failovers += 1
+        _FAILOVERS.inc()
+        self._refresh_active_gauge()
+        logger.warning("hive failover: %s -> %s (%s)",
+                       old, self.hive_uri, reason)
+
+    def _note_transport_error(self, uri: str) -> None:
+        _ENDPOINT_ERRORS.inc(uri=uri)
+        self._consecutive_errors += 1
+        if self._consecutive_errors >= self._failover_errors:
+            self._failover(
+                f"{self._consecutive_errors} consecutive transport errors")
+
+    def _note_success(self) -> None:
+        self._consecutive_errors = 0
+
+    def _note_request_failure(self, endpoint: str, uri: str,
+                              exc: Exception) -> None:
+        """Failover accounting shared by the poll and delivery paths:
+        transport-level failures and 5xx count toward the pin advancing;
+        any other HTTP status proves the endpoint alive and authoritative
+        (a drain refusal, bad params) — reachability-wise a success.
+        HiveNotPrimary already moved the pin at the refusal site."""
+        if isinstance(exc, HiveNotPrimary):
+            return
+        _ERRORS.inc(endpoint=endpoint)
+        if isinstance(exc, aiohttp.ClientResponseError) and exc.status < 500:
+            self._note_success()
+        else:
+            self._note_transport_error(uri)
+
+    def _note_epoch(self, response) -> None:
+        raw = response.headers.get("X-Hive-Epoch", "")
+        try:
+            seen = int(raw)
+        except ValueError:
+            return
+        if seen > self.epoch:
+            self.epoch = seen
+            _persist_epoch(seen)  # rare: epochs bump only on promotions
+
+    async def _raise_not_primary(self, response) -> None:
+        """Map a 409 into HiveNotPrimary (pin already advanced)."""
+        self._note_epoch(response)
+        try:
+            message = (await response.json()).get("message", "not primary")
+        except Exception:
+            message = "not primary"
+        logger.warning("hive %s refused as not-primary: %s",
+                       self.hive_uri, message)
+        self._failover(message)
+        raise HiveNotPrimary(message)
+
     async def ask_for_work(self, capabilities: dict[str, Any]) -> list[dict]:
         """Poll the hive for jobs, advertising this worker's capabilities.
 
         `capabilities` comes from the chip layer (chips/allocator.py) and
-        includes legacy keys (`memory`, `gpu`) plus TPU keys.
-        """
-        logger.info("asking for work from %s", self.hive_uri)
+        includes legacy keys (`memory`, `gpu`) plus TPU keys. A
+        not-primary 409 fails over and retries the next endpoint within
+        this call (one full cycle at most); transport errors surface to
+        the poll loop's backoff after noting the endpoint failure."""
+        last: Exception | None = None
+        for _ in range(len(self.endpoints)):
+            try:
+                return await self._ask_once(capabilities)
+            except HiveNotPrimary as e:
+                last = e  # pin already advanced; try the next hive now
+        remedy = ""
+        if self.epoch > 0 and "stale hive epoch" in str(last):
+            # every hive is BEHIND our persisted epoch: either a failover
+            # is mid-flight (transient) or the fleet was rebuilt from
+            # scratch and this worker's fencing memory outlived it —
+            # name the recovery, or the wedge looks like an outage
+            remedy = (f"; if the hive fleet was rebuilt (fresh epoch 0), "
+                      f"delete {EPOCH_FILENAME} under $SDAAS_ROOT on this "
+                      f"worker to reset its fencing epoch ({self.epoch})")
+        raise HiveError(
+            f"every hive endpoint refused as not-primary: {last}{remedy}",
+            permanent=False) from last
+
+    async def _ask_once(self, capabilities: dict[str, Any]) -> list[dict]:
+        uri = self.hive_uri
+        logger.info("asking for work from %s", uri)
         params = {
             "worker_version": __version__,
             "worker_name": self.settings.worker_name,
@@ -118,12 +332,14 @@ class HiveClient:
         t0 = time.perf_counter()
         try:
             async with session.get(
-                f"{self.hive_uri}/work",
+                f"{uri}/work",
                 params=params,
                 headers=self._headers(),
                 timeout=timeout,
             ) as response:
+                self._note_epoch(response)
                 if response.status == 200:
+                    self._note_success()
                     try:
                         payload = await response.json()
                         return payload["jobs"]
@@ -133,19 +349,28 @@ class HiveClient:
 
                 if response.status == 400:
                     # hive refuses this worker (reference swarm/hive.py:39-44)
-                    payload = await response.json()
-                    message = payload.get("message", "bad worker")
+                    try:
+                        message = (await response.json()).get(
+                            "message", "bad worker")
+                    except Exception:
+                        # a proxy's HTML 400 must not read as a transport
+                        # error below — the endpoint is alive
+                        message = "bad worker (unparseable refusal body)"
                     logger.warning("hive refused worker: %s", message)
+                if response.status == 409:
+                    # standby, or a deposed stale-epoch primary
+                    await self._raise_not_primary(response)
 
                 response.raise_for_status()
                 return []
-        except Exception:
-            _ERRORS.inc(endpoint="work")
+        except Exception as e:
+            self._note_request_failure("work", uri, e)
             raise
         finally:
             _REQUEST_SECONDS.observe(time.perf_counter() - t0, endpoint="work")
 
     async def _submit_once(self, result: dict) -> dict:
+        uri = self.hive_uri
         session = await self._get_session()
         timeout = aiohttp.ClientTimeout(total=SUBMIT_TIMEOUT_S)
         t0 = time.perf_counter()
@@ -158,17 +383,23 @@ class HiveClient:
                 exc=aiohttp.ClientConnectionError("injected fault: drop_submit"),
             )
             async with session.post(
-                f"{self.hive_uri}/results",
+                f"{uri}/results",
                 data=json.dumps(result),
                 headers=self._headers(),
                 timeout=timeout,
             ) as response:
+                self._note_epoch(response)
+                if response.status == 409:
+                    # not primary: this envelope belongs on the promoted
+                    # hive's idempotent-ACK path, not parked as a 4xx
+                    await self._raise_not_primary(response)
                 response.raise_for_status()
+                self._note_success()
                 ack = await response.json()
                 logger.info("result ack: %s", ack)
                 return ack
-        except Exception:
-            _ERRORS.inc(endpoint="results")
+        except Exception as e:
+            self._note_request_failure("results", uri, e)
             raise
         finally:
             _REQUEST_SECONDS.observe(
@@ -179,20 +410,30 @@ class HiveClient:
         aiohttp.ClientError or a 5xx status) gets exactly one retry after a
         short backoff before surfacing as HiveError — the artifacts in
         `result` cost a full denoise pass and a single hive hiccup must not
-        discard them. Non-transient client errors (4xx) surface
-        immediately; timeouts keep propagating as asyncio.TimeoutError (the
-        worker's result loop already has a policy for those)."""
+        discard them. A not-primary 409 retries the NEXT endpoint
+        immediately (the pin already moved; one extra attempt per
+        configured hive), so a failover lands the envelope on the new
+        primary's idempotent-ACK path within this call when possible.
+        Non-transient client errors (4xx) surface immediately; timeouts
+        keep propagating as asyncio.TimeoutError (the worker's result
+        loop already has a policy for those)."""
         last_exc: Exception | None = None
-        for attempt in (0, 1):
+        transient = True
+        attempts = len(self.endpoints) + 1
+        for attempt in range(attempts):
             try:
                 return await self._submit_once(result)
+            except HiveNotPrimary as e:
+                last_exc = e
+                transient = True
+                continue  # the pin advanced; the next try is a new hive
             except aiohttp.ClientResponseError as e:
                 transient = e.status >= 500
                 last_exc = e
             except aiohttp.ClientError as e:
                 transient = True
                 last_exc = e
-            if not transient or attempt == 1:
+            if not transient or attempt == attempts - 1:
                 break
             _RETRIES.inc(endpoint="results")
             logger.warning(
@@ -208,16 +449,30 @@ class HiveClient:
     async def get_models(self) -> list[dict]:
         """Fetch the hive's model catalog; cached to models.json on success.
 
-        Raises on network/auth/shape failure — the caller decides what a
-        missing catalog means (`initialize --download`, the sole caller
-        today, treats it as fatal rather than silently proceeding with
-        zero models).
-        """
+        Tries each configured endpoint once, starting from the pin (the
+        catalog is replicated trivially — every hive serves it, standby
+        included, so no 409 handling applies). Raises the last failure —
+        the caller decides what a missing catalog means
+        (`initialize --download` treats it as fatal rather than silently
+        proceeding with zero models)."""
+        last: Exception | None = None
+        for offset in range(len(self.endpoints)):
+            uri = self.endpoints[(self._pin + offset) % len(self.endpoints)]
+            try:
+                return await self._get_models_once(uri)
+            except Exception as e:
+                last = e
+                if offset + 1 < len(self.endpoints):
+                    logger.warning(
+                        "model catalog fetch from %s failed (%s); trying "
+                        "the next hive", uri, e)
+        raise last
+
+    async def _get_models_once(self, base: str) -> list[dict]:
         from .settings import save_file
 
         # normalize whether we were handed the API base ({uri}/api, as Worker
         # does) or the bare site URI (as the reference's initialize CLI does)
-        base = self.hive_uri
         models_url = (
             f"{base}/models" if base.endswith("/api") else f"{base}/api/models"
         )
@@ -243,22 +498,63 @@ class HiveClient:
 
 
 # --- reference-signature wrappers (swarm/hive.py:9,50,69) ---
+#
+# These used to build a fresh HiveClient (and a fresh HTTP session) per
+# call — connection reuse and failover pinning evaporated for every
+# caller outside Worker (initialize.py's catalog fetch included). They
+# now route through a process-wide client cache: same signatures, shared
+# sessions, shared pin/epoch state.
+
+_SHARED_CLIENTS: dict[tuple, HiveClient] = {}
+
+
+def shared_client(settings, hive_uri: str | list[str]) -> HiveClient:
+    """The process-wide HiveClient for (endpoints, token). Callers must
+    NOT close it — it outlives any single call so failover pinning and
+    connection reuse apply everywhere; sessions re-open per event loop
+    (see _get_session), so it survives sequential asyncio.run calls."""
+    uris = ((hive_uri,) if isinstance(hive_uri, str) else tuple(hive_uri))
+    key = (uris, str(getattr(settings, "sdaas_token", "")))
+    client = _SHARED_CLIENTS.get(key)
+    if client is None:
+        client = HiveClient(settings, list(uris))
+        _SHARED_CLIENTS[key] = client
+    else:
+        # latest caller's settings win (worker_name etc.); token is part
+        # of the key, so auth can never silently change underneath
+        client.settings = settings
+    return client
+
+
+async def close_shared_clients() -> None:
+    """Close every cached client's session (test teardown hygiene)."""
+    for client in _SHARED_CLIENTS.values():
+        await client.close()
+    _SHARED_CLIENTS.clear()
+
+
+def _close_shared_clients_at_exit() -> None:
+    """Short-lived CLI callers (initialize --download) exit without a
+    running loop to await close() on; closing the connector releases the
+    sockets synchronously and marks the session closed, so aiohttp's
+    'Unclosed client session' GC warning never fires."""
+    for client in _SHARED_CLIENTS.values():
+        session = client._session
+        if session is not None and not session.closed:
+            with contextlib.suppress(Exception):
+                session.connector.close()
+    _SHARED_CLIENTS.clear()
+
+
+atexit.register(_close_shared_clients_at_exit)
 
 
 async def ask_for_work(settings, hive_uri: str, capabilities: dict) -> list[dict]:
-    client = HiveClient(settings, hive_uri)
-    try:
-        return await client.ask_for_work(capabilities)
-    finally:
-        await client.close()
+    return await shared_client(settings, hive_uri).ask_for_work(capabilities)
 
 
 async def submit_result(settings, hive_uri: str, result: dict) -> dict:
-    client = HiveClient(settings, hive_uri)
-    try:
-        return await client.submit_result(result)
-    finally:
-        await client.close()
+    return await shared_client(settings, hive_uri).submit_result(result)
 
 
 class _AnonymousSettings:
@@ -273,8 +569,4 @@ class _AnonymousSettings:
 
 
 async def get_models(hive_uri: str) -> list[dict]:
-    client = HiveClient(_AnonymousSettings(), hive_uri)
-    try:
-        return await client.get_models()
-    finally:
-        await client.close()
+    return await shared_client(_AnonymousSettings(), hive_uri).get_models()

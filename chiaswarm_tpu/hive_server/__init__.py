@@ -28,6 +28,16 @@ speaking the exact wire protocol in `chiaswarm_tpu/hive.py` — a pristine
 - `app.py`      the aiohttp server tying it together (bearer auth,
                 400-with-message refusals, idempotent result ACKs,
                 /metrics + /healthz from the shared telemetry registry);
+- `replication.py` WAL-shipped standby + health-checked failover: a
+                second hive tails the primary's journal event stream
+                (`GET /api/replication/stream`), refuses work until the
+                primary goes silent past `hive_failover_grace_s`, then
+                promotes itself — fresh lease deadlines, a bumped
+                fencing epoch, and 409s for stale-epoch traffic, so a
+                revived deposed primary cannot double-settle against
+                any client that has contacted the promoted hive (see
+                replication.py for the honest limits of a two-node,
+                no-quorum fence under asymmetric partitions);
 - `harness.py`  in-process swarm (HiveServer + real Workers over real
                 sockets) for e2e tests, chaos scenarios, and the bench.
 
@@ -39,6 +49,7 @@ from .app import HiveServer
 from .clock import CLOCK, HiveClock
 from .journal import HiveJournal
 from .queue import JOB_CLASSES, JobRecord, PriorityJobQueue, QueueFull, job_class
+from .replication import StandbyHive
 
 
 def __getattr__(name):
@@ -53,6 +64,7 @@ def __getattr__(name):
 
 __all__ = [
     "HiveServer",
+    "StandbyHive",
     "HiveJournal",
     "HiveClock",
     "CLOCK",

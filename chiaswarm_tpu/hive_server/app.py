@@ -69,6 +69,18 @@ _POLLS = telemetry.counter(
 )
 # registered by leases.py (imported above); same-name counter() returns it
 _JOBS_FAILED = telemetry.counter("swarm_hive_jobs_failed_total")
+_STALE_EPOCH = telemetry.counter(
+    "swarm_hive_stale_epoch_total",
+    "Requests refused with 409 because the caller has seen a newer hive "
+    "epoch — this hive is a deposed primary (split-brain fencing)",
+)
+_EPOCH = telemetry.gauge(
+    "swarm_hive_epoch",
+    "This hive's fencing epoch (bumped by every standby promotion)")
+_ROLE = telemetry.gauge(
+    "swarm_hive_standby",
+    "1 while this hive is a standby replicating from a primary, 0 once "
+    "primary (born-primary or promoted)")
 
 # served when no models.json exists under $SDAAS_ROOT — enough for a
 # worker's `initialize --download` probe to succeed against a dev hive
@@ -82,21 +94,21 @@ class HiveServer:
     """One coordinator instance; start()/stop() or `async with`."""
 
     def __init__(self, settings: Settings | None = None,
-                 host: str | None = None, port: int | None = None):
+                 host: str | None = None, port: int | None = None,
+                 standby: bool = False):
         self.settings = settings or load_settings()
         g = lambda name, default: getattr(self.settings, name, default)  # noqa: E731
         self.host = host if host is not None else g("hive_host", "127.0.0.1")
         self.port = port if port is not None else int(g("hive_port", 9511))
         self.token = str(g("sdaas_token", ""))
-        self.queue = PriorityJobQueue(
-            depth_limit=int(g("hive_queue_depth_limit", 256)),
-            history_limit=int(g("hive_job_history_limit", 1000)),
-            shed_watermarks=parse_shed_watermarks(
-                g("hive_shed_watermarks", None)))
-        self.leases = LeaseTable(
-            deadline_s=float(g("hive_lease_deadline_s", 300.0)),
-            max_redeliveries=int(g("hive_max_redeliveries", 3)),
-        )
+        # standby role (replication.py): refuse dispatch/results/submits
+        # with a 409 not-primary until promoted; epoch is the split-brain
+        # fence — a request stamped with a NEWER epoch than ours proves a
+        # standby was promoted over us, so we answer 409 rather than
+        # double-dispatch or double-settle (see _fenced)
+        self.standby = bool(standby)
+        self.epoch = 0
+        self.queue, self.leases = self._new_state()
         self.directory = WorkerDirectory(
             ttl_s=float(g("hive_worker_ttl_s", 45.0)))
         self.dispatcher = Dispatcher(
@@ -129,15 +141,41 @@ class HiveServer:
             events = self.journal.recover()
             if events:
                 self.recovery = apply_events(events, self.queue, self.leases)
+                self.epoch = max(
+                    self.epoch, int(self.recovery.get("epoch", 0)))
                 logger.warning(
                     "hive WAL replayed %d event(s) -> %s (recovered leases "
                     "get a fresh %gs deadline)", len(events), self.recovery,
                     self.leases.deadline_s)
             # compact now: the stream shrinks to live state, and a
             # crash-restart-crash loop cannot grow it without bound
-            self.journal.compact(snapshot_events(self.queue, self.leases))
+            self.journal.compact(
+                snapshot_events(self.queue, self.leases, self.epoch))
             self.journal.snapshot_fn = (
-                lambda: snapshot_events(self.queue, self.leases))
+                lambda: snapshot_events(self.queue, self.leases, self.epoch))
+        self.note_role_change()
+
+    def note_role_change(self) -> None:
+        """Refresh the role/epoch gauges (called again on promotion)."""
+        _EPOCH.set(self.epoch)
+        _ROLE.set(1 if self.standby else 0)
+
+    def _new_state(self) -> tuple[PriorityJobQueue, LeaseTable]:
+        """Fresh queue + lease tables with this hive's knobs. Split out
+        of __init__ because a standby performing a replication RESET
+        (its position was compacted away on the primary) rebuilds state
+        from the snapshot rather than patching the divergent copy."""
+        g = lambda name, default: getattr(self.settings, name, default)  # noqa: E731
+        queue = PriorityJobQueue(
+            depth_limit=int(g("hive_queue_depth_limit", 256)),
+            history_limit=int(g("hive_job_history_limit", 1000)),
+            shed_watermarks=parse_shed_watermarks(
+                g("hive_shed_watermarks", None)))
+        leases = LeaseTable(
+            deadline_s=float(g("hive_lease_deadline_s", 300.0)),
+            max_redeliveries=int(g("hive_max_redeliveries", 3)),
+        )
+        return queue, leases
 
     # --- lifecycle ---
 
@@ -157,6 +195,7 @@ class HiveServer:
         app.router.add_post("/api/jobs", self._submit)
         app.router.add_get("/api/jobs/{job_id}", self._job_status)
         app.router.add_get("/api/artifacts/{digest}", self._artifact)
+        app.router.add_get("/api/replication/stream", self._replication_stream)
         app.router.add_get("/metrics", self._metrics)
         app.router.add_get("/healthz", self._healthz)
         return app
@@ -214,6 +253,11 @@ class HiveServer:
         interval = min(1.0, max(self.leases.deadline_s / 4.0, 0.05))
         while True:
             await asyncio.sleep(interval)
+            if self.standby:
+                # replicated leases are the PRIMARY's to expire; a
+                # standby reaping them would diverge from the stream it
+                # is applying (promotion re-grants them fresh instead)
+                continue
             try:
                 for record in self.leases.reap(self.queue):
                     if record.state == "failed":
@@ -309,11 +353,63 @@ class HiveServer:
     def _unauthorized() -> web.Response:
         return web.json_response({"message": "unauthorized"}, status=401)
 
+    # --- replication role + split-brain fencing ---
+
+    def _epoch_headers(self) -> dict[str, str]:
+        """Every hive answer advertises the fencing epoch; workers track
+        the maximum they have seen and echo it back (X-Hive-Epoch), which
+        is what lets a deposed primary discover it was deposed."""
+        return {"X-Hive-Epoch": str(self.epoch)}
+
+    def _refuse_not_primary(self) -> web.Response | None:
+        if not self.standby:
+            return None
+        return web.json_response(
+            {"message": "not primary: standby replicating "
+                        "(fail over to the promoted hive)"},
+            status=409, headers=self._epoch_headers())
+
+    def _refuse_stale_epoch(self, request: web.Request) -> web.Response | None:
+        """409 any request stamped with a NEWER epoch than ours: the
+        caller has talked to a hive promoted over us, so we are a deposed
+        primary and our dispatches/ACKs must not count — accepting them
+        would double-dispatch the job we think is queued or double-settle
+        the one the true primary already owns."""
+        raw = request.headers.get("X-Hive-Epoch", "")
+        try:
+            seen = int(raw)
+        except ValueError:
+            return None
+        if seen <= self.epoch:
+            return None
+        _STALE_EPOCH.inc()
+        logger.error(
+            "stale-epoch request refused: caller at epoch %d, this hive "
+            "at %d — a standby was promoted over this (deposed) primary",
+            seen, self.epoch)
+        return web.json_response(
+            {"message": f"not primary: stale hive epoch {self.epoch} "
+                        f"(the swarm is at epoch {seen}; this hive was "
+                        "deposed)"},
+            status=409, headers=self._epoch_headers())
+
+    def _refused(self, request: web.Request) -> web.Response | None:
+        # explicit None checks: web.Response is a MutableMapping and an
+        # empty one is FALSY, so `a or b` would drop a real refusal
+        refused = self._refuse_not_primary()
+        if refused is not None:
+            return refused
+        return self._refuse_stale_epoch(request)
+
     # --- wire-protocol handlers ---
 
     async def _work(self, request: web.Request) -> web.Response:
         if not self._authorized(request):
             return self._unauthorized()
+        refused = self._refused(request)
+        if refused is not None:
+            _POLLS.inc(reply="refused")
+            return refused
         if self.refuse_with is not None:
             _POLLS.inc(reply="refused")
             return web.json_response(
@@ -338,11 +434,15 @@ class HiveServer:
         faults.fire("crash_after_lease")
         _POLLS.inc(reply="jobs" if handed else "empty")
         return web.json_response(
-            {"jobs": [record.job for record, _ in handed]})
+            {"jobs": [record.job for record, _ in handed]},
+            headers=self._epoch_headers())
 
     async def _results(self, request: web.Request) -> web.Response:
         if not self._authorized(request):
             return self._unauthorized()
+        refused = self._refused(request)
+        if refused is not None:
+            return refused
         body = await request.read()
         try:
             # a result envelope can be hundreds of MB of base64 blobs
@@ -413,7 +513,8 @@ class HiveServer:
         for pruned in self.queue.retire(record):
             self._journal(ev_retire(pruned))
         _RESULTS.inc(status=status)
-        return web.json_response({"status": "ok"})
+        return web.json_response(
+            {"status": "ok"}, headers=self._epoch_headers())
 
     async def _models(self, request: web.Request) -> web.Response:
         # deliberately unauthenticated: public catalog, reference parity
@@ -436,6 +537,9 @@ class HiveServer:
     async def _submit(self, request: web.Request) -> web.Response:
         if not self._authorized(request):
             return self._unauthorized()
+        refused = self._refused(request)
+        if refused is not None:
+            return refused
         try:
             job = json.loads(await request.text())
         except json.JSONDecodeError:
@@ -479,6 +583,35 @@ class HiveServer:
         return web.FileResponse(
             path, headers={"Content-Type": "application/octet-stream"})
 
+    # --- replication (hive_server/replication.py tails this) ---
+
+    async def _replication_stream(self, request: web.Request) -> web.Response:
+        """WAL event stream for a standby: events past `since`, or the
+        full compacted snapshot with `reset` when the requested position
+        was compacted away. Served from the journal's in-memory mirror,
+        so a torn tail on disk never reaches a replica."""
+        if not self._authorized(request):
+            return self._unauthorized()
+        if self.journal is None:
+            return web.json_response(
+                {"message": "replication requires a WAL "
+                            "(hive_wal_dir is disabled on this hive)"},
+                status=400)
+        try:
+            since = int(request.query.get("since", "0"))
+        except ValueError:
+            return web.json_response(
+                {"message": "since must be an integer replication "
+                            "sequence"}, status=400)
+        events, reset = self.journal.stream_since(since)
+        return web.json_response({
+            "events": events,
+            "seq": self.journal.last_rs,
+            "reset": reset,
+            "epoch": self.epoch,
+            "standby": self.standby,
+        }, headers=self._epoch_headers())
+
     # --- telemetry ---
 
     async def _metrics(self, request: web.Request) -> web.Response:
@@ -511,6 +644,8 @@ class HiveServer:
         payload = {
             "status": "degraded" if reasons else "ok",
             "degraded_reasons": reasons,
+            "role": "standby" if self.standby else "primary",
+            "epoch": self.epoch,
             "uptime_s": round(time.monotonic() - self.started_at, 1),
             "queue_depth": self.queue.depths(),
             "leases_active": len(self.leases),
@@ -536,12 +671,27 @@ class HiveServer:
 async def serve(settings: Settings | None = None, host: str | None = None,
                 port: int | None = None) -> None:
     """Run a hive until SIGTERM/SIGINT (tools/hive_serve.py and
-    `python -m chiaswarm_tpu.hive_server`)."""
+    `python -m chiaswarm_tpu.hive_server`). With `hive_standby_of` /
+    CHIASWARM_HIVE_STANDBY_OF set, runs as a WAL-shipped STANDBY of that
+    primary instead: replicating, health-checking, and self-promoting
+    after `hive_failover_grace_s` of primary silence."""
     import signal
 
-    server = await HiveServer(settings, host=host, port=port).start()
-    print(f"hive coordinator listening on {server.uri} "
-          f"(workers poll {server.api_uri}/work)")
+    settings = settings or load_settings()
+    standby_of = str(getattr(settings, "hive_standby_of", "") or "")
+    if standby_of:
+        from .replication import StandbyHive
+
+        server = await StandbyHive(
+            settings, primary_uri=standby_of, host=host, port=port).start()
+        print(f"hive STANDBY on {server.uri} replicating from {standby_of} "
+              f"(auto-promotes after "
+              f"{getattr(settings, 'hive_failover_grace_s', 10.0)}s of "
+              "primary silence)")
+    else:
+        server = await HiveServer(settings, host=host, port=port).start()
+        print(f"hive coordinator listening on {server.uri} "
+              f"(workers poll {server.api_uri}/work)")
     stop = asyncio.Event()
     loop = asyncio.get_running_loop()
     for sig in (signal.SIGINT, signal.SIGTERM):

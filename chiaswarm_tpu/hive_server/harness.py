@@ -26,18 +26,27 @@ from ..chips.allocator import SliceAllocator
 from ..settings import Settings
 from ..worker import Worker
 from .app import HiveServer
+from .replication import StandbyHive
 
 
 class LocalSwarm:
     def __init__(self, n_workers: int = 1, chips_per_job: int = 0,
                  settings: Settings | None = None,
-                 worker_overrides: dict[str, Any] | None = None):
+                 worker_overrides: dict[str, Any] | None = None,
+                 standby: bool = False):
         self.settings = settings or Settings(
             sdaas_token="local-swarm", worker_name="swarm-worker",
             hive_port=0, metrics_port=0)
         self.n_workers = n_workers
         self.chips_per_job = chips_per_job
         self.worker_overrides = worker_overrides or {}
+        # standby=True stands a WAL-shipped standby hive next to the
+        # primary (replication.py) and gives every worker BOTH endpoints,
+        # so failover scenarios — kill_primary(), promote() — run in
+        # process. The standby journals to its own WAL dir; the
+        # content-addressed artifact spool is shared by design.
+        self.with_standby = standby
+        self.standby: StandbyHive | None = None
         self.hive: HiveServer | None = None
         self.workers: list[Worker] = []
         self._worker_tasks: list[asyncio.Task] = []
@@ -51,10 +60,30 @@ class LocalSwarm:
 
     async def start(self) -> "LocalSwarm":
         self.hive = await HiveServer(self.settings, port=0).start()
+        if self.with_standby:
+            wal = str(getattr(self.settings, "hive_wal_dir", "hive_wal"))
+            self.standby = await StandbyHive(
+                dataclasses.replace(
+                    self.settings, hive_port=0,
+                    hive_wal_dir=f"{wal}_standby" if wal else ""),
+                primary_uri=self.hive.uri).start()
         for i in range(self.n_workers):
             self.add_worker(f"swarm-worker-{i}")
         self._session = aiohttp.ClientSession()
         return self
+
+    @property
+    def active_hive(self) -> HiveServer:
+        """The hive currently entitled to serve: the promoted standby
+        once promote()/failover happened, the primary before."""
+        if self.standby is not None and self.standby.promoted:
+            return self.standby.server
+        return self.hive
+
+    def worker_endpoints(self) -> list[str] | str:
+        if self.standby is not None:
+            return [self.hive.api_uri, self.standby.api_uri]
+        return self.hive.api_uri
 
     def add_worker(self, name: str) -> Worker:
         """Start one more pristine Worker against the hive (the
@@ -67,12 +96,25 @@ class LocalSwarm:
                 self.settings, worker_name=name, metrics_port=0,
                 **self.worker_overrides),
             allocator=SliceAllocator(chips_per_job=self.chips_per_job),
-            hive_uri=self.hive.api_uri,
+            hive_uri=self.worker_endpoints(),
         )
         self.workers.append(worker)
         self._worker_tasks.append(
             asyncio.create_task(worker.run(), name=f"swarm_{name}"))
         return worker
+
+    async def kill_primary(self) -> None:
+        """Hard-stop the primary hive: sockets close, in-flight requests
+        die — externally indistinguishable from SIGKILLing its process
+        (workers see refused connections, the standby sees stream+health
+        silence and eventually promotes itself)."""
+        await self.hive.stop()
+
+    async def promote(self) -> HiveServer:
+        """Promote the standby explicitly (the operator seam; the
+        health-check loop does the same on its own after
+        hive_failover_grace_s of primary silence)."""
+        return await self.standby.promote()
 
     async def restart_hive(self) -> HiveServer:
         """Hard-stop the hive and stand a fresh instance up over the same
@@ -103,6 +145,8 @@ class LocalSwarm:
         if self._session is not None:
             await self._session.close()
             self._session = None
+        if self.standby is not None:
+            await self.standby.stop()
         if self.hive is not None:
             await self.hive.stop()
 
@@ -116,7 +160,7 @@ class LocalSwarm:
         import json
 
         async with self._session.post(
-                f"{self.hive.api_uri}/jobs", data=json.dumps(job),
+                f"{self.active_hive.api_uri}/jobs", data=json.dumps(job),
                 headers=self._headers()) as resp:
             resp.raise_for_status()
             payload = await resp.json()
@@ -124,7 +168,7 @@ class LocalSwarm:
 
     async def job_status(self, job_id: str) -> dict:
         async with self._session.get(
-                f"{self.hive.api_uri}/jobs/{job_id}",
+                f"{self.active_hive.api_uri}/jobs/{job_id}",
                 headers=self._headers()) as resp:
             resp.raise_for_status()
             return await resp.json()
@@ -152,7 +196,7 @@ class LocalSwarm:
     async def artifact(self, href_or_digest: str) -> bytes:
         path = (href_or_digest if href_or_digest.startswith("/")
                 else f"/api/artifacts/{href_or_digest}")
-        async with self._session.get(f"{self.hive.uri}{path}",
+        async with self._session.get(f"{self.active_hive.uri}{path}",
                                      headers=self._headers()) as resp:
             resp.raise_for_status()
             return await resp.read()

@@ -38,6 +38,17 @@ Durability model: every append is flushed to the OS, so the journal
 survives process death (SIGKILL included). ``fsync=True`` additionally
 survives power loss at a per-transition fsync cost; compaction snapshots
 are always fsynced before the rename either way.
+
+Replication (hive_server/replication.py) rides this exact stream: every
+event carries a monotonically increasing replication sequence (``rs``,
+never reused, stamped on append and re-stamped fresh on compaction), and
+:meth:`HiveJournal.stream_since` answers a standby's
+``GET /api/replication/stream?since=<rs>`` from the journal's in-memory
+mirror of the current file — incrementally while the requested position
+is still continuous with the stream, or with ``reset=True`` (the full
+compacted snapshot, retired history excluded) once compaction has
+retired the events between. The WAL's compact-to-events format is the
+replication unit, as the ROADMAP predicted.
 """
 
 from __future__ import annotations
@@ -99,6 +110,13 @@ class HiveJournal:
         self.appends_since_compact = 0
         self.replayed_events = 0
         self.torn_lines = 0
+        # replication bookkeeping: the in-memory mirror of the current
+        # file (what stream_since serves), the next sequence to stamp,
+        # and the rs from which the current file reconstructs full state
+        # from empty (a standby behind this point must full-resync)
+        self.events: list[dict] = []
+        self.next_rs = 1
+        self.stream_start_rs = 1
         self._fh = None
         # a crash mid-compaction leaves a tmp beside the live stream;
         # the rename never happened, so the live stream is authoritative
@@ -146,6 +164,19 @@ class HiveJournal:
                         "lost and will resolve as a redelivery", i, e)
                 continue
             events.append(event)
+        # re-establish the replication sequence: pre-replication WALs
+        # carry no rs at all, and a torn tail may have clipped the
+        # highest one — stamp forward monotonically either way
+        last_rs = 0
+        for event in events:
+            rs = event.get("rs")
+            rs = int(rs) if isinstance(rs, int) else last_rs + 1
+            rs = max(rs, last_rs + 1)
+            event["rs"] = rs
+            last_rs = rs
+        self.next_rs = last_rs + 1
+        self.stream_start_rs = events[0]["rs"] if events else self.next_rs
+        self.events = events
         self.replayed_events = len(events)
         return events
 
@@ -163,11 +194,14 @@ class HiveJournal:
         exception propagates so the in-flight HTTP response dies exactly
         as it would mid-crash."""
         faults.fire("kill_before_journal_sync")
+        event["rs"] = self.next_rs
         fh = self._handle()
         fh.write(json.dumps(event, separators=(",", ":")).encode() + b"\n")
         fh.flush()
         if self.fsync:
             os.fsync(fh.fileno())
+        self.next_rs += 1
+        self.events.append(event)
         _APPENDS.inc(event=str(event.get("ev", "?")))
         self.appends_since_compact += 1
         if (self.compact_every > 0 and self.snapshot_fn is not None
@@ -176,7 +210,15 @@ class HiveJournal:
 
     def compact(self, events: list[dict]) -> None:
         """Atomically replace the stream with the given minimal event
-        sequence (tmp + fsync + rename, like the outbox and the spool)."""
+        sequence (tmp + fsync + rename, like the outbox and the spool).
+        Snapshot events get FRESH rs stamps continuing the counter —
+        sequences are never reused, so a standby holding a pre-compaction
+        position either continues exactly at the tip or detects the gap
+        and full-resyncs from this snapshot (stream_since)."""
+        events = [dict(event) for event in events]
+        for event in events:
+            event["rs"] = self.next_rs
+            self.next_rs += 1
         tmp = self.root / f".{WAL_NAME}.{os.getpid()}.tmp"
         with open(tmp, "wb") as fh:
             for event in events:
@@ -186,8 +228,39 @@ class HiveJournal:
             os.fsync(fh.fileno())
         self.close()
         os.replace(tmp, self.path)
+        self.events = events
+        self.stream_start_rs = events[0]["rs"] if events else self.next_rs
         self.appends_since_compact = 0
         _COMPACTIONS.inc()
+
+    # --- replication stream (GET /api/replication/stream) ---
+
+    @property
+    def last_rs(self) -> int:
+        """The highest replication sequence stamped so far (0 = none)."""
+        return self.next_rs - 1
+
+    def stream_since(self, since: int) -> tuple[list[dict], bool]:
+        """Events a standby at position `since` still needs.
+
+        Returns ``(events, reset)``: while `since` is continuous with the
+        current file (``since + 1 >= stream_start_rs``) the reply is the
+        incremental tail — possibly the whole compacted snapshot, which
+        applies idempotently over a standby already at the tip. Once
+        compaction has retired events past the standby's position the
+        reply is the FULL current stream with ``reset=True``: the standby
+        discards its state and rebuilds from the snapshot, never
+        replaying retired history. A position AHEAD of this journal's
+        counter is also a reset — the primary lost WAL tail (power loss
+        without fsync) or was stood up over a wiped directory, and an
+        empty incremental reply would leave the standby silently
+        filtering every future event as already-seen."""
+        since = int(since)
+        if since > self.last_rs:
+            return list(self.events), True
+        if since + 1 >= self.stream_start_rs:
+            return [e for e in self.events if e["rs"] > since], False
+        return list(self.events), True
 
     def close(self) -> None:
         if self._fh is not None:
@@ -240,14 +313,24 @@ def ev_retire(job_id: str) -> dict:
     return {"ev": "retire", "id": job_id}
 
 
-def snapshot_events(queue: PriorityJobQueue,
-                    leases: LeaseTable) -> list[dict]:
-    """The minimal event sequence reconstructing the current state: one
-    admit per live record, plus the single event carrying its terminal
-    or leased condition. Queued records are emitted LAST and in dispatch
-    order, so replay's enqueue order reproduces the queue exactly
-    (requeue-front history included — the order IS the state)."""
+def ev_epoch(epoch: int) -> dict:
+    """The fencing epoch (bumped on every standby promotion). Persisted
+    so a promoted hive that restarts keeps refusing a deposed
+    predecessor's stale-epoch traffic."""
+    return {"ev": "epoch", "epoch": int(epoch)}
+
+
+def snapshot_events(queue: PriorityJobQueue, leases: LeaseTable,
+                    epoch: int = 0) -> list[dict]:
+    """The minimal event sequence reconstructing the current state: the
+    fencing epoch (when ever bumped), one admit per live record, plus
+    the single event carrying its terminal or leased condition. Queued
+    records are emitted LAST and in dispatch order, so replay's enqueue
+    order reproduces the queue exactly (requeue-front history included —
+    the order IS the state)."""
     events: list[dict] = []
+    if epoch:
+        events.append(ev_epoch(epoch))
     queued_ids = set()
     for record in queue.iter_queued():
         queued_ids.add(record.job_id)
@@ -273,8 +356,17 @@ def apply_events(events: list[dict], queue: PriorityJobQueue,
     record was retired in a compacted-away past) are skipped and
     counted, never fatal. Returns a summary for the recovery log line."""
     skipped = 0
+    epoch = 0
     for event in events:
         ev = event.get("ev")
+        if ev == "epoch":
+            try:
+                epoch = max(epoch, int(event.get("epoch", 0)))
+            except (TypeError, ValueError):
+                skipped += 1
+                continue
+            _REPLAYED.inc()
+            continue
         if ev == "admit":
             job = event.get("job")
             if not isinstance(job, dict) or not job.get("id"):
@@ -342,4 +434,4 @@ def apply_events(events: list[dict], queue: PriorityJobQueue,
     for state in ("queued", "leased", "done", "failed"):
         _RECOVERED_JOBS.set(states.get(state, 0), state=state)
     return {"jobs": len(queue.records), "states": states,
-            "leases": len(leases), "skipped": skipped}
+            "leases": len(leases), "skipped": skipped, "epoch": epoch}

@@ -54,6 +54,11 @@ class LeaseTable:
     def get(self, job_id: str) -> Lease | None:
         return self._leases.get(job_id)
 
+    def active(self) -> list[Lease]:
+        """Snapshot of every live lease (promotion re-grants these with
+        fresh deadlines; tests assert against them)."""
+        return list(self._leases.values())
+
     def grant(self, record: JobRecord, worker: str) -> Lease:
         lease = Lease(record, worker, self.clock.mono() + self.deadline_s)
         self._leases[record.job_id] = lease

@@ -1,0 +1,352 @@
+"""Replicated hive: a WAL-shipped standby with health-checked failover.
+
+PR 6 made one hive durable — its queue/lease state survives its own
+death because the WAL replays on restart. The hive HOST was still a
+single point of failure: nothing restarts a process whose machine is
+gone. This module closes that gap with the classic primary/standby
+shape, built on the journal the durability work already pays for:
+
+- the **standby** is a full :class:`~.app.HiveServer` in standby mode
+  (409 not-primary on /work, /results, and /api/jobs until promoted)
+  that tails the primary's WAL event stream over HTTP
+  (``GET /api/replication/stream?since=<rs>``) every
+  ``hive_replication_poll_s`` and applies events through the exact
+  replay path recovery uses (:func:`~.journal.apply_events`), so the
+  replica is correct by the same argument restart-recovery is;
+- the stream is **resumable**: every journal event carries a replication
+  sequence (``rs``); a standby presents the last one it applied and gets
+  the tail. Compaction retires history — a standby whose position was
+  compacted away receives the full compacted snapshot with
+  ``reset=True`` and rebuilds from scratch, never replaying retired
+  events. Torn WAL tails never reach a replica (the stream is served
+  from the journal's in-memory mirror);
+- the standby **health-checks** the primary: a stream failure is
+  confirmed against ``/healthz`` (any HTTP answer, even a degraded 503,
+  means the process lives), and after ``hive_failover_grace_s`` of
+  unbroken silence the standby **promotes itself** — drains the stream
+  best-effort, re-grants every replicated lease with a fresh full
+  deadline (PR 6 semantics: a surviving lessee's result lands on the
+  idempotent-ACK path, a dead one costs one deadline), bumps the fencing
+  **epoch**, journals it durably, and starts answering /work;
+- the **epoch** is the split-brain fence. Every hive answer advertises
+  its epoch (``X-Hive-Epoch``); workers track the maximum (persisted per
+  worker host, so it survives restarts) and echo it on every request. A
+  deposed primary that comes back sees requests stamped with a newer
+  epoch than its own and answers 409 (``_refuse_stale_epoch``) instead
+  of dispatching or settling — its late ACKs cannot double-settle a job
+  the promoted hive owns, and workers treat the 409 as a not-primary
+  refusal and stay failed over.
+
+Scope of the fence, stated honestly: it reaches every client that
+CONTACTS the promoted hive — which multi-endpoint workers do the moment
+their pinned primary errors or refuses. What a two-node,
+no-quorum design cannot fence is a clean asymmetric partition that cuts
+only the hive-to-hive link while the old primary stays reachable: the
+standby (unable to see /healthz) promotes, and a client that never
+talks to the promoted side never learns the new epoch, so the deposed
+primary can still serve it. The at-least-once lifecycle bounds the
+damage to duplicate compute (settles are idempotent per hive), but
+submitters who must not land work on a deposed primary during such a
+partition should use the same multi-endpoint failover the workers do
+(so they learn the epoch), or front the pair with an external health
+check. Leases replicated at promotion get a FRESH deadline either way,
+so nothing is lost — at worst re-run.
+
+Deploy: run the standby with ``hive_standby_of`` /
+``CHIASWARM_HIVE_STANDBY_OF`` pointing at the primary's site URI (its
+own ``hive_wal_dir`` must be a different directory when both share a
+filesystem); point workers at both hives via ``sdaas_uris`` /
+``CHIASWARM_HIVE_URIS``. The worker-side half lives in
+``chiaswarm_tpu/hive.py`` (endpoint pinning + failover).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+
+import aiohttp
+
+from .. import faults, telemetry
+from ..settings import Settings, load_settings
+from .app import HiveServer
+from .clock import CLOCK
+from .journal import apply_events, snapshot_events
+
+logger = logging.getLogger(__name__)
+
+_APPLIED = telemetry.counter(
+    "swarm_hive_replication_applied_total",
+    "Journal events applied from the primary's replication stream")
+_RESETS = telemetry.counter(
+    "swarm_hive_replication_resets_total",
+    "Full standby resyncs (the standby's stream position was compacted "
+    "away on the primary; state rebuilt from the snapshot)")
+_PROMOTIONS = telemetry.counter(
+    "swarm_hive_promotions_total",
+    "Standby self-promotions after the primary failed its health checks")
+_LAG = telemetry.gauge(
+    "swarm_hive_replication_lag_s",
+    "Seconds since the standby last applied the primary's stream tip")
+
+
+class StandbyHive:
+    """One standby instance: a HiveServer in standby mode plus the
+    replication tail and the failover watchdog. ``start()`` serves and
+    begins tailing; ``promote()`` can also be called explicitly (operator
+    seam, LocalSwarm.promote(), tests)."""
+
+    def __init__(self, settings: Settings | None = None,
+                 primary_uri: str | None = None,
+                 host: str | None = None, port: int | None = None):
+        self.settings = settings or load_settings()
+        g = lambda name, default: getattr(self.settings, name, default)  # noqa: E731
+        self.primary_uri = str(
+            primary_uri or g("hive_standby_of", "")).rstrip("/")
+        if not self.primary_uri:
+            raise ValueError(
+                "a standby needs the primary's URI (hive_standby_of / "
+                "CHIASWARM_HIVE_STANDBY_OF or the primary_uri argument)")
+        self.poll_s = max(float(g("hive_replication_poll_s", 1.0)), 0.02)
+        self.grace_s = max(float(g("hive_failover_grace_s", 10.0)), 0.0)
+        self.server = HiveServer(
+            self.settings, host=host, port=port, standby=True)
+        # the primary's stream is authoritative from the first sync:
+        # whatever a stale standby-side WAL replayed is discarded (a
+        # standby restart full-resyncs rather than trusting old state)
+        self._reset_state()
+        self.promoted = False
+        self.since = 0
+        self.primary_epoch = 0
+        self.last_sync_mono: float | None = None
+        self._first_failure: float | None = None
+        self._session: aiohttp.ClientSession | None = None
+        self._tasks: list[asyncio.Task] = []
+
+    # --- lifecycle ---
+
+    @property
+    def uri(self) -> str:
+        return self.server.uri
+
+    @property
+    def api_uri(self) -> str:
+        return self.server.api_uri
+
+    async def __aenter__(self) -> "StandbyHive":
+        return await self.start()
+
+    async def __aexit__(self, *exc) -> None:
+        await self.stop()
+
+    async def start(self) -> "StandbyHive":
+        await self.server.start()
+        self._tasks = [asyncio.create_task(
+            self._replicate_loop(), name="hive_standby_replicator")]
+        logger.info(
+            "hive standby on %s replicating from %s (poll %.2gs, "
+            "failover grace %.2gs)",
+            self.server.uri, self.primary_uri, self.poll_s, self.grace_s)
+        return self
+
+    async def stop(self) -> None:
+        for task in self._tasks:
+            task.cancel()
+        if self._tasks:
+            await asyncio.gather(*self._tasks, return_exceptions=True)
+            self._tasks = []
+        if self._session is not None and not self._session.closed:
+            await self._session.close()
+        await self.server.stop()
+
+    # --- replication tail ---
+
+    def _reset_state(self) -> None:
+        """Discard the replica and start over from the primary's
+        snapshot (initial sync, or the stream position was compacted
+        away). Safe because the standby refuses every mutating request
+        until promoted — nothing else touches these tables."""
+        self.server.queue, self.server.leases = self.server._new_state()
+        self.since = 0
+
+    async def _get_session(self) -> aiohttp.ClientSession:
+        if self._session is None or self._session.closed:
+            self._session = aiohttp.ClientSession()
+        return self._session
+
+    def _headers(self) -> dict[str, str]:
+        token = self.server.token
+        return {"Authorization": f"Bearer {token}"} if token else {}
+
+    async def sync_once(self) -> int:
+        """One stream fetch + apply; returns the number of events
+        applied. Raises on any transport/protocol failure — the loop
+        (or the caller) decides what a failure means."""
+        # deterministic injection: the stream fetch dies (partition /
+        # primary mid-crash); the next sync must resume cleanly
+        faults.fire("drop_replication")
+        session = await self._get_session()
+        async with session.get(
+                f"{self.primary_uri}/api/replication/stream",
+                params={"since": str(self.since)},
+                headers=self._headers(),
+                timeout=aiohttp.ClientTimeout(total=10),
+        ) as resp:
+            if resp.status != 200:
+                raise RuntimeError(
+                    f"replication stream answered {resp.status}: "
+                    f"{(await resp.text())[:200]}")
+            payload = await resp.json()
+        events = payload.get("events") or []
+        if payload.get("reset"):
+            _RESETS.inc()
+            logger.warning(
+                "replication reset: position %d was compacted away on "
+                "the primary; rebuilding from its %d-event snapshot",
+                self.since, len(events))
+            self._reset_state()
+        if events:
+            summary = apply_events(
+                events, self.server.queue, self.server.leases)
+            _APPLIED.inc(len(events))
+            logger.debug("replicated %d event(s) -> %s", len(events), summary)
+        # a reset ADOPTS the primary's position outright (it may be LOWER
+        # than ours was — wiped/truncated primary WAL); only incremental
+        # replies move the cursor monotonically. (_reset_state already
+        # zeroed self.since above, so max() would behave identically —
+        # this spells the contract out rather than relying on that.)
+        seq = int(payload.get("seq", self.since))
+        self.since = seq if payload.get("reset") else max(self.since, seq)
+        self.primary_epoch = max(
+            self.primary_epoch, int(payload.get("epoch", 0)))
+        # track the primary's epoch while standby so promotion always
+        # bumps PAST it, and stale-epoch fencing stays coherent
+        if self.server.epoch < self.primary_epoch:
+            self.server.epoch = self.primary_epoch
+            self.server.note_role_change()
+        self.last_sync_mono = CLOCK.mono()
+        _LAG.set(0.0)
+        return len(events)
+
+    async def _primary_alive(self) -> bool:
+        """ANY HTTP answer from /healthz counts as alive — a degraded
+        503 primary is still the primary; only silence (connection
+        refused, timeout) argues for failover."""
+        try:
+            session = await self._get_session()
+            timeout = aiohttp.ClientTimeout(
+                total=max(min(self.grace_s / 2, 5.0), 0.25))
+            async with session.get(f"{self.primary_uri}/healthz",
+                                   timeout=timeout):
+                return True
+        except asyncio.CancelledError:
+            raise
+        except Exception:
+            return False
+
+    async def _replicate_loop(self) -> None:
+        while not self.promoted:
+            try:
+                await self.sync_once()
+                self._first_failure = None
+            except asyncio.CancelledError:
+                raise
+            except Exception as e:
+                if self.last_sync_mono is not None:
+                    _LAG.set(round(CLOCK.mono() - self.last_sync_mono, 1))
+                if await self._primary_alive():
+                    # the process answers health but not the stream
+                    # (e.g. WAL disabled, auth mismatch): not a failover
+                    # case — promotion here would split the brain
+                    self._first_failure = None
+                    logger.warning(
+                        "replication stream failed (%s) but the primary "
+                        "answers /healthz; not counting toward failover",
+                        e)
+                else:
+                    now = CLOCK.mono()
+                    if self._first_failure is None:
+                        self._first_failure = now
+                        logger.warning(
+                            "primary %s unreachable (%s); failover in "
+                            "%.2gs unless it recovers",
+                            self.primary_uri, e, self.grace_s)
+                    elif now - self._first_failure >= self.grace_s:
+                        logger.error(
+                            "primary %s silent for %.2gs; promoting",
+                            self.primary_uri, now - self._first_failure)
+                        try:
+                            await self.promote()
+                            return
+                        except asyncio.CancelledError:
+                            raise
+                        except Exception:
+                            # the watchdog must never die silently half-
+                            # promoted; promote() is idempotent-safe to
+                            # retry (the epoch only moves forward)
+                            logger.exception(
+                                "promotion attempt failed; retrying")
+            await asyncio.sleep(self.poll_s)
+
+    # --- failover ---
+
+    async def promote(self) -> HiveServer:
+        """Promote this standby to primary: drain the stream best-effort,
+        bump the fencing epoch past everything seen, re-grant every
+        replicated lease with a fresh full deadline, persist it all to
+        the standby's own WAL, and start serving. Idempotent."""
+        if self.promoted:
+            return self.server
+        try:
+            await self.sync_once()
+        except Exception as e:
+            logger.warning(
+                "promotion: final stream drain failed (%s); proceeding "
+                "with the replicated state at position %d", e, self.since)
+        srv = self.server
+        srv.epoch = max(srv.epoch, self.primary_epoch) + 1
+        regranted = 0
+        for lease in srv.leases.active():
+            # fresh full deadline, exactly like WAL-replay recovery: the
+            # lessee may still be running (idempotent-ACK absorbs its
+            # result) or died with the primary (one deadline, then
+            # redelivery)
+            srv.leases.grant(lease.record, lease.worker)
+            regranted += 1
+        srv.standby = False
+        if srv.journal is not None:
+            try:
+                srv.journal.compact(
+                    snapshot_events(srv.queue, srv.leases, srv.epoch))
+            except OSError:
+                # same degradation policy as HiveServer._journal: a full
+                # disk costs restart-durability of the promotion, never
+                # the promotion itself — the swarm needs a primary NOW
+                logger.exception(
+                    "promotion snapshot failed; serving as primary at "
+                    "epoch %d anyway (state is NOT restart-durable)",
+                    srv.epoch)
+        srv.note_role_change()
+        _PROMOTIONS.inc()
+        self.promoted = True
+        logger.warning(
+            "standby promoted to PRIMARY at epoch %d: %d job record(s), "
+            "%d lease(s) re-granted with fresh %gs deadlines",
+            srv.epoch, len(srv.queue.records), regranted,
+            srv.leases.deadline_s)
+        return srv
+
+    def health(self) -> dict:
+        """Replication-side health (the server's own /healthz already
+        reports role + epoch; this adds the tail's view for tests and
+        tools)."""
+        lag = None
+        if self.last_sync_mono is not None:
+            lag = round(CLOCK.mono() - self.last_sync_mono, 2)
+        return {
+            "promoted": self.promoted,
+            "primary_uri": self.primary_uri,
+            "since": self.since,
+            "primary_epoch": self.primary_epoch,
+            "last_sync_age_s": lag,
+        }

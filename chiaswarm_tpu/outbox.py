@@ -131,13 +131,26 @@ class Outbox:
         _DELIVERED.inc()
         self.refresh_gauges()
 
-    def park(self, entry: OutboxEntry) -> None:
+    def park(self, entry: OutboxEntry, reason: str | None = None) -> None:
         """Permanent hive refusal: take the entry out of the in-process
         retry loop but KEEP it on disk (renamed aside). recover() picks
-        parked entries up on the next start — never a silent drop."""
+        parked entries up on the next start — never a silent drop. The
+        payload is rewritten with the delivery history (retries, when and
+        why it parked) so `tools/outbox_inspect.py` can show an operator
+        what happened without the process that knew."""
         entry.parked = True
         if entry.path is not None and not entry.path.name.endswith(".parked"):
             try:
+                payload = json.dumps({
+                    "spooled_at": entry.spooled_at,
+                    "parked_at": time.time(),
+                    "retries": entry.retries,
+                    "park_reason": reason,
+                    "result": entry.result,
+                })
+                tmp = entry.path.with_name(entry.path.name + ".tmp")
+                tmp.write_text(payload)
+                os.replace(tmp, entry.path)
                 parked = entry.path.with_name(entry.path.name + ".parked")
                 os.replace(entry.path, parked)
                 entry.path = parked
@@ -145,6 +158,32 @@ class Outbox:
                 logger.warning("could not park entry %s", entry.path)
         _PARKED.inc()
         self.refresh_gauges()
+
+    def requeue_parked(self, job_id: str | None = None) -> list[Path]:
+        """Move parked envelopes back into the delivery spool (strip the
+        `.parked` suffix) so the next `recover()` — a worker restart —
+        retries them against a hive that may accept them now (e.g. after
+        a failover to a fresh primary). `job_id` picks one envelope;
+        None requeues every parked one. Returns the restored paths; the
+        ops entry point is `tools/outbox_inspect.py --requeue`."""
+        restored: list[Path] = []
+        for path in sorted(self.directory.glob("*.json.parked")):
+            if job_id is not None:
+                try:
+                    payload = json.loads(path.read_text())
+                    result = payload.get("result") or {}
+                except (OSError, ValueError):
+                    continue
+                if str(result.get("id")) != str(job_id):
+                    continue
+            target = path.with_name(path.name[: -len(".parked")])
+            try:
+                os.replace(path, target)
+                restored.append(target)
+            except OSError:
+                logger.warning("could not requeue parked entry %s", path)
+        self.refresh_gauges()
+        return restored
 
     def recover(self) -> list[OutboxEntry]:
         """Entries spooled by a previous process, oldest first. Unreadable
@@ -164,6 +203,7 @@ class Outbox:
                 str(result.get("id", "unknown")),
                 path,
                 float(payload.get("spooled_at", time.time())),
+                retries=int(payload.get("retries", 0) or 0),
                 parked=path.name.endswith(".parked"),
             ))
             _RECOVERED.inc()

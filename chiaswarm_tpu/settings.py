@@ -154,6 +154,24 @@ class Settings:
     # never evicted
     hive_spool_max_bytes: int = 0
     hive_spool_max_age_s: float = 0.0
+    # --- hive replication & failover (hive_server/replication.py) ---
+    # worker side: comma-separated hive site URIs in preference order
+    # (primary first, standby after); the HiveClient pins to one and
+    # fails over on consecutive transport errors or a not-primary 409.
+    # Empty = the single sdaas_uri, the pre-replication behavior
+    sdaas_uris: str = ""
+    # hive side: set to the PRIMARY's site URI to run this hive as its
+    # WAL-shipped standby (refuses work until promoted); "" = primary
+    hive_standby_of: str = ""
+    # how often the standby tails the primary's replication stream (and
+    # therefore the failover-detection cadence)
+    hive_replication_poll_s: float = 1.0
+    # consecutive seconds of primary silence (no stream AND no /healthz
+    # answer) before the standby promotes itself
+    hive_failover_grace_s: float = 10.0
+    # worker side: consecutive transport errors on the pinned hive
+    # endpoint before the client pins to the next one
+    hive_failover_errors: int = 2
 
     @classmethod
     def field_names(cls) -> tuple[str, ...]:
@@ -199,6 +217,11 @@ _ENV_OVERRIDES = {
     "CHIASWARM_HIVE_SHED_WATERMARKS": "hive_shed_watermarks",
     "CHIASWARM_HIVE_SPOOL_MAX_BYTES": "hive_spool_max_bytes",
     "CHIASWARM_HIVE_SPOOL_MAX_AGE_S": "hive_spool_max_age_s",
+    "CHIASWARM_HIVE_URIS": "sdaas_uris",
+    "CHIASWARM_HIVE_STANDBY_OF": "hive_standby_of",
+    "CHIASWARM_HIVE_REPLICATION_POLL_S": "hive_replication_poll_s",
+    "CHIASWARM_HIVE_FAILOVER_GRACE_S": "hive_failover_grace_s",
+    "CHIASWARM_HIVE_FAILOVER_ERRORS": "hive_failover_errors",
 }
 
 

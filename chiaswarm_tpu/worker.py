@@ -54,7 +54,7 @@ from . import outbox as outbox_mod
 from .batching import BatchScheduler
 from .chips.allocator import SliceAllocator
 from .faults import FaultInjected
-from .hive import HiveClient, HiveError
+from .hive import HiveClient, HiveError, hive_endpoints
 from .job_arguments import format_args
 from .log_setup import setup_logging
 from .outbox import Outbox, OutboxEntry
@@ -152,11 +152,15 @@ class Worker:
         hive_uri: str | None = None,
     ):
         self.settings = settings or load_settings()
+        # hive_uri (str or list) pins the endpoints explicitly (tests,
+        # LocalSwarm); otherwise Settings decides — sdaas_uris names the
+        # primary+standby set for client-side failover, sdaas_uri the
+        # classic single hive
         self.hive_uri = (
-            hive_uri
-            if hive_uri is not None
-            else f"{self.settings.sdaas_uri.rstrip('/')}/api"
-        )
+            hive_uri if hive_uri is not None
+            else hive_endpoints(self.settings))
+        if isinstance(self.hive_uri, list) and len(self.hive_uri) == 1:
+            self.hive_uri = self.hive_uri[0]
         self.allocator = allocator or SliceAllocator(
             chips_per_job=self.settings.chips_per_job,
             tensor_parallelism=self.settings.tensor_parallelism,
@@ -368,6 +372,14 @@ class Worker:
                 "depth": self.outbox.depth,
                 "oldest_age_s": round(oldest, 1) if oldest else 0,
                 "saturated": self.outbox.saturated,
+            },
+            # multi-hive failover view (hive.py): which endpoint this
+            # worker is pinned to, and how often it has had to move
+            "hive": {
+                "active_endpoint": self.hive.hive_uri,
+                "endpoints": list(self.hive.endpoints),
+                "failovers": self.hive.failovers,
+                "epoch": self.hive.epoch,
             },
             "resident_models": resident_models(),
             "slices": [
@@ -880,7 +892,11 @@ class Worker:
                     logger.error(
                         "hive permanently refused result %s (%s); parking "
                         "the envelope on disk", entry.job_id, e)
-                    self.outbox.park(entry)
+                    # park() rewrites the full envelope with its delivery
+                    # history — off-loop, like spool(): a multi-MB
+                    # artifact payload must not stall polls or timers
+                    await asyncio.get_running_loop().run_in_executor(
+                        None, self.outbox.park, entry, str(e))
                     return
                 err = e
             except Exception as e:  # unexpected: still never drop work

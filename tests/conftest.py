@@ -61,10 +61,12 @@ _QUICK_MODULES = {
     "test_faults",
     "test_flash_attention",
     "test_hive_protocol",
+    "test_hive_replication",
     "test_job_arguments",
     "test_loras",
     "test_mpeg_audio",
     "test_outbox",
+    "test_outbox_inspect",
     "test_output_processor",
     "test_placement_stats",
     "test_registry_exhaustive",
@@ -74,6 +76,7 @@ _QUICK_MODULES = {
     "test_telemetry",
     "test_tokenizer",
     "test_weights_path",
+    "test_worker_failover",
 }
 
 

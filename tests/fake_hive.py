@@ -37,6 +37,18 @@ class FakeHive:
         self.drop_work_times: int = 0
         # artificial latency before /results answers (timeout/drain tests)
         self.slow_results_s: float = 0.0
+        # --- two-endpoint / failover mode (FakeHivePair) ---
+        # set -> /work and /results answer 409 {"message": "not primary:
+        # ..."} like a replicating standby or a deposed stale-epoch
+        # primary (chiaswarm_tpu/hive_server/replication.py semantics)
+        self.not_primary: str | None = None
+        # set -> EVERY connection is severed (a dead/partitioned hive)
+        self.sever_all: bool = False
+        # fencing epoch advertised in X-Hive-Epoch answer headers (0 =
+        # no header, the legacy pre-replication hive)
+        self.epoch: int = 0
+        # X-Hive-Epoch values workers echoed on /work and /results
+        self.seen_epochs: list[str] = []
         self.result_attempts: int = 0
         self._runner: web.AppRunner | None = None
         self.port: int | None = None
@@ -92,24 +104,49 @@ class FakeHive:
             return None
         return web.json_response({"message": "unauthorized"}, status=401)
 
+    def _epoch_headers(self) -> dict[str, str]:
+        return {"X-Hive-Epoch": str(self.epoch)} if self.epoch else {}
+
+    def _note_epoch(self, request: web.Request) -> None:
+        raw = request.headers.get("X-Hive-Epoch")
+        if raw is not None:
+            self.seen_epochs.append(raw)
+
+    def _refuse_not_primary(self) -> web.Response | None:
+        if self.not_primary is None:
+            return None
+        return web.json_response(
+            {"message": f"not primary: {self.not_primary}"},
+            status=409, headers=self._epoch_headers())
+
     async def _work(self, request: web.Request) -> web.Response:
         denied = self._unauthorized(request)
         if denied is not None:
             return denied
+        self._note_epoch(request)
         self.work_requests.append(dict(request.query))
+        if self.sever_all:
+            return self._drop_connection(request)
         if self.drop_work_times > 0:
             self.drop_work_times -= 1
             return self._drop_connection(request)
+        refused = self._refuse_not_primary()
+        if refused is not None:
+            return refused
         if self.refuse_with is not None:
             return web.json_response({"message": self.refuse_with}, status=400)
         jobs, self.pending_jobs = self.pending_jobs, []
-        return web.json_response({"jobs": jobs})
+        return web.json_response({"jobs": jobs},
+                                 headers=self._epoch_headers())
 
     async def _results(self, request: web.Request) -> web.Response:
         denied = self._unauthorized(request)
         if denied is not None:
             return denied
+        self._note_epoch(request)
         self.result_attempts += 1
+        if self.sever_all:
+            return self._drop_connection(request)
         if self.slow_results_s:
             await asyncio.sleep(self.slow_results_s)
         if self.drop_results_times > 0:
@@ -118,9 +155,13 @@ class FakeHive:
         if self.fail_results_times > 0:
             self.fail_results_times -= 1
             return web.json_response({"message": "hive hiccup"}, status=502)
+        refused = self._refuse_not_primary()
+        if refused is not None:
+            return refused
         self.results.append(json.loads(await request.text()))
         self.result_event.set()
-        return web.json_response({"status": "ok"})
+        return web.json_response({"status": "ok"},
+                                 headers=self._epoch_headers())
 
     async def _models(self, request: web.Request) -> web.Response:
         return web.json_response(
@@ -144,3 +185,41 @@ class FakeHive:
         buf = io.BytesIO()
         img.save(buf, format="PNG")
         return web.Response(body=buf.getvalue(), content_type="image/png")
+
+
+class FakeHivePair:
+    """Two-endpoint mode: a primary + standby FakeHive, so worker-side
+    failover (hive.py endpoint pinning) is testable in the quick tier
+    without the real server. Starts with the replicated-hive topology —
+    endpoint 0 serving, endpoint 1 refusing 409 not-primary — and
+    `fail_over()` flips it: the primary goes dark (every connection
+    severed) and the standby is 'promoted' (serves, epoch bumped)."""
+
+    def __init__(self):
+        self.primary = FakeHive()
+        self.standby = FakeHive()
+
+    async def start(self) -> "FakeHivePair":
+        await self.primary.start()
+        await self.standby.start()
+        self.standby.not_primary = "standby replicating (fake)"
+        return self
+
+    async def stop(self) -> None:
+        await self.primary.stop()
+        await self.standby.stop()
+
+    @property
+    def uris(self) -> list[str]:
+        """Worker-facing endpoint list, primary first (what
+        Settings.sdaas_uris would resolve to)."""
+        return [self.primary.uri, self.standby.uri]
+
+    def fail_over(self) -> None:
+        """Kill the primary and promote the standby, handing it the
+        undispatched backlog (the real standby has it via replication)."""
+        self.primary.sever_all = True
+        self.standby.not_primary = None
+        self.standby.epoch = self.primary.epoch + 1
+        self.standby.pending_jobs.extend(self.primary.pending_jobs)
+        self.primary.pending_jobs = []

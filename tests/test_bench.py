@@ -90,6 +90,15 @@ def test_bench_cpu_fallback_produces_labeled_smoke_row():
         out["hive_restart_leased"], out
     assert out.get("hive_restart_recovery_s", -1) >= 0, out
 
+    # hive availability row (ISSUE 7): primary killed under a WAL-shipped
+    # standby — the standby must promote (epoch bumped) and the failed-
+    # over worker must complete every job; zero lost is the acceptance
+    # bar, takeover_s the number the row exists to report
+    assert out.get("hive_failover_jobs", 0) >= 1, out
+    assert out.get("hive_failover_jobs_lost") == 0, out
+    assert out.get("hive_failover_takeover_s", -1) >= 0, out
+    assert out.get("hive_failover_epoch", 0) >= 1, out
+
     # cross-job micro-batching row (4-virtual-device slice child): the
     # coalesce ladder landed, and filling the slice beats batch-1 passes
     # (structurally ~4x here — replicated vs sharded — so >1 is a safe,

@@ -2,9 +2,12 @@
 submit drops, hive connection drops, hang-in-denoise under the watchdog,
 crash-before-ack, drain-with-in-flight-job, a hive-side lease takeover
 (worker dies mid-lease, the real coordinator redelivers to a second
-worker), and a hive SIGKILL'd while holding queued + leased jobs (WAL
-replay on restart, zero lost) — must end with a healthy swarm and zero
-lost envelopes.
+worker), a hive SIGKILL'd while holding queued + leased jobs (WAL
+replay on restart, zero lost), a primary killed under a WAL-shipped
+standby (health-checked self-promotion, worker failover, zero lost),
+and a revived deposed primary whose stale-epoch ACK must be fenced
+(no double-settle) — must end with a healthy swarm and zero lost
+envelopes.
 """
 
 import importlib.util
@@ -32,6 +35,8 @@ def _load_tool():
     "sigterm_drain",
     "hive_lease_takeover",
     "hive_crash_recovery",
+    "hive_failover",
+    "hive_split_brain_fenced",
 ])
 def test_chaos_scenario(name, sdaas_root):
     tool = _load_tool()

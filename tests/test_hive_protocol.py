@@ -1,15 +1,18 @@
-"""Wire-protocol conformance: FakeHive and the real hive_server answer
-identically to the worker's own client.
+"""Wire-protocol conformance: FakeHive, the real hive_server, and a
+PROMOTED STANDBY all answer identically to the worker's own client.
 
-Every assertion here runs against BOTH backends (parametrized), driven
-through `chiaswarm_tpu.hive.HiveClient` — the exact code a production
-worker uses — plus raw aiohttp where the contract is about status codes
-and payload shapes. The fake can therefore never drift from the real
-coordinator's wire contract again: a behavior change in either backend
-breaks this suite until the other follows.
+Every assertion here runs against all three backends (parametrized),
+driven through `chiaswarm_tpu.hive.HiveClient` — the exact code a
+production worker uses — plus raw aiohttp where the contract is about
+status codes and payload shapes. The fake can therefore never drift
+from the real coordinator's wire contract again, and a standby that
+replicated + promoted (ISSUE 7) is pinned to the same contract as a
+born-primary hive: a behavior change in any backend breaks this suite
+until the others follow.
 """
 
 import asyncio
+import dataclasses
 import json
 
 import aiohttp
@@ -72,7 +75,47 @@ class RealBackend:
         await self.server.stop()
 
 
-BACKENDS = {"fake": FakeBackend, "real": RealBackend}
+class PromotedBackend:
+    """A standby that replicated a (briefly live) primary and promoted
+    itself after the primary stopped — the protocol surface a worker
+    lands on after a failover. Conformance against it proves promotion
+    produces a full primary, not a half-serving replica."""
+
+    name = "promoted"
+
+    async def start(self):
+        from chiaswarm_tpu.hive_server import HiveServer
+        from chiaswarm_tpu.hive_server.replication import StandbyHive
+
+        base = Settings(sdaas_token=TOKEN, hive_port=0,
+                        hive_max_jobs_per_poll=8,
+                        hive_wal_dir="wal_conf_primary")
+        primary = await HiveServer(base, port=0).start()
+        self.standby = StandbyHive(
+            dataclasses.replace(base, hive_wal_dir="wal_conf_standby"),
+            primary_uri=primary.uri, port=0)
+        await self.standby.server.start()
+        await self.standby.sync_once()
+        await primary.stop()
+        self.server = await self.standby.promote()
+        return self
+
+    @property
+    def uri(self) -> str:
+        return self.server.api_uri
+
+    def queue_job(self, job: dict) -> None:
+        self.server.queue.submit(job)
+
+    def refuse(self, message: str) -> None:
+        self.server.refuse_with = message
+
+    async def stop(self) -> None:
+        await self.standby.stop()
+
+
+BACKENDS = {"fake": FakeBackend, "real": RealBackend,
+            "promoted": PromotedBackend}
 
 
 def run_conformance(backend_name: str, scenario):
@@ -99,7 +142,7 @@ def echo_job(job_id: str = "conf-1") -> dict:
             "prompt": job_id}
 
 
-@pytest.fixture(params=["fake", "real"])
+@pytest.fixture(params=["fake", "real", "promoted"])
 def backend_name(request, sdaas_root):
     return request.param
 

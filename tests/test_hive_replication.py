@@ -1,0 +1,424 @@
+"""Hive replication & failover (ISSUE 7): the WAL event stream, the
+standby's tail/resume semantics, promotion, and split-brain fencing.
+
+Covers the journal's replication-sequence protocol (incremental tail vs
+reset-after-compaction), a standby replicating a live primary over real
+HTTP while refusing work itself, stream resume across a torn WAL tail
+and across compaction (retired history never replayed), promotion
+semantics (fresh lease deadlines, epoch bump, durable across a restart
+of the promoted hive), stale-epoch fencing, the drop_replication fault
+point, and the health-check-driven auto-failover loop.
+"""
+
+import asyncio
+import dataclasses
+import json
+
+import aiohttp
+import pytest
+
+from chiaswarm_tpu import faults
+from chiaswarm_tpu.hive_server import HiveServer, StandbyHive
+from chiaswarm_tpu.hive_server.journal import (
+    HiveJournal,
+    ev_admit,
+    ev_epoch,
+    snapshot_events,
+)
+from chiaswarm_tpu.settings import Settings
+
+TOKEN = "replication-test-token"
+
+
+@pytest.fixture(autouse=True)
+def disarm_faults():
+    yield
+    faults.configure("")
+
+
+def _settings(**overrides) -> Settings:
+    fields = dict(sdaas_token=TOKEN, hive_port=0, metrics_port=0,
+                  hive_wal_dir="wal_primary")
+    fields.update(overrides)
+    return Settings(**fields)
+
+
+def _standby_settings(primary: Settings, **overrides) -> Settings:
+    return dataclasses.replace(primary, hive_wal_dir="wal_standby",
+                               **overrides)
+
+
+def _echo(job_id: str) -> dict:
+    return {"id": job_id, "workflow": "echo", "model_name": "none",
+            "prompt": job_id}
+
+
+def _headers(**extra) -> dict:
+    headers = {"Authorization": f"Bearer {TOKEN}",
+               "Content-type": "application/json"}
+    headers.update(extra)
+    return headers
+
+
+async def _submit(session, server, job: dict) -> str:
+    async with session.post(f"{server.api_uri}/jobs", data=json.dumps(job),
+                            headers=_headers()) as r:
+        assert r.status == 200, await r.text()
+        return (await r.json())["id"]
+
+
+async def _poll(session, server, name: str, **extra):
+    params = {"worker_version": "0.1.0", "worker_name": name}
+    params.update({k: str(v) for k, v in extra.items()})
+    async with session.get(f"{server.api_uri}/work", params=params,
+                           headers=_headers()) as r:
+        payload = None
+        try:
+            payload = await r.json()
+        except aiohttp.ContentTypeError:
+            pass
+        return r.status, payload
+
+
+# --- journal stream protocol (no sockets) -----------------------------------
+
+
+def test_stream_since_incremental_and_reset(tmp_path):
+    journal = HiveJournal(tmp_path / "wal")
+    for i in range(4):
+        journal.append(ev_admit(type("R", (), {
+            "job": {"id": f"j{i}"}, "job_class": "default", "seq": i,
+            "submitted_wall": 0.0, "attempts": 0})()))
+    assert journal.last_rs == 4
+
+    events, reset = journal.stream_since(0)
+    assert not reset and [e["rs"] for e in events] == [1, 2, 3, 4]
+    events, reset = journal.stream_since(2)
+    assert not reset and [e["rs"] for e in events] == [3, 4]
+    events, reset = journal.stream_since(4)
+    assert not reset and events == []
+
+    # compaction re-stamps fresh sequences: a standby AT the old tip is
+    # still continuous (idempotent snapshot re-apply), one behind is not
+    snapshot = [ev_admit(type("R", (), {
+        "job": {"id": "j3"}, "job_class": "default", "seq": 3,
+        "submitted_wall": 0.0, "attempts": 0})())]
+    journal.compact(snapshot)
+    assert journal.stream_start_rs == 5
+    events, reset = journal.stream_since(4)
+    assert not reset and [e["rs"] for e in events] == [5]
+    events, reset = journal.stream_since(2)
+    assert reset and [e["rs"] for e in events] == [5]
+    journal.close()
+
+
+def test_stream_since_ahead_of_counter_forces_reset(tmp_path):
+    """A standby position AHEAD of the journal's counter (primary lost
+    WAL tail to power loss, or was stood up over a wiped dir) must be a
+    reset — an empty incremental reply would leave the standby silently
+    filtering every future event as already-seen."""
+    journal = HiveJournal(tmp_path / "wal")
+    journal.append(ev_epoch(1))
+    assert journal.last_rs == 1
+    events, reset = journal.stream_since(50)
+    assert reset
+    assert [e["rs"] for e in events] == [1]
+    journal.close()
+
+
+def test_epoch_event_survives_recover_and_snapshot(tmp_path):
+    journal = HiveJournal(tmp_path / "wal")
+    journal.append(ev_epoch(3))
+    journal.close()
+    reopened = HiveJournal(tmp_path / "wal")
+    events = reopened.recover()
+    assert events[0]["ev"] == "epoch" and events[0]["epoch"] == 3
+    reopened.close()
+    # snapshot_events leads with the epoch so replay sees it first
+    from chiaswarm_tpu.hive_server.leases import LeaseTable
+    from chiaswarm_tpu.hive_server.queue import PriorityJobQueue
+
+    events = snapshot_events(PriorityJobQueue(), LeaseTable(10, 1), epoch=2)
+    assert events[0] == {"ev": "epoch", "epoch": 2}
+
+
+# --- standby replication over HTTP ------------------------------------------
+
+
+def test_standby_replicates_and_refuses_until_promoted(sdaas_root):
+    async def scenario():
+        primary_settings = _settings()
+        primary = await HiveServer(primary_settings, port=0).start()
+        standby = StandbyHive(_standby_settings(primary_settings),
+                              primary_uri=primary.uri, port=0)
+        await standby.server.start()
+        async with aiohttp.ClientSession() as session:
+            for i in range(3):
+                await _submit(session, primary, _echo(f"rep-{i}"))
+            status, payload = await _poll(session, primary, "w1")
+            assert status == 200
+            assert [j["id"] for j in payload["jobs"]] == ["rep-0"]
+
+            await standby.sync_once()
+            states = {k: v.state
+                      for k, v in standby.server.queue.records.items()}
+            assert states == {"rep-0": "leased", "rep-1": "queued",
+                              "rep-2": "queued"}
+            # replicated queue preserves dispatch order
+            assert [r.job_id
+                    for r in standby.server.queue.iter_queued()] == \
+                ["rep-1", "rep-2"]
+
+            # a standby must not dispatch, settle, or admit
+            status, payload = await _poll(session, standby.server, "w2")
+            assert status == 409
+            assert payload["message"].startswith("not primary")
+            async with session.post(
+                    f"{standby.server.api_uri}/results",
+                    data=json.dumps({"id": "rep-0", "artifacts": {}}),
+                    headers=_headers()) as r:
+                assert r.status == 409
+            async with session.post(
+                    f"{standby.server.api_uri}/jobs",
+                    data=json.dumps(_echo("rep-x")),
+                    headers=_headers()) as r:
+                assert r.status == 409
+            # reads stay open on a standby (ops visibility)
+            async with session.get(
+                    f"{standby.server.api_uri}/jobs/rep-1",
+                    headers=_headers()) as r:
+                assert r.status == 200
+
+            health = standby.server.health()
+            assert health["role"] == "standby"
+        await primary.stop()
+        await standby.stop()
+
+    asyncio.run(scenario())
+
+
+def test_stream_resumes_after_primary_restart_with_torn_tail(sdaas_root):
+    """A crash mid-append leaves a torn tail in the primary's WAL; the
+    restarted primary skips it, and the standby resumes the stream and
+    converges — the torn transition resolves like any lost event."""
+
+    async def scenario():
+        primary_settings = _settings()
+        primary = await HiveServer(primary_settings, port=0).start()
+        port = primary.port
+        standby = StandbyHive(_standby_settings(primary_settings),
+                              primary_uri=primary.uri, port=0)
+        await standby.server.start()
+        async with aiohttp.ClientSession() as session:
+            for i in range(2):
+                await _submit(session, primary, _echo(f"torn-{i}"))
+            await standby.sync_once()
+            assert len(standby.server.queue.records) == 2
+            wal_path = primary.journal.path
+            await primary.stop()
+            # the crash interrupted an append: half a JSON line on disk
+            with open(wal_path, "ab") as fh:
+                fh.write(b'{"ev": "admit", "job": {"id": "torn-lost')
+
+            restarted = await HiveServer(primary_settings, port=port).start()
+            assert restarted.journal.torn_lines == 1
+            await _submit(session, restarted, _echo("torn-2"))
+            await standby.sync_once()
+            assert set(standby.server.queue.records) == \
+                {"torn-0", "torn-1", "torn-2"}
+            assert "torn-lost" not in standby.server.queue.records
+            await restarted.stop()
+        await standby.stop()
+
+    asyncio.run(scenario())
+
+
+def test_stream_resets_across_compaction_without_retired_history(sdaas_root):
+    """A standby whose position was compacted away full-resyncs from the
+    snapshot: pruned (retired) jobs never reach it, and its state lands
+    exactly on the primary's."""
+
+    async def scenario():
+        # history_limit=1 so settling jobs retires older finished records
+        primary_settings = _settings(hive_job_history_limit=1)
+        primary = await HiveServer(primary_settings, port=0).start()
+        standby = StandbyHive(_standby_settings(primary_settings),
+                              primary_uri=primary.uri, port=0)
+        await standby.server.start()
+        async with aiohttp.ClientSession() as session:
+            for i in range(3):
+                await _submit(session, primary, _echo(f"cmp-{i}"))
+            await standby.sync_once()
+            before_reset_position = standby.since
+            assert before_reset_position > 0
+
+            # the primary settles two jobs (the older retires under the
+            # history limit) and compacts — the standby's position is gone
+            for i in range(2):
+                status, payload = await _poll(session, primary, "w1")
+                job_id = payload["jobs"][0]["id"]
+                async with session.post(
+                        f"{primary.api_uri}/results",
+                        data=json.dumps({"id": job_id, "artifacts": {}}),
+                        headers=_headers()) as r:
+                    assert r.status == 200
+            assert "cmp-0" not in primary.queue.records  # retired
+            primary.journal.compact(primary.journal.snapshot_fn())
+
+            applied = await standby.sync_once()
+            assert applied > 0
+            assert set(standby.server.queue.records) == \
+                set(primary.queue.records)
+            assert standby.server.queue.records["cmp-1"].state == "done"
+            assert "cmp-0" not in standby.server.queue.records
+            assert standby.since > before_reset_position
+        await primary.stop()
+        await standby.stop()
+
+    asyncio.run(scenario())
+
+
+def test_drop_replication_fault_then_clean_resume(sdaas_root):
+    async def scenario():
+        primary_settings = _settings()
+        primary = await HiveServer(primary_settings, port=0).start()
+        standby = StandbyHive(_standby_settings(primary_settings),
+                              primary_uri=primary.uri, port=0)
+        await standby.server.start()
+        async with aiohttp.ClientSession() as session:
+            await _submit(session, primary, _echo("fault-0"))
+        faults.configure("drop_replication=1")
+        with pytest.raises(faults.FaultInjected):
+            await standby.sync_once()
+        assert standby.server.queue.records == {}
+        # the next sync resumes from the same position, nothing doubled
+        await standby.sync_once()
+        assert set(standby.server.queue.records) == {"fault-0"}
+        assert faults.get_plan().fired("drop_replication") == 1
+        await primary.stop()
+        await standby.stop()
+
+    asyncio.run(scenario())
+
+
+# --- promotion + fencing ----------------------------------------------------
+
+
+def test_promote_bumps_epoch_regrants_leases_and_persists(sdaas_root):
+    async def scenario():
+        primary_settings = _settings(hive_lease_deadline_s=50.0)
+        primary = await HiveServer(primary_settings, port=0).start()
+        standby_settings = _standby_settings(
+            primary_settings, hive_lease_deadline_s=50.0)
+        standby = StandbyHive(standby_settings,
+                              primary_uri=primary.uri, port=0)
+        await standby.server.start()
+        async with aiohttp.ClientSession() as session:
+            await _submit(session, primary, _echo("pro-0"))
+            await _submit(session, primary, _echo("pro-1"))
+            status, payload = await _poll(session, primary, "doomed")
+            assert [j["id"] for j in payload["jobs"]] == ["pro-0"]
+            await standby.sync_once()
+            await primary.stop()
+
+            promoted = await standby.promote()
+            assert standby.promoted
+            assert promoted.epoch == 1
+            assert promoted.standby is False
+            # the replicated lease was re-granted with a FRESH deadline
+            lease = promoted.leases.get("pro-0")
+            assert lease is not None and lease.worker == "doomed"
+            remaining = lease.expires_at - promoted.leases.clock.mono()
+            assert remaining == pytest.approx(50.0, abs=5.0)
+
+            # the promoted hive serves: dispatch + settle work now
+            status, payload = await _poll(session, standby.server, "w2")
+            assert status == 200
+            assert [j["id"] for j in payload["jobs"]] == ["pro-1"]
+            assert standby.server.health()["role"] == "primary"
+        await standby.stop()
+
+        # promotion is DURABLE: a restart of the promoted hive keeps the
+        # epoch and the record table (its own WAL got the snapshot)
+        restarted = HiveServer(standby_settings, port=0)
+        assert restarted.epoch == 1
+        assert set(restarted.queue.records) == {"pro-0", "pro-1"}
+        if restarted.journal is not None:
+            restarted.journal.close()
+
+    asyncio.run(scenario())
+
+
+def test_stale_epoch_requests_fenced_with_409(sdaas_root):
+    async def scenario():
+        primary = await HiveServer(_settings(), port=0).start()
+        async with aiohttp.ClientSession() as session:
+            await _submit(session, primary, _echo("fence-0"))
+            # an epoch-5 worker polling an epoch-0 hive: deposed, refuse
+            params = {"worker_version": "0.1.0", "worker_name": "w"}
+            async with session.get(
+                    f"{primary.api_uri}/work", params=params,
+                    headers=_headers(**{"X-Hive-Epoch": "5"})) as r:
+                assert r.status == 409
+                assert "stale hive epoch" in (await r.json())["message"]
+            async with session.post(
+                    f"{primary.api_uri}/results",
+                    data=json.dumps({"id": "fence-0", "artifacts": {}}),
+                    headers=_headers(**{"X-Hive-Epoch": "5"})) as r:
+                assert r.status == 409
+            assert primary.queue.records["fence-0"].state == "queued"
+            # the same requests without the newer epoch are served
+            async with session.get(
+                    f"{primary.api_uri}/work", params=params,
+                    headers=_headers()) as r:
+                assert r.status == 200
+                assert r.headers["X-Hive-Epoch"] == "0"
+        await primary.stop()
+
+    asyncio.run(scenario())
+
+
+def test_health_check_loop_promotes_after_grace(sdaas_root):
+    """The autonomous path: primary dies, the replication loop's health
+    checks fail past hive_failover_grace_s, the standby promotes itself."""
+
+    async def scenario():
+        primary_settings = _settings()
+        primary = await HiveServer(primary_settings, port=0).start()
+        standby = await StandbyHive(
+            _standby_settings(primary_settings,
+                              hive_replication_poll_s=0.05,
+                              hive_failover_grace_s=0.3),
+            primary_uri=primary.uri, port=0).start()
+        async with aiohttp.ClientSession() as session:
+            await _submit(session, primary, _echo("auto-0"))
+        deadline = asyncio.get_running_loop().time() + 10.0
+        while not standby.server.queue.records:
+            assert asyncio.get_running_loop().time() < deadline, \
+                "standby never caught up"
+            await asyncio.sleep(0.02)
+        await primary.stop()
+        deadline = asyncio.get_running_loop().time() + 20.0
+        while not standby.promoted:
+            assert asyncio.get_running_loop().time() < deadline, \
+                "standby never promoted itself"
+            await asyncio.sleep(0.02)
+        assert standby.server.epoch == 1
+        assert set(standby.server.queue.records) == {"auto-0"}
+        await standby.stop()
+
+    asyncio.run(scenario())
+
+
+def test_replication_stream_requires_wal(sdaas_root):
+    async def scenario():
+        primary = await HiveServer(_settings(hive_wal_dir=""), port=0).start()
+        async with aiohttp.ClientSession() as session:
+            async with session.get(
+                    f"{primary.api_uri}/replication/stream",
+                    params={"since": "0"}, headers=_headers()) as r:
+                assert r.status == 400
+                assert "hive_wal_dir" in (await r.json())["message"]
+        await primary.stop()
+
+    asyncio.run(scenario())

@@ -1,0 +1,233 @@
+"""Worker-side multi-hive failover (hive.py endpoint pinning) against
+the two-endpoint FakeHive mode — the quick-tier half of ISSUE 7 (the
+real-server half lives in tests/test_hive_replication.py and the chaos
+scenarios).
+
+Covers: failover off a severed (dead) primary and off a 409 not-primary
+refusal, result delivery landing on the surviving hive, epoch
+learn-and-echo, the /healthz hive block and failover metrics, the
+sdaas_uris endpoint parsing, and the shared module-level client cache.
+"""
+
+import asyncio
+
+import pytest
+
+from chiaswarm_tpu import hive as hive_mod
+from chiaswarm_tpu import telemetry
+from chiaswarm_tpu import worker as worker_mod
+from chiaswarm_tpu.chips.allocator import SliceAllocator
+from chiaswarm_tpu.hive import HiveClient, hive_endpoints, shared_client
+from chiaswarm_tpu.settings import Settings
+from chiaswarm_tpu.worker import Worker
+
+from .fake_hive import FakeHive, FakeHivePair
+
+
+@pytest.fixture(autouse=True)
+def fast_poll(monkeypatch):
+    monkeypatch.setattr(worker_mod, "POLL_SECONDS", 0.05)
+    monkeypatch.setattr(worker_mod, "ERROR_BACKOFF_SECONDS", 0.2)
+
+
+def echo_job(job_id: str) -> dict:
+    return {"id": job_id, "workflow": "echo", "model_name": "none",
+            "prompt": job_id}
+
+
+def _settings(**overrides) -> Settings:
+    base = dict(sdaas_token="failover-token", worker_name="failover-worker",
+                metrics_port=0, hive_failover_errors=2)
+    base.update(overrides)
+    return Settings(**base)
+
+
+# --- endpoint parsing -------------------------------------------------------
+
+
+def test_hive_endpoints_multi_and_fallback():
+    s = Settings(sdaas_uri="http://one:9511")
+    assert hive_endpoints(s) == ["http://one:9511/api"]
+    s = Settings(sdaas_uri="http://one:9511",
+                 sdaas_uris="http://a:1/, http://b:2;http://c:3/api")
+    assert hive_endpoints(s) == [
+        "http://a:1/api", "http://b:2/api", "http://c:3/api"]
+
+
+def test_settings_env_overrides_for_failover_knobs(monkeypatch):
+    from chiaswarm_tpu.settings import load_settings
+
+    monkeypatch.setenv("CHIASWARM_HIVE_URIS", "http://p:1,http://s:2")
+    monkeypatch.setenv("CHIASWARM_HIVE_FAILOVER_GRACE_S", "3.5")
+    monkeypatch.setenv("CHIASWARM_HIVE_STANDBY_OF", "http://p:1")
+    monkeypatch.setenv("CHIASWARM_HIVE_REPLICATION_POLL_S", "0.25")
+    monkeypatch.setenv("CHIASWARM_HIVE_FAILOVER_ERRORS", "5")
+    s = load_settings()
+    assert s.sdaas_uris == "http://p:1,http://s:2"
+    assert s.hive_failover_grace_s == 3.5
+    assert s.hive_standby_of == "http://p:1"
+    assert s.hive_replication_poll_s == 0.25
+    assert s.hive_failover_errors == 5
+
+
+# --- client-level failover --------------------------------------------------
+
+
+def test_client_fails_over_on_not_primary_409(sdaas_root):
+    async def scenario():
+        pair = await FakeHivePair().start()
+        # inverted roles: the FIRST endpoint refuses as not-primary (a
+        # deposed/standby hive), the second serves
+        pair.primary.not_primary = "deposed"
+        pair.standby.not_primary = None
+        pair.standby.add_job(echo_job("fo-409"))
+        client = HiveClient(_settings(), pair.uris)
+        try:
+            jobs = await client.ask_for_work({"chips": 1})
+            assert [j["id"] for j in jobs] == ["fo-409"]
+            assert client.failovers >= 1
+            assert client.hive_uri == pair.standby.uri
+        finally:
+            await client.close()
+            await pair.stop()
+
+    asyncio.run(scenario())
+
+
+def test_client_fails_over_after_consecutive_transport_errors(sdaas_root):
+    async def scenario():
+        pair = await FakeHivePair().start()
+        pair.fail_over()  # primary severed, standby promoted
+        client = HiveClient(_settings(hive_failover_errors=2), pair.uris)
+        try:
+            # two polls die on the severed primary, the pin advances
+            for _ in range(2):
+                with pytest.raises(Exception):
+                    await client.ask_for_work({"chips": 1})
+            assert client.hive_uri == pair.standby.uri
+            pair.standby.add_job(echo_job("fo-sever"))
+            jobs = await client.ask_for_work({"chips": 1})
+            assert [j["id"] for j in jobs] == ["fo-sever"]
+        finally:
+            await client.close()
+            await pair.stop()
+
+    asyncio.run(scenario())
+
+
+def test_submit_result_lands_on_survivor_and_echoes_epoch(sdaas_root):
+    async def scenario():
+        pair = await FakeHivePair().start()
+        pair.primary.not_primary = "deposed"
+        pair.standby.not_primary = None
+        pair.standby.epoch = 2
+        client = HiveClient(_settings(), pair.uris)
+        try:
+            ack = await client.submit_result(
+                {"id": "fo-res", "artifacts": {}})
+            assert ack == {"status": "ok"}
+            assert [r["id"] for r in pair.standby.results] == ["fo-res"]
+            assert pair.primary.results == []
+            # the survivor's epoch was learned and is echoed from now on
+            assert client.epoch == 2
+            await client.submit_result({"id": "fo-res2", "artifacts": {}})
+            assert "2" in pair.standby.seen_epochs
+        finally:
+            await client.close()
+            await pair.stop()
+
+    asyncio.run(scenario())
+
+
+# --- whole-worker failover (quick tier, no real server) ---------------------
+
+
+def test_worker_fails_over_and_reports_it(sdaas_root):
+    async def scenario():
+        pair = await FakeHivePair().start()
+        pair.fail_over()  # the primary is dead from the start
+        pair.standby.add_job(echo_job("fo-worker"))
+        failovers = telemetry.REGISTRY.get("swarm_hive_failover_total")
+        before = failovers.value()
+        w = Worker(settings=_settings(),
+                   allocator=SliceAllocator(chips_per_job=0),
+                   hive_uri=pair.uris)
+        runner = asyncio.create_task(w.run())
+        try:
+            results = await pair.standby.wait_for_results(1, timeout=60.0)
+            assert results[0]["id"] == "fo-worker"
+            health = w._health()
+            assert health["hive"]["active_endpoint"] == pair.standby.uri
+            assert health["hive"]["endpoints"] == pair.uris
+            assert health["hive"]["failovers"] >= 1
+            assert failovers.value() > before
+            # the per-endpoint error counter saw the dead primary
+            errors = telemetry.REGISTRY.get(
+                "swarm_hive_endpoint_errors_total")
+            assert errors.value(uri=pair.primary.uri) > 0
+        finally:
+            w.stop()
+            await asyncio.wait_for(runner, 10)
+            await pair.stop()
+
+    asyncio.run(scenario())
+
+
+def test_epoch_persists_across_client_restarts(sdaas_root):
+    """The fencing epoch survives a worker restart: outbox redelivery
+    from a fresh process must still refuse to hand its envelope to a
+    revived deposed primary (in-memory-only epoch would reopen the
+    double-settle hole)."""
+
+    async def scenario():
+        hive = await FakeHive().start()
+        hive.epoch = 3
+        client = HiveClient(_settings(), [hive.uri])
+        try:
+            await client.ask_for_work({"chips": 1})
+            assert client.epoch == 3
+        finally:
+            await client.close()
+            await hive.stop()
+        # 'restart': a brand-new client in the same $SDAAS_ROOT starts
+        # at the persisted epoch and echoes it immediately
+        reborn = HiveClient(_settings(), ["http://unused:1/api"])
+        assert reborn.epoch == 3
+        assert reborn._headers()["X-Hive-Epoch"] == "3"
+        await reborn.close()
+
+    asyncio.run(scenario())
+
+
+# --- shared module-level clients -------------------------------------------
+
+
+def test_module_helpers_reuse_one_client(sdaas_root):
+    settings = _settings()
+    a = shared_client(settings, "http://h:1/api")
+    b = shared_client(settings, "http://h:1/api")
+    assert a is b
+    c = shared_client(settings, "http://other:1/api")
+    assert c is not a
+
+
+def test_module_get_models_survives_sequential_event_loops(sdaas_root):
+    """The shared client must work across asyncio.run calls (the
+    reference-signature helpers are used from short-lived CLIs like
+    initialize.py): the session re-opens per loop instead of dying with
+    the first one."""
+
+    async def fetch(uri):
+        return await hive_mod.get_models(uri)
+
+    async def run_once():
+        hive = await FakeHive().start()
+        try:
+            models = await fetch(hive.uri)
+            assert any("stable-diffusion" in m["id"] for m in models)
+        finally:
+            await hive.stop()
+
+    asyncio.run(run_once())
+    asyncio.run(run_once())  # second loop: the cached client must adapt
+    asyncio.run(hive_mod.close_shared_clients())

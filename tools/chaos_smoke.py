@@ -393,6 +393,164 @@ async def scenario_hive_crash_recovery() -> str:
             "was redelivered to a pristine worker")
 
 
+async def scenario_hive_failover() -> str:
+    """Hive replication (ISSUE 7 acceptance): the primary dies mid-lease
+    with queued jobs; the WAL-shipped standby health-checks it dead and
+    promotes itself; a pristine worker fails over and completes EVERY
+    job — zero lost."""
+    from chiaswarm_tpu.hive_server import LocalSwarm
+    from chiaswarm_tpu.settings import Settings
+
+    faults.configure("hang_denoise=1", hang_timeout_s=120.0)
+    settings = Settings(
+        sdaas_token="chaos", hive_port=0, metrics_port=0,
+        hive_lease_deadline_s=1.0, hive_max_redeliveries=3,
+        hive_failover_grace_s=0.5, hive_replication_poll_s=0.05,
+        hive_wal_dir="wal_failover")  # isolated from other scenarios
+    swarm = LocalSwarm(n_workers=1, chips_per_job=0, settings=settings,
+                       standby=True)
+    plan = faults.get_plan()
+    async with swarm:
+        ids = [await swarm.submit(_echo(f"chaos-fo-{i}")) for i in range(3)]
+        # worker 1 leases one job and hangs in it — 'mid-lease'
+        _check(await _spin(lambda: plan.hanging == 1),
+               "worker 1 never started a job")
+        # the standby must hold the whole backlog before the crash
+        _check(await _spin(lambda: all(
+            j in swarm.standby.server.queue.records for j in ids), 10.0),
+            "standby never replicated the backlog")
+        await swarm.stop_worker(swarm.workers[0])
+        faults.configure("")  # the takeover worker runs clean
+        await swarm.kill_primary()
+        _check(await _spin(lambda: swarm.standby.promoted, 20.0),
+               "standby never promoted itself after the primary died")
+        _check(swarm.standby.server.epoch >= 1,
+               "promotion did not bump the fencing epoch")
+        takeover = swarm.add_worker("chaos-failover-worker")
+        for job_id in ids:
+            status = await swarm.wait_done(job_id, timeout=30.0)
+            _check(status["status"] == "done",
+                   f"job {job_id} lost across the failover")
+        _check(takeover.hive.failovers >= 1,
+               "takeover worker never pinned away from the dead primary")
+        plan.release_hangs()  # unstick worker 1's orphaned thread
+    return ("primary killed mid-lease; standby promoted at epoch "
+            f"{swarm.standby.server.epoch}; all {len(ids)} jobs completed")
+
+
+async def scenario_hive_split_brain_fenced() -> str:
+    """Split-brain fencing: the deposed primary is revived from its own
+    WAL still believing it holds the lease; a worker that has seen the
+    promoted hive's epoch POSTs its result there first — the stale-epoch
+    ACK is refused with a 409, the client fails over, and the job is
+    settled EXACTLY once (on the promoted hive)."""
+    import dataclasses
+    import json
+
+    import aiohttp
+
+    from chiaswarm_tpu import telemetry
+    from chiaswarm_tpu.hive import HiveClient
+    from chiaswarm_tpu.hive_server import HiveServer
+    from chiaswarm_tpu.hive_server.replication import StandbyHive
+    from chiaswarm_tpu.settings import Settings
+
+    faults.configure("")
+    base = Settings(sdaas_token="chaos", hive_port=0, metrics_port=0,
+                    hive_wal_dir="wal_splitbrain_p")
+    stale = telemetry.REGISTRY.get(
+        "swarm_hive_stale_epoch_total") or telemetry.counter(
+        "swarm_hive_stale_epoch_total", "")
+    stale_before = stale.value()
+    primary = await HiveServer(base, port=0).start()
+    primary_port = primary.port
+    primary_api = primary.api_uri
+    standby = StandbyHive(
+        dataclasses.replace(base, hive_wal_dir="wal_splitbrain_s"),
+        primary_uri=primary.uri, port=0)
+    await standby.server.start()
+    revived = None
+    clients = []
+    headers = {"Authorization": "Bearer chaos",
+               "Content-type": "application/json"}
+    try:
+        async with aiohttp.ClientSession() as session:
+            async with session.post(
+                    f"{primary_api}/jobs",
+                    data=json.dumps(_echo("chaos-splitbrain")),
+                    headers=headers) as r:
+                _check(r.status == 200, f"submit failed: {r.status}")
+            async with session.get(
+                    f"{primary_api}/work",
+                    params={"worker_version": "0.1.0",
+                            "worker_name": "doomed-w"},
+                    headers=headers) as r:
+                jobs = (await r.json())["jobs"]
+            _check([j["id"] for j in jobs] == ["chaos-splitbrain"],
+                   "the worker never leased the job")
+        await standby.sync_once()
+        _check(standby.server.queue.records[
+            "chaos-splitbrain"].state == "leased",
+            "standby did not replicate the lease")
+        # the primary 'dies'; the standby promotes (epoch 1)
+        await primary.stop()
+        await standby.promote()
+        # the worker polls, fails over to the promoted hive, and learns
+        # the new epoch from its answer headers
+        worker_settings = Settings(sdaas_token="chaos",
+                                   worker_name="doomed-w",
+                                   hive_failover_errors=1)
+        poller = HiveClient(worker_settings,
+                            [f"http://127.0.0.1:{primary_port}/api",
+                             standby.api_uri])
+        clients.append(poller)
+        try:
+            await poller.ask_for_work({"chips": 1})
+        except Exception:
+            pass  # dead primary: transport error advances the pin
+        await poller.ask_for_work({"chips": 1})
+        _check(poller.epoch >= 1,
+               "worker never learned the promoted hive's epoch")
+        # the deposed primary is revived over its own WAL, epoch 0,
+        # still believing it holds the lease
+        revived = await HiveServer(base, port=primary_port).start()
+        _check(revived.epoch == 0, "revived primary epoch should be 0")
+        _check(revived.queue.records["chaos-splitbrain"].state == "leased",
+               "revived primary lost its pre-crash lease state")
+        # the same worker process delivers its result; its endpoint list
+        # starts at the revived primary (a fresh delivery client models
+        # the outbox redelivery path hitting the old pin first)
+        deliverer = HiveClient(worker_settings,
+                               [f"http://127.0.0.1:{primary_port}/api",
+                                standby.api_uri])
+        deliverer.epoch = poller.epoch  # one process, one epoch view
+        clients.append(deliverer)
+        envelope = {"id": "chaos-splitbrain", "artifacts": {},
+                    "nsfw": False, "worker_version": "0.1.0",
+                    "pipeline_config": {}, "worker_name": "doomed-w"}
+        ack = await deliverer.submit_result(envelope)
+        _check(isinstance(ack, dict), "delivery never ACKed")
+        _check(stale.value() > stale_before,
+               "the stale-epoch refusal was never observed")
+        _check(deliverer.failovers >= 1,
+               "the delivery client never failed over off the deposed "
+               "primary")
+        _check(revived.queue.records["chaos-splitbrain"].state == "leased",
+               "DOUBLE-SETTLE: the deposed primary accepted the stale ACK")
+        _check(standby.server.queue.records[
+            "chaos-splitbrain"].state == "done",
+            "the promoted hive never settled the job")
+    finally:
+        for client in clients:
+            await client.close()
+        if revived is not None:
+            await revived.stop()
+        await standby.stop()
+        await primary.stop()
+    return ("deposed primary refused the stale-epoch ACK (409); the job "
+            "settled exactly once on the promoted hive")
+
+
 SCENARIOS = {
     "drop_submit": scenario_drop_submit,
     "hive_connection_drop": scenario_hive_connection_drop,
@@ -401,6 +559,8 @@ SCENARIOS = {
     "sigterm_drain": scenario_sigterm_drain,
     "hive_lease_takeover": scenario_hive_lease_takeover,
     "hive_crash_recovery": scenario_hive_crash_recovery,
+    "hive_failover": scenario_hive_failover,
+    "hive_split_brain_fenced": scenario_hive_split_brain_fenced,
 }
 
 
@@ -429,12 +589,17 @@ def main(argv: list[str] | None = None) -> int:
         print(f"unknown scenario(s): {unknown}; have {list(SCENARIOS)}")
         return len(unknown)
     failed = 0
-    with tempfile.TemporaryDirectory(prefix="chaos-sdaas-") as root:
-        os.environ["SDAAS_ROOT"] = root  # isolate spool/log from ~/.sdaas
-        for name in names:
+    for name in names:
+        # fresh root PER SCENARIO (not per run): persisted worker state —
+        # the fencing epoch file above all — must not leak between
+        # scenarios, which the CLI accepts in ANY order (a failover
+        # scenario's epoch-1 file would 409 a later scenario's fresh
+        # epoch-0 hive as 'deposed')
+        with tempfile.TemporaryDirectory(prefix="chaos-sdaas-") as root:
+            os.environ["SDAAS_ROOT"] = root  # isolate from ~/.sdaas
             ok, detail = run_scenario(name)
-            print(f"  {name}: {'ok' if ok else 'FAILED'} — {detail}")
-            failed += 0 if ok else 1
+        print(f"  {name}: {'ok' if ok else 'FAILED'} — {detail}")
+        failed += 0 if ok else 1
     print(f"chaos: {len(names) - failed}/{len(names)} scenarios ok")
     return failed
 

@@ -44,6 +44,10 @@ def main(argv: list[str] | None = None) -> int:
                         metavar="S", help="override hive_lease_deadline_s")
     parser.add_argument("--queue-limit", type=int, default=None,
                         help="override hive_queue_depth_limit")
+    parser.add_argument("--standby-of", default=None, metavar="URI",
+                        help="run as a WAL-shipped standby of this "
+                             "primary (overrides hive_standby_of; "
+                             "replicates + auto-promotes on failover)")
     args = parser.parse_args(argv)
 
     settings = load_settings()
@@ -51,6 +55,8 @@ def main(argv: list[str] | None = None) -> int:
         settings.hive_lease_deadline_s = args.lease_deadline
     if args.queue_limit is not None:
         settings.hive_queue_depth_limit = args.queue_limit
+    if args.standby_of is not None:
+        settings.hive_standby_of = args.standby_of
     try:
         asyncio.run(serve(settings, host=args.host, port=args.port))
     except KeyboardInterrupt:

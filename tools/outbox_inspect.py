@@ -1,0 +1,148 @@
+#!/usr/bin/env python
+"""Inspect (and requeue) a worker's durable result outbox.
+
+The outbox (chiaswarm_tpu/outbox.py) is the worker's write-ahead spool:
+every finished job's envelope sits under ``$SDAAS_ROOT/outbox/`` until
+the hive ACKs it, and a permanent 4xx refusal parks it aside
+(``*.json.parked``) instead of dropping it. This is the ops counterpart:
+
+  python tools/outbox_inspect.py
+      Table of every spooled and parked envelope: job id, state, age,
+      recorded delivery retries, size, and the park reason if any.
+
+  python tools/outbox_inspect.py --json
+      The same rows as one JSON document (for scripts/alerts).
+
+  python tools/outbox_inspect.py --requeue <job-id>
+  python tools/outbox_inspect.py --requeue all
+      Move parked envelope(s) back into the delivery spool; the next
+      worker start redelivers them (at-least-once — the hive dedupes by
+      job id). Typical use: envelopes parked by a deposed primary's
+      refusals, after the swarm has failed over to a hive that will
+      accept them.
+
+Reads the same settings the worker does (``$SDAAS_ROOT``,
+``Settings.outbox_dir`` / ``CHIASWARM_OUTBOX_DIR``); ``--dir`` overrides.
+Contract-tested by tests/test_outbox_inspect.py (quick tier).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import pathlib
+import sys
+import time
+
+sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent.parent))
+
+from chiaswarm_tpu.outbox import Outbox  # noqa: E402
+from chiaswarm_tpu.settings import load_settings, resolve_path  # noqa: E402
+
+
+def outbox_dir(override: str | None = None) -> pathlib.Path:
+    if override:
+        return pathlib.Path(override)
+    settings = load_settings()
+    return resolve_path(getattr(settings, "outbox_dir", "outbox"))
+
+
+def inspect_rows(directory: pathlib.Path) -> list[dict]:
+    """One row per envelope on disk, parked last, oldest first within
+    each state. Unreadable files still get a row — an operator must see
+    them, not lose them to a parse error."""
+    rows: list[dict] = []
+    now = time.time()
+    for path in sorted(directory.glob("*.json")) + sorted(
+            directory.glob("*.json.parked")):
+        parked = path.name.endswith(".parked")
+        row = {
+            "state": "parked" if parked else "spooled",
+            "path": str(path),
+            "size_bytes": None,
+            "job_id": "?",
+            "age_s": None,
+            "retries": 0,
+            "park_reason": None,
+        }
+        try:
+            row["size_bytes"] = path.stat().st_size
+            row["age_s"] = round(now - path.stat().st_mtime, 1)
+        except OSError:
+            pass
+        try:
+            payload = json.loads(path.read_text())
+            result = payload.get("result") or {}
+            row["job_id"] = str(result.get("id", "?"))
+            spooled_at = payload.get("spooled_at")
+            if spooled_at:
+                row["age_s"] = round(now - float(spooled_at), 1)
+            row["retries"] = int(payload.get("retries", 0) or 0)
+            row["park_reason"] = payload.get("park_reason")
+        except (OSError, ValueError, TypeError):
+            row["state"] = "unreadable"
+        rows.append(row)
+    state_rank = {"spooled": 0, "parked": 1, "unreadable": 2}
+    rows.sort(key=lambda r: (state_rank.get(r["state"], 3),
+                             -(r["age_s"] or 0)))
+    return rows
+
+
+def render_table(rows: list[dict]) -> str:
+    if not rows:
+        return "outbox empty: every delivered envelope was ACKed and unlinked"
+    header = f"{'job id':<28} {'state':<10} {'age':>9} {'retries':>7} {'size':>9}  reason"
+    lines = [header, "-" * len(header)]
+    for r in rows:
+        age = f"{r['age_s']:.0f}s" if r["age_s"] is not None else "?"
+        size = f"{r['size_bytes']}B" if r["size_bytes"] is not None else "?"
+        reason = (r["park_reason"] or "")[:60]
+        lines.append(
+            f"{r['job_id']:<28} {r['state']:<10} {age:>9} "
+            f"{r['retries']:>7} {size:>9}  {reason}")
+    parked = sum(1 for r in rows if r["state"] == "parked")
+    lines.append(
+        f"{len(rows)} envelope(s) on disk, {parked} parked "
+        "(requeue with --requeue <job-id> | all)")
+    return "\n".join(lines)
+
+
+def main(argv: list[str] | None = None) -> int:
+    parser = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    parser.add_argument("--dir", default=None,
+                        help="outbox directory (default: "
+                             "$SDAAS_ROOT/<Settings.outbox_dir>)")
+    parser.add_argument("--json", action="store_true",
+                        help="emit rows as JSON instead of a table")
+    parser.add_argument("--requeue", metavar="JOB_ID", default=None,
+                        help="move a parked envelope (or 'all') back "
+                             "into the delivery spool")
+    args = parser.parse_args(argv)
+
+    directory = outbox_dir(args.dir)
+    if not directory.is_dir():
+        print(f"no outbox at {directory} (worker never spooled anything)")
+        return 0
+
+    if args.requeue is not None:
+        box = Outbox(directory)
+        target = None if args.requeue == "all" else args.requeue
+        restored = box.requeue_parked(target)
+        if not restored:
+            print(f"nothing to requeue for {args.requeue!r} "
+                  f"(see the table below)")
+        for path in restored:
+            print(f"requeued {path.name} — the next worker start "
+                  "redelivers it")
+
+    rows = inspect_rows(directory)
+    if args.json:
+        print(json.dumps({"outbox_dir": str(directory), "entries": rows},
+                         indent=2))
+    else:
+        print(render_table(rows))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
