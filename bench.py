@@ -1160,8 +1160,8 @@ def run_hive_e2e_row() -> None:
                 # + XLA compile; the timed window must not include that
                 # one-off cost, so it is measured (and reported) apart
                 t0 = time.monotonic()
-                status = await wait_done(
-                    await submit(tiny_job(0, "warmup")), 600.0)
+                warmup_id = await submit(tiny_job(0, "warmup"))
+                status = await wait_done(warmup_id, 600.0)
                 if status["status"] != "done":
                     raise RuntimeError(
                         f"warmup job failed at the hive: {status['error']}")
@@ -1186,8 +1186,35 @@ def run_hive_e2e_row() -> None:
                     waits.append(float(status["queue_wait_s"] or 0.0))
                 wall_s = time.monotonic() - t0
 
+                # trace_e2e: every settled job must answer with a
+                # COMPLETE, gap-free timeline — hive lifecycle events,
+                # placement outcome, attributed queue-wait gap, and the
+                # worker's stage spans merged from the envelope
+                # (trace_missing is the same checker the durability
+                # tests pin)
+                from chiaswarm_tpu.hive_server.trace import trace_missing
+
+                traced, incomplete = 0, []
+                for job_id in [warmup_id, *ids]:
+                    async with session.get(
+                            f"{hive.api_uri}/jobs/{job_id}/trace",
+                            headers=headers) as resp:
+                        if resp.status != 200:
+                            incomplete.append(
+                                f"{job_id}: trace HTTP {resp.status}")
+                            continue
+                        trace = await resp.json()
+                    missing = trace_missing(trace)
+                    if missing:
+                        incomplete.append(f"{job_id}: {missing}")
+                    else:
+                        traced += 1
+
             waits.sort()
             return {
+                "trace_e2e_jobs": 1 + len(ids),
+                "trace_e2e_complete": traced,
+                "trace_e2e_incomplete": incomplete,
                 "hive_e2e_jobs_per_s": round(n_jobs / wall_s, 3),
                 "hive_e2e_jobs": n_jobs,
                 "hive_e2e_wall_s": round(wall_s, 2),

@@ -515,7 +515,17 @@ class BatchScheduler:
         _FLUSHES.inc(reason=reason)
         _GROUP_JOBS.observe(len(group["jobs"]))
         _GROUP_ROWS.observe(group["rows"])
-        _LINGER_WAIT.observe(time.monotonic() - group["opened"])
+        lingered = time.monotonic() - group["opened"]
+        _LINGER_WAIT.observe(lingered)
+        # split the linger window out of the worker-side queue_wait in
+        # each job's trace context (ISSUE 8): "waiting for batchmates"
+        # and "waiting for a slice" are different tuning knobs
+        # (batch_linger_ms vs capacity), and the job's end-to-end
+        # timeline should attribute them separately
+        for job in group["jobs"]:
+            if isinstance(job.get("trace"), dict):
+                job["trace"]["lingered_s"] = round(lingered, 3)
+                job["trace"]["coalesced_with"] = len(group["jobs"]) - 1
         if len(group["jobs"]) > 1:
             logger.info(
                 "coalesced %d jobs (%d images) for %s [%s]",

@@ -12,6 +12,14 @@ advertise TPU capability (`chips`, `hbm_gb`, `topology`) alongside the legacy
 `memory`/`gpu` keys so a capability-aware hive can place by chip count while
 legacy hives keep working.
 
+Tracing (ISSUE 8): a tracing hive stamps each handed job with a `trace`
+context — `{id, attempt, dispatched_wall, queue_wait_s}`, pinned by the
+protocol-conformance suite — which the worker enriches (receipt instant,
+linger split) and echoes back inside the result envelope's
+`pipeline_config.trace`, so the hive can assemble one end-to-end timeline
+per job (`GET /api/jobs/{id}/trace`). Legacy hives send no context and
+nothing is added; legacy workers ignore the key harmlessly.
+
 Unlike the reference (one aiohttp session per call), `HiveClient` holds a
 single session for connection reuse; the module-level functions keep the
 reference's call signatures for drop-in use (routed through a shared

@@ -53,6 +53,12 @@ from .journal import (
 from .leases import LeaseTable
 from .queue import PriorityJobQueue, QueueFull, parse_shed_watermarks
 from .spool import ArtifactSpool
+from .trace import (
+    build_shed_trace,
+    build_trace,
+    envelope_trace,
+    wire_trace_context,
+)
 
 logger = logging.getLogger(__name__)
 
@@ -121,6 +127,10 @@ class HiveServer:
         self.spool_max_bytes = int(g("hive_spool_max_bytes", 0))
         self.spool_max_age_s = float(g("hive_spool_max_age_s", 0.0))
         self.refuse_with: str | None = None
+        # optional health augmentation (replication.py installs the
+        # standby's lag view); returns a dict merged into health(),
+        # with its "degraded_reasons" list folded into the verdict
+        self.extra_health = None
         self.started_at = time.monotonic()
         self._last_spool_sweep = time.monotonic()
         self._runner: web.AppRunner | None = None
@@ -194,6 +204,7 @@ class HiveServer:
         app.router.add_get("/api/models", self._models)
         app.router.add_post("/api/jobs", self._submit)
         app.router.add_get("/api/jobs/{job_id}", self._job_status)
+        app.router.add_get("/api/jobs/{job_id}/trace", self._job_trace)
         app.router.add_get("/api/artifacts/{digest}", self._artifact)
         app.router.add_get("/api/replication/stream", self._replication_stream)
         app.router.add_get("/metrics", self._metrics)
@@ -301,6 +312,9 @@ class HiveServer:
                 "unplaceable: every live worker advertises this job's "
                 "model family as unconverted "
                 f"(waited {self.leases.deadline_s:g}s)")
+            record.timeline.append({
+                "event": "park", "wall": self.queue.clock.wall(),
+                "reason": "unplaceable"})
             self._journal(ev_park(record))
             for pruned in self.queue.retire(record):
                 self._journal(ev_retire(pruned))
@@ -433,8 +447,14 @@ class HiveServer:
         # recovery + lease expiry must redeliver them
         faults.fire("crash_after_lease")
         _POLLS.inc(reply="jobs" if handed else "empty")
+        # every handed job carries its trace context on the wire (a copy
+        # — the stored job dict stays pristine in the WAL): the worker
+        # echoes it back inside the envelope's pipeline_config.trace so
+        # its stage spans attach to the right dispatch attempt. Field
+        # set pinned by the protocol-conformance suite.
         return web.json_response(
-            {"jobs": [record.job for record, _ in handed]},
+            {"jobs": [dict(record.job, trace=wire_trace_context(record))
+                      for record, _ in handed]},
             headers=self._epoch_headers())
 
     async def _results(self, request: web.Request) -> web.Response:
@@ -505,10 +525,22 @@ class HiveServer:
             stored = result
         record.result = stored
         record.error = None
-        record.done_at = time.monotonic()
+        record.done_at = self.queue.clock.mono()
         record.completed_by = (
             sender or (lease.worker if lease else record.worker))
         record.state = "done"
+        settle_event = {
+            "event": "settle", "wall": self.queue.clock.wall(),
+            "worker": record.completed_by, "disposition": status,
+        }
+        # the worker echoes the wire trace context; its attempt number
+        # ties the envelope's stage spans to the dispatch that produced
+        # them (a late result names the EARLIER attempt, visibly)
+        echoed_attempt = envelope_trace(stored).get("attempt")
+        if isinstance(echoed_attempt, int):
+            settle_event["attempt"] = echoed_attempt
+        record.timeline.append(settle_event)
+        self.queue.observe_settle(record)
         self._journal(ev_settle(record))
         for pruned in self.queue.retire(record):
             self._journal(ev_retire(pruned))
@@ -570,6 +602,26 @@ class HiveServer:
             return web.json_response(
                 {"message": "unknown job id"}, status=404)
         return web.json_response(record.status())
+
+    async def _job_trace(self, request: web.Request) -> web.Response:
+        """One ordered, gap-attributed timeline per job: hive lifecycle
+        events (admit/shed/dispatch/lease/redeliver/settle, WAL-durable)
+        merged with the worker's stage spans from the settled envelope.
+        See hive_server/trace.py for the assembly contract."""
+        if not self._authorized(request):
+            return self._unauthorized()
+        job_id = request.match_info["job_id"]
+        record = self.queue.records.get(job_id)
+        if record is None:
+            shed = self.queue.shed_traces.get(job_id)
+            if shed:
+                # never admitted, but we watched it being shed: the
+                # refusals ARE its timeline so far
+                return web.json_response(build_shed_trace(job_id, shed))
+            return web.json_response(
+                {"message": "unknown job id"}, status=404)
+        return web.json_response(
+            build_trace(record, self.queue.clock.wall()))
 
     async def _artifact(self, request: web.Request) -> web.Response:
         if not self._authorized(request):
@@ -641,6 +693,15 @@ class HiveServer:
                     f"{cls} watermark {threshold})")
         if self.refuse_with is not None:
             reasons.append(f"draining: refusing workers ({self.refuse_with})")
+        extra: dict = {}
+        if self.extra_health is not None:
+            # replication.py installs its tail-side view here: a standby
+            # reports its lag and goes degraded when the stream stalls
+            try:
+                extra = dict(self.extra_health() or {})
+                reasons.extend(extra.pop("degraded_reasons", []))
+            except Exception:  # a broken probe must not break /healthz
+                logger.exception("extra health probe failed")
         payload = {
             "status": "degraded" if reasons else "ok",
             "degraded_reasons": reasons,
@@ -660,6 +721,7 @@ class HiveServer:
                 "torn_lines": self.journal.torn_lines,
                 "recovery": self.recovery,
             }
+        payload.update(extra)
         return payload
 
     async def _healthz(self, request: web.Request) -> web.Response:

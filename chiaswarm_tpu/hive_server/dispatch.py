@@ -218,6 +218,20 @@ class Dispatcher:
                     outcome = "steal"
                 else:
                     _DISPATCH.inc(outcome="hold")
+                    # first hold only: the job's trace shows WHEN the
+                    # affinity window started costing it latency without
+                    # one event per skipped poll. Advisory until the next
+                    # journaled transition carries the timeline forward.
+                    if not any(e.get("event") == "hold"
+                               for e in record.timeline):
+                        # the queue's clock, not the module CLOCK: every
+                        # other timeline stamp uses the injected clock,
+                        # and mixing timebases would scramble the sorted
+                        # trace under a test-injected wall clock
+                        record.timeline.append({
+                            "event": "hold", "wall": queue.clock.wall(),
+                            "worker": worker.name,
+                            "warm_on": sorted(h.name for h in holders)})
                     continue
             _DISPATCH.inc(outcome=outcome)
             handed.append((record, outcome))

@@ -91,10 +91,16 @@ class LocalSwarm:
         swarm's settings (a caller tuning e.g. job_deadline_s or
         batch_linger_ms configures the whole swarm, not just the hive),
         with per-worker identity and `worker_overrides` on top."""
+        fields = {"metrics_port": 0}
+        # overrides win over the harness defaults (a scenario that wants
+        # a live worker /metrics endpoint passes metrics_port explicitly)
+        # — except worker_name: per-worker identity keys the hive's
+        # directory and lease attribution, so a shared override would
+        # silently conflate every worker in the swarm
+        fields.update(self.worker_overrides)
+        fields["worker_name"] = name
         worker = Worker(
-            settings=dataclasses.replace(
-                self.settings, worker_name=name, metrics_port=0,
-                **self.worker_overrides),
+            settings=dataclasses.replace(self.settings, **fields),
             allocator=SliceAllocator(chips_per_job=self.chips_per_job),
             hive_uri=self.worker_endpoints(),
         )

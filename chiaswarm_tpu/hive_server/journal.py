@@ -274,9 +274,19 @@ class HiveJournal:
 # --- event constructors (one vocabulary for append sites and replay) ---
 
 
+def _timeline_of(record) -> list[dict]:
+    """The record's trace timeline, copied for the journal. EVERY event
+    carries the full timeline-so-far (a dozen small dicts at most), so
+    replay — recovery, compaction snapshots, and the standby's
+    replication stream alike — restores it by plain replacement: no
+    merge logic, no duplicate or reordered entries possible."""
+    return [dict(e) for e in getattr(record, "timeline", ())]
+
+
 def ev_admit(record) -> dict:
     event = {"ev": "admit", "job": record.job, "class": record.job_class,
-             "seq": record.seq, "wall": record.submitted_wall}
+             "seq": record.seq, "wall": record.submitted_wall,
+             "timeline": _timeline_of(record)}
     if record.attempts:
         # compaction folds a queued record's dispatch history (it was
         # leased and requeued before the snapshot) into its admit, so
@@ -291,22 +301,25 @@ def ev_admit(record) -> dict:
 def ev_lease(record) -> dict:
     return {"ev": "lease", "id": record.job_id, "worker": record.worker,
             "attempts": record.attempts, "outcome": record.placement,
-            "queue_wait_s": record.queue_wait_s}
+            "queue_wait_s": record.queue_wait_s,
+            "timeline": _timeline_of(record)}
 
 
 def ev_settle(record) -> dict:
     return {"ev": "settle", "id": record.job_id,
             "completed_by": record.completed_by,
-            "attempts": record.attempts, "result": record.result}
+            "attempts": record.attempts, "result": record.result,
+            "timeline": _timeline_of(record)}
 
 
 def ev_requeue(record) -> dict:
-    return {"ev": "requeue", "id": record.job_id, "attempts": record.attempts}
+    return {"ev": "requeue", "id": record.job_id, "attempts": record.attempts,
+            "timeline": _timeline_of(record)}
 
 
 def ev_park(record) -> dict:
     return {"ev": "park", "id": record.job_id, "error": record.error,
-            "attempts": record.attempts}
+            "attempts": record.attempts, "timeline": _timeline_of(record)}
 
 
 def ev_retire(job_id: str) -> dict:
@@ -357,6 +370,17 @@ def apply_events(events: list[dict], queue: PriorityJobQueue,
     counted, never fatal. Returns a summary for the recovery log line."""
     skipped = 0
     epoch = 0
+
+    def restore_timeline(record, event) -> None:
+        """Adopt the journaled timeline verbatim (replacement, not merge
+        — see _timeline_of). A legacy pre-trace event without one leaves
+        whatever the replay mutations stamped; the trace degrades to a
+        partial timeline rather than failing."""
+        timeline = event.get("timeline")
+        if isinstance(timeline, list):
+            record.timeline = [dict(e) for e in timeline
+                               if isinstance(e, dict)]
+
     for event in events:
         ev = event.get("ev")
         if ev == "epoch":
@@ -384,6 +408,13 @@ def apply_events(events: list[dict], queue: PriorityJobQueue,
                 restored.worker = event.get("worker")
                 restored.queue_wait_s = event.get("queue_wait_s")
                 restored.placement = event.get("placement")
+            restore_timeline(restored, event)
+            if not restored.timeline:
+                # legacy pre-trace WAL: synthesize the admit instant the
+                # event already carries so the trace is never empty
+                restored.timeline = [{
+                    "event": "admit", "wall": restored.submitted_wall,
+                    "class": restored.job_class}]
             _REPLAYED.inc()
             continue
         record = queue.records.get(str(event.get("id", "")))
@@ -399,6 +430,7 @@ def apply_events(events: list[dict], queue: PriorityJobQueue,
                 int(event.get("attempts", 1)), event.get("outcome"),
                 event.get("queue_wait_s"))
             leases.restore(record, record.worker)
+            restore_timeline(record, event)
         elif ev == "settle":
             leases.settle(record.job_id)
             queue.discard_queued(record)
@@ -408,18 +440,21 @@ def apply_events(events: list[dict], queue: PriorityJobQueue,
             record.completed_by = event.get("completed_by")
             record.attempts = int(event.get("attempts", record.attempts))
             record.done_at = queue.clock.mono()
+            restore_timeline(record, event)
             queue.retire(record)
         elif ev == "requeue":
             leases.settle(record.job_id)
             if record.state == "leased":
                 record.attempts = int(event.get("attempts", record.attempts))
                 queue.requeue_front(record)
+            restore_timeline(record, event)
         elif ev == "park":
             leases.settle(record.job_id)
             queue.discard_queued(record)
             record.state = "failed"
             record.error = event.get("error")
             record.attempts = int(event.get("attempts", record.attempts))
+            restore_timeline(record, event)
             queue.retire(record)
         elif ev == "retire":
             queue.forget(record.job_id)

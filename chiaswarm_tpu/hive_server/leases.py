@@ -62,6 +62,14 @@ class LeaseTable:
     def grant(self, record: JobRecord, worker: str) -> Lease:
         lease = Lease(record, worker, self.clock.mono() + self.deadline_s)
         self._leases[record.job_id] = lease
+        # trace timeline: a lease event per grant — re-grants (WAL
+        # recovery, standby promotion) appear too, which is the point:
+        # the timeline shows WHY a job's deadline restarted. Replay
+        # paths overwrite the timeline from the journal event afterwards,
+        # so replayed grants never double-stamp.
+        record.timeline.append({
+            "event": "lease", "wall": self.clock.wall(), "worker": worker,
+            "deadline_s": self.deadline_s})
         _LEASES_ACTIVE.set(len(self._leases))
         return lease
 
@@ -104,6 +112,10 @@ class LeaseTable:
                     f"{lease.worker}); redelivery budget "
                     f"{self.max_redeliveries} exhausted"
                 )
+                record.timeline.append({
+                    "event": "park", "wall": self.clock.wall(),
+                    "worker": lease.worker,
+                    "reason": "redelivery budget exhausted"})
                 _JOBS_FAILED.inc()
             else:
                 queue.requeue_front(record)

@@ -31,7 +31,7 @@ import dataclasses
 import logging
 import math
 import uuid
-from collections import deque
+from collections import OrderedDict, deque
 
 from .. import telemetry
 from .clock import CLOCK, HiveClock
@@ -69,10 +69,36 @@ _SHED = telemetry.counter(
     "watermark crossed; batch sheds first, interactive last)",
     ("class",),
 )
+# hive-side latency buckets: 5 ms (a poll already in flight) up to 10
+# minutes (a batch job parked behind a long compile) — the stage
+# histograms' DEFAULT_BUCKETS stop at 300 s, too short for queue waits
+HIVE_LATENCY_BUCKETS = telemetry.DEFAULT_BUCKETS + (600.0,)
+
 _QUEUE_WAIT = telemetry.histogram(
     "swarm_hive_queue_wait_seconds",
-    "Hive-side wait from job submission to dispatch to a worker"
+    "Hive-side wait from job submission to first dispatch to a worker, "
+    "by priority class",
+    ("class",),
+    buckets=HIVE_LATENCY_BUCKETS,
 )
+_DISPATCH_TO_SETTLE = telemetry.histogram(
+    "swarm_hive_dispatch_to_settle_seconds",
+    "Hive-side wait from the LAST dispatch of a job to its settled "
+    "result, by priority class (the queue-wait histogram's twin: "
+    "together they split a job's hive wall clock into waiting and "
+    "executing)",
+    ("class",),
+    buckets=HIVE_LATENCY_BUCKETS,
+)
+
+# shed submissions remembered for trace assembly (job id -> events): a
+# shed job has no record, but if the submitter retries the same id after
+# backoff the admitted record's timeline should show the shed attempts.
+# Both dimensions are bounded: distinct ids, AND events per id — a
+# client hammering one id against a saturated hive must not grow a
+# timeline that every later WAL event would then carry in full
+_SHED_TRACE_LIMIT = 256
+_SHED_EVENTS_PER_ID = 8
 
 
 def job_class(job: dict) -> str:
@@ -143,7 +169,14 @@ class JobRecord:
     result: dict | None = None  # spooled envelope (blob refs, not blobs)
     error: str | None = None
     done_at: float | None = None  # monotonic, stamped on result acceptance
+    dispatched_at: float | None = None  # monotonic, LAST dispatch instant
     retired: bool = False  # already counted against history_limit
+    # per-job trace timeline: ordered wall-stamped lifecycle events
+    # ({"event", "wall", ...detail}), appended at every mutation site and
+    # persisted verbatim with each journal event so a timeline survives
+    # crash recovery, compaction, and standby promotion exactly like the
+    # lease state it describes (GET /api/jobs/{id}/trace renders it)
+    timeline: list = dataclasses.field(default_factory=list)
     # lazy-deletion bookkeeping: a deque entry (token, record) is live
     # iff the record is queued AND the token matches (requeue_front /
     # discard_queued bump it, turning older entries into tombstones)
@@ -192,6 +225,9 @@ class PriorityJobQueue:
         self.records: dict[str, JobRecord] = {}
         self._finished: deque[str] = deque()
         self._next_seq = 0
+        # shed events for ids that were never admitted, folded into the
+        # record's timeline if the id is later admitted (bounded)
+        self.shed_traces: OrderedDict[str, list] = OrderedDict()
         self._refresh_gauges()
 
     def _refresh_gauges(self) -> None:
@@ -261,6 +297,9 @@ class PriorityJobQueue:
         sheds first, interactive only at the full depth limit (a full
         hive must shed load, not reorder it away)."""
         job = dict(job)
+        # noted BEFORE the id is filled in: only a submitter-chosen id
+        # can ever recur, so only those are worth a shed-trace slot
+        explicit_id = bool(job.get("id"))
         job_id = str(job.get("id") or uuid.uuid4().hex)
         job["id"] = job_id
         if job_id in self.records:
@@ -274,6 +313,11 @@ class PriorityJobQueue:
         if threshold and self.depth >= threshold:
             _REFUSED.inc()
             _SHED.inc(**{"class": cls})
+            if explicit_id:
+                # an anonymous shed submission's generated id can never
+                # recur; remembering it would only churn the bounded map
+                # and evict a correlatable client's shed history
+                self._note_shed(job_id, cls, threshold)
             raise QueueFull(
                 f"hive queue full for {cls} jobs ({self.depth} queued, "
                 f"limit {self.depth_limit}, {cls} sheds at {threshold}); "
@@ -287,11 +331,32 @@ class PriorityJobQueue:
             submitted_wall=self.clock.wall(),
             seq=self._next_seq,
         )
+        # shed attempts for this id (the submitter backed off and
+        # retried) lead the timeline — the backoff gap is real latency
+        # the trace must attribute
+        record.timeline = self.shed_traces.pop(job_id, [])
+        record.timeline.append({
+            "event": "admit", "wall": record.submitted_wall, "class": cls})
         self._next_seq += 1
         self.records[job_id] = record
         self._enqueue(record)
         _SUBMITTED.inc(**{"class": cls})
         return record
+
+    def _note_shed(self, job_id: str, cls: str, threshold: int) -> None:
+        """Remember a shed submission (trace assembly); only explicit
+        submitter-chosen ids can ever be correlated with a later retry."""
+        events = self.shed_traces.setdefault(job_id, [])
+        events.append({
+            "event": "shed", "wall": self.clock.wall(), "class": cls,
+            "depth": self.depth, "threshold": threshold})
+        if len(events) > _SHED_EVENTS_PER_ID:
+            # keep the FIRST shed (when the backoff began) and the most
+            # recent ones; the middle of a retry storm carries no signal
+            del events[1:len(events) - (_SHED_EVENTS_PER_ID - 1)]
+        self.shed_traces.move_to_end(job_id)
+        while len(self.shed_traces) > _SHED_TRACE_LIMIT:
+            self.shed_traces.popitem(last=False)
 
     def iter_queued(self):
         """Records in dispatch order: class rank, FIFO within class.
@@ -308,11 +373,26 @@ class PriorityJobQueue:
         record.worker = worker
         record.attempts += 1
         record.placement = outcome
+        record.dispatched_at = self.clock.mono()
         if record.queue_wait_s is None:
             record.queue_wait_s = round(
                 self.clock.mono() - record.submitted_at, 3)
-            _QUEUE_WAIT.observe(record.queue_wait_s)
+            _QUEUE_WAIT.observe(record.queue_wait_s,
+                                **{"class": record.job_class})
+        record.timeline.append({
+            "event": "dispatch", "wall": self.clock.wall(),
+            "worker": worker, "outcome": outcome,
+            "attempt": record.attempts})
         self._dequeued(record)
+
+    def observe_settle(self, record: JobRecord) -> None:
+        """Feed the dispatch-to-settle histogram (the queue-wait twin);
+        called once per settled result, never on replay."""
+        if record.dispatched_at is None or record.done_at is None:
+            return
+        _DISPATCH_TO_SETTLE.observe(
+            max(record.done_at - record.dispatched_at, 0.0),
+            **{"class": record.job_class})
 
     def requeue_front(self, record: JobRecord) -> None:
         """Put an expired-lease job back at the FRONT of its class: a
@@ -321,6 +401,9 @@ class PriorityJobQueue:
         the expired lessee's name — a LATE result from it is attributed
         correctly, and the next take() overwrites it anyway."""
         record.state = "queued"
+        record.timeline.append({
+            "event": "redeliver", "wall": self.clock.wall(),
+            "worker": record.worker, "attempt": record.attempts})
         self._enqueue(record, front=True)
 
     def retire(self, record: JobRecord) -> list[str]:
@@ -390,6 +473,10 @@ class PriorityJobQueue:
         record.worker = worker
         record.attempts = int(attempts)
         record.placement = placement
+        # re-anchored to NOW, matching the fresh deadline the restored
+        # lease gets — dispatch-to-settle for a recovered lease measures
+        # from the recovery, never from a dead process's offset
+        record.dispatched_at = self.clock.mono()
         if record.queue_wait_s is None:
             record.queue_wait_s = queue_wait_s
         self._dequeued(record)

@@ -109,8 +109,15 @@ class StandbyHive:
                 "CHIASWARM_HIVE_STANDBY_OF or the primary_uri argument)")
         self.poll_s = max(float(g("hive_replication_poll_s", 1.0)), 0.02)
         self.grace_s = max(float(g("hive_failover_grace_s", 10.0)), 0.0)
+        # replication-lag health: past this many seconds without an
+        # applied sync the standby's /healthz goes degraded (503) — a
+        # silently stalled standby must not look healthy right up until
+        # the failover it can no longer serve (0 disables)
+        self.lag_degraded_s = float(g("hive_replication_lag_degraded_s", 30.0))
         self.server = HiveServer(
             self.settings, host=host, port=port, standby=True)
+        # the standby's /healthz carries the replication view + verdict
+        self.server.extra_health = self.health
         # the primary's stream is authoritative from the first sync:
         # whatever a stale standby-side WAL replayed is discarded (a
         # standby restart full-resyncs rather than trusting old state)
@@ -118,7 +125,11 @@ class StandbyHive:
         self.promoted = False
         self.since = 0
         self.primary_epoch = 0
+        # the primary's stream tip as of the last successful fetch: the
+        # rs delta vs `since` is the apply backlog (0 when caught up)
+        self.primary_seq = 0
         self.last_sync_mono: float | None = None
+        self.started_mono = CLOCK.mono()
         self._first_failure: float | None = None
         self._session: aiohttp.ClientSession | None = None
         self._tasks: list[asyncio.Task] = []
@@ -216,6 +227,7 @@ class StandbyHive:
         # zeroed self.since above, so max() would behave identically —
         # this spells the contract out rather than relying on that.)
         seq = int(payload.get("seq", self.since))
+        self.primary_seq = seq
         self.since = seq if payload.get("reset") else max(self.since, seq)
         self.primary_epoch = max(
             self.primary_epoch, int(payload.get("epoch", 0)))
@@ -337,16 +349,39 @@ class StandbyHive:
         return srv
 
     def health(self) -> dict:
-        """Replication-side health (the server's own /healthz already
-        reports role + epoch; this adds the tail's view for tests and
-        tools)."""
-        lag = None
-        if self.last_sync_mono is not None:
-            lag = round(CLOCK.mono() - self.last_sync_mono, 2)
+        """Replication-side health, installed as the server's
+        ``extra_health`` so the standby's /healthz carries it: the
+        applied replication position vs the primary's stream tip (rs
+        delta = apply backlog) and the seconds since the last applied
+        sync. Past ``hive_replication_lag_degraded_s`` of stall the
+        standby reports itself degraded (503) — a silently stalled
+        standby must not look healthy until the failover that then finds
+        it hopelessly behind. A standby that has NEVER synced reports
+        ``last_sync_age_s: null`` (the stall clock still runs from
+        standby start, so it degrades on schedule — but nobody is told a
+        sync happened that never did)."""
+        never_synced = self.last_sync_mono is None
+        stalled_s = round(CLOCK.mono() - (
+            self.started_mono if never_synced else self.last_sync_mono), 2)
+        reasons: list[str] = []
+        if (not self.promoted and self.lag_degraded_s > 0
+                and stalled_s > self.lag_degraded_s):
+            reasons.append(
+                "replication stalled: "
+                + ("NO sync has ever been applied"
+                   if never_synced else
+                   f"last applied sync {stalled_s:.0f}s ago")
+                + f" (degraded past {self.lag_degraded_s:g}s; applied rs "
+                f"{self.since}, primary tip rs {self.primary_seq})")
         return {
-            "promoted": self.promoted,
-            "primary_uri": self.primary_uri,
-            "since": self.since,
-            "primary_epoch": self.primary_epoch,
-            "last_sync_age_s": lag,
+            "replication": {
+                "promoted": self.promoted,
+                "primary_uri": self.primary_uri,
+                "rs_applied": self.since,
+                "rs_primary_tip": self.primary_seq,
+                "rs_delta": max(self.primary_seq - self.since, 0),
+                "last_sync_age_s": None if never_synced else stalled_s,
+                "lag_degraded_s": self.lag_degraded_s,
+            },
+            "degraded_reasons": reasons,
         }

@@ -1,0 +1,226 @@
+"""Per-job trace assembly: one ordered, gap-attributed timeline.
+
+The raw material is collected elsewhere — the hive stamps wall-clock
+lifecycle events into ``JobRecord.timeline`` at every mutation site
+(admit/shed in queue.py, dispatch in ``take()``, lease grants in
+leases.py, redeliver/park in the reaper path, settle in app.py), the
+journal persists the timeline with every WAL event so it survives crash
+recovery, compaction, and standby promotion, and the worker's
+``trace_job`` stage spans ride back inside the result envelope's
+``pipeline_config.timings`` (with the wire trace context echoed under
+``pipeline_config.trace``). This module is the read side: it merges
+those sources into the one answer nobody could give before —
+"where did job X spend its 40 seconds?" — served at
+``GET /api/jobs/{id}/trace`` and asserted gap-free by the bench's
+trace_e2e row.
+
+The timeline contract:
+
+- events are ordered by their wall stamps (they are appended in order;
+  sorting is stable, so two events sharing an instant — dispatch and its
+  lease — keep their append order);
+- every inter-event gap is attributed (hive_queue, executing,
+  lease_lost, resubmit_backoff, ...) so the sum of gaps IS the job's
+  hive wall clock, with nothing hidden;
+- the final executing gap is broken down further with the worker's own
+  stage spans; whatever the spans do not cover (network hops, envelope
+  spooling, result upload) is reported honestly as ``unattributed_s``
+  rather than silently absorbed.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+# gap attribution between consecutive timeline events, keyed on
+# (from_event, to_event); pairs not listed fall back to "other"
+_GAP_LABELS = {
+    ("shed", "shed"): "resubmit_backoff",
+    ("shed", "admit"): "resubmit_backoff",
+    ("admit", "dispatch"): "hive_queue",
+    ("admit", "hold"): "hive_queue",
+    ("hold", "dispatch"): "affinity_hold",
+    ("redeliver", "hold"): "hive_queue",
+    ("redeliver", "dispatch"): "hive_queue",
+    ("dispatch", "lease"): "hive_grant",
+    ("lease", "settle"): "executing",
+    ("lease", "redeliver"): "lease_lost",
+    ("lease", "lease"): "lease_regrant",
+    ("lease", "park"): "lease_lost",
+    ("dispatch", "settle"): "executing",
+    ("admit", "park"): "unplaceable_wait",
+}
+
+def worker_stages(result: dict | None) -> list[dict]:
+    """The worker's stage spans from a settled envelope, in the order
+    the worker recorded them: ``pipeline_config.timings``'s ``*_s``
+    entries (insertion order is stage order — JSON preserves it)."""
+    if not isinstance(result, dict):
+        return []
+    cfg = result.get("pipeline_config")
+    if not isinstance(cfg, dict):
+        return []
+    timings = cfg.get("timings")
+    if not isinstance(timings, dict):
+        return []
+    stages = []
+    # every *_s timing is a stage — queue_wait_s included: the worker-
+    # side handoff wait is a real slice of the execution window
+    for key, value in timings.items():
+        if not isinstance(key, str) or not key.endswith("_s"):
+            continue
+        try:
+            stages.append({"stage": key[:-2], "seconds": float(value)})
+        except (TypeError, ValueError):
+            continue
+    return stages
+
+
+def wire_trace_context(record) -> dict:
+    """The trace context a /work reply carries into the worker: enough
+    for the worker to stamp its half of the trace into the envelope and
+    for the hive to attribute the returning spans to the right dispatch
+    attempt. Field set is pinned by the protocol-conformance suite."""
+    dispatched_wall = None
+    for entry in reversed(record.timeline):
+        if entry.get("event") == "dispatch":
+            dispatched_wall = entry.get("wall")
+            break
+    return {
+        "id": record.job_id,
+        "attempt": record.attempts,
+        "dispatched_wall": dispatched_wall,
+        "queue_wait_s": record.queue_wait_s,
+    }
+
+
+def envelope_trace(result: dict | None) -> dict:
+    """The worker-echoed trace context from a settled envelope."""
+    if isinstance(result, dict):
+        cfg = result.get("pipeline_config")
+        if isinstance(cfg, dict) and isinstance(cfg.get("trace"), dict):
+            return cfg["trace"]
+    return {}
+
+
+def build_trace(record, now_wall: float) -> dict[str, Any]:
+    """Assemble the ordered, gap-attributed trace payload for one job."""
+    raw = [dict(e) for e in record.timeline if isinstance(e, dict)]
+    events = sorted(raw, key=lambda e: float(e.get("wall", 0.0)))
+    # append order IS the causal order; a sort that actually changed it
+    # means the stored timeline was scrambled (replay bug, clock skew) —
+    # rendered sorted for display, but flagged so trace_missing (and the
+    # chaos no-reordering assertion) can see the repair instead of being
+    # silently satisfied by it
+    events_resorted = events != raw
+    t0 = float(events[0]["wall"]) if events else now_wall
+    for event in events:
+        event["t_s"] = round(float(event.get("wall", t0)) - t0, 3)
+
+    stages = worker_stages(record.result)
+    worker_total = round(sum(s["seconds"] for s in stages), 3)
+    echoed = envelope_trace(record.result)
+
+    gaps: list[dict] = []
+    for prev, nxt in zip(events, events[1:]):
+        seconds = round(float(nxt["wall"]) - float(prev["wall"]), 3)
+        gap = {
+            "from": prev.get("event"),
+            "to": nxt.get("event"),
+            "seconds": seconds,
+            "attribution": _GAP_LABELS.get(
+                (prev.get("event"), nxt.get("event")), "other"),
+        }
+        if gap["attribution"] == "executing" and stages:
+            # the worker's own spans carve the execution window up;
+            # the remainder is wire + spool + upload overhead, reported
+            # rather than absorbed
+            gap["worker_stages"] = stages
+            gap["worker_total_s"] = worker_total
+            gap["unattributed_s"] = round(max(seconds - worker_total, 0.0), 3)
+        gaps.append(gap)
+
+    terminal = events[-1].get("event") if events else None
+    open_ended = terminal not in ("settle", "park")
+    total_s = round(
+        (now_wall if open_ended else float(events[-1]["wall"])) - t0, 3)
+
+    payload: dict[str, Any] = {
+        "id": record.job_id,
+        "class": record.job_class,
+        "status": record.state,
+        "attempts": record.attempts,
+        "placement": record.placement,
+        "queue_wait_s": record.queue_wait_s,
+        "events": events,
+        "events_resorted": events_resorted,
+        "gaps": gaps,
+        "total_s": max(total_s, 0.0),
+        "open": open_ended,
+        "worker": {
+            "stages": stages,
+            "total_s": worker_total,
+            "trace": echoed,
+        },
+    }
+    return payload
+
+
+def build_shed_trace(job_id: str, shed_events: list[dict]) -> dict[str, Any]:
+    """Trace payload for an id that was shed but never admitted: the
+    refusals ARE its timeline, and the spans between them are the
+    submitter's backoff — reported with the same gap arithmetic an
+    admitted record gets, not flattened to zero."""
+    events = sorted((dict(e) for e in shed_events if isinstance(e, dict)),
+                    key=lambda e: float(e.get("wall", 0.0)))
+    t0 = float(events[0]["wall"]) if events else 0.0
+    for event in events:
+        event["t_s"] = round(float(event.get("wall", t0)) - t0, 3)
+    gaps = [{
+        "from": "shed", "to": "shed",
+        "seconds": round(float(nxt["wall"]) - float(prev["wall"]), 3),
+        "attribution": "resubmit_backoff",
+    } for prev, nxt in zip(events, events[1:])]
+    total_s = round(float(events[-1]["wall"]) - t0, 3) if events else 0.0
+    return {
+        "id": job_id, "status": "shed",
+        "events": events, "gaps": gaps,
+        "total_s": max(total_s, 0.0), "open": True,
+    }
+
+
+def trace_missing(payload: dict) -> list[str]:
+    """What a COMPLETE (settled, gap-free) trace is missing, empty when
+    nothing — the bench trace_e2e row and the durability tests assert
+    on this instead of re-deriving completeness ad hoc.
+
+    Complete means: admit, at least one dispatch with a placement
+    outcome, and a settle are all present; events are monotonically
+    ordered; the admit->dispatch queue wait is attributed; the worker's
+    stage spans came back through the envelope."""
+    missing: list[str] = []
+    events = payload.get("events") or []
+    kinds = [e.get("event") for e in events]
+    if "admit" not in kinds:
+        missing.append("no admit event")
+    if "dispatch" not in kinds:
+        missing.append("no dispatch event")
+    elif not any(e.get("event") == "dispatch" and e.get("outcome")
+                 for e in events):
+        missing.append("dispatch event lacks a placement outcome")
+    if "settle" not in kinds:
+        missing.append("no settle event")
+    if payload.get("events_resorted"):
+        # build_trace sorts for display, so the served walls are always
+        # monotone; the flag is the only witness that the STORED order
+        # disagreed with the wall stamps
+        missing.append("stored events were not monotonically ordered "
+                       "(resorted by wall for display)")
+    if payload.get("queue_wait_s") is None:
+        missing.append("no queue wait recorded")
+    gaps = payload.get("gaps") or []
+    if not any(g.get("attribution") == "hive_queue" for g in gaps):
+        missing.append("no attributed hive_queue gap")
+    if not (payload.get("worker") or {}).get("stages"):
+        missing.append("no worker stage spans in the envelope")
+    return missing

@@ -56,6 +56,11 @@ class Settings:
     safety_checker_model: str = "CompVis/stable-diffusion-safety-checker"
     # jax.profiler trace server port (0 = disabled)
     profiler_port: int = 0
+    # arm the on-demand profiler capture hook on the worker metrics app
+    # (POST /debug/profile?seconds=N writes a perfetto trace under
+    # $SDAAS_ROOT/profiles/); off by default — profiling is an operator
+    # action, not an always-on surface
+    profiler_capture: bool = False
     # serve Flux on single-chip slices by paging transformer blocks from
     # host RAM (the TPU analog of the reference's sequential CPU offload);
     # False restores the round-4 behavior of refusing with flux_min_chips
@@ -169,6 +174,10 @@ class Settings:
     # consecutive seconds of primary silence (no stream AND no /healthz
     # answer) before the standby promotes itself
     hive_failover_grace_s: float = 10.0
+    # seconds without an APPLIED replication sync before a standby's
+    # /healthz reports degraded (a silently stalled standby must be
+    # visible before failover needs it); 0 disables the check
+    hive_replication_lag_degraded_s: float = 30.0
     # worker side: consecutive transport errors on the pinned hive
     # endpoint before the client pins to the next one
     hive_failover_errors: int = 2
@@ -222,6 +231,9 @@ _ENV_OVERRIDES = {
     "CHIASWARM_HIVE_REPLICATION_POLL_S": "hive_replication_poll_s",
     "CHIASWARM_HIVE_FAILOVER_GRACE_S": "hive_failover_grace_s",
     "CHIASWARM_HIVE_FAILOVER_ERRORS": "hive_failover_errors",
+    "CHIASWARM_HIVE_REPLICATION_LAG_DEGRADED_S":
+        "hive_replication_lag_degraded_s",
+    "CHIASWARM_PROFILER_CAPTURE": "profiler_capture",
 }
 
 

@@ -375,11 +375,29 @@ def trace_job(job_id: str | None = None, timings: dict | None = None,
 # --- HTTP exposition -------------------------------------------------------
 
 
-def build_metrics_app(registry: Registry | None = None, health=None):
+# profiler captures may not stack and a runaway duration would pin the
+# trace machinery for the whole window — bound one capture hard
+PROFILE_MAX_SECONDS = 120.0
+
+
+def build_metrics_app(registry: Registry | None = None, health=None,
+                      profile=None, token: str = ""):
     """aiohttp app with GET /metrics (Prometheus text) and GET /healthz
     (JSON from the caller's `health()` snapshot; a payload carrying
     `status` != "ok" answers 503 so probes can act on it). aiohttp is
-    imported lazily — the registry itself must stay dependency-free."""
+    imported lazily — the registry itself must stay dependency-free.
+
+    `profile` (optional) is an async callable `(seconds) -> dict` wired
+    to POST /debug/profile?seconds=N — the worker passes its on-demand
+    jax.profiler capture (writes a perfetto trace under
+    $SDAAS_ROOT/profiles/). The callable raising PermissionError maps to
+    403 (the Settings.profiler_capture gate), RuntimeError to 409 (a
+    capture already running); no callable, no route. Unlike the two
+    read-only GETs, /debug/profile MUTATES (pins an executor thread,
+    writes prompt-exposing traces to disk), so when `token` is set it
+    requires the same bearer auth the hive APIs use — a worker whose
+    metrics_host is widened off loopback must not expose an anonymous
+    write endpoint (empty token = dev mode, matching the hive)."""
     from aiohttp import web
 
     reg = registry or REGISTRY
@@ -403,21 +421,50 @@ def build_metrics_app(registry: Registry | None = None, health=None):
         status = 200 if payload.get("status") == "ok" else 503
         return web.json_response(payload, status=status)
 
+    async def debug_profile(request):
+        if token and request.headers.get(
+                "Authorization", "") != f"Bearer {token}":
+            return web.json_response(
+                {"message": "unauthorized"}, status=401)
+        try:
+            seconds = float(request.query.get("seconds", "2"))
+        except ValueError:
+            return web.json_response(
+                {"message": "seconds must be a number"}, status=400)
+        if not 0 < seconds <= PROFILE_MAX_SECONDS:
+            return web.json_response(
+                {"message": f"seconds must be in (0, "
+                            f"{PROFILE_MAX_SECONDS:g}]"}, status=400)
+        try:
+            detail = await profile(seconds)
+        except PermissionError as e:
+            return web.json_response({"message": str(e)}, status=403)
+        except RuntimeError as e:
+            return web.json_response({"message": str(e)}, status=409)
+        except Exception as e:  # profiling must never kill the app
+            return web.json_response(
+                {"message": f"{type(e).__name__}: {e}"}, status=500)
+        return web.json_response({"status": "ok", **(detail or {})})
+
     app = web.Application()
     app.router.add_get("/metrics", metrics)
     app.router.add_get("/healthz", healthz)
+    if profile is not None:
+        app.router.add_post("/debug/profile", debug_profile)
     return app
 
 
 async def start_metrics_server(port: int, registry: Registry | None = None,
-                               health=None, host: str = "127.0.0.1"):
+                               health=None, host: str = "127.0.0.1",
+                               profile=None, token: str = ""):
     """Bind the telemetry app; returns the AppRunner (caller cleans up) or
     None when port is falsy (CHIASWARM_METRICS_PORT=0 opt-out)."""
     if not port:
         return None
     from aiohttp import web
 
-    runner = web.AppRunner(build_metrics_app(registry, health))
+    runner = web.AppRunner(
+        build_metrics_app(registry, health, profile, token))
     await runner.setup()
     await web.TCPSite(runner, host, int(port)).start()
     return runner

@@ -204,6 +204,7 @@ class Worker:
         self._probe_tasks: set[asyncio.Task] = set()
         self._delivering = 0  # entries popped from result_queue, not yet acked
         self._metrics_runner = None
+        self._profiling = False  # one on-demand profiler capture at a time
         # monotonic time of the last SUCCESSFUL hive poll (healthz age)
         self._last_poll_monotonic: float | None = None
         self._poll_backoff_s = float(POLL_SECONDS)
@@ -325,10 +326,51 @@ class Worker:
                 port,
                 health=self._health,
                 host=getattr(self.settings, "metrics_host", "127.0.0.1"),
+                profile=self._capture_profile,
+                # the profile hook mutates; it requires the same bearer
+                # token the worker itself is provisioned with
+                token=str(getattr(self.settings, "sdaas_token", "")),
             )
             logger.info("metrics server on :%d", port)
         except Exception as e:  # observability is an add-on, never fatal
             logger.warning("metrics server unavailable: %s", e)
+
+    async def _capture_profile(self, seconds: float) -> dict:
+        """On-demand jax.profiler capture (POST /debug/profile?seconds=N
+        on the metrics app): traces this process for `seconds` and writes
+        a perfetto/TensorBoard trace bundle under $SDAAS_ROOT/profiles/.
+        Gated by Settings.profiler_capture (off by default — a profile
+        exposes prompts and timings, so arming it is an operator
+        decision), and serialized: jax keeps one global tracer, so a
+        second concurrent capture answers 409 instead of corrupting the
+        first."""
+        if not bool(getattr(self.settings, "profiler_capture", False)):
+            raise PermissionError(
+                "profiler capture is disabled; set profiler_capture=true "
+                "(CHIASWARM_PROFILER_CAPTURE=1) to arm it")
+        if self._profiling:
+            raise RuntimeError("a profiler capture is already running")
+        import jax.profiler
+
+        # nanosecond suffix: two captures starting in the same wall-clock
+        # second must not interleave their bundles in one directory
+        out_dir = resolve_path("profiles") / (
+            time.strftime("trace_%Y%m%d_%H%M%S")
+            + f"_{time.time_ns() % 1_000_000_000:09d}")
+        self._profiling = True
+        try:
+            def run() -> None:
+                with jax.profiler.trace(str(out_dir)):
+                    time.sleep(seconds)
+
+            # off-loop: the capture sleeps for the whole window and the
+            # metrics app must keep answering scrapes meanwhile
+            await asyncio.get_running_loop().run_in_executor(None, run)
+        finally:
+            self._profiling = False
+        logger.warning("profiler capture (%.1fs) written under %s",
+                       seconds, out_dir)
+        return {"path": str(out_dir), "seconds": seconds}
 
     def _health(self) -> dict:
         """/healthz snapshot: is this worker polling, what is resident,
@@ -500,6 +542,13 @@ class Worker:
                         # queue_wait stage starts here; the slice worker
                         # pops the stamp when it picks the job up
                         job["_telemetry_enqueued"] = time.monotonic()
+                        # hive-stamped trace context (hive_server wire
+                        # contract): note the receipt instant so the
+                        # settled timeline can place the worker handoff;
+                        # a legacy hive sends none and nothing is added
+                        if isinstance(job.get("trace"), dict):
+                            job["trace"].setdefault(
+                                "received_wall", round(time.time(), 3))
                         await self.batcher.put(job)
                     sleep_seconds = POLL_SECONDS
                 except asyncio.TimeoutError:
@@ -544,10 +593,18 @@ class Worker:
             # queue_wait: hive handoff -> a slice actually starting the work
             picked_up = time.monotonic()
             queue_wait = {}
+            traces = {}
             for job in batch:
                 enqueued = job.pop("_telemetry_enqueued", None)
                 if enqueued is not None and "id" in job:
                     queue_wait[job["id"]] = picked_up - enqueued
+                # hive trace context comes OFF the job before formatting
+                # and rides the envelope back (pipeline_config.trace) so
+                # the hive attaches this worker's stage spans to the
+                # right dispatch attempt
+                trace = job.pop("trace", None)
+                if isinstance(trace, dict) and "id" in job:
+                    traces[job["id"]] = trace
             self._update_queue_gauges()
             try:
                 prepared = []
@@ -560,14 +617,16 @@ class Worker:
                 if len(prepared) > 1 and self._batchable(prepared):
                     results = await self.do_batched_work(chipset, prepared)
                     for result in results:
-                        self._finish_result(result, queue_wait, outcome)
+                        self._finish_result(
+                            result, queue_wait, outcome, traces)
                         await self._enqueue_result(result)
                 else:
                     for worker_function, kwargs in prepared:
                         result = await self.do_work(
                             chipset, worker_function, kwargs
                         )
-                        self._finish_result(result, queue_wait, outcome)
+                        self._finish_result(
+                            result, queue_wait, outcome, traces)
                         await self._enqueue_result(result)
             except Exception as e:
                 logger.exception("slice_worker error")
@@ -580,7 +639,8 @@ class Worker:
 
     @staticmethod
     def _finish_result(result: dict, queue_wait: dict,
-                       placement: str | None = None) -> None:
+                       placement: str | None = None,
+                       traces: dict | None = None) -> None:
         """Stamp worker-side stage timings (and the placement outcome that
         routed the work item to its slice) into the envelope and count the
         job by outcome — ONE place, so solo, coalesced, and fallback paths
@@ -588,6 +648,11 @@ class Worker:
         cfg = result.setdefault("pipeline_config", {})
         if placement is not None:
             cfg["placement"] = placement
+        trace = (traces or {}).get(result.get("id"))
+        if isinstance(trace, dict):
+            # echo the hive's trace context (attempt, dispatch instant,
+            # plus our receipt instant) back through the envelope
+            cfg["trace"] = trace
         timings = cfg.setdefault("timings", {})
         wait = queue_wait.get(result.get("id"))
         if wait is not None:

@@ -11,6 +11,7 @@ from __future__ import annotations
 
 import asyncio
 import json
+import time
 
 from aiohttp import web
 
@@ -50,6 +51,10 @@ class FakeHive:
         # X-Hive-Epoch values workers echoed on /work and /results
         self.seen_epochs: list[str] = []
         self.result_attempts: int = 0
+        # dispatches per job id, for the wire trace context (the real
+        # hive stamps one on every handed job; the conformance suite
+        # pins the field set so this fake cannot drift)
+        self.dispatch_attempts: dict[str, int] = {}
         self._runner: web.AppRunner | None = None
         self.port: int | None = None
 
@@ -136,7 +141,20 @@ class FakeHive:
         if self.refuse_with is not None:
             return web.json_response({"message": self.refuse_with}, status=400)
         jobs, self.pending_jobs = self.pending_jobs, []
-        return web.json_response({"jobs": jobs},
+        # wire trace context parity with hive_server/app.py: every
+        # handed job carries {id, attempt, dispatched_wall, queue_wait_s}
+        handed = []
+        for job in jobs:
+            job_id = str(job.get("id", ""))
+            attempt = self.dispatch_attempts.get(job_id, 0) + 1
+            self.dispatch_attempts[job_id] = attempt
+            handed.append(dict(job, trace={
+                "id": job_id,
+                "attempt": attempt,
+                "dispatched_wall": round(time.time(), 3),
+                "queue_wait_s": 0.0,
+            }))
+        return web.json_response({"jobs": handed},
                                  headers=self._epoch_headers())
 
     async def _results(self, request: web.Request) -> web.Response:

@@ -538,3 +538,35 @@ def test_unbatchable_interactive_job_also_preempts():
         assert _FLUSHES.value(reason="preempt") == before + 1
 
     run(scenario())
+
+
+def test_flush_stamps_linger_split_into_trace_context():
+    """ISSUE 8: a coalesced job's trace context gains the linger split
+    (lingered_s + coalesced_with), so the end-to-end timeline can tell
+    waiting-for-batchmates apart from waiting-for-a-slice; jobs without
+    a hive trace context (legacy hives) are untouched."""
+    import asyncio
+
+    from chiaswarm_tpu.batching import BatchScheduler
+
+    def tiny(job_id, with_trace=True):
+        job = {"id": job_id, "workflow": "txt2img",
+               "model_name": "stabilityai/stable-diffusion-2-1",
+               "prompt": job_id, "height": 64, "width": 64,
+               "parameters": {"test_tiny_model": True}}
+        if with_trace:
+            job["trace"] = {"id": job_id, "attempt": 1}
+        return job
+
+    async def scenario():
+        sched = BatchScheduler(linger_s=10.0, max_coalesce=2)
+        await sched.put(tiny("t-1"))
+        await sched.put(tiny("t-2", with_trace=False))  # size flush at 2
+        group = await sched.get()
+        assert [j["id"] for j in group] == ["t-1", "t-2"]
+        trace = group[0]["trace"]
+        assert trace["lingered_s"] >= 0.0
+        assert trace["coalesced_with"] == 1
+        assert "trace" not in group[1]
+
+    asyncio.run(scenario())

@@ -80,6 +80,14 @@ def test_bench_cpu_fallback_produces_labeled_smoke_row():
     assert out.get("hive_e2e_queue_wait_p95_s") >= \
         out["hive_e2e_queue_wait_p50_s"], out
 
+    # end-to-end tracing row (ISSUE 8): every settled job in the
+    # hive_e2e scenario must carry a COMPLETE gap-free timeline —
+    # admit/dispatch(placement)/settle events, an attributed queue-wait
+    # gap, and the worker's stage spans merged from the envelope
+    assert out.get("trace_e2e_jobs", 0) >= 1, out
+    assert out.get("trace_e2e_complete") == out["trace_e2e_jobs"], out
+    assert out.get("trace_e2e_incomplete") == [], out
+
     # hive durability row (ISSUE 6): a SIGKILL'd hive restarted over the
     # same $SDAAS_ROOT must recover every queued + leased job from the
     # WAL — zero lost is the acceptance bar, not a target

@@ -270,6 +270,26 @@ def test_unknown_query_params_are_ignored(backend_name):
     run_conformance(backend_name, scenario)
 
 
+def test_work_reply_carries_trace_context(backend_name):
+    """ISSUE 8: every handed job carries its trace context on the wire —
+    {id, attempt, dispatched_wall, queue_wait_s} under the `trace` key —
+    so the worker can echo it back inside the envelope and the hive can
+    attribute the returning stage spans to the right dispatch attempt.
+    Pinned across all three backends so fake_hive cannot drift."""
+
+    async def scenario(backend, client):
+        backend.queue_job(echo_job("conf-trace"))
+        [job] = await client.ask_for_work(dict(CAPS))
+        trace = job["trace"]
+        assert isinstance(trace, dict)
+        assert trace["id"] == "conf-trace"
+        assert isinstance(trace["attempt"], int) and trace["attempt"] >= 1
+        assert isinstance(trace["dispatched_wall"], (int, float))
+        assert isinstance(trace["queue_wait_s"], (int, float))
+
+    run_conformance(backend_name, scenario)
+
+
 def test_work_query_carries_placement_signal(backend_name):
     """Satellite: the /work poll itself carries the dispatcher's
     placement inputs — worker identity, chip capabilities, resident

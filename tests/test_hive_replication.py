@@ -422,3 +422,54 @@ def test_replication_stream_requires_wal(sdaas_root):
         await primary.stop()
 
     asyncio.run(scenario())
+
+
+def test_standby_healthz_reports_replication_lag_and_degrades(sdaas_root):
+    """ISSUE 8 satellite: a standby's /healthz carries the replication
+    view (applied rs vs the primary's stream tip + seconds since the
+    last applied sync) and goes degraded (503) once the stream stalls
+    past hive_replication_lag_degraded_s — a silently stalled standby
+    must be visible BEFORE a failover discovers it is hopelessly
+    behind."""
+
+    async def scenario():
+        base = _settings()
+        primary = await HiveServer(base, port=0).start()
+        standby = StandbyHive(
+            _standby_settings(base, hive_replication_lag_degraded_s=0.2),
+            primary_uri=primary.uri, port=0)
+        await standby.server.start()
+        try:
+            async with aiohttp.ClientSession() as session:
+                await _submit(session, primary, _echo("lag-1"))
+                await standby.sync_once()
+                async with session.get(f"{standby.server.uri}/healthz",
+                                       headers=_headers()) as r:
+                    assert r.status == 200
+                    health = await r.json()
+                rep = health["replication"]
+                assert rep["promoted"] is False
+                assert rep["rs_applied"] >= 1
+                assert rep["rs_delta"] == 0
+                assert rep["last_sync_age_s"] is not None
+
+                # the primary goes dark; past the threshold the standby
+                # reports itself degraded with the stall named
+                await primary.stop()
+                await asyncio.sleep(0.3)
+                async with session.get(f"{standby.server.uri}/healthz",
+                                       headers=_headers()) as r:
+                    assert r.status == 503
+                    health = await r.json()
+                assert any("replication stalled" in reason
+                           for reason in health["degraded_reasons"])
+
+                # promotion clears the verdict: a primary is not lagging
+                await standby.promote()
+                async with session.get(f"{standby.server.uri}/healthz",
+                                       headers=_headers()) as r:
+                    assert r.status == 200
+        finally:
+            await standby.stop()
+
+    asyncio.run(scenario())

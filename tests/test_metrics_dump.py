@@ -66,3 +66,65 @@ def test_inprocess_smoke_job_prints_stage_table(sdaas_root, capsys):
     assert rc == 0
     for stage in ("compile", "denoise", "decode", "text_encode"):
         assert stage in out, out
+
+
+HIVE_SYNTHETIC = """\
+# TYPE swarm_hive_dispatch_total counter
+swarm_hive_dispatch_total{outcome="affinity"} 6
+swarm_hive_dispatch_total{outcome="cold"} 2
+swarm_hive_dispatch_total{outcome="hold"} 1
+# TYPE swarm_hive_jobs_submitted_total counter
+swarm_hive_jobs_submitted_total{class="default"} 7
+swarm_hive_jobs_submitted_total{class="batch"} 3
+# TYPE swarm_hive_shed_total counter
+swarm_hive_shed_total{class="batch"} 2
+# TYPE swarm_hive_queue_depth gauge
+swarm_hive_queue_depth{class="default"} 1
+swarm_hive_queue_depth{class="batch"} 0
+swarm_hive_queue_depth{class="interactive"} 0
+# TYPE swarm_hive_leases_active gauge
+swarm_hive_leases_active 2
+# TYPE swarm_hive_leases_expired_total counter
+swarm_hive_leases_expired_total 1
+# TYPE swarm_hive_results_total counter
+swarm_hive_results_total{status="ok"} 5
+swarm_hive_results_total{status="duplicate"} 1
+# TYPE swarm_hive_queue_wait_seconds histogram
+swarm_hive_queue_wait_seconds_bucket{class="default",le="0.1"} 3
+swarm_hive_queue_wait_seconds_bucket{class="default",le="1"} 6
+swarm_hive_queue_wait_seconds_bucket{class="default",le="+Inf"} 6
+swarm_hive_queue_wait_seconds_sum{class="default"} 2.0
+swarm_hive_queue_wait_seconds_count{class="default"} 6
+# TYPE swarm_hive_dispatch_to_settle_seconds histogram
+swarm_hive_dispatch_to_settle_seconds_bucket{class="default",le="5"} 5
+swarm_hive_dispatch_to_settle_seconds_bucket{class="default",le="+Inf"} 5
+swarm_hive_dispatch_to_settle_seconds_sum{class="default"} 9.0
+swarm_hive_dispatch_to_settle_seconds_count{class="default"} 5
+"""
+
+
+def test_hive_tables_from_synthetic_text():
+    """--hive satellite (ISSUE 8): the hive-side dispatch/shed/lease
+    tables render from exposition text alone — the same shape a live
+    scrape produces."""
+    tool = _load_tool()
+    summary = tool.hive_summary(tool.parse_metrics(HIVE_SYNTHETIC))
+    assert summary["dispatch"] == {"affinity": 6, "cold": 2, "hold": 1}
+    assert summary["submitted"] == {"batch": 3, "default": 7}
+    assert summary["shed"] == {"batch": 2}
+    assert summary["leases_active"] == 2
+    assert summary["leases_expired"] == 1
+    assert summary["results"] == {"duplicate": 1, "ok": 5}
+    [qw] = summary["queue_wait"]
+    assert qw["class"] == "default" and qw["count"] == 6
+    assert qw["p50_le_s"] == 0.1  # cumulative 3/6 crosses at le=0.1
+    [d2s] = summary["dispatch_to_settle"]
+    assert d2s["p50_le_s"] == 5.0
+
+    table = tool.render_hive_tables(summary)
+    assert "affinity" in table and "6" in table
+    assert "hive admission by class" in table
+    assert "batch" in table and "shed" not in summary["dispatch"]
+    assert "hive queue wait" in table
+    assert "hive dispatch->settle" in table
+    assert "p50<=0.100" in table

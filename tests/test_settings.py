@@ -142,3 +142,16 @@ def test_observability_knobs(sdaas_root, monkeypatch):
     assert s.metrics_port == 0  # opt-out disables the HTTP server
     assert s.metrics_host == "0.0.0.0"
     assert s.log_format == "json"
+
+
+def test_tracing_and_profiler_knobs(sdaas_root, monkeypatch):
+    s = load_settings()
+    assert s.profiler_capture is False  # arming a profile is opt-in
+    assert s.hive_replication_lag_degraded_s == 30.0
+    monkeypatch.setenv("CHIASWARM_PROFILER_CAPTURE", "1")
+    monkeypatch.setenv("CHIASWARM_HIVE_REPLICATION_LAG_DEGRADED_S", "5.5")
+    s = load_settings()
+    assert s.profiler_capture is True
+    assert s.hive_replication_lag_degraded_s == 5.5
+    monkeypatch.setenv("CHIASWARM_PROFILER_CAPTURE", "false")
+    assert load_settings().profiler_capture is False

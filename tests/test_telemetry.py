@@ -279,3 +279,135 @@ def test_setup_logging_json_format(tmp_path):
             if h not in before:
                 root.removeHandler(h)
                 h.close()
+
+
+# --- on-demand profiler capture hook (ISSUE 8) ---
+
+
+def test_debug_profile_route_gating_and_capture():
+    """POST /debug/profile: absent without a callback, 403 while the
+    Settings gate is closed (PermissionError), 409 while a capture runs
+    (RuntimeError), 200 + detail when the capture callback succeeds,
+    and 400 for nonsense durations."""
+    from aiohttp.test_utils import TestClient, TestServer
+
+    async def scenario():
+        # no callback -> no route
+        app = build_metrics_app(Registry())
+        client = TestClient(TestServer(app))
+        await client.start_server()
+        try:
+            assert (await client.post("/debug/profile")).status == 404
+        finally:
+            await client.close()
+
+        calls = []
+
+        async def capture(seconds):
+            calls.append(seconds)
+            return {"path": "/tmp/profiles/trace_x", "seconds": seconds}
+
+        app = build_metrics_app(Registry(), profile=capture)
+        client = TestClient(TestServer(app))
+        await client.start_server()
+        try:
+            resp = await client.post("/debug/profile?seconds=0.5")
+            assert resp.status == 200
+            payload = await resp.json()
+            assert payload["status"] == "ok"
+            assert payload["path"].endswith("trace_x")
+            assert calls == [0.5]
+
+            assert (await client.post(
+                "/debug/profile?seconds=nope")).status == 400
+            assert (await client.post(
+                "/debug/profile?seconds=0")).status == 400
+            assert (await client.post(
+                "/debug/profile?seconds=1e9")).status == 400
+        finally:
+            await client.close()
+
+        async def gated(seconds):
+            raise PermissionError("profiler capture is disabled")
+
+        app = build_metrics_app(Registry(), profile=gated)
+        client = TestClient(TestServer(app))
+        await client.start_server()
+        try:
+            resp = await client.post("/debug/profile")
+            assert resp.status == 403
+            assert "disabled" in (await resp.json())["message"]
+        finally:
+            await client.close()
+
+        async def busy(seconds):
+            raise RuntimeError("a profiler capture is already running")
+
+        app = build_metrics_app(Registry(), profile=busy)
+        client = TestClient(TestServer(app))
+        await client.start_server()
+        try:
+            assert (await client.post("/debug/profile")).status == 409
+        finally:
+            await client.close()
+
+        # /debug/profile MUTATES, so unlike the read-only GETs it
+        # honors the worker's bearer token when one is configured
+        app = build_metrics_app(Registry(), profile=capture, token="tok")
+        client = TestClient(TestServer(app))
+        await client.start_server()
+        try:
+            assert (await client.post("/debug/profile")).status == 401
+            resp = await client.post(
+                "/debug/profile?seconds=0.1",
+                headers={"Authorization": "Bearer tok"})
+            assert resp.status == 200
+            # the GETs stay unauthenticated (scrape contract unchanged)
+            assert (await client.get("/metrics")).status == 200
+        finally:
+            await client.close()
+
+    asyncio.run(scenario())
+
+
+def test_worker_capture_profile_knob_and_output(sdaas_root, monkeypatch):
+    """The worker's capture callback: PermissionError while the
+    profiler_capture knob is off; with it on, the (stubbed) jax.profiler
+    trace context runs for the requested window and the reply names the
+    output directory under $SDAAS_ROOT/profiles/."""
+    import contextlib
+
+    import jax.profiler
+
+    from chiaswarm_tpu.chips.allocator import SliceAllocator
+    from chiaswarm_tpu.settings import Settings
+    from chiaswarm_tpu.worker import Worker
+
+    traced_dirs = []
+
+    @contextlib.contextmanager
+    def fake_trace(path):
+        traced_dirs.append(path)
+        yield
+
+    monkeypatch.setattr(jax.profiler, "trace", fake_trace)
+
+    async def scenario():
+        worker = Worker(
+            settings=Settings(sdaas_token="t", metrics_port=0),
+            allocator=SliceAllocator(chips_per_job=0),
+            hive_uri="http://127.0.0.1:1/api")
+        with pytest.raises(PermissionError):
+            await worker._capture_profile(0.01)
+        assert traced_dirs == []
+
+        worker.settings = Settings(
+            sdaas_token="t", metrics_port=0, profiler_capture=True)
+        detail = await worker._capture_profile(0.01)
+        assert detail["seconds"] == 0.01
+        [path] = traced_dirs
+        assert "/profiles/" in f"{path}/"
+        assert detail["path"] == str(path)
+        await worker.hive.close()
+
+    asyncio.run(scenario())

@@ -858,3 +858,21 @@ def test_compatible_img2img_jobs_coalesce_into_one_batch(sdaas_root):
         blobs.append(blob)
     # distinct seeds/prompts -> distinct images (no cross-row leakage)
     assert len(set(blobs)) == 3
+
+
+def test_envelope_echoes_hive_trace_context(sdaas_root):
+    """ISSUE 8: the /work reply's trace context (stamped by the hive;
+    the fake stamps the same field set, pinned by the conformance
+    suite) rides back inside pipeline_config.trace — with the worker's
+    receipt instant added — so the hive can merge this worker's stage
+    spans into the job's timeline at the right dispatch attempt."""
+    hive, results = run_jobs([echo_job("traced-1")], sdaas_root)
+    [result] = results
+    trace = result["pipeline_config"]["trace"]
+    assert trace["id"] == "traced-1"
+    assert trace["attempt"] == 1
+    assert isinstance(trace["dispatched_wall"], float)
+    assert isinstance(trace["received_wall"], float)
+    assert trace["received_wall"] >= trace["dispatched_wall"] - 1.0
+    # stage timings still ride next to it
+    assert "queue_wait_s" in result["pipeline_config"]["timings"]

@@ -380,6 +380,24 @@ async def scenario_hive_crash_recovery() -> str:
                    "leased job not completed by the takeover worker")
             _check(finals[leased_id]["attempts"] >= 2,
                    "redelivery attempt not recorded across the restart")
+
+            # ISSUE 8: the redelivered job answers with ONE complete
+            # timeline spanning the SIGKILL — both dispatch attempts,
+            # the redelivery, the settle, nothing duplicated
+            from chiaswarm_tpu.hive_server.trace import trace_missing
+
+            async with session.get(f"{uri}/api/jobs/{leased_id}/trace",
+                                   headers=headers) as r:
+                _check(r.status == 200,
+                       f"trace endpoint answered {r.status}")
+                trace = await r.json()
+            missing = trace_missing(trace)
+            _check(not missing,
+                   f"timeline incomplete across SIGKILL: {missing}")
+            kinds = [e["event"] for e in trace["events"]]
+            _check(kinds.count("redeliver") == 1
+                   and kinds.count("settle") == 1,
+                   f"timeline duplicated/lost events: {kinds}")
     finally:
         if w is not None:
             w.stop()

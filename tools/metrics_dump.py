@@ -1,11 +1,17 @@
 #!/usr/bin/env python
 """Fetch a worker's /metrics and print the per-stage latency table.
 
-Two modes:
+Three modes (combinable):
 
   python tools/metrics_dump.py --url http://127.0.0.1:8061
       Scrape a LIVE worker's telemetry endpoint (Settings.metrics_port /
       CHIASWARM_METRICS_PORT) and print its stage breakdown + health.
+
+  python tools/metrics_dump.py --hive http://127.0.0.1:9511
+      Scrape a LIVE hive coordinator and print its dispatch-outcome,
+      shed/admission, and lease/result tables plus per-class
+      queue-wait / dispatch-to-settle quantiles — the hive half of the
+      same picture, renderable next to the worker stage table.
 
   python tools/metrics_dump.py
       No worker required: run one hermetic tiny-model txt2img smoke job
@@ -14,9 +20,9 @@ Two modes:
       process-local registry. Uses the ambient JAX backend (set
       JAX_PLATFORMS=cpu to keep it off a TPU relay).
 
-The table is computed from the `swarm_job_stage_seconds` histogram series
-(count / mean / approx p50 / p90 from the cumulative buckets), so what it
-prints is exactly what a Prometheus scrape would see.
+The tables are computed from the histogram/counter series (count / mean /
+approx p50 / p90 from the cumulative buckets), so what it prints is
+exactly what a Prometheus scrape would see.
 """
 
 from __future__ import annotations
@@ -110,17 +116,19 @@ def stage_rows(samples: list[tuple[str, dict, float]]) -> list[dict]:
     return rows
 
 
+def _fmt_seconds(v) -> str:
+    if v is None:
+        return "-"
+    if v == float("inf"):
+        return "+Inf"
+    return f"{v:.3f}"
+
+
 def render_table(rows: list[dict]) -> str:
     if not rows:
         return "(no job stages recorded yet — has a job run?)"
 
-    def fmt(v):
-        if v is None:
-            return "-"
-        if v == float("inf"):
-            return "+Inf"
-        return f"{v:.3f}"
-
+    fmt = _fmt_seconds
     header = f"{'stage':<14} {'count':>6} {'mean_s':>9} " \
              f"{'p50<=s':>9} {'p90<=s':>9} {'total_s':>9}"
     lines = [header, "-" * len(header)]
@@ -136,6 +144,108 @@ def render_table(rows: list[dict]) -> str:
 def fetch(url: str, path: str) -> str:
     with urllib.request.urlopen(f"{url.rstrip('/')}{path}", timeout=10) as r:
         return r.read().decode("utf-8")
+
+
+# --- hive-side tables (--hive) ---------------------------------------------
+
+HIVE_CLASSES = ("interactive", "default", "batch")
+
+
+def _label_counts(samples, name: str, label: str) -> dict[str, float]:
+    return {labels[label]: value for metric, labels, value in samples
+            if metric == name and label in labels}
+
+
+def _class_quantiles(samples, name: str) -> list[dict]:
+    """Per-class p50/p95 rows from a {class}-labeled hive histogram."""
+    rows = []
+    for cls in HIVE_CLASSES:
+        buckets, count = [], 0.0
+        for metric, labels, value in samples:
+            if labels.get("class") != cls:
+                continue
+            if metric == f"{name}_bucket":
+                le = labels.get("le", "+Inf")
+                buckets.append(
+                    (float("inf") if le == "+Inf" else float(le), value))
+            elif metric == f"{name}_count":
+                count = value
+        if count:
+            rows.append({
+                "class": cls, "count": int(count),
+                "p50_le_s": _quantile_from_buckets(buckets, count, 0.5),
+                "p95_le_s": _quantile_from_buckets(buckets, count, 0.95),
+            })
+    return rows
+
+
+def hive_summary(samples) -> dict:
+    """Exposition samples -> the hive-side dispatch/shed/lease view."""
+    return {
+        "dispatch": {k: int(v) for k, v in sorted(_label_counts(
+            samples, "swarm_hive_dispatch_total", "outcome").items())},
+        "submitted": {k: int(v) for k, v in sorted(_label_counts(
+            samples, "swarm_hive_jobs_submitted_total", "class").items())},
+        "shed": {k: int(v) for k, v in sorted(_label_counts(
+            samples, "swarm_hive_shed_total", "class").items())},
+        "queue_depth": {k: int(v) for k, v in sorted(_label_counts(
+            samples, "swarm_hive_queue_depth", "class").items())},
+        "results": {k: int(v) for k, v in sorted(_label_counts(
+            samples, "swarm_hive_results_total", "status").items())},
+        "leases_active": next(
+            (int(v) for m, _, v in samples
+             if m == "swarm_hive_leases_active"), 0),
+        "leases_expired": next(
+            (int(v) for m, _, v in samples
+             if m == "swarm_hive_leases_expired_total"), 0),
+        "jobs_failed": next(
+            (int(v) for m, _, v in samples
+             if m == "swarm_hive_jobs_failed_total"), 0),
+        "queue_wait": _class_quantiles(
+            samples, "swarm_hive_queue_wait_seconds"),
+        "dispatch_to_settle": _class_quantiles(
+            samples, "swarm_hive_dispatch_to_settle_seconds"),
+    }
+
+
+def render_hive_tables(summary: dict) -> str:
+    fmt = _fmt_seconds
+    lines = ["hive dispatch outcomes"]
+    if summary["dispatch"]:
+        for outcome, n in summary["dispatch"].items():
+            lines.append(f"  {outcome:<10} {n:>8}")
+    else:
+        lines.append("  (no dispatches yet)")
+
+    lines.append("hive admission by class "
+                 "(queued now / admitted / shed 429)")
+    classes = sorted(set(summary["submitted"]) | set(summary["shed"])
+                     | set(summary["queue_depth"]))
+    for cls in classes or ["-"]:
+        lines.append(
+            f"  {cls:<12} {summary['queue_depth'].get(cls, 0):>6} "
+            f"{summary['submitted'].get(cls, 0):>9} "
+            f"{summary['shed'].get(cls, 0):>6}")
+
+    lines.append(
+        f"hive leases   active={summary['leases_active']} "
+        f"expired={summary['leases_expired']} "
+        f"failed={summary['jobs_failed']}")
+    if summary["results"]:
+        lines.append("hive results  " + " ".join(
+            f"{s}={n}" for s, n in summary["results"].items()))
+
+    for key, title in (("queue_wait", "hive queue wait"),
+                       ("dispatch_to_settle", "hive dispatch->settle")):
+        rows = summary[key]
+        if not rows:
+            continue
+        lines.append(f"{title} (per class)")
+        for r in rows:
+            lines.append(
+                f"  {r['class']:<12} n={r['count']:<6} "
+                f"p50<={fmt(r['p50_le_s'])} p95<={fmt(r['p95_le_s'])}")
+    return "\n".join(lines)
 
 
 async def _run_smoke_job() -> None:
@@ -183,9 +293,23 @@ def main(argv: list[str] | None = None) -> int:
         help="live worker telemetry base URL (e.g. http://127.0.0.1:8061); "
              "omit to run one in-process smoke job instead")
     parser.add_argument(
+        "--hive", default=None,
+        help="live hive base URL (e.g. http://127.0.0.1:9511): also print "
+             "the hive-side dispatch/shed/lease tables")
+    parser.add_argument(
         "--raw", action="store_true",
         help="also dump the raw /metrics exposition text")
     args = parser.parse_args(argv)
+
+    if args.hive:
+        hive_text = fetch(args.hive, "/metrics")
+        if args.raw:
+            print(hive_text)
+        print(render_hive_tables(hive_summary(parse_metrics(hive_text))))
+        print()
+        if not args.url:
+            # hive-only mode: no worker scrape, no in-process smoke job
+            return 0
 
     if args.url:
         text = fetch(args.url, "/metrics")

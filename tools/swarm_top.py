@@ -1,0 +1,367 @@
+#!/usr/bin/env python
+"""swarm_top: a live fleet console for one hive + N workers.
+
+Scrapes the hive's and each worker's `/metrics` (Prometheus text) and
+`/healthz` (JSON) — nothing else, no jax, no chiaswarm imports — and
+renders one refreshing frame answering the operator's standing
+questions: how deep is the queue by class, where is every slice and
+what is warm on it, how are dispatch outcomes and shedding trending,
+is the outbox/WAL backing up, and what do stage latencies look like
+RIGHT NOW (p50/p95 over the delta between refreshes, not over the
+process's whole life).
+
+  python tools/swarm_top.py --hive http://127.0.0.1:9511 \
+      --worker http://127.0.0.1:8061 --worker http://10.0.0.2:8061
+
+  python tools/swarm_top.py --hive http://127.0.0.1:9511 --once
+      One snapshot (cumulative quantiles), no screen control — the
+      contract-test / scripting mode.
+
+Counters render as totals plus per-second rates since the previous
+frame; histograms as approximate p50/p95 from the cumulative-bucket
+DELTA between frames (what changed in the last interval), falling back
+to cumulative in --once mode. Endpoints that refuse or time out render
+as unreachable instead of killing the loop — mid-failover is exactly
+when the console matters most.
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import json
+import sys
+import time
+
+try:
+    from metrics_dump import _quantile_from_buckets, parse_metrics
+except ImportError:  # direct script invocation: tools/ not on sys.path
+    import pathlib
+
+    sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent))
+    from metrics_dump import _quantile_from_buckets, parse_metrics
+
+STAGE_METRIC = "swarm_job_stage_seconds"
+HIVE_WAIT_METRIC = "swarm_hive_queue_wait_seconds"
+HIVE_D2S_METRIC = "swarm_hive_dispatch_to_settle_seconds"
+JOB_CLASSES = ("interactive", "default", "batch")
+
+
+# --- scrape -----------------------------------------------------------------
+
+
+class Snapshot:
+    """One endpoint's state at one instant: parsed samples + healthz."""
+
+    def __init__(self, url: str, samples=None, health=None, error=None):
+        self.url = url
+        self.taken = time.monotonic()
+        self.samples = samples or []
+        self.health = health or {}
+        self.error = error
+
+    def counters(self, name: str, label: str) -> dict[str, float]:
+        """{label value: count} across a counter's series."""
+        out: dict[str, float] = {}
+        for metric, labels, value in self.samples:
+            if metric == name and label in labels:
+                out[labels[label]] = value
+        return out
+
+    def gauge(self, name: str, **match) -> float | None:
+        for metric, labels, value in self.samples:
+            if metric == name and all(
+                    labels.get(k) == v for k, v in match.items()):
+                return value
+        return None
+
+    def histogram(self, name: str, **match):
+        """{le: cumulative count} for one histogram series."""
+        buckets: dict[float, float] = {}
+        for metric, labels, value in self.samples:
+            if metric != f"{name}_bucket":
+                continue
+            if not all(labels.get(k) == v for k, v in match.items()):
+                continue
+            le = labels.get("le", "+Inf")
+            buckets[float("inf") if le == "+Inf" else float(le)] = value
+        return buckets
+
+
+async def scrape(session, url: str) -> Snapshot:
+    base = url.rstrip("/")
+    try:
+        async with session.get(f"{base}/metrics") as resp:
+            if resp.status != 200:
+                # a proxy 502 / wrong port must read as unreachable, not
+                # as an empty-but-healthy frame (--once exits 1 on it)
+                return Snapshot(
+                    base, error=f"/metrics answered HTTP {resp.status}")
+            samples = parse_metrics(await resp.text())
+        health = {}
+        try:
+            async with session.get(f"{base}/healthz") as resp:
+                health = await resp.json()
+        except Exception:
+            pass  # metrics without healthz still renders most rows
+        return Snapshot(base, samples, health)
+    except Exception as e:
+        return Snapshot(base, error=f"{type(e).__name__}: {e}")
+
+
+# --- deltas -----------------------------------------------------------------
+
+
+def rate(cur: float | None, prev: float | None, dt: float) -> str:
+    if cur is None or prev is None or dt <= 0 or cur < prev:
+        return ""
+    return f" (+{(cur - prev) / dt:.1f}/s)"
+
+
+def quantile_from_buckets(buckets: dict[float, float],
+                          q: float) -> float | None:
+    """Approximate quantile over a {le: cumulative count} map — thin
+    adapter over metrics_dump's crossing logic, so both tools stay in
+    agreement about quantile semantics."""
+    if not buckets:
+        return None
+    total = buckets.get(float("inf"), max(buckets.values()))
+    if total <= 0:
+        return None
+    return _quantile_from_buckets(list(buckets.items()), total, q)
+
+
+def bucket_delta(cur: dict[float, float],
+                 prev: dict[float, float] | None) -> dict[float, float]:
+    """Per-bound count delta between two cumulative scrapes; negative
+    deltas (a restarted process) fall back to the current counts."""
+    if not prev:
+        return cur
+    delta = {le: cur[le] - prev.get(le, 0.0) for le in cur}
+    if any(v < 0 for v in delta.values()):
+        return cur
+    return delta
+
+
+def fmt_s(v: float | None) -> str:
+    if v is None:
+        return "-"
+    if v == float("inf"):
+        return "+Inf"
+    return f"{v:g}s"
+
+
+# --- rendering --------------------------------------------------------------
+
+
+def render_hive(cur: Snapshot, prev: Snapshot | None) -> list[str]:
+    lines = [f"HIVE {cur.url}"]
+    if cur.error:
+        lines.append(f"  unreachable: {cur.error}")
+        return lines
+    h = cur.health
+    dt = (cur.taken - prev.taken) if prev else 0.0
+    live = int(cur.gauge("swarm_hive_workers_live") or 0)
+    lines[0] += (f"  role={h.get('role', '?')} epoch={h.get('epoch', '?')}"
+                 f" status={h.get('status', '?')} workers_live={live}")
+    for reason in h.get("degraded_reasons") or []:
+        lines.append(f"  ! {reason}")
+
+    depths = []
+    for cls in JOB_CLASSES:
+        depth = int(cur.gauge("swarm_hive_queue_depth",
+                              **{"class": cls}) or 0)
+        depths.append(f"{cls}={depth}")
+    lines.append(
+        f"  queue     {' '.join(depths)}  "
+        f"leases={int(h.get('leases_active', 0))}")
+
+    dispatch = cur.counters("swarm_hive_dispatch_total", "outcome")
+    pdispatch = prev.counters(
+        "swarm_hive_dispatch_total", "outcome") if prev else {}
+    lines.append("  dispatch  " + (" ".join(
+        f"{o}={int(n)}{rate(n, pdispatch.get(o), dt)}"
+        for o, n in sorted(dispatch.items())) or "(none yet)"))
+
+    shed = cur.counters("swarm_hive_shed_total", "class")
+    pshed = prev.counters("swarm_hive_shed_total", "class") if prev else {}
+    if shed:
+        lines.append("  shed      " + " ".join(
+            f"{c}={int(n)}{rate(n, pshed.get(c), dt)}"
+            for c, n in sorted(shed.items())))
+    results = cur.counters("swarm_hive_results_total", "status")
+    if results:
+        lines.append("  results   " + " ".join(
+            f"{s}={int(n)}" for s, n in sorted(results.items())))
+
+    wal = h.get("wal") or {}
+    if wal:
+        lines.append(
+            f"  wal       appends_since_compact="
+            f"{wal.get('appends_since_compact', '?')} "
+            f"torn={wal.get('torn_lines', '?')} "
+            f"replayed={wal.get('replayed_events', '?')}")
+    rep = h.get("replication") or {}
+    if rep:
+        lines.append(
+            f"  replica   rs={rep.get('rs_applied', '?')}"
+            f"/{rep.get('rs_primary_tip', '?')} "
+            f"last_sync={rep.get('last_sync_age_s', '?')}s")
+
+    for name, label in ((HIVE_WAIT_METRIC, "queue_wait"),
+                        (HIVE_D2S_METRIC, "disp->settle")):
+        parts = []
+        for cls in JOB_CLASSES:
+            buckets = bucket_delta(
+                cur.histogram(name, **{"class": cls}),
+                prev.histogram(name, **{"class": cls}) if prev else None)
+            p50 = quantile_from_buckets(buckets, 0.5)
+            if p50 is None:
+                continue
+            p95 = quantile_from_buckets(buckets, 0.95)
+            parts.append(f"{cls} p50<={fmt_s(p50)} p95<={fmt_s(p95)}")
+        if parts:
+            lines.append(f"  {label:<9} " + "  ".join(parts))
+    return lines
+
+
+def render_worker(cur: Snapshot, prev: Snapshot | None) -> list[str]:
+    lines = [f"WORKER {cur.url}"]
+    if cur.error:
+        lines.append(f"  unreachable: {cur.error}")
+        return lines
+    h = cur.health
+    outbox = h.get("outbox") or {}
+    age = h.get("last_poll_age_s")
+    lines[0] += (f"  status={h.get('status', '?')}"
+                 f" in_flight={h.get('jobs_in_flight', '?')}"
+                 f" outbox={outbox.get('depth', '?')}"
+                 f" last_poll={'-' if age is None else f'{age:g}s'}")
+    for reason in h.get("degraded_reasons") or []:
+        lines.append(f"  ! {reason}")
+    hive = h.get("hive") or {}
+    if hive:
+        lines.append(
+            f"  hive      {hive.get('active_endpoint', '?')} "
+            f"failovers={hive.get('failovers', '?')} "
+            f"epoch={hive.get('epoch', '?')}")
+    for s in h.get("slices") or []:
+        resident = ",".join(s.get("resident") or []) or "-"
+        busy = "busy" if s.get("busy") else "idle"
+        lines.append(
+            f"  slice {s.get('slice_id', '?')}   {busy:<5} "
+            f"{s.get('state', '?'):<12} resident: {resident}")
+
+    # per-stage latency over the last interval (cumulative in --once)
+    stages: dict[str, dict[float, float]] = {}
+    for metric, labels, value in cur.samples:
+        if metric == f"{STAGE_METRIC}_bucket" and "stage" in labels:
+            le = labels.get("le", "+Inf")
+            stages.setdefault(labels["stage"], {})[
+                float("inf") if le == "+Inf" else float(le)] = value
+    parts = []
+    for stage in sorted(stages):
+        buckets = bucket_delta(
+            stages[stage],
+            prev.histogram(STAGE_METRIC, stage=stage) if prev else None)
+        if not any(buckets.values()):
+            continue  # no samples this interval
+        p50 = quantile_from_buckets(buckets, 0.5)
+        p95 = quantile_from_buckets(buckets, 0.95)
+        parts.append(f"{stage} p50<={fmt_s(p50)} p95<={fmt_s(p95)}")
+    if parts:
+        lines.append("  stages    " + "  ".join(parts))
+    return lines
+
+
+def render_frame(hive: Snapshot | None, workers: list[Snapshot],
+                 prev_hive: Snapshot | None,
+                 prev_workers: dict[str, Snapshot],
+                 interval: float | None) -> str:
+    header = time.strftime("swarm_top  %H:%M:%S")
+    if interval:
+        header += f"  (refresh {interval:g}s; stage quantiles are per-interval)"
+    blocks = [header]
+    if hive is not None:
+        blocks.append("\n".join(render_hive(hive, prev_hive)))
+    for snap in workers:
+        blocks.append("\n".join(
+            render_worker(snap, prev_workers.get(snap.url))))
+    return "\n\n".join(blocks)
+
+
+# --- main loop --------------------------------------------------------------
+
+
+async def run(args) -> int:
+    import aiohttp
+
+    prev_hive: Snapshot | None = None
+    prev_workers: dict[str, Snapshot] = {}
+    timeout = aiohttp.ClientTimeout(total=args.timeout)
+    async with aiohttp.ClientSession(timeout=timeout) as session:
+        while True:
+            tasks = []
+            if args.hive:
+                tasks.append(scrape(session, args.hive))
+            tasks.extend(scrape(session, w) for w in args.worker)
+            snaps = await asyncio.gather(*tasks)
+            hive = snaps[0] if args.hive else None
+            workers = list(snaps[1 if args.hive else 0:])
+            frame = render_frame(
+                hive, workers, prev_hive, prev_workers,
+                None if args.once else args.interval)
+            if not args.once and sys.stdout.isatty():
+                sys.stdout.write("\x1b[2J\x1b[H")  # clear + home
+            if args.json:
+                # after the clear, or live mode would wipe it instantly
+                print(json.dumps({
+                    "hive": None if hive is None else
+                    {"url": hive.url, "health": hive.health,
+                     "error": hive.error},
+                    "workers": [{"url": w.url, "health": w.health,
+                                 "error": w.error} for w in workers],
+                }))
+            print(frame, flush=True)
+            if args.once:
+                ok = (hive is None or hive.error is None) and all(
+                    w.error is None for w in workers)
+                return 0 if ok else 1
+            prev_hive, prev_workers = hive, {w.url: w for w in workers}
+            await asyncio.sleep(args.interval)
+
+
+def main(argv: list[str] | None = None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="swarm_top", description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter)
+    parser.add_argument(
+        "--hive", default=None,
+        help="hive base URL (e.g. http://127.0.0.1:9511)")
+    parser.add_argument(
+        "--worker", action="append", default=[],
+        help="worker telemetry base URL (repeatable; "
+             "Settings.metrics_port, default :8061)")
+    parser.add_argument(
+        "--interval", type=float, default=2.0,
+        help="refresh cadence in seconds (default 2)")
+    parser.add_argument(
+        "--once", action="store_true",
+        help="render one snapshot and exit (scripting/CI mode)")
+    parser.add_argument(
+        "--json", action="store_true",
+        help="also emit one machine-readable JSON line per frame")
+    parser.add_argument(
+        "--timeout", type=float, default=5.0,
+        help="per-endpoint scrape timeout in seconds")
+    args = parser.parse_args(argv)
+    if not args.hive and not args.worker:
+        parser.error("nothing to watch: pass --hive and/or --worker")
+    try:
+        return asyncio.run(run(args))
+    except KeyboardInterrupt:
+        return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
