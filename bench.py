@@ -1101,6 +1101,8 @@ def run_hive_e2e_row() -> None:
         }
 
     async def scenario(root: str) -> dict:
+        import socket
+
         import aiohttp
 
         from chiaswarm_tpu import telemetry
@@ -1110,14 +1112,23 @@ def run_hive_e2e_row() -> None:
         token = "bench-hive"
         # the lease deadline must outlast the 600 s warmup budget: a slow
         # first compile on a loaded machine would otherwise expire the
-        # lease mid-run and fail test_bench's redeliveries==0 assertion
+        # lease mid-run and fail test_bench's redeliveries==0 assertion.
+        # max_jobs_per_poll=8 lets the gang scheduler (ISSUE 9) hand the
+        # whole 8-job burst as ONE pre-batched /work reply.
         hive = await HiveServer(
             Settings(sdaas_token=token, hive_port=0,
-                     hive_lease_deadline_s=900.0), port=0).start()
+                     hive_lease_deadline_s=900.0,
+                     hive_max_jobs_per_poll=8), port=0).start()
         expired = telemetry.REGISTRY.get("swarm_hive_leases_expired_total")
         headers = {"Authorization": f"Bearer {token}",
                    "Content-type": "application/json"}
 
+        # a real (loopback) worker metrics port: the embed-cache hit
+        # rate lives in the worker SUBPROCESS's registry and is only
+        # observable the way an operator would see it — a /metrics scrape
+        with socket.socket() as probe:
+            probe.bind(("127.0.0.1", 0))
+            metrics_port = probe.getsockname()[1]
         worker_env = dict(
             os.environ,
             JAX_PLATFORMS="cpu",
@@ -1126,7 +1137,7 @@ def run_hive_e2e_row() -> None:
             SDAAS_TOKEN=token,
             SDAAS_WORKERNAME="bench-hive-worker",
             CHIASWARM_POLL_SECONDS="0.1",
-            CHIASWARM_METRICS_PORT="0",
+            CHIASWARM_METRICS_PORT=str(metrics_port),
             PYTHONPATH=repo + os.pathsep + os.environ.get("PYTHONPATH", ""),
         )
         worker = subprocess.Popen(
@@ -1156,20 +1167,40 @@ def run_hive_e2e_row() -> None:
                         await asyncio.sleep(0.1)
                     raise TimeoutError(f"job {job_id} never completed")
 
-                # warmup: the worker's first tiny job pays pipeline build
-                # + XLA compile; the timed window must not include that
-                # one-off cost, so it is measured (and reported) apart
+                async def submit_burst(tag: str, count: int) -> list[str]:
+                    """Queue `count` jobs as ONE burst: /work polls are
+                    gated (refuse_with — the hive-side drain switch, a
+                    400 the worker just backs off from) while the jobs
+                    are submitted, so the whole burst is queued when the
+                    next poll lands and the gang scheduler sees it
+                    together — the deterministic version of 'bursty
+                    multi-client traffic'."""
+                    hive.refuse_with = f"queueing {tag} burst"
+                    try:
+                        return [await submit(tiny_job(i, tag))
+                                for i in range(count)]
+                    finally:
+                        hive.refuse_with = None
+
+                # warmup: the worker's first tiny burst pays pipeline
+                # build + the BATCHED program's XLA compile; the timed
+                # window must not include those one-off costs, so it is
+                # a full same-shape gang measured (and reported) apart
                 t0 = time.monotonic()
-                warmup_id = await submit(tiny_job(0, "warmup"))
-                status = await wait_done(warmup_id, 600.0)
-                if status["status"] != "done":
-                    raise RuntimeError(
-                        f"warmup job failed at the hive: {status['error']}")
+                warmup_ids = await submit_burst("warmup", n_jobs)
+                warmup_deadline = time.monotonic() + 600.0
+                for warmup_id in warmup_ids:
+                    status = await wait_done(
+                        warmup_id,
+                        max(warmup_deadline - time.monotonic(), 1.0))
+                    if status["status"] != "done":
+                        raise RuntimeError(
+                            f"warmup job failed at the hive: "
+                            f"{status['error']}")
                 warmup_s = time.monotonic() - t0
 
                 t0 = time.monotonic()
-                ids = [await submit(tiny_job(i, "run"))
-                       for i in range(n_jobs)]
+                ids = await submit_burst("run", n_jobs)
                 waits = []
                 # one SHARED deadline for the timed phase, not 300 s per
                 # job: 600 s warmup + 240 s run stays inside the parent
@@ -1195,7 +1226,8 @@ def run_hive_e2e_row() -> None:
                 from chiaswarm_tpu.hive_server.trace import trace_missing
 
                 traced, incomplete = 0, []
-                for job_id in [warmup_id, *ids]:
+                gang_sizes = []  # timed jobs only: the gang_rate datum
+                for job_id in [*warmup_ids, *ids]:
                     async with session.get(
                             f"{hive.api_uri}/jobs/{job_id}/trace",
                             headers=headers) as resp:
@@ -1209,10 +1241,52 @@ def run_hive_e2e_row() -> None:
                         incomplete.append(f"{job_id}: {missing}")
                     else:
                         traced += 1
+                    if job_id in ids:
+                        # the LAST dispatch is the one that produced the
+                        # settle; its gang_size (stamped by queue.take,
+                        # WAL-durable) says whether the job arrived
+                        # pre-batched
+                        dispatches = [e for e in trace.get("events", [])
+                                      if e.get("event") == "dispatch"]
+                        gang_sizes.append(int(
+                            dispatches[-1].get("gang_size", 1))
+                            if dispatches else 1)
+
+                # embed-cache hit rate, scraped from the worker
+                # subprocess's /metrics exactly as an operator would.
+                # Retried: the ephemeral port was probed bind-then-close,
+                # so a (rare) collision or a slow metrics-app start must
+                # read as a visible scrape failure, not a silent 0.0
+                embed_hits = embed_misses = 0.0
+                for attempt in range(3):
+                    try:
+                        async with session.get(
+                                "http://127.0.0.1:"
+                                f"{metrics_port}/metrics") as resp:
+                            exposition = await resp.text()
+                        for line in exposition.splitlines():
+                            if line.startswith(
+                                    'swarm_embed_cache_total{event="hit"}'):
+                                embed_hits = float(line.rsplit(None, 1)[-1])
+                            elif line.startswith(
+                                    'swarm_embed_cache_total'
+                                    '{event="miss"}'):
+                                embed_misses = float(
+                                    line.rsplit(None, 1)[-1])
+                        break
+                    except Exception as e:  # noqa: BLE001 — report it
+                        if attempt == 2:
+                            incomplete.append(
+                                f"worker metrics scrape failed: {e}")
+                        else:
+                            await asyncio.sleep(1.0)
 
             waits.sort()
+            pre_batched = sum(1 for s in gang_sizes if s >= 2)
+            gang_sizes.sort()
+            embed_total = embed_hits + embed_misses
             return {
-                "trace_e2e_jobs": 1 + len(ids),
+                "trace_e2e_jobs": len(warmup_ids) + len(ids),
                 "trace_e2e_complete": traced,
                 "trace_e2e_incomplete": incomplete,
                 "hive_e2e_jobs_per_s": round(n_jobs / wall_s, 3),
@@ -1224,6 +1298,16 @@ def run_hive_e2e_row() -> None:
                     int(0.95 * (len(waits) - 1))],
                 "hive_e2e_redeliveries": int(
                     expired.value()) if expired else 0,
+                # hive-side coalesced dispatch (ISSUE 9): fraction of the
+                # timed burst arriving pre-batched, and the size spread
+                "gang_rate": round(
+                    pre_batched / len(gang_sizes), 3) if gang_sizes else 0.0,
+                "gang_size_p50": (
+                    gang_sizes[len(gang_sizes) // 2] if gang_sizes else 0),
+                "embed_cache_hit_rate": round(
+                    embed_hits / embed_total, 3) if embed_total else 0.0,
+                "embed_cache_hits": int(embed_hits),
+                "embed_cache_misses": int(embed_misses),
             }
         finally:
             worker.terminate()  # SIGTERM -> graceful drain

@@ -7,17 +7,22 @@ shape bucket* — the under-utilization request-batching serving systems
 (SwiftDiffusion, arXiv:2407.02031) attack. This module is the batching
 layer between the poll loop and the slice workers:
 
-- `coalesce_key(job)` buckets a raw hive job by everything that must be
-  IDENTICAL for two jobs to share one jitted denoise+decode invocation:
-  (model, family, canvas, steps, scheduler, guidance mode, workflow —
-  plain txt2img, or img2img with per-request start images at a shared
-  explicit canvas and strength). Jobs that carry per-job structure the
-  batched program can't express — masks, ControlNet, LoRA, chained
-  stages — key to None and take the existing single-job path unchanged.
+- `coalesce_key(job)` (now in the jax-free shared module coalesce.py,
+  re-exported here, because the HIVE uses the same key to gang-schedule)
+  buckets a raw hive job by everything that must be IDENTICAL for two
+  jobs to share one jitted denoise+decode invocation: (model, family,
+  canvas, steps, scheduler, guidance mode, workflow — plain txt2img, or
+  img2img with per-request start images at a shared explicit canvas and
+  strength). Jobs that carry per-job structure the batched program can't
+  express — masks, ControlNet, LoRA, chained stages — key to None and
+  take the existing single-job path unchanged.
 - `BatchScheduler` holds compatible jobs for a short linger window
   (Settings.batch_linger_ms) so batchmates arriving in the same poll
   burst coalesce, then releases the group to the DISPATCH BOARD as ONE
-  work item. Groups cap at Settings.max_coalesce jobs and at the slice's
+  work item. Jobs that arrive PRE-BATCHED from a gang-scheduling hive
+  (trace.gang on the wire, ISSUE 9) skip the linger entirely via
+  `put_gang()` — the hive already did the waiting — flushing as one
+  group with reason "gang". Groups cap at Settings.max_coalesce jobs and at the slice's
   capacity limit in images (rows_limit, wired to
   chips/requirements.fit_batch by the worker), so a coalesced batch is
   always admissible without rejection.
@@ -48,6 +53,20 @@ import time
 from typing import Callable
 
 from . import telemetry
+# the compatibility vocabulary moved to the jax-free shared module
+# (coalesce.py) so the hive's gang scheduler and this worker-side layer
+# can never disagree about what coalesces; re-exported here because five
+# PRs of call sites (and tests) import them from batching
+from .coalesce import (  # noqa: F401  (re-exports)
+    DEFAULT_GUIDANCE,
+    DEFAULT_SCHEDULER,
+    DEFAULT_STEPS,
+    DEFAULT_STRENGTH,
+    coalesce_key,
+    is_interactive,
+    job_rows,
+    placement_model,
+)
 
 logger = logging.getLogger(__name__)
 
@@ -55,7 +74,8 @@ logger = logging.getLogger(__name__)
 # off), "linger" (timer expired), "size" (hit max_coalesce), "rows" (hit
 # the slice's image capacity), "priority" (interactive fast-path),
 # "preempt" (an interactive job in a DIFFERENT group flushed this one —
-# slice contention, see put()), "shutdown" (flush_all)
+# slice contention, see put()), "gang" (pre-batched by the hive's gang
+# scheduler — no linger, see put_gang()), "shutdown" (flush_all)
 _FLUSHES = telemetry.counter(
     "swarm_batch_flush_total",
     "Work items released by the batch scheduler, by flush reason",
@@ -85,191 +105,6 @@ _PLACEMENT = telemetry.counter(
     "Dispatch-board claims by placement outcome (affinity | steal | cold)",
     ("outcome",),
 )
-
-# wire pipeline_type strings whose txt2img semantics the batched program
-# reproduces exactly (plain prompt-conditioned CFG denoise + decode)
-_BATCHABLE_PIPELINE_TYPES = {
-    None,
-    "DiffusionPipeline",
-    "StableDiffusionPipeline",
-    "StableDiffusionXLPipeline",
-    "AutoPipelineForText2Image",
-}
-
-# img2img wire names the stacked-init-latent program variant serves
-_BATCHABLE_I2I_PIPELINE_TYPES = {
-    None,
-    "DiffusionPipeline",
-    "StableDiffusionImg2ImgPipeline",
-    "StableDiffusionXLImg2ImgPipeline",
-    "AutoPipelineForImage2Image",
-}
-
-# families with a run_batched entry (pipelines/stable_diffusion.py)
-_BATCHABLE_FAMILIES = {"sd", "sdxl"}
-
-# job-level keys that mean per-job structure the padded batch can't carry
-# (start_image_uri and strength are handled per-workflow: txt2img refuses
-# them, img2img REQUIRES the start image and keys on the strength)
-_UNBATCHABLE_JOB_KEYS = (
-    "mask_image_uri",
-    "lora",
-    "refiner",
-    "upscale",
-    "textual_inversion",
-    "vae",
-)
-
-# the only `parameters` keys a batchable job may carry; anything else
-# (controlnet, scheduler_args, aesthetic_score, ...) is per-job behavior
-# we refuse to guess at — the job falls through to the single path
-_SAFE_PARAMETER_KEYS = frozenset({
-    "test_tiny_model",
-    "pipeline_type",
-    "scheduler_type",
-    "num_inference_steps",
-    "guidance_scale",
-    "num_images_per_prompt",
-    "large_model",
-    "use_karras_sigmas",
-    "default_height",
-    "default_width",
-})
-
-DEFAULT_STEPS = 30
-DEFAULT_GUIDANCE = 7.5
-DEFAULT_SCHEDULER = "DPMSolverMultistepScheduler"
-DEFAULT_STRENGTH = 0.75
-
-
-def is_interactive(job: dict) -> bool:
-    """Latency-sensitive marker (ROADMAP "priority-aware batching", minimal
-    slice): a job carrying `priority: "interactive"` (or the legacy
-    `sdaas_priority` spelling) must not sit in a linger window."""
-    return "interactive" in (
-        str(job.get("priority", "")).lower(),
-        str(job.get("sdaas_priority", "")).lower(),
-    )
-
-
-def job_rows(job: dict) -> int:
-    """Images this job contributes to a coalesced batch."""
-    params = job.get("parameters") or {}
-    try:
-        n = int(params.get("num_images_per_prompt",
-                           job.get("num_images_per_prompt", 1)) or 1)
-    except (TypeError, ValueError):
-        return 1
-    return max(n, 1)
-
-
-def placement_model(job: dict) -> str | None:
-    """The model name the residency map will know this job by — the tiny
-    stand-in when `test_tiny_model` is set (that is the name the registry
-    loads and therefore the name load events record)."""
-    model = job.get("model_name")
-    if not isinstance(model, str) or not model:
-        return None
-    params = job.get("parameters")
-    tiny = bool(job.get("test_tiny_model"))
-    if isinstance(params, dict):
-        tiny = tiny or bool(params.get("test_tiny_model"))
-    if tiny:
-        try:
-            from .workflows.diffusion import _tiny_stand_in
-
-            return _tiny_stand_in(model)
-        except Exception:  # placement is advisory; never fail a job over it
-            return model
-    return model
-
-
-def coalesce_key(job: dict) -> tuple | None:
-    """Compatibility bucket for one raw hive job; None = not batchable.
-
-    Two jobs with equal keys produce identical results whether they run
-    alone or coalesced: everything the jitted program closes over or
-    shares across the batch (model, canvas, step count, scheduler,
-    guidance scale, workflow, img2img strength) is in the key;
-    everything per-row (prompt, negative, seed, start image, image
-    count) rides outside it.
-    """
-    try:
-        workflow = job.get("workflow")
-        if workflow not in ("txt2img", "img2img"):
-            return None
-        model = job.get("model_name")
-        if not isinstance(model, str) or not model:
-            return None
-        if any(k in job for k in _UNBATCHABLE_JOB_KEYS):
-            return None
-        params = job.get("parameters") or {}
-        if not isinstance(params, dict):
-            return None
-        if not set(params) <= _SAFE_PARAMETER_KEYS:
-            return None
-
-        from .registry import _auto_family
-
-        family = _auto_family(model)
-        if family not in _BATCHABLE_FAMILIES:
-            return None
-
-        # canvas: explicit dims, else the model-pinned default the
-        # formatter would apply; jobs relying on the family default share
-        # the None bucket (they all resolve to the same canvas)
-        height = job.get("height", params.get("default_height"))
-        width = job.get("width", params.get("default_width"))
-        if (height is None) != (width is None):
-            return None
-        if height is not None:
-            height, width = int(height), int(width)
-
-        strength = None
-        if workflow == "txt2img":
-            # a txt2img job carrying img2img-shaped fields is something
-            # the formatter may interpret per-job — single path
-            if "start_image_uri" in job or "strength" in job:
-                return None
-            if params.get("pipeline_type") not in _BATCHABLE_PIPELINE_TYPES:
-                return None
-        else:  # img2img: per-request start images -> stacked init latents
-            if not job.get("start_image_uri"):
-                return None
-            # without an explicit canvas the solo path sizes the pass to
-            # each start image — a group can't share a program over
-            # unknown per-image canvases, so explicit dims are required
-            if height is None:
-                return None
-            if params.get("pipeline_type") not in _BATCHABLE_I2I_PIPELINE_TYPES:
-                return None
-            name = model.lower()
-            # edit/inpaint architectures condition on the channel dim —
-            # different program semantics, out of the batched variant
-            if any(s in name for s in ("pix2pix", "ip2p", "inpaint")):
-                return None
-            strength = round(float(job.get("strength", DEFAULT_STRENGTH)), 4)
-
-        steps = int(params.get("num_inference_steps",
-                               job.get("num_inference_steps", DEFAULT_STEPS)))
-        guidance = round(float(params.get(
-            "guidance_scale", job.get("guidance_scale", DEFAULT_GUIDANCE))), 4)
-        scheduler = str(params.get("scheduler_type", DEFAULT_SCHEDULER))
-        karras = bool(params.get("use_karras_sigmas", False))
-        # the tiny flag rides at either level on the wire (formatters copy
-        # the whole job); both must split the bucket or a real job could
-        # coalesce behind a tiny-flagged one and run on the stand-in model
-        tiny = bool(params.get("test_tiny_model", False)) \
-            or bool(job.get("test_tiny_model", False))
-        # large_model flips the SD-vs-SDXL default pipeline class
-        large = bool(params.get("large_model", False))
-        return (model, family, height, width, steps, scheduler, guidance,
-                karras, tiny, large, workflow, strength)
-    except (TypeError, ValueError):
-        # hive-controlled values that don't parse: let the single-job
-        # path produce its usual fatal envelope for them
-        return None
-
 
 class BatchScheduler:
     """Linger-window grouping between the poll loop and the slice workers'
@@ -305,6 +140,12 @@ class BatchScheduler:
         self._pending: dict[tuple, dict] = {}
         self._outstanding = 0
         self._ready_jobs = 0  # jobs released to the board, not yet claimed
+        # row (image) twins of the job counters, for the capability
+        # advertisement: the hive's gang budget is row-denominated, and a
+        # job with num_images_per_prompt=4 occupies 4 rows of a slice's
+        # coalescing appetite, not 1
+        self._ready_rows = 0
+        self._executing_rows = 0  # rows claimed off the board, not done
         self._closed = False  # drain mode: nothing lingers anymore
 
     # --- queue-compatible surface for the worker loop ---
@@ -323,8 +164,14 @@ class BatchScheduler:
             return True
         return self.maxsize > 0 and self._outstanding >= self.maxsize
 
-    def task_done(self) -> None:
+    def task_done(self, job: dict | None = None) -> None:
+        """One job finished executing. Pass the job dict so the row
+        accounting can subtract its true image count (a no-arg call keeps
+        the old signature and assumes one row)."""
         self._outstanding -= 1
+        self._executing_rows = max(
+            self._executing_rows - (job_rows(job) if job is not None else 1),
+            0)
 
     @property
     def pending_jobs(self) -> int:
@@ -340,6 +187,16 @@ class BatchScheduler:
     def outstanding_jobs(self) -> int:
         """All in-flight jobs: lingering + ready + executing."""
         return self._outstanding
+
+    @property
+    def outstanding_rows(self) -> int:
+        """All in-flight IMAGE ROWS: lingering + ready + executing. This
+        is what the worker advertises as `queue_depth` on /work polls —
+        the hive's gang budget is row-denominated, and counting jobs
+        instead would let a gang reply oversubscribe a slice that is
+        mid-coalesce on multi-image jobs."""
+        pending_rows = sum(g["rows"] for g in self._pending.values())
+        return pending_rows + self._ready_rows + self._executing_rows
 
     def notify(self) -> None:
         """Wake claim()/get() waiters to re-match (fired on every board
@@ -362,6 +219,8 @@ class BatchScheduler:
             await self._wait_change()
         entry = self._board.pop(0)
         self._ready_jobs -= len(entry["jobs"])
+        self._ready_rows -= entry["rows"]
+        self._executing_rows += entry["rows"]
         return entry["jobs"]
 
     async def claim(self, allocator) -> tuple[list[dict], object, str]:
@@ -392,6 +251,8 @@ class BatchScheduler:
         def take(idx: int, chipset, outcome: str):
             entry = self._board.pop(idx)
             self._ready_jobs -= len(entry["jobs"])
+            self._ready_rows -= entry["rows"]
+            self._executing_rows += entry["rows"]
             _PLACEMENT.inc(outcome=outcome)
             return entry["jobs"], chipset, outcome
 
@@ -424,9 +285,12 @@ class BatchScheduler:
         return take(0, *acquired)
 
     def _release(self, jobs: list[dict]) -> None:
+        rows = sum(job_rows(j) for j in jobs)
         self._ready_jobs += len(jobs)
+        self._ready_rows += rows
         self._board.append({
             "jobs": jobs,
+            "rows": rows,
             "model": placement_model(jobs[0]),
             "interactive": any(is_interactive(j) for j in jobs),
         })
@@ -476,6 +340,68 @@ class BatchScheduler:
             self._flush(key, reason="size")
         elif group["cap"] is not None and group["rows"] >= group["cap"]:
             self._flush(key, reason="rows")
+
+    async def put_gang(self, jobs: list[dict]) -> None:
+        """Admit a hive-pre-batched gang (jobs sharing one `trace.gang`
+        id on the wire): flush immediately as one group with reason
+        "gang" — the hive already did the waiting, so a linger window
+        here would only add latency. Degrades gracefully: members whose
+        key disagrees (or is None — the hive and worker should agree,
+        but the worker's view is authoritative for its own slice) fall
+        back to the normal put() path, and a gang larger than one
+        slice's capacity splits into admissible chunks."""
+        if len(jobs) <= 1 or self._closed or self.max_coalesce <= 1:
+            for job in jobs:
+                await self.put(job)
+            return
+        solos: list[dict] = []
+        by_key: dict[tuple, list[dict]] = {}
+        for job in jobs:
+            key = coalesce_key(job)
+            if key is None:
+                solos.append(job)
+            else:
+                by_key.setdefault(key, []).append(job)
+        for members in by_key.values():
+            cap = None
+            if self.rows_limit is not None:
+                try:
+                    cap = self.rows_limit(members[0])
+                except Exception:  # capacity probe is advisory, never fatal
+                    logger.exception("rows_limit probe failed")
+            chunk: list[dict] = []
+            rows = 0
+            for job in members:
+                r = job_rows(job)
+                if chunk and (len(chunk) >= self.max_coalesce
+                              or (cap is not None and rows + r > cap)):
+                    self._release_gang(chunk, rows)
+                    chunk, rows = [], 0
+                chunk.append(job)
+                rows += r
+            if chunk:
+                self._release_gang(chunk, rows)
+        for job in solos:
+            await self.put(job)
+        if any(is_interactive(j) for j in jobs):
+            # same latency-first rule as put(): an interactive gang on a
+            # contended worker must not queue behind linger-timer luck
+            self._preempt_lingerers()
+
+    def _release_gang(self, jobs: list[dict], rows: int) -> None:
+        self._outstanding += len(jobs)
+        _FLUSHES.inc(reason="gang")
+        _GROUP_JOBS.observe(len(jobs))
+        _GROUP_ROWS.observe(rows)
+        _LINGER_WAIT.observe(0.0)
+        for job in jobs:
+            if isinstance(job.get("trace"), dict):
+                job["trace"]["lingered_s"] = 0.0
+                job["trace"]["coalesced_with"] = len(jobs) - 1
+        if len(jobs) > 1:
+            logger.info("hive gang of %d jobs (%d images) for %s",
+                        len(jobs), rows, jobs[0].get("model_name"))
+        self._release(jobs)
 
     def _preempt_lingerers(self) -> None:
         """Interactive preemption ACROSS groups (ROADMAP): when an

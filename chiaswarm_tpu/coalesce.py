@@ -1,0 +1,211 @@
+"""Coalesce compatibility: the shared, jax-free vocabulary of batching.
+
+`coalesce_key(job)` decides whether two raw hive jobs may share ONE
+padded jitted denoise+decode invocation. Until ISSUE 9 that decision
+lived inside the worker's batching layer, so the only place compatible
+jobs could meet was a 50 ms linger window on one worker — batchmates
+that landed in different polls (or on different workers) ran solo by
+bad luck. The hive now gang-schedules: `hive_server/queue.py` keeps a
+secondary index from this exact key to queued jobs, and
+`hive_server/dispatch.py` hands same-key jobs out as ONE pre-batched
+/work reply. For that to be sound, both sides MUST agree on the key —
+hence this module: imported by the worker's BatchScheduler, the hive's
+queue/dispatcher, and the test fake alike, with no jax dependency so a
+chip-less coordinator can import it.
+
+Everything here operates on plain wire-format job dicts:
+
+- `coalesce_key(job)` -> tuple | None: the compatibility bucket; None
+  means "not batchable, single-job path".
+- `job_rows(job)`: images the job contributes to a coalesced batch.
+- `is_interactive(job)`: the latency-sensitive marker both the hive's
+  priority classes and the worker's linger fast-path read.
+- `placement_model(job)`: the model name residency maps know the job
+  by (the tiny stand-in when `test_tiny_model` is set).
+"""
+
+from __future__ import annotations
+
+# wire pipeline_type strings whose txt2img semantics the batched program
+# reproduces exactly (plain prompt-conditioned CFG denoise + decode)
+_BATCHABLE_PIPELINE_TYPES = {
+    None,
+    "DiffusionPipeline",
+    "StableDiffusionPipeline",
+    "StableDiffusionXLPipeline",
+    "AutoPipelineForText2Image",
+}
+
+# img2img wire names the stacked-init-latent program variant serves
+_BATCHABLE_I2I_PIPELINE_TYPES = {
+    None,
+    "DiffusionPipeline",
+    "StableDiffusionImg2ImgPipeline",
+    "StableDiffusionXLImg2ImgPipeline",
+    "AutoPipelineForImage2Image",
+}
+
+# families with a run_batched entry (pipelines/stable_diffusion.py)
+_BATCHABLE_FAMILIES = {"sd", "sdxl"}
+
+# job-level keys that mean per-job structure the padded batch can't carry
+# (start_image_uri and strength are handled per-workflow: txt2img refuses
+# them, img2img REQUIRES the start image and keys on the strength)
+_UNBATCHABLE_JOB_KEYS = (
+    "mask_image_uri",
+    "lora",
+    "refiner",
+    "upscale",
+    "textual_inversion",
+    "vae",
+)
+
+# the only `parameters` keys a batchable job may carry; anything else
+# (controlnet, scheduler_args, aesthetic_score, ...) is per-job behavior
+# we refuse to guess at — the job falls through to the single path
+_SAFE_PARAMETER_KEYS = frozenset({
+    "test_tiny_model",
+    "pipeline_type",
+    "scheduler_type",
+    "num_inference_steps",
+    "guidance_scale",
+    "num_images_per_prompt",
+    "large_model",
+    "use_karras_sigmas",
+    "default_height",
+    "default_width",
+})
+
+DEFAULT_STEPS = 30
+DEFAULT_GUIDANCE = 7.5
+DEFAULT_SCHEDULER = "DPMSolverMultistepScheduler"
+DEFAULT_STRENGTH = 0.75
+
+
+def is_interactive(job: dict) -> bool:
+    """Latency-sensitive marker (ROADMAP "priority-aware batching", minimal
+    slice): a job carrying `priority: "interactive"` (or the legacy
+    `sdaas_priority` spelling) must not sit in a linger window."""
+    return "interactive" in (
+        str(job.get("priority", "")).lower(),
+        str(job.get("sdaas_priority", "")).lower(),
+    )
+
+
+def job_rows(job: dict) -> int:
+    """Images this job contributes to a coalesced batch."""
+    params = job.get("parameters") or {}
+    try:
+        n = int(params.get("num_images_per_prompt",
+                           job.get("num_images_per_prompt", 1)) or 1)
+    except (TypeError, ValueError):
+        return 1
+    return max(n, 1)
+
+
+def placement_model(job: dict) -> str | None:
+    """The model name the residency map will know this job by — the tiny
+    stand-in when `test_tiny_model` is set (that is the name the registry
+    loads and therefore the name load events record)."""
+    model = job.get("model_name")
+    if not isinstance(model, str) or not model:
+        return None
+    params = job.get("parameters")
+    tiny = bool(job.get("test_tiny_model"))
+    if isinstance(params, dict):
+        tiny = tiny or bool(params.get("test_tiny_model"))
+    if tiny:
+        try:
+            from .workflows.diffusion import _tiny_stand_in
+
+            return _tiny_stand_in(model)
+        except Exception:  # placement is advisory; never fail a job over it
+            return model
+    return model
+
+
+def coalesce_key(job: dict) -> tuple | None:
+    """Compatibility bucket for one raw hive job; None = not batchable.
+
+    Two jobs with equal keys produce identical results whether they run
+    alone or coalesced: everything the jitted program closes over or
+    shares across the batch (model, canvas, step count, scheduler,
+    guidance scale, workflow, img2img strength) is in the key;
+    everything per-row (prompt, negative, seed, start image, image
+    count) rides outside it.
+    """
+    try:
+        workflow = job.get("workflow")
+        if workflow not in ("txt2img", "img2img"):
+            return None
+        model = job.get("model_name")
+        if not isinstance(model, str) or not model:
+            return None
+        if any(k in job for k in _UNBATCHABLE_JOB_KEYS):
+            return None
+        params = job.get("parameters") or {}
+        if not isinstance(params, dict):
+            return None
+        if not set(params) <= _SAFE_PARAMETER_KEYS:
+            return None
+
+        from .registry import _auto_family
+
+        family = _auto_family(model)
+        if family not in _BATCHABLE_FAMILIES:
+            return None
+
+        # canvas: explicit dims, else the model-pinned default the
+        # formatter would apply; jobs relying on the family default share
+        # the None bucket (they all resolve to the same canvas)
+        height = job.get("height", params.get("default_height"))
+        width = job.get("width", params.get("default_width"))
+        if (height is None) != (width is None):
+            return None
+        if height is not None:
+            height, width = int(height), int(width)
+
+        strength = None
+        if workflow == "txt2img":
+            # a txt2img job carrying img2img-shaped fields is something
+            # the formatter may interpret per-job — single path
+            if "start_image_uri" in job or "strength" in job:
+                return None
+            if params.get("pipeline_type") not in _BATCHABLE_PIPELINE_TYPES:
+                return None
+        else:  # img2img: per-request start images -> stacked init latents
+            if not job.get("start_image_uri"):
+                return None
+            # without an explicit canvas the solo path sizes the pass to
+            # each start image — a group can't share a program over
+            # unknown per-image canvases, so explicit dims are required
+            if height is None:
+                return None
+            if params.get("pipeline_type") not in _BATCHABLE_I2I_PIPELINE_TYPES:
+                return None
+            name = model.lower()
+            # edit/inpaint architectures condition on the channel dim —
+            # different program semantics, out of the batched variant
+            if any(s in name for s in ("pix2pix", "ip2p", "inpaint")):
+                return None
+            strength = round(float(job.get("strength", DEFAULT_STRENGTH)), 4)
+
+        steps = int(params.get("num_inference_steps",
+                               job.get("num_inference_steps", DEFAULT_STEPS)))
+        guidance = round(float(params.get(
+            "guidance_scale", job.get("guidance_scale", DEFAULT_GUIDANCE))), 4)
+        scheduler = str(params.get("scheduler_type", DEFAULT_SCHEDULER))
+        karras = bool(params.get("use_karras_sigmas", False))
+        # the tiny flag rides at either level on the wire (formatters copy
+        # the whole job); both must split the bucket or a real job could
+        # coalesce behind a tiny-flagged one and run on the stand-in model
+        tiny = bool(params.get("test_tiny_model", False)) \
+            or bool(job.get("test_tiny_model", False))
+        # large_model flips the SD-vs-SDXL default pipeline class
+        large = bool(params.get("large_model", False))
+        return (model, family, height, width, steps, scheduler, guidance,
+                karras, tiny, large, workflow, strength)
+    except (TypeError, ValueError):
+        # hive-controlled values that don't parse: let the single-job
+        # path produce its usual fatal envelope for them
+        return None

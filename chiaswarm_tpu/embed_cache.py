@@ -1,0 +1,145 @@
+"""Text-encoder embedding cache: repeat prompts skip text_encode.
+
+The second perf rung of ISSUE 9 (the first step of the ROADMAP's
+"phase-aware fast paths + request-level caching" ladder): at serving
+scale prompt text repeats constantly — gang members share negative
+prompts, users iterate seeds over one prompt, template front-ends send
+identical boilerplate negatives on every job — yet every job paid a
+full CLIP forward per row. This module is a process-wide LRU cache of
+encoded rows keyed by ``(model_name, text)``, byte-capped by
+``Settings.embed_cache_mb`` (``CHIASWARM_EMBED_CACHE_MB``; 0 disables).
+
+Keying on the individual text rather than a (prompt, negative) pair is
+strictly stronger than the ISSUE's sketch: the prompt and the negative
+are cached independently, so a job that shares only its negative with
+the fleet still skips half its encode, and the shared ``""`` negative
+becomes a near-permanent hit. The pipeline only consults the cache when
+nothing job-specific perturbs the encoder (no textual-inversion
+tokenizer/embedding overrides, base text-encoder params) — see
+``SDPipeline.encode_prompts`` — so a cached row is bitwise identical to
+what the encoder would produce.
+
+Values are host numpy arrays (the context row, plus the pooled row for
+SDXL); a hit costs one host->device stack instead of a CLIP forward.
+Thread-safe: slice executor threads encode concurrently.
+"""
+
+from __future__ import annotations
+
+import threading
+from collections import OrderedDict
+
+from . import telemetry
+
+_EVENTS = telemetry.counter(
+    "swarm_embed_cache_total",
+    "Text-embedding cache lookups by row, by outcome (hit = the row "
+    "skipped its text-encoder forward entirely)",
+    ("event",),
+)
+_BYTES = telemetry.gauge(
+    "swarm_embed_cache_bytes",
+    "Bytes of encoded prompt rows currently resident in the embedding "
+    "cache (bounded by Settings.embed_cache_mb)")
+_ENTRIES = telemetry.gauge(
+    "swarm_embed_cache_entries",
+    "Distinct (model, text) rows resident in the embedding cache")
+
+
+class EmbedCache:
+    """Byte-capped LRU of encoded text rows."""
+
+    def __init__(self, max_bytes: int):
+        self.max_bytes = int(max_bytes)
+        self._lock = threading.Lock()
+        self._entries: OrderedDict[tuple, tuple] = OrderedDict()
+        self._bytes = 0
+
+    @staticmethod
+    def _nbytes(value: tuple) -> int:
+        return sum(int(a.nbytes) for a in value if a is not None)
+
+    def lookup(self, key: tuple):
+        """The cached (context_row, pooled_row|None) for `key`, or None.
+        Does NOT touch the hit/miss counters — the caller counts per
+        ROW (note_rows), so duplicate rows in one batch score as the
+        hits they are."""
+        with self._lock:
+            value = self._entries.get(key)
+            if value is not None:
+                self._entries.move_to_end(key)
+            return value
+
+    def put(self, key: tuple, value: tuple) -> None:
+        nbytes = self._nbytes(value)
+        if nbytes > self.max_bytes:
+            return  # one giant row must not wipe the whole cache
+        with self._lock:
+            old = self._entries.pop(key, None)
+            if old is not None:
+                self._bytes -= self._nbytes(old)
+            self._entries[key] = value
+            self._bytes += nbytes
+            while self._bytes > self.max_bytes and self._entries:
+                _, evicted = self._entries.popitem(last=False)
+                self._bytes -= self._nbytes(evicted)
+            _BYTES.set(self._bytes)
+            _ENTRIES.set(len(self._entries))
+
+    @staticmethod
+    def note_rows(hits: int, misses: int) -> None:
+        """Count one encode call's per-row outcomes."""
+        if hits:
+            _EVENTS.inc(hits, event="hit")
+        if misses:
+            _EVENTS.inc(misses, event="miss")
+
+    @property
+    def resident_bytes(self) -> int:
+        return self._bytes
+
+    def __len__(self) -> int:
+        return len(self._entries)
+
+
+_CACHE: EmbedCache | None = None
+_CONFIGURED = False
+_LOCK = threading.Lock()
+
+
+def get_cache() -> EmbedCache | None:
+    """The process-wide cache, sized from Settings.embed_cache_mb on
+    first use; None when disabled (0)."""
+    global _CACHE, _CONFIGURED
+    with _LOCK:
+        if not _CONFIGURED:
+            from .settings import load_settings
+
+            try:
+                mb = int(getattr(load_settings(), "embed_cache_mb", 0))
+            except Exception:  # the cache is an optimization, never fatal
+                mb = 0
+            _CACHE = EmbedCache(mb * 1024 * 1024) if mb > 0 else None
+            _CONFIGURED = True
+        return _CACHE
+
+
+def configure(max_bytes: int | None) -> EmbedCache | None:
+    """Explicitly (re)size the process-wide cache — tests and benches;
+    None or <= 0 disables."""
+    global _CACHE, _CONFIGURED
+    with _LOCK:
+        _CACHE = (EmbedCache(int(max_bytes))
+                  if max_bytes and int(max_bytes) > 0 else None)
+        _CONFIGURED = True
+        _BYTES.set(0)
+        _ENTRIES.set(0)
+        return _CACHE
+
+
+def reset() -> None:
+    """Forget the configured cache (next get_cache() re-reads Settings)."""
+    global _CACHE, _CONFIGURED
+    with _LOCK:
+        _CACHE = None
+        _CONFIGURED = False

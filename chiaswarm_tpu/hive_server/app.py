@@ -121,6 +121,7 @@ class HiveServer:
             self.directory,
             affinity_hold_s=float(g("hive_affinity_hold_s", 15.0)),
             max_jobs_per_poll=int(g("hive_max_jobs_per_poll", 4)),
+            gang_max=int(g("hive_gang_max", 8)),
         )
         self.spool = ArtifactSpool(
             resolve_path(g("hive_spool_dir", "hive_spool")))
@@ -436,12 +437,18 @@ class HiveServer:
                 {"message": "worker_version is required"}, status=400)
         worker = self.directory.observe(query)
         handed = self.dispatcher.select(worker, self.queue)
-        for record, outcome in handed:
-            self.queue.take(record, worker.name, outcome)
+        for record, outcome, gang in handed:
+            # a gang is a dispatch-time grouping, NOT a new lifecycle:
+            # each member is taken, leased, and journaled individually —
+            # redelivery/settle semantics per job are unchanged, and a
+            # lost gang degrades to singles through the normal reaper
+            self.queue.take(record, worker.name, outcome, gang=gang)
             self.leases.grant(record, worker.name)
             self._journal(ev_lease(record))
-            logger.info("dispatched job %s to %s (%s, attempt %d)",
-                        record.job_id, worker.name, outcome, record.attempts)
+            logger.info("dispatched job %s to %s (%s, attempt %d%s)",
+                        record.job_id, worker.name, outcome, record.attempts,
+                        f", gang {gang['id']} {gang['index'] + 1}/"
+                        f"{gang['size']}" if gang else "")
         # chaos hook: the hive 'dies' after leasing + journaling but
         # before the reply leaves — the worker never sees the jobs, and
         # recovery + lease expiry must redeliver them
@@ -450,11 +457,13 @@ class HiveServer:
         # every handed job carries its trace context on the wire (a copy
         # — the stored job dict stays pristine in the WAL): the worker
         # echoes it back inside the envelope's pipeline_config.trace so
-        # its stage spans attach to the right dispatch attempt. Field
+        # its stage spans attach to the right dispatch attempt, and gang
+        # members carry trace.gang so they arrive pre-batched. Field
         # set pinned by the protocol-conformance suite.
         return web.json_response(
-            {"jobs": [dict(record.job, trace=wire_trace_context(record))
-                      for record, _ in handed]},
+            {"jobs": [dict(record.job,
+                           trace=wire_trace_context(record, gang=gang))
+                      for record, _, gang in handed]},
             headers=self._epoch_headers())
 
     async def _results(self, request: web.Request) -> web.Response:

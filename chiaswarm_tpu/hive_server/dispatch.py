@@ -18,23 +18,48 @@ Outcomes (counted in `swarm_hive_dispatch_total{outcome}`):
             `affinity_hold_s`, so the cold poller takes it rather than
             letting latency pile up behind a busy home;
 - hold      the job was SKIPPED this poll (a warm worker is live and the
-            hold window hasn't lapsed) — deferred, not dispatched.
+            hold window hasn't lapsed) — deferred, not dispatched;
+- gang      the job rode along as a gang MEMBER behind a seed job with
+            the same coalesce key (ISSUE 9): same-key queued batchmates
+            leave in ONE /work reply, pre-batched, so the worker's
+            linger window is no longer the only coalescing opportunity.
+
+Gang scheduling: when the picked job is coalesce-compatible
+(coalesce.py — the exact key the worker's BatchScheduler groups by) and
+the worker advertised a per-slice row appetite (`gang_rows`, its
+max_coalesce), the dispatcher pulls queued same-class same-key
+batchmates up to min(advertised rows, hive_gang_max, per-poll job cap).
+A gang is a dispatch-time grouping, not a new lifecycle: each member is
+leased and journaled individually, redelivery may degrade it to
+singles, and the only wire evidence is `trace.gang = {id, size, index}`
+stamped into each member's trace context. The seed keeps its placement
+outcome (so affinity still prefers the worker whose slice holds the
+model — the whole gang follows the seed's placement), members count as
+`gang`, and `swarm_hive_gang_size` histograms the grouping.
 """
 
 from __future__ import annotations
 
 import dataclasses
+import math
+import uuid
 
 from .. import telemetry
-from ..batching import placement_model
+from ..coalesce import job_rows, placement_model
 from .clock import CLOCK
 from .queue import JobRecord, PriorityJobQueue
 
 _DISPATCH = telemetry.counter(
     "swarm_hive_dispatch_total",
     "Hive /work dispatch decisions by placement outcome "
-    "(affinity | cold | steal | hold)",
+    "(affinity | cold | steal | hold | gang)",
     ("outcome",),
+)
+_GANG_SIZE = telemetry.histogram(
+    "swarm_hive_gang_size",
+    "Jobs per gang-scheduled /work group (observed once per gang; "
+    "solo dispatches are not observed)",
+    buckets=(2, 3, 4, 6, 8, 12, 16),
 )
 _WORKERS_LIVE = telemetry.gauge(
     "swarm_hive_workers_live",
@@ -67,6 +92,15 @@ class WorkerInfo:
     slices: int = 1
     busy_slices: int = 0
     queue_depth: int = 0
+    # per-slice coalescing appetite in image rows (the worker's
+    # max_coalesce — a JOB cap, so multi-image jobs make this a
+    # conservative under-estimate of the slice's true row capacity:
+    # gangs under-fill rather than oversubscribe); 1 = no appetite
+    gang_rows: int = 1
+    # whether the poll advertised gang_rows at all: a gang-aware worker
+    # also reports queue_depth in ROWS incl. executing (ISSUE 9); a
+    # legacy poller keeps the pre-gang budget contract
+    gang_aware: bool = False
     last_seen: float = 0.0
 
     @property
@@ -90,6 +124,7 @@ class WorkerInfo:
             "slices": self.slices,
             "busy_slices": self.busy_slices,
             "queue_depth": self.queue_depth,
+            "gang_rows": self.gang_rows,
             "resident_models": sorted(self.resident),
         }
 
@@ -120,6 +155,8 @@ class WorkerDirectory:
             slices=max(_to_int(query.get("slices"), 1), 1),
             busy_slices=_to_int(query.get("busy_slices")),
             queue_depth=_to_int(query.get("queue_depth")),
+            gang_rows=max(_to_int(query.get("gang_rows"), 1), 1),
+            gang_aware="gang_rows" in query,
             last_seen=CLOCK.mono(),
         )
         self._workers[name] = info
@@ -157,22 +194,41 @@ class Dispatcher:
     """The placement decision for one /work poll."""
 
     def __init__(self, directory: WorkerDirectory, affinity_hold_s: float,
-                 max_jobs_per_poll: int):
+                 max_jobs_per_poll: int, gang_max: int = 8):
         self.directory = directory
         self.affinity_hold_s = max(float(affinity_hold_s), 0.0)
         self.max_jobs_per_poll = max(int(max_jobs_per_poll), 1)
+        # most jobs one GANG may hold (Settings.hive_gang_max); <= 1
+        # disables gang scheduling hive-side entirely
+        self.gang_max = max(int(gang_max), 1)
 
-    def _budget(self, worker: WorkerInfo) -> int:
-        """Jobs to hand this poll: the worker's advertised free capacity,
-        capped by the per-poll knob. A worker already sitting on a local
-        queue gets that counted against it — depth it reported is work
-        it has not started — and one advertising no net capacity gets
-        NOTHING: its poll is a heartbeat, and handing it a job anyway
-        would bury it while an idle worker's next poll could have taken
-        the job immediately. Workers that advertise no load fields at
-        all default to slices=1/busy=0/depth=0, i.e. budget 1."""
-        free = worker.free_slices - worker.queue_depth
-        return max(0, min(self.max_jobs_per_poll, free))
+    def _budget(self, worker: WorkerInfo) -> tuple[int, int]:
+        """(work items, image rows) to hand this poll.
+
+        Gang-aware workers (they sent `gang_rows`): work items are
+        slice-grained — each solo job or gang lands on ONE slice, so at
+        most `free_slices` of them leave per poll — and rows are the
+        worker's total advertised appetite (slices x gang_rows) minus
+        `queue_depth`, which for these workers counts lingering + ready
+        + EXECUTING rows (ISSUE 9), so a slice mid-coalesce is already
+        accounted and a gang reply can't oversubscribe it.
+
+        Legacy pollers (no `gang_rows`) keep the EXACT pre-gang
+        contract: `free_slices - queue_depth` jobs, one row each —
+        their depth excludes executing work (busy_slices covers it), and
+        mixing it into the rows formula would hand a job to a worker
+        whose free slice is already spoken for by a queued one. Either
+        way a worker advertising no net capacity gets NOTHING: its poll
+        is a heartbeat, and handing it work anyway would bury it while
+        an idle worker's next poll could have taken the work
+        immediately."""
+        if not worker.gang_aware:
+            free = max(worker.free_slices - worker.queue_depth, 0)
+            return free, free
+        per_slice = max(worker.gang_rows, 1)
+        free_rows = max(worker.slices * per_slice - worker.queue_depth, 0)
+        items = min(worker.free_slices, math.ceil(free_rows / per_slice))
+        return max(items, 0), free_rows
 
     def unplaceable(self, record: JobRecord) -> bool:
         """True when every LIVE worker has declared itself incapable of
@@ -189,19 +245,31 @@ class Dispatcher:
         model = placement_model(record.job)
         return all(not w.can_run(model) for w in live)
 
-    def select(self, worker: WorkerInfo,
-               queue: PriorityJobQueue) -> list[tuple[JobRecord, str]]:
-        """Pick (record, outcome) pairs for this worker, class order
-        first, residency second. Jobs a warm OTHER worker should take
-        are held back ("hold") until `affinity_hold_s` lapses; jobs this
-        worker cannot run at all (unconverted family) are skipped
-        silently for it."""
-        handed: list[tuple[JobRecord, str]] = []
-        budget = self._budget(worker)
+    def select(self, worker: WorkerInfo, queue: PriorityJobQueue
+               ) -> list[tuple[JobRecord, str, dict | None]]:
+        """Pick (record, outcome, gang) triples for this worker, class
+        order first, residency second. Jobs a warm OTHER worker should
+        take are held back ("hold") until `affinity_hold_s` lapses; jobs
+        this worker cannot run at all (unconverted family) are skipped
+        silently for it.
+
+        When a picked SEED job is coalesce-compatible and the worker
+        advertised gang capacity, its queued same-class same-key
+        batchmates leave in the same reply as one gang — never split
+        across the per-poll budget (the stamped gang size is exactly
+        what this reply carries) and never pulled across priority
+        classes (the peers index is per-class). `gang` is
+        {id, size, index} for gang members, None for solo dispatches."""
+        handed: list[tuple[JobRecord, str, dict | None]] = []
+        items, free_rows = self._budget(worker)
         now = CLOCK.mono()
+        taken: set[str] = set()
         for record in queue.iter_queued():
-            if len(handed) >= budget:
+            if (items <= 0 or free_rows <= 0
+                    or len(handed) >= self.max_jobs_per_poll):
                 break
+            if record.job_id in taken:
+                continue  # already left as a gang member this reply
             # placement_model maps tiny-flagged jobs to the stand-in
             # name the worker's registry (and therefore its advertised
             # resident_models) actually knows them by
@@ -222,6 +290,9 @@ class Dispatcher:
                     # affinity window started costing it latency without
                     # one event per skipped poll. Advisory until the next
                     # journaled transition carries the timeline forward.
+                    # Held seeds hold their whole gang implicitly: the
+                    # peers stay queued for the warm worker's next poll —
+                    # affinity places the GANG, not just the seed.
                     if not any(e.get("event") == "hold"
                                for e in record.timeline):
                         # the queue's clock, not the module CLOCK: every
@@ -233,6 +304,49 @@ class Dispatcher:
                             "worker": worker.name,
                             "warm_on": sorted(h.name for h in holders)})
                     continue
-            _DISPATCH.inc(outcome=outcome)
-            handed.append((record, outcome))
+            members = [record]
+            # a legacy poller's budget is in JOBS (its depth never knew
+            # rows); only gang-aware workers get row-denominated math —
+            # a 4-image job must not eat 4 of a legacy worker's job slots
+            rows = job_rows(record.job) if worker.gang_aware else 1
+            if (record.coalesce is not None and self.gang_max > 1
+                    and worker.gang_rows > 1):
+                # one gang = one slice pass: its rows must fit the
+                # per-slice appetite AND the poll's remaining row budget
+                cap_jobs = min(self.gang_max,
+                               self.max_jobs_per_poll - len(handed))
+                cap_rows = min(worker.gang_rows, free_rows)
+                for peer in queue.queued_peers(record):
+                    if len(members) >= cap_jobs:
+                        break
+                    if peer.job_id in taken:
+                        # already left with an EARLIER gang this reply;
+                        # it stays queue-live until app.py takes it
+                        # after select() returns, so the index alone
+                        # cannot know
+                        continue
+                    peer_rows = job_rows(peer.job)
+                    if rows + peer_rows > cap_rows:
+                        # stop rather than skip ahead: pulling a later
+                        # smaller peer over this one would reorder the
+                        # class FIFO
+                        break
+                    members.append(peer)
+                    rows += peer_rows
+            items -= 1
+            free_rows -= rows
+            taken.update(m.job_id for m in members)
+            if len(members) > 1:
+                gang_id = uuid.uuid4().hex[:12]
+                _GANG_SIZE.observe(len(members))
+                for i, member in enumerate(members):
+                    # the seed keeps its placement outcome; riders are
+                    # the gang win the counter exists to measure
+                    member_outcome = outcome if i == 0 else "gang"
+                    _DISPATCH.inc(outcome=member_outcome)
+                    handed.append((member, member_outcome, {
+                        "id": gang_id, "size": len(members), "index": i}))
+            else:
+                _DISPATCH.inc(outcome=outcome)
+                handed.append((record, outcome, None))
         return handed

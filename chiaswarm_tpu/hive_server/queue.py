@@ -23,6 +23,15 @@ entries are skipped on iteration and compacted away once they outnumber
 the live ones. Dispatch cost therefore stays flat at thousands of queued
 jobs — the same "stays cheap at thousands" direction as the worker
 directory.
+
+Gang scheduling (ISSUE 9) adds a SECONDARY index over the same entries:
+(class, coalesce key) -> deque of the identical (token, record) tuples,
+so the dispatcher can find a picked job's queued batchmates in O(1)
+instead of scanning the class queue. The index shares the tombstone
+discipline (an entry is live iff `_is_live`), is rebuilt for free by
+WAL replay and replication resets (it is maintained inside `_enqueue`,
+which every restore path already goes through), and is never persisted
+— it is pure derived state.
 """
 
 from __future__ import annotations
@@ -34,6 +43,7 @@ import uuid
 from collections import OrderedDict, deque
 
 from .. import telemetry
+from ..coalesce import coalesce_key
 from .clock import CLOCK, HiveClock
 
 logger = logging.getLogger(__name__)
@@ -181,6 +191,10 @@ class JobRecord:
     # iff the record is queued AND the token matches (requeue_front /
     # discard_queued bump it, turning older entries into tombstones)
     enqueue_token: int = 0
+    # coalesce-compatibility bucket (coalesce.py), computed once at
+    # admit/restore; None = not batchable. Derived state — never
+    # journaled, always recomputable from the job dict
+    coalesce: tuple | None = None
 
     def status(self) -> dict:
         """JSON-ready snapshot for GET /api/jobs/{id}."""
@@ -222,6 +236,11 @@ class PriorityJobQueue:
         # live (queued) entries per class; deque lengths include
         # tombstones and must never be used as a depth
         self._live: dict[str, int] = {cls: 0 for cls in JOB_CLASSES}
+        # gang index: (class, coalesce key) -> deque of the SAME
+        # (token, record) tuples the class queue holds, so liveness is
+        # one shared predicate. Per-class keying keeps gang pulls from
+        # ever reordering across priority classes.
+        self._by_key: dict[tuple, deque[tuple[int, JobRecord]]] = {}
         self.records: dict[str, JobRecord] = {}
         self._finished: deque[str] = deque()
         self._next_seq = 0
@@ -256,6 +275,16 @@ class PriorityJobQueue:
             q.appendleft(entry)
         else:
             q.append(entry)
+        if record.coalesce is not None:
+            # mirror the entry (not a copy) into the gang index; FIFO
+            # position within the key tracks class-queue position because
+            # both honor the same `front` flag
+            kq = self._by_key.setdefault(
+                (record.job_class, record.coalesce), deque())
+            if front:
+                kq.appendleft(entry)
+            else:
+                kq.append(entry)
         self._live[record.job_class] += 1
         self._refresh_gauges()
 
@@ -268,7 +297,40 @@ class PriorityJobQueue:
         q = self._queues[cls]
         if len(q) - self._live[cls] > max(self._live[cls], 8):
             self._queues[cls] = deque(e for e in q if self._is_live(e))
+            self._compact_key_index(cls)
         self._refresh_gauges()
+
+    def _compact_key_index(self, cls: str) -> None:
+        """Drop tombstones (and empty keys) from the gang index for one
+        class — piggybacks on class-queue compaction so the index's
+        memory is bounded by the same live-entry count."""
+        for key in [k for k in self._by_key if k[0] == cls]:
+            live = deque(e for e in self._by_key[key] if self._is_live(e))
+            if live:
+                self._by_key[key] = live
+            else:
+                del self._by_key[key]
+
+    def queued_peers(self, record: JobRecord):
+        """Queued batchmates of `record` — same class, same coalesce
+        key, FIFO order, `record` itself excluded. Lazily sheds
+        tombstones from the front as it walks. O(peers) per call."""
+        if record.coalesce is None:
+            return
+        kq = self._by_key.get((record.job_class, record.coalesce))
+        if not kq:
+            return
+        # shed dead entries at the head so a hot key's deque can't grow
+        # unboundedly between compactions
+        while kq and not self._is_live(kq[0]):
+            kq.popleft()
+        for entry in list(kq):
+            if not self._is_live(entry):
+                continue
+            peer = entry[1]
+            if peer is record:
+                continue
+            yield peer
 
     # --- admission ---
 
@@ -330,6 +392,7 @@ class PriorityJobQueue:
             submitted_at=self.clock.mono(),
             submitted_wall=self.clock.wall(),
             seq=self._next_seq,
+            coalesce=coalesce_key(job),
         )
         # shed attempts for this id (the submitter backed off and
         # retried) lead the timeline — the backoff gap is real latency
@@ -366,9 +429,14 @@ class PriorityJobQueue:
                 if self._is_live(entry):
                     yield entry[1]
 
-    def take(self, record: JobRecord, worker: str, outcome: str) -> None:
+    def take(self, record: JobRecord, worker: str, outcome: str,
+             gang: dict | None = None) -> None:
         """Remove a queued record for dispatch and stamp its lease-side
-        bookkeeping (attempts, queue wait on the first dispatch)."""
+        bookkeeping (attempts, queue wait on the first dispatch). `gang`
+        is the dispatch-time grouping context ({id, size, index}) when
+        this dispatch rode a gang-scheduled /work reply — recorded in
+        the timeline (and therefore WAL-durable) so a trace shows the
+        job arrived pre-batched."""
         record.state = "leased"
         record.worker = worker
         record.attempts += 1
@@ -379,10 +447,15 @@ class PriorityJobQueue:
                 self.clock.mono() - record.submitted_at, 3)
             _QUEUE_WAIT.observe(record.queue_wait_s,
                                 **{"class": record.job_class})
-        record.timeline.append({
+        event = {
             "event": "dispatch", "wall": self.clock.wall(),
             "worker": worker, "outcome": outcome,
-            "attempt": record.attempts})
+            "attempt": record.attempts}
+        if gang is not None:
+            event["gang"] = str(gang.get("id"))
+            event["gang_size"] = int(gang.get("size", 0))
+            event["gang_index"] = int(gang.get("index", 0))
+        record.timeline.append(event)
         self._dequeued(record)
 
     def observe_settle(self, record: JobRecord) -> None:
@@ -458,6 +531,7 @@ class PriorityJobQueue:
             submitted_wall=submitted_wall,
             seq=int(seq),
             queue_wait_s=queue_wait_s,
+            coalesce=coalesce_key(job),
         )
         self._next_seq = max(self._next_seq, record.seq + 1)
         self.records[job_id] = record

@@ -76,22 +76,35 @@ def worker_stages(result: dict | None) -> list[dict]:
     return stages
 
 
-def wire_trace_context(record) -> dict:
+def wire_trace_context(record, gang: dict | None = None) -> dict:
     """The trace context a /work reply carries into the worker: enough
     for the worker to stamp its half of the trace into the envelope and
     for the hive to attribute the returning spans to the right dispatch
-    attempt. Field set is pinned by the protocol-conformance suite."""
+    attempt. Field set is pinned by the protocol-conformance suite.
+
+    `gang` ({id, size, index}) rides along when this dispatch left as
+    part of a gang-scheduled group — the worker's poll loop uses the id
+    to feed the members into its BatchScheduler as one pre-formed group
+    (flush reason "gang", no linger). Solo dispatches carry NO gang key
+    at all, so a legacy worker sees nothing new."""
     dispatched_wall = None
     for entry in reversed(record.timeline):
         if entry.get("event") == "dispatch":
             dispatched_wall = entry.get("wall")
             break
-    return {
+    context = {
         "id": record.job_id,
         "attempt": record.attempts,
         "dispatched_wall": dispatched_wall,
         "queue_wait_s": record.queue_wait_s,
     }
+    if gang is not None:
+        context["gang"] = {
+            "id": str(gang.get("id")),
+            "size": int(gang.get("size", 0)),
+            "index": int(gang.get("index", 0)),
+        }
+    return context
 
 
 def envelope_trace(result: dict | None) -> dict:

@@ -75,6 +75,10 @@ class Settings:
     batch_linger_ms: float = 50.0
     # most jobs one coalesced group may hold; <= 1 disables coalescing
     max_coalesce: int = 8
+    # text-encoder embedding cache (pipelines/, embed_cache.py): LRU
+    # byte cap in MiB for encoded (model, prompt-text) rows, so gang
+    # members and repeat prompts skip text_encode entirely; 0 disables
+    embed_cache_mb: int = 64
     # --- observability (telemetry.py) ---
     # local /metrics + /healthz HTTP port; 0 disables the server (the
     # in-process instrumentation stays on either way — it is dict ops)
@@ -132,6 +136,12 @@ class Settings:
     # most jobs one /work poll may hand out (also capped by the worker's
     # advertised free capacity)
     hive_max_jobs_per_poll: int = 4
+    # most jobs one gang-scheduled /work group may hold (hive-side
+    # coalescing, ISSUE 9): same-model same-shape queued jobs leave in
+    # ONE reply, pre-batched, sized to min(this, the worker's advertised
+    # gang_rows appetite, hive_max_jobs_per_poll). <= 1 disables gang
+    # scheduling and restores per-job dispatch
+    hive_gang_max: int = 8
     # content-addressed artifact spool directory (relative to $SDAAS_ROOT)
     hive_spool_dir: str = "hive_spool"
     # finished (done/failed) job records kept in memory for
@@ -218,6 +228,8 @@ _ENV_OVERRIDES = {
     "CHIASWARM_HIVE_AFFINITY_HOLD_S": "hive_affinity_hold_s",
     "CHIASWARM_HIVE_WORKER_TTL_S": "hive_worker_ttl_s",
     "CHIASWARM_HIVE_MAX_JOBS_PER_POLL": "hive_max_jobs_per_poll",
+    "CHIASWARM_HIVE_GANG_MAX": "hive_gang_max",
+    "CHIASWARM_EMBED_CACHE_MB": "embed_cache_mb",
     "CHIASWARM_HIVE_SPOOL_DIR": "hive_spool_dir",
     "CHIASWARM_HIVE_JOB_HISTORY_LIMIT": "hive_job_history_limit",
     "CHIASWARM_HIVE_WAL_DIR": "hive_wal_dir",

@@ -21,7 +21,9 @@ Differences by design:
   shape bucket coalesce — after a short linger window — into ONE padded
   denoise+decode pass per slice, each job keeping its own id, seed, and
   result envelope. Anything the batched program can't express dispatches
-  solo, exactly as before.
+  solo, exactly as before. Jobs that arrive pre-batched from a
+  gang-scheduling hive (trace.gang on the /work reply, ISSUE 9) skip
+  the linger window entirely and flush as one group immediately.
 - Released work items land on the scheduler's dispatch board and are
   matched to slices by MODEL RESIDENCY (batching.BatchScheduler.claim +
   chips/allocator residency map): groups route to the slice whose HBM
@@ -514,11 +516,23 @@ class Worker:
         # hives ignore unknown query params)
         caps["jobs_in_flight"] = self.batcher.outstanding_jobs
         caps["busy_slices"] = len(self.allocator) - self.allocator.free_count
-        # jobs accepted but not yet executing (lingering + board): the
-        # residency-aware hive counts this against the next poll's
-        # dispatch budget so it never buries one worker in work
-        caps["queue_depth"] = (
-            self.batcher.pending_jobs + self.batcher.ready_jobs)
+        # in-flight IMAGE ROWS (lingering + ready + executing; ISSUE 9):
+        # the hive's gang budget is row-denominated — counting jobs, or
+        # skipping executing work, would let a gang reply oversubscribe
+        # a slice that is mid-coalesce. Versioning note: a pre-gang hive
+        # reads this with the old jobs-excl-executing semantics and
+        # under-feeds this worker while a coalesced batch executes —
+        # transient, conservative (never oversubscribes), and gone once
+        # the coordinator is upgraded (it keys the new arithmetic off
+        # the gang_rows param below)
+        caps["queue_depth"] = self.batcher.outstanding_rows
+        # per-slice coalescing appetite: how many rows this worker will
+        # merge into ONE pass (the hive sizes gangs by it; 1 = solo-only).
+        # max_coalesce is a JOB cap, so for multi-image jobs this
+        # under-states the slice's true row capacity — deliberately
+        # conservative: gangs under-fill rather than oversubscribe, and
+        # put_gang re-chunks anything that still doesn't fit
+        caps["gang_rows"] = max(self.batcher.max_coalesce, 1)
         caps["jobs_completed"] = int(_JOBS_COMPLETED.total())
         if self._last_poll_monotonic is not None:
             caps["last_poll_age_s"] = round(
@@ -536,6 +550,14 @@ class Worker:
                     jobs = await self.hive.ask_for_work(self._capabilities())
                     self._last_poll_monotonic = time.monotonic()
                     _LAST_POLL.set(time.time())
+                    # a gang-scheduling hive groups same-key jobs in one
+                    # reply and marks them with trace.gang; same-gang
+                    # jobs enter the BatchScheduler as ONE pre-formed
+                    # group (immediate flush, no linger — the hive
+                    # already did the waiting). Everything else takes
+                    # the classic per-job put() path.
+                    gangs: dict[str, list[dict]] = {}
+                    intake: list[tuple[str, object]] = []
                     for job in jobs:
                         print(f"Got job {job['id']}")
                         _JOBS_POLLED.inc()
@@ -546,10 +568,24 @@ class Worker:
                         # contract): note the receipt instant so the
                         # settled timeline can place the worker handoff;
                         # a legacy hive sends none and nothing is added
+                        gang_id = None
                         if isinstance(job.get("trace"), dict):
                             job["trace"].setdefault(
                                 "received_wall", round(time.time(), 3))
-                        await self.batcher.put(job)
+                            gang = job["trace"].get("gang")
+                            if isinstance(gang, dict) and gang.get("id"):
+                                gang_id = str(gang["id"])
+                        if gang_id is None:
+                            intake.append(("job", job))
+                        else:
+                            if gang_id not in gangs:
+                                intake.append(("gang", gang_id))
+                            gangs.setdefault(gang_id, []).append(job)
+                    for kind, item in intake:
+                        if kind == "gang":
+                            await self.batcher.put_gang(gangs[item])
+                        else:
+                            await self.batcher.put(item)
                     sleep_seconds = POLL_SECONDS
                 except asyncio.TimeoutError:
                     # a timeout IS a poll failure: back off like one (the
@@ -633,8 +669,10 @@ class Worker:
                 print(f"slice_worker {e}")
             finally:
                 self.allocator.release(chipset)
-                for _ in batch:
-                    self.batcher.task_done()
+                for job in batch:
+                    # pass the job so the row accounting (advertised
+                    # queue_depth) subtracts its true image count
+                    self.batcher.task_done(job)
                 self._update_queue_gauges()
 
     @staticmethod

@@ -12,8 +12,11 @@ from __future__ import annotations
 import asyncio
 import json
 import time
+import uuid
 
 from aiohttp import web
+
+from chiaswarm_tpu.coalesce import coalesce_key, job_rows
 
 
 class FakeHive:
@@ -55,6 +58,13 @@ class FakeHive:
         # hive stamps one on every handed job; the conformance suite
         # pins the field set so this fake cannot drift)
         self.dispatch_attempts: dict[str, int] = {}
+        # gang scheduling parity (ISSUE 9): compatible pending jobs
+        # (same coalesce key — the SAME shared-module key the real hive
+        # groups by) leave in one reply with trace.gang stamped, sized
+        # to min(gang_max, the poll's advertised gang_rows). A poll
+        # advertising no gang_rows (or 1) never sees a gang, exactly
+        # like the real dispatcher.
+        self.gang_max: int = 8
         self._runner: web.AppRunner | None = None
         self.port: int | None = None
 
@@ -141,21 +151,61 @@ class FakeHive:
         if self.refuse_with is not None:
             return web.json_response({"message": self.refuse_with}, status=400)
         jobs, self.pending_jobs = self.pending_jobs, []
+        try:
+            gang_rows = max(int(request.query.get("gang_rows", 1)), 1)
+        except ValueError:
+            gang_rows = 1
         # wire trace context parity with hive_server/app.py: every
-        # handed job carries {id, attempt, dispatched_wall, queue_wait_s}
+        # handed job carries {id, attempt, dispatched_wall, queue_wait_s},
+        # and gang members additionally carry trace.gang {id, size, index}
         handed = []
-        for job in jobs:
-            job_id = str(job.get("id", ""))
-            attempt = self.dispatch_attempts.get(job_id, 0) + 1
-            self.dispatch_attempts[job_id] = attempt
-            handed.append(dict(job, trace={
-                "id": job_id,
-                "attempt": attempt,
-                "dispatched_wall": round(time.time(), 3),
-                "queue_wait_s": 0.0,
-            }))
+        for group in self._gang_groups(jobs, gang_rows):
+            gang_id = uuid.uuid4().hex[:12] if len(group) > 1 else None
+            for index, job in enumerate(group):
+                job_id = str(job.get("id", ""))
+                attempt = self.dispatch_attempts.get(job_id, 0) + 1
+                self.dispatch_attempts[job_id] = attempt
+                trace = {
+                    "id": job_id,
+                    "attempt": attempt,
+                    "dispatched_wall": round(time.time(), 3),
+                    "queue_wait_s": 0.0,
+                }
+                if gang_id is not None:
+                    trace["gang"] = {"id": gang_id, "size": len(group),
+                                     "index": index}
+                handed.append(dict(job, trace=trace))
         return web.json_response({"jobs": handed},
                                  headers=self._epoch_headers())
+
+    def _gang_groups(self, jobs: list[dict],
+                     gang_rows: int) -> list[list[dict]]:
+        """Partition one reply's jobs into gangs: compatible same-key
+        jobs group (arrival order preserved), chunked to the smaller of
+        `gang_max` jobs and `gang_rows` image rows; everything else is
+        a singleton group."""
+        if gang_rows <= 1 or self.gang_max <= 1:
+            return [[job] for job in jobs]
+        groups: list[list[dict]] = []
+        rows: list[int] = []
+        open_by_key: dict[tuple, int] = {}  # key -> index into groups
+        for job in jobs:
+            key = coalesce_key(job)
+            if key is None:
+                groups.append([job])
+                rows.append(0)
+                continue
+            r = job_rows(job)
+            idx = open_by_key.get(key)
+            if (idx is not None and len(groups[idx]) < self.gang_max
+                    and rows[idx] + r <= gang_rows):
+                groups[idx].append(job)
+                rows[idx] += r
+            else:
+                groups.append([job])
+                rows.append(r)
+                open_by_key[key] = len(groups) - 1
+        return groups
 
     async def _results(self, request: web.Request) -> web.Response:
         denied = self._unauthorized(request)

@@ -80,6 +80,17 @@ def test_bench_cpu_fallback_produces_labeled_smoke_row():
     assert out.get("hive_e2e_queue_wait_p95_s") >= \
         out["hive_e2e_queue_wait_p50_s"], out
 
+    # hive-side coalesced dispatch (ISSUE 9): the 8-job burst arrives
+    # pre-batched (gang_rate > 0 is the unflaky CI floor; the gated-burst
+    # scenario deterministically measures ~1.0 and the acceptance bar is
+    # >= 0.75, carried by the artifact), with a coalesced-size spread and
+    # a warm prompt-embedding cache. The coalesce-4 speedup assertion
+    # below (batched_coalesce4_speedup > 1.0) must survive unchanged —
+    # ganging feeds that same batched pass, it does not replace it.
+    assert out.get("gang_rate", 0) > 0, out
+    assert out.get("gang_size_p50", 0) >= 2, out
+    assert out.get("embed_cache_hit_rate", 0) > 0, out
+
     # end-to-end tracing row (ISSUE 8): every settled job in the
     # hive_e2e scenario must carry a COMPLETE gap-free timeline —
     # admit/dispatch(placement)/settle events, an attributed queue-wait

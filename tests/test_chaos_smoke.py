@@ -2,7 +2,9 @@
 submit drops, hive connection drops, hang-in-denoise under the watchdog,
 crash-before-ack, drain-with-in-flight-job, a hive-side lease takeover
 (worker dies mid-lease, the real coordinator redelivers to a second
-worker), a hive SIGKILL'd while holding queued + leased jobs (WAL
+worker), a worker dying while holding a 4-job GANG mid-denoise (lease
+expiry redelivers every member; exactly-once settle with gap-free
+traces), a hive SIGKILL'd while holding queued + leased jobs (WAL
 replay on restart, zero lost), a primary killed under a WAL-shipped
 standby (health-checked self-promotion, worker failover, zero lost),
 and a revived deposed primary whose stale-epoch ACK must be fenced
@@ -34,6 +36,7 @@ def _load_tool():
     "kill_before_ack",
     "sigterm_drain",
     "hive_lease_takeover",
+    "gang_member_lost",
     "hive_crash_recovery",
     "hive_failover",
     "hive_split_brain_fenced",

@@ -1,0 +1,129 @@
+"""Prompt-embedding cache (ISSUE 9 second rung): LRU/byte-cap unit
+semantics, the Settings knob, and the pipeline integration — repeat
+prompts skip text_encode with bitwise-identical conditioning.
+"""
+
+import numpy as np
+import pytest
+
+from chiaswarm_tpu import embed_cache, telemetry
+from chiaswarm_tpu.embed_cache import EmbedCache
+
+
+@pytest.fixture(autouse=True)
+def fresh_cache():
+    yield
+    embed_cache.reset()
+
+
+def row(fill: float, n: int = 1024) -> np.ndarray:
+    return np.full((n,), fill, dtype=np.float32)  # 4 KiB at n=1024
+
+
+def test_lru_evicts_oldest_past_byte_cap():
+    cache = EmbedCache(3 * row(0).nbytes)
+    for i in range(3):
+        cache.put(("m", f"t{i}"), (row(i), None))
+    assert len(cache) == 3
+    cache.put(("m", "t3"), (row(3), None))
+    assert len(cache) == 3
+    assert cache.lookup(("m", "t0")) is None  # oldest evicted
+    assert cache.lookup(("m", "t3")) is not None
+
+
+def test_lookup_refreshes_recency():
+    cache = EmbedCache(2 * row(0).nbytes)
+    cache.put(("m", "a"), (row(1), None))
+    cache.put(("m", "b"), (row(2), None))
+    assert cache.lookup(("m", "a")) is not None  # a is now most-recent
+    cache.put(("m", "c"), (row(3), None))
+    assert cache.lookup(("m", "b")) is None  # b was the LRU
+    assert cache.lookup(("m", "a")) is not None
+
+
+def test_oversized_entry_is_refused_not_destructive():
+    cache = EmbedCache(row(0).nbytes)
+    cache.put(("m", "small"), (row(1), None))
+    cache.put(("m", "huge"), (row(1, n=4096), None))  # > cap: refused
+    assert cache.lookup(("m", "small")) is not None
+    assert cache.lookup(("m", "huge")) is None
+
+
+def test_replacing_a_key_accounts_bytes_once():
+    cache = EmbedCache(10 * row(0).nbytes)
+    for _ in range(5):
+        cache.put(("m", "same"), (row(1), None))
+    assert len(cache) == 1
+    assert cache.resident_bytes == row(0).nbytes
+
+
+def test_pooled_row_counts_toward_bytes():
+    ctx = row(1)
+    pooled = row(2, n=256)
+    cache = EmbedCache(ctx.nbytes + pooled.nbytes)
+    cache.put(("m", "xl"), (ctx, pooled))
+    assert cache.resident_bytes == ctx.nbytes + pooled.nbytes
+    cache.put(("m", "xl2"), (ctx.copy(), pooled.copy()))
+    assert len(cache) == 1  # the pair didn't fit twice
+
+
+def test_hit_miss_counters_count_rows():
+    events = telemetry.REGISTRY.get("swarm_embed_cache_total")
+    h0, m0 = events.value(event="hit"), events.value(event="miss")
+    EmbedCache.note_rows(3, 2)
+    assert events.value(event="hit") == h0 + 3
+    assert events.value(event="miss") == m0 + 2
+
+
+def test_settings_knob_sizes_process_cache(monkeypatch, sdaas_root):
+    monkeypatch.setenv("CHIASWARM_EMBED_CACHE_MB", "1")
+    embed_cache.reset()
+    cache = embed_cache.get_cache()
+    assert cache is not None and cache.max_bytes == 1024 * 1024
+    monkeypatch.setenv("CHIASWARM_EMBED_CACHE_MB", "0")
+    embed_cache.reset()
+    assert embed_cache.get_cache() is None
+
+
+@pytest.fixture(scope="module")
+def tiny_pipe():
+    from chiaswarm_tpu.pipelines.stable_diffusion import SDPipeline
+
+    return SDPipeline("test/tiny-sd")
+
+
+def test_encode_prompts_hits_cache_and_matches_uncached(sdaas_root,
+                                                        tiny_pipe):
+    """Pipeline integration on the tiny model: a second encode of the
+    same texts is served from the cache (hit counters move, not the
+    encoder) and the conditioning matches the uncached encode exactly."""
+    pipe = tiny_pipe
+    events = telemetry.REGISTRY.get("swarm_embed_cache_total")
+
+    embed_cache.configure(None)  # disabled: the reference encode
+    ref_ctx, ref_pooled = pipe.encode_prompts(["", "a red cube"],
+                                              pipe.params)
+    assert ref_pooled is None  # tiny-sd is not XL
+
+    embed_cache.configure(8 * 1024 * 1024)
+    h0, m0 = events.value(event="hit"), events.value(event="miss")
+    ctx1, _ = pipe.encode_prompts(["", "a red cube"], pipe.params)
+    assert events.value(event="miss") == m0 + 2  # both rows cold
+    ctx2, _ = pipe.encode_prompts(["", "a red cube", ""], pipe.params)
+    assert events.value(event="hit") >= h0 + 3  # every row warm now
+    np.testing.assert_array_equal(np.asarray(ctx1), np.asarray(ctx2)[:2])
+    # cached rows are bitwise what the encoder produced
+    np.testing.assert_array_equal(np.asarray(ctx1), np.asarray(ref_ctx))
+
+
+def test_encode_prompts_bypasses_cache_for_overridden_encoders(sdaas_root,
+                                                               tiny_pipe):
+    """Job-specific tokenizers/embeddings (textual inversion) must not
+    read or write the shared cache — their rows are job-local."""
+    pipe = tiny_pipe
+    events = telemetry.REGISTRY.get("swarm_embed_cache_total")
+    embed_cache.configure(8 * 1024 * 1024)
+    before = (events.value(event="hit"), events.value(event="miss"))
+    pipe.encode_prompts(["x"], pipe.params, tokenizers=pipe.tokenizers)
+    assert (events.value(event="hit"),
+            events.value(event="miss")) == before

@@ -286,6 +286,58 @@ def test_work_reply_carries_trace_context(backend_name):
         assert isinstance(trace["attempt"], int) and trace["attempt"] >= 1
         assert isinstance(trace["dispatched_wall"], (int, float))
         assert isinstance(trace["queue_wait_s"], (int, float))
+        # a solo dispatch carries NO gang key at all — the key's absence
+        # is what tells the worker's poll loop to take the classic path
+        assert "gang" not in trace
+
+    run_conformance(backend_name, scenario)
+
+
+def gang_job(i: int) -> dict:
+    """Coalesce-compatible txt2img jobs (same model/canvas/steps) — the
+    exact shape both the hive's gang scheduler and the worker's
+    BatchScheduler bucket together via the shared coalesce module."""
+    return {"id": f"conf-gang-{i}", "workflow": "txt2img",
+            "model_name": "stabilityai/stable-diffusion-2-1",
+            "prompt": f"gang member {i}", "height": 64, "width": 64,
+            "num_inference_steps": 2,
+            "parameters": {"test_tiny_model": True}}
+
+
+def test_gang_reply_groups_compatible_jobs(backend_name):
+    """ISSUE 9: a poll advertising gang capacity (`gang_rows`) receives
+    same-key queued jobs as ONE pre-batched group — every member carries
+    `trace.gang = {id, size, index}` with one shared id, the true group
+    size, and its position. Pinned across all three backends so
+    fake_hive cannot drift from the gang wire contract."""
+
+    async def scenario(backend, client):
+        for i in range(3):
+            backend.queue_job(gang_job(i))
+        jobs = await client.ask_for_work(dict(CAPS, gang_rows=8))
+        assert [j["id"] for j in jobs] == [f"conf-gang-{i}" for i in range(3)]
+        gangs = [j["trace"]["gang"] for j in jobs]
+        assert len({g["id"] for g in gangs}) == 1 and gangs[0]["id"]
+        assert all(g["size"] == 3 for g in gangs)
+        assert [g["index"] for g in gangs] == [0, 1, 2]
+        # each member still carries its OWN per-job trace context — a
+        # gang is a dispatch-time grouping, not a merged job
+        assert [j["trace"]["id"] for j in jobs] == [j["id"] for j in jobs]
+
+    run_conformance(backend_name, scenario)
+
+
+def test_no_gang_without_worker_gang_rows(backend_name):
+    """A worker that does not advertise `gang_rows` keeps the pre-gang
+    contract: jobs may still arrive in one reply, but never marked as a
+    gang — a legacy worker must see nothing new on the wire."""
+
+    async def scenario(backend, client):
+        for i in range(2):
+            backend.queue_job(gang_job(i))
+        jobs = await client.ask_for_work(dict(CAPS))
+        assert jobs  # at least one handed
+        assert all("gang" not in j["trace"] for j in jobs)
 
     run_conformance(backend_name, scenario)
 

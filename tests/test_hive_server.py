@@ -225,7 +225,7 @@ def test_dispatch_prefers_resident_worker():
     assert dispatcher.select(cold, q) == []
     # the warm worker gets it with the affinity outcome
     handed = dispatcher.select(warm, q)
-    assert [(r.job_id, o) for r, o in handed] == [("warmjob", "affinity")]
+    assert [(r.job_id, o) for r, o, _ in handed] == [("warmjob", "affinity")]
     delta = {k: v - before[k] for k, v in _dispatch_counts().items()}
     assert delta["affinity"] == 1 and delta["hold"] == 1
 
@@ -243,13 +243,13 @@ def test_dispatch_steals_after_hold_window_and_cold_without_holders():
 
     # no live holder anywhere -> cold, immediately
     handed = dispatcher.select(cold, q)
-    assert [(r.job_id, o) for r, o in handed] == [("coldjob", "cold")]
-    for record, outcome in handed:  # what the /work handler does
-        q.take(record, cold.name, outcome)
+    assert [(r.job_id, o) for r, o, _ in handed] == [("coldjob", "cold")]
+    for record, outcome, gang in handed:  # what the /work handler does
+        q.take(record, cold.name, outcome, gang=gang)
     assert held.state == "queued"  # still held for the warm worker
     time.sleep(0.06)  # the hold window lapses
     handed = dispatcher.select(cold, q)
-    assert [(r.job_id, o) for r, o in handed] == [("heldjob", "steal")]
+    assert [(r.job_id, o) for r, o, _ in handed] == [("heldjob", "steal")]
 
 
 def test_dispatch_dead_holders_do_not_hold_jobs():
@@ -262,7 +262,7 @@ def test_dispatch_dead_holders_do_not_hold_jobs():
     time.sleep(0.06)  # the warm worker ages out of the liveness window
     cold = _observe(directory, "cold-worker")
     handed = dispatcher.select(cold, q)
-    assert [(r.job_id, o) for r, o in handed] == [("orphan", "cold")]
+    assert [(r.job_id, o) for r, o, _ in handed] == [("orphan", "cold")]
 
 
 def test_dispatch_skips_unconverted_families():
@@ -274,7 +274,7 @@ def test_dispatch_skips_unconverted_families():
     limited = _observe(directory, "limited", unconverted_families="bark,svd")
     assert dispatcher.select(limited, q) == []
     capable = _observe(directory, "capable")
-    assert [r.job_id for r, _ in dispatcher.select(capable, q)] == ["bark1"]
+    assert [r.job_id for r, _, _ in dispatcher.select(capable, q)] == ["bark1"]
 
 
 def test_dispatch_unconverted_keywords_match_case_insensitively():
@@ -327,11 +327,58 @@ def test_dispatch_budget_respects_advertised_capacity():
     part = _observe(directory, "part", slices=2, busy_slices=1,
                     queue_depth=0)
     assert len(dispatcher.select(part, q)) == 1  # one free slice
-    # advertised queue depth consumes the free slice: this poll is a
+    # a LEGACY poller (no gang_rows) keeps the exact pre-gang contract:
+    # advertised queue depth consumes the free slice — this poll is a
     # heartbeat, handing it a job would bury the worker
     saturated = _observe(directory, "saturated", slices=2, busy_slices=1,
                          queue_depth=1)
     assert dispatcher.select(saturated, q) == []
+    # a GANG-AWARE poller reports rows incl. executing: same saturation,
+    # new arithmetic (2 slices x 1-row appetite, 1 executing + 1 ready)
+    aware = _observe(directory, "aware", slices=2, busy_slices=1,
+                     queue_depth=2, gang_rows=1)
+    assert dispatcher.select(aware, q) == []
+    aware_free = _observe(directory, "aware-free", slices=2, busy_slices=1,
+                          queue_depth=1, gang_rows=1)
+    assert len(dispatcher.select(aware_free, q)) == 1  # idle slice fed
+
+
+def test_dispatch_budget_rows_cap_gang_replies():
+    """The gang budget is row-denominated: a worker mid-coalesce (its
+    executing rows advertised in queue_depth) must not be handed more
+    rows than its remaining appetite — and a worker with NO gang_rows
+    advertisement keeps the one-job-per-free-slice pre-gang contract."""
+    directory = WorkerDirectory(ttl_s=45.0)
+    dispatcher = Dispatcher(directory, affinity_hold_s=0.0,
+                            max_jobs_per_poll=8, gang_max=8)
+    q = PriorityJobQueue()
+    for i in range(8):
+        q.submit({"id": f"g{i}", "workflow": "txt2img",
+                  "model_name": "stabilityai/stable-diffusion-2-1",
+                  "prompt": str(i), "height": 64, "width": 64,
+                  "parameters": {"test_tiny_model": True}})
+    # no free slice at all: nothing, however big the appetite
+    busy = _observe(directory, "mid-coalesce", slices=1, busy_slices=1,
+                    queue_depth=6, gang_rows=8)
+    assert dispatcher.select(busy, q) == []
+    # 1 idle slice, appetite 8, 6 rows already lingering toward a
+    # coalesced pass: only 2 rows of appetite remain -> gang of 2
+    part = _observe(directory, "partial", slices=1, busy_slices=0,
+                    queue_depth=6, gang_rows=8)
+    handed = dispatcher.select(part, q)
+    assert len(handed) == 2
+    assert [g["size"] for _, _, g in handed] == [2, 2]
+    # an idle worker with a free second slice takes a FULL gang for it
+    fresh = _observe(directory, "fresh", slices=2, busy_slices=1,
+                     queue_depth=6, gang_rows=8)
+    handed = dispatcher.select(fresh, q)
+    assert len(handed) == 8  # one gang of 8 rows fits the free slice
+    assert {g["id"] for _, _, g in handed} == {handed[0][2]["id"]}
+    # legacy advertiser (no gang_rows): one 1-row job per free slice
+    legacy = _observe(directory, "legacy", slices=2, busy_slices=0)
+    handed = dispatcher.select(legacy, q)
+    assert len(handed) == 2
+    assert all(g is None for _, _, g in handed)  # never ganged
 
 
 def test_retire_bounds_finished_record_history():

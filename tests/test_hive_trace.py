@@ -401,7 +401,7 @@ def test_affinity_hold_is_visible_and_deduped_in_timeline():
     warm = directory.observe({"worker_name": "warm-w", "worker_version": "1",
                               "resident_models": "m/a", "slices": "1",
                               "busy_slices": "0"})
-    [(handed, outcome)] = dispatcher.select(warm, queue)
+    [(handed, outcome, _)] = dispatcher.select(warm, queue)
     assert handed is record and outcome == "affinity"
     queue.take(record, "warm-w", outcome)
     trace = build_trace(record, CLOCK.wall())

@@ -66,6 +66,18 @@ def test_inprocess_smoke_job_prints_stage_table(sdaas_root, capsys):
     assert rc == 0
     for stage in ("compile", "denoise", "decode", "text_encode"):
         assert stage in out, out
+    # the smoke encode went through the embedding cache (default-on)
+    assert "embed cache" in out and "hit_rate=" in out, out
+
+
+def test_embed_cache_line_from_synthetic_text():
+    tool = _load_tool()
+    samples = tool.parse_metrics(
+        'swarm_embed_cache_total{event="hit"} 6\n'
+        'swarm_embed_cache_total{event="miss"} 2\n')
+    assert tool.embed_cache_line(samples) == \
+        "embed cache    hit=6 miss=2 hit_rate=0.75"
+    assert tool.embed_cache_line([]) is None
 
 
 HIVE_SYNTHETIC = """\
@@ -73,6 +85,13 @@ HIVE_SYNTHETIC = """\
 swarm_hive_dispatch_total{outcome="affinity"} 6
 swarm_hive_dispatch_total{outcome="cold"} 2
 swarm_hive_dispatch_total{outcome="hold"} 1
+swarm_hive_dispatch_total{outcome="gang"} 4
+# TYPE swarm_hive_gang_size histogram
+swarm_hive_gang_size_bucket{le="2"} 1
+swarm_hive_gang_size_bucket{le="4"} 2
+swarm_hive_gang_size_bucket{le="+Inf"} 2
+swarm_hive_gang_size_sum 6
+swarm_hive_gang_size_count 2
 # TYPE swarm_hive_jobs_submitted_total counter
 swarm_hive_jobs_submitted_total{class="default"} 7
 swarm_hive_jobs_submitted_total{class="batch"} 3
@@ -109,7 +128,11 @@ def test_hive_tables_from_synthetic_text():
     scrape produces."""
     tool = _load_tool()
     summary = tool.hive_summary(tool.parse_metrics(HIVE_SYNTHETIC))
-    assert summary["dispatch"] == {"affinity": 6, "cold": 2, "hold": 1}
+    assert summary["dispatch"] == {"affinity": 6, "cold": 2, "gang": 4,
+                                   "hold": 1}
+    # gang-scheduled dispatch (ISSUE 9): 2 gangs totalling 6 jobs
+    assert summary["gang"] == {"gangs": 2, "jobs": 6,
+                               "size_p50": 2.0, "size_p95": 4.0}
     assert summary["submitted"] == {"batch": 3, "default": 7}
     assert summary["shed"] == {"batch": 2}
     assert summary["leases_active"] == 2
@@ -123,6 +146,10 @@ def test_hive_tables_from_synthetic_text():
 
     table = tool.render_hive_tables(summary)
     assert "affinity" in table and "6" in table
+    # 6 gang jobs over 12 delivered (hold excluded) -> rate 0.50;
+    # sizes render as integer job counts, not seconds
+    assert "hive gangs    count=2 jobs=6 rate=0.50" in table
+    assert "size p50<=2 p95<=4" in table
     assert "hive admission by class" in table
     assert "batch" in table and "shed" not in summary["dispatch"]
     assert "hive queue wait" in table

@@ -47,6 +47,14 @@ swarm_hive_queue_depth{class="interactive"} 0
 # TYPE swarm_hive_dispatch_total counter
 swarm_hive_dispatch_total{outcome="affinity"} 10
 swarm_hive_dispatch_total{outcome="cold"} 3
+swarm_hive_dispatch_total{outcome="gang"} 9
+# TYPE swarm_hive_gang_size histogram
+swarm_hive_gang_size_bucket{le="2"} 0
+swarm_hive_gang_size_bucket{le="4"} 2
+swarm_hive_gang_size_bucket{le="8"} 3
+swarm_hive_gang_size_bucket{le="+Inf"} 3
+swarm_hive_gang_size_sum 12
+swarm_hive_gang_size_count 3
 # TYPE swarm_hive_shed_total counter
 swarm_hive_shed_total{class="batch"} 4
 # TYPE swarm_hive_workers_live gauge
@@ -66,6 +74,9 @@ swarm_job_stage_seconds_bucket{stage="denoise",le="5"} 4
 swarm_job_stage_seconds_bucket{stage="denoise",le="+Inf"} 4
 swarm_job_stage_seconds_sum{stage="denoise"} 6.0
 swarm_job_stage_seconds_count{stage="denoise"} 4
+# TYPE swarm_embed_cache_total counter
+swarm_embed_cache_total{event="hit"} 30
+swarm_embed_cache_total{event="miss"} 10
 """
 
 
@@ -85,6 +96,10 @@ def test_render_hive_and_worker_frames_from_synthetic_data():
     assert "interactive=0 default=2 batch=5" in lines
     assert "leases=2" in lines
     assert "affinity=10" in lines and "cold=3" in lines
+    # gang-scheduled dispatch (ISSUE 9): 12 of 22 delivered jobs left
+    # pre-batched in 3 gangs; size quantiles from the histogram
+    assert "gang=9" in lines
+    assert "gangs=3 jobs=12 rate=0.55 size p50<=4 p95<=8" in lines
     assert "batch=4" in lines  # shed
     assert "! shedding batch jobs" in lines
     assert "appends_since_compact=7" in lines
@@ -108,6 +123,8 @@ def test_render_hive_and_worker_frames_from_synthetic_data():
     assert "slice 1" in lines and "quarantined" in lines
     assert "denoise p50<=1s p95<=5s" in lines
     assert "failovers=0" in lines
+    # prompt-embedding cache hit rate (ISSUE 9)
+    assert "hit=30 miss=10 hit_rate=0.75" in lines
 
     # an unreachable endpoint renders as such instead of raising
     dead = tool.Snapshot("http://gone:1", error="ConnectionError: refused")

@@ -262,6 +262,87 @@ async def scenario_hive_lease_takeover() -> str:
     return "dead worker's lease expired; second worker completed the job"
 
 
+async def scenario_gang_member_lost() -> str:
+    """Gang dispatch under failure (ISSUE 9): a worker takes a 4-job
+    GANG in one /work reply and dies mid-denoise holding all four
+    leases. Lease expiry must redeliver every member (possibly as
+    singles — a gang is a dispatch-time grouping, not a lifecycle), a
+    second worker must complete all four, every job settles EXACTLY
+    once, and each trace timeline is gap-free across the loss."""
+    from chiaswarm_tpu import telemetry
+    from chiaswarm_tpu.hive_server import LocalSwarm
+    from chiaswarm_tpu.hive_server.trace import build_trace, trace_missing
+    from chiaswarm_tpu.settings import Settings
+
+    def gang_job(i: int) -> dict:
+        return {"id": f"chaos-gang-{i}", "workflow": "txt2img",
+                "model_name": "stabilityai/stable-diffusion-2-1",
+                "prompt": f"gang member {i}", "seed": 7000 + i,
+                "height": 64, "width": 64, "num_inference_steps": 2,
+                "parameters": {"test_tiny_model": True}}
+
+    # worker 1 hangs at the denoise entry (before any compile), so the
+    # scenario's only real pipeline work is worker 2's clean gang pass
+    faults.configure("hang_denoise=1", hang_timeout_s=120.0)
+    results_ok = telemetry.REGISTRY.get(
+        "swarm_hive_results_total") or telemetry.counter(
+        "swarm_hive_results_total", "", ("status",))
+    ok_before = results_ok.value(status="ok")
+    settings = Settings(sdaas_token="chaos", hive_port=0, metrics_port=0,
+                        hive_lease_deadline_s=1.0, hive_max_redeliveries=3,
+                        hive_max_jobs_per_poll=8, hive_gang_max=8)
+    swarm = LocalSwarm(n_workers=0, chips_per_job=0, settings=settings)
+    plan = faults.get_plan()
+    async with swarm:
+        # all four queued BEFORE the first worker exists: its first poll
+        # deterministically receives them as ONE gang
+        ids = [await swarm.submit(gang_job(i)) for i in range(4)]
+        swarm.add_worker("chaos-gang-worker-1")
+        _check(await _spin(lambda: plan.hanging == 1),
+               "worker 1 never started the gang")
+        records = [swarm.hive.queue.records[j] for j in ids]
+        dispatches = [e for r in records for e in r.timeline
+                      if e.get("event") == "dispatch"]
+        _check(len(dispatches) == 4
+               and all(e.get("gang_size") == 4 for e in dispatches)
+               and len({e.get("gang") for e in dispatches}) == 1,
+               f"jobs were not dispatched as one 4-gang: {dispatches}")
+        # the gang holder dies mid-denoise with all 4 leases
+        await swarm.stop_worker(swarm.workers[0])
+        faults.configure("")  # the takeover worker runs clean
+        _check(await _spin(
+            lambda: all(r.state == "queued" for r in records), 15.0),
+            "lease expiry never redelivered every gang member")
+        # the 1 s deadline existed to expire the DEAD holder fast; the
+        # takeover worker legitimately pays a cold tiny-model compile
+        # (tens of seconds), which must not read as a second loss
+        swarm.hive.leases.deadline_s = 600.0
+        swarm.add_worker("chaos-gang-worker-2")
+        for job_id in ids:
+            status = await swarm.wait_done(job_id, timeout=240.0)
+            _check(status["status"] == "done",
+                   f"gang member {job_id} lost across the worker death")
+            _check(status["attempts"] >= 2,
+                   f"{job_id} should record the redelivery attempt")
+        # exactly-once settle: one ok ACK per member, and the late
+        # worker-1 envelopes (it died before producing any) never land
+        _check(results_ok.value(status="ok") == ok_before + 4,
+               "members did not settle exactly once")
+        for job_id in ids:
+            trace = build_trace(swarm.hive.queue.records[job_id],
+                                swarm.hive.queue.clock.wall())
+            missing = trace_missing(trace)
+            _check(not missing,
+                   f"{job_id} timeline incomplete: {missing}")
+            kinds = [e["event"] for e in trace["events"]]
+            _check(kinds.count("settle") == 1
+                   and kinds.count("redeliver") == 1,
+                   f"{job_id} timeline duplicated/lost events: {kinds}")
+        plan.release_hangs()  # unstick worker 1's orphaned thread
+    return ("4-job gang redelivered after its holder died mid-denoise; "
+            "all members settled exactly once with gap-free traces")
+
+
 async def scenario_hive_crash_recovery() -> str:
     """Hive durability (ISSUE 6 acceptance): a hive subprocess holding
     one QUEUED and one LEASED job is killed with SIGKILL; a restart over
@@ -576,6 +657,7 @@ SCENARIOS = {
     "kill_before_ack": scenario_kill_before_ack,
     "sigterm_drain": scenario_sigterm_drain,
     "hive_lease_takeover": scenario_hive_lease_takeover,
+    "gang_member_lost": scenario_gang_member_lost,
     "hive_crash_recovery": scenario_hive_crash_recovery,
     "hive_failover": scenario_hive_failover,
     "hive_split_brain_fenced": scenario_hive_split_brain_fenced,

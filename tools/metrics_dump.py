@@ -179,11 +179,32 @@ def _class_quantiles(samples, name: str) -> list[dict]:
     return rows
 
 
+def _gang_summary(samples) -> dict:
+    """Gang-size histogram -> {gangs, jobs, p50, p95} (ISSUE 9)."""
+    buckets, count, total = [], 0.0, 0.0
+    for metric, labels, value in samples:
+        if metric == "swarm_hive_gang_size_bucket":
+            le = labels.get("le", "+Inf")
+            buckets.append(
+                (float("inf") if le == "+Inf" else float(le), value))
+        elif metric == "swarm_hive_gang_size_count":
+            count = value
+        elif metric == "swarm_hive_gang_size_sum":
+            total = value
+    return {
+        "gangs": int(count),
+        "jobs": int(total),
+        "size_p50": _quantile_from_buckets(buckets, count, 0.5),
+        "size_p95": _quantile_from_buckets(buckets, count, 0.95),
+    }
+
+
 def hive_summary(samples) -> dict:
     """Exposition samples -> the hive-side dispatch/shed/lease view."""
     return {
         "dispatch": {k: int(v) for k, v in sorted(_label_counts(
             samples, "swarm_hive_dispatch_total", "outcome").items())},
+        "gang": _gang_summary(samples),
         "submitted": {k: int(v) for k, v in sorted(_label_counts(
             samples, "swarm_hive_jobs_submitted_total", "class").items())},
         "shed": {k: int(v) for k, v in sorted(_label_counts(
@@ -217,6 +238,24 @@ def render_hive_tables(summary: dict) -> str:
     else:
         lines.append("  (no dispatches yet)")
 
+    gang = summary.get("gang") or {}
+    if gang.get("gangs"):
+        # gang rate = jobs that left pre-batched over all DELIVERED jobs
+        # ("hold" is a deferral, not a delivery); sizes are job COUNTS,
+        # not seconds — integer buckets, +Inf = past the largest bucket
+        def fmt_size(v):
+            if v is None:
+                return "-"
+            return ">16" if v == float("inf") else str(int(v))
+
+        delivered = sum(n for o, n in summary["dispatch"].items()
+                        if o != "hold") or 1
+        lines.append(
+            f"hive gangs    count={gang['gangs']} jobs={gang['jobs']} "
+            f"rate={min(gang['jobs'] / delivered, 1.0):.2f} "
+            f"size p50<={fmt_size(gang['size_p50'])} "
+            f"p95<={fmt_size(gang['size_p95'])}")
+
     lines.append("hive admission by class "
                  "(queued now / admitted / shed 429)")
     classes = sorted(set(summary["submitted"]) | set(summary["shed"])
@@ -246,6 +285,19 @@ def render_hive_tables(summary: dict) -> str:
                 f"  {r['class']:<12} n={r['count']:<6} "
                 f"p50<={fmt(r['p50_le_s'])} p95<={fmt(r['p95_le_s'])}")
     return "\n".join(lines)
+
+
+def embed_cache_line(samples) -> str | None:
+    """Worker-side prompt-embedding cache summary (ISSUE 9), rendered
+    under the stage table; None when no lookup ever happened (cache
+    disabled, or no encode ran)."""
+    events = _label_counts(samples, "swarm_embed_cache_total", "event")
+    hits, misses = events.get("hit", 0.0), events.get("miss", 0.0)
+    total = hits + misses
+    if total <= 0:
+        return None
+    return (f"embed cache    hit={int(hits)} miss={int(misses)} "
+            f"hit_rate={hits / total:.2f}")
 
 
 async def _run_smoke_job() -> None:
@@ -325,8 +377,12 @@ def main(argv: list[str] | None = None) -> int:
 
     if args.raw:
         print(text)
-    rows = stage_rows(parse_metrics(text))
+    samples = parse_metrics(text)
+    rows = stage_rows(samples)
     print(render_table(rows))
+    embed = embed_cache_line(samples)
+    if embed:
+        print(embed)
     return 0 if rows else 1
 
 

@@ -183,6 +183,25 @@ def render_hive(cur: Snapshot, prev: Snapshot | None) -> list[str]:
         f"{o}={int(n)}{rate(n, pdispatch.get(o), dt)}"
         for o, n in sorted(dispatch.items())) or "(none yet)"))
 
+    # gang-scheduled dispatch (ISSUE 9): how often jobs leave pre-batched
+    # and how big the groups run (size quantiles over the last interval)
+    gang_buckets = bucket_delta(
+        cur.histogram("swarm_hive_gang_size"),
+        prev.histogram("swarm_hive_gang_size") if prev else None)
+    gangs_total = cur.gauge("swarm_hive_gang_size_count")
+    gang_jobs = cur.gauge("swarm_hive_gang_size_sum")
+    if gangs_total:
+        p50 = quantile_from_buckets(gang_buckets, 0.5)
+        p95 = quantile_from_buckets(gang_buckets, 0.95)
+        # "hold" is a deferral, not a delivery — keep it out of the base
+        total_jobs = sum(n for o, n in dispatch.items() if o != "hold") or 1
+        lines.append(
+            f"  gang      gangs={int(gangs_total)} "
+            f"jobs={int(gang_jobs or 0)} "
+            f"rate={min((gang_jobs or 0) / total_jobs, 1.0):.2f} "
+            f"size p50<={'-' if p50 is None else int(p50)} "
+            f"p95<={'-' if p95 is None else int(p95)}")
+
     shed = cur.counters("swarm_hive_shed_total", "class")
     pshed = prev.counters("swarm_hive_shed_total", "class") if prev else {}
     if shed:
@@ -251,6 +270,19 @@ def render_worker(cur: Snapshot, prev: Snapshot | None) -> list[str]:
         lines.append(
             f"  slice {s.get('slice_id', '?')}   {busy:<5} "
             f"{s.get('state', '?'):<12} resident: {resident}")
+
+    # prompt-embedding cache (ISSUE 9): per-row hit rate — at scale the
+    # shared "" negative alone should hold this well above zero
+    embed = cur.counters("swarm_embed_cache_total", "event")
+    hits, misses = embed.get("hit", 0.0), embed.get("miss", 0.0)
+    if hits + misses > 0:
+        dt = (cur.taken - prev.taken) if prev else 0.0
+        pembed = prev.counters(
+            "swarm_embed_cache_total", "event") if prev else {}
+        lines.append(
+            f"  embed     hit={int(hits)}"
+            f"{rate(hits, pembed.get('hit'), dt)} miss={int(misses)} "
+            f"hit_rate={hits / (hits + misses):.2f}")
 
     # per-stage latency over the last interval (cumulative in --once)
     stages: dict[str, dict[float, float]] = {}
