@@ -1138,6 +1138,11 @@ def run_hive_e2e_row() -> None:
             SDAAS_WORKERNAME="bench-hive-worker",
             CHIASWARM_POLL_SECONDS="0.1",
             CHIASWARM_METRICS_PORT=str(metrics_port),
+            # chunked denoise (ISSUE 10): the cancel_reclaim_s phase
+            # needs chunk boundaries to abort at; the 2-step burst jobs
+            # run as a single 2-step chunk, so their numbers are
+            # unchanged in practice
+            CHIASWARM_DENOISE_CHUNK_STEPS="2",
             PYTHONPATH=repo + os.pathsep + os.environ.get("PYTHONPATH", ""),
         )
         worker = subprocess.Popen(
@@ -1281,6 +1286,55 @@ def run_hive_e2e_row() -> None:
                         else:
                             await asyncio.sleep(1.0)
 
+                # --- cancellation phase (ISSUE 10): wall clock from the
+                # cancel POST to the slice reporting free, asserted
+                # against a measured full pass of the same shape ---
+                async def busy_slices() -> float:
+                    async with session.get(
+                            "http://127.0.0.1:"
+                            f"{metrics_port}/metrics") as resp:
+                        for line in (await resp.text()).splitlines():
+                            if line.startswith("swarm_slices_busy "):
+                                return float(line.rsplit(None, 1)[-1])
+                    return 0.0
+
+                def long_job(tag: str) -> dict:
+                    # a pass long enough to cancel INSIDE: many chunk
+                    # boundaries at denoise_chunk_steps=2, short enough
+                    # that the two reference passes stay cheap
+                    return dict(tiny_job(0, tag), num_inference_steps=32)
+
+                # two reference passes: the first pays the fresh 48-step
+                # chunk-program compiles, the second measures the warm
+                # full-pass wall the reclaim must beat
+                await wait_done(await submit(long_job("cancel-warm")), 600.0)
+                t0 = time.monotonic()
+                await wait_done(await submit(long_job("cancel-ref")), 240.0)
+                full_pass_s = time.monotonic() - t0
+
+                victim = await submit(long_job("cancel-victim"))
+                # cancel once the pass is actually ON the slice
+                deadline = time.monotonic() + 60.0
+                while time.monotonic() < deadline:
+                    if await busy_slices() >= 1:
+                        break
+                    await asyncio.sleep(0.02)
+                t0 = time.monotonic()
+                async with session.post(
+                        f"{hive.api_uri}/jobs/{victim}/cancel",
+                        headers=headers) as resp:
+                    cancel_ack = await resp.json()
+                reclaim_s = None
+                deadline = time.monotonic() + max(2 * full_pass_s, 30.0)
+                while time.monotonic() < deadline:
+                    if await busy_slices() == 0:
+                        reclaim_s = time.monotonic() - t0
+                        break
+                    await asyncio.sleep(0.02)
+                async with session.get(f"{hive.api_uri}/jobs/{victim}",
+                                       headers=headers) as resp:
+                    victim_status = (await resp.json())["status"]
+
             waits.sort()
             pre_batched = sum(1 for s in gang_sizes if s >= 2)
             gang_sizes.sort()
@@ -1308,6 +1362,15 @@ def run_hive_e2e_row() -> None:
                     embed_hits / embed_total, 3) if embed_total else 0.0,
                 "embed_cache_hits": int(embed_hits),
                 "embed_cache_misses": int(embed_misses),
+                # cancellation & deadlines (ISSUE 10): cancel POST ->
+                # slice free, vs the warm full pass it interrupted.
+                # cancel_raced=True means the pass finished before the
+                # cancel landed (the no-op side of the pinned race)
+                "cancel_reclaim_s": (round(reclaim_s, 3)
+                                     if reclaim_s is not None else None),
+                "cancel_full_pass_s": round(full_pass_s, 3),
+                "cancel_victim_status": victim_status,
+                "cancel_raced": not bool(cancel_ack.get("cancelled")),
             }
         finally:
             worker.terminate()  # SIGTERM -> graceful drain

@@ -459,6 +459,54 @@ class BatchScheduler:
             )
         self._release(group["jobs"])
 
+    def cancel(self, job_id: str) -> bool:
+        """Drop a job the hive cancelled while it was still HELD here —
+        lingering in an open group or released to the dispatch board but
+        not yet claimed by a slice. Returns True when found (the caller
+        produces no envelope for it: the hive tombstoned the job, the
+        worker simply never runs it). A job already claimed/executing is
+        NOT here — that is the cancel registry's half (cancel.py), probed
+        by the chunked denoise at chunk boundaries.
+
+        Accounting mirrors the claim/task_done path: the job leaves
+        outstanding/ready/row counters so poll gating and the advertised
+        queue_depth stay truthful, and an emptied group or board entry
+        disappears entirely (its linger timer cancelled)."""
+        job_id = str(job_id)
+
+        def matches(job: dict) -> bool:
+            return str(job.get("id")) == job_id
+
+        for key, group in list(self._pending.items()):
+            for job in group["jobs"]:
+                if not matches(job):
+                    continue
+                group["jobs"].remove(job)
+                group["rows"] -= job_rows(job)
+                self._outstanding -= 1
+                if not group["jobs"]:
+                    group["timer"].cancel()
+                    del self._pending[key]
+                logger.info("cancelled lingering job %s before dispatch",
+                            job_id)
+                return True
+        for entry in list(self._board):
+            for job in entry["jobs"]:
+                if not matches(job):
+                    continue
+                rows = job_rows(job)
+                entry["jobs"].remove(job)
+                entry["rows"] -= rows
+                self._ready_jobs -= 1
+                self._ready_rows -= rows
+                self._outstanding -= 1
+                if not entry["jobs"]:
+                    self._board.remove(entry)
+                logger.info("cancelled board job %s before a slice "
+                            "claimed it", job_id)
+                return True
+        return False
+
     def flush_all(self) -> None:
         """Release every lingering group immediately (shutdown/tests)."""
         for key in list(self._pending):

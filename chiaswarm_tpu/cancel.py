@@ -1,0 +1,107 @@
+"""Worker-side cancel tokens: stop burning a chip on work nobody wants.
+
+The hive owns the durable half of cancellation (a WAL-journaled
+``cancelled`` lifecycle state, see hive_server/); this module is the
+volatile worker half — a process-wide registry of job ids whose cancel
+the hive piggybacked on a ``/work`` reply (``cancels: [...]``) while the
+job was already EXECUTING on a slice. The poll loop marks the id here,
+and the chunked denoise path (pipelines/stable_diffusion.py,
+``denoise_chunk_steps``) probes the registry at every chunk boundary:
+
+- a solo pass whose job is cancelled aborts with :class:`JobCancelled`
+  and frees the slice within one chunk instead of one full pass;
+- a coalesced pass with a cancelled MEMBER keeps running (batchmates
+  must finish unharmed — the padded program's shapes are fixed), but the
+  cancelled row's envelope is never built or delivered;
+- a coalesced pass whose EVERY member is cancelled aborts like a solo.
+
+Jobs the worker still holds pre-execution (lingering or on the dispatch
+board) never reach this registry — ``BatchScheduler.cancel`` drops them
+outright. Ids are discarded when their pass ends, so a resubmission of
+the same id later is never poisoned by a stale token.
+
+Thread-safe by construction: the asyncio loop marks ids while slice
+executor threads probe them.
+"""
+
+from __future__ import annotations
+
+import threading
+
+from . import telemetry
+
+_PENDING = telemetry.gauge(
+    "swarm_cancel_tokens_pending",
+    "Job ids marked cancelled while executing, not yet reaped by their "
+    "slice (the chunked denoise probes these at chunk boundaries)")
+
+
+class JobCancelled(Exception):
+    """An executing pass was aborted because every live row's job was
+    cancelled. Carries the job ids so the caller can account them; the
+    worker produces NO envelope for an aborted pass — the hive already
+    tombstoned the jobs, and a late result would only earn a
+    ``cancelled`` disposition."""
+
+    def __init__(self, job_ids):
+        self.job_ids = [str(j) for j in (job_ids or [])]
+        super().__init__(
+            "job cancelled mid-denoise: " + (",".join(self.job_ids) or "?"))
+
+
+class CancelRegistry:
+    """Set of cancelled-while-executing job ids (marked by the poll loop,
+    probed by executor threads, discarded when the pass ends)."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._ids: set[str] = set()
+
+    def cancel(self, job_id) -> None:
+        with self._lock:
+            self._ids.add(str(job_id))
+            _PENDING.set(len(self._ids))
+
+    def cancelled(self, job_id) -> bool:
+        with self._lock:
+            return str(job_id) in self._ids
+
+    def discard(self, job_id) -> None:
+        with self._lock:
+            self._ids.discard(str(job_id))
+            _PENDING.set(len(self._ids))
+
+    def clear(self) -> None:
+        with self._lock:
+            self._ids.clear()
+            _PENDING.set(0)
+
+
+_REGISTRY = CancelRegistry()
+
+
+def get_registry() -> CancelRegistry:
+    return _REGISTRY
+
+
+def cancel(job_id) -> None:
+    _REGISTRY.cancel(job_id)
+
+
+def cancelled(job_id) -> bool:
+    return _REGISTRY.cancelled(job_id)
+
+
+def discard(job_id) -> None:
+    _REGISTRY.discard(job_id)
+
+
+def current_job_ids() -> list[str]:
+    """The job id(s) pinned on this thread by ``telemetry.trace_job``
+    (a coalesced pass pins the comma-joined list). How a pipeline deep
+    inside a workflow callback learns which job it is running without
+    every layer re-plumbing an id argument."""
+    raw = telemetry.current_job_id.get(None)
+    if not raw:
+        return []
+    return [part for part in str(raw).split(",") if part]

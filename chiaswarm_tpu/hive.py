@@ -179,6 +179,11 @@ class HiveClient:
         self._consecutive_errors = 0
         self._failover_errors = max(
             int(getattr(settings, "hive_failover_errors", 2) or 2), 1)
+        # job ids the last successful /work reply asked this worker to
+        # CANCEL (the hive's `cancels` piggyback, ISSUE 10); the worker
+        # routes them through its BatchScheduler / cancel registry after
+        # each poll. A legacy hive sends none and this stays empty.
+        self.last_cancels: list[str] = []
         self._session: aiohttp.ClientSession | None = None
         self._session_loop: asyncio.AbstractEventLoop | None = None
         self._refresh_active_gauge()
@@ -350,9 +355,16 @@ class HiveClient:
                     self._note_success()
                     try:
                         payload = await response.json()
+                        # lease revocations ride the same reply; surface
+                        # them per-poll (stale cancels must not linger
+                        # into the next poll's view)
+                        self.last_cancels = [
+                            str(c) for c in (payload.get("cancels") or [])
+                            if c]
                         return payload["jobs"]
                     except Exception:
                         logger.exception("malformed /work response")
+                        self.last_cancels = []
                         return []
 
                 if response.status == 400:

@@ -13,6 +13,9 @@ closed-source:
 
   POST /api/jobs            submit a job (admission control; 429 on a
                             full queue), returns {"id", "class"}
+  POST /api/jobs/{id}/cancel  revoke a queued or leased job (WAL-durable;
+                            leased cancels ride the next /work reply's
+                            `cancels` piggyback to the lessee)
   GET  /api/jobs/{id}       lifecycle snapshot + spooled result
   GET  /api/artifacts/{d}   content-addressed artifact bytes
   GET  /metrics, /healthz   same telemetry registry the worker uses
@@ -43,6 +46,8 @@ from .journal import (
     HiveJournal,
     apply_events,
     ev_admit,
+    ev_cancel,
+    ev_expire,
     ev_lease,
     ev_park,
     ev_requeue,
@@ -65,9 +70,13 @@ logger = logging.getLogger(__name__)
 _RESULTS = telemetry.counter(
     "swarm_hive_results_total",
     "Result envelopes POSTed to the hive, by disposition "
-    "(ok | duplicate | late | unknown)",
+    "(ok | duplicate | late | unknown | cancelled | expired)",
     ("status",),
 )
+_CANCEL_REVOCATIONS = telemetry.gauge(
+    "swarm_hive_cancel_revocations_pending",
+    "Leased-job cancels awaiting delivery to their lessee via the next "
+    "/work reply's `cancels` piggyback (lease already revoked hive-side)")
 _POLLS = telemetry.counter(
     "swarm_hive_polls_total",
     "GET /work polls answered, by reply (jobs | empty | refused)",
@@ -164,7 +173,33 @@ class HiveServer:
                 snapshot_events(self.queue, self.leases, self.epoch))
             self.journal.snapshot_fn = (
                 lambda: snapshot_events(self.queue, self.leases, self.epoch))
+        # leased-job cancels awaiting their lessee's next poll:
+        # worker name -> job ids, delivered as the /work reply's
+        # `cancels` piggyback. Volatile by design (the durable fact is
+        # the record's `cancelled` state) — rebuilt from the records
+        # after WAL replay and standby promotion, so a worker mid-denoise
+        # across a hive crash still hears about the revocation
+        self._cancel_notify: dict[str, set[str]] = {}
+        self.rebuild_cancel_notify()
         self.note_role_change()
+
+    def rebuild_cancel_notify(self) -> None:
+        """Re-derive the pending-revocation map from record state (WAL
+        recovery, standby promotion). A cancelled-while-leased record
+        whose lessee never answered is re-notified on that worker's next
+        poll; re-notifying a worker that already dropped the job is a
+        harmless no-op on its side."""
+        self._cancel_notify = {}
+        for record in self.queue.records.values():
+            if (record.state == "cancelled"
+                    and record.cancel_stage == "leased" and record.worker):
+                self._cancel_notify.setdefault(
+                    record.worker, set()).add(record.job_id)
+        self._refresh_cancel_gauge()
+
+    def _refresh_cancel_gauge(self) -> None:
+        _CANCEL_REVOCATIONS.set(
+            sum(len(ids) for ids in self._cancel_notify.values()))
 
     def note_role_change(self) -> None:
         """Refresh the role/epoch gauges (called again on promotion)."""
@@ -181,7 +216,8 @@ class HiveServer:
             depth_limit=int(g("hive_queue_depth_limit", 256)),
             history_limit=int(g("hive_job_history_limit", 1000)),
             shed_watermarks=parse_shed_watermarks(
-                g("hive_shed_watermarks", None)))
+                g("hive_shed_watermarks", None)),
+            job_ttl_s=float(g("hive_job_ttl_s", 0.0)))
         leases = LeaseTable(
             deadline_s=float(g("hive_lease_deadline_s", 300.0)),
             max_redeliveries=int(g("hive_max_redeliveries", 3)),
@@ -204,6 +240,7 @@ class HiveServer:
         app.router.add_post("/api/results", self._results)
         app.router.add_get("/api/models", self._models)
         app.router.add_post("/api/jobs", self._submit)
+        app.router.add_post("/api/jobs/{job_id}/cancel", self._cancel)
         app.router.add_get("/api/jobs/{job_id}", self._job_status)
         app.router.add_get("/api/jobs/{job_id}/trace", self._job_trace)
         app.router.add_get("/api/artifacts/{digest}", self._artifact)
@@ -285,6 +322,7 @@ class HiveServer:
                             "re-queued at the front of class %s",
                             record.job_id, record.attempts,
                             record.job_class)
+                self._expire_due()
                 self._park_unplaceable()
                 self._sweep_spool_if_due()
             except Exception:
@@ -436,7 +474,17 @@ class HiveServer:
             return web.json_response(
                 {"message": "worker_version is required"}, status=400)
         worker = self.directory.observe(query)
-        handed = self.dispatcher.select(worker, self.queue)
+        # park TTL-lapsed queued jobs BEFORE the dispatcher looks: an
+        # expired job must never waste this poll's dispatch budget
+        self._expire_due()
+        if query.get("cancel_only"):
+            # heartbeat from a saturated worker (every slice busy): it
+            # cannot take work but must still hear about revocations of
+            # the leases it is executing — and the observe() above keeps
+            # it live in the directory through a long denoise
+            handed = []
+        else:
+            handed = self.dispatcher.select(worker, self.queue)
         for record, outcome, gang in handed:
             # a gang is a dispatch-time grouping, NOT a new lifecycle:
             # each member is taken, leased, and journaled individually —
@@ -460,11 +508,24 @@ class HiveServer:
         # its stage spans attach to the right dispatch attempt, and gang
         # members carry trace.gang so they arrive pre-batched. Field
         # set pinned by the protocol-conformance suite.
-        return web.json_response(
-            {"jobs": [dict(record.job,
-                           trace=wire_trace_context(record, gang=gang))
-                      for record, _, gang in handed]},
-            headers=self._epoch_headers())
+        reply = {"jobs": [dict(record.job,
+                               trace=wire_trace_context(record, gang=gang))
+                          for record, _, gang in handed]}
+        # piggyback pending lease revocations for THIS worker: the ids
+        # of its live leases cancelled since its last poll. Popped on
+        # delivery — a reply lost in flight degrades to the job running
+        # to completion and its late result earning the `cancelled`
+        # disposition (the durable state, not this hint, is the truth).
+        # Legacy workers ignore the unknown key; the key is absent when
+        # there is nothing to revoke, so the pre-cancel wire shape is
+        # byte-identical (conformance-pinned).
+        cancels = self._cancel_notify.pop(worker.name, None)
+        if cancels:
+            reply["cancels"] = sorted(cancels)
+            self._refresh_cancel_gauge()
+            logger.info("revoking %d cancelled lease(s) from %s: %s",
+                        len(cancels), worker.name, sorted(cancels))
+        return web.json_response(reply, headers=self._epoch_headers())
 
     async def _results(self, request: web.Request) -> web.Response:
         if not self._authorized(request):
@@ -499,6 +560,30 @@ class HiveServer:
             # nothing re-stored
             _RESULTS.inc(status="duplicate")
             return web.json_response({"status": "ok", "duplicate": True})
+        if record.state in ("cancelled", "expired"):
+            # the cancel/TTL won the race: the result is not stored, but
+            # the ACK names the disposition so the worker's outbox can
+            # PARK the envelope (reason visible in outbox_inspect)
+            # instead of retrying a submission this hive will never
+            # accept. The cancel-vs-result race is pinned: whichever
+            # settled first wins, this side is an idempotent no-op.
+            disposition = record.state
+            _RESULTS.inc(status=disposition)
+            # only the CURRENT lessee's own envelope proves it knows: a
+            # late result from a PREVIOUS lessee (expired lease, job
+            # redelivered, then cancelled) must not silence the pending
+            # revocation the live lessee still needs to abort its pass
+            sender = str(result.get("worker_name") or "") or None
+            if record.worker and sender == record.worker:
+                pending = self._cancel_notify.get(record.worker)
+                if pending and job_id in pending:
+                    pending.discard(job_id)
+                    if not pending:
+                        del self._cancel_notify[record.worker]
+                    self._refresh_cancel_gauge()
+            return web.json_response(
+                {"status": "ok", disposition: True},
+                headers=self._epoch_headers())
         # the envelope's own worker_name (stamped by the worker's outbox
         # path; optional on the wire) identifies the true sender — the
         # current lease does NOT: a late result from an expired lessee
@@ -556,6 +641,81 @@ class HiveServer:
         _RESULTS.inc(status=status)
         return web.json_response(
             {"status": "ok"}, headers=self._epoch_headers())
+
+    async def _cancel(self, request: web.Request) -> web.Response:
+        """POST /api/jobs/{id}/cancel: revoke a job. A QUEUED job is
+        tombstoned from its class queue (and the gang index) on the spot;
+        a LEASED one has its lease revoked hive-side and the lessee is
+        told on its next /work poll (`cancels` piggyback) so a chunked
+        denoise can abort within one chunk. Races are pinned: whichever
+        settles first wins — cancelling a done/settling job is an
+        idempotent no-op (cancelled=False, the result stands), and a
+        result arriving after a cancel earns the `cancelled` disposition
+        (the worker's outbox parks it instead of retrying forever).
+        Every real transition is WAL-journaled before the response
+        leaves, so a cancel survives SIGKILL recovery and standby
+        promotion exactly like lease state."""
+        if not self._authorized(request):
+            return self._unauthorized()
+        refused = self._refused(request)
+        if refused is not None:
+            return refused
+        job_id = request.match_info["job_id"]
+        record = self.queue.records.get(job_id)
+        if record is None:
+            return web.json_response(
+                {"message": "unknown job id"}, status=404)
+
+        def reply(cancelled: bool) -> web.Response:
+            return web.json_response({
+                "id": job_id,
+                "status": record.state,
+                "cancelled": cancelled,
+            }, headers=self._epoch_headers())
+
+        if record.state == "cancelled":
+            return reply(True)  # idempotent repeat
+        if record.state in ("done", "settling", "failed", "expired"):
+            # the other side of the race already settled; no-op
+            return reply(False)
+        if record.state == "queued":
+            self.queue.mark_cancelled(record, "queued")
+            self._journal(ev_cancel(record))
+            for pruned in self.queue.retire(record):
+                self._journal(ev_retire(pruned))
+            logger.info("job %s cancelled while queued", job_id)
+            return reply(True)
+        # leased: revoke the lease (the reaper must not redeliver a job
+        # nobody wants) and queue the revocation for the lessee's next
+        # poll; the denoise chunk boundary does the actual abort
+        self.leases.settle(job_id)
+        self.queue.mark_cancelled(record, "leased")
+        self._journal(ev_cancel(record))
+        for pruned in self.queue.retire(record):
+            self._journal(ev_retire(pruned))
+        if record.worker:
+            self._cancel_notify.setdefault(
+                record.worker, set()).add(job_id)
+            self._refresh_cancel_gauge()
+        logger.warning(
+            "job %s cancelled while leased to %s (attempt %d); lease "
+            "revoked, worker notified on its next poll",
+            job_id, record.worker, record.attempts)
+        return reply(True)
+
+    def _expire_due(self) -> None:
+        """Park queued jobs whose admission-time TTL lapsed. Runs before
+        every dispatch decision (an expired job must not waste a
+        dispatch) and on every reaper pass (so expiry fires even with no
+        worker polling)."""
+        for record in self.queue.expired_queued():
+            self.queue.mark_expired(record)
+            self._journal(ev_expire(record))
+            for pruned in self.queue.retire(record):
+                self._journal(ev_retire(pruned))
+            logger.warning("job %s expired after %.0fs queued (TTL)",
+                           record.job_id,
+                           self.queue.clock.mono() - record.submitted_at)
 
     async def _models(self, request: web.Request) -> web.Response:
         # deliberately unauthenticated: public catalog, reference parity

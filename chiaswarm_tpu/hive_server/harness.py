@@ -172,6 +172,15 @@ class LocalSwarm:
             payload = await resp.json()
             return payload["id"]
 
+    async def cancel(self, job_id: str) -> dict:
+        """POST /api/jobs/{id}/cancel against the active hive (the
+        submitter-side revoke the cancellation scenarios drive)."""
+        async with self._session.post(
+                f"{self.active_hive.api_uri}/jobs/{job_id}/cancel",
+                headers=self._headers()) as resp:
+            resp.raise_for_status()
+            return await resp.json()
+
     async def job_status(self, job_id: str) -> dict:
         async with self._session.get(
                 f"{self.active_hive.api_uri}/jobs/{job_id}",

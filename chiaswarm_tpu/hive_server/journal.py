@@ -7,7 +7,8 @@ silently lost every queued and leased job even though the artifact spool
 under $SDAAS_ROOT already survived. This module closes that gap with the
 same write-ahead discipline the outbox uses, at coordinator granularity:
 
-- every state transition — admit, lease, settle, requeue, park, retire —
+- every state transition — admit, lease, settle, requeue, park, cancel,
+  expire, retire —
   appends one JSON line to ``$SDAAS_ROOT/hive_wal/wal.jsonl`` *after* the
   in-memory mutation and *before* the HTTP response leaves (so a client
   never holds an ACK for state the journal missed);
@@ -322,6 +323,23 @@ def ev_park(record) -> dict:
             "attempts": record.attempts, "timeline": _timeline_of(record)}
 
 
+def ev_cancel(record) -> dict:
+    """A cancel is a first-class lifecycle transition, journaled exactly
+    like lease state: replayed on SIGKILL recovery, folded into
+    compaction snapshots, and shipped to the standby — a promoted hive
+    keeps refusing the cancelled job's dispatch and answers its late
+    result with the `cancelled` disposition."""
+    return {"ev": "cancel", "id": record.job_id,
+            "stage": record.cancel_stage, "worker": record.worker,
+            "error": record.error, "attempts": record.attempts,
+            "timeline": _timeline_of(record)}
+
+
+def ev_expire(record) -> dict:
+    return {"ev": "expire", "id": record.job_id, "error": record.error,
+            "timeline": _timeline_of(record)}
+
+
 def ev_retire(job_id: str) -> dict:
     return {"ev": "retire", "id": job_id}
 
@@ -357,6 +375,10 @@ def snapshot_events(queue: PriorityJobQueue, leases: LeaseTable,
             events.append(ev_settle(record))
         elif record.state == "failed":
             events.append(ev_park(record))
+        elif record.state == "cancelled":
+            events.append(ev_cancel(record))
+        elif record.state == "expired":
+            events.append(ev_expire(record))
     for record in queue.iter_queued():
         events.append(ev_admit(record))
     return events
@@ -456,6 +478,26 @@ def apply_events(events: list[dict], queue: PriorityJobQueue,
             record.attempts = int(event.get("attempts", record.attempts))
             restore_timeline(record, event)
             queue.retire(record)
+        elif ev == "cancel":
+            # restore directly — never through mark_cancelled, which
+            # would re-count the cancel and re-stamp the timeline the
+            # event already carries verbatim
+            leases.settle(record.job_id)
+            queue.discard_queued(record)
+            record.state = "cancelled"
+            record.cancel_stage = event.get("stage")
+            record.error = event.get("error")
+            record.attempts = int(event.get("attempts", record.attempts))
+            if event.get("worker"):
+                record.worker = event.get("worker")
+            restore_timeline(record, event)
+            queue.retire(record)
+        elif ev == "expire":
+            queue.discard_queued(record)
+            record.state = "expired"
+            record.error = event.get("error")
+            restore_timeline(record, event)
+            queue.retire(record)
         elif ev == "retire":
             queue.forget(record.job_id)
         else:
@@ -466,7 +508,8 @@ def apply_events(events: list[dict], queue: PriorityJobQueue,
     states: dict[str, int] = {}
     for record in queue.records.values():
         states[record.state] = states.get(record.state, 0) + 1
-    for state in ("queued", "leased", "done", "failed"):
+    for state in ("queued", "leased", "done", "failed", "cancelled",
+                  "expired"):
         _RECOVERED_JOBS.set(states.get(state, 0), state=state)
     return {"jobs": len(queue.records), "states": states,
             "leases": len(leases), "skipped": skipped, "epoch": epoch}

@@ -79,6 +79,18 @@ _SHED = telemetry.counter(
     "watermark crossed; batch sheds first, interactive last)",
     ("class",),
 )
+_CANCELLED = telemetry.counter(
+    "swarm_hive_cancelled_total",
+    "Jobs cancelled via POST /api/jobs/{id}/cancel, by the lifecycle "
+    "stage the cancel caught them in (queued = tombstoned before any "
+    "dispatch; leased = revoked mid-flight via the /work piggyback)",
+    ("stage",),
+)
+_EXPIRED = telemetry.counter(
+    "swarm_hive_expired_total",
+    "Queued jobs parked as expired by the admission-time TTL "
+    "(hive_job_ttl_s / per-job deadline_s) before wasting a dispatch",
+)
 # hive-side latency buckets: 5 ms (a poll already in flight) up to 10
 # minutes (a batch job parked behind a long compile) — the stage
 # histograms' DEFAULT_BUCKETS stop at 300 s, too short for queue waits
@@ -159,10 +171,12 @@ class QueueFull(Exception):
 @dataclasses.dataclass
 class JobRecord:
     """One job's hive-side lifecycle. `state` walks
-    queued -> leased -> settling -> done, with the exit `failed`
-    (redelivery budget exhausted) and a leased->queued loop on lease
-    expiry ("settling" = result accepted, artifact spool write in
-    flight)."""
+    queued -> leased -> settling -> done, with the exits `failed`
+    (redelivery budget exhausted), `cancelled` (revoked via
+    POST /api/jobs/{id}/cancel — from queued or leased), and `expired`
+    (the admission-time TTL lapsed while still queued), and a
+    leased->queued loop on lease expiry ("settling" = result accepted,
+    artifact spool write in flight)."""
 
     job: dict
     job_id: str
@@ -195,6 +209,16 @@ class JobRecord:
     # admit/restore; None = not batchable. Derived state — never
     # journaled, always recomputable from the job dict
     coalesce: tuple | None = None
+    # admission-time TTL: monotonic instant past which a still-QUEUED
+    # job parks as `expired` (None = no deadline). Derived at admit and
+    # restore from submitted_at + the job's own `deadline_s` (or the
+    # hive_job_ttl_s default), so it spans restarts via the re-anchored
+    # submitted_at — never persisted as-is
+    expires_at: float | None = None
+    # which lifecycle stage a cancel caught this job in ("queued" |
+    # "leased"); carried in the WAL cancel event so replay, compaction,
+    # and replication all reconstruct it
+    cancel_stage: str | None = None
 
     def status(self) -> dict:
         """JSON-ready snapshot for GET /api/jobs/{id}."""
@@ -219,8 +243,11 @@ class PriorityJobQueue:
 
     def __init__(self, depth_limit: int = 0, history_limit: int = 0,
                  shed_watermarks: dict[str, float] | None = None,
-                 clock: HiveClock | None = None):
+                 clock: HiveClock | None = None, job_ttl_s: float = 0.0):
         self.depth_limit = int(depth_limit)
+        # admission-time TTL default (per-job `deadline_s` overrides);
+        # 0 = queued jobs never expire
+        self.job_ttl_s = max(float(job_ttl_s), 0.0)
         # finished (done/failed) records kept for GET /api/jobs/{id};
         # past this many the oldest are forgotten so a long-running
         # coordinator's memory is bounded by the limit, not its job
@@ -334,6 +361,16 @@ class PriorityJobQueue:
 
     # --- admission ---
 
+    def _ttl_of(self, job: dict) -> float:
+        """Effective TTL for one job: its own `deadline_s` field when
+        positive, else the hive-wide default. 0 = never expires."""
+        raw = job.get("deadline_s")
+        try:
+            ttl = float(raw) if raw is not None else 0.0
+        except (TypeError, ValueError):
+            ttl = 0.0
+        return ttl if ttl > 0 else self.job_ttl_s
+
     def shed_threshold(self, cls: str) -> int:
         """Queued-job count at which class `cls` submissions shed
         (0 = unlimited)."""
@@ -394,6 +431,9 @@ class PriorityJobQueue:
             seq=self._next_seq,
             coalesce=coalesce_key(job),
         )
+        ttl = self._ttl_of(job)
+        if ttl > 0:
+            record.expires_at = record.submitted_at + ttl
         # shed attempts for this id (the submitter backed off and
         # retried) lead the timeline — the backoff gap is real latency
         # the trace must attribute
@@ -479,6 +519,48 @@ class PriorityJobQueue:
             "worker": record.worker, "attempt": record.attempts})
         self._enqueue(record, front=True)
 
+    # states a record can end in (history pruning + status rendering)
+    TERMINAL_STATES = ("done", "failed", "cancelled", "expired")
+
+    def mark_cancelled(self, record: JobRecord, stage: str) -> None:
+        """Move a record to the terminal `cancelled` state. `stage` names
+        where the cancel caught it: "queued" (tombstoned from its class
+        queue and the gang index before any dispatch) or "leased" (the
+        lease is the caller's to settle; the record keeps its lessee so
+        the /work piggyback knows whom to notify). Counted once per
+        transition — replay paths restore state directly and never come
+        through here."""
+        self.discard_queued(record)
+        record.state = "cancelled"
+        record.cancel_stage = stage
+        record.error = f"cancelled while {stage}"
+        record.timeline.append({
+            "event": "cancel", "wall": self.clock.wall(), "stage": stage,
+            **({"worker": record.worker} if stage == "leased" else {})})
+        _CANCELLED.inc(stage=stage)
+
+    def mark_expired(self, record: JobRecord) -> None:
+        """Move a still-queued record to the terminal `expired` state:
+        its admission-time TTL lapsed before any worker could take it.
+        Dispatch never sees it again, and a submitter poll reads the
+        honest outcome instead of a stale queue position."""
+        self.discard_queued(record)
+        record.state = "expired"
+        ttl = self._ttl_of(record.job)
+        record.error = (
+            f"expired: still queued {ttl:g}s after submission "
+            "(hive_job_ttl_s / per-job deadline_s)")
+        record.timeline.append({
+            "event": "expire", "wall": self.clock.wall(), "ttl_s": ttl})
+        _EXPIRED.inc()
+
+    def expired_queued(self) -> list[JobRecord]:
+        """Queued records whose TTL has lapsed (the caller parks them,
+        journals the transition, and retires)."""
+        now = self.clock.mono()
+        return [r for r in self.iter_queued()
+                if r.expires_at is not None and r.expires_at <= now]
+
     def retire(self, record: JobRecord) -> list[str]:
         """Note a record reaching a terminal state and prune the oldest
         finished ones past `history_limit`. Returns the pruned job ids
@@ -498,7 +580,7 @@ class PriorityJobQueue:
         while len(self._finished) > self.history_limit:
             old = self._finished.popleft()
             stale = self.records.get(old)
-            if stale is not None and stale.state in ("done", "failed"):
+            if stale is not None and stale.state in self.TERMINAL_STATES:
                 del self.records[old]
                 pruned.append(old)
         return pruned
@@ -533,6 +615,12 @@ class PriorityJobQueue:
             queue_wait_s=queue_wait_s,
             coalesce=coalesce_key(job),
         )
+        ttl = self._ttl_of(record.job)
+        if ttl > 0:
+            # submitted_at was re-anchored above, so the TTL window spans
+            # the restart: a job that expired while the hive was down
+            # parks on the first post-recovery expiry sweep
+            record.expires_at = record.submitted_at + ttl
         self._next_seq = max(self._next_seq, record.seq + 1)
         self.records[job_id] = record
         self._enqueue(record)

@@ -338,6 +338,10 @@ class StandbyHive:
                     "promotion snapshot failed; serving as primary at "
                     "epoch %d anyway (state is NOT restart-durable)",
                     srv.epoch)
+        # replication applied cancel events straight into the record
+        # table; the promoted hive must also take over the NOTIFY half
+        # (tell surviving lessees about revocations on their next poll)
+        srv.rebuild_cancel_notify()
         srv.note_role_change()
         _PROMOTIONS.inc()
         self.promoted = True

@@ -49,6 +49,17 @@ _GAP_LABELS = {
     ("lease", "park"): "lease_lost",
     ("dispatch", "settle"): "executing",
     ("admit", "park"): "unplaceable_wait",
+    # cancellation & deadlines (ISSUE 10): a cancel caught the job
+    # waiting (hive_queue) or executing; an expire is TTL'd queue time
+    ("admit", "cancel"): "hive_queue",
+    ("hold", "cancel"): "hive_queue",
+    ("redeliver", "cancel"): "hive_queue",
+    ("dispatch", "cancel"): "executing",
+    ("lease", "cancel"): "executing",
+    ("cancel", "settle"): "cancel_vs_result_race",
+    ("admit", "expire"): "ttl_expired",
+    ("hold", "expire"): "ttl_expired",
+    ("redeliver", "expire"): "ttl_expired",
 }
 
 def worker_stages(result: dict | None) -> list[dict]:
@@ -154,7 +165,7 @@ def build_trace(record, now_wall: float) -> dict[str, Any]:
         gaps.append(gap)
 
     terminal = events[-1].get("event") if events else None
-    open_ended = terminal not in ("settle", "park")
+    open_ended = terminal not in ("settle", "park", "cancel", "expire")
     total_s = round(
         (now_wall if open_ended else float(events[-1]["wall"])) - t0, 3)
 

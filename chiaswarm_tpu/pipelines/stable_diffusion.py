@@ -287,6 +287,10 @@ class SDPipeline:
 
         self._jit_lock = threading.Lock()
         self._programs: dict[tuple, callable] = {}
+        # assembled denoise runners (fused wrapper or chunked set) keyed
+        # (bucket key, chunk size): a warm pass is one dict lookup, not a
+        # scheduler rebuild + per-sub-program cache probe
+        self._runner_cache: dict[tuple, callable] = {}
         # jitted aux programs — ONE device dispatch for text encode and VAE
         # encode instead of op-by-op applies (each unjitted op is a separate
         # host->device round trip; round 1 measured >50% of job time on the
@@ -444,6 +448,7 @@ class SDPipeline:
         """Drop device references so HBM frees on registry eviction."""
         self.params = None
         self._programs.clear()
+        self._runner_cache.clear()
         self._controlnets.clear()
         self._lora_cache.clear()
         self._ti_cache.clear()
@@ -807,24 +812,22 @@ class SDPipeline:
 
     # --- the jitted core ---
 
-    def _denoise_program(self, key, controlnet_module=None):
-        """Build (or fetch) the jitted denoise+decode program for one bucket.
+    def _denoise_parts(self, key, controlnet_module=None):
+        """The denoise program's composable pieces for one bucket:
+        ``prep`` (initial latents + scheduler state), ``make_steps(n)``
+        (n compiled iterations of the shared step body, starting at a
+        traced ``offset``), and ``decode`` (VAE decode + on-device uint8
+        quantize), plus the loop bounds. ``_denoise_program`` fuses them
+        into the classic single jitted program (the zero-cost
+        ``denoise_chunk_steps=0`` path); the chunked path jits them
+        separately so the executor thread can probe cancel tokens
+        (cancel.py) between compiled chunks. Both paths run the exact
+        same ops on the same values in the same order, so their outputs
+        are bitwise identical (pinned by tests/test_cancel.py).
 
         key = (mode, lh, lw, batch, steps, scheduler_key, t_start,
                cn_key) where cn_key = (controlnet_name, cg_lo, cg_hi) or None
         """
-        with self._jit_lock:
-            if key in self._programs:
-                _COMPILE_CACHE.inc(event="hit")
-                return self._programs[key]
-        _COMPILE_CACHE.inc(event="miss")
-        if self.chipset is not None:
-            # compile event -> placement layer: refresh this model's
-            # residency so the dispatch board keeps routing same-model
-            # groups at the slice that owns the jitted programs
-            from ..chips.allocator import note_resident
-
-            note_resident(self.model_name, self.chipset.slice_id)
         mode, lh, lw, batch, steps, sched_key, t_start, cn_key = key
         scheduler = get_scheduler(
             sched_key[0],
@@ -846,9 +849,8 @@ class SDPipeline:
         decode_area = lh * lw
         big_decode = decode_area >= 9216 and batch >= 2 and self.data_parts == 1
 
-        def run(params, init_rng, context, added, guidance_scale, image_guidance,
-                image_latents, mask, rng, cn_params, control_cond, cn_scale):
-            """context [cfg_rows*B,77,D] (uncond first); noise drawn in-program."""
+        def prep(params, init_rng, image_latents):
+            """Initial latents (f32) + scheduler state, pre-step-loop."""
             if mode in ("batched", "batched_i2i"):
                 # cross-job coalesced pass: init_rng is a [batch] key
                 # array, one per row, each derived only from its own job's
@@ -860,133 +862,150 @@ class SDPipeline:
                 latents = jax.random.normal(
                     init_rng, (batch, lh, lw, latent_c), jnp.float32
                 )
-            if mode in ("img2img", "batched_i2i"):
+            if mode in ("img2img", "batched_i2i", "inpaint"):
                 # batched_i2i: image_latents is the [batch] stack of each
-                # row's own start-image latents (padding rows zeros)
+                # row's own start-image latents (padding rows zeros);
+                # inpaint denoises from the clean image's noised latents
                 latents = scheduler.add_noise(
                     schedule, image_latents, latents, loop_start
                 )
-            elif mode == "inpaint":
-                clean = image_latents
-                latents = scheduler.add_noise(schedule, clean, latents, loop_start)
             else:
                 # txt2img and pix2pix both denoise from pure noise; pix2pix's
                 # image conditioning rides the UNet's channel dim instead
                 latents = latents * jnp.asarray(
                     schedule.init_noise_sigma, latents.dtype
                 )
-
             state = scheduler.init_state(latents.shape, latents.dtype)
-            if mode == "pix2pix":
-                # per-row channel conditioning: zeros for the uncond row so
-                # image guidance has a true no-image baseline
-                cond_rows = jnp.concatenate(
-                    [jnp.zeros_like(image_latents), image_latents, image_latents],
-                    axis=0,
-                ).astype(self.dtype)
-            if mode == "inpaint9":
-                # dedicated inpaint UNet: mask plane + masked-image latents
-                # ride the channel dim on both CFG rows
-                cond9 = jnp.concatenate([mask, image_latents], axis=-1)
-                cond9 = jnp.concatenate([cond9, cond9], axis=0).astype(
-                    self.dtype
-                )
-            if cn_key is not None:
-                control2 = jnp.concatenate([control_cond, control_cond], axis=0).astype(
-                    self.dtype
-                )
-                _, cg_lo, cg_hi = cn_key
+            return latents.astype(jnp.float32), state
 
-            def body(carry, i):
-                latents, state = carry
-                inp = scheduler.scale_model_input(schedule, latents, i)
-                model_in = jnp.concatenate([inp] * cfg_rows, axis=0).astype(
-                    self.dtype
-                )
+        def make_steps(length: int):
+            """`length` step-body iterations from a traced `offset` (the
+            fused program passes loop_start once; the chunked path walks
+            the same index sequence in denoise_chunk_steps strides)."""
+
+            def run_steps(params, latents, state, context, added,
+                          guidance_scale, image_guidance, image_latents,
+                          mask, rng, cn_params, control_cond, cn_scale,
+                          offset):
+                """context [cfg_rows*B,77,D] (uncond first)."""
                 if mode == "pix2pix":
-                    # image latents join unscaled: the edit checkpoint was
-                    # trained on raw latent-dist modes
-                    model_in = jnp.concatenate([model_in, cond_rows], axis=-1)
-                elif mode == "inpaint9":
-                    model_in = jnp.concatenate([model_in, cond9], axis=-1)
-                t = jnp.asarray(schedule.timesteps)[i]
-                t_vec = jnp.broadcast_to(t, (model_in.shape[0],))
-                residual_kw = {}
-                if cn_key is not None:
-                    # guidance window: the control branch is active only for
-                    # steps in [cg_lo, cg_hi) (control_guidance_start/end)
-                    eff = cn_scale * ((i >= cg_lo) & (i < cg_hi)).astype(
-                        jnp.float32
+                    # per-row channel conditioning: zeros for the uncond
+                    # row so image guidance has a true no-image baseline
+                    cond_rows = jnp.concatenate(
+                        [jnp.zeros_like(image_latents), image_latents,
+                         image_latents],
+                        axis=0,
+                    ).astype(self.dtype)
+                if mode == "inpaint":
+                    clean = image_latents
+                if mode == "inpaint9":
+                    # dedicated inpaint UNet: mask plane + masked-image
+                    # latents ride the channel dim on both CFG rows
+                    cond9 = jnp.concatenate([mask, image_latents], axis=-1)
+                    cond9 = jnp.concatenate([cond9, cond9], axis=0).astype(
+                        self.dtype
                     )
-                    down_res, mid_res = controlnet_module.apply(
-                        {"params": cn_params},
+                if cn_key is not None:
+                    control2 = jnp.concatenate(
+                        [control_cond, control_cond], axis=0).astype(
+                        self.dtype
+                    )
+                    _, cg_lo, cg_hi = cn_key
+
+                def body(carry, i):
+                    latents, state = carry
+                    inp = scheduler.scale_model_input(schedule, latents, i)
+                    model_in = jnp.concatenate([inp] * cfg_rows, axis=0).astype(
+                        self.dtype
+                    )
+                    if mode == "pix2pix":
+                        # image latents join unscaled: the edit checkpoint was
+                        # trained on raw latent-dist modes
+                        model_in = jnp.concatenate([model_in, cond_rows], axis=-1)
+                    elif mode == "inpaint9":
+                        model_in = jnp.concatenate([model_in, cond9], axis=-1)
+                    t = jnp.asarray(schedule.timesteps)[i]
+                    t_vec = jnp.broadcast_to(t, (model_in.shape[0],))
+                    residual_kw = {}
+                    if cn_key is not None:
+                        # guidance window: the control branch is active only for
+                        # steps in [cg_lo, cg_hi) (control_guidance_start/end)
+                        eff = cn_scale * ((i >= cg_lo) & (i < cg_hi)).astype(
+                            jnp.float32
+                        )
+                        down_res, mid_res = controlnet_module.apply(
+                            {"params": cn_params},
+                            model_in,
+                            t_vec,
+                            context,
+                            control2,
+                            conditioning_scale=eff,
+                            added_cond=added,
+                        )
+                        residual_kw = {
+                            "down_residuals": down_res,
+                            "mid_residual": mid_res,
+                        }
+                    out = unet_apply(
+                        {"params": params["unet"]},
                         model_in,
                         t_vec,
                         context,
-                        control2,
-                        conditioning_scale=eff,
                         added_cond=added,
-                    )
-                    residual_kw = {
-                        "down_residuals": down_res,
-                        "mid_residual": mid_res,
-                    }
-                out = unet_apply(
-                    {"params": params["unet"]},
-                    model_in,
-                    t_vec,
-                    context,
-                    added_cond=added,
-                    **residual_kw,
-                ).astype(jnp.float32)
-                if mode == "pix2pix":
-                    # dual guidance (InstructPix2Pix eq. 3): text guidance
-                    # pulls away from image-only, image guidance away from
-                    # the fully-unconditional row
-                    out_u, out_i, out_c = jnp.split(out, 3, axis=0)
-                    out = (
-                        out_u
-                        + guidance_scale * (out_c - out_i)
-                        + image_guidance * (out_i - out_u)
-                    )
-                else:
-                    out_u, out_c = jnp.split(out, 2, axis=0)
-                    out = out_u + guidance_scale * (out_c - out_u)
+                        **residual_kw,
+                    ).astype(jnp.float32)
+                    if mode == "pix2pix":
+                        # dual guidance (InstructPix2Pix eq. 3): text guidance
+                        # pulls away from image-only, image guidance away from
+                        # the fully-unconditional row
+                        out_u, out_i, out_c = jnp.split(out, 3, axis=0)
+                        out = (
+                            out_u
+                            + guidance_scale * (out_c - out_i)
+                            + image_guidance * (out_i - out_u)
+                        )
+                    else:
+                        out_u, out_c = jnp.split(out, 2, axis=0)
+                        out = out_u + guidance_scale * (out_c - out_u)
 
-                if mode in ("batched", "batched_i2i"):
-                    # per-row ancestral noise from per-job keys (same
-                    # independence argument as the init draw)
-                    noise = jax.vmap(lambda k: jax.random.normal(
-                        jax.random.fold_in(k, i), (lh, lw, latent_c),
-                        jnp.float32))(rng)
-                else:
-                    noise = jax.random.normal(
-                        jax.random.fold_in(rng, i), latents.shape, jnp.float32
+                    if mode in ("batched", "batched_i2i"):
+                        # per-row ancestral noise from per-job keys (same
+                        # independence argument as the init draw)
+                        noise = jax.vmap(lambda k: jax.random.normal(
+                            jax.random.fold_in(k, i), (lh, lw, latent_c),
+                            jnp.float32))(rng)
+                    else:
+                        noise = jax.random.normal(
+                            jax.random.fold_in(rng, i), latents.shape, jnp.float32
+                        )
+                    state, latents = scheduler.step(
+                        schedule, state, i, latents, out, noise
                     )
-                state, latents = scheduler.step(
-                    schedule, state, i, latents, out, noise
+                    if mode == "inpaint":
+                        # keep the unmasked region on the original image's
+                        # noise trajectory (4-channel inpainting)
+                        keep = scheduler.add_noise(
+                            schedule,
+                            clean,
+                            jax.random.normal(
+                                jax.random.fold_in(rng, 7919 + i),
+                                clean.shape,
+                                jnp.float32,
+                            ),
+                            jnp.minimum(i + 1, loop_end - 1),
+                        )
+                        keep = jnp.where(i == loop_end - 1, clean, keep)
+                        latents = mask * latents + (1.0 - mask) * keep
+                    return (latents, state), ()
+
+                (latents, state), _ = jax.lax.scan(
+                    body, (latents, state), jnp.arange(length) + offset
                 )
-                if mode == "inpaint":
-                    # keep the unmasked region on the original image's
-                    # noise trajectory (4-channel inpainting)
-                    keep = scheduler.add_noise(
-                        schedule,
-                        clean,
-                        jax.random.normal(
-                            jax.random.fold_in(rng, 7919 + i),
-                            clean.shape,
-                            jnp.float32,
-                        ),
-                        jnp.minimum(i + 1, loop_end - 1),
-                    )
-                    keep = jnp.where(i == loop_end - 1, clean, keep)
-                    latents = mask * latents + (1.0 - mask) * keep
-                return (latents, state), ()
+                return latents, state
 
-            (latents, _), _ = jax.lax.scan(
-                body, (latents.astype(jnp.float32), state),
-                jnp.arange(loop_start, loop_end)
-            )
+            return run_steps
+
+        def decode(params, latents):
             latents = latents.astype(self.dtype)
             if big_decode:
                 pixels = jax.lax.map(
@@ -1005,10 +1024,161 @@ class SDPipeline:
                 (pixels.astype(jnp.float32) + 1.0) * 127.5
             ).clip(0.0, 255.0).round().astype(jnp.uint8)
 
-        program = jax.jit(run)
+        return prep, make_steps, decode, (loop_start, loop_end)
+
+    def _program(self, cache_key, build):
+        """One jitted program per cache key, sharing the compile-cache
+        metrics and the placement-layer residency note across every
+        denoise program kind (fused, prep, chunk, decode)."""
         with self._jit_lock:
-            self._programs[key] = program
+            if cache_key in self._programs:
+                _COMPILE_CACHE.inc(event="hit")
+                return self._programs[cache_key]
+        _COMPILE_CACHE.inc(event="miss")
+        if self.chipset is not None:
+            # compile event -> placement layer: refresh this model's
+            # residency so the dispatch board keeps routing same-model
+            # groups at the slice that owns the jitted programs
+            from ..chips.allocator import note_resident
+
+            note_resident(self.model_name, self.chipset.slice_id)
+        program = jax.jit(build())
+        with self._jit_lock:
+            self._programs[cache_key] = program
         return program
+
+    def _denoise_program(self, key, controlnet_module=None):
+        """Build (or fetch) the classic fused jitted denoise+decode
+        program for one bucket — prep, the full step loop, and decode in
+        ONE dispatch. This is the denoise_chunk_steps=0 path, cached
+        under the bare bucket key exactly as before the chunked seam."""
+
+        def build():
+            prep, make_steps, decode, (lo, hi) = self._denoise_parts(
+                key, controlnet_module)
+            run_steps = make_steps(hi - lo)
+
+            def run(params, init_rng, context, added, guidance_scale,
+                    image_guidance, image_latents, mask, rng, cn_params,
+                    control_cond, cn_scale):
+                latents, state = prep(params, init_rng, image_latents)
+                latents, _ = run_steps(
+                    params, latents, state, context, added, guidance_scale,
+                    image_guidance, image_latents, mask, rng, cn_params,
+                    control_cond, cn_scale, jnp.int32(lo))
+                return decode(params, latents)
+
+            return run
+
+        return self._program(key, build)
+
+    def _denoise_chunk_steps(self) -> int:
+        """Settings.denoise_chunk_steps at call time (env-overridable per
+        process, CHIASWARM_DENOISE_CHUNK_STEPS); 0 = single fused pass."""
+        try:
+            return max(int(getattr(
+                load_settings(), "denoise_chunk_steps", 0) or 0), 0)
+        except Exception:
+            return 0
+
+    def _denoise_runner(self, key, controlnet_module=None):
+        """Resolve the execution strategy for one bucket. Returns
+        ``runner(*program_args, cancel_probe=None) -> uint8 pixels``.
+
+        denoise_chunk_steps=0: the fused single program — the probe (if
+        any) runs once before launch, so a job cancelled while it waited
+        for the slice still aborts for free, but a cancel landing
+        mid-pass waits out the full pass (the pre-chunking behavior).
+
+        denoise_chunk_steps=N: prep, length-N step chunks (plus one
+        remainder chunk), and decode are separate compiled programs; the
+        probe runs between every chunk, so a cancelled pass frees the
+        slice within one chunk. All programs are resolved (and counted,
+        and compiled) HERE, not lazily mid-loop, so the caller's compile
+        span stays honest."""
+        chunk = self._denoise_chunk_steps()
+        cache_key = (key, chunk)
+        with self._jit_lock:
+            cached = self._runner_cache.get(cache_key)
+        if cached is not None:
+            return cached
+        if chunk <= 0:
+            program = self._denoise_program(key, controlnet_module)
+
+            def runner(*args, cancel_probe=None):
+                if cancel_probe is not None:
+                    cancel_probe()
+                return program(*args)
+        else:
+            prep_fn, make_steps, decode_fn, (lo, hi) = self._denoise_parts(
+                key, controlnet_module)
+            lengths: list[int] = []
+            pos = lo
+            while pos < hi:
+                lengths.append(min(chunk, hi - pos))
+                pos += lengths[-1]
+            prep_prog = self._program((key, "prep"), lambda: prep_fn)
+            chunk_progs = {
+                n: self._program((key, "chunk", n), lambda n=n: make_steps(n))
+                for n in set(lengths)
+            }
+            decode_prog = self._program((key, "decode"), lambda: decode_fn)
+
+            def runner(params, init_rng, context, added, guidance_scale,
+                       image_guidance, image_latents, mask, rng,
+                       cn_params, control_cond, cn_scale,
+                       cancel_probe=None):
+                # Each boundary BLOCKS on the previous chunk before
+                # probing. This sync is load-bearing, not optional: jax
+                # dispatches asynchronously, so without it the host
+                # races through every chunk_prog call in milliseconds
+                # and all probes fire before the first chunk's compute
+                # finishes — a mid-pass cancel could never interject
+                # (observed empirically in the e2e drive). Chunks are
+                # data-dependent, so no device-side pipelining is lost;
+                # the happy-path cost is one host round trip per chunk,
+                # microseconds against a multi-second chunk. A pass
+                # with no probe (direct pipeline calls) runs free.
+                if cancel_probe is not None:
+                    cancel_probe()
+                latents, state = prep_prog(params, init_rng, image_latents)
+                at = lo
+                for n in lengths:
+                    if at != lo and cancel_probe is not None:
+                        jax.block_until_ready(latents)
+                        cancel_probe()
+                    latents, state = chunk_progs[n](
+                        params, latents, state, context, added,
+                        guidance_scale, image_guidance, image_latents, mask,
+                        rng, cn_params, control_cond, cn_scale,
+                        jnp.int32(at))
+                    at += n
+                if cancel_probe is not None:
+                    jax.block_until_ready(latents)
+                    cancel_probe()
+                return decode_prog(params, latents)
+
+        with self._jit_lock:
+            self._runner_cache[cache_key] = runner
+        return runner
+
+    @staticmethod
+    def _solo_cancel_probe():
+        """Abort probe for a single-job pass: raises JobCancelled when
+        the job pinned on this executor thread (the telemetry trace
+        context) has been revoked by the hive. None when no job id is
+        pinned (direct pipeline calls, tests, tools)."""
+        from ..cancel import JobCancelled, cancelled, current_job_ids
+
+        ids = current_job_ids()
+        if not ids:
+            return None
+
+        def probe():
+            if any(cancelled(j) for j in ids):
+                raise JobCancelled(ids)
+
+        return probe
 
     # --- public job API ---
 
@@ -1273,9 +1443,10 @@ class SDPipeline:
         key = (mode, lh, lw, n_images, steps, sched_key, t_start, cn_key)
         # stage "compile" is program-cache resolution: ~0 on a hit, the
         # full trace+XLA compile on a miss (swarm_compile_cache_total
-        # tells the two apart in aggregate)
+        # tells the two apart in aggregate). With denoise_chunk_steps>0
+        # the runner resolves the whole chunked program set here.
         with Span("compile", timings, key="trace_s"):
-            program = self._denoise_program(key, controlnet_module)
+            runner = self._denoise_runner(key, controlnet_module)
 
         # long-sequence self-attention shards over the mesh seq axis (ring
         # attention) when this ChipSet carved one out; trace-time routing,
@@ -1284,7 +1455,7 @@ class SDPipeline:
 
         with Span("denoise", timings, key="denoise_decode_s"):
             with sequence_parallel_scope(self.mesh):
-                pixels = program(
+                pixels = runner(
                     job_params,
                     init_rng,
                     context,
@@ -1297,6 +1468,10 @@ class SDPipeline:
                     cn_params,
                     control_cond,
                     jnp.float32(cn_scale),
+                    # a hive-revoked job aborts at the next chunk
+                    # boundary (JobCancelled propagates to the worker,
+                    # which frees the slice and produces no envelope)
+                    cancel_probe=self._solo_cancel_probe(),
                 )
             pixels = jax.block_until_ready(pixels)
 
@@ -1572,13 +1747,37 @@ class SDPipeline:
         key = ("batched_i2i" if i2i else "batched",
                lh, lw, padded, steps, sched_key, t_start, None)
         with Span("compile", timings, key="trace_s"):
-            program = self._denoise_program(key)
+            runner = self._denoise_runner(key)
+
+        # per-ROW cancel tokens (ISSUE 10): each request carries its
+        # job_id, so a hive revocation of ONE member marks just that row
+        # — batchmates finish unharmed (the padded program's shapes are
+        # fixed; the cancelled row keeps computing, its result is simply
+        # flagged and never packaged). When EVERY member is cancelled
+        # the probe aborts the whole pass, freeing the slice within one
+        # denoise_chunk_steps boundary.
+        row_ids = [r.get("job_id") for r in requests]
+        cancelled_rows: set[int] = set()
+        probe = None
+        if any(row_ids):
+            from ..cancel import JobCancelled, cancelled as _row_cancelled
+
+            def probe():
+                for idx, jid in enumerate(row_ids):
+                    if (jid and idx not in cancelled_rows
+                            and _row_cancelled(jid)):
+                        cancelled_rows.add(idx)
+                        logger.warning(
+                            "coalesced row for job %s cancelled; "
+                            "batchmates continue", jid)
+                if cancelled_rows and len(cancelled_rows) == len(requests):
+                    raise JobCancelled([j for j in row_ids if j])
 
         from ..ops.attention import sequence_parallel_scope
 
         with Span("denoise", timings, key="denoise_decode_s"):
             with sequence_parallel_scope(self.mesh):
-                pixels = program(
+                pixels = runner(
                     base_params,
                     init_rng,
                     context,
@@ -1591,6 +1790,7 @@ class SDPipeline:
                     {},
                     control_cond,
                     jnp.float32(1.0),
+                    cancel_probe=probe,
                 )
             pixels = jax.block_until_ready(pixels)
 
@@ -1600,8 +1800,11 @@ class SDPipeline:
 
         results = []
         offset = 0
-        for r, n, images in zip(requests, counts, groups):
+        for row, (r, n, images) in enumerate(zip(requests, counts, groups)):
             results.append((images, {
+                # a cancelled member's envelope is never built: the flag
+                # tells the workflow/worker layers to drop this slot
+                **({"cancelled": True} if row in cancelled_rows else {}),
                 "model": self.model_name,
                 "pipeline": pipeline_type,
                 "scheduler": scheduler_type,

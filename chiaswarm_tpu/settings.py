@@ -79,6 +79,13 @@ class Settings:
     # byte cap in MiB for encoded (model, prompt-text) rows, so gang
     # members and repeat prompts skip text_encode entirely; 0 disables
     embed_cache_mb: int = 64
+    # chunked denoise (pipelines/stable_diffusion.py): run the compiled
+    # denoise loop in chunks of this many steps, probing the cancel
+    # registry (cancel.py) at every chunk boundary so a cancelled job
+    # frees its slice within one chunk instead of one full pass. 0 (the
+    # default) keeps the single-pass compiled denoise at zero cost;
+    # chunked and single-pass outputs are bitwise identical (pinned)
+    denoise_chunk_steps: int = 0
     # --- observability (telemetry.py) ---
     # local /metrics + /healthz HTTP port; 0 disables the server (the
     # in-process instrumentation stays on either way — it is dict ops)
@@ -148,6 +155,13 @@ class Settings:
     # GET /api/jobs/{id}; older ones are forgotten so coordinator memory
     # is bounded by this, not by job history (0 = keep everything)
     hive_job_history_limit: int = 1000
+    # admission-time job TTL: a job still QUEUED this many seconds after
+    # submission is parked as `expired` instead of wasting a dispatch
+    # (the submitter is presumed gone, or the answer stale). A per-job
+    # `deadline_s` field on the submitted job dict overrides it; the
+    # worker's slice watchdog also treats that per-job deadline as its
+    # execution cap. 0 = no TTL (the pre-cancellation behavior)
+    hive_job_ttl_s: float = 0.0
     # --- hive durability (hive_server/journal.py) ---
     # write-ahead journal directory (relative to $SDAAS_ROOT); every
     # queue/lease transition is appended so a crashed hive replays to its
@@ -230,6 +244,8 @@ _ENV_OVERRIDES = {
     "CHIASWARM_HIVE_MAX_JOBS_PER_POLL": "hive_max_jobs_per_poll",
     "CHIASWARM_HIVE_GANG_MAX": "hive_gang_max",
     "CHIASWARM_EMBED_CACHE_MB": "embed_cache_mb",
+    "CHIASWARM_DENOISE_CHUNK_STEPS": "denoise_chunk_steps",
+    "CHIASWARM_HIVE_JOB_TTL_S": "hive_job_ttl_s",
     "CHIASWARM_HIVE_SPOOL_DIR": "hive_spool_dir",
     "CHIASWARM_HIVE_JOB_HISTORY_LIMIT": "hive_job_history_limit",
     "CHIASWARM_HIVE_WAL_DIR": "hive_wal_dir",

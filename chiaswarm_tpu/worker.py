@@ -52,8 +52,10 @@ import time
 from concurrent.futures import ThreadPoolExecutor
 
 from . import __version__, faults, telemetry
+from . import cancel as cancel_mod
 from . import outbox as outbox_mod
 from .batching import BatchScheduler
+from .cancel import JobCancelled
 from .chips.allocator import SliceAllocator
 from .faults import FaultInjected
 from .hive import HiveClient, HiveError, hive_endpoints
@@ -144,6 +146,26 @@ _SLICE_STATE = telemetry.gauge(
     "Chip slices by lifecycle state (active | quarantined)",
     ("state",),
 )
+_JOBS_CANCELLED = telemetry.counter(
+    "swarm_jobs_cancelled_total",
+    "Hive-revoked jobs this worker dropped, by where the cancel caught "
+    "them (held = still lingering/on the dispatch board, no envelope "
+    "ever produced; executing = aborted or row-dropped mid-denoise at a "
+    "chunk boundary; unknown = already delivered or never held)",
+    ("stage",),
+)
+
+
+def _deadline_cap_of(job: dict) -> float:
+    """The job's own watchdog cap from its `deadline_s` field; 0 = none.
+    `deadline_s` is submitter-controlled and forwarded un-validated by
+    the hive (its own TTL parse is just as tolerant), so garbage must
+    degrade to "no cap", never kill the slice worker task."""
+    try:
+        cap = float(job.get("deadline_s") or 0.0)
+    except (TypeError, ValueError):
+        return 0.0
+    return cap if cap > 0 else 0.0
 
 
 class Worker:
@@ -205,6 +227,10 @@ class Worker:
         self._draining = asyncio.Event()
         self._probe_tasks: set[asyncio.Task] = set()
         self._delivering = 0  # entries popped from result_queue, not yet acked
+        # job ids currently claimed by a slice (the cancel router's
+        # "executing" test: a hive revocation for one of these marks the
+        # process-wide cancel registry the chunked denoise probes)
+        self._executing_ids: set[str] = set()
         self._metrics_runner = None
         self._profiling = False  # one on-demand profiler capture at a time
         # monotonic time of the last SUCCESSFUL hive poll (healthz age)
@@ -544,10 +570,23 @@ class Worker:
     async def poll_loop(self) -> None:
         sleep_seconds = POLL_SECONDS
         while True:
-            if (not self._draining.is_set() and not self.batcher.full()
-                    and self.allocator.has_free_slice()):
+            can_take = (not self._draining.is_set() and not self.batcher.full()
+                        and self.allocator.has_free_slice())
+            # cancel-only heartbeat (ISSUE 10): a worker whose every
+            # slice is busy used to go silent for the whole denoise —
+            # exactly the window in which a cancel matters most. It now
+            # keeps polling with `cancel_only=1`: the hive skips dispatch
+            # (and a legacy hive that hands jobs anyway just feeds the
+            # batcher early), keeps the worker live in its directory,
+            # and piggybacks lease revocations for the executing slices.
+            heartbeat = (not can_take and not self._draining.is_set()
+                         and self.batcher.outstanding_jobs > 0)
+            if can_take or heartbeat:
                 try:
-                    jobs = await self.hive.ask_for_work(self._capabilities())
+                    caps = self._capabilities()
+                    if heartbeat:
+                        caps["cancel_only"] = 1
+                    jobs = await self.hive.ask_for_work(caps)
                     self._last_poll_monotonic = time.monotonic()
                     _LAST_POLL.set(time.time())
                     # a gang-scheduling hive groups same-key jobs in one
@@ -586,6 +625,12 @@ class Worker:
                             await self.batcher.put_gang(gangs[item])
                         else:
                             await self.batcher.put(item)
+                    # lease revocations piggybacked on this reply: route
+                    # each to wherever the job currently lives (batcher
+                    # -> dropped outright; executing slice -> cancel
+                    # token probed at the next denoise chunk boundary)
+                    for job_id in self.hive.last_cancels:
+                        self._cancel_job(job_id)
                     sleep_seconds = POLL_SECONDS
                 except asyncio.TimeoutError:
                     # a timeout IS a poll failure: back off like one (the
@@ -602,6 +647,27 @@ class Worker:
             self._poll_backoff_s = sleep_seconds
             self._update_queue_gauges()
             await asyncio.sleep(sleep_seconds)
+
+    def _cancel_job(self, job_id: str) -> None:
+        """Route one hive-revoked job id. Held (lingering / on the
+        board): dropped outright, no envelope ever produced. Executing:
+        the cancel registry is marked and the chunked denoise aborts the
+        row (or the whole pass) at its next chunk boundary. Anything
+        else — already delivered, or never ours — is a no-op; a late
+        result earns the hive's `cancelled` disposition and parks."""
+        job_id = str(job_id)
+        if self.batcher.cancel(job_id):
+            stage = "held"
+        elif job_id in self._executing_ids:
+            cancel_mod.cancel(job_id)
+            stage = "executing"
+            logger.warning(
+                "hive cancelled executing job %s; the slice aborts at "
+                "its next denoise chunk boundary", job_id)
+        else:
+            stage = "unknown"
+        _JOBS_CANCELLED.inc(stage=stage)
+        self._update_queue_gauges()
 
     # --- consumers: one logical worker per chip slice ---
 
@@ -630,6 +696,21 @@ class Worker:
             picked_up = time.monotonic()
             queue_wait = {}
             traces = {}
+            batch_ids = [str(job["id"]) for job in batch if "id" in job]
+            self._executing_ids.update(batch_ids)
+            # a job-level deadline (`deadline_s`, the hive TTL's per-job
+            # override) caps the slice watchdog for its pass: the
+            # submitter's promise outranks the worker-side default. A
+            # COALESCED pass is capped only when EVERY member opted in,
+            # and then by the loosest promise — a watchdog expiry kills
+            # the whole pass, and one job's tight deadline must never
+            # cost its batchmates their denoise (observed: a 0.5s
+            # deadline ganged with a normal job quarantined the slice)
+            caps_by_id = {str(job.get("id")): _deadline_cap_of(job)
+                          for job in batch}
+            caps = list(caps_by_id.values())
+            batch_cap = max(caps) if caps and all(
+                c > 0 for c in caps) else None
             for job in batch:
                 enqueued = job.pop("_telemetry_enqueued", None)
                 if enqueued is not None and "id" in job:
@@ -651,16 +732,26 @@ class Worker:
                     if worker_function is not None:
                         prepared.append((worker_function, kwargs))
                 if len(prepared) > 1 and self._batchable(prepared):
-                    results = await self.do_batched_work(chipset, prepared)
+                    results = await self.do_batched_work(
+                        chipset, prepared, batch_cap)
                     for result in results:
+                        # a cancelled member's slot comes back as None:
+                        # no envelope exists and none is delivered — the
+                        # hive tombstoned the job, batchmates unharmed
+                        if result is None:
+                            continue
                         self._finish_result(
                             result, queue_wait, outcome, traces)
                         await self._enqueue_result(result)
                 else:
                     for worker_function, kwargs in prepared:
+                        solo_cap = caps_by_id.get(
+                            str(kwargs.get("id"))) or None
                         result = await self.do_work(
-                            chipset, worker_function, kwargs
+                            chipset, worker_function, kwargs, solo_cap
                         )
+                        if result is None:  # pass aborted by a cancel
+                            continue
                         self._finish_result(
                             result, queue_wait, outcome, traces)
                         await self._enqueue_result(result)
@@ -673,6 +764,11 @@ class Worker:
                     # pass the job so the row accounting (advertised
                     # queue_depth) subtracts its true image count
                     self.batcher.task_done(job)
+                for job_id in batch_ids:
+                    # tokens die with the pass: a later resubmission of
+                    # the same id must start with a clean slate
+                    self._executing_ids.discard(job_id)
+                    cancel_mod.discard(job_id)
                 self._update_queue_gauges()
 
     @staticmethod
@@ -727,26 +823,35 @@ class Worker:
 
     # --- slice watchdog ---
 
-    def _job_deadline(self, model_name, chipset=None) -> float | None:
+    def _job_deadline(self, model_name, chipset=None,
+                      cap_s: float | None = None) -> float | None:
         """Execution deadline for one pass; None = watchdog off. A model
         that is not yet resident ON THIS SLICE gets the first-compile
         allowance — big programs legitimately take minutes to compile
         once, and a STOLEN group pays that on the stealing slice even
-        when the model is warm elsewhere in the process."""
+        when the model is warm elsewhere in the process. `cap_s` (the
+        job's own `deadline_s`, ISSUE 10) is a hard ceiling: the
+        watchdog treats the submitter's deadline as its cap, compile
+        allowance included — and it arms the watchdog even when the
+        worker-wide knob is off."""
         base = float(getattr(self.settings, "job_deadline_s", 0.0) or 0.0)
-        if base <= 0:
-            return None
-        scale = 1.0
-        try:
-            from .registry import resident_models
+        deadline: float | None = None
+        if base > 0:
+            scale = 1.0
+            try:
+                from .registry import resident_models
 
-            slice_id = getattr(chipset, "slice_id", None)
-            if model_name and model_name not in resident_models(slice_id):
-                scale = max(float(getattr(
-                    self.settings, "job_deadline_compile_scale", 4.0)), 1.0)
-        except Exception:  # residency probe must never block execution
-            pass
-        return base * scale
+                slice_id = getattr(chipset, "slice_id", None)
+                if model_name and model_name not in resident_models(slice_id):
+                    scale = max(float(getattr(
+                        self.settings, "job_deadline_compile_scale", 4.0)),
+                        1.0)
+            except Exception:  # residency probe must never block execution
+                pass
+            deadline = base * scale
+        if cap_s is not None and cap_s > 0:
+            deadline = cap_s if deadline is None else min(deadline, cap_s)
+        return deadline
 
     def _expire_pass(self, chipset, fut, jobs_meta: list[dict],
                      deadline: float, kind: str) -> list[dict]:
@@ -827,12 +932,14 @@ class Worker:
                 chipset.slice_id)
         self._update_queue_gauges()
 
-    async def do_work(self, chipset, worker_function, kwargs) -> dict:
+    async def do_work(self, chipset, worker_function, kwargs,
+                      deadline_cap_s: float | None = None) -> dict | None:
         loop = asyncio.get_running_loop()
         # captured BEFORE dispatch: the executor thread mutates kwargs
         meta = [{"id": kwargs.get("id"),
                  "content_type": kwargs.get("content_type", "image/jpeg")}]
-        deadline = self._job_deadline(kwargs.get("model_name"), chipset)
+        deadline = self._job_deadline(
+            kwargs.get("model_name"), chipset, deadline_cap_s)
         fut = loop.run_in_executor(
             self._executor, self.synchronous_do_work, chipset, worker_function, kwargs
         )
@@ -843,7 +950,9 @@ class Worker:
         except asyncio.TimeoutError:
             return self._expire_pass(chipset, fut, meta, deadline, "solo")[0]
 
-    async def do_batched_work(self, chipset, prepared: list) -> list[dict]:
+    async def do_batched_work(self, chipset, prepared: list,
+                              deadline_cap_s: float | None = None
+                              ) -> list[dict | None]:
         loop = asyncio.get_running_loop()
         meta = [{"id": kw.get("id"),
                  "content_type": kw.get("content_type", "image/jpeg")}
@@ -855,6 +964,11 @@ class Worker:
             # sequentially through the solo path — a legitimate full-group
             # fallback must not read as a hang and cost the slice
             deadline *= max(len(prepared), 1)
+        if deadline_cap_s is not None and deadline_cap_s > 0:
+            # the job-level deadline is an absolute promise; it caps the
+            # final budget AFTER the fallback allowance, never scales
+            deadline = (deadline_cap_s if deadline is None
+                        else min(deadline, deadline_cap_s))
         fut = loop.run_in_executor(
             self._executor, self.synchronous_do_batch, chipset, prepared
         )
@@ -873,10 +987,14 @@ class Worker:
         from .workflows.diffusion import diffusion_batched_callback
 
         # pristine copies for the fallback: the batched path pops/injects
-        # keys (id, seed, rng, chipset) destructively
+        # keys (seed, rng, chipset) destructively
         singles = [(fn, dict(kwargs)) for fn, kwargs in prepared]
         requests = [kwargs for _, kwargs in prepared]
-        ids = [kwargs.pop("id") for kwargs in requests]
+        # ids stay IN the request kwargs: the batched pipeline path needs
+        # them for its per-row cancel tokens (chunked denoise); the
+        # callbacks read only the keys they know, so the extra key rides
+        # along harmlessly
+        ids = [kwargs.get("id") for kwargs in requests]
         print(
             f"Processing batch of {len(ids)} jobs {ids} "
             f"on {chipset.descriptor()}"
@@ -885,7 +1003,7 @@ class Worker:
             with trace_job(",".join(str(i) for i in ids)):
                 outs = chipset.run_batched(diffusion_batched_callback, requests)
             return [
-                {
+                None if pipeline_config.get("cancelled") else {
                     "id": job_id,
                     "artifacts": artifacts,
                     "nsfw": pipeline_config.get("nsfw", False),
@@ -894,6 +1012,13 @@ class Worker:
                 }
                 for job_id, (artifacts, pipeline_config) in zip(ids, outs)
             ]
+        except JobCancelled as e:
+            # every live member was cancelled: the pass aborted at a
+            # chunk boundary, the slice is free, and NO envelope exists —
+            # the hive tombstoned these jobs and wants nothing back
+            logger.warning("coalesced pass aborted by cancellation: %s",
+                           e.job_ids)
+            return [None] * len(ids)
         except Exception as e:
             logger.exception(
                 "coalesced pass for %s failed; retrying jobs individually", ids
@@ -913,6 +1038,13 @@ class Worker:
         try:
             with trace_job(job_id):
                 artifacts, pipeline_config = chipset(worker_function, **kwargs)
+        except JobCancelled:
+            # aborted at a denoise chunk boundary: the hive revoked this
+            # job mid-flight. No envelope — the slice frees within one
+            # chunk and the hive's tombstone is the terminal truth
+            logger.warning("job %s cancelled mid-denoise; pass aborted",
+                           job_id)
+            return None
         except (ValueError, TypeError) as e:
             # non-recoverable (e.g. incompatible adapter): fatal envelope
             return fatal_exception_response(e, job_id, kwargs)
@@ -979,11 +1111,35 @@ class Worker:
             err: Exception
             try:
                 t0 = time.perf_counter()
-                await self.hive.submit_result(entry.result)
+                ack = await self.hive.submit_result(entry.result)
                 # stage "submit": successful upload latency (failures are
                 # counted per-endpoint by hive.py)
                 observe_stage("submit", time.perf_counter() - t0)
                 faults.fire("kill_before_ack")
+                # disposition ACKs (ISSUE 10): the hive took the POST but
+                # will never store this result — the job was cancelled,
+                # expired, or retired ("gone"). PARK the envelope with
+                # the reason instead of unlinking: the artifacts cost a
+                # full denoise pass and stay on disk for the operator
+                # (tools/outbox_inspect.py shows the reason; --requeue
+                # retries them if a hive will take them later). Before
+                # this, a 200 ACK always unlinked and a non-200 for a
+                # gone job retried on the transient path forever.
+                reason = None
+                if isinstance(ack, dict):
+                    if ack.get("cancelled"):
+                        reason = "cancelled: hive revoked this job"
+                    elif ack.get("expired"):
+                        reason = "expired: job TTL lapsed at the hive"
+                    elif ack.get("unknown_job"):
+                        reason = "gone: hive no longer knows this job id"
+                if reason is not None:
+                    logger.warning(
+                        "hive acknowledged but discarded result %s (%s); "
+                        "parking the envelope", entry.job_id, reason)
+                    await asyncio.get_running_loop().run_in_executor(
+                        None, self.outbox.park, entry, reason)
+                    return
                 self.outbox.delivered(entry)
                 return
             except FaultInjected:

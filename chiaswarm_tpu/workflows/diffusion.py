@@ -172,6 +172,9 @@ def diffusion_batched_callback(device_identifier: str, requests: list[dict]):
             "rng": r.get("rng"),
             "num_images_per_prompt": n,
             "image": r.get("image"),
+            # per-row cancel token key (ISSUE 10): run_batched probes the
+            # cancel registry for this id at denoise chunk boundaries
+            "job_id": r.get("id"),
         })
 
     # capacity admits the COALESCED batch, capping rather than rejecting:
@@ -210,6 +213,11 @@ def diffusion_batched_callback(device_identifier: str, requests: list[dict]):
 
     out = []
     for i, ((images, pipeline_config), env) in enumerate(zip(results, envelopes)):
+        if pipeline_config.get("cancelled"):
+            # hive-revoked mid-denoise: no safety pass, no packaging —
+            # the worker drops this slot (no envelope is ever delivered)
+            out.append((None, pipeline_config))
+            continue
         with Span("decode", pipeline_config.setdefault("timings", {})):
             nsfw, checked = flag_images(images)
             pipeline_config["nsfw"] = nsfw

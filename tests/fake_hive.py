@@ -65,6 +65,15 @@ class FakeHive:
         # advertising no gang_rows (or 1) never sees a gang, exactly
         # like the real dispatcher.
         self.gang_max: int = 8
+        # cancellation parity (ISSUE 10): POST /api/jobs/{id}/cancel
+        # tombstones a pending job or queues a dispatched one's id for
+        # the next /work reply's `cancels` piggyback; a result for a
+        # cancelled id is ACKed with the `cancelled` disposition and
+        # recorded in cancelled_results (NOT results — the real hive
+        # discards it). The conformance suite pins all of it.
+        self.cancels: list[str] = []
+        self.cancelled_ids: set[str] = set()
+        self.cancelled_results: list[dict] = []
         self._runner: web.AppRunner | None = None
         self.port: int | None = None
 
@@ -77,6 +86,7 @@ class FakeHive:
         app.router.add_get("/api/work", self._work)
         app.router.add_post("/api/results", self._results)
         app.router.add_get("/api/models", self._models)
+        app.router.add_post("/api/jobs/{job_id}/cancel", self._cancel)
         app.router.add_get("/image.png", self._image)
         self._runner = web.AppRunner(app)
         await self._runner.setup()
@@ -150,6 +160,13 @@ class FakeHive:
             return refused
         if self.refuse_with is not None:
             return web.json_response({"message": self.refuse_with}, status=400)
+        if request.query.get("cancel_only"):
+            # saturated-worker heartbeat (worker.py poll_loop): no
+            # dispatch, just the revocation piggyback — real-hive parity
+            reply: dict = {"jobs": []}
+            if self.cancels:
+                reply["cancels"], self.cancels = sorted(self.cancels), []
+            return web.json_response(reply, headers=self._epoch_headers())
         jobs, self.pending_jobs = self.pending_jobs, []
         try:
             gang_rows = max(int(request.query.get("gang_rows", 1)), 1)
@@ -175,8 +192,46 @@ class FakeHive:
                     trace["gang"] = {"id": gang_id, "size": len(group),
                                      "index": index}
                 handed.append(dict(job, trace=trace))
-        return web.json_response({"jobs": handed},
-                                 headers=self._epoch_headers())
+        reply = {"jobs": handed}
+        if self.cancels:
+            # same contract as the real hive: the key appears only when
+            # there is something to revoke, and it is popped on delivery
+            reply["cancels"], self.cancels = sorted(self.cancels), []
+        return web.json_response(reply, headers=self._epoch_headers())
+
+    async def _cancel(self, request: web.Request) -> web.Response:
+        """POST /api/jobs/{id}/cancel, wire-parity with the real hive: a
+        still-pending job is tombstoned on the spot; a dispatched one is
+        queued for the `cancels` piggyback on the next /work reply."""
+        denied = self._unauthorized(request)
+        if denied is not None:
+            return denied
+        job_id = request.match_info["job_id"]
+        pending = [j for j in self.pending_jobs
+                   if str(j.get("id")) == job_id]
+        if pending:
+            for job in pending:
+                self.pending_jobs.remove(job)
+            self.cancelled_ids.add(job_id)
+            return web.json_response(
+                {"id": job_id, "status": "cancelled", "cancelled": True},
+                headers=self._epoch_headers())
+        if job_id in self.cancelled_ids:
+            return web.json_response(  # idempotent repeat
+                {"id": job_id, "status": "cancelled", "cancelled": True},
+                headers=self._epoch_headers())
+        if job_id in self.dispatch_attempts:
+            if any(str(r.get("id")) == job_id for r in self.results):
+                # the result won the race: idempotent no-op
+                return web.json_response(
+                    {"id": job_id, "status": "done", "cancelled": False},
+                    headers=self._epoch_headers())
+            self.cancels.append(job_id)
+            self.cancelled_ids.add(job_id)
+            return web.json_response(
+                {"id": job_id, "status": "cancelled", "cancelled": True},
+                headers=self._epoch_headers())
+        return web.json_response({"message": "unknown job id"}, status=404)
 
     def _gang_groups(self, jobs: list[dict],
                      gang_rows: int) -> list[list[dict]]:
@@ -226,7 +281,16 @@ class FakeHive:
         refused = self._refuse_not_primary()
         if refused is not None:
             return refused
-        self.results.append(json.loads(await request.text()))
+        envelope = json.loads(await request.text())
+        if str(envelope.get("id")) in self.cancelled_ids:
+            # cancel-vs-result race, hive side: the cancel settled first,
+            # so the envelope is discarded and the ACK names the
+            # disposition (the worker's outbox parks it)
+            self.cancelled_results.append(envelope)
+            self.result_event.set()
+            return web.json_response({"status": "ok", "cancelled": True},
+                                     headers=self._epoch_headers())
+        self.results.append(envelope)
         self.result_event.set()
         return web.json_response({"status": "ok"},
                                  headers=self._epoch_headers())

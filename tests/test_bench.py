@@ -91,6 +91,17 @@ def test_bench_cpu_fallback_produces_labeled_smoke_row():
     assert out.get("gang_size_p50", 0) >= 2, out
     assert out.get("embed_cache_hit_rate", 0) > 0, out
 
+    # cancellation & deadlines (ISSUE 10): cancelling a mid-denoise job
+    # frees the slice within one denoise_chunk_steps boundary — the
+    # reclaim must beat the full pass it interrupted, by construction of
+    # the chunked denoise, so anything else is a propagation regression
+    assert out.get("cancel_raced") is False, out
+    assert out.get("cancel_victim_status") == "cancelled", out
+    assert out.get("cancel_reclaim_s") is not None, out
+    assert out["cancel_reclaim_s"] > 0, out
+    assert out.get("cancel_full_pass_s", 0) > 0, out
+    assert out["cancel_reclaim_s"] < out["cancel_full_pass_s"], out
+
     # end-to-end tracing row (ISSUE 8): every settled job in the
     # hive_e2e scenario must carry a COMPLETE gap-free timeline —
     # admit/dispatch(placement)/settle events, an attributed queue-wait

@@ -1,0 +1,479 @@
+"""Worker-side cancellation (ISSUE 10): cancel tokens, the chunked
+denoise, BatchScheduler drops, and the outbox's disposition parking.
+
+The acceptance-critical pin lives here: with ``denoise_chunk_steps`` on,
+chunked and single-pass denoise outputs are BITWISE identical (the chunk
+seam exists for control, not as a numerics fork), a cancelled solo pass
+aborts at a chunk boundary with no envelope, and a cancelled member of a
+coalesced pass is dropped while its batchmates' outputs stay identical
+to an undisturbed run.
+"""
+
+import asyncio
+
+import numpy as np
+import pytest
+
+import jax
+
+from chiaswarm_tpu import cancel as cancel_mod
+from chiaswarm_tpu.batching import BatchScheduler
+from chiaswarm_tpu.cancel import CancelRegistry, JobCancelled
+from chiaswarm_tpu.pipelines.stable_diffusion import SDPipeline
+from chiaswarm_tpu.telemetry import trace_job
+
+
+@pytest.fixture(autouse=True)
+def clean_registry():
+    cancel_mod.get_registry().clear()
+    yield
+    cancel_mod.get_registry().clear()
+
+
+@pytest.fixture(scope="module")
+def tiny_sd():
+    return SDPipeline("test/tiny-sd")
+
+
+# --- registry units --------------------------------------------------------
+
+
+def test_registry_mark_probe_discard():
+    reg = CancelRegistry()
+    assert not reg.cancelled("a")
+    reg.cancel("a")
+    assert reg.cancelled("a") and not reg.cancelled("b")
+    reg.discard("a")
+    assert not reg.cancelled("a")
+    reg.discard("never-seen")  # discarding an unknown id is a no-op
+
+
+def test_current_job_ids_reads_trace_context():
+    assert cancel_mod.current_job_ids() == []
+    with trace_job("solo-1"):
+        assert cancel_mod.current_job_ids() == ["solo-1"]
+    with trace_job("a,b,c"):
+        assert cancel_mod.current_job_ids() == ["a", "b", "c"]
+    assert cancel_mod.current_job_ids() == []
+
+
+# --- chunked denoise: golden equality --------------------------------------
+
+
+def _render(pipe, monkeypatch, chunk: int, steps: int = 5, **kwargs):
+    if chunk:
+        monkeypatch.setenv("CHIASWARM_DENOISE_CHUNK_STEPS", str(chunk))
+    else:
+        monkeypatch.delenv("CHIASWARM_DENOISE_CHUNK_STEPS", raising=False)
+    images, config = pipe.run(
+        prompt="chunk seam", height=64, width=64,
+        num_inference_steps=steps, rng=jax.random.key(11), **kwargs)
+    return np.asarray(images[0]), config
+
+
+def test_chunked_solo_outputs_bitwise_identical(tiny_sd, sdaas_root,
+                                                monkeypatch):
+    """denoise_chunk_steps=N walks the exact same step sequence as the
+    fused pass (2+2+1 chunks for 5 steps exercises the remainder
+    program) — outputs must be bit-for-bit the single-pass image."""
+    fused, _ = _render(tiny_sd, monkeypatch, chunk=0)
+    chunked, _ = _render(tiny_sd, monkeypatch, chunk=2)
+    assert np.array_equal(fused, chunked)
+    # chunk >= steps degenerates to one chunk, still identical
+    one_chunk, _ = _render(tiny_sd, monkeypatch, chunk=64)
+    assert np.array_equal(fused, one_chunk)
+
+
+def test_chunked_img2img_outputs_bitwise_identical(tiny_sd, sdaas_root,
+                                                   monkeypatch):
+    from PIL import Image
+
+    start = Image.fromarray(
+        (np.linspace(0, 255, 64 * 64 * 3).reshape(64, 64, 3)
+         ).astype(np.uint8))
+    fused, _ = _render(tiny_sd, monkeypatch, chunk=0,
+                       image=start, strength=0.6)
+    chunked, _ = _render(tiny_sd, monkeypatch, chunk=2,
+                         image=start, strength=0.6)
+    assert np.array_equal(fused, chunked)
+
+
+def _batched(pipe, requests, **kw):
+    return pipe.run_batched(
+        requests, height=64, width=64, num_inference_steps=4, **kw)
+
+
+def test_chunked_batched_outputs_bitwise_identical(tiny_sd, sdaas_root,
+                                                   monkeypatch):
+    requests = [
+        {"prompt": "row one", "rng": jax.random.key(1)},
+        {"prompt": "row two", "rng": jax.random.key(2)},
+        {"prompt": "row three", "rng": jax.random.key(3)},
+    ]
+    monkeypatch.delenv("CHIASWARM_DENOISE_CHUNK_STEPS", raising=False)
+    fused = _batched(tiny_sd, [dict(r) for r in requests])
+    monkeypatch.setenv("CHIASWARM_DENOISE_CHUNK_STEPS", "3")
+    chunked = _batched(tiny_sd, [dict(r) for r in requests])
+    for (fi, _), (ci, _) in zip(fused, chunked):
+        assert np.array_equal(np.asarray(fi[0]), np.asarray(ci[0]))
+
+
+# --- chunked denoise: cancellation semantics -------------------------------
+
+
+def test_cancelled_solo_pass_aborts_at_chunk_boundary(tiny_sd, sdaas_root,
+                                                      monkeypatch):
+    monkeypatch.setenv("CHIASWARM_DENOISE_CHUNK_STEPS", "1")
+    cancel_mod.cancel("doomed-solo")
+    with trace_job("doomed-solo"):
+        with pytest.raises(JobCancelled) as err:
+            tiny_sd.run(prompt="never finishes", height=64, width=64,
+                        num_inference_steps=4, rng=jax.random.key(5))
+    assert err.value.job_ids == ["doomed-solo"]
+
+
+def test_uncancelled_job_unaffected_by_foreign_token(tiny_sd, sdaas_root,
+                                                     monkeypatch):
+    monkeypatch.setenv("CHIASWARM_DENOISE_CHUNK_STEPS", "1")
+    cancel_mod.cancel("somebody-else")
+    with trace_job("innocent"):
+        images, _ = tiny_sd.run(
+            prompt="finishes fine", height=64, width=64,
+            num_inference_steps=2, rng=jax.random.key(6))
+    assert len(images) == 1
+
+
+def test_cancelled_batch_member_dropped_batchmates_identical(
+        tiny_sd, sdaas_root, monkeypatch):
+    """One cancelled member of a coalesced pass: its slot is flagged
+    (no images packaged downstream), and the SURVIVORS' pixels are
+    bit-identical to a run where nobody was cancelled."""
+    def requests():
+        return [
+            {"prompt": "survivor a", "rng": jax.random.key(21),
+             "job_id": "batch-a"},
+            {"prompt": "the victim", "rng": jax.random.key(22),
+             "job_id": "batch-b"},
+            {"prompt": "survivor c", "rng": jax.random.key(23),
+             "job_id": "batch-c"},
+        ]
+
+    monkeypatch.setenv("CHIASWARM_DENOISE_CHUNK_STEPS", "2")
+    baseline = _batched(tiny_sd, requests())
+    cancel_mod.cancel("batch-b")
+    cancelled = _batched(tiny_sd, requests())
+    assert "cancelled" not in cancelled[0][1]
+    assert cancelled[1][1]["cancelled"] is True
+    assert "cancelled" not in cancelled[2][1]
+    for idx in (0, 2):
+        assert np.array_equal(np.asarray(baseline[idx][0][0]),
+                              np.asarray(cancelled[idx][0][0]))
+
+
+def test_fully_cancelled_batch_aborts(tiny_sd, sdaas_root, monkeypatch):
+    monkeypatch.setenv("CHIASWARM_DENOISE_CHUNK_STEPS", "1")
+    for job_id in ("all-a", "all-b"):
+        cancel_mod.cancel(job_id)
+    with pytest.raises(JobCancelled):
+        _batched(tiny_sd, [
+            {"prompt": "a", "rng": jax.random.key(1), "job_id": "all-a"},
+            {"prompt": "b", "rng": jax.random.key(2), "job_id": "all-b"},
+        ])
+
+
+def test_chunk_zero_keeps_single_program_cache_shape(sdaas_root,
+                                                     monkeypatch):
+    """The zero-cost contract: with chunking off, exactly ONE program is
+    cached per bucket under the bare key (no prep/chunk/decode split)."""
+    monkeypatch.delenv("CHIASWARM_DENOISE_CHUNK_STEPS", raising=False)
+    pipe = SDPipeline("test/tiny-sd")
+    pipe.run(prompt="warm", height=64, width=64, num_inference_steps=2,
+             rng=jax.random.key(1))
+    assert all(not (isinstance(k, tuple) and len(k) == 2
+                    and k[1] in ("prep", "decode"))
+               for k in pipe._programs)
+    assert len(pipe._programs) == 1
+
+
+# --- BatchScheduler.cancel -------------------------------------------------
+
+
+def _txt2img(job_id: str) -> dict:
+    return {"id": job_id, "workflow": "txt2img", "model_name": "m/a",
+            "prompt": job_id, "height": 64, "width": 64,
+            "num_inference_steps": 2}
+
+
+def test_scheduler_cancel_drops_lingering_member():
+    async def scenario():
+        sched = BatchScheduler(linger_s=60.0, max_coalesce=8)
+        await sched.put(_txt2img("lin-1"))
+        await sched.put(_txt2img("lin-2"))
+        assert sched.pending_jobs == 2 and sched.outstanding_jobs == 2
+        assert sched.cancel("lin-1") is True
+        assert sched.pending_jobs == 1 and sched.outstanding_jobs == 1
+        assert sched.outstanding_rows == 1
+        # the survivor still dispatches
+        sched.flush_all()
+        jobs = await sched.get()
+        assert [j["id"] for j in jobs] == ["lin-2"]
+
+    asyncio.run(scenario())
+
+
+def test_scheduler_cancel_empties_group_and_timer():
+    async def scenario():
+        sched = BatchScheduler(linger_s=60.0, max_coalesce=8)
+        await sched.put(_txt2img("only"))
+        assert sched.cancel("only") is True
+        assert sched.pending_jobs == 0
+        assert sched.outstanding_jobs == 0
+        assert not sched._pending  # group gone, timer cancelled
+
+    asyncio.run(scenario())
+
+
+def test_scheduler_cancel_drops_board_entry():
+    async def scenario():
+        sched = BatchScheduler(linger_s=0.0, max_coalesce=1)
+        await sched.put({"id": "solo-board", "workflow": "echo",
+                         "model_name": "none", "prompt": "x"})
+        assert sched.ready_jobs == 1
+        assert sched.cancel("solo-board") is True
+        assert sched.ready_jobs == 0 and sched.outstanding_jobs == 0
+        assert sched._board == []
+
+    asyncio.run(scenario())
+
+
+def test_scheduler_cancel_unknown_id_is_false():
+    async def scenario():
+        sched = BatchScheduler(linger_s=0.0)
+        assert sched.cancel("nobody") is False
+
+    asyncio.run(scenario())
+
+
+# --- worker routing + outbox parking ---------------------------------------
+
+
+def _make_worker(hive_uri: str = "http://127.0.0.1:1/api", **overrides):
+    from chiaswarm_tpu.chips.allocator import SliceAllocator
+    from chiaswarm_tpu.settings import Settings
+    from chiaswarm_tpu.worker import Worker
+
+    settings = Settings(sdaas_token="cancel-test", metrics_port=0,
+                        **overrides)
+    return Worker(settings=settings,
+                  allocator=SliceAllocator(chips_per_job=0),
+                  hive_uri=hive_uri)
+
+
+def test_worker_routes_cancel_by_stage(sdaas_root):
+    from chiaswarm_tpu import telemetry
+
+    async def scenario():
+        counter = telemetry.REGISTRY.get(
+            "swarm_jobs_cancelled_total") or telemetry.counter(
+            "swarm_jobs_cancelled_total", "", ("stage",))
+        held_before = counter.value(stage="held")
+        exec_before = counter.value(stage="executing")
+        unknown_before = counter.value(stage="unknown")
+        w = _make_worker()
+        await w.batcher.put(_txt2img("held-job"))
+        w._executing_ids.add("exec-job")
+        w._cancel_job("held-job")
+        w._cancel_job("exec-job")
+        w._cancel_job("gone-job")
+        assert w.batcher.outstanding_jobs == 0  # held job dropped
+        assert cancel_mod.cancelled("exec-job")
+        assert not cancel_mod.cancelled("held-job")
+        assert counter.value(stage="held") == held_before + 1
+        assert counter.value(stage="executing") == exec_before + 1
+        assert counter.value(stage="unknown") == unknown_before + 1
+        cancel_mod.discard("exec-job")
+
+    asyncio.run(scenario())
+
+
+def test_deliver_parks_on_disposition_acks(sdaas_root):
+    """The outbox satellite regression: an ACK naming a cancelled /
+    expired / gone disposition PARKS the envelope (reason on disk,
+    visible to outbox_inspect) instead of unlinking it silently — and
+    instead of the pre-fix behavior of retrying a submission the hive
+    will never store."""
+    import importlib.util
+    import pathlib
+    import sys
+
+    async def scenario(ack: dict, expected_reason: str):
+        w = _make_worker()
+
+        async def fake_submit(result):
+            return ack
+
+        w.hive.submit_result = fake_submit
+        entry = w.outbox.spool({"id": f"disp-{expected_reason}",
+                                "artifacts": {}})
+        await w._deliver(entry)
+        assert entry.parked is True
+        assert entry.path is not None
+        assert entry.path.name.endswith(".parked")
+        await w.hive.close()
+        return entry
+
+    asyncio.run(scenario({"status": "ok", "cancelled": True}, "cancelled"))
+    asyncio.run(scenario({"status": "ok", "expired": True}, "expired"))
+    asyncio.run(scenario({"status": "ok", "unknown_job": True}, "gone"))
+
+    # the park reasons are operator-visible through outbox_inspect
+    tool_path = (pathlib.Path(__file__).resolve().parent.parent
+                 / "tools" / "outbox_inspect.py")
+    spec = importlib.util.spec_from_file_location("outbox_inspect", tool_path)
+    tool = importlib.util.module_from_spec(spec)
+    sys.modules.setdefault("outbox_inspect", tool)
+    spec.loader.exec_module(tool)
+    rows = tool.inspect_rows(_make_worker().outbox.directory)
+    reasons = {r["job_id"]: r["park_reason"] for r in rows}
+    assert reasons["disp-cancelled"].startswith("cancelled")
+    assert reasons["disp-expired"].startswith("expired")
+    assert reasons["disp-gone"].startswith("gone")
+    assert all(r["state"] == "parked" for r in rows)
+
+
+def test_malformed_deadline_never_kills_the_slice_worker(sdaas_root):
+    """deadline_s is submitter-controlled and the hive forwards it
+    un-validated: garbage must degrade to 'no cap', not raise outside
+    slice_worker's try/finally and permanently leak the claimed slice."""
+    from chiaswarm_tpu.worker import _deadline_cap_of
+
+    assert _deadline_cap_of({"deadline_s": "fast"}) == 0.0
+    assert _deadline_cap_of({"deadline_s": None}) == 0.0
+    assert _deadline_cap_of({"deadline_s": -3}) == 0.0
+    assert _deadline_cap_of({"deadline_s": "2.5"}) == 2.5
+    assert _deadline_cap_of({}) == 0.0
+
+    from tests.fake_hive import FakeHive
+
+    async def scenario():
+        hive = await FakeHive().start()
+        hive.add_job({"id": "bad-deadline", "workflow": "echo",
+                      "model_name": "none", "prompt": "x",
+                      "deadline_s": "not-a-number"})
+        w = _make_worker(hive_uri=hive.uri)
+        import chiaswarm_tpu.worker as wm
+        old = wm.POLL_SECONDS
+        wm.POLL_SECONDS = 0.05
+        runner = asyncio.create_task(w.run())
+        try:
+            results = await hive.wait_for_results(1, timeout=30.0)
+            assert results[0]["id"] == "bad-deadline"
+            assert w.allocator.has_free_slice()  # slice released
+        finally:
+            wm.POLL_SECONDS = old
+            w.stop()
+            await asyncio.wait_for(
+                asyncio.gather(runner, return_exceptions=True), 10)
+            await hive.stop()
+
+    asyncio.run(scenario())
+
+
+def test_deliver_unlinks_on_plain_ack(sdaas_root):
+    async def scenario():
+        w = _make_worker()
+
+        async def fake_submit(result):
+            return {"status": "ok"}
+
+        w.hive.submit_result = fake_submit
+        entry = w.outbox.spool({"id": "plain-ok", "artifacts": {}})
+        await w._deliver(entry)
+        assert entry.parked is False
+        assert w.outbox.depth == 0
+        await w.hive.close()
+
+    asyncio.run(scenario())
+
+
+def test_worker_e2e_cancelled_result_parks(sdaas_root):
+    """End-to-end against the fake hive: a job whose id the hive
+    cancelled AFTER dispatch completes on the worker, the result ACK
+    carries the cancelled disposition, and the envelope ends PARKED —
+    never delivered, never retried forever."""
+    from tests.fake_hive import FakeHive
+
+    async def scenario():
+        hive = await FakeHive().start()
+        hive.add_job({"id": "late-cancel", "workflow": "echo",
+                      "model_name": "none", "prompt": "late"})
+        # the cancel lands hive-side while the job executes: the fake
+        # marks the id so the eventual result gets the disposition
+        hive.cancelled_ids.add("late-cancel")
+        w = _make_worker(hive_uri=hive.uri)
+        import chiaswarm_tpu.worker as wm
+        old = wm.POLL_SECONDS
+        wm.POLL_SECONDS = 0.05
+        runner = asyncio.create_task(w.run())
+        try:
+            deadline = asyncio.get_running_loop().time() + 30.0
+            while (not hive.cancelled_results
+                   and asyncio.get_running_loop().time() < deadline):
+                await asyncio.sleep(0.02)
+            assert hive.cancelled_results, "result never reached the hive"
+            assert hive.results == []  # never accepted as a real result
+            deadline = asyncio.get_running_loop().time() + 10.0
+            while (asyncio.get_running_loop().time() < deadline
+                   and not list(
+                       w.outbox.directory.glob("*.json.parked"))):
+                await asyncio.sleep(0.02)
+            parked = list(w.outbox.directory.glob("*.json.parked"))
+            assert len(parked) == 1
+        finally:
+            wm.POLL_SECONDS = old
+            w.stop()
+            await asyncio.wait_for(
+                asyncio.gather(runner, return_exceptions=True), 10)
+            await hive.stop()
+
+    asyncio.run(scenario())
+
+
+def test_worker_e2e_held_job_cancelled_via_piggyback(sdaas_root):
+    """A cancel arriving while the job still LINGERS in the batcher
+    drops it outright: no execution, no envelope, nothing delivered."""
+    from tests.fake_hive import FakeHive
+
+    async def scenario():
+        hive = await FakeHive().start()
+        # a long linger holds the txt2img job in the open group; the
+        # SECOND poll's piggyback cancels it before any flush
+        hive.add_job(_txt2img("held-e2e"))
+        hive.cancels.append("held-e2e")
+        hive.cancelled_ids.add("held-e2e")
+        w = _make_worker(hive_uri=hive.uri, batch_linger_ms=60000.0)
+        import chiaswarm_tpu.worker as wm
+        old = wm.POLL_SECONDS
+        wm.POLL_SECONDS = 0.05
+        runner = asyncio.create_task(w.run())
+        try:
+            deadline = asyncio.get_running_loop().time() + 15.0
+            while (asyncio.get_running_loop().time() < deadline
+                   and w.batcher.outstanding_jobs == 0):
+                await asyncio.sleep(0.01)
+            # ... job arrived; now wait for the cancel to drop it
+            deadline = asyncio.get_running_loop().time() + 15.0
+            while (asyncio.get_running_loop().time() < deadline
+                   and w.batcher.outstanding_jobs > 0):
+                await asyncio.sleep(0.01)
+            assert w.batcher.outstanding_jobs == 0
+            assert hive.results == [] and hive.cancelled_results == []
+            assert w.outbox.depth == 0
+        finally:
+            wm.POLL_SECONDS = old
+            w.stop()
+            await asyncio.wait_for(
+                asyncio.gather(runner, return_exceptions=True), 10)
+            await hive.stop()
+
+    asyncio.run(scenario())

@@ -37,6 +37,7 @@ def _load_tool():
     "sigterm_drain",
     "hive_lease_takeover",
     "gang_member_lost",
+    "cancel_mid_denoise",
     "hive_crash_recovery",
     "hive_failover",
     "hive_split_brain_fenced",

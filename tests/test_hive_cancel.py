@@ -1,0 +1,468 @@
+"""Hive-side cancellation & deadlines (ISSUE 10).
+
+POST /api/jobs/{id}/cancel as a first-class, WAL-durable lifecycle
+transition: queued jobs tombstone on the spot, leased jobs have their
+lease revoked and the lessee notified via the /work `cancels` piggyback,
+races with results are pinned (whichever settles first wins, the other
+is an idempotent no-op), and the admission-time TTL (`hive_job_ttl_s` /
+per-job `deadline_s`) parks still-queued jobs as `expired` before they
+waste a dispatch. Every transition replays across SIGKILL recovery and
+ships to the standby, exactly like lease state.
+"""
+
+import asyncio
+import json
+
+import aiohttp
+import pytest
+
+from chiaswarm_tpu import faults, telemetry
+from chiaswarm_tpu.hive_server.clock import HiveClock
+from chiaswarm_tpu.hive_server.leases import LeaseTable
+from chiaswarm_tpu.hive_server.queue import PriorityJobQueue
+from chiaswarm_tpu.settings import Settings
+
+TOKEN = "cancel-test-token"
+
+
+@pytest.fixture(autouse=True)
+def disarm_faults():
+    yield
+    faults.configure("")
+
+
+def _hive_settings(**overrides) -> Settings:
+    fields = dict(sdaas_token=TOKEN, hive_port=0, metrics_port=0)
+    fields.update(overrides)
+    return Settings(**fields)
+
+
+def _headers():
+    return {"Authorization": f"Bearer {TOKEN}",
+            "Content-type": "application/json"}
+
+
+async def _poll(session, api_uri, name="w1", **extra):
+    params = {"worker_version": "0.1.0", "worker_name": name,
+              "chips": "4", "slices": "4", "busy_slices": "0",
+              "queue_depth": "0", "resident_models": ""}
+    params.update({k: str(v) for k, v in extra.items()})
+    async with session.get(f"{api_uri}/work", params=params,
+                           headers=_headers()) as r:
+        return r.status, (await r.json() if r.status == 200 else None)
+
+
+async def _post(session, url, payload=None):
+    async with session.post(
+            url, data=json.dumps(payload) if payload is not None else b"",
+            headers=_headers()) as r:
+        try:
+            return r.status, await r.json()
+        except (aiohttp.ContentTypeError, json.JSONDecodeError):
+            return r.status, None
+
+
+def _job(job_id: str, **extra) -> dict:
+    return {"id": job_id, "workflow": "echo", "model_name": "none",
+            "prompt": job_id, **extra}
+
+
+# --- queue-level units -----------------------------------------------------
+
+
+def test_mark_cancelled_tombstones_queued_record():
+    q = PriorityJobQueue()
+    record = q.submit(_job("c1"))
+    other = q.submit(_job("c2"))
+    q.mark_cancelled(record, "queued")
+    assert record.state == "cancelled"
+    assert record.cancel_stage == "queued"
+    assert [r.job_id for r in q.iter_queued()] == ["c2"]
+    assert q.depth == 1
+    assert record.timeline[-1]["event"] == "cancel"
+    assert record.timeline[-1]["stage"] == "queued"
+    # the batchmate is untouched
+    assert other.state == "queued"
+
+
+def test_cancelled_gang_member_leaves_peers_intact():
+    """A cancelled member of a coalesce-compatible group must vanish
+    from the gang index too (shared tombstone discipline)."""
+    def gang_job(i):
+        return {"id": f"g{i}", "workflow": "txt2img",
+                "model_name": "m/a", "prompt": str(i),
+                "height": 64, "width": 64, "num_inference_steps": 2}
+
+    q = PriorityJobQueue()
+    records = [q.submit(gang_job(i)) for i in range(3)]
+    q.mark_cancelled(records[1], "queued")
+    peers = list(q.queued_peers(records[0]))
+    assert [p.job_id for p in peers] == ["g2"]
+
+
+def test_job_ttl_expiry_uses_injected_clock_and_per_job_override():
+    now = [0.0]
+    clock = HiveClock(mono=lambda: now[0], wall=lambda: 1e9 + now[0])
+    q = PriorityJobQueue(clock=clock, job_ttl_s=10.0)
+    default_ttl = q.submit(_job("ttl-default"))
+    override = q.submit(_job("ttl-override", deadline_s=2.0))
+    forever = q.submit(_job("ttl-forever", deadline_s=0))
+    assert default_ttl.expires_at == 10.0
+    assert override.expires_at == 2.0
+    # deadline_s=0 falls back to the hive-wide TTL, not "never": an
+    # explicit zero is "no per-job override"
+    assert forever.expires_at == 10.0
+    now[0] = 5.0
+    assert [r.job_id for r in q.expired_queued()] == ["ttl-override"]
+    q.mark_expired(override)
+    assert override.state == "expired"
+    assert override.timeline[-1]["event"] == "expire"
+    now[0] = 11.0
+    assert {r.job_id for r in q.expired_queued()} == {
+        "ttl-default", "ttl-forever"}
+
+
+def test_no_ttl_by_default():
+    q = PriorityJobQueue()
+    record = q.submit(_job("no-ttl"))
+    assert record.expires_at is None
+    assert q.expired_queued() == []
+
+
+def test_terminal_states_prune_from_history():
+    q = PriorityJobQueue(history_limit=2)
+    kept = []
+    for i in range(4):
+        record = q.submit(_job(f"h{i}"))
+        q.mark_cancelled(record, "queued")
+        q.retire(record)
+        kept.append(record.job_id)
+    # only the 2 most recent cancelled records survive the prune
+    assert set(q.records) == {"h2", "h3"}
+
+
+# --- wire-level: cancel lifecycle + piggyback ------------------------------
+
+
+def test_cancel_leased_revokes_lease_and_notifies_lessee(sdaas_root):
+    from chiaswarm_tpu.hive_server import HiveServer
+
+    async def scenario():
+        async with HiveServer(_hive_settings(), port=0) as hive, \
+                aiohttp.ClientSession() as session:
+            await _post(session, f"{hive.api_uri}/jobs", _job("mid"))
+            status, payload = await _poll(session, hive.api_uri, "lessee")
+            assert [j["id"] for j in payload["jobs"]] == ["mid"]
+            assert len(hive.leases) == 1
+            status, ack = await _post(
+                session, f"{hive.api_uri}/jobs/mid/cancel")
+            assert status == 200 and ack["cancelled"] is True
+            # the lease is revoked NOW (the reaper must not redeliver)
+            assert len(hive.leases) == 0
+            assert hive.queue.records["mid"].state == "cancelled"
+            assert hive.queue.records["mid"].cancel_stage == "leased"
+            # a DIFFERENT worker's poll carries no revocation...
+            status, payload = await _poll(session, hive.api_uri, "other")
+            assert "cancels" not in payload
+            # ...the lessee's does, exactly once
+            status, payload = await _poll(session, hive.api_uri, "lessee")
+            assert payload["cancels"] == ["mid"]
+            status, payload = await _poll(session, hive.api_uri, "lessee")
+            assert "cancels" not in payload
+
+    asyncio.run(scenario())
+
+
+def test_cancel_only_heartbeat_carries_revocations_without_dispatch(
+        sdaas_root):
+    from chiaswarm_tpu.hive_server import HiveServer
+
+    async def scenario():
+        async with HiveServer(_hive_settings(), port=0) as hive, \
+                aiohttp.ClientSession() as session:
+            await _post(session, f"{hive.api_uri}/jobs", _job("busy"))
+            await _post(session, f"{hive.api_uri}/jobs", _job("waiting"))
+            status, payload = await _poll(
+                session, hive.api_uri, "lessee", slices=1)
+            assert [j["id"] for j in payload["jobs"]] == ["busy"]
+            await _post(session, f"{hive.api_uri}/jobs/busy/cancel")
+            # the saturated worker's heartbeat: no dispatch even though
+            # "waiting" is queued, but the revocation arrives
+            status, payload = await _poll(
+                session, hive.api_uri, "lessee",
+                slices=1, busy_slices=1, cancel_only=1)
+            assert payload["jobs"] == []
+            assert payload["cancels"] == ["busy"]
+            # "waiting" is still there for a normal poll later
+            status, payload = await _poll(session, hive.api_uri, "lessee")
+            assert [j["id"] for j in payload["jobs"]] == ["waiting"]
+
+    asyncio.run(scenario())
+
+
+def test_late_result_after_cancel_gets_cancelled_disposition(sdaas_root):
+    from chiaswarm_tpu.hive_server import HiveServer
+
+    async def scenario():
+        results = telemetry.REGISTRY.get(
+            "swarm_hive_results_total") or telemetry.counter(
+            "swarm_hive_results_total", "", ("status",))
+        before = results.value(status="cancelled")
+        async with HiveServer(_hive_settings(), port=0) as hive, \
+                aiohttp.ClientSession() as session:
+            await _post(session, f"{hive.api_uri}/jobs", _job("race"))
+            await _poll(session, hive.api_uri, "lessee")
+            await _post(session, f"{hive.api_uri}/jobs/race/cancel")
+            status, ack = await _post(
+                session, f"{hive.api_uri}/results",
+                {"id": "race", "artifacts": {}, "nsfw": False,
+                 "pipeline_config": {}, "worker_name": "lessee"})
+            assert status == 200
+            assert ack == {"status": "ok", "cancelled": True}
+            # the result is NOT stored; the cancel is the terminal truth
+            assert hive.queue.records["race"].state == "cancelled"
+            assert hive.queue.records["race"].result is None
+            assert results.value(status="cancelled") == before + 1
+            # the pending revocation is dropped — the lessee clearly
+            # knows (it just POSTed), so no stale piggyback remains
+            status, payload = await _poll(session, hive.api_uri, "lessee")
+            assert "cancels" not in payload
+
+    asyncio.run(scenario())
+
+
+def test_result_wins_race_cancel_is_noop(sdaas_root):
+    from chiaswarm_tpu.hive_server import HiveServer
+
+    async def scenario():
+        async with HiveServer(_hive_settings(), port=0) as hive, \
+                aiohttp.ClientSession() as session:
+            await _post(session, f"{hive.api_uri}/jobs", _job("won"))
+            await _poll(session, hive.api_uri, "lessee")
+            await _post(session, f"{hive.api_uri}/results",
+                        {"id": "won", "artifacts": {}, "nsfw": False,
+                         "pipeline_config": {}, "worker_name": "lessee"})
+            status, ack = await _post(
+                session, f"{hive.api_uri}/jobs/won/cancel")
+            assert status == 200
+            assert ack["cancelled"] is False and ack["status"] == "done"
+            assert hive.queue.records["won"].state == "done"
+            assert hive.queue.records["won"].result is not None
+
+    asyncio.run(scenario())
+
+
+# --- TTL expiry at the wire ------------------------------------------------
+
+
+def test_expired_job_never_dispatches_and_result_acks_expired(sdaas_root):
+    from chiaswarm_tpu.hive_server import HiveServer
+
+    async def scenario():
+        async with HiveServer(
+                _hive_settings(hive_job_ttl_s=0.05), port=0) as hive, \
+                aiohttp.ClientSession() as session:
+            await _post(session, f"{hive.api_uri}/jobs", _job("stale"))
+            await asyncio.sleep(0.1)
+            # the TTL lapsed while queued: the poll parks it instead of
+            # wasting the dispatch
+            status, payload = await _poll(session, hive.api_uri)
+            assert payload["jobs"] == []
+            record = hive.queue.records["stale"]
+            assert record.state == "expired"
+            assert record.timeline[-1]["event"] == "expire"
+            async with session.get(f"{hive.api_uri}/jobs/stale",
+                                   headers=_headers()) as r:
+                snap = await r.json()
+            assert snap["status"] == "expired"
+            assert "expired" in snap["error"]
+            # a result for an expired job is ACKed with the disposition
+            status, ack = await _post(
+                session, f"{hive.api_uri}/results",
+                {"id": "stale", "artifacts": {}, "nsfw": False,
+                 "pipeline_config": {}, "worker_name": "w"})
+            assert status == 200 and ack == {"status": "ok", "expired": True}
+
+    asyncio.run(scenario())
+
+
+def test_reaper_expires_ttl_without_any_poll(sdaas_root):
+    from chiaswarm_tpu.hive_server import HiveServer
+
+    async def scenario():
+        async with HiveServer(
+                _hive_settings(hive_job_ttl_s=0.05,
+                               hive_lease_deadline_s=0.2),
+                port=0) as hive, aiohttp.ClientSession() as session:
+            await _post(session, f"{hive.api_uri}/jobs", _job("unpolled"))
+            # nobody ever polls; the reaper's pass parks it
+            deadline = asyncio.get_running_loop().time() + 5.0
+            while (hive.queue.records["unpolled"].state != "expired"
+                   and asyncio.get_running_loop().time() < deadline):
+                await asyncio.sleep(0.02)
+            assert hive.queue.records["unpolled"].state == "expired"
+
+    asyncio.run(scenario())
+
+
+# --- WAL durability --------------------------------------------------------
+
+
+def test_cancel_survives_restart_and_renotifies_lessee(sdaas_root):
+    """SIGKILL-recovery half of the acceptance criterion: a leased-job
+    cancel replays from the WAL — the record stays cancelled, the lease
+    is NOT re-granted, and the lessee is re-notified on its first
+    post-recovery poll (the pre-crash piggyback may never have left)."""
+    from chiaswarm_tpu.hive_server import HiveServer
+
+    async def scenario():
+        settings = _hive_settings()
+        async with HiveServer(settings, port=0) as hive, \
+                aiohttp.ClientSession() as session:
+            await _post(session, f"{hive.api_uri}/jobs", _job("durable-q"))
+            await _post(session, f"{hive.api_uri}/jobs", _job("durable-l"))
+            status, payload = await _poll(
+                session, hive.api_uri, "lessee", slices=1)
+            assert [j["id"] for j in payload["jobs"]] == ["durable-q"]
+            # rename for clarity: first job leased, second stays queued
+            await _post(session,
+                        f"{hive.api_uri}/jobs/durable-q/cancel")
+            await _post(session,
+                        f"{hive.api_uri}/jobs/durable-l/cancel")
+
+        # fresh construction over the same root = the SIGKILL restart
+        revived = HiveServer(settings)
+        try:
+            leased_rec = revived.queue.records["durable-q"]
+            queued_rec = revived.queue.records["durable-l"]
+            assert leased_rec.state == "cancelled"
+            assert leased_rec.cancel_stage == "leased"
+            assert leased_rec.timeline[-1]["event"] == "cancel"
+            assert queued_rec.state == "cancelled"
+            assert queued_rec.cancel_stage == "queued"
+            # no zombie lease, nothing dispatchable
+            assert len(revived.leases) == 0
+            assert list(revived.queue.iter_queued()) == []
+            # the notify map is rebuilt from record state
+            assert revived._cancel_notify == {"lessee": {"durable-q"}}
+        finally:
+            if revived.journal is not None:
+                revived.journal.close()
+
+        async with HiveServer(settings, port=0) as served, \
+                aiohttp.ClientSession() as session:
+            status, payload = await _poll(session, served.api_uri, "lessee")
+            assert payload["jobs"] == []
+            assert payload["cancels"] == ["durable-q"]
+
+    asyncio.run(scenario())
+
+
+def test_expired_state_survives_restart_and_ttl_spans_it(sdaas_root):
+    from chiaswarm_tpu.hive_server import HiveServer
+
+    async def scenario():
+        settings = _hive_settings(hive_job_ttl_s=0.05)
+        async with HiveServer(settings, port=0) as hive, \
+                aiohttp.ClientSession() as session:
+            await _post(session, f"{hive.api_uri}/jobs", _job("exp-done"))
+            await asyncio.sleep(0.1)
+            await _poll(session, hive.api_uri)  # parks it
+            assert hive.queue.records["exp-done"].state == "expired"
+            # submitted moments before the stop: still queued at stop
+            await _post(session, f"{hive.api_uri}/jobs",
+                        _job("exp-across", deadline_s=0.2))
+            assert hive.queue.records["exp-across"].state == "queued"
+
+        await asyncio.sleep(0.25)  # the TTL lapses while the hive is down
+        revived = HiveServer(settings)
+        try:
+            assert revived.queue.records["exp-done"].state == "expired"
+            across = revived.queue.records["exp-across"]
+            # re-anchored submitted_at: already past its window
+            assert across.expires_at is not None
+            assert across.expires_at <= revived.queue.clock.mono()
+            revived._expire_due()
+            assert across.state == "expired"
+        finally:
+            if revived.journal is not None:
+                revived.journal.close()
+
+    asyncio.run(scenario())
+
+
+# --- replication / promotion ----------------------------------------------
+
+
+def test_cancel_replicates_and_promoted_standby_serves_it(sdaas_root):
+    """Standby-promotion half of the acceptance criterion: a cancel
+    ships over the replication stream like lease state; the PROMOTED
+    hive refuses to dispatch the cancelled job, answers its late result
+    with the cancelled disposition, and takes over the lessee
+    notification."""
+    import dataclasses
+
+    from chiaswarm_tpu.hive_server import HiveServer
+    from chiaswarm_tpu.hive_server.replication import StandbyHive
+
+    async def scenario():
+        base = _hive_settings(hive_wal_dir="wal_cancel_p")
+        primary = await HiveServer(base, port=0).start()
+        standby = StandbyHive(
+            dataclasses.replace(base, hive_wal_dir="wal_cancel_s"),
+            primary_uri=primary.uri, port=0)
+        await standby.server.start()
+        try:
+            async with aiohttp.ClientSession() as session:
+                await _post(session, f"{primary.api_uri}/jobs",
+                            _job("repl"))
+                await _poll(session, primary.api_uri, "lessee")
+                await _post(session,
+                            f"{primary.api_uri}/jobs/repl/cancel")
+                await standby.sync_once()
+                replica = standby.server.queue.records["repl"]
+                assert replica.state == "cancelled"
+                assert replica.cancel_stage == "leased"
+
+                await primary.stop()
+                promoted = await standby.promote()
+                # no dispatch of a cancelled job, and the promoted hive
+                # owns the notification
+                status, payload = await _poll(
+                    session, promoted.api_uri, "lessee")
+                assert payload["jobs"] == []
+                assert payload["cancels"] == ["repl"]
+                status, ack = await _post(
+                    session, f"{promoted.api_uri}/results",
+                    {"id": "repl", "artifacts": {}, "nsfw": False,
+                     "pipeline_config": {}, "worker_name": "lessee"})
+                assert status == 200 and ack["cancelled"] is True
+        finally:
+            await standby.stop()
+            await primary.stop()
+
+    asyncio.run(scenario())
+
+
+# --- trace -----------------------------------------------------------------
+
+
+def test_cancel_and_expire_traces_are_attributed(sdaas_root):
+    from chiaswarm_tpu.hive_server import HiveServer
+    from chiaswarm_tpu.hive_server.trace import build_trace
+
+    async def scenario():
+        async with HiveServer(_hive_settings(), port=0) as hive, \
+                aiohttp.ClientSession() as session:
+            await _post(session, f"{hive.api_uri}/jobs", _job("traced"))
+            await _poll(session, hive.api_uri, "lessee")
+            await _post(session, f"{hive.api_uri}/jobs/traced/cancel")
+            trace = build_trace(hive.queue.records["traced"],
+                                hive.queue.clock.wall())
+            kinds = [e["event"] for e in trace["events"]]
+            assert kinds[-1] == "cancel"
+            assert trace["open"] is False  # cancel is terminal
+            assert any(g["attribution"] == "executing"
+                       and g["to"] == "cancel" for g in trace["gaps"])
+
+    asyncio.run(scenario())

@@ -342,6 +342,110 @@ def test_no_gang_without_worker_gang_rows(backend_name):
     run_conformance(backend_name, scenario)
 
 
+async def _post_cancel(backend, job_id: str):
+    """POST /api/jobs/{id}/cancel the way a submitter would (raw HTTP —
+    the cancel surface is part of the wire contract under test)."""
+    async with aiohttp.ClientSession() as session:
+        async with session.post(
+                f"{backend.uri}/jobs/{job_id}/cancel",
+                headers={"Authorization": f"Bearer {TOKEN}"}) as resp:
+            return resp.status, await resp.json()
+
+
+def test_cancel_queued_job_is_tombstoned(backend_name):
+    """ISSUE 10: cancelling a QUEUED job answers 200 with
+    {"id", "status": "cancelled", "cancelled": true}, the job is never
+    handed out afterwards, and a repeat cancel is idempotent. Pinned
+    across all three backends so fake_hive cannot drift."""
+
+    async def scenario(backend, client):
+        backend.queue_job(echo_job("conf-cancel-q"))
+        status, payload = await _post_cancel(backend, "conf-cancel-q")
+        assert status == 200
+        assert payload["id"] == "conf-cancel-q"
+        assert payload["status"] == "cancelled"
+        assert payload["cancelled"] is True
+        # tombstoned: the next poll hands nothing
+        assert await client.ask_for_work(dict(CAPS)) == []
+        # idempotent repeat
+        status, payload = await _post_cancel(backend, "conf-cancel-q")
+        assert status == 200 and payload["status"] == "cancelled"
+        # unknown ids are a 404, not a silent no-op
+        status, _ = await _post_cancel(backend, "conf-no-such-job")
+        assert status == 404
+
+    run_conformance(backend_name, scenario)
+
+
+def test_cancel_leased_job_piggybacks_and_result_acks_cancelled(backend_name):
+    """ISSUE 10, the mid-flight half of the wire contract: cancelling a
+    LEASED job makes the lessee's next /work reply carry the id in a
+    top-level `cancels` list (absent entirely when there is nothing to
+    revoke — a legacy worker sees no new key), and a result arriving
+    AFTER the cancel is ACKed 200 with the `cancelled` disposition so
+    the worker's outbox parks instead of retrying forever."""
+
+    async def scenario(backend, client):
+        backend.queue_job(echo_job("conf-cancel-l"))
+        [job] = await client.ask_for_work(dict(CAPS))
+        assert job["id"] == "conf-cancel-l"
+        assert client.last_cancels == []
+        status, payload = await _post_cancel(backend, "conf-cancel-l")
+        assert status == 200 and payload["cancelled"] is True
+        # the revocation rides the next poll, once
+        assert await client.ask_for_work(dict(CAPS)) == []
+        assert client.last_cancels == ["conf-cancel-l"]
+        assert await client.ask_for_work(dict(CAPS)) == []
+        assert client.last_cancels == []
+        # the late result earns the cancelled disposition, still a 200
+        # ACK (at-least-once delivery must terminate, never 4xx-park as
+        # a hive refusal)
+        ack = await client.submit_result({
+            "id": "conf-cancel-l", "artifacts": {}, "nsfw": False,
+            "worker_version": "0.1.0", "pipeline_config": {}})
+        assert ack["status"] == "ok"
+        assert ack["cancelled"] is True
+
+    run_conformance(backend_name, scenario)
+
+
+def test_cancel_after_result_is_noop(backend_name):
+    """The other side of the cancel-vs-result race: a job that already
+    settled answers the cancel with cancelled=false and keeps its
+    result — whichever settles first wins, pinned identically across
+    backends."""
+
+    async def scenario(backend, client):
+        backend.queue_job(echo_job("conf-cancel-race"))
+        [job] = await client.ask_for_work(dict(CAPS))
+        await client.submit_result({
+            "id": job["id"], "artifacts": {}, "nsfw": False,
+            "worker_version": "0.1.0", "pipeline_config": {}})
+        status, payload = await _post_cancel(backend, job["id"])
+        assert status == 200
+        assert payload["cancelled"] is False
+        assert payload["status"] in ("done", "settling")
+
+    run_conformance(backend_name, scenario)
+
+
+def test_cancel_only_poll_never_dispatches(backend_name):
+    """The saturated-worker heartbeat: a /work poll carrying
+    `cancel_only=1` gets an empty jobs list even with work queued (and
+    still hears revocations) — the wire shape every backend must share
+    for mid-denoise cancellation to reach a busy worker."""
+
+    async def scenario(backend, client):
+        backend.queue_job(echo_job("conf-hb"))
+        jobs = await client.ask_for_work(dict(CAPS, cancel_only=1))
+        assert jobs == []
+        # the job is still there for a normal poll
+        jobs = await client.ask_for_work(dict(CAPS))
+        assert [j["id"] for j in jobs] == ["conf-hb"]
+
+    run_conformance(backend_name, scenario)
+
+
 def test_work_query_carries_placement_signal(backend_name):
     """Satellite: the /work poll itself carries the dispatcher's
     placement inputs — worker identity, chip capabilities, resident

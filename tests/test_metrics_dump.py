@@ -97,6 +97,13 @@ swarm_hive_jobs_submitted_total{class="default"} 7
 swarm_hive_jobs_submitted_total{class="batch"} 3
 # TYPE swarm_hive_shed_total counter
 swarm_hive_shed_total{class="batch"} 2
+# TYPE swarm_hive_cancelled_total counter
+swarm_hive_cancelled_total{stage="queued"} 3
+swarm_hive_cancelled_total{stage="leased"} 2
+# TYPE swarm_hive_expired_total counter
+swarm_hive_expired_total 4
+# TYPE swarm_hive_cancel_revocations_pending gauge
+swarm_hive_cancel_revocations_pending 2
 # TYPE swarm_hive_queue_depth gauge
 swarm_hive_queue_depth{class="default"} 1
 swarm_hive_queue_depth{class="batch"} 0
@@ -138,6 +145,10 @@ def test_hive_tables_from_synthetic_text():
     assert summary["leases_active"] == 2
     assert summary["leases_expired"] == 1
     assert summary["results"] == {"duplicate": 1, "ok": 5}
+    # cancellation & deadlines (ISSUE 10)
+    assert summary["cancelled"] == {"leased": 2, "queued": 3}
+    assert summary["expired"] == 4
+    assert summary["cancel_revocations_pending"] == 2
     [qw] = summary["queue_wait"]
     assert qw["class"] == "default" and qw["count"] == 6
     assert qw["p50_le_s"] == 0.1  # cumulative 3/6 crosses at le=0.1
@@ -152,6 +163,8 @@ def test_hive_tables_from_synthetic_text():
     assert "size p50<=2 p95<=4" in table
     assert "hive admission by class" in table
     assert "batch" in table and "shed" not in summary["dispatch"]
+    assert ("hive cancels  leased=2 queued=3 expired=4 "
+            "pending_revocations=2") in table
     assert "hive queue wait" in table
     assert "hive dispatch->settle" in table
     assert "p50<=0.100" in table

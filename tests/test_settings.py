@@ -71,6 +71,22 @@ def test_hive_durability_env_overrides(sdaas_root, monkeypatch):
     assert load_settings().hive_wal_fsync is False
 
 
+def test_cancellation_knobs(sdaas_root, monkeypatch):
+    """ISSUE 10: the chunked-denoise and admission-TTL knobs layer like
+    every other setting — defaults OFF (single-pass denoise, no TTL),
+    env overrides win."""
+    s = load_settings()
+    assert s.denoise_chunk_steps == 0  # single fused pass at zero cost
+    assert s.hive_job_ttl_s == 0.0  # queued jobs never expire by default
+    monkeypatch.setenv("CHIASWARM_DENOISE_CHUNK_STEPS", "4")
+    monkeypatch.setenv("CHIASWARM_HIVE_JOB_TTL_S", "7.5")
+    s = load_settings()
+    assert s.denoise_chunk_steps == 4
+    assert s.hive_job_ttl_s == 7.5
+    monkeypatch.undo()
+    assert load_settings().denoise_chunk_steps == 0
+
+
 def test_tpu_fields_roundtrip(sdaas_root):
     save_settings(Settings(chips_per_job=4, dtype="float32"))
     s = load_settings()

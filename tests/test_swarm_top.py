@@ -57,6 +57,13 @@ swarm_hive_gang_size_sum 12
 swarm_hive_gang_size_count 3
 # TYPE swarm_hive_shed_total counter
 swarm_hive_shed_total{class="batch"} 4
+# TYPE swarm_hive_cancelled_total counter
+swarm_hive_cancelled_total{stage="queued"} 2
+swarm_hive_cancelled_total{stage="leased"} 1
+# TYPE swarm_hive_expired_total counter
+swarm_hive_expired_total 3
+# TYPE swarm_hive_cancel_revocations_pending gauge
+swarm_hive_cancel_revocations_pending 1
 # TYPE swarm_hive_workers_live gauge
 swarm_hive_workers_live 2
 # TYPE swarm_hive_queue_wait_seconds histogram
@@ -101,6 +108,10 @@ def test_render_hive_and_worker_frames_from_synthetic_data():
     assert "gang=9" in lines
     assert "gangs=3 jobs=12 rate=0.55 size p50<=4 p95<=8" in lines
     assert "batch=4" in lines  # shed
+    # cancellation & deadlines (ISSUE 10): revoked/expired counters +
+    # the lease-revocation gauge render on their own hive line
+    assert ("cancel    leased=1 queued=2 expired=3 "
+            "pending_revocations=1") in lines
     assert "! shedding batch jobs" in lines
     assert "appends_since_compact=7" in lines
     assert "default p50<=1s p95<=1s" in lines

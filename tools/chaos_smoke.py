@@ -343,6 +343,117 @@ async def scenario_gang_member_lost() -> str:
             "all members settled exactly once with gap-free traces")
 
 
+async def scenario_cancel_mid_denoise() -> str:
+    """End-to-end cancellation (ISSUE 10): a worker holds a 4-job GANG
+    mid-denoise (hang_denoise pins it at the pass entry); the submitter
+    cancels ONE member. The cancel-only heartbeat poll delivers the
+    revocation to the busy worker, the chunked denoise drops the row at
+    its first chunk boundary, the remaining three members complete with
+    correct outputs, the slice is reclaimed, swarm_hive_results_total
+    proves exactly-once settle (ok delta == 3, zero for the cancelled
+    member), and every timeline is trace_missing-clean."""
+    import os
+
+    from chiaswarm_tpu import cancel as cancel_mod
+    from chiaswarm_tpu import telemetry
+    from chiaswarm_tpu.hive_server import LocalSwarm
+    from chiaswarm_tpu.hive_server.trace import build_trace, trace_missing
+    from chiaswarm_tpu.settings import Settings
+
+    def gang_job(i: int) -> dict:
+        return {"id": f"chaos-cancel-{i}", "workflow": "txt2img",
+                "model_name": "stabilityai/stable-diffusion-2-1",
+                "prompt": f"cancel member {i}", "seed": 8000 + i,
+                "height": 64, "width": 64, "num_inference_steps": 2,
+                "parameters": {"test_tiny_model": True}}
+
+    faults.configure("hang_denoise=1", hang_timeout_s=120.0)
+    results_ok = telemetry.REGISTRY.get(
+        "swarm_hive_results_total") or telemetry.counter(
+        "swarm_hive_results_total", "", ("status",))
+    ok_before = results_ok.value(status="ok")
+    cancelled_disp_before = results_ok.value(status="cancelled")
+    # chunk the denoise so the cancel lands at a chunk boundary, not
+    # after the full pass (the pipeline reads the knob per pass via
+    # load_settings, so the env override reaches in-process workers)
+    os.environ["CHIASWARM_DENOISE_CHUNK_STEPS"] = "1"
+    settings = Settings(sdaas_token="chaos", hive_port=0, metrics_port=0,
+                        hive_lease_deadline_s=600.0,
+                        hive_max_jobs_per_poll=8, hive_gang_max=8,
+                        denoise_chunk_steps=1)
+    swarm = LocalSwarm(n_workers=0, chips_per_job=0, settings=settings)
+    plan = faults.get_plan()
+    try:
+        async with swarm:
+            ids = [await swarm.submit(gang_job(i)) for i in range(4)]
+            worker = swarm.add_worker("chaos-cancel-worker")
+            _check(await _spin(lambda: plan.hanging == 1),
+                   "worker never started the gang")
+            # cancel ONE member while the gang is mid-denoise
+            victim = ids[1]
+            ack = await swarm.cancel(victim)
+            _check(ack["cancelled"] is True and ack["status"] == "cancelled",
+                   f"cancel not acknowledged: {ack}")
+            _check(swarm.hive.leases.get(victim) is None,
+                   "hive did not revoke the victim's lease")
+            # the cancel-only heartbeat must reach the BUSY worker (its
+            # only slice is executing, yet it keeps polling) and mark
+            # the executing row's cancel token
+            _check(await _spin(lambda: cancel_mod.cancelled(victim), 15.0),
+                   "revocation never reached the executing worker")
+            plan.release_hangs()
+            # survivors complete; the victim's row was dropped at the
+            # first chunk boundary and no envelope was ever produced
+            for job_id in ids:
+                if job_id == victim:
+                    continue
+                status = await swarm.wait_done(job_id, timeout=240.0)
+                _check(status["status"] == "done",
+                       f"surviving member {job_id} did not complete")
+                _check(status["result"] is not None,
+                       f"surviving member {job_id} has no result")
+            victim_status = await swarm.job_status(victim)
+            _check(victim_status["status"] == "cancelled",
+                   f"victim ended {victim_status['status']}, not cancelled")
+            # exactly-once settle: 3 ok ACKs, and the victim NEVER
+            # settled (no late envelope — the row was dropped, and the
+            # disposition counter stays untouched)
+            _check(results_ok.value(status="ok") == ok_before + 3,
+                   "surviving members did not settle exactly once")
+            _check(results_ok.value(
+                       status="cancelled") == cancelled_disp_before,
+                   "a cancelled-member envelope reached the hive")
+            # the slice is reclaimed: the worker serves a fresh job
+            _check(await _spin(
+                lambda: worker.allocator.has_free_slice(), 30.0),
+                "slice never freed after the cancelled pass")
+            follow_up = await swarm.submit(gang_job(9))
+            status = await swarm.wait_done(follow_up, timeout=240.0)
+            _check(status["status"] == "done",
+                   "follow-up job failed on the reclaimed slice")
+            # timelines: survivors are complete end-to-end; the victim's
+            # terminal event is its cancel, WAL-durable
+            for job_id in ids:
+                record = swarm.hive.queue.records[job_id]
+                trace = build_trace(record, swarm.hive.queue.clock.wall())
+                if job_id == victim:
+                    _check(trace["events"][-1]["event"] == "cancel"
+                           and trace["open"] is False,
+                           f"victim timeline not cancel-terminal: "
+                           f"{[e['event'] for e in trace['events']]}")
+                else:
+                    missing = trace_missing(trace)
+                    _check(not missing,
+                           f"{job_id} timeline incomplete: {missing}")
+            _check(worker.outbox.depth == 0,
+                   "outbox should hold nothing for a dropped row")
+    finally:
+        os.environ.pop("CHIASWARM_DENOISE_CHUNK_STEPS", None)
+        plan.release_hangs()
+    return ("gang member cancelled mid-denoise: row dropped at a chunk "
+            "boundary, 3 batchmates settled exactly once, slice reclaimed")
+
+
 async def scenario_hive_crash_recovery() -> str:
     """Hive durability (ISSUE 6 acceptance): a hive subprocess holding
     one QUEUED and one LEASED job is killed with SIGKILL; a restart over
@@ -658,6 +769,7 @@ SCENARIOS = {
     "sigterm_drain": scenario_sigterm_drain,
     "hive_lease_takeover": scenario_hive_lease_takeover,
     "gang_member_lost": scenario_gang_member_lost,
+    "cancel_mid_denoise": scenario_cancel_mid_denoise,
     "hive_crash_recovery": scenario_hive_crash_recovery,
     "hive_failover": scenario_hive_failover,
     "hive_split_brain_fenced": scenario_hive_split_brain_fenced,

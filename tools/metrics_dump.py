@@ -222,6 +222,15 @@ def hive_summary(samples) -> dict:
         "jobs_failed": next(
             (int(v) for m, _, v in samples
              if m == "swarm_hive_jobs_failed_total"), 0),
+        # cancellation & deadlines (ISSUE 10)
+        "cancelled": {k: int(v) for k, v in sorted(_label_counts(
+            samples, "swarm_hive_cancelled_total", "stage").items())},
+        "expired": next(
+            (int(v) for m, _, v in samples
+             if m == "swarm_hive_expired_total"), 0),
+        "cancel_revocations_pending": next(
+            (int(v) for m, _, v in samples
+             if m == "swarm_hive_cancel_revocations_pending"), 0),
         "queue_wait": _class_quantiles(
             samples, "swarm_hive_queue_wait_seconds"),
         "dispatch_to_settle": _class_quantiles(
@@ -270,6 +279,16 @@ def render_hive_tables(summary: dict) -> str:
         f"hive leases   active={summary['leases_active']} "
         f"expired={summary['leases_expired']} "
         f"failed={summary['jobs_failed']}")
+    if (summary.get("cancelled") or summary.get("expired")
+            or summary.get("cancel_revocations_pending")):
+        cancelled = summary.get("cancelled") or {}
+        lines.append(
+            "hive cancels  "
+            + " ".join(f"{s}={n}" for s, n in cancelled.items())
+            + (" " if cancelled else "")
+            + f"expired={summary.get('expired', 0)} "
+            f"pending_revocations="
+            f"{summary.get('cancel_revocations_pending', 0)}")
     if summary["results"]:
         lines.append("hive results  " + " ".join(
             f"{s}={n}" for s, n in summary["results"].items()))

@@ -208,6 +208,20 @@ def render_hive(cur: Snapshot, prev: Snapshot | None) -> list[str]:
         lines.append("  shed      " + " ".join(
             f"{c}={int(n)}{rate(n, pshed.get(c), dt)}"
             for c, n in sorted(shed.items())))
+    # cancellation & deadlines (ISSUE 10): revoked jobs by the stage the
+    # cancel caught them in, TTL expiries, and lease revocations still
+    # waiting for their lessee's next poll
+    cancelled = cur.counters("swarm_hive_cancelled_total", "stage")
+    expired = cur.gauge("swarm_hive_expired_total")
+    pending_rev = cur.gauge("swarm_hive_cancel_revocations_pending")
+    if cancelled or expired or pending_rev:
+        pcancelled = prev.counters(
+            "swarm_hive_cancelled_total", "stage") if prev else {}
+        parts = [f"{s}={int(n)}{rate(n, pcancelled.get(s), dt)}"
+                 for s, n in sorted(cancelled.items())]
+        parts.append(f"expired={int(expired or 0)}")
+        parts.append(f"pending_revocations={int(pending_rev or 0)}")
+        lines.append("  cancel    " + " ".join(parts))
     results = cur.counters("swarm_hive_results_total", "status")
     if results:
         lines.append("  results   " + " ".join(
