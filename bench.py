@@ -1115,9 +1115,14 @@ def run_hive_e2e_row() -> None:
         # lease mid-run and fail test_bench's redeliveries==0 assertion.
         # max_jobs_per_poll=8 lets the gang scheduler (ISSUE 9) hand the
         # whole 8-job burst as ONE pre-batched /work reply.
+        # the SLO engine on (loose objectives — the row asserts the
+        # REPORT exists and carries per-class data, not that a loaded CI
+        # box hits production latencies)
         hive = await HiveServer(
             Settings(sdaas_token=token, hive_port=0,
                      hive_lease_deadline_s=900.0,
+                     hive_slo="default:e2e_p95<600,queue_wait_p95<600",
+                     hive_slo_fast_window_s=900.0,
                      hive_max_jobs_per_poll=8), port=0).start()
         expired = telemetry.REGISTRY.get("swarm_hive_leases_expired_total")
         headers = {"Authorization": f"Bearer {token}",
@@ -1335,6 +1340,37 @@ def run_hive_e2e_row() -> None:
                                        headers=headers) as resp:
                     victim_status = (await resp.json())["status"]
 
+                # --- fleet accounting & SLOs (ISSUE 11): the ledger's
+                # attributed chip-seconds over the independently summed
+                # executing spans of every settled job (from each
+                # envelope's own stage timings) — anything the ledger
+                # dropped shows up as a ratio below 1.0 ---
+                from chiaswarm_tpu.hive_server.accounting import (
+                    chip_seconds_of,
+                )
+
+                settled_ids = [*warmup_ids, *ids,
+                               "bench-cancel-warm-0", "bench-cancel-ref-0"]
+                if victim_status == "done":  # the raced no-op side
+                    settled_ids.append(victim)
+                executing_span_s = 0.0
+                for job_id in settled_ids:
+                    async with session.get(
+                            f"{hive.api_uri}/jobs/{job_id}",
+                            headers=headers) as resp:
+                        st = await resp.json()
+                    timings = ((st.get("result") or {}).get(
+                        "pipeline_config") or {}).get("timings")
+                    span = chip_seconds_of(timings)
+                    if span:
+                        executing_span_s += span
+                async with session.get(f"{hive.api_uri}/usage",
+                                       headers=headers) as resp:
+                    usage = await resp.json()
+                async with session.get(f"{hive.api_uri}/slo",
+                                       headers=headers) as resp:
+                    slo_report = await resp.json()
+
             waits.sort()
             pre_batched = sum(1 for s in gang_sizes if s >= 2)
             gang_sizes.sort()
@@ -1371,6 +1407,20 @@ def run_hive_e2e_row() -> None:
                 "cancel_full_pass_s": round(full_pass_s, 3),
                 "cancel_victim_status": victim_status,
                 "cancel_raced": not bool(cancel_ack.get("cancelled")),
+                # fleet accounting & SLOs (ISSUE 11): tenant-attributed
+                # chip-seconds over summed executing spans (>= 0.95 in
+                # test_bench = nothing silently dropped), and whether
+                # the SLO engine reported real per-class data
+                "usage_accounted_ratio": round(
+                    usage["totals"]["chip_seconds"] / executing_span_s, 4)
+                if executing_span_s > 0 else 0.0,
+                "usage_chip_seconds": usage["totals"]["chip_seconds"],
+                "usage_settled_jobs": usage["totals"]["jobs"],
+                "usage_fallback_jobs": usage["totals"]["fallback_jobs"],
+                "slo_report_present": bool(
+                    slo_report.get("enabled")
+                    and slo_report.get("classes", {}).get("default", {})
+                    .get("objectives")),
             }
         finally:
             worker.terminate()  # SIGTERM -> graceful drain

@@ -297,10 +297,14 @@ class HiveClient:
         """Poll the hive for jobs, advertising this worker's capabilities.
 
         `capabilities` comes from the chip layer (chips/allocator.py) and
-        includes legacy keys (`memory`, `gpu`) plus TPU keys. A
-        not-primary 409 fails over and retries the next endpoint within
-        this call (one full cycle at most); transport errors surface to
-        the poll loop's backoff after noting the endpoint failure."""
+        includes legacy keys (`memory`, `gpu`) plus TPU keys — and, for
+        a stats-reporting worker, the compact per-stage EWMA blob the
+        hive's straggler detector reads (`stats`, a JSON string; the
+        worker pre-serializes it because every value here is stringified
+        onto the query). A not-primary 409 fails over and retries the
+        next endpoint within this call (one full cycle at most);
+        transport errors surface to the poll loop's backoff after noting
+        the endpoint failure."""
         last: Exception | None = None
         for _ in range(len(self.endpoints)):
             try:

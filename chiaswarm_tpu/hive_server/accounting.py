@@ -1,0 +1,349 @@
+"""Per-tenant usage accounting: who consumed which chip-seconds.
+
+The ROADMAP's planet-scale front-door item says it plainly: at
+millions-of-users traffic "per-tenant accounting becomes load-bearing"
+— weighted-fair queueing, quota-aware shedding, and LoRA-aware dispatch
+(SwiftDiffusion, arXiv 2407.02031: per-user add-on modules dominate
+serving cost) all presuppose the hive can ATTRIBUTE cost per submitter.
+This module is that attribution.
+
+Design: the ledger is **pure derived state**. Every settled job's record
+already carries, WAL-journaled verbatim with its settle event, the raw
+material attribution needs — the job dict (tenant field), the result
+envelope (``pipeline_config.timings`` stage spans, ``embed_cache``
+counters, spooled artifact byte counts) and the wall-stamped timeline
+(dispatch/settle instants, gang size). So usage is computed *from the
+records*, never separately persisted: crash recovery, WAL compaction,
+and standby replication all reproduce the ledger for free because they
+reproduce the records — the same trick the trace endpoint uses. The
+totals are therefore crash-consistent bit for bit (integer micro-units
+internally, so summation order across live-vs-replay cannot perturb a
+single bit; pinned by the ``usage_survives_restart`` chaos scenario).
+
+Attribution per settled job:
+
+- ``tenant``       the job's ``tenant`` field (default ``"anon"``);
+- ``chip_seconds`` the worker's whole-pass ``job_s`` stage span (the
+                   authoritative chip occupancy, stamped by ChipSet
+                   around the pass), else the sum of per-stage spans,
+                   else — the **fallback** — the wall-clock delta from
+                   the last dispatch to the settle in the timeline,
+                   counted in ``swarm_hive_usage_fallback_total`` so a
+                   legacy worker's envelopes are never silently dropped
+                   from the tenant's bill;
+- ``rows``         image rows (coalesce.job_rows);
+- ``coalesce_saved_seconds`` the chip time sharing a pass saved:
+                   chip_s * (group-1)/group, group = coalesced batch
+                   size from the envelope trace (``coalesced_with``) or
+                   the dispatch gang size;
+- ``embed_cache_hits`` prompt-embedding rows served from cache during
+                   the job's pass (stamped by the pipeline);
+- ``artifact_bytes`` decoded artifact payload bytes (spool refs carry
+                   exact counts; inline blobs are estimated from the
+                   base64 length).
+
+Served at ``GET /api/usage`` and ``GET /api/tenants/{id}/usage``, and
+exported as ``swarm_hive_tenant_chip_seconds_total{tenant}`` /
+``swarm_hive_tenant_rows_total{tenant}`` gauges with the top-K tenants
+by chip-seconds named and the rest folded into ``other``
+(``hive_tenant_topk``) so tenant cardinality can never blow up the
+metrics surface.
+"""
+
+from __future__ import annotations
+
+from .. import telemetry
+from ..coalesce import job_rows
+
+TENANT_DEFAULT = "anon"
+# the fold bucket for tenants past the top-K gauge cut; a real tenant
+# named "other" folds into it too (documented, bounded > perfect)
+TENANT_OTHER = "other"
+
+# timings keys that are waiting, not chip work
+_NON_CHIP_KEYS = frozenset({"queue_wait_s", "submit_s"})
+
+_FALLBACK = telemetry.counter(
+    "swarm_hive_usage_fallback_total",
+    "Settled jobs attributed by wall-clock dispatch-to-settle because "
+    "the envelope carried no pipeline_config.timings (older worker, or "
+    "a parked-then-requeued outbox envelope) — billed approximately "
+    "instead of silently dropped from the tenant ledger",
+)
+_TENANT_CHIP_S = telemetry.gauge(
+    "swarm_hive_tenant_chip_seconds_total",
+    "Chip-seconds attributed to each tenant's settled jobs (top-K by "
+    "cost; the rest fold into tenant=\"other\")",
+    ("tenant",),
+)
+_TENANT_ROWS = telemetry.gauge(
+    "swarm_hive_tenant_rows_total",
+    "Image rows attributed to each tenant's settled jobs (top-K by "
+    "chip-seconds; the rest fold into tenant=\"other\")",
+    ("tenant",),
+)
+
+# label values currently exported, so a tenant dropping out of the
+# top-K retires its series instead of freezing at its last value
+_exported_tenants: set[str] = set()
+
+
+def tenant_of(job: dict) -> str:
+    """The submitter a job bills to: its `tenant` field (set from the
+    submit body; a missing/blank/non-string value is the shared
+    anonymous tenant). Reading the job dict — which rides the WAL admit
+    event verbatim — is what makes attribution replay- and
+    replication-safe with no extra persistence."""
+    if not isinstance(job, dict):
+        return TENANT_DEFAULT
+    tenant = job.get("tenant")
+    if isinstance(tenant, str) and tenant.strip():
+        return tenant.strip()
+    return TENANT_DEFAULT
+
+
+def _as_float(value) -> float | None:
+    try:
+        v = float(value)
+    except (TypeError, ValueError):
+        return None
+    return v if v >= 0 else None
+
+
+def chip_seconds_of(timings) -> float | None:
+    """Chip occupancy from a settled envelope's stage timings: ``job_s``
+    (the ChipSet's whole-pass wall clock, which every per-stage span
+    nests inside) when present, else the per-stage sum excluding the
+    waiting stages. None = no usable timings (the caller falls back to
+    hive wall clock and counts it)."""
+    if not isinstance(timings, dict):
+        return None
+    job_s = _as_float(timings.get("job_s"))
+    if job_s is not None:
+        return job_s
+    total = 0.0
+    seen = False
+    for key, value in timings.items():
+        if not (isinstance(key, str) and key.endswith("_s")):
+            continue
+        if key in _NON_CHIP_KEYS:
+            continue
+        v = _as_float(value)
+        if v is not None:
+            total += v
+            seen = True
+    return total if seen else None
+
+
+def _pipeline_config(result) -> dict:
+    if isinstance(result, dict) and isinstance(
+            result.get("pipeline_config"), dict):
+        return result["pipeline_config"]
+    return {}
+
+
+def _coalesce_group(record) -> int:
+    """How many jobs shared the pass that served this record: the
+    worker-echoed ``coalesced_with`` count from the envelope trace when
+    present (the worker knows what actually coalesced, linger merges
+    included), else the dispatch-time gang size from the timeline."""
+    trace = _pipeline_config(record.result).get("trace")
+    if isinstance(trace, dict):
+        mates = trace.get("coalesced_with")
+        if isinstance(mates, int) and mates >= 0:
+            return mates + 1
+    for event in reversed(getattr(record, "timeline", ()) or ()):
+        if isinstance(event, dict) and event.get("event") == "dispatch":
+            size = event.get("gang_size")
+            if isinstance(size, int) and size >= 1:
+                return size
+            break
+    return 1
+
+
+def _fallback_wall_s(record) -> float:
+    """Wall-clock dispatch-to-settle from the journaled timeline — the
+    approximation a timings-free envelope is billed at."""
+    dispatched = settled = None
+    for event in getattr(record, "timeline", ()) or ():
+        if not isinstance(event, dict):
+            continue
+        if event.get("event") == "dispatch":
+            dispatched = _as_float(event.get("wall"))
+        elif event.get("event") == "settle":
+            settled = _as_float(event.get("wall"))
+    if dispatched is None or settled is None:
+        return 0.0
+    return max(settled - dispatched, 0.0)
+
+
+def _artifact_bytes(result) -> int:
+    total = 0
+    artifacts = result.get("artifacts") if isinstance(result, dict) else None
+    if not isinstance(artifacts, dict):
+        return 0
+    for art in artifacts.values():
+        if not isinstance(art, dict):
+            continue
+        if isinstance(art.get("bytes"), int):
+            total += max(art["bytes"], 0)
+        elif isinstance(art.get("blob"), str):
+            # inline base64 (spool disabled or failed): decoded size
+            total += len(art["blob"]) * 3 // 4
+    return total
+
+
+def job_usage(record) -> dict | None:
+    """One settled record's attribution, in integer micro-units (so
+    per-tenant sums are independent of summation order — live settle
+    order vs WAL-replay record order must produce bit-identical
+    totals). None for anything not settled `done` with a result."""
+    if getattr(record, "state", None) != "done":
+        return None
+    if not isinstance(record.result, dict):
+        return None
+    cfg = _pipeline_config(record.result)
+    chip_s = chip_seconds_of(cfg.get("timings"))
+    fallback = chip_s is None
+    if fallback:
+        chip_s = _fallback_wall_s(record)
+    chip_us = int(round(chip_s * 1e6))
+    group = _coalesce_group(record)
+    embed = cfg.get("embed_cache")
+    hits = 0
+    if isinstance(embed, dict) and isinstance(embed.get("hits"), int):
+        hits = max(embed["hits"], 0)
+    return {
+        "tenant": tenant_of(record.job),
+        "chip_us": chip_us,
+        "rows": job_rows(record.job),
+        "coalesced": group > 1,
+        "saved_us": chip_us * (group - 1) // max(group, 1),
+        "embed_cache_hits": hits,
+        "artifact_bytes": _artifact_bytes(record.result),
+        "fallback": fallback,
+    }
+
+
+_FIELDS = ("jobs", "chip_us", "rows", "coalesced_jobs", "saved_us",
+           "embed_cache_hits", "artifact_bytes", "fallback_jobs")
+
+
+def zero_bucket() -> dict:
+    return {field: 0 for field in _FIELDS}
+
+
+def usage_summary(records) -> dict:
+    """Aggregate every settled record into per-tenant + total buckets
+    (integer micro-units; `render_usage` turns them wire-ready). Pure —
+    derived state, recomputed on demand from whatever records the
+    process holds (history pruning bounds the window, exactly as it
+    bounds GET /api/jobs/{id})."""
+    tenants: dict[str, dict] = {}
+    totals = zero_bucket()
+    for record in records:
+        usage = job_usage(record)
+        if usage is None:
+            continue
+        bucket = tenants.setdefault(usage["tenant"], zero_bucket())
+        for dst in (bucket, totals):
+            dst["jobs"] += 1
+            dst["chip_us"] += usage["chip_us"]
+            dst["rows"] += usage["rows"]
+            dst["coalesced_jobs"] += 1 if usage["coalesced"] else 0
+            dst["saved_us"] += usage["saved_us"]
+            dst["embed_cache_hits"] += usage["embed_cache_hits"]
+            dst["artifact_bytes"] += usage["artifact_bytes"]
+            dst["fallback_jobs"] += 1 if usage["fallback"] else 0
+    return {"tenants": tenants, "totals": totals}
+
+
+def render_bucket(bucket: dict) -> dict:
+    """One tenant's (or the totals') wire shape: micro-units become
+    rounded seconds, counters stay integers. Field set pinned by the
+    protocol-conformance suite."""
+    return {
+        "jobs": bucket["jobs"],
+        "chip_seconds": round(bucket["chip_us"] / 1e6, 3),
+        "rows": bucket["rows"],
+        "coalesced_jobs": bucket["coalesced_jobs"],
+        "coalesce_saved_seconds": round(bucket["saved_us"] / 1e6, 3),
+        "embed_cache_hits": bucket["embed_cache_hits"],
+        "artifact_bytes": bucket["artifact_bytes"],
+        "fallback_jobs": bucket["fallback_jobs"],
+    }
+
+
+def render_usage(summary: dict, topk: int = 0) -> dict:
+    """The GET /api/usage payload: every tenant rendered (the JSON
+    surface is for operators and billing — it is not cardinality-bound
+    the way the metrics are), sorted by chip-seconds, plus the grand
+    totals and the top-K cut the gauges use. The one assembly both the
+    real hive and the test fake serve, so the conformance-pinned reply
+    shape has a single source of truth."""
+    tenants = summary["tenants"]
+    ordered = sorted(tenants.items(),
+                     key=lambda kv: (-kv[1]["chip_us"], kv[0]))
+    return {
+        "tenants": {t: render_bucket(b) for t, b in ordered},
+        "totals": render_bucket(summary["totals"]),
+        "top": [t for t, _ in ordered[:topk]] if topk > 0
+               else [t for t, _ in ordered],
+        "settled_jobs": summary["totals"]["jobs"],
+        "topk": topk,
+    }
+
+
+def render_tenant_reply(summary: dict, tenant: str) -> dict:
+    """The GET /api/tenants/{id}/usage payload (shared by the real hive
+    and the test fake): one tenant's bucket, zeroed when the retained
+    history holds nothing for it."""
+    bucket = summary["tenants"].get(tenant)
+    return {
+        "tenant": tenant,
+        "known": bucket is not None,
+        "usage": render_bucket(
+            bucket if bucket is not None else zero_bucket()),
+    }
+
+
+def refresh_tenant_metrics(summary: dict, topk: int) -> None:
+    """Re-export the per-tenant gauges from a fresh summary: the top-K
+    tenants by chip-seconds keep their own label value, everything else
+    folds into ``other``, and label values that dropped out of the cut
+    are REMOVED (a gauge is a statement about now, and a stale tenant
+    series would misreport forever)."""
+    global _exported_tenants
+    ordered = sorted(summary["tenants"].items(),
+                     key=lambda kv: (-kv[1]["chip_us"], kv[0]))
+    topk = max(int(topk), 1)
+    named = ordered[:topk]
+    folded = ordered[topk:]
+    exported: set[str] = set()
+    for tenant, bucket in named:
+        label = TENANT_OTHER if tenant == TENANT_OTHER else tenant
+        _TENANT_CHIP_S.set(round(bucket["chip_us"] / 1e6, 3), tenant=label)
+        _TENANT_ROWS.set(bucket["rows"], tenant=label)
+        exported.add(label)
+    if folded or TENANT_OTHER in exported:
+        chip_us = sum(b["chip_us"] for _, b in folded)
+        rows = sum(b["rows"] for _, b in folded)
+        if TENANT_OTHER in exported:
+            # a literal "other" tenant merged with the fold bucket
+            chip_us += sum(b["chip_us"] for t, b in named
+                           if t == TENANT_OTHER)
+            rows += sum(b["rows"] for t, b in named if t == TENANT_OTHER)
+        _TENANT_CHIP_S.set(round(chip_us / 1e6, 3), tenant=TENANT_OTHER)
+        _TENANT_ROWS.set(rows, tenant=TENANT_OTHER)
+        exported.add(TENANT_OTHER)
+    for stale in _exported_tenants - exported:
+        _TENANT_CHIP_S.remove(tenant=stale)
+        _TENANT_ROWS.remove(tenant=stale)
+    _exported_tenants = exported
+
+
+def note_fallback() -> None:
+    """Count one live fallback attribution (never called on replay —
+    the counter, like every hive counter, measures this process's own
+    observations, not reconstructed history)."""
+    _FALLBACK.inc()

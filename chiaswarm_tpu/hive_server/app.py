@@ -17,6 +17,9 @@ closed-source:
                             leased cancels ride the next /work reply's
                             `cancels` piggyback to the lessee)
   GET  /api/jobs/{id}       lifecycle snapshot + spooled result
+  GET  /api/usage           per-tenant usage ledger (accounting.py)
+  GET  /api/tenants/{t}/usage  one tenant's bucket
+  GET  /api/slo             per-class SLO compliance + burn rates (slo.py)
   GET  /api/artifacts/{d}   content-addressed artifact bytes
   GET  /metrics, /healthz   same telemetry registry the worker uses
 
@@ -41,7 +44,10 @@ from aiohttp import web
 
 from .. import faults, telemetry
 from ..settings import Settings, get_settings_dir, load_settings, resolve_path
+from . import accounting
 from .dispatch import Dispatcher, WorkerDirectory
+from .fleet import FleetStats
+from .slo import SLOEngine, parse_slo
 from .journal import (
     HiveJournal,
     apply_events,
@@ -123,9 +129,20 @@ class HiveServer:
         # double-dispatch or double-settle (see _fenced)
         self.standby = bool(standby)
         self.epoch = 0
+        # fleet observability plane (ISSUE 11): per-tenant usage is pure
+        # derived state over the records (accounting.py); the SLO engine
+        # and fleet straggler stats are live-traffic views created here
+        # so _new_state (also the replication reset path) can rewire the
+        # queue's observation hook into the same engine
+        self.tenant_topk = int(g("hive_tenant_topk", 10))
+        self.slo = SLOEngine(
+            parse_slo(g("hive_slo", "")),
+            fast_window_s=float(g("hive_slo_fast_window_s", 60.0)),
+            slow_window_s=float(g("hive_slo_slow_window_s", 600.0)))
+        self.fleet = FleetStats(factor=float(g("hive_straggler_factor", 2.5)))
         self.queue, self.leases = self._new_state()
         self.directory = WorkerDirectory(
-            ttl_s=float(g("hive_worker_ttl_s", 45.0)))
+            ttl_s=float(g("hive_worker_ttl_s", 45.0)), fleet=self.fleet)
         self.dispatcher = Dispatcher(
             self.directory,
             affinity_hold_s=float(g("hive_affinity_hold_s", 15.0)),
@@ -181,7 +198,22 @@ class HiveServer:
         # across a hive crash still hears about the revocation
         self._cancel_notify: dict[str, set[str]] = {}
         self.rebuild_cancel_notify()
+        # the tenant ledger is derived from the records, so a WAL replay
+        # (or a fresh start) prices in here — the gauges agree with
+        # GET /api/usage from the first scrape
+        self.refresh_usage_metrics()
         self.note_role_change()
+
+    def refresh_usage_metrics(self) -> dict:
+        """Recompute the per-tenant usage summary from the records and
+        re-export the top-K gauges; returns the raw summary (micro-unit
+        buckets) for the callers that render it. O(retained history) —
+        settles only mark the gauges dirty and the reaper (or the next
+        /api/usage read) pays this, never the result hot path."""
+        summary = accounting.usage_summary(self.queue.records.values())
+        accounting.refresh_tenant_metrics(summary, self.tenant_topk)
+        self._usage_dirty = False
+        return summary
 
     def rebuild_cancel_notify(self) -> None:
         """Re-derive the pending-revocation map from record state (WAL
@@ -222,6 +254,9 @@ class HiveServer:
             deadline_s=float(g("hive_lease_deadline_s", 300.0)),
             max_redeliveries=int(g("hive_max_redeliveries", 3)),
         )
+        # rewired on every reset so a standby's rebuilt queue keeps
+        # feeding the same live SLO windows
+        queue.slo = self.slo
         return queue, leases
 
     # --- lifecycle ---
@@ -243,6 +278,9 @@ class HiveServer:
         app.router.add_post("/api/jobs/{job_id}/cancel", self._cancel)
         app.router.add_get("/api/jobs/{job_id}", self._job_status)
         app.router.add_get("/api/jobs/{job_id}/trace", self._job_trace)
+        app.router.add_get("/api/usage", self._usage)
+        app.router.add_get("/api/tenants/{tenant}/usage", self._tenant_usage)
+        app.router.add_get("/api/slo", self._slo)
         app.router.add_get("/api/artifacts/{digest}", self._artifact)
         app.router.add_get("/api/replication/stream", self._replication_stream)
         app.router.add_get("/metrics", self._metrics)
@@ -325,6 +363,13 @@ class HiveServer:
                 self._expire_due()
                 self._park_unplaceable()
                 self._sweep_spool_if_due()
+                # keep the burn-rate gauges fresh between scrapes: the
+                # windows slide whether or not anyone polls /api/slo
+                self.slo.refresh_metrics()
+                if self._usage_dirty:
+                    # settles defer the O(history) tenant-gauge refresh
+                    # here: once per reaper tick, not once per result
+                    self.refresh_usage_metrics()
             except Exception:
                 # the reaper is the only thing that frees a dead
                 # worker's lease; it must survive any single bad pass
@@ -638,6 +683,24 @@ class HiveServer:
         self._journal(ev_settle(record))
         for pruned in self.queue.retire(record):
             self._journal(ev_retire(pruned))
+        # tenant accounting (accounting.py): bill this settle. An
+        # envelope with no usable stage timings (older worker, a parked-
+        # then-requeued outbox redelivery) is billed its wall-clock
+        # dispatch-to-settle and COUNTED — approximate beats silently
+        # absent from the tenant's ledger. Counted live only; replay
+        # rebuilds the ledger without re-counting.
+        usage = accounting.job_usage(record)
+        if usage is not None and usage["fallback"]:
+            accounting.note_fallback()
+            logger.warning(
+                "job %s settled without pipeline_config.timings; tenant "
+                "%s billed wall-clock %.3fs (fallback)", job_id,
+                usage["tenant"], usage["chip_us"] / 1e6)
+        # the gauge refresh re-scans the retained records (O(history));
+        # deferring it to the next reaper tick keeps the settle path
+        # O(1) however deep the history runs — /api/usage itself always
+        # refreshes, so readers never see the deferral
+        self._usage_dirty = True
         _RESULTS.inc(status=status)
         return web.json_response(
             {"status": "ok"}, headers=self._epoch_headers())
@@ -759,6 +822,7 @@ class HiveServer:
         return web.json_response({
             "id": record.job_id,
             "class": record.job_class,
+            "tenant": record.tenant,
             "status": record.state,
             "depth": self.queue.depth,
         })
@@ -791,6 +855,40 @@ class HiveServer:
                 {"message": "unknown job id"}, status=404)
         return web.json_response(
             build_trace(record, self.queue.clock.wall()))
+
+    async def _usage(self, request: web.Request) -> web.Response:
+        """GET /api/usage: the per-tenant ledger — chip-seconds, rows,
+        coalesce savings, embed-cache hits, artifact bytes, and fallback
+        counts per submitter, plus grand totals. Derived on demand from
+        the settled records (accounting.py), so it is exactly as
+        crash-consistent and replication-consistent as the records
+        themselves; standbys answer it like any other read. Window =
+        whatever history the hive retains (hive_job_history_limit), the
+        same window GET /api/jobs/{id} answers from."""
+        if not self._authorized(request):
+            return self._unauthorized()
+        summary = self.refresh_usage_metrics()
+        return web.json_response(
+            accounting.render_usage(summary, self.tenant_topk))
+
+    async def _tenant_usage(self, request: web.Request) -> web.Response:
+        """GET /api/tenants/{id}/usage: one tenant's bucket (zeroed when
+        the retained history holds nothing for it — an unknown tenant is
+        indistinguishable from an idle one by design)."""
+        if not self._authorized(request):
+            return self._unauthorized()
+        return web.json_response(accounting.render_tenant_reply(
+            accounting.usage_summary(self.queue.records.values()),
+            request.match_info["tenant"]))
+
+    async def _slo(self, request: web.Request) -> web.Response:
+        """GET /api/slo: per-class objective compliance and fast/slow
+        burn rates over the sliding windows (slo.py). Shape is
+        conformance-pinned; with no hive_slo configured the reply
+        carries enabled=false and an empty classes map."""
+        if not self._authorized(request):
+            return self._unauthorized()
+        return web.json_response(self.slo.refresh_metrics())
 
     async def _artifact(self, request: web.Request) -> web.Response:
         if not self._authorized(request):
@@ -862,6 +960,11 @@ class HiveServer:
                     f"{cls} watermark {threshold})")
         if self.refuse_with is not None:
             reasons.append(f"draining: refusing workers ({self.refuse_with})")
+        # SLO fast-burn breaches are degraded reasons: a class burning
+        # its error budget >FAST_BURN_DEGRADED x over the fast window is
+        # exactly what an orchestrator probe should react to
+        slo_report = self.slo.refresh_metrics()
+        reasons.extend(self.slo.degraded_reasons(slo_report))
         extra: dict = {}
         if self.extra_health is not None:
             # replication.py installs its tail-side view here: a standby
@@ -881,6 +984,17 @@ class HiveServer:
             "leases_active": len(self.leases),
             "jobs": states,
             "workers": self.directory.snapshot(),
+            # fleet observability plane (ISSUE 11): compact SLO verdict
+            # per class, straggler flags per live reporter, and the
+            # top-K tenant cut — the swarm_top frames read these
+            "slo": {
+                cls: {"fast_burn": view["fast_burn"],
+                      "slow_burn": view["slow_burn"],
+                      "compliance": view["compliance"],
+                      "breaching": view["breaching"]}
+                for cls, view in slo_report["classes"].items()
+            },
+            "stragglers": self.fleet.snapshot(self.directory.live_names()),
         }
         if self.journal is not None:
             payload["wal"] = {

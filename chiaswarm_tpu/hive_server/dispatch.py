@@ -22,7 +22,12 @@ Outcomes (counted in `swarm_hive_dispatch_total{outcome}`):
 - gang      the job rode along as a gang MEMBER behind a seed job with
             the same coalesce key (ISSUE 9): same-key queued batchmates
             leave in ONE /work reply, pre-batched, so the worker's
-            linger window is no longer the only coalescing opportunity.
+            linger window is no longer the only coalescing opportunity;
+- straggler_hold  an INTERACTIVE job was withheld from a poller the
+            fleet stats flag as a straggler (fleet.py) while a healthy
+            capable worker is live — bounded by the same hold window as
+            affinity, so stragglers degrade latency-sensitive placement,
+            never availability.
 
 Gang scheduling: when the picked job is coalesce-compatible
 (coalesce.py — the exact key the worker's BatchScheduler groups by) and
@@ -47,12 +52,13 @@ import uuid
 from .. import telemetry
 from ..coalesce import job_rows, placement_model
 from .clock import CLOCK
+from .fleet import parse_stats
 from .queue import JobRecord, PriorityJobQueue
 
 _DISPATCH = telemetry.counter(
     "swarm_hive_dispatch_total",
     "Hive /work dispatch decisions by placement outcome "
-    "(affinity | cold | steal | hold | gang)",
+    "(affinity | cold | steal | hold | gang | straggler_hold)",
     ("outcome",),
 )
 _GANG_SIZE = telemetry.histogram(
@@ -101,6 +107,9 @@ class WorkerInfo:
     # also reports queue_depth in ROWS incl. executing (ISSUE 9); a
     # legacy poller keeps the pre-gang budget contract
     gang_aware: bool = False
+    # per-stage EWMA stats blob from the `stats` poll param (fleet.py):
+    # {stage: (ewma_seconds, samples)}; empty for legacy pollers
+    stats: dict = dataclasses.field(default_factory=dict)
     last_seen: float = 0.0
 
     @property
@@ -134,8 +143,12 @@ class WorkerDirectory:
     /work poll and age out after `ttl_s` — a dead worker's stale
     residency claim must not hold jobs hostage (see live_holders)."""
 
-    def __init__(self, ttl_s: float):
+    def __init__(self, ttl_s: float, fleet=None):
         self.ttl_s = max(float(ttl_s), 0.0)
+        # FleetStats (fleet.py): fed the per-stage EWMA blobs workers
+        # piggyback on their polls, pruned in lockstep with liveness so
+        # a departed worker's stats can't skew the straggler medians
+        self.fleet = fleet
         self._workers: dict[str, WorkerInfo] = {}
 
     def observe(self, query: dict) -> WorkerInfo:
@@ -157,6 +170,7 @@ class WorkerDirectory:
             queue_depth=_to_int(query.get("queue_depth")),
             gang_rows=max(_to_int(query.get("gang_rows"), 1), 1),
             gang_aware="gang_rows" in query,
+            stats=parse_stats(query.get("stats")),
             last_seen=CLOCK.mono(),
         )
         self._workers[name] = info
@@ -168,8 +182,16 @@ class WorkerDirectory:
         for stale in [n for n, w in self._workers.items()
                       if w.last_seen < cutoff]:
             del self._workers[stale]
+            if self.fleet is not None:
+                self.fleet.forget(stale)
+        if self.fleet is not None:
+            self.fleet.note(name, info.stats)
+            self.fleet.refresh_metrics(self.live_names())
         _WORKERS_LIVE.set(len(self.live()))
         return info
+
+    def live_names(self) -> list[str]:
+        return [w.name for w in self.live()]
 
     def live(self) -> list[WorkerInfo]:
         cutoff = CLOCK.mono() - self.ttl_s
@@ -264,6 +286,14 @@ class Dispatcher:
         items, free_rows = self._budget(worker)
         now = CLOCK.mono()
         taken: set[str] = set()
+        # straggler view for this poll (fleet.py): computed once — the
+        # poller's own verdict and the set of healthy live workers that
+        # could serve an interactive seed instead
+        fleet = self.directory.fleet
+        live = self.directory.live() if fleet is not None else []
+        live_names = [w.name for w in live]
+        poller_is_straggler = (
+            fleet is not None and fleet.is_outlier(worker.name, live_names))
         for record in queue.iter_queued():
             if (items <= 0 or free_rows <= 0
                     or len(handed) >= self.max_jobs_per_poll):
@@ -275,6 +305,20 @@ class Dispatcher:
             # resident_models) actually knows them by
             model = placement_model(record.job)
             if not worker.can_run(model):
+                continue
+            if (poller_is_straggler
+                    and record.job_class == "interactive"
+                    and now - record.submitted_at < self.affinity_hold_s
+                    and any(w.name != worker.name and w.can_run(model)
+                            and not fleet.is_outlier(w.name, live_names)
+                            for w in live)):
+                # observability feeding placement: a latency-sensitive
+                # seed is withheld from a fleet straggler while a
+                # healthy capable worker is live — but only inside the
+                # placement-hold window, so a fleet of stragglers (or a
+                # healthy worker that stopped polling) degrades to the
+                # slow dispatch, never to starvation
+                _DISPATCH.inc(outcome="straggler_hold")
                 continue
             if model and model in worker.resident:
                 outcome = "affinity"

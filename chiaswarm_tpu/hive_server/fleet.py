@@ -1,0 +1,182 @@
+"""Fleet stage statistics and straggler detection.
+
+A gang-scheduled swarm has a new failure mode the per-worker telemetry
+cannot see: one slice that runs every stage 3x slower than its peers —
+a thermally throttled chip, a host swapping, a half-broken driver —
+silently drags EVERY gang it joins down to its pace. The worker itself
+looks healthy (it polls, it completes jobs); only a FLEET-relative view
+can tell it is the straggler.
+
+The raw material rides the /work poll: each worker piggybacks a compact
+per-stage EWMA blob in the ``stats`` query param (worker.py maintains
+the EWMAs from its own settled envelopes' stage timings — per Worker
+instance, alpha = ``hive_stats_ewma_alpha``)::
+
+    stats={"a": 0.2, "s": {"job": [1.234, 17], "denoise": [0.81, 17]}}
+
+(``s`` maps stage -> [ewma_seconds, sample_count]; the param is
+conformance-pinned, and a hive that predates it simply ignores the
+unknown key.)
+
+This module keeps the latest blob per worker and compares each worker's
+per-stage EWMA against the MEDIAN of its live peers (median of the
+*others*, so one extreme value cannot drag the baseline toward itself —
+with two workers the peer median is simply the other worker). A worker
+is an outlier on a stage when its EWMA exceeds
+``hive_straggler_factor`` x the peer median AND beats it by an absolute
+floor (MIN_DELTA_S — microsecond-scale stages must not flag on noise),
+with at least MIN_SAMPLES observations on both sides and at least
+MIN_REPORTERS live workers reporting the stage.
+
+Outliers are exported as ``swarm_hive_worker_outlier{worker}`` (live
+workers only — series retire with the directory) and surfaced to the
+dispatcher, which deprioritizes stragglers for INTERACTIVE seeds: an
+interactive job inside its placement-hold window is withheld from an
+outlier's poll while a healthy capable worker is live (counted as
+``swarm_hive_dispatch_total{outcome="straggler_hold"}``), so
+observability feeds placement — the slow slice keeps serving batch
+traffic, but latency-sensitive work routes around it.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import statistics
+
+from .. import telemetry
+
+logger = logging.getLogger(__name__)
+
+# minimum EWMA sample count before a stage participates (both for the
+# candidate and for any peer feeding the median)
+MIN_SAMPLES = 3
+# minimum live workers reporting a stage before anyone can be judged
+MIN_REPORTERS = 2
+# absolute slowdown floor: a stage must be this many seconds over the
+# peer median (on top of the factor) to flag — sub-50ms jitter on fast
+# stages is noise, not a straggler
+MIN_DELTA_S = 0.05
+
+_OUTLIER = telemetry.gauge(
+    "swarm_hive_worker_outlier",
+    "1 while this worker's per-stage EWMA marks it a fleet straggler "
+    "(slower than hive_straggler_factor x the live peer median on some "
+    "stage), 0 for a healthy live worker",
+    ("worker",),
+)
+
+
+def parse_stats(raw: str | None) -> dict[str, tuple[float, int]]:
+    """The /work ``stats`` query param -> {stage: (ewma_s, n)}. Tolerant
+    of anything — the blob is worker-volunteered advisory data and a
+    malformed one must cost the stats, never the poll."""
+    if not raw:
+        return {}
+    try:
+        blob = json.loads(raw)
+    except ValueError:
+        return {}
+    stages = blob.get("s") if isinstance(blob, dict) else None
+    if not isinstance(stages, dict):
+        return {}
+    out: dict[str, tuple[float, int]] = {}
+    for stage, pair in stages.items():
+        if not (isinstance(stage, str)
+                and isinstance(pair, (list, tuple)) and len(pair) == 2):
+            continue
+        try:
+            ewma, n = float(pair[0]), int(pair[1])
+        except (ValueError, TypeError):
+            continue  # one bad entry must not cost the rest
+        if ewma >= 0 and n >= 0:
+            out[stage] = (ewma, n)
+    return out
+
+
+class FleetStats:
+    """Latest per-worker stage EWMAs + the fleet-relative outlier
+    verdicts. Owned by the HiveServer, fed by WorkerDirectory.observe,
+    read by Dispatcher.select — all on one event loop."""
+
+    def __init__(self, factor: float = 2.5):
+        self.factor = max(float(factor), 1.0)
+        self._stats: dict[str, dict[str, tuple[float, int]]] = {}
+        self._exported: set[str] = set()
+        # verdict memo: every poll reads verdicts (observe refreshes the
+        # gauge, select gates placement for each live peer), so the full
+        # evaluation is computed ONCE per (stats generation, live set)
+        # in a single pass over the fleet instead of per caller
+        self._gen = 0
+        self._verdict_key: tuple | None = None
+        self._verdicts: dict[str, list[str]] = {}
+
+    def note(self, worker: str, stages: dict[str, tuple[float, int]]) -> None:
+        if stages and self._stats.get(worker) != stages:
+            self._stats[worker] = stages
+            self._gen += 1
+
+    def forget(self, worker: str) -> None:
+        """Directory aged the worker out; its stats and gauge series go
+        with it (a dead worker is not a straggler, it is gone)."""
+        if self._stats.pop(worker, None) is not None:
+            self._gen += 1
+        if worker in self._exported:
+            _OUTLIER.remove(worker=worker)
+            self._exported.discard(worker)
+
+    def stages_of(self, worker: str) -> dict[str, tuple[float, int]]:
+        return dict(self._stats.get(worker, {}))
+
+    def verdicts(self, live: list[str]) -> dict[str, list[str]]:
+        """{reporting live worker: stages flagged} — the whole fleet
+        judged in one pass (per stage: collect the qualifying reporters,
+        compare each against the median of the OTHERS), memoized until
+        the stats or the live set change."""
+        key = (self._gen, tuple(sorted(live)))
+        if key == self._verdict_key:
+            return self._verdicts
+        result: dict[str, list[str]] = {
+            w: [] for w in live if w in self._stats}
+        by_stage: dict[str, list[tuple[str, float]]] = {}
+        for worker in result:
+            for stage, (ewma, n) in self._stats[worker].items():
+                if n >= MIN_SAMPLES:
+                    by_stage.setdefault(stage, []).append((worker, ewma))
+        for stage, pairs in by_stage.items():
+            if len(pairs) < MIN_REPORTERS:
+                continue
+            values = sorted(e for _, e in pairs)
+            for worker, ewma in pairs:
+                # peer baseline: the sorted values minus ONE instance of
+                # this worker's own (equal values are interchangeable)
+                i = values.index(ewma)
+                baseline = statistics.median(values[:i] + values[i + 1:])
+                if (ewma > self.factor * baseline
+                        and ewma - baseline > MIN_DELTA_S):
+                    result[worker].append(stage)
+        self._verdict_key, self._verdicts = key, result
+        return result
+
+    def outlier_stages(self, worker: str, live: list[str]) -> list[str]:
+        """Stages on which `worker` is a straggler relative to its live
+        peers' median (see module docstring for the gate stack)."""
+        return self.verdicts(live).get(worker, [])
+
+    def is_outlier(self, worker: str, live: list[str]) -> bool:
+        return bool(self.outlier_stages(worker, live))
+
+    def snapshot(self, live: list[str]) -> dict:
+        """/healthz view: per-live-worker flagged stages (empty list =
+        healthy), for operators and swarm_top."""
+        return dict(self.verdicts(live))
+
+    def refresh_metrics(self, live: list[str]) -> None:
+        """Re-export the outlier gauge for exactly the live reporters;
+        series for departed workers are removed, not zeroed forever."""
+        verdicts = self.verdicts(live)
+        for worker, flagged in verdicts.items():
+            _OUTLIER.set(1 if flagged else 0, worker=worker)
+        for stale in self._exported - set(verdicts):
+            _OUTLIER.remove(worker=stale)
+        self._exported = set(verdicts)

@@ -43,6 +43,7 @@ import uuid
 from collections import OrderedDict, deque
 
 from .. import telemetry
+from .accounting import tenant_of
 from ..coalesce import coalesce_key
 from .clock import CLOCK, HiveClock
 
@@ -220,11 +221,19 @@ class JobRecord:
     # and replication all reconstruct it
     cancel_stage: str | None = None
 
+    @property
+    def tenant(self) -> str:
+        """The submitter this job bills to (accounting.py). Derived from
+        the job dict — which every WAL admit event carries verbatim — so
+        attribution is replay- and replication-safe for free."""
+        return tenant_of(self.job)
+
     def status(self) -> dict:
         """JSON-ready snapshot for GET /api/jobs/{id}."""
         return {
             "id": self.job_id,
             "class": self.job_class,
+            "tenant": self.tenant,
             "status": self.state,
             "attempts": self.attempts,
             "worker": self.worker,
@@ -257,6 +266,13 @@ class PriorityJobQueue:
             shed_watermarks if shed_watermarks is not None
             else DEFAULT_SHED_WATERMARKS)
         self.clock = clock or CLOCK
+        # SLO engine hook (slo.py, installed by HiveServer): the same
+        # queue-wait / settle measurements the histograms observe also
+        # feed the sliding-window burn-rate evaluation — one
+        # measurement, two views. Replay paths never come through the
+        # observing methods, so recovered history can't pollute a
+        # live-traffic SLO window.
+        self.slo = None
         self._queues: dict[str, deque[tuple[int, JobRecord]]] = {
             cls: deque() for cls in JOB_CLASSES
         }
@@ -487,6 +503,9 @@ class PriorityJobQueue:
                 self.clock.mono() - record.submitted_at, 3)
             _QUEUE_WAIT.observe(record.queue_wait_s,
                                 **{"class": record.job_class})
+            if self.slo is not None:
+                self.slo.observe(record.job_class, "queue_wait",
+                                 record.queue_wait_s)
         event = {
             "event": "dispatch", "wall": self.clock.wall(),
             "worker": worker, "outcome": outcome,
@@ -503,9 +522,13 @@ class PriorityJobQueue:
         called once per settled result, never on replay."""
         if record.dispatched_at is None or record.done_at is None:
             return
-        _DISPATCH_TO_SETTLE.observe(
-            max(record.done_at - record.dispatched_at, 0.0),
-            **{"class": record.job_class})
+        d2s = max(record.done_at - record.dispatched_at, 0.0)
+        _DISPATCH_TO_SETTLE.observe(d2s, **{"class": record.job_class})
+        if self.slo is not None:
+            self.slo.observe(record.job_class, "dispatch_to_settle", d2s)
+            self.slo.observe(
+                record.job_class, "e2e",
+                max(record.done_at - record.submitted_at, 0.0))
 
     def requeue_front(self, record: JobRecord) -> None:
         """Put an expired-lease job back at the FRONT of its class: a

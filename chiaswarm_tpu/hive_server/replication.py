@@ -221,6 +221,11 @@ class StandbyHive:
                 events, self.server.queue, self.server.leases)
             _APPLIED.inc(len(events))
             logger.debug("replicated %d event(s) -> %s", len(events), summary)
+            # replicated settles carry usage (the ledger is derived from
+            # the records); refresh the per-tenant gauges here or this
+            # standby's /metrics would disagree with its own /api/usage
+            # until promotion — once per applied sync, never per event
+            self.server.refresh_usage_metrics()
         # a reset ADOPTS the primary's position outright (it may be LOWER
         # than ours was — wiped/truncated primary WAL); only incremental
         # replies move the cursor monotonically. (_reset_state already
@@ -342,6 +347,10 @@ class StandbyHive:
         # table; the promoted hive must also take over the NOTIFY half
         # (tell surviving lessees about revocations on their next poll)
         srv.rebuild_cancel_notify()
+        # ...and the tenant gauges must reflect the replicated ledger
+        # from the promoted hive's first scrape (the final drain above
+        # may have failed, so don't rely on sync_once's refresh)
+        srv.refresh_usage_metrics()
         srv.note_role_change()
         _PROMOTIONS.inc()
         self.promoted = True

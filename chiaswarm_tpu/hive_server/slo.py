@@ -1,0 +1,272 @@
+"""Declarative per-class latency SLOs with sliding-window burn rates.
+
+The hive already histograms hive-side queue wait and dispatch-to-settle
+per priority class, but a histogram is a lifetime statement — it cannot
+answer "is the interactive class meeting its objective RIGHT NOW, and
+how fast is it burning its error budget?". This engine does, the
+standard SRE way:
+
+- ``Settings.hive_slo`` declares objectives per class, e.g.::
+
+      interactive:queue_wait_p95<2.0,e2e_p95<30;default:e2e_p95<120
+
+  classes separated by ``;``, objectives by ``,``; each objective is
+  ``<metric>_p<NN><threshold_seconds`` with metrics ``queue_wait``
+  (submission -> first dispatch), ``dispatch_to_settle`` (last dispatch
+  -> settled result), and ``e2e`` (submission -> settled result). An
+  empty spec disables the engine (``GET /api/slo`` still answers, with
+  ``enabled: false`` — the reply shape is conformance-pinned).
+
+- the engine keeps raw timestamped observations over two sliding
+  windows (``hive_slo_fast_window_s`` default 60 s,
+  ``hive_slo_slow_window_s`` default 600 s), fed at the exact sites the
+  existing ``swarm_hive_queue_wait_seconds`` /
+  ``swarm_hive_dispatch_to_settle_seconds`` histograms observe (the
+  queue's take/settle paths) — one measurement, two views. Replay and
+  replication never feed it: an SLO is a statement about live traffic.
+
+- per objective and window it reports **compliance** (fraction of
+  observations within threshold) and **burn rate** — the error budget
+  consumption multiplier, ``(1 - compliance) / (1 - quantile)``: burn
+  1.0 exactly spends the budget (e.g. 5% of requests over threshold
+  against a p95 objective), burn 2.0 spends it twice as fast. When the
+  fast-window burn crosses ``FAST_BURN_DEGRADED`` the class lands in
+  /healthz ``degraded_reasons`` — a page-worthy fast burn, per the
+  classic multi-window alerting policy.
+
+Exported as ``swarm_hive_slo_burn_rate{class,window}`` (worst objective
+per class per window) and ``swarm_hive_slo_compliance{class}`` (worst
+fast-window compliance), and served whole at ``GET /api/slo``.
+"""
+
+from __future__ import annotations
+
+import logging
+import re
+from collections import deque
+
+from .. import telemetry
+from .clock import CLOCK, HiveClock
+
+logger = logging.getLogger(__name__)
+
+# metrics an objective may target; fed by queue.py observation hooks
+METRICS = ("queue_wait", "dispatch_to_settle", "e2e")
+
+# fast-window burn rate past which the class is a /healthz degraded
+# reason: >2x budget burn sustained over the fast window is the classic
+# "page now" half of a multi-window burn alert
+FAST_BURN_DEGRADED = 2.0
+
+_OBJECTIVE_RE = re.compile(
+    r"^(?P<metric>[a-z0-9_]+)_p(?P<pct>\d{1,2})\s*<\s*(?P<threshold>[0-9.]+)$")
+
+_BURN_RATE = telemetry.gauge(
+    "swarm_hive_slo_burn_rate",
+    "Error-budget burn-rate multiplier per priority class and window "
+    "(worst objective; 1.0 = spending the budget exactly, >1 = "
+    "over-budget), over the fast/slow sliding windows",
+    ("class", "window"),
+)
+_COMPLIANCE = telemetry.gauge(
+    "swarm_hive_slo_compliance",
+    "Worst fast-window objective compliance per priority class "
+    "(fraction of observations within threshold; 1.0 = fully compliant)",
+    ("class",),
+)
+
+
+class Objective:
+    __slots__ = ("metric", "quantile", "threshold_s")
+
+    def __init__(self, metric: str, quantile: float, threshold_s: float):
+        self.metric = metric
+        self.quantile = quantile
+        self.threshold_s = threshold_s
+
+    @property
+    def name(self) -> str:
+        return f"{self.metric}_p{int(round(self.quantile * 100))}" \
+               f"<{self.threshold_s:g}"
+
+
+def parse_slo(spec: str | None) -> dict[str, list[Objective]]:
+    """``hive_slo`` spec -> {class: [Objective]}. Unparseable entries
+    are logged and dropped — a typo in one objective must not take the
+    whole engine (or the hive) down."""
+    objectives: dict[str, list[Objective]] = {}
+    spec = (spec or "").strip()
+    if not spec:
+        return objectives
+    for clause in spec.split(";"):
+        clause = clause.strip()
+        if not clause:
+            continue
+        cls, sep, body = clause.partition(":")
+        cls = cls.strip().lower()
+        if not sep or not cls:
+            logger.warning("hive_slo clause %r has no class prefix; "
+                           "ignored", clause)
+            continue
+        for part in body.split(","):
+            part = part.strip()
+            if not part:
+                continue
+            m = _OBJECTIVE_RE.match(part)
+            if not m or m.group("metric") not in METRICS:
+                logger.warning(
+                    "unparseable hive_slo objective %r ignored "
+                    "(want <metric>_p<NN><seconds with metric in %s)",
+                    part, METRICS)
+                continue
+            pct = int(m.group("pct"))
+            if not 0 < pct < 100:
+                logger.warning("hive_slo quantile p%d out of (0,100); "
+                               "%r ignored", pct, part)
+                continue
+            try:
+                threshold = float(m.group("threshold"))
+            except ValueError:
+                # "1.2.3" matches the [0-9.]+ capture but is no number
+                logger.warning(
+                    "hive_slo threshold in %r is not a number; ignored",
+                    part)
+                continue
+            objectives.setdefault(cls, []).append(
+                Objective(m.group("metric"), pct / 100.0, threshold))
+    return objectives
+
+
+class SLOEngine:
+    """Sliding-window compliance + burn-rate evaluation for the parsed
+    objectives. Single-threaded like the rest of the hive (observe sites
+    and report callers all live on the coordinator's event loop)."""
+
+    # per (class, metric) observation cap — at any plausible settle rate
+    # the slow window is long gone before this trips; it only bounds a
+    # pathological burst's memory
+    MAX_SAMPLES = 4096
+
+    def __init__(self, objectives: dict[str, list[Objective]],
+                 fast_window_s: float = 60.0, slow_window_s: float = 600.0,
+                 clock: HiveClock | None = None):
+        self.objectives = objectives
+        self.fast_window_s = max(float(fast_window_s), 1.0)
+        self.slow_window_s = max(float(slow_window_s), self.fast_window_s)
+        self.clock = clock or CLOCK
+        # (class, metric) -> deque[(mono, seconds)], newest right
+        self._samples: dict[tuple[str, str], deque] = {}
+        self._needed: dict[str, set[str]] = {
+            cls: {o.metric for o in objs}
+            for cls, objs in objectives.items()
+        }
+
+    @property
+    def enabled(self) -> bool:
+        return bool(self.objectives)
+
+    def observe(self, cls: str, metric: str, seconds: float) -> None:
+        """One live measurement from the queue's take/settle path; a
+        class or metric no objective watches is dropped at the door."""
+        if metric not in self._needed.get(cls, ()):
+            return
+        q = self._samples.setdefault((cls, metric), deque())
+        q.append((self.clock.mono(), float(seconds)))
+        if len(q) > self.MAX_SAMPLES:
+            q.popleft()
+
+    def _window(self, cls: str, metric: str, window_s: float) -> list[float]:
+        q = self._samples.get((cls, metric))
+        if not q:
+            return []
+        cutoff = self.clock.mono() - window_s
+        # expire from the left while we're here: the deque stays bounded
+        # by the slow window without a separate sweep
+        slow_cutoff = self.clock.mono() - self.slow_window_s
+        while q and q[0][0] < slow_cutoff:
+            q.popleft()
+        return [v for t, v in q if t >= cutoff]
+
+    @staticmethod
+    def _evaluate(objective: Objective, samples: list[float]) -> dict:
+        n = len(samples)
+        if n == 0:
+            # no traffic = no budget burned; compliance is vacuous
+            return {"samples": 0, "compliance": 1.0, "burn_rate": 0.0,
+                    "met": True}
+        within = sum(1 for v in samples if v <= objective.threshold_s)
+        compliance = within / n
+        budget = 1.0 - objective.quantile
+        burn = (1.0 - compliance) / budget if budget > 0 else 0.0
+        return {
+            "samples": n,
+            "compliance": round(compliance, 4),
+            "burn_rate": round(burn, 3),
+            "met": compliance >= objective.quantile,
+        }
+
+    def report(self) -> dict:
+        """The GET /api/slo payload (shape conformance-pinned): every
+        declared class with per-objective windowed compliance/burn, plus
+        the class-level worst burns the gauges export."""
+        classes: dict[str, dict] = {}
+        for cls, objs in self.objectives.items():
+            rows = []
+            fast_burn = slow_burn = 0.0
+            worst_compliance = 1.0
+            for objective in objs:
+                windows = {}
+                for name, span in (("fast", self.fast_window_s),
+                                   ("slow", self.slow_window_s)):
+                    windows[name] = self._evaluate(
+                        objective, self._window(cls, objective.metric, span))
+                rows.append({
+                    "objective": objective.name,
+                    "metric": objective.metric,
+                    "quantile": objective.quantile,
+                    "threshold_s": objective.threshold_s,
+                    "windows": windows,
+                })
+                fast_burn = max(fast_burn, windows["fast"]["burn_rate"])
+                slow_burn = max(slow_burn, windows["slow"]["burn_rate"])
+                worst_compliance = min(
+                    worst_compliance, windows["fast"]["compliance"])
+            classes[cls] = {
+                "objectives": rows,
+                "fast_burn": fast_burn,
+                "slow_burn": slow_burn,
+                "compliance": worst_compliance,
+                "breaching": fast_burn > FAST_BURN_DEGRADED,
+            }
+        return {
+            "enabled": self.enabled,
+            "fast_window_s": self.fast_window_s,
+            "slow_window_s": self.slow_window_s,
+            "fast_burn_degraded": FAST_BURN_DEGRADED,
+            "classes": classes,
+        }
+
+    def refresh_metrics(self, report: dict | None = None) -> dict:
+        """Export the per-class gauges from a (fresh) report; returns the
+        report so callers evaluating for /healthz don't compute twice."""
+        report = report or self.report()
+        for cls, view in report["classes"].items():
+            _BURN_RATE.set(view["fast_burn"],
+                           **{"class": cls, "window": "fast"})
+            _BURN_RATE.set(view["slow_burn"],
+                           **{"class": cls, "window": "slow"})
+            _COMPLIANCE.set(view["compliance"], **{"class": cls})
+        return report
+
+    def degraded_reasons(self, report: dict | None = None) -> list[str]:
+        """/healthz reasons: one per class whose fast-window burn rate
+        crossed the page threshold."""
+        report = report or self.report()
+        reasons = []
+        for cls, view in report["classes"].items():
+            if view["breaching"]:
+                reasons.append(
+                    f"SLO fast burn for {cls}: {view['fast_burn']:.1f}x "
+                    f"budget over {self.fast_window_s:g}s "
+                    f"(compliance {view['compliance']:.2f})")
+        return reasons

@@ -748,6 +748,12 @@ class SDPipeline:
         """
         toks = tokenizers or self.tokenizers
         extras = extra_embeddings or [None] * len(toks)
+        # per-pass cache stats for the envelope (the hive's tenant
+        # ledger attributes embed-cache hits per job from it); reset
+        # here so a bypassed encode reports nothing rather than the
+        # previous pass's numbers. Instance state is safe: the slice
+        # busy lock serializes passes through one pipeline.
+        self.last_encode_stats = None
         cache = embed_cache.get_cache()
         # the resident text params, identity-compared below: a job that
         # swapped them (merged LoRA touching the encoders, custom
@@ -779,6 +785,7 @@ class SDPipeline:
                 else:
                     hits += 1
         cache.note_rows(hits, misses)
+        self.last_encode_stats = (hits, misses)
         missing = [t for t, v in found.items() if v is None]
         if missing:
             from .common import pad_bucket
@@ -1583,6 +1590,12 @@ class SDPipeline:
                 / 1e12,
                 4,
             ),
+            # per-pass prompt-embedding cache stats (tenant accounting:
+            # the hive attributes these hits to the job's submitter)
+            **({"embed_cache": {
+                "hits": self.last_encode_stats[0],
+                "misses": self.last_encode_stats[1]}}
+               if getattr(self, "last_encode_stats", None) else {}),
             "timings": timings,
         }
         return images, pipeline_config
@@ -1821,6 +1834,14 @@ class SDPipeline:
                     denoise_flops(self.unet.config, lh, lw, n,
                                   steps - t_start, cfg_rows=2) / 1e12, 4,
                 ),
+                # shared-pass embed-cache stats, copied per envelope
+                # like the timings below (the per-job split is unknown
+                # once rows stack — accounting treats them as the
+                # pass-level figure they are)
+                **({"embed_cache": {
+                    "hits": self.last_encode_stats[0],
+                    "misses": self.last_encode_stats[1]}}
+                   if getattr(self, "last_encode_stats", None) else {}),
                 # shared pass timings, copied per envelope: the envelope
                 # must stand alone once the hive splits the batch apart
                 "timings": dict(timings),

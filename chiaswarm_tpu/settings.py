@@ -183,6 +183,28 @@ class Settings:
     # never evicted
     hive_spool_max_bytes: int = 0
     hive_spool_max_age_s: float = 0.0
+    # --- fleet observability plane (accounting.py / slo.py / fleet.py) ---
+    # declarative per-class latency objectives, e.g.
+    # "interactive:queue_wait_p95<2.0,e2e_p95<30;default:e2e_p95<120"
+    # (classes split on ";", objectives on ","; metrics: queue_wait,
+    # dispatch_to_settle, e2e). "" disables the SLO engine; GET /api/slo
+    # still answers with enabled=false
+    hive_slo: str = ""
+    # sliding evaluation windows for compliance + burn rate: the fast
+    # window drives /healthz degraded reasons, the slow one trend view
+    hive_slo_fast_window_s: float = 60.0
+    hive_slo_slow_window_s: float = 600.0
+    # tenants named individually in the per-tenant usage gauges; the
+    # rest fold into tenant="other" so cardinality stays bounded
+    # (GET /api/usage always renders every tenant)
+    hive_tenant_topk: int = 10
+    # worker side: EWMA smoothing factor for the per-stage stats blob
+    # piggybacked on /work polls (the hive's straggler detector input)
+    hive_stats_ewma_alpha: float = 0.2
+    # hive side: a worker is flagged a straggler when its per-stage EWMA
+    # exceeds this multiple of the live peer median (plus an absolute
+    # floor — fleet.py MIN_DELTA_S)
+    hive_straggler_factor: float = 2.5
     # --- hive replication & failover (hive_server/replication.py) ---
     # worker side: comma-separated hive site URIs in preference order
     # (primary first, standby after); the HiveClient pins to one and
@@ -254,6 +276,12 @@ _ENV_OVERRIDES = {
     "CHIASWARM_HIVE_SHED_WATERMARKS": "hive_shed_watermarks",
     "CHIASWARM_HIVE_SPOOL_MAX_BYTES": "hive_spool_max_bytes",
     "CHIASWARM_HIVE_SPOOL_MAX_AGE_S": "hive_spool_max_age_s",
+    "CHIASWARM_HIVE_SLO": "hive_slo",
+    "CHIASWARM_HIVE_SLO_FAST_WINDOW_S": "hive_slo_fast_window_s",
+    "CHIASWARM_HIVE_SLO_SLOW_WINDOW_S": "hive_slo_slow_window_s",
+    "CHIASWARM_HIVE_TENANT_TOPK": "hive_tenant_topk",
+    "CHIASWARM_HIVE_STATS_EWMA_ALPHA": "hive_stats_ewma_alpha",
+    "CHIASWARM_HIVE_STRAGGLER_FACTOR": "hive_straggler_factor",
     "CHIASWARM_HIVE_URIS": "sdaas_uris",
     "CHIASWARM_HIVE_STANDBY_OF": "hive_standby_of",
     "CHIASWARM_HIVE_REPLICATION_POLL_S": "hive_replication_poll_s",

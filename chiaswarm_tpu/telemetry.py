@@ -103,6 +103,14 @@ class _Metric:
             )
         return tuple(str(labels[n]) for n in self.labelnames)
 
+    def remove(self, **labels) -> None:
+        """Drop one label combination's series. Bounded-cardinality
+        surfaces (per-tenant usage gauges folded to top-K, per-worker
+        outlier flags pruned with the directory) retire label values
+        here instead of exposing stale series forever."""
+        with self._lock:
+            self._values.pop(self._key(labels), None)
+
     def samples(self) -> list[str]:
         raise NotImplementedError
 

@@ -44,6 +44,7 @@ Differences by design:
 from __future__ import annotations
 
 import asyncio
+import json
 import logging
 import os
 import random
@@ -233,6 +234,17 @@ class Worker:
         self._executing_ids: set[str] = set()
         self._metrics_runner = None
         self._profiling = False  # one on-demand profiler capture at a time
+        # per-stage EWMA of this worker's OWN envelope stage timings
+        # (stage -> [ewma_seconds, samples]), piggybacked on every /work
+        # poll as the `stats` query param so the hive's fleet view
+        # (hive_server/fleet.py) can spot a straggler slice that looks
+        # healthy in isolation. Per-instance state, fed from the settled
+        # envelopes in _finish_result — deliberately NOT the process-
+        # global stage histogram, so in-process multi-worker harnesses
+        # report per-worker truth.
+        self._stage_stats: dict[str, list] = {}
+        self._stats_alpha = min(max(float(getattr(
+            self.settings, "hive_stats_ewma_alpha", 0.2) or 0.2), 0.01), 1.0)
         # monotonic time of the last SUCCESSFUL hive poll (healthz age)
         self._last_poll_monotonic: float | None = None
         self._poll_backoff_s = float(POLL_SECONDS)
@@ -563,7 +575,44 @@ class Worker:
         if self._last_poll_monotonic is not None:
             caps["last_poll_age_s"] = round(
                 time.monotonic() - self._last_poll_monotonic, 1)
+        # compact per-stage EWMA blob for the hive's straggler detector
+        # (hive_server/fleet.py): {"a": alpha, "s": {stage: [ewma, n]}}.
+        # Sent only once samples exist; legacy hives ignore the key.
+        if self._stage_stats:
+            caps["stats"] = json.dumps(
+                {"a": self._stats_alpha,
+                 "s": {stage: [round(ewma, 4), n]
+                       for stage, (ewma, n) in self._stage_stats.items()}},
+                separators=(",", ":"))
         return caps
+
+    def _note_stage_stats(self, timings: dict) -> None:
+        """Fold one PASS's stage spans into the per-stage EWMAs the
+        `stats` poll param advertises. Called once per physical pass
+        (a coalesced group's envelopes share copied timings — folding
+        each would fake the hive's min-samples confidence gate with one
+        observation), and waiting stages are excluded: queue_wait
+        measures THIS worker's backlog, which is load, not slowness —
+        folding it in would let the hive's own uneven dispatch
+        manufacture a 'straggler'."""
+        for key, value in timings.items():
+            if not (isinstance(key, str) and key.endswith("_s")):
+                continue
+            if key == "queue_wait_s":
+                continue
+            try:
+                v = float(value)
+            except (TypeError, ValueError):
+                continue
+            if v < 0:
+                continue
+            stage = key[:-2]
+            entry = self._stage_stats.get(stage)
+            if entry is None:
+                self._stage_stats[stage] = [v, 1]
+            else:
+                entry[0] += self._stats_alpha * (v - entry[0])
+                entry[1] += 1
 
     # --- producer: poll the hive ---
 
@@ -694,6 +743,12 @@ class Worker:
             batch, chipset, outcome = await self.batcher.claim(self.allocator)
             # queue_wait: hive handoff -> a slice actually starting the work
             picked_up = time.monotonic()
+            # whole-pass slice occupancy feeds the "pass" stage EWMA for
+            # the hive's straggler detector: unlike the envelope's
+            # job_s, this wall clock covers EVERYTHING that holds the
+            # slice (arg formatting, a wedged busy lock, an injected
+            # hang) — exactly the time a silently sick slice inflates
+            pass_started = picked_up
             queue_wait = {}
             traces = {}
             batch_ids = [str(job["id"]) for job in batch if "id" in job]
@@ -734,6 +789,7 @@ class Worker:
                 if len(prepared) > 1 and self._batchable(prepared):
                     results = await self.do_batched_work(
                         chipset, prepared, batch_cap)
+                    stats_folded = False
                     for result in results:
                         # a cancelled member's slot comes back as None:
                         # no envelope exists and none is delivered — the
@@ -742,6 +798,13 @@ class Worker:
                             continue
                         self._finish_result(
                             result, queue_wait, outcome, traces)
+                        if not stats_folded:
+                            # ONE coalesced pass = one stats sample; the
+                            # envelopes all carry the same copied timings
+                            self._note_stage_stats(
+                                result["pipeline_config"].get(
+                                    "timings") or {})
+                            stats_folded = True
                         await self._enqueue_result(result)
                 else:
                     for worker_function, kwargs in prepared:
@@ -754,12 +817,16 @@ class Worker:
                             continue
                         self._finish_result(
                             result, queue_wait, outcome, traces)
+                        self._note_stage_stats(
+                            result["pipeline_config"].get("timings") or {})
                         await self._enqueue_result(result)
             except Exception as e:
                 logger.exception("slice_worker error")
                 print(f"slice_worker {e}")
             finally:
                 self.allocator.release(chipset)
+                self._note_stage_stats(
+                    {"pass_s": round(time.monotonic() - pass_started, 4)})
                 for job in batch:
                     # pass the job so the row accounting (advertised
                     # queue_depth) subtracts its true image count
@@ -771,14 +838,14 @@ class Worker:
                     cancel_mod.discard(job_id)
                 self._update_queue_gauges()
 
-    @staticmethod
-    def _finish_result(result: dict, queue_wait: dict,
+    def _finish_result(self, result: dict, queue_wait: dict,
                        placement: str | None = None,
                        traces: dict | None = None) -> None:
         """Stamp worker-side stage timings (and the placement outcome that
         routed the work item to its slice) into the envelope and count the
         job by outcome — ONE place, so solo, coalesced, and fallback paths
-        all report identically."""
+        all report identically. (The `stats` EWMAs are fed separately,
+        once per physical pass — see _note_stage_stats.)"""
         cfg = result.setdefault("pipeline_config", {})
         if placement is not None:
             cfg["placement"] = placement

@@ -17,6 +17,33 @@ import uuid
 from aiohttp import web
 
 from chiaswarm_tpu.coalesce import coalesce_key, job_rows
+from chiaswarm_tpu.hive_server import accounting
+from chiaswarm_tpu.hive_server.slo import SLOEngine, parse_slo
+
+
+class _FakeRecord:
+    """Just enough of JobRecord for tenant echo + the shared accounting
+    helpers (which duck-type `job`, `state`, `result`, `timeline`)."""
+
+    def __init__(self, job: dict):
+        self.job = job
+        self.job_id = str(job.get("id", ""))
+        self.state = "queued"
+        self.result: dict | None = None
+        self.timeline: list[dict] = []
+
+    @property
+    def tenant(self) -> str:
+        return accounting.tenant_of(self.job)
+
+    def status(self) -> dict:
+        return {
+            "id": self.job_id,
+            "class": "default",
+            "tenant": self.tenant,
+            "status": self.state,
+            "result": self.result,
+        }
 
 
 class FakeHive:
@@ -74,6 +101,13 @@ class FakeHive:
         self.cancels: list[str] = []
         self.cancelled_ids: set[str] = set()
         self.cancelled_results: list[dict] = []
+        # fleet observability parity (ISSUE 11): jobs submitted via
+        # POST /api/jobs get a record echoing their tenant on
+        # GET /api/jobs/{id}; settled results feed the same accounting
+        # helpers the real hive uses, so GET /api/usage and GET /api/slo
+        # answer the conformance-pinned shapes without drift
+        self.records: dict[str, "_FakeRecord"] = {}
+        self._slo = SLOEngine(parse_slo(""))
         self._runner: web.AppRunner | None = None
         self.port: int | None = None
 
@@ -86,7 +120,12 @@ class FakeHive:
         app.router.add_get("/api/work", self._work)
         app.router.add_post("/api/results", self._results)
         app.router.add_get("/api/models", self._models)
+        app.router.add_post("/api/jobs", self._submit)
         app.router.add_post("/api/jobs/{job_id}/cancel", self._cancel)
+        app.router.add_get("/api/jobs/{job_id}", self._job_status)
+        app.router.add_get("/api/usage", self._usage)
+        app.router.add_get("/api/tenants/{tenant}/usage", self._tenant_usage)
+        app.router.add_get("/api/slo", self._slo_report)
         app.router.add_get("/image.png", self._image)
         self._runner = web.AppRunner(app)
         await self._runner.setup()
@@ -101,6 +140,71 @@ class FakeHive:
 
     def add_job(self, job: dict) -> None:
         self.pending_jobs.append(job)
+
+    async def _submit(self, request: web.Request) -> web.Response:
+        """POST /api/jobs, wire-parity with the real coordinator's
+        submit surface: the job (tenant field included) is queued for
+        the next /work poll and its record echoes the tenant on
+        GET /api/jobs/{id}."""
+        denied = self._unauthorized(request)
+        if denied is not None:
+            return denied
+        try:
+            job = json.loads(await request.text())
+        except json.JSONDecodeError:
+            return web.json_response(
+                {"message": "job is not JSON"}, status=400)
+        if not isinstance(job, dict):
+            return web.json_response(
+                {"message": "job must be a JSON object"}, status=400)
+        job = dict(job)
+        job_id = str(job.get("id") or uuid.uuid4().hex)
+        job["id"] = job_id
+        record = self.records.get(job_id)
+        if record is None:
+            record = _FakeRecord(job)
+            self.records[job_id] = record
+            self.pending_jobs.append(job)
+        return web.json_response({
+            "id": job_id,
+            "class": "default",
+            "tenant": record.tenant,
+            "status": record.state,
+            "depth": len(self.pending_jobs),
+        })
+
+    async def _job_status(self, request: web.Request) -> web.Response:
+        denied = self._unauthorized(request)
+        if denied is not None:
+            return denied
+        record = self.records.get(request.match_info["job_id"])
+        if record is None:
+            return web.json_response(
+                {"message": "unknown job id"}, status=404)
+        return web.json_response(record.status())
+
+    async def _usage(self, request: web.Request) -> web.Response:
+        """GET /api/usage through the SAME accounting helpers the real
+        hive serves from, so the reply shape cannot drift."""
+        denied = self._unauthorized(request)
+        if denied is not None:
+            return denied
+        return web.json_response(accounting.render_usage(
+            accounting.usage_summary(self.records.values()), 10))
+
+    async def _tenant_usage(self, request: web.Request) -> web.Response:
+        denied = self._unauthorized(request)
+        if denied is not None:
+            return denied
+        return web.json_response(accounting.render_tenant_reply(
+            accounting.usage_summary(self.records.values()),
+            request.match_info["tenant"]))
+
+    async def _slo_report(self, request: web.Request) -> web.Response:
+        denied = self._unauthorized(request)
+        if denied is not None:
+            return denied
+        return web.json_response(self._slo.report())
 
     async def wait_for_results(self, n: int, timeout: float = 30.0) -> list[dict]:
         async def _wait():
@@ -191,6 +295,11 @@ class FakeHive:
                 if gang_id is not None:
                     trace["gang"] = {"id": gang_id, "size": len(group),
                                      "index": index}
+                record = self.records.get(job_id)
+                if record is not None:
+                    record.state = "leased"
+                    record.timeline.append({
+                        "event": "dispatch", "wall": round(time.time(), 3)})
                 handed.append(dict(job, trace=trace))
         reply = {"jobs": handed}
         if self.cancels:
@@ -291,6 +400,12 @@ class FakeHive:
             return web.json_response({"status": "ok", "cancelled": True},
                                      headers=self._epoch_headers())
         self.results.append(envelope)
+        record = self.records.get(str(envelope.get("id", "")))
+        if record is not None:
+            record.state = "done"
+            record.result = envelope
+            record.timeline.append({
+                "event": "settle", "wall": round(time.time(), 3)})
         self.result_event.set()
         return web.json_response({"status": "ok"},
                                  headers=self._epoch_headers())

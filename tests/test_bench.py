@@ -102,6 +102,16 @@ def test_bench_cpu_fallback_produces_labeled_smoke_row():
     assert out.get("cancel_full_pass_s", 0) > 0, out
     assert out["cancel_reclaim_s"] < out["cancel_full_pass_s"], out
 
+    # fleet accounting & SLOs (ISSUE 11): the per-tenant ledger must
+    # account for (essentially) every executed chip-second — a ratio
+    # under 0.95 means settles silently dropped out of attribution —
+    # with zero fallback billings from a current worker, and the SLO
+    # engine must report real per-class objective data
+    assert out.get("usage_accounted_ratio", 0) >= 0.95, out
+    assert out.get("usage_settled_jobs", 0) >= out["hive_e2e_jobs"], out
+    assert out.get("usage_fallback_jobs") == 0, out
+    assert out.get("slo_report_present") is True, out
+
     # end-to-end tracing row (ISSUE 8): every settled job in the
     # hive_e2e scenario must carry a COMPLETE gap-free timeline —
     # admit/dispatch(placement)/settle events, an attributed queue-wait

@@ -5,7 +5,9 @@ crash-before-ack, drain-with-in-flight-job, a hive-side lease takeover
 worker), a worker dying while holding a 4-job GANG mid-denoise (lease
 expiry redelivers every member; exactly-once settle with gap-free
 traces), a hive SIGKILL'd while holding queued + leased jobs (WAL
-replay on restart, zero lost), a primary killed under a WAL-shipped
+replay on restart, zero lost), the per-tenant usage ledger surviving a
+hive SIGKILL bit-identically (and on a promoted standby), a primary
+killed under a WAL-shipped
 standby (health-checked self-promotion, worker failover, zero lost),
 and a revived deposed primary whose stale-epoch ACK must be fenced
 (no double-settle) — must end with a healthy swarm and zero lost
@@ -39,6 +41,7 @@ def _load_tool():
     "gang_member_lost",
     "cancel_mid_denoise",
     "hive_crash_recovery",
+    "usage_survives_restart",
     "hive_failover",
     "hive_split_brain_fenced",
 ])

@@ -1,0 +1,561 @@
+"""Fleet observability plane (ISSUE 11): per-tenant usage accounting,
+the SLO burn-rate engine, and fleet straggler detection — units over the
+three new hive_server modules, the worker's stats piggyback, and the
+LocalSwarm acceptance scenarios (usage crash-consistency across a hive
+restart, SLO reporting from real traffic, and an interactive seed
+measurably routing around a deliberately slowed worker)."""
+
+import asyncio
+import json
+import types
+
+import aiohttp
+import pytest
+
+from chiaswarm_tpu import faults, telemetry
+from chiaswarm_tpu import worker as worker_mod
+from chiaswarm_tpu.chips.allocator import SliceAllocator
+from chiaswarm_tpu.hive_server import accounting, fleet as fleet_mod, slo
+from chiaswarm_tpu.hive_server.clock import HiveClock
+from chiaswarm_tpu.hive_server.dispatch import Dispatcher, WorkerDirectory
+from chiaswarm_tpu.hive_server.queue import PriorityJobQueue
+from chiaswarm_tpu.settings import Settings
+from chiaswarm_tpu.worker import Worker
+
+
+@pytest.fixture(autouse=True)
+def fast_poll(monkeypatch):
+    monkeypatch.setattr(worker_mod, "POLL_SECONDS", 0.05)
+    monkeypatch.setattr(worker_mod, "ERROR_BACKOFF_SECONDS", 0.2)
+
+
+@pytest.fixture(autouse=True)
+def disarm_faults():
+    yield
+    faults.configure("")
+
+
+def _record(job=None, state="done", result=None, timeline=None):
+    """Duck-typed JobRecord stand-in: accounting only reads these."""
+    return types.SimpleNamespace(
+        job=job or {"id": "j"}, state=state, result=result,
+        timeline=timeline or [])
+
+
+def _echo(job_id, **extra):
+    return {"id": job_id, "workflow": "echo", "model_name": "none",
+            "prompt": job_id, **extra}
+
+
+# --- accounting units -------------------------------------------------------
+
+
+def test_tenant_of_defaults_and_trims():
+    assert accounting.tenant_of({}) == "anon"
+    assert accounting.tenant_of({"tenant": "  acme "}) == "acme"
+    assert accounting.tenant_of({"tenant": ""}) == "anon"
+    assert accounting.tenant_of({"tenant": 7}) == "anon"
+    assert accounting.tenant_of(None) == "anon"
+
+
+def test_chip_seconds_prefers_whole_pass_then_stage_sum():
+    # job_s is the ChipSet's whole-pass wall and every stage nests
+    # inside it: summing stages ON TOP would double-bill
+    assert accounting.chip_seconds_of(
+        {"job_s": 2.0, "denoise_s": 1.5, "queue_wait_s": 9.0}) == 2.0
+    # no job_s: per-stage sum, waiting stages excluded
+    assert accounting.chip_seconds_of(
+        {"denoise_s": 1.0, "decode_s": 0.5, "queue_wait_s": 4.0,
+         "submit_s": 3.0}) == 1.5
+    assert accounting.chip_seconds_of({"queue_wait_s": 1.0}) is None
+    assert accounting.chip_seconds_of({}) is None
+    assert accounting.chip_seconds_of(None) is None
+    assert accounting.chip_seconds_of({"job_s": "bogus"}) is None
+
+
+def test_job_usage_fallback_bills_wall_clock_from_timeline():
+    """Satellite bugfix: a settle with no pipeline_config.timings (older
+    worker / parked-then-requeued outbox envelope) must be billed its
+    wall-clock dispatch-to-settle instead of silently dropping out of
+    the tenant's ledger."""
+    record = _record(
+        job={"id": "j", "tenant": "acme"},
+        result={"id": "j", "artifacts": {}, "pipeline_config": {}},
+        timeline=[{"event": "admit", "wall": 100.0},
+                  {"event": "dispatch", "wall": 101.0},
+                  {"event": "settle", "wall": 103.5}])
+    usage = accounting.job_usage(record)
+    assert usage["fallback"] is True
+    assert usage["tenant"] == "acme"
+    assert usage["chip_us"] == 2_500_000  # 101.0 -> 103.5 wall
+    # an unfinished record contributes nothing
+    assert accounting.job_usage(_record(state="leased")) is None
+
+
+def test_job_usage_attribution_fields():
+    record = _record(
+        job={"id": "j", "tenant": "acme",
+             "parameters": {"num_images_per_prompt": 2}},
+        result={
+            "id": "j",
+            "artifacts": {
+                "primary": {"sha256": "x", "bytes": 1000},
+                "thumb": {"blob": "A" * 8},  # inline: 8 b64 chars -> 6
+            },
+            "pipeline_config": {
+                "timings": {"job_s": 4.0, "queue_wait_s": 0.5},
+                "embed_cache": {"hits": 3, "misses": 1},
+                "trace": {"coalesced_with": 3},  # 4-way shared pass
+            }},
+        timeline=[{"event": "dispatch", "wall": 1.0, "gang_size": 2}])
+    usage = accounting.job_usage(record)
+    assert usage["chip_us"] == 4_000_000
+    assert usage["rows"] == 2
+    assert usage["coalesced"] is True
+    # 4-way pass: 3/4 of the chip time was shared away
+    assert usage["saved_us"] == 3_000_000
+    assert usage["embed_cache_hits"] == 3
+    assert usage["artifact_bytes"] == 1006
+    assert usage["fallback"] is False
+
+
+def test_usage_summary_render_and_topk_gauge_fold():
+    records = [
+        _record(job={"id": f"a{i}", "tenant": "acme"},
+                result={"pipeline_config": {"timings": {"job_s": 2.0}},
+                        "artifacts": {}})
+        for i in range(2)
+    ] + [
+        _record(job={"id": "b", "tenant": "tiny"},
+                result={"pipeline_config": {"timings": {"job_s": 0.5}},
+                        "artifacts": {}}),
+        _record(job={"id": "c"},  # anon
+                result={"pipeline_config": {"timings": {"job_s": 1.0}},
+                        "artifacts": {}}),
+    ]
+    summary = accounting.usage_summary(records)
+    rendered = accounting.render_usage(summary, topk=2)
+    assert list(rendered["tenants"]) == ["acme", "anon", "tiny"]  # by cost
+    assert rendered["tenants"]["acme"]["jobs"] == 2
+    assert rendered["tenants"]["acme"]["chip_seconds"] == 4.0
+    assert rendered["totals"]["jobs"] == 4
+    assert rendered["totals"]["chip_seconds"] == 5.5
+    assert rendered["top"] == ["acme", "anon"]
+
+    # gauges: top-2 named, the rest folded into "other"; a later refresh
+    # that drops a tenant REMOVES its series instead of freezing it
+    chip = telemetry.REGISTRY.get("swarm_hive_tenant_chip_seconds_total")
+    accounting.refresh_tenant_metrics(summary, topk=2)
+    assert chip.value(tenant="acme") == 4.0
+    assert chip.value(tenant="anon") == 1.0
+    assert chip.value(tenant="other") == 0.5
+    accounting.refresh_tenant_metrics(
+        accounting.usage_summary(records[:2]), topk=2)
+    assert chip.value(tenant="acme") == 4.0
+    assert chip.value(tenant="anon") == 0.0  # removed -> default 0
+    assert chip.value(tenant="other") == 0.0
+
+
+# --- SLO engine units -------------------------------------------------------
+
+
+class FakeClock(HiveClock):
+    def __init__(self):
+        self.now = 1000.0
+        super().__init__(mono=lambda: self.now, wall=lambda: self.now)
+
+
+def test_parse_slo_tolerates_garbage():
+    objs = slo.parse_slo(
+        "interactive:queue_wait_p95<2.0,e2e_p95<30;"
+        "default:bogus_metric_p95<1,e2e_p200<5,e2e_p50<9;;nonsense")
+    assert [o.name for o in objs["interactive"]] == \
+        ["queue_wait_p95<2", "e2e_p95<30"]
+    assert [o.name for o in objs["default"]] == ["e2e_p50<9"]
+    assert slo.parse_slo("") == {}
+    assert slo.parse_slo(None) == {}
+
+
+def test_slo_compliance_burn_and_window_expiry():
+    clock = FakeClock()
+    engine = slo.SLOEngine(
+        slo.parse_slo("interactive:queue_wait_p95<1.0"),
+        fast_window_s=10.0, slow_window_s=100.0, clock=clock)
+    assert engine.enabled
+    # 18 good + 2 bad = 90% compliance -> burn (1-0.9)/0.05 = 2.0
+    for _ in range(18):
+        engine.observe("interactive", "queue_wait", 0.1)
+    for _ in range(2):
+        engine.observe("interactive", "queue_wait", 5.0)
+    # observations for unwatched classes/metrics are dropped at the door
+    engine.observe("batch", "queue_wait", 99.0)
+    engine.observe("interactive", "e2e", 99.0)
+    report = engine.report()
+    view = report["classes"]["interactive"]
+    [objective] = view["objectives"]
+    fast = objective["windows"]["fast"]
+    assert fast["samples"] == 20
+    assert fast["compliance"] == 0.9
+    assert fast["burn_rate"] == 2.0
+    assert fast["met"] is False
+    assert view["fast_burn"] == 2.0
+    assert view["breaching"] is False  # 2.0 is the threshold, not past it
+    assert engine.degraded_reasons(report) == []
+
+    # one more breach tips fast burn past FAST_BURN_DEGRADED
+    engine.observe("interactive", "queue_wait", 7.0)
+    report = engine.report()
+    assert report["classes"]["interactive"]["breaching"] is True
+    [reason] = engine.degraded_reasons(report)
+    assert "SLO fast burn for interactive" in reason
+
+    # the fast window slides: 15s later those samples only count toward
+    # the slow window, and an empty fast window burns nothing
+    clock.now += 15.0
+    report = engine.report()
+    [objective] = report["classes"]["interactive"]["objectives"]
+    assert objective["windows"]["fast"]["samples"] == 0
+    assert objective["windows"]["fast"]["burn_rate"] == 0.0
+    assert objective["windows"]["slow"]["samples"] == 21
+    # gauges follow the report
+    engine.refresh_metrics(report)
+    burn = telemetry.REGISTRY.get("swarm_hive_slo_burn_rate")
+    assert burn.value(**{"class": "interactive", "window": "fast"}) == 0.0
+    assert burn.value(**{"class": "interactive", "window": "slow"}) > 0
+
+
+def test_queue_feeds_slo_engine_at_take_and_settle():
+    clock = FakeClock()
+    engine = slo.SLOEngine(
+        slo.parse_slo("default:queue_wait_p95<10,e2e_p95<10"),
+        clock=clock)
+    queue = PriorityJobQueue(clock=clock)
+    queue.slo = engine
+    record = queue.submit(_echo("slo-1"))
+    clock.now += 2.0
+    queue.take(record, "w", "cold")
+    clock.now += 3.0
+    record.done_at = clock.mono()
+    queue.observe_settle(record)
+    report = engine.report()
+    by_metric = {o["metric"]: o for o
+                 in report["classes"]["default"]["objectives"]}
+    assert by_metric["queue_wait"]["windows"]["fast"]["samples"] == 1
+    assert by_metric["e2e"]["windows"]["fast"]["samples"] == 1
+
+
+# --- fleet straggler units --------------------------------------------------
+
+
+def test_parse_stats_tolerates_garbage():
+    blob = json.dumps({"a": 0.2, "s": {"job": [1.5, 4], "bad": ["x", 1],
+                                       "neg": [-1, 2]}})
+    assert fleet_mod.parse_stats(blob) == {"job": (1.5, 4)}
+    assert fleet_mod.parse_stats(None) == {}
+    assert fleet_mod.parse_stats("not json") == {}
+    assert fleet_mod.parse_stats(json.dumps({"s": "nope"})) == {}
+    assert fleet_mod.parse_stats(json.dumps([1, 2])) == {}
+
+
+def test_fleet_outlier_gates_and_gauge_lifecycle():
+    stats = fleet_mod.FleetStats(factor=2.5)
+    live = ["w-slow", "w-fast"]
+    stats.note("w-slow", {"pass": (1.0, 5)})
+    stats.note("w-fast", {"pass": (0.01, 5)})
+    # slow vs the PEER median (the other worker): 1.0 > 2.5*0.01 + floor
+    assert stats.outlier_stages("w-slow", live) == ["pass"]
+    assert stats.is_outlier("w-slow", live)
+    assert not stats.is_outlier("w-fast", live)
+    # a lone reporter can never be an outlier (no fleet to compare to)
+    assert not stats.is_outlier("w-slow", ["w-slow"])
+    # under MIN_SAMPLES on either side -> no verdict
+    stats.note("w-warm", {"pass": (9.0, 2)})
+    assert not stats.is_outlier("w-warm", live + ["w-warm"])
+    # the absolute floor: 2.6x a 10ms baseline is noise, not a straggler
+    stats.note("w-jitter", {"pass": (0.026, 5)})
+    assert not stats.is_outlier("w-jitter", ["w-jitter", "w-fast"])
+
+    gauge = telemetry.REGISTRY.get("swarm_hive_worker_outlier")
+    stats.refresh_metrics(live)
+    assert gauge.value(worker="w-slow") == 1
+    assert gauge.value(worker="w-fast") == 0
+    assert stats.snapshot(live) == {"w-slow": ["pass"], "w-fast": []}
+    # a departed worker's series retires with it
+    stats.forget("w-slow")
+    stats.refresh_metrics(["w-fast"])
+    assert gauge.value(worker="w-slow") == 0
+    assert stats.snapshot(["w-fast"]) == {"w-fast": []}
+
+
+def _poll_query(name, stats_blob=None, **extra):
+    query = {"worker_name": name, "worker_version": "0.1.0", "slices": "1",
+             "busy_slices": "0", "queue_depth": "0", "chips": "1"}
+    if stats_blob is not None:
+        query["stats"] = json.dumps(stats_blob)
+    query.update({k: str(v) for k, v in extra.items()})
+    return query
+
+
+def test_dispatch_withholds_interactive_from_straggler():
+    """Observability feeding placement: an interactive seed inside its
+    hold window is withheld from a flagged straggler while a healthy
+    capable worker is live (counted as straggler_hold); batch/default
+    traffic still flows, and a zero hold window disables avoidance
+    entirely (no starvation path)."""
+    stats = fleet_mod.FleetStats(factor=2.5)
+    directory = WorkerDirectory(ttl_s=60.0, fleet=stats)
+    dispatcher = Dispatcher(directory, affinity_hold_s=30.0,
+                            max_jobs_per_poll=4)
+    counter = telemetry.REGISTRY.get("swarm_hive_dispatch_total")
+    held_before = counter.value(outcome="straggler_hold")
+    slow = directory.observe(_poll_query(
+        "w-slow", {"a": 0.2, "s": {"pass": [1.0, 5]}}))
+    healthy = directory.observe(_poll_query(
+        "w-fast", {"a": 0.2, "s": {"pass": [0.01, 5]}}))
+
+    queue = PriorityJobQueue()
+    queue.submit(_echo("interactive-1", priority="interactive"))
+    queue.submit(_echo("default-1"))
+    # the straggler polls: the interactive seed is withheld, the default
+    # job still dispatches to it
+    handed = dispatcher.select(slow, queue)
+    assert [r.job_id for r, _, _ in handed] == ["default-1"]
+    assert counter.value(outcome="straggler_hold") == held_before + 1
+    for record, outcome, _ in handed:
+        queue.take(record, "w-slow", outcome)
+    # the healthy worker takes the interactive seed
+    handed = dispatcher.select(healthy, queue)
+    assert [r.job_id for r, _, _ in handed] == ["interactive-1"]
+    for record, outcome, _ in handed:
+        queue.take(record, "w-fast", outcome)
+
+    # hold window 0: avoidance off — a straggler-only fleet must not
+    # starve interactive traffic
+    dispatcher_off = Dispatcher(directory, affinity_hold_s=0.0,
+                                max_jobs_per_poll=4)
+    queue.submit(_echo("interactive-2", priority="interactive"))
+    slow = directory.observe(_poll_query(
+        "w-slow", {"a": 0.2, "s": {"pass": [1.0, 6]}}))
+    handed = dispatcher_off.select(slow, queue)
+    assert [r.job_id for r, _, _ in handed] == ["interactive-2"]
+
+
+# --- worker stats piggyback -------------------------------------------------
+
+
+def test_worker_stats_ewma_and_capabilities_blob(sdaas_root):
+    w = Worker(settings=Settings(sdaas_token="t", metrics_port=0,
+                                 hive_stats_ewma_alpha=0.5),
+               allocator=SliceAllocator(chips_per_job=0),
+               hive_uri="http://127.0.0.1:1/api")
+    # two passes' stage spans fold into the per-stage EWMAs; queue_wait
+    # is excluded (local backlog is load, not slowness — the hive's own
+    # uneven dispatch must not manufacture a straggler)
+    w._note_stage_stats({"job_s": 1.0, "denoise_s": 0.8,
+                         "queue_wait_s": 9.0})
+    w._note_stage_stats({"job_s": 2.0, "denoise_s": "bogus"})
+    assert w._stage_stats["job"] == [1.5, 2]  # 1.0 then +0.5*(2.0-1.0)
+    assert w._stage_stats["denoise"] == [0.8, 1]  # bogus value skipped
+    assert "queue_wait" not in w._stage_stats
+    caps = w._capabilities()
+    blob = json.loads(caps["stats"])
+    assert blob["a"] == 0.5
+    assert blob["s"]["job"] == [1.5, 2]
+    assert blob["s"]["denoise"] == [0.8, 1]
+
+
+def test_worker_without_samples_sends_no_stats(sdaas_root):
+    w = Worker(settings=Settings(sdaas_token="t", metrics_port=0),
+               allocator=SliceAllocator(chips_per_job=0),
+               hive_uri="http://127.0.0.1:1/api")
+    assert "stats" not in w._capabilities()
+
+
+# --- acceptance: LocalSwarm e2e ---------------------------------------------
+
+
+async def _get(session, uri, path, token="local-swarm"):
+    async with session.get(
+            f"{uri}{path}",
+            headers={"Authorization": f"Bearer {token}"}) as resp:
+        assert resp.status == 200, f"{path} -> HTTP {resp.status}"
+        return await resp.json()
+
+
+def test_usage_and_slo_e2e_across_hive_restart(sdaas_root):
+    """ISSUE 11 acceptance: jobs under two tenants settle through a real
+    swarm; GET /api/usage attributes them per tenant, survives a hive
+    restart bit-identically (WAL-derived), and GET /api/slo reports
+    per-class compliance from the real traffic."""
+    from chiaswarm_tpu.hive_server.harness import LocalSwarm
+
+    async def scenario():
+        swarm = LocalSwarm(
+            n_workers=1,
+            settings=Settings(
+                sdaas_token="local-swarm", worker_name="swarm-worker",
+                hive_port=0, metrics_port=0,
+                hive_slo="default:e2e_p95<600,queue_wait_p95<600"))
+        async with swarm:
+            for i, tenant in enumerate(["acme", "acme", "beta"]):
+                job_id = await swarm.submit(
+                    _echo(f"usage-{i}", tenant=tenant))
+                await swarm.wait_done(job_id)
+            async with aiohttp.ClientSession() as session:
+                usage = await _get(session, swarm.hive.uri, "/api/usage")
+                assert usage["tenants"]["acme"]["jobs"] == 2
+                assert usage["tenants"]["beta"]["jobs"] == 1
+                assert usage["tenants"]["acme"]["chip_seconds"] > 0
+                assert usage["tenants"]["acme"]["fallback_jobs"] == 0
+                assert usage["totals"]["jobs"] == 3
+                one = await _get(session, swarm.hive.uri,
+                                 "/api/tenants/beta/usage")
+                assert one["known"] and one["usage"]["jobs"] == 1
+
+                report = await _get(session, swarm.hive.uri, "/api/slo")
+                assert report["enabled"] is True
+                view = report["classes"]["default"]
+                by_metric = {o["metric"]: o for o in view["objectives"]}
+                assert by_metric["e2e"]["windows"]["fast"]["samples"] >= 3
+                assert by_metric["queue_wait"]["windows"]["fast"][
+                    "compliance"] == 1.0
+                assert view["breaching"] is False
+
+                # the restart replays the WAL; the ledger — pure derived
+                # state over the replayed records — must not move a bit
+                await swarm.restart_hive()
+                recovered = await _get(session, swarm.hive.uri,
+                                       "/api/usage")
+                assert recovered["tenants"] == usage["tenants"]
+                assert recovered["totals"] == usage["totals"]
+        return True
+
+    assert asyncio.run(scenario())
+
+
+def test_straggler_flagged_and_interactive_avoids_it_e2e(sdaas_root):
+    """ISSUE 11 acceptance: a deliberately slowed worker (hang_denoise
+    at low severity — every pass stalls 0.25 s) is flagged outlier from
+    its piggybacked stats within the sample window, and an interactive
+    seed measurably avoids it: the hive counts straggler_hold for the
+    slow worker's polls and hands the seed to the healthy peer."""
+    from chiaswarm_tpu.hive_server.harness import LocalSwarm
+
+    faults.configure("hang_denoise=50", hang_timeout_s=0.25)
+
+    async def scenario():
+        swarm = LocalSwarm(n_workers=1)
+        counter = telemetry.REGISTRY.get("swarm_hive_dispatch_total")
+        outlier_gauge = telemetry.REGISTRY.get("swarm_hive_worker_outlier")
+        async with swarm:
+            # three slowed passes give the real worker's "pass" EWMA its
+            # MIN_SAMPLES at ~0.25s+
+            for i in range(3):
+                await swarm.wait_done(
+                    await swarm.submit(_echo(f"warm-{i}")), timeout=30.0)
+            server = swarm.hive
+            async with aiohttp.ClientSession() as session:
+                headers = {"Authorization": "Bearer local-swarm"}
+
+                async def healthy_poll():
+                    params = _poll_query(
+                        "w-healthy",
+                        {"a": 0.2, "s": {"pass": [0.01, 5]}})
+                    async with session.get(f"{server.api_uri}/work",
+                                           params=params,
+                                           headers=headers) as resp:
+                        assert resp.status == 200
+                        return (await resp.json())["jobs"]
+
+                # register the healthy baseline, then wait for the fleet
+                # view to flag the real worker
+                await healthy_poll()
+                deadline = asyncio.get_running_loop().time() + 15.0
+                worker_name = swarm.workers[0].settings.worker_name
+                while not server.fleet.is_outlier(
+                        worker_name, server.directory.live_names()):
+                    assert asyncio.get_running_loop().time() < deadline, (
+                        "slowed worker never flagged outlier; stats: "
+                        f"{server.fleet.stages_of(worker_name)}")
+                    await asyncio.sleep(0.05)
+                assert outlier_gauge.value(worker=worker_name) == 1
+
+                held_before = counter.value(outcome="straggler_hold")
+                victim = await swarm.submit(
+                    _echo("interactive-seed", priority="interactive"))
+                # the slow worker keeps polling but must be refused the
+                # interactive seed...
+                deadline = asyncio.get_running_loop().time() + 15.0
+                while counter.value(
+                        outcome="straggler_hold") <= held_before:
+                    assert asyncio.get_running_loop().time() < deadline, \
+                        "straggler_hold never counted"
+                    await asyncio.sleep(0.05)
+                # ...and the healthy peer receives it on its next poll
+                jobs = await healthy_poll()
+                assert [j["id"] for j in jobs] == ["interactive-seed"]
+                # settle it from the healthy worker so the swarm ends
+                # clean and placement is attributed where it landed
+                async with session.post(
+                        f"{server.api_uri}/results",
+                        data=json.dumps({
+                            "id": victim, "artifacts": {}, "nsfw": False,
+                            "worker_version": "0.1.0",
+                            "worker_name": "w-healthy",
+                            "pipeline_config": {
+                                "timings": {"job_s": 0.01}}}),
+                        headers=headers) as resp:
+                    assert resp.status == 200
+                status = await swarm.job_status(victim)
+                assert status["status"] == "done"
+                assert status["completed_by"] == "w-healthy"
+        faults.get_plan().release_hangs()
+        return True
+
+    assert asyncio.run(scenario())
+
+
+def test_settle_without_timings_counts_fallback_e2e(sdaas_root):
+    """The fallback satellite over the real wire: a result envelope with
+    no pipeline_config lands in the ledger at wall-clock cost and bumps
+    swarm_hive_usage_fallback_total."""
+    from chiaswarm_tpu.hive_server import HiveServer
+
+    async def scenario():
+        counter = telemetry.REGISTRY.get("swarm_hive_usage_fallback_total")
+        before = counter.value()
+        server = await HiveServer(
+            Settings(sdaas_token="t", hive_port=0, hive_wal_dir=""),
+            port=0).start()
+        try:
+            async with aiohttp.ClientSession() as session:
+                headers = {"Authorization": "Bearer t",
+                           "Content-type": "application/json"}
+                async with session.post(
+                        f"{server.api_uri}/jobs",
+                        data=json.dumps(_echo("fb-1", tenant="legacy")),
+                        headers=headers) as resp:
+                    assert resp.status == 200
+                async with session.get(
+                        f"{server.api_uri}/work",
+                        params=_poll_query("w-legacy"),
+                        headers=headers) as resp:
+                    assert [j["id"] for j in (await resp.json())["jobs"]] \
+                        == ["fb-1"]
+                await asyncio.sleep(0.05)  # a sliver of billable wall
+                async with session.post(
+                        f"{server.api_uri}/results",
+                        data=json.dumps({"id": "fb-1", "artifacts": {}}),
+                        headers=headers) as resp:
+                    assert resp.status == 200
+                usage = await _get(session, server.uri, "/api/usage",
+                                   token="t")
+        finally:
+            await server.stop()
+        assert counter.value() == before + 1
+        bucket = usage["tenants"]["legacy"]
+        assert bucket["fallback_jobs"] == 1
+        assert bucket["chip_seconds"] > 0  # wall-billed, not dropped
+        return True
+
+    assert asyncio.run(scenario())

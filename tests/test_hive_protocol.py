@@ -446,6 +446,126 @@ def test_cancel_only_poll_never_dispatches(backend_name):
     run_conformance(backend_name, scenario)
 
 
+async def _post_job(backend, job: dict):
+    """POST /api/jobs the way a submitter would (the coordinator's own
+    submit surface, part of the wire contract since ISSUE 11 pinned the
+    tenant field)."""
+    async with aiohttp.ClientSession() as session:
+        async with session.post(
+                f"{backend.uri}/jobs", data=json.dumps(job),
+                headers={"Authorization": f"Bearer {TOKEN}",
+                         "Content-type": "application/json"}) as resp:
+            return resp.status, await resp.json()
+
+
+async def _get_json(backend, path: str):
+    async with aiohttp.ClientSession() as session:
+        async with session.get(
+                f"{backend.uri}{path}",
+                headers={"Authorization": f"Bearer {TOKEN}"}) as resp:
+            return resp.status, await resp.json()
+
+
+def test_submit_echoes_tenant(backend_name):
+    """ISSUE 11: a submitted job's `tenant` field is accepted and echoed
+    by both the submit ACK and GET /api/jobs/{id}; a job without one
+    bills to the shared "anon" tenant. Pinned across all three backends
+    so fake_hive cannot drift from the accounting wire contract."""
+
+    async def scenario(backend, client):
+        status, ack = await _post_job(
+            backend, dict(echo_job("conf-tenant-1"), tenant="acme"))
+        assert status == 200
+        assert ack["id"] == "conf-tenant-1"
+        assert ack["tenant"] == "acme"
+        status, snapshot = await _get_json(backend, "/jobs/conf-tenant-1")
+        assert status == 200
+        assert snapshot["tenant"] == "acme"
+        # tenant-less submissions land on the shared anonymous tenant
+        status, ack = await _post_job(backend, echo_job("conf-tenant-2"))
+        assert status == 200 and ack["tenant"] == "anon"
+        status, snapshot = await _get_json(backend, "/jobs/conf-tenant-2")
+        assert status == 200 and snapshot["tenant"] == "anon"
+
+    run_conformance(backend_name, scenario)
+
+
+def test_stats_poll_param_accepted(backend_name):
+    """ISSUE 11: the compact per-stage EWMA blob workers piggyback on
+    /work (`stats`, a JSON string) is accepted by every backend — jobs
+    still flow — and a stats-aware hive parses it for its fleet view."""
+
+    async def scenario(backend, client):
+        backend.queue_job(echo_job("conf-stats"))
+        blob = json.dumps({"a": 0.2, "s": {"job": [1.25, 9]}})
+        jobs = await client.ask_for_work(dict(CAPS, stats=blob))
+        assert [j["id"] for j in jobs] == ["conf-stats"]
+        if backend.name == "fake":
+            assert backend.hive.work_requests[-1]["stats"] == blob
+        else:
+            [worker] = backend.server.directory.live()
+            assert worker.stats == {"job": (1.25, 9)}
+
+    run_conformance(backend_name, scenario)
+
+
+def test_usage_reply_shape(backend_name):
+    """ISSUE 11: GET /api/usage answers the pinned per-tenant ledger
+    shape — a settled job's chip-seconds/rows land under its tenant and
+    in the totals — and GET /api/tenants/{id}/usage filters to one
+    tenant. Identical across fake/real/promoted backends."""
+
+    USAGE_FIELDS = {"jobs", "chip_seconds", "rows", "coalesced_jobs",
+                    "coalesce_saved_seconds", "embed_cache_hits",
+                    "artifact_bytes", "fallback_jobs"}
+
+    async def scenario(backend, client):
+        status, _ = await _post_job(
+            backend, dict(echo_job("conf-usage"), tenant="acme"))
+        assert status == 200
+        [job] = await client.ask_for_work(dict(CAPS))
+        await client.submit_result({
+            "id": job["id"], "artifacts": {}, "nsfw": False,
+            "worker_version": "0.1.0",
+            "pipeline_config": {"timings": {"job_s": 1.5}}})
+        status, usage = await _get_json(backend, "/usage")
+        assert status == 200
+        assert isinstance(usage["tenants"], dict)
+        assert set(usage["tenants"]["acme"]) == USAGE_FIELDS
+        assert usage["tenants"]["acme"]["jobs"] == 1
+        assert usage["tenants"]["acme"]["chip_seconds"] == 1.5
+        assert usage["tenants"]["acme"]["fallback_jobs"] == 0
+        assert set(usage["totals"]) == USAGE_FIELDS
+        assert usage["totals"]["jobs"] >= 1
+        status, one = await _get_json(backend, "/tenants/acme/usage")
+        assert status == 200
+        assert one["tenant"] == "acme" and one["known"] is True
+        assert set(one["usage"]) == USAGE_FIELDS
+        status, none = await _get_json(backend, "/tenants/nobody/usage")
+        assert status == 200
+        assert none["known"] is False and none["usage"]["jobs"] == 0
+
+    run_conformance(backend_name, scenario)
+
+
+def test_slo_reply_shape(backend_name):
+    """ISSUE 11: GET /api/slo answers the pinned engine-report shape on
+    every backend — enabled flag, both window spans, and the per-class
+    map (empty when no hive_slo is configured, as here)."""
+
+    async def scenario(backend, client):
+        status, report = await _get_json(backend, "/slo")
+        assert status == 200
+        assert report["enabled"] is False
+        assert isinstance(report["classes"], dict)
+        assert report["classes"] == {}
+        assert report["fast_window_s"] > 0
+        assert report["slow_window_s"] >= report["fast_window_s"]
+        assert "fast_burn_degraded" in report
+
+    run_conformance(backend_name, scenario)
+
+
 def test_work_query_carries_placement_signal(backend_name):
     """Satellite: the /work poll itself carries the dispatcher's
     placement inputs — worker identity, chip capabilities, resident

@@ -126,6 +126,22 @@ swarm_hive_dispatch_to_settle_seconds_bucket{class="default",le="5"} 5
 swarm_hive_dispatch_to_settle_seconds_bucket{class="default",le="+Inf"} 5
 swarm_hive_dispatch_to_settle_seconds_sum{class="default"} 9.0
 swarm_hive_dispatch_to_settle_seconds_count{class="default"} 5
+# TYPE swarm_hive_tenant_chip_seconds_total gauge
+swarm_hive_tenant_chip_seconds_total{tenant="acme"} 42.5
+swarm_hive_tenant_chip_seconds_total{tenant="other"} 1.5
+# TYPE swarm_hive_tenant_rows_total gauge
+swarm_hive_tenant_rows_total{tenant="acme"} 19
+swarm_hive_tenant_rows_total{tenant="other"} 1
+# TYPE swarm_hive_usage_fallback_total counter
+swarm_hive_usage_fallback_total 2
+# TYPE swarm_hive_slo_burn_rate gauge
+swarm_hive_slo_burn_rate{class="interactive",window="fast"} 2.4
+swarm_hive_slo_burn_rate{class="interactive",window="slow"} 0.3
+# TYPE swarm_hive_slo_compliance gauge
+swarm_hive_slo_compliance{class="interactive"} 0.88
+# TYPE swarm_hive_worker_outlier gauge
+swarm_hive_worker_outlier{worker="w-slow"} 1
+swarm_hive_worker_outlier{worker="w-fast"} 0
 """
 
 
@@ -155,6 +171,17 @@ def test_hive_tables_from_synthetic_text():
     [d2s] = summary["dispatch_to_settle"]
     assert d2s["p50_le_s"] == 5.0
 
+    # fleet observability plane (ISSUE 11): per-tenant usage, SLO burn,
+    # fallback settles, straggler flags
+    assert summary["tenants"] == {
+        "acme": {"chip_seconds": 42.5, "rows": 19},
+        "other": {"chip_seconds": 1.5, "rows": 1}}
+    assert list(summary["tenants"]) == ["acme", "other"]  # cost-sorted
+    assert summary["usage_fallback"] == 2
+    assert summary["slo"] == {"interactive": {
+        "fast_burn": 2.4, "slow_burn": 0.3, "compliance": 0.88}}
+    assert summary["outliers"] == ["w-slow"]
+
     table = tool.render_hive_tables(summary)
     assert "affinity" in table and "6" in table
     # 6 gang jobs over 12 delivered (hold excluded) -> rate 0.50;
@@ -168,3 +195,44 @@ def test_hive_tables_from_synthetic_text():
     assert "hive queue wait" in table
     assert "hive dispatch->settle" in table
     assert "p50<=0.100" in table
+    assert "hive tenants" in table and "acme" in table
+    assert "usage fallback settles: 2" in table
+    assert "hive slo" in table
+    assert "fast=2.40 slow=0.30 compliance=0.88" in table
+    assert "hive outliers w-slow" in table
+
+
+def test_json_mode_emits_machine_readable_twin(monkeypatch, capsys):
+    """--json (ISSUE 11 satellite): one JSON object carrying the twin of
+    every table — hive summary (tenants/slo included) and the worker
+    stage rows — with inf bucket bounds spelled "+Inf" so the output is
+    strict JSON that CI tooling can parse without screen-scraping."""
+    import json
+
+    tool = _load_tool()
+
+    def fake_fetch(url, path):
+        if path == "/metrics":
+            return HIVE_SYNTHETIC if "9511" in url else SYNTHETIC
+        return json.dumps({"status": "ok"})
+
+    monkeypatch.setattr(tool, "fetch", fake_fetch)
+    rc = tool.main(["--hive", "http://h:9511", "--url", "http://w:8061",
+                    "--json"])
+    out = capsys.readouterr().out.strip()
+    assert rc == 0
+    payload = json.loads(out)  # strict JSON — a single object
+    assert payload["hive"]["tenants"]["acme"]["chip_seconds"] == 42.5
+    assert payload["hive"]["slo"]["interactive"]["fast_burn"] == 2.4
+    assert payload["hive"]["dispatch"]["affinity"] == 6
+    stages = {r["stage"]: r for r in payload["worker"]["stages"]}
+    assert stages["denoise"]["count"] == 4
+    assert stages["denoise"]["p90_le_s"] == "+Inf"  # inf spelled safely
+    assert payload["worker"]["healthz"] == {"status": "ok"}
+
+    # hive-only --json still emits the hive twin and exits 0
+    rc = tool.main(["--hive", "http://h:9511", "--json"])
+    out = capsys.readouterr().out.strip()
+    assert rc == 0
+    payload = json.loads(out)
+    assert "hive" in payload and "worker" not in payload

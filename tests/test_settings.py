@@ -87,6 +87,35 @@ def test_cancellation_knobs(sdaas_root, monkeypatch):
     assert load_settings().denoise_chunk_steps == 0
 
 
+def test_fleet_observability_knobs(sdaas_root, monkeypatch):
+    """ISSUE 11: the accounting/SLO/straggler knobs layer like every
+    other setting — SLO engine off by default, sane window/top-K/EWMA
+    defaults, env overrides win."""
+    s = load_settings()
+    assert s.hive_slo == ""  # engine disabled until declared
+    assert s.hive_slo_fast_window_s == 60.0
+    assert s.hive_slo_slow_window_s == 600.0
+    assert s.hive_tenant_topk == 10
+    assert s.hive_stats_ewma_alpha == 0.2
+    assert s.hive_straggler_factor == 2.5
+    monkeypatch.setenv("CHIASWARM_HIVE_SLO",
+                       "interactive:queue_wait_p95<2.0")
+    monkeypatch.setenv("CHIASWARM_HIVE_SLO_FAST_WINDOW_S", "30")
+    monkeypatch.setenv("CHIASWARM_HIVE_SLO_SLOW_WINDOW_S", "300")
+    monkeypatch.setenv("CHIASWARM_HIVE_TENANT_TOPK", "3")
+    monkeypatch.setenv("CHIASWARM_HIVE_STATS_EWMA_ALPHA", "0.5")
+    monkeypatch.setenv("CHIASWARM_HIVE_STRAGGLER_FACTOR", "4.0")
+    s = load_settings()
+    assert s.hive_slo == "interactive:queue_wait_p95<2.0"
+    assert s.hive_slo_fast_window_s == 30.0
+    assert s.hive_slo_slow_window_s == 300.0
+    assert s.hive_tenant_topk == 3
+    assert s.hive_stats_ewma_alpha == 0.5
+    assert s.hive_straggler_factor == 4.0
+    monkeypatch.undo()
+    assert load_settings().hive_slo == ""
+
+
 def test_tpu_fields_roundtrip(sdaas_root):
     save_settings(Settings(chips_per_job=4, dtype="float32"))
     s = load_settings()

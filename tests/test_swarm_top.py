@@ -66,6 +66,15 @@ swarm_hive_expired_total 3
 swarm_hive_cancel_revocations_pending 1
 # TYPE swarm_hive_workers_live gauge
 swarm_hive_workers_live 2
+# TYPE swarm_hive_tenant_chip_seconds_total gauge
+swarm_hive_tenant_chip_seconds_total{tenant="acme"} 12.5
+swarm_hive_tenant_chip_seconds_total{tenant="other"} 3.25
+# TYPE swarm_hive_tenant_rows_total gauge
+swarm_hive_tenant_rows_total{tenant="acme"} 7
+swarm_hive_tenant_rows_total{tenant="other"} 2
+# TYPE swarm_hive_worker_outlier gauge
+swarm_hive_worker_outlier{worker="w-fast"} 0
+swarm_hive_worker_outlier{worker="w-slow"} 1
 # TYPE swarm_hive_queue_wait_seconds histogram
 swarm_hive_queue_wait_seconds_bucket{class="default",le="0.1"} 1
 swarm_hive_queue_wait_seconds_bucket{class="default",le="1"} 4
@@ -95,6 +104,10 @@ def test_render_hive_and_worker_frames_from_synthetic_data():
         health={"role": "primary", "epoch": 1, "status": "degraded",
                 "degraded_reasons": ["shedding batch jobs"],
                 "leases_active": 2,
+                "slo": {"interactive": {
+                    "fast_burn": 3.2, "slow_burn": 0.4,
+                    "compliance": 0.84, "breaching": True}},
+                "stragglers": {"w-slow": ["job"], "w-fast": []},
                 "wal": {"appends_since_compact": 7, "torn_lines": 0,
                         "replayed_events": 0}})
     lines = "\n".join(tool.render_hive(hive, None))
@@ -113,6 +126,15 @@ def test_render_hive_and_worker_frames_from_synthetic_data():
     assert ("cancel    leased=1 queued=2 expired=3 "
             "pending_revocations=1") in lines
     assert "! shedding batch jobs" in lines
+    # fleet observability plane (ISSUE 11): tenant frame (sorted by
+    # chip-seconds, rows alongside), SLO frame (fast/slow burn +
+    # compliance, BURNING on a breach), straggler flag with its stages
+    assert "tenants   acme=12.5s/7r other=3.2s/2r" in lines
+    assert "slo       interactive burn=3.20/0.40 comp=0.84 BURNING" in lines
+    assert "straggler w-slow (stages: job)" in lines
+    straggler_line = next(
+        ln for ln in lines.splitlines() if "straggler" in ln)
+    assert "w-fast" not in straggler_line  # healthy workers don't render
     assert "appends_since_compact=7" in lines
     assert "default p50<=1s p95<=1s" in lines
 

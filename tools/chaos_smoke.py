@@ -603,6 +603,163 @@ async def scenario_hive_crash_recovery() -> str:
             "was redelivered to a pristine worker")
 
 
+async def scenario_usage_survives_restart() -> str:
+    """Fleet accounting (ISSUE 11 acceptance): N jobs settle across two
+    tenants; the hive is SIGKILLed and restarted over the same
+    $SDAAS_ROOT; the per-tenant ledger (GET /api/usage) must come back
+    BIT-IDENTICAL from the WAL replay — and identical again on a
+    promoted standby that replicated the same stream. The ledger is
+    derived from the journaled records, so this pins that derivation
+    end to end."""
+    import dataclasses
+    import json
+    import os
+    import socket
+    import subprocess
+
+    import aiohttp
+
+    from chiaswarm_tpu.hive_server.replication import StandbyHive
+
+    faults.configure("")
+    token = "chaos"
+    repo = str(pathlib.Path(__file__).resolve().parent.parent)
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+    env = dict(os.environ, SDAAS_TOKEN=token,
+               CHIASWARM_HIVE_PORT=str(port),
+               PYTHONPATH=repo + os.pathsep + os.environ.get("PYTHONPATH", ""))
+    uri = f"http://127.0.0.1:{port}"
+    headers = {"Authorization": f"Bearer {token}",
+               "Content-type": "application/json"}
+
+    def spawn() -> subprocess.Popen:
+        return subprocess.Popen(
+            [sys.executable, "-m", "chiaswarm_tpu.hive_server"],
+            cwd=repo, env=env,
+            stdout=subprocess.DEVNULL, stderr=subprocess.STDOUT)
+
+    async def wait_up(session) -> bool:
+        for _ in range(200):
+            try:
+                async with session.get(f"{uri}/healthz") as r:
+                    if r.status in (200, 503):
+                        return True
+            except aiohttp.ClientError:
+                pass
+            await asyncio.sleep(0.1)
+        return False
+
+    procs = [spawn()]
+    w = runner = standby = None
+    try:
+        async with aiohttp.ClientSession() as session:
+            _check(await wait_up(session),
+                   "hive subprocess never answered /healthz")
+            jobs = [dict(_echo(f"chaos-usage-{i}"),
+                         tenant="tenant-a" if i % 2 == 0 else "tenant-b")
+                    for i in range(4)]
+            for job in jobs:
+                async with session.post(f"{uri}/api/jobs",
+                                        data=json.dumps(job),
+                                        headers=headers) as r:
+                    _check(r.status == 200, f"submit failed: {r.status}")
+
+            # a real worker settles all four (its envelopes carry the
+            # stage timings the ledger attributes from)
+            w = Worker(settings=_settings(),
+                       allocator=SliceAllocator(chips_per_job=0),
+                       hive_uri=f"{uri}/api")
+            runner = asyncio.create_task(w.run())
+
+            async def all_done() -> bool:
+                for job in jobs:
+                    async with session.get(
+                            f"{uri}/api/jobs/{job['id']}",
+                            headers=headers) as r:
+                        if r.status != 200 or (
+                                await r.json())["status"] != "done":
+                            return False
+                return True
+
+            deadline = asyncio.get_running_loop().time() + 30.0
+            while not await all_done():
+                _check(asyncio.get_running_loop().time() < deadline,
+                       "jobs never settled before the crash")
+                await asyncio.sleep(0.1)
+            w.stop()
+            await asyncio.wait_for(
+                asyncio.gather(runner, return_exceptions=True), 10)
+            w = runner = None
+
+            async def usage() -> dict:
+                async with session.get(f"{uri}/api/usage",
+                                       headers=headers) as r:
+                    _check(r.status == 200, f"/api/usage -> {r.status}")
+                    return await r.json()
+
+            before = await usage()
+            _check(before["tenants"].get("tenant-a", {}).get("jobs") == 2
+                   and before["tenants"].get("tenant-b", {}).get("jobs") == 2,
+                   f"pre-crash ledger wrong: {before['tenants']}")
+            _check(before["totals"]["chip_seconds"] > 0,
+                   "pre-crash ledger attributed zero chip-seconds")
+            _check(before["totals"]["fallback_jobs"] == 0,
+                   "real envelopes must not take the fallback path")
+
+            procs[0].kill()  # SIGKILL: no drain, no flush
+            procs[0].wait()
+            procs.append(spawn())  # same $SDAAS_ROOT, same port
+            _check(await wait_up(session),
+                   "restarted hive never answered /healthz")
+            after = await usage()
+            _check(after["tenants"] == before["tenants"],
+                   f"per-tenant ledger drifted across SIGKILL recovery:\n"
+                   f"  before: {before['tenants']}\n"
+                   f"  after:  {after['tenants']}")
+            _check(after["totals"] == before["totals"],
+                   "ledger totals drifted across SIGKILL recovery")
+
+            # a standby replicating the restarted primary's WAL stream
+            # must derive the very same ledger — and keep it once
+            # promoted over the (killed) primary
+            standby = StandbyHive(
+                dataclasses.replace(
+                    _settings(), hive_port=0,
+                    hive_wal_dir="wal_usage_standby"),
+                primary_uri=uri, port=0)
+            await standby.server.start()
+            await standby.sync_once()
+            procs[1].kill()
+            procs[1].wait()
+            await standby.promote()
+            async with session.get(f"{standby.api_uri}/usage",
+                                   headers=headers) as r:
+                _check(r.status == 200,
+                       f"promoted standby /api/usage -> {r.status}")
+                promoted = await r.json()
+            _check(promoted["tenants"] == before["tenants"],
+                   f"promoted standby's ledger drifted:\n"
+                   f"  primary:  {before['tenants']}\n"
+                   f"  promoted: {promoted['tenants']}")
+            _check(promoted["totals"] == before["totals"],
+                   "promoted standby's ledger totals drifted")
+    finally:
+        if w is not None:
+            w.stop()
+        if runner is not None:
+            await asyncio.wait_for(
+                asyncio.gather(runner, return_exceptions=True), 10)
+        if standby is not None:
+            await standby.stop()
+        for proc in procs:
+            proc.kill()
+            proc.wait()
+    return ("per-tenant ledger bit-identical across a hive SIGKILL "
+            "restart AND on a promoted standby (4 jobs, 2 tenants)")
+
+
 async def scenario_hive_failover() -> str:
     """Hive replication (ISSUE 7 acceptance): the primary dies mid-lease
     with queued jobs; the WAL-shipped standby health-checks it dead and
@@ -771,6 +928,7 @@ SCENARIOS = {
     "gang_member_lost": scenario_gang_member_lost,
     "cancel_mid_denoise": scenario_cancel_mid_denoise,
     "hive_crash_recovery": scenario_hive_crash_recovery,
+    "usage_survives_restart": scenario_usage_survives_restart,
     "hive_failover": scenario_hive_failover,
     "hive_split_brain_fenced": scenario_hive_split_brain_fenced,
 }

@@ -199,9 +199,54 @@ def _gang_summary(samples) -> dict:
     }
 
 
+def _tenant_summary(samples) -> dict:
+    """Per-tenant usage gauges -> {tenant: {chip_seconds, rows}}, sorted
+    by chip-seconds (the hive already folded past-top-K tenants into
+    "other", so cardinality here is bounded by construction)."""
+    chip = _label_counts(
+        samples, "swarm_hive_tenant_chip_seconds_total", "tenant")
+    rows = _label_counts(samples, "swarm_hive_tenant_rows_total", "tenant")
+    return {
+        tenant: {"chip_seconds": chip[tenant],
+                 "rows": int(rows.get(tenant, 0))}
+        for tenant in sorted(chip, key=lambda t: (-chip[t], t))
+    }
+
+
+def _slo_summary(samples) -> dict:
+    """SLO gauges -> per-class fast/slow burn + worst compliance."""
+    compliance = _label_counts(
+        samples, "swarm_hive_slo_compliance", "class")
+    burns: dict[str, dict[str, float]] = {}
+    for metric, labels, value in samples:
+        if metric != "swarm_hive_slo_burn_rate":
+            continue
+        cls, window = labels.get("class"), labels.get("window")
+        if cls and window:
+            burns.setdefault(cls, {})[window] = value
+    return {
+        cls: {
+            "fast_burn": burns.get(cls, {}).get("fast", 0.0),
+            "slow_burn": burns.get(cls, {}).get("slow", 0.0),
+            "compliance": compliance.get(cls),
+        }
+        for cls in sorted(set(burns) | set(compliance))
+    }
+
+
 def hive_summary(samples) -> dict:
     """Exposition samples -> the hive-side dispatch/shed/lease view."""
     return {
+        # fleet observability plane (ISSUE 11)
+        "tenants": _tenant_summary(samples),
+        "slo": _slo_summary(samples),
+        "usage_fallback": next(
+            (int(v) for m, _, v in samples
+             if m == "swarm_hive_usage_fallback_total"), 0),
+        "outliers": sorted(
+            labels["worker"] for m, labels, v in samples
+            if m == "swarm_hive_worker_outlier" and v >= 1
+            and "worker" in labels),
         "dispatch": {k: int(v) for k, v in sorted(_label_counts(
             samples, "swarm_hive_dispatch_total", "outcome").items())},
         "gang": _gang_summary(samples),
@@ -303,6 +348,32 @@ def render_hive_tables(summary: dict) -> str:
             lines.append(
                 f"  {r['class']:<12} n={r['count']:<6} "
                 f"p50<={fmt(r['p50_le_s'])} p95<={fmt(r['p95_le_s'])}")
+
+    # fleet observability plane (ISSUE 11): who consumed the chips, is
+    # each class inside its objective, who is dragging the fleet
+    tenants = summary.get("tenants") or {}
+    if tenants:
+        lines.append("hive tenants  (chip_s / rows; past-top-K folded "
+                     "into 'other')")
+        for tenant, t in tenants.items():
+            lines.append(
+                f"  {tenant:<16} {t['chip_seconds']:>10.3f} "
+                f"{t['rows']:>6}")
+        if summary.get("usage_fallback"):
+            lines.append(
+                f"  (usage fallback settles: {summary['usage_fallback']})")
+    slo = summary.get("slo") or {}
+    if slo:
+        lines.append("hive slo      (burn rate: 1.0 = budget spent "
+                     "exactly; fast window pages)")
+        for cls, view in slo.items():
+            comp = view.get("compliance")
+            lines.append(
+                f"  {cls:<12} fast={view['fast_burn']:.2f} "
+                f"slow={view['slow_burn']:.2f} "
+                f"compliance={'-' if comp is None else f'{comp:.2f}'}")
+    if summary.get("outliers"):
+        lines.append("hive outliers " + " ".join(summary["outliers"]))
     return "\n".join(lines)
 
 
@@ -355,6 +426,31 @@ def run_inprocess() -> str:
     return REGISTRY.render()
 
 
+def _jsonable(value):
+    """JSON-safe twin of a summary structure: bucket bounds and
+    quantiles can be float('inf'), which json.dumps would emit as the
+    non-standard `Infinity` literal — render them as the exposition
+    format's own "+Inf" spelling instead."""
+    if isinstance(value, float) and value == float("inf"):
+        return "+Inf"
+    if isinstance(value, dict):
+        return {k: _jsonable(v) for k, v in value.items()}
+    if isinstance(value, (list, tuple)):
+        return [_jsonable(v) for v in value]
+    return value
+
+
+def embed_cache_summary(samples) -> dict | None:
+    """The machine-readable twin of embed_cache_line."""
+    events = _label_counts(samples, "swarm_embed_cache_total", "event")
+    hits, misses = events.get("hit", 0.0), events.get("miss", 0.0)
+    total = hits + misses
+    if total <= 0:
+        return None
+    return {"hits": int(hits), "misses": int(misses),
+            "hit_rate": round(hits / total, 4)}
+
+
 def main(argv: list[str] | None = None) -> int:
     parser = argparse.ArgumentParser(
         prog="metrics_dump", description=__doc__,
@@ -370,38 +466,61 @@ def main(argv: list[str] | None = None) -> int:
     parser.add_argument(
         "--raw", action="store_true",
         help="also dump the raw /metrics exposition text")
+    parser.add_argument(
+        "--json", action="store_true",
+        help="emit ONE machine-readable JSON object — the twin of every "
+             "table this run would render — instead of the tables, so CI "
+             "and bench tooling consume structured data, not screen text")
     args = parser.parse_args(argv)
+    payload: dict = {}
 
     if args.hive:
         hive_text = fetch(args.hive, "/metrics")
-        if args.raw:
+        if args.raw and not args.json:
             print(hive_text)
-        print(render_hive_tables(hive_summary(parse_metrics(hive_text))))
-        print()
+        summary = hive_summary(parse_metrics(hive_text))
+        payload["hive"] = summary
+        if not args.json:
+            print(render_hive_tables(summary))
+            print()
         if not args.url:
             # hive-only mode: no worker scrape, no in-process smoke job
+            if args.json:
+                print(json.dumps(_jsonable(payload)))
             return 0
 
+    health = None
     if args.url:
         text = fetch(args.url, "/metrics")
         try:
             health = json.loads(fetch(args.url, "/healthz"))
-            print(f"healthz: {json.dumps(health, indent=1)}")
+            if not args.json:
+                print(f"healthz: {json.dumps(health, indent=1)}")
         except Exception as e:  # the table is still worth printing
-            print(f"healthz unavailable: {e}")
+            if not args.json:
+                print(f"healthz unavailable: {e}")
     else:
-        print("no --url given: running one in-process tiny smoke job "
-              "(this compiles a tiny pipeline; ~a minute on CPU)")
+        if not args.json:
+            print("no --url given: running one in-process tiny smoke job "
+                  "(this compiles a tiny pipeline; ~a minute on CPU)")
         text = run_inprocess()
 
-    if args.raw:
+    if args.raw and not args.json:
         print(text)
     samples = parse_metrics(text)
     rows = stage_rows(samples)
-    print(render_table(rows))
-    embed = embed_cache_line(samples)
-    if embed:
-        print(embed)
+    payload["worker"] = {
+        "stages": rows,
+        "embed_cache": embed_cache_summary(samples),
+        "healthz": health,
+    }
+    if args.json:
+        print(json.dumps(_jsonable(payload)))
+    else:
+        print(render_table(rows))
+        embed = embed_cache_line(samples)
+        if embed:
+            print(embed)
     return 0 if rows else 1
 
 

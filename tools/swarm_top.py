@@ -6,9 +6,11 @@ Scrapes the hive's and each worker's `/metrics` (Prometheus text) and
 renders one refreshing frame answering the operator's standing
 questions: how deep is the queue by class, where is every slice and
 what is warm on it, how are dispatch outcomes and shedding trending,
-is the outbox/WAL backing up, and what do stage latencies look like
-RIGHT NOW (p50/p95 over the delta between refreshes, not over the
-process's whole life).
+is the outbox/WAL backing up, which tenants are consuming the
+chip-seconds, is each class inside its SLO (burn rate over the fast and
+slow windows), which worker is the fleet straggler, and what do stage
+latencies look like RIGHT NOW (p50/p95 over the delta between
+refreshes, not over the process's whole life).
 
   python tools/swarm_top.py --hive http://127.0.0.1:9511 \
       --worker http://127.0.0.1:8061 --worker http://10.0.0.2:8061
@@ -226,6 +228,41 @@ def render_hive(cur: Snapshot, prev: Snapshot | None) -> list[str]:
     if results:
         lines.append("  results   " + " ".join(
             f"{s}={int(n)}" for s, n in sorted(results.items())))
+
+    # fleet observability plane (ISSUE 11): top-K tenants by
+    # chip-seconds (the hive folds the rest into 'other'), per-class SLO
+    # compliance + burn rate, and the worst straggler worker
+    tenant_chip = cur.counters("swarm_hive_tenant_chip_seconds_total",
+                               "tenant")
+    tenant_rows = cur.counters("swarm_hive_tenant_rows_total", "tenant")
+    if tenant_chip:
+        ranked = sorted(tenant_chip.items(), key=lambda kv: (-kv[1], kv[0]))
+        lines.append("  tenants   " + " ".join(
+            f"{t}={chip:.1f}s/{int(tenant_rows.get(t, 0))}r"
+            for t, chip in ranked))
+    slo = h.get("slo") or {}
+    if slo:
+        parts = []
+        for cls in JOB_CLASSES:
+            view = slo.get(cls)
+            if not view:
+                continue
+            verdict = "BURNING" if view.get("breaching") else "ok"
+            parts.append(
+                f"{cls} burn={view.get('fast_burn', 0):.2f}/"
+                f"{view.get('slow_burn', 0):.2f} "
+                f"comp={view.get('compliance', 1):.2f} {verdict}")
+        if parts:
+            lines.append("  slo       " + "  ".join(parts))
+    outliers = cur.counters("swarm_hive_worker_outlier", "worker")
+    flagged = sorted(w for w, v in outliers.items() if v >= 1)
+    if flagged:
+        stages = h.get("stragglers") or {}
+        worst = flagged[0]
+        lines.append(
+            f"  straggler {' '.join(flagged)}"
+            + (f" (stages: {','.join(stages.get(worst) or [])})"
+               if stages.get(worst) else ""))
 
     wal = h.get("wal") or {}
     if wal:
