@@ -530,6 +530,11 @@ def cpu_smoke(extra_fields: dict | None = None,
     # one device for the primary metric's continuity.
     out.update(_batched_cpu_row_subprocess())
 
+    # priority-aware multi-chip sharding row (ISSUE 12): one job, many
+    # chips — tensor=1/2/4 mesh views over an 8-virtual-device slice,
+    # with the sharded-vs-replicated max-abs diff as the numerics bar
+    out.update(_sharded_cpu_row_subprocess())
+
     # persistent-compile-cache restart probe: two fresh processes sharing
     # one cache dir — the second's cold-start must be well under the
     # first's (the tentpole claim that warmup survives restarts)
@@ -675,6 +680,92 @@ def _batched_cpu_row_subprocess() -> dict:
     except subprocess.TimeoutExpired:
         row = {"batched_txt2img_row": f"failed: timeout after {timeout_s:.0f}s"}
     return row
+
+
+def _sharded_cpu_row_subprocess() -> dict:
+    """Spawn the sharded-geometry row on an 8-virtual-device slice (the
+    MULTICHIP test mesh): one interactive-shaped txt2img pass at
+    tensor=1/2/4 over the SAME chips, reporting per-geometry latency and
+    the sharded-vs-replicated max-abs pixel diff (the numerics-clean
+    acceptance bar). A fresh process because device count freezes at
+    first jax import."""
+    import subprocess
+
+    timeout_s = _row_timeout("sharded_cpu", 900.0)
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    env["XLA_FLAGS"] = (
+        env.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=8"
+    ).strip()
+    try:
+        proc = subprocess.run(
+            [sys.executable, os.path.abspath(__file__), "--row", "sharded-cpu"],
+            timeout=timeout_s, capture_output=True, text=True, env=env,
+        )
+        sys.stderr.write(proc.stderr[-2000:] + "\n")
+        row = _parse_last_json(proc.stdout)
+        if row is None:
+            row = {"sharded_txt2img_row":
+                   f"failed: no JSON (rc={proc.returncode})"}
+    except subprocess.TimeoutExpired:
+        row = {"sharded_txt2img_row": f"failed: timeout after {timeout_s:.0f}s"}
+    return row
+
+
+def run_sharded_cpu_row() -> None:
+    """Child for the sharded-geometry row (ISSUE 12): ONE batch-1 job on
+    an 8-device slice under tensor=1 (replicated — the single-chip-bound
+    baseline the ROADMAP names), tensor=2, and tensor=4 mesh views, plus
+    the max-abs uint8 diff of each sharded output against the replicated
+    one. On real multi-chip hardware the latency column is the tentpole
+    claim (a single job faster than one chip); on the virtual CPU mesh
+    the diff column is the load-bearing number and the latencies prove
+    the geometry path end-to-end."""
+    import jax
+    import numpy as np
+
+    jax.config.update("jax_platforms", "cpu")
+    _enable_compile_cache()
+    chips = jax.devices()
+
+    from chiaswarm_tpu.chips.device import ChipSet
+    from chiaswarm_tpu.pipelines.stable_diffusion import SDPipeline
+
+    size, steps = 64, 4
+    pipe = SDPipeline("test/tiny-sd", chipset=ChipSet(chips),
+                      allow_random_init=True)
+    out: dict = {"sharded_slice_devices": len(chips)}
+    kw = dict(prompt="sharded bench", height=size, width=size,
+              num_inference_steps=steps,
+              scheduler_type="EulerDiscreteScheduler")
+    reference = None
+    for tensor in (1, 2, 4):
+        if len(chips) % tensor:
+            continue
+        geometry = {"tensor": tensor}
+        try:
+            pipe.run(rng=jax.random.key(7), geometry=geometry, **kw)  # compile
+            times = []
+            last = None
+            for _ in range(3):
+                t0 = time.perf_counter()
+                last, cfg = pipe.run(rng=jax.random.key(7),
+                                     geometry=geometry, **kw)
+                times.append(time.perf_counter() - t0)
+            p50 = sorted(times)[1]
+            out[f"sharded_txt2img_t{tensor}_p50_s"] = round(p50, 3)
+            out[f"sharded_txt2img_t{tensor}_geometry"] = cfg["geometry"]
+            pixels = np.asarray(last[0], np.int16)
+            if tensor == 1:
+                reference = pixels
+            elif reference is not None:
+                out[f"sharded_txt2img_t{tensor}_maxdiff"] = int(
+                    np.abs(pixels - reference).max())
+        except Exception as e:
+            sys.stderr.write(
+                f"sharded row t{tensor} failed: {type(e).__name__}: {e}\n")
+            out[f"sharded_txt2img_t{tensor}_row"] = \
+                f"failed: {type(e).__name__}: {e}"
+    print(json.dumps(out))
 
 
 def _warm_restart_rows() -> dict:
@@ -1601,6 +1692,8 @@ if __name__ == "__main__":
     if len(sys.argv) >= 3 and sys.argv[1] == "--row":
         if sys.argv[2] == "batched-cpu":
             run_batched_cpu_row()
+        elif sys.argv[2] == "sharded-cpu":
+            run_sharded_cpu_row()
         elif sys.argv[2] == "warm-restart":
             run_warm_restart_row()
         elif sys.argv[2] == "placement-cpu":

@@ -31,6 +31,19 @@ _EXECUTE_SECONDS = telemetry.histogram(
     ("kind",),
 )
 
+# the mesh view the slice's LAST pass ran under, one series per axis
+# (ISSUE 12): data = coalescing rows / CFG pair, tensor = Megatron-style
+# kernel sharding, seq = ring-attention blocks. A slice serving batch
+# traffic sits at tensor=1; an interactive sharded pass flips tensor>1
+# for its duration — the gauge is how an operator sees the class-aware
+# geometry actually switching.
+_SLICE_GEOMETRY = telemetry.gauge(
+    "swarm_slice_geometry",
+    "Mesh degree of the slice's most recent pass, per axis "
+    "(data | tensor | seq)",
+    ("slice", "axis"),
+)
+
 # Known HBM per chip (GiB) by device kind; fallback is queried or 16.
 _HBM_GB = {
     "TPU v2": 8,
@@ -82,6 +95,10 @@ class ChipSet:
         self.tensor = tensor
         self.seq = seq
         self._mutex = threading.Lock()
+        # geometry of the most recent pass (healthz / swarm_top column);
+        # starts at the construction-time default
+        self.last_geometry: tuple[int, int, int] = (
+            len(devices) // (tensor * seq), tensor, seq)
 
     # --- identity / capability (reference swarm/gpu/device.py:17-27) ---
 
@@ -153,12 +170,69 @@ class ChipSet:
         finally:
             self._mutex.release()
 
+    # --- geometry (ISSUE 12: one slice, two views) ---
+
+    @property
+    def shard_capable(self) -> bool:
+        """Whether this slice can run one job as a sharded program at
+        all: more than one chip to spread attention heads / sequence
+        blocks over. The worker ANDs this with Settings.shard_interactive
+        before advertising `shard_capable` on /work polls."""
+        return len(self.devices) > 1
+
+    def resolve_geometry(self, tensor: int | None = None,
+                         seq: int | None = None) -> tuple[int, int] | None:
+        """Validate a requested (tensor, seq) view over THIS slice's
+        chips; None when it cannot mesh (doesn't divide the chip count).
+        tensor=0/None means "auto": the largest power-of-two degree that
+        leaves a data axis of at least the CFG pair (2), so a batch-1
+        interactive job still shards its uncond/cond rows over `data`
+        while attention heads spread over `tensor`."""
+        n = len(self.devices)
+        seq = int(seq or 1)
+        if seq < 1 or n % seq:
+            return None
+        if tensor:
+            tensor = int(tensor)
+            if tensor < 1 or n % (tensor * seq):
+                return None
+            return tensor, seq
+        # auto: chips / (2 * seq), floored to a power of two >= 1
+        room = n // (2 * seq)
+        tensor = 1
+        while tensor * 2 <= room and n % (tensor * 2 * seq) == 0:
+            tensor *= 2
+        return tensor, seq
+
+    def note_geometry(self, data: int, tensor: int, seq: int) -> None:
+        """Record the mesh view a pass is running under (called by the
+        pipeline at dispatch): feeds the swarm_slice_geometry gauge and
+        the healthz/swarm_top geometry column."""
+        self.last_geometry = (int(data), int(tensor), int(seq))
+        label = str(self.slice_id)
+        _SLICE_GEOMETRY.set(data, slice=label, axis="data")
+        _SLICE_GEOMETRY.set(tensor, slice=label, axis="tensor")
+        _SLICE_GEOMETRY.set(seq, slice=label, axis="seq")
+
+    def geometry_str(self) -> str:
+        d, t, s = self.last_geometry
+        return f"data{d}·tensor{t}·seq{s}"
+
     # --- execution ---
 
-    def mesh(self) -> Mesh:
+    def mesh(self, tensor: int | None = None, seq: int | None = None) -> Mesh:
+        """The slice's device mesh — by default the construction-time
+        [data, tensor, seq] view; pass `tensor`/`seq` to carve the SAME
+        chips into a different geometry (the elastic view ISSUE 12 adds:
+        a sharded interactive pass and a data-parallel coalesced pass
+        run over identical hardware)."""
         from ..parallel.mesh import make_mesh
 
-        return make_mesh(self.devices, tensor=self.tensor, seq=self.seq)
+        return make_mesh(
+            self.devices,
+            tensor=self.tensor if tensor is None else tensor,
+            seq=self.seq if seq is None else seq,
+        )
 
     def __call__(self, func, **kwargs):
         """Run one job on this slice under the busy lock.

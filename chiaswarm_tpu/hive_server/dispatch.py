@@ -28,6 +28,10 @@ Outcomes (counted in `swarm_hive_dispatch_total{outcome}`):
             capable worker is live — bounded by the same hold window as
             affinity, so stragglers degrade latency-sensitive placement,
             never availability.
+- shard_hold  an INTERACTIVE job was withheld from a poller that cannot
+            run it as one sharded multi-chip program (`shard_capable`,
+            ISSUE 12) while a shard-capable worker is live — same hold
+            window bound: geometry prefers, never starves.
 
 Gang scheduling: when the picked job is coalesce-compatible
 (coalesce.py — the exact key the worker's BatchScheduler groups by) and
@@ -58,7 +62,7 @@ from .queue import JobRecord, PriorityJobQueue
 _DISPATCH = telemetry.counter(
     "swarm_hive_dispatch_total",
     "Hive /work dispatch decisions by placement outcome "
-    "(affinity | cold | steal | hold | gang | straggler_hold)",
+    "(affinity | cold | steal | hold | gang | straggler_hold | shard_hold)",
     ("outcome",),
 )
 _GANG_SIZE = telemetry.histogram(
@@ -110,6 +114,12 @@ class WorkerInfo:
     # per-stage EWMA stats blob from the `stats` poll param (fleet.py):
     # {stage: (ewma_seconds, samples)}; empty for legacy pollers
     stats: dict = dataclasses.field(default_factory=dict)
+    # slice-geometry advertisement (ISSUE 12): chips one job slice spans,
+    # and whether the worker runs interactive jobs as ONE sharded program
+    # over them (shard_interactive on a multi-chip slice). The dispatcher
+    # prefers a shard-capable worker for interactive seeds.
+    chips_per_slice: int = 0
+    shard_capable: bool = False
     last_seen: float = 0.0
 
     @property
@@ -134,6 +144,8 @@ class WorkerInfo:
             "busy_slices": self.busy_slices,
             "queue_depth": self.queue_depth,
             "gang_rows": self.gang_rows,
+            "chips_per_slice": self.chips_per_slice,
+            "shard_capable": self.shard_capable,
             "resident_models": sorted(self.resident),
         }
 
@@ -171,6 +183,8 @@ class WorkerDirectory:
             gang_rows=max(_to_int(query.get("gang_rows"), 1), 1),
             gang_aware="gang_rows" in query,
             stats=parse_stats(query.get("stats")),
+            chips_per_slice=_to_int(query.get("chips_per_slice")),
+            shard_capable=_to_int(query.get("shard_capable")) > 0,
             last_seen=CLOCK.mono(),
         )
         self._workers[name] = info
@@ -286,11 +300,11 @@ class Dispatcher:
         items, free_rows = self._budget(worker)
         now = CLOCK.mono()
         taken: set[str] = set()
-        # straggler view for this poll (fleet.py): computed once — the
-        # poller's own verdict and the set of healthy live workers that
-        # could serve an interactive seed instead
+        # straggler + shard-capability view for this poll: ONE live
+        # snapshot (directory.live() filters the whole map per call, so
+        # per-record rebuilds would make select() O(jobs x workers))
         fleet = self.directory.fleet
-        live = self.directory.live() if fleet is not None else []
+        live = self.directory.live()
         live_names = [w.name for w in live]
         poller_is_straggler = (
             fleet is not None and fleet.is_outlier(worker.name, live_names))
@@ -319,6 +333,28 @@ class Dispatcher:
                 # healthy worker that stopped polling) degrades to the
                 # slow dispatch, never to starvation
                 _DISPATCH.inc(outcome="straggler_hold")
+                continue
+            if (record.job_class == "interactive"
+                    and not worker.shard_capable
+                    and now - record.submitted_at < self.affinity_hold_s
+                    and any(w.name != worker.name and w.shard_capable
+                            and w.can_run(model)
+                            and (fleet is None or not fleet.is_outlier(
+                                w.name, live_names))
+                            for w in live)):
+                # slice-geometry preference (ISSUE 12): an interactive
+                # seed waits (inside the same hold window as affinity)
+                # for a worker that will fan the single image over every
+                # chip of its slice — the sharded pass is the latency
+                # win the class exists for. Bounded exactly like
+                # affinity/straggler holds: once the window lapses, or
+                # when no shard-capable worker is live, any poller takes
+                # it — geometry prefers, never starves. A straggler-
+                # flagged shard-capable worker does NOT count as a
+                # target: straggler_hold withholds the seed from it, so
+                # counting it here would make the two rules defer to
+                # each other and park the seed for the whole window.
+                _DISPATCH.inc(outcome="shard_hold")
                 continue
             if model and model in worker.resident:
                 outcome = "affinity"

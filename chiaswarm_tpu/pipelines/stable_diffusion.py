@@ -39,7 +39,13 @@ from ..models.clip import CLIPTextEncoder
 from ..models.tokenizer import load_tokenizer
 from ..models.unet2d import UNet2DConditionModel
 from ..models.vae import AutoencoderKL
-from ..parallel.mesh import batch_sharding, make_mesh, replicated
+from ..parallel.mesh import (
+    batch_sharding,
+    make_mesh,
+    repeat_rows,
+    replicated,
+    stack_rows,
+)
 from ..registry import register_family
 from ..schedulers import get_scheduler
 from ..schedulers.common import SchedulerConfig
@@ -64,9 +70,38 @@ _BATCH_ROWS = telemetry_counter(
     ("kind",),
 )
 
+# per-pass slice-geometry accounting (ISSUE 12): one count per denoise
+# pass, labelled by the mesh view it ran under — "replicated" (data-only
+# mesh, today's coalescing view), "tensorN"/"seqN"/"tensorN_seqM" for
+# sharded passes. The class-aware scheduler's whole point is that this
+# distribution shifts with the traffic mix.
+_SHARDED_PASSES = telemetry_counter(
+    "swarm_sharded_passes_total",
+    "Denoise passes by slice geometry (replicated | tensorN | seqN ...)",
+    ("geometry",),
+)
+
 MAX_RESIDENT_LORAS = 4
 MAX_RESIDENT_TI = 4
 MAX_RESIDENT_VAES = 2
+# placed param copies per pipeline beyond the default view: each sharded
+# geometry pins ~1/tensor of the model per chip next to the replicated
+# copy, so the LRU stays tiny
+MAX_RESIDENT_GEOMETRIES = 2
+
+
+def geometry_label(tensor: int, seq: int) -> str:
+    """Canonical metric label for a mesh view (swarm_sharded_passes_total).
+    Any data-only view is "replicated" regardless of its data degree —
+    the batch shards, the model does not."""
+    if tensor <= 1 and seq <= 1:
+        return "replicated"
+    parts = []
+    if tensor > 1:
+        parts.append(f"tensor{tensor}")
+    if seq > 1:
+        parts.append(f"seq{seq}")
+    return "_".join(parts)
 
 
 def load_learned_embeddings(ref) -> list[dict]:
@@ -275,6 +310,14 @@ class SDPipeline:
         )
         self.data_parts = self.mesh.shape.get("data", 1)
         self.tensor_parts = self.mesh.shape.get("tensor", 1)
+        # the slice's construction-time view; per-pass `geometry` requests
+        # resolve against it (default_geometry passes run exactly the
+        # pre-ISSUE-12 programs, byte for byte)
+        self.default_geometry = (self.tensor_parts, self.mesh.shape.get("seq", 1))
+        # lazily-built alternate views over the SAME chips: geometry ->
+        # (mesh, placed base params). LRU-bounded — each sharded entry
+        # pins ~1/tensor of the model per chip next to the default copy.
+        self._geometries: OrderedDict[tuple, tuple] = OrderedDict()
 
         t0 = time.perf_counter()
         self.params = self._load_params()
@@ -396,7 +439,7 @@ class SDPipeline:
             )
         return self._place(params)
 
-    def _place(self, params):
+    def _place(self, params, mesh=None, tensor_parts=None):
         """Cast to the serving dtype and place on the mesh.
 
         Data-only mesh: everything replicated (the batch shards instead).
@@ -404,21 +447,87 @@ class SDPipeline:
         Megatron-style per parallel/tensor.py partition rules — XLA inserts
         the psums where row-parallel matmuls contract. The VAE stays
         replicated; its decode shards over `data` via the batch sharding.
+
+        `mesh`/`tensor_parts` default to the pipeline's construction-time
+        view; the elastic-geometry path (params_for) passes an alternate
+        mesh over the same chips.
         """
+        mesh = self.mesh if mesh is None else mesh
+        if tensor_parts is None:
+            tensor_parts = mesh.shape.get("tensor", 1)
         cast = lambda x: jnp.asarray(x, self.dtype)
         params = jax.tree_util.tree_map(cast, params)
-        if self.tensor_parts <= 1:
-            return jax.device_put(params, replicated(self.mesh))
+        if tensor_parts <= 1:
+            return jax.device_put(params, replicated(mesh))
         from ..parallel.tensor import shard_params
 
         def place_component(name, tree):
             if name == "vae":
-                return jax.device_put(tree, replicated(self.mesh))
+                return jax.device_put(tree, replicated(mesh))
             if isinstance(tree, list):
-                return [shard_params(self.mesh, t) for t in tree]
-            return shard_params(self.mesh, tree)
+                return [shard_params(mesh, t) for t in tree]
+            return shard_params(mesh, tree)
 
         return {k: place_component(k, v) for k, v in params.items()}
+
+    # --- elastic slice geometry (ISSUE 12) ---
+
+    def resolve_geometry(self, geometry) -> tuple[int, int]:
+        """A per-pass geometry request -> validated (tensor, seq) over
+        this pipeline's chipset; anything that cannot mesh (no chipset,
+        bad divisor, single chip) falls back to the default view so a
+        malformed request degrades to the classic pass, never fails it.
+        Accepts a dict ({"tensor": t, "seq": s}), a (tensor, seq) tuple,
+        or None/"default"."""
+        if geometry is None or geometry == "default" or self.chipset is None:
+            return self.default_geometry
+        try:
+            if isinstance(geometry, dict):
+                tensor = geometry.get("tensor")
+                seq = geometry.get("seq")
+            else:
+                tensor, seq = geometry
+            resolved = self.chipset.resolve_geometry(tensor, seq)
+        except (TypeError, ValueError):
+            resolved = None
+        if resolved is None:
+            logger.warning(
+                "geometry request %r does not fit slice %s; serving the "
+                "default view", geometry,
+                getattr(self.chipset, "identifier", lambda: "?")())
+            return self.default_geometry
+        return resolved
+
+    def _geometry_view(self, geo: tuple[int, int]):
+        """(mesh, placed base params) for one validated geometry over the
+        slice's chips. The default view is the construction-time mesh +
+        self.params (no copy); alternates are placed lazily from the
+        resident tree — a reshard over ICI, not a reload — and kept in a
+        tiny LRU. Thread-safe under the jit lock: geometry swaps happen on
+        executor threads."""
+        if geo == self.default_geometry:
+            return self.mesh, self.params
+        with self._jit_lock:
+            if geo in self._geometries:
+                self._geometries.move_to_end(geo)
+                return self._geometries[geo]
+        tensor, seq = geo
+        mesh = self.chipset.mesh(tensor=tensor, seq=seq)
+        base = self.params
+        if base is None:
+            raise Exception(
+                f"pipeline {self.model_name} was evicted; resubmit the job")
+        placed = self._place(base, mesh=mesh, tensor_parts=tensor)
+        with self._jit_lock:
+            self._geometries[geo] = (mesh, placed)
+            self._geometries.move_to_end(geo)
+            while len(self._geometries) > MAX_RESIDENT_GEOMETRIES:
+                self._geometries.popitem(last=False)
+        if self.chipset is not None:
+            from ..chips.allocator import note_resident
+
+            note_resident(self.model_name, self.chipset.slice_id)
+        return mesh, placed
 
     def _dummy_added_cond(self, b):
         return dummy_added_cond(self.unet.config, b) if self.is_xl else None
@@ -436,19 +545,23 @@ class SDPipeline:
             return [height, width, 0, 0, float(aesthetic_score)]
         return [height, width, 0, 0, height, width][:n_ids]
 
-    def _place_batch(self, x):
+    def _place_batch(self, x, mesh=None):
         """Shard a leading-batch array over the mesh's data axis when the
         batch divides it evenly; replicate otherwise (rank-preserving
-        placeholders, odd batches). Shared by solo and batched paths."""
-        if self.data_parts > 1 and x.shape[0] % self.data_parts == 0:
-            return jax.device_put(x, batch_sharding(self.mesh, x.ndim))
-        return jax.device_put(x, replicated(self.mesh))
+        placeholders, odd batches). Shared by solo and batched paths;
+        `mesh` defaults to the construction-time view."""
+        mesh = self.mesh if mesh is None else mesh
+        data_parts = mesh.shape.get("data", 1)
+        if data_parts > 1 and x.shape[0] % data_parts == 0:
+            return jax.device_put(x, batch_sharding(mesh, x.ndim))
+        return jax.device_put(x, replicated(mesh))
 
     def release(self):
         """Drop device references so HBM frees on registry eviction."""
         self.params = None
         self._programs.clear()
         self._runner_cache.clear()
+        self._geometries.clear()
         self._controlnets.clear()
         self._lora_cache.clear()
         self._ti_cache.clear()
@@ -819,7 +932,7 @@ class SDPipeline:
 
     # --- the jitted core ---
 
-    def _denoise_parts(self, key, controlnet_module=None):
+    def _denoise_parts(self, key, controlnet_module=None, mesh=None):
         """The denoise program's composable pieces for one bucket:
         ``prep`` (initial latents + scheduler state), ``make_steps(n)``
         (n compiled iterations of the shared step body, starting at a
@@ -840,6 +953,25 @@ class SDPipeline:
             sched_key[0],
             **dict(sched_key[1]),
         )
+        # On a multi-chip mesh every jax.random draw inside the program is
+        # pinned replicated: GSPMD otherwise propagates the consumers'
+        # sharding back into the threefry computation, and this jax's
+        # non-partitionable RNG lowering then generates DIFFERENT values
+        # per shard layout (the sharded-vs-replicated numerics drift that
+        # broke test_parallel/test_seq_parallel_serving). The draw is a
+        # few KB of latents against a multi-second denoise, so replicating
+        # it costs nothing; single-chip programs keep their exact HLO.
+        mesh = self.mesh if mesh is None else mesh
+        multichip = mesh.devices.size > 1
+        rep_sharding = replicated(mesh) if multichip else None
+
+        def pin(z):
+            if multichip:
+                return jax.lax.with_sharding_constraint(z, rep_sharding)
+            return z
+
+        def draw_normal(rng_key, shape):
+            return pin(jax.random.normal(rng_key, shape, jnp.float32))
         schedule = scheduler.schedule(steps)
         # most solvers: one model call per user step; Heun interleaves two
         # and maps the bounds onto its doubled index space
@@ -862,13 +994,11 @@ class SDPipeline:
                 # cross-job coalesced pass: init_rng is a [batch] key
                 # array, one per row, each derived only from its own job's
                 # seed — a job's images must not depend on its batchmates
-                latents = jax.vmap(
+                latents = pin(jax.vmap(
                     lambda k: jax.random.normal(k, (lh, lw, latent_c), jnp.float32)
-                )(init_rng)
+                )(init_rng))
             else:
-                latents = jax.random.normal(
-                    init_rng, (batch, lh, lw, latent_c), jnp.float32
-                )
+                latents = draw_normal(init_rng, (batch, lh, lw, latent_c))
             if mode in ("img2img", "batched_i2i", "inpaint"):
                 # batched_i2i: image_latents is the [batch] stack of each
                 # row's own start-image latents (padding rows zeros);
@@ -898,10 +1028,9 @@ class SDPipeline:
                 if mode == "pix2pix":
                     # per-row channel conditioning: zeros for the uncond
                     # row so image guidance has a true no-image baseline
-                    cond_rows = jnp.concatenate(
-                        [jnp.zeros_like(image_latents), image_latents,
-                         image_latents],
-                        axis=0,
+                    cond_rows = stack_rows(
+                        jnp.zeros_like(image_latents), image_latents,
+                        image_latents,
                     ).astype(self.dtype)
                 if mode == "inpaint":
                     clean = image_latents
@@ -909,22 +1038,15 @@ class SDPipeline:
                     # dedicated inpaint UNet: mask plane + masked-image
                     # latents ride the channel dim on both CFG rows
                     cond9 = jnp.concatenate([mask, image_latents], axis=-1)
-                    cond9 = jnp.concatenate([cond9, cond9], axis=0).astype(
-                        self.dtype
-                    )
+                    cond9 = repeat_rows(cond9, 2).astype(self.dtype)
                 if cn_key is not None:
-                    control2 = jnp.concatenate(
-                        [control_cond, control_cond], axis=0).astype(
-                        self.dtype
-                    )
+                    control2 = repeat_rows(control_cond, 2).astype(self.dtype)
                     _, cg_lo, cg_hi = cn_key
 
                 def body(carry, i):
                     latents, state = carry
                     inp = scheduler.scale_model_input(schedule, latents, i)
-                    model_in = jnp.concatenate([inp] * cfg_rows, axis=0).astype(
-                        self.dtype
-                    )
+                    model_in = repeat_rows(inp, cfg_rows).astype(self.dtype)
                     if mode == "pix2pix":
                         # image latents join unscaled: the edit checkpoint was
                         # trained on raw latent-dist modes
@@ -978,12 +1100,12 @@ class SDPipeline:
                     if mode in ("batched", "batched_i2i"):
                         # per-row ancestral noise from per-job keys (same
                         # independence argument as the init draw)
-                        noise = jax.vmap(lambda k: jax.random.normal(
+                        noise = pin(jax.vmap(lambda k: jax.random.normal(
                             jax.random.fold_in(k, i), (lh, lw, latent_c),
-                            jnp.float32))(rng)
+                            jnp.float32))(rng))
                     else:
-                        noise = jax.random.normal(
-                            jax.random.fold_in(rng, i), latents.shape, jnp.float32
+                        noise = draw_normal(
+                            jax.random.fold_in(rng, i), latents.shape
                         )
                     state, latents = scheduler.step(
                         schedule, state, i, latents, out, noise
@@ -994,10 +1116,8 @@ class SDPipeline:
                         keep = scheduler.add_noise(
                             schedule,
                             clean,
-                            jax.random.normal(
-                                jax.random.fold_in(rng, 7919 + i),
-                                clean.shape,
-                                jnp.float32,
+                            draw_normal(
+                                jax.random.fold_in(rng, 7919 + i), clean.shape
                             ),
                             jnp.minimum(i + 1, loop_end - 1),
                         )
@@ -1054,15 +1174,26 @@ class SDPipeline:
             self._programs[cache_key] = program
         return program
 
-    def _denoise_program(self, key, controlnet_module=None):
+    def _geo_key(self, key, geo):
+        """Program-cache key for one bucket under one geometry. The
+        default view keeps the BARE bucket key — byte-identical to the
+        pre-geometry cache, so the zero-cost pinning (exactly one
+        program per bucket at chunk=0) holds — and alternates suffix it."""
+        if geo is None or geo == self.default_geometry:
+            return key
+        return (key, "geo", geo)
+
+    def _denoise_program(self, key, controlnet_module=None, geo=None,
+                         mesh=None):
         """Build (or fetch) the classic fused jitted denoise+decode
         program for one bucket — prep, the full step loop, and decode in
         ONE dispatch. This is the denoise_chunk_steps=0 path, cached
-        under the bare bucket key exactly as before the chunked seam."""
+        under the bare bucket key exactly as before the chunked seam
+        (geometry-suffixed for non-default mesh views)."""
 
         def build():
             prep, make_steps, decode, (lo, hi) = self._denoise_parts(
-                key, controlnet_module)
+                key, controlnet_module, mesh=mesh)
             run_steps = make_steps(hi - lo)
 
             def run(params, init_rng, context, added, guidance_scale,
@@ -1077,7 +1208,7 @@ class SDPipeline:
 
             return run
 
-        return self._program(key, build)
+        return self._program(self._geo_key(key, geo), build)
 
     def _denoise_chunk_steps(self) -> int:
         """Settings.denoise_chunk_steps at call time (env-overridable per
@@ -1088,9 +1219,50 @@ class SDPipeline:
         except Exception:
             return 0
 
-    def _denoise_runner(self, key, controlnet_module=None):
+    def _chunk_programs(self, key, controlnet_module, geo, mesh, chunk):
+        """(prep, {length: chunk}, decode, lengths, lo) — the compiled
+        program set for one bucket under one geometry, plus the chunk
+        walk it serves. Shared by the chunked runner and the mid-pass
+        re-shard path (which resolves the TARGET geometry's set lazily
+        at the first seam that needs it; the walk is bucket-derived, so
+        both geometries share it)."""
+        prep_fn, make_steps, decode_fn, (lo, hi) = self._denoise_parts(
+            key, controlnet_module, mesh=mesh)
+        lengths: list[int] = []
+        pos = lo
+        while pos < hi:
+            lengths.append(min(chunk, hi - pos))
+            pos += lengths[-1]
+        gkey = self._geo_key(key, geo)
+        prep_prog = self._program((gkey, "prep"), lambda: prep_fn)
+        chunk_progs = {
+            n: self._program((gkey, "chunk", n), lambda n=n: make_steps(n))
+            for n in set(lengths)
+        }
+        decode_prog = self._program((gkey, "decode"), lambda: decode_fn)
+        return prep_prog, chunk_progs, decode_prog, lengths, lo
+
+    def _migrate_operands(self, mesh, operands: tuple) -> tuple:
+        """Re-place a chunked pass's live operands onto another mesh view
+        of the same chips (the chunk-seam re-shard): leading-batch arrays
+        keep their data-axis sharding when divisible, everything else
+        replicates. Pure data movement — values are bit-identical, so a
+        migrated pass equals an undisturbed one up to the float
+        reassociation the geometries themselves differ by."""
+
+        def place(x):
+            if getattr(x, "ndim", 0) == 0:
+                return jax.device_put(x, replicated(mesh))
+            return self._place_batch(x, mesh=mesh)
+
+        # tree_map traverses dicts (added, cn_params), skips Nones, and
+        # applies directly to bare arrays (latents, context, rng keys)
+        return tuple(jax.tree_util.tree_map(place, op) for op in operands)
+
+    def _denoise_runner(self, key, controlnet_module=None, geo=None):
         """Resolve the execution strategy for one bucket. Returns
-        ``runner(*program_args, cancel_probe=None) -> uint8 pixels``.
+        ``runner(*program_args, cancel_probe=None, reshard_probe=None)
+        -> uint8 pixels``.
 
         denoise_chunk_steps=0: the fused single program — the probe (if
         any) runs once before launch, so a job cancelled while it waited
@@ -1102,39 +1274,41 @@ class SDPipeline:
         probe runs between every chunk, so a cancelled pass frees the
         slice within one chunk. All programs are resolved (and counted,
         and compiled) HERE, not lazily mid-loop, so the caller's compile
-        span stays honest."""
+        span stays honest.
+
+        `geo` selects the mesh view ((tensor, seq) over the slice's
+        chips; None = the construction default). The chunk boundary is
+        also the RE-SHARD seam (ISSUE 12): `reshard_probe`, consulted at
+        every boundary next to the cancel probe, may return a different
+        validated geometry — the runner then re-places the live latents
+        / conditioning onto the new mesh view and continues with that
+        geometry's compiled chunk set, so a pass can migrate
+        sharded->replicated (or back) mid-denoise when the queue shifts."""
         chunk = self._denoise_chunk_steps()
-        cache_key = (key, chunk)
+        geo = self.default_geometry if geo is None else geo
+        cache_key = (key, chunk, geo)
         with self._jit_lock:
             cached = self._runner_cache.get(cache_key)
         if cached is not None:
             return cached
+        mesh, _ = self._geometry_view(geo)
         if chunk <= 0:
-            program = self._denoise_program(key, controlnet_module)
+            program = self._denoise_program(
+                key, controlnet_module, geo=geo, mesh=mesh)
 
-            def runner(*args, cancel_probe=None):
+            def runner(*args, cancel_probe=None, reshard_probe=None):
+                # no chunk seams: a fused pass cannot re-shard mid-flight
                 if cancel_probe is not None:
                     cancel_probe()
                 return program(*args)
         else:
-            prep_fn, make_steps, decode_fn, (lo, hi) = self._denoise_parts(
-                key, controlnet_module)
-            lengths: list[int] = []
-            pos = lo
-            while pos < hi:
-                lengths.append(min(chunk, hi - pos))
-                pos += lengths[-1]
-            prep_prog = self._program((key, "prep"), lambda: prep_fn)
-            chunk_progs = {
-                n: self._program((key, "chunk", n), lambda n=n: make_steps(n))
-                for n in set(lengths)
-            }
-            decode_prog = self._program((key, "decode"), lambda: decode_fn)
+            prep_prog, chunk_progs, decode_prog, lengths, lo = \
+                self._chunk_programs(key, controlnet_module, geo, mesh, chunk)
 
             def runner(params, init_rng, context, added, guidance_scale,
                        image_guidance, image_latents, mask, rng,
                        cn_params, control_cond, cn_scale,
-                       cancel_probe=None):
+                       cancel_probe=None, reshard_probe=None):
                 # Each boundary BLOCKS on the previous chunk before
                 # probing. This sync is load-bearing, not optional: jax
                 # dispatches asynchronously, so without it the host
@@ -1146,24 +1320,71 @@ class SDPipeline:
                 # the happy-path cost is one host round trip per chunk,
                 # microseconds against a multi-second chunk. A pass
                 # with no probe (direct pipeline calls) runs free.
+                from ..ops.attention import sequence_parallel_scope
+
+                cur_geo, cur_mesh = geo, mesh
+                cur_chunks, cur_decode = chunk_progs, decode_prog
+                resharded: list[tuple] = []
                 if cancel_probe is not None:
                     cancel_probe()
                 latents, state = prep_prog(params, init_rng, image_latents)
                 at = lo
                 for n in lengths:
-                    if at != lo and cancel_probe is not None:
+                    if at != lo and (cancel_probe is not None
+                                     or reshard_probe is not None):
                         jax.block_until_ready(latents)
-                        cancel_probe()
-                    latents, state = chunk_progs[n](
-                        params, latents, state, context, added,
-                        guidance_scale, image_guidance, image_latents, mask,
-                        rng, cn_params, control_cond, cn_scale,
-                        jnp.int32(at))
+                        if cancel_probe is not None:
+                            cancel_probe()
+                        if reshard_probe is not None:
+                            target = reshard_probe()
+                            if target is not None:
+                                target = self.resolve_geometry(target)
+                            if target is not None and target != cur_geo:
+                                # a cold target program set compiles
+                                # HERE, inside the caller's denoise
+                                # span — timed so run() can re-attribute
+                                # it to the compile stage (a multi-
+                                # second XLA compile folded into the
+                                # denoise EWMA would trip the PR 11
+                                # straggler detector on exactly the
+                                # shard-capable workers shard_hold
+                                # prefers)
+                                t0 = time.perf_counter()
+                                cur_mesh, geo_params = self._geometry_view(
+                                    target)
+                                with sequence_parallel_scope(cur_mesh):
+                                    _, cur_chunks, cur_decode, _, _ = \
+                                        self._chunk_programs(
+                                            key, controlnet_module, target,
+                                            cur_mesh, chunk)
+                                compile_s = time.perf_counter() - t0
+                                (latents, state, context, added,
+                                 image_latents, mask, rng, cn_params,
+                                 control_cond) = self._migrate_operands(
+                                    cur_mesh,
+                                    (latents, state, context, added,
+                                     image_latents, mask, rng, cn_params,
+                                     control_cond))
+                                params = geo_params
+                                logger.info(
+                                    "re-sharded mid-pass at step %d: "
+                                    "%s -> %s", at, cur_geo, target)
+                                resharded.append(
+                                    (cur_geo, target, at, compile_s))
+                                cur_geo = target
+                    with sequence_parallel_scope(cur_mesh):
+                        latents, state = cur_chunks[n](
+                            params, latents, state, context, added,
+                            guidance_scale, image_guidance, image_latents,
+                            mask, rng, cn_params, control_cond, cn_scale,
+                            jnp.int32(at))
                     at += n
                 if cancel_probe is not None:
                     jax.block_until_ready(latents)
                     cancel_probe()
-                return decode_prog(params, latents)
+                self._last_reshards = resharded
+                with sequence_parallel_scope(cur_mesh):
+                    return cur_decode(params, latents)
 
         with self._jit_lock:
             self._runner_cache[cache_key] = runner
@@ -1191,7 +1412,20 @@ class SDPipeline:
 
     def run(self, prompt="", negative_prompt="", pipeline_type="DiffusionPipeline",
             **kwargs):
-        """Execute one job; returns (list[PIL.Image], pipeline_config)."""
+        """Execute one job; returns (list[PIL.Image], pipeline_config).
+
+        `geometry` ({"tensor": t, "seq": s} or (t, s); ISSUE 12) asks for
+        a per-pass mesh view over the slice's chips: an interactive job
+        fans ONE image's attention heads / sequence blocks across every
+        chip for latency instead of the default data-parallel view.
+        Requests that cannot mesh — or that arrive with per-job structure
+        the sharded placement does not cover (LoRA-merged or custom
+        params, ControlNet) — fall back to the default view and the pass
+        runs exactly as before. `reshard_probe` (chunked passes only) is
+        consulted at every denoise chunk boundary and may return a new
+        geometry to migrate the live pass to (the chunk-seam re-shard)."""
+        geometry = kwargs.pop("geometry", None)
+        reshard_probe = kwargs.pop("reshard_probe", None)
         if (
             kwargs.get("controlnet_prepipeline_type")
             and kwargs.get("controlnet_model_name")
@@ -1429,14 +1663,31 @@ class SDPipeline:
                 max(int(np.ceil(cg_end * steps)), int(cg_start * steps) + 1),
             )
 
+        # --- pick the pass's mesh view (ISSUE 12): sharded geometry only
+        # for passes on the resident base params — LoRA-merged / custom
+        # trees and ControlNet branches live on the default mesh, and a
+        # geometry request for them degrades to the classic pass ---
+        geo = self.resolve_geometry(geometry)
+        if geo != self.default_geometry and (
+                job_params is not base_params or controlnet_module is not None):
+            logger.info(
+                "geometry %s refused for a pass with job-specific params; "
+                "serving the default view", geo)
+            geo = self.default_geometry
+        pass_mesh, geo_params = self._geometry_view(geo)
+        if geo != self.default_geometry:
+            job_params = geo_params
+
         # --- shard or replicate over the slice (per array: placeholders
         # with batch dim 1 stay replicated; the CFG-doubled 2N batch shards
         # evenly iff N does) ---
-        context, image_latents, mask, control_cond = map(
-            self._place_batch, (context, image_latents, mask, control_cond)
+        context, image_latents, mask, control_cond = (
+            self._place_batch(x, mesh=pass_mesh)
+            for x in (context, image_latents, mask, control_cond)
         )
         if added is not None:
-            added = {k: self._place_batch(v) for k, v in added.items()}
+            added = {k: self._place_batch(v, mesh=pass_mesh)
+                     for k, v in added.items()}
 
         # --- compile (cached) + execute ---
         sched_cfg = SchedulerConfig(
@@ -1453,15 +1704,25 @@ class SDPipeline:
         # tells the two apart in aggregate). With denoise_chunk_steps>0
         # the runner resolves the whole chunked program set here.
         with Span("compile", timings, key="trace_s"):
-            runner = self._denoise_runner(key, controlnet_module)
+            runner = self._denoise_runner(key, controlnet_module, geo=geo)
 
         # long-sequence self-attention shards over the mesh seq axis (ring
-        # attention) when this ChipSet carved one out; trace-time routing,
-        # so it binds on the first (tracing) call of each program bucket
+        # attention) when this pass's mesh view carved one out; trace-time
+        # routing, so it binds on the first (tracing) call of each bucket
         from ..ops.attention import sequence_parallel_scope
 
+        # a re-shard mid-pass must only swap between BASE-params views —
+        # the same gate as the initial geometry above, ControlNet
+        # included (its branch params never get geometry placement, so a
+        # probe migrating a ControlNet pass onto a sharded mesh would
+        # run the exact combination the initial gate refuses)
+        if controlnet_module is not None or (
+                job_params is not base_params
+                and job_params is not geo_params):
+            reshard_probe = None
+        self._last_reshards = []
         with Span("denoise", timings, key="denoise_decode_s"):
-            with sequence_parallel_scope(self.mesh):
+            with sequence_parallel_scope(pass_mesh):
                 pixels = runner(
                     job_params,
                     init_rng,
@@ -1479,8 +1740,30 @@ class SDPipeline:
                     # boundary (JobCancelled propagates to the worker,
                     # which frees the slice and produces no envelope)
                     cancel_probe=self._solo_cancel_probe(),
+                    # the chunk boundary doubles as the re-shard seam
+                    reshard_probe=reshard_probe,
                 )
             pixels = jax.block_until_ready(pixels)
+        # a mid-pass re-shard that had to COMPILE its target program set
+        # did so inside the denoise span; move those seconds to the
+        # compile stage so the straggler EWMAs see honest denoise time
+        reshard_compile = sum(
+            entry[3] for entry in self._last_reshards if len(entry) > 3)
+        if reshard_compile > 0.01:
+            timings["denoise_decode_s"] = round(max(
+                timings.get("denoise_decode_s", 0.0) - reshard_compile,
+                0.0), 3)
+            timings["trace_s"] = round(
+                timings.get("trace_s", 0.0) + reshard_compile, 3)
+        pass_geometry = {
+            "data": pass_mesh.shape.get("data", 1),
+            "tensor": pass_mesh.shape.get("tensor", 1),
+            "seq": pass_mesh.shape.get("seq", 1),
+        }
+        _SHARDED_PASSES.inc(geometry=geometry_label(
+            pass_geometry["tensor"], pass_geometry["seq"]))
+        if self.chipset is not None:
+            self.chipset.note_geometry(**pass_geometry)
 
         images = _to_pil(np.asarray(pixels))
 
@@ -1596,6 +1879,16 @@ class SDPipeline:
                 "hits": self.last_encode_stats[0],
                 "misses": self.last_encode_stats[1]}}
                if getattr(self, "last_encode_stats", None) else {}),
+            # the mesh view this pass STARTED under (ISSUE 12) — the
+            # end-to-end proof that the class actually picked the
+            # geometry; `resharded` records any chunk-seam migrations as
+            # (from_geo, to_geo, step) triples
+            "geometry": pass_geometry,
+            **({"resharded": [
+                {"from": list(f), "to": list(t), "step": int(s),
+                 "compile_s": round(c, 3)}
+                for f, t, s, c in self._last_reshards]}
+               if getattr(self, "_last_reshards", None) else {}),
             "timings": timings,
         }
         return images, pipeline_config
@@ -1761,6 +2054,18 @@ class SDPipeline:
                lh, lw, padded, steps, sched_key, t_start, None)
         with Span("compile", timings, key="trace_s"):
             runner = self._denoise_runner(key)
+        # coalesced passes ALWAYS run the default data-parallel view:
+        # throughput traffic keeps the coalescing geometry while
+        # interactive solos may shard (the class-aware split, ISSUE 12).
+        # Counted AFTER the pass succeeds (below), like run(): a failed
+        # batched pass falls back to solo runs that count themselves,
+        # and a phantom batched count would skew the sharded_rate
+        # exactly when an operator is debugging a misbehaving fleet.
+        pass_geometry = {
+            "data": self.mesh.shape.get("data", 1),
+            "tensor": self.mesh.shape.get("tensor", 1),
+            "seq": self.mesh.shape.get("seq", 1),
+        }
 
         # per-ROW cancel tokens (ISSUE 10): each request carries its
         # job_id, so a hive revocation of ONE member marks just that row
@@ -1806,6 +2111,10 @@ class SDPipeline:
                     cancel_probe=probe,
                 )
             pixels = jax.block_until_ready(pixels)
+        _SHARDED_PASSES.inc(geometry=geometry_label(
+            pass_geometry["tensor"], pass_geometry["seq"]))
+        if self.chipset is not None:
+            self.chipset.note_geometry(**pass_geometry)
 
         groups = split_by_counts(_to_pil(np.asarray(pixels)), counts)
 
@@ -1842,6 +2151,9 @@ class SDPipeline:
                     "hits": self.last_encode_stats[0],
                     "misses": self.last_encode_stats[1]}}
                    if getattr(self, "last_encode_stats", None) else {}),
+                # coalesced passes stamp the data-parallel view they ran
+                # under, same key as the solo path (ISSUE 12)
+                "geometry": dict(pass_geometry),
                 # shared pass timings, copied per envelope: the envelope
                 # must stand alone once the hive splits the batch apart
                 "timings": dict(timings),

@@ -86,6 +86,23 @@ class Settings:
     # default) keeps the single-pass compiled denoise at zero cost;
     # chunked and single-pass outputs are bitwise identical (pinned)
     denoise_chunk_steps: int = 0
+    # --- priority-aware multi-chip sharding (ISSUE 12) ---
+    # run INTERACTIVE solo jobs as ONE sharded program over every chip of
+    # their slice (attention heads + MLP inner dims on the mesh's tensor
+    # axis, the CFG pair on data, optional ring-attention seq axis) so a
+    # single job's latency scales with the slice instead of being bounded
+    # by one chip. Batch/coalesced traffic keeps the data-parallel view
+    # either way — the job class picks the geometry. Off by default: the
+    # sharded view compiles its own program set per bucket, so an
+    # operator turns it on per-fleet once the compile budget is warm
+    shard_interactive: bool = False
+    # tensor-parallel degree for sharded interactive passes; 0 = auto
+    # (the largest power-of-two that still leaves a data axis >= the CFG
+    # pair). Must divide the slice's chip count (with shard_seq)
+    shard_tensor: int = 0
+    # ring-attention sequence-parallel degree for sharded interactive
+    # passes (long-canvas latents); 1 = off
+    shard_seq: int = 1
     # --- observability (telemetry.py) ---
     # local /metrics + /healthz HTTP port; 0 disables the server (the
     # in-process instrumentation stays on either way — it is dict ops)
@@ -267,6 +284,9 @@ _ENV_OVERRIDES = {
     "CHIASWARM_HIVE_GANG_MAX": "hive_gang_max",
     "CHIASWARM_EMBED_CACHE_MB": "embed_cache_mb",
     "CHIASWARM_DENOISE_CHUNK_STEPS": "denoise_chunk_steps",
+    "CHIASWARM_SHARD_INTERACTIVE": "shard_interactive",
+    "CHIASWARM_SHARD_TENSOR": "shard_tensor",
+    "CHIASWARM_SHARD_SEQ": "shard_seq",
     "CHIASWARM_HIVE_JOB_TTL_S": "hive_job_ttl_s",
     "CHIASWARM_HIVE_SPOOL_DIR": "hive_spool_dir",
     "CHIASWARM_HIVE_JOB_HISTORY_LIMIT": "hive_job_history_limit",

@@ -476,6 +476,10 @@ class Worker:
                     # which slice the dispatch board would route each
                     # model's next group to
                     "resident": s.resident_models(),
+                    # the mesh view of the slice's most recent pass
+                    # (ISSUE 12): data-parallel for coalesced batch
+                    # traffic, tensor/seq-sharded for interactive solos
+                    "geometry": s.geometry_str(),
                 }
                 for s in self.allocator.slices
             ],
@@ -549,6 +553,16 @@ class Worker:
             # chips a slice would need at full TP — the remediation the
             # hive/operator can act on when flux_runnable is 0
             caps["flux_min_chips"] = min_chips(flux, max(per_chip, 1e-6))
+        # slice geometry advertisement (ISSUE 12): how many chips one job
+        # slice spans, and whether this worker will run an interactive
+        # job as ONE sharded program over them (shard_interactive AND a
+        # multi-chip slice). A geometry-aware hive prefers a
+        # shard-capable worker for interactive seeds; legacy hives
+        # ignore both keys.
+        caps["chips_per_slice"] = job_slice.chip_count()
+        caps["shard_capable"] = int(
+            bool(getattr(self.settings, "shard_interactive", False))
+            and job_slice.shard_capable)
         # live-load snapshot riding the heartbeat: a capability-aware hive
         # can place by actual occupancy instead of round-robin (legacy
         # hives ignore unknown query params)
@@ -807,9 +821,19 @@ class Worker:
                             stats_folded = True
                         await self._enqueue_result(result)
                 else:
+                    jobs_by_id = {str(j.get("id")): j for j in batch
+                                  if "id" in j}
                     for worker_function, kwargs in prepared:
                         solo_cap = caps_by_id.get(
                             str(kwargs.get("id"))) or None
+                        # class-aware geometry (ISSUE 12): an interactive
+                        # solo on a multi-chip slice fans ONE image over
+                        # every chip as a sharded program; batch solos
+                        # (and every coalesced pass) keep the default
+                        # data-parallel view
+                        self._apply_shard_geometry(
+                            jobs_by_id.get(str(kwargs.get("id"))),
+                            worker_function, kwargs, chipset)
                         result = await self.do_work(
                             chipset, worker_function, kwargs, solo_cap
                         )
@@ -866,6 +890,67 @@ class Worker:
         else:
             outcome = "ok"
         _JOBS_COMPLETED.inc(outcome=outcome)
+
+    # --- priority-aware multi-chip sharding (ISSUE 12) ---
+
+    def _shard_geometry(self, chipset) -> tuple[int, int] | None:
+        """The (tensor, seq) view an interactive solo should run under on
+        `chipset`, or None when sharding is off / impossible / identical
+        to the slice's default view. shard_tensor=0 resolves to the
+        chipset's auto degree (largest power-of-two leaving a data axis
+        for the CFG pair)."""
+        s = self.settings
+        if not getattr(s, "shard_interactive", False):
+            return None
+        if not getattr(chipset, "shard_capable", False):
+            return None
+        geo = chipset.resolve_geometry(
+            int(getattr(s, "shard_tensor", 0) or 0),
+            int(getattr(s, "shard_seq", 1) or 1))
+        if geo is None or geo == (chipset.tensor, chipset.seq):
+            return None
+        return geo
+
+    def _apply_shard_geometry(self, job, worker_function, kwargs,
+                              chipset) -> None:
+        """Attach the sharded mesh view (and the chunk-seam re-shard
+        probe) to one interactive solo's kwargs. Only the SD-family
+        callback understands the keys; everything else runs untouched."""
+        from .batching import is_interactive
+        from .workflows.diffusion import diffusion_callback
+
+        if job is None or not is_interactive(job):
+            return
+        if worker_function is not diffusion_callback:
+            return
+        geo = self._shard_geometry(chipset)
+        if geo is None:
+            return
+        kwargs["geometry"] = {"tensor": geo[0], "seq": geo[1]}
+        kwargs["reshard_probe"] = self._reshard_probe(chipset)
+        logger.info(
+            "interactive job %s shards over slice %s as tensor=%d seq=%d",
+            job.get("id"), chipset.slice_id, geo[0], geo[1])
+
+    def _reshard_probe(self, chipset):
+        """Chunk-boundary migration policy for a sharded interactive
+        pass: when the queue shifts — released work is waiting on the
+        dispatch board and no slice is free — the pass migrates back to
+        the slice's default data-parallel view, so its remaining chunks
+        run the programs and resident weights every queued coalesced
+        pass will reuse (zero geometry churn between back-to-back
+        passes). An empty board keeps the latency-optimal sharded view.
+        Runs on the executor thread; reads of the asyncio-side counters
+        are GIL-atomic ints, same discipline as the cancel registry."""
+        default = {"tensor": chipset.tensor, "seq": chipset.seq}
+
+        def probe():
+            if (self.batcher.ready_jobs > 0
+                    and not self.allocator.has_free_slice()):
+                return default
+            return None
+
+        return probe
 
     @staticmethod
     def _batchable(prepared: list) -> bool:

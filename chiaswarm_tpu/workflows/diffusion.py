@@ -83,9 +83,20 @@ def diffusion_callback(device_identifier: str, model_name: str, **kwargs):
             kwargs["num_images_per_prompt"] = allowed
             batch_capped = {"requested": requested_batch, "served": allowed}
 
+    # class-aware slice geometry (ISSUE 12): the worker attaches these
+    # for interactive solos on multi-chip slices; forwarded only to
+    # pipelines that understand per-pass mesh views (SD family) so a
+    # kandinsky/cascade job routed through this callback is unaffected
+    geometry = kwargs.pop("geometry", None)
+    reshard_probe = kwargs.pop("reshard_probe", None)
+
     pipeline = get_pipeline(
         model_name, pipeline_type=pipeline_type, chipset=chipset
     )
+    if geometry is not None and hasattr(pipeline, "resolve_geometry"):
+        kwargs["geometry"] = geometry
+        if reshard_probe is not None:
+            kwargs["reshard_probe"] = reshard_probe
     images, pipeline_config = pipeline.run(pipeline_type=pipeline_type, **kwargs)
     if batch_capped:
         pipeline_config["batch_capped"] = batch_capped

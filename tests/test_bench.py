@@ -139,6 +139,19 @@ def test_bench_cpu_fallback_produces_labeled_smoke_row():
     assert out.get("hive_failover_takeover_s", -1) >= 0, out
     assert out.get("hive_failover_epoch", 0) >= 1, out
 
+    # priority-aware multi-chip sharding row (ISSUE 12, 8-virtual-device
+    # slice child): the same batch-1 job ran under tensor=1/2/4 mesh
+    # views over one slice, and the sharded outputs match the replicated
+    # one to the uint8 rounding boundary (numerics-clean acceptance bar)
+    assert out.get("sharded_slice_devices") == 8, out
+    assert out.get("sharded_txt2img_t1_p50_s", 0) > 0, out
+    assert out.get("sharded_txt2img_t2_p50_s", 0) > 0, out
+    assert out.get("sharded_txt2img_t4_p50_s", 0) > 0, out
+    assert out.get("sharded_txt2img_t2_geometry", {}).get("tensor") == 2, out
+    assert out.get("sharded_txt2img_t4_geometry", {}).get("tensor") == 4, out
+    assert out.get("sharded_txt2img_t2_maxdiff", 99) <= 2, out
+    assert out.get("sharded_txt2img_t4_maxdiff", 99) <= 2, out
+
     # cross-job micro-batching row (4-virtual-device slice child): the
     # coalesce ladder landed, and filling the slice beats batch-1 passes
     # (structurally ~4x here — replicated vs sharded — so >1 is a safe,

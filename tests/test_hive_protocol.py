@@ -509,6 +509,30 @@ def test_stats_poll_param_accepted(backend_name):
     run_conformance(backend_name, scenario)
 
 
+def test_shard_geometry_poll_params_accepted(backend_name):
+    """ISSUE 12: the slice-geometry advertisement (`chips_per_slice`,
+    `shard_capable`) is accepted by every backend — jobs still flow —
+    and a geometry-aware hive parses it for its dispatch preference
+    (interactive seeds prefer a shard-capable worker)."""
+
+    async def scenario(backend, client):
+        backend.queue_job(echo_job("conf-shard"))
+        jobs = await client.ask_for_work(
+            dict(CAPS, chips_per_slice=8, shard_capable=1))
+        assert [j["id"] for j in jobs] == ["conf-shard"]
+        if backend.name == "fake":
+            recorded = backend.hive.work_requests[-1]
+            assert recorded["chips_per_slice"] == "8"
+            assert recorded["shard_capable"] == "1"
+        else:
+            [worker] = backend.server.directory.live()
+            assert worker.chips_per_slice == 8
+            assert worker.shard_capable is True
+            assert worker.snapshot()["shard_capable"] is True
+
+    run_conformance(backend_name, scenario)
+
+
 def test_usage_reply_shape(backend_name):
     """ISSUE 11: GET /api/usage answers the pinned per-tenant ledger
     shape — a settled job's chip-seconds/rows land under its tenant and

@@ -80,6 +80,23 @@ def test_embed_cache_line_from_synthetic_text():
     assert tool.embed_cache_line([]) is None
 
 
+def test_geometry_line_from_synthetic_text():
+    """ISSUE 12: the per-geometry pass distribution renders under the
+    stage table (and its machine-readable twin carries the sharded
+    rate)."""
+    tool = _load_tool()
+    samples = tool.parse_metrics(
+        'swarm_sharded_passes_total{geometry="replicated"} 6\n'
+        'swarm_sharded_passes_total{geometry="tensor2"} 2\n')
+    assert tool.geometry_line(samples) == \
+        "slice geometry replicated=6 tensor2=2 sharded_rate=0.25"
+    summary = tool.geometry_summary(samples)
+    assert summary == {"passes": {"replicated": 6, "tensor2": 2},
+                       "total": 8, "sharded": 2, "sharded_rate": 0.25}
+    assert tool.geometry_line([]) is None
+    assert tool.geometry_summary([]) is None
+
+
 HIVE_SYNTHETIC = """\
 # TYPE swarm_hive_dispatch_total counter
 swarm_hive_dispatch_total{outcome="affinity"} 6

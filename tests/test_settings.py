@@ -87,6 +87,28 @@ def test_cancellation_knobs(sdaas_root, monkeypatch):
     assert load_settings().denoise_chunk_steps == 0
 
 
+def test_shard_geometry_knobs(sdaas_root, monkeypatch):
+    """ISSUE 12: the class-aware sharding knobs layer like every other
+    setting — interactive sharding OFF by default (the sharded view
+    compiles its own program set), tensor auto / seq off, CHIASWARM_SHARD_*
+    env overrides win."""
+    s = load_settings()
+    assert s.shard_interactive is False
+    assert s.shard_tensor == 0  # 0 = auto degree
+    assert s.shard_seq == 1
+    monkeypatch.setenv("CHIASWARM_SHARD_INTERACTIVE", "1")
+    monkeypatch.setenv("CHIASWARM_SHARD_TENSOR", "4")
+    monkeypatch.setenv("CHIASWARM_SHARD_SEQ", "2")
+    s = load_settings()
+    assert s.shard_interactive is True
+    assert s.shard_tensor == 4
+    assert s.shard_seq == 2
+    monkeypatch.setenv("CHIASWARM_SHARD_INTERACTIVE", "false")
+    assert load_settings().shard_interactive is False
+    monkeypatch.undo()
+    assert load_settings().shard_interactive is False
+
+
 def test_fleet_observability_knobs(sdaas_root, monkeypatch):
     """ISSUE 11: the accounting/SLO/straggler knobs layer like every
     other setting — SLO engine off by default, sane window/top-K/EWMA

@@ -147,13 +147,19 @@ def test_render_hive_and_worker_frames_from_synthetic_data():
                 "hive": {"active_endpoint": "http://hive:9511/api",
                          "failovers": 0, "epoch": 1},
                 "slices": [{"slice_id": 0, "busy": True, "state": "active",
-                            "resident": ["m/a"]},
+                            "resident": ["m/a"],
+                            "geometry": "data4·tensor2·seq1"},
                            {"slice_id": 1, "busy": False,
                             "state": "quarantined", "resident": []}]})
     lines = "\n".join(tool.render_worker(worker, None))
     assert "in_flight=1" in lines and "outbox=3" in lines
     assert "slice 0" in lines and "busy" in lines and "m/a" in lines
     assert "slice 1" in lines and "quarantined" in lines
+    # slice geometry column (ISSUE 12): the mesh view of the slice's
+    # most recent pass; a legacy healthz without the key renders "-"
+    assert "data4·tensor2·seq1" in lines
+    slice1_line = next(ln for ln in lines.splitlines() if "slice 1" in ln)
+    assert " - " in slice1_line
     assert "denoise p50<=1s p95<=5s" in lines
     assert "failovers=0" in lines
     # prompt-embedding cache hit rate (ISSUE 9)

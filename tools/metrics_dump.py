@@ -390,6 +390,35 @@ def embed_cache_line(samples) -> str | None:
             f"hit_rate={hits / total:.2f}")
 
 
+def geometry_summary(samples) -> dict | None:
+    """Per-geometry pass counts (swarm_sharded_passes_total, ISSUE 12):
+    how many denoise passes ran replicated (data-parallel coalescing
+    view) vs sharded (tensorN/seqN interactive view). None when no pass
+    ever ran."""
+    passes = _label_counts(samples, "swarm_sharded_passes_total", "geometry")
+    if not passes:
+        return None
+    total = sum(passes.values())
+    sharded = sum(v for k, v in passes.items() if k != "replicated")
+    return {
+        "passes": {k: int(v) for k, v in sorted(passes.items())},
+        "total": int(total),
+        "sharded": int(sharded),
+        "sharded_rate": round(sharded / total, 4) if total else 0.0,
+    }
+
+
+def geometry_line(samples) -> str | None:
+    """Human-readable twin of geometry_summary."""
+    summary = geometry_summary(samples)
+    if summary is None:
+        return None
+    counts = " ".join(
+        f"{k}={v}" for k, v in summary["passes"].items())
+    return (f"slice geometry {counts} "
+            f"sharded_rate={summary['sharded_rate']:.2f}")
+
+
 async def _run_smoke_job() -> None:
     """One tiny-model txt2img job through the REAL worker path (the same
     code a hive job takes minus the HTTP hop), populating the stage spans."""
@@ -512,6 +541,7 @@ def main(argv: list[str] | None = None) -> int:
     payload["worker"] = {
         "stages": rows,
         "embed_cache": embed_cache_summary(samples),
+        "geometry": geometry_summary(samples),
         "healthz": health,
     }
     if args.json:
@@ -521,6 +551,9 @@ def main(argv: list[str] | None = None) -> int:
         embed = embed_cache_line(samples)
         if embed:
             print(embed)
+        geometry = geometry_line(samples)
+        if geometry:
+            print(geometry)
     return 0 if rows else 1
 
 

@@ -318,9 +318,14 @@ def render_worker(cur: Snapshot, prev: Snapshot | None) -> list[str]:
     for s in h.get("slices") or []:
         resident = ",".join(s.get("resident") or []) or "-"
         busy = "busy" if s.get("busy") else "idle"
+        # mesh view of the slice's most recent pass (ISSUE 12): batch
+        # traffic shows dataN·tensor1·seq1, a sharded interactive pass
+        # flips tensor/seq up for its duration
+        geometry = s.get("geometry") or "-"
         lines.append(
             f"  slice {s.get('slice_id', '?')}   {busy:<5} "
-            f"{s.get('state', '?'):<12} resident: {resident}")
+            f"{s.get('state', '?'):<12} {geometry:<22} "
+            f"resident: {resident}")
 
     # prompt-embedding cache (ISSUE 9): per-row hit rate — at scale the
     # shared "" negative alone should hold this well above zero
