@@ -535,6 +535,12 @@ def cpu_smoke(extra_fields: dict | None = None,
     # with the sharded-vs-replicated max-abs diff as the numerics bar
     out.update(_sharded_cpu_row_subprocess())
 
+    # multi-tenant adapter serving row (ISSUE 13): 4 distinct LoRAs on
+    # one base model as ONE mixed-adapter coalesced pass (runtime
+    # per-row deltas) vs the solo-merged baseline, plus the
+    # delta-vs-merged numerics bar and the dispatcher gang smoke
+    out.update(_lora_coalesce_row_subprocess())
+
     # persistent-compile-cache restart probe: two fresh processes sharing
     # one cache dir — the second's cold-start must be well under the
     # first's (the tentpole claim that warmup survives restarts)
@@ -680,6 +686,219 @@ def _batched_cpu_row_subprocess() -> dict:
     except subprocess.TimeoutExpired:
         row = {"batched_txt2img_row": f"failed: timeout after {timeout_s:.0f}s"}
     return row
+
+
+def _lora_coalesce_row_subprocess() -> dict:
+    """Spawn the multi-tenant adapter row (ISSUE 13) on a 4-virtual-
+    device slice: 4 jobs with 4 DISTINCT LoRA adapters on one tiny base
+    model, served as ONE mixed-adapter coalesced pass (runtime per-row
+    deltas) vs the solo-merged baseline (one pass + one merged param
+    tree per adapter — the pre-ISSUE-13 serving shape)."""
+    import subprocess
+
+    timeout_s = _row_timeout("lora_coalesce", 900.0)
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    env["XLA_FLAGS"] = (
+        env.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=4"
+    ).strip()
+    # the row toggles the delta knob itself; a parent override would
+    # make the two legs measure the same path
+    env.pop("CHIASWARM_LORA_RUNTIME_DELTA", None)
+    try:
+        proc = subprocess.run(
+            [sys.executable, os.path.abspath(__file__),
+             "--row", "lora-coalesce-cpu"],
+            timeout=timeout_s, capture_output=True, text=True, env=env,
+        )
+        sys.stderr.write(proc.stderr[-2000:] + "\n")
+        row = _parse_last_json(proc.stdout)
+        if row is None:
+            row = {"lora_coalesce_row":
+                   f"failed: no JSON (rc={proc.returncode})"}
+    except subprocess.TimeoutExpired:
+        row = {"lora_coalesce_row": f"failed: timeout after {timeout_s:.0f}s"}
+    return row
+
+
+def run_lora_coalesce_row() -> None:
+    """Child for the lora_coalesce row (ISSUE 13): mixed-adapter
+    coalesced serving vs solo-merged, plus the delta-vs-merged numerics
+    bar, the adapter factor-cache hit rate, and a jax-free gang smoke
+    proving the hive dispatcher gangs adapter jobs."""
+    import tempfile
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    _enable_compile_cache()
+    import numpy as np
+    from safetensors.numpy import save_file
+
+    from chiaswarm_tpu import lora_cache
+    from chiaswarm_tpu.chips.device import ChipSet
+    from chiaswarm_tpu.pipelines.stable_diffusion import SDPipeline
+
+    chips = jax.devices()
+    pipe = SDPipeline("test/tiny-sd", chipset=ChipSet(chips),
+                      allow_random_init=True)
+    cache = lora_cache.configure(256 * 1024 * 1024)
+
+    # 4 distinct rank-4 adapters over the tiny UNet's attention kernels
+    unet = pipe.params["unet"]
+    q_dim = int(unet["down_blocks_0"]["attentions_0"]["transformer_blocks_0"]
+                ["attn1"]["to_q"]["kernel"].shape[0])
+    adapter_dir = tempfile.mkdtemp(prefix="bench_lora_")
+    base_key = "unet.down_blocks.0.attentions.0.transformer_blocks.0"
+    refs = []
+    for i in range(4):
+        rng = np.random.default_rng(1000 + i)
+        state = {}
+        for proj in ("attn1.to_q", "attn2.to_v"):
+            state[f"{base_key}.{proj}.lora_A.weight"] = \
+                0.05 * rng.standard_normal((4, q_dim)).astype(np.float32)
+            state[f"{base_key}.{proj}.lora_B.weight"] = \
+                0.05 * rng.standard_normal((q_dim, 4)).astype(np.float32)
+        path = os.path.join(adapter_dir, f"adapter_{i}.safetensors")
+        save_file(state, path)
+        refs.append({"lora": path})
+
+    # steps=2 and 2 timed reps: compiles dominate this row's wall clock
+    # (3 distinct programs), and the ratio under test is per-PASS — the
+    # tier-1 budget shares one 870 s window with the whole bench
+    size, steps = 64, 2
+    shared = dict(height=size, width=size, num_inference_steps=steps,
+                  guidance_scale=7.5,
+                  scheduler_type="EulerDiscreteScheduler")
+    out: dict = {}
+
+    # --- leg 1: ONE mixed-adapter coalesced pass (runtime deltas) ---
+    # pin the kill switch ON via env (wins over a host settings.json
+    # carrying lora_runtime_delta=false): the row toggles the knob per
+    # leg and must not inherit fleet config
+    os.environ["CHIASWARM_LORA_RUNTIME_DELTA"] = "1"
+    requests = [
+        dict(prompt=f"tenant {i}", negative_prompt="",
+             num_images_per_prompt=1, rng=jax.random.key(500 + i),
+             lora=refs[i], lora_scale=1.0)
+        for i in range(4)
+    ]
+    pipe.run_batched(requests, **shared)  # compile + factor loads
+    times = []
+    for _ in range(2):
+        t0 = time.perf_counter()
+        ganged = pipe.run_batched(requests, **shared)
+        times.append(time.perf_counter() - t0)
+    ganged_p50 = min(times)
+    ganged_rate = 4 / ganged_p50 / len(chips)
+    assert all(cfg.get("lora_mode") == "delta" for _, cfg in ganged)
+
+    # --- leg 2: solo-merged baseline, both regimes of the old serving
+    # shape. THRASHING: 4 adapters > the merged LRU (2), every cycle
+    # re-merges + re-places a full UNet copy — the fleet-realistic
+    # multi-tenant regime (a real census of adapters dwarfs any
+    # whole-tree LRU; 4-over-2 reproduces the thrash in miniature) and
+    # the headline this ISSUE's speedup is quoted against. RESIDENT:
+    # the LRU raised to the pre-ISSUE-13 cap of 4 so all merged trees
+    # stay warm — the literal 4-adapter best case of the old code,
+    # isolating the pure coalescing win (1 padded pass vs 4 passes)
+    # from the re-merge cost. Reporting both keeps the headline honest.
+    from chiaswarm_tpu.pipelines import stable_diffusion as sd_mod
+
+    os.environ["CHIASWARM_LORA_RUNTIME_DELTA"] = "0"
+    try:
+        solo_kw = [dict(prompt=f"tenant {i}", rng=jax.random.key(500 + i),
+                        lora=refs[i], lora_scale=1.0, **shared)
+                   for i in range(4)]
+        for kw in solo_kw:
+            pipe.run(**dict(kw))  # compile + first merges
+        times = []
+        for _ in range(2):
+            t0 = time.perf_counter()
+            for kw in solo_kw:
+                pipe.run(**dict(kw))
+            times.append(time.perf_counter() - t0)
+        solo_p50 = min(times)
+        solo_rate = 4 / solo_p50 / len(chips)
+
+        old_cap = sd_mod.MAX_RESIDENT_LORAS
+        sd_mod.MAX_RESIDENT_LORAS = 4
+        try:
+            for kw in solo_kw:
+                pipe.run(**dict(kw))  # warm all 4 merged trees resident
+            times = []
+            for _ in range(2):
+                t0 = time.perf_counter()
+                for kw in solo_kw:
+                    pipe.run(**dict(kw))
+                times.append(time.perf_counter() - t0)
+            resident_p50 = min(times)
+            resident_rate = 4 / resident_p50 / len(chips)
+        finally:
+            sd_mod.MAX_RESIDENT_LORAS = old_cap
+            pipe._lora_cache.clear()
+
+        # --- numerics bar: the SAME solo job served by the delta path vs
+        # the merged tree (identical rng/noise path) must agree to the
+        # uint8 rounding boundary ---
+        merged_img = np.asarray(pipe.run(**dict(solo_kw[0]))[0][0],
+                                np.int32)
+    finally:
+        # back to "1" (not a pop): the delta-path probe below must not
+        # inherit a host settings.json kill switch either
+        os.environ["CHIASWARM_LORA_RUNTIME_DELTA"] = "1"
+    delta_img = np.asarray(pipe.run(**dict(solo_kw[0]))[0][0], np.int32)
+    maxdiff = int(np.abs(delta_img - merged_img).max())
+
+    # --- adapter factor-cache effectiveness across both legs ---
+    from chiaswarm_tpu.lora_cache import _EVENTS as _LORA_CACHE_EVENTS
+
+    hits = _LORA_CACHE_EVENTS.value(event="hit")
+    misses = _LORA_CACHE_EVENTS.value(event="miss")
+
+    # --- jax-free hive gang smoke: 4 adapter jobs, one poll, one gang ---
+    from chiaswarm_tpu.hive_server.dispatch import Dispatcher, WorkerDirectory
+    from chiaswarm_tpu.hive_server.queue import PriorityJobQueue
+
+    directory = WorkerDirectory(ttl_s=45.0)
+    dispatcher = Dispatcher(directory, affinity_hold_s=0.0,
+                            max_jobs_per_poll=8, gang_max=8, lora_slots=8)
+    queue = PriorityJobQueue()
+    for i in range(4):
+        queue.submit({
+            "id": f"bench-lora-{i}", "workflow": "txt2img",
+            "model_name": "stabilityai/stable-diffusion-2-1",
+            "lora": f"tenant-style-{i}", "prompt": "x",
+            "height": 64, "width": 64, "num_inference_steps": steps,
+            "parameters": {"test_tiny_model": True},
+        })
+    worker = directory.observe({
+        "worker_name": "bench", "worker_version": "0.1.0", "slices": "1",
+        "busy_slices": "0", "queue_depth": "0", "gang_rows": "8"})
+    handed = dispatcher.select(worker, queue)
+    gang_members = sum(1 for _, _, g in handed if g is not None)
+
+    out.update({
+        "lora_coalesce_ganged_img_per_sec_per_chip": round(ganged_rate, 4),
+        "lora_coalesce_ganged_p50_pass_s": round(ganged_p50, 3),
+        "lora_coalesce_solo_merged_img_per_sec_per_chip":
+            round(solo_rate, 4),
+        "lora_coalesce_solo_merged_p50_cycle_s": round(solo_p50, 3),
+        "lora_coalesce_solo_resident_img_per_sec_per_chip":
+            round(resident_rate, 4),
+        "lora_coalesce_solo_resident_p50_cycle_s": round(resident_p50, 3),
+        "lora_coalesce_speedup": round(ganged_rate / solo_rate, 3)
+        if solo_rate else 0.0,
+        "lora_coalesce_speedup_vs_resident":
+            round(ganged_rate / resident_rate, 3) if resident_rate else 0.0,
+        "lora_delta_vs_merged_maxdiff": maxdiff,
+        "lora_cache_hit_rate": round(hits / (hits + misses), 4)
+        if hits + misses else 0.0,
+        "lora_cache_resident_entries": len(cache) if cache else 0,
+        "lora_gang_rate": round(gang_members / 4, 4),
+        "lora_adapters": 4,
+        "lora_slice_devices": len(chips),
+    })
+    print(json.dumps(out))
 
 
 def _sharded_cpu_row_subprocess() -> dict:
@@ -1692,6 +1911,8 @@ if __name__ == "__main__":
     if len(sys.argv) >= 3 and sys.argv[1] == "--row":
         if sys.argv[2] == "batched-cpu":
             run_batched_cpu_row()
+        elif sys.argv[2] == "lora-coalesce-cpu":
+            run_lora_coalesce_row()
         elif sys.argv[2] == "sharded-cpu":
             run_sharded_cpu_row()
         elif sys.argv[2] == "warm-restart":

@@ -62,6 +62,7 @@ from .coalesce import (  # noqa: F401  (re-exports)
     DEFAULT_SCHEDULER,
     DEFAULT_STEPS,
     DEFAULT_STRENGTH,
+    adapter_ref,
     coalesce_key,
     is_interactive,
     job_rows,
@@ -72,7 +73,8 @@ logger = logging.getLogger(__name__)
 
 # why a work item left the scheduler: "solo" (unbatchable / coalescing
 # off), "linger" (timer expired), "size" (hit max_coalesce), "rows" (hit
-# the slice's image capacity), "priority" (interactive fast-path),
+# the slice's image capacity), "slots" (hit the distinct-adapter cap,
+# ISSUE 13), "priority" (interactive fast-path),
 # "preempt" (an interactive job in a DIFFERENT group flushed this one —
 # slice contention, see put()), "gang" (pre-batched by the hive's gang
 # scheduler — no linger, see put_gang()), "shutdown" (flush_all)
@@ -123,9 +125,14 @@ class BatchScheduler:
     def __init__(self, linger_s: float = 0.05, max_coalesce: int = 8,
                  maxsize: int = 0, ready_maxsize: int = 0,
                  rows_limit: Callable[[dict], int | None] | None = None,
-                 free_slices: Callable[[], int] | None = None):
+                 free_slices: Callable[[], int] | None = None,
+                 lora_slots: int = 8):
         self.linger_s = max(float(linger_s), 0.0)
         self.max_coalesce = int(max_coalesce)
+        # most DISTINCT adapters one group may carry (ISSUE 13): the
+        # batched program stacks one factor slot per adapter, so the
+        # grouping layer must respect the same cap run_batched enforces
+        self.lora_slots = max(int(lora_slots), 1)
         self.maxsize = int(maxsize)
         self.ready_maxsize = int(ready_maxsize)
         self.rows_limit = rows_limit
@@ -309,12 +316,20 @@ class BatchScheduler:
             return
 
         rows = job_rows(job)
+        adapter = adapter_ref(job)
         group = self._pending.get(key)
         if group is not None and group["cap"] is not None \
                 and group["rows"] + rows > group["cap"]:
             # this job would push the group past what the slice fits in
             # one pass — release the full group now, start a fresh one
             self._flush(key, reason="rows")
+            group = None
+        if (group is not None and adapter is not None
+                and adapter not in group["adapters"]
+                and len(group["adapters"]) >= self.lora_slots):
+            # a new DISTINCT adapter past the stacked-slot cap: release
+            # the full group, start a fresh one (ISSUE 13)
+            self._flush(key, reason="slots")
             group = None
         if group is None:
             cap = None
@@ -324,12 +339,14 @@ class BatchScheduler:
                 except Exception:  # capacity probe is advisory, never fatal
                     logger.exception("rows_limit probe failed")
             loop = asyncio.get_running_loop()
-            group = {"jobs": [], "rows": 0, "cap": cap,
+            group = {"jobs": [], "rows": 0, "cap": cap, "adapters": set(),
                      "opened": time.monotonic()}
             group["timer"] = loop.call_later(self.linger_s, self._flush, key)
             self._pending[key] = group
         group["jobs"].append(job)
         group["rows"] += rows
+        if adapter is not None:
+            group["adapters"].add(adapter)
         if is_interactive(job):
             # priority fast-path: an interactive job takes its whole group
             # with it NOW — batchmates already lingering ride along (they
@@ -371,14 +388,20 @@ class BatchScheduler:
                     logger.exception("rows_limit probe failed")
             chunk: list[dict] = []
             rows = 0
+            adapters: set[str] = set()
             for job in members:
                 r = job_rows(job)
+                a = adapter_ref(job)
                 if chunk and (len(chunk) >= self.max_coalesce
-                              or (cap is not None and rows + r > cap)):
+                              or (cap is not None and rows + r > cap)
+                              or (a is not None and a not in adapters
+                                  and len(adapters) >= self.lora_slots)):
                     self._release_gang(chunk, rows)
-                    chunk, rows = [], 0
+                    chunk, rows, adapters = [], 0, set()
                 chunk.append(job)
                 rows += r
+                if a is not None:
+                    adapters.add(a)
             if chunk:
                 self._release_gang(chunk, rows)
         for job in solos:
@@ -483,6 +506,13 @@ class BatchScheduler:
                     continue
                 group["jobs"].remove(job)
                 group["rows"] -= job_rows(job)
+                # recompute the distinct-adapter slot accounting (an
+                # adapter may be shared by surviving members): a stale
+                # set would flush future same-key groups on reason
+                # "slots" for adapters no surviving job carries
+                group["adapters"] = {
+                    a for a in map(adapter_ref, group["jobs"])
+                    if a is not None}
                 self._outstanding -= 1
                 if not group["jobs"]:
                     group["timer"].cancel()

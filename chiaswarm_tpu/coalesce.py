@@ -50,10 +50,11 @@ _BATCHABLE_FAMILIES = {"sd", "sdxl"}
 
 # job-level keys that mean per-job structure the padded batch can't carry
 # (start_image_uri and strength are handled per-workflow: txt2img refuses
-# them, img2img REQUIRES the start image and keys on the strength)
+# them, img2img REQUIRES the start image and keys on the strength; `lora`
+# left this list in ISSUE 13 — adapters now ride PER ROW as runtime
+# low-rank deltas, so adapter identity no longer splits the bucket)
 _UNBATCHABLE_JOB_KEYS = (
     "mask_image_uri",
-    "lora",
     "refiner",
     "upscale",
     "textual_inversion",
@@ -61,8 +62,10 @@ _UNBATCHABLE_JOB_KEYS = (
 )
 
 # the only `parameters` keys a batchable job may carry; anything else
-# (controlnet, scheduler_args, aesthetic_score, ...) is per-job behavior
-# we refuse to guess at — the job falls through to the single path
+# (scheduler_args, aesthetic_score, ...) is per-job behavior we refuse
+# to guess at — the job falls through to the single path. `controlnet`
+# is handled explicitly (the shared-ControlNet component below), and
+# cross_attention_kwargs / lora_rank ride with the per-row adapter.
 _SAFE_PARAMETER_KEYS = frozenset({
     "test_tiny_model",
     "pipeline_type",
@@ -74,7 +77,18 @@ _SAFE_PARAMETER_KEYS = frozenset({
     "use_karras_sigmas",
     "default_height",
     "default_width",
+    "controlnet",
+    "cross_attention_kwargs",
+    "lora_rank",
 })
+
+# txt2img-ControlNet wire names whose batched semantics the shared-
+# ControlNet group reproduces (one control image conditions every row)
+_BATCHABLE_CN_PIPELINE_TYPES = {
+    None,
+    "StableDiffusionControlNetPipeline",
+    "StableDiffusionXLControlNetPipeline",
+}
 
 DEFAULT_STEPS = 30
 DEFAULT_GUIDANCE = 7.5
@@ -124,15 +138,145 @@ def placement_model(job: dict) -> str | None:
     return model
 
 
+def adapter_ref(job: dict) -> str | None:
+    """The adapter IDENTITY one job carries, or None — per-row data for
+    the batched program, but the hive's gang dispatcher and the worker's
+    scheduler both cap DISTINCT adapters per gang at `lora_slots_max`
+    (the stacked-factor slot dimension), so both need one canonical
+    spelling. Handles the raw wire string and the resolved
+    {lora, weight_name, subfolder} dict alike."""
+    lora = job.get("lora")
+    if lora is None or lora == "":
+        return None
+    if isinstance(lora, dict):
+        return "|".join(
+            str(lora.get(k) or "")
+            for k in ("lora", "weight_name", "subfolder"))
+    return str(lora)
+
+
+# smallest padded factor rank the batched program compiles
+# (lora_runtime.MIN_RANK imports this): declared ranks below it all run
+# as the same rank-4-padded program, so they must share one bucket here
+LORA_MIN_RANK = 4
+
+
+def _pow2_bucket(n: int) -> int:
+    b = 1
+    while b < n:
+        b *= 2
+    return b
+
+
+# _runtime_delta_on memo: (env spelling, settings-file mtime_ns) -> flag.
+# coalesce_key runs per job on the hive submit and worker enqueue hot
+# paths; a full settings read+parse per adapter job would add disk I/O
+# there, but the flag only changes when the env var or the file does —
+# one getenv + one stat re-validates it.
+_DELTA_FLAG: tuple[tuple, bool] | None = None
+
+
+def _runtime_delta_on() -> bool:
+    """Settings.lora_runtime_delta at call time — jax-free. The kill
+    switch restores the pre-ISSUE-13 serving shape end to end: with
+    deltas off, run_batched refuses adapter groups, so admitting them
+    here would only buy a doomed coalesced attempt + a noisy solo
+    fallback per group."""
+    global _DELTA_FLAG
+    try:
+        import os
+
+        from .settings import get_settings_dir, load_settings
+
+        # get_settings_full_path() mkdirs the settings dir as a side
+        # effect — derive the path without it, one stat only
+        root = os.getenv("SDAAS_ROOT")
+        try:
+            mtime = os.stat(
+                get_settings_dir() / "settings.json").st_mtime_ns
+        except OSError:
+            mtime = None
+        fingerprint = (os.getenv("CHIASWARM_LORA_RUNTIME_DELTA"), root,
+                       mtime)
+        if _DELTA_FLAG is not None and _DELTA_FLAG[0] == fingerprint:
+            return _DELTA_FLAG[1]
+        flag = bool(getattr(load_settings(), "lora_runtime_delta", True))
+        _DELTA_FLAG = (fingerprint, flag)
+        return flag
+    except Exception:  # settings trouble must never unbatch plain jobs
+        return True
+
+
+def _adapter_component(job: dict, params: dict) -> tuple | None:
+    """The coalesce key's adapter-slot dimension (ISSUE 13): jobs
+    carrying an adapter coalesce with each other AND with adapter-free
+    jobs on the same base model (adapter-free rows ride slot 0 of the
+    stacked factors with an exact zero delta), so adapter PRESENCE never
+    splits the bucket — identity rides per row. Only a submitter-
+    declared `lora_rank` splits, by power-of-two RANK BUCKET: a gang's
+    stacked factors share one padded rank, and an explicit hint keeps a
+    rank-4 fleet from padding to a declared rank-128 outlier. Undeclared
+    ranks coalesce with everything; zero-padding keeps any mix exact
+    either way."""
+    if adapter_ref(job) is None:
+        return None
+    try:
+        rank = int(params.get("lora_rank", job.get("lora_rank", 0)) or 0)
+    except (TypeError, ValueError):
+        rank = 0
+    if rank <= 0:
+        return None  # same bucket as adapter-free jobs
+    return ("lora", _pow2_bucket(max(rank, LORA_MIN_RANK)))
+
+
+def _controlnet_component(job: dict, params: dict,
+                          workflow: str) -> tuple | None | bool:
+    """The shared-ControlNet dimension (ISSUE 13 second rung): jobs
+    conditioned by ONE identical ControlNet branch + control image
+    coalesce, with the control residuals computed once per group. False
+    = the job carries ControlNet structure the batched program cannot
+    share (per-job start-image conditioning, QR prepipelines) -> single
+    path; None = no ControlNet."""
+    cn = params.get("controlnet")
+    if cn is None:
+        return None
+    if not isinstance(cn, dict) or workflow != "txt2img":
+        return False
+    cn_params = cn.get("parameters") or {}
+    if not isinstance(cn_params, dict):
+        return False
+    if cn_params.get("controlnet_prepipeline_type"):
+        return False  # QR two-stage chains per job
+    if cn.get("qr_code_contents"):
+        return False  # generated control images are per-job content
+    uri = cn.get("control_image_uri")
+    if not uri:
+        return False
+    if params.get("pipeline_type") not in _BATCHABLE_CN_PIPELINE_TYPES:
+        return False
+    return (
+        str(cn.get("controlnet_model_name",
+                   "lllyasviel/control_v11p_sd15_canny")),
+        str(uri),
+        str(cn.get("preprocessor") or ""),
+        round(float(cn.get("controlnet_conditioning_scale", 1.0)), 4),
+        round(float(cn.get("control_guidance_start", 0.0)), 4),
+        round(float(cn.get("control_guidance_end", 1.0)), 4),
+    )
+
+
 def coalesce_key(job: dict) -> tuple | None:
     """Compatibility bucket for one raw hive job; None = not batchable.
 
     Two jobs with equal keys produce identical results whether they run
     alone or coalesced: everything the jitted program closes over or
     shares across the batch (model, canvas, step count, scheduler,
-    guidance scale, workflow, img2img strength) is in the key;
-    everything per-row (prompt, negative, seed, start image, image
-    count) rides outside it.
+    guidance scale, workflow, img2img strength, the SHARED ControlNet
+    branch + control image) is in the key; everything per-row (prompt,
+    negative, seed, start image, image count, ADAPTER identity + scale)
+    rides outside it. The adapter-slot element splits only by rank
+    bucket — same base model + compatible rank coalesce, thousands of
+    adapters over one resident tree (ISSUE 13).
     """
     try:
         workflow = job.get("workflow")
@@ -147,6 +291,20 @@ def coalesce_key(job: dict) -> tuple | None:
         if not isinstance(params, dict):
             return None
         if not set(params) <= _SAFE_PARAMETER_KEYS:
+            return None
+
+        adapter = _adapter_component(job, params)
+        if adapter_ref(job) is not None and not _runtime_delta_on():
+            # lora_runtime_delta=0: adapters serve via merged trees on
+            # the single path — adapter jobs are uncoalesceable again
+            return None
+        cn = _controlnet_component(job, params, workflow)
+        if cn is False:
+            return None
+        if cn is not None and adapter_ref(job) is not None:
+            # each is batchable alone; the combination stays on the
+            # single path (the delta interceptor is scoped to the UNet,
+            # but the grouping matrix stays small and tested)
             return None
 
         from .registry import _auto_family
@@ -171,7 +329,11 @@ def coalesce_key(job: dict) -> tuple | None:
             # the formatter may interpret per-job — single path
             if "start_image_uri" in job or "strength" in job:
                 return None
-            if params.get("pipeline_type") not in _BATCHABLE_PIPELINE_TYPES:
+            # the shared-ControlNet component validated its own pipeline
+            # types; a plain txt2img job keeps the original gate
+            if cn is None and (
+                    params.get("pipeline_type")
+                    not in _BATCHABLE_PIPELINE_TYPES):
                 return None
         else:  # img2img: per-request start images -> stacked init latents
             if not job.get("start_image_uri"):
@@ -204,7 +366,7 @@ def coalesce_key(job: dict) -> tuple | None:
         # large_model flips the SD-vs-SDXL default pipeline class
         large = bool(params.get("large_model", False))
         return (model, family, height, width, steps, scheduler, guidance,
-                karras, tiny, large, workflow, strength)
+                karras, tiny, large, workflow, strength, adapter, cn)
     except (TypeError, ValueError):
         # hive-controlled values that don't parse: let the single-job
         # path produce its usual fatal envelope for them

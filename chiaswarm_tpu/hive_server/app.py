@@ -148,6 +148,7 @@ class HiveServer:
             affinity_hold_s=float(g("hive_affinity_hold_s", 15.0)),
             max_jobs_per_poll=int(g("hive_max_jobs_per_poll", 4)),
             gang_max=int(g("hive_gang_max", 8)),
+            lora_slots=int(g("lora_slots_max", 8)),
         )
         self.spool = ArtifactSpool(
             resolve_path(g("hive_spool_dir", "hive_spool")))

@@ -54,7 +54,7 @@ import math
 import uuid
 
 from .. import telemetry
-from ..coalesce import job_rows, placement_model
+from ..coalesce import adapter_ref, job_rows, placement_model
 from .clock import CLOCK
 from .fleet import parse_stats
 from .queue import JobRecord, PriorityJobQueue
@@ -230,13 +230,19 @@ class Dispatcher:
     """The placement decision for one /work poll."""
 
     def __init__(self, directory: WorkerDirectory, affinity_hold_s: float,
-                 max_jobs_per_poll: int, gang_max: int = 8):
+                 max_jobs_per_poll: int, gang_max: int = 8,
+                 lora_slots: int = 8):
         self.directory = directory
         self.affinity_hold_s = max(float(affinity_hold_s), 0.0)
         self.max_jobs_per_poll = max(int(max_jobs_per_poll), 1)
         # most jobs one GANG may hold (Settings.hive_gang_max); <= 1
         # disables gang scheduling hive-side entirely
         self.gang_max = max(int(gang_max), 1)
+        # most DISTINCT adapters one gang may carry (ISSUE 13,
+        # Settings.lora_slots_max): the worker's stacked-factor program
+        # has that many slots, so a gang past the cap would only fall
+        # apart into solo fallbacks at the slice
+        self.lora_slots = max(int(lora_slots), 1)
 
     def _budget(self, worker: WorkerInfo) -> tuple[int, int]:
         """(work items, image rows) to hand this poll.
@@ -396,6 +402,12 @@ class Dispatcher:
                 cap_jobs = min(self.gang_max,
                                self.max_jobs_per_poll - len(handed))
                 cap_rows = min(worker.gang_rows, free_rows)
+                # adapter-aware gangs (ISSUE 13): mixed-adapter members
+                # share one pass as stacked per-row deltas, capped at
+                # lora_slots DISTINCT adapters (the worker program's
+                # factor-slot dimension)
+                adapters = {a for a in (adapter_ref(record.job),)
+                            if a is not None}
                 for peer in queue.queued_peers(record):
                     if len(members) >= cap_jobs:
                         break
@@ -411,8 +423,17 @@ class Dispatcher:
                         # smaller peer over this one would reorder the
                         # class FIFO
                         break
+                    peer_adapter = adapter_ref(peer.job)
+                    if (peer_adapter is not None
+                            and peer_adapter not in adapters
+                            and len(adapters) >= self.lora_slots):
+                        # same stop-don't-skip rule as rows: a later
+                        # same-adapter peer must not overtake this one
+                        break
                     members.append(peer)
                     rows += peer_rows
+                    if peer_adapter is not None:
+                        adapters.add(peer_adapter)
             items -= 1
             free_rows -= rows
             taken.update(m.job_id for m in members)

@@ -1,0 +1,175 @@
+"""Adapter factor cache: raw LoRA A/B factors, byte-capped, per process.
+
+ISSUE 13's satellite fix for multi-tenant adapter serving: the old
+`SDPipeline._lora_cache` kept up to four FULL merged UNet param trees in
+HBM — one per (adapter, scale) — so every distinct adapter split base
+residency and four tenants' worth of adapters evicted each other by
+count, not by cost. This module replaces it with a process-wide LRU of
+raw adapter FACTORS ({module_key: (A [r,in], B [out,r], alpha)}), keyed
+by the scale-independent adapter identity (ref, weight_name, subfolder)
+and byte-capped by ``Settings.lora_cache_mb``
+(``CHIASWARM_LORA_CACHE_MB``; 0 disables caching — adapters still load,
+they just reload per pass).
+
+Factors are host numpy arrays: a rank-16 SDXL adapter is a few MiB
+against the multi-GiB merged tree it used to pin, so a fleet-realistic
+census of hundreds of adapters fits one worker. The runtime-delta path
+(pipelines/lora_runtime.py) stacks them per batch slot at pass time; the
+merged-tree fallback merges from the same cached factors.
+
+Thread-safe: slice executor threads resolve adapters concurrently.
+"""
+
+from __future__ import annotations
+
+import threading
+from collections import OrderedDict
+
+from . import telemetry
+
+_EVENTS = telemetry.counter(
+    "swarm_lora_cache_total",
+    "Adapter factor-cache lookups by outcome (miss = the adapter's "
+    "safetensors were read and parsed from disk)",
+    ("event",),
+)
+_BYTES = telemetry.gauge(
+    "swarm_lora_cache_bytes",
+    "Bytes of raw adapter factors currently resident in the factor "
+    "cache (bounded by Settings.lora_cache_mb)")
+_ENTRIES = telemetry.gauge(
+    "swarm_lora_cache_entries",
+    "Distinct adapters resident in the factor cache")
+
+
+def adapter_key(lora: dict) -> tuple:
+    """The cache identity of one resolved adapter reference. Scale is
+    deliberately absent: factors are scale-independent (the runtime
+    delta and the merge both apply scale at use time)."""
+    return (str(lora.get("lora")), lora.get("weight_name"),
+            lora.get("subfolder"))
+
+
+class LoraFactorCache:
+    """Byte-capped LRU of raw adapter factors."""
+
+    def __init__(self, max_bytes: int):
+        self.max_bytes = int(max_bytes)
+        self._lock = threading.Lock()
+        self._entries: OrderedDict[tuple, tuple] = OrderedDict()
+        self._bytes = 0
+
+    def lookup(self, key: tuple):
+        """The cached (factors, nbytes) for `key`, or None. Counts the
+        hit; the caller counts the miss once the load succeeds (a
+        failing adapter load must not read as a cache miss forever)."""
+        with self._lock:
+            value = self._entries.get(key)
+            if value is not None:
+                self._entries.move_to_end(key)
+                _EVENTS.inc(event="hit")
+            return value[0] if value is not None else None
+
+    def derived(self, key: tuple) -> dict | None:
+        """The per-entry derived-data slot for a RESIDENT adapter, or
+        None. Pipelines memoize work computed FROM the factors here
+        (e.g. the Dense-match verdict, which walks the whole UNet param
+        tree) so it shares the entry's byte-capped lifetime: eviction
+        drops the derivations with the factors they reference, so the
+        memo can never pin bytes the cap already reclaimed."""
+        with self._lock:
+            value = self._entries.get(key)
+            return value[2] if value is not None else None
+
+    def put(self, key: tuple, factors: dict, nbytes: int) -> None:
+        _EVENTS.inc(event="miss")
+        if nbytes > self.max_bytes:
+            return  # one giant adapter must not wipe the whole cache
+        with self._lock:
+            old = self._entries.pop(key, None)
+            if old is not None:
+                self._bytes -= old[1]
+            self._entries[key] = (factors, int(nbytes), {})
+            self._bytes += int(nbytes)
+            while self._bytes > self.max_bytes and self._entries:
+                _, entry = self._entries.popitem(last=False)
+                self._bytes -= entry[1]
+            _BYTES.set(self._bytes)
+            _ENTRIES.set(len(self._entries))
+
+    @property
+    def resident_bytes(self) -> int:
+        return self._bytes
+
+    def __len__(self) -> int:
+        return len(self._entries)
+
+
+_CACHE: LoraFactorCache | None = None
+_CONFIGURED = False
+_LOCK = threading.Lock()
+
+
+def get_cache() -> LoraFactorCache | None:
+    """The process-wide cache, sized from Settings.lora_cache_mb on
+    first use; None when disabled (0)."""
+    global _CACHE, _CONFIGURED
+    with _LOCK:
+        if not _CONFIGURED:
+            from .settings import load_settings
+
+            try:
+                mb = int(getattr(load_settings(), "lora_cache_mb", 0))
+            except Exception:  # the cache is an optimization, never fatal
+                mb = 0
+            _CACHE = LoraFactorCache(mb * 1024 * 1024) if mb > 0 else None
+            _CONFIGURED = True
+        return _CACHE
+
+
+def configure(max_bytes: int | None) -> LoraFactorCache | None:
+    """Explicitly (re)size the process-wide cache — tests and benches;
+    None or <= 0 disables."""
+    global _CACHE, _CONFIGURED
+    with _LOCK:
+        _CACHE = (LoraFactorCache(int(max_bytes))
+                  if max_bytes and int(max_bytes) > 0 else None)
+        _CONFIGURED = True
+        _BYTES.set(0)
+        _ENTRIES.set(0)
+        return _CACHE
+
+
+def reset() -> None:
+    """Forget the configured cache (next get_cache() re-reads Settings)."""
+    global _CACHE, _CONFIGURED
+    with _LOCK:
+        _CACHE = None
+        _CONFIGURED = False
+
+
+def resolve(lora: dict, model_name: str) -> dict:
+    """Adapter reference -> raw factors, through the byte-capped cache.
+    A disabled cache still loads (uncached, counted as a miss); load
+    failures raise ValueError (fatal job error, reference contract)."""
+    return resolve_entry(lora, model_name)[0]
+
+
+def resolve_entry(lora: dict, model_name: str) -> tuple[dict, dict | None]:
+    """resolve() plus the entry's derived-data slot (None when the
+    cache is disabled or the entry didn't fit): callers memoize
+    factor-derived work there so it lives and dies with the entry."""
+    from .models.lora import factors_nbytes, load_factors
+
+    key = adapter_key(lora)
+    cache = get_cache()
+    if cache is not None:
+        factors = cache.lookup(key)
+        if factors is not None:
+            return factors, cache.derived(key)
+    factors = load_factors(lora, model_name)
+    if cache is not None:
+        cache.put(key, factors, factors_nbytes(factors))
+        return factors, cache.derived(key)
+    _EVENTS.inc(event="miss")
+    return factors, None

@@ -1,11 +1,18 @@
-"""LoRA adapters merged into Flax param trees at load time.
+"""LoRA adapter loading: raw low-rank factors, plus the legacy merge.
 
 The reference loads LoRA per job via diffusers `load_lora_weights` + fuse
 (swarm/diffusion/diffusion_func.py:113-126) — a per-job torch graph edit.
-On TPU the jitted program's weights are just a pytree, so a LoRA is merged
-arithmetically (W += scale * (alpha/r) * B @ A) into a COPY of the base
-tree, and the merged tree is cached by (model, lora, scale) at the pipeline
-layer — zero per-step cost, no graph surgery.
+On TPU the serving path (ISSUE 13) keeps ONE resident base UNet and
+applies each adapter as a RUNTIME per-row delta inside the jitted
+program: `W·x + scale·(alpha/r)·B·(A·x)` — see pipelines/lora_runtime.py.
+This module owns the host side of that: loading a safetensors adapter
+into raw `(A [r,in], B [out,r], alpha)` factors and matching them onto
+the 2D Dense kernels of a UNet param tree.
+
+`merge_lora` / `resolve_and_merge` (W += scale * (alpha/r) * B @ A into
+a COPY of the base tree) remain as the fallback path for adapters the
+runtime delta cannot express (non-Dense modules) and for pipelines that
+have no delta path (video motion LoRAs).
 
 Supports both common safetensors layouts:
 - diffusers/PEFT: `unet.down_blocks.0...to_q.lora_A.weight` / `lora_B`
@@ -109,6 +116,101 @@ def _flat_params(tree, prefix=()):
             yield path, v
 
 
+def factors_nbytes(factors: dict[str, tuple]) -> int:
+    """Host bytes of one adapter's raw factors (the byte-capped factor
+    cache's accounting unit — lora_cache.py)."""
+    total = 0
+    for a, b, _alpha in factors.values():
+        total += int(np.asarray(a).nbytes) + int(np.asarray(b).nbytes)
+    return total
+
+
+def load_factors(lora: dict, model_name: str) -> dict[str, tuple]:
+    """Load an adapter by job reference into raw factors
+    {module_key: (A [r,in], B [out,r], alpha|None)}.
+
+    Same candidate roots and failure contract as the merge path: the
+    literal path, then `model_root_dir`/<ref>; load failures and
+    zero-module adapters raise ValueError -> fatal job error (the
+    reference's "incompatible lora" contract). The factors are
+    scale-independent — one cache entry serves every lora_scale.
+    """
+    from ..settings import load_settings
+
+    candidates = [Path(str(lora.get("lora"))).expanduser()]
+    candidates.append(
+        Path(load_settings().model_root_dir).expanduser() / str(lora.get("lora"))
+    )
+    state = None
+    errors = []
+    for root in candidates:
+        try:
+            state = load_lora_state(
+                root, lora.get("weight_name"), lora.get("subfolder")
+            )
+            break
+        except (FileNotFoundError, OSError) as e:
+            errors.append(str(e))
+    if state is None:
+        raise ValueError(
+            f"Could not load lora {lora}. It might be incompatible with "
+            f"{model_name}: {'; '.join(errors)}"
+        )
+    factors = collect_lora_deltas(state)
+    if not factors:
+        raise ValueError(
+            f"Could not load lora {lora}: no LoRA modules found in its "
+            f"safetensors (incompatible with {model_name})"
+        )
+    return factors
+
+
+def match_dense_factors(factors: dict[str, tuple], unet_params: dict
+                        ) -> tuple[dict[str, tuple], int]:
+    """Match raw factors onto a UNet tree's 2D Dense kernels.
+
+    Returns ({'/'-joined module path: (A, B, alpha)}, unmatched_dense) —
+    the operand layout pipelines/lora_runtime.py stacks per batch slot.
+    `unmatched_dense` counts modules that matched a kernel by NAME but
+    not by SHAPE, or no kernel at all: >0 means the adapter has content
+    the runtime delta cannot express (conv/LoCon modules, a mismatched
+    base), so the caller must fall back to the merged-tree path rather
+    than silently drop part of the adapter.
+    """
+    index = {}
+    for path, leaf in _flat_params(unet_params):
+        if path[-1] != "kernel":
+            continue
+        index["_".join(path[:-1])] = (path[:-1], getattr(leaf, "shape", None),
+                                      getattr(leaf, "ndim", 0))
+    matched: dict[str, tuple] = {}
+    unmatched = 0
+    for key, (a, b, alpha) in factors.items():
+        hit = index.get(key)
+        if hit is None:
+            unmatched += 1
+            continue
+        path, shape, ndim = hit
+        a_arr, b_arr = np.asarray(a), np.asarray(b)
+        # delta = (B @ A).T must land on a 2D [in, out] kernel
+        if (ndim != 2 or a_arr.ndim != 2 or b_arr.ndim != 2
+                or shape != (a_arr.shape[1], b_arr.shape[0])
+                or a_arr.shape[0] != b_arr.shape[1]):
+            unmatched += 1
+            continue
+        matched["/".join(path)] = (a_arr, b_arr,
+                                   float(alpha) if alpha is not None else None)
+    return matched, unmatched
+
+
+def merge_factors(params: dict, factors: dict[str, tuple],
+                  scale: float = 1.0) -> tuple[dict, int]:
+    """merge_lora over pre-collected factors (the factor-cache fallback
+    path: the adapter was already loaded once; re-reading safetensors to
+    merge would defeat the cache)."""
+    return _merge_deltas(params, factors, scale)
+
+
 def merge_lora(params: dict, lora_state: dict, scale: float = 1.0) -> tuple[dict, int]:
     """Return (new param tree with LoRA deltas merged, matched module count).
 
@@ -120,7 +222,11 @@ def merge_lora(params: dict, lora_state: dict, scale: float = 1.0) -> tuple[dict
     deltas = collect_lora_deltas(lora_state)
     if not deltas:
         return params, 0
+    return _merge_deltas(params, deltas, scale)
 
+
+def _merge_deltas(params: dict, deltas: dict[str, tuple],
+                  scale: float) -> tuple[dict, int]:
     # index the param tree by normalized underscore path of the kernel's parent
     index = {}
     for path, leaf in _flat_params(params):
@@ -174,28 +280,8 @@ def resolve_and_merge(base_unet_params: dict, lora: dict, scale: float,
     swarm/diffusion/diffusion_func.py:113-126). Returns the merged UNet
     tree (host-side); the caller places/casts and caches it.
     """
-    from ..settings import load_settings
-
-    candidates = [Path(str(lora.get("lora"))).expanduser()]
-    candidates.append(
-        Path(load_settings().model_root_dir).expanduser() / str(lora.get("lora"))
-    )
-    state = None
-    errors = []
-    for root in candidates:
-        try:
-            state = load_lora_state(
-                root, lora.get("weight_name"), lora.get("subfolder")
-            )
-            break
-        except (FileNotFoundError, OSError) as e:
-            errors.append(str(e))
-    if state is None:
-        raise ValueError(
-            f"Could not load lora {lora}. It might be incompatible with "
-            f"{model_name}: {'; '.join(errors)}"
-        )
-    merged, matched = merge_lora(base_unet_params, state, scale)
+    factors = load_factors(lora, model_name)
+    merged, matched = _merge_deltas(base_unet_params, factors, scale)
     if matched == 0:
         raise ValueError(
             f"Could not load lora {lora}: no modules matched "

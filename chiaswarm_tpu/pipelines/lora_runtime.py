@@ -1,0 +1,170 @@
+"""Runtime per-row LoRA deltas inside the jitted denoise program.
+
+The ISSUE 13 tentpole: instead of merging each adapter into a full COPY
+of the base UNet tree (per-adapter HBM residency, no coalescing across
+tenants), the padded batched program carries up to N adapters as STACKED
+low-rank factors and computes, per batch row b with adapter slot s(b):
+
+    y_b = W·x_b + gain_b · B[s(b)] · (A[s(b)] · x_b)
+
+- ``A`` stacks are ``[N, r, in]`` and ``B`` stacks ``[N, out, r]`` per
+  Dense module path, zero-padded in both the slot dim (slot 0 is always
+  the zero adapter — adapter-free rows compute an exact zero delta) and
+  the rank dim (every adapter pads to one shared power-of-two rank
+  bucket; zero rows/cols keep B@A exact), so ONE compiled program serves
+  any mix of adapters with those bucket dims — adapter identity is data,
+  not program structure, and swapping adapters never recompiles.
+- ``gain`` carries ``scale * (alpha/rank)`` per row (0 for no-adapter
+  rows), so per-module alphas and per-job lora_scale ride per row too.
+
+Injection uses flax's method interceptor (`nn.intercept_methods`) scoped
+to the UNet apply alone: every `nn.Dense.__call__` whose module path has
+a factor stack gets the low-rank correction added to its output. The
+base model's params and HLO are untouched — a pass with an empty operand
+dict traces to the identical program (pinned bitwise by tests).
+"""
+
+from __future__ import annotations
+
+import flax.linen as nn
+import jax.numpy as jnp
+import numpy as np
+
+from ..telemetry import counter as telemetry_counter
+
+# image rows through SD denoise passes by adapter mode: "delta" rows had
+# a runtime per-row delta applied, "merged" rows ran on a merged-tree
+# param copy (the fallback path), "none" rows carried no adapter. The
+# multi-tenant refactor's whole point is delta >> merged at scale.
+LORA_ROWS = telemetry_counter(
+    "swarm_lora_rows_total",
+    "Image rows through denoise passes by adapter mode "
+    "(delta | merged | none)",
+    ("mode",),
+)
+
+# slot-count and rank buckets: each distinct (slots, rank) pair is one
+# compiled program variant per shape bucket, so both snap to powers of
+# two. MIN_RANK keeps trivial adapters from fragmenting the space; it
+# AND the bucketing function are shared with the jax-free coalesce
+# vocabulary so the rank buckets that gang jobs together are exactly the
+# ones that compile together.
+from ..coalesce import LORA_MIN_RANK as MIN_RANK
+from ..coalesce import _pow2_bucket as pow2_bucket
+
+
+class DeltaIneligibleError(ValueError):
+    """A coalesced group carries adapters the runtime delta cannot
+    express (conv/LoCon modules, rank past lora_rank_max). Carries the
+    affected member job ids so the worker can RE-BATCH the eligible
+    majority and route only these members through the solo merged-tree
+    fallback — one slow adapter must not serialize its batchmates.
+    Subclasses ValueError so callers without per-member identity (direct
+    run_batched users) still get the classic whole-group solo fallback.
+    """
+
+    def __init__(self, job_ids):
+        self.job_ids = [j for j in job_ids if j is not None]
+        super().__init__(
+            f"adapter(s) for jobs {self.job_ids or list(job_ids)} are not "
+            "delta-eligible; merged-tree fallback")
+
+
+def adapter_rank(factors: dict[str, tuple]) -> int:
+    """The largest rank across an adapter's matched modules."""
+    return max((np.asarray(a).shape[0] for a, _b, _al in factors.values()),
+               default=0)
+
+
+def build_operands(adapters: list[dict], row_slots: list[int],
+                   row_gains: list[float], dtype) -> tuple[dict, tuple]:
+    """Stack per-slot factors into the jitted program's lora operand.
+
+    ``adapters``: matched factor dicts ({path: (A, B, alpha)}), one per
+    occupied slot, slot numbers 1..len(adapters) — slot 0 is the
+    implicit zero adapter. ``row_slots``/``row_gains`` are per BATCH ROW
+    (pre-CFG; the step body tiles them over the CFG rows). Returns
+    (operands, sig) where sig = (n_slot_bucket, rank_bucket,
+    targeted-module-paths) — the program-cache suffix: same sig => same
+    compiled program, any adapters. The path set is part of the sig
+    because it is the operand dict's PYTREE STRUCTURE: two adapters
+    hitting different Dense subsets would otherwise silently retrace
+    inside one cached jit wrapper.
+
+    The alpha/rank gain convention: callers pass ``row_gains`` as the
+    job's lora_scale; the per-module ``alpha/rank`` factor is folded
+    INTO the stacked A here (rows scaled once, host-side), so modules
+    with different alphas inside one adapter stay exact.
+    """
+    n_slots = pow2_bucket(1 + len(adapters))
+    ranks = [adapter_rank(f) for f in adapters]
+    r_bucket = pow2_bucket(max([MIN_RANK] + ranks))
+    paths = sorted({p for f in adapters for p in f})
+    a_map: dict[str, jnp.ndarray] = {}
+    b_map: dict[str, jnp.ndarray] = {}
+    for path in paths:
+        a_stack = b_stack = None
+        for slot, factors in enumerate(adapters, start=1):
+            entry = factors.get(path)
+            if entry is None:
+                continue
+            a, b, alpha = entry
+            a = np.asarray(a, np.float32)
+            b = np.asarray(b, np.float32)
+            rank = a.shape[0]
+            if a_stack is None:
+                a_stack = np.zeros((n_slots, r_bucket, a.shape[1]), np.float32)
+                b_stack = np.zeros((n_slots, b.shape[0], r_bucket), np.float32)
+            # per-module alpha/rank folds into A so one per-row gain
+            # (the job's lora_scale) serves modules with distinct alphas
+            eff = (alpha / rank) if alpha is not None else 1.0
+            a_stack[slot, :rank, :] = eff * a
+            b_stack[slot, :, :rank] = b
+        a_map[path] = jnp.asarray(a_stack, dtype)
+        b_map[path] = jnp.asarray(b_stack, dtype)
+    operands = {
+        "a": a_map,
+        "b": b_map,
+        "slot": jnp.asarray(np.asarray(row_slots, np.int32)),
+        "gain": jnp.asarray(np.asarray(row_gains, np.float32)),
+    }
+    return operands, (n_slots, r_bucket, tuple(paths))
+
+
+def make_interceptor(operands: dict, cfg_rows: int):
+    """Flax method interceptor applying the stacked per-row deltas to
+    every targeted Dense inside ONE unet apply. ``operands['slot']`` /
+    ``['gain']`` are per batch row; the UNet sees the CFG-tiled batch
+    (uncond rows first), so both tile by ``cfg_rows`` here. Dense calls
+    whose leading dim is not the CFG batch (never the case in the SD
+    UNet, but cheap to guard at trace time) pass through untouched."""
+    a_map, b_map = operands["a"], operands["b"]
+    slots = jnp.tile(operands["slot"], (cfg_rows,))
+    gains = jnp.tile(operands["gain"], (cfg_rows,)).astype(jnp.float32)
+    rows = slots.shape[0]
+
+    def interceptor(next_fun, args, kwargs, context):
+        if (context.method_name != "__call__"
+                or not isinstance(context.module, nn.Dense)):
+            return next_fun(*args, **kwargs)
+        stack_a = a_map.get("/".join(context.module.path))
+        if stack_a is None:
+            return next_fun(*args, **kwargs)
+        x = args[0]
+        if getattr(x, "ndim", 0) < 2 or x.shape[0] != rows:
+            return next_fun(*args, **kwargs)
+        y = next_fun(*args, **kwargs)
+        stack_b = b_map["/".join(context.module.path)]
+        a = jnp.take(stack_a, slots, axis=0)  # [rows, r, in]
+        b = jnp.take(stack_b, slots, axis=0)  # [rows, out, r]
+        if x.ndim == 2:
+            low = jnp.einsum("bi,bri->br", x, a)
+            delta = jnp.einsum("br,bor->bo", low, b)
+            delta = delta * gains[:, None]
+        else:
+            low = jnp.einsum("bsi,bri->bsr", x, a)
+            delta = jnp.einsum("bsr,bor->bso", low, b)
+            delta = delta * gains[:, None, None]
+        return y + delta.astype(y.dtype)
+
+    return interceptor

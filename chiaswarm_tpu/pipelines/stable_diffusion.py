@@ -81,7 +81,13 @@ _SHARDED_PASSES = telemetry_counter(
     ("geometry",),
 )
 
-MAX_RESIDENT_LORAS = 4
+# merged-tree LoRA fallback LRU (each entry pins a FULL UNet copy in
+# HBM). Small by design since ISSUE 13: the serving path applies
+# adapters as runtime per-row deltas against the ONE resident base tree
+# (pipelines/lora_runtime.py + the byte-capped factor cache in
+# lora_cache.py); merged trees remain only for adapters the delta
+# cannot express
+MAX_RESIDENT_LORAS = 2
 MAX_RESIDENT_TI = 4
 MAX_RESIDENT_VAES = 2
 # placed param copies per pipeline beyond the default view: each sharded
@@ -567,23 +573,205 @@ class SDPipeline:
         self._ti_cache.clear()
         self._vae_cache.clear()
 
-    def _lora_params(self, base_params: dict, lora: dict, scale: float) -> dict:
-        """Base params with a LoRA merged into the UNet, cached by (ref, scale).
+    def _note_base_residency(self) -> None:
+        """Residency event for an ADAPTER pass, keyed on the BASE model
+        (ISSUE 13 satellite): a LoRA-heavy tenant's traffic must warm the
+        same slice affinity as plain traffic — the registry's load/hit
+        events fire at get_pipeline, but the adapter resolution inside a
+        pass is a residency signal of its own (the factors, programs,
+        and base tree all live here now)."""
+        if self.chipset is None:
+            return
+        try:
+            from ..chips.allocator import note_resident
 
-        Reference fuses via diffusers per job (diffusion_func.py:113-126);
-        here the merge is done once and the result stays resident alongside
-        the base tree. Load failures raise ValueError -> fatal job error,
-        matching the reference's "incompatible lora" contract.
+            note_resident(self.model_name, self.chipset.slice_id)
+        except Exception:  # placement is advisory; never fail a job over it
+            logger.debug("adapter residency note failed", exc_info=True)
+
+    def _adapter_delta_factors(self, lora: dict) -> dict | None:
+        """Matched, delta-eligible factors for one adapter reference —
+        the runtime per-row path (ISSUE 13) — or None when the adapter
+        must fall back to the merged-tree path: runtime deltas disabled
+        (Settings.lora_runtime_delta), modules the per-row Dense delta
+        cannot express (conv/LoCon, shape-mismatched), or a rank past
+        Settings.lora_rank_max (the padded stack would dwarf the batch).
+        Load failures raise ValueError (fatal job error, reference
+        contract). Resolution goes through the process-wide byte-capped
+        factor cache (lora_cache.py) either way."""
+        settings = load_settings()
+        if not bool(getattr(settings, "lora_runtime_delta", True)):
+            return None
+        from .. import lora_cache
+        from .lora_runtime import adapter_rank
+
+        factors, derived = lora_cache.resolve_entry(lora, self.model_name)
+        self._note_base_residency()
+        # the Dense match walks the whole UNet param tree — fully
+        # determined by (adapter, model), so it memoizes in the cache
+        # entry's derived slot (same lifetime as the factors; the
+        # rank-cap gate below stays per-call so a settings flip applies
+        # to resident adapters too)
+        memo_key = ("dense_match", self.model_name)
+        verdict = derived.get(memo_key) if derived is not None else None
+        if verdict is None:
+            from ..models.lora import match_dense_factors
+
+            matched, unmatched = match_dense_factors(
+                factors, self.params["unet"])
+            if not matched:
+                raise ValueError(
+                    f"Could not load lora {lora}: no modules matched "
+                    f"{self.model_name}'s parameter tree"
+                )
+            if unmatched:
+                # the adapter carries content the per-row Dense delta
+                # can't express (conv/LoCon) — route it to the merged
+                # tree, the one conservative path for such adapters.
+                # KNOWN GAP (ROADMAP): _merge_deltas currently also
+                # skips shape-mismatched modules with a warning, so
+                # today both paths drop the conv content; the fallback
+                # keeps these adapters on the path where a real LoCon
+                # conv merge lands when implemented, rather than baking
+                # partial-delta semantics into the gang vocabulary
+                logger.info(
+                    "adapter %s has %d non-Dense module(s); merged-tree "
+                    "fallback", lora.get("lora"), unmatched)
+            verdict = (None if unmatched else matched,
+                       adapter_rank(matched))
+            if derived is not None:
+                derived[memo_key] = verdict
+        matched, rank = verdict
+        if matched is None:
+            return None
+        rank_cap = int(getattr(settings, "lora_rank_max", 128) or 0)
+        if rank_cap and rank > rank_cap:
+            logger.info(
+                "adapter %s rank %d exceeds lora_rank_max=%d; merged-tree "
+                "fallback", lora.get("lora"), rank, rank_cap)
+            return None
+        return matched
+
+    @staticmethod
+    def _require_runtime_delta() -> None:
+        """The kill switch: delta serving disabled means adapter groups
+        refuse (the solo fallback serves each member via the merged
+        tree). Shared by run_batched and the multi-chunk prescan so the
+        refusal (and the message callers match on) cannot drift."""
+        if not bool(getattr(load_settings(), "lora_runtime_delta", True)):
+            raise ValueError(
+                "runtime LoRA deltas are disabled "
+                "(lora_runtime_delta=0); serving members individually")
+
+    @staticmethod
+    def _adapter_slots_cap(lora_slots_max: int | None) -> int:
+        return int(lora_slots_max
+                   or getattr(load_settings(), "lora_slots_max", 8)
+                   or 8)
+
+    def _scan_adapter_specs(self, specs) -> tuple[dict, set, list]:
+        """One pass's adapter eligibility scan: resolve every DISTINCT
+        adapter once (factor-cache backed; the match verdict memoizes
+        in the entry's derived slot) -> (factors_of by adapter key,
+        distinct eligible keys, ineligible member job_ids). Load
+        FAILURES raise plain ValueError: the classic whole-group
+        fallback reproduces the fatal error with per-job attribution.
+        Shared by run_batched and prescan_adapter_chunks."""
+        from .. import lora_cache
+
+        factors_of: dict[tuple, dict | None] = {}
+        distinct: set = set()
+        ineligible: list = []
+        for spec in specs:
+            lora = spec.get("lora")
+            if not lora:
+                continue
+            akey = lora_cache.adapter_key(lora)
+            if akey not in factors_of:
+                factors_of[akey] = self._adapter_delta_factors(lora)
+            if factors_of[akey] is None:
+                ineligible.append(spec.get("job_id"))
+            else:
+                distinct.add(akey)
+        return factors_of, distinct, ineligible
+
+    def prescan_adapter_chunks(self, chunks: list[list[dict]],
+                               lora_slots_max: int | None = None) -> None:
+        """Raise every adapter refusal run_batched would hit in ANY pass
+        of a multi-pass group — the kill switch, delta-ineligible
+        adapters (DeltaIneligibleError naming every affected member),
+        the per-pass distinct-adapter slots cap — BEFORE the first pass
+        runs. A group split across passes otherwise wastes work: a
+        LATER chunk's refusal discards earlier chunks' finished denoise
+        output and re-counts their row metrics on the worker's
+        re-batch. Built from the same scan run_batched uses per call,
+        so the two cannot desynchronize."""
+        if not any(s.get("lora") for chunk in chunks for s in chunk):
+            return
+        from .lora_runtime import DeltaIneligibleError
+
+        self._require_runtime_delta()
+        slots_cap = self._adapter_slots_cap(lora_slots_max)
+        ineligible: list = []
+        overflow = False
+        for chunk in chunks:
+            _factors, distinct, inel = self._scan_adapter_specs(chunk)
+            ineligible.extend(inel)
+            overflow = overflow or len(distinct) > slots_cap
+        # ineligibility outranks the cap, as in run_batched (its slot
+        # assignment never starts when the eligibility scan refuses)
+        if ineligible:
+            raise DeltaIneligibleError(ineligible)
+        if overflow:
+            raise ValueError(
+                f"group carries more than {slots_cap} distinct adapters "
+                "in one pass; serving members individually")
+
+    def _lora_operands(self, adapters: list[dict], row_slots: list[int],
+                       row_gains: list[float]):
+        """Stack matched factors into the jitted program's lora operand,
+        replicated over the pass mesh (the stacks are weights-like: a
+        few MiB against the batch, and the slot dim must never be
+        mistaken for a batch dim by the data-axis sharder)."""
+        from .lora_runtime import build_operands
+
+        operands, sig = build_operands(adapters, row_slots, row_gains,
+                                       self.dtype)
+        if self.mesh.devices.size > 1:
+            operands = jax.device_put(operands, replicated(self.mesh))
+        return operands, sig
+
+    def _lora_params(self, base_params: dict, lora: dict, scale: float) -> dict:
+        """Base params with a LoRA merged into the UNet — the FALLBACK
+        path (ISSUE 13): adapters the runtime per-row delta cannot
+        express still work, at the old cost of a full UNet copy. Merges
+        from the byte-capped factor cache (lora_cache.py), so the
+        safetensors parse is shared with the delta path; the merged
+        trees themselves keep only a tiny LRU (each entry pins a full
+        UNet copy in HBM — the very cost the delta path removes).
+        Load failures raise ValueError -> fatal job error, matching the
+        reference's "incompatible lora" contract.
         """
         key = (lora.get("lora"), lora.get("weight_name"), lora.get("subfolder"),
                round(scale, 4))
         if key in self._lora_cache:
             self._lora_cache.move_to_end(key)
             return self._lora_cache[key]
-        from ..models.lora import resolve_and_merge
+        from .. import lora_cache
+        from ..models.lora import merge_factors
 
-        merged_unet = resolve_and_merge(
-            base_params["unet"], lora, scale, self.model_name
+        factors = lora_cache.resolve(lora, self.model_name)
+        self._note_base_residency()
+        merged_unet, matched = merge_factors(
+            base_params["unet"], factors, scale)
+        if matched == 0:
+            raise ValueError(
+                f"Could not load lora {lora}: no modules matched "
+                f"{self.model_name}'s parameter tree"
+            )
+        logger.info(
+            "merged LoRA %s into %s (%d modules, scale %.2f)",
+            lora.get("lora"), self.model_name, matched, scale,
         )
         params = dict(base_params)
         params["unet"] = self._place({"unet": merged_unet})["unet"]
@@ -1023,8 +1211,15 @@ class SDPipeline:
             def run_steps(params, latents, state, context, added,
                           guidance_scale, image_guidance, image_latents,
                           mask, rng, cn_params, control_cond, cn_scale,
-                          offset):
-                """context [cfg_rows*B,77,D] (uncond first)."""
+                          lora, offset):
+                """context [cfg_rows*B,77,D] (uncond first). `lora` is the
+                stacked per-row adapter operand (lora_runtime.py) — an
+                EMPTY dict for adapter-free passes, which traces to the
+                identical program (zero pytree leaves, no extra HLO)."""
+                if lora:
+                    from .lora_runtime import make_interceptor
+
+                    lora_interceptor = make_interceptor(lora, cfg_rows)
                 if mode == "pix2pix":
                     # per-row channel conditioning: zeros for the uncond
                     # row so image guidance has a true no-image baseline
@@ -1075,14 +1270,23 @@ class SDPipeline:
                             "down_residuals": down_res,
                             "mid_residual": mid_res,
                         }
-                    out = unet_apply(
-                        {"params": params["unet"]},
-                        model_in,
-                        t_vec,
-                        context,
-                        added_cond=added,
-                        **residual_kw,
-                    ).astype(jnp.float32)
+                    unet_in = (
+                        {"params": params["unet"]}, model_in, t_vec, context)
+                    if lora:
+                        # scoped to the UNet apply alone: the ControlNet
+                        # branch above shares module names (down_blocks_*/
+                        # attn*), so a body-wide interceptor would apply
+                        # the UNet's deltas to the control branch too
+                        import flax.linen as fnn
+
+                        with fnn.intercept_methods(lora_interceptor):
+                            out = unet_apply(
+                                *unet_in, added_cond=added, **residual_kw
+                            ).astype(jnp.float32)
+                    else:
+                        out = unet_apply(
+                            *unet_in, added_cond=added, **residual_kw
+                        ).astype(jnp.float32)
                     if mode == "pix2pix":
                         # dual guidance (InstructPix2Pix eq. 3): text guidance
                         # pulls away from image-only, image guidance away from
@@ -1183,13 +1387,26 @@ class SDPipeline:
             return key
         return (key, "geo", geo)
 
+    @staticmethod
+    def _sig_key(gkey, lora_sig):
+        """Adapter-pass program-cache suffix (ISSUE 13): adapter-free
+        passes keep the bare (geometry-suffixed) key so every pre-LoRA
+        cache-shape pin holds; runtime-delta passes compile per
+        (slot-bucket, rank-bucket, targeted-module-set) signature — adapter
+        IDENTITY is data,
+        so swapping adapters inside one signature never recompiles."""
+        if lora_sig is None:
+            return gkey
+        return (gkey, "lora", lora_sig)
+
     def _denoise_program(self, key, controlnet_module=None, geo=None,
-                         mesh=None):
+                         mesh=None, lora_sig=None):
         """Build (or fetch) the classic fused jitted denoise+decode
         program for one bucket — prep, the full step loop, and decode in
         ONE dispatch. This is the denoise_chunk_steps=0 path, cached
         under the bare bucket key exactly as before the chunked seam
-        (geometry-suffixed for non-default mesh views)."""
+        (geometry-suffixed for non-default mesh views, signature-suffixed
+        for runtime-delta adapter passes)."""
 
         def build():
             prep, make_steps, decode, (lo, hi) = self._denoise_parts(
@@ -1198,17 +1415,18 @@ class SDPipeline:
 
             def run(params, init_rng, context, added, guidance_scale,
                     image_guidance, image_latents, mask, rng, cn_params,
-                    control_cond, cn_scale):
+                    control_cond, cn_scale, lora):
                 latents, state = prep(params, init_rng, image_latents)
                 latents, _ = run_steps(
                     params, latents, state, context, added, guidance_scale,
                     image_guidance, image_latents, mask, rng, cn_params,
-                    control_cond, cn_scale, jnp.int32(lo))
+                    control_cond, cn_scale, lora, jnp.int32(lo))
                 return decode(params, latents)
 
             return run
 
-        return self._program(self._geo_key(key, geo), build)
+        return self._program(
+            self._sig_key(self._geo_key(key, geo), lora_sig), build)
 
     def _denoise_chunk_steps(self) -> int:
         """Settings.denoise_chunk_steps at call time (env-overridable per
@@ -1219,13 +1437,16 @@ class SDPipeline:
         except Exception:
             return 0
 
-    def _chunk_programs(self, key, controlnet_module, geo, mesh, chunk):
+    def _chunk_programs(self, key, controlnet_module, geo, mesh, chunk,
+                        lora_sig=None):
         """(prep, {length: chunk}, decode, lengths, lo) — the compiled
         program set for one bucket under one geometry, plus the chunk
         walk it serves. Shared by the chunked runner and the mid-pass
         re-shard path (which resolves the TARGET geometry's set lazily
         at the first seam that needs it; the walk is bucket-derived, so
-        both geometries share it)."""
+        both geometries share it). Adapter passes (lora_sig) suffix only
+        the STEP chunks: prep and decode never see the lora operand, so
+        adapter and plain passes share those compiled programs."""
         prep_fn, make_steps, decode_fn, (lo, hi) = self._denoise_parts(
             key, controlnet_module, mesh=mesh)
         lengths: list[int] = []
@@ -1234,9 +1455,10 @@ class SDPipeline:
             lengths.append(min(chunk, hi - pos))
             pos += lengths[-1]
         gkey = self._geo_key(key, geo)
+        skey = self._sig_key(gkey, lora_sig)
         prep_prog = self._program((gkey, "prep"), lambda: prep_fn)
         chunk_progs = {
-            n: self._program((gkey, "chunk", n), lambda n=n: make_steps(n))
+            n: self._program((skey, "chunk", n), lambda n=n: make_steps(n))
             for n in set(lengths)
         }
         decode_prog = self._program((gkey, "decode"), lambda: decode_fn)
@@ -1259,7 +1481,8 @@ class SDPipeline:
         # applies directly to bare arrays (latents, context, rng keys)
         return tuple(jax.tree_util.tree_map(place, op) for op in operands)
 
-    def _denoise_runner(self, key, controlnet_module=None, geo=None):
+    def _denoise_runner(self, key, controlnet_module=None, geo=None,
+                        lora_sig=None):
         """Resolve the execution strategy for one bucket. Returns
         ``runner(*program_args, cancel_probe=None, reshard_probe=None)
         -> uint8 pixels``.
@@ -1286,7 +1509,7 @@ class SDPipeline:
         sharded->replicated (or back) mid-denoise when the queue shifts."""
         chunk = self._denoise_chunk_steps()
         geo = self.default_geometry if geo is None else geo
-        cache_key = (key, chunk, geo)
+        cache_key = (key, chunk, geo, lora_sig)
         with self._jit_lock:
             cached = self._runner_cache.get(cache_key)
         if cached is not None:
@@ -1294,7 +1517,8 @@ class SDPipeline:
         mesh, _ = self._geometry_view(geo)
         if chunk <= 0:
             program = self._denoise_program(
-                key, controlnet_module, geo=geo, mesh=mesh)
+                key, controlnet_module, geo=geo, mesh=mesh,
+                lora_sig=lora_sig)
 
             def runner(*args, cancel_probe=None, reshard_probe=None):
                 # no chunk seams: a fused pass cannot re-shard mid-flight
@@ -1303,11 +1527,12 @@ class SDPipeline:
                 return program(*args)
         else:
             prep_prog, chunk_progs, decode_prog, lengths, lo = \
-                self._chunk_programs(key, controlnet_module, geo, mesh, chunk)
+                self._chunk_programs(key, controlnet_module, geo, mesh,
+                                     chunk, lora_sig=lora_sig)
 
             def runner(params, init_rng, context, added, guidance_scale,
                        image_guidance, image_latents, mask, rng,
-                       cn_params, control_cond, cn_scale,
+                       cn_params, control_cond, cn_scale, lora,
                        cancel_probe=None, reshard_probe=None):
                 # Each boundary BLOCKS on the previous chunk before
                 # probing. This sync is load-bearing, not optional: jax
@@ -1356,7 +1581,8 @@ class SDPipeline:
                                     _, cur_chunks, cur_decode, _, _ = \
                                         self._chunk_programs(
                                             key, controlnet_module, target,
-                                            cur_mesh, chunk)
+                                            cur_mesh, chunk,
+                                            lora_sig=lora_sig)
                                 compile_s = time.perf_counter() - t0
                                 (latents, state, context, added,
                                  image_latents, mask, rng, cn_params,
@@ -1377,7 +1603,7 @@ class SDPipeline:
                             params, latents, state, context, added,
                             guidance_scale, image_guidance, image_latents,
                             mask, rng, cn_params, control_cond, cn_scale,
-                            jnp.int32(at))
+                            lora, jnp.int32(at))
                     at += n
                 if cancel_probe is not None:
                     jax.block_until_ready(latents)
@@ -1494,11 +1720,24 @@ class SDPipeline:
         # (swarm/job_arguments.py lora path) or a direct lora_scale
         xattn_kwargs = kwargs.pop("cross_attention_kwargs", {}) or {}
         lora_scale = float(kwargs.pop("lora_scale", xattn_kwargs.get("scale", 1.0)))
-        job_params = (
-            base_params
-            if lora is None
-            else self._lora_params(base_params, lora, lora_scale)
-        )
+        kwargs.pop("lora_rank", None)  # advisory coalesce-key hint only
+        # adapter routing (ISSUE 13): runtime per-row delta against the
+        # ONE resident base tree whenever the adapter is delta-eligible;
+        # merged-tree copy only as the fallback. lora_mode feeds the
+        # swarm_lora_rows_total counter + the envelope.
+        lora_operands, lora_sig, delta_factors = None, None, None
+        lora_mode = "none"
+        job_params = base_params
+        if lora is not None:
+            delta_factors = self._adapter_delta_factors(lora)
+            if delta_factors is not None:
+                # operands are stacked per ROW further down, once the
+                # final row count is known (a list of start images
+                # rewrites num_images_per_prompt)
+                lora_mode = "delta"
+            else:
+                job_params = self._lora_params(base_params, lora, lora_scale)
+                lora_mode = "merged"
 
         # per-job conditioning/decoding add-ons (reference
         # diffusion_func.py:46-49 custom VAE, :105-111 textual inversion)
@@ -1663,13 +1902,20 @@ class SDPipeline:
                 max(int(np.ceil(cg_end * steps)), int(cg_start * steps) + 1),
             )
 
+        # --- per-row adapter operand (ISSUE 13), stacked at the FINAL
+        # row count: every row of this job carries slot 1 ---
+        if delta_factors is not None:
+            lora_operands, lora_sig = self._lora_operands(
+                [delta_factors], [1] * n_images, [lora_scale] * n_images)
+
         # --- pick the pass's mesh view (ISSUE 12): sharded geometry only
         # for passes on the resident base params — LoRA-merged / custom
         # trees and ControlNet branches live on the default mesh, and a
         # geometry request for them degrades to the classic pass ---
         geo = self.resolve_geometry(geometry)
         if geo != self.default_geometry and (
-                job_params is not base_params or controlnet_module is not None):
+                job_params is not base_params or controlnet_module is not None
+                or lora_operands is not None):
             logger.info(
                 "geometry %s refused for a pass with job-specific params; "
                 "serving the default view", geo)
@@ -1704,7 +1950,8 @@ class SDPipeline:
         # tells the two apart in aggregate). With denoise_chunk_steps>0
         # the runner resolves the whole chunked program set here.
         with Span("compile", timings, key="trace_s"):
-            runner = self._denoise_runner(key, controlnet_module, geo=geo)
+            runner = self._denoise_runner(
+                key, controlnet_module, geo=geo, lora_sig=lora_sig)
 
         # long-sequence self-attention shards over the mesh seq axis (ring
         # attention) when this pass's mesh view carved one out; trace-time
@@ -1716,7 +1963,7 @@ class SDPipeline:
         # included (its branch params never get geometry placement, so a
         # probe migrating a ControlNet pass onto a sharded mesh would
         # run the exact combination the initial gate refuses)
-        if controlnet_module is not None or (
+        if controlnet_module is not None or lora_operands is not None or (
                 job_params is not base_params
                 and job_params is not geo_params):
             reshard_probe = None
@@ -1736,6 +1983,9 @@ class SDPipeline:
                     cn_params,
                     control_cond,
                     jnp.float32(cn_scale),
+                    # stacked per-row adapter factors (ISSUE 13); the
+                    # empty dict traces to the identical adapter-free HLO
+                    lora_operands or {},
                     # a hive-revoked job aborts at the next chunk
                     # boundary (JobCancelled propagates to the worker,
                     # which frees the slice and produces no envelope)
@@ -1764,6 +2014,9 @@ class SDPipeline:
             pass_geometry["tensor"], pass_geometry["seq"]))
         if self.chipset is not None:
             self.chipset.note_geometry(**pass_geometry)
+        from .lora_runtime import LORA_ROWS
+
+        LORA_ROWS.inc(n_images, mode=lora_mode)
 
         images = _to_pil(np.asarray(pixels))
 
@@ -1873,6 +2126,10 @@ class SDPipeline:
                 / 1e12,
                 4,
             ),
+            # adapter execution path (ISSUE 13): "delta" = runtime
+            # per-row low-rank delta on the resident base tree,
+            # "merged" = full merged-tree fallback copy
+            **({"lora_mode": lora_mode} if lora is not None else {}),
             # per-pass prompt-embedding cache stats (tenant accounting:
             # the hive attributes these hits to the job's submitter)
             **({"embed_cache": {
@@ -1898,14 +2155,21 @@ class SDPipeline:
                     scheduler_type: str = "DPMSolverMultistepScheduler",
                     use_karras_sigmas: bool = False,
                     pipeline_type: str = "DiffusionPipeline",
-                    strength: float = 0.75):
+                    strength: float = 0.75,
+                    controlnet_model_name: str | None = None,
+                    control_image=None,
+                    controlnet_conditioning_scale: float = 1.0,
+                    control_guidance_start: float = 0.0,
+                    control_guidance_end: float = 1.0,
+                    lora_slots_max: int | None = None):
         """Coalesced txt2img/img2img: N independent requests, ONE padded
         jitted denoise+decode invocation (batching.py design).
 
         requests: [{"prompt", "negative_prompt", "rng",
-        "num_images_per_prompt", "image"?}] — everything that must match
-        across the batch (model, canvas, steps, scheduler, guidance,
-        img2img strength) arrives as shared keyword arguments; the caller
+        "num_images_per_prompt", "image"?, "lora"?, "lora_scale"?}] —
+        everything that must match across the batch (model, canvas,
+        steps, scheduler, guidance, img2img strength, shared ControlNet)
+        arrives as shared keyword arguments; the caller
         (workflows/diffusion.diffusion_batched_callback) groups by
         batching.coalesce_key so that invariant holds. When requests
         carry start images (img2img), EVERY request must: each image is
@@ -1913,6 +2177,19 @@ class SDPipeline:
         of init latents ("batched_i2i" program variant), so each row
         denoises from ITS OWN image's noised latents — padding rows get
         zero latents and are discarded after decode.
+
+        Adapters ride PER ROW (ISSUE 13): a request's resolved `lora`
+        reference becomes a slot in the stacked low-rank factors the
+        jitted program applies as runtime deltas — mixed-adapter (and
+        adapter-free) requests share one pass with no param-tree copy.
+        An adapter the delta path cannot express raises ValueError, so
+        the worker's solo fallback serves the group via the merged path.
+
+        A shared ControlNet (ISSUE 13 second rung) arrives as
+        `controlnet_model_name` + ONE `control_image` common to the
+        whole group (coalesce_key guarantees identity): the control
+        residuals are computed once per group per step instead of once
+        per job.
 
         Returns [(images_j, pipeline_config_j)] aligned with requests.
         Every row's noise derives only from its own request's rng (the
@@ -1968,6 +2245,64 @@ class SDPipeline:
         total = sum(counts)
         padded = pad_bucket(total)
         pad_rows = padded - total
+
+        # --- per-row adapters (ISSUE 13): distinct adapters become slots
+        # in one stacked factor operand; rows map to their slot (0 = the
+        # zero adapter for adapter-free rows and padding). This block
+        # runs BEFORE the row counters: its refusals (deltas disabled,
+        # ineligible adapters, slots-cap overflow) re-route members to
+        # other paths, which must not read as batched rows — the
+        # DeltaIneligible re-batch would double-count its survivors ---
+        lora_operands, lora_sig = None, None
+        row_modes: list[str] = []
+        if any(r.get("lora") for r in requests):
+            from .. import lora_cache
+            from .lora_runtime import DeltaIneligibleError
+
+            self._require_runtime_delta()
+            slots_cap = self._adapter_slots_cap(lora_slots_max)
+            # surface ALL delta-ineligible members in one typed refusal,
+            # so the worker re-batches the eligible majority instead of
+            # serializing the whole group behind one conv/over-rank
+            # adapter
+            factors_of, _distinct, ineligible = \
+                self._scan_adapter_specs(requests)
+            if ineligible:
+                raise DeltaIneligibleError(ineligible)
+            slot_of: dict[tuple, int] = {}
+            adapters: list[dict] = []
+            row_slots: list[int] = []
+            row_gains: list[float] = []
+            for r, n in zip(requests, counts):
+                lora = r.get("lora")
+                if not lora:
+                    slot, gain = 0, 0.0
+                    row_modes.append("none")
+                else:
+                    akey = lora_cache.adapter_key(lora)
+                    slot = slot_of.get(akey)
+                    if slot is None:
+                        factors = factors_of[akey]
+                        if len(adapters) >= slots_cap:
+                            # the grouping layers cap distinct adapters
+                            # per gang; a group past the cap fell through
+                            # an estimate — solo fallback, never OOM
+                            raise ValueError(
+                                f"group carries more than {slots_cap} "
+                                "distinct adapters; serving members "
+                                "individually")
+                        adapters.append(factors)
+                        slot = slot_of[akey] = len(adapters)
+                    gain = float(r.get("lora_scale", 1.0) or 0.0)
+                    row_modes.append("delta")
+                row_slots.extend([slot] * n)
+                row_gains.extend([gain] * n)
+            row_slots.extend([0] * pad_rows)
+            row_gains.extend([0.0] * pad_rows)
+            lora_operands, lora_sig = self._lora_operands(
+                adapters, row_slots, row_gains)
+        else:
+            row_modes = ["none"] * len(requests)
 
         _BATCH_ROWS.inc(total, kind="real")
         if pad_rows:
@@ -2039,6 +2374,28 @@ class SDPipeline:
             row_index.extend([len(start_images)] * pad_rows)
             image_latents = uniq_latents[jnp.asarray(row_index)]
 
+        # --- shared ControlNet (ISSUE 13 second rung): one control image
+        # conditions the whole group, so the branch's residuals are
+        # computed once per group per step instead of once per job ---
+        controlnet_module, cn_params, cn_key = None, {}, None
+        cn_scale = float(controlnet_conditioning_scale)
+        if controlnet_model_name:
+            if control_image is None:
+                raise ValueError(
+                    "Controlnet specified but no control image provided")
+            controlnet_module, cn_params = self._get_controlnet(
+                controlnet_model_name)
+            cond = (_pil_to_array(control_image, width, height) + 1.0) / 2.0
+            control_cond = jnp.broadcast_to(
+                jnp.asarray(cond)[None], (padded, height, width, 3))
+            cg_lo = int(float(control_guidance_start) * steps)
+            cn_key = (
+                controlnet_model_name,
+                cg_lo,
+                max(int(np.ceil(float(control_guidance_end) * steps)),
+                    cg_lo + 1),
+            )
+
         context, image_latents, mask, control_cond = map(
             self._place_batch, (context, image_latents, mask, control_cond)
         )
@@ -2051,9 +2408,10 @@ class SDPipeline:
         )
         sched_key = (scheduler_type, tuple(sorted(dataclass_items(sched_cfg))))
         key = ("batched_i2i" if i2i else "batched",
-               lh, lw, padded, steps, sched_key, t_start, None)
+               lh, lw, padded, steps, sched_key, t_start, cn_key)
         with Span("compile", timings, key="trace_s"):
-            runner = self._denoise_runner(key)
+            runner = self._denoise_runner(
+                key, controlnet_module, lora_sig=lora_sig)
         # coalesced passes ALWAYS run the default data-parallel view:
         # throughput traffic keeps the coalescing geometry while
         # interactive solos may shard (the class-aware split, ISSUE 12).
@@ -2105,9 +2463,10 @@ class SDPipeline:
                     image_latents,
                     mask,
                     step_rng,
-                    {},
+                    cn_params,
                     control_cond,
-                    jnp.float32(1.0),
+                    jnp.float32(cn_scale),
+                    lora_operands or {},
                     cancel_probe=probe,
                 )
             pixels = jax.block_until_ready(pixels)
@@ -2115,6 +2474,10 @@ class SDPipeline:
             pass_geometry["tensor"], pass_geometry["seq"]))
         if self.chipset is not None:
             self.chipset.note_geometry(**pass_geometry)
+        from .lora_runtime import LORA_ROWS
+
+        for mode, n in zip(row_modes, counts):
+            LORA_ROWS.inc(n, mode=mode)
 
         groups = split_by_counts(_to_pil(np.asarray(pixels)), counts)
 
@@ -2130,11 +2493,15 @@ class SDPipeline:
                 "model": self.model_name,
                 "pipeline": pipeline_type,
                 "scheduler": scheduler_type,
-                "controlnet": None,
+                "controlnet": controlnet_model_name,
                 "mode": "img2img" if i2i else "txt2img",
                 "steps": steps,
                 "size": [width, height],
                 "guidance_scale": guidance_scale,
+                # adapter rows in this pass ran as runtime per-row
+                # deltas (ISSUE 13); adapter-free rows stamp nothing
+                **({"lora_mode": "delta"} if row_modes[row] == "delta"
+                   else {}),
                 **({"strength": clamp_strength(strength)} if i2i else {}),
                 "batched_with": len(requests),
                 "batch_rows": [offset, n],

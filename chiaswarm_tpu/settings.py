@@ -79,6 +79,27 @@ class Settings:
     # byte cap in MiB for encoded (model, prompt-text) rows, so gang
     # members and repeat prompts skip text_encode entirely; 0 disables
     embed_cache_mb: int = 64
+    # --- multi-tenant add-on serving (ISSUE 13, pipelines/lora_runtime) ---
+    # apply LoRA adapters as RUNTIME per-row low-rank deltas inside the
+    # jitted program (one resident base UNet, adapters as stacked
+    # factors) instead of merging each adapter into a full param-tree
+    # copy. Off restores the merged-tree path everywhere (and makes
+    # adapter jobs uncoalesceable again) — the A/B knob the lora_coalesce
+    # bench flips for its solo-merged baseline
+    lora_runtime_delta: bool = True
+    # byte cap (MiB) for the process-wide raw adapter-factor LRU
+    # (lora_cache.py); 0 disables caching (adapters reload per pass)
+    lora_cache_mb: int = 256
+    # most DISTINCT adapters one coalesced group/gang may carry. Shared
+    # vocabulary: the hive's gang dispatcher, the worker's batch
+    # scheduler, and run_batched all cap on it. The compiled slot
+    # dimension is pow2(cap + 1) — one implicit zero slot for
+    # adapter-free rows, padded to a power of two — so a FULL gang at
+    # the default 8 compiles a 16-slot stack; set 7 to stay at 8 slots
+    lora_slots_max: int = 8
+    # adapters with rank beyond this serve via the merged-tree fallback
+    # (their padded factor stacks would rival the activations they ride)
+    lora_rank_max: int = 128
     # chunked denoise (pipelines/stable_diffusion.py): run the compiled
     # denoise loop in chunks of this many steps, probing the cancel
     # registry (cancel.py) at every chunk boundary so a cancelled job
@@ -283,6 +304,10 @@ _ENV_OVERRIDES = {
     "CHIASWARM_HIVE_MAX_JOBS_PER_POLL": "hive_max_jobs_per_poll",
     "CHIASWARM_HIVE_GANG_MAX": "hive_gang_max",
     "CHIASWARM_EMBED_CACHE_MB": "embed_cache_mb",
+    "CHIASWARM_LORA_RUNTIME_DELTA": "lora_runtime_delta",
+    "CHIASWARM_LORA_CACHE_MB": "lora_cache_mb",
+    "CHIASWARM_LORA_SLOTS_MAX": "lora_slots_max",
+    "CHIASWARM_LORA_RANK_MAX": "lora_rank_max",
     "CHIASWARM_DENOISE_CHUNK_STEPS": "denoise_chunk_steps",
     "CHIASWARM_SHARD_INTERACTIVE": "shard_interactive",
     "CHIASWARM_SHARD_TENSOR": "shard_tensor",

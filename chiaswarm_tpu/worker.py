@@ -207,6 +207,9 @@ class Worker:
             # interactive preemption probe: other lingering groups flush
             # when an interactive dispatch finds slices contended
             free_slices=lambda: self.allocator.free_count,
+            # distinct-adapter cap per coalesced group (ISSUE 13) — the
+            # stacked-factor slot dimension run_batched enforces
+            lora_slots=int(getattr(self.settings, "lora_slots_max", 8) or 8),
         )
         # a slice returning to the free pool re-runs the placement match,
         # so a board entry blocked on "no slice free" dispatches the
@@ -1135,7 +1138,12 @@ class Worker:
         """One coalesced pass for a compatible group; on ANY failure, fall
         back to the single-job path per member — which reproduces the
         error with the existing fatal/transient attribution, so batching
-        never changes what the hive sees beyond latency."""
+        never changes what the hive sees beyond latency. The one typed
+        exception is DeltaIneligibleError: a member whose adapter the
+        runtime delta cannot express (conv/LoCon, over-rank) goes solo
+        through the merged-tree path while its batchmates RE-BATCH —
+        one slow adapter must not serialize the whole gang."""
+        from .pipelines.lora_runtime import DeltaIneligibleError
         from .workflows.diffusion import diffusion_batched_callback
 
         # pristine copies for the fallback: the batched path pops/injects
@@ -1171,6 +1179,27 @@ class Worker:
             logger.warning("coalesced pass aborted by cancellation: %s",
                            e.job_ids)
             return [None] * len(ids)
+        except DeltaIneligibleError as e:
+            bad = set(e.job_ids)
+            eligible = [(fn, dict(kw)) for fn, kw in singles
+                        if kw.get("id") not in bad]
+            if not (bad & set(ids)) or len(eligible) < 2:
+                # no per-member identity or nothing left worth
+                # re-batching: classic whole-group solo fallback
+                logger.info("coalesced pass for %s: %s", ids, e)
+                return [self.synchronous_do_work(chipset, fn, dict(kw))
+                        for fn, kw in singles]
+            logger.info(
+                "coalesced pass for %s: members %s are not delta-eligible; "
+                "re-batching the %d eligible member(s)",
+                ids, sorted(bad), len(eligible))
+            by_id = dict(zip([kw.get("id") for _, kw in eligible],
+                             self.synchronous_do_batch(chipset, eligible)))
+            for fn, kw in singles:
+                if kw.get("id") in bad:
+                    by_id[kw.get("id")] = self.synchronous_do_work(
+                        chipset, fn, dict(kw))
+            return [by_id[i] for i in ids]
         except Exception as e:
             logger.exception(
                 "coalesced pass for %s failed; retrying jobs individually", ids

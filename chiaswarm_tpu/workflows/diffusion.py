@@ -143,20 +143,38 @@ def diffusion_batched_callback(device_identifier: str, requests: list[dict]):
         model_name = _tiny_stand_in(model_name)
     pipeline_type = shared.get("pipeline_type", "DiffusionPipeline")
     chipset = shared.get("chipset")
+    # shared ControlNet (ISSUE 13 second rung): coalesce_key guarantees
+    # every member carries the IDENTICAL branch + control image, so the
+    # group conditions on ONE image — for txt2img-ControlNet wire names
+    # the formatter delivered it as `image`, which therefore must not be
+    # mistaken for an img2img start image
+    cn_name = shared.get("controlnet_model_name")
+    control_image = None
+    if cn_name:
+        control_image = (shared.get("control_image")
+                         if shared.get("control_image") is not None
+                         else shared.get("image"))
     # None flows through to run_batched, which defaults to the pipeline's
     # own default_size (or, for img2img, the shared start-image canvas) —
     # the same resolution the single path's run() does; the canvas below
     # is only the capacity gate's estimate
     height = shared.get("height")
     width = shared.get("width")
-    i2i = shared.get("image") is not None
-    if (height is None or width is None) and i2i:
+    i2i = shared.get("image") is not None and not cn_name
+    if (height is None or width is None) and cn_name \
+            and control_image is not None:
+        est_w, est_h = control_image.size
+    elif (height is None or width is None) and i2i:
         # img2img formatting pops height/width after resizing every start
         # image to the shared explicit canvas — read it back off the image
         est_w, est_h = shared["image"].size
     else:
         est_h = int(height or default_canvas(model_name))
         est_w = int(width or est_h)
+    if cn_name and (height is None or width is None):
+        # solo ControlNet passes size the canvas to the control image;
+        # the shared group must reproduce that, not the family default
+        height, width = est_h, est_w
     steps = int(shared.get("num_inference_steps", 30))
     guidance = float(shared.get("guidance_scale", 7.5))
     scheduler_type = shared.get("scheduler_type", "DPMSolverMultistepScheduler")
@@ -177,12 +195,20 @@ def diffusion_batched_callback(device_identifier: str, requests: list[dict]):
         })
         n = max(int(r.get("num_images_per_prompt", 1) or 1), 1)
         counts.append(n)
+        xattn = r.get("cross_attention_kwargs") or {}
         row_specs.append({
             "prompt": r.get("prompt", ""),
             "negative_prompt": r.get("negative_prompt", ""),
             "rng": r.get("rng"),
             "num_images_per_prompt": n,
-            "image": r.get("image"),
+            "image": None if cn_name else r.get("image"),
+            # per-row adapter (ISSUE 13): the resolved reference becomes
+            # a slot in the batched program's stacked low-rank factors;
+            # scale rides per row like the reference's
+            # cross_attention_kwargs.scale
+            "lora": r.get("lora"),
+            "lora_scale": float(r.get("lora_scale",
+                                      xattn.get("scale", 1.0)) or 0.0),
             # per-row cancel token key (ISSUE 10): run_batched probes the
             # cancel registry for this id at denoise chunk boundaries
             "job_id": r.get("id"),
@@ -208,8 +234,29 @@ def diffusion_batched_callback(device_identifier: str, requests: list[dict]):
     pipeline = get_pipeline(
         model_name, pipeline_type=pipeline_type, chipset=chipset
     )
+    cn_kwargs = {}
+    if cn_name:
+        cn_kwargs = {
+            "controlnet_model_name": cn_name,
+            "control_image": control_image,
+            "controlnet_conditioning_scale": float(
+                shared.get("controlnet_conditioning_scale", 1.0)),
+            "control_guidance_start": float(
+                shared.get("control_guidance_start", 0.0)),
+            "control_guidance_end": float(
+                shared.get("control_guidance_end", 1.0)),
+        }
     results = []
-    for start, end in chunk_by_rows(counts, max_rows):
+    chunks = list(chunk_by_rows(counts, max_rows))
+    if len(chunks) > 1:
+        # a group split across passes surfaces every adapter refusal
+        # up front: a LATER chunk's refusal would discard earlier
+        # chunks' finished denoise work and re-count their row metrics
+        # on the worker's re-batch
+        prescan = getattr(pipeline, "prescan_adapter_chunks", None)
+        if prescan is not None:
+            prescan([row_specs[s:e] for s, e in chunks])
+    for start, end in chunks:
         results.extend(pipeline.run_batched(
             row_specs[start:end],
             height=height,
@@ -220,10 +267,16 @@ def diffusion_batched_callback(device_identifier: str, requests: list[dict]):
             use_karras_sigmas=karras,
             pipeline_type=pipeline_type,
             strength=strength,
+            **cn_kwargs,
         ))
 
     out = []
     for i, ((images, pipeline_config), env) in enumerate(zip(results, envelopes)):
+        # classical-CV annotator stand-ins surface per envelope exactly
+        # like the solo path (the conditioning image is an approximation)
+        if requests[i].get("degraded_preprocessors"):
+            pipeline_config["degraded_preprocessors"] = \
+                requests[i]["degraded_preprocessors"]
         if pipeline_config.get("cancelled"):
             # hive-revoked mid-denoise: no safety pass, no packaging —
             # the worker drops this slot (no envelope is ever delivered)

@@ -107,6 +107,17 @@ def _module_uses_torch(path: str) -> bool:
         return False
 
 
+# Modules whose tests spawn whole child processes (bench rows, chaos
+# scenarios: each a fresh interpreter + jax compile set). On a small
+# CI box these dominate the suite's wall clock; they sort AFTER the
+# in-process tests (same rationale as the torch ordering below: bank
+# the hundreds of cheap results first, so an external timeout chops the
+# expensive integration tail rather than the unit tests that happen to
+# sort after "bench" alphabetically). They still run exactly once, and
+# still before the torch group — a torch segfault must not eat them.
+_SUBPROCESS_HEAVY_MODULES = {"test_bench", "test_chaos_smoke"}
+
+
 def pytest_collection_modifyitems(config, items):
     for item in items:
         name = item.module.__name__.rsplit(".", 1)[-1]
@@ -125,8 +136,13 @@ def pytest_collection_modifyitems(config, items):
     # first at-risk forward; the torch modules themselves all pass when
     # run standalone. Stable sort: alphabetical order is preserved within
     # each group, and every test still runs exactly once.
-    items.sort(key=lambda item: 1 if _module_uses_torch(str(item.fspath))
-               else 0)
+    def _order(item):
+        if _module_uses_torch(str(item.fspath)):
+            return 2
+        name = item.module.__name__.rsplit(".", 1)[-1]
+        return 1 if name in _SUBPROCESS_HEAVY_MODULES else 0
+
+    items.sort(key=_order)
 
 
 @pytest.fixture()

@@ -16,7 +16,7 @@ import uuid
 
 from aiohttp import web
 
-from chiaswarm_tpu.coalesce import coalesce_key, job_rows
+from chiaswarm_tpu.coalesce import adapter_ref, coalesce_key, job_rows
 from chiaswarm_tpu.hive_server import accounting
 from chiaswarm_tpu.hive_server.slo import SLOEngine, parse_slo
 
@@ -92,6 +92,9 @@ class FakeHive:
         # advertising no gang_rows (or 1) never sees a gang, exactly
         # like the real dispatcher.
         self.gang_max: int = 8
+        # distinct-adapter cap per gang (ISSUE 13), mirroring
+        # the real dispatcher's Settings.lora_slots_max
+        self.lora_slots_max: int = 8
         # cancellation parity (ISSUE 10): POST /api/jobs/{id}/cancel
         # tombstones a pending job or queues a dispatched one's id for
         # the next /work reply's `cancels` piggyback; a result for a
@@ -346,28 +349,38 @@ class FakeHive:
                      gang_rows: int) -> list[list[dict]]:
         """Partition one reply's jobs into gangs: compatible same-key
         jobs group (arrival order preserved), chunked to the smaller of
-        `gang_max` jobs and `gang_rows` image rows; everything else is
-        a singleton group."""
+        `gang_max` jobs and `gang_rows` image rows — and, for adapter
+        jobs (ISSUE 13), at most `lora_slots_max` DISTINCT adapters per
+        gang, the same cap the real dispatcher enforces; everything else
+        is a singleton group."""
         if gang_rows <= 1 or self.gang_max <= 1:
             return [[job] for job in jobs]
         groups: list[list[dict]] = []
         rows: list[int] = []
+        adapters: list[set] = []
         open_by_key: dict[tuple, int] = {}  # key -> index into groups
         for job in jobs:
             key = coalesce_key(job)
             if key is None:
                 groups.append([job])
                 rows.append(0)
+                adapters.append(set())
                 continue
             r = job_rows(job)
+            a = adapter_ref(job)
             idx = open_by_key.get(key)
             if (idx is not None and len(groups[idx]) < self.gang_max
-                    and rows[idx] + r <= gang_rows):
+                    and rows[idx] + r <= gang_rows
+                    and (a is None or a in adapters[idx]
+                         or len(adapters[idx]) < self.lora_slots_max)):
                 groups[idx].append(job)
                 rows[idx] += r
+                if a is not None:
+                    adapters[idx].add(a)
             else:
                 groups.append([job])
                 rows.append(r)
+                adapters.append({a} if a is not None else set())
                 open_by_key[key] = len(groups) - 1
         return groups
 

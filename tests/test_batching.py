@@ -52,9 +52,10 @@ def test_per_row_fields_stay_out_of_the_key():
     {"workflow": "echo"},
     {"start_image_uri": "http://x/i.png"},
     {"mask_image_uri": "http://x/m.png"},
-    {"lora": "some-lora"},
     {"refiner": {"model_name": "x"}},
     {"upscale": True},
+    # a ControlNet without a shareable control image (per-job start-image
+    # conditioning) stays on the single path
     {"parameters": {"controlnet": {"preprocessor": "canny"}}},
     {"parameters": {"pipeline_type": "StableDiffusionImg2ImgPipeline"}},
     # unknown passthrough parameters are per-job behavior we refuse to
@@ -65,6 +66,74 @@ def test_per_row_fields_stay_out_of_the_key():
 ])
 def test_unbatchable_jobs_key_to_none(variant):
     assert coalesce_key(job(**variant)) is None
+
+
+# --- ISSUE 13: adapter-aware coalescing ---
+
+
+def test_lora_jobs_coalesce_with_plain_jobs():
+    # adapter identity rides per row: a LoRA job shares the plain bucket
+    base = coalesce_key(job())
+    assert base is not None
+    assert coalesce_key(job(lora="style-a")) == base
+    assert coalesce_key(job(lora="style-b")) == base
+
+
+def test_runtime_delta_kill_switch_unbatches_adapter_jobs(monkeypatch):
+    # lora_runtime_delta=0 restores the pre-ISSUE-13 serving shape:
+    # adapter jobs go back to the single path (run_batched would refuse
+    # the group anyway), while plain jobs keep coalescing
+    monkeypatch.setenv("CHIASWARM_LORA_RUNTIME_DELTA", "0")
+    assert coalesce_key(job(lora="style-a")) is None
+    assert coalesce_key(job()) is not None
+    monkeypatch.setenv("CHIASWARM_LORA_RUNTIME_DELTA", "1")
+    assert coalesce_key(job(lora="style-a")) == coalesce_key(job())
+
+
+def test_declared_tiny_ranks_share_the_min_bucket():
+    # ranks at or below the padded minimum all run as the same rank-4
+    # program, so they must share one bucket (and one gang)
+    r1 = coalesce_key(job(lora="a", parameters={"lora_rank": 1}))
+    r4 = coalesce_key(job(lora="b", parameters={"lora_rank": 4}))
+    assert r1 is not None
+    assert r1 == r4
+
+
+def test_declared_rank_bucket_splits():
+    base = coalesce_key(job())
+    r16 = coalesce_key(job(lora="a", parameters={"lora_rank": 16}))
+    r9 = coalesce_key(job(lora="b", parameters={"lora_rank": 9}))
+    assert r16 is not None and r16 != base
+    assert r9 == r16  # 9 rounds up into the 16 bucket
+    assert coalesce_key(job(lora="c", parameters={"lora_rank": 4})) != r16
+
+
+def test_adapter_ref_spellings():
+    from chiaswarm_tpu.coalesce import adapter_ref
+
+    assert adapter_ref(job()) is None
+    assert adapter_ref(job(lora="style-a")) == "style-a"
+    resolved = adapter_ref(job(lora={"lora": "~/lora", "weight_name":
+                                     "style-a", "subfolder": None}))
+    assert "style-a" in resolved
+
+
+def test_shared_controlnet_jobs_coalesce():
+    cn = {"controlnet_model_name": "lllyasviel/sd-controlnet-canny",
+          "control_image_uri": "http://x/qr.png"}
+    a = coalesce_key(job(parameters={"controlnet": dict(cn)}))
+    b = coalesce_key(job(id="job-2", seed=9,
+                         parameters={"controlnet": dict(cn)}))
+    assert a is not None and a == b
+    # a different control image (or model) is a different bucket
+    other = coalesce_key(job(parameters={"controlnet": dict(
+        cn, control_image_uri="http://x/other.png")}))
+    assert other is not None and other != a
+    # and never the plain-txt2img bucket
+    assert a != coalesce_key(job())
+    # ControlNet + adapter stays on the single path
+    assert coalesce_key(job(lora="a",
+                            parameters={"controlnet": dict(cn)})) is None
 
 
 @pytest.mark.parametrize("variant", [

@@ -161,6 +161,22 @@ def test_bench_cpu_fallback_produces_labeled_smoke_row():
     assert out.get("batched_coalesce4_speedup", 0) > 1.0, out
     assert out.get("batched_slice_devices") == 4, out
 
+    # multi-tenant adapter serving row (ISSUE 13, 4-virtual-device slice
+    # child): 4 distinct adapters on one base model as ONE mixed-adapter
+    # coalesced pass — the acceptance bar is >= 2x the solo-merged
+    # baseline (measured ~4x), delta outputs matching the merged-tree
+    # goldens to the uint8 boundary, a warm factor cache, and the hive
+    # dispatcher ganging EVERY adapter job (gang_rate > 0 is the
+    # assertion; the scenario deterministically measures 1.0)
+    assert out.get("lora_coalesce_speedup", 0) >= 2.0, out
+    assert out.get("lora_coalesce_ganged_img_per_sec_per_chip", 0) > 0, out
+    assert out.get(
+        "lora_coalesce_solo_merged_img_per_sec_per_chip", 0) > 0, out
+    assert out.get("lora_delta_vs_merged_maxdiff", 99) <= 2, out
+    assert out.get("lora_cache_hit_rate", 0) > 0, out
+    assert out.get("lora_gang_rate", 0) > 0, out
+    assert out.get("lora_adapters") == 4, out
+
 
 @pytest.mark.parametrize("row", ["tiny", "sdxl", "flux"])
 def test_row_child_refuses_without_tpu(row):

@@ -221,6 +221,28 @@ def test_scheduler_cancel_drops_lingering_member():
     asyncio.run(scenario())
 
 
+def test_scheduler_cancel_releases_adapter_slot():
+    """ISSUE 13: cancelling the sole job carrying an adapter must free
+    its distinct-adapter slot, or the group flushes on reason "slots"
+    for adapters no surviving member carries."""
+    async def scenario():
+        sched = BatchScheduler(linger_s=60.0, max_coalesce=8, lora_slots=2)
+        a = dict(_txt2img("ad-1"), lora="s1.safetensors")
+        b = dict(_txt2img("ad-2"), lora="s2.safetensors")
+        await sched.put(a)
+        await sched.put(b)
+        [group] = sched._pending.values()
+        assert len(group["adapters"]) == 2
+        assert sched.cancel("ad-1") is True
+        assert len(group["adapters"]) == 1  # slot freed, not stale
+        # a THIRD distinct adapter now fits without a "slots" flush
+        await sched.put(dict(_txt2img("ad-3"), lora="s3.safetensors"))
+        assert sched.pending_jobs == 2
+        assert len(group["adapters"]) == 2
+
+    asyncio.run(scenario())
+
+
 def test_scheduler_cancel_empties_group_and_timer():
     async def scenario():
         sched = BatchScheduler(linger_s=60.0, max_coalesce=8)

@@ -147,6 +147,47 @@ def test_gang_rows_cap_counts_multi_image_jobs():
     assert handed[0][2]["size"] == 2
 
 
+def test_gang_caps_distinct_adapters_at_lora_slots():
+    """ISSUE 13: mixed-adapter jobs gang together, but at most
+    `lora_slots` DISTINCT adapters leave in one gang (the worker's
+    stacked-factor program has that many slots). Repeats of an adapter
+    already aboard — and adapter-free batchmates — still ride."""
+    directory = WorkerDirectory(ttl_s=45.0)
+    dispatcher = Dispatcher(directory, affinity_hold_s=0.0,
+                            max_jobs_per_poll=8, gang_max=8, lora_slots=2)
+    q = PriorityJobQueue()
+    adapters = ["style-a", "style-b", "style-a", "style-c", None]
+    for i, adapter in enumerate(adapters):
+        job = gang_job(i)
+        if adapter is not None:
+            job["lora"] = adapter
+        q.submit(job)
+    worker = observe(directory, "w1", gang_rows=8)
+    handed = dispatcher.select(worker, q)
+    gang_ids = [r.job_id for r, _, g in handed
+                if g is not None and g["id"] == handed[0][2]["id"]]
+    # g3 (third distinct adapter) stops the pull — stop-don't-skip keeps
+    # the class FIFO, so the adapter-free g4 behind it waits too
+    assert gang_ids == ["g0", "g1", "g2"]
+
+
+def test_adapter_jobs_gang_with_plain_jobs():
+    """Adapter identity is per-row data: a LoRA job and a plain job on
+    one base model share a key and leave as one gang."""
+    directory = WorkerDirectory(ttl_s=45.0)
+    dispatcher = Dispatcher(directory, affinity_hold_s=0.0,
+                            max_jobs_per_poll=8, gang_max=8)
+    q = PriorityJobQueue()
+    lora_job = gang_job(0)
+    lora_job["lora"] = "style-a"
+    q.submit(lora_job)
+    q.submit(gang_job(1))
+    worker = observe(directory, "w1", gang_rows=8)
+    handed = dispatcher.select(worker, q)
+    assert [r.job_id for r, _, _ in handed] == ["g0", "g1"]
+    assert all(g is not None and g["size"] == 2 for _, _, g in handed)
+
+
 def test_no_job_dispatched_twice_in_one_reply():
     """A gang member handed behind an earlier seed is still queue-live
     until app.py takes it AFTER select() returns — the peer pull must

@@ -327,6 +327,50 @@ def test_gang_reply_groups_compatible_jobs(backend_name):
     run_conformance(backend_name, scenario)
 
 
+def test_adapter_jobs_gang_with_mixed_adapters(backend_name):
+    """ISSUE 13: jobs carrying DIFFERENT `lora` adapters (and an
+    adapter-free batchmate) on one base model leave as ONE gang — the
+    extended coalesce key admits adapters, identity rides per job on
+    the wire. Pinned across all three backends so fake_hive cannot
+    drift from the adapter-aware grouping."""
+
+    async def scenario(backend, client):
+        backend.queue_job(dict(gang_job(0), lora="style-a"))
+        backend.queue_job(dict(gang_job(1), lora="style-b"))
+        backend.queue_job(gang_job(2))  # adapter-free batchmate
+        jobs = await client.ask_for_work(dict(CAPS, gang_rows=8))
+        assert [j["id"] for j in jobs] == [f"conf-gang-{i}" for i in range(3)]
+        gangs = [j["trace"]["gang"] for j in jobs]
+        assert len({g["id"] for g in gangs}) == 1
+        assert all(g["size"] == 3 for g in gangs)
+        # each member keeps its OWN adapter reference on the wire —
+        # adapter identity is per-row data, never merged into the gang
+        assert [j.get("lora") for j in jobs] == ["style-a", "style-b", None]
+
+    run_conformance(backend_name, scenario)
+
+
+def test_declared_rank_bucket_splits_the_gang(backend_name):
+    """ISSUE 13: a job declaring an incompatible `lora_rank` keys to a
+    different rank bucket and must NOT ride the same gang (the gang's
+    stacked factors share one padded rank)."""
+
+    async def scenario(backend, client):
+        backend.queue_job(dict(gang_job(0), lora="style-a"))
+        ranked = dict(gang_job(1), lora="style-b")
+        ranked["parameters"] = dict(ranked["parameters"], lora_rank=64)
+        backend.queue_job(ranked)
+        jobs = await client.ask_for_work(dict(CAPS, gang_rows=8))
+        assert len(jobs) == 2
+        by_id = {j["id"]: j for j in jobs}
+        g0 = by_id["conf-gang-0"]["trace"].get("gang")
+        g1 = by_id["conf-gang-1"]["trace"].get("gang")
+        # two different buckets: either solo dispatches or distinct gangs
+        assert g0 is None or g1 is None or g0["id"] != g1["id"]
+
+    run_conformance(backend_name, scenario)
+
+
 def test_no_gang_without_worker_gang_rows(backend_name):
     """A worker that does not advertise `gang_rows` keeps the pre-gang
     contract: jobs may still arrive in one reply, but never marked as a

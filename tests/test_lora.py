@@ -79,6 +79,8 @@ def test_unmatched_modules_skipped():
 
 
 def test_job_with_lora_changes_output(tmp_path):
+    from chiaswarm_tpu import lora_cache
+
     pipe = SDPipeline("test/tiny-sd")
     q_kernel = np.asarray(
         pipe.params["unet"]["down_blocks_0"]["attentions_0"][
@@ -89,17 +91,52 @@ def test_job_with_lora_changes_output(tmp_path):
     lora_file = tmp_path / "adapter.safetensors"
     save_file(state, str(lora_file))
 
-    kw = dict(prompt="with lora", height=64, width=64, num_inference_steps=2,
-              rng=jax.random.key(4))
-    base = np.asarray(pipe.run(**kw)[0][0])
-    lored = np.asarray(
-        pipe.run(lora={"lora": str(lora_file)}, lora_scale=1.0, **kw)[0][0]
+    lora_cache.configure(64 * 1024 * 1024)
+    try:
+        kw = dict(prompt="with lora", height=64, width=64,
+                  num_inference_steps=2, rng=jax.random.key(4))
+        base = np.asarray(pipe.run(**kw)[0][0])
+        images, cfg = pipe.run(
+            lora={"lora": str(lora_file)}, lora_scale=1.0, **kw)
+        lored = np.asarray(images[0])
+        assert not np.array_equal(base, lored)
+        # ISSUE 13 serving path: runtime per-row delta on the resident
+        # base tree — NO merged param-tree copy, factors cached once
+        assert cfg["lora_mode"] == "delta"
+        assert len(pipe._lora_cache) == 0
+        assert len(lora_cache.get_cache()) == 1
+        pipe.run(lora={"lora": str(lora_file)}, lora_scale=1.0, **kw)
+        assert len(lora_cache.get_cache()) == 1
+    finally:
+        lora_cache.reset()
+
+
+def test_merged_fallback_when_runtime_delta_disabled(tmp_path, monkeypatch):
+    from chiaswarm_tpu import lora_cache
+
+    pipe = SDPipeline("test/tiny-sd")
+    q_kernel = np.asarray(
+        pipe.params["unet"]["down_blocks_0"]["attentions_0"][
+            "transformer_blocks_0"]["attn1"]["to_q"]["kernel"]
     )
-    assert not np.array_equal(base, lored)
-    # cached merge reused
-    assert len(pipe._lora_cache) == 1
-    pipe.run(lora={"lora": str(lora_file)}, lora_scale=1.0, **kw)
-    assert len(pipe._lora_cache) == 1
+    state, _, _ = _lora_state("diffusers", rank=2, dim=q_kernel.shape[0])
+    lora_file = tmp_path / "adapter.safetensors"
+    save_file(state, str(lora_file))
+
+    monkeypatch.setenv("CHIASWARM_LORA_RUNTIME_DELTA", "0")
+    lora_cache.configure(64 * 1024 * 1024)
+    try:
+        kw = dict(prompt="with lora", height=64, width=64,
+                  num_inference_steps=2, rng=jax.random.key(4))
+        images, cfg = pipe.run(
+            lora={"lora": str(lora_file)}, lora_scale=1.0, **kw)
+        assert cfg["lora_mode"] == "merged"
+        # the merged tree is cached (tiny LRU) and reused
+        assert len(pipe._lora_cache) == 1
+        pipe.run(lora={"lora": str(lora_file)}, lora_scale=1.0, **kw)
+        assert len(pipe._lora_cache) == 1
+    finally:
+        lora_cache.reset()
 
 
 def test_missing_lora_is_fatal_value_error():

@@ -1,0 +1,424 @@
+"""ISSUE 13: runtime per-row LoRA deltas — golden equivalence vs the
+merged-tree path, the byte-capped factor cache, adapter-aware grouping,
+residency events, and the shared-ControlNet batched rung."""
+
+import asyncio
+
+import numpy as np
+import pytest
+from PIL import Image
+from safetensors.numpy import save_file
+
+import jax
+
+from chiaswarm_tpu import lora_cache
+from chiaswarm_tpu.pipelines.stable_diffusion import SDPipeline
+
+pytestmark = pytest.mark.usefixtures("sdaas_root")
+
+
+@pytest.fixture()
+def factor_cache():
+    cache = lora_cache.configure(64 * 1024 * 1024)
+    yield cache
+    lora_cache.reset()
+
+
+@pytest.fixture(scope="module")
+def tiny_pipe():
+    return SDPipeline("test/tiny-sd")
+
+
+def _write_adapter(path, dim, rank=2, seed=0, extra_conv=False):
+    rng = np.random.default_rng(seed)
+    base = "unet.down_blocks.0.attentions.0.transformer_blocks.0"
+    state = {
+        f"{base}.attn1.to_q.lora_A.weight":
+            rng.standard_normal((rank, dim)).astype(np.float32),
+        f"{base}.attn1.to_q.lora_B.weight":
+            rng.standard_normal((dim, rank)).astype(np.float32),
+        f"{base}.attn2.to_v.lora_A.weight":
+            rng.standard_normal((rank, dim)).astype(np.float32),
+        f"{base}.attn2.to_v.lora_B.weight":
+            rng.standard_normal((dim, rank)).astype(np.float32),
+    }
+    if extra_conv:
+        # a 4D conv module the per-row Dense delta cannot express
+        state["unet.down_blocks.0.resnets_0.conv1.lora_A.weight"] = \
+            rng.standard_normal((rank, 9)).astype(np.float32)
+        state["unet.down_blocks.0.resnets_0.conv1.lora_B.weight"] = \
+            rng.standard_normal((9, rank)).astype(np.float32)
+    save_file(state, str(path))
+    return str(path)
+
+
+def _q_dim(pipe):
+    return int(pipe.params["unet"]["down_blocks_0"]["attentions_0"]
+               ["transformer_blocks_0"]["attn1"]["to_q"]["kernel"].shape[0])
+
+
+def _maxdiff(a, b):
+    return int(np.abs(np.asarray(a, np.int32) - np.asarray(b, np.int32)).max())
+
+
+# --- golden equivalence: delta vs merged ------------------------------------
+
+
+def test_solo_txt2img_delta_matches_merged(tiny_pipe, tmp_path, factor_cache,
+                                           monkeypatch):
+    adapter = _write_adapter(tmp_path / "a.safetensors", _q_dim(tiny_pipe),
+                             seed=1)
+    kw = dict(prompt="a red cube", height=64, width=64,
+              num_inference_steps=2, rng=jax.random.key(7),
+              lora={"lora": adapter}, lora_scale=0.8)
+    images, cfg = tiny_pipe.run(**dict(kw))
+    assert cfg["lora_mode"] == "delta"
+    monkeypatch.setenv("CHIASWARM_LORA_RUNTIME_DELTA", "0")
+    merged, cfg_m = tiny_pipe.run(**dict(kw))
+    assert cfg_m["lora_mode"] == "merged"
+    assert _maxdiff(images[0], merged[0]) <= 2
+
+
+def test_solo_img2img_delta_matches_merged(tiny_pipe, tmp_path, factor_cache,
+                                           monkeypatch):
+    adapter = _write_adapter(tmp_path / "a.safetensors", _q_dim(tiny_pipe),
+                             seed=2)
+    start = Image.fromarray(
+        np.full((64, 64, 3), 128, np.uint8))
+    kw = dict(prompt="repaint", image=start, strength=0.5,
+              num_inference_steps=4, rng=jax.random.key(9),
+              lora={"lora": adapter}, lora_scale=1.0)
+    images, cfg = tiny_pipe.run(**dict(kw))
+    assert cfg["lora_mode"] == "delta"
+    monkeypatch.setenv("CHIASWARM_LORA_RUNTIME_DELTA", "0")
+    merged, cfg_m = tiny_pipe.run(**dict(kw))
+    assert cfg_m["lora_mode"] == "merged"
+    assert _maxdiff(images[0], merged[0]) <= 2
+
+
+def test_coalesced_with_plain_batchmate_matches(tiny_pipe, tmp_path,
+                                                factor_cache):
+    """The mixed group's adapter row matches a merged-params batched
+    reference; the adapter-free batchmate is untouched by its
+    neighbour's adapter (exact zero delta on slot 0)."""
+    from chiaswarm_tpu.models.lora import resolve_and_merge
+
+    adapter = _write_adapter(tmp_path / "a.safetensors", _q_dim(tiny_pipe),
+                             seed=3)
+    shared = dict(height=64, width=64, num_inference_steps=2)
+    reqs = [
+        dict(prompt="styled", rng=jax.random.key(1),
+             num_images_per_prompt=1, lora={"lora": adapter},
+             lora_scale=1.0),
+        dict(prompt="plain", rng=jax.random.key(2),
+             num_images_per_prompt=1),
+    ]
+    mixed = tiny_pipe.run_batched([dict(r) for r in reqs], **shared)
+    assert mixed[0][1]["lora_mode"] == "delta"
+    assert "lora_mode" not in mixed[1][1]
+
+    # plain reference group: same rngs, no adapters anywhere
+    plain_reqs = [dict(r) for r in reqs]
+    plain_reqs[0].pop("lora"), plain_reqs[0].pop("lora_scale")
+    plain = tiny_pipe.run_batched(plain_reqs, **shared)
+    # the batchmate's row must not feel the neighbour's adapter
+    assert _maxdiff(mixed[1][0][0], plain[1][0][0]) <= 1
+    # and the adapter row must differ from its unadapted self
+    assert _maxdiff(mixed[0][0][0], plain[0][0][0]) > 0
+
+    # merged-params batched reference for the adapter row: the SAME
+    # batched program with the adapter merged into the tree
+    merged_unet = resolve_and_merge(
+        tiny_pipe.params["unet"], {"lora": adapter}, 1.0, "test/tiny-sd")
+    original = tiny_pipe.params
+    try:
+        tiny_pipe.params = dict(original)
+        tiny_pipe.params["unet"] = tiny_pipe._place(
+            {"unet": merged_unet})["unet"]
+        reference = tiny_pipe.run_batched(plain_reqs, **shared)
+    finally:
+        tiny_pipe.params = original
+    assert _maxdiff(mixed[0][0][0], reference[0][0][0]) <= 2
+
+
+def test_mixed_adapters_one_pass_counts_rows(tiny_pipe, tmp_path,
+                                             factor_cache):
+    from chiaswarm_tpu.pipelines.lora_runtime import LORA_ROWS
+
+    a1 = _write_adapter(tmp_path / "a1.safetensors", _q_dim(tiny_pipe),
+                        seed=4)
+    a2 = _write_adapter(tmp_path / "a2.safetensors", _q_dim(tiny_pipe),
+                        seed=5)
+    before_delta = LORA_ROWS.value(mode="delta")
+    before_none = LORA_ROWS.value(mode="none")
+    outs = tiny_pipe.run_batched([
+        dict(prompt="a", rng=jax.random.key(1), num_images_per_prompt=1,
+             lora={"lora": a1}, lora_scale=1.0),
+        dict(prompt="b", rng=jax.random.key(2), num_images_per_prompt=2,
+             lora={"lora": a2}, lora_scale=0.5),
+        dict(prompt="c", rng=jax.random.key(3), num_images_per_prompt=1),
+    ], height=64, width=64, num_inference_steps=2)
+    assert [cfg.get("lora_mode") for _, cfg in outs] == \
+        ["delta", "delta", None]
+    assert LORA_ROWS.value(mode="delta") - before_delta == 3
+    assert LORA_ROWS.value(mode="none") - before_none == 1
+    # two distinct adapters resolved exactly once each
+    assert len(factor_cache) == 2
+
+
+def test_slots_cap_raises_for_fallback(tiny_pipe, tmp_path, factor_cache):
+    a1 = _write_adapter(tmp_path / "a1.safetensors", _q_dim(tiny_pipe),
+                        seed=6)
+    a2 = _write_adapter(tmp_path / "a2.safetensors", _q_dim(tiny_pipe),
+                        seed=7)
+    with pytest.raises(ValueError, match="distinct adapters"):
+        tiny_pipe.run_batched([
+            dict(prompt="a", rng=jax.random.key(1),
+                 num_images_per_prompt=1, lora={"lora": a1}),
+            dict(prompt="b", rng=jax.random.key(2),
+                 num_images_per_prompt=1, lora={"lora": a2}),
+        ], height=64, width=64, num_inference_steps=2, lora_slots_max=1)
+
+
+def test_conv_adapter_falls_back_to_merged(tiny_pipe, tmp_path, factor_cache):
+    """An adapter with modules the Dense delta can't express (conv)
+    serves via the merged tree rather than silently dropping content."""
+    adapter = _write_adapter(tmp_path / "c.safetensors", _q_dim(tiny_pipe),
+                             seed=8, extra_conv=True)
+    images, cfg = tiny_pipe.run(
+        prompt="x", height=64, width=64, num_inference_steps=2,
+        rng=jax.random.key(1), lora={"lora": adapter}, lora_scale=1.0)
+    assert cfg["lora_mode"] == "merged"
+
+
+def test_batched_conv_adapter_raises_typed_with_member_ids(
+        tiny_pipe, tmp_path, factor_cache):
+    """A group carrying one merged-fallback adapter refuses with a TYPED
+    error naming exactly the ineligible members, so the worker can
+    re-batch the eligible majority instead of serializing everyone."""
+    from chiaswarm_tpu.pipelines.lora_runtime import DeltaIneligibleError
+
+    good = _write_adapter(tmp_path / "g.safetensors", _q_dim(tiny_pipe),
+                          seed=9)
+    conv = _write_adapter(tmp_path / "k.safetensors", _q_dim(tiny_pipe),
+                          seed=10, extra_conv=True)
+    with pytest.raises(DeltaIneligibleError) as err:
+        tiny_pipe.run_batched([
+            dict(prompt="a", rng=jax.random.key(1),
+                 num_images_per_prompt=1, lora={"lora": good},
+                 job_id="j-good"),
+            dict(prompt="b", rng=jax.random.key(2),
+                 num_images_per_prompt=1, lora={"lora": conv},
+                 job_id="j-conv"),
+            dict(prompt="c", rng=jax.random.key(3),
+                 num_images_per_prompt=1, job_id="j-plain"),
+        ], height=64, width=64, num_inference_steps=2)
+    assert err.value.job_ids == ["j-conv"]
+
+
+def test_prescan_adapter_chunks_refuses_before_any_pass(
+        tiny_pipe, tmp_path, factor_cache, monkeypatch):
+    """A group split across passes surfaces every refusal UP FRONT
+    (prescan_adapter_chunks): a later chunk's ineligible adapter or a
+    per-pass slots-cap overflow must raise before chunk 1 runs, or its
+    finished denoise work is discarded and its row metrics re-counted
+    on the worker's re-batch."""
+    from chiaswarm_tpu.pipelines.lora_runtime import DeltaIneligibleError
+
+    good = _write_adapter(tmp_path / "g.safetensors", _q_dim(tiny_pipe),
+                          seed=20)
+    conv = _write_adapter(tmp_path / "k.safetensors", _q_dim(tiny_pipe),
+                          seed=21, extra_conv=True)
+    good_spec = dict(prompt="a", lora={"lora": good}, job_id="j-good")
+    conv_spec = dict(prompt="b", lora={"lora": conv}, job_id="j-conv")
+    plain = dict(prompt="c", job_id="j-plain")
+
+    # adapter-free group: no-op
+    tiny_pipe.prescan_adapter_chunks([[dict(plain)], [dict(plain)]])
+
+    # an ineligible adapter in the SECOND chunk raises the typed error
+    # naming it, before any pass could run
+    with pytest.raises(DeltaIneligibleError) as err:
+        tiny_pipe.prescan_adapter_chunks(
+            [[dict(good_spec), dict(plain)], [dict(conv_spec)]])
+    assert err.value.job_ids == ["j-conv"]
+
+    # kill switch outranks everything, as in run_batched
+    monkeypatch.setenv("CHIASWARM_LORA_RUNTIME_DELTA", "0")
+    with pytest.raises(ValueError, match="disabled"):
+        tiny_pipe.prescan_adapter_chunks([[dict(good_spec)], [dict(plain)]])
+    monkeypatch.delenv("CHIASWARM_LORA_RUNTIME_DELTA")
+
+    # per-PASS distinct-adapter cap: two adapters in one chunk overflow
+    # a cap of 1, but split across chunks they fit
+    good2 = _write_adapter(tmp_path / "g2.safetensors", _q_dim(tiny_pipe),
+                           seed=22)
+    spec2 = dict(prompt="d", lora={"lora": good2}, job_id="j-good2")
+    with pytest.raises(ValueError, match="distinct adapters"):
+        tiny_pipe.prescan_adapter_chunks(
+            [[dict(good_spec), dict(spec2)], [dict(plain)]],
+            lora_slots_max=1)
+    tiny_pipe.prescan_adapter_chunks(
+        [[dict(good_spec)], [dict(spec2)]], lora_slots_max=1)
+
+
+def test_unknown_adapter_still_fatal(tiny_pipe, factor_cache):
+    with pytest.raises(ValueError, match="Could not load lora"):
+        tiny_pipe.run(prompt="x", height=64, width=64,
+                      num_inference_steps=2,
+                      lora={"lora": "/does/not/exist.safetensors"},
+                      rng=jax.random.key(0))
+
+
+def test_chunked_delta_bitwise_matches_fused(tiny_pipe, tmp_path,
+                                             factor_cache, monkeypatch):
+    """ISSUE 10 x ISSUE 13: the chunked denoise (cancel-probe seam)
+    threads the lora operand through every chunk — fused and chunked
+    delta passes run the same ops on the same values, so their outputs
+    are bitwise identical, exactly like the adapter-free pin."""
+    adapter = _write_adapter(tmp_path / "a.safetensors", _q_dim(tiny_pipe),
+                             seed=11)
+    kw = dict(prompt="chunked", height=64, width=64,
+              num_inference_steps=4, rng=jax.random.key(3),
+              lora={"lora": adapter}, lora_scale=1.0)
+    fused, cfg = tiny_pipe.run(**dict(kw))
+    assert cfg["lora_mode"] == "delta"
+    monkeypatch.setenv("CHIASWARM_DENOISE_CHUNK_STEPS", "2")
+    chunked, cfg_c = tiny_pipe.run(**dict(kw))
+    assert cfg_c["lora_mode"] == "delta"
+    assert _maxdiff(fused[0], chunked[0]) == 0
+
+
+# --- factor cache -----------------------------------------------------------
+
+
+def test_factor_cache_byte_cap_and_metrics(tmp_path):
+    from chiaswarm_tpu.lora_cache import LoraFactorCache, adapter_key
+
+    cache = LoraFactorCache(max_bytes=2000)
+    small = {"m": (np.zeros((2, 50), np.float32),
+                   np.zeros((50, 2), np.float32), None)}
+    nbytes = 2 * 2 * 50 * 4  # 800
+    cache.put(("a", None, None), small, nbytes)
+    cache.put(("b", None, None), small, nbytes)
+    assert len(cache) == 2
+    # third entry pushes past the byte cap -> LRU eviction of "a"
+    cache.put(("c", None, None), small, nbytes)
+    assert len(cache) == 2
+    assert cache.lookup(("a", None, None)) is None
+    assert cache.lookup(("c", None, None)) is not None
+    # an oversize adapter never wipes the cache
+    cache.put(("d", None, None), small, 10_000)
+    assert cache.lookup(("d", None, None)) is None
+    assert len(cache) == 2
+    # identity is scale-independent
+    assert adapter_key({"lora": "x", "weight_name": None,
+                        "subfolder": None}) == \
+        adapter_key({"lora": "x"})
+
+
+def test_factor_cache_disabled_still_loads(tiny_pipe, tmp_path, monkeypatch):
+    adapter = _write_adapter(tmp_path / "a.safetensors", _q_dim(tiny_pipe),
+                             seed=9)
+    lora_cache.configure(0)  # disabled
+    try:
+        assert lora_cache.get_cache() is None
+        images, cfg = tiny_pipe.run(
+            prompt="x", height=64, width=64, num_inference_steps=2,
+            rng=jax.random.key(1), lora={"lora": adapter}, lora_scale=1.0)
+        assert cfg["lora_mode"] == "delta"
+    finally:
+        lora_cache.reset()
+
+
+def test_factor_cache_sized_from_settings(monkeypatch):
+    monkeypatch.setenv("CHIASWARM_LORA_CACHE_MB", "3")
+    lora_cache.reset()
+    try:
+        cache = lora_cache.get_cache()
+        assert cache is not None
+        assert cache.max_bytes == 3 * 1024 * 1024
+    finally:
+        lora_cache.reset()
+
+
+# --- residency satellite ----------------------------------------------------
+
+
+def test_adapter_pass_notes_base_model_residency(tmp_path, factor_cache):
+    from chiaswarm_tpu.chips.allocator import (
+        reset_residency,
+        resident_slice,
+    )
+    from chiaswarm_tpu.chips.device import ChipSet
+
+    pipe = SDPipeline("test/tiny-sd", chipset=ChipSet(jax.devices()[:1]))
+    adapter = _write_adapter(tmp_path / "a.safetensors", _q_dim(pipe),
+                             seed=10)
+    reset_residency()
+    pipe.run(prompt="x", height=64, width=64, num_inference_steps=2,
+             rng=jax.random.key(1), lora={"lora": adapter}, lora_scale=1.0)
+    # the adapter pass recorded a residency event keyed on the BASE
+    # model, so affinity placement stays warm for LoRA-heavy tenants
+    assert resident_slice("test/tiny-sd") == pipe.chipset.slice_id
+
+
+# --- scheduler grouping -----------------------------------------------------
+
+
+def _wire_job(i, adapter=None, **over):
+    job = {"id": f"j{i}", "workflow": "txt2img",
+           "model_name": "stabilityai/stable-diffusion-2-1",
+           "prompt": f"p{i}", "height": 64, "width": 64,
+           "num_inference_steps": 2,
+           "parameters": {"test_tiny_model": True}}
+    if adapter is not None:
+        job["lora"] = adapter
+    job.update(over)
+    return job
+
+
+def test_scheduler_groups_mixed_adapters_and_caps_slots():
+    from chiaswarm_tpu.batching import BatchScheduler
+
+    async def scenario():
+        b = BatchScheduler(linger_s=60.0, max_coalesce=8, lora_slots=2)
+        await b.put(_wire_job(0, adapter="style-a"))
+        await b.put(_wire_job(1, adapter="style-b"))
+        await b.put(_wire_job(2))          # plain batchmate rides
+        await b.put(_wire_job(3, adapter="style-a"))  # repeat rides
+        # third DISTINCT adapter flushes the open group (reason "slots")
+        await b.put(_wire_job(4, adapter="style-c"))
+        first = await b.get()
+        assert [j["id"] for j in first] == ["j0", "j1", "j2", "j3"]
+        b.flush_all()
+        second = await b.get()
+        assert [j["id"] for j in second] == ["j4"]
+
+    asyncio.run(scenario())
+
+
+# --- shared-ControlNet batched rung ----------------------------------------
+
+
+def test_shared_controlnet_batched_group(factor_cache):
+    """Two jobs sharing ONE ControlNet + control image coalesce into a
+    single pass; each row matches its solo-path twin within the same
+    tolerance the batched program is allowed anywhere (different noise
+    layout, so only mode/config equivalence + sanity are pinned)."""
+    pipe = SDPipeline("test/tiny-sd")
+    control = Image.fromarray(
+        (np.indices((64, 64)).sum(0) % 2 * 255).astype(np.uint8)
+    ).convert("RGB")
+    outs = pipe.run_batched([
+        dict(prompt="qr a", rng=jax.random.key(1), num_images_per_prompt=1),
+        dict(prompt="qr b", rng=jax.random.key(2), num_images_per_prompt=1),
+    ], height=64, width=64, num_inference_steps=2,
+        controlnet_model_name="test/tiny-controlnet",
+        control_image=control,
+        controlnet_conditioning_scale=0.7)
+    assert len(outs) == 2
+    for images, cfg in outs:
+        assert cfg["controlnet"] == "test/tiny-controlnet"
+        assert cfg["batched_with"] == 2
+        assert images[0].size == (64, 64)

@@ -80,6 +80,34 @@ def test_embed_cache_line_from_synthetic_text():
     assert tool.embed_cache_line([]) is None
 
 
+def test_lora_line_from_synthetic_text():
+    """ISSUE 13: the adapter-serving line (rows by execution mode +
+    factor-cache hit rate/residency) and its machine-readable twin."""
+    tool = _load_tool()
+    samples = tool.parse_metrics(
+        'swarm_lora_rows_total{mode="delta"} 6\n'
+        'swarm_lora_rows_total{mode="merged"} 2\n'
+        'swarm_lora_rows_total{mode="none"} 8\n'
+        'swarm_lora_cache_total{event="hit"} 3\n'
+        'swarm_lora_cache_total{event="miss"} 1\n'
+        'swarm_lora_cache_bytes 2048\n'
+        'swarm_lora_cache_entries 2\n')
+    assert tool.lora_line(samples) == (
+        "adapters       rows delta=6 merged=2 none=8 "
+        "cache hit_rate=0.75 entries=2 bytes=2048")
+    summary = tool.lora_summary(samples)
+    assert summary == {
+        "rows": {"delta": 6, "merged": 2, "none": 8},
+        "adapter_rows": 8,
+        "delta_rate": 0.75,
+        "cache": {"hits": 3, "misses": 1, "hit_rate": 0.75,
+                  "bytes": 2048, "entries": 2},
+    }
+    # adapter-free fleets render nothing rather than a zero line
+    assert tool.lora_line([]) is None
+    assert tool.lora_summary([]) is None
+
+
 def test_geometry_line_from_synthetic_text():
     """ISSUE 12: the per-geometry pass distribution renders under the
     stage table (and its machine-readable twin carries the sharded

@@ -87,6 +87,28 @@ def test_cancellation_knobs(sdaas_root, monkeypatch):
     assert load_settings().denoise_chunk_steps == 0
 
 
+def test_lora_serving_knobs(sdaas_root, monkeypatch):
+    """ISSUE 13: runtime-delta adapter serving layers like every other
+    setting — delta ON by default (the multi-tenant path is the serving
+    path), env overrides win."""
+    s = load_settings()
+    assert s.lora_runtime_delta is True
+    assert s.lora_cache_mb == 256
+    assert s.lora_slots_max == 8
+    assert s.lora_rank_max == 128
+    monkeypatch.setenv("CHIASWARM_LORA_RUNTIME_DELTA", "0")
+    monkeypatch.setenv("CHIASWARM_LORA_CACHE_MB", "64")
+    monkeypatch.setenv("CHIASWARM_LORA_SLOTS_MAX", "4")
+    monkeypatch.setenv("CHIASWARM_LORA_RANK_MAX", "32")
+    s = load_settings()
+    assert s.lora_runtime_delta is False
+    assert s.lora_cache_mb == 64
+    assert s.lora_slots_max == 4
+    assert s.lora_rank_max == 32
+    monkeypatch.undo()
+    assert load_settings().lora_runtime_delta is True
+
+
 def test_shard_geometry_knobs(sdaas_root, monkeypatch):
     """ISSUE 12: the class-aware sharding knobs layer like every other
     setting — interactive sharding OFF by default (the sharded view

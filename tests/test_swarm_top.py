@@ -93,6 +93,15 @@ swarm_job_stage_seconds_count{stage="denoise"} 4
 # TYPE swarm_embed_cache_total counter
 swarm_embed_cache_total{event="hit"} 30
 swarm_embed_cache_total{event="miss"} 10
+# TYPE swarm_lora_rows_total counter
+swarm_lora_rows_total{mode="delta"} 6
+swarm_lora_rows_total{mode="merged"} 2
+swarm_lora_rows_total{mode="none"} 8
+# TYPE swarm_lora_cache_total counter
+swarm_lora_cache_total{event="hit"} 3
+swarm_lora_cache_total{event="miss"} 1
+# TYPE swarm_lora_cache_entries gauge
+swarm_lora_cache_entries 2
 """
 
 
@@ -164,6 +173,10 @@ def test_render_hive_and_worker_frames_from_synthetic_data():
     assert "failovers=0" in lines
     # prompt-embedding cache hit rate (ISSUE 9)
     assert "hit=30 miss=10 hit_rate=0.75" in lines
+    # adapter serving line (ISSUE 13): rows by execution mode + the
+    # factor cache's hit rate and residency
+    assert ("adapters  delta=6 merged=2 plain=8 "
+            "cache_hit_rate=0.75 factors=2") in lines
 
     # an unreachable endpoint renders as such instead of raising
     dead = tool.Snapshot("http://gone:1", error="ConnectionError: refused")

@@ -297,6 +297,112 @@ def test_compatible_jobs_coalesce_into_one_batch(sdaas_root):
     assert len(set(blobs)) == 4
 
 
+def test_adapter_jobs_coalesce_with_runtime_deltas(sdaas_root, tmp_path):
+    """ISSUE 13 end to end: two jobs carrying DISTINCT LoRA adapters
+    plus an adapter-free batchmate — all one base model — coalesce into
+    ONE padded pass served by runtime per-row deltas: 3 distinct
+    envelopes, adapter rows stamped lora_mode=delta, no merged param
+    tree ever built, distinct images per row. A 4th member whose adapter
+    the delta can't express (conv module) rides the same group but is
+    PARTITIONED OUT at the slice: it serves solo via the merged tree
+    while the eligible trio keeps its coalesced pass."""
+    import numpy as np
+    from safetensors.numpy import save_file
+
+    lora_root = tmp_path / "lora"
+    lora_root.mkdir()
+    dim = 32  # TINY_UNET block_out_channels[0]
+    base_key = "unet.down_blocks.0.attentions.0.transformer_blocks.0.attn1"
+    for i in range(2):
+        rng = np.random.default_rng(40 + i)
+        save_file({
+            f"{base_key}.to_q.lora_A.weight":
+                rng.standard_normal((2, dim)).astype(np.float32),
+            f"{base_key}.to_q.lora_B.weight":
+                rng.standard_normal((dim, 2)).astype(np.float32),
+        }, str(lora_root / f"style-{i}.safetensors"))
+    rng = np.random.default_rng(49)
+    save_file({
+        f"{base_key}.to_q.lora_A.weight":
+            rng.standard_normal((2, dim)).astype(np.float32),
+        f"{base_key}.to_q.lora_B.weight":
+            rng.standard_normal((dim, 2)).astype(np.float32),
+        # a 4D conv module the per-row Dense delta cannot express
+        "unet.down_blocks.0.resnets_0.conv1.lora_A.weight":
+            rng.standard_normal((2, 9)).astype(np.float32),
+        "unet.down_blocks.0.resnets_0.conv1.lora_B.weight":
+            rng.standard_normal((9, 2)).astype(np.float32),
+    }, str(lora_root / "conv-style.safetensors"))
+
+    def job(i, **over):
+        out = {
+            "id": f"job-l{i}",
+            "workflow": "txt2img",
+            "model_name": "stabilityai/stable-diffusion-2-1",
+            "prompt": f"tenant {i}",
+            "seed": 2000 + i,
+            "height": 64,
+            "width": 64,
+            "num_inference_steps": 2,
+            "parameters": {"test_tiny_model": True},
+        }
+        out.update(over)
+        return out
+
+    jobs = [
+        job(0, lora="style-0.safetensors"),
+        job(1, lora="style-1.safetensors"),
+        job(2),
+        job(3, lora="conv-style.safetensors"),
+    ]
+
+    async def scenario():
+        hive = await FakeHive().start()
+        for j in jobs:
+            hive.add_job(j)
+        settings = Settings(sdaas_token="test-token",
+                            worker_name="test-worker",
+                            lora_root_dir=str(lora_root))
+        w = Worker(
+            settings=settings,
+            allocator=SliceAllocator(chips_per_job=8),
+            hive_uri=hive.uri,
+        )
+        runner = asyncio.create_task(w.run())
+        try:
+            results = await hive.wait_for_results(4, timeout=300.0)
+        finally:
+            w.stop()
+            await asyncio.wait_for(runner, 10)
+            await hive.stop()
+        return results
+
+    results = asyncio.run(scenario())
+    by_id = {r["id"]: r for r in results}
+    assert set(by_id) == {"job-l0", "job-l1", "job-l2", "job-l3"}
+    blobs = set()
+    for i in range(3):
+        r = by_id[f"job-l{i}"]
+        cfg = r["pipeline_config"]
+        assert not r.get("fatal_error"), cfg
+        # the conv member was partitioned out; the eligible trio still
+        # ran as ONE coalesced pass
+        assert cfg["batched_with"] == 3, cfg
+        if i < 2:
+            assert cfg["lora_mode"] == "delta", cfg
+        else:
+            assert "lora_mode" not in cfg, cfg
+        blobs.add(r["artifacts"]["primary"]["blob"])
+    conv = by_id["job-l3"]
+    assert not conv.get("fatal_error"), conv["pipeline_config"]
+    assert conv["pipeline_config"]["lora_mode"] == "merged", \
+        conv["pipeline_config"]
+    assert "batched_with" not in conv["pipeline_config"], \
+        conv["pipeline_config"]
+    blobs.add(conv["artifacts"]["primary"]["blob"])
+    assert len(blobs) == 4  # distinct adapters/seeds -> distinct images
+
+
 def test_degraded_preprocessor_flag_in_envelope(sdaas_root):
     """A ControlNet job conditioned through a classical-CV stand-in
     annotator (mlsd) must carry `degraded_preprocessors` in its result

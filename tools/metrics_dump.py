@@ -156,6 +156,13 @@ def _label_counts(samples, name: str, label: str) -> dict[str, float]:
             if metric == name and label in labels}
 
 
+def _gauge_value(samples, name: str) -> float | None:
+    for metric, _labels, value in samples:
+        if metric == name:
+            return value
+    return None
+
+
 def _class_quantiles(samples, name: str) -> list[dict]:
     """Per-class p50/p95 rows from a {class}-labeled hive histogram."""
     rows = []
@@ -390,6 +397,53 @@ def embed_cache_line(samples) -> str | None:
             f"hit_rate={hits / total:.2f}")
 
 
+def lora_summary(samples) -> dict | None:
+    """Adapter-serving summary (ISSUE 13): image rows by execution mode
+    (delta = runtime per-row low-rank deltas on the resident base tree,
+    merged = full merged-tree fallback, none = adapter-free), plus the
+    factor cache's hit rate and residency. None when no SD pass ever
+    ran AND no adapter was ever resolved."""
+    rows = _label_counts(samples, "swarm_lora_rows_total", "mode")
+    events = _label_counts(samples, "swarm_lora_cache_total", "event")
+    hits, misses = events.get("hit", 0.0), events.get("miss", 0.0)
+    lookups = hits + misses
+    if not rows and lookups <= 0:
+        return None
+    adapter_rows = rows.get("delta", 0.0) + rows.get("merged", 0.0)
+    return {
+        "rows": {k: int(v) for k, v in sorted(rows.items())},
+        "adapter_rows": int(adapter_rows),
+        "delta_rate": (round(rows.get("delta", 0.0) / adapter_rows, 4)
+                       if adapter_rows else None),
+        "cache": {
+            "hits": int(hits),
+            "misses": int(misses),
+            "hit_rate": round(hits / lookups, 4) if lookups else None,
+            "bytes": int(_gauge_value(
+                samples, "swarm_lora_cache_bytes") or 0),
+            "entries": int(_gauge_value(
+                samples, "swarm_lora_cache_entries") or 0),
+        },
+    }
+
+
+def lora_line(samples) -> str | None:
+    """Human-readable twin of lora_summary."""
+    summary = lora_summary(samples)
+    if summary is None:
+        return None
+    rows = summary["rows"]
+    cache = summary["cache"]
+    parts = [f"adapters       rows "
+             + " ".join(f"{k}={v}" for k, v in rows.items())]
+    if cache["hits"] or cache["misses"]:
+        parts.append(
+            f"cache hit_rate={cache['hit_rate']:.2f} "
+            f"entries={cache['entries']} "
+            f"bytes={cache['bytes']}")
+    return " ".join(parts)
+
+
 def geometry_summary(samples) -> dict | None:
     """Per-geometry pass counts (swarm_sharded_passes_total, ISSUE 12):
     how many denoise passes ran replicated (data-parallel coalescing
@@ -541,6 +595,7 @@ def main(argv: list[str] | None = None) -> int:
     payload["worker"] = {
         "stages": rows,
         "embed_cache": embed_cache_summary(samples),
+        "lora": lora_summary(samples),
         "geometry": geometry_summary(samples),
         "healthz": health,
     }
@@ -551,6 +606,9 @@ def main(argv: list[str] | None = None) -> int:
         embed = embed_cache_line(samples)
         if embed:
             print(embed)
+        adapters = lora_line(samples)
+        if adapters:
+            print(adapters)
         geometry = geometry_line(samples)
         if geometry:
             print(geometry)

@@ -340,6 +340,24 @@ def render_worker(cur: Snapshot, prev: Snapshot | None) -> list[str]:
             f"{rate(hits, pembed.get('hit'), dt)} miss={int(misses)} "
             f"hit_rate={hits / (hits + misses):.2f}")
 
+    # adapter serving (ISSUE 13): rows by execution mode (delta = the
+    # runtime per-row path, merged = the fallback full-tree copy) plus
+    # the factor cache's residency and hit rate
+    lrows = cur.counters("swarm_lora_rows_total", "mode")
+    lcache = cur.counters("swarm_lora_cache_total", "event")
+    lhits, lmisses = lcache.get("hit", 0.0), lcache.get("miss", 0.0)
+    adapter_rows = lrows.get("delta", 0.0) + lrows.get("merged", 0.0)
+    if adapter_rows > 0 or lhits + lmisses > 0:
+        entries = cur.gauge("swarm_lora_cache_entries") or 0
+        cache_bit = ""
+        if lhits + lmisses > 0:
+            cache_bit = (f" cache_hit_rate={lhits / (lhits + lmisses):.2f} "
+                         f"factors={int(entries)}")
+        lines.append(
+            f"  adapters  delta={int(lrows.get('delta', 0))} "
+            f"merged={int(lrows.get('merged', 0))} "
+            f"plain={int(lrows.get('none', 0))}{cache_bit}")
+
     # per-stage latency over the last interval (cumulative in --once)
     stages: dict[str, dict[float, float]] = {}
     for metric, labels, value in cur.samples:
