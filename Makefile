@@ -1,0 +1,17 @@
+# Developer/CI entry points. The builder and every future PR run lint
+# exactly the way tier-1 does (tests/test_lint.py wraps the same call).
+
+PYTHON ?= python
+
+.PHONY: lint lint-json test
+
+lint:
+	$(PYTHON) -m chiaswarm_tpu.lint
+
+lint-json:
+	$(PYTHON) -m chiaswarm_tpu.lint --json
+
+# the tier-1 quick suite (ROADMAP "Tier-1 verify" is the canonical line)
+test:
+	JAX_PLATFORMS=cpu $(PYTHON) -m pytest tests/ -q -m 'not slow' \
+	  --continue-on-collection-errors -p no:cacheprovider

@@ -38,7 +38,6 @@ from __future__ import annotations
 import asyncio
 import json
 import logging
-import time
 
 from aiohttp import web
 
@@ -159,8 +158,8 @@ class HiveServer:
         # standby's lag view); returns a dict merged into health(),
         # with its "degraded_reasons" list folded into the verdict
         self.extra_health = None
-        self.started_at = time.monotonic()
-        self._last_spool_sweep = time.monotonic()
+        self.started_at = self.queue.clock.mono()
+        self._last_spool_sweep = self.queue.clock.mono()
         self._runner: web.AppRunner | None = None
         self._reaper: asyncio.Task | None = None
         # write-ahead journal: recover the pre-crash queue + lease state
@@ -413,7 +412,7 @@ class HiveServer:
     def _sweep_spool_if_due(self) -> None:
         if self.spool_max_bytes <= 0 and self.spool_max_age_s <= 0:
             return
-        now = time.monotonic()
+        now = self.queue.clock.mono()
         if now - self._last_spool_sweep < self.SPOOL_SWEEP_INTERVAL_S:
             return
         self._last_spool_sweep = now
@@ -787,7 +786,11 @@ class HiveServer:
         catalog = _DEFAULT_CATALOG
         path = get_settings_dir() / "models.json"
         try:
-            data = json.loads(path.read_text())
+            # off-loop (read AND parse): an operator-supplied catalog can
+            # be arbitrarily large, and this handler shares the loop
+            # with dispatch
+            data = await asyncio.to_thread(
+                lambda: json.loads(path.read_text()))
             if isinstance(data, dict) and "models" in data:
                 catalog = {
                     "models": data.get("models", []),
@@ -980,7 +983,7 @@ class HiveServer:
             "degraded_reasons": reasons,
             "role": "standby" if self.standby else "primary",
             "epoch": self.epoch,
-            "uptime_s": round(time.monotonic() - self.started_at, 1),
+            "uptime_s": round(self.queue.clock.mono() - self.started_at, 1),
             "queue_depth": self.queue.depths(),
             "leases_active": len(self.leases),
             "jobs": states,

@@ -18,15 +18,16 @@ from __future__ import annotations
 
 import asyncio
 import dataclasses
-from typing import Any
+from typing import TYPE_CHECKING, Any
 
 import aiohttp
 
-from ..chips.allocator import SliceAllocator
 from ..settings import Settings
-from ..worker import Worker
 from .app import HiveServer
 from .replication import StandbyHive
+
+if TYPE_CHECKING:  # worker-side types only; see lazy import below
+    from ..worker import Worker
 
 
 class LocalSwarm:
@@ -48,7 +49,7 @@ class LocalSwarm:
         self.with_standby = standby
         self.standby: StandbyHive | None = None
         self.hive: HiveServer | None = None
-        self.workers: list[Worker] = []
+        self.workers: list["Worker"] = []
         self._worker_tasks: list[asyncio.Task] = []
         self._session: aiohttp.ClientSession | None = None
 
@@ -85,7 +86,7 @@ class LocalSwarm:
             return [self.hive.api_uri, self.standby.api_uri]
         return self.hive.api_uri
 
-    def add_worker(self, name: str) -> Worker:
+    def add_worker(self, name: str) -> "Worker":
         """Start one more pristine Worker against the hive (the
         second-worker half of takeover scenarios). Workers inherit the
         swarm's settings (a caller tuning e.g. job_deadline_s or
@@ -99,6 +100,11 @@ class LocalSwarm:
         # silently conflate every worker in the swarm
         fields.update(self.worker_overrides)
         fields["worker_name"] = name
+        # lazy: the worker half pulls jax; a chip-less host must be able
+        # to import hive_server.harness for its hive-only surface (SW001)
+        from ..chips.allocator import SliceAllocator
+        from ..worker import Worker
+
         worker = Worker(
             settings=dataclasses.replace(self.settings, **fields),
             allocator=SliceAllocator(chips_per_job=self.chips_per_job),
@@ -134,7 +140,7 @@ class LocalSwarm:
         self.hive = await HiveServer(self.settings, port=port).start()
         return self.hive
 
-    async def stop_worker(self, worker: Worker) -> None:
+    async def stop_worker(self, worker: "Worker") -> None:
         """Hard-stop one worker (no drain) — 'the worker died mid-lease'."""
         idx = self.workers.index(worker)
         worker.stop()

@@ -313,7 +313,11 @@ def ev_settle(record) -> dict:
             "timeline": _timeline_of(record)}
 
 
-def ev_requeue(record) -> dict:
+def ev_requeue(record) -> dict:  # swarmlint: disable=SW006 -- compaction
+    # deliberately never emits requeue: a queued record's dispatch
+    # history folds into its admit event (see ev_admit) so replay
+    # reproduces queue ORDER by plain appends — replaying lease+requeue
+    # pairs would front-insert and reverse the queue
     return {"ev": "requeue", "id": record.job_id, "attempts": record.attempts,
             "timeline": _timeline_of(record)}
 
@@ -340,7 +344,9 @@ def ev_expire(record) -> dict:
             "timeline": _timeline_of(record)}
 
 
-def ev_retire(job_id: str) -> dict:
+def ev_retire(job_id: str) -> dict:  # swarmlint: disable=SW006 -- a
+    # compaction snapshot contains only LIVE records; retirement is
+    # expressed by omission, so snapshot_events never emits retire
     return {"ev": "retire", "id": job_id}
 
 

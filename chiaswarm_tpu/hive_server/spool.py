@@ -22,11 +22,11 @@ import hashlib
 import logging
 import os
 import threading
-import time
 import uuid
 from pathlib import Path
 
 from .. import telemetry
+from .clock import CLOCK, HiveClock
 
 logger = logging.getLogger(__name__)
 
@@ -46,7 +46,11 @@ _EVICTED = telemetry.counter(
 
 
 class ArtifactSpool:
-    def __init__(self, root: Path):
+    def __init__(self, root: Path, clock: HiveClock | None = None):
+        # retention compares blob mtimes (wall-clock by nature) against
+        # "now"; the clock is injectable so sweep tests need not touch
+        # real file ages
+        self.clock = clock or CLOCK
         self.root = Path(root)
         self.root.mkdir(parents=True, exist_ok=True)
         # a crash between tmp write and rename leaves dot-prefixed .tmp
@@ -131,7 +135,7 @@ class ArtifactSpool:
                 entries.append((st.st_mtime, st.st_size, path))
             entries.sort()  # oldest first
             evicted = 0
-            now = time.time()
+            now = self.clock.wall()
             survivors = []
             for mtime, size, path in entries:
                 if path.name in protected:

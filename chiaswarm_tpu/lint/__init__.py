@@ -1,0 +1,62 @@
+"""swarmlint: repo-native static analysis for the swarm's load-bearing invariants.
+
+Five of the last six PRs needed review-hardening passes for the same
+recurring bug classes: blocking work on the asyncio event loop, wall-vs-
+monotonic clock misuse outside HiveClock, jax imports leaking into
+modules that must stay importable from chip-less hosts, and drift
+between code, the conformance twin, and the README knob/metric
+catalogs. Those invariants are the correctness substrate under every
+ROADMAP scaling item, so this package encodes them as machine-checked
+rules instead of reviewer folklore.
+
+Usage:
+
+    python -m chiaswarm_tpu.lint            # text report, exit 1 on findings
+    python -m chiaswarm_tpu.lint --json     # machine-readable report
+
+Per-line suppression (the flagged line itself):
+
+    now = time.time()  # swarmlint: disable=SW003 -- wall clock needed: ...
+
+Grandfathered findings live in ``chiaswarm_tpu/lint/baseline.json``;
+the runner exits 0 while every finding is baselined, and
+``tests/test_lint.py`` pins that the baseline only ever shrinks.
+
+The package is deliberately stdlib-only (ast + tokenize + json): it must
+run on the same chip-less hosts the hive coordinates from, and in CI
+before any accelerator dependency is importable.
+
+Rules:
+
+==== =====================================================================
+SW001 jax/flax/torch import purity for declared jax-free modules,
+      checked transitively over the first-party MODULE-LEVEL import graph
+SW002 blocking calls (time.sleep, sync file I/O, subprocess, file-handle
+      json codec) inside ``async def`` bodies not routed through an executor
+SW003 clock discipline: direct time.time()/time.monotonic() in
+      hive_server/ outside clock.py (HiveClock is the one timebase)
+SW004 Settings-knob drift: every Settings field needs an env override,
+      a README knob-table row, and a tests/test_settings.py reference
+SW005 metric-catalog drift: every registered swarm_* metric must appear
+      in the README catalog with a consistent label set
+SW006 WAL-event exhaustiveness: every ev_* journal event type needs
+      replay (apply_events) and compaction (snapshot_events) handling;
+      the replication stream rides the same apply path
+SW007 unbounded cache dicts: a dict assigned to a cache-named target
+      with no eviction (popitem) in the same file
+SW008 bare ``except``; and handlers that swallow CancelledError /
+      BaseException inside coroutines without re-raising
+==== =====================================================================
+"""
+
+from .core import Baseline, Finding, LintResult, Project, run_lint
+from .rules import RULES
+
+__all__ = [
+    "Baseline",
+    "Finding",
+    "LintResult",
+    "Project",
+    "RULES",
+    "run_lint",
+]

@@ -1,0 +1,83 @@
+"""`python -m chiaswarm_tpu.lint` — run swarmlint over the repo.
+
+Exit codes: 0 clean (every finding suppressed or baselined), 1 findings
+(or stale baseline entries — paid-off debt must be deleted), 2 bad
+usage. `--json` emits the full machine-readable verdict for CI and the
+chaos-smoke self-check.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from pathlib import Path
+
+from .core import DEFAULT_BASELINE, Baseline, run_lint
+from .rules import RULES
+
+
+def _default_root() -> Path:
+    # chiaswarm_tpu/lint/__main__.py -> repo root two packages up
+    return Path(__file__).resolve().parents[2]
+
+
+def main(argv: list[str] | None = None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m chiaswarm_tpu.lint",
+        description="swarmlint: repo-native invariant checks (SW001-SW008)")
+    parser.add_argument("--root", type=Path, default=None,
+                        help="repo root to lint (default: this checkout)")
+    parser.add_argument("--json", action="store_true", dest="as_json",
+                        help="machine-readable report on stdout")
+    parser.add_argument("--baseline", type=Path, default=None,
+                        help=f"baseline file (default: {DEFAULT_BASELINE})")
+    parser.add_argument("--no-baseline", action="store_true",
+                        help="ignore the baseline (report everything)")
+    parser.add_argument("--rules", default=None,
+                        help="comma-separated rule codes to run "
+                             "(default: all)")
+    parser.add_argument("--list-rules", action="store_true",
+                        help="print the rule table and exit")
+    args = parser.parse_args(argv)
+
+    if args.list_rules:
+        for code in sorted(RULES):
+            print(f"{code}  {RULES[code].title}")
+        return 0
+
+    selected = None
+    if args.rules:
+        wanted = {c.strip().upper() for c in args.rules.split(",") if c.strip()}
+        unknown = wanted - set(RULES)
+        if unknown:
+            print(f"unknown rule(s): {', '.join(sorted(unknown))}",
+                  file=sys.stderr)
+            return 2
+        selected = {c: RULES[c] for c in wanted}
+
+    root = args.root or _default_root()
+    if not root.is_dir():
+        print(f"not a directory: {root}", file=sys.stderr)
+        return 2
+    baseline = (Baseline() if args.no_baseline
+                else Baseline.load(args.baseline or DEFAULT_BASELINE))
+    result = run_lint(root, baseline=baseline, rules=selected)
+
+    if args.as_json:
+        print(json.dumps(result.as_dict(), indent=2))
+    else:
+        for f in result.parse_errors + result.findings:
+            print(f.render())
+        for key in result.stale_baseline:
+            print(f"stale baseline entry (finding fixed — delete it): {key}")
+        n = len(result.findings)
+        print(f"swarmlint: {n} finding(s), "
+              f"{len(result.baselined)} baselined, "
+              f"{result.suppressed_count} suppressed, "
+              f"{len(result.stale_baseline)} stale baseline entr(ies)")
+    return 0 if result.clean and not result.stale_baseline else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

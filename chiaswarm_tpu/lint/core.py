@@ -1,0 +1,297 @@
+"""swarmlint engine: file discovery, suppressions, import graph, baseline.
+
+Stdlib-only by contract (see package docstring). The engine is rule-
+agnostic: it loads every scanned file once (source + AST + suppression
+map), exposes a first-party MODULE-LEVEL import graph, and applies the
+suppression / baseline bookkeeping uniformly so every rule gets the
+same workflow for free.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import json
+import re
+from pathlib import Path
+
+from . import config
+
+# end-of-line suppression: `# swarmlint: disable=SW003` (comma-separated
+# for several rules). An optional ` -- reason` tail is encouraged.
+_SUPPRESS_RE = re.compile(r"#\s*swarmlint:\s*disable=([A-Z0-9,\s]+)")
+
+_RULE_CODE_RE = re.compile(r"SW\d{3}")
+
+
+@dataclasses.dataclass(frozen=True)
+class Finding:
+    rule: str
+    path: str  # repo-relative posix path
+    line: int
+    message: str
+    anchor: str  # normalized source line: the baseline identity survives
+    # line-number churn from unrelated edits
+
+    @property
+    def key(self) -> str:
+        return f"{self.rule}|{self.path}|{self.anchor}"
+
+    def render(self) -> str:
+        return f"{self.path}:{self.line}: {self.rule} {self.message}"
+
+    def as_dict(self) -> dict:
+        return {"rule": self.rule, "path": self.path, "line": self.line,
+                "message": self.message, "key": self.key}
+
+
+class SourceFile:
+    """One scanned file: source text, parse tree (None on syntax error),
+    and the per-line suppression map."""
+
+    def __init__(self, abspath: Path, rel: str):
+        self.abspath = abspath
+        self.rel = rel
+        self.text = abspath.read_text(encoding="utf-8", errors="replace")
+        self.lines = self.text.splitlines()
+        try:
+            self.tree: ast.Module | None = ast.parse(self.text)
+            self.parse_error: str | None = None
+        except SyntaxError as e:
+            self.tree = None
+            self.parse_error = f"{e.msg} (line {e.lineno})"
+        self.suppress: dict[int, set[str]] = {}
+        for i, line in enumerate(self.lines, start=1):
+            m = _SUPPRESS_RE.search(line)
+            if m:
+                self.suppress[i] = set(_RULE_CODE_RE.findall(m.group(1)))
+
+    def anchor(self, line: int) -> str:
+        if 1 <= line <= len(self.lines):
+            text = " ".join(self.lines[line - 1].split())
+            if text:
+                return text[:160]
+        return f"L{line}"
+
+    def finding(self, rule: str, line: int, message: str) -> Finding:
+        return Finding(rule, self.rel, line, message, self.anchor(line))
+
+    def suppressed(self, finding: Finding) -> bool:
+        return finding.rule in self.suppress.get(finding.line, ())
+
+
+class Project:
+    """The scanned tree plus the first-party import graph.
+
+    ``root`` is the repository root; rule fixtures point it at a temp
+    tree mirroring the real layout, which is how every rule gets
+    positive-case tests without planting findings in the real repo.
+    """
+
+    def __init__(self, root: str | Path, scan_paths=config.SCAN_PATHS):
+        self.root = Path(root)
+        self.files: dict[str, SourceFile] = {}
+        for top in scan_paths:
+            base = self.root / top
+            if base.is_file() and base.suffix == ".py":
+                self._add(base)
+            elif base.is_dir():
+                for p in sorted(base.rglob("*.py")):
+                    if any(part in config.EXCLUDE_DIRS
+                           for part in p.parts):
+                        continue
+                    self._add(p)
+        # dotted module name -> SourceFile (tools/ scripts count as the
+        # pseudo-package `tools` so relative chains resolve uniformly)
+        self.modules: dict[str, SourceFile] = {}
+        for rel, sf in self.files.items():
+            self.modules[self.module_name(rel)] = sf
+
+    def _add(self, p: Path) -> None:
+        rel = p.relative_to(self.root).as_posix()
+        self.files[rel] = SourceFile(p, rel)
+
+    @staticmethod
+    def module_name(rel: str) -> str:
+        name = rel[:-3] if rel.endswith(".py") else rel
+        return name.replace("/", ".")
+
+    def file(self, rel: str) -> SourceFile | None:
+        return self.files.get(rel)
+
+    def read_text(self, rel: str) -> str | None:
+        """Project-context text file (README, tests) — not scanned, not
+        linted, but several drift rules compare against them."""
+        p = self.root / rel
+        try:
+            return p.read_text(encoding="utf-8", errors="replace")
+        except OSError:
+            return None
+
+    # --- first-party module-level import graph ---
+
+    def toplevel_imports(self, sf: SourceFile) -> list[tuple[str, int]]:
+        """(dotted target, line) for every MODULE-LEVEL import: module
+        body, recursing through top-level if/try/class blocks (those
+        execute at import time) but never into function bodies (lazy
+        imports are the sanctioned worker-side escape hatch). Blocks
+        guarded by ``if TYPE_CHECKING:`` never execute and are skipped.
+        """
+        if sf.tree is None:
+            return []
+        pkg = self.module_name(sf.rel).split(".")[:-1]
+        out: list[tuple[str, int]] = []
+
+        def visit(body) -> None:
+            for node in body:
+                if isinstance(node, ast.Import):
+                    for alias in node.names:
+                        out.append((alias.name, node.lineno))
+                elif isinstance(node, ast.ImportFrom):
+                    if node.level:
+                        base = pkg[: len(pkg) - (node.level - 1)]
+                        stem = ".".join(
+                            base + ([node.module] if node.module else []))
+                    else:
+                        stem = node.module or ""
+                    if not stem:
+                        continue
+                    out.append((stem, node.lineno))
+                    # `from pkg import sub` may bind a submodule
+                    for alias in node.names:
+                        out.append((f"{stem}.{alias.name}", node.lineno))
+                elif isinstance(node, ast.If):
+                    if "TYPE_CHECKING" in ast.dump(node.test):
+                        continue
+                    visit(node.body)
+                    visit(node.orelse)
+                elif isinstance(node, ast.Try):
+                    visit(node.body)
+                    visit(node.orelse)
+                    visit(node.finalbody)
+                    for handler in node.handlers:
+                        visit(handler.body)
+                elif isinstance(node, ast.ClassDef):
+                    visit(node.body)
+        visit(sf.tree.body)
+        return out
+
+    def resolve_first_party(self, dotted: str) -> str | None:
+        """Dotted import target -> module name in this project, or None
+        for third-party / stdlib. `pkg.name` resolves to `pkg.name`,
+        `pkg.name.__init__`, or (an attribute import) its parent."""
+        for cand in (dotted, f"{dotted}.__init__"):
+            if cand in self.modules:
+                return cand
+        if "." in dotted:
+            parent = dotted.rsplit(".", 1)[0]
+            for cand in (parent, f"{parent}.__init__"):
+                if cand in self.modules:
+                    return cand
+        return None
+
+
+class Baseline:
+    """The checked-in grandfather file: a sorted list of finding keys.
+
+    Keys use the normalized source line as identity (see Finding.anchor)
+    so unrelated edits moving line numbers don't churn the file. The
+    workflow is one-way by policy — tests/test_lint.py pins that this
+    file only ever shrinks."""
+
+    def __init__(self, keys=()):
+        self.keys = set(keys)
+
+    @classmethod
+    def load(cls, path: str | Path) -> "Baseline":
+        try:
+            raw = json.loads(Path(path).read_text())
+        except (OSError, json.JSONDecodeError):
+            return cls()
+        return cls(raw.get("findings", []))
+
+    def save(self, path: str | Path) -> None:
+        Path(path).write_text(json.dumps(
+            {"findings": sorted(self.keys)}, indent=2) + "\n")
+
+    def split(self, findings: list[Finding]
+              ) -> tuple[list[Finding], list[Finding], list[str]]:
+        """(new, grandfathered, stale-keys): stale keys are baseline
+        entries matching no current finding — the debt was paid, so the
+        entry must be deleted (the shrink-only test enforces it)."""
+        new = [f for f in findings if f.key not in self.keys]
+        old = [f for f in findings if f.key in self.keys]
+        live = {f.key for f in findings}
+        stale = sorted(k for k in self.keys if k not in live)
+        return new, old, stale
+
+
+DEFAULT_BASELINE = Path(__file__).with_name("baseline.json")
+
+
+@dataclasses.dataclass
+class LintResult:
+    findings: list[Finding]        # non-suppressed, non-baselined
+    baselined: list[Finding]
+    suppressed_count: int
+    stale_baseline: list[str]
+    parse_errors: list[Finding]
+
+    @property
+    def clean(self) -> bool:
+        return not self.findings and not self.parse_errors
+
+    def as_dict(self) -> dict:
+        return {
+            "clean": self.clean,
+            "findings": [f.as_dict() for f in self.findings],
+            "baselined": [f.as_dict() for f in self.baselined],
+            "suppressed": self.suppressed_count,
+            "stale_baseline": self.stale_baseline,
+            "parse_errors": [f.as_dict() for f in self.parse_errors],
+            "counts": _counts(self.findings),
+        }
+
+
+def _counts(findings: list[Finding]) -> dict[str, int]:
+    out: dict[str, int] = {}
+    for f in findings:
+        out[f.rule] = out.get(f.rule, 0) + 1
+    return out
+
+
+def run_lint(root: str | Path, baseline: Baseline | None = None,
+             rules: dict | None = None,
+             scan_paths=config.SCAN_PATHS) -> LintResult:
+    """Run every rule over the tree at ``root``; apply suppressions and
+    the baseline; return the full verdict. Rule callables get the
+    Project and return raw findings — everything workflow-shaped
+    happens here, once."""
+    from .rules import RULES
+
+    project = Project(root, scan_paths=scan_paths)
+    parse_errors = [
+        sf.finding("SW000", 1, f"syntax error: {sf.parse_error}")
+        for sf in project.files.values() if sf.parse_error
+    ]
+    raw: list[Finding] = []
+    for code, rule in sorted((rules or RULES).items()):
+        raw.extend(rule.check(project))
+    raw.sort(key=lambda f: (f.path, f.line, f.rule))
+
+    kept: list[Finding] = []
+    suppressed = 0
+    for f in raw:
+        sf = project.file(f.path)
+        if sf is not None and sf.suppressed(f):
+            suppressed += 1
+        else:
+            kept.append(f)
+
+    baseline = baseline or Baseline()
+    new, old, stale = baseline.split(kept)
+    # a narrowed run (--rules SW00x) cannot judge other rules' baseline
+    # entries stale — only rules that actually ran produce findings
+    ran = set((rules or RULES).keys())
+    stale = [k for k in stale if k.split("|", 1)[0] in ran]
+    return LintResult(new, old, suppressed, stale, parse_errors)

@@ -1,0 +1,602 @@
+"""The SW rules. Each rule is a small class with `code`, `title`, and
+`check(project) -> list[Finding]`; the engine (core.run_lint) owns
+suppression and baseline handling, so rules just report what they see.
+
+Rules are deliberately shallow pattern matchers over the AST — a
+tripwire, not a proof system. Where a rule cannot see through an
+indirection (a blocking call hidden behind a helper, a cache bounded in
+another module), it stays silent; the reviewer folklore it replaces had
+the same blind spots, minus the consistency.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+
+from . import config
+from .core import Finding, Project, SourceFile
+
+
+class Rule:
+    code = "SW000"
+    title = ""
+
+    def check(self, project: Project) -> list[Finding]:
+        raise NotImplementedError
+
+
+# --- helpers ---------------------------------------------------------------
+
+
+def _call_target(node: ast.Call) -> tuple[str | None, str | None]:
+    """(owner, name) for a call: owner is the dotted-most base name for
+    `owner.name(...)`, None for a bare `name(...)`."""
+    func = node.func
+    if isinstance(func, ast.Attribute):
+        base = func.value
+        if isinstance(base, ast.Name):
+            return base.id, func.attr
+        if isinstance(base, ast.Attribute) and isinstance(
+                base.value, ast.Name):
+            # e.g. urllib.request.urlopen -> owner "urllib"
+            return base.value.id, func.attr
+        return "", func.attr
+    if isinstance(func, ast.Name):
+        return None, func.id
+    return "", None
+
+
+def _iter_own_statements(fn: ast.AsyncFunctionDef):
+    """Walk a coroutine's own body, never descending into nested
+    function scopes (nested defs/lambdas usually run off-loop via
+    run_in_executor; nested `async def`s get their own visit). A
+    blocking call hidden behind an inline call to such a nested def is
+    an acknowledged blind spot — tripwire, not proof."""
+    stack = list(fn.body)
+    while stack:
+        node = stack.pop()
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Lambda)):
+            continue
+        yield node
+        stack.extend(ast.iter_child_nodes(node))
+
+
+def _const_str(node, module_consts: dict[str, str]) -> str | None:
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value
+    if isinstance(node, ast.Name):
+        return module_consts.get(node.id)
+    return None
+
+
+def _module_str_consts(tree: ast.Module) -> dict[str, str]:
+    out: dict[str, str] = {}
+    for node in tree.body:
+        if (isinstance(node, ast.Assign) and len(node.targets) == 1
+                and isinstance(node.targets[0], ast.Name)
+                and isinstance(node.value, ast.Constant)
+                and isinstance(node.value.value, str)):
+            out[node.targets[0].id] = node.value.value
+    return out
+
+
+# --- SW001 -----------------------------------------------------------------
+
+
+class JaxFreePurity(Rule):
+    code = "SW001"
+    title = ("accelerator import reachable from a declared jax-free "
+             "module (module-level, transitive)")
+
+    def _roots(self, project: Project) -> list[SourceFile]:
+        roots: list[SourceFile] = []
+        for spec in config.JAXFREE_ROOTS:
+            if spec.endswith(".py"):
+                sf = project.file(spec)
+                if sf is not None:
+                    roots.append(sf)
+            else:
+                prefix = spec.rstrip("/") + "/"
+                roots.extend(sf for rel, sf in sorted(project.files.items())
+                             if rel.startswith(prefix))
+        return roots
+
+    def check(self, project: Project) -> list[Finding]:
+        # per-module direct facts, computed once
+        direct_bad: dict[str, list[tuple[str, int]]] = {}
+        deps: dict[str, dict[str, int]] = {}  # module -> dep -> line
+        for mod, sf in project.modules.items():
+            bad: dict[str, int] = {}
+            dep_lines: dict[str, int] = {}
+            for target, line in project.toplevel_imports(sf):
+                if target.split(".")[0] in config.ACCELERATOR_PACKAGES:
+                    bad.setdefault(target, line)
+                fp = project.resolve_first_party(target)
+                if fp is not None and fp != mod:
+                    dep_lines.setdefault(fp, line)
+            direct_bad[mod] = sorted(bad.items())
+            deps[mod] = dep_lines
+
+        findings: list[Finding] = []
+        for sf in self._roots(project):
+            root_mod = project.module_name(sf.rel)
+            # BFS with parent pointers for chain reconstruction
+            parent: dict[str, str] = {root_mod: ""}
+            order = [root_mod]
+            i = 0
+            while i < len(order):
+                mod = order[i]
+                i += 1
+                for dep in deps.get(mod, {}):
+                    if dep not in parent:
+                        parent[dep] = mod
+                        order.append(dep)
+            reported: set[str] = set()
+            for mod in order:
+                if not direct_bad.get(mod):
+                    continue
+                # rebuild the chain root -> ... -> mod
+                chain = [mod]
+                while parent[chain[-1]]:
+                    chain.append(parent[chain[-1]])
+                chain.reverse()
+                if mod in reported:
+                    continue
+                reported.add(mod)
+                # anchor at the root's own offending line: the import
+                # starting the chain, or — for a direct violation — the
+                # forbidden import itself (so per-line suppression and
+                # baseline anchors land on the real statement)
+                if len(chain) > 1:
+                    line = deps[chain[0]].get(chain[1], 1)
+                else:
+                    line = direct_bad[mod][0][1]
+                via = " -> ".join(chain)
+                pkgs = ", ".join(name for name, _ in direct_bad[mod])
+                findings.append(sf.finding(
+                    self.code, line,
+                    f"jax-free module reaches {pkgs} at module level "
+                    f"via {via}; make the accelerator import lazy "
+                    "(function-local) or drop the dependency"))
+        return findings
+
+
+# --- SW002 -----------------------------------------------------------------
+
+
+class AsyncBlockingCalls(Rule):
+    code = "SW002"
+    title = "blocking call on the event loop (inside `async def`)"
+
+    def check(self, project: Project) -> list[Finding]:
+        findings: list[Finding] = []
+        for sf in project.files.values():
+            if sf.tree is None:
+                continue
+            for fn in ast.walk(sf.tree):
+                if not isinstance(fn, ast.AsyncFunctionDef):
+                    continue
+                for node in _iter_own_statements(fn):
+                    if not isinstance(node, ast.Call):
+                        continue
+                    owner, name = _call_target(node)
+                    hit = None
+                    if owner is None and name in config.BLOCKING_NAME_CALLS:
+                        hit = f"{name}()"
+                    elif owner and (owner, name) in \
+                            config.BLOCKING_MODULE_CALLS:
+                        hit = f"{owner}.{name}()"
+                    elif name in config.BLOCKING_METHOD_NAMES:
+                        hit = f".{name}()"
+                    if hit:
+                        findings.append(sf.finding(
+                            self.code, node.lineno,
+                            f"{hit} blocks the event loop inside "
+                            f"`async def {fn.name}`; route it through "
+                            "run_in_executor / asyncio.to_thread"))
+        return findings
+
+
+# --- SW003 -----------------------------------------------------------------
+
+
+class HiveClockDiscipline(Rule):
+    code = "SW003"
+    title = "direct wall/monotonic clock read in hive_server/ (use HiveClock)"
+
+    def check(self, project: Project) -> list[Finding]:
+        findings: list[Finding] = []
+        prefix = config.HIVE_SERVER_DIR.rstrip("/") + "/"
+        for rel, sf in sorted(project.files.items()):
+            if not rel.startswith(prefix) or rel == config.CLOCK_MODULE:
+                continue
+            if sf.tree is None:
+                continue
+            for node in ast.walk(sf.tree):
+                if not isinstance(node, ast.Call):
+                    continue
+                owner, name = _call_target(node)
+                if owner and (owner, name) in config.CLOCK_CALLS:
+                    findings.append(sf.finding(
+                        self.code, node.lineno,
+                        f"{owner}.{name}() in hive_server bypasses "
+                        "HiveClock; use clock.mono() for intervals, "
+                        "clock.wall() for persistence (clock.py)"))
+        return findings
+
+
+# --- SW004 -----------------------------------------------------------------
+
+
+class SettingsKnobDrift(Rule):
+    code = "SW004"
+    title = "Settings knob drift (env override / README row / settings test)"
+
+    def check(self, project: Project) -> list[Finding]:
+        sf = project.file(config.SETTINGS_FILE)
+        if sf is None or sf.tree is None:
+            return []
+        fields: dict[str, int] = {}
+        env_by_field: dict[str, str] = {}
+        overrides_line = 1
+        for node in ast.walk(sf.tree):
+            if isinstance(node, ast.ClassDef) and node.name == "Settings":
+                for stmt in node.body:
+                    if isinstance(stmt, ast.AnnAssign) and isinstance(
+                            stmt.target, ast.Name):
+                        fields[stmt.target.id] = stmt.lineno
+            elif (isinstance(node, ast.Assign)
+                    and len(node.targets) == 1
+                    and isinstance(node.targets[0], ast.Name)
+                    and node.targets[0].id == "_ENV_OVERRIDES"
+                    and isinstance(node.value, ast.Dict)):
+                overrides_line = node.lineno
+                for k, v in zip(node.value.keys, node.value.values):
+                    if (isinstance(k, ast.Constant)
+                            and isinstance(v, ast.Constant)):
+                        env_by_field[str(v.value)] = str(k.value)
+        readme = project.read_text(config.README_FILE) or ""
+        tests = project.read_text(config.SETTINGS_TEST_FILE) or ""
+
+        findings: list[Finding] = []
+        for field, line in fields.items():
+            env = env_by_field.get(field)
+            if env is None:
+                findings.append(sf.finding(
+                    self.code, line,
+                    f"Settings.{field} has no env override in "
+                    "_ENV_OVERRIDES (CHIASWARM_* / legacy SDAAS_*)"))
+            if field not in readme:
+                findings.append(sf.finding(
+                    self.code, line,
+                    f"Settings.{field} has no README knob-table row "
+                    "(see \"Configuration reference\")"))
+            elif env is not None and env not in readme:
+                findings.append(sf.finding(
+                    self.code, line,
+                    f"env override {env} for Settings.{field} is not "
+                    "documented in the README"))
+            if field not in tests:
+                findings.append(sf.finding(
+                    self.code, line,
+                    f"Settings.{field} is never referenced in "
+                    f"{config.SETTINGS_TEST_FILE}"))
+        for field, env in sorted(env_by_field.items()):
+            if field not in fields:
+                findings.append(sf.finding(
+                    self.code, overrides_line,
+                    f"env override {env} maps to nonexistent "
+                    f"Settings.{field}"))
+        return findings
+
+
+# --- SW005 -----------------------------------------------------------------
+
+
+_METRIC_METHODS = ("counter", "gauge", "histogram")
+_DOC_NAME_RE = re.compile(r"swarm_[a-z0-9_]+")
+_DOC_SUFFIX_RE = re.compile(r"`(_[a-z0-9_]+)`")
+
+
+class MetricCatalogDrift(Rule):
+    code = "SW005"
+    title = "registered swarm_* metric missing/mismatched in README catalog"
+
+    @staticmethod
+    def _registrations(project: Project):
+        """(name, labels, sf, line) for every metric registration in the
+        package: a call to counter/gauge/histogram (any receiver) whose
+        first argument resolves to a swarm_* string literal."""
+        for rel, sf in sorted(project.files.items()):
+            if not rel.startswith(config.METRICS_SCAN_PREFIX):
+                continue
+            if sf.tree is None:
+                continue
+            consts = _module_str_consts(sf.tree)
+            for node in ast.walk(sf.tree):
+                if not isinstance(node, ast.Call):
+                    continue
+                _, name = _call_target(node)
+                if name not in _METRIC_METHODS or not node.args:
+                    continue
+                metric = _const_str(node.args[0], consts)
+                if not metric or not metric.startswith(
+                        config.METRIC_PREFIX):
+                    continue
+                labels: list[str] = []
+                label_node = None
+                if len(node.args) >= 3:
+                    label_node = node.args[2]
+                for kw in node.keywords:
+                    if kw.arg == "labelnames":
+                        label_node = kw.value
+                if isinstance(label_node, (ast.Tuple, ast.List)):
+                    labels = [e.value for e in label_node.elts
+                              if isinstance(e, ast.Constant)]
+                yield metric, labels, sf, node.lineno
+
+    @staticmethod
+    def _catalog(readme: str):
+        """(catalog, rows): catalog maps each fully-spelled metric name
+        to its concatenated labels-cell text; rows keeps every parsed
+        (full names, suffix tokens, labels cell) triple so shorthand
+        suffix forms (`swarm_outbox_spooled_total` / `_delivered_total`)
+        can expand against a row's first full name."""
+        rows: list[tuple[list[str], list[str], str]] = []
+        for line in readme.splitlines():
+            if not line.lstrip().startswith("|") or "swarm_" not in line:
+                continue
+            cells = [c.strip() for c in line.strip().strip("|").split("|")]
+            metric_cell = cells[0] if cells else ""
+            labels_cell = cells[2] if len(cells) >= 3 else ""
+            full = _DOC_NAME_RE.findall(metric_cell)
+            suffixes = _DOC_SUFFIX_RE.findall(metric_cell)
+            if full:
+                rows.append((full, suffixes, labels_cell))
+        catalog: dict[str, str] = {}
+        for full, _suffixes, labels_cell in rows:
+            for name in full:
+                catalog[name] = catalog.get(name, "") + " " + labels_cell
+        return catalog, rows
+
+    def check(self, project: Project) -> list[Finding]:
+        readme = project.read_text(config.README_FILE) or ""
+        catalog, rows = self._catalog(readme)
+        findings: list[Finding] = []
+        seen: set[str] = set()
+        for metric, labels, sf, line in self._registrations(project):
+            if metric in seen:
+                continue
+            seen.add(metric)
+            labels_cell = catalog.get(metric)
+            if labels_cell is None:
+                # try the suffix shorthand: metric = prefix(first full
+                # name of some row) + documented `_suffix`
+                for full, suffixes, cell in rows:
+                    anchor_name = full[0]
+                    for sfx in suffixes:
+                        if (metric.endswith(sfx) and anchor_name.startswith(
+                                metric[: len(metric) - len(sfx)])):
+                            labels_cell = cell
+                            break
+                    if labels_cell is not None:
+                        break
+            if labels_cell is None:
+                findings.append(sf.finding(
+                    self.code, line,
+                    f"metric {metric} is registered but missing from the "
+                    "README metric catalog"))
+                continue
+            for label in labels:
+                if label not in labels_cell:
+                    findings.append(sf.finding(
+                        self.code, line,
+                        f"metric {metric} label `{label}` is not in its "
+                        "README catalog row's label column"))
+        return findings
+
+
+# --- SW006 -----------------------------------------------------------------
+
+
+class WalEventExhaustiveness(Rule):
+    code = "SW006"
+    title = "ev_* journal event without replay/compaction/replication handling"
+
+    def check(self, project: Project) -> list[Finding]:
+        sf = project.file(config.JOURNAL_FILE)
+        if sf is None or sf.tree is None:
+            return []
+        constructors: dict[str, tuple[str, int]] = {}  # fn -> (ev, line)
+        apply_fn = snapshot_fn = None
+        for node in sf.tree.body:
+            if not isinstance(node, ast.FunctionDef):
+                continue
+            if node.name == "apply_events":
+                apply_fn = node
+            elif node.name == "snapshot_events":
+                snapshot_fn = node
+            elif node.name.startswith("ev_"):
+                ev_type = None
+                for sub in ast.walk(node):
+                    if isinstance(sub, ast.Dict):
+                        for k, v in zip(sub.keys, sub.values):
+                            if (isinstance(k, ast.Constant)
+                                    and k.value == "ev"
+                                    and isinstance(v, ast.Constant)):
+                                ev_type = str(v.value)
+                if ev_type:
+                    constructors[node.name] = (ev_type, node.lineno)
+
+        replayed: set[str] = set()
+        if apply_fn is not None:
+            known = {ev for ev, _ in constructors.values()}
+            for sub in ast.walk(apply_fn):
+                if isinstance(sub, ast.Constant) and sub.value in known:
+                    replayed.add(sub.value)
+        compacted: set[str] = set()
+        if snapshot_fn is not None:
+            for sub in ast.walk(snapshot_fn):
+                if isinstance(sub, ast.Call):
+                    _, name = _call_target(sub)
+                    if name in constructors:
+                        compacted.add(name)
+
+        findings: list[Finding] = []
+        for fn_name, (ev_type, line) in sorted(constructors.items()):
+            if ev_type not in replayed:
+                findings.append(sf.finding(
+                    self.code, line,
+                    f"journal event '{ev_type}' ({fn_name}) has no "
+                    "replay branch in apply_events — a crash or standby "
+                    "would silently drop this transition"))
+            if fn_name not in compacted:
+                findings.append(sf.finding(
+                    self.code, line,
+                    f"journal event '{ev_type}' ({fn_name}) is never "
+                    "emitted by snapshot_events — compaction would "
+                    "erase this transition from the stream"))
+        # replication must ride the same apply path recovery uses
+        repl = project.file(config.REPLICATION_FILE)
+        if repl is not None and "apply_events" not in repl.text:
+            findings.append(sf.finding(
+                self.code, 1,
+                "replication no longer applies the stream through "
+                "journal.apply_events — the standby's correctness "
+                "argument (same path as recovery) is broken"))
+        return findings
+
+
+# --- SW007 -----------------------------------------------------------------
+
+
+_DICT_CTORS = {"dict", "OrderedDict", "defaultdict"}
+
+
+class UnboundedCacheDict(Rule):
+    code = "SW007"
+    title = "cache dict with no eviction (byte/entry cap) in its module"
+
+    @staticmethod
+    def _target_name(node) -> str | None:
+        if isinstance(node, ast.Name):
+            return node.id
+        if isinstance(node, ast.Attribute):
+            return node.attr
+        return None
+
+    def check(self, project: Project) -> list[Finding]:
+        findings: list[Finding] = []
+        sub = config.CACHE_NAME_SUBSTRING
+        for rel, sf in sorted(project.files.items()):
+            if sf.tree is None:
+                continue
+            for node in ast.walk(sf.tree):
+                if isinstance(node, ast.Assign):
+                    targets, value = node.targets, node.value
+                elif isinstance(node, ast.AnnAssign) and node.value:
+                    targets, value = [node.target], node.value
+                else:
+                    continue
+                if isinstance(value, ast.Dict):
+                    if value.keys:  # literal with entries: a lookup
+                        continue    # table, not an accumulating cache
+                elif isinstance(value, ast.Call):
+                    _, ctor = _call_target(value)
+                    if ctor not in _DICT_CTORS:
+                        continue  # a cache class is presumed bounded
+                else:
+                    continue
+                for target in targets:
+                    name = self._target_name(target)
+                    if name is None:
+                        continue
+                    if (sub not in name.lower()
+                            and name not in config.CACHE_EXTRA_NAMES):
+                        continue
+                    if re.search(
+                            rf"(?<![A-Za-z0-9_]){re.escape(name)}\s*\.\s*"
+                            r"popitem", sf.text):
+                        continue  # LRU eviction present in this module
+                    findings.append(sf.finding(
+                        self.code, node.lineno,
+                        f"cache dict `{name}` has no eviction in "
+                        f"{rel} — every growth axis needs a byte or "
+                        "entry cap (popitem LRU) or an explicit "
+                        "suppression arguing why it is bounded"))
+        return findings
+
+
+# --- SW008 -----------------------------------------------------------------
+
+
+_SWALLOWABLE = {"BaseException", "CancelledError"}
+
+
+class ExceptionHygiene(Rule):
+    code = "SW008"
+    title = "bare except / swallowed CancelledError in a coroutine"
+
+    @staticmethod
+    def _catches_swallowable(handler: ast.ExceptHandler) -> str | None:
+        t = handler.type
+        names = []
+        if t is None:
+            return "bare except"
+        elts = t.elts if isinstance(t, ast.Tuple) else [t]
+        for e in elts:
+            if isinstance(e, ast.Name):
+                names.append(e.id)
+            elif isinstance(e, ast.Attribute):
+                names.append(e.attr)
+        hit = sorted(set(names) & _SWALLOWABLE)
+        return f"except {hit[0]}" if hit else None
+
+    @staticmethod
+    def _reraises(handler: ast.ExceptHandler) -> bool:
+        return any(isinstance(n, ast.Raise) for n in ast.walk(handler))
+
+    def check(self, project: Project) -> list[Finding]:
+        findings: list[Finding] = []
+        for rel, sf in sorted(project.files.items()):
+            if sf.tree is None:
+                continue
+            # every handler lexically inside an async def swallows
+            # cancellation for the whole task tree above it
+            async_handlers: set[int] = set()
+            for fn in ast.walk(sf.tree):
+                if isinstance(fn, ast.AsyncFunctionDef):
+                    for n in ast.walk(fn):
+                        if isinstance(n, ast.ExceptHandler):
+                            async_handlers.add(id(n))
+            for node in ast.walk(sf.tree):
+                if not isinstance(node, ast.ExceptHandler):
+                    continue
+                if node.type is None:
+                    findings.append(sf.finding(
+                        self.code, node.lineno,
+                        "bare `except:` catches SystemExit/"
+                        "KeyboardInterrupt/CancelledError; catch "
+                        "Exception (or narrower) instead"))
+                    continue
+                if id(node) not in async_handlers:
+                    continue
+                caught = self._catches_swallowable(node)
+                if caught and not self._reraises(node):
+                    findings.append(sf.finding(
+                        self.code, node.lineno,
+                        f"`{caught}` inside a coroutine swallows task "
+                        "cancellation; re-raise CancelledError or "
+                        "narrow the handler"))
+        return findings
+
+
+RULES: dict[str, Rule] = {
+    r.code: r for r in (
+        JaxFreePurity(), AsyncBlockingCalls(), HiveClockDiscipline(),
+        SettingsKnobDrift(), MetricCatalogDrift(),
+        WalEventExhaustiveness(), UnboundedCacheDict(), ExceptionHygiene(),
+    )
+}

@@ -62,7 +62,10 @@ class CLIPTokenizer:
         self.max_length = max_length
         self.bos = vocab.get("<|startoftext|>", len(vocab) - 2)
         self.eos = vocab.get("<|endoftext|>", len(vocab) - 1)
-        self._cache: dict[str, list[str]] = {}
+        # per-token BPE memo (HF tokenizers keep the same memo
+        # unbounded): entries are a few hundred bytes and the key space
+        # is natural-language vocabulary, not request volume
+        self._cache: dict[str, list[str]] = {}  # swarmlint: disable=SW007
 
     @classmethod
     def from_dir(cls, path: str | Path, max_length: int = 77) -> "CLIPTokenizer":

@@ -62,6 +62,19 @@ _COMPILE_CACHE = telemetry_counter(
     ("event",),
 )
 
+# ISSUE 15 (SW007 headline): the program/runner variant caches gained an
+# unbounded growth axis with runtime-delta LoRA — one compiled variant
+# per (slot-bucket, rank-bucket, targeted-module-path-set), and the
+# path-set fan-out is census-dependent. `program_cache_max` bounds both
+# caches per pipeline; evictions (with the compiled executable freed via
+# clear_cache) are counted here so a thrashing fleet is visible
+_PROGRAM_EVICTED = telemetry_counter(
+    "swarm_program_cache_evicted_total",
+    "Compiled denoise programs / assembled runners evicted LRU at the "
+    "program_cache_max bound, by kind",
+    ("kind",),
+)
+
 # padded-vs-real rows through run_batched: how much of each coalesced
 # pass was real work vs power-of-two padding (batching ROI, per PR 1)
 _BATCH_ROWS = telemetry_counter(
@@ -335,11 +348,15 @@ class SDPipeline:
         logger.info("%s resident in %.1fs (dtype=%s)", model_name, self.load_s, dtype)
 
         self._jit_lock = threading.Lock()
-        self._programs: dict[tuple, callable] = {}
+        # LRU-bounded (program_cache_max; _trim_program_caches): the
+        # runtime-delta adapter path compiles one variant per signature
+        # and the signature space is census-dependent, so the cache must
+        # evict — executables included — instead of growing forever
+        self._programs: OrderedDict[tuple, callable] = OrderedDict()
         # assembled denoise runners (fused wrapper or chunked set) keyed
         # (bucket key, chunk size): a warm pass is one dict lookup, not a
         # scheduler rebuild + per-sub-program cache probe
-        self._runner_cache: dict[tuple, callable] = {}
+        self._runner_cache: OrderedDict[tuple, callable] = OrderedDict()
         # jitted aux programs — ONE device dispatch for text encode and VAE
         # encode instead of op-by-op applies (each unjitted op is a separate
         # host->device round trip; round 1 measured >50% of job time on the
@@ -1357,14 +1374,51 @@ class SDPipeline:
 
         return prep, make_steps, decode, (loop_start, loop_end)
 
+    @staticmethod
+    def _program_cache_max() -> int:
+        """Settings.program_cache_max at call time (env-overridable,
+        CHIASWARM_PROGRAM_CACHE_MAX); 0 = unbounded."""
+        try:
+            return max(int(getattr(
+                load_settings(), "program_cache_max", 64) or 0), 0)
+        except Exception:
+            return 64
+
+    def _trim_program_caches(self) -> None:
+        """LRU-bound both variant caches to program_cache_max (caller
+        holds _jit_lock). Evicted programs get their compiled executable
+        dropped too (PjitFunction.clear_cache) — evicting only the dict
+        reference would leak the XLA executable until pipeline release,
+        which is exactly the unbounded axis this bound exists to close.
+        A runner closure may still reference a cleared program; its next
+        call retraces (counted as a compile-cache miss), never breaks."""
+        cap = self._program_cache_max()
+        if cap <= 0:
+            return
+        while len(self._programs) > cap:
+            _, evicted = self._programs.popitem(last=False)
+            clear = getattr(evicted, "clear_cache", None)
+            if callable(clear):
+                try:
+                    clear()
+                except Exception:  # freeing best-effort, never fatal
+                    logger.debug("clear_cache failed on evicted program",
+                                 exc_info=True)
+            _PROGRAM_EVICTED.inc(kind="program")
+        while len(self._runner_cache) > cap:
+            self._runner_cache.popitem(last=False)
+            _PROGRAM_EVICTED.inc(kind="runner")
+
     def _program(self, cache_key, build):
         """One jitted program per cache key, sharing the compile-cache
         metrics and the placement-layer residency note across every
         denoise program kind (fused, prep, chunk, decode)."""
         with self._jit_lock:
-            if cache_key in self._programs:
+            cached = self._programs.get(cache_key)
+            if cached is not None:
+                self._programs.move_to_end(cache_key)
                 _COMPILE_CACHE.inc(event="hit")
-                return self._programs[cache_key]
+                return cached
         _COMPILE_CACHE.inc(event="miss")
         if self.chipset is not None:
             # compile event -> placement layer: refresh this model's
@@ -1376,6 +1430,8 @@ class SDPipeline:
         program = jax.jit(build())
         with self._jit_lock:
             self._programs[cache_key] = program
+            self._programs.move_to_end(cache_key)
+            self._trim_program_caches()
         return program
 
     def _geo_key(self, key, geo):
@@ -1512,6 +1568,8 @@ class SDPipeline:
         cache_key = (key, chunk, geo, lora_sig)
         with self._jit_lock:
             cached = self._runner_cache.get(cache_key)
+            if cached is not None:
+                self._runner_cache.move_to_end(cache_key)
         if cached is not None:
             return cached
         mesh, _ = self._geometry_view(geo)
@@ -1614,6 +1672,8 @@ class SDPipeline:
 
         with self._jit_lock:
             self._runner_cache[cache_key] = runner
+            self._runner_cache.move_to_end(cache_key)
+            self._trim_program_caches()
         return runner
 
     @staticmethod

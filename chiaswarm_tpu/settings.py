@@ -100,6 +100,15 @@ class Settings:
     # adapters with rank beyond this serve via the merged-tree fallback
     # (their padded factor stacks would rival the activations they ride)
     lora_rank_max: int = 128
+    # most compiled denoise-program variants (and assembled runners) one
+    # pipeline keeps resident (pipelines/stable_diffusion.py). The
+    # runtime-delta adapter path compiles one variant per (slot-bucket,
+    # rank-bucket, targeted-module-path-set) and the path-set fan-out is
+    # census-dependent, so a fleet-realistic worker bounds the cache:
+    # past the cap the LRU entry is evicted WITH its compiled executable
+    # (counted in swarm_program_cache_evicted_total). 0 = unbounded
+    # (the pre-ISSUE-15 behavior)
+    program_cache_max: int = 64
     # chunked denoise (pipelines/stable_diffusion.py): run the compiled
     # denoise loop in chunks of this many steps, probing the cancel
     # registry (cancel.py) at every chunk boundary so a cancelled job
@@ -271,9 +280,22 @@ class Settings:
         return tuple(f.name for f in dataclasses.fields(cls))
 
 
-# env var -> settings attribute (reference swarm/settings.py:38-41)
+# env var -> settings attribute (reference swarm/settings.py:38-41).
+# Every Settings field has exactly one override here (swarmlint SW004
+# enforces it): SDAAS_* spellings are reference parity, CHIASWARM_*
+# everything since.
 _ENV_OVERRIDES = {
     "SDAAS_TOKEN": "sdaas_token",
+    "CHIASWARM_LOG_LEVEL": "log_level",
+    "CHIASWARM_LOG_FILENAME": "log_filename",
+    "CHIASWARM_LORA_ROOT_DIR": "lora_root_dir",
+    "CHIASWARM_MODEL_ROOT_DIR": "model_root_dir",
+    "CHIASWARM_DEPTH_MODEL": "depth_model",
+    "CHIASWARM_POSE_MODEL": "pose_model",
+    "CHIASWARM_SAFETY_CHECKER_MODEL": "safety_checker_model",
+    "CHIASWARM_PROFILER_PORT": "profiler_port",
+    "CHIASWARM_JOB_DEADLINE_COMPILE_SCALE": "job_deadline_compile_scale",
+    "CHIASWARM_QUARANTINE_PROBE_GRACE_S": "quarantine_probe_grace_s",
     "SDAAS_URI": "sdaas_uri",
     "SDAAS_WORKERNAME": "worker_name",
     "SDAAS_CHIPS_PER_JOB": "chips_per_job",
@@ -308,6 +330,7 @@ _ENV_OVERRIDES = {
     "CHIASWARM_LORA_CACHE_MB": "lora_cache_mb",
     "CHIASWARM_LORA_SLOTS_MAX": "lora_slots_max",
     "CHIASWARM_LORA_RANK_MAX": "lora_rank_max",
+    "CHIASWARM_PROGRAM_CACHE_MAX": "program_cache_max",
     "CHIASWARM_DENOISE_CHUNK_STEPS": "denoise_chunk_steps",
     "CHIASWARM_SHARD_INTERACTIVE": "shard_interactive",
     "CHIASWARM_SHARD_TENSOR": "shard_tensor",

@@ -163,7 +163,12 @@ def test_registry_residency():
 
 
 def test_program_cache_reused(tiny_sd):
+    # clear BOTH cache levels: the assembled-runner cache memoizes the
+    # whole execution strategy, so a warm runner never re-resolves
+    # programs — clearing only _programs would assert against a pass
+    # that (correctly) compiled nothing
     tiny_sd._programs.clear()
+    tiny_sd._runner_cache.clear()
     kw = dict(prompt="warm", height=64, width=64, num_inference_steps=2,
               rng=jax.random.key(0))
     tiny_sd.run(**kw)

@@ -1,0 +1,114 @@
+"""ISSUE 15 (SW007 headline): the compiled program/runner variant caches
+are LRU-bounded by `program_cache_max`, evictions free the compiled
+executable (clear_cache) and are counted in
+swarm_program_cache_evicted_total.
+
+The thrash tests drive SDPipeline._program / _trim_program_caches on a
+bare instance (no weights, no chips — the cache discipline is pure dict
++ lock mechanics) with jax.jit stubbed to a recorder, so the growth axis
+that motivated the bound — one variant per (slot-bucket, rank-bucket,
+targeted-module-path-set) — is simulated as distinct cache keys.
+"""
+
+import threading
+from collections import OrderedDict
+
+import pytest
+
+from chiaswarm_tpu import telemetry
+from chiaswarm_tpu.pipelines import stable_diffusion as sd
+
+
+class RecordingProgram:
+    """Stands in for a PjitFunction: callable, clear_cache-able."""
+
+    def __init__(self, fn):
+        self.fn = fn
+        self.cleared = False
+
+    def __call__(self, *a, **kw):
+        return self.fn(*a, **kw)
+
+    def clear_cache(self):
+        self.cleared = True
+
+
+@pytest.fixture
+def pipeline(monkeypatch, sdaas_root):
+    """A bare SDPipeline carrying only what the program cache touches."""
+    monkeypatch.setattr(sd.jax, "jit", RecordingProgram)
+    p = sd.SDPipeline.__new__(sd.SDPipeline)
+    p.model_name = "cache-thrash-test"
+    p.chipset = None
+    p._jit_lock = threading.Lock()
+    p._programs = OrderedDict()
+    p._runner_cache = OrderedDict()
+    return p
+
+
+def _evicted(kind: str) -> float:
+    metric = telemetry.REGISTRY.get("swarm_program_cache_evicted_total")
+    return metric.value(kind=kind) if metric is not None else 0.0
+
+
+def test_program_cache_entries_bounded_and_counted(pipeline, monkeypatch):
+    monkeypatch.setenv("CHIASWARM_PROGRAM_CACHE_MAX", "4")
+    before = _evicted("program")
+    programs = [
+        pipeline._program(("bucket", i), lambda i=i: (lambda: i))
+        for i in range(10)
+    ]
+    assert len(pipeline._programs) == 4
+    assert _evicted("program") - before == 6
+    # the oldest six were evicted WITH their executables freed
+    assert [p.cleared for p in programs] == [True] * 6 + [False] * 4
+    # the survivors are the most recent keys, still served as hits
+    for i in range(6, 10):
+        assert pipeline._program(("bucket", i), None) is programs[i]
+
+
+def test_lru_order_respects_hits(pipeline, monkeypatch):
+    monkeypatch.setenv("CHIASWARM_PROGRAM_CACHE_MAX", "2")
+    a = pipeline._program(("a",), lambda: (lambda: 0))
+    pipeline._program(("b",), lambda: (lambda: 1))
+    # touching `a` promotes it, so the next insert evicts `b`
+    assert pipeline._program(("a",), None) is a
+    pipeline._program(("c",), lambda: (lambda: 2))
+    assert ("a",) in pipeline._programs
+    assert ("b",) not in pipeline._programs
+    assert ("c",) in pipeline._programs
+
+
+def test_runner_cache_trimmed_at_same_bound(pipeline, monkeypatch):
+    monkeypatch.setenv("CHIASWARM_PROGRAM_CACHE_MAX", "3")
+    before = _evicted("runner")
+    with pipeline._jit_lock:
+        for i in range(8):
+            pipeline._runner_cache[("runner", i)] = lambda: i
+            pipeline._runner_cache.move_to_end(("runner", i))
+            pipeline._trim_program_caches()
+    assert len(pipeline._runner_cache) == 3
+    assert _evicted("runner") - before == 5
+    assert list(pipeline._runner_cache) == [("runner", i) for i in (5, 6, 7)]
+
+
+def test_zero_cap_means_unbounded(pipeline, monkeypatch):
+    monkeypatch.setenv("CHIASWARM_PROGRAM_CACHE_MAX", "0")
+    before = _evicted("program")
+    for i in range(100):
+        pipeline._program(("wide", i), lambda i=i: (lambda: i))
+    assert len(pipeline._programs) == 100  # the pre-ISSUE-15 behavior
+    assert _evicted("program") == before
+
+
+def test_clear_cache_failure_never_breaks_eviction(pipeline, monkeypatch):
+    monkeypatch.setenv("CHIASWARM_PROGRAM_CACHE_MAX", "1")
+
+    class Exploding(RecordingProgram):
+        def clear_cache(self):
+            raise RuntimeError("backend already torn down")
+
+    monkeypatch.setattr(sd.jax, "jit", Exploding)
+    pipeline._program(("x",), lambda: (lambda: 0))
+    pipeline._program(("y",), lambda: (lambda: 1))  # evicts ("x",)
+    assert list(pipeline._programs) == [("y",)]

@@ -244,3 +244,82 @@ def test_tracing_and_profiler_knobs(sdaas_root, monkeypatch):
     assert s.hive_replication_lag_degraded_s == 5.5
     monkeypatch.setenv("CHIASWARM_PROFILER_CAPTURE", "false")
     assert load_settings().profiler_capture is False
+
+
+# --- ISSUE 15 (swarmlint SW004): the knob catalog is a contract ------------
+
+# Every Settings field, literally. Adding a field without extending this
+# tuple — and the README "Configuration reference" row, and the
+# _ENV_OVERRIDES entry — fails this test AND `python -m chiaswarm_tpu.lint`.
+EXPECTED_FIELDS = (
+    "log_level", "log_filename", "sdaas_token", "sdaas_uri", "worker_name",
+    "lora_root_dir", "chips_per_job", "tensor_parallelism",
+    "sequence_parallelism", "ring_min_seq", "compile_cache_dir",
+    "model_root_dir", "dtype", "depth_model", "pose_model",
+    "safety_checker_model", "profiler_port", "profiler_capture",
+    "flux_streaming", "flux_stream_int8", "batch_linger_ms", "max_coalesce",
+    "embed_cache_mb", "lora_runtime_delta", "lora_cache_mb",
+    "lora_slots_max", "lora_rank_max", "program_cache_max",
+    "denoise_chunk_steps", "shard_interactive", "shard_tensor", "shard_seq",
+    "metrics_port", "metrics_host", "log_format", "job_deadline_s",
+    "job_deadline_compile_scale", "quarantine_probe_grace_s",
+    "drain_deadline_s", "outbox_dir", "outbox_max_entries",
+    "fault_injection", "hive_host", "hive_port", "hive_lease_deadline_s",
+    "hive_max_redeliveries", "hive_queue_depth_limit",
+    "hive_affinity_hold_s", "hive_worker_ttl_s", "hive_max_jobs_per_poll",
+    "hive_gang_max", "hive_spool_dir", "hive_job_history_limit",
+    "hive_job_ttl_s", "hive_wal_dir", "hive_wal_fsync",
+    "hive_wal_compact_every", "hive_shed_watermarks",
+    "hive_spool_max_bytes", "hive_spool_max_age_s", "hive_slo",
+    "hive_slo_fast_window_s", "hive_slo_slow_window_s", "hive_tenant_topk",
+    "hive_stats_ewma_alpha", "hive_straggler_factor", "sdaas_uris",
+    "hive_standby_of", "hive_replication_poll_s", "hive_failover_grace_s",
+    "hive_replication_lag_degraded_s", "hive_failover_errors",
+)
+
+
+def test_settings_field_catalog_is_exhaustive():
+    """The literal tuple above IS the drift tripwire: a new field lands
+    here in the same PR that documents and env-wires it."""
+    assert tuple(Settings.field_names()) == EXPECTED_FIELDS
+
+
+def test_every_field_has_exactly_one_env_override():
+    from chiaswarm_tpu.settings import _ENV_OVERRIDES
+
+    mapped = list(_ENV_OVERRIDES.values())
+    # no field double-mapped (last-env-wins would be load-order dependent)
+    assert sorted(mapped) == sorted(set(mapped))
+    assert set(mapped) == set(Settings.field_names())
+
+
+def test_every_env_override_roundtrips(sdaas_root, monkeypatch):
+    """Each env key actually lands on its field with the field's type —
+    the whole _ENV_OVERRIDES table, not a sampled subset."""
+    from chiaswarm_tpu.settings import _ENV_OVERRIDES
+
+    defaults = Settings()
+    for env, attr in sorted(_ENV_OVERRIDES.items()):
+        default = getattr(defaults, attr)
+        if isinstance(default, bool):  # before int: bool is an int
+            value, expect = ("0" if default else "1"), (not default)
+        elif isinstance(default, int):
+            value, expect = "1234", 1234
+        elif isinstance(default, float):
+            value, expect = "17.5", 17.5
+        else:
+            value, expect = f"env-{attr}", f"env-{attr}"
+        monkeypatch.setenv(env, value)
+        assert getattr(load_settings(), attr) == expect, (env, attr)
+        monkeypatch.delenv(env)
+        assert getattr(load_settings(), attr) == default, (env, attr)
+
+
+def test_program_cache_knob(sdaas_root, monkeypatch):
+    """ISSUE 15 (SW007 headline): the compiled-variant cache bound
+    layers like every other setting — bounded by default, env wins."""
+    assert load_settings().program_cache_max == 64
+    monkeypatch.setenv("CHIASWARM_PROGRAM_CACHE_MAX", "2")
+    assert load_settings().program_cache_max == 2
+    monkeypatch.undo()
+    assert load_settings().program_cache_max == 64
