@@ -26,6 +26,8 @@ Everything here operates on plain wire-format job dicts:
 
 from __future__ import annotations
 
+import os
+
 # wire pipeline_type strings whose txt2img semantics the batched program
 # reproduces exactly (plain prompt-conditioned CFG denoise + decode)
 _BATCHABLE_PIPELINE_TYPES = {
@@ -153,6 +155,46 @@ def adapter_ref(job: dict) -> str | None:
             str(lora.get(k) or "")
             for k in ("lora", "weight_name", "subfolder"))
     return str(lora)
+
+
+def wire_adapter_ref(ref, weight_name=None, subfolder=None) -> str:
+    """Resolved adapter parts -> the WIRE spelling the submitting
+    client used (loras.resolve_lora inverted). The worker's operand
+    cache is keyed by the RESOLVED dict — its `lora` field holds the
+    worker-local root dir for bare-name references — while the hive
+    reads the raw job string, so cross-process identity (the /work
+    resident-adapter advertisement, ISSUE 16) must reconstruct the
+    form both started from:
+
+      local root dir + weight file      -> the bare file name
+      hub repo [+ subfolder] [+ file]   -> "pub/repo[/sub...][/file]"
+
+    A worker-local root dir is configuration, not adapter identity —
+    two workers with different `lora_root_dir` serving the same
+    adapter must advertise the same ref."""
+    ref = str(ref or "")
+    name = str(weight_name or "")
+    sub = str(subfolder or "")
+    if name and os.path.isabs(os.path.expanduser(ref)):
+        return "/".join(p for p in (sub, name) if p)
+    return "/".join(p for p in (ref, sub, name) if p)
+
+
+def canonical_adapter_ref(job: dict) -> str | None:
+    """adapter_ref normalized for CROSS-PROCESS identity (the /work
+    resident-adapter advertisement, ISSUE 16): the resolved dict
+    spelling and the raw wire string collapse to one form via
+    wire_adapter_ref, so a worker whose operand cache was fed by
+    resolved-dict jobs still matches a string-form job's adapters."""
+    lora = job.get("lora")
+    if lora is None or lora == "":
+        return None
+    if isinstance(lora, dict):
+        return wire_adapter_ref(
+            lora.get("lora"), lora.get("weight_name"),
+            lora.get("subfolder"))
+    # legacy pipe-joined string spellings ("style-a||") still collapse
+    return str(lora).rstrip("|")
 
 
 # smallest padded factor rank the batched program compiles

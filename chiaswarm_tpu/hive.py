@@ -344,6 +344,19 @@ class HiveClient:
                 params["resident_models"] = ",".join(resident_models())
             except Exception:  # advertisement is advisory, never a gate
                 pass
+        # adapter-operand residency signal (ISSUE 16): which adapters'
+        # stacked device operands are warm HERE, so an adapter-aware hive
+        # can route a repeat gang back to the worker that pays zero
+        # upload for it. Same contract as resident_models: advisory,
+        # caller-overridable, ignored by legacy hives.
+        if "resident_adapters" not in params:
+            try:
+                from .lora_operands import resident_adapter_refs
+
+                params["resident_adapters"] = ",".join(
+                    resident_adapter_refs())
+            except Exception:
+                pass
         session = await self._get_session()
         timeout = aiohttp.ClientTimeout(total=ASK_TIMEOUT_S)
         t0 = time.perf_counter()

@@ -213,6 +213,15 @@ def job_usage(record) -> dict | None:
     hits = 0
     if isinstance(embed, dict) and isinstance(embed.get("hits"), int):
         hits = max(embed["hits"], 0)
+    # adapter-operand residency (ISSUE 16): device bytes the worker did
+    # NOT re-upload because the job's stacked LoRA operands were already
+    # resident. Pass-level like embed_cache: a coalesced group's
+    # envelopes each carry the shared pass figure.
+    operand = cfg.get("operand_cache")
+    operand_saved = 0
+    if isinstance(operand, dict) and isinstance(
+            operand.get("bytes_saved"), int):
+        operand_saved = max(operand["bytes_saved"], 0)
     return {
         "tenant": tenant_of(record.job),
         "chip_us": chip_us,
@@ -221,12 +230,14 @@ def job_usage(record) -> dict | None:
         "saved_us": chip_us * (group - 1) // max(group, 1),
         "embed_cache_hits": hits,
         "artifact_bytes": _artifact_bytes(record.result),
+        "operand_saved_bytes": operand_saved,
         "fallback": fallback,
     }
 
 
 _FIELDS = ("jobs", "chip_us", "rows", "coalesced_jobs", "saved_us",
-           "embed_cache_hits", "artifact_bytes", "fallback_jobs")
+           "embed_cache_hits", "artifact_bytes",
+           "operand_upload_bytes_saved", "fallback_jobs")
 
 
 def zero_bucket() -> dict:
@@ -254,6 +265,7 @@ def usage_summary(records) -> dict:
             dst["saved_us"] += usage["saved_us"]
             dst["embed_cache_hits"] += usage["embed_cache_hits"]
             dst["artifact_bytes"] += usage["artifact_bytes"]
+            dst["operand_upload_bytes_saved"] += usage["operand_saved_bytes"]
             dst["fallback_jobs"] += 1 if usage["fallback"] else 0
     return {"tenants": tenants, "totals": totals}
 
@@ -270,6 +282,7 @@ def render_bucket(bucket: dict) -> dict:
         "coalesce_saved_seconds": round(bucket["saved_us"] / 1e6, 3),
         "embed_cache_hits": bucket["embed_cache_hits"],
         "artifact_bytes": bucket["artifact_bytes"],
+        "operand_upload_bytes_saved": bucket["operand_upload_bytes_saved"],
         "fallback_jobs": bucket["fallback_jobs"],
     }
 

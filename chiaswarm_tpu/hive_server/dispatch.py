@@ -13,6 +13,12 @@ load + compile; placed on the warm one it pays neither.
 Outcomes (counted in `swarm_hive_dispatch_total{outcome}`):
 
 - affinity  the polling worker already holds the job's model;
+- adapter_affinity  the polling worker holds the job's model AND
+            advertises the job's adapter operands resident
+            (`resident_adapters`, ISSUE 16) — the zero-upload placement;
+            a model-warm poller WITHOUT the adapter defers (counted as
+            `hold`) while an adapter-warm peer is live and the job is
+            inside `affinity_hold_s`: operands prefer, never starve;
 - cold      no live worker holds it — whoever polls first loads it;
 - steal     a warm worker exists but the job has waited past
             `affinity_hold_s`, so the cold poller takes it rather than
@@ -54,7 +60,8 @@ import math
 import uuid
 
 from .. import telemetry
-from ..coalesce import adapter_ref, job_rows, placement_model
+from ..coalesce import (adapter_ref, canonical_adapter_ref, job_rows,
+                        placement_model)
 from .clock import CLOCK
 from .fleet import parse_stats
 from .queue import JobRecord, PriorityJobQueue
@@ -62,7 +69,8 @@ from .queue import JobRecord, PriorityJobQueue
 _DISPATCH = telemetry.counter(
     "swarm_hive_dispatch_total",
     "Hive /work dispatch decisions by placement outcome "
-    "(affinity | cold | steal | hold | gang | straggler_hold | shard_hold)",
+    "(affinity | adapter_affinity | cold | steal | hold | gang | "
+    "straggler_hold | shard_hold)",
     ("outcome",),
 )
 _GANG_SIZE = telemetry.histogram(
@@ -120,6 +128,11 @@ class WorkerInfo:
     # prefers a shard-capable worker for interactive seeds.
     chips_per_slice: int = 0
     shard_capable: bool = False
+    # adapter-operand residency (ISSUE 16): canonical adapter refs whose
+    # stacked device operands are warm on this worker (lora_operands.py)
+    # — the dispatcher routes a repeat adapter gang back to them so the
+    # steady state re-uploads nothing
+    resident_adapters: frozenset[str] = frozenset()
     last_seen: float = 0.0
 
     @property
@@ -147,6 +160,7 @@ class WorkerInfo:
             "chips_per_slice": self.chips_per_slice,
             "shard_capable": self.shard_capable,
             "resident_models": sorted(self.resident),
+            "resident_adapters": sorted(self.resident_adapters),
         }
 
 
@@ -185,6 +199,7 @@ class WorkerDirectory:
             stats=parse_stats(query.get("stats")),
             chips_per_slice=_to_int(query.get("chips_per_slice")),
             shard_capable=_to_int(query.get("shard_capable")) > 0,
+            resident_adapters=_split_csv(query.get("resident_adapters")),
             last_seen=CLOCK.mono(),
         )
         self._workers[name] = info
@@ -363,7 +378,28 @@ class Dispatcher:
                 _DISPATCH.inc(outcome="shard_hold")
                 continue
             if model and model in worker.resident:
-                outcome = "affinity"
+                aref = canonical_adapter_ref(record.job)
+                if aref is not None and aref in worker.resident_adapters:
+                    # model AND stacked adapter operands warm here: the
+                    # zero-upload placement (ISSUE 16). Gang riders
+                    # follow the seed as ever, so the whole repeat gang
+                    # lands where its operand cache entry lives.
+                    outcome = "adapter_affinity"
+                elif (aref is not None
+                        and now - record.submitted_at < self.affinity_hold_s
+                        and any(aref in w.resident_adapters
+                                for w in self.directory.live_holders(
+                                    model, exclude=worker.name))):
+                    # model warm here but the adapter's operands are warm
+                    # on ANOTHER model-warm worker: defer inside the same
+                    # hold window affinity uses. Operand residency
+                    # PREFERS, never starves — once the window lapses (or
+                    # the operand-warm peer goes dark) this poller takes
+                    # the job as plain affinity.
+                    _DISPATCH.inc(outcome="hold")
+                    continue
+                else:
+                    outcome = "affinity"
             else:
                 holders = self.directory.live_holders(model, exclude=worker.name)
                 if not holders:

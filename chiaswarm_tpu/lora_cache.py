@@ -50,6 +50,33 @@ def adapter_key(lora: dict) -> tuple:
             lora.get("subfolder"))
 
 
+# Derived caches (the operand-stack cache in lora_operands.py) register
+# here so factor eviction/replacement cascades: an operand stack built
+# from evicted factors must not outlive them, or a re-resolved adapter
+# with different weights would keep serving stale device arrays. Hooks
+# receive the invalidated factor key, or None when the whole cache is
+# reconfigured/reset. Fired OUTSIDE the cache lock (hooks take their
+# own locks).
+_INVALIDATE_HOOKS: list = []
+
+
+def on_invalidate(hook) -> None:
+    """Register `hook(key_or_None)` to fire when a factor entry is
+    evicted or replaced (key) or the factor cache is reconfigured or
+    reset wholesale (None)."""
+    if hook not in _INVALIDATE_HOOKS:
+        _INVALIDATE_HOOKS.append(hook)
+
+
+def _fire_invalidate(keys) -> None:
+    for key in keys:
+        for hook in list(_INVALIDATE_HOOKS):
+            try:
+                hook(key)
+            except Exception:  # a broken listener must not break loads
+                pass
+
+
 class LoraFactorCache:
     """Byte-capped LRU of raw adapter factors."""
 
@@ -85,17 +112,21 @@ class LoraFactorCache:
         _EVENTS.inc(event="miss")
         if nbytes > self.max_bytes:
             return  # one giant adapter must not wipe the whole cache
+        invalidated: list[tuple] = []
         with self._lock:
             old = self._entries.pop(key, None)
             if old is not None:
                 self._bytes -= old[1]
+                invalidated.append(key)
             self._entries[key] = (factors, int(nbytes), {})
             self._bytes += int(nbytes)
             while self._bytes > self.max_bytes and self._entries:
-                _, entry = self._entries.popitem(last=False)
+                evicted_key, entry = self._entries.popitem(last=False)
                 self._bytes -= entry[1]
+                invalidated.append(evicted_key)
             _BYTES.set(self._bytes)
             _ENTRIES.set(len(self._entries))
+        _fire_invalidate(invalidated)
 
     @property
     def resident_bytes(self) -> int:
@@ -137,7 +168,8 @@ def configure(max_bytes: int | None) -> LoraFactorCache | None:
         _CONFIGURED = True
         _BYTES.set(0)
         _ENTRIES.set(0)
-        return _CACHE
+    _fire_invalidate([None])
+    return _CACHE
 
 
 def reset() -> None:
@@ -146,6 +178,7 @@ def reset() -> None:
     with _LOCK:
         _CACHE = None
         _CONFIGURED = False
+    _fire_invalidate([None])
 
 
 def resolve(lora: dict, model_name: str) -> dict:

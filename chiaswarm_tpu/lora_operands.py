@@ -1,0 +1,252 @@
+"""Device-resident adapter operand stacks: zero-upload steady state.
+
+ISSUE 16. PR 13 made adapter identity *data* (zero-padded A/B stacks
+indexed by per-row slot ids), but every coalesced pass still re-ran the
+host-side numpy assembly and re-uploaded the stacks (`build_operands`
+ends in `jnp.asarray`) — at rank cap with full slots that is hundreds
+of MB of host→device transfer per pass, paid even when the SAME gang of
+adapters repeats forever. This module keeps the already-stacked,
+already-device-placed operands resident in a byte-capped process-wide
+LRU keyed by the full recipe that produced them:
+
+    (model name, ordered adapter-key tuple, operand signature
+     (slot bucket, rank bucket, module-path set), dtype, geometry view)
+
+Scale is deliberately absent from the key: ``alpha/rank`` is folded into
+the A stack host-side (adapter-intrinsic, scale-independent) and
+``lora_scale`` rides the tiny per-row gain vector, so the same adapter
+at two scales is ONE resident stack, not two uploads.
+
+Coherence: the raw-factor LRU (lora_cache.py) is the source of truth
+for adapter bytes. This cache registers an invalidation hook there —
+evicting or replacing a factor entry drops every operand entry derived
+from it, so a re-resolved adapter with different weights can never keep
+serving stale device arrays.
+
+Eviction explicitly frees the device buffers (``.delete()`` on every
+jax array in the entry) instead of waiting for the GC: the whole point
+of the byte cap is bounding HBM, so reclaim must be immediate (swarmlint
+SW007).
+
+Sized by ``Settings.lora_operand_cache_mb``
+(``CHIASWARM_LORA_OPERAND_CACHE_MB``; 0 disables — passes still run,
+they just re-assemble and re-upload like PR 13 did).
+
+Import-time jax-free: the hive server imports the package tree and must
+not drag in jax. Thread-safe: slice executor threads consult it
+concurrently.
+"""
+
+from __future__ import annotations
+
+import threading
+from collections import OrderedDict
+
+from . import lora_cache, telemetry
+from .coalesce import wire_adapter_ref
+
+_EVENTS = telemetry.counter(
+    "swarm_lora_operand_cache_total",
+    "Device-resident operand-stack cache lookups by outcome (miss = the "
+    "stacks were re-assembled host-side and re-uploaded)",
+    ("event",),
+)
+_BYTES = telemetry.gauge(
+    "swarm_lora_operand_cache_bytes",
+    "Bytes of stacked adapter operands currently resident on device "
+    "(bounded by Settings.lora_operand_cache_mb)")
+_ENTRIES = telemetry.gauge(
+    "swarm_lora_operand_cache_entries",
+    "Distinct operand-stack recipes resident in the operand cache")
+
+
+def ref_of_key(akey: tuple) -> str:
+    """Factor-cache adapter key (ref, weight_name, subfolder) -> the
+    canonical wire ref workers advertise on /work. Delegates to
+    coalesce.wire_adapter_ref so the advertisement and the hive's
+    canonical_adapter_ref(job) — computed from the RAW job before the
+    worker's loras.resolve_lora normalization rewrote the fields —
+    spell the same adapter identically."""
+    ref, name, sub = (tuple(akey) + (None, None, None))[:3]
+    return wire_adapter_ref(ref, name, sub)
+
+
+def _free(value) -> None:
+    """Release device buffers held by an evicted entry, recursively.
+    numpy leaves have no .delete(); already-deleted jax buffers raise —
+    both are fine, the entry is unreachable either way."""
+    if isinstance(value, dict):
+        for leaf in value.values():
+            _free(leaf)
+    elif isinstance(value, (list, tuple)):
+        for leaf in value:
+            _free(leaf)
+    else:
+        delete = getattr(value, "delete", None)
+        if callable(delete):
+            try:
+                delete()
+            except Exception:
+                pass
+
+
+class LoraOperandCache:
+    """Byte-capped LRU of device-resident operand stacks."""
+
+    def __init__(self, max_bytes: int):
+        self.max_bytes = int(max_bytes)
+        self._lock = threading.Lock()
+        self._entries: OrderedDict[tuple, tuple] = OrderedDict()
+        self._bytes = 0
+
+    def lookup(self, key: tuple):
+        """The cached (value, nbytes) for `key`, or None. Counts the
+        hit; the caller counts the miss once assembly succeeds."""
+        with self._lock:
+            entry = self._entries.get(key)
+            if entry is not None:
+                self._entries.move_to_end(key)
+                _EVENTS.inc(event="hit")
+            return entry
+
+    def put(self, key: tuple, value, nbytes: int) -> None:
+        _EVENTS.inc(event="miss")
+        if nbytes > self.max_bytes:
+            return  # one giant recipe must not wipe the whole cache
+        freed = []
+        with self._lock:
+            old = self._entries.pop(key, None)
+            if old is not None:
+                self._bytes -= old[1]
+                freed.append(old[0])
+            self._entries[key] = (value, int(nbytes))
+            self._bytes += int(nbytes)
+            while self._bytes > self.max_bytes and self._entries:
+                _, entry = self._entries.popitem(last=False)
+                self._bytes -= entry[1]
+                freed.append(entry[0])
+            _BYTES.set(self._bytes)
+            _ENTRIES.set(len(self._entries))
+        for value in freed:
+            _free(value)
+
+    def invalidate_where(self, pred) -> int:
+        """Drop (and free) every entry whose key satisfies `pred`;
+        returns how many were dropped."""
+        freed = []
+        with self._lock:
+            doomed = [k for k in self._entries if pred(k)]
+            for key in doomed:
+                value, nbytes = self._entries.pop(key)
+                self._bytes -= nbytes
+                freed.append(value)
+            _BYTES.set(self._bytes)
+            _ENTRIES.set(len(self._entries))
+        for value in freed:
+            _free(value)
+        return len(freed)
+
+    def resident_adapter_refs(self) -> list[str]:
+        """Canonical refs of every adapter with a resident operand
+        stack, most-recently-used last (the /work advertisement)."""
+        with self._lock:
+            seen: dict[str, None] = {}
+            for key in self._entries:
+                for akey in key[1]:
+                    seen[ref_of_key(akey)] = None
+            return list(seen)
+
+    @property
+    def resident_bytes(self) -> int:
+        return self._bytes
+
+    def __len__(self) -> int:
+        return len(self._entries)
+
+
+_CACHE: LoraOperandCache | None = None
+_CONFIGURED = False
+_LOCK = threading.Lock()
+
+
+def get_cache() -> LoraOperandCache | None:
+    """The process-wide cache, sized from Settings.lora_operand_cache_mb
+    on first use; None when disabled (0)."""
+    global _CACHE, _CONFIGURED
+    with _LOCK:
+        if not _CONFIGURED:
+            from .settings import load_settings
+
+            try:
+                mb = int(getattr(
+                    load_settings(), "lora_operand_cache_mb", 0))
+            except Exception:  # the cache is an optimization, never fatal
+                mb = 0
+            _CACHE = LoraOperandCache(mb * 1024 * 1024) if mb > 0 else None
+            _CONFIGURED = True
+        return _CACHE
+
+
+def configure(max_bytes: int | None) -> LoraOperandCache | None:
+    """Explicitly (re)size the process-wide cache — tests and benches;
+    None or <= 0 disables. The old cache's device buffers are freed."""
+    global _CACHE, _CONFIGURED
+    with _LOCK:
+        old = _CACHE
+        _CACHE = (LoraOperandCache(int(max_bytes))
+                  if max_bytes and int(max_bytes) > 0 else None)
+        _CONFIGURED = True
+        _BYTES.set(0)
+        _ENTRIES.set(0)
+    if old is not None:
+        old.invalidate_where(lambda key: True)
+    return _CACHE
+
+
+def reset() -> None:
+    """Forget the configured cache (next get_cache() re-reads Settings),
+    freeing whatever it held."""
+    global _CACHE, _CONFIGURED
+    with _LOCK:
+        old = _CACHE
+        _CACHE = None
+        _CONFIGURED = False
+    if old is not None:
+        old.invalidate_where(lambda key: True)
+
+
+def invalidate_adapter(akey: tuple) -> None:
+    """Drop every operand entry derived from factor-cache key `akey`."""
+    cache = _CACHE
+    if cache is not None:
+        cache.invalidate_where(lambda key: akey in key[1])
+
+
+def invalidate_model(model_name: str) -> None:
+    """Drop every operand entry for `model_name` (pipeline release:
+    the mesh the stacks were placed on is going away)."""
+    cache = _CACHE
+    if cache is not None:
+        cache.invalidate_where(lambda key: key[0] == model_name)
+
+
+def resident_adapter_refs() -> list[str]:
+    """Canonical refs resident in the live cache (empty when disabled
+    or unconfigured — advertising nothing is always safe)."""
+    cache = _CACHE
+    return cache.resident_adapter_refs() if cache is not None else []
+
+
+def _on_factor_invalidate(akey) -> None:
+    """Factor-cache coherence hook: a factor entry was evicted or
+    replaced (akey) or the factor cache was reconfigured (None)."""
+    cache = _CACHE
+    if cache is None:
+        return
+    if akey is None:
+        cache.invalidate_where(lambda key: True)
+    else:
+        cache.invalidate_where(lambda key: akey in key[1])
+
+
+lora_cache.on_invalidate(_on_factor_invalidate)

@@ -28,7 +28,35 @@ from pathlib import Path
 import jax.numpy as jnp
 import numpy as np
 
+from ..telemetry import counter as _telemetry_counter
+
 logger = logging.getLogger(__name__)
+
+# adapter modules the Dense-only delta/merge paths cannot express
+# (conv/LoCon layers, mismatched bases) — each skipped module counts
+# here, while the log WARNING dedups to once per adapter ref so a
+# 40-conv LoCon adapter in a hot gang doesn't firehose the worker log
+CONV_SKIPPED = _telemetry_counter(
+    "swarm_lora_conv_skipped_total",
+    "Adapter modules skipped by the Dense-only LoRA paths "
+    "(conv/LoCon layers or kernels the base tree cannot match)")
+
+_WARNED_REFS: set[str] = set()
+_WARNED_REFS_MAX = 4096  # dedup memory, not a cache: drop-all when full
+
+
+def _warn_skipped(adapter_ref: str | None, message: str, *args) -> None:
+    """Count every skipped module; WARN once per adapter ref (every
+    time when the caller has no ref — the raw merge_lora entrypoint)."""
+    CONV_SKIPPED.inc()
+    if adapter_ref is not None:
+        if adapter_ref in _WARNED_REFS:
+            logger.debug(message, *args)
+            return
+        if len(_WARNED_REFS) >= _WARNED_REFS_MAX:
+            _WARNED_REFS.clear()
+        _WARNED_REFS.add(adapter_ref)
+    logger.warning(message, *args)
 
 
 def load_lora_state(path: str | Path, weight_name: str | None = None,
@@ -56,7 +84,14 @@ def load_lora_state(path: str | Path, weight_name: str | None = None,
 
 
 def _module_path(name: str) -> tuple[str, str] | None:
-    """LoRA tensor name -> ('/'-joined flax module path, 'A'|'B'|'alpha')."""
+    """LoRA tensor name -> ('/'-joined flax module path, 'A'|'B'|'alpha').
+
+    Text-encoder tensors (kohya ``lora_te_``/``lora_te1_``/``lora_te2_``,
+    diffusers ``text_encoder.``/``text_encoder_2.``) map into a
+    ``te{i}:``-namespaced key (encoder index 0/1 in the pipeline's
+    text-encoder LIST). ':' never appears in a flax module name, so the
+    UNet matcher/interceptor can never cross-match a TE key — one flat
+    factor dict carries both."""
     if name.endswith(".alpha"):
         base, kind = name[: -len(".alpha")], "alpha"
     elif name.endswith(".lora_A.weight") or name.endswith(".lora_down.weight"):
@@ -70,21 +105,39 @@ def _module_path(name: str) -> tuple[str, str] | None:
     if base.startswith("lora_unet_"):
         base = base[len("lora_unet_"):]
         return base, kind
-    if base.startswith("lora_te_") or base.startswith("lora_te1_") or base.startswith(
-        "lora_te2_"
-    ):
-        return None  # text-encoder LoRA: not merged yet
-    # diffusers: unet.down_blocks.0.attentions.0....processor?.to_q(_lora)?
-    if base.startswith("unet."):
-        base = base[len("unet."):]
-    elif base.startswith("text_encoder"):
-        return None
+    te_ns = None
+    for prefix, ns in (("lora_te1_", "te0:"), ("lora_te2_", "te1:"),
+                       ("lora_te_", "te0:")):
+        if base.startswith(prefix):
+            te_ns, base = ns, base[len(prefix):]
+            break
+    if te_ns is None:
+        # diffusers: unet.down_blocks.0.attentions.0....processor?.to_q(_lora)?
+        if base.startswith("unet."):
+            base = base[len("unet."):]
+        elif base.startswith("text_encoder_2."):
+            te_ns, base = "te1:", base[len("text_encoder_2."):]
+        elif base.startswith("text_encoder."):
+            te_ns, base = "te0:", base[len("text_encoder."):]
+        elif base.startswith("text_encoder"):
+            return None
     base = (
         base.replace(".processor.", ".")
         .replace("_lora", "")
         .replace("to_out.0", "to_out_0")
     )
-    return base.replace(".", "_"), kind
+    base = base.replace(".", "_")
+    if te_ns is not None:
+        # the flax CLIP tree is rooted at the encoder module (clip.py):
+        # HF's text_model.encoder. / text_model. wrapper levels vanish,
+        # and fc1/fc2 sit directly in the layer (no `mlp` submodule)
+        for strip in ("text_model_encoder_", "text_model_"):
+            if base.startswith(strip):
+                base = base[len(strip):]
+                break
+        base = base.replace("_mlp_fc", "_fc")
+        return te_ns + base, kind
+    return base, kind
 
 
 def collect_lora_deltas(state: dict) -> dict[str, tuple]:
@@ -175,40 +228,113 @@ def match_dense_factors(factors: dict[str, tuple], unet_params: dict
     not by SHAPE, or no kernel at all: >0 means the adapter has content
     the runtime delta cannot express (conv/LoCon modules, a mismatched
     base), so the caller must fall back to the merged-tree path rather
-    than silently drop part of the adapter.
+    than silently drop part of the adapter. ``te{i}:``-namespaced keys
+    are text-encoder content — not this tree's to match; they neither
+    match nor count (match_te_dense_factors owns them).
     """
+    index = _kernel_index(unet_params)
+    matched: dict[str, tuple] = {}
+    unmatched = 0
+    for key, (a, b, alpha) in factors.items():
+        if ":" in key:
+            continue  # text-encoder namespace
+        entry = _match_one(index, key, a, b, alpha)
+        if entry is None:
+            unmatched += 1
+            continue
+        matched[entry[0]] = entry[1]
+    return matched, unmatched
+
+
+def _kernel_index(params: dict) -> dict:
     index = {}
-    for path, leaf in _flat_params(unet_params):
+    for path, leaf in _flat_params(params):
         if path[-1] != "kernel":
             continue
         index["_".join(path[:-1])] = (path[:-1], getattr(leaf, "shape", None),
                                       getattr(leaf, "ndim", 0))
+    return index
+
+
+def _match_one(index: dict, key: str, a, b, alpha):
+    """One factor against one kernel index: ('/'-path, (A, B, alpha))
+    on a 2D shape-exact match, None otherwise."""
+    hit = index.get(key)
+    if hit is None:
+        return None
+    path, shape, ndim = hit
+    a_arr, b_arr = np.asarray(a), np.asarray(b)
+    # delta = (B @ A).T must land on a 2D [in, out] kernel
+    if (ndim != 2 or a_arr.ndim != 2 or b_arr.ndim != 2
+            or shape != (a_arr.shape[1], b_arr.shape[0])
+            or a_arr.shape[0] != b_arr.shape[1]):
+        return None
+    return "/".join(path), (a_arr, b_arr,
+                            float(alpha) if alpha is not None else None)
+
+
+def match_te_dense_factors(factors: dict[str, tuple],
+                           text_params_list: list[dict]
+                           ) -> tuple[dict[str, tuple], int]:
+    """Match ``te{i}:``-namespaced factors onto the pipeline's text
+    encoder param trees (one per encoder, pipeline order).
+
+    Returns ({'te{i}:' + '/'-joined path: (A, B, alpha)}, unmatched) —
+    the same operand layout as match_dense_factors, keys kept under
+    their namespace so the stacks ride ONE operand dict and the TE
+    interceptor (make_te_interceptor) finds them by prefixed path.
+    UNet keys (no ':') are ignored here. `unmatched` > 0 means TE
+    content the delta cannot express — the caller falls back to the
+    merged-tree path, exactly like the UNet side.
+    """
+    indexes = [_kernel_index(params) for params in text_params_list]
     matched: dict[str, tuple] = {}
     unmatched = 0
     for key, (a, b, alpha) in factors.items():
-        hit = index.get(key)
-        if hit is None:
+        ns, sep, rest = key.partition(":")
+        if not sep:
+            continue  # unet namespace
+        enc = int(ns[2:]) if ns.startswith("te") and ns[2:].isdigit() else -1
+        if not 0 <= enc < len(indexes):
             unmatched += 1
             continue
-        path, shape, ndim = hit
-        a_arr, b_arr = np.asarray(a), np.asarray(b)
-        # delta = (B @ A).T must land on a 2D [in, out] kernel
-        if (ndim != 2 or a_arr.ndim != 2 or b_arr.ndim != 2
-                or shape != (a_arr.shape[1], b_arr.shape[0])
-                or a_arr.shape[0] != b_arr.shape[1]):
+        entry = _match_one(indexes[enc], rest, a, b, alpha)
+        if entry is None:
             unmatched += 1
             continue
-        matched["/".join(path)] = (a_arr, b_arr,
-                                   float(alpha) if alpha is not None else None)
+        matched[f"{ns}:{entry[0]}"] = entry[1]
     return matched, unmatched
 
 
 def merge_factors(params: dict, factors: dict[str, tuple],
-                  scale: float = 1.0) -> tuple[dict, int]:
+                  scale: float = 1.0,
+                  adapter_ref: str | None = None) -> tuple[dict, int]:
     """merge_lora over pre-collected factors (the factor-cache fallback
     path: the adapter was already loaded once; re-reading safetensors to
     merge would defeat the cache)."""
-    return _merge_deltas(params, factors, scale)
+    return _merge_deltas(params, factors, scale, adapter_ref)
+
+
+def merge_te_factors(text_params_list: list[dict], factors: dict[str, tuple],
+                     scale: float = 1.0,
+                     adapter_ref: str | None = None) -> tuple[list, int]:
+    """Merge ``te{i}:``-namespaced factors into COPIES of the matching
+    text-encoder trees (untouched encoders pass through by identity, so
+    the prompt-embedding cache's identity check correctly bypasses).
+    Returns (new text-params list, matched module count)."""
+    merged_list = list(text_params_list)
+    matched = 0
+    for i, params in enumerate(text_params_list):
+        prefix = f"te{i}:"
+        sub = {key[len(prefix):]: val for key, val in factors.items()
+               if key.startswith(prefix)}
+        if not sub:
+            continue
+        merged, n = _merge_deltas(params, sub, scale, adapter_ref)
+        if n:
+            merged_list[i] = merged
+        matched += n
+    return merged_list, matched
 
 
 def merge_lora(params: dict, lora_state: dict, scale: float = 1.0) -> tuple[dict, int]:
@@ -225,8 +351,8 @@ def merge_lora(params: dict, lora_state: dict, scale: float = 1.0) -> tuple[dict
     return _merge_deltas(params, deltas, scale)
 
 
-def _merge_deltas(params: dict, deltas: dict[str, tuple],
-                  scale: float) -> tuple[dict, int]:
+def _merge_deltas(params: dict, deltas: dict[str, tuple], scale: float,
+                  adapter_ref: str | None = None) -> tuple[dict, int]:
     # index the param tree by normalized underscore path of the kernel's parent
     index = {}
     for path, leaf in _flat_params(params):
@@ -247,9 +373,12 @@ def _merge_deltas(params: dict, deltas: dict[str, tuple],
 
     matched = 0
     for key, (a, b, alpha) in deltas.items():
+        if ":" in key:
+            continue  # text-encoder namespace: merge_te_factors owns it
         path = index.get(key)
         if path is None:
-            logger.warning("LoRA module %s not found in param tree", key)
+            _warn_skipped(adapter_ref,
+                          "LoRA module %s not found in param tree", key)
             continue
         node = params
         for p in path:
@@ -259,7 +388,8 @@ def _merge_deltas(params: dict, deltas: dict[str, tuple],
         eff = scale * ((alpha / rank) if alpha is not None else 1.0)
         delta = (np.asarray(b, np.float32) @ np.asarray(a, np.float32)).T
         if delta.shape != kernel.shape:
-            logger.warning(
+            _warn_skipped(
+                adapter_ref,
                 "LoRA %s shape %s incompatible with kernel %s",
                 key, delta.shape, kernel.shape,
             )
@@ -281,7 +411,8 @@ def resolve_and_merge(base_unet_params: dict, lora: dict, scale: float,
     tree (host-side); the caller places/casts and caches it.
     """
     factors = load_factors(lora, model_name)
-    merged, matched = _merge_deltas(base_unet_params, factors, scale)
+    merged, matched = _merge_deltas(base_unet_params, factors, scale,
+                                    str(lora.get("lora")))
     if matched == 0:
         raise ValueError(
             f"Could not load lora {lora}: no modules matched "

@@ -76,32 +76,37 @@ def adapter_rank(factors: dict[str, tuple]) -> int:
                default=0)
 
 
-def build_operands(adapters: list[dict], row_slots: list[int],
-                   row_gains: list[float], dtype) -> tuple[dict, tuple]:
-    """Stack per-slot factors into the jitted program's lora operand.
-
-    ``adapters``: matched factor dicts ({path: (A, B, alpha)}), one per
-    occupied slot, slot numbers 1..len(adapters) — slot 0 is the
-    implicit zero adapter. ``row_slots``/``row_gains`` are per BATCH ROW
-    (pre-CFG; the step body tiles them over the CFG rows). Returns
-    (operands, sig) where sig = (n_slot_bucket, rank_bucket,
-    targeted-module-paths) — the program-cache suffix: same sig => same
-    compiled program, any adapters. The path set is part of the sig
-    because it is the operand dict's PYTREE STRUCTURE: two adapters
-    hitting different Dense subsets would otherwise silently retrace
-    inside one cached jit wrapper.
-
-    The alpha/rank gain convention: callers pass ``row_gains`` as the
-    job's lora_scale; the per-module ``alpha/rank`` factor is folded
-    INTO the stacked A here (rows scaled once, host-side), so modules
-    with different alphas inside one adapter stay exact.
-    """
+def stacks_sig(adapters: list[dict]) -> tuple:
+    """The operand signature — (n_slot_bucket, rank_bucket,
+    targeted-module-paths) — computed host-side WITHOUT assembling or
+    uploading anything, so the operand-residency cache (lora_operands.py)
+    can be consulted before any stacking work. The path set is part of
+    the sig because it is the operand dict's PYTREE STRUCTURE: two
+    adapters hitting different Dense subsets would otherwise silently
+    retrace inside one cached jit wrapper."""
     n_slots = pow2_bucket(1 + len(adapters))
     ranks = [adapter_rank(f) for f in adapters]
     r_bucket = pow2_bucket(max([MIN_RANK] + ranks))
     paths = sorted({p for f in adapters for p in f})
+    return (n_slots, r_bucket, tuple(paths))
+
+
+def build_stacks(adapters: list[dict], dtype,
+                 sig: tuple | None = None) -> tuple[dict, dict, int]:
+    """Assemble + upload the per-path A/B stacks — the expensive leg
+    (host numpy assembly then `jnp.asarray` device transfer). Returns
+    (a_map, b_map, nbytes) where nbytes is the device footprint the
+    residency cache charges for the pair. Scale-INDEPENDENT by
+    construction: the per-module ``alpha/rank`` folds into A here
+    (adapter-intrinsic), while the job's ``lora_scale`` rides the
+    per-row gain vector (row_operands), so one resident stack serves
+    the same adapter at any scale."""
+    if sig is None:
+        sig = stacks_sig(adapters)
+    n_slots, r_bucket, paths = sig
     a_map: dict[str, jnp.ndarray] = {}
     b_map: dict[str, jnp.ndarray] = {}
+    nbytes = 0
     for path in paths:
         a_stack = b_stack = None
         for slot, factors in enumerate(adapters, start=1):
@@ -122,39 +127,61 @@ def build_operands(adapters: list[dict], row_slots: list[int],
             b_stack[slot, :, :rank] = b
         a_map[path] = jnp.asarray(a_stack, dtype)
         b_map[path] = jnp.asarray(b_stack, dtype)
-    operands = {
+        nbytes += a_map[path].nbytes + b_map[path].nbytes
+    return a_map, b_map, nbytes
+
+
+def row_operands(a_map: dict, b_map: dict, row_slots: list[int],
+                 row_gains: list[float]) -> dict:
+    """Join (possibly cache-resident) stacks with the pass's tiny
+    per-row slot/gain vectors into the jitted program's lora operand.
+    ``row_slots``/``row_gains`` are per BATCH ROW (pre-CFG; the step
+    body tiles them over the CFG rows)."""
+    return {
         "a": a_map,
         "b": b_map,
         "slot": jnp.asarray(np.asarray(row_slots, np.int32)),
         "gain": jnp.asarray(np.asarray(row_gains, np.float32)),
     }
-    return operands, (n_slots, r_bucket, tuple(paths))
 
 
-def make_interceptor(operands: dict, cfg_rows: int):
-    """Flax method interceptor applying the stacked per-row deltas to
-    every targeted Dense inside ONE unet apply. ``operands['slot']`` /
-    ``['gain']`` are per batch row; the UNet sees the CFG-tiled batch
-    (uncond rows first), so both tile by ``cfg_rows`` here. Dense calls
-    whose leading dim is not the CFG batch (never the case in the SD
-    UNet, but cheap to guard at trace time) pass through untouched."""
-    a_map, b_map = operands["a"], operands["b"]
-    slots = jnp.tile(operands["slot"], (cfg_rows,))
-    gains = jnp.tile(operands["gain"], (cfg_rows,)).astype(jnp.float32)
+def build_operands(adapters: list[dict], row_slots: list[int],
+                   row_gains: list[float], dtype) -> tuple[dict, tuple]:
+    """Stack per-slot factors into the jitted program's lora operand.
+
+    ``adapters``: matched factor dicts ({path: (A, B, alpha)}), one per
+    occupied slot, slot numbers 1..len(adapters) — slot 0 is the
+    implicit zero adapter. Returns (operands, sig); same sig => same
+    compiled program, any adapters. The uncached composition of
+    stacks_sig + build_stacks + row_operands — the residency-aware path
+    (SDPipeline._lora_operands) calls the legs separately so a repeat
+    gang skips build_stacks entirely.
+    """
+    sig = stacks_sig(adapters)
+    a_map, b_map, _nbytes = build_stacks(adapters, dtype, sig)
+    return row_operands(a_map, b_map, row_slots, row_gains), sig
+
+
+def _path_interceptor(a_map: dict, b_map: dict, slots, gains, prefix: str):
+    """The shared Dense-call interceptor body: every `nn.Dense.__call__`
+    whose (prefixed) module path has a factor stack gets the per-row
+    low-rank correction added to its output. Dense calls whose leading
+    dim is not the expected batch pass through untouched."""
     rows = slots.shape[0]
 
     def interceptor(next_fun, args, kwargs, context):
         if (context.method_name != "__call__"
                 or not isinstance(context.module, nn.Dense)):
             return next_fun(*args, **kwargs)
-        stack_a = a_map.get("/".join(context.module.path))
+        key = prefix + "/".join(context.module.path)
+        stack_a = a_map.get(key)
         if stack_a is None:
             return next_fun(*args, **kwargs)
         x = args[0]
         if getattr(x, "ndim", 0) < 2 or x.shape[0] != rows:
             return next_fun(*args, **kwargs)
         y = next_fun(*args, **kwargs)
-        stack_b = b_map["/".join(context.module.path)]
+        stack_b = b_map[key]
         a = jnp.take(stack_a, slots, axis=0)  # [rows, r, in]
         b = jnp.take(stack_b, slots, axis=0)  # [rows, out, r]
         if x.ndim == 2:
@@ -168,3 +195,28 @@ def make_interceptor(operands: dict, cfg_rows: int):
         return y + delta.astype(y.dtype)
 
     return interceptor
+
+
+def make_interceptor(operands: dict, cfg_rows: int):
+    """Flax method interceptor applying the stacked per-row deltas to
+    every targeted Dense inside ONE unet apply. ``operands['slot']`` /
+    ``['gain']`` are per batch row; the UNet sees the CFG-tiled batch
+    (uncond rows first), so both tile by ``cfg_rows`` here. Text-encoder
+    paths in the stacks carry a ``te{i}:`` prefix, which can never equal
+    a flax module path (':' is not a module-name character), so one
+    shared stack map serves both interceptors without cross-matching."""
+    slots = jnp.tile(operands["slot"], (cfg_rows,))
+    gains = jnp.tile(operands["gain"], (cfg_rows,)).astype(jnp.float32)
+    return _path_interceptor(operands["a"], operands["b"], slots, gains, "")
+
+
+def make_te_interceptor(operands: dict, enc_index: int):
+    """Interceptor for ONE text-encoder apply (ISSUE 16 tentpole part
+    2): stacks are looked up under the ``te{enc_index}:`` namespace the
+    TE-aware matcher emits, and ``operands['slot']``/``['gain']`` are
+    already per TEXT ROW (the encoder batch is the text batch — no CFG
+    tiling; callers lay slots out to match their negs+prompts rows)."""
+    slots = operands["slot"]
+    gains = operands["gain"].astype(jnp.float32)
+    return _path_interceptor(operands["a"], operands["b"], slots, gains,
+                             f"te{enc_index}:")

@@ -362,6 +362,14 @@ class SDPipeline:
         # host->device round trip; round 1 measured >50% of job time on the
         # host side, VERDICT weak #2). jit retraces per shape bucket.
         self._encode_program = jax.jit(self._encode_impl)
+        # text-encoder-LoRA twin (ISSUE 16): the TE delta operands ride
+        # as traced ARGUMENTS, so swapping adapters never retraces —
+        # jit retraces per operand structure (sig), like _encode_program
+        # retraces per shape bucket
+        self._encode_delta_program = jax.jit(self._encode_delta_impl)
+        # per-pass operand-residency stats for the envelope (ISSUE 16):
+        # set by _lora_operands, reset at pass start by run/run_batched
+        self.last_operand_stats = None
         self._vae_encode_program = jax.jit(
             lambda vae_params, px: self.vae.apply(
                 {"params": vae_params}, px, method=self.vae.encode
@@ -581,6 +589,11 @@ class SDPipeline:
 
     def release(self):
         """Drop device references so HBM frees on registry eviction."""
+        from .. import lora_operands
+
+        # device-resident operand stacks for this model free WITH it
+        # (their buffers were placed for this pipeline's mesh)
+        lora_operands.invalidate_model(self.model_name)
         self.params = None
         self._programs.clear()
         self._runner_cache.clear()
@@ -632,10 +645,18 @@ class SDPipeline:
         memo_key = ("dense_match", self.model_name)
         verdict = derived.get(memo_key) if derived is not None else None
         if verdict is None:
-            from ..models.lora import match_dense_factors
+            from ..models.lora import (match_dense_factors,
+                                       match_te_dense_factors)
 
             matched, unmatched = match_dense_factors(
                 factors, self.params["unet"])
+            # text-encoder factors (te{i}:-namespaced, ISSUE 16) match
+            # against the encoder trees and ride the SAME operand dict —
+            # the ':' in their keys keeps the UNet interceptor away
+            te_matched, te_unmatched = match_te_dense_factors(
+                factors, self.params.get("text") or [])
+            matched = {**matched, **te_matched}
+            unmatched += te_unmatched
             if not matched:
                 raise ValueError(
                     f"Could not load lora {lora}: no modules matched "
@@ -745,17 +766,50 @@ class SDPipeline:
                 "in one pass; serving members individually")
 
     def _lora_operands(self, adapters: list[dict], row_slots: list[int],
-                       row_gains: list[float]):
+                       row_gains: list[float],
+                       adapter_keys: tuple | None = None):
         """Stack matched factors into the jitted program's lora operand,
         replicated over the pass mesh (the stacks are weights-like: a
         few MiB against the batch, and the slot dim must never be
-        mistaken for a batch dim by the data-axis sharder)."""
-        from .lora_runtime import build_operands
+        mistaken for a batch dim by the data-axis sharder).
 
-        operands, sig = build_operands(adapters, row_slots, row_gains,
-                                       self.dtype)
+        Operand residency (ISSUE 16): with `adapter_keys` (the factor-
+        cache keys in SLOT ORDER — the stack recipe), the device-resident
+        operand cache (lora_operands.py) is consulted FIRST; a hit skips
+        assembly and upload entirely — steady state is a dict lookup
+        handing jit the resident stacks plus this pass's tiny slot/gain
+        vectors. Sets `self.last_operand_stats` for the envelope."""
+        from .. import lora_operands
+        from .lora_runtime import build_stacks, row_operands, stacks_sig
+
+        sig = stacks_sig(adapters)
+        cache = lora_operands.get_cache()
+        key = None
+        if cache is not None and adapter_keys is not None:
+            key = (self.model_name, tuple(adapter_keys), sig,
+                   np.dtype(self.dtype).name, self.default_geometry)
+        a_map = b_map = None
+        hits, bytes_saved = 0, 0
+        if key is not None:
+            entry = cache.lookup(key)
+            if entry is not None:
+                (a_map, b_map), nbytes = entry
+                hits, bytes_saved = 1, int(nbytes)
+        if a_map is None:
+            a_map, b_map, nbytes = build_stacks(adapters, self.dtype, sig)
+            if self.mesh.devices.size > 1:
+                a_map = jax.device_put(a_map, replicated(self.mesh))
+                b_map = jax.device_put(b_map, replicated(self.mesh))
+            if key is not None:
+                cache.put(key, (a_map, b_map), nbytes)
+        operands = row_operands(a_map, b_map, row_slots, row_gains)
         if self.mesh.devices.size > 1:
-            operands = jax.device_put(operands, replicated(self.mesh))
+            operands["slot"] = jax.device_put(
+                operands["slot"], replicated(self.mesh))
+            operands["gain"] = jax.device_put(
+                operands["gain"], replicated(self.mesh))
+        self.last_operand_stats = {"hits": hits, "misses": 1 - hits,
+                                   "bytes_saved": bytes_saved}
         return operands, sig
 
     def _lora_params(self, base_params: dict, lora: dict, scale: float) -> dict:
@@ -775,23 +829,33 @@ class SDPipeline:
             self._lora_cache.move_to_end(key)
             return self._lora_cache[key]
         from .. import lora_cache
-        from ..models.lora import merge_factors
+        from ..models.lora import merge_factors, merge_te_factors
 
         factors = lora_cache.resolve(lora, self.model_name)
         self._note_base_residency()
+        ref = str(lora.get("lora"))
         merged_unet, matched = merge_factors(
-            base_params["unet"], factors, scale)
-        if matched == 0:
+            base_params["unet"], factors, scale, ref)
+        # text-encoder factors merge into encoder-tree copies (ISSUE
+        # 16); swapping params["text"] off the resident list makes the
+        # prompt-embedding cache's identity check bypass automatically
+        merged_text, te_matched = merge_te_factors(
+            base_params.get("text") or [], factors, scale, ref)
+        if matched + te_matched == 0:
             raise ValueError(
                 f"Could not load lora {lora}: no modules matched "
                 f"{self.model_name}'s parameter tree"
             )
         logger.info(
-            "merged LoRA %s into %s (%d modules, scale %.2f)",
-            lora.get("lora"), self.model_name, matched, scale,
+            "merged LoRA %s into %s (%d unet + %d text modules, "
+            "scale %.2f)",
+            lora.get("lora"), self.model_name, matched, te_matched, scale,
         )
         params = dict(base_params)
-        params["unet"] = self._place({"unet": merged_unet})["unet"]
+        if matched:
+            params["unet"] = self._place({"unet": merged_unet})["unet"]
+        if te_matched:
+            params["text"] = self._place({"text": merged_text})["text"]
         self._lora_cache[key] = params
         while len(self._lora_cache) > MAX_RESIDENT_LORAS:
             self._lora_cache.popitem(last=False)
@@ -1045,8 +1109,31 @@ class SDPipeline:
         context = jnp.concatenate(hiddens, axis=-1) if len(hiddens) > 1 else hiddens[0]
         return context, pooled
 
+    def _encode_delta_impl(self, text_params, ids_list, extras_list,
+                           te_operands):
+        """_encode_impl with the per-row TE-LoRA delta interceptor
+        (ISSUE 16) wrapped around each encoder apply: the resident text
+        params and the compiled structure stay untouched — adapter
+        identity is data, exactly like the UNet delta path. Each encoder
+        only matches stacks under ITS te{i}: namespace."""
+        import flax.linen as nn
+
+        from .lora_runtime import make_te_interceptor
+
+        hiddens, pooled = [], None
+        for i, (enc, p, ids, extra) in enumerate(zip(
+            self.text_encoders, text_params, ids_list, extras_list
+        )):
+            with nn.intercept_methods(make_te_interceptor(te_operands, i)):
+                out = enc.apply({"params": p}, ids, extra_embeddings=extra)
+            hiddens.append(out["hidden_states"])
+            pooled = out["pooled"]
+        context = jnp.concatenate(hiddens, axis=-1) if len(hiddens) > 1 else hiddens[0]
+        return context, pooled
+
     def encode_prompts(self, prompts: list[str], params: dict,
-                       tokenizers=None, extra_embeddings=None):
+                       tokenizers=None, extra_embeddings=None,
+                       te_operands=None):
         """-> (context [B,77,D], pooled [B,P] or None).
 
         One batched pass over all encoders in a single jitted dispatch —
@@ -1080,11 +1167,19 @@ class SDPipeline:
                          if isinstance(self.params, dict) else None)
         if (cache is None or tokenizers is not None
                 or extra_embeddings is not None
+                or te_operands is not None
                 or resident_text is None
                 or params.get("text") is not resident_text):
             ids_list = [jnp.asarray(tok(prompts)) for tok in toks]
-            context, pooled = self._encode_program(
-                params["text"], ids_list, extras)
+            if te_operands is not None:
+                # TE-LoRA delta rows are adapter-specific: they bypass
+                # the (model, text)-keyed embedding cache and run the
+                # interceptor-wrapped twin program (ISSUE 16)
+                context, pooled = self._encode_delta_program(
+                    params["text"], ids_list, extras, te_operands)
+            else:
+                context, pooled = self._encode_program(
+                    params["text"], ids_list, extras)
             return context, (pooled if self.is_xl else None)
 
         found: dict[str, tuple | None] = {}
@@ -1787,6 +1882,7 @@ class SDPipeline:
         # swarm_lora_rows_total counter + the envelope.
         lora_operands, lora_sig, delta_factors = None, None, None
         lora_mode = "none"
+        self.last_operand_stats = None  # adapter-free passes stamp nothing
         job_params = base_params
         if lora is not None:
             delta_factors = self._adapter_delta_factors(lora)
@@ -1867,6 +1963,25 @@ class SDPipeline:
         if mode in ("img2img", "inpaint"):
             t_start = min(max(int(steps * (1.0 - strength)), 0), steps - 1)
 
+        # --- per-row adapter operand (ISSUE 13/16), stacked at the FINAL
+        # row count (the start-image list above rewrote it last) and
+        # BEFORE text encode, so TE-LoRA factors ride the same resident
+        # stacks into the encoder: every row of this job carries slot 1
+        te_operands = None
+        if delta_factors is not None:
+            from .. import lora_cache
+            from .lora_runtime import row_operands
+
+            lora_operands, lora_sig = self._lora_operands(
+                [delta_factors], [1] * n_images, [lora_scale] * n_images,
+                adapter_keys=(lora_cache.adapter_key(lora),))
+            if any(":" in p for p in lora_sig[2]):
+                # the adapter carries text-encoder content: the encode
+                # batch is [negatives*N | prompt*N], every row slot 1
+                te_operands = row_operands(
+                    lora_operands["a"], lora_operands["b"],
+                    [1] * (2 * n_images), [lora_scale] * (2 * n_images))
+
         # --- conditioning: one batched pass, rows [uncond*N | cond*N];
         # pix2pix duplicates the uncond rows for its image-only CFG row ---
         with Span("text_encode", timings):
@@ -1874,7 +1989,7 @@ class SDPipeline:
             texts = [negative_prompt] * n_images + [prompt] * n_images
             context, pooled = self.encode_prompts(
                 texts, job_params, tokenizers=job_tokenizers,
-                extra_embeddings=job_extras,
+                extra_embeddings=job_extras, te_operands=te_operands,
             )
             pooled_u = pooled[:n_images] if pooled is not None else None
             pooled_c = pooled[n_images:] if pooled is not None else None
@@ -1961,12 +2076,6 @@ class SDPipeline:
                 int(cg_start * steps),
                 max(int(np.ceil(cg_end * steps)), int(cg_start * steps) + 1),
             )
-
-        # --- per-row adapter operand (ISSUE 13), stacked at the FINAL
-        # row count: every row of this job carries slot 1 ---
-        if delta_factors is not None:
-            lora_operands, lora_sig = self._lora_operands(
-                [delta_factors], [1] * n_images, [lora_scale] * n_images)
 
         # --- pick the pass's mesh view (ISSUE 12): sharded geometry only
         # for passes on the resident base params — LoRA-merged / custom
@@ -2196,6 +2305,11 @@ class SDPipeline:
                 "hits": self.last_encode_stats[0],
                 "misses": self.last_encode_stats[1]}}
                if getattr(self, "last_encode_stats", None) else {}),
+            # operand-residency stats (ISSUE 16): bytes_saved is the
+            # host->device upload the resident stacks spared this pass
+            # (the tenant ledger attributes it to the job's submitter)
+            **({"operand_cache": dict(self.last_operand_stats)}
+               if getattr(self, "last_operand_stats", None) else {}),
             # the mesh view this pass STARTED under (ISSUE 12) — the
             # end-to-end proof that the class actually picked the
             # geometry; `resharded` records any chunk-seam migrations as
@@ -2313,11 +2427,12 @@ class SDPipeline:
         # ineligible adapters, slots-cap overflow) re-route members to
         # other paths, which must not read as batched rows — the
         # DeltaIneligible re-batch would double-count its survivors ---
-        lora_operands, lora_sig = None, None
+        lora_operands, lora_sig, te_operands = None, None, None
+        self.last_operand_stats = None  # adapter-free passes stamp nothing
         row_modes: list[str] = []
         if any(r.get("lora") for r in requests):
             from .. import lora_cache
-            from .lora_runtime import DeltaIneligibleError
+            from .lora_runtime import DeltaIneligibleError, row_operands
 
             self._require_runtime_delta()
             slots_cap = self._adapter_slots_cap(lora_slots_max)
@@ -2331,6 +2446,7 @@ class SDPipeline:
                 raise DeltaIneligibleError(ineligible)
             slot_of: dict[tuple, int] = {}
             adapters: list[dict] = []
+            adapter_keys: list[tuple] = []  # slot order — the stack recipe
             row_slots: list[int] = []
             row_gains: list[float] = []
             for r, n in zip(requests, counts):
@@ -2352,6 +2468,7 @@ class SDPipeline:
                                 "distinct adapters; serving members "
                                 "individually")
                         adapters.append(factors)
+                        adapter_keys.append(akey)
                         slot = slot_of[akey] = len(adapters)
                     gain = float(r.get("lora_scale", 1.0) or 0.0)
                     row_modes.append("delta")
@@ -2360,7 +2477,16 @@ class SDPipeline:
             row_slots.extend([0] * pad_rows)
             row_gains.extend([0.0] * pad_rows)
             lora_operands, lora_sig = self._lora_operands(
-                adapters, row_slots, row_gains)
+                adapters, row_slots, row_gains,
+                adapter_keys=tuple(adapter_keys))
+            if any(":" in p for p in lora_sig[2]):
+                # text-encoder content rides the pass (ISSUE 16): the
+                # encode batch is [negs+pad | prompts+pad], so the TE
+                # slot/gain layout is the row vector twice (pad rows
+                # already carry slot 0 / gain 0 at the tail)
+                te_operands = row_operands(
+                    lora_operands["a"], lora_operands["b"],
+                    row_slots + row_slots, row_gains + row_gains)
         else:
             row_modes = ["none"] * len(requests)
 
@@ -2377,7 +2503,8 @@ class SDPipeline:
                 negs.extend([r.get("negative_prompt") or ""] * n)
                 prompts.extend([r.get("prompt") or ""] * n)
             texts = negs + [""] * pad_rows + prompts + [""] * pad_rows
-            context, pooled = self.encode_prompts(texts, base_params)
+            context, pooled = self.encode_prompts(
+                texts, base_params, te_operands=te_operands)
 
             added = None
             if self.is_xl:
@@ -2578,6 +2705,11 @@ class SDPipeline:
                     "hits": self.last_encode_stats[0],
                     "misses": self.last_encode_stats[1]}}
                    if getattr(self, "last_encode_stats", None) else {}),
+                # shared-pass operand-residency stats (ISSUE 16), copied
+                # per envelope like embed_cache: bytes_saved is the
+                # upload the resident stacks spared this pass
+                **({"operand_cache": dict(self.last_operand_stats)}
+                   if getattr(self, "last_operand_stats", None) else {}),
                 # coalesced passes stamp the data-parallel view they ran
                 # under, same key as the solo path (ISSUE 12)
                 "geometry": dict(pass_geometry),

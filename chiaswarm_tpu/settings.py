@@ -90,6 +90,13 @@ class Settings:
     # byte cap (MiB) for the process-wide raw adapter-factor LRU
     # (lora_cache.py); 0 disables caching (adapters reload per pass)
     lora_cache_mb: int = 256
+    # byte cap (MiB) for the DEVICE-resident stacked-operand LRU
+    # (lora_operands.py, ISSUE 16): already-assembled, already-uploaded
+    # A/B stacks keyed by (model, adapter set, sig, dtype, geometry), so
+    # a repeat gang of the same adapters uploads nothing. Coherent with
+    # the factor LRU (factor eviction drops derived stacks); 0 disables
+    # (every pass re-assembles + re-uploads, the PR 13 behavior)
+    lora_operand_cache_mb: int = 512
     # most DISTINCT adapters one coalesced group/gang may carry. Shared
     # vocabulary: the hive's gang dispatcher, the worker's batch
     # scheduler, and run_batched all cap on it. The compiled slot
@@ -328,6 +335,7 @@ _ENV_OVERRIDES = {
     "CHIASWARM_EMBED_CACHE_MB": "embed_cache_mb",
     "CHIASWARM_LORA_RUNTIME_DELTA": "lora_runtime_delta",
     "CHIASWARM_LORA_CACHE_MB": "lora_cache_mb",
+    "CHIASWARM_LORA_OPERAND_CACHE_MB": "lora_operand_cache_mb",
     "CHIASWARM_LORA_SLOTS_MAX": "lora_slots_max",
     "CHIASWARM_LORA_RANK_MAX": "lora_rank_max",
     "CHIASWARM_PROGRAM_CACHE_MAX": "program_cache_max",

@@ -176,6 +176,14 @@ def test_bench_cpu_fallback_produces_labeled_smoke_row():
     assert out.get("lora_cache_hit_rate", 0) > 0, out
     assert out.get("lora_gang_rate", 0) > 0, out
     assert out.get("lora_adapters") == 4, out
+    # operand residency (ISSUE 16): a repeat gang's steady-state passes
+    # must run entirely off resident device stacks — every lookup a hit,
+    # real upload bytes saved, and no slower than the cold leg that
+    # re-assembles + re-uploads the stacks every pass
+    assert out.get("lora_coalesce_operand_hit_rate", 0) >= 0.9, out
+    assert out.get("lora_coalesce_upload_bytes_saved", 0) > 0, out
+    assert out.get("lora_coalesce_steady_p50_pass_s", 1e9) <= \
+        out.get("lora_coalesce_cold_pass_s", 0) * 1.1, out
 
 
 @pytest.mark.parametrize("row", ["tiny", "sdxl", "flux"])

@@ -262,6 +262,72 @@ def test_gang_prefers_warm_worker_via_seed_affinity():
     assert model  # silence unused warning paths
 
 
+def test_adapter_affinity_prefers_operand_warm_worker():
+    """ISSUE 16: a model-warm poller whose operand cache also holds the
+    job's adapter places as `adapter_affinity` (and its gang riders
+    follow the seed); a model-warm poller WITHOUT the operands defers
+    while an operand-warm model-warm peer is live inside the hold
+    window. The dict job form ({'lora': ...}) and the advertised string
+    must agree via the canonical ref."""
+    from chiaswarm_tpu.coalesce import placement_model
+
+    directory = WorkerDirectory(ttl_s=45.0)
+    dispatcher = Dispatcher(directory, affinity_hold_s=300.0,
+                            max_jobs_per_poll=8, gang_max=8)
+    q = PriorityJobQueue()
+    q.submit(gang_job(0, lora={"lora": "style-a"}))
+    q.submit(gang_job(1, lora={"lora": "style-a"}))
+    resident = placement_model(q.records["g0"].job)
+    # both workers are model-warm; only "warm-op" holds the operands
+    observe(directory, "warm-op", resident_models=resident,
+            resident_adapters="style-a,style-b")
+    plain = observe(directory, "plain", resident_models=resident)
+    assert dispatcher.select(plain, q) == []  # held for the operand peer
+    warm = observe(directory, "warm-op", resident_models=resident,
+                   resident_adapters="style-a,style-b")
+    handed = dispatcher.select(warm, q)
+    assert [(r.job_id, o) for r, o, _ in handed] == \
+        [("g0", "adapter_affinity"), ("g1", "gang")]
+
+
+def test_adapter_affinity_never_starves():
+    """Residency prefers, never starves: with NO operand-warm peer a
+    model-warm poller takes the adapter job as plain affinity, and once
+    the hold window lapses it takes it even when a peer advertises the
+    operands. Adapter-free jobs never enter the operand machinery."""
+    from chiaswarm_tpu.coalesce import placement_model
+
+    directory = WorkerDirectory(ttl_s=45.0)
+    dispatcher = Dispatcher(directory, affinity_hold_s=300.0,
+                            max_jobs_per_poll=8, gang_max=8)
+    q = PriorityJobQueue()
+    q.submit(gang_job(0, lora="style-a"))
+    resident = placement_model(q.records["g0"].job)
+    # nobody advertises the operands -> plain affinity, no deferral
+    plain = observe(directory, "plain", resident_models=resident)
+    handed = dispatcher.select(plain, q)
+    assert [(r.job_id, o) for r, o, _ in handed] == [("g0", "affinity")]
+
+    # window lapsed (hold 0): the operand-warm peer does not block
+    lapsed = Dispatcher(directory, affinity_hold_s=0.0,
+                        max_jobs_per_poll=8, gang_max=8)
+    q2 = PriorityJobQueue()
+    q2.submit(gang_job(5, lora="style-a"))
+    observe(directory, "warm-op", resident_models=resident,
+            resident_adapters="style-a")
+    plain = observe(directory, "plain", resident_models=resident)
+    handed = lapsed.select(plain, q2)
+    assert [(r.job_id, o) for r, o, _ in handed] == [("g5", "affinity")]
+
+    # adapter-free job on an operand-warm worker: plain affinity
+    q3 = PriorityJobQueue()
+    q3.submit(gang_job(7))
+    warm = observe(directory, "warm-op", resident_models=resident,
+                   resident_adapters="style-a")
+    handed = dispatcher.select(warm, q3)
+    assert [(r.job_id, o) for r, o, _ in handed] == [("g7", "affinity")]
+
+
 def test_gang_timeline_and_wire_context_through_hive_server(sdaas_root):
     """Through the real HiveServer surface: each member is leased and
     journaled individually, the dispatch timeline event carries the gang

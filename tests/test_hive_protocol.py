@@ -585,7 +585,8 @@ def test_usage_reply_shape(backend_name):
 
     USAGE_FIELDS = {"jobs", "chip_seconds", "rows", "coalesced_jobs",
                     "coalesce_saved_seconds", "embed_cache_hits",
-                    "artifact_bytes", "fallback_jobs"}
+                    "artifact_bytes", "operand_upload_bytes_saved",
+                    "fallback_jobs"}
 
     async def scenario(backend, client):
         status, _ = await _post_job(
@@ -651,6 +652,8 @@ def test_work_query_carries_placement_signal(backend_name):
                 "chips": str(worker.chips),
                 "queue_depth": str(worker.queue_depth),
                 "resident_models": ",".join(sorted(worker.resident)),
+                "resident_adapters": ",".join(
+                    sorted(worker.resident_adapters)),
             }
         assert recorded["worker_name"] == "worker"
         assert recorded["worker_version"]
@@ -659,5 +662,45 @@ def test_work_query_carries_placement_signal(backend_name):
         # the client injects the registry's warm set when the caller
         # didn't provide one (empty registry here -> empty string)
         assert "resident_models" in recorded
+        # ... and likewise the operand-residency set (ISSUE 16; empty
+        # operand cache here -> empty string)
+        assert "resident_adapters" in recorded
+
+    run_conformance(backend_name, scenario)
+
+
+def test_resident_adapters_drive_adapter_affinity(backend_name):
+    """ISSUE 16: the /work poll advertises which adapters' stacked
+    device operands are warm on the poller (`resident_adapters`), and a
+    residency-aware hive places a model-warm job carrying one of those
+    adapters as the `adapter_affinity` outcome — the zero-upload
+    dispatch. Pinned across fake/real/promoted so fake_hive cannot
+    drift from the operand-residency wire contract."""
+
+    async def scenario(backend, client):
+        from chiaswarm_tpu.hive_server.dispatch import _DISPATCH
+
+        model = "stabilityai/stable-diffusion-2-1"
+        backend.queue_job({
+            "id": "conf-adapter-aff", "workflow": "txt2img",
+            "model_name": model, "prompt": "warm operands",
+            "height": 64, "width": 64, "num_inference_steps": 2,
+            "lora": "style-a"})
+        before = _DISPATCH.value(outcome="adapter_affinity")
+        jobs = await client.ask_for_work(dict(
+            CAPS, resident_models=model,
+            resident_adapters="style-a,style-b"))
+        assert [j["id"] for j in jobs] == ["conf-adapter-aff"]
+        if backend.name == "fake":
+            recorded = backend.hive.work_requests[-1]
+            assert recorded["resident_adapters"] == "style-a,style-b"
+        else:
+            [worker] = backend.server.directory.live()
+            assert worker.resident_adapters == {"style-a", "style-b"}
+            assert worker.snapshot()["resident_adapters"] == [
+                "style-a", "style-b"]
+            # the dispatcher counted the zero-upload placement
+            assert _DISPATCH.value(
+                outcome="adapter_affinity") == before + 1
 
     run_conformance(backend_name, scenario)

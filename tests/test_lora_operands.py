@@ -1,0 +1,365 @@
+"""ISSUE 16: device-resident adapter operand stacks — byte-capped LRU
+semantics with explicit buffer frees, factor-cache coherence (evicting
+raw factors drops the stacks derived from them), zero-upload steady
+state through the pipeline, scale riding the gain vector instead of the
+cache key, and TE-LoRA delta-vs-merged golden equivalence."""
+
+import logging
+
+import numpy as np
+import pytest
+from safetensors.numpy import save_file
+
+import jax
+
+from chiaswarm_tpu import lora_cache, lora_operands
+from chiaswarm_tpu.pipelines.stable_diffusion import SDPipeline
+
+pytestmark = pytest.mark.usefixtures("sdaas_root")
+
+
+@pytest.fixture()
+def factor_cache():
+    cache = lora_cache.configure(64 * 1024 * 1024)
+    yield cache
+    lora_cache.reset()
+
+
+@pytest.fixture()
+def operand_cache(factor_cache):
+    cache = lora_operands.configure(256 * 1024 * 1024)
+    yield cache
+    lora_operands.reset()
+
+
+@pytest.fixture(scope="module")
+def tiny_pipe():
+    return SDPipeline("test/tiny-sd")
+
+
+def _write_adapter(path, dim, rank=2, seed=0):
+    rng = np.random.default_rng(seed)
+    base = "unet.down_blocks.0.attentions.0.transformer_blocks.0"
+    state = {
+        f"{base}.attn1.to_q.lora_A.weight":
+            rng.standard_normal((rank, dim)).astype(np.float32),
+        f"{base}.attn1.to_q.lora_B.weight":
+            rng.standard_normal((dim, rank)).astype(np.float32),
+    }
+    save_file(state, str(path))
+    return str(path)
+
+
+def _write_te_adapter(path, pipe, rank=2, seed=0):
+    """An adapter touching BOTH the UNet and text-encoder 0 (diffusers
+    key spelling), with dims read off the live param tree."""
+    rng = np.random.default_rng(seed)
+    te = pipe.params["text"][0]
+    q_kernel = np.asarray(te["layers_0"]["self_attn"]["q_proj"]["kernel"])
+    fc1_kernel = np.asarray(te["layers_0"]["fc1"]["kernel"])
+    unet_dim = _q_dim(pipe)
+    unet_base = "unet.down_blocks.0.attentions.0.transformer_blocks.0"
+    te_base = "text_encoder.text_model.encoder.layers.0"
+    state = {
+        f"{unet_base}.attn1.to_q.lora_A.weight":
+            rng.standard_normal((rank, unet_dim)).astype(np.float32),
+        f"{unet_base}.attn1.to_q.lora_B.weight":
+            rng.standard_normal((unet_dim, rank)).astype(np.float32),
+        f"{te_base}.self_attn.q_proj.lora_A.weight":
+            rng.standard_normal((rank, q_kernel.shape[0])).astype(np.float32),
+        f"{te_base}.self_attn.q_proj.lora_B.weight":
+            rng.standard_normal((q_kernel.shape[1], rank)).astype(np.float32),
+        f"{te_base}.mlp.fc1.lora_A.weight":
+            rng.standard_normal((rank, fc1_kernel.shape[0])).astype(np.float32),
+        f"{te_base}.mlp.fc1.lora_B.weight":
+            rng.standard_normal((fc1_kernel.shape[1], rank)).astype(np.float32),
+    }
+    save_file(state, str(path))
+    return str(path)
+
+
+def _q_dim(pipe):
+    return int(pipe.params["unet"]["down_blocks_0"]["attentions_0"]
+               ["transformer_blocks_0"]["attn1"]["to_q"]["kernel"].shape[0])
+
+
+def _maxdiff(a, b):
+    return int(np.abs(np.asarray(a, np.int32) - np.asarray(b, np.int32)).max())
+
+
+class _FakeBuf:
+    """Stands in for a device array: records its .delete() so the tests
+    can pin that eviction frees buffers immediately (SW007)."""
+
+    def __init__(self, freed, name):
+        self._freed, self._name = freed, name
+
+    def delete(self):
+        self._freed.append(self._name)
+
+
+def _entry(freed, name):
+    return ({"p": _FakeBuf(freed, f"{name}.a")},
+            {"p": _FakeBuf(freed, f"{name}.b")})
+
+
+def _key(ref, geometry="64x64", model="test/tiny-sd"):
+    return (model, ((ref, None, None),), (2, 2, ("p",)), "float32", geometry)
+
+
+# --- LRU semantics (unit) ---------------------------------------------------
+
+
+def test_operand_cache_byte_cap_recency_and_explicit_free():
+    from chiaswarm_tpu.lora_operands import _EVENTS, LoraOperandCache
+
+    freed = []
+    cache = LoraOperandCache(max_bytes=2000)
+    cache.put(_key("a"), _entry(freed, "a"), 800)
+    cache.put(_key("b"), _entry(freed, "b"), 800)
+    # touching "a" makes "b" the LRU head, and counts the hit
+    hits0 = _EVENTS.value(event="hit")
+    assert cache.lookup(_key("a")) is not None
+    assert _EVENTS.value(event="hit") - hits0 == 1
+    cache.put(_key("c"), _entry(freed, "c"), 800)  # evicts "b", not "a"
+    assert cache.lookup(_key("b")) is None
+    assert cache.lookup(_key("a")) is not None
+    assert cache.lookup(_key("c")) is not None
+    # the evicted entry's device buffers were freed immediately
+    assert freed == ["b.a", "b.b"]
+    assert cache.resident_bytes == 1600
+    assert len(cache) == 2
+    # an oversize recipe never wipes the cache, but still counts a miss
+    miss0 = _EVENTS.value(event="miss")
+    cache.put(_key("huge"), _entry(freed, "huge"), 10_000)
+    assert _EVENTS.value(event="miss") - miss0 == 1
+    assert cache.lookup(_key("huge")) is None
+    assert len(cache) == 2
+
+
+def test_ref_of_key_and_resident_refs():
+    from chiaswarm_tpu.lora_operands import LoraOperandCache, ref_of_key
+
+    # string form and resolved-dict form agree on the WIRE spelling:
+    # a bare local name resolved against lora_root_dir drops the
+    # worker-local root dir, hub forms rebuild "pub/repo[/sub][/file]"
+    assert ref_of_key(("style-a", None, None)) == "style-a"
+    assert ref_of_key(("/any/root/dir", "w.safetensors", None)) == \
+        "w.safetensors"
+    assert ref_of_key(("pub/repo", "w.safetensors", "sub")) == \
+        "pub/repo/sub/w.safetensors"
+    # every wire form round-trips through the worker's resolver back to
+    # itself — the advertisement matches the hive's raw-job canonical
+    from chiaswarm_tpu.coalesce import canonical_adapter_ref
+    from chiaswarm_tpu.loras import resolve_lora
+    for wire in ("op-a.safetensors", "pub/repo", "pub/repo/f.st",
+                 "pub/repo/a/b/f.st"):
+        resolved = resolve_lora(wire, "/srv/lora-root")
+        assert canonical_adapter_ref({"lora": resolved}) == wire
+        assert canonical_adapter_ref({"lora": wire}) == wire
+    cache = LoraOperandCache(1 << 20)
+    cache.put(_key("style-a"), _entry([], "a"), 10)
+    cache.put(("test/tiny-sd",
+               (("style-b", None, None), ("style-a", None, None)),
+               (4, 2, ("p",)), "float32", "64x64"), _entry([], "x"), 10)
+    assert cache.resident_adapter_refs() == ["style-a", "style-b"]
+
+
+def test_geometry_views_key_separately():
+    cache = lora_operands.configure(1 << 20)
+    try:
+        # one adapter serving two data-parallel views is two recipes
+        cache.put(_key("a", "64x64"), _entry([], "g1"), 10)
+        cache.put(_key("a", "128x128"), _entry([], "g2"), 10)
+        cache.put(_key("a", "64x64", model="other/model"),
+                  _entry([], "g3"), 10)
+        assert len(cache) == 3
+        lora_operands.invalidate_model("other/model")
+        assert len(cache) == 2
+        # adapter invalidation drops every view of it
+        lora_operands.invalidate_adapter(("a", None, None))
+        assert len(cache) == 0
+    finally:
+        lora_operands.reset()
+
+
+def test_operand_cache_sized_from_settings(monkeypatch):
+    monkeypatch.setenv("CHIASWARM_LORA_OPERAND_CACHE_MB", "3")
+    lora_operands.reset()
+    try:
+        cache = lora_operands.get_cache()
+        assert cache is not None
+        assert cache.max_bytes == 3 * 1024 * 1024
+    finally:
+        lora_operands.reset()
+
+
+# --- factor-cache coherence -------------------------------------------------
+
+
+def test_factor_eviction_cascades_to_operand_entries():
+    factor = lora_cache.configure(2000)
+    opcache = lora_operands.configure(1 << 20)
+    try:
+        akey = ("adapter-a", None, None)
+        factors = {"m": (np.zeros((2, 8), np.float32),
+                         np.zeros((8, 2), np.float32), None)}
+        factor.put(akey, factors, 800)
+        freed = []
+        opcache.put(_key("adapter-a"), _entry(freed, "a"), 100)
+        assert len(opcache) == 1
+        # two more factor entries push "adapter-a" past the byte cap:
+        # the invalidation hook must drop (and free) the derived stacks
+        factor.put(("b", None, None), factors, 800)
+        factor.put(("c", None, None), factors, 800)
+        assert factor.lookup(akey) is None
+        assert len(opcache) == 0
+        assert freed == ["a.a", "a.b"]
+        # replacing a RESIDENT factor entry invalidates too (re-resolved
+        # adapter with different weights must not serve stale stacks)
+        opcache.put(_key("b"), _entry([], "b"), 100)
+        factor.put(("b", None, None), factors, 800)
+        assert len(opcache) == 0
+        # wholesale factor reconfigure (key None) drops everything
+        opcache.put(_key("c"), _entry([], "c"), 100)
+        lora_cache.configure(2000)
+        assert len(opcache) == 0
+    finally:
+        lora_cache.reset()
+        lora_operands.reset()
+
+
+# --- steady state through the pipeline --------------------------------------
+
+
+def test_steady_state_operand_hit_is_bitwise_identical(
+        tiny_pipe, tmp_path, operand_cache):
+    adapter = _write_adapter(tmp_path / "a.safetensors", _q_dim(tiny_pipe),
+                             seed=31)
+    kw = dict(prompt="steady", height=64, width=64, num_inference_steps=2,
+              rng=jax.random.key(5), lora={"lora": adapter}, lora_scale=0.8)
+    cold, cfg = tiny_pipe.run(**dict(kw))
+    assert cfg["lora_mode"] == "delta"
+    assert cfg["operand_cache"] == {"hits": 0, "misses": 1, "bytes_saved": 0}
+    warm, cfg2 = tiny_pipe.run(**dict(kw))
+    stats = cfg2["operand_cache"]
+    assert stats["hits"] == 1 and stats["misses"] == 0
+    assert stats["bytes_saved"] > 0
+    # the resident stacks ARE the uploaded stacks: same ops, same values
+    assert _maxdiff(cold[0], warm[0]) == 0
+    # the steady adapter is advertised for placement (canonical ref)
+    assert lora_operands.resident_adapter_refs() == [adapter]
+
+
+def test_factor_eviction_mid_steady_state_reassembles(
+        tiny_pipe, tmp_path, factor_cache, operand_cache):
+    adapter = _write_adapter(tmp_path / "a.safetensors", _q_dim(tiny_pipe),
+                             seed=32)
+    kw = dict(prompt="evicted", height=64, width=64, num_inference_steps=2,
+              rng=jax.random.key(6), lora={"lora": adapter}, lora_scale=1.0)
+    first, _ = tiny_pipe.run(**dict(kw))
+    tiny_pipe.run(**dict(kw))
+    assert tiny_pipe.last_operand_stats["hits"] == 1
+    # crowd the adapter's FACTOR entry out of the 64MB byte cap
+    dummy = {"m": (np.zeros((2, 8), np.float32),
+                   np.zeros((8, 2), np.float32), None)}
+    for i in range(3):
+        factor_cache.put((f"dummy-{i}", None, None), dummy,
+                         30 * 1024 * 1024)
+    assert factor_cache.lookup(lora_cache.adapter_key({"lora": adapter})) \
+        is None
+    # coherence: the derived operand stacks went with the factors
+    assert len(operand_cache) == 0
+    again, cfg = tiny_pipe.run(**dict(kw))
+    # the pass re-resolved + re-assembled (counted as a miss) and the
+    # rebuilt stacks produce the exact same image
+    assert cfg["operand_cache"] == {"hits": 0, "misses": 1, "bytes_saved": 0}
+    assert _maxdiff(first[0], again[0]) == 0
+
+
+def test_operand_cache_disabled_still_serves_delta(
+        tiny_pipe, tmp_path, factor_cache):
+    lora_operands.configure(0)
+    try:
+        assert lora_operands.get_cache() is None
+        assert lora_operands.resident_adapter_refs() == []
+        adapter = _write_adapter(tmp_path / "a.safetensors",
+                                 _q_dim(tiny_pipe), seed=33)
+        kw = dict(prompt="uncached", height=64, width=64,
+                  num_inference_steps=2, rng=jax.random.key(7),
+                  lora={"lora": adapter}, lora_scale=1.0)
+        _, cfg = tiny_pipe.run(**dict(kw))
+        assert cfg["lora_mode"] == "delta"
+        # every pass re-uploads, exactly the PR 13 behavior
+        _, cfg2 = tiny_pipe.run(**dict(kw))
+        assert cfg2["operand_cache"] == \
+            {"hits": 0, "misses": 1, "bytes_saved": 0}
+    finally:
+        lora_operands.reset()
+
+
+def test_scale_change_hits_the_same_resident_stack(
+        tiny_pipe, tmp_path, operand_cache):
+    adapter = _write_adapter(tmp_path / "a.safetensors", _q_dim(tiny_pipe),
+                             seed=34)
+    base = dict(prompt="scaled", height=64, width=64, num_inference_steps=2,
+                lora={"lora": adapter})
+    strong, _ = tiny_pipe.run(rng=jax.random.key(11), lora_scale=1.0,
+                              **dict(base))
+    weak, cfg = tiny_pipe.run(rng=jax.random.key(11), lora_scale=0.25,
+                              **dict(base))
+    # lora_scale rides the per-row gain vector, NOT the cache key: the
+    # second scale is a hit on the same single resident recipe...
+    assert cfg["operand_cache"]["hits"] == 1
+    assert len(operand_cache) == 1
+    # ...and the gain was genuinely applied, not baked into the stacks
+    assert _maxdiff(strong[0], weak[0]) > 0
+
+
+# --- text-encoder LoRA golden equivalence -----------------------------------
+
+
+def test_te_lora_delta_matches_merged(tiny_pipe, tmp_path, operand_cache,
+                                      monkeypatch):
+    adapter = _write_te_adapter(tmp_path / "te.safetensors", tiny_pipe,
+                                seed=35)
+    kw = dict(prompt="a blue sphere", height=64, width=64,
+              num_inference_steps=2, rng=jax.random.key(21),
+              lora={"lora": adapter}, lora_scale=0.5)
+    delta, cfg = tiny_pipe.run(**dict(kw))
+    assert cfg["lora_mode"] == "delta"
+    # TE factors ride the SAME resident entry as the UNet stacks
+    warm, cfg2 = tiny_pipe.run(**dict(kw))
+    assert cfg2["operand_cache"]["hits"] == 1
+    assert _maxdiff(delta[0], warm[0]) == 0
+    # golden: the interceptor-wrapped encoder matches the merged trees
+    monkeypatch.setenv("CHIASWARM_LORA_RUNTIME_DELTA", "0")
+    merged, cfg_m = tiny_pipe.run(**dict(kw))
+    assert cfg_m["lora_mode"] == "merged"
+    assert _maxdiff(delta[0], merged[0]) <= 2
+    # and the TE delta actually perturbs the conditioning
+    monkeypatch.delenv("CHIASWARM_LORA_RUNTIME_DELTA")
+    plain_kw = dict(kw)
+    plain_kw.pop("lora"), plain_kw.pop("lora_scale")
+    plain, _ = tiny_pipe.run(**plain_kw)
+    assert _maxdiff(delta[0], plain[0]) > 0
+
+
+# --- conv/LoCon skip dedup (satellite) --------------------------------------
+
+
+def test_conv_skip_warns_once_per_ref_counts_every_skip(caplog):
+    from chiaswarm_tpu.models import lora as lora_mod
+
+    params = {"blk": {"kernel": np.zeros((4, 4), np.float32)}}
+    deltas = {"no_such_module": (np.zeros((2, 3), np.float32),
+                                 np.zeros((3, 2), np.float32), None)}
+    lora_mod._WARNED_REFS.discard("ref-x")
+    before = lora_mod.CONV_SKIPPED.total()
+    with caplog.at_level(logging.WARNING,
+                         logger="chiaswarm_tpu.models.lora"):
+        lora_mod._merge_deltas(params, deltas, 1.0, "ref-x")
+        lora_mod._merge_deltas(params, deltas, 1.0, "ref-x")
+    warnings = [r for r in caplog.records if r.levelno == logging.WARNING]
+    assert len(warnings) == 1  # deduped per adapter ref
+    assert lora_mod.CONV_SKIPPED.total() - before == 2  # counted per skip

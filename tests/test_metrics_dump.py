@@ -108,6 +108,32 @@ def test_lora_line_from_synthetic_text():
     assert tool.lora_summary([]) is None
 
 
+def test_lora_operand_residency_line_from_synthetic_text():
+    """ISSUE 16: once the device-resident operand cache sees lookups,
+    the adapters line and summary grow a stacked-operand section (hit
+    rate + resident footprint); fleets that never consulted it keep the
+    ISSUE 13 shape (pinned above) with no operand_cache key at all."""
+    tool = _load_tool()
+    samples = tool.parse_metrics(
+        'swarm_lora_rows_total{mode="delta"} 6\n'
+        'swarm_lora_cache_total{event="hit"} 3\n'
+        'swarm_lora_cache_total{event="miss"} 1\n'
+        'swarm_lora_cache_bytes 2048\n'
+        'swarm_lora_cache_entries 2\n'
+        'swarm_lora_operand_cache_total{event="hit"} 9\n'
+        'swarm_lora_operand_cache_total{event="miss"} 1\n'
+        'swarm_lora_operand_cache_bytes 4096\n'
+        'swarm_lora_operand_cache_entries 3\n')
+    assert tool.lora_line(samples) == (
+        "adapters       rows delta=6 "
+        "cache hit_rate=0.75 entries=2 bytes=2048 "
+        "operands hit_rate=0.90 entries=3 resident_bytes=4096")
+    summary = tool.lora_summary(samples)
+    assert summary["operand_cache"] == {
+        "hits": 9, "misses": 1, "hit_rate": 0.9,
+        "bytes": 4096, "entries": 3}
+
+
 def test_geometry_line_from_synthetic_text():
     """ISSUE 12: the per-geometry pass distribution renders under the
     stage table (and its machine-readable twin carries the sharded

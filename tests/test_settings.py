@@ -94,15 +94,18 @@ def test_lora_serving_knobs(sdaas_root, monkeypatch):
     s = load_settings()
     assert s.lora_runtime_delta is True
     assert s.lora_cache_mb == 256
+    assert s.lora_operand_cache_mb == 512
     assert s.lora_slots_max == 8
     assert s.lora_rank_max == 128
     monkeypatch.setenv("CHIASWARM_LORA_RUNTIME_DELTA", "0")
     monkeypatch.setenv("CHIASWARM_LORA_CACHE_MB", "64")
+    monkeypatch.setenv("CHIASWARM_LORA_OPERAND_CACHE_MB", "128")
     monkeypatch.setenv("CHIASWARM_LORA_SLOTS_MAX", "4")
     monkeypatch.setenv("CHIASWARM_LORA_RANK_MAX", "32")
     s = load_settings()
     assert s.lora_runtime_delta is False
     assert s.lora_cache_mb == 64
+    assert s.lora_operand_cache_mb == 128
     assert s.lora_slots_max == 4
     assert s.lora_rank_max == 32
     monkeypatch.undo()
@@ -259,7 +262,8 @@ EXPECTED_FIELDS = (
     "safety_checker_model", "profiler_port", "profiler_capture",
     "flux_streaming", "flux_stream_int8", "batch_linger_ms", "max_coalesce",
     "embed_cache_mb", "lora_runtime_delta", "lora_cache_mb",
-    "lora_slots_max", "lora_rank_max", "program_cache_max",
+    "lora_operand_cache_mb", "lora_slots_max", "lora_rank_max",
+    "program_cache_max",
     "denoise_chunk_steps", "shard_interactive", "shard_tensor", "shard_seq",
     "metrics_port", "metrics_host", "log_format", "job_deadline_s",
     "job_deadline_compile_scale", "quarantine_probe_grace_s",

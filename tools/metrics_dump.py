@@ -407,10 +407,14 @@ def lora_summary(samples) -> dict | None:
     events = _label_counts(samples, "swarm_lora_cache_total", "event")
     hits, misses = events.get("hit", 0.0), events.get("miss", 0.0)
     lookups = hits + misses
-    if not rows and lookups <= 0:
+    operand = _label_counts(
+        samples, "swarm_lora_operand_cache_total", "event")
+    ohits, omisses = operand.get("hit", 0.0), operand.get("miss", 0.0)
+    olookups = ohits + omisses
+    if not rows and lookups <= 0 and olookups <= 0:
         return None
     adapter_rows = rows.get("delta", 0.0) + rows.get("merged", 0.0)
-    return {
+    summary = {
         "rows": {k: int(v) for k, v in sorted(rows.items())},
         "adapter_rows": int(adapter_rows),
         "delta_rate": (round(rows.get("delta", 0.0) / adapter_rows, 4)
@@ -425,6 +429,21 @@ def lora_summary(samples) -> dict | None:
                 samples, "swarm_lora_cache_entries") or 0),
         },
     }
+    if olookups > 0:
+        # stacked-operand residency (ISSUE 16): steady-state repeat
+        # gangs should drive hit_rate -> 1.0 with the working set's
+        # device footprint held in `bytes`; absent entirely on fleets
+        # that never consulted the operand cache
+        summary["operand_cache"] = {
+            "hits": int(ohits),
+            "misses": int(omisses),
+            "hit_rate": round(ohits / olookups, 4),
+            "bytes": int(_gauge_value(
+                samples, "swarm_lora_operand_cache_bytes") or 0),
+            "entries": int(_gauge_value(
+                samples, "swarm_lora_operand_cache_entries") or 0),
+        }
+    return summary
 
 
 def lora_line(samples) -> str | None:
@@ -441,6 +460,12 @@ def lora_line(samples) -> str | None:
             f"cache hit_rate={cache['hit_rate']:.2f} "
             f"entries={cache['entries']} "
             f"bytes={cache['bytes']}")
+    operand = summary.get("operand_cache")
+    if operand is not None:
+        parts.append(
+            f"operands hit_rate={operand['hit_rate']:.2f} "
+            f"entries={operand['entries']} "
+            f"resident_bytes={operand['bytes']}")
     return " ".join(parts)
 
 

@@ -342,21 +342,32 @@ def render_worker(cur: Snapshot, prev: Snapshot | None) -> list[str]:
 
     # adapter serving (ISSUE 13): rows by execution mode (delta = the
     # runtime per-row path, merged = the fallback full-tree copy) plus
-    # the factor cache's residency and hit rate
+    # the factor cache's residency and hit rate — and (ISSUE 16) the
+    # stacked-operand cache's steady-state hit rate + device bytes
+    # resident, the zero-upload signal
     lrows = cur.counters("swarm_lora_rows_total", "mode")
     lcache = cur.counters("swarm_lora_cache_total", "event")
     lhits, lmisses = lcache.get("hit", 0.0), lcache.get("miss", 0.0)
+    opcache = cur.counters("swarm_lora_operand_cache_total", "event")
+    ohits, omisses = opcache.get("hit", 0.0), opcache.get("miss", 0.0)
     adapter_rows = lrows.get("delta", 0.0) + lrows.get("merged", 0.0)
-    if adapter_rows > 0 or lhits + lmisses > 0:
+    if adapter_rows > 0 or lhits + lmisses > 0 or ohits + omisses > 0:
         entries = cur.gauge("swarm_lora_cache_entries") or 0
         cache_bit = ""
         if lhits + lmisses > 0:
             cache_bit = (f" cache_hit_rate={lhits / (lhits + lmisses):.2f} "
                          f"factors={int(entries)}")
+        operand_bit = ""
+        if ohits + omisses > 0:
+            resident_mb = (cur.gauge("swarm_lora_operand_cache_bytes")
+                           or 0) / (1 << 20)
+            operand_bit = (
+                f" operand_hit_rate={ohits / (ohits + omisses):.2f} "
+                f"resident={resident_mb:.0f}MB")
         lines.append(
             f"  adapters  delta={int(lrows.get('delta', 0))} "
             f"merged={int(lrows.get('merged', 0))} "
-            f"plain={int(lrows.get('none', 0))}{cache_bit}")
+            f"plain={int(lrows.get('none', 0))}{cache_bit}{operand_bit}")
 
     # per-stage latency over the last interval (cumulative in --once)
     stages: dict[str, dict[float, float]] = {}
