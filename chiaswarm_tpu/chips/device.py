@@ -69,6 +69,46 @@ def hbm_gb_of(device) -> int:
         return 16
 
 
+def hbm_census() -> list[dict]:
+    """Per-local-device memory view for the fleet census (ISSUE 17,
+    memory_census.py): whatever ``device.memory_stats()`` reports —
+    TPU runtimes give bytes_in_use / bytes_limit / peak_bytes_in_use,
+    the CPU backend an allocator subset or nothing — normalised to ints
+    with the HBM table as the limit fallback, so /debug/memory always
+    has a per-chip row even where the runtime is silent."""
+    import jax
+
+    out = []
+    for device in jax.local_devices():
+        stats = {}
+        try:
+            stats = device.memory_stats() or {}
+        except Exception:
+            stats = {}
+        limit = stats.get("bytes_limit")
+        if not isinstance(limit, int) or limit <= 0:
+            limit = None
+            kind = getattr(device, "device_kind", "cpu")
+            for prefix, gb in _HBM_GB.items():
+                if kind.startswith(prefix) and prefix != "cpu":
+                    # the table is authoritative for known TPU kinds;
+                    # a CPU "limit" would fake headroom where none is
+                    # enforced
+                    limit = gb << 30
+                    break
+        row = {
+            "device": f"{device.platform}:{device.id}",
+            "kind": getattr(device, "device_kind", device.platform),
+            "bytes_in_use": stats.get("bytes_in_use")
+            if isinstance(stats.get("bytes_in_use"), int) else None,
+            "bytes_limit": limit,
+            "peak_bytes_in_use": stats.get("peak_bytes_in_use")
+            if isinstance(stats.get("peak_bytes_in_use"), int) else None,
+        }
+        out.append(row)
+    return out
+
+
 class ChipSet:
     """A fixed subset of local accelerator chips, meshed for one job at a time.
 

@@ -40,7 +40,13 @@ Attribution per settled job:
                    the job's pass (stamped by the pipeline);
 - ``artifact_bytes`` decoded artifact payload bytes (spool refs carry
                    exact counts; inline blobs are estimated from the
-                   base64 length).
+                   base64 length);
+- ``flops``        the job's own analytic UNet FLOPs from the envelope's
+                   ``pipeline_config.cost`` stamp (ISSUE 17) — already
+                   an integer at the source, so per-tenant sums equal
+                   the sum of envelope stamps exactly; surfaced on the
+                   wire as both ``flops`` and ``petaflops``
+                   ("petaflops served" next to chip-seconds).
 
 Served at ``GET /api/usage`` and ``GET /api/tenants/{id}/usage``, and
 exported as ``swarm_hive_tenant_chip_seconds_total{tenant}`` /
@@ -80,6 +86,13 @@ _TENANT_ROWS = telemetry.gauge(
     "swarm_hive_tenant_rows_total",
     "Image rows attributed to each tenant's settled jobs (top-K by "
     "chip-seconds; the rest fold into tenant=\"other\")",
+    ("tenant",),
+)
+_TENANT_FLOPS = telemetry.gauge(
+    "swarm_hive_tenant_flops_total",
+    "Analytic UNet FLOPs attributed to each tenant's settled jobs from "
+    "the envelopes' pipeline_config.cost stamps (top-K by chip-seconds; "
+    "the rest fold into tenant=\"other\")",
     ("tenant",),
 )
 
@@ -222,6 +235,14 @@ def job_usage(record) -> dict | None:
     if isinstance(operand, dict) and isinstance(
             operand.get("bytes_saved"), int):
         operand_saved = max(operand["bytes_saved"], 0)
+    # serving-path cost stamp (ISSUE 17): the job's OWN integer FLOPs —
+    # per-job at the source even for coalesced passes, so tenant sums
+    # and envelope sums agree exactly. An old envelope with no stamp
+    # bills 0 FLOPs (chip-seconds still cover it).
+    cost = cfg.get("cost")
+    flops = 0
+    if isinstance(cost, dict) and isinstance(cost.get("flops"), int):
+        flops = max(cost["flops"], 0)
     return {
         "tenant": tenant_of(record.job),
         "chip_us": chip_us,
@@ -231,13 +252,14 @@ def job_usage(record) -> dict | None:
         "embed_cache_hits": hits,
         "artifact_bytes": _artifact_bytes(record.result),
         "operand_saved_bytes": operand_saved,
+        "flops": flops,
         "fallback": fallback,
     }
 
 
 _FIELDS = ("jobs", "chip_us", "rows", "coalesced_jobs", "saved_us",
            "embed_cache_hits", "artifact_bytes",
-           "operand_upload_bytes_saved", "fallback_jobs")
+           "operand_upload_bytes_saved", "flops", "fallback_jobs")
 
 
 def zero_bucket() -> dict:
@@ -266,6 +288,7 @@ def usage_summary(records) -> dict:
             dst["embed_cache_hits"] += usage["embed_cache_hits"]
             dst["artifact_bytes"] += usage["artifact_bytes"]
             dst["operand_upload_bytes_saved"] += usage["operand_saved_bytes"]
+            dst["flops"] += usage["flops"]
             dst["fallback_jobs"] += 1 if usage["fallback"] else 0
     return {"tenants": tenants, "totals": totals}
 
@@ -283,6 +306,10 @@ def render_bucket(bucket: dict) -> dict:
         "embed_cache_hits": bucket["embed_cache_hits"],
         "artifact_bytes": bucket["artifact_bytes"],
         "operand_upload_bytes_saved": bucket["operand_upload_bytes_saved"],
+        # FLOPs stay the exact integer (envelope-sum reconciliation);
+        # petaflops is the human-scale twin for billing surfaces
+        "flops": bucket["flops"],
+        "petaflops": round(bucket["flops"] / 1e15, 6),
         "fallback_jobs": bucket["fallback_jobs"],
     }
 
@@ -337,21 +364,26 @@ def refresh_tenant_metrics(summary: dict, topk: int) -> None:
         label = TENANT_OTHER if tenant == TENANT_OTHER else tenant
         _TENANT_CHIP_S.set(round(bucket["chip_us"] / 1e6, 3), tenant=label)
         _TENANT_ROWS.set(bucket["rows"], tenant=label)
+        _TENANT_FLOPS.set(bucket["flops"], tenant=label)
         exported.add(label)
     if folded or TENANT_OTHER in exported:
         chip_us = sum(b["chip_us"] for _, b in folded)
         rows = sum(b["rows"] for _, b in folded)
+        flops = sum(b["flops"] for _, b in folded)
         if TENANT_OTHER in exported:
             # a literal "other" tenant merged with the fold bucket
             chip_us += sum(b["chip_us"] for t, b in named
                            if t == TENANT_OTHER)
             rows += sum(b["rows"] for t, b in named if t == TENANT_OTHER)
+            flops += sum(b["flops"] for t, b in named if t == TENANT_OTHER)
         _TENANT_CHIP_S.set(round(chip_us / 1e6, 3), tenant=TENANT_OTHER)
         _TENANT_ROWS.set(rows, tenant=TENANT_OTHER)
+        _TENANT_FLOPS.set(flops, tenant=TENANT_OTHER)
         exported.add(TENANT_OTHER)
     for stale in _exported_tenants - exported:
         _TENANT_CHIP_S.remove(tenant=stale)
         _TENANT_ROWS.remove(tenant=stale)
+        _TENANT_FLOPS.remove(tenant=stale)
     _exported_tenants = exported
 
 

@@ -126,6 +126,18 @@ class HiveJournal:
                 orphan.unlink()
             except OSError:
                 pass
+        # fleet memory census (ISSUE 17): WAL file bytes + the in-memory
+        # event mirror's length; last-constructed journal wins
+        from .. import memory_census
+
+        memory_census.register("wal", self._resident_bytes)
+
+    def _resident_bytes(self) -> dict:
+        try:
+            nbytes = self.path.stat().st_size
+        except OSError:
+            nbytes = 0
+        return {"bytes": int(nbytes), "entries": len(self.events)}
 
     # --- recovery ---
 

@@ -64,6 +64,12 @@ class ArtifactSpool:
         self._bytes = sum(
             f.stat().st_size for f in self.root.glob("*/*") if f.is_file())
         _SPOOL_BYTES.set(self._bytes)
+        # fleet memory census (ISSUE 17): the spool's running byte count
+        # is already maintained by put/sweep — serve it, don't re-stat
+        from .. import memory_census
+
+        memory_census.register(
+            "artifact_spool", lambda: {"bytes": int(self._bytes)})
 
     def _path(self, digest: str) -> Path:
         return self.root / digest[:2] / digest

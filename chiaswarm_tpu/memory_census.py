@@ -1,0 +1,177 @@
+"""Fleet memory census: every byte-capped store, one ledger.
+
+ISSUE 17. The repo grew byte-capped stores one PR at a time — the
+embedding cache, the LoRA factor and device-operand caches, the
+compiled-program LRUs, the hive's artifact spool, the worker's outbox,
+the WAL — each with its own gauge, none with a unified answer to "how
+many bytes is this process actually holding, and how close is the chip
+to its HBM ceiling?". This module is that answer:
+
+- a registry of named byte providers (``register``): module-level
+  stores register pull-providers here at census time; instance-scoped
+  stores (outbox, artifact spool, WAL) push-register from their
+  constructors. Each provider returns at least ``{"bytes": int}`` plus
+  whatever detail it wants surfaced;
+- ``census()``: the ``GET /debug/memory`` payload — every store's
+  bytes (exported as ``swarm_memory_store_bytes{store}``), the grand
+  total, per-device HBM occupancy from ``device.memory_stats()``
+  (chips/device.hbm_census), and the fleet's worst-device headroom
+  ratio;
+- ``device_headroom()``: the cheap headroom probe the worker's
+  ``/healthz`` consults — below ``Settings.memory_headroom_degraded``
+  the worker reports degraded, so an orchestrator sees an
+  HBM-squeezed slice before the next big pass OOMs it.
+
+Import-time jax-free (SW001): the accelerator side lives behind a lazy
+chips.device import that only executes on worker call paths; providers
+that fail (a store torn down mid-scrape) record an error detail, never
+break the endpoint.
+"""
+
+from __future__ import annotations
+
+import threading
+
+from . import telemetry
+
+_STORE_BYTES = telemetry.gauge(
+    "swarm_memory_store_bytes",
+    "Resident bytes per byte-capped store (embed cache, LoRA factor / "
+    "operand caches, program ledger, outbox, artifact spool, WAL), "
+    "refreshed on each /debug/memory census",
+    ("store",),
+)
+_HBM_USED = telemetry.gauge(
+    "swarm_device_hbm_used_bytes",
+    "Bytes in use on each local device (device.memory_stats), refreshed "
+    "on each /debug/memory census",
+    ("device",),
+)
+_HBM_LIMIT = telemetry.gauge(
+    "swarm_device_hbm_limit_bytes",
+    "Per-device memory limit (device.memory_stats bytes_limit, falling "
+    "back to the chips/device HBM table)",
+    ("device",),
+)
+_HEADROOM = telemetry.gauge(
+    "swarm_memory_headroom_ratio",
+    "Worst-device free-HBM fraction (1 - used/limit); drives the "
+    "low-headroom /healthz degradation (memory_headroom_degraded)",
+)
+
+_LOCK = threading.Lock()
+_PROVIDERS: dict[str, object] = {}
+
+
+def register(store: str, provider) -> None:
+    """Register (or replace) the byte provider for `store`. Providers
+    are callables returning ``{"bytes": int, ...detail}``. Instance
+    stores re-register on construction — last instance wins, which is
+    the live one."""
+    with _LOCK:
+        _PROVIDERS[str(store)] = provider
+
+
+def unregister(store: str) -> None:
+    with _LOCK:
+        _PROVIDERS.pop(str(store), None)
+
+
+def _cache_provider(get_cache):
+    """Provider over the module-level cache pattern (embed_cache /
+    lora_cache / lora_operands): resident bytes + entry count, 0 when
+    the cache is disabled."""
+    def provider() -> dict:
+        cache = get_cache()
+        if cache is None:
+            return {"bytes": 0, "entries": 0, "enabled": False}
+        return {"bytes": int(cache.resident_bytes), "entries": len(cache),
+                "cap_bytes": int(getattr(cache, "max_bytes", 0))}
+    return provider
+
+
+def _builtin_providers() -> dict:
+    """The process-wide stores every census can pull without anyone
+    registering them (lazy imports: a hive-only process that never
+    touched the worker stores still censuses cleanly)."""
+    from . import embed_cache, lora_cache, lora_operands, programs
+
+    return {
+        "embed_cache": _cache_provider(embed_cache.get_cache),
+        "lora_factor_cache": _cache_provider(lora_cache.get_cache),
+        "lora_operand_cache": _cache_provider(lora_operands.get_cache),
+        "program_ledger": programs.resident_code_bytes,
+    }
+
+
+def census() -> dict:
+    """The GET /debug/memory payload: per-store bytes (gauges refreshed
+    as a side effect), the total, per-device HBM occupancy, and the
+    worst-device headroom."""
+    with _LOCK:
+        providers = dict(_PROVIDERS)
+    for name, provider in _builtin_providers().items():
+        providers.setdefault(name, provider)
+    stores: dict[str, dict] = {}
+    total = 0
+    for name in sorted(providers):
+        try:
+            detail = providers[name]() or {}
+        except Exception as e:  # a torn-down store must not 500 the census
+            detail = {"bytes": 0, "error": f"{type(e).__name__}: {e}"}
+        nbytes = detail.get("bytes")
+        nbytes = int(nbytes) if isinstance(nbytes, (int, float)) else 0
+        detail["bytes"] = nbytes
+        _STORE_BYTES.set(nbytes, store=name)
+        total += nbytes
+        stores[name] = detail
+    payload = {"stores": stores, "total_bytes": total}
+    devices = _device_census()
+    if devices is not None:
+        payload["devices"] = devices
+        headrooms = [d["headroom_ratio"] for d in devices
+                     if d.get("headroom_ratio") is not None]
+        if headrooms:
+            payload["headroom_ratio"] = min(headrooms)
+            _HEADROOM.set(payload["headroom_ratio"])
+    return payload
+
+
+def _device_census() -> list[dict] | None:
+    """Per-device HBM view (worker processes only — returns None where
+    no accelerator runtime is importable)."""
+    try:
+        from .chips.device import hbm_census
+    except Exception:
+        return None
+    try:
+        devices = hbm_census()
+    except Exception:
+        return None
+    for d in devices:
+        label = d.get("device", "?")
+        used, limit = d.get("bytes_in_use"), d.get("bytes_limit")
+        if isinstance(used, int):
+            _HBM_USED.set(used, device=label)
+        if isinstance(limit, int) and limit > 0:
+            _HBM_LIMIT.set(limit, device=label)
+            if isinstance(used, int):
+                d["headroom_ratio"] = round(max(1.0 - used / limit, 0.0), 4)
+        d.setdefault("headroom_ratio", None)
+    return devices
+
+
+def device_headroom() -> float | None:
+    """Worst-device free-HBM fraction, or None when no device reports a
+    limit (CPU smoke). Cheap enough for every /healthz probe; exports
+    the headroom gauge as a side effect."""
+    devices = _device_census()
+    if not devices:
+        return None
+    headrooms = [d["headroom_ratio"] for d in devices
+                 if d.get("headroom_ratio") is not None]
+    if not headrooms:
+        return None
+    worst = min(headrooms)
+    _HEADROOM.set(worst)
+    return worst

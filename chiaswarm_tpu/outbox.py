@@ -92,6 +92,23 @@ class Outbox:
         self.directory.mkdir(parents=True, exist_ok=True)
         self.max_entries = int(max_entries)
         self._seq = itertools.count()
+        # fleet memory census (ISSUE 17): the spool directory's resident
+        # bytes next to the in-HBM stores; last-constructed outbox wins
+        from . import memory_census
+
+        memory_census.register("outbox", self.resident_bytes)
+
+    def resident_bytes(self) -> dict:
+        """Census provider: spooled envelope bytes on disk (delivery
+        spool + parked), plus the file count."""
+        files = self._files()
+        total = 0
+        for path in files:
+            try:
+                total += path.stat().st_size
+            except OSError:
+                continue
+        return {"bytes": total, "entries": len(files)}
 
     # --- spool lifecycle ---
 

@@ -33,7 +33,7 @@ import jax.numpy as jnp
 import numpy as np
 from PIL import Image
 
-from .. import embed_cache
+from .. import costs, embed_cache, programs
 from ..models import configs as cfgs
 from ..models.clip import CLIPTextEncoder
 from ..models.tokenizer import load_tokenizer
@@ -361,25 +361,31 @@ class SDPipeline:
         # encode instead of op-by-op applies (each unjitted op is a separate
         # host->device round trip; round 1 measured >50% of job time on the
         # host side, VERDICT weak #2). jit retraces per shape bucket.
-        self._encode_program = jax.jit(self._encode_impl)
+        self._encode_program = programs.instrument(
+            jax.jit(self._encode_impl), model=model_name, kind="encode")
         # text-encoder-LoRA twin (ISSUE 16): the TE delta operands ride
         # as traced ARGUMENTS, so swapping adapters never retraces —
         # jit retraces per operand structure (sig), like _encode_program
         # retraces per shape bucket
-        self._encode_delta_program = jax.jit(self._encode_delta_impl)
+        self._encode_delta_program = programs.instrument(
+            jax.jit(self._encode_delta_impl), model=model_name,
+            kind="encode_delta")
         # per-pass operand-residency stats for the envelope (ISSUE 16):
         # set by _lora_operands, reset at pass start by run/run_batched
         self.last_operand_stats = None
-        self._vae_encode_program = jax.jit(
-            lambda vae_params, px: self.vae.apply(
-                {"params": vae_params}, px, method=self.vae.encode
-            ).astype(jnp.float32)
-        )
+        self._vae_encode_program = programs.instrument(
+            jax.jit(
+                lambda vae_params, px: self.vae.apply(
+                    {"params": vae_params}, px, method=self.vae.encode
+                ).astype(jnp.float32)
+            ),
+            model=model_name, kind="vae_encode")
         # weights-free 2x: encode -> bilinear latent resize -> decode.
         # Kept as the explicit `upscale` fallback when the learned sd-x2
         # upscaler has no converted weights (otherwise every production
         # upscale job would die on MissingWeightsError)
-        self._latent2x_program = jax.jit(self._latent2x_impl)
+        self._latent2x_program = programs.instrument(
+            jax.jit(self._latent2x_impl), model=model_name, kind="latent2x")
         # resident ControlNet branches keyed by controlnet model name
         self._controlnets: dict[str, tuple] = {}
         # param trees with LoRAs merged, keyed by (lora ref, scale); LRU-
@@ -1504,10 +1510,15 @@ class SDPipeline:
             self._runner_cache.popitem(last=False)
             _PROGRAM_EVICTED.inc(kind="runner")
 
-    def _program(self, cache_key, build):
+    def _program(self, cache_key, build, kind="program",
+                 analytic_flops=None):
         """One jitted program per cache key, sharing the compile-cache
         metrics and the placement-layer residency note across every
-        denoise program kind (fused, prep, chunk, decode)."""
+        denoise program kind (fused, prep, chunk, decode). Every compile
+        registers with the program ledger (programs.py, ISSUE 17);
+        `analytic_flops` — supplied by sites that know their program's
+        models/flops.py count — arms the analytic-vs-XLA divergence
+        cross-check on first call."""
         with self._jit_lock:
             cached = self._programs.get(cache_key)
             if cached is not None:
@@ -1522,7 +1533,9 @@ class SDPipeline:
             from ..chips.allocator import note_resident
 
             note_resident(self.model_name, self.chipset.slice_id)
-        program = jax.jit(build())
+        program = programs.instrument(
+            jax.jit(build()), model=self.model_name, kind=kind,
+            key=cache_key, analytic_flops=analytic_flops)
         with self._jit_lock:
             self._programs[cache_key] = program
             self._programs.move_to_end(cache_key)
@@ -1551,7 +1564,7 @@ class SDPipeline:
         return (gkey, "lora", lora_sig)
 
     def _denoise_program(self, key, controlnet_module=None, geo=None,
-                         mesh=None, lora_sig=None):
+                         mesh=None, lora_sig=None, analytic_flops=None):
         """Build (or fetch) the classic fused jitted denoise+decode
         program for one bucket — prep, the full step loop, and decode in
         ONE dispatch. This is the denoise_chunk_steps=0 path, cached
@@ -1577,7 +1590,8 @@ class SDPipeline:
             return run
 
         return self._program(
-            self._sig_key(self._geo_key(key, geo), lora_sig), build)
+            self._sig_key(self._geo_key(key, geo), lora_sig), build,
+            kind="fused", analytic_flops=analytic_flops)
 
     def _denoise_chunk_steps(self) -> int:
         """Settings.denoise_chunk_steps at call time (env-overridable per
@@ -1589,7 +1603,7 @@ class SDPipeline:
             return 0
 
     def _chunk_programs(self, key, controlnet_module, geo, mesh, chunk,
-                        lora_sig=None):
+                        lora_sig=None, analytic_flops=None):
         """(prep, {length: chunk}, decode, lengths, lo) — the compiled
         program set for one bucket under one geometry, plus the chunk
         walk it serves. Shared by the chunked runner and the mid-pass
@@ -1607,12 +1621,21 @@ class SDPipeline:
             pos += lengths[-1]
         gkey = self._geo_key(key, geo)
         skey = self._sig_key(gkey, lora_sig)
-        prep_prog = self._program((gkey, "prep"), lambda: prep_fn)
+        prep_prog = self._program((gkey, "prep"), lambda: prep_fn,
+                                  kind="prep")
+        # the analytic count covers the whole denoise span; a length-n
+        # chunk owns its proportional share of the (hi - lo) steps
+        per_step = (analytic_flops / (hi - lo)
+                    if analytic_flops and hi > lo else None)
         chunk_progs = {
-            n: self._program((skey, "chunk", n), lambda n=n: make_steps(n))
+            n: self._program((skey, "chunk", n), lambda n=n: make_steps(n),
+                             kind="chunk",
+                             analytic_flops=(per_step * n if per_step
+                                             else None))
             for n in set(lengths)
         }
-        decode_prog = self._program((gkey, "decode"), lambda: decode_fn)
+        decode_prog = self._program((gkey, "decode"), lambda: decode_fn,
+                                    kind="decode")
         return prep_prog, chunk_progs, decode_prog, lengths, lo
 
     def _migrate_operands(self, mesh, operands: tuple) -> tuple:
@@ -1633,7 +1656,7 @@ class SDPipeline:
         return tuple(jax.tree_util.tree_map(place, op) for op in operands)
 
     def _denoise_runner(self, key, controlnet_module=None, geo=None,
-                        lora_sig=None):
+                        lora_sig=None, analytic_flops=None):
         """Resolve the execution strategy for one bucket. Returns
         ``runner(*program_args, cancel_probe=None, reshard_probe=None)
         -> uint8 pixels``.
@@ -1671,7 +1694,7 @@ class SDPipeline:
         if chunk <= 0:
             program = self._denoise_program(
                 key, controlnet_module, geo=geo, mesh=mesh,
-                lora_sig=lora_sig)
+                lora_sig=lora_sig, analytic_flops=analytic_flops)
 
             def runner(*args, cancel_probe=None, reshard_probe=None):
                 # no chunk seams: a fused pass cannot re-shard mid-flight
@@ -1681,7 +1704,8 @@ class SDPipeline:
         else:
             prep_prog, chunk_progs, decode_prog, lengths, lo = \
                 self._chunk_programs(key, controlnet_module, geo, mesh,
-                                     chunk, lora_sig=lora_sig)
+                                     chunk, lora_sig=lora_sig,
+                                     analytic_flops=analytic_flops)
 
             def runner(params, init_rng, context, added, guidance_scale,
                        image_guidance, image_latents, mask, rng,
@@ -1735,7 +1759,8 @@ class SDPipeline:
                                         self._chunk_programs(
                                             key, controlnet_module, target,
                                             cur_mesh, chunk,
-                                            lora_sig=lora_sig)
+                                            lora_sig=lora_sig,
+                                            analytic_flops=analytic_flops)
                                 compile_s = time.perf_counter() - t0
                                 (latents, state, context, added,
                                  image_latents, mask, rng, cn_params,
@@ -2114,13 +2139,21 @@ class SDPipeline:
             tuple(sorted(dataclass_items(sched_cfg))),
         )
         key = (mode, lh, lw, n_images, steps, sched_key, t_start, cn_key)
+        # analytic UNet FLOPs of this pass (models/flops.py) — the cost
+        # stamp's numerator AND the program ledger's divergence hint
+        from ..models.flops import denoise_flops
+
+        pass_flops_raw = denoise_flops(
+            self.unet.config, lh, lw, n_images, steps - t_start,
+            cfg_rows=cfg_rows)
         # stage "compile" is program-cache resolution: ~0 on a hit, the
         # full trace+XLA compile on a miss (swarm_compile_cache_total
         # tells the two apart in aggregate). With denoise_chunk_steps>0
         # the runner resolves the whole chunked program set here.
         with Span("compile", timings, key="trace_s"):
             runner = self._denoise_runner(
-                key, controlnet_module, geo=geo, lora_sig=lora_sig)
+                key, controlnet_module, geo=geo, lora_sig=lora_sig,
+                analytic_flops=pass_flops_raw)
 
         # long-sequence self-attention shards over the mesh seq axis (ring
         # attention) when this pass's mesh view carved one out; trace-time
@@ -2253,7 +2286,21 @@ class SDPipeline:
             images = out
             timings["upscale_s"] = round(time.perf_counter() - t0, 3)
 
-        from ..models.flops import denoise_flops
+        # per-pass cost figures (ISSUE 17): a solo pass IS its own job,
+        # so the job's flops equal the pass flops
+        cost = costs.job_cost(
+            costs.pass_cost(
+                model=self.model_name,
+                pass_flops=pass_flops_raw,
+                denoise_s=timings.get("denoise_decode_s"),
+                chips=(self.chipset.chip_count() if self.chipset is not None
+                       else 1),
+                device=jax.devices()[0] if jax.devices() else None,
+                geometry=geometry_label(pass_geometry["tensor"],
+                                        pass_geometry["seq"]),
+            ),
+            pass_flops_raw,
+        )
 
         pipeline_config = {
             "model": self.model_name,
@@ -2289,12 +2336,11 @@ class SDPipeline:
                 else {}
             ),
             # analytic UNet FLOPs of the denoise loop -> MFU in the bench
-            "unet_tflops": round(
-                denoise_flops(self.unet.config, lh, lw, n_images, steps - t_start,
-                              cfg_rows=cfg_rows)
-                / 1e12,
-                4,
-            ),
+            "unet_tflops": round(pass_flops_raw / 1e12, 4),
+            # serving-path cost stamp (ISSUE 17): the job's own integer
+            # FLOPs plus the pass's achieved TFLOP/s and MFU (null where
+            # the platform has no peak entry — CPU smoke)
+            "cost": cost,
             # adapter execution path (ISSUE 13): "delta" = runtime
             # per-row low-rank delta on the resident base tree,
             # "merged" = full merged-tree fallback copy
@@ -2596,9 +2642,17 @@ class SDPipeline:
         sched_key = (scheduler_type, tuple(sorted(dataclass_items(sched_cfg))))
         key = ("batched_i2i" if i2i else "batched",
                lh, lw, padded, steps, sched_key, t_start, cn_key)
+        # analytic UNet FLOPs of the whole PADDED pass (padding rows
+        # burn chip time too — the pass-level figure owns them; per-job
+        # stamps below count only each job's real rows)
+        from ..models.flops import denoise_flops
+
+        pass_flops_raw = denoise_flops(
+            self.unet.config, lh, lw, padded, steps - t_start, cfg_rows=2)
         with Span("compile", timings, key="trace_s"):
             runner = self._denoise_runner(
-                key, controlnet_module, lora_sig=lora_sig)
+                key, controlnet_module, lora_sig=lora_sig,
+                analytic_flops=pass_flops_raw)
         # coalesced passes ALWAYS run the default data-parallel view:
         # throughput traffic keeps the coalescing geometry while
         # interactive solos may shard (the class-aware split, ISSUE 12).
@@ -2668,7 +2722,19 @@ class SDPipeline:
 
         groups = split_by_counts(_to_pil(np.asarray(pixels)), counts)
 
-        from ..models.flops import denoise_flops
+        # pass-level cost figures (ISSUE 17), counted ONCE for the
+        # coalesced pass; each envelope below derives its own stamp with
+        # its job's real-row FLOPs
+        pass_cost_figures = costs.pass_cost(
+            model=self.model_name,
+            pass_flops=pass_flops_raw,
+            denoise_s=timings.get("denoise_decode_s"),
+            chips=(self.chipset.chip_count() if self.chipset is not None
+                   else 1),
+            device=jax.devices()[0] if jax.devices() else None,
+            geometry=geometry_label(pass_geometry["tensor"],
+                                    pass_geometry["seq"]),
+        )
 
         results = []
         offset = 0
@@ -2697,6 +2763,12 @@ class SDPipeline:
                     denoise_flops(self.unet.config, lh, lw, n,
                                   steps - t_start, cfg_rows=2) / 1e12, 4,
                 ),
+                # per-envelope cost stamp (ISSUE 17): THIS job's real-row
+                # FLOPs, then the shared pass figures (like embed_cache)
+                "cost": costs.job_cost(
+                    pass_cost_figures,
+                    denoise_flops(self.unet.config, lh, lw, n,
+                                  steps - t_start, cfg_rows=2)),
                 # shared-pass embed-cache stats, copied per envelope
                 # like the timings below (the per-job split is unknown
                 # once rows stack — accounting treats them as the

@@ -281,6 +281,11 @@ class Settings:
     # worker side: consecutive transport errors on the pinned hive
     # endpoint before the client pins to the next one
     hive_failover_errors: int = 2
+    # /healthz reports degraded when the worst device's free-HBM
+    # fraction (memory_census.device_headroom) drops below this; 0
+    # disables — some fleets legitimately run HBM near-full, so the
+    # squeeze probe is an operator opt-in
+    memory_headroom_degraded: float = 0.0
 
     @classmethod
     def field_names(cls) -> tuple[str, ...]:
@@ -366,6 +371,7 @@ _ENV_OVERRIDES = {
     "CHIASWARM_HIVE_REPLICATION_LAG_DEGRADED_S":
         "hive_replication_lag_degraded_s",
     "CHIASWARM_PROFILER_CAPTURE": "profiler_capture",
+    "CHIASWARM_MEMORY_HEADROOM_DEGRADED": "memory_headroom_degraded",
 }
 
 

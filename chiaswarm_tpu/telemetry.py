@@ -389,7 +389,8 @@ PROFILE_MAX_SECONDS = 120.0
 
 
 def build_metrics_app(registry: Registry | None = None, health=None,
-                      profile=None, token: str = ""):
+                      profile=None, token: str = "", programs=None,
+                      memory=None):
     """aiohttp app with GET /metrics (Prometheus text) and GET /healthz
     (JSON from the caller's `health()` snapshot; a payload carrying
     `status` != "ok" answers 503 so probes can act on it). aiohttp is
@@ -400,12 +401,18 @@ def build_metrics_app(registry: Registry | None = None, health=None,
     jax.profiler capture (writes a perfetto trace under
     $SDAAS_ROOT/profiles/). The callable raising PermissionError maps to
     403 (the Settings.profiler_capture gate), RuntimeError to 409 (a
-    capture already running); no callable, no route. Unlike the two
+    capture already running); no callable, no route. Unlike the
     read-only GETs, /debug/profile MUTATES (pins an executor thread,
     writes prompt-exposing traces to disk), so when `token` is set it
     requires the same bearer auth the hive APIs use — a worker whose
     metrics_host is widened off loopback must not expose an anonymous
-    write endpoint (empty token = dev mode, matching the hive)."""
+    write endpoint (empty token = dev mode, matching the hive).
+
+    `programs` / `memory` (optional, ISSUE 17) are sync callables
+    returning JSON-ready dicts, wired to GET /debug/programs (the
+    compiled-program ledger, programs.snapshot) and GET /debug/memory
+    (the fleet byte census, memory_census.census). Read-only like
+    /metrics; no callable, no route."""
     from aiohttp import web
 
     reg = registry or REGISTRY
@@ -454,17 +461,32 @@ def build_metrics_app(registry: Registry | None = None, health=None,
                 {"message": f"{type(e).__name__}: {e}"}, status=500)
         return web.json_response({"status": "ok", **(detail or {})})
 
+    def debug_snapshot(provider):
+        async def handler(_request):
+            try:
+                payload = provider() or {}
+            except Exception as e:  # a broken ledger must not kill the app
+                return web.json_response(
+                    {"message": f"{type(e).__name__}: {e}"}, status=500)
+            return web.json_response(payload)
+        return handler
+
     app = web.Application()
     app.router.add_get("/metrics", metrics)
     app.router.add_get("/healthz", healthz)
     if profile is not None:
         app.router.add_post("/debug/profile", debug_profile)
+    if programs is not None:
+        app.router.add_get("/debug/programs", debug_snapshot(programs))
+    if memory is not None:
+        app.router.add_get("/debug/memory", debug_snapshot(memory))
     return app
 
 
 async def start_metrics_server(port: int, registry: Registry | None = None,
                                health=None, host: str = "127.0.0.1",
-                               profile=None, token: str = ""):
+                               profile=None, token: str = "",
+                               programs=None, memory=None):
     """Bind the telemetry app; returns the AppRunner (caller cleans up) or
     None when port is falsy (CHIASWARM_METRICS_PORT=0 opt-out)."""
     if not port:
@@ -472,7 +494,8 @@ async def start_metrics_server(port: int, registry: Registry | None = None,
     from aiohttp import web
 
     runner = web.AppRunner(
-        build_metrics_app(registry, health, profile, token))
+        build_metrics_app(registry, health, profile, token,
+                          programs=programs, memory=memory))
     await runner.setup()
     await web.TCPSite(runner, host, int(port)).start()
     return runner

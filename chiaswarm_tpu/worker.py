@@ -363,6 +363,7 @@ class Worker:
         if not port:
             return
         try:
+            from . import memory_census, programs
             from .telemetry import start_metrics_server
 
             self._metrics_runner = await start_metrics_server(
@@ -373,6 +374,10 @@ class Worker:
                 # the profile hook mutates; it requires the same bearer
                 # token the worker itself is provisioned with
                 token=str(getattr(self.settings, "sdaas_token", "")),
+                # ISSUE 17 cost plane: the compiled-program ledger and the
+                # fleet byte census, both read-only snapshots
+                programs=programs.snapshot,
+                memory=memory_census.census,
             )
             logger.info("metrics server on :%d", port)
         except Exception as e:  # observability is an add-on, never fatal
@@ -444,12 +449,28 @@ class Worker:
         if self.outbox.saturated:
             reasons.append(
                 f"outbox saturated ({self.outbox.depth} spooled envelopes)")
+        # ISSUE 17: HBM squeeze probe. Opt-in (threshold 0 = off) because
+        # a healthy steady state legitimately keeps HBM near-full on some
+        # fleets; CPU smoke reports no bytes_limit -> headroom None ->
+        # never fires
+        headroom = None
+        threshold = float(
+            getattr(self.settings, "memory_headroom_degraded", 0.0) or 0.0)
+        if threshold > 0:
+            from . import memory_census
+
+            headroom = memory_census.device_headroom()
+            if headroom is not None and headroom < threshold:
+                reasons.append(
+                    f"device HBM headroom {headroom:.1%} below "
+                    f"{threshold:.1%}")
         oldest = self.outbox.oldest_age_s()
         return {
             "status": "degraded" if reasons else "ok",
             "degraded_reasons": reasons,
             "worker_version": __version__,
             "last_poll_age_s": age,
+            "memory_headroom_ratio": headroom,
             "draining": self._draining.is_set(),
             "jobs_in_flight": self.batcher.outstanding_jobs,
             "results_pending": self.result_queue.qsize(),

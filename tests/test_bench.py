@@ -42,6 +42,12 @@ def test_bench_cpu_fallback_produces_labeled_smoke_row():
     assert out["unit"] == "images/sec/chip"
     assert out["backend"] == "cpu"
     assert 0 < out["denoise_fraction"] <= 1
+    # ISSUE 17 satellite: a 64^2 4-step CPU toy must NOT be ratioed
+    # against the SDXL TPU roofline target — the key stays, the value is
+    # null (the field is present so dashboards see "not comparable"
+    # rather than "missing")
+    assert "vs_baseline" in out
+    assert out["vs_baseline"] is None, out["vs_baseline"]
 
     # warm-compile probe produced a number (or a visible failure string)
     assert "warm_compile_s" in out
@@ -112,6 +118,20 @@ def test_bench_cpu_fallback_produces_labeled_smoke_row():
     assert out.get("usage_fallback_jobs") == 0, out
     assert out.get("slo_report_present") is True, out
 
+    # serving-path cost plane (ISSUE 17): every settled envelope carries
+    # a cost stamp with flops > 0, the hive ledger's flops agree with
+    # the independent envelope-stamp sum within 5%, and the fleet-rate
+    # keys are present (MFU is null on CPU — no peak-TFLOPs entry)
+    assert out.get("hive_e2e_cost_stamped_jobs", 0) >= \
+        out["usage_settled_jobs"], out
+    assert out.get("hive_e2e_envelope_flops", 0) > 0, out
+    assert out.get("usage_flops", 0) > 0, out
+    assert 0.95 <= out.get("usage_flops_ratio", 0) <= 1.05, out
+    assert out.get("hive_e2e_fleet_tflops") is not None, out
+    assert out["hive_e2e_fleet_tflops"] > 0, out
+    assert "hive_e2e_mfu" in out, out
+    assert out["hive_e2e_mfu"] is None, out  # CPU: no peak entry
+
     # end-to-end tracing row (ISSUE 8): every settled job in the
     # hive_e2e scenario must carry a COMPLETE gap-free timeline —
     # admit/dispatch(placement)/settle events, an attributed queue-wait
@@ -151,6 +171,13 @@ def test_bench_cpu_fallback_produces_labeled_smoke_row():
     assert out.get("sharded_txt2img_t4_geometry", {}).get("tensor") == 4, out
     assert out.get("sharded_txt2img_t2_maxdiff", 99) <= 2, out
     assert out.get("sharded_txt2img_t4_maxdiff", 99) <= 2, out
+    # cost plane on the sharded row (ISSUE 17): achieved fleet TFLOP/s
+    # from the envelope's own cost stamp; MFU null on CPU
+    for tensor in (1, 2, 4):
+        assert out.get(
+            f"sharded_txt2img_t{tensor}_fleet_tflops", 0) > 0, out
+        assert f"sharded_txt2img_t{tensor}_mfu" in out, out
+        assert out[f"sharded_txt2img_t{tensor}_mfu"] is None, out
 
     # cross-job micro-batching row (4-virtual-device slice child): the
     # coalesce ladder landed, and filling the slice beats batch-1 passes

@@ -586,7 +586,7 @@ def test_usage_reply_shape(backend_name):
     USAGE_FIELDS = {"jobs", "chip_seconds", "rows", "coalesced_jobs",
                     "coalesce_saved_seconds", "embed_cache_hits",
                     "artifact_bytes", "operand_upload_bytes_saved",
-                    "fallback_jobs"}
+                    "flops", "petaflops", "fallback_jobs"}
 
     async def scenario(backend, client):
         status, _ = await _post_job(
@@ -596,7 +596,10 @@ def test_usage_reply_shape(backend_name):
         await client.submit_result({
             "id": job["id"], "artifacts": {}, "nsfw": False,
             "worker_version": "0.1.0",
-            "pipeline_config": {"timings": {"job_s": 1.5}}})
+            "pipeline_config": {"timings": {"job_s": 1.5},
+                                # serving-path cost stamp (ISSUE 17): the
+                                # ledger bills the job's own integer FLOPs
+                                "cost": {"flops": 2_000_000_000_000}}})
         status, usage = await _get_json(backend, "/usage")
         assert status == 200
         assert isinstance(usage["tenants"], dict)
@@ -604,8 +607,13 @@ def test_usage_reply_shape(backend_name):
         assert usage["tenants"]["acme"]["jobs"] == 1
         assert usage["tenants"]["acme"]["chip_seconds"] == 1.5
         assert usage["tenants"]["acme"]["fallback_jobs"] == 0
+        # FLOPs land integer-exact under the tenant AND in the totals,
+        # with the human-scale petaflops twin derived from the same sum
+        assert usage["tenants"]["acme"]["flops"] == 2_000_000_000_000
+        assert usage["tenants"]["acme"]["petaflops"] == 0.002
         assert set(usage["totals"]) == USAGE_FIELDS
         assert usage["totals"]["jobs"] >= 1
+        assert usage["totals"]["flops"] >= 2_000_000_000_000
         status, one = await _get_json(backend, "/tenants/acme/usage")
         assert status == 200
         assert one["tenant"] == "acme" and one["known"] is True

@@ -151,6 +151,39 @@ def test_geometry_line_from_synthetic_text():
     assert tool.geometry_summary([]) is None
 
 
+def test_cost_line_from_synthetic_text():
+    """ISSUE 17: the serving-path cost line — analytic TFLOPs served per
+    model, MFU per model/geometry, XLA divergence, live program count —
+    and its machine-readable twin; MFU and divergence sections vanish on
+    fleets (CPU) that never produced them."""
+    tool = _load_tool()
+    samples = tool.parse_metrics(
+        'swarm_pass_flops_total{model="sdxl"} 4.2e+12\n'
+        'swarm_pass_mfu{model="sdxl",geometry="replicated"} 0.4321\n'
+        'swarm_pass_mfu{model="sdxl",geometry="tensor2"} 0.3111\n'
+        'swarm_flops_divergence_ratio{model="sdxl"} 1.02\n'
+        'swarm_programs_live{model="sdxl"} 5\n')
+    assert tool.cost_line(samples) == (
+        "cost           tflops sdxl=4.200 "
+        "mfu sdxl/replicated=0.432 sdxl/tensor2=0.311 "
+        "xla_divergence sdxl=1.02 programs_live=5")
+    summary = tool.cost_summary(samples)
+    assert summary == {
+        "pass_flops": {"sdxl": 4_200_000_000_000},
+        "mfu": {"sdxl/replicated": 0.4321, "sdxl/tensor2": 0.3111},
+        "divergence": {"sdxl": 1.02},
+        "programs_live": {"sdxl": 5},
+    }
+    # a CPU fleet has flops but no MFU/divergence — partial line, no "-"
+    cpu = tool.parse_metrics(
+        'swarm_pass_flops_total{model="sd21"} 1e+09\n')
+    assert tool.cost_line(cpu) == "cost           tflops sd21=0.001"
+    assert tool.cost_summary(cpu)["mfu"] == {}
+    # a fleet that never stamped a pass renders nothing at all
+    assert tool.cost_line([]) is None
+    assert tool.cost_summary([]) is None
+
+
 HIVE_SYNTHETIC = """\
 # TYPE swarm_hive_dispatch_total counter
 swarm_hive_dispatch_total{outcome="affinity"} 6
@@ -203,6 +236,8 @@ swarm_hive_tenant_chip_seconds_total{tenant="other"} 1.5
 # TYPE swarm_hive_tenant_rows_total gauge
 swarm_hive_tenant_rows_total{tenant="acme"} 19
 swarm_hive_tenant_rows_total{tenant="other"} 1
+# TYPE swarm_hive_tenant_flops_total gauge
+swarm_hive_tenant_flops_total{tenant="acme"} 2e+15
 # TYPE swarm_hive_usage_fallback_total counter
 swarm_hive_usage_fallback_total 2
 # TYPE swarm_hive_slo_burn_rate gauge
@@ -245,8 +280,8 @@ def test_hive_tables_from_synthetic_text():
     # fleet observability plane (ISSUE 11): per-tenant usage, SLO burn,
     # fallback settles, straggler flags
     assert summary["tenants"] == {
-        "acme": {"chip_seconds": 42.5, "rows": 19},
-        "other": {"chip_seconds": 1.5, "rows": 1}}
+        "acme": {"chip_seconds": 42.5, "rows": 19, "petaflops": 2.0},
+        "other": {"chip_seconds": 1.5, "rows": 1, "petaflops": 0.0}}
     assert list(summary["tenants"]) == ["acme", "other"]  # cost-sorted
     assert summary["usage_fallback"] == 2
     assert summary["slo"] == {"interactive": {

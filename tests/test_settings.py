@@ -279,6 +279,7 @@ EXPECTED_FIELDS = (
     "hive_stats_ewma_alpha", "hive_straggler_factor", "sdaas_uris",
     "hive_standby_of", "hive_replication_poll_s", "hive_failover_grace_s",
     "hive_replication_lag_degraded_s", "hive_failover_errors",
+    "memory_headroom_degraded",
 )
 
 

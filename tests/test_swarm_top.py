@@ -72,6 +72,8 @@ swarm_hive_tenant_chip_seconds_total{tenant="other"} 3.25
 # TYPE swarm_hive_tenant_rows_total gauge
 swarm_hive_tenant_rows_total{tenant="acme"} 7
 swarm_hive_tenant_rows_total{tenant="other"} 2
+# TYPE swarm_hive_tenant_flops_total gauge
+swarm_hive_tenant_flops_total{tenant="acme"} 2.5e+15
 # TYPE swarm_hive_worker_outlier gauge
 swarm_hive_worker_outlier{worker="w-fast"} 0
 swarm_hive_worker_outlier{worker="w-slow"} 1
@@ -102,6 +104,12 @@ swarm_lora_cache_total{event="hit"} 3
 swarm_lora_cache_total{event="miss"} 1
 # TYPE swarm_lora_cache_entries gauge
 swarm_lora_cache_entries 2
+# TYPE swarm_pass_flops_total counter
+swarm_pass_flops_total{model="sdxl"} 4.2e+12
+# TYPE swarm_pass_mfu gauge
+swarm_pass_mfu{model="sdxl",geometry="replicated"} 0.43
+# TYPE swarm_programs_live gauge
+swarm_programs_live{model="sdxl"} 5
 """
 
 
@@ -138,7 +146,9 @@ def test_render_hive_and_worker_frames_from_synthetic_data():
     # fleet observability plane (ISSUE 11): tenant frame (sorted by
     # chip-seconds, rows alongside), SLO frame (fast/slow burn +
     # compliance, BURNING on a breach), straggler flag with its stages
-    assert "tenants   acme=12.5s/7r other=3.2s/2r" in lines
+    # cost plane (ISSUE 17): petaflops ride the tenant frame where the
+    # hive exported them; tenants without a flops series keep s/r only
+    assert "tenants   acme=12.5s/7r/2.5000Pf other=3.2s/2r" in lines
     assert "slo       interactive burn=3.20/0.40 comp=0.84 BURNING" in lines
     assert "straggler w-slow (stages: job)" in lines
     straggler_line = next(
@@ -177,6 +187,9 @@ def test_render_hive_and_worker_frames_from_synthetic_data():
     # factor cache's hit rate and residency
     assert ("adapters  delta=6 merged=2 plain=8 "
             "cache_hit_rate=0.75 factors=2") in lines
+    # serving-path cost frame (ISSUE 17): analytic TFLOPs served, MFU
+    # where the chip has a peak entry, and the live program population
+    assert "cost      sdxl=4.20T mfu sdxl/replicated=0.43 programs=5" in lines
 
     # an unreachable endpoint renders as such instead of raising
     dead = tool.Snapshot("http://gone:1", error="ConnectionError: refused")

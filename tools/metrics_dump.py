@@ -207,15 +207,20 @@ def _gang_summary(samples) -> dict:
 
 
 def _tenant_summary(samples) -> dict:
-    """Per-tenant usage gauges -> {tenant: {chip_seconds, rows}}, sorted
-    by chip-seconds (the hive already folded past-top-K tenants into
-    "other", so cardinality here is bounded by construction)."""
+    """Per-tenant usage gauges -> {tenant: {chip_seconds, rows,
+    petaflops}}, sorted by chip-seconds (the hive already folded
+    past-top-K tenants into "other", so cardinality here is bounded by
+    construction)."""
     chip = _label_counts(
         samples, "swarm_hive_tenant_chip_seconds_total", "tenant")
     rows = _label_counts(samples, "swarm_hive_tenant_rows_total", "tenant")
+    flops = _label_counts(samples, "swarm_hive_tenant_flops_total", "tenant")
     return {
         tenant: {"chip_seconds": chip[tenant],
-                 "rows": int(rows.get(tenant, 0))}
+                 "rows": int(rows.get(tenant, 0)),
+                 # cost plane (ISSUE 17): "petaflops served" next to the
+                 # chip-seconds it was served in
+                 "petaflops": round(flops.get(tenant, 0.0) / 1e15, 6)}
         for tenant in sorted(chip, key=lambda t: (-chip[t], t))
     }
 
@@ -360,12 +365,12 @@ def render_hive_tables(summary: dict) -> str:
     # each class inside its objective, who is dragging the fleet
     tenants = summary.get("tenants") or {}
     if tenants:
-        lines.append("hive tenants  (chip_s / rows; past-top-K folded "
-                     "into 'other')")
+        lines.append("hive tenants  (chip_s / rows / Pflops; past-top-K "
+                     "folded into 'other')")
         for tenant, t in tenants.items():
             lines.append(
                 f"  {tenant:<16} {t['chip_seconds']:>10.3f} "
-                f"{t['rows']:>6}")
+                f"{t['rows']:>6} {t.get('petaflops', 0.0):>10.6f}")
         if summary.get("usage_fallback"):
             lines.append(
                 f"  (usage fallback settles: {summary['usage_fallback']})")
@@ -498,6 +503,53 @@ def geometry_line(samples) -> str | None:
             f"sharded_rate={summary['sharded_rate']:.2f}")
 
 
+def cost_summary(samples) -> dict | None:
+    """Serving-path cost plane (ISSUE 17): analytic UNet FLOPs served
+    per model, latest MFU per model/geometry (absent on accelerators
+    with no peak-FLOPs table entry — CPU always), the analytic-vs-XLA
+    divergence ratio, and live compiled programs per model. None when
+    no denoise pass ever stamped a cost."""
+    flops = _label_counts(samples, "swarm_pass_flops_total", "model")
+    if not flops:
+        return None
+    mfu = {
+        f"{labels['model']}/{labels['geometry']}": round(v, 4)
+        for m, labels, v in samples
+        if m == "swarm_pass_mfu" and "model" in labels
+        and "geometry" in labels
+    }
+    return {
+        "pass_flops": {k: int(v) for k, v in sorted(flops.items())},
+        "mfu": dict(sorted(mfu.items())),
+        "divergence": {
+            k: round(v, 4) for k, v in sorted(_label_counts(
+                samples, "swarm_flops_divergence_ratio", "model").items())},
+        "programs_live": {k: int(v) for k, v in sorted(_label_counts(
+            samples, "swarm_programs_live", "model").items())},
+    }
+
+
+def cost_line(samples) -> str | None:
+    """Human-readable twin of cost_summary."""
+    summary = cost_summary(samples)
+    if summary is None:
+        return None
+    tflops = " ".join(
+        f"{model}={flops / 1e12:.3f}"
+        for model, flops in summary["pass_flops"].items())
+    parts = [f"cost           tflops {tflops}"]
+    if summary["mfu"]:
+        parts.append("mfu " + " ".join(
+            f"{k}={v:.3f}" for k, v in summary["mfu"].items()))
+    if summary["divergence"]:
+        parts.append("xla_divergence " + " ".join(
+            f"{k}={v:.2f}" for k, v in summary["divergence"].items()))
+    live = sum(summary["programs_live"].values())
+    if live:
+        parts.append(f"programs_live={live}")
+    return " ".join(parts)
+
+
 async def _run_smoke_job() -> None:
     """One tiny-model txt2img job through the REAL worker path (the same
     code a hive job takes minus the HTTP hop), populating the stage spans."""
@@ -622,6 +674,7 @@ def main(argv: list[str] | None = None) -> int:
         "embed_cache": embed_cache_summary(samples),
         "lora": lora_summary(samples),
         "geometry": geometry_summary(samples),
+        "cost": cost_summary(samples),
         "healthz": health,
     }
     if args.json:
@@ -637,6 +690,9 @@ def main(argv: list[str] | None = None) -> int:
         geometry = geometry_line(samples)
         if geometry:
             print(geometry)
+        cost = cost_line(samples)
+        if cost:
+            print(cost)
     return 0 if rows else 1
 
 

@@ -235,10 +235,15 @@ def render_hive(cur: Snapshot, prev: Snapshot | None) -> list[str]:
     tenant_chip = cur.counters("swarm_hive_tenant_chip_seconds_total",
                                "tenant")
     tenant_rows = cur.counters("swarm_hive_tenant_rows_total", "tenant")
+    # cost plane (ISSUE 17): petaflops served alongside the
+    # chip-seconds they were served in
+    tenant_flops = cur.counters("swarm_hive_tenant_flops_total", "tenant")
     if tenant_chip:
         ranked = sorted(tenant_chip.items(), key=lambda kv: (-kv[1], kv[0]))
         lines.append("  tenants   " + " ".join(
             f"{t}={chip:.1f}s/{int(tenant_rows.get(t, 0))}r"
+            + (f"/{tenant_flops[t] / 1e15:.4f}Pf"
+               if t in tenant_flops else "")
             for t, chip in ranked))
     slo = h.get("slo") or {}
     if slo:
@@ -368,6 +373,34 @@ def render_worker(cur: Snapshot, prev: Snapshot | None) -> list[str]:
             f"  adapters  delta={int(lrows.get('delta', 0))} "
             f"merged={int(lrows.get('merged', 0))} "
             f"plain={int(lrows.get('none', 0))}{cache_bit}{operand_bit}")
+
+    # serving-path cost plane (ISSUE 17): analytic TFLOPs served per
+    # model with the achieved fleet rate over the last interval, MFU
+    # where the chip has a peak-FLOPs table entry (never on CPU), and
+    # the compiled-program ledger's live population
+    pass_flops = cur.counters("swarm_pass_flops_total", "model")
+    if pass_flops:
+        dt = (cur.taken - prev.taken) if prev else 0.0
+        pflops = prev.counters(
+            "swarm_pass_flops_total", "model") if prev else {}
+        bits = []
+        for m, v in sorted(pass_flops.items()):
+            bit = f"{m}={v / 1e12:.2f}T"
+            pv = pflops.get(m)
+            if pv is not None and dt > 0 and v >= pv:
+                bit += f"(+{(v - pv) / dt / 1e12:.2f}T/s)"
+            bits.append(bit)
+        mfu = {f"{labels['model']}/{labels['geometry']}": v
+               for metric, labels, v in cur.samples
+               if metric == "swarm_pass_mfu"
+               and "model" in labels and "geometry" in labels}
+        mfu_bit = ""
+        if mfu:
+            mfu_bit = " mfu " + " ".join(
+                f"{k}={v:.2f}" for k, v in sorted(mfu.items()))
+        live = sum(cur.counters("swarm_programs_live", "model").values())
+        lines.append(
+            f"  cost      {' '.join(bits)}{mfu_bit} programs={int(live)}")
 
     # per-stage latency over the last interval (cumulative in --once)
     stages: dict[str, dict[float, float]] = {}
