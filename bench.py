@@ -1216,7 +1216,10 @@ def _hive_e2e_row_subprocess() -> dict:
     pristine worker in a grandchild, talking over real loopback sockets —
     submit -> queue -> residency-aware dispatch -> lease -> denoise ->
     POST /results -> idempotent ACK. Reports jobs/s, hive-side queue-wait
-    p50/p95, and the redelivery count (0 in a healthy run)."""
+    p50/p95, and the redelivery count (0 in a healthy run), then a
+    preemption-tolerance phase (ISSUE 18): a checkpoint-armed worker
+    killed mid-denoise, a second worker resuming from the checkpoint —
+    resume_saved_steps_ratio + the preview artifact count."""
     import subprocess
 
     timeout_s = _row_timeout("hive_e2e", 900.0)
@@ -1759,6 +1762,99 @@ def run_hive_e2e_row() -> None:
                                        headers=headers) as resp:
                     slo_report = await resp.json()
 
+                # --- preemption tolerance (ISSUE 18): a checkpoint-armed
+                # worker is SIGKILL'd mid-denoise past a shipped
+                # chunk-boundary checkpoint; the lease is force-expired
+                # and a second resume-capable worker must finish the
+                # pass from the checkpointed step via the redelivery's
+                # `resume` offer. Reports the fraction of the pass the
+                # resume SAVED over a naive full redelivery, plus the
+                # progressive-preview artifact count. The main worker
+                # ran WITHOUT the checkpoint knobs, so every number
+                # above is from the classic (byte-identical) path; its
+                # redelivery count is snapshotted here — the forced
+                # expiry below belongs to this phase alone ---
+                redeliveries_main = int(expired.value()) if expired else 0
+                worker.terminate()  # the resume workers replace it
+                try:
+                    await asyncio.to_thread(worker.wait, 30)
+                except subprocess.TimeoutExpired:
+                    worker.kill()
+
+                def spawn_resume_worker(name: str) -> subprocess.Popen:
+                    # same env (shared $SDAAS_ROOT -> warm persistent
+                    # compile cache from the main phase) + the ISSUE 18
+                    # knobs: checkpoint every chunk, preview every 4th
+                    env2 = dict(worker_env, SDAAS_WORKERNAME=name,
+                                CHIASWARM_METRICS_PORT="0",
+                                CHIASWARM_CHECKPOINT_EVERY_CHUNKS="1",
+                                CHIASWARM_PREVIEW_EVERY_CHUNKS="4")
+                    return subprocess.Popen(
+                        [sys.executable, "-m", "chiaswarm_tpu.worker"],
+                        cwd=repo, env=env2, stdout=subprocess.DEVNULL,
+                        stderr=subprocess.STDOUT)
+
+                resume_steps = 32  # the cancel jobs' shape: warm compile
+                doomed = spawn_resume_worker("bench-resume-doomed")
+                heir = None
+                try:
+                    resume_id = await submit(dict(
+                        tiny_job(0, "resume"),
+                        num_inference_steps=resume_steps))
+
+                    async def checkpoint_shipped() -> bool:
+                        async with session.get(
+                                f"{hive.api_uri}/jobs/{resume_id}/trace",
+                                headers=headers) as resp:
+                            if resp.status != 200:
+                                return False
+                            tr = await resp.json()
+                        return any(e.get("event") == "checkpoint"
+                                   for e in tr.get("events", []))
+
+                    deadline = time.monotonic() + 600.0
+                    while not await checkpoint_shipped():
+                        if time.monotonic() > deadline:
+                            raise TimeoutError(
+                                "resume phase: no checkpoint within 600s")
+                        await asyncio.sleep(0.05)
+                    # checkpoint durable at step >=2 of 32: the kill
+                    # lands mid-denoise, never after the result POST
+                    doomed.kill()
+                    await asyncio.to_thread(doomed.wait)
+                    # the row's 900s lease would stall the phase: expire
+                    # it NOW (the hive is in-process) so the reaper
+                    # redelivers on its next ~1s tick
+                    lease = hive.leases.get(resume_id)
+                    if lease is not None:
+                        lease.expires_at = hive.queue.clock.mono() - 1.0
+                    heir = spawn_resume_worker("bench-resume-heir")
+                    resume_status = await wait_done(resume_id, 240.0)
+                    if resume_status["status"] != "done":
+                        raise RuntimeError(
+                            "resume job failed: "
+                            f"{resume_status['error']}")
+                finally:
+                    for proc in (doomed, heir):
+                        if proc is not None and proc.poll() is None:
+                            proc.terminate()
+                            try:
+                                await asyncio.to_thread(proc.wait, 30)
+                            except subprocess.TimeoutExpired:
+                                proc.kill()
+
+                resumed_stamp = ((resume_status.get("result") or {})
+                                 .get("pipeline_config")
+                                 or {}).get("resumed") or {}
+                resume_from_step = int(resumed_stamp.get("from_step", 0))
+                resume_recomputed = int(resumed_stamp.get(
+                    "recomputed_steps", resume_steps))
+                async with session.get(
+                        f"{hive.api_uri}/jobs/{resume_id}/trace",
+                        headers=headers) as resp:
+                    resume_events = [e.get("event") for e in
+                                     (await resp.json()).get("events", [])]
+
             waits.sort()
             pre_batched = sum(1 for s in gang_sizes if s >= 2)
             gang_sizes.sort()
@@ -1774,8 +1870,7 @@ def run_hive_e2e_row() -> None:
                 "hive_e2e_queue_wait_p50_s": waits[len(waits) // 2],
                 "hive_e2e_queue_wait_p95_s": waits[
                     int(0.95 * (len(waits) - 1))],
-                "hive_e2e_redeliveries": int(
-                    expired.value()) if expired else 0,
+                "hive_e2e_redeliveries": redeliveries_main,
                 # hive-side coalesced dispatch (ISSUE 9): fraction of the
                 # timed burst arriving pre-batched, and the size spread
                 "gang_rate": round(
@@ -1823,6 +1918,20 @@ def run_hive_e2e_row() -> None:
                     slo_report.get("enabled")
                     and slo_report.get("classes", {}).get("default", {})
                     .get("objectives")),
+                # preemption tolerance (ISSUE 18): resume-on-redelivery
+                # skipped `from_step` of the pass's steps; a naive
+                # redelivery recomputes every one. Previews are counted
+                # from the trace timeline — terminal states clear the
+                # `partial` disposition, the timeline keeps the events
+                "hive_e2e_resume_saved_steps_ratio": round(
+                    resume_from_step
+                    / max(resume_from_step + resume_recomputed, 1), 3),
+                "hive_e2e_resume_from_step": resume_from_step,
+                "hive_e2e_resume_recomputed_steps": resume_recomputed,
+                "hive_e2e_resume_offers":
+                    resume_events.count("resume_offer"),
+                "hive_e2e_preview_artifacts":
+                    resume_events.count("preview"),
             }
         finally:
             worker.terminate()  # SIGTERM -> graceful drain

@@ -1,0 +1,117 @@
+"""Denoise checkpoint blobs: the wire format of a preempted pass.
+
+A checkpoint-armed chunked denoise (ISSUE 18) ships its live state at
+chunk boundaries — current latents, the scheduler-state leaves, and the
+step index — so a redelivered job rehydrates at step K on another worker
+instead of recomputing the whole pass. Everything else a resume needs
+(conditioning, per-step RNG, guidance) recomputes deterministically from
+the redelivered job arguments, so the blob stays tens-to-hundreds of KB.
+
+The format is deliberately self-contained and numpy-version-stable:
+an 8-byte magic, a little-endian u32 header length, a JSON header
+describing every array (name, dtype, shape), then the arrays' raw bytes
+concatenated in header order. ``np.savez`` is avoided on purpose — the
+scheduler state may carry ``bfloat16`` leaves, which numpy only
+round-trips via pickle; here the dtype travels by NAME and is resolved
+through ml_dtypes when numpy alone cannot.
+
+A ``program signature`` pins compatibility: a resume offer is honored
+only when the redelivered job resolves to the same (model, bucket key,
+dtype, geometry) the checkpoint was cut under — otherwise the latents
+would be fed to a program with a different meaning of "step K" and the
+pass silently diverges. Signature mismatch degrades to a full recompute,
+never an error.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import struct
+
+import numpy as np
+
+MAGIC = b"CSWCKPT1"
+FORMAT_VERSION = 1
+
+
+def program_signature(model_name: str, key, dtype, geo=None) -> str:
+    """Stable short id for the compiled-program family a checkpoint
+    belongs to. Built from the same ingredients the pipeline's program
+    bucket key uses, so two passes share a signature exactly when their
+    chunk programs are interchangeable."""
+    raw = repr((str(model_name), key, str(dtype), tuple(geo) if geo else None))
+    return hashlib.sha256(raw.encode()).hexdigest()[:16]
+
+
+def _np_dtype(name: str) -> np.dtype:
+    try:
+        return np.dtype(name)
+    except TypeError:
+        # bfloat16 & friends live in ml_dtypes (a jax dependency)
+        import ml_dtypes
+
+        return np.dtype(getattr(ml_dtypes, name))
+
+
+def pack(step: int, latents, state_leaves, signature: str) -> bytes:
+    """Serialize one checkpoint. `latents` and each entry of
+    `state_leaves` must be array-likes (device arrays are gathered by
+    np.asarray); order of the leaves is the pytree flatten order, which
+    the resuming pipeline re-derives from a fresh prep pass."""
+    arrays: list[tuple[str, np.ndarray]] = [("latents", np.asarray(latents))]
+    for i, leaf in enumerate(state_leaves):
+        arrays.append((f"leaf{i}", np.asarray(leaf)))
+    header = {
+        "v": FORMAT_VERSION,
+        "step": int(step),
+        "signature": str(signature),
+        "leaves": len(state_leaves),
+        "arrays": [
+            {"name": name, "dtype": str(a.dtype), "shape": list(a.shape)}
+            for name, a in arrays
+        ],
+    }
+    head = json.dumps(header, separators=(",", ":")).encode()
+    parts = [MAGIC, struct.pack("<I", len(head)), head]
+    for _, a in arrays:
+        parts.append(np.ascontiguousarray(a).tobytes())
+    return b"".join(parts)
+
+
+def unpack(blob: bytes) -> dict:
+    """Parse a checkpoint blob back into host arrays. Raises ValueError
+    on anything malformed — callers treat that as "no checkpoint" and
+    run the full pass."""
+    if len(blob) < len(MAGIC) + 4 or blob[: len(MAGIC)] != MAGIC:
+        raise ValueError("not a checkpoint blob")
+    head_len = struct.unpack_from("<I", blob, len(MAGIC))[0]
+    start = len(MAGIC) + 4
+    try:
+        header = json.loads(blob[start:start + head_len].decode())
+    except (UnicodeDecodeError, json.JSONDecodeError) as e:
+        raise ValueError(f"corrupt checkpoint header: {e}") from e
+    if header.get("v") != FORMAT_VERSION:
+        raise ValueError(f"unsupported checkpoint version {header.get('v')}")
+    offset = start + head_len
+    out: dict[str, np.ndarray] = {}
+    for spec in header.get("arrays", []):
+        dtype = _np_dtype(str(spec["dtype"]))
+        shape = tuple(int(d) for d in spec["shape"])
+        count = int(np.prod(shape, dtype=np.int64)) if shape else 1
+        nbytes = count * dtype.itemsize
+        chunk = blob[offset:offset + nbytes]
+        if len(chunk) != nbytes:
+            raise ValueError("truncated checkpoint blob")
+        out[str(spec["name"])] = np.frombuffer(
+            chunk, dtype=dtype).reshape(shape).copy()
+        offset += nbytes
+    if "latents" not in out:
+        raise ValueError("checkpoint blob has no latents")
+    leaves = [out[f"leaf{i}"] for i in range(int(header.get("leaves", 0)))]
+    return {
+        "step": int(header.get("step", 0)),
+        "signature": str(header.get("signature", "")),
+        "latents": out["latents"],
+        "state_leaves": leaves,
+    }

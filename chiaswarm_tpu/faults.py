@@ -28,6 +28,11 @@ Injection points wired today (site -> effect):
 - ``kill_before_ack`` worker result delivery raises FaultInjected AFTER
                      the hive ack, BEFORE the outbox unlink (simulated
                      crash; exercises redelivery-on-restart)
+- ``hang_after_checkpoint`` the chunk-boundary checkpoint shipper blocks
+                     right after handing a checkpoint upload to the
+                     event loop — the worker 'dies' mid-denoise past a
+                     durable checkpoint (exercises resume-on-redelivery,
+                     ISSUE 18)
 - ``kill_before_journal_sync`` (hive-side) the coordinator dies between
                      an in-memory state mutation and the WAL append —
                      the in-flight HTTP response errors and the journal

@@ -483,6 +483,71 @@ class HiveClient:
             permanent=not transient,
         ) from last_exc
 
+    async def fetch_artifact(self, href: str) -> bytes | None:
+        """GET one spooled blob by its hive href (``/api/artifacts/<digest>``,
+        the shape a /work reply's `resume` offer carries). Best-effort by
+        contract: every failure returns None — a resume offer degrades to
+        a full pass, never to an error."""
+        uri = self.hive_uri
+        # hrefs are site-absolute; the pinned endpoint is the API base
+        base = uri[:-4] if uri.endswith("/api") else uri
+        session = await self._get_session()
+        timeout = aiohttp.ClientTimeout(total=SUBMIT_TIMEOUT_S)
+        t0 = time.perf_counter()
+        try:
+            async with session.get(
+                f"{base}{href}",
+                headers=self._headers(),
+                timeout=timeout,
+            ) as response:
+                self._note_epoch(response)
+                if response.status != 200:
+                    logger.warning("artifact fetch %s answered %d",
+                                   href, response.status)
+                    return None
+                self._note_success()
+                return await response.read()
+        except Exception as e:
+            self._note_request_failure("artifact", uri, e)
+            logger.warning("artifact fetch %s failed: %s", href, e)
+            return None
+        finally:
+            _REQUEST_SECONDS.observe(
+                time.perf_counter() - t0, endpoint="artifact")
+
+    async def post_partial(self, kind: str, job_id: str,
+                           payload: dict) -> dict | None:
+        """POST one mid-pass partial (`kind` is ``checkpoint`` or
+        ``preview``) to the hive's durability endpoints (ISSUE 18).
+        Best-effort: the denoise pass never waits on this and never
+        fails because of it — any refusal (a 409 means the lease moved
+        or the job went terminal, so further partials are pointless) or
+        transport error returns None."""
+        uri = self.hive_uri
+        session = await self._get_session()
+        timeout = aiohttp.ClientTimeout(total=SUBMIT_TIMEOUT_S)
+        t0 = time.perf_counter()
+        try:
+            async with session.post(
+                f"{uri}/jobs/{job_id}/{kind}",
+                data=json.dumps(payload),
+                headers=self._headers(),
+                timeout=timeout,
+            ) as response:
+                self._note_epoch(response)
+                if response.status != 200:
+                    logger.info("%s upload for %s refused with %d",
+                                kind, job_id, response.status)
+                    return None
+                self._note_success()
+                return await response.json()
+        except Exception as e:
+            self._note_request_failure(kind, uri, e)
+            logger.warning("%s upload for %s failed: %s", kind, job_id, e)
+            return None
+        finally:
+            _REQUEST_SECONDS.observe(time.perf_counter() - t0, endpoint=kind)
+
     async def get_models(self) -> list[dict]:
         """Fetch the hive's model catalog; cached to models.json on success.
 

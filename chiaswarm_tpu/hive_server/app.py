@@ -52,6 +52,7 @@ from .journal import (
     apply_events,
     ev_admit,
     ev_cancel,
+    ev_checkpoint,
     ev_expire,
     ev_lease,
     ev_park,
@@ -89,6 +90,26 @@ _POLLS = telemetry.counter(
 )
 # registered by leases.py (imported above); same-name counter() returns it
 _JOBS_FAILED = telemetry.counter("swarm_hive_jobs_failed_total")
+_CHECKPOINTS = telemetry.counter(
+    "swarm_hive_checkpoints_total",
+    "Mid-pass checkpoint blobs POSTed to the hive (ISSUE 18), by outcome "
+    "(stored = spooled + WAL-journaled; superseded = an older checkpoint "
+    "blob of the same job dropped; rejected = sender is not the lessee "
+    "or the job is not leased)",
+    ("outcome",),
+)
+_PREVIEWS_STORED = telemetry.counter(
+    "swarm_hive_previews_total",
+    "Progressive preview artifacts POSTed to the hive (ISSUE 18), by "
+    "outcome (stored | rejected)",
+    ("outcome",),
+)
+_RESUME_OFFERS = telemetry.counter(
+    "swarm_hive_resume_offers_total",
+    "Redelivered jobs whose /work reply carried a `resume` offer "
+    "(checkpoint href + step + program signature) to a resume-capable "
+    "worker (ISSUE 18)",
+)
 _STALE_EPOCH = telemetry.counter(
     "swarm_hive_stale_epoch_total",
     "Requests refused with 409 because the caller has seen a newer hive "
@@ -142,12 +163,18 @@ class HiveServer:
         self.queue, self.leases = self._new_state()
         self.directory = WorkerDirectory(
             ttl_s=float(g("hive_worker_ttl_s", 45.0)), fleet=self.fleet)
+        # flap detection (ISSUE 18): the dispatcher queries the LIVE
+        # lease table through self (a standby's replication reset swaps
+        # self.leases, and the closure must follow it)
+        self.flap_threshold = int(g("hive_flap_threshold", 3))
         self.dispatcher = Dispatcher(
             self.directory,
             affinity_hold_s=float(g("hive_affinity_hold_s", 15.0)),
             max_jobs_per_poll=int(g("hive_max_jobs_per_poll", 4)),
             gang_max=int(g("hive_gang_max", 8)),
             lora_slots=int(g("lora_slots_max", 8)),
+            flap_threshold=self.flap_threshold,
+            flapping_fn=lambda: self.leases.flapping(self.flap_threshold),
         )
         self.spool = ArtifactSpool(
             resolve_path(g("hive_spool_dir", "hive_spool")))
@@ -276,6 +303,8 @@ class HiveServer:
         app.router.add_get("/api/models", self._models)
         app.router.add_post("/api/jobs", self._submit)
         app.router.add_post("/api/jobs/{job_id}/cancel", self._cancel)
+        app.router.add_post("/api/jobs/{job_id}/checkpoint", self._checkpoint)
+        app.router.add_post("/api/jobs/{job_id}/preview", self._preview)
         app.router.add_get("/api/jobs/{job_id}", self._job_status)
         app.router.add_get("/api/jobs/{job_id}/trace", self._job_trace)
         app.router.add_get("/api/usage", self._usage)
@@ -348,6 +377,7 @@ class HiveServer:
             try:
                 for record in self.leases.reap(self.queue):
                     if record.state == "failed":
+                        self._drop_partials(record)
                         self._journal(ev_park(record))
                         for pruned in self.queue.retire(record):
                             self._journal(ev_retire(pruned))
@@ -399,6 +429,7 @@ class HiveServer:
             record.timeline.append({
                 "event": "park", "wall": self.queue.clock.wall(),
                 "reason": "unplaceable"})
+            self._drop_partials(record)
             self._journal(ev_park(record))
             for pruned in self.queue.retire(record):
                 self._journal(ev_retire(pruned))
@@ -436,6 +467,10 @@ class HiveServer:
                 if isinstance(art, dict) and isinstance(
                         art.get("sha256"), str):
                     protected.add(art["sha256"])
+        # live mid-pass state (ISSUE 18): a checkpoint awaiting its
+        # resume, or previews a poll can still reference, must survive
+        # the sweep whatever their age
+        protected |= self.queue.partial_digests()
         return self.spool.sweep(self.spool_max_bytes, self.spool_max_age_s,
                                 protected)
 
@@ -553,9 +588,33 @@ class HiveServer:
         # its stage spans attach to the right dispatch attempt, and gang
         # members carry trace.gang so they arrive pre-batched. Field
         # set pinned by the protocol-conformance suite.
-        reply = {"jobs": [dict(record.job,
-                               trace=wire_trace_context(record, gang=gang))
-                          for record, _, gang in handed]}
+        jobs_payload = []
+        for record, _, gang in handed:
+            job = dict(record.job,
+                       trace=wire_trace_context(record, gang=gang))
+            ck = record.checkpoint
+            if (ck and ck.get("sha256") and worker.resume_capable
+                    and record.attempts > 1):
+                # resume-on-redelivery (ISSUE 18): a redelivered job
+                # whose previous lessee shipped a mid-pass checkpoint
+                # carries the offer — href to the spooled blob, the
+                # step it was cut at, and the program signature the
+                # worker validates before rehydrating. Only attached
+                # for resume-capable pollers (capability-advertised),
+                # so legacy workers see the pre-resume wire shape.
+                job["resume"] = {
+                    "href": f"/api/artifacts/{ck['sha256']}",
+                    "step": int(ck.get("step", 0)),
+                    "signature": ck.get("signature"),
+                }
+                _RESUME_OFFERS.inc()
+                record.timeline.append({
+                    "event": "resume_offer",
+                    "wall": self.queue.clock.wall(),
+                    "worker": worker.name,
+                    "step": int(ck.get("step", 0))})
+            jobs_payload.append(job)
+        reply = {"jobs": jobs_payload}
         # piggyback pending lease revocations for THIS worker: the ids
         # of its live leases cancelled since its last poll. Popped on
         # delivery — a reply lost in flight degrades to the job running
@@ -668,6 +727,8 @@ class HiveServer:
         record.completed_by = (
             sender or (lease.worker if lease else record.worker))
         record.state = "done"
+        # the final artifact supersedes every partial (ISSUE 18)
+        self._drop_partials(record)
         settle_event = {
             "event": "settle", "wall": self.queue.clock.wall(),
             "worker": record.completed_by, "disposition": status,
@@ -743,6 +804,7 @@ class HiveServer:
             return reply(False)
         if record.state == "queued":
             self.queue.mark_cancelled(record, "queued")
+            self._drop_partials(record)
             self._journal(ev_cancel(record))
             for pruned in self.queue.retire(record):
                 self._journal(ev_retire(pruned))
@@ -753,6 +815,7 @@ class HiveServer:
         # poll; the denoise chunk boundary does the actual abort
         self.leases.settle(job_id)
         self.queue.mark_cancelled(record, "leased")
+        self._drop_partials(record)
         self._journal(ev_cancel(record))
         for pruned in self.queue.retire(record):
             self._journal(ev_retire(pruned))
@@ -766,6 +829,119 @@ class HiveServer:
             job_id, record.worker, record.attempts)
         return reply(True)
 
+    # --- mid-pass durability (ISSUE 18) ---
+
+    def _drop_partials(self, record) -> None:
+        """Terminal states keep no mid-pass state: clear the record's
+        checkpoint + previews and delete their now-unreferenced spool
+        blobs (the final artifact supersedes every partial)."""
+        for digest in self.queue.clear_partial(record):
+            self.spool.drop(digest)
+
+    async def _partial_body(self, request: web.Request
+                            ) -> tuple[dict | None, bytes | None,
+                                       web.Response | None]:
+        """Shared validation for checkpoint/preview POSTs: the sender
+        must be the job's CURRENT lessee and the job must still be
+        leased — a blob from an expired lessee (or for a settled job)
+        is refused so stale state can never shadow live state. Returns
+        (record_meta, blob, error_response)."""
+        import base64
+        import binascii
+
+        job_id = request.match_info["job_id"]
+        record = self.queue.records.get(job_id)
+        if record is None:
+            return None, None, web.json_response(
+                {"message": "unknown job id"}, status=404)
+        try:
+            body = json.loads(await request.text())
+        except json.JSONDecodeError:
+            return None, None, web.json_response(
+                {"message": "body is not JSON"}, status=400)
+        if not isinstance(body, dict) or not isinstance(
+                body.get("blob"), str):
+            return None, None, web.json_response(
+                {"message": "body must carry a base64 `blob`"}, status=400)
+        sender = str(body.get("worker_name") or "") or None
+        lease = self.leases.get(job_id)
+        if record.state != "leased" or lease is None or (
+                sender is not None and sender != lease.worker):
+            return {"record": record}, None, web.json_response(
+                {"message": f"job is {record.state}; only the current "
+                            "lessee may ship mid-pass state",
+                 "status": record.state},
+                status=409, headers=self._epoch_headers())
+        try:
+            blob = base64.b64decode(body["blob"])
+        except (binascii.Error, ValueError):
+            return None, None, web.json_response(
+                {"message": "blob is not base64"}, status=400)
+        return {"record": record, "body": body}, blob, None
+
+    async def _checkpoint(self, request: web.Request) -> web.Response:
+        """POST /api/jobs/{id}/checkpoint: the lessee's mid-pass state
+        at a chunk boundary. Spooled content-addressed, recorded on the
+        job as ONE WAL event (replayed, compacted, replicated), and only
+        the newest kept — a superseded blob is dropped on the spot."""
+        if not self._authorized(request):
+            return self._unauthorized()
+        refused = self._refused(request)
+        if refused is not None:
+            return refused
+        meta, blob, error = await self._partial_body(request)
+        if error is not None:
+            _CHECKPOINTS.inc(outcome="rejected")
+            return error
+        record, body = meta["record"], meta["body"]
+        digest = await asyncio.to_thread(self.spool.put, blob)
+        superseded = self.queue.note_checkpoint(record, {
+            "step": int(body.get("step", 0)),
+            "sha256": digest,
+            "signature": str(body.get("signature", "")),
+            "bytes": len(blob),
+        })
+        if superseded:
+            self.spool.drop(superseded)
+            _CHECKPOINTS.inc(outcome="superseded")
+        self._journal(ev_checkpoint(record))
+        _CHECKPOINTS.inc(outcome="stored")
+        return web.json_response({
+            "status": "ok", "step": int(body.get("step", 0)),
+            "sha256": digest,
+        }, headers=self._epoch_headers())
+
+    async def _preview(self, request: web.Request) -> web.Response:
+        """POST /api/jobs/{id}/preview: an intermediate decode of the
+        live latents. Appends to the record's `partial` disposition
+        (GET /api/jobs/{id}) and rides the same WAL event as the
+        checkpoint meta."""
+        if not self._authorized(request):
+            return self._unauthorized()
+        refused = self._refused(request)
+        if refused is not None:
+            return refused
+        meta, blob, error = await self._partial_body(request)
+        if error is not None:
+            _PREVIEWS_STORED.inc(outcome="rejected")
+            return error
+        record, body = meta["record"], meta["body"]
+        digest = await asyncio.to_thread(self.spool.put, blob)
+        self.queue.note_preview(record, {
+            "step": int(body.get("step", 0)),
+            "sha256": digest,
+            "bytes": len(blob),
+            "href": f"/api/artifacts/{digest}",
+            **({"content_type": str(body["content_type"])}
+               if body.get("content_type") else {}),
+        })
+        self._journal(ev_checkpoint(record))
+        _PREVIEWS_STORED.inc(outcome="stored")
+        return web.json_response({
+            "status": "ok", "step": int(body.get("step", 0)),
+            "href": f"/api/artifacts/{digest}",
+        }, headers=self._epoch_headers())
+
     def _expire_due(self) -> None:
         """Park queued jobs whose admission-time TTL lapsed. Runs before
         every dispatch decision (an expired job must not waste a
@@ -773,6 +949,7 @@ class HiveServer:
         worker polling)."""
         for record in self.queue.expired_queued():
             self.queue.mark_expired(record)
+            self._drop_partials(record)
             self._journal(ev_expire(record))
             for pruned in self.queue.retire(record):
                 self._journal(ev_retire(pruned))
@@ -999,6 +1176,11 @@ class HiveServer:
                 for cls, view in slo_report["classes"].items()
             },
             "stragglers": self.fleet.snapshot(self.directory.live_names()),
+            # flap detection (ISSUE 18): workers currently preferred-
+            # against for fresh seeds (consecutive lease expiries >=
+            # hive_flap_threshold), plus the raw streaks behind them
+            "flapping": sorted(self.leases.flapping(self.flap_threshold)),
+            "flap_streaks": dict(self.leases.flaps),
         }
         if self.journal is not None:
             payload["wal"] = {

@@ -38,6 +38,11 @@ Outcomes (counted in `swarm_hive_dispatch_total{outcome}`):
             run it as one sharded multi-chip program (`shard_capable`,
             ISSUE 12) while a shard-capable worker is live — same hold
             window bound: geometry prefers, never starves.
+- flap_hold a FRESH seed (never dispatched) was withheld from a poller
+            whose leases have expired `hive_flap_threshold` consecutive
+            times (ISSUE 18) while a healthy capable worker is live —
+            same hold window bound, and one settled result clears the
+            streak: flap detection prefers, never starves.
 
 Gang scheduling: when the picked job is coalesce-compatible
 (coalesce.py — the exact key the worker's BatchScheduler groups by) and
@@ -70,7 +75,7 @@ _DISPATCH = telemetry.counter(
     "swarm_hive_dispatch_total",
     "Hive /work dispatch decisions by placement outcome "
     "(affinity | adapter_affinity | cold | steal | hold | gang | "
-    "straggler_hold | shard_hold)",
+    "straggler_hold | shard_hold | flap_hold)",
     ("outcome",),
 )
 _GANG_SIZE = telemetry.histogram(
@@ -133,6 +138,10 @@ class WorkerInfo:
     # — the dispatcher routes a repeat adapter gang back to them so the
     # steady state re-uploads nothing
     resident_adapters: frozenset[str] = frozenset()
+    # preemption tolerance (ISSUE 18): the worker runs a chunked,
+    # checkpoint-armed denoise and can rehydrate a checkpoint blob —
+    # only these pollers get `resume` offers on redelivered jobs
+    resume_capable: bool = False
     last_seen: float = 0.0
 
     @property
@@ -159,6 +168,7 @@ class WorkerInfo:
             "gang_rows": self.gang_rows,
             "chips_per_slice": self.chips_per_slice,
             "shard_capable": self.shard_capable,
+            "resume_capable": self.resume_capable,
             "resident_models": sorted(self.resident),
             "resident_adapters": sorted(self.resident_adapters),
         }
@@ -200,6 +210,7 @@ class WorkerDirectory:
             chips_per_slice=_to_int(query.get("chips_per_slice")),
             shard_capable=_to_int(query.get("shard_capable")) > 0,
             resident_adapters=_split_csv(query.get("resident_adapters")),
+            resume_capable=_to_int(query.get("resume_capable")) > 0,
             last_seen=CLOCK.mono(),
         )
         self._workers[name] = info
@@ -246,10 +257,17 @@ class Dispatcher:
 
     def __init__(self, directory: WorkerDirectory, affinity_hold_s: float,
                  max_jobs_per_poll: int, gang_max: int = 8,
-                 lora_slots: int = 8):
+                 lora_slots: int = 8, flap_threshold: int = 0,
+                 flapping_fn=None):
         self.directory = directory
         self.affinity_hold_s = max(float(affinity_hold_s), 0.0)
         self.max_jobs_per_poll = max(int(max_jobs_per_poll), 1)
+        # flap detection (ISSUE 18): `flapping_fn` returns the worker
+        # names whose leases have expired `flap_threshold` consecutive
+        # times (LeaseTable.flapping) — derived live state, queried once
+        # per select() call
+        self.flap_threshold = max(int(flap_threshold), 0)
+        self.flapping_fn = flapping_fn
         # most jobs one GANG may hold (Settings.hive_gang_max); <= 1
         # disables gang scheduling hive-side entirely
         self.gang_max = max(int(gang_max), 1)
@@ -329,6 +347,9 @@ class Dispatcher:
         live_names = [w.name for w in live]
         poller_is_straggler = (
             fleet is not None and fleet.is_outlier(worker.name, live_names))
+        flapping: set[str] = set()
+        if self.flap_threshold > 0 and self.flapping_fn is not None:
+            flapping = set(self.flapping_fn() or ())
         for record in queue.iter_queued():
             if (items <= 0 or free_rows <= 0
                     or len(handed) >= self.max_jobs_per_poll):
@@ -354,6 +375,22 @@ class Dispatcher:
                 # healthy worker that stopped polling) degrades to the
                 # slow dispatch, never to starvation
                 _DISPATCH.inc(outcome="straggler_hold")
+                continue
+            if (worker.name in flapping
+                    and record.attempts == 0
+                    and now - record.submitted_at < self.affinity_hold_s
+                    and any(w.name != worker.name and w.can_run(model)
+                            and w.name not in flapping
+                            for w in live)):
+                # flap detection (ISSUE 18): a worker losing lease after
+                # lease is probably dying repeatedly (OOM loop, flaky
+                # host) — withhold FRESH seeds from it while a healthy
+                # capable worker is live, inside the same hold window as
+                # every other preference. Redeliveries are exempt (they
+                # already waited a full deadline), and a settled result
+                # resets the streak: flapping degrades placement, never
+                # availability.
+                _DISPATCH.inc(outcome="flap_hold")
                 continue
             if (record.job_class == "interactive"
                     and not worker.shard_capable

@@ -362,6 +362,19 @@ def ev_retire(job_id: str) -> dict:  # swarmlint: disable=SW006 -- a
     return {"ev": "retire", "id": job_id}
 
 
+def ev_checkpoint(record) -> dict:
+    """Mid-pass durability state (ISSUE 18): the record's latest
+    checkpoint meta AND its preview list in one event — both are tiny
+    (blob bytes live in the spool, addressed by digest), and a single
+    event per boundary keeps the WAL cost of a checkpoint at one line.
+    Replay restores by replacement, like the timeline."""
+    return {"ev": "checkpoint", "id": record.job_id,
+            "checkpoint": (dict(record.checkpoint)
+                           if record.checkpoint else None),
+            "previews": [dict(p) for p in record.previews],
+            "timeline": _timeline_of(record)}
+
+
 def ev_epoch(epoch: int) -> dict:
     """The fencing epoch (bumped on every standby promotion). Persisted
     so a promoted hive that restarts keeps refusing a deposed
@@ -397,8 +410,15 @@ def snapshot_events(queue: PriorityJobQueue, leases: LeaseTable,
             events.append(ev_cancel(record))
         elif record.state == "expired":
             events.append(ev_expire(record))
+        if record.state in ("leased", "settling") and (
+                record.checkpoint or record.previews):
+            events.append(ev_checkpoint(record))
     for record in queue.iter_queued():
         events.append(ev_admit(record))
+        if record.checkpoint or record.previews:
+            # a requeued job awaiting redelivery still holds its
+            # mid-pass state — exactly the record a resume offer needs
+            events.append(ev_checkpoint(record))
     return events
 
 
@@ -516,6 +536,14 @@ def apply_events(events: list[dict], queue: PriorityJobQueue,
             record.error = event.get("error")
             restore_timeline(record, event)
             queue.retire(record)
+        elif ev == "checkpoint":
+            # restore by replacement, like the timeline — the event is
+            # the record's full mid-pass state at append time
+            ck = event.get("checkpoint")
+            record.checkpoint = dict(ck) if isinstance(ck, dict) else None
+            record.previews = [dict(p) for p in event.get("previews", ())
+                               if isinstance(p, dict)]
+            restore_timeline(record, event)
         elif ev == "retire":
             queue.forget(record.job_id)
         else:

@@ -47,6 +47,13 @@ class LeaseTable:
         self.max_redeliveries = max(int(max_redeliveries), 0)
         self.clock = clock or CLOCK
         self._leases: dict[str, Lease] = {}
+        # flap detection (ISSUE 18): consecutive lease expiries per
+        # worker — reset to zero the moment one of its leases settles,
+        # so only an unbroken run of losses counts as flapping. Purely
+        # derived dispatch-bias state: never journaled, rebuilt from
+        # live traffic after a restart (a restarted hive giving a
+        # formerly-flappy worker a clean slate is the right call).
+        self.flaps: dict[str, int] = {}
 
     def __len__(self) -> int:
         return len(self._leases)
@@ -87,8 +94,20 @@ class LeaseTable:
         called for late results so an already-expired worker's answer
         stops any further redelivery)."""
         lease = self._leases.pop(job_id, None)
+        if lease is not None:
+            # a delivered result breaks the worker's expiry streak
+            self.flaps.pop(lease.worker, None)
         _LEASES_ACTIVE.set(len(self._leases))
         return lease
+
+    def flapping(self, threshold: int) -> set[str]:
+        """Workers whose consecutive-expiry count has reached
+        `threshold` (0 disables). The dispatcher withholds fresh seeds
+        from them within the affinity-hold window — prefers, never
+        starves — and /healthz surfaces the set."""
+        if threshold <= 0:
+            return set()
+        return {w for w, n in self.flaps.items() if n >= threshold}
 
     def reap(self, queue: PriorityJobQueue) -> list[JobRecord]:
         """Expire overdue leases: re-queue while the redelivery budget
@@ -102,6 +121,7 @@ class LeaseTable:
             del self._leases[job_id]
             record = lease.record
             _LEASES_EXPIRED.inc()
+            self.flaps[lease.worker] = self.flaps.get(lease.worker, 0) + 1
             # attempts counts dispatches; the budget bounds how many
             # times the job may be handed out in total
             if record.attempts > self.max_redeliveries:

@@ -220,6 +220,15 @@ class JobRecord:
     # "leased"); carried in the WAL cancel event so replay, compaction,
     # and replication all reconstruct it
     cancel_stage: str | None = None
+    # preemption-tolerant denoise (ISSUE 18): the latest mid-pass
+    # checkpoint this job's lessee shipped ({step, sha256, signature,
+    # bytes} — the blob itself lives in the spool, content-addressed)
+    # and the progressive previews decoded so far ([{step, sha256,
+    # bytes, href}]). Both ride the WAL (ev_checkpoint) so a restarted
+    # or promoted hive still offers the resume and serves the previews;
+    # both are cleared (and their blobs dropped) on terminal states.
+    checkpoint: dict | None = None
+    previews: list = dataclasses.field(default_factory=list)
 
     @property
     def tenant(self) -> str:
@@ -230,7 +239,7 @@ class JobRecord:
 
     def status(self) -> dict:
         """JSON-ready snapshot for GET /api/jobs/{id}."""
-        return {
+        out = {
             "id": self.job_id,
             "class": self.job_class,
             "tenant": self.tenant,
@@ -243,6 +252,21 @@ class JobRecord:
             "error": self.error,
             "result": self.result,
         }
+        # progressive previews (ISSUE 18): while the pass is still
+        # in flight, a poll carries the intermediate decodes so far (the
+        # `partial` disposition) — terminal states clear them, so a
+        # finished job's status never advertises stale partials
+        if self.previews and self.state not in ("done", "failed",
+                                                "cancelled", "expired"):
+            out["partial"] = {
+                "previews": [
+                    {"step": int(p.get("step", 0)), "href": p.get("href")}
+                    for p in self.previews
+                ],
+                **({"checkpoint_step": int(self.checkpoint.get("step", 0))}
+                   if self.checkpoint else {}),
+            }
+        return out
 
 
 class PriorityJobQueue:
@@ -544,6 +568,58 @@ class PriorityJobQueue:
 
     # states a record can end in (history pruning + status rendering)
     TERMINAL_STATES = ("done", "failed", "cancelled", "expired")
+
+    # --- mid-pass durability (ISSUE 18) ---
+
+    def note_checkpoint(self, record: JobRecord, meta: dict) -> str | None:
+        """Record the lessee's latest mid-pass checkpoint ({step, sha256,
+        signature, bytes}); only the NEWEST is kept — a resume always
+        wants the furthest step. Returns the superseded blob digest (for
+        the caller to drop from the spool) or None."""
+        old = (record.checkpoint or {}).get("sha256")
+        record.checkpoint = dict(meta)
+        record.timeline.append({
+            "event": "checkpoint", "wall": self.clock.wall(),
+            "step": int(meta.get("step", 0)),
+            "bytes": int(meta.get("bytes", 0))})
+        new = record.checkpoint.get("sha256")
+        return old if old and old != new else None
+
+    def note_preview(self, record: JobRecord, meta: dict) -> None:
+        """Append one progressive preview ({step, sha256, bytes, href})
+        to the record's partial disposition."""
+        record.previews.append(dict(meta))
+        record.timeline.append({
+            "event": "preview", "wall": self.clock.wall(),
+            "step": int(meta.get("step", 0)),
+            "bytes": int(meta.get("bytes", 0))})
+
+    def clear_partial(self, record: JobRecord) -> list[str]:
+        """Drop a record's checkpoint + previews (terminal states keep
+        neither: the final artifact supersedes every partial). Returns
+        the now-unreferenced blob digests for the caller to drop from
+        the spool."""
+        digests = []
+        if record.checkpoint:
+            digests.append(record.checkpoint.get("sha256"))
+        digests.extend(p.get("sha256") for p in record.previews)
+        record.checkpoint = None
+        record.previews = []
+        return [d for d in digests if d]
+
+    def partial_digests(self) -> set[str]:
+        """Every blob digest a live checkpoint or preview still
+        references (the spool retention sweep must not collect them)."""
+        live: set[str] = set()
+        for record in self.records.values():
+            if record.state in self.TERMINAL_STATES:
+                continue
+            if record.checkpoint and record.checkpoint.get("sha256"):
+                live.add(record.checkpoint["sha256"])
+            for p in record.previews:
+                if p.get("sha256"):
+                    live.add(p["sha256"])
+        return live
 
     def mark_cancelled(self, record: JobRecord, stage: str) -> None:
         """Move a record to the terminal `cancelled` state. `stage` names

@@ -115,6 +115,27 @@ class ArtifactSpool:
         except OSError:
             return None
 
+    def drop(self, digest: str) -> bool:
+        """Delete one blob by digest (terminal-state checkpoint/preview
+        sweeping, ISSUE 18). Content addressing makes this safe only
+        when the CALLER knows nothing else references the digest — the
+        hive tracks checkpoint/preview digests per record and drops them
+        exactly once, on the record's terminal transition. Returns True
+        if a blob was deleted."""
+        path = self.path_for(digest)
+        if path is None:
+            return False
+        with self._lock:
+            try:
+                size = path.stat().st_size
+                path.unlink()
+            except OSError:
+                return False
+            self._bytes = max(self._bytes - size, 0)
+            _SPOOL_BYTES.set(self._bytes)
+            _EVICTED.inc()
+        return True
+
     def sweep(self, max_bytes: int = 0, max_age_s: float = 0.0,
               protected: frozenset[str] | set[str] = frozenset()) -> int:
         """Retention sweep: `retire()` prunes in-memory records but the

@@ -60,6 +60,34 @@ _GAP_LABELS = {
     ("admit", "expire"): "ttl_expired",
     ("hold", "expire"): "ttl_expired",
     ("redeliver", "expire"): "ttl_expired",
+    # mid-pass durability (ISSUE 18): checkpoint/preview events land
+    # DURING execution, so the spans around them are still executing
+    # time; a lease lost after a checkpoint is the resume-saved window
+    ("lease", "checkpoint"): "executing",
+    ("dispatch", "checkpoint"): "executing",
+    ("checkpoint", "checkpoint"): "executing",
+    ("checkpoint", "preview"): "executing",
+    ("checkpoint", "settle"): "executing",
+    ("checkpoint", "redeliver"): "lease_lost",
+    ("checkpoint", "cancel"): "executing",
+    ("checkpoint", "park"): "lease_lost",
+    ("lease", "preview"): "executing",
+    ("dispatch", "preview"): "executing",
+    ("preview", "preview"): "executing",
+    ("preview", "checkpoint"): "executing",
+    ("preview", "settle"): "executing",
+    ("preview", "redeliver"): "lease_lost",
+    ("preview", "cancel"): "executing",
+    ("preview", "park"): "lease_lost",
+    # a redelivered dispatch carrying a resume offer stamps it between
+    # the lease grant and the (shorter) execution window
+    ("lease", "resume_offer"): "hive_grant",
+    ("resume_offer", "checkpoint"): "executing",
+    ("resume_offer", "preview"): "executing",
+    ("resume_offer", "settle"): "executing",
+    ("resume_offer", "redeliver"): "lease_lost",
+    ("resume_offer", "cancel"): "executing",
+    ("resume_offer", "park"): "lease_lost",
 }
 
 def worker_stages(result: dict | None) -> list[dict]:

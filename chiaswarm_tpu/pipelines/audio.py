@@ -19,6 +19,7 @@ import logging
 import os
 import time
 import zlib
+from collections import OrderedDict
 
 import jax
 import jax.numpy as jnp
@@ -315,7 +316,9 @@ class AudioPipeline:
             "%s audio pipeline resident in %.1fs", model_name,
             time.perf_counter() - t0,
         )
-        self._programs = {}
+        # insertion-ordered so the program_cache_max bound below can evict
+        # least-recently-used first (SW007; same knob as the SD family)
+        self._programs: OrderedDict = OrderedDict()
 
     def _model_dir(self):
         from pathlib import Path
@@ -345,6 +348,7 @@ class AudioPipeline:
 
     def _program(self, key):
         if key in self._programs:
+            self._programs.move_to_end(key)
             return self._programs[key]
         lt, lf, steps, sched_name = key
         scheduler = get_scheduler(sched_name)
@@ -398,6 +402,12 @@ class AudioPipeline:
 
         program = jax.jit(run)
         self._programs[key] = program
+        from .common import PROGRAM_EVICTED, program_cache_cap
+
+        cap = program_cache_cap()
+        while cap and len(self._programs) > cap:
+            self._programs.popitem(last=False)
+            PROGRAM_EVICTED.inc(kind="program")
         return program
 
     def run(self, prompt="", negative_prompt="", **kwargs):

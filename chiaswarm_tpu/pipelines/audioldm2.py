@@ -21,6 +21,7 @@ from __future__ import annotations
 
 import time
 import zlib
+from collections import OrderedDict
 
 import jax
 import jax.numpy as jnp
@@ -248,7 +249,9 @@ class AudioLDM2Pipeline:
         self.params = jax.tree_util.tree_map(
             lambda x: jnp.asarray(x, self.dtype), params
         )
-        self._programs: dict = {}
+        # insertion-ordered so the program_cache_max bound below can evict
+        # least-recently-used first (SW007; same knob as the SD family)
+        self._programs: OrderedDict = OrderedDict()
         self._gpt2_step = jax.jit(
             lambda p, seq, mask: self.gpt2.apply(
                 {"params": p}, seq, mask
@@ -334,6 +337,7 @@ class AudioLDM2Pipeline:
 
     def _program(self, key):
         if key in self._programs:
+            self._programs.move_to_end(key)
             return self._programs[key]
         lt, lf, steps, sched_name = key
         from ..schedulers import get_scheduler
@@ -389,6 +393,12 @@ class AudioLDM2Pipeline:
 
         program = jax.jit(run)
         self._programs[key] = program
+        from .common import PROGRAM_EVICTED, program_cache_cap
+
+        cap = program_cache_cap()
+        while cap and len(self._programs) > cap:
+            self._programs.popitem(last=False)
+            PROGRAM_EVICTED.inc(kind="program")
         return program
 
     def run(self, prompt="", negative_prompt="", **kwargs):

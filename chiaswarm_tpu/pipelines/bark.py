@@ -28,6 +28,7 @@ import logging
 import threading
 import time
 import zlib
+from collections import OrderedDict
 
 import jax
 import jax.numpy as jnp
@@ -285,7 +286,9 @@ class BarkPipeline:
         self.params = jax.device_put(
             jax.tree_util.tree_map(cast, params), replicated(self.mesh)
         )
-        self._programs: dict[tuple, callable] = {}
+        # insertion-ordered so the program_cache_max bound below can evict
+        # least-recently-used first (SW007; same knob as the SD family)
+        self._programs: OrderedDict = OrderedDict()
         self._lock = threading.Lock()
 
     def _tokenizer(self, model_dir):
@@ -311,6 +314,7 @@ class BarkPipeline:
         """One fused text->waveform program."""
         with self._lock:
             if key in self._programs:
+                self._programs.move_to_end(key)
                 return self._programs[key]
         t_text, n_sem, n_frames = key
         semantic, coarse, fine, codec = (
@@ -388,6 +392,12 @@ class BarkPipeline:
         program = jax.jit(run)
         with self._lock:
             self._programs[key] = program
+            from .common import PROGRAM_EVICTED, program_cache_cap
+
+            cap = program_cache_cap()
+            while cap and len(self._programs) > cap:
+                self._programs.popitem(last=False)
+                PROGRAM_EVICTED.inc(kind="program")
         return program
 
     def run(self, prompt="", **kwargs):

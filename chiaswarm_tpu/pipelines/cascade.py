@@ -27,6 +27,7 @@ import math
 import threading
 import time
 import zlib
+from collections import OrderedDict
 
 import jax
 import jax.numpy as jnp
@@ -260,7 +261,9 @@ class CascadePriorPipeline:
         self.params = jax.device_put(
             jax.tree_util.tree_map(cast, tree), replicated(self.mesh)
         )
-        self._programs: dict[tuple, callable] = {}
+        # insertion-ordered so the program_cache_max bound below can evict
+        # least-recently-used first (SW007; same knob as the SD family)
+        self._programs: OrderedDict = OrderedDict()
         self._lock = threading.Lock()
 
     def release(self):
@@ -270,6 +273,7 @@ class CascadePriorPipeline:
     def _program(self, key: tuple):
         with self._lock:
             if key in self._programs:
+                self._programs.move_to_end(key)
                 return self._programs[key]
         ch, cw, batch, steps = key
         scheduler = get_scheduler("DDPMWuerstchenScheduler")
@@ -317,6 +321,12 @@ class CascadePriorPipeline:
         program = jax.jit(run)
         with self._lock:
             self._programs[key] = program
+            from .common import PROGRAM_EVICTED, program_cache_cap
+
+            cap = program_cache_cap()
+            while cap and len(self._programs) > cap:
+                self._programs.popitem(last=False)
+                PROGRAM_EVICTED.inc(kind="program")
         return program
 
     def generate(self, prompt: str, negative_prompt: str = "",
@@ -482,7 +492,9 @@ class CascadePipeline:
         self.params = jax.device_put(
             jax.tree_util.tree_map(cast, tree), replicated(self.mesh)
         )
-        self._programs: dict[tuple, callable] = {}
+        # insertion-ordered so the program_cache_max bound below can evict
+        # least-recently-used first (SW007; same knob as the SD family)
+        self._programs: OrderedDict = OrderedDict()
         self._lock = threading.Lock()
 
     def release(self):
@@ -492,6 +504,7 @@ class CascadePipeline:
     def _program(self, key: tuple):
         with self._lock:
             if key in self._programs:
+                self._programs.move_to_end(key)
                 return self._programs[key]
         lh, lw, batch, steps, eh, ew = key
         scheduler = get_scheduler("DDPMWuerstchenScheduler")
@@ -542,6 +555,12 @@ class CascadePipeline:
         program = jax.jit(run)
         with self._lock:
             self._programs[key] = program
+            from .common import PROGRAM_EVICTED, program_cache_cap
+
+            cap = program_cache_cap()
+            while cap and len(self._programs) > cap:
+                self._programs.popitem(last=False)
+                PROGRAM_EVICTED.inc(kind="program")
         return program
 
     def run(self, prompt="", negative_prompt="",

@@ -14,6 +14,32 @@ from __future__ import annotations
 
 import numpy as np
 
+from .. import telemetry
+
+# same metric the SD family's _trim_program_caches feeds — telemetry
+# dedups by name, so whichever pipeline module imports first registers it
+PROGRAM_EVICTED = telemetry.counter(
+    "swarm_program_cache_evicted_total",
+    "Compiled denoise programs / assembled runners evicted LRU at the "
+    "program_cache_max bound, by kind",
+    ("kind",),
+)
+
+
+def program_cache_cap() -> int:
+    """Settings.program_cache_max at call time (env-overridable,
+    CHIASWARM_PROGRAM_CACHE_MAX); 0 = unbounded. The dormant pipelines'
+    `_programs` caches bound themselves with this (SW007 shrink,
+    ISSUE 18) — the SD family keeps its own richer trim that also frees
+    evicted executables (_trim_program_caches)."""
+    try:
+        from ..settings import load_settings
+
+        return max(int(getattr(
+            load_settings(), "program_cache_max", 64) or 0), 0)
+    except Exception:  # settings must never gate a compile
+        return 64
+
 
 def pad_bucket(rows: int) -> int:
     """Next power-of-two row count >= rows.

@@ -25,6 +25,7 @@ import logging
 import threading
 import time
 import zlib
+from collections import OrderedDict
 
 import jax
 import jax.numpy as jnp
@@ -215,7 +216,9 @@ class DeepFloydIFPipeline:
             }),
             replicated(self.mesh),
         )
-        self._programs: dict[tuple, callable] = {}
+        # insertion-ordered so the program_cache_max bound below can evict
+        # least-recently-used first (SW007; same knob as the SD family)
+        self._programs: OrderedDict = OrderedDict()
         self._lock = threading.Lock()
 
     def release(self):
@@ -227,6 +230,7 @@ class DeepFloydIFPipeline:
         denoise. Pixel space end to end; nothing leaves the device."""
         with self._lock:
             if key in self._programs:
+                self._programs.move_to_end(key)
                 return self._programs[key]
         size, batch, steps, sr_steps = key
         scheduler = get_scheduler("DDPMScheduler")
@@ -315,6 +319,12 @@ class DeepFloydIFPipeline:
         program = jax.jit(run)
         with self._lock:
             self._programs[key] = program
+            from .common import PROGRAM_EVICTED, program_cache_cap
+
+            cap = program_cache_cap()
+            while cap and len(self._programs) > cap:
+                self._programs.popitem(last=False)
+                PROGRAM_EVICTED.inc(kind="program")
         return program
 
     def run(self, prompt="", negative_prompt="", pipeline_type="IFPipeline",

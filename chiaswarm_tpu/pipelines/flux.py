@@ -18,6 +18,7 @@ import logging
 import threading
 import time
 import zlib
+from collections import OrderedDict
 from pathlib import Path
 
 import jax
@@ -147,7 +148,9 @@ class FluxPipeline:
                     time.perf_counter() - t0, dtype)
 
         self._jit_lock = threading.Lock()
-        self._programs: dict[tuple, callable] = {}
+        # insertion-ordered so the program_cache_max bound below can evict
+        # least-recently-used first (SW007; same knob as the SD family)
+        self._programs: OrderedDict = OrderedDict()
         self._encode_program = jax.jit(self._encode_impl)
 
     def _model_dir(self) -> Path | None:
@@ -281,6 +284,7 @@ class FluxPipeline:
     def _program(self, key: tuple):
         with self._jit_lock:
             if key in self._programs:
+                self._programs.move_to_end(key)
                 return self._programs[key]
         lh, lw, batch, steps, txt_len = key
         shift = _sigma_shift((lh // 2) * (lw // 2), self.dynamic_shift)
@@ -330,6 +334,12 @@ class FluxPipeline:
         program = jax.jit(run)
         with self._jit_lock:
             self._programs[key] = program
+            from .common import PROGRAM_EVICTED, program_cache_cap
+
+            cap = program_cache_cap()
+            while cap and len(self._programs) > cap:
+                self._programs.popitem(last=False)
+                PROGRAM_EVICTED.inc(kind="program")
         return program
 
     # --- weight-streaming sampler (host-RAM paged transformer blocks) ---

@@ -24,6 +24,7 @@ import logging
 import threading
 import time
 import zlib
+from collections import OrderedDict
 
 import jax
 import jax.numpy as jnp
@@ -284,7 +285,9 @@ class KandinskyPriorPipeline:
             ),
             replicated(self.mesh),
         )
-        self._programs: dict[tuple, callable] = {}
+        # insertion-ordered so the program_cache_max bound below can evict
+        # least-recently-used first (SW007; same knob as the SD family)
+        self._programs: OrderedDict = OrderedDict()
         self._lock = threading.Lock()
 
     def release(self):
@@ -303,6 +306,7 @@ class KandinskyPriorPipeline:
         key = (steps, guided)
         with self._lock:
             if key in self._programs:
+                self._programs.move_to_end(key)
                 return self._programs[key]
         scheduler = get_scheduler("DDPMScheduler", prediction_type="sample")
         schedule = scheduler.schedule(steps)
@@ -355,6 +359,12 @@ class KandinskyPriorPipeline:
         program = jax.jit(run)
         with self._lock:
             self._programs[key] = program
+            from .common import PROGRAM_EVICTED, program_cache_cap
+
+            cap = program_cache_cap()
+            while cap and len(self._programs) > cap:
+                self._programs.popitem(last=False)
+                PROGRAM_EVICTED.inc(kind="program")
         return program
 
     def generate(self, prompt: str, negative_prompt: str = "",
@@ -523,7 +533,9 @@ class KandinskyPipeline:
         self.params = jax.device_put(
             jax.tree_util.tree_map(cast, tree), replicated(self.mesh)
         )
-        self._programs: dict[tuple, callable] = {}
+        # insertion-ordered so the program_cache_max bound below can evict
+        # least-recently-used first (SW007; same knob as the SD family)
+        self._programs: OrderedDict = OrderedDict()
         self._lock = threading.Lock()
 
     @staticmethod
@@ -603,6 +615,7 @@ class KandinskyPipeline:
     def _program(self, key: tuple):
         with self._lock:
             if key in self._programs:
+                self._programs.move_to_end(key)
                 return self._programs[key]
         mode, lh, lw, batch, steps, sched_name, t_start = key
         scheduler = get_scheduler(sched_name)
@@ -686,6 +699,12 @@ class KandinskyPipeline:
         program = jax.jit(run)
         with self._lock:
             self._programs[key] = program
+            from .common import PROGRAM_EVICTED, program_cache_cap
+
+            cap = program_cache_cap()
+            while cap and len(self._programs) > cap:
+                self._programs.popitem(last=False)
+                PROGRAM_EVICTED.inc(kind="program")
         return program
 
     def run(self, prompt="", negative_prompt="",

@@ -23,6 +23,7 @@ import logging
 import threading
 import time
 import zlib
+from collections import OrderedDict
 
 import jax
 import jax.numpy as jnp
@@ -187,7 +188,9 @@ class Kandinsky3Pipeline:
         self.params = jax.device_put(
             jax.tree_util.tree_map(cast, params), replicated(self.mesh)
         )
-        self._programs: dict[tuple, callable] = {}
+        # insertion-ordered so the program_cache_max bound below can evict
+        # least-recently-used first (SW007; same knob as the SD family)
+        self._programs: OrderedDict = OrderedDict()
         self._lock = threading.Lock()
 
     def _random_params(self, unet_cfg, t5_cfg):
@@ -221,6 +224,7 @@ class Kandinsky3Pipeline:
     def _program(self, key: tuple):
         with self._lock:
             if key in self._programs:
+                self._programs.move_to_end(key)
                 return self._programs[key]
         mode, lh, lw, batch, steps, sched_name, t_start = key
         scheduler = get_scheduler(sched_name)
@@ -284,6 +288,12 @@ class Kandinsky3Pipeline:
         program = jax.jit(run)
         with self._lock:
             self._programs[key] = program
+            from .common import PROGRAM_EVICTED, program_cache_cap
+
+            cap = program_cache_cap()
+            while cap and len(self._programs) > cap:
+                self._programs.popitem(last=False)
+                PROGRAM_EVICTED.inc(kind="program")
         return program
 
     def run(self, prompt="", negative_prompt="",

@@ -296,6 +296,11 @@ def _to_pil(batch: np.ndarray) -> list[Image.Image]:
 class SDPipeline:
     """One model family resident on one ChipSet; serves all SD wire names."""
 
+    # the chunked runner's boundary doubles as a checkpoint/resume seam
+    # (ISSUE 18); workflows gate the checkpoint kwargs on this attribute
+    # the same way geometry kwargs gate on resolve_geometry
+    supports_checkpoint = True
+
     def __init__(self, model_name: str, chipset=None, dtype=None,
                  allow_random_init: bool = False):
         self.model_name = model_name
@@ -1604,12 +1609,17 @@ class SDPipeline:
 
     def _chunk_programs(self, key, controlnet_module, geo, mesh, chunk,
                         lora_sig=None, analytic_flops=None):
-        """(prep, {length: chunk}, decode, lengths, lo) — the compiled
-        program set for one bucket under one geometry, plus the chunk
-        walk it serves. Shared by the chunked runner and the mid-pass
-        re-shard path (which resolves the TARGET geometry's set lazily
-        at the first seam that needs it; the walk is bucket-derived, so
-        both geometries share it). Adapter passes (lora_sig) suffix only
+        """(prep, chunk_for, decode, lengths, lo) — the compiled program
+        set for one bucket under one geometry, plus the chunk walk it
+        serves. ``chunk_for(n)`` resolves the compiled length-n step
+        chunk — the walk's lengths are resolved eagerly here (so the
+        caller's compile span stays honest), while a length the original
+        walk never needed (a mid-pass RESUME's remainder chunk, ISSUE 18)
+        compiles on first request under the same cache key scheme.
+        Shared by the chunked runner and the mid-pass re-shard path
+        (which resolves the TARGET geometry's set lazily at the first
+        seam that needs it; the walk is bucket-derived, so both
+        geometries share it). Adapter passes (lora_sig) suffix only
         the STEP chunks: prep and decode never see the lora operand, so
         adapter and plain passes share those compiled programs."""
         prep_fn, make_steps, decode_fn, (lo, hi) = self._denoise_parts(
@@ -1627,16 +1637,18 @@ class SDPipeline:
         # chunk owns its proportional share of the (hi - lo) steps
         per_step = (analytic_flops / (hi - lo)
                     if analytic_flops and hi > lo else None)
-        chunk_progs = {
-            n: self._program((skey, "chunk", n), lambda n=n: make_steps(n),
-                             kind="chunk",
-                             analytic_flops=(per_step * n if per_step
-                                             else None))
-            for n in set(lengths)
-        }
+
+        def chunk_for(n: int):
+            n = int(n)
+            return self._program(
+                (skey, "chunk", n), lambda: make_steps(n), kind="chunk",
+                analytic_flops=(per_step * n if per_step else None))
+
+        for n in set(lengths):
+            chunk_for(n)
         decode_prog = self._program((gkey, "decode"), lambda: decode_fn,
                                     kind="decode")
-        return prep_prog, chunk_progs, decode_prog, lengths, lo
+        return prep_prog, chunk_for, decode_prog, lengths, lo
 
     def _migrate_operands(self, mesh, operands: tuple) -> tuple:
         """Re-place a chunked pass's live operands onto another mesh view
@@ -1654,6 +1666,48 @@ class SDPipeline:
         # tree_map traverses dicts (added, cn_params), skips Nones, and
         # applies directly to bare arrays (latents, context, rng keys)
         return tuple(jax.tree_util.tree_map(place, op) for op in operands)
+
+    def _rehydrate(self, resume, latents, state, mesh, lo, hi):
+        """Swap a freshly-prepped (latents, scheduler state) for a
+        checkpoint's arrays (ISSUE 18 resume-on-redelivery): prep
+        supplies the pytree STRUCTURE and the placement recipe, the
+        checkpoint supplies values, so the resumed chunk programs see
+        operands indistinguishable from an undisturbed pass at step K.
+        Validates the step against this bucket's denoise span and every
+        array against its prepped twin — any mismatch raises and the
+        caller degrades to the full pass."""
+        at = int(resume.get("step", lo))
+        if not (lo < at < hi):
+            raise ValueError(
+                f"resume step {at} outside the denoise span [{lo}, {hi})")
+        ck_latents = np.asarray(resume["latents"])
+        if (tuple(ck_latents.shape) != tuple(latents.shape)
+                or ck_latents.dtype != np.dtype(latents.dtype)):
+            raise ValueError(
+                f"checkpoint latents {ck_latents.dtype}{ck_latents.shape} "
+                f"do not match this bucket's "
+                f"{np.dtype(latents.dtype)}{tuple(latents.shape)}")
+        leaves, treedef = jax.tree_util.tree_flatten(state)
+        ck_leaves = list(resume.get("state_leaves") or [])
+        if len(ck_leaves) != len(leaves):
+            raise ValueError(
+                f"checkpoint carries {len(ck_leaves)} scheduler leaves, "
+                f"this program has {len(leaves)}")
+
+        def place(x):
+            if getattr(x, "ndim", 0) == 0:
+                return jax.device_put(jnp.asarray(x), replicated(mesh))
+            return self._place_batch(jnp.asarray(x), mesh=mesh)
+
+        placed = []
+        for fresh, ck in zip(leaves, ck_leaves):
+            ck = np.asarray(ck)
+            if (tuple(ck.shape) != tuple(getattr(fresh, "shape", ()))
+                    or ck.dtype != np.dtype(fresh.dtype)):
+                raise ValueError("checkpoint scheduler leaf mismatch")
+            placed.append(place(ck))
+        return (at, place(ck_latents),
+                jax.tree_util.tree_unflatten(treedef, placed))
 
     def _denoise_runner(self, key, controlnet_module=None, geo=None,
                         lora_sig=None, analytic_flops=None):
@@ -1696,8 +1750,12 @@ class SDPipeline:
                 key, controlnet_module, geo=geo, mesh=mesh,
                 lora_sig=lora_sig, analytic_flops=analytic_flops)
 
-            def runner(*args, cancel_probe=None, reshard_probe=None):
-                # no chunk seams: a fused pass cannot re-shard mid-flight
+            def runner(*args, cancel_probe=None, reshard_probe=None,
+                       boundary_cb=None, resume=None):
+                # no chunk seams: a fused pass cannot re-shard, cannot
+                # checkpoint, and cannot resume mid-flight — boundary_cb
+                # and resume are accepted (and ignored) so the caller
+                # need not care which strategy resolved
                 if cancel_probe is not None:
                     cancel_probe()
                 return program(*args)
@@ -1710,7 +1768,8 @@ class SDPipeline:
             def runner(params, init_rng, context, added, guidance_scale,
                        image_guidance, image_latents, mask, rng,
                        cn_params, control_cond, cn_scale, lora,
-                       cancel_probe=None, reshard_probe=None):
+                       cancel_probe=None, reshard_probe=None,
+                       boundary_cb=None, resume=None):
                 # Each boundary BLOCKS on the previous chunk before
                 # probing. This sync is load-bearing, not optional: jax
                 # dispatches asynchronously, so without it the host
@@ -1731,9 +1790,35 @@ class SDPipeline:
                     cancel_probe()
                 latents, state = prep_prog(params, init_rng, image_latents)
                 at = lo
-                for n in lengths:
-                    if at != lo and (cancel_probe is not None
-                                     or reshard_probe is not None):
+                hi = lo + sum(lengths)
+                walk = lengths
+                if resume is not None:
+                    # rehydrate at the checkpointed step: prep already
+                    # produced the right state STRUCTURE and sharding,
+                    # so the checkpointed leaves just replace the fresh
+                    # ones. Any mismatch (shape drift, torn blob)
+                    # degrades to the full pass — resume is an
+                    # optimization, never a gate
+                    try:
+                        at, latents, state = self._rehydrate(
+                            resume, latents, state, cur_mesh, lo, hi)
+                    except Exception:
+                        logger.warning(
+                            "checkpoint rehydration failed; running the "
+                            "full pass", exc_info=True)
+                        at = lo
+                    if at != lo:
+                        walk = []
+                        pos = at
+                        while pos < hi:
+                            walk.append(min(chunk, hi - pos))
+                            pos += walk[-1]
+                self._last_resume_step = at if at != lo else None
+                start_at = at
+                for n in walk:
+                    if at != start_at and (cancel_probe is not None
+                                           or reshard_probe is not None
+                                           or boundary_cb is not None):
                         jax.block_until_ready(latents)
                         if cancel_probe is not None:
                             cancel_probe()
@@ -1776,8 +1861,20 @@ class SDPipeline:
                                 resharded.append(
                                     (cur_geo, target, at, compile_s))
                                 cur_geo = target
+                        if boundary_cb is not None:
+                            # durability/preview seam (ISSUE 18): hand
+                            # the host the live latents + scheduler
+                            # state, plus a lazy decode bound to the
+                            # CURRENT geometry's program — the callback
+                            # decides whether this boundary is due
+                            def _decode(latents=latents, params=params,
+                                        dec=cur_decode, m=cur_mesh):
+                                with sequence_parallel_scope(m):
+                                    return dec(params, latents)
+
+                            boundary_cb(at, latents, state, _decode)
                     with sequence_parallel_scope(cur_mesh):
-                        latents, state = cur_chunks[n](
+                        latents, state = cur_chunks(n)(
                             params, latents, state, context, added,
                             guidance_scale, image_guidance, image_latents,
                             mask, rng, cn_params, control_cond, cn_scale,
@@ -1832,6 +1929,14 @@ class SDPipeline:
         geometry to migrate the live pass to (the chunk-seam re-shard)."""
         geometry = kwargs.pop("geometry", None)
         reshard_probe = kwargs.pop("reshard_probe", None)
+        # preemption-tolerant denoise (ISSUE 18): the worker arms the
+        # chunk boundary with these. All default to off/None, so direct
+        # pipeline calls and the classic fused path stay byte-identical.
+        ckpt_every = int(kwargs.pop("checkpoint_every_chunks", 0) or 0)
+        preview_every = int(kwargs.pop("preview_every_chunks", 0) or 0)
+        checkpoint_cb = kwargs.pop("checkpoint_cb", None)
+        preview_cb = kwargs.pop("preview_cb", None)
+        resume_offer = kwargs.pop("resume", None)
         if (
             kwargs.get("controlnet_prepipeline_type")
             and kwargs.get("controlnet_model_name")
@@ -2155,6 +2260,47 @@ class SDPipeline:
                 key, controlnet_module, geo=geo, lora_sig=lora_sig,
                 analytic_flops=pass_flops_raw)
 
+        # --- preemption-tolerant denoise (ISSUE 18): the program
+        # signature pins which compiled-program family a checkpoint is
+        # valid for — a resume offer cut under a different (model, bucket,
+        # dtype, geometry) would feed latents to a program with a
+        # different meaning of "step K", so it degrades to a full pass,
+        # never an error. boundary_cb turns the chunk seam into the
+        # durability/preview seam at the knobbed cadence. ---
+        boundary_cb = None
+        resume_state = None
+        chunk_steps = self._denoise_chunk_steps()
+        arm_ckpt = checkpoint_cb is not None and ckpt_every > 0
+        arm_preview = preview_cb is not None and preview_every > 0
+        if chunk_steps > 0 and (resume_offer is not None
+                                or arm_ckpt or arm_preview):
+            from .. import checkpoint as _ckpt
+
+            pass_signature = _ckpt.program_signature(
+                self.model_name, key, self.dtype, geo)
+            if resume_offer is not None:
+                if str(resume_offer.get("signature", "")) == pass_signature:
+                    resume_state = resume_offer
+                else:
+                    logger.warning(
+                        "resume offer signature %s does not match this "
+                        "pass's %s; running the full pass",
+                        resume_offer.get("signature"), pass_signature)
+            if arm_ckpt or arm_preview:
+                boundaries = {"n": 0}
+
+                def boundary_cb(step, latents, state, decode,
+                                _sig=pass_signature):
+                    boundaries["n"] += 1
+                    k = boundaries["n"]
+                    if arm_ckpt and k % ckpt_every == 0:
+                        leaves = jax.tree_util.tree_leaves(state)
+                        checkpoint_cb(
+                            int(step), np.asarray(latents),
+                            [np.asarray(x) for x in leaves], _sig)
+                    if arm_preview and k % preview_every == 0:
+                        preview_cb(int(step), np.asarray(decode()))
+
         # long-sequence self-attention shards over the mesh seq axis (ring
         # attention) when this pass's mesh view carved one out; trace-time
         # routing, so it binds on the first (tracing) call of each bucket
@@ -2170,6 +2316,7 @@ class SDPipeline:
                 and job_params is not geo_params):
             reshard_probe = None
         self._last_reshards = []
+        self._last_resume_step = None
         with Span("denoise", timings, key="denoise_decode_s"):
             with sequence_parallel_scope(pass_mesh):
                 pixels = runner(
@@ -2194,6 +2341,9 @@ class SDPipeline:
                     cancel_probe=self._solo_cancel_probe(),
                     # the chunk boundary doubles as the re-shard seam
                     reshard_probe=reshard_probe,
+                    # ... and the durability/preview seam (ISSUE 18)
+                    boundary_cb=boundary_cb,
+                    resume=resume_state,
                 )
             pixels = jax.block_until_ready(pixels)
         # a mid-pass re-shard that had to COMPILE its target program set
@@ -2286,12 +2436,27 @@ class SDPipeline:
             images = out
             timings["upscale_s"] = round(time.perf_counter() - t0, 3)
 
+        # resumed passes (ISSUE 18) recomputed only steps >= from_step;
+        # the cost stamp (and so the tenant ledger) bills that fraction,
+        # not the full pass the FIRST delivery already burned
+        resumed_info = None
+        resume_at = getattr(self, "_last_resume_step", None)
+        if resume_at is not None:
+            resumed_info = {
+                "from_step": int(resume_at),
+                "recomputed_steps": int(steps - resume_at),
+            }
+        billed_flops = pass_flops_raw
+        if resumed_info is not None and steps > t_start:
+            billed_flops = int(round(
+                pass_flops_raw * resumed_info["recomputed_steps"]
+                / (steps - t_start)))
         # per-pass cost figures (ISSUE 17): a solo pass IS its own job,
         # so the job's flops equal the pass flops
         cost = costs.job_cost(
             costs.pass_cost(
                 model=self.model_name,
-                pass_flops=pass_flops_raw,
+                pass_flops=billed_flops,
                 denoise_s=timings.get("denoise_decode_s"),
                 chips=(self.chipset.chip_count() if self.chipset is not None
                        else 1),
@@ -2299,7 +2464,7 @@ class SDPipeline:
                 geometry=geometry_label(pass_geometry["tensor"],
                                         pass_geometry["seq"]),
             ),
-            pass_flops_raw,
+            billed_flops,
         )
 
         pipeline_config = {
@@ -2366,6 +2531,9 @@ class SDPipeline:
                  "compile_s": round(c, 3)}
                 for f, t, s, c in self._last_reshards]}
                if getattr(self, "_last_reshards", None) else {}),
+            # resume-on-redelivery (ISSUE 18): this pass rehydrated a
+            # checkpoint at from_step and recomputed only the remainder
+            **({"resumed": resumed_info} if resumed_info else {}),
             "timings": timings,
         }
         return images, pipeline_config

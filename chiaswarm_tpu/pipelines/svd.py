@@ -27,6 +27,7 @@ import logging
 import threading
 import time
 import zlib
+from collections import OrderedDict
 
 import jax
 import jax.numpy as jnp
@@ -177,7 +178,9 @@ class SVDPipeline:
         self.params = jax.device_put(
             jax.tree_util.tree_map(cast, tree), replicated(self.mesh)
         )
-        self._programs: dict[tuple, callable] = {}
+        # insertion-ordered so the program_cache_max bound below can evict
+        # least-recently-used first (SW007; same knob as the SD family)
+        self._programs: OrderedDict = OrderedDict()
         self._lock = threading.Lock()
 
     def release(self):
@@ -187,6 +190,7 @@ class SVDPipeline:
     def _program(self, key: tuple):
         with self._lock:
             if key in self._programs:
+                self._programs.move_to_end(key)
                 return self._programs[key]
         lh, lw, frames, steps = key
         sigmas = np.concatenate(
@@ -268,6 +272,12 @@ class SVDPipeline:
         program = jax.jit(run)
         with self._lock:
             self._programs[key] = program
+            from .common import PROGRAM_EVICTED, program_cache_cap
+
+            cap = program_cache_cap()
+            while cap and len(self._programs) > cap:
+                self._programs.popitem(last=False)
+                PROGRAM_EVICTED.inc(kind="program")
         return program
 
     def _image_embed(self, params, image: Image.Image):

@@ -26,6 +26,7 @@ import logging
 import threading
 import time
 import zlib
+from collections import OrderedDict
 
 import jax
 import jax.numpy as jnp
@@ -227,7 +228,9 @@ class LatentUpscalePipeline:
         self.params = jax.device_put(
             jax.tree_util.tree_map(cast, params), replicated(self.mesh)
         )
-        self._programs: dict[tuple, callable] = {}
+        # insertion-ordered so the program_cache_max bound below can evict
+        # least-recently-used first (SW007; same knob as the SD family)
+        self._programs: OrderedDict = OrderedDict()
         self._lock = threading.Lock()
 
     def _random_params(self, unet_cfg, clip_cfg, vae_cfg):
@@ -275,6 +278,7 @@ class LatentUpscalePipeline:
     def _program(self, key: tuple):
         with self._lock:
             if key in self._programs:
+                self._programs.move_to_end(key)
                 return self._programs[key]
         lh, lw, batch, steps = key  # INPUT latent dims; output is 2x
         scheduler = self._scheduler()
@@ -359,6 +363,12 @@ class LatentUpscalePipeline:
         program = jax.jit(run)
         with self._lock:
             self._programs[key] = program
+            from .common import PROGRAM_EVICTED, program_cache_cap
+
+            cap = program_cache_cap()
+            while cap and len(self._programs) > cap:
+                self._programs.popitem(last=False)
+                PROGRAM_EVICTED.inc(kind="program")
         return program
 
     def upscale(self, images: list[Image.Image], prompt: str = "",

@@ -17,6 +17,7 @@ import logging
 import os
 import time
 import zlib
+from collections import OrderedDict
 
 import jax
 import jax.numpy as jnp
@@ -225,7 +226,9 @@ class VideoPipeline:
             "%s video pipeline resident in %.1fs", model_name,
             time.perf_counter() - t0,
         )
-        self._programs = {}
+        # insertion-ordered so the program_cache_max bound below can evict
+        # least-recently-used first (SW007; same knob as the SD family)
+        self._programs: OrderedDict = OrderedDict()
         # param trees with motion-LoRAs merged, keyed by (ref, scale);
         # bounded — each entry pins a full UNet copy
         from collections import OrderedDict
@@ -374,6 +377,7 @@ class VideoPipeline:
 
     def _program(self, key):
         if key in self._programs:
+            self._programs.move_to_end(key)
             return self._programs[key]
         lh, lw, frames, steps, sched_name = key
         scheduler = get_scheduler(sched_name)
@@ -421,6 +425,12 @@ class VideoPipeline:
 
         program = jax.jit(run)
         self._programs[key] = program
+        from .common import PROGRAM_EVICTED, program_cache_cap
+
+        cap = program_cache_cap()
+        while cap and len(self._programs) > cap:
+            self._programs.popitem(last=False)
+            PROGRAM_EVICTED.inc(kind="program")
         return program
 
     def run(self, prompt="", negative_prompt="", image=None, **kwargs):

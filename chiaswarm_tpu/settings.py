@@ -123,6 +123,21 @@ class Settings:
     # default) keeps the single-pass compiled denoise at zero cost;
     # chunked and single-pass outputs are bitwise identical (pinned)
     denoise_chunk_steps: int = 0
+    # --- preemption-tolerant denoise (ISSUE 18, checkpoint.py) ---
+    # ship a durable mid-pass checkpoint (latents + scheduler state +
+    # step index) to the hive every N chunk boundaries of a chunked
+    # denoise, so a redelivered job resumes at step K instead of
+    # recomputing the whole pass. Requires denoise_chunk_steps > 0.
+    # 0 (the default) disables: the classic path stays byte-identical
+    checkpoint_every_chunks: int = 0
+    # largest checkpoint blob the worker will ship (bytes); a bigger
+    # pack is skipped (counted), never truncated — losing a checkpoint
+    # only costs recompute on redelivery
+    checkpoint_max_bytes: int = 8388608
+    # VAE-decode the intermediate latents every N chunk boundaries into
+    # a progressive-preview artifact (spooled hive-side, surfaced as the
+    # `partial` disposition on GET /api/jobs/{id}); 0 disables
+    preview_every_chunks: int = 0
     # --- priority-aware multi-chip sharding (ISSUE 12) ---
     # run INTERACTIVE solo jobs as ONE sharded program over every chip of
     # their slice (attention heads + MLP inner dims on the mesh's tensor
@@ -259,6 +274,12 @@ class Settings:
     # exceeds this multiple of the live peer median (plus an absolute
     # floor — fleet.py MIN_DELTA_S)
     hive_straggler_factor: float = 2.5
+    # hive side: a worker whose leases expire this many CONSECUTIVE
+    # times (no settle in between) stops receiving fresh seeds while a
+    # healthy capable alternative is live — bounded by the affinity-hold
+    # window exactly like straggler_hold, so a flapping worker is
+    # preferred-against, never starved. 0 disables flap detection
+    hive_flap_threshold: int = 3
     # --- hive replication & failover (hive_server/replication.py) ---
     # worker side: comma-separated hive site URIs in preference order
     # (primary first, standby after); the HiveClient pins to one and
@@ -345,6 +366,9 @@ _ENV_OVERRIDES = {
     "CHIASWARM_LORA_RANK_MAX": "lora_rank_max",
     "CHIASWARM_PROGRAM_CACHE_MAX": "program_cache_max",
     "CHIASWARM_DENOISE_CHUNK_STEPS": "denoise_chunk_steps",
+    "CHIASWARM_CHECKPOINT_EVERY_CHUNKS": "checkpoint_every_chunks",
+    "CHIASWARM_CHECKPOINT_MAX_BYTES": "checkpoint_max_bytes",
+    "CHIASWARM_PREVIEW_EVERY_CHUNKS": "preview_every_chunks",
     "CHIASWARM_SHARD_INTERACTIVE": "shard_interactive",
     "CHIASWARM_SHARD_TENSOR": "shard_tensor",
     "CHIASWARM_SHARD_SEQ": "shard_seq",
@@ -363,6 +387,7 @@ _ENV_OVERRIDES = {
     "CHIASWARM_HIVE_TENANT_TOPK": "hive_tenant_topk",
     "CHIASWARM_HIVE_STATS_EWMA_ALPHA": "hive_stats_ewma_alpha",
     "CHIASWARM_HIVE_STRAGGLER_FACTOR": "hive_straggler_factor",
+    "CHIASWARM_HIVE_FLAP_THRESHOLD": "hive_flap_threshold",
     "CHIASWARM_HIVE_URIS": "sdaas_uris",
     "CHIASWARM_HIVE_STANDBY_OF": "hive_standby_of",
     "CHIASWARM_HIVE_REPLICATION_POLL_S": "hive_replication_poll_s",

@@ -44,6 +44,7 @@ Differences by design:
 from __future__ import annotations
 
 import asyncio
+import base64
 import json
 import logging
 import os
@@ -146,6 +147,26 @@ _SLICE_STATE = telemetry.gauge(
     "swarm_slice_state",
     "Chip slices by lifecycle state (active | quarantined)",
     ("state",),
+)
+_CHECKPOINTS = telemetry.counter(
+    "swarm_checkpoints_total",
+    "Mid-pass checkpoints cut at denoise chunk boundaries, by outcome "
+    "(shipped = the hive stored it; oversize = bigger than "
+    "checkpoint_max_bytes, skipped; error = pack or upload failed)",
+    ("outcome",),
+)
+_PREVIEWS = telemetry.counter(
+    "swarm_previews_total",
+    "Progressive preview frames decoded at denoise chunk boundaries, "
+    "by outcome (shipped | error)",
+    ("outcome",),
+)
+_RESUMES = telemetry.counter(
+    "swarm_resume_total",
+    "Redelivered jobs that arrived with a resume offer, by outcome "
+    "(resumed = checkpoint fetched+unpacked and handed to the pipeline; "
+    "fetch_failed | unpack_failed degrade to a full pass)",
+    ("outcome",),
 )
 _JOBS_CANCELLED = telemetry.counter(
     "swarm_jobs_cancelled_total",
@@ -609,6 +630,14 @@ class Worker:
         # conservative: gangs under-fill rather than oversubscribe, and
         # put_gang re-chunks anything that still doesn't fit
         caps["gang_rows"] = max(self.batcher.max_coalesce, 1)
+        # preemption tolerance (ISSUE 18): a chunked, checkpoint-armed
+        # worker can rehydrate a redelivered job from a hive-held
+        # checkpoint; the hive attaches `resume` offers only to workers
+        # advertising this (legacy hives ignore the key)
+        caps["resume_capable"] = int(
+            int(getattr(self.settings, "denoise_chunk_steps", 0) or 0) > 0
+            and int(getattr(
+                self.settings, "checkpoint_every_chunks", 0) or 0) > 0)
         caps["jobs_completed"] = int(_JOBS_COMPLETED.total())
         if self._last_poll_monotonic is not None:
             caps["last_poll_age_s"] = round(
@@ -789,6 +818,7 @@ class Worker:
             pass_started = picked_up
             queue_wait = {}
             traces = {}
+            resume_offers = {}
             batch_ids = [str(job["id"]) for job in batch if "id" in job]
             self._executing_ids.update(batch_ids)
             # a job-level deadline (`deadline_s`, the hive TTL's per-job
@@ -815,6 +845,12 @@ class Worker:
                 trace = job.pop("trace", None)
                 if isinstance(trace, dict) and "id" in job:
                     traces[job["id"]] = trace
+                # a redelivery's resume offer (ISSUE 18) comes off the
+                # job the same way — it is dispatch metadata, not a
+                # pipeline argument; the solo path rehydrates from it
+                offer = job.pop("resume", None)
+                if isinstance(offer, dict) and "id" in job:
+                    resume_offers[str(job["id"])] = offer
             self._update_queue_gauges()
             try:
                 prepared = []
@@ -858,6 +894,13 @@ class Worker:
                         self._apply_shard_geometry(
                             jobs_by_id.get(str(kwargs.get("id"))),
                             worker_function, kwargs, chipset)
+                        # mid-pass durability (ISSUE 18): arm the solo
+                        # pass with checkpoint/preview callbacks and,
+                        # for a redelivery carrying an offer, the
+                        # rehydrated resume state
+                        await self._apply_checkpointing(
+                            worker_function, kwargs,
+                            resume_offers.get(str(kwargs.get("id"))))
                         result = await self.do_work(
                             chipset, worker_function, kwargs, solo_cap
                         )
@@ -975,6 +1018,161 @@ class Worker:
             return None
 
         return probe
+
+    # --- preemption-tolerant denoise (ISSUE 18) ---
+
+    async def _apply_checkpointing(self, worker_function, kwargs,
+                                   offer: dict | None) -> None:
+        """Arm one solo diffusion pass with the mid-pass durability seam:
+        checkpoint/preview callbacks cut at the knobbed chunk cadence,
+        plus — for a redelivery that arrived with a `resume` offer — the
+        checkpointed state rehydrated from the hive's spool. Only the
+        SD-family callback understands the keys (workflows gate them on
+        `supports_checkpoint`); coalesced passes never checkpoint by
+        design — a batch member's padded row is not a job's worth of
+        resumable state."""
+        from .workflows.diffusion import diffusion_callback
+
+        if worker_function is not diffusion_callback:
+            return
+        s = self.settings
+        if int(getattr(s, "denoise_chunk_steps", 0) or 0) <= 0:
+            return  # fused pass: no boundaries to checkpoint at
+        job_id = str(kwargs.get("id"))
+        if isinstance(offer, dict) and offer.get("href"):
+            state = await self._fetch_resume_state(job_id, offer)
+            if state is not None:
+                kwargs["resume"] = state
+        loop = asyncio.get_running_loop()
+        ckpt_every = int(getattr(s, "checkpoint_every_chunks", 0) or 0)
+        if ckpt_every > 0:
+            kwargs["checkpoint_every_chunks"] = ckpt_every
+            kwargs["checkpoint_cb"] = self._checkpoint_shipper(job_id, loop)
+        preview_every = int(getattr(s, "preview_every_chunks", 0) or 0)
+        if preview_every > 0:
+            kwargs["preview_every_chunks"] = preview_every
+            kwargs["preview_cb"] = self._preview_shipper(
+                job_id, loop, str(kwargs.get("content_type", "image/jpeg")))
+
+    async def _fetch_resume_state(self, job_id: str,
+                                  offer: dict) -> dict | None:
+        """Fetch and unpack one resume offer's checkpoint blob. Every
+        failure degrades to the full pass (counted, logged), never to a
+        job error — resume is an optimization, not a dependency."""
+        blob = await self.hive.fetch_artifact(str(offer["href"]))
+        if blob is None:
+            _RESUMES.inc(outcome="fetch_failed")
+            logger.warning(
+                "resume offer for %s: checkpoint fetch failed; "
+                "running the full pass", job_id)
+            return None
+        try:
+            from . import checkpoint as ckpt
+
+            state = await asyncio.get_running_loop().run_in_executor(
+                None, ckpt.unpack, blob)
+        except Exception as e:
+            _RESUMES.inc(outcome="unpack_failed")
+            logger.warning(
+                "resume offer for %s: checkpoint unpack failed (%s); "
+                "running the full pass", job_id, e)
+            return None
+        _RESUMES.inc(outcome="resumed")
+        logger.info("job %s rehydrates from checkpointed step %s",
+                    job_id, state.get("step"))
+        return state
+
+    def _checkpoint_shipper(self, job_id: str, loop):
+        """The checkpoint callback for one pass. Runs on the executor
+        thread at chunk boundaries: packs the live state there (the
+        arrays are already host-side numpy), then hands the upload to
+        the event loop fire-and-forget — the denoise never waits on the
+        hive, and a failed upload costs the checkpoint, not the pass."""
+        max_bytes = int(getattr(
+            self.settings, "checkpoint_max_bytes", 0) or 0)
+
+        def ship(step, latents, state_leaves, signature):
+            try:
+                from . import checkpoint as ckpt
+
+                blob = ckpt.pack(step, latents, state_leaves, signature)
+            except Exception:
+                _CHECKPOINTS.inc(outcome="error")
+                logger.exception("checkpoint pack failed for %s", job_id)
+                return
+            if max_bytes > 0 and len(blob) > max_bytes:
+                _CHECKPOINTS.inc(outcome="oversize")
+                logger.warning(
+                    "checkpoint for %s at step %d is %d bytes "
+                    "(checkpoint_max_bytes %d); skipped",
+                    job_id, step, len(blob), max_bytes)
+                return
+            payload = {
+                "step": int(step),
+                "signature": signature,
+                "worker_name": self.settings.worker_name,
+                "blob": base64.b64encode(blob).decode("ascii"),
+            }
+            coro = self._ship_partial("checkpoint", job_id, payload)
+            try:
+                asyncio.run_coroutine_threadsafe(coro, loop)
+            except RuntimeError:  # loop gone: the worker died mid-pass
+                coro.close()
+                _CHECKPOINTS.inc(outcome="error")
+                return
+            # chaos seam (tools/chaos_smoke.py resume_after_worker_kill):
+            # the worker dies HERE — mid-denoise, past a shipped
+            # checkpoint — and a second worker must finish from it
+            faults.hang("hang_after_checkpoint")
+
+        return ship
+
+    def _preview_shipper(self, job_id: str, loop, content_type: str):
+        """The preview callback for one pass: VAE-decoded boundary pixels
+        arrive on the executor thread, are encoded there, and ship to
+        the hive's preview endpoint fire-and-forget."""
+        if not content_type.startswith("image/"):
+            content_type = "image/jpeg"
+
+        def ship(step, pixels):
+            try:
+                from .pipelines.stable_diffusion import _to_pil
+                from .post_processors.output_processor import image_to_buffer
+
+                image = _to_pil(pixels)[0]
+                payload = {
+                    "step": int(step),
+                    "content_type": content_type,
+                    "worker_name": self.settings.worker_name,
+                    "blob": base64.b64encode(
+                        image_to_buffer(image, content_type).getvalue()
+                    ).decode("ascii"),
+                }
+            except Exception:
+                _PREVIEWS.inc(outcome="error")
+                logger.exception("preview encode failed for %s", job_id)
+                return
+            coro = self._ship_partial("preview", job_id, payload)
+            try:
+                asyncio.run_coroutine_threadsafe(coro, loop)
+            except RuntimeError:  # loop gone: the worker died mid-pass
+                coro.close()
+                _PREVIEWS.inc(outcome="error")
+
+        return ship
+
+    async def _ship_partial(self, kind: str, job_id: str,
+                            payload: dict) -> None:
+        """Upload one mid-pass partial; the pass never learns whether it
+        landed (post_partial already absorbs refusals and transport
+        errors into None)."""
+        counter = _CHECKPOINTS if kind == "checkpoint" else _PREVIEWS
+        try:
+            ack = await self.hive.post_partial(kind, job_id, payload)
+        except Exception as e:  # belt and braces: never kill the loop
+            ack = None
+            logger.warning("%s upload for %s raised: %s", kind, job_id, e)
+        counter.inc(outcome="shipped" if ack else "error")
 
     @staticmethod
     def _batchable(prepared: list) -> bool:

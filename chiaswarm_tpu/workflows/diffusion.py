@@ -90,6 +90,17 @@ def diffusion_callback(device_identifier: str, model_name: str, **kwargs):
     geometry = kwargs.pop("geometry", None)
     reshard_probe = kwargs.pop("reshard_probe", None)
 
+    # mid-pass durability seam (ISSUE 18): the worker attaches these for
+    # checkpoint-armed solos; forwarded only to pipelines whose chunked
+    # runner exposes the boundary (`supports_checkpoint`), so other
+    # families routed through this callback run untouched
+    ckpt_kwargs = {
+        key: kwargs.pop(key)
+        for key in ("checkpoint_every_chunks", "preview_every_chunks",
+                    "checkpoint_cb", "preview_cb", "resume")
+        if key in kwargs
+    }
+
     pipeline = get_pipeline(
         model_name, pipeline_type=pipeline_type, chipset=chipset
     )
@@ -97,6 +108,8 @@ def diffusion_callback(device_identifier: str, model_name: str, **kwargs):
         kwargs["geometry"] = geometry
         if reshard_probe is not None:
             kwargs["reshard_probe"] = reshard_probe
+    if ckpt_kwargs and getattr(pipeline, "supports_checkpoint", False):
+        kwargs.update(ckpt_kwargs)
     images, pipeline_config = pipeline.run(pipeline_type=pipeline_type, **kwargs)
     if batch_capped:
         pipeline_config["batch_capped"] = batch_capped

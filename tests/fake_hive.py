@@ -10,6 +10,9 @@ against the production hive.
 from __future__ import annotations
 
 import asyncio
+import base64
+import binascii
+import hashlib
 import json
 import time
 import uuid
@@ -110,6 +113,16 @@ class FakeHive:
         # helpers the real hive uses, so GET /api/usage and GET /api/slo
         # answer the conformance-pinned shapes without drift
         self.records: dict[str, "_FakeRecord"] = {}
+        # preemption tolerance parity (ISSUE 18): POST /api/jobs/{id}/
+        # checkpoint stores the blob content-addressed and keeps the
+        # NEWEST checkpoint per job; /preview appends; a redelivered
+        # /work hand-out to a resume_capable poller carries the `resume`
+        # offer ({href, step, signature}); GET /api/jobs/{id} grows the
+        # `partial` disposition while previews exist pre-settle. The
+        # conformance suite pins all of it against the real hive.
+        self.artifacts: dict[str, bytes] = {}
+        self.checkpoints: dict[str, dict] = {}
+        self.previews: dict[str, list] = {}
         self._slo = SLOEngine(parse_slo(""))
         self._runner: web.AppRunner | None = None
         self.port: int | None = None
@@ -125,6 +138,9 @@ class FakeHive:
         app.router.add_get("/api/models", self._models)
         app.router.add_post("/api/jobs", self._submit)
         app.router.add_post("/api/jobs/{job_id}/cancel", self._cancel)
+        app.router.add_post("/api/jobs/{job_id}/checkpoint", self._checkpoint)
+        app.router.add_post("/api/jobs/{job_id}/preview", self._preview)
+        app.router.add_get("/api/artifacts/{digest}", self._artifact)
         app.router.add_get("/api/jobs/{job_id}", self._job_status)
         app.router.add_get("/api/usage", self._usage)
         app.router.add_get("/api/tenants/{tenant}/usage", self._tenant_usage)
@@ -180,11 +196,26 @@ class FakeHive:
         denied = self._unauthorized(request)
         if denied is not None:
             return denied
-        record = self.records.get(request.match_info["job_id"])
+        job_id = request.match_info["job_id"]
+        record = self.records.get(job_id)
         if record is None:
             return web.json_response(
                 {"message": "unknown job id"}, status=404)
-        return web.json_response(record.status())
+        out = record.status()
+        # partial disposition parity (ISSUE 18): progressive previews
+        # surface while the job is still in flight, exactly the shape
+        # the real hive's JobRecord.status() serves
+        previews = self.previews.get(job_id)
+        if previews and record.state not in (
+                "done", "failed", "cancelled", "expired"):
+            out["partial"] = {
+                "previews": [{"step": int(p.get("step", 0)),
+                              "href": p.get("href")} for p in previews],
+                **({"checkpoint_step": int(
+                    self.checkpoints[job_id].get("step", 0))}
+                   if self.checkpoints.get(job_id) else {}),
+            }
+        return web.json_response(out)
 
     async def _usage(self, request: web.Request) -> web.Response:
         """GET /api/usage through the SAME accounting helpers the real
@@ -303,7 +334,29 @@ class FakeHive:
                     record.state = "leased"
                     record.timeline.append({
                         "event": "dispatch", "wall": round(time.time(), 3)})
-                handed.append(dict(job, trace=trace))
+                handed_job = dict(job, trace=trace)
+                # resume offer parity (ISSUE 18): a REDELIVERY of a job
+                # with a stored checkpoint, handed to a resume_capable
+                # poller, carries the offer — same field set as the
+                # real hive's /work reply (conformance-pinned)
+                ck = self.checkpoints.get(job_id)
+                try:
+                    resume_capable = int(
+                        request.query.get("resume_capable", 0)) > 0
+                except ValueError:
+                    resume_capable = False
+                if ck and resume_capable and attempt > 1:
+                    handed_job["resume"] = {
+                        "href": f"/api/artifacts/{ck['sha256']}",
+                        "step": int(ck.get("step", 0)),
+                        "signature": ck.get("signature"),
+                    }
+                    if record is not None:
+                        record.timeline.append({
+                            "event": "resume_offer",
+                            "wall": round(time.time(), 3),
+                            "step": int(ck.get("step", 0))})
+                handed.append(handed_job)
         reply = {"jobs": handed}
         if self.cancels:
             # same contract as the real hive: the key appears only when
@@ -344,6 +397,118 @@ class FakeHive:
                 {"id": job_id, "status": "cancelled", "cancelled": True},
                 headers=self._epoch_headers())
         return web.json_response({"message": "unknown job id"}, status=404)
+
+    def _partial_refusal(self, job_id: str) -> web.Response | None:
+        """Shared gate for the checkpoint/preview endpoints, mirroring
+        the real hive: 404 for an id never seen, 409 once the job is no
+        longer executing (cancelled, or its result already settled)."""
+        known = (job_id in self.dispatch_attempts
+                 or job_id in self.records
+                 or any(str(j.get("id")) == job_id
+                        for j in self.pending_jobs))
+        if not known:
+            return web.json_response({"message": "unknown job id"},
+                                     status=404)
+        if job_id in self.cancelled_ids:
+            return web.json_response(
+                {"message": "job is not executing", "status": "cancelled"},
+                status=409)
+        if any(str(r.get("id")) == job_id for r in self.results):
+            return web.json_response(
+                {"message": "job is not executing", "status": "done"},
+                status=409)
+        if job_id not in self.dispatch_attempts:
+            return web.json_response(
+                {"message": "job is not executing", "status": "queued"},
+                status=409)
+        return None
+
+    async def _partial_blob(self, request: web.Request):
+        """Decode one checkpoint/preview POST body; returns
+        (meta, payload, error_response)."""
+        try:
+            meta = json.loads(await request.text())
+        except json.JSONDecodeError:
+            return None, None, web.json_response(
+                {"message": "body is not JSON"}, status=400)
+        if not (isinstance(meta, dict) and isinstance(meta.get("blob"), str)):
+            return None, None, web.json_response(
+                {"message": "no blob in body"}, status=400)
+        try:
+            payload = base64.b64decode(meta["blob"])
+        except (binascii.Error, ValueError):
+            return None, None, web.json_response(
+                {"message": "blob is not base64"}, status=400)
+        return meta, payload, None
+
+    async def _checkpoint(self, request: web.Request) -> web.Response:
+        denied = self._unauthorized(request)
+        if denied is not None:
+            return denied
+        job_id = request.match_info["job_id"]
+        refused = self._partial_refusal(job_id)
+        if refused is not None:
+            return refused
+        meta, payload, error = await self._partial_blob(request)
+        if error is not None:
+            return error
+        digest = hashlib.sha256(payload).hexdigest()
+        self.artifacts[digest] = payload
+        step = int(meta.get("step", 0) or 0)
+        # newest-only, like the real hive (the superseded blob would be
+        # dropped there; the fake just forgets the reference)
+        self.checkpoints[job_id] = {
+            "step": step, "sha256": digest,
+            "signature": meta.get("signature"), "bytes": len(payload)}
+        record = self.records.get(job_id)
+        if record is not None:
+            record.timeline.append({
+                "event": "checkpoint", "wall": round(time.time(), 3),
+                "step": step, "bytes": len(payload)})
+        return web.json_response(
+            {"status": "ok", "step": step, "sha256": digest},
+            headers=self._epoch_headers())
+
+    async def _preview(self, request: web.Request) -> web.Response:
+        denied = self._unauthorized(request)
+        if denied is not None:
+            return denied
+        job_id = request.match_info["job_id"]
+        refused = self._partial_refusal(job_id)
+        if refused is not None:
+            return refused
+        meta, payload, error = await self._partial_blob(request)
+        if error is not None:
+            return error
+        digest = hashlib.sha256(payload).hexdigest()
+        self.artifacts[digest] = payload
+        step = int(meta.get("step", 0) or 0)
+        href = f"/api/artifacts/{digest}"
+        self.previews.setdefault(job_id, []).append({
+            "step": step, "sha256": digest, "bytes": len(payload),
+            "href": href,
+            **({"content_type": meta["content_type"]}
+               if isinstance(meta.get("content_type"), str) else {}),
+        })
+        record = self.records.get(job_id)
+        if record is not None:
+            record.timeline.append({
+                "event": "preview", "wall": round(time.time(), 3),
+                "step": step})
+        return web.json_response(
+            {"status": "ok", "step": step, "href": href},
+            headers=self._epoch_headers())
+
+    async def _artifact(self, request: web.Request) -> web.Response:
+        denied = self._unauthorized(request)
+        if denied is not None:
+            return denied
+        blob = self.artifacts.get(request.match_info["digest"])
+        if blob is None:
+            return web.json_response(
+                {"message": "unknown artifact"}, status=404)
+        return web.Response(body=blob,
+                            content_type="application/octet-stream")
 
     def _gang_groups(self, jobs: list[dict],
                      gang_rows: int) -> list[list[dict]]:

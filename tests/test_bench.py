@@ -132,6 +132,21 @@ def test_bench_cpu_fallback_produces_labeled_smoke_row():
     assert "hive_e2e_mfu" in out, out
     assert out["hive_e2e_mfu"] is None, out  # CPU: no peak entry
 
+    # preemption tolerance (ISSUE 18): a checkpoint-armed worker killed
+    # mid-denoise past a shipped checkpoint, lease force-expired, and a
+    # second resume-capable worker finished from the checkpointed step —
+    # the resume must SAVE a real fraction of the pass (ratio in (0,1):
+    # 0 means it recomputed everything, 1 would mean nothing ran), with
+    # the redelivery's resume offer on the timeline and progressive
+    # previews decoded along the way. The main-phase redeliveries==0
+    # assertion above is untouched: that counter is snapshotted before
+    # this phase's deliberate expiry.
+    assert 0 < out.get("hive_e2e_resume_saved_steps_ratio", 0) < 1, out
+    assert out.get("hive_e2e_resume_from_step", 0) >= 2, out
+    assert out.get("hive_e2e_resume_recomputed_steps", 0) > 0, out
+    assert out.get("hive_e2e_resume_offers", 0) >= 1, out
+    assert out.get("hive_e2e_preview_artifacts", 0) > 0, out
+
     # end-to-end tracing row (ISSUE 8): every settled job in the
     # hive_e2e scenario must carry a COMPLETE gap-free timeline —
     # admit/dispatch(placement)/settle events, an attributed queue-wait

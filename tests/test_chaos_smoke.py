@@ -9,8 +9,11 @@ replay on restart, zero lost), the per-tenant usage ledger surviving a
 hive SIGKILL bit-identically (and on a promoted standby), a primary
 killed under a WAL-shipped
 standby (health-checked self-promotion, worker failover, zero lost),
-and a revived deposed primary whose stale-epoch ACK must be fenced
-(no double-settle) — must end with a healthy swarm and zero lost
+a revived deposed primary whose stale-epoch ACK must be fenced
+(no double-settle), and a worker killed mid-denoise PAST a durable
+checkpoint with the hive SIGKILL'd on top (a second worker resumes from
+the checkpointed step via the redelivery's resume offer; exactly-once
+settle, gap-free trace) — must end with a healthy swarm and zero lost
 envelopes.
 """
 
@@ -44,6 +47,7 @@ def _load_tool():
     "usage_survives_restart",
     "hive_failover",
     "hive_split_brain_fenced",
+    "resume_after_worker_kill",
 ])
 def test_chaos_scenario(name, sdaas_root):
     tool = _load_tool()

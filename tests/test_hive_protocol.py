@@ -12,7 +12,9 @@ until the others follow.
 """
 
 import asyncio
+import base64
 import dataclasses
+import hashlib
 import json
 
 import aiohttp
@@ -44,6 +46,12 @@ class FakeBackend:
     def refuse(self, message: str) -> None:
         self.hive.refuse_with = message
 
+    def redeliver(self, job: dict) -> None:
+        # the fake has no lease clock: re-queueing the same id IS the
+        # redelivery (dispatch_attempts persists, so the next hand-out
+        # carries attempt 2 — exactly what a reaped lease produces)
+        self.hive.add_job(dict(job))
+
     async def stop(self) -> None:
         await self.hive.stop()
 
@@ -71,8 +79,21 @@ class RealBackend:
     def refuse(self, message: str) -> None:
         self.server.refuse_with = message
 
+    def redeliver(self, job: dict) -> None:
+        _expire_and_reap(self.server, str(job["id"]))
+
     async def stop(self) -> None:
         await self.server.stop()
+
+
+def _expire_and_reap(server, job_id: str) -> None:
+    """Force the lease reaper's hand: expire the live lease NOW and
+    reap, putting the job back at the front of its class exactly as a
+    worker death would."""
+    lease = server.leases.get(job_id)
+    assert lease is not None, f"no live lease for {job_id}"
+    lease.expires_at = 0.0
+    server.leases.reap(server.queue)
 
 
 class PromotedBackend:
@@ -109,6 +130,9 @@ class PromotedBackend:
 
     def refuse(self, message: str) -> None:
         self.server.refuse_with = message
+
+    def redeliver(self, job: dict) -> None:
+        _expire_and_reap(self.server, str(job["id"]))
 
     async def stop(self) -> None:
         await self.standby.stop()
@@ -710,5 +734,177 @@ def test_resident_adapters_drive_adapter_affinity(backend_name):
             # the dispatcher counted the zero-upload placement
             assert _DISPATCH.value(
                 outcome="adapter_affinity") == before + 1
+
+    run_conformance(backend_name, scenario)
+
+
+async def _post_partial(backend, job_id: str, kind: str, payload: dict):
+    """POST /api/jobs/{id}/checkpoint|preview raw (the refusal status
+    codes are part of the wire contract under test; HiveClient's
+    post_partial deliberately flattens them to None)."""
+    async with aiohttp.ClientSession() as session:
+        async with session.post(
+                f"{backend.uri}/jobs/{job_id}/{kind}",
+                data=json.dumps(payload),
+                headers={"Authorization": f"Bearer {TOKEN}",
+                         "Content-type": "application/json"}) as resp:
+            return resp.status, await resp.json()
+
+
+def _partial_payload(step: int, blob: bytes, **extra) -> dict:
+    return {"step": step, "worker_name": "worker",
+            "blob": base64.b64encode(blob).decode("ascii"), **extra}
+
+
+def test_checkpoint_post_ack_and_refusals(backend_name):
+    """ISSUE 18: the lessee's mid-pass checkpoint POST is ACKed
+    {"status": "ok", "step", "sha256"} with the content digest of the
+    blob it just durably stored; an unknown id is a 404, a body without
+    a base64 `blob` is a 400, and a job that already settled answers
+    409 {"message", "status"} — stale state can never shadow live
+    state. Pinned across all three backends so fake_hive cannot
+    drift."""
+
+    async def scenario(backend, client):
+        backend.queue_job(echo_job("conf-ckpt"))
+        [job] = await client.ask_for_work(dict(CAPS))
+        state = b"latents-at-step-12"
+        status, ack = await _post_partial(
+            backend, "conf-ckpt", "checkpoint",
+            _partial_payload(12, state, signature="prog-sig"))
+        assert status == 200
+        assert ack["status"] == "ok"
+        assert ack["step"] == 12
+        assert ack["sha256"] == hashlib.sha256(state).hexdigest()
+        # unknown ids are a 404, not a silent 200
+        status, payload = await _post_partial(
+            backend, "conf-no-such-job", "checkpoint",
+            _partial_payload(1, b"x"))
+        assert status == 404 and "message" in payload
+        # a body without a base64 blob is a 400
+        status, payload = await _post_partial(
+            backend, "conf-ckpt", "checkpoint", {"step": 13})
+        assert status == 400 and "message" in payload
+        # once the result settles, further partials are refused with the
+        # job's disposition (the worker's shipper stops, never retries)
+        await client.submit_result({
+            "id": "conf-ckpt", "artifacts": {}, "nsfw": False,
+            "worker_version": "0.1.0", "pipeline_config": {}})
+        status, payload = await _post_partial(
+            backend, "conf-ckpt", "checkpoint",
+            _partial_payload(14, b"too-late"))
+        assert status == 409
+        assert payload["status"] in ("done", "settling")
+        assert "message" in payload
+
+    run_conformance(backend_name, scenario)
+
+
+def test_resume_offer_on_redelivery(backend_name):
+    """ISSUE 18: a redelivered job whose previous lessee shipped a
+    checkpoint carries a `resume` offer on the /work reply — exactly
+    {href, step, signature} — for a resume-capable poller, and the href
+    serves back the exact blob bytes through the worker's own client.
+    The first delivery carries no offer (there is nothing to resume
+    from). Pinned across all three backends so fake_hive cannot
+    drift."""
+
+    async def scenario(backend, client):
+        job = echo_job("conf-resume")
+        backend.queue_job(job)
+        caps = dict(CAPS, resume_capable=1)
+        [handed] = await client.ask_for_work(caps)
+        assert "resume" not in handed  # attempt 1: nothing to resume
+        state = b"ckpt-state-at-step-20"
+        status, ack = await _post_partial(
+            backend, "conf-resume", "checkpoint",
+            _partial_payload(20, state, signature="prog-sig"))
+        assert status == 200
+        backend.redeliver(job)
+        [again] = await client.ask_for_work(caps)
+        assert again["id"] == "conf-resume"
+        assert again["trace"]["attempt"] == 2
+        offer = again["resume"]
+        assert set(offer) == {"href", "step", "signature"}
+        assert offer["href"] == f"/api/artifacts/{ack['sha256']}"
+        assert offer["step"] == 20
+        assert offer["signature"] == "prog-sig"
+        # the offer's href serves the exact checkpoint bytes back
+        # through the client call the worker's rehydration path uses
+        assert await client.fetch_artifact(offer["href"]) == state
+
+    run_conformance(backend_name, scenario)
+
+
+def test_no_resume_offer_for_legacy_pollers(backend_name):
+    """ISSUE 18: the resume offer is capability-gated — a poller that
+    does not advertise `resume_capable` sees the pre-resume wire shape
+    on a redelivery even when a checkpoint exists (it would have no way
+    to rehydrate the blob)."""
+
+    async def scenario(backend, client):
+        job = echo_job("conf-legacy")
+        backend.queue_job(job)
+        [handed] = await client.ask_for_work(dict(CAPS))
+        status, _ = await _post_partial(
+            backend, "conf-legacy", "checkpoint",
+            _partial_payload(8, b"ckpt", signature="sig"))
+        assert status == 200
+        backend.redeliver(job)
+        [again] = await client.ask_for_work(dict(CAPS))
+        assert again["id"] == "conf-legacy"
+        assert "resume" not in again
+
+    run_conformance(backend_name, scenario)
+
+
+def test_preview_partial_disposition(backend_name):
+    """ISSUE 18: progressive previews surface on GET /api/jobs/{id} as
+    the `partial` disposition — {"previews": [{"step", "href"}, ...],
+    "checkpoint_step"?} — strictly while the pass is in flight; the
+    preview href serves the decoded bytes; a checkpoint alone (no
+    preview yet) surfaces nothing; and settling clears the disposition
+    so a finished job never advertises stale partials. Pinned across
+    all three backends so fake_hive cannot drift."""
+
+    async def scenario(backend, client):
+        status, _ = await _post_job(backend, echo_job("conf-preview"))
+        assert status == 200
+        [job] = await client.ask_for_work(dict(CAPS))
+        # a checkpoint alone is resume state, not a tenant-visible
+        # partial — the disposition appears only once a preview exists
+        status, _ = await _post_partial(
+            backend, "conf-preview", "checkpoint",
+            _partial_payload(10, b"ckpt-state", signature="sig"))
+        assert status == 200
+        status, snapshot = await _get_json(backend, "/jobs/conf-preview")
+        assert status == 200 and "partial" not in snapshot
+        pixels = b"decoded-jpeg-bytes"
+        status, ack = await _post_partial(
+            backend, "conf-preview", "preview",
+            _partial_payload(8, pixels, content_type="image/jpeg"))
+        assert status == 200
+        assert ack["status"] == "ok" and ack["step"] == 8
+        assert ack["href"] == (
+            f"/api/artifacts/{hashlib.sha256(pixels).hexdigest()}")
+        status, snapshot = await _get_json(backend, "/jobs/conf-preview")
+        assert status == 200
+        partial = snapshot["partial"]
+        assert partial["previews"] == [{"step": 8, "href": ack["href"]}]
+        assert partial["checkpoint_step"] == 10
+        assert await client.fetch_artifact(ack["href"]) == pixels
+        # previews append in order
+        status, ack2 = await _post_partial(
+            backend, "conf-preview", "preview",
+            _partial_payload(16, b"later-preview"))
+        assert status == 200
+        status, snapshot = await _get_json(backend, "/jobs/conf-preview")
+        assert [p["step"] for p in snapshot["partial"]["previews"]] == [8, 16]
+        # settle: the partial disposition disappears from the reply
+        await client.submit_result({
+            "id": "conf-preview", "artifacts": {}, "nsfw": False,
+            "worker_version": "0.1.0", "pipeline_config": {}})
+        status, snapshot = await _get_json(backend, "/jobs/conf-preview")
+        assert status == 200 and "partial" not in snapshot
 
     run_conformance(backend_name, scenario)

@@ -544,6 +544,77 @@ def test_server_sweep_protects_live_record_artifacts(sdaas_root):
     asyncio.run(scenario())
 
 
+def test_partial_blobs_swept_on_terminal_states(sdaas_root):
+    """ISSUE 18: checkpoint + preview blobs are spool-backed only while
+    the job is live — a superseding checkpoint drops the stale blob on
+    the spot, and the settle drops every remaining partial (the final
+    artifact supersedes them all). Deliberately NOT a conformance pin:
+    sweeping is real-coordinator durability behavior the fake hive
+    does not model."""
+    from chiaswarm_tpu.hive_server import HiveServer
+
+    auth = {"Authorization": f"Bearer {TOKEN}"}
+
+    async def scenario():
+        async with HiveServer(_hive_settings(), port=0) as hive, \
+                aiohttp.ClientSession() as session:
+            await _post(session, f"{hive.api_uri}/jobs",
+                        {"id": "ckpt-job", "workflow": "echo",
+                         "model_name": "none", "prompt": "x"})
+            [job] = await _poll(session, hive.api_uri, "w1")
+            assert job["id"] == "ckpt-job"
+
+            def b64(payload: bytes) -> str:
+                return base64.b64encode(payload).decode()
+
+            status, ack1 = await _post(
+                session, f"{hive.api_uri}/jobs/ckpt-job/checkpoint",
+                {"worker_name": "w1", "step": 6, "signature": "sig",
+                 "blob": b64(b"ckpt-step-6")})
+            assert status == 200, ack1
+            status, ack2 = await _post(
+                session, f"{hive.api_uri}/jobs/ckpt-job/checkpoint",
+                {"worker_name": "w1", "step": 12, "signature": "sig",
+                 "blob": b64(b"ckpt-step-12")})
+            assert status == 200, ack2
+            # newest-wins: the superseded blob left the spool immediately
+            assert hive.spool.path_for(ack1["sha256"]) is None
+            assert hive.spool.path_for(ack2["sha256"]) is not None
+
+            status, pv = await _post(
+                session, f"{hive.api_uri}/jobs/ckpt-job/preview",
+                {"worker_name": "w1", "step": 12, "blob": b64(b"preview")})
+            assert status == 200, pv
+            preview_digest = pv["href"].rsplit("/", 1)[-1]
+            assert hive.spool.path_for(preview_digest) is not None
+
+            # live partial disposition while the pass runs
+            async with session.get(f"{hive.api_uri}/jobs/ckpt-job",
+                                   headers=auth) as r:
+                st = await r.json()
+            assert st["partial"]["checkpoint_step"] == 12
+            assert [p["step"] for p in st["partial"]["previews"]] == [12]
+
+            # terminal settle: every partial blob leaves the spool, the
+            # status stops advertising them, the result artifact stays
+            await _post(session, f"{hive.api_uri}/results",
+                        {"id": "ckpt-job", "nsfw": False,
+                         "pipeline_config": {},
+                         "artifacts": {"primary": {"blob": b64(b"final")}}})
+            record = hive.queue.records["ckpt-job"]
+            assert record.checkpoint is None and record.previews == []
+            assert hive.spool.path_for(ack2["sha256"]) is None
+            assert hive.spool.path_for(preview_digest) is None
+            final = record.result["artifacts"]["primary"]["sha256"]
+            assert hive.spool.path_for(final) is not None
+            async with session.get(f"{hive.api_uri}/jobs/ckpt-job",
+                                   headers=auth) as r:
+                st = await r.json()
+            assert "partial" not in st
+
+    asyncio.run(scenario())
+
+
 # --- HTTP + e2e (ISSUE 5 acceptance) ---------------------------------------
 
 

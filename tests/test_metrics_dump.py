@@ -184,6 +184,31 @@ def test_cost_line_from_synthetic_text():
     assert tool.cost_summary([]) is None
 
 
+def test_resume_line_from_synthetic_text():
+    """ISSUE 18: the worker-side preemption line — checkpoints shipped
+    at chunk boundaries (plus skips/failures), preview frames decoded,
+    and redelivered passes resumed from a checkpoint — with its
+    machine-readable twin; fleets that never engaged the feature render
+    nothing at all."""
+    tool = _load_tool()
+    samples = tool.parse_metrics(
+        'swarm_checkpoints_total{outcome="shipped"} 5\n'
+        'swarm_checkpoints_total{outcome="oversize"} 1\n'
+        'swarm_previews_total{outcome="shipped"} 3\n'
+        'swarm_resume_total{outcome="resumed"} 2\n'
+        'swarm_resume_total{outcome="fetch_failed"} 1\n')
+    assert tool.resume_line(samples) == (
+        "resume         checkpoints oversize=1 shipped=5  "
+        "previews shipped=3  resumes fetch_failed=1 resumed=2")
+    assert tool.resume_summary(samples) == {
+        "checkpoints": {"oversize": 1, "shipped": 5},
+        "previews": {"shipped": 3},
+        "resumes": {"fetch_failed": 1, "resumed": 2},
+    }
+    assert tool.resume_line([]) is None
+    assert tool.resume_summary([]) is None
+
+
 HIVE_SYNTHETIC = """\
 # TYPE swarm_hive_dispatch_total counter
 swarm_hive_dispatch_total{outcome="affinity"} 6
@@ -248,6 +273,13 @@ swarm_hive_slo_compliance{class="interactive"} 0.88
 # TYPE swarm_hive_worker_outlier gauge
 swarm_hive_worker_outlier{worker="w-slow"} 1
 swarm_hive_worker_outlier{worker="w-fast"} 0
+# TYPE swarm_hive_checkpoints_total counter
+swarm_hive_checkpoints_total{outcome="stored"} 4
+swarm_hive_checkpoints_total{outcome="superseded"} 3
+# TYPE swarm_hive_previews_total counter
+swarm_hive_previews_total{outcome="stored"} 2
+# TYPE swarm_hive_resume_offers_total counter
+swarm_hive_resume_offers_total 1
 """
 
 
@@ -287,6 +319,12 @@ def test_hive_tables_from_synthetic_text():
     assert summary["slo"] == {"interactive": {
         "fast_burn": 2.4, "slow_burn": 0.3, "compliance": 0.88}}
     assert summary["outliers"] == ["w-slow"]
+    # preemption tolerance (ISSUE 18)
+    assert summary["partials"] == {
+        "checkpoints": {"stored": 4, "superseded": 3},
+        "previews": {"stored": 2},
+        "resume_offers": 1,
+    }
 
     table = tool.render_hive_tables(summary)
     assert "affinity" in table and "6" in table
@@ -306,6 +344,8 @@ def test_hive_tables_from_synthetic_text():
     assert "hive slo" in table
     assert "fast=2.40 slow=0.30 compliance=0.88" in table
     assert "hive outliers w-slow" in table
+    assert ("hive partials checkpoints stored=4 superseded=3  "
+            "previews stored=2  resume_offers=1") in table
 
 
 def test_json_mode_emits_machine_readable_twin(monkeypatch, capsys):
@@ -331,6 +371,9 @@ def test_json_mode_emits_machine_readable_twin(monkeypatch, capsys):
     assert payload["hive"]["tenants"]["acme"]["chip_seconds"] == 42.5
     assert payload["hive"]["slo"]["interactive"]["fast_burn"] == 2.4
     assert payload["hive"]["dispatch"]["affinity"] == 6
+    assert payload["hive"]["partials"]["resume_offers"] == 1
+    # the synthetic worker never checkpointed: the twin is null, not {}
+    assert payload["worker"]["resume"] is None
     stages = {r["stage"]: r for r in payload["worker"]["stages"]}
     assert stages["denoise"]["count"] == 4
     assert stages["denoise"]["p90_le_s"] == "+Inf"  # inf spelled safely

@@ -1,0 +1,218 @@
+"""Preemption-tolerant denoise (ISSUE 18): resume-correctness pins.
+
+A 30-step solo is checkpointed at chunk boundaries, "killed", and
+resumed from the checkpointed step — the resumed output must be BITWISE
+the undisturbed pass's (the chunked runner's RNG is per-step keyed), the
+``pipeline_config.resumed`` stamp must bill only the recomputed steps,
+and every degrade path (signature mismatch, torn blob, out-of-span step,
+chunking off) must fall back to the full pass rather than error. The
+wire blob format round-trips here too, bfloat16 leaves included.
+
+The degrade/preview pins run 9-step passes: the chunked runner compiles
+per-CHUNK programs, so any step count shares the 30-step pin's compile
+set and only the acceptance test itself pays the full walk. Reference
+renders are cached per step count — the runs are deterministic by
+construction (that is the whole point of the module).
+
+Hive-side terminal-state blob sweeping is pinned in test_hive_server.py;
+the distributed kill/redeliver drive lives in tools/chaos_smoke.py
+(``resume_after_worker_kill``) and the bench's hive_e2e resume phase.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+
+from chiaswarm_tpu import checkpoint as ckpt
+from chiaswarm_tpu.pipelines.stable_diffusion import SDPipeline
+
+STEPS = 30
+CHUNK = 3
+
+
+@pytest.fixture(scope="module")
+def tiny_sd():
+    return SDPipeline("test/tiny-sd")
+
+
+def _run(pipe, monkeypatch, steps=STEPS, **kwargs):
+    monkeypatch.setenv("CHIASWARM_DENOISE_CHUNK_STEPS", str(CHUNK))
+    images, config = pipe.run(
+        prompt="preemption pin", height=64, width=64,
+        num_inference_steps=steps, rng=jax.random.key(1811), **kwargs)
+    return np.asarray(images[0]), config
+
+
+_REF_CACHE: dict = {}
+
+
+def _ref(pipe, monkeypatch, steps=STEPS):
+    """The undisturbed pass every pin compares against, rendered once
+    per step count."""
+    if steps not in _REF_CACHE:
+        _REF_CACHE[steps] = _run(pipe, monkeypatch, steps=steps)
+    return _REF_CACHE[steps]
+
+
+# --- blob wire format -------------------------------------------------------
+
+
+def test_checkpoint_blob_round_trip_with_bfloat16_leaves():
+    import ml_dtypes
+
+    latents = np.arange(2 * 4 * 8 * 8, dtype=np.float32).reshape(2, 4, 8, 8)
+    leaves = [np.float32(0.5),
+              np.arange(6, dtype=np.int32).reshape(2, 3),
+              np.ones((3,), dtype=ml_dtypes.bfloat16)]
+    blob = ckpt.pack(12, latents, leaves, "sig-abc")
+    out = ckpt.unpack(blob)
+    assert out["step"] == 12
+    assert out["signature"] == "sig-abc"
+    np.testing.assert_array_equal(out["latents"], latents)
+    assert [str(x.dtype) for x in out["state_leaves"]] == [
+        "float32", "int32", "bfloat16"]
+    for got, sent in zip(out["state_leaves"], leaves):
+        np.testing.assert_array_equal(got, np.asarray(sent))
+
+
+@pytest.mark.parametrize("blob", [
+    b"",                              # empty
+    b"junk-not-a-checkpoint",         # wrong magic
+    b"CSWCKPT1\xff\xff\xff\xff",      # header length past the blob end
+])
+def test_checkpoint_unpack_refuses_garbage(blob):
+    with pytest.raises(ValueError):
+        ckpt.unpack(blob)
+
+
+def test_checkpoint_unpack_refuses_truncated_blob():
+    blob = ckpt.pack(3, np.zeros((1, 4, 8, 8), np.float32), [], "s")
+    with pytest.raises(ValueError):
+        ckpt.unpack(blob[:-16])
+
+
+def test_program_signature_varies_with_every_ingredient():
+    base = ckpt.program_signature("m", ("k",), "float32", (1, 2))
+    assert base == ckpt.program_signature("m", ("k",), "float32", (1, 2))
+    assert base != ckpt.program_signature("m2", ("k",), "float32", (1, 2))
+    assert base != ckpt.program_signature("m", ("k2",), "float32", (1, 2))
+    assert base != ckpt.program_signature("m", ("k",), "bfloat16", (1, 2))
+    assert base != ckpt.program_signature("m", ("k",), "float32", (2, 1))
+
+
+# --- resume correctness (the ISSUE 18 acceptance pin) -----------------------
+
+
+def test_resume_from_midpass_checkpoint_is_bitwise_and_bills_remainder(
+        tiny_sd, sdaas_root, monkeypatch):
+    """The acceptance bar: a 30-step solo killed at a chunk boundary
+    resumes from the last checkpointed step; the resumed output is
+    bit-for-bit the undisturbed pass (per-step-keyed RNG), `resumed` is
+    stamped, and the cost stamp bills only the recomputed steps."""
+    ref, ref_cfg = _ref(tiny_sd, monkeypatch)
+    assert "resumed" not in ref_cfg
+
+    shipped = []
+
+    def capture(step, latents, leaves, signature):
+        shipped.append({"step": step, "latents": latents,
+                        "state_leaves": leaves, "signature": signature})
+
+    armed, armed_cfg = _run(tiny_sd, monkeypatch,
+                            checkpoint_every_chunks=2,
+                            checkpoint_cb=capture)
+    # shipping checkpoints never perturbs the pass
+    np.testing.assert_array_equal(ref, armed)
+    assert "resumed" not in armed_cfg
+    # chunk boundaries land every 3 steps; every 2nd is checkpointed
+    assert [c["step"] for c in shipped] == [6, 12, 18, 24]
+    assert len({c["signature"] for c in shipped}) == 1
+
+    # "kill" at the step-18 boundary: the blob round-trips the wire
+    # format and the resumed pass recomputes ONLY steps 18..30
+    picked = shipped[2]
+    blob = ckpt.pack(picked["step"], picked["latents"],
+                     picked["state_leaves"], picked["signature"])
+    resumed, res_cfg = _run(tiny_sd, monkeypatch, resume=ckpt.unpack(blob))
+    np.testing.assert_array_equal(ref, resumed)
+    assert res_cfg["resumed"] == {"from_step": 18, "recomputed_steps": 12}
+    # the ledger bills the recomputed fraction, not the full pass the
+    # first delivery already burned
+    assert abs(res_cfg["cost"]["flops"]
+               - ref_cfg["cost"]["flops"] * 12 / 30) <= 1
+
+
+def test_resume_degrade_paths_fall_back_to_full_pass(tiny_sd, sdaas_root,
+                                                     monkeypatch):
+    """Resume is an optimization, never a gate: a wrong program
+    signature, a torn blob, or an out-of-span step each run the full
+    pass (same output, full billing, no `resumed` stamp)."""
+    steps = 9
+    ref, ref_cfg = _ref(tiny_sd, monkeypatch, steps)
+    shipped = []
+    _run(tiny_sd, monkeypatch, steps=steps, checkpoint_every_chunks=2,
+         checkpoint_cb=lambda s, la, lv, sig: shipped.append((s, la, lv, sig)))
+    step, latents, leaves, sig = shipped[0]
+    assert step == 6
+
+    # wrong program signature: the offer is refused before the runner
+    out, cfg = _run(tiny_sd, monkeypatch, steps=steps, resume={
+        "step": step, "signature": "f" * 16,
+        "latents": latents, "state_leaves": leaves})
+    np.testing.assert_array_equal(ref, out)
+    assert "resumed" not in cfg
+    assert cfg["cost"]["flops"] == ref_cfg["cost"]["flops"]
+
+    # torn blob: right signature, wrong-shaped latents — rehydration
+    # fails inside the runner and the pass restarts from step 0
+    out, cfg = _run(tiny_sd, monkeypatch, steps=steps, resume={
+        "step": step, "signature": sig,
+        "latents": np.zeros((1, 2, 3, 4), np.float32),
+        "state_leaves": leaves})
+    np.testing.assert_array_equal(ref, out)
+    assert "resumed" not in cfg
+
+    # a checkpoint step outside the denoise span degrades too
+    out, cfg = _run(tiny_sd, monkeypatch, steps=steps, resume={
+        "step": steps + 3, "signature": sig,
+        "latents": latents, "state_leaves": leaves})
+    np.testing.assert_array_equal(ref, out)
+    assert "resumed" not in cfg
+
+
+def test_progressive_previews_decode_at_cadence_without_perturbing(
+        tiny_sd, sdaas_root, monkeypatch):
+    steps = 9
+    frames = []
+    ref, _ = _ref(tiny_sd, monkeypatch, steps)
+    out, cfg = _run(tiny_sd, monkeypatch, steps=steps, preview_every_chunks=1,
+                    preview_cb=lambda step, px: frames.append((step, px)))
+    np.testing.assert_array_equal(ref, out)
+    assert "resumed" not in cfg
+    # every 3-step chunk boundary decodes the live latents
+    assert [s for s, _ in frames] == [3, 6]
+    for _, px in frames:
+        assert px.shape[-3:-1] == (64, 64) and px.shape[-1] == 3
+
+
+def test_checkpoint_kwargs_ignored_when_chunking_off(tiny_sd, sdaas_root,
+                                                     monkeypatch):
+    """checkpoint_every_chunks=0 / chunking off is the classic path:
+    the ISSUE 18 kwargs are accepted and ignored, output byte-identical,
+    nothing captured — the pipeline goldens cannot move."""
+    monkeypatch.delenv("CHIASWARM_DENOISE_CHUNK_STEPS", raising=False)
+
+    def fused(**kw):
+        return tiny_sd.run(prompt="preemption pin", height=64, width=64,
+                           num_inference_steps=5, rng=jax.random.key(4),
+                           **kw)
+
+    ref = np.asarray(fused()[0][0])
+    captured = []
+    images, cfg = fused(checkpoint_every_chunks=2, preview_every_chunks=2,
+                        checkpoint_cb=lambda *a: captured.append(a),
+                        preview_cb=lambda *a: captured.append(a))
+    np.testing.assert_array_equal(ref, np.asarray(images[0]))
+    assert captured == []
+    assert "resumed" not in cfg

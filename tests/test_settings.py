@@ -264,7 +264,9 @@ EXPECTED_FIELDS = (
     "embed_cache_mb", "lora_runtime_delta", "lora_cache_mb",
     "lora_operand_cache_mb", "lora_slots_max", "lora_rank_max",
     "program_cache_max",
-    "denoise_chunk_steps", "shard_interactive", "shard_tensor", "shard_seq",
+    "denoise_chunk_steps", "checkpoint_every_chunks", "checkpoint_max_bytes",
+    "preview_every_chunks",
+    "shard_interactive", "shard_tensor", "shard_seq",
     "metrics_port", "metrics_host", "log_format", "job_deadline_s",
     "job_deadline_compile_scale", "quarantine_probe_grace_s",
     "drain_deadline_s", "outbox_dir", "outbox_max_entries",
@@ -276,7 +278,8 @@ EXPECTED_FIELDS = (
     "hive_wal_compact_every", "hive_shed_watermarks",
     "hive_spool_max_bytes", "hive_spool_max_age_s", "hive_slo",
     "hive_slo_fast_window_s", "hive_slo_slow_window_s", "hive_tenant_topk",
-    "hive_stats_ewma_alpha", "hive_straggler_factor", "sdaas_uris",
+    "hive_stats_ewma_alpha", "hive_straggler_factor", "hive_flap_threshold",
+    "sdaas_uris",
     "hive_standby_of", "hive_replication_poll_s", "hive_failover_grace_s",
     "hive_replication_lag_degraded_s", "hive_failover_errors",
     "memory_headroom_degraded",
@@ -318,6 +321,29 @@ def test_every_env_override_roundtrips(sdaas_root, monkeypatch):
         assert getattr(load_settings(), attr) == expect, (env, attr)
         monkeypatch.delenv(env)
         assert getattr(load_settings(), attr) == default, (env, attr)
+
+
+def test_preemption_knobs(sdaas_root, monkeypatch):
+    """ISSUE 18: the checkpoint/preview/flap knobs layer like every
+    other setting — checkpoints and previews OFF by default (the classic
+    path stays byte-identical), an 8 MiB blob ceiling, flap detection at
+    3 consecutive expiries, env overrides win."""
+    s = load_settings()
+    assert s.checkpoint_every_chunks == 0
+    assert s.checkpoint_max_bytes == 8 * 1024 * 1024
+    assert s.preview_every_chunks == 0
+    assert s.hive_flap_threshold == 3
+    monkeypatch.setenv("CHIASWARM_CHECKPOINT_EVERY_CHUNKS", "2")
+    monkeypatch.setenv("CHIASWARM_CHECKPOINT_MAX_BYTES", "1048576")
+    monkeypatch.setenv("CHIASWARM_PREVIEW_EVERY_CHUNKS", "4")
+    monkeypatch.setenv("CHIASWARM_HIVE_FLAP_THRESHOLD", "0")
+    s = load_settings()
+    assert s.checkpoint_every_chunks == 2
+    assert s.checkpoint_max_bytes == 1048576
+    assert s.preview_every_chunks == 4
+    assert s.hive_flap_threshold == 0  # 0 disables flap holds entirely
+    monkeypatch.undo()
+    assert load_settings().checkpoint_every_chunks == 0
 
 
 def test_program_cache_knob(sdaas_root, monkeypatch):

@@ -83,6 +83,13 @@ swarm_hive_queue_wait_seconds_bucket{class="default",le="1"} 4
 swarm_hive_queue_wait_seconds_bucket{class="default",le="+Inf"} 4
 swarm_hive_queue_wait_seconds_sum{class="default"} 1.5
 swarm_hive_queue_wait_seconds_count{class="default"} 4
+# TYPE swarm_hive_checkpoints_total counter
+swarm_hive_checkpoints_total{outcome="stored"} 4
+swarm_hive_checkpoints_total{outcome="superseded"} 3
+# TYPE swarm_hive_previews_total counter
+swarm_hive_previews_total{outcome="stored"} 2
+# TYPE swarm_hive_resume_offers_total counter
+swarm_hive_resume_offers_total 1
 """
 
 WORKER_METRICS = """\
@@ -110,6 +117,14 @@ swarm_pass_flops_total{model="sdxl"} 4.2e+12
 swarm_pass_mfu{model="sdxl",geometry="replicated"} 0.43
 # TYPE swarm_programs_live gauge
 swarm_programs_live{model="sdxl"} 5
+# TYPE swarm_checkpoints_total counter
+swarm_checkpoints_total{outcome="shipped"} 5
+swarm_checkpoints_total{outcome="oversize"} 1
+# TYPE swarm_previews_total counter
+swarm_previews_total{outcome="shipped"} 3
+# TYPE swarm_resume_total counter
+swarm_resume_total{outcome="resumed"} 2
+swarm_resume_total{outcome="fetch_failed"} 1
 """
 
 
@@ -156,6 +171,9 @@ def test_render_hive_and_worker_frames_from_synthetic_data():
     assert "w-fast" not in straggler_line  # healthy workers don't render
     assert "appends_since_compact=7" in lines
     assert "default p50<=1s p95<=1s" in lines
+    # preemption plane (ISSUE 18): checkpoint/preview/resume-offer flow
+    assert ("partials  checkpoints stored=4 superseded=3  "
+            "previews stored=2  resume_offers=1") in lines
 
     worker = tool.Snapshot(
         "http://w:8061",
@@ -190,6 +208,10 @@ def test_render_hive_and_worker_frames_from_synthetic_data():
     # serving-path cost frame (ISSUE 17): analytic TFLOPs served, MFU
     # where the chip has a peak entry, and the live program population
     assert "cost      sdxl=4.20T mfu sdxl/replicated=0.43 programs=5" in lines
+    # preemption tolerance (ISSUE 18): shipped checkpoints (skips and
+    # failures broken out), previews, and resumed passes
+    assert ("resume    checkpoints=5 oversize=1 previews=3 resumed=2 "
+            "resume_degraded=1") in lines
 
     # an unreachable endpoint renders as such instead of raising
     dead = tool.Snapshot("http://gone:1", error="ConnectionError: refused")

@@ -918,6 +918,203 @@ async def scenario_hive_split_brain_fenced() -> str:
             "settled exactly once on the promoted hive")
 
 
+async def scenario_resume_after_worker_kill() -> str:
+    """Preemption tolerance end to end (ISSUE 18 acceptance): worker 1
+    runs a chunked, checkpoint-armed denoise and dies mid-pass PAST a
+    shipped checkpoint (hang_after_checkpoint pins its executor thread
+    right after the upload; the worker is then stopped without drain —
+    the hive-visible signature of a SIGKILL). The hive itself is then
+    SIGKILLed and restarted over the same $SDAAS_ROOT, so the checkpoint
+    must survive via WAL replay + spool. A second worker receives the
+    redelivery WITH the `resume` offer, rehydrates at step >= K, finishes
+    only the remaining steps, and settles EXACTLY once with a gap-free
+    trace timeline and the `resumed` billing stamp."""
+    import json
+    import os
+    import socket
+    import subprocess
+
+    import aiohttp
+
+    from chiaswarm_tpu import telemetry
+    from chiaswarm_tpu.hive_server.trace import trace_missing
+
+    STEPS, CKPT_EVERY = 6, 2
+    faults.configure("hang_after_checkpoint=1", hang_timeout_s=600.0)
+    resumed_metric = telemetry.REGISTRY.get(
+        "swarm_resume_total") or telemetry.counter(
+        "swarm_resume_total", "", ("outcome",))
+    resumed_before = resumed_metric.value(outcome="resumed")
+    token = "chaos"
+    repo = str(pathlib.Path(__file__).resolve().parent.parent)
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+    base_env = dict(os.environ, SDAAS_TOKEN=token,
+                    CHIASWARM_HIVE_PORT=str(port),
+                    PYTHONPATH=repo + os.pathsep
+                    + os.environ.get("PYTHONPATH", ""))
+    uri = f"http://127.0.0.1:{port}"
+    headers = {"Authorization": f"Bearer {token}",
+               "Content-type": "application/json"}
+
+    def spawn(lease_deadline_s: str) -> subprocess.Popen:
+        env = dict(base_env,
+                   CHIASWARM_HIVE_LEASE_DEADLINE_S=lease_deadline_s,
+                   CHIASWARM_HIVE_MAX_REDELIVERIES="5")
+        return subprocess.Popen(
+            [sys.executable, "-m", "chiaswarm_tpu.hive_server"],
+            cwd=repo, env=env,
+            stdout=subprocess.DEVNULL, stderr=subprocess.STDOUT)
+
+    async def wait_up(session) -> bool:
+        for _ in range(200):
+            try:
+                async with session.get(f"{uri}/healthz") as r:
+                    if r.status in (200, 503):
+                        return True
+            except aiohttp.ClientError:
+                pass
+            await asyncio.sleep(0.1)
+        return False
+
+    def worker_settings(name: str) -> Settings:
+        # chunk every step, checkpoint every 2 chunks -> first durable
+        # checkpoint at step K=2 of 6; the env twin reaches the pipeline,
+        # which reads the chunk knob per pass via load_settings
+        return _settings(worker_name=name, denoise_chunk_steps=1,
+                         checkpoint_every_chunks=CKPT_EVERY)
+
+    # the first worker's lease must survive its cold tiny-model compile
+    # (tens of seconds); redelivery speed only matters after the restart
+    os.environ["CHIASWARM_DENOISE_CHUNK_STEPS"] = "1"
+    procs = [spawn("600.0")]
+    w1 = w2 = runner1 = runner2 = None
+    plan = faults.get_plan()
+    try:
+        async with aiohttp.ClientSession() as session:
+            _check(await wait_up(session),
+                   "hive subprocess never answered /healthz")
+            job = {"id": "chaos-resume", "workflow": "txt2img",
+                   "model_name": "stabilityai/stable-diffusion-2-1",
+                   "prompt": "preempted mid-denoise", "seed": 9100,
+                   "height": 64, "width": 64,
+                   "num_inference_steps": STEPS,
+                   "parameters": {"test_tiny_model": True}}
+            async with session.post(f"{uri}/api/jobs",
+                                    data=json.dumps(job),
+                                    headers=headers) as r:
+                _check(r.status == 200, f"submit failed: {r.status}")
+
+            w1 = Worker(settings=worker_settings("chaos-ckpt-w1"),
+                        allocator=SliceAllocator(chips_per_job=0),
+                        hive_uri=f"{uri}/api")
+            runner1 = asyncio.create_task(w1.run())
+
+            async def trace_events() -> list[dict]:
+                async with session.get(f"{uri}/api/jobs/chaos-resume/trace",
+                                       headers=headers) as r:
+                    if r.status != 200:
+                        return []
+                    return (await r.json()).get("events", [])
+
+            async def checkpoint_durable() -> bool:
+                return any(e["event"] == "checkpoint"
+                           for e in await trace_events())
+
+            deadline = asyncio.get_running_loop().time() + 240.0
+            while not (await checkpoint_durable() and plan.hanging == 1):
+                _check(asyncio.get_running_loop().time() < deadline,
+                       "worker 1 never shipped a checkpoint (fired="
+                       f"{plan.fired('hang_after_checkpoint')})")
+                await asyncio.sleep(0.1)
+
+            # worker 1 'dies' holding the lease: stopped without drain,
+            # its denoise thread pinned mid-pass — from the hive's side
+            # this is a SIGKILL (no result, no release, lease orphaned)
+            w1.stop()
+            await asyncio.wait_for(
+                asyncio.gather(runner1, return_exceptions=True), 10)
+            runner1 = None
+
+            procs[0].kill()  # SIGKILL: no drain, no flush
+            procs[0].wait()
+            # restart over the same $SDAAS_ROOT with a short deadline so
+            # the recovered (dead) lease expires promptly
+            procs.append(spawn("3.0"))
+            _check(await wait_up(session),
+                   "restarted hive never answered /healthz")
+            _check(await checkpoint_durable(),
+                   "checkpoint event lost across the hive SIGKILL (WAL)")
+
+            # worker 2 rehydrates and finishes only the remaining steps
+            w2 = Worker(settings=worker_settings("chaos-ckpt-w2"),
+                        allocator=SliceAllocator(chips_per_job=0),
+                        hive_uri=f"{uri}/api")
+            runner2 = asyncio.create_task(w2.run())
+
+            status = {}
+            deadline = asyncio.get_running_loop().time() + 240.0
+            while status.get("status") != "done":
+                _check(asyncio.get_running_loop().time() < deadline,
+                       f"job never settled after the restart: {status}")
+                _check(status.get("status") != "failed",
+                       f"job failed: {status.get('error')}")
+                async with session.get(f"{uri}/api/jobs/chaos-resume",
+                                       headers=headers) as r:
+                    _check(r.status == 200,
+                           f"job lost across the restart ({r.status})")
+                    status = await r.json()
+                await asyncio.sleep(0.1)
+
+            _check(status["completed_by"] == "chaos-ckpt-w2",
+                   f"finished by {status['completed_by']}, not worker 2")
+            _check(status["attempts"] >= 2,
+                   "the redelivery attempt was not recorded")
+            resumed = status["result"]["pipeline_config"].get("resumed")
+            _check(resumed is not None,
+                   "resumed billing stamp missing from the envelope")
+            _check(resumed["from_step"] >= CKPT_EVERY,
+                   f"resumed from step {resumed['from_step']}, before the "
+                   f"checkpointed step {CKPT_EVERY}")
+            _check(resumed["from_step"] + resumed["recomputed_steps"]
+                   == STEPS, f"billing stamp inconsistent: {resumed}")
+            _check(resumed_metric.value(
+                       outcome="resumed") == resumed_before + 1,
+                   "worker 2 never counted a rehydrated pass")
+
+            # exactly-once settle with a gap-free timeline spanning the
+            # worker death, the hive SIGKILL, and the resume
+            async with session.get(f"{uri}/api/jobs/chaos-resume/trace",
+                                   headers=headers) as r:
+                _check(r.status == 200, f"trace answered {r.status}")
+                trace = await r.json()
+            missing = trace_missing(trace)
+            _check(not missing, f"timeline incomplete: {missing}")
+            kinds = [e["event"] for e in trace["events"]]
+            _check(kinds.count("settle") == 1,
+                   f"job did not settle exactly once: {kinds}")
+            _check(kinds.count("checkpoint") >= 1
+                   and kinds.count("resume_offer") >= 1
+                   and kinds.count("redeliver") >= 1,
+                   f"checkpoint/resume events missing from: {kinds}")
+    finally:
+        os.environ.pop("CHIASWARM_DENOISE_CHUNK_STEPS", None)
+        for worker, runner in ((w1, runner1), (w2, runner2)):
+            if worker is not None:
+                worker.stop()
+            if runner is not None:
+                await asyncio.wait_for(
+                    asyncio.gather(runner, return_exceptions=True), 10)
+        for proc in procs:
+            proc.kill()
+            proc.wait()
+        plan.release_hangs()  # unstick worker 1's orphaned thread
+    return (f"worker killed past checkpoint K={CKPT_EVERY}; hive SIGKILL "
+            f"survived; second worker resumed from step "
+            f"{resumed['from_step']} and settled exactly once")
+
+
 SCENARIOS = {
     "drop_submit": scenario_drop_submit,
     "hive_connection_drop": scenario_hive_connection_drop,
@@ -931,6 +1128,7 @@ SCENARIOS = {
     "usage_survives_restart": scenario_usage_survives_restart,
     "hive_failover": scenario_hive_failover,
     "hive_split_brain_fenced": scenario_hive_split_brain_fenced,
+    "resume_after_worker_kill": scenario_resume_after_worker_kill,
 }
 
 

@@ -292,6 +292,18 @@ def hive_summary(samples) -> dict:
             samples, "swarm_hive_queue_wait_seconds"),
         "dispatch_to_settle": _class_quantiles(
             samples, "swarm_hive_dispatch_to_settle_seconds"),
+        # preemption tolerance (ISSUE 18): mid-pass checkpoint blobs,
+        # progressive-preview artifacts, resume offers on redelivery
+        "partials": {
+            "checkpoints": {k: int(v) for k, v in sorted(_label_counts(
+                samples, "swarm_hive_checkpoints_total",
+                "outcome").items())},
+            "previews": {k: int(v) for k, v in sorted(_label_counts(
+                samples, "swarm_hive_previews_total", "outcome").items())},
+            "resume_offers": next(
+                (int(v) for m, _, v in samples
+                 if m == "swarm_hive_resume_offers_total"), 0),
+        },
     }
 
 
@@ -349,6 +361,18 @@ def render_hive_tables(summary: dict) -> str:
     if summary["results"]:
         lines.append("hive results  " + " ".join(
             f"{s}={n}" for s, n in summary["results"].items()))
+    partials = summary.get("partials") or {}
+    if (partials.get("checkpoints") or partials.get("previews")
+            or partials.get("resume_offers")):
+        bits = []
+        if partials.get("checkpoints"):
+            bits.append("checkpoints " + " ".join(
+                f"{o}={n}" for o, n in partials["checkpoints"].items()))
+        if partials.get("previews"):
+            bits.append("previews " + " ".join(
+                f"{o}={n}" for o, n in partials["previews"].items()))
+        bits.append(f"resume_offers={partials.get('resume_offers', 0)}")
+        lines.append("hive partials " + "  ".join(bits))
 
     for key, title in (("queue_wait", "hive queue wait"),
                        ("dispatch_to_settle", "hive dispatch->settle")):
@@ -550,6 +574,37 @@ def cost_line(samples) -> str | None:
     return " ".join(parts)
 
 
+def resume_summary(samples) -> dict | None:
+    """Preemption-tolerance summary (ISSUE 18): mid-pass checkpoints
+    shipped at chunk boundaries, preview frames decoded, and redelivered
+    passes that resumed from a checkpoint instead of recomputing. None
+    when the feature never engaged (checkpoint_every_chunks = 0, or no
+    chunked pass ever ran)."""
+    ckpts = _label_counts(samples, "swarm_checkpoints_total", "outcome")
+    previews = _label_counts(samples, "swarm_previews_total", "outcome")
+    resumes = _label_counts(samples, "swarm_resume_total", "outcome")
+    if not ckpts and not previews and not resumes:
+        return None
+    return {
+        "checkpoints": {k: int(v) for k, v in sorted(ckpts.items())},
+        "previews": {k: int(v) for k, v in sorted(previews.items())},
+        "resumes": {k: int(v) for k, v in sorted(resumes.items())},
+    }
+
+
+def resume_line(samples) -> str | None:
+    """Human-readable twin of resume_summary."""
+    summary = resume_summary(samples)
+    if summary is None:
+        return None
+    parts = []
+    for key in ("checkpoints", "previews", "resumes"):
+        if summary[key]:
+            parts.append(f"{key} " + " ".join(
+                f"{o}={n}" for o, n in summary[key].items()))
+    return "resume         " + "  ".join(parts)
+
+
 async def _run_smoke_job() -> None:
     """One tiny-model txt2img job through the REAL worker path (the same
     code a hive job takes minus the HTTP hop), populating the stage spans."""
@@ -675,6 +730,7 @@ def main(argv: list[str] | None = None) -> int:
         "lora": lora_summary(samples),
         "geometry": geometry_summary(samples),
         "cost": cost_summary(samples),
+        "resume": resume_summary(samples),
         "healthz": health,
     }
     if args.json:
@@ -693,6 +749,9 @@ def main(argv: list[str] | None = None) -> int:
         cost = cost_line(samples)
         if cost:
             print(cost)
+        resume = resume_line(samples)
+        if resume:
+            print(resume)
     return 0 if rows else 1
 
 

@@ -229,6 +229,23 @@ def render_hive(cur: Snapshot, prev: Snapshot | None) -> list[str]:
         lines.append("  results   " + " ".join(
             f"{s}={int(n)}" for s, n in sorted(results.items())))
 
+    # preemption plane (ISSUE 18): durable mid-pass checkpoint blobs,
+    # progressive-preview artifacts, and resume offers extended to
+    # capable workers on redelivery
+    ckpts = cur.counters("swarm_hive_checkpoints_total", "outcome")
+    previews = cur.counters("swarm_hive_previews_total", "outcome")
+    offers = cur.gauge("swarm_hive_resume_offers_total")
+    if ckpts or previews or offers:
+        parts = []
+        if ckpts:
+            parts.append("checkpoints " + " ".join(
+                f"{o}={int(n)}" for o, n in sorted(ckpts.items())))
+        if previews:
+            parts.append("previews " + " ".join(
+                f"{o}={int(n)}" for o, n in sorted(previews.items())))
+        parts.append(f"resume_offers={int(offers or 0)}")
+        lines.append("  partials  " + "  ".join(parts))
+
     # fleet observability plane (ISSUE 11): top-K tenants by
     # chip-seconds (the hive folds the rest into 'other'), per-class SLO
     # compliance + burn rate, and the worst straggler worker
@@ -401,6 +418,30 @@ def render_worker(cur: Snapshot, prev: Snapshot | None) -> list[str]:
         live = sum(cur.counters("swarm_programs_live", "model").values())
         lines.append(
             f"  cost      {' '.join(bits)}{mfu_bit} programs={int(live)}")
+
+    # preemption tolerance (ISSUE 18): mid-pass checkpoints shipped at
+    # chunk boundaries, preview frames decoded, and redelivered passes
+    # that actually resumed from a checkpoint instead of recomputing
+    ckpts = cur.counters("swarm_checkpoints_total", "outcome")
+    previews = cur.counters("swarm_previews_total", "outcome")
+    resumes = cur.counters("swarm_resume_total", "outcome")
+    if ckpts or previews or resumes:
+        dt = (cur.taken - prev.taken) if prev else 0.0
+        pck = prev.counters(
+            "swarm_checkpoints_total", "outcome") if prev else {}
+        shipped = ckpts.get("shipped", 0.0)
+        parts = [f"checkpoints={int(shipped)}"
+                 f"{rate(shipped, pck.get('shipped'), dt)}"]
+        for outcome in ("oversize", "error"):
+            if ckpts.get(outcome):
+                parts.append(f"{outcome}={int(ckpts[outcome])}")
+        parts.append(f"previews={int(previews.get('shipped', 0))}")
+        parts.append(f"resumed={int(resumes.get('resumed', 0))}")
+        degraded = (resumes.get("fetch_failed", 0.0)
+                    + resumes.get("unpack_failed", 0.0))
+        if degraded:
+            parts.append(f"resume_degraded={int(degraded)}")
+        lines.append("  resume    " + " ".join(parts))
 
     # per-stage latency over the last interval (cumulative in --once)
     stages: dict[str, dict[float, float]] = {}
