@@ -47,8 +47,18 @@ _BATCHABLE_I2I_PIPELINE_TYPES = {
     "AutoPipelineForImage2Image",
 }
 
-# families with a run_batched entry (pipelines/stable_diffusion.py)
-_BATCHABLE_FAMILIES = {"sd", "sdxl"}
+# families with a run_batched entry (pipelines/stable_diffusion.py for
+# the UNet families; pipelines/flux.py since ISSUE 20)
+_BATCHABLE_FAMILIES = {"sd", "sdxl", "flux"}
+
+# txt2img wire names the coalesced flux pass reproduces exactly (plain
+# prompt-conditioned rectified-flow denoise + decode; no CFG doubling)
+_BATCHABLE_FLUX_PIPELINE_TYPES = {
+    None,
+    "DiffusionPipeline",
+    "FluxPipeline",
+    "AutoPipelineForText2Image",
+}
 
 # job-level keys that mean per-job structure the padded batch can't carry
 # (start_image_uri and strength are handled per-workflow: txt2img refuses
@@ -96,6 +106,34 @@ DEFAULT_STEPS = 30
 DEFAULT_GUIDANCE = 7.5
 DEFAULT_SCHEDULER = "DPMSolverMultistepScheduler"
 DEFAULT_STRENGTH = 0.75
+
+# --- stage-graph vocabulary (ISSUE 20) -------------------------------
+# Stage-typed placement needs one spelling of stage names on BOTH sides
+# of the wire: the hive's dispatcher gates hand-outs on the stages a
+# worker advertises, and the worker derives its advertisement (and its
+# local routing — chip slice vs. the jax-free stage executor) from the
+# same sets. Chip stages run accelerator programs; CPU stages are
+# jax-free host work (prompt/conditioning prep, NSFW check + packaging)
+# that can land on a chip-less host.
+
+CHIP_STAGES = frozenset({
+    "denoise", "upscale", "svd", "i2vgen", "txt2vid", "vid2vid", "audio",
+})
+CPU_STAGES = frozenset({
+    "encode", "decode", "postprocess", "stitch", "caption",
+})
+
+
+def stage_of(job: dict) -> str | None:
+    """The stage name a stage-job carries, or None for a monolithic job.
+    The `stage` context dict is stamped by the hive's workflow expander
+    (hive_server/dag.py); its absence IS the monolithic path."""
+    stage = job.get("stage")
+    if isinstance(stage, dict):
+        name = stage.get("name")
+        if isinstance(name, str) and name:
+            return name
+    return None
 
 
 def is_interactive(job: dict) -> bool:
@@ -324,6 +362,15 @@ def coalesce_key(job: dict) -> tuple | None:
         workflow = job.get("workflow")
         if workflow not in ("txt2img", "img2img"):
             return None
+        # stage-jobs (ISSUE 20): only the denoise stage is the padded
+        # jitted program; it coalesces with OTHER denoise stages but
+        # never with monolithic jobs (the envelopes differ — a denoise
+        # stage hands off raw rows instead of packaged outputs), so the
+        # stage name is a key dimension. Every other stage is host work
+        # on the single path.
+        stage = stage_of(job)
+        if stage is not None and stage != "denoise":
+            return None
         model = job.get("model_name")
         if not isinstance(model, str) or not model:
             return None
@@ -354,6 +401,25 @@ def coalesce_key(job: dict) -> tuple | None:
         family = _auto_family(model)
         if family not in _BATCHABLE_FAMILIES:
             return None
+        if family == "flux":
+            # flow-matching txt2img only: no CFG pair, no adapter delta
+            # path, no ControlNet branch in the MMDiT program. Steps and
+            # guidance must be EXPLICIT — the solo path's defaults are
+            # model-variant-dependent (schnell distills to 4 steps,
+            # guidance 3.5 vs the UNet families' 7.5), which this
+            # jax-free key cannot reproduce without guessing.
+            if workflow != "txt2img" or cn is not None \
+                    or adapter_ref(job) is not None:
+                return None
+            if params.get("pipeline_type") \
+                    not in _BATCHABLE_FLUX_PIPELINE_TYPES:
+                return None
+            if params.get("num_inference_steps",
+                          job.get("num_inference_steps")) is None:
+                return None
+            if params.get("guidance_scale",
+                          job.get("guidance_scale")) is None:
+                return None
 
         # canvas: explicit dims, else the model-pinned default the
         # formatter would apply; jobs relying on the family default share
@@ -372,8 +438,9 @@ def coalesce_key(job: dict) -> tuple | None:
             if "start_image_uri" in job or "strength" in job:
                 return None
             # the shared-ControlNet component validated its own pipeline
-            # types; a plain txt2img job keeps the original gate
-            if cn is None and (
+            # types, and the flux branch above validated flux wire
+            # names; a plain txt2img job keeps the original gate
+            if cn is None and family != "flux" and (
                     params.get("pipeline_type")
                     not in _BATCHABLE_PIPELINE_TYPES):
                 return None
@@ -408,7 +475,8 @@ def coalesce_key(job: dict) -> tuple | None:
         # large_model flips the SD-vs-SDXL default pipeline class
         large = bool(params.get("large_model", False))
         return (model, family, height, width, steps, scheduler, guidance,
-                karras, tiny, large, workflow, strength, adapter, cn)
+                karras, tiny, large, workflow, strength, adapter, cn,
+                stage)
     except (TypeError, ValueError):
         # hive-controlled values that don't parse: let the single-job
         # path produce its usual fatal envelope for them

@@ -515,6 +515,33 @@ class HiveClient:
             _REQUEST_SECONDS.observe(
                 time.perf_counter() - t0, endpoint="artifact")
 
+    async def submit_workflow(self, payload: dict) -> dict:
+        """POST one multi-stage workflow to ``/api/workflows`` (ISSUE 20).
+        Single attempt against the pinned hive — the submit ACK is cheap
+        to retry at the caller's policy, unlike a result envelope.
+        Raises on any non-2xx."""
+        uri = self.hive_uri
+        session = await self._get_session()
+        timeout = aiohttp.ClientTimeout(total=SUBMIT_TIMEOUT_S)
+        t0 = time.perf_counter()
+        try:
+            async with session.post(
+                f"{uri}/workflows",
+                data=json.dumps(payload),
+                headers=self._headers(),
+                timeout=timeout,
+            ) as response:
+                self._note_epoch(response)
+                response.raise_for_status()
+                self._note_success()
+                return await response.json()
+        except Exception as e:
+            self._note_request_failure("workflows", uri, e)
+            raise
+        finally:
+            _REQUEST_SECONDS.observe(
+                time.perf_counter() - t0, endpoint="workflows")
+
     async def post_partial(self, kind: str, job_id: str,
                            payload: dict) -> dict | None:
         """POST one mid-pass partial (`kind` is ``checkpoint`` or

@@ -44,6 +44,7 @@ from aiohttp import web
 from .. import faults, telemetry
 from ..settings import Settings, get_settings_dir, load_settings, resolve_path
 from . import accounting
+from .dag import DagTable, WorkflowError
 from .dispatch import Dispatcher, WorkerDirectory
 from .fleet import FleetStats
 from .slo import SLOEngine, parse_slo
@@ -53,6 +54,7 @@ from .journal import (
     ev_admit,
     ev_cancel,
     ev_checkpoint,
+    ev_dag,
     ev_expire,
     ev_lease,
     ev_park,
@@ -62,7 +64,8 @@ from .journal import (
     snapshot_events,
 )
 from .leases import LeaseTable
-from .queue import PriorityJobQueue, QueueFull, parse_shed_watermarks
+from .queue import (PriorityJobQueue, QueueFull, job_class,
+                    parse_shed_watermarks)
 from .spool import ArtifactSpool
 from .trace import (
     build_shed_trace,
@@ -161,6 +164,11 @@ class HiveServer:
             slow_window_s=float(g("hive_slo_slow_window_s", 600.0)))
         self.fleet = FleetStats(factor=float(g("hive_straggler_factor", 2.5)))
         self.queue, self.leases = self._new_state()
+        # workflow graphs (ISSUE 20): stage-jobs live in the queue as
+        # ordinary records; the dag table only owns the edges between
+        # them and the parent aggregation — reset alongside the queue on
+        # a replication reset (see replication._reset_state)
+        self.dag = self._new_dag()
         self.directory = WorkerDirectory(
             ttl_s=float(g("hive_worker_ttl_s", 45.0)), fleet=self.fleet)
         # flap detection (ISSUE 18): the dispatcher queries the LIVE
@@ -204,19 +212,29 @@ class HiveServer:
                 compact_every=int(g("hive_wal_compact_every", 512)))
             events = self.journal.recover()
             if events:
-                self.recovery = apply_events(events, self.queue, self.leases)
+                self.recovery = apply_events(
+                    events, self.queue, self.leases, dag=self.dag)
                 self.epoch = max(
                     self.epoch, int(self.recovery.get("epoch", 0)))
                 logger.warning(
                     "hive WAL replayed %d event(s) -> %s (recovered leases "
                     "get a fresh %gs deadline)", len(events), self.recovery,
                     self.leases.deadline_s)
+                # repair the graph edges against the replayed records: a
+                # crash between a stage settle and its ev_dag append left
+                # the workflow behind its own stages — re-derive states
+                # and re-admit ready successors (deterministic stage ids
+                # make this exactly-once)
+                for readmitted in self.dag.reconcile(self.queue):
+                    self._journal(ev_admit(readmitted))
             # compact now: the stream shrinks to live state, and a
             # crash-restart-crash loop cannot grow it without bound
             self.journal.compact(
-                snapshot_events(self.queue, self.leases, self.epoch))
+                snapshot_events(self.queue, self.leases, self.epoch,
+                                dag=self.dag))
             self.journal.snapshot_fn = (
-                lambda: snapshot_events(self.queue, self.leases, self.epoch))
+                lambda: snapshot_events(self.queue, self.leases, self.epoch,
+                                        dag=self.dag))
         # leased-job cancels awaiting their lessee's next poll:
         # worker name -> job ids, delivered as the /work reply's
         # `cancels` piggyback. Volatile by design (the durable fact is
@@ -286,6 +304,11 @@ class HiveServer:
         queue.slo = self.slo
         return queue, leases
 
+    def _new_dag(self) -> DagTable:
+        g = lambda name, default: getattr(self.settings, name, default)  # noqa: E731
+        return DagTable(self.queue.clock,
+                        history_limit=int(g("hive_dag_history", 256)))
+
     # --- lifecycle ---
 
     @property
@@ -302,6 +325,11 @@ class HiveServer:
         app.router.add_post("/api/results", self._results)
         app.router.add_get("/api/models", self._models)
         app.router.add_post("/api/jobs", self._submit)
+        app.router.add_post("/api/workflows", self._workflow_submit)
+        app.router.add_get("/api/workflows/{workflow_id}",
+                           self._workflow_status)
+        app.router.add_get("/api/workflows/{workflow_id}/trace",
+                           self._workflow_trace)
         app.router.add_post("/api/jobs/{job_id}/cancel", self._cancel)
         app.router.add_post("/api/jobs/{job_id}/checkpoint", self._checkpoint)
         app.router.add_post("/api/jobs/{job_id}/preview", self._preview)
@@ -381,6 +409,7 @@ class HiveServer:
                         self._journal(ev_park(record))
                         for pruned in self.queue.retire(record):
                             self._journal(ev_retire(pruned))
+                        self._note_stage_terminal(record, "failed")
                         logger.error("job %s failed: %s",
                                      record.job_id, record.error)
                     else:
@@ -433,6 +462,7 @@ class HiveServer:
             self._journal(ev_park(record))
             for pruned in self.queue.retire(record):
                 self._journal(ev_retire(pruned))
+            self._note_stage_terminal(record, "failed")
             _JOBS_FAILED.inc()
             logger.error("job %s failed: %s", record.job_id, record.error)
 
@@ -744,6 +774,17 @@ class HiveServer:
         self._journal(ev_settle(record))
         for pruned in self.queue.retire(record):
             self._journal(ev_retire(pruned))
+        # stage-graph advance (ISSUE 20): a settled stage-job admits its
+        # ready successors (with the settled stage's spool artifacts
+        # injected as handoff inputs) and may complete the workflow;
+        # records journal before the graph so replay never restores a
+        # graph pointing at jobs the WAL has not admitted yet. A
+        # monolithic job returns (None, []) and journals nothing extra.
+        wf, stage_admitted = self.dag.note_settle(record, self.queue)
+        if wf is not None:
+            for stage_record in stage_admitted:
+                self._journal(ev_admit(stage_record))
+            self._journal(ev_dag(wf))
         # tenant accounting (accounting.py): bill this settle. An
         # envelope with no usable stage timings (older worker, a parked-
         # then-requeued outbox redelivery) is billed its wall-clock
@@ -808,6 +849,7 @@ class HiveServer:
             self._journal(ev_cancel(record))
             for pruned in self.queue.retire(record):
                 self._journal(ev_retire(pruned))
+            self._note_stage_terminal(record, "cancelled")
             logger.info("job %s cancelled while queued", job_id)
             return reply(True)
         # leased: revoke the lease (the reaper must not redeliver a job
@@ -819,6 +861,7 @@ class HiveServer:
         self._journal(ev_cancel(record))
         for pruned in self.queue.retire(record):
             self._journal(ev_retire(pruned))
+        self._note_stage_terminal(record, "cancelled")
         if record.worker:
             self._cancel_notify.setdefault(
                 record.worker, set()).add(job_id)
@@ -830,6 +873,22 @@ class HiveServer:
         return reply(True)
 
     # --- mid-pass durability (ISSUE 18) ---
+
+    def _note_stage_terminal(self, record, outcome: str) -> None:
+        """Stage-graph fail-closed (ISSUE 20): a stage-job that ended
+        without settling (cancelled / expired / parked failed) fails its
+        workflow — blocked descendants never admit, still-queued siblings
+        are cancelled and journaled here, and the updated graph state
+        rides ONE ev_dag. No-op for monolithic jobs."""
+        wf, cascaded = self.dag.note_terminal(record, outcome, self.queue)
+        if wf is None:
+            return
+        for sibling in cascaded:
+            self._drop_partials(sibling)
+            self._journal(ev_cancel(sibling))
+            for pruned in self.queue.retire(sibling):
+                self._journal(ev_retire(pruned))
+        self._journal(ev_dag(wf))
 
     def _drop_partials(self, record) -> None:
         """Terminal states keep no mid-pass state: clear the record's
@@ -953,6 +1012,7 @@ class HiveServer:
             self._journal(ev_expire(record))
             for pruned in self.queue.retire(record):
                 self._journal(ev_retire(pruned))
+            self._note_stage_terminal(record, "expired")
             logger.warning("job %s expired after %.0fs queued (TTL)",
                            record.job_id,
                            self.queue.clock.mono() - record.submitted_at)
@@ -1007,6 +1067,76 @@ class HiveServer:
             "status": record.state,
             "depth": self.queue.depth,
         })
+
+    async def _workflow_submit(self, request: web.Request) -> web.Response:
+        """POST /api/workflows: expand a multi-stage submission into its
+        stage-job DAG (hive_server/dag.py). The ready stages are admitted
+        immediately as ordinary records; successors admit as their needs
+        settle. WAL order is records-then-graph (ev_admit per stage, then
+        ONE ev_dag carrying the whole workflow state) so replay always
+        sees the jobs a restored graph refers to; the reconcile pass in
+        __init__ repairs a crash that landed between the two."""
+        if not self._authorized(request):
+            return self._unauthorized()
+        refused = self._refused(request)
+        if refused is not None:
+            return refused
+        try:
+            payload = json.loads(await request.text())
+        except json.JSONDecodeError:
+            return web.json_response(
+                {"message": "workflow is not JSON"}, status=400)
+        if not isinstance(payload, dict):
+            return web.json_response(
+                {"message": "workflow must be a JSON object"}, status=400)
+        try:
+            wf, admitted = self.dag.submit(payload, self.queue)
+        except WorkflowError as e:
+            return web.json_response({"message": str(e)}, status=400)
+        except QueueFull as e:
+            return web.json_response({"message": str(e)}, status=429)
+        for record in admitted:
+            self._journal(ev_admit(record))
+        # unconditional: an idempotent resubmit re-appends the same graph
+        # state, and restore-by-replacement makes that a no-op on replay
+        self._journal(ev_dag(wf))
+        return web.json_response({
+            "id": wf.workflow_id,
+            "workflow": wf.job.get("workflow"),
+            "class": job_class(wf.job),
+            "tenant": wf.tenant,
+            "status": wf.state,
+            "stages": [{"stage": s["name"], "index": s["index"],
+                        "id": s["job_id"], "status": s["state"]}
+                       for s in wf.stages],
+            "depth": self.queue.depth,
+        }, headers=self._epoch_headers())
+
+    async def _workflow_status(self, request: web.Request) -> web.Response:
+        """GET /api/workflows/{id}: the parent aggregation — per-stage
+        lifecycle + attempts + worker, the pooled usage totals, and (once
+        done) the final stage's result envelope."""
+        if not self._authorized(request):
+            return self._unauthorized()
+        wf = self.dag.workflows.get(request.match_info["workflow_id"])
+        if wf is None:
+            return web.json_response(
+                {"message": "unknown workflow id"}, status=404)
+        return web.json_response(self.dag.status(wf, self.queue))
+
+    async def _workflow_trace(self, request: web.Request) -> web.Response:
+        """GET /api/workflows/{id}/trace: every stage's timeline merged
+        on one wall clock, with the settle->admit seams attributed as
+        `stage_handoff` — shaped to pass the same trace_missing oracle a
+        monolithic trace does."""
+        if not self._authorized(request):
+            return self._unauthorized()
+        wf = self.dag.workflows.get(request.match_info["workflow_id"])
+        if wf is None:
+            return web.json_response(
+                {"message": "unknown workflow id"}, status=404)
+        return web.json_response(
+            self.dag.build_trace(wf, self.queue, self.queue.clock.wall()))
 
     async def _job_status(self, request: web.Request) -> web.Response:
         if not self._authorized(request):
@@ -1164,6 +1294,9 @@ class HiveServer:
             "queue_depth": self.queue.depths(),
             "leases_active": len(self.leases),
             "jobs": states,
+            # stage-graph serving (ISSUE 20): workflow counts by state +
+            # ready-stage depth — the swarm_top `workflows` line
+            "workflows": self.dag.summary(),
             "workers": self.directory.snapshot(),
             # fleet observability plane (ISSUE 11): compact SLO verdict
             # per class, straggler flags per live reporter, and the

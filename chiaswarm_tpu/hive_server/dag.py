@@ -1,0 +1,602 @@
+"""Stage-graph micro-serving: hive-visible DAG jobs (ISSUE 20).
+
+The swarm's serving plane already had every seam of a disaggregated
+pipeline — encode is cache-backed, denoise is a chunked/checkpointed
+program, decode/postprocess are separate trace spans — but the hive
+still served each request as ONE monolithic lease. This module turns a
+workflow submission (``POST /api/workflows``) into a DAG of
+**stage-jobs**: each stage is a real :class:`~.queue.JobRecord` with its
+own lease, class, timeline, SLO attribution, and cancel/TTL semantics,
+so every existing mechanism (gang dispatch, WAL durability, tracing,
+accounting) applies per stage with no special cases. Stage outputs hand
+off as content-addressed spool artifacts (the settle path already
+stores them), successors are admitted the moment their needs settle,
+and the parent workflow id aggregates status/trace/usage across its
+stages.
+
+Durability: the graph itself rides the WAL as the ``ev_dag`` event
+(journal.py) — the FULL workflow state, restored by replacement exactly
+like ``ev_checkpoint``. Stage-job ids are deterministic
+(``<workflow>-s<index>-<name>``), so queue-level id dedup makes stage
+admission exactly-once across SIGKILL replay, compaction, and standby
+promotion; :meth:`DagTable.reconcile` re-admits any ready stage whose
+admission was lost between a settle and the matching ``ev_dag`` append.
+
+Placement: stage NAMES are the dispatch vocabulary (``coalesce.py``
+owns it, shared with the worker). Chip stages (denoise, upscale, svd)
+only land on hosts advertising chips; encode/decode/postprocess can
+land on a jax-free host. A worker that never advertises ``stages`` on
+/work sees only monolithic jobs — legacy-poller opacity.
+
+jax-free by design (SW001): a chip-less coordinator imports this.
+"""
+
+from __future__ import annotations
+
+import uuid
+
+from .. import telemetry
+from ..coalesce import CHIP_STAGES, stage_of  # noqa: F401  (re-exported)
+from . import accounting
+from .queue import job_class
+from .trace import _GAP_LABELS, worker_stages
+
+_STAGES = telemetry.counter(
+    "swarm_hive_dag_stages_total",
+    "Stage-job lifecycle outcomes across all workflows",
+    ("stage", "outcome"))
+_READY = telemetry.gauge(
+    "swarm_hive_dag_ready_depth",
+    "Stage-jobs admitted (deps satisfied) but not yet settled")
+_WORKFLOWS = telemetry.gauge(
+    "swarm_hive_dag_workflows",
+    "Workflows the hive currently tracks, by aggregate state",
+    ("state",))
+_STAGE_WAIT = telemetry.histogram(
+    "swarm_hive_dag_stage_queue_wait_seconds",
+    "Per-stage queue wait (admit -> first dispatch), labelled by stage",
+    ("stage",))
+
+# identity keys every stage-job inherits from the workflow submission so
+# class/tenant/TTL semantics attribute per stage with no special cases
+_INHERITED_KEYS = ("tenant", "priority", "sdaas_priority", "ttl_s")
+
+# payload keys that are workflow-graph structure, never stage-job content
+_GRAPH_KEYS = ("id", "stages", "links", "image_stage")
+
+_DEFAULT_IMAGE_MODEL = "stabilityai/stable-diffusion-2-1"
+
+# stage name a monolithic wire workflow maps to in explicit chains
+_WORKFLOW_STAGE_NAMES = {
+    "txt2img": "denoise", "img2img": "denoise", "inpaint": "denoise",
+    "upscale": "upscale", "img2vid": "svd", "txt2vid": "txt2vid",
+    "vid2vid": "vid2vid", "txt2audio": "audio", "stitch": "stitch",
+    "img2txt": "caption", "echo": "postprocess",
+}
+
+_TERMINAL = ("done", "failed", "cancelled", "expired")
+
+
+class WorkflowError(ValueError):
+    """A workflow submission the expander refuses (400 on the wire)."""
+
+
+def _stage_id(workflow_id: str, index: int, name: str) -> str:
+    """Deterministic stage-job id: the same workflow replayed after a
+    crash admits the same ids, so queue-level dedup is the exactly-once
+    mechanism."""
+    return f"{workflow_id}-s{index}-{name}"
+
+
+def _inherit(payload: dict) -> dict:
+    return {k: payload[k] for k in _INHERITED_KEYS if k in payload}
+
+
+def _stage(workflow_id: str, index: int, name: str, needs: list[int],
+           job: dict, handoff: str | None = None) -> dict:
+    job = dict(job)
+    job["id"] = _stage_id(workflow_id, index, name)
+    job["stage"] = {"workflow": workflow_id, "name": name, "index": index,
+                    "needs": list(needs)}
+    if handoff:
+        job["stage"]["handoff"] = handoff
+    return {"name": name, "index": index, "needs": list(needs),
+            "job_id": job["id"], "state": "blocked", "handoff": handoff,
+            "job": job}
+
+
+def _expand_diffusion(payload: dict, workflow_id: str) -> list[dict]:
+    """txt2img (optionally upscale-after-txt2img) -> encode / denoise
+    [/ upscale] / decode. The denoise stage is the parent job verbatim
+    minus the chained-upscale key, so it inherits the gang/coalesce/
+    adapter-affinity machinery unchanged; encode and decode are
+    jax-free-capable."""
+    base = {k: v for k, v in payload.items() if k not in _GRAPH_KEYS}
+    model = base.get("model_name")
+    if not isinstance(model, str) or not model:
+        raise WorkflowError("workflow needs a model_name")
+    stages: list[dict] = []
+    encode_job = {
+        "workflow": base.get("workflow", "txt2img"), "model_name": model,
+        "prompt": base.get("prompt", ""),
+        "negative_prompt": base.get("negative_prompt", ""),
+        **({"parameters": {"test_tiny_model": True}}
+           if (base.get("parameters") or {}).get("test_tiny_model")
+           or base.get("test_tiny_model") else {}),
+        **_inherit(payload),
+    }
+    stages.append(_stage(workflow_id, 0, "encode", [], encode_job))
+    denoise_job = dict(base)
+    denoise_job.pop("upscale", None)
+    stages.append(_stage(workflow_id, 1, "denoise", [0], denoise_job,
+                         handoff="raw"))
+    prev = 1
+    if base.get("upscale"):
+        upscale_job = {
+            "workflow": base.get("workflow", "txt2img"),
+            "model_name": model, "prompt": base.get("prompt", ""),
+            "upscale": base.get("upscale"),
+            **({"parameters": dict(base["parameters"])}
+               if isinstance(base.get("parameters"), dict) else {}),
+            **_inherit(payload),
+        }
+        stages.append(_stage(workflow_id, 2, "upscale", [1], upscale_job,
+                             handoff="raw"))
+        prev = 2
+    decode_job = {
+        "workflow": base.get("workflow", "txt2img"), "model_name": model,
+        **{k: base[k] for k in ("content_type", "outputs", "nsfw_filter")
+           if k in base},
+        **_inherit(payload),
+    }
+    stages.append(_stage(workflow_id, prev + 1, "decode", [prev],
+                         decode_job, handoff="raw"))
+    return stages
+
+
+def _expand_img2vid(payload: dict, workflow_id: str) -> list[dict]:
+    """img2vid WITHOUT a start image -> the txt2img stage renders it,
+    the svd stage animates it via the spool handoff (ISSUE 20 satellite:
+    the graph path serves more than still images)."""
+    source = payload.get("image_stage")
+    if not isinstance(source, dict):
+        source = {}
+    image_model = source.get("model_name") or _DEFAULT_IMAGE_MODEL
+    prompt = source.get("prompt", payload.get("prompt", ""))
+    encode_job = {
+        "workflow": "txt2img", "model_name": image_model, "prompt": prompt,
+        "negative_prompt": source.get("negative_prompt", ""),
+        **_inherit(payload),
+    }
+    denoise_job = {
+        "workflow": "txt2img", "model_name": image_model, "prompt": prompt,
+        **{k: v for k, v in source.items() if k not in _GRAPH_KEYS},
+        **_inherit(payload),
+    }
+    svd_job = {k: v for k, v in payload.items() if k not in _GRAPH_KEYS}
+    return [
+        _stage(workflow_id, 0, "encode", [], encode_job),
+        _stage(workflow_id, 1, "denoise", [0], denoise_job),
+        _stage(workflow_id, 2, "svd", [1], svd_job, handoff="image"),
+    ]
+
+
+def _expand_explicit(payload: dict, workflow_id: str) -> list[dict]:
+    """Explicit chain: ``stages`` is a list of ordinary wire jobs, each
+    consuming its predecessor's primary artifact (stitch chains, audio
+    chains, anything the templates don't know)."""
+    entries = payload.get("stages")
+    if not isinstance(entries, list) or not entries:
+        raise WorkflowError("stages must be a non-empty list of jobs")
+    stages = []
+    for i, entry in enumerate(entries):
+        if not isinstance(entry, dict):
+            raise WorkflowError(f"stage {i} is not a job object")
+        job = {k: v for k, v in entry.items() if k not in ("id", "stage")}
+        name = str(entry.get("stage_name") or _WORKFLOW_STAGE_NAMES.get(
+            str(entry.get("workflow")), f"stage{i}"))
+        job.pop("stage_name", None)
+        for k in _INHERITED_KEYS:
+            if k in payload:
+                job.setdefault(k, payload[k])
+        needs = [i - 1] if i else []
+        stages.append(_stage(workflow_id, i, name, needs, job,
+                             handoff="image" if i else None))
+    return stages
+
+
+def expand_workflow(payload: dict, workflow_id: str) -> list[dict]:
+    """One workflow submission -> its stage list, or WorkflowError."""
+    if not isinstance(payload, dict):
+        raise WorkflowError("workflow must be a JSON object")
+    if isinstance(payload.get("stages"), list):
+        return _expand_explicit(payload, workflow_id)
+    workflow = payload.get("workflow")
+    if workflow == "img2vid" and not payload.get("start_image_uri"):
+        return _expand_img2vid(payload, workflow_id)
+    if workflow in ("txt2img", "img2img"):
+        return _expand_diffusion(payload, workflow_id)
+    raise WorkflowError(
+        f"workflow {workflow!r} has no stage-graph expansion; submit an "
+        "explicit `stages` list or use POST /api/jobs")
+
+
+class Workflow:
+    """One submitted stage-graph: the parent id, the original payload,
+    and the per-stage states. Serializes losslessly to/from the ev_dag
+    WAL event (plain JSON types only)."""
+
+    def __init__(self, workflow_id: str, job: dict, stages: list[dict],
+                 submitted_wall: float):
+        self.workflow_id = workflow_id
+        self.job = job
+        self.stages = stages
+        self.submitted_wall = submitted_wall
+        self.state = "running"
+        self.done_wall: float | None = None
+
+    @property
+    def tenant(self) -> str:
+        return accounting.tenant_of(self.job)
+
+    def stage(self, index: int) -> dict:
+        return self.stages[index]
+
+    def to_state(self) -> dict:
+        return {
+            "id": self.workflow_id, "job": self.job, "state": self.state,
+            "submitted_wall": self.submitted_wall,
+            "done_wall": self.done_wall,
+            "stages": [dict(s) for s in self.stages],
+        }
+
+    @classmethod
+    def from_state(cls, state: dict) -> "Workflow":
+        wf = cls(str(state.get("id", "")), state.get("job") or {},
+                 [dict(s) for s in (state.get("stages") or [])],
+                 float(state.get("submitted_wall", 0.0)))
+        wf.state = str(state.get("state", "running"))
+        done = state.get("done_wall")
+        wf.done_wall = float(done) if done is not None else None
+        return wf
+
+
+class DagTable:
+    """The hive's workflow graphs. Owns NO job state — stages live in
+    the PriorityJobQueue as ordinary records; this table only tracks the
+    edges between them and aggregates the parent view."""
+
+    def __init__(self, clock, history_limit: int = 256):
+        self.clock = clock
+        self.history_limit = max(int(history_limit), 1)
+        self.workflows: dict[str, Workflow] = {}
+        self.by_stage: dict[str, tuple[str, int]] = {}
+
+    # --- submission -------------------------------------------------
+
+    def submit(self, payload: dict, queue) -> tuple[Workflow, list]:
+        """Expand one workflow submission and admit its ready stages.
+        Returns (workflow, newly admitted records). Raises WorkflowError
+        on a payload the expander refuses; queue.QueueFull propagates
+        (the caller answers 429 and the workflow is not registered)."""
+        workflow_id = str(payload.get("id") or f"wf-{uuid.uuid4().hex[:12]}")
+        existing = self.workflows.get(workflow_id)
+        if existing is not None:
+            return existing, []
+        stages = expand_workflow(payload, workflow_id)
+        wf = Workflow(workflow_id, dict(payload), stages,
+                      round(self.clock.wall(), 3))
+        admitted = self._admit_ready(wf, queue)
+        self.workflows[workflow_id] = wf
+        for s in wf.stages:
+            self.by_stage[s["job_id"]] = (workflow_id, s["index"])
+        self._prune()
+        self._refresh_gauges()
+        return wf, admitted
+
+    def _admit_ready(self, wf: Workflow, queue) -> list:
+        """Admit every blocked stage whose needs are all done. Queue-id
+        dedup makes this idempotent (replay/reconcile safe)."""
+        admitted = []
+        for s in wf.stages:
+            if s["state"] != "blocked":
+                continue
+            if any(wf.stages[n]["state"] != "done" for n in s["needs"]):
+                continue
+            job = dict(s["job"])
+            job["stage"] = dict(job.get("stage") or {})
+            inputs = self._inputs_for(wf, s, queue)
+            if inputs:
+                job["stage"]["inputs"] = inputs
+            known = job["id"] in queue.records
+            record = queue.submit(job)
+            s["state"] = "queued"
+            if not known:
+                admitted.append(record)
+                _STAGES.inc(stage=s["name"], outcome="admitted")
+        return admitted
+
+    def _inputs_for(self, wf: Workflow, stage: dict, queue) -> list[dict]:
+        """Predecessor spool artifacts, injected into the successor's
+        stage context: content-addressed references ({sha256, bytes,
+        href}) the worker rehydrates through its authed artifact
+        client. The handoff is how stage outputs travel — never inline
+        blobs through the queue."""
+        inputs = []
+        for n in stage.get("needs", ()):
+            pred = wf.stages[n]
+            record = queue.records.get(pred["job_id"])
+            artifacts = {}
+            if record is not None and isinstance(record.result, dict):
+                for key, art in (record.result.get("artifacts")
+                                 or {}).items():
+                    if isinstance(art, dict) and art.get("sha256"):
+                        artifacts[key] = {
+                            k: art[k] for k in
+                            ("sha256", "bytes", "href", "content_type")
+                            if k in art}
+            inputs.append({"stage": pred["name"], "index": n,
+                           "artifacts": artifacts})
+        return inputs
+
+    # --- lifecycle hooks (called from the settle/cancel paths) ------
+
+    def workflow_of(self, record) -> Workflow | None:
+        ref = self.by_stage.get(getattr(record, "job_id", None))
+        return self.workflows.get(ref[0]) if ref else None
+
+    def note_settle(self, record, queue) -> tuple[Workflow | None, list]:
+        """A stage-job settled: mark it done, admit newly-ready
+        successors, and finish the workflow when the last stage lands.
+        Returns (workflow, newly admitted records) — (None, []) for a
+        monolithic job."""
+        ref = self.by_stage.get(record.job_id)
+        if ref is None:
+            return None, []
+        wf = self.workflows.get(ref[0])
+        if wf is None:
+            return None, []
+        stage = wf.stage(ref[1])
+        if stage["state"] == "done":
+            return wf, []  # duplicate settle: already advanced
+        stage["state"] = "done"
+        _STAGES.inc(stage=stage["name"], outcome="done")
+        if record.queue_wait_s is not None:
+            _STAGE_WAIT.observe(float(record.queue_wait_s),
+                                stage=stage["name"])
+        admitted = []
+        if wf.state == "running":
+            admitted = self._admit_ready(wf, queue)
+            if all(s["state"] == "done" for s in wf.stages):
+                wf.state = "done"
+                wf.done_wall = round(self.clock.wall(), 3)
+        self._refresh_gauges()
+        return wf, admitted
+
+    def note_terminal(self, record, outcome: str, queue) -> tuple[
+            Workflow | None, list]:
+        """A stage-job ended without settling (cancelled/expired/failed):
+        the workflow fails closed — descendants are never admitted, and
+        still-queued sibling stages are cancelled (returned for the
+        caller to journal). Idempotent."""
+        ref = self.by_stage.get(getattr(record, "job_id", None))
+        if ref is None:
+            return None, []
+        wf = self.workflows.get(ref[0])
+        if wf is None:
+            return None, []
+        stage = wf.stage(ref[1])
+        if stage["state"] in _TERMINAL:
+            return wf, []
+        stage["state"] = outcome if outcome in _TERMINAL else "failed"
+        _STAGES.inc(stage=stage["name"], outcome=stage["state"])
+        cascaded = []
+        if wf.state == "running":
+            wf.state = "cancelled" if outcome == "cancelled" else "failed"
+            wf.done_wall = round(self.clock.wall(), 3)
+            for s in wf.stages:
+                if s["state"] == "blocked":
+                    s["state"] = "cancelled"
+                    _STAGES.inc(stage=s["name"], outcome="cancelled")
+                elif s["state"] == "queued":
+                    sibling = queue.records.get(s["job_id"])
+                    if sibling is not None and sibling.state == "queued":
+                        queue.mark_cancelled(sibling, "queued")
+                        s["state"] = "cancelled"
+                        _STAGES.inc(stage=s["name"], outcome="cancelled")
+                        cascaded.append(sibling)
+        self._refresh_gauges()
+        return wf, cascaded
+
+    # --- recovery ---------------------------------------------------
+
+    def restore(self, state: dict) -> None:
+        """ev_dag replay: restore-by-replacement, like ev_checkpoint.
+        The LAST event for a workflow id wins."""
+        wf = Workflow.from_state(state)
+        if not wf.workflow_id:
+            return
+        old = self.workflows.pop(wf.workflow_id, None)
+        if old is not None:
+            for s in old.stages:
+                self.by_stage.pop(s["job_id"], None)
+        self.workflows[wf.workflow_id] = wf
+        for s in wf.stages:
+            self.by_stage[s["job_id"]] = (wf.workflow_id, s["index"])
+        self._refresh_gauges()
+
+    def reconcile(self, queue) -> list:
+        """Post-replay repair: the WAL may have settled a stage without
+        the matching ev_dag (crash between the two appends). Re-derive
+        stage states from the records and admit any ready stage that is
+        not yet queued — exactly-once via deterministic ids."""
+        admitted = []
+        for wf in self.workflows.values():
+            if wf.state != "running":
+                continue
+            for s in wf.stages:
+                record = queue.records.get(s["job_id"])
+                if record is None:
+                    if s["state"] == "queued":
+                        # admitted once, then pruned/lost: re-admit below
+                        s["state"] = "blocked"
+                    continue
+                if record.state == "done" and s["state"] != "done":
+                    s["state"] = "done"
+                elif record.state in ("cancelled", "expired", "failed") \
+                        and s["state"] not in _TERMINAL:
+                    s["state"] = record.state
+            if any(s["state"] in ("cancelled", "expired", "failed")
+                   for s in wf.stages):
+                wf.state = "failed"
+                wf.done_wall = wf.done_wall or round(self.clock.wall(), 3)
+                continue
+            admitted.extend(self._admit_ready(wf, queue))
+            if all(s["state"] == "done" for s in wf.stages):
+                wf.state = "done"
+                wf.done_wall = wf.done_wall or round(self.clock.wall(), 3)
+        self._refresh_gauges()
+        return admitted
+
+    # --- aggregation (the parent view) ------------------------------
+
+    def status(self, wf: Workflow, queue) -> dict:
+        stages = []
+        records = []
+        for s in wf.stages:
+            record = queue.records.get(s["job_id"])
+            if record is not None:
+                records.append(record)
+            stages.append({
+                "stage": s["name"], "index": s["index"], "id": s["job_id"],
+                "status": record.state if record is not None else s["state"],
+                "attempts": record.attempts if record is not None else 0,
+                "worker": record.worker if record is not None else None,
+            })
+        out = {
+            "id": wf.workflow_id,
+            "workflow": wf.job.get("workflow"),
+            "class": job_class(wf.job),
+            "tenant": wf.tenant,
+            "status": wf.state,
+            "stages": stages,
+            "usage": accounting.render_usage(
+                accounting.usage_summary(records))["totals"],
+        }
+        if wf.state == "done" and wf.stages:
+            final = queue.records.get(wf.stages[-1]["job_id"])
+            if final is not None and final.result is not None:
+                out["result"] = final.result
+        return out
+
+    def build_trace(self, wf: Workflow, queue, now_wall: float) -> dict:
+        """The parent trace: every stage's timeline merged on one wall
+        clock, gaps attributed with the shared labels plus the
+        settle->admit `stage_handoff` seam, and the workers' stage spans
+        aggregated per stage. Shaped so a COMPLETED workflow passes the
+        same `trace_missing` oracle a monolithic job does."""
+        events: list[dict] = []
+        spans: list[dict] = []
+        attempts = 0
+        placement = None
+        queue_wait = None
+        for s in wf.stages:
+            record = queue.records.get(s["job_id"])
+            if record is None:
+                continue
+            attempts += record.attempts
+            if record.placement:
+                placement = record.placement
+            if queue_wait is None and record.queue_wait_s is not None:
+                queue_wait = record.queue_wait_s
+            for e in record.timeline:
+                if isinstance(e, dict):
+                    events.append(dict(e, stage=s["name"]))
+            stage_spans = worker_stages(record.result)
+            if stage_spans:
+                spans.extend({"stage": f"{s['name']}:{sp['stage']}",
+                              "seconds": sp["seconds"]}
+                             for sp in stage_spans)
+            elif record.state == "done":
+                # synthetic envelopes carry no timings; the dispatch ->
+                # settle window is still honest per-stage attribution
+                walls = {e.get("event"): float(e.get("wall", 0.0))
+                         for e in record.timeline if isinstance(e, dict)}
+                if "dispatch" in walls and "settle" in walls:
+                    spans.append({
+                        "stage": s["name"],
+                        "seconds": round(max(
+                            walls["settle"] - walls["dispatch"], 0.0), 3)})
+        events.sort(key=lambda e: float(e.get("wall", 0.0)))
+        t0 = float(events[0]["wall"]) if events else now_wall
+        for e in events:
+            e["t_s"] = round(float(e.get("wall", t0)) - t0, 3)
+        gaps = []
+        for prev, nxt in zip(events, events[1:]):
+            pair = (prev.get("event"), nxt.get("event"))
+            attribution = _GAP_LABELS.get(pair, "other")
+            if pair == ("settle", "admit"):
+                attribution = "stage_handoff"
+            gap = {
+                "from": prev.get("event"), "to": nxt.get("event"),
+                "seconds": round(
+                    float(nxt["wall"]) - float(prev["wall"]), 3),
+                "attribution": attribution,
+            }
+            if prev.get("stage") != nxt.get("stage"):
+                gap["stages"] = [prev.get("stage"), nxt.get("stage")]
+            gaps.append(gap)
+        open_ended = wf.state == "running"
+        end = now_wall if open_ended else float(
+            wf.done_wall or (events[-1]["wall"] if events else now_wall))
+        return {
+            "id": wf.workflow_id,
+            "class": job_class(wf.job),
+            "status": wf.state,
+            "attempts": attempts,
+            "placement": placement,
+            "queue_wait_s": queue_wait,
+            "workflow": True,
+            "stage_states": {s["name"]: s["state"] for s in wf.stages},
+            "events": events,
+            "events_resorted": False,
+            "gaps": gaps,
+            "total_s": max(round(end - t0, 3), 0.0),
+            "open": open_ended,
+            "worker": {
+                "stages": spans,
+                "total_s": round(sum(sp["seconds"] for sp in spans), 3),
+                "trace": {},
+            },
+        }
+
+    # --- bookkeeping ------------------------------------------------
+
+    def summary(self) -> dict:
+        states = {"running": 0, "done": 0, "failed": 0, "cancelled": 0}
+        ready = 0
+        for wf in self.workflows.values():
+            states[wf.state] = states.get(wf.state, 0) + 1
+            if wf.state == "running":
+                ready += sum(1 for s in wf.stages if s["state"] == "queued")
+        return {"total": len(self.workflows), "ready_stages": ready,
+                **states}
+
+    def _refresh_gauges(self) -> None:
+        summary = self.summary()
+        _READY.set(summary["ready_stages"])
+        for state in ("running", "done", "failed", "cancelled"):
+            _WORKFLOWS.set(summary.get(state, 0), state=state)
+
+    def _prune(self) -> None:
+        """Bound history like the queue's retired-record window: oldest
+        TERMINAL workflows fall off first; running graphs are never
+        dropped."""
+        while len(self.workflows) > self.history_limit:
+            victim = next((wid for wid, wf in self.workflows.items()
+                           if wf.state != "running"), None)
+            if victim is None:
+                return
+            wf = self.workflows.pop(victim)
+            for s in wf.stages:
+                self.by_stage.pop(s["job_id"], None)

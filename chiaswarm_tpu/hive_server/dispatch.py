@@ -65,8 +65,8 @@ import math
 import uuid
 
 from .. import telemetry
-from ..coalesce import (adapter_ref, canonical_adapter_ref, job_rows,
-                        placement_model)
+from ..coalesce import (CHIP_STAGES, adapter_ref, canonical_adapter_ref,
+                        job_rows, placement_model, stage_of)
 from .clock import CLOCK
 from .fleet import parse_stats
 from .queue import JobRecord, PriorityJobQueue
@@ -142,6 +142,12 @@ class WorkerInfo:
     # checkpoint-armed denoise and can rehydrate a checkpoint blob —
     # only these pollers get `resume` offers on redelivered jobs
     resume_capable: bool = False
+    # stage-typed placement (ISSUE 20): the stage names this poller will
+    # serve (`stages` csv param — a jax-free host advertises only the
+    # CPU set). `stage_aware` records whether the param was present at
+    # all: a legacy poller never sees stage-jobs, in either direction.
+    stages: frozenset[str] = frozenset()
+    stage_aware: bool = False
     last_seen: float = 0.0
 
     @property
@@ -171,6 +177,7 @@ class WorkerInfo:
             "resume_capable": self.resume_capable,
             "resident_models": sorted(self.resident),
             "resident_adapters": sorted(self.resident_adapters),
+            "stages": sorted(self.stages),
         }
 
 
@@ -211,6 +218,8 @@ class WorkerDirectory:
             shard_capable=_to_int(query.get("shard_capable")) > 0,
             resident_adapters=_split_csv(query.get("resident_adapters")),
             resume_capable=_to_int(query.get("resume_capable")) > 0,
+            stages=_split_csv(query.get("stages")),
+            stage_aware="stages" in query,
             last_seen=CLOCK.mono(),
         )
         self._workers[name] = info
@@ -362,7 +371,18 @@ class Dispatcher:
             model = placement_model(record.job)
             if not worker.can_run(model):
                 continue
-            if (poller_is_straggler
+            stage = stage_of(record.job)
+            if stage is not None and (
+                    not worker.stage_aware or stage not in worker.stages
+                    or (stage in CHIP_STAGES and worker.chips <= 0)):
+                # stage-typed placement (ISSUE 20): a stage-job only
+                # leaves with a poller that advertised its stage —
+                # legacy pollers (no `stages` param) never see graph
+                # work — and chip-path stages (denoise/upscale/video)
+                # additionally require a chip host, whatever it claims
+                continue
+            cpu_stage = stage is not None and stage not in CHIP_STAGES
+            if (not cpu_stage and poller_is_straggler
                     and record.job_class == "interactive"
                     and now - record.submitted_at < self.affinity_hold_s
                     and any(w.name != worker.name and w.can_run(model)
@@ -392,7 +412,8 @@ class Dispatcher:
                 # availability.
                 _DISPATCH.inc(outcome="flap_hold")
                 continue
-            if (record.job_class == "interactive"
+            if (not cpu_stage
+                    and record.job_class == "interactive"
                     and not worker.shard_capable
                     and now - record.submitted_at < self.affinity_hold_s
                     and any(w.name != worker.name and w.shard_capable
@@ -414,7 +435,14 @@ class Dispatcher:
                 # each other and park the seed for the whole window.
                 _DISPATCH.inc(outcome="shard_hold")
                 continue
-            if model and model in worker.resident:
+            if cpu_stage:
+                # host-path stages (encode/decode/postprocess) have no
+                # warm-weight economics: no affinity hold applies, the
+                # first capable poller drains them immediately — which
+                # is exactly what lets a jax-free encode host keep the
+                # chip fleet fed without ever touching a chip itself
+                outcome = "cold"
+            elif model and model in worker.resident:
                 aref = canonical_adapter_ref(record.job)
                 if aref is not None and aref in worker.resident_adapters:
                     # model AND stacked adapter operands warm here: the

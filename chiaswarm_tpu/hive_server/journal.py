@@ -382,8 +382,20 @@ def ev_epoch(epoch: int) -> dict:
     return {"ev": "epoch", "epoch": int(epoch)}
 
 
+def ev_dag(workflow) -> dict:
+    """One workflow graph's FULL state (ISSUE 20): stage list, edges,
+    and per-stage states — a few hundred bytes (stage payloads are job
+    dicts the admit events carry anyway; artifact blobs live in the
+    spool). Appended on submission and on every advancement; replay
+    restores by replacement like ev_checkpoint, so the LAST event per
+    workflow id wins and graphs survive SIGKILL recovery, compaction,
+    and standby promotion."""
+    return {"ev": "dag", "id": workflow.workflow_id,
+            "workflow": workflow.to_state()}
+
+
 def snapshot_events(queue: PriorityJobQueue, leases: LeaseTable,
-                    epoch: int = 0) -> list[dict]:
+                    epoch: int = 0, dag=None) -> list[dict]:
     """The minimal event sequence reconstructing the current state: the
     fencing epoch (when ever bumped), one admit per live record, plus
     the single event carrying its terminal or leased condition. Queued
@@ -393,6 +405,12 @@ def snapshot_events(queue: PriorityJobQueue, leases: LeaseTable,
     events: list[dict] = []
     if epoch:
         events.append(ev_epoch(epoch))
+    if dag is not None:
+        # workflow graphs first: their restore needs no records (stage
+        # states re-derive from the record events that follow, via the
+        # server's post-replay reconcile)
+        for workflow in dag.workflows.values():
+            events.append(ev_dag(workflow))
     queued_ids = set()
     for record in queue.iter_queued():
         queued_ids.add(record.job_id)
@@ -423,7 +441,7 @@ def snapshot_events(queue: PriorityJobQueue, leases: LeaseTable,
 
 
 def apply_events(events: list[dict], queue: PriorityJobQueue,
-                 leases: LeaseTable) -> dict:
+                 leases: LeaseTable, dag=None) -> dict:
     """Replay a recovered stream into fresh queue/lease tables. Events
     referencing unknown ids (their admit was the torn tail, or the
     record was retired in a compacted-away past) are skipped and
@@ -475,6 +493,17 @@ def apply_events(events: list[dict], queue: PriorityJobQueue,
                 restored.timeline = [{
                     "event": "admit", "wall": restored.submitted_wall,
                     "class": restored.job_class}]
+            _REPLAYED.inc()
+            continue
+        if ev == "dag":
+            # workflow graph state (ISSUE 20): no job record to look up —
+            # restore-by-replacement into the dag table (skipped, and
+            # counted, when this replayer has none: a legacy caller)
+            state = event.get("workflow")
+            if dag is None or not isinstance(state, dict):
+                skipped += 1
+                continue
+            dag.restore(state)
             _REPLAYED.inc()
             continue
         record = queue.records.get(str(event.get("id", "")))

@@ -178,6 +178,7 @@ class StandbyHive:
         away). Safe because the standby refuses every mutating request
         until promoted — nothing else touches these tables."""
         self.server.queue, self.server.leases = self.server._new_state()
+        self.server.dag = self.server._new_dag()
         self.since = 0
 
     async def _get_session(self) -> aiohttp.ClientSession:
@@ -218,7 +219,8 @@ class StandbyHive:
             self._reset_state()
         if events:
             summary = apply_events(
-                events, self.server.queue, self.server.leases)
+                events, self.server.queue, self.server.leases,
+                dag=self.server.dag)
             _APPLIED.inc(len(events))
             logger.debug("replicated %d event(s) -> %s", len(events), summary)
             # replicated settles carry usage (the ledger is derived from
@@ -331,10 +333,16 @@ class StandbyHive:
             srv.leases.grant(lease.record, lease.worker)
             regranted += 1
         srv.standby = False
+        # the stream may have delivered a stage settle without its
+        # trailing ev_dag (primary died between the appends): re-derive
+        # stage states from the replicated records and re-admit ready
+        # successors before this hive serves its first poll
+        srv.dag.reconcile(srv.queue)
         if srv.journal is not None:
             try:
                 srv.journal.compact(
-                    snapshot_events(srv.queue, srv.leases, srv.epoch))
+                    snapshot_events(srv.queue, srv.leases, srv.epoch,
+                                    dag=srv.dag))
             except OSError:
                 # same degradation policy as HiveServer._journal: a full
                 # disk costs restart-durability of the promotion, never

@@ -143,6 +143,17 @@ def wire_trace_context(record, gang: dict | None = None) -> dict:
             "size": int(gang.get("size", 0)),
             "index": int(gang.get("index", 0)),
         }
+    stage = record.job.get("stage") if isinstance(record.job, dict) else None
+    if isinstance(stage, dict) and stage.get("workflow"):
+        # stage-jobs (ISSUE 20) carry their graph coordinates so the
+        # worker's envelope echo — and anything tailing the wire — can
+        # attribute spans to the parent workflow; monolithic dispatches
+        # carry NO stage key, keeping the legacy wire shape untouched
+        context["stage"] = {
+            "workflow_id": str(stage.get("workflow")),
+            "stage": str(stage.get("name", "")),
+            "index": int(stage.get("index", 0)),
+        }
     return context
 
 

@@ -44,7 +44,20 @@ _SIZE_LOCKED_MODELS = {
 
 async def format_args(job: dict, settings, device_identifier: str):
     args = prepare_args(job, settings)
+    stage = args.pop("stage", None)
     workflow = args.pop("workflow", None)
+
+    if isinstance(stage, dict) and stage.get("name"):
+        # stage-graph jobs (ISSUE 20): host stages (encode/decode) route
+        # to their own callbacks; chip stages fall through to the classic
+        # dispatch below with the graph metadata (emit_raw handoff,
+        # injected start image) already applied to `args`
+        from .workflows.stages import format_stage_args
+
+        routed = await format_stage_args(
+            stage, workflow, args, settings, device_identifier)
+        if routed is not None:
+            return routed
 
     if workflow == "echo":
         from .workflows.echo import echo_callback

@@ -544,6 +544,198 @@ class FluxPipeline:
                 pipeline_config["stream_int8"] = True
         return images, pipeline_config
 
+    # --- coalesced txt2img (ISSUE 20: flux joins run_batched) ---
+
+    def _batched_program(self, key: tuple):
+        """Like _program, but the init latents arrive PRE-DRAWN: each
+        request's rows are sampled eagerly from its own rng with the
+        exact split + draw shape run() uses, so a coalesced row matches
+        its solo twin to within one uint8 quantization step — the
+        MMDiT/VAE programs are row-independent and nothing inside the
+        jit depends on who a row was batched with; only XLA's
+        batch-width vectorization can move the last float bit. Shares
+        the LRU-bounded program cache with the solo entries (the
+        leading "batched" tag keeps the two key shapes from
+        colliding)."""
+        with self._jit_lock:
+            if key in self._programs:
+                self._programs.move_to_end(key)
+                return self._programs[key]
+        _tag, lh, lw, batch, steps, txt_len = key
+        shift = _sigma_shift((lh // 2) * (lw // 2), self.dynamic_shift)
+        scheduler = FlowMatchEulerScheduler(
+            SchedulerConfig(prediction_type="flow", shift=shift)
+        )
+        sigmas = jnp.asarray(scheduler.schedule(steps).sigmas)
+        transformer = self.transformer
+        vae = self.vae
+
+        def run(params, latents, context, pooled, guidance):
+            img, img_ids = patchify(latents.astype(self.dtype))
+            txt_ids = jnp.zeros((batch, txt_len, 3), jnp.int32)
+
+            def body(img, i):
+                t = jnp.broadcast_to(sigmas[i], (batch,))
+                velocity = transformer.apply(
+                    {"params": params["flux"]},
+                    img.astype(self.dtype),
+                    img_ids,
+                    context,
+                    txt_ids,
+                    t,
+                    pooled,
+                    guidance=guidance,
+                ).astype(jnp.float32)
+                img = img.astype(jnp.float32) + (
+                    sigmas[i + 1] - sigmas[i]
+                ) * velocity
+                return img, ()
+
+            img, _ = jax.lax.scan(body, img.astype(jnp.float32),
+                                  jnp.arange(steps))
+            latents = unpatchify(img, lh, lw).astype(self.dtype)
+            pixels = vae.apply(
+                {"params": params["vae"]}, latents, method=vae.decode
+            )
+            return (
+                (pixels.astype(jnp.float32) + 1.0) * 127.5
+            ).clip(0.0, 255.0).round().astype(jnp.uint8)
+
+        program = jax.jit(run)
+        with self._jit_lock:
+            self._programs[key] = program
+            from .common import PROGRAM_EVICTED, program_cache_cap
+
+            cap = program_cache_cap()
+            while cap and len(self._programs) > cap:
+                self._programs.popitem(last=False)
+                PROGRAM_EVICTED.inc(kind="program")
+        return program
+
+    def run_batched(self, requests: list[dict], *, height=None, width=None,
+                    num_inference_steps=None, guidance_scale=3.5,
+                    pipeline_type: str = "FluxPipeline", **_shared):
+        """Coalesced flux txt2img: N independent requests, ONE padded
+        jitted flow-matching pass (batching.py design; coalesce_key
+        admits only the shapes this reproduces — txt2img, no adapters,
+        no ControlNet, explicit steps + guidance). Per-row payload is
+        prompt / rng / num_images_per_prompt; everything shared rides as
+        keyword arguments. There is no CFG row doubling, so the pass
+        batches exactly sum(rows) images padded to a power-of-two
+        bucket.
+
+        Returns [(images_j, pipeline_config_j)] aligned with requests.
+        Raising here is fine: the worker's solo fallback serves the
+        members individually (the contract SD's run_batched set)."""
+        from .common import pad_bucket, split_by_counts
+
+        params = self.params
+        if params is None:
+            raise Exception(
+                f"pipeline {self.model_name} was evicted; resubmit the job"
+            )
+        if self.streaming:
+            # the paged sampler is host-RAM-bound, not row-bound: wider
+            # rows don't amortize the PCIe traffic, and the python-loop
+            # sampler has no batched-latents seam — solo fallback
+            raise ValueError(
+                "weight-streaming flux serves members individually")
+        if any(r.get("lora") for r in requests):
+            raise ValueError("flux adapters serve on the single path")
+        if any(r.get("image") is not None for r in requests):
+            raise ValueError("flux has no coalesced img2img variant")
+
+        timings: dict[str, float] = {}
+        steps = int(num_inference_steps or self.default_steps)
+        guidance_scale = float(guidance_scale)
+        max_seq = 512
+        height = int(height or self.default_size)
+        width = int(width or height)
+        snap = self.latent_factor * 2
+        height, width = (max(snap, (d // snap) * snap) for d in (height, width))
+        lh, lw = height // self.latent_factor, width // self.latent_factor
+
+        counts = [
+            max(int(r.get("num_images_per_prompt", 1) or 1), 1)
+            for r in requests
+        ]
+        total = sum(counts)
+        padded = pad_bucket(total)
+        pad_rows = padded - total
+
+        # --- conditioning: every row carries its own prompt; padding
+        # rows are empty prompts whose outputs are discarded ---
+        t0 = time.perf_counter()
+        prompts: list[str] = []
+        for r, n in zip(requests, counts):
+            prompts.extend([str(r.get("prompt") or "")] * n)
+        prompts.extend([""] * pad_rows)
+        clip_ids = jnp.asarray(self.clip_tokenizer(prompts))
+        t5_ids = jnp.asarray(self.t5_tokenizer(prompts, max_seq), jnp.int32)
+        context, pooled = self._encode_program(params, clip_ids, t5_ids)
+        timings["text_encode_s"] = round(time.perf_counter() - t0, 3)
+
+        def place_b(x):
+            if self.data_parts > 1 and x.shape[0] % self.data_parts == 0:
+                return jax.device_put(x, batch_sharding(self.mesh, x.ndim))
+            return jax.device_put(x, replicated(self.mesh))
+
+        context, pooled = place_b(context), place_b(pooled)
+        guidance = jnp.full((padded,), guidance_scale, jnp.float32)
+
+        # --- per-request init latents, drawn EXACTLY as run() draws
+        # them (split the request's rng, sample the request-shaped
+        # block) so each row matches its solo twin; padding rows are
+        # zeros a row-independent program never mixes in ---
+        blocks = []
+        for r, n in zip(requests, counts):
+            base = r.get("rng")
+            if base is None:
+                base = jax.random.key(0)
+            init_rng = jax.random.split(base)[1]
+            blocks.append(jax.random.normal(
+                init_rng, (n, lh, lw, self.latent_channels), jnp.float32))
+        if pad_rows:
+            blocks.append(jnp.zeros(
+                (pad_rows, lh, lw, self.latent_channels), jnp.float32))
+        latents = place_b(jnp.concatenate(blocks, axis=0))
+
+        key = ("batched", lh, lw, padded, steps, int(t5_ids.shape[1]))
+        t0 = time.perf_counter()
+        program = self._batched_program(key)
+        timings["trace_s"] = round(time.perf_counter() - t0, 3)
+
+        t0 = time.perf_counter()
+        pixels = jax.block_until_ready(
+            program(params, latents, context, pooled, guidance)
+        )
+        timings["denoise_decode_s"] = round(time.perf_counter() - t0, 3)
+
+        from PIL import Image
+
+        groups = split_by_counts(
+            [Image.fromarray(a) for a in np.asarray(pixels[:total])], counts)
+        results = []
+        offset = 0
+        for n, images in zip(counts, groups):
+            results.append((images, {
+                "model": self.model_name,
+                "pipeline": pipeline_type,
+                "scheduler": "FlowMatchEulerScheduler",
+                "mode": "txt2img",
+                "steps": steps,
+                "size": [width, height],
+                "guidance_scale": guidance_scale,
+                "batched_with": len(requests),
+                "batch_rows": [offset, n],
+                "padded_rows": padded,
+                # shared pass timings, copied per envelope: the envelope
+                # must stand alone once the hive splits the batch apart
+                "timings": dict(timings),
+            }))
+            offset += n
+        return results
+
 
 class _HashT5Tokenizer:
     """Deterministic stand-in (tiny models / missing spiece.model)."""

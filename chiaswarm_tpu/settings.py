@@ -307,6 +307,23 @@ class Settings:
     # disables — some fleets legitimately run HBM near-full, so the
     # squeeze probe is an operator opt-in
     memory_headroom_degraded: float = 0.0
+    # --- stage-graph serving (ISSUE 20, hive_server/dag.py) ---
+    # worker side: which workflow stages this worker advertises on /work.
+    # "auto" derives from hardware (chip hosts serve every stage; a
+    # jax-free/CPU host serves only the host-path set — encode, decode,
+    # postprocess, stitch, caption); "none" suppresses the advertisement
+    # entirely (legacy poller: never sees stage-jobs); or an explicit
+    # comma-separated stage list
+    stage_roles: str = "auto"
+    # worker side: concurrent host-path stage executions (encode/decode
+    # jobs run beside the slice scheduler, so decode of pass N overlaps
+    # denoise of pass N+1); 0 disables the side lane — CPU stages are
+    # then refused by "auto" advertisement
+    stage_workers: int = 2
+    # hive side: terminal workflow graphs kept for GET /api/workflows
+    # (running graphs never drop); bounds dag-table memory like
+    # hive_job_history_limit bounds records
+    hive_dag_history: int = 256
 
     @classmethod
     def field_names(cls) -> tuple[str, ...]:
@@ -397,6 +414,9 @@ _ENV_OVERRIDES = {
         "hive_replication_lag_degraded_s",
     "CHIASWARM_PROFILER_CAPTURE": "profiler_capture",
     "CHIASWARM_MEMORY_HEADROOM_DEGRADED": "memory_headroom_degraded",
+    "CHIASWARM_STAGE_ROLES": "stage_roles",
+    "CHIASWARM_STAGE_WORKERS": "stage_workers",
+    "CHIASWARM_HIVE_DAG_HISTORY": "hive_dag_history",
 }
 
 

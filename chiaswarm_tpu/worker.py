@@ -256,6 +256,14 @@ class Worker:
         # "executing" test: a hive revocation for one of these marks the
         # process-wide cancel registry the chunked denoise probes)
         self._executing_ids: set[str] = set()
+        # host-path stage lane (ISSUE 20): encode/decode/postprocess
+        # stage-jobs bypass the BatchScheduler and the slice allocator —
+        # they run on the default executor, so the decode of pass N
+        # overlaps the denoise of pass N+1 instead of holding its slice
+        self._stage_queue: asyncio.Queue = asyncio.Queue()
+        self._stage_inflight = 0
+        self._stage_queued_ids: set[str] = set()
+        self._stage_cancelled: set[str] = set()
         self._metrics_runner = None
         self._profiling = False  # one on-demand profiler capture at a time
         # per-stage EWMA of this worker's OWN envelope stage timings
@@ -299,6 +307,9 @@ class Worker:
             asyncio.create_task(self.slice_worker(), name=f"slice_worker_{i}")
             for i in range(len(self.allocator))
         ]
+        for i in range(int(getattr(self.settings, "stage_workers", 2) or 0)):
+            tasks.append(asyncio.create_task(
+                self.stage_worker(), name=f"stage_worker_{i}"))
         tasks.append(asyncio.create_task(self.result_worker(), name="result_worker"))
         tasks.append(asyncio.create_task(self.poll_loop(), name="poll_loop"))
         tasks.append(asyncio.create_task(self._drain_watcher(), name="drain_watcher"))
@@ -345,6 +356,8 @@ class Worker:
             # NOT outbox.depth, which also counts parked (permanently
             # refused) envelopes that only a restart may retry
             if (self.batcher.outstanding_jobs == 0
+                    and self._stage_queue.qsize() == 0
+                    and self._stage_inflight == 0
                     and self.result_queue.qsize() == 0
                     and self._delivering == 0):
                 logger.warning("drain complete: no in-flight work remains")
@@ -495,6 +508,13 @@ class Worker:
             "draining": self._draining.is_set(),
             "jobs_in_flight": self.batcher.outstanding_jobs,
             "results_pending": self.result_queue.qsize(),
+            # host-path stage lane (ISSUE 20)
+            "stage_lane": {
+                "queued": self._stage_queue.qsize(),
+                "inflight": self._stage_inflight,
+                "workers": int(getattr(
+                    self.settings, "stage_workers", 2) or 0),
+            },
             "outbox": {
                 "depth": self.outbox.depth,
                 "oldest_age_s": round(oldest, 1) if oldest else 0,
@@ -536,6 +556,8 @@ class Worker:
         _QUEUE_DEPTH.set(self.batcher.pending_jobs, queue="lingering")
         _QUEUE_DEPTH.set(self.batcher.ready_jobs, queue="ready")
         _QUEUE_DEPTH.set(self.result_queue.qsize(), queue="results")
+        _QUEUE_DEPTH.set(
+            self._stage_queue.qsize() + self._stage_inflight, queue="stage")
         quarantined = self.allocator.quarantined_count
         _SLICE_STATE.set(len(self.allocator) - quarantined, state="active")
         _SLICE_STATE.set(quarantined, state="quarantined")
@@ -638,6 +660,13 @@ class Worker:
             int(getattr(self.settings, "denoise_chunk_steps", 0) or 0) > 0
             and int(getattr(
                 self.settings, "checkpoint_every_chunks", 0) or 0) > 0)
+        # stage-typed placement (ISSUE 20): the stage names this worker
+        # serves. A stage-graph hive gates stage-job hand-outs on this;
+        # omitting the key entirely (stage_roles="none") keeps the
+        # legacy wire shape — such a worker sees only monolithic jobs.
+        stages = self._stage_roles()
+        if stages is not None:
+            caps["stages"] = ",".join(sorted(stages))
         caps["jobs_completed"] = int(_JOBS_COMPLETED.total())
         if self._last_poll_monotonic is not None:
             caps["last_poll_age_s"] = round(
@@ -652,6 +681,31 @@ class Worker:
                        for stage, (ewma, n) in self._stage_stats.items()}},
                 separators=(",", ":"))
         return caps
+
+    def _stage_roles(self) -> frozenset[str] | None:
+        """Stage names to advertise on /work, or None for the legacy
+        (no `stages` param) shape. "auto": a chip-bearing worker serves
+        every stage; the host (CPU) stages are advertised only while the
+        stage lane has consumers. An explicit csv passes through, minus
+        the CPU stages when the lane is disabled — advertising a stage
+        no coroutine will ever pop would strand its jobs until lease
+        expiry."""
+        from .coalesce import CHIP_STAGES, CPU_STAGES
+
+        raw = str(getattr(self.settings, "stage_roles", "auto")
+                  or "auto").strip()
+        if raw.lower() == "none":
+            return None
+        host_ok = int(getattr(self.settings, "stage_workers", 2) or 0) > 0
+        if raw.lower() == "auto":
+            roles = set(CHIP_STAGES)
+            if host_ok:
+                roles |= CPU_STAGES
+            return frozenset(roles)
+        roles = {s.strip() for s in raw.split(",") if s.strip()}
+        if not host_ok:
+            roles -= CPU_STAGES
+        return frozenset(roles)
 
     def _note_stage_stats(self, timings: dict) -> None:
         """Fold one PASS's stage spans into the per-stage EWMAs the
@@ -730,7 +784,15 @@ class Worker:
                             gang = job["trace"].get("gang")
                             if isinstance(gang, dict) and gang.get("id"):
                                 gang_id = str(gang["id"])
-                        if gang_id is None:
+                        # stage-jobs (ISSUE 20): hydrate the predecessor
+                        # handoff artifacts through the authed client,
+                        # then route host stages to the stage lane — they
+                        # never touch the batcher or claim a chip slice
+                        if isinstance(job.get("stage"), dict):
+                            await self._resolve_stage_inputs(job)
+                        if self._is_host_stage(job):
+                            intake.append(("stage", job))
+                        elif gang_id is None:
                             intake.append(("job", job))
                         else:
                             if gang_id not in gangs:
@@ -739,6 +801,9 @@ class Worker:
                     for kind, item in intake:
                         if kind == "gang":
                             await self.batcher.put_gang(gangs[item])
+                        elif kind == "stage":
+                            self._stage_queued_ids.add(str(item.get("id")))
+                            self._stage_queue.put_nowait(item)
                         else:
                             await self.batcher.put(item)
                     # lease revocations piggybacked on this reply: route
@@ -780,10 +845,99 @@ class Worker:
             logger.warning(
                 "hive cancelled executing job %s; the slice aborts at "
                 "its next denoise chunk boundary", job_id)
+        elif job_id in self._stage_queued_ids:
+            # sitting in the stage lane: tombstone it — the consumer
+            # drops it on pickup, no envelope is ever produced
+            self._stage_cancelled.add(job_id)
+            stage = "held"
         else:
             stage = "unknown"
         _JOBS_CANCELLED.inc(stage=stage)
         self._update_queue_gauges()
+
+    # --- host-path stage lane (ISSUE 20) ---
+
+    @staticmethod
+    def _is_host_stage(job: dict) -> bool:
+        """True for a stage-job whose stage name is host work (encode/
+        decode/postprocess/...): it runs on the stage lane, jax-free,
+        and never claims a chip slice."""
+        from .coalesce import CPU_STAGES, stage_of
+
+        return stage_of(job) in CPU_STAGES
+
+    async def _resolve_stage_inputs(self, job: dict) -> None:
+        """Hydrate a stage-job's handoff: predecessors' outputs arrive
+        as content-addressed spool references ({sha256, bytes, href});
+        fetch each blob through the AUTHED artifact client and stamp it
+        back as base64 so the stage callback works from bytes. Fetch
+        failures degrade — the callback reports the missing input as a
+        fatal envelope instead of the worker dying here."""
+        stage = job.get("stage")
+        if not isinstance(stage, dict):
+            return
+        for entry in stage.get("inputs") or []:
+            artifacts = (entry.get("artifacts")
+                         if isinstance(entry, dict) else None)
+            if not isinstance(artifacts, dict):
+                continue
+            for art in artifacts.values():
+                if not isinstance(art, dict) or art.get("blob"):
+                    continue
+                href = art.get("href")
+                if not href:
+                    continue
+                blob = await self.hive.fetch_artifact(str(href))
+                if blob is not None:
+                    art["blob"] = base64.b64encode(blob).decode("ascii")
+
+    async def stage_worker(self) -> None:
+        """One consumer of the stage lane: pops a host stage-job, runs
+        its callback on the default executor (device "cpu" — no slice,
+        no jax), and ships the envelope through the same finish/outbox
+        path a slice pass uses. N of these run concurrently
+        (Settings.stage_workers), so decode of pass N overlaps denoise
+        of pass N+1 on the chip slices."""
+        while True:
+            job = await self._stage_queue.get()
+            picked_up = time.monotonic()
+            job_id = str(job.get("id"))
+            self._stage_queued_ids.discard(job_id)
+            if job_id in self._stage_cancelled:
+                self._stage_cancelled.discard(job_id)
+                self._stage_queue.task_done()
+                continue
+            self._stage_inflight += 1
+            self._executing_ids.add(job_id)
+            enqueued = job.pop("_telemetry_enqueued", None)
+            trace = job.pop("trace", None)
+            job.pop("resume", None)
+            stage_name = str((job.get("stage") or {}).get("name", ""))
+            queue_wait = ({job.get("id"): picked_up - enqueued}
+                          if enqueued is not None else {})
+            traces = ({job.get("id"): trace}
+                      if isinstance(trace, dict) else {})
+            self._update_queue_gauges()
+            try:
+                worker_function, kwargs = await self.get_args(job, "cpu")
+                if worker_function is not None:
+                    result = await asyncio.get_running_loop().run_in_executor(
+                        None, self.synchronous_do_work,
+                        _HostLane(stage_name), worker_function, kwargs)
+                    if result is not None:
+                        self._finish_result(result, queue_wait, "cold", traces)
+                        self._note_stage_stats(
+                            result["pipeline_config"].get("timings") or {})
+                        await self._enqueue_result(result)
+            except Exception as e:
+                logger.exception("stage_worker error")
+                print(f"stage_worker {e}")
+            finally:
+                self._stage_inflight -= 1
+                self._executing_ids.discard(job_id)
+                cancel_mod.discard(job_id)
+                self._stage_queue.task_done()
+                self._update_queue_gauges()
 
     # --- consumers: one logical worker per chip slice ---
 
@@ -1567,6 +1721,32 @@ class Worker:
                 "submit failed for %s (attempt %d: %s); retrying in %.1fs",
                 entry.job_id, entry.retries, err, delay)
             await asyncio.sleep(delay)
+
+
+class _HostLane:
+    """Chipset stand-in for the stage lane (ISSUE 20): satisfies the
+    synchronous_do_work contract — descriptor for logging, __call__
+    running the callback — without touching a slice, the busy lock, or
+    jax. Host stage callbacks (encode/decode/postprocess) are
+    deterministic CPU work, so no seed/RNG is drawn."""
+
+    def __init__(self, stage: str):
+        self._stage = stage or "stage"
+
+    def descriptor(self) -> str:
+        return f"host:{self._stage}"
+
+    def identifier(self) -> str:
+        return "cpu"
+
+    def __call__(self, func, **kwargs):
+        model_name = kwargs.pop("model_name", "")
+        kwargs.pop("seed", None)
+        started = time.perf_counter()
+        artifacts, pipeline_config = func("cpu", model_name, **kwargs)
+        pipeline_config.setdefault("timings", {})["job_s"] = round(
+            time.perf_counter() - started, 3)
+        return artifacts, pipeline_config
 
 
 async def run_worker() -> None:

@@ -44,6 +44,9 @@ def _tiny_stand_in(model_name: str) -> str:
 def diffusion_callback(device_identifier: str, model_name: str, **kwargs):
     content_type = kwargs.pop("content_type", "image/jpeg")
     outputs = kwargs.pop("outputs", ["primary"])
+    # stage-graph handoff (ISSUE 20): a denoise stage-job skips the
+    # host-side decode tail and emits raw rows for its successor stage
+    emit_raw = bool(kwargs.pop("emit_raw", False))
     # classical-stand-in annotators used for conditioning (job_arguments
     # _flag_degraded) surface in the result envelope, not just the logs
     degraded_preprocessors = kwargs.pop("degraded_preprocessors", None)
@@ -115,6 +118,13 @@ def diffusion_callback(device_identifier: str, model_name: str, **kwargs):
         pipeline_config["batch_capped"] = batch_capped
     if degraded_preprocessors:
         pipeline_config["degraded_preprocessors"] = degraded_preprocessors
+
+    if emit_raw:
+        from .stages import pack_raw
+
+        with Span("handoff", pipeline_config.setdefault("timings", {})):
+            packaged = {"raw": pack_raw(images)}
+        return packaged, pipeline_config
 
     # real NSFW detection on the decoded pixels (reference envelope parity:
     # swarm/worker.py:166); auxiliary — never fails the job
@@ -205,6 +215,10 @@ def diffusion_batched_callback(device_identifier: str, requests: list[dict]):
         envelopes.append({
             "content_type": r.get("content_type", "image/jpeg"),
             "outputs": r.get("outputs", ["primary"]),
+            # stage-graph denoise members (ISSUE 20) hand off raw rows;
+            # the coalesce key's stage element keeps them from mixing
+            # with monolithic jobs, so a group is all-raw or all-packaged
+            "emit_raw": bool(r.get("emit_raw")),
         })
         n = max(int(r.get("num_images_per_prompt", 1) or 1), 1)
         counts.append(n)
@@ -294,6 +308,16 @@ def diffusion_batched_callback(device_identifier: str, requests: list[dict]):
             # hive-revoked mid-denoise: no safety pass, no packaging —
             # the worker drops this slot (no envelope is ever delivered)
             out.append((None, pipeline_config))
+            continue
+        if env["emit_raw"]:
+            from .stages import pack_raw
+
+            with Span("handoff", pipeline_config.setdefault("timings", {})):
+                packaged = {"raw": pack_raw(images)}
+            pipeline_config["batched_with"] = len(requests)
+            if i in capped:
+                pipeline_config["batch_capped"] = capped[i]
+            out.append((packaged, pipeline_config))
             continue
         with Span("decode", pipeline_config.setdefault("timings", {})):
             nsfw, checked = flag_images(images)

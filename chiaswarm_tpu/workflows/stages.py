@@ -1,0 +1,243 @@
+"""Worker-side stage-job callbacks (ISSUE 20 stage-graph serving).
+
+The hive's workflow expander (hive_server/dag.py) splits a diffusion
+request into encode / denoise [/ upscale] / decode stage-jobs. The chip
+stages (denoise, upscale) ride the classic diffusion path with the
+`emit_raw` handoff flag; the host stages (encode, decode, postprocess)
+format to the callbacks here and run on the worker's jax-free stage
+lane — so a chip-less host can serve them, and a chip host can overlap
+them with the next pass's denoise.
+
+Raw handoff format (`application/x-swarm-raw+json`): a JSON container
+of losslessly PNG-encoded rows. Lossless matters — the decode stage
+must package pixels identical to what the monolithic path would have
+packaged, so the final envelope differs from a single-lease run only
+in which host did the work.
+"""
+
+from __future__ import annotations
+
+import base64
+import hashlib
+import io
+import json
+
+from PIL import Image
+
+from ..post_processors.output_processor import OutputProcessor
+from ..telemetry import Span
+
+RAW_CONTENT_TYPE = "application/x-swarm-raw+json"
+
+# wire workflows whose stage names the dag templates own; explicit-chain
+# stages keep their native workflow dispatch (an echo "postprocess"
+# stage runs echo, not the diffusion decode)
+_DIFFUSION_WORKFLOWS = (None, "txt2img", "img2img")
+
+# workflows that consume a start image, for the handoff="image" seam
+_IMAGE_CONSUMERS = ("img2img", "img2vid", "vid2vid", "img2txt")
+
+
+def pack_raw(images) -> dict:
+    """Denoised rows -> ONE raw-handoff artifact (the producing stage's
+    whole output travels as a single content-addressed spool blob)."""
+    rows = []
+    for image in images:
+        buffer = io.BytesIO()
+        image.save(buffer, format="PNG")
+        rows.append(base64.b64encode(buffer.getvalue()).decode("ascii"))
+    payload = json.dumps({"format": "png", "images": rows}).encode("utf-8")
+    return {
+        "blob": base64.b64encode(payload).decode("ascii"),
+        "content_type": RAW_CONTENT_TYPE,
+        "sha256_hash": hashlib.sha256(payload).hexdigest(),
+        "rows": len(rows),
+    }
+
+
+def unpack_raw(payload: bytes) -> list[Image.Image]:
+    doc = json.loads(payload.decode("utf-8"))
+    return [
+        Image.open(io.BytesIO(base64.b64decode(row))).convert("RGB")
+        for row in doc.get("images", [])
+    ]
+
+
+def input_blob(inputs, key: str | None = None) -> bytes | None:
+    """The newest predecessor artifact blob (optionally by artifact key)
+    from a stage-job's hydrated inputs — the worker's poll loop fetched
+    each spool href and stamped the bytes back as `blob`."""
+    for entry in reversed(list(inputs or [])):
+        artifacts = entry.get("artifacts") if isinstance(entry, dict) else None
+        if not isinstance(artifacts, dict):
+            continue
+        for name, art in artifacts.items():
+            if key is not None and name != key:
+                continue
+            blob = art.get("blob") if isinstance(art, dict) else None
+            if isinstance(blob, str) and blob:
+                try:
+                    return base64.b64decode(blob)
+                except (ValueError, TypeError):
+                    continue
+    return None
+
+
+def stage_images(inputs) -> list[Image.Image]:
+    """The image rows a consuming stage works from: the predecessor's
+    raw handoff when present, else its packaged primary artifact."""
+    payload = input_blob(inputs, key="raw")
+    if payload is not None:
+        return unpack_raw(payload)
+    payload = input_blob(inputs, key="primary")
+    if payload is not None:
+        return [Image.open(io.BytesIO(payload)).convert("RGB")]
+    raise ValueError(
+        "stage-job has no input artifacts to work from (predecessor "
+        "handoff missing or not yet hydrated)")
+
+
+async def format_stage_args(stage: dict, workflow, args: dict, settings,
+                            device_identifier: str):
+    """Route one stage-job. Returns (callback, kwargs) for the host
+    stages this module owns, or None to fall through to the classic
+    dispatch — with the graph metadata (emit_raw / injected start
+    image) already applied to `args`."""
+    name = str(stage.get("name") or "")
+    inputs = stage.get("inputs") or []
+    if workflow in _DIFFUSION_WORKFLOWS:
+        if name == "encode":
+            args.setdefault("prompt", "")
+            args.setdefault("negative_prompt", "")
+            return encode_callback, args
+        if name in ("decode", "postprocess"):
+            args["stage_inputs"] = inputs
+            return decode_callback, args
+        if name == "upscale":
+            args["stage_inputs"] = inputs
+            return upscale_stage_callback, args
+        if name == "denoise" and stage.get("handoff") == "raw":
+            # classic dispatch, raw handoff: the pass skips the host-side
+            # packaging and emits rows for the successor stage
+            args["emit_raw"] = True
+            return None
+    if stage.get("handoff") == "image" and inputs \
+            and workflow in _IMAGE_CONSUMERS \
+            and "start_image_uri" not in args and args.get("image") is None:
+        payload = input_blob(inputs, key="primary")
+        if payload is not None:
+            args["image"] = Image.open(io.BytesIO(payload)).convert("RGB")
+    return None
+
+
+def encode_callback(device_identifier: str, model_name: str, **kwargs):
+    """Text-encode stage: jax-free conditioning prep. The CPU-serving
+    half of prompt handling — tokenize-and-fingerprint the prompts so
+    the denoise stage (and the hive's dedup/cache layers) can key on the
+    conditioning identity without re-reading free text. Runs fine on a
+    host advertising no chips."""
+    prompt = str(kwargs.get("prompt", ""))
+    negative = str(kwargs.get("negative_prompt", ""))
+    pipeline_config = {"stage": "encode", "model": model_name,
+                       "device": device_identifier}
+    with Span("encode", pipeline_config.setdefault("timings", {})):
+        doc = {
+            "model_name": model_name,
+            "prompt": prompt,
+            "negative_prompt": negative,
+            "prompt_sha256": hashlib.sha256(
+                prompt.encode("utf-8")).hexdigest(),
+            "negative_sha256": hashlib.sha256(
+                negative.encode("utf-8")).hexdigest(),
+            # whitespace tokens: an honest size hint, not a model vocab
+            "tokens_estimate": len(prompt.split()),
+        }
+        payload = json.dumps(doc, sort_keys=True).encode("utf-8")
+    artifacts = {
+        "conditioning": {
+            "blob": base64.b64encode(payload).decode("ascii"),
+            "content_type": "application/json",
+            "sha256_hash": hashlib.sha256(payload).hexdigest(),
+        }
+    }
+    return artifacts, pipeline_config
+
+
+def decode_callback(device_identifier: str, model_name: str, **kwargs):
+    """Decode/postprocess stage: the host-side tail of the monolithic
+    diffusion callback — NSFW check, grid composite, encode — applied to
+    the predecessor's raw rows. Package-identical to what the single
+    path produces from the same pixels."""
+    content_type = kwargs.pop("content_type", "image/jpeg")
+    outputs = kwargs.pop("outputs", ["primary"])
+    inputs = kwargs.pop("stage_inputs", [])
+    images = stage_images(inputs)
+    pipeline_config = {"stage": "decode", "model": model_name,
+                       "device": device_identifier, "rows": len(images)}
+    from ..pipelines.safety import flag_images
+
+    with Span("decode", pipeline_config.setdefault("timings", {})):
+        nsfw, checked = flag_images(images)
+        pipeline_config["nsfw"] = nsfw
+        pipeline_config["nsfw_checked"] = checked
+        processor = OutputProcessor(outputs, content_type)
+        processor.add_outputs(images)
+        results = processor.get_results()
+    return results, pipeline_config
+
+
+def upscale_stage_callback(device_identifier: str, model_name: str, **kwargs):
+    """Upscale stage: the learned sd-x2 latent upscaler as its own
+    leased chip stage (the monolithic path chains it inside one pass).
+    Consumes the denoise stage's raw rows, emits raw rows for decode.
+    Missing upscaler weights degrade to a recorded 2x resize — parity
+    with the monolithic path's fallback policy: never fail a job over
+    an auxiliary stage."""
+    inputs = kwargs.pop("stage_inputs", [])
+    rng = kwargs.pop("rng", None)
+    chipset = kwargs.pop("chipset", None)
+    params = kwargs.get("parameters") or {}
+    tiny = bool(kwargs.pop("test_tiny_model", False)
+                or (isinstance(params, dict)
+                    and params.get("test_tiny_model")))
+    if tiny:
+        from .diffusion import _tiny_stand_in
+
+        model_name = _tiny_stand_in(model_name)
+    images = stage_images(inputs)
+    pipeline_config = {"stage": "upscale", "model": model_name,
+                       "rows": len(images)}
+    timings = pipeline_config.setdefault("timings", {})
+    upscaler = None
+    try:
+        from ..registry import get_pipeline
+        from ..pipelines.upscale import upscaler_name_for
+        from ..weights import MissingWeightsError
+
+        try:
+            upscaler = get_pipeline(
+                upscaler_name_for(model_name),
+                pipeline_type="StableDiffusionLatentUpscalePipeline",
+                chipset=chipset,
+            )
+        except MissingWeightsError:
+            upscaler = None
+    except Exception:  # registry trouble: the resize fallback still serves
+        upscaler = None
+    with Span("upscale", timings):
+        if upscaler is not None:
+            images = upscaler.upscale(
+                list(images),
+                prompt=str(kwargs.get("prompt", "")),
+                negative_prompt=str(kwargs.get("negative_prompt", "")),
+                rng=rng,
+            )
+        else:
+            images = [
+                im.resize((im.width * 2, im.height * 2),
+                          Image.Resampling.LANCZOS)
+                for im in images
+            ]
+            pipeline_config["upscaler"] = "resize-fallback"
+    pipeline_config["upscaled"] = True
+    return {"raw": pack_raw(images)}, pipeline_config

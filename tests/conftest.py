@@ -108,14 +108,19 @@ def _module_uses_torch(path: str) -> bool:
 
 
 # Modules whose tests spawn whole child processes (bench rows, chaos
-# scenarios: each a fresh interpreter + jax compile set). On a small
-# CI box these dominate the suite's wall clock; they sort AFTER the
-# in-process tests (same rationale as the torch ordering below: bank
-# the hundreds of cheap results first, so an external timeout chops the
-# expensive integration tail rather than the unit tests that happen to
-# sort after "bench" alphabetically). They still run exactly once, and
-# still before the torch group — a torch segfault must not eat them.
-_SUBPROCESS_HEAVY_MODULES = {"test_bench", "test_chaos_smoke"}
+# scenarios: each a fresh interpreter + jax compile set) or compile a
+# whole pipeline family in-process from scratch (the IF cascade pair
+# and the svd golden-workflow module each jit multi-minute program
+# sets on a 1-core box; ~50 s per test, versus ~2 s for the median
+# unit test). On a small CI box these dominate the suite's wall
+# clock; they sort AFTER the in-process tests (same rationale as the
+# torch ordering below: bank the hundreds of cheap results first, so
+# an external timeout chops the expensive integration tail rather
+# than the unit tests that happen to sort after "bench"
+# alphabetically). They still run exactly once, and still before the
+# torch group — a torch segfault must not eat them.
+_HEAVY_TAIL_MODULES = {"test_bench", "test_chaos_smoke", "test_dag_svd",
+                       "test_cascade", "test_deepfloyd", "test_depth"}
 
 
 def pytest_collection_modifyitems(config, items):
@@ -140,7 +145,7 @@ def pytest_collection_modifyitems(config, items):
         if _module_uses_torch(str(item.fspath)):
             return 2
         name = item.module.__name__.rsplit(".", 1)[-1]
-        return 1 if name in _SUBPROCESS_HEAVY_MODULES else 0
+        return 1 if name in _HEAVY_TAIL_MODULES else 0
 
     items.sort(key=_order)
 

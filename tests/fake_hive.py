@@ -19,8 +19,12 @@ import uuid
 
 from aiohttp import web
 
-from chiaswarm_tpu.coalesce import adapter_ref, coalesce_key, job_rows
+from chiaswarm_tpu.coalesce import (CHIP_STAGES, adapter_ref, coalesce_key,
+                                    job_rows, stage_of)
 from chiaswarm_tpu.hive_server import accounting
+from chiaswarm_tpu.hive_server import dag as dag_mod
+from chiaswarm_tpu.hive_server.clock import CLOCK
+from chiaswarm_tpu.hive_server.queue import job_class
 from chiaswarm_tpu.hive_server.slo import SLOEngine, parse_slo
 
 
@@ -33,7 +37,16 @@ class _FakeRecord:
         self.job_id = str(job.get("id", ""))
         self.state = "queued"
         self.result: dict | None = None
-        self.timeline: list[dict] = []
+        # the real record's admit stamp leads its timeline; the DAG
+        # parent trace (ISSUE 20) merges these, and the settle->admit
+        # seam between stages is the `stage_handoff` attribution
+        self.timeline: list[dict] = [
+            {"event": "admit", "wall": round(time.time(), 3)}]
+        # duck-typed JobRecord surface the real DagTable aggregates over
+        self.attempts: int = 0
+        self.worker: str | None = None
+        self.queue_wait_s: float | None = None
+        self.placement: str | None = None
 
     @property
     def tenant(self) -> str:
@@ -47,6 +60,37 @@ class _FakeRecord:
             "status": self.state,
             "result": self.result,
         }
+
+
+class _FakeQueue:
+    """Duck-typed PriorityJobQueue facade for the REAL DagTable: stage
+    records live in FakeHive.records, admission appends to pending_jobs.
+    Running the real graph code over it is what keeps the fake's
+    workflow semantics (expansion, admission order, aggregation shapes)
+    incapable of drifting from the real coordinator's."""
+
+    def __init__(self, hive: "FakeHive"):
+        self.hive = hive
+
+    @property
+    def records(self) -> dict:
+        return self.hive.records
+
+    def submit(self, job: dict) -> _FakeRecord:
+        job_id = str(job.get("id", ""))
+        record = self.hive.records.get(job_id)
+        if record is None:
+            record = _FakeRecord(job)
+            self.hive.records[job_id] = record
+            self.hive.pending_jobs.append(job)
+        return record
+
+    def mark_cancelled(self, record, stage: str) -> None:
+        record.state = "cancelled"
+        self.hive.cancelled_ids.add(record.job_id)
+        self.hive.pending_jobs = [
+            j for j in self.hive.pending_jobs
+            if str(j.get("id")) != record.job_id]
 
 
 class FakeHive:
@@ -123,6 +167,12 @@ class FakeHive:
         self.artifacts: dict[str, bytes] = {}
         self.checkpoints: dict[str, dict] = {}
         self.previews: dict[str, list] = {}
+        # stage-graph parity (ISSUE 20): POST /api/workflows expands
+        # through the REAL DagTable — same expander, same admission,
+        # same parent aggregation — over the thin queue facade above,
+        # so the fake cannot drift from the graph wire contract
+        self.dag = dag_mod.DagTable(CLOCK)
+        self._queue = _FakeQueue(self)
         self._slo = SLOEngine(parse_slo(""))
         self._runner: web.AppRunner | None = None
         self.port: int | None = None
@@ -137,6 +187,11 @@ class FakeHive:
         app.router.add_post("/api/results", self._results)
         app.router.add_get("/api/models", self._models)
         app.router.add_post("/api/jobs", self._submit)
+        app.router.add_post("/api/workflows", self._workflow_submit)
+        app.router.add_get("/api/workflows/{workflow_id}",
+                           self._workflow_status)
+        app.router.add_get("/api/workflows/{workflow_id}/trace",
+                           self._workflow_trace)
         app.router.add_post("/api/jobs/{job_id}/cancel", self._cancel)
         app.router.add_post("/api/jobs/{job_id}/checkpoint", self._checkpoint)
         app.router.add_post("/api/jobs/{job_id}/preview", self._preview)
@@ -191,6 +246,64 @@ class FakeHive:
             "status": record.state,
             "depth": len(self.pending_jobs),
         })
+
+    async def _workflow_submit(self, request: web.Request) -> web.Response:
+        """POST /api/workflows, wire-parity with the real coordinator
+        (ISSUE 20): the submission expands through the real DagTable,
+        ready stages queue for the next stage-capable /work poll, and
+        the ACK shape matches app.py's byte for byte (conformance-
+        pinned)."""
+        denied = self._unauthorized(request)
+        if denied is not None:
+            return denied
+        refused = self._refuse_not_primary()
+        if refused is not None:
+            return refused
+        try:
+            payload = json.loads(await request.text())
+        except json.JSONDecodeError:
+            return web.json_response(
+                {"message": "workflow is not JSON"}, status=400)
+        if not isinstance(payload, dict):
+            return web.json_response(
+                {"message": "workflow must be a JSON object"}, status=400)
+        try:
+            wf, _ = self.dag.submit(payload, self._queue)
+        except dag_mod.WorkflowError as e:
+            return web.json_response({"message": str(e)}, status=400)
+        return web.json_response({
+            "id": wf.workflow_id,
+            "workflow": wf.job.get("workflow"),
+            "class": job_class(wf.job),
+            "tenant": wf.tenant,
+            "status": wf.state,
+            "stages": [{"stage": s["name"], "index": s["index"],
+                        "id": s["job_id"], "status": s["state"]}
+                       for s in wf.stages],
+            "depth": len(self.pending_jobs),
+        }, headers=self._epoch_headers())
+
+    async def _workflow_status(self, request: web.Request) -> web.Response:
+        denied = self._unauthorized(request)
+        if denied is not None:
+            return denied
+        wf = self.dag.workflows.get(request.match_info["workflow_id"])
+        if wf is None:
+            return web.json_response(
+                {"message": "unknown workflow id"}, status=404)
+        # the REAL parent aggregation over the fake's records
+        return web.json_response(self.dag.status(wf, self._queue))
+
+    async def _workflow_trace(self, request: web.Request) -> web.Response:
+        denied = self._unauthorized(request)
+        if denied is not None:
+            return denied
+        wf = self.dag.workflows.get(request.match_info["workflow_id"])
+        if wf is None:
+            return web.json_response(
+                {"message": "unknown workflow id"}, status=404)
+        return web.json_response(
+            self.dag.build_trace(wf, self._queue, CLOCK.wall()))
 
     async def _job_status(self, request: web.Request) -> web.Response:
         denied = self._unauthorized(request)
@@ -305,7 +418,27 @@ class FakeHive:
             if self.cancels:
                 reply["cancels"], self.cancels = sorted(self.cancels), []
             return web.json_response(reply, headers=self._epoch_headers())
-        jobs, self.pending_jobs = self.pending_jobs, []
+        # stage-typed placement (ISSUE 20), the same gate the real
+        # dispatcher applies: a stage-job only leaves with a poller that
+        # advertised its stage (`stages` csv) — legacy pollers never see
+        # graph work — and chip-path stages additionally need chips > 0
+        stage_aware = "stages" in request.query
+        advertised = {s for s in str(
+            request.query.get("stages", "")).split(",") if s}
+        try:
+            chips = int(request.query.get("chips", 0))
+        except (TypeError, ValueError):
+            chips = 0
+        jobs, held = [], []
+        for job in self.pending_jobs:
+            stage = stage_of(job)
+            if stage is not None and (
+                    not stage_aware or stage not in advertised
+                    or (stage in CHIP_STAGES and chips <= 0)):
+                held.append(job)
+            else:
+                jobs.append(job)
+        self.pending_jobs = held
         try:
             gang_rows = max(int(request.query.get("gang_rows", 1)), 1)
         except ValueError:
@@ -329,9 +462,22 @@ class FakeHive:
                 if gang_id is not None:
                     trace["gang"] = {"id": gang_id, "size": len(group),
                                      "index": index}
+                stage = job.get("stage")
+                if isinstance(stage, dict) and stage.get("workflow"):
+                    # stage-jobs (ISSUE 20) carry their graph coordinates
+                    # on the wire trace, same field set as the real
+                    # hive's wire_trace_context (conformance-pinned);
+                    # monolithic dispatches carry NO stage key
+                    trace["stage"] = {
+                        "workflow_id": str(stage.get("workflow")),
+                        "stage": str(stage.get("name", "")),
+                        "index": int(stage.get("index", 0)),
+                    }
                 record = self.records.get(job_id)
                 if record is not None:
                     record.state = "leased"
+                    record.attempts = attempt
+                    record.worker = request.query.get("worker_name")
                     record.timeline.append({
                         "event": "dispatch", "wall": round(time.time(), 3)})
                 handed_job = dict(job, trace=trace)
@@ -584,9 +730,46 @@ class FakeHive:
             record.result = envelope
             record.timeline.append({
                 "event": "settle", "wall": round(time.time(), 3)})
+            if record.job_id in self.dag.by_stage:
+                # stage-graph advance (ISSUE 20): spool the stage's
+                # artifacts to content-addressed refs (mirroring
+                # ArtifactSpool.store_result — successors' handoff
+                # inputs derive from the record's copy; self.results
+                # keeps the original envelope for test assertions),
+                # then let the REAL DagTable admit ready successors
+                record.result = self._spool_result(envelope)
+                self.dag.note_settle(record, self._queue)
         self.result_event.set()
         return web.json_response({"status": "ok"},
                                  headers=self._epoch_headers())
+
+    def _spool_result(self, envelope: dict) -> dict:
+        """ArtifactSpool.store_result parity for stage results: every
+        base64 blob becomes a content-addressed reference ({sha256,
+        bytes, href} + the artifact's other keys) served back by
+        GET /api/artifacts/{digest}."""
+        artifacts = envelope.get("artifacts")
+        if not isinstance(artifacts, dict):
+            return dict(envelope)
+        out = {}
+        for name, art in artifacts.items():
+            if not (isinstance(art, dict)
+                    and isinstance(art.get("blob"), str)):
+                out[name] = art
+                continue
+            try:
+                payload = base64.b64decode(art["blob"])
+            except (binascii.Error, ValueError):
+                out[name] = art
+                continue
+            digest = hashlib.sha256(payload).hexdigest()
+            self.artifacts[digest] = payload
+            ref = {k: v for k, v in art.items() if k != "blob"}
+            ref["sha256"] = digest
+            ref["bytes"] = len(payload)
+            ref["href"] = f"/api/artifacts/{digest}"
+            out[name] = ref
+        return dict(envelope, artifacts=out)
 
     async def _models(self, request: web.Request) -> web.Response:
         return web.json_response(

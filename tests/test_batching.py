@@ -61,11 +61,63 @@ def test_per_row_fields_stay_out_of_the_key():
     # unknown passthrough parameters are per-job behavior we refuse to
     # guess at: single path
     {"parameters": {"aesthetic_score": 9.0}},
-    {"model_name": "black-forest-labs/FLUX.1-dev"},  # no run_batched family
+    # flux WITHOUT explicit guidance: the solo default is variant-
+    # dependent (3.5, vs the UNet families' 7.5), so the key refuses
+    {"model_name": "black-forest-labs/FLUX.1-dev"},
     {"model_name": ""},
 ])
 def test_unbatchable_jobs_key_to_none(variant):
     assert coalesce_key(job(**variant)) is None
+
+
+# --- ISSUE 20 satellite: flux joins the coalesce vocabulary ---
+
+
+def flux_job(**overrides) -> dict:
+    j = job(model_name="black-forest-labs/FLUX.1-schnell",
+            parameters={"pipeline_type": "FluxPipeline",
+                        "guidance_scale": 3.5})
+    params = overrides.pop("parameters", None)
+    if params is not None:
+        j["parameters"].update(params)
+    j.update(overrides)
+    return j
+
+
+def test_flux_jobs_coalesce():
+    a = coalesce_key(flux_job())
+    b = coalesce_key(flux_job(id="job-2", prompt="a blue sphere", seed=7,
+                              num_images_per_prompt=3))
+    assert a is not None
+    assert a == b
+    # and never with the UNet families on the same canvas
+    assert a != coalesce_key(job())
+
+
+@pytest.mark.parametrize("variant", [
+    {"lora": "style-a"},           # no adapter delta path in the MMDiT
+    {"workflow": "img2img", "start_image_uri": "http://x/i.png",
+     "strength": 0.5},             # no coalesced img2img variant
+    {"parameters": {"controlnet": {
+        "control_image_uri": "http://x/c.png"}}},
+    {"num_inference_steps": None},  # variant-dependent solo default
+    {"parameters": {"guidance_scale": None}},
+    {"parameters": {"pipeline_type": "StableDiffusionPipeline"}},
+])
+def test_unbatchable_flux_jobs_key_to_none(variant):
+    j = flux_job(**variant)
+    if j.get("num_inference_steps") is None:
+        j.pop("num_inference_steps", None)
+    if j["parameters"].get("guidance_scale") is None:
+        j["parameters"].pop("guidance_scale", None)
+    assert coalesce_key(j) is None
+
+
+def test_flux_guidance_and_steps_split_the_bucket():
+    base = coalesce_key(flux_job())
+    assert coalesce_key(
+        flux_job(parameters={"guidance_scale": 7.0})) != base
+    assert coalesce_key(flux_job(num_inference_steps=4)) != base
 
 
 # --- ISSUE 13: adapter-aware coalescing ---

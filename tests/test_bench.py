@@ -147,6 +147,23 @@ def test_bench_cpu_fallback_produces_labeled_smoke_row():
     assert out.get("hive_e2e_resume_offers", 0) >= 1, out
     assert out.get("hive_e2e_preview_artifacts", 0) > 0, out
 
+    # stage-graph micro-serving (ISSUE 20): the txt2img chain served as
+    # a hive-visible DAG over a stage-typed two-worker fleet. Placement
+    # is deterministic by construction — the chip worker advertises no
+    # host stages, so EVERY encode stage must land on the chip-less
+    # host worker — and the pipelined burst must beat the strictly
+    # sequential serving of the same workflows (>1.0 is the unflaky CI
+    # floor; the artifact carries the measured ratio and the wall-clock
+    # seconds decode-of-N actually overlapped another pass's denoise)
+    assert out.get("dag_pipeline_workflows", 0) >= 2, out
+    assert out.get("dag_sequential_wall_s", 0) > 0, out
+    assert out.get("dag_pipelined_wall_s", 0) > 0, out
+    assert out.get("dag_overlap_speedup") is not None, out
+    assert out["dag_overlap_speedup"] > 1.0, out
+    assert out.get("dag_encode_stages", 0) >= 2, out
+    assert out.get("dag_encode_offload_rate") == 1.0, out
+    assert out.get("dag_decode_denoise_overlap_s", -1) >= 0, out
+
     # end-to-end tracing row (ISSUE 8): every settled job in the
     # hive_e2e scenario must carry a COMPLETE gap-free timeline —
     # admit/dispatch(placement)/settle events, an attributed queue-wait

@@ -13,8 +13,10 @@ a revived deposed primary whose stale-epoch ACK must be fenced
 (no double-settle), and a worker killed mid-denoise PAST a durable
 checkpoint with the hive SIGKILL'd on top (a second worker resumes from
 the checkpointed step via the redelivery's resume offer; exactly-once
-settle, gap-free trace) — must end with a healthy swarm and zero lost
-envelopes.
+settle, gap-free trace), and a stage-graph workflow whose hive is
+SIGKILL'd between two stage settles (WAL replay restores the graph; a
+fresh worker finishes the recovered stage off the spooled handoff) —
+must end with a healthy swarm and zero lost envelopes.
 """
 
 import importlib.util
@@ -48,6 +50,7 @@ def _load_tool():
     "hive_failover",
     "hive_split_brain_fenced",
     "resume_after_worker_kill",
+    "dag_survives_restart",
 ])
 def test_chaos_scenario(name, sdaas_root):
     tool = _load_tool()

@@ -858,6 +858,199 @@ def test_no_resume_offer_for_legacy_pollers(backend_name):
     run_conformance(backend_name, scenario)
 
 
+STAGE_CAPS = dict(CAPS, stages="encode,denoise,decode,postprocess")
+
+
+def chain_workflow(workflow_id: str, n: int = 2, **extra) -> dict:
+    """An explicit-chain workflow of echo stage-jobs (each mapping to
+    the CPU-servable `postprocess` stage), the simplest graph every
+    backend can run end to end."""
+    return {"id": workflow_id,
+            "stages": [{"workflow": "echo", "model_name": "none",
+                        "prompt": f"stage {i}"} for i in range(n)],
+            **extra}
+
+
+async def _post_workflow(backend, payload: dict):
+    """POST /api/workflows raw (the refusal status codes are part of
+    the wire contract under test)."""
+    async with aiohttp.ClientSession() as session:
+        async with session.post(
+                f"{backend.uri}/workflows", data=json.dumps(payload),
+                headers={"Authorization": f"Bearer {TOKEN}",
+                         "Content-type": "application/json"}) as resp:
+            return resp.status, await resp.json()
+
+
+def test_workflow_submit_ack_shape(backend_name):
+    """ISSUE 20: POST /api/workflows ACKs the expanded graph — parent
+    id, class/tenant attribution, running state, and one {stage, index,
+    id, status} entry per stage with ready roots already `queued` and
+    dependents `blocked`; resubmitting the same id is idempotent; an
+    inexpandable submission is a 400 with a message. Pinned across all
+    three backends so fake_hive cannot drift."""
+
+    async def scenario(backend, client):
+        ack = await client.submit_workflow(
+            dict(chain_workflow("conf-wf-ack"), tenant="acme"))
+        assert ack["id"] == "conf-wf-ack"
+        assert ack["status"] == "running"
+        assert ack["tenant"] == "acme"
+        assert isinstance(ack["class"], str) and ack["class"]
+        assert isinstance(ack["depth"], int)
+        stages = ack["stages"]
+        assert [s["index"] for s in stages] == [0, 1]
+        assert all(set(s) == {"stage", "index", "id", "status"}
+                   for s in stages)
+        assert stages[0]["id"] == "conf-wf-ack-s0-postprocess"
+        assert stages[0]["status"] == "queued"   # ready root admitted
+        assert stages[1]["status"] == "blocked"  # awaits its need
+        # idempotent resubmission: same graph, no duplicate stages
+        again = await client.submit_workflow(chain_workflow("conf-wf-ack"))
+        assert [s["id"] for s in again["stages"]] == [
+            s["id"] for s in stages]
+        # a workflow with no expansion is a 400 refusal, never a 500
+        status, payload = await _post_workflow(
+            backend, {"workflow": "txt2audio", "model_name": "m"})
+        assert status == 400 and "message" in payload
+        status, payload = await _post_workflow(backend, {"stages": []})
+        assert status == 400 and "message" in payload
+
+    run_conformance(backend_name, scenario)
+
+
+def test_stage_job_wire_trace_carries_graph_coordinates(backend_name):
+    """ISSUE 20: a dispatched stage-job's wire trace carries its graph
+    coordinates — exactly {workflow_id, stage, index} under
+    trace.stage — and the job itself carries the stage context with the
+    parent id and, for successors, the predecessor's spool handoff as
+    content-addressed input refs. A monolithic dispatch carries NO
+    stage key anywhere. Pinned across all three backends."""
+
+    async def scenario(backend, client):
+        await client.submit_workflow(chain_workflow("conf-wf-tr"))
+        [job] = await client.ask_for_work(dict(STAGE_CAPS))
+        assert job["id"] == "conf-wf-tr-s0-postprocess"
+        coords = job["trace"]["stage"]
+        assert coords == {"workflow_id": "conf-wf-tr",
+                          "stage": "postprocess", "index": 0}
+        assert job["stage"]["workflow"] == "conf-wf-tr"
+        assert job["stage"]["needs"] == []
+        # settle stage 0: its successor admits with the handoff inputs
+        await client.submit_result({
+            "id": job["id"],
+            "artifacts": {"primary": {"blob": "aGVsbG8=",
+                                      "content_type": "image/jpeg"}},
+            "nsfw": False, "worker_version": "0.1.0",
+            "pipeline_config": {}})
+        [nxt] = await client.ask_for_work(dict(STAGE_CAPS))
+        assert nxt["id"] == "conf-wf-tr-s1-postprocess"
+        assert nxt["trace"]["stage"]["index"] == 1
+        [handoff] = nxt["stage"]["inputs"]
+        assert handoff["stage"] == "postprocess" and handoff["index"] == 0
+        ref = handoff["artifacts"]["primary"]
+        assert "blob" not in ref  # refs travel, blobs stay spooled
+        assert ref["sha256"] == hashlib.sha256(b"hello").hexdigest()
+        assert ref["bytes"] == 5
+        # the href rehydrates the exact bytes through the worker's own
+        # artifact client — the spool handoff round-trips
+        assert await client.fetch_artifact(ref["href"]) == b"hello"
+        # a monolithic job's trace has no stage key at all
+        backend.queue_job(echo_job("conf-mono-tr"))
+        [mono] = await client.ask_for_work(dict(STAGE_CAPS))
+        assert "stage" not in mono["trace"] and "stage" not in mono
+
+    run_conformance(backend_name, scenario)
+
+
+def test_workflow_parent_aggregation(backend_name):
+    """ISSUE 20: GET /api/workflows/{id} aggregates the parent view —
+    per-stage lifecycle with attempts and worker, the pooled usage
+    totals across every stage-job, and (once done) the final stage's
+    envelope as the workflow result; the /trace twin merges every
+    stage's timeline with the settle->admit seam attributed as
+    `stage_handoff`. Pinned across all three backends."""
+
+    async def scenario(backend, client):
+        await client.submit_workflow(
+            dict(chain_workflow("conf-wf-agg"), tenant="acme"))
+        for index in range(2):
+            [job] = await client.ask_for_work(dict(STAGE_CAPS))
+            assert job["id"] == f"conf-wf-agg-s{index}-postprocess"
+            await client.submit_result({
+                "id": job["id"],
+                "artifacts": {"primary": {"blob": "aGVsbG8=",
+                                          "content_type": "image/jpeg"}},
+                "nsfw": False, "worker_version": "0.1.0",
+                "pipeline_config": {"timings": {"job_s": 0.5}}})
+        status, parent = await _get_json(backend, "/workflows/conf-wf-agg")
+        assert status == 200
+        assert parent["id"] == "conf-wf-agg"
+        assert parent["status"] == "done"
+        assert parent["tenant"] == "acme"
+        for s in parent["stages"]:
+            assert set(s) == {"stage", "index", "id", "status",
+                              "attempts", "worker"}
+            assert s["status"] == "done"
+            assert s["attempts"] >= 1
+            assert s["worker"] == "worker"
+        # both stage-jobs pool under the parent's usage totals
+        assert parent["usage"]["jobs"] == 2
+        assert parent["usage"]["chip_seconds"] == 1.0
+        # the final stage's spooled envelope IS the workflow result
+        ref = parent["result"]["artifacts"]["primary"]
+        assert ref["sha256"] == hashlib.sha256(b"hello").hexdigest()
+        status, trace = await _get_json(
+            backend, "/workflows/conf-wf-agg/trace")
+        assert status == 200
+        assert trace["workflow"] is True and trace["status"] == "done"
+        assert trace["stage_states"] == {"postprocess": "done"}
+        assert trace["open"] is False
+        assert any(g["attribution"] == "stage_handoff"
+                   for g in trace["gaps"])
+        # unknown workflow ids are a 404, on both surfaces
+        status, _ = await _get_json(backend, "/workflows/conf-nope")
+        assert status == 404
+        status, _ = await _get_json(backend, "/workflows/conf-nope/trace")
+        assert status == 404
+
+    run_conformance(backend_name, scenario)
+
+
+def test_stage_jobs_opaque_to_legacy_pollers(backend_name):
+    """ISSUE 20: stage-typed placement on the wire — a poller that does
+    not advertise `stages` NEVER receives a stage-job (legacy opacity),
+    a poller advertising the wrong stages waits too, chip-path stages
+    (denoise) refuse chip-less hosts even when advertised, and a
+    stage-aware poller still receives monolithic work unchanged.
+    Pinned across all three backends so fake_hive cannot drift."""
+
+    async def scenario(backend, client):
+        await client.submit_workflow(chain_workflow("conf-wf-leg", n=1))
+        # legacy poller: no `stages` param -> no graph work, ever
+        assert await client.ask_for_work(dict(CAPS)) == []
+        # wrong stage set advertised -> still withheld
+        assert await client.ask_for_work(
+            dict(CAPS, stages="encode,decode")) == []
+        # chip stage on a chip-less host: a denoise stage-job is
+        # withheld even from a poller advertising the stage
+        await client.submit_workflow({
+            "id": "conf-wf-chip",
+            "stages": [gang_job(0)]})  # txt2img -> the denoise stage
+        assert await client.ask_for_work(
+            dict(CAPS, chips=0, stages="denoise")) == []
+        # the right advertisement drains both
+        jobs = await client.ask_for_work(dict(STAGE_CAPS))
+        assert {j["id"] for j in jobs} == {
+            "conf-wf-leg-s0-postprocess", "conf-wf-chip-s0-denoise"}
+        # monolithic work still flows to a stage-aware poller
+        backend.queue_job(echo_job("conf-mono-leg"))
+        [mono] = await client.ask_for_work(dict(STAGE_CAPS))
+        assert mono["id"] == "conf-mono-leg"
+
+    run_conformance(backend_name, scenario)
+
+
 def test_preview_partial_disposition(backend_name):
     """ISSUE 18: progressive previews surface on GET /api/jobs/{id} as
     the `partial` disposition — {"previews": [{"step", "href"}, ...],

@@ -280,6 +280,23 @@ swarm_hive_checkpoints_total{outcome="superseded"} 3
 swarm_hive_previews_total{outcome="stored"} 2
 # TYPE swarm_hive_resume_offers_total counter
 swarm_hive_resume_offers_total 1
+# TYPE swarm_hive_dag_stages_total counter
+swarm_hive_dag_stages_total{stage="denoise",outcome="admitted"} 4
+swarm_hive_dag_stages_total{stage="denoise",outcome="done"} 3
+swarm_hive_dag_stages_total{stage="encode",outcome="done"} 4
+swarm_hive_dag_stages_total{stage="decode",outcome="cancelled"} 1
+# TYPE swarm_hive_dag_ready_depth gauge
+swarm_hive_dag_ready_depth 2
+# TYPE swarm_hive_dag_workflows gauge
+swarm_hive_dag_workflows{state="running"} 1
+swarm_hive_dag_workflows{state="done"} 3
+swarm_hive_dag_workflows{state="cancelled"} 1
+# TYPE swarm_hive_dag_stage_queue_wait_seconds histogram
+swarm_hive_dag_stage_queue_wait_seconds_bucket{stage="denoise",le="0.1"} 1
+swarm_hive_dag_stage_queue_wait_seconds_bucket{stage="denoise",le="1"} 3
+swarm_hive_dag_stage_queue_wait_seconds_bucket{stage="denoise",le="+Inf"} 3
+swarm_hive_dag_stage_queue_wait_seconds_sum{stage="denoise"} 1.2
+swarm_hive_dag_stage_queue_wait_seconds_count{stage="denoise"} 3
 """
 
 
@@ -325,6 +342,21 @@ def test_hive_tables_from_synthetic_text():
         "previews": {"stored": 2},
         "resume_offers": 1,
     }
+    # stage-graph serving (ISSUE 20): workflow population, ready depth,
+    # per-stage outcomes, and per-stage queue-wait quantiles
+    assert summary["dag"] == {
+        "workflows": {"cancelled": 1, "done": 3, "running": 1},
+        "ready_depth": 2,
+        "stages": {
+            "decode": {"cancelled": 1},
+            "denoise": {"admitted": 4, "done": 3},
+            "encode": {"done": 4},
+        },
+        "stage_queue_wait": [{
+            "stage": "denoise", "count": 3,
+            "p50_le_s": 1.0, "p95_le_s": 1.0,
+        }],
+    }
 
     table = tool.render_hive_tables(summary)
     assert "affinity" in table and "6" in table
@@ -346,6 +378,15 @@ def test_hive_tables_from_synthetic_text():
     assert "hive outliers w-slow" in table
     assert ("hive partials checkpoints stored=4 superseded=3  "
             "previews stored=2  resume_offers=1") in table
+    assert ("hive dag      running=1 done=3 failed=0 cancelled=1 "
+            "ready_depth=2") in table
+    assert "hive dag stages (lifecycle outcomes)" in table
+    assert "denoise      admitted=4 done=3" in table
+    assert "hive dag stage wait (admit -> first dispatch)" in table
+    # a fleet that never submitted a workflow renders no dag block
+    assert tool.dag_summary([]) is None
+    assert "hive dag" not in tool.render_hive_tables(
+        tool.hive_summary([]))
 
 
 def test_json_mode_emits_machine_readable_twin(monkeypatch, capsys):
@@ -372,6 +413,8 @@ def test_json_mode_emits_machine_readable_twin(monkeypatch, capsys):
     assert payload["hive"]["slo"]["interactive"]["fast_burn"] == 2.4
     assert payload["hive"]["dispatch"]["affinity"] == 6
     assert payload["hive"]["partials"]["resume_offers"] == 1
+    assert payload["hive"]["dag"]["ready_depth"] == 2
+    assert payload["hive"]["dag"]["stages"]["denoise"]["done"] == 3
     # the synthetic worker never checkpointed: the twin is null, not {}
     assert payload["worker"]["resume"] is None
     stages = {r["stage"]: r for r in payload["worker"]["stages"]}

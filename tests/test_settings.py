@@ -283,6 +283,7 @@ EXPECTED_FIELDS = (
     "hive_standby_of", "hive_replication_poll_s", "hive_failover_grace_s",
     "hive_replication_lag_degraded_s", "hive_failover_errors",
     "memory_headroom_degraded",
+    "stage_roles", "stage_workers", "hive_dag_history",
 )
 
 
@@ -344,6 +345,26 @@ def test_preemption_knobs(sdaas_root, monkeypatch):
     assert s.hive_flap_threshold == 0  # 0 disables flap holds entirely
     monkeypatch.undo()
     assert load_settings().checkpoint_every_chunks == 0
+
+
+def test_stage_graph_knobs(sdaas_root, monkeypatch):
+    """ISSUE 20: stage-typed placement layers like every other setting —
+    `auto` advertisement derives stages from hardware, two host-path
+    lane slots so decode overlaps the next denoise, a bounded workflow
+    history, env overrides win."""
+    s = load_settings()
+    assert s.stage_roles == "auto"
+    assert s.stage_workers == 2
+    assert s.hive_dag_history == 256
+    monkeypatch.setenv("CHIASWARM_STAGE_ROLES", "encode,decode")
+    monkeypatch.setenv("CHIASWARM_STAGE_WORKERS", "0")
+    monkeypatch.setenv("CHIASWARM_HIVE_DAG_HISTORY", "16")
+    s = load_settings()
+    assert s.stage_roles == "encode,decode"
+    assert s.stage_workers == 0  # 0 disables the host-path side lane
+    assert s.hive_dag_history == 16
+    monkeypatch.undo()
+    assert load_settings().stage_roles == "auto"
 
 
 def test_program_cache_knob(sdaas_root, monkeypatch):

@@ -90,6 +90,21 @@ swarm_hive_checkpoints_total{outcome="superseded"} 3
 swarm_hive_previews_total{outcome="stored"} 2
 # TYPE swarm_hive_resume_offers_total counter
 swarm_hive_resume_offers_total 1
+# TYPE swarm_hive_dag_stages_total counter
+swarm_hive_dag_stages_total{stage="denoise",outcome="admitted"} 3
+swarm_hive_dag_stages_total{stage="denoise",outcome="done"} 2
+swarm_hive_dag_stages_total{stage="encode",outcome="done"} 3
+# TYPE swarm_hive_dag_ready_depth gauge
+swarm_hive_dag_ready_depth 1
+# TYPE swarm_hive_dag_workflows gauge
+swarm_hive_dag_workflows{state="running"} 1
+swarm_hive_dag_workflows{state="done"} 2
+# TYPE swarm_hive_dag_stage_queue_wait_seconds histogram
+swarm_hive_dag_stage_queue_wait_seconds_bucket{stage="denoise",le="0.1"} 0
+swarm_hive_dag_stage_queue_wait_seconds_bucket{stage="denoise",le="1"} 2
+swarm_hive_dag_stage_queue_wait_seconds_bucket{stage="denoise",le="+Inf"} 2
+swarm_hive_dag_stage_queue_wait_seconds_sum{stage="denoise"} 0.9
+swarm_hive_dag_stage_queue_wait_seconds_count{stage="denoise"} 2
 """
 
 WORKER_METRICS = """\
@@ -140,6 +155,8 @@ def test_render_hive_and_worker_frames_from_synthetic_data():
                     "fast_burn": 3.2, "slow_burn": 0.4,
                     "compliance": 0.84, "breaching": True}},
                 "stragglers": {"w-slow": ["job"], "w-fast": []},
+                "workflows": {"total": 3, "ready_stages": 1, "running": 1,
+                              "done": 2, "failed": 0, "cancelled": 0},
                 "wal": {"appends_since_compact": 7, "torn_lines": 0,
                         "replayed_events": 0}})
     lines = "\n".join(tool.render_hive(hive, None))
@@ -174,6 +191,12 @@ def test_render_hive_and_worker_frames_from_synthetic_data():
     # preemption plane (ISSUE 18): checkpoint/preview/resume-offer flow
     assert ("partials  checkpoints stored=4 superseded=3  "
             "previews stored=2  resume_offers=1") in lines
+    # stage-graph serving (ISSUE 20): workflow population from healthz,
+    # per-stage lifecycle outcomes + queue-wait quantiles from /metrics
+    assert ("workflows total=3 running=1 done=2 failed=0 cancelled=0 "
+            "ready_stages=1") in lines
+    assert ("dag       denoise[admitted=3 done=2 wait p50<=1s] "
+            "encode[done=3]") in lines
 
     worker = tool.Snapshot(
         "http://w:8061",

@@ -1115,6 +1115,202 @@ async def scenario_resume_after_worker_kill() -> str:
             f"{resumed['from_step']} and settled exactly once")
 
 
+async def scenario_dag_survives_restart() -> str:
+    """Stage-graph durability (ISSUE 20 acceptance): the hive is
+    SIGKILL'd BETWEEN two stage settles of one workflow. WAL replay
+    (ev_dag + the stage-job records) must restore the graph — edges
+    intact, stage 0 done with its spooled handoff still fetchable,
+    stage 1 admitted and pending — and a fresh stage-capable worker
+    must complete the remaining stage EXACTLY once, leaving the parent
+    trace gap-free across the crash."""
+    import base64
+    import hashlib
+    import json
+    import os
+    import socket
+    import subprocess
+
+    import aiohttp
+
+    from chiaswarm_tpu.hive_server.trace import trace_missing
+
+    faults.configure("")
+    token = "chaos"
+    repo = str(pathlib.Path(__file__).resolve().parent.parent)
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+    env = dict(os.environ, SDAAS_TOKEN=token,
+               CHIASWARM_HIVE_PORT=str(port),
+               # the pre-crash lease belongs to a SYNTHETIC worker that
+               # settles by hand — a short deadline would race its settle
+               CHIASWARM_HIVE_LEASE_DEADLINE_S="600.0",
+               PYTHONPATH=repo + os.pathsep + os.environ.get("PYTHONPATH", ""))
+    uri = f"http://127.0.0.1:{port}"
+    headers = {"Authorization": f"Bearer {token}",
+               "Content-type": "application/json"}
+
+    def spawn() -> subprocess.Popen:
+        return subprocess.Popen(
+            [sys.executable, "-m", "chiaswarm_tpu.hive_server"],
+            cwd=repo, env=env,
+            stdout=subprocess.DEVNULL, stderr=subprocess.STDOUT)
+
+    async def wait_up(session) -> bool:
+        for _ in range(200):
+            try:
+                async with session.get(f"{uri}/healthz") as r:
+                    if r.status in (200, 503):
+                        return True
+            except aiohttp.ClientError:
+                pass
+            await asyncio.sleep(0.1)
+        return False
+
+    procs = [spawn()]
+    w = runner = None
+    try:
+        async with aiohttp.ClientSession() as session:
+            _check(await wait_up(session),
+                   "hive subprocess never answered /healthz")
+            # an explicit 2-stage echo chain: no model weights, and both
+            # stages are host ("postprocess") work a chip-less worker
+            # serves — the scenario is about the GRAPH, not the pipeline
+            workflow = {"id": "chaos-dag", "stages": [
+                {"workflow": "echo", "model_name": "none",
+                 "prompt": "stage zero"},
+                {"workflow": "echo", "model_name": "none",
+                 "prompt": "stage one"},
+            ]}
+            async with session.post(f"{uri}/api/workflows",
+                                    data=json.dumps(workflow),
+                                    headers=headers) as r:
+                _check(r.status == 200, f"workflow submit -> {r.status}")
+                ack = await r.json()
+            _check([s["status"] for s in ack["stages"]]
+                   == ["queued", "blocked"],
+                   f"expansion did not gate stage 1 on stage 0: {ack}")
+            s0_id = ack["stages"][0]["id"]
+
+            # a synthetic stage-capable worker settles stage 0 BY HAND:
+            # deterministic — nobody is around to take stage 1 when the
+            # settle unblocks it, so the SIGKILL lands exactly between
+            # the two stage settles
+            async with session.get(
+                    f"{uri}/api/work",
+                    params={"worker_version": "0.1.0",
+                            "worker_name": "dag-doomed-w",
+                            "stages": "encode,denoise,decode,postprocess"},
+                    headers=headers) as r:
+                jobs = (await r.json())["jobs"]
+            _check([j["id"] for j in jobs] == [s0_id],
+                   f"expected exactly stage 0 handed out, got "
+                   f"{[j.get('id') for j in jobs]}")
+            _check(jobs[0]["trace"].get("stage")
+                   == {"workflow_id": "chaos-dag", "stage": "postprocess",
+                       "index": 0},
+                   f"stage-job trace lacks graph coordinates: "
+                   f"{jobs[0].get('trace')}")
+            handoff_bytes = b"chaos dag stage zero output"
+            envelope = {
+                "id": s0_id,
+                "artifacts": {"primary": {
+                    "blob": base64.b64encode(handoff_bytes).decode("ascii"),
+                    "content_type": "text/plain"}},
+                "nsfw": False, "worker_version": "0.1.0",
+                "pipeline_config": {"timings": {"job_s": 0.25}},
+                "worker_name": "dag-doomed-w"}
+            async with session.post(f"{uri}/api/results",
+                                    data=json.dumps(envelope),
+                                    headers=headers) as r:
+                _check(r.status == 200, f"stage 0 settle -> {r.status}")
+
+            async def wf_status() -> dict:
+                async with session.get(f"{uri}/api/workflows/chaos-dag",
+                                       headers=headers) as r:
+                    _check(r.status == 200,
+                           f"workflow lost (HTTP {r.status})")
+                    return await r.json()
+
+            st = await wf_status()
+            _check([s["status"] for s in st["stages"]]
+                   == ["done", "queued"],
+                   f"settle did not unblock stage 1: {st['stages']}")
+
+            procs[0].kill()  # SIGKILL: no drain, no atexit, no flush
+            procs[0].wait()
+            procs.append(spawn())  # same $SDAAS_ROOT, same port
+            _check(await wait_up(session),
+                   "restarted hive never answered /healthz")
+
+            st = await wf_status()
+            _check(st["status"] == "running"
+                   and [s["status"] for s in st["stages"]]
+                   == ["done", "queued"],
+                   f"WAL replay lost the graph state: {st}")
+
+            # a fresh chip-less worker (stage lane only) completes the
+            # recovered ready stage off the spooled handoff — proving
+            # the edges AND the content-addressed artifact survived
+            w = Worker(settings=_settings(worker_name="chaos-dag-w2"),
+                       allocator=SliceAllocator(chips_per_job=0),
+                       hive_uri=f"{uri}/api")
+            runner = asyncio.create_task(w.run())
+            deadline = asyncio.get_running_loop().time() + 30.0
+            while (st := await wf_status())["status"] != "done":
+                _check(st["status"] == "running",
+                       f"workflow ended {st['status']} after the restart")
+                _check(asyncio.get_running_loop().time() < deadline,
+                       f"workflow never finished after the restart: {st}")
+                await asyncio.sleep(0.1)
+            _check([s["status"] for s in st["stages"]] == ["done", "done"],
+                   f"stage states wrong at the end: {st['stages']}")
+            _check(st["stages"][1]["worker"] == "chaos-dag-w2",
+                   "recovered stage not completed by the fresh worker")
+            _check(st["usage"]["jobs"] == 2,
+                   f"parent usage lost a stage: {st['usage']}")
+            primary = st["result"]["artifacts"]["primary"]
+            _check("blob" not in primary and primary.get("href"),
+                   f"final result not spool-referenced: {primary}")
+            async with session.get(f"{uri}{primary['href']}",
+                                   headers=headers) as r:
+                _check(r.status == 200, f"final artifact -> {r.status}")
+                blob = await r.read()
+            _check(hashlib.sha256(blob).hexdigest() == primary["sha256"],
+                   "final artifact bytes drifted from their digest")
+
+            # the parent trace spans the SIGKILL gap-free, every stage
+            # settled exactly once, and the settle->admit seam is
+            # attributed as the stage handoff it is
+            async with session.get(f"{uri}/api/workflows/chaos-dag/trace",
+                                   headers=headers) as r:
+                _check(r.status == 200, f"workflow trace -> {r.status}")
+                trace = await r.json()
+            missing = trace_missing(trace)
+            _check(not missing,
+                   f"parent trace incomplete across SIGKILL: {missing}")
+            kinds = [e["event"] for e in trace["events"]]
+            _check(kinds.count("settle") == 2,
+                   f"stages did not settle exactly once: {kinds}")
+            _check(trace["workflow"] is True and trace["open"] is False,
+                   f"parent trace not closed: {trace['status']}")
+            _check(any(g["attribution"] == "stage_handoff"
+                       for g in trace["gaps"]),
+                   f"settle->admit seam not attributed: {trace['gaps']}")
+    finally:
+        if w is not None:
+            w.stop()
+        if runner is not None:
+            await asyncio.wait_for(
+                asyncio.gather(runner, return_exceptions=True), 10)
+        for proc in procs:
+            proc.kill()
+            proc.wait()
+    return ("workflow graph survived a hive SIGKILL between stage "
+            "settles; a fresh worker finished the recovered stage off "
+            "the spooled handoff, exactly once, gap-free")
+
+
 SCENARIOS = {
     "drop_submit": scenario_drop_submit,
     "hive_connection_drop": scenario_hive_connection_drop,
@@ -1129,6 +1325,7 @@ SCENARIOS = {
     "hive_failover": scenario_hive_failover,
     "hive_split_brain_fenced": scenario_hive_split_brain_fenced,
     "resume_after_worker_kill": scenario_resume_after_worker_kill,
+    "dag_survives_restart": scenario_dag_survives_restart,
 }
 
 

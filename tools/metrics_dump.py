@@ -246,9 +246,55 @@ def _slo_summary(samples) -> dict:
     }
 
 
+def dag_summary(samples) -> dict | None:
+    """Stage-graph serving summary (ISSUE 20): workflow population by
+    aggregate state, the ready depth (stage-jobs admitted but not yet
+    settled), per-stage lifecycle outcomes, and per-stage queue-wait
+    quantiles (admit -> first dispatch). None when the hive never
+    tracked a workflow — classic single-stage fleets render nothing."""
+    stages: dict[str, dict[str, int]] = {}
+    for metric, labels, value in samples:
+        if metric == "swarm_hive_dag_stages_total" \
+                and "stage" in labels and "outcome" in labels:
+            stages.setdefault(labels["stage"], {})[labels["outcome"]] = \
+                int(value)
+    workflows = {k: int(v) for k, v in sorted(_label_counts(
+        samples, "swarm_hive_dag_workflows", "state").items())}
+    ready = _gauge_value(samples, "swarm_hive_dag_ready_depth")
+    if not stages and not any(workflows.values()) and ready is None:
+        return None
+    waits = []
+    for stage in sorted(stages):
+        buckets, count = [], 0.0
+        for metric, labels, value in samples:
+            if labels.get("stage") != stage:
+                continue
+            if metric == "swarm_hive_dag_stage_queue_wait_seconds_bucket":
+                le = labels.get("le", "+Inf")
+                buckets.append(
+                    (float("inf") if le == "+Inf" else float(le), value))
+            elif metric == "swarm_hive_dag_stage_queue_wait_seconds_count":
+                count = value
+        if count:
+            waits.append({
+                "stage": stage, "count": int(count),
+                "p50_le_s": _quantile_from_buckets(buckets, count, 0.5),
+                "p95_le_s": _quantile_from_buckets(buckets, count, 0.95),
+            })
+    return {
+        "workflows": workflows,
+        "ready_depth": int(ready or 0),
+        "stages": {s: dict(sorted(o.items()))
+                   for s, o in sorted(stages.items())},
+        "stage_queue_wait": waits,
+    }
+
+
 def hive_summary(samples) -> dict:
     """Exposition samples -> the hive-side dispatch/shed/lease view."""
     return {
+        # stage-graph serving (ISSUE 20)
+        "dag": dag_summary(samples),
         # fleet observability plane (ISSUE 11)
         "tenants": _tenant_summary(samples),
         "slo": _slo_summary(samples),
@@ -373,6 +419,30 @@ def render_hive_tables(summary: dict) -> str:
                 f"{o}={n}" for o, n in partials["previews"].items()))
         bits.append(f"resume_offers={partials.get('resume_offers', 0)}")
         lines.append("hive partials " + "  ".join(bits))
+
+    # stage-graph serving (ISSUE 20): workflow population, ready depth,
+    # and per-stage outcomes + queue-wait quantiles — absent entirely on
+    # fleets that never submitted a workflow
+    dag = summary.get("dag")
+    if dag:
+        wf = dag["workflows"]
+        lines.append(
+            "hive dag      "
+            + " ".join(f"{s}={wf.get(s, 0)}"
+                       for s in ("running", "done", "failed", "cancelled"))
+            + f" ready_depth={dag['ready_depth']}")
+        if dag["stages"]:
+            lines.append("hive dag stages (lifecycle outcomes)")
+            for stage, outcomes in dag["stages"].items():
+                lines.append(
+                    f"  {stage:<12} "
+                    + " ".join(f"{o}={n}" for o, n in outcomes.items()))
+        if dag["stage_queue_wait"]:
+            lines.append("hive dag stage wait (admit -> first dispatch)")
+            for r in dag["stage_queue_wait"]:
+                lines.append(
+                    f"  {r['stage']:<12} n={r['count']:<6} "
+                    f"p50<={fmt(r['p50_le_s'])} p95<={fmt(r['p95_le_s'])}")
 
     for key, title in (("queue_wait", "hive queue wait"),
                        ("dispatch_to_settle", "hive dispatch->settle")):

@@ -178,6 +178,41 @@ def render_hive(cur: Snapshot, prev: Snapshot | None) -> list[str]:
         f"  queue     {' '.join(depths)}  "
         f"leases={int(h.get('leases_active', 0))}")
 
+    # stage-graph serving (ISSUE 20): workflow population by aggregate
+    # state + ready depth on one line, then per-stage lifecycle outcomes
+    # with queue-wait quantiles over the last interval
+    wf = h.get("workflows") or {}
+    dag_stages: dict[str, dict[str, float]] = {}
+    for metric, labels, value in cur.samples:
+        if metric == "swarm_hive_dag_stages_total" and "stage" in labels:
+            dag_stages.setdefault(labels["stage"], {})[
+                labels.get("outcome", "?")] = value
+    if wf.get("total") or dag_stages:
+        ready = int(cur.gauge("swarm_hive_dag_ready_depth")
+                    or wf.get("ready_stages", 0) or 0)
+        lines.append(
+            f"  workflows total={int(wf.get('total', 0))} "
+            f"running={int(wf.get('running', 0))} "
+            f"done={int(wf.get('done', 0))} "
+            f"failed={int(wf.get('failed', 0))} "
+            f"cancelled={int(wf.get('cancelled', 0))} "
+            f"ready_stages={ready}")
+        parts = []
+        for stage in sorted(dag_stages):
+            outcomes = " ".join(
+                f"{o}={int(n)}"
+                for o, n in sorted(dag_stages[stage].items()))
+            buckets = bucket_delta(
+                cur.histogram("swarm_hive_dag_stage_queue_wait_seconds",
+                              stage=stage),
+                prev.histogram("swarm_hive_dag_stage_queue_wait_seconds",
+                               stage=stage) if prev else None)
+            p50 = quantile_from_buckets(buckets, 0.5)
+            wait = "" if p50 is None else f" wait p50<={fmt_s(p50)}"
+            parts.append(f"{stage}[{outcomes}{wait}]")
+        if parts:
+            lines.append("  dag       " + " ".join(parts))
+
     dispatch = cur.counters("swarm_hive_dispatch_total", "outcome")
     pdispatch = prev.counters(
         "swarm_hive_dispatch_total", "outcome") if prev else {}
